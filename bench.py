@@ -1,0 +1,117 @@
+#!/usr/bin/env python
+"""Benchmark driver — runs on the real TPU chip.
+
+Reproduces the reference's test-oracle benchmark: Llama-3.2-1B shapes truncated
+to 4 layers, random weights, batch 2, context 64, measuring the
+token-generation (TKG) step latency. Reference p50 on trn2 tp=32:
+0.670 ms (test/integration/tp32/models/llama/llama3.2/1b/
+test_llama3_2_1b_4layer.py:40; see BASELINE.md). Here: ONE v5e chip, tp=1.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "ms", "vs_baseline": N}
+vs_baseline > 1.0 means faster than the reference oracle.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+BASELINE_TKG_P50_MS = 0.670  # reference oracle (tp32 trn2), BASELINE.md
+
+
+def main():
+    import jax
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+    from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
+
+    batch_size = 2
+    seq_len = 64
+
+    tcfg = TpuConfig(
+        tp_degree=1,
+        batch_size=batch_size,
+        seq_len=seq_len,
+        max_context_length=seq_len // 2,
+        dtype="bfloat16",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=False,
+    )
+    # Llama-3.2-1B hyperparams, 4 layers (reference oracle config)
+    cfg = ml.LlamaInferenceConfig(
+        tcfg,
+        hidden_size=2048,
+        intermediate_size=8192,
+        num_hidden_layers=4,
+        num_attention_heads=32,
+        num_key_value_heads=8,
+        head_dim=64,
+        vocab_size=128256,
+        rms_norm_eps=1e-5,
+        rope_theta=500000.0,
+    )
+
+    rng = np.random.default_rng(0)
+    arch = ml.build_arch(cfg)
+    struct = params_shape_struct(ml, cfg, arch)
+
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    def rand(s):
+        return (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16
+        )
+
+    state = jtu.tree_map(rand, struct)
+
+    class App(TpuModelForCausalLM):
+        def build_params(self):
+            return state
+
+    app = App("<random>", cfg, model_family=ml)
+    app.load()
+
+    # prefill once to populate the cache
+    prompt_len = 32
+    prompt = rng.integers(0, 1000, size=(batch_size, prompt_len)).astype(np.int32)
+    pos = np.tile(np.arange(prompt_len, dtype=np.int32), (batch_size, 1))
+    out = app.forward(prompt, pos, last_token_index=np.full((batch_size,), prompt_len - 1, dtype=np.int32))
+    tok = np.asarray(jax.device_get(out["tokens"]))[:, 0]
+
+    # timed TKG steps
+    n_iters = 200
+    lat = []
+    p = prompt_len
+    for i in range(n_iters):
+        t0 = time.perf_counter()
+        out = app.forward(
+            tok[:, None].astype(np.int32),
+            np.full((batch_size, 1), p, dtype=np.int32),
+            last_token_index=np.zeros((batch_size,), dtype=np.int32),
+        )
+        jax.block_until_ready(out["tokens"])
+        lat.append((time.perf_counter() - t0) * 1000.0)
+        tok = np.asarray(jax.device_get(out["tokens"]))[:, 0]
+        p = min(p + 1, seq_len - 1)
+
+    p50 = float(np.percentile(lat, 50))
+    print(
+        json.dumps(
+            {
+                "metric": "llama3.2-1b-4layer_tkg_step_p50",
+                "value": round(p50, 4),
+                "unit": "ms",
+                "vs_baseline": round(BASELINE_TKG_P50_MS / p50, 4),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

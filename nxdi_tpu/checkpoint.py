@@ -1,0 +1,202 @@
+"""HF checkpoint ingestion and saving.
+
+Loads a HuggingFace-style checkpoint directory (safetensors, sharded safetensors
+with an index, or pytorch ``.bin``) into a flat ``{name: np.ndarray}`` dict, and
+saves state dicts back out as safetensors.
+
+Reference behavior being reproduced (not ported line-by-line):
+  - modules/checkpoint.py:24 ``load_state_dict`` — dir containing
+    ``model.safetensors`` | ``model.safetensors.index.json`` | ``pytorch_model.bin``(+index)
+  - modules/checkpoint.py:171 ``save_state_dict_safetensors`` with sharding by size
+  - modules/checkpoint.py:202 ``create_n_layer_checkpoint`` for tiny test models
+
+All tensors come back as numpy (host) arrays; device placement and sharding are
+the runtime's job (parallel/mesh.py), keeping IO independent of jax state.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, Optional
+
+import numpy as np
+
+SAFETENSORS_MODEL = "model.safetensors"
+SAFETENSORS_INDEX = "model.safetensors.index.json"
+PYTORCH_MODEL = "pytorch_model.bin"
+PYTORCH_INDEX = "pytorch_model.bin.index.json"
+
+# torch is CPU-only in this image and used strictly for .bin deserialization and
+# bf16<->numpy conversion (numpy has no native bfloat16).
+try:
+    import torch  # noqa: F401
+
+    _HAS_TORCH = True
+except ImportError:  # pragma: no cover
+    _HAS_TORCH = False
+
+import ml_dtypes
+
+
+def _torch_to_numpy(t) -> np.ndarray:
+    import torch
+
+    t = t.detach().contiguous().cpu()
+    if t.dtype == torch.bfloat16:
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    if t.dtype == torch.float8_e4m3fn:
+        return t.view(torch.uint8).numpy().view(ml_dtypes.float8_e4m3fn)
+    return t.numpy()
+
+
+def _load_safetensors_file(path: str) -> Dict[str, np.ndarray]:
+    # framework="pt" handles every dtype (the numpy framework rejects bf16/fp8)
+    # and is preferred when torch is present; otherwise fall back to numpy,
+    # which suffices for fp32/fp16/int checkpoints.
+    from safetensors import safe_open
+
+    out = {}
+    if _HAS_TORCH:
+        with safe_open(path, framework="pt") as f:
+            for k in f.keys():
+                out[k] = _torch_to_numpy(f.get_tensor(k))
+        return out
+    try:
+        with safe_open(path, framework="np") as f:
+            for k in f.keys():
+                out[k] = f.get_tensor(k)
+    except (TypeError, ValueError) as e:
+        raise RuntimeError(
+            f"Loading {path} requires torch (bf16/fp8 tensors cannot be read "
+            f"via the numpy framework): {e}"
+        ) from e
+    return out
+
+
+def load_state_dict(model_path: str) -> Dict[str, np.ndarray]:
+    """Load a full (unsharded view of a possibly sharded) checkpoint directory.
+
+    Mirrors reference modules/checkpoint.py:24-170 dispatch order: safetensors
+    file, safetensors index, pytorch bin, pytorch bin index.
+    """
+    model_path = str(model_path)
+    if os.path.isfile(model_path):
+        return _load_checkpoint_file(model_path)
+    if not os.path.isdir(model_path):
+        raise FileNotFoundError(f"Checkpoint path not found: {model_path}")
+
+    st = os.path.join(model_path, SAFETENSORS_MODEL)
+    st_index = os.path.join(model_path, SAFETENSORS_INDEX)
+    pt = os.path.join(model_path, PYTORCH_MODEL)
+    pt_index = os.path.join(model_path, PYTORCH_INDEX)
+
+    if os.path.exists(st):
+        return _load_safetensors_file(st)
+    if os.path.exists(st_index):
+        return _load_from_index(model_path, st_index)
+    if os.path.exists(pt):
+        return _load_checkpoint_file(pt)
+    if os.path.exists(pt_index):
+        return _load_from_index(model_path, pt_index)
+    # last resort: any *.safetensors files in dir
+    files = sorted(f for f in os.listdir(model_path) if f.endswith(".safetensors"))
+    if files:
+        out = {}
+        for f in files:
+            out.update(_load_safetensors_file(os.path.join(model_path, f)))
+        return out
+    raise FileNotFoundError(f"No checkpoint files found under {model_path}")
+
+
+def _load_from_index(model_path: str, index_path: str) -> Dict[str, np.ndarray]:
+    with open(index_path) as f:
+        index = json.load(f)
+    shard_files = sorted(set(index["weight_map"].values()))
+    out: Dict[str, np.ndarray] = {}
+    for shard in shard_files:
+        out.update(_load_checkpoint_file(os.path.join(model_path, shard)))
+    return out
+
+
+def _load_checkpoint_file(path: str) -> Dict[str, np.ndarray]:
+    if path.endswith(".safetensors"):
+        return _load_safetensors_file(path)
+    if not _HAS_TORCH:
+        raise RuntimeError("Loading .bin checkpoints requires torch")
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    return {k: _torch_to_numpy(v) for k, v in sd.items() if v is not None}
+
+
+def save_state_dict_safetensors(
+    state_dict: Dict[str, np.ndarray],
+    save_dir: str,
+    max_shard_size_bytes: int = 10 * 1024**3,
+) -> None:
+    """Save as (possibly sharded) safetensors with an index file
+    (reference: modules/checkpoint.py:171-199)."""
+    os.makedirs(save_dir, exist_ok=True)
+    items = [(k, v) for k, v in state_dict.items() if v is not None]
+    shards, cur, cur_bytes = [], {}, 0
+    for k, v in items:
+        nbytes = int(np.asarray(v).nbytes)
+        if cur and cur_bytes + nbytes > max_shard_size_bytes:
+            shards.append(cur)
+            cur, cur_bytes = {}, 0
+        cur[k] = np.asarray(v)
+        cur_bytes += nbytes
+    if cur:
+        shards.append(cur)
+
+    from safetensors.numpy import save_file
+
+    if len(shards) == 1:
+        save_file(shards[0], os.path.join(save_dir, SAFETENSORS_MODEL))
+        return
+    weight_map = {}
+    for i, shard in enumerate(shards):
+        name = f"model-{i + 1:05d}-of-{len(shards):05d}.safetensors"
+        save_file(shard, os.path.join(save_dir, name))
+        for k in shard:
+            weight_map[k] = name
+    with open(os.path.join(save_dir, SAFETENSORS_INDEX), "w") as f:
+        json.dump({"weight_map": weight_map}, f)
+
+
+_LAYER_RE = re.compile(r"(^|\.)layers\.(\d+)\.")
+
+
+def prune_state_dict(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Drop None entries (reference: modules/checkpoint.py ``prune_state_dict``)."""
+    return {k: v for k, v in state_dict.items() if v is not None}
+
+
+def create_n_layer_checkpoint(
+    state_dict: Dict[str, np.ndarray], num_layers: int
+) -> Dict[str, np.ndarray]:
+    """Keep only the first ``num_layers`` decoder layers — used to synthesize tiny
+    test checkpoints from full models (reference: modules/checkpoint.py:202)."""
+    out = {}
+    for k, v in state_dict.items():
+        m = _LAYER_RE.search(k)
+        if m and int(m.group(2)) >= num_layers:
+            continue
+        out[k] = v
+    return out
+
+
+def rename_keys(
+    state_dict: Dict[str, np.ndarray], renames: Iterable[tuple]
+) -> Dict[str, np.ndarray]:
+    """Apply (pattern, replacement) regex renames, e.g. stripping a ``model.`` prefix
+    (reference: application_base.py:691-737 prefix handling)."""
+    out = {}
+    for k, v in state_dict.items():
+        nk = k
+        for pat, rep in renames:
+            nk = re.sub(pat, rep, nk)
+        out[nk] = v
+    return out

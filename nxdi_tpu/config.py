@@ -1,0 +1,445 @@
+"""Configuration system for the TPU-native inference framework.
+
+Two-level design mirroring the reference framework's contract
+(reference: models/config.py:84 ``NeuronConfig``, :813 ``InferenceConfig``):
+
+- :class:`TpuConfig` — runtime/feature flags (parallel degrees, bucketing,
+  sampling, speculation, quantization, ...). Everything the compiler/runtime
+  needs that is NOT a model hyperparameter.
+- :class:`InferenceConfig` — model hyperparameters, typically adapted from a
+  HuggingFace ``config.json``, plus a ``tpu_config`` attribute. Serialized to
+  JSON next to compiled artifacts so compile-time and run-time agree
+  (reference: models/config.py:891-1002).
+
+The JSON artifact is intentionally shaped like the reference's
+``neuron_config.json`` so tooling that reads it keeps working.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+import jax.numpy as jnp
+
+CONFIG_FILE = "tpu_config.json"
+
+_DTYPES = {
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float16": jnp.float16,
+    "float8_e4m3": jnp.float8_e4m3fn,
+    "float8_e5m2": jnp.float8_e5m2,
+    "int8": jnp.int8,
+}
+
+
+def to_jax_dtype(dtype) -> Any:
+    """Map a string (or jnp dtype) to a jnp dtype (reference: utils/distributed.py analog)."""
+    if isinstance(dtype, str):
+        key = dtype.replace("torch.", "")
+        if key not in _DTYPES:
+            raise ValueError(f"Unsupported dtype {dtype!r}; options: {sorted(_DTYPES)}")
+        return _DTYPES[key]
+    return dtype
+
+
+def dtype_name(dtype) -> str:
+    for name, dt in _DTYPES.items():
+        if dt == dtype:
+            return name
+    return str(dtype)
+
+
+class OnDeviceSamplingConfig:
+    """Sampling-on-device flags (reference: models/config.py:1028)."""
+
+    def __init__(self, **kwargs):
+        self.do_sample = kwargs.pop("do_sample", False)
+        self.top_k = kwargs.pop("top_k", 1)
+        self.top_p = kwargs.pop("top_p", 1.0)
+        self.temperature = kwargs.pop("temperature", 1.0)
+        self.dynamic = kwargs.pop("dynamic", True)  # per-request sampling params tensor
+        self.global_topk = kwargs.pop("global_topk", 256)  # stage-1 shard top-k width
+        self.deterministic = kwargs.pop("deterministic", False)
+        self.on_device_sampling_seed = kwargs.pop("on_device_sampling_seed", 0)
+        if kwargs:
+            raise ValueError(f"Unknown OnDeviceSamplingConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class KVQuantizationConfig:
+    """KV-cache quantization (reference: models/config.py:300-306, kv_cache_manager.py:642)."""
+
+    def __init__(self, **kwargs):
+        self.dtype = kwargs.pop("dtype", "float8_e4m3")
+        self.scale_mode = kwargs.pop("scale_mode", "direct_cast")  # direct_cast|per_tensor
+        if kwargs:
+            raise ValueError(f"Unknown KVQuantizationConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class ChunkedPrefillConfig:
+    """Chunked prefill over block KV (reference: models/config.py:1042)."""
+
+    def __init__(self, **kwargs):
+        self.max_num_seqs = kwargs.pop("max_num_seqs", 8)
+        self.chunk_size = kwargs.pop("chunk_size", 512)
+        self.kernel_q_tile_size = kwargs.pop("kernel_q_tile_size", 128)
+        self.kernel_kv_tile_size = kwargs.pop("kernel_kv_tile_size", 512)
+        if kwargs:
+            raise ValueError(f"Unknown ChunkedPrefillConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class SpeculationConfig:
+    """Speculative decoding knobs (reference: models/config.py:244-266)."""
+
+    def __init__(self, **kwargs):
+        self.speculation_length = kwargs.pop("speculation_length", 0)
+        self.enable_fused_speculation = kwargs.pop("enable_fused_speculation", False)
+        self.enable_eagle_speculation = kwargs.pop("enable_eagle_speculation", False)
+        self.is_eagle3 = kwargs.pop("is_eagle3", False)
+        self.is_eagle_draft = kwargs.pop("is_eagle_draft", False)
+        self.token_tree_config = kwargs.pop("token_tree_config", None)
+        if kwargs:
+            raise ValueError(f"Unknown SpeculationConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        d = dict(self.__dict__)
+        if self.token_tree_config is not None and hasattr(self.token_tree_config, "to_dict"):
+            d["token_tree_config"] = self.token_tree_config.to_dict()
+        return d
+
+
+class LoraServingConfig:
+    """Multi-adapter LoRA serving (reference: modules/lora_serving/config.py)."""
+
+    def __init__(self, **kwargs):
+        self.max_loras = kwargs.pop("max_loras", 1)
+        self.max_lora_rank = kwargs.pop("max_lora_rank", 16)
+        self.lora_ckpt_paths = kwargs.pop("lora_ckpt_paths", None)  # {adapter_id: path}
+        self.target_modules = kwargs.pop(
+            "target_modules", ["q_proj", "k_proj", "v_proj", "o_proj"]
+        )
+        self.lora_dtype = kwargs.pop("lora_dtype", "bfloat16")
+        self.lora_alpha = kwargs.pop("lora_alpha", 16.0)
+        if kwargs:
+            raise ValueError(f"Unknown LoraServingConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class TpuConfig:
+    """Runtime/feature configuration — the analog of the reference's NeuronConfig
+    (reference: models/config.py:84-609). Field names are kept compatible where the
+    concept transfers so users of the reference find what they expect.
+    """
+
+    def __init__(self, **kwargs) -> None:
+        # --- basic shapes (reference: config.py:94-101) ---
+        self.batch_size = kwargs.pop("batch_size", 1)
+        self.padding_side = kwargs.pop("padding_side", "right")
+        self.seq_len = kwargs.pop("seq_len", 128)
+        self.n_active_tokens = kwargs.pop("n_active_tokens", self.seq_len)
+        self.max_context_length = kwargs.pop("max_context_length", self.seq_len)
+        self.max_new_tokens = kwargs.pop("max_new_tokens", None)
+        self.max_length = kwargs.pop("max_length", self.seq_len)
+        self.on_cpu = kwargs.pop("on_cpu", False)
+        self.output_logits = kwargs.pop("output_logits", False)
+
+        # --- dtypes ---
+        self.dtype = to_jax_dtype(kwargs.pop("dtype", kwargs.pop("torch_dtype", "bfloat16")))
+        self.attention_dtype = kwargs.pop("attention_dtype", None)
+        if self.attention_dtype is not None:
+            self.attention_dtype = to_jax_dtype(self.attention_dtype)
+        self.rpl_reduce_dtype = kwargs.pop("rpl_reduce_dtype", None)  # row-parallel reduce dtype
+        if self.rpl_reduce_dtype is not None:
+            self.rpl_reduce_dtype = to_jax_dtype(self.rpl_reduce_dtype)
+        self.cast_type = kwargs.pop("cast_type", "config")
+        self.softmax_dtype = to_jax_dtype(kwargs.pop("softmax_dtype", "float32"))
+
+        # --- batching (reference: config.py:162-171) ---
+        self.ctx_batch_size = kwargs.pop("ctx_batch_size", self.batch_size)
+        self.tkg_batch_size = kwargs.pop("tkg_batch_size", self.batch_size)
+        self.max_batch_size = kwargs.pop("max_batch_size", self.batch_size)
+        self.is_continuous_batching = kwargs.pop("is_continuous_batching", False)
+        self.kv_cache_batch_size = kwargs.pop("kv_cache_batch_size", self.batch_size)
+        self.kv_cache_padding_size = kwargs.pop("kv_cache_padding_size", 0)
+
+        # --- sampling (reference: config.py:174-181) ---
+        odsc = kwargs.pop("on_device_sampling_config", None)
+        if isinstance(odsc, dict):
+            odsc = OnDeviceSamplingConfig(**odsc)
+        self.on_device_sampling_config = odsc
+
+        # --- async (reference: config.py:184) — JAX dispatch is async by default; this
+        # flag controls explicit double-buffered dispatch in the generation loop.
+        self.async_mode = kwargs.pop("async_mode", False)
+
+        # --- bucketing (reference: config.py:187-208) ---
+        self.enable_bucketing = kwargs.pop("enable_bucketing", False)
+        self.buckets = kwargs.pop("buckets", None)
+        self.bucket_n_active_tokens = kwargs.pop("bucket_n_active_tokens", False)
+        self.context_encoding_buckets = kwargs.pop("context_encoding_buckets", None)
+        self.token_generation_buckets = kwargs.pop("token_generation_buckets", None)
+        self.prefix_buckets = kwargs.pop("prefix_buckets", None)
+
+        # --- quantization (reference: config.py:217-241) ---
+        self.quantized = kwargs.pop("quantized", False)
+        self.quantized_checkpoints_path = kwargs.pop("quantized_checkpoints_path", None)
+        self.quantization_dtype = kwargs.pop("quantization_dtype", "int8")
+        self.quantization_type = kwargs.pop("quantization_type", "per_tensor_symmetric")
+        self.modules_to_not_convert = kwargs.pop("modules_to_not_convert", None)
+        kvq = kwargs.pop("kv_quant_config", None)
+        if isinstance(kvq, dict):
+            kvq = KVQuantizationConfig(**kvq)
+        self.kv_quant_config = kvq
+        self.kv_cache_quant = kwargs.pop("kv_cache_quant", False)
+        if self.kv_cache_quant and self.kv_quant_config is None:
+            self.kv_quant_config = KVQuantizationConfig()
+
+        # --- speculation (reference: config.py:244-272) ---
+        spec = kwargs.pop("speculation_config", None)
+        if isinstance(spec, dict):
+            spec = SpeculationConfig(**spec)
+        self.speculation_config = spec
+        self.speculation_length = kwargs.pop(
+            "speculation_length", spec.speculation_length if spec else 0
+        )
+        self.enable_fused_speculation = kwargs.pop(
+            "enable_fused_speculation", spec.enable_fused_speculation if spec else False
+        )
+        self.enable_eagle_speculation = kwargs.pop(
+            "enable_eagle_speculation", spec.enable_eagle_speculation if spec else False
+        )
+        if self.enable_eagle_speculation:
+            self.enable_fused_speculation = True
+        self.is_eagle_draft = kwargs.pop("is_eagle_draft", False)
+        self.is_medusa = kwargs.pop("is_medusa", False)
+        self.medusa_speculation_length = kwargs.pop("medusa_speculation_length", 0)
+        self.num_medusa_heads = kwargs.pop("num_medusa_heads", 0)
+        self.medusa_tree = kwargs.pop("medusa_tree", None)
+
+        # --- paged / block KV (reference: config.py:278-283) ---
+        self.is_block_kv_layout = kwargs.pop("is_block_kv_layout", False)
+        self.pa_num_blocks = kwargs.pop("pa_num_blocks", None)
+        self.pa_block_size = kwargs.pop("pa_block_size", 128)
+        self.is_prefix_caching = kwargs.pop("is_prefix_caching", False)
+        cpc = kwargs.pop("chunked_prefill_config", None)
+        if isinstance(cpc, dict):
+            cpc = ChunkedPrefillConfig(**cpc)
+        self.chunked_prefill_config = cpc
+        self.is_chunked_prefill = cpc is not None
+
+        # --- LoRA (reference: config.py:357-359) ---
+        lora = kwargs.pop("lora_config", None)
+        if isinstance(lora, dict):
+            lora = LoraServingConfig(**lora)
+        self.lora_config = lora
+
+        # --- parallelism (reference: config.py:362-390) ---
+        self.tp_degree = kwargs.pop("tp_degree", 1)
+        self.cp_degree = kwargs.pop("cp_degree", 1)
+        self.attention_dp_degree = kwargs.pop("attention_dp_degree", 1)
+        self.pp_degree = kwargs.pop("pp_degree", 1)
+        self.ep_degree = kwargs.pop("ep_degree", 1)
+        self.moe_tp_degree = kwargs.pop("moe_tp_degree", None)
+        self.moe_ep_degree = kwargs.pop("moe_ep_degree", None)
+        self.world_size = kwargs.pop("world_size", None)
+        if self.world_size is None:
+            self.world_size = self.tp_degree * self.pp_degree
+        self.start_rank_id = kwargs.pop("start_rank_id", 0)
+        self.sequence_parallel_enabled = kwargs.pop("sequence_parallel_enabled", False)
+        self.flash_decoding_enabled = kwargs.pop("flash_decoding_enabled", False)
+        self.num_cores_per_group = kwargs.pop("num_cores_per_group", 1)
+        self.vocab_parallel = kwargs.pop("vocab_parallel", True)
+
+        # --- kernels (reference: config.py:418-533). On TPU these gate Pallas kernels;
+        # the XLA path is always available as fallback.
+        self.attn_kernel_enabled = kwargs.pop("attn_kernel_enabled", None)
+        self.attn_tkg_kernel_enabled = kwargs.pop("attn_tkg_kernel_enabled", False)
+        self.attn_block_tkg_kernel_enabled = kwargs.pop("attn_block_tkg_kernel_enabled", False)
+        self.fused_qkv = kwargs.pop("fused_qkv", False)
+        self.qkv_kernel_enabled = kwargs.pop("qkv_kernel_enabled", False)
+        self.mlp_kernel_enabled = kwargs.pop("mlp_kernel_enabled", False)
+        self.k_cache_transposed = kwargs.pop("k_cache_transposed", False)
+
+        # --- misc/debug ---
+        self.qk_layernorm = kwargs.pop("qk_layernorm", False)
+        self.sliding_window = kwargs.pop("sliding_window", None)
+        self.windowed_context_encoding_size = kwargs.pop("windowed_context_encoding_size", None)
+        self.logical_nc_config = kwargs.pop("logical_nc_config", 1)
+        self.skip_warmup = kwargs.pop("skip_warmup", False)
+        self.save_sharded_checkpoint = kwargs.pop("save_sharded_checkpoint", False)
+        self.compilation_cache_dir = kwargs.pop("compilation_cache_dir", None)
+        self.tensor_capture_config = kwargs.pop("tensor_capture_config", None)
+        self.allow_unknown = kwargs.pop("allow_unknown", False)
+
+        self.is_prefill_stage = None  # set by enable_context_encoding/token_generation
+
+        if kwargs and not self.allow_unknown:
+            raise ValueError(f"Unknown TpuConfig arguments: {sorted(kwargs)}")
+        self.validate()
+
+    # -- validation (reference: config.py:611-687 does similar cross-checks) --
+    def validate(self) -> None:
+        if self.padding_side not in ("right", "left"):
+            raise ValueError("padding_side must be 'right' or 'left'")
+        if self.max_context_length > self.seq_len:
+            raise ValueError(
+                f"max_context_length ({self.max_context_length}) cannot exceed seq_len ({self.seq_len})"
+            )
+        if self.cp_degree > 1 and self.tp_degree % self.cp_degree != 0:
+            raise ValueError("cp_degree must divide tp_degree (CP splits the TP world)")
+        if self.attention_dp_degree > 1:
+            if self.tp_degree % self.attention_dp_degree != 0:
+                raise ValueError("attention_dp_degree must divide tp_degree")
+            if self.tkg_batch_size % self.attention_dp_degree != 0:
+                raise ValueError("tkg_batch_size must be divisible by attention_dp_degree")
+        if self.is_medusa and self.num_medusa_heads <= 0:
+            raise ValueError("is_medusa requires num_medusa_heads > 0")
+        if self.speculation_length < 0:
+            raise ValueError("speculation_length must be >= 0")
+        if self.is_block_kv_layout and self.pa_num_blocks is None:
+            self.pa_num_blocks = max(
+                1, (self.seq_len * self.max_batch_size) // self.pa_block_size
+            )
+        if self.is_prefix_caching and not self.is_block_kv_layout:
+            raise ValueError("is_prefix_caching requires is_block_kv_layout")
+        if self.is_chunked_prefill and not self.is_block_kv_layout:
+            raise ValueError("chunked prefill requires is_block_kv_layout")
+
+    # -- (de)serialization (reference: config.py:891-1002) --
+    _SUBCONFIGS = {
+        "on_device_sampling_config": OnDeviceSamplingConfig,
+        "kv_quant_config": KVQuantizationConfig,
+        "chunked_prefill_config": ChunkedPrefillConfig,
+        "speculation_config": SpeculationConfig,
+        "lora_config": LoraServingConfig,
+    }
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        derived = ("is_prefill_stage", "allow_unknown", "is_chunked_prefill")
+        for k, v in self.__dict__.items():
+            if k in derived:
+                continue
+            if k in self._SUBCONFIGS:
+                out[k] = v.to_dict() if v is not None else None
+            elif k in ("dtype", "attention_dtype", "rpl_reduce_dtype", "softmax_dtype"):
+                out[k] = dtype_name(v) if v is not None else None
+            else:
+                out[k] = v
+        return out
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TpuConfig":
+        return cls(**{k: v for k, v in dict(d).items() if v is not None or k.endswith("_config")})
+
+    def copy(self, **overrides) -> "TpuConfig":
+        d = self.to_dict()
+        d.update(overrides)
+        return TpuConfig.from_dict(d)
+
+
+class InferenceConfig:
+    """Model hyperparameters + a :class:`TpuConfig` (reference: models/config.py:813).
+
+    ``load_config`` is a callable returning a dict of hyperparameters — typically
+    :func:`nxdi_tpu.generation.hf_adapter.load_pretrained_config` wrapping a HF
+    ``config.json`` (reference: utils/hf_adapter.py:36).
+    """
+
+    # attributes that must exist after construction (reference: config.py:841-858)
+    REQUIRED = ["hidden_size", "num_attention_heads", "num_hidden_layers", "vocab_size"]
+
+    def __init__(self, tpu_config: TpuConfig, load_config=None, metadata=None, **kwargs):
+        self.tpu_config = tpu_config
+        self.metadata = metadata or {}
+        if load_config is not None:
+            for k, v in load_config().items():
+                setattr(self, k, v)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+        self.add_derived_config()
+        self.validate_config()
+
+    # subclasses override (reference: config.py:860-888)
+    def add_derived_config(self) -> None:
+        if not hasattr(self, "num_key_value_heads") and hasattr(self, "num_attention_heads"):
+            self.num_key_value_heads = self.num_attention_heads
+        if not hasattr(self, "head_dim") and hasattr(self, "hidden_size"):
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+    def get_required_attributes(self) -> List[str]:
+        return list(self.REQUIRED)
+
+    def validate_config(self) -> None:
+        missing = [a for a in self.get_required_attributes() if not hasattr(self, a)]
+        if missing:
+            raise ValueError(f"InferenceConfig missing required attributes: {missing}")
+
+    # -- JSON round trip (reference: config.py:891-1002) --
+    def to_dict(self) -> Dict[str, Any]:
+        out = {}
+        for k, v in self.__dict__.items():
+            if k == "tpu_config":
+                out[k] = v.to_dict()
+            elif k == "fused_spec_config" and v is not None:
+                out[k] = v.to_dict() if hasattr(v, "to_dict") else v
+            else:
+                try:
+                    json.dumps(v)
+                    out[k] = v
+                except TypeError:
+                    continue  # non-serializable helper attrs are reconstructable
+        return out
+
+    def save(self, model_path: str) -> str:
+        os.makedirs(model_path, exist_ok=True)
+        path = os.path.join(model_path, CONFIG_FILE)
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+        return path
+
+    @classmethod
+    def load(cls, model_path: str, **kwargs) -> "InferenceConfig":
+        with open(os.path.join(model_path, CONFIG_FILE)) as f:
+            d = json.load(f)
+        tpu_config = TpuConfig.from_dict(d.pop("tpu_config"))
+        obj = cls.__new__(cls)
+        obj.tpu_config = tpu_config
+        obj.metadata = {}
+        for k, v in d.items():
+            setattr(obj, k, v)
+        for k, v in kwargs.items():
+            setattr(obj, k, v)
+        obj.add_derived_config()
+        obj.validate_config()
+        return obj
+
+
+class FusedSpecConfig:
+    """Pairs a draft model config with the target for fused speculation
+    (reference: models/config.py:1009 ``FusedSpecNeuronConfig``)."""
+
+    def __init__(self, worker_cls_name: str, draft_config: InferenceConfig, draft_model_path: str):
+        self.worker_cls_name = worker_cls_name
+        self.draft_config = draft_config
+        self.draft_model_path = draft_model_path
+
+    def to_dict(self):
+        return {
+            "worker_cls_name": self.worker_cls_name,
+            "draft_config": self.draft_config.to_dict(),
+            "draft_model_path": self.draft_model_path,
+        }

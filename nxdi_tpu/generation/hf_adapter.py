@@ -1,0 +1,179 @@
+"""HuggingFace-compatible generation driver.
+
+The analog of the reference's ``HuggingFaceGenerationAdapter``
+(utils/hf_adapter.py:115): a CPU-side loop that makes a compiled TPU
+application behave like ``model.generate(...)`` — right-padding aware, KV-cache
+aware, on-device sampling aware. One CTE dispatch for the prompt, then one TKG
+dispatch per generated token (reference ``_sample`` :150).
+
+``load_pretrained_config`` adapts a HF ``config.json`` into the kwargs an
+:class:`InferenceConfig` expects (reference: hf_adapter.py:36).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+from dataclasses import dataclass
+from typing import List, Optional
+
+import jax
+import numpy as np
+
+from nxdi_tpu.ops.sampling import prepare_sampling_params
+
+logger = logging.getLogger("nxdi_tpu")
+
+
+def load_pretrained_config(model_path: str):
+    """Returns a callable giving the HF config dict (reference: hf_adapter.py:36)."""
+
+    def load():
+        cfg_path = os.path.join(model_path, "config.json")
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+        # flatten nested text_config style entries are model-family concerns;
+        # here we pass the dict through.
+        return cfg
+
+    return load
+
+
+@dataclass
+class GenerationConfigLite:
+    max_new_tokens: Optional[int] = None
+    max_length: Optional[int] = None
+    do_sample: bool = False
+    top_k: int = 1
+    top_p: float = 1.0
+    temperature: float = 1.0
+    eos_token_id: Optional[object] = None  # int or list
+    pad_token_id: int = 0
+    seed: int = 0
+
+
+class HuggingFaceGenerationAdapter:
+    def __init__(self, app):
+        self.app = app
+        self.config = app.config
+        self.tpu_config = app.tpu_config
+
+    def generate(
+        self,
+        input_ids: np.ndarray,  # (B, S) right-padded
+        attention_mask: Optional[np.ndarray] = None,
+        max_new_tokens: Optional[int] = None,
+        max_length: Optional[int] = None,
+        do_sample: bool = False,
+        top_k: int = 1,
+        top_p: float = 1.0,
+        temperature: float = 1.0,
+        eos_token_id=None,
+        pad_token_id: int = 0,
+        seed: int = 0,
+        **unused,
+    ) -> np.ndarray:
+        """Greedy/sampling generation. Returns (B, S + new_tokens) ids, with each
+        row's generated tokens appended after its true prompt (right-padding in
+        the prompt region is preserved, like the reference's right-pad support).
+        """
+        input_ids = np.asarray(input_ids)
+        B, S = input_ids.shape
+        if attention_mask is None:
+            attention_mask = (input_ids != pad_token_id).astype(np.int32)
+            # all-pad rows would break length math; treat fully-pad as len 1
+        lengths = attention_mask.sum(axis=1).astype(np.int32)
+        lengths = np.maximum(lengths, 1)
+
+        if max_length is None:
+            max_length = (
+                int(lengths.max()) + max_new_tokens
+                if max_new_tokens is not None
+                else self.tpu_config.seq_len
+            )
+        max_length = min(max_length, self.tpu_config.seq_len)
+        n_new = max_length - int(lengths.max())
+        if n_new <= 0:
+            return input_ids
+
+        eos_ids = []
+        if eos_token_id is not None:
+            eos_ids = list(np.atleast_1d(eos_token_id).astype(np.int64))
+
+        odsc = self.tpu_config.on_device_sampling_config
+        compiled_do_sample = bool(odsc and odsc.do_sample)
+        if do_sample and not compiled_do_sample:
+            logger.warning(
+                "generate(do_sample=True) requested but the model was compiled "
+                "without on-device sampling (OnDeviceSamplingConfig(do_sample="
+                "True)); falling back to greedy."
+            )
+        self._rng_counter = 0
+        self._seed = seed
+
+        sampling_params = prepare_sampling_params(
+            B,
+            top_k=[top_k if do_sample else 1],
+            top_p=[top_p],
+            temperature=[temperature],
+        )
+
+        # ---- context encoding ----
+        position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+        outputs = self.app.forward(
+            input_ids.astype(np.int32),
+            position_ids,
+            last_token_index=lengths - 1,
+            sampling_params=sampling_params,
+            rng=self._next_rng(),
+        )
+        next_tokens = self._next_tokens(outputs)
+
+        generated: List[np.ndarray] = [next_tokens]
+        finished = np.zeros((B,), dtype=bool)
+        for e in eos_ids:
+            finished |= next_tokens == e
+
+        # ---- token generation loop ----
+        cur_pos = lengths.copy()  # position of the next token to write
+        for _ in range(n_new - 1):
+            if finished.all():
+                break
+            step_inputs = next_tokens[:, None].astype(np.int32)
+            outputs = self.app.forward(
+                step_inputs,
+                cur_pos[:, None].astype(np.int32),
+                last_token_index=np.zeros((B,), dtype=np.int32),
+                sampling_params=sampling_params,
+                rng=self._next_rng(),
+            )
+            next_tokens = self._next_tokens(outputs)
+            next_tokens = np.where(finished, pad_token_id, next_tokens)
+            generated.append(next_tokens)
+            for e in eos_ids:
+                finished |= next_tokens == e
+            cur_pos = cur_pos + 1
+
+        gen = np.stack(generated, axis=1)  # (B, T)
+        # place generated tokens immediately after each row's true length
+        T = gen.shape[1]
+        out = np.full((B, S + T), pad_token_id, dtype=input_ids.dtype)
+        out[:, :S] = input_ids
+        for b in range(B):
+            out[b, lengths[b] : lengths[b] + T] = gen[b]
+        return out
+
+    def _next_rng(self) -> np.ndarray:
+        """Fresh (seed, counter) threefry key data per step — distinct draws
+        every step, reproducible under a fixed seed."""
+        self._rng_counter += 1
+        return np.array([self._seed, self._rng_counter], dtype=np.uint32)
+
+    def _next_tokens(self, outputs) -> np.ndarray:
+        """On-device sampled tokens, or host-side greedy from logits when
+        on-device sampling is off (reference keeps both paths too)."""
+        if "tokens" in outputs:
+            return np.asarray(jax.device_get(outputs["tokens"]))[:, 0]
+        logits = np.asarray(jax.device_get(outputs["logits"]))
+        return logits[:, -1, :].argmax(axis=-1).astype(np.int64)

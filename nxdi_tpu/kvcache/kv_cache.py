@@ -1,0 +1,123 @@
+"""KV cache — functional, donation-friendly, layer-stacked.
+
+The reference keeps K/V as per-layer ``nn.Parameter``s mutated in-graph
+(modules/kvcache/kv_cache_manager.py:107 ``KVCacheManager``; shape
+``(batch+pad, kv_heads/rank, max_len, head_dim)``). The TPU-native equivalent
+is an explicit pytree carried through the jitted step and **donated**
+(``donate_argnums``) so XLA aliases the buffers — zero-copy in steady state,
+which is what the reference's parameter aliasing achieves.
+
+Layout choice: one array per cache side, stacked over layers —
+``(n_layers, batch, kv_heads, max_len, head_dim)`` — so the decoder runs as a
+single ``lax.scan`` over layers (cache slices are scan xs, updated slices are
+scan ys). One compiled layer body instead of n_layers unrolled copies: much
+faster XLA compiles at 70B scale, same runtime code.
+
+Write semantics: exact-position scatter. New K/V for token at position p of
+sequence b is written at [b, :, p, :]. Combined with position-derived causal
+masks (ops/attention.py), right-padded prefill garbage is harmless: pad
+positions are overwritten before any query can attend them (reference gets the
+same effect from its scatter at position_ids, kv_cache_manager.py:374).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.parallel.mesh import AXIS_TP
+
+
+@dataclass(frozen=True)
+class KVCacheSpec:
+    """Static shape/dtype description of the cache (hashable; closed over by jit)."""
+
+    num_layers: int
+    batch_size: int
+    num_kv_heads: int  # per-model padded count (parallel/gqa.py), NOT per-shard
+    max_len: int
+    head_dim: int
+    dtype: str = "bfloat16"
+    # fp8 KV quantization (reference: kv_cache_manager.py:642-692)
+    quant_dtype: Optional[str] = None
+
+    @property
+    def store_dtype(self):
+        from nxdi_tpu.config import to_jax_dtype
+
+        return to_jax_dtype(self.quant_dtype or self.dtype)
+
+    @property
+    def compute_dtype(self):
+        from nxdi_tpu.config import to_jax_dtype
+
+        return to_jax_dtype(self.dtype)
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.num_layers, self.batch_size, self.num_kv_heads, self.max_len, self.head_dim)
+
+
+def init_kv_cache(spec: KVCacheSpec) -> Dict[str, jax.Array]:
+    """Zero-initialized cache pytree {'k': ..., 'v': ...}."""
+    # distinct arrays: k and v are donated separately, sharing one buffer
+    # would trip double-donation
+    return {
+        "k": jnp.zeros(spec.shape, dtype=spec.store_dtype),
+        "v": jnp.zeros(spec.shape, dtype=spec.store_dtype),
+    }
+
+
+def kv_cache_partition_spec() -> Dict[str, P]:
+    """Cache sharded over kv heads on the tp axis (layers/batch/seq replicated);
+    the analog of per-rank ``kv_heads/rank`` slices in the reference."""
+    spec = P(None, None, AXIS_TP, None, None)
+    return {"k": spec, "v": spec}
+
+
+def update_layer_cache(
+    k_cache_l: jax.Array,  # (B, KV, S_max, D)
+    v_cache_l: jax.Array,
+    k_new: jax.Array,  # (B, KV, S_act, D)
+    v_new: jax.Array,
+    position_ids: jax.Array,  # (B, S_act) int32; exact write positions
+    spec: KVCacheSpec,
+) -> Tuple[jax.Array, jax.Array]:
+    """Scatter new K/V at their positions (reference: kv_cache_manager.py:374
+    ``update_cache`` scatter semantics).
+
+    Uses advanced-index scatter, which XLA lowers to an in-place scatter on the
+    donated buffer. Positions are clamped into range; callers mask invalid lanes
+    by pointing them at a position that will be overwritten (or via seq masks).
+    """
+    B, KV, S_act, D = k_new.shape
+    # Out-of-range positions (padding lanes) are dropped by the scatter mode;
+    # negatives would wrap like numpy indexing, so remap them out of bounds.
+    pos = jnp.where(position_ids < 0, k_cache_l.shape[2], position_ids)  # (B, S_act)
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]  # (B, 1)
+    store = k_cache_l.dtype
+    # (B, S_act, KV, D) values scattered at [b, pos, :, :] on a (B, S, KV, D) view:
+    # keep cache layout (B, KV, S, D) and scatter with transposed values instead.
+    k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
+    v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
+    k_cache_l = k_cache_l.at[b_idx, :, pos].set(k_vals, mode="drop")
+    v_cache_l = v_cache_l.at[b_idx, :, pos].set(v_vals, mode="drop")
+    return k_cache_l, v_cache_l
+
+
+def read_layer_cache(
+    k_cache_l: jax.Array, v_cache_l: jax.Array, spec: KVCacheSpec
+) -> Tuple[jax.Array, jax.Array]:
+    """Full-window read, dequantizing if the cache stores a quant dtype
+    (reference: kv_cache_manager.py:349 ``get_cache``)."""
+    compute = spec.compute_dtype
+    return k_cache_l.astype(compute), v_cache_l.astype(compute)
+
+
+def reset_kv_cache(cache: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    """Zero the cache (reference: model_base.py:3964 ``reset_kv_cache``)."""
+    return jax.tree_util.tree_map(jnp.zeros_like, cache)

@@ -1,0 +1,388 @@
+"""The compiled decoder graph — pure-functional analog of the reference's
+``NeuronBaseModel`` (models/model_base.py:99, forward :713).
+
+What the reference expresses as a traced torch module mutating Parameter KV
+caches, we express as a pure function over (params, kv_cache, batch) returning
+(outputs, new_kv_cache), jitted per (submodel, bucket) with the cache donated.
+
+Structure of one forward (reference: model_base.py:1264 ``get_model_output``):
+  embed -> [scan over decoder layers: rmsnorm -> attention(+KV update) ->
+  residual -> rmsnorm -> MLP -> residual] -> final rmsnorm -> last-token gather
+  -> lm_head -> padded-logit mask -> on-device sampler.
+
+The layer stack runs as ONE ``lax.scan`` over layer-stacked params and cache
+(kvcache/kv_cache.py layout): a single compiled layer body regardless of depth,
+which keeps XLA compile times flat as models grow. Heterogeneous stacks (e.g.
+interleaved sliding-window layers) pass per-layer scalars through the scan xs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.kvcache.kv_cache import (
+    KVCacheSpec,
+    read_layer_cache,
+    update_layer_cache,
+)
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops.norms import rms_norm
+from nxdi_tpu.ops.rope import apply_rotary_pos_emb, rope_cos_sin
+from nxdi_tpu.parallel.layers import (
+    COLUMN_PARALLEL,
+    REPLICATED,
+    ROW_PARALLEL,
+    VOCAB_PARALLEL,
+    constrain,
+)
+from nxdi_tpu.parallel.mesh import AXIS_TP
+
+ACT_FNS: Dict[str, Callable] = {
+    "silu": jax.nn.silu,
+    "gelu": jax.nn.gelu,
+    "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
+    "relu": jax.nn.relu,
+}
+
+
+@dataclass(frozen=True)
+class DecoderArch:
+    """Static (hashable) architecture description closed over by the jitted fns.
+
+    Head/vocab counts are the PADDED values after GQA sharding planning
+    (parallel/gqa.py) and vocab padding; original sizes are kept for masking.
+    """
+
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int
+    vocab_size: int  # padded
+    vocab_pad: int  # rows added to reach vocab_size
+    rms_norm_eps: float = 1e-5
+    hidden_act: str = "silu"
+    attention_bias: bool = False
+    mlp_bias: bool = False
+    qk_norm: bool = False  # qwen3-style per-head q/k rmsnorm
+    sliding_window: Optional[int] = None
+    chunk_size: Optional[int] = None  # llama4 chunked attention
+    attention_scale: Optional[float] = None
+    tie_word_embeddings: bool = False
+    dtype: str = "bfloat16"
+    softmax_dtype: str = "float32"
+
+    def kv_cache_spec(self, batch_size: int, max_len: int, quant_dtype=None) -> KVCacheSpec:
+        return KVCacheSpec(
+            num_layers=self.num_layers,
+            batch_size=batch_size,
+            num_kv_heads=self.num_kv_heads,
+            max_len=max_len,
+            head_dim=self.head_dim,
+            dtype=self.dtype,
+            quant_dtype=quant_dtype,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Parameter pytree layout + sharding specs
+# ---------------------------------------------------------------------------
+
+def attention_param_specs(arch: DecoderArch) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "q_proj": {"w": COLUMN_PARALLEL},
+        "k_proj": {"w": COLUMN_PARALLEL},
+        "v_proj": {"w": COLUMN_PARALLEL},
+        "o_proj": {"w": ROW_PARALLEL},
+    }
+    if arch.attention_bias:
+        # Qwen2-style layout: q/k/v carry biases, o_proj does not
+        for name in ("q_proj", "k_proj", "v_proj"):
+            spec[name]["b"] = P(AXIS_TP)
+    if arch.qk_norm:
+        spec["q_norm"] = REPLICATED
+        spec["k_norm"] = REPLICATED
+    return spec
+
+
+def mlp_param_specs(arch: DecoderArch) -> Dict[str, Any]:
+    spec: Dict[str, Any] = {
+        "gate_proj": {"w": COLUMN_PARALLEL},
+        "up_proj": {"w": COLUMN_PARALLEL},
+        "down_proj": {"w": ROW_PARALLEL},
+    }
+    if arch.mlp_bias:
+        spec["gate_proj"]["b"] = P(AXIS_TP)
+        spec["up_proj"]["b"] = P(AXIS_TP)
+        spec["down_proj"]["b"] = REPLICATED
+    return spec
+
+
+def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
+    """PartitionSpec pytree matching the params pytree produced by the model's
+    checkpoint converter. Layer-stacked leaves get their layer dim unsharded
+    (P(None, ...) prefix is implicit: specs rank-match via GSPMD trailing rules,
+    so we write them explicitly below)."""
+
+    def stack(spec_tree):
+        # prepend a None (layer) axis to every leaf spec
+        return jax.tree_util.tree_map(
+            lambda s: P(*((None,) + tuple(s))), spec_tree, is_leaf=lambda x: isinstance(x, P)
+        )
+
+    specs = {
+        "embed_tokens": VOCAB_PARALLEL,
+        "layers": stack(
+            {
+                "input_layernorm": REPLICATED,
+                "post_attention_layernorm": REPLICATED,
+                "attn": attention_param_specs(arch),
+                "mlp": mlp_param_specs(arch),
+            }
+        ),
+        "norm": REPLICATED,
+    }
+    if not arch.tie_word_embeddings:
+        specs["lm_head"] = COLUMN_PARALLEL
+    return specs
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+def _linear(x, p):
+    y = x @ p["w"]
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+def attention_block(
+    arch: DecoderArch,
+    p_attn: Dict[str, Any],
+    hidden: jax.Array,  # (B, S, hidden)
+    cos: jax.Array,
+    sin: jax.Array,
+    k_cache_l: jax.Array,  # (B, KV, W, D) bucket-windowed view
+    v_cache_l: jax.Array,
+    position_ids: jax.Array,  # (B, S)
+    cache_spec: KVCacheSpec,
+    attend_to_cache: bool,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    """QKV -> RoPE -> KV update -> attention -> O (reference:
+    attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
+
+    ``attend_to_cache=False`` (context encoding): queries attend the fresh K/V
+    only — O(S^2) not O(S * max_len). ``True`` (decode/speculation): attend the
+    windowed cache after the in-place update.
+    """
+    B, S, _ = hidden.shape
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+
+    q = _linear(hidden, p_attn["q_proj"]).reshape(B, S, H, D)
+    k = _linear(hidden, p_attn["k_proj"]).reshape(B, S, KV, D)
+    v = _linear(hidden, p_attn["v_proj"]).reshape(B, S, KV, D)
+
+    if arch.qk_norm:
+        q = rms_norm(q, p_attn["q_norm"], arch.rms_norm_eps)
+        k = rms_norm(k, p_attn["k_norm"], arch.rms_norm_eps)
+
+    q = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
+    k = jnp.swapaxes(k, 1, 2)  # (B, KV, S, D)
+    v = jnp.swapaxes(v, 1, 2)
+
+    q = constrain(q, P(None, AXIS_TP, None, None))
+    k = constrain(k, P(None, AXIS_TP, None, None))
+    v = constrain(v, P(None, AXIS_TP, None, None))
+
+    q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+    new_k, new_v = update_layer_cache(
+        k_cache_l, v_cache_l, k, v, position_ids, cache_spec
+    )
+
+    if attend_to_cache:
+        kk, vv = read_layer_cache(new_k, new_v, cache_spec)
+        window = kk.shape[2]
+        kv_pos = jnp.broadcast_to(jnp.arange(window, dtype=position_ids.dtype)[None, :], (B, window))
+        ctx = attn_ops.attention_with_positions(
+            q, kk, vv, position_ids, kv_pos,
+            scale=arch.attention_scale,
+            softmax_dtype=jnp.float32,
+            sliding_window=arch.sliding_window,
+            chunk_size=arch.chunk_size,
+        )
+    else:
+        ctx = attn_ops.attention_with_positions(
+            q, k, v, position_ids, position_ids,
+            scale=arch.attention_scale,
+            softmax_dtype=jnp.float32,
+            sliding_window=arch.sliding_window,
+            chunk_size=arch.chunk_size,
+        )
+
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    out = _linear(ctx, p_attn["o_proj"])
+    return out, (new_k, new_v)
+
+
+def mlp_block(arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """Gated MLP (SwiGLU family). XLA fuses act+mul into the matmuls."""
+    act = ACT_FNS[arch.hidden_act]
+    gate = act(_linear(x, p_mlp["gate_proj"]))
+    up = _linear(x, p_mlp["up_proj"])
+    return _linear(gate * up, p_mlp["down_proj"])
+
+
+def decoder_layer(
+    arch: DecoderArch,
+    lp: Dict[str, Any],
+    hidden: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    k_cache_l: jax.Array,
+    v_cache_l: jax.Array,
+    position_ids: jax.Array,
+    cache_spec: KVCacheSpec,
+    attend_to_cache: bool,
+):
+    h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
+    attn_out, (nk, nv) = attention_block(
+        arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
+        position_ids, cache_spec, attend_to_cache,
+    )
+    hidden = hidden + attn_out
+    h = rms_norm(hidden, lp["post_attention_layernorm"], arch.rms_norm_eps)
+    hidden = hidden + mlp_block(arch, lp["mlp"], h)
+    return hidden, (nk, nv)
+
+
+def run_decoder_layers(
+    arch: DecoderArch,
+    layer_params: Dict[str, Any],  # layer-stacked pytree
+    hidden: jax.Array,
+    cos: jax.Array,
+    sin: jax.Array,
+    cache: Dict[str, jax.Array],  # full (L, B, KV, S_max, D)
+    position_ids: jax.Array,
+    cache_spec: KVCacheSpec,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+):
+    """Scan the layer stack. Cache slices ride the scan as xs/ys.
+
+    ``kv_window`` statically truncates the attended cache to the bucket's token
+    budget (reference: per-bucket compiled TKG programs attend only bucket-many
+    positions) while writes still target the full-length cache.
+    """
+
+    def body(h, xs):
+        lp, kl, vl = xs
+        if kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
+            k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
+            h, (nkw, nvw) = decoder_layer(
+                arch, lp, h, cos, sin, k_win, v_win, position_ids, cache_spec, attend_to_cache
+            )
+            nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
+            nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
+        else:
+            h, (nk, nv) = decoder_layer(
+                arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec, attend_to_cache
+            )
+        return h, (nk, nv)
+
+    hidden, (new_k, new_v) = jax.lax.scan(body, hidden, (layer_params, cache["k"], cache["v"]))
+    return hidden, {"k": new_k, "v": new_v}
+
+
+# ---------------------------------------------------------------------------
+# Full forward
+# ---------------------------------------------------------------------------
+
+def causal_lm_forward(
+    arch: DecoderArch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    output_all_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
+
+    ``batch`` keys: input_ids (B,S) i32, position_ids (B,S) i32,
+    last_token_index (B,) i32, sampling_params (B,3) f32, rng key.
+    Returns (outputs, new_cache); outputs has "tokens" and/or "logits".
+    """
+    from nxdi_tpu.config import to_jax_dtype
+
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    compute_dtype = to_jax_dtype(arch.dtype)
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    cos, sin = rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32)
+
+    cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
+    hidden, new_cache = run_decoder_layers(
+        arch, params["layers"], hidden, cos, sin, cache,
+        position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+    )
+    hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
+
+    lm_head = params.get("lm_head")
+    if lm_head is None:  # tied embeddings
+        lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
+
+    if gather_last_token and not output_all_logits:
+        idx = batch["last_token_index"][:, None, None]  # (B,1,1)
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (hidden.shape[0], 1, hidden.shape[2])), axis=1
+        )  # (B, 1, hidden)
+
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    logits = constrain(logits, P(None, None, AXIS_TP))
+    logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if output_all_logits and gather_last_token:
+        # still provide the last-position logits for the sampler
+        idx = batch["last_token_index"][:, None, None]
+        last_logits = jnp.take_along_axis(
+            logits, jnp.broadcast_to(idx, (logits.shape[0], 1, logits.shape[2])), axis=1
+        )
+    else:
+        last_logits = logits
+
+    if on_device_sampling:
+        tokens = sampling_ops.sample(
+            last_logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )
+        outputs["tokens"] = tokens[:, None]  # (B, 1)
+    if output_logits or output_all_logits or not on_device_sampling:
+        outputs["logits"] = logits[..., : arch.vocab_size - arch.vocab_pad]
+    return outputs, new_cache

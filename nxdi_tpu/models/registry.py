@@ -1,0 +1,34 @@
+"""Model-family registry: HF ``model_type`` -> family module + config class.
+
+The analog of the reference CLI's MODEL_TYPES table (inference_demo.py:53).
+A "family module" exposes: ``build_arch``, ``build_inv_freq``,
+``convert_hf_state_dict``, ``param_specs``, and a ``*InferenceConfig`` class.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Dict, Tuple
+
+_REGISTRY: Dict[str, Tuple[str, str]] = {
+    # model_type -> (module path, config class name)
+    "llama": ("nxdi_tpu.models.llama.modeling_llama", "LlamaInferenceConfig"),
+}
+
+
+def register(model_type: str, module_path: str, config_cls_name: str) -> None:
+    _REGISTRY[model_type] = (module_path, config_cls_name)
+
+
+def get_family(model_type: str):
+    if model_type not in _REGISTRY:
+        raise KeyError(
+            f"Unknown model_type {model_type!r}; registered: {sorted(_REGISTRY)}"
+        )
+    module_path, cfg_name = _REGISTRY[model_type]
+    module = importlib.import_module(module_path)
+    return module, getattr(module, cfg_name)
+
+
+def known_model_types():
+    return sorted(_REGISTRY)

@@ -1,0 +1,102 @@
+"""Attention ops — XLA-native reference path.
+
+This is the always-available fallback the Pallas kernels (ops/kernels/) swap in
+for, mirroring the reference's strategy switch in
+modules/attention/attention_base.py:1330 ``get_flash_attention_strategy``:
+kernels are an optimization, never a semantic change.
+
+TPU-first details:
+  - GQA is computed grouped — Q reshaped to (B, KV, G, S, D) and einsummed
+    against un-repeated K/V — instead of materializing ``repeat_kv`` like the
+    reference's torch path (attention_base.py ``repeat_kv``). Saves HBM
+    bandwidth, and XLA maps the grouped einsum onto the MXU directly.
+  - Softmax accumulates in fp32 (configurable via softmax_dtype).
+  - Masks are computed from position ids, not passed as materialized (S, S)
+    bool inputs, so the same jitted program serves any right-padded batch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -30000.0  # large-negative in bf16 range; matches reference mask fill style
+
+
+def causal_mask_from_positions(q_pos, kv_pos, valid_kv=None):
+    """Boolean mask (B, Sq, Skv): query at q_pos may attend key at kv_pos iff
+    kv_pos <= q_pos (exact-position KV write semantics; see kvcache/kv_cache.py).
+
+    reference: models/model_base.py:226-434 mask builders.
+    """
+    mask = kv_pos[:, None, :] <= q_pos[:, :, None]
+    if valid_kv is not None:
+        mask = mask & valid_kv[:, None, :]
+    return mask
+
+
+def sliding_window_mask_from_positions(q_pos, kv_pos, window: int, valid_kv=None):
+    """Causal AND kv_pos > q_pos - window (reference: attention_base.py:3080 windowed)."""
+    mask = causal_mask_from_positions(q_pos, kv_pos, valid_kv)
+    return mask & (kv_pos[:, None, :] > q_pos[:, :, None] - window)
+
+
+def chunked_attention_mask_from_positions(q_pos, kv_pos, chunk_size: int, valid_kv=None):
+    """Llama4-style chunked attention: attend only within the same chunk
+    (reference: attention_base.py:2559-2648)."""
+    mask = causal_mask_from_positions(q_pos, kv_pos, valid_kv)
+    same_chunk = (kv_pos[:, None, :] // chunk_size) == (q_pos[:, :, None] // chunk_size)
+    return mask & same_chunk
+
+
+def grouped_attention(
+    q,  # (B, H, Sq, D)
+    k,  # (B, KV, Skv, D)
+    v,  # (B, KV, Skv, D)
+    mask,  # (B, Sq, Skv) bool
+    scale: Optional[float] = None,
+    softmax_dtype=jnp.float32,
+    sink: Optional[jax.Array] = None,  # (H,) learned attention-sink logits
+):
+    """Grouped-head scaled dot-product attention. Returns (B, H, Sq, D)."""
+    B, H, Sq, D = q.shape
+    KV = k.shape[1]
+    G = H // KV
+    if scale is None:
+        scale = D ** -0.5
+    qg = q.reshape(B, KV, G, Sq, D)
+    scores = jnp.einsum("bkgqd,bksd->bkgqs", qg, k, preferred_element_type=softmax_dtype)
+    scores = scores.astype(softmax_dtype) * scale
+    scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
+    if sink is not None:
+        # gpt-oss style: concat a learned per-head sink logit before softmax and
+        # drop its probability mass (reference: modules/attention/sink.py).
+        sink_col = jnp.broadcast_to(
+            sink.reshape(1, KV, G, 1, 1).astype(softmax_dtype), (B, KV, G, Sq, 1)
+        )
+        full = jnp.concatenate([scores, sink_col], axis=-1)
+        weights = jax.nn.softmax(full, axis=-1)[..., :-1]
+    else:
+        weights = jax.nn.softmax(scores, axis=-1)
+    weights = weights.astype(v.dtype)
+    out = jnp.einsum("bkgqs,bksd->bkgqd", weights, v)
+    return out.reshape(B, H, Sq, D)
+
+
+def attention_with_positions(
+    q, k, v, q_pos, kv_pos, *,
+    scale=None, softmax_dtype=jnp.float32,
+    sliding_window: Optional[int] = None,
+    chunk_size: Optional[int] = None,
+    sink=None,
+):
+    """Attention with the mask derived from positions (prefill and decode both)."""
+    if sliding_window is not None:
+        mask = sliding_window_mask_from_positions(q_pos, kv_pos, sliding_window)
+    elif chunk_size is not None:
+        mask = chunked_attention_mask_from_positions(q_pos, kv_pos, chunk_size)
+    else:
+        mask = causal_mask_from_positions(q_pos, kv_pos)
+    return grouped_attention(q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink)

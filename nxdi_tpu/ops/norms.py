@@ -1,0 +1,34 @@
+"""Normalization ops.
+
+The reference routes RMSNorm through a Neuron custom call ``AwsNeuronRmsNorm``
+(modules/custom_calls.py:36-61). On TPU, XLA fuses the reduction+rsqrt+scale
+pattern natively, so the idiomatic implementation is plain jnp with fp32
+accumulation; a Pallas fused rmsnorm(+quant) kernel slots in later behind
+``mlp_kernel_enabled``-style flags.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-6):
+    """RMSNorm with float32 accumulation, output in x.dtype (matches HF llama)."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    y = xf * jax.lax.rsqrt(var + eps)
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x, weight, bias=None, eps: float = 1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)

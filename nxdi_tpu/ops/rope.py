@@ -1,0 +1,100 @@
+"""Rotary position embeddings.
+
+Matches HF's llama rotation convention (rotate_half) which the reference also
+uses (modules/attention/utils.py ``apply_rotary_pos_emb``). Supports plain RoPE
+(rope_theta), llama3-style frequency scaling, and (later) M-RoPE for Qwen-VL.
+
+Frequencies are computed on the fly from position ids — no precomputed
+sin/cos cache parameter, which keeps the jitted graph shape-polymorphic only
+over the bucketed dims and lets XLA fuse the trig into the surrounding ops.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def default_inv_freq(head_dim: int, rope_theta: float) -> np.ndarray:
+    return 1.0 / (rope_theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim))
+
+
+def llama3_scaled_inv_freq(
+    head_dim: int,
+    rope_theta: float,
+    factor: float = 8.0,
+    low_freq_factor: float = 1.0,
+    high_freq_factor: float = 4.0,
+    original_max_position: int = 8192,
+) -> np.ndarray:
+    """Llama-3.1 rope scaling (matches HF ``_compute_llama3_parameters``)."""
+    inv_freq = default_inv_freq(head_dim, rope_theta)
+    low_freq_wavelen = original_max_position / low_freq_factor
+    high_freq_wavelen = original_max_position / high_freq_factor
+    wavelen = 2 * np.pi / inv_freq
+    scaled = np.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    smooth = (original_max_position / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smoothed = (1 - smooth) * scaled / factor + smooth * scaled
+    is_medium = (wavelen >= high_freq_wavelen) & (wavelen <= low_freq_wavelen)
+    return np.where(is_medium, smoothed, scaled)
+
+
+def inv_freq_from_hf_config(head_dim: int, rope_theta: float, rope_scaling=None) -> np.ndarray:
+    if rope_scaling is None:
+        return default_inv_freq(head_dim, rope_theta)
+    rope_type = rope_scaling.get("rope_type", rope_scaling.get("type", "default"))
+    if rope_type == "llama3":
+        return llama3_scaled_inv_freq(
+            head_dim,
+            rope_theta,
+            factor=rope_scaling.get("factor", 8.0),
+            low_freq_factor=rope_scaling.get("low_freq_factor", 1.0),
+            high_freq_factor=rope_scaling.get("high_freq_factor", 4.0),
+            original_max_position=rope_scaling.get("original_max_position_embeddings", 8192),
+        )
+    if rope_type in ("linear",):
+        return default_inv_freq(head_dim, rope_theta) / rope_scaling.get("factor", 1.0)
+    if rope_type == "default":
+        return default_inv_freq(head_dim, rope_theta)
+    if rope_type == "dynamic":
+        # dynamic NTK equals default frequencies within the original context
+        # window; beyond it the runtime would need to rescale — warn loudly.
+        import warnings
+
+        warnings.warn(
+            "rope_type 'dynamic' treated as default frequencies; positions "
+            "beyond original_max_position_embeddings will rotate incorrectly"
+        )
+        return default_inv_freq(head_dim, rope_theta)
+    # yarn etc.: failing loudly beats silently wrong long-context rotations
+    raise ValueError(f"Unsupported rope scaling type: {rope_type}")
+
+
+def rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32):
+    """(B, S) int positions -> cos/sin of shape (B, S, head_dim)."""
+    inv_freq = jnp.asarray(inv_freq, dtype=jnp.float32)
+    freqs = position_ids.astype(jnp.float32)[..., None] * inv_freq[None, None, :]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)
+
+
+def rotate_half(x):
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rotary_pos_emb(q, k, cos, sin):
+    """q/k: (B, heads, S, head_dim); cos/sin: (B, S, head_dim).
+
+    Computed in fp32 and cast back — bf16 rotation loses position precision at
+    long context (same reason the reference keeps rope in fp32).
+    """
+    cos = cos[:, None, :, :].astype(jnp.float32)
+    sin = sin[:, None, :, :].astype(jnp.float32)
+    qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+    q_out = qf * cos + rotate_half(qf) * sin
+    k_out = kf * cos + rotate_half(kf) * sin
+    return q_out.astype(q.dtype), k_out.astype(k.dtype)

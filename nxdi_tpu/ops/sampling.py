@@ -1,0 +1,140 @@
+"""On-device sampling: greedy and top-k -> top-p -> temperature multinomial.
+
+Reference: modules/generation/sampling.py — ``Sampler`` (:243) with per-batch
+sampling-params tensor ``[top_k, top_p, temperature]`` (:185
+``prepare_sampling_params``), staged sharded top-k (:287), inverse-CDF
+multinomial (:364, torch.multinomial is untraceable there; here we use the same
+inverse-CDF trick because it is deterministic given the uniform draw), and
+padded-logit masking (:24 ``mask_padded_logits``).
+
+TPU-native notes:
+  - Logits arrive vocab-sharded (lm_head is column-parallel). ``lax.top_k`` on
+    the sharded axis is handled by GSPMD as shard-local top-k + gather + final
+    top-k — the same two-stage reduction the reference hand-writes.
+  - ``global_topk`` bounds the candidate set (default 256) so the expensive
+    full-vocab sort never happens.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -30000.0
+
+
+def prepare_sampling_params(
+    batch_size: int,
+    top_k: Sequence[int] = (1,),
+    top_p: Sequence[float] = (1.0,),
+    temperature: Sequence[float] = (1.0,),
+) -> np.ndarray:
+    """(B, 3) float32 tensor [top_k, top_p, temperature] per batch line
+    (reference: sampling.py:185-208)."""
+
+    def bcast(x, name):
+        arr = np.asarray(x, dtype=np.float32).reshape(-1)
+        if arr.size == 1:
+            arr = np.full((batch_size,), arr[0], dtype=np.float32)
+        if arr.size != batch_size:
+            raise ValueError(f"{name} must have 1 or batch_size entries, got {arr.size}")
+        return arr
+
+    return np.stack(
+        [bcast(top_k, "top_k"), bcast(top_p, "top_p"), bcast(temperature, "temperature")],
+        axis=1,
+    )
+
+
+def mask_padded_logits(logits, pad_size: int):
+    """Mask the vocab-padding tail added so vocab divides tp
+    (reference: sampling.py:24-40)."""
+    if pad_size == 0:
+        return logits
+    vocab = logits.shape[-1]
+    idx = jnp.arange(vocab)
+    return jnp.where(idx >= vocab - pad_size, NEG_INF, logits)
+
+
+def greedy_sample(logits):
+    """(..., V) -> (...) argmax token ids."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+
+def topk_topp_temperature_sample(
+    logits,  # (B, V) fp32/bf16
+    sampling_params,  # (B, 3) [top_k, top_p, temperature]
+    rng: jax.Array,  # PRNG key
+    global_topk: int = 256,
+    deterministic: bool = False,
+):
+    """Per-batch dynamic top-k/top-p/temperature sampling, fixed-shape.
+
+    All batch lines run the same fixed-shape program; per-line parameters are
+    applied as masks (the reference's approach on Neuron, same reason: traced
+    graphs need static shapes).
+    """
+    B, V = logits.shape
+    k = min(global_topk, V)
+    logits = logits.astype(jnp.float32)
+    top_vals, top_idx = jax.lax.top_k(logits, k)  # (B, k), sorted desc
+
+    top_k_param = sampling_params[:, 0]
+    top_p_param = sampling_params[:, 1]
+    temperature = jnp.maximum(sampling_params[:, 2], 1e-6)
+
+    rank = jnp.arange(k)[None, :].astype(jnp.float32)
+    # top-k mask: keep rank < top_k (top_k <= 0 means disabled -> keep all)
+    k_mask = jnp.where(top_k_param[:, None] > 0, rank < top_k_param[:, None], True)
+    vals = jnp.where(k_mask, top_vals, NEG_INF)
+
+    # temperature before top-p (HF order: temperature -> top-k -> top-p)
+    vals = vals / temperature[:, None]
+
+    # top-p over the candidate set: keep smallest prefix with cumprob >= top_p,
+    # always keeping the best token (reference: sampling.py:338-363)
+    probs = jax.nn.softmax(vals, axis=-1)
+    cumprobs = jnp.cumsum(probs, axis=-1)
+    p_mask = (cumprobs - probs) < top_p_param[:, None]  # exclusive cumsum < p
+    p_mask = p_mask.at[:, 0].set(True)  # rank 0 always survives (top_p -> 0 == greedy)
+    vals = jnp.where(p_mask, vals, NEG_INF)
+
+    probs = jax.nn.softmax(vals, axis=-1)
+    cdf = jnp.cumsum(probs, axis=-1)
+    if deterministic:
+        u = jnp.full((B, 1), 0.5, dtype=jnp.float32)
+    else:
+        u = jax.random.uniform(rng, (B, 1), dtype=jnp.float32)
+    # inverse CDF: first index where cdf >= u  (reference: sampling.py:364-436)
+    choice = jnp.sum((cdf < u).astype(jnp.int32), axis=-1)
+    choice = jnp.clip(choice, 0, k - 1)
+    return jnp.take_along_axis(top_idx, choice[:, None], axis=1)[:, 0].astype(jnp.int32)
+
+
+def sample(
+    logits,  # (B, V)
+    sampling_params,  # (B, 3)
+    rng: Optional[jax.Array] = None,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+):
+    """Top-level sampler (reference: sampling.py:437-467 ``Sampler.forward``).
+
+    With ``do_sample=False`` this is pure argmax. With ``do_sample=True``,
+    batch lines with top_k==1 still reduce to greedy exactly (their mask keeps
+    only rank 0).
+    """
+    if not do_sample:
+        return greedy_sample(logits)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    greedy = greedy_sample(logits)
+    sampled = topk_topp_temperature_sample(
+        logits, sampling_params, rng, global_topk=global_topk, deterministic=deterministic
+    )
+    is_greedy = sampling_params[:, 0] == 1
+    return jnp.where(is_greedy, greedy, sampled)

@@ -1,0 +1,167 @@
+"""GQA head-sharding strategy: make Q/KV head counts divide the TP degree.
+
+The reference solves "kv_heads doesn't divide tp" by rewriting the checkpoint
+(reference: modules/attention/gqa.py:89 ``determine_sharding_strategy``,
+:105 ``get_shardable_head_counts``, :353 ``replicate_kv``). We do the same —
+at checkpoint-conversion time, on host numpy arrays — so the on-device params
+always shard cleanly along the head axis with a plain PartitionSpec.
+
+Strategies (reference gqa.py:59):
+  - ``REPLICATE_TO_TP_DEGREE`` — replicate each KV head tp/kv times in place
+    (replicas adjacent) so kv_heads == tp; query heads are interleaved into
+    their group's slot range so the q->kv group mapping is preserved.
+  - ``CONVERT_TO_MHA`` — replicate each KV head group-size times so every query
+    head gets a private KV head; any remaining q padding appends zero heads.
+
+All transforms are layout-aware: for q-head padding, source group g's heads
+must land in the slot range adjacent to g's KV replicas — appending zeros at
+the end would silently remap real q heads to the wrong KV group.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class GQA(enum.Enum):
+    CONVERT_TO_MHA = "convert-to-mha"
+    REPLICATE_TO_TP_DEGREE = "replicate-to-tp-degree"
+
+
+@dataclass(frozen=True)
+class GQAPlan:
+    strategy: GQA
+    source_heads: int
+    source_kv: int
+    target_heads: int
+    target_kv: int
+
+    @property
+    def changed(self) -> bool:
+        return (self.source_heads, self.source_kv) != (self.target_heads, self.target_kv)
+
+
+def determine_sharding_strategy(
+    tp_degree: int, source_kv_heads: int, desired: GQA = GQA.REPLICATE_TO_TP_DEGREE
+) -> GQA:
+    """reference: gqa.py:89-103."""
+    if desired == GQA.REPLICATE_TO_TP_DEGREE and not (
+        tp_degree % source_kv_heads == 0 or source_kv_heads % tp_degree == 0
+    ):
+        return GQA.CONVERT_TO_MHA
+    return desired
+
+
+def get_shardable_head_counts(
+    tp_degree: int, num_heads: int, num_kv_heads: int, strategy: GQA
+):
+    """Padded (num_heads, num_kv_heads) that divide tp (reference: gqa.py:105-150)."""
+    padded_heads = math.ceil(num_heads / tp_degree) * tp_degree
+    if num_heads == num_kv_heads or strategy == GQA.CONVERT_TO_MHA:
+        return padded_heads, padded_heads
+    # REPLICATE_TO_TP_DEGREE
+    if num_kv_heads % tp_degree == 0:
+        return padded_heads, num_kv_heads  # already shardable, no replication
+    return padded_heads, tp_degree  # replicate up to one kv head per rank
+
+
+def plan_gqa_sharding(
+    tp_degree: int,
+    num_heads: int,
+    num_kv_heads: int,
+    desired: GQA = GQA.REPLICATE_TO_TP_DEGREE,
+) -> GQAPlan:
+    strategy = determine_sharding_strategy(tp_degree, num_kv_heads, desired)
+    heads, kv = get_shardable_head_counts(tp_degree, num_heads, num_kv_heads, strategy)
+    return GQAPlan(strategy, num_heads, num_kv_heads, heads, kv)
+
+
+# ---------------------------------------------------------------------------
+# Weight transforms. All take HF-layout projections ``(heads*head_dim, in)``.
+# ---------------------------------------------------------------------------
+
+def convert_kv(weight: np.ndarray, head_dim: int, plan: GQAPlan) -> np.ndarray:
+    """K/V projection: source kv heads -> target kv heads."""
+    if plan.target_kv == plan.source_kv:
+        return weight
+    w = weight.reshape(plan.source_kv, head_dim, -1)
+    if plan.strategy == GQA.CONVERT_TO_MHA:
+        # one kv replica per source q head (aligned in q order), zero-pad tail
+        group = plan.source_heads // plan.source_kv
+        w = np.repeat(w, group, axis=0)  # source_heads kv heads
+        pad = plan.target_kv - plan.source_heads
+        if pad:
+            w = np.concatenate(
+                [w, np.zeros((pad, head_dim, w.shape[-1]), dtype=w.dtype)], axis=0
+            )
+    else:
+        if plan.target_kv % plan.source_kv != 0:
+            raise ValueError(f"Bad replicate plan: {plan}")
+        w = np.repeat(w, plan.target_kv // plan.source_kv, axis=0)  # adjacent replicas
+    return w.reshape(plan.target_kv * head_dim, -1)
+
+
+def convert_q(weight: np.ndarray, head_dim: int, plan: GQAPlan) -> np.ndarray:
+    """Q projection: interleave source groups into the target slot layout."""
+    if plan.target_heads == plan.source_heads and plan.target_kv == plan.source_kv:
+        return weight
+    if plan.strategy == GQA.CONVERT_TO_MHA:
+        pad_rows = (plan.target_heads - plan.source_heads) * head_dim
+        pad = np.zeros((pad_rows, weight.shape[1]), dtype=weight.dtype)
+        return np.concatenate([weight, pad], axis=0)
+    Gs = plan.source_heads // plan.source_kv
+    r = plan.target_kv // plan.source_kv
+    Gt = plan.target_heads // plan.target_kv
+    slots = r * Gt  # q slots per source kv group
+    if Gs > slots:
+        raise ValueError(f"Cannot fit {Gs} query heads into {slots} slots: {plan}")
+    w = weight.reshape(plan.source_kv, Gs, head_dim, -1)
+    out = np.zeros((plan.source_kv, slots, head_dim, w.shape[-1]), dtype=weight.dtype)
+    out[:, :Gs] = w
+    return out.reshape(plan.target_heads * head_dim, -1)
+
+
+def convert_o(weight: np.ndarray, head_dim: int, plan: GQAPlan) -> np.ndarray:
+    """o_proj input-column rearrangement matching :func:`convert_q`
+    (HF layout ``(hidden, heads*head_dim)``)."""
+    if not plan.changed:
+        return weight
+    return convert_q(
+        np.ascontiguousarray(weight.T), head_dim, plan
+    ).T
+
+
+# -- thin compat wrappers used by earlier call sites/tests --
+
+def replicate_kv_heads(weight, head_dim, source_kv, target_kv):
+    plan = GQAPlan(GQA.REPLICATE_TO_TP_DEGREE, source_kv, source_kv, target_kv, target_kv)
+    # pure replication path: treat as kv-only transform
+    if target_kv == source_kv:
+        return weight
+    if target_kv % source_kv != 0:
+        raise ValueError(f"target_kv {target_kv} must be a multiple of {source_kv}")
+    w = weight.reshape(source_kv, head_dim, -1)
+    w = np.repeat(w, target_kv // source_kv, axis=0)
+    return w.reshape(target_kv * head_dim, -1)
+
+
+def pad_q_heads(weight, head_dim, source_heads, source_kv, target_heads, target_kv):
+    if source_heads == source_kv and target_heads == target_kv:
+        strategy = GQA.CONVERT_TO_MHA
+    else:
+        strategy = determine_sharding_strategy(target_kv, source_kv)
+    plan = GQAPlan(strategy, source_heads, source_kv, target_heads, target_kv)
+    return convert_q(weight, head_dim, plan)
+
+
+def pad_o_proj(weight, head_dim, source_heads, source_kv, target_heads, target_kv):
+    if source_heads == source_kv and target_heads == target_kv:
+        strategy = GQA.CONVERT_TO_MHA
+    else:
+        strategy = determine_sharding_strategy(target_kv, source_kv)
+    plan = GQAPlan(strategy, source_heads, source_kv, target_heads, target_kv)
+    return convert_o(weight, head_dim, plan)

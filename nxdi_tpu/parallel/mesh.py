@@ -1,0 +1,93 @@
+"""Device-mesh construction — the TPU-native replacement for the reference's
+torch.distributed process groups.
+
+The reference builds explicit rank meshes per parallel strategy
+(reference: models/model_base.py:172-188 ``initialize_model_parallel``,
+modules/attention/attention_process_groups.py:11-160 CP/DP meshes over the TP
+world). On TPU all of that collapses into ONE :class:`jax.sharding.Mesh` with
+named logical axes; XLA GSPMD inserts the collectives, and
+``mesh_utils.create_device_mesh`` lays ranks out along the physical ICI torus —
+the analog of the reference's hand-built 8x8 TRN2 physical-topology mesh
+(attention_process_groups.py:11 ``tp_mesh_8_by_8``).
+
+Axis naming convention (used by every PartitionSpec in the framework):
+  - ``dp``  — data parallel over requests (attention-DP for decode splits batch)
+  - ``cp``  — context parallel (prefill sequence sharding inside the TP world)
+  - ``tp``  — tensor parallel (heads / hidden / vocab / experts)
+The EP axis for MoE reuses ``tp`` via reshaped specs (experts x tp_inner), see
+parallel/moe sharding in ops/moe.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_CP = "cp"
+AXIS_TP = "tp"
+AXIS_EP = "ep"  # alias axis used when a dedicated expert-parallel dim is built
+
+
+def build_mesh(
+    tp_degree: int = 1,
+    dp_degree: int = 1,
+    cp_degree: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+    allow_split_physical_axes: bool = True,
+) -> Mesh:
+    """Build a ``Mesh`` with axes (dp, cp, tp).
+
+    ``cp`` splits the TP world the way the reference's CP process groups do
+    (attention_process_groups.py:47 ``get_tp_cp_group_mesh``): the attention TP
+    degree during prefill becomes tp/cp while Q sequence is sharded over cp.
+    We therefore build the mesh as (dp, cp, tp/cp) so dp*cp*(tp/cp) == device count.
+    """
+    if tp_degree % cp_degree != 0:
+        raise ValueError(f"cp_degree {cp_degree} must divide tp_degree {tp_degree}")
+    inner_tp = tp_degree // cp_degree
+    n = dp_degree * cp_degree * inner_tp
+    if devices is None:
+        devices = jax.devices()
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    devices = list(devices)[:n]
+    if len(devices) == 1:
+        dev_array = np.array(devices).reshape(1, 1, 1)
+    else:
+        try:
+            dev_array = mesh_utils.create_device_mesh(
+                (dp_degree, cp_degree, inner_tp),
+                devices=devices,
+                allow_split_physical_axes=allow_split_physical_axes,
+            )
+        except (ValueError, AssertionError, NotImplementedError):
+            dev_array = np.array(devices).reshape(dp_degree, cp_degree, inner_tp)
+    return Mesh(dev_array, (AXIS_DP, AXIS_CP, AXIS_TP))
+
+
+def mesh_from_config(tpu_config, devices=None) -> Mesh:
+    """Mesh for a :class:`TpuConfig` (tp/cp/attention-dp degrees).
+
+    The ``dp`` mesh axis stays 1: attention-DP splits the TP world per
+    submodel (reference: attention_process_groups.py:125), which is expressed
+    through per-submodel PartitionSpecs, not extra devices.
+    """
+    return build_mesh(
+        tp_degree=tpu_config.tp_degree,
+        dp_degree=1,
+        cp_degree=tpu_config.cp_degree,
+        devices=devices,
+    )
+
+
+def named(mesh: Mesh, *spec) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,287 @@
+"""Application lifecycle: compile -> save -> load -> forward.
+
+The analog of the reference's ``NeuronApplicationBase``/``NeuronBaseForCausalLM``
+(models/application_base.py:292 compile, :317 load, :348 warmup;
+models/model_base.py:3078 CausalLM submodel construction and :3367 dispatch).
+
+Artifact model: the reference serializes traced NEFFs into
+``--compiled-model-path``. Here the artifact directory holds
+  - ``tpu_config.json``   — the InferenceConfig round trip (config.py),
+  - ``cache/``            — JAX persistent compilation cache entries, written
+                            by AOT ``lower().compile()`` of every bucket
+                            program (so a later ``load()`` never recompiles),
+  - ``weights/``          — optional presharded safetensors.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu import checkpoint as ckpt
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+from nxdi_tpu.parallel.layers import shard_pytree, sharding_tree
+from nxdi_tpu.parallel.mesh import mesh_from_config
+from nxdi_tpu.runtime import autobucketing
+from nxdi_tpu.runtime.model_wrapper import (
+    TAG_CONTEXT_ENCODING,
+    TAG_TOKEN_GENERATION,
+    ModelWrapper,
+)
+
+logger = logging.getLogger("nxdi_tpu")
+
+
+def enable_persistent_cache(path: str) -> None:
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+
+
+class ApplicationBase:
+    """Owns the submodel ModelWrappers + device state (params, KV cache)."""
+
+    _model_cls = None  # model-family module; set by subclasses/registry
+
+    def __init__(self, model_path: str, config: InferenceConfig, model_family=None):
+        self.model_path = model_path
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.family = model_family or self._model_cls
+        if self.family is None:
+            raise ValueError("No model family bound to this application")
+        self.models: Dict[str, ModelWrapper] = {}
+        self.mesh = None
+        self.params = None
+        self.kv_cache = None
+        self.is_loaded = False
+
+    # -- submodel construction: subclasses populate self.models --
+    def enable_models(self) -> None:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def get_state_dict(self) -> Dict[str, np.ndarray]:
+        """HF checkpoint -> flat numpy dict, with reference-compatible prefix
+        normalization (application_base.py:691 get_state_dict)."""
+        sd = ckpt.load_state_dict(self.model_path)
+        return sd
+
+    def build_params(self) -> Any:
+        sd = self.get_state_dict()
+        return self.family.convert_hf_state_dict(sd, self.config)
+
+    # ------------------------------------------------------------------
+    def compile(self, compiled_model_path: str) -> None:
+        """AOT-compile every (submodel, bucket) program into the persistent
+        cache at ``compiled_model_path`` (reference: application_base.py:292)."""
+        t0 = time.time()
+        os.makedirs(compiled_model_path, exist_ok=True)
+        self.config.save(compiled_model_path)
+        enable_persistent_cache(os.path.join(compiled_model_path, "cache"))
+        self._build_wrappers()
+        params_struct = self.build_params_struct()
+        cache_struct = self._cache_struct()
+        for wrapper in self.models.values():
+            wrapper.aot_compile(params_struct, cache_struct)
+        logger.info("compiled %d submodels in %.1fs", len(self.models), time.time() - t0)
+
+    def build_params_struct(self):
+        """Abstract param pytree (no weight IO) for AOT lowering."""
+        arch = self.family.build_arch(self.config)
+        return params_shape_struct(self.family, self.config, arch)
+
+    def _cache_struct(self):
+        spec = self._cache_spec()
+        from nxdi_tpu.config import to_jax_dtype
+
+        z = jax.ShapeDtypeStruct(spec.shape, spec.store_dtype)
+        return {"k": z, "v": z}
+
+    def _cache_spec(self):
+        arch = self.family.build_arch(self.config)
+        return arch.kv_cache_spec(
+            self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size,
+            self.tpu_config.seq_len,
+            quant_dtype=(
+                self.tpu_config.kv_quant_config.dtype
+                if self.tpu_config.kv_quant_config
+                else None
+            ),
+        )
+
+    # ------------------------------------------------------------------
+    def load(self, compiled_model_path: Optional[str] = None) -> None:
+        """Weights to HBM (sharded), KV cache allocated, programs built, warmup
+        (reference: application_base.py:317-372)."""
+        if compiled_model_path is not None:
+            enable_persistent_cache(os.path.join(compiled_model_path, "cache"))
+        self.mesh = mesh_from_config(self.tpu_config)
+        jax.set_mesh(self.mesh)
+        self._build_wrappers()
+
+        params_host = self.build_params()
+        specs = self.family.param_specs(self.config)
+        self.params = shard_pytree(params_host, specs, self.mesh)
+        del params_host
+
+        cache_specs = kv_cache_partition_spec()
+        cache_host = init_kv_cache(self._cache_spec())
+        self.kv_cache = shard_pytree(cache_host, cache_specs, self.mesh)
+
+        if not self.tpu_config.skip_warmup:
+            self.warmup()
+        self.is_loaded = True
+
+    def _build_wrappers(self) -> None:
+        if self.models:
+            return
+        self.enable_models()
+        if self.mesh is None:
+            self.mesh = mesh_from_config(self.tpu_config)
+            jax.set_mesh(self.mesh)
+        param_shardings = sharding_tree(self.family.param_specs(self.config), self.mesh)
+        cache_shardings = sharding_tree(kv_cache_partition_spec(), self.mesh)
+        for wrapper in self.models.values():
+            wrapper.build(self.mesh, param_shardings, cache_shardings)
+
+    def warmup(self) -> None:
+        """Run every (submodel, bucket) once on dummy inputs so first real
+        requests never hit compile latency (reference: application_base.py:348)."""
+        t0 = time.time()
+        for wrapper in self.models.values():
+            for bucket in wrapper.buckets:
+                seq = wrapper.n_active_tokens if wrapper.attend_to_cache else bucket
+                b = wrapper.batch_size
+                batch = {
+                    "input_ids": np.zeros((b, seq), dtype=np.int32),
+                    "position_ids": np.tile(np.arange(seq, dtype=np.int32), (b, 1))
+                    if not wrapper.attend_to_cache
+                    else np.full((b, seq), bucket - 1, dtype=np.int32),
+                    "last_token_index": np.zeros((b,), dtype=np.int32),
+                    "sampling_params": np.tile([1.0, 1.0, 1.0], (b, 1)).astype(np.float32),
+                }
+                out, self.kv_cache = wrapper.forward(self.params, self.kv_cache, batch)
+                jax.block_until_ready(out)
+        logger.info("warmup done in %.1fs", time.time() - t0)
+
+    def reset_kv_cache(self) -> None:
+        from nxdi_tpu.kvcache.kv_cache import reset_kv_cache
+
+        self.kv_cache = reset_kv_cache(self.kv_cache)
+
+
+def params_shape_struct(family, config, arch):
+    """Build a ShapeDtypeStruct pytree matching the family's params layout
+    without touching checkpoint bytes — used for AOT compile before weights
+    exist (reference compiles from checkpoint_loader_fn lazily too,
+    application_base.py:628)."""
+    from nxdi_tpu.config import to_jax_dtype
+
+    dt = to_jax_dtype(arch.dtype)
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    hs, inter, V, L = arch.hidden_size, arch.intermediate_size, arch.vocab_size, arch.num_layers
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    attn = {
+        "q_proj": {"w": s(L, hs, H * D)},
+        "k_proj": {"w": s(L, hs, KV * D)},
+        "v_proj": {"w": s(L, hs, KV * D)},
+        "o_proj": {"w": s(L, H * D, hs)},
+    }
+    if arch.attention_bias:
+        attn["q_proj"]["b"] = s(L, H * D)
+        attn["k_proj"]["b"] = s(L, KV * D)
+        attn["v_proj"]["b"] = s(L, KV * D)
+    if arch.qk_norm:
+        attn["q_norm"] = s(L, D)
+        attn["k_norm"] = s(L, D)
+    params = {
+        "embed_tokens": s(V, hs),
+        "layers": {
+            "input_layernorm": s(L, hs),
+            "post_attention_layernorm": s(L, hs),
+            "attn": attn,
+            "mlp": {
+                "gate_proj": {"w": s(L, hs, inter)},
+                "up_proj": {"w": s(L, hs, inter)},
+                "down_proj": {"w": s(L, inter, hs)},
+            },
+        },
+        "norm": s(hs),
+    }
+    if not arch.tie_word_embeddings:
+        params["lm_head"] = s(hs, V)
+    return params
+
+
+class TpuModelForCausalLM(ApplicationBase):
+    """CausalLM application: CTE + TKG submodels, CPU-side dispatch
+    (reference: models/model_base.py:3078 ``NeuronBaseForCausalLM``)."""
+
+    def enable_models(self) -> None:
+        arch = self.family.build_arch(self.config)
+        inv_freq = self.family.build_inv_freq(self.config)
+        tc = self.tpu_config
+        sampling_kwargs = {}
+        odsc = tc.on_device_sampling_config
+        on_device_sampling = odsc is not None
+        if on_device_sampling:
+            sampling_kwargs = dict(
+                do_sample=odsc.do_sample,
+                global_topk=odsc.global_topk,
+                deterministic=odsc.deterministic,
+            )
+
+        self.models[TAG_CONTEXT_ENCODING] = ModelWrapper(
+            TAG_CONTEXT_ENCODING,
+            self.config,
+            arch,
+            inv_freq,
+            batch_size=tc.ctx_batch_size,
+            n_active_tokens=0,  # bucket-determined
+            buckets=autobucketing.context_encoding_buckets(self.config),
+            attend_to_cache=False,
+            forward_kwargs=dict(
+                gather_last_token=True,
+                output_logits=tc.output_logits,
+                on_device_sampling=on_device_sampling,
+                **sampling_kwargs,
+            ),
+        )
+        self.models[TAG_TOKEN_GENERATION] = ModelWrapper(
+            TAG_TOKEN_GENERATION,
+            self.config,
+            arch,
+            inv_freq,
+            batch_size=tc.tkg_batch_size,
+            n_active_tokens=1,
+            buckets=autobucketing.token_generation_buckets(self.config),
+            attend_to_cache=True,
+            forward_kwargs=dict(
+                gather_last_token=False,
+                output_logits=tc.output_logits,
+                on_device_sampling=on_device_sampling,
+                **sampling_kwargs,
+            ),
+        )
+
+    # -- dispatch (reference: model_base.py:3606 _get_model_outputs) --
+    def forward(self, input_ids: np.ndarray, position_ids: np.ndarray, **kwargs):
+        if not self.is_loaded:
+            raise RuntimeError("call load() before forward()")
+        is_prefill = input_ids.shape[1] > 1
+        tag = TAG_CONTEXT_ENCODING if is_prefill else TAG_TOKEN_GENERATION
+        batch = {"input_ids": input_ids, "position_ids": position_ids, **kwargs}
+        outputs, self.kv_cache = self.models[tag].forward(self.params, self.kv_cache, batch)
+        return outputs

@@ -1,0 +1,98 @@
+"""Bucket-ladder generation — behavior-compatible with the reference
+(modules/autobucketing.py): powers of two from min to max with the true max as
+the last rung, 2-D ladders for prefix caching, and capped chunk ladders for
+chunked prefill.
+
+Each bucket becomes one AOT-compiled XLA program (static shapes feed the MXU
+tiling); the CPU-side dispatcher pads to the smallest rung that fits.
+"""
+
+from __future__ import annotations
+
+from math import ceil, log2
+from typing import List, Sequence
+
+BUCKET_SELECTION_STRATEGIES = {"max", "first_fit", "second_fit"}
+
+
+def generate_buckets(min_length: int, max_length: int) -> List[int]:
+    """reference: autobucketing.py:8-20 (round(log2) spacing, max appended)."""
+    if min_length == max_length:
+        return [max_length]
+    min_bound = int(log2(min_length))
+    max_bound = round(log2(max_length))
+    return [2**i for i in range(min_bound, max_bound)] + [max_length]
+
+
+def generate_2d_buckets_for_prefix_caching(
+    min_vertical: int,
+    max_vertical: int,
+    min_horizontal: int,
+    max_horizontal: int,
+    is_context_encode: bool = False,
+) -> List[List[int]]:
+    """(active_tokens x prefix_size) grid (reference: autobucketing.py:22-42)."""
+    vertical = generate_buckets(min_vertical, max_vertical)
+    horizontal = generate_buckets(min_horizontal, max_horizontal)
+    if is_context_encode:
+        horizontal = [0] + horizontal
+    return [[v, h] for v in vertical for h in horizontal]
+
+
+def generate_buckets_on_chunk_size(q_tile_size: int, max_context_len: int) -> List[int]:
+    """At most 3 rungs, multiples of the q tile (reference: autobucketing.py:64-99)."""
+    if max_context_len < q_tile_size:
+        return [q_tile_size]
+    num_q_tiles = ceil(max_context_len / q_tile_size)
+    all_buckets = [b * q_tile_size for b in range(1, num_q_tiles + 1)]
+    left, right = 0, len(all_buckets) - 1
+    median = right // 2
+    out = [all_buckets[left]]
+    if median > left:
+        out.append(all_buckets[median])
+    if right > median:
+        out.append(all_buckets[right])
+    return out
+
+
+def context_encoding_buckets(config) -> List[int]:
+    """Default CTE ladder (reference: autobucketing.py:149-200 behavior)."""
+    tc = config.tpu_config
+    if tc.context_encoding_buckets:
+        return sorted(tc.context_encoding_buckets)
+    if not tc.enable_bucketing:
+        return [tc.max_context_length]
+    return generate_buckets(min(128, tc.max_context_length), tc.max_context_length)
+
+
+def token_generation_buckets(config) -> List[int]:
+    """Default TKG ladder over total KV length (reference: autobucketing.py:226-280)."""
+    tc = config.tpu_config
+    if tc.token_generation_buckets:
+        return sorted(tc.token_generation_buckets)
+    if not tc.enable_bucketing:
+        return [tc.seq_len]
+    return generate_buckets(min(128, tc.seq_len), tc.seq_len)
+
+
+def get_target_bucket(
+    length: int, buckets: Sequence[int], strategy: str = "first_fit"
+) -> int:
+    """Pick the bucket for a request of ``length`` tokens
+    (reference: model_wrapper.py:826 ``get_target_bucket``).
+
+    ``second_fit`` skips one rung up to reduce recompilation thrash near
+    boundaries — useful with speculation where length jumps by k.
+    """
+    if strategy not in BUCKET_SELECTION_STRATEGIES:
+        raise ValueError(f"Unknown bucket strategy {strategy}")
+    if strategy == "max":
+        return buckets[-1]
+    fits = [b for b in sorted(buckets) if b >= length]
+    if not fits:
+        raise ValueError(
+            f"Input length {length} exceeds the largest bucket {max(buckets)}"
+        )
+    if strategy == "second_fit" and len(fits) > 1:
+        return fits[1]
+    return fits[0]

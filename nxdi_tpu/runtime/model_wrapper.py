@@ -1,0 +1,203 @@
+"""Per-submodel façade: bucketed jitted programs + CPU-side pad/dispatch.
+
+The analog of the reference's ``ModelWrapper`` (models/model_wrapper.py:47):
+one instance per submodel tag (context_encoding_model, token_generation_model,
+speculation_model, ...), owning
+  - the bucket ladder and one jitted/AOT-compiled program per bucket,
+  - input padding to the bucket's static shape (pad_inputs :725),
+  - bucket selection (get_target_bucket :826),
+  - batch padding with first-batchline repetition (_forward_with_pad :569).
+
+TPU-native difference: a "compiled program" is ``jax.jit`` of the pure forward
+closed over (arch, bucket shape, flags), with params/cache shardings bound and
+the KV cache donated. Dispatch is async by default (JAX returns futures), which
+subsumes most of the reference's async_execution machinery.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.runtime import autobucketing
+from nxdi_tpu.runtime.padding import pad_with_first_batchline
+
+TAG_CONTEXT_ENCODING = "context_encoding_model"
+TAG_TOKEN_GENERATION = "token_generation_model"
+TAG_SPECULATION = "speculation_model"
+TAG_FUSED_SPECULATION = "fused_speculation_model"
+
+
+class ModelWrapper:
+    def __init__(
+        self,
+        tag: str,
+        config,  # InferenceConfig
+        arch,
+        inv_freq: np.ndarray,
+        *,
+        batch_size: int,
+        n_active_tokens: int,
+        buckets: Sequence[int],
+        attend_to_cache: bool,
+        bucket_strategy: str = "first_fit",
+        forward_fn: Optional[Callable] = None,
+        forward_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        self.tag = tag
+        self.config = config
+        self.arch = arch
+        self.inv_freq = inv_freq
+        self.batch_size = batch_size
+        self.n_active_tokens = n_active_tokens
+        self.buckets = sorted(buckets)
+        self.attend_to_cache = attend_to_cache
+        self.bucket_strategy = bucket_strategy
+        self.forward_fn = forward_fn or causal_lm_forward
+        self.forward_kwargs = dict(forward_kwargs or {})
+        # stochastic sampling needs a per-step PRNG key threaded as an input
+        self.needs_rng = bool(self.forward_kwargs.get("do_sample", False))
+        self._programs: Dict[int, Callable] = {}
+        self._mesh = None
+
+    # ------------------------------------------------------------------
+    # build: one jitted program per bucket (reference: model_wrapper.py:1442
+    # DecoderModelInstance supplies the traced graph per bucket)
+    # ------------------------------------------------------------------
+    def build(self, mesh, param_shardings, cache_shardings) -> None:
+        self._mesh = mesh
+        for bucket in self.buckets:
+            self._programs[bucket] = self._make_program(
+                bucket, mesh, param_shardings, cache_shardings
+            )
+
+    def _make_program(self, bucket: int, mesh, param_shardings, cache_shardings):
+        if self.attend_to_cache:
+            # token generation: fixed active tokens, bucket bounds the attended KV window
+            seq = self.n_active_tokens
+            kwargs = dict(attend_to_cache=True, kv_window=bucket)
+        else:
+            # context encoding: bucket IS the padded input length
+            seq = bucket
+            kwargs = dict(attend_to_cache=False, kv_window=None)
+        kwargs.update(self.forward_kwargs)
+
+        fn = partial(self.forward_fn, self.arch, self.inv_freq, **kwargs)
+
+        replicated = NamedSharding(mesh, P())
+        batch_shardings = {
+            "input_ids": replicated,
+            "position_ids": replicated,
+            "last_token_index": replicated,
+            "sampling_params": replicated,
+        }
+        if self.needs_rng:
+            batch_shardings["rng"] = replicated
+        jitted = jax.jit(
+            fn,
+            in_shardings=(param_shardings, cache_shardings, batch_shardings),
+            donate_argnums=(1,),
+        )
+        return jitted
+
+    def example_batch(self, bucket: int) -> Dict[str, jax.ShapeDtypeStruct]:
+        """Shape structs per bucket for AOT lowering (reference:
+        model_wrapper.py:205 ``input_generator``)."""
+        seq = self.n_active_tokens if self.attend_to_cache else bucket
+        B = self.batch_size
+        batch = {
+            "input_ids": jax.ShapeDtypeStruct((B, seq), jnp.int32),
+            "position_ids": jax.ShapeDtypeStruct((B, seq), jnp.int32),
+            "last_token_index": jax.ShapeDtypeStruct((B,), jnp.int32),
+            "sampling_params": jax.ShapeDtypeStruct((B, 3), jnp.float32),
+        }
+        if self.needs_rng:
+            batch["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
+        return batch
+
+    def aot_compile(self, params_struct, cache_struct) -> Dict[int, Any]:
+        """Lower+compile every bucket ahead of time (reference:
+        application_base.py:292 ``compile``). With a persistent compilation
+        cache configured, this populates the on-disk artifact."""
+        compiled = {}
+        for bucket, prog in self._programs.items():
+            lowered = prog.lower(params_struct, cache_struct, self.example_batch(bucket))
+            compiled[bucket] = lowered.compile()
+        return compiled
+
+    # ------------------------------------------------------------------
+    # dispatch (reference: model_wrapper.py:1314 forward)
+    # ------------------------------------------------------------------
+    def select_bucket(self, length: int) -> int:
+        return autobucketing.get_target_bucket(length, self.buckets, self.bucket_strategy)
+
+    def forward(self, params, cache, batch_np: Dict[str, np.ndarray]):
+        """Pad numpy inputs to the target bucket's static shape and dispatch.
+
+        ``batch_np``: input_ids (b, s), position_ids (b, s), last_token_index
+        (b,), sampling_params (b, 3). b may be smaller than the compiled batch.
+        Returns (outputs, new_cache) with outputs still on device (async).
+        """
+        input_ids = np.asarray(batch_np["input_ids"], dtype=np.int32)
+        position_ids = np.asarray(batch_np["position_ids"], dtype=np.int32)
+        b, s = input_ids.shape
+
+        if self.attend_to_cache:
+            if s != self.n_active_tokens:
+                raise ValueError(
+                    f"{self.tag}: expected {self.n_active_tokens} active tokens, got {s}"
+                )
+            length = int(position_ids.max()) + 1
+            bucket = self.select_bucket(length)
+            pad_s = s
+        else:
+            bucket = self.select_bucket(s)
+            pad_s = bucket
+
+        # pad sequence dim (right padding; pad positions continue arange so
+        # their garbage KV lands at future positions that decode overwrites)
+        if pad_s > s:
+            pad_ids = np.zeros((b, pad_s - s), dtype=np.int32)
+            last_pos = position_ids[:, -1:]
+            pad_pos = last_pos + np.arange(1, pad_s - s + 1, dtype=np.int32)[None, :]
+            input_ids = np.concatenate([input_ids, pad_ids], axis=1)
+            position_ids = np.concatenate([position_ids, pad_pos], axis=1)
+
+        last_token_index = np.asarray(
+            batch_np.get("last_token_index", np.full((b,), s - 1)), dtype=np.int32
+        )
+        sampling_params = np.asarray(
+            batch_np.get("sampling_params", np.tile([1.0, 1.0, 1.0], (b, 1))),
+            dtype=np.float32,
+        )
+
+        # pad batch dim (reference: _forward_with_pad model_wrapper.py:569)
+        orig_b = b
+        if b < self.batch_size:
+            input_ids = pad_with_first_batchline(input_ids, self.batch_size)
+            position_ids = pad_with_first_batchline(position_ids, self.batch_size)
+            last_token_index = pad_with_first_batchline(last_token_index, self.batch_size)
+            sampling_params = pad_with_first_batchline(sampling_params, self.batch_size)
+        elif b > self.batch_size:
+            raise ValueError(f"{self.tag}: batch {b} exceeds compiled batch {self.batch_size}")
+
+        device_batch = {
+            "input_ids": jnp.asarray(input_ids),
+            "position_ids": jnp.asarray(position_ids),
+            "last_token_index": jnp.asarray(last_token_index),
+            "sampling_params": jnp.asarray(sampling_params),
+        }
+        if self.needs_rng:
+            rng = batch_np.get("rng")
+            if rng is None:
+                rng = np.zeros((2,), dtype=np.uint32)
+            device_batch["rng"] = jnp.asarray(rng, dtype=jnp.uint32)
+        outputs, new_cache = self._programs[bucket](params, cache, device_batch)
+        outputs = {k: v[:orig_b] for k, v in outputs.items()}
+        return outputs, new_cache

@@ -1,0 +1,39 @@
+"""Batch/sequence padding utilities (reference: modules/padding.py).
+
+``pad_with_first_batchline`` repeats row 0 instead of zero-filling so padded
+lanes execute the same SPMD math on valid-looking data — garbage lanes can't
+produce NaN/Inf that would pollute collectives (reference: padding.py:67).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+
+def pad_tensor(tensor: np.ndarray, target_shape, pad_value=0) -> Tuple[np.ndarray, np.ndarray]:
+    """Pad (trailing) to target_shape; returns (padded, mask) like reference padding.py:6."""
+    pads = [(0, t - s) for s, t in zip(tensor.shape, target_shape)]
+    if any(p[1] < 0 for p in pads):
+        raise ValueError(f"Cannot pad {tensor.shape} to smaller {target_shape}")
+    padded = np.pad(tensor, pads, constant_values=pad_value)
+    mask = np.zeros(target_shape, dtype=bool)
+    mask[tuple(slice(0, s) for s in tensor.shape)] = True
+    return padded, mask
+
+
+def unpad_tensor(tensor: np.ndarray, original_shape) -> np.ndarray:
+    """reference: padding.py:49."""
+    return tensor[tuple(slice(0, s) for s in original_shape)]
+
+
+def pad_with_first_batchline(tensor: np.ndarray, target_batch: int) -> np.ndarray:
+    """reference: padding.py:67."""
+    b = tensor.shape[0]
+    if b == target_batch:
+        return tensor
+    if b > target_batch:
+        raise ValueError(f"batch {b} > target {target_batch}")
+    reps = np.repeat(tensor[:1], target_batch - b, axis=0)
+    return np.concatenate([tensor, reps], axis=0)

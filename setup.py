@@ -1,0 +1,19 @@
+from setuptools import find_packages, setup
+
+setup(
+    name="nxdi-tpu",
+    version="0.1.0",
+    description="TPU-native LLM inference framework (JAX/XLA/Pallas)",
+    packages=find_packages(include=["nxdi_tpu", "nxdi_tpu.*"]),
+    python_requires=">=3.10",
+    install_requires=[
+        "jax",
+        "numpy",
+        "ml_dtypes",
+        "safetensors",
+    ],
+    extras_require={
+        "hf": ["transformers", "torch"],
+        "test": ["pytest", "transformers", "torch"],
+    },
+)

@@ -1,0 +1,60 @@
+"""Test harness: force CPU backend with 8 virtual devices BEFORE jax imports,
+so every sharding/mesh test runs without TPU hardware (the driver's
+``dryrun_multichip`` uses the same trick)."""
+
+import os
+
+# Force CPU even when the shell exports JAX_PLATFORMS=axon (real TPU): tests
+# must run device-free; bench.py is what exercises the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# sitecustomize may have imported jax already (axon TPU plugin registration),
+# making the env var too late — set the config explicitly as well.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    try:
+        import torch
+
+        torch.manual_seed(0)
+    except ImportError:
+        pass
+    yield
+
+
+@pytest.fixture
+def tiny_hf_llama():
+    """Tiny random-weight HF llama (reference test strategy: 4-layer random
+    models, seed pinned — test/README.md:57-66)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    return model, cfg

@@ -1,0 +1,121 @@
+"""End-to-end token matching vs HF CPU on a tiny random llama
+(reference analog: test/integration/tp32/models/llama/... 4-layer tests +
+utils/accuracy.py:240 check_accuracy token matching)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.llama import modeling_llama as ml
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+
+def hf_greedy(hf_model, input_ids, max_new_tokens):
+    import torch
+
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor(input_ids, dtype=torch.long),
+            max_new_tokens=max_new_tokens,
+            do_sample=False,
+            pad_token_id=0,
+        )
+    return out.numpy()
+
+
+def build_app(hf_model, hf_cfg, tmp_path, **tpu_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tpu_kwargs)
+    tcfg = TpuConfig(**defaults)
+    cfg = ml.LlamaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=ml)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_greedy_token_matching(tiny_hf_llama, tmp_path, tp_degree):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, tp_degree=tp_degree)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    assert actual.shape == expected.shape, (actual.shape, expected.shape)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_greedy_token_matching_batched_right_padded(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, batch_size=2)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    # two prompts, right padded to the same length with 0
+    p0 = [5, 9, 3, 17, 2, 8]
+    p1 = [7, 13, 21]
+    prompt = np.zeros((2, 6), dtype=np.int64)
+    prompt[0] = p0
+    prompt[1, :3] = p1
+    mask = (prompt != 0).astype(np.int32)
+
+    out = adapter.generate(prompt, attention_mask=mask, max_new_tokens=10)
+    # each row must match the unbatched HF run of its own prompt
+    e0 = hf_greedy(hf_model, np.array([p0]), 10)
+    e1 = hf_greedy(hf_model, np.array([p1]), 10)
+    np.testing.assert_array_equal(out[0, : e0.shape[1]], e0[0])
+    np.testing.assert_array_equal(out[1, 3:13], e1[0, 3:])
+
+
+def test_bucketing_dispatch(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(
+        hf_model,
+        hf_cfg,
+        tmp_path,
+        enable_bucketing=True,
+        seq_len=64,
+        max_context_length=32,
+        context_encoding_buckets=[8, 16, 32],
+        token_generation_buckets=[16, 32, 64],
+    )
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42, 7, 1]], dtype=np.int64)  # len 10 -> bucket 16
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=24)
+    actual = adapter.generate(prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_logit_output_path(tiny_hf_llama, tmp_path):
+    import torch
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, output_logits=True)
+    prompt = np.array([[5, 9, 3, 17]], dtype=np.int32)
+    out = app.forward(
+        prompt,
+        np.arange(4, dtype=np.int32)[None, :],
+        last_token_index=np.array([3], dtype=np.int32),
+    )
+    import jax
+
+    logits = np.asarray(jax.device_get(out["logits"]))
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(prompt, dtype=torch.long)).logits.numpy()
+    np.testing.assert_allclose(logits[0, -1], ref[0, -1], atol=2e-2, rtol=2e-2)

@@ -1,0 +1,57 @@
+"""Bucket ladder tests (reference analog: test/unit/modules/test_autobucketing.py)."""
+
+import pytest
+
+from nxdi_tpu.runtime.autobucketing import (
+    generate_2d_buckets_for_prefix_caching,
+    generate_buckets,
+    generate_buckets_on_chunk_size,
+    get_target_bucket,
+)
+
+
+def test_single_bucket():
+    assert generate_buckets(128, 128) == [128]
+
+
+def test_powers_of_two_ladder():
+    assert generate_buckets(128, 1024) == [128, 256, 512, 1024]
+
+
+def test_non_power_max_appended():
+    # round(log2(1000)) == 10, so rungs stop at 512 and 1000 is the cap
+    assert generate_buckets(128, 1000) == [128, 256, 512, 1000]
+
+
+def test_first_fit():
+    buckets = [128, 256, 512]
+    assert get_target_bucket(1, buckets) == 128
+    assert get_target_bucket(128, buckets) == 128
+    assert get_target_bucket(129, buckets) == 256
+    assert get_target_bucket(512, buckets) == 512
+
+
+def test_second_fit_skips_one():
+    buckets = [128, 256, 512]
+    assert get_target_bucket(100, buckets, "second_fit") == 256
+    assert get_target_bucket(512, buckets, "second_fit") == 512
+
+
+def test_max_strategy():
+    assert get_target_bucket(1, [128, 256], "max") == 256
+
+
+def test_too_long_raises():
+    with pytest.raises(ValueError, match="exceeds"):
+        get_target_bucket(513, [128, 256, 512])
+
+
+def test_2d_prefix_buckets():
+    got = generate_2d_buckets_for_prefix_caching(128, 256, 512, 1024, is_context_encode=True)
+    assert [128, 0] in got and [128, 512] in got and [256, 1024] in got
+
+
+def test_chunk_size_buckets():
+    assert generate_buckets_on_chunk_size(128, 100) == [128]
+    got = generate_buckets_on_chunk_size(128, 1024)
+    assert len(got) <= 3 and all(b % 128 == 0 for b in got) and got[-1] == 1024

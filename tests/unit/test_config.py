@@ -1,0 +1,76 @@
+"""Config system tests (reference analog: test/unit/models/test_config.py)."""
+
+import pytest
+
+from nxdi_tpu.config import (
+    InferenceConfig,
+    OnDeviceSamplingConfig,
+    TpuConfig,
+    to_jax_dtype,
+)
+
+
+def test_defaults():
+    c = TpuConfig()
+    assert c.batch_size == 1
+    assert c.seq_len == 128
+    assert c.tp_degree == 1
+    assert c.max_context_length == c.seq_len
+
+
+def test_unknown_kwarg_rejected():
+    with pytest.raises(ValueError, match="Unknown TpuConfig"):
+        TpuConfig(not_a_flag=True)
+
+
+def test_validation_max_context():
+    with pytest.raises(ValueError, match="max_context_length"):
+        TpuConfig(seq_len=64, max_context_length=128)
+
+
+def test_cp_must_divide_tp():
+    with pytest.raises(ValueError, match="cp_degree"):
+        TpuConfig(tp_degree=8, cp_degree=3)
+
+
+def test_dp_batch_validation():
+    with pytest.raises(ValueError, match="attention_dp_degree"):
+        TpuConfig(tp_degree=8, attention_dp_degree=2, batch_size=3)
+
+
+def test_round_trip(tmp_path):
+    c = TpuConfig(
+        tp_degree=8,
+        seq_len=1024,
+        batch_size=4,
+        dtype="bfloat16",
+        enable_bucketing=True,
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=True, top_k=5),
+        speculation_length=5,
+    )
+    cfg = InferenceConfig(
+        c,
+        hidden_size=64,
+        num_attention_heads=4,
+        num_hidden_layers=2,
+        vocab_size=256,
+    )
+    cfg.save(str(tmp_path))
+    loaded = InferenceConfig.load(str(tmp_path))
+    assert loaded.tpu_config.tp_degree == 8
+    assert loaded.tpu_config.on_device_sampling_config.top_k == 5
+    assert loaded.tpu_config.speculation_length == 5
+    assert loaded.hidden_size == 64
+    assert loaded.tpu_config.dtype == to_jax_dtype("bfloat16")
+
+
+def test_kv_quant_from_flag():
+    c = TpuConfig(kv_cache_quant=True)
+    assert c.kv_quant_config is not None
+    assert c.kv_quant_config.dtype == "float8_e4m3"
+
+
+def test_copy_with_overrides():
+    c = TpuConfig(seq_len=256, batch_size=2)
+    c2 = c.copy(batch_size=8)
+    assert c2.batch_size == 8 and c2.seq_len == 256 and c.batch_size == 2

@@ -1,0 +1,90 @@
+"""GQA sharding-plan tests (reference analog: test/unit/modules/attention)."""
+
+import numpy as np
+
+from nxdi_tpu.parallel.gqa import (
+    GQA,
+    determine_sharding_strategy,
+    get_shardable_head_counts,
+    pad_o_proj,
+    pad_q_heads,
+    replicate_kv_heads,
+)
+
+
+def test_strategy_fallback_to_mha():
+    # tp not a multiple of kv heads -> convert to MHA
+    assert determine_sharding_strategy(4, 3) == GQA.CONVERT_TO_MHA
+    assert determine_sharding_strategy(8, 2) == GQA.REPLICATE_TO_TP_DEGREE
+
+
+def test_head_counts_replicate():
+    heads, kv = get_shardable_head_counts(8, 32, 8, GQA.REPLICATE_TO_TP_DEGREE)
+    assert (heads, kv) == (32, 8)
+    heads, kv = get_shardable_head_counts(8, 32, 4, GQA.REPLICATE_TO_TP_DEGREE)
+    assert (heads, kv) == (32, 8)  # kv replicated up to tp
+
+
+def test_head_counts_mha():
+    heads, kv = get_shardable_head_counts(8, 6, 2, GQA.CONVERT_TO_MHA)
+    assert heads == 8 and kv == 8
+
+
+def test_replicate_kv_heads_layout():
+    D, hidden = 2, 3
+    w = np.arange(2 * D * hidden).reshape(2 * D, hidden).astype(np.float32)
+    out = replicate_kv_heads(w, D, 2, 4)
+    assert out.shape == (4 * D, hidden)
+    # head replicas are adjacent: rows [0:2]==[2:4] (head0), [4:6]==[6:8] (head1)
+    assert np.array_equal(out[0:D], out[D : 2 * D])
+    assert np.array_equal(out[2 * D : 3 * D], out[3 * D : 4 * D])
+    assert np.array_equal(out[0:D], w[0:D])
+    assert np.array_equal(out[2 * D : 3 * D], w[D : 2 * D])
+
+
+def test_pad_q_and_o():
+    D = 4
+    # MHA 3 heads -> 4 heads (kv pads with q): real heads keep their slots
+    q = np.random.randn(3 * D, 16).astype(np.float32)
+    q_pad = pad_q_heads(q, D, 3, 3, 4, 4)
+    assert q_pad.shape == (4 * D, 16) and np.all(q_pad[3 * D :] == 0)
+    o = np.random.randn(16, 3 * D).astype(np.float32)
+    o_pad = pad_o_proj(o, D, 3, 3, 4, 4)
+    assert o_pad.shape == (16, 4 * D) and np.all(o_pad[:, 3 * D :] == 0)
+
+
+def test_pad_q_interleaved_group_mapping():
+    """4 q heads / 2 kv heads replicated to tp=8: q heads of kv group g must
+    land in slots [4g, 4g+2), not appended at the end."""
+    D = 2
+    q = np.arange(4 * D * 3).reshape(4 * D, 3).astype(np.float32)
+    out = pad_q_heads(q, D, 4, 2, 8, 8)
+    assert out.shape == (8 * D, 3)
+    # group 0 (orig q0, q1) -> slots 0, 1; slots 2, 3 zero
+    assert np.array_equal(out[0 : 2 * D], q[0 : 2 * D])
+    assert np.all(out[2 * D : 4 * D] == 0)
+    # group 1 (orig q2, q3) -> slots 4, 5; slots 6, 7 zero
+    assert np.array_equal(out[4 * D : 6 * D], q[2 * D : 4 * D])
+    assert np.all(out[6 * D : 8 * D] == 0)
+
+
+def test_gqa_grouped_attention_equivalence():
+    """Replicated-KV grouped attention == original GQA attention."""
+    import jax.numpy as jnp
+
+    from nxdi_tpu.ops.attention import causal_mask_from_positions, grouped_attention
+
+    B, H, KV, S, D = 1, 4, 2, 6, 8
+    rng = np.random.default_rng(0)
+    q = rng.standard_normal((B, H, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, KV, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, KV, S, D)).astype(np.float32)
+    pos = np.arange(S, dtype=np.int32)[None, :]
+    mask = causal_mask_from_positions(jnp.asarray(pos), jnp.asarray(pos))
+
+    out_gqa = grouped_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), mask)
+    # replicate kv to MHA and recompute
+    k_mha = np.repeat(k, H // KV, axis=1)
+    v_mha = np.repeat(v, H // KV, axis=1)
+    out_mha = grouped_attention(jnp.asarray(q), jnp.asarray(k_mha), jnp.asarray(v_mha), mask)
+    np.testing.assert_allclose(np.asarray(out_gqa), np.asarray(out_mha), rtol=1e-5, atol=1e-5)

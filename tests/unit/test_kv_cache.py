@@ -1,0 +1,78 @@
+"""KV cache tests (reference analog: test/unit/modules/kvcache)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.kvcache.kv_cache import (
+    KVCacheSpec,
+    init_kv_cache,
+    read_layer_cache,
+    reset_kv_cache,
+    update_layer_cache,
+)
+
+
+def make_spec(**kw):
+    base = dict(num_layers=2, batch_size=2, num_kv_heads=2, max_len=8, head_dim=4, dtype="float32")
+    base.update(kw)
+    return KVCacheSpec(**base)
+
+
+def test_init_shape():
+    spec = make_spec()
+    cache = init_kv_cache(spec)
+    assert cache["k"].shape == (2, 2, 2, 8, 4)
+    assert cache["v"].dtype == jnp.float32
+
+
+def test_update_exact_positions():
+    spec = make_spec()
+    cache = init_kv_cache(spec)
+    k_new = jnp.ones((2, 2, 3, 4)) * 7  # 3 active tokens
+    v_new = jnp.ones((2, 2, 3, 4)) * 9
+    pos = jnp.array([[0, 1, 2], [2, 3, 4]], dtype=jnp.int32)
+    k_l, v_l = update_layer_cache(cache["k"][0], cache["v"][0], k_new, v_new, pos, spec)
+    k_np = np.asarray(k_l)
+    assert np.all(k_np[0, :, 0:3] == 7) and np.all(k_np[0, :, 3:] == 0)
+    assert np.all(k_np[1, :, 2:5] == 7) and np.all(k_np[1, :, :2] == 0)
+    assert np.all(np.asarray(v_l)[1, :, 2:5] == 9)
+
+
+def test_out_of_range_writes_dropped():
+    spec = make_spec()
+    cache = init_kv_cache(spec)
+    k_new = jnp.ones((2, 2, 1, 4))
+    pos = jnp.array([[100], [-5]], dtype=jnp.int32)  # both invalid
+    k_l, v_l = update_layer_cache(cache["k"][0], cache["v"][0], k_new, k_new, pos, spec)
+    assert np.all(np.asarray(k_l) == 0)
+
+
+def test_overwrite_same_position():
+    spec = make_spec()
+    cache = init_kv_cache(spec)
+    pos = jnp.zeros((2, 1), dtype=jnp.int32)
+    a = jnp.ones((2, 2, 1, 4)) * 3
+    b = jnp.ones((2, 2, 1, 4)) * 5
+    k_l, v_l = update_layer_cache(cache["k"][0], cache["v"][0], a, a, pos, spec)
+    k_l, v_l = update_layer_cache(k_l, v_l, b, b, pos, spec)
+    assert np.all(np.asarray(k_l)[:, :, 0] == 5)
+
+
+def test_quantized_cache_round_trip():
+    spec = make_spec(quant_dtype="float8_e4m3")
+    cache = init_kv_cache(spec)
+    assert cache["k"].dtype == jnp.float8_e4m3fn
+    k_new = jnp.ones((2, 2, 1, 4)) * 1.5
+    pos = jnp.zeros((2, 1), dtype=jnp.int32)
+    k_l, v_l = update_layer_cache(cache["k"][0], cache["v"][0], k_new, k_new, pos, spec)
+    k_read, _ = read_layer_cache(k_l, v_l, spec)
+    assert k_read.dtype == jnp.float32
+    assert np.allclose(np.asarray(k_read)[:, :, 0], 1.5)  # 1.5 is exact in e4m3
+
+
+def test_reset():
+    spec = make_spec()
+    cache = init_kv_cache(spec)
+    cache = {"k": cache["k"] + 1, "v": cache["v"] + 2}
+    cache = reset_kv_cache(cache)
+    assert np.all(np.asarray(cache["k"]) == 0) and np.all(np.asarray(cache["v"]) == 0)

@@ -1,0 +1,32 @@
+"""Padding tests (reference analog: test/unit/modules/test_padding.py)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.runtime.padding import pad_tensor, pad_with_first_batchline, unpad_tensor
+
+
+def test_pad_and_mask():
+    x = np.ones((2, 3))
+    padded, mask = pad_tensor(x, (4, 5))
+    assert padded.shape == (4, 5)
+    assert padded[:2, :3].sum() == 6 and padded.sum() == 6
+    assert mask[:2, :3].all() and mask.sum() == 6
+
+
+def test_pad_smaller_raises():
+    with pytest.raises(ValueError):
+        pad_tensor(np.ones((4,)), (2,))
+
+
+def test_unpad_round_trip():
+    x = np.arange(6).reshape(2, 3)
+    padded, _ = pad_tensor(x, (4, 4))
+    assert np.array_equal(unpad_tensor(padded, (2, 3)), x)
+
+
+def test_first_batchline():
+    x = np.array([[1, 2], [3, 4]])
+    out = pad_with_first_batchline(x, 4)
+    assert out.shape == (4, 2)
+    assert np.array_equal(out[2], x[0]) and np.array_equal(out[3], x[0])

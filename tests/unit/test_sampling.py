@@ -1,0 +1,96 @@
+"""Sampler tests (reference analog: test/unit/modules/generation)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nxdi_tpu.ops.sampling import (
+    greedy_sample,
+    mask_padded_logits,
+    prepare_sampling_params,
+    sample,
+    topk_topp_temperature_sample,
+)
+
+
+def test_prepare_sampling_params_broadcast():
+    p = prepare_sampling_params(4, top_k=[5], top_p=[0.9], temperature=[0.7])
+    assert p.shape == (4, 3)
+    assert np.allclose(p[:, 0], 5) and np.allclose(p[:, 1], 0.9)
+
+
+def test_prepare_sampling_params_per_batch():
+    p = prepare_sampling_params(2, top_k=[1, 5], top_p=[1.0, 0.5], temperature=[1.0, 2.0])
+    assert p[1, 0] == 5 and p[1, 1] == 0.5 and p[1, 2] == 2.0
+
+
+def test_prepare_sampling_params_bad_len():
+    with pytest.raises(ValueError):
+        prepare_sampling_params(3, top_k=[1, 2])
+
+
+def test_greedy():
+    logits = jnp.array([[0.1, 3.0, -1.0], [5.0, 0.0, 0.0]])
+    assert greedy_sample(logits).tolist() == [1, 0]
+
+
+def test_mask_padded_logits():
+    logits = jnp.ones((2, 8))
+    masked = mask_padded_logits(logits, 3)
+    assert np.all(np.asarray(masked)[:, 5:] < -1000)
+    assert np.all(np.asarray(masked)[:, :5] == 1)
+
+
+def test_topk1_matches_greedy():
+    rng = jax.random.PRNGKey(0)
+    logits = jax.random.normal(rng, (4, 64))
+    params = jnp.asarray(prepare_sampling_params(4, top_k=[1]))
+    toks = topk_topp_temperature_sample(logits, params, rng)
+    assert toks.tolist() == greedy_sample(logits).tolist()
+
+
+def test_topk_restricts_support():
+    rng = jax.random.PRNGKey(1)
+    logits = jnp.asarray(np.random.randn(2, 100).astype(np.float32))
+    params = jnp.asarray(prepare_sampling_params(2, top_k=[3]))
+    top3 = np.argsort(-np.asarray(logits), axis=-1)[:, :3]
+    for i in range(20):
+        toks = np.asarray(
+            topk_topp_temperature_sample(logits, params, jax.random.PRNGKey(i))
+        )
+        for b in range(2):
+            assert toks[b] in top3[b]
+
+
+def test_top_p_keeps_best_token():
+    # extreme top_p: only the single best token should survive
+    logits = jnp.asarray(np.random.randn(2, 50).astype(np.float32))
+    params = jnp.asarray(prepare_sampling_params(2, top_k=[0], top_p=[1e-9]))
+    toks = topk_topp_temperature_sample(logits, params, jax.random.PRNGKey(0))
+    assert toks.tolist() == greedy_sample(logits).tolist()
+
+
+def test_sample_mixed_batch():
+    # row 0 greedy (top_k=1), row 1 sampled (top_k=10)
+    logits = jnp.asarray(np.random.randn(2, 100).astype(np.float32))
+    params = jnp.asarray(prepare_sampling_params(2, top_k=[1, 10]))
+    toks = sample(logits, params, rng=jax.random.PRNGKey(3), do_sample=True)
+    assert int(toks[0]) == int(greedy_sample(logits)[0])
+
+
+def test_temperature_sharpening():
+    # temperature -> 0 approaches greedy
+    logits = jnp.asarray(np.random.randn(4, 100).astype(np.float32))
+    params = jnp.asarray(prepare_sampling_params(4, top_k=[50], temperature=[1e-4]))
+    toks = topk_topp_temperature_sample(logits, params, jax.random.PRNGKey(7))
+    assert toks.tolist() == greedy_sample(logits).tolist()
+
+
+def test_top_p_zero_is_greedy():
+    # top_p=0.0 must keep exactly the best token, not mask everything
+    logits = jnp.asarray(np.random.randn(3, 80).astype(np.float32))
+    params = jnp.asarray(prepare_sampling_params(3, top_k=[0], top_p=[0.0]))
+    for i in range(5):
+        toks = topk_topp_temperature_sample(logits, params, jax.random.PRNGKey(i))
+        assert toks.tolist() == greedy_sample(logits).tolist()
