@@ -1,0 +1,267 @@
+"""inference_demo CLI — compile/load a model, check accuracy, generate, benchmark.
+
+The user-facing entry point mirroring the reference's ``inference_demo``
+(inference_demo.py:97 setup_run_parser, :438 create_neuron_config,
+:495 run_inference, :784 main): same flag vocabulary where concepts transfer,
+so reference users can bring their command lines across.
+
+Usage:
+  python -m nxdi_tpu.cli.inference_demo run --model-type llama \
+      --model-path /path/to/hf_ckpt --compiled-model-path /tmp/compiled \
+      --tp-degree 8 --batch-size 1 --seq-len 1024 --on-device-sampling \
+      --prompt "I believe the meaning of life is" \
+      --check-accuracy-mode token-matching --benchmark
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("nxdi_tpu")
+
+CHECK_ACCURACY_MODES = ("skip", "token-matching", "logit-matching")
+
+
+def setup_run_parser(parser: argparse.ArgumentParser) -> None:
+    """Flag surface (reference: inference_demo.py:97-410, subset growing per round)."""
+    p = parser
+    p.add_argument("--model-type", required=True, help="registry key, e.g. llama, qwen2")
+    p.add_argument("--task-type", default="causal-lm", choices=["causal-lm"])
+    p.add_argument("--model-path", required=True)
+    p.add_argument("--compiled-model-path", default=None)
+    p.add_argument("--skip-compile", action="store_true")
+    p.add_argument("--skip-warmup", action="store_true")
+    p.add_argument("--on-cpu", action="store_true", help="run on the CPU backend")
+
+    # shapes / dtypes
+    p.add_argument("--batch-size", type=int, default=1)
+    p.add_argument("--ctx-batch-size", type=int, default=None)
+    p.add_argument("--tkg-batch-size", type=int, default=None)
+    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--max-context-length", type=int, default=None)
+    p.add_argument("--max-new-tokens", type=int, default=64)
+    p.add_argument("--torch-dtype", "--dtype", dest="dtype", default="bfloat16")
+    p.add_argument("--padding-side", default="right", choices=["right", "left"])
+
+    # parallelism
+    p.add_argument("--tp-degree", type=int, default=1)
+    p.add_argument("--cp-degree", type=int, default=1)
+    p.add_argument("--ep-degree", type=int, default=1)
+    p.add_argument("--attention-dp-degree", type=int, default=1)
+
+    # sampling
+    p.add_argument("--on-device-sampling", action="store_true")
+    p.add_argument("--do-sample", action="store_true")
+    p.add_argument("--top-k", type=int, default=1)
+    p.add_argument("--top-p", type=float, default=1.0)
+    p.add_argument("--temperature", type=float, default=1.0)
+    p.add_argument("--global-topk", type=int, default=256)
+    p.add_argument("--seed", type=int, default=0)
+
+    # bucketing
+    p.add_argument("--enable-bucketing", action="store_true")
+    p.add_argument("--context-encoding-buckets", nargs="+", type=int, default=None)
+    p.add_argument("--token-generation-buckets", nargs="+", type=int, default=None)
+
+    # execution
+    p.add_argument("--async-mode", action="store_true")
+
+    # speculation
+    p.add_argument("--draft-model-path", default=None)
+    p.add_argument("--speculation-length", type=int, default=0)
+    p.add_argument("--enable-fused-speculation", action="store_true")
+
+    # quantization
+    p.add_argument("--quantized", action="store_true")
+    p.add_argument("--quantization-dtype", default="int8")
+    p.add_argument("--kv-cache-quant", action="store_true")
+
+    # accuracy / benchmark
+    p.add_argument("--check-accuracy-mode", default="skip", choices=CHECK_ACCURACY_MODES)
+    p.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    p.add_argument("--benchmark", action="store_true")
+    p.add_argument("--num-runs", type=int, default=5)
+
+    # inputs
+    p.add_argument("--prompt", action="append", default=None)
+    p.add_argument("--input-ids", default=None, help="JSON list-of-lists of token ids")
+    p.add_argument("--pad-token-id", type=int, default=0)
+    p.add_argument("--verbose", action="store_true")
+
+
+def create_tpu_config(args):
+    """argparse namespace -> TpuConfig (reference: create_neuron_config
+    inference_demo.py:438)."""
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+
+    odsc = None
+    if args.on_device_sampling:
+        odsc = OnDeviceSamplingConfig(
+            do_sample=args.do_sample,
+            top_k=args.top_k,
+            top_p=args.top_p,
+            temperature=args.temperature,
+            global_topk=args.global_topk,
+        )
+    return TpuConfig(
+        batch_size=args.batch_size,
+        ctx_batch_size=args.ctx_batch_size or args.batch_size,
+        tkg_batch_size=args.tkg_batch_size or args.batch_size,
+        seq_len=args.seq_len,
+        max_context_length=args.max_context_length or args.seq_len // 2,
+        padding_side=args.padding_side,
+        dtype="float32" if args.on_cpu else args.dtype,
+        on_cpu=args.on_cpu,
+        tp_degree=args.tp_degree,
+        cp_degree=args.cp_degree,
+        ep_degree=args.ep_degree,
+        attention_dp_degree=args.attention_dp_degree,
+        on_device_sampling_config=odsc,
+        enable_bucketing=args.enable_bucketing,
+        context_encoding_buckets=args.context_encoding_buckets,
+        token_generation_buckets=args.token_generation_buckets,
+        async_mode=args.async_mode,
+        speculation_length=args.speculation_length,
+        enable_fused_speculation=args.enable_fused_speculation,
+        quantized=args.quantized,
+        quantization_dtype=args.quantization_dtype,
+        kv_cache_quant=args.kv_cache_quant,
+        skip_warmup=args.skip_warmup,
+    )
+
+
+def _resolve_input_ids(args) -> np.ndarray:
+    if args.input_ids:
+        return np.asarray(json.loads(args.input_ids), dtype=np.int64)
+    prompts = args.prompt or ["I believe the meaning of life is"]
+    from transformers import AutoTokenizer
+
+    tok = AutoTokenizer.from_pretrained(args.model_path)
+    if tok.pad_token_id is None:
+        tok.pad_token = tok.eos_token
+    enc = tok(prompts, return_tensors="np", padding=True, padding_side="right")
+    args._tokenizer = tok
+    return enc["input_ids"].astype(np.int64)
+
+
+def run_inference(args) -> int:
+    """Compile -> load -> accuracy -> generate -> benchmark
+    (reference: inference_demo.py:495)."""
+    if args.on_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    from nxdi_tpu.generation.hf_adapter import (
+        HuggingFaceGenerationAdapter,
+        load_pretrained_config,
+    )
+    from nxdi_tpu.models.registry import get_family
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+    family, cfg_cls = get_family(args.model_type)
+    tpu_config = create_tpu_config(args)
+    config = cfg_cls(tpu_config, load_config=load_pretrained_config(args.model_path))
+
+    app = TpuModelForCausalLM(args.model_path, config, model_family=family)
+    if args.compiled_model_path and not args.skip_compile:
+        app.compile(args.compiled_model_path)
+    app.load(args.compiled_model_path)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    input_ids = _resolve_input_ids(args)
+    gen_kwargs = dict(
+        max_new_tokens=args.max_new_tokens,
+        do_sample=args.do_sample,
+        top_k=args.top_k,
+        top_p=args.top_p,
+        temperature=args.temperature,
+        pad_token_id=args.pad_token_id,
+        seed=args.seed,
+    )
+
+    rc = 0
+    if args.check_accuracy_mode != "skip":
+        rc = _run_accuracy(args, app, adapter, input_ids)
+
+    outputs = adapter.generate(input_ids, **gen_kwargs)
+    tok = getattr(args, "_tokenizer", None)
+    print("Generated outputs:")
+    for i, row in enumerate(outputs):
+        if tok is not None:
+            print(f"Output {i}: {tok.decode([t for t in row if t != args.pad_token_id])}")
+        else:
+            print(f"Output {i}: {row.tolist()}")
+
+    if args.benchmark:
+        from nxdi_tpu.utils.benchmark import BENCHMARK_REPORT_FILENAME, benchmark_sampling
+
+        benchmark_sampling(
+            adapter,
+            input_ids,
+            args.max_new_tokens,
+            n_runs=args.num_runs,
+            report_path=BENCHMARK_REPORT_FILENAME,
+            **{k: v for k, v in gen_kwargs.items() if k != "max_new_tokens"},
+        )
+    return rc
+
+
+def _run_accuracy(args, app, adapter, input_ids) -> int:
+    """HF CPU golden accuracy checks (reference: inference_demo.py:712)."""
+    from transformers import AutoModelForCausalLM
+
+    from nxdi_tpu.utils import accuracy
+    from nxdi_tpu.utils.exceptions import AccuracyValidationError, LogitMatchingValidationError
+
+    logger.info("loading HF golden model on CPU for accuracy check")
+    hf_model = AutoModelForCausalLM.from_pretrained(args.model_path).eval()
+    try:
+        if args.check_accuracy_mode == "token-matching":
+            accuracy.check_accuracy(
+                adapter,
+                input_ids,
+                args.max_new_tokens,
+                hf_model=hf_model,
+                pad_token_id=args.pad_token_id,
+            )
+            print("Accuracy check (token-matching): PASS")
+        else:
+            golden = accuracy.hf_greedy_generate(hf_model, input_ids, args.max_new_tokens)
+            errors = accuracy.check_accuracy_logits(
+                app,
+                golden,
+                hf_model=hf_model,
+                divergence_difference_tol=args.divergence_difference_tol,
+            )
+            print(
+                f"Accuracy check (logit-matching): PASS "
+                f"(max err {max(errors.values()):.6f} over {len(errors)} positions)"
+            )
+        return 0
+    except (AccuracyValidationError, LogitMatchingValidationError) as e:
+        print(f"Accuracy check FAILED: {e}")
+        return 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="inference_demo")
+    sub = parser.add_subparsers(dest="command")
+    run_parser = sub.add_parser("run", help="compile, load and run a model")
+    setup_run_parser(run_parser)
+    args = parser.parse_args(argv)
+    if args.command != "run":
+        parser.print_help()
+        return 2
+    logging.basicConfig(level=logging.DEBUG if args.verbose else logging.INFO)
+    return run_inference(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -135,6 +135,12 @@ class HuggingFaceGenerationAdapter:
         for e in eos_ids:
             finished |= next_tokens == e
 
+        if self.app.async_supported and "next_inputs" in outputs and not finished.all():
+            gen = self._device_decode_loop(
+                outputs["next_inputs"], next_tokens, lengths, n_new, eos_ids, pad_token_id, B
+            )
+            return self._assemble(input_ids, gen, lengths, pad_token_id)
+
         # ---- token generation loop ----
         cur_pos = lengths.copy()  # position of the next token to write
         for _ in range(n_new - 1):
@@ -156,13 +162,65 @@ class HuggingFaceGenerationAdapter:
             cur_pos = cur_pos + 1
 
         gen = np.stack(generated, axis=1)  # (B, T)
-        # place generated tokens immediately after each row's true length
+        return self._assemble(input_ids, gen, lengths, pad_token_id)
+
+    def _assemble(self, input_ids, gen, lengths, pad_token_id) -> np.ndarray:
+        """Place generated tokens immediately after each row's true length."""
+        B, S = input_ids.shape
         T = gen.shape[1]
         out = np.full((B, S + T), pad_token_id, dtype=input_ids.dtype)
         out[:, :S] = input_ids
         for b in range(B):
             out[b, lengths[b] : lengths[b] + T] = gen[b]
         return out
+
+    def _device_decode_loop(
+        self, next_inputs, first_tokens, lengths, n_new, eos_ids, pad_token_id, B
+    ) -> np.ndarray:
+        """Device-resident decode: each step's outputs feed the next step with
+        no host round trip; EOS is checked with a one-step lag so the fetch of
+        step N-1 overlaps step N's execution (the reference's 2-deep async
+        pipeline, async_execution.py:190)."""
+        token_stream = [first_tokens]  # step 0 already on host
+        device_stream = []
+        finished = np.zeros((B,), dtype=bool)
+        for e in eos_ids:
+            finished |= first_tokens == e
+        max_len0 = int(lengths.max())
+
+        for step in range(1, n_new):
+            # query position this step = lengths + step - 1 -> window = max+1
+            outputs = self.app.token_gen_device(next_inputs, max_len0 + step)
+            next_inputs = outputs["next_inputs"]
+            device_stream.append(outputs["tokens"])
+            # lag-1 EOS: fetch the PREVIOUS step's tokens (ready or nearly so)
+            if len(device_stream) >= 2:
+                prev = np.asarray(jax.device_get(device_stream[-2]))[:B, 0]
+                token_stream.append(prev)
+                for e in eos_ids:
+                    finished |= prev == e
+                if finished.all():
+                    device_stream = device_stream[-1:]
+                    break
+        for dev in device_stream[-1:] if device_stream else []:
+            tok = np.asarray(jax.device_get(dev))[:B, 0]
+            token_stream.append(tok)
+
+        gen = np.stack(token_stream[:n_new], axis=1)
+        # mask tokens sampled after each row finished
+        if eos_ids:
+            for b in range(B):
+                hits = [i for i, t in enumerate(gen[b]) if t in eos_ids]
+                if hits:
+                    gen[b, hits[0] + 1 :] = pad_token_id
+            # the pipeline dispatches one step past the all-finished point;
+            # trim so output length matches the sync loop exactly
+            first_eos = []
+            for b in range(B):
+                hits = [i for i, t in enumerate(gen[b]) if t in eos_ids]
+                first_eos.append(hits[0] if hits else gen.shape[1] - 1)
+            gen = gen[:, : max(first_eos) + 1]
+        return gen
 
     def _next_rng(self) -> np.ndarray:
         """Fresh (seed, counter) threefry key data per step — distinct draws

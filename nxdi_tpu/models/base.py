@@ -326,6 +326,7 @@ def causal_lm_forward(
     do_sample: bool = False,
     global_topk: int = 256,
     deterministic: bool = False,
+    return_next_inputs: bool = False,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
 
@@ -385,4 +386,25 @@ def causal_lm_forward(
         outputs["tokens"] = tokens[:, None]  # (B, 1)
     if output_logits or output_all_logits or not on_device_sampling:
         outputs["logits"] = logits[..., : arch.vocab_size - arch.vocab_pad]
+
+    if return_next_inputs and on_device_sampling:
+        # Device-resident generation loop (the analog of the reference's async
+        # execution + ranked I/O keeping tensors on device between steps,
+        # async_execution.py:131, model_wrapper.py:623): emit the NEXT step's
+        # token-generation inputs so the host never touches the hot path.
+        nxt: Dict[str, jax.Array] = {
+            "input_ids": outputs["tokens"].astype(jnp.int32),
+            # next token goes one past each sequence's current last position
+            "position_ids": (
+                jnp.take_along_axis(
+                    position_ids, batch["last_token_index"][:, None], axis=1
+                )
+                + 1
+            ).astype(jnp.int32),
+            "last_token_index": jnp.zeros_like(batch["last_token_index"]),
+            "sampling_params": batch["sampling_params"],
+        }
+        if "rng" in batch:
+            nxt["rng"] = jax.random.split(batch["rng"], 1)[0]
+        outputs["next_inputs"] = nxt
     return outputs, new_cache

@@ -242,6 +242,10 @@ class TpuModelForCausalLM(ApplicationBase):
                 global_topk=odsc.global_topk,
                 deterministic=odsc.deterministic,
             )
+        # async (device-resident) loop needs every step to emit the next step's
+        # inputs on device; only meaningful with on-device sampling
+        if tc.async_mode and on_device_sampling:
+            sampling_kwargs["return_next_inputs"] = True
 
         self.models[TAG_CONTEXT_ENCODING] = ModelWrapper(
             TAG_CONTEXT_ENCODING,
@@ -285,3 +289,20 @@ class TpuModelForCausalLM(ApplicationBase):
         batch = {"input_ids": input_ids, "position_ids": position_ids, **kwargs}
         outputs, self.kv_cache = self.models[tag].forward(self.params, self.kv_cache, batch)
         return outputs
+
+    def token_gen_device(self, device_batch, total_len: int):
+        """Async hot path: TKG step with device-resident inputs
+        (reference: causal_lm_async_execution async_execution.py:190)."""
+        outputs, self.kv_cache = self.models[TAG_TOKEN_GENERATION].forward_device(
+            self.params, self.kv_cache, device_batch, total_len
+        )
+        return outputs
+
+    @property
+    def async_supported(self) -> bool:
+        tc = self.tpu_config
+        return (
+            tc.async_mode
+            and tc.on_device_sampling_config is not None
+            and tc.ctx_batch_size == tc.tkg_batch_size
+        )

@@ -65,6 +65,10 @@ class ModelWrapper:
         self.needs_rng = bool(self.forward_kwargs.get("do_sample", False))
         self._programs: Dict[int, Callable] = {}
         self._mesh = None
+        # latency observability (reference: benchmark.py:468 LatencyCollector
+        # registers forward pre/post hooks)
+        self.pre_hooks: List[Callable] = []
+        self.post_hooks: List[Callable] = []
 
     # ------------------------------------------------------------------
     # build: one jitted program per bucket (reference: model_wrapper.py:1442
@@ -198,6 +202,24 @@ class ModelWrapper:
             if rng is None:
                 rng = np.zeros((2,), dtype=np.uint32)
             device_batch["rng"] = jnp.asarray(rng, dtype=jnp.uint32)
+        for hook in self.pre_hooks:
+            hook(self.tag)
         outputs, new_cache = self._programs[bucket](params, cache, device_batch)
-        outputs = {k: v[:orig_b] for k, v in outputs.items()}
+        if self.post_hooks:
+            jax.block_until_ready(outputs)
+            for hook in self.post_hooks:
+                hook(self.tag)
+        outputs = {
+            k: (v if k == "next_inputs" else v[:orig_b]) for k, v in outputs.items()
+        }
         return outputs, new_cache
+
+    def forward_device(self, params, cache, device_batch, total_len: int):
+        """Hot-path dispatch with inputs already on device (the async loop:
+        outputs of step N feed step N+1 without a host round trip; reference:
+        async_execution.py:131 execute_model + ranked I/O).
+
+        ``total_len`` (host-tracked) picks the bucket; no device sync happens.
+        """
+        bucket = self.select_bucket(total_len)
+        return self._programs[bucket](params, cache, device_batch)

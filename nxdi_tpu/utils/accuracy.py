@@ -1,0 +1,192 @@
+"""Accuracy verification: the golden oracle is a HuggingFace CPU run.
+
+Reproduces the reference toolkit's two modes (utils/accuracy.py):
+  - ``check_accuracy`` (:240) — greedy TOKEN matching: generated ids must be
+    exactly equal to the HF CPU generation.
+  - ``check_accuracy_logits`` (:474) — teacher-forced LOGIT matching: feed the
+    golden token sequence and compare per-position logits within tolerance,
+    reporting the first divergence index (per-index tolerance overrides via
+    ``tol_map``, like the reference's divergence re-run with tolerance maps).
+
+Both operate on ids/arrays — no tokenizer required — so they drive equally
+well from tests and from the CLI.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+
+from nxdi_tpu.utils.exceptions import AccuracyValidationError, LogitMatchingValidationError
+
+
+def hf_greedy_generate(
+    hf_model, input_ids: np.ndarray, max_new_tokens: int, pad_token_id: int = 0
+) -> np.ndarray:
+    import torch
+
+    with torch.no_grad():
+        out = hf_model.generate(
+            torch.tensor(np.asarray(input_ids), dtype=torch.long),
+            max_new_tokens=max_new_tokens,
+            do_sample=False,
+            pad_token_id=pad_token_id,
+        )
+    return out.numpy()
+
+
+def hf_forward_logits(hf_model, input_ids: np.ndarray) -> np.ndarray:
+    import torch
+
+    with torch.no_grad():
+        return hf_model(torch.tensor(np.asarray(input_ids), dtype=torch.long)).logits.numpy()
+
+
+def check_accuracy(
+    adapter,
+    input_ids: np.ndarray,
+    max_new_tokens: int,
+    hf_model=None,
+    expected_outputs: Optional[np.ndarray] = None,
+    **generate_kwargs,
+) -> np.ndarray:
+    """Greedy token matching (reference: accuracy.py:240 check_accuracy).
+
+    Either ``hf_model`` (golden computed here, per row so right-padding never
+    skews the comparison) or ``expected_outputs`` must be given. Returns the
+    actual outputs on success; raises :class:`AccuracyValidationError` with the
+    first mismatch position otherwise.
+    """
+    input_ids = np.asarray(input_ids)
+    pad_token_id = generate_kwargs.get("pad_token_id", 0)
+    lengths = (input_ids != pad_token_id).sum(axis=1)
+    lengths = np.maximum(lengths, 1)
+
+    actual = adapter.generate(input_ids, max_new_tokens=max_new_tokens, **generate_kwargs)
+    act = np.asarray(actual)
+
+    if expected_outputs is not None:
+        exp = np.asarray(expected_outputs)
+        n = min(exp.shape[1], act.shape[1])
+        if not np.array_equal(exp[:, :n], act[:, :n]):
+            mism = np.argwhere(exp[:, :n] != act[:, :n])
+            b, i = mism[0]
+            raise AccuracyValidationError(
+                f"Token mismatch at batch {b} position {i}: "
+                f"expected {exp[b, i]}, got {act[b, i]} "
+                f"(total {len(mism)} mismatched positions)",
+                expected=exp,
+                actual=act,
+            )
+        return act
+
+    if hf_model is None:
+        raise ValueError("need hf_model or expected_outputs")
+    # golden per row: the adapter places row b's generation at lengths[b],
+    # while a batched HF run would append after the padded column S
+    for b in range(input_ids.shape[0]):
+        prompt = input_ids[b : b + 1, : lengths[b]]
+        exp_row = hf_greedy_generate(hf_model, prompt, max_new_tokens, pad_token_id)[0]
+        act_row = act[b, : exp_row.shape[0]]
+        if not np.array_equal(exp_row, act_row):
+            i = int(np.argwhere(exp_row != act_row)[0])
+            raise AccuracyValidationError(
+                f"Token mismatch at batch {b} position {i}: "
+                f"expected {exp_row[i]}, got {act_row[i]}",
+                expected=exp_row,
+                actual=act_row,
+            )
+    return act
+
+
+def _get_logit_probe(app):
+    """All-position-logits CTE probe, cached on the app: a jit re-trace of
+    every CTE bucket is minutes of compile on hardware, so build it once."""
+    cached = getattr(app, "_logit_probe", None)
+    if cached is not None:
+        return cached
+
+    from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+    from nxdi_tpu.parallel.layers import shard_pytree, sharding_tree
+
+    wrapper = app.models["context_encoding_model"]
+    fkw = dict(wrapper.forward_kwargs)
+    fkw.update(output_all_logits=True, output_logits=True)
+    probe = type(wrapper)(
+        wrapper.tag + "_logit_probe",
+        wrapper.config,
+        wrapper.arch,
+        wrapper.inv_freq,
+        batch_size=wrapper.batch_size,
+        n_active_tokens=0,
+        buckets=wrapper.buckets,
+        attend_to_cache=False,
+        forward_kwargs=fkw,
+    )
+    probe.build(
+        app.mesh,
+        sharding_tree(app.family.param_specs(app.config), app.mesh),
+        sharding_tree(kv_cache_partition_spec(), app.mesh),
+    )
+    cache = shard_pytree(
+        init_kv_cache(app._cache_spec()), kv_cache_partition_spec(), app.mesh
+    )
+    app._logit_probe = (probe, cache)
+    return app._logit_probe
+
+
+def check_accuracy_logits(
+    app,
+    input_ids: np.ndarray,
+    hf_model=None,
+    golden_logits: Optional[np.ndarray] = None,
+    divergence_difference_tol: float = 0.001,
+    tol_map: Optional[Dict[int, float]] = None,
+) -> Dict[int, float]:
+    """Teacher-forced logit matching (reference: accuracy.py:474).
+
+    Runs the full golden sequence through the app's context-encoding submodel
+    with all-position logits and compares each position against HF CPU.
+    ``tol_map`` maps position -> looser tolerance (reference's per-index
+    tolerance maps for known-noisy positions). Returns {index: max_abs_err}.
+    """
+    input_ids = np.asarray(input_ids)
+    if golden_logits is None:
+        if hf_model is None:
+            raise ValueError("need hf_model or golden_logits")
+        golden_logits = hf_forward_logits(hf_model, input_ids)
+
+    B, S = input_ids.shape
+    position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    probe, cache = _get_logit_probe(app)
+    outputs, _ = probe.forward(
+        app.params,
+        cache,
+        {
+            "input_ids": input_ids.astype(np.int32),
+            "position_ids": position_ids,
+            "last_token_index": np.full((B,), S - 1, dtype=np.int32),
+        },
+    )
+    actual = np.asarray(jax.device_get(outputs["logits"]))[:, :S, :]
+
+    errors_by_index: Dict[int, float] = {}
+    first_divergence = None
+    for i in range(S):
+        err = float(np.abs(actual[:, i, :] - golden_logits[:, i, :]).max())
+        errors_by_index[i] = err
+        tol = (tol_map or {}).get(i, divergence_difference_tol)
+        if err > tol and first_divergence is None:
+            first_divergence = i
+    if first_divergence is not None:
+        raise LogitMatchingValidationError(
+            f"Logits diverge at index {first_divergence}: "
+            f"max abs err {errors_by_index[first_divergence]:.6f} > tol "
+            f"{(tol_map or {}).get(first_divergence, divergence_difference_tol)}",
+            divergence_index=first_divergence,
+            max_error=max(errors_by_index.values()),
+            errors_by_index=errors_by_index,
+        )
+    return errors_by_index

@@ -1,0 +1,130 @@
+"""Benchmark harness — report format compatible with the reference
+(utils/benchmark.py: ``benchmark_sampling`` :21, ``Benchmark`` :433,
+``LatencyCollector`` :468, ``generate_report`` :480).
+
+Measures end-to-end generation latency plus per-submodel step latencies via
+ModelWrapper pre/post hooks, and writes ``benchmark_report.json`` with
+p50/p90/p95/p99/p100 and throughput = n_runs * max_length * batch / total_time.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+BENCHMARK_REPORT_FILENAME = "benchmark_report.json"
+
+
+class LatencyCollector:
+    """Collects per-dispatch wall-clock via wrapper pre/post hooks
+    (reference: benchmark.py:468)."""
+
+    def __init__(self):
+        self.latency_list: List[float] = []
+        self._start = 0.0
+
+    def pre_hook(self, tag):
+        self._start = time.perf_counter()
+
+    def post_hook(self, tag):
+        self.latency_list.append(time.perf_counter() - self._start)
+
+    def percentile(self, p: float) -> float:
+        if not self.latency_list:
+            return 0.0
+        return float(np.percentile(self.latency_list, p))
+
+
+def generate_report(
+    latencies_s: List[float], max_length: int, max_batch_size: int, n_runs: int
+) -> Dict[str, float]:
+    """reference: benchmark.py:480-500 (identical metric definitions)."""
+    if not latencies_s:
+        return {}
+    total = float(np.sum(latencies_s))
+    return {
+        "latency_ms_p50": float(np.percentile(latencies_s, 50)) * 1000,
+        "latency_ms_p90": float(np.percentile(latencies_s, 90)) * 1000,
+        "latency_ms_p95": float(np.percentile(latencies_s, 95)) * 1000,
+        "latency_ms_p99": float(np.percentile(latencies_s, 99)) * 1000,
+        "latency_ms_p100": float(np.percentile(latencies_s, 100)) * 1000,
+        "latency_ms_avg": float(np.mean(latencies_s)) * 1000,
+        "throughput": n_runs * max_length * max_batch_size / total,
+    }
+
+
+class Benchmark:
+    """Warmup + N timed runs of an arbitrary callable (reference: benchmark.py:433)."""
+
+    def __init__(self, benchmark_func: Callable, n_runs: int = 20, warmup: int = 3):
+        self.benchmark_func = benchmark_func
+        self.n_runs = n_runs
+        self.warmup = warmup
+        self.latency_list: List[float] = []
+
+    def run(self) -> List[float]:
+        for _ in range(self.warmup):
+            self.benchmark_func()
+        self.latency_list = []
+        for _ in range(self.n_runs):
+            t0 = time.perf_counter()
+            self.benchmark_func()
+            self.latency_list.append(time.perf_counter() - t0)
+        return self.latency_list
+
+
+def benchmark_sampling(
+    adapter,
+    input_ids: np.ndarray,
+    max_new_tokens: int,
+    n_runs: int = 20,
+    report_path: Optional[str] = None,
+    **generate_kwargs,
+) -> Dict[str, Dict[str, float]]:
+    """End-to-end + per-submodel benchmark (reference: benchmark.py:21).
+
+    Returns {"e2e_model": {...}, "context_encoding_model": {...},
+    "token_generation_model": {...}} and writes benchmark_report.json.
+    """
+    app = adapter.app
+    input_ids = np.asarray(input_ids)
+    max_batch = input_ids.shape[0]
+    max_length = input_ids.shape[1] + max_new_tokens
+
+    collectors = {}
+    for tag, wrapper in app.models.items():
+        c = LatencyCollector()
+        wrapper.pre_hooks.append(c.pre_hook)
+        wrapper.post_hooks.append(c.post_hook)
+        collectors[tag] = c
+
+    try:
+        bench = Benchmark(
+            lambda: adapter.generate(input_ids, max_new_tokens=max_new_tokens, **generate_kwargs),
+            n_runs=n_runs,
+        )
+        e2e = bench.run()
+    finally:
+        # never leak hooks: an orphaned post_hook would force a
+        # block_until_ready on every future dispatch
+        for tag, wrapper in app.models.items():
+            c = collectors[tag]
+            if c.pre_hook in wrapper.pre_hooks:
+                wrapper.pre_hooks.remove(c.pre_hook)
+            if c.post_hook in wrapper.post_hooks:
+                wrapper.post_hooks.remove(c.post_hook)
+
+    report = {"e2e_model": generate_report(e2e, max_length, max_batch, n_runs)}
+    for tag, c in collectors.items():
+        if c.latency_list:
+            report[tag] = generate_report(c.latency_list, max_length, max_batch, len(c.latency_list))
+
+    if report_path:
+        with open(report_path, "w") as f:
+            json.dump(report, f, indent=2)
+    print("Benchmark completed and its result is as following")
+    print(json.dumps(report, indent=2))
+    return report

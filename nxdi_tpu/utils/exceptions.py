@@ -1,0 +1,22 @@
+"""Accuracy-check exceptions (reference: utils/exceptions.py)."""
+
+
+class AccuracyValidationError(AssertionError):
+    """Token-matching failure (reference: check_accuracy accuracy.py:240)."""
+
+    def __init__(self, message, expected=None, actual=None):
+        super().__init__(message)
+        self.expected = expected
+        self.actual = actual
+
+
+class LogitMatchingValidationError(AssertionError):
+    """Logit-matching failure with the divergence index preserved so tooling
+    can capture inputs at that position (reference: utils/exceptions.py +
+    accuracy.py:474 divergence re-run)."""
+
+    def __init__(self, message, divergence_index=None, max_error=None, errors_by_index=None):
+        super().__init__(message)
+        self.divergence_index = divergence_index
+        self.max_error = max_error
+        self.errors_by_index = errors_by_index or {}

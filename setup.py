@@ -16,4 +16,9 @@ setup(
         "hf": ["transformers", "torch"],
         "test": ["pytest", "transformers", "torch"],
     },
+    entry_points={
+        "console_scripts": [
+            "nxdi-tpu-demo = nxdi_tpu.cli.inference_demo:main",
+        ]
+    },
 )

@@ -1,0 +1,68 @@
+"""Accuracy toolkit vs HF CPU (reference analog: utils/accuracy.py flows)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.utils import accuracy
+from nxdi_tpu.utils.exceptions import AccuracyValidationError, LogitMatchingValidationError
+from tests.integration.test_llama_token_matching import build_app
+
+
+@pytest.fixture()
+def app_and_hf(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, output_logits=True)
+    return app, hf_model
+
+
+PROMPT = np.array([[5, 9, 3, 17, 2, 8]], dtype=np.int64)
+
+
+def test_token_matching_pass(app_and_hf):
+    app, hf_model = app_and_hf
+    adapter = HuggingFaceGenerationAdapter(app)
+    out = accuracy.check_accuracy(adapter, PROMPT, 10, hf_model=hf_model)
+    assert out.shape == (1, 16)
+
+
+def test_token_matching_detects_mismatch(app_and_hf):
+    app, hf_model = app_and_hf
+    adapter = HuggingFaceGenerationAdapter(app)
+    golden = accuracy.hf_greedy_generate(hf_model, PROMPT, 10)
+    corrupted = golden.copy()
+    corrupted[0, -2] = (corrupted[0, -2] + 1) % 256
+    with pytest.raises(AccuracyValidationError, match="Token mismatch"):
+        accuracy.check_accuracy(adapter, PROMPT, 10, expected_outputs=corrupted)
+
+
+def test_logit_matching_pass(app_and_hf):
+    app, hf_model = app_and_hf
+    golden = accuracy.hf_greedy_generate(hf_model, PROMPT, 6)
+    errors = accuracy.check_accuracy_logits(
+        app, golden, hf_model=hf_model, divergence_difference_tol=0.05
+    )
+    assert len(errors) == golden.shape[1]
+    assert max(errors.values()) < 0.05
+
+
+def test_logit_matching_reports_divergence_index(app_and_hf):
+    app, hf_model = app_and_hf
+    golden = accuracy.hf_greedy_generate(hf_model, PROMPT, 6)
+    with pytest.raises(LogitMatchingValidationError) as ei:
+        accuracy.check_accuracy_logits(
+            app, golden, hf_model=hf_model, divergence_difference_tol=1e-9
+        )
+    assert ei.value.divergence_index is not None
+    assert ei.value.errors_by_index
+
+
+def test_logit_matching_tol_map(app_and_hf):
+    app, hf_model = app_and_hf
+    golden = accuracy.hf_greedy_generate(hf_model, PROMPT, 6)
+    # loosen every index via tol_map: must pass even with tiny base tol
+    tol_map = {i: 0.5 for i in range(golden.shape[1])}
+    errors = accuracy.check_accuracy_logits(
+        app, golden, hf_model=hf_model, divergence_difference_tol=1e-9, tol_map=tol_map
+    )
+    assert errors

@@ -1,0 +1,52 @@
+"""Async (device-resident) decode loop must produce identical tokens to the
+sync loop (reference analog: test_async_execution.py + async integration
+variants of the 4-layer llama tests)."""
+
+import numpy as np
+
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from tests.integration.test_llama_token_matching import build_app, hf_greedy
+
+
+def test_async_matches_sync_and_hf(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app_async = build_app(hf_model, hf_cfg, tmp_path, async_mode=True)
+    assert app_async.async_supported
+    adapter = HuggingFaceGenerationAdapter(app_async)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_async_eos_early_stop(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, async_mode=True)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+
+    # find the greedy continuation, then declare its 5th new token as EOS
+    full = hf_greedy(hf_model, prompt, max_new_tokens=20)
+    eos = int(full[0, prompt.shape[1] + 4])
+    out = adapter.generate(prompt, max_new_tokens=20, eos_token_id=eos, pad_token_id=0)
+    got = out[0, prompt.shape[1] :]
+    np.testing.assert_array_equal(got[:5], full[0, prompt.shape[1] : prompt.shape[1] + 5])
+    assert np.all(got[5:] == 0), got  # everything after EOS padded
+
+
+def test_async_batched(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, batch_size=2, async_mode=True)
+    adapter = HuggingFaceGenerationAdapter(app)
+    p0 = [5, 9, 3, 17, 2, 8]
+    p1 = [7, 13, 21]
+    prompt = np.zeros((2, 6), dtype=np.int64)
+    prompt[0] = p0
+    prompt[1, :3] = p1
+    mask = (prompt != 0).astype(np.int32)
+    out = adapter.generate(prompt, attention_mask=mask, max_new_tokens=10)
+    e0 = hf_greedy(hf_model, np.array([p0]), 10)
+    e1 = hf_greedy(hf_model, np.array([p1]), 10)
+    np.testing.assert_array_equal(out[0, : e0.shape[1]], e0[0])
+    np.testing.assert_array_equal(out[1, 3:13], e1[0, 3:])

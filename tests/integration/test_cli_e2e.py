@@ -1,0 +1,66 @@
+"""inference_demo CLI end-to-end on a tiny checkpoint (reference analog:
+inference_demo runs in test/integration)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.cli.inference_demo import main
+
+
+@pytest.fixture()
+def tiny_ckpt_dir(tiny_hf_llama, tmp_path):
+    hf_model, _ = tiny_hf_llama
+    d = tmp_path / "ckpt"
+    hf_model.save_pretrained(str(d))
+    return str(d)
+
+
+def test_cli_run_token_matching(tiny_ckpt_dir, capsys):
+    rc = main(
+        [
+            "run",
+            "--model-type", "llama",
+            "--model-path", tiny_ckpt_dir,
+            "--on-cpu",
+            "--seq-len", "64",
+            "--max-context-length", "32",
+            "--max-new-tokens", "8",
+            "--on-device-sampling",
+            "--skip-warmup",
+            "--input-ids", "[[5, 9, 3, 17, 2, 8]]",
+            "--check-accuracy-mode", "token-matching",
+        ]
+    )
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "Accuracy check (token-matching): PASS" in out
+    assert "Generated outputs:" in out
+
+
+def test_cli_benchmark_report(tiny_ckpt_dir, tmp_path, capsys, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rc = main(
+        [
+            "run",
+            "--model-type", "llama",
+            "--model-path", tiny_ckpt_dir,
+            "--on-cpu",
+            "--seq-len", "64",
+            "--max-context-length", "32",
+            "--max-new-tokens", "4",
+            "--on-device-sampling",
+            "--skip-warmup",
+            "--num-runs", "2",
+            "--input-ids", "[[5, 9, 3]]",
+            "--benchmark",
+        ]
+    )
+    assert rc == 0
+    import json
+    import os
+
+    assert os.path.exists("benchmark_report.json")
+    report = json.load(open("benchmark_report.json"))
+    assert "e2e_model" in report and "latency_ms_p50" in report["e2e_model"]
+    assert "context_encoding_model" in report
+    assert "token_generation_model" in report

@@ -11,17 +11,7 @@ from nxdi_tpu.models.llama import modeling_llama as ml
 from nxdi_tpu.runtime.application import TpuModelForCausalLM
 
 
-def hf_greedy(hf_model, input_ids, max_new_tokens):
-    import torch
-
-    with torch.no_grad():
-        out = hf_model.generate(
-            torch.tensor(input_ids, dtype=torch.long),
-            max_new_tokens=max_new_tokens,
-            do_sample=False,
-            pad_token_id=0,
-        )
-    return out.numpy()
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
 
 
 def build_app(hf_model, hf_cfg, tmp_path, **tpu_kwargs):

@@ -1,0 +1,57 @@
+"""CLI parsing tests (reference analog: test/unit/test_inference_demo.py)."""
+
+import argparse
+
+from nxdi_tpu.cli.inference_demo import CHECK_ACCURACY_MODES, create_tpu_config, setup_run_parser
+
+
+def parse(argv):
+    p = argparse.ArgumentParser()
+    setup_run_parser(p)
+    return p.parse_args(argv)
+
+
+BASE = ["--model-type", "llama", "--model-path", "/tmp/x"]
+
+
+def test_defaults():
+    a = parse(BASE)
+    assert a.batch_size == 1 and a.seq_len == 1024 and a.tp_degree == 1
+    assert a.check_accuracy_mode == "skip"
+
+
+def test_config_construction():
+    a = parse(BASE + ["--tp-degree", "8", "--seq-len", "256", "--on-device-sampling",
+                      "--do-sample", "--top-k", "5", "--enable-bucketing", "--async-mode"])
+    c = create_tpu_config(a)
+    assert c.tp_degree == 8 and c.seq_len == 256
+    assert c.on_device_sampling_config.do_sample and c.on_device_sampling_config.top_k == 5
+    assert c.enable_bucketing and c.async_mode
+    assert c.max_context_length == 128  # defaults to seq_len // 2
+
+
+def test_buckets_flags():
+    a = parse(BASE + ["--enable-bucketing", "--context-encoding-buckets", "128", "256",
+                      "--token-generation-buckets", "256", "512"])
+    c = create_tpu_config(a)
+    assert c.context_encoding_buckets == [128, 256]
+    assert c.token_generation_buckets == [256, 512]
+
+
+def test_on_cpu_forces_fp32():
+    a = parse(BASE + ["--on-cpu"])
+    c = create_tpu_config(a)
+    import jax.numpy as jnp
+
+    assert c.dtype == jnp.float32 and c.on_cpu
+
+
+def test_speculation_flags():
+    a = parse(BASE + ["--speculation-length", "5", "--draft-model-path", "/tmp/d",
+                      "--enable-fused-speculation"])
+    c = create_tpu_config(a)
+    assert c.speculation_length == 5 and c.enable_fused_speculation
+
+
+def test_accuracy_modes_exposed():
+    assert set(CHECK_ACCURACY_MODES) == {"skip", "token-matching", "logit-matching"}
