@@ -1,11 +1,12 @@
 """Llama model family (Llama 2/3/3.1/3.2, TinyLlama, OpenLlama...).
 
 The canonical dense model, mirroring the role of the reference's
-models/llama/modeling_llama.py (1624 LoC there). Here a model family is:
+models/llama/modeling_llama.py (1624 LoC there). A family module exposes:
   - an ``InferenceConfig`` subclass (hyperparameter surface),
-  - a :class:`DecoderArch` builder (padded head/vocab planning for TP),
-  - a HF-checkpoint -> params-pytree converter (host-side numpy),
-  - rope inv_freq construction (llama3 scaling supported).
+  - ``build_arch`` — :class:`DecoderArch` with family flags set,
+  - ``build_inv_freq`` — rope tables (llama3 scaling supported),
+  - ``convert_hf_state_dict`` — HF checkpoint -> params pytree,
+  - ``param_specs`` — PartitionSpec pytree.
 
 The forward pass itself is the shared generic decoder (models/base.py) — Llama
 needs no overrides, exactly like the reference where NeuronLlamaAttention is a
@@ -14,194 +15,36 @@ thin NeuronAttentionBase subclass (reference: modeling_llama.py:1186-1250).
 
 from __future__ import annotations
 
-import math
 from typing import Any, Dict
 
-import ml_dtypes
 import numpy as np
 
-from nxdi_tpu.config import InferenceConfig, dtype_name
-from nxdi_tpu.models.base import DecoderArch, decoder_param_specs
-from nxdi_tpu.ops.rope import inv_freq_from_hf_config
-from nxdi_tpu.parallel import gqa
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
 
-_NP_DTYPES = {
-    "bfloat16": ml_dtypes.bfloat16,
-    "float32": np.float32,
-    "float16": np.float16,
-}
-
-
-class LlamaInferenceConfig(InferenceConfig):
-    REQUIRED = [
-        "hidden_size",
-        "num_attention_heads",
-        "num_hidden_layers",
-        "num_key_value_heads",
-        "vocab_size",
-        "intermediate_size",
-        "rms_norm_eps",
-    ]
-
-    def add_derived_config(self):
-        super().add_derived_config()
-        if not hasattr(self, "rope_theta"):
-            self.rope_theta = 10000.0
-        if not hasattr(self, "rope_scaling"):
-            self.rope_scaling = None
-        if not hasattr(self, "tie_word_embeddings"):
-            self.tie_word_embeddings = False
-        if not hasattr(self, "hidden_act"):
-            self.hidden_act = "silu"
-        if not hasattr(self, "attention_bias"):
-            self.attention_bias = False
-        if not hasattr(self, "mlp_bias"):
-            self.mlp_bias = False
+# re-exported helpers (public API used by tests/tools)
+gqa_plan = dense.gqa_plan
+planned_head_counts = dense.planned_head_counts
+padded_vocab = dense.padded_vocab
+build_inv_freq = dense.build_inv_freq
+jax_tree_stack = dense.tree_stack
 
 
-def gqa_plan(config: InferenceConfig) -> gqa.GQAPlan:
-    return gqa.plan_gqa_sharding(
-        config.tpu_config.tp_degree, config.num_attention_heads, config.num_key_value_heads
-    )
-
-
-def planned_head_counts(config: InferenceConfig):
-    """Padded (q_heads, kv_heads) for the configured tp degree (parallel/gqa.py)."""
-    plan = gqa_plan(config)
-    return plan.target_heads, plan.target_kv
-
-
-def padded_vocab(config: InferenceConfig):
-    tp = config.tpu_config.tp_degree
-    padded = math.ceil(config.vocab_size / tp) * tp
-    return padded, padded - config.vocab_size
+class LlamaInferenceConfig(dense.DenseInferenceConfig):
+    pass
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    heads, kv_heads = planned_head_counts(config)
-    vocab, vocab_pad = padded_vocab(config)
-    kwargs = dict(
-        num_layers=config.num_hidden_layers,
-        hidden_size=config.hidden_size,
-        num_attention_heads=heads,
-        num_kv_heads=kv_heads,
-        head_dim=getattr(config, "head_dim", config.hidden_size // config.num_attention_heads),
-        intermediate_size=config.intermediate_size,
-        vocab_size=vocab,
-        vocab_pad=vocab_pad,
-        rms_norm_eps=config.rms_norm_eps,
-        hidden_act=getattr(config, "hidden_act", "silu"),
-        attention_bias=getattr(config, "attention_bias", False),
-        mlp_bias=getattr(config, "mlp_bias", False),
-        tie_word_embeddings=getattr(config, "tie_word_embeddings", False),
-        dtype=dtype_name(config.tpu_config.dtype),
-    )
-    kwargs.update(overrides)
-    return DecoderArch(**kwargs)
-
-
-def build_inv_freq(config: InferenceConfig) -> np.ndarray:
-    head_dim = getattr(config, "head_dim", config.hidden_size // config.num_attention_heads)
-    return inv_freq_from_hf_config(
-        head_dim, getattr(config, "rope_theta", 10000.0), getattr(config, "rope_scaling", None)
-    )
+    return dense.build_arch(config, **overrides)
 
 
 def convert_hf_state_dict(
     state_dict: Dict[str, np.ndarray], config: InferenceConfig
 ) -> Dict[str, Any]:
-    """HF llama checkpoint -> layer-stacked params pytree.
-
-    Does the reference's preshard-hook work (gqa.py:353 replicate_kv, head and
-    vocab padding) once, on host, so device params shard evenly over tp.
-    Weights are transposed to (in, out) layout (see parallel/layers.py).
-    """
-    arch = build_arch(config)
-    np_dtype = _NP_DTYPES[arch.dtype]
-    plan = gqa_plan(config)
-    D = arch.head_dim
-
-    def get(name):
-        for k in (name, f"model.{name}"):
-            if k in state_dict:
-                return state_dict[k]
-        raise KeyError(f"Missing weight {name}; available sample: {list(state_dict)[:8]}")
-
-    def has(name):
-        return name in state_dict or f"model.{name}" in state_dict
-
-    def cast(x):
-        return np.asarray(x, dtype=np_dtype)
-
-    layers = []
-    for i in range(arch.num_layers):
-        pre = f"layers.{i}."
-        q = gqa.convert_q(get(pre + "self_attn.q_proj.weight"), D, plan)
-        k = gqa.convert_kv(get(pre + "self_attn.k_proj.weight"), D, plan)
-        v = gqa.convert_kv(get(pre + "self_attn.v_proj.weight"), D, plan)
-        o = gqa.convert_o(get(pre + "self_attn.o_proj.weight"), D, plan)
-        attn: Dict[str, Any] = {
-            "q_proj": {"w": cast(q.T)},
-            "k_proj": {"w": cast(k.T)},
-            "v_proj": {"w": cast(v.T)},
-            "o_proj": {"w": cast(o.T)},
-        }
-        if arch.attention_bias:
-            qb = gqa.convert_q(get(pre + "self_attn.q_proj.bias")[:, None], D, plan)[:, 0]
-            kb = gqa.convert_kv(get(pre + "self_attn.k_proj.bias")[:, None], D, plan)[:, 0]
-            vb = gqa.convert_kv(get(pre + "self_attn.v_proj.bias")[:, None], D, plan)[:, 0]
-            attn["q_proj"]["b"] = cast(qb)
-            attn["k_proj"]["b"] = cast(kb)
-            attn["v_proj"]["b"] = cast(vb)
-        if arch.qk_norm:
-            attn["q_norm"] = cast(get(pre + "self_attn.q_norm.weight"))
-            attn["k_norm"] = cast(get(pre + "self_attn.k_norm.weight"))
-        layer = {
-            "input_layernorm": cast(get(pre + "input_layernorm.weight")),
-            "post_attention_layernorm": cast(get(pre + "post_attention_layernorm.weight")),
-            "attn": attn,
-            "mlp": {
-                "gate_proj": {"w": cast(get(pre + "mlp.gate_proj.weight").T)},
-                "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T)},
-                "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T)},
-            },
-        }
-        layers.append(layer)
-
-    stacked = jax_tree_stack(layers)
-
-    embed = get("embed_tokens.weight")
-    if arch.vocab_pad:
-        embed = np.concatenate(
-            [embed, np.zeros((arch.vocab_pad, embed.shape[1]), dtype=embed.dtype)], axis=0
-        )
-    params: Dict[str, Any] = {
-        "embed_tokens": cast(embed),
-        "layers": stacked,
-        "norm": cast(get("norm.weight")),
-    }
-    if not arch.tie_word_embeddings:
-        if has("lm_head.weight"):
-            head = get("lm_head.weight")
-        else:  # some checkpoints tie without the config flag
-            head = embed[: config.vocab_size]
-        if arch.vocab_pad:
-            head = np.concatenate(
-                [head, np.zeros((arch.vocab_pad, head.shape[1]), dtype=head.dtype)], axis=0
-            )
-        params["lm_head"] = cast(head.T)
-    return params
-
-
-def jax_tree_stack(trees):
-    """Stack a list of identical pytrees along a new leading (layer) axis."""
-    import jax
-
-    return jax.tree_util.tree_map(lambda *xs: np.stack(xs, axis=0), *trees)
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
 
 
 def param_specs(config: InferenceConfig):
-    return decoder_param_specs(build_arch(config))
+    return dense.param_specs_for(build_arch(config))
 
-
-MODEL_TYPES = ("llama", "tinyllama", "open_llama")

@@ -1,0 +1,40 @@
+"""Mistral family (7B v0.1/v0.2, Ministral...).
+
+Llama-lineage dense decoder with optional sliding-window attention
+(reference handles SWA via the sliding-window kernel + windowed KV,
+modules/sliding_window/attention.py and attention_base.py:3080; here the
+window is a mask family in ops/attention.py plus the same full-length cache).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class MistralInferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
+    return dense.build_arch(config, **{"sliding_window": sw, **overrides})
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+

@@ -1,0 +1,39 @@
+"""Qwen2 / Qwen2.5 family (reference: models/qwen2/modeling_qwen2.py, 283 LoC).
+
+Llama-lineage dense decoder distinguished by QKV projection biases
+(``attention_bias=True``) and tied embeddings on the small variants. The HF
+state dict shares llama's key layout, so conversion is the generic dense path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Qwen2InferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    # qwen2 always carries q/k/v biases (HF Qwen2Attention)
+    return dense.build_arch(config, **{"attention_bias": True, **overrides})
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+

@@ -1,0 +1,38 @@
+"""Qwen3 family (reference: models/qwen3/modeling_qwen3.py, 241 LoC).
+
+Dense llama-lineage decoder distinguished by per-head q/k RMSNorm
+(``qk_norm``), an explicit ``head_dim`` decoupled from hidden_size/heads, and
+no attention biases.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Qwen3InferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    return dense.build_arch(config, **{"qk_norm": True, **overrides})
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+

@@ -13,6 +13,9 @@ from typing import Dict, Tuple
 _REGISTRY: Dict[str, Tuple[str, str]] = {
     # model_type -> (module path, config class name)
     "llama": ("nxdi_tpu.models.llama.modeling_llama", "LlamaInferenceConfig"),
+    "qwen2": ("nxdi_tpu.models.qwen2.modeling_qwen2", "Qwen2InferenceConfig"),
+    "qwen3": ("nxdi_tpu.models.qwen3.modeling_qwen3", "Qwen3InferenceConfig"),
+    "mistral": ("nxdi_tpu.models.mistral.modeling_mistral", "MistralInferenceConfig"),
 }
 
 

@@ -1,0 +1,91 @@
+"""Token matching vs HF CPU for the dense model families beyond llama
+(reference analog: per-family integration tests under test/integration and
+contrib model tests)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.registry import get_family
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+
+def _tiny_hf(model_type):
+    import torch
+
+    torch.manual_seed(0)
+    common = dict(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+    )
+    if model_type == "qwen2":
+        from transformers import Qwen2Config, Qwen2ForCausalLM
+
+        cfg = Qwen2Config(**common, tie_word_embeddings=True)
+        model = Qwen2ForCausalLM(cfg)
+    elif model_type == "qwen3":
+        from transformers import Qwen3Config, Qwen3ForCausalLM
+
+        # head_dim decoupled from hidden_size/num_heads (qwen3 signature trait)
+        cfg = Qwen3Config(**common, head_dim=24, tie_word_embeddings=False)
+        model = Qwen3ForCausalLM(cfg)
+    elif model_type == "mistral":
+        from transformers import MistralConfig, MistralForCausalLM
+
+        cfg = MistralConfig(**common, sliding_window=8)
+        model = MistralForCausalLM(cfg)
+    else:
+        raise ValueError(model_type)
+    return model.eval(), cfg
+
+
+def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
+    family, cfg_cls = get_family(model_type)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=tp_degree,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = cfg_cls(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=family)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("model_type", ["qwen2", "qwen3", "mistral"])
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_family_greedy_token_matching(model_type, tp_degree):
+    hf_model, hf_cfg = _tiny_hf(model_type)
+    app = _build_app(model_type, hf_model, hf_cfg, tp_degree=tp_degree)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_registry_covers_families():
+    from nxdi_tpu.models.registry import known_model_types
+
+    for t in ("llama", "qwen2", "qwen3", "mistral"):
+        assert t in known_model_types()
