@@ -34,6 +34,7 @@ from nxdi_tpu.kvcache.kv_cache import (
     update_layer_cache,
 )
 from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import moe as moe_ops
 from nxdi_tpu.ops import sampling as sampling_ops
 from nxdi_tpu.ops.norms import rms_norm
 from nxdi_tpu.ops.rope import apply_rotary_pos_emb, rope_cos_sin
@@ -81,6 +82,8 @@ class DecoderArch:
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
     softmax_dtype: str = "float32"
+    # MoE feed-forward replaces the dense MLP when set (ops/moe.py)
+    moe: Optional[moe_ops.MoEArch] = None
 
     def kv_cache_spec(self, batch_size: int, max_len: int, quant_dtype=None) -> KVCacheSpec:
         return KVCacheSpec(
@@ -140,16 +143,18 @@ def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
             lambda s: P(*((None,) + tuple(s))), spec_tree, is_leaf=lambda x: isinstance(x, P)
         )
 
+    layer_specs = {
+        "input_layernorm": REPLICATED,
+        "post_attention_layernorm": REPLICATED,
+        "attn": attention_param_specs(arch),
+    }
+    if arch.moe is not None:
+        layer_specs["moe"] = moe_ops.expert_parallel_specs(arch.moe)
+    else:
+        layer_specs["mlp"] = mlp_param_specs(arch)
     specs = {
         "embed_tokens": VOCAB_PARALLEL,
-        "layers": stack(
-            {
-                "input_layernorm": REPLICATED,
-                "post_attention_layernorm": REPLICATED,
-                "attn": attention_param_specs(arch),
-                "mlp": mlp_param_specs(arch),
-            }
-        ),
+        "layers": stack(layer_specs),
         "norm": REPLICATED,
     }
     if not arch.tie_word_embeddings:
@@ -264,7 +269,10 @@ def decoder_layer(
     )
     hidden = hidden + attn_out
     h = rms_norm(hidden, lp["post_attention_layernorm"], arch.rms_norm_eps)
-    hidden = hidden + mlp_block(arch, lp["mlp"], h)
+    if arch.moe is not None:
+        hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
+    else:
+        hidden = hidden + mlp_block(arch, lp["mlp"], h)
     return hidden, (nk, nv)
 
 

@@ -93,7 +93,10 @@ def build_inv_freq(config: InferenceConfig) -> np.ndarray:
 
 
 def convert_hf_state_dict(
-    state_dict: Dict[str, np.ndarray], config: InferenceConfig, arch: DecoderArch
+    state_dict: Dict[str, np.ndarray],
+    config: InferenceConfig,
+    arch: DecoderArch,
+    ff_converter=None,
 ) -> Dict[str, Any]:
     """HF llama-layout checkpoint -> layer-stacked params pytree.
 
@@ -101,7 +104,9 @@ def convert_hf_state_dict(
     vocab padding) once, on host, so device params shard evenly over tp.
     Weights are transposed to (in, out) layout (see parallel/layers.py).
     Covers the whole llama lineage (llama, qwen2 w/ qkv bias, qwen3 w/ q/k
-    norms, mistral) — their HF state dicts share key names.
+    norms, mistral) — their HF state dicts share key names. MoE families pass
+    ``ff_converter(get, has, cast, layer_prefix) -> (key, params)`` to replace
+    the dense-MLP conversion per layer (e.g. ("moe", {...})).
     """
     dt = np_dtype(arch.dtype)
     plan = gqa_plan(config)
@@ -151,12 +156,20 @@ def convert_hf_state_dict(
             "input_layernorm": cast(get(pre + "input_layernorm.weight")),
             "post_attention_layernorm": cast(get(pre + "post_attention_layernorm.weight")),
             "attn": attn,
-            "mlp": {
+        }
+        if ff_converter is not None:
+            key, ff = ff_converter(get, has, cast, pre)
+            layer[key] = ff
+        else:
+            mlp = {
                 "gate_proj": {"w": cast(get(pre + "mlp.gate_proj.weight").T)},
                 "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T)},
                 "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T)},
-            },
-        }
+            }
+            if arch.mlp_bias:
+                for proj in ("gate_proj", "up_proj", "down_proj"):
+                    mlp[proj]["b"] = cast(get(f"{pre}mlp.{proj}.bias"))
+            layer["mlp"] = mlp
         layers.append(layer)
 
     stacked = tree_stack(layers)
@@ -181,6 +194,63 @@ def convert_hf_state_dict(
                 [head, np.zeros((arch.vocab_pad, head.shape[1]), dtype=head.dtype)], axis=0
             )
         params["lm_head"] = cast(head.T)
+    return params
+
+
+def param_shape_struct(config: InferenceConfig, arch: DecoderArch):
+    """ShapeDtypeStruct pytree matching :func:`convert_hf_state_dict` output —
+    AOT compile needs shapes before weights exist (reference compiles from a
+    lazy checkpoint_loader_fn the same way, application_base.py:628)."""
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+    from nxdi_tpu.ops import moe as moe_ops
+
+    dt = to_jax_dtype(arch.dtype)
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    hs, inter, V, L = arch.hidden_size, arch.intermediate_size, arch.vocab_size, arch.num_layers
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    attn = {
+        "q_proj": {"w": s(L, hs, H * D)},
+        "k_proj": {"w": s(L, hs, KV * D)},
+        "v_proj": {"w": s(L, hs, KV * D)},
+        "o_proj": {"w": s(L, H * D, hs)},
+    }
+    if arch.attention_bias:
+        attn["q_proj"]["b"] = s(L, H * D)
+        attn["k_proj"]["b"] = s(L, KV * D)
+        attn["v_proj"]["b"] = s(L, KV * D)
+    if arch.qk_norm:
+        attn["q_norm"] = s(L, D)
+        attn["k_norm"] = s(L, D)
+    layers = {
+        "input_layernorm": s(L, hs),
+        "post_attention_layernorm": s(L, hs),
+        "attn": attn,
+    }
+    if arch.moe is not None:
+        layers["moe"] = moe_ops.moe_shape_struct(arch.moe, hs, L, dt)
+    else:
+        mlp = {
+            "gate_proj": {"w": s(L, hs, inter)},
+            "up_proj": {"w": s(L, hs, inter)},
+            "down_proj": {"w": s(L, inter, hs)},
+        }
+        if arch.mlp_bias:
+            mlp["gate_proj"]["b"] = s(L, inter)
+            mlp["up_proj"]["b"] = s(L, inter)
+            mlp["down_proj"]["b"] = s(L, hs)
+        layers["mlp"] = mlp
+    params = {
+        "embed_tokens": s(V, hs),
+        "layers": layers,
+        "norm": s(hs),
+    }
+    if not arch.tie_word_embeddings:
+        params["lm_head"] = s(hs, V)
     return params
 
 

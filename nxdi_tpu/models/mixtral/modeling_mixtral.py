@@ -1,0 +1,77 @@
+"""Mixtral family (8x7B / 8x22B) — sparse-MoE llama lineage.
+
+Reference: models/mixtral/modeling_mixtral.py (330 LoC) builds the MoE via
+modules/moe_v2.py; here the MoE feed-forward is ops/moe.py with the expert dim
+sharded over tp when it divides (expert parallelism).
+
+HF weight layout: ``block_sparse_moe.gate`` router, experts ``w1`` (gate),
+``w3`` (up), ``w2`` (down). Router semantics: full softmax -> top-k ->
+renormalize (always).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, ep_policy
+
+build_inv_freq = dense.build_inv_freq
+
+# HF Mixtral expert projections: w1=gate, w3=up, w2=down
+_W_NAMES = {"gate": "w1", "up": "w3", "down": "w2"}
+
+
+class MixtralInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + [
+        "num_local_experts",
+        "num_experts_per_tok",
+    ]
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.num_local_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.intermediate_size,
+        hidden_act=getattr(config, "hidden_act", "silu"),
+        norm_topk_prob=True,
+        ep=ep_policy(config.tpu_config.tp_degree, config.num_local_experts),
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
+    return dense.build_arch(
+        config, **{"moe": _moe_arch(config), "sliding_window": sw, **overrides}
+    )
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+
+    def ff(get, has, cast, pre):
+        return "moe", convert_hf_experts(
+            get,
+            cast,
+            arch.moe.num_experts,
+            pre + "block_sparse_moe.gate.weight",
+            lambda j, proj: f"{pre}block_sparse_moe.experts.{j}.{_W_NAMES[proj]}.weight",
+        )
+
+    return dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -1,0 +1,80 @@
+"""Qwen3-MoE family (Qwen3-30B-A3B, Qwen3-235B-A22B).
+
+Reference: models/qwen3_moe/modeling_qwen3_moe.py (544 LoC) — the flagship MoE
+benchmark model (BASELINE.md Qwen3-235B numbers). Qwen3 attention traits
+(qk_norm, explicit head_dim) + sparse MoE feed-forward with configurable
+``norm_topk_prob`` and per-expert ``moe_intermediate_size``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, ep_policy
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Qwen3MoeInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + [
+        "num_experts",
+        "num_experts_per_tok",
+        "moe_intermediate_size",
+    ]
+
+    def add_derived_config(self):
+        super().add_derived_config()
+        if not hasattr(self, "norm_topk_prob"):
+            # HF Qwen3MoeConfig default — saved configs omit default values
+            self.norm_topk_prob = False
+        # dense-layer interleaving is not supported yet; validate it is off
+        if getattr(self, "mlp_only_layers", None):
+            raise NotImplementedError("qwen3_moe mlp_only_layers not supported yet")
+        if getattr(self, "decoder_sparse_step", 1) != 1:
+            raise NotImplementedError("qwen3_moe decoder_sparse_step != 1 not supported yet")
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.num_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.moe_intermediate_size,
+        hidden_act=getattr(config, "hidden_act", "silu"),
+        norm_topk_prob=config.norm_topk_prob,
+        ep=ep_policy(config.tpu_config.tp_degree, config.num_experts),
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    return dense.build_arch(config, **{"qk_norm": True, "moe": _moe_arch(config), **overrides})
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+
+    def ff(get, has, cast, pre):
+        return "moe", convert_hf_experts(
+            get,
+            cast,
+            arch.moe.num_experts,
+            pre + "mlp.gate.weight",
+            lambda j, proj: f"{pre}mlp.experts.{j}.{proj}_proj.weight",
+        )
+
+    return dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -16,6 +16,8 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "qwen2": ("nxdi_tpu.models.qwen2.modeling_qwen2", "Qwen2InferenceConfig"),
     "qwen3": ("nxdi_tpu.models.qwen3.modeling_qwen3", "Qwen3InferenceConfig"),
     "mistral": ("nxdi_tpu.models.mistral.modeling_mistral", "MistralInferenceConfig"),
+    "mixtral": ("nxdi_tpu.models.mixtral.modeling_mixtral", "MixtralInferenceConfig"),
+    "qwen3_moe": ("nxdi_tpu.models.qwen3_moe.modeling_qwen3_moe", "Qwen3MoeInferenceConfig"),
 }
 
 

@@ -1,0 +1,190 @@
+"""Mixture-of-Experts ops — router + expert MLPs, expert-parallel over the mesh.
+
+Reference: modules/moe_v2.py:23-132 assembles RouterTopK + ExpertMLPsV2 +
+SharedExperts into an MoE wrapper, with TPxEP process groups (:135-161) and
+NKI blockwise-matmul kernels. TPU-native the same structure is:
+
+  - **Router**: one replicated linear -> full softmax -> top-k -> (optional)
+    renormalize, exactly HF's semantics so logits match the CPU golden.
+  - **Experts**: dense dispatch. Every expert runs on every token; the per-token
+    combine weight is zero for unselected experts. No gather/scatter, no
+    capacity limits, no dynamic shapes — the einsum over the expert dim maps
+    straight onto the MXU, and the combine contraction is exact.
+  - **Parallelism**: the expert dim is sharded over the ``tp`` mesh axis when it
+    divides (expert parallelism: each device holds E/tp full experts; the
+    combine einsum contracts over experts so GSPMD inserts one psum — the
+    reference's EP dispatch AR/RS collectives, attention_base.py:179).
+    Otherwise the intermediate dim is sharded (expert-internal TP, the
+    reference's moe_tp_degree).
+
+Dense dispatch costs E/topk x the active-expert FLOPs. That is the right first
+trade on TPU: decode is HBM-bound on expert *weights*, which any-expert routing
+must stream anyway; a ragged/sorted dispatch kernel is a later optimization
+(PAPERS.md megablocks lineage) that slots in behind this same interface.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.parallel.mesh import AXIS_TP
+
+
+@dataclass(frozen=True)
+class MoEArch:
+    """Static MoE architecture description (hashable; part of DecoderArch)."""
+
+    num_experts: int
+    top_k: int
+    intermediate_size: int  # per-expert intermediate
+    hidden_act: str = "silu"
+    norm_topk_prob: bool = True  # renormalize top-k weights (mixtral: always)
+    # expert-parallel over tp axis (family builder sets this when tp | E);
+    # False -> expert-internal TP on the intermediate dim
+    ep: bool = False
+    # shared (always-on) experts, qwen2-moe/llama4 style
+    shared_expert_intermediate_size: Optional[int] = None
+    shared_expert_gated: bool = False  # sigmoid(gate(x)) scaling on shared out
+
+
+def ep_policy(tp_degree: int, num_experts: int) -> bool:
+    """Shared EP-vs-TP decision for family builders: expert parallelism when
+    the tp world divides the expert count."""
+    return tp_degree > 1 and num_experts % tp_degree == 0
+
+
+def convert_hf_experts(get, cast, num_experts: int, router_key: str, expert_fmt) -> Dict[str, Any]:
+    """Stack per-expert HF weights into the (E, in, out) layout ops/moe.py
+    consumes. ``expert_fmt(j, proj)`` yields the HF key for expert j's
+    gate/up/down projection."""
+    import numpy as np
+
+    gate = np.stack([get(expert_fmt(j, "gate")).T for j in range(num_experts)])
+    up = np.stack([get(expert_fmt(j, "up")).T for j in range(num_experts)])
+    down = np.stack([get(expert_fmt(j, "down")).T for j in range(num_experts)])
+    return {
+        "router": {"w": cast(get(router_key).T)},
+        "experts": {
+            "gate_proj": {"w": cast(gate)},
+            "up_proj": {"w": cast(up)},
+            "down_proj": {"w": cast(down)},
+        },
+    }
+
+
+def expert_parallel_specs(moe: MoEArch) -> Dict[str, Any]:
+    """PartitionSpecs for one layer's MoE params (pre-layer-stacking).
+
+    EP when ``moe.ep`` (family builder sets it when tp divides the expert
+    count), else TP on the expert intermediate (reference: moe_ep_degree vs
+    moe_tp_degree, config.py:603).
+    """
+    if moe.ep:
+        expert_spec = {
+            "gate_proj": {"w": P(AXIS_TP, None, None)},
+            "up_proj": {"w": P(AXIS_TP, None, None)},
+            "down_proj": {"w": P(AXIS_TP, None, None)},
+        }
+    else:
+        expert_spec = {
+            "gate_proj": {"w": P(None, None, AXIS_TP)},
+            "up_proj": {"w": P(None, None, AXIS_TP)},
+            "down_proj": {"w": P(None, AXIS_TP, None)},
+        }
+    specs: Dict[str, Any] = {
+        "router": {"w": P()},
+        "experts": expert_spec,
+    }
+    if moe.shared_expert_intermediate_size:
+        specs["shared_expert"] = {
+            "gate_proj": {"w": P(None, AXIS_TP)},
+            "up_proj": {"w": P(None, AXIS_TP)},
+            "down_proj": {"w": P(AXIS_TP, None)},
+        }
+        if moe.shared_expert_gated:
+            specs["shared_expert_gate"] = {"w": P()}
+    return specs
+
+
+def route(router_logits: jax.Array, moe: MoEArch) -> jax.Array:
+    """Router logits (T, E) -> dense combine weights (T, E), zero for
+    unselected experts (HF Mixtral/Qwen3Moe semantics: full softmax -> top-k ->
+    optional renormalize; reference: RouterTopK in moe_v2.py:23)."""
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, moe.top_k)  # (T, K)
+    if moe.norm_topk_prob:
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    dense = jnp.sum(
+        jax.nn.one_hot(top_idx, moe.num_experts, dtype=top_vals.dtype)
+        * top_vals[..., None],
+        axis=-2,
+    )  # (T, E)
+    return dense
+
+
+def moe_block(arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+    """MoE feed-forward: (B, S, H) -> (B, S, H).
+
+    Param leaves: router.w (H, E); experts.{gate,up}_proj.w (E, H, I),
+    experts.down_proj.w (E, I, H); optional shared_expert mlp.
+    """
+    from nxdi_tpu.models.base import ACT_FNS
+
+    act = ACT_FNS[moe.hidden_act]
+    B, S, H = x.shape
+    xt = x.reshape(B * S, H)
+
+    router_logits = xt.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
+    weights = route(router_logits, moe).astype(x.dtype)  # (T, E)
+
+    # dense dispatch: all experts on all tokens, combine contracted over E
+    gate = jnp.einsum("th,ehi->eti", xt, p["experts"]["gate_proj"]["w"])
+    up = jnp.einsum("th,ehi->eti", xt, p["experts"]["up_proj"]["w"])
+    inner = act(gate) * up  # (E, T, I)
+    expert_out = jnp.einsum("eti,eih->eth", inner, p["experts"]["down_proj"]["w"])
+    out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
+
+    if moe.shared_expert_intermediate_size:
+        sp = p["shared_expert"]
+        shared = (
+            act(xt @ sp["gate_proj"]["w"]) * (xt @ sp["up_proj"]["w"])
+        ) @ sp["down_proj"]["w"]
+        if moe.shared_expert_gated:
+            shared = jax.nn.sigmoid(
+                xt.astype(jnp.float32) @ p["shared_expert_gate"]["w"].astype(jnp.float32)
+            ).astype(shared.dtype) * shared
+        out = out + shared
+
+    return out.reshape(B, S, H)
+
+
+def moe_shape_struct(moe: MoEArch, hidden_size: int, num_layers: int, dtype) -> Dict[str, Any]:
+    """ShapeDtypeStruct pytree for layer-stacked MoE params."""
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct((num_layers,) + shape, dtype)
+
+    E, H, I = moe.num_experts, hidden_size, moe.intermediate_size
+    struct: Dict[str, Any] = {
+        "router": {"w": s(H, E)},
+        "experts": {
+            "gate_proj": {"w": s(E, H, I)},
+            "up_proj": {"w": s(E, H, I)},
+            "down_proj": {"w": s(E, I, H)},
+        },
+    }
+    if moe.shared_expert_intermediate_size:
+        SI = moe.shared_expert_intermediate_size
+        struct["shared_expert"] = {
+            "gate_proj": {"w": s(H, SI)},
+            "up_proj": {"w": s(H, SI)},
+            "down_proj": {"w": s(SI, H)},
+        }
+        if moe.shared_expert_gated:
+            struct["shared_expert_gate"] = {"w": s(H, 1)}
+    return struct

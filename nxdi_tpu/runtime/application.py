@@ -184,45 +184,11 @@ def params_shape_struct(family, config, arch):
     without touching checkpoint bytes — used for AOT compile before weights
     exist (reference compiles from checkpoint_loader_fn lazily too,
     application_base.py:628)."""
-    from nxdi_tpu.config import to_jax_dtype
+    if hasattr(family, "param_shape_struct"):
+        return family.param_shape_struct(config)
+    from nxdi_tpu.models import dense
 
-    dt = to_jax_dtype(arch.dtype)
-    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
-    hs, inter, V, L = arch.hidden_size, arch.intermediate_size, arch.vocab_size, arch.num_layers
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, dt)
-
-    attn = {
-        "q_proj": {"w": s(L, hs, H * D)},
-        "k_proj": {"w": s(L, hs, KV * D)},
-        "v_proj": {"w": s(L, hs, KV * D)},
-        "o_proj": {"w": s(L, H * D, hs)},
-    }
-    if arch.attention_bias:
-        attn["q_proj"]["b"] = s(L, H * D)
-        attn["k_proj"]["b"] = s(L, KV * D)
-        attn["v_proj"]["b"] = s(L, KV * D)
-    if arch.qk_norm:
-        attn["q_norm"] = s(L, D)
-        attn["k_norm"] = s(L, D)
-    params = {
-        "embed_tokens": s(V, hs),
-        "layers": {
-            "input_layernorm": s(L, hs),
-            "post_attention_layernorm": s(L, hs),
-            "attn": attn,
-            "mlp": {
-                "gate_proj": {"w": s(L, hs, inter)},
-                "up_proj": {"w": s(L, hs, inter)},
-                "down_proj": {"w": s(L, inter, hs)},
-            },
-        },
-        "norm": s(hs),
-    }
-    if not arch.tie_word_embeddings:
-        params["lm_head"] = s(hs, V)
-    return params
+    return dense.param_shape_struct(config, arch)
 
 
 class TpuModelForCausalLM(ApplicationBase):

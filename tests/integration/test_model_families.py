@@ -43,6 +43,25 @@ def _tiny_hf(model_type):
 
         cfg = MistralConfig(**common, sliding_window=8)
         model = MistralForCausalLM(cfg)
+    elif model_type == "mixtral":
+        from transformers import MixtralConfig, MixtralForCausalLM
+
+        cfg = MixtralConfig(**common, num_local_experts=8, num_experts_per_tok=2)
+        model = MixtralForCausalLM(cfg)
+    elif model_type == "qwen3_moe":
+        from transformers import Qwen3MoeConfig, Qwen3MoeForCausalLM
+
+        cfg = Qwen3MoeConfig(
+            **common,
+            head_dim=16,
+            num_experts=8,
+            num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            norm_topk_prob=True,
+            decoder_sparse_step=1,
+            mlp_only_layers=[],
+        )
+        model = Qwen3MoeForCausalLM(cfg)
     else:
         raise ValueError(model_type)
     return model.eval(), cfg
@@ -71,7 +90,7 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
     return app
 
 
-@pytest.mark.parametrize("model_type", ["qwen2", "qwen3", "mistral"])
+@pytest.mark.parametrize("model_type", ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe"])
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):
     hf_model, hf_cfg = _tiny_hf(model_type)
@@ -87,5 +106,41 @@ def test_family_greedy_token_matching(model_type, tp_degree):
 def test_registry_covers_families():
     from nxdi_tpu.models.registry import known_model_types
 
-    for t in ("llama", "qwen2", "qwen3", "mistral"):
+    for t in ("llama", "qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe"):
         assert t in known_model_types()
+
+
+def test_moe_ep_sharding_plan():
+    """tp=8 with 8 experts must choose expert parallelism (ep=True)."""
+    from nxdi_tpu.config import TpuConfig
+    from nxdi_tpu.models.registry import get_family
+
+    family, cfg_cls = get_family("mixtral")
+    cfg = cfg_cls(
+        TpuConfig(tp_degree=8, seq_len=32, dtype="float32"),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=8,
+        vocab_size=256,
+        rms_norm_eps=1e-5,
+        num_local_experts=8,
+        num_experts_per_tok=2,
+    )
+    arch = family.build_arch(cfg)
+    assert arch.moe is not None and arch.moe.ep
+    # 6 experts with tp=8: falls back to intermediate-dim TP
+    cfg2 = cfg_cls(
+        TpuConfig(tp_degree=8, seq_len=32, dtype="float32"),
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=2,
+        num_attention_heads=8,
+        num_key_value_heads=8,
+        vocab_size=256,
+        rms_norm_eps=1e-5,
+        num_local_experts=6,
+        num_experts_per_tok=2,
+    )
+    assert not family.build_arch(cfg2).moe.ep
