@@ -135,6 +135,18 @@ class HuggingFaceGenerationAdapter:
         for e in eos_ids:
             finished |= next_tokens == e
 
+        if getattr(self.app, "is_fused_spec", False) and do_sample:
+            logger.warning(
+                "fused speculation decodes greedily (draft proposal + target "
+                "verification are argmax); do_sample=True request falls back "
+                "to greedy."
+            )
+        if getattr(self.app, "is_fused_spec", False) and not finished.all():
+            gen = self._fused_spec_decode(
+                next_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B
+            )
+            return self._assemble(input_ids, gen, lengths, pad_token_id)
+
         if self.app.async_supported and "next_inputs" in outputs and not finished.all():
             gen = self._device_decode_loop(
                 outputs["next_inputs"], next_tokens, lengths, n_new, eos_ids, pad_token_id, B
@@ -220,6 +232,57 @@ class HuggingFaceGenerationAdapter:
                 hits = [i for i, t in enumerate(gen[b]) if t in eos_ids]
                 first_eos.append(hits[0] if hits else gen.shape[1] - 1)
             gen = gen[:, : max(first_eos) + 1]
+        return gen
+
+    def _fused_spec_decode(
+        self, first_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B
+    ) -> np.ndarray:
+        """Multi-token decode via fused speculation (reference:
+        hf_adapter.py:515 ``_fused_assisted_decoding``): each dispatch retires
+        counts[b] tokens per row; rows advance at different rates, so per-row
+        positions are tracked host-side. Returns (B, T<=n_new) including the
+        context-encoding token, padded after each row's EOS."""
+        eos_set = set(int(e) for e in eos_ids)
+        rows = [[int(first_tokens[b])] for b in range(B)]
+        finished = np.array(
+            [rows[b][0] in eos_set or n_new <= 1 for b in range(B)], dtype=bool
+        )
+        cur_tok = np.array(first_tokens, dtype=np.int32)
+        cur_pos = lengths.astype(np.int32).copy()  # position of cur_tok
+
+        while not finished.all():
+            outputs = self.app.forward(
+                cur_tok[:, None],
+                cur_pos[:, None],
+                last_token_index=np.zeros((B,), dtype=np.int32),
+                sampling_params=sampling_params,
+            )
+            toks = np.asarray(jax.device_get(outputs["tokens"]))  # (B, k+1)
+            cnts = np.asarray(jax.device_get(outputs["counts"]))  # (B,)
+            for b in range(B):
+                if finished[b]:
+                    continue
+                # token j sits at position cur_pos+1+j; tokens at positions
+                # >= seq_len were computed against dropped KV writes — discard
+                # them (a row can still fill the cache to the last slot)
+                c = min(int(cnts[b]), self.tpu_config.seq_len - 1 - int(cur_pos[b]))
+                if c <= 0:
+                    finished[b] = True
+                    continue
+                for j in range(c):
+                    t = int(toks[b, j])
+                    rows[b].append(t)
+                    if t in eos_set or len(rows[b]) >= n_new:
+                        finished[b] = True
+                        break
+                cur_tok[b] = toks[b, c - 1]
+                cur_pos[b] += c
+
+        T = min(n_new, max(len(r) for r in rows))
+        gen = np.full((B, T), pad_token_id, dtype=np.int64)
+        for b in range(B):
+            r = rows[b][:T]
+            gen[b, : len(r)] = r
         return gen
 
     def _next_rng(self) -> np.ndarray:

@@ -79,6 +79,16 @@ class ApplicationBase:
         sd = self.get_state_dict()
         return self.family.convert_hf_state_dict(sd, self.config)
 
+    # -- overridable pytree layouts (multi-model apps override all three) --
+    def param_specs(self):
+        return self.family.param_specs(self.config)
+
+    def cache_partition_specs(self):
+        return kv_cache_partition_spec()
+
+    def init_cache_host(self):
+        return init_kv_cache(self._cache_spec())
+
     # ------------------------------------------------------------------
     def compile(self, compiled_model_path: str) -> None:
         """AOT-compile every (submodel, bucket) program into the persistent
@@ -106,8 +116,10 @@ class ApplicationBase:
         z = jax.ShapeDtypeStruct(spec.shape, spec.store_dtype)
         return {"k": z, "v": z}
 
-    def _cache_spec(self):
-        arch = self.family.build_arch(self.config)
+    def _cache_spec(self, family=None, config=None):
+        family = family or self.family
+        config = config or self.config
+        arch = family.build_arch(config)
         return arch.kv_cache_spec(
             self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size,
             self.tpu_config.seq_len,
@@ -129,13 +141,11 @@ class ApplicationBase:
         self._build_wrappers()
 
         params_host = self.build_params()
-        specs = self.family.param_specs(self.config)
-        self.params = shard_pytree(params_host, specs, self.mesh)
+        self.params = shard_pytree(params_host, self.param_specs(), self.mesh)
         del params_host
 
-        cache_specs = kv_cache_partition_spec()
-        cache_host = init_kv_cache(self._cache_spec())
-        self.kv_cache = shard_pytree(cache_host, cache_specs, self.mesh)
+        cache_host = self.init_cache_host()
+        self.kv_cache = shard_pytree(cache_host, self.cache_partition_specs(), self.mesh)
 
         if not self.tpu_config.skip_warmup:
             self.warmup()
@@ -148,8 +158,8 @@ class ApplicationBase:
         if self.mesh is None:
             self.mesh = mesh_from_config(self.tpu_config)
             jax.set_mesh(self.mesh)
-        param_shardings = sharding_tree(self.family.param_specs(self.config), self.mesh)
-        cache_shardings = sharding_tree(kv_cache_partition_spec(), self.mesh)
+        param_shardings = sharding_tree(self.param_specs(), self.mesh)
+        cache_shardings = sharding_tree(self.cache_partition_specs(), self.mesh)
         for wrapper in self.models.values():
             wrapper.build(self.mesh, param_shardings, cache_shardings)
 
@@ -165,7 +175,9 @@ class ApplicationBase:
                     "input_ids": np.zeros((b, seq), dtype=np.int32),
                     "position_ids": np.tile(np.arange(seq, dtype=np.int32), (b, 1))
                     if not wrapper.attend_to_cache
-                    else np.full((b, seq), bucket - 1, dtype=np.int32),
+                    else np.full(
+                        (b, seq), max(bucket - 1 - wrapper.lookahead, 0), dtype=np.int32
+                    ),
                     "last_token_index": np.zeros((b,), dtype=np.int32),
                     "sampling_params": np.tile([1.0, 1.0, 1.0], (b, 1)).astype(np.float32),
                 }

@@ -61,6 +61,9 @@ class ModelWrapper:
         self.bucket_strategy = bucket_strategy
         self.forward_fn = forward_fn or causal_lm_forward
         self.forward_kwargs = dict(forward_kwargs or {})
+        # extra KV positions a single dispatch may write past the current
+        # length (speculation windows); widens bucket selection accordingly
+        self.lookahead = 0
         # stochastic sampling needs a per-step PRNG key threaded as an input
         self.needs_rng = bool(self.forward_kwargs.get("do_sample", False))
         self._programs: Dict[int, Callable] = {}
@@ -81,18 +84,20 @@ class ModelWrapper:
                 bucket, mesh, param_shardings, cache_shardings
             )
 
-    def _make_program(self, bucket: int, mesh, param_shardings, cache_shardings):
+    def make_forward(self, bucket: int):
+        """The pure (params, cache, batch) -> (outputs, cache) function this
+        bucket compiles. Subclasses (fused speculation, ...) override."""
         if self.attend_to_cache:
             # token generation: fixed active tokens, bucket bounds the attended KV window
-            seq = self.n_active_tokens
             kwargs = dict(attend_to_cache=True, kv_window=bucket)
         else:
             # context encoding: bucket IS the padded input length
-            seq = bucket
             kwargs = dict(attend_to_cache=False, kv_window=None)
         kwargs.update(self.forward_kwargs)
+        return partial(self.forward_fn, self.arch, self.inv_freq, **kwargs)
 
-        fn = partial(self.forward_fn, self.arch, self.inv_freq, **kwargs)
+    def _make_program(self, bucket: int, mesh, param_shardings, cache_shardings):
+        fn = self.make_forward(bucket)
 
         replicated = NamedSharding(mesh, P())
         batch_shardings = {
@@ -158,6 +163,11 @@ class ModelWrapper:
                     f"{self.tag}: expected {self.n_active_tokens} active tokens, got {s}"
                 )
             length = int(position_ids.max()) + 1
+            # real overflow must still raise loudly in select_bucket; only the
+            # speculative lookahead may be clamped to the largest bucket
+            # (overshooting writes are dropped and the host discards their tokens)
+            if length <= self.buckets[-1]:
+                length = min(length + self.lookahead, self.buckets[-1])
             bucket = self.select_bucket(length)
             pad_s = s
         else:

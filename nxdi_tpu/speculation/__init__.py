@@ -1,0 +1,13 @@
+from nxdi_tpu.speculation.application import FusedSpecCausalLM
+from nxdi_tpu.speculation.fused import (
+    FusedSpecWrapper,
+    fused_spec_context_encoding,
+    fused_spec_token_gen,
+)
+
+__all__ = [
+    "FusedSpecCausalLM",
+    "FusedSpecWrapper",
+    "fused_spec_context_encoding",
+    "fused_spec_token_gen",
+]

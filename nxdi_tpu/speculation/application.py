@@ -1,0 +1,151 @@
+"""Fused-speculation application: draft + target owned by one lifecycle.
+
+The analog of the reference wiring a ``FusedSpecNeuronConfig`` into
+``NeuronBaseForCausalLM`` (models/model_base.py:3132 ``enable_fused_spec``;
+draft/target checkpoint prefixing application_base.py:691): one application
+holds both models' params and KV caches as {"draft": ..., "target": ...}
+pytrees, and its two submodels are the fused context-encoding and fused
+token-generation graphs from :mod:`nxdi_tpu.speculation.fused`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from nxdi_tpu import checkpoint as ckpt
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+from nxdi_tpu.runtime import autobucketing
+from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+from nxdi_tpu.runtime.model_wrapper import (
+    TAG_CONTEXT_ENCODING,
+    TAG_FUSED_SPECULATION,
+)
+from nxdi_tpu.speculation.fused import FusedSpecWrapper
+
+
+class FusedSpecCausalLM(TpuModelForCausalLM):
+    """CausalLM with on-device speculative decoding (draft + target fused)."""
+
+    is_fused_spec = True
+
+    def __init__(
+        self,
+        model_path: str,
+        config: InferenceConfig,
+        draft_model_path: str,
+        draft_config: InferenceConfig,
+        model_family=None,
+        draft_family=None,
+    ):
+        super().__init__(model_path, config, model_family)
+        self.draft_model_path = draft_model_path
+        self.draft_config = draft_config
+        self.draft_family = draft_family or self.family
+        self.spec_len = config.tpu_config.speculation_length
+        if self.spec_len < 1:
+            raise ValueError("fused speculation requires speculation_length >= 1")
+
+    # ------------------------------------------------------------------
+    # params / cache pytrees: {"draft": ..., "target": ...}
+    # ------------------------------------------------------------------
+    def get_draft_state_dict(self):
+        return ckpt.load_state_dict(self.draft_model_path)
+
+    def build_params(self) -> Dict[str, Any]:
+        target = self.family.convert_hf_state_dict(self.get_state_dict(), self.config)
+        draft = self.draft_family.convert_hf_state_dict(
+            self.get_draft_state_dict(), self.draft_config
+        )
+        return {"draft": draft, "target": target}
+
+    def build_params_struct(self):
+        t_arch = self.family.build_arch(self.config)
+        d_arch = self.draft_family.build_arch(self.draft_config)
+        return {
+            "draft": params_shape_struct(self.draft_family, self.draft_config, d_arch),
+            "target": params_shape_struct(self.family, self.config, t_arch),
+        }
+
+    def param_specs(self):
+        return {
+            "draft": self.draft_family.param_specs(self.draft_config),
+            "target": self.family.param_specs(self.config),
+        }
+
+    def cache_partition_specs(self):
+        return {"draft": kv_cache_partition_spec(), "target": kv_cache_partition_spec()}
+
+    def init_cache_host(self):
+        return {
+            "draft": init_kv_cache(self._cache_spec(self.draft_family, self.draft_config)),
+            "target": init_kv_cache(self._cache_spec()),
+        }
+
+    def _cache_struct(self):
+        import jax
+
+        out = {}
+        for name, family, config in (
+            ("draft", self.draft_family, self.draft_config),
+            ("target", self.family, self.config),
+        ):
+            spec = self._cache_spec(family, config)
+            z = jax.ShapeDtypeStruct(spec.shape, spec.store_dtype)
+            out[name] = {"k": z, "v": z}
+        return out
+
+    # ------------------------------------------------------------------
+    # submodels (reference: model_base.py:3161 enable_context_encoding,
+    # :3132 enable_fused_spec)
+    # ------------------------------------------------------------------
+    def enable_models(self) -> None:
+        t_arch = self.family.build_arch(self.config)
+        d_arch = self.draft_family.build_arch(self.draft_config)
+        t_inv = self.family.build_inv_freq(self.config)
+        d_inv = self.draft_family.build_inv_freq(self.draft_config)
+        tc = self.tpu_config
+
+        common = dict(
+            draft_arch=d_arch,
+            draft_inv_freq=d_inv,
+            spec_len=self.spec_len,
+        )
+        self.models[TAG_CONTEXT_ENCODING] = FusedSpecWrapper(
+            TAG_CONTEXT_ENCODING,
+            self.config,
+            t_arch,
+            t_inv,
+            batch_size=tc.ctx_batch_size,
+            n_active_tokens=0,
+            buckets=autobucketing.context_encoding_buckets(self.config),
+            attend_to_cache=False,
+            forward_kwargs={},
+            **common,
+        )
+        self.models[TAG_FUSED_SPECULATION] = FusedSpecWrapper(
+            TAG_FUSED_SPECULATION,
+            self.config,
+            t_arch,
+            t_inv,
+            batch_size=tc.tkg_batch_size,
+            n_active_tokens=1,
+            buckets=autobucketing.token_generation_buckets(self.config),
+            attend_to_cache=True,
+            forward_kwargs={},
+            **common,
+        )
+
+    # -- dispatch (reference: model_base.py:3689 fused-spec branch) --
+    def forward(self, input_ids, position_ids, **kwargs):
+        if not self.is_loaded:
+            raise RuntimeError("call load() before forward()")
+        is_prefill = input_ids.shape[1] > 1
+        tag = TAG_CONTEXT_ENCODING if is_prefill else TAG_FUSED_SPECULATION
+        batch = {"input_ids": input_ids, "position_ids": position_ids, **kwargs}
+        outputs, self.kv_cache = self.models[tag].forward(self.params, self.kv_cache, batch)
+        return outputs
+
+    @property
+    def async_supported(self) -> bool:
+        return False

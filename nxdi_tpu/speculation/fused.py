@@ -1,0 +1,211 @@
+"""Fused speculative decoding — draft + target compiled as ONE graph.
+
+The analog of the reference's ``NeuronFusedSpecModel`` (models/model_base.py:1653):
+its token-gen forward runs the draft loop, the target verify pass, and the
+rejection/acceptance logic all inside one compiled program (:1866
+``_token_gen_forward``), so the host sees one dispatch per *speculation window*
+rather than per token.
+
+TPU-native shape of the same idea:
+
+- the draft loop is a ``lax.scan`` over ``spec_len + 1`` single-token draft
+  forwards (the reference Python-unrolls ``for i in range(spec_len)`` inside the
+  traced graph, model_base.py:1893-1968 — scan gives one compiled body);
+- the target verifies all ``spec_len + 1`` candidate positions in one
+  multi-token forward (same as the reference's single target call);
+- acceptance = greedy token matching with a ``cumprod`` prefix mask — the
+  fixed-shape masked equivalent of the reference's ``_speculative_token_selection``
+  (model_base.py:1773);
+- **no KV fix-up pass is needed** (the reference gathers/scatters rejected KV,
+  :2020-2100): our caches scatter new K/V at exact positions *before* any read
+  (kvcache/kv_cache.py), so a later window simply overwrites the garbage a
+  rejected draft left behind, and causal masks hide it until then. The one
+  subtlety: the draft scan runs ``spec_len + 1`` steps (not ``spec_len``) so the
+  *last* drafted token's KV is written too — without it, a fully-accepted window
+  would leave a KV hole at its final position.
+
+Greedy acceptance note: emitted tokens are the TARGET's greedy tokens at every
+position, so fused-spec output is bit-identical to target-only greedy decoding
+regardless of draft quality — drafts only change how many tokens each dispatch
+retires. This matches the reference's greedy path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.runtime.model_wrapper import ModelWrapper
+
+
+def fused_spec_context_encoding(
+    draft_arch,
+    target_arch,
+    draft_inv_freq,
+    target_inv_freq,
+    params: Dict[str, Any],  # {"draft": ..., "target": ...}
+    cache: Dict[str, Any],  # {"draft": ..., "target": ...}
+    batch: Dict[str, jax.Array],
+    **sampling_kwargs,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """Draft CTE + target CTE back-to-back in one program (reference:
+    model_base.py:1804 ``_context_encoding_forward``). Returns the target's
+    sampled first token; both caches come back filled with the prompt."""
+    t_out, t_cache = causal_lm_forward(
+        target_arch,
+        target_inv_freq,
+        params["target"],
+        cache["target"],
+        batch,
+        attend_to_cache=False,
+        gather_last_token=True,
+        on_device_sampling=True,
+        **sampling_kwargs,
+    )
+    _, d_cache = causal_lm_forward(
+        draft_arch,
+        draft_inv_freq,
+        params["draft"],
+        cache["draft"],
+        batch,
+        attend_to_cache=False,
+        gather_last_token=True,
+        on_device_sampling=True,
+        **sampling_kwargs,
+    )
+    outputs = {"tokens": t_out["tokens"]}
+    # uniform output contract with the TKG path: CTE retires exactly one token
+    outputs["counts"] = jnp.ones((batch["input_ids"].shape[0],), jnp.int32)
+    return outputs, {"draft": d_cache, "target": t_cache}
+
+
+def fused_spec_token_gen(
+    draft_arch,
+    target_arch,
+    draft_inv_freq,
+    target_inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    *,
+    spec_len: int,
+    kv_window: int,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """One speculation window (reference: model_base.py:1866 ``_token_gen_forward``).
+
+    ``batch``: input_ids (B, 1) = last accepted token, position_ids (B, 1) its
+    position. Returns tokens (B, spec_len+1) — the target's greedy token at
+    every candidate position — and counts (B,) = accepted+bonus token count;
+    the host consumes ``tokens[b, :counts[b]]``.
+    """
+    B = batch["input_ids"].shape[0]
+    tok0 = batch["input_ids"].astype(jnp.int32)  # (B, 1)
+    pos0 = batch["position_ids"].astype(jnp.int32)  # (B, 1)
+    lti = jnp.zeros((B,), jnp.int32)
+    sp = batch["sampling_params"]
+
+    # -- draft loop: spec_len+1 greedy single-token steps (see module docstring
+    # for why the extra step). ys collect each step's INPUT token, so the
+    # stacked ys are exactly the candidate tokens [t_cur, d_1, ..., d_k].
+    def draft_step(carry, _):
+        tok, pos, dcache = carry
+        dbatch = {
+            "input_ids": tok,
+            "position_ids": pos,
+            "last_token_index": lti,
+            "sampling_params": sp,
+        }
+        out, dcache = causal_lm_forward(
+            draft_arch,
+            draft_inv_freq,
+            params["draft"],
+            dcache,
+            dbatch,
+            attend_to_cache=True,
+            kv_window=kv_window,
+            gather_last_token=False,
+            on_device_sampling=True,
+        )
+        nxt = out["tokens"].astype(jnp.int32)  # (B, 1) greedy draft token
+        return (nxt, pos + 1, dcache), tok
+
+    (_, _, d_cache), fed = jax.lax.scan(
+        draft_step, (tok0, pos0, cache["draft"]), None, length=spec_len + 1
+    )
+    candidates = jnp.swapaxes(fed[:, :, 0], 0, 1)  # (B, spec_len+1)
+
+    # -- target verify: one multi-token forward over the candidates
+    positions = pos0 + jnp.arange(spec_len + 1, dtype=jnp.int32)[None, :]
+    tbatch = {
+        "input_ids": candidates,
+        "position_ids": positions,
+        "last_token_index": lti,
+        "sampling_params": sp,
+    }
+    t_out, t_cache = causal_lm_forward(
+        target_arch,
+        target_inv_freq,
+        params["target"],
+        cache["target"],
+        tbatch,
+        attend_to_cache=True,
+        kv_window=kv_window,
+        gather_last_token=False,
+        output_all_logits=True,
+        on_device_sampling=False,
+    )
+    target_tokens = jnp.argmax(t_out["logits"], axis=-1).astype(jnp.int32)  # (B, k+1)
+
+    # -- acceptance: longest prefix of drafts matching the target's greedy
+    # choice (reference: _speculative_token_selection model_base.py:1773)
+    drafted = candidates[:, 1:]  # d_1..d_k
+    matches = (drafted == target_tokens[:, :-1]).astype(jnp.int32)
+    accepted = jnp.cumprod(matches, axis=1)  # prefix mask
+    counts = jnp.sum(accepted, axis=1) + 1  # + bonus token
+
+    return {"tokens": target_tokens, "counts": counts}, {
+        "draft": d_cache,
+        "target": t_cache,
+    }
+
+
+class FusedSpecWrapper(ModelWrapper):
+    """ModelWrapper whose compiled program is the fused draft+target graph
+    (reference: the fused_speculation_model ModelWrapper, model_base.py:3132).
+
+    ``lookahead = spec_len + 1`` extends bucket selection so the window's write
+    positions (up to pos + spec_len) stay inside the compiled KV window.
+    """
+
+    def __init__(self, *args, draft_arch, draft_inv_freq, spec_len: int, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.draft_arch = draft_arch
+        self.draft_inv_freq = draft_inv_freq
+        self.spec_len = spec_len
+        if self.attend_to_cache:
+            self.lookahead = spec_len + 1
+
+    def make_forward(self, bucket: int):
+        if self.attend_to_cache:
+            return partial(
+                fused_spec_token_gen,
+                self.draft_arch,
+                self.arch,
+                self.draft_inv_freq,
+                self.inv_freq,
+                spec_len=self.spec_len,
+                kv_window=bucket,
+            )
+        return partial(
+            fused_spec_context_encoding,
+            self.draft_arch,
+            self.arch,
+            self.draft_inv_freq,
+            self.inv_freq,
+            **self.forward_kwargs,
+        )

@@ -1,0 +1,202 @@
+"""Fused speculative decoding correctness (reference analog: fused-spec
+integration tests; model_base.py:1653 NeuronFusedSpecModel).
+
+The load-bearing property: with greedy acceptance, fused-spec output is
+bit-identical to target-only greedy decoding for ANY draft — good drafts only
+make it faster. So we check token-matching vs HF CPU greedy with (a) a weak
+random draft and (b) a perfect draft (= the target), and that the perfect
+draft accepts full windows."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, SpeculationConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.speculation import FusedSpecCausalLM
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+
+def _tiny_hf_llama(seed, layers=4):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    cfg = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    return LlamaForCausalLM(cfg).eval(), cfg
+
+
+def _build_fused_app(target, target_cfg, draft, draft_cfg, spec_len, tp_degree=1):
+    t_sd = {k: v.detach().numpy() for k, v in target.state_dict().items()}
+    d_sd = {k: v.detach().numpy() for k, v in draft.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=tp_degree,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        speculation_config=SpeculationConfig(
+            speculation_length=spec_len, enable_fused_speculation=True
+        ),
+        skip_warmup=True,
+    )
+    dcfg_t = TpuConfig(
+        tp_degree=tp_degree,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
+    dcfg = llama.LlamaInferenceConfig(dcfg_t, load_config=lambda: draft_cfg.to_dict())
+
+    class App(FusedSpecCausalLM):
+        def get_state_dict(self):
+            return t_sd
+
+        def get_draft_state_dict(self):
+            return d_sd
+
+    app = App("<target>", cfg, "<draft>", dcfg, model_family=llama, draft_family=llama)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("spec_len", [2, 4])
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_fused_spec_matches_hf_greedy_weak_draft(spec_len, tp_degree):
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)  # different weights
+    app = _build_fused_app(target, target_cfg, draft, draft_cfg, spec_len, tp_degree)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_fused_spec_perfect_draft_accepts_full_windows():
+    spec_len = 4
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    app = _build_fused_app(target, target_cfg, target, target_cfg, spec_len)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=16)
+    actual = adapter.generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
+
+    # draft == target: every window must accept all drafts (counts == k+1)
+    app.reset_kv_cache()
+    B, S = prompt.shape
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(prompt.astype(np.int32), pos, last_token_index=np.array([S - 1], np.int32))
+    t0 = np.asarray(out["tokens"])[:, 0].astype(np.int32)
+    out = app.forward(t0[:, None], np.array([[S]], np.int32))
+    counts = np.asarray(out["counts"])
+    assert counts[0] == spec_len + 1, counts
+
+
+def test_fused_spec_fills_cache_to_last_slot():
+    """Generating right up to seq_len must not truncate: overshooting window
+    writes are dropped in-graph and their tokens discarded host-side, but every
+    position < seq_len still gets its token."""
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_fused_app(target, target_cfg, draft, draft_cfg, spec_len=4)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=56)  # fills seq_len=64
+    actual = adapter.generate(prompt, max_new_tokens=56)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_fused_spec_batch_and_eos():
+    """Rows retiring at different rates + EOS mid-window must match HF."""
+    spec_len = 3
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=2, layers=2)
+    t_sd = {k: v.detach().numpy() for k, v in target.state_dict().items()}
+    d_sd = {k: v.detach().numpy() for k, v in draft.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        speculation_config=SpeculationConfig(
+            speculation_length=spec_len, enable_fused_speculation=True
+        ),
+        skip_warmup=True,
+    )
+    dtc = TpuConfig(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
+    dcfg = llama.LlamaInferenceConfig(dtc, load_config=lambda: draft_cfg.to_dict())
+
+    class App(FusedSpecCausalLM):
+        def get_state_dict(self):
+            return t_sd
+
+        def get_draft_state_dict(self):
+            return d_sd
+
+    app = App("<t>", cfg, "<d>", dcfg, model_family=llama, draft_family=llama)
+    app.load()
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    # two right-padded rows: each must match its own unbatched HF greedy run
+    p0 = [5, 9, 3, 17, 2, 8, 11, 42]
+    p1 = [7, 13, 21, 4]
+    prompt = np.zeros((2, 8), dtype=np.int64)
+    prompt[0] = p0
+    prompt[1, :4] = p1
+    mask = (prompt != 0).astype(np.int32)
+    out = adapter.generate(prompt, attention_mask=mask, max_new_tokens=12)
+    e0 = hf_greedy(target, np.array([p0]), 12)
+    e1 = hf_greedy(target, np.array([p1]), 12)
+    np.testing.assert_array_equal(out[0, : e0.shape[1]], e0[0])
+    np.testing.assert_array_equal(out[1, 4:16], e1[0, 4:])
+
+    # EOS mid-window: pick a token the greedy continuation is known to emit a
+    # few steps in; generation must stop there (pad after), matching HF
+    eos = int(e0[0, len(p0) + 3])
+    out_eos = adapter.generate(
+        np.array([p0], dtype=np.int64), max_new_tokens=12, eos_token_id=eos, pad_token_id=0
+    )
+    import torch
+
+    with torch.no_grad():
+        e_eos = target.generate(
+            torch.tensor([p0]), max_new_tokens=12, do_sample=False,
+            eos_token_id=eos, pad_token_id=0,
+        ).numpy()
+    np.testing.assert_array_equal(out_eos[0, : e_eos.shape[1]], e_eos[0])
+    assert eos in out_eos[0]
+    # nothing but pad after the EOS position
+    eos_idx = int(np.where(out_eos[0] == eos)[0][0])
+    assert (out_eos[0, eos_idx + 1 :] == 0).all()
