@@ -301,10 +301,30 @@ class TpuConfig:
         if self.cp_degree > 1 and self.tp_degree % self.cp_degree != 0:
             raise ValueError("cp_degree must divide tp_degree (CP splits the TP world)")
         if self.attention_dp_degree > 1:
-            if self.tp_degree % self.attention_dp_degree != 0:
-                raise ValueError("attention_dp_degree must divide tp_degree")
+            if self.tp_degree % (self.attention_dp_degree * self.cp_degree) != 0:
+                raise ValueError(
+                    "attention_dp_degree * cp_degree must divide tp_degree "
+                    "(both carve sub-axes out of the TP world)"
+                )
             if self.tkg_batch_size % self.attention_dp_degree != 0:
                 raise ValueError("tkg_batch_size must be divisible by attention_dp_degree")
+        if self.flash_decoding_enabled:
+            if self.attention_dp_degree > 1:
+                raise ValueError(
+                    "flash_decoding_enabled and attention_dp_degree > 1 are "
+                    "mutually exclusive: both claim the decode KV cache layout"
+                )
+            if self.cp_degree <= 1:
+                raise ValueError(
+                    "flash_decoding_enabled shards the KV cache sequence dim over "
+                    "the cp mesh axis; set cp_degree > 1"
+                )
+            if self.enable_bucketing or self.token_generation_buckets:
+                raise ValueError(
+                    "flash decoding requires a single token-generation bucket: "
+                    "the cache sequence dim is sharded and cannot be re-windowed "
+                    "per bucket"
+                )
         if self.is_medusa and self.num_medusa_heads <= 0:
             raise ValueError("is_medusa requires num_medusa_heads > 0")
         if self.speculation_length < 0:

@@ -249,6 +249,12 @@ class HuggingFaceGenerationAdapter:
         )
         cur_tok = np.array(first_tokens, dtype=np.int32)
         cur_pos = lengths.astype(np.int32).copy()  # position of cur_tok
+        # the device drops KV writes beyond the largest compiled TKG bucket,
+        # not just beyond seq_len — bound retired tokens by both
+        window_limit = min(
+            self.tpu_config.seq_len,
+            *(w.buckets[-1] for w in self.app.models.values() if w.attend_to_cache),
+        )
 
         while not finished.all():
             outputs = self.app.forward(
@@ -263,9 +269,9 @@ class HuggingFaceGenerationAdapter:
                 if finished[b]:
                     continue
                 # token j sits at position cur_pos+1+j; tokens at positions
-                # >= seq_len were computed against dropped KV writes — discard
-                # them (a row can still fill the cache to the last slot)
-                c = min(int(cnts[b]), self.tpu_config.seq_len - 1 - int(cur_pos[b]))
+                # >= the compiled window were computed against dropped KV
+                # writes — discard them (a row can still fill to the last slot)
+                c = min(int(cnts[b]), window_limit - 1 - int(cur_pos[b]))
                 if c <= 0:
                     finished[b] = True
                     continue

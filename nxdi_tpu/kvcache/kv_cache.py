@@ -72,10 +72,16 @@ def init_kv_cache(spec: KVCacheSpec) -> Dict[str, jax.Array]:
     }
 
 
-def kv_cache_partition_spec() -> Dict[str, P]:
-    """Cache sharded over kv heads on the tp axis (layers/batch/seq replicated);
-    the analog of per-rank ``kv_heads/rank`` slices in the reference."""
-    spec = P(None, None, AXIS_TP, None, None)
+def kv_cache_partition_spec(tpu_config=None) -> Dict[str, P]:
+    """Cache sharded over kv heads on the tp axis; with attention-DP the batch
+    dim also shards over dp, with flash decoding the sequence dim shards over
+    cp (parallel/policy.py maps the reference's DP/flash-decode KV managers)."""
+    if tpu_config is not None:
+        from nxdi_tpu.parallel.policy import kv_cache_partition_spec_for
+
+        spec = kv_cache_partition_spec_for(tpu_config)
+    else:
+        spec = P(None, None, AXIS_TP, None, None)
     return {"k": spec, "v": spec}
 
 

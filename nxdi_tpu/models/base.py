@@ -46,6 +46,7 @@ from nxdi_tpu.parallel.layers import (
     constrain,
 )
 from nxdi_tpu.parallel.mesh import AXIS_TP
+from nxdi_tpu.parallel.policy import DEFAULT_POLICY, ShardingPolicy
 
 ACT_FNS: Dict[str, Callable] = {
     "silu": jax.nn.silu,
@@ -184,6 +185,7 @@ def attention_block(
     position_ids: jax.Array,  # (B, S)
     cache_spec: KVCacheSpec,
     attend_to_cache: bool,
+    policy: ShardingPolicy = DEFAULT_POLICY,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -207,9 +209,9 @@ def attention_block(
     k = jnp.swapaxes(k, 1, 2)  # (B, KV, S, D)
     v = jnp.swapaxes(v, 1, 2)
 
-    q = constrain(q, P(None, AXIS_TP, None, None))
-    k = constrain(k, P(None, AXIS_TP, None, None))
-    v = constrain(v, P(None, AXIS_TP, None, None))
+    q = constrain(q, policy.q)
+    k = constrain(k, policy.kv)
+    v = constrain(v, policy.kv)
 
     q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
@@ -219,6 +221,8 @@ def attention_block(
 
     if attend_to_cache:
         kk, vv = read_layer_cache(new_k, new_v, cache_spec)
+        kk = constrain(kk, policy.cache_kv)
+        vv = constrain(vv, policy.cache_kv)
         window = kk.shape[2]
         kv_pos = jnp.broadcast_to(jnp.arange(window, dtype=position_ids.dtype)[None, :], (B, window))
         ctx = attn_ops.attention_with_positions(
@@ -261,11 +265,12 @@ def decoder_layer(
     position_ids: jax.Array,
     cache_spec: KVCacheSpec,
     attend_to_cache: bool,
+    policy: ShardingPolicy = DEFAULT_POLICY,
 ):
     h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
     attn_out, (nk, nv) = attention_block(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
-        position_ids, cache_spec, attend_to_cache,
+        position_ids, cache_spec, attend_to_cache, policy,
     )
     hidden = hidden + attn_out
     h = rms_norm(hidden, lp["post_attention_layernorm"], arch.rms_norm_eps)
@@ -273,6 +278,7 @@ def decoder_layer(
         hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
     else:
         hidden = hidden + mlp_block(arch, lp["mlp"], h)
+    hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
 
@@ -287,6 +293,7 @@ def run_decoder_layers(
     cache_spec: KVCacheSpec,
     attend_to_cache: bool,
     kv_window: Optional[int] = None,
+    policy: ShardingPolicy = DEFAULT_POLICY,
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
 
@@ -300,13 +307,15 @@ def run_decoder_layers(
         if kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
-                arch, lp, h, cos, sin, k_win, v_win, position_ids, cache_spec, attend_to_cache
+                arch, lp, h, cos, sin, k_win, v_win, position_ids, cache_spec,
+                attend_to_cache, policy,
             )
             nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
             nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
         else:
             h, (nk, nv) = decoder_layer(
-                arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec, attend_to_cache
+                arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec,
+                attend_to_cache, policy,
             )
         return h, (nk, nv)
 
@@ -327,6 +336,7 @@ def causal_lm_forward(
     *,
     attend_to_cache: bool,
     kv_window: Optional[int] = None,
+    policy: ShardingPolicy = DEFAULT_POLICY,
     gather_last_token: bool = True,
     output_logits: bool = False,
     output_all_logits: bool = False,
@@ -349,12 +359,14 @@ def causal_lm_forward(
     compute_dtype = to_jax_dtype(arch.dtype)
 
     hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    hidden = constrain(hidden, policy.hidden)
     cos, sin = rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32)
 
     cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
     hidden, new_cache = run_decoder_layers(
         arch, params["layers"], hidden, cos, sin, cache,
         position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+        policy=policy,
     )
     hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
 
@@ -369,7 +381,7 @@ def causal_lm_forward(
         )  # (B, 1, hidden)
 
     logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
-    logits = constrain(logits, P(None, None, AXIS_TP))
+    logits = constrain(logits, policy.logits)
     logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
 
     outputs: Dict[str, jax.Array] = {}

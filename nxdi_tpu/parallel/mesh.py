@@ -42,14 +42,17 @@ def build_mesh(
 ) -> Mesh:
     """Build a ``Mesh`` with axes (dp, cp, tp).
 
-    ``cp`` splits the TP world the way the reference's CP process groups do
-    (attention_process_groups.py:47 ``get_tp_cp_group_mesh``): the attention TP
-    degree during prefill becomes tp/cp while Q sequence is sharded over cp.
-    We therefore build the mesh as (dp, cp, tp/cp) so dp*cp*(tp/cp) == device count.
+    ``cp`` and ``dp`` split the TP world the way the reference's CP/DP process
+    groups do (attention_process_groups.py:47 ``get_tp_cp_group_mesh``, :125
+    DP groups): ``tp_degree`` is the WORLD size, and the inner tensor-parallel
+    axis is tp/(dp*cp), so dp*cp*(tp/(dp*cp)) == device count == tp_degree.
     """
-    if tp_degree % cp_degree != 0:
-        raise ValueError(f"cp_degree {cp_degree} must divide tp_degree {tp_degree}")
-    inner_tp = tp_degree // cp_degree
+    if tp_degree % (cp_degree * dp_degree) != 0:
+        raise ValueError(
+            f"cp_degree*dp_degree ({cp_degree}*{dp_degree}) must divide "
+            f"tp_degree ({tp_degree})"
+        )
+    inner_tp = tp_degree // (cp_degree * dp_degree)
     n = dp_degree * cp_degree * inner_tp
     if devices is None:
         devices = jax.devices()
@@ -71,15 +74,13 @@ def build_mesh(
 
 
 def mesh_from_config(tpu_config, devices=None) -> Mesh:
-    """Mesh for a :class:`TpuConfig` (tp/cp/attention-dp degrees).
-
-    The ``dp`` mesh axis stays 1: attention-DP splits the TP world per
-    submodel (reference: attention_process_groups.py:125), which is expressed
-    through per-submodel PartitionSpecs, not extra devices.
-    """
+    """Mesh for a :class:`TpuConfig`: tp_degree is the world size; the cp and
+    attention-dp degrees carve named sub-axes out of it (reference:
+    attention_process_groups.py:81,125 building CP/DP groups over the TP
+    world). Submodels that don't use an axis simply leave it unsharded."""
     return build_mesh(
         tp_degree=tpu_config.tp_degree,
-        dp_degree=1,
+        dp_degree=tpu_config.attention_dp_degree,
         cp_degree=tpu_config.cp_degree,
         devices=devices,
     )

@@ -1,0 +1,106 @@
+"""Sharding policies — how each submodel's activations map onto the mesh.
+
+The reference implements each parallelism strategy as a separate code path
+with hand-wired collectives (SURVEY §2.3): SP gathers/scatters activations
+around attention (models/model_base.py:1332-1337), CP builds dedicated process
+groups and all-gathers KV per CP rank (modules/attention/attention_base.py:
+2324-2558, attention_process_groups.py:81), flash decoding shards the KV cache
+sequence dim inside a KV-head group with a distributed softmax
+(modules/flashdecode/utils.py, attention_base.py:1387-1418), and attention-DP
+splits decode batch across sub-groups of the TP world
+(attention_process_groups.py:125, kvcache/data_parallel_kv_cache_manager.py:8).
+
+TPU-native, every one of those is the SAME mechanism: a
+:class:`ShardingPolicy` — a small frozen set of PartitionSpecs for the
+activations flowing through ``causal_lm_forward`` — and GSPMD inserts the
+collectives the reference writes by hand:
+
+  - **SP**  = inter-layer hidden states sharded on S over ``tp`` during
+    prefill; XLA turns the row-parallel psum into reduce-scatter and
+    all-gathers in front of QKV — exactly the reference's
+    scatter_to/gather_from_sequence_parallel_region pairs.
+  - **CP**  = hidden states + Q sharded on S over the ``cp`` axis while K/V are
+    constrained cp-replicated, which lowers to the all-gather-KV-within-
+    CP-group pattern of the reference's CP attention.
+  - **Flash decoding** = the KV *cache* sequence dim sharded over ``cp``;
+    decode attention scores inherit the sharding and XLA partitions the
+    softmax+weighted-sum as a distributed reduction over cache shards.
+  - **Attention-DP** = decode batch dim sharded over ``dp``; each dp group
+    holds batch/dp KV lines (the DataParallelKVCacheManager layout).
+
+Policies are static (hashable) and closed over by the jitted programs, one per
+submodel — mirroring how the reference compiles CTE and TKG with different
+process-group wiring.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_TP
+
+
+@dataclass(frozen=True)
+class ShardingPolicy:
+    """PartitionSpecs for the tensors flowing through one submodel forward.
+
+    Dims: hidden (B, S, H) — q/kv (B, heads, S, D) — cache_kv = the windowed
+    cache view read during decode (B, KV_heads, W, D) — logits (B, S, V).
+    """
+
+    hidden: P = P()
+    q: P = P(None, AXIS_TP, None, None)
+    kv: P = P(None, AXIS_TP, None, None)
+    cache_kv: P = P(None, AXIS_TP, None, None)
+    logits: P = P(None, None, AXIS_TP)
+
+
+DEFAULT_POLICY = ShardingPolicy()
+
+
+def context_encoding_policy(tc) -> ShardingPolicy:
+    """Prefill policy from the config's parallel degrees (reference analog:
+    the CTE-side config cross-checks in models/config.py:362-390)."""
+    if tc.cp_degree > 1:
+        # CP: S over cp for activations and Q; KV cp-replicated (all-gather)
+        return ShardingPolicy(
+            hidden=P(None, AXIS_CP, None),
+            q=P(None, AXIS_TP, AXIS_CP, None),
+            kv=P(None, AXIS_TP, None, None),
+        )
+    if tc.sequence_parallel_enabled:
+        # SP: inter-layer activations S-sharded over tp; attention runs with
+        # full heads per rank (GSPMD re-shards at the QKV boundary)
+        return ShardingPolicy(hidden=P(None, AXIS_TP, None))
+    return DEFAULT_POLICY
+
+
+def token_generation_policy(tc) -> ShardingPolicy:
+    """Decode policy. SP/CP never apply to single-token decode (the reference
+    disables SP for TKG too, model_base.py:3146-3148)."""
+    if tc.attention_dp_degree > 1:
+        return ShardingPolicy(
+            hidden=P(AXIS_DP, None, None),
+            q=P(AXIS_DP, AXIS_TP, None, None),
+            kv=P(AXIS_DP, AXIS_TP, None, None),
+            cache_kv=P(AXIS_DP, AXIS_TP, None, None),
+            logits=P(AXIS_DP, None, AXIS_TP),
+        )
+    if tc.flash_decoding_enabled:
+        # KV-S sharding: cache (and its windowed read) S-sharded over cp;
+        # scores (B,H,1,W) inherit the W sharding -> distributed softmax
+        return ShardingPolicy(cache_kv=P(None, AXIS_TP, AXIS_CP, None))
+    return DEFAULT_POLICY
+
+
+def kv_cache_partition_spec_for(tc) -> P:
+    """Cache layout (L, B, KV_heads, S, D) matching the decode policy
+    (reference analogs: DataParallelKVCacheManager batch split, flashdecode
+    get_cache_size S split)."""
+    if tc.attention_dp_degree > 1:
+        return P(None, AXIS_DP, AXIS_TP, None, None)
+    if tc.flash_decoding_enabled:
+        return P(None, None, AXIS_TP, AXIS_CP, None)
+    return P(None, None, AXIS_TP, None, None)

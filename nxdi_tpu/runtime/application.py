@@ -84,7 +84,7 @@ class ApplicationBase:
         return self.family.param_specs(self.config)
 
     def cache_partition_specs(self):
-        return kv_cache_partition_spec()
+        return kv_cache_partition_spec(self.tpu_config)
 
     def init_cache_host(self):
         return init_kv_cache(self._cache_spec())

@@ -84,6 +84,22 @@ class ModelWrapper:
                 bucket, mesh, param_shardings, cache_shardings
             )
 
+    @property
+    def policy(self):
+        """Sharding policy for this submodel's activations (parallel/policy.py:
+        SP/CP for prefill, attention-DP/flash-decoding for decode)."""
+        from nxdi_tpu.parallel.policy import (
+            context_encoding_policy,
+            token_generation_policy,
+        )
+
+        tc = self.config.tpu_config
+        return (
+            token_generation_policy(tc)
+            if self.attend_to_cache
+            else context_encoding_policy(tc)
+        )
+
     def make_forward(self, bucket: int):
         """The pure (params, cache, batch) -> (outputs, cache) function this
         bucket compiles. Subclasses (fused speculation, ...) override."""
@@ -93,6 +109,7 @@ class ModelWrapper:
         else:
             # context encoding: bucket IS the padded input length
             kwargs = dict(attend_to_cache=False, kv_window=None)
+        kwargs["policy"] = self.policy
         kwargs.update(self.forward_kwargs)
         return partial(self.forward_fn, self.arch, self.inv_freq, **kwargs)
 

@@ -74,7 +74,10 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
         }
 
     def cache_partition_specs(self):
-        return {"draft": kv_cache_partition_spec(), "target": kv_cache_partition_spec()}
+        return {
+            "draft": kv_cache_partition_spec(self.tpu_config),
+            "target": kv_cache_partition_spec(self.tpu_config),
+        }
 
     def init_cache_host(self):
         return {

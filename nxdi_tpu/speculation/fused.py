@@ -37,9 +37,9 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
 
 from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.parallel.policy import DEFAULT_POLICY
 from nxdi_tpu.runtime.model_wrapper import ModelWrapper
 
 
@@ -51,6 +51,7 @@ def fused_spec_context_encoding(
     params: Dict[str, Any],  # {"draft": ..., "target": ...}
     cache: Dict[str, Any],  # {"draft": ..., "target": ...}
     batch: Dict[str, jax.Array],
+    policy=DEFAULT_POLICY,
     **sampling_kwargs,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """Draft CTE + target CTE back-to-back in one program (reference:
@@ -63,6 +64,7 @@ def fused_spec_context_encoding(
         cache["target"],
         batch,
         attend_to_cache=False,
+        policy=policy,
         gather_last_token=True,
         on_device_sampling=True,
         **sampling_kwargs,
@@ -74,6 +76,7 @@ def fused_spec_context_encoding(
         cache["draft"],
         batch,
         attend_to_cache=False,
+        policy=policy,
         gather_last_token=True,
         on_device_sampling=True,
         **sampling_kwargs,
@@ -95,6 +98,7 @@ def fused_spec_token_gen(
     *,
     spec_len: int,
     kv_window: int,
+    policy=DEFAULT_POLICY,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """One speculation window (reference: model_base.py:1866 ``_token_gen_forward``).
 
@@ -128,6 +132,7 @@ def fused_spec_token_gen(
             dbatch,
             attend_to_cache=True,
             kv_window=kv_window,
+            policy=policy,
             gather_last_token=False,
             on_device_sampling=True,
         )
@@ -155,6 +160,7 @@ def fused_spec_token_gen(
         tbatch,
         attend_to_cache=True,
         kv_window=kv_window,
+        policy=policy,
         gather_last_token=False,
         output_all_logits=True,
         on_device_sampling=False,
@@ -200,6 +206,7 @@ class FusedSpecWrapper(ModelWrapper):
                 self.inv_freq,
                 spec_len=self.spec_len,
                 kv_window=bucket,
+                policy=self.policy,
             )
         return partial(
             fused_spec_context_encoding,
@@ -207,5 +214,6 @@ class FusedSpecWrapper(ModelWrapper):
             self.arch,
             self.draft_inv_freq,
             self.inv_freq,
+            policy=self.policy,
             **self.forward_kwargs,
         )

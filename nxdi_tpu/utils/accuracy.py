@@ -110,11 +110,15 @@ def _get_logit_probe(app):
 
     from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
     from nxdi_tpu.parallel.layers import shard_pytree, sharding_tree
+    from nxdi_tpu.runtime.model_wrapper import ModelWrapper
 
     wrapper = app.models["context_encoding_model"]
     fkw = dict(wrapper.forward_kwargs)
     fkw.update(output_all_logits=True, output_logits=True)
-    probe = type(wrapper)(
+    # always a plain ModelWrapper probing the TARGET model — for fused-spec
+    # apps logit matching is defined on the target (the draft never changes
+    # greedy outputs), and FusedSpecWrapper's graph has a different signature
+    probe = ModelWrapper(
         wrapper.tag + "_logit_probe",
         wrapper.config,
         wrapper.arch,
@@ -128,10 +132,10 @@ def _get_logit_probe(app):
     probe.build(
         app.mesh,
         sharding_tree(app.family.param_specs(app.config), app.mesh),
-        sharding_tree(kv_cache_partition_spec(), app.mesh),
+        sharding_tree(kv_cache_partition_spec(app.tpu_config), app.mesh),
     )
     cache = shard_pytree(
-        init_kv_cache(app._cache_spec()), kv_cache_partition_spec(), app.mesh
+        init_kv_cache(app._cache_spec()), kv_cache_partition_spec(app.tpu_config), app.mesh
     )
     app._logit_probe = (probe, cache)
     return app._logit_probe
@@ -161,8 +165,9 @@ def check_accuracy_logits(
     B, S = input_ids.shape
     position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
     probe, cache = _get_logit_probe(app)
+    params = app.params["target"] if getattr(app, "is_fused_spec", False) else app.params
     outputs, _ = probe.forward(
-        app.params,
+        params,
         cache,
         {
             "input_ids": input_ids.astype(np.int32),

@@ -37,30 +37,28 @@ def _tiny_hf_llama(seed, layers=4):
     return LlamaForCausalLM(cfg).eval(), cfg
 
 
-def _build_fused_app(target, target_cfg, draft, draft_cfg, spec_len, tp_degree=1):
+def _build_fused_app(
+    target, target_cfg, draft, draft_cfg, spec_len, tp_degree=1, batch_size=1, **extra
+):
     t_sd = {k: v.detach().numpy() for k, v in target.state_dict().items()}
     d_sd = {k: v.detach().numpy() for k, v in draft.state_dict().items()}
-    tcfg = TpuConfig(
+    common = dict(
         tp_degree=tp_degree,
         seq_len=64,
         max_context_length=32,
-        batch_size=1,
+        batch_size=batch_size,
         dtype="float32",
         on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    common.update(extra)
+    tcfg = TpuConfig(
+        **common,
         speculation_config=SpeculationConfig(
             speculation_length=spec_len, enable_fused_speculation=True
         ),
-        skip_warmup=True,
     )
-    dcfg_t = TpuConfig(
-        tp_degree=tp_degree,
-        seq_len=64,
-        max_context_length=32,
-        batch_size=1,
-        dtype="float32",
-        on_device_sampling_config=OnDeviceSamplingConfig(),
-        skip_warmup=True,
-    )
+    dcfg_t = TpuConfig(**common)
     cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
     dcfg = llama.LlamaInferenceConfig(dcfg_t, load_config=lambda: draft_cfg.to_dict())
 
@@ -127,46 +125,44 @@ def test_fused_spec_fills_cache_to_last_slot():
     np.testing.assert_array_equal(actual, expected)
 
 
+def test_fused_spec_small_tkg_bucket_window_limit():
+    """With token_generation_buckets smaller than seq_len, the host must stop
+    retiring tokens at the compiled window edge, not at seq_len — tokens past
+    it were computed against dropped KV writes."""
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_fused_app(
+        target, target_cfg, draft, draft_cfg, spec_len=4,
+        token_generation_buckets=[32],
+    )
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=24)  # fills to pos 31
+    actual = adapter.generate(prompt, max_new_tokens=24)
+    n = actual.shape[1]
+    np.testing.assert_array_equal(actual, expected[:, :n])
+    assert n >= 24  # window 32 holds prompt 8 + 24 generated
+
+
+def test_fused_spec_logit_matching_probe():
+    """check_accuracy_logits must work on a fused-spec app (probes the target)."""
+    from nxdi_tpu.utils.accuracy import check_accuracy_logits
+
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_fused_app(target, target_cfg, draft, draft_cfg, spec_len=2)
+    ids = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    errs = check_accuracy_logits(app, ids, hf_model=target, divergence_difference_tol=0.01)
+    assert max(errs.values()) < 0.01
+
+
 def test_fused_spec_batch_and_eos():
     """Rows retiring at different rates + EOS mid-window must match HF."""
-    spec_len = 3
     target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
     draft, draft_cfg = _tiny_hf_llama(seed=2, layers=2)
-    t_sd = {k: v.detach().numpy() for k, v in target.state_dict().items()}
-    d_sd = {k: v.detach().numpy() for k, v in draft.state_dict().items()}
-    tcfg = TpuConfig(
-        tp_degree=1,
-        seq_len=64,
-        max_context_length=32,
-        batch_size=2,
-        dtype="float32",
-        on_device_sampling_config=OnDeviceSamplingConfig(),
-        speculation_config=SpeculationConfig(
-            speculation_length=spec_len, enable_fused_speculation=True
-        ),
-        skip_warmup=True,
+    app = _build_fused_app(
+        target, target_cfg, draft, draft_cfg, spec_len=3, batch_size=2
     )
-    dtc = TpuConfig(
-        tp_degree=1,
-        seq_len=64,
-        max_context_length=32,
-        batch_size=2,
-        dtype="float32",
-        on_device_sampling_config=OnDeviceSamplingConfig(),
-        skip_warmup=True,
-    )
-    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
-    dcfg = llama.LlamaInferenceConfig(dtc, load_config=lambda: draft_cfg.to_dict())
-
-    class App(FusedSpecCausalLM):
-        def get_state_dict(self):
-            return t_sd
-
-        def get_draft_state_dict(self):
-            return d_sd
-
-    app = App("<t>", cfg, "<d>", dcfg, model_family=llama, draft_family=llama)
-    app.load()
     adapter = HuggingFaceGenerationAdapter(app)
 
     # two right-padded rows: each must match its own unbatched HF greedy run
