@@ -85,43 +85,146 @@ def kv_cache_partition_spec(tpu_config=None) -> Dict[str, P]:
     return {"k": spec, "v": spec}
 
 
-def update_layer_cache(
-    k_cache_l: jax.Array,  # (B, KV, S_max, D)
-    v_cache_l: jax.Array,
-    k_new: jax.Array,  # (B, KV, S_act, D)
-    v_new: jax.Array,
-    position_ids: jax.Array,  # (B, S_act) int32; exact write positions
-    spec: KVCacheSpec,
-) -> Tuple[jax.Array, jax.Array]:
-    """Scatter new K/V at their positions (reference: kv_cache_manager.py:374
-    ``update_cache`` scatter semantics).
+@dataclass(frozen=True)
+class BlockKVCacheSpec:
+    """Paged layout: a flat pool of ``num_blocks * block_size`` token slots per
+    layer (reference: modules/kvcache/block_kv_cache_manager.py:11 — vLLM-style
+    ``(num_blocks, block_size, heads, dim)``; we keep slots flat so scatter and
+    block-table gather are single-index ops)."""
 
-    Uses advanced-index scatter, which XLA lowers to an in-place scatter on the
-    donated buffer. Positions are clamped into range; callers mask invalid lanes
-    by pointing them at a position that will be overwritten (or via seq masks).
-    """
-    B, KV, S_act, D = k_new.shape
-    # Out-of-range positions (padding lanes) are dropped by the scatter mode;
-    # negatives would wrap like numpy indexing, so remap them out of bounds.
-    pos = jnp.where(position_ids < 0, k_cache_l.shape[2], position_ids)  # (B, S_act)
-    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]  # (B, 1)
-    store = k_cache_l.dtype
-    # (B, S_act, KV, D) values scattered at [b, pos, :, :] on a (B, S, KV, D) view:
-    # keep cache layout (B, KV, S, D) and scatter with transposed values instead.
-    k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
-    v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
-    k_cache_l = k_cache_l.at[b_idx, :, pos].set(k_vals, mode="drop")
-    v_cache_l = v_cache_l.at[b_idx, :, pos].set(v_vals, mode="drop")
-    return k_cache_l, v_cache_l
+    num_layers: int
+    num_blocks: int
+    block_size: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: str = "bfloat16"
+    quant_dtype: Optional[str] = None
+
+    @property
+    def store_dtype(self):
+        from nxdi_tpu.config import to_jax_dtype
+
+        return to_jax_dtype(self.quant_dtype or self.dtype)
+
+    @property
+    def compute_dtype(self):
+        from nxdi_tpu.config import to_jax_dtype
+
+        return to_jax_dtype(self.dtype)
+
+    @property
+    def total_slots(self) -> int:
+        return self.num_blocks * self.block_size
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return (self.num_layers, self.total_slots, self.num_kv_heads, self.head_dim)
 
 
-def read_layer_cache(
-    k_cache_l: jax.Array, v_cache_l: jax.Array, spec: KVCacheSpec
-) -> Tuple[jax.Array, jax.Array]:
-    """Full-window read, dequantizing if the cache stores a quant dtype
-    (reference: kv_cache_manager.py:349 ``get_cache``)."""
-    compute = spec.compute_dtype
-    return k_cache_l.astype(compute), v_cache_l.astype(compute)
+def init_block_kv_cache(spec: BlockKVCacheSpec) -> Dict[str, jax.Array]:
+    return {
+        "k": jnp.zeros(spec.shape, dtype=spec.store_dtype),
+        "v": jnp.zeros(spec.shape, dtype=spec.store_dtype),
+    }
+
+
+def block_kv_cache_partition_spec() -> Dict[str, P]:
+    spec = P(None, None, AXIS_TP, None)
+    return {"k": spec, "v": spec}
+
+
+# ---------------------------------------------------------------------------
+# Layout strategies — how new K/V lands in the cache and how decode reads it.
+# The static analog of the reference's KVCacheManager subclass hierarchy
+# (kv_cache_manager.py / block_kv_cache_manager.py / data_parallel_...): a
+# frozen layout object is closed over by each jitted program.
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ContiguousKVLayout:
+    """(B_cache, KV, S, D) lines addressed by (seq_id, position).
+
+    ``route_by_seq_id=True`` is continuous batching (reference:
+    is_continuous_batching config + seq_ids plumbed through model_base.py
+    forward :3367): batch row i reads/writes cache line ``seq_ids[i]`` instead
+    of line i, so a CTE dispatch for one new request can land in any line while
+    other lines keep decoding."""
+
+    route_by_seq_id: bool = False
+
+    def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
+        B = k_new.shape[0]
+        position_ids = cache_inputs["position_ids"]
+        pos = jnp.where(position_ids < 0, k_cache_l.shape[2], position_ids)
+        if self.route_by_seq_id:
+            b_idx = cache_inputs["seq_ids"][:, None].astype(jnp.int32)
+        else:
+            b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        store = k_cache_l.dtype
+        k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
+        v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
+        k_cache_l = k_cache_l.at[b_idx, :, pos].set(k_vals, mode="drop")
+        v_cache_l = v_cache_l.at[b_idx, :, pos].set(v_vals, mode="drop")
+        return k_cache_l, v_cache_l
+
+    def read(self, k_cache_l, v_cache_l, cache_inputs, spec):
+        """Returns (kk, vv, kv_pos): (B, KV, W, D) x2 and (B, W) positions."""
+        compute = spec.compute_dtype
+        kk, vv = k_cache_l.astype(compute), v_cache_l.astype(compute)
+        if self.route_by_seq_id:
+            seq_ids = cache_inputs["seq_ids"].astype(jnp.int32)
+            kk = jnp.take(kk, seq_ids, axis=0, mode="clip")
+            vv = jnp.take(vv, seq_ids, axis=0, mode="clip")
+        B, W = kk.shape[0], kk.shape[2]
+        kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
+        return kk, vv, kv_pos
+
+
+@dataclass(frozen=True)
+class BlockKVLayout:
+    """Paged cache addressed by slot mappings (writes) and block tables (reads).
+
+    reference: block_kv_cache_manager.py:268 ``_update_cache_into_block_layout``
+    (slot-mapping scatter) and :150 ``_get_block_cache_and_reshape_bhsd``
+    (active-block-table gather). Negative slots drop the write (padding lanes);
+    the block-table gather returns rows in logical token order so kv positions
+    are simply 0..W-1."""
+
+    block_size: int
+
+    def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
+        # k_new (B, KV, S_act, D); slot_mapping (B, S_act) flat slot per token
+        slots = cache_inputs["slot_mapping"].astype(jnp.int32)
+        slots = jnp.where(slots < 0, k_cache_l.shape[0], slots)  # drop padding
+        store = k_cache_l.dtype
+        k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
+        v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
+        flat = (-1, k_vals.shape[-2], k_vals.shape[-1])
+        k_cache_l = k_cache_l.at[slots.reshape(-1)].set(k_vals.reshape(flat), mode="drop")
+        v_cache_l = v_cache_l.at[slots.reshape(-1)].set(v_vals.reshape(flat), mode="drop")
+        return k_cache_l, v_cache_l
+
+    def read(self, k_cache_l, v_cache_l, cache_inputs, spec):
+        # block_table (B, max_blocks) -> flat slots (B, max_blocks*block_size)
+        bt = cache_inputs["block_table"].astype(jnp.int32)
+        B, NB = bt.shape
+        offs = jnp.arange(self.block_size, dtype=jnp.int32)
+        slots = (bt[:, :, None] * self.block_size + offs[None, None, :]).reshape(B, -1)
+        compute = spec.compute_dtype
+        kk = jnp.take(k_cache_l, slots, axis=0, mode="clip").astype(compute)
+        vv = jnp.take(v_cache_l, slots, axis=0, mode="clip").astype(compute)
+        kk = jnp.swapaxes(kk, 1, 2)  # (B, KV, W, D)
+        vv = jnp.swapaxes(vv, 1, 2)
+        W = NB * self.block_size
+        kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
+        # rows whose table entry is negative (unallocated) must not be attended
+        valid = jnp.repeat(bt >= 0, self.block_size, axis=1)
+        kv_pos = jnp.where(valid, kv_pos, jnp.int32(2**30))
+        return kk, vv, kv_pos
+
+
+DEFAULT_KV_LAYOUT = ContiguousKVLayout()
 
 
 def reset_kv_cache(cache: Dict[str, jax.Array]) -> Dict[str, jax.Array]:

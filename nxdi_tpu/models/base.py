@@ -29,9 +29,10 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from nxdi_tpu.kvcache.kv_cache import (
+    DEFAULT_KV_LAYOUT,
+    BlockKVCacheSpec,
+    BlockKVLayout,
     KVCacheSpec,
-    read_layer_cache,
-    update_layer_cache,
 )
 from nxdi_tpu.ops import attention as attn_ops
 from nxdi_tpu.ops import moe as moe_ops
@@ -180,19 +181,23 @@ def attention_block(
     hidden: jax.Array,  # (B, S, hidden)
     cos: jax.Array,
     sin: jax.Array,
-    k_cache_l: jax.Array,  # (B, KV, W, D) bucket-windowed view
+    k_cache_l: jax.Array,  # contiguous: (B, KV, W, D) view; block: (slots, KV, D)
     v_cache_l: jax.Array,
     position_ids: jax.Array,  # (B, S)
-    cache_spec: KVCacheSpec,
+    cache_spec,  # KVCacheSpec | BlockKVCacheSpec
     attend_to_cache: bool,
     policy: ShardingPolicy = DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    cache_inputs: Optional[Dict[str, jax.Array]] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
 
     ``attend_to_cache=False`` (context encoding): queries attend the fresh K/V
     only — O(S^2) not O(S * max_len). ``True`` (decode/speculation): attend the
-    windowed cache after the in-place update.
+    cache through the layout's read after the in-place update. ``layout``
+    (kvcache/kv_cache.py) decides how K/V land: contiguous lines by
+    (seq_id, position) or a paged block pool by slot mapping.
     """
     B, S, _ = hidden.shape
     H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
@@ -215,16 +220,14 @@ def attention_block(
 
     q, k = apply_rotary_pos_emb(q, k, cos, sin)
 
-    new_k, new_v = update_layer_cache(
-        k_cache_l, v_cache_l, k, v, position_ids, cache_spec
-    )
+    ci = dict(cache_inputs or {})
+    ci["position_ids"] = position_ids
+    new_k, new_v = layout.update(k_cache_l, v_cache_l, k, v, ci, cache_spec)
 
     if attend_to_cache:
-        kk, vv = read_layer_cache(new_k, new_v, cache_spec)
+        kk, vv, kv_pos = layout.read(new_k, new_v, ci, cache_spec)
         kk = constrain(kk, policy.cache_kv)
         vv = constrain(vv, policy.cache_kv)
-        window = kk.shape[2]
-        kv_pos = jnp.broadcast_to(jnp.arange(window, dtype=position_ids.dtype)[None, :], (B, window))
         ctx = attn_ops.attention_with_positions(
             q, kk, vv, position_ids, kv_pos,
             scale=arch.attention_scale,
@@ -263,14 +266,16 @@ def decoder_layer(
     k_cache_l: jax.Array,
     v_cache_l: jax.Array,
     position_ids: jax.Array,
-    cache_spec: KVCacheSpec,
+    cache_spec,
     attend_to_cache: bool,
     policy: ShardingPolicy = DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    cache_inputs: Optional[Dict[str, jax.Array]] = None,
 ):
     h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
     attn_out, (nk, nv) = attention_block(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
-        position_ids, cache_spec, attend_to_cache, policy,
+        position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
     )
     hidden = hidden + attn_out
     h = rms_norm(hidden, lp["post_attention_layernorm"], arch.rms_norm_eps)
@@ -294,28 +299,33 @@ def run_decoder_layers(
     attend_to_cache: bool,
     kv_window: Optional[int] = None,
     policy: ShardingPolicy = DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    cache_inputs: Optional[Dict[str, jax.Array]] = None,
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
 
     ``kv_window`` statically truncates the attended cache to the bucket's token
     budget (reference: per-bucket compiled TKG programs attend only bucket-many
-    positions) while writes still target the full-length cache.
+    positions) while writes still target the full-length cache. Contiguous
+    layout only — the block layout's window is its block-table width.
     """
+
+    windowable = not isinstance(layout, BlockKVLayout)
 
     def body(h, xs):
         lp, kl, vl = xs
-        if kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
+        if windowable and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos, sin, k_win, v_win, position_ids, cache_spec,
-                attend_to_cache, policy,
+                attend_to_cache, policy, layout, cache_inputs,
             )
             nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
             nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
         else:
             h, (nk, nv) = decoder_layer(
                 arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec,
-                attend_to_cache, policy,
+                attend_to_cache, policy, layout, cache_inputs,
             )
         return h, (nk, nv)
 
@@ -337,6 +347,7 @@ def causal_lm_forward(
     attend_to_cache: bool,
     kv_window: Optional[int] = None,
     policy: ShardingPolicy = DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
     gather_last_token: bool = True,
     output_logits: bool = False,
     output_all_logits: bool = False,
@@ -362,11 +373,25 @@ def causal_lm_forward(
     hidden = constrain(hidden, policy.hidden)
     cos, sin = rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32)
 
-    cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
+    if isinstance(layout, BlockKVLayout):
+        slots = cache["k"].shape[1]
+        cache_spec = BlockKVCacheSpec(
+            num_layers=arch.num_layers,
+            num_blocks=slots // layout.block_size,
+            block_size=layout.block_size,
+            num_kv_heads=arch.num_kv_heads,
+            head_dim=arch.head_dim,
+            dtype=arch.dtype,
+        )
+    else:
+        cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
+    cache_inputs = {
+        k: batch[k] for k in ("seq_ids", "slot_mapping", "block_table") if k in batch
+    }
     hidden, new_cache = run_decoder_layers(
         arch, params["layers"], hidden, cos, sin, cache,
         position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
-        policy=policy,
+        policy=policy, layout=layout, cache_inputs=cache_inputs,
     )
     hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
 

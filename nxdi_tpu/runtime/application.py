@@ -26,7 +26,13 @@ import numpy as np
 
 from nxdi_tpu import checkpoint as ckpt
 from nxdi_tpu.config import InferenceConfig
-from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+from nxdi_tpu.kvcache.kv_cache import (
+    BlockKVCacheSpec,
+    block_kv_cache_partition_spec,
+    init_block_kv_cache,
+    init_kv_cache,
+    kv_cache_partition_spec,
+)
 from nxdi_tpu.parallel.layers import shard_pytree, sharding_tree
 from nxdi_tpu.parallel.mesh import mesh_from_config
 from nxdi_tpu.runtime import autobucketing
@@ -35,6 +41,8 @@ from nxdi_tpu.runtime.model_wrapper import (
     TAG_TOKEN_GENERATION,
     ModelWrapper,
 )
+
+TAG_PREFIX_PREFILL = "prefix_prefill_model"
 
 logger = logging.getLogger("nxdi_tpu")
 
@@ -84,10 +92,15 @@ class ApplicationBase:
         return self.family.param_specs(self.config)
 
     def cache_partition_specs(self):
+        if self.tpu_config.is_block_kv_layout:
+            return block_kv_cache_partition_spec()
         return kv_cache_partition_spec(self.tpu_config)
 
     def init_cache_host(self):
-        return init_kv_cache(self._cache_spec())
+        spec = self._cache_spec()
+        if isinstance(spec, BlockKVCacheSpec):
+            return init_block_kv_cache(spec)
+        return init_kv_cache(spec)
 
     # ------------------------------------------------------------------
     def compile(self, compiled_model_path: str) -> None:
@@ -120,6 +133,17 @@ class ApplicationBase:
         family = family or self.family
         config = config or self.config
         arch = family.build_arch(config)
+        tc = self.tpu_config
+        if tc.is_block_kv_layout:
+            return BlockKVCacheSpec(
+                num_layers=arch.num_layers,
+                num_blocks=tc.pa_num_blocks,
+                block_size=tc.pa_block_size,
+                num_kv_heads=arch.num_kv_heads,
+                head_dim=arch.head_dim,
+                dtype=arch.dtype,
+                quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
+            )
         return arch.kv_cache_spec(
             self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size,
             self.tpu_config.seq_len,
@@ -169,15 +193,16 @@ class ApplicationBase:
         t0 = time.time()
         for wrapper in self.models.values():
             for bucket in wrapper.buckets:
-                seq = wrapper.n_active_tokens if wrapper.attend_to_cache else bucket
+                decode_like = wrapper.attend_to_cache and not wrapper.prefill_to_cache
+                seq = wrapper.n_active_tokens if decode_like else bucket
                 b = wrapper.batch_size
                 batch = {
                     "input_ids": np.zeros((b, seq), dtype=np.int32),
-                    "position_ids": np.tile(np.arange(seq, dtype=np.int32), (b, 1))
-                    if not wrapper.attend_to_cache
-                    else np.full(
+                    "position_ids": np.full(
                         (b, seq), max(bucket - 1 - wrapper.lookahead, 0), dtype=np.int32
-                    ),
+                    )
+                    if decode_like
+                    else np.tile(np.arange(seq, dtype=np.int32), (b, 1)),
                     "last_token_index": np.zeros((b,), dtype=np.int32),
                     "sampling_params": np.tile([1.0, 1.0, 1.0], (b, 1)).astype(np.float32),
                 }
@@ -257,15 +282,51 @@ class TpuModelForCausalLM(ApplicationBase):
                 **sampling_kwargs,
             ),
         )
+        if tc.is_prefix_caching or tc.is_chunked_prefill:
+            # multi-token prefill that attends the cache: the new chunk/suffix
+            # sees the cached prefix through the block table (reference:
+            # prefix-caching CTE with 2-D buckets, model_wrapper.py:918;
+            # chunked prefill ChunkedPrefillConfig config.py:1042)
+            self.models[TAG_PREFIX_PREFILL] = ModelWrapper(
+                TAG_PREFIX_PREFILL,
+                self.config,
+                arch,
+                inv_freq,
+                batch_size=tc.ctx_batch_size,
+                n_active_tokens=0,
+                buckets=autobucketing.prefix_prefill_buckets(self.config),
+                attend_to_cache=True,
+                prefill_to_cache=True,
+                forward_kwargs=dict(
+                    gather_last_token=True,
+                    output_logits=tc.output_logits,
+                    on_device_sampling=on_device_sampling,
+                    **sampling_kwargs,
+                ),
+            )
 
     # -- dispatch (reference: model_base.py:3606 _get_model_outputs) --
-    def forward(self, input_ids: np.ndarray, position_ids: np.ndarray, **kwargs):
+    def forward(
+        self,
+        input_ids: np.ndarray,
+        position_ids: np.ndarray,
+        submodel: Optional[str] = None,
+        **kwargs,
+    ):
         if not self.is_loaded:
             raise RuntimeError("call load() before forward()")
-        is_prefill = input_ids.shape[1] > 1
-        tag = TAG_CONTEXT_ENCODING if is_prefill else TAG_TOKEN_GENERATION
+        if submodel is None:
+            is_prefill = input_ids.shape[1] > 1
+            # a prefill whose first position is nonzero continues an existing
+            # context -> prefix/chunked prefill submodel
+            if is_prefill and TAG_PREFIX_PREFILL in self.models and position_ids[:, 0].max() > 0:
+                submodel = TAG_PREFIX_PREFILL
+            else:
+                submodel = TAG_CONTEXT_ENCODING if is_prefill else TAG_TOKEN_GENERATION
         batch = {"input_ids": input_ids, "position_ids": position_ids, **kwargs}
-        outputs, self.kv_cache = self.models[tag].forward(self.params, self.kv_cache, batch)
+        outputs, self.kv_cache = self.models[submodel].forward(
+            self.params, self.kv_cache, batch
+        )
         return outputs
 
     def token_gen_device(self, device_batch, total_len: int):

@@ -68,11 +68,27 @@ def context_encoding_buckets(config) -> List[int]:
 def token_generation_buckets(config) -> List[int]:
     """Default TKG ladder over total KV length (reference: autobucketing.py:226-280)."""
     tc = config.tpu_config
+    if tc.is_block_kv_layout:
+        # the block-table width is the window; per-bucket TKG programs would
+        # compile identically (kvcache layout has no contiguous window to slice)
+        return [tc.seq_len]
     if tc.token_generation_buckets:
         return sorted(tc.token_generation_buckets)
     if not tc.enable_bucketing:
         return [tc.seq_len]
     return generate_buckets(min(128, tc.seq_len), tc.seq_len)
+
+
+def prefix_prefill_buckets(config) -> List[int]:
+    """Active-token ladder for prefix-cached / chunked prefill (reference:
+    chunked-prefill tile buckets autobucketing.py:101 + 2-D prefix buckets :22;
+    the prefix dim needs no bucket here — the block-table gather is fixed-width)."""
+    tc = config.tpu_config
+    if tc.chunked_prefill_config is not None:
+        return generate_buckets_on_chunk_size(
+            tc.chunked_prefill_config.kernel_q_tile_size, tc.max_context_length
+        )
+    return context_encoding_buckets(config)
 
 
 def get_target_bucket(

@@ -1,0 +1,86 @@
+"""Host-side block-space manager for the paged KV cache.
+
+The reference receives block tables / slot mappings from its serving layer
+(vLLM) and only consumes them in-graph (block_kv_cache_manager.py:376
+``generate_tokengen_slot_mapping``). This module supplies the missing
+serving-side piece so the paged layout is drivable standalone: allocate
+fixed-size blocks per sequence, hand out padded block tables, derive slot
+mappings, and reference-count shared prefix blocks for prefix caching.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class BlockSpaceManager:
+    """First-fit block allocator with refcounts (prefix blocks can be shared)."""
+
+    def __init__(self, num_blocks: int, block_size: int):
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free = deque(range(num_blocks))
+        self._tables: Dict[int, List[int]] = {}
+        self._refs = np.zeros(num_blocks, dtype=np.int64)
+
+    # ------------------------------------------------------------------
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    def ensure_capacity(self, seq_id: int, num_tokens: int) -> List[int]:
+        """Grow seq_id's table to cover ``num_tokens`` positions; returns the
+        table. Raises if the pool is exhausted (caller preempts/evicts)."""
+        table = self._tables.setdefault(seq_id, [])
+        needed = -(-num_tokens // self.block_size)
+        while len(table) < needed:
+            if not self._free:
+                raise RuntimeError(
+                    f"KV block pool exhausted ({self.num_blocks} blocks); "
+                    f"free a sequence or raise pa_num_blocks"
+                )
+            blk = self._free.popleft()
+            self._refs[blk] += 1
+            table.append(blk)
+        return table
+
+    def fork_prefix(self, seq_id: int, prefix_table: Sequence[int]) -> None:
+        """Start seq_id with shared (refcounted) prefix blocks — prefix caching
+        (reference: is_prefix_caching config + 2-D prefix buckets)."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id} already allocated")
+        for blk in prefix_table:
+            self._refs[blk] += 1
+        self._tables[seq_id] = list(prefix_table)
+
+    def free_seq(self, seq_id: int) -> None:
+        for blk in self._tables.pop(seq_id, []):
+            self._refs[blk] -= 1
+            if self._refs[blk] == 0:
+                self._free.append(blk)
+
+    # ------------------------------------------------------------------
+    def block_table(self, seq_id: int, width: Optional[int] = None) -> np.ndarray:
+        """Padded (-1) int32 table row for the compiled program."""
+        table = self._tables.get(seq_id, [])
+        width = width if width is not None else len(table)
+        out = np.full((width,), -1, dtype=np.int32)
+        out[: len(table)] = table[:width]
+        return out
+
+    def slot_mapping(self, seq_id: int, positions: np.ndarray) -> np.ndarray:
+        """Flat slot per position: table[p // bs] * bs + p % bs (unallocated
+        positions map to -1 = dropped write)."""
+        table = self._tables.get(seq_id, [])
+        positions = np.asarray(positions)
+        blk_idx = positions // self.block_size
+        out = np.full(positions.shape, -1, dtype=np.int32)
+        valid = (positions >= 0) & (blk_idx < len(table))
+        if len(table):
+            tbl = np.asarray(table, dtype=np.int32)
+            out[valid] = (
+                tbl[blk_idx[valid]] * self.block_size + positions[valid] % self.block_size
+            )
+        return out

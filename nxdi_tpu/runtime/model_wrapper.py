@@ -24,9 +24,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from nxdi_tpu.kvcache.kv_cache import BlockKVLayout, ContiguousKVLayout
 from nxdi_tpu.models.base import causal_lm_forward
 from nxdi_tpu.runtime import autobucketing
 from nxdi_tpu.runtime.padding import pad_with_first_batchline
+
+
+def kv_layout_from_config(tc):
+    """The KV layout every submodel of this app compiles against
+    (reference: config flags is_block_kv_layout / is_continuous_batching,
+    models/config.py:278-283)."""
+    if tc.is_block_kv_layout:
+        return BlockKVLayout(block_size=tc.pa_block_size)
+    if tc.is_continuous_batching:
+        return ContiguousKVLayout(route_by_seq_id=True)
+    return ContiguousKVLayout()
 
 TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"
@@ -46,6 +58,7 @@ class ModelWrapper:
         n_active_tokens: int,
         buckets: Sequence[int],
         attend_to_cache: bool,
+        prefill_to_cache: bool = False,
         bucket_strategy: str = "first_fit",
         forward_fn: Optional[Callable] = None,
         forward_kwargs: Optional[Dict[str, Any]] = None,
@@ -58,9 +71,15 @@ class ModelWrapper:
         self.n_active_tokens = n_active_tokens
         self.buckets = sorted(buckets)
         self.attend_to_cache = attend_to_cache
+        # prefix-cached / chunked prefill: multi-token input (bucketed on its
+        # length like CTE) that ALSO attends the cache — the suffix sees the
+        # prefix through the block table (reference: perform_prefix_prefill
+        # attention_base.py:909, chunked :1083)
+        self.prefill_to_cache = prefill_to_cache
         self.bucket_strategy = bucket_strategy
         self.forward_fn = forward_fn or causal_lm_forward
         self.forward_kwargs = dict(forward_kwargs or {})
+        self.layout = kv_layout_from_config(config.tpu_config)
         # extra KV positions a single dispatch may write past the current
         # length (speculation windows); widens bucket selection accordingly
         self.lookahead = 0
@@ -94,22 +113,25 @@ class ModelWrapper:
         )
 
         tc = self.config.tpu_config
+        decode_like = self.attend_to_cache and not self.prefill_to_cache
         return (
-            token_generation_policy(tc)
-            if self.attend_to_cache
-            else context_encoding_policy(tc)
+            token_generation_policy(tc) if decode_like else context_encoding_policy(tc)
         )
 
     def make_forward(self, bucket: int):
         """The pure (params, cache, batch) -> (outputs, cache) function this
         bucket compiles. Subclasses (fused speculation, ...) override."""
-        if self.attend_to_cache:
+        if self.prefill_to_cache:
+            # chunk/suffix prefill: bucket pads the input; attends the cache
+            kwargs = dict(attend_to_cache=True, kv_window=None)
+        elif self.attend_to_cache:
             # token generation: fixed active tokens, bucket bounds the attended KV window
             kwargs = dict(attend_to_cache=True, kv_window=bucket)
         else:
             # context encoding: bucket IS the padded input length
             kwargs = dict(attend_to_cache=False, kv_window=None)
         kwargs["policy"] = self.policy
+        kwargs["layout"] = self.layout
         kwargs.update(self.forward_kwargs)
         return partial(self.forward_fn, self.arch, self.inv_freq, **kwargs)
 
@@ -123,6 +145,8 @@ class ModelWrapper:
             "last_token_index": replicated,
             "sampling_params": replicated,
         }
+        for key in self._layout_input_keys():
+            batch_shardings[key] = replicated
         if self.needs_rng:
             batch_shardings["rng"] = replicated
         jitted = jax.jit(
@@ -132,10 +156,25 @@ class ModelWrapper:
         )
         return jitted
 
+    def _layout_input_keys(self):
+        if isinstance(self.layout, BlockKVLayout):
+            return ("slot_mapping", "block_table")
+        if getattr(self.layout, "route_by_seq_id", False):
+            return ("seq_ids",)
+        return ()
+
+    def _block_table_width(self) -> int:
+        tc = self.config.tpu_config
+        return -(-tc.seq_len // self.layout.block_size)  # ceil div
+
     def example_batch(self, bucket: int) -> Dict[str, jax.ShapeDtypeStruct]:
         """Shape structs per bucket for AOT lowering (reference:
         model_wrapper.py:205 ``input_generator``)."""
-        seq = self.n_active_tokens if self.attend_to_cache else bucket
+        seq = (
+            self.n_active_tokens
+            if self.attend_to_cache and not self.prefill_to_cache
+            else bucket
+        )
         B = self.batch_size
         batch = {
             "input_ids": jax.ShapeDtypeStruct((B, seq), jnp.int32),
@@ -143,6 +182,13 @@ class ModelWrapper:
             "last_token_index": jax.ShapeDtypeStruct((B,), jnp.int32),
             "sampling_params": jax.ShapeDtypeStruct((B, 3), jnp.float32),
         }
+        for key in self._layout_input_keys():
+            if key == "seq_ids":
+                batch[key] = jax.ShapeDtypeStruct((B,), jnp.int32)
+            elif key == "slot_mapping":
+                batch[key] = jax.ShapeDtypeStruct((B, seq), jnp.int32)
+            elif key == "block_table":
+                batch[key] = jax.ShapeDtypeStruct((B, self._block_table_width()), jnp.int32)
         if self.needs_rng:
             batch["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
         return batch
@@ -174,7 +220,7 @@ class ModelWrapper:
         position_ids = np.asarray(batch_np["position_ids"], dtype=np.int32)
         b, s = input_ids.shape
 
-        if self.attend_to_cache:
+        if self.attend_to_cache and not self.prefill_to_cache:
             if s != self.n_active_tokens:
                 raise ValueError(
                     f"{self.tag}: expected {self.n_active_tokens} active tokens, got {s}"
@@ -207,6 +253,7 @@ class ModelWrapper:
             batch_np.get("sampling_params", np.tile([1.0, 1.0, 1.0], (b, 1))),
             dtype=np.float32,
         )
+        extra = self._layout_inputs(batch_np, b, s, pad_s, position_ids)
 
         # pad batch dim (reference: _forward_with_pad model_wrapper.py:569)
         orig_b = b
@@ -215,6 +262,9 @@ class ModelWrapper:
             position_ids = pad_with_first_batchline(position_ids, self.batch_size)
             last_token_index = pad_with_first_batchline(last_token_index, self.batch_size)
             sampling_params = pad_with_first_batchline(sampling_params, self.batch_size)
+            extra = {
+                k: pad_with_first_batchline(v, self.batch_size) for k, v in extra.items()
+            }
         elif b > self.batch_size:
             raise ValueError(f"{self.tag}: batch {b} exceeds compiled batch {self.batch_size}")
 
@@ -224,6 +274,7 @@ class ModelWrapper:
             "last_token_index": jnp.asarray(last_token_index),
             "sampling_params": jnp.asarray(sampling_params),
         }
+        device_batch.update({k: jnp.asarray(v) for k, v in extra.items()})
         if self.needs_rng:
             rng = batch_np.get("rng")
             if rng is None:
@@ -240,6 +291,53 @@ class ModelWrapper:
             k: (v if k == "next_inputs" else v[:orig_b]) for k, v in outputs.items()
         }
         return outputs, new_cache
+
+    def _layout_inputs(
+        self, batch_np, b: int, s: int, pad_s: int, position_ids
+    ) -> Dict[str, np.ndarray]:
+        """Layout-specific inputs, padded along the sequence dim.
+
+        Batch-row padding rules keep SPMD lanes harmless: duplicate seq_ids /
+        block tables repeat row 0's writes with identical values (idempotent),
+        and -1 slots are dropped by the scatter (reference analog: repeated
+        first batchline + garbage-slot convention,
+        block_kv_cache_manager.py:376 generate_tokengen_slot_mapping)."""
+        extra: Dict[str, np.ndarray] = {}
+        if getattr(self.layout, "route_by_seq_id", False):
+            extra["seq_ids"] = np.asarray(
+                batch_np.get("seq_ids", np.arange(b)), dtype=np.int32
+            )
+        elif isinstance(self.layout, BlockKVLayout):
+            bs = self.layout.block_size
+            width = self._block_table_width()
+            bt = np.asarray(
+                batch_np.get("block_table", np.zeros((b, width))), dtype=np.int32
+            )
+            if bt.shape[1] < width:  # right-pad table with unallocated entries
+                bt = np.concatenate(
+                    [bt, np.full((b, width - bt.shape[1]), -1, dtype=np.int32)], axis=1
+                )
+            sm = batch_np.get("slot_mapping")
+            if sm is None:
+                # derive: token at position p writes slot bt[p//bs]*bs + p%bs
+                blk = position_ids // bs
+                safe_blk = np.clip(blk, 0, width - 1)
+                entry = np.take_along_axis(bt, safe_blk, axis=1)
+                sm = np.where(
+                    (position_ids >= 0) & (blk < width) & (entry >= 0),
+                    entry * bs + position_ids % bs,
+                    -1,
+                ).astype(np.int32)
+            else:
+                sm = np.asarray(sm, dtype=np.int32)
+                if sm.shape[1] < pad_s:  # seq padding never writes
+                    sm = np.concatenate(
+                        [sm, np.full((b, pad_s - sm.shape[1]), -1, dtype=np.int32)],
+                        axis=1,
+                    )
+            extra["block_table"] = bt
+            extra["slot_mapping"] = sm
+        return extra
 
     def forward_device(self, params, cache, device_batch, total_len: int):
         """Hot-path dispatch with inputs already on device (the async loop:

@@ -45,6 +45,11 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
         self.spec_len = config.tpu_config.speculation_length
         if self.spec_len < 1:
             raise ValueError("fused speculation requires speculation_length >= 1")
+        if config.tpu_config.is_block_kv_layout:
+            raise ValueError(
+                "fused speculation does not support the block KV layout yet: "
+                "the in-graph draft loop would need per-step slot mappings"
+            )
 
     # ------------------------------------------------------------------
     # params / cache pytrees: {"draft": ..., "target": ...}

@@ -38,6 +38,7 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
+from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT
 from nxdi_tpu.models.base import causal_lm_forward
 from nxdi_tpu.parallel.policy import DEFAULT_POLICY
 from nxdi_tpu.runtime.model_wrapper import ModelWrapper
@@ -52,6 +53,7 @@ def fused_spec_context_encoding(
     cache: Dict[str, Any],  # {"draft": ..., "target": ...}
     batch: Dict[str, jax.Array],
     policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
     **sampling_kwargs,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """Draft CTE + target CTE back-to-back in one program (reference:
@@ -65,6 +67,7 @@ def fused_spec_context_encoding(
         batch,
         attend_to_cache=False,
         policy=policy,
+        layout=layout,
         gather_last_token=True,
         on_device_sampling=True,
         **sampling_kwargs,
@@ -77,6 +80,7 @@ def fused_spec_context_encoding(
         batch,
         attend_to_cache=False,
         policy=policy,
+        layout=layout,
         gather_last_token=True,
         on_device_sampling=True,
         **sampling_kwargs,
@@ -99,6 +103,7 @@ def fused_spec_token_gen(
     spec_len: int,
     kv_window: int,
     policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """One speculation window (reference: model_base.py:1866 ``_token_gen_forward``).
 
@@ -124,6 +129,8 @@ def fused_spec_token_gen(
             "last_token_index": lti,
             "sampling_params": sp,
         }
+        if "seq_ids" in batch:
+            dbatch["seq_ids"] = batch["seq_ids"]
         out, dcache = causal_lm_forward(
             draft_arch,
             draft_inv_freq,
@@ -133,6 +140,7 @@ def fused_spec_token_gen(
             attend_to_cache=True,
             kv_window=kv_window,
             policy=policy,
+            layout=layout,
             gather_last_token=False,
             on_device_sampling=True,
         )
@@ -152,6 +160,8 @@ def fused_spec_token_gen(
         "last_token_index": lti,
         "sampling_params": sp,
     }
+    if "seq_ids" in batch:
+        tbatch["seq_ids"] = batch["seq_ids"]
     t_out, t_cache = causal_lm_forward(
         target_arch,
         target_inv_freq,
@@ -161,6 +171,7 @@ def fused_spec_token_gen(
         attend_to_cache=True,
         kv_window=kv_window,
         policy=policy,
+        layout=layout,
         gather_last_token=False,
         output_all_logits=True,
         on_device_sampling=False,
@@ -207,6 +218,7 @@ class FusedSpecWrapper(ModelWrapper):
                 spec_len=self.spec_len,
                 kv_window=bucket,
                 policy=self.policy,
+                layout=self.layout,
             )
         return partial(
             fused_spec_context_encoding,
@@ -215,5 +227,6 @@ class FusedSpecWrapper(ModelWrapper):
             self.draft_inv_freq,
             self.inv_freq,
             policy=self.policy,
+            layout=self.layout,
             **self.forward_kwargs,
         )

@@ -108,7 +108,6 @@ def _get_logit_probe(app):
     if cached is not None:
         return cached
 
-    from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
     from nxdi_tpu.parallel.layers import shard_pytree, sharding_tree
     from nxdi_tpu.runtime.model_wrapper import ModelWrapper
 
@@ -129,14 +128,21 @@ def _get_logit_probe(app):
         attend_to_cache=False,
         forward_kwargs=fkw,
     )
+    if getattr(app, "is_fused_spec", False):
+        # the probe graph is target-only; give it a target-only cache
+        from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+
+        cache_host = init_kv_cache(app._cache_spec())
+        cache_specs = kv_cache_partition_spec(app.tpu_config)
+    else:
+        cache_host = app.init_cache_host()
+        cache_specs = app.cache_partition_specs()
     probe.build(
         app.mesh,
         sharding_tree(app.family.param_specs(app.config), app.mesh),
-        sharding_tree(kv_cache_partition_spec(app.tpu_config), app.mesh),
+        sharding_tree(cache_specs, app.mesh),
     )
-    cache = shard_pytree(
-        init_kv_cache(app._cache_spec()), kv_cache_partition_spec(app.tpu_config), app.mesh
-    )
+    cache = shard_pytree(cache_host, cache_specs, app.mesh)
     app._logit_probe = (probe, cache)
     return app._logit_probe
 
@@ -166,15 +172,24 @@ def check_accuracy_logits(
     position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
     probe, cache = _get_logit_probe(app)
     params = app.params["target"] if getattr(app, "is_fused_spec", False) else app.params
-    outputs, _ = probe.forward(
-        params,
-        cache,
-        {
-            "input_ids": input_ids.astype(np.int32),
-            "position_ids": position_ids,
-            "last_token_index": np.full((B,), S - 1, dtype=np.int32),
-        },
-    )
+    batch = {
+        "input_ids": input_ids.astype(np.int32),
+        "position_ids": position_ids,
+        "last_token_index": np.full((B,), S - 1, dtype=np.int32),
+    }
+    tc = app.tpu_config
+    if tc.is_block_kv_layout:
+        # a real (non-aliasing) table: row b owns sequential blocks b*W..b*W+W-1
+        width = -(-tc.seq_len // tc.pa_block_size)
+        if tc.pa_num_blocks < B * width:
+            raise ValueError(
+                f"logit probe needs pa_num_blocks >= batch*width ({B}*{width})"
+            )
+        batch["block_table"] = (
+            np.arange(B, dtype=np.int32)[:, None] * width
+            + np.arange(width, dtype=np.int32)[None, :]
+        )
+    outputs, _ = probe.forward(params, cache, batch)
     actual = np.asarray(jax.device_get(outputs["logits"]))[:, :S, :]
 
     errors_by_index: Dict[int, float] = {}

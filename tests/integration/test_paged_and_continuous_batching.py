@@ -1,0 +1,295 @@
+"""Continuous batching (seq-id cache routing) and paged/block KV correctness —
+every flow must reproduce HF CPU greedy tokens exactly.
+
+Reference analogs: continuous-batching llama integration tests, and the block
+KV manager tests (modules/kvcache/block_kv_cache_manager.py semantics)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.runtime.block_manager import BlockSpaceManager
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+P0 = [5, 9, 3, 17, 2, 8, 11, 42]
+P1 = [7, 13, 21, 4, 33]
+
+
+def _prefill(app, prompt, **kw):
+    ids = np.asarray([prompt], dtype=np.int32)
+    pos = np.arange(len(prompt), dtype=np.int32)[None, :]
+    out = app.forward(
+        ids, pos, last_token_index=np.array([len(prompt) - 1], np.int32), **kw
+    )
+    return int(np.asarray(out["tokens"])[0, 0])
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_continuous_batching_interleaved(tiny_hf_llama, tp_degree):
+    """Prefill A -> decode A -> prefill B into another cache line -> joint
+    decode; both rows must match their unbatched HF greedy runs."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model,
+        hf_cfg,
+        tp_degree=tp_degree,
+        is_continuous_batching=True,
+        ctx_batch_size=1,
+        tkg_batch_size=2,
+        kv_cache_batch_size=2,
+    )
+    e0 = hf_greedy(hf_model, np.array([P0]), 12)[0, len(P0):]
+    e1 = hf_greedy(hf_model, np.array([P1]), 12)[0, len(P1):]
+
+    got0 = [_prefill(app, P0, seq_ids=np.array([0], np.int32))]
+    # decode A alone for 3 steps (row routed to cache line 0)
+    pos0 = len(P0)
+    for _ in range(3):
+        out = app.forward(
+            np.array([[got0[-1]]], np.int32),
+            np.array([[pos0]], np.int32),
+            seq_ids=np.array([0], np.int32),
+        )
+        got0.append(int(np.asarray(out["tokens"])[0, 0]))
+        pos0 += 1
+
+    # now prefill B into line 1 — must not disturb line 0
+    got1 = [_prefill(app, P1, seq_ids=np.array([1], np.int32))]
+    pos1 = len(P1)
+
+    # joint decode
+    for _ in range(8):
+        out = app.forward(
+            np.array([[got0[-1]], [got1[-1]]], np.int32).reshape(2, 1),
+            np.array([[pos0], [pos1]], np.int32),
+            seq_ids=np.array([0, 1], np.int32),
+        )
+        toks = np.asarray(out["tokens"])[:, 0]
+        got0.append(int(toks[0]))
+        got1.append(int(toks[1]))
+        pos0 += 1
+        pos1 += 1
+
+    np.testing.assert_array_equal(np.array(got0), e0[: len(got0)])
+    np.testing.assert_array_equal(np.array(got1), e1[: len(got1)])
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_paged_block_kv_token_matching(tiny_hf_llama, tp_degree):
+    """Paged layout with deliberately scrambled physical blocks: prefill each
+    row into its (non-contiguous) blocks, then decode jointly via block tables."""
+    hf_model, hf_cfg = tiny_hf_llama
+    block_size = 8
+    app = _build_app(
+        hf_model,
+        hf_cfg,
+        tp_degree=tp_degree,
+        is_block_kv_layout=True,
+        pa_block_size=block_size,
+        pa_num_blocks=24,
+        ctx_batch_size=1,
+        tkg_batch_size=2,
+    )
+    mgr = BlockSpaceManager(24, block_size)
+    # scramble: burn a few blocks so row tables are non-contiguous and offset
+    mgr.ensure_capacity(99, 3 * block_size)
+    width = app.tpu_config.seq_len // block_size
+
+    e0 = hf_greedy(hf_model, np.array([P0]), 12)[0, len(P0):]
+    e1 = hf_greedy(hf_model, np.array([P1]), 12)[0, len(P1):]
+
+    seqs = {0: list(P0), 1: list(P1)}
+    got = {0: [], 1: []}
+    for sid, prompt in seqs.items():
+        mgr.ensure_capacity(sid, len(prompt) + 13)
+        tok = _prefill(app, prompt, block_table=mgr.block_table(sid, width)[None, :])
+        got[sid].append(tok)
+    mgr.free_seq(99)
+
+    pos = {0: len(P0), 1: len(P1)}
+    for _ in range(8):
+        bt = np.stack([mgr.block_table(0, width), mgr.block_table(1, width)])
+        out = app.forward(
+            np.array([[got[0][-1]], [got[1][-1]]], np.int32),
+            np.array([[pos[0]], [pos[1]]], np.int32),
+            block_table=bt,
+        )
+        toks = np.asarray(out["tokens"])[:, 0]
+        for sid in (0, 1):
+            got[sid].append(int(toks[sid]))
+            pos[sid] += 1
+
+    np.testing.assert_array_equal(np.array(got[0]), e0[: len(got[0])])
+    np.testing.assert_array_equal(np.array(got[1]), e1[: len(got[1])])
+
+
+def test_prefix_caching_shared_blocks(tiny_hf_llama):
+    """Request B forks request A's (block-aligned) prefix blocks and prefills
+    only its suffix; its continuation must match HF greedy on the full prompt."""
+    hf_model, hf_cfg = tiny_hf_llama
+    block_size = 4
+    app = _build_app(
+        hf_model,
+        hf_cfg,
+        is_block_kv_layout=True,
+        is_prefix_caching=True,
+        pa_block_size=block_size,
+        pa_num_blocks=32,
+        ctx_batch_size=1,
+        tkg_batch_size=2,
+    )
+    mgr = BlockSpaceManager(32, block_size)
+    width = app.tpu_config.seq_len // block_size
+
+    prefix = [5, 9, 3, 17, 2, 8, 11, 42]  # 8 tokens = 2 full blocks
+    sfx_a, sfx_b = [7, 13], [21, 4, 33]
+    prompt_a, prompt_b = prefix + sfx_a, prefix + sfx_b
+
+    # request A: full prefill
+    mgr.ensure_capacity(0, len(prompt_a) + 10)
+    tok_a = _prefill(app, prompt_a, block_table=mgr.block_table(0, width)[None, :])
+
+    # request B: share A's prefix blocks, prefill ONLY the suffix
+    mgr.fork_prefix(1, mgr.block_table(0)[: len(prefix) // block_size].tolist())
+    mgr.ensure_capacity(1, len(prompt_b) + 10)
+    ids = np.asarray([sfx_b], dtype=np.int32)
+    pos = (len(prefix) + np.arange(len(sfx_b), dtype=np.int32))[None, :]
+    out = app.forward(
+        ids,
+        pos,
+        last_token_index=np.array([len(sfx_b) - 1], np.int32),
+        block_table=mgr.block_table(1, width)[None, :],
+    )
+    tok_b = int(np.asarray(out["tokens"])[0, 0])
+
+    e_a = hf_greedy(hf_model, np.array([prompt_a]), 8)[0, len(prompt_a):]
+    e_b = hf_greedy(hf_model, np.array([prompt_b]), 8)[0, len(prompt_b):]
+    assert tok_a == e_a[0] and tok_b == e_b[0]
+
+    # joint decode keeps both correct (A's prefix blocks are shared, read-only)
+    got = {0: [tok_a], 1: [tok_b]}
+    pos_d = {0: len(prompt_a), 1: len(prompt_b)}
+    for _ in range(5):
+        bt = np.stack([mgr.block_table(0, width), mgr.block_table(1, width)])
+        out = app.forward(
+            np.array([[got[0][-1]], [got[1][-1]]], np.int32),
+            np.array([[pos_d[0]], [pos_d[1]]], np.int32),
+            block_table=bt,
+        )
+        toks = np.asarray(out["tokens"])[:, 0]
+        for sid in (0, 1):
+            got[sid].append(int(toks[sid]))
+            pos_d[sid] += 1
+    np.testing.assert_array_equal(np.array(got[0]), e_a[: len(got[0])])
+    np.testing.assert_array_equal(np.array(got[1]), e_b[: len(got[1])])
+
+
+def test_chunked_prefill(tiny_hf_llama):
+    """A long prompt prefilled in chunks (each chunk attends the cached
+    previous chunks) must produce the same first token as one-shot prefill."""
+    hf_model, hf_cfg = tiny_hf_llama
+    block_size = 4
+    app = _build_app(
+        hf_model,
+        hf_cfg,
+        is_block_kv_layout=True,
+        chunked_prefill_config={"chunk_size": 8, "kernel_q_tile_size": 8},
+        pa_block_size=block_size,
+        pa_num_blocks=32,
+        ctx_batch_size=1,
+        tkg_batch_size=1,
+        batch_size=1,
+    )
+    mgr = BlockSpaceManager(32, block_size)
+    width = app.tpu_config.seq_len // block_size
+
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, 255, size=20).tolist()
+    mgr.ensure_capacity(0, len(prompt) + 4)
+    bt = mgr.block_table(0, width)[None, :]
+
+    tok = None
+    for start in range(0, len(prompt), 8):
+        chunk = prompt[start : start + 8]
+        ids = np.asarray([chunk], dtype=np.int32)
+        pos = (start + np.arange(len(chunk), dtype=np.int32))[None, :]
+        out = app.forward(
+            ids, pos, last_token_index=np.array([len(chunk) - 1], np.int32),
+            block_table=bt,
+        )
+        tok = int(np.asarray(out["tokens"])[0, 0])
+
+    expected = hf_greedy(hf_model, np.array([prompt]), 2)[0, len(prompt)]
+    assert tok == expected
+
+
+def test_block_space_manager():
+    mgr = BlockSpaceManager(8, 4)
+    t = mgr.ensure_capacity(0, 10)  # 3 blocks
+    assert len(t) == 3 and mgr.num_free_blocks() == 5
+    # prefix sharing bumps refcounts; freeing the fork keeps the prefix alive
+    mgr.fork_prefix(1, t[:2])
+    mgr.ensure_capacity(1, 12)
+    mgr.free_seq(1)
+    assert mgr.num_free_blocks() == 5
+    mgr.free_seq(0)
+    assert mgr.num_free_blocks() == 8
+    # slot mapping: position p -> table[p//bs]*bs + p%bs, -1 past the table
+    mgr2 = BlockSpaceManager(4, 4)
+    mgr2.ensure_capacity(7, 8)
+    sm = mgr2.slot_mapping(7, np.array([0, 3, 4, 9]))
+    tbl = mgr2.block_table(7)
+    assert sm[0] == tbl[0] * 4 and sm[1] == tbl[0] * 4 + 3
+    assert sm[2] == tbl[1] * 4 and sm[3] == -1
+    # pool exhaustion raises
+    with pytest.raises(RuntimeError, match="exhausted"):
+        mgr2.ensure_capacity(8, 16)
+
+
+def test_logit_matching_on_paged_app(tiny_hf_llama):
+    """check_accuracy_logits must handle the block layout (real block table,
+    4-dim cache specs)."""
+    from nxdi_tpu.utils.accuracy import check_accuracy_logits
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model,
+        hf_cfg,
+        is_block_kv_layout=True,
+        pa_block_size=8,
+        pa_num_blocks=32,
+        ctx_batch_size=1,
+        tkg_batch_size=1,
+        batch_size=1,
+    )
+    ids = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    errs = check_accuracy_logits(app, ids, hf_model=hf_model, divergence_difference_tol=0.01)
+    assert max(errs.values()) < 0.01

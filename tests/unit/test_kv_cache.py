@@ -4,12 +4,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from nxdi_tpu.kvcache.kv_cache import (
+    BlockKVLayout,
+    ContiguousKVLayout,
     KVCacheSpec,
     init_kv_cache,
-    read_layer_cache,
     reset_kv_cache,
-    update_layer_cache,
 )
+
+LAYOUT = ContiguousKVLayout()
+
+
+def update_layer_cache(kl, vl, k_new, v_new, pos, spec):
+    return LAYOUT.update(kl, vl, k_new, v_new, {"position_ids": pos}, spec)
+
+
+def read_layer_cache(kl, vl, spec):
+    kk, vv, _ = LAYOUT.read(kl, vl, {}, spec)
+    return kk, vv
 
 
 def make_spec(**kw):
@@ -76,3 +87,57 @@ def test_reset():
     cache = {"k": cache["k"] + 1, "v": cache["v"] + 2}
     cache = reset_kv_cache(cache)
     assert np.all(np.asarray(cache["k"]) == 0) and np.all(np.asarray(cache["v"]) == 0)
+
+
+def test_seq_id_routed_update_and_read():
+    """Continuous batching: batch row 0 routed to cache line 1 and vice versa."""
+    layout = ContiguousKVLayout(route_by_seq_id=True)
+    spec = make_spec()
+    cache = init_kv_cache(spec)
+    k_new = jnp.stack([jnp.ones((2, 1, 4)) * 3, jnp.ones((2, 1, 4)) * 5])  # (2,2,1,4)
+    ci = {
+        "position_ids": jnp.zeros((2, 1), jnp.int32),
+        "seq_ids": jnp.array([1, 0], jnp.int32),
+    }
+    k_l, v_l = layout.update(cache["k"][0], cache["v"][0], k_new, k_new, ci, spec)
+    k_np = np.asarray(k_l)
+    assert np.all(k_np[1, :, 0] == 3) and np.all(k_np[0, :, 0] == 5)
+    kk, _, kv_pos = layout.read(k_l, v_l, ci, spec)
+    # read gathers back in batch order: row 0 sees line 1 (its own writes)
+    assert np.all(np.asarray(kk)[0, :, 0] == 3) and np.all(np.asarray(kk)[1, :, 0] == 5)
+    assert kv_pos.shape == (2, 8)
+
+
+def test_block_layout_scatter_and_gather():
+    layout = BlockKVLayout(block_size=4)
+    spec = make_spec()  # dtype fields reused; shape comes from the array
+    pool = jnp.zeros((16, 2, 4))  # 4 blocks x 4 slots
+    k_new = jnp.arange(2 * 2 * 3 * 4, dtype=jnp.float32).reshape(2, 2, 3, 4)
+    ci = {
+        "position_ids": jnp.array([[0, 1, 2], [0, 1, 2]], jnp.int32),
+        # row0 -> block 2 (slots 8..), row1 -> block 0 (slots 0..)
+        "slot_mapping": jnp.array([[8, 9, 10], [0, 1, 2]], jnp.int32),
+        "block_table": jnp.array([[2, -1], [0, -1]], jnp.int32),
+    }
+    k_l, v_l = layout.update(pool, pool, k_new, k_new, ci, spec)
+    k_np = np.asarray(k_l)
+    assert np.allclose(k_np[8], np.asarray(k_new)[0, :, 0])  # (KV, D) at slot 8
+    assert np.allclose(k_np[2], np.asarray(k_new)[1, :, 2])
+    kk, _, kv_pos = layout.read(k_l, v_l, ci, spec)
+    assert kk.shape == (2, 2, 8, 4)  # 2 table entries x block_size
+    assert np.allclose(np.asarray(kk)[0, :, 0], np.asarray(k_new)[0, :, 0])
+    # unallocated second block: kv positions pushed out of causal range
+    assert np.all(np.asarray(kv_pos)[:, 4:] >= 2**29)
+
+
+def test_block_layout_negative_slots_dropped():
+    layout = BlockKVLayout(block_size=4)
+    spec = make_spec()
+    pool = jnp.zeros((8, 2, 4))
+    k_new = jnp.ones((1, 2, 2, 4))
+    ci = {
+        "position_ids": jnp.array([[0, 1]], jnp.int32),
+        "slot_mapping": jnp.array([[-1, -1]], jnp.int32),
+    }
+    k_l, _ = layout.update(pool, pool, k_new, k_new, ci, spec)
+    assert np.all(np.asarray(k_l) == 0)
