@@ -35,6 +35,7 @@ from nxdi_tpu.kvcache.kv_cache import (
     KVCacheSpec,
 )
 from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import kernels as attn_kernels
 from nxdi_tpu.ops import moe as moe_ops
 from nxdi_tpu.ops import sampling as sampling_ops
 from nxdi_tpu.ops.norms import rms_norm
@@ -84,6 +85,9 @@ class DecoderArch:
     tie_word_embeddings: bool = False
     dtype: str = "bfloat16"
     softmax_dtype: str = "float32"
+    # Pallas kernel gates (reference: attn_kernel_enabled flags config.py:418-533)
+    attn_kernel_enabled: bool = False
+    attn_tkg_kernel_enabled: bool = False
     # MoE feed-forward replaces the dense MLP when set (ops/moe.py)
     moe: Optional[moe_ops.MoEArch] = None
 
@@ -228,21 +232,47 @@ def attention_block(
         kk, vv, kv_pos = layout.read(new_k, new_v, ci, cache_spec)
         kk = constrain(kk, policy.cache_kv)
         vv = constrain(vv, policy.cache_kv)
-        ctx = attn_ops.attention_with_positions(
-            q, kk, vv, position_ids, kv_pos,
-            scale=arch.attention_scale,
-            softmax_dtype=jnp.float32,
-            sliding_window=arch.sliding_window,
-            chunk_size=arch.chunk_size,
-        )
+        ctx = None
+        if (
+            arch.attn_tkg_kernel_enabled
+            and attn_kernels.decode_kernel_supported(q.shape, kk.shape)
+        ):
+            ctx = attn_kernels.sharded_kernel_call(
+                policy, q, kk, vv, position_ids, kv_pos,
+                decode=True,
+                scale=arch.attention_scale,
+                sliding_window=arch.sliding_window,
+                chunk_size=arch.chunk_size,
+            )
+        if ctx is None:
+            ctx = attn_ops.attention_with_positions(
+                q, kk, vv, position_ids, kv_pos,
+                scale=arch.attention_scale,
+                softmax_dtype=jnp.float32,
+                sliding_window=arch.sliding_window,
+                chunk_size=arch.chunk_size,
+            )
     else:
-        ctx = attn_ops.attention_with_positions(
-            q, k, v, position_ids, position_ids,
-            scale=arch.attention_scale,
-            softmax_dtype=jnp.float32,
-            sliding_window=arch.sliding_window,
-            chunk_size=arch.chunk_size,
-        )
+        ctx = None
+        if (
+            arch.attn_kernel_enabled
+            and attn_kernels.prefill_kernel_supported(q.shape, k.shape)
+        ):
+            ctx = attn_kernels.sharded_kernel_call(
+                policy, q, k, v, position_ids, position_ids,
+                decode=False,
+                scale=arch.attention_scale,
+                sliding_window=arch.sliding_window,
+                chunk_size=arch.chunk_size,
+            )
+        if ctx is None:
+            ctx = attn_ops.attention_with_positions(
+                q, k, v, position_ids, position_ids,
+                scale=arch.attention_scale,
+                softmax_dtype=jnp.float32,
+                sliding_window=arch.sliding_window,
+                chunk_size=arch.chunk_size,
+            )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
     out = _linear(ctx, p_attn["o_proj"])

@@ -79,6 +79,8 @@ def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
         mlp_bias=getattr(config, "mlp_bias", False),
         tie_word_embeddings=getattr(config, "tie_word_embeddings", False),
         dtype=dtype_name(config.tpu_config.dtype),
+        attn_kernel_enabled=bool(config.tpu_config.attn_kernel_enabled),
+        attn_tkg_kernel_enabled=bool(config.tpu_config.attn_tkg_kernel_enabled),
     )
     kwargs.update(overrides)
     return DecoderArch(**kwargs)

@@ -1,0 +1,15 @@
+from nxdi_tpu.ops.kernels.flash_attention import (
+    decode_kernel_supported,
+    flash_attention_decode,
+    flash_attention_prefill,
+    prefill_kernel_supported,
+    sharded_kernel_call,
+)
+
+__all__ = [
+    "decode_kernel_supported",
+    "flash_attention_decode",
+    "flash_attention_prefill",
+    "prefill_kernel_supported",
+    "sharded_kernel_call",
+]

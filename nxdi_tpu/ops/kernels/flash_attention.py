@@ -1,0 +1,364 @@
+"""Pallas flash-attention kernels (TPU).
+
+The TPU-native replacements for the reference's NKI attention kernels
+(SURVEY §2.9: external ``attention_isa_kernel`` CTE flash,
+``attention_tkg_fwd_isa_kernel`` decode, in-repo sliding-window flash
+``modules/sliding_window/attention.py:234``). Same role as there: an
+*optimization* behind a flag (``attn_kernel_enabled``), never a semantic
+change — ops/attention.py stays the always-available XLA fallback with
+identical mask semantics.
+
+Design notes (vs the reference's 128-partition NKI tiling):
+  - grid = (batch*q_heads, S_q/block_q, S_kv/block_k); the kv dim is the
+    innermost (sequential) axis so the online-softmax running state (m, l,
+    acc) lives in VMEM scratch across kv steps — the classic flash recipe
+    tiled for the 128x128 MXU.
+  - positions are AFFINE per row (start + arange) everywhere this framework
+    calls attention — prefill arange, decode scalar, speculation windows,
+    chunk prefill — so the kernels take per-row scalar STARTS via scalar
+    prefetch (SMEM) and rebuild position tiles with 2-D iota in-kernel.
+    Mosaic gets no awkward 1-row vector loads, and causal / sliding-window /
+    chunked masks still match the XLA path bit-for-bit.
+  - causal block skip: a kv block entirely in the future contributes nothing
+    and is skipped under ``pl.when`` (the reference's strided-CP kernel
+    solves the same wasted-work problem differently).
+  - GQA without repeat_kv: q head h reads kv head h // (H/KV) via the
+    BlockSpec index map — no materialized head replication in HBM.
+
+On non-TPU backends the kernels run in interpreter mode (tests compare them
+against the XLA path on CPU); on TPU they compile with Mosaic.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -30000.0
+
+
+def _interpret() -> bool:
+    return jax.devices()[0].platform != "tpu"
+
+
+def _pick_block(s: int, target: int) -> int:
+    b = min(target, s)
+    while s % b:
+        b //= 2
+    return max(b, 1)
+
+
+def prefill_kernel_supported(q_shape, k_shape) -> bool:
+    B, H, Sq, D = q_shape
+    KV, Sk = k_shape[1], k_shape[2]
+    if H % KV:
+        return False
+    if _interpret():
+        return True
+    # Mosaic tiling: head_dim fills the 128-lane registers; blocks divide S
+    return D % 128 == 0 and Sq % 8 == 0 and Sk % 128 == 0
+
+
+def decode_kernel_supported(q_shape, k_shape) -> bool:
+    B, H, Sq, D = q_shape
+    KV, Sk = k_shape[1], k_shape[2]
+    if H % KV or Sq != 1:
+        return False
+    if _interpret():
+        return True
+    return D % 128 == 0 and Sk % 128 == 0
+
+
+# ---------------------------------------------------------------------------
+# Shared mask math (2-D position tiles from scalar starts)
+# ---------------------------------------------------------------------------
+
+
+def _mask_tile(q_start, kv_start, qi, ki, bq, bk, sliding_window, chunk_size):
+    """(bq, bk) bool mask; q row r is position q_start + qi*bq + r, kv col c
+    is position kv_start + ki*bk + c."""
+    q_pos = q_start + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kv_pos = kv_start + ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    m = kv_pos <= q_pos
+    if sliding_window is not None:
+        m &= kv_pos > q_pos - sliding_window
+    if chunk_size is not None:
+        m &= (kv_pos // chunk_size) == (q_pos // chunk_size)
+    return m
+
+
+def _online_softmax_step(s, mask, m_ref, l_ref, acc_ref, v):
+    s = jnp.where(mask, s, NEG_INF)
+    m_prev = m_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+    l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+    m_ref[:, 0] = m_new
+    # probabilities ride the MXU in the inputs' dtype; accumulate in f32
+    acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32
+    )
+
+
+# ---------------------------------------------------------------------------
+# Prefill (context encoding) kernel
+# ---------------------------------------------------------------------------
+
+
+def _prefill_kernel(
+    qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale, sliding_window, chunk_size, n_kv_blocks, H, block_q, block_k,
+):
+    qi, ki = pl.program_id(1), pl.program_id(2)
+    b = pl.program_id(0) // H
+    q_start = qs_ref[b]
+    kv_start = ks_ref[b]
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # causal skip: kv block entirely in the future of the q block
+    @pl.when(kv_start + ki * block_k <= q_start + qi * block_q + block_q - 1)
+    def _():
+        q = q_ref[0]  # (block_q, D) — native dtype feeds the MXU
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        mask = _mask_tile(
+            q_start, kv_start, qi, ki, block_q, block_k, sliding_window, chunk_size
+        )
+        _online_softmax_step(s, mask, m_ref, l_ref, acc_ref, v)
+
+    @pl.when(ki == n_kv_blocks - 1)
+    def _():
+        l = jnp.maximum(l_ref[:, 0], 1e-20)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_prefill(
+    q,  # (B, H, Sq, D)
+    k,  # (B, KV, Sk, D)
+    v,  # (B, KV, Sk, D)
+    q_pos,  # (B, Sq) int32 — affine per row (start + arange)
+    kv_pos,  # (B, Sk) int32 — affine per row
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    chunk_size: Optional[int] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+):
+    B, H, Sq, D = q.shape
+    KV, Sk = k.shape[1], k.shape[2]
+    G = H // KV
+    scale = D ** -0.5 if scale is None else scale
+    block_q = _pick_block(Sq, block_q)
+    block_k = _pick_block(Sk, block_k)
+    n_kv_blocks = Sk // block_k
+
+    qf = q.reshape(B * H, Sq, D)
+    kf = k.reshape(B * KV, Sk, D)
+    vf = v.reshape(B * KV, Sk, D)
+    q_start = q_pos[:, 0].astype(jnp.int32)
+    kv_start = kv_pos[:, 0].astype(jnp.int32)
+
+    kernel = functools.partial(
+        _prefill_kernel,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+        n_kv_blocks=n_kv_blocks,
+        H=H,
+        block_q=block_q,
+        block_k=block_k,
+    )
+
+    def kv_index(bh, qi, ki, *prefetch):
+        return (bh // H) * KV + (bh % H) // G, ki, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * H, Sq // block_q, n_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, ki, *_: (bh, qi, 0)),
+            pl.BlockSpec((1, block_k, D), kv_index),
+            pl.BlockSpec((1, block_k, D), kv_index),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi, ki, *_: (bh, qi, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),  # running denom
+            pltpu.VMEM((block_q, D), jnp.float32),  # weighted-V accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq, D), q.dtype),
+        interpret=_interpret(),
+    )(q_start, kv_start, qf, kf, vf)
+    return out.reshape(B, H, Sq, D)
+
+
+# ---------------------------------------------------------------------------
+# Decode (token generation) kernel — q_len == 1, KV long
+# ---------------------------------------------------------------------------
+
+
+def _decode_kernel(
+    qs_ref, ks_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale, sliding_window, chunk_size, n_kv_blocks, KV, block_k,
+):
+    ki = pl.program_id(1)
+    b = pl.program_id(0) // KV
+    q_start = qs_ref[b]  # the single decode position (same for all G rows)
+    kv_start = ks_ref[b]
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(kv_start + ki * block_k <= q_start)
+    def _():
+        q = q_ref[0]  # (G, D) — native dtype feeds the MXU
+        k = k_ref[0]
+        v = v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # (G, block_k)
+        G = s.shape[0]
+        mask = _mask_tile(
+            q_start, kv_start, 0, ki, 1, block_k, sliding_window, chunk_size
+        )  # (1, block_k): all G rows decode the same position
+        mask = jnp.broadcast_to(mask, (G, block_k))
+        _online_softmax_step(s, mask, m_ref, l_ref, acc_ref, v)
+
+    @pl.when(ki == n_kv_blocks - 1)
+    def _():
+        l = jnp.maximum(l_ref[:, 0], 1e-20)
+        o_ref[0] = (acc_ref[:] / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_decode(
+    q,  # (B, H, 1, D)
+    k,  # (B, KV, Sk, D)
+    v,  # (B, KV, Sk, D)
+    q_pos,  # (B, 1) int32
+    kv_pos,  # (B, Sk) int32 — affine per row
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    chunk_size: Optional[int] = None,
+    block_k: int = 512,
+):
+    """Single-position decode: grid over (batch x kv-head) with the G grouped
+    query rows as the matmul M dim — one (G, D) x (D, block_k) MXU pass per
+    cache block (the reference's TKG kernel role, attention_base.py:1419)."""
+    B, H, Sq, D = q.shape
+    assert Sq == 1, "decode kernel is single-position"
+    KV, Sk = k.shape[1], k.shape[2]
+    G = H // KV
+    scale = D ** -0.5 if scale is None else scale
+    block_k = _pick_block(Sk, block_k)
+    n_kv_blocks = Sk // block_k
+
+    qf = q.reshape(B, KV, G, D).reshape(B * KV, G, D)
+    kf = k.reshape(B * KV, Sk, D)
+    vf = v.reshape(B * KV, Sk, D)
+    q_start = q_pos[:, 0].astype(jnp.int32)
+    kv_start = kv_pos[:, 0].astype(jnp.int32)
+
+    kernel = functools.partial(
+        _decode_kernel,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+        n_kv_blocks=n_kv_blocks,
+        KV=KV,
+        block_k=block_k,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * KV, n_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, G, D), lambda bk, ki, *_: (bk, 0, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bk, ki, *_: (bk, ki, 0)),
+            pl.BlockSpec((1, block_k, D), lambda bk, ki, *_: (bk, ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, D), lambda bk, ki, *_: (bk, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * KV, G, D), q.dtype),
+        interpret=_interpret(),
+    )(q_start, kv_start, qf, kf, vf)
+    return out.reshape(B, KV, G, D).reshape(B, H, 1, D).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Sharded dispatch — kernels under GSPMD
+# ---------------------------------------------------------------------------
+
+
+def sharded_kernel_call(
+    policy,
+    q, k, v, q_pos, kv_pos,
+    *,
+    decode: bool,
+    scale=None,
+    sliding_window=None,
+    chunk_size=None,
+):
+    """Run the flash kernel per mesh shard via ``shard_map`` (GSPMD cannot
+    partition a pallas_call by itself). Head/batch shardings follow the
+    submodel's :class:`ShardingPolicy`; attention is head-local so no in-shard
+    collectives are needed. CP's q-sequence sharding is fine — GSPMD shards
+    are contiguous slices, so per-shard positions stay affine and each shard's
+    start is its own ``row[0]``. Returns None only when the policy shards the
+    KV sequence dim (flash decoding needs a cross-shard softmax) — the caller
+    falls back to ops/attention.py."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = functools.partial(
+        flash_attention_decode if decode else flash_attention_prefill,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+    )
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return fn(q, k, v, q_pos, kv_pos)
+
+    kv_spec = policy.cache_kv if decode else policy.kv
+    if kv_spec[2] is not None:
+        return None  # KV sequence sharded (flash decoding) -> XLA path
+    q_spec = P(*policy.q)
+    qp_spec = P(policy.q[0], policy.q[2])  # (B, Sq) follows q's batch/seq axes
+    kp_spec = P(kv_spec[0], None)
+    shard_fn = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(q_spec, P(*kv_spec), P(*kv_spec), qp_spec, kp_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return shard_fn(q, k, v, q_pos, kv_pos)

@@ -102,3 +102,38 @@ def test_cache_partition_spec_variants():
     tc = TpuConfig(tp_degree=8, cp_degree=2, flash_decoding_enabled=True)
     assert kv_cache_partition_spec(tc)["k"] == P(None, None, "tp", "cp", None)
     assert kv_cache_partition_spec(None)["k"] == P(None, None, "tp", None, None)
+
+
+@pytest.mark.parametrize(
+    "tcfg_kwargs",
+    [
+        pytest.param(dict(attn_kernel_enabled=True), id="prefill-kernel"),
+        pytest.param(
+            dict(attn_kernel_enabled=True, attn_tkg_kernel_enabled=True),
+            id="prefill+decode-kernel",
+        ),
+        pytest.param(
+            dict(attn_kernel_enabled=True, cp_degree=2), id="kernel+cp2"
+        ),
+        pytest.param(
+            dict(
+                attn_kernel_enabled=True,
+                attn_tkg_kernel_enabled=True,
+                attention_dp_degree=2,
+                batch_size=2,
+            ),
+            id="kernel+attn-dp2",
+        ),
+    ],
+)
+def test_flash_kernel_token_matching(tiny_hf_llama, tcfg_kwargs):
+    """Pallas kernels (interpret mode on CPU) under the sharded dispatch must
+    reproduce HF greedy tokens exactly on an 8-device mesh."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(hf_model, hf_cfg, **tcfg_kwargs)
+    adapter = HuggingFaceGenerationAdapter(app)
+    batch = tcfg_kwargs.get("batch_size", 1)
+    prompt = np.tile(PROMPT, (batch, 1))
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=16)
+    actual = adapter.generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)

@@ -1,0 +1,70 @@
+"""Pallas flash-attention kernel parity vs the XLA reference path
+(reference analog: NKI kernel unit tests, test/unit/modules/kernels).
+
+On CPU the kernels run in interpreter mode; semantics must match
+ops/attention.py to float tolerance on every mask variant."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nxdi_tpu.ops.attention import attention_with_positions
+from nxdi_tpu.ops.kernels import flash_attention_decode, flash_attention_prefill
+
+
+def _rand(shape, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).standard_normal(shape), jnp.float32)
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2)])
+@pytest.mark.parametrize("window,chunk", [(None, None), (6, None), (None, 8)])
+def test_prefill_kernel_matches_xla(H, KV, window, chunk):
+    B, S, D = 2, 32, 16
+    q = _rand((B, H, S, D), 0)
+    k = _rand((B, KV, S, D), 1)
+    v = _rand((B, KV, S, D), 2)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32), (B, 1))
+    expected = attention_with_positions(
+        q, k, v, pos, pos, sliding_window=window, chunk_size=chunk
+    )
+    actual = flash_attention_prefill(
+        q, k, v, pos, pos, sliding_window=window, chunk_size=chunk,
+        block_q=8, block_k=8,
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+def test_prefill_kernel_right_padded_positions():
+    """Pad lanes carry positions past the true length; outputs at true
+    positions must be identical to the XLA path."""
+    B, H, KV, S, D = 1, 4, 2, 16, 8
+    q, k, v = _rand((B, H, S, D)), _rand((B, KV, S, D), 1), _rand((B, KV, S, D), 2)
+    pos = jnp.tile(jnp.arange(S, dtype=jnp.int32), (B, 1))
+    expected = attention_with_positions(q, k, v, pos, pos)
+    actual = flash_attention_prefill(q, k, v, pos, pos, block_q=4, block_k=4)
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2)])
+def test_decode_kernel_matches_xla(H, KV):
+    B, W, D = 2, 32, 16
+    q = _rand((B, H, 1, D), 0)
+    k = _rand((B, KV, W, D), 1)
+    v = _rand((B, KV, W, D), 2)
+    q_pos = jnp.array([[13], [7]], jnp.int32)
+    kv_pos = jnp.tile(jnp.arange(W, dtype=jnp.int32), (B, 1))
+    expected = attention_with_positions(q, k, v, q_pos, kv_pos)
+    actual = flash_attention_decode(q, k, v, q_pos, kv_pos, block_k=8)
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+def test_decode_kernel_sliding_window():
+    B, H, KV, W, D = 1, 4, 2, 32, 8
+    q = _rand((B, H, 1, D), 3)
+    k, v = _rand((B, KV, W, D), 4), _rand((B, KV, W, D), 5)
+    q_pos = jnp.array([[20]], jnp.int32)
+    kv_pos = jnp.tile(jnp.arange(W, dtype=jnp.int32), (B, 1))
+    expected = attention_with_positions(q, k, v, q_pos, kv_pos, sliding_window=8)
+    actual = flash_attention_decode(q, k, v, q_pos, kv_pos, sliding_window=8, block_k=8)
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
