@@ -146,7 +146,9 @@ def save_state_dict_safetensors(
         if cur and cur_bytes + nbytes > max_shard_size_bytes:
             shards.append(cur)
             cur, cur_bytes = {}, 0
-        cur[k] = np.asarray(v)
+        # safetensors serializes the raw buffer — transposed/strided views
+        # (e.g. converted (in, out)-layout weights) must be made contiguous
+        cur[k] = np.ascontiguousarray(v)
         cur_bytes += nbytes
     if cur:
         shards.append(cur)

@@ -206,6 +206,20 @@ class TpuConfig:
         self.kv_cache_quant = kwargs.pop("kv_cache_quant", False)
         if self.kv_cache_quant and self.kv_quant_config is None:
             self.kv_quant_config = KVQuantizationConfig()
+        # dynamic activation quantization (reference: config.py:434-517)
+        self.activation_quantization_type = kwargs.pop("activation_quantization_type", None)
+        self.quantize_clamp_bound = kwargs.pop("quantize_clamp_bound", None)
+        if self.activation_quantization_type is not None:
+            if self.activation_quantization_type != "dynamic":
+                raise ValueError(
+                    "activation_quantization_type: only 'dynamic' is supported "
+                    f"(got {self.activation_quantization_type!r})"
+                )
+            if not self.quantized or self.quantization_dtype != "int8":
+                raise ValueError(
+                    "activation_quantization_type='dynamic' requires quantized=True "
+                    "with quantization_dtype='int8' (the int8 MXU path)"
+                )
 
         # --- speculation (reference: config.py:244-272) ---
         spec = kwargs.pop("speculation_config", None)

@@ -37,6 +37,7 @@ from nxdi_tpu.kvcache.kv_cache import (
 from nxdi_tpu.ops import attention as attn_ops
 from nxdi_tpu.ops import kernels as attn_kernels
 from nxdi_tpu.ops import moe as moe_ops
+from nxdi_tpu.ops import quantization as quant_ops
 from nxdi_tpu.ops import sampling as sampling_ops
 from nxdi_tpu.ops.norms import rms_norm
 from nxdi_tpu.ops.rope import apply_rotary_pos_emb, rope_cos_sin
@@ -88,6 +89,10 @@ class DecoderArch:
     # Pallas kernel gates (reference: attn_kernel_enabled flags config.py:418-533)
     attn_kernel_enabled: bool = False
     attn_tkg_kernel_enabled: bool = False
+    # dynamic activation quantization (reference: ActivationQuantizationType
+    # config.py:434-517); weights themselves are quantized in the params pytree
+    act_quant: Optional[str] = None
+    act_clamp: Optional[float] = None
     # MoE feed-forward replaces the dense MLP when set (ops/moe.py)
     moe: Optional[moe_ops.MoEArch] = None
 
@@ -172,7 +177,11 @@ def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
 # Blocks
 # ---------------------------------------------------------------------------
 
-def _linear(x, p):
+def _linear(x, p, act_quant=None, clamp=None):
+    """Linear over either a full-precision param dict ``{"w"[, "b"]}`` or a
+    quantized one ``{"qw", "scale"[, "b"]}`` (ops/quantization.py)."""
+    if "qw" in p:
+        return quant_ops.quantized_linear(x, p, act_quant=act_quant, clamp_bound=clamp)
     y = x @ p["w"]
     if "b" in p:
         y = y + p["b"]
@@ -206,9 +215,10 @@ def attention_block(
     B, S, _ = hidden.shape
     H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
 
-    q = _linear(hidden, p_attn["q_proj"]).reshape(B, S, H, D)
-    k = _linear(hidden, p_attn["k_proj"]).reshape(B, S, KV, D)
-    v = _linear(hidden, p_attn["v_proj"]).reshape(B, S, KV, D)
+    aq, ac = arch.act_quant, arch.act_clamp
+    q = _linear(hidden, p_attn["q_proj"], aq, ac).reshape(B, S, H, D)
+    k = _linear(hidden, p_attn["k_proj"], aq, ac).reshape(B, S, KV, D)
+    v = _linear(hidden, p_attn["v_proj"], aq, ac).reshape(B, S, KV, D)
 
     if arch.qk_norm:
         q = rms_norm(q, p_attn["q_norm"], arch.rms_norm_eps)
@@ -275,16 +285,17 @@ def attention_block(
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
-    out = _linear(ctx, p_attn["o_proj"])
+    out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp)
     return out, (new_k, new_v)
 
 
 def mlp_block(arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array) -> jax.Array:
     """Gated MLP (SwiGLU family). XLA fuses act+mul into the matmuls."""
     act = ACT_FNS[arch.hidden_act]
-    gate = act(_linear(x, p_mlp["gate_proj"]))
-    up = _linear(x, p_mlp["up_proj"])
-    return _linear(gate * up, p_mlp["down_proj"])
+    aq, ac = arch.act_quant, arch.act_clamp
+    gate = act(_linear(x, p_mlp["gate_proj"], aq, ac))
+    up = _linear(x, p_mlp["up_proj"], aq, ac)
+    return _linear(gate * up, p_mlp["down_proj"], aq, ac)
 
 
 def decoder_layer(

@@ -81,6 +81,8 @@ def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
         dtype=dtype_name(config.tpu_config.dtype),
         attn_kernel_enabled=bool(config.tpu_config.attn_kernel_enabled),
         attn_tkg_kernel_enabled=bool(config.tpu_config.attn_tkg_kernel_enabled),
+        act_quant=getattr(config.tpu_config, "activation_quantization_type", None),
+        act_clamp=getattr(config.tpu_config, "quantize_clamp_bound", None),
     )
     kwargs.update(overrides)
     return DecoderArch(**kwargs)

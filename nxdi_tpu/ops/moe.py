@@ -139,21 +139,24 @@ def moe_block(arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array) -> jax.Array:
     B, S, H = x.shape
     xt = x.reshape(B * S, H)
 
+    from nxdi_tpu.ops.quantization import materialize_weight as mat_w
+
     router_logits = xt.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
     weights = route(router_logits, moe).astype(x.dtype)  # (T, E)
 
-    # dense dispatch: all experts on all tokens, combine contracted over E
-    gate = jnp.einsum("th,ehi->eti", xt, p["experts"]["gate_proj"]["w"])
-    up = jnp.einsum("th,ehi->eti", xt, p["experts"]["up_proj"]["w"])
+    # dense dispatch: all experts on all tokens, combine contracted over E.
+    # mat_w dequantizes low-bit expert weights in the einsum's operand read.
+    gate = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["gate_proj"], x.dtype))
+    up = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["up_proj"], x.dtype))
     inner = act(gate) * up  # (E, T, I)
-    expert_out = jnp.einsum("eti,eih->eth", inner, p["experts"]["down_proj"]["w"])
+    expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p["experts"]["down_proj"], x.dtype))
     out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
 
     if moe.shared_expert_intermediate_size:
         sp = p["shared_expert"]
         shared = (
-            act(xt @ sp["gate_proj"]["w"]) * (xt @ sp["up_proj"]["w"])
-        ) @ sp["down_proj"]["w"]
+            act(xt @ mat_w(sp["gate_proj"], x.dtype)) * (xt @ mat_w(sp["up_proj"], x.dtype))
+        ) @ mat_w(sp["down_proj"], x.dtype)
         if moe.shared_expert_gated:
             shared = jax.nn.sigmoid(
                 xt.astype(jnp.float32) @ p["shared_expert_gate"]["w"].astype(jnp.float32)

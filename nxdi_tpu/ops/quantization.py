@@ -1,0 +1,266 @@
+"""Quantization — weight int8/fp8, dynamic activation int8, pytree transforms.
+
+The reference quantizes offline via NxD's ``quantize`` (per-tensor / per-channel
+symmetric) and swaps modules to quantized parallel layers at load
+(application_base.py:744-797 ``save_quantized_state_dict``/``quantize()``,
+inference_demo.py:170-199 CLI flags, config.py:217-241 + :434-517 activation
+quantization). TPU-native, a "quantized linear" is just a low-bit weight array
+plus a scale array with matching PartitionSpecs; XLA fuses the dequantizing
+upcast-and-multiply into the matmul's operand read, so HBM traffic is the
+int8/fp8 bytes — which is the entire win on a bandwidth-bound chip.
+
+Conventions
+-----------
+- Weights live in ``(in, out)`` layout (parallel/layers.py); per-channel scales
+  reduce over the ``in`` axis with **keepdims**, so dequantization is always the
+  broadcast ``qw.astype(dt) * scale`` regardless of rank (works unchanged for
+  layer-stacked ``(L, in, out)`` leaves and MoE expert ``(E, in, out)`` /
+  ``(L, E, in, out)`` leaves).
+- A "linear param dict" is any sub-dict containing key ``"w"``. Quantization
+  replaces it with ``{"qw", "scale", **rest}``. ``models/base._linear`` and the
+  MoE einsums consume either form via :func:`materialize_weight` /
+  :func:`quantized_linear`.
+- Per-tensor scales keep full rank with all-singleton dims, so the same
+  broadcast rule applies.
+
+Activation quantization: ``dynamic`` computes a per-token symmetric scale on
+the activations, runs the matmul in int8 on the MXU
+(``preferred_element_type=int32``), and rescales — the analog of the
+reference's dynamic ``ActivationQuantizationType`` (config.py:434-517).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+# quant dtype name -> (numpy dtype, qmax)
+QUANT_DTYPES = {
+    "int8": (np.int8, 127.0),
+    "f8e4m3": (ml_dtypes.float8_e4m3fn, 448.0),
+    "f8e5m2": (ml_dtypes.float8_e5m2, 57344.0),
+}
+
+PER_TENSOR = "per_tensor_symmetric"
+PER_CHANNEL = "per_channel_symmetric"
+
+# Never quantized regardless of user config: routing stays full precision (the
+# reference keeps router/gating fp32 too — moe_v2.py RouterTopK), and these are
+# consumed via p["w"] directly in ops/moe.py.
+DEFAULT_MODULES_TO_NOT_CONVERT = ("router", "shared_expert_gate")
+
+
+def quantize_array(
+    w: np.ndarray, quant_dtype: str = "int8", scheme: str = PER_CHANNEL
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Symmetric quantization of an (in, out)-layout weight (any rank >= 2).
+
+    Returns ``(qw, scale)`` with ``scale`` float32, keepdims over the reduced
+    axes so that ``qw * scale`` dequantizes by broadcast.
+    """
+    np_dt, qmax = QUANT_DTYPES[quant_dtype]
+    w32 = np.asarray(w, dtype=np.float32)
+    if scheme == PER_TENSOR:
+        # leading stack dims (layer, expert) were separate tensors in the
+        # reference — keep one scale per stacked (in, out) matrix
+        amax = np.max(np.abs(w32), axis=(-2, -1), keepdims=True)
+    elif scheme == PER_CHANNEL:
+        # per-output-channel: reduce over the `in` axis (-2)
+        amax = np.max(np.abs(w32), axis=-2, keepdims=True)
+    else:
+        raise ValueError(f"unknown quantization scheme {scheme!r}")
+    scale = np.maximum(amax, 1e-12) / qmax
+    q = w32 / scale
+    if quant_dtype == "int8":
+        qw = np.clip(np.rint(q), -127, 127).astype(np_dt)
+    else:
+        qw = np.clip(q, -qmax, qmax).astype(np_dt)
+    return qw, scale.astype(np.float32)
+
+
+def dequantize_array(qw: np.ndarray, scale: np.ndarray, dtype=np.float32) -> np.ndarray:
+    return (np.asarray(qw, dtype=np.float32) * scale).astype(dtype)
+
+
+def is_quantized(p: Dict[str, Any]) -> bool:
+    return isinstance(p, dict) and "qw" in p
+
+
+def materialize_weight(p: Dict[str, Any], dtype) -> jax.Array:
+    """Return the (dequantized) weight for einsum-style consumers (MoE experts).
+    XLA fuses the convert+scale into the downstream contraction's operand read."""
+    if is_quantized(p):
+        return p["qw"].astype(dtype) * p["scale"].astype(dtype)
+    return p["w"].astype(dtype)
+
+
+def quantized_linear(
+    x: jax.Array,
+    p: Dict[str, Any],
+    act_quant: Optional[str] = None,
+    clamp_bound: Optional[float] = None,
+) -> jax.Array:
+    """``x @ W`` over a quantized param dict ``{"qw", "scale"[, "b"]}``.
+
+    Weight-only path: upcast-in-matmul, rescale after (scale broadcasts over the
+    out axis since it kept a singleton `in` dim). ``act_quant="dynamic"`` with an
+    int8 weight additionally quantizes activations per-token and runs the
+    contraction on the MXU in int8 (reference: config.py:434-517).
+    """
+    qw, scale = p["qw"], p["scale"]
+    if act_quant == "dynamic" and qw.dtype == jnp.int8:
+        if clamp_bound is not None:
+            x = jnp.clip(x, -clamp_bound, clamp_bound)
+        x_amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        x_scale = jnp.maximum(x_amax.astype(jnp.float32), 1e-12) / 127.0
+        qx = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / x_scale), -127, 127
+        ).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            qx, qw, (((qx.ndim - 1,), (qw.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        # scale: (..., 1, out) -> broadcast over y's out axis; x_scale per token
+        y = y.astype(jnp.float32) * x_scale * jnp.squeeze(scale, axis=-2)
+        y = y.astype(x.dtype)
+    else:
+        y = x @ qw.astype(x.dtype)
+        y = (y * jnp.squeeze(scale, axis=-2).astype(x.dtype))
+    if "b" in p:
+        y = y + p["b"]
+    return y
+
+
+# ---------------------------------------------------------------------------
+# Pytree transforms: params / PartitionSpecs / ShapeDtypeStructs
+# ---------------------------------------------------------------------------
+
+def _should_quantize(path: Tuple[str, ...], skip: Optional[list]) -> bool:
+    """Module-name filter (reference: ``modules_to_not_convert``,
+    inference_demo.py:170-199). ``skip`` entries match the last path component
+    ("o_proj") or a dotted path suffix ("attn.o_proj"). The defaults in
+    :data:`DEFAULT_MODULES_TO_NOT_CONVERT` always apply."""
+    skip = list(DEFAULT_MODULES_TO_NOT_CONVERT) + list(skip or [])
+    dotted = ".".join(str(s) for s in path)
+    for name in skip:
+        if path and str(path[-1]) == name:
+            return False
+        if dotted.endswith(name):
+            return False
+    return True
+
+
+def _walk(tree: Any, path: Tuple[str, ...], fn):
+    if isinstance(tree, dict):
+        if "w" in tree:
+            out = fn(tree, path)
+            if out is not None:
+                return out
+        return {k: _walk(v, path + (k,), fn) for k, v in tree.items()}
+    return tree
+
+
+def quantize_params(
+    params: Dict[str, Any],
+    quant_dtype: str = "int8",
+    scheme: str = PER_CHANNEL,
+    modules_to_not_convert: Optional[list] = None,
+) -> Dict[str, Any]:
+    """Quantize every linear param dict (``{"w": ...}``) in a host params
+    pytree. Biases and norms pass through untouched. This is the online analog
+    of the reference's offline ``generate_quantized_state_dict``."""
+
+    def fn(d, path):
+        if not _should_quantize(path, modules_to_not_convert):
+            return None
+        qw, scale = quantize_array(np.asarray(d["w"]), quant_dtype, scheme)
+        out = {k: v for k, v in d.items() if k != "w"}
+        out.update(qw=qw, scale=scale)
+        return out
+
+    return _walk(params, (), fn)
+
+
+def quantize_param_specs(
+    specs: Dict[str, Any],
+    scheme: str = PER_CHANNEL,
+    modules_to_not_convert: Optional[list] = None,
+) -> Dict[str, Any]:
+    """Mirror :func:`quantize_params` on a PartitionSpec pytree. The scale
+    inherits the weight's spec with the ``in`` axis (index -2) un-sharded —
+    per-output-channel scales shard exactly like the out dim."""
+
+    def fn(d, path):
+        if not _should_quantize(path, modules_to_not_convert):
+            return None
+        spec_w = d["w"]
+        entries = tuple(spec_w)
+        if len(entries) < 2:
+            # replicated / short spec (GSPMD pads trailing dims): scale replicated
+            scale_spec = P()
+        else:
+            out_entry = entries[-1] if scheme == PER_CHANNEL else None
+            scale_spec = P(*(entries[:-2] + (None, out_entry)))
+        out = {k: v for k, v in d.items() if k != "w"}
+        out.update(qw=spec_w, scale=scale_spec)
+        return out
+
+    return _walk(specs, (), fn)
+
+
+def quantize_shape_struct(
+    struct: Dict[str, Any],
+    quant_dtype: str = "int8",
+    scheme: str = PER_CHANNEL,
+    modules_to_not_convert: Optional[list] = None,
+) -> Dict[str, Any]:
+    """Mirror :func:`quantize_params` on a ShapeDtypeStruct pytree (AOT compile
+    path, application.py params_shape_struct)."""
+    np_dt, _ = QUANT_DTYPES[quant_dtype]
+
+    def fn(d, path):
+        if not _should_quantize(path, modules_to_not_convert):
+            return None
+        s = d["w"]
+        if scheme == PER_TENSOR:
+            scale_shape = s.shape[:-2] + (1, 1)
+        else:
+            scale_shape = s.shape[:-2] + (1, s.shape[-1])
+        out = {k: v for k, v in d.items() if k != "w"}
+        out.update(
+            qw=jax.ShapeDtypeStruct(s.shape, jnp.dtype(np_dt)),
+            scale=jax.ShapeDtypeStruct(scale_shape, jnp.float32),
+        )
+        return out
+
+    return _walk(struct, (), fn)
+
+
+def flatten_params(params: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:
+    """Dotted-key flat dict for safetensors round-trip of quantized checkpoints
+    (reference saves quantized state dicts to ``quantized_checkpoints_path``,
+    application_base.py:744)."""
+    flat: Dict[str, np.ndarray] = {}
+    for k, v in params.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            flat.update(flatten_params(v, key + "."))
+        else:
+            flat[key] = np.asarray(v)
+    return flat
+
+
+def unflatten_params(flat: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key, v in flat.items():
+        parts = key.split(".")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+        d[parts[-1]] = v
+    return out

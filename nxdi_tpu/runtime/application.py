@@ -47,6 +47,46 @@ TAG_PREFIX_PREFILL = "prefix_prefill_model"
 logger = logging.getLogger("nxdi_tpu")
 
 
+def maybe_quantize_params(params, tc):
+    """Apply weight quantization per the TpuConfig (no-op unless quantized).
+    Shared by every application subclass, including ones that override
+    build_params (fused speculation's draft/target sub-pytrees)."""
+    if not tc.quantized:
+        return params
+    from nxdi_tpu.ops import quantization as quant_ops
+
+    return quant_ops.quantize_params(
+        params,
+        quant_dtype=tc.quantization_dtype,
+        scheme=tc.quantization_type,
+        modules_to_not_convert=tc.modules_to_not_convert,
+    )
+
+
+def maybe_quantize_specs(specs, tc):
+    if not tc.quantized:
+        return specs
+    from nxdi_tpu.ops import quantization as quant_ops
+
+    return quant_ops.quantize_param_specs(
+        specs, scheme=tc.quantization_type,
+        modules_to_not_convert=tc.modules_to_not_convert,
+    )
+
+
+def maybe_quantize_struct(struct, tc):
+    if not tc.quantized:
+        return struct
+    from nxdi_tpu.ops import quantization as quant_ops
+
+    return quant_ops.quantize_shape_struct(
+        struct,
+        quant_dtype=tc.quantization_dtype,
+        scheme=tc.quantization_type,
+        modules_to_not_convert=tc.modules_to_not_convert,
+    )
+
+
 def enable_persistent_cache(path: str) -> None:
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
@@ -84,12 +124,37 @@ class ApplicationBase:
         return sd
 
     def build_params(self) -> Any:
-        sd = self.get_state_dict()
-        return self.family.convert_hf_state_dict(sd, self.config)
+        tc = self.tpu_config
+        if tc.quantized and tc.quantized_checkpoints_path and os.path.isdir(
+            tc.quantized_checkpoints_path
+        ):
+            # pre-quantized artifact (reference: quantized_checkpoints_path,
+            # application_base.py:744) — skip HF conversion + re-quantization
+            from nxdi_tpu.ops import quantization as quant_ops
 
-    # -- overridable pytree layouts (multi-model apps override all three) --
+            sd = ckpt.load_state_dict(tc.quantized_checkpoints_path)
+            return quant_ops.unflatten_params(sd)
+        sd = self.get_state_dict()
+        params = self.family.convert_hf_state_dict(sd, self.config)
+        return maybe_quantize_params(params, tc)
+
+    def save_quantized_state_dict(self, path: str) -> None:
+        """Offline weight quantization artifact (reference:
+        application_base.py:744 ``save_quantized_state_dict``): quantize the
+        converted params pytree and save it flat as safetensors for fast reload
+        via ``quantized_checkpoints_path``."""
+        from nxdi_tpu.ops import quantization as quant_ops
+
+        sd = self.get_state_dict()
+        params = self.family.convert_hf_state_dict(sd, self.config)
+        flat = quant_ops.flatten_params(maybe_quantize_params(params, self.tpu_config))
+        os.makedirs(path, exist_ok=True)
+        ckpt.save_state_dict_safetensors(flat, path)
+
+    # -- overridable pytree layouts (multi-model apps override all three and
+    # must apply maybe_quantize_* to each sub-pytree themselves) --
     def param_specs(self):
-        return self.family.param_specs(self.config)
+        return maybe_quantize_specs(self.family.param_specs(self.config), self.tpu_config)
 
     def cache_partition_specs(self):
         if self.tpu_config.is_block_kv_layout:
@@ -120,7 +185,8 @@ class ApplicationBase:
     def build_params_struct(self):
         """Abstract param pytree (no weight IO) for AOT lowering."""
         arch = self.family.build_arch(self.config)
-        return params_shape_struct(self.family, self.config, arch)
+        struct = params_shape_struct(self.family, self.config, arch)
+        return maybe_quantize_struct(struct, self.tpu_config)
 
     def _cache_struct(self):
         spec = self._cache_spec()

@@ -282,7 +282,11 @@ class ModelWrapper:
             device_batch["rng"] = jnp.asarray(rng, dtype=jnp.uint32)
         for hook in self.pre_hooks:
             hook(self.tag)
-        outputs, new_cache = self._programs[bucket](params, cache, device_batch)
+        # dispatch under this app's mesh: several apps with different meshes
+        # can coexist in one process (the reference runs draft+target or
+        # encoder+decoder apps side by side the same way)
+        with jax.set_mesh(self._mesh):
+            outputs, new_cache = self._programs[bucket](params, cache, device_batch)
         if self.post_hooks:
             jax.block_until_ready(outputs)
             for hook in self.post_hooks:
@@ -347,4 +351,5 @@ class ModelWrapper:
         ``total_len`` (host-tracked) picks the bucket; no device sync happens.
         """
         bucket = self.select_bucket(total_len)
-        return self._programs[bucket](params, cache, device_batch)
+        with jax.set_mesh(self._mesh):
+            return self._programs[bucket](params, cache, device_batch)

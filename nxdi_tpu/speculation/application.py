@@ -16,7 +16,13 @@ from nxdi_tpu import checkpoint as ckpt
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
 from nxdi_tpu.runtime import autobucketing
-from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+from nxdi_tpu.runtime.application import (
+    TpuModelForCausalLM,
+    maybe_quantize_params,
+    maybe_quantize_specs,
+    maybe_quantize_struct,
+    params_shape_struct,
+)
 from nxdi_tpu.runtime.model_wrapper import (
     TAG_CONTEXT_ENCODING,
     TAG_FUSED_SPECULATION,
@@ -62,20 +68,33 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
         draft = self.draft_family.convert_hf_state_dict(
             self.get_draft_state_dict(), self.draft_config
         )
-        return {"draft": draft, "target": target}
+        return {
+            "draft": maybe_quantize_params(draft, self.draft_config.tpu_config),
+            "target": maybe_quantize_params(target, self.tpu_config),
+        }
 
     def build_params_struct(self):
         t_arch = self.family.build_arch(self.config)
         d_arch = self.draft_family.build_arch(self.draft_config)
         return {
-            "draft": params_shape_struct(self.draft_family, self.draft_config, d_arch),
-            "target": params_shape_struct(self.family, self.config, t_arch),
+            "draft": maybe_quantize_struct(
+                params_shape_struct(self.draft_family, self.draft_config, d_arch),
+                self.draft_config.tpu_config,
+            ),
+            "target": maybe_quantize_struct(
+                params_shape_struct(self.family, self.config, t_arch), self.tpu_config
+            ),
         }
 
     def param_specs(self):
         return {
-            "draft": self.draft_family.param_specs(self.draft_config),
-            "target": self.family.param_specs(self.config),
+            "draft": maybe_quantize_specs(
+                self.draft_family.param_specs(self.draft_config),
+                self.draft_config.tpu_config,
+            ),
+            "target": maybe_quantize_specs(
+                self.family.param_specs(self.config), self.tpu_config
+            ),
         }
 
     def cache_partition_specs(self):

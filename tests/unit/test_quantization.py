@@ -1,0 +1,151 @@
+"""Quantization unit tests (reference analog: NxD quantize + quantized layer
+swap, application_base.py:744-797; activation quant config.py:434-517)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.ops import quantization as q
+
+
+def test_int8_per_channel_roundtrip():
+    rng = np.random.default_rng(0)
+    w = rng.standard_normal((64, 32)).astype(np.float32)
+    qw, scale = q.quantize_array(w, "int8", q.PER_CHANNEL)
+    assert qw.dtype == np.int8 and scale.shape == (1, 32)
+    wd = q.dequantize_array(qw, scale)
+    err = np.abs(wd - w).max() / np.abs(w).max()
+    assert err < 0.01, err
+
+
+def test_per_tensor_and_fp8():
+    rng = np.random.default_rng(1)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    qw, scale = q.quantize_array(w, "int8", q.PER_TENSOR)
+    assert scale.shape == (1, 1)
+    # stacked leaves keep one scale per (in, out) matrix so the layer scan works
+    ws = np.stack([w, w * 2])
+    _, scale_s = q.quantize_array(ws, "int8", q.PER_TENSOR)
+    assert scale_s.shape == (2, 1, 1)
+    assert np.abs(q.dequantize_array(qw, scale) - w).max() < 0.05
+
+    for fp8 in ("f8e4m3", "f8e5m2"):
+        qw, scale = q.quantize_array(w, fp8, q.PER_CHANNEL)
+        wd = q.dequantize_array(qw, scale)
+        assert np.abs(wd - w).max() / np.abs(w).max() < 0.1
+
+
+def test_stacked_and_expert_rank():
+    """Layer-stacked (L, in, out) and expert (E, in, out) leaves keep per-leaf
+    broadcastable scales."""
+    rng = np.random.default_rng(2)
+    w = rng.standard_normal((3, 4, 16, 8)).astype(np.float32)  # (L, E, in, out)
+    qw, scale = q.quantize_array(w, "int8", q.PER_CHANNEL)
+    assert scale.shape == (3, 4, 1, 8)
+    assert np.abs(q.dequantize_array(qw, scale) - w).max() / np.abs(w).max() < 0.01
+
+
+def test_quantized_linear_matches_dequantized():
+    rng = np.random.default_rng(3)
+    w = rng.standard_normal((32, 16)).astype(np.float32)
+    b = rng.standard_normal((16,)).astype(np.float32)
+    x = rng.standard_normal((4, 32)).astype(np.float32)
+    qw, scale = q.quantize_array(w)
+    p = {"qw": jnp.asarray(qw), "scale": jnp.asarray(scale), "b": jnp.asarray(b)}
+    y = q.quantized_linear(jnp.asarray(x), p)
+    y_ref = x @ q.dequantize_array(qw, scale) + b
+    np.testing.assert_allclose(np.asarray(y), y_ref, rtol=2e-5, atol=2e-5)
+
+
+def test_dynamic_activation_quant():
+    rng = np.random.default_rng(4)
+    w = rng.standard_normal((32, 16)).astype(np.float32)
+    x = rng.standard_normal((4, 32)).astype(np.float32)
+    qw, scale = q.quantize_array(w)
+    p = {"qw": jnp.asarray(qw), "scale": jnp.asarray(scale)}
+    y = q.quantized_linear(jnp.asarray(x), p, act_quant="dynamic")
+    y_ref = x @ w
+    # int8 x int8 on both operands: ~1-2% relative error expected
+    rel = np.abs(np.asarray(y) - y_ref).max() / np.abs(y_ref).max()
+    assert rel < 0.05, rel
+
+
+def test_pytree_transforms_align():
+    rng = np.random.default_rng(5)
+    params = {
+        "embed_tokens": rng.standard_normal((8, 4)).astype(np.float32),
+        "layers": {
+            "attn": {
+                "q_proj": {"w": rng.standard_normal((2, 4, 4)).astype(np.float32)},
+                "o_proj": {"w": rng.standard_normal((2, 4, 4)).astype(np.float32)},
+            },
+            "mlp": {
+                "down_proj": {
+                    "w": rng.standard_normal((2, 6, 4)).astype(np.float32),
+                    "b": rng.standard_normal((2, 4)).astype(np.float32),
+                }
+            },
+            "input_layernorm": rng.standard_normal((2, 4)).astype(np.float32),
+        },
+    }
+    specs = {
+        "embed_tokens": P("tp", None),
+        "layers": {
+            "attn": {
+                "q_proj": {"w": P(None, None, "tp")},
+                "o_proj": {"w": P(None, "tp", None)},
+            },
+            "mlp": {"down_proj": {"w": P(None, "tp", None), "b": P(None, None)}},
+            "input_layernorm": P(None, None),
+        },
+    }
+    skip = ["o_proj"]
+    qp = q.quantize_params(params, modules_to_not_convert=skip)
+    qs = q.quantize_param_specs(specs, modules_to_not_convert=skip)
+
+    # same structure
+    assert jax.tree_util.tree_structure(
+        jax.tree_util.tree_map(lambda _: 0, qp)
+    ) == jax.tree_util.tree_structure(
+        jax.tree_util.tree_map(lambda _: 0, qs, is_leaf=lambda x: isinstance(x, P))
+    )
+    # o_proj untouched; q_proj quantized; bias preserved
+    assert "w" in qp["layers"]["attn"]["o_proj"]
+    assert "qw" in qp["layers"]["attn"]["q_proj"]
+    assert "b" in qp["layers"]["mlp"]["down_proj"]
+    # scale spec: in axis un-sharded, out axis inherits
+    assert qs["layers"]["attn"]["q_proj"]["scale"] == P(None, None, "tp")
+    assert qs["layers"]["mlp"]["down_proj"]["scale"] == P(None, None, None)
+
+    # shape struct mirrors quantized params
+    struct = jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params
+    )
+    qstruct = q.quantize_shape_struct(struct, modules_to_not_convert=skip)
+    got = jax.tree_util.tree_map(lambda a: (a.shape, str(jnp.asarray(a).dtype)), qp)
+    want = jax.tree_util.tree_map(lambda s: (s.shape, str(s.dtype)), qstruct)
+    assert got == want
+
+
+def test_flatten_unflatten_roundtrip():
+    rng = np.random.default_rng(6)
+    params = {
+        "a": {"b": {"qw": rng.integers(-127, 127, (4, 4), dtype=np.int8),
+                    "scale": rng.random((1, 4)).astype(np.float32)}},
+        "c": rng.standard_normal((3,)).astype(np.float32),
+    }
+    flat = q.flatten_params(params)
+    assert set(flat) == {"a.b.qw", "a.b.scale", "c"}
+    back = q.unflatten_params(flat)
+    np.testing.assert_array_equal(back["a"]["b"]["qw"], params["a"]["b"]["qw"])
+    np.testing.assert_array_equal(back["c"], params["c"])
+
+
+@pytest.mark.parametrize("scheme", [q.PER_TENSOR, q.PER_CHANNEL])
+def test_should_quantize_filter(scheme):
+    assert q._should_quantize(("layers", "attn", "q_proj"), None)
+    assert not q._should_quantize(("layers", "attn", "q_proj"), ["q_proj"])
+    assert not q._should_quantize(("layers", "attn", "q_proj"), ["attn.q_proj"])
+    assert q._should_quantize(("layers", "attn", "q_proj"), ["k_proj"])
