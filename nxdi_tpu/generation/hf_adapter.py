@@ -92,6 +92,12 @@ class HuggingFaceGenerationAdapter:
                 if max_new_tokens is not None
                 else self.tpu_config.seq_len
             )
+        if int(lengths.max()) > self.tpu_config.max_context_length:
+            raise ValueError(
+                f"prompt length {int(lengths.max())} exceeds max_context_length "
+                f"{self.tpu_config.max_context_length} (largest context-encoding "
+                "bucket); recompile with a larger max_context_length"
+            )
         max_length = min(max_length, self.tpu_config.seq_len)
         n_new = max_length - int(lengths.max())
         if n_new <= 0:

@@ -150,7 +150,9 @@ def _should_quantize(path: Tuple[str, ...], skip: Optional[list]) -> bool:
     for name in skip:
         if path and str(path[-1]) == name:
             return False
-        if dotted.endswith(name):
+        # dotted-suffix match on component boundaries only: "attn.q_proj"
+        # must not match "self_attn.q_proj"
+        if dotted == name or dotted.endswith("." + name):
             return False
     return True
 
@@ -239,6 +241,47 @@ def quantize_shape_struct(
         return out
 
     return _walk(struct, (), fn)
+
+
+def validate_quantized_params(params: Dict[str, Any], tpu_config) -> None:
+    """Check a loaded pre-quantized artifact against the configured scheme:
+    qw dtype must match ``quantization_dtype`` and scale shapes must match
+    ``quantization_type`` (an artifact saved per-channel loaded under a
+    per-tensor config would otherwise fail deep inside AOT compile)."""
+    np_dt, _ = QUANT_DTYPES[tpu_config.quantization_dtype]
+    scheme = tpu_config.quantization_type
+    problems = []
+
+    def visit(tree, path):
+        if not isinstance(tree, dict):
+            return
+        if "qw" in tree:
+            name = ".".join(path)
+            if np.dtype(tree["qw"].dtype) != np.dtype(np_dt):
+                problems.append(
+                    f"{name}: qw dtype {tree['qw'].dtype} != configured "
+                    f"quantization_dtype={tpu_config.quantization_dtype}"
+                )
+            want = (
+                tree["qw"].shape[:-2] + (1, 1)
+                if scheme == PER_TENSOR
+                else tree["qw"].shape[:-2] + (1, tree["qw"].shape[-1])
+            )
+            if tuple(tree["scale"].shape) != want:
+                problems.append(
+                    f"{name}: scale shape {tuple(tree['scale'].shape)} != {want} "
+                    f"expected for quantization_type={scheme}"
+                )
+            return
+        for k, v in tree.items():
+            visit(v, path + (k,))
+
+    visit(params, ())
+    if problems:
+        raise ValueError(
+            "quantized_checkpoints_path artifact does not match the configured "
+            "quantization scheme:\n  " + "\n  ".join(problems[:8])
+        )
 
 
 def flatten_params(params: Dict[str, Any], prefix: str = "") -> Dict[str, np.ndarray]:

@@ -125,15 +125,21 @@ class ApplicationBase:
 
     def build_params(self) -> Any:
         tc = self.tpu_config
-        if tc.quantized and tc.quantized_checkpoints_path and os.path.isdir(
-            tc.quantized_checkpoints_path
-        ):
+        if tc.quantized and tc.quantized_checkpoints_path:
             # pre-quantized artifact (reference: quantized_checkpoints_path,
             # application_base.py:744) — skip HF conversion + re-quantization
+            if not os.path.isdir(tc.quantized_checkpoints_path):
+                raise FileNotFoundError(
+                    f"quantized_checkpoints_path={tc.quantized_checkpoints_path!r}"
+                    " does not exist; run save_quantized_state_dict first or"
+                    " unset it to quantize online from the HF checkpoint"
+                )
             from nxdi_tpu.ops import quantization as quant_ops
 
             sd = ckpt.load_state_dict(tc.quantized_checkpoints_path)
-            return quant_ops.unflatten_params(sd)
+            params = quant_ops.unflatten_params(sd)
+            quant_ops.validate_quantized_params(params, tc)
+            return params
         sd = self.get_state_dict()
         params = self.family.convert_hf_state_dict(sd, self.config)
         return maybe_quantize_params(params, tc)

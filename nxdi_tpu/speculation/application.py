@@ -64,6 +64,12 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
         return ckpt.load_state_dict(self.draft_model_path)
 
     def build_params(self) -> Dict[str, Any]:
+        if self.tpu_config.quantized and self.tpu_config.quantized_checkpoints_path:
+            raise NotImplementedError(
+                "quantized_checkpoints_path is not supported with fused "
+                "speculation yet (the artifact holds a single model, not the "
+                "draft+target pair); unset it to quantize online"
+            )
         target = self.family.convert_hf_state_dict(self.get_state_dict(), self.config)
         draft = self.draft_family.convert_hf_state_dict(
             self.get_draft_state_dict(), self.draft_config
