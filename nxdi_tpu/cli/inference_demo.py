@@ -74,8 +74,11 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
 
     # speculation
     p.add_argument("--draft-model-path", default=None)
+    p.add_argument("--draft-model-type", default=None, help="defaults to --model-type")
     p.add_argument("--speculation-length", type=int, default=0)
     p.add_argument("--enable-fused-speculation", action="store_true")
+    p.add_argument("--enable-eagle-speculation", action="store_true")
+    p.add_argument("--is-eagle3", action="store_true")
 
     # quantization
     p.add_argument("--quantized", action="store_true")
@@ -129,6 +132,8 @@ def create_tpu_config(args):
         async_mode=args.async_mode,
         speculation_length=args.speculation_length,
         enable_fused_speculation=args.enable_fused_speculation,
+        enable_eagle_speculation=args.enable_eagle_speculation,
+        is_eagle3=args.is_eagle3,
         quantized=args.quantized,
         quantization_dtype=args.quantization_dtype,
         kv_cache_quant=args.kv_cache_quant,
@@ -169,7 +174,17 @@ def run_inference(args) -> int:
     tpu_config = create_tpu_config(args)
     config = cfg_cls(tpu_config, load_config=load_pretrained_config(args.model_path))
 
-    app = TpuModelForCausalLM(args.model_path, config, model_family=family)
+    wants_spec = args.enable_fused_speculation or args.enable_eagle_speculation
+    if wants_spec and not args.draft_model_path:
+        raise ValueError(
+            "--enable-fused-speculation/--enable-eagle-speculation require "
+            "--draft-model-path (there is no draft model to speculate with)"
+        )
+    if wants_spec:
+        # draft config surgery (reference: inference_demo.py:502-537)
+        app = _build_spec_app(args, family, config)
+    else:
+        app = TpuModelForCausalLM(args.model_path, config, model_family=family)
     if args.compiled_model_path and not args.skip_compile:
         app.compile(args.compiled_model_path)
     app.load(args.compiled_model_path)
@@ -211,6 +226,41 @@ def run_inference(args) -> int:
             **{k: v for k, v in gen_kwargs.items() if k != "max_new_tokens"},
         )
     return rc
+
+
+def _build_spec_app(args, family, config):
+    """Fused / EAGLE speculation application construction (reference: draft
+    model config surgery inference_demo.py:502-537)."""
+    from nxdi_tpu.config import TpuConfig
+    from nxdi_tpu.generation.hf_adapter import load_pretrained_config
+    from nxdi_tpu.models.registry import get_family
+    from nxdi_tpu.speculation import EagleSpecCausalLM, FusedSpecCausalLM
+
+    draft_tpu = TpuConfig(
+        **{
+            **{k: v for k, v in config.tpu_config.to_dict().items()
+               if k not in ("speculation_config", "speculation_length",
+                            "enable_fused_speculation", "enable_eagle_speculation")},
+            "is_eagle3": args.is_eagle3,
+        }
+    )
+    if args.enable_eagle_speculation:
+        from nxdi_tpu.models import llama_eagle
+
+        dcfg = llama_eagle.LlamaEagleInferenceConfig(
+            draft_tpu, load_config=load_pretrained_config(args.draft_model_path)
+        )
+        return EagleSpecCausalLM(
+            args.model_path, config, args.draft_model_path, dcfg, model_family=family
+        )
+    d_family, d_cfg_cls = get_family(args.draft_model_type or args.model_type)
+    dcfg = d_cfg_cls(
+        draft_tpu, load_config=load_pretrained_config(args.draft_model_path)
+    )
+    return FusedSpecCausalLM(
+        args.model_path, config, args.draft_model_path, dcfg,
+        model_family=family, draft_family=d_family,
+    )
 
 
 def _run_accuracy(args, app, adapter, input_ids) -> int:

@@ -237,6 +237,7 @@ class TpuConfig:
         )
         if self.enable_eagle_speculation:
             self.enable_fused_speculation = True
+        self.is_eagle3 = kwargs.pop("is_eagle3", spec.is_eagle3 if spec else False)
         self.is_eagle_draft = kwargs.pop("is_eagle_draft", False)
         self.is_medusa = kwargs.pop("is_medusa", False)
         self.medusa_speculation_length = kwargs.pop("medusa_speculation_length", 0)

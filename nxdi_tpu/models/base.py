@@ -314,6 +314,10 @@ def decoder_layer(
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
 ):
     h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
+    if "input_norm_skip" in lp:
+        # per-layer scalar riding the scan xs: EAGLE drafts feed the fc output
+        # straight into attention for their first layer (no input norm)
+        h = jnp.where(lp["input_norm_skip"], hidden, h)
     attn_out, (nk, nv) = attention_block(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
@@ -342,6 +346,7 @@ def run_decoder_layers(
     policy: ShardingPolicy = DEFAULT_POLICY,
     layout=DEFAULT_KV_LAYOUT,
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
+    collect_hidden: bool = False,
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
 
@@ -349,6 +354,11 @@ def run_decoder_layers(
     budget (reference: per-bucket compiled TKG programs attend only bucket-many
     positions) while writes still target the full-length cache. Contiguous
     layout only — the block layout's window is its block-table width.
+
+    ``collect_hidden`` additionally stacks each layer's output hidden state as
+    scan ys — (L, B, S, hidden) — for EAGLE3's aux-feature taps (reference:
+    model_base.py:1581). Costs L×B×S×H activation memory, so only submodels
+    that need it compile with it; returns a 3-tuple then.
     """
 
     windowable = not isinstance(layout, BlockKVLayout)
@@ -368,9 +378,13 @@ def run_decoder_layers(
                 arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec,
                 attend_to_cache, policy, layout, cache_inputs,
             )
-        return h, (nk, nv)
+        return h, ((nk, nv, h) if collect_hidden else (nk, nv))
 
-    hidden, (new_k, new_v) = jax.lax.scan(body, hidden, (layer_params, cache["k"], cache["v"]))
+    hidden, ys = jax.lax.scan(body, hidden, (layer_params, cache["k"], cache["v"]))
+    if collect_hidden:
+        new_k, new_v, layer_hiddens = ys
+        return hidden, {"k": new_k, "v": new_v}, layer_hiddens
+    new_k, new_v = ys
     return hidden, {"k": new_k, "v": new_v}
 
 
@@ -397,6 +411,8 @@ def causal_lm_forward(
     global_topk: int = 256,
     deterministic: bool = False,
     return_next_inputs: bool = False,
+    output_hidden: bool = False,
+    aux_hidden_indices: Optional[Tuple[int, ...]] = None,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
 
@@ -411,6 +427,15 @@ def causal_lm_forward(
     compute_dtype = to_jax_dtype(arch.dtype)
 
     hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    if "fc" in params:
+        # EAGLE draft input: concat(token embedding, previous-position feature)
+        # projected back to the hidden size (reference: the EAGLE draft fc,
+        # modeling_llama.py:1408, fed target hidden states model_base.py:1581).
+        feats = batch["prev_hidden"].astype(compute_dtype)
+        hidden = _linear(
+            jnp.concatenate([hidden, feats], axis=-1),
+            params["fc"], arch.act_quant, arch.act_clamp,
+        )
     hidden = constrain(hidden, policy.hidden)
     cos, sin = rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32)
 
@@ -429,12 +454,23 @@ def causal_lm_forward(
     cache_inputs = {
         k: batch[k] for k in ("seq_ids", "slot_mapping", "block_table") if k in batch
     }
-    hidden, new_cache = run_decoder_layers(
-        arch, params["layers"], hidden, cos, sin, cache,
-        position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
-        policy=policy, layout=layout, cache_inputs=cache_inputs,
-    )
-    hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
+    layer_hiddens = None
+    if aux_hidden_indices:
+        hidden, new_cache, layer_hiddens = run_decoder_layers(
+            arch, params["layers"], hidden, cos, sin, cache,
+            position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+            policy=policy, layout=layout, cache_inputs=cache_inputs,
+            collect_hidden=True,
+        )
+    else:
+        hidden, new_cache = run_decoder_layers(
+            arch, params["layers"], hidden, cos, sin, cache,
+            position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+            policy=policy, layout=layout, cache_inputs=cache_inputs,
+        )
+    pre_norm_hidden = hidden
+    if "norm" in params:  # EAGLE drafts have no final norm
+        hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
 
     lm_head = params.get("lm_head")
     if lm_head is None:  # tied embeddings
@@ -451,6 +487,13 @@ def causal_lm_forward(
     logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
 
     outputs: Dict[str, jax.Array] = {}
+    if output_hidden:
+        # last-layer hidden BEFORE the final norm — the EAGLE feature stream
+        outputs["hidden"] = pre_norm_hidden
+    if aux_hidden_indices:
+        # (B, S, len(indices)*H) concat of selected layers' outputs (EAGLE3)
+        sel = [layer_hiddens[i] for i in aux_hidden_indices]
+        outputs["aux_hidden"] = jnp.concatenate(sel, axis=-1)
     if output_all_logits and gather_last_token:
         # still provide the last-position logits for the sampler
         idx = batch["last_token_index"][:, None, None]

@@ -1,0 +1,174 @@
+"""EAGLE draft model family (llama-lineage).
+
+The reference expresses EAGLE drafts as a llama variant with an ``fc`` layer
+fusing (embedding, previous hidden state) (modeling_llama.py:1408-1416) and
+wires them into fused speculation via ``FusedSpecNeuronConfig``
+(config.py:1009). Here the draft is its own model family: the dense param
+layout (models/dense.py) plus
+
+  - ``fc``          — (2H, H) projection of concat(embed, feature),
+  - ``fc_features`` — (kH, H) EAGLE3 aux-feature projection (k = number of
+                      captured target layers),
+  - ``d2t``         — optional EAGLE3 draft→target vocab id table,
+  - ``input_norm_skip`` — per-layer flag: official EAGLE drafts feed the fc
+                      output into attention without an input layernorm for
+                      layer 0; the flag rides the layer scan (models/base.py).
+
+EAGLE drafts have no final norm; conversion simply omits ``norm`` and the
+forward skips it (models/base.py handles a missing ``norm``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class LlamaEagleInferenceConfig(dense.DenseInferenceConfig):
+    """Draft hyperparams; ``target_vocab_size`` is set (by the application)
+    when the draft vocabulary is reduced (EAGLE3 d2t)."""
+
+    def add_derived_config(self):
+        super().add_derived_config()
+        # drafts always own an explicit lm_head over (possibly reduced) vocab
+        self.tie_word_embeddings = False
+
+
+def build_arch(config, **overrides) -> DecoderArch:
+    return dense.build_arch(config, **overrides)
+
+
+def _layer_key(i: int, name: str) -> str:
+    return f"layers.{i}.{name}"
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config, arch: DecoderArch = None
+) -> Dict[str, Any]:
+    arch = arch or build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+    sd = dict(state_dict)
+
+    # strip HF "model." prefixes once so the probes below are uniform
+    sd = {k[len("model."):] if k.startswith("model.") else k: v for k, v in sd.items()}
+
+    # layer-0 input layernorm is absent in official EAGLE drafts: synthesize a
+    # placeholder weight (never read — the skip flag bypasses it) and record
+    # which layers skip
+    skip = np.zeros((arch.num_layers,), dtype=bool)
+    for i in range(arch.num_layers):
+        key = _layer_key(i, "input_layernorm.weight")
+        if key not in sd:
+            sd[key] = np.ones((arch.hidden_size,), dtype=dt)
+            skip[i] = True
+
+    had_norm = "norm.weight" in sd
+    if not had_norm:
+        sd["norm.weight"] = np.ones((arch.hidden_size,), dtype=dt)
+
+    # EAGLE3 reduced draft vocab: the draft EMBEDS target-vocab ids (borrowed
+    # target table) but its lm_head scores only the draft vocab; d2t maps the
+    # argmax back to target ids. Stash the target-vocab embedding so the dense
+    # converter (which assumes one vocab) pads only the lm_head side. Gated on
+    # is_eagle3 — the same predicate param_specs/param_shape_struct use — so
+    # the three pytrees always agree regardless of checkpoint contents.
+    is_eagle3 = bool(config.tpu_config.is_eagle3)
+    target_embed = None
+    if is_eagle3:
+        target_embed = np.asarray(sd["embed_tokens.weight"], dtype=dt)
+        sd["embed_tokens.weight"] = np.zeros(
+            (config.vocab_size, arch.hidden_size), dtype=dt
+        )
+    elif "d2t" in sd:
+        del sd["d2t"]  # non-eagle3 drafts have no reduced vocab to translate
+
+    params = dense.convert_hf_state_dict(sd, config, arch)
+    if not had_norm:
+        del params["norm"]
+    params["layers"]["input_norm_skip"] = skip
+
+    if target_embed is not None:
+        tp = config.tpu_config.tp_degree
+        tv = target_embed.shape[0]
+        pad = (-tv) % tp
+        if pad:
+            target_embed = np.concatenate(
+                [target_embed, np.zeros((pad, target_embed.shape[1]), dtype=dt)], axis=0
+            )
+        params["embed_tokens"] = target_embed
+
+    params["fc"] = {"w": np.asarray(sd["fc.weight"], dtype=dt).T}
+    if "fc.bias" in sd:
+        params["fc"]["b"] = np.asarray(sd["fc.bias"], dtype=dt)
+    if "fc_features.weight" in sd:
+        params["fc_features"] = {"w": np.asarray(sd["fc_features.weight"], dtype=dt).T}
+        if "fc_features.bias" in sd:
+            params["fc_features"]["b"] = np.asarray(sd["fc_features.bias"], dtype=dt)
+    elif is_eagle3:
+        raise KeyError(
+            "is_eagle3 requires an fc_features.weight in the draft checkpoint "
+            "(projects the concatenated target aux hidden states)"
+        )
+    if is_eagle3:
+        draft_vocab = arch.vocab_size - arch.vocab_pad
+        params["d2t"] = (
+            np.asarray(sd["d2t"], dtype=np.int32)
+            if "d2t" in sd
+            else np.arange(draft_vocab, dtype=np.int32)  # full-vocab draft head
+        )
+    return params
+
+
+def param_specs(config) -> Dict[str, Any]:
+    arch = build_arch(config)
+    specs = dense.param_specs_for(arch)
+    specs.pop("norm", None)
+    specs["layers"]["input_norm_skip"] = REPLICATED
+    specs["fc"] = {"w": REPLICATED}
+    if config.tpu_config.is_eagle3:
+        specs["fc_features"] = {"w": REPLICATED}
+        specs["d2t"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    struct.pop("norm", None)
+    dt = to_jax_dtype(arch.dtype)
+    H = arch.hidden_size
+    struct["layers"]["input_norm_skip"] = jax.ShapeDtypeStruct(
+        (arch.num_layers,), jnp.bool_
+    )
+    struct["fc"] = {"w": jax.ShapeDtypeStruct((2 * H, H), dt)}
+    if config.tpu_config.is_eagle3:
+        k = len(eagle3_aux_indices_default(getattr(config, "target_num_layers", 3)))
+        Ht = getattr(config, "target_hidden_size", H)
+        struct["fc_features"] = {"w": jax.ShapeDtypeStruct((k * Ht, H), dt)}
+        struct["d2t"] = jax.ShapeDtypeStruct((arch.vocab_size - arch.vocab_pad,), jnp.int32)
+        tv = getattr(config, "target_vocab_size", None) or (arch.vocab_size - arch.vocab_pad)
+        tp = config.tpu_config.tp_degree
+        struct["embed_tokens"] = jax.ShapeDtypeStruct(
+            (tv + (-tv) % tp, arch.hidden_size), dt
+        )
+    return struct
+
+
+def eagle3_aux_indices_default(target_num_layers: int):
+    """Which target layers feed the EAGLE3 feature concat: an early, middle,
+    and late layer (clamped for tiny test models)."""
+    L = target_num_layers
+    idx = sorted({max(0, min(L - 1, i)) for i in (1, L // 2, L - 2)})
+    return tuple(idx)

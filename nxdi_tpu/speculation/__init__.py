@@ -1,4 +1,9 @@
-from nxdi_tpu.speculation.application import FusedSpecCausalLM
+from nxdi_tpu.speculation.application import EagleSpecCausalLM, FusedSpecCausalLM
+from nxdi_tpu.speculation.eagle import (
+    EagleSpecWrapper,
+    eagle_context_encoding,
+    eagle_token_gen,
+)
 from nxdi_tpu.speculation.fused import (
     FusedSpecWrapper,
     fused_spec_context_encoding,
@@ -6,8 +11,12 @@ from nxdi_tpu.speculation.fused import (
 )
 
 __all__ = [
+    "EagleSpecCausalLM",
+    "EagleSpecWrapper",
     "FusedSpecCausalLM",
     "FusedSpecWrapper",
+    "eagle_context_encoding",
+    "eagle_token_gen",
     "fused_spec_context_encoding",
     "fused_spec_token_gen",
 ]

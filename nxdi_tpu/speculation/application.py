@@ -132,6 +132,12 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
     # submodels (reference: model_base.py:3161 enable_context_encoding,
     # :3132 enable_fused_spec)
     # ------------------------------------------------------------------
+    _wrapper_cls = FusedSpecWrapper
+
+    def _spec_wrapper_kwargs(self) -> Dict[str, Any]:
+        """Extra kwargs for this app's spec wrapper (EAGLE adds its own)."""
+        return {}
+
     def enable_models(self) -> None:
         t_arch = self.family.build_arch(self.config)
         d_arch = self.draft_family.build_arch(self.draft_config)
@@ -143,8 +149,9 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
             draft_arch=d_arch,
             draft_inv_freq=d_inv,
             spec_len=self.spec_len,
+            **self._spec_wrapper_kwargs(),
         )
-        self.models[TAG_CONTEXT_ENCODING] = FusedSpecWrapper(
+        self.models[TAG_CONTEXT_ENCODING] = self._wrapper_cls(
             TAG_CONTEXT_ENCODING,
             self.config,
             t_arch,
@@ -156,7 +163,7 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
             forward_kwargs={},
             **common,
         )
-        self.models[TAG_FUSED_SPECULATION] = FusedSpecWrapper(
+        self.models[TAG_FUSED_SPECULATION] = self._wrapper_cls(
             TAG_FUSED_SPECULATION,
             self.config,
             t_arch,
@@ -182,3 +189,109 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
     @property
     def async_supported(self) -> bool:
         return False
+
+
+class EagleSpecCausalLM(FusedSpecCausalLM):
+    """Fused speculation with an EAGLE draft (reference: the EAGLE branches of
+    NeuronFusedSpecModel, model_base.py:1985-2809; draft wiring
+    inference_demo.py:502-537).
+
+    Extends the fused app with: the EAGLE draft family (models/llama_eagle.py)
+    as the default draft, a ``features`` hidden-state buffer in the cache
+    pytree (the functional HiddenStateRollingBuffer), and draft params that
+    borrow the target's embed/lm_head when the draft checkpoint omits them.
+    """
+
+    def __init__(self, *args, **kwargs):
+        from nxdi_tpu.models import llama_eagle
+
+        kwargs.setdefault("draft_family", llama_eagle)
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        self.is_eagle3 = bool(tc.is_eagle3)
+        self.draft_config.tpu_config.is_eagle3 = self.is_eagle3
+        # tell the draft config what it needs to size fc_features/d2t structs
+        self.draft_config.target_num_layers = self.config.num_hidden_layers
+        self.draft_config.target_hidden_size = self.config.hidden_size
+        if self.is_eagle3:
+            self.draft_config.target_vocab_size = self.config.vocab_size
+        from nxdi_tpu.models.llama_eagle import eagle3_aux_indices_default
+
+        self.aux_hidden_indices = (
+            eagle3_aux_indices_default(self.config.num_hidden_layers)
+            if self.is_eagle3
+            else None
+        )
+
+    def build_params(self) -> Dict[str, Any]:
+        if self.tpu_config.quantized and self.tpu_config.quantized_checkpoints_path:
+            raise NotImplementedError(
+                "quantized_checkpoints_path is not supported with EAGLE yet"
+            )
+        target_sd = self.get_state_dict()
+        target = self.family.convert_hf_state_dict(target_sd, self.config)
+        draft_sd = dict(self.get_draft_state_dict())
+        # official EAGLE drafts ship without embeddings / lm_head: borrow the
+        # target's (reference prefixes draft+target checkpoints together,
+        # application_base.py:691)
+        def _probe(sd, name):
+            return name in sd or f"model.{name}" in sd
+
+        if not _probe(draft_sd, "embed_tokens.weight"):
+            draft_sd["embed_tokens.weight"] = target_sd.get(
+                "embed_tokens.weight", target_sd.get("model.embed_tokens.weight")
+            )
+        same_vocab = self.draft_config.vocab_size == self.config.vocab_size
+        if not _probe(draft_sd, "lm_head.weight") and same_vocab:
+            head = target_sd.get("lm_head.weight")
+            if head is None:  # tied target
+                head = draft_sd["embed_tokens.weight"]
+            draft_sd["lm_head.weight"] = head
+        draft = self.draft_family.convert_hf_state_dict(draft_sd, self.draft_config)
+        return {
+            "draft": maybe_quantize_params(draft, self.draft_config.tpu_config),
+            "target": maybe_quantize_params(target, self.tpu_config),
+        }
+
+    # -- cache pytree gains the features buffer --
+    def _features_shape(self):
+        from nxdi_tpu.models.dense import head_dim_of  # noqa: F401 (doc anchor)
+
+        B = self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size
+        return (B, self.draft_config.hidden_size)
+
+    def init_cache_host(self):
+        import jax.numpy as jnp
+
+        from nxdi_tpu.config import to_jax_dtype
+
+        cache = super().init_cache_host()
+        dt = to_jax_dtype(self.draft_family.build_arch(self.draft_config).dtype)
+        cache["features"] = jnp.zeros(self._features_shape(), dt)
+        return cache
+
+    def _cache_struct(self):
+        import jax
+
+        from nxdi_tpu.config import to_jax_dtype
+
+        struct = super()._cache_struct()
+        dt = to_jax_dtype(self.draft_family.build_arch(self.draft_config).dtype)
+        struct["features"] = jax.ShapeDtypeStruct(self._features_shape(), dt)
+        return struct
+
+    def cache_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().cache_partition_specs()
+        specs["features"] = P()
+        return specs
+
+    @property
+    def _wrapper_cls(self):
+        from nxdi_tpu.speculation.eagle import EagleSpecWrapper
+
+        return EagleSpecWrapper
+
+    def _spec_wrapper_kwargs(self) -> Dict[str, Any]:
+        return dict(is_eagle3=self.is_eagle3, aux_hidden_indices=self.aux_hidden_indices)

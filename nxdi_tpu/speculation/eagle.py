@@ -1,0 +1,302 @@
+"""EAGLE / EAGLE3 fused speculative decoding.
+
+The analog of the reference's EAGLE paths inside ``NeuronFusedSpecModel``
+(models/model_base.py:1985-2809 ``_eagle_*``; draft fc modeling_llama.py:1408;
+hidden-state plumbing model_base.py:1581 and modules/eagle/hidden_state.py).
+
+EAGLE's draft is a 1-layer model whose input at position ``p`` is the token
+embedding at ``p`` concatenated with the *feature* of position ``p-1``, fused by
+an ``fc`` projection (handled inside :func:`causal_lm_forward` when the draft
+params carry ``fc``). Features are the target's last-layer pre-norm hidden
+states; within a speculation window the draft chains its OWN hidden states as
+features (exactly the official EAGLE recurrence).
+
+Where the reference keeps a ``HiddenStateRollingBuffer`` module holding hidden
+states between dispatches (modules/eagle/hidden_state.py:64), our functional
+equivalent is a ``features`` array carried in the cache pytree: ``(B, H)`` — the
+feature of the position *before* each sequence's next input token. The jitted
+window updates it in-graph (gather at the accept length), so the host never
+touches hidden states.
+
+EAGLE3 differences handled here:
+  - the feature stream is a concat of selected intermediate layers' hiddens
+    (``aux_hidden_indices``), projected ``3H -> H`` by the draft's
+    ``fc_features`` before use;
+  - the draft may have a reduced vocabulary with a ``d2t`` index table mapping
+    draft token ids to target ids.
+
+Output contract matches :mod:`nxdi_tpu.speculation.fused`: greedy acceptance
+makes emitted tokens bit-identical to target-only greedy decoding; drafts only
+change how many tokens each dispatch retires.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT
+from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.parallel.policy import DEFAULT_POLICY
+from nxdi_tpu.speculation.fused import FusedSpecWrapper
+
+
+def _project_features(draft_params: Dict[str, Any], hidden: jax.Array) -> jax.Array:
+    """EAGLE3: target aux-hidden concat -> H via the draft's fc_features.
+    EAGLE1: identity (features are already H-dim last-layer hiddens)."""
+    if "fc_features" in draft_params:
+        from nxdi_tpu.models.base import _linear
+
+        return _linear(hidden, draft_params["fc_features"])
+    return hidden
+
+
+def _feature_rows(batch: Dict[str, jax.Array], B: int):
+    """Row indices into the (kv_cache_batch, H) features buffer: seq_ids under
+    continuous batching, else batch order — mirroring the KV cache's row
+    routing so each live sequence keeps its own feature."""
+    ids = batch.get("seq_ids")
+    if ids is None:
+        ids = jnp.arange(B, dtype=jnp.int32)
+    return ids.astype(jnp.int32)
+
+
+def _target_feature_kwargs(is_eagle3: bool, aux_hidden_indices):
+    if is_eagle3:
+        return dict(aux_hidden_indices=tuple(aux_hidden_indices))
+    return dict(output_hidden=True)
+
+
+def _target_features(is_eagle3: bool, t_out: Dict[str, jax.Array]) -> jax.Array:
+    return t_out["aux_hidden"] if is_eagle3 else t_out["hidden"]
+
+
+def _draft_token(draft_params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
+    """Map draft-vocab greedy tokens to target ids (EAGLE3 d2t table)."""
+    if "d2t" in draft_params:
+        return jnp.take(draft_params["d2t"], tokens, axis=0).astype(jnp.int32)
+    return tokens.astype(jnp.int32)
+
+
+def eagle_context_encoding(
+    draft_arch,
+    target_arch,
+    draft_inv_freq,
+    target_inv_freq,
+    params: Dict[str, Any],  # {"draft", "target"}
+    cache: Dict[str, Any],  # {"draft", "target", "features"}
+    batch: Dict[str, jax.Array],
+    *,
+    is_eagle3: bool = False,
+    aux_hidden_indices: Optional[Tuple[int, ...]] = None,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    **sampling_kwargs,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """Prompt pass (reference: _eagle_context_encoding_forward,
+    model_base.py:1985): target CTE emits features; draft CTE consumes the
+    prompt with features shifted one right; the features buffer keeps the last
+    prompt token's feature for the first speculation window."""
+    t_out, t_cache = causal_lm_forward(
+        target_arch,
+        target_inv_freq,
+        params["target"],
+        cache["target"],
+        batch,
+        attend_to_cache=False,
+        policy=policy,
+        layout=layout,
+        gather_last_token=True,
+        on_device_sampling=True,
+        **_target_feature_kwargs(is_eagle3, aux_hidden_indices),
+        **sampling_kwargs,
+    )
+    feats = _project_features(params["draft"], _target_features(is_eagle3, t_out))
+
+    # draft sees (token_j, feature_{j-1}): shift features right, zero at j=0
+    prev_hidden = jnp.pad(feats[:, :-1], ((0, 0), (1, 0), (0, 0)))
+    d_batch = dict(batch)
+    d_batch["prev_hidden"] = prev_hidden
+    _, d_cache = causal_lm_forward(
+        draft_arch,
+        draft_inv_freq,
+        params["draft"],
+        cache["draft"],
+        d_batch,
+        attend_to_cache=False,
+        policy=policy,
+        layout=layout,
+        gather_last_token=True,
+        on_device_sampling=True,
+    )
+
+    # feature of the last real prompt token (position of the sampled token - 1)
+    lti = batch["last_token_index"][:, None, None]
+    last_feat = jnp.take_along_axis(
+        feats, jnp.broadcast_to(lti, (feats.shape[0], 1, feats.shape[2])), axis=1
+    )[:, 0]
+
+    B = batch["input_ids"].shape[0]
+    rows = _feature_rows(batch, B)
+    feat_buf = cache["features"].at[rows].set(last_feat.astype(cache["features"].dtype))
+
+    outputs = {
+        "tokens": t_out["tokens"],
+        "counts": jnp.ones((B,), jnp.int32),
+    }
+    return outputs, {"draft": d_cache, "target": t_cache, "features": feat_buf}
+
+
+def eagle_token_gen(
+    draft_arch,
+    target_arch,
+    draft_inv_freq,
+    target_inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    *,
+    spec_len: int,
+    kv_window: int,
+    is_eagle3: bool = False,
+    aux_hidden_indices: Optional[Tuple[int, ...]] = None,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """One speculation window (reference: _eagle_token_gen_forward,
+    model_base.py:2100-2300). Draft steps chain their own hidden states as
+    features; the target verify pass refreshes the features buffer at the
+    accept point."""
+    B = batch["input_ids"].shape[0]
+    tok0 = batch["input_ids"].astype(jnp.int32)  # (B, 1) last accepted token
+    pos0 = batch["position_ids"].astype(jnp.int32)  # (B, 1) its position
+    rows = _feature_rows(batch, B)
+    feat0 = cache["features"][rows]  # (B, H) feature at pos0 - 1
+    lti = jnp.zeros((B,), jnp.int32)
+    sp = batch["sampling_params"]
+
+    def draft_step(carry, _):
+        tok, pos, feat, dcache = carry
+        dbatch = {
+            "input_ids": tok,
+            "position_ids": pos,
+            "last_token_index": lti,
+            "sampling_params": sp,
+            "prev_hidden": feat[:, None, :],
+        }
+        if "seq_ids" in batch:
+            dbatch["seq_ids"] = batch["seq_ids"]
+        out, dcache = causal_lm_forward(
+            draft_arch,
+            draft_inv_freq,
+            params["draft"],
+            dcache,
+            dbatch,
+            attend_to_cache=True,
+            kv_window=kv_window,
+            policy=policy,
+            layout=layout,
+            gather_last_token=False,
+            on_device_sampling=True,
+            output_hidden=True,
+        )
+        nxt = _draft_token(params["draft"], out["tokens"])  # (B, 1)
+        return (nxt, pos + 1, out["hidden"][:, 0], dcache), tok
+
+    (_, _, _, d_cache), fed = jax.lax.scan(
+        draft_step, (tok0, pos0, feat0, cache["draft"]), None, length=spec_len + 1
+    )
+    candidates = jnp.swapaxes(fed[:, :, 0], 0, 1)  # (B, spec_len+1)
+
+    positions = pos0 + jnp.arange(spec_len + 1, dtype=jnp.int32)[None, :]
+    tbatch = {
+        "input_ids": candidates,
+        "position_ids": positions,
+        "last_token_index": lti,
+        "sampling_params": sp,
+    }
+    if "seq_ids" in batch:
+        tbatch["seq_ids"] = batch["seq_ids"]
+    t_out, t_cache = causal_lm_forward(
+        target_arch,
+        target_inv_freq,
+        params["target"],
+        cache["target"],
+        tbatch,
+        attend_to_cache=True,
+        kv_window=kv_window,
+        policy=policy,
+        layout=layout,
+        gather_last_token=False,
+        output_all_logits=True,
+        on_device_sampling=False,
+        **_target_feature_kwargs(is_eagle3, aux_hidden_indices),
+    )
+    target_tokens = jnp.argmax(t_out["logits"], axis=-1).astype(jnp.int32)
+
+    drafted = candidates[:, 1:]
+    matches = (drafted == target_tokens[:, :-1]).astype(jnp.int32)
+    accepted = jnp.cumprod(matches, axis=1)
+    counts = jnp.sum(accepted, axis=1) + 1
+
+    # features buffer <- target feature at the last RETIRED window index (the
+    # next window's start token sits one past it). The host clamps retired
+    # tokens to the compiled KV window edge (hf_adapter.py _fused_spec_decode);
+    # mirror that clamp here so feature and start-token never desynchronize
+    # near the bucket boundary.
+    retire = jnp.clip(
+        jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, spec_len + 1
+    )
+    feats = _project_features(params["draft"], _target_features(is_eagle3, t_out))
+    idx = (retire - 1)[:, None, None]
+    new_feat = jnp.take_along_axis(
+        feats, jnp.broadcast_to(idx, (B, 1, feats.shape[2])), axis=1
+    )[:, 0]
+    feat_buf = cache["features"].at[rows].set(new_feat.astype(cache["features"].dtype))
+
+    return {"tokens": target_tokens, "counts": counts}, {
+        "draft": d_cache,
+        "target": t_cache,
+        "features": feat_buf,
+    }
+
+
+class EagleSpecWrapper(FusedSpecWrapper):
+    """ModelWrapper compiling the EAGLE fused graphs (reference: the eagle
+    branches of the fused_speculation_model, model_base.py:3132)."""
+
+    def __init__(self, *args, is_eagle3=False, aux_hidden_indices=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.is_eagle3 = is_eagle3
+        self.aux_hidden_indices = aux_hidden_indices
+
+    def make_forward(self, bucket: int):
+        common = dict(
+            is_eagle3=self.is_eagle3,
+            aux_hidden_indices=self.aux_hidden_indices,
+            policy=self.policy,
+            layout=self.layout,
+        )
+        if self.attend_to_cache:
+            return partial(
+                eagle_token_gen,
+                self.draft_arch,
+                self.arch,
+                self.draft_inv_freq,
+                self.inv_freq,
+                spec_len=self.spec_len,
+                kv_window=bucket,
+                **common,
+            )
+        return partial(
+            eagle_context_encoding,
+            self.draft_arch,
+            self.arch,
+            self.draft_inv_freq,
+            self.inv_freq,
+            **common,
+            **self.forward_kwargs,
+        )
