@@ -79,6 +79,8 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--enable-fused-speculation", action="store_true")
     p.add_argument("--enable-eagle-speculation", action="store_true")
     p.add_argument("--is-eagle3", action="store_true")
+    p.add_argument("--is-medusa", action="store_true")
+    p.add_argument("--num-medusa-heads", type=int, default=0)
 
     # quantization
     p.add_argument("--quantized", action="store_true")
@@ -134,6 +136,8 @@ def create_tpu_config(args):
         enable_fused_speculation=args.enable_fused_speculation,
         enable_eagle_speculation=args.enable_eagle_speculation,
         is_eagle3=args.is_eagle3,
+        is_medusa=args.is_medusa,
+        num_medusa_heads=args.num_medusa_heads,
         quantized=args.quantized,
         quantization_dtype=args.quantization_dtype,
         kv_cache_quant=args.kv_cache_quant,
@@ -174,15 +178,24 @@ def run_inference(args) -> int:
     tpu_config = create_tpu_config(args)
     config = cfg_cls(tpu_config, load_config=load_pretrained_config(args.model_path))
 
-    wants_spec = args.enable_fused_speculation or args.enable_eagle_speculation
+    wants_spec = (
+        args.enable_fused_speculation
+        or args.enable_eagle_speculation
+        or (args.speculation_length > 0 and not args.is_medusa)
+    )
     if wants_spec and not args.draft_model_path:
         raise ValueError(
-            "--enable-fused-speculation/--enable-eagle-speculation require "
-            "--draft-model-path (there is no draft model to speculate with)"
+            "speculative decoding flags (--speculation-length/--enable-fused-"
+            "speculation/--enable-eagle-speculation) require --draft-model-path "
+            "(there is no draft model to speculate with)"
         )
     if wants_spec:
         # draft config surgery (reference: inference_demo.py:502-537)
         app = _build_spec_app(args, family, config)
+    elif args.is_medusa:
+        from nxdi_tpu.speculation import MedusaCausalLM
+
+        app = MedusaCausalLM(args.model_path, config, model_family=family)
     else:
         app = TpuModelForCausalLM(args.model_path, config, model_family=family)
     if args.compiled_model_path and not args.skip_compile:
@@ -257,7 +270,14 @@ def _build_spec_app(args, family, config):
     dcfg = d_cfg_cls(
         draft_tpu, load_config=load_pretrained_config(args.draft_model_path)
     )
-    return FusedSpecCausalLM(
+    if args.enable_fused_speculation:
+        return FusedSpecCausalLM(
+            args.model_path, config, args.draft_model_path, dcfg,
+            model_family=family, draft_family=d_family,
+        )
+    from nxdi_tpu.speculation import StandardSpecCausalLM
+
+    return StandardSpecCausalLM(
         args.model_path, config, args.draft_model_path, dcfg,
         model_family=family, draft_family=d_family,
     )

@@ -104,13 +104,21 @@ def convert_hf_state_dict(
             )
         params["embed_tokens"] = target_embed
 
-    params["fc"] = {"w": np.asarray(sd["fc.weight"], dtype=dt).T}
-    if "fc.bias" in sd:
-        params["fc"]["b"] = np.asarray(sd["fc.bias"], dtype=dt)
+    # biases are always present in the pytree (zeros when the checkpoint has
+    # none) so params/specs/struct agree regardless of checkpoint contents —
+    # official EAGLE drafts ship fc WITH bias, many retrains without
+    def _proj(prefix):
+        w = np.asarray(sd[f"{prefix}.weight"], dtype=dt).T
+        b = (
+            np.asarray(sd[f"{prefix}.bias"], dtype=dt)
+            if f"{prefix}.bias" in sd
+            else np.zeros((w.shape[1],), dtype=dt)
+        )
+        return {"w": w, "b": b}
+
+    params["fc"] = _proj("fc")
     if "fc_features.weight" in sd:
-        params["fc_features"] = {"w": np.asarray(sd["fc_features.weight"], dtype=dt).T}
-        if "fc_features.bias" in sd:
-            params["fc_features"]["b"] = np.asarray(sd["fc_features.bias"], dtype=dt)
+        params["fc_features"] = _proj("fc_features")
     elif is_eagle3:
         raise KeyError(
             "is_eagle3 requires an fc_features.weight in the draft checkpoint "
@@ -131,9 +139,9 @@ def param_specs(config) -> Dict[str, Any]:
     specs = dense.param_specs_for(arch)
     specs.pop("norm", None)
     specs["layers"]["input_norm_skip"] = REPLICATED
-    specs["fc"] = {"w": REPLICATED}
+    specs["fc"] = {"w": REPLICATED, "b": REPLICATED}
     if config.tpu_config.is_eagle3:
-        specs["fc_features"] = {"w": REPLICATED}
+        specs["fc_features"] = {"w": REPLICATED, "b": REPLICATED}
         specs["d2t"] = REPLICATED
     return specs
 
@@ -152,11 +160,17 @@ def param_shape_struct(config) -> Dict[str, Any]:
     struct["layers"]["input_norm_skip"] = jax.ShapeDtypeStruct(
         (arch.num_layers,), jnp.bool_
     )
-    struct["fc"] = {"w": jax.ShapeDtypeStruct((2 * H, H), dt)}
+    struct["fc"] = {
+        "w": jax.ShapeDtypeStruct((2 * H, H), dt),
+        "b": jax.ShapeDtypeStruct((H,), dt),
+    }
     if config.tpu_config.is_eagle3:
         k = len(eagle3_aux_indices_default(getattr(config, "target_num_layers", 3)))
         Ht = getattr(config, "target_hidden_size", H)
-        struct["fc_features"] = {"w": jax.ShapeDtypeStruct((k * Ht, H), dt)}
+        struct["fc_features"] = {
+            "w": jax.ShapeDtypeStruct((k * Ht, H), dt),
+            "b": jax.ShapeDtypeStruct((H,), dt),
+        }
         struct["d2t"] = jax.ShapeDtypeStruct((arch.vocab_size - arch.vocab_pad,), jnp.int32)
         tv = getattr(config, "target_vocab_size", None) or (arch.vocab_size - arch.vocab_pad)
         tp = config.tpu_config.tp_degree

@@ -44,6 +44,7 @@ TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"
 TAG_SPECULATION = "speculation_model"
 TAG_FUSED_SPECULATION = "fused_speculation_model"
+TAG_MEDUSA_SPECULATION = "medusa_speculation_model"
 
 
 class ModelWrapper:

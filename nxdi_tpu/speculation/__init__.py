@@ -1,4 +1,14 @@
-from nxdi_tpu.speculation.application import EagleSpecCausalLM, FusedSpecCausalLM
+from nxdi_tpu.speculation.application import (
+    EagleSpecCausalLM,
+    FusedSpecCausalLM,
+    MedusaCausalLM,
+)
+from nxdi_tpu.speculation.medusa import (
+    MedusaWrapper,
+    medusa_context_encoding,
+    medusa_token_gen,
+)
+from nxdi_tpu.speculation.standard import SpecTargetCausalLM, StandardSpecCausalLM
 from nxdi_tpu.speculation.eagle import (
     EagleSpecWrapper,
     eagle_context_encoding,
@@ -15,6 +25,12 @@ __all__ = [
     "EagleSpecWrapper",
     "FusedSpecCausalLM",
     "FusedSpecWrapper",
+    "MedusaCausalLM",
+    "MedusaWrapper",
+    "SpecTargetCausalLM",
+    "StandardSpecCausalLM",
+    "medusa_context_encoding",
+    "medusa_token_gen",
     "eagle_context_encoding",
     "eagle_token_gen",
     "fused_spec_context_encoding",

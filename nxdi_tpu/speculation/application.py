@@ -26,6 +26,7 @@ from nxdi_tpu.runtime.application import (
 from nxdi_tpu.runtime.model_wrapper import (
     TAG_CONTEXT_ENCODING,
     TAG_FUSED_SPECULATION,
+    TAG_MEDUSA_SPECULATION,
 )
 from nxdi_tpu.speculation.fused import FusedSpecWrapper
 
@@ -295,3 +296,167 @@ class EagleSpecCausalLM(FusedSpecCausalLM):
 
     def _spec_wrapper_kwargs(self) -> Dict[str, Any]:
         return dict(is_eagle3=self.is_eagle3, aux_hidden_indices=self.aux_hidden_indices)
+
+
+class MedusaCausalLM(TpuModelForCausalLM):
+    """CausalLM with Medusa heads (reference: is_medusa/num_medusa_heads
+    config.py:241-244, medusa heads modeling_llama.py:1420-1435, medusa
+    speculation submodel model_base.py:3209).
+
+    One model (no separate draft): extra ResBlock+lm_head stacks are appended
+    to the target params as ``medusa_heads``; proposals between dispatches
+    live in the cache pytree as ``medusa_tokens``. Reuses the fused-spec host
+    decode loop (same tokens/counts output contract).
+    """
+
+    is_fused_spec = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        self.num_heads = tc.num_medusa_heads
+        if not tc.is_medusa or self.num_heads < 1:
+            raise ValueError("MedusaCausalLM requires is_medusa and num_medusa_heads >= 1")
+        if tc.is_block_kv_layout:
+            raise ValueError("medusa does not support the block KV layout yet")
+
+    # -- params: target + stacked heads --
+    def build_params(self):
+        tc = self.tpu_config
+        if tc.quantized and tc.quantized_checkpoints_path:
+            raise NotImplementedError(
+                "quantized_checkpoints_path is not supported with medusa yet"
+            )
+        sd = self.get_state_dict()  # ONE checkpoint read for model + heads
+        params = maybe_quantize_params(
+            self.family.convert_hf_state_dict(sd, self.config), tc
+        )
+        params["medusa_heads"] = self._convert_medusa_heads(sd)
+        return params
+
+    def _convert_medusa_heads(self, sd):
+        """HF medusa checkpoints: medusa_head.{i}.0.linear.{weight,bias} is the
+        ResBlock, medusa_head.{i}.1.weight the per-head lm_head."""
+        import numpy as np
+
+        from nxdi_tpu.models.dense import np_dtype
+
+        arch = self.family.build_arch(self.config)
+        dt = np_dtype(arch.dtype)
+        H, V, K = arch.hidden_size, arch.vocab_size, self.num_heads
+
+        def get(i, suffix):
+            for prefix in ("medusa_head", "medusa_heads", "model.medusa_head"):
+                k = f"{prefix}.{i}.{suffix}"
+                if k in sd:
+                    return sd[k]
+            raise KeyError(f"medusa head weight {i}.{suffix} not found in checkpoint")
+
+        res_w = np.stack([np.asarray(get(i, "0.linear.weight"), dtype=dt).T for i in range(K)])
+        res_b = np.stack([np.asarray(get(i, "0.linear.bias"), dtype=dt) for i in range(K)])
+        heads = []
+        for i in range(K):
+            h = np.asarray(get(i, "1.weight"), dtype=dt).T  # (H, v)
+            if h.shape[1] < V:  # pad vocab like the main lm_head
+                h = np.concatenate([h, np.zeros((H, V - h.shape[1]), dtype=dt)], axis=1)
+            heads.append(h)
+        return {"res_w": res_w, "res_b": res_b, "head": np.stack(heads)}
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().param_specs()
+        specs["medusa_heads"] = {
+            "res_w": P(),
+            "res_b": P(),
+            "head": P(None, None, "tp"),  # vocab-sharded like the lm_head
+        }
+        return specs
+
+    def build_params_struct(self):
+        import jax
+
+        from nxdi_tpu.config import to_jax_dtype
+
+        struct = super().build_params_struct()
+        arch = self.family.build_arch(self.config)
+        dt = to_jax_dtype(arch.dtype)
+        H, V, K = arch.hidden_size, arch.vocab_size, self.num_heads
+        struct["medusa_heads"] = {
+            "res_w": jax.ShapeDtypeStruct((K, H, H), dt),
+            "res_b": jax.ShapeDtypeStruct((K, H), dt),
+            "head": jax.ShapeDtypeStruct((K, H, V), dt),
+        }
+        return struct
+
+    # -- cache pytree gains the proposal buffer --
+    def _proposal_shape(self):
+        tc = self.tpu_config
+        return (tc.kv_cache_batch_size + tc.kv_cache_padding_size, self.num_heads)
+
+    def init_cache_host(self):
+        import jax.numpy as jnp
+
+        cache = super().init_cache_host()
+        cache["medusa_tokens"] = jnp.zeros(self._proposal_shape(), jnp.int32)
+        return cache
+
+    def _cache_struct(self):
+        import jax
+        import jax.numpy as jnp
+
+        struct = super()._cache_struct()
+        struct["medusa_tokens"] = jax.ShapeDtypeStruct(self._proposal_shape(), jnp.int32)
+        return struct
+
+    def cache_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().cache_partition_specs()
+        specs["medusa_tokens"] = P()
+        return specs
+
+    def enable_models(self) -> None:
+        from nxdi_tpu.runtime import autobucketing
+        from nxdi_tpu.speculation.medusa import MedusaWrapper
+
+        arch = self.family.build_arch(self.config)
+        inv_freq = self.family.build_inv_freq(self.config)
+        tc = self.tpu_config
+        self.models[TAG_CONTEXT_ENCODING] = MedusaWrapper(
+            TAG_CONTEXT_ENCODING,
+            self.config,
+            arch,
+            inv_freq,
+            batch_size=tc.ctx_batch_size,
+            n_active_tokens=0,
+            buckets=autobucketing.context_encoding_buckets(self.config),
+            attend_to_cache=False,
+            forward_kwargs={},
+            num_heads=self.num_heads,
+        )
+        self.models[TAG_MEDUSA_SPECULATION] = MedusaWrapper(
+            TAG_MEDUSA_SPECULATION,
+            self.config,
+            arch,
+            inv_freq,
+            batch_size=tc.tkg_batch_size,
+            n_active_tokens=1,
+            buckets=autobucketing.token_generation_buckets(self.config),
+            attend_to_cache=True,
+            forward_kwargs={},
+            num_heads=self.num_heads,
+        )
+
+    def forward(self, input_ids, position_ids, **kwargs):
+        if not self.is_loaded:
+            raise RuntimeError("call load() before forward()")
+        is_prefill = input_ids.shape[1] > 1
+        tag = TAG_CONTEXT_ENCODING if is_prefill else TAG_MEDUSA_SPECULATION
+        batch = {"input_ids": input_ids, "position_ids": position_ids, **kwargs}
+        outputs, self.kv_cache = self.models[tag].forward(self.params, self.kv_cache, batch)
+        return outputs
+
+    @property
+    def async_supported(self) -> bool:
+        return False

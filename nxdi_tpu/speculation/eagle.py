@@ -44,13 +44,17 @@ from nxdi_tpu.parallel.policy import DEFAULT_POLICY
 from nxdi_tpu.speculation.fused import FusedSpecWrapper
 
 
-def _project_features(draft_params: Dict[str, Any], hidden: jax.Array) -> jax.Array:
+def _project_features(
+    draft_arch, draft_params: Dict[str, Any], hidden: jax.Array
+) -> jax.Array:
     """EAGLE3: target aux-hidden concat -> H via the draft's fc_features.
     EAGLE1: identity (features are already H-dim last-layer hiddens)."""
     if "fc_features" in draft_params:
         from nxdi_tpu.models.base import _linear
 
-        return _linear(hidden, draft_params["fc_features"])
+        return _linear(
+            hidden, draft_params["fc_features"], draft_arch.act_quant, draft_arch.act_clamp
+        )
     return hidden
 
 
@@ -114,7 +118,7 @@ def eagle_context_encoding(
         **_target_feature_kwargs(is_eagle3, aux_hidden_indices),
         **sampling_kwargs,
     )
-    feats = _project_features(params["draft"], _target_features(is_eagle3, t_out))
+    feats = _project_features(draft_arch, params["draft"], _target_features(is_eagle3, t_out))
 
     # draft sees (token_j, feature_{j-1}): shift features right, zero at j=0
     prev_hidden = jnp.pad(feats[:, :-1], ((0, 0), (1, 0), (0, 0)))
@@ -250,7 +254,7 @@ def eagle_token_gen(
     retire = jnp.clip(
         jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, spec_len + 1
     )
-    feats = _project_features(params["draft"], _target_features(is_eagle3, t_out))
+    feats = _project_features(draft_arch, params["draft"], _target_features(is_eagle3, t_out))
     idx = (retire - 1)[:, None, None]
     new_feat = jnp.take_along_axis(
         feats, jnp.broadcast_to(idx, (B, 1, feats.shape[2])), axis=1

@@ -1,0 +1,200 @@
+"""Medusa speculative decoding — extra prediction heads on the target model.
+
+The analog of the reference's Medusa path: ResBlock+lm_head stacks bolted onto
+the target (modeling_llama.py:1420-1435 medusa heads), a medusa speculation
+submodel (model_base.py:450 ``_medusa_forward``, :3209 enable_speculation
+medusa variant) and the medusa assisted-decoding loop (hf_adapter.py:819).
+
+Decoding scheme (top-1 chain; the reference's tree variant layers a token-tree
+mask on the same machinery): each head ``i`` predicts the token ``i+1``
+positions ahead from the hidden state that feeds the lm_head. A speculation
+window verifies the PREVIOUS window's head proposals with one multi-token
+target pass — acceptance is the longest prefix matching the target's greedy
+choices (tokens emitted are always the target's, so output is bit-identical to
+target-only greedy decoding) — then refreshes the proposals from the hidden
+state at the accept point.
+
+The proposal state between dispatches lives in the cache pytree as
+``medusa_tokens`` (kv_batch, num_heads) — the functional analog of the
+reference keeping medusa candidates in module state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT
+from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops.norms import rms_norm
+from nxdi_tpu.parallel.policy import DEFAULT_POLICY
+from nxdi_tpu.runtime.model_wrapper import ModelWrapper
+from nxdi_tpu.speculation.eagle import _feature_rows
+
+
+def medusa_propose(
+    heads: Dict[str, jax.Array], hidden: jax.Array, vocab_pad: int
+) -> jax.Array:
+    """Top-1 proposal from every head. ``hidden`` (B, H) is the post-norm
+    hidden that also feeds the lm_head (reference: heads consume the same
+    stream, modeling_llama.py:1420). Heads are stacked (K, ...) and evaluated
+    in one einsum each: ResBlock (x + silu(xW+b)) then a head lm_head."""
+    x = jnp.einsum("bh,khg->bkg", hidden, heads["res_w"]) + heads["res_b"][None]
+    x = hidden[:, None, :] + jax.nn.silu(x)  # (B, K, H)
+    logits = jnp.einsum("bkh,khv->bkv", x, heads["head"]).astype(jnp.float32)
+    logits = sampling_ops.mask_padded_logits(logits, vocab_pad)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K)
+
+
+def _post_norm_hidden_at(arch, params, hidden_stream: jax.Array, idx: jax.Array):
+    """Gather the pre-norm hidden at per-row index ``idx`` (B,), apply the
+    final norm — the exact stream the lm_head (and so the heads) read."""
+    B, _, H = hidden_stream.shape
+    h = jnp.take_along_axis(
+        hidden_stream, jnp.broadcast_to(idx[:, None, None], (B, 1, H)), axis=1
+    )[:, 0]
+    if "norm" in params:
+        h = rms_norm(h, params["norm"], arch.rms_norm_eps)
+    return h
+
+
+def medusa_context_encoding(
+    arch,
+    inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],  # {"k", "v", "medusa_tokens"}
+    batch: Dict[str, jax.Array],
+    *,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    **sampling_kwargs,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """Prompt pass: sample the first token AND seed the medusa proposals from
+    the last prompt position's hidden state."""
+    kv = {"k": cache["k"], "v": cache["v"]}
+    out, new_kv = causal_lm_forward(
+        arch,
+        inv_freq,
+        params,
+        kv,
+        batch,
+        attend_to_cache=False,
+        policy=policy,
+        layout=layout,
+        gather_last_token=True,
+        on_device_sampling=True,
+        output_hidden=True,
+        **sampling_kwargs,
+    )
+    B = batch["input_ids"].shape[0]
+    h = _post_norm_hidden_at(arch, params, out["hidden"], batch["last_token_index"])
+    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad)
+    rows = _feature_rows(batch, B)
+    buf = cache["medusa_tokens"].at[rows].set(proposals)
+    outputs = {"tokens": out["tokens"], "counts": jnp.ones((B,), jnp.int32)}
+    return outputs, {**new_kv, "medusa_tokens": buf}
+
+
+def medusa_token_gen(
+    arch,
+    inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    *,
+    num_heads: int,
+    kv_window: int,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """One medusa window: verify last window's proposals, emit target greedy
+    tokens + accept count, refresh proposals at the accept point (reference:
+    _medusa_forward model_base.py:450; accepted-indices gather
+    kv_cache_manager.py:266 — unnecessary here, exact-position KV writes are
+    simply overwritten by the next window)."""
+    B = batch["input_ids"].shape[0]
+    tok0 = batch["input_ids"].astype(jnp.int32)  # (B, 1) last accepted token
+    pos0 = batch["position_ids"].astype(jnp.int32)
+    rows = _feature_rows(batch, B)
+    proposals = cache["medusa_tokens"][rows]  # (B, K)
+
+    candidates = jnp.concatenate([tok0, proposals], axis=1)  # (B, K+1)
+    positions = pos0 + jnp.arange(num_heads + 1, dtype=jnp.int32)[None, :]
+    tbatch = {
+        "input_ids": candidates,
+        "position_ids": positions,
+        "last_token_index": jnp.zeros((B,), jnp.int32),
+        "sampling_params": batch["sampling_params"],
+    }
+    if "seq_ids" in batch:
+        tbatch["seq_ids"] = batch["seq_ids"]
+    kv = {"k": cache["k"], "v": cache["v"]}
+    out, new_kv = causal_lm_forward(
+        arch,
+        inv_freq,
+        params,
+        kv,
+        tbatch,
+        attend_to_cache=True,
+        kv_window=kv_window,
+        policy=policy,
+        layout=layout,
+        gather_last_token=False,
+        output_all_logits=True,
+        on_device_sampling=False,
+        output_hidden=True,
+    )
+    target_tokens = jnp.argmax(out["logits"], axis=-1).astype(jnp.int32)  # (B, K+1)
+
+    matches = (proposals == target_tokens[:, :-1]).astype(jnp.int32)
+    accepted = jnp.cumprod(matches, axis=1)
+    counts = jnp.sum(accepted, axis=1) + 1
+
+    # refresh proposals from the last RETIRED position's hidden (host clamps
+    # retirement to the window edge; mirror it, as in eagle_token_gen)
+    retire = jnp.clip(
+        jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, num_heads + 1
+    )
+    h = _post_norm_hidden_at(arch, params, out["hidden"], retire - 1)
+    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad)
+    buf = cache["medusa_tokens"].at[rows].set(proposals)
+
+    return {"tokens": target_tokens, "counts": counts}, {
+        **new_kv,
+        "medusa_tokens": buf,
+    }
+
+
+class MedusaWrapper(ModelWrapper):
+    """ModelWrapper compiling the medusa graphs (reference: the
+    medusa_speculation_model ModelWrapper, model_base.py:3209)."""
+
+    def __init__(self, *args, num_heads: int, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_heads = num_heads
+        if self.attend_to_cache:
+            self.lookahead = num_heads + 1
+
+    def make_forward(self, bucket: int):
+        if self.attend_to_cache:
+            return partial(
+                medusa_token_gen,
+                self.arch,
+                self.inv_freq,
+                num_heads=self.num_heads,
+                kv_window=bucket,
+                policy=self.policy,
+                layout=self.layout,
+            )
+        return partial(
+            medusa_context_encoding,
+            self.arch,
+            self.inv_freq,
+            policy=self.policy,
+            layout=self.layout,
+            **self.forward_kwargs,
+        )

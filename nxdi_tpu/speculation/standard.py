@@ -1,0 +1,159 @@
+"""Standard (unfused) speculative decoding — separately compiled draft and
+target applications driven by a host propose/verify loop.
+
+The analog of the reference's assisted decoding over two Neuron apps
+(hf_adapter.py:652 ``_standard_assisted_decoding``; draft app construction
+inference_demo.py:502-537). Unlike fused speculation the draft runs at its own
+configuration (it may use a different TP degree or dtype — the reference's
+``draft_model_tp_degree``), at the cost of k extra host dispatches per window.
+
+:class:`StandardSpecCausalLM` presents the fused-spec application interface
+(``is_fused_spec`` + tokens/counts outputs), so
+``HuggingFaceGenerationAdapter``'s multi-token decode loop drives it unchanged.
+
+Near the KV-window edge (where the k+1 verify positions would overflow the
+compiled bucket) the loop falls back to plain single-token TKG on the target —
+the same clamping the fused path applies in-graph.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import numpy as np
+
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.runtime.model_wrapper import (
+    TAG_SPECULATION,
+    TAG_TOKEN_GENERATION,
+    ModelWrapper,
+)
+
+
+class SpecTargetCausalLM(TpuModelForCausalLM):
+    """Target app with an extra multi-token verify submodel (reference:
+    enable_speculation model_base.py:3209 — the ``speculation_model`` that
+    scores spec_len candidate tokens in one pass)."""
+
+    def enable_models(self) -> None:
+        super().enable_models()
+        tc = self.tpu_config
+        spec_len = tc.speculation_length
+        arch = self.family.build_arch(self.config)
+        inv_freq = self.family.build_inv_freq(self.config)
+        self.models[TAG_SPECULATION] = ModelWrapper(
+            TAG_SPECULATION,
+            self.config,
+            arch,
+            inv_freq,
+            batch_size=tc.tkg_batch_size,
+            n_active_tokens=spec_len + 1,
+            buckets=self.models[TAG_TOKEN_GENERATION].buckets,
+            attend_to_cache=True,
+            forward_kwargs=dict(
+                gather_last_token=False,
+                output_all_logits=True,
+                on_device_sampling=False,
+            ),
+        )
+
+
+class StandardSpecCausalLM:
+    """Draft + target apps, host-orchestrated (reference: the unfused path of
+    inference_demo.py:502 — two compiled models, CPU assisted-decoding)."""
+
+    is_fused_spec = True
+
+    def __init__(
+        self,
+        model_path: str,
+        config,
+        draft_model_path: str,
+        draft_config,
+        model_family=None,
+        draft_family=None,
+    ):
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.spec_len = config.tpu_config.speculation_length
+        if self.spec_len < 1:
+            raise ValueError("speculation requires speculation_length >= 1")
+        self.target = SpecTargetCausalLM(model_path, config, model_family=model_family)
+        self.draft = TpuModelForCausalLM(
+            draft_model_path, draft_config, model_family=draft_family or model_family
+        )
+
+    # the adapter reads .models for the KV window limit
+    @property
+    def models(self):
+        return self.target.models
+
+    @property
+    def is_loaded(self):
+        return self.target.is_loaded and self.draft.is_loaded
+
+    def compile(self, path: str) -> None:
+        self.target.compile(path)
+        self.draft.compile(path + "_draft")
+
+    def load(self, path: Optional[str] = None) -> None:
+        self.target.load(path)
+        self.draft.load(path + "_draft" if path else None)
+
+    def reset_kv_cache(self) -> None:
+        self.target.reset_kv_cache()
+        self.draft.reset_kv_cache()
+
+    def _window_limit(self) -> int:
+        return min(
+            self.tpu_config.seq_len,
+            *(w.buckets[-1] for w in self.target.models.values() if w.attend_to_cache),
+        )
+
+    def forward(self, input_ids: np.ndarray, position_ids: np.ndarray, **kwargs):
+        if input_ids.shape[1] > 1:  # prefill: prime BOTH caches on the prompt
+            out = self.target.forward(input_ids, position_ids, **kwargs)
+            self.draft.forward(input_ids, position_ids, **kwargs)
+            tokens = np.asarray(jax.device_get(out["tokens"]))
+            return {
+                "tokens": tokens,
+                "counts": np.ones((input_ids.shape[0],), np.int32),
+            }
+        return self._spec_window(input_ids, position_ids, **kwargs)
+
+    def _spec_window(self, cur_tok, cur_pos, **kwargs):
+        B = cur_tok.shape[0]
+        k = self.spec_len
+        ones = np.ones((B,), np.int32)
+
+        # verify positions would overflow the compiled window: single-token
+        # fallback (keeps the draft cache warm with a matching step)
+        if int(cur_pos.max()) + k + 1 > self._window_limit():
+            out = self.target.forward(cur_tok, cur_pos, **kwargs)
+            self.draft.forward(cur_tok, cur_pos, **kwargs)
+            tokens = np.asarray(jax.device_get(out["tokens"]))
+            return {"tokens": tokens, "counts": ones}
+
+        # -- propose: k greedy draft TKG steps
+        drafted = []
+        d_tok, d_pos = cur_tok, cur_pos
+        for _ in range(k):
+            d_out = self.draft.forward(d_tok, d_pos, **kwargs)
+            d_tok = np.asarray(jax.device_get(d_out["tokens"])).astype(np.int32)
+            d_pos = d_pos + 1
+            drafted.append(d_tok)
+
+        # -- verify: one multi-token target pass over [cur, d_1..d_k]
+        candidates = np.concatenate([cur_tok] + drafted, axis=1)  # (B, k+1)
+        positions = cur_pos + np.arange(k + 1, dtype=np.int32)[None, :]
+        t_out = self.target.forward(
+            candidates, positions, submodel=TAG_SPECULATION, **kwargs
+        )
+        logits = np.asarray(jax.device_get(t_out["logits"]))  # (B, k+1, V)
+        target_tokens = np.argmax(logits, axis=-1).astype(np.int32)
+
+        matches = (candidates[:, 1:] == target_tokens[:, :-1]).astype(np.int32)
+        accepted = np.cumprod(matches, axis=1)
+        counts = accepted.sum(axis=1) + 1
+        return {"tokens": target_tokens, "counts": counts}

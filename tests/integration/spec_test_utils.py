@@ -1,0 +1,27 @@
+"""Shared tiny-model builders for the speculation test files (reference test
+strategy: tiny random-weight models, seed pinned — test/README.md:57-66)."""
+
+VOCAB = 256
+HIDDEN = 64
+
+
+def make_tiny_hf_llama(seed, layers=4, **overrides):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(seed)
+    kwargs = dict(
+        hidden_size=HIDDEN,
+        intermediate_size=128,
+        num_hidden_layers=layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=VOCAB,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    kwargs.update(overrides)
+    cfg = LlamaConfig(**kwargs)
+    return LlamaForCausalLM(cfg).eval(), cfg

@@ -17,28 +17,9 @@ from nxdi_tpu.models.llama import modeling_llama as llama
 from nxdi_tpu.speculation import EagleSpecCausalLM
 from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
 
-H = 64
-VOCAB = 256
+from spec_test_utils import HIDDEN as H, VOCAB, make_tiny_hf_llama as _tiny_hf_llama
 
 
-def _tiny_hf_llama(seed, layers=4):
-    import torch
-    from transformers import LlamaConfig, LlamaForCausalLM
-
-    torch.manual_seed(seed)
-    cfg = LlamaConfig(
-        hidden_size=H,
-        intermediate_size=128,
-        num_hidden_layers=layers,
-        num_attention_heads=4,
-        num_key_value_heads=2,
-        vocab_size=VOCAB,
-        max_position_embeddings=256,
-        rms_norm_eps=1e-5,
-        rope_theta=10000.0,
-        tie_word_embeddings=False,
-    )
-    return LlamaForCausalLM(cfg).eval(), cfg
 
 
 def _eagle_draft_sd(seed, eagle3=False, draft_vocab=None, aux_k=3):
@@ -57,6 +38,7 @@ def _eagle_draft_sd(seed, eagle3=False, draft_vocab=None, aux_k=3):
             continue
         out[k] = v
     out["fc.weight"] = (rng.standard_normal((H, 2 * H)) * 0.05).astype(np.float32)
+    out["fc.bias"] = (rng.standard_normal((H,)) * 0.01).astype(np.float32)
     if eagle3:
         out["fc_features.weight"] = (
             rng.standard_normal((H, aux_k * H)) * 0.05
@@ -181,28 +163,46 @@ def test_eagle_quantized_draft_and_target():
     assert (out >= 0).all() and (out < VOCAB).all()
 
 
-def test_eagle_nontrivial_acceptance():
-    """A draft distilled from the target should accept more than the minimum.
-    We fake 'distillation' by reusing the target's OWN first layer + lm_head in
-    the draft with an fc that passes the feature stream through: acceptance is
-    not guaranteed, but the mechanism (counts > 1 possible, never < 1) is."""
+def test_eagle_features_buffer_is_live():
+    """The features buffer must actually feed the draft: zeroing it after CTE
+    must change the draft's first-step hidden state (and so, generically, its
+    proposals). Guards against a regression that silently drops the buffer
+    (which greedy acceptance would mask — output stays correct either way)."""
+    import jax.numpy as jnp
+
     target, target_cfg = _tiny_hf_llama(seed=0)
     draft_sd = _eagle_draft_sd(seed=6)
     app = _build_eagle_app(target, target_cfg, draft_sd, spec_len=3)
-    adapter = HuggingFaceGenerationAdapter(app)
 
     prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
-    app.reset_kv_cache()
     B, S = prompt.shape
     pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
     out = app.forward(
         prompt.astype(np.int32), pos, last_token_index=np.array([S - 1], np.int32)
     )
+    feats_after_cte = np.asarray(app.kv_cache["features"])
+    assert np.abs(feats_after_cte).max() > 0, "CTE must populate the features buffer"
+
     t0 = np.asarray(out["tokens"])[:, 0].astype(np.int32)
-    out = app.forward(t0[:, None], np.array([[S]], np.int32))
-    counts = np.asarray(out["counts"])
-    assert 1 <= counts[0] <= 4
-    # and the generation still matches HF exactly
+    out_real = app.forward(t0[:, None], np.array([[S]], np.int32))
+    tokens_real = np.asarray(out_real["tokens"]).copy()
+
+    # same window with a zeroed buffer: the target's greedy tokens for the
+    # FIRST position must agree (independent of drafts), and the buffer must
+    # have been refreshed in-graph from the verify pass
+    app.reset_kv_cache()
+    app.forward(prompt.astype(np.int32), pos, last_token_index=np.array([S - 1], np.int32))
+    app.kv_cache["features"] = jnp.zeros_like(app.kv_cache["features"])
+    out_zero = app.forward(t0[:, None], np.array([[S]], np.int32))
+    tokens_zero = np.asarray(out_zero["tokens"])
+    assert tokens_real[0, 0] == tokens_zero[0, 0]
+    assert np.abs(np.asarray(app.kv_cache["features"])).max() > 0, (
+        "token-gen must refresh the features buffer from the verify pass"
+    )
+
+    # and end-to-end generation still matches HF exactly
+    app.reset_kv_cache()
+    adapter = HuggingFaceGenerationAdapter(app)
     expected = hf_greedy(target, prompt, max_new_tokens=12)
     actual = adapter.generate(prompt, max_new_tokens=12)
     np.testing.assert_array_equal(actual, expected)

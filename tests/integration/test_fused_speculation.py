@@ -16,25 +16,8 @@ from nxdi_tpu.models.llama import modeling_llama as llama
 from nxdi_tpu.speculation import FusedSpecCausalLM
 from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
 
+from spec_test_utils import make_tiny_hf_llama as _tiny_hf_llama
 
-def _tiny_hf_llama(seed, layers=4):
-    import torch
-    from transformers import LlamaConfig, LlamaForCausalLM
-
-    torch.manual_seed(seed)
-    cfg = LlamaConfig(
-        hidden_size=64,
-        intermediate_size=128,
-        num_hidden_layers=layers,
-        num_attention_heads=4,
-        num_key_value_heads=2,
-        vocab_size=256,
-        max_position_embeddings=256,
-        rms_norm_eps=1e-5,
-        rope_theta=10000.0,
-        tie_word_embeddings=False,
-    )
-    return LlamaForCausalLM(cfg).eval(), cfg
 
 
 def _build_fused_app(

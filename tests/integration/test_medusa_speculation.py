@@ -1,0 +1,117 @@
+"""Medusa speculation correctness (reference analog: medusa heads
+modeling_llama.py:1420-1435, _medusa_forward model_base.py:450).
+
+Same oracle as fused spec/EAGLE: tokens emitted are always the TARGET's greedy
+choices, so output is bit-identical to target-only greedy decoding regardless
+of head quality — random heads exercise the full proposal/verify machinery.
+"""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.speculation import MedusaCausalLM
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+from spec_test_utils import HIDDEN as H, VOCAB, make_tiny_hf_llama as _tiny_hf_llama
+
+
+
+
+def _with_medusa_heads(sd, num_heads, seed, scale=0.05):
+    """Append random medusa head weights in the official checkpoint layout."""
+    rng = np.random.default_rng(seed)
+    out = dict(sd)
+    for i in range(num_heads):
+        out[f"medusa_head.{i}.0.linear.weight"] = (
+            rng.standard_normal((H, H)) * scale
+        ).astype(np.float32)
+        out[f"medusa_head.{i}.0.linear.bias"] = np.zeros((H,), np.float32)
+        out[f"medusa_head.{i}.1.weight"] = (
+            rng.standard_normal((VOCAB, H)) * scale
+        ).astype(np.float32)
+    return out
+
+
+def _build_medusa_app(target, target_cfg, num_heads, tp_degree=1, batch_size=1, **extra):
+    sd = _with_medusa_heads(
+        {k: v.detach().numpy() for k, v in target.state_dict().items()},
+        num_heads,
+        seed=11,
+    )
+    tcfg = TpuConfig(
+        tp_degree=tp_degree,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=batch_size,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        is_medusa=True,
+        num_medusa_heads=num_heads,
+        medusa_speculation_length=num_heads + 1,
+        **extra,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
+
+    class App(MedusaCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<target>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("num_heads", [2, 4])
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_medusa_matches_hf_greedy(num_heads, tp_degree):
+    target, target_cfg = _tiny_hf_llama(seed=0)
+    app = _build_medusa_app(target, target_cfg, num_heads, tp_degree)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_medusa_batch_rows_advance_independently():
+    target, target_cfg = _tiny_hf_llama(seed=0)
+    app = _build_medusa_app(target, target_cfg, num_heads=3, batch_size=2)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    p0 = [5, 9, 3, 17, 2, 8, 11, 42]
+    p1 = [7, 13, 21, 4]
+    prompt = np.zeros((2, 8), dtype=np.int64)
+    prompt[0] = p0
+    prompt[1, :4] = p1
+    mask = (prompt != 0).astype(np.int32)
+    out = adapter.generate(prompt, attention_mask=mask, max_new_tokens=10)
+    e0 = hf_greedy(target, np.array([p0]), 10)
+    e1 = hf_greedy(target, np.array([p1]), 10)
+    np.testing.assert_array_equal(out[0, : e0.shape[1]], e0[0])
+    np.testing.assert_array_equal(out[1, 4:14], e1[0, 4:])
+
+
+def test_medusa_fills_cache_to_last_slot():
+    target, target_cfg = _tiny_hf_llama(seed=0)
+    app = _build_medusa_app(target, target_cfg, num_heads=4)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=56)
+    actual = adapter.generate(prompt, max_new_tokens=56)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_medusa_requires_heads_config():
+    target, target_cfg = _tiny_hf_llama(seed=0)
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", skip_warmup=True,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
+    with pytest.raises(ValueError, match="is_medusa"):
+        MedusaCausalLM("<target>", cfg, model_family=llama)

@@ -1,0 +1,98 @@
+"""Standard (unfused) speculative decoding: separately compiled draft and
+target apps with a host propose/verify loop (reference analog:
+_standard_assisted_decoding hf_adapter.py:652)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.speculation import StandardSpecCausalLM
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+from spec_test_utils import make_tiny_hf_llama as _tiny_hf_llama
+
+
+
+def _build_app(target, target_cfg, draft, draft_cfg, spec_len, draft_tp=1, **extra):
+    t_sd = {k: v.detach().numpy() for k, v in target.state_dict().items()}
+    d_sd = {k: v.detach().numpy() for k, v in draft.state_dict().items()}
+    common = dict(
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    common.update(extra)
+    tcfg = TpuConfig(**common, tp_degree=1, speculation_length=spec_len)
+    # the draft may run at a DIFFERENT tp degree than the target — the point
+    # of the unfused path (reference: draft_model_tp_degree)
+    dcfg_t = TpuConfig(**common, tp_degree=draft_tp)
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
+    dcfg = llama.LlamaInferenceConfig(dcfg_t, load_config=lambda: draft_cfg.to_dict())
+
+    app = StandardSpecCausalLM(
+        "<target>", cfg, "<draft>", dcfg, model_family=llama
+    )
+    app.target.get_state_dict = lambda: t_sd
+    app.draft.get_state_dict = lambda: d_sd
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("spec_len", [2, 4])
+def test_standard_spec_matches_hf_greedy(spec_len):
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_app(target, target_cfg, draft, draft_cfg, spec_len)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_standard_spec_draft_at_different_tp():
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_app(target, target_cfg, draft, draft_cfg, spec_len=3, draft_tp=2)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=16)
+    actual = adapter.generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_standard_spec_fills_to_window_edge():
+    """The single-token fallback near the KV window edge must keep output
+    exact all the way to the last slot."""
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_app(target, target_cfg, draft, draft_cfg, spec_len=4)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=56)
+    actual = adapter.generate(prompt, max_new_tokens=56)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_standard_spec_perfect_draft_full_windows():
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    app = _build_app(target, target_cfg, target, target_cfg, spec_len=3)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+
+    app.reset_kv_cache()
+    B, S = prompt.shape
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(
+        prompt.astype(np.int32), pos, last_token_index=np.array([S - 1], np.int32)
+    )
+    t0 = np.asarray(out["tokens"])[:, 0].astype(np.int32)
+    out = app.forward(t0[:, None], np.array([[S]], np.int32))
+    assert out["counts"][0] == 4, out["counts"]
