@@ -82,6 +82,19 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--is-medusa", action="store_true")
     p.add_argument("--num-medusa-heads", type=int, default=0)
 
+    # LoRA serving
+    p.add_argument("--enable-lora", action="store_true")
+    p.add_argument("--max-loras", type=int, default=1)
+    p.add_argument("--max-lora-rank", type=int, default=16)
+    p.add_argument(
+        "--lora-ckpt-path",
+        action="append",
+        default=None,
+        help="adapter_name=/path/to/peft_adapter (repeatable)",
+    )
+    p.add_argument("--adapter-id", action="append", default=None,
+                   help="per-prompt adapter name (repeatable, aligns with --prompt)")
+
     # quantization
     p.add_argument("--quantized", action="store_true")
     p.add_argument("--quantization-dtype", default="int8")
@@ -103,7 +116,16 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
 def create_tpu_config(args):
     """argparse namespace -> TpuConfig (reference: create_neuron_config
     inference_demo.py:438)."""
-    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.config import LoraServingConfig, OnDeviceSamplingConfig, TpuConfig
+
+    lora_cfg = None
+    if args.enable_lora:
+        paths = dict(e.split("=", 1) for e in (args.lora_ckpt_path or []))
+        lora_cfg = LoraServingConfig(
+            max_loras=max(args.max_loras, len(paths)),
+            max_lora_rank=args.max_lora_rank,
+            lora_ckpt_paths=paths or None,
+        )
 
     odsc = None
     if args.on_device_sampling:
@@ -142,6 +164,7 @@ def create_tpu_config(args):
         quantization_dtype=args.quantization_dtype,
         kv_cache_quant=args.kv_cache_quant,
         skip_warmup=args.skip_warmup,
+        lora_config=lora_cfg,
     )
 
 
@@ -213,6 +236,12 @@ def run_inference(args) -> int:
         pad_token_id=args.pad_token_id,
         seed=args.seed,
     )
+    if args.enable_lora and args.adapter_id:
+        gen_kwargs["adapter_ids"] = np.array(
+            [app.lora_adapter_id(None if a in ("base", "none") else a)
+             for a in args.adapter_id],
+            dtype=np.int32,
+        )
 
     rc = 0
     if args.check_accuracy_mode != "skip":

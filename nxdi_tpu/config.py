@@ -342,6 +342,18 @@ class TpuConfig:
                 )
         if self.is_medusa and self.num_medusa_heads <= 0:
             raise ValueError("is_medusa requires num_medusa_heads > 0")
+        if self.lora_config is not None and self.async_mode:
+            raise ValueError(
+                "LoRA serving is incompatible with async_mode: the device-"
+                "resident decode loop cannot carry per-request adapter_ids"
+            )
+        if self.lora_config is not None and (
+            self.enable_fused_speculation or self.is_medusa or self.speculation_length > 0
+        ):
+            raise ValueError(
+                "LoRA serving is not supported with speculative decoding yet: "
+                "the speculation graphs do not thread adapter_ids"
+            )
         if self.speculation_length < 0:
             raise ValueError("speculation_length must be >= 0")
         if self.is_block_kv_layout and self.pa_num_blocks is None:

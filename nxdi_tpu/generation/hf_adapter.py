@@ -72,6 +72,7 @@ class HuggingFaceGenerationAdapter:
         eos_token_id=None,
         pad_token_id: int = 0,
         seed: int = 0,
+        adapter_ids: Optional[np.ndarray] = None,
         **unused,
     ) -> np.ndarray:
         """Greedy/sampling generation. Returns (B, S + new_tokens) ids, with each
@@ -125,6 +126,10 @@ class HuggingFaceGenerationAdapter:
             temperature=[temperature],
         )
 
+        lora_kwargs = {}
+        if adapter_ids is not None:
+            lora_kwargs["adapter_ids"] = np.asarray(adapter_ids, dtype=np.int32)
+
         # ---- context encoding ----
         position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
         outputs = self.app.forward(
@@ -133,6 +138,7 @@ class HuggingFaceGenerationAdapter:
             last_token_index=lengths - 1,
             sampling_params=sampling_params,
             rng=self._next_rng(),
+            **lora_kwargs,
         )
         next_tokens = self._next_tokens(outputs)
 
@@ -149,11 +155,19 @@ class HuggingFaceGenerationAdapter:
             )
         if getattr(self.app, "is_fused_spec", False) and not finished.all():
             gen = self._fused_spec_decode(
-                next_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B
+                next_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B,
+                lora_kwargs=lora_kwargs,
             )
             return self._assemble(input_ids, gen, lengths, pad_token_id)
 
-        if self.app.async_supported and "next_inputs" in outputs and not finished.all():
+        # per-request adapters are host-side state the device decode loop
+        # cannot carry; fall back to the sync loop when they are in play
+        if (
+            self.app.async_supported
+            and "next_inputs" in outputs
+            and not finished.all()
+            and not lora_kwargs
+        ):
             gen = self._device_decode_loop(
                 outputs["next_inputs"], next_tokens, lengths, n_new, eos_ids, pad_token_id, B
             )
@@ -171,6 +185,7 @@ class HuggingFaceGenerationAdapter:
                 last_token_index=np.zeros((B,), dtype=np.int32),
                 sampling_params=sampling_params,
                 rng=self._next_rng(),
+                **lora_kwargs,
             )
             next_tokens = self._next_tokens(outputs)
             next_tokens = np.where(finished, pad_token_id, next_tokens)
@@ -241,7 +256,8 @@ class HuggingFaceGenerationAdapter:
         return gen
 
     def _fused_spec_decode(
-        self, first_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B
+        self, first_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B,
+        lora_kwargs=None,
     ) -> np.ndarray:
         """Multi-token decode via fused speculation (reference:
         hf_adapter.py:515 ``_fused_assisted_decoding``): each dispatch retires
@@ -255,12 +271,9 @@ class HuggingFaceGenerationAdapter:
         )
         cur_tok = np.array(first_tokens, dtype=np.int32)
         cur_pos = lengths.astype(np.int32).copy()  # position of cur_tok
-        # the device drops KV writes beyond the largest compiled TKG bucket,
-        # not just beyond seq_len — bound retired tokens by both
-        window_limit = min(
-            self.tpu_config.seq_len,
-            *(w.buckets[-1] for w in self.app.models.values() if w.attend_to_cache),
-        )
+        from nxdi_tpu.runtime.model_wrapper import decode_window_limit
+
+        window_limit = decode_window_limit(self.tpu_config, self.app.models)
 
         while not finished.all():
             outputs = self.app.forward(
@@ -268,6 +281,7 @@ class HuggingFaceGenerationAdapter:
                 cur_pos[:, None],
                 last_token_index=np.zeros((B,), dtype=np.int32),
                 sampling_params=sampling_params,
+                **(lora_kwargs or {}),
             )
             toks = np.asarray(jax.device_get(outputs["tokens"]))  # (B, k+1)
             cnts = np.asarray(jax.device_get(outputs["counts"]))  # (B,)

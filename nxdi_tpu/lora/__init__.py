@@ -1,0 +1,21 @@
+from nxdi_tpu.lora.serving import (
+    LORA_TARGETABLE_MODULES,
+    AdapterCache,
+    attach_lora_buffers,
+    convert_peft_adapter,
+    load_adapter_state_dict,
+    lora_shape_struct,
+    lora_spec_update,
+    write_adapter_into_buffers,
+)
+
+__all__ = [
+    "LORA_TARGETABLE_MODULES",
+    "AdapterCache",
+    "attach_lora_buffers",
+    "convert_peft_adapter",
+    "load_adapter_state_dict",
+    "lora_shape_struct",
+    "lora_spec_update",
+    "write_adapter_into_buffers",
+]

@@ -1,0 +1,322 @@
+"""Multi-adapter LoRA serving.
+
+The analog of the reference's ``modules/lora_serving/`` (lora_model.py:35
+``LoraModel``, lora_layer.py ParallelLinear LoRA wraps, lora_checkpoint.py
+adapter ingestion, ``AdapterCache`` lora_model.py:293 for dynamic swapping).
+
+TPU-native shape of the idea: instead of wrapping layers with LoRA modules,
+every targeted projection's param dict carries slot-stacked buffers
+
+    ``lora_A``     (L, S, in, r)   — S = max_loras + 1 slots, slot 0 = base
+    ``lora_B``     (L, S, r, out)
+    ``lora_scale`` (L, S)
+
+and the shared ``_linear`` (models/base.py) adds ``((x @ A[id]) @ B[id]) * s``
+per batch row, selected by the ``adapter_ids`` batch input — the SPMD analog
+of the reference's static multi-LoRA (one compiled graph, per-request
+adapters). Slot 0 stays all-zeros so ``adapter_id=0`` serves the base model.
+
+Dynamic multi-LoRA (more adapters than slots) is :class:`AdapterCache`: a
+host-side LRU that writes adapter weights into device slots between requests
+(reference: CPU AdapterCache swapped into device weights, lora_model.py:293).
+
+GQA note: adapters target the CHECKPOINT's head layout; k/v ``lora_B`` and
+o-proj ``lora_A`` go through the same head replication/padding as the base
+weights (parallel/gqa.py) so deltas line up with the padded layout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from nxdi_tpu.models.dense import np_dtype
+from nxdi_tpu.parallel import gqa
+from nxdi_tpu.parallel.layers import REPLICATED
+from jax.sharding import PartitionSpec as P
+
+# module name -> (pytree path under layers, HF checkpoint scope)
+LORA_TARGETABLE_MODULES = {
+    "q_proj": (("attn", "q_proj"), "self_attn"),
+    "k_proj": (("attn", "k_proj"), "self_attn"),
+    "v_proj": (("attn", "v_proj"), "self_attn"),
+    "o_proj": (("attn", "o_proj"), "self_attn"),
+    "gate_proj": (("mlp", "gate_proj"), "mlp"),
+    "up_proj": (("mlp", "up_proj"), "mlp"),
+    "down_proj": (("mlp", "down_proj"), "mlp"),
+}
+
+
+def _module_dims(arch, name: str) -> Tuple[int, int]:
+    """(in_features, out_features) of a targeted projection in the PADDED
+    on-device layout."""
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    hs, inter = arch.hidden_size, arch.intermediate_size
+    return {
+        "q_proj": (hs, H * D),
+        "k_proj": (hs, KV * D),
+        "v_proj": (hs, KV * D),
+        "o_proj": (H * D, hs),
+        "gate_proj": (hs, inter),
+        "up_proj": (hs, inter),
+        "down_proj": (inter, hs),
+    }[name]
+
+
+# ---------------------------------------------------------------------------
+# Adapter checkpoint ingestion (reference: lora_checkpoint.py)
+# ---------------------------------------------------------------------------
+
+def load_adapter_state_dict(path: str) -> Tuple[Dict[str, np.ndarray], Dict[str, Any]]:
+    """Load a PEFT-format adapter directory (adapter_model.safetensors / .bin
+    + adapter_config.json). Returns (state_dict, adapter_config)."""
+    cfg = {}
+    cfg_path = os.path.join(path, "adapter_config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            cfg = json.load(f)
+    st_path = os.path.join(path, "adapter_model.safetensors")
+    if os.path.exists(st_path):
+        from safetensors.numpy import load_file
+
+        return dict(load_file(st_path)), cfg
+    bin_path = os.path.join(path, "adapter_model.bin")
+    if os.path.exists(bin_path):
+        import torch
+
+        sd = torch.load(bin_path, map_location="cpu", weights_only=True)
+        return {k: v.numpy() for k, v in sd.items()}, cfg
+    from nxdi_tpu import checkpoint as ckpt
+
+    return ckpt.load_state_dict(path), cfg
+
+
+def _adapter_key(sd: Dict[str, np.ndarray], layer: int, scope: str, module: str, ab: str):
+    """Probe the common PEFT key spellings for one projection's A/B weight."""
+    for prefix in ("base_model.model.model.", "base_model.model.", "model.", ""):
+        for suffix in (f"lora_{ab}.weight", f"lora_{ab}.default.weight"):
+            k = f"{prefix}layers.{layer}.{scope}.{module}.{suffix}"
+            if k in sd:
+                return sd[k]
+    return None
+
+
+def convert_peft_adapter(
+    sd: Dict[str, np.ndarray],
+    adapter_cfg: Dict[str, Any],
+    config,
+    arch,
+    lora_cfg,
+) -> Dict[str, Dict[str, np.ndarray]]:
+    """PEFT adapter state dict -> per-module host buffers in the padded device
+    layout: {module: {"A": (L, in, r_max), "B": (L, r_max, out), "scale": f}}.
+
+    Missing (layer, module) pairs contribute zeros — an adapter may target a
+    subset of layers/modules. Rank is zero-padded to ``max_lora_rank``.
+    """
+    dt = np_dtype(lora_cfg.lora_dtype)
+    plan = gqa.plan_gqa_sharding(
+        config.tpu_config.tp_degree, config.num_attention_heads, config.num_key_value_heads
+    )
+    D = arch.head_dim
+    r_max = lora_cfg.max_lora_rank
+    alpha = float(adapter_cfg.get("lora_alpha", lora_cfg.lora_alpha))
+    r_cfg = adapter_cfg.get("r")
+
+    out: Dict[str, Dict[str, np.ndarray]] = {}
+    for name in lora_cfg.target_modules:
+        path, scope = LORA_TARGETABLE_MODULES[name]
+        fin, fout = _module_dims(arch, name)
+        A = np.zeros((arch.num_layers, fin, r_max), dtype=dt)
+        B = np.zeros((arch.num_layers, r_max, fout), dtype=dt)
+        r_used = None
+        for layer in range(arch.num_layers):
+            a = _adapter_key(sd, layer, scope, name, "A")  # (r, in)
+            b = _adapter_key(sd, layer, scope, name, "B")  # (out, r)
+            if a is None or b is None:
+                continue
+            a = np.asarray(a, dtype=dt)
+            b = np.asarray(b, dtype=dt)
+            r = a.shape[0]
+            if r > r_max:
+                raise ValueError(
+                    f"adapter rank {r} exceeds max_lora_rank {r_max} "
+                    f"(module {name}, layer {layer})"
+                )
+            r_used = r
+            # head-layout transforms matching the base weight conversion
+            if name in ("k_proj", "v_proj"):
+                b = gqa.convert_kv(b, D, plan)  # (out_padded, r)
+            elif name == "q_proj":
+                b = gqa.convert_q(b, D, plan)
+            elif name == "o_proj":
+                a = gqa.convert_q(a.T, D, plan).T  # pad the head-structured in dim
+            A[layer, : a.shape[1], :r] = a.T
+            B[layer, :r, : b.shape[0]] = b.T
+        scale = alpha / float(r_cfg or r_used or r_max)
+        out[name] = {"A": A, "B": B, "scale": np.float32(scale)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Device buffer layout
+# ---------------------------------------------------------------------------
+
+def _slots(lora_cfg) -> int:
+    return lora_cfg.max_loras + 1  # slot 0 = base model (zeros)
+
+
+def attach_lora_buffers(params: Dict[str, Any], arch, lora_cfg) -> Dict[str, Any]:
+    """Add all-zero slot-stacked LoRA buffers to every targeted projection's
+    param dict (host side, before sharding)."""
+    dt = np_dtype(lora_cfg.lora_dtype)
+    S, r = _slots(lora_cfg), lora_cfg.max_lora_rank
+    L = arch.num_layers
+    layers = params["layers"]
+    for name in lora_cfg.target_modules:
+        group, proj = LORA_TARGETABLE_MODULES[name][0]
+        if group not in layers:  # e.g. MoE models have no dense "mlp"
+            continue
+        fin, fout = _module_dims(arch, name)
+        p = layers[group][proj]
+        p["lora_A"] = np.zeros((L, S, fin, r), dtype=dt)
+        p["lora_B"] = np.zeros((L, S, r, fout), dtype=dt)
+        p["lora_scale"] = np.zeros((L, S), dtype=np.float32)
+    return params
+
+
+def write_adapter_into_buffers(
+    params: Dict[str, Any], slot: int, converted: Dict[str, Dict[str, np.ndarray]]
+):
+    """Write one converted adapter into device slot ``slot`` (jax .at updates —
+    small buffers, so the copies are cheap). Returns the updated params."""
+    layers = params["layers"]
+    for name, buf in converted.items():
+        group, proj = LORA_TARGETABLE_MODULES[name][0]
+        if group not in layers:
+            continue
+        p = layers[group][proj]
+        p["lora_A"] = p["lora_A"].at[:, slot].set(buf["A"]) if hasattr(
+            p["lora_A"], "at"
+        ) else _np_set(p["lora_A"], slot, buf["A"])
+        p["lora_B"] = p["lora_B"].at[:, slot].set(buf["B"]) if hasattr(
+            p["lora_B"], "at"
+        ) else _np_set(p["lora_B"], slot, buf["B"])
+        scale_col = np.full((p["lora_scale"].shape[0],), buf["scale"], np.float32)
+        p["lora_scale"] = p["lora_scale"].at[:, slot].set(scale_col) if hasattr(
+            p["lora_scale"], "at"
+        ) else _np_set(p["lora_scale"], slot, scale_col)
+    return params
+
+
+def _np_set(arr: np.ndarray, slot: int, value) -> np.ndarray:
+    arr[:, slot] = value
+    return arr
+
+
+def lora_spec_update(specs: Dict[str, Any], lora_cfg) -> Dict[str, Any]:
+    """Add PartitionSpecs for the LoRA buffers. B shards like the base
+    weight's out dim for column-parallel modules; A shards like the in dim for
+    row-parallel modules; scales replicated. Leading dims: (L, S, ...)."""
+    layers = specs["layers"]
+    col = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
+    for name in lora_cfg.target_modules:
+        group, proj = LORA_TARGETABLE_MODULES[name][0]
+        if group not in layers:
+            continue
+        p = layers[group][proj]
+        if name in col:
+            p["lora_A"] = REPLICATED
+            p["lora_B"] = P(None, None, None, "tp")
+        else:  # o_proj / down_proj: row-parallel
+            p["lora_A"] = P(None, None, "tp", None)
+            p["lora_B"] = REPLICATED
+        p["lora_scale"] = REPLICATED
+    return specs
+
+
+def lora_shape_struct(struct: Dict[str, Any], arch, lora_cfg) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    dt = to_jax_dtype(lora_cfg.lora_dtype)
+    S, r, L = _slots(lora_cfg), lora_cfg.max_lora_rank, arch.num_layers
+    layers = struct["layers"]
+    for name in lora_cfg.target_modules:
+        group, proj = LORA_TARGETABLE_MODULES[name][0]
+        if group not in layers:
+            continue
+        fin, fout = _module_dims(arch, name)
+        p = layers[group][proj]
+        p["lora_A"] = jax.ShapeDtypeStruct((L, S, fin, r), dt)
+        p["lora_B"] = jax.ShapeDtypeStruct((L, S, r, fout), dt)
+        p["lora_scale"] = jax.ShapeDtypeStruct((L, S), jnp.float32)
+    return struct
+
+
+# ---------------------------------------------------------------------------
+# Dynamic multi-LoRA (reference: AdapterCache lora_model.py:293)
+# ---------------------------------------------------------------------------
+
+class AdapterCache:
+    """Host-side LRU of adapters over the device slots. ``ensure(name)``
+    returns the slot id, loading/evicting as needed; the application passes
+    the returned (possibly updated) params back into its device state."""
+
+    def __init__(self, config, arch, lora_cfg):
+        self.config = config
+        self.arch = arch
+        self.lora_cfg = lora_cfg
+        self.slot_of: Dict[str, int] = {}
+        self._lru: list = []  # least-recent first
+        self._host: Dict[str, Dict[str, Dict[str, np.ndarray]]] = {}
+        self._dirty: set = set()  # re-registered while device-resident
+
+    @property
+    def num_slots(self) -> int:
+        return self.lora_cfg.max_loras  # slots 1..max_loras (0 = base)
+
+    def register(self, name: str, path_or_sd, adapter_cfg: Optional[dict] = None):
+        """Convert and keep an adapter host-side (no device slot yet)."""
+        if isinstance(path_or_sd, str):
+            sd, file_cfg = load_adapter_state_dict(path_or_sd)
+            adapter_cfg = {**file_cfg, **(adapter_cfg or {})}
+        else:
+            sd = path_or_sd
+            adapter_cfg = adapter_cfg or {}
+        self._host[name] = convert_peft_adapter(
+            sd, adapter_cfg, self.config, self.arch, self.lora_cfg
+        )
+        if name in self.slot_of:
+            # already device-resident: the stale slot must be rewritten on the
+            # next ensure(), not silently served
+            self._dirty.add(name)
+
+    def ensure(self, name: str, params: Dict[str, Any]) -> Tuple[int, Dict[str, Any]]:
+        """Slot id for ``name``, writing it into device buffers if absent
+        (evicting the least-recently-used adapter when slots are full)."""
+        if name not in self._host:
+            raise KeyError(f"adapter {name!r} was never registered")
+        if name in self.slot_of:
+            self._lru.remove(name)
+            self._lru.append(name)
+            slot = self.slot_of[name]
+            if name in self._dirty:
+                params = write_adapter_into_buffers(params, slot, self._host[name])
+                self._dirty.discard(name)
+            return slot, params
+        if len(self.slot_of) < self.num_slots:
+            slot = len(self.slot_of) + 1  # slot 0 reserved for base
+        else:
+            evicted = self._lru.pop(0)
+            slot = self.slot_of.pop(evicted)
+            self._dirty.discard(evicted)
+        params = write_adapter_into_buffers(params, slot, self._host[name])
+        self.slot_of[name] = slot
+        self._lru.append(name)
+        return slot, params

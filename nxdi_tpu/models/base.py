@@ -177,14 +177,24 @@ def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
 # Blocks
 # ---------------------------------------------------------------------------
 
-def _linear(x, p, act_quant=None, clamp=None):
+def _linear(x, p, act_quant=None, clamp=None, adapter_ids=None):
     """Linear over either a full-precision param dict ``{"w"[, "b"]}`` or a
-    quantized one ``{"qw", "scale"[, "b"]}`` (ops/quantization.py)."""
+    quantized one ``{"qw", "scale"[, "b"]}`` (ops/quantization.py). When the
+    dict carries slot-stacked LoRA buffers (lora/serving.py) and the batch
+    supplies ``adapter_ids``, each row adds its adapter's low-rank delta —
+    the reference's multi-LoRA linear (lora_serving/lora_layer.py)."""
     if "qw" in p:
-        return quant_ops.quantized_linear(x, p, act_quant=act_quant, clamp_bound=clamp)
-    y = x @ p["w"]
-    if "b" in p:
-        y = y + p["b"]
+        y = quant_ops.quantized_linear(x, p, act_quant=act_quant, clamp_bound=clamp)
+    else:
+        y = x @ p["w"]
+        if "b" in p:
+            y = y + p["b"]
+    if adapter_ids is not None and "lora_A" in p:
+        A = p["lora_A"][adapter_ids].astype(x.dtype)  # (B, in, r)
+        Bw = p["lora_B"][adapter_ids].astype(x.dtype)  # (B, r, out)
+        s = p["lora_scale"][adapter_ids]  # (B,)
+        delta = jnp.einsum("b...r,bro->b...o", jnp.einsum("b...i,bir->b...r", x, A), Bw)
+        y = y + delta * s[(...,) + (None,) * (y.ndim - 1)].astype(y.dtype)
     return y
 
 
@@ -202,6 +212,7 @@ def attention_block(
     policy: ShardingPolicy = DEFAULT_POLICY,
     layout=DEFAULT_KV_LAYOUT,
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
+    adapter_ids: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -216,9 +227,9 @@ def attention_block(
     H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
 
     aq, ac = arch.act_quant, arch.act_clamp
-    q = _linear(hidden, p_attn["q_proj"], aq, ac).reshape(B, S, H, D)
-    k = _linear(hidden, p_attn["k_proj"], aq, ac).reshape(B, S, KV, D)
-    v = _linear(hidden, p_attn["v_proj"], aq, ac).reshape(B, S, KV, D)
+    q = _linear(hidden, p_attn["q_proj"], aq, ac, adapter_ids).reshape(B, S, H, D)
+    k = _linear(hidden, p_attn["k_proj"], aq, ac, adapter_ids).reshape(B, S, KV, D)
+    v = _linear(hidden, p_attn["v_proj"], aq, ac, adapter_ids).reshape(B, S, KV, D)
 
     if arch.qk_norm:
         q = rms_norm(q, p_attn["q_norm"], arch.rms_norm_eps)
@@ -285,17 +296,19 @@ def attention_block(
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
-    out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp)
+    out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
     return out, (new_k, new_v)
 
 
-def mlp_block(arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array) -> jax.Array:
+def mlp_block(
+    arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array, adapter_ids=None
+) -> jax.Array:
     """Gated MLP (SwiGLU family). XLA fuses act+mul into the matmuls."""
     act = ACT_FNS[arch.hidden_act]
     aq, ac = arch.act_quant, arch.act_clamp
-    gate = act(_linear(x, p_mlp["gate_proj"], aq, ac))
-    up = _linear(x, p_mlp["up_proj"], aq, ac)
-    return _linear(gate * up, p_mlp["down_proj"], aq, ac)
+    gate = act(_linear(x, p_mlp["gate_proj"], aq, ac, adapter_ids))
+    up = _linear(x, p_mlp["up_proj"], aq, ac, adapter_ids)
+    return _linear(gate * up, p_mlp["down_proj"], aq, ac, adapter_ids)
 
 
 def decoder_layer(
@@ -312,6 +325,7 @@ def decoder_layer(
     policy: ShardingPolicy = DEFAULT_POLICY,
     layout=DEFAULT_KV_LAYOUT,
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
+    adapter_ids: Optional[jax.Array] = None,
 ):
     h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
     if "input_norm_skip" in lp:
@@ -321,13 +335,14 @@ def decoder_layer(
     attn_out, (nk, nv) = attention_block(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
+        adapter_ids,
     )
     hidden = hidden + attn_out
     h = rms_norm(hidden, lp["post_attention_layernorm"], arch.rms_norm_eps)
     if arch.moe is not None:
         hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
     else:
-        hidden = hidden + mlp_block(arch, lp["mlp"], h)
+        hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids)
     hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
@@ -347,6 +362,7 @@ def run_decoder_layers(
     layout=DEFAULT_KV_LAYOUT,
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     collect_hidden: bool = False,
+    adapter_ids: Optional[jax.Array] = None,
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
 
@@ -369,14 +385,14 @@ def run_decoder_layers(
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos, sin, k_win, v_win, position_ids, cache_spec,
-                attend_to_cache, policy, layout, cache_inputs,
+                attend_to_cache, policy, layout, cache_inputs, adapter_ids,
             )
             nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
             nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
         else:
             h, (nk, nv) = decoder_layer(
                 arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec,
-                attend_to_cache, policy, layout, cache_inputs,
+                attend_to_cache, policy, layout, cache_inputs, adapter_ids,
             )
         return h, ((nk, nv, h) if collect_hidden else (nk, nv))
 
@@ -460,13 +476,14 @@ def causal_lm_forward(
             arch, params["layers"], hidden, cos, sin, cache,
             position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
             policy=policy, layout=layout, cache_inputs=cache_inputs,
-            collect_hidden=True,
+            collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
         )
     else:
         hidden, new_cache = run_decoder_layers(
             arch, params["layers"], hidden, cos, sin, cache,
             position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
             policy=policy, layout=layout, cache_inputs=cache_inputs,
+            adapter_ids=batch.get("adapter_ids"),
         )
     pre_norm_hidden = hidden
     if "norm" in params:  # EAGLE drafts have no final norm

@@ -142,7 +142,42 @@ class ApplicationBase:
             return params
         sd = self.get_state_dict()
         params = self.family.convert_hf_state_dict(sd, self.config)
-        return maybe_quantize_params(params, tc)
+        params = maybe_quantize_params(params, tc)
+        if tc.lora_config is not None:
+            params = self._attach_lora(params)
+        return params
+
+    # -- LoRA serving (reference: modules/lora_serving/, wrap_model_with_lora
+    # model_base.py:144) --
+    def _attach_lora(self, params):
+        from nxdi_tpu.lora import AdapterCache, attach_lora_buffers
+
+        arch = self.family.build_arch(self.config)
+        lc = self.tpu_config.lora_config
+        params = attach_lora_buffers(params, arch, lc)
+        self.adapter_cache = AdapterCache(self.config, arch, lc)
+        if lc.lora_ckpt_paths:
+            for name, path in lc.lora_ckpt_paths.items():
+                self.adapter_cache.register(name, path)
+                _, params = self.adapter_cache.ensure(name, params)
+        return params
+
+    def set_lora_adapter(self, name: str, path_or_sd=None, adapter_cfg=None) -> int:
+        """Dynamic multi-LoRA: make ``name`` resident on device (LRU-evicting
+        if slots are full) and return its adapter id for ``generate``
+        (reference: AdapterCache swap, lora_serving/lora_model.py:293)."""
+        if getattr(self, "adapter_cache", None) is None:
+            raise RuntimeError("LoRA serving is not enabled (set lora_config)")
+        if path_or_sd is not None:
+            self.adapter_cache.register(name, path_or_sd, adapter_cfg)
+        slot, self.params = self.adapter_cache.ensure(name, self.params)
+        return slot
+
+    def lora_adapter_id(self, name: str) -> int:
+        """Adapter id for a resident adapter (0 = base model)."""
+        if name is None:
+            return 0
+        return self.adapter_cache.slot_of[name]
 
     def save_quantized_state_dict(self, path: str) -> None:
         """Offline weight quantization artifact (reference:
@@ -160,7 +195,12 @@ class ApplicationBase:
     # -- overridable pytree layouts (multi-model apps override all three and
     # must apply maybe_quantize_* to each sub-pytree themselves) --
     def param_specs(self):
-        return maybe_quantize_specs(self.family.param_specs(self.config), self.tpu_config)
+        specs = self.family.param_specs(self.config)
+        if self.tpu_config.lora_config is not None:
+            from nxdi_tpu.lora import lora_spec_update
+
+            specs = lora_spec_update(specs, self.tpu_config.lora_config)
+        return maybe_quantize_specs(specs, self.tpu_config)
 
     def cache_partition_specs(self):
         if self.tpu_config.is_block_kv_layout:
@@ -192,6 +232,10 @@ class ApplicationBase:
         """Abstract param pytree (no weight IO) for AOT lowering."""
         arch = self.family.build_arch(self.config)
         struct = params_shape_struct(self.family, self.config, arch)
+        if self.tpu_config.lora_config is not None:
+            from nxdi_tpu.lora import lora_shape_struct
+
+            struct = lora_shape_struct(struct, arch, self.tpu_config.lora_config)
         return maybe_quantize_struct(struct, self.tpu_config)
 
     def _cache_struct(self):

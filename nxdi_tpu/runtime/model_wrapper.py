@@ -47,6 +47,16 @@ TAG_FUSED_SPECULATION = "fused_speculation_model"
 TAG_MEDUSA_SPECULATION = "medusa_speculation_model"
 
 
+def decode_window_limit(tpu_config, models) -> int:
+    """Largest KV position the compiled decode programs can serve: the device
+    drops KV writes beyond the largest compiled TKG bucket, not just beyond
+    seq_len (shared by the host decode loops that clamp retirement)."""
+    return min(
+        tpu_config.seq_len,
+        *(w.buckets[-1] for w in models.values() if w.attend_to_cache),
+    )
+
+
 class ModelWrapper:
     def __init__(
         self,
@@ -148,6 +158,8 @@ class ModelWrapper:
         }
         for key in self._layout_input_keys():
             batch_shardings[key] = replicated
+        if self.lora_enabled:
+            batch_shardings["adapter_ids"] = replicated
         if self.needs_rng:
             batch_shardings["rng"] = replicated
         jitted = jax.jit(
@@ -163,6 +175,10 @@ class ModelWrapper:
         if getattr(self.layout, "route_by_seq_id", False):
             return ("seq_ids",)
         return ()
+
+    @property
+    def lora_enabled(self) -> bool:
+        return self.config.tpu_config.lora_config is not None
 
     def _block_table_width(self) -> int:
         tc = self.config.tpu_config
@@ -190,6 +206,8 @@ class ModelWrapper:
                 batch[key] = jax.ShapeDtypeStruct((B, seq), jnp.int32)
             elif key == "block_table":
                 batch[key] = jax.ShapeDtypeStruct((B, self._block_table_width()), jnp.int32)
+        if self.lora_enabled:
+            batch["adapter_ids"] = jax.ShapeDtypeStruct((B,), jnp.int32)
         if self.needs_rng:
             batch["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
         return batch
@@ -255,6 +273,10 @@ class ModelWrapper:
             dtype=np.float32,
         )
         extra = self._layout_inputs(batch_np, b, s, pad_s, position_ids)
+        if self.lora_enabled:
+            extra["adapter_ids"] = np.asarray(
+                batch_np.get("adapter_ids", np.zeros((b,))), dtype=np.int32
+            )
 
         # pad batch dim (reference: _forward_with_pad model_wrapper.py:569)
         orig_b = b

@@ -79,6 +79,16 @@ class StandardSpecCausalLM:
         self.spec_len = config.tpu_config.speculation_length
         if self.spec_len < 1:
             raise ValueError("speculation requires speculation_length >= 1")
+        if config.tpu_config.on_device_sampling_config is None:
+            raise ValueError(
+                "standard speculation requires on-device sampling (the draft "
+                "proposes with the on-device greedy sampler); set "
+                "on_device_sampling_config / --on-device-sampling"
+            )
+        if draft_config.tpu_config.on_device_sampling_config is None:
+            draft_config.tpu_config.on_device_sampling_config = (
+                config.tpu_config.on_device_sampling_config
+            )
         self.target = SpecTargetCausalLM(model_path, config, model_family=model_family)
         self.draft = TpuModelForCausalLM(
             draft_model_path, draft_config, model_family=draft_family or model_family
@@ -106,10 +116,9 @@ class StandardSpecCausalLM:
         self.draft.reset_kv_cache()
 
     def _window_limit(self) -> int:
-        return min(
-            self.tpu_config.seq_len,
-            *(w.buckets[-1] for w in self.target.models.values() if w.attend_to_cache),
-        )
+        from nxdi_tpu.runtime.model_wrapper import decode_window_limit
+
+        return decode_window_limit(self.tpu_config, self.target.models)
 
     def forward(self, input_ids: np.ndarray, position_ids: np.ndarray, **kwargs):
         if input_ids.shape[1] > 1:  # prefill: prime BOTH caches on the prompt
