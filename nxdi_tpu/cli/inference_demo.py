@@ -237,6 +237,11 @@ def run_inference(args) -> int:
         seed=args.seed,
     )
     if args.enable_lora and args.adapter_id:
+        if len(args.adapter_id) != input_ids.shape[0]:
+            raise ValueError(
+                f"--adapter-id count ({len(args.adapter_id)}) must match the "
+                f"prompt count ({input_ids.shape[0]})"
+            )
         gen_kwargs["adapter_ids"] = np.array(
             [app.lora_adapter_id(None if a in ("base", "none") else a)
              for a in args.adapter_id],

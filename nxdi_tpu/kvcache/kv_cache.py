@@ -44,6 +44,9 @@ class KVCacheSpec:
     dtype: str = "bfloat16"
     # fp8 KV quantization (reference: kv_cache_manager.py:642-692)
     quant_dtype: Optional[str] = None
+    # MLA latent caches store DIFFERENT per-position widths in k and v
+    # (k: rotated rope key, v: compressed normed kv latent); None = same as k
+    v_head_dim: Optional[int] = None
 
     @property
     def store_dtype(self):
@@ -61,6 +64,11 @@ class KVCacheSpec:
     def shape(self) -> Tuple[int, ...]:
         return (self.num_layers, self.batch_size, self.num_kv_heads, self.max_len, self.head_dim)
 
+    @property
+    def shape_v(self) -> Tuple[int, ...]:
+        d = self.v_head_dim if self.v_head_dim is not None else self.head_dim
+        return self.shape[:-1] + (d,)
+
 
 def init_kv_cache(spec: KVCacheSpec) -> Dict[str, jax.Array]:
     """Zero-initialized cache pytree {'k': ..., 'v': ...}."""
@@ -68,7 +76,7 @@ def init_kv_cache(spec: KVCacheSpec) -> Dict[str, jax.Array]:
     # would trip double-donation
     return {
         "k": jnp.zeros(spec.shape, dtype=spec.store_dtype),
-        "v": jnp.zeros(spec.shape, dtype=spec.store_dtype),
+        "v": jnp.zeros(spec.shape_v, dtype=spec.store_dtype),
     }
 
 

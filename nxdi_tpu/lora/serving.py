@@ -178,7 +178,8 @@ def attach_lora_buffers(params: Dict[str, Any], arch, lora_cfg) -> Dict[str, Any
     layers = params["layers"]
     for name in lora_cfg.target_modules:
         group, proj = LORA_TARGETABLE_MODULES[name][0]
-        if group not in layers:  # e.g. MoE models have no dense "mlp"
+        # MoE models have no dense "mlp"; MLA attention has no q/k/v_proj
+        if group not in layers or proj not in layers[group]:
             continue
         fin, fout = _module_dims(arch, name)
         p = layers[group][proj]
@@ -196,7 +197,7 @@ def write_adapter_into_buffers(
     layers = params["layers"]
     for name, buf in converted.items():
         group, proj = LORA_TARGETABLE_MODULES[name][0]
-        if group not in layers:
+        if group not in layers or proj not in layers[group]:
             continue
         p = layers[group][proj]
         p["lora_A"] = p["lora_A"].at[:, slot].set(buf["A"]) if hasattr(
@@ -225,7 +226,7 @@ def lora_spec_update(specs: Dict[str, Any], lora_cfg) -> Dict[str, Any]:
     col = {"q_proj", "k_proj", "v_proj", "gate_proj", "up_proj"}
     for name in lora_cfg.target_modules:
         group, proj = LORA_TARGETABLE_MODULES[name][0]
-        if group not in layers:
+        if group not in layers or proj not in layers[group]:
             continue
         p = layers[group][proj]
         if name in col:
@@ -249,7 +250,7 @@ def lora_shape_struct(struct: Dict[str, Any], arch, lora_cfg) -> Dict[str, Any]:
     layers = struct["layers"]
     for name in lora_cfg.target_modules:
         group, proj = LORA_TARGETABLE_MODULES[name][0]
-        if group not in layers:
+        if group not in layers or proj not in layers[group]:
             continue
         fin, fout = _module_dims(arch, name)
         p = layers[group][proj]

@@ -95,8 +95,40 @@ class DecoderArch:
     act_clamp: Optional[float] = None
     # MoE feed-forward replaces the dense MLP when set (ops/moe.py)
     moe: Optional[moe_ops.MoEArch] = None
+    # gemma lineage (reference: models/gemma3/modeling_gemma3.py): (1+w)
+    # float32 norms, sandwich (pre+post) feed-forward norms, sqrt(H) embedding
+    # scale; per-layer sliding-window/rope selection rides the layer scan as
+    # params flags ("use_sliding_window", "use_local_rope")
+    gemma_norm: bool = False
+    sandwich_norm: bool = False
+    embed_scale: Optional[float] = None
+    # gpt-oss style learned attention-sink logits (params: attn["sink"] (H,))
+    attention_sink: bool = False
+    # dbrx: weight-only LayerNorm instead of RMSNorm; qkv clamp
+    layernorm: bool = False
+    clip_qkv: Optional[float] = None
+    # o_proj bias (gpt-oss; the llama lineage never has one)
+    attention_o_bias: bool = False
+    # YaRN attention factor multiplying cos/sin (gpt-oss, deepseek)
+    rope_mscale: float = 1.0
+    # Multi-head Latent Attention replaces the GQA attention when set
+    # (ops/mla.py; deepseek lineage)
+    mla: Optional[Any] = None
 
     def kv_cache_spec(self, batch_size: int, max_len: int, quant_dtype=None) -> KVCacheSpec:
+        if self.mla is not None:
+            # latent cache: k holds the shared rotated rope key, v the normed
+            # compressed kv latent (ops/mla.py)
+            return KVCacheSpec(
+                num_layers=self.num_layers,
+                batch_size=batch_size,
+                num_kv_heads=1,
+                max_len=max_len,
+                head_dim=self.mla.qk_rope_head_dim,
+                v_head_dim=self.mla.kv_lora_rank,
+                dtype=self.dtype,
+                quant_dtype=quant_dtype,
+            )
         return KVCacheSpec(
             num_layers=self.num_layers,
             batch_size=batch_size,
@@ -123,6 +155,8 @@ def attention_param_specs(arch: DecoderArch) -> Dict[str, Any]:
         # Qwen2-style layout: q/k/v carry biases, o_proj does not
         for name in ("q_proj", "k_proj", "v_proj"):
             spec[name]["b"] = P(AXIS_TP)
+    if arch.attention_o_bias:  # gpt-oss
+        spec["o_proj"]["b"] = REPLICATED
     if arch.qk_norm:
         spec["q_norm"] = REPLICATED
         spec["k_norm"] = REPLICATED
@@ -177,6 +211,14 @@ def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
 # Blocks
 # ---------------------------------------------------------------------------
 
+def _norm(arch, x, w):
+    if arch.layernorm:
+        from nxdi_tpu.ops.norms import layer_norm
+
+        return layer_norm(x, w, eps=1e-5)
+    return rms_norm(x, w, arch.rms_norm_eps, gemma_style=arch.gemma_norm)
+
+
 def _linear(x, p, act_quant=None, clamp=None, adapter_ids=None):
     """Linear over either a full-precision param dict ``{"w"[, "b"]}`` or a
     quantized one ``{"qw", "scale"[, "b"]}`` (ops/quantization.py). When the
@@ -213,6 +255,7 @@ def attention_block(
     layout=DEFAULT_KV_LAYOUT,
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
+    window_enabled: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -227,13 +270,20 @@ def attention_block(
     H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
 
     aq, ac = arch.act_quant, arch.act_clamp
-    q = _linear(hidden, p_attn["q_proj"], aq, ac, adapter_ids).reshape(B, S, H, D)
-    k = _linear(hidden, p_attn["k_proj"], aq, ac, adapter_ids).reshape(B, S, KV, D)
-    v = _linear(hidden, p_attn["v_proj"], aq, ac, adapter_ids).reshape(B, S, KV, D)
+    q = _linear(hidden, p_attn["q_proj"], aq, ac, adapter_ids)
+    k = _linear(hidden, p_attn["k_proj"], aq, ac, adapter_ids)
+    v = _linear(hidden, p_attn["v_proj"], aq, ac, adapter_ids)
+    if arch.clip_qkv is not None:  # dbrx clamps the qkv outputs
+        q = jnp.clip(q, -arch.clip_qkv, arch.clip_qkv)
+        k = jnp.clip(k, -arch.clip_qkv, arch.clip_qkv)
+        v = jnp.clip(v, -arch.clip_qkv, arch.clip_qkv)
+    q = q.reshape(B, S, H, D)
+    k = k.reshape(B, S, KV, D)
+    v = v.reshape(B, S, KV, D)
 
     if arch.qk_norm:
-        q = rms_norm(q, p_attn["q_norm"], arch.rms_norm_eps)
-        k = rms_norm(k, p_attn["k_norm"], arch.rms_norm_eps)
+        q = _norm(arch, q, p_attn["q_norm"])
+        k = _norm(arch, k, p_attn["k_norm"])
 
     q = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
     k = jnp.swapaxes(k, 1, 2)  # (B, KV, S, D)
@@ -256,6 +306,8 @@ def attention_block(
         ctx = None
         if (
             arch.attn_tkg_kernel_enabled
+            and not arch.attention_sink
+            and window_enabled is None
             and attn_kernels.decode_kernel_supported(q.shape, kk.shape)
         ):
             ctx = attn_kernels.sharded_kernel_call(
@@ -272,11 +324,15 @@ def attention_block(
                 softmax_dtype=jnp.float32,
                 sliding_window=arch.sliding_window,
                 chunk_size=arch.chunk_size,
+                sink=p_attn.get("sink") if arch.attention_sink else None,
+                sliding_window_enabled=window_enabled,
             )
     else:
         ctx = None
         if (
             arch.attn_kernel_enabled
+            and not arch.attention_sink
+            and window_enabled is None
             and attn_kernels.prefill_kernel_supported(q.shape, k.shape)
         ):
             ctx = attn_kernels.sharded_kernel_call(
@@ -293,6 +349,8 @@ def attention_block(
                 softmax_dtype=jnp.float32,
                 sliding_window=arch.sliding_window,
                 chunk_size=arch.chunk_size,
+                sink=p_attn.get("sink") if arch.attention_sink else None,
+                sliding_window_enabled=window_enabled,
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
@@ -327,22 +385,47 @@ def decoder_layer(
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
 ):
-    h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
+    # per-layer rope selection (gemma3 local/global thetas): cos/sin arrive
+    # stacked (2, B, S, D) and the layer flag picks one inside the scan body
+    if "use_local_rope" in lp:
+        cos = jnp.where(lp["use_local_rope"], cos[1], cos[0])
+        sin = jnp.where(lp["use_local_rope"], sin[1], sin[0])
+    window_enabled = lp.get("use_sliding_window")
+
+    h = _norm(arch, hidden, lp["input_layernorm"])
     if "input_norm_skip" in lp:
         # per-layer scalar riding the scan xs: EAGLE drafts feed the fc output
         # straight into attention for their first layer (no input norm)
         h = jnp.where(lp["input_norm_skip"], hidden, h)
-    attn_out, (nk, nv) = attention_block(
+    if arch.mla is not None:
+        from nxdi_tpu.ops.mla import mla_attention_block as attn_block_fn
+    else:
+        attn_block_fn = attention_block
+    attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
-        adapter_ids,
+        adapter_ids, window_enabled,
     )
-    hidden = hidden + attn_out
-    h = rms_norm(hidden, lp["post_attention_layernorm"], arch.rms_norm_eps)
-    if arch.moe is not None:
-        hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
+    if arch.sandwich_norm:
+        # gemma lineage: post-norms applied to the block OUTPUT before the
+        # residual add, and a dedicated pre-feedforward norm
+        # (reference: NeuronGemma3DecoderLayer forward, modeling_gemma3.py:224)
+        attn_out = _norm(arch, attn_out, lp["post_attention_layernorm"])
+        hidden = hidden + attn_out
+        h = _norm(arch, hidden, lp["pre_feedforward_layernorm"])
+        if arch.moe is not None:
+            ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
+        else:
+            ff = mlp_block(arch, lp["mlp"], h, adapter_ids)
+        ff = _norm(arch, ff, lp["post_feedforward_layernorm"])
+        hidden = hidden + ff
     else:
-        hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids)
+        hidden = hidden + attn_out
+        h = _norm(arch, hidden, lp["post_attention_layernorm"])
+        if arch.moe is not None:
+            hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
+        else:
+            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids)
     hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
@@ -443,6 +526,10 @@ def causal_lm_forward(
     compute_dtype = to_jax_dtype(arch.dtype)
 
     hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    if arch.embed_scale is not None:
+        # gemma scales embeddings by sqrt(hidden) AFTER the dtype downcast
+        # (reference: modeling_gemma3.py:238-241)
+        hidden = hidden * jnp.asarray(arch.embed_scale, compute_dtype)
     if "fc" in params:
         # EAGLE draft input: concat(token embedding, previous-position feature)
         # projected back to the hidden size (reference: the EAGLE draft fc,
@@ -453,7 +540,17 @@ def causal_lm_forward(
             params["fc"], arch.act_quant, arch.act_clamp,
         )
     hidden = constrain(hidden, policy.hidden)
-    cos, sin = rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32)
+    inv_freq = np.asarray(inv_freq)
+    if inv_freq.ndim == 2:  # (2, D/2): [global, local] thetas (gemma3)
+        cos_g, sin_g = rope_cos_sin(position_ids, inv_freq[0], dtype=jnp.float32)
+        cos_l, sin_l = rope_cos_sin(position_ids, inv_freq[1], dtype=jnp.float32)
+        cos = jnp.stack([cos_g, cos_l])
+        sin = jnp.stack([sin_g, sin_l])
+    else:
+        cos, sin = rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32)
+    if arch.rope_mscale != 1.0:
+        cos = cos * arch.rope_mscale
+        sin = sin * arch.rope_mscale
 
     if isinstance(layout, BlockKVLayout):
         slots = cache["k"].shape[1]
@@ -487,7 +584,7 @@ def causal_lm_forward(
         )
     pre_norm_hidden = hidden
     if "norm" in params:  # EAGLE drafts have no final norm
-        hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
+        hidden = _norm(arch, hidden, params["norm"])
 
     lm_head = params.get("lm_head")
     if lm_head is None:  # tied embeddings

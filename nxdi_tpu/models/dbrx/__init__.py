@@ -1,0 +1,1 @@
+from nxdi_tpu.models.dbrx import modeling_dbrx

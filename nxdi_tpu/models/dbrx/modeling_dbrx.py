@@ -1,0 +1,130 @@
+"""DBRX family — 16-expert MoE with fused Wqkv, qkv clamp and LayerNorm.
+
+Reference: models/dbrx/modeling_dbrx.py (308 LoC). Distinguishing traits vs
+the llama lineage: weight-only LayerNorm (not RMSNorm), a fused ``Wqkv``
+projection whose output is clamped to ±clip_qkv, packed expert weights
+(``experts.mlp.w1/v1/w2`` holding all experts stacked on the row dim), and a
+router whose top-k weights renormalize by their sum — the same semantics as
+mixtral's router, so ops/moe.py is reused as-is.
+
+HF config nests attention/ffn knobs under ``attn_config``/``ffn_config``;
+the InferenceConfig flattens them to the shared field names.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, ep_policy
+from nxdi_tpu.parallel import gqa
+
+build_inv_freq = dense.build_inv_freq
+
+
+class DbrxInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["d_model", "n_heads", "n_layers", "vocab_size"]
+
+    def add_derived_config(self):
+        # flatten dbrx's nested config blocks into the shared names
+        attn = getattr(self, "attn_config", None) or {}
+        ffn = getattr(self, "ffn_config", None) or {}
+        if not isinstance(attn, dict):
+            attn = dict(attn)
+        if not isinstance(ffn, dict):
+            ffn = dict(ffn)
+        self.hidden_size = self.d_model
+        self.num_attention_heads = self.n_heads
+        self.num_hidden_layers = self.n_layers
+        self.num_key_value_heads = attn.get("kv_n_heads", self.n_heads)
+        self.rope_theta = attn.get("rope_theta", 10000.0)
+        self.clip_qkv = attn.get("clip_qkv")
+        self.intermediate_size = ffn.get("ffn_hidden_size", 4 * self.d_model)
+        self.num_local_experts = ffn.get("moe_num_experts", 16)
+        self.num_experts_per_tok = ffn.get("moe_top_k", 4)
+        act = ffn.get("ffn_act_fn") or {}
+        self.hidden_act = act.get("name", "silu")
+        self.rms_norm_eps = 1e-5  # LayerNorm eps (HF nn.LayerNorm default)
+        self.rope_scaling = None
+        self.tie_word_embeddings = False
+        super().add_derived_config()
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.num_local_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.intermediate_size,
+        hidden_act=config.hidden_act,
+        norm_topk_prob=True,  # dbrx: / sum(top_weights) (p=1 norm of softmax)
+        ep=ep_policy(config.tpu_config.tp_degree, config.num_local_experts),
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    return dense.build_arch(
+        config,
+        **{
+            "moe": _moe_arch(config),
+            "layernorm": True,
+            "clip_qkv": getattr(config, "clip_qkv", None),
+            **overrides,
+        },
+    )
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    """dbrx HF layout (transformer.blocks.{i}...) -> the shared dense layout,
+    then the dense converter does GQA padding etc."""
+    arch = build_arch(config)
+    E = arch.moe.num_experts
+    inter, hs = arch.moe.intermediate_size, config.hidden_size
+    kv_dim = config.num_key_value_heads * (hs // config.num_attention_heads)
+
+    sd: Dict[str, np.ndarray] = {}
+    sd["embed_tokens.weight"] = state_dict["transformer.wte.weight"]
+    sd["norm.weight"] = state_dict["transformer.norm_f.weight"]
+    sd["lm_head.weight"] = state_dict["lm_head.weight"]
+    for i in range(arch.num_layers):
+        src = f"transformer.blocks.{i}."
+        dst = f"layers.{i}."
+        qkv = state_dict[src + "norm_attn_norm.attn.Wqkv.weight"]  # (hs+2kv, hs)
+        sd[dst + "self_attn.q_proj.weight"] = qkv[:hs]
+        sd[dst + "self_attn.k_proj.weight"] = qkv[hs : hs + kv_dim]
+        sd[dst + "self_attn.v_proj.weight"] = qkv[hs + kv_dim :]
+        sd[dst + "self_attn.o_proj.weight"] = state_dict[src + "norm_attn_norm.attn.out_proj.weight"]
+        sd[dst + "input_layernorm.weight"] = state_dict[src + "norm_attn_norm.norm_1.weight"]
+        sd[dst + "post_attention_layernorm.weight"] = state_dict[src + "norm_attn_norm.norm_2.weight"]
+
+    def ff(get, has, cast, pre):
+        i = int(pre.split(".")[1])
+        src = f"transformer.blocks.{i}.ffn."
+        # packed (E*inter, hs) rows -> (E, hs, inter) stacked layout;
+        # w2 rows are (inter, hs) per expert already (x @ w2, no transpose)
+        w1 = state_dict[src + "experts.mlp.w1"].reshape(E, inter, hs)
+        v1 = state_dict[src + "experts.mlp.v1"].reshape(E, inter, hs)
+        w2 = state_dict[src + "experts.mlp.w2"].reshape(E, inter, hs)
+        return "moe", {
+            "router": {"w": cast(state_dict[src + "router.layer.weight"].T)},
+            "experts": {
+                "gate_proj": {"w": cast(np.swapaxes(w1, 1, 2))},
+                "up_proj": {"w": cast(np.swapaxes(v1, 1, 2))},
+                "down_proj": {"w": cast(w2)},
+            },
+        }
+
+    return dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.deepseek import modeling_deepseek

@@ -79,6 +79,7 @@ def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
         mlp_bias=getattr(config, "mlp_bias", False),
         tie_word_embeddings=getattr(config, "tie_word_embeddings", False),
         dtype=dtype_name(config.tpu_config.dtype),
+        rope_mscale=rope_mscale_from_config(config),
         attn_kernel_enabled=bool(config.tpu_config.attn_kernel_enabled),
         attn_tkg_kernel_enabled=bool(config.tpu_config.attn_tkg_kernel_enabled),
         act_quant=getattr(config.tpu_config, "activation_quantization_type", None),
@@ -93,7 +94,23 @@ def build_inv_freq(config: InferenceConfig) -> np.ndarray:
         head_dim_of(config),
         getattr(config, "rope_theta", 10000.0),
         getattr(config, "rope_scaling", None),
+        max_position_embeddings=getattr(config, "max_position_embeddings", 4096),
     )
+
+
+def rope_mscale_from_config(config: InferenceConfig) -> float:
+    """YaRN attention factor for cos/sin scaling (1.0 for non-yarn ropes)."""
+    rs = getattr(config, "rope_scaling", None)
+    if rs and rs.get("rope_type", rs.get("type")) == "yarn":
+        from nxdi_tpu.ops.rope import yarn_inv_freq
+
+        return yarn_inv_freq(
+            head_dim_of(config),
+            getattr(config, "rope_theta", 10000.0),
+            rs,
+            getattr(config, "max_position_embeddings", 4096),
+        )[1]
+    return 1.0
 
 
 def convert_hf_state_dict(
@@ -153,6 +170,8 @@ def convert_hf_state_dict(
             attn["q_proj"]["b"] = cast(qb)
             attn["k_proj"]["b"] = cast(kb)
             attn["v_proj"]["b"] = cast(vb)
+        if arch.attention_o_bias:
+            attn["o_proj"]["b"] = cast(get(pre + "self_attn.o_proj.bias"))
         if arch.qk_norm:
             attn["q_norm"] = cast(get(pre + "self_attn.q_norm.weight"))
             attn["k_norm"] = cast(get(pre + "self_attn.k_norm.weight"))
@@ -227,6 +246,8 @@ def param_shape_struct(config: InferenceConfig, arch: DecoderArch):
         attn["q_proj"]["b"] = s(L, H * D)
         attn["k_proj"]["b"] = s(L, KV * D)
         attn["v_proj"]["b"] = s(L, KV * D)
+    if arch.attention_o_bias:
+        attn["o_proj"]["b"] = s(L, hs)
     if arch.qk_norm:
         attn["q_norm"] = s(L, D)
         attn["k_norm"] = s(L, D)

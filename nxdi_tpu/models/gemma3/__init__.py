@@ -1,0 +1,1 @@
+from nxdi_tpu.models.gemma3 import modeling_gemma3

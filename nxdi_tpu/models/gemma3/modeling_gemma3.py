@@ -1,0 +1,133 @@
+"""Gemma3 (text) family.
+
+Reference: models/gemma3/modeling_gemma3.py (361 LoC) — gemma-style (1+w)
+float32 RMSNorm (:44), per-layer interleaved sliding-window attention with a
+full-attention layer every Nth (:68 ``get_updated_configs``), local/global
+rope thetas chosen per layer (:151), sandwich pre/post feed-forward norms
+(:224), sqrt(hidden) embedding scale (:238), and a ``query_pre_attn_scalar``
+softmax scale.
+
+TPU-native mapping: all per-layer heterogeneity (window on/off, local/global
+rope) rides the layer scan as boolean flag arrays in the params pytree
+(models/base.py decoder_layer), so the stack still compiles as ONE scanned
+body; ``build_inv_freq`` returns the [global, local] inv-freq pair stacked.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.rope import default_inv_freq, inv_freq_from_hf_config
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class Gemma3InferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + ["head_dim"]
+
+    def add_derived_config(self):
+        super().add_derived_config()
+        if getattr(self, "hidden_act", None) in (None, "silu"):
+            # HF stores gemma's activation under hidden_activation
+            self.hidden_act = getattr(self, "hidden_activation", "gelu_pytorch_tanh")
+        if not hasattr(self, "query_pre_attn_scalar"):
+            self.query_pre_attn_scalar = self.head_dim
+        if not hasattr(self, "rope_local_base_freq"):
+            self.rope_local_base_freq = 10000.0
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+        if not hasattr(self, "tie_word_embeddings"):
+            self.tie_word_embeddings = True
+
+
+def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:
+    """Which layers use the sliding window: HF ``layer_types`` when present,
+    else the every-Nth-global pattern (reference: modeling_gemma3.py:79)."""
+    lt = getattr(config, "layer_types", None)
+    if lt:
+        return lt[i] == "sliding_attention"
+    pattern = getattr(config, "sliding_window_pattern", None) or 6
+    return (i + 1) % pattern != 0
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        qk_norm=True,
+        gemma_norm=True,
+        sandwich_norm=True,
+        embed_scale=float(config.hidden_size) ** 0.5,
+        sliding_window=getattr(config, "sliding_window", None),
+        attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
+        tie_word_embeddings=getattr(config, "tie_word_embeddings", True),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    """Stacked [global, local] inverse frequencies — global layers use
+    rope_theta (+ scaling), sliding layers the local base freq."""
+    g = inv_freq_from_hf_config(
+        config.head_dim,
+        getattr(config, "rope_theta", 1000000.0),
+        getattr(config, "rope_scaling", None),
+    )
+    loc = default_inv_freq(config.head_dim, config.rope_local_base_freq)
+    return np.stack([g, loc])
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    params = dense.convert_hf_state_dict(state_dict, config, arch)
+    dt = dense.np_dtype(arch.dtype)
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(name)
+
+    L = arch.num_layers
+    pre_ff, post_ff = [], []
+    for i in range(L):
+        pre_ff.append(np.asarray(get(f"layers.{i}.pre_feedforward_layernorm.weight"), dt))
+        post_ff.append(np.asarray(get(f"layers.{i}.post_feedforward_layernorm.weight"), dt))
+    params["layers"]["pre_feedforward_layernorm"] = np.stack(pre_ff)
+    params["layers"]["post_feedforward_layernorm"] = np.stack(post_ff)
+
+    sliding = np.array([_layer_is_sliding(config, i) for i in range(L)], dtype=bool)
+    params["layers"]["use_sliding_window"] = sliding
+    params["layers"]["use_local_rope"] = sliding  # local rope on sliding layers
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["pre_feedforward_layernorm"] = REPLICATED
+    specs["layers"]["post_feedforward_layernorm"] = REPLICATED
+    specs["layers"]["use_sliding_window"] = REPLICATED
+    specs["layers"]["use_local_rope"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+    struct["layers"]["pre_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
+    struct["layers"]["post_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
+    struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    struct["layers"]["use_local_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return struct

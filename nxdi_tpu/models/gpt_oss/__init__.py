@@ -1,0 +1,1 @@
+from nxdi_tpu.models.gpt_oss import modeling_gpt_oss

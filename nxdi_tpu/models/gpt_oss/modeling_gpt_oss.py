@@ -1,0 +1,175 @@
+"""gpt-oss family — attention sinks, interleaved sliding window, biased MoE.
+
+Reference: models/gpt_oss/modeling_gpt_oss.py (2034 LoC) with the LearnedSink
+module (modules/attention/sink.py), interleaved sliding-window KV manager
+(modules/kvcache/gpt_oss_kv_cache_manager.py) and MXFP4 layout transforms
+(mx_layout_transform.py — MXFP4 is not implemented here yet; bf16/int8/fp8
+paths serve the weights).
+
+Architecture traits handled by the shared decoder (models/base.py):
+  - learned per-head attention-sink logits joining the softmax and dropping
+    their mass (``attention_sink`` + ``attn["sink"]`` params);
+  - alternating sliding/full attention layers via the ``use_sliding_window``
+    per-layer scan flag (one KV cache sized seq_len; the reference's
+    window-sized interleaved caches are a memory optimization to revisit);
+  - q/k/v/o projection biases;
+  - YaRN rope with the attention factor folded into cos/sin (rope_mscale);
+  - MoE: router takes top-k of logits then softmaxes them; experts carry
+    biases and the clamped glu (up+1)*gate*sigmoid(1.702*gate) (ops/moe.py
+    gptoss_glu).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, ep_policy
+from nxdi_tpu.ops.rope import default_inv_freq, yarn_inv_freq
+from nxdi_tpu.parallel import gqa
+from nxdi_tpu.parallel.layers import REPLICATED
+
+GLU_ALPHA = 1.702
+GLU_LIMIT = 7.0
+
+
+class GptOssInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + [
+        "num_local_experts",
+        "num_experts_per_tok",
+        "head_dim",
+    ]
+
+    def add_derived_config(self):
+        super().add_derived_config()
+        self.attention_bias = True
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.num_local_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.intermediate_size,
+        topk_softmax=True,
+        router_bias=True,
+        expert_bias=True,
+        gptoss_glu=True,
+        glu_limit=GLU_LIMIT,
+        glu_alpha=GLU_ALPHA,
+        ep=ep_policy(config.tpu_config.tp_degree, config.num_local_experts),
+    )
+
+
+def _rope(config: InferenceConfig):
+    scaling = getattr(config, "rope_scaling", None)
+    theta = getattr(config, "rope_theta", 150000.0)
+    if scaling and scaling.get("rope_type", scaling.get("type")) == "yarn":
+        return yarn_inv_freq(
+            config.head_dim, theta, scaling,
+            getattr(config, "max_position_embeddings", 4096),
+        )
+    return dense.build_inv_freq(config), 1.0
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    _, mscale = _rope(config)
+    kwargs = dict(
+        moe=_moe_arch(config),
+        attention_sink=True,
+        attention_o_bias=True,
+        sliding_window=getattr(config, "sliding_window", None),
+        rope_mscale=mscale,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return _rope(config)[0]
+
+
+def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:
+    lt = getattr(config, "layer_types", None)
+    if lt:
+        return lt[i] == "sliding_attention"
+    return i % 2 == 0  # gpt-oss default: even layers sliding
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    E, inter = arch.moe.num_experts, arch.moe.intermediate_size
+    plan = dense.gqa_plan(config)
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(name)
+
+    def ff(g, has, cast, pre):
+        src = pre + "mlp."
+        gu = np.asarray(get(src + "experts.gate_up_proj"))  # (E, H, 2I) interleaved
+        gub = np.asarray(get(src + "experts.gate_up_proj_bias"))  # (E, 2I)
+        return "moe", {
+            "router": {
+                "w": cast(np.asarray(get(src + "router.weight")).T),
+                "b": cast(np.asarray(get(src + "router.bias"))),
+            },
+            "experts": {
+                "gate_proj": {"w": cast(gu[..., ::2]), "b": cast(gub[..., ::2])},
+                "up_proj": {"w": cast(gu[..., 1::2]), "b": cast(gub[..., 1::2])},
+                "down_proj": {
+                    "w": cast(np.asarray(get(src + "experts.down_proj"))),
+                    "b": cast(np.asarray(get(src + "experts.down_proj_bias"))),
+                },
+            },
+        }
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+    sinks = []
+    for i in range(L):
+        s = np.asarray(get(f"layers.{i}.self_attn.sinks"), dtype=dt)
+        # sinks follow the q-head order: apply the same head permutation/pad
+        # the q weights get (padded heads' sink value is irrelevant — their
+        # o_proj columns are zero)
+        sinks.append(gqa.convert_q(s[:, None], 1, plan)[:, 0])
+    params["layers"]["attn"]["sink"] = np.stack(sinks)
+    params["layers"]["use_sliding_window"] = np.array(
+        [_layer_is_sliding(config, i) for i in range(L)], dtype=bool
+    )
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["attn"]["sink"] = REPLICATED
+    specs["layers"]["use_sliding_window"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L = arch.num_layers
+    struct["layers"]["attn"]["sink"] = jax.ShapeDtypeStruct(
+        (L, arch.num_attention_heads), dt
+    )
+    struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return struct

@@ -82,7 +82,8 @@ def grouped_attention(
         weights = jax.nn.softmax(scores, axis=-1)
     weights = weights.astype(v.dtype)
     out = jnp.einsum("bkgqs,bksd->bkgqd", weights, v)
-    return out.reshape(B, H, Sq, D)
+    # v's head dim may differ from q's (MLA: qk_head_dim vs v_head_dim)
+    return out.reshape(B, H, Sq, v.shape[-1])
 
 
 def attention_with_positions(
@@ -91,10 +92,22 @@ def attention_with_positions(
     sliding_window: Optional[int] = None,
     chunk_size: Optional[int] = None,
     sink=None,
+    sliding_window_enabled=None,
 ):
-    """Attention with the mask derived from positions (prefill and decode both)."""
+    """Attention with the mask derived from positions (prefill and decode both).
+
+    ``sliding_window_enabled`` (traced scalar bool) gates the window per LAYER
+    for interleaved-SWA models (gemma3 every-6th-global, gpt-oss alternating —
+    reference: get_updated_configs gemma3/modeling_gemma3.py:68, gpt-oss
+    interleaved kv manager): the flag rides the layer scan, selecting between
+    the windowed and plain causal mask inside one compiled body.
+    """
     if sliding_window is not None:
         mask = sliding_window_mask_from_positions(q_pos, kv_pos, sliding_window)
+        if sliding_window_enabled is not None:
+            mask = jnp.where(
+                sliding_window_enabled, mask, causal_mask_from_positions(q_pos, kv_pos)
+            )
     elif chunk_size is not None:
         mask = chunked_attention_mask_from_positions(q_pos, kv_pos, chunk_size)
     else:

@@ -1,0 +1,183 @@
+"""Multi-head Latent Attention (MLA) — deepseek lineage.
+
+Reference: models/deepseek/modeling_deepseek.py:79 ``DeepseekV3Attention``
+(q LoRA path :172-186, compressed kv :188-199, yarn rope rope_util.py) —
+re-designed around a LATENT KV cache instead of the reference's expanded
+per-head cache:
+
+  - the cache's ``k`` stores the ROTATED shared rope key (B, 1, S, qk_rope),
+    its ``v`` the rms-normed compressed kv latent (B, 1, S, kv_lora) —
+    per-position cache cost is ``kv_lora + qk_rope`` (e.g. 576 for V3) instead
+    of ``heads * (qk_nope + qk_rope + v_dim)``, the whole point of MLA;
+  - at attention time the latent is expanded through ``kv_b`` to per-head
+    k_nope/value (the non-absorbed formulation — mathematically identical to
+    HF eager; the absorbed-matmul decode optimization is a later kernel).
+
+Head sharding: MLA has no GQA — q/kv_b/o shard over heads, which must divide
+tp (the reference asserts the same, modeling_deepseek.py:137).
+
+``rope_interleave`` checkpoints (deepseek stores rope channels interleaved)
+are handled at CONVERSION time by permuting the rope-dim output columns of
+q(_b) and kv_a, so the runtime always uses the standard rotate-half.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops.norms import rms_norm
+from nxdi_tpu.ops.rope import apply_rotary_pos_emb
+from nxdi_tpu.parallel.mesh import AXIS_TP
+
+
+@dataclass(frozen=True)
+class MLAArch:
+    num_heads: int
+    q_lora_rank: Optional[int]
+    kv_lora_rank: int
+    qk_nope_head_dim: int
+    qk_rope_head_dim: int
+    v_head_dim: int
+    softmax_scale: float
+
+    @property
+    def qk_head_dim(self) -> int:
+        return self.qk_nope_head_dim + self.qk_rope_head_dim
+
+
+def mla_attention_block(
+    arch,  # DecoderArch with .mla set
+    p_attn: Dict[str, Any],
+    hidden: jax.Array,  # (B, S, hidden)
+    cos: jax.Array,
+    sin: jax.Array,
+    k_cache_l: jax.Array,  # (B, 1, S_max, qk_rope) rotated rope keys
+    v_cache_l: jax.Array,  # (B, 1, S_max, kv_lora) normed latents
+    position_ids: jax.Array,
+    cache_spec,
+    attend_to_cache: bool,
+    policy,
+    layout,
+    cache_inputs: Optional[Dict[str, jax.Array]] = None,
+    adapter_ids: Optional[jax.Array] = None,
+    window_enabled=None,
+) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
+    from nxdi_tpu.models.base import _linear
+
+    mla: MLAArch = arch.mla
+    B, S, _ = hidden.shape
+    H = mla.num_heads
+    nope, rope_d, r = mla.qk_nope_head_dim, mla.qk_rope_head_dim, mla.kv_lora_rank
+
+    # -- queries
+    if mla.q_lora_rank is None:
+        q = _linear(hidden, p_attn["q_proj"])
+    else:
+        qa = _linear(hidden, p_attn["q_a"])
+        qa = rms_norm(qa, p_attn["q_a_norm"], arch.rms_norm_eps)
+        q = _linear(qa, p_attn["q_b"])
+    q = q.reshape(B, S, H, mla.qk_head_dim)
+    q_nope, q_rot = q[..., :nope], q[..., nope:]
+
+    # -- compressed kv + shared rope key
+    ckv = _linear(hidden, p_attn["kv_a"])  # (B, S, r + rope_d)
+    c, k_rot = ckv[..., :r], ckv[..., r:]
+    c = rms_norm(c, p_attn["kv_a_norm"], arch.rms_norm_eps)  # normed BEFORE caching
+
+    q_rot = jnp.swapaxes(q_rot, 1, 2)  # (B, H, S, rope_d)
+    k_rot = k_rot[:, None]  # (B, 1, S, rope_d)
+    q_rot, k_rot = apply_rotary_pos_emb(q_rot, k_rot, cos, sin)
+
+    # -- latent cache update (k <- rotated rope key, v <- normed latent)
+    # layouts expect (B, KV, S, D): rope key (B, 1, S, rope_d), latent (B, 1, S, r)
+    ci = dict(cache_inputs or {})
+    ci["position_ids"] = position_ids
+    new_k, new_v = layout.update(k_cache_l, v_cache_l, k_rot, c[:, None], ci, cache_spec)
+
+    if attend_to_cache:
+        k_rot_all, c_all, kv_pos = layout.read(new_k, new_v, ci, cache_spec)
+    else:
+        k_rot_all, c_all = k_rot, c[:, None]
+        kv_pos = position_ids
+
+    # -- expand latent to per-head k_nope / value through kv_b
+    W = c_all.shape[2]
+    kb = _linear(c_all[:, 0], p_attn["kv_b"])  # (B, W, H*(nope+v))
+    kb = kb.reshape(B, W, H, nope + mla.v_head_dim)
+    k_nope = jnp.swapaxes(kb[..., :nope], 1, 2)  # (B, H, W, nope)
+    v = jnp.swapaxes(kb[..., nope:], 1, 2)  # (B, H, W, v_dim)
+
+    qq = jnp.concatenate([jnp.swapaxes(q_nope, 1, 2), q_rot], axis=-1)  # (B,H,S,qk)
+    kk = jnp.concatenate(
+        [k_nope, jnp.broadcast_to(k_rot_all, (B, H, W, rope_d))], axis=-1
+    )
+
+    mask = attn_ops.causal_mask_from_positions(position_ids, kv_pos)
+    ctx = attn_ops.grouped_attention(
+        qq, kk, v, mask, scale=mla.softmax_scale, softmax_dtype=jnp.float32
+    )  # (B, H, S, v_dim)
+
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * mla.v_head_dim)
+    out = _linear(ctx, p_attn["o_proj"])
+    return out, (new_k, new_v)
+
+
+# ---------------------------------------------------------------------------
+# Param layout / conversion helpers (used by the deepseek family module)
+# ---------------------------------------------------------------------------
+
+def mla_param_specs(mla: MLAArch) -> Dict[str, Any]:
+    specs: Dict[str, Any] = {
+        "kv_a": {"w": P()},  # small (hidden -> r + rope): replicated
+        "kv_a_norm": P(),
+        "kv_b": {"w": P(None, AXIS_TP)},  # heads on out dim
+        "o_proj": {"w": P(AXIS_TP, None)},
+    }
+    if mla.q_lora_rank is None:
+        specs["q_proj"] = {"w": P(None, AXIS_TP)}
+    else:
+        specs["q_a"] = {"w": P()}
+        specs["q_a_norm"] = P()
+        specs["q_b"] = {"w": P(None, AXIS_TP)}
+    return specs
+
+
+def mla_shape_struct(mla: MLAArch, hidden_size: int, num_layers: int, dtype) -> Dict[str, Any]:
+    def s(*shape):
+        return jax.ShapeDtypeStruct((num_layers,) + shape, dtype)
+
+    H, hs = mla.num_heads, hidden_size
+    struct: Dict[str, Any] = {
+        "kv_a": {"w": s(hs, mla.kv_lora_rank + mla.qk_rope_head_dim)},
+        "kv_a_norm": s(mla.kv_lora_rank),
+        "kv_b": {"w": s(mla.kv_lora_rank, H * (mla.qk_nope_head_dim + mla.v_head_dim))},
+        "o_proj": {"w": s(H * mla.v_head_dim, hs)},
+    }
+    if mla.q_lora_rank is None:
+        struct["q_proj"] = {"w": s(hs, H * mla.qk_head_dim)}
+    else:
+        struct["q_a"] = {"w": s(hs, mla.q_lora_rank)}
+        struct["q_a_norm"] = s(mla.q_lora_rank)
+        struct["q_b"] = {"w": s(mla.q_lora_rank, H * mla.qk_head_dim)}
+    return struct
+
+
+def deinterleave_rope_columns(w_t: np.ndarray, head_dim: int, nope: int, rope_d: int) -> np.ndarray:
+    """Permute the rope-dim output columns of a per-head projection weight
+    (already transposed to (in, H*head_dim)) from interleaved [r0,i0,r1,i1,...]
+    to rotate-half [r0,r1,...,i0,i1,...] layout (HF rope_interleave handling,
+    done once at conversion instead of per step)."""
+    fin, out = w_t.shape
+    H = out // head_dim
+    w = w_t.reshape(fin, H, head_dim)
+    rope_part = w[..., nope:]
+    perm = np.concatenate([np.arange(0, rope_d, 2), np.arange(1, rope_d, 2)])
+    w = np.concatenate([w[..., :nope], rope_part[..., perm]], axis=-1)
+    return w.reshape(fin, out)

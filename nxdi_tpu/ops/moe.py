@@ -50,6 +50,15 @@ class MoEArch:
     # shared (always-on) experts, qwen2-moe/llama4 style
     shared_expert_intermediate_size: Optional[int] = None
     shared_expert_gated: bool = False  # sigmoid(gate(x)) scaling on shared out
+    # gpt-oss variants (reference: models/gpt_oss/modeling_gpt_oss.py): router
+    # takes top-k of LOGITS then softmaxes the selected values; experts carry
+    # biases and use the clamped glu  (up+1) * gate*sigmoid(alpha*gate)
+    topk_softmax: bool = False
+    router_bias: bool = False
+    expert_bias: bool = False
+    gptoss_glu: bool = False
+    glu_limit: Optional[float] = None
+    glu_alpha: float = 1.702
 
 
 def ep_policy(tp_degree: int, num_experts: int) -> bool:
@@ -90,16 +99,25 @@ def expert_parallel_specs(moe: MoEArch) -> Dict[str, Any]:
             "up_proj": {"w": P(AXIS_TP, None, None)},
             "down_proj": {"w": P(AXIS_TP, None, None)},
         }
+        if moe.expert_bias:
+            for k in expert_spec:
+                expert_spec[k]["b"] = P(AXIS_TP, None)
     else:
         expert_spec = {
             "gate_proj": {"w": P(None, None, AXIS_TP)},
             "up_proj": {"w": P(None, None, AXIS_TP)},
             "down_proj": {"w": P(None, AXIS_TP, None)},
         }
+        if moe.expert_bias:
+            expert_spec["gate_proj"]["b"] = P(None, AXIS_TP)
+            expert_spec["up_proj"]["b"] = P(None, AXIS_TP)
+            expert_spec["down_proj"]["b"] = P()
     specs: Dict[str, Any] = {
         "router": {"w": P()},
         "experts": expert_spec,
     }
+    if moe.router_bias:
+        specs["router"]["b"] = P()
     if moe.shared_expert_intermediate_size:
         specs["shared_expert"] = {
             "gate_proj": {"w": P(None, AXIS_TP)},
@@ -115,10 +133,15 @@ def route(router_logits: jax.Array, moe: MoEArch) -> jax.Array:
     """Router logits (T, E) -> dense combine weights (T, E), zero for
     unselected experts (HF Mixtral/Qwen3Moe semantics: full softmax -> top-k ->
     optional renormalize; reference: RouterTopK in moe_v2.py:23)."""
-    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-    top_vals, top_idx = jax.lax.top_k(probs, moe.top_k)  # (T, K)
-    if moe.norm_topk_prob:
-        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    if moe.topk_softmax:
+        # gpt-oss: top-k on raw logits, softmax over the k selected values
+        top_vals, top_idx = jax.lax.top_k(router_logits.astype(jnp.float32), moe.top_k)
+        top_vals = jax.nn.softmax(top_vals, axis=-1)
+    else:
+        probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+        top_vals, top_idx = jax.lax.top_k(probs, moe.top_k)  # (T, K)
+        if moe.norm_topk_prob:
+            top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
     dense = jnp.sum(
         jax.nn.one_hot(top_idx, moe.num_experts, dtype=top_vals.dtype)
         * top_vals[..., None],
@@ -142,14 +165,27 @@ def moe_block(arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array) -> jax.Array:
     from nxdi_tpu.ops.quantization import materialize_weight as mat_w
 
     router_logits = xt.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
+    if moe.router_bias:
+        router_logits = router_logits + p["router"]["b"].astype(jnp.float32)
     weights = route(router_logits, moe).astype(x.dtype)  # (T, E)
 
     # dense dispatch: all experts on all tokens, combine contracted over E.
     # mat_w dequantizes low-bit expert weights in the einsum's operand read.
     gate = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["gate_proj"], x.dtype))
     up = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["up_proj"], x.dtype))
-    inner = act(gate) * up  # (E, T, I)
+    if moe.expert_bias:
+        gate = gate + p["experts"]["gate_proj"]["b"][:, None, :]
+        up = up + p["experts"]["up_proj"]["b"][:, None, :]
+    if moe.gptoss_glu:
+        if moe.glu_limit is not None:
+            gate = jnp.minimum(gate, moe.glu_limit)
+            up = jnp.clip(up, -moe.glu_limit, moe.glu_limit)
+        inner = (up + 1.0) * (gate * jax.nn.sigmoid(gate * moe.glu_alpha))
+    else:
+        inner = act(gate) * up  # (E, T, I)
     expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p["experts"]["down_proj"], x.dtype))
+    if moe.expert_bias:
+        expert_out = expert_out + p["experts"]["down_proj"]["b"][:, None, :]
     out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
 
     if moe.shared_expert_intermediate_size:
@@ -181,6 +217,12 @@ def moe_shape_struct(moe: MoEArch, hidden_size: int, num_layers: int, dtype) -> 
             "down_proj": {"w": s(E, I, H)},
         },
     }
+    if moe.router_bias:
+        struct["router"]["b"] = s(E)
+    if moe.expert_bias:
+        struct["experts"]["gate_proj"]["b"] = s(E, I)
+        struct["experts"]["up_proj"]["b"] = s(E, I)
+        struct["experts"]["down_proj"]["b"] = s(E, H)
     if moe.shared_expert_intermediate_size:
         SI = moe.shared_expert_intermediate_size
         struct["shared_expert"] = {

@@ -13,13 +13,20 @@ import jax
 import jax.numpy as jnp
 
 
-def rms_norm(x, weight, eps: float = 1e-6):
-    """RMSNorm with float32 accumulation, output in x.dtype (matches HF llama)."""
+def rms_norm(x, weight, eps: float = 1e-6, gemma_style: bool = False):
+    """RMSNorm with float32 accumulation, output in x.dtype (matches HF llama).
+
+    ``gemma_style``: gemma-lineage checkpoints store weights as an OFFSET from
+    one and multiply in float32 before the downcast — ``(norm(x) * (1 + w))``
+    (reference: NeuronGemma3RMSNorm, models/gemma3/modeling_gemma3.py:44)."""
     dtype = x.dtype
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     y = xf * jax.lax.rsqrt(var + eps)
-    return (y * weight.astype(jnp.float32)).astype(dtype)
+    w = weight.astype(jnp.float32)
+    if gemma_style:
+        w = 1.0 + w
+    return (y * w).astype(dtype)
 
 
 def layer_norm(x, weight, bias=None, eps: float = 1e-5):

@@ -139,6 +139,8 @@ class ApplicationBase:
             sd = ckpt.load_state_dict(tc.quantized_checkpoints_path)
             params = quant_ops.unflatten_params(sd)
             quant_ops.validate_quantized_params(params, tc)
+            if tc.lora_config is not None:
+                params = self._attach_lora(params)
             return params
         sd = self.get_state_dict()
         params = self.family.convert_hf_state_dict(sd, self.config)
@@ -205,6 +207,13 @@ class ApplicationBase:
     def cache_partition_specs(self):
         if self.tpu_config.is_block_kv_layout:
             return block_kv_cache_partition_spec()
+        arch = self.family.build_arch(self.config)
+        if getattr(arch, "mla", None) is not None:
+            # MLA latent cache has ONE shared kv head; nothing to shard on the
+            # head axis — replicate (sequence sharding comes with flash decode)
+            from jax.sharding import PartitionSpec as P
+
+            return {"k": P(), "v": P()}
         return kv_cache_partition_spec(self.tpu_config)
 
     def init_cache_host(self):
@@ -240,10 +249,11 @@ class ApplicationBase:
 
     def _cache_struct(self):
         spec = self._cache_spec()
-        from nxdi_tpu.config import to_jax_dtype
-
-        z = jax.ShapeDtypeStruct(spec.shape, spec.store_dtype)
-        return {"k": z, "v": z}
+        shape_v = getattr(spec, "shape_v", spec.shape)
+        return {
+            "k": jax.ShapeDtypeStruct(spec.shape, spec.store_dtype),
+            "v": jax.ShapeDtypeStruct(shape_v, spec.store_dtype),
+        }
 
     def _cache_spec(self, family=None, config=None):
         family = family or self.family

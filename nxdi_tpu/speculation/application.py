@@ -125,8 +125,11 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
             ("target", self.family, self.config),
         ):
             spec = self._cache_spec(family, config)
-            z = jax.ShapeDtypeStruct(spec.shape, spec.store_dtype)
-            out[name] = {"k": z, "v": z}
+            shape_v = getattr(spec, "shape_v", spec.shape)
+            out[name] = {
+                "k": jax.ShapeDtypeStruct(spec.shape, spec.store_dtype),
+                "v": jax.ShapeDtypeStruct(shape_v, spec.store_dtype),
+            }
         return out
 
     # ------------------------------------------------------------------

@@ -62,6 +62,91 @@ def _tiny_hf(model_type):
             mlp_only_layers=[],
         )
         model = Qwen3MoeForCausalLM(cfg)
+    elif model_type == "gemma3":
+        from transformers import Gemma3ForCausalLM, Gemma3TextConfig
+
+        # interleaved SWA (every 3rd layer full attention), dual rope thetas,
+        # sandwich norms, (1+w) gemma norms, tied embeddings
+        common2 = dict(common)
+        common2.pop("rope_theta")
+        cfg = Gemma3TextConfig(
+            **common2,
+            head_dim=16,
+            sliding_window=8,
+            rope_theta=1000000.0,
+            rope_local_base_freq=10000.0,
+            query_pre_attn_scalar=16,
+            layer_types=[
+                "sliding_attention", "sliding_attention", "full_attention",
+                "sliding_attention",
+            ],
+            tie_word_embeddings=True,
+        )
+        model = Gemma3ForCausalLM(cfg)
+    elif model_type == "gpt_oss":
+        from transformers import GptOssConfig, GptOssForCausalLM
+
+        # sinks + alternating SWA + biased qkv/o + topk-softmax router +
+        # clamped glu experts + yarn rope
+        cfg = GptOssConfig(
+            hidden_size=64,
+            intermediate_size=32,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            head_dim=16,
+            num_local_experts=4,
+            num_experts_per_tok=2,
+            sliding_window=8,
+            max_position_embeddings=256,
+            rope_theta=150000.0,
+            tie_word_embeddings=False,
+        )
+        model = GptOssForCausalLM(cfg)
+    elif model_type == "deepseek_v3":
+        from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+        # MLA: q-LoRA + compressed kv latents + interleaved rope channels
+        cfg = DeepseekV3Config(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=4,
+            num_attention_heads=8,
+            num_key_value_heads=8,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rms_norm_eps=1e-5,
+            rope_theta=10000.0,
+            q_lora_rank=32,
+            kv_lora_rank=32,
+            qk_rope_head_dim=8,
+            qk_nope_head_dim=16,
+            v_head_dim=16,
+            first_k_dense_replace=4,  # all layers dense-MLP (MLA under test)
+            n_routed_experts=4,
+            num_experts_per_tok=2,
+            rope_scaling=None,
+            tie_word_embeddings=False,
+            # random weights CAN emit the default eos (1) mid-rollout; disable
+            # so both sides generate the full budget
+            eos_token_id=None,
+        )
+        model = DeepseekV3ForCausalLM(cfg)
+    elif model_type == "dbrx":
+        from transformers import DbrxConfig, DbrxForCausalLM
+
+        # fused Wqkv + clip, packed experts, LayerNorm, sum-normalized router
+        cfg = DbrxConfig(
+            d_model=64,
+            n_heads=4,
+            n_layers=4,
+            max_seq_len=256,
+            vocab_size=256,
+            attn_config={"kv_n_heads": 2, "rope_theta": 10000.0, "clip_qkv": 6.0},
+            ffn_config={"ffn_hidden_size": 32, "moe_num_experts": 8, "moe_top_k": 2},
+        )
+        model = DbrxForCausalLM(cfg)
     else:
         raise ValueError(model_type)
     return model.eval(), cfg
@@ -90,7 +175,10 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
     return app
 
 
-@pytest.mark.parametrize("model_type", ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe"])
+@pytest.mark.parametrize(
+    "model_type",
+    ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "dbrx", "gpt_oss", "deepseek_v3"]
+)
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):
     hf_model, hf_cfg = _tiny_hf(model_type)
