@@ -169,6 +169,13 @@ def _slots(lora_cfg) -> int:
     return lora_cfg.max_loras + 1  # slot 0 = base model (zeros)
 
 
+def _lora_skips(arch, group: str) -> bool:
+    """MLA attention has a different projection structure (q_a/q_b/kv_a/kv_b
+    with distinct dims); LoRA on its attention is not supported — only the
+    mlp targets apply."""
+    return group == "attn" and getattr(arch, "mla", None) is not None
+
+
 def attach_lora_buffers(params: Dict[str, Any], arch, lora_cfg) -> Dict[str, Any]:
     """Add all-zero slot-stacked LoRA buffers to every targeted projection's
     param dict (host side, before sharding)."""
@@ -178,8 +185,8 @@ def attach_lora_buffers(params: Dict[str, Any], arch, lora_cfg) -> Dict[str, Any
     layers = params["layers"]
     for name in lora_cfg.target_modules:
         group, proj = LORA_TARGETABLE_MODULES[name][0]
-        # MoE models have no dense "mlp"; MLA attention has no q/k/v_proj
-        if group not in layers or proj not in layers[group]:
+        # MoE models have no dense "mlp"; MLA attention is not LoRA-targetable
+        if group not in layers or proj not in layers[group] or _lora_skips(arch, group):
             continue
         fin, fout = _module_dims(arch, name)
         p = layers[group][proj]

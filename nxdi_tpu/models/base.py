@@ -114,6 +114,15 @@ class DecoderArch:
     # Multi-head Latent Attention replaces the GQA attention when set
     # (ops/mla.py; deepseek lineage)
     mla: Optional[Any] = None
+    # llama4 (reference: models/llama4/): adjacent-pair (GPT-J) rope layout,
+    # unweighted L2 qk-norm AFTER rope, per-position query temperature tuning
+    # on no-rope layers; per-layer rope/chunk gating rides the scan via the
+    # "use_rope" params flag
+    rope_interleaved: bool = False
+    qk_l2norm: bool = False
+    attn_temperature_tuning: bool = False
+    floor_scale: float = 8192.0
+    attn_scale: float = 0.1
 
     def kv_cache_spec(self, batch_size: int, max_len: int, quant_dtype=None) -> KVCacheSpec:
         if self.mla is not None:
@@ -256,6 +265,7 @@ def attention_block(
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
     window_enabled: Optional[jax.Array] = None,
+    use_rope: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -293,7 +303,36 @@ def attention_block(
     k = constrain(k, policy.kv)
     v = constrain(v, policy.kv)
 
-    q, k = apply_rotary_pos_emb(q, k, cos, sin)
+    rope_fn = apply_rotary_pos_emb
+    if arch.rope_interleaved:
+        from nxdi_tpu.ops.rope import apply_rotary_pos_emb_interleaved as rope_fn
+    if use_rope is None:
+        q, k = rope_fn(q, k, cos, sin)
+    else:
+        # llama4: some layers skip rope entirely (per-layer scan flag)
+        qr, kr = rope_fn(q, k, cos, sin)
+        q = jnp.where(use_rope, qr, q)
+        k = jnp.where(use_rope, kr, k)
+
+    if arch.qk_l2norm:
+        # llama4 unweighted qk norm, AFTER rope, on rope layers only
+        from nxdi_tpu.ops.rope import l2_norm
+
+        qn, kn = l2_norm(q, arch.rms_norm_eps), l2_norm(k, arch.rms_norm_eps)
+        if use_rope is None:
+            q, k = qn, kn
+        else:
+            q = jnp.where(use_rope, qn, q)
+            k = jnp.where(use_rope, kn, k)
+
+    if arch.attn_temperature_tuning and use_rope is not None:
+        # per-position query temperature on NO-rope layers
+        # (reference: llama4 attn temperature tuning)
+        pos = position_ids.astype(jnp.float32)
+        scales = (
+            jnp.log1p(jnp.floor((pos + 1.0) / arch.floor_scale)) * arch.attn_scale + 1.0
+        )[:, None, :, None]
+        q = jnp.where(use_rope, q, (q * scales).astype(q.dtype))
 
     ci = dict(cache_inputs or {})
     ci["position_ids"] = position_ids
@@ -308,6 +347,7 @@ def attention_block(
             arch.attn_tkg_kernel_enabled
             and not arch.attention_sink
             and window_enabled is None
+            and use_rope is None
             and attn_kernels.decode_kernel_supported(q.shape, kk.shape)
         ):
             ctx = attn_kernels.sharded_kernel_call(
@@ -326,6 +366,7 @@ def attention_block(
                 chunk_size=arch.chunk_size,
                 sink=p_attn.get("sink") if arch.attention_sink else None,
                 sliding_window_enabled=window_enabled,
+                chunk_enabled=use_rope,
             )
     else:
         ctx = None
@@ -333,6 +374,7 @@ def attention_block(
             arch.attn_kernel_enabled
             and not arch.attention_sink
             and window_enabled is None
+            and use_rope is None
             and attn_kernels.prefill_kernel_supported(q.shape, k.shape)
         ):
             ctx = attn_kernels.sharded_kernel_call(
@@ -351,6 +393,7 @@ def attention_block(
                 chunk_size=arch.chunk_size,
                 sink=p_attn.get("sink") if arch.attention_sink else None,
                 sliding_window_enabled=window_enabled,
+                chunk_enabled=use_rope,
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
@@ -391,6 +434,7 @@ def decoder_layer(
         cos = jnp.where(lp["use_local_rope"], cos[1], cos[0])
         sin = jnp.where(lp["use_local_rope"], sin[1], sin[0])
     window_enabled = lp.get("use_sliding_window")
+    use_rope = lp.get("use_rope")
 
     h = _norm(arch, hidden, lp["input_layernorm"])
     if "input_norm_skip" in lp:
@@ -404,7 +448,7 @@ def decoder_layer(
     attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
-        adapter_ids, window_enabled,
+        adapter_ids, window_enabled, use_rope,
     )
     if arch.sandwich_norm:
         # gemma lineage: post-norms applied to the block OUTPUT before the

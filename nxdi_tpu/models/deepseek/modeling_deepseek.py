@@ -99,20 +99,10 @@ def _mla_arch(config: InferenceConfig) -> MLAArch:
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    rs = getattr(config, "rope_scaling", None)
-    mscale = 1.0
-    if rs and rs.get("rope_type", rs.get("type")) == "yarn":
-        _, mscale = yarn_inv_freq(
-            config.qk_rope_head_dim,
-            getattr(config, "rope_theta", 10000.0),
-            rs,
-            getattr(config, "max_position_embeddings", 4096),
-        )
-    kwargs = dict(
-        mla=_mla_arch(config),
-        # head fields unused by MLA but keep the dense pipeline consistent
-        rope_mscale=mscale,
-    )
+    # the yarn attention factor (rope_mscale) is computed by dense.build_arch;
+    # it depends only on the scaling config, not on which head_dim the
+    # frequencies use
+    kwargs = dict(mla=_mla_arch(config))
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)
 

@@ -29,7 +29,6 @@ from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
 from nxdi_tpu.ops.moe import MoEArch, ep_policy
-from nxdi_tpu.ops.rope import default_inv_freq, yarn_inv_freq
 from nxdi_tpu.parallel import gqa
 from nxdi_tpu.parallel.layers import REPLICATED
 
@@ -66,32 +65,19 @@ def _moe_arch(config: InferenceConfig) -> MoEArch:
     )
 
 
-def _rope(config: InferenceConfig):
-    scaling = getattr(config, "rope_scaling", None)
-    theta = getattr(config, "rope_theta", 150000.0)
-    if scaling and scaling.get("rope_type", scaling.get("type")) == "yarn":
-        return yarn_inv_freq(
-            config.head_dim, theta, scaling,
-            getattr(config, "max_position_embeddings", 4096),
-        )
-    return dense.build_inv_freq(config), 1.0
+build_inv_freq = dense.build_inv_freq  # yarn handled generically (ops/rope.py)
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    _, mscale = _rope(config)
+    # rope_mscale (yarn attention factor) is set by dense.build_arch
     kwargs = dict(
         moe=_moe_arch(config),
         attention_sink=True,
         attention_o_bias=True,
         sliding_window=getattr(config, "sliding_window", None),
-        rope_mscale=mscale,
     )
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)
-
-
-def build_inv_freq(config: InferenceConfig) -> np.ndarray:
-    return _rope(config)[0]
 
 
 def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.llama4 import modeling_llama4

@@ -1,0 +1,142 @@
+"""Llama4 text family.
+
+Reference: models/llama4/ (3245 LoC: text+vision, chunked attention, 16E/128E
+MoE). This module is the TEXT decoder; the vision encoder rides the
+image-to-text application (models/image_to_text.py).
+
+Distinguishing traits handled by the shared decoder (models/base.py):
+  - adjacent-pair (GPT-J style) rope with some layers skipping rope entirely
+    (``no_rope_layers``; per-layer ``use_rope`` scan flag);
+  - unweighted L2 qk-norm AFTER rope on rope layers (``qk_l2norm``);
+  - per-position query temperature tuning on no-rope layers
+    (``attn_temperature_tuning``);
+  - chunked attention on rope layers (``attention_chunk_size``; the no-rope
+    layers attend globally — reference: attention_base.py:2559 chunked paths);
+  - MoE with sigmoid top-k scores scaling the expert INPUT plus an always-on
+    shared expert (ops/moe.py ``llama4_router``).
+
+Heterogeneous dense/MoE stacks (interleave_moe_layer_step > 1, the 128E
+model) are not supported yet — the layer scan requires a homogeneous stack.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, ep_policy
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Llama4InferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + [
+        "num_local_experts",
+        "num_experts_per_tok",
+    ]
+
+    def add_derived_config(self):
+        super().add_derived_config()
+        defaults = {
+            "no_rope_layers": None,
+            "attention_chunk_size": None,
+            "use_qk_norm": True,
+            "attn_temperature_tuning": True,
+            "floor_scale": 8192.0,
+            "attn_scale": 0.1,
+            "interleave_moe_layer_step": 1,
+        }
+        for k, v in defaults.items():
+            if not hasattr(self, k):
+                setattr(self, k, v)
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    step = getattr(config, "interleave_moe_layer_step", 1) or 1
+    if step != 1:
+        raise NotImplementedError(
+            "llama4 with interleave_moe_layer_step > 1 (dense/MoE interleaved "
+            "stack, the 128E model) is not supported yet: the layer scan needs "
+            "a homogeneous stack"
+        )
+    return MoEArch(
+        num_experts=config.num_local_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.intermediate_size,
+        llama4_router=True,
+        shared_expert_intermediate_size=config.intermediate_size,
+        ep=ep_policy(config.tpu_config.tp_degree, config.num_local_experts),
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        moe=_moe_arch(config),
+        rope_interleaved=True,
+        qk_l2norm=bool(getattr(config, "use_qk_norm", True)),
+        chunk_size=getattr(config, "attention_chunk_size", None),
+        attn_temperature_tuning=bool(getattr(config, "attn_temperature_tuning", True)),
+        floor_scale=float(getattr(config, "floor_scale", 8192.0)),
+        attn_scale=float(getattr(config, "attn_scale", 0.1)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def _use_rope_flags(config: InferenceConfig) -> np.ndarray:
+    nrl = getattr(config, "no_rope_layers", None)
+    L = config.num_hidden_layers
+    if nrl:
+        return np.array([bool(v) for v in nrl], dtype=bool)  # 1 = USE rope
+    interval = getattr(config, "no_rope_layer_interval", 4) or 4
+    return np.array([(i + 1) % interval != 0 for i in range(L)], dtype=bool)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    inter = arch.moe.intermediate_size
+
+    def ff(get, has, cast, pre):
+        src = pre + "feed_forward."
+        gu = np.asarray(get(src + "experts.gate_up_proj"))  # (E, H, 2I) chunked
+        return "moe", {
+            "router": {"w": cast(np.asarray(get(src + "router.weight")).T)},
+            "experts": {
+                "gate_proj": {"w": cast(gu[..., :inter])},
+                "up_proj": {"w": cast(gu[..., inter:])},
+                "down_proj": {"w": cast(np.asarray(get(src + "experts.down_proj")))},
+            },
+            "shared_expert": {
+                "gate_proj": {"w": cast(np.asarray(get(src + "shared_expert.gate_proj.weight")).T)},
+                "up_proj": {"w": cast(np.asarray(get(src + "shared_expert.up_proj.weight")).T)},
+                "down_proj": {"w": cast(np.asarray(get(src + "shared_expert.down_proj.weight")).T)},
+            },
+        }
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+    params["layers"]["use_rope"] = _use_rope_flags(config)
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["use_rope"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    struct["layers"]["use_rope"] = jax.ShapeDtypeStruct(
+        (config.num_hidden_layers,), jnp.bool_
+    )
+    return struct

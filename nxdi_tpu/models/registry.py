@@ -24,6 +24,8 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "gpt_oss": ("nxdi_tpu.models.gpt_oss.modeling_gpt_oss", "GptOssInferenceConfig"),
     "deepseek_v3": ("nxdi_tpu.models.deepseek.modeling_deepseek", "DeepseekInferenceConfig"),
     "deepseek": ("nxdi_tpu.models.deepseek.modeling_deepseek", "DeepseekInferenceConfig"),
+    "llama4": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
+    "llama4_text": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
 }
 
 

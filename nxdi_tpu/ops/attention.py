@@ -93,6 +93,7 @@ def attention_with_positions(
     chunk_size: Optional[int] = None,
     sink=None,
     sliding_window_enabled=None,
+    chunk_enabled=None,
 ):
     """Attention with the mask derived from positions (prefill and decode both).
 
@@ -110,6 +111,11 @@ def attention_with_positions(
             )
     elif chunk_size is not None:
         mask = chunked_attention_mask_from_positions(q_pos, kv_pos, chunk_size)
+        if chunk_enabled is not None:
+            # llama4: chunked attention on rope layers only (per-layer flag)
+            mask = jnp.where(
+                chunk_enabled, mask, causal_mask_from_positions(q_pos, kv_pos)
+            )
     else:
         mask = causal_mask_from_positions(q_pos, kv_pos)
     return grouped_attention(q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink)

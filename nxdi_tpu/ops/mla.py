@@ -68,6 +68,7 @@ def mla_attention_block(
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
     window_enabled=None,
+    use_rope=None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     from nxdi_tpu.models.base import _linear
 
@@ -75,19 +76,20 @@ def mla_attention_block(
     B, S, _ = hidden.shape
     H = mla.num_heads
     nope, rope_d, r = mla.qk_nope_head_dim, mla.qk_rope_head_dim, mla.kv_lora_rank
+    aq, ac = arch.act_quant, arch.act_clamp
 
     # -- queries
     if mla.q_lora_rank is None:
-        q = _linear(hidden, p_attn["q_proj"])
+        q = _linear(hidden, p_attn["q_proj"], aq, ac)
     else:
-        qa = _linear(hidden, p_attn["q_a"])
+        qa = _linear(hidden, p_attn["q_a"], aq, ac)
         qa = rms_norm(qa, p_attn["q_a_norm"], arch.rms_norm_eps)
-        q = _linear(qa, p_attn["q_b"])
+        q = _linear(qa, p_attn["q_b"], aq, ac)
     q = q.reshape(B, S, H, mla.qk_head_dim)
     q_nope, q_rot = q[..., :nope], q[..., nope:]
 
     # -- compressed kv + shared rope key
-    ckv = _linear(hidden, p_attn["kv_a"])  # (B, S, r + rope_d)
+    ckv = _linear(hidden, p_attn["kv_a"], aq, ac)  # (B, S, r + rope_d)
     c, k_rot = ckv[..., :r], ckv[..., r:]
     c = rms_norm(c, p_attn["kv_a_norm"], arch.rms_norm_eps)  # normed BEFORE caching
 
@@ -109,7 +111,7 @@ def mla_attention_block(
 
     # -- expand latent to per-head k_nope / value through kv_b
     W = c_all.shape[2]
-    kb = _linear(c_all[:, 0], p_attn["kv_b"])  # (B, W, H*(nope+v))
+    kb = _linear(c_all[:, 0], p_attn["kv_b"], aq, ac)  # (B, W, H*(nope+v))
     kb = kb.reshape(B, W, H, nope + mla.v_head_dim)
     k_nope = jnp.swapaxes(kb[..., :nope], 1, 2)  # (B, H, W, nope)
     v = jnp.swapaxes(kb[..., nope:], 1, 2)  # (B, H, W, v_dim)
@@ -125,7 +127,7 @@ def mla_attention_block(
     )  # (B, H, S, v_dim)
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * mla.v_head_dim)
-    out = _linear(ctx, p_attn["o_proj"])
+    out = _linear(ctx, p_attn["o_proj"], aq, ac)
     return out, (new_k, new_v)
 
 

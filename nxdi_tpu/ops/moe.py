@@ -54,6 +54,9 @@ class MoEArch:
     # takes top-k of LOGITS then softmaxes the selected values; experts carry
     # biases and use the clamped glu  (up+1) * gate*sigmoid(alpha*gate)
     topk_softmax: bool = False
+    # llama4 (reference: models/llama4/): top-k logits -> sigmoid scores that
+    # scale the expert INPUT (not output); shared expert always added
+    llama4_router: bool = False
     router_bias: bool = False
     expert_bias: bool = False
     gptoss_glu: bool = False
@@ -133,6 +136,15 @@ def route(router_logits: jax.Array, moe: MoEArch) -> jax.Array:
     """Router logits (T, E) -> dense combine weights (T, E), zero for
     unselected experts (HF Mixtral/Qwen3Moe semantics: full softmax -> top-k ->
     optional renormalize; reference: RouterTopK in moe_v2.py:23)."""
+    if moe.llama4_router:
+        top_vals, top_idx = jax.lax.top_k(router_logits.astype(jnp.float32), moe.top_k)
+        scores = jax.nn.sigmoid(top_vals)
+        dense = jnp.sum(
+            jax.nn.one_hot(top_idx, moe.num_experts, dtype=scores.dtype)
+            * scores[..., None],
+            axis=-2,
+        )
+        return dense
     if moe.topk_softmax:
         # gpt-oss: top-k on raw logits, softmax over the k selected values
         top_vals, top_idx = jax.lax.top_k(router_logits.astype(jnp.float32), moe.top_k)
@@ -173,6 +185,14 @@ def moe_block(arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array) -> jax.Array:
     # mat_w dequantizes low-bit expert weights in the einsum's operand read.
     gate = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["gate_proj"], x.dtype))
     up = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["up_proj"], x.dtype))
+    if moe.llama4_router:
+        # llama4 scales the expert INPUT by the sigmoid score. gate/up are
+        # linear and bias-free on this path, so scaling their OUTPUTS before
+        # the activation is identical (act(s*g(x)) where s*g(x) = g(s*x)) —
+        # avoids materializing an (E, T, H) scaled-input tensor
+        se = jnp.swapaxes(weights, 0, 1)[:, :, None].astype(gate.dtype)  # (E, T, 1)
+        gate = gate * se
+        up = up * se
     if moe.expert_bias:
         gate = gate + p["experts"]["gate_proj"]["b"][:, None, :]
         up = up + p["experts"]["up_proj"]["b"][:, None, :]
@@ -186,7 +206,10 @@ def moe_block(arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array) -> jax.Array:
     expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p["experts"]["down_proj"], x.dtype))
     if moe.expert_bias:
         expert_out = expert_out + p["experts"]["down_proj"]["b"][:, None, :]
-    out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
+    if moe.llama4_router:
+        out = jnp.sum(expert_out, axis=0)  # input already carries the score
+    else:
+        out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
 
     if moe.shared_expert_intermediate_size:
         sp = p["shared_expert"]

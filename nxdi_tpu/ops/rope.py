@@ -155,3 +155,31 @@ def apply_rotary_pos_emb(q, k, cos, sin):
     q_out = qf * cos + rotate_half(qf) * sin
     k_out = kf * cos + rotate_half(kf) * sin
     return q_out.astype(q.dtype), k_out.astype(k.dtype)
+
+
+def apply_rotary_pos_emb_interleaved(q, k, cos, sin):
+    """GPT-J/llama4-style rope: channels form ADJACENT (real, imag) pairs
+    (HF llama4 apply_rotary_emb via complex view) instead of rotate-half.
+    q/k: (B, heads, S, head_dim); cos/sin: (B, S, head_dim) — only the first
+    head_dim/2 entries (one per pair) are read."""
+    D = q.shape[-1]
+    cos = cos[:, None, :, : D // 2].astype(jnp.float32)
+    sin = sin[:, None, :, : D // 2].astype(jnp.float32)
+
+    def rot(x):
+        xf = x.astype(jnp.float32)
+        x1, x2 = xf[..., ::2], xf[..., 1::2]
+        o1 = x1 * cos - x2 * sin
+        o2 = x2 * cos + x1 * sin
+        return jnp.stack([o1, o2], axis=-1).reshape(x.shape).astype(x.dtype)
+
+    return rot(q), rot(k)
+
+
+def l2_norm(x, eps: float = 1e-6):
+    """Unweighted RMS/L2 normalization (llama4 qk norm, Llama4TextL2Norm)."""
+    import jax
+
+    xf = x.astype(jnp.float32)
+    y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return y.astype(x.dtype)

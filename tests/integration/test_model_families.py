@@ -133,6 +133,35 @@ def _tiny_hf(model_type):
             eos_token_id=None,
         )
         model = DeepseekV3ForCausalLM(cfg)
+    elif model_type == "llama4_text":
+        from transformers.models.llama4.modeling_llama4 import Llama4ForCausalLM
+        from transformers import Llama4TextConfig
+
+        # GPT-J rope with no-rope layers, L2 qk norm, temperature tuning,
+        # chunked attention on rope layers, sigmoid input-scaled MoE + shared
+        cfg = Llama4TextConfig(
+            hidden_size=64,
+            intermediate_size=128,
+            intermediate_size_mlp=128,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            head_dim=16,
+            num_local_experts=4,
+            num_experts_per_tok=2,
+            max_position_embeddings=256,
+            rope_theta=10000.0,
+            rope_scaling=None,
+            no_rope_layers=[1, 1, 1, 0],
+            attention_chunk_size=8,
+            interleave_moe_layer_step=1,
+            use_qk_norm=True,
+            attn_temperature_tuning=True,
+            tie_word_embeddings=False,
+            eos_token_id=None,
+        )
+        model = Llama4ForCausalLM(cfg)
     elif model_type == "dbrx":
         from transformers import DbrxConfig, DbrxForCausalLM
 
@@ -177,7 +206,8 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
 
 @pytest.mark.parametrize(
     "model_type",
-    ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "dbrx", "gpt_oss", "deepseek_v3"]
+    ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "dbrx",
+     "gpt_oss", "deepseek_v3", "llama4_text"]
 )
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):
