@@ -73,6 +73,7 @@ class HuggingFaceGenerationAdapter:
         pad_token_id: int = 0,
         seed: int = 0,
         adapter_ids: Optional[np.ndarray] = None,
+        pixel_values: Optional[np.ndarray] = None,
         **unused,
     ) -> np.ndarray:
         """Greedy/sampling generation. Returns (B, S + new_tokens) ids, with each
@@ -130,7 +131,10 @@ class HuggingFaceGenerationAdapter:
         if adapter_ids is not None:
             lora_kwargs["adapter_ids"] = np.asarray(adapter_ids, dtype=np.int32)
 
-        # ---- context encoding ----
+        # ---- context encoding (multimodal prefill carries pixel_values) ----
+        cte_kwargs = dict(lora_kwargs)
+        if pixel_values is not None:
+            cte_kwargs["pixel_values"] = pixel_values
         position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
         outputs = self.app.forward(
             input_ids.astype(np.int32),
@@ -138,7 +142,7 @@ class HuggingFaceGenerationAdapter:
             last_token_index=lengths - 1,
             sampling_params=sampling_params,
             rng=self._next_rng(),
-            **lora_kwargs,
+            **cte_kwargs,
         )
         next_tokens = self._next_tokens(outputs)
 

@@ -556,6 +556,7 @@ def causal_lm_forward(
     return_next_inputs: bool = False,
     output_hidden: bool = False,
     aux_hidden_indices: Optional[Tuple[int, ...]] = None,
+    image_token_id: Optional[int] = None,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
 
@@ -574,6 +575,17 @@ def causal_lm_forward(
         # gemma scales embeddings by sqrt(hidden) AFTER the dtype downcast
         # (reference: modeling_gemma3.py:238-241)
         hidden = hidden * jnp.asarray(arch.embed_scale, compute_dtype)
+    if image_token_id is not None and "image_embeds" in batch:
+        # multimodal prefill: replace image-placeholder token embeddings with
+        # the projected vision features, row-local order (reference: the
+        # image-to-text CTE merging vision embeds, image_to_text_model_base.py)
+        img = batch["image_embeds"].astype(compute_dtype)  # (B, N, hidden)
+        is_img = input_ids == image_token_id  # (B, S)
+        idx = jnp.clip(jnp.cumsum(is_img, axis=1) - 1, 0, img.shape[1] - 1)
+        gathered = jnp.take_along_axis(
+            img, idx[:, :, None].astype(jnp.int32), axis=1
+        )
+        hidden = jnp.where(is_img[:, :, None], gathered, hidden)
     if "fc" in params:
         # EAGLE draft input: concat(token embedding, previous-position feature)
         # projected back to the hidden size (reference: the EAGLE draft fc,

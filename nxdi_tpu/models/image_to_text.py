@@ -1,0 +1,126 @@
+"""Image-to-text application: vision encoder + CTE + TKG orchestration.
+
+The analog of the reference's image-to-text base (models/
+image_to_text_model_base.py:34,118 and image_to_text_model_wrapper.py:19):
+a vision-encoder submodel produces projected image features; the
+context-encoding graph merges them into the token-embedding stream at the
+image-placeholder positions (models/base.py image_token_id merge); token
+generation runs unchanged.
+
+The vision encoder compiles as its own jitted program over the ``vision`` /
+``projector`` sub-pytrees (reference: EncoderModelInstance,
+model_wrapper.py:1616). Vision params are replicated — towers are small
+relative to the LM; TP sharding of the tower is a later optimization.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from nxdi_tpu.runtime.application import TAG_PREFIX_PREFILL, TpuModelForCausalLM
+from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
+
+TAG_VISION_ENCODER = "vision_encoder_model"
+
+
+class ImageToTextForCausalLM(TpuModelForCausalLM):
+    """CausalLM whose prefill consumes image features (reference:
+    NeuronBaseForImageToText three-submodel flow).
+
+    The model family module must additionally expose:
+      - ``build_vision_arch(config)`` -> static vision arch,
+      - ``convert_vision_params(state_dict, config)`` -> {"vision", "projector"},
+      - ``vision_shape_struct(config)`` -> matching ShapeDtypeStruct pytree,
+      - ``encode_images(vision_arch, params, pixel_values)`` -> (B, N, hidden),
+      - ``num_image_tokens(config)`` and ``config.image_token_index``.
+    """
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        for attr in ("build_vision_arch", "convert_vision_params", "encode_images"):
+            if not hasattr(self.family, attr):
+                raise ValueError(
+                    f"model family {self.family.__name__} does not expose {attr}; "
+                    "not an image-to-text family"
+                )
+        self._encode_jit = None
+
+    # -- params: text + vision/projector sub-pytrees --
+    def build_params(self):
+        # memoize the checkpoint read: the text conversion (super) and the
+        # vision conversion below must share ONE multi-GB safetensors load
+        real_get = self.get_state_dict
+        cache = {}
+
+        def cached():
+            if "sd" not in cache:
+                cache["sd"] = real_get()
+            return cache["sd"]
+
+        self.get_state_dict = cached
+        try:
+            params = super().build_params()
+            params.update(self.family.convert_vision_params(cached(), self.config))
+        finally:
+            self.get_state_dict = real_get
+        return params
+
+    def build_params_struct(self):
+        struct = super().build_params_struct()
+        struct.update(self.family.vision_shape_struct(self.config))
+        return struct
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().param_specs()
+        struct = self.family.vision_shape_struct(self.config)
+        specs.update(jax.tree_util.tree_map(lambda _: P(), struct))
+        return specs
+
+    # -- submodels: CTE takes image_embeds; vision encoder is its own program --
+    def enable_models(self) -> None:
+        super().enable_models()
+        import jax.numpy as jnp
+
+        N = self.family.num_image_tokens(self.config)
+        # every prefill-shaped submodel must carry the image inputs — a
+        # prefix/chunked continuation prefill can also contain placeholders
+        for tag in (TAG_CONTEXT_ENCODING, TAG_PREFIX_PREFILL):
+            w = self.models.get(tag)
+            if w is None:
+                continue
+            w.extra_inputs["image_embeds"] = ((N, self.config.hidden_size), jnp.float32)
+            w.forward_kwargs["image_token_id"] = int(self.config.image_token_index)
+
+    def encode_images(self, pixel_values: np.ndarray):
+        """Run the vision tower + projector (compiled on first use per shape;
+        reference: the vision encoder submodel invoked before CTE)."""
+        if self._encode_jit is None:
+            varch = self.family.build_vision_arch(self.config)
+            self._encode_jit = jax.jit(partial(self.family.encode_images, varch))
+        with jax.set_mesh(self.mesh):
+            return self._encode_jit(
+                {"vision": self.params["vision"], "projector": self.params["projector"]},
+                np.asarray(pixel_values, dtype=np.float32),
+            )
+
+    def forward(self, input_ids, position_ids, pixel_values=None, **kwargs):
+        if pixel_values is not None:
+            kwargs["image_embeds"] = self.encode_images(pixel_values)
+        if "image_embeds" in kwargs:
+            n_placeholders = int(
+                (np.asarray(input_ids) == int(self.config.image_token_index)).sum(axis=1).max()
+            )
+            n_feats = kwargs["image_embeds"].shape[1]
+            if n_placeholders > n_feats:
+                raise ValueError(
+                    f"prompt contains {n_placeholders} image-placeholder tokens "
+                    f"but the vision encoder produced only {n_feats} features "
+                    "(image features and image tokens do not match)"
+                )
+        return super().forward(input_ids, position_ids, **kwargs)

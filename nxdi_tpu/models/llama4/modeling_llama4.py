@@ -41,6 +41,14 @@ class Llama4InferenceConfig(dense.DenseInferenceConfig):
     ]
 
     def add_derived_config(self):
+        # composite Llama-4 checkpoints nest the LM hyperparams under
+        # text_config (model_type 'llama4'); promote them as source of truth
+        tc = getattr(self, "text_config", None)
+        if tc is not None:
+            if not isinstance(tc, dict):
+                tc = tc.to_dict()
+            for k, v in tc.items():
+                setattr(self, k, v)
         super().add_derived_config()
         defaults = {
             "no_rope_layers": None,

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.llava import modeling_llava

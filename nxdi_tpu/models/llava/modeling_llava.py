@@ -1,0 +1,150 @@
+"""Llava family — CLIP vision tower + llama language model.
+
+Reference: the image-to-text stack (models/image_to_text_model_base.py,
+contrib llava model). The language model is the shared dense decoder; the
+vision tower + 2-layer projector live in ops/vision.py. Checkpoints use the
+HF llava layout (model.vision_tower.*, model.multi_modal_projector.*,
+model.language_model.*, top-level lm_head).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops import vision as vision_ops
+
+
+class LlavaInferenceConfig(dense.DenseInferenceConfig):
+    """HF llava configs nest text/vision configs; promote the text fields to
+    the top level (the decoder pipeline reads them there) and keep the vision
+    dict for the tower arch."""
+
+    REQUIRED = ["text_config", "vision_config", "image_token_index"]
+
+    def add_derived_config(self):
+        tc = self.text_config
+        if not isinstance(tc, dict):
+            tc = tc.to_dict()
+        # the text config is the source of truth for LM hyperparams: the
+        # composite wrapper carries PretrainedConfig defaults (e.g.
+        # tie_word_embeddings=True) that must NOT shadow it
+        for k, v in tc.items():
+            setattr(self, k, v)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        super().add_derived_config()
+
+
+def _strip_text_prefix(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+            if k.startswith(prefix):
+                out[k[len(prefix):]] = v
+                break
+        else:
+            if k == "lm_head.weight" or k == "language_model.lm_head.weight":
+                out["lm_head.weight"] = v
+    return out
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    return dense.build_arch(config, **overrides)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return dense.build_inv_freq(config)
+
+
+def build_vision_arch(config: InferenceConfig) -> vision_ops.ClipVisionArch:
+    vc = config.vision_config
+    return vision_ops.ClipVisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        hidden_act=vc.get("hidden_act", "quick_gelu"),
+        layer_norm_eps=vc.get("layer_norm_eps", 1e-5),
+        feature_layer=getattr(config, "vision_feature_layer", -2),
+        drop_cls=getattr(config, "vision_feature_select_strategy", "default") == "default",
+        projector_act=getattr(config, "projector_hidden_act", "gelu"),
+    )
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    return build_vision_arch(config).num_patches
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    return dense.convert_hf_state_dict(
+        _strip_text_prefix(state_dict), config, build_arch(config)
+    )
+
+
+def convert_vision_params(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    return {
+        "vision": vision_ops.convert_clip_vision(state_dict, varch),
+        "projector": vision_ops.convert_llava_projector(state_dict),
+    }
+
+
+def encode_images(varch, params: Dict[str, Any], pixel_values):
+    feat = vision_ops.clip_vision_forward(varch, params["vision"], pixel_values)
+    return vision_ops.project_image_features(varch, params["projector"], feat)
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    """ShapeDtypeStructs matching convert_vision_params (for AOT compile)."""
+    varch = build_vision_arch(config)
+    Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    Ht = config.hidden_size
+    P2 = varch.num_channels * varch.patch_size ** 2
+    f32 = np.float32
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, f32)
+
+    lin = lambda i, o: {"w": s(L, i, o), "b": s(L, o)}  # noqa: E731
+    return {
+        "vision": {
+            "patch_embedding": s(P2, Hv),
+            "class_embedding": s(Hv),
+            "position_embedding": s(varch.num_patches + 1, Hv),
+            "pre_layernorm": {"w": s(Hv), "b": s(Hv)},
+            "layers": {
+                "attn": {
+                    n: lin(Hv, Hv) for n in ("q_proj", "k_proj", "v_proj", "out_proj")
+                },
+                "ln1": {"w": s(L, Hv), "b": s(L, Hv)},
+                "ln2": {"w": s(L, Hv), "b": s(L, Hv)},
+                "fc1": lin(Hv, Iv),
+                "fc2": lin(Iv, Hv),
+            },
+        },
+        "projector": {
+            "linear_1": {"w": s(Hv, Ht), "b": s(Ht)},
+            "linear_2": {"w": s(Ht, Ht), "b": s(Ht)},
+        },
+    }
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

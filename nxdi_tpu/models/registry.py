@@ -26,6 +26,7 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "deepseek": ("nxdi_tpu.models.deepseek.modeling_deepseek", "DeepseekInferenceConfig"),
     "llama4": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
     "llama4_text": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
+    "llava": ("nxdi_tpu.models.llava.modeling_llava", "LlavaInferenceConfig"),
 }
 
 

@@ -1,0 +1,188 @@
+"""ViT-family vision encoders (CLIP-style) — the compute for image-to-text
+models' vision towers.
+
+Reference: the vision encoders under models/{mllama,llama4,pixtral,qwen2_vl}
+and the image-encoding applications (models/encoder_base.py:16,
+image_to_text_model_base.py:34). The first tower implemented is the CLIP
+layout (llava lineage; contrib llava): conv patch embedding, CLS token,
+learned position embeddings, pre-LN transformer with biased qkv/out and
+quick-gelu MLP, feature tap at an intermediate layer, optional CLS drop, and
+a 2-layer gelu projector into the language model's hidden space.
+
+Everything static lives in :class:`ClipVisionArch` so the encoder jits into a
+single fixed-shape program per batch size (the reference compiles the vision
+encoder as its own submodel, model_wrapper.py:1616 EncoderModelInstance).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.ops.norms import layer_norm
+
+ACTS = {
+    "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x),
+    "gelu": jax.nn.gelu,
+    "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+}
+
+
+@dataclass(frozen=True)
+class ClipVisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int = 3
+    hidden_act: str = "quick_gelu"
+    layer_norm_eps: float = 1e-5
+    # llava: vision_feature_layer=-2 -> hidden state AFTER layer L-2's block
+    # (HF indexes the [embeddings, layer0_out, ...] list)
+    feature_layer: int = -2
+    drop_cls: bool = True  # vision_feature_select_strategy == "default"
+    projector_act: str = "gelu"
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def _vit_attention(p, x, num_heads: int):
+    B, S, H = x.shape
+    D = H // num_heads
+
+    def proj(name):
+        return (x @ p[name]["w"] + p[name]["b"]).reshape(B, S, num_heads, D)
+
+    q = jnp.swapaxes(proj("q_proj"), 1, 2)
+    k = jnp.swapaxes(proj("k_proj"), 1, 2)
+    v = jnp.swapaxes(proj("v_proj"), 1, 2)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
+    weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H)
+    return ctx @ p["out_proj"]["w"] + p["out_proj"]["b"]
+
+
+def clip_vision_forward(
+    arch: ClipVisionArch, params: Dict[str, Any], pixel_values: jax.Array
+) -> jax.Array:
+    """pixel_values (B, C, H, W) -> patch features (B, N[, +CLS], hidden).
+
+    The feature tap mirrors HF CLIPVisionModel(output_hidden_states=True)
+    indexed at ``feature_layer`` so llava goldens match exactly.
+    """
+    B = pixel_values.shape[0]
+    P, C, H = arch.patch_size, arch.num_channels, arch.hidden_size
+    g = arch.image_size // P
+
+    # conv with stride=patch == unfold into patches + one matmul (MXU-friendly)
+    x = pixel_values.reshape(B, C, g, P, g, P)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(B, g * g, C * P * P)
+    patches = x @ params["patch_embedding"]  # (B, N, H)
+
+    cls = jnp.broadcast_to(params["class_embedding"], (B, 1, H))
+    h = jnp.concatenate([cls, patches], axis=1)
+    h = h + params["position_embedding"][None]
+    h = layer_norm(h, params["pre_layernorm"]["w"], params["pre_layernorm"]["b"],
+                   eps=arch.layer_norm_eps)
+
+    # the feature tap index is static (HF hidden-states list semantics:
+    # index 0 = embeddings, i+1 = after layer i), so run ONLY the layers the
+    # tap needs — no wasted trailing layers, no stacked per-layer states
+    def body(carry, lp):
+        res = carry
+        y = layer_norm(res, lp["ln1"]["w"], lp["ln1"]["b"], eps=arch.layer_norm_eps)
+        res = res + _vit_attention(lp["attn"], y, arch.num_heads)
+        y = layer_norm(res, lp["ln2"]["w"], lp["ln2"]["b"], eps=arch.layer_norm_eps)
+        y = ACTS[arch.hidden_act](y @ lp["fc1"]["w"] + lp["fc1"]["b"])
+        res = res + (y @ lp["fc2"]["w"] + lp["fc2"]["b"])
+        return res, None
+
+    idx = arch.feature_layer % (arch.num_layers + 1)
+    if idx == 0:
+        feat = h
+    else:
+        used = jax.tree_util.tree_map(lambda a: a[:idx], params["layers"])
+        feat, _ = jax.lax.scan(body, h, used)
+    if arch.drop_cls:
+        feat = feat[:, 1:]
+    return feat
+
+
+def project_image_features(arch: ClipVisionArch, params: Dict[str, Any], feat):
+    """2-layer gelu projector into the LM hidden space (llava
+    multi_modal_projector)."""
+    p = params
+    h = feat @ p["linear_1"]["w"] + p["linear_1"]["b"]
+    h = ACTS[arch.projector_act](h)
+    return h @ p["linear_2"]["w"] + p["linear_2"]["b"]
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint conversion (HF CLIPVisionModel layout)
+# ---------------------------------------------------------------------------
+
+def convert_clip_vision(
+    state_dict: Dict[str, np.ndarray],
+    arch: ClipVisionArch,
+    prefix: str = "vision_tower.vision_model.",
+    dtype=np.float32,
+) -> Dict[str, Any]:
+    def get(name):
+        for k in (prefix + name, "model." + prefix + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=dtype)
+        raise KeyError(prefix + name)
+
+    conv = get("embeddings.patch_embedding.weight")  # (H, C, P, P)
+    params: Dict[str, Any] = {
+        # match the unfold layout: (C, P, P) flattened -> H
+        "patch_embedding": conv.reshape(conv.shape[0], -1).T,
+        "class_embedding": get("embeddings.class_embedding"),
+        "position_embedding": get("embeddings.position_embedding.weight"),
+        "pre_layernorm": {"w": get("pre_layrnorm.weight"), "b": get("pre_layrnorm.bias")},
+    }
+    layers = []
+    for i in range(arch.num_layers):
+        pre = f"encoder.layers.{i}."
+        lp = {
+            "attn": {
+                name: {
+                    "w": get(pre + f"self_attn.{name}.weight").T,
+                    "b": get(pre + f"self_attn.{name}.bias"),
+                }
+                for name in ("q_proj", "k_proj", "v_proj", "out_proj")
+            },
+            "ln1": {"w": get(pre + "layer_norm1.weight"), "b": get(pre + "layer_norm1.bias")},
+            "ln2": {"w": get(pre + "layer_norm2.weight"), "b": get(pre + "layer_norm2.bias")},
+            "fc1": {"w": get(pre + "mlp.fc1.weight").T, "b": get(pre + "mlp.fc1.bias")},
+            "fc2": {"w": get(pre + "mlp.fc2.weight").T, "b": get(pre + "mlp.fc2.bias")},
+        }
+        layers.append(lp)
+    import jax.tree_util as jtu
+
+    params["layers"] = jtu.tree_map(lambda *xs: np.stack(xs), *layers)
+    return params
+
+
+def convert_llava_projector(
+    state_dict: Dict[str, np.ndarray], dtype=np.float32
+) -> Dict[str, Any]:
+    def get(name):
+        for k in ("multi_modal_projector." + name, "model.multi_modal_projector." + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=dtype)
+        raise KeyError(name)
+
+    return {
+        "linear_1": {"w": get("linear_1.weight").T, "b": get("linear_1.bias")},
+        "linear_2": {"w": get("linear_2.weight").T, "b": get("linear_2.bias")},
+    }

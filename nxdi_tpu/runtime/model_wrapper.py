@@ -73,6 +73,7 @@ class ModelWrapper:
         bucket_strategy: str = "first_fit",
         forward_fn: Optional[Callable] = None,
         forward_kwargs: Optional[Dict[str, Any]] = None,
+        extra_inputs: Optional[Dict[str, Any]] = None,
     ) -> None:
         self.tag = tag
         self.config = config
@@ -94,6 +95,11 @@ class ModelWrapper:
         # extra KV positions a single dispatch may write past the current
         # length (speculation windows); widens bucket selection accordingly
         self.lookahead = 0
+        # extra fixed-shape batch inputs beyond the decoder contract, e.g.
+        # {"image_embeds": ((num_image_tokens, hidden), jnp.float32)} — shape
+        # is WITHOUT the batch dim (reference: multimodal model wrappers take
+        # vision inputs, image_to_text_model_wrapper.py:19)
+        self.extra_inputs = dict(extra_inputs or {})
         # stochastic sampling needs a per-step PRNG key threaded as an input
         self.needs_rng = bool(self.forward_kwargs.get("do_sample", False))
         self._programs: Dict[int, Callable] = {}
@@ -160,6 +166,8 @@ class ModelWrapper:
             batch_shardings[key] = replicated
         if self.lora_enabled:
             batch_shardings["adapter_ids"] = replicated
+        for key in self.extra_inputs:
+            batch_shardings[key] = replicated
         if self.needs_rng:
             batch_shardings["rng"] = replicated
         jitted = jax.jit(
@@ -208,6 +216,8 @@ class ModelWrapper:
                 batch[key] = jax.ShapeDtypeStruct((B, self._block_table_width()), jnp.int32)
         if self.lora_enabled:
             batch["adapter_ids"] = jax.ShapeDtypeStruct((B,), jnp.int32)
+        for key, (shape, dtype) in self.extra_inputs.items():
+            batch[key] = jax.ShapeDtypeStruct((B,) + tuple(shape), dtype)
         if self.needs_rng:
             batch["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
         return batch
@@ -277,6 +287,11 @@ class ModelWrapper:
             extra["adapter_ids"] = np.asarray(
                 batch_np.get("adapter_ids", np.zeros((b,))), dtype=np.int32
             )
+        for key, (shape, dtype) in self.extra_inputs.items():
+            val = batch_np.get(key)
+            if val is None:
+                val = np.zeros((b,) + tuple(shape), dtype=np.dtype(str(np.dtype(dtype))))
+            extra[key] = np.asarray(val, dtype=np.dtype(str(np.dtype(dtype))))
 
         # pad batch dim (reference: _forward_with_pad model_wrapper.py:569)
         orig_b = b

@@ -1,0 +1,123 @@
+"""Image-to-text (llava) pipeline: CLIP vision tower + projector + llama LM
+with in-graph image-embedding merge — exact token match vs HF CPU
+(reference analog: the image_to_text 3-submodel flow and contrib llava)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models import llava as llava_pkg
+from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+from nxdi_tpu.models.llava import modeling_llava
+
+IMAGE_TOKEN = 255
+N_IMG_TOKENS = 4  # (32/16)^2
+
+
+def _tiny_hf_llava(seed=0):
+    import torch
+    from transformers import (
+        CLIPVisionConfig,
+        LlamaConfig,
+        LlavaConfig,
+        LlavaForConditionalGeneration,
+    )
+
+    torch.manual_seed(seed)
+    vc = CLIPVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=2, image_size=32, patch_size=16, projection_dim=32,
+    )
+    tc = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    cfg = LlavaConfig(vision_config=vc, text_config=tc, image_token_index=IMAGE_TOKEN)
+    return LlavaForConditionalGeneration(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg, tp_degree=1):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=tp_degree,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = modeling_llava.LlavaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(ImageToTextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=modeling_llava)
+    app.load()
+    return app
+
+
+def _prompt_with_image():
+    # [text, <image> x4, text] — the merge scatters 4 projected patch embeds
+    pre = [5, 9]
+    post = [3, 17, 2, 8]
+    ids = pre + [IMAGE_TOKEN] * N_IMG_TOKENS + post
+    return np.array([ids], dtype=np.int64)
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_llava_matches_hf_greedy(tp_degree):
+    import torch
+
+    hf, hf_cfg = _tiny_hf_llava()
+    app = _build_app(hf, hf_cfg, tp_degree)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    ids = _prompt_with_image()
+
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.tensor(ids),
+            pixel_values=torch.tensor(pixels),
+            max_new_tokens=16,
+            do_sample=False,
+        ).numpy()
+    actual = adapter.generate(ids, pixel_values=pixels, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_llava_vision_features_match_hf():
+    """The tower+projector in isolation must match HF's projected features."""
+    import torch
+
+    hf, hf_cfg = _tiny_hf_llava()
+    app = _build_app(hf, hf_cfg)
+    rng = np.random.default_rng(1)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+
+    with torch.no_grad():
+        expected = hf.get_image_features(torch.tensor(pixels))
+        if isinstance(expected, (list, tuple)):
+            expected = expected[0]
+        expected = expected.numpy()
+    actual = np.asarray(app.encode_images(pixels))
+    np.testing.assert_allclose(actual.reshape(expected.shape), expected, atol=2e-5)
+
+
+def test_llava_text_only_prompt_still_works():
+    hf, hf_cfg = _tiny_hf_llava()
+    app = _build_app(hf, hf_cfg)
+    adapter = HuggingFaceGenerationAdapter(app)
+    ids = np.array([[5, 9, 3, 17, 2, 8]], dtype=np.int64)
+    import torch
+
+    with torch.no_grad():
+        expected = hf.generate(torch.tensor(ids), max_new_tokens=8, do_sample=False).numpy()
+    actual = adapter.generate(ids, max_new_tokens=8)
+    np.testing.assert_array_equal(actual, expected)
