@@ -103,6 +103,11 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     # accuracy / benchmark
     p.add_argument("--check-accuracy-mode", default="skip", choices=CHECK_ACCURACY_MODES)
     p.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    p.add_argument(
+        "--capture-output-dir", default=None,
+        help="on logit-matching failure, write a divergence repro bundle here "
+             "(reference: --capture-indices auto)",
+    )
     p.add_argument("--benchmark", action="store_true")
     p.add_argument("--num-runs", type=int, default=5)
 
@@ -326,6 +331,7 @@ def _run_accuracy(args, app, adapter, input_ids) -> int:
 
     logger.info("loading HF golden model on CPU for accuracy check")
     hf_model = AutoModelForCausalLM.from_pretrained(args.model_path).eval()
+    checked_ids = input_ids  # the sequence the failing check actually ran on
     try:
         if args.check_accuracy_mode == "token-matching":
             accuracy.check_accuracy(
@@ -338,6 +344,7 @@ def _run_accuracy(args, app, adapter, input_ids) -> int:
             print("Accuracy check (token-matching): PASS")
         else:
             golden = accuracy.hf_greedy_generate(hf_model, input_ids, args.max_new_tokens)
+            checked_ids = golden
             errors = accuracy.check_accuracy_logits(
                 app,
                 golden,
@@ -351,6 +358,14 @@ def _run_accuracy(args, app, adapter, input_ids) -> int:
         return 0
     except (AccuracyValidationError, LogitMatchingValidationError) as e:
         print(f"Accuracy check FAILED: {e}")
+        if args.capture_output_dir and isinstance(e, LogitMatchingValidationError):
+            from nxdi_tpu.utils.debug import capture_inputs_at_divergence
+
+            res = capture_inputs_at_divergence(
+                app, checked_ids, args.capture_output_dir, hf_model=hf_model,
+                divergence_difference_tol=args.divergence_difference_tol,
+            )
+            print(f"Divergence bundle written: {res['path']}")
         return 1
 
 

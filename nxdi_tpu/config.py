@@ -119,6 +119,45 @@ class SpeculationConfig:
         return d
 
 
+def promote_text_config(config) -> None:
+    """Composite HF configs (llava, llama4, ...) nest the LM hyperparams under
+    ``text_config``; promote them to the top level as the source of truth —
+    the wrapper level carries PretrainedConfig defaults (e.g.
+    tie_word_embeddings) that must NOT shadow the text values."""
+    tc = getattr(config, "text_config", None)
+    if tc is None:
+        return
+    if not isinstance(tc, dict):
+        tc = tc.to_dict()
+    for k, v in tc.items():
+        setattr(config, k, v)
+
+
+class TensorCaptureConfig:
+    """Named intermediate tensors compiled into extra model outputs
+    (reference: TensorCaptureConfig config.py:1085, model_base.py:1091-1198).
+
+    ``capture_points``: any of "embeds" (post-embedding stream),
+    "layer_hiddens" (every decoder layer's output, stacked (L, B, S, H)),
+    "hidden" (pre-final-norm stream), "logits" (full-vocab logits)."""
+
+    VALID = ("embeds", "layer_hiddens", "hidden", "logits")
+
+    def __init__(self, **kwargs):
+        pts = tuple(kwargs.pop("capture_points", ("hidden",)))
+        for p in pts:
+            if p not in self.VALID:
+                raise ValueError(
+                    f"unknown capture point {p!r}; valid: {self.VALID}"
+                )
+        self.capture_points = pts
+        if kwargs:
+            raise ValueError(f"Unknown TensorCaptureConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return {"capture_points": list(self.capture_points)}
+
+
 class LoraServingConfig:
     """Multi-adapter LoRA serving (reference: modules/lora_serving/config.py)."""
 
@@ -296,7 +335,10 @@ class TpuConfig:
         self.skip_warmup = kwargs.pop("skip_warmup", False)
         self.save_sharded_checkpoint = kwargs.pop("save_sharded_checkpoint", False)
         self.compilation_cache_dir = kwargs.pop("compilation_cache_dir", None)
-        self.tensor_capture_config = kwargs.pop("tensor_capture_config", None)
+        tcc = kwargs.pop("tensor_capture_config", None)
+        if isinstance(tcc, dict):
+            tcc = TensorCaptureConfig(**tcc)
+        self.tensor_capture_config = tcc
         self.allow_unknown = kwargs.pop("allow_unknown", False)
 
         self.is_prefill_stage = None  # set by enable_context_encoding/token_generation
@@ -370,6 +412,7 @@ class TpuConfig:
         "on_device_sampling_config": OnDeviceSamplingConfig,
         "kv_quant_config": KVQuantizationConfig,
         "chunked_prefill_config": ChunkedPrefillConfig,
+        "tensor_capture_config": TensorCaptureConfig,
         "speculation_config": SpeculationConfig,
         "lora_config": LoraServingConfig,
     }

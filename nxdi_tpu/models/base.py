@@ -557,6 +557,7 @@ def causal_lm_forward(
     output_hidden: bool = False,
     aux_hidden_indices: Optional[Tuple[int, ...]] = None,
     image_token_id: Optional[int] = None,
+    tensor_capture: Optional[Tuple[str, ...]] = None,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
 
@@ -623,14 +624,28 @@ def causal_lm_forward(
     cache_inputs = {
         k: batch[k] for k in ("seq_ids", "slot_mapping", "block_table") if k in batch
     }
+    captured: Dict[str, jax.Array] = {}
+    if tensor_capture and "embeds" in tensor_capture:
+        captured["embeds"] = hidden
     layer_hiddens = None
-    if aux_hidden_indices:
+    if tensor_capture and "layer_hiddens" in tensor_capture and not aux_hidden_indices:
+        aux_hidden_indices = ()  # falsy: don't emit aux_hidden output
         hidden, new_cache, layer_hiddens = run_decoder_layers(
             arch, params["layers"], hidden, cos, sin, cache,
             position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
         )
+        captured["layer_hiddens"] = layer_hiddens
+    elif aux_hidden_indices:
+        hidden, new_cache, layer_hiddens = run_decoder_layers(
+            arch, params["layers"], hidden, cos, sin, cache,
+            position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+            policy=policy, layout=layout, cache_inputs=cache_inputs,
+            collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
+        )
+        if tensor_capture and "layer_hiddens" in tensor_capture:
+            captured["layer_hiddens"] = layer_hiddens
     else:
         hidden, new_cache = run_decoder_layers(
             arch, params["layers"], hidden, cos, sin, cache,
@@ -657,6 +672,12 @@ def causal_lm_forward(
     logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
 
     outputs: Dict[str, jax.Array] = {}
+    if tensor_capture:
+        if "hidden" in tensor_capture:
+            captured["hidden"] = pre_norm_hidden
+        if "logits" in tensor_capture:
+            captured["logits"] = logits
+        outputs["captured"] = captured
     if output_hidden:
         # last-layer hidden BEFORE the final norm — the EAGLE feature stream
         outputs["hidden"] = pre_norm_hidden

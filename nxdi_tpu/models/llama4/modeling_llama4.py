@@ -41,14 +41,9 @@ class Llama4InferenceConfig(dense.DenseInferenceConfig):
     ]
 
     def add_derived_config(self):
-        # composite Llama-4 checkpoints nest the LM hyperparams under
-        # text_config (model_type 'llama4'); promote them as source of truth
-        tc = getattr(self, "text_config", None)
-        if tc is not None:
-            if not isinstance(tc, dict):
-                tc = tc.to_dict()
-            for k, v in tc.items():
-                setattr(self, k, v)
+        from nxdi_tpu.config import promote_text_config
+
+        promote_text_config(self)  # composite 'llama4' checkpoints
         super().add_derived_config()
         defaults = {
             "no_rope_layers": None,

@@ -27,14 +27,9 @@ class LlavaInferenceConfig(dense.DenseInferenceConfig):
     REQUIRED = ["text_config", "vision_config", "image_token_index"]
 
     def add_derived_config(self):
-        tc = self.text_config
-        if not isinstance(tc, dict):
-            tc = tc.to_dict()
-        # the text config is the source of truth for LM hyperparams: the
-        # composite wrapper carries PretrainedConfig defaults (e.g.
-        # tie_word_embeddings=True) that must NOT shadow it
-        for k, v in tc.items():
-            setattr(self, k, v)
+        from nxdi_tpu.config import promote_text_config
+
+        promote_text_config(self)
         vc = self.vision_config
         if not isinstance(vc, dict):
             self.vision_config = vc.to_dict()

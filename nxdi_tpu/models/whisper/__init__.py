@@ -1,0 +1,1 @@
+from nxdi_tpu.models.whisper import modeling_whisper

@@ -1,0 +1,410 @@
+"""Whisper — audio encoder-decoder (speech-to-text).
+
+Reference: models/whisper/ (951 LoC): ``NeuronAudioEncoder``
+(modeling_whisper.py:304), ``NeuronTextDecoder`` (:345) and the separate
+encoder/decoder applications (:571-677).
+
+TPU-native mapping:
+  - the audio encoder (two gelu convs + sinusoid positions + pre-LN
+    transformer) jits as one program; convs lower to XLA's conv which tiles
+    onto the MXU;
+  - cross-attention K/V are computed ONCE per utterance from the encoder
+    output and carried in the cache pytree alongside the self-attention KV
+    cache (the reference's encoder application hands its output to the
+    decoder application the same way);
+  - the decoder step is a fixed-shape jitted program with the self-KV cache
+    donated, greedy-sampled on device; one dispatch per token.
+
+Parameters are replicated — whisper tops out ~1.5B (large-v3), well within a
+single chip; TP sharding of the encoder/decoder is a later optimization.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.ops.norms import layer_norm
+
+
+@dataclass(frozen=True)
+class WhisperArch:
+    d_model: int
+    encoder_layers: int
+    decoder_layers: int
+    encoder_heads: int
+    decoder_heads: int
+    encoder_ffn: int
+    decoder_ffn: int
+    num_mel_bins: int
+    max_source_positions: int
+    max_target_positions: int
+    vocab_size: int
+    eps: float = 1e-5
+
+
+class WhisperInferenceConfig(InferenceConfig):
+    REQUIRED = [
+        "d_model",
+        "encoder_layers",
+        "decoder_layers",
+        "encoder_attention_heads",
+        "decoder_attention_heads",
+        "num_mel_bins",
+        "max_source_positions",
+        "max_target_positions",
+        "vocab_size",
+    ]
+
+    def add_derived_config(self):
+        if not hasattr(self, "encoder_ffn_dim"):
+            self.encoder_ffn_dim = 4 * self.d_model
+        if not hasattr(self, "decoder_ffn_dim"):
+            self.decoder_ffn_dim = 4 * self.d_model
+
+
+def build_arch(config: InferenceConfig) -> WhisperArch:
+    return WhisperArch(
+        d_model=config.d_model,
+        encoder_layers=config.encoder_layers,
+        decoder_layers=config.decoder_layers,
+        encoder_heads=config.encoder_attention_heads,
+        decoder_heads=config.decoder_attention_heads,
+        encoder_ffn=config.encoder_ffn_dim,
+        decoder_ffn=config.decoder_ffn_dim,
+        num_mel_bins=config.num_mel_bins,
+        max_source_positions=config.max_source_positions,
+        max_target_positions=config.max_target_positions,
+        vocab_size=config.vocab_size,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Blocks
+# ---------------------------------------------------------------------------
+
+def _attn(p, q_in, kv_in, num_heads: int, mask=None, kv_override=None):
+    """Whisper attention: q/v/out biased, k unbiased (HF layout). ``kv_override``
+    supplies precomputed (k, v) — the cached cross-attention path."""
+    B, Sq, Dm = q_in.shape
+    D = Dm // num_heads
+    q = (q_in @ p["q_proj"]["w"] + p["q_proj"]["b"]).reshape(B, Sq, num_heads, D)
+    q = jnp.swapaxes(q, 1, 2) * (D ** -0.5)
+    if kv_override is not None:
+        k, v = kv_override
+    else:
+        Skv = kv_in.shape[1]
+        k = jnp.swapaxes((kv_in @ p["k_proj"]["w"]).reshape(B, Skv, num_heads, D), 1, 2)
+        v = jnp.swapaxes(
+            (kv_in @ p["v_proj"]["w"] + p["v_proj"]["b"]).reshape(B, Skv, num_heads, D), 1, 2
+        )
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+    if mask is not None:
+        scores = jnp.where(mask, scores, -30000.0)
+    w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, Sq, Dm)
+    return ctx @ p["out_proj"]["w"] + p["out_proj"]["b"]
+
+
+def whisper_encode(arch: WhisperArch, params: Dict[str, Any], input_features):
+    """(B, mel, T) -> (B, T//2, d_model) (reference: NeuronAudioEncoder)."""
+    p = params["encoder"]
+    x = jnp.swapaxes(input_features, 1, 2)  # (B, T, mel)
+    # conv1: k=3 stride=1 pad=1; conv2: k=3 stride=2 pad=1 (gelu both)
+    x = jax.lax.conv_general_dilated(
+        x, p["conv1"]["w"], (1,), [(1, 1)], dimension_numbers=("NWC", "WIO", "NWC")
+    ) + p["conv1"]["b"]
+    x = jax.nn.gelu(x, approximate=False)
+    x = jax.lax.conv_general_dilated(
+        x, p["conv2"]["w"], (2,), [(1, 1)], dimension_numbers=("NWC", "WIO", "NWC")
+    ) + p["conv2"]["b"]
+    x = jax.nn.gelu(x, approximate=False)
+    x = x + p["embed_positions"][None, : x.shape[1]]
+
+    def body(h, lp):
+        y = layer_norm(h, lp["self_attn_layer_norm"]["w"], lp["self_attn_layer_norm"]["b"])
+        h = h + _attn(lp["self_attn"], y, y, arch.encoder_heads)
+        y = layer_norm(h, lp["final_layer_norm"]["w"], lp["final_layer_norm"]["b"])
+        y = jax.nn.gelu(y @ lp["fc1"]["w"] + lp["fc1"]["b"], approximate=False)
+        h = h + (y @ lp["fc2"]["w"] + lp["fc2"]["b"])
+        return h, None
+
+    x, _ = jax.lax.scan(body, x, p["layers"])
+    return layer_norm(x, p["layer_norm"]["w"], p["layer_norm"]["b"])
+
+
+def whisper_cross_kv(arch: WhisperArch, params: Dict[str, Any], enc_out):
+    """Per-decoder-layer cross K/V from the encoder output, computed once
+    (reference: the decoder consumes encoder states each step; caching the
+    projections trades a little HBM for per-token matmuls)."""
+    B, S, Dm = enc_out.shape
+    H = arch.decoder_heads
+    D = Dm // H
+
+    def per_layer(carry, lp):
+        a = lp["encoder_attn"]
+        k = jnp.swapaxes((enc_out @ a["k_proj"]["w"]).reshape(B, S, H, D), 1, 2)
+        v = jnp.swapaxes(
+            (enc_out @ a["v_proj"]["w"] + a["v_proj"]["b"]).reshape(B, S, H, D), 1, 2
+        )
+        return carry, (k, v)
+
+    _, (ks, vs) = jax.lax.scan(per_layer, None, params["decoder"]["layers"])
+    return {"cross_k": ks, "cross_v": vs}  # (L, B, H, S_enc, D)
+
+
+def whisper_decode_step(
+    arch: WhisperArch,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],  # {"k","v","cross_k","cross_v"}
+    batch: Dict[str, jax.Array],
+    *,
+    kv_window: int,
+) -> Any:
+    """One decoder dispatch over S_new tokens (prefill and single-token decode
+    are the same program shape-family; reference: NeuronTextDecoder :345)."""
+    p = params["decoder"]
+    ids = batch["input_ids"]
+    positions = batch["position_ids"]
+    B, S = ids.shape
+    H = arch.decoder_heads
+    Dm = arch.d_model
+    D = Dm // H
+
+    h = jnp.take(p["embed_tokens"], ids, axis=0)
+    h = h + jnp.take(p["embed_positions"], positions, axis=0)
+
+    def body(carry, xs):
+        h = carry
+        lp, k_l, v_l, ck, cv = xs
+        # self attention with exact-position KV writes (kvcache semantics)
+        y = layer_norm(h, lp["self_attn_layer_norm"]["w"], lp["self_attn_layer_norm"]["b"])
+        q = (y @ lp["self_attn"]["q_proj"]["w"] + lp["self_attn"]["q_proj"]["b"])
+        k_new = (y @ lp["self_attn"]["k_proj"]["w"]).reshape(B, S, H, D)
+        v_new = (y @ lp["self_attn"]["v_proj"]["w"] + lp["self_attn"]["v_proj"]["b"]).reshape(B, S, H, D)
+        # cache layout (B, H, W, D); scatter at [b, :, pos] takes (B, S, H, D)
+        b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        k_l = k_l.at[b_idx, :, positions].set(k_new, mode="drop")
+        v_l = v_l.at[b_idx, :, positions].set(v_new, mode="drop")
+        kk = k_l[:, :, :kv_window]
+        vv = v_l[:, :, :kv_window]
+        kv_pos = jnp.arange(kv_window, dtype=jnp.int32)[None, :]
+        mask = kv_pos[:, None, :] <= positions[:, :, None]  # (B, S, W)
+        q = jnp.swapaxes(q.reshape(B, S, H, D), 1, 2) * (D ** -0.5)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kk).astype(jnp.float32)
+        scores = jnp.where(mask[:, None], scores, -30000.0)
+        w = jax.nn.softmax(scores, axis=-1).astype(vv.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", w, vv)
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, Dm)
+        h = h + (ctx @ lp["self_attn"]["out_proj"]["w"] + lp["self_attn"]["out_proj"]["b"])
+
+        # cross attention over the precomputed encoder K/V (no mask)
+        y = layer_norm(h, lp["encoder_attn_layer_norm"]["w"], lp["encoder_attn_layer_norm"]["b"])
+        h = h + _attn(lp["encoder_attn"], y, None, H, kv_override=(ck, cv))
+
+        y = layer_norm(h, lp["final_layer_norm"]["w"], lp["final_layer_norm"]["b"])
+        y = jax.nn.gelu(y @ lp["fc1"]["w"] + lp["fc1"]["b"], approximate=False)
+        h = h + (y @ lp["fc2"]["w"] + lp["fc2"]["b"])
+        return h, (k_l, v_l)
+
+    h, (new_k, new_v) = jax.lax.scan(
+        body, h, (p["layers"], cache["k"], cache["v"], cache["cross_k"], cache["cross_v"])
+    )
+    h = layer_norm(h, p["layer_norm"]["w"], p["layer_norm"]["b"])
+    # proj_out shares the token embedding (HF whisper ties them)
+    logits = (h @ params["proj_out"]).astype(jnp.float32)
+    idx = batch["last_token_index"][:, None, None]
+    last = jnp.take_along_axis(
+        logits, jnp.broadcast_to(idx, (B, 1, logits.shape[-1])), axis=1
+    )
+    tokens = jnp.argmax(last[:, 0], axis=-1).astype(jnp.int32)
+    new_cache = dict(cache)
+    new_cache["k"] = new_k
+    new_cache["v"] = new_v
+    return {"tokens": tokens[:, None], "logits": logits}, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Conversion
+# ---------------------------------------------------------------------------
+
+def convert_hf_state_dict(sd: Dict[str, np.ndarray], config: InferenceConfig):
+    arch = build_arch(config)
+    f32 = np.float32
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in sd:
+                return np.asarray(sd[k], dtype=f32)
+        raise KeyError(name)
+
+    def lin(prefix, bias=True):
+        out = {"w": get(prefix + ".weight").T}
+        if bias:
+            out["b"] = get(prefix + ".bias")
+        return out
+
+    def ln(prefix):
+        return {"w": get(prefix + ".weight"), "b": get(prefix + ".bias")}
+
+    def attn(prefix):
+        return {
+            "q_proj": lin(prefix + ".q_proj"),
+            "k_proj": lin(prefix + ".k_proj", bias=False),
+            "v_proj": lin(prefix + ".v_proj"),
+            "out_proj": lin(prefix + ".out_proj"),
+        }
+
+    def enc_layer(i):
+        pre = f"encoder.layers.{i}"
+        return {
+            "self_attn": attn(pre + ".self_attn"),
+            "self_attn_layer_norm": ln(pre + ".self_attn_layer_norm"),
+            "fc1": lin(pre + ".fc1"),
+            "fc2": lin(pre + ".fc2"),
+            "final_layer_norm": ln(pre + ".final_layer_norm"),
+        }
+
+    def dec_layer(i):
+        pre = f"decoder.layers.{i}"
+        return {
+            "self_attn": attn(pre + ".self_attn"),
+            "self_attn_layer_norm": ln(pre + ".self_attn_layer_norm"),
+            "encoder_attn": attn(pre + ".encoder_attn"),
+            "encoder_attn_layer_norm": ln(pre + ".encoder_attn_layer_norm"),
+            "fc1": lin(pre + ".fc1"),
+            "fc2": lin(pre + ".fc2"),
+            "final_layer_norm": ln(pre + ".final_layer_norm"),
+        }
+
+    import jax.tree_util as jtu
+
+    stack = lambda ls: jtu.tree_map(lambda *xs: np.stack(xs), *ls)  # noqa: E731
+
+    embed = get("decoder.embed_tokens.weight")
+    proj_out = np.asarray(sd.get("proj_out.weight", embed), dtype=f32)
+    return {
+        "encoder": {
+            # HF conv weight (out, in, k) -> XLA WIO (k, in, out)
+            "conv1": {"w": get("encoder.conv1.weight").transpose(2, 1, 0),
+                      "b": get("encoder.conv1.bias")},
+            "conv2": {"w": get("encoder.conv2.weight").transpose(2, 1, 0),
+                      "b": get("encoder.conv2.bias")},
+            "embed_positions": get("encoder.embed_positions.weight"),
+            "layers": stack([enc_layer(i) for i in range(arch.encoder_layers)]),
+            "layer_norm": ln("encoder.layer_norm"),
+        },
+        "decoder": {
+            "embed_tokens": embed,
+            "embed_positions": get("decoder.embed_positions.weight"),
+            "layers": stack([dec_layer(i) for i in range(arch.decoder_layers)]),
+            "layer_norm": ln("decoder.layer_norm"),
+        },
+        "proj_out": proj_out.T,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Application (reference: separate encoder/decoder apps, modeling_whisper.py:571)
+# ---------------------------------------------------------------------------
+
+class WhisperForConditionalGeneration:
+    """Greedy speech-to-text: encode once, then one decoder dispatch per token."""
+
+    def __init__(self, model_path: str, config: InferenceConfig, model_family=None):
+        self.model_path = model_path
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.arch = build_arch(config)
+        self.params = None
+        self.is_loaded = False
+        self._programs: Dict[Any, Any] = {}
+
+    def get_state_dict(self):
+        from nxdi_tpu import checkpoint as ckpt
+
+        return ckpt.load_state_dict(self.model_path)
+
+    def load(self, compiled_model_path: Optional[str] = None) -> None:
+        params_host = convert_hf_state_dict(self.get_state_dict(), self.config)
+        self.params = jax.tree_util.tree_map(jnp.asarray, params_host)
+        self.is_loaded = True
+
+    def _program(self, key, fn):
+        if key not in self._programs:
+            self._programs[key] = jax.jit(fn)
+        return self._programs[key]
+
+    def encode(self, input_features: np.ndarray):
+        fn = self._program("encode", partial(whisper_encode, self.arch))
+        return fn(self.params, np.asarray(input_features, np.float32))
+
+    def generate(
+        self,
+        input_features: np.ndarray,
+        decoder_input_ids: np.ndarray,
+        max_new_tokens: int = 32,
+        eos_token_id: Optional[int] = None,
+    ) -> np.ndarray:
+        """Greedy transcription loop (reference: the decoder application's
+        generation loop)."""
+        if not self.is_loaded:
+            raise RuntimeError("call load() before generate()")
+        enc_out = self.encode(input_features)
+        cross = self._program("cross", partial(whisper_cross_kv, self.arch))(
+            self.params, enc_out
+        )
+
+        B, S0 = decoder_input_ids.shape
+        W = min(self.arch.max_target_positions, S0 + max_new_tokens)
+        H, D = self.arch.decoder_heads, self.arch.d_model // self.arch.decoder_heads
+        cache = {
+            "k": jnp.zeros((self.arch.decoder_layers, B, H, W, D), jnp.float32),
+            "v": jnp.zeros((self.arch.decoder_layers, B, H, W, D), jnp.float32),
+            "cross_k": cross["cross_k"],
+            "cross_v": cross["cross_v"],
+        }
+
+        step = self._program(
+            ("prefill", S0, W),
+            partial(whisper_decode_step, self.arch, kv_window=W),
+        )
+        batch = {
+            "input_ids": jnp.asarray(decoder_input_ids, jnp.int32),
+            "position_ids": jnp.tile(jnp.arange(S0, dtype=jnp.int32), (B, 1)),
+            "last_token_index": jnp.full((B,), S0 - 1, jnp.int32),
+        }
+        out, cache = step(self.params, cache, batch)
+        tokens = [np.asarray(out["tokens"])[:, 0]]
+
+        decode = self._program(
+            ("decode", W), partial(whisper_decode_step, self.arch, kv_window=W)
+        )
+        finished = np.zeros((B,), dtype=bool)
+        if eos_token_id is not None:
+            finished |= tokens[-1] == eos_token_id
+        pos = S0
+        while pos < W and len(tokens) < max_new_tokens and not finished.all():
+            batch = {
+                "input_ids": jnp.asarray(tokens[-1][:, None], jnp.int32),
+                "position_ids": jnp.full((B, 1), pos, jnp.int32),
+                "last_token_index": jnp.zeros((B,), jnp.int32),
+            }
+            out, cache = decode(self.params, cache, batch)
+            nxt = np.asarray(out["tokens"])[:, 0]
+            if eos_token_id is not None:
+                nxt = np.where(finished, eos_token_id, nxt)
+            tokens.append(nxt)
+            if eos_token_id is not None:
+                finished |= nxt == eos_token_id
+            pos += 1
+
+        gen = np.stack(tokens, axis=1)
+        return np.concatenate([decoder_input_ids, gen], axis=1)

@@ -299,6 +299,9 @@ class ApplicationBase:
 
         if not self.tpu_config.skip_warmup:
             self.warmup()
+        from nxdi_tpu.utils.snapshot import maybe_attach_from_env
+
+        maybe_attach_from_env(self)  # reference-style env-driven snapshotting
         self.is_loaded = True
 
     def _build_wrappers(self) -> None:
@@ -375,6 +378,12 @@ class TpuModelForCausalLM(ApplicationBase):
         # inputs on device; only meaningful with on-device sampling
         if tc.async_mode and on_device_sampling:
             sampling_kwargs["return_next_inputs"] = True
+        if tc.tensor_capture_config is not None:
+            # debug intermediates compiled into extra outputs (reference:
+            # TensorCaptureConfig, model_base.py:1091-1198)
+            sampling_kwargs["tensor_capture"] = tuple(
+                tc.tensor_capture_config.capture_points
+            )
 
         self.models[TAG_CONTEXT_ENCODING] = ModelWrapper(
             TAG_CONTEXT_ENCODING,

@@ -108,6 +108,9 @@ class ModelWrapper:
         # registers forward pre/post hooks)
         self.pre_hooks: List[Callable] = []
         self.post_hooks: List[Callable] = []
+        # input snapshotting (utils/snapshot.py; reference: snapshot hooks
+        # application_base.py:421) — called with (tag, numpy batch) per dispatch
+        self.snapshot_hook: Optional[Callable] = None
 
     # ------------------------------------------------------------------
     # build: one jitted program per bucket (reference: model_wrapper.py:1442
@@ -288,10 +291,11 @@ class ModelWrapper:
                 batch_np.get("adapter_ids", np.zeros((b,))), dtype=np.int32
             )
         for key, (shape, dtype) in self.extra_inputs.items():
+            nd = np.dtype(dtype)
             val = batch_np.get(key)
             if val is None:
-                val = np.zeros((b,) + tuple(shape), dtype=np.dtype(str(np.dtype(dtype))))
-            extra[key] = np.asarray(val, dtype=np.dtype(str(np.dtype(dtype))))
+                val = np.zeros((b,) + tuple(shape), dtype=nd)
+            extra[key] = np.asarray(val, dtype=nd)
 
         # pad batch dim (reference: _forward_with_pad model_wrapper.py:569)
         orig_b = b
@@ -318,6 +322,15 @@ class ModelWrapper:
             if rng is None:
                 rng = np.zeros((2,), dtype=np.uint32)
             device_batch["rng"] = jnp.asarray(rng, dtype=jnp.uint32)
+        if self.snapshot_hook is not None:
+            snap = {
+                "input_ids": input_ids,
+                "position_ids": position_ids,
+                "last_token_index": last_token_index,
+                "sampling_params": sampling_params,
+                **extra,
+            }
+            self.snapshot_hook(self.tag, snap)
         for hook in self.pre_hooks:
             hook(self.tag)
         # dispatch under this app's mesh: several apps with different meshes
@@ -330,7 +343,8 @@ class ModelWrapper:
             for hook in self.post_hooks:
                 hook(self.tag)
         outputs = {
-            k: (v if k == "next_inputs" else v[:orig_b]) for k, v in outputs.items()
+            k: (v if k in ("next_inputs", "captured") else v[:orig_b])
+            for k, v in outputs.items()
         }
         return outputs, new_cache
 

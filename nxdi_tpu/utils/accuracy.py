@@ -129,17 +129,24 @@ def _get_logit_probe(app):
         forward_kwargs=fkw,
     )
     if getattr(app, "is_fused_spec", False):
-        # the probe graph is target-only; give it a target-only cache
+        # the probe graph is target-only; give it target-only specs + cache
         from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+        from nxdi_tpu.runtime.application import maybe_quantize_specs
 
         cache_host = init_kv_cache(app._cache_spec())
         cache_specs = kv_cache_partition_spec(app.tpu_config)
+        param_specs = maybe_quantize_specs(
+            app.family.param_specs(app.config), app.tpu_config
+        )
     else:
         cache_host = app.init_cache_host()
         cache_specs = app.cache_partition_specs()
+        # the INSTANCE specs: apps may extend the params pytree (LoRA buffers,
+        # vision/projector sub-pytrees) beyond the family layout
+        param_specs = app.param_specs()
     probe.build(
         app.mesh,
-        sharding_tree(app.family.param_specs(app.config), app.mesh),
+        sharding_tree(param_specs, app.mesh),
         sharding_tree(cache_specs, app.mesh),
     )
     cache = shard_pytree(cache_host, cache_specs, app.mesh)
@@ -189,7 +196,10 @@ def check_accuracy_logits(
             np.arange(B, dtype=np.int32)[:, None] * width
             + np.arange(width, dtype=np.int32)[None, :]
         )
-    outputs, _ = probe.forward(params, cache, batch)
+    outputs, new_cache = probe.forward(params, cache, batch)
+    # the probe program DONATES its cache buffer: keep the returned one so a
+    # later probe run (e.g. capture-on-divergence re-runs) stays valid
+    app._logit_probe = (probe, new_cache)
     actual = np.asarray(jax.device_get(outputs["logits"]))[:, :S, :]
 
     errors_by_index: Dict[int, float] = {}

@@ -1,0 +1,73 @@
+"""Debug utilities — input capture at divergence indices.
+
+The analog of the reference's ``--capture-indices auto`` flow
+(inference_demo.py:349-356,637-651; utils/debug_utils.py:11): after a failed
+logit-matching run, persist the exact inputs + device/golden logits around
+the first divergent position so the numeric bisect can be replayed offline
+(optionally with tensor capture enabled to dump intermediates too).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+
+def capture_inputs_at_divergence(
+    app,
+    input_ids: np.ndarray,
+    output_dir: str,
+    hf_model=None,
+    golden_logits: Optional[np.ndarray] = None,
+    divergence_difference_tol: float = 0.001,
+) -> Dict[str, object]:
+    """Run teacher-forced logit matching; on any divergence, write a repro
+    bundle: the checked token sequence, the golden logits, the divergent
+    index, and per-index error magnitudes (replay: load the bundle and rerun
+    check_accuracy_logits with golden_logits from it).
+
+    Returns {"divergence_index": int | None, "path": str | None, "errors": {...}}.
+    """
+    from nxdi_tpu.utils import accuracy
+    from nxdi_tpu.utils.exceptions import LogitMatchingValidationError
+
+    input_ids = np.asarray(input_ids)
+    if golden_logits is None:
+        if hf_model is None:
+            raise ValueError("need hf_model or golden_logits")
+        golden_logits = accuracy.hf_forward_logits(hf_model, input_ids)
+
+    try:
+        errors = accuracy.check_accuracy_logits(
+            app,
+            input_ids,
+            golden_logits=golden_logits,
+            divergence_difference_tol=divergence_difference_tol,
+        )
+        return {"divergence_index": None, "path": None, "errors": errors}
+    except LogitMatchingValidationError as e:
+        div = e.divergence_index
+        errors = e.errors_by_index
+
+    os.makedirs(output_dir, exist_ok=True)
+    path = os.path.join(output_dir, f"divergence_idx{div}.npz")
+    np.savez(
+        path,
+        input_ids=input_ids,
+        golden_logits=golden_logits,
+        divergence_index=np.int64(-1 if div is None else div),
+    )
+    with open(os.path.join(output_dir, "divergence_report.json"), "w") as f:
+        json.dump(
+            {
+                "divergence_index": div,
+                "tolerance": divergence_difference_tol,
+                "errors_by_index": {str(k): float(v) for k, v in errors.items()},
+            },
+            f,
+            indent=2,
+        )
+    return {"divergence_index": div, "path": path, "errors": errors}

@@ -1,0 +1,108 @@
+"""Profiling — per-submodel latency stats and XLA/TPU trace capture.
+
+The analog of the reference's profiler wrapper (utils/profiling.py:33-63:
+wraps the neuron-profile binary, captures 2 executions and profiles the 2nd,
+emits a summary JSON). TPU-native: `jax.profiler` writes an xprof/perfetto
+trace viewable in TensorBoard or Perfetto; the per-submodel wall-clock
+summary comes from the same forward pre/post hooks the benchmark harness
+uses (runtime/model_wrapper.py hooks; reference: benchmark.py:468).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+
+@contextmanager
+def trace(output_dir: str):
+    """Capture an xprof trace of everything dispatched inside the block
+    (reference: profile one execution after a warmup run)."""
+    os.makedirs(output_dir, exist_ok=True)
+    jax.profiler.start_trace(output_dir)
+    try:
+        yield output_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+class SubmodelProfiler:
+    """Wall-clock per (submodel, dispatch): attach, run traffic, summarize.
+
+    Mirrors the reference's profile flow: warmup execution excluded, the
+    summary has per-tag latency stats (utils/profiling.py:87-121 summary
+    JSON)."""
+
+    def __init__(self, app):
+        self.app = app
+        self.records: Dict[str, list] = {}
+        self._t0: Dict[str, float] = {}
+        for wrapper in app.models.values():
+            wrapper.pre_hooks.append(self._pre)
+            wrapper.post_hooks.append(self._post)
+
+    def _pre(self, tag: str):
+        self._t0[tag] = time.perf_counter()
+
+    def _post(self, tag: str):
+        dt = (time.perf_counter() - self._t0[tag]) * 1000.0
+        self.records.setdefault(tag, []).append(dt)
+
+    def detach(self):
+        for wrapper in self.app.models.values():
+            if self._pre in wrapper.pre_hooks:
+                wrapper.pre_hooks.remove(self._pre)
+            if self._post in wrapper.post_hooks:
+                wrapper.post_hooks.remove(self._post)
+
+    def summary(self, skip_first: int = 1) -> Dict[str, Any]:
+        """Per-tag stats, excluding the first ``skip_first`` dispatches (the
+        reference captures 2 executions and profiles the 2nd)."""
+        out: Dict[str, Any] = {}
+        for tag, xs in self.records.items():
+            xs = xs[skip_first:] or xs
+            xs_sorted = sorted(xs)
+
+            def pct(p):
+                i = min(len(xs_sorted) - 1, int(round(p / 100 * (len(xs_sorted) - 1))))
+                return xs_sorted[i]
+
+            out[tag] = {
+                "count": len(xs),
+                "mean_ms": sum(xs) / len(xs),
+                "p50_ms": pct(50),
+                "p99_ms": pct(99),
+                "max_ms": xs_sorted[-1],
+            }
+        return out
+
+    def save_summary(self, path: str, skip_first: int = 1) -> Dict[str, Any]:
+        s = self.summary(skip_first)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(s, f, indent=2)
+        return s
+
+
+def profile_generation(
+    app,
+    run: Callable[[], Any],
+    output_dir: str,
+    warmup: Optional[Callable[[], Any]] = None,
+) -> Dict[str, Any]:
+    """Reference-shaped flow: warmup once (compile+cache), then trace one run
+    and emit {trace dir, per-submodel summary json}."""
+    prof = SubmodelProfiler(app)
+    try:
+        (warmup or run)()
+        with trace(os.path.join(output_dir, "xprof")):
+            run()
+    finally:
+        prof.detach()
+    summary = prof.save_summary(os.path.join(output_dir, "summary.json"))
+    return {"output_dir": output_dir, "summary": summary}

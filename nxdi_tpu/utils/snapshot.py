@@ -1,0 +1,72 @@
+"""Input snapshotting — capture every dispatch's input tensors for repro.
+
+The analog of the reference's snapshot subsystem (utils/snapshot.py;
+env-driven hooks application_base.py:344,421-552 writing per-request/per-token
+``.npy`` bundles). A :class:`SnapshotCollector` attaches to an application's
+ModelWrappers and writes each dispatched batch as an ``.npz`` under
+
+    <output_dir>/<submodel_tag>/request{N}.npz
+
+Activation is either programmatic (``attach_snapshot_hooks``) or via env vars
+mirroring the reference's:
+
+    NXDI_TPU_SNAPSHOT_OUTPUT_PATH=/dir     enable + where to write
+    NXDI_TPU_SNAPSHOT_CAPTURE_AT_REQUESTS=0,5   (optional) request filter
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+import numpy as np
+
+SNAPSHOT_ENV = "NXDI_TPU_SNAPSHOT_OUTPUT_PATH"
+SNAPSHOT_REQUESTS_ENV = "NXDI_TPU_SNAPSHOT_CAPTURE_AT_REQUESTS"
+
+
+class SnapshotCollector:
+    """Writes each dispatch's numpy batch per submodel tag."""
+
+    def __init__(self, output_dir: str, capture_at_requests: Optional[List[int]] = None):
+        self.output_dir = output_dir
+        self.capture_at_requests = (
+            set(capture_at_requests) if capture_at_requests is not None else None
+        )
+        self._counters: Dict[str, int] = {}
+        self.saved: List[str] = []
+
+    def __call__(self, tag: str, batch_np: Dict[str, np.ndarray]) -> None:
+        n = self._counters.get(tag, 0)
+        self._counters[tag] = n + 1
+        if self.capture_at_requests is not None and n not in self.capture_at_requests:
+            return
+        d = os.path.join(self.output_dir, tag)
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"request{n}.npz")
+        np.savez(path, **{k: np.asarray(v) for k, v in batch_np.items()})
+        self.saved.append(path)
+
+
+def attach_snapshot_hooks(app, output_dir: str, capture_at_requests=None) -> SnapshotCollector:
+    """Attach a collector to every submodel wrapper of a loaded application."""
+    collector = SnapshotCollector(output_dir, capture_at_requests)
+    for wrapper in app.models.values():
+        wrapper.snapshot_hook = collector
+    return collector
+
+
+def maybe_attach_from_env(app) -> Optional[SnapshotCollector]:
+    """Reference-style env activation (checked by applications at load)."""
+    path = os.environ.get(SNAPSHOT_ENV)
+    if not path:
+        return None
+    at = os.environ.get(SNAPSHOT_REQUESTS_ENV)
+    requests = [int(x) for x in at.split(",")] if at else None
+    return attach_snapshot_hooks(app, path, requests)
+
+
+def load_snapshot(path: str) -> Dict[str, np.ndarray]:
+    """Load one captured request bundle (for replay through app.forward)."""
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}

@@ -1,0 +1,169 @@
+"""Observability suite: input snapshots, tensor capture, profiling,
+capture-on-divergence (reference analogs: utils/snapshot.py,
+TensorCaptureConfig, utils/profiling.py, --capture-indices)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TensorCaptureConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+from spec_test_utils import make_tiny_hf_llama
+
+
+def _build_app(hf_model, hf_cfg, **extra):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True, **extra,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+PROMPT = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+
+
+def test_input_snapshots(tmp_path):
+    from nxdi_tpu.utils.snapshot import attach_snapshot_hooks, load_snapshot
+
+    hf, cfg = make_tiny_hf_llama(seed=0)
+    app = _build_app(hf, cfg)
+    collector = attach_snapshot_hooks(app, str(tmp_path))
+    adapter = HuggingFaceGenerationAdapter(app)
+    adapter.generate(PROMPT, max_new_tokens=4)
+
+    # 1 CTE + 3 TKG dispatches captured
+    cte = sorted(os.listdir(tmp_path / "context_encoding_model"))
+    tkg = sorted(os.listdir(tmp_path / "token_generation_model"))
+    assert cte == ["request0.npz"]
+    assert len(tkg) == 3
+    snap = load_snapshot(str(tmp_path / "context_encoding_model" / "request0.npz"))
+    # the captured CTE inputs are the PADDED bucket shapes actually dispatched
+    assert snap["input_ids"].shape[1] == 32
+    np.testing.assert_array_equal(snap["input_ids"][0, :8], PROMPT[0])
+    assert len(collector.saved) == 4
+
+
+def test_snapshot_env_activation(tmp_path, monkeypatch):
+    from nxdi_tpu.utils.snapshot import SNAPSHOT_ENV
+
+    monkeypatch.setenv(SNAPSHOT_ENV, str(tmp_path))
+    hf, cfg = make_tiny_hf_llama(seed=0)
+    app = _build_app(hf, cfg)  # load() attaches from env
+    adapter = HuggingFaceGenerationAdapter(app)
+    adapter.generate(PROMPT, max_new_tokens=2)
+    assert os.path.exists(tmp_path / "context_encoding_model" / "request0.npz")
+
+
+def test_tensor_capture_outputs(tmp_path):
+    """Captured intermediates must come back as extra outputs and agree with
+    the HF reference at the capture points."""
+    import torch
+
+    hf, cfg = make_tiny_hf_llama(seed=0)
+    app = _build_app(
+        hf, cfg,
+        tensor_capture_config=TensorCaptureConfig(
+            capture_points=("embeds", "layer_hiddens", "hidden", "logits")
+        ),
+    )
+    B, S = PROMPT.shape
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(PROMPT.astype(np.int32), pos, last_token_index=np.array([S - 1], np.int32))
+    cap = out["captured"]
+    assert set(cap) == {"embeds", "layer_hiddens", "hidden", "logits"}
+    # layer_hiddens: (L, B, S_padded, H)
+    assert cap["layer_hiddens"].shape[0] == cfg.num_hidden_layers
+
+    with torch.no_grad():
+        hf_out = hf(torch.tensor(PROMPT), output_hidden_states=True)
+    # embeds == HF hidden_states[0]; layer i out == hidden_states[i+1] for
+    # i < L-1 (HF's LAST entry is post-final-norm, ours captures pre-norm)
+    np.testing.assert_allclose(
+        np.asarray(cap["embeds"])[:, :S], hf_out.hidden_states[0].numpy(), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(cap["layer_hiddens"])[0][:, :S],
+        hf_out.hidden_states[1].numpy(),
+        atol=2e-5,
+    )
+    # "hidden" is the pre-final-norm stream == last collected layer output
+    np.testing.assert_allclose(
+        np.asarray(cap["hidden"]), np.asarray(cap["layer_hiddens"])[-1], atol=1e-6
+    )
+    # captured logits agree with HF at the last real position (the CTE
+    # gathers the last token, so captured logits are (B, 1, V))
+    np.testing.assert_allclose(
+        np.asarray(cap["logits"])[:, -1, : cfg.vocab_size],
+        hf_out.logits[:, S - 1].numpy(),
+        atol=2e-5,
+    )
+
+
+def test_profiler_summary(tmp_path):
+    from nxdi_tpu.utils.profiling import profile_generation
+
+    hf, cfg = make_tiny_hf_llama(seed=0)
+    app = _build_app(hf, cfg)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    result = profile_generation(
+        app,
+        run=lambda: adapter.generate(PROMPT, max_new_tokens=4),
+        output_dir=str(tmp_path),
+    )
+    summary = result["summary"]
+    assert "context_encoding_model" in summary
+    assert "token_generation_model" in summary
+    assert summary["token_generation_model"]["count"] >= 3
+    assert summary["token_generation_model"]["p50_ms"] > 0
+    # summary json on disk + an xprof trace directory
+    with open(tmp_path / "summary.json") as f:
+        assert json.load(f).keys() == summary.keys()
+    assert any(os.scandir(tmp_path / "xprof"))
+
+
+def test_capture_inputs_at_divergence(tmp_path):
+    from nxdi_tpu.utils.debug import capture_inputs_at_divergence
+
+    hf, cfg = make_tiny_hf_llama(seed=0)
+    app = _build_app(hf, cfg)
+
+    # clean model: no divergence, nothing written
+    res = capture_inputs_at_divergence(
+        app, PROMPT, str(tmp_path / "clean"), hf_model=hf,
+        divergence_difference_tol=0.01,
+    )
+    assert res["divergence_index"] is None
+    assert not os.path.exists(tmp_path / "clean")
+
+    # corrupt the golden logits at one position -> divergence bundle
+    from nxdi_tpu.utils.accuracy import hf_forward_logits
+
+    golden = hf_forward_logits(hf, PROMPT).copy()
+    golden[:, 5, :] += 1.0
+    res = capture_inputs_at_divergence(
+        app, PROMPT, str(tmp_path / "bad"), golden_logits=golden,
+        divergence_difference_tol=0.01,
+    )
+    assert res["divergence_index"] == 5
+    bundle = np.load(res["path"])
+    np.testing.assert_array_equal(bundle["input_ids"], PROMPT)
+    with open(tmp_path / "bad" / "divergence_report.json") as f:
+        report = json.load(f)
+    assert report["divergence_index"] == 5
+    assert report["errors_by_index"]["5"] > 0.5

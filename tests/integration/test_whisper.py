@@ -1,0 +1,112 @@
+"""Whisper audio encoder-decoder: exact greedy token match vs HF CPU
+(reference analog: models/whisper tests)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import TpuConfig
+from nxdi_tpu.models.whisper import modeling_whisper as mw
+
+
+def _tiny_hf_whisper(seed=0):
+    import torch
+    from transformers import WhisperConfig, WhisperForConditionalGeneration
+
+    torch.manual_seed(seed)
+    cfg = WhisperConfig(
+        d_model=64,
+        encoder_layers=2,
+        decoder_layers=2,
+        encoder_attention_heads=4,
+        decoder_attention_heads=4,
+        encoder_ffn_dim=128,
+        decoder_ffn_dim=128,
+        num_mel_bins=16,
+        max_source_positions=32,
+        max_target_positions=64,
+        vocab_size=256,
+        pad_token_id=0,
+        bos_token_id=1,
+        eos_token_id=2,
+        decoder_start_token_id=1,
+        suppress_tokens=None,
+        begin_suppress_tokens=None,
+        forced_decoder_ids=None,
+    )
+    return WhisperForConditionalGeneration(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(seq_len=64, dtype="float32", skip_warmup=True)
+    cfg = mw.WhisperInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(mw.WhisperForConditionalGeneration):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg)
+    app.load()
+    return app
+
+
+def test_whisper_encoder_matches_hf():
+    import torch
+
+    hf, cfg = _tiny_hf_whisper()
+    app = _build_app(hf, cfg)
+    rng = np.random.default_rng(0)
+    # input length = 2 * max_source_positions (conv2 stride halves it)
+    feats = rng.standard_normal((1, 16, 64)).astype(np.float32)
+    with torch.no_grad():
+        expected = hf.model.encoder(torch.tensor(feats)).last_hidden_state.numpy()
+    actual = np.asarray(app.encode(feats))
+    np.testing.assert_allclose(actual, expected, atol=2e-5)
+
+
+def test_whisper_greedy_matches_hf():
+    import torch
+
+    hf, cfg = _tiny_hf_whisper()
+    app = _build_app(hf, cfg)
+    rng = np.random.default_rng(1)
+    feats = rng.standard_normal((1, 16, 64)).astype(np.float32)
+    dec_start = np.array([[1, 7, 12]], dtype=np.int64)  # sot + fake task tokens
+
+    with torch.no_grad():
+        expected = hf.generate(
+            input_features=torch.tensor(feats),
+            decoder_input_ids=torch.tensor(dec_start),
+            max_new_tokens=16,
+            do_sample=False,
+        ).numpy()
+    # HF whisper generate returns only the NEW tokens (it strips the decoder
+    # prompt); ours returns prompt + generated — compare the generated part
+    actual = app.generate(feats, dec_start, max_new_tokens=16, eos_token_id=2)
+    gen = actual[:, dec_start.shape[1]:]
+    n = min(gen.shape[1], expected.shape[1])
+    np.testing.assert_array_equal(gen[:, :n], expected[:, :n])
+    assert n >= 10
+
+
+def test_whisper_batch_greedy():
+    import torch
+
+    hf, cfg = _tiny_hf_whisper()
+    app = _build_app(hf, cfg)
+    rng = np.random.default_rng(2)
+    feats = rng.standard_normal((2, 16, 64)).astype(np.float32)
+    dec_start = np.array([[1], [1]], dtype=np.int64)
+
+    with torch.no_grad():
+        expected = hf.generate(
+            input_features=torch.tensor(feats),
+            decoder_input_ids=torch.tensor(dec_start),
+            max_new_tokens=10,
+            do_sample=False,
+        ).numpy()
+    actual = app.generate(feats, dec_start, max_new_tokens=10, eos_token_id=2)
+    gen = actual[:, dec_start.shape[1]:]
+    n = min(gen.shape[1], expected.shape[1])
+    np.testing.assert_array_equal(gen[:, :n], expected[:, :n])
+    assert n >= 8
