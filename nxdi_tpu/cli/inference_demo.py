@@ -364,6 +364,8 @@ def _run_accuracy(args, app, adapter, input_ids) -> int:
             res = capture_inputs_at_divergence(
                 app, checked_ids, args.capture_output_dir, hf_model=hf_model,
                 divergence_difference_tol=args.divergence_difference_tol,
+                divergence_index=e.divergence_index,
+                errors_by_index=e.errors_by_index,
             )
             print(f"Divergence bundle written: {res['path']}")
         return 1

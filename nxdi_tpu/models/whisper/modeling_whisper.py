@@ -167,6 +167,7 @@ def whisper_decode_step(
     batch: Dict[str, jax.Array],
     *,
     kv_window: int,
+    suppress_tokens: tuple = (),
 ) -> Any:
     """One decoder dispatch over S_new tokens (prefill and single-token decode
     are the same program shape-family; reference: NeuronTextDecoder :345)."""
@@ -223,8 +224,12 @@ def whisper_decode_step(
     idx = batch["last_token_index"][:, None, None]
     last = jnp.take_along_axis(
         logits, jnp.broadcast_to(idx, (B, 1, logits.shape[-1])), axis=1
-    )
-    tokens = jnp.argmax(last[:, 0], axis=-1).astype(jnp.int32)
+    )[:, 0]
+    if suppress_tokens:
+        # HF masks suppressed ids to -inf before argmax (whisper generation
+        # config suppress_tokens / begin_suppress_tokens)
+        last = last.at[:, jnp.asarray(suppress_tokens, jnp.int32)].set(-jnp.inf)
+    tokens = jnp.argmax(last, axis=-1).astype(jnp.int32)
     new_cache = dict(cache)
     new_cache["k"] = new_k
     new_cache["v"] = new_v
@@ -352,11 +357,27 @@ class WhisperForConditionalGeneration:
         decoder_input_ids: np.ndarray,
         max_new_tokens: int = 32,
         eos_token_id: Optional[int] = None,
+        suppress_tokens: Optional[list] = None,
+        begin_suppress_tokens: Optional[list] = None,
+        forced_decoder_ids: Optional[list] = None,
     ) -> np.ndarray:
         """Greedy transcription loop (reference: the decoder application's
-        generation loop)."""
+        generation loop). Token suppression mirrors HF whisper generation:
+        ``suppress_tokens`` masked at every step, ``begin_suppress_tokens``
+        additionally at the FIRST generated position, ``forced_decoder_ids``
+        ([(pos, id), ...]) override sampled tokens at given positions. Values
+        default to the model config when present."""
         if not self.is_loaded:
             raise RuntimeError("call load() before generate()")
+        if suppress_tokens is None:
+            suppress_tokens = getattr(self.config, "suppress_tokens", None) or []
+        if begin_suppress_tokens is None:
+            begin_suppress_tokens = getattr(self.config, "begin_suppress_tokens", None) or []
+        if forced_decoder_ids is None:
+            forced_decoder_ids = getattr(self.config, "forced_decoder_ids", None) or []
+        forced = {int(p): int(t) for p, t in forced_decoder_ids}
+        sup = tuple(int(t) for t in suppress_tokens)
+        sup_begin = tuple(sorted(set(sup) | {int(t) for t in begin_suppress_tokens}))
         enc_out = self.encode(input_features)
         cross = self._program("cross", partial(whisper_cross_kv, self.arch))(
             self.params, enc_out
@@ -372,9 +393,11 @@ class WhisperForConditionalGeneration:
             "cross_v": cross["cross_v"],
         }
 
+        # the prefill program samples the FIRST generated token: it carries
+        # the begin-suppress mask on top of the always-suppress set
         step = self._program(
-            ("prefill", S0, W),
-            partial(whisper_decode_step, self.arch, kv_window=W),
+            ("prefill", S0, W, sup_begin),
+            partial(whisper_decode_step, self.arch, kv_window=W, suppress_tokens=sup_begin),
         )
         batch = {
             "input_ids": jnp.asarray(decoder_input_ids, jnp.int32),
@@ -382,10 +405,14 @@ class WhisperForConditionalGeneration:
             "last_token_index": jnp.full((B,), S0 - 1, jnp.int32),
         }
         out, cache = step(self.params, cache, batch)
-        tokens = [np.asarray(out["tokens"])[:, 0]]
+        first = np.asarray(out["tokens"])[:, 0]
+        if S0 in forced:
+            first = np.full_like(first, forced[S0])
+        tokens = [first]
 
         decode = self._program(
-            ("decode", W), partial(whisper_decode_step, self.arch, kv_window=W)
+            ("decode", W, sup),
+            partial(whisper_decode_step, self.arch, kv_window=W, suppress_tokens=sup),
         )
         finished = np.zeros((B,), dtype=bool)
         if eos_token_id is not None:
@@ -399,6 +426,8 @@ class WhisperForConditionalGeneration:
             }
             out, cache = decode(self.params, cache, batch)
             nxt = np.asarray(out["tokens"])[:, 0]
+            if pos + 1 in forced:
+                nxt = np.full_like(nxt, forced[pos + 1])
             if eos_token_id is not None:
                 nxt = np.where(finished, eos_token_id, nxt)
             tokens.append(nxt)

@@ -23,6 +23,8 @@ def capture_inputs_at_divergence(
     hf_model=None,
     golden_logits: Optional[np.ndarray] = None,
     divergence_difference_tol: float = 0.001,
+    divergence_index: Optional[int] = None,
+    errors_by_index: Optional[Dict[int, float]] = None,
 ) -> Dict[str, object]:
     """Run teacher-forced logit matching; on any divergence, write a repro
     bundle: the checked token sequence, the golden logits, the divergent
@@ -40,6 +42,14 @@ def capture_inputs_at_divergence(
             raise ValueError("need hf_model or golden_logits")
         golden_logits = accuracy.hf_forward_logits(hf_model, input_ids)
 
+    if divergence_index is not None:
+        # the caller already ran the failing check (e.g. the CLI caught a
+        # LogitMatchingValidationError): skip the re-run, just write the bundle
+        div, errors = divergence_index, errors_by_index or {}
+        return _write_bundle(
+            output_dir, input_ids, golden_logits, div, errors, divergence_difference_tol
+        )
+
     try:
         errors = accuracy.check_accuracy_logits(
             app,
@@ -51,7 +61,12 @@ def capture_inputs_at_divergence(
     except LogitMatchingValidationError as e:
         div = e.divergence_index
         errors = e.errors_by_index
+    return _write_bundle(
+        output_dir, input_ids, golden_logits, div, errors, divergence_difference_tol
+    )
 
+
+def _write_bundle(output_dir, input_ids, golden_logits, div, errors, tol):
     os.makedirs(output_dir, exist_ok=True)
     path = os.path.join(output_dir, f"divergence_idx{div}.npz")
     np.savez(
@@ -64,7 +79,7 @@ def capture_inputs_at_divergence(
         json.dump(
             {
                 "divergence_index": div,
-                "tolerance": divergence_difference_tol,
+                "tolerance": tol,
                 "errors_by_index": {str(k): float(v) for k, v in errors.items()},
             },
             f,

@@ -32,57 +32,51 @@ def trace(output_dir: str):
 
 
 class SubmodelProfiler:
-    """Wall-clock per (submodel, dispatch): attach, run traffic, summarize.
-
-    Mirrors the reference's profile flow: warmup execution excluded, the
-    summary has per-tag latency stats (utils/profiling.py:87-121 summary
-    JSON)."""
+    """Per-submodel wall-clock stats via one LatencyCollector per tag
+    (utils/benchmark.py — the same hook machinery the benchmark harness uses;
+    reference: utils/profiling.py:87-121 summary JSON)."""
 
     def __init__(self, app):
+        from nxdi_tpu.utils.benchmark import LatencyCollector
+
         self.app = app
-        self.records: Dict[str, list] = {}
-        self._t0: Dict[str, float] = {}
-        for wrapper in app.models.values():
-            wrapper.pre_hooks.append(self._pre)
-            wrapper.post_hooks.append(self._post)
+        self.collectors: Dict[str, Any] = {}
+        self._make = LatencyCollector
+        for tag, wrapper in app.models.items():
+            c = self.collectors[tag] = LatencyCollector()
+            wrapper.pre_hooks.append(c.pre_hook)
+            wrapper.post_hooks.append(c.post_hook)
 
-    def _pre(self, tag: str):
-        self._t0[tag] = time.perf_counter()
-
-    def _post(self, tag: str):
-        dt = (time.perf_counter() - self._t0[tag]) * 1000.0
-        self.records.setdefault(tag, []).append(dt)
+    def reset(self):
+        """Drop everything recorded so far (call after warmup traffic)."""
+        for c in self.collectors.values():
+            c.latency_list.clear()
 
     def detach(self):
-        for wrapper in self.app.models.values():
-            if self._pre in wrapper.pre_hooks:
-                wrapper.pre_hooks.remove(self._pre)
-            if self._post in wrapper.post_hooks:
-                wrapper.post_hooks.remove(self._post)
+        for tag, wrapper in self.app.models.items():
+            c = self.collectors[tag]
+            if c.pre_hook in wrapper.pre_hooks:
+                wrapper.pre_hooks.remove(c.pre_hook)
+            if c.post_hook in wrapper.post_hooks:
+                wrapper.post_hooks.remove(c.post_hook)
 
-    def summary(self, skip_first: int = 1) -> Dict[str, Any]:
-        """Per-tag stats, excluding the first ``skip_first`` dispatches (the
-        reference captures 2 executions and profiles the 2nd)."""
+    def summary(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
-        for tag, xs in self.records.items():
-            xs = xs[skip_first:] or xs
-            xs_sorted = sorted(xs)
-
-            def pct(p):
-                i = min(len(xs_sorted) - 1, int(round(p / 100 * (len(xs_sorted) - 1))))
-                return xs_sorted[i]
-
+        for tag, c in self.collectors.items():
+            xs = c.latency_list
+            if not xs:
+                continue
             out[tag] = {
                 "count": len(xs),
-                "mean_ms": sum(xs) / len(xs),
-                "p50_ms": pct(50),
-                "p99_ms": pct(99),
-                "max_ms": xs_sorted[-1],
+                "mean_ms": 1000.0 * sum(xs) / len(xs),
+                "p50_ms": 1000.0 * c.percentile(50),
+                "p99_ms": 1000.0 * c.percentile(99),
+                "max_ms": 1000.0 * c.percentile(100),
             }
         return out
 
-    def save_summary(self, path: str, skip_first: int = 1) -> Dict[str, Any]:
-        s = self.summary(skip_first)
+    def save_summary(self, path: str) -> Dict[str, Any]:
+        s = self.summary()
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:
             json.dump(s, f, indent=2)
@@ -100,6 +94,7 @@ def profile_generation(
     prof = SubmodelProfiler(app)
     try:
         (warmup or run)()
+        prof.reset()  # warmup dispatches are excluded from the summary
         with trace(os.path.join(output_dir, "xprof")):
             run()
     finally:
