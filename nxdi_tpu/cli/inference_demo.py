@@ -225,7 +225,8 @@ def run_inference(args) -> int:
 
         app = MedusaCausalLM(args.model_path, config, model_family=family)
     else:
-        app = TpuModelForCausalLM(args.model_path, config, model_family=family)
+        app_cls = getattr(family, "APPLICATION_CLS", TpuModelForCausalLM)
+        app = app_cls(args.model_path, config, model_family=family)
     if args.compiled_model_path and not args.skip_compile:
         app.compile(args.compiled_model_path)
     app.load(args.compiled_model_path)

@@ -27,6 +27,10 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "llama4": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
     "llama4_text": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
     "llava": ("nxdi_tpu.models.llava.modeling_llava", "LlavaInferenceConfig"),
+    "qwen3_next": (
+        "nxdi_tpu.models.qwen3_next.modeling_qwen3_next",
+        "Qwen3NextInferenceConfig",
+    ),
 }
 
 

@@ -378,6 +378,8 @@ class WhisperForConditionalGeneration:
         forced = {int(p): int(t) for p, t in forced_decoder_ids}
         sup = tuple(int(t) for t in suppress_tokens)
         sup_begin = tuple(sorted(set(sup) | {int(t) for t in begin_suppress_tokens}))
+        # HF applies begin_suppress at the first position NOT overridden by
+        # forced decoder ids (begin_index skips past the forced prefix)
         enc_out = self.encode(input_features)
         cross = self._program("cross", partial(whisper_cross_kv, self.arch))(
             self.params, enc_out
@@ -393,11 +395,13 @@ class WhisperForConditionalGeneration:
             "cross_v": cross["cross_v"],
         }
 
-        # the prefill program samples the FIRST generated token: it carries
-        # the begin-suppress mask on top of the always-suppress set
+        begin_pos = S0
+        while begin_pos in forced:
+            begin_pos += 1
+        prefill_sup = sup_begin if begin_pos == S0 else sup
         step = self._program(
-            ("prefill", S0, W, sup_begin),
-            partial(whisper_decode_step, self.arch, kv_window=W, suppress_tokens=sup_begin),
+            ("prefill", S0, W, prefill_sup),
+            partial(whisper_decode_step, self.arch, kv_window=W, suppress_tokens=prefill_sup),
         )
         batch = {
             "input_ids": jnp.asarray(decoder_input_ids, jnp.int32),
@@ -410,10 +414,11 @@ class WhisperForConditionalGeneration:
             first = np.full_like(first, forced[S0])
         tokens = [first]
 
-        decode = self._program(
-            ("decode", W, sup),
-            partial(whisper_decode_step, self.arch, kv_window=W, suppress_tokens=sup),
-        )
+        def decode_program(step_sup):
+            return self._program(
+                ("decode", W, step_sup),
+                partial(whisper_decode_step, self.arch, kv_window=W, suppress_tokens=step_sup),
+            )
         finished = np.zeros((B,), dtype=bool)
         if eos_token_id is not None:
             finished |= tokens[-1] == eos_token_id
@@ -424,7 +429,10 @@ class WhisperForConditionalGeneration:
                 "position_ids": jnp.full((B, 1), pos, jnp.int32),
                 "last_token_index": jnp.zeros((B,), jnp.int32),
             }
-            out, cache = decode(self.params, cache, batch)
+            # the step that samples sequence position pos+1 carries the
+            # begin-suppress mask iff that is the first non-forced position
+            step_sup = sup_begin if (pos + 1) == begin_pos else sup
+            out, cache = decode_program(step_sup)(self.params, cache, batch)
             nxt = np.asarray(out["tokens"])[:, 0]
             if pos + 1 in forced:
                 nxt = np.full_like(nxt, forced[pos + 1])

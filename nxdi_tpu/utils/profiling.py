@@ -41,7 +41,6 @@ class SubmodelProfiler:
 
         self.app = app
         self.collectors: Dict[str, Any] = {}
-        self._make = LatencyCollector
         for tag, wrapper in app.models.items():
             c = self.collectors[tag] = LatencyCollector()
             wrapper.pre_hooks.append(c.pre_hook)

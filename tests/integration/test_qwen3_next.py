@@ -1,0 +1,122 @@
+"""Qwen3Next hybrid linear-attention model: exact greedy token match vs HF CPU
+(reference analog: models/qwen3_next tests — GatedDeltaNet + gated full
+attention interleave)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.qwen3_next import modeling_qwen3_next as mq
+
+
+def _tiny_hf(moe=False, layers=4):
+    import torch
+    from transformers import Qwen3NextConfig, Qwen3NextForCausalLM
+
+    torch.manual_seed(0)
+    kwargs = dict(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=layers,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        partial_rotary_factor=0.25,
+        linear_num_value_heads=4,
+        linear_num_key_heads=2,
+        linear_key_head_dim=16,
+        linear_value_head_dim=16,
+        linear_conv_kernel_dim=4,
+        tie_word_embeddings=False,
+        eos_token_id=None,
+    )
+    if moe:
+        kwargs.update(
+            num_experts=4,
+            num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            shared_expert_intermediate_size=32,
+            decoder_sparse_step=1,
+            norm_topk_prob=True,
+            mlp_only_layers=[],
+        )
+    else:
+        kwargs.update(num_experts=0, decoder_sparse_step=0, mlp_only_layers=[])
+    cfg = Qwen3NextConfig(**kwargs)
+    return Qwen3NextForCausalLM(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg, batch_size=1):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=batch_size,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = mq.Qwen3NextInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(mq.Qwen3NextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=mq)
+    app.load()
+    return app
+
+
+def _hf_greedy(hf_model, ids, n):
+    import torch
+
+    with torch.no_grad():
+        return hf_model.generate(
+            torch.tensor(ids), max_new_tokens=n, do_sample=False
+        ).numpy()
+
+
+def test_qwen3_next_dense_matches_hf():
+    hf, cfg = _tiny_hf(moe=False)
+    app = _build_app(hf, cfg)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = _hf_greedy(hf, prompt, 16)
+    actual = adapter.generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_qwen3_next_moe_matches_hf():
+    hf, cfg = _tiny_hf(moe=True)
+    app = _build_app(hf, cfg)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = _hf_greedy(hf, prompt, 12)
+    actual = adapter.generate(prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_qwen3_next_padded_batch_state_isolation():
+    """Right-padded rows must not pollute the delta-rule/conv state: each row
+    matches its own unbatched HF run."""
+    hf, cfg = _tiny_hf(moe=False)
+    app = _build_app(hf, cfg, batch_size=2)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    p0 = [5, 9, 3, 17, 2, 8, 11, 42]
+    p1 = [7, 13, 21, 4]
+    prompt = np.zeros((2, 8), dtype=np.int64)
+    prompt[0] = p0
+    prompt[1, :4] = p1
+    mask = (prompt != 0).astype(np.int32)
+    out = adapter.generate(prompt, attention_mask=mask, max_new_tokens=10)
+    e0 = _hf_greedy(hf, np.array([p0]), 10)
+    e1 = _hf_greedy(hf, np.array([p1]), 10)
+    np.testing.assert_array_equal(out[0, : e0.shape[1]], e0[0])
+    np.testing.assert_array_equal(out[1, 4:14], e1[0, 4:])
