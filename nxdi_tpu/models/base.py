@@ -55,6 +55,7 @@ ACT_FNS: Dict[str, Callable] = {
     "silu": jax.nn.silu,
     "gelu": jax.nn.gelu,
     "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
+    "gelu_new": partial(jax.nn.gelu, approximate=True),
     "relu": jax.nn.relu,
 }
 
@@ -107,6 +108,11 @@ class DecoderArch:
     # dbrx: weight-only LayerNorm instead of RMSNorm; qkv clamp
     layernorm: bool = False
     clip_qkv: Optional[float] = None
+    # gpt2 lineage: learned position embeddings added to the token embeds
+    # (params["position_embeddings"]), no rope, plain (non-gated) MLP
+    learned_pos_embeds: bool = False
+    no_rope: bool = False
+    gated_mlp: bool = True
     # o_proj bias (gpt-oss; the llama lineage never has one)
     attention_o_bias: bool = False
     # YaRN attention factor multiplying cos/sin (gpt-oss, deepseek)
@@ -120,6 +126,9 @@ class DecoderArch:
     # "use_rope" params flag
     rope_interleaved: bool = False
     qk_l2norm: bool = False
+    # gemma2 softcapping: cap*tanh(x/cap) on attention scores / final logits
+    attn_logit_softcap: Optional[float] = None
+    final_logit_softcap: Optional[float] = None
     attn_temperature_tuning: bool = False
     floor_scale: float = 8192.0
     attn_scale: float = 0.1
@@ -174,12 +183,14 @@ def attention_param_specs(arch: DecoderArch) -> Dict[str, Any]:
 
 def mlp_param_specs(arch: DecoderArch) -> Dict[str, Any]:
     spec: Dict[str, Any] = {
-        "gate_proj": {"w": COLUMN_PARALLEL},
         "up_proj": {"w": COLUMN_PARALLEL},
         "down_proj": {"w": ROW_PARALLEL},
     }
+    if arch.gated_mlp:
+        spec["gate_proj"] = {"w": COLUMN_PARALLEL}
     if arch.mlp_bias:
-        spec["gate_proj"]["b"] = P(AXIS_TP)
+        if arch.gated_mlp:
+            spec["gate_proj"]["b"] = P(AXIS_TP)
         spec["up_proj"]["b"] = P(AXIS_TP)
         spec["down_proj"]["b"] = REPLICATED
     return spec
@@ -221,6 +232,10 @@ def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 
 def _norm(arch, x, w):
+    if isinstance(w, dict):  # biased LayerNorm (gpt2 lineage): {"w", "b"}
+        from nxdi_tpu.ops.norms import layer_norm
+
+        return layer_norm(x, w["w"], w.get("b"), eps=arch.rms_norm_eps)
     if arch.layernorm:
         from nxdi_tpu.ops.norms import layer_norm
 
@@ -306,7 +321,9 @@ def attention_block(
     rope_fn = apply_rotary_pos_emb
     if arch.rope_interleaved:
         from nxdi_tpu.ops.rope import apply_rotary_pos_emb_interleaved as rope_fn
-    if use_rope is None:
+    if arch.no_rope:
+        pass  # gpt2 lineage: positions come from learned embeddings
+    elif use_rope is None:
         q, k = rope_fn(q, k, cos, sin)
     else:
         # llama4: some layers skip rope entirely (per-layer scan flag)
@@ -346,6 +363,7 @@ def attention_block(
         if (
             arch.attn_tkg_kernel_enabled
             and not arch.attention_sink
+            and arch.attn_logit_softcap is None
             and window_enabled is None
             and use_rope is None
             and attn_kernels.decode_kernel_supported(q.shape, kk.shape)
@@ -367,12 +385,14 @@ def attention_block(
                 sink=p_attn.get("sink") if arch.attention_sink else None,
                 sliding_window_enabled=window_enabled,
                 chunk_enabled=use_rope,
+                logit_softcap=arch.attn_logit_softcap,
             )
     else:
         ctx = None
         if (
             arch.attn_kernel_enabled
             and not arch.attention_sink
+            and arch.attn_logit_softcap is None
             and window_enabled is None
             and use_rope is None
             and attn_kernels.prefill_kernel_supported(q.shape, k.shape)
@@ -394,6 +414,7 @@ def attention_block(
                 sink=p_attn.get("sink") if arch.attention_sink else None,
                 sliding_window_enabled=window_enabled,
                 chunk_enabled=use_rope,
+                logit_softcap=arch.attn_logit_softcap,
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
@@ -404,9 +425,13 @@ def attention_block(
 def mlp_block(
     arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array, adapter_ids=None
 ) -> jax.Array:
-    """Gated MLP (SwiGLU family). XLA fuses act+mul into the matmuls."""
+    """Gated MLP (SwiGLU family) — or the plain 2-layer MLP for the gpt2
+    lineage (gated_mlp=False). XLA fuses act+mul into the matmuls."""
     act = ACT_FNS[arch.hidden_act]
     aq, ac = arch.act_quant, arch.act_clamp
+    if not arch.gated_mlp:
+        up = act(_linear(x, p_mlp["up_proj"], aq, ac, adapter_ids))
+        return _linear(up, p_mlp["down_proj"], aq, ac, adapter_ids)
     gate = act(_linear(x, p_mlp["gate_proj"], aq, ac, adapter_ids))
     up = _linear(x, p_mlp["up_proj"], aq, ac, adapter_ids)
     return _linear(gate * up, p_mlp["down_proj"], aq, ac, adapter_ids)
@@ -576,6 +601,10 @@ def causal_lm_forward(
         # gemma scales embeddings by sqrt(hidden) AFTER the dtype downcast
         # (reference: modeling_gemma3.py:238-241)
         hidden = hidden * jnp.asarray(arch.embed_scale, compute_dtype)
+    if arch.learned_pos_embeds:
+        hidden = hidden + jnp.take(
+            params["position_embeddings"], position_ids, axis=0
+        ).astype(compute_dtype)
     if image_token_id is not None and "image_embeds" in batch:
         # multimodal prefill: replace image-placeholder token embeddings with
         # the projected vision features, row-local order (reference: the
@@ -668,6 +697,9 @@ def causal_lm_forward(
         )  # (B, 1, hidden)
 
     logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    if arch.final_logit_softcap is not None:
+        cap = arch.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
     logits = constrain(logits, policy.logits)
     logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
 

@@ -260,12 +260,14 @@ def param_shape_struct(config: InferenceConfig, arch: DecoderArch):
         layers["moe"] = moe_ops.moe_shape_struct(arch.moe, hs, L, dt)
     else:
         mlp = {
-            "gate_proj": {"w": s(L, hs, inter)},
             "up_proj": {"w": s(L, hs, inter)},
             "down_proj": {"w": s(L, inter, hs)},
         }
+        if arch.gated_mlp:
+            mlp["gate_proj"] = {"w": s(L, hs, inter)}
         if arch.mlp_bias:
-            mlp["gate_proj"]["b"] = s(L, inter)
+            if arch.gated_mlp:
+                mlp["gate_proj"]["b"] = s(L, inter)
             mlp["up_proj"]["b"] = s(L, inter)
             mlp["down_proj"]["b"] = s(L, hs)
         layers["mlp"] = mlp

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.gemma2 import modeling_gemma2

@@ -1,0 +1,115 @@
+"""Gemma2 family.
+
+Reference scope: the gemma lineage modules tested in models/gemma3 (the
+reference's contrib tree covers gemma2). Shares gemma3's machinery
+(models/gemma3/modeling_gemma3.py here): (1+w) float32 norms, sandwich
+pre/post feed-forward norms, sqrt(H) embedding scale, alternating
+sliding/full attention — plus gemma2's distinguishing soft-capping of
+attention scores AND final logits (cap * tanh(x / cap)), a single rope theta
+for every layer, and query_pre_attn_scalar softmax scaling.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq  # one theta; no local/global split
+
+
+class Gemma2InferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + ["head_dim"]
+
+    def add_derived_config(self):
+        super().add_derived_config()
+        if getattr(self, "hidden_act", None) in (None, "silu"):
+            self.hidden_act = getattr(self, "hidden_activation", "gelu_pytorch_tanh")
+        defaults = {
+            "query_pre_attn_scalar": self.head_dim,
+            "sliding_window": None,
+            "attn_logit_softcapping": 50.0,
+            "final_logit_softcapping": 30.0,
+        }
+        for k, v in defaults.items():
+            if not hasattr(self, k):
+                setattr(self, k, v)
+
+
+def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:
+    lt = getattr(config, "layer_types", None)
+    if lt:
+        return lt[i] == "sliding_attention"
+    return i % 2 == 0  # gemma2 default: even layers sliding
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        qk_norm=False,
+        gemma_norm=True,
+        sandwich_norm=True,
+        embed_scale=float(config.hidden_size) ** 0.5,
+        sliding_window=getattr(config, "sliding_window", None),
+        attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
+        attn_logit_softcap=getattr(config, "attn_logit_softcapping", None),
+        final_logit_softcap=getattr(config, "final_logit_softcapping", None),
+        tie_word_embeddings=getattr(config, "tie_word_embeddings", True),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    params = dense.convert_hf_state_dict(state_dict, config, arch)
+    dt = dense.np_dtype(arch.dtype)
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(name)
+
+    L = arch.num_layers
+    params["layers"]["pre_feedforward_layernorm"] = np.stack(
+        [np.asarray(get(f"layers.{i}.pre_feedforward_layernorm.weight"), dt) for i in range(L)]
+    )
+    params["layers"]["post_feedforward_layernorm"] = np.stack(
+        [np.asarray(get(f"layers.{i}.post_feedforward_layernorm.weight"), dt) for i in range(L)]
+    )
+    params["layers"]["use_sliding_window"] = np.array(
+        [_layer_is_sliding(config, i) for i in range(L)], dtype=bool
+    )
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from nxdi_tpu.parallel.layers import REPLICATED
+
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["pre_feedforward_layernorm"] = REPLICATED
+    specs["layers"]["post_feedforward_layernorm"] = REPLICATED
+    specs["layers"]["use_sliding_window"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+    struct["layers"]["pre_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
+    struct["layers"]["post_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
+    struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return struct

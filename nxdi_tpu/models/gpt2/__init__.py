@@ -1,0 +1,1 @@
+from nxdi_tpu.models.gpt2 import modeling_gpt2

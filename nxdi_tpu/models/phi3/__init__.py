@@ -1,0 +1,1 @@
+from nxdi_tpu.models.phi3 import modeling_phi3

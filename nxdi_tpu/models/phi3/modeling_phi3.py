@@ -1,0 +1,66 @@
+"""Phi-3 family (reference scope: the contrib hub's phi models).
+
+Llama-lineage decoder whose checkpoints fuse the projections:
+``qkv_proj`` holds Q|K|V stacked on the out dim, ``gate_up_proj`` holds
+gate|up. Conversion splits them into the shared dense layout; everything else
+(rms norms, silu MLP, default rope) is the stock pipeline. The 128k-context
+'longrope' scaling variant is NOT implemented yet — those checkpoints are
+rejected by the rope scaling dispatch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Phi3InferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    return dense.build_arch(
+        config,
+        **{"sliding_window": getattr(config, "sliding_window", None), **overrides},
+    )
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    D = arch.head_dim
+    q_dim = config.num_attention_heads * D
+    kv_dim = config.num_key_value_heads * D
+    inter = config.intermediate_size
+
+    sd = {}
+    for k, v in state_dict.items():
+        key = k[len("model."):] if k.startswith("model.") else k
+        if key.endswith("self_attn.qkv_proj.weight"):
+            pre = key[: -len("qkv_proj.weight")]
+            sd[pre + "q_proj.weight"] = v[:q_dim]
+            sd[pre + "k_proj.weight"] = v[q_dim : q_dim + kv_dim]
+            sd[pre + "v_proj.weight"] = v[q_dim + kv_dim :]
+        elif key.endswith("mlp.gate_up_proj.weight"):
+            pre = key[: -len("gate_up_proj.weight")]
+            sd[pre + "gate_proj.weight"] = v[:inter]
+            sd[pre + "up_proj.weight"] = v[inter:]
+        else:
+            sd[key] = v
+    return dense.convert_hf_state_dict(sd, config, arch)
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -384,28 +384,26 @@ def _mlp(arch: Qwen3NextArch, lp, x):
     return (gate * (x @ lp["up_proj"])) @ lp["down_proj"]
 
 
+def _moe_arch(arch: Qwen3NextArch):
+    from nxdi_tpu.ops.moe import MoEArch
+
+    return MoEArch(
+        num_experts=arch.num_experts,
+        top_k=arch.top_k,
+        intermediate_size=arch.moe_intermediate_size,
+        hidden_act="silu",
+        norm_topk_prob=arch.norm_topk_prob,
+        shared_expert_intermediate_size=arch.shared_expert_intermediate_size,
+        shared_expert_gated=True,
+    )
+
+
 def _moe(arch: Qwen3NextArch, lp, x):
-    B, S, Hd = x.shape
-    xt = x.reshape(-1, Hd)
-    logits = xt.astype(jnp.float32) @ lp["router"].astype(jnp.float32)
-    probs = jax.nn.softmax(logits, axis=-1)
-    top_vals, top_idx = jax.lax.top_k(probs, arch.top_k)
-    if arch.norm_topk_prob:
-        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
-    weights = jnp.sum(
-        jax.nn.one_hot(top_idx, arch.num_experts, dtype=top_vals.dtype) * top_vals[..., None],
-        axis=-2,
-    ).astype(x.dtype)
-    gate = jax.nn.silu(jnp.einsum("th,ehi->eti", xt, lp["experts"]["gate_proj"]))
-    up = jnp.einsum("th,ehi->eti", xt, lp["experts"]["up_proj"])
-    eo = jnp.einsum("eti,eih->eth", gate * up, lp["experts"]["down_proj"])
-    out = jnp.einsum("te,eth->th", weights, eo)
-    shared = (
-        jax.nn.silu(xt @ lp["shared_expert"]["gate_proj"]) * (xt @ lp["shared_expert"]["up_proj"])
-    ) @ lp["shared_expert"]["down_proj"]
-    sgate = jax.nn.sigmoid(xt.astype(jnp.float32) @ lp["shared_expert_gate"].astype(jnp.float32))
-    out = out + sgate.astype(shared.dtype) * shared
-    return out.reshape(B, S, Hd)
+    # the qwen-style router (softmax -> top-k -> renorm) + sigmoid-gated
+    # shared expert IS the shared MoE machinery — reuse it (ops/moe.py)
+    from nxdi_tpu.ops.moe import moe_block
+
+    return moe_block(arch, _moe_arch(arch), lp, x)
 
 
 # ---------------------------------------------------------------------------
@@ -555,24 +553,24 @@ def convert_hf_state_dict(
             mp = pre + "mlp."
             E = arch.num_experts
             lp["mlp"] = {
-                "router": get(mp + "gate.weight").T,
+                "router": {"w": get(mp + "gate.weight").T},
                 "experts": {
-                    "gate_proj": np.stack(
+                    "gate_proj": {"w": np.stack(
                         [get(mp + f"experts.{j}.gate_proj.weight").T for j in range(E)]
-                    ),
-                    "up_proj": np.stack(
+                    )},
+                    "up_proj": {"w": np.stack(
                         [get(mp + f"experts.{j}.up_proj.weight").T for j in range(E)]
-                    ),
-                    "down_proj": np.stack(
+                    )},
+                    "down_proj": {"w": np.stack(
                         [get(mp + f"experts.{j}.down_proj.weight").T for j in range(E)]
-                    ),
+                    )},
                 },
                 "shared_expert": {
-                    "gate_proj": get(mp + "shared_expert.gate_proj.weight").T,
-                    "up_proj": get(mp + "shared_expert.up_proj.weight").T,
-                    "down_proj": get(mp + "shared_expert.down_proj.weight").T,
+                    "gate_proj": {"w": get(mp + "shared_expert.gate_proj.weight").T},
+                    "up_proj": {"w": get(mp + "shared_expert.up_proj.weight").T},
+                    "down_proj": {"w": get(mp + "shared_expert.down_proj.weight").T},
                 },
-                "shared_expert_gate": get(mp + "shared_expert_gate.weight").T,
+                "shared_expert_gate": {"w": get(mp + "shared_expert_gate.weight").T},
             }
         else:
             lp["mlp"] = {
@@ -654,18 +652,18 @@ def param_shape_struct(config: InferenceConfig):
         if arch.num_experts:
             E, I, SI = arch.num_experts, arch.moe_intermediate_size, arch.shared_expert_intermediate_size
             lp["mlp"] = {
-                "router": s(Hd, E),
+                "router": {"w": s(Hd, E)},
                 "experts": {
-                    "gate_proj": s(E, Hd, I),
-                    "up_proj": s(E, Hd, I),
-                    "down_proj": s(E, I, Hd),
+                    "gate_proj": {"w": s(E, Hd, I)},
+                    "up_proj": {"w": s(E, Hd, I)},
+                    "down_proj": {"w": s(E, I, Hd)},
                 },
                 "shared_expert": {
-                    "gate_proj": s(Hd, SI),
-                    "up_proj": s(Hd, SI),
-                    "down_proj": s(SI, Hd),
+                    "gate_proj": {"w": s(Hd, SI)},
+                    "up_proj": {"w": s(Hd, SI)},
+                    "down_proj": {"w": s(SI, Hd)},
                 },
-                "shared_expert_gate": s(Hd, 1),
+                "shared_expert_gate": {"w": s(Hd, 1)},
             }
         else:
             lp["mlp"] = {
@@ -722,6 +720,8 @@ class Qwen3NextForCausalLM(TpuModelForCausalLM):
             ("is_chunked_prefill", tc.is_chunked_prefill),
             ("is_block_kv_layout", tc.is_block_kv_layout),
             ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
+            ("speculation", tc.speculation_length > 0 or tc.is_medusa),
+            ("tensor_capture_config", tc.tensor_capture_config is not None),
         ]
         bad = [name for name, val in unsupported if val]
         if bad:

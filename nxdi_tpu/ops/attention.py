@@ -59,6 +59,7 @@ def grouped_attention(
     scale: Optional[float] = None,
     softmax_dtype=jnp.float32,
     sink: Optional[jax.Array] = None,  # (H,) learned attention-sink logits
+    logit_softcap: Optional[float] = None,  # gemma2: cap*tanh(s/cap)
 ):
     """Grouped-head scaled dot-product attention. Returns (B, H, Sq, D)."""
     B, H, Sq, D = q.shape
@@ -69,6 +70,8 @@ def grouped_attention(
     qg = q.reshape(B, KV, G, Sq, D)
     scores = jnp.einsum("bkgqd,bksd->bkgqs", qg, k, preferred_element_type=softmax_dtype)
     scores = scores.astype(softmax_dtype) * scale
+    if logit_softcap is not None:
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, NEG_INF)
     if sink is not None:
         # gpt-oss style: concat a learned per-head sink logit before softmax and
@@ -94,6 +97,7 @@ def attention_with_positions(
     sink=None,
     sliding_window_enabled=None,
     chunk_enabled=None,
+    logit_softcap=None,
 ):
     """Attention with the mask derived from positions (prefill and decode both).
 
@@ -118,4 +122,7 @@ def attention_with_positions(
             )
     else:
         mask = causal_mask_from_positions(q_pos, kv_pos)
-    return grouped_attention(q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink)
+    return grouped_attention(
+        q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink,
+        logit_softcap=logit_softcap,
+    )

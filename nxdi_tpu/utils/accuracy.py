@@ -126,6 +126,9 @@ def _get_logit_probe(app):
         n_active_tokens=0,
         buckets=wrapper.buckets,
         attend_to_cache=False,
+        # families with custom graphs (qwen3_next's heterogeneous stack) set
+        # their own forward_fn on the CTE wrapper — the probe must match it
+        forward_fn=wrapper.forward_fn,
         forward_kwargs=fkw,
     )
     if getattr(app, "is_fused_spec", False):

@@ -162,6 +162,37 @@ def _tiny_hf(model_type):
             eos_token_id=None,
         )
         model = Llama4ForCausalLM(cfg)
+    elif model_type == "gpt2":
+        from transformers import GPT2Config, GPT2LMHeadModel
+
+        # learned positions, biased LayerNorms, fused Conv1D c_attn, plain MLP
+        cfg = GPT2Config(
+            n_embd=64, n_head=4, n_layer=4, n_positions=256, vocab_size=256,
+            n_inner=128, eos_token_id=None, bos_token_id=None,
+        )
+        model = GPT2LMHeadModel(cfg)
+    elif model_type == "gemma2":
+        from transformers import Gemma2Config, Gemma2ForCausalLM
+
+        # attention + final logit softcapping, alternating SWA, sandwich norms
+        common2 = dict(common)
+        cfg = Gemma2Config(
+            **common2,
+            head_dim=16,
+            sliding_window=8,
+            query_pre_attn_scalar=16,
+            attn_logit_softcapping=50.0,
+            final_logit_softcapping=30.0,
+            tie_word_embeddings=True,
+        )
+        model = Gemma2ForCausalLM(cfg)
+    elif model_type == "phi3":
+        from transformers import Phi3Config, Phi3ForCausalLM
+
+        # fused qkv_proj / gate_up_proj checkpoints
+        cfg = Phi3Config(**common, pad_token_id=0, tie_word_embeddings=False,
+                         eos_token_id=None)
+        model = Phi3ForCausalLM(cfg)
     elif model_type == "dbrx":
         from transformers import DbrxConfig, DbrxForCausalLM
 
@@ -206,8 +237,8 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
 
 @pytest.mark.parametrize(
     "model_type",
-    ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "dbrx",
-     "gpt_oss", "deepseek_v3", "llama4_text"]
+    ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "gemma2",
+     "phi3", "gpt2", "dbrx", "gpt_oss", "deepseek_v3", "llama4_text"]
 )
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):
