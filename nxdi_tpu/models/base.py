@@ -249,7 +249,7 @@ def _linear(x, p, act_quant=None, clamp=None, adapter_ids=None):
     dict carries slot-stacked LoRA buffers (lora/serving.py) and the batch
     supplies ``adapter_ids``, each row adds its adapter's low-rank delta —
     the reference's multi-LoRA linear (lora_serving/lora_layer.py)."""
-    if "qw" in p:
+    if "qw" in p or "qw4" in p:
         y = quant_ops.quantized_linear(x, p, act_quant=act_quant, clamp_bound=clamp)
     else:
         y = x @ p["w"]

@@ -66,50 +66,25 @@ def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
 def convert_hf_state_dict(
     state_dict: Dict[str, np.ndarray], config: InferenceConfig
 ) -> Dict[str, Any]:
+    from nxdi_tpu.models.gemma3.modeling_gemma3 import add_sandwich_params
+
     arch = build_arch(config)
     params = dense.convert_hf_state_dict(state_dict, config, arch)
-    dt = dense.np_dtype(arch.dtype)
-
-    def get(name):
-        for k in (name, f"model.{name}"):
-            if k in state_dict:
-                return state_dict[k]
-        raise KeyError(name)
-
-    L = arch.num_layers
-    params["layers"]["pre_feedforward_layernorm"] = np.stack(
-        [np.asarray(get(f"layers.{i}.pre_feedforward_layernorm.weight"), dt) for i in range(L)]
+    return add_sandwich_params(
+        params, state_dict, config, arch, _layer_is_sliding, dual_rope=False
     )
-    params["layers"]["post_feedforward_layernorm"] = np.stack(
-        [np.asarray(get(f"layers.{i}.post_feedforward_layernorm.weight"), dt) for i in range(L)]
-    )
-    params["layers"]["use_sliding_window"] = np.array(
-        [_layer_is_sliding(config, i) for i in range(L)], dtype=bool
-    )
-    return params
 
 
 def param_specs(config: InferenceConfig):
-    from nxdi_tpu.parallel.layers import REPLICATED
+    from nxdi_tpu.models.gemma3.modeling_gemma3 import add_sandwich_specs
 
     specs = dense.param_specs_for(build_arch(config))
-    specs["layers"]["pre_feedforward_layernorm"] = REPLICATED
-    specs["layers"]["post_feedforward_layernorm"] = REPLICATED
-    specs["layers"]["use_sliding_window"] = REPLICATED
-    return specs
+    return add_sandwich_specs(specs, dual_rope=False)
 
 
 def param_shape_struct(config: InferenceConfig):
-    import jax
-    import jax.numpy as jnp
-
-    from nxdi_tpu.config import to_jax_dtype
+    from nxdi_tpu.models.gemma3.modeling_gemma3 import add_sandwich_struct
 
     arch = build_arch(config)
     struct = dense.param_shape_struct(config, arch)
-    dt = to_jax_dtype(arch.dtype)
-    L, H = arch.num_layers, arch.hidden_size
-    struct["layers"]["pre_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
-    struct["layers"]["post_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
-    struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
-    return struct
+    return add_sandwich_struct(struct, config, arch, dual_rope=False)

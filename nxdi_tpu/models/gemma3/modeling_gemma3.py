@@ -80,11 +80,9 @@ def build_inv_freq(config: InferenceConfig) -> np.ndarray:
     return np.stack([g, loc])
 
 
-def convert_hf_state_dict(
-    state_dict: Dict[str, np.ndarray], config: InferenceConfig
-) -> Dict[str, Any]:
-    arch = build_arch(config)
-    params = dense.convert_hf_state_dict(state_dict, config, arch)
+# -- shared gemma-lineage helpers (gemma2 reuses these with dual_rope=False) --
+
+def add_sandwich_params(params, state_dict, config, arch, layer_is_sliding, dual_rope):
     dt = dense.np_dtype(arch.dtype)
 
     def get(name):
@@ -94,40 +92,60 @@ def convert_hf_state_dict(
         raise KeyError(name)
 
     L = arch.num_layers
-    pre_ff, post_ff = [], []
-    for i in range(L):
-        pre_ff.append(np.asarray(get(f"layers.{i}.pre_feedforward_layernorm.weight"), dt))
-        post_ff.append(np.asarray(get(f"layers.{i}.post_feedforward_layernorm.weight"), dt))
-    params["layers"]["pre_feedforward_layernorm"] = np.stack(pre_ff)
-    params["layers"]["post_feedforward_layernorm"] = np.stack(post_ff)
-
-    sliding = np.array([_layer_is_sliding(config, i) for i in range(L)], dtype=bool)
+    params["layers"]["pre_feedforward_layernorm"] = np.stack(
+        [np.asarray(get(f"layers.{i}.pre_feedforward_layernorm.weight"), dt) for i in range(L)]
+    )
+    params["layers"]["post_feedforward_layernorm"] = np.stack(
+        [np.asarray(get(f"layers.{i}.post_feedforward_layernorm.weight"), dt) for i in range(L)]
+    )
+    sliding = np.array([layer_is_sliding(config, i) for i in range(L)], dtype=bool)
     params["layers"]["use_sliding_window"] = sliding
-    params["layers"]["use_local_rope"] = sliding  # local rope on sliding layers
+    if dual_rope:
+        params["layers"]["use_local_rope"] = sliding  # local rope on SWA layers
     return params
 
 
-def param_specs(config: InferenceConfig):
-    specs = dense.param_specs_for(build_arch(config))
+def add_sandwich_specs(specs, dual_rope):
     specs["layers"]["pre_feedforward_layernorm"] = REPLICATED
     specs["layers"]["post_feedforward_layernorm"] = REPLICATED
     specs["layers"]["use_sliding_window"] = REPLICATED
-    specs["layers"]["use_local_rope"] = REPLICATED
+    if dual_rope:
+        specs["layers"]["use_local_rope"] = REPLICATED
     return specs
 
 
-def param_shape_struct(config: InferenceConfig):
+def add_sandwich_struct(struct, config, arch, dual_rope):
     import jax
     import jax.numpy as jnp
 
     from nxdi_tpu.config import to_jax_dtype
 
-    arch = build_arch(config)
-    struct = dense.param_shape_struct(config, arch)
     dt = to_jax_dtype(arch.dtype)
     L, H = arch.num_layers, arch.hidden_size
     struct["layers"]["pre_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
     struct["layers"]["post_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
     struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
-    struct["layers"]["use_local_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    if dual_rope:
+        struct["layers"]["use_local_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
     return struct
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    params = dense.convert_hf_state_dict(state_dict, config, arch)
+    return add_sandwich_params(
+        params, state_dict, config, arch, _layer_is_sliding, dual_rope=True
+    )
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    return add_sandwich_specs(specs, dual_rope=True)
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    return add_sandwich_struct(struct, config, arch, dual_rope=True)

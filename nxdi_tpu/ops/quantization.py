@@ -48,11 +48,62 @@ QUANT_DTYPES = {
 
 PER_TENSOR = "per_tensor_symmetric"
 PER_CHANNEL = "per_channel_symmetric"
+MXFP4 = "mxfp4"  # OCP microscaling fp4: E2M1 values, power-of-2 block scales
+
+MXFP4_BLOCK = 32
+# E2M1 representable magnitudes; stored as value*2 in int8 so the grid is
+# integer-exact ({0,1,2,3,4,6,8,12} with signs)
+_E2M1_GRID = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0], dtype=np.float32)
 
 # Never quantized regardless of user config: routing stays full precision (the
 # reference keeps router/gating fp32 too — moe_v2.py RouterTopK), and these are
 # consumed via p["w"] directly in ops/moe.py.
-DEFAULT_MODULES_TO_NOT_CONVERT = ("router", "shared_expert_gate")
+DEFAULT_MODULES_TO_NOT_CONVERT = (
+    "router",
+    "shared_expert_gate",
+    # biased norms are {"w","b"} dicts too (gpt2/whisper/vision lineages) —
+    # they must never be mistaken for linear layers by the {"w"}-dict walk
+    "input_layernorm",
+    "post_attention_layernorm",
+    "pre_feedforward_layernorm",
+    "post_feedforward_layernorm",
+    "norm",
+    "layer_norm",
+    "pre_layernorm",
+    "ln1",
+    "ln2",
+    "self_attn_layer_norm",
+    "encoder_attn_layer_norm",
+    "final_layer_norm",
+)
+
+
+def quantize_mxfp4(w: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """OCP MXFP4 (reference: gpt-oss MXFP4 weights, models/gpt_oss/
+    mx_layout_transform.py): 32-element blocks along the ``in`` axis share a
+    power-of-two scale; elements quantize to the E2M1 grid.
+
+    Returns ``(qw4, scale)``: qw4 int8 of shape (..., in/32, 32, out) holding
+    2x the fp4 value (integer-exact), scale float32 (..., in/32, 1, out) with
+    the 0.5 folded in — so ``qw4 * scale`` dequantizes by broadcast.
+    """
+    w32 = np.asarray(w, dtype=np.float32)
+    fin = w32.shape[-2]
+    if fin % MXFP4_BLOCK:
+        raise ValueError(
+            f"mxfp4 needs the in dim ({fin}) divisible by {MXFP4_BLOCK}"
+        )
+    nb = fin // MXFP4_BLOCK
+    blocks = w32.reshape(*w32.shape[:-2], nb, MXFP4_BLOCK, w32.shape[-1])
+    amax = np.max(np.abs(blocks), axis=-2, keepdims=True)
+    # power-of-two scale: smallest 2^e with amax/2^e <= 6 (the E2M1 max)
+    e = np.ceil(np.log2(np.maximum(amax, 1e-30) / _E2M1_GRID[-1]))
+    scale = np.exp2(e).astype(np.float32)
+    t = blocks / scale  # |t| <= 6
+    mids = (_E2M1_GRID[:-1] + _E2M1_GRID[1:]) / 2  # nearest-grid thresholds
+    idx = np.searchsorted(mids, np.abs(t), side="right")
+    q = np.sign(t) * _E2M1_GRID[idx] * 2.0  # store value*2
+    return q.astype(np.int8), (scale * 0.5).astype(np.float32)
 
 
 def quantize_array(
@@ -63,6 +114,8 @@ def quantize_array(
     Returns ``(qw, scale)`` with ``scale`` float32, keepdims over the reduced
     axes so that ``qw * scale`` dequantizes by broadcast.
     """
+    if quant_dtype == MXFP4 or scheme == MXFP4:
+        return quantize_mxfp4(w)
     np_dt, qmax = QUANT_DTYPES[quant_dtype]
     w32 = np.asarray(w, dtype=np.float32)
     if scheme == PER_TENSOR:
@@ -88,13 +141,16 @@ def dequantize_array(qw: np.ndarray, scale: np.ndarray, dtype=np.float32) -> np.
 
 
 def is_quantized(p: Dict[str, Any]) -> bool:
-    return isinstance(p, dict) and "qw" in p
+    return isinstance(p, dict) and ("qw" in p or "qw4" in p)
 
 
 def materialize_weight(p: Dict[str, Any], dtype) -> jax.Array:
     """Return the (dequantized) weight for einsum-style consumers (MoE experts).
     XLA fuses the convert+scale into the downstream contraction's operand read."""
-    if is_quantized(p):
+    if "qw4" in p:  # mxfp4 block layout -> flatten blocks back to (in, out)
+        w = p["qw4"].astype(dtype) * p["scale"].astype(dtype)
+        return w.reshape(*w.shape[:-3], w.shape[-3] * w.shape[-2], w.shape[-1])
+    if "qw" in p:
         return p["qw"].astype(dtype) * p["scale"].astype(dtype)
     return p["w"].astype(dtype)
 
@@ -112,6 +168,11 @@ def quantized_linear(
     int8 weight additionally quantizes activations per-token and runs the
     contraction on the MXU in int8 (reference: config.py:434-517).
     """
+    if "qw4" in p:  # mxfp4: dequantize-on-read, weight-only
+        y = x @ materialize_weight(p, x.dtype)
+        if "b" in p:
+            y = y + p["b"]
+        return y
     qw, scale = p["qw"], p["scale"]
     if act_quant == "dynamic" and qw.dtype == jnp.int8:
         if clamp_bound is not None:
@@ -182,7 +243,10 @@ def quantize_params(
             return None
         qw, scale = quantize_array(np.asarray(d["w"]), quant_dtype, scheme)
         out = {k: v for k, v in d.items() if k != "w"}
-        out.update(qw=qw, scale=scale)
+        if quant_dtype == MXFP4 or scheme == MXFP4:
+            out.update(qw4=qw, scale=scale)
+        else:
+            out.update(qw=qw, scale=scale)
         return out
 
     return _walk(params, (), fn)
@@ -192,6 +256,7 @@ def quantize_param_specs(
     specs: Dict[str, Any],
     scheme: str = PER_CHANNEL,
     modules_to_not_convert: Optional[list] = None,
+    quant_dtype: str = "int8",
 ) -> Dict[str, Any]:
     """Mirror :func:`quantize_params` on a PartitionSpec pytree. The scale
     inherits the weight's spec with the ``in`` axis (index -2) un-sharded —
@@ -201,6 +266,17 @@ def quantize_param_specs(
         if not _should_quantize(path, modules_to_not_convert):
             return None
         spec_w = d["w"]
+        if scheme == MXFP4 or quant_dtype == MXFP4:
+            # block layout (..., nb, 32, out): the in-axis sharding moves to
+            # the block axis (sharding nb over tp == sharding in over tp),
+            # the 32-wide block axis stays unsharded
+            entries = tuple(spec_w)
+            out_entry = entries[-1] if len(entries) >= 1 else None
+            in_entry = entries[-2] if len(entries) >= 2 else None
+            blocked = P(*(entries[:-2] + (in_entry, None, out_entry)))
+            out = {k: v for k, v in d.items() if k != "w"}
+            out.update(qw4=blocked, scale=blocked)
+            return out
         entries = tuple(spec_w)
         if len(entries) < 2:
             # replicated / short spec (GSPMD pads trailing dims): scale replicated
@@ -223,12 +299,28 @@ def quantize_shape_struct(
 ) -> Dict[str, Any]:
     """Mirror :func:`quantize_params` on a ShapeDtypeStruct pytree (AOT compile
     path, application.py params_shape_struct)."""
-    np_dt, _ = QUANT_DTYPES[quant_dtype]
+    np_dt = None if quant_dtype == MXFP4 else QUANT_DTYPES[quant_dtype][0]
 
     def fn(d, path):
         if not _should_quantize(path, modules_to_not_convert):
             return None
         s = d["w"]
+        if quant_dtype == MXFP4 or scheme == MXFP4:
+            fin, fout = s.shape[-2], s.shape[-1]
+            if fin % MXFP4_BLOCK:
+                raise ValueError(
+                    f"{'.'.join(map(str, path))}: mxfp4 needs the in dim "
+                    f"({fin}) divisible by {MXFP4_BLOCK}"
+                )
+            nb = fin // MXFP4_BLOCK
+            out = {k: v for k, v in d.items() if k != "w"}
+            out.update(
+                qw4=jax.ShapeDtypeStruct(
+                    s.shape[:-2] + (nb, MXFP4_BLOCK, fout), jnp.int8
+                ),
+                scale=jax.ShapeDtypeStruct(s.shape[:-2] + (nb, 1, fout), jnp.float32),
+            )
+            return out
         if scheme == PER_TENSOR:
             scale_shape = s.shape[:-2] + (1, 1)
         else:
@@ -248,14 +340,45 @@ def validate_quantized_params(params: Dict[str, Any], tpu_config) -> None:
     qw dtype must match ``quantization_dtype`` and scale shapes must match
     ``quantization_type`` (an artifact saved per-channel loaded under a
     per-tensor config would otherwise fail deep inside AOT compile)."""
-    np_dt, _ = QUANT_DTYPES[tpu_config.quantization_dtype]
+    want_mx = tpu_config.quantization_dtype == MXFP4
+    np_dt = None if want_mx else QUANT_DTYPES[tpu_config.quantization_dtype][0]
     scheme = tpu_config.quantization_type
     problems = []
 
     def visit(tree, path):
         if not isinstance(tree, dict):
             return
+        if "qw4" in tree:
+            name = ".".join(path)
+            if not want_mx:
+                problems.append(
+                    f"{name}: artifact holds mxfp4 (qw4) but configured "
+                    f"quantization_dtype={tpu_config.quantization_dtype}"
+                )
+                return
+            q4 = tree["qw4"]
+            if np.dtype(q4.dtype) != np.int8:
+                problems.append(f"{name}: qw4 dtype {q4.dtype} != int8")
+            if q4.ndim < 3 or q4.shape[-2] != MXFP4_BLOCK:
+                problems.append(
+                    f"{name}: qw4 shape {tuple(q4.shape)} is not the "
+                    f"(..., nb, {MXFP4_BLOCK}, out) block layout"
+                )
+            elif "scale" not in tree:
+                problems.append(f"{name}: missing mxfp4 scale")
+            elif tuple(tree["scale"].shape) != q4.shape[:-2] + (1, q4.shape[-1]):
+                problems.append(
+                    f"{name}: scale shape {tuple(tree['scale'].shape)} != "
+                    f"{q4.shape[:-2] + (1, q4.shape[-1])}"
+                )
+            return
         if "qw" in tree:
+            if want_mx:
+                problems.append(
+                    ".".join(path) + ": artifact holds qw but configured "
+                    "quantization_dtype=mxfp4 expects qw4 block layout"
+                )
+                return
             name = ".".join(path)
             if np.dtype(tree["qw"].dtype) != np.dtype(np_dt):
                 problems.append(

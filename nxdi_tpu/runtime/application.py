@@ -71,6 +71,7 @@ def maybe_quantize_specs(specs, tc):
     return quant_ops.quantize_param_specs(
         specs, scheme=tc.quantization_type,
         modules_to_not_convert=tc.modules_to_not_convert,
+        quant_dtype=tc.quantization_dtype,
     )
 
 

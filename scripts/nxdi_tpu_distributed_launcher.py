@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Multi-host launcher — the analog of the reference's distributed launcher
+(scripts/nxdi_distributed_launcher.py: MPI command builder :29, torchrun
+rendezvous :71, gloo control plane inference_demo.py:790-798).
+
+On TPU none of MPI/torchrun/gloo is needed: each host runs this launcher,
+which calls ``jax.distributed.initialize`` (bootstrapping the JAX multi-host
+runtime over DCN) and then executes the regular inference_demo CLI. After
+initialization ``jax.devices()`` returns the GLOBAL device list, so the mesh
+construction in nxdi_tpu/parallel/mesh.py spans hosts unchanged — intra-host
+collectives ride ICI, cross-host segments ride DCN, both inserted by GSPMD.
+
+On Cloud TPU pods the coordinator/process-id/process-count are discovered from
+the TPU metadata automatically (``jax.distributed.initialize()`` with no
+args); elsewhere pass them explicitly:
+
+  python scripts/nxdi_tpu_distributed_launcher.py \
+      --coordinator-address host0:8476 --num-processes 4 --process-id $RANK \
+      -- run --model-type llama --model-path ... --tp-degree 32 ...
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="nxdi_tpu_distributed_launcher")
+    parser.add_argument("--coordinator-address", default=None,
+                        help="host:port of process 0 (auto-detected on TPU pods)")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    parser.add_argument("--local-device-ids", default=None,
+                        help="comma-separated device ids this process owns")
+    parser.add_argument("cli_args", nargs=argparse.REMAINDER,
+                        help="arguments after -- go to inference_demo")
+    args = parser.parse_args(argv)
+
+    import jax
+
+    kwargs = {}
+    if args.coordinator_address:
+        kwargs["coordinator_address"] = args.coordinator_address
+    if args.num_processes is not None:
+        kwargs["num_processes"] = args.num_processes
+    if args.process_id is not None:
+        kwargs["process_id"] = args.process_id
+    if args.local_device_ids:
+        kwargs["local_device_ids"] = [
+            int(x) for x in args.local_device_ids.split(",")
+        ]
+    jax.distributed.initialize(**kwargs)
+
+    cli = list(args.cli_args)
+    if cli and cli[0] == "--":
+        cli = cli[1:]
+
+    from nxdi_tpu.cli.inference_demo import main as demo_main
+
+    rc = demo_main(cli)
+    jax.distributed.shutdown()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

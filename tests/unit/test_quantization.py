@@ -149,3 +149,38 @@ def test_should_quantize_filter(scheme):
     assert not q._should_quantize(("layers", "attn", "q_proj"), ["q_proj"])
     assert not q._should_quantize(("layers", "attn", "q_proj"), ["attn.q_proj"])
     assert q._should_quantize(("layers", "attn", "q_proj"), ["k_proj"])
+
+
+def test_mxfp4_roundtrip_grid_exact():
+    """Values ON the E2M1 grid (scaled by a power of two) must round-trip
+    exactly; arbitrary values land within half a grid step of t=w/scale."""
+    import numpy as np
+
+    from nxdi_tpu.ops.quantization import quantize_mxfp4
+
+    rng = np.random.default_rng(0)
+    grid = np.array([0.0, 0.5, 1.0, 1.5, 2.0, 3.0, 4.0, 6.0], dtype=np.float32)
+    vals = rng.choice(np.concatenate([grid, -grid]), size=(64, 16)).astype(np.float32)
+    w = vals * 4.0  # power-of-two block scale
+    qw4, scale = quantize_mxfp4(w)
+    assert qw4.shape == (2, 32, 16) and qw4.dtype == np.int8
+    deq = (qw4.astype(np.float32) * scale).reshape(64, 16)
+    np.testing.assert_array_equal(deq, w)
+
+    w2 = rng.standard_normal((64, 8)).astype(np.float32)
+    qw4, scale = quantize_mxfp4(w2)
+    deq = (qw4.astype(np.float32) * scale).reshape(64, 8)
+    blocks = w2.reshape(2, 32, 8)
+    step = (scale * 2).reshape(2, 1, 8)  # grid granularity near max is coarse;
+    # bound: error <= scale * 1.0 (half the largest grid gap, 6-4=2 -> 1)
+    assert np.all(np.abs(deq.reshape(2, 32, 8) - blocks) <= step * 1.0 + 1e-6)
+
+
+def test_mxfp4_rejects_bad_in_dim():
+    import numpy as np
+    import pytest
+
+    from nxdi_tpu.ops.quantization import quantize_mxfp4
+
+    with pytest.raises(ValueError, match="divisible"):
+        quantize_mxfp4(np.zeros((33, 4), np.float32))
