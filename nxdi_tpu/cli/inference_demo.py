@@ -81,6 +81,11 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--is-eagle3", action="store_true")
     p.add_argument("--is-medusa", action="store_true")
     p.add_argument("--num-medusa-heads", type=int, default=0)
+    p.add_argument(
+        "--medusa-tree", default=None,
+        help="token tree: path to a JSON file of paths, or inline JSON "
+             "(reference: examples/medusa_mc_sim_7b_63.json)",
+    )
 
     # LoRA serving
     p.add_argument("--enable-lora", action="store_true")
@@ -165,12 +170,24 @@ def create_tpu_config(args):
         is_eagle3=args.is_eagle3,
         is_medusa=args.is_medusa,
         num_medusa_heads=args.num_medusa_heads,
+        medusa_tree=_load_medusa_tree(args.medusa_tree),
         quantized=args.quantized,
         quantization_dtype=args.quantization_dtype,
         kv_cache_quant=args.kv_cache_quant,
         skip_warmup=args.skip_warmup,
         lora_config=lora_cfg,
     )
+
+
+def _load_medusa_tree(arg):
+    if not arg:
+        return None
+    import os
+
+    if os.path.exists(arg):
+        with open(arg) as f:
+            return json.load(f)
+    return json.loads(arg)
 
 
 def _resolve_input_ids(args) -> np.ndarray:

@@ -163,7 +163,10 @@ class ContiguousKVLayout:
 
     def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
         B = k_new.shape[0]
-        position_ids = cache_inputs["position_ids"]
+        # tree speculation writes nodes to DISTINCT slots while their rope
+        # positions share depths (speculation/token_tree.py); everywhere else
+        # write slot == rope position
+        position_ids = cache_inputs.get("write_positions", cache_inputs["position_ids"])
         pos = jnp.where(position_ids < 0, k_cache_l.shape[2], position_ids)
         if self.route_by_seq_id:
             b_idx = cache_inputs["seq_ids"][:, None].astype(jnp.int32)

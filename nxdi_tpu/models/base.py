@@ -359,6 +359,22 @@ def attention_block(
         kk, vv, kv_pos = layout.read(new_k, new_v, ci, cache_spec)
         kk = constrain(kk, policy.cache_kv)
         vv = constrain(vv, policy.cache_kv)
+        mask_override = ci.get("attn_mask")
+        if mask_override is not None:
+            # explicit (B, S, W) mask — tree-attention verify passes
+            # (speculation/token_tree.py) where causal-by-position is wrong.
+            # Sink/softcap still apply; window/chunk masks cannot compose with
+            # an override (applications reject those combinations up front).
+            W = kk.shape[2]
+            ctx = attn_ops.grouped_attention(
+                q, kk, vv, mask_override[:, :, :W],
+                scale=arch.attention_scale, softmax_dtype=jnp.float32,
+                sink=p_attn.get("sink") if arch.attention_sink else None,
+                logit_softcap=arch.attn_logit_softcap,
+            )
+            ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+            out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
+            return out, (new_k, new_v)
         ctx = None
         if (
             arch.attn_tkg_kernel_enabled
@@ -651,7 +667,9 @@ def causal_lm_forward(
     else:
         cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
     cache_inputs = {
-        k: batch[k] for k in ("seq_ids", "slot_mapping", "block_table") if k in batch
+        k: batch[k]
+        for k in ("seq_ids", "slot_mapping", "block_table", "write_positions", "attn_mask")
+        if k in batch
     }
     captured: Dict[str, jax.Array] = {}
     if tensor_capture and "embeds" in tensor_capture:

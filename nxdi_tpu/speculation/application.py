@@ -322,6 +322,23 @@ class MedusaCausalLM(TpuModelForCausalLM):
             raise ValueError("MedusaCausalLM requires is_medusa and num_medusa_heads >= 1")
         if tc.is_block_kv_layout:
             raise ValueError("medusa does not support the block KV layout yet")
+        self.tree = None
+        if tc.medusa_tree:
+            from nxdi_tpu.speculation.token_tree import TokenTree
+
+            self.tree = TokenTree.from_choices(tc.medusa_tree)
+            if self.tree.max_depth > self.num_heads:
+                raise ValueError(
+                    f"medusa_tree depth {self.tree.max_depth} exceeds "
+                    f"num_medusa_heads {self.num_heads}"
+                )
+            arch = self.family.build_arch(self.config)
+            if arch.sliding_window is not None or arch.chunk_size is not None:
+                raise ValueError(
+                    "medusa tree decoding does not support sliding-window or "
+                    "chunked-attention targets yet: the tree-attention mask "
+                    "override cannot compose with position-window masks"
+                )
 
     # -- params: target + stacked heads --
     def build_params(self):
@@ -392,10 +409,15 @@ class MedusaCausalLM(TpuModelForCausalLM):
         }
         return struct
 
-    # -- cache pytree gains the proposal buffer --
+    # -- cache pytree gains the proposal buffer (per-head top-K; chain = 1) --
     def _proposal_shape(self):
         tc = self.tpu_config
-        return (tc.kv_cache_batch_size + tc.kv_cache_padding_size, self.num_heads)
+        topk = self.tree.max_branch if self.tree is not None else 1
+        return (
+            tc.kv_cache_batch_size + tc.kv_cache_padding_size,
+            self.num_heads,
+            topk,
+        )
 
     def init_cache_host(self):
         import jax.numpy as jnp
@@ -437,6 +459,7 @@ class MedusaCausalLM(TpuModelForCausalLM):
             attend_to_cache=False,
             forward_kwargs={},
             num_heads=self.num_heads,
+            tree=self.tree,
         )
         self.models[TAG_MEDUSA_SPECULATION] = MedusaWrapper(
             TAG_MEDUSA_SPECULATION,
@@ -449,6 +472,7 @@ class MedusaCausalLM(TpuModelForCausalLM):
             attend_to_cache=True,
             forward_kwargs={},
             num_heads=self.num_heads,
+            tree=self.tree,
         )
 
     def forward(self, input_ids, position_ids, **kwargs):

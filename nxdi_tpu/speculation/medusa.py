@@ -37,17 +37,19 @@ from nxdi_tpu.speculation.eagle import _feature_rows
 
 
 def medusa_propose(
-    heads: Dict[str, jax.Array], hidden: jax.Array, vocab_pad: int
+    heads: Dict[str, jax.Array], hidden: jax.Array, vocab_pad: int, topk: int = 1
 ) -> jax.Array:
-    """Top-1 proposal from every head. ``hidden`` (B, H) is the post-norm
-    hidden that also feeds the lm_head (reference: heads consume the same
-    stream, modeling_llama.py:1420). Heads are stacked (K, ...) and evaluated
-    in one einsum each: ResBlock (x + silu(xW+b)) then a head lm_head."""
+    """Top-K proposals from every head -> (B, num_heads, topk). ``hidden``
+    (B, H) is the post-norm hidden that also feeds the lm_head (reference:
+    heads consume the same stream, modeling_llama.py:1420). Heads are stacked
+    (K, ...) and evaluated in one einsum each: ResBlock (x + silu(xW+b)) then
+    a head lm_head. Chain decoding uses topk=1; tree decoding branches."""
     x = jnp.einsum("bh,khg->bkg", hidden, heads["res_w"]) + heads["res_b"][None]
     x = hidden[:, None, :] + jax.nn.silu(x)  # (B, K, H)
     logits = jnp.einsum("bkh,khv->bkv", x, heads["head"]).astype(jnp.float32)
     logits = sampling_ops.mask_padded_logits(logits, vocab_pad)
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32)  # (B, K)
+    _, idx = jax.lax.top_k(logits, topk)
+    return idx.astype(jnp.int32)  # (B, num_heads, topk)
 
 
 def _post_norm_hidden_at(arch, params, hidden_stream: jax.Array, idx: jax.Array):
@@ -92,7 +94,8 @@ def medusa_context_encoding(
     )
     B = batch["input_ids"].shape[0]
     h = _post_norm_hidden_at(arch, params, out["hidden"], batch["last_token_index"])
-    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad)
+    topk = cache["medusa_tokens"].shape[-1]
+    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad, topk)
     rows = _feature_rows(batch, B)
     buf = cache["medusa_tokens"].at[rows].set(proposals)
     outputs = {"tokens": out["tokens"], "counts": jnp.ones((B,), jnp.int32)}
@@ -120,7 +123,7 @@ def medusa_token_gen(
     tok0 = batch["input_ids"].astype(jnp.int32)  # (B, 1) last accepted token
     pos0 = batch["position_ids"].astype(jnp.int32)
     rows = _feature_rows(batch, B)
-    proposals = cache["medusa_tokens"][rows]  # (B, K)
+    proposals = cache["medusa_tokens"][rows][..., 0]  # (B, K) chain = top-1
 
     candidates = jnp.concatenate([tok0, proposals], axis=1)  # (B, K+1)
     positions = pos0 + jnp.arange(num_heads + 1, dtype=jnp.int32)[None, :]
@@ -160,7 +163,8 @@ def medusa_token_gen(
         jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, num_heads + 1
     )
     h = _post_norm_hidden_at(arch, params, out["hidden"], retire - 1)
-    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad)
+    topk = cache["medusa_tokens"].shape[-1]
+    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad, topk)
     buf = cache["medusa_tokens"].at[rows].set(proposals)
 
     return {"tokens": target_tokens, "counts": counts}, {
@@ -169,17 +173,143 @@ def medusa_token_gen(
     }
 
 
+def medusa_tree_token_gen(
+    arch,
+    inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    *,
+    tree,
+    num_heads: int,
+    kv_window: int,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """One TREE speculation window (reference: the medusa tree flow,
+    examples/medusa_mc_sim_7b_63.json + model_base.py:450): one verify
+    dispatch scores every tree node; nodes share rope positions by depth but
+    write distinct KV slots; the best accepted path's KV is gathered into the
+    contiguous positions the next window expects."""
+    from nxdi_tpu.speculation.token_tree import (
+        best_path_acceptance,
+        gather_tree_candidates,
+        tree_verify_mask,
+    )
+
+    B = batch["input_ids"].shape[0]
+    tok0 = batch["input_ids"].astype(jnp.int32)
+    pos0 = batch["position_ids"].astype(jnp.int32)  # (B, 1)
+    rows = _feature_rows(batch, B)
+    proposals = cache["medusa_tokens"][rows]  # (B, num_heads, K)
+
+    N, D = tree.num_nodes, tree.max_depth
+    candidates = gather_tree_candidates(tree, tok0, proposals)  # (B, 1+N)
+    depth_row = jnp.asarray([0] + list(tree.node_depth), jnp.int32)[None, :]
+    rope_pos = pos0 + depth_row  # (B, 1+N)
+    write_pos = pos0 + jnp.arange(N + 1, dtype=jnp.int32)[None, :]  # distinct slots
+    mask = tree_verify_mask(tree, pos0[:, 0], kv_window)
+
+    tbatch = {
+        "input_ids": candidates,
+        "position_ids": rope_pos,
+        "write_positions": write_pos,
+        "attn_mask": mask,
+        "last_token_index": jnp.zeros((B,), jnp.int32),
+        "sampling_params": batch["sampling_params"],
+    }
+    if "seq_ids" in batch:
+        tbatch["seq_ids"] = batch["seq_ids"]
+    kv = {"k": cache["k"], "v": cache["v"]}
+    out, new_kv = causal_lm_forward(
+        arch,
+        inv_freq,
+        params,
+        kv,
+        tbatch,
+        attend_to_cache=True,
+        kv_window=kv_window,
+        policy=policy,
+        layout=layout,
+        gather_last_token=False,
+        output_all_logits=True,
+        on_device_sampling=False,
+        output_hidden=True,
+    )
+    target_tokens = jnp.argmax(out["logits"], axis=-1).astype(jnp.int32)  # (B, 1+N)
+
+    counts, best_path, emit_rows = best_path_acceptance(tree, candidates, target_tokens)
+
+    # near the window edge some tree-node KV writes fall past the compiled
+    # window (slot pos0+1+node_idx, dropped by the scatter) and their verify
+    # rows read a clipped mask — their tokens are garbage. The ROOT row only
+    # attends the committed prefix, so degrade to one token per step there
+    # (the host's position-based clamp cannot see slot overflow in tree mode).
+    tree_fits = pos0[:, 0] + 1 + N <= kv_window
+    counts = jnp.where(tree_fits, counts, 1)
+    tokens_out = jnp.take_along_axis(target_tokens, emit_rows, axis=1)  # (B, 1+D)
+
+    # KV fix-up: best path nodes' KV from their tree slots -> contiguous
+    # slots, routed by the same cache lines the layout writes (seq_ids under
+    # continuous batching)
+    src = pos0 + 1 + jnp.clip(best_path, 0)  # (B, D)
+    dest = pos0 + 1 + jnp.arange(D, dtype=jnp.int32)[None, :]
+    b_idx = rows[:, None]
+
+    def fixup(cache_arr):  # (L, B, KV, S, Dh)
+        def per_layer(cl):
+            KVh, Dh = cl.shape[1], cl.shape[3]
+            lines = jnp.take(cl, rows, axis=0)  # route like the layout does
+            gathered = jnp.take_along_axis(
+                lines,
+                jnp.clip(src, 0, cl.shape[2] - 1)[:, None, :, None].astype(jnp.int32)
+                * jnp.ones((1, KVh, 1, Dh), jnp.int32),
+                axis=2,
+            )  # (B, KV, D, Dh)
+            vals = jnp.swapaxes(gathered, 1, 2)  # (B, D, KV, Dh)
+            return cl.at[b_idx, :, dest].set(vals, mode="drop")
+
+        return jax.vmap(per_layer)(cache_arr)
+
+    new_kv = {"k": fixup(new_kv["k"]), "v": fixup(new_kv["v"])}
+
+    # refresh proposals from the last RETIRED row's hidden (host clamps to the
+    # window edge; mirror it)
+    retire = jnp.clip(jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, D + 1)
+    last_row = jnp.take_along_axis(emit_rows, (retire - 1)[:, None], axis=1)[:, 0]
+    h = _post_norm_hidden_at(arch, params, out["hidden"], last_row)
+    topk = cache["medusa_tokens"].shape[-1]
+    proposals = medusa_propose(params["medusa_heads"], h, arch.vocab_pad, topk)
+    buf = cache["medusa_tokens"].at[rows].set(proposals)
+
+    return {"tokens": tokens_out, "counts": counts}, {**new_kv, "medusa_tokens": buf}
+
+
 class MedusaWrapper(ModelWrapper):
     """ModelWrapper compiling the medusa graphs (reference: the
     medusa_speculation_model ModelWrapper, model_base.py:3209)."""
 
-    def __init__(self, *args, num_heads: int, **kwargs):
+    def __init__(self, *args, num_heads: int, tree=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.num_heads = num_heads
+        self.tree = tree
         if self.attend_to_cache:
-            self.lookahead = num_heads + 1
+            # chain writes num_heads+1 slots ahead; a tree writes one slot per
+            # NODE (plus the root)
+            self.lookahead = (tree.num_nodes + 1) if tree is not None else num_heads + 1
 
     def make_forward(self, bucket: int):
+        if self.attend_to_cache and self.tree is not None:
+            return partial(
+                medusa_tree_token_gen,
+                self.arch,
+                self.inv_freq,
+                tree=self.tree,
+                num_heads=self.num_heads,
+                kv_window=bucket,
+                policy=self.policy,
+                layout=self.layout,
+            )
         if self.attend_to_cache:
             return partial(
                 medusa_token_gen,

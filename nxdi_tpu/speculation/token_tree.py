@@ -1,0 +1,169 @@
+"""Token-tree speculation — tree-attention verify for Medusa.
+
+The analog of the reference's ``TokenTree`` (modules/eagle/token_tree.py:8:
+adjacency-list config -> masks, paths, permutes, rotary offsets) and the
+medusa tree flow (examples/medusa_mc_sim_7b_63.json,
+``_medusa_forward`` model_base.py:450).
+
+A tree is specified HF-medusa style as a list of paths, each path a tuple of
+per-depth child indices, e.g. ``[[0], [1], [0,0], [0,1], [1,0], [0,0,0]]``:
+node ``[0,0]`` is head-2's top-1 continuation of head-1's top-1 proposal.
+
+One verify dispatch scores the WHOLE tree: node tokens come from the per-head
+top-K proposal buffer; nodes share rope positions by depth but write DISTINCT
+KV slots (``write_positions`` in kvcache/kv_cache.py); attention uses an
+explicit ancestor mask (``attn_mask`` override in models/base.py). After
+acceptance the best path's KV is gathered from its scattered tree slots into
+the contiguous positions the next window expects — the in-graph analog of the
+reference's accepted-indices KV gather (kv_cache_manager.py:266
+``configure_medusa_gather_slice_idx``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class TokenTree:
+    """Static tree structure (hashable arrays via tuples; built once)."""
+
+    num_nodes: int
+    max_depth: int
+    max_branch: int
+    node_depth: Tuple[int, ...]  # depth per node, 1-based (root prompt token = 0)
+    node_head: Tuple[int, ...]  # which medusa head proposes this node (depth-1)
+    node_child: Tuple[int, ...]  # which top-k slot of that head
+    node_parent: Tuple[int, ...]  # node index of parent, -1 = root
+    # leaf-to-root enumerations of every ROOT-to-node path, padded with -1
+    paths: Tuple[Tuple[int, ...], ...]  # (num_paths, max_depth) node indices
+    ancestors: Tuple[Tuple[bool, ...], ...]  # (N, N): ancestors[i][j] = j is ancestor-or-self of i
+
+    @staticmethod
+    def from_choices(choices: Sequence[Sequence[int]]) -> "TokenTree":
+        """Build from the HF-medusa path list. Implicit parents are added
+        (e.g. [0,0] requires [0])."""
+        node_set = set()
+        for path in choices:
+            for d in range(1, len(path) + 1):
+                node_set.add(tuple(path[:d]))
+        nodes: List[Tuple[int, ...]] = sorted(node_set, key=lambda p: (len(p), p))
+        index = {p: i for i, p in enumerate(nodes)}
+        N = len(nodes)
+        depth = [len(p) for p in nodes]
+        head = [len(p) - 1 for p in nodes]
+        child = [p[-1] for p in nodes]
+        parent = [index[p[:-1]] if len(p) > 1 else -1 for p in nodes]
+
+        anc = [[False] * N for _ in range(N)]
+        for i, p in enumerate(nodes):
+            for d in range(1, len(p) + 1):
+                anc[i][index[p[:d]]] = True
+
+        max_depth = max(depth)
+        # every node defines a root-to-node path (acceptance considers all)
+        paths = []
+        for i, p in enumerate(nodes):
+            chain = [index[p[:d]] for d in range(1, len(p) + 1)]
+            paths.append(tuple(chain + [-1] * (max_depth - len(chain))))
+        return TokenTree(
+            num_nodes=N,
+            max_depth=max_depth,
+            max_branch=max(child) + 1,
+            node_depth=tuple(depth),
+            node_head=tuple(head),
+            node_child=tuple(child),
+            node_parent=tuple(parent),
+            paths=tuple(paths),
+            ancestors=tuple(tuple(r) for r in anc),
+        )
+
+
+def tree_verify_mask(tree: TokenTree, pos0: jax.Array, kv_width: int) -> jax.Array:
+    """(B, 1+N, kv_width) attention mask for the verify dispatch.
+
+    Row 0 is the root (the last accepted token at position pos0): attends the
+    committed prefix (slots <= pos0). Row 1+i is tree node i at slot
+    pos0+1+i: attends the prefix, the root, and its ancestor nodes + itself.
+    """
+    B = pos0.shape[0]
+    N = tree.num_nodes
+    slots = jnp.arange(kv_width, dtype=jnp.int32)[None, :]  # (1, W)
+    prefix = slots <= pos0[:, None]  # incl. the root's own slot (B, W)
+
+    anc = jnp.asarray(np.array(tree.ancestors, dtype=bool))  # (N, N)
+    # one vectorized scatter: node j occupies kv slot pos0+1+j; row i may
+    # attend slot(j) iff anc[i, j]
+    node_slot = jnp.clip(
+        pos0[:, None] + 1 + jnp.arange(N, dtype=jnp.int32)[None, :], 0, kv_width - 1
+    )  # (B, N)
+    node_rows = jnp.zeros((B, N, kv_width), bool)
+    node_rows = node_rows.at[
+        jnp.arange(B)[:, None, None],
+        jnp.arange(N)[None, :, None],
+        node_slot[:, None, :],
+    ].max(jnp.broadcast_to(anc[None], (B, N, N)))
+    rows = prefix[:, None, :] | jnp.concatenate(
+        [jnp.zeros((B, 1, kv_width), bool), node_rows], axis=1
+    )
+    return rows  # (B, 1+N, W)
+
+
+def gather_tree_candidates(
+    tree: TokenTree, tok0: jax.Array, proposals: jax.Array
+) -> jax.Array:
+    """tok0 (B, 1) + proposal buffer (B, num_heads, K) -> (B, 1+N) candidates
+    in node order."""
+    head = jnp.asarray(tree.node_head)
+    child = jnp.asarray(tree.node_child)
+    node_toks = proposals[:, head, child]  # (B, N)
+    return jnp.concatenate([tok0, node_toks.astype(jnp.int32)], axis=1)
+
+
+def best_path_acceptance(
+    tree: TokenTree, candidates: jax.Array, target_tokens: jax.Array
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Greedy tree acceptance.
+
+    ``candidates``/(B, 1+N) node tokens (row 0 = root);
+    ``target_tokens`` (B, 1+N) the target's greedy token at each row.
+    A node is CORRECT if its token equals the target's greedy choice at its
+    parent row. Returns (counts, best_path_nodes, emit_rows):
+      counts (B,): accepted nodes on the best path + 1 (bonus);
+      best_path_nodes (B, max_depth): node indices of the best path (-1 pad);
+      emit_rows (B, 1+max_depth): row indices whose target tokens are emitted
+      (root, then the accepted path nodes — padded by repeating the last).
+    """
+    B = candidates.shape[0]
+    parent_row = jnp.asarray([0] + [p + 1 for p in tree.node_parent])  # per row
+    # correctness per node row (row 0 root is trivially correct)
+    parent_of_rows = parent_row[1:]  # (N,)
+    correct = candidates[:, 1:] == jnp.take_along_axis(
+        target_tokens, jnp.broadcast_to(parent_of_rows[None, :], (B, tree.num_nodes)), axis=1
+    )  # (B, N)
+
+    paths = jnp.asarray(np.array(tree.paths))  # (P, D) node indices, -1 pad
+    valid = paths >= 0
+    path_correct = jnp.where(
+        valid[None], jnp.take(correct, jnp.clip(paths, 0), axis=1), False
+    )  # (B, P, D)
+    accepted_len = jnp.sum(jnp.cumprod(path_correct.astype(jnp.int32), axis=2), axis=2)
+    best = jnp.argmax(accepted_len, axis=1)  # (B,)
+    best_len = jnp.take_along_axis(accepted_len, best[:, None], axis=1)[:, 0]
+    best_path = paths[best]  # (B, D)
+    counts = best_len + 1
+
+    # rows to emit target tokens from: root, then accepted path nodes; pad by
+    # clamping to the last accepted entry (host discards past counts anyway)
+    D = tree.max_depth
+    j = jnp.arange(D, dtype=jnp.int32)[None, :]
+    path_rows = jnp.where(j < best_len[:, None], best_path + 1, 0)
+    emit_rows = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), path_rows.astype(jnp.int32)], axis=1
+    )
+    return counts, best_path, emit_rows

@@ -115,3 +115,65 @@ def test_medusa_requires_heads_config():
     cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
     with pytest.raises(ValueError, match="is_medusa"):
         MedusaCausalLM("<target>", cfg, model_family=llama)
+
+
+def _build_medusa_tree_app(target, target_cfg, num_heads, tree, **extra):
+    sd = _with_medusa_heads(
+        {k: v.detach().numpy() for k, v in target.state_dict().items()},
+        num_heads,
+        seed=11,
+    )
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True, is_medusa=True, num_medusa_heads=num_heads,
+        medusa_tree=tree, **extra,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: target_cfg.to_dict())
+
+    class App(MedusaCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<target>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+MC_TREE = [[0], [1], [0, 0], [0, 1], [1, 0], [0, 0, 0]]
+
+
+def test_medusa_tree_matches_hf_greedy():
+    """Tree-attention verify (distinct KV slots, shared rope depths, ancestor
+    masks, best-path KV gather) must stay bit-identical to target greedy."""
+    target, target_cfg = _tiny_hf_llama(seed=0)
+    app = _build_medusa_tree_app(target, target_cfg, num_heads=3, tree=MC_TREE)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=20)
+    actual = adapter.generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_medusa_tree_fills_cache():
+    target, target_cfg = _tiny_hf_llama(seed=0)
+    app = _build_medusa_tree_app(target, target_cfg, num_heads=3, tree=MC_TREE)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(target, prompt, max_new_tokens=48)
+    actual = adapter.generate(prompt, max_new_tokens=48)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_token_tree_structure():
+    from nxdi_tpu.speculation.token_tree import TokenTree
+
+    tree = TokenTree.from_choices(MC_TREE)
+    assert tree.num_nodes == 6
+    assert tree.max_depth == 3
+    assert tree.max_branch == 2
+    # [0,0,0]'s ancestors: itself, [0,0], [0]
+    i = sorted({(0,), (1,), (0, 0), (0, 1), (1, 0), (0, 0, 0)},
+               key=lambda p: (len(p), p)).index((0, 0, 0))
+    assert sum(tree.ancestors[i]) == 3
