@@ -57,8 +57,28 @@ def build_inv_freq(config: InferenceConfig) -> np.ndarray:
     return dense.build_inv_freq(config)
 
 
-def build_vision_arch(config: InferenceConfig) -> vision_ops.ClipVisionArch:
+def _is_pixtral(config: InferenceConfig) -> bool:
+    return config.vision_config.get("model_type") == "pixtral"
+
+
+def build_vision_arch(config: InferenceConfig):
     vc = config.vision_config
+    if _is_pixtral(config):
+        fl = getattr(config, "vision_feature_layer", -1)
+        return vision_ops.PixtralVisionArch(
+            hidden_size=vc["hidden_size"],
+            intermediate_size=vc["intermediate_size"],
+            num_layers=vc["num_hidden_layers"],
+            num_heads=vc["num_attention_heads"],
+            image_size=vc["image_size"],
+            patch_size=vc["patch_size"],
+            num_channels=vc.get("num_channels", 3),
+            rope_theta=vc.get("rope_theta", 10000.0),
+            rms_norm_eps=vc.get("rms_norm_eps", 1e-5),
+            hidden_act=vc.get("hidden_act", "gelu"),
+            feature_layer=fl if fl is not None else -1,
+            projector_act=getattr(config, "projector_hidden_act", "gelu"),
+        )
     return vision_ops.ClipVisionArch(
         hidden_size=vc["hidden_size"],
         intermediate_size=vc["intermediate_size"],
@@ -91,20 +111,29 @@ def convert_vision_params(
     state_dict: Dict[str, np.ndarray], config: InferenceConfig
 ) -> Dict[str, Any]:
     varch = build_vision_arch(config)
+    if isinstance(varch, vision_ops.PixtralVisionArch):
+        vision = vision_ops.convert_pixtral_vision(state_dict, varch)
+    else:
+        vision = vision_ops.convert_clip_vision(state_dict, varch)
     return {
-        "vision": vision_ops.convert_clip_vision(state_dict, varch),
+        "vision": vision,
         "projector": vision_ops.convert_llava_projector(state_dict),
     }
 
 
 def encode_images(varch, params: Dict[str, Any], pixel_values):
-    feat = vision_ops.clip_vision_forward(varch, params["vision"], pixel_values)
+    if isinstance(varch, vision_ops.PixtralVisionArch):
+        feat = vision_ops.pixtral_vision_forward(varch, params["vision"], pixel_values)
+    else:
+        feat = vision_ops.clip_vision_forward(varch, params["vision"], pixel_values)
     return vision_ops.project_image_features(varch, params["projector"], feat)
 
 
 def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
     """ShapeDtypeStructs matching convert_vision_params (for AOT compile)."""
     varch = build_vision_arch(config)
+    if isinstance(varch, vision_ops.PixtralVisionArch):
+        return _pixtral_shape_struct(config, varch)
     Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
     Ht = config.hidden_size
     P2 = varch.num_channels * varch.patch_size ** 2
@@ -143,3 +172,36 @@ def param_specs(config: InferenceConfig):
 
 def param_shape_struct(config: InferenceConfig):
     return dense.param_shape_struct(config, build_arch(config))
+
+
+def _pixtral_shape_struct(config: InferenceConfig, varch) -> Dict[str, Any]:
+    Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    Ht = config.hidden_size
+    P2 = varch.num_channels * varch.patch_size ** 2
+    f32 = np.float32
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, f32)
+
+    return {
+        "vision": {
+            "patch_embedding": s(P2, Hv),
+            "ln_pre": s(Hv),
+            "rope_table": s(varch.num_patches, Hv // varch.num_heads),
+            "layers": {
+                "q_proj": s(L, Hv, Hv),
+                "k_proj": s(L, Hv, Hv),
+                "v_proj": s(L, Hv, Hv),
+                "o_proj": s(L, Hv, Hv),
+                "attention_norm": s(L, Hv),
+                "ffn_norm": s(L, Hv),
+                "gate_proj": s(L, Hv, Iv),
+                "up_proj": s(L, Hv, Iv),
+                "down_proj": s(L, Iv, Hv),
+            },
+        },
+        "projector": {
+            "linear_1": {"w": s(Hv, Ht), "b": s(Ht)},
+            "linear_2": {"w": s(Ht, Ht), "b": s(Ht)},
+        },
+    }

@@ -29,6 +29,7 @@ ACTS = {
     "quick_gelu": lambda x: x * jax.nn.sigmoid(1.702 * x),
     "gelu": jax.nn.gelu,
     "gelu_pytorch_tanh": lambda x: jax.nn.gelu(x, approximate=True),
+    "silu": jax.nn.silu,
 }
 
 
@@ -185,4 +186,147 @@ def convert_llava_projector(
     return {
         "linear_1": {"w": get("linear_1.weight").T, "b": get("linear_1.bias")},
         "linear_2": {"w": get("linear_2.weight").T, "b": get("linear_2.bias")},
+    }
+
+
+# ---------------------------------------------------------------------------
+# Pixtral vision tower (mistral-lineage ViT with 2-D rope, no CLS)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class PixtralVisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int = 3
+    rope_theta: float = 10000.0
+    rms_norm_eps: float = 1e-5
+    feature_layer: int = -1  # pixtral-llava taps the LAST layer, keeps all patches
+    hidden_act: str = "gelu"  # HF PixtralVisionConfig default (NOT silu)
+    projector_act: str = "gelu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.grid ** 2
+
+
+def pixtral_rope_table(arch: PixtralVisionArch) -> np.ndarray:
+    """(grid^2, head_dim) angle table: h rows use even freqs, w columns odd
+    freqs, concatenated twice for the rotate-half convention (HF
+    PixtralRotaryEmbedding)."""
+    dim = arch.head_dim
+    freqs = 1.0 / (arch.rope_theta ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    g = arch.grid
+    h = np.arange(g, dtype=np.float64)
+    freqs_h = np.outer(h, freqs[::2])  # (g, dim/4)
+    freqs_w = np.outer(h, freqs[1::2])
+    table = np.concatenate(
+        [
+            np.repeat(freqs_h[:, None, :], g, axis=1),
+            np.repeat(freqs_w[None, :, :], g, axis=0),
+        ],
+        axis=-1,
+    ).reshape(g * g, dim // 2)
+    return np.concatenate([table, table], axis=-1).astype(np.float32)
+
+
+def pixtral_vision_forward(
+    arch: PixtralVisionArch, params: Dict[str, Any], pixel_values: jax.Array
+) -> jax.Array:
+    """(B, C, H, W) -> (B, N, hidden). Each image attends fully within itself
+    (HF runs all images as one block-masked sequence; per-image batching is
+    the equivalent factorization)."""
+    from nxdi_tpu.ops.norms import rms_norm
+    from nxdi_tpu.ops.rope import rotate_half
+
+    B = pixel_values.shape[0]
+    P, C, H = arch.patch_size, arch.num_channels, arch.hidden_size
+    g = arch.grid
+    x = pixel_values.reshape(B, C, g, P, g, P)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(B, g * g, C * P * P)
+    h = x @ params["patch_embedding"]  # (B, N, H)
+    h = rms_norm(h, params["ln_pre"], arch.rms_norm_eps)
+
+    # 2-D rope: position of patch (r, c) is r*grid + c; full-resolution images
+    # cover the whole table in row-major order
+    angles = params["rope_table"]  # (N, head_dim)
+    cos = jnp.cos(angles)[None, None]  # (1, 1, N, D)
+    sin = jnp.sin(angles)[None, None]
+
+    nH, D = arch.num_heads, arch.head_dim
+
+    def attn(lp, y):
+        q = jnp.swapaxes((y @ lp["q_proj"]).reshape(B, -1, nH, D), 1, 2)
+        k = jnp.swapaxes((y @ lp["k_proj"]).reshape(B, -1, nH, D), 1, 2)
+        v = jnp.swapaxes((y @ lp["v_proj"]).reshape(B, -1, nH, D), 1, 2)
+        q = (q * cos + rotate_half(q) * sin).astype(y.dtype)
+        k = (k * cos + rotate_half(k) * sin).astype(y.dtype)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
+        w = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        return jnp.swapaxes(ctx, 1, 2).reshape(B, -1, H) @ lp["o_proj"]
+
+    act = ACTS.get(arch.hidden_act, jax.nn.silu)
+
+    def body(carry, lp):
+        res = carry
+        res = res + attn(lp, rms_norm(res, lp["attention_norm"], arch.rms_norm_eps))
+        y = rms_norm(res, lp["ffn_norm"], arch.rms_norm_eps)
+        y = act(y @ lp["gate_proj"]) * (y @ lp["up_proj"])
+        res = res + y @ lp["down_proj"]
+        return res, res
+
+    idx = arch.feature_layer % (arch.num_layers + 1)
+    if idx == 0:
+        return h
+    used = jax.tree_util.tree_map(lambda a: a[:idx], params["layers"])
+    feat, _ = jax.lax.scan(lambda c, lp: (body(c, lp)[0], None), h, used)
+    return feat
+
+
+def convert_pixtral_vision(
+    state_dict: Dict[str, np.ndarray],
+    arch: PixtralVisionArch,
+    prefix: str = "vision_tower.",
+    dtype=np.float32,
+) -> Dict[str, Any]:
+    def get(name):
+        for k in (prefix + name, "model." + prefix + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=dtype)
+        raise KeyError(prefix + name)
+
+    conv = get("patch_conv.weight")  # (H, C, P, P)
+    layers = []
+    for i in range(arch.num_layers):
+        pre = f"transformer.layers.{i}."
+        layers.append({
+            "q_proj": get(pre + "attention.q_proj.weight").T,
+            "k_proj": get(pre + "attention.k_proj.weight").T,
+            "v_proj": get(pre + "attention.v_proj.weight").T,
+            "o_proj": get(pre + "attention.o_proj.weight").T,
+            "attention_norm": get(pre + "attention_norm.weight"),
+            "ffn_norm": get(pre + "ffn_norm.weight"),
+            "gate_proj": get(pre + "feed_forward.gate_proj.weight").T,
+            "up_proj": get(pre + "feed_forward.up_proj.weight").T,
+            "down_proj": get(pre + "feed_forward.down_proj.weight").T,
+        })
+    import jax.tree_util as jtu
+
+    return {
+        "patch_embedding": conv.reshape(conv.shape[0], -1).T,
+        "ln_pre": get("ln_pre.weight"),
+        "rope_table": pixtral_rope_table(arch),
+        "layers": jtu.tree_map(lambda *xs: np.stack(xs), *layers),
     }

@@ -121,3 +121,98 @@ def test_llava_text_only_prompt_still_works():
         expected = hf.generate(torch.tensor(ids), max_new_tokens=8, do_sample=False).numpy()
     actual = adapter.generate(ids, max_new_tokens=8)
     np.testing.assert_array_equal(actual, expected)
+
+
+def _tiny_hf_pixtral_llava(seed=0):
+    import torch
+    from transformers import (
+        LlavaConfig,
+        LlavaForConditionalGeneration,
+        MistralConfig,
+        PixtralVisionConfig,
+    )
+
+    torch.manual_seed(seed)
+    vc = PixtralVisionConfig(
+        hidden_size=32, intermediate_size=64, num_hidden_layers=2,
+        num_attention_heads=2, image_size=32, patch_size=16, rope_theta=10000.0,
+    )
+    tc = MistralConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        sliding_window=None, tie_word_embeddings=False,
+    )
+    cfg = LlavaConfig(
+        vision_config=vc, text_config=tc, image_token_index=IMAGE_TOKEN,
+        vision_feature_layer=-1, vision_feature_select_strategy="full",
+        projector_hidden_act="gelu",
+    )
+    return LlavaForConditionalGeneration(cfg).eval(), cfg
+
+
+def test_pixtral_llava_matches_hf_greedy():
+    """Pixtral vision tower (2-D rope, no CLS, mistral-lineage blocks) inside
+    the llava pipeline — exact token match."""
+    import torch
+
+    hf, hf_cfg = _tiny_hf_pixtral_llava()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = modeling_llava.LlavaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(ImageToTextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=modeling_llava)
+    app.load()
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    rng = np.random.default_rng(3)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    ids = _prompt_with_image()
+
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.tensor(ids), pixel_values=torch.tensor(pixels),
+            max_new_tokens=12, do_sample=False,
+        ).numpy()
+    actual = adapter.generate(ids, pixel_values=pixels, max_new_tokens=12)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_pixtral_vision_features_match_hf():
+    """The pixtral tower+projector in isolation must match HF's projected
+    features to near float precision (token matching alone can mask small
+    numerical drift on tiny random models)."""
+    import torch
+
+    hf, hf_cfg = _tiny_hf_pixtral_llava()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = modeling_llava.LlavaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(ImageToTextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=modeling_llava)
+    app.load()
+    rng = np.random.default_rng(4)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    with torch.no_grad():
+        expected = hf.get_image_features(torch.tensor(pixels))
+        if isinstance(expected, (list, tuple)):
+            expected = expected[0]
+        expected = expected.numpy()
+    actual = np.asarray(app.encode_images(pixels))
+    np.testing.assert_allclose(actual.reshape(expected.shape), expected, atol=3e-5)
