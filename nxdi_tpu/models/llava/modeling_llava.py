@@ -64,6 +64,13 @@ def _is_pixtral(config: InferenceConfig) -> bool:
 def build_vision_arch(config: InferenceConfig):
     vc = config.vision_config
     if _is_pixtral(config):
+        strategy = getattr(config, "vision_feature_select_strategy", "full")
+        if strategy != "full":
+            raise NotImplementedError(
+                f"pixtral vision supports vision_feature_select_strategy='full' "
+                f"only (got {strategy!r}); the CLS-dropping 'default' strategy "
+                "belongs to CLIP-style towers"
+            )
         fl = getattr(config, "vision_feature_layer", -1)
         return vision_ops.PixtralVisionArch(
             hidden_size=vc["hidden_size"],
@@ -129,18 +136,26 @@ def encode_images(varch, params: Dict[str, Any], pixel_values):
     return vision_ops.project_image_features(varch, params["projector"], feat)
 
 
+def _struct(*shape):
+    return jax.ShapeDtypeStruct(shape, np.float32)
+
+
+def _projector_struct(vision_hidden: int, text_hidden: int) -> Dict[str, Any]:
+    s = _struct
+    return {
+        "linear_1": {"w": s(vision_hidden, text_hidden), "b": s(text_hidden)},
+        "linear_2": {"w": s(text_hidden, text_hidden), "b": s(text_hidden)},
+    }
+
+
 def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
     """ShapeDtypeStructs matching convert_vision_params (for AOT compile)."""
     varch = build_vision_arch(config)
     if isinstance(varch, vision_ops.PixtralVisionArch):
         return _pixtral_shape_struct(config, varch)
     Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
-    Ht = config.hidden_size
     P2 = varch.num_channels * varch.patch_size ** 2
-    f32 = np.float32
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, f32)
+    s = _struct
 
     lin = lambda i, o: {"w": s(L, i, o), "b": s(L, o)}  # noqa: E731
     return {
@@ -159,10 +174,7 @@ def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
                 "fc2": lin(Iv, Hv),
             },
         },
-        "projector": {
-            "linear_1": {"w": s(Hv, Ht), "b": s(Ht)},
-            "linear_2": {"w": s(Ht, Ht), "b": s(Ht)},
-        },
+        "projector": _projector_struct(Hv, config.hidden_size),
     }
 
 
@@ -176,12 +188,8 @@ def param_shape_struct(config: InferenceConfig):
 
 def _pixtral_shape_struct(config: InferenceConfig, varch) -> Dict[str, Any]:
     Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
-    Ht = config.hidden_size
     P2 = varch.num_channels * varch.patch_size ** 2
-    f32 = np.float32
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, f32)
+    s = _struct
 
     return {
         "vision": {
@@ -200,8 +208,5 @@ def _pixtral_shape_struct(config: InferenceConfig, varch) -> Dict[str, Any]:
                 "down_proj": s(L, Iv, Hv),
             },
         },
-        "projector": {
-            "linear_1": {"w": s(Hv, Ht), "b": s(Ht)},
-            "linear_2": {"w": s(Ht, Ht), "b": s(Ht)},
-        },
+        "projector": _projector_struct(Hv, config.hidden_size),
     }

@@ -277,7 +277,7 @@ def pixtral_vision_forward(
         ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
         return jnp.swapaxes(ctx, 1, 2).reshape(B, -1, H) @ lp["o_proj"]
 
-    act = ACTS.get(arch.hidden_act, jax.nn.silu)
+    act = ACTS[arch.hidden_act]  # KeyError on unsupported acts, not silent silu
 
     def body(carry, lp):
         res = carry
