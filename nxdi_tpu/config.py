@@ -77,6 +77,18 @@ class KVQuantizationConfig:
     def __init__(self, **kwargs):
         self.dtype = kwargs.pop("dtype", "float8_e4m3")
         self.scale_mode = kwargs.pop("scale_mode", "direct_cast")  # direct_cast|per_tensor
+        # per_tensor: values are stored as value/scale in fp8 and rescaled on
+        # read (reference: calibrated k/v scale buffers, kv_cache_manager.py:
+        # 642-692). Static per-tensor scales, typically from offline amax
+        # calibration.
+        self.k_scale = float(kwargs.pop("k_scale", 1.0))
+        self.v_scale = float(kwargs.pop("v_scale", 1.0))
+        if self.scale_mode not in ("direct_cast", "per_tensor"):
+            raise ValueError(
+                f"kv quant scale_mode must be direct_cast|per_tensor, got {self.scale_mode!r}"
+            )
+        if self.scale_mode == "direct_cast" and (self.k_scale != 1.0 or self.v_scale != 1.0):
+            raise ValueError("k_scale/v_scale require scale_mode='per_tensor'")
         if kwargs:
             raise ValueError(f"Unknown KVQuantizationConfig args: {sorted(kwargs)}")
 
@@ -308,6 +320,14 @@ class TpuConfig:
         self.ep_degree = kwargs.pop("ep_degree", 1)
         self.moe_tp_degree = kwargs.pop("moe_tp_degree", None)
         self.moe_ep_degree = kwargs.pop("moe_ep_degree", None)
+        # "sparse" = ragged_dot grouped matmul over routed tokens (default);
+        # "dense" = all experts compute all tokens (reference ExpertMLPs
+        # non-blockwise mode; kept as an A/B and debugging fallback)
+        self.moe_dispatch = kwargs.pop("moe_dispatch", "sparse")
+        if self.moe_dispatch not in ("sparse", "dense"):
+            raise ValueError(
+                f"moe_dispatch must be 'sparse' or 'dense', got {self.moe_dispatch!r}"
+            )
         self.world_size = kwargs.pop("world_size", None)
         if self.world_size is None:
             self.world_size = self.tp_degree * self.pp_degree

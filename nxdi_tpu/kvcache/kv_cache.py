@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_MP
 
 
 @dataclass(frozen=True)
@@ -89,7 +89,7 @@ def kv_cache_partition_spec(tpu_config=None) -> Dict[str, P]:
 
         spec = kv_cache_partition_spec_for(tpu_config)
     else:
-        spec = P(None, None, AXIS_TP, None, None)
+        spec = P(None, None, AXIS_MP, None, None)
     return {"k": spec, "v": spec}
 
 
@@ -137,7 +137,7 @@ def init_block_kv_cache(spec: BlockKVCacheSpec) -> Dict[str, jax.Array]:
 
 
 def block_kv_cache_partition_spec() -> Dict[str, P]:
-    spec = P(None, None, AXIS_TP, None)
+    spec = P(None, None, AXIS_MP, None)
     return {"k": spec, "v": spec}
 
 
@@ -157,9 +157,18 @@ class ContiguousKVLayout:
     is_continuous_batching config + seq_ids plumbed through model_base.py
     forward :3367): batch row i reads/writes cache line ``seq_ids[i]`` instead
     of line i, so a CTE dispatch for one new request can land in any line while
-    other lines keep decoding."""
+    other lines keep decoding.
+
+    ``k_scale``/``v_scale`` implement the reference's scaled fp8 KV cache
+    (scale_mode="per_tensor", kv_cache_manager.py:642-692): values are divided
+    by the scale before the fp8 store and re-multiplied after the load, so
+    activations larger than the fp8 dynamic range survive. Static floats —
+    part of the compiled program, like the reference's calibrated scale
+    buffers baked into the traced graph."""
 
     route_by_seq_id: bool = False
+    k_scale: float = 1.0
+    v_scale: float = 1.0
 
     def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
         B = k_new.shape[0]
@@ -173,6 +182,10 @@ class ContiguousKVLayout:
         else:
             b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
         store = k_cache_l.dtype
+        if self.k_scale != 1.0:
+            k_new = k_new / jnp.asarray(self.k_scale, k_new.dtype)
+        if self.v_scale != 1.0:
+            v_new = v_new / jnp.asarray(self.v_scale, v_new.dtype)
         k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
         v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
         k_cache_l = k_cache_l.at[b_idx, :, pos].set(k_vals, mode="drop")
@@ -183,6 +196,10 @@ class ContiguousKVLayout:
         """Returns (kk, vv, kv_pos): (B, KV, W, D) x2 and (B, W) positions."""
         compute = spec.compute_dtype
         kk, vv = k_cache_l.astype(compute), v_cache_l.astype(compute)
+        if self.k_scale != 1.0:
+            kk = kk * jnp.asarray(self.k_scale, compute)
+        if self.v_scale != 1.0:
+            vv = vv * jnp.asarray(self.v_scale, compute)
         if self.route_by_seq_id:
             seq_ids = cache_inputs["seq_ids"].astype(jnp.int32)
             kk = jnp.take(kk, seq_ids, axis=0, mode="clip")
@@ -203,12 +220,18 @@ class BlockKVLayout:
     are simply 0..W-1."""
 
     block_size: int
+    k_scale: float = 1.0  # scaled fp8 store, see ContiguousKVLayout
+    v_scale: float = 1.0
 
     def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
         # k_new (B, KV, S_act, D); slot_mapping (B, S_act) flat slot per token
         slots = cache_inputs["slot_mapping"].astype(jnp.int32)
         slots = jnp.where(slots < 0, k_cache_l.shape[0], slots)  # drop padding
         store = k_cache_l.dtype
+        if self.k_scale != 1.0:
+            k_new = k_new / jnp.asarray(self.k_scale, k_new.dtype)
+        if self.v_scale != 1.0:
+            v_new = v_new / jnp.asarray(self.v_scale, v_new.dtype)
         k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
         v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
         flat = (-1, k_vals.shape[-2], k_vals.shape[-1])
@@ -225,6 +248,10 @@ class BlockKVLayout:
         compute = spec.compute_dtype
         kk = jnp.take(k_cache_l, slots, axis=0, mode="clip").astype(compute)
         vv = jnp.take(v_cache_l, slots, axis=0, mode="clip").astype(compute)
+        if self.k_scale != 1.0:
+            kk = kk * jnp.asarray(self.k_scale, compute)
+        if self.v_scale != 1.0:
+            vv = vv * jnp.asarray(self.v_scale, compute)
         kk = jnp.swapaxes(kk, 1, 2)  # (B, KV, W, D)
         vv = jnp.swapaxes(vv, 1, 2)
         W = NB * self.block_size

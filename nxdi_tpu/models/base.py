@@ -48,7 +48,7 @@ from nxdi_tpu.parallel.layers import (
     VOCAB_PARALLEL,
     constrain,
 )
-from nxdi_tpu.parallel.mesh import AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_MP
 from nxdi_tpu.parallel.policy import DEFAULT_POLICY, ShardingPolicy
 
 ACT_FNS: Dict[str, Callable] = {
@@ -172,7 +172,7 @@ def attention_param_specs(arch: DecoderArch) -> Dict[str, Any]:
     if arch.attention_bias:
         # Qwen2-style layout: q/k/v carry biases, o_proj does not
         for name in ("q_proj", "k_proj", "v_proj"):
-            spec[name]["b"] = P(AXIS_TP)
+            spec[name]["b"] = P(AXIS_MP)
     if arch.attention_o_bias:  # gpt-oss
         spec["o_proj"]["b"] = REPLICATED
     if arch.qk_norm:
@@ -190,8 +190,8 @@ def mlp_param_specs(arch: DecoderArch) -> Dict[str, Any]:
         spec["gate_proj"] = {"w": COLUMN_PARALLEL}
     if arch.mlp_bias:
         if arch.gated_mlp:
-            spec["gate_proj"]["b"] = P(AXIS_TP)
-        spec["up_proj"]["b"] = P(AXIS_TP)
+            spec["gate_proj"]["b"] = P(AXIS_MP)
+        spec["up_proj"]["b"] = P(AXIS_MP)
         spec["down_proj"]["b"] = REPLICATED
     return spec
 
@@ -498,8 +498,10 @@ def decoder_layer(
         attn_out = _norm(arch, attn_out, lp["post_attention_layernorm"])
         hidden = hidden + attn_out
         h = _norm(arch, hidden, lp["pre_feedforward_layernorm"])
-        if arch.moe is not None:
-            ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
+        # per-layer MoE-vs-dense decided by the params structure so segmented
+        # stacks (deepseek-V3 first_k_dense_replace, minimax) mix both
+        if arch.moe is not None and "moe" in lp:
+            ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden)
         else:
             ff = mlp_block(arch, lp["mlp"], h, adapter_ids)
         ff = _norm(arch, ff, lp["post_feedforward_layernorm"])
@@ -507,8 +509,8 @@ def decoder_layer(
     else:
         hidden = hidden + attn_out
         h = _norm(arch, hidden, lp["post_attention_layernorm"])
-        if arch.moe is not None:
-            hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h)
+        if arch.moe is not None and "moe" in lp:
+            hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden)
         else:
             hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids)
     hidden = constrain(hidden, policy.hidden)
@@ -564,12 +566,30 @@ def run_decoder_layers(
             )
         return h, ((nk, nv, h) if collect_hidden else (nk, nv))
 
-    hidden, ys = jax.lax.scan(body, hidden, (layer_params, cache["k"], cache["v"]))
+    # Heterogeneous stacks (deepseek-V3 first_k_dense_replace, minimax) arrive
+    # as a LIST of layer-stacked segments — e.g. [dense-MLP head, MoE rest] —
+    # each scanned over its static slice of the cache. Homogeneous models pass
+    # the single stacked pytree unchanged.
+    segments = (
+        list(layer_params) if isinstance(layer_params, (list, tuple)) else [layer_params]
+    )
+    ks, vs, hs = [], [], []
+    off = 0
+    for seg in segments:
+        n_seg = jax.tree_util.tree_leaves(seg)[0].shape[0]
+        k_seg = jax.lax.slice_in_dim(cache["k"], off, off + n_seg, axis=0)
+        v_seg = jax.lax.slice_in_dim(cache["v"], off, off + n_seg, axis=0)
+        hidden, ys = jax.lax.scan(body, hidden, (seg, k_seg, v_seg))
+        off += n_seg
+        if collect_hidden:
+            ks.append(ys[0]); vs.append(ys[1]); hs.append(ys[2])
+        else:
+            ks.append(ys[0]); vs.append(ys[1])
+    cat = (lambda xs: xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0))
+    new_cache = {"k": cat(ks), "v": cat(vs)}
     if collect_hidden:
-        new_k, new_v, layer_hiddens = ys
-        return hidden, {"k": new_k, "v": new_v}, layer_hiddens
-    new_k, new_v = ys
-    return hidden, {"k": new_k, "v": new_v}
+        return hidden, new_cache, cat(hs)
+    return hidden, new_cache
 
 
 # ---------------------------------------------------------------------------

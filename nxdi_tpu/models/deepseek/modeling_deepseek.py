@@ -1,35 +1,35 @@
-"""Deepseek (V2/V3 lineage) family — Multi-head Latent Attention.
+"""Deepseek (V2/V3 lineage) family — Multi-head Latent Attention + V3 MoE.
 
 Reference: models/deepseek/modeling_deepseek.py (493 LoC; MLA attention with
-q-LoRA, compressed kv latents, yarn rope from rope_util.py). The attention
-itself lives in ops/mla.py, designed around a latent KV cache (the reference
-caches expanded per-head K/V; the latent cache is the TPU-native choice — see
-the ops/mla.py docstring).
-
-The in-tree reference scope is the dense-MLP deepseek (the full V3 MoE with
-sigmoid scoring + grouped top-k lives in its contrib tree); here the MoE
-layers use the deepseek routing variant when ``n_routed_experts`` is present,
-with dense layers for the first ``first_k_dense_replace`` layers NOT yet
-heterogeneous — models mixing dense and MoE layers set
-``first_k_dense_replace == 0`` or all-dense for now.
+q-LoRA, compressed kv latents, yarn rope from rope_util.py) and the contrib
+DeepSeek-V3 tree (sigmoid-scored grouped top-k router with learned correction
+bias, shared experts, first_k_dense_replace leading dense layers). The
+attention lives in ops/mla.py, designed around a latent KV cache (the
+reference caches expanded per-head K/V; the latent cache is the TPU-native
+choice — see the ops/mla.py docstring). V3 routing semantics live in
+ops/moe.py:route_topk (sigmoid_routing / n_group / topk_group /
+correction_bias); the dense-head + MoE-tail layer mix rides the segmented
+layer scan (models/base.py run_decoder_layers).
 """
 
 from __future__ import annotations
 
+import dataclasses
 import math
-from typing import Any, Dict
+from typing import Any, Dict, Optional
 
 import numpy as np
 
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
-from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.models.base import DecoderArch, decoder_param_specs
 from nxdi_tpu.ops.mla import (
     MLAArch,
     deinterleave_rope_columns,
     mla_param_specs,
     mla_shape_struct,
 )
+from nxdi_tpu.ops.moe import MoEArch, moe_parallel_fields
 from nxdi_tpu.ops.rope import default_inv_freq, yarn_inv_freq
 
 
@@ -98,11 +98,54 @@ def _mla_arch(config: InferenceConfig) -> MLAArch:
     )
 
 
+def _moe_arch(config: InferenceConfig) -> Optional[MoEArch]:
+    """V3/V2 MoE description from the HF config (None for all-dense models).
+
+    HF DeepseekV3TopkRouter semantics: sigmoid scores, selection over
+    bias-corrected scores with grouped top-k (n_group groups, topk_group
+    kept), weights from the UNCORRECTED scores, renormalized and scaled by
+    routed_scaling_factor. Shared experts are n_shared_experts plain
+    (ungated) MLPs of moe_intermediate_size each, fused here into one wide
+    shared MLP."""
+    E = getattr(config, "n_routed_experts", None)
+    if not E:
+        return None
+    scoring = getattr(config, "scoring_func", "sigmoid")
+    if scoring not in ("sigmoid", "softmax"):
+        raise ValueError(f"deepseek scoring_func {scoring!r} not supported")
+    n_shared = getattr(config, "n_shared_experts", None) or 0
+    return MoEArch(
+        num_experts=E,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.moe_intermediate_size,
+        hidden_act=getattr(config, "hidden_act", "silu"),
+        norm_topk_prob=bool(getattr(config, "norm_topk_prob", True)),
+        sigmoid_routing=scoring == "sigmoid",
+        n_group=getattr(config, "n_group", None),
+        topk_group=getattr(config, "topk_group", None),
+        routed_scaling=float(getattr(config, "routed_scaling_factor", 1.0)),
+        correction_bias=scoring == "sigmoid",
+        shared_expert_intermediate_size=(
+            n_shared * config.moe_intermediate_size if n_shared else None
+        ),
+        **moe_parallel_fields(config.tpu_config, E),
+    )
+
+
+def _first_k_dense(config: InferenceConfig) -> int:
+    if getattr(config, "n_routed_experts", None):
+        return int(getattr(config, "first_k_dense_replace", 0) or 0)
+    return 0
+
+
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
     # the yarn attention factor (rope_mscale) is computed by dense.build_arch;
     # it depends only on the scaling config, not on which head_dim the
     # frequencies use
-    kwargs = dict(mla=_mla_arch(config))
+    moe = _moe_arch(config)
+    if moe is not None and _first_k_dense(config) >= config.num_hidden_layers:
+        moe = None  # every layer is dense — no MoE layer exists in the model
+    kwargs = dict(mla=_mla_arch(config), moe=moe)
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)
 
@@ -121,10 +164,9 @@ def build_inv_freq(config: InferenceConfig) -> np.ndarray:
 def _dense_mlp(state_dict, pre, cast):
     key = pre + "mlp.gate_proj.weight"
     if key not in state_dict and f"model.{key}" not in state_dict:
-        raise NotImplementedError(
-            f"deepseek layer {pre.rstrip('.')} is a MoE layer (mlp.experts.*): "
-            "the deepseek family currently supports dense-MLP layers only "
-            "(the V3 sigmoid-scored grouped-top-k MoE is not implemented yet)"
+        raise ValueError(
+            f"deepseek layer {pre.rstrip('.')} has no dense mlp weights; "
+            "MoE layers require n_routed_experts in the config"
         )
 
     def get(name):
@@ -138,6 +180,51 @@ def _dense_mlp(state_dict, pre, cast):
         "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight")).T},
         "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight")).T},
     }
+
+
+def _moe_layer(state_dict, pre, cast, moe: MoEArch):
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(name)
+
+    out: Dict[str, Any] = {
+        "router": {"w": cast(get(pre + "mlp.gate.weight")).T},
+        "experts": {
+            "gate_proj": {
+                "w": cast(np.stack([
+                    np.asarray(get(f"{pre}mlp.experts.{j}.gate_proj.weight")).T
+                    for j in range(moe.num_experts)
+                ]))
+            },
+            "up_proj": {
+                "w": cast(np.stack([
+                    np.asarray(get(f"{pre}mlp.experts.{j}.up_proj.weight")).T
+                    for j in range(moe.num_experts)
+                ]))
+            },
+            "down_proj": {
+                "w": cast(np.stack([
+                    np.asarray(get(f"{pre}mlp.experts.{j}.down_proj.weight")).T
+                    for j in range(moe.num_experts)
+                ]))
+            },
+        },
+    }
+    if moe.correction_bias:
+        # selection-only bias kept in f32 like HF (bf16 rounding here flips
+        # near-tie expert selections vs the CPU golden)
+        out["router"]["e_bias"] = np.asarray(
+            get(pre + "mlp.gate.e_score_correction_bias"), np.float32
+        )
+    if moe.shared_expert_intermediate_size:
+        out["shared_expert"] = {
+            "gate_proj": {"w": cast(get(pre + "mlp.shared_experts.gate_proj.weight")).T},
+            "up_proj": {"w": cast(get(pre + "mlp.shared_experts.up_proj.weight")).T},
+            "down_proj": {"w": cast(get(pre + "mlp.shared_experts.down_proj.weight")).T},
+        }
+    return out
 
 
 def convert_hf_state_dict(
@@ -191,13 +278,21 @@ def convert_hf_state_dict(
             "input_layernorm": cast(get(pre + "input_layernorm.weight")),
             "post_attention_layernorm": cast(get(pre + "post_attention_layernorm.weight")),
             "attn": attn,
-            "mlp": _dense_mlp(state_dict, pre, cast),
         }
+        if arch.moe is not None and i >= _first_k_dense(config):
+            layer["moe"] = _moe_layer(state_dict, pre, cast, arch.moe)
+        else:
+            layer["mlp"] = _dense_mlp(state_dict, pre, cast)
         layers.append(layer)
 
+    k_dense = _first_k_dense(config)
+    if arch.moe is not None and 0 < k_dense < arch.num_layers:
+        stacked = [dense.tree_stack(layers[:k_dense]), dense.tree_stack(layers[k_dense:])]
+    else:
+        stacked = dense.tree_stack(layers)
     params: Dict[str, Any] = {
         "embed_tokens": cast(get("embed_tokens.weight")),
-        "layers": dense.tree_stack(layers),
+        "layers": stacked,
         "norm": cast(get("norm.weight")),
     }
     vocab_pad = arch.vocab_pad
@@ -221,20 +316,41 @@ def convert_hf_state_dict(
     return params
 
 
+def _segment_archs(config: InferenceConfig, arch: DecoderArch):
+    """(dense-head arch, moe-tail arch) for segmented stacks, or None when the
+    stack is homogeneous."""
+    k = _first_k_dense(config)
+    if arch.moe is None or not (0 < k < arch.num_layers):
+        return None
+    head = dataclasses.replace(arch, num_layers=k, moe=None)
+    tail = dataclasses.replace(arch, num_layers=arch.num_layers - k)
+    return head, tail
+
+
 def param_specs(config: InferenceConfig):
     import jax
 
     from jax.sharding import PartitionSpec as P
 
     arch = build_arch(config)
-    specs = dense.param_specs_for(arch)
 
     def stack(tree):
         return jax.tree_util.tree_map(
             lambda s: P(*((None,) + tuple(s))), tree, is_leaf=lambda x: isinstance(x, P)
         )
 
-    specs["layers"]["attn"] = stack(mla_param_specs(arch.mla))
+    mla_specs = stack(mla_param_specs(arch.mla))
+    segs = _segment_archs(config, arch)
+    specs = dense.param_specs_for(arch)
+    if segs is None:
+        specs["layers"]["attn"] = mla_specs
+        return specs
+    seg_specs = []
+    for seg_arch in segs:
+        seg = decoder_param_specs(seg_arch)["layers"]
+        seg["attn"] = mla_specs
+        seg_specs.append(seg)
+    specs["layers"] = seg_specs
     return specs
 
 
@@ -243,7 +359,19 @@ def param_shape_struct(config: InferenceConfig):
 
     arch = build_arch(config)
     struct = dense.param_shape_struct(config, arch)
-    struct["layers"]["attn"] = mla_shape_struct(
-        arch.mla, arch.hidden_size, arch.num_layers, to_jax_dtype(arch.dtype)
-    )
+    segs = _segment_archs(config, arch)
+    if segs is None:
+        struct["layers"]["attn"] = mla_shape_struct(
+            arch.mla, arch.hidden_size, arch.num_layers, to_jax_dtype(arch.dtype)
+        )
+        return struct
+    seg_structs = []
+    for seg_arch in segs:
+        seg_cfg_struct = dense.param_shape_struct(config, seg_arch)["layers"]
+        seg_cfg_struct["attn"] = mla_shape_struct(
+            seg_arch.mla, seg_arch.hidden_size, seg_arch.num_layers,
+            to_jax_dtype(seg_arch.dtype),
+        )
+        seg_structs.append(seg_cfg_struct)
+    struct["layers"] = seg_structs
     return struct

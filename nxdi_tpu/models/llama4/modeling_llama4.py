@@ -28,7 +28,7 @@ import numpy as np
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
-from nxdi_tpu.ops.moe import MoEArch, ep_policy
+from nxdi_tpu.ops.moe import MoEArch, moe_parallel_fields
 from nxdi_tpu.parallel.layers import REPLICATED
 
 build_inv_freq = dense.build_inv_freq
@@ -73,7 +73,7 @@ def _moe_arch(config: InferenceConfig) -> MoEArch:
         intermediate_size=config.intermediate_size,
         llama4_router=True,
         shared_expert_intermediate_size=config.intermediate_size,
-        ep=ep_policy(config.tpu_config.tp_degree, config.num_local_experts),
+        **moe_parallel_fields(config.tpu_config, config.num_local_experts),
     )
 
 

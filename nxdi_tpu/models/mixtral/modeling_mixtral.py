@@ -19,7 +19,7 @@ import numpy as np
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
-from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, ep_policy
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, moe_parallel_fields
 
 build_inv_freq = dense.build_inv_freq
 
@@ -41,7 +41,7 @@ def _moe_arch(config: InferenceConfig) -> MoEArch:
         intermediate_size=config.intermediate_size,
         hidden_act=getattr(config, "hidden_act", "silu"),
         norm_topk_prob=True,
-        ep=ep_policy(config.tpu_config.tp_degree, config.num_local_experts),
+        **moe_parallel_fields(config.tpu_config, config.num_local_experts),
     )
 
 

@@ -16,7 +16,7 @@ import numpy as np
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
-from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, ep_policy
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, moe_parallel_fields
 
 build_inv_freq = dense.build_inv_freq
 
@@ -47,7 +47,7 @@ def _moe_arch(config: InferenceConfig) -> MoEArch:
         intermediate_size=config.moe_intermediate_size,
         hidden_act=getattr(config, "hidden_act", "silu"),
         norm_topk_prob=config.norm_topk_prob,
-        ep=ep_policy(config.tpu_config.tp_degree, config.num_experts),
+        **moe_parallel_fields(config.tpu_config, config.num_experts),
     )
 
 

@@ -34,7 +34,7 @@ from jax.sharding import PartitionSpec as P
 from nxdi_tpu.ops import attention as attn_ops
 from nxdi_tpu.ops.norms import rms_norm
 from nxdi_tpu.ops.rope import apply_rotary_pos_emb
-from nxdi_tpu.parallel.mesh import AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_MP
 
 
 @dataclass(frozen=True)
@@ -139,15 +139,15 @@ def mla_param_specs(mla: MLAArch) -> Dict[str, Any]:
     specs: Dict[str, Any] = {
         "kv_a": {"w": P()},  # small (hidden -> r + rope): replicated
         "kv_a_norm": P(),
-        "kv_b": {"w": P(None, AXIS_TP)},  # heads on out dim
-        "o_proj": {"w": P(AXIS_TP, None)},
+        "kv_b": {"w": P(None, AXIS_MP)},  # heads on out dim
+        "o_proj": {"w": P(AXIS_MP, None)},
     }
     if mla.q_lora_rank is None:
-        specs["q_proj"] = {"w": P(None, AXIS_TP)}
+        specs["q_proj"] = {"w": P(None, AXIS_MP)}
     else:
         specs["q_a"] = {"w": P()}
         specs["q_a_norm"] = P()
-        specs["q_b"] = {"w": P(None, AXIS_TP)}
+        specs["q_b"] = {"w": P(None, AXIS_MP)}
     return specs
 
 

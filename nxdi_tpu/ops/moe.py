@@ -4,35 +4,48 @@ Reference: modules/moe_v2.py:23-132 assembles RouterTopK + ExpertMLPsV2 +
 SharedExperts into an MoE wrapper, with TPxEP process groups (:135-161) and
 NKI blockwise-matmul kernels. TPU-native the same structure is:
 
-  - **Router**: one replicated linear -> full softmax -> top-k -> (optional)
-    renormalize, exactly HF's semantics so logits match the CPU golden.
-  - **Experts**: dense dispatch. Every expert runs on every token; the per-token
-    combine weight is zero for unselected experts. No gather/scatter, no
-    capacity limits, no dynamic shapes — the einsum over the expert dim maps
-    straight onto the MXU, and the combine contraction is exact.
-  - **Parallelism**: the expert dim is sharded over the ``tp`` mesh axis when it
-    divides (expert parallelism: each device holds E/tp full experts; the
-    combine einsum contracts over experts so GSPMD inserts one psum — the
-    reference's EP dispatch AR/RS collectives, attention_base.py:179).
-    Otherwise the intermediate dim is sharded (expert-internal TP, the
-    reference's moe_tp_degree).
-
-Dense dispatch costs E/topk x the active-expert FLOPs. That is the right first
-trade on TPU: decode is HBM-bound on expert *weights*, which any-expert routing
-must stream anyway; a ragged/sorted dispatch kernel is a later optimization
-(PAPERS.md megablocks lineage) that slots in behind this same interface.
+  - **Router**: one replicated linear -> scoring (softmax / sigmoid /
+    grouped-top-k for deepseek-V3) -> top-k -> (optional) renormalize, exactly
+    HF's semantics so logits match the CPU golden.
+  - **Experts, sparse dispatch (default)**: tokens are sorted by their routed
+    expert and run through ``jax.lax.ragged_dot`` — XLA's grouped matmul, the
+    MXU-native equivalent of the reference's blockwise NKI expert kernels
+    (ExpertMLPsV2 block dispatch). FLOPs scale with ``T x top_k``, not with
+    ``T x num_experts``; at 128-expert/top-8 scale that is 16x fewer expert
+    FLOPs than dense dispatch. Static shapes throughout: the sort, the group
+    sizes, and the combine are all fixed-(T*K) arrays, so the path jits/scans
+    cleanly.
+  - **Experts, dense dispatch (fallback)**: every expert runs on every token
+    with a zero combine weight for unselected experts. No sort, no
+    gather/scatter; kept for A/B testing via ``moe_dispatch="dense"``.
+  - **Parallelism**: three regimes over the (ep, tp) mesh axes (parallel/mesh
+    AXIS_MP = the full model-parallel world):
+      * full-EP (``ep=True``, default when the world divides the expert
+        count): the expert dim is sharded over the whole (ep, tp) world.
+      * expert-internal TP (``ep=False``): the expert intermediate dim is
+        sharded over the world (the reference's moe_tp_degree).
+      * hybrid TPxEP (``hybrid_ep=True``, from ``moe_ep_degree`` x
+        ``moe_tp_degree``): experts shard over the dedicated ``ep`` mesh axis
+        while each expert's intermediate shards over ``tp`` — the reference's
+        moe_v2.py:135-161 TPxEP process-group factorization. Attention and
+        dense layers keep sharding over the full world via AXIS_MP.
+    The sparse path runs under ``shard_map`` (GSPMD cannot partition a
+    ragged_dot over its group dim); each shard computes its local experts /
+    intermediate slice and one psum over (ep, tp) produces the combined
+    output — the reference's EP dispatch AR/RS collectives
+    (attention_base.py:179 EPDispatchOption).
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_EP, AXIS_MP, AXIS_TP
 
 
 @dataclass(frozen=True)
@@ -44,9 +57,14 @@ class MoEArch:
     intermediate_size: int  # per-expert intermediate
     hidden_act: str = "silu"
     norm_topk_prob: bool = True  # renormalize top-k weights (mixtral: always)
-    # expert-parallel over tp axis (family builder sets this when tp | E);
-    # False -> expert-internal TP on the intermediate dim
+    # expert-parallel over the full (ep, tp) world (family builder sets this
+    # when the world divides E); False -> expert-internal TP on the
+    # intermediate dim; hybrid_ep -> experts over the ep axis, intermediate
+    # over tp (reference: moe_ep_degree x moe_tp_degree, config.py:603)
     ep: bool = False
+    hybrid_ep: bool = False
+    # "sparse" (ragged_dot grouped matmul) or "dense" (all experts, all tokens)
+    dispatch: str = "sparse"
     # shared (always-on) experts, qwen2-moe/llama4 style
     shared_expert_intermediate_size: Optional[int] = None
     shared_expert_gated: bool = False  # sigmoid(gate(x)) scaling on shared out
@@ -62,12 +80,37 @@ class MoEArch:
     gptoss_glu: bool = False
     glu_limit: Optional[float] = None
     glu_alpha: float = 1.702
+    # deepseek-V3 routing (reference contrib DeepSeek-V3; HF DeepseekV3TopkRouter):
+    # sigmoid scores (+ optional learned correction bias used ONLY for
+    # selection), grouped top-k over n_group groups keeping topk_group, final
+    # weights from the UNCORRECTED sigmoid scores, scaled by routed_scaling
+    sigmoid_routing: bool = False
+    n_group: Optional[int] = None
+    topk_group: Optional[int] = None
+    routed_scaling: float = 1.0
+    correction_bias: bool = False
 
 
 def ep_policy(tp_degree: int, num_experts: int) -> bool:
     """Shared EP-vs-TP decision for family builders: expert parallelism when
     the tp world divides the expert count."""
     return tp_degree > 1 and num_experts % tp_degree == 0
+
+
+def moe_parallel_fields(tc, num_experts: int) -> Dict[str, Any]:
+    """MoEArch constructor kwargs for the parallel/dispatch knobs, derived from
+    the :class:`TpuConfig` — shared by every MoE family builder."""
+    hybrid = bool(getattr(tc, "moe_ep_degree", None) and tc.moe_ep_degree > 1)
+    if hybrid and num_experts % tc.moe_ep_degree != 0:
+        raise ValueError(
+            f"moe_ep_degree ({tc.moe_ep_degree}) must divide the expert count "
+            f"({num_experts})"
+        )
+    return {
+        "ep": (not hybrid) and ep_policy(tc.tp_degree, num_experts),
+        "hybrid_ep": hybrid,
+        "dispatch": getattr(tc, "moe_dispatch", "sparse"),
+    }
 
 
 def convert_hf_experts(get, cast, num_experts: int, router_key: str, expert_fmt) -> Dict[str, Any]:
@@ -89,129 +132,365 @@ def convert_hf_experts(get, cast, num_experts: int, router_key: str, expert_fmt)
     }
 
 
+def _expert_dim_axes(moe: MoEArch) -> Tuple[str, ...]:
+    """Mesh axes sharding the expert dim (for specs and shard_map offsets)."""
+    if moe.hybrid_ep:
+        return (AXIS_EP,)
+    if moe.ep:
+        return AXIS_MP
+    return ()
+
+
+def _inter_dim_axes(moe: MoEArch) -> Tuple[str, ...]:
+    """Mesh axes sharding the expert intermediate dim."""
+    if moe.hybrid_ep:
+        return (AXIS_TP,)
+    if moe.ep:
+        return ()
+    return AXIS_MP
+
+
+def _axes_entry(axes: Tuple[str, ...]):
+    """PartitionSpec entry for an axes tuple ('' -> None)."""
+    if not axes:
+        return None
+    return axes if len(axes) > 1 else axes[0]
+
+
 def expert_parallel_specs(moe: MoEArch) -> Dict[str, Any]:
     """PartitionSpecs for one layer's MoE params (pre-layer-stacking).
 
-    EP when ``moe.ep`` (family builder sets it when tp divides the expert
-    count), else TP on the expert intermediate (reference: moe_ep_degree vs
-    moe_tp_degree, config.py:603).
-    """
-    if moe.ep:
-        expert_spec = {
-            "gate_proj": {"w": P(AXIS_TP, None, None)},
-            "up_proj": {"w": P(AXIS_TP, None, None)},
-            "down_proj": {"w": P(AXIS_TP, None, None)},
-        }
-        if moe.expert_bias:
-            for k in expert_spec:
-                expert_spec[k]["b"] = P(AXIS_TP, None)
-    else:
-        expert_spec = {
-            "gate_proj": {"w": P(None, None, AXIS_TP)},
-            "up_proj": {"w": P(None, None, AXIS_TP)},
-            "down_proj": {"w": P(None, AXIS_TP, None)},
-        }
-        if moe.expert_bias:
-            expert_spec["gate_proj"]["b"] = P(None, AXIS_TP)
-            expert_spec["up_proj"]["b"] = P(None, AXIS_TP)
-            expert_spec["down_proj"]["b"] = P()
+    Expert dim over :func:`_expert_dim_axes`, intermediate dim over
+    :func:`_inter_dim_axes` (reference: moe_ep_degree vs moe_tp_degree,
+    config.py:603). In hybrid mode weights are 2-D sharded (experts x
+    intermediate)."""
+    e = _axes_entry(_expert_dim_axes(moe))
+    i = _axes_entry(_inter_dim_axes(moe))
+    expert_spec = {
+        "gate_proj": {"w": P(e, None, i)},
+        "up_proj": {"w": P(e, None, i)},
+        "down_proj": {"w": P(e, i, None)},
+    }
+    if moe.expert_bias:
+        expert_spec["gate_proj"]["b"] = P(e, i)
+        expert_spec["up_proj"]["b"] = P(e, i)
+        expert_spec["down_proj"]["b"] = P(e, None)
     specs: Dict[str, Any] = {
         "router": {"w": P()},
         "experts": expert_spec,
     }
     if moe.router_bias:
         specs["router"]["b"] = P()
+    if moe.correction_bias:
+        specs["router"]["e_bias"] = P()
     if moe.shared_expert_intermediate_size:
         specs["shared_expert"] = {
-            "gate_proj": {"w": P(None, AXIS_TP)},
-            "up_proj": {"w": P(None, AXIS_TP)},
-            "down_proj": {"w": P(AXIS_TP, None)},
+            "gate_proj": {"w": P(None, AXIS_MP)},
+            "up_proj": {"w": P(None, AXIS_MP)},
+            "down_proj": {"w": P(AXIS_MP, None)},
         }
         if moe.shared_expert_gated:
             specs["shared_expert_gate"] = {"w": P()}
     return specs
 
 
-def route(router_logits: jax.Array, moe: MoEArch) -> jax.Array:
-    """Router logits (T, E) -> dense combine weights (T, E), zero for
-    unselected experts (HF Mixtral/Qwen3Moe semantics: full softmax -> top-k ->
-    optional renormalize; reference: RouterTopK in moe_v2.py:23)."""
+# ---------------------------------------------------------------------------
+# Routing
+# ---------------------------------------------------------------------------
+
+
+def route_topk(
+    router_logits: jax.Array, moe: MoEArch, p_router: Optional[Dict[str, Any]] = None
+) -> Tuple[jax.Array, jax.Array]:
+    """Router logits (T, E) -> (weights (T, K) f32, expert ids (T, K) i32).
+
+    Covers the HF routing family zoo: full-softmax top-k (mixtral/qwen3moe,
+    reference RouterTopK moe_v2.py:23), top-k-then-softmax (gpt-oss), sigmoid
+    top-k on the INPUT scale (llama4), and deepseek-V3 sigmoid grouped top-k
+    with selection-only correction bias."""
+    logits = router_logits.astype(jnp.float32)
+    if moe.sigmoid_routing or moe.routed_scaling != 1.0 or (moe.n_group or 0) > 1:
+        # deepseek lineage. V3 (sigmoid_routing): sigmoid scores, selection
+        # over bias-corrected scores, group metric = sum of top-2 members.
+        # V2 (softmax scoring): softmax scores, no correction bias, group
+        # metric = max member (HF DeepseekV2 MoEGate). Both: weights from the
+        # raw scores, optional renorm, * routed_scaling_factor.
+        if moe.sigmoid_routing:
+            scores = jax.nn.sigmoid(logits)
+        else:
+            scores = jax.nn.softmax(logits, axis=-1)
+        select = scores
+        if moe.correction_bias:
+            select = scores + p_router["e_bias"].astype(jnp.float32)
+        if moe.n_group and moe.n_group > 1:
+            T = logits.shape[0]
+            E, G = moe.num_experts, moe.n_group
+            grouped = select.reshape(T, G, E // G)
+            if moe.sigmoid_routing:
+                top2, _ = jax.lax.top_k(grouped, min(2, E // G))
+                group_scores = jnp.sum(top2, axis=-1)
+            else:
+                group_scores = jnp.max(grouped, axis=-1)
+            _, group_idx = jax.lax.top_k(group_scores, moe.topk_group)
+            group_mask = jnp.sum(
+                jax.nn.one_hot(group_idx, G, dtype=jnp.float32), axis=-2
+            )  # (T, G)
+            member_mask = jnp.repeat(group_mask, E // G, axis=-1)
+            select = jnp.where(member_mask > 0, select, -jnp.inf)
+        _, top_idx = jax.lax.top_k(select, moe.top_k)
+        # weights come from the UNCORRECTED scores
+        top_vals = jnp.take_along_axis(scores, top_idx, axis=-1)
+        if moe.norm_topk_prob:
+            top_vals = top_vals / (jnp.sum(top_vals, axis=-1, keepdims=True) + 1e-20)
+        top_vals = top_vals * moe.routed_scaling
+        return top_vals, top_idx
     if moe.llama4_router:
-        top_vals, top_idx = jax.lax.top_k(router_logits.astype(jnp.float32), moe.top_k)
-        scores = jax.nn.sigmoid(top_vals)
-        dense = jnp.sum(
-            jax.nn.one_hot(top_idx, moe.num_experts, dtype=scores.dtype)
-            * scores[..., None],
-            axis=-2,
-        )
-        return dense
+        top_vals, top_idx = jax.lax.top_k(logits, moe.top_k)
+        return jax.nn.sigmoid(top_vals), top_idx
     if moe.topk_softmax:
         # gpt-oss: top-k on raw logits, softmax over the k selected values
-        top_vals, top_idx = jax.lax.top_k(router_logits.astype(jnp.float32), moe.top_k)
-        top_vals = jax.nn.softmax(top_vals, axis=-1)
-    else:
-        probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
-        top_vals, top_idx = jax.lax.top_k(probs, moe.top_k)  # (T, K)
-        if moe.norm_topk_prob:
-            top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
-    dense = jnp.sum(
+        top_vals, top_idx = jax.lax.top_k(logits, moe.top_k)
+        return jax.nn.softmax(top_vals, axis=-1), top_idx
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_idx = jax.lax.top_k(probs, moe.top_k)  # (T, K)
+    if moe.norm_topk_prob:
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    return top_vals, top_idx
+
+
+def route(router_logits: jax.Array, moe: MoEArch, p_router=None) -> jax.Array:
+    """Router logits (T, E) -> dense combine weights (T, E), zero for
+    unselected experts (used by the dense-dispatch path)."""
+    top_vals, top_idx = route_topk(router_logits, moe, p_router)
+    return jnp.sum(
         jax.nn.one_hot(top_idx, moe.num_experts, dtype=top_vals.dtype)
         * top_vals[..., None],
         axis=-2,
     )  # (T, E)
-    return dense
 
 
-def moe_block(arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array) -> jax.Array:
+# ---------------------------------------------------------------------------
+# Expert compute — sparse (ragged_dot) and dense dispatch
+# ---------------------------------------------------------------------------
+
+
+def _expert_act(moe: MoEArch, gate: jax.Array, up: jax.Array) -> jax.Array:
+    from nxdi_tpu.models.base import ACT_FNS
+
+    if moe.gptoss_glu:
+        if moe.glu_limit is not None:
+            gate = jnp.minimum(gate, moe.glu_limit)
+            up = jnp.clip(up, -moe.glu_limit, moe.glu_limit)
+        return (up + 1.0) * (gate * jax.nn.sigmoid(gate * moe.glu_alpha))
+    return ACT_FNS[moe.hidden_act](gate) * up
+
+
+def _sparse_expert_ffn(
+    moe: MoEArch,
+    ew: Dict[str, Any],
+    xt: jax.Array,  # (T, H) local tokens
+    weights: jax.Array,  # (T, K) f32 combine weights
+    idx: jax.Array,  # (T, K) i32 expert ids
+    e_lo,  # scalar: first expert id held locally
+    e_count: int,  # number of experts held locally
+    down_bias_on=1.0,  # 0/1 gate so replicated down biases aren't double-psummed
+) -> jax.Array:
+    """Grouped-matmul expert FFN over the locally-held expert/intermediate
+    shard. Returns the PARTIAL combined output (T, H) — callers psum over the
+    (ep, tp) axes when sharded.
+
+    The ragged_dot grouped matmul wants rows sorted by group; rows routed to
+    non-local experts sort to a tail past ``sum(group_sizes)`` whose output is
+    unspecified-but-finite — their combine weight is zeroed so they never
+    contribute."""
+    T, H = xt.shape
+    K = moe.top_k
+    N = T * K
+    hp = jax.lax.Precision.HIGHEST
+
+    flat_e = idx.reshape(N)
+    flat_t = jnp.repeat(jnp.arange(T, dtype=jnp.int32), K)
+    local_e = flat_e - e_lo
+    in_range = (local_e >= 0) & (local_e < e_count)
+    sort_key = jnp.where(in_range, local_e, e_count).astype(jnp.int32)
+    order = jnp.argsort(sort_key, stable=True)
+    se = sort_key[order]  # sorted local expert ids (tail = e_count)
+    st = flat_t[order]  # token row per sorted slot
+    comb = jnp.where(in_range, weights.reshape(N), 0.0)[order]  # f32
+
+    xs = jnp.take(xt, st, axis=0)  # (N, H)
+    if moe.llama4_router:
+        # llama4 scales the expert INPUT by the sigmoid score; combine weight 1
+        xs = xs * comb[:, None].astype(xs.dtype)
+        comb = jnp.where(comb > 0, 1.0, 0.0)
+    group_sizes = jnp.bincount(se, length=e_count).astype(jnp.int32)
+
+    se_c = jnp.minimum(se, e_count - 1)  # clipped for bias gathers
+    gate = jax.lax.ragged_dot(xs, ew["gate_proj"]["w"], group_sizes, precision=hp)
+    up = jax.lax.ragged_dot(xs, ew["up_proj"]["w"], group_sizes, precision=hp)
+    if moe.expert_bias:
+        gate = gate + ew["gate_proj"]["b"][se_c]
+        up = up + ew["up_proj"]["b"][se_c]
+    inner = _expert_act(moe, gate, up)
+    rows = jax.lax.ragged_dot(inner, ew["down_proj"]["w"], group_sizes, precision=hp)
+    if moe.expert_bias:
+        rows = rows + (ew["down_proj"]["b"][se_c] * down_bias_on).astype(rows.dtype)
+
+    rows = rows * comb[:, None].astype(rows.dtype)
+    # un-sort back to (T, K) slots, then reduce over K — deterministic combine
+    unsorted = jnp.zeros((N, H), rows.dtype).at[order].set(rows)
+    return jnp.sum(unsorted.reshape(T, K, H), axis=1)
+
+
+def _strip_mp_axes(spec: P) -> P:
+    """Drop ep/tp axes from an activation spec (tokens replicate over the
+    model-parallel world inside the sparse shard_map; dp/cp stay sharded)."""
+    out = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+            continue
+        axes = tuple(a for a in (entry if isinstance(entry, (tuple, list)) else (entry,))
+                     if a not in (AXIS_EP, AXIS_TP))
+        out.append(_axes_entry(axes))
+    return P(*out)
+
+
+def _sparse_moe(
+    moe: MoEArch,
+    experts: Dict[str, Any],  # dequantized expert weights (global view)
+    x: jax.Array,  # (B, S, H)
+    weights: jax.Array,  # (B, S, K) f32
+    idx: jax.Array,  # (B, S, K) i32
+    hidden_spec: P,
+) -> jax.Array:
+    """Dispatch the sparse expert FFN, sharded over the mesh when one is in
+    scope. Token (dp/cp) axes stay data-parallel; expert/intermediate shards
+    each compute a partial combined output and one psum over (ep, tp) merges
+    them — the EP dispatch collective of the reference (moe_v2.py:135-161)."""
+    e_axes = _expert_dim_axes(moe)
+    i_axes = _inter_dim_axes(moe)
+    mesh = jax.sharding.get_abstract_mesh()
+
+    def local(ex, xb, wb, ib):
+        B, S, H = xb.shape
+        if e_axes:
+            e_count = ex["gate_proj"]["w"].shape[0]
+            e_lo = jax.lax.axis_index(e_axes) * e_count
+        else:
+            e_count = moe.num_experts
+            e_lo = 0
+        if i_axes:
+            down_on = (jax.lax.axis_index(i_axes) == 0).astype(jnp.float32)
+        else:
+            down_on = 1.0
+        out = _sparse_expert_ffn(
+            moe, ex, xb.reshape(B * S, H), wb.reshape(B * S, -1),
+            ib.reshape(B * S, -1), e_lo, e_count, down_on,
+        )
+        out = jax.lax.psum(out, AXIS_MP)
+        return out.reshape(B, S, H)
+
+    if mesh is None or mesh.empty or not set(AXIS_MP).issubset(mesh.axis_names):
+        return _sparse_expert_ffn(
+            moe,
+            experts,
+            x.reshape(-1, x.shape[-1]),
+            weights.reshape(-1, moe.top_k),
+            idx.reshape(-1, moe.top_k),
+            0,
+            moe.num_experts,
+        ).reshape(x.shape)
+
+    tok_spec = _strip_mp_axes(hidden_spec)
+    tok2 = P(tok_spec[0] if len(tok_spec) > 0 else None,
+             tok_spec[1] if len(tok_spec) > 1 else None, None)
+    e = _axes_entry(e_axes)
+    i = _axes_entry(i_axes)
+    w_specs = {
+        "gate_proj": {"w": P(e, None, i)},
+        "up_proj": {"w": P(e, None, i)},
+        "down_proj": {"w": P(e, i, None)},
+    }
+    if moe.expert_bias:
+        w_specs["gate_proj"]["b"] = P(e, i)
+        w_specs["up_proj"]["b"] = P(e, i)
+        w_specs["down_proj"]["b"] = P(e, None)
+    fn = jax.shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(w_specs, tok2, tok2, tok2),
+        out_specs=tok2,
+        check_vma=False,
+    )
+    return fn(experts, x, weights, idx)
+
+
+def moe_block(
+    arch, moe: MoEArch, p: Dict[str, Any], x: jax.Array, hidden_spec: Optional[P] = None
+) -> jax.Array:
     """MoE feed-forward: (B, S, H) -> (B, S, H).
 
     Param leaves: router.w (H, E); experts.{gate,up}_proj.w (E, H, I),
     experts.down_proj.w (E, I, H); optional shared_expert mlp.
     """
-    from nxdi_tpu.models.base import ACT_FNS
+    from nxdi_tpu.ops.quantization import materialize_weight as mat_w
 
-    act = ACT_FNS[moe.hidden_act]
     B, S, H = x.shape
     xt = x.reshape(B * S, H)
-
-    from nxdi_tpu.ops.quantization import materialize_weight as mat_w
 
     router_logits = xt.astype(jnp.float32) @ p["router"]["w"].astype(jnp.float32)
     if moe.router_bias:
         router_logits = router_logits + p["router"]["b"].astype(jnp.float32)
-    weights = route(router_logits, moe).astype(x.dtype)  # (T, E)
 
-    # dense dispatch: all experts on all tokens, combine contracted over E.
-    # mat_w dequantizes low-bit expert weights in the einsum's operand read.
-    gate = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["gate_proj"], x.dtype))
-    up = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["up_proj"], x.dtype))
-    if moe.llama4_router:
-        # llama4 scales the expert INPUT by the sigmoid score. gate/up are
-        # linear and bias-free on this path, so scaling their OUTPUTS before
-        # the activation is identical (act(s*g(x)) where s*g(x) = g(s*x)) —
-        # avoids materializing an (E, T, H) scaled-input tensor
-        se = jnp.swapaxes(weights, 0, 1)[:, :, None].astype(gate.dtype)  # (E, T, 1)
-        gate = gate * se
-        up = up * se
-    if moe.expert_bias:
-        gate = gate + p["experts"]["gate_proj"]["b"][:, None, :]
-        up = up + p["experts"]["up_proj"]["b"][:, None, :]
-    if moe.gptoss_glu:
-        if moe.glu_limit is not None:
-            gate = jnp.minimum(gate, moe.glu_limit)
-            up = jnp.clip(up, -moe.glu_limit, moe.glu_limit)
-        inner = (up + 1.0) * (gate * jax.nn.sigmoid(gate * moe.glu_alpha))
+    if moe.dispatch == "sparse":
+        top_vals, top_idx = route_topk(router_logits, moe, p["router"])
+        experts = {
+            "gate_proj": {"w": mat_w(p["experts"]["gate_proj"], x.dtype)},
+            "up_proj": {"w": mat_w(p["experts"]["up_proj"], x.dtype)},
+            "down_proj": {"w": mat_w(p["experts"]["down_proj"], x.dtype)},
+        }
+        if moe.expert_bias:
+            for k in experts:
+                experts[k]["b"] = p["experts"][k]["b"]
+        out = _sparse_moe(
+            moe,
+            experts,
+            x,
+            top_vals.reshape(B, S, moe.top_k),
+            top_idx.reshape(B, S, moe.top_k),
+            hidden_spec if hidden_spec is not None else P(),
+        ).reshape(B * S, H)
     else:
-        inner = act(gate) * up  # (E, T, I)
-    expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p["experts"]["down_proj"], x.dtype))
-    if moe.expert_bias:
-        expert_out = expert_out + p["experts"]["down_proj"]["b"][:, None, :]
-    if moe.llama4_router:
-        out = jnp.sum(expert_out, axis=0)  # input already carries the score
-    else:
-        out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
+        weights = route(router_logits, moe, p["router"]).astype(x.dtype)  # (T, E)
+        # dense dispatch: all experts on all tokens, combine contracted over E.
+        # mat_w dequantizes low-bit expert weights in the einsum's operand read.
+        gate = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["gate_proj"], x.dtype))
+        up = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["up_proj"], x.dtype))
+        if moe.llama4_router:
+            # llama4 scales the expert INPUT by the sigmoid score. gate/up are
+            # linear and bias-free on this path, so scaling their OUTPUTS before
+            # the activation is identical (act(s*g(x)) where s*g(x) = g(s*x)) —
+            # avoids materializing an (E, T, H) scaled-input tensor
+            se = jnp.swapaxes(weights, 0, 1)[:, :, None].astype(gate.dtype)  # (E, T, 1)
+            gate = gate * se
+            up = up * se
+        if moe.expert_bias:
+            gate = gate + p["experts"]["gate_proj"]["b"][:, None, :]
+            up = up + p["experts"]["up_proj"]["b"][:, None, :]
+        inner = _expert_act(moe, gate, up)  # (E, T, I)
+        expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p["experts"]["down_proj"], x.dtype))
+        if moe.expert_bias:
+            expert_out = expert_out + p["experts"]["down_proj"]["b"][:, None, :]
+        if moe.llama4_router:
+            out = jnp.sum(expert_out, axis=0)  # input already carries the score
+        else:
+            out = jnp.einsum("te,eth->th", weights, expert_out)  # psum over E under EP
 
     if moe.shared_expert_intermediate_size:
+        from nxdi_tpu.models.base import ACT_FNS
+
+        act = ACT_FNS[moe.hidden_act]
         sp = p["shared_expert"]
         shared = (
             act(xt @ mat_w(sp["gate_proj"], x.dtype)) * (xt @ mat_w(sp["up_proj"], x.dtype))
@@ -242,6 +521,9 @@ def moe_shape_struct(moe: MoEArch, hidden_size: int, num_layers: int, dtype) -> 
     }
     if moe.router_bias:
         struct["router"]["b"] = s(E)
+    if moe.correction_bias:
+        # f32 regardless of model dtype (selection-precision critical)
+        struct["router"]["e_bias"] = jax.ShapeDtypeStruct((num_layers, E), jnp.float32)
     if moe.expert_bias:
         struct["experts"]["gate_proj"]["b"] = s(E, I)
         struct["experts"]["up_proj"]["b"] = s(E, I)

@@ -22,17 +22,17 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_MP, AXIS_TP
 
 # Column parallel: output features sharded over tp  (y = x @ W, W: [in, out/tp])
-COLUMN_PARALLEL = P(None, AXIS_TP)
+COLUMN_PARALLEL = P(None, AXIS_MP)
 # Row parallel: input features sharded over tp; GSPMD adds the psum over tp
-ROW_PARALLEL = P(AXIS_TP, None)
+ROW_PARALLEL = P(AXIS_MP, None)
 # Vocab/Parallel embedding: vocab rows sharded over tp (masked-lookup + psum by GSPMD)
-VOCAB_PARALLEL = P(AXIS_TP, None)
+VOCAB_PARALLEL = P(AXIS_MP, None)
 REPLICATED = P()
 # Per-head sharding for attention params reshaped to (in, heads, head_dim)
-HEAD_PARALLEL = P(None, AXIS_TP, None)
+HEAD_PARALLEL = P(None, AXIS_MP, None)
 
 
 def column_parallel(x, w):

@@ -13,9 +13,14 @@ the analog of the reference's hand-built 8x8 TRN2 physical-topology mesh
 Axis naming convention (used by every PartitionSpec in the framework):
   - ``dp``  — data parallel over requests (attention-DP for decode splits batch)
   - ``cp``  — context parallel (prefill sequence sharding inside the TP world)
-  - ``tp``  — tensor parallel (heads / hidden / vocab / experts)
-The EP axis for MoE reuses ``tp`` via reshaped specs (experts x tp_inner), see
-parallel/moe sharding in ops/moe.py.
+  - ``ep``  — expert parallel (MoE expert dim; size 1 unless moe_ep_degree set)
+  - ``tp``  — tensor parallel (heads / hidden / vocab / expert-intermediate)
+
+Most tensors shard over the FULL model-parallel world — the (ep, tp) axis pair,
+spelled :data:`AXIS_MP` — so that when ``moe_ep_degree`` carves a real ep axis
+out of the world, attention/vocab/MLP sharding is unchanged while MoE experts
+shard over ``ep`` and expert intermediates over ``tp`` (the reference's
+moe_ep_degree x moe_tp_degree factorization, modules/moe_v2.py:135-161).
 """
 
 from __future__ import annotations
@@ -30,58 +35,66 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 AXIS_DP = "dp"
 AXIS_CP = "cp"
 AXIS_TP = "tp"
-AXIS_EP = "ep"  # alias axis used when a dedicated expert-parallel dim is built
+AXIS_EP = "ep"
+# Full model-parallel world: PartitionSpec entries may be tuples of axes, and
+# sharding over ("ep", "tp") with ep-size 1 is identical to sharding over tp.
+AXIS_MP = (AXIS_EP, AXIS_TP)
 
 
 def build_mesh(
     tp_degree: int = 1,
     dp_degree: int = 1,
     cp_degree: int = 1,
+    ep_degree: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
     allow_split_physical_axes: bool = True,
 ) -> Mesh:
-    """Build a ``Mesh`` with axes (dp, cp, tp).
+    """Build a ``Mesh`` with axes (dp, cp, ep, tp).
 
-    ``cp`` and ``dp`` split the TP world the way the reference's CP/DP process
-    groups do (attention_process_groups.py:47 ``get_tp_cp_group_mesh``, :125
-    DP groups): ``tp_degree`` is the WORLD size, and the inner tensor-parallel
-    axis is tp/(dp*cp), so dp*cp*(tp/(dp*cp)) == device count == tp_degree.
+    ``cp``/``dp``/``ep`` split the TP world the way the reference's CP/DP/EP
+    process groups do (attention_process_groups.py:47 ``get_tp_cp_group_mesh``,
+    :125 DP groups, moe_v2.py:135-161 TPxEP groups): ``tp_degree`` is the WORLD
+    size, and the inner tensor-parallel axis is tp/(dp*cp*ep).
     """
-    if tp_degree % (cp_degree * dp_degree) != 0:
+    if tp_degree % (cp_degree * dp_degree * ep_degree) != 0:
         raise ValueError(
-            f"cp_degree*dp_degree ({cp_degree}*{dp_degree}) must divide "
-            f"tp_degree ({tp_degree})"
+            f"cp_degree*dp_degree*ep_degree ({cp_degree}*{dp_degree}*{ep_degree}) "
+            f"must divide tp_degree ({tp_degree})"
         )
-    inner_tp = tp_degree // (cp_degree * dp_degree)
-    n = dp_degree * cp_degree * inner_tp
+    inner_tp = tp_degree // (cp_degree * dp_degree * ep_degree)
+    n = dp_degree * cp_degree * ep_degree * inner_tp
     if devices is None:
         devices = jax.devices()
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     devices = list(devices)[:n]
     if len(devices) == 1:
-        dev_array = np.array(devices).reshape(1, 1, 1)
+        dev_array = np.array(devices).reshape(1, 1, 1, 1)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(
-                (dp_degree, cp_degree, inner_tp),
+                (dp_degree, cp_degree, ep_degree, inner_tp),
                 devices=devices,
                 allow_split_physical_axes=allow_split_physical_axes,
             )
         except (ValueError, AssertionError, NotImplementedError):
-            dev_array = np.array(devices).reshape(dp_degree, cp_degree, inner_tp)
-    return Mesh(dev_array, (AXIS_DP, AXIS_CP, AXIS_TP))
+            dev_array = np.array(devices).reshape(
+                dp_degree, cp_degree, ep_degree, inner_tp
+            )
+    return Mesh(dev_array, (AXIS_DP, AXIS_CP, AXIS_EP, AXIS_TP))
 
 
 def mesh_from_config(tpu_config, devices=None) -> Mesh:
-    """Mesh for a :class:`TpuConfig`: tp_degree is the world size; the cp and
-    attention-dp degrees carve named sub-axes out of it (reference:
+    """Mesh for a :class:`TpuConfig`: tp_degree is the world size; the cp,
+    attention-dp, and moe-ep degrees carve named sub-axes out of it (reference:
     attention_process_groups.py:81,125 building CP/DP groups over the TP
-    world). Submodels that don't use an axis simply leave it unsharded."""
+    world; moe_v2.py:135-161 EP groups). Submodels that don't use an axis
+    simply leave it unsharded."""
     return build_mesh(
         tp_degree=tpu_config.tp_degree,
         dp_degree=tpu_config.attention_dp_degree,
         cp_degree=tpu_config.cp_degree,
+        ep_degree=getattr(tpu_config, "moe_ep_degree", None) or 1,
         devices=devices,
     )
 

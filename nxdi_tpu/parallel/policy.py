@@ -39,7 +39,7 @@ from dataclasses import dataclass
 
 from jax.sharding import PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_MP
 
 
 @dataclass(frozen=True)
@@ -51,10 +51,10 @@ class ShardingPolicy:
     """
 
     hidden: P = P()
-    q: P = P(None, AXIS_TP, None, None)
-    kv: P = P(None, AXIS_TP, None, None)
-    cache_kv: P = P(None, AXIS_TP, None, None)
-    logits: P = P(None, None, AXIS_TP)
+    q: P = P(None, AXIS_MP, None, None)
+    kv: P = P(None, AXIS_MP, None, None)
+    cache_kv: P = P(None, AXIS_MP, None, None)
+    logits: P = P(None, None, AXIS_MP)
 
 
 DEFAULT_POLICY = ShardingPolicy()
@@ -67,13 +67,13 @@ def context_encoding_policy(tc) -> ShardingPolicy:
         # CP: S over cp for activations and Q; KV cp-replicated (all-gather)
         return ShardingPolicy(
             hidden=P(None, AXIS_CP, None),
-            q=P(None, AXIS_TP, AXIS_CP, None),
-            kv=P(None, AXIS_TP, None, None),
+            q=P(None, AXIS_MP, AXIS_CP, None),
+            kv=P(None, AXIS_MP, None, None),
         )
     if tc.sequence_parallel_enabled:
         # SP: inter-layer activations S-sharded over tp; attention runs with
         # full heads per rank (GSPMD re-shards at the QKV boundary)
-        return ShardingPolicy(hidden=P(None, AXIS_TP, None))
+        return ShardingPolicy(hidden=P(None, AXIS_MP, None))
     return DEFAULT_POLICY
 
 
@@ -83,15 +83,15 @@ def token_generation_policy(tc) -> ShardingPolicy:
     if tc.attention_dp_degree > 1:
         return ShardingPolicy(
             hidden=P(AXIS_DP, None, None),
-            q=P(AXIS_DP, AXIS_TP, None, None),
-            kv=P(AXIS_DP, AXIS_TP, None, None),
-            cache_kv=P(AXIS_DP, AXIS_TP, None, None),
-            logits=P(AXIS_DP, None, AXIS_TP),
+            q=P(AXIS_DP, AXIS_MP, None, None),
+            kv=P(AXIS_DP, AXIS_MP, None, None),
+            cache_kv=P(AXIS_DP, AXIS_MP, None, None),
+            logits=P(AXIS_DP, None, AXIS_MP),
         )
     if tc.flash_decoding_enabled:
         # KV-S sharding: cache (and its windowed read) S-sharded over cp;
         # scores (B,H,1,W) inherit the W sharding -> distributed softmax
-        return ShardingPolicy(cache_kv=P(None, AXIS_TP, AXIS_CP, None))
+        return ShardingPolicy(cache_kv=P(None, AXIS_MP, AXIS_CP, None))
     return DEFAULT_POLICY
 
 
@@ -100,7 +100,7 @@ def kv_cache_partition_spec_for(tc) -> P:
     (reference analogs: DataParallelKVCacheManager batch split, flashdecode
     get_cache_size S split)."""
     if tc.attention_dp_degree > 1:
-        return P(None, AXIS_DP, AXIS_TP, None, None)
+        return P(None, AXIS_DP, AXIS_MP, None, None)
     if tc.flash_decoding_enabled:
-        return P(None, None, AXIS_TP, AXIS_CP, None)
-    return P(None, None, AXIS_TP, None, None)
+        return P(None, None, AXIS_MP, AXIS_CP, None)
+    return P(None, None, AXIS_MP, None, None)

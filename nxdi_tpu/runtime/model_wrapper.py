@@ -33,12 +33,17 @@ from nxdi_tpu.runtime.padding import pad_with_first_batchline
 def kv_layout_from_config(tc):
     """The KV layout every submodel of this app compiles against
     (reference: config flags is_block_kv_layout / is_continuous_batching,
-    models/config.py:278-283)."""
+    models/config.py:278-283). Scaled fp8 KV (scale_mode="per_tensor",
+    kv_cache_manager.py:642-692) rides the layout as static scales."""
+    kvq = tc.kv_quant_config
+    scales = {}
+    if kvq is not None and kvq.scale_mode == "per_tensor":
+        scales = {"k_scale": kvq.k_scale, "v_scale": kvq.v_scale}
     if tc.is_block_kv_layout:
-        return BlockKVLayout(block_size=tc.pa_block_size)
+        return BlockKVLayout(block_size=tc.pa_block_size, **scales)
     if tc.is_continuous_batching:
-        return ContiguousKVLayout(route_by_seq_id=True)
-    return ContiguousKVLayout()
+        return ContiguousKVLayout(route_by_seq_id=True, **scales)
+    return ContiguousKVLayout(**scales)
 
 TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"

@@ -133,6 +133,40 @@ def _tiny_hf(model_type):
             eos_token_id=None,
         )
         model = DeepseekV3ForCausalLM(cfg)
+    elif model_type == "deepseek_v3_moe":
+        from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+        # V3 MoE: sigmoid grouped-top-k router w/ correction bias, shared
+        # expert, one leading dense layer (segmented layer scan)
+        cfg = DeepseekV3Config(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=4,
+            num_attention_heads=8,
+            num_key_value_heads=8,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rms_norm_eps=1e-5,
+            rope_theta=10000.0,
+            q_lora_rank=32,
+            kv_lora_rank=32,
+            qk_rope_head_dim=8,
+            qk_nope_head_dim=16,
+            v_head_dim=16,
+            first_k_dense_replace=1,
+            n_routed_experts=8,
+            num_experts_per_tok=2,
+            moe_intermediate_size=32,
+            n_group=4,
+            topk_group=2,
+            n_shared_experts=1,
+            norm_topk_prob=True,
+            routed_scaling_factor=2.5,
+            rope_scaling=None,
+            tie_word_embeddings=False,
+            eos_token_id=None,
+        )
+        model = DeepseekV3ForCausalLM(cfg)
     elif model_type == "llama4_text":
         from transformers.models.llama4.modeling_llama4 import Llama4ForCausalLM
         from transformers import Llama4TextConfig
@@ -213,7 +247,7 @@ def _tiny_hf(model_type):
 
 
 def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
-    family, cfg_cls = get_family(model_type)
+    family, cfg_cls = get_family(model_type.replace("_moe", "") if model_type.startswith("deepseek") else model_type)
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
     tcfg = TpuConfig(
         tp_degree=tp_degree,
@@ -238,7 +272,8 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
 @pytest.mark.parametrize(
     "model_type",
     ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "gemma2",
-     "phi3", "gpt2", "dbrx", "gpt_oss", "deepseek_v3", "llama4_text"]
+     "phi3", "gpt2", "dbrx", "gpt_oss", "deepseek_v3", "deepseek_v3_moe",
+     "llama4_text"]
 )
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):

@@ -80,7 +80,7 @@ def test_mesh_axes_from_config():
 
     tc = TpuConfig(tp_degree=8, cp_degree=2, attention_dp_degree=2, batch_size=2)
     mesh = mesh_from_config(tc)
-    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "cp": 2, "tp": 2}
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "cp": 2, "ep": 1, "tp": 2}
 
 
 def test_flash_decoding_requires_single_bucket():
@@ -98,10 +98,10 @@ def test_cache_partition_spec_variants():
     from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
 
     tc = TpuConfig(tp_degree=8, attention_dp_degree=2, batch_size=2)
-    assert kv_cache_partition_spec(tc)["k"] == P(None, "dp", "tp", None, None)
+    assert kv_cache_partition_spec(tc)["k"] == P(None, "dp", ("ep", "tp"), None, None)
     tc = TpuConfig(tp_degree=8, cp_degree=2, flash_decoding_enabled=True)
-    assert kv_cache_partition_spec(tc)["k"] == P(None, None, "tp", "cp", None)
-    assert kv_cache_partition_spec(None)["k"] == P(None, None, "tp", None, None)
+    assert kv_cache_partition_spec(tc)["k"] == P(None, None, ("ep", "tp"), "cp", None)
+    assert kv_cache_partition_spec(None)["k"] == P(None, None, ("ep", "tp"), None, None)
 
 
 @pytest.mark.parametrize(

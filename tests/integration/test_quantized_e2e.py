@@ -191,6 +191,46 @@ def test_kv_cache_fp8_quant(tiny_hf_llama):
     assert match >= 0.75, (actual, expected)
 
 
+def test_kv_cache_fp8_per_tensor_scaled(tiny_hf_llama):
+    """Scaled fp8 KV cache (scale_mode="per_tensor"): values stored as v/scale
+    and rescaled on read (reference: calibrated scale buffers,
+    kv_cache_manager.py:642-692). With a scale the quantized rollout must
+    still track the f32 golden; an absurd scale must change tokens (proving
+    the scale actually flows through the compiled program)."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(
+        hf_model, hf_cfg,
+        kv_quant_config={"dtype": "float8_e4m3", "scale_mode": "per_tensor",
+                         "k_scale": 0.5, "v_scale": 0.5},
+    )
+    assert app.kv_cache["k"].dtype.name.startswith("float8")
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=8)
+    actual = adapter.generate(prompt, max_new_tokens=8)
+    match = (actual == expected).mean()
+    assert match >= 0.75, (actual, expected)
+
+    # degenerate scale wrecks the cache contents -> rollout must diverge,
+    # i.e. the scale is not a silent no-op
+    app_bad = build_app(
+        hf_model, hf_cfg,
+        kv_quant_config={"dtype": "float8_e4m3", "scale_mode": "per_tensor",
+                         "k_scale": 1e-6, "v_scale": 1e-6},
+    )
+    bad = HuggingFaceGenerationAdapter(app_bad).generate(prompt, max_new_tokens=8)
+    assert not np.array_equal(bad, expected)
+
+
+def test_kv_quant_scale_mode_validation():
+    from nxdi_tpu.config import KVQuantizationConfig
+
+    with pytest.raises(ValueError, match="scale_mode"):
+        KVQuantizationConfig(scale_mode="per_channel")
+    with pytest.raises(ValueError, match="per_tensor"):
+        KVQuantizationConfig(scale_mode="direct_cast", k_scale=0.5)
+
+
 def test_mxfp4_e2e_rollout(tiny_hf_llama):
     """MXFP4 weights produce a sane rollout and differ from the base model
     (reference pairing: gpt-oss MXFP4 — here proven on the shared linear path)."""
