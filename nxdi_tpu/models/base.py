@@ -117,6 +117,10 @@ class DecoderArch:
     attention_o_bias: bool = False
     # YaRN attention factor multiplying cos/sin (gpt-oss, deepseek)
     rope_mscale: float = 1.0
+    # LongRoPE (phi3 128k): inv_freq arrives stacked (2, D/2) [short, long];
+    # the long set activates in-graph when max(position)+1 exceeds this
+    # (HF _longrope_frequency_update semantics)
+    longrope_original_max: Optional[int] = None
     # Multi-head Latent Attention replaces the GQA attention when set
     # (ops/mla.py; deepseek lineage)
     mla: Optional[Any] = None
@@ -663,7 +667,23 @@ def causal_lm_forward(
         )
     hidden = constrain(hidden, policy.hidden)
     inv_freq = np.asarray(inv_freq)
-    if inv_freq.ndim == 2:  # (2, D/2): [global, local] thetas (gemma3)
+    if arch.longrope_original_max is not None and inv_freq.ndim == 2:
+        # LongRoPE: [short, long] frequency sets, selected per forward from
+        # the true max position (padding lanes continue the arange past the
+        # real last token, so read positions at last_token_index)
+        cos_s, sin_s = rope_cos_sin(position_ids, inv_freq[0], dtype=jnp.float32)
+        cos_l, sin_l = rope_cos_sin(position_ids, inv_freq[1], dtype=jnp.float32)
+        if "last_token_index" in batch:
+            real_last = jnp.take_along_axis(
+                position_ids, batch["last_token_index"][:, None], axis=1
+            )
+            seq_len_now = jnp.max(real_last) + 1
+        else:
+            seq_len_now = jnp.max(position_ids) + 1
+        is_long = seq_len_now > arch.longrope_original_max
+        cos = jnp.where(is_long, cos_l, cos_s)
+        sin = jnp.where(is_long, sin_l, sin_s)
+    elif inv_freq.ndim == 2:  # (2, D/2): [global, local] thetas (gemma3)
         cos_g, sin_g = rope_cos_sin(position_ids, inv_freq[0], dtype=jnp.float32)
         cos_l, sin_l = rope_cos_sin(position_ids, inv_freq[1], dtype=jnp.float32)
         cos = jnp.stack([cos_g, cos_l])

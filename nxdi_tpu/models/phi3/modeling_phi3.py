@@ -3,9 +3,10 @@
 Llama-lineage decoder whose checkpoints fuse the projections:
 ``qkv_proj`` holds Q|K|V stacked on the out dim, ``gate_up_proj`` holds
 gate|up. Conversion splits them into the shared dense layout; everything else
-(rms norms, silu MLP, default rope) is the stock pipeline. The 128k-context
-'longrope' scaling variant is NOT implemented yet — those checkpoints are
-rejected by the rope scaling dispatch.
+(rms norms, silu MLP) is the stock pipeline. The 128k-context LongRoPE
+variant ships [short, long] frequency sets picked in-graph per forward
+(ops/rope.py longrope_inv_freq + models/base.py selection), with the
+attention factor riding DecoderArch.rope_mscale.
 """
 
 from __future__ import annotations
@@ -17,19 +18,52 @@ import numpy as np
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
-
-build_inv_freq = dense.build_inv_freq
+from nxdi_tpu.ops.rope import longrope_inv_freq
 
 
 class Phi3InferenceConfig(dense.DenseInferenceConfig):
     pass
 
 
+def _longrope(config: InferenceConfig):
+    rs = getattr(config, "rope_scaling", None)
+    if rs and rs.get("rope_type", rs.get("type")) == "longrope":
+        return rs
+    return None
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    rs = _longrope(config)
+    if rs is None:
+        return dense.build_inv_freq(config)
+    return longrope_inv_freq(
+        dense.head_dim_of(config),
+        getattr(config, "rope_theta", 10000.0),
+        rs,
+        config.max_position_embeddings,
+        getattr(config, "original_max_position_embeddings", None)
+        or config.max_position_embeddings,
+    )[0]
+
+
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    return dense.build_arch(
-        config,
-        **{"sliding_window": getattr(config, "sliding_window", None), **overrides},
-    )
+    kwargs: Dict[str, Any] = {"sliding_window": getattr(config, "sliding_window", None)}
+    rs = _longrope(config)
+    if rs is not None:
+        orig = (
+            getattr(config, "original_max_position_embeddings", None)
+            or config.max_position_embeddings
+        )
+        kwargs["longrope_original_max"] = orig
+        kwargs["rope_mscale"] = longrope_inv_freq(
+            dense.head_dim_of(config),
+            getattr(config, "rope_theta", 10000.0),
+            rs,
+            config.max_position_embeddings,
+            orig,
+        )[1]
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
 
 
 def convert_hf_state_dict(

@@ -11,6 +11,8 @@ over the bucketed dims and lets XLA fuse the trig into the surrounding ops.
 
 from __future__ import annotations
 
+import math
+
 import jax.numpy as jnp
 import numpy as np
 
@@ -127,6 +129,43 @@ def inv_freq_from_hf_config(
         return default_inv_freq(head_dim, rope_theta)
     # yarn etc.: failing loudly beats silently wrong long-context rotations
     raise ValueError(f"Unsupported rope scaling type: {rope_type}")
+
+
+def longrope_inv_freq(
+    head_dim: int,
+    rope_theta: float,
+    rope_scaling: dict,
+    max_position_embeddings: int,
+    original_max_position_embeddings: int,
+):
+    """LongRoPE (phi3 128k lineage) frequencies + attention factor.
+
+    Matches HF ``_compute_longrope_parameters``: per-channel rescale factors
+    (``short_factor`` within the pretrained window, ``long_factor`` beyond it)
+    and a cos/sin scale ``sqrt(1 + ln(factor)/ln(orig_max))`` where factor =
+    max_position/original_max. Returns a STACKED (2, D/2) array
+    [short, long]; the regime is selected in-graph per forward from
+    ``max(position_ids)+1 > original_max`` (models/base.py), mirroring HF's
+    dynamic frequency update."""
+    short = np.asarray(rope_scaling["short_factor"], np.float32)
+    long = np.asarray(rope_scaling["long_factor"], np.float32)
+    exponents = np.arange(0, head_dim, 2, dtype=np.float32) / head_dim
+    base = rope_theta ** exponents
+    factor = rope_scaling.get("factor")
+    if original_max_position_embeddings:
+        factor = max_position_embeddings / original_max_position_embeddings
+    attention_factor = rope_scaling.get("attention_factor")
+    if attention_factor is None:
+        if factor is None or factor <= 1.0:
+            attention_factor = 1.0
+        else:
+            attention_factor = math.sqrt(
+                1 + math.log(factor) / math.log(original_max_position_embeddings)
+            )
+    return (
+        np.stack([1.0 / (short * base), 1.0 / (long * base)]),
+        float(attention_factor),
+    )
 
 
 def rope_cos_sin(position_ids, inv_freq, dtype=jnp.float32):

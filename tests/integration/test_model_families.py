@@ -196,6 +196,32 @@ def _tiny_hf(model_type):
             eos_token_id=None,
         )
         model = Llama4ForCausalLM(cfg)
+    elif model_type == "phi3_longrope":
+        from transformers import Phi3Config, Phi3ForCausalLM
+
+        # LongRoPE: [short, long] factor sets with in-graph regime switch; the
+        # tiny original_max (16) forces the long set to activate mid-rollout
+        cfg = Phi3Config(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            max_position_embeddings=64,
+            original_max_position_embeddings=16,
+            rms_norm_eps=1e-5,
+            rope_theta=10000.0,
+            rope_scaling={
+                "type": "longrope",
+                "short_factor": [1.0 + 0.05 * i for i in range(8)],
+                "long_factor": [2.0 + 0.25 * i for i in range(8)],
+            },
+            tie_word_embeddings=False,
+            eos_token_id=None,
+            pad_token_id=0,
+        )
+        model = Phi3ForCausalLM(cfg)
     elif model_type == "gpt2":
         from transformers import GPT2Config, GPT2LMHeadModel
 
@@ -247,7 +273,7 @@ def _tiny_hf(model_type):
 
 
 def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
-    family, cfg_cls = get_family(model_type.replace("_moe", "") if model_type.startswith("deepseek") else model_type)
+    family, cfg_cls = get_family(model_type.split("_longrope")[0].replace("_moe", "") if model_type.startswith(("deepseek", "phi3")) else model_type)
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
     tcfg = TpuConfig(
         tp_degree=tp_degree,
@@ -272,8 +298,8 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
 @pytest.mark.parametrize(
     "model_type",
     ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "gemma2",
-     "phi3", "gpt2", "dbrx", "gpt_oss", "deepseek_v3", "deepseek_v3_moe",
-     "llama4_text"]
+     "phi3", "phi3_longrope", "gpt2", "dbrx", "gpt_oss", "deepseek_v3",
+     "deepseek_v3_moe", "llama4_text"]
 )
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):
