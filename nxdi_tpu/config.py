@@ -317,6 +317,10 @@ class TpuConfig:
         self.cp_degree = kwargs.pop("cp_degree", 1)
         self.attention_dp_degree = kwargs.pop("attention_dp_degree", 1)
         self.pp_degree = kwargs.pop("pp_degree", 1)
+        # microbatches per pipelined forward (GPipe rotation over the batch
+        # dim; reference: pp_degree plumbed via NxD ModelBuilder,
+        # application_base.py:158-163). 0 = use pp_degree.
+        self.pp_microbatches = kwargs.pop("pp_microbatches", 0)
         self.ep_degree = kwargs.pop("ep_degree", 1)
         self.moe_tp_degree = kwargs.pop("moe_tp_degree", None)
         self.moe_ep_degree = kwargs.pop("moe_ep_degree", None)
@@ -402,6 +406,26 @@ class TpuConfig:
                     "the cache sequence dim is sharded and cannot be re-windowed "
                     "per bucket"
                 )
+        if self.pp_degree > 1:
+            n_micro = self.pp_microbatches or self.pp_degree
+            if self.is_block_kv_layout:
+                raise ValueError(
+                    "pipeline parallel composes with the contiguous KV layout "
+                    "only (the paged pool is not batch-addressable per stage)"
+                )
+            if self.flash_decoding_enabled or self.attention_dp_degree > 1 or self.cp_degree > 1:
+                raise ValueError(
+                    "pipeline parallel currently composes with tp/sp only "
+                    "(cp / attention-dp / flash-decoding also reshard the "
+                    "batch or cache dims the pipeline microbatches over)"
+                )
+            for name, bs in (("batch_size", self.batch_size),
+                             ("ctx_batch_size", self.ctx_batch_size),
+                             ("tkg_batch_size", self.tkg_batch_size)):
+                if bs and bs % n_micro != 0:
+                    raise ValueError(
+                        f"{name} ({bs}) must be divisible by pp_microbatches ({n_micro})"
+                    )
         if self.is_medusa and self.num_medusa_heads <= 0:
             raise ValueError("is_medusa requires num_medusa_heads > 0")
         if self.lora_config is not None and self.async_mode:

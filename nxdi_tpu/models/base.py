@@ -48,7 +48,7 @@ from nxdi_tpu.parallel.layers import (
     VOCAB_PARALLEL,
     constrain,
 )
-from nxdi_tpu.parallel.mesh import AXIS_MP
+from nxdi_tpu.parallel.mesh import AXIS_MP, AXIS_PP
 from nxdi_tpu.parallel.policy import DEFAULT_POLICY, ShardingPolicy
 
 ACT_FNS: Dict[str, Callable] = {
@@ -90,6 +90,12 @@ class DecoderArch:
     # Pallas kernel gates (reference: attn_kernel_enabled flags config.py:418-533)
     attn_kernel_enabled: bool = False
     attn_tkg_kernel_enabled: bool = False
+    attn_block_tkg_kernel_enabled: bool = False  # paged decode through table
+    # pipeline parallel: layer stack sharded over the pp mesh axis, GPipe
+    # microbatch rotation in run_decoder_layers (reference: pp_degree,
+    # models/config.py:366, application_base.py:158-163)
+    pp_degree: int = 1
+    pp_microbatches: int = 0  # 0 = pp_degree
     # dynamic activation quantization (reference: ActivationQuantizationType
     # config.py:434-517); weights themselves are quantized in the params pytree
     act_quant: Optional[str] = None
@@ -121,6 +127,11 @@ class DecoderArch:
     # the long set activates in-graph when max(position)+1 exceeds this
     # (HF _longrope_frequency_update semantics)
     longrope_original_max: Optional[int] = None
+    # Qwen2-VL M-RoPE: head_dim/2 frequency channels partitioned into
+    # [temporal, height, width] sections; batch supplies (B, 3, S) position
+    # streams as "mrope_position_ids" (reference: models/qwen2_vl/ M-RoPE)
+    mrope_section: Optional[Tuple[int, ...]] = None
+    mrope_interleaved: bool = False  # qwen3-vl channel-interleaved layout
     # Multi-head Latent Attention replaces the GQA attention when set
     # (ops/mla.py; deepseek lineage)
     mla: Optional[Any] = None
@@ -206,10 +217,16 @@ def decoder_param_specs(arch: DecoderArch) -> Dict[str, Any]:
     (P(None, ...) prefix is implicit: specs rank-match via GSPMD trailing rules,
     so we write them explicitly below)."""
 
+    # layer-stacked leaves: the leading (layer) axis shards over pp when
+    # pipeline parallel is on — each stage holds its contiguous layer slice
+    layer_axis = AXIS_PP if arch.pp_degree > 1 else None
+
     def stack(spec_tree):
-        # prepend a None (layer) axis to every leaf spec
+        # prepend the layer axis to every leaf spec
         return jax.tree_util.tree_map(
-            lambda s: P(*((None,) + tuple(s))), spec_tree, is_leaf=lambda x: isinstance(x, P)
+            lambda s: P(*((layer_axis,) + tuple(s))),
+            spec_tree,
+            is_leaf=lambda x: isinstance(x, P),
         )
 
     layer_specs = {
@@ -360,6 +377,39 @@ def attention_block(
     new_k, new_v = layout.update(k_cache_l, v_cache_l, k, v, ci, cache_spec)
 
     if attend_to_cache:
+        # paged decode: read K/V straight through the block table inside the
+        # kernel — skips the materialized O(table-width) gather of
+        # BlockKVLayout.read (reference: NKI block-TKG kernel,
+        # attention_base.py:50-162)
+        if (
+            isinstance(layout, BlockKVLayout)
+            and arch.attn_block_tkg_kernel_enabled
+            and S == 1
+            and "block_table" in ci
+            and ci.get("attn_mask") is None
+            and not arch.attention_sink
+            and arch.attn_logit_softcap is None
+            and arch.sliding_window is None
+            and arch.chunk_size is None
+            and window_enabled is None
+            and use_rope is None
+            and attn_kernels.paged_decode_kernel_supported(
+                q.shape, new_k.shape, layout.block_size
+            )
+        ):
+            ctx = attn_kernels.sharded_paged_decode_call(
+                policy, q, new_k, new_v, ci["block_table"], position_ids,
+                block_size=layout.block_size,
+                scale=arch.attention_scale,
+                k_scale=layout.k_scale,
+                v_scale=layout.v_scale,
+            )
+            if ctx is not None:
+                ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+                out = _linear(
+                    ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
+                )
+                return out, (new_k, new_v)
         kk, vv, kv_pos = layout.read(new_k, new_v, ci, cache_spec)
         kk = constrain(kk, policy.cache_kv)
         vv = constrain(vv, policy.cache_kv)
@@ -521,6 +571,113 @@ def decoder_layer(
     return hidden, (nk, nv)
 
 
+def _pipelined_decoder_layers(
+    arch, layer_params, hidden, cos, sin, cache, position_ids, step_fn,
+    cache_inputs, adapter_ids,
+):
+    """GPipe-style pipeline over the ``pp`` mesh axis.
+
+    TPU-native pipeline parallel (reference: pp_degree through the NxD
+    ModelBuilder, models/config.py:366, application_base.py:158-163 — the
+    reference delegates the schedule to its builder; here it is explicit).
+    Mechanism: ``shard_map`` manual over ``pp`` only (tp/ep/... stay under
+    GSPMD), the layer-stacked params and the cache sharded on their leading
+    layer dim so each stage owns a contiguous slice of layers + stage-local
+    KV. The batch splits into M microbatches; for ``T = M + pp - 1`` ticks
+    each stage scans its local layers over its current microbatch and hands
+    the activations to the next stage with a ring ``ppermute`` — collectives
+    ride ICI, bubble fraction (pp-1)/(M+pp-1).
+
+    Bubble ticks still compute (SPMD requires it) but write back the old
+    cache values, so garbage never lands.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    pp = arch.pp_degree
+    n_micro = arch.pp_microbatches or pp
+    B = hidden.shape[0]
+    if B % n_micro:
+        raise ValueError(f"batch {B} not divisible by pp microbatches {n_micro}")
+    mb = B // n_micro
+    ci = cache_inputs or {}
+    cos_baxis = 0 if cos.ndim == 3 else 1  # stacked rope variants: (2, B, S, D)
+
+    def slice_b(x, i, axis=0):
+        return jax.lax.dynamic_slice_in_dim(x, i * mb, mb, axis)
+
+    def staged(params_local, k_local, v_local, hidden_all, cos_, sin_, pos_, ci_, ad_):
+        stage = jax.lax.axis_index(AXIS_PP)
+
+        def scan_body(mb_ctx):
+            cos_m, sin_m, pos_m, ci_m, ad_m = mb_ctx
+
+            def body(h, xs):
+                lp, kl, vl = xs
+                h, nk, nv = step_fn(h, lp, kl, vl, cos_m, sin_m, pos_m, ci_m, ad_m)
+                return h, (nk, nv)
+
+            return body
+
+        def tick(t, carry):
+            h, out, kl, vl = carry
+            i = t - stage  # this stage's microbatch index at tick t
+            i_c = jnp.clip(i, 0, n_micro - 1)
+            valid = (i >= 0) & (i < n_micro)
+            ctx = (
+                slice_b(cos_, i_c, cos_baxis),
+                slice_b(sin_, i_c, cos_baxis),
+                slice_b(pos_, i_c),
+                {k: slice_b(v, i_c) for k, v in ci_.items()},
+                None if ad_ is None else slice_b(ad_, i_c),
+            )
+            k_mb = jax.lax.dynamic_slice_in_dim(kl, i_c * mb, mb, axis=1)
+            v_mb = jax.lax.dynamic_slice_in_dim(vl, i_c * mb, mb, axis=1)
+            h_out, (k_new, v_new) = jax.lax.scan(
+                scan_body(ctx), h, (params_local, k_mb, v_mb)
+            )
+            # bubble ticks write back the old values (no-op update)
+            k_new = jnp.where(valid, k_new, k_mb)
+            v_new = jnp.where(valid, v_new, v_mb)
+            kl = jax.lax.dynamic_update_slice_in_dim(kl, k_new, i_c * mb, axis=1)
+            vl = jax.lax.dynamic_update_slice_in_dim(vl, v_new, i_c * mb, axis=1)
+            # the last stage banks finished microbatches
+            banked = jax.lax.dynamic_update_slice_in_dim(out, h_out[None], i_c, 0)
+            out = jnp.where(valid & (stage == pp - 1), banked, out)
+            # ring-shift activations to the next stage; stage 0 feeds the
+            # next microbatch from the embedded input
+            h_next = jax.lax.ppermute(
+                h_out, AXIS_PP, [(s, (s + 1) % pp) for s in range(pp)]
+            )
+            feed = slice_b(hidden_all, jnp.clip(t + 1, 0, n_micro - 1))
+            h = jnp.where(stage == 0, feed, h_next)
+            return h, out, kl, vl
+
+        h0 = slice_b(hidden_all, 0)
+        out0 = jnp.zeros((n_micro,) + h0.shape, h0.dtype)
+        h_fin, out, k_fin, v_fin = jax.lax.fori_loop(
+            0, n_micro + pp - 1, tick, (h0, out0, k_local, v_local)
+        )
+        # replicate the last stage's banked outputs to every stage
+        out = jax.lax.psum(
+            jnp.where(stage == pp - 1, out, jnp.zeros_like(out)), AXIS_PP
+        )
+        return out, k_fin, v_fin
+
+    p_specs = jax.tree_util.tree_map(lambda _: P(AXIS_PP), layer_params)
+    ci_specs = {k: P() for k in ci}
+    out, new_k, new_v = jax.shard_map(
+        staged,
+        mesh=mesh,
+        in_specs=(p_specs, P(AXIS_PP), P(AXIS_PP), P(), P(), P(), P(), ci_specs,
+                  P() if adapter_ids is not None else None),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        axis_names={AXIS_PP},
+        check_vma=False,
+    )(layer_params, cache["k"], cache["v"], hidden, cos, sin, position_ids, ci,
+      adapter_ids)
+    hidden_out = out.reshape((B,) + out.shape[2:])
+    return hidden_out, {"k": new_k, "v": new_v}
+
+
 def run_decoder_layers(
     arch: DecoderArch,
     layer_params: Dict[str, Any],  # layer-stacked pytree
@@ -537,8 +694,13 @@ def run_decoder_layers(
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     collect_hidden: bool = False,
     adapter_ids: Optional[jax.Array] = None,
+    layer_injections: Optional[jax.Array] = None,  # (L, B, S, hidden) or None
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
+
+    ``layer_injections``: per-layer residual additions applied AFTER each
+    layer (qwen3-vl deepstack: vision features summed into the first K
+    layers' outputs at visual positions — reference: _deepstack_process).
 
     ``kv_window`` statically truncates the attended cache to the bucket's token
     budget (reference: per-bucket compiled TKG programs attend only bucket-many
@@ -553,21 +715,63 @@ def run_decoder_layers(
 
     windowable = not isinstance(layout, BlockKVLayout)
 
-    def body(h, xs):
-        lp, kl, vl = xs
+    def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_):
+        """One decoder layer with the bucket's static KV window applied."""
         if windowable and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
-                arch, lp, h, cos, sin, k_win, v_win, position_ids, cache_spec,
-                attend_to_cache, policy, layout, cache_inputs, adapter_ids,
+                arch, lp, h, cos_, sin_, k_win, v_win, pos_, cache_spec,
+                attend_to_cache, policy, layout, ci_, ad_,
             )
             nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
             nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
         else:
             h, (nk, nv) = decoder_layer(
-                arch, lp, h, cos, sin, kl, vl, position_ids, cache_spec,
-                attend_to_cache, policy, layout, cache_inputs, adapter_ids,
+                arch, lp, h, cos_, sin_, kl, vl, pos_, cache_spec,
+                attend_to_cache, policy, layout, ci_, ad_,
             )
+        return h, nk, nv
+
+    if arch.pp_degree > 1:
+        segments_chk = (
+            list(layer_params) if isinstance(layer_params, (list, tuple)) else [layer_params]
+        )
+        if len(segments_chk) != 1:
+            raise NotImplementedError(
+                "pipeline parallel requires a homogeneous layer stack "
+                "(heterogeneous segment models are not pp-sharded yet)"
+            )
+        if collect_hidden:
+            raise NotImplementedError(
+                "collect_hidden (EAGLE3 aux taps / tensor capture) is not "
+                "supported under pipeline parallel"
+            )
+        if layer_injections is not None:
+            raise NotImplementedError(
+                "deepstack layer injections are not supported under "
+                "pipeline parallel"
+            )
+        n_layers_chk = jax.tree_util.tree_leaves(segments_chk[0])[0].shape[0]
+        if n_layers_chk % arch.pp_degree:
+            raise ValueError(
+                f"num_layers ({n_layers_chk}) must be divisible by pp_degree "
+                f"({arch.pp_degree}) — pipeline stages hold equal layer slices"
+            )
+        return _pipelined_decoder_layers(
+            arch, segments_chk[0], hidden, cos, sin, cache, position_ids,
+            _step, cache_inputs, adapter_ids,
+        )
+
+    def body(h, xs):
+        if layer_injections is not None:
+            lp, kl, vl, inj = xs
+        else:
+            (lp, kl, vl), inj = xs, None
+        h, nk, nv = _step(
+            h, lp, kl, vl, cos, sin, position_ids, cache_inputs, adapter_ids
+        )
+        if inj is not None:
+            h = h + inj.astype(h.dtype)
         return h, ((nk, nv, h) if collect_hidden else (nk, nv))
 
     # Heterogeneous stacks (deepseek-V3 first_k_dense_replace, minimax) arrive
@@ -583,7 +787,12 @@ def run_decoder_layers(
         n_seg = jax.tree_util.tree_leaves(seg)[0].shape[0]
         k_seg = jax.lax.slice_in_dim(cache["k"], off, off + n_seg, axis=0)
         v_seg = jax.lax.slice_in_dim(cache["v"], off, off + n_seg, axis=0)
-        hidden, ys = jax.lax.scan(body, hidden, (seg, k_seg, v_seg))
+        if layer_injections is not None:
+            inj_seg = jax.lax.slice_in_dim(layer_injections, off, off + n_seg, axis=0)
+            xs = (seg, k_seg, v_seg, inj_seg)
+        else:
+            xs = (seg, k_seg, v_seg)
+        hidden, ys = jax.lax.scan(body, hidden, xs)
         off += n_seg
         if collect_hidden:
             ks.append(ys[0]); vs.append(ys[1]); hs.append(ys[2])
@@ -667,12 +876,20 @@ def causal_lm_forward(
         )
     hidden = constrain(hidden, policy.hidden)
     inv_freq = np.asarray(inv_freq)
-    if arch.longrope_original_max is not None and inv_freq.ndim == 2:
+    if arch.mrope_section is not None and "mrope_position_ids" in batch:
+        from nxdi_tpu.ops.rope import mrope_cos_sin
+
+        cos, sin = mrope_cos_sin(
+            batch["mrope_position_ids"][..., : input_ids.shape[1]],
+            inv_freq, arch.mrope_section, dtype=jnp.float32,
+            interleaved=arch.mrope_interleaved,
+        )
+    elif arch.longrope_original_max is not None and inv_freq.ndim == 2:
         # LongRoPE: [short, long] frequency sets, selected per forward from
         # the true max position (padding lanes continue the arange past the
-        # real last token, so read positions at last_token_index)
-        cos_s, sin_s = rope_cos_sin(position_ids, inv_freq[0], dtype=jnp.float32)
-        cos_l, sin_l = rope_cos_sin(position_ids, inv_freq[1], dtype=jnp.float32)
+        # real last token, so read positions at last_token_index). The regime
+        # is a scalar, so select the frequency SET before the trig — one
+        # cos/sin build instead of two.
         if "last_token_index" in batch:
             real_last = jnp.take_along_axis(
                 position_ids, batch["last_token_index"][:, None], axis=1
@@ -681,8 +898,8 @@ def causal_lm_forward(
         else:
             seq_len_now = jnp.max(position_ids) + 1
         is_long = seq_len_now > arch.longrope_original_max
-        cos = jnp.where(is_long, cos_l, cos_s)
-        sin = jnp.where(is_long, sin_l, sin_s)
+        inv = jnp.where(is_long, jnp.asarray(inv_freq[1]), jnp.asarray(inv_freq[0]))
+        cos, sin = rope_cos_sin(position_ids, inv, dtype=jnp.float32)
     elif inv_freq.ndim == 2:  # (2, D/2): [global, local] thetas (gemma3)
         cos_g, sin_g = rope_cos_sin(position_ids, inv_freq[0], dtype=jnp.float32)
         cos_l, sin_l = rope_cos_sin(position_ids, inv_freq[1], dtype=jnp.float32)
@@ -711,6 +928,25 @@ def causal_lm_forward(
         for k in ("seq_ids", "slot_mapping", "block_table", "write_positions", "attn_mask")
         if k in batch
     }
+    layer_injections = None
+    if image_token_id is not None and "deepstack_embeds" in batch:
+        # qwen3-vl deepstack: layer k's output gains the k-th vision feature
+        # stream at image-placeholder positions (reference: qwen3_vl
+        # _deepstack_process; HF Qwen3VLTextModel layer loop)
+        ds = batch["deepstack_embeds"].astype(compute_dtype)  # (B, K, N, H)
+        K = ds.shape[1]
+        is_img = input_ids == image_token_id  # (B, S)
+        idx = jnp.clip(jnp.cumsum(is_img, axis=1) - 1, 0, ds.shape[2] - 1)
+        gathered = jnp.take_along_axis(
+            ds, idx[:, None, :, None].astype(jnp.int32), axis=2
+        )  # (B, K, S, H)
+        inj = jnp.where(is_img[:, None, :, None], gathered, 0.0)
+        inj = jnp.swapaxes(inj, 0, 1)  # (K, B, S, H)
+        pad = arch.num_layers - K
+        layer_injections = jnp.concatenate(
+            [inj, jnp.zeros((pad,) + inj.shape[1:], inj.dtype)], axis=0
+        )
+
     captured: Dict[str, jax.Array] = {}
     if tensor_capture and "embeds" in tensor_capture:
         captured["embeds"] = hidden
@@ -722,6 +958,7 @@ def causal_lm_forward(
             position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
+            layer_injections=layer_injections,
         )
         captured["layer_hiddens"] = layer_hiddens
     elif aux_hidden_indices:
@@ -730,6 +967,7 @@ def causal_lm_forward(
             position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
+            layer_injections=layer_injections,
         )
         if tensor_capture and "layer_hiddens" in tensor_capture:
             captured["layer_hiddens"] = layer_hiddens
@@ -739,6 +977,7 @@ def causal_lm_forward(
             position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             adapter_ids=batch.get("adapter_ids"),
+            layer_injections=layer_injections,
         )
     pre_norm_hidden = hidden
     if "norm" in params:  # EAGLE drafts have no final norm

@@ -82,6 +82,11 @@ def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
         rope_mscale=rope_mscale_from_config(config),
         attn_kernel_enabled=bool(config.tpu_config.attn_kernel_enabled),
         attn_tkg_kernel_enabled=bool(config.tpu_config.attn_tkg_kernel_enabled),
+        attn_block_tkg_kernel_enabled=bool(
+            config.tpu_config.attn_block_tkg_kernel_enabled
+        ),
+        pp_degree=int(getattr(config.tpu_config, "pp_degree", 1) or 1),
+        pp_microbatches=int(getattr(config.tpu_config, "pp_microbatches", 0) or 0),
         act_quant=getattr(config.tpu_config, "activation_quantization_type", None),
         act_clamp=getattr(config.tpu_config, "quantize_clamp_bound", None),
     )

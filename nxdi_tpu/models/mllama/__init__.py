@@ -1,0 +1,1 @@
+from nxdi_tpu.models.mllama import modeling_mllama  # noqa: F401

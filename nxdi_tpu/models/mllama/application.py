@@ -1,0 +1,193 @@
+"""Mllama application — vision encoder + cross-attention CausalLM.
+
+Reference: NeuronMllamaForCausalLM (models/mllama/modeling_mllama.py:1083)
+and its model wrapper (model_wrapper_mllama.py): a vision submodel feeds
+cross-attention states into CTE; decode reads the cross-KV cache written at
+prefill. Here the cross-KV are two extra entries in the donated cache pytree
+(the reference's MultimodalKVCache as explicit state).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+
+from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
+from nxdi_tpu.models.mllama import modeling_mllama as mm
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING, TAG_TOKEN_GENERATION
+
+
+class MllamaApplication(TpuModelForCausalLM):
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("model_family", mm)
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        for flag, why in (
+            (tc.async_mode, "async (device-resident) decode"),
+            (tc.is_block_kv_layout, "paged KV layout"),
+            (tc.lora_config is not None, "LoRA serving"),
+            (tc.speculation_length > 0, "speculative decoding"),
+            (tc.enable_fused_speculation, "fused speculation"),
+            (tc.is_medusa, "medusa"),
+            (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
+            (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
+            (tc.is_continuous_batching, "continuous batching (cross-KV is not "
+             "seq-id routed yet)"),
+        ):
+            if flag:
+                raise NotImplementedError(f"mllama does not support {why} yet")
+        self._encode_jit = None
+        # last prompt cross-mask row per batch line (HF generation repeats it
+        # for every generated token, modeling_mllama.py:1732)
+        self._last_xmask: Optional[np.ndarray] = None
+
+    # -- params --
+    def build_params(self):
+        real_get = self.get_state_dict
+        cache = {}
+
+        def cached():
+            if "sd" not in cache:
+                cache["sd"] = real_get()
+            return cache["sd"]
+
+        self.get_state_dict = cached
+        try:
+            params = super().build_params()
+            params.update(mm.convert_vision_params(cached(), self.config))
+        finally:
+            self.get_state_dict = real_get
+        return params
+
+    def build_params_struct(self):
+        struct = super().build_params_struct()
+        struct.update(mm.vision_shape_struct(self.config))
+        return struct
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().param_specs()
+        struct = mm.vision_shape_struct(self.config)
+        specs.update(jax.tree_util.tree_map(lambda _: P(), struct))
+        return specs
+
+    # -- cache: self-attn KV + cross-attn KV --
+    def _cross_cache_struct(self):
+        arch = mm.build_arch(self.config)
+        t = arch.text
+        spec = self._cache_spec()
+        B = self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size
+        shape = (arch.n_cross, B, t.num_kv_heads, arch.t_vis, t.head_dim)
+        return {
+            "cross_k": jax.ShapeDtypeStruct(shape, spec.store_dtype),
+            "cross_v": jax.ShapeDtypeStruct(shape, spec.store_dtype),
+        }
+
+    def _cache_struct(self):
+        struct = super()._cache_struct()
+        struct.update(self._cross_cache_struct())
+        return struct
+
+    def init_cache_host(self):
+        import jax.numpy as jnp
+
+        cache = super().init_cache_host()
+        for k, s in self._cross_cache_struct().items():
+            cache[k] = jnp.zeros(s.shape, s.dtype)
+        return cache
+
+    def cache_partition_specs(self):
+        specs = dict(kv_cache_partition_spec(self.tpu_config))
+        self_spec = specs["k"]
+        specs["cross_k"] = self_spec
+        specs["cross_v"] = self_spec
+        return specs
+
+    # -- submodels --
+    def enable_models(self) -> None:
+        import jax.numpy as jnp
+
+        super().enable_models()
+        arch = mm.build_arch(self.config)
+        H = self.config.hidden_size
+        MT = arch.max_tiles_total
+        for tag, w in self.models.items():
+            w.forward_fn = mm.causal_lm_forward
+            # the mllama forward does not implement these base-fn kwargs
+            w.forward_kwargs.pop("output_all_logits", None)
+            w.forward_kwargs.pop("tensor_capture", None)
+            w.forward_kwargs.pop("return_next_inputs", None)
+            if tag == TAG_CONTEXT_ENCODING:
+                w.extra_inputs["cross_states"] = ((arch.t_vis, H), jnp.float32)
+                w.extra_inputs["cross_attention_mask"] = (
+                    (self.tpu_config.max_context_length, MT), jnp.float32,
+                )
+            else:
+                w.extra_inputs["cross_attention_mask"] = ((1, MT), jnp.float32)
+
+    # -- vision program --
+    def encode_images(self, pixel_values, aspect_ratio_ids, aspect_ratio_mask):
+        if self._encode_jit is None:
+            varch = mm.build_vision_arch(self.config)
+            self._encode_jit = jax.jit(partial(mm.encode_images, varch))
+        with jax.set_mesh(self.mesh):
+            return self._encode_jit(
+                {"vision": self.params["vision"], "projector": self.params["projector"]},
+                np.asarray(pixel_values, np.float32),
+                np.asarray(aspect_ratio_ids, np.int32),
+                np.asarray(aspect_ratio_mask, np.float32),
+            )
+
+    # -- dispatch --
+    def forward(
+        self,
+        input_ids,
+        position_ids,
+        pixel_values=None,
+        aspect_ratio_ids=None,
+        aspect_ratio_mask=None,
+        cross_attention_mask=None,
+        **kwargs,
+    ):
+        arch = mm.build_arch(self.config)
+        MT = arch.max_tiles_total
+        B, S = np.asarray(input_ids).shape
+        is_prefill = S > 1
+        if is_prefill:
+            if pixel_values is None:
+                raise NotImplementedError(
+                    "mllama prefill requires images (text-only prefill would "
+                    "need a cross-layer-free compiled variant)"
+                )
+            kwargs["cross_states"] = np.asarray(
+                self.encode_images(pixel_values, aspect_ratio_ids, aspect_ratio_mask)
+            )
+            if cross_attention_mask is None:
+                raise ValueError("cross_attention_mask is required at prefill")
+            xm = np.asarray(cross_attention_mask, np.float32)  # (B, S, M, T) or (B, S, MT)
+            xm = xm.reshape(B, xm.shape[1], -1)[:, :, :MT]
+            S_cap = self.tpu_config.max_context_length
+            pad = np.zeros((B, S_cap, MT), np.float32)
+            pad[:, : xm.shape[1]] = xm[:, :S_cap]
+            kwargs["cross_attention_mask"] = pad
+            lti = kwargs.get("last_token_index")
+            last = (
+                np.asarray(lti, np.int64)
+                if lti is not None
+                else np.full((B,), xm.shape[1] - 1, np.int64)
+            )
+            self._last_xmask = xm[np.arange(B), np.minimum(last, xm.shape[1] - 1)]
+        else:
+            if cross_attention_mask is not None:
+                xm = np.asarray(cross_attention_mask, np.float32).reshape(B, 1, -1)[:, :, :MT]
+            elif self._last_xmask is not None:
+                xm = self._last_xmask[:B].reshape(B, 1, MT)
+            else:
+                raise ValueError("decode before prefill: no cross_attention_mask available")
+            kwargs["cross_attention_mask"] = xm
+        return super().forward(input_ids, position_ids, **kwargs)

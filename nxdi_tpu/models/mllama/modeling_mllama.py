@@ -1,0 +1,747 @@
+"""Mllama (Llama-3.2 Vision) — tiled ViT encoder + cross-attention llama.
+
+Reference: models/mllama/modeling_mllama.py (cross-attention text stack,
+fusion schedule every-Nth layer), modeling_mllama_vision.py (two-stage tiled
+ViT), and the cross-attn KV manager modules/kvcache/multimodal_kv_cache_manager.py.
+Semantics follow the HF ``MllamaForConditionalGeneration`` graph exactly so
+tiny-model greedy tokens match.
+
+TPU-native layout:
+  - text self-attention layers are the shared dense decoder (models/base.py)
+    scanned in contiguous SEGMENTS between cross-attention layers; cross
+    layers are unrolled (there are few — 8 in the 11B) with their own
+    stacked params.
+  - cross-attention K/V are computed ONCE at prefill from the vision
+    features and live in the donated cache pytree as ``cross_k``/``cross_v``
+    shaped (n_cross, B, KV, T_vis, D) — the reference's MultimodalKVCache
+    (multimodal_kv_cache_manager.py:18) as explicit state. Decode reads them;
+    the self-attn KV cache behaves exactly as in the dense decoder.
+  - the vision tower (local transformer + gated global transformer, gated
+    tile/position embeddings) runs as its own jitted program; patchify is a
+    reshape+matmul (stride == kernel), so everything rides the MXU.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, promote_text_config, to_jax_dtype
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import (
+    DEFAULT_KV_LAYOUT,
+    DecoderArch,
+    constrain,
+    rms_norm,
+    run_decoder_layers,
+)
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops.norms import layer_norm
+from nxdi_tpu.ops.rope import rope_cos_sin
+from nxdi_tpu.models.dense import gqa_plan
+from nxdi_tpu.parallel import gqa
+from nxdi_tpu.parallel.layers import COLUMN_PARALLEL, REPLICATED, ROW_PARALLEL
+from nxdi_tpu.parallel.policy import DEFAULT_POLICY
+
+
+class MllamaInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["text_config", "vision_config", "image_token_index"]
+
+    def add_derived_config(self):
+        promote_text_config(self)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        super().add_derived_config()
+
+
+# ---------------------------------------------------------------------------
+# Arch
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MllamaArch:
+    """Static composite: dense text arch over the SELF layers only, plus the
+    fusion schedule (reference: cross_attention_layers, modeling_mllama.py
+    fusion schedule _init_fusion_schedule :747)."""
+
+    text: DecoderArch
+    # alternating walk: ("self", start, end) half-open self-layer ranges in
+    # the stacked self params / self KV cache; ("cross", ordinal) unrolled
+    schedule: Tuple[Tuple, ...]
+    n_cross: int
+    num_patches: int  # per tile, INCLUDING the cls token
+    t_vis: int  # total vision tokens per text row = media*tiles*num_patches
+    max_tiles_total: int  # media * tiles (cross-mask width)
+    image_token_index: int
+
+    def kv_cache_spec(self, batch_size, max_len, quant_dtype=None):
+        return self.text.kv_cache_spec(batch_size, max_len, quant_dtype=quant_dtype)
+
+
+def _cross_layer_indices(config: InferenceConfig) -> Tuple[int, ...]:
+    return tuple(config.cross_attention_layers)
+
+
+def build_arch(config: InferenceConfig) -> MllamaArch:
+    cross = _cross_layer_indices(config)
+    n_total = config.num_hidden_layers
+    n_self = n_total - len(cross)
+    text = dense.build_arch(config, num_layers=n_self)
+    schedule = []
+    s = 0
+    for i in range(n_total):
+        if i in cross:
+            schedule.append(("cross", cross.index(i)))
+        else:
+            if schedule and schedule[-1][0] == "self":
+                schedule[-1] = ("self", schedule[-1][1], schedule[-1][2] + 1)
+            else:
+                schedule.append(("self", s, s + 1))
+            s += 1
+    vc = config.vision_config
+    num_patches = (vc["image_size"] // vc["patch_size"]) ** 2 + 1
+    max_media = int(getattr(config.tpu_config, "max_num_images", 1) or 1)
+    tiles = vc["max_num_tiles"]
+    return MllamaArch(
+        text=text,
+        schedule=tuple(tuple(x) for x in schedule),
+        n_cross=len(cross),
+        num_patches=num_patches,
+        t_vis=max_media * tiles * num_patches,
+        max_tiles_total=max_media * tiles,
+        image_token_index=config.image_token_index,
+    )
+
+
+def _self_count_before(cross, i):
+    return i - sum(1 for c in cross if c < i)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return dense.build_inv_freq(config)
+
+
+# ---------------------------------------------------------------------------
+# Vision tower
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class MllamaVisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_global_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int
+    max_num_tiles: int
+    max_aspect_ratio_id: int
+    intermediate_layers_indices: Tuple[int, ...]
+    norm_eps: float
+    vision_output_dim: int
+    text_hidden: int
+
+    @property
+    def num_patches(self) -> int:  # per tile, incl cls
+        return (self.image_size // self.patch_size) ** 2 + 1
+
+    @property
+    def padded_patches(self) -> int:  # HF pads the patch dim to %8
+        return self.num_patches + (8 - self.num_patches % 8) % 8
+
+
+def build_vision_arch(config: InferenceConfig) -> MllamaVisionArch:
+    vc = config.vision_config
+    sar = vc.get("supported_aspect_ratios") or [[1, 1]]
+    return MllamaVisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_global_layers=vc["num_global_layers"],
+        num_heads=vc["attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        max_num_tiles=vc["max_num_tiles"],
+        max_aspect_ratio_id=vc.get("max_aspect_ratio_id", len(sar)),
+        intermediate_layers_indices=tuple(vc["intermediate_layers_indices"]),
+        norm_eps=vc.get("norm_eps", 1e-5),
+        vision_output_dim=vc["vision_output_dim"],
+        text_hidden=config.hidden_size,
+    )
+
+
+def _vit_layer(varch: MllamaVisionArch, lp, h, additive_mask, gated: bool):
+    """One vision encoder layer (HF MllamaVisionEncoderLayer semantics:
+    pre-LN attn + MLP, optional tanh gates on both residual branches)."""
+    B, S, Hv = h.shape
+    nh = varch.num_heads
+    d = Hv // nh
+
+    y = layer_norm(h, lp["ln1"]["w"], lp["ln1"]["b"], eps=varch.norm_eps)
+    q = (y @ lp["q_proj"]["w"]).reshape(B, S, nh, d).transpose(0, 2, 1, 3)
+    k = (y @ lp["k_proj"]["w"]).reshape(B, S, nh, d).transpose(0, 2, 1, 3)
+    v = (y @ lp["v_proj"]["w"]).reshape(B, S, nh, d).transpose(0, 2, 1, 3)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32)
+    s = s * (d ** -0.5) + additive_mask
+    w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+    attn = jnp.einsum("bhqk,bhkd->bhqd", w, v).transpose(0, 2, 1, 3).reshape(B, S, Hv)
+    attn = attn @ lp["o_proj"]["w"]
+    if gated:
+        attn = jnp.tanh(lp["gate_attn"]) * attn
+    h = h + attn
+
+    y = layer_norm(h, lp["ln2"]["w"], lp["ln2"]["b"], eps=varch.norm_eps)
+    ff = jax.nn.gelu(y @ lp["fc1"]["w"] + lp["fc1"]["b"], approximate=False)
+    ff = ff @ lp["fc2"]["w"] + lp["fc2"]["b"]
+    if gated:
+        ff = jnp.tanh(lp["gate_ffn"]) * ff
+    return h + ff
+
+
+def encode_images(
+    varch: MllamaVisionArch,
+    params: Dict[str, Any],
+    pixel_values,  # (B, M, T, C, Himg, Wimg)
+    aspect_ratio_ids,  # (B, M) int32
+    aspect_ratio_mask,  # (B, M, T)
+):
+    """HF MllamaVisionModel.forward + multi_modal_projector, returning
+    cross-attention states (B, M*T*num_patches, text_hidden)."""
+    v = params["vision"]
+    B, M, T, C, HI, WI = pixel_values.shape
+    P = varch.patch_size
+    g = HI // P
+    Hv = varch.hidden_size
+    np_tile = varch.num_patches  # incl cls
+    pad_p = varch.padded_patches
+
+    # patchify: (BMT, C, g, P, g, P) -> (BMT, g*g, C*P*P) @ W
+    x = pixel_values.reshape(B * M * T, C, g, P, g, P)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(B * M * T, g * g, C * P * P)
+    h = x @ v["patch_embedding"]  # (BMT, g*g, Hv)
+
+    ar_ids = aspect_ratio_ids.reshape(B * M)
+    # pre-tile positional embedding (gated)
+    pre = jnp.take(v["pre_tile_pos"]["emb"], ar_ids, axis=0).reshape(B * M, varch.max_num_tiles, 1, Hv)
+    h = h.reshape(B * M, T, g * g, Hv) + pre[:, :T] * jnp.tanh(v["pre_tile_pos"]["gate"])
+
+    # cls token first
+    h = h.reshape(B * M * T, g * g, Hv)
+    cls = jnp.broadcast_to(v["class_embedding"][None, None, :], (B * M * T, 1, Hv))
+    h = jnp.concatenate([cls, h], axis=1)  # (BMT, np_tile, Hv)
+
+    # gated position embedding
+    gate = jnp.tanh(v["pos_gate"])
+    h = h + (1.0 - gate) * v["pos_embedding"][None]
+    tile_pos = jnp.take(v["tile_pos_emb"], ar_ids, axis=0).reshape(
+        B * M, varch.max_num_tiles, np_tile, Hv
+    )
+    h = h.reshape(B * M, T, np_tile, Hv) + gate * tile_pos[:, :T]
+
+    h = layer_norm(h, v["ln_pre"]["w"], v["ln_pre"]["b"], eps=1e-5)
+
+    # pad patch dim to %8 and build the HF aspect-ratio attention mask:
+    # additive MIN where BOTH query and key slots are invalid (HF quirk —
+    # _prepare_aspect_ratio_attention_mask modeling_mllama.py:76)
+    h = jnp.pad(h, ((0, 0), (0, 0), (0, pad_p - np_tile), (0, 0)))
+    valid = jnp.broadcast_to(
+        aspect_ratio_mask.reshape(B * M, T, 1).astype(jnp.float32), (B * M, T, pad_p)
+    )
+    valid = valid * (jnp.arange(pad_p)[None, None, :] < np_tile)
+    inv = (1.0 - valid).reshape(B * M, T * pad_p, 1)
+    additive = (inv @ jnp.swapaxes(inv, 1, 2)) * jnp.float32(-3.4028235e38)
+    additive = additive[:, None]  # (BM, 1, T*pad, T*pad)
+
+    h = h.reshape(B * M, T * pad_p, Hv)
+
+    def local_body(carry, lp):
+        out = _vit_layer(varch, lp, carry, additive, gated=False)
+        return out, out
+
+    h, layer_outs = jax.lax.scan(local_body, h, v["layers"])
+    intermediates = jnp.stack(
+        [layer_outs[i] for i in varch.intermediate_layers_indices], axis=-1
+    )  # (BM, T*pad, Hv, n_int)
+
+    h = layer_norm(h, v["ln_post"]["w"], v["ln_post"]["b"], eps=1e-5)
+
+    post = jnp.take(v["post_tile_pos"]["emb"], ar_ids, axis=0).reshape(
+        B * M, varch.max_num_tiles, 1, Hv
+    )
+    h = h.reshape(B * M, T, pad_p, Hv) + post[:, :T] * jnp.tanh(v["post_tile_pos"]["gate"])
+    h = h.reshape(B * M, T * pad_p, Hv)
+
+    def global_body(carry, lp):
+        return _vit_layer(varch, lp, carry, additive, gated=True), None
+
+    h, _ = jax.lax.scan(global_body, h, v["global_layers"])
+
+    # strip patch padding, concat intermediates -> vision_output_dim
+    h = h.reshape(B * M, T, pad_p, Hv)[:, :, :np_tile]
+    inter = intermediates.reshape(B * M, T, pad_p, -1)[:, :, :np_tile]
+    feat = jnp.concatenate([h, inter], axis=-1)  # (BM, T, np_tile, vision_output_dim)
+
+    proj = params["projector"]
+    states = feat @ proj["w"] + proj["b"]  # (BM, T, np_tile, text_hidden)
+    return states.reshape(B, M * T * np_tile, varch.text_hidden)
+
+
+# ---------------------------------------------------------------------------
+# Text forward
+# ---------------------------------------------------------------------------
+
+
+def _cross_attention_layer(
+    t: DecoderArch,
+    lp: Dict[str, Any],
+    hidden,  # (B, S, H)
+    xk,  # (B, KV, Tv, D)
+    xv,
+    attend,  # (B, S, Tv) bool
+    full_row,  # (B, S, 1) float
+    policy,
+):
+    """HF MllamaCrossAttentionDecoderLayer: q-normed cross attention with a
+    tanh attn gate, MLP row-masked by full_text_row then tanh mlp gate."""
+    B, S, _ = hidden.shape
+    H, KV, D = t.num_attention_heads, t.num_kv_heads, t.head_dim
+
+    y = rms_norm(hidden, lp["input_layernorm"], t.rms_norm_eps)
+    q = (y @ lp["attn"]["q_proj"]["w"]).reshape(B, S, H, D)
+    q = rms_norm(q, lp["attn"]["q_norm"], t.rms_norm_eps)
+    q = jnp.swapaxes(q, 1, 2)  # (B, H, S, D)
+    q = constrain(q, policy.q)
+
+    ctx = attn_ops.grouped_attention(q, xk, xv, attend, softmax_dtype=jnp.float32)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    attn_out = ctx @ lp["attn"]["o_proj"]["w"]
+    hidden = hidden + jnp.tanh(lp["gate_attn"]) * attn_out
+
+    y = rms_norm(hidden, lp["post_attention_layernorm"], t.rms_norm_eps)
+    from nxdi_tpu.models.base import mlp_block
+
+    ff = mlp_block(t, lp["mlp"], y)
+    ff = ff * full_row.astype(ff.dtype)
+    hidden = hidden + jnp.tanh(lp["gate_mlp"]) * ff
+    return constrain(hidden, policy.hidden)
+
+
+def _compute_cross_kv(t: DecoderArch, lp, cross_states, policy):
+    """k/v projections of the vision states with per-head k-norm (HF
+    MllamaTextCrossAttention._compute / k_norm semantics)."""
+    B, Tv, _ = cross_states.shape
+    KV, D = t.num_kv_heads, t.head_dim
+    k = (cross_states @ lp["attn"]["k_proj"]["w"]).reshape(B, Tv, KV, D)
+    v = (cross_states @ lp["attn"]["v_proj"]["w"]).reshape(B, Tv, KV, D)
+    k = rms_norm(k, lp["attn"]["k_norm"], t.rms_norm_eps)
+    k = jnp.swapaxes(k, 1, 2)  # (B, KV, Tv, D)
+    v = jnp.swapaxes(v, 1, 2)
+    return constrain(k, policy.kv), constrain(v, policy.kv)
+
+
+def causal_lm_forward(
+    arch: MllamaArch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+):
+    """One submodel forward (reference: NeuronMllamaTextModel.forward,
+    modeling_mllama.py:819): dense self-attn segments + unrolled gated
+    cross-attn layers walking the fusion schedule."""
+    t = arch.text
+    compute_dtype = to_jax_dtype(t.dtype)
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    B, S = input_ids.shape
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    hidden = constrain(hidden, policy.hidden)
+    cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq), dtype=jnp.float32)
+
+    cache_spec = t.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
+
+    # cross mask rows for the active tokens: (B, S_fixed, MT) -> (B, S, Tv)
+    xmask = batch["cross_attention_mask"][:, :S].astype(jnp.float32)
+    attend = jnp.repeat(xmask, arch.num_patches, axis=2) > 0  # (B, S, Tv)
+    full_row = jnp.any(attend, axis=-1, keepdims=True).astype(jnp.float32)
+    # HF cancels the mask for rows that attend nothing (full-row masking):
+    # all-False rows already softmax uniformly over every vision token,
+    # which is exactly the canceled-mask result — no special case needed.
+
+    if attend_to_cache:
+        xk_all, xv_all = cache["cross_k"], cache["cross_v"]
+    else:
+        xk_list, xv_list = [], []
+
+    k_segs, v_segs = [], []
+    for item in arch.schedule:
+        if item[0] == "self":
+            _, lo, hi = item
+            seg = jax.tree_util.tree_map(lambda x: x[lo:hi], params["layers"])
+            k_sl = jax.lax.slice_in_dim(cache["k"], lo, hi, axis=0)
+            v_sl = jax.lax.slice_in_dim(cache["v"], lo, hi, axis=0)
+            hidden, seg_cache = run_decoder_layers(
+                t, seg, hidden, cos, sin, {"k": k_sl, "v": v_sl},
+                position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+                policy=policy, layout=layout,
+            )
+            k_segs.append(seg_cache["k"])
+            v_segs.append(seg_cache["v"])
+        else:
+            _, ordinal = item
+            lp = jax.tree_util.tree_map(lambda x: x[ordinal], params["cross"])
+            if attend_to_cache:
+                xk = xk_all[ordinal].astype(compute_dtype)
+                xv = xv_all[ordinal].astype(compute_dtype)
+            else:
+                xk, xv = _compute_cross_kv(
+                    t, lp, batch["cross_states"].astype(compute_dtype), policy
+                )
+                xk_list.append(xk)
+                xv_list.append(xv)
+            hidden = _cross_attention_layer(
+                t, lp, hidden, xk, xv, attend, full_row, policy
+            )
+
+    new_cache = {
+        "k": jnp.concatenate(k_segs, axis=0) if len(k_segs) > 1 else k_segs[0],
+        "v": jnp.concatenate(v_segs, axis=0) if len(v_segs) > 1 else v_segs[0],
+    }
+    if attend_to_cache:
+        new_cache["cross_k"], new_cache["cross_v"] = xk_all, xv_all
+    else:
+        store = cache["cross_k"].dtype
+        new_cache["cross_k"] = jnp.stack(xk_list).astype(store)
+        new_cache["cross_v"] = jnp.stack(xv_list).astype(store)
+
+    hidden = rms_norm(hidden, params["norm"], t.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
+    if gather_last_token:
+        idx = batch["last_token_index"][:, None, None]
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
+        )
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    logits = constrain(logits, policy.logits)
+    logits = sampling_ops.mask_padded_logits(logits, t.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if on_device_sampling:
+        outputs["tokens"] = sampling_ops.sample(
+            logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )[:, None]
+    if output_logits or not on_device_sampling:
+        outputs["logits"] = logits
+    return outputs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint conversion
+# ---------------------------------------------------------------------------
+
+
+def _text_sd(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+            if k.startswith(prefix):
+                out[k[len(prefix):]] = v
+                break
+        else:
+            if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                out["lm_head.weight"] = v
+    return out
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    t = arch.text
+    sd = _text_sd(state_dict)
+    cross = _cross_layer_indices(config)
+
+    # renumber SELF layers contiguously and convert them with the dense
+    # converter (handles GQA padding/replication + vocab pad)
+    sd_self = {}
+    s = 0
+    for i in range(config.num_hidden_layers):
+        if i in cross:
+            continue
+        pre = f"layers.{i}."
+        for k, v in sd.items():
+            if k.startswith(pre):
+                sd_self[f"layers.{s}." + k[len(pre):]] = v
+        s += 1
+    for k, v in sd.items():
+        if not k.startswith("layers."):
+            sd_self[k] = v
+    params = dense.convert_hf_state_dict(sd_self, config, t)
+
+    # cross layers: stacked over their ordinals
+    dt = dense.np_dtype(t.dtype)
+    plan = gqa_plan(config)
+    D = t.head_dim
+    cast = lambda x: np.asarray(x, dtype=dt)  # noqa: E731
+    cross_layers = []
+    for i in cross:
+        pre = f"layers.{i}."
+
+        def get(name):
+            return sd[pre + name]
+
+        cross_layers.append({
+            "input_layernorm": cast(get("input_layernorm.weight")),
+            "post_attention_layernorm": cast(get("post_attention_layernorm.weight")),
+            "gate_attn": cast(get("cross_attn_attn_gate")),
+            "gate_mlp": cast(get("cross_attn_mlp_gate")),
+            "attn": {
+                "q_proj": {"w": cast(gqa.convert_q(get("cross_attn.q_proj.weight"), D, plan).T)},
+                "k_proj": {"w": cast(gqa.convert_kv(get("cross_attn.k_proj.weight"), D, plan).T)},
+                "v_proj": {"w": cast(gqa.convert_kv(get("cross_attn.v_proj.weight"), D, plan).T)},
+                "o_proj": {"w": cast(gqa.convert_o(get("cross_attn.o_proj.weight"), D, plan).T)},
+                "q_norm": cast(get("cross_attn.q_norm.weight")),
+                "k_norm": cast(get("cross_attn.k_norm.weight")),
+            },
+            "mlp": {
+                "gate_proj": {"w": cast(get("mlp.gate_proj.weight").T)},
+                "up_proj": {"w": cast(get("mlp.up_proj.weight").T)},
+                "down_proj": {"w": cast(get("mlp.down_proj.weight").T)},
+            },
+        })
+    params["cross"] = dense.tree_stack(cross_layers)
+    return params
+
+
+def convert_vision_params(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in (f"model.{name}", name):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(f"missing vision weight {name}")
+
+    def f32(x):
+        return np.asarray(x, np.float32)
+
+    Hv = varch.hidden_size
+
+    def vit_layers(prefix, n, gated):
+        layers = []
+        for i in range(n):
+            p = f"{prefix}.layers.{i}."
+            lp = {
+                "q_proj": {"w": f32(get(p + "self_attn.q_proj.weight").T)},
+                "k_proj": {"w": f32(get(p + "self_attn.k_proj.weight").T)},
+                "v_proj": {"w": f32(get(p + "self_attn.v_proj.weight").T)},
+                "o_proj": {"w": f32(get(p + "self_attn.o_proj.weight").T)},
+                "ln1": {"w": f32(get(p + "input_layernorm.weight")),
+                        "b": f32(get(p + "input_layernorm.bias"))},
+                "ln2": {"w": f32(get(p + "post_attention_layernorm.weight")),
+                        "b": f32(get(p + "post_attention_layernorm.bias"))},
+                "fc1": {"w": f32(get(p + "mlp.fc1.weight").T), "b": f32(get(p + "mlp.fc1.bias"))},
+                "fc2": {"w": f32(get(p + "mlp.fc2.weight").T), "b": f32(get(p + "mlp.fc2.bias"))},
+            }
+            if gated:
+                lp["gate_attn"] = f32(get(p + "gate_attn"))
+                lp["gate_ffn"] = f32(get(p + "gate_ffn"))
+            layers.append(lp)
+        return dense.tree_stack(layers)
+
+    conv = get("vision_model.patch_embedding.weight")  # (Hv, C, P, P)
+    vision = {
+        "patch_embedding": f32(conv.reshape(Hv, -1).T),  # (C*P*P, Hv)
+        "class_embedding": f32(get("vision_model.class_embedding")),
+        "pos_gate": f32(get("vision_model.gated_positional_embedding.gate")),
+        "pos_embedding": f32(get("vision_model.gated_positional_embedding.embedding")),
+        "tile_pos_emb": f32(get("vision_model.gated_positional_embedding.tile_embedding.weight")),
+        "pre_tile_pos": {
+            "emb": f32(get("vision_model.pre_tile_positional_embedding.embedding.weight")),
+            "gate": f32(get("vision_model.pre_tile_positional_embedding.gate")),
+        },
+        "post_tile_pos": {
+            "emb": f32(get("vision_model.post_tile_positional_embedding.embedding.weight")),
+            "gate": f32(get("vision_model.post_tile_positional_embedding.gate")),
+        },
+        "ln_pre": {"w": f32(get("vision_model.layernorm_pre.weight")),
+                   "b": f32(get("vision_model.layernorm_pre.bias"))},
+        "ln_post": {"w": f32(get("vision_model.layernorm_post.weight")),
+                    "b": f32(get("vision_model.layernorm_post.bias"))},
+        "layers": vit_layers("vision_model.transformer", varch.num_layers, gated=False),
+        "global_layers": vit_layers(
+            "vision_model.global_transformer", varch.num_global_layers, gated=True
+        ),
+    }
+    projector = {
+        "w": f32(get("multi_modal_projector.weight").T),
+        "b": f32(get("multi_modal_projector.bias")),
+    }
+    return {"vision": vision, "projector": projector}
+
+
+# ---------------------------------------------------------------------------
+# Shape structs + sharding specs
+# ---------------------------------------------------------------------------
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    t = arch.text
+    struct = dense.param_shape_struct(config, t)
+    dt = dense.np_dtype(t.dtype)
+    H = t.hidden_size
+    nC = arch.n_cross
+    HD = t.num_attention_heads * t.head_dim
+    KVD = t.num_kv_heads * t.head_dim
+    I = t.intermediate_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    struct["cross"] = {
+        "input_layernorm": s(nC, H),
+        "post_attention_layernorm": s(nC, H),
+        "gate_attn": s(nC, 1),
+        "gate_mlp": s(nC, 1),
+        "attn": {
+            "q_proj": {"w": s(nC, H, HD)},
+            "k_proj": {"w": s(nC, H, KVD)},
+            "v_proj": {"w": s(nC, H, KVD)},
+            "o_proj": {"w": s(nC, HD, H)},
+            "q_norm": s(nC, t.head_dim),
+            "k_norm": s(nC, t.head_dim),
+        },
+        "mlp": {
+            "gate_proj": {"w": s(nC, H, I)},
+            "up_proj": {"w": s(nC, H, I)},
+            "down_proj": {"w": s(nC, I, H)},
+        },
+    }
+    return struct
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    arch = build_arch(config)
+    specs = dense.param_specs_for(arch.text)
+
+    def stack(tree):
+        return jax.tree_util.tree_map(
+            lambda sp: P(*((None,) + tuple(sp))), tree, is_leaf=lambda x: isinstance(x, P)
+        )
+
+    specs["cross"] = stack({
+        "input_layernorm": REPLICATED,
+        "post_attention_layernorm": REPLICATED,
+        "gate_attn": REPLICATED,
+        "gate_mlp": REPLICATED,
+        "attn": {
+            "q_proj": {"w": COLUMN_PARALLEL},
+            "k_proj": {"w": COLUMN_PARALLEL},
+            "v_proj": {"w": COLUMN_PARALLEL},
+            "o_proj": {"w": ROW_PARALLEL},
+            "q_norm": REPLICATED,
+            "k_norm": REPLICATED,
+        },
+        "mlp": {
+            "gate_proj": {"w": COLUMN_PARALLEL},
+            "up_proj": {"w": COLUMN_PARALLEL},
+            "down_proj": {"w": ROW_PARALLEL},
+        },
+    })
+    return specs
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    Hv, Iv = varch.hidden_size, varch.intermediate_size
+    nP = varch.num_patches
+    nAR = varch.max_aspect_ratio_id + 1
+    TmaxP = varch.max_num_tiles
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    def vit(L, gated):
+        lp = {
+            "q_proj": {"w": s(L, Hv, Hv)},
+            "k_proj": {"w": s(L, Hv, Hv)},
+            "v_proj": {"w": s(L, Hv, Hv)},
+            "o_proj": {"w": s(L, Hv, Hv)},
+            "ln1": {"w": s(L, Hv), "b": s(L, Hv)},
+            "ln2": {"w": s(L, Hv), "b": s(L, Hv)},
+            "fc1": {"w": s(L, Hv, Iv), "b": s(L, Iv)},
+            "fc2": {"w": s(L, Iv, Hv), "b": s(L, Hv)},
+        }
+        if gated:
+            lp["gate_attn"] = s(L, 1)
+            lp["gate_ffn"] = s(L, 1)
+        return lp
+
+    return {
+        "vision": {
+            "patch_embedding": s(varch.num_channels * varch.patch_size ** 2, Hv),
+            "class_embedding": s(Hv),
+            "pos_gate": s(1),
+            "pos_embedding": s(nP, Hv),
+            "tile_pos_emb": s(nAR, TmaxP * nP * Hv),
+            "pre_tile_pos": {"emb": s(nAR, TmaxP * Hv), "gate": s(1)},
+            "post_tile_pos": {"emb": s(nAR, TmaxP * Hv), "gate": s(1)},
+            "ln_pre": {"w": s(Hv), "b": s(Hv)},
+            "ln_post": {"w": s(Hv), "b": s(Hv)},
+            "layers": vit(varch.num_layers, False),
+            "global_layers": vit(varch.num_global_layers, True),
+        },
+        "projector": {
+            "w": s(varch.vision_output_dim, varch.text_hidden),
+            "b": s(varch.text_hidden),
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Application
+# ---------------------------------------------------------------------------
+
+
+class MllamaForConditionalGeneration:
+    """Factory: builds the app class lazily to avoid a runtime import cycle."""
+
+    def __new__(cls, *args, **kwargs):
+        from nxdi_tpu.models.mllama.application import MllamaApplication
+
+        return MllamaApplication(*args, **kwargs)

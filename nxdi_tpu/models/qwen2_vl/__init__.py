@@ -1,0 +1,1 @@
+from nxdi_tpu.models.qwen2_vl import modeling_qwen2_vl  # noqa: F401

@@ -1,0 +1,132 @@
+"""Qwen2-VL application — vision program + M-RoPE position threading.
+
+Reference: the qwen2_vl model wrapper plumbing vision inputs and 3-D rope
+position streams into the compiled text graph (models/qwen2_vl/
+modeling_qwen2_vl.py; HF Qwen2VLModel.get_rope_index runs host-side there
+too)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+from nxdi_tpu.models.qwen2_vl import modeling_qwen2_vl as mq
+from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
+
+
+class Qwen2VLApplication(ImageToTextForCausalLM):
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("model_family", mq)
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        if tc.async_mode:
+            raise NotImplementedError(
+                "qwen2_vl decode needs per-step M-RoPE positions; the "
+                "device-resident loop does not thread them yet"
+            )
+        if tc.is_continuous_batching:
+            raise NotImplementedError(
+                "qwen2_vl tracks one rope-delta set per prefill; continuous "
+                "batching would interleave prefills and corrupt decode "
+                "M-RoPE positions"
+            )
+        self._rope_deltas = None
+        self._vision_jit = {}
+
+    def enable_models(self) -> None:
+        import jax.numpy as jnp
+
+        super().enable_models()
+        for tag, w in self.models.items():
+            S = (
+                self.tpu_config.max_context_length
+                if tag == TAG_CONTEXT_ENCODING
+                else w.n_active_tokens or 1
+            )
+            w.extra_inputs["mrope_position_ids"] = ((3, S), jnp.int32)
+
+    def encode_images(self, pixel_values, image_grid_thw):
+        """Vision tower over the flat processor patches; one compiled program
+        per distinct image grid (static shapes)."""
+        varch = mq.build_vision_arch(self.config)
+        grid = tuple(tuple(int(x) for x in g) for g in np.asarray(image_grid_thw))
+        if grid not in self._vision_jit:
+            self._vision_jit[grid] = jax.jit(partial(mq.vision_forward, varch))
+        phases = mq.vision_rot_table(varch, grid)
+        seg = mq.vision_segment_ids(grid)
+        with jax.set_mesh(self.mesh):
+            return self._vision_jit[grid](
+                {"vision": self.params["vision"], "merger": self.params["merger"]},
+                np.asarray(pixel_values, np.float32),
+                phases,
+                seg,
+            )
+
+    def forward(
+        self,
+        input_ids,
+        position_ids,
+        pixel_values=None,
+        image_grid_thw=None,
+        **kwargs,
+    ):
+        input_ids = np.asarray(input_ids)
+        B, S = input_ids.shape
+        is_prefill = S > 1
+        vc = self.config.vision_config
+        if is_prefill:
+            if pixel_values is not None:
+                feats = np.asarray(self.encode_images(pixel_values, image_grid_thw))
+                # distribute merged features per row by placeholder counts,
+                # padded to the fixed per-row slot
+                N = mq.num_image_tokens(self.config)
+                counts = (input_ids == int(self.config.image_token_id)).sum(axis=1)
+                if counts.max() > N:
+                    raise ValueError(
+                        f"row has {counts.max()} image tokens > max_image_tokens {N}"
+                    )
+                embeds = np.zeros((B, N, feats.shape[-1]), np.float32)
+                off = 0
+                for b in range(B):
+                    c = int(counts[b])
+                    embeds[b, :c] = feats[off : off + c]
+                    off += c
+                kwargs["image_embeds"] = embeds
+                mrope, deltas = mq.get_rope_index(
+                    input_ids,
+                    np.asarray(image_grid_thw),
+                    int(self.config.image_token_id),
+                    int(getattr(self.config, "vision_start_token_id", -1)),
+                    vc.get("spatial_merge_size", 2),
+                )
+                self._rope_deltas = deltas
+            else:
+                mrope = np.tile(np.asarray(position_ids)[:, None, :], (1, 3, 1))
+                self._rope_deltas = np.zeros((B,), np.int64)
+            S_cap = self.tpu_config.max_context_length
+            padded = np.zeros((B, 3, S_cap), np.int64)
+            padded[:, :, :S] = mrope[:, :, :S_cap]
+            # pad lanes continue the arange so garbage rows stay affine
+            if S < S_cap:
+                cont = mrope[:, :, S - 1 : S] + np.arange(1, S_cap - S + 1)[None, None, :]
+                padded[:, :, S:] = cont
+            kwargs["mrope_position_ids"] = padded
+        else:
+            deltas = (
+                self._rope_deltas
+                if self._rope_deltas is not None
+                else np.zeros((B,), np.int64)
+            )
+            if len(deltas) < B:
+                raise ValueError(
+                    f"decode batch ({B}) larger than the prefilled batch "
+                    f"({len(deltas)}); rope deltas unknown for the extra rows"
+                )
+            p = np.asarray(position_ids)[:, None, :] + deltas[:B, None, None]
+            kwargs["mrope_position_ids"] = np.tile(p, (1, 3, 1))
+        # the base image_to_text forward re-encodes pixel_values; we already
+        # merged features above, so drop them
+        return super().forward(input_ids, np.asarray(position_ids), **kwargs)

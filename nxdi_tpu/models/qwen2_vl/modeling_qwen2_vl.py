@@ -1,0 +1,345 @@
+"""Qwen2-VL — M-RoPE text decoder + windowless 2-D-rope ViT with patch merger.
+
+Reference: models/qwen2_vl/ (1206 LoC: modeling_qwen2_vl{,_text,_vision}.py)
+— M-RoPE position streams threaded into the attention rope, a flat
+variable-grid vision transformer, and vision features merged into the token
+embedding stream at image-placeholder positions. HF semantics
+(``Qwen2VLForConditionalGeneration``) are matched exactly.
+
+TPU-native layout: the text model IS the shared dense decoder — M-RoPE is a
+per-forward cos/sin construction (ops/rope.py mrope_cos_sin) selected by an
+arch flag, not a model fork. The vision tower runs as a separate jitted
+program per image grid (grids are static shapes); its 2-D rope table and the
+3-D text position streams are tiny host-side numpy (the reference computes
+them on CPU too — get_rope_index runs eagerly)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, promote_text_config
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops.norms import layer_norm
+from nxdi_tpu.ops.rope import inv_freq_from_hf_config
+
+
+class Qwen2VLInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["text_config", "vision_config", "image_token_id"]
+
+    def add_derived_config(self):
+        promote_text_config(self)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        # the image-to-text base addresses the placeholder token as
+        # image_token_index (llava naming); qwen2-vl calls it image_token_id
+        if not hasattr(self, "image_token_index"):
+            self.image_token_index = self.image_token_id
+        super().add_derived_config()
+
+
+def _mrope_section(config: InferenceConfig) -> Tuple[int, ...]:
+    rs = getattr(config, "rope_scaling", None) or {}
+    return tuple(rs.get("mrope_section", ()))
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    kwargs = dict(
+        mrope_section=_mrope_section(config) or None,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    # M-RoPE reuses the DEFAULT frequency table; the "mrope" rope_scaling
+    # entry only carries the section split (HF Qwen2VLRotaryEmbedding treats
+    # type=mrope/default identically)
+    return inv_freq_from_hf_config(
+        dense.head_dim_of(config),
+        getattr(config, "rope_theta", 10000.0),
+        None,
+        max_position_embeddings=getattr(config, "max_position_embeddings", 4096),
+    )
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    sd = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+            if k.startswith(prefix):
+                sd[k[len(prefix):]] = v
+                break
+        else:
+            if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                sd["lm_head.weight"] = v
+    return dense.convert_hf_state_dict(sd, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))
+
+
+# ---------------------------------------------------------------------------
+# Vision tower
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Qwen2VLVisionArch:
+    embed_dim: int
+    depth: int
+    num_heads: int
+    mlp_hidden: int
+    patch_size: int
+    temporal_patch_size: int
+    in_channels: int
+    spatial_merge_size: int
+    out_hidden: int  # merger output = vision_config.hidden_size
+    hidden_act: str = "quick_gelu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def build_vision_arch(config: InferenceConfig) -> Qwen2VLVisionArch:
+    vc = config.vision_config
+    embed = vc["embed_dim"]
+    return Qwen2VLVisionArch(
+        embed_dim=embed,
+        depth=vc["depth"],
+        num_heads=vc["num_heads"],
+        mlp_hidden=int(embed * vc.get("mlp_ratio", 4)),
+        patch_size=vc["patch_size"],
+        temporal_patch_size=vc.get("temporal_patch_size", 2),
+        in_channels=vc.get("in_channels", 3),
+        spatial_merge_size=vc.get("spatial_merge_size", 2),
+        out_hidden=vc["hidden_size"],
+        hidden_act=vc.get("hidden_act", "quick_gelu"),
+    )
+
+
+def vision_rot_table(varch: Qwen2VLVisionArch, grid_thw) -> np.ndarray:
+    """(N_patches, head_dim) cos/sin phase table in the processor's
+    merge-grouped patch order (HF rot_pos_emb, modeling_qwen2_vl.py:676)."""
+    m = varch.spatial_merge_size
+    dim = varch.head_dim // 2  # rope dim per (h, w) pair
+    inv = 1.0 / (10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    pos_list = []
+    for t, h, w in grid_thw:
+        hp = np.arange(h)[:, None].repeat(w, axis=1)
+        hp = hp.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+        wp = np.arange(w)[None, :].repeat(h, axis=0)
+        wp = wp.reshape(h // m, m, w // m, m).transpose(0, 2, 1, 3).reshape(-1)
+        pos = np.stack([hp, wp], axis=-1)  # (h*w, 2)
+        pos_list.append(np.tile(pos, (t, 1)))
+    pos = np.concatenate(pos_list, axis=0)  # (N, 2)
+    freqs = pos[:, :, None].astype(np.float64) * inv[None, None, :]  # (N, 2, dim/2)
+    half = freqs.reshape(pos.shape[0], -1)  # (N, head_dim/2)
+    return np.concatenate([half, half], axis=-1).astype(np.float32)  # (N, head_dim)
+
+
+def vision_segment_ids(grid_thw) -> np.ndarray:
+    """Image index per patch — attention is block-diagonal per image
+    (HF cu_seqlens chunking)."""
+    return np.concatenate(
+        [np.full(int(t * h * w), i, np.int32) for i, (t, h, w) in enumerate(grid_thw)]
+    )
+
+
+def vision_forward(
+    varch: Qwen2VLVisionArch,
+    params: Dict[str, Any],
+    patches,  # (N, C * Tp * P * P) flattened processor patches
+    phases,  # (N, head_dim) rope phase table (vision_rot_table)
+    seg_ids,  # (N,) image index per patch
+):
+    """Flat-sequence ViT over all images' patches (HF
+    Qwen2VisionTransformerPretrainedModel.forward) -> merged features
+    (N / merge^2, out_hidden)."""
+    from nxdi_tpu.ops.vision import ACTS as ACT_FNS
+
+    v = params["vision"]
+    nh, d = varch.num_heads, varch.head_dim
+    h = patches @ v["patch_embedding"]  # (N, embed)
+    N = h.shape[0]
+    cos = jnp.cos(phases)[:, None, :]  # (N, 1, D)
+    sin = jnp.sin(phases)[:, None, :]
+    block_mask = seg_ids[:, None] == seg_ids[None, :]  # (N, N)
+
+    def rot(x):
+        half = x.shape[-1] // 2
+        x1, x2 = x[..., :half], x[..., half:]
+        return jnp.concatenate([-x2, x1], axis=-1)
+
+    act = ACT_FNS[varch.hidden_act]
+
+    def body(carry, lp):
+        y = layer_norm(carry, lp["ln1"]["w"], lp["ln1"]["b"], eps=1e-6)
+        qkv = y @ lp["qkv"]["w"] + lp["qkv"]["b"]  # (N, 3*embed)
+        q, k, val = jnp.split(qkv.reshape(N, 3, nh, d), 3, axis=1)
+        q, k, val = q[:, 0], k[:, 0], val[:, 0]  # (N, nh, d)
+        qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = qf * cos + rot(qf) * sin
+        k = kf * cos + rot(kf) * sin
+        s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32)
+        s = s * (d ** -0.5)
+        s = jnp.where(block_mask[None], s, -3.4028235e38)
+        w = jax.nn.softmax(s, axis=-1).astype(val.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", w, val).reshape(N, nh * d)
+        carry = carry + attn @ lp["proj"]["w"] + lp["proj"]["b"]
+        y = layer_norm(carry, lp["ln2"]["w"], lp["ln2"]["b"], eps=1e-6)
+        ff = act(y @ lp["fc1"]["w"] + lp["fc1"]["b"]) @ lp["fc2"]["w"] + lp["fc2"]["b"]
+        return carry + ff, None
+
+    h, _ = jax.lax.scan(body, h, v["blocks"])
+
+    mg = params["merger"]
+    h = layer_norm(h, mg["ln_q"]["w"], mg["ln_q"]["b"], eps=1e-6)
+    m2 = varch.spatial_merge_size ** 2
+    h = h.reshape(N // m2, m2 * varch.embed_dim)
+    h = jax.nn.gelu(h @ mg["fc1"]["w"] + mg["fc1"]["b"], approximate=False)
+    return h @ mg["fc2"]["w"] + mg["fc2"]["b"]  # (N/m2, out_hidden)
+
+
+# family-protocol alias (the app overrides encode_images with the
+# grid-aware variant; the base class only checks presence)
+encode_images = vision_forward
+
+
+def convert_vision_params(state_dict, config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in (f"model.visual.{name}", f"visual.{name}", f"model.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(f"missing vision weight {name}")
+
+    f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    conv = get("patch_embed.proj.weight")  # (embed, C, Tp, P, P)
+    blocks = []
+    for i in range(varch.depth):
+        p = f"blocks.{i}."
+        blocks.append({
+            "ln1": {"w": f32(get(p + "norm1.weight")), "b": f32(get(p + "norm1.bias"))},
+            "ln2": {"w": f32(get(p + "norm2.weight")), "b": f32(get(p + "norm2.bias"))},
+            "qkv": {"w": f32(get(p + "attn.qkv.weight").T), "b": f32(get(p + "attn.qkv.bias"))},
+            "proj": {"w": f32(get(p + "attn.proj.weight").T), "b": f32(get(p + "attn.proj.bias"))},
+            "fc1": {"w": f32(get(p + "mlp.fc1.weight").T), "b": f32(get(p + "mlp.fc1.bias"))},
+            "fc2": {"w": f32(get(p + "mlp.fc2.weight").T), "b": f32(get(p + "mlp.fc2.bias"))},
+        })
+    return {
+        "vision": {
+            "patch_embedding": f32(conv.reshape(varch.embed_dim, -1).T),
+            "blocks": dense.tree_stack(blocks),
+        },
+        "merger": {
+            "ln_q": {"w": f32(get("merger.ln_q.weight")), "b": f32(get("merger.ln_q.bias"))},
+            "fc1": {"w": f32(get("merger.mlp.0.weight").T), "b": f32(get("merger.mlp.0.bias"))},
+            "fc2": {"w": f32(get("merger.mlp.2.weight").T), "b": f32(get("merger.mlp.2.bias"))},
+        },
+    }
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    E, M, L = varch.embed_dim, varch.mlp_hidden, varch.depth
+    P2 = varch.in_channels * varch.temporal_patch_size * varch.patch_size ** 2
+    m2E = varch.spatial_merge_size ** 2 * E
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    return {
+        "vision": {
+            "patch_embedding": s(P2, E),
+            "blocks": {
+                "ln1": {"w": s(L, E), "b": s(L, E)},
+                "ln2": {"w": s(L, E), "b": s(L, E)},
+                "qkv": {"w": s(L, E, 3 * E), "b": s(L, 3 * E)},
+                "proj": {"w": s(L, E, E), "b": s(L, E)},
+                "fc1": {"w": s(L, E, M), "b": s(L, M)},
+                "fc2": {"w": s(L, M, E), "b": s(L, E)},
+            },
+        },
+        "merger": {
+            "ln_q": {"w": s(E), "b": s(E)},
+            "fc1": {"w": s(m2E, m2E), "b": s(m2E)},
+            "fc2": {"w": s(m2E, varch.out_hidden), "b": s(varch.out_hidden)},
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Host-side 3-D rope index (HF Qwen2VLModel.get_rope_index, images only)
+# ---------------------------------------------------------------------------
+
+
+def get_rope_index(
+    input_ids: np.ndarray,  # (B, S)
+    image_grid_thw,  # (n_images, 3) in order of appearance across the batch
+    image_token_id: int,
+    vision_start_token_id: int,
+    spatial_merge_size: int,
+):
+    """Returns (position_ids (B, 3, S), rope_deltas (B,)). Text tokens carry
+    sequential positions in all three streams; each image block carries
+    (t, h, w) grid positions offset by the current text position."""
+    B, S = input_ids.shape
+    pos = np.zeros((B, 3, S), np.int64)
+    deltas = np.zeros((B,), np.int64)
+    img_idx = 0
+    for b in range(B):
+        row = input_ids[b]
+        out = []
+        st = 0
+        tokens = row.tolist()
+        while st < S:
+            if tokens[st] == image_token_id:
+                t, h, w = (int(x) for x in image_grid_thw[img_idx])
+                lh, lw = h // spatial_merge_size, w // spatial_merge_size
+                st_idx = out[-1].max() + 1 if out else 0
+                tpos = np.repeat(np.arange(t), lh * lw)
+                hpos = np.tile(np.repeat(np.arange(lh), lw), t)
+                wpos = np.tile(np.arange(lw), t * lh)
+                out.append(np.stack([tpos, hpos, wpos]) + st_idx)
+                st += t * lh * lw
+                img_idx += 1
+            else:
+                # run of text tokens up to the next image token
+                end = st
+                while end < S and tokens[end] != image_token_id:
+                    end += 1
+                st_idx = out[-1].max() + 1 if out else 0
+                text = np.arange(end - st) + st_idx
+                out.append(np.tile(text, (3, 1)))
+                st = end
+        p = np.concatenate(out, axis=1)[:, :S]
+        pos[b] = p
+        deltas[b] = p.max() + 1 - S
+    return pos, deltas
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    """Capacity of the per-row image-feature slot (merged tokens). Grids are
+    dynamic; the cap comes from config (``max_image_tokens``) or a modest
+    default — the app pads features up to it."""
+    return int(getattr(config, "max_image_tokens", 0) or 64)
+
+
+class Qwen2VLForConditionalGeneration:
+    def __new__(cls, *args, **kwargs):
+        from nxdi_tpu.models.qwen2_vl.application import Qwen2VLApplication
+
+        return Qwen2VLApplication(*args, **kwargs)

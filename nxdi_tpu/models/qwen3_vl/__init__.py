@@ -1,0 +1,1 @@
+from nxdi_tpu.models.qwen3_vl import modeling_qwen3_vl  # noqa: F401

@@ -1,0 +1,380 @@
+"""Qwen3-VL — interleaved M-RoPE qwen3 decoder + deepstack ViT.
+
+Reference: models/qwen3_vl/ (1852 LoC) — the deepstack vision tower emits
+per-depth feature streams that are summed into the FIRST K text layers'
+outputs at image positions (model_base.py:1421-1428 analog), on top of the
+qwen2-vl style flat-grid ViT. HF ``Qwen3VLForConditionalGeneration``
+semantics are matched exactly.
+
+TPU-native: the text model is the shared dense decoder (qwen3 flavor:
+qk-norm, no biases) with two arch flags — interleaved M-RoPE cos/sin
+(ops/rope.py) and per-layer residual injections that ride the layer scan as
+xs (models/base.py run_decoder_layers ``layer_injections``). The vision
+tower is one jitted program per grid; position-embedding bilinear
+interpolation is folded into a host-computed (4, N) gather + weight table so
+the device sees a fixed-shape weighted embedding lookup."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, promote_text_config
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.qwen2_vl.modeling_qwen2_vl import (  # shared M-RoPE host helpers
+    get_rope_index,
+)
+from nxdi_tpu.ops.norms import layer_norm
+from nxdi_tpu.ops.rope import inv_freq_from_hf_config
+
+
+class Qwen3VLInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["text_config", "vision_config", "image_token_id"]
+
+    def add_derived_config(self):
+        promote_text_config(self)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        if not hasattr(self, "image_token_index"):
+            self.image_token_index = self.image_token_id
+        super().add_derived_config()
+
+
+def _mrope_section(config: InferenceConfig) -> Tuple[int, ...]:
+    rs = getattr(config, "rope_scaling", None) or {}
+    return tuple(rs.get("mrope_section", ()))
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    kwargs = dict(
+        qk_norm=True,
+        mrope_section=_mrope_section(config) or None,
+        mrope_interleaved=True,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return inv_freq_from_hf_config(
+        dense.head_dim_of(config),
+        getattr(config, "rope_theta", 10000.0),
+        None,
+        max_position_embeddings=getattr(config, "max_position_embeddings", 4096),
+    )
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    sd = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+            if k.startswith(prefix):
+                sd[k[len(prefix):]] = v
+                break
+        else:
+            if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                sd["lm_head.weight"] = v
+    return dense.convert_hf_state_dict(sd, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))
+
+
+# ---------------------------------------------------------------------------
+# Vision tower
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Qwen3VLVisionArch:
+    hidden_size: int
+    intermediate_size: int
+    depth: int
+    num_heads: int
+    patch_size: int
+    temporal_patch_size: int
+    in_channels: int
+    spatial_merge_size: int
+    out_hidden: int
+    num_position_embeddings: int
+    deepstack_indexes: Tuple[int, ...]
+    hidden_act: str = "gelu_pytorch_tanh"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @property
+    def num_grid_per_side(self) -> int:
+        return int(self.num_position_embeddings ** 0.5)
+
+
+def build_vision_arch(config: InferenceConfig) -> Qwen3VLVisionArch:
+    vc = config.vision_config
+    return Qwen3VLVisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        depth=vc["depth"],
+        num_heads=vc["num_heads"],
+        patch_size=vc["patch_size"],
+        temporal_patch_size=vc.get("temporal_patch_size", 2),
+        in_channels=vc.get("in_channels", 3),
+        spatial_merge_size=vc.get("spatial_merge_size", 2),
+        out_hidden=vc["out_hidden_size"],
+        num_position_embeddings=vc["num_position_embeddings"],
+        deepstack_indexes=tuple(vc["deepstack_visual_indexes"]),
+        hidden_act=vc.get("hidden_act", "gelu_pytorch_tanh"),
+    )
+
+
+def vision_rot_table(varch: Qwen3VLVisionArch, grid_thw) -> np.ndarray:
+    """(N, head_dim) rope phase table in merge-grouped order (HF
+    Qwen3VLVisionModel.rot_pos_emb)."""
+    m = varch.spatial_merge_size
+    dim = varch.head_dim // 2
+    inv = 1.0 / (10000.0 ** (np.arange(0, dim, 2, dtype=np.float64) / dim))
+    pos_list = []
+    for t, h, w in grid_thw:
+        mh, mw = h // m, w // m
+        rows = (
+            np.arange(mh)[:, None, None, None] * m + np.arange(m)[None, None, :, None]
+        )
+        cols = (
+            np.arange(mw)[None, :, None, None] * m + np.arange(m)[None, None, None, :]
+        )
+        rows = np.broadcast_to(rows, (mh, mw, m, m)).reshape(-1)
+        cols = np.broadcast_to(cols, (mh, mw, m, m)).reshape(-1)
+        coords = np.stack([rows, cols], axis=-1)
+        pos_list.append(np.tile(coords, (int(t), 1)))
+    pos = np.concatenate(pos_list, axis=0)
+    freqs = pos[:, :, None].astype(np.float64) * inv[None, None, :]
+    half = freqs.reshape(pos.shape[0], -1)
+    return np.concatenate([half, half], axis=-1).astype(np.float32)
+
+
+def pos_embed_gather(varch: Qwen3VLVisionArch, grid_thw):
+    """Host: bilinear pos-embed interpolation folded into (4, N) indices +
+    weights in merge-grouped patch order (HF fast_pos_embed_interpolate)."""
+    side = varch.num_grid_per_side
+    m = varch.spatial_merge_size
+    idx_all, w_all = [], []
+    for t, h, w in grid_thw:
+        t, h, w = int(t), int(h), int(w)
+        hi = np.linspace(0, side - 1, h)
+        wi = np.linspace(0, side - 1, w)
+        hf_, wf_ = hi.astype(np.int64), wi.astype(np.int64)
+        hc = np.clip(hf_ + 1, None, side - 1)
+        wc = np.clip(wf_ + 1, None, side - 1)
+        dh, dw = hi - hf_, wi - wf_
+        idx = np.stack([
+            (hf_[:, None] * side + wf_[None, :]).reshape(-1),
+            (hf_[:, None] * side + wc[None, :]).reshape(-1),
+            (hc[:, None] * side + wf_[None, :]).reshape(-1),
+            (hc[:, None] * side + wc[None, :]).reshape(-1),
+        ])
+        wt = np.stack([
+            ((1 - dh)[:, None] * (1 - dw)[None, :]).reshape(-1),
+            ((1 - dh)[:, None] * dw[None, :]).reshape(-1),
+            (dh[:, None] * (1 - dw)[None, :]).reshape(-1),
+            (dh[:, None] * dw[None, :]).reshape(-1),
+        ])
+        # permute (h, w) order -> merge-grouped order, tile over t
+        perm = (
+            np.arange(h * w)
+            .reshape(h // m, m, w // m, m)
+            .transpose(0, 2, 1, 3)
+            .reshape(-1)
+        )
+        idx = np.tile(idx[:, perm], (1, t))
+        wt = np.tile(wt[:, perm], (1, t))
+        idx_all.append(idx)
+        w_all.append(wt)
+    return (
+        np.concatenate(idx_all, axis=1).astype(np.int32),
+        np.concatenate(w_all, axis=1).astype(np.float32),
+    )
+
+
+def _merger(p, x, m2_hidden, post_norm):
+    if post_norm:
+        x = x.reshape(-1, m2_hidden)
+        x = layer_norm(x, p["norm"]["w"], p["norm"]["b"], eps=1e-6)
+    else:
+        x = layer_norm(x, p["norm"]["w"], p["norm"]["b"], eps=1e-6)
+        x = x.reshape(-1, m2_hidden)
+    x = jax.nn.gelu(x @ p["fc1"]["w"] + p["fc1"]["b"], approximate=False)
+    return x @ p["fc2"]["w"] + p["fc2"]["b"]
+
+
+def vision_forward(
+    varch: Qwen3VLVisionArch,
+    params: Dict[str, Any],
+    patches,  # (N, C * Tp * P * P)
+    phases,  # (N, head_dim)
+    seg_ids,  # (N,)
+    pe_idx,  # (4, N) pos-embed gather indices
+    pe_w,  # (4, N) bilinear weights
+):
+    """Returns (merged_features (N/m2, out_hidden), deepstack (K, N/m2, out_hidden))."""
+    from nxdi_tpu.ops.vision import ACTS
+
+    v = params["vision"]
+    nh, d = varch.num_heads, varch.head_dim
+    E = varch.hidden_size
+    h = patches @ v["patch_embedding"]["w"] + v["patch_embedding"]["b"]
+    pe = jnp.einsum("gn,gnh->nh", pe_w, v["pos_embed"][pe_idx])
+    h = h + pe
+    N = h.shape[0]
+    cos = jnp.cos(phases)[:, None, :]
+    sin = jnp.sin(phases)[:, None, :]
+    block_mask = seg_ids[:, None] == seg_ids[None, :]
+    act = ACTS[varch.hidden_act]
+    m2 = varch.spatial_merge_size ** 2
+
+    def rot(x):
+        half = x.shape[-1] // 2
+        return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+    def layer(carry, lp):
+        y = layer_norm(carry, lp["ln1"]["w"], lp["ln1"]["b"], eps=1e-6)
+        qkv = y @ lp["qkv"]["w"] + lp["qkv"]["b"]
+        q, k, val = jnp.split(qkv.reshape(N, 3, nh, d), 3, axis=1)
+        q, k, val = q[:, 0], k[:, 0], val[:, 0]
+        qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = qf * cos + rot(qf) * sin
+        k = kf * cos + rot(kf) * sin
+        s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32)
+        s = s * (d ** -0.5)
+        s = jnp.where(block_mask[None], s, -3.4028235e38)
+        w = jax.nn.softmax(s, axis=-1).astype(val.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", w, val).reshape(N, nh * d)
+        carry = carry + attn @ lp["proj"]["w"] + lp["proj"]["b"]
+        y = layer_norm(carry, lp["ln2"]["w"], lp["ln2"]["b"], eps=1e-6)
+        ff = act(y @ lp["fc1"]["w"] + lp["fc1"]["b"]) @ lp["fc2"]["w"] + lp["fc2"]["b"]
+        return carry + ff
+
+    # unrolled blocks: deepstack taps specific depths (K is small)
+    deepstack = []
+    for i in range(varch.depth):
+        lp = jax.tree_util.tree_map(lambda x: x[i], v["blocks"])
+        h = layer(h, lp)
+        if i in varch.deepstack_indexes:
+            k_idx = varch.deepstack_indexes.index(i)
+            mp = jax.tree_util.tree_map(lambda x: x[k_idx], params["deepstack_mergers"])
+            deepstack.append(_merger(mp, h, m2 * E, post_norm=True))
+
+    merged = _merger(params["merger"], h, m2 * E, post_norm=False)
+    return merged, jnp.stack(deepstack)
+
+
+def vision_segment_ids(grid_thw) -> np.ndarray:
+    return np.concatenate(
+        [np.full(int(t * h * w), i, np.int32) for i, (t, h, w) in enumerate(grid_thw)]
+    )
+
+
+# family-protocol alias (presence check; the app drives the grid-aware path)
+encode_images = vision_forward
+
+
+def convert_vision_params(state_dict, config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in (f"model.visual.{name}", f"visual.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(f"missing vision weight {name}")
+
+    f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    conv = get("patch_embed.proj.weight")
+    blocks = []
+    for i in range(varch.depth):
+        p = f"blocks.{i}."
+        blocks.append({
+            "ln1": {"w": f32(get(p + "norm1.weight")), "b": f32(get(p + "norm1.bias"))},
+            "ln2": {"w": f32(get(p + "norm2.weight")), "b": f32(get(p + "norm2.bias"))},
+            "qkv": {"w": f32(get(p + "attn.qkv.weight").T), "b": f32(get(p + "attn.qkv.bias"))},
+            "proj": {"w": f32(get(p + "attn.proj.weight").T), "b": f32(get(p + "attn.proj.bias"))},
+            "fc1": {"w": f32(get(p + "mlp.linear_fc1.weight").T), "b": f32(get(p + "mlp.linear_fc1.bias"))},
+            "fc2": {"w": f32(get(p + "mlp.linear_fc2.weight").T), "b": f32(get(p + "mlp.linear_fc2.bias"))},
+        })
+
+    def merger(prefix):
+        return {
+            "norm": {"w": f32(get(prefix + ".norm.weight")), "b": f32(get(prefix + ".norm.bias"))},
+            "fc1": {"w": f32(get(prefix + ".linear_fc1.weight").T), "b": f32(get(prefix + ".linear_fc1.bias"))},
+            "fc2": {"w": f32(get(prefix + ".linear_fc2.weight").T), "b": f32(get(prefix + ".linear_fc2.bias"))},
+        }
+
+    ds = [merger(f"deepstack_merger_list.{i}") for i in range(len(varch.deepstack_indexes))]
+    return {
+        "vision": {
+            "patch_embedding": {
+                "w": f32(conv.reshape(varch.hidden_size, -1).T),
+                "b": f32(get("patch_embed.proj.bias")),
+            },
+            "pos_embed": f32(get("pos_embed.weight")),
+            "blocks": dense.tree_stack(blocks),
+        },
+        "merger": merger("merger"),
+        "deepstack_mergers": dense.tree_stack(ds),
+    }
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    E, I, L = varch.hidden_size, varch.intermediate_size, varch.depth
+    P2 = varch.in_channels * varch.temporal_patch_size * varch.patch_size ** 2
+    m2E = varch.spatial_merge_size ** 2 * E
+    K = len(varch.deepstack_indexes)
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    def merger_struct(n=None):
+        pre = (n,) if n is not None else ()
+        norm_dim = m2E if n is not None else E  # deepstack uses postshuffle norm
+        return {
+            "norm": {"w": s(*pre, norm_dim), "b": s(*pre, norm_dim)},
+            "fc1": {"w": s(*pre, m2E, m2E), "b": s(*pre, m2E)},
+            "fc2": {"w": s(*pre, m2E, varch.out_hidden), "b": s(*pre, varch.out_hidden)},
+        }
+
+    return {
+        "vision": {
+            "patch_embedding": {"w": s(P2, E), "b": s(E)},
+            "pos_embed": s(varch.num_position_embeddings, E),
+            "blocks": {
+                "ln1": {"w": s(L, E), "b": s(L, E)},
+                "ln2": {"w": s(L, E), "b": s(L, E)},
+                "qkv": {"w": s(L, E, 3 * E), "b": s(L, 3 * E)},
+                "proj": {"w": s(L, E, E), "b": s(L, E)},
+                "fc1": {"w": s(L, E, I), "b": s(L, I)},
+                "fc2": {"w": s(L, I, E), "b": s(L, E)},
+            },
+        },
+        "merger": merger_struct(),
+        "deepstack_mergers": merger_struct(K),
+    }
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    return int(getattr(config, "max_image_tokens", 0) or 64)
+
+
+class Qwen3VLForConditionalGeneration:
+    def __new__(cls, *args, **kwargs):
+        from nxdi_tpu.models.qwen3_vl.application import Qwen3VLApplication
+
+        return Qwen3VLApplication(*args, **kwargs)

@@ -27,6 +27,9 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "llama4": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
     "llama4_text": ("nxdi_tpu.models.llama4.modeling_llama4", "Llama4InferenceConfig"),
     "llava": ("nxdi_tpu.models.llava.modeling_llava", "LlavaInferenceConfig"),
+    "mllama": ("nxdi_tpu.models.mllama.modeling_mllama", "MllamaInferenceConfig"),
+    "qwen2_vl": ("nxdi_tpu.models.qwen2_vl.modeling_qwen2_vl", "Qwen2VLInferenceConfig"),
+    "qwen3_vl": ("nxdi_tpu.models.qwen3_vl.modeling_qwen3_vl", "Qwen3VLInferenceConfig"),
     "gpt2": ("nxdi_tpu.models.gpt2.modeling_gpt2", "GPT2InferenceConfig"),
     "gemma2": ("nxdi_tpu.models.gemma2.modeling_gemma2", "Gemma2InferenceConfig"),
     "phi3": ("nxdi_tpu.models.phi3.modeling_phi3", "Phi3InferenceConfig"),

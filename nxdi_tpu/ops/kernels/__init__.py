@@ -2,14 +2,20 @@ from nxdi_tpu.ops.kernels.flash_attention import (
     decode_kernel_supported,
     flash_attention_decode,
     flash_attention_prefill,
+    paged_attention_decode,
+    paged_decode_kernel_supported,
     prefill_kernel_supported,
     sharded_kernel_call,
+    sharded_paged_decode_call,
 )
 
 __all__ = [
     "decode_kernel_supported",
     "flash_attention_decode",
     "flash_attention_prefill",
+    "paged_attention_decode",
+    "paged_decode_kernel_supported",
     "prefill_kernel_supported",
     "sharded_kernel_call",
+    "sharded_paged_decode_call",
 ]

@@ -222,3 +222,36 @@ def l2_norm(x, eps: float = 1e-6):
     xf = x.astype(jnp.float32)
     y = xf * jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
     return y.astype(x.dtype)
+
+
+def mrope_cos_sin(
+    mrope_position_ids, inv_freq, mrope_section, dtype=jnp.float32,
+    interleaved: bool = False,
+):
+    """Qwen2-VL multimodal rope: (B, 3, S) [temporal, height, width] position
+    streams -> cos/sin (B, S, head_dim), the head_dim/2 frequency channels
+    partitioned into ``mrope_section`` chunks that each read their stream
+    (HF apply_multimodal_rotary_pos_emb; reference: models/qwen2_vl/ M-RoPE).
+    Text tokens carry identical positions in all three streams, which reduces
+    exactly to standard 1-D rope."""
+    inv_freq = jnp.asarray(inv_freq, dtype=jnp.float32)  # (D/2,)
+    pos = mrope_position_ids.astype(jnp.float32)  # (B, 3, S)
+    freqs = pos[..., None] * inv_freq[None, None, None, :]  # (B, 3, S, D/2)
+    if interleaved:
+        # qwen3-vl interleaved layout [T H W T H W ... T T]: start from the
+        # temporal stream and overwrite every 3rd channel with H / W
+        # (HF Qwen3VLTextRotaryEmbedding.apply_interleaved_mrope)
+        half_dim = freqs.shape[-1]
+        ch = jnp.arange(half_dim)
+        sel_h = (ch % 3 == 1) & (ch < 3 * mrope_section[1])
+        sel_w = (ch % 3 == 2) & (ch < 3 * mrope_section[2])
+        half = jnp.where(sel_h, freqs[:, 1], jnp.where(sel_w, freqs[:, 2], freqs[:, 0]))
+    else:
+        parts = []
+        off = 0
+        for i, sec in enumerate(mrope_section):
+            parts.append(freqs[:, i % 3, :, off:off + sec])
+            off += sec
+        half = jnp.concatenate(parts, axis=-1)  # (B, S, D/2)
+    emb = jnp.concatenate([half, half], axis=-1)
+    return jnp.cos(emb).astype(dtype), jnp.sin(emb).astype(dtype)

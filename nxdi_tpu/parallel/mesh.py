@@ -32,6 +32,7 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+AXIS_PP = "pp"
 AXIS_DP = "dp"
 AXIS_CP = "cp"
 AXIS_TP = "tp"
@@ -46,15 +47,20 @@ def build_mesh(
     dp_degree: int = 1,
     cp_degree: int = 1,
     ep_degree: int = 1,
+    pp_degree: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
     allow_split_physical_axes: bool = True,
 ) -> Mesh:
-    """Build a ``Mesh`` with axes (dp, cp, ep, tp).
+    """Build a ``Mesh`` with axes (pp, dp, cp, ep, tp).
 
     ``cp``/``dp``/``ep`` split the TP world the way the reference's CP/DP/EP
     process groups do (attention_process_groups.py:47 ``get_tp_cp_group_mesh``,
     :125 DP groups, moe_v2.py:135-161 TPxEP groups): ``tp_degree`` is the WORLD
-    size, and the inner tensor-parallel axis is tp/(dp*cp*ep).
+    size, and the inner tensor-parallel axis is tp/(dp*cp*ep). ``pp_degree``
+    multiplies the world like the reference's pp process groups
+    (world = tp * pp, models/config.py:366): pipeline stages hold layer
+    slices and exchange activations over the ``pp`` axis (parallel/pipeline
+    schedule in models/base.py).
     """
     if tp_degree % (cp_degree * dp_degree * ep_degree) != 0:
         raise ValueError(
@@ -62,39 +68,40 @@ def build_mesh(
             f"must divide tp_degree ({tp_degree})"
         )
     inner_tp = tp_degree // (cp_degree * dp_degree * ep_degree)
-    n = dp_degree * cp_degree * ep_degree * inner_tp
+    n = pp_degree * dp_degree * cp_degree * ep_degree * inner_tp
     if devices is None:
         devices = jax.devices()
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     devices = list(devices)[:n]
     if len(devices) == 1:
-        dev_array = np.array(devices).reshape(1, 1, 1, 1)
+        dev_array = np.array(devices).reshape(1, 1, 1, 1, 1)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(
-                (dp_degree, cp_degree, ep_degree, inner_tp),
+                (pp_degree, dp_degree, cp_degree, ep_degree, inner_tp),
                 devices=devices,
                 allow_split_physical_axes=allow_split_physical_axes,
             )
         except (ValueError, AssertionError, NotImplementedError):
             dev_array = np.array(devices).reshape(
-                dp_degree, cp_degree, ep_degree, inner_tp
+                pp_degree, dp_degree, cp_degree, ep_degree, inner_tp
             )
-    return Mesh(dev_array, (AXIS_DP, AXIS_CP, AXIS_EP, AXIS_TP))
+    return Mesh(dev_array, (AXIS_PP, AXIS_DP, AXIS_CP, AXIS_EP, AXIS_TP))
 
 
 def mesh_from_config(tpu_config, devices=None) -> Mesh:
     """Mesh for a :class:`TpuConfig`: tp_degree is the world size; the cp,
     attention-dp, and moe-ep degrees carve named sub-axes out of it (reference:
     attention_process_groups.py:81,125 building CP/DP groups over the TP
-    world; moe_v2.py:135-161 EP groups). Submodels that don't use an axis
-    simply leave it unsharded."""
+    world; moe_v2.py:135-161 EP groups); pp_degree multiplies it. Submodels
+    that don't use an axis simply leave it unsharded."""
     return build_mesh(
         tp_degree=tpu_config.tp_degree,
         dp_degree=tpu_config.attention_dp_degree,
         cp_degree=tpu_config.cp_degree,
         ep_degree=getattr(tpu_config, "moe_ep_degree", None) or 1,
+        pp_degree=getattr(tpu_config, "pp_degree", 1) or 1,
         devices=devices,
     )
 

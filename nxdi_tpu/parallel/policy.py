@@ -39,7 +39,7 @@ from dataclasses import dataclass
 
 from jax.sharding import PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_MP
+from nxdi_tpu.parallel.mesh import AXIS_CP, AXIS_DP, AXIS_MP, AXIS_PP
 
 
 @dataclass(frozen=True)
@@ -103,4 +103,8 @@ def kv_cache_partition_spec_for(tc) -> P:
         return P(None, AXIS_DP, AXIS_MP, None, None)
     if tc.flash_decoding_enabled:
         return P(None, None, AXIS_MP, AXIS_CP, None)
+    if getattr(tc, "pp_degree", 1) > 1:
+        # pipeline stages own their layer slice of the cache (stage-local KV,
+        # reference: pp-sharded cache via NxD builder)
+        return P(AXIS_PP, None, AXIS_MP, None, None)
     return P(None, None, AXIS_MP, None, None)

@@ -1,0 +1,144 @@
+"""Mllama (Llama-3.2 Vision) token matching vs HF CPU.
+
+Reference analog: mllama integration tests driving the cross-attention text
+stack + tiled vision encoder (models/mllama/). Greedy tokens must match
+``MllamaForConditionalGeneration`` exactly, including the cross-attention KV
+written at prefill and reused at every decode step."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.mllama import modeling_mllama as mm
+
+
+@pytest.fixture
+def tiny_hf_mllama():
+    from transformers import MllamaConfig, MllamaForConditionalGeneration
+    from transformers.models.mllama.configuration_mllama import (
+        MllamaTextConfig,
+        MllamaVisionConfig,
+    )
+
+    torch.manual_seed(0)
+    vision = MllamaVisionConfig(
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_global_layers=1,
+        attention_heads=4,
+        image_size=16,
+        patch_size=8,
+        max_num_tiles=2,
+        supported_aspect_ratios=[[1, 1], [1, 2], [2, 1]],
+        intermediate_layers_indices=[0, 1],
+        vision_output_dim=96,  # hidden * (1 + len(intermediate_layers_indices))
+    )
+    text = MllamaTextConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=6,
+        cross_attention_layers=[1, 4],
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 128,
+        },
+        tie_word_embeddings=False,
+        bos_token_id=1,
+        eos_token_id=2,
+        pad_token_id=0,
+    )
+    cfg = MllamaConfig(vision_config=vision, text_config=text, image_token_index=250)
+    model = MllamaForConditionalGeneration(cfg).eval()
+    return model, cfg
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = mm.MllamaInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+    app = mm.MllamaForConditionalGeneration("<memory>", cfg)
+    app.get_state_dict = lambda: sd
+    app.load()
+    return app
+
+
+def _vision_inputs(rng, B):
+    pixel = rng.standard_normal((B, 1, 2, 3, 16, 16)).astype(np.float32)
+    ar_ids = np.full((B, 1), 2, np.int64)  # aspect ratio [1, 2] -> two tiles
+    ar_mask = np.ones((B, 1, 2), np.int64)
+    return pixel, ar_ids, ar_mask
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_mllama_token_matching(tiny_hf_mllama, tp_degree):
+    hf_model, hf_cfg = tiny_hf_mllama
+    rng = np.random.default_rng(0)
+    B = 2
+    pixel, ar_ids, ar_mask = _vision_inputs(rng, B)
+    prompts = np.array(
+        [[250, 5, 9, 3, 17, 2, 8, 11], [250, 7, 13, 21, 4, 33, 6, 19]], np.int64
+    )
+    S = prompts.shape[1]
+    xmask = np.ones((B, S, 1, 2), np.int64)
+    n_new = 10
+
+    with torch.no_grad():
+        expected = hf_model.generate(
+            input_ids=torch.tensor(prompts),
+            attention_mask=torch.ones_like(torch.tensor(prompts)),
+            pixel_values=torch.tensor(pixel),
+            aspect_ratio_ids=torch.tensor(ar_ids),
+            aspect_ratio_mask=torch.tensor(ar_mask),
+            cross_attention_mask=torch.tensor(xmask),
+            max_new_tokens=n_new,
+            do_sample=False,
+        ).numpy()[:, S:]
+
+    app = _build_app(hf_model, hf_cfg, tp_degree=tp_degree)
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(
+        prompts.astype(np.int32),
+        pos,
+        pixel_values=pixel,
+        aspect_ratio_ids=ar_ids,
+        aspect_ratio_mask=ar_mask,
+        cross_attention_mask=xmask,
+        last_token_index=np.full((B,), S - 1, np.int32),
+    )
+    got = [np.asarray(out["tokens"])[:, 0]]
+    for step in range(n_new - 1):
+        p = S + step
+        out = app.forward(
+            got[-1][:, None].astype(np.int32),
+            np.full((B, 1), p, np.int32),
+        )
+        got.append(np.asarray(out["tokens"])[:, 0])
+    actual = np.stack(got, axis=1)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_mllama_rejects_unsupported_modes(tiny_hf_mllama):
+    hf_model, hf_cfg = tiny_hf_mllama
+    with pytest.raises(NotImplementedError, match="async"):
+        _build_app(hf_model, hf_cfg, async_mode=True)

@@ -102,10 +102,14 @@ def test_continuous_batching_interleaved(tiny_hf_llama, tp_degree):
     np.testing.assert_array_equal(np.array(got1), e1[: len(got1)])
 
 
-@pytest.mark.parametrize("tp_degree", [1, 8])
-def test_paged_block_kv_token_matching(tiny_hf_llama, tp_degree):
+@pytest.mark.parametrize(
+    "tp_degree,block_kernel", [(1, False), (8, False), (1, True), (8, True)]
+)
+def test_paged_block_kv_token_matching(tiny_hf_llama, tp_degree, block_kernel):
     """Paged layout with deliberately scrambled physical blocks: prefill each
-    row into its (non-contiguous) blocks, then decode jointly via block tables."""
+    row into its (non-contiguous) blocks, then decode jointly via block tables.
+    ``block_kernel`` additionally routes decode through the Pallas paged
+    kernel (block-table-indexed reads) — tokens must be identical."""
     hf_model, hf_cfg = tiny_hf_llama
     block_size = 8
     app = _build_app(
@@ -117,6 +121,7 @@ def test_paged_block_kv_token_matching(tiny_hf_llama, tp_degree):
         pa_num_blocks=24,
         ctx_batch_size=1,
         tkg_batch_size=2,
+        attn_block_tkg_kernel_enabled=block_kernel,
     )
     mgr = BlockSpaceManager(24, block_size)
     # scramble: burn a few blocks so row tables are non-contiguous and offset

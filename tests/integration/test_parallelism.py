@@ -61,6 +61,18 @@ PROMPT = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
         pytest.param(
             dict(cp_degree=2, attention_dp_degree=2, batch_size=2), id="cp2+dp2"
         ),
+        pytest.param(
+            dict(tp_degree=4, pp_degree=2, batch_size=2), id="pp2xtp4"
+        ),
+        pytest.param(
+            dict(tp_degree=2, pp_degree=2, batch_size=4, pp_microbatches=4),
+            id="pp2-micro4",
+        ),
+        pytest.param(
+            dict(tp_degree=4, pp_degree=2, batch_size=2,
+                 sequence_parallel_enabled=True),
+            id="pp2+sp",
+        ),
     ],
 )
 def test_parallel_strategy_token_matching(tiny_hf_llama, tcfg_kwargs):
@@ -80,7 +92,14 @@ def test_mesh_axes_from_config():
 
     tc = TpuConfig(tp_degree=8, cp_degree=2, attention_dp_degree=2, batch_size=2)
     mesh = mesh_from_config(tc)
-    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {"dp": 2, "cp": 2, "ep": 1, "tp": 2}
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "pp": 1, "dp": 2, "cp": 2, "ep": 1, "tp": 2
+    }
+    tc = TpuConfig(tp_degree=4, pp_degree=2, batch_size=2)
+    mesh = mesh_from_config(tc)
+    assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
+        "pp": 2, "dp": 1, "cp": 1, "ep": 1, "tp": 4
+    }
 
 
 def test_flash_decoding_requires_single_bucket():

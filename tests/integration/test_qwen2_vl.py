@@ -1,0 +1,132 @@
+"""Qwen2-VL token matching vs HF CPU — M-RoPE position streams + 2-D-rope
+vision tower + patch merger (reference: models/qwen2_vl/, 3-D rope index
+model_base.py get_rope_index analog)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.qwen2_vl import modeling_qwen2_vl as mq
+
+IMG, VIS_START, VIDEO = 250, 249, 248
+
+
+@pytest.fixture
+def tiny_hf_qwen2vl():
+    from transformers import Qwen2VLConfig, Qwen2VLForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = Qwen2VLConfig(
+        text_config=dict(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rope_theta=10000.0,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            tie_word_embeddings=False,
+            bos_token_id=1,
+            eos_token_id=2,
+            pad_token_id=0,
+        ),
+        vision_config=dict(
+            embed_dim=32,
+            depth=2,
+            num_heads=4,
+            mlp_ratio=2,
+            patch_size=4,
+            temporal_patch_size=1,
+            in_channels=3,
+            spatial_merge_size=2,
+            hidden_size=64,
+        ),
+        image_token_id=IMG,
+        video_token_id=VIDEO,
+        vision_start_token_id=VIS_START,
+    )
+    model = Qwen2VLForConditionalGeneration(cfg).eval()
+    return model, cfg
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_qwen2_vl_token_matching(tiny_hf_qwen2vl, tp_degree):
+    hf_model, hf_cfg = tiny_hf_qwen2vl
+    rng = np.random.default_rng(0)
+    B = 2
+    grid = np.array([[1, 4, 4], [1, 4, 4]], np.int64)  # 16 patches -> 4 tokens each
+    n_patches = int(grid[:, 0].mul if False else (grid.prod(axis=1)).sum())
+    pixel = rng.standard_normal((n_patches, 3 * 1 * 4 * 4)).astype(np.float32)
+    # prompts: vision_start + 4 merged placeholders + text
+    prompts = np.array(
+        [
+            [VIS_START, IMG, IMG, IMG, IMG, 5, 9, 3, 17, 2],
+            [VIS_START, IMG, IMG, IMG, IMG, 7, 13, 21, 4, 33],
+        ],
+        np.int64,
+    )
+    S = prompts.shape[1]
+    n_new = 10
+
+    with torch.no_grad():
+        expected = hf_model.generate(
+            input_ids=torch.tensor(prompts),
+            attention_mask=torch.ones_like(torch.tensor(prompts)),
+            pixel_values=torch.tensor(pixel),
+            image_grid_thw=torch.tensor(grid),
+            max_new_tokens=n_new,
+            do_sample=False,
+        ).numpy()[:, S:]
+
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = mq.Qwen2VLInferenceConfig(
+        TpuConfig(
+            tp_degree=tp_degree,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+        ),
+        load_config=lambda: hf_cfg.to_dict(),
+    )
+    app = mq.Qwen2VLForConditionalGeneration("<memory>", cfg)
+    app.get_state_dict = lambda: sd
+    app.load()
+
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(
+        prompts.astype(np.int32),
+        pos,
+        pixel_values=pixel,
+        image_grid_thw=grid,
+        last_token_index=np.full((B,), S - 1, np.int32),
+    )
+    got = [np.asarray(out["tokens"])[:, 0]]
+    for step in range(n_new - 1):
+        p = S + step
+        out = app.forward(
+            got[-1][:, None].astype(np.int32), np.full((B, 1), p, np.int32)
+        )
+        got.append(np.asarray(out["tokens"])[:, 0])
+    actual = np.stack(got, axis=1)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_get_rope_index_matches_hf(tiny_hf_qwen2vl):
+    """The host-side 3-D rope index must equal HF get_rope_index."""
+    hf_model, hf_cfg = tiny_hf_qwen2vl
+    prompts = np.array(
+        [[VIS_START, IMG, IMG, IMG, IMG, 5, 9, 3, 17, 2]], np.int64
+    )
+    grid = np.array([[1, 4, 4]], np.int64)
+    exp_pos, exp_delta = hf_model.model.get_rope_index(
+        torch.tensor(prompts), torch.tensor(grid), None, torch.ones_like(torch.tensor(prompts))
+    )
+    got_pos, got_delta = mq.get_rope_index(prompts, grid, IMG, VIS_START, 2)
+    np.testing.assert_array_equal(got_pos.transpose(1, 0, 2), exp_pos.numpy())
+    np.testing.assert_array_equal(got_delta, exp_delta.numpy()[:, 0])

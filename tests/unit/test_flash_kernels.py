@@ -68,3 +68,69 @@ def test_decode_kernel_sliding_window():
     expected = attention_with_positions(q, k, v, q_pos, kv_pos, sliding_window=8)
     actual = flash_attention_decode(q, k, v, q_pos, kv_pos, sliding_window=8, block_k=8)
     np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Paged decode kernel
+# ---------------------------------------------------------------------------
+
+from nxdi_tpu.kvcache.kv_cache import BlockKVCacheSpec, BlockKVLayout  # noqa: E402
+from nxdi_tpu.ops.kernels.flash_attention import paged_attention_decode  # noqa: E402
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2)])
+def test_paged_decode_kernel_matches_gathered_read(H, KV):
+    """Kernel reading through a scrambled block table (with holes) must equal
+    the XLA gather path (BlockKVLayout.read + attention)."""
+    B, D, block_size, num_blocks = 2, 16, 8, 12
+    NB = 4  # table width per row
+    total = num_blocks * block_size
+    rng = np.random.default_rng(3)
+    k_cache = jnp.asarray(rng.standard_normal((total, KV, D)), jnp.float32)
+    v_cache = jnp.asarray(rng.standard_normal((total, KV, D)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, H, 1, D)), jnp.float32)
+    # row 0: 3 live blocks (scrambled), 1 hole; row 1: 2 live blocks
+    bt = jnp.array([[7, 2, 9, -1], [11, 0, -1, -1]], jnp.int32)
+    q_pos = jnp.array([[21], [10]], jnp.int32)
+
+    layout = BlockKVLayout(block_size=block_size)
+    spec = BlockKVCacheSpec(
+        num_layers=1, num_blocks=num_blocks, block_size=block_size,
+        num_kv_heads=KV, head_dim=D, dtype="float32",
+    )
+    kk, vv, kv_pos = layout.read(k_cache, v_cache, {"block_table": bt}, spec)
+    expected = attention_with_positions(q, kk, vv, q_pos, kv_pos)
+
+    actual = paged_attention_decode(
+        q, k_cache, v_cache, bt, q_pos, block_size=block_size
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+def test_paged_decode_kernel_scaled_fp8_folding():
+    """k/v per-tensor scales fold into softmax scale / output normalization —
+    must match the unscaled reference on a cache stored with inverse scales."""
+    B, H, KV, D, block_size, num_blocks = 1, 4, 2, 16, 8, 6
+    total = num_blocks * block_size
+    rng = np.random.default_rng(4)
+    k_raw = rng.standard_normal((total, KV, D)).astype(np.float32)
+    v_raw = rng.standard_normal((total, KV, D)).astype(np.float32)
+    q = jnp.asarray(rng.standard_normal((B, H, 1, D)), jnp.float32)
+    bt = jnp.array([[3, 1, -1]], jnp.int32)
+    q_pos = jnp.array([[13]], jnp.int32)
+    k_scale, v_scale = 2.5, 0.75
+
+    expected = paged_attention_decode(
+        q, jnp.asarray(k_raw), jnp.asarray(v_raw), bt, q_pos, block_size=block_size
+    )
+    actual = paged_attention_decode(
+        q,
+        jnp.asarray(k_raw / k_scale),
+        jnp.asarray(v_raw / v_scale),
+        bt,
+        q_pos,
+        block_size=block_size,
+        k_scale=k_scale,
+        v_scale=v_scale,
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
