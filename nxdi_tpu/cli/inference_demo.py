@@ -54,6 +54,15 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--cp-degree", type=int, default=1)
     p.add_argument("--ep-degree", type=int, default=1)
     p.add_argument("--attention-dp-degree", type=int, default=1)
+    p.add_argument("--pp-degree", type=int, default=1)
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="GPipe microbatches per pipelined forward (0 = pp-degree)")
+    p.add_argument("--moe-ep-degree", type=int, default=None,
+                   help="hybrid MoE expert-parallel degree (experts over ep, "
+                        "expert intermediates over tp)")
+    p.add_argument("--moe-dispatch", default="sparse", choices=["sparse", "dense"])
+    p.add_argument("--sequence-parallel-enabled", action="store_true")
+    p.add_argument("--flash-decoding-enabled", action="store_true")
 
     # sampling
     p.add_argument("--on-device-sampling", action="store_true")
@@ -71,6 +80,24 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
 
     # execution
     p.add_argument("--async-mode", action="store_true")
+    p.add_argument("--is-continuous-batching", action="store_true")
+
+    # KV layouts
+    p.add_argument("--is-block-kv-layout", action="store_true",
+                   help="paged (vLLM-style) KV cache")
+    p.add_argument("--pa-block-size", type=int, default=128)
+    p.add_argument("--pa-num-blocks", type=int, default=None)
+    p.add_argument("--window-sized-kv", action="store_true",
+                   help="ring KV cache sized to --sliding-window slots")
+    p.add_argument("--sliding-window", type=int, default=None)
+
+    # Pallas kernels
+    p.add_argument("--attn-kernel-enabled", action="store_true",
+                   help="flash prefill kernel")
+    p.add_argument("--attn-tkg-kernel-enabled", action="store_true",
+                   help="flash decode kernel")
+    p.add_argument("--attn-block-tkg-kernel-enabled", action="store_true",
+                   help="paged decode kernel (reads through the block table)")
 
     # speculation
     p.add_argument("--draft-model-path", default=None)
@@ -85,6 +112,10 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
         "--medusa-tree", default=None,
         help="token tree: path to a JSON file of paths, or inline JSON "
              "(reference: examples/medusa_mc_sim_7b_63.json)",
+    )
+    p.add_argument(
+        "--token-tree-config", default=None,
+        help="EAGLE token tree: path to a JSON file of paths, or inline JSON",
     )
 
     # LoRA serving
@@ -104,6 +135,11 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--quantized", action="store_true")
     p.add_argument("--quantization-dtype", default="int8")
     p.add_argument("--kv-cache-quant", action="store_true")
+    p.add_argument("--kv-scale-mode", default="direct_cast",
+                   choices=["direct_cast", "per_tensor"],
+                   help="fp8 KV store: raw cast or scaled by --k-scale/--v-scale")
+    p.add_argument("--k-scale", type=float, default=1.0)
+    p.add_argument("--v-scale", type=float, default=1.0)
 
     # accuracy / benchmark
     p.add_argument("--check-accuracy-mode", default="skip", choices=CHECK_ACCURACY_MODES)
@@ -159,6 +195,21 @@ def create_tpu_config(args):
         cp_degree=args.cp_degree,
         ep_degree=args.ep_degree,
         attention_dp_degree=args.attention_dp_degree,
+        pp_degree=args.pp_degree,
+        pp_microbatches=args.pp_microbatches,
+        moe_ep_degree=args.moe_ep_degree,
+        moe_dispatch=args.moe_dispatch,
+        sequence_parallel_enabled=args.sequence_parallel_enabled,
+        flash_decoding_enabled=args.flash_decoding_enabled,
+        is_continuous_batching=args.is_continuous_batching,
+        is_block_kv_layout=args.is_block_kv_layout,
+        pa_block_size=args.pa_block_size,
+        pa_num_blocks=args.pa_num_blocks,
+        window_sized_kv=args.window_sized_kv,
+        sliding_window=args.sliding_window,
+        attn_kernel_enabled=args.attn_kernel_enabled,
+        attn_tkg_kernel_enabled=args.attn_tkg_kernel_enabled,
+        attn_block_tkg_kernel_enabled=args.attn_block_tkg_kernel_enabled,
         on_device_sampling_config=odsc,
         enable_bucketing=args.enable_bucketing,
         context_encoding_buckets=args.context_encoding_buckets,
@@ -174,6 +225,13 @@ def create_tpu_config(args):
         quantized=args.quantized,
         quantization_dtype=args.quantization_dtype,
         kv_cache_quant=args.kv_cache_quant,
+        kv_quant_config=(
+            {"scale_mode": args.kv_scale_mode, "k_scale": args.k_scale,
+             "v_scale": args.v_scale}
+            if args.kv_cache_quant and args.kv_scale_mode == "per_tensor"
+            else None
+        ),
+        token_tree_config=_load_medusa_tree(args.token_tree_config),
         skip_warmup=args.skip_warmup,
         lora_config=lora_cfg,
     )

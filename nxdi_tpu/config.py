@@ -290,6 +290,11 @@ class TpuConfig:
             self.enable_fused_speculation = True
         self.is_eagle3 = kwargs.pop("is_eagle3", spec.is_eagle3 if spec else False)
         self.is_eagle_draft = kwargs.pop("is_eagle_draft", False)
+        # EAGLE token-tree speculation: medusa-style path list (reference:
+        # modules/eagle/token_tree.py:8 TokenTree config)
+        self.token_tree_config = kwargs.pop(
+            "token_tree_config", spec.token_tree_config if spec else None
+        )
         self.is_medusa = kwargs.pop("is_medusa", False)
         self.medusa_speculation_length = kwargs.pop("medusa_speculation_length", 0)
         self.num_medusa_heads = kwargs.pop("num_medusa_heads", 0)
@@ -354,6 +359,10 @@ class TpuConfig:
         # --- misc/debug ---
         self.qk_layernorm = kwargs.pop("qk_layernorm", False)
         self.sliding_window = kwargs.pop("sliding_window", None)
+        # window-sized ring KV cache for uniformly sliding-window models
+        # (reference: window-sized cache shapes kv_cache_manager.py:195-210):
+        # cache S dim = sliding_window slots instead of seq_len
+        self.window_sized_kv = kwargs.pop("window_sized_kv", False)
         self.windowed_context_encoding_size = kwargs.pop("windowed_context_encoding_size", None)
         self.logical_nc_config = kwargs.pop("logical_nc_config", 1)
         self.skip_warmup = kwargs.pop("skip_warmup", False)
@@ -426,6 +435,26 @@ class TpuConfig:
                     raise ValueError(
                         f"{name} ({bs}) must be divisible by pp_microbatches ({n_micro})"
                     )
+        if self.window_sized_kv:
+            if not self.sliding_window:
+                raise ValueError(
+                    "window_sized_kv needs tpu_config.sliding_window (the ring "
+                    "slot count) — set it to the model's sliding window"
+                )
+            if (
+                self.is_block_kv_layout
+                or self.speculation_length > 0
+                or self.enable_fused_speculation
+                or self.is_medusa
+                or self.is_prefix_caching
+                or self.is_chunked_prefill
+                or self.flash_decoding_enabled
+            ):
+                raise ValueError(
+                    "window_sized_kv composes with plain decode only: paged/"
+                    "speculative/prefix modes assume position-addressed cache "
+                    "slots, which the ring layout does not provide"
+                )
         if self.is_medusa and self.num_medusa_heads <= 0:
             raise ValueError("is_medusa requires num_medusa_heads > 0")
         if self.lora_config is not None and self.async_mode:

@@ -262,6 +262,68 @@ class BlockKVLayout:
         return kk, vv, kv_pos
 
 
+@dataclass(frozen=True)
+class WindowKVLayout:
+    """Window-sized ring cache for sliding-window models: (B, KV, W, D) with
+    position ``p`` living in slot ``p % W`` — cache memory is W slots instead
+    of max_len (reference: per-layer window-sized cache shapes,
+    kv_cache_manager.py:195-210 / gpt_oss_kv_cache_manager.py).
+
+    Writes: only the LAST W real tokens land (a position is dropped if a
+    later real token maps to the same slot); right-padding lanes continue the
+    position arange past the true last token, so the keep-mask reads
+    ``last_token_index`` from the cache inputs — without it a pad lane would
+    alias (clobber) a live slot, which the full-length layout never had to
+    care about.
+
+    Reads (decode): slot ``s`` holds position ``p - ((p - s) mod W)`` for the
+    current position ``p``; slots that would be negative (early decode) are
+    pushed out of every causal mask. Single-position decode only — the
+    in-window read-after-write interleaving of speculation windows has no
+    consistent ring state, and applications reject those combinations.
+    """
+
+    window: int
+    route_by_seq_id: bool = False
+
+    def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
+        B, S = cache_inputs["position_ids"].shape
+        W = self.window
+        pos = cache_inputs["position_ids"].astype(jnp.int32)
+        lti = cache_inputs.get("last_token_index")
+        last_real = (
+            jnp.take_along_axis(pos, lti[:, None].astype(jnp.int32), axis=1)
+            if lti is not None
+            else pos[:, -1:]
+        )  # (B, 1)
+        keep = (pos <= last_real) & (pos > last_real - W)
+        slot = jnp.where(keep, pos % W, W)  # W = dropped by the scatter
+        if self.route_by_seq_id:
+            b_idx = cache_inputs["seq_ids"][:, None].astype(jnp.int32)
+        else:
+            b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+        store = k_cache_l.dtype
+        k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S, KV, D)
+        v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
+        k_cache_l = k_cache_l.at[b_idx, :, slot].set(k_vals, mode="drop")
+        v_cache_l = v_cache_l.at[b_idx, :, slot].set(v_vals, mode="drop")
+        return k_cache_l, v_cache_l
+
+    def read(self, k_cache_l, v_cache_l, cache_inputs, spec):
+        compute = spec.compute_dtype
+        kk, vv = k_cache_l.astype(compute), v_cache_l.astype(compute)
+        if self.route_by_seq_id:
+            seq_ids = cache_inputs["seq_ids"].astype(jnp.int32)
+            kk = jnp.take(kk, seq_ids, axis=0, mode="clip")
+            vv = jnp.take(vv, seq_ids, axis=0, mode="clip")
+        W = self.window
+        p = cache_inputs["position_ids"][:, :1].astype(jnp.int32)  # (B, 1)
+        s = jnp.arange(W, dtype=jnp.int32)[None, :]
+        kv_pos = p - ((p - s) % W)  # (B, W)
+        kv_pos = jnp.where(kv_pos >= 0, kv_pos, jnp.int32(2 ** 30))
+        return kk, vv, kv_pos
+
+
 DEFAULT_KV_LAYOUT = ContiguousKVLayout()
 
 

@@ -132,6 +132,19 @@ class DecoderArch:
     # streams as "mrope_position_ids" (reference: models/qwen2_vl/ M-RoPE)
     mrope_section: Optional[Tuple[int, ...]] = None
     mrope_interleaved: bool = False  # qwen3-vl channel-interleaved layout
+    # partial rotary (minimax-m2 rotary_dim=64 of head_dim=128; phi lineage):
+    # only the first rotary_dim channels rotate, the rest pass through
+    rotary_dim: Optional[int] = None
+    # minimax-m2 "per_layer" qk norm: RMSNorm over the FLAT projection output
+    # (num_heads*head_dim) BEFORE head reshape/rope. Under GQA zero-padding
+    # the q denominator must stay the TRUE (unpadded) width — padded entries
+    # are exactly zero, so sum(x^2)/true_dim reproduces the unpadded mean;
+    # replicated k heads preserve the mean, so k uses the plain mean.
+    qk_norm_flat: bool = False
+    qk_norm_flat_qdim: int = 0  # true (unpadded) q width
+    # asymmetric value width (mimo-v2: q/k head_dim 192, v head_dim 128);
+    # None = same as head_dim. Cache stores v at this width.
+    v_head_dim: Optional[int] = None
     # Multi-head Latent Attention replaces the GQA attention when set
     # (ops/mla.py; deepseek lineage)
     mla: Optional[Any] = None
@@ -170,6 +183,7 @@ class DecoderArch:
             head_dim=self.head_dim,
             dtype=self.dtype,
             quant_dtype=quant_dtype,
+            v_head_dim=self.v_head_dim,
         )
 
 
@@ -314,6 +328,7 @@ def attention_block(
     """
     B, S, _ = hidden.shape
     H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    Dv = arch.v_head_dim or D  # mimo-v2: value width differs from q/k
 
     aq, ac = arch.act_quant, arch.act_clamp
     q = _linear(hidden, p_attn["q_proj"], aq, ac, adapter_ids)
@@ -323,9 +338,18 @@ def attention_block(
         q = jnp.clip(q, -arch.clip_qkv, arch.clip_qkv)
         k = jnp.clip(k, -arch.clip_qkv, arch.clip_qkv)
         v = jnp.clip(v, -arch.clip_qkv, arch.clip_qkv)
+    if arch.qk_norm_flat:
+        # minimax-m2: rmsnorm over the whole flattened projection, pre-reshape
+        def flat_rms(x, w, denom):
+            xf = x.astype(jnp.float32)
+            ms = jnp.sum(xf * xf, axis=-1, keepdims=True) / denom
+            return (xf * jax.lax.rsqrt(ms + arch.rms_norm_eps) * w).astype(x.dtype)
+
+        q = flat_rms(q, p_attn["q_norm"], arch.qk_norm_flat_qdim or q.shape[-1])
+        k = flat_rms(k, p_attn["k_norm"], k.shape[-1])
     q = q.reshape(B, S, H, D)
     k = k.reshape(B, S, KV, D)
-    v = v.reshape(B, S, KV, D)
+    v = v.reshape(B, S, KV, Dv)
 
     if arch.qk_norm:
         q = _norm(arch, q, p_attn["q_norm"])
@@ -342,6 +366,17 @@ def attention_block(
     rope_fn = apply_rotary_pos_emb
     if arch.rope_interleaved:
         from nxdi_tpu.ops.rope import apply_rotary_pos_emb_interleaved as rope_fn
+    if arch.rotary_dim is not None and arch.rotary_dim < D:
+        # partial rotary: rotate the first rotary_dim channels only
+        # (cos/sin are built from a rotary_dim-sized frequency table)
+        rd, base_rope = arch.rotary_dim, rope_fn
+
+        def rope_fn(q_, k_, cos_, sin_):
+            qr, kr = base_rope(q_[..., :rd], k_[..., :rd], cos_, sin_)
+            return (
+                jnp.concatenate([qr, q_[..., rd:]], axis=-1),
+                jnp.concatenate([kr, k_[..., rd:]], axis=-1),
+            )
     if arch.no_rope:
         pass  # gpt2 lineage: positions come from learned embeddings
     elif use_rope is None:
@@ -383,6 +418,7 @@ def attention_block(
         # attention_base.py:50-162)
         if (
             isinstance(layout, BlockKVLayout)
+            and arch.v_head_dim is None
             and arch.attn_block_tkg_kernel_enabled
             and S == 1
             and "block_table" in ci
@@ -426,12 +462,13 @@ def attention_block(
                 sink=p_attn.get("sink") if arch.attention_sink else None,
                 logit_softcap=arch.attn_logit_softcap,
             )
-            ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+            ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
             out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
             return out, (new_k, new_v)
         ctx = None
         if (
             arch.attn_tkg_kernel_enabled
+            and arch.v_head_dim is None
             and not arch.attention_sink
             and arch.attn_logit_softcap is None
             and window_enabled is None
@@ -461,6 +498,7 @@ def attention_block(
         ctx = None
         if (
             arch.attn_kernel_enabled
+            and arch.v_head_dim is None
             and not arch.attention_sink
             and arch.attn_logit_softcap is None
             and window_enabled is None
@@ -487,7 +525,7 @@ def attention_block(
                 logit_softcap=arch.attn_logit_softcap,
             )
 
-    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
     out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
     return out, (new_k, new_v)
 
@@ -713,7 +751,11 @@ def run_decoder_layers(
     that need it compile with it; returns a 3-tuple then.
     """
 
-    windowable = not isinstance(layout, BlockKVLayout)
+    from nxdi_tpu.kvcache.kv_cache import WindowKVLayout
+
+    # bucket re-windowing slices the cache S dim — meaningless for the paged
+    # pool and for the ring layout (its S dim is slots, not positions)
+    windowable = not isinstance(layout, (BlockKVLayout, WindowKVLayout))
 
     def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_):
         """One decoder layer with the bucket's static KV window applied."""
@@ -869,7 +911,7 @@ def causal_lm_forward(
         # EAGLE draft input: concat(token embedding, previous-position feature)
         # projected back to the hidden size (reference: the EAGLE draft fc,
         # modeling_llama.py:1408, fed target hidden states model_base.py:1581).
-        feats = batch["prev_hidden"].astype(compute_dtype)
+        feats = batch["prev_hidden"][:, : input_ids.shape[1]].astype(compute_dtype)
         hidden = _linear(
             jnp.concatenate([hidden, feats], axis=-1),
             params["fc"], arch.act_quant, arch.act_clamp,
@@ -925,7 +967,8 @@ def causal_lm_forward(
         cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
     cache_inputs = {
         k: batch[k]
-        for k in ("seq_ids", "slot_mapping", "block_table", "write_positions", "attn_mask")
+        for k in ("seq_ids", "slot_mapping", "block_table", "write_positions",
+                  "attn_mask", "last_token_index")
         if k in batch
     }
     layer_injections = None

@@ -103,6 +103,18 @@ def _use_rope_flags(config: InferenceConfig) -> np.ndarray:
 def convert_hf_state_dict(
     state_dict: Dict[str, np.ndarray], config: InferenceConfig
 ) -> Dict[str, Any]:
+    # composite (vision) checkpoints nest the text side under language_model.*
+    if any(k.startswith(("language_model.", "model.language_model.")) for k in state_dict):
+        stripped = {}
+        for k, v in state_dict.items():
+            for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+                if k.startswith(prefix):
+                    stripped[k[len(prefix):]] = v
+                    break
+            else:
+                if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                    stripped["lm_head.weight"] = v
+        state_dict = stripped
     arch = build_arch(config)
     inter = arch.moe.intermediate_size
 
@@ -143,3 +155,239 @@ def param_shape_struct(config: InferenceConfig):
         (config.num_hidden_layers,), jnp.bool_
     )
     return struct
+
+
+# ---------------------------------------------------------------------------
+# Vision tower (reference: the llama4 vision side of models/llama4/, ~2000 LoC
+# of its 3245; HF Llama4VisionModel semantics)
+# ---------------------------------------------------------------------------
+
+from dataclasses import dataclass as _dataclass  # noqa: E402
+from typing import Tuple as _Tuple  # noqa: E402
+
+
+@_dataclass(frozen=True)
+class Llama4VisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int
+    pixel_shuffle_ratio: float
+    projector_input_dim: int
+    projector_output_dim: int
+    norm_eps: float
+    rope_theta: float
+    vision_output_dim: int
+    text_hidden: int
+
+    @property
+    def num_patches(self) -> int:  # EXCLUDING the (appended) cls token
+        return (self.image_size // self.patch_size) ** 2
+
+
+def build_vision_arch(config: InferenceConfig) -> Llama4VisionArch:
+    vc = config.vision_config
+    if not isinstance(vc, dict):
+        vc = vc.to_dict()
+    return Llama4VisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        pixel_shuffle_ratio=vc.get("pixel_shuffle_ratio", 0.5),
+        projector_input_dim=vc["projector_input_dim"],
+        projector_output_dim=vc["projector_output_dim"],
+        norm_eps=vc.get("norm_eps", 1e-5),
+        rope_theta=vc.get("rope_theta", 10000.0),
+        vision_output_dim=vc["vision_output_dim"],
+        text_hidden=config.hidden_size,
+    )
+
+
+def _vision_freqs(varch: Llama4VisionArch) -> np.ndarray:
+    """(N+1, D/2, 2) [cos, sin] 2-D rope phases, cls row zeroed (HF
+    Llama4VisionRotaryEmbedding — the cls token gets identity rotation)."""
+    idx = varch.image_size // varch.patch_size
+    D = varch.hidden_size // varch.num_heads
+    fd = D // 2
+    img = np.arange(idx ** 2)
+    fx = (img % idx + 1).astype(np.float64)
+    fy = (img // idx + 1).astype(np.float64)
+    rope_freq = 1.0 / (
+        varch.rope_theta ** (np.arange(0, fd, 2)[: fd // 2] / fd)
+    )
+    freqs_x = np.repeat(fx[:, None] * rope_freq[None, :], 2, axis=-1)
+    freqs_y = np.repeat(fy[:, None] * rope_freq[None, :], 2, axis=-1)
+    freqs = np.concatenate([freqs_x, freqs_y], axis=-1)[:, ::2]  # (N, D/2)
+    freqs = np.concatenate([freqs, np.zeros((1, freqs.shape[1]))], axis=0)
+    return np.stack([np.cos(freqs), np.sin(freqs)], axis=-1).astype(np.float32)
+
+
+def encode_images(varch: Llama4VisionArch, params, pixel_values):
+    """(BT, C, H, W) tiles -> (B?, merged_tokens, text_hidden) — unfold patch
+    embed, cls APPENDED, learned positions, pre-LN, 2-D complex rope layers,
+    post-LN, pixel shuffle + MLP2 adapter, projector."""
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.ops.norms import layer_norm
+
+    v = params["vision"]
+    BT, C, HI, WI = pixel_values.shape
+    P = varch.patch_size
+    g = HI // P
+    E = varch.hidden_size
+    nh = varch.num_heads
+    d = E // nh
+
+    # unfold == patchify: (BT, gh, gw, C, P, P) -> rows flattened (C, ph, pw)
+    x = pixel_values.reshape(BT, C, g, P, g, P)
+    x = x.transpose(0, 2, 4, 1, 3, 5).reshape(BT, g * g, C * P * P)
+    h = x @ v["patch_embedding"]
+    cls = jnp.broadcast_to(v["class_embedding"][None, None, :], (BT, 1, E))
+    h = jnp.concatenate([h, cls], axis=1)  # cls LAST (llama4 quirk)
+    h = h + v["positional_embedding"][None]
+    h = layer_norm(h, v["ln_pre"]["w"], v["ln_pre"]["b"], eps=1e-5)
+
+    cs = jnp.asarray(_vision_freqs(varch))  # (N+1, D/2, 2)
+    cos, sin = cs[None, :, None, :, 0], cs[None, :, None, :, 1]  # (1, N+1, 1, D/2)
+
+    def rot(x_):  # adjacent-pair complex multiply
+        xr = x_.reshape(x_.shape[:-1] + (d // 2, 2))
+        a, b = xr[..., 0], xr[..., 1]
+        return jnp.stack([a * cos - b * sin, a * sin + b * cos], axis=-1).reshape(x_.shape)
+
+    def layer(carry, lp):
+        N = carry.shape[1]
+        y = layer_norm(carry, lp["ln1"]["w"], lp["ln1"]["b"], eps=1e-5)
+        q = (y @ lp["q_proj"]["w"] + lp["q_proj"]["b"]).reshape(BT, N, nh, d)
+        k = (y @ lp["k_proj"]["w"] + lp["k_proj"]["b"]).reshape(BT, N, nh, d)
+        val = (y @ lp["v_proj"]["w"] + lp["v_proj"]["b"]).reshape(BT, N, nh, d)
+        q, k = rot(q.astype(jnp.float32)), rot(k.astype(jnp.float32))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        w = jax.nn.softmax(s * (d ** -0.5), axis=-1).astype(val.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", w, val).reshape(BT, N, E)
+        carry = carry + attn @ lp["o_proj"]["w"] + lp["o_proj"]["b"]
+        y = layer_norm(carry, lp["ln2"]["w"], lp["ln2"]["b"], eps=1e-5)
+        ff = jax.nn.gelu(y @ lp["fc1"]["w"] + lp["fc1"]["b"], approximate=False)
+        ff = ff @ lp["fc2"]["w"] + lp["fc2"]["b"]
+        return carry + ff, None
+
+    h, _ = jax.lax.scan(layer, h, v["layers"])
+    h = layer_norm(h, v["ln_post"]["w"], v["ln_post"]["b"], eps=1e-5)
+    h = h[:, :-1]  # drop cls
+
+    # pixel shuffle (HF pixel_shuffle): (BT, N, C) -> (BT, N*r^2? ...)
+    r = varch.pixel_shuffle_ratio
+    ps = int(varch.num_patches ** 0.5)
+    ch = h.shape[-1]
+    t = h.reshape(BT, ps, ps, ch)
+    t = t.reshape(BT, ps, int(ps * r), int(ch / r)).transpose(0, 2, 1, 3)
+    t = t.reshape(BT, int(ps * r), int(ps * r), int(ch / (r * r))).transpose(0, 2, 1, 3)
+    t = t.reshape(BT, -1, int(ch / (r * r)))
+    # MLP2 adapter: gelu(fc1) -> gelu(fc2)
+    a = v["adapter"]
+    t = jax.nn.gelu(t @ a["fc1"]["w"], approximate=False)
+    t = jax.nn.gelu(t @ a["fc2"]["w"], approximate=False)
+    # (BT, merged, text_hidden): one tile per image per batch row — the
+    # image-to-text base distributes rows by placeholder counts
+    return t @ params["projector"]["w"]
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    varch = build_vision_arch(config)
+    per_tile = int(varch.num_patches * varch.pixel_shuffle_ratio ** 2)
+    return int(getattr(config, "max_image_tokens", 0) or per_tile)
+
+
+def convert_vision_params(state_dict, config: InferenceConfig):
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in (f"model.{name}", name):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(f"missing vision weight {name}")
+
+    f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    layers = []
+    for i in range(varch.num_layers):
+        p = f"vision_model.model.layers.{i}."
+        layers.append({
+            "ln1": {"w": f32(get(p + "input_layernorm.weight")),
+                    "b": f32(get(p + "input_layernorm.bias"))},
+            "ln2": {"w": f32(get(p + "post_attention_layernorm.weight")),
+                    "b": f32(get(p + "post_attention_layernorm.bias"))},
+            "q_proj": {"w": f32(get(p + "self_attn.q_proj.weight").T),
+                       "b": f32(get(p + "self_attn.q_proj.bias"))},
+            "k_proj": {"w": f32(get(p + "self_attn.k_proj.weight").T),
+                       "b": f32(get(p + "self_attn.k_proj.bias"))},
+            "v_proj": {"w": f32(get(p + "self_attn.v_proj.weight").T),
+                       "b": f32(get(p + "self_attn.v_proj.bias"))},
+            "o_proj": {"w": f32(get(p + "self_attn.o_proj.weight").T),
+                       "b": f32(get(p + "self_attn.o_proj.bias"))},
+            "fc1": {"w": f32(get(p + "mlp.fc1.weight").T), "b": f32(get(p + "mlp.fc1.bias"))},
+            "fc2": {"w": f32(get(p + "mlp.fc2.weight").T), "b": f32(get(p + "mlp.fc2.bias"))},
+        })
+    import jax.tree_util as jtu
+
+    stack = lambda ls: jtu.tree_map(lambda *xs: np.stack(xs), *ls)  # noqa: E731
+    return {
+        "vision": {
+            "patch_embedding": f32(get("vision_model.patch_embedding.linear.weight").T),
+            "class_embedding": f32(get("vision_model.class_embedding")),
+            "positional_embedding": f32(get("vision_model.positional_embedding_vlm")),
+            "ln_pre": {"w": f32(get("vision_model.layernorm_pre.weight")),
+                       "b": f32(get("vision_model.layernorm_pre.bias"))},
+            "ln_post": {"w": f32(get("vision_model.layernorm_post.weight")),
+                        "b": f32(get("vision_model.layernorm_post.bias"))},
+            "layers": stack(layers),
+            "adapter": {
+                "fc1": {"w": f32(get("vision_model.vision_adapter.mlp.fc1.weight").T)},
+                "fc2": {"w": f32(get("vision_model.vision_adapter.mlp.fc2.weight").T)},
+            },
+        },
+        "projector": {"w": f32(get("multi_modal_projector.linear_1.weight").T)},
+    }
+
+
+def vision_shape_struct(config: InferenceConfig):
+    import jax
+
+    varch = build_vision_arch(config)
+    E, I, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    nP = varch.num_patches + 1
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    return {
+        "vision": {
+            "patch_embedding": s(varch.num_channels * varch.patch_size ** 2, E),
+            "class_embedding": s(E),
+            "positional_embedding": s(nP, E),
+            "ln_pre": {"w": s(E), "b": s(E)},
+            "ln_post": {"w": s(E), "b": s(E)},
+            "layers": {
+                "ln1": {"w": s(L, E), "b": s(L, E)},
+                "ln2": {"w": s(L, E), "b": s(L, E)},
+                "q_proj": {"w": s(L, E, E), "b": s(L, E)},
+                "k_proj": {"w": s(L, E, E), "b": s(L, E)},
+                "v_proj": {"w": s(L, E, E), "b": s(L, E)},
+                "o_proj": {"w": s(L, E, E), "b": s(L, E)},
+                "fc1": {"w": s(L, E, I), "b": s(L, I)},
+                "fc2": {"w": s(L, I, E), "b": s(L, E)},
+            },
+            "adapter": {
+                "fc1": {"w": s(varch.intermediate_size, varch.projector_input_dim)},
+                "fc2": {"w": s(varch.projector_input_dim, varch.projector_output_dim)},
+            },
+        },
+        "projector": {"w": s(varch.vision_output_dim, varch.text_hidden)},
+    }

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.mimo_v2 import modeling_mimo_v2  # noqa: F401

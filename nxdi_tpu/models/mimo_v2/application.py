@@ -1,0 +1,73 @@
+"""MiMo-V2 application — dual-type KV cache (full + sliding-window stacks).
+
+Reference: NeuronMiMoV2ForCausalLM (models/mimo_v2/modeling_mimo_v2.py:1265);
+the reference sizes one cache at the max kv-head count across types, here
+each type owns a correctly-shaped stack."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
+from nxdi_tpu.models.mimo_v2 import modeling_mimo_v2 as mv
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+
+class MiMoV2Application(TpuModelForCausalLM):
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("model_family", mv)
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        for flag, why in (
+            (tc.async_mode, "async (device-resident) decode"),
+            (tc.is_block_kv_layout, "paged KV layout"),
+            (tc.is_continuous_batching, "continuous batching"),
+            (tc.lora_config is not None, "LoRA serving"),
+            (tc.speculation_length > 0 or tc.enable_fused_speculation or tc.is_medusa,
+             "speculative decoding"),
+            (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
+            (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
+        ):
+            if flag:
+                raise NotImplementedError(f"mimo_v2 does not support {why} yet")
+
+    def _swa_cache_struct(self):
+        arch = mv.build_arch(self.config)
+        tc = self.tpu_config
+        B = tc.kv_cache_batch_size + tc.kv_cache_padding_size
+        spec = arch.swa.kv_cache_spec(
+            B, tc.seq_len,
+            quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
+        )
+        return {
+            "k_swa": jax.ShapeDtypeStruct(spec.shape, spec.store_dtype),
+            "v_swa": jax.ShapeDtypeStruct(spec.shape_v, spec.store_dtype),
+        }
+
+    def _cache_struct(self):
+        struct = super()._cache_struct()
+        struct.update(self._swa_cache_struct())
+        return struct
+
+    def init_cache_host(self):
+        import jax.numpy as jnp
+
+        cache = super().init_cache_host()
+        for k, s in self._swa_cache_struct().items():
+            cache[k] = jnp.zeros(s.shape, s.dtype)
+        return cache
+
+    def cache_partition_specs(self):
+        specs = dict(kv_cache_partition_spec(self.tpu_config))
+        specs["k_swa"] = specs["k"]
+        specs["v_swa"] = specs["v"]
+        return specs
+
+    def enable_models(self) -> None:
+        super().enable_models()
+        for w in self.models.values():
+            w.forward_fn = mv.causal_lm_forward
+            w.forward_kwargs.pop("output_all_logits", None)
+            w.forward_kwargs.pop("tensor_capture", None)
+            w.forward_kwargs.pop("return_next_inputs", None)

@@ -1,0 +1,520 @@
+"""MiMo-V2-Flash — hybrid full/sliding-window MoE decoder with asymmetric
+q/k vs v head widths (the reference's second published-benchmark model).
+
+Reference: models/mimo_v2/modeling_mimo_v2.py (1975 LoC). Architectural
+pieces and how they land here:
+  - hybrid_layer_pattern: per-layer full vs sliding-window attention with
+    INDEPENDENT head counts, head dims, and rope theta per type (:276) —
+    expressed as two DecoderArch variants walked in depth-ordered segments,
+    each type owning its own layer-stacked KV cache.
+  - asymmetric q/k head_dim (192) vs v head_dim (128) (:324) —
+    DecoderArch.v_head_dim; the cache stores v at its own width.
+  - partial rotary (partial_rotary_factor, even-rounded) per type ->
+    DecoderArch.rotary_dim.
+  - moe_layer_freq: per-layer MoE or dense MLP (:888) — segments also split
+    on the ff-type boundary; sigmoid router, renormalized top-k.
+
+HF weight layout: llama-style attention; router ``mlp.gate``; experts
+``mlp.experts.{i}.gate/up/down_proj``; dense layers ``mlp.gate/up/down_proj``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Tuple
+
+import jax
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, moe_parallel_fields
+from nxdi_tpu.ops.rope import inv_freq_from_hf_config
+from nxdi_tpu.parallel import gqa
+
+
+class MiMoV2InferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = [
+        "hidden_size", "num_hidden_layers", "num_attention_heads",
+        "num_key_value_heads", "head_dim", "v_head_dim", "vocab_size",
+        "hybrid_layer_pattern", "moe_layer_freq", "n_routed_experts",
+        "num_experts_per_tok", "moe_intermediate_size", "partial_rotary_factor",
+        "sliding_window", "swa_head_dim", "swa_v_head_dim",
+        "swa_num_attention_heads", "swa_num_key_value_heads", "swa_rope_theta",
+        "rope_theta",
+    ]
+
+    def add_derived_config(self):
+        if not hasattr(self, "rms_norm_eps"):
+            self.rms_norm_eps = getattr(self, "layernorm_epsilon", 1e-6)
+        if not hasattr(self, "intermediate_size"):
+            # dense layers use the plain intermediate size; experts use
+            # moe_intermediate_size
+            self.intermediate_size = getattr(
+                self, "dense_intermediate_size", self.moe_intermediate_size
+            )
+        super().add_derived_config()
+
+
+def _rope_dim(head_dim: int, factor: float) -> int:
+    rd = int(head_dim * factor)
+    return rd - (rd % 2)
+
+
+@dataclass(frozen=True)
+class MiMoV2Arch:
+    """Two per-type decoder arches + the depth-ordered segment walk.
+
+    Each schedule entry: (attn_type, type_lo, type_hi, seg_idx) — half-open
+    type-local layer range into that type's stacked params/cache, and the
+    index of the stacked params segment in ``params["segments"]``."""
+
+    full: DecoderArch
+    swa: DecoderArch
+    schedule: Tuple[Tuple[str, int, int, int], ...]
+    swa_theta: float
+
+    # the app sizes the FULL-type cache through the usual path
+    def kv_cache_spec(self, batch_size, max_len, quant_dtype=None):
+        return self.full.kv_cache_spec(batch_size, max_len, quant_dtype=quant_dtype)
+
+    @property
+    def num_layers(self):
+        return self.full.num_layers + self.swa.num_layers
+
+    def __getattr__(self, name):
+        # the runtime reads generic decoder attrs (vocab, dtype, sampler
+        # wiring) — proxy them to the full-attention arch
+        return getattr(object.__getattribute__(self, "full"), name)
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.n_routed_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.moe_intermediate_size,
+        hidden_act=getattr(config, "hidden_act", "silu"),
+        norm_topk_prob=bool(getattr(config, "norm_topk_prob", True)),
+        sigmoid_routing=str(getattr(config, "scoring_func", "sigmoid")) == "sigmoid",
+        **moe_parallel_fields(config.tpu_config, config.n_routed_experts),
+    )
+
+
+def _layer_types(config) -> List[str]:
+    return ["swa" if p == 1 else "full" for p in config.hybrid_layer_pattern]
+
+
+def _layer_moe(config) -> List[bool]:
+    return [bool(f) for f in config.moe_layer_freq]
+
+
+def build_arch(config: InferenceConfig, **overrides) -> MiMoV2Arch:
+    tp = config.tpu_config.tp_degree
+    prf = float(config.partial_rotary_factor)
+    types = _layer_types(config)
+    moe = _moe_arch(config)
+
+    def type_arch(kind: str) -> DecoderArch:
+        if kind == "swa":
+            heads, kv = config.swa_num_attention_heads, config.swa_num_key_value_heads
+            hd, vd = config.swa_head_dim, config.swa_v_head_dim
+            window = config.sliding_window
+        else:
+            heads, kv = config.num_attention_heads, config.num_key_value_heads
+            hd, vd = config.head_dim, config.v_head_dim
+            window = None
+        plan = gqa.plan_gqa_sharding(tp, heads, kv)
+        return dense.build_arch(
+            config,
+            num_layers=types.count(kind),
+            num_attention_heads=plan.target_heads,
+            num_kv_heads=plan.target_kv,
+            head_dim=hd,
+            v_head_dim=None if vd == hd else vd,
+            sliding_window=window,
+            rotary_dim=(lambda rd: rd if rd < hd else None)(_rope_dim(hd, prf)),
+            moe=moe,
+            **overrides,
+        )
+
+    # depth walk, splitting segments on (type, ff-kind) boundaries
+    uses_moe = _layer_moe(config)
+    schedule = []
+    counters = {"full": 0, "swa": 0}
+    seg_idx = -1
+    prev = None
+    for i, kind in enumerate(types):
+        key = (kind, uses_moe[i])
+        lo = counters[kind]
+        if key == prev:
+            t, a, b, s = schedule[-1]
+            schedule[-1] = (t, a, b + 1, s)
+        else:
+            seg_idx += 1
+            schedule.append((kind, lo, lo + 1, seg_idx))
+            prev = key
+        counters[kind] += 1
+    return MiMoV2Arch(
+        full=type_arch("full"),
+        swa=type_arch("swa"),
+        schedule=tuple(schedule),
+        swa_theta=float(getattr(config, "swa_rope_theta", 10000.0)),
+    )
+
+
+def build_inv_freq(config: InferenceConfig) -> Dict[str, np.ndarray]:
+    prf = float(config.partial_rotary_factor)
+    return {
+        "full": inv_freq_from_hf_config(
+            _rope_dim(config.head_dim, prf), config.rope_theta, None
+        ),
+        "swa": inv_freq_from_hf_config(
+            _rope_dim(config.swa_head_dim, prf),
+            getattr(config, "swa_rope_theta", 10000.0),
+            None,
+        ),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Forward — segment walk over two attention types
+# ---------------------------------------------------------------------------
+
+
+def causal_lm_forward(
+    arch: MiMoV2Arch,
+    inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window=None,
+    policy=None,
+    layout=None,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+):
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+    from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT
+    from nxdi_tpu.models.base import constrain, run_decoder_layers
+    from nxdi_tpu.ops import sampling as sampling_ops
+    from nxdi_tpu.ops.norms import rms_norm
+    from nxdi_tpu.ops.rope import rope_cos_sin
+    from nxdi_tpu.parallel.policy import DEFAULT_POLICY
+
+    policy = policy or DEFAULT_POLICY
+    layout = layout or DEFAULT_KV_LAYOUT
+    t = arch.full
+    compute_dtype = to_jax_dtype(t.dtype)
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    B = input_ids.shape[0]
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    hidden = constrain(hidden, policy.hidden)
+    cos_full, sin_full = rope_cos_sin(position_ids, np.asarray(inv_freq["full"]))
+    cos_swa, sin_swa = rope_cos_sin(position_ids, np.asarray(inv_freq["swa"]))
+
+    caches = {
+        "full": (cache["k"], cache["v"]),
+        "swa": (cache["k_swa"], cache["v_swa"]),
+    }
+    seg_new = {"full": {}, "swa": {}}  # type -> {lo: (k, v)}
+    for kind, lo, hi, seg_idx in arch.schedule:
+        ta = arch.full if kind == "full" else arch.swa
+        ck, cv = caches[kind]
+        k_sl = jax.lax.slice_in_dim(ck, lo, hi, axis=0)
+        v_sl = jax.lax.slice_in_dim(cv, lo, hi, axis=0)
+        spec = ta.kv_cache_spec(ck.shape[1], ck.shape[3])
+        cs = (cos_full, sin_full) if kind == "full" else (cos_swa, sin_swa)
+        hidden, seg_cache = run_decoder_layers(
+            ta, params["segments"][seg_idx], hidden, cs[0], cs[1],
+            {"k": k_sl, "v": v_sl}, position_ids, spec, attend_to_cache,
+            kv_window=kv_window, policy=policy, layout=layout,
+        )
+        seg_new[kind][lo] = seg_cache
+
+    def rebuild(kind):
+        parts = [seg_new[kind][lo] for lo in sorted(seg_new[kind])]
+        if not parts:
+            z = caches[kind]
+            return z[0], z[1]
+        ks = [p["k"] for p in parts]
+        vs = [p["v"] for p in parts]
+        cat = lambda xs: xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0)  # noqa: E731
+        return cat(ks), cat(vs)
+
+    new_cache = {}
+    new_cache["k"], new_cache["v"] = rebuild("full")
+    new_cache["k_swa"], new_cache["v_swa"] = rebuild("swa")
+
+    hidden = rms_norm(hidden, params["norm"], t.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
+    if gather_last_token:
+        idx = batch["last_token_index"][:, None, None]
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
+        )
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    logits = constrain(logits, policy.logits)
+    logits = sampling_ops.mask_padded_logits(logits, t.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if on_device_sampling:
+        outputs["tokens"] = sampling_ops.sample(
+            logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )[:, None]
+    if output_logits or not on_device_sampling:
+        outputs["logits"] = logits
+    return outputs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Conversion / specs / structs
+# ---------------------------------------------------------------------------
+
+
+def _convert_layer(state_dict, config, arch: MiMoV2Arch, i: int, kind: str, use_moe: bool):
+    ta = arch.full if kind == "full" else arch.swa
+    tp = config.tpu_config.tp_degree
+    if kind == "swa":
+        plan = gqa.plan_gqa_sharding(
+            tp, config.swa_num_attention_heads, config.swa_num_key_value_heads
+        )
+    else:
+        plan = gqa.plan_gqa_sharding(
+            tp, config.num_attention_heads, config.num_key_value_heads
+        )
+    D = ta.head_dim
+    Dv = ta.v_head_dim or D
+    dt = dense.np_dtype(ta.dtype)
+    cast = lambda x: np.asarray(x, dt)  # noqa: E731
+    pre = f"model.layers.{i}."
+
+    def get(name):
+        for k in (pre + name, pre.replace("model.", "", 1) + name):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(pre + name)
+
+    layer = {
+        "input_layernorm": cast(get("input_layernorm.weight")),
+        "post_attention_layernorm": cast(get("post_attention_layernorm.weight")),
+        "attn": {
+            "q_proj": {"w": cast(gqa.convert_q(get("self_attn.q_proj.weight"), D, plan).T)},
+            "k_proj": {"w": cast(gqa.convert_kv(get("self_attn.k_proj.weight"), D, plan).T)},
+            "v_proj": {"w": cast(gqa.convert_kv(get("self_attn.v_proj.weight"), Dv, plan).T)},
+            "o_proj": {"w": cast(gqa.convert_o(get("self_attn.o_proj.weight"), Dv, plan).T)},
+        },
+    }
+    if use_moe:
+        layer["moe"] = convert_hf_experts(
+            get,
+            cast,
+            arch.full.moe.num_experts,
+            "mlp.gate.weight",
+            lambda j, proj: f"mlp.experts.{j}.{proj}_proj.weight",
+        )
+    else:
+        layer["mlp"] = {
+            "gate_proj": {"w": cast(get("mlp.gate_proj.weight").T)},
+            "up_proj": {"w": cast(get("mlp.up_proj.weight").T)},
+            "down_proj": {"w": cast(get("mlp.down_proj.weight").T)},
+        }
+    return layer
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    types = _layer_types(config)
+    uses_moe = _layer_moe(config)
+    dt = dense.np_dtype(arch.full.dtype)
+
+    # group depth-contiguous layers into the schedule's segments
+    segments: List[Any] = []
+    bucket: List[Any] = []
+    prev = None
+    for i, kind in enumerate(types):
+        key = (kind, uses_moe[i])
+        if prev is not None and key != prev:
+            segments.append(dense.tree_stack(bucket))
+            bucket = []
+        bucket.append(_convert_layer(state_dict, config, arch, i, kind, uses_moe[i]))
+        prev = key
+    segments.append(dense.tree_stack(bucket))
+    assert len(segments) == len({s for (_, _, _, s) in arch.schedule})
+
+    def top(name):
+        for k in (f"model.{name}", name):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(name)
+
+    embed = np.asarray(top("embed_tokens.weight"))
+    if arch.full.vocab_pad:
+        embed = np.concatenate(
+            [embed, np.zeros((arch.full.vocab_pad, embed.shape[1]), embed.dtype)]
+        )
+    params: Dict[str, Any] = {
+        "embed_tokens": np.asarray(embed, dt),
+        "segments": segments,
+        "norm": np.asarray(top("norm.weight"), dt),
+    }
+    if not arch.full.tie_word_embeddings:
+        head = np.asarray(state_dict["lm_head.weight"])
+        if arch.full.vocab_pad:
+            head = np.concatenate(
+                [head, np.zeros((arch.full.vocab_pad, head.shape[1]), head.dtype)]
+            )
+        params["lm_head"] = np.asarray(head.T, dt)
+    return params
+
+
+def _map_segments(config, per_layer_fn, top_fn):
+    """Build the segments-list structure by mapping a per-layer constructor."""
+    arch = build_arch(config)
+    types = _layer_types(config)
+    uses_moe = _layer_moe(config)
+    segs, bucket, prev = [], [], None
+    for i, kind in enumerate(types):
+        key = (kind, uses_moe[i])
+        if prev is not None and key != prev:
+            segs.append(bucket)
+            bucket = []
+        bucket.append(per_layer_fn(arch, kind, uses_moe[i]))
+        prev = key
+    segs.append(bucket)
+    return top_fn(arch, segs)
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.models.base import attention_param_specs, mlp_param_specs
+    from nxdi_tpu.ops.moe import expert_parallel_specs
+    from nxdi_tpu.parallel.layers import REPLICATED, VOCAB_PARALLEL
+
+    def per_layer(arch, kind, use_moe):
+        ta = arch.full if kind == "full" else arch.swa
+        layer = {
+            "input_layernorm": REPLICATED,
+            "post_attention_layernorm": REPLICATED,
+            "attn": attention_param_specs(ta),
+        }
+        if use_moe:
+            layer["moe"] = expert_parallel_specs(ta.moe)
+        else:
+            layer["mlp"] = mlp_param_specs(ta)
+        return layer
+
+    def top(arch, segs):
+        def stack(tree):
+            return jax.tree_util.tree_map(
+                lambda sp: P(*((None,) + tuple(sp))),
+                tree,
+                is_leaf=lambda x: isinstance(x, P),
+            )
+
+        specs = {
+            "embed_tokens": VOCAB_PARALLEL,
+            "segments": [stack(s[0]) for s in segs],
+            "norm": REPLICATED,
+        }
+        if not arch.full.tie_word_embeddings:
+            from nxdi_tpu.parallel.layers import COLUMN_PARALLEL
+
+            specs["lm_head"] = COLUMN_PARALLEL
+        return specs
+
+    return _map_segments(config, per_layer, top)
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    types = _layer_types(config)
+    uses_moe = _layer_moe(config)
+    dt = dense.np_dtype(arch.full.dtype)
+    H = arch.full.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    def layer_struct(kind, use_moe, n):
+        ta = arch.full if kind == "full" else arch.swa
+        D, Dv = ta.head_dim, ta.v_head_dim or ta.head_dim
+        NH, NKV = ta.num_attention_heads, ta.num_kv_heads
+        layer = {
+            "input_layernorm": s(n, H),
+            "post_attention_layernorm": s(n, H),
+            "attn": {
+                "q_proj": {"w": s(n, H, NH * D)},
+                "k_proj": {"w": s(n, H, NKV * D)},
+                "v_proj": {"w": s(n, H, NKV * Dv)},
+                "o_proj": {"w": s(n, NH * Dv, H)},
+            },
+        }
+        if use_moe:
+            m = ta.moe
+            layer["moe"] = {
+                "router": {"w": s(n, H, m.num_experts)},
+                "experts": {
+                    "gate_proj": {"w": s(n, m.num_experts, H, m.intermediate_size)},
+                    "up_proj": {"w": s(n, m.num_experts, H, m.intermediate_size)},
+                    "down_proj": {"w": s(n, m.num_experts, m.intermediate_size, H)},
+                },
+            }
+        else:
+            I = config.intermediate_size
+            layer["mlp"] = {
+                "gate_proj": {"w": s(n, H, I)},
+                "up_proj": {"w": s(n, H, I)},
+                "down_proj": {"w": s(n, I, H)},
+            }
+        return layer
+
+    segs, run, prev = [], 0, None
+    order = []
+    for i, kind in enumerate(types):
+        key = (kind, uses_moe[i])
+        if prev is not None and key != prev:
+            order.append((prev, run))
+            run = 0
+        run += 1
+        prev = key
+    order.append((prev, run))
+    for (kind, use_moe), n in order:
+        segs.append(layer_struct(kind, use_moe, n))
+
+    V = arch.full.vocab_size
+    struct = {
+        "embed_tokens": s(V, H),
+        "segments": segs,
+        "norm": s(H),
+    }
+    if not arch.full.tie_word_embeddings:
+        struct["lm_head"] = s(H, V)
+    return struct
+
+
+class MiMoV2ForCausalLM:
+    def __new__(cls, *args, **kwargs):
+        from nxdi_tpu.models.mimo_v2.application import MiMoV2Application
+
+        return MiMoV2Application(*args, **kwargs)

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.minimax_m2 import modeling_minimax_m2  # noqa: F401

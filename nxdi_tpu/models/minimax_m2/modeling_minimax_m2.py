@@ -1,0 +1,160 @@
+"""MiniMax-M2 — 256-expert sigmoid-routed MoE with flat qk-norm and partial
+rotary (the reference's flagship published-benchmark model, BASELINE.md).
+
+Reference: models/minimax_m2/modeling_minimax_m2.py (3878 LoC) — all of its
+architectural deltas vs llama map onto existing framework switches:
+  - MoE every layer: sigmoid affinities, e_score_correction_bias added ONLY
+    for expert selection, weights renormalized from the uncorrected scores
+    (RouterTopKWithBias :56) -> MoEArch(sigmoid_routing, correction_bias,
+    norm_topk_prob).
+  - "per_layer" qk-norm: RMSNorm over the FLAT q/k projection before head
+    reshape (:260) -> DecoderArch.qk_norm_flat (GQA-padding-safe: fixed true
+    denominator for zero-padded q, plain mean for replicated k).
+  - partial rotary rotary_dim=64 of head_dim=128 (:730) ->
+    DecoderArch.rotary_dim; inv_freq built at rotary_dim.
+MTP (multi-token-prediction) weights in the checkpoint are serving-irrelevant
+and dropped, matching the reference which serves the causal trunk only.
+
+HF weight layout: llama-style attention (+ flat q_norm/k_norm vectors) and
+``block_sparse_moe`` with ``gate``, ``experts.{i}.w1/w3/w2`` (gate/up/down),
+``e_score_correction_bias``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, moe_parallel_fields
+from nxdi_tpu.ops.rope import inv_freq_from_hf_config
+from nxdi_tpu.parallel import gqa
+
+_W_NAMES = {"gate": "w1", "up": "w3", "down": "w2"}
+
+
+class MiniMaxM2InferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + [
+        "num_local_experts",
+        "num_experts_per_tok",
+        "rotary_dim",
+        "use_qk_norm",
+    ]
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.num_local_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.intermediate_size,
+        hidden_act=getattr(config, "hidden_act", "silu"),
+        norm_topk_prob=True,
+        sigmoid_routing=True,
+        correction_bias=True,
+        **moe_parallel_fields(config.tpu_config, config.num_local_experts),
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    rd = int(getattr(config, "rotary_dim", 0) or 0)
+    kwargs: Dict[str, Any] = {"moe": _moe_arch(config)}
+    if rd and rd < dense.head_dim_of(config):
+        kwargs["rotary_dim"] = rd
+    if getattr(config, "use_qk_norm", False):
+        kwargs["qk_norm_flat"] = True
+        kwargs["qk_norm_flat_qdim"] = (
+            config.num_attention_heads * dense.head_dim_of(config)
+        )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    rd = int(getattr(config, "rotary_dim", 0) or 0) or dense.head_dim_of(config)
+    return inv_freq_from_hf_config(
+        rd,
+        getattr(config, "rope_theta", 10000.0),
+        None,
+        max_position_embeddings=getattr(config, "max_position_embeddings", 4096),
+    )
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    # drop MTP module weights (serving uses the causal trunk only)
+    state_dict = {k: v for k, v in state_dict.items() if ".mtp" not in k and "mtp_" not in k}
+
+    def ff(get, has, cast, pre):
+        moe_params = convert_hf_experts(
+            get,
+            cast,
+            arch.moe.num_experts,
+            pre + "block_sparse_moe.gate.weight",
+            lambda j, proj: f"{pre}block_sparse_moe.experts.{j}.{_W_NAMES[proj]}.weight",
+        )
+        moe_params["router"]["e_bias"] = np.asarray(
+            get(pre + "block_sparse_moe.e_score_correction_bias"), np.float32
+        )
+        return "moe", moe_params
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+
+    if arch.qk_norm_flat:
+        # flat norm weights follow the projections' GQA padding layout:
+        # q interleaved zero-pad, k per-head replication (vector variant of
+        # the bias conversion)
+        plan = dense.gqa_plan(config)
+        D = arch.head_dim
+        dt = dense.np_dtype(arch.dtype)
+
+        def grab(i, side, conv):
+            w = state_dict[f"model.layers.{i}.self_attn.{side}.weight"]
+            return np.asarray(conv(w[:, None], D, plan)[:, 0], dt)
+
+        params["layers"]["attn"]["q_norm"] = np.stack(
+            [grab(i, "q_norm", gqa.convert_q) for i in range(arch.num_layers)]
+        )
+        params["layers"]["attn"]["k_norm"] = np.stack(
+            [grab(i, "k_norm", gqa.convert_kv) for i in range(arch.num_layers)]
+        )
+    return params
+
+
+def _add_flat_norm_entries(arch: DecoderArch, specs_or_struct, kind: str):
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.parallel.mesh import AXIS_MP
+
+    attn = specs_or_struct["layers"]["attn"]
+    if kind == "spec":
+        # weights multiply the tp-sharded flat projections elementwise
+        attn["q_norm"] = P(None, AXIS_MP)
+        attn["k_norm"] = P(None, AXIS_MP)
+    else:
+        dt = dense.np_dtype(arch.dtype)
+        L, D = arch.num_layers, arch.head_dim
+        attn["q_norm"] = jax.ShapeDtypeStruct((L, arch.num_attention_heads * D), dt)
+        attn["k_norm"] = jax.ShapeDtypeStruct((L, arch.num_kv_heads * D), dt)
+    return specs_or_struct
+
+
+def param_specs(config: InferenceConfig):
+    arch = build_arch(config)
+    specs = dense.param_specs_for(arch)
+    if arch.qk_norm_flat:
+        specs = _add_flat_norm_entries(arch, specs, "spec")
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    if arch.qk_norm_flat:
+        struct = _add_flat_norm_entries(arch, struct, "struct")
+    return struct

@@ -44,6 +44,8 @@ class MllamaApplication(TpuModelForCausalLM):
         # last prompt cross-mask row per batch line (HF generation repeats it
         # for every generated token, modeling_mllama.py:1732)
         self._last_xmask: Optional[np.ndarray] = None
+        # static across the app's life; avoid rebuilding per decode dispatch
+        self._arch = mm.build_arch(self.config)
 
     # -- params --
     def build_params(self):
@@ -154,8 +156,7 @@ class MllamaApplication(TpuModelForCausalLM):
         cross_attention_mask=None,
         **kwargs,
     ):
-        arch = mm.build_arch(self.config)
-        MT = arch.max_tiles_total
+        MT = self._arch.max_tiles_total
         B, S = np.asarray(input_ids).shape
         is_prefill = S > 1
         if is_prefill:

@@ -608,12 +608,74 @@ def convert_hf_state_dict(
 
 
 def param_specs(config: InferenceConfig):
-    """Replicated for now: the heterogeneous stack's TP layout (head sharding
-    per layer type) is a follow-up; correctness and the state machinery come
-    first (reference asserts similar head/tp divisibility constraints)."""
+    """TP layout over the heterogeneous stack. Every sharded dim is
+    HEAD-BLOCK aligned so a plain dim shard keeps whole heads per rank:
+    ``in_proj_qkvz``/``in_proj_ba`` pack per-K-HEAD blocks (the reshape in
+    :func:`_split_qkvz_ba`), so their output dims shard when tp divides
+    num_k_heads; the gated-attention q packs (head, 2, D) blocks. Dims that
+    don't divide stay replicated (GSPMD reshards activations around them —
+    notably the causal conv, whose channel layout is section- not
+    head-contiguous and is left replicated on purpose)."""
     from jax.sharding import PartitionSpec as P
 
-    return jax.tree_util.tree_map(lambda _: P(), param_shape_struct(config))
+    from nxdi_tpu.parallel.mesh import AXIS_MP
+
+    arch = build_arch(config)
+    tp = config.tpu_config.tp_degree
+    struct = param_shape_struct(config)
+    specs = jax.tree_util.tree_map(lambda _: P(), struct)
+
+    def col(ok):  # shard output dim
+        return P(None, AXIS_MP) if ok else P()
+
+    def row(ok):  # shard input dim
+        return P(AXIS_MP, None) if ok else P()
+
+    gk_ok = tp > 1 and arch.num_k_heads % tp == 0
+    gv_ok = tp > 1 and arch.num_v_heads % tp == 0
+    h_ok = tp > 1 and arch.num_attention_heads % tp == 0
+    kv_ok = tp > 1 and arch.num_kv_heads % tp == 0
+    i_ok = tp > 1 and arch.intermediate_size % tp == 0
+
+    if tp > 1:
+        specs["embed_tokens"] = P(AXIS_MP, None)  # vocab is tp-padded
+        if "lm_head" in specs:
+            specs["lm_head"] = P(None, AXIS_MP)
+    for li, lt in enumerate(arch.layer_types):
+        lp = specs["layers"][li]
+        if lt == "linear_attention":
+            la = lp["linear_attn"]
+            la["in_proj_qkvz"] = col(gk_ok and gv_ok)
+            la["in_proj_ba"] = col(gk_ok and gv_ok)
+            la["out_proj"] = row(gv_ok)
+        else:
+            sa = lp["self_attn"]
+            sa["q_proj"] = col(h_ok)
+            sa["k_proj"] = col(kv_ok)
+            sa["v_proj"] = col(kv_ok)
+            sa["o_proj"] = row(h_ok)
+        mlp = lp["mlp"]
+        if arch.num_experts:
+            e_ok = tp > 1 and arch.num_experts % tp == 0
+            mi_ok = tp > 1 and arch.moe_intermediate_size % tp == 0
+            si_ok = tp > 1 and arch.shared_expert_intermediate_size % tp == 0
+            ex = mlp["experts"]
+            if e_ok:
+                for name in ("gate_proj", "up_proj", "down_proj"):
+                    ex[name]["w"] = P(AXIS_MP, None, None)
+            elif mi_ok:
+                ex["gate_proj"]["w"] = P(None, None, AXIS_MP)
+                ex["up_proj"]["w"] = P(None, None, AXIS_MP)
+                ex["down_proj"]["w"] = P(None, AXIS_MP, None)
+            sh = mlp["shared_expert"]
+            sh["gate_proj"]["w"] = col(si_ok)
+            sh["up_proj"]["w"] = col(si_ok)
+            sh["down_proj"]["w"] = row(si_ok)
+        else:
+            mlp["gate_proj"] = col(i_ok)
+            mlp["up_proj"] = col(i_ok)
+            mlp["down_proj"] = row(i_ok)
+    return specs
 
 
 def param_shape_struct(config: InferenceConfig):
@@ -745,7 +807,18 @@ class Qwen3NextForCausalLM(TpuModelForCausalLM):
     def cache_partition_specs(self):
         from jax.sharding import PartitionSpec as P
 
-        return {k: P() for k in ("k", "v", "conv", "rec")}
+        from nxdi_tpu.parallel.mesh import AXIS_MP
+
+        arch = self._arch()
+        tp = self.tpu_config.tp_degree
+        kv = AXIS_MP if (tp > 1 and arch.num_kv_heads % tp == 0) else None
+        gv = AXIS_MP if (tp > 1 and arch.num_v_heads % tp == 0) else None
+        return {
+            "k": P(None, None, kv, None, None),
+            "v": P(None, None, kv, None, None),
+            "conv": P(),  # section-contiguous channels: stays replicated
+            "rec": P(None, None, gv, None, None),
+        }
 
     def init_cache_host(self):
         tc = self.tpu_config

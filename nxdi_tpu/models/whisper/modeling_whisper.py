@@ -15,8 +15,10 @@ TPU-native mapping:
   - the decoder step is a fixed-shape jitted program with the self-KV cache
     donated, greedy-sampled on device; one dispatch per token.
 
-Parameters are replicated — whisper tops out ~1.5B (large-v3), well within a
-single chip; TP sharding of the encoder/decoder is a later optimization.
+TP: attention projections shard by heads (column q/k/v, row out) and the
+FFNs on their intermediate dim whenever tp divides them (see
+:func:`param_specs`); GSPMD inserts the collectives. Dims that don't divide
+stay replicated, so any tp degree is safe.
 """
 
 from __future__ import annotations
@@ -320,6 +322,77 @@ def convert_hf_state_dict(sd: Dict[str, np.ndarray], config: InferenceConfig):
 # Application (reference: separate encoder/decoder apps, modeling_whisper.py:571)
 # ---------------------------------------------------------------------------
 
+def param_specs(config: InferenceConfig):
+    """PartitionSpec tree matching convert_hf_state_dict: head-sharded
+    attention + intermediate-sharded FFN when tp divides (reference analog:
+    the TP ColumnParallel/RowParallel wiring of the encoder/decoder apps)."""
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.parallel.mesh import AXIS_MP
+
+    arch = build_arch(config)
+    tp = config.tpu_config.tp_degree
+
+    def lin_col(ok, bias=True):
+        out = {"w": P(None, None, AXIS_MP) if ok else P(None, None, None)}
+        if bias:
+            out["b"] = P(None, AXIS_MP) if ok else P(None, None)
+        return out
+
+    def lin_row(ok, bias=True):
+        out = {"w": P(None, AXIS_MP, None) if ok else P(None, None, None)}
+        if bias:
+            out["b"] = P(None, None)
+        return out
+
+    def ln():
+        return {"w": P(None, None), "b": P(None, None)}
+
+    def attn(heads):
+        ok = tp > 1 and heads % tp == 0
+        return {
+            "q_proj": lin_col(ok),
+            "k_proj": lin_col(ok, bias=False),
+            "v_proj": lin_col(ok),
+            "out_proj": lin_row(ok),
+        }
+
+    def layers(heads, ffn, cross=False):
+        ok_f = tp > 1 and ffn % tp == 0
+        lp = {
+            "self_attn": attn(heads),
+            "self_attn_layer_norm": ln(),
+            "fc1": lin_col(ok_f),
+            "fc2": lin_row(ok_f),
+            "final_layer_norm": ln(),
+        }
+        if cross:
+            lp["encoder_attn"] = attn(heads)
+            lp["encoder_attn_layer_norm"] = ln()
+        return lp
+
+    rep = P()
+    rep2 = {"w": rep, "b": rep}
+    return {
+        "encoder": {
+            "conv1": rep2,
+            "conv2": rep2,
+            "embed_positions": rep,
+            "layers": layers(arch.encoder_heads, arch.encoder_ffn),
+            "layer_norm": rep2,
+        },
+        "decoder": {
+            "embed_tokens": rep,
+            "embed_positions": rep,
+            "layers": layers(arch.decoder_heads, arch.decoder_ffn, cross=True),
+            "layer_norm": rep2,
+        },
+        "proj_out": P(None, AXIS_MP)
+        if tp > 1 and config.vocab_size % tp == 0
+        else rep,
+    }
+
+
 class WhisperForConditionalGeneration:
     """Greedy speech-to-text: encode once, then one decoder dispatch per token."""
 
@@ -328,6 +401,7 @@ class WhisperForConditionalGeneration:
         self.config = config
         self.tpu_config = config.tpu_config
         self.arch = build_arch(config)
+        self.mesh = None
         self.params = None
         self.is_loaded = False
         self._programs: Dict[Any, Any] = {}
@@ -338,13 +412,19 @@ class WhisperForConditionalGeneration:
         return ckpt.load_state_dict(self.model_path)
 
     def load(self, compiled_model_path: Optional[str] = None) -> None:
+        from nxdi_tpu.parallel.layers import shard_pytree
+        from nxdi_tpu.parallel.mesh import mesh_from_config
+
+        self.mesh = mesh_from_config(self.tpu_config)
+        jax.set_mesh(self.mesh)
         params_host = convert_hf_state_dict(self.get_state_dict(), self.config)
-        self.params = jax.tree_util.tree_map(jnp.asarray, params_host)
+        self.params = shard_pytree(params_host, param_specs(self.config), self.mesh)
         self.is_loaded = True
 
     def _program(self, key, fn):
         if key not in self._programs:
-            self._programs[key] = jax.jit(fn)
+            with jax.set_mesh(self.mesh):
+                self._programs[key] = jax.jit(fn)
         return self._programs[key]
 
     def encode(self, input_features: np.ndarray):

@@ -271,9 +271,14 @@ class ApplicationBase:
                 dtype=arch.dtype,
                 quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
             )
+        max_len = self.tpu_config.seq_len
+        if getattr(tc, "window_sized_kv", False):
+            # ring layout: W slots per layer instead of the full budget
+            # (reference: window-sized cache shapes kv_cache_manager.py:195)
+            max_len = min(max_len, tc.sliding_window)
         return arch.kv_cache_spec(
             self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size,
-            self.tpu_config.seq_len,
+            max_len,
             quant_dtype=(
                 self.tpu_config.kv_quant_config.dtype
                 if self.tpu_config.kv_quant_config

@@ -41,6 +41,16 @@ def kv_layout_from_config(tc):
         scales = {"k_scale": kvq.k_scale, "v_scale": kvq.v_scale}
     if tc.is_block_kv_layout:
         return BlockKVLayout(block_size=tc.pa_block_size, **scales)
+    if getattr(tc, "window_sized_kv", False):
+        from nxdi_tpu.kvcache.kv_cache import WindowKVLayout
+
+        if scales:
+            raise NotImplementedError(
+                "scaled fp8 KV is not wired into the window ring layout yet"
+            )
+        return WindowKVLayout(
+            window=tc.sliding_window, route_by_seq_id=tc.is_continuous_batching
+        )
     if tc.is_continuous_batching:
         return ContiguousKVLayout(route_by_seq_id=True, **scales)
     return ContiguousKVLayout(**scales)
@@ -181,6 +191,11 @@ class ModelWrapper:
         jitted = jax.jit(
             fn,
             in_shardings=(param_shardings, cache_shardings, batch_shardings),
+            # pin the cache OUTPUT to the input layout: donation requires the
+            # round-trip sharding to be stable, and GSPMD would otherwise pick
+            # whatever layout the last touching op produced (seen with the
+            # qwen3_next conv state, whose channel dim must stay replicated)
+            out_shardings=(None, cache_shardings),
             donate_argnums=(1,),
         )
         return jitted

@@ -226,6 +226,20 @@ class EagleSpecCausalLM(FusedSpecCausalLM):
             if self.is_eagle3
             else None
         )
+        # EAGLE token-tree speculation (reference: modules/eagle/token_tree.py)
+        self.tree = None
+        ttc = getattr(tc, "token_tree_config", None)
+        if ttc:
+            from nxdi_tpu.speculation.token_tree import TokenTree
+
+            choices = ttc["choices"] if isinstance(ttc, dict) else ttc
+            self.tree = TokenTree.from_choices(choices)
+            if tc.speculation_length != self.tree.max_depth:
+                raise ValueError(
+                    f"speculation_length ({tc.speculation_length}) must equal "
+                    f"the token tree depth ({self.tree.max_depth}) — each tree "
+                    "window retires at most depth+1 tokens"
+                )
 
     def build_params(self) -> Dict[str, Any]:
         if self.tpu_config.quantized and self.tpu_config.quantized_checkpoints_path:
@@ -298,7 +312,11 @@ class EagleSpecCausalLM(FusedSpecCausalLM):
         return EagleSpecWrapper
 
     def _spec_wrapper_kwargs(self) -> Dict[str, Any]:
-        return dict(is_eagle3=self.is_eagle3, aux_hidden_indices=self.aux_hidden_indices)
+        return dict(
+            is_eagle3=self.is_eagle3,
+            aux_hidden_indices=self.aux_hidden_indices,
+            tree=self.tree,
+        )
 
 
 class MedusaCausalLM(TpuModelForCausalLM):

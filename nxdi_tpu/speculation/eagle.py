@@ -268,14 +268,214 @@ def eagle_token_gen(
     }
 
 
+def eagle_tree_token_gen(
+    draft_arch,
+    target_arch,
+    draft_inv_freq,
+    target_inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    *,
+    tree,
+    kv_window: int,
+    is_eagle3: bool = False,
+    aux_hidden_indices: Optional[Tuple[int, ...]] = None,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """One EAGLE TREE window (reference: modules/eagle/token_tree.py:8 + the
+    eagle tree-decoding branch model_base.py:2148).
+
+    The draft expands the static tree depth by depth: every depth-d node's
+    token is the ``child``-th highest logit of its PARENT's draft row, and
+    each draft pass runs ALL nodes of a depth at once with an explicit
+    ancestor mask — the draft's own KV holds the tree at distinct slots
+    exactly like the target verify. One target pass scores the whole tree;
+    best-path acceptance and KV compaction reuse the medusa tree machinery
+    (speculation/token_tree.py), and BOTH caches (target and draft) get the
+    accepted path gathered back to contiguous slots."""
+    import numpy as np
+
+    from nxdi_tpu.speculation.token_tree import (
+        best_path_acceptance,
+        gather_tree_candidates,  # noqa: F401 (doc anchor: candidate layout)
+        tree_verify_mask,
+    )
+
+    B = batch["input_ids"].shape[0]
+    tok0 = batch["input_ids"].astype(jnp.int32)  # (B, 1)
+    pos0 = batch["position_ids"].astype(jnp.int32)  # (B, 1)
+    rows = _feature_rows(batch, B)
+    feat0 = cache["features"][rows]  # (B, H)
+    sp = batch["sampling_params"]
+    N, Dmax = tree.num_nodes, tree.max_depth
+
+    # static per-depth node groups (node order == slot order)
+    by_depth = [
+        [i for i in range(N) if tree.node_depth[i] == d] for d in range(1, Dmax + 1)
+    ]
+    anc = np.array(tree.ancestors, dtype=bool)
+
+    full_mask = tree_verify_mask(tree, pos0[:, 0], kv_window)  # (B, 1+N, W)
+
+    d_cache = cache["draft"]
+    node_tokens = [None] * N
+    node_feats = [None] * N  # draft hidden of the node's own row
+    # depth-0: the root row (last accepted token, feature from the buffer)
+    level_nodes = [-1]  # -1 denotes the root
+    level_tokens = tok0  # (B, 1)
+    level_feats = feat0[:, None, :]  # (B, 1, H)
+    for d in range(Dmax + 1):
+        n_lvl = len(level_nodes)
+        rope_pos = pos0 + d  # (B, 1) -> broadcast (B, n_lvl)
+        rope_pos = jnp.broadcast_to(rope_pos, (B, n_lvl))
+        if d == 0:
+            write_pos = jnp.broadcast_to(pos0, (B, 1))
+            mask = full_mask[:, 0:1]
+        else:
+            idxs = jnp.asarray(level_nodes, jnp.int32)[None, :]
+            write_pos = pos0 + 1 + idxs
+            mask = full_mask[:, 1 + np.asarray(level_nodes)]
+        dbatch = {
+            "input_ids": level_tokens,
+            "position_ids": rope_pos,
+            "write_positions": write_pos,
+            "attn_mask": mask,
+            "last_token_index": jnp.zeros((B,), jnp.int32),
+            "sampling_params": sp,
+            "prev_hidden": level_feats,
+        }
+        if "seq_ids" in batch:
+            dbatch["seq_ids"] = batch["seq_ids"]
+        out, d_cache = causal_lm_forward(
+            draft_arch,
+            draft_inv_freq,
+            params["draft"],
+            d_cache,
+            dbatch,
+            attend_to_cache=True,
+            kv_window=kv_window,
+            policy=policy,
+            layout=layout,
+            gather_last_token=False,
+            output_all_logits=True,
+            on_device_sampling=False,
+            output_hidden=True,
+        )
+        for li, node in enumerate(level_nodes):
+            if node >= 0:
+                node_feats[node] = out["hidden"][:, li]
+        if d == Dmax:
+            break
+        # children at depth d+1: child-th highest logit of the parent's row
+        kids = by_depth[d]
+        if not kids:
+            break
+        topk = jax.lax.top_k(out["logits"], tree.max_branch)[1]  # (B, n_lvl, K)
+        parent_rowidx = {n: i for i, n in enumerate(level_nodes)}
+        toks, feats = [], []
+        for node in kids:
+            pr = parent_rowidx[tree.node_parent[node] if d > 0 else -1]
+            tok = _draft_token(params["draft"], topk[:, pr, tree.node_child[node]])
+            node_tokens[node] = tok
+            toks.append(tok)
+            feats.append(out["hidden"][:, pr])
+        level_nodes = kids
+        level_tokens = jnp.stack(toks, axis=1)  # (B, n_kids)
+        level_feats = jnp.stack(feats, axis=1)  # (B, n_kids, H)
+
+    candidates = jnp.concatenate(
+        [tok0] + [node_tokens[i][:, None] for i in range(N)], axis=1
+    )  # (B, 1+N)
+
+    # -- target verify over the whole tree (medusa-tree layout) --
+    depth_row = jnp.asarray([0] + list(tree.node_depth), jnp.int32)[None, :]
+    tbatch = {
+        "input_ids": candidates,
+        "position_ids": pos0 + depth_row,
+        "write_positions": pos0 + jnp.arange(N + 1, dtype=jnp.int32)[None, :],
+        "attn_mask": full_mask,
+        "last_token_index": jnp.zeros((B,), jnp.int32),
+        "sampling_params": sp,
+    }
+    if "seq_ids" in batch:
+        tbatch["seq_ids"] = batch["seq_ids"]
+    t_out, t_cache = causal_lm_forward(
+        target_arch,
+        target_inv_freq,
+        params["target"],
+        cache["target"],
+        tbatch,
+        attend_to_cache=True,
+        kv_window=kv_window,
+        policy=policy,
+        layout=layout,
+        gather_last_token=False,
+        output_all_logits=True,
+        on_device_sampling=False,
+        **_target_feature_kwargs(is_eagle3, aux_hidden_indices),
+    )
+    target_tokens = jnp.argmax(t_out["logits"], axis=-1).astype(jnp.int32)
+
+    counts, best_path, emit_rows = best_path_acceptance(tree, candidates, target_tokens)
+    tree_fits = pos0[:, 0] + 1 + N <= kv_window
+    counts = jnp.where(tree_fits, counts, 1)
+    tokens_out = jnp.take_along_axis(target_tokens, emit_rows, axis=1)  # (B, 1+D)
+
+    # KV fix-up on BOTH caches: accepted path's tree slots -> contiguous
+    src = pos0 + 1 + jnp.clip(best_path, 0)  # (B, D)
+    dest = pos0 + 1 + jnp.arange(Dmax, dtype=jnp.int32)[None, :]
+    b_idx = rows[:, None]
+
+    def fixup(cache_arr):
+        def per_layer(cl):
+            KVh, Dh = cl.shape[1], cl.shape[3]
+            lines = jnp.take(cl, rows, axis=0)
+            gathered = jnp.take_along_axis(
+                lines,
+                jnp.clip(src, 0, cl.shape[2] - 1)[:, None, :, None].astype(jnp.int32)
+                * jnp.ones((1, KVh, 1, Dh), jnp.int32),
+                axis=2,
+            )
+            vals = jnp.swapaxes(gathered, 1, 2)
+            return cl.at[b_idx, :, dest].set(vals, mode="drop")
+
+        return jax.vmap(per_layer)(cache_arr)
+
+    t_cache = {"k": fixup(t_cache["k"]), "v": fixup(t_cache["v"])}
+    d_cache = {"k": fixup(d_cache["k"]), "v": fixup(d_cache["v"])}
+
+    # features buffer <- target feature at the last retired row
+    retire = jnp.clip(jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, Dmax + 1)
+    last_row = jnp.take_along_axis(emit_rows, (retire - 1)[:, None], axis=1)  # (B, 1)
+    feats_t = _project_features(
+        draft_arch, params["draft"], _target_features(is_eagle3, t_out)
+    )
+    new_feat = jnp.take_along_axis(
+        feats_t, last_row[:, :, None] * jnp.ones((1, 1, feats_t.shape[2]), jnp.int32), axis=1
+    )[:, 0]
+    feat_buf = cache["features"].at[rows].set(new_feat.astype(cache["features"].dtype))
+
+    return {"tokens": tokens_out, "counts": counts}, {
+        "draft": d_cache,
+        "target": t_cache,
+        "features": feat_buf,
+    }
+
+
 class EagleSpecWrapper(FusedSpecWrapper):
     """ModelWrapper compiling the EAGLE fused graphs (reference: the eagle
     branches of the fused_speculation_model, model_base.py:3132)."""
 
-    def __init__(self, *args, is_eagle3=False, aux_hidden_indices=None, **kwargs):
+    def __init__(self, *args, is_eagle3=False, aux_hidden_indices=None, tree=None, **kwargs):
         super().__init__(*args, **kwargs)
         self.is_eagle3 = is_eagle3
         self.aux_hidden_indices = aux_hidden_indices
+        self.tree = tree
+        if tree is not None and self.attend_to_cache:
+            # a tree window writes one KV slot per node (plus the root)
+            self.lookahead = tree.num_nodes + 1
 
     def make_forward(self, bucket: int):
         common = dict(
@@ -284,6 +484,17 @@ class EagleSpecWrapper(FusedSpecWrapper):
             policy=self.policy,
             layout=self.layout,
         )
+        if self.attend_to_cache and self.tree is not None:
+            return partial(
+                eagle_tree_token_gen,
+                self.draft_arch,
+                self.arch,
+                self.draft_inv_freq,
+                self.inv_freq,
+                tree=self.tree,
+                kv_window=bucket,
+                **common,
+            )
         if self.attend_to_cache:
             return partial(
                 eagle_token_gen,

@@ -223,3 +223,154 @@ def check_accuracy_logits(
             errors_by_index=errors_by_index,
         )
     return errors_by_index
+
+
+def _get_draft_logit_probe(app):
+    """Teacher-forced all-position-logits probe over the DRAFT model of a
+    fused-speculation app (reference: the draft-logit accuracy flow,
+    accuracy.py:1214 run_accuracy_draft_logit_test_flow — goldens there are
+    per-loop captures; the TPU equivalent validates the same draft weights
+    teacher-forced, which greedy acceptance makes behavior-defining)."""
+    cached = getattr(app, "_draft_logit_probe", None)
+    if cached is not None:
+        return cached
+
+    from nxdi_tpu.kvcache.kv_cache import init_kv_cache, kv_cache_partition_spec
+    from nxdi_tpu.parallel.layers import shard_pytree, sharding_tree
+    from nxdi_tpu.runtime.application import maybe_quantize_specs
+    from nxdi_tpu.runtime.model_wrapper import ModelWrapper
+
+    if not getattr(app, "is_fused_spec", False):
+        raise ValueError("draft logit matching needs a fused-speculation app")
+    wrapper = app.models["context_encoding_model"]
+    d_arch = app.draft_family.build_arch(app.draft_config)
+    d_inv = app.draft_family.build_inv_freq(app.draft_config)
+    extra = {}
+    if "fc" in app.params.get("draft", {}):
+        # EAGLE drafts consume a feature stream; declare it so the wrapper
+        # threads it (padded to the largest bucket; the graph slices to S)
+        import jax.numpy as jnp
+
+        extra["prev_hidden"] = ((max(wrapper.buckets), d_arch.hidden_size), jnp.float32)
+    probe = ModelWrapper(
+        "draft_logit_probe",
+        app.draft_config,
+        d_arch,
+        d_inv,
+        batch_size=wrapper.batch_size,
+        n_active_tokens=0,
+        buckets=wrapper.buckets,
+        attend_to_cache=False,
+        extra_inputs=extra,
+        forward_kwargs=dict(
+            gather_last_token=False,
+            output_all_logits=True,
+            output_logits=True,
+            on_device_sampling=False,
+        ),
+    )
+    spec = d_arch.kv_cache_spec(
+        app.tpu_config.kv_cache_batch_size + app.tpu_config.kv_cache_padding_size,
+        app.tpu_config.seq_len,
+    )
+    cache_host = init_kv_cache(spec)
+    cache_specs = kv_cache_partition_spec(app.tpu_config)
+    param_specs = maybe_quantize_specs(
+        app.draft_family.param_specs(app.draft_config), app.draft_config.tpu_config
+    )
+    probe.build(
+        app.mesh,
+        sharding_tree(param_specs, app.mesh),
+        sharding_tree(cache_specs, app.mesh),
+    )
+    cache = shard_pytree(cache_host, cache_specs, app.mesh)
+    app._draft_logit_probe = (probe, cache)
+    return app._draft_logit_probe
+
+
+def check_accuracy_draft_logits(
+    app,
+    input_ids: np.ndarray,
+    golden_logits: Optional[np.ndarray] = None,
+    hf_draft_model=None,
+    prev_hidden: Optional[np.ndarray] = None,
+    divergence_difference_tol: float = 0.001,
+    tol_map: Optional[Dict[int, float]] = None,
+) -> Dict[int, float]:
+    """Teacher-forced logit matching over the DRAFT model of a fused-spec app
+    (reference: accuracy.py:1214/:1233 check_accuracy_draft_logit). EAGLE
+    drafts additionally consume the previous-position feature stream; pass
+    ``prev_hidden`` (B, S, H) to drive the fc fusion (zeros otherwise)."""
+    input_ids = np.asarray(input_ids)
+    if golden_logits is None:
+        if hf_draft_model is None:
+            raise ValueError("need hf_draft_model or golden_logits")
+        golden_logits = hf_forward_logits(hf_draft_model, input_ids)
+
+    B, S = input_ids.shape
+    probe, cache = _get_draft_logit_probe(app)
+    batch = {
+        "input_ids": input_ids.astype(np.int32),
+        "position_ids": np.tile(np.arange(S, dtype=np.int32), (B, 1)),
+        "last_token_index": np.full((B,), S - 1, dtype=np.int32),
+    }
+    d_arch = app.draft_family.build_arch(app.draft_config)
+    if "fc" in app.params.get("draft", {}):
+        S_cap = max(probe.buckets)
+        ph = np.zeros((B, S_cap, d_arch.hidden_size), np.float32)
+        if prev_hidden is not None:
+            ph[:, : prev_hidden.shape[1]] = prev_hidden
+        batch["prev_hidden"] = ph
+    outputs, new_cache = probe.forward(app.params["draft"], cache, batch)
+    app._draft_logit_probe = (probe, new_cache)
+    actual = np.asarray(jax.device_get(outputs["logits"]))[:, :S, :]
+    V = min(actual.shape[-1], golden_logits.shape[-1])
+
+    errors_by_index: Dict[int, float] = {}
+    first_divergence = None
+    for i in range(S):
+        err = float(np.abs(actual[:, i, :V] - golden_logits[:, i, :V]).max())
+        errors_by_index[i] = err
+        tol = (tol_map or {}).get(i, divergence_difference_tol)
+        if err > tol and first_divergence is None:
+            first_divergence = i
+    if first_divergence is not None:
+        raise LogitMatchingValidationError(
+            f"Draft logits diverge at index {first_divergence}: "
+            f"max abs err {errors_by_index[first_divergence]:.6f}",
+            divergence_index=first_divergence,
+            max_error=max(errors_by_index.values()),
+            errors_by_index=errors_by_index,
+        )
+    return errors_by_index
+
+
+def check_accuracy_logits_v2(
+    app,
+    adapter,
+    input_ids: np.ndarray,
+    max_new_tokens: int,
+    hf_model=None,
+    divergence_difference_tol: float = 0.001,
+    tol_map: Optional[Dict[int, float]] = None,
+    **generate_kwargs,
+) -> Dict[int, float]:
+    """Generate-then-match (reference: accuracy.py:699 check_accuracy_logits_v2):
+    run the app's own generation, then teacher-force the PROMPT + GENERATED
+    sequence through both the app and HF CPU and logit-match every position —
+    catching drift that only appears in decode-time state (KV writes, ring
+    wrap-around, continuous-batching routing), which prefill-only matching
+    cannot see."""
+    input_ids = np.asarray(input_ids)
+    out = adapter.generate(input_ids, max_new_tokens=max_new_tokens, **generate_kwargs)
+    full = np.asarray(out)
+    # keep within the CTE budget
+    S_cap = app.tpu_config.max_context_length
+    full = full[:, :S_cap]
+    return check_accuracy_logits(
+        app,
+        full,
+        hf_model=hf_model,
+        divergence_difference_tol=divergence_difference_tol,
+        tol_map=tol_map,
+    )

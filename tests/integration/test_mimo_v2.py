@@ -1,0 +1,205 @@
+"""MiMo-V2-Flash token matching vs an in-test torch golden.
+
+No HF implementation exists in this environment; the golden is a
+self-contained torch re-statement of the published architecture (hybrid
+full/SWA layers with independent head geometry, asymmetric q/k vs v widths,
+partial rotary per type, sigmoid-routed per-layer MoE) — the reference
+validates the same way (its own GPU-side modeling)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.mimo_v2 import modeling_mimo_v2 as mv
+
+CFG = dict(
+    model_type="mimo_v2",
+    hidden_size=64,
+    num_hidden_layers=4,
+    hybrid_layer_pattern=[0, 1, 0, 1],  # full, swa, full, swa
+    moe_layer_freq=[0, 1, 1, 1],  # first layer dense
+    num_attention_heads=4,
+    num_key_value_heads=2,
+    head_dim=16,
+    v_head_dim=8,
+    swa_num_attention_heads=8,
+    swa_num_key_value_heads=4,
+    swa_head_dim=8,
+    swa_v_head_dim=8,
+    sliding_window=4,
+    swa_rope_theta=5000.0,
+    rope_theta=10000.0,
+    partial_rotary_factor=0.5,
+    n_routed_experts=4,
+    num_experts_per_tok=2,
+    moe_intermediate_size=32,
+    intermediate_size=48,
+    scoring_func="sigmoid",
+    norm_topk_prob=True,
+    vocab_size=256,
+    max_position_embeddings=128,
+    layernorm_epsilon=1e-6,
+    rms_norm_eps=1e-6,
+    hidden_act="silu",
+    tie_word_embeddings=False,
+)
+
+
+def _geom(kind):
+    if kind == "swa":
+        return (CFG["swa_num_attention_heads"], CFG["swa_num_key_value_heads"],
+                CFG["swa_head_dim"], CFG["swa_v_head_dim"], CFG["swa_rope_theta"],
+                CFG["sliding_window"])
+    return (CFG["num_attention_heads"], CFG["num_key_value_heads"],
+            CFG["head_dim"], CFG["v_head_dim"], CFG["rope_theta"], None)
+
+
+def _random_sd(rng):
+    H, V, L = CFG["hidden_size"], CFG["vocab_size"], CFG["num_hidden_layers"]
+    E, Im = CFG["n_routed_experts"], CFG["moe_intermediate_size"]
+    Id = CFG["intermediate_size"]
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    sd = {
+        "model.embed_tokens.weight": w(V, H),
+        "model.norm.weight": 1.0 + w(H, scale=0.02),
+        "lm_head.weight": w(V, H),
+    }
+    for i in range(L):
+        kind = "swa" if CFG["hybrid_layer_pattern"][i] == 1 else "full"
+        NH, NKV, D, Dv, _, _ = _geom(kind)
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = 1.0 + w(H, scale=0.02)
+        sd[p + "post_attention_layernorm.weight"] = 1.0 + w(H, scale=0.02)
+        sd[p + "self_attn.q_proj.weight"] = w(NH * D, H)
+        sd[p + "self_attn.k_proj.weight"] = w(NKV * D, H)
+        sd[p + "self_attn.v_proj.weight"] = w(NKV * Dv, H)
+        sd[p + "self_attn.o_proj.weight"] = w(H, NH * Dv)
+        if CFG["moe_layer_freq"][i]:
+            sd[p + "mlp.gate.weight"] = w(E, H)
+            for j in range(E):
+                q = f"{p}mlp.experts.{j}."
+                sd[q + "gate_proj.weight"] = w(Im, H)
+                sd[q + "up_proj.weight"] = w(Im, H)
+                sd[q + "down_proj.weight"] = w(H, Im)
+        else:
+            sd[p + "mlp.gate_proj.weight"] = w(Id, H)
+            sd[p + "mlp.up_proj.weight"] = w(Id, H)
+            sd[p + "mlp.down_proj.weight"] = w(H, Id)
+    return sd
+
+
+def _golden_logits(sd, ids):
+    t = {k: torch.tensor(v) for k, v in sd.items()}
+    H, eps = CFG["hidden_size"], CFG["rms_norm_eps"]
+    B, S = ids.shape
+    prf = CFG["partial_rotary_factor"]
+
+    def rms(x, wgt):
+        return x * torch.rsqrt(x.pow(2).mean(-1, keepdim=True) + eps) * wgt
+
+    def rope_tab(rd, theta):
+        pos = torch.arange(S, dtype=torch.float32)
+        inv = 1.0 / (theta ** (torch.arange(0, rd, 2, dtype=torch.float32) / rd))
+        fr = pos[:, None] * inv[None, :]
+        return torch.cat([fr, fr], -1).cos(), torch.cat([fr, fr], -1).sin()
+
+    x = t["model.embed_tokens.weight"][torch.tensor(ids)]
+    base = torch.tril(torch.ones(S, S, dtype=torch.bool))
+    qp = torch.arange(S)[:, None]
+    kp = torch.arange(S)[None, :]
+    for i in range(CFG["num_hidden_layers"]):
+        kind = "swa" if CFG["hybrid_layer_pattern"][i] == 1 else "full"
+        NH, NKV, D, Dv, theta, window = _geom(kind)
+        rd = int(D * prf) - (int(D * prf) % 2)
+        cos, sin = rope_tab(rd, theta)
+        mask = base if window is None else base & (kp > qp - window)
+        p = f"model.layers.{i}."
+        y = rms(x, t[p + "input_layernorm.weight"])
+        q = (y @ t[p + "self_attn.q_proj.weight"].T).view(B, S, NH, D).transpose(1, 2)
+        k = (y @ t[p + "self_attn.k_proj.weight"].T).view(B, S, NKV, D).transpose(1, 2)
+        v = (y @ t[p + "self_attn.v_proj.weight"].T).view(B, S, NKV, Dv).transpose(1, 2)
+
+        def rot(z):
+            zr, zp = z[..., :rd], z[..., rd:]
+            r1, r2 = zr[..., : rd // 2], zr[..., rd // 2 :]
+            return torch.cat([zr * cos + torch.cat([-r2, r1], -1) * sin, zp], -1)
+
+        q, k = rot(q), rot(k)
+        k = k.repeat_interleave(NH // NKV, 1)
+        v = v.repeat_interleave(NH // NKV, 1)
+        s = (q @ k.transpose(-1, -2)) * D ** -0.5
+        s = s.masked_fill(~mask, float("-inf"))
+        ctx = torch.softmax(s, -1) @ v
+        x = x + ctx.transpose(1, 2).reshape(B, S, NH * Dv) @ t[p + "self_attn.o_proj.weight"].T
+
+        y = rms(x, t[p + "post_attention_layernorm.weight"])
+        if CFG["moe_layer_freq"][i]:
+            flat = y.reshape(-1, H)
+            scores = torch.sigmoid(flat.float() @ t[p + "mlp.gate.weight"].T.float())
+            _, idx = torch.topk(scores, CFG["num_experts_per_tok"], dim=-1)
+            wts = scores.gather(1, idx)
+            wts = wts / wts.sum(-1, keepdim=True)
+            out = torch.zeros_like(flat)
+            for j in range(CFG["n_routed_experts"]):
+                sel = (idx == j).any(-1)
+                if not sel.any():
+                    continue
+                xt = flat[sel]
+                pe = f"{p}mlp.experts.{j}."
+                h = torch.nn.functional.silu(xt @ t[pe + "gate_proj.weight"].T) * (
+                    xt @ t[pe + "up_proj.weight"].T
+                )
+                h = h @ t[pe + "down_proj.weight"].T
+                wj = (wts * (idx == j)).sum(-1)[sel]
+                out[sel] += h * wj[:, None].to(h.dtype)
+            x = x + out.reshape(B, S, H)
+        else:
+            ff = torch.nn.functional.silu(y @ t[p + "mlp.gate_proj.weight"].T) * (
+                y @ t[p + "mlp.up_proj.weight"].T
+            )
+            x = x + ff @ t[p + "mlp.down_proj.weight"].T
+
+    x = rms(x, t["model.norm.weight"])
+    return x @ t["lm_head.weight"].T
+
+
+def _golden_greedy(sd, prompt, n_new):
+    ids = np.array(prompt)
+    for _ in range(n_new):
+        logits = _golden_logits(sd, ids)
+        ids = np.concatenate([ids, logits[:, -1].argmax(-1).numpy()[:, None]], axis=1)
+    return ids[:, prompt.shape[1]:]
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_mimo_v2_token_matching(tp_degree):
+    rng = np.random.default_rng(0)
+    sd = _random_sd(rng)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42], [7, 13, 21, 4, 33, 6, 19, 2]])
+    n_new = 12
+    expected = _golden_greedy(sd, prompt, n_new)
+
+    cfg = mv.MiMoV2InferenceConfig(
+        TpuConfig(
+            tp_degree=tp_degree,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+        ),
+        load_config=lambda: dict(CFG),
+    )
+    app = mv.MiMoV2ForCausalLM("<memory>", cfg)
+    app.get_state_dict = lambda: sd
+    app.load()
+
+    from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=n_new)
+    np.testing.assert_array_equal(actual[:, prompt.shape[1]:], expected)

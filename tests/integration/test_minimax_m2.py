@@ -1,0 +1,183 @@
+"""MiniMax-M2 token matching vs an in-test torch golden.
+
+No HF implementation of minimax_m2 exists in this environment, so the golden
+is a self-contained torch re-statement of the published architecture
+semantics (sigmoid router with selection-only correction bias + renorm, flat
+"per_layer" qk rmsnorm, partial rotary) — the same strategy the reference
+uses (its GPU-side test modeling, test_minimax_m2_gpu.py)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.minimax_m2 import modeling_minimax_m2 as mm
+
+CFG = dict(
+    model_type="minimax_m2",
+    hidden_size=64,
+    intermediate_size=32,  # per-expert intermediate (M2 naming)
+    num_hidden_layers=2,
+    num_attention_heads=8,
+    num_key_value_heads=4,
+    head_dim=16,
+    rotary_dim=8,
+    use_qk_norm=True,
+    num_local_experts=8,
+    num_experts_per_tok=2,
+    vocab_size=256,
+    max_position_embeddings=128,
+    rms_norm_eps=1e-6,
+    rope_theta=10000.0,
+    hidden_act="silu",
+    tie_word_embeddings=False,
+)
+
+
+def _random_sd(rng):
+    H, D, NH, NKV = CFG["hidden_size"], CFG["head_dim"], CFG["num_attention_heads"], CFG["num_key_value_heads"]
+    E, I, V, L = CFG["num_local_experts"], CFG["intermediate_size"], CFG["vocab_size"], CFG["num_hidden_layers"]
+
+    def w(*shape, scale=0.05):
+        return (rng.standard_normal(shape) * scale).astype(np.float32)
+
+    sd = {
+        "model.embed_tokens.weight": w(V, H),
+        "model.norm.weight": 1.0 + w(H, scale=0.02),
+        "lm_head.weight": w(V, H),
+    }
+    for i in range(L):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = 1.0 + w(H, scale=0.02)
+        sd[p + "post_attention_layernorm.weight"] = 1.0 + w(H, scale=0.02)
+        sd[p + "self_attn.q_proj.weight"] = w(NH * D, H)
+        sd[p + "self_attn.k_proj.weight"] = w(NKV * D, H)
+        sd[p + "self_attn.v_proj.weight"] = w(NKV * D, H)
+        sd[p + "self_attn.o_proj.weight"] = w(H, NH * D)
+        sd[p + "self_attn.q_norm.weight"] = 1.0 + w(NH * D, scale=0.02)
+        sd[p + "self_attn.k_norm.weight"] = 1.0 + w(NKV * D, scale=0.02)
+        sd[p + "block_sparse_moe.gate.weight"] = w(E, H)
+        sd[p + "block_sparse_moe.e_score_correction_bias"] = w(E, scale=0.5)
+        for j in range(E):
+            q = f"{p}block_sparse_moe.experts.{j}."
+            sd[q + "w1.weight"] = w(I, H)
+            sd[q + "w3.weight"] = w(I, H)
+            sd[q + "w2.weight"] = w(H, I)
+    return sd
+
+
+def _golden_logits(sd, ids):
+    """Full-sequence forward per the published M2 semantics (torch, fp32)."""
+    t = {k: torch.tensor(v) for k, v in sd.items()}
+    H, D = CFG["hidden_size"], CFG["head_dim"]
+    NH, NKV = CFG["num_attention_heads"], CFG["num_key_value_heads"]
+    rd, eps = CFG["rotary_dim"], CFG["rms_norm_eps"]
+    B, S = ids.shape
+
+    def rms(x, wgt):
+        return x * torch.rsqrt(x.pow(2).mean(-1, keepdim=True) + eps) * wgt
+
+    pos = torch.arange(S, dtype=torch.float32)
+    inv = 1.0 / (CFG["rope_theta"] ** (torch.arange(0, rd, 2, dtype=torch.float32) / rd))
+    fr = pos[:, None] * inv[None, :]
+    cos = torch.cat([fr, fr], -1).cos()  # (S, rd)
+    sin = torch.cat([fr, fr], -1).sin()
+
+    def rope(x):  # (B, h, S, D) rotate first rd channels
+        xr, xp = x[..., :rd], x[..., rd:]
+        r1, r2 = xr[..., : rd // 2], xr[..., rd // 2 :]
+        rot = torch.cat([-r2, r1], -1)
+        return torch.cat([xr * cos + rot * sin, xp], -1)
+
+    x = t["model.embed_tokens.weight"][torch.tensor(ids)]
+    mask = torch.tril(torch.ones(S, S, dtype=torch.bool))
+    for i in range(CFG["num_hidden_layers"]):
+        p = f"model.layers.{i}."
+        y = rms(x, t[p + "input_layernorm.weight"])
+        q = rms(y @ t[p + "self_attn.q_proj.weight"].T, t[p + "self_attn.q_norm.weight"])
+        k = rms(y @ t[p + "self_attn.k_proj.weight"].T, t[p + "self_attn.k_norm.weight"])
+        v = y @ t[p + "self_attn.v_proj.weight"].T
+        q = rope(q.view(B, S, NH, D).transpose(1, 2))
+        k = rope(k.view(B, S, NKV, D).transpose(1, 2))
+        v = v.view(B, S, NKV, D).transpose(1, 2)
+        k = k.repeat_interleave(NH // NKV, 1)
+        v = v.repeat_interleave(NH // NKV, 1)
+        s = (q @ k.transpose(-1, -2)) * D ** -0.5
+        s = s.masked_fill(~mask, float("-inf"))
+        ctx = torch.softmax(s, -1) @ v
+        ctx = ctx.transpose(1, 2).reshape(B, S, NH * D)
+        x = x + ctx @ t[p + "self_attn.o_proj.weight"].T
+
+        y = rms(x, t[p + "post_attention_layernorm.weight"])
+        flat = y.reshape(-1, H)
+        scores = torch.sigmoid(flat @ t[p + "block_sparse_moe.gate.weight"].T.float())
+        corrected = scores + t[p + "block_sparse_moe.e_score_correction_bias"]
+        _, idx = torch.topk(corrected, CFG["num_experts_per_tok"], dim=-1)
+        wts = scores.gather(1, idx)
+        wts = wts / wts.sum(-1, keepdim=True)
+        out = torch.zeros_like(flat)
+        for j in range(CFG["num_local_experts"]):
+            sel = (idx == j).any(-1)
+            if not sel.any():
+                continue
+            xt = flat[sel]
+            pexp = f"{p}block_sparse_moe.experts.{j}."
+            h = torch.nn.functional.silu(xt @ t[pexp + "w1.weight"].T) * (
+                xt @ t[pexp + "w3.weight"].T
+            )
+            h = h @ t[pexp + "w2.weight"].T
+            wj = (wts * (idx == j)).sum(-1)[sel]
+            out[sel] += h * wj[:, None]
+        x = x + out.reshape(B, S, H)
+
+    x = rms(x, t["model.norm.weight"])
+    return x @ t["lm_head.weight"].T
+
+
+def _golden_greedy(sd, prompt, n_new):
+    ids = np.array(prompt)
+    for _ in range(n_new):
+        logits = _golden_logits(sd, ids)
+        nxt = logits[:, -1].argmax(-1).numpy()
+        ids = np.concatenate([ids, nxt[:, None]], axis=1)
+    return ids[:, prompt.shape[1]:]
+
+
+@pytest.mark.parametrize("tp_degree,extra", [
+    (1, {}),
+    (8, {}),
+    (8, {"moe_ep_degree": 2}),
+])
+def test_minimax_m2_token_matching(tp_degree, extra):
+    rng = np.random.default_rng(0)
+    sd = _random_sd(rng)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42], [7, 13, 21, 4, 33, 6, 19, 2]])
+    n_new = 12
+    expected = _golden_greedy(sd, prompt, n_new)
+
+    cfg = mm.MiniMaxM2InferenceConfig(
+        TpuConfig(
+            tp_degree=tp_degree,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+            **extra,
+        ),
+        load_config=lambda: dict(CFG),
+    )
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=mm)
+    app.load()
+
+    from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=n_new)
+    np.testing.assert_array_equal(actual[:, prompt.shape[1]:], expected)

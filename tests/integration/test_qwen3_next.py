@@ -51,10 +51,10 @@ def _tiny_hf(moe=False, layers=4):
     return Qwen3NextForCausalLM(cfg).eval(), cfg
 
 
-def _build_app(hf_model, hf_cfg, batch_size=1):
+def _build_app(hf_model, hf_cfg, batch_size=1, tp_degree=1):
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
     tcfg = TpuConfig(
-        tp_degree=1,
+        tp_degree=tp_degree,
         seq_len=64,
         max_context_length=32,
         batch_size=batch_size,
@@ -82,9 +82,15 @@ def _hf_greedy(hf_model, ids, n):
         ).numpy()
 
 
-def test_qwen3_next_dense_matches_hf():
+import pytest
+
+
+@pytest.mark.parametrize("tp_degree", [1, 2])
+def test_qwen3_next_dense_matches_hf(tp_degree):
+    """tp=2 exercises the head-block TP layout (every head count divides 2:
+    linear k/v heads, gated-attn q heads, kv heads, expert/intermediate dims)."""
     hf, cfg = _tiny_hf(moe=False)
-    app = _build_app(hf, cfg)
+    app = _build_app(hf, cfg, tp_degree=tp_degree)
     adapter = HuggingFaceGenerationAdapter(app)
     prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
     expected = _hf_greedy(hf, prompt, 16)
@@ -92,9 +98,10 @@ def test_qwen3_next_dense_matches_hf():
     np.testing.assert_array_equal(actual, expected)
 
 
-def test_qwen3_next_moe_matches_hf():
+@pytest.mark.parametrize("tp_degree", [1, 2])
+def test_qwen3_next_moe_matches_hf(tp_degree):
     hf, cfg = _tiny_hf(moe=True)
-    app = _build_app(hf, cfg)
+    app = _build_app(hf, cfg, tp_degree=tp_degree)
     adapter = HuggingFaceGenerationAdapter(app)
     prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
     expected = _hf_greedy(hf, prompt, 12)

@@ -36,9 +36,9 @@ def _tiny_hf_whisper(seed=0):
     return WhisperForConditionalGeneration(cfg).eval(), cfg
 
 
-def _build_app(hf_model, hf_cfg):
+def _build_app(hf_model, hf_cfg, tp_degree=1):
     sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
-    tcfg = TpuConfig(seq_len=64, dtype="float32", skip_warmup=True)
+    tcfg = TpuConfig(tp_degree=tp_degree, seq_len=64, dtype="float32", skip_warmup=True)
     cfg = mw.WhisperInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
 
     class App(mw.WhisperForConditionalGeneration):
@@ -62,6 +62,32 @@ def test_whisper_encoder_matches_hf():
         expected = hf.model.encoder(torch.tensor(feats)).last_hidden_state.numpy()
     actual = np.asarray(app.encode(feats))
     np.testing.assert_allclose(actual, expected, atol=2e-5)
+
+
+import pytest
+
+
+@pytest.mark.parametrize("tp_degree", [1, 4])
+def test_whisper_greedy_matches_hf_tp(tp_degree):
+    """TP variant: head-sharded enc/dec attention + intermediate-sharded FFN
+    must reproduce HF tokens exactly."""
+    hf, cfg = _tiny_hf_whisper()
+    app = _build_app(hf, cfg, tp_degree=tp_degree)
+    rng = np.random.default_rng(0)
+    feats = rng.standard_normal((1, 16, 64)).astype(np.float32)
+    dec = np.array([[1]], dtype=np.int64)
+    import torch
+
+    with torch.no_grad():
+        expected = hf.generate(
+            input_features=torch.tensor(feats), decoder_input_ids=torch.tensor(dec),
+            max_new_tokens=12, do_sample=False,
+        ).numpy()
+    actual = app.generate(feats, dec, max_new_tokens=12, eos_token_id=2)
+    gen = actual[:, dec.shape[1]:]
+    n = min(gen.shape[1], expected.shape[1])
+    np.testing.assert_array_equal(gen[:, :n], expected[:, :n])
+    assert n >= 8
 
 
 def test_whisper_greedy_matches_hf():

@@ -1,0 +1,96 @@
+"""Window-sized ring KV cache (reference: window-sized cache shapes,
+kv_cache_manager.py:195-210 / gpt_oss_kv_cache_manager.py): a sliding-window
+model decodes from a W-slot ring instead of a seq_len cache, and greedy
+tokens must stay EXACTLY equal to HF CPU even far past the window."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.mistral import modeling_mistral as mistral
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+WINDOW = 8
+
+
+@pytest.fixture
+def tiny_hf_mistral_swa():
+    from transformers import MistralConfig, MistralForCausalLM
+
+    torch.manual_seed(0)
+    cfg = MistralConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        sliding_window=WINDOW,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        # eager attention applies the sliding window exactly
+        attn_implementation="eager",
+    )
+    return MistralForCausalLM(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = mistral.MistralInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=mistral)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_window_kv_token_matching(tiny_hf_mistral_swa, tp_degree):
+    """Generate 3x past the window: ring wrap-around must keep exact HF
+    parity (every live position is among the last W, which the ring holds)."""
+    hf_model, hf_cfg = tiny_hf_mistral_swa
+    app = _build_app(
+        hf_model, hf_cfg, tp_degree=tp_degree,
+        window_sized_kv=True, sliding_window=WINDOW,
+    )
+    prompt = np.tile(
+        np.array([[5, 9, 3, 17, 2, 8, 11, 42, 7, 13, 21, 4]], np.int64), (2, 1)
+    )
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=24)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_window_kv_cache_is_window_sized(tiny_hf_mistral_swa):
+    hf_model, hf_cfg = tiny_hf_mistral_swa
+    app = _build_app(
+        hf_model, hf_cfg, window_sized_kv=True, sliding_window=WINDOW,
+    )
+    assert app.kv_cache["k"].shape[3] == WINDOW  # not seq_len (64)
+
+
+def test_window_kv_rejects_unsupported_modes():
+    with pytest.raises(ValueError, match="ring"):
+        TpuConfig(window_sized_kv=True, sliding_window=8, speculation_length=3)
+    with pytest.raises(ValueError, match="sliding_window"):
+        TpuConfig(window_sized_kv=True)
