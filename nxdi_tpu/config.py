@@ -64,6 +64,10 @@ class OnDeviceSamplingConfig:
         self.global_topk = kwargs.pop("global_topk", 256)  # stage-1 shard top-k width
         self.deterministic = kwargs.pop("deterministic", False)
         self.on_device_sampling_seed = kwargs.pop("on_device_sampling_seed", 0)
+        # batch-sharded sampling over the tp world (reference:
+        # DataParallelSampler, modules/generation/sampling.py:469-569): each
+        # shard runs the top-k stages on its batch rows, GSPMD gathers tokens
+        self.dp_sampling = kwargs.pop("dp_sampling", False)
         if kwargs:
             raise ValueError(f"Unknown OnDeviceSamplingConfig args: {sorted(kwargs)}")
 
@@ -342,6 +346,12 @@ class TpuConfig:
             self.world_size = self.tp_degree * self.pp_degree
         self.start_rank_id = kwargs.pop("start_rank_id", 0)
         self.sequence_parallel_enabled = kwargs.pop("sequence_parallel_enabled", False)
+        # MLP-CP (reference: mlp_cp_degree config.py:364,374-375). Under GSPMD
+        # this is subsumed: with SP (or CP) the inter-layer hidden is already
+        # sequence-sharded, so the MLP computes context-parallel without a
+        # dedicated path — the knob is accepted for config parity and
+        # validated to require SP exactly like the reference.
+        self.mlp_cp_degree = kwargs.pop("mlp_cp_degree", 1)
         self.flash_decoding_enabled = kwargs.pop("flash_decoding_enabled", False)
         self.num_cores_per_group = kwargs.pop("num_cores_per_group", 1)
         self.vocab_parallel = kwargs.pop("vocab_parallel", True)
@@ -441,6 +451,12 @@ class TpuConfig:
                     "window_sized_kv needs tpu_config.sliding_window (the ring "
                     "slot count) — set it to the model's sliding window"
                 )
+            if self.sliding_window > self.seq_len:
+                raise ValueError(
+                    f"window_sized_kv ring ({self.sliding_window} slots) cannot "
+                    f"exceed seq_len ({self.seq_len}) — the ring layout would "
+                    "address slots the cache does not have"
+                )
             if (
                 self.is_block_kv_layout
                 or self.speculation_length > 0
@@ -455,6 +471,14 @@ class TpuConfig:
                     "speculative/prefix modes assume position-addressed cache "
                     "slots, which the ring layout does not provide"
                 )
+        if self.mlp_cp_degree and self.mlp_cp_degree > 1:
+            if not self.sequence_parallel_enabled:
+                raise ValueError(
+                    "mlp_cp_degree > 1 requires sequence_parallel_enabled "
+                    "(the context-parallel MLP reads S-sharded activations)"
+                )
+            if self.tp_degree % self.mlp_cp_degree != 0:
+                raise ValueError("mlp_cp_degree must divide tp_degree")
         if self.is_medusa and self.num_medusa_heads <= 0:
             raise ValueError("is_medusa requires num_medusa_heads > 0")
         if self.lora_config is not None and self.async_mode:

@@ -208,6 +208,40 @@ class ContiguousKVLayout:
         kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
         return kk, vv, kv_pos
 
+    def commit_rows(self, cache, k_rows, v_rows, cache_inputs, spec):
+        """Deferred-write commit: scatter the per-layer fresh K/V rows
+        (L, B, KV, S_act, D) into the FULL stacked cache in one in-place op.
+
+        The decode hot path cannot afford carrying cache slices through the
+        layer scan as xs/ys — XLA round-trips the whole cache per layer
+        (measured ~6x the pure-attention cost). Instead the scan emits only
+        the new rows and attention reads the OLD cache with the written slots
+        masked + fresh rows appended (models/base.py attention_block
+        ``defer_write``); this commit is the single full-cache touch."""
+        position_ids = cache_inputs.get("write_positions", cache_inputs["position_ids"])
+        S = cache["k"].shape[3]
+        pos = jnp.where(position_ids < 0, S, position_ids).astype(jnp.int32)  # (B, S_act)
+        B = pos.shape[0]
+        if self.route_by_seq_id:
+            b_idx = cache_inputs["seq_ids"].astype(jnp.int32)[:, None]
+        else:
+            b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+
+        def put(cache_arr, rows, scale):
+            if scale != 1.0:
+                rows = rows / jnp.asarray(scale, rows.dtype)
+            vals = rows.astype(cache_arr.dtype).swapaxes(2, 3)  # (L,B,S,KV,D)
+
+            def per_layer(cl, rl):  # (B,KV,S,D), (B,S,KV,D)
+                return cl.at[b_idx, :, pos].set(rl, mode="drop")
+
+            return jax.vmap(per_layer)(cache_arr, vals)
+
+        return {
+            "k": put(cache["k"], k_rows, self.k_scale),
+            "v": put(cache["v"], v_rows, self.v_scale),
+        }
+
 
 @dataclass(frozen=True)
 class BlockKVLayout:

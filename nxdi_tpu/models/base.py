@@ -32,6 +32,7 @@ from nxdi_tpu.kvcache.kv_cache import (
     DEFAULT_KV_LAYOUT,
     BlockKVCacheSpec,
     BlockKVLayout,
+    ContiguousKVLayout,
     KVCacheSpec,
 )
 from nxdi_tpu.ops import attention as attn_ops
@@ -160,6 +161,15 @@ class DecoderArch:
     attn_temperature_tuning: bool = False
     floor_scale: float = 8192.0
     attn_scale: float = 0.1
+    # olmo2: NO input norms; RMSNorm applied to the attn/mlp OUTPUT before the
+    # residual add. Params reuse the standard layer keys: "input_layernorm"
+    # holds the post-ATTENTION norm, "post_attention_layernorm" the
+    # post-FEEDFORWARD norm (conversion aliases them; HF Olmo2DecoderLayer).
+    post_block_norm: bool = False
+    # granite: scalar multipliers on block outputs and logits
+    # (HF GraniteForCausalLM residual_multiplier / logits_scaling)
+    residual_multiplier: float = 1.0
+    logits_scaling: float = 1.0
 
     def kv_cache_spec(self, batch_size: int, max_len: int, quant_dtype=None) -> KVCacheSpec:
         if self.mla is not None:
@@ -316,9 +326,18 @@ def attention_block(
     adapter_ids: Optional[jax.Array] = None,
     window_enabled: Optional[jax.Array] = None,
     use_rope: Optional[jax.Array] = None,
+    defer_write: bool = False,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
+
+    ``defer_write`` (decode hot path): instead of scattering fresh K/V into
+    the cache slice and carrying the full slice through the layer scan (XLA
+    round-trips the whole cache per layer), attend over the OLD cache with
+    this step's slots masked out plus the fresh rows appended, and return
+    only the fresh rows — run_decoder_layers commits them all in ONE scatter
+    on the stacked cache after the scan. Bitwise-equivalent attention inputs;
+    only the softmax summation order differs.
 
     ``attend_to_cache=False`` (context encoding): queries attend the fresh K/V
     only — O(S^2) not O(S * max_len). ``True`` (decode/speculation): attend the
@@ -409,6 +428,34 @@ def attention_block(
 
     ci = dict(cache_inputs or {})
     ci["position_ids"] = position_ids
+    # run_decoder_layers is the single authority on eligibility; the mask
+    # check repeats here only because tree-verify programs statically carry
+    # attn_mask in their cache inputs
+    defer = defer_write and attend_to_cache and ci.get("attn_mask") is None
+    if defer:
+        # OLD cache; this step's slots are masked below and the fresh rows
+        # appended — no per-layer full-cache write-back
+        kk, vv, kv_pos = layout.read(k_cache_l, v_cache_l, ci, cache_spec)
+        kk = constrain(kk, policy.cache_kv)
+        vv = constrain(vv, policy.cache_kv)
+        wpos = ci.get("write_positions", position_ids).astype(jnp.int32)
+        hit = jnp.any(kv_pos[:, None, :] == wpos[:, :, None], axis=1)
+        kv_pos = jnp.where(hit, jnp.int32(2 ** 30), kv_pos)
+        ctx = attn_ops.attention_two_part(
+            q, kk, vv, k, v, position_ids, kv_pos, wpos,
+            scale=arch.attention_scale,
+            softmax_dtype=jnp.float32,
+            sliding_window=arch.sliding_window,
+            chunk_size=arch.chunk_size,
+            sink=p_attn.get("sink") if arch.attention_sink else None,
+            sliding_window_enabled=window_enabled,
+            chunk_enabled=use_rope,
+            logit_softcap=arch.attn_logit_softcap,
+        )
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
+        out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
+        return out, (k, v)  # fresh rows only; committed after the scan
+
     new_k, new_v = layout.update(k_cache_l, v_cache_l, k, v, ci, cache_spec)
 
     if attend_to_cache:
@@ -560,6 +607,7 @@ def decoder_layer(
     layout=DEFAULT_KV_LAYOUT,
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
+    defer_write: bool = False,
 ):
     # per-layer rope selection (gemma3 local/global thetas): cos/sin arrive
     # stacked (2, B, S, D) and the layer flag picks one inside the scan body
@@ -569,7 +617,7 @@ def decoder_layer(
     window_enabled = lp.get("use_sliding_window")
     use_rope = lp.get("use_rope")
 
-    h = _norm(arch, hidden, lp["input_layernorm"])
+    h = hidden if arch.post_block_norm else _norm(arch, hidden, lp["input_layernorm"])
     if "input_norm_skip" in lp:
         # per-layer scalar riding the scan xs: EAGLE drafts feed the fc output
         # straight into attention for their first layer (no input norm)
@@ -578,12 +626,20 @@ def decoder_layer(
         from nxdi_tpu.ops.mla import mla_attention_block as attn_block_fn
     else:
         attn_block_fn = attention_block
+    extra = {}
+    if attn_block_fn is attention_block:
+        extra["defer_write"] = defer_write
     attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
-        adapter_ids, window_enabled, use_rope,
+        adapter_ids, window_enabled, use_rope, **extra,
     )
-    if arch.sandwich_norm:
+    if arch.post_block_norm:
+        # olmo2: x + norm(attn(x)); x + norm(mlp(x))
+        hidden = hidden + _norm(arch, attn_out, lp["input_layernorm"]) * arch.residual_multiplier
+        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids)
+        hidden = hidden + _norm(arch, ff, lp["post_attention_layernorm"]) * arch.residual_multiplier
+    elif arch.sandwich_norm:
         # gemma lineage: post-norms applied to the block OUTPUT before the
         # residual add, and a dedicated pre-feedforward norm
         # (reference: NeuronGemma3DecoderLayer forward, modeling_gemma3.py:224)
@@ -599,12 +655,12 @@ def decoder_layer(
         ff = _norm(arch, ff, lp["post_feedforward_layernorm"])
         hidden = hidden + ff
     else:
-        hidden = hidden + attn_out
+        hidden = hidden + attn_out * arch.residual_multiplier
         h = _norm(arch, hidden, lp["post_attention_layernorm"])
         if arch.moe is not None and "moe" in lp:
-            hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden)
+            hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden) * arch.residual_multiplier
         else:
-            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids)
+            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids) * arch.residual_multiplier
     hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
@@ -756,6 +812,18 @@ def run_decoder_layers(
     # bucket re-windowing slices the cache S dim — meaningless for the paged
     # pool and for the ring layout (its S dim is slots, not positions)
     windowable = not isinstance(layout, (BlockKVLayout, WindowKVLayout))
+    # deferred cache writes (decode hot path): the scan emits only fresh K/V
+    # rows; they commit in ONE scatter on the stacked cache below — carrying
+    # full cache slices through the scan as ys round-trips the whole cache
+    # per layer (measured ~6x the pure-attention cost on v5e)
+    defer = (
+        attend_to_cache
+        and arch.pp_degree == 1
+        and arch.mla is None
+        and not arch.attn_tkg_kernel_enabled
+        and isinstance(layout, ContiguousKVLayout)
+        and (cache_inputs or {}).get("attn_mask") is None
+    )
 
     def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_):
         """One decoder layer with the bucket's static KV window applied."""
@@ -763,14 +831,17 @@ def run_decoder_layers(
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos_, sin_, k_win, v_win, pos_, cache_spec,
-                attend_to_cache, policy, layout, ci_, ad_,
+                attend_to_cache, policy, layout, ci_, ad_, defer_write=defer,
             )
-            nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
-            nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
+            if defer:
+                nk, nv = nkw, nvw  # fresh rows, committed after the scan
+            else:
+                nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
+                nv = jax.lax.dynamic_update_slice(vl, nvw, (0, 0, 0, 0))
         else:
             h, (nk, nv) = decoder_layer(
                 arch, lp, h, cos_, sin_, kl, vl, pos_, cache_spec,
-                attend_to_cache, policy, layout, ci_, ad_,
+                attend_to_cache, policy, layout, ci_, ad_, defer_write=defer,
             )
         return h, nk, nv
 
@@ -841,7 +912,14 @@ def run_decoder_layers(
         else:
             ks.append(ys[0]); vs.append(ys[1])
     cat = (lambda xs: xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0))
-    new_cache = {"k": cat(ks), "v": cat(vs)}
+    if defer:
+        ci_commit = dict(cache_inputs or {})
+        ci_commit["position_ids"] = position_ids
+        new_cache = layout.commit_rows(
+            cache, cat(ks), cat(vs), ci_commit, cache_spec
+        )
+    else:
+        new_cache = {"k": cat(ks), "v": cat(vs)}
     if collect_hidden:
         return hidden, new_cache, cat(hs)
     return hidden, new_cache
@@ -869,6 +947,7 @@ def causal_lm_forward(
     do_sample: bool = False,
     global_topk: int = 256,
     deterministic: bool = False,
+    dp_sampling: bool = False,
     return_next_inputs: bool = False,
     output_hidden: bool = False,
     aux_hidden_indices: Optional[Tuple[int, ...]] = None,
@@ -1037,6 +1116,8 @@ def causal_lm_forward(
         )  # (B, 1, hidden)
 
     logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    if arch.logits_scaling != 1.0:
+        logits = logits / arch.logits_scaling
     if arch.final_logit_softcap is not None:
         cap = arch.final_logit_softcap
         logits = cap * jnp.tanh(logits / cap)
@@ -1067,8 +1148,14 @@ def causal_lm_forward(
         last_logits = logits
 
     if on_device_sampling:
+        sample_in = last_logits[:, -1, :]
+        if dp_sampling:
+            # DataParallelSampler analog (reference: sampling.py:469-569):
+            # batch rows shard over the tp world for the top-k stages; GSPMD
+            # gathers the sampled tokens
+            sample_in = constrain(sample_in, P(AXIS_MP, None))
         tokens = sampling_ops.sample(
-            last_logits[:, -1, :],
+            sample_in,
             batch["sampling_params"],
             rng=batch.get("rng"),
             do_sample=do_sample,

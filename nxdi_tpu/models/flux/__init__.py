@@ -1,0 +1,1 @@
+from nxdi_tpu.models.flux import modeling_flux  # noqa: F401

@@ -1,0 +1,465 @@
+"""Flux (rectified-flow DiT) — diffusion text-to-image pipeline.
+
+Reference: models/diffusers/ (3772 LoC) + flux/application.py:133-429 — a
+multi-submodel application orchestrating text encoders, the flux transformer
+(double-stream + single-stream DiT blocks), and the VAE decoder, with the
+denoising loop on the host.
+
+The ``diffusers`` package is not available in this environment, so there is
+no HF golden; per the build plan this module provides the full multi-app
+orchestration with handmade numerics checks (tests/integration/test_flux.py):
+shape/finiteness/determinism of every submodel, scheduler integration on an
+analytically-solvable flow, and end-to-end pipeline execution on random
+weights.
+
+Architecture implemented (FluxTransformer2DModel semantics):
+  - sinusoidal timestep + guidance embeddings -> MLPs, plus pooled text
+    projection, summed into the modulation stream ``temb``;
+  - 3-axis rope over (id, y, x) position ids for the joint txt+img sequence;
+  - N double-stream blocks: separate img/txt streams with AdaLN-Zero
+    modulation, one JOINT attention over the concatenated sequence, per-head
+    qk rmsnorm;
+  - M single-stream blocks: concatenated stream, parallel attention + MLP
+    fused by one output projection, AdaLN modulation;
+  - final AdaLN-continuous norm + linear to patch channels.
+VAE decoder: conv-in -> mid resnets -> nearest-upsample stages -> groupnorm
+silu conv-out, with the scaling/shift factor applied to latents.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+
+
+class FluxInferenceConfig(InferenceConfig):
+    REQUIRED = [
+        "num_layers", "num_single_layers", "attention_head_dim",
+        "num_attention_heads", "joint_attention_dim", "pooled_projection_dim",
+        "in_channels",
+    ]
+
+    def add_derived_config(self):
+        if not hasattr(self, "axes_dims_rope"):
+            self.axes_dims_rope = [16, 56, 56]
+        if not hasattr(self, "guidance_embeds"):
+            self.guidance_embeds = True
+        if not hasattr(self, "vae_channels"):
+            self.vae_channels = 64
+        if not hasattr(self, "vae_latent_channels"):
+            self.vae_latent_channels = self.in_channels // 4
+
+
+@dataclass(frozen=True)
+class FluxArch:
+    num_layers: int  # double-stream blocks
+    num_single_layers: int
+    num_heads: int
+    head_dim: int
+    joint_dim: int  # T5 feature width
+    pooled_dim: int  # CLIP pooled width
+    in_channels: int  # packed latent patch channels
+    axes_dims: Tuple[int, ...]  # rope split per (id, y, x)
+    guidance: bool
+    vae_channels: int
+    vae_latent_channels: int
+
+    @property
+    def inner(self) -> int:
+        return self.num_heads * self.head_dim
+
+
+def build_arch(config: InferenceConfig) -> FluxArch:
+    return FluxArch(
+        num_layers=config.num_layers,
+        num_single_layers=config.num_single_layers,
+        num_heads=config.num_attention_heads,
+        head_dim=config.attention_head_dim,
+        joint_dim=config.joint_attention_dim,
+        pooled_dim=config.pooled_projection_dim,
+        in_channels=config.in_channels,
+        axes_dims=tuple(config.axes_dims_rope),
+        guidance=bool(config.guidance_embeds),
+        vae_channels=config.vae_channels,
+        vae_latent_channels=config.vae_latent_channels,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def _sinusoidal(t, dim, max_period=10000.0):
+    half = dim // 2
+    freqs = jnp.exp(-np.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    return jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+
+
+def _mlp(p, x, act=jax.nn.silu):
+    return act(x @ p["fc1"]["w"] + p["fc1"]["b"]) @ p["fc2"]["w"] + p["fc2"]["b"]
+
+
+def _rms(x, w, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    return (xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + eps)).astype(
+        x.dtype
+    ) * w
+
+
+def _layer_norm_noaffine(x, eps=1e-6):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps)).astype(x.dtype)
+
+
+def rope_table(arch: FluxArch, ids):
+    """(S, sum(axes_dims)/2, 2) cos/sin from 3-axis position ids (S, 3)."""
+    comps = []
+    for i, d in enumerate(arch.axes_dims):
+        freqs = 1.0 / (10000.0 ** (np.arange(0, d, 2, dtype=np.float64) / d))
+        ph = np.asarray(ids)[:, i : i + 1].astype(np.float64) * freqs[None]
+        comps.append(ph)
+    ph = np.concatenate(comps, axis=-1)  # (S, head_dim/2)
+    return np.stack([np.cos(ph), np.sin(ph)], axis=-1).astype(np.float32)
+
+
+def _apply_rope(x, tab):
+    # x (B, S, H, D): adjacent-pair rotation with per-position phases
+    cos = tab[None, :, None, :, 0]
+    sin = tab[None, :, None, :, 1]
+    xr = x.astype(jnp.float32).reshape(x.shape[:-1] + (x.shape[-1] // 2, 2))
+    a, b = xr[..., 0], xr[..., 1]
+    out = jnp.stack([a * cos - b * sin, a * sin + b * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def _joint_attention(arch, q, k, v):
+    B, S, H, D = q.shape
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    w = jax.nn.softmax(s * (D ** -0.5), axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, v).reshape(B, S, H * D)
+
+
+def time_text_embed(arch: FluxArch, p, timestep, guidance, pooled):
+    temb = _mlp(p["time"], _sinusoidal(timestep * 1000.0, 256))
+    if arch.guidance:
+        temb = temb + _mlp(p["guidance"], _sinusoidal(guidance * 1000.0, 256))
+    return temb + _mlp(p["text"], pooled)
+
+
+def _modulation(p, temb, n):
+    """AdaLN: silu(temb) @ W -> n chunks of inner width."""
+    out = jax.nn.silu(temb) @ p["w"] + p["b"]
+    return jnp.split(out[:, None, :], n, axis=-1)
+
+
+def flux_transformer_forward(
+    arch: FluxArch,
+    params: Dict[str, Any],
+    hidden,  # (B, S_img, in_channels) packed latents
+    encoder_hidden,  # (B, S_txt, joint_dim)
+    pooled,  # (B, pooled_dim)
+    timestep,  # (B,) in [0, 1]
+    guidance,  # (B,)
+    rope_tab,  # (S_txt + S_img, head_dim/2, 2) from rope_table
+):
+    H, D = arch.num_heads, arch.head_dim
+    S_txt = encoder_hidden.shape[1]
+    temb = time_text_embed(arch, params["time_text_embed"], timestep, guidance, pooled)
+    img = hidden @ params["x_embedder"]["w"] + params["x_embedder"]["b"]
+    txt = encoder_hidden @ params["context_embedder"]["w"] + params["context_embedder"]["b"]
+    B, S_img, _ = img.shape
+
+    def double_block(carry, lp):
+        img, txt = carry
+        # img stream modulation (AdaLN-Zero: shift/scale/gate for attn + mlp)
+        i_sh1, i_sc1, i_g1, i_sh2, i_sc2, i_g2 = _modulation(lp["img_mod"], temb, 6)
+        t_sh1, t_sc1, t_g1, t_sh2, t_sc2, t_g2 = _modulation(lp["txt_mod"], temb, 6)
+        img_n = _layer_norm_noaffine(img) * (1 + i_sc1) + i_sh1
+        txt_n = _layer_norm_noaffine(txt) * (1 + t_sc1) + t_sh1
+
+        def qkv(x, p):
+            S = x.shape[1]
+            q = (x @ p["q"]["w"] + p["q"]["b"]).reshape(B, S, H, D)
+            k = (x @ p["k"]["w"] + p["k"]["b"]).reshape(B, S, H, D)
+            v = (x @ p["v"]["w"] + p["v"]["b"]).reshape(B, S, H, D)
+            return _rms(q, p["q_norm"]), _rms(k, p["k_norm"]), v
+
+        iq, ik, iv = qkv(img_n, lp["img_attn"])
+        tq, tk, tv = qkv(txt_n, lp["txt_attn"])
+        # joint sequence order: [txt, img] (flux convention)
+        q = jnp.concatenate([tq, iq], axis=1)
+        k = jnp.concatenate([tk, ik], axis=1)
+        v = jnp.concatenate([tv, iv], axis=1)
+        q, k = _apply_rope(q, rope_tab), _apply_rope(k, rope_tab)
+        attn = _joint_attention(arch, q, k, v)
+        t_attn, i_attn = attn[:, :S_txt], attn[:, S_txt:]
+        img = img + i_g1 * (i_attn @ lp["img_attn"]["o"]["w"] + lp["img_attn"]["o"]["b"])
+        txt = txt + t_g1 * (t_attn @ lp["txt_attn"]["o"]["w"] + lp["txt_attn"]["o"]["b"])
+
+        img_n2 = _layer_norm_noaffine(img) * (1 + i_sc2) + i_sh2
+        txt_n2 = _layer_norm_noaffine(txt) * (1 + t_sc2) + t_sh2
+        img = img + i_g2 * _mlp(lp["img_mlp"], img_n2, act=lambda x: jax.nn.gelu(x, approximate=True))
+        txt = txt + t_g2 * _mlp(lp["txt_mlp"], txt_n2, act=lambda x: jax.nn.gelu(x, approximate=True))
+        return (img, txt), None
+
+    (img, txt), _ = jax.lax.scan(double_block, (img, txt), params["double_blocks"])
+
+    x = jnp.concatenate([txt, img], axis=1)  # (B, S, inner)
+
+    def single_block(carry, lp):
+        x = carry
+        sh, sc, gate = _modulation(lp["mod"], temb, 3)
+        xn = _layer_norm_noaffine(x) * (1 + sc) + sh
+        S = x.shape[1]
+        q = (xn @ lp["q"]["w"] + lp["q"]["b"]).reshape(B, S, H, D)
+        k = (xn @ lp["k"]["w"] + lp["k"]["b"]).reshape(B, S, H, D)
+        v = (xn @ lp["v"]["w"] + lp["v"]["b"]).reshape(B, S, H, D)
+        q, k = _rms(q, lp["q_norm"]), _rms(k, lp["k_norm"])
+        q, k = _apply_rope(q, rope_tab), _apply_rope(k, rope_tab)
+        attn = _joint_attention(arch, q, k, v)
+        mlp = jax.nn.gelu(xn @ lp["mlp_in"]["w"] + lp["mlp_in"]["b"], approximate=True)
+        fused = jnp.concatenate([attn, mlp], axis=-1)
+        x = x + gate * (fused @ lp["out"]["w"] + lp["out"]["b"])
+        return x, None
+
+    x, _ = jax.lax.scan(single_block, x, params["single_blocks"])
+    img = x[:, S_txt:]
+
+    sh, sc = _modulation(params["norm_out"], temb, 2)
+    img = _layer_norm_noaffine(img) * (1 + sc) + sh
+    return img @ params["proj_out"]["w"] + params["proj_out"]["b"]
+
+
+# ---------------------------------------------------------------------------
+# VAE decoder (compact conv decoder; reference: the diffusers VAE app)
+# ---------------------------------------------------------------------------
+
+
+def _conv(p, x):  # x NHWC, w (kh, kw, cin, cout)
+    return (
+        jax.lax.conv_general_dilated(
+            x, p["w"], (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+        + p["b"]
+    )
+
+
+def _group_norm(x, w, b, groups=8, eps=1e-6):
+    B, Hh, Ww, C = x.shape
+    xf = x.astype(jnp.float32).reshape(B, Hh, Ww, groups, C // groups)
+    mu = jnp.mean(xf, axis=(1, 2, 4), keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=(1, 2, 4), keepdims=True)
+    xf = (xf - mu) * jax.lax.rsqrt(var + eps)
+    return (xf.reshape(B, Hh, Ww, C) * w + b).astype(x.dtype)
+
+
+def _resnet(p, x):
+    h = _conv(p["conv1"], jax.nn.silu(_group_norm(x, p["norm1"]["w"], p["norm1"]["b"])))
+    h = _conv(p["conv2"], jax.nn.silu(_group_norm(h, p["norm2"]["w"], p["norm2"]["b"])))
+    if "skip" in p:
+        x = _conv(p["skip"], x)
+    return x + h
+
+
+def vae_decode(arch: FluxArch, params: Dict[str, Any], latents):
+    """(B, h, w, latent_ch) -> (B, 8h, 8w, 3) image in [-1, 1]."""
+    p = params
+    x = latents / p["scaling_factor"] + p["shift_factor"]
+    x = _conv(p["conv_in"], x)
+    x = _resnet(p["mid1"], x)
+    x = _resnet(p["mid2"], x)
+    for i in range(3):  # 3 nearest-neighbor x2 upsample stages -> x8
+        up = p[f"up{i}"]
+        x = _resnet(up["res"], x)
+        B, Hh, Ww, C = x.shape
+        x = jax.image.resize(x, (B, Hh * 2, Ww * 2, C), "nearest")
+        x = _conv(up["conv"], x)
+    x = jax.nn.silu(_group_norm(x, p["norm_out"]["w"], p["norm_out"]["b"]))
+    return jnp.tanh(_conv(p["conv_out"], x))
+
+
+# ---------------------------------------------------------------------------
+# Scheduler (rectified flow / Euler, reference: the flux application loop)
+# ---------------------------------------------------------------------------
+
+
+def flow_match_sigmas(num_steps: int, shift: float = 1.0) -> np.ndarray:
+    """FlowMatchEuler sigma schedule: t in (1, 0], time-shifted."""
+    sigmas = np.linspace(1.0, 1.0 / num_steps, num_steps)
+    sigmas = shift * sigmas / (1 + (shift - 1) * sigmas)
+    return np.append(sigmas, 0.0).astype(np.float32)
+
+
+def euler_step(latents, velocity, sigma, sigma_next):
+    """x_{t+1} = x_t + (sigma_next - sigma) * v (rectified flow ODE)."""
+    return latents + (sigma_next - sigma) * velocity
+
+
+# ---------------------------------------------------------------------------
+# Application
+# ---------------------------------------------------------------------------
+
+ENCODER_PROGRAMS = {
+    "transformer": (flux_transformer_forward, "transformer"),
+    "vae_decoder": (vae_decode, "vae"),
+}
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree_util.tree_map(lambda _: P(), param_shape_struct(config))
+
+
+def convert_hf_state_dict(state_dict, config):  # pragma: no cover - no goldens
+    raise NotImplementedError(
+        "flux checkpoint conversion needs the diffusers weight layout, which "
+        "is unavailable in this environment; construct params matching "
+        "param_shape_struct instead (see tests/integration/test_flux.py)"
+    )
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    inner, D = arch.inner, arch.head_dim
+    mlp_dim = 4 * inner
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    def lin(i, o, n=None):
+        pre = (n,) if n is not None else ()
+        return {"w": s(*pre, i, o), "b": s(*pre, o)}
+
+    def emb_mlp(i, n=None):
+        return {"fc1": lin(i, inner, n), "fc2": lin(inner, inner, n)}
+
+    def attn(n):
+        return {
+            "q": lin(inner, inner, n), "k": lin(inner, inner, n),
+            "v": lin(inner, inner, n), "o": lin(inner, inner, n),
+            "q_norm": s(n, D), "k_norm": s(n, D),
+        }
+
+    nD, nS = arch.num_layers, arch.num_single_layers
+    transformer = {
+        "time_text_embed": {
+            "time": emb_mlp(256),
+            "text": emb_mlp(arch.pooled_dim),
+            **({"guidance": emb_mlp(256)} if arch.guidance else {}),
+        },
+        "x_embedder": lin(arch.in_channels, inner),
+        "context_embedder": lin(arch.joint_dim, inner),
+        "double_blocks": {
+            "img_mod": lin(inner, 6 * inner, nD),
+            "txt_mod": lin(inner, 6 * inner, nD),
+            "img_attn": attn(nD),
+            "txt_attn": attn(nD),
+            "img_mlp": {"fc1": lin(inner, mlp_dim, nD), "fc2": lin(mlp_dim, inner, nD)},
+            "txt_mlp": {"fc1": lin(inner, mlp_dim, nD), "fc2": lin(mlp_dim, inner, nD)},
+        },
+        "single_blocks": {
+            "mod": lin(inner, 3 * inner, nS),
+            "q": lin(inner, inner, nS), "k": lin(inner, inner, nS),
+            "v": lin(inner, inner, nS), "q_norm": s(nS, D), "k_norm": s(nS, D),
+            "mlp_in": lin(inner, mlp_dim, nS),
+            "out": lin(inner + mlp_dim, inner, nS),
+        },
+        "norm_out": lin(inner, 2 * inner),
+        "proj_out": lin(inner, arch.in_channels),
+    }
+    C = arch.vae_channels
+    conv = lambda ci, co: {"w": s(3, 3, ci, co), "b": s(co)}  # noqa: E731
+    gn = lambda c: {"w": s(c), "b": s(c)}  # noqa: E731
+    res = lambda c: {"norm1": gn(c), "conv1": conv(c, c), "norm2": gn(c), "conv2": conv(c, c)}  # noqa: E731
+    vae = {
+        "scaling_factor": s(),
+        "shift_factor": s(),
+        "conv_in": conv(arch.vae_latent_channels, C),
+        "mid1": res(C), "mid2": res(C),
+        "up0": {"res": res(C), "conv": conv(C, C)},
+        "up1": {"res": res(C), "conv": conv(C, C)},
+        "up2": {"res": res(C), "conv": conv(C, C)},
+        "norm_out": gn(C),
+        "conv_out": conv(C, 3),
+    }
+    return {"transformer": transformer, "vae": vae}
+
+
+class FluxPipeline:
+    """Text-to-image orchestration (reference: flux/application.py:133-429):
+    precomputed text embeddings -> host denoising loop over the compiled
+    transformer -> VAE decode. Text encoders (CLIP/T5) plug in as additional
+    encoder programs when their weights are supplied; the pipeline accepts
+    precomputed embeddings directly, matching the reference's embedding
+    hand-off between its text-encoder and transformer applications."""
+
+    def __init__(self, model_path: str, config, params=None):
+        from nxdi_tpu.models.flux import modeling_flux
+        from nxdi_tpu.runtime.encoder import EncoderApplication
+
+        self.app = EncoderApplication(model_path, config, model_family=modeling_flux)
+        if params is not None:
+            from nxdi_tpu.parallel.layers import shard_pytree
+            from nxdi_tpu.parallel.mesh import mesh_from_config
+
+            self.app.mesh = mesh_from_config(config.tpu_config)
+            jax.set_mesh(self.app.mesh)
+            self.app.params = shard_pytree(
+                params, param_specs(config), self.app.mesh
+            )
+            self.app.is_loaded = True
+        self.arch = self.app.arch
+
+    def __call__(
+        self,
+        prompt_embeds,  # (B, S_txt, joint_dim)
+        pooled_embeds,  # (B, pooled_dim)
+        height: int = 64,
+        width: int = 64,
+        num_steps: int = 4,
+        guidance_scale: float = 3.5,
+        seed: int = 0,
+    ):
+        arch = self.arch
+        B = prompt_embeds.shape[0]
+        h, w = height // 16, width // 16  # 8x VAE + 2x2 patch packing
+        S_img, S_txt = h * w, prompt_embeds.shape[1]
+        rng = np.random.default_rng(seed)
+        latents = rng.standard_normal((B, S_img, arch.in_channels)).astype(np.float32)
+
+        txt_ids = np.zeros((S_txt, 3), np.int64)
+        img_ids = np.stack(
+            [
+                np.zeros(S_img),
+                np.repeat(np.arange(h), w),
+                np.tile(np.arange(w), h),
+            ],
+            axis=-1,
+        )
+        tab = rope_table(arch, np.concatenate([txt_ids, img_ids], axis=0))
+
+        sigmas = flow_match_sigmas(num_steps)
+        guidance = np.full((B,), guidance_scale, np.float32)
+        for i in range(num_steps):
+            t = np.full((B,), sigmas[i], np.float32)
+            v = self.app.forward(
+                "transformer", latents, prompt_embeds, pooled_embeds, t, guidance, tab
+            )
+            latents = np.asarray(euler_step(latents, np.asarray(v), sigmas[i], sigmas[i + 1]))
+
+        # unpack 2x2 patches -> (B, 2h, 2w, latent_ch) and decode
+        lc = arch.vae_latent_channels
+        lat = latents.reshape(B, h, w, 2, 2, lc).transpose(0, 1, 3, 2, 4, 5)
+        lat = lat.reshape(B, 2 * h, 2 * w, lc)
+        return np.asarray(self.app.forward("vae_decoder", lat))

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.granite import modeling_granite  # noqa: F401

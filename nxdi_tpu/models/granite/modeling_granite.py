@@ -1,0 +1,44 @@
+"""Granite family — llama with scalar multipliers.
+
+Reference: contrib/models/granite-3.1-8b-instruct. HF GraniteForCausalLM =
+llama plus ``embedding_multiplier`` (scales token embeddings),
+``attention_multiplier`` (replaces 1/sqrt(d) attention scaling),
+``residual_multiplier`` (scales every block output before the residual add)
+and ``logits_scaling`` (divides the final logits)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class GraniteInferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        embed_scale=float(getattr(config, "embedding_multiplier", 1.0)),
+        attention_scale=float(getattr(config, "attention_multiplier", 0.0)) or None,
+        residual_multiplier=float(getattr(config, "residual_multiplier", 1.0)),
+        logits_scaling=float(getattr(config, "logits_scaling", 1.0)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

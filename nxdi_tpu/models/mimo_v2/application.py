@@ -28,6 +28,9 @@ class MiMoV2Application(TpuModelForCausalLM):
              "speculative decoding"),
             (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
             (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
+            (getattr(tc, "window_sized_kv", False),
+             "window-sized ring KV (it would shrink the FULL-attention "
+             "layers' cache too)"),
         ):
             if flag:
                 raise NotImplementedError(f"mimo_v2 does not support {why} yet")
@@ -71,3 +74,7 @@ class MiMoV2Application(TpuModelForCausalLM):
             w.forward_kwargs.pop("output_all_logits", None)
             w.forward_kwargs.pop("tensor_capture", None)
             w.forward_kwargs.pop("return_next_inputs", None)
+            if w.forward_kwargs.pop("dp_sampling", False):
+                raise NotImplementedError(
+                    "mimo_v2 does not support dp_sampling yet"
+                )

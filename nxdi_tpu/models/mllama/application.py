@@ -124,6 +124,10 @@ class MllamaApplication(TpuModelForCausalLM):
             w.forward_kwargs.pop("output_all_logits", None)
             w.forward_kwargs.pop("tensor_capture", None)
             w.forward_kwargs.pop("return_next_inputs", None)
+            if w.forward_kwargs.pop("dp_sampling", False):
+                raise NotImplementedError(
+                    "mllama does not support dp_sampling yet"
+                )
             if tag == TAG_CONTEXT_ENCODING:
                 w.extra_inputs["cross_states"] = ((arch.t_vis, H), jnp.float32)
                 w.extra_inputs["cross_attention_mask"] = (

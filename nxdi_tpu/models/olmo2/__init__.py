@@ -1,0 +1,1 @@
+from nxdi_tpu.models.olmo2 import modeling_olmo2  # noqa: F401

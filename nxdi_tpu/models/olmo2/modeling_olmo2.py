@@ -1,0 +1,83 @@
+"""OLMo-2 family — post-block-norm llama variant with flat qk rmsnorm.
+
+Reference: contrib/models/OLMo-2-* (community hub). Architectural deltas vs
+llama, all expressed as shared-decoder switches (models/base.py):
+  - NO input layernorms; RMSNorm on the attention/MLP OUTPUT before the
+    residual add (``post_block_norm``) — the conversion aliases the HF
+    ``post_attention_layernorm`` -> layer key "input_layernorm" (used as the
+    attn post-norm) and ``post_feedforward_layernorm`` ->
+    "post_attention_layernorm" (the mlp post-norm);
+  - RMSNorm over the FLAT q/k projections before head reshape
+    (``qk_norm_flat``, same switch as minimax-m2).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.models.minimax_m2.modeling_minimax_m2 import _add_flat_norm_entries
+from nxdi_tpu.parallel import gqa
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Olmo2InferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        post_block_norm=True,
+        qk_norm_flat=True,
+        qk_norm_flat_qdim=config.num_attention_heads * dense.head_dim_of(config),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    # alias the post-norms onto the standard layer keys (see module docstring)
+    sd = dict(state_dict)
+    for i in range(config.num_hidden_layers):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = sd[p + "post_attention_layernorm.weight"]
+        sd[p + "post_attention_layernorm.weight"] = sd.pop(
+            p + "post_feedforward_layernorm.weight"
+        )
+    params = dense.convert_hf_state_dict(sd, config, arch)
+
+    plan = dense.gqa_plan(config)
+    D = arch.head_dim
+    dt = dense.np_dtype(arch.dtype)
+
+    def grab(i, side, conv):
+        w = state_dict[f"model.layers.{i}.self_attn.{side}.weight"]
+        return np.asarray(conv(w[:, None], D, plan)[:, 0], dt)
+
+    params["layers"]["attn"]["q_norm"] = np.stack(
+        [grab(i, "q_norm", gqa.convert_q) for i in range(arch.num_layers)]
+    )
+    params["layers"]["attn"]["k_norm"] = np.stack(
+        [grab(i, "k_norm", gqa.convert_kv) for i in range(arch.num_layers)]
+    )
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    arch = build_arch(config)
+    return _add_flat_norm_entries(arch, dense.param_specs_for(arch), "spec")
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    return _add_flat_norm_entries(
+        arch, dense.param_shape_struct(config, arch), "struct"
+    )

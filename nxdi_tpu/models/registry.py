@@ -32,6 +32,9 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "qwen3_vl": ("nxdi_tpu.models.qwen3_vl.modeling_qwen3_vl", "Qwen3VLInferenceConfig"),
     "minimax_m2": ("nxdi_tpu.models.minimax_m2.modeling_minimax_m2", "MiniMaxM2InferenceConfig"),
     "mimo_v2": ("nxdi_tpu.models.mimo_v2.modeling_mimo_v2", "MiMoV2InferenceConfig"),
+    "olmo2": ("nxdi_tpu.models.olmo2.modeling_olmo2", "Olmo2InferenceConfig"),
+    "granite": ("nxdi_tpu.models.granite.modeling_granite", "GraniteInferenceConfig"),
+    "smollm3": ("nxdi_tpu.models.smollm3.modeling_smollm3", "SmolLM3InferenceConfig"),
     "gpt2": ("nxdi_tpu.models.gpt2.modeling_gpt2", "GPT2InferenceConfig"),
     "gemma2": ("nxdi_tpu.models.gemma2.modeling_gemma2", "Gemma2InferenceConfig"),
     "phi3": ("nxdi_tpu.models.phi3.modeling_phi3", "Phi3InferenceConfig"),

@@ -1,0 +1,1 @@
+from nxdi_tpu.models.smollm3 import modeling_smollm3  # noqa: F401

@@ -1,0 +1,57 @@
+"""SmolLM3 family — llama with interleaved NoPE layers.
+
+Reference: contrib/models/SmolLM3-3B. HF SmolLM3 = llama where every
+``no_rope_layer_interval``-th layer skips rope entirely; the per-layer
+``use_rope`` flag rides the layer scan exactly like llama4's no-rope layers
+(models/base.py), with the STANDARD rotate-half rope on the others."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class SmolLM3InferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    return dense.build_arch(config, **overrides)
+
+
+def _use_rope_flags(config: InferenceConfig) -> np.ndarray:
+    nrl = getattr(config, "no_rope_layers", None)
+    L = config.num_hidden_layers
+    if nrl:
+        return np.array([bool(v) for v in nrl], dtype=bool)  # 1 = USE rope
+    interval = getattr(config, "no_rope_layer_interval", 4) or 4
+    return np.array([(i + 1) % interval != 0 for i in range(L)], dtype=bool)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    params = dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+    params["layers"]["use_rope"] = _use_rope_flags(config)
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["use_rope"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    struct["layers"]["use_rope"] = jax.ShapeDtypeStruct(
+        (config.num_hidden_layers,), jnp.bool_
+    )
+    return struct

@@ -126,3 +126,73 @@ def attention_with_positions(
         q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink,
         logit_softcap=logit_softcap,
     )
+
+
+def _mask_from_positions(
+    q_pos, kv_pos, sliding_window, chunk_size, sliding_window_enabled, chunk_enabled
+):
+    if sliding_window is not None:
+        mask = sliding_window_mask_from_positions(q_pos, kv_pos, sliding_window)
+        if sliding_window_enabled is not None:
+            mask = jnp.where(
+                sliding_window_enabled, mask, causal_mask_from_positions(q_pos, kv_pos)
+            )
+    elif chunk_size is not None:
+        mask = chunked_attention_mask_from_positions(q_pos, kv_pos, chunk_size)
+        if chunk_enabled is not None:
+            mask = jnp.where(
+                chunk_enabled, mask, causal_mask_from_positions(q_pos, kv_pos)
+            )
+    else:
+        mask = causal_mask_from_positions(q_pos, kv_pos)
+    return mask
+
+
+def attention_two_part(
+    q,  # (B, H, Sq, D)
+    kk, vv,  # cache segment (B, KV, W, D/Dv)
+    k2, v2,  # fresh segment (B, KV, S2, D/Dv)
+    q_pos, kv_pos, kv_pos2, *,
+    scale=None, softmax_dtype=jnp.float32,
+    sliding_window=None, chunk_size=None, sink=None,
+    sliding_window_enabled=None, chunk_enabled=None, logit_softcap=None,
+):
+    """Attention over [cache, fresh] WITHOUT concatenating K/V: only the
+    SCORES (tiny vs the cache) are concatenated for one softmax, then the two
+    weighted sums add. This is the deferred-cache-write decode path
+    (models/base.py): concatenating the K/V would re-materialize the whole
+    cache window per layer, which costs more than the attention itself."""
+    B, H, Sq, D = q.shape
+    KV = kk.shape[1]
+    G = H // KV
+    W, S2 = kk.shape[2], k2.shape[2]
+    if scale is None:
+        scale = D ** -0.5
+    qg = q.reshape(B, KV, G, Sq, D)
+    s1 = jnp.einsum("bkgqd,bksd->bkgqs", qg, kk, preferred_element_type=softmax_dtype)
+    s2 = jnp.einsum("bkgqd,bksd->bkgqs", qg, k2, preferred_element_type=softmax_dtype)
+    s = jnp.concatenate([s1, s2], axis=-1).astype(softmax_dtype) * scale
+    if logit_softcap is not None:
+        s = logit_softcap * jnp.tanh(s / logit_softcap)
+    m1 = _mask_from_positions(
+        q_pos, kv_pos, sliding_window, chunk_size, sliding_window_enabled, chunk_enabled
+    )
+    m2 = _mask_from_positions(
+        q_pos, kv_pos2, sliding_window, chunk_size, sliding_window_enabled, chunk_enabled
+    )
+    mask = jnp.concatenate([m1, m2], axis=-1)
+    s = jnp.where(mask[:, None, None, :, :], s, NEG_INF)
+    if sink is not None:
+        sink_col = jnp.broadcast_to(
+            sink.reshape(1, KV, G, 1, 1).astype(softmax_dtype), (B, KV, G, Sq, 1)
+        )
+        full = jnp.concatenate([s, sink_col], axis=-1)
+        weights = jax.nn.softmax(full, axis=-1)[..., :-1]
+    else:
+        weights = jax.nn.softmax(s, axis=-1)
+    w1 = weights[..., :W].astype(vv.dtype)
+    w2 = weights[..., W:].astype(v2.dtype)
+    out = jnp.einsum("bkgqs,bksd->bkgqd", w1, vv) + jnp.einsum(
+        "bkgqs,bksd->bkgqd", w2, v2
+    )
+    return out.reshape(B, H, Sq, vv.shape[-1])

@@ -379,6 +379,7 @@ class TpuModelForCausalLM(ApplicationBase):
                 do_sample=odsc.do_sample,
                 global_topk=odsc.global_topk,
                 deterministic=odsc.deterministic,
+                dp_sampling=getattr(odsc, "dp_sampling", False),
             )
         # async (device-resident) loop needs every step to emit the next step's
         # inputs on device; only meaningful with on-device sampling

@@ -1,0 +1,90 @@
+"""Encoder / pipeline application base — stateless multi-submodel apps.
+
+Reference: models/encoder_base.py:16-99 ``NeuronEncoderApplication``: an
+application owning a LIST of compiled submodels (ViT towers, text encoders,
+diffusion backbones, VAEs), each traced separately, dispatched by name.
+
+TPU-native: each submodel is a pure function ``fn(params_subtree, *inputs)``
+jitted once per input-shape signature under the app's mesh, with params
+sharded by the family's PartitionSpecs. No KV cache, no buckets — encoders
+are fixed-shape (or few-shape) programs; shape-specialized jit re-traces per
+new signature and caches, which subsumes the reference's per-submodel
+ModelWrapper machinery for stateless models.
+
+Family protocol (module-level):
+  - ``ENCODER_PROGRAMS``: {name: (forward_fn, params_key)} — forward_fn is
+    called as fn(arch, params[params_key], *inputs); params_key may be None
+    for the whole tree.
+  - ``build_arch(config)``, ``convert_hf_state_dict(sd, config)``,
+    ``param_specs(config)``; optionally ``param_shape_struct(config)``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+class EncoderApplication:
+    def __init__(self, model_path: str, config, model_family=None):
+        self.model_path = model_path
+        self.config = config
+        self.tpu_config = config.tpu_config
+        self.family = model_family
+        if not hasattr(model_family, "ENCODER_PROGRAMS"):
+            raise ValueError(
+                f"{model_family.__name__} does not expose ENCODER_PROGRAMS; "
+                "not an encoder family"
+            )
+        self.arch = model_family.build_arch(config)
+        self.params = None
+        self.mesh = None
+        self.is_loaded = False
+        self._programs: Dict[Any, Any] = {}
+
+    # -- weights --
+    def get_state_dict(self):
+        from nxdi_tpu import checkpoint as ckpt
+
+        return ckpt.load_state_dict(self.model_path)
+
+    def load(self, compiled_model_path: Optional[str] = None) -> None:
+        from nxdi_tpu.parallel.layers import shard_pytree
+        from nxdi_tpu.parallel.mesh import mesh_from_config
+
+        self.mesh = mesh_from_config(self.tpu_config)
+        jax.set_mesh(self.mesh)
+        params_host = self.family.convert_hf_state_dict(self.get_state_dict(), self.config)
+        self.params = shard_pytree(
+            params_host, self.family.param_specs(self.config), self.mesh
+        )
+        self.is_loaded = True
+
+    # -- dispatch --
+    def program(self, name: str):
+        if name not in self.family.ENCODER_PROGRAMS:
+            raise KeyError(
+                f"unknown encoder program {name!r}; have "
+                f"{sorted(self.family.ENCODER_PROGRAMS)}"
+            )
+        if name not in self._programs:
+            fn, _ = self.family.ENCODER_PROGRAMS[name]
+            with jax.set_mesh(self.mesh):
+                self._programs[name] = jax.jit(partial(fn, self.arch))
+        return self._programs[name]
+
+    def forward(self, name: str, *inputs):
+        """Run one named submodel (reference: per-submodel ModelWrapper
+        dispatch, encoder_base.py:71-86)."""
+        if not self.is_loaded:
+            raise RuntimeError("call load() before forward()")
+        _, params_key = self.family.ENCODER_PROGRAMS[name]
+        sub = self.params if params_key is None else self.params[params_key]
+        inputs = tuple(
+            np.asarray(x) if not isinstance(x, jax.Array) else x for x in inputs
+        )
+        with jax.set_mesh(self.mesh):
+            return self.program(name)(sub, *inputs)

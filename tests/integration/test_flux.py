@@ -1,0 +1,115 @@
+"""Flux pipeline — handmade numerics checks (reference: models/diffusers/ +
+flux/application.py; no ``diffusers`` golden exists in this environment, so
+the checks are structural + analytic: submodel shapes/finiteness/determinism,
+exact ODE integration of the Euler flow scheduler, modulation-path liveness,
+and end-to-end pipeline execution)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from nxdi_tpu.config import TpuConfig
+from nxdi_tpu.models.flux import modeling_flux as mf
+
+CFG = dict(
+    model_type="flux",
+    num_layers=2,
+    num_single_layers=2,
+    attention_head_dim=16,
+    num_attention_heads=4,
+    joint_attention_dim=48,
+    pooled_projection_dim=32,
+    in_channels=16,
+    axes_dims_rope=[4, 6, 6],
+    guidance_embeds=True,
+    vae_channels=16,
+    vae_latent_channels=4,
+)
+
+
+@pytest.fixture(scope="module")
+def flux_setup():
+    cfg = mf.FluxInferenceConfig(
+        TpuConfig(seq_len=64, dtype="float32", skip_warmup=True),
+        load_config=lambda: dict(CFG),
+    )
+    arch = mf.build_arch(cfg)
+    rng = np.random.default_rng(0)
+    struct = mf.param_shape_struct(cfg)
+    params = jax.tree_util.tree_map(
+        lambda s: (rng.standard_normal(s.shape) * 0.05).astype(np.float32), struct
+    )
+    params["vae"]["scaling_factor"] = np.float32(0.36)
+    params["vae"]["shift_factor"] = np.float32(0.11)
+    return cfg, arch, params
+
+
+def test_scheduler_integrates_linear_flow_exactly():
+    """Euler over a CONSTANT velocity field must land exactly on x0 + total
+    sigma change * v regardless of step count (rectified flow is linear)."""
+    x0 = np.array([2.0, -1.0])
+    v = np.array([0.5, 3.0])
+    for steps in (1, 4, 16):
+        sig = mf.flow_match_sigmas(steps)
+        x = x0.copy()
+        for i in range(steps):
+            x = mf.euler_step(x, v, sig[i], sig[i + 1])
+        np.testing.assert_allclose(x, x0 + (0.0 - sig[0]) * v, rtol=1e-6)
+
+
+def test_transformer_shapes_determinism_and_conditioning(flux_setup):
+    cfg, arch, params = flux_setup
+    rng = np.random.default_rng(1)
+    B, S_txt, h, w = 2, 5, 4, 4
+    S_img = h * w
+    hidden = rng.standard_normal((B, S_img, arch.in_channels)).astype(np.float32)
+    txt = rng.standard_normal((B, S_txt, arch.joint_dim)).astype(np.float32)
+    pooled = rng.standard_normal((B, arch.pooled_dim)).astype(np.float32)
+    ids = np.concatenate(
+        [np.zeros((S_txt, 3)),
+         np.stack([np.zeros(S_img), np.repeat(np.arange(h), w), np.tile(np.arange(w), h)], -1)]
+    )
+    tab = mf.rope_table(arch, ids)
+    t = np.full((B,), 0.7, np.float32)
+    g = np.full((B,), 3.5, np.float32)
+
+    out1 = np.asarray(mf.flux_transformer_forward(arch, params["transformer"], hidden, txt, pooled, t, g, tab))
+    out2 = np.asarray(mf.flux_transformer_forward(arch, params["transformer"], hidden, txt, pooled, t, g, tab))
+    assert out1.shape == (B, S_img, arch.in_channels)
+    assert np.isfinite(out1).all()
+    np.testing.assert_array_equal(out1, out2)  # deterministic
+
+    # every conditioning input must be LIVE (timestep, text, pooled)
+    out_t = np.asarray(mf.flux_transformer_forward(arch, params["transformer"], hidden, txt, pooled, t * 0.1, g, tab))
+    out_txt = np.asarray(mf.flux_transformer_forward(arch, params["transformer"], hidden, txt * 0.0, pooled, t, g, tab))
+    out_p = np.asarray(mf.flux_transformer_forward(arch, params["transformer"], hidden, txt, pooled * 0.0, t, g, tab))
+    assert np.abs(out1 - out_t).max() > 1e-6
+    assert np.abs(out1 - out_txt).max() > 1e-6
+    assert np.abs(out1 - out_p).max() > 1e-6
+
+
+def test_vae_decoder_upsamples_8x(flux_setup):
+    cfg, arch, params = flux_setup
+    rng = np.random.default_rng(2)
+    lat = rng.standard_normal((1, 4, 4, arch.vae_latent_channels)).astype(np.float32)
+    img = np.asarray(mf.vae_decode(arch, params["vae"], lat))
+    assert img.shape == (1, 32, 32, 3)
+    assert np.isfinite(img).all()
+    assert img.min() >= -1.0 and img.max() <= 1.0
+
+
+def test_flux_pipeline_end_to_end(flux_setup):
+    cfg, arch, params = flux_setup
+    pipe = mf.FluxPipeline("<random>", cfg, params=params)
+    rng = np.random.default_rng(3)
+    txt = rng.standard_normal((1, 5, arch.joint_dim)).astype(np.float32)
+    pooled = rng.standard_normal((1, arch.pooled_dim)).astype(np.float32)
+    img = pipe(txt, pooled, height=64, width=64, num_steps=2)
+    assert img.shape == (1, 64, 64, 3)
+    assert np.isfinite(img).all()
+    # seeds change the result; same seed reproduces it
+    img_b = pipe(txt, pooled, height=64, width=64, num_steps=2)
+    np.testing.assert_array_equal(img, img_b)
+    img_c = pipe(txt, pooled, height=64, width=64, num_steps=2, seed=7)
+    assert np.abs(img - img_c).max() > 1e-6

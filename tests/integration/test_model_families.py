@@ -253,6 +253,34 @@ def _tiny_hf(model_type):
         cfg = Phi3Config(**common, pad_token_id=0, tie_word_embeddings=False,
                          eos_token_id=None)
         model = Phi3ForCausalLM(cfg)
+    elif model_type == "olmo2":
+        from transformers import Olmo2Config, Olmo2ForCausalLM
+
+        # post-block norms + flat qk rmsnorm (no input layernorms)
+        cfg = Olmo2Config(**common, tie_word_embeddings=False)
+        model = Olmo2ForCausalLM(cfg)
+    elif model_type == "granite":
+        from transformers import GraniteConfig, GraniteForCausalLM
+
+        cfg = GraniteConfig(
+            **common,
+            embedding_multiplier=2.0,
+            attention_multiplier=0.2,
+            residual_multiplier=0.5,
+            logits_scaling=1.5,
+            tie_word_embeddings=False,
+        )
+        model = GraniteForCausalLM(cfg)
+    elif model_type == "smollm3":
+        from transformers import SmolLM3Config, SmolLM3ForCausalLM
+
+        cfg = SmolLM3Config(
+            **common,
+            no_rope_layers=[1, 1, 1, 0],  # last layer NoPE
+            tie_word_embeddings=False,
+            pad_token_id=0,  # default pad id exceeds the tiny vocab
+        )
+        model = SmolLM3ForCausalLM(cfg)
     elif model_type == "dbrx":
         from transformers import DbrxConfig, DbrxForCausalLM
 
@@ -299,7 +327,7 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
     "model_type",
     ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "gemma2",
      "phi3", "phi3_longrope", "gpt2", "dbrx", "gpt_oss", "deepseek_v3",
-     "deepseek_v3_moe", "llama4_text"]
+     "deepseek_v3_moe", "llama4_text", "olmo2", "granite", "smollm3"]
 )
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):

@@ -156,3 +156,26 @@ def test_flash_kernel_token_matching(tiny_hf_llama, tcfg_kwargs):
     expected = hf_greedy(hf_model, prompt, max_new_tokens=16)
     actual = adapter.generate(prompt, max_new_tokens=16)
     np.testing.assert_array_equal(actual, expected)
+
+
+def test_dp_sampling_token_matching(tiny_hf_llama):
+    """DataParallelSampler analog: batch-sharded sampling must emit the same
+    greedy tokens (reference: modules/generation/sampling.py:469-569)."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg, batch_size=8,
+        on_device_sampling_config=dict(dp_sampling=True),
+    )
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.tile(PROMPT, (8, 1))
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=12)
+    actual = adapter.generate(prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_mlp_cp_degree_validation():
+    from nxdi_tpu.config import TpuConfig
+
+    with pytest.raises(ValueError, match="sequence_parallel"):
+        TpuConfig(tp_degree=8, mlp_cp_degree=2)
+    TpuConfig(tp_degree=8, mlp_cp_degree=2, sequence_parallel_enabled=True)
