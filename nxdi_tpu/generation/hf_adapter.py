@@ -74,12 +74,50 @@ class HuggingFaceGenerationAdapter:
         seed: int = 0,
         adapter_ids: Optional[np.ndarray] = None,
         pixel_values: Optional[np.ndarray] = None,
+        logits_processor=None,
+        generation_config=None,
         **unused,
     ) -> np.ndarray:
         """Greedy/sampling generation. Returns (B, S + new_tokens) ids, with each
         row's generated tokens appended after its true prompt (right-padding in
         the prompt region is preserved, like the reference's right-pad support).
         """
+        # HF GenerationConfig passthrough (reference: hf_adapter.py generation
+        # config plumbing): config values act as defaults for unset args
+        if generation_config is not None:
+            gc = generation_config
+            if max_new_tokens is None:
+                max_new_tokens = getattr(gc, "max_new_tokens", None)
+            # HF GenerationConfig carries a DEFAULT max_length=20; only honor
+            # it when max_new_tokens is genuinely unset
+            if max_length is None and max_new_tokens is None:
+                max_length = getattr(gc, "max_length", None)
+            if not do_sample:
+                do_sample = bool(getattr(gc, "do_sample", False))
+            if top_k == 1 and getattr(gc, "top_k", None):
+                top_k = gc.top_k
+            if top_p == 1.0 and getattr(gc, "top_p", None):
+                top_p = gc.top_p
+            if temperature == 1.0 and getattr(gc, "temperature", None):
+                temperature = gc.temperature
+            if eos_token_id is None:
+                eos_token_id = getattr(gc, "eos_token_id", None)
+            if pad_token_id == 0 and getattr(gc, "pad_token_id", None) is not None:
+                pad_token_id = gc.pad_token_id
+        if logits_processor:
+            # host-side logits interception (reference: LogitsProcessorList
+            # support in the HF adapter): tokens are selected on host from the
+            # compiled model's full logits, so the program must emit them
+            if not self.tpu_config.output_logits:
+                raise ValueError(
+                    "logits_processor needs host-visible logits: compile with "
+                    "TpuConfig(output_logits=True)"
+                )
+            if getattr(self.app, "is_fused_spec", False):
+                raise ValueError(
+                    "logits_processor is incompatible with fused speculation "
+                    "(tokens are selected inside the compiled window)"
+                )
         input_ids = np.asarray(input_ids)
         B, S = input_ids.shape
         if attention_mask is None:
@@ -111,7 +149,9 @@ class HuggingFaceGenerationAdapter:
 
         odsc = self.tpu_config.on_device_sampling_config
         compiled_do_sample = bool(odsc and odsc.do_sample)
-        if do_sample and not compiled_do_sample:
+        if do_sample and not compiled_do_sample and not logits_processor:
+            # (with logits_processor, sampling runs on HOST from the emitted
+            # logits, so the compiled sampler mode is irrelevant)
             logger.warning(
                 "generate(do_sample=True) requested but the model was compiled "
                 "without on-device sampling (OnDeviceSamplingConfig(do_sample="
@@ -144,7 +184,14 @@ class HuggingFaceGenerationAdapter:
             rng=self._next_rng(),
             **cte_kwargs,
         )
-        next_tokens = self._next_tokens(outputs)
+        running = input_ids.copy() if logits_processor else None
+        if logits_processor:
+            next_tokens = self._host_select(
+                outputs, running, logits_processor, do_sample, top_k, top_p, temperature
+            )
+            running = np.concatenate([running, next_tokens[:, None]], axis=1)
+        else:
+            next_tokens = self._next_tokens(outputs)
 
         generated: List[np.ndarray] = [next_tokens]
         finished = np.zeros((B,), dtype=bool)
@@ -171,6 +218,7 @@ class HuggingFaceGenerationAdapter:
             and "next_inputs" in outputs
             and not finished.all()
             and not lora_kwargs
+            and not logits_processor
         ):
             gen = self._device_decode_loop(
                 outputs["next_inputs"], next_tokens, lengths, n_new, eos_ids, pad_token_id, B
@@ -191,7 +239,14 @@ class HuggingFaceGenerationAdapter:
                 rng=self._next_rng(),
                 **lora_kwargs,
             )
-            next_tokens = self._next_tokens(outputs)
+            if logits_processor:
+                next_tokens = self._host_select(
+                    outputs, running, logits_processor, do_sample, top_k, top_p,
+                    temperature,
+                )
+                running = np.concatenate([running, next_tokens[:, None]], axis=1)
+            else:
+                next_tokens = self._next_tokens(outputs)
             next_tokens = np.where(finished, pad_token_id, next_tokens)
             generated.append(next_tokens)
             for e in eos_ids:
@@ -200,6 +255,42 @@ class HuggingFaceGenerationAdapter:
 
         gen = np.stack(generated, axis=1)  # (B, T)
         return self._assemble(input_ids, gen, lengths, pad_token_id)
+
+    def _host_select(
+        self, outputs, running, processors, do_sample, top_k, top_p, temperature
+    ) -> np.ndarray:
+        """Apply host logits processors, then pick tokens on host (reference:
+        the HF adapter's LogitsProcessorList flow)."""
+        import torch
+
+        logits = np.asarray(outputs["logits"])[:, -1, :].astype(np.float32)
+        scores = torch.tensor(logits)
+        ids = torch.tensor(np.asarray(running), dtype=torch.long)
+        for proc in processors:
+            scores = proc(ids, scores)
+        scores = scores.numpy()
+        if not do_sample:
+            return scores.argmax(-1).astype(np.int64)
+        rng = np.random.default_rng(self._seed + self._rng_counter)
+        self._rng_counter += 1
+        scores = scores / max(temperature, 1e-6)
+        if top_k and top_k > 0:
+            kth = np.partition(scores, -top_k, axis=-1)[:, -top_k][:, None]
+            scores = np.where(scores < kth, -np.inf, scores)
+        probs = np.exp(scores - scores.max(-1, keepdims=True))
+        probs = probs / probs.sum(-1, keepdims=True)
+        if top_p < 1.0:
+            order = np.argsort(-probs, axis=-1)
+            sorted_p = np.take_along_axis(probs, order, axis=-1)
+            keep = np.cumsum(sorted_p, axis=-1) - sorted_p < top_p
+            mask = np.zeros_like(probs, dtype=bool)
+            np.put_along_axis(mask, order, keep, axis=-1)
+            probs = np.where(mask, probs, 0.0)
+            probs = probs / probs.sum(-1, keepdims=True)
+        return np.array(
+            [rng.choice(probs.shape[-1], p=probs[b]) for b in range(probs.shape[0])],
+            dtype=np.int64,
+        )
 
     def _assemble(self, input_ids, gen, lengths, pad_token_id) -> np.ndarray:
         """Place generated tokens immediately after each row's true length."""

@@ -414,7 +414,6 @@ class FluxPipeline:
             from nxdi_tpu.parallel.mesh import mesh_from_config
 
             self.app.mesh = mesh_from_config(config.tpu_config)
-            jax.set_mesh(self.app.mesh)
             self.app.params = shard_pytree(
                 params, param_specs(config), self.app.mesh
             )

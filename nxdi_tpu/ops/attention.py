@@ -107,21 +107,10 @@ def attention_with_positions(
     interleaved kv manager): the flag rides the layer scan, selecting between
     the windowed and plain causal mask inside one compiled body.
     """
-    if sliding_window is not None:
-        mask = sliding_window_mask_from_positions(q_pos, kv_pos, sliding_window)
-        if sliding_window_enabled is not None:
-            mask = jnp.where(
-                sliding_window_enabled, mask, causal_mask_from_positions(q_pos, kv_pos)
-            )
-    elif chunk_size is not None:
-        mask = chunked_attention_mask_from_positions(q_pos, kv_pos, chunk_size)
-        if chunk_enabled is not None:
-            # llama4: chunked attention on rope layers only (per-layer flag)
-            mask = jnp.where(
-                chunk_enabled, mask, causal_mask_from_positions(q_pos, kv_pos)
-            )
-    else:
-        mask = causal_mask_from_positions(q_pos, kv_pos)
+    mask = _mask_from_positions(
+        q_pos, kv_pos, sliding_window, chunk_size, sliding_window_enabled,
+        chunk_enabled,
+    )
     return grouped_attention(
         q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink,
         logit_softcap=logit_softcap,

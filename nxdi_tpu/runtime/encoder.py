@@ -56,7 +56,6 @@ class EncoderApplication:
         from nxdi_tpu.parallel.mesh import mesh_from_config
 
         self.mesh = mesh_from_config(self.tpu_config)
-        jax.set_mesh(self.mesh)
         params_host = self.family.convert_hf_state_dict(self.get_state_dict(), self.config)
         self.params = shard_pytree(
             params_host, self.family.param_specs(self.config), self.mesh

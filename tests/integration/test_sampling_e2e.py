@@ -45,3 +45,49 @@ def test_greedy_rows_in_sampling_app_still_greedy(tiny_hf_llama, tmp_path):
             torch.tensor(prompt), max_new_tokens=10, do_sample=False, pad_token_id=0
         ).numpy()
     np.testing.assert_array_equal(out, ref)
+
+
+def test_logits_processor_hook(tiny_hf_llama, tmp_path):
+    """Host logits processors intercept the compiled model's logits
+    (reference: the HF adapter's LogitsProcessorList flow): a processor that
+    bans a token must keep it out of greedy output, and the banned-free run
+    must match HF with the same ban."""
+    import torch
+    from transformers import LlamaConfig  # noqa: F401 (env check)
+    from transformers.generation.logits_process import SuppressTokensLogitsProcessor
+
+    from tests.integration.test_llama_token_matching import build_app
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path, output_logits=True)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8]], dtype=np.int64)
+
+    # find the token greedy decoding would emit, then ban it
+    base = adapter.generate(prompt, max_new_tokens=1)
+    banned = int(base[0, -1])
+    proc = SuppressTokensLogitsProcessor([banned], device="cpu")
+
+    out = adapter.generate(prompt, max_new_tokens=8, logits_processor=[proc])
+    assert banned not in out[0, prompt.shape[1]:]
+
+    with torch.no_grad():
+        expected = hf_model.generate(
+            torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+            logits_processor=[proc], pad_token_id=0,
+        ).numpy()
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_generation_config_passthrough(tiny_hf_llama, tmp_path):
+    from transformers import GenerationConfig
+
+    from tests.integration.test_llama_token_matching import build_app
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(hf_model, hf_cfg, tmp_path)
+    adapter = HuggingFaceGenerationAdapter(app)
+    prompt = np.array([[5, 9, 3, 17, 2, 8]], dtype=np.int64)
+    gc = GenerationConfig(max_new_tokens=6, do_sample=False)
+    out = adapter.generate(prompt, generation_config=gc)
+    assert out.shape[1] == prompt.shape[1] + 6
