@@ -271,26 +271,19 @@ class HuggingFaceGenerationAdapter:
         scores = scores.numpy()
         if not do_sample:
             return scores.argmax(-1).astype(np.int64)
-        rng = np.random.default_rng(self._seed + self._rng_counter)
-        self._rng_counter += 1
-        scores = scores / max(temperature, 1e-6)
-        if top_k and top_k > 0:
-            kth = np.partition(scores, -top_k, axis=-1)[:, -top_k][:, None]
-            scores = np.where(scores < kth, -np.inf, scores)
-        probs = np.exp(scores - scores.max(-1, keepdims=True))
-        probs = probs / probs.sum(-1, keepdims=True)
-        if top_p < 1.0:
-            order = np.argsort(-probs, axis=-1)
-            sorted_p = np.take_along_axis(probs, order, axis=-1)
-            keep = np.cumsum(sorted_p, axis=-1) - sorted_p < top_p
-            mask = np.zeros_like(probs, dtype=bool)
-            np.put_along_axis(mask, order, keep, axis=-1)
-            probs = np.where(mask, probs, 0.0)
-            probs = probs / probs.sum(-1, keepdims=True)
-        return np.array(
-            [rng.choice(probs.shape[-1], p=probs[b]) for b in range(probs.shape[0])],
-            dtype=np.int64,
+        # ONE sampling semantics: route the processed logits through the same
+        # sampler the compiled programs use (ops/sampling.py)
+        from nxdi_tpu.ops import sampling as sampling_ops
+        from nxdi_tpu.ops.sampling import prepare_sampling_params
+
+        B = scores.shape[0]
+        sp = prepare_sampling_params(
+            B, top_k=[top_k], top_p=[top_p], temperature=[temperature]
         )
+        toks = sampling_ops.sample(
+            scores, sp, rng=self._next_rng(), do_sample=True
+        )
+        return np.asarray(toks).astype(np.int64)
 
     def _assemble(self, input_ids, gen, lengths, pad_token_id) -> np.ndarray:
         """Place generated tokens immediately after each row's true length."""

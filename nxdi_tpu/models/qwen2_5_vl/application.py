@@ -1,0 +1,41 @@
+"""Qwen2.5-VL application — windowed vision program + M-RoPE threading
+(reference: contrib Qwen2.5-VL; shares the qwen2_vl app flow)."""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import numpy as np
+
+from nxdi_tpu.models.qwen2_5_vl import modeling_qwen2_5_vl as mq
+from nxdi_tpu.models.qwen2_vl.application import Qwen2VLApplication
+
+
+class Qwen25VLApplication(Qwen2VLApplication):
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("model_family", mq)
+        super().__init__(*args, **kwargs)
+
+    def encode_images(self, pixel_values, image_grid_thw):
+        varch = mq.build_vision_arch(self.config)
+        grid = tuple(tuple(int(x) for x in g) for g in np.asarray(image_grid_thw))
+        if grid not in self._vision_jit:
+            self._vision_jit[grid] = jax.jit(
+                partial(mq.vision_forward, varch), static_argnums=()
+            )
+        perm, win_seg, img_seg = mq.window_order(varch, grid)
+        phases = mq.vision_rot_table_perm(varch, grid, perm)
+        layer_full = np.array(
+            [i in varch.fullatt_indexes for i in range(varch.depth)], bool
+        )
+        with jax.set_mesh(self.mesh):
+            return self._vision_jit[grid](
+                {"vision": self.params["vision"], "merger": self.params["merger"]},
+                np.asarray(pixel_values, np.float32),
+                perm,
+                phases,
+                win_seg,
+                img_seg,
+                layer_full,
+            )

@@ -1,0 +1,278 @@
+"""Qwen2.5-VL — qwen2-vl M-RoPE text decoder + WINDOWED-attention ViT.
+
+Reference: contrib/models/Qwen2.5-VL-* (community hub). Deltas vs qwen2-vl,
+all in the vision tower (HF ``Qwen2_5_VisionTransformerPretrainedModel``):
+  - RMSNorm block norms and a gated (SwiGLU) vision MLP with biases;
+  - WINDOW attention: patches permuted into window-contiguous order
+    (``get_window_index``), most layers attend within their window segment,
+    ``fullatt_block_indexes`` layers attend the whole image; features are
+    un-permuted after the merger.
+The window permutation, both segment-id vectors, and the (permuted) 2-D rope
+table are tiny host-side numpy per image grid — static per compiled program,
+exactly like qwen2-vl's tables. The text side (M-RoPE llama/qwen2 decoder and
+the host 3-D rope index) is shared with qwen2_vl verbatim.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.qwen2_vl.modeling_qwen2_vl import (  # shared text-side pieces
+    Qwen2VLInferenceConfig,
+    build_arch,
+    build_inv_freq,
+    convert_hf_state_dict,
+    get_rope_index,
+    num_image_tokens,
+    param_shape_struct,
+    param_specs,
+)
+
+__all__ = [
+    "Qwen2_5_VLInferenceConfig", "build_arch", "build_inv_freq",
+    "convert_hf_state_dict", "param_specs", "param_shape_struct",
+    "get_rope_index", "num_image_tokens",
+]
+
+
+class Qwen2_5_VLInferenceConfig(Qwen2VLInferenceConfig):
+    pass
+
+
+@dataclass(frozen=True)
+class Qwen25VLVisionArch:
+    embed_dim: int  # vision_config.hidden_size
+    depth: int
+    num_heads: int
+    intermediate_size: int
+    patch_size: int
+    temporal_patch_size: int
+    in_channels: int
+    spatial_merge_size: int
+    out_hidden: int
+    window_size: int
+    fullatt_indexes: Tuple[int, ...]
+    hidden_act: str = "silu"
+
+    @property
+    def head_dim(self) -> int:
+        return self.embed_dim // self.num_heads
+
+
+def build_vision_arch(config: InferenceConfig) -> Qwen25VLVisionArch:
+    vc = config.vision_config
+    return Qwen25VLVisionArch(
+        embed_dim=vc["hidden_size"],
+        depth=vc["depth"],
+        num_heads=vc["num_heads"],
+        intermediate_size=vc["intermediate_size"],
+        patch_size=vc["patch_size"],
+        temporal_patch_size=vc.get("temporal_patch_size", 2),
+        in_channels=vc.get("in_channels", 3),
+        spatial_merge_size=vc.get("spatial_merge_size", 2),
+        out_hidden=vc["out_hidden_size"],
+        window_size=vc["window_size"],
+        fullatt_indexes=tuple(vc["fullatt_block_indexes"]),
+        hidden_act=vc.get("hidden_act", "silu"),
+    )
+
+
+def window_order(varch: Qwen25VLVisionArch, grid_thw):
+    """Host: (perm over merge-groups, window segment ids per PATCH in the
+    permuted order, image segment ids per patch in the permuted order) —
+    HF get_window_index semantics, with padded window cells dropped."""
+    m = varch.spatial_merge_size
+    vit_win = varch.window_size // m // varch.patch_size
+    perm = []
+    win_seg = []
+    img_seg = []
+    base = 0
+    wid = 0
+    for img_i, (t, h, w) in enumerate(grid_thw):
+        t, h, w = int(t), int(h), int(w)
+        gh, gw = h // m, w // m
+        idx = np.arange(gh * gw).reshape(gh, gw)
+        pad_h = (-gh) % vit_win
+        pad_w = (-gw) % vit_win
+        padded = np.full((gh + pad_h, gw + pad_w), -1, np.int64)
+        padded[:gh, :gw] = idx
+        nwh, nww = (gh + pad_h) // vit_win, (gw + pad_w) // vit_win
+        padded = padded.reshape(nwh, vit_win, nww, vit_win).transpose(0, 2, 1, 3)
+        for win in padded.reshape(-1, vit_win * vit_win):
+            cells = win[win >= 0]
+            if len(cells) == 0:
+                continue
+            perm.extend((cells + base).tolist())
+            win_seg.extend([wid] * (len(cells) * m * m))
+            img_seg.extend([img_i] * (len(cells) * m * m))
+            wid += 1
+        base += gh * gw
+    return (
+        np.asarray(perm, np.int64),
+        np.asarray(win_seg, np.int32),
+        np.asarray(img_seg, np.int32),
+    )
+
+
+def vision_rot_table_perm(varch, grid_thw, perm):
+    """(N, head_dim) rope phases in the WINDOW-permuted patch order."""
+    from nxdi_tpu.models.qwen2_vl.modeling_qwen2_vl import vision_rot_table
+
+    class _V:  # duck-typed view for the shared table builder
+        spatial_merge_size = varch.spatial_merge_size
+        head_dim = varch.head_dim
+
+    tab = vision_rot_table(_V, grid_thw)  # (N, head_dim), merge-group order
+    m2 = varch.spatial_merge_size ** 2
+    tab = tab.reshape(-1, m2, tab.shape[-1])[perm].reshape(-1, tab.shape[-1])
+    return tab
+
+
+def vision_forward(
+    varch: Qwen25VLVisionArch,
+    params: Dict[str, Any],
+    patches,  # (N, C*Tp*P*P) in the ORIGINAL processor order
+    perm,  # (N/m2,) window permutation over merge groups
+    phases,  # (N, head_dim) rope table, permuted order
+    win_seg,  # (N,) window segment id per permuted patch
+    img_seg,  # (N,) image segment id per permuted patch
+    layer_full,  # (depth,) bool: layer attends image-wide
+):
+    from nxdi_tpu.models.base import ACT_FNS
+
+    v = params["vision"]
+    nh, d = varch.num_heads, varch.head_dim
+    E = varch.embed_dim
+    m2 = varch.spatial_merge_size ** 2
+    h = patches @ v["patch_embedding"]
+    N = h.shape[0]
+    h = h.reshape(N // m2, m2, E)[perm].reshape(N, E)  # window order
+
+    cos = jnp.cos(phases)[:, None, :]
+    sin = jnp.sin(phases)[:, None, :]
+    win_mask = win_seg[:, None] == win_seg[None, :]
+    img_mask = img_seg[:, None] == img_seg[None, :]
+    act = ACT_FNS[varch.hidden_act]
+
+    def rms(x, w):
+        xf = x.astype(jnp.float32)
+        return (xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + 1e-6)).astype(x.dtype) * w
+
+    def rot(x):
+        half = x.shape[-1] // 2
+        return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+    def body(carry, xs):
+        lp, full = xs
+        mask = jnp.where(full, img_mask, win_mask)
+        y = rms(carry, lp["norm1"])
+        qkv = y @ lp["qkv"]["w"] + lp["qkv"]["b"]
+        q, k, val = jnp.split(qkv.reshape(N, 3, nh, d), 3, axis=1)
+        q, k, val = q[:, 0], k[:, 0], val[:, 0]
+        qf, kf = q.astype(jnp.float32), k.astype(jnp.float32)
+        q = qf * cos + rot(qf) * sin
+        k = kf * cos + rot(kf) * sin
+        s = jnp.einsum("qhd,khd->hqk", q, k, preferred_element_type=jnp.float32)
+        s = s * (d ** -0.5)
+        s = jnp.where(mask[None], s, -3.4028235e38)
+        w = jax.nn.softmax(s, axis=-1).astype(val.dtype)
+        attn = jnp.einsum("hqk,khd->qhd", w, val).reshape(N, nh * d)
+        carry = carry + attn @ lp["proj"]["w"] + lp["proj"]["b"]
+        y = rms(carry, lp["norm2"])
+        gate = act(y @ lp["gate_proj"]["w"] + lp["gate_proj"]["b"])
+        up = y @ lp["up_proj"]["w"] + lp["up_proj"]["b"]
+        ff = (gate * up) @ lp["down_proj"]["w"] + lp["down_proj"]["b"]
+        return carry + ff, None
+
+    h, _ = jax.lax.scan(body, h, (v["blocks"], jnp.asarray(layer_full)))
+
+    mg = params["merger"]
+    h = rms(h, mg["ln_q"])
+    h = h.reshape(N // m2, m2 * E)
+    h = jax.nn.gelu(h @ mg["fc1"]["w"] + mg["fc1"]["b"], approximate=False)
+    h = h @ mg["fc2"]["w"] + mg["fc2"]["b"]  # (N/m2, out) in window order
+    inv = jnp.argsort(jnp.asarray(perm))
+    return h[inv]
+
+
+encode_images = vision_forward  # family-protocol presence
+
+
+def convert_vision_params(state_dict, config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in (f"model.visual.{name}", f"visual.{name}"):
+            if k in state_dict:
+                return state_dict[k]
+        raise KeyError(f"missing vision weight {name}")
+
+    f32 = lambda x: np.asarray(x, np.float32)  # noqa: E731
+    conv = get("patch_embed.proj.weight")
+    blocks = []
+    for i in range(varch.depth):
+        p = f"blocks.{i}."
+        blocks.append({
+            "norm1": f32(get(p + "norm1.weight")),
+            "norm2": f32(get(p + "norm2.weight")),
+            "qkv": {"w": f32(get(p + "attn.qkv.weight").T), "b": f32(get(p + "attn.qkv.bias"))},
+            "proj": {"w": f32(get(p + "attn.proj.weight").T), "b": f32(get(p + "attn.proj.bias"))},
+            "gate_proj": {"w": f32(get(p + "mlp.gate_proj.weight").T), "b": f32(get(p + "mlp.gate_proj.bias"))},
+            "up_proj": {"w": f32(get(p + "mlp.up_proj.weight").T), "b": f32(get(p + "mlp.up_proj.bias"))},
+            "down_proj": {"w": f32(get(p + "mlp.down_proj.weight").T), "b": f32(get(p + "mlp.down_proj.bias"))},
+        })
+    return {
+        "vision": {
+            "patch_embedding": f32(conv.reshape(varch.embed_dim, -1).T),
+            "blocks": dense.tree_stack(blocks),
+        },
+        "merger": {
+            "ln_q": f32(get("merger.ln_q.weight")),
+            "fc1": {"w": f32(get("merger.mlp.0.weight").T), "b": f32(get("merger.mlp.0.bias"))},
+            "fc2": {"w": f32(get("merger.mlp.2.weight").T), "b": f32(get("merger.mlp.2.bias"))},
+        },
+    }
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    E, I, L = varch.embed_dim, varch.intermediate_size, varch.depth
+    P2 = varch.in_channels * varch.temporal_patch_size * varch.patch_size ** 2
+    m2E = varch.spatial_merge_size ** 2 * E
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    return {
+        "vision": {
+            "patch_embedding": s(P2, E),
+            "blocks": {
+                "norm1": s(L, E),
+                "norm2": s(L, E),
+                "qkv": {"w": s(L, E, 3 * E), "b": s(L, 3 * E)},
+                "proj": {"w": s(L, E, E), "b": s(L, E)},
+                "gate_proj": {"w": s(L, E, I), "b": s(L, I)},
+                "up_proj": {"w": s(L, E, I), "b": s(L, I)},
+                "down_proj": {"w": s(L, I, E), "b": s(L, E)},
+            },
+        },
+        "merger": {
+            "ln_q": s(E),
+            "fc1": {"w": s(m2E, m2E), "b": s(m2E)},
+            "fc2": {"w": s(m2E, varch.out_hidden), "b": s(varch.out_hidden)},
+        },
+    }
+
+
+class Qwen2_5_VLForConditionalGeneration:
+    def __new__(cls, *args, **kwargs):
+        from nxdi_tpu.models.qwen2_5_vl.application import Qwen25VLApplication
+
+        return Qwen25VLApplication(*args, **kwargs)

@@ -30,6 +30,7 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "mllama": ("nxdi_tpu.models.mllama.modeling_mllama", "MllamaInferenceConfig"),
     "qwen2_vl": ("nxdi_tpu.models.qwen2_vl.modeling_qwen2_vl", "Qwen2VLInferenceConfig"),
     "qwen3_vl": ("nxdi_tpu.models.qwen3_vl.modeling_qwen3_vl", "Qwen3VLInferenceConfig"),
+    "qwen2_5_vl": ("nxdi_tpu.models.qwen2_5_vl.modeling_qwen2_5_vl", "Qwen2_5_VLInferenceConfig"),
     "minimax_m2": ("nxdi_tpu.models.minimax_m2.modeling_minimax_m2", "MiniMaxM2InferenceConfig"),
     "mimo_v2": ("nxdi_tpu.models.mimo_v2.modeling_mimo_v2", "MiMoV2InferenceConfig"),
     "olmo2": ("nxdi_tpu.models.olmo2.modeling_olmo2", "Olmo2InferenceConfig"),

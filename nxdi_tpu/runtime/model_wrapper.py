@@ -241,7 +241,13 @@ class ModelWrapper:
         # it forward with zero copies in steady state.
         from jax.experimental.layout import Format, Layout
 
-        auto = jax.tree_util.tree_map(lambda _: Format(Layout.AUTO), cache_shardings)
+        # AUTO layout, PINNED sharding: the sharding invariant must survive
+        # the donated round-trip (a drifting output sharding breaks aliasing
+        # and re-triggers per-step relayouts — seen with the qwen3_next conv
+        # state); only the memory layout is left to the compiler
+        auto = jax.tree_util.tree_map(
+            lambda sh: Format(Layout.AUTO, sh), cache_shardings
+        )
         jitted = jax.jit(
             fn,
             in_shardings=(None, auto, batch_shardings),

@@ -1,0 +1,116 @@
+"""Module/function build-and-validate harness.
+
+Reference: utils/testing.py:67-230 (``build_function`` / ``build_module`` /
+``validate_accuracy``): compile a single function or parameterized module the
+same way the full runtime would (sharded params over a mesh, jitted per
+example-input signature) and compare its outputs against a CPU callable or
+precomputed goldens — the unit-level analog of the application accuracy
+flows (utils/accuracy.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+
+def rand_weights(struct, seed: int = 0, scale: float = 0.05):
+    """Random params matching a ShapeDtypeStruct pytree (reference:
+    _get_rand_weights testing.py:358)."""
+    rng = np.random.default_rng(seed)
+    return jax.tree_util.tree_map(
+        lambda s: (rng.standard_normal(s.shape) * scale).astype(s.dtype), struct
+    )
+
+
+def build_function(
+    fn: Callable,
+    tp_degree: int = 1,
+    static_argnums: Sequence[int] = (),
+):
+    """Jit a pure function under a tp-degree mesh (reference: build_function
+    testing.py:123). Returns a callable; tracing happens per input signature
+    like the runtime's bucket programs."""
+    from nxdi_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(tp_degree=tp_degree)
+    jitted = jax.jit(fn, static_argnums=tuple(static_argnums))
+
+    def run(*args):
+        with jax.set_mesh(mesh):
+            return jitted(*args)
+
+    run.mesh = mesh
+    return run
+
+
+def build_module(
+    fn: Callable,  # fn(params, *inputs)
+    params,
+    param_specs=None,
+    tp_degree: int = 1,
+):
+    """Compile a parameterized module the way the runtime does: params
+    sharded by their PartitionSpecs over a tp mesh, function jitted over them
+    (reference: build_module testing.py:174 — trace a module with sharded
+    weights). ``param_specs`` defaults to fully replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.parallel.layers import shard_pytree
+    from nxdi_tpu.parallel.mesh import build_mesh
+
+    mesh = build_mesh(tp_degree=tp_degree)
+    if param_specs is None:
+        param_specs = jax.tree_util.tree_map(lambda _: P(), params)
+    sharded = shard_pytree(params, param_specs, mesh)
+    jitted = jax.jit(fn)
+
+    def run(*inputs):
+        with jax.set_mesh(mesh):
+            return jitted(sharded, *inputs)
+
+    run.mesh = mesh
+    run.params = sharded
+    return run
+
+
+def validate_accuracy(
+    compiled: Callable,
+    inputs: List[Tuple],
+    expected_outputs: Optional[List] = None,
+    cpu_callable: Optional[Callable] = None,
+    rtol: float = 1e-5,
+    atol: float = 1e-5,
+) -> None:
+    """Run ``compiled`` on every input tuple and assert closeness against the
+    goldens and/or the CPU callable (reference: validate_accuracy
+    testing.py:67 — including its golden-vs-cpu cross-check)."""
+    if expected_outputs is None and cpu_callable is None:
+        raise ValueError("Provide expected_outputs or a cpu_callable")
+    if not isinstance(inputs, list) or not inputs:
+        raise ValueError("inputs must be a non-empty list of arg tuples")
+    if expected_outputs is None:
+        expected_outputs = [None] * len(inputs)
+    if len(expected_outputs) != len(inputs):
+        raise ValueError("len(expected_outputs) must match len(inputs)")
+
+    def assert_close(a, b, msg):
+        jax.tree_util.tree_map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x, np.float32), np.asarray(y, np.float32),
+                rtol=rtol, atol=atol, err_msg=msg,
+            ),
+            a, b,
+        )
+
+    for i, (args, expected) in enumerate(zip(inputs, expected_outputs)):
+        if cpu_callable is not None:
+            cpu_out = cpu_callable(*args)
+            if expected is not None:
+                assert_close(expected, cpu_out, f"input {i}: golden vs cpu")
+            else:
+                expected = cpu_out
+        actual = compiled(*args)
+        assert_close(expected, actual, f"input {i}: expected vs compiled")

@@ -1,0 +1,123 @@
+"""Qwen2.5-VL token matching vs HF CPU — windowed vision attention + RMSNorm
+gated-MLP blocks on top of the shared M-RoPE text stack (reference: contrib
+Qwen2.5-VL models)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.qwen2_5_vl import modeling_qwen2_5_vl as mq
+
+IMG, VIS_START, VIDEO = 250, 249, 248
+
+
+@pytest.fixture
+def tiny_hf_qwen25vl():
+    from transformers import Qwen2_5_VLConfig, Qwen2_5_VLForConditionalGeneration
+
+    torch.manual_seed(0)
+    cfg = Qwen2_5_VLConfig(
+        text_config=dict(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rope_theta=10000.0,
+            rope_scaling={"type": "mrope", "mrope_section": [2, 3, 3]},
+            tie_word_embeddings=False,
+            bos_token_id=1,
+            eos_token_id=2,
+            pad_token_id=0,
+        ),
+        vision_config=dict(
+            hidden_size=32,
+            depth=3,
+            num_heads=4,
+            intermediate_size=64,
+            patch_size=4,
+            temporal_patch_size=1,
+            in_channels=3,
+            spatial_merge_size=2,
+            out_hidden_size=64,
+            window_size=16,  # 2 merge-groups per window side
+            fullatt_block_indexes=[1],
+        ),
+        image_token_id=IMG,
+        video_token_id=VIDEO,
+        vision_start_token_id=VIS_START,
+    )
+    model = Qwen2_5_VLForConditionalGeneration(cfg).eval()
+    return model, cfg
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_qwen2_5_vl_token_matching(tiny_hf_qwen25vl, tp_degree):
+    hf_model, hf_cfg = tiny_hf_qwen25vl
+    rng = np.random.default_rng(0)
+    B = 2
+    # 5x7 merged grid per image (10x14 patches): NOT divisible by the
+    # 2-group window side, so the padded/dropped-cell branch of the window
+    # permutation is genuinely exercised
+    grid = np.array([[1, 10, 14], [1, 10, 14]], np.int64)
+    n_patches = int(grid.prod(axis=1).sum())
+    pixel = rng.standard_normal((n_patches, 3 * 1 * 4 * 4)).astype(np.float32)
+    n_tok = 35  # merged tokens per image (5x7)
+    prompts = np.concatenate(
+        [
+            np.array([[VIS_START]] * B),
+            np.full((B, n_tok), IMG),
+            np.array([[5, 9, 3], [7, 13, 21]]),
+        ],
+        axis=1,
+    ).astype(np.int64)
+    S = prompts.shape[1]
+    n_new = 8
+
+    with torch.no_grad():
+        expected = hf_model.generate(
+            input_ids=torch.tensor(prompts),
+            attention_mask=torch.ones_like(torch.tensor(prompts)),
+            pixel_values=torch.tensor(pixel),
+            image_grid_thw=torch.tensor(grid),
+            max_new_tokens=n_new,
+            do_sample=False,
+        ).numpy()[:, S:]
+
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = mq.Qwen2_5_VLInferenceConfig(
+        TpuConfig(
+            tp_degree=tp_degree,
+            seq_len=96,
+            max_context_length=64,
+            batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+        ),
+        load_config=lambda: hf_cfg.to_dict(),
+    )
+    app = mq.Qwen2_5_VLForConditionalGeneration("<memory>", cfg)
+    app.get_state_dict = lambda: sd
+    app.load()
+
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(
+        prompts.astype(np.int32),
+        pos,
+        pixel_values=pixel,
+        image_grid_thw=grid,
+        last_token_index=np.full((B,), S - 1, np.int32),
+    )
+    got = [np.asarray(out["tokens"])[:, 0]]
+    for step in range(n_new - 1):
+        p = S + step
+        out = app.forward(
+            got[-1][:, None].astype(np.int32), np.full((B, 1), p, np.int32)
+        )
+        got.append(np.asarray(out["tokens"])[:, 0])
+    actual = np.stack(got, axis=1)
+    np.testing.assert_array_equal(actual, expected)

@@ -1,0 +1,54 @@
+"""Build/validate harness (reference: utils/testing.py build_function /
+build_module / validate_accuracy)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from nxdi_tpu.utils.testing import (
+    build_function,
+    build_module,
+    rand_weights,
+    validate_accuracy,
+)
+
+
+def test_build_function_matches_numpy():
+    fn = build_function(lambda x, y: jnp.tanh(x) @ y, tp_degree=1)
+    x = np.random.default_rng(0).standard_normal((4, 8)).astype(np.float32)
+    y = np.random.default_rng(1).standard_normal((8, 3)).astype(np.float32)
+    validate_accuracy(
+        fn, [(x, y)], cpu_callable=lambda x, y: np.tanh(x) @ y, atol=1e-5
+    )
+
+
+def test_build_module_sharded_params_match():
+    struct = {
+        "w1": jax.ShapeDtypeStruct((16, 32), np.float32),
+        "w2": jax.ShapeDtypeStruct((32, 16), np.float32),
+    }
+    params = rand_weights(struct, seed=3)
+    specs = {"w1": P(None, ("ep", "tp")), "w2": P(("ep", "tp"), None)}
+
+    def fn(p, x):
+        return jax.nn.relu(x @ p["w1"]) @ p["w2"]
+
+    mod = build_module(fn, params, param_specs=specs, tp_degree=8)
+    x = np.random.default_rng(4).standard_normal((2, 16)).astype(np.float32)
+
+    def cpu(x):
+        return np.maximum(x @ params["w1"], 0) @ params["w2"]
+
+    validate_accuracy(mod, [(x,)], cpu_callable=cpu, atol=1e-4)
+
+
+def test_validate_accuracy_flags_divergence():
+    fn = build_function(lambda x: x * 2.0)
+    x = np.ones((3,), np.float32)
+    with pytest.raises(AssertionError):
+        validate_accuracy(fn, [(x,)], expected_outputs=[x * 3.0])
+    with pytest.raises(ValueError):
+        validate_accuracy(fn, [(x,)])
