@@ -208,19 +208,57 @@ class ContiguousKVLayout:
         kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
         return kk, vv, kv_pos
 
-    def commit_rows(self, cache, k_rows, v_rows, cache_inputs, spec):
-        """Deferred-write commit: scatter the per-layer fresh K/V rows
-        (L, B, KV, S_act, D) into the FULL stacked cache in one in-place op.
+    def commit_rows(self, cache, k_rows, v_rows, cache_inputs, spec, policy=None):
+        """Deferred-write commit: land the per-layer fresh K/V rows
+        (L, B, KV, S_act, D) in the FULL stacked cache in one in-place op.
 
         The decode hot path cannot afford carrying cache slices through the
         layer scan as xs/ys — XLA round-trips the whole cache per layer
         (measured ~6x the pure-attention cost). Instead the scan emits only
         the new rows and attention reads the OLD cache with the written slots
         masked + fresh rows appended (models/base.py attention_block
-        ``defer_write``); this commit is the single full-cache touch."""
+        ``defer_write``); this commit is the single full-cache touch.
+
+        Single-row commits (plain TKG decode) go through the Pallas in-place
+        commit kernel (ops/kernels/kv_commit.py): XLA's TPU scatter lowering
+        costs 8-14 ms at decode shapes (full-cache copies around the
+        scatter), the kernel ~2 ms. Multi-row (speculation windows) and
+        exotic shardings keep the jnp scatter."""
         position_ids = cache_inputs.get("write_positions", cache_inputs["position_ids"])
         S = cache["k"].shape[3]
-        pos = jnp.where(position_ids < 0, S, position_ids).astype(jnp.int32)  # (B, S_act)
+        raw_pos = position_ids.astype(jnp.int32)  # (B, S_act); <0 = drop
+
+        def scaled(rows, scale, store):
+            if scale != 1.0:
+                rows = rows / jnp.asarray(scale, rows.dtype)
+            return rows.astype(store)
+
+        from nxdi_tpu.ops.kernels import kv_commit
+
+        if kv_commit.commit_rows_supported(
+            cache["k"].shape, cache["v"].shape, k_rows.shape, v_rows.shape
+        ):
+            seq_ids = (
+                cache_inputs["seq_ids"] if self.route_by_seq_id else None
+            )
+            if policy is not None:
+                ck = policy.cache_kv
+                pspec = P(None, ck[0], ck[1], ck[2], None)
+            else:
+                pspec = P(None, None, AXIS_MP, None, None)
+            committed = kv_commit.sharded_commit_call(
+                pspec,
+                cache["k"],
+                cache["v"],
+                scaled(k_rows, self.k_scale, cache["k"].dtype),
+                scaled(v_rows, self.v_scale, cache["v"].dtype),
+                raw_pos,
+                seq_ids,
+            )
+            if committed is not None:
+                return {"k": committed[0], "v": committed[1]}
+
+        pos = jnp.where(raw_pos < 0, S, raw_pos)  # OOB -> dropped by scatter
         B = pos.shape[0]
         if self.route_by_seq_id:
             b_idx = cache_inputs["seq_ids"].astype(jnp.int32)[:, None]
@@ -228,9 +266,7 @@ class ContiguousKVLayout:
             b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
 
         def put(cache_arr, rows, scale):
-            if scale != 1.0:
-                rows = rows / jnp.asarray(scale, rows.dtype)
-            vals = rows.astype(cache_arr.dtype).swapaxes(2, 3)  # (L,B,S,KV,D)
+            vals = scaled(rows, scale, cache_arr.dtype).swapaxes(2, 3)  # (L,B,S,KV,D)
 
             def per_layer(cl, rl):  # (B,KV,S,D), (B,S,KV,D)
                 return cl.at[b_idx, :, pos].set(rl, mode="drop")

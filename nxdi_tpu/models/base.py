@@ -916,7 +916,7 @@ def run_decoder_layers(
         ci_commit = dict(cache_inputs or {})
         ci_commit["position_ids"] = position_ids
         new_cache = layout.commit_rows(
-            cache, cat(ks), cat(vs), ci_commit, cache_spec
+            cache, cat(ks), cat(vs), ci_commit, cache_spec, policy=policy
         )
     else:
         new_cache = {"k": cat(ks), "v": cat(vs)}

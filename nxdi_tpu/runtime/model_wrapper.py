@@ -444,9 +444,18 @@ class ModelWrapper:
         block_kv_cache_manager.py:376 generate_tokengen_slot_mapping)."""
         extra: Dict[str, np.ndarray] = {}
         if getattr(self.layout, "route_by_seq_id", False):
-            extra["seq_ids"] = np.asarray(
-                batch_np.get("seq_ids", np.arange(b)), dtype=np.int32
-            )
+            sids = np.asarray(batch_np.get("seq_ids", np.arange(b)), dtype=np.int32)
+            cb = self.config.tpu_config.max_batch_size
+            if sids.min(initial=0) < 0 or sids.max(initial=0) >= cb:
+                # loud host-side gate: an out-of-range seq_id would route a
+                # cache write to a clipped line on device (the commit kernel
+                # drops it, but a stale-window race with a legit write to the
+                # same line is then possible — keep it impossible instead)
+                raise ValueError(
+                    f"{self.tag}: seq_ids must lie in [0, {cb}); got "
+                    f"{sids.tolist()}"
+                )
+            extra["seq_ids"] = sids
         elif isinstance(self.layout, BlockKVLayout):
             bs = self.layout.block_size
             width = self._block_table_width()

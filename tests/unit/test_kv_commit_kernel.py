@@ -1,0 +1,105 @@
+"""Commit-kernel semantics vs the jnp scatter path (CPU interpreter).
+
+The Pallas in-place commit (ops/kernels/kv_commit.py) must be a pure
+optimization of ContiguousKVLayout.commit_rows' scatter — same bytes for
+in-range slots, drops for negative slots, seq-id routing (reference:
+kv_cache_manager.py:374 update_cache scatter semantics).
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from nxdi_tpu.ops.kernels.kv_commit import commit_rows_supported, kv_commit_rows
+
+L, B, KV, S, D = 3, 4, 2, 128, 16
+
+
+def _golden(cache, rows, pos, b_idx):
+    p = jnp.where(pos < 0, S, pos)
+    vals = rows.swapaxes(2, 3)
+
+    def per_layer(cl, rl):
+        return cl.at[b_idx, :, p].set(rl, mode="drop")
+
+    return jax.vmap(per_layer)(cache, vals)
+
+
+def _mk(seed=0):
+    rng = np.random.default_rng(seed)
+    kc = jnp.asarray(rng.standard_normal((L, B, KV, S, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((L, B, KV, S, D)), jnp.bfloat16)
+    kr = jnp.asarray(rng.standard_normal((L, B, KV, 1, D)), jnp.bfloat16)
+    vr = jnp.asarray(rng.standard_normal((L, B, KV, 1, D)), jnp.bfloat16)
+    pos = jnp.asarray(rng.integers(0, S, size=(B, 1)), jnp.int32)
+    return kc, vc, kr, vr, pos
+
+
+def test_supported_gate():
+    c = (L, B, KV, S, D)
+    assert commit_rows_supported(c, c, (L, B, KV, 1, D), (L, B, KV, 1, D))
+    # T > 1 (speculation windows) stays on the scatter path
+    assert not commit_rows_supported(c, c, (L, B, KV, 2, D), (L, B, KV, 2, D))
+    # head-count mismatch
+    assert not commit_rows_supported(c, c, (L, B, KV + 1, 1, D), (L, B, KV + 1, 1, D))
+    # k/v cache disagreement (everything but Dv must match)
+    assert not commit_rows_supported(
+        c, (L, B, KV, S // 2, D), (L, B, KV, 1, D), (L, B, KV, 1, D)
+    )
+
+
+def test_commit_matches_scatter():
+    kc, vc, kr, vr, pos = _mk()
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    ok, ov = kv_commit_rows(kc, vc, kr, vr, pos)
+    assert jnp.array_equal(ok, _golden(kc, kr, pos, b_idx))
+    assert jnp.array_equal(ov, _golden(vc, vr, pos, b_idx))
+
+
+def test_negative_slot_drops():
+    kc, vc, kr, vr, pos = _mk(1)
+    pos = pos.at[1, 0].set(-1)
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    ok, _ = kv_commit_rows(kc, vc, kr, vr, pos)
+    assert jnp.array_equal(ok, _golden(kc, kr, pos, b_idx))
+    # row 1 untouched everywhere
+    assert jnp.array_equal(ok[:, 1], kc[:, 1])
+
+
+def test_seq_id_routing():
+    kc, vc, kr, vr, pos = _mk(2)
+    sids = jnp.asarray([2, 0, 3, 1], jnp.int32)
+    ok, ov = kv_commit_rows(kc, vc, kr, vr, pos, sids)
+    assert jnp.array_equal(ok, _golden(kc, kr, pos, sids[:, None]))
+    assert jnp.array_equal(ov, _golden(vc, vr, pos, sids[:, None]))
+
+
+def test_out_of_range_seq_id_drops_alone():
+    # an out-of-range seq_id drops its row. Only the dropped lane is present
+    # (the kernel contract forbids an invalid lane COLLIDING with a valid
+    # write's window — the host-side wrapper gate enforces in-range seq_ids
+    # in production; see kv_commit.py docstring)
+    kc, vc, kr, vr, pos = _mk(4)
+    # valid lanes route to lines 2 and 1; invalid lanes clamp-address line 0,
+    # which no valid lane writes, so the drop cannot clobber anything
+    sids = jnp.asarray([2, -1, B + 3, 1], jnp.int32)
+    ok, ov = kv_commit_rows(kc, vc, kr, vr, pos, sids)
+    golden_sids = jnp.asarray([2, B, B, 1], jnp.int32)  # OOB -> dropped
+    assert jnp.array_equal(ok, _golden(kc, kr, pos, golden_sids[:, None]))
+    assert jnp.array_equal(ov, _golden(vc, vr, pos, golden_sids[:, None]))
+
+
+def test_distinct_v_head_dim():
+    # mimo-v2 style: v wider than k
+    rng = np.random.default_rng(3)
+    Dv = 32
+    kc = jnp.asarray(rng.standard_normal((L, B, KV, S, D)), jnp.bfloat16)
+    vc = jnp.asarray(rng.standard_normal((L, B, KV, S, Dv)), jnp.bfloat16)
+    kr = jnp.asarray(rng.standard_normal((L, B, KV, 1, D)), jnp.bfloat16)
+    vr = jnp.asarray(rng.standard_normal((L, B, KV, 1, Dv)), jnp.bfloat16)
+    pos = jnp.asarray(rng.integers(0, S, size=(B, 1)), jnp.int32)
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    ok, ov = kv_commit_rows(kc, vc, kr, vr, pos)
+    assert jnp.array_equal(ok, _golden(kc, kr, pos, b_idx))
+    assert jnp.array_equal(ov, _golden(vc, vr, pos, b_idx))
