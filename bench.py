@@ -59,6 +59,13 @@ def main():
         on_device_sampling_config=OnDeviceSamplingConfig(),
         async_mode=True,  # device-resident decode: steps chain on device
         attn_kernel_enabled=True,  # Pallas flash prefill (D=64 Mosaic path)
+        # attn_tkg_kernel_enabled stays OFF: the fused deferred-write decode
+        # kernel (flash_attention_decode_fused) is correct and composes with
+        # the commit kernel, but measured SLOWER here than XLA's two-part
+        # path (17.1 vs 8.7 ms/step): a pallas operand can't fuse with the
+        # layer scan's cache slice (one materialized copy per layer), and at
+        # G=4 grouped queries XLA's VPU decode lowering is already at the
+        # bandwidth roofline. Revisit if XLA stops fusing the slice reads.
         skip_warmup=False,
     )
     cfg = ml.LlamaInferenceConfig(
@@ -138,6 +145,17 @@ def main():
     tkg_p50 = float(np.percentile(per_step_ms, 50))
     tok_s = BATCH / (tkg_p50 / 1000.0)
 
+    # prefill MFU: matmul FLOPs (2*params*tokens, minus the last-token-only
+    # lm_head) + causal attention FLOPs, against the v5e bf16 peak
+    tokens = BATCH * PROMPT_LEN
+    lm_head_params = VOCAB * HIDDEN
+    cte_flops = (
+        2.0 * (param_count - lm_head_params) * tokens
+        + 2.0 * lm_head_params * BATCH
+        + 2.0 * N_LAYERS * N_HEADS * HEAD_DIM * PROMPT_LEN * PROMPT_LEN * BATCH
+    )
+    cte_mfu_pct = cte_flops / 1e12 / V5E_BF16_TFLOPS / (cte_p50 / 1000.0) * 100
+
     # --- roofline accounting (decode step) ---
     param_bytes = 2.0 * param_count
     kv_bytes = 2.0 * N_LAYERS * N_KV_HEADS * HEAD_DIM * SEQ_LEN * 2 * BATCH  # K+V read
@@ -155,6 +173,7 @@ def main():
                 "vs_baseline": round(tok_s / NORTH_STAR_TOK_S_CHIP, 4),
                 "tkg_step_p50_ms": round(tkg_p50, 3),
                 "cte_p50_ms": round(cte_p50, 2),
+                "cte_mfu_pct": round(cte_mfu_pct, 1),
                 "hbm_roofline_pct": round(hbm_pct, 1),
                 "mfu_pct": round(mfu_pct, 1),
                 "config": f"llama3.2-1b full {N_LAYERS}L bf16 bs{BATCH} kv{SEQ_LEN} prompt{PROMPT_LEN} tp1",

@@ -115,6 +115,31 @@ class ChunkedPrefillConfig:
         return dict(self.__dict__)
 
 
+class HybridShardingConfig:
+    """Per-phase hybrid MoE TPxEP regimes (reference: models/config.py:1060
+    ``HybridShardingConfig``). ``moe_cte_ep_degree`` experts-axis width for
+    prefill (TP-heavy), ``moe_tkg_ep_degree`` for decode (EP-heavy); the
+    per-phase moe-tp widths are the world divided by these. The tkg degree
+    must be a multiple of the cte degree (the mesh refines ep into ep x epx)."""
+
+    def __init__(self, **kwargs):
+        self.moe_cte_ep_degree = int(kwargs.pop("moe_cte_ep_degree", 1))
+        self.moe_tkg_ep_degree = int(kwargs.pop("moe_tkg_ep_degree", 1))
+        if kwargs:
+            raise ValueError(f"Unknown HybridShardingConfig args: {sorted(kwargs)}")
+        if self.moe_cte_ep_degree < 1 or self.moe_tkg_ep_degree < 1:
+            raise ValueError("hybrid sharding degrees must be >= 1")
+        if self.moe_tkg_ep_degree % self.moe_cte_ep_degree:
+            raise ValueError(
+                f"moe_tkg_ep_degree ({self.moe_tkg_ep_degree}) must be a "
+                f"multiple of moe_cte_ep_degree ({self.moe_cte_ep_degree}) — "
+                "the mesh refines the cte ep axis into (ep, epx)"
+            )
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
 class SpeculationConfig:
     """Speculative decoding knobs (reference: models/config.py:244-266)."""
 
@@ -333,6 +358,16 @@ class TpuConfig:
         self.ep_degree = kwargs.pop("ep_degree", 1)
         self.moe_tp_degree = kwargs.pop("moe_tp_degree", None)
         self.moe_ep_degree = kwargs.pop("moe_ep_degree", None)
+        # per-phase hybrid MoE sharding (reference: HybridShardingConfig,
+        # models/config.py:1060): prefill compiles TP-heavy (experts over a
+        # small cte-ep axis), decode EP-heavy (experts over cte-ep x epx).
+        # Expert weights are DUPLICATED per regime like the reference's
+        # preshard hook (mlp_op_tkg duplication) — relayout-free at phase
+        # transitions at the cost of one extra per-rank expert shard copy.
+        hsc = kwargs.pop("hybrid_sharding_config", None)
+        if isinstance(hsc, dict):
+            hsc = HybridShardingConfig(**hsc)
+        self.hybrid_sharding_config = hsc
         # "sparse" = ragged_dot grouped matmul over routed tokens (default);
         # "dense" = all experts compute all tokens (reference ExpertMLPs
         # non-blockwise mode; kept as an A/B and debugging fallback)
@@ -445,6 +480,18 @@ class TpuConfig:
                     raise ValueError(
                         f"{name} ({bs}) must be divisible by pp_microbatches ({n_micro})"
                     )
+        if self.hybrid_sharding_config is not None:
+            hsc = self.hybrid_sharding_config
+            if self.moe_ep_degree and self.moe_ep_degree > 1:
+                raise ValueError(
+                    "hybrid_sharding_config replaces moe_ep_degree (the mesh "
+                    "ep/epx axes come from the per-phase degrees)"
+                )
+            if self.tp_degree % hsc.moe_tkg_ep_degree:
+                raise ValueError(
+                    f"moe_tkg_ep_degree ({hsc.moe_tkg_ep_degree}) must divide "
+                    f"tp_degree ({self.tp_degree})"
+                )
         if self.window_sized_kv:
             if not self.sliding_window:
                 raise ValueError(
@@ -512,6 +559,7 @@ class TpuConfig:
         "tensor_capture_config": TensorCaptureConfig,
         "speculation_config": SpeculationConfig,
         "lora_config": LoraServingConfig,
+        "hybrid_sharding_config": HybridShardingConfig,
     }
 
     def to_dict(self) -> Dict[str, Any]:

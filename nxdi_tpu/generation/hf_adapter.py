@@ -187,7 +187,9 @@ class HuggingFaceGenerationAdapter:
         running = input_ids.copy() if logits_processor else None
         if logits_processor:
             next_tokens = self._host_select(
-                outputs, running, logits_processor, do_sample, top_k, top_p, temperature
+                outputs, running, logits_processor, do_sample, top_k, top_p,
+                temperature, lengths=lengths, prompt_width=S,
+                pad_token_id=pad_token_id,
             )
             running = np.concatenate([running, next_tokens[:, None]], axis=1)
         else:
@@ -242,7 +244,8 @@ class HuggingFaceGenerationAdapter:
             if logits_processor:
                 next_tokens = self._host_select(
                     outputs, running, logits_processor, do_sample, top_k, top_p,
-                    temperature,
+                    temperature, lengths=lengths, prompt_width=S,
+                    pad_token_id=pad_token_id,
                 )
                 running = np.concatenate([running, next_tokens[:, None]], axis=1)
             else:
@@ -257,15 +260,33 @@ class HuggingFaceGenerationAdapter:
         return self._assemble(input_ids, gen, lengths, pad_token_id)
 
     def _host_select(
-        self, outputs, running, processors, do_sample, top_k, top_p, temperature
+        self, outputs, running, processors, do_sample, top_k, top_p, temperature,
+        lengths=None, prompt_width=None, pad_token_id=0,
     ) -> np.ndarray:
         """Apply host logits processors, then pick tokens on host (reference:
-        the HF adapter's LogitsProcessorList flow)."""
+        the HF adapter's LogitsProcessorList flow).
+
+        ``running`` is the right-padded prompt with generated tokens appended
+        past ``prompt_width``. Ids-dependent processors (repetition penalty,
+        no-repeat-ngram) must not see pad tokens as context, so each row is
+        rebuilt LEFT-padded from its true length — the layout HF's own
+        generate feeds processors."""
         import torch
 
         logits = np.asarray(outputs["logits"])[:, -1, :].astype(np.float32)
         scores = torch.tensor(logits)
-        ids = torch.tensor(np.asarray(running), dtype=torch.long)
+        running = np.asarray(running)
+        if lengths is not None and prompt_width is not None:
+            B, W = running.shape
+            ids_np = np.full_like(running, pad_token_id)
+            for b in range(B):
+                true = np.concatenate(
+                    [running[b, : lengths[b]], running[b, prompt_width:]]
+                )
+                ids_np[b, W - true.shape[0]:] = true
+        else:
+            ids_np = running
+        ids = torch.tensor(ids_np, dtype=torch.long)
         for proc in processors:
             scores = proc(ids, scores)
         scores = scores.numpy()

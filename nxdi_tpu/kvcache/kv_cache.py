@@ -393,6 +393,58 @@ class WindowKVLayout:
         kv_pos = jnp.where(kv_pos >= 0, kv_pos, jnp.int32(2 ** 30))
         return kk, vv, kv_pos
 
+    def commit_rows(self, cache, k_rows, v_rows, cache_inputs, spec, policy=None):
+        """Deferred-write commit into the ring: the single decode row lands at
+        slot ``pos % W``. Correctness of attending the OLD ring before this
+        commit: the stale row in that slot reports kv_pos == pos (ring math in
+        ``read``), which the deferred poison mask excludes, and its true
+        position pos - W is outside the window anyway. Single-position decode
+        only — speculation windows are rejected at config level."""
+        position_ids = cache_inputs["position_ids"]
+        if position_ids.shape[1] != 1:
+            raise NotImplementedError(
+                "window ring deferred commit is single-position (decode) only"
+            )
+        W = self.window
+        pos = position_ids.astype(jnp.int32)
+        slots = jnp.where(pos >= 0, pos % W, jnp.int32(-1))  # neg = drop
+
+        from nxdi_tpu.ops.kernels import kv_commit
+
+        if kv_commit.commit_rows_supported(
+            cache["k"].shape, cache["v"].shape, k_rows.shape, v_rows.shape
+        ):
+            seq_ids = cache_inputs["seq_ids"] if self.route_by_seq_id else None
+            if policy is not None:
+                ck = policy.cache_kv
+                pspec = P(None, ck[0], ck[1], None, None)
+            else:
+                pspec = P(None, None, AXIS_MP, None, None)
+            store = cache["k"].dtype
+            committed = kv_commit.sharded_commit_call(
+                pspec, cache["k"], cache["v"],
+                k_rows.astype(store), v_rows.astype(store), slots, seq_ids,
+            )
+            if committed is not None:
+                return {"k": committed[0], "v": committed[1]}
+
+        B = slots.shape[0]
+        sl = jnp.where(slots < 0, W, slots)  # OOB -> dropped by scatter
+        if self.route_by_seq_id:
+            b_idx = cache_inputs["seq_ids"].astype(jnp.int32)[:, None]
+        else:
+            b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+
+        def put(cache_arr, rows):
+            vals = rows.astype(cache_arr.dtype).swapaxes(2, 3)  # (L,B,1,KV,D)
+
+            def per_layer(cl, rl):
+                return cl.at[b_idx, :, sl].set(rl, mode="drop")
+
+            return jax.vmap(per_layer)(cache_arr, vals)
+
+        return {"k": put(cache["k"], k_rows), "v": put(cache["v"], v_rows)}
+
 
 DEFAULT_KV_LAYOUT = ContiguousKVLayout()
 

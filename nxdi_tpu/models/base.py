@@ -170,6 +170,25 @@ class DecoderArch:
     # (HF GraniteForCausalLM residual_multiplier / logits_scaling)
     residual_multiplier: float = 1.0
     logits_scaling: float = 1.0
+    # interleaved sliding-window stacks (gpt-oss alternating, gemma3 5-of-6):
+    # per-layer True = sliding-window layer. With window_sized_kv the cache
+    # splits into a full-length stack for False layers and a W-slot ring
+    # stack for True layers (reference: per-layer window-sized cache shapes,
+    # gpt_oss_kv_cache_manager.py, kv_cache_manager.py:195-210); the layer
+    # scan runs over the pattern's repeating unit (run_decoder_layers).
+    kv_window_pattern: Optional[Tuple[bool, ...]] = None
+
+    @property
+    def kv_pattern_period(self) -> int:
+        """Smallest repeating unit of kv_window_pattern (the unit-scan body
+        compiles one decoder block per unit position)."""
+        pat = self.kv_window_pattern
+        assert pat is not None
+        L = len(pat)
+        for p in range(1, L + 1):
+            if L % p == 0 and all(pat[i] == pat[i % p] for i in range(L)):
+                return p
+        return L
 
     def kv_cache_spec(self, batch_size: int, max_len: int, quant_dtype=None) -> KVCacheSpec:
         if self.mla is not None:
@@ -336,8 +355,10 @@ def attention_block(
     round-trips the whole cache per layer), attend over the OLD cache with
     this step's slots masked out plus the fresh rows appended, and return
     only the fresh rows — run_decoder_layers commits them all in ONE scatter
-    on the stacked cache after the scan. Bitwise-equivalent attention inputs;
-    only the softmax summation order differs.
+    on the stacked cache after the scan. Bitwise-equivalent attention inputs
+    (quantized caches round-trip the fresh rows through the store
+    dtype/scale first, matching the non-deferred read-after-write); only the
+    softmax summation order differs.
 
     ``attend_to_cache=False`` (context encoding): queries attend the fresh K/V
     only — O(S^2) not O(S * max_len). ``True`` (decode/speculation): attend the
@@ -438,11 +459,49 @@ def attention_block(
         kk, vv, kv_pos = layout.read(k_cache_l, v_cache_l, ci, cache_spec)
         kk = constrain(kk, policy.cache_kv)
         vv = constrain(vv, policy.cache_kv)
+        store = cache_spec.store_dtype
+        if store != k.dtype or getattr(layout, "k_scale", 1.0) != 1.0:
+            # quantized cache: round-trip the fresh rows through the store
+            # dtype/scale so this step's numerics match the non-deferred
+            # path (which attends the quantize->dequantize'd row) exactly
+            ks, vs = getattr(layout, "k_scale", 1.0), getattr(layout, "v_scale", 1.0)
+            k_att = ((k / ks).astype(store).astype(k.dtype) * ks).astype(k.dtype)
+            v_att = ((v / vs).astype(store).astype(v.dtype) * vs).astype(v.dtype)
+        else:
+            k_att, v_att = k, v
+        # fused TKG kernel: strict-causal online softmax over the old cache
+        # merged with the fresh row in ONE pallas pass — the kernel that
+        # COMPOSES with deferred writes (reference: fused TKG kernels,
+        # attention_base.py:1419-1994); two_part attention is the XLA fallback
+        if (
+            arch.attn_tkg_kernel_enabled
+            and S == 1
+            and isinstance(layout, ContiguousKVLayout)  # ring kv_pos wraps
+            and arch.v_head_dim is None
+            and not arch.attention_sink
+            and arch.attn_logit_softcap is None
+            and window_enabled is None
+            and use_rope is None
+            and ci.get("write_positions") is None
+            and attn_kernels.fused_decode_kernel_supported(q.shape, kk.shape)
+        ):
+            ctx = attn_kernels.sharded_fused_decode_call(
+                policy, q, kk, vv, k_att, v_att, position_ids, kv_pos,
+                scale=arch.attention_scale,
+                sliding_window=arch.sliding_window,
+                chunk_size=arch.chunk_size,
+            )
+            if ctx is not None:
+                ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
+                out = _linear(
+                    ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
+                )
+                return out, (k, v)
         wpos = ci.get("write_positions", position_ids).astype(jnp.int32)
         hit = jnp.any(kv_pos[:, None, :] == wpos[:, :, None], axis=1)
         kv_pos = jnp.where(hit, jnp.int32(2 ** 30), kv_pos)
         ctx = attn_ops.attention_two_part(
-            q, kk, vv, k, v, position_ids, kv_pos, wpos,
+            q, kk, vv, k_att, v_att, position_ids, kv_pos, wpos,
             scale=arch.attention_scale,
             softmax_dtype=jnp.float32,
             sliding_window=arch.sliding_window,
@@ -459,6 +518,42 @@ def attention_block(
     new_k, new_v = layout.update(k_cache_l, v_cache_l, k, v, ci, cache_spec)
 
     if attend_to_cache:
+        # prefix-cache / chunked-prefill CTE through the block table: the
+        # chunk is already scattered into the pool (update above), so the
+        # kernel reads prefix + chunk in token order without materializing
+        # the (B, KV, W, D) gather (reference: NKI block-CTE kernels,
+        # attention_base.py:909,1083)
+        if (
+            isinstance(layout, BlockKVLayout)
+            and arch.v_head_dim is None
+            and arch.attn_kernel_enabled
+            and S > 1
+            and "block_table" in ci
+            and ci.get("attn_mask") is None
+            and ci.get("write_positions") is None
+            and not arch.attention_sink
+            and arch.attn_logit_softcap is None
+            and arch.sliding_window is None
+            and arch.chunk_size is None
+            and window_enabled is None
+            and use_rope is None
+            and attn_kernels.paged_prefill_kernel_supported(
+                q.shape, new_k.shape, layout.block_size
+            )
+        ):
+            ctx = attn_kernels.sharded_paged_prefill_call(
+                policy, q, new_k, new_v, ci["block_table"], position_ids,
+                block_size=layout.block_size,
+                scale=arch.attention_scale,
+                k_scale=layout.k_scale,
+                v_scale=layout.v_scale,
+            )
+            if ctx is not None:
+                ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+                out = _linear(
+                    ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
+                )
+                return out, (new_k, new_v)
         # paged decode: read K/V straight through the block table inside the
         # kernel — skips the materialized O(table-width) gather of
         # BlockKVLayout.read (reference: NKI block-TKG kernel,
@@ -772,6 +867,121 @@ def _pipelined_decoder_layers(
     return hidden_out, {"k": new_k, "v": new_v}
 
 
+def _interleaved_window_scan(
+    arch, layer_params, hidden, cos, sin, cache, position_ids, cache_spec,
+    step_fn, defer, layout, policy, cache_inputs, adapter_ids,
+    collect_hidden, layer_injections,
+):
+    """Unit scan over interleaved full/sliding-window layer stacks.
+
+    TPU-native form of the reference's per-layer window-sized caches
+    (gpt_oss_kv_cache_manager.py [403 LoC]; kv_cache_manager.py:195-210):
+    full-attention layers read/write the full-length ``cache['k']/['v']``
+    stack; sliding-window layers a W-slot ring stack ``['k_win']/['v_win']``
+    (kvcache WindowKVLayout semantics). A single lax.scan cannot carry xs of
+    two different sequence lengths, so the scan runs over the pattern's
+    smallest REPEATING UNIT (gpt-oss [SWA, full] -> period 2; gemma3 5 local
+    + 1 global -> period 6): one compiled body per unit position, L/period
+    scan steps — compile cost grows with the pattern period, not the depth.
+
+    Window kinds are STATIC per unit position, so sliding-window masks
+    compile directly (no traced per-layer flag is needed, though flags
+    riding the params stay correct). Deferred-write decode emits fresh rows
+    per kind; commits land separately (ring rows at slot ``pos % W``).
+    """
+    from nxdi_tpu.kvcache.kv_cache import WindowKVLayout
+
+    pat = arch.kv_window_pattern
+    if pat is None or len(pat) != arch.num_layers:
+        raise ValueError(
+            "cache carries a k_win ring stack but arch.kv_window_pattern is "
+            f"unset or mismatched (pattern {pat}, layers {arch.num_layers})"
+        )
+    if collect_hidden or layer_injections is not None:
+        raise NotImplementedError(
+            "interleaved window-sized KV does not compose with EAGLE3 aux "
+            "taps / tensor capture / deepstack injections"
+        )
+    if isinstance(layer_params, (list, tuple)):
+        raise NotImplementedError(
+            "interleaved window-sized KV requires a homogeneous layer stack"
+        )
+    p = arch.kv_pattern_period
+    U = arch.num_layers // p
+    f_idx = [j for j in range(p) if not pat[j]]
+    w_idx = [j for j in range(p) if pat[j]]
+    assert f_idx and w_idx, "cache split requires both full and window layers"
+    win_layout = WindowKVLayout(
+        window=cache["k_win"].shape[3],
+        route_by_seq_id=getattr(layout, "route_by_seq_id", False),
+    )
+
+    def unit(x):
+        return x.reshape((U, x.shape[0] // U) + x.shape[1:])
+
+    unit_params = jax.tree_util.tree_map(unit, layer_params)
+    kf, vf = unit(cache["k"]), unit(cache["v"])
+    kw, vw = unit(cache["k_win"]), unit(cache["v_win"])
+
+    def unit_body(h, xs):
+        lp_u, kf_u, vf_u, kw_u, vw_u = xs
+        rows_f, rows_w = [], []
+        fi = wi = 0
+        for j in range(p):
+            lp = jax.tree_util.tree_map(lambda x: x[j], lp_u)
+            if pat[j]:
+                h, nk, nv = step_fn(
+                    h, lp, kw_u[wi], vw_u[wi], cos, sin, position_ids,
+                    cache_inputs, adapter_ids,
+                    layout_=win_layout, windowable_=False,
+                )
+                rows_w.append((nk, nv))
+                wi += 1
+            else:
+                h, nk, nv = step_fn(
+                    h, lp, kf_u[fi], vf_u[fi], cos, sin, position_ids,
+                    cache_inputs, adapter_ids,
+                )
+                rows_f.append((nk, nv))
+                fi += 1
+
+        def stack(rows):
+            return (
+                jnp.stack([r[0] for r in rows]),
+                jnp.stack([r[1] for r in rows]),
+            )
+
+        return h, (stack(rows_f), stack(rows_w))
+
+    hidden, ((ys_kf, ys_vf), (ys_kw, ys_vw)) = jax.lax.scan(
+        unit_body, hidden, (unit_params, kf, vf, kw, vw)
+    )
+
+    def flat(y):  # (U, per_unit, ...) -> (L_kind, ...)
+        return y.reshape((-1,) + y.shape[2:])
+
+    if defer:
+        ci_commit = dict(cache_inputs or {})
+        ci_commit["position_ids"] = position_ids
+        full_new = layout.commit_rows(
+            {"k": cache["k"], "v": cache["v"]},
+            flat(ys_kf), flat(ys_vf), ci_commit, cache_spec, policy=policy,
+        )
+        win_new = win_layout.commit_rows(
+            {"k": cache["k_win"], "v": cache["v_win"]},
+            flat(ys_kw), flat(ys_vw), ci_commit, cache_spec, policy=policy,
+        )
+    else:
+        full_new = {"k": flat(ys_kf), "v": flat(ys_vf)}
+        win_new = {"k": flat(ys_kw), "v": flat(ys_vw)}
+    return hidden, {
+        "k": full_new["k"],
+        "v": full_new["v"],
+        "k_win": win_new["k"],
+        "v_win": win_new["v"],
+    }
+
+
 def run_decoder_layers(
     arch: DecoderArch,
     layer_params: Dict[str, Any],  # layer-stacked pytree
@@ -816,22 +1026,28 @@ def run_decoder_layers(
     # rows; they commit in ONE scatter on the stacked cache below — carrying
     # full cache slices through the scan as ys round-trips the whole cache
     # per layer (measured ~6x the pure-attention cost on v5e)
+    # (the TKG kernel no longer disables defer: the fused decode kernel in
+    # attention_block implements two-part attention in one pallas pass, and
+    # ineligible layer shapes fall back to the XLA two_part path per layer)
     defer = (
         attend_to_cache
         and arch.pp_degree == 1
         and arch.mla is None
-        and not arch.attn_tkg_kernel_enabled
         and isinstance(layout, ContiguousKVLayout)
         and (cache_inputs or {}).get("attn_mask") is None
     )
 
-    def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_):
-        """One decoder layer with the bucket's static KV window applied."""
-        if windowable and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
+    def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None, windowable_=None):
+        """One decoder layer with the bucket's static KV window applied.
+        ``layout_``/``windowable_`` override the stack-wide defaults for the
+        interleaved-window unit scan (ring slices use the ring layout)."""
+        lay = layout if layout_ is None else layout_
+        win_ok = windowable if windowable_ is None else windowable_
+        if win_ok and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos_, sin_, k_win, v_win, pos_, cache_spec,
-                attend_to_cache, policy, layout, ci_, ad_, defer_write=defer,
+                attend_to_cache, policy, lay, ci_, ad_, defer_write=defer,
             )
             if defer:
                 nk, nv = nkw, nvw  # fresh rows, committed after the scan
@@ -841,7 +1057,7 @@ def run_decoder_layers(
         else:
             h, (nk, nv) = decoder_layer(
                 arch, lp, h, cos_, sin_, kl, vl, pos_, cache_spec,
-                attend_to_cache, policy, layout, ci_, ad_, defer_write=defer,
+                attend_to_cache, policy, lay, ci_, ad_, defer_write=defer,
             )
         return h, nk, nv
 
@@ -873,6 +1089,13 @@ def run_decoder_layers(
         return _pipelined_decoder_layers(
             arch, segments_chk[0], hidden, cos, sin, cache, position_ids,
             _step, cache_inputs, adapter_ids,
+        )
+
+    if "k_win" in cache:
+        return _interleaved_window_scan(
+            arch, layer_params, hidden, cos, sin, cache, position_ids,
+            cache_spec, _step, defer, layout, policy, cache_inputs,
+            adapter_ids, collect_hidden, layer_injections,
         )
 
     def body(h, xs):

@@ -55,14 +55,22 @@ def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
     kwargs = dict(
         qk_norm=True,
         gemma_norm=True,
         sandwich_norm=True,
         embed_scale=float(config.hidden_size) ** 0.5,
-        sliding_window=getattr(config, "sliding_window", None),
+        sliding_window=sw,
         attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
         tie_word_embeddings=getattr(config, "tie_word_embeddings", True),
+        # interleaved ring stacks under window_sized_kv (5-of-6 local layers;
+        # reference: per-layer window-sized shapes kv_cache_manager.py:195)
+        kv_window_pattern=(
+            tuple(_layer_is_sliding(config, i) for i in range(config.num_hidden_layers))
+            if sw
+            else None
+        ),
     )
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)

@@ -68,23 +68,31 @@ def _moe_arch(config: InferenceConfig) -> MoEArch:
 build_inv_freq = dense.build_inv_freq  # yarn handled generically (ops/rope.py)
 
 
-def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    # rope_mscale (yarn attention factor) is set by dense.build_arch
-    kwargs = dict(
-        moe=_moe_arch(config),
-        attention_sink=True,
-        attention_o_bias=True,
-        sliding_window=getattr(config, "sliding_window", None),
-    )
-    kwargs.update(overrides)
-    return dense.build_arch(config, **kwargs)
-
-
 def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:
     lt = getattr(config, "layer_types", None)
     if lt:
         return lt[i] == "sliding_attention"
     return i % 2 == 0  # gpt-oss default: even layers sliding
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    # rope_mscale (yarn attention factor) is set by dense.build_arch
+    sw = getattr(config, "sliding_window", None)
+    kwargs = dict(
+        moe=_moe_arch(config),
+        attention_sink=True,
+        attention_o_bias=True,
+        sliding_window=sw,
+        # interleaved ring stacks under window_sized_kv (reference:
+        # gpt_oss_kv_cache_manager.py interleaved window-sized caches)
+        kv_window_pattern=(
+            tuple(_layer_is_sliding(config, i) for i in range(config.num_hidden_layers))
+            if sw
+            else None
+        ),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
 
 
 def convert_hf_state_dict(

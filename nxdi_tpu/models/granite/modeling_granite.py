@@ -22,9 +22,12 @@ class GraniteInferenceConfig(dense.DenseInferenceConfig):
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    # HF GraniteConfig defaults attention_multiplier to 1.0 — a config relying
+    # on that default must get scale 1.0, not the 1/sqrt(d) fallback (None)
+    attn_mult = getattr(config, "attention_multiplier", None)
     kwargs = dict(
         embed_scale=float(getattr(config, "embedding_multiplier", 1.0)),
-        attention_scale=float(getattr(config, "attention_multiplier", 0.0)) or None,
+        attention_scale=1.0 if attn_mult is None else float(attn_mult),
         residual_multiplier=float(getattr(config, "residual_multiplier", 1.0)),
         logits_scaling=float(getattr(config, "logits_scaling", 1.0)),
     )

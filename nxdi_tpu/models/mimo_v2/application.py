@@ -28,19 +28,36 @@ class MiMoV2Application(TpuModelForCausalLM):
              "speculative decoding"),
             (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
             (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
-            (getattr(tc, "window_sized_kv", False),
-             "window-sized ring KV (it would shrink the FULL-attention "
-             "layers' cache too)"),
         ):
             if flag:
                 raise NotImplementedError(f"mimo_v2 does not support {why} yet")
+
+    def _interleaved_window_split(self, arch=None):
+        return None  # mimo manages its own dual stacks (k_swa/v_swa)
+
+    def _cache_spec(self, family=None, config=None):
+        # the FULL-attention stack always keeps seq_len slots; window_sized_kv
+        # shrinks only the swa stack (see _swa_cache_struct)
+        arch = mv.build_arch(self.config)
+        tc = self.tpu_config
+        return arch.kv_cache_spec(
+            tc.kv_cache_batch_size + tc.kv_cache_padding_size,
+            tc.seq_len,
+            quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
+        )
 
     def _swa_cache_struct(self):
         arch = mv.build_arch(self.config)
         tc = self.tpu_config
         B = tc.kv_cache_batch_size + tc.kv_cache_padding_size
+        # window_sized_kv shrinks ONLY the sliding-window stack to a W-slot
+        # ring; full-attention layers keep the seq_len stack (reference:
+        # per-layer window-sized cache shapes, kv_cache_manager.py:195-210)
+        max_len = tc.seq_len
+        if getattr(tc, "window_sized_kv", False):
+            max_len = min(max_len, tc.sliding_window)
         spec = arch.swa.kv_cache_spec(
-            B, tc.seq_len,
+            B, max_len,
             quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
         )
         return {

@@ -83,6 +83,17 @@ class MiMoV2Arch:
     def num_layers(self):
         return self.full.num_layers + self.swa.num_layers
 
+    @property
+    def kv_window_pattern(self):
+        """Depth-ordered window flags (schedule order) — lets the wrapper's
+        layout selection keep the CONTIGUOUS layout primary under
+        window_sized_kv (only the swa stack rides the ring; see
+        application.py / kv_layout_from_config)."""
+        flags = []
+        for kind, lo, hi, _ in self.schedule:
+            flags.extend([kind == "swa"] * (hi - lo))
+        return tuple(flags)
+
     def __getattr__(self, name):
         # the runtime reads generic decoder attrs (vocab, dtype, sampler
         # wiring) — proxy them to the full-attention arch
@@ -227,6 +238,18 @@ def causal_lm_forward(
         "full": (cache["k"], cache["v"]),
         "swa": (cache["k_swa"], cache["v_swa"]),
     }
+    # window-sized swa stack: when the swa cache holds fewer slots than the
+    # full stack it is a W-slot ring — swa segments then read/write through
+    # the ring layout (reference: per-layer window-sized caches,
+    # kv_cache_manager.py:195-210); the full stack keeps the primary layout
+    layouts = {"full": layout, "swa": layout}
+    if cache["k_swa"].shape[3] < cache["k"].shape[3]:
+        from nxdi_tpu.kvcache.kv_cache import WindowKVLayout
+
+        layouts["swa"] = WindowKVLayout(
+            window=cache["k_swa"].shape[3],
+            route_by_seq_id=getattr(layout, "route_by_seq_id", False),
+        )
     seg_new = {"full": {}, "swa": {}}  # type -> {lo: (k, v)}
     for kind, lo, hi, seg_idx in arch.schedule:
         ta = arch.full if kind == "full" else arch.swa
@@ -238,7 +261,12 @@ def causal_lm_forward(
         hidden, seg_cache = run_decoder_layers(
             ta, params["segments"][seg_idx], hidden, cs[0], cs[1],
             {"k": k_sl, "v": v_sl}, position_ids, spec, attend_to_cache,
-            kv_window=kv_window, policy=policy, layout=layout,
+            kv_window=kv_window, policy=policy, layout=layouts[kind],
+            # the ring write's keep-mask needs the true last token under
+            # right padding (WindowKVLayout.update)
+            cache_inputs={"last_token_index": batch["last_token_index"]}
+            if "last_token_index" in batch
+            else None,
         )
         seg_new[kind][lo] = seg_cache
 

@@ -416,15 +416,26 @@ class WhisperForConditionalGeneration:
         from nxdi_tpu.parallel.mesh import mesh_from_config
 
         self.mesh = mesh_from_config(self.tpu_config)
-        jax.set_mesh(self.mesh)
-        params_host = convert_hf_state_dict(self.get_state_dict(), self.config)
-        self.params = shard_pytree(params_host, param_specs(self.config), self.mesh)
+        # context manager, NOT the process-global setter: other apps jitted
+        # later in the same process must not inherit the whisper mesh
+        with jax.set_mesh(self.mesh):
+            params_host = convert_hf_state_dict(self.get_state_dict(), self.config)
+            self.params = shard_pytree(
+                params_host, param_specs(self.config), self.mesh
+            )
         self.is_loaded = True
 
     def _program(self, key, fn):
+        # mesh scoped at CALL time (jit resolves the context mesh per call,
+        # not at wrapping time) — keeps this app's mesh out of global state
         if key not in self._programs:
-            with jax.set_mesh(self.mesh):
-                self._programs[key] = jax.jit(fn)
+            jitted = jax.jit(fn)
+
+            def call(*args, _jitted=jitted, **kw):
+                with jax.set_mesh(self.mesh):
+                    return _jitted(*args, **kw)
+
+            self._programs[key] = call
         return self._programs[key]
 
     def encode(self, input_features: np.ndarray):

@@ -94,16 +94,19 @@ def _mask_tile(q_start, kv_start, qi, ki, bq, bk, sliding_window, chunk_size):
     return m
 
 
-def _online_softmax_step(s, mask, m_ref, l_ref, acc_ref, v):
+def _online_softmax_step(s, mask, m_ref, l_ref, acc_ref, v, sl=slice(None)):
+    """One flash block update of the (m, l, acc) running state; ``sl`` selects
+    the scratch rows (the paged kernels keep per-kv-head slices in one
+    scratch buffer)."""
     s = jnp.where(mask, s, NEG_INF)
-    m_prev = m_ref[:, 0]
+    m_prev = m_ref[sl, 0]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
     corr = jnp.exp(m_prev - m_new)
     p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
-    l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
-    m_ref[:, 0] = m_new
+    l_ref[sl, 0] = l_ref[sl, 0] * corr + jnp.sum(p, axis=-1)
+    m_ref[sl, 0] = m_new
     # probabilities ride the MXU in the inputs' dtype; accumulate in f32
-    acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.dot(
+    acc_ref[sl, :] = acc_ref[sl, :] * corr[:, None] + jnp.dot(
         p.astype(v.dtype), v, preferred_element_type=jnp.float32
     )
 
@@ -158,9 +161,13 @@ def flash_attention_prefill(
     scale: Optional[float] = None,
     sliding_window: Optional[int] = None,
     chunk_size: Optional[int] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 512,
+    block_k: int = 512,
 ):
+    """512x512 default blocks: at 128x128 the (B*H, Sq/bq, Sk/bk) grid hits
+    ~65k steps/layer at prefill shapes and per-step overhead dominated the
+    kernel (xprof: 30 ms/layer vs ~11 ms of FLOPs; 512x512 measured ~3x
+    faster end to end on v5e)."""
     B, H, Sq, D = q.shape
     KV, Sk = k.shape[1], k.shape[2]
     G = H // KV
@@ -317,6 +324,208 @@ def flash_attention_decode(
 
 
 # ---------------------------------------------------------------------------
+# Fused decode kernel — deferred-write composition (cache + fresh row)
+# ---------------------------------------------------------------------------
+
+
+def fused_decode_kernel_supported(q_shape, k_cache_shape) -> bool:
+    """Same envelope as the plain decode kernel; the fresh row adds nothing."""
+    return decode_kernel_supported(q_shape, k_cache_shape)
+
+
+def _fused_decode_kernel(
+    qs_ref, ks_ref, q_ref, k_ref, v_ref, kn_ref, vn_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale, sliding_window, chunk_size, n_kv_blocks, KV, block_k,
+):
+    ki = pl.program_id(1)
+    b = pl.program_id(0) // KV
+    q_start = qs_ref[b]  # the single decode position == this step's write slot
+    kv_start = ks_ref[b]
+
+    @pl.when(ki == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    @pl.when(kv_start + ki * block_k <= q_start)
+    def _():
+        q = q_ref[0]  # (G, D)
+        kT = k_ref[0]  # (D, block_k) — S-minor transposed cache view
+        vT = v_ref[0]  # (D, block_k)
+        # VPU broadcast-multiply-reduce: with M = G (typically 4-8) an MXU
+        # matmul wastes ~97% of the systolic array; the elementwise form
+        # matches XLA's own near-roofline decode lowering
+        s = jnp.sum(
+            q.astype(jnp.float32)[:, :, None] * kT.astype(jnp.float32)[None, :, :],
+            axis=1,
+        ) * scale  # (G, block_k)
+        G = s.shape[0]
+        # STRICT causal mask over the cache: the slot AT q_start holds last
+        # step's (stale) row — the fresh row below replaces it (deferred-write
+        # semantics, attention_two_part's poisoned-slot mask with T == 1)
+        kv_pos = kv_start + ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        mask = kv_pos < q_start
+        if sliding_window is not None:
+            mask &= kv_pos > q_start - sliding_window
+        if chunk_size is not None:
+            mask &= (kv_pos // chunk_size) == (q_start // chunk_size)
+        mask = jnp.broadcast_to(mask, (G, block_k))
+        s = jnp.where(mask, s, NEG_INF)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.where(mask, jnp.exp(s - m_new[:, None]), 0.0)
+        l_ref[:, 0] = l_ref[:, 0] * corr + jnp.sum(p, axis=-1)
+        m_ref[:, 0] = m_new
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jnp.sum(
+            p[:, None, :] * vT.astype(jnp.float32)[None, :, :], axis=2
+        )
+
+    @pl.when(ki == n_kv_blocks - 1)
+    def _():
+        # fold in the fresh row (position q_start; always attended — its own
+        # position satisfies every causal/window/chunk mask). The (G, 1) dot
+        # is a VPU reduction — Mosaic rejects an MXU matmul with N == 1.
+        q = q_ref[0]
+        kn = kn_ref[0]  # (1, D)
+        vn = vn_ref[0]
+        s2 = jnp.sum(
+            q.astype(jnp.float32) * kn.astype(jnp.float32), axis=-1
+        ) * scale  # (G,)
+        m_prev = m_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s2)
+        corr = jnp.exp(m_prev - m_new)
+        p2 = jnp.exp(s2 - m_new)
+        l = l_ref[:, 0] * corr + p2
+        acc = acc_ref[:] * corr[:, None] + p2[:, None] * vn.astype(jnp.float32)
+        l = jnp.maximum(l, 1e-20)
+        o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def flash_attention_decode_fused(
+    q,  # (B, H, 1, D)
+    k_cache,  # (B, KV, Sk, D) — OLD cache (this step's slot stale)
+    v_cache,  # (B, KV, Sk, D)
+    k_new,  # (B, KV, 1, D) — this step's fresh row
+    v_new,  # (B, KV, 1, D)
+    q_pos,  # (B, 1) int32 decode position == write slot
+    kv_pos,  # (B, Sk) int32 — affine per row
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    chunk_size: Optional[int] = None,
+    block_k: int = 512,
+    kv_len: Optional[int] = None,
+):
+    """Deferred-write decode attention in ONE kernel: online-softmax over the
+    old cache with a STRICT causal mask (this step's slot excluded) merged
+    with the fresh K/V row — the kernel form of ops/attention.py
+    ``attention_two_part`` for T == 1 (reference: the fused TKG kernels,
+    attention_base.py:1419-1994). Composes with the Pallas commit kernel
+    (kv_commit.py): the step never materializes an updated cache view.
+
+    ``kv_len`` statically bounds how many cache positions are attended (the
+    bucket's KV window) WITHOUT slicing the cache — the grid just stops
+    early, so no windowed copy of the cache is materialized for the kernel.
+
+    The cache operands ride the S-minor TRANSPOSED view (B*KV, D, Sk): the
+    decode program's preferred cache layout is sequence-minor, so the
+    swapaxes below is a layout-preserving bitcast — feeding the cache to the
+    kernel untransposed costs a full relayout copy per layer (measured: the
+    kernel was 3x SLOWER than the XLA path until the view matched).
+    """
+    B, H, Sq, D = q.shape
+    assert Sq == 1, "fused decode kernel is single-position"
+    KV, Sk = k_cache.shape[1], k_cache.shape[2]
+    G = H // KV
+    scale = D ** -0.5 if scale is None else scale
+    attended = Sk if kv_len is None else min(kv_len, Sk)
+    block_k = _pick_block(attended, block_k)
+    n_kv_blocks = attended // block_k
+
+    qf = q.reshape(B, KV, G, D).reshape(B * KV, G, D)
+    kf = jnp.swapaxes(k_cache, 2, 3).reshape(B * KV, D, Sk)  # bitcast view
+    vf = jnp.swapaxes(v_cache, 2, 3).reshape(B * KV, D, Sk)
+    knf = k_new.reshape(B * KV, 1, D)
+    vnf = v_new.reshape(B * KV, 1, D)
+    q_start = q_pos[:, 0].astype(jnp.int32)
+    kv_start = kv_pos[:, 0].astype(jnp.int32)
+
+    kernel = functools.partial(
+        _fused_decode_kernel,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+        n_kv_blocks=n_kv_blocks,
+        KV=KV,
+        block_k=block_k,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B * KV, n_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, G, D), lambda bk, ki, *_: (bk, 0, 0)),
+            pl.BlockSpec((1, D, block_k), lambda bk, ki, *_: (bk, 0, ki)),
+            pl.BlockSpec((1, D, block_k), lambda bk, ki, *_: (bk, 0, ki)),
+            pl.BlockSpec((1, 1, D), lambda bk, ki, *_: (bk, 0, 0)),
+            pl.BlockSpec((1, 1, D), lambda bk, ki, *_: (bk, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, D), lambda bk, ki, *_: (bk, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * KV, G, D), q.dtype),
+        interpret=_interpret(),
+    )(q_start, kv_start, qf, kf, vf, knf, vnf)
+    return out.reshape(B, KV, G, D).reshape(B, H, 1, D).astype(q.dtype)
+
+
+def sharded_fused_decode_call(
+    policy, q, k_cache, v_cache, k_new, v_new, q_pos, kv_pos,
+    *, scale=None, sliding_window=None, chunk_size=None, kv_len=None,
+):
+    """Fused deferred-write decode under GSPMD (see sharded_kernel_call).
+    Returns None when the KV sequence dim is sharded (flash decoding)."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = functools.partial(
+        flash_attention_decode_fused,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+        kv_len=kv_len,
+    )
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return fn(q, k_cache, v_cache, k_new, v_new, q_pos, kv_pos)
+    kv_spec = policy.cache_kv
+    if kv_spec[2] is not None:
+        return None  # KV sequence sharded (flash decoding) -> XLA path
+    q_spec = P(*policy.q)
+    fresh_spec = P(*policy.kv)
+    qp_spec = P(policy.q[0], policy.q[2])
+    kp_spec = P(kv_spec[0], None)
+    shard_fn = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(q_spec, P(*kv_spec), P(*kv_spec), fresh_spec, fresh_spec,
+                  qp_spec, kp_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return shard_fn(q, k_cache, v_cache, k_new, v_new, q_pos, kv_pos)
+
+
+# ---------------------------------------------------------------------------
 # Paged (block-table) decode kernel
 # ---------------------------------------------------------------------------
 
@@ -328,15 +537,18 @@ def paged_decode_kernel_supported(q_shape, cache_shape, block_size) -> bool:
         return False
     if _interpret():
         return True
-    return D % 8 == 0 and block_size % 8 == 0
+    # the cache block is (block_size, KV, D): Mosaic needs the last two dims
+    # (KV, D) full (they are) and the head count small enough that the
+    # per-head python loop stays reasonable
+    return D % 8 == 0 and block_size % 8 == 0 and KV <= 16
 
 
 def _paged_decode_kernel(
     bt_ref, qp_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, scale, v_scale, n_blocks, KV, block_size, compute_dtype,
+    *, scale, v_scale, n_blocks, KV, G, block_size, compute_dtype,
 ):
     bi = pl.program_id(1)
-    b = pl.program_id(0) // KV
+    b = pl.program_id(0)
     q_pos = qp_ref[b]
     bt = bt_ref[b, bi]
 
@@ -349,23 +561,32 @@ def _paged_decode_kernel(
     # skip unallocated blocks and blocks entirely past the decode position
     @pl.when((bt >= 0) & (bi * block_size <= q_pos))
     def _():
-        q = q_ref[0]  # (G, D)
-        k = k_ref[:, 0, :].astype(compute_dtype)  # (block_size, D)
-        v = v_ref[:, 0, :].astype(compute_dtype)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * scale  # (G, block_size)
-        G = s.shape[0]
         kv_pos = bi * block_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_size), 1
         )
-        mask = jnp.broadcast_to(kv_pos <= q_pos, (G, block_size))
-        _online_softmax_step(s, mask, m_ref, l_ref, acc_ref, v)
+        base_mask = kv_pos <= q_pos
+        # one cache-block read serves every kv head (the block's last two
+        # dims are the FULL (KV, D) tail — Mosaic-valid for any KV)
+        for kv in range(KV):
+            q = q_ref[0, kv]  # (G, D)
+            k = k_ref[:, kv, :].astype(compute_dtype)  # (block_size, D)
+            v = v_ref[:, kv, :].astype(compute_dtype)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # (G, block_size)
+            mask = jnp.broadcast_to(base_mask, (G, block_size))
+            _online_softmax_step(
+                s, mask, m_ref, l_ref, acc_ref, v, sl=slice(kv * G, (kv + 1) * G)
+            )
 
     @pl.when(bi == n_blocks - 1)
     def _():
         l = jnp.maximum(l_ref[:, 0], 1e-20)
-        o_ref[0] = (acc_ref[:] * v_scale / l[:, None]).astype(o_ref.dtype)
+        o_ref[0] = (
+            (acc_ref[:] * v_scale / l[:, None])
+            .reshape(KV, G, acc_ref.shape[-1])
+            .astype(o_ref.dtype)
+        )
 
 
 def paged_attention_decode(
@@ -384,11 +605,12 @@ def paged_attention_decode(
     materialized (B, KV, W, D) gather in HBM (the round-1 XLA path's
     O(table-width) traffic; reference analog: NKI block-TKG kernel,
     attention_base.py:50-162). The table rides scalar prefetch (SMEM) and the
-    BlockSpec index maps address cache blocks directly, so HBM traffic is one
-    read of the live blocks per head. Prefix-cached blocks are just table
-    entries — nothing special. fp8 scaled caches fold ``k_scale`` into the
-    softmax scale and ``v_scale`` into the output normalization (exact, since
-    both are per-tensor)."""
+    BlockSpec index maps address cache blocks directly; each grid step reads
+    a (block_size, KV, D) block ONCE for all kv heads (full-tail blocks keep
+    Mosaic's tiling constraints satisfied for any per-shard KV count).
+    Prefix-cached blocks are just table entries — nothing special. fp8 scaled
+    caches fold ``k_scale`` into the softmax scale and ``v_scale`` into the
+    output normalization (exact, since both are per-tensor)."""
     B, H, Sq, D = q.shape
     assert Sq == 1, "paged decode kernel is single-position"
     KV = k_cache.shape[1]
@@ -397,7 +619,7 @@ def paged_attention_decode(
     scale = (D ** -0.5 if scale is None else scale) * k_scale
     compute_dtype = q.dtype
 
-    qf = q.reshape(B, KV, G, D).reshape(B * KV, G, D)
+    qf = q.reshape(B, KV, G, D)
     bt = block_table.astype(jnp.int32)
     qp = q_pos[:, 0].astype(jnp.int32)
 
@@ -407,36 +629,217 @@ def paged_attention_decode(
         v_scale=v_scale,
         n_blocks=NB,
         KV=KV,
+        G=G,
         block_size=block_size,
         compute_dtype=compute_dtype,
     )
 
-    def cache_index(bk, bi, bt_ref, qp_ref):
+    def cache_index(b, bi, bt_ref, qp_ref):
         # unallocated/future blocks clamp to block 0 — the kernel masks them out
-        return jnp.maximum(bt_ref[bk // KV, bi], 0), bk % KV, 0
+        return jnp.maximum(bt_ref[b, bi], 0), 0, 0
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B * KV, NB),
+        grid=(B, NB),
         in_specs=[
-            pl.BlockSpec((1, G, D), lambda bk, bi, *_: (bk, 0, 0)),
-            pl.BlockSpec((block_size, 1, D), cache_index),
-            pl.BlockSpec((block_size, 1, D), cache_index),
+            pl.BlockSpec((1, KV, G, D), lambda b, bi, *_: (b, 0, 0, 0)),
+            pl.BlockSpec((block_size, KV, D), cache_index),
+            pl.BlockSpec((block_size, KV, D), cache_index),
         ],
-        out_specs=pl.BlockSpec((1, G, D), lambda bk, bi, *_: (bk, 0, 0)),
+        out_specs=pl.BlockSpec((1, KV, G, D), lambda b, bi, *_: (b, 0, 0, 0)),
         scratch_shapes=[
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, 1), jnp.float32),
-            pltpu.VMEM((G, D), jnp.float32),
+            pltpu.VMEM((KV * G, 1), jnp.float32),
+            pltpu.VMEM((KV * G, 1), jnp.float32),
+            pltpu.VMEM((KV * G, D), jnp.float32),
         ],
     )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B * KV, G, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, D), q.dtype),
         interpret=_interpret(),
     )(bt, qp, qf, k_cache, v_cache)
-    return out.reshape(B, KV, G, D).reshape(B, H, 1, D)
+    return out.reshape(B, H, 1, D)
+
+
+def paged_prefill_kernel_supported(q_shape, cache_shape, block_size) -> bool:
+    B, H, Sq, D = q_shape
+    total_slots, KV = cache_shape[0], cache_shape[1]
+    G = H // KV if H % KV == 0 else 0
+    if not G or total_slots % block_size:
+        return False
+    if _interpret():
+        return True
+    return D % 8 == 0 and block_size % 128 == 0 and Sq % 8 == 0 and KV <= 16
+
+
+def _paged_prefill_kernel(
+    bt_ref, qs_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+    *, scale, v_scale, n_blocks, KV, G, block_q, block_size, compute_dtype,
+):
+    qi, bi = pl.program_id(1), pl.program_id(2)
+    b = pl.program_id(0)
+    q_start = qs_ref[b]
+    bt = bt_ref[b, bi]
+
+    @pl.when(bi == 0)
+    def _():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    # skip unallocated blocks and blocks entirely past this q tile
+    @pl.when((bt >= 0) & (bi * block_size <= q_start + qi * block_q + block_q - 1))
+    def _():
+        # row r is query position q_start + qi*block_q + r; kv col c is
+        # LOGICAL position bi*block_size + c (table order); one cache block
+        # read serves every kv head (full (KV, D) block tail)
+        q_pos = (
+            q_start
+            + qi * block_q
+            + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_size), 0)
+        )
+        kv_pos = bi * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_size), 1
+        )
+        base_mask = kv_pos <= q_pos
+        for kv in range(KV):
+            q = q_ref[0, kv].reshape(G * block_q, q_ref.shape[-1])
+            k = k_ref[:, kv, :].astype(compute_dtype)  # (block_size, D)
+            v = v_ref[:, kv, :].astype(compute_dtype)
+            s = jax.lax.dot_general(
+                q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+            ) * scale  # (G*bq, block_size)
+            mask = jnp.broadcast_to(
+                base_mask[None], (G, block_q, block_size)
+            ).reshape(G * block_q, block_size)
+            _online_softmax_step(
+                s, mask, m_ref, l_ref, acc_ref, v,
+                sl=slice(kv * G * block_q, (kv + 1) * G * block_q),
+            )
+
+    @pl.when(bi == n_blocks - 1)
+    def _():
+        l = jnp.maximum(l_ref[:, 0], 1e-20)
+        o_ref[0] = (
+            (acc_ref[:] * v_scale / l[:, None])
+            .reshape(KV, G, block_q, acc_ref.shape[-1])
+            .astype(o_ref.dtype)
+        )
+
+
+def paged_attention_prefill(
+    q,  # (B, H, Sq, D) — the active chunk/suffix queries
+    k_cache,  # (total_slots, KV, D) — paged pool, chunk already written
+    v_cache,  # (total_slots, KV, D)
+    block_table,  # (B, NB) int32 block ids in logical token order; <0 = hole
+    q_pos,  # (B, Sq) int32 — affine per row (chunk start + arange)
+    *,
+    block_size: int,
+    scale: Optional[float] = None,
+    k_scale: float = 1.0,
+    v_scale: float = 1.0,
+    block_q: int = 256,
+):
+    """Prefix-cache / chunked-prefill CTE attention reading K/V **through the
+    block table** — the multi-token-q extension of ``paged_attention_decode``
+    (reference: the NKI block-CTE kernels, attention_base.py:50-162,909,1083).
+    HBM traffic is one pass over the LIVE blocks per kv head instead of the
+    XLA path's materialized (B, KV, NB*block_size, D) gather; prefix-cached
+    blocks are just table entries. The chunk's own K/V must already be
+    scattered into the pool (BlockKVLayout.update runs first), so new tokens
+    attend earlier tokens of the same chunk through the table like the
+    reference's contexted prefill."""
+    B, H, Sq, D = q.shape
+    KV = k_cache.shape[1]
+    G = H // KV
+    NB = block_table.shape[1]
+    scale = (D ** -0.5 if scale is None else scale) * k_scale
+    compute_dtype = q.dtype
+    # bound the softmax state (KV*G*bq rows of f32 scratch) against VMEM
+    block_q = _pick_block(Sq, max(8, min(block_q, 4096 // max(H, 1))))
+
+    qf = q.reshape(B, KV, G, Sq, D)
+    bt = block_table.astype(jnp.int32)
+    qs = q_pos[:, 0].astype(jnp.int32)
+
+    kernel = functools.partial(
+        _paged_prefill_kernel,
+        scale=scale,
+        v_scale=v_scale,
+        n_blocks=NB,
+        KV=KV,
+        G=G,
+        block_q=block_q,
+        block_size=block_size,
+        compute_dtype=compute_dtype,
+    )
+
+    def cache_index(b, qi, bi, bt_ref, qs_ref):
+        return jnp.maximum(bt_ref[b, bi], 0), 0, 0
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, Sq // block_q, NB),
+        in_specs=[
+            pl.BlockSpec(
+                (1, KV, G, block_q, D), lambda b, qi, bi, *_: (b, 0, 0, qi, 0)
+            ),
+            pl.BlockSpec((block_size, KV, D), cache_index),
+            pl.BlockSpec((block_size, KV, D), cache_index),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, KV, G, block_q, D), lambda b, qi, bi, *_: (b, 0, 0, qi, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((KV * G * block_q, 1), jnp.float32),
+            pltpu.VMEM((KV * G * block_q, 1), jnp.float32),
+            pltpu.VMEM((KV * G * block_q, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, Sq, D), q.dtype),
+        interpret=_interpret(),
+    )(bt, qs, qf, k_cache, v_cache)
+    return out.reshape(B, H, Sq, D)
+
+
+def sharded_paged_prefill_call(
+    policy, q, k_cache, v_cache, block_table, q_pos,
+    *, block_size, scale=None, k_scale=1.0, v_scale=1.0,
+):
+    """Paged prefill under GSPMD (see sharded_paged_decode_call): cache and q
+    shard over kv heads on tp; table and positions are replicated."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = functools.partial(
+        paged_attention_prefill,
+        block_size=block_size,
+        scale=scale,
+        k_scale=k_scale,
+        v_scale=v_scale,
+    )
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return fn(q, k_cache, v_cache, block_table, q_pos)
+    if policy.q[0] is not None or policy.q[2] is not None:
+        return None  # batch/seq-sharded prefill (DP/CP) -> XLA path
+    shard_fn = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(
+            P(*policy.q),
+            P(None, policy.q[1], None),
+            P(None, policy.q[1], None),
+            P(None, None),
+            P(None, None),
+        ),
+        out_specs=P(*policy.q),
+        check_vma=False,
+    )
+    return shard_fn(q, k_cache, v_cache, block_table, q_pos)
 
 
 def sharded_paged_decode_call(

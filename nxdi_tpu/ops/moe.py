@@ -45,7 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_EP, AXIS_MP, AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_EP, AXIS_EPX, AXIS_MP, AXIS_TP
 
 
 @dataclass(frozen=True)
@@ -63,6 +63,15 @@ class MoEArch:
     # over tp (reference: moe_ep_degree x moe_tp_degree, config.py:603)
     ep: bool = False
     hybrid_ep: bool = False
+    # per-phase hybrid TPxEP (reference: HybridShardingConfig config.py:1060 +
+    # moe_v2.py:135-161 per-phase process groups): prefill programs compile
+    # TP-heavy (experts over ep, intermediate over epx x tp), decode programs
+    # EP-heavy (experts over ep x epx, intermediate over tp). ``phase`` is a
+    # per-SUBMODEL arch override (the TKG/speculation wrappers flip it to
+    # "decode"); expert weights are duplicated per regime ("experts_tkg"),
+    # mirroring the reference's preshard-hook duplication.
+    per_phase_hybrid: bool = False
+    phase: str = "prefill"
     # "sparse" (ragged_dot grouped matmul) or "dense" (all experts, all tokens)
     dispatch: str = "sparse"
     # shared (always-on) experts, qwen2-moe/llama4 style
@@ -100,6 +109,19 @@ def ep_policy(tp_degree: int, num_experts: int) -> bool:
 def moe_parallel_fields(tc, num_experts: int) -> Dict[str, Any]:
     """MoEArch constructor kwargs for the parallel/dispatch knobs, derived from
     the :class:`TpuConfig` — shared by every MoE family builder."""
+    hsc = getattr(tc, "hybrid_sharding_config", None)
+    if hsc is not None:
+        if num_experts % hsc.moe_tkg_ep_degree:
+            raise ValueError(
+                f"moe_tkg_ep_degree ({hsc.moe_tkg_ep_degree}) must divide the "
+                f"expert count ({num_experts})"
+            )
+        return {
+            "ep": False,
+            "hybrid_ep": True,
+            "per_phase_hybrid": True,
+            "dispatch": getattr(tc, "moe_dispatch", "sparse"),
+        }
     hybrid = bool(getattr(tc, "moe_ep_degree", None) and tc.moe_ep_degree > 1)
     if hybrid and num_experts % tc.moe_ep_degree != 0:
         raise ValueError(
@@ -132,19 +154,24 @@ def convert_hf_experts(get, cast, num_experts: int, router_key: str, expert_fmt)
     }
 
 
-def _expert_dim_axes(moe: MoEArch) -> Tuple[str, ...]:
-    """Mesh axes sharding the expert dim (for specs and shard_map offsets)."""
+def _expert_dim_axes(moe: MoEArch, phase: Optional[str] = None) -> Tuple[str, ...]:
+    """Mesh axes sharding the expert dim (for specs and shard_map offsets).
+    ``phase`` overrides ``moe.phase`` (spec builders emit both regimes)."""
     if moe.hybrid_ep:
+        if moe.per_phase_hybrid and (phase or moe.phase) == "decode":
+            return (AXIS_EP, AXIS_EPX)
         return (AXIS_EP,)
     if moe.ep:
         return AXIS_MP
     return ()
 
 
-def _inter_dim_axes(moe: MoEArch) -> Tuple[str, ...]:
+def _inter_dim_axes(moe: MoEArch, phase: Optional[str] = None) -> Tuple[str, ...]:
     """Mesh axes sharding the expert intermediate dim."""
     if moe.hybrid_ep:
-        return (AXIS_TP,)
+        if moe.per_phase_hybrid and (phase or moe.phase) == "decode":
+            return (AXIS_TP,)
+        return (AXIS_EPX, AXIS_TP)
     if moe.ep:
         return ()
     return AXIS_MP
@@ -164,21 +191,28 @@ def expert_parallel_specs(moe: MoEArch) -> Dict[str, Any]:
     :func:`_inter_dim_axes` (reference: moe_ep_degree vs moe_tp_degree,
     config.py:603). In hybrid mode weights are 2-D sharded (experts x
     intermediate)."""
-    e = _axes_entry(_expert_dim_axes(moe))
-    i = _axes_entry(_inter_dim_axes(moe))
-    expert_spec = {
-        "gate_proj": {"w": P(e, None, i)},
-        "up_proj": {"w": P(e, None, i)},
-        "down_proj": {"w": P(e, i, None)},
-    }
-    if moe.expert_bias:
-        expert_spec["gate_proj"]["b"] = P(e, i)
-        expert_spec["up_proj"]["b"] = P(e, i)
-        expert_spec["down_proj"]["b"] = P(e, None)
+    def expert_spec_for(phase):
+        e = _axes_entry(_expert_dim_axes(moe, phase))
+        i = _axes_entry(_inter_dim_axes(moe, phase))
+        spec = {
+            "gate_proj": {"w": P(e, None, i)},
+            "up_proj": {"w": P(e, None, i)},
+            "down_proj": {"w": P(e, i, None)},
+        }
+        if moe.expert_bias:
+            spec["gate_proj"]["b"] = P(e, i)
+            spec["up_proj"]["b"] = P(e, i)
+            spec["down_proj"]["b"] = P(e, None)
+        return spec
+
     specs: Dict[str, Any] = {
         "router": {"w": P()},
-        "experts": expert_spec,
+        "experts": expert_spec_for("prefill"),
     }
+    if moe.per_phase_hybrid:
+        # duplicated decode-regime copy (reference: mlp_op_tkg duplication in
+        # the hybrid preshard hook)
+        specs["experts_tkg"] = expert_spec_for("decode")
     if moe.router_bias:
         specs["router"]["b"] = P()
     if moe.correction_bias:
@@ -351,7 +385,7 @@ def _strip_mp_axes(spec: P) -> P:
             out.append(None)
             continue
         axes = tuple(a for a in (entry if isinstance(entry, (tuple, list)) else (entry,))
-                     if a not in (AXIS_EP, AXIS_TP))
+                     if a not in (AXIS_EP, AXIS_EPX, AXIS_TP))
         out.append(_axes_entry(axes))
     return P(*out)
 
@@ -443,16 +477,21 @@ def moe_block(
     if moe.router_bias:
         router_logits = router_logits + p["router"]["b"].astype(jnp.float32)
 
+    # per-phase hybrid: decode programs read the EP-heavy duplicated copy
+    p_experts = p["experts"]
+    if moe.per_phase_hybrid and moe.phase == "decode" and "experts_tkg" in p:
+        p_experts = p["experts_tkg"]
+
     if moe.dispatch == "sparse":
         top_vals, top_idx = route_topk(router_logits, moe, p["router"])
         experts = {
-            "gate_proj": {"w": mat_w(p["experts"]["gate_proj"], x.dtype)},
-            "up_proj": {"w": mat_w(p["experts"]["up_proj"], x.dtype)},
-            "down_proj": {"w": mat_w(p["experts"]["down_proj"], x.dtype)},
+            "gate_proj": {"w": mat_w(p_experts["gate_proj"], x.dtype)},
+            "up_proj": {"w": mat_w(p_experts["up_proj"], x.dtype)},
+            "down_proj": {"w": mat_w(p_experts["down_proj"], x.dtype)},
         }
         if moe.expert_bias:
             for k in experts:
-                experts[k]["b"] = p["experts"][k]["b"]
+                experts[k]["b"] = p_experts[k]["b"]
         out = _sparse_moe(
             moe,
             experts,
@@ -465,8 +504,8 @@ def moe_block(
         weights = route(router_logits, moe, p["router"]).astype(x.dtype)  # (T, E)
         # dense dispatch: all experts on all tokens, combine contracted over E.
         # mat_w dequantizes low-bit expert weights in the einsum's operand read.
-        gate = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["gate_proj"], x.dtype))
-        up = jnp.einsum("th,ehi->eti", xt, mat_w(p["experts"]["up_proj"], x.dtype))
+        gate = jnp.einsum("th,ehi->eti", xt, mat_w(p_experts["gate_proj"], x.dtype))
+        up = jnp.einsum("th,ehi->eti", xt, mat_w(p_experts["up_proj"], x.dtype))
         if moe.llama4_router:
             # llama4 scales the expert INPUT by the sigmoid score. gate/up are
             # linear and bias-free on this path, so scaling their OUTPUTS before
@@ -476,12 +515,12 @@ def moe_block(
             gate = gate * se
             up = up * se
         if moe.expert_bias:
-            gate = gate + p["experts"]["gate_proj"]["b"][:, None, :]
-            up = up + p["experts"]["up_proj"]["b"][:, None, :]
+            gate = gate + p_experts["gate_proj"]["b"][:, None, :]
+            up = up + p_experts["up_proj"]["b"][:, None, :]
         inner = _expert_act(moe, gate, up)  # (E, T, I)
-        expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p["experts"]["down_proj"], x.dtype))
+        expert_out = jnp.einsum("eti,eih->eth", inner, mat_w(p_experts["down_proj"], x.dtype))
         if moe.expert_bias:
-            expert_out = expert_out + p["experts"]["down_proj"]["b"][:, None, :]
+            expert_out = expert_out + p_experts["down_proj"]["b"][:, None, :]
         if moe.llama4_router:
             out = jnp.sum(expert_out, axis=0)  # input already carries the score
         else:
@@ -502,6 +541,21 @@ def moe_block(
         out = out + shared
 
     return out.reshape(B, S, H)
+
+
+def duplicate_per_phase_experts(obj):
+    """Mirror every MoE ``experts`` subtree as ``experts_tkg`` in a HOST param
+    pytree (reference: ``duplicate_and_replace_prefixes`` in the hybrid
+    preshard hook — the decode regime gets its own sharded copy). Host arrays
+    are shared; ``device_put`` lays each copy out under its own spec."""
+    if isinstance(obj, dict):
+        out = {k: duplicate_per_phase_experts(v) for k, v in obj.items()}
+        if "router" in out and "experts" in out and "experts_tkg" not in out:
+            out["experts_tkg"] = out["experts"]
+        return out
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(duplicate_per_phase_experts(v) for v in obj)
+    return obj
 
 
 def moe_shape_struct(moe: MoEArch, hidden_size: int, num_layers: int, dtype) -> Dict[str, Any]:
@@ -528,6 +582,10 @@ def moe_shape_struct(moe: MoEArch, hidden_size: int, num_layers: int, dtype) -> 
         struct["experts"]["gate_proj"]["b"] = s(E, I)
         struct["experts"]["up_proj"]["b"] = s(E, I)
         struct["experts"]["down_proj"]["b"] = s(E, H)
+    if moe.per_phase_hybrid:
+        import copy
+
+        struct["experts_tkg"] = copy.deepcopy(struct["experts"])
     if moe.shared_expert_intermediate_size:
         SI = moe.shared_expert_intermediate_size
         struct["shared_expert"] = {

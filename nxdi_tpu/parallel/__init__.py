@@ -1,6 +1,7 @@
 from nxdi_tpu.parallel.mesh import (  # noqa: F401
     AXIS_DP,
     AXIS_EP,
+    AXIS_EPX,
     AXIS_MP,
     AXIS_TP,
     build_mesh,

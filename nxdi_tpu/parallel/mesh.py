@@ -37,9 +37,15 @@ AXIS_DP = "dp"
 AXIS_CP = "cp"
 AXIS_TP = "tp"
 AXIS_EP = "ep"
+# epx refines the ep<->tp boundary for PER-PHASE hybrid MoE sharding
+# (reference: HybridShardingConfig, config.py:1060): prefill runs experts
+# over ep with intermediates over (epx, tp); decode runs experts over
+# (ep, epx) with intermediates over tp. Size 1 unless hybrid_sharding_config
+# sets moe_tkg_ep_degree > moe_cte_ep_degree.
+AXIS_EPX = "epx"
 # Full model-parallel world: PartitionSpec entries may be tuples of axes, and
-# sharding over ("ep", "tp") with ep-size 1 is identical to sharding over tp.
-AXIS_MP = (AXIS_EP, AXIS_TP)
+# sharding over ("ep", "epx", "tp") with ep/epx size 1 is identical to tp.
+AXIS_MP = (AXIS_EP, AXIS_EPX, AXIS_TP)
 
 
 def build_mesh(
@@ -47,6 +53,7 @@ def build_mesh(
     dp_degree: int = 1,
     cp_degree: int = 1,
     ep_degree: int = 1,
+    epx_degree: int = 1,
     pp_degree: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
     allow_split_physical_axes: bool = True,
@@ -62,32 +69,32 @@ def build_mesh(
     slices and exchange activations over the ``pp`` axis (parallel/pipeline
     schedule in models/base.py).
     """
-    if tp_degree % (cp_degree * dp_degree * ep_degree) != 0:
+    if tp_degree % (cp_degree * dp_degree * ep_degree * epx_degree) != 0:
         raise ValueError(
-            f"cp_degree*dp_degree*ep_degree ({cp_degree}*{dp_degree}*{ep_degree}) "
-            f"must divide tp_degree ({tp_degree})"
+            f"cp_degree*dp_degree*ep_degree*epx_degree ({cp_degree}*{dp_degree}"
+            f"*{ep_degree}*{epx_degree}) must divide tp_degree ({tp_degree})"
         )
-    inner_tp = tp_degree // (cp_degree * dp_degree * ep_degree)
-    n = pp_degree * dp_degree * cp_degree * ep_degree * inner_tp
+    inner_tp = tp_degree // (cp_degree * dp_degree * ep_degree * epx_degree)
+    n = pp_degree * dp_degree * cp_degree * ep_degree * epx_degree * inner_tp
     if devices is None:
         devices = jax.devices()
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     devices = list(devices)[:n]
     if len(devices) == 1:
-        dev_array = np.array(devices).reshape(1, 1, 1, 1, 1)
+        dev_array = np.array(devices).reshape(1, 1, 1, 1, 1, 1)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(
-                (pp_degree, dp_degree, cp_degree, ep_degree, inner_tp),
+                (pp_degree, dp_degree, cp_degree, ep_degree, epx_degree, inner_tp),
                 devices=devices,
                 allow_split_physical_axes=allow_split_physical_axes,
             )
         except (ValueError, AssertionError, NotImplementedError):
             dev_array = np.array(devices).reshape(
-                pp_degree, dp_degree, cp_degree, ep_degree, inner_tp
+                pp_degree, dp_degree, cp_degree, ep_degree, epx_degree, inner_tp
             )
-    return Mesh(dev_array, (AXIS_PP, AXIS_DP, AXIS_CP, AXIS_EP, AXIS_TP))
+    return Mesh(dev_array, (AXIS_PP, AXIS_DP, AXIS_CP, AXIS_EP, AXIS_EPX, AXIS_TP))
 
 
 def mesh_from_config(tpu_config, devices=None) -> Mesh:
@@ -96,11 +103,19 @@ def mesh_from_config(tpu_config, devices=None) -> Mesh:
     attention_process_groups.py:81,125 building CP/DP groups over the TP
     world; moe_v2.py:135-161 EP groups); pp_degree multiplies it. Submodels
     that don't use an axis simply leave it unsharded."""
+    hyb = getattr(tpu_config, "hybrid_sharding_config", None)
+    if hyb is not None:
+        ep = hyb.moe_cte_ep_degree
+        epx = hyb.moe_tkg_ep_degree // hyb.moe_cte_ep_degree
+    else:
+        ep = getattr(tpu_config, "moe_ep_degree", None) or 1
+        epx = 1
     return build_mesh(
         tp_degree=tpu_config.tp_degree,
         dp_degree=tpu_config.attention_dp_degree,
         cp_degree=tpu_config.cp_degree,
-        ep_degree=getattr(tpu_config, "moe_ep_degree", None) or 1,
+        ep_degree=ep,
+        epx_degree=epx,
         pp_degree=getattr(tpu_config, "pp_degree", 1) or 1,
         devices=devices,
     )

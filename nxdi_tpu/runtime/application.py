@@ -205,6 +205,18 @@ class ApplicationBase:
             specs = lora_spec_update(specs, self.tpu_config.lora_config)
         return maybe_quantize_specs(specs, self.tpu_config)
 
+    def _interleaved_window_split(self, arch=None):
+        """(n_full, n_window) when the cache splits into full + ring stacks
+        (window_sized_kv on an interleaved-SWA arch), else None (reference:
+        per-layer window-sized caches, gpt_oss_kv_cache_manager.py)."""
+        if not getattr(self.tpu_config, "window_sized_kv", False):
+            return None
+        arch = arch or self.family.build_arch(self.config)
+        pat = getattr(arch, "kv_window_pattern", None)
+        if not pat or all(pat) or not any(pat):
+            return None  # homogeneous stacks keep the single-layout path
+        return (sum(not w for w in pat), sum(bool(w) for w in pat))
+
     def cache_partition_specs(self):
         if self.tpu_config.is_block_kv_layout:
             return block_kv_cache_partition_spec()
@@ -215,13 +227,37 @@ class ApplicationBase:
             from jax.sharding import PartitionSpec as P
 
             return {"k": P(), "v": P()}
-        return kv_cache_partition_spec(self.tpu_config)
+        specs = dict(kv_cache_partition_spec(self.tpu_config))
+        if self._interleaved_window_split(arch) is not None:
+            specs["k_win"] = specs["k"]
+            specs["v_win"] = specs["v"]
+        return specs
 
     def init_cache_host(self):
         spec = self._cache_spec()
         if isinstance(spec, BlockKVCacheSpec):
             return init_block_kv_cache(spec)
-        return init_kv_cache(spec)
+        cache = init_kv_cache(spec)
+        ring = self._ring_cache_spec()
+        if ring is not None:
+            win = init_kv_cache(ring)
+            cache["k_win"], cache["v_win"] = win["k"], win["v"]
+        return cache
+
+    def _ring_cache_spec(self):
+        """Ring-stack spec for the window layers of an interleaved split."""
+        import dataclasses
+
+        arch = self.family.build_arch(self.config)
+        split = self._interleaved_window_split(arch)
+        if split is None:
+            return None
+        base = self._cache_spec()
+        return dataclasses.replace(
+            base,
+            num_layers=split[1],
+            max_len=min(self.tpu_config.sliding_window, self.tpu_config.seq_len),
+        )
 
     # ------------------------------------------------------------------
     def compile(self, compiled_model_path: str) -> None:
@@ -272,10 +308,22 @@ class ApplicationBase:
                 quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
             )
         max_len = self.tpu_config.seq_len
-        if getattr(tc, "window_sized_kv", False):
+        split = self._interleaved_window_split(arch)
+        if getattr(tc, "window_sized_kv", False) and split is None:
             # ring layout: W slots per layer instead of the full budget
             # (reference: window-sized cache shapes kv_cache_manager.py:195)
             max_len = min(max_len, tc.sliding_window)
+        if split is not None:
+            # interleaved split: this spec covers the FULL-attention layers
+            # only; the window layers live in the ring stack (_ring_cache_spec)
+            import dataclasses
+
+            spec = arch.kv_cache_spec(
+                tc.kv_cache_batch_size + tc.kv_cache_padding_size,
+                max_len,
+                quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
+            )
+            return dataclasses.replace(spec, num_layers=split[0])
         return arch.kv_cache_spec(
             self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size,
             max_len,
@@ -293,10 +341,16 @@ class ApplicationBase:
         if compiled_model_path is not None:
             enable_persistent_cache(os.path.join(compiled_model_path, "cache"))
         self.mesh = mesh_from_config(self.tpu_config)
-        jax.set_mesh(self.mesh)
         self._build_wrappers()
 
         params_host = self.build_params()
+        arch = self.family.build_arch(self.config)
+        if getattr(getattr(arch, "moe", None), "per_phase_hybrid", False):
+            # decode regime gets its own EP-heavy sharded expert copy
+            # (reference: hybrid preshard-hook weight duplication)
+            from nxdi_tpu.ops.moe import duplicate_per_phase_experts
+
+            params_host = duplicate_per_phase_experts(params_host)
         self.params = shard_pytree(params_host, self.param_specs(), self.mesh)
         del params_host
 
@@ -316,7 +370,6 @@ class ApplicationBase:
         self.enable_models()
         if self.mesh is None:
             self.mesh = mesh_from_config(self.tpu_config)
-            jax.set_mesh(self.mesh)
         param_shardings = sharding_tree(self.param_specs(), self.mesh)
         cache_shardings = sharding_tree(self.cache_partition_specs(), self.mesh)
         for wrapper in self.models.values():
@@ -371,6 +424,16 @@ class TpuModelForCausalLM(ApplicationBase):
         arch = self.family.build_arch(self.config)
         inv_freq = self.family.build_inv_freq(self.config)
         tc = self.tpu_config
+        # per-phase hybrid MoE: the decode submodel compiles EP-heavy via a
+        # per-submodel arch override (reference: per-phase moe process groups,
+        # moe_v2.py:135-161; HybridShardingConfig config.py:1060)
+        arch_tkg = arch
+        if getattr(getattr(arch, "moe", None), "per_phase_hybrid", False):
+            import dataclasses
+
+            arch_tkg = dataclasses.replace(
+                arch, moe=dataclasses.replace(arch.moe, phase="decode")
+            )
         sampling_kwargs = {}
         odsc = tc.on_device_sampling_config
         on_device_sampling = odsc is not None
@@ -411,7 +474,7 @@ class TpuModelForCausalLM(ApplicationBase):
         self.models[TAG_TOKEN_GENERATION] = ModelWrapper(
             TAG_TOKEN_GENERATION,
             self.config,
-            arch,
+            arch_tkg,
             inv_freq,
             batch_size=tc.tkg_batch_size,
             n_active_tokens=1,

@@ -30,11 +30,16 @@ from nxdi_tpu.runtime import autobucketing
 from nxdi_tpu.runtime.padding import pad_with_first_batchline
 
 
-def kv_layout_from_config(tc):
+def kv_layout_from_config(tc, arch=None):
     """The KV layout every submodel of this app compiles against
     (reference: config flags is_block_kv_layout / is_continuous_batching,
     models/config.py:278-283). Scaled fp8 KV (scale_mode="per_tensor",
-    kv_cache_manager.py:642-692) rides the layout as static scales."""
+    kv_cache_manager.py:642-692) rides the layout as static scales.
+
+    ``window_sized_kv`` on an INTERLEAVED-SWA arch (kv_window_pattern with
+    both kinds) keeps the contiguous layout as primary: only the window
+    layers ride the W-slot ring stack, assembled per layer inside
+    run_decoder_layers' unit scan (reference: gpt_oss_kv_cache_manager.py)."""
     kvq = tc.kv_quant_config
     scales = {}
     if kvq is not None and kvq.scale_mode == "per_tensor":
@@ -48,6 +53,9 @@ def kv_layout_from_config(tc):
             raise NotImplementedError(
                 "scaled fp8 KV is not wired into the window ring layout yet"
             )
+        pat = getattr(arch, "kv_window_pattern", None) if arch is not None else None
+        if pat and any(pat) and not all(pat):
+            return ContiguousKVLayout(route_by_seq_id=tc.is_continuous_batching)
         return WindowKVLayout(
             window=tc.sliding_window, route_by_seq_id=tc.is_continuous_batching
         )
@@ -142,7 +150,7 @@ class ModelWrapper:
         self.bucket_strategy = bucket_strategy
         self.forward_fn = forward_fn or causal_lm_forward
         self.forward_kwargs = dict(forward_kwargs or {})
-        self.layout = kv_layout_from_config(config.tpu_config)
+        self.layout = kv_layout_from_config(config.tpu_config, arch)
         # extra KV positions a single dispatch may write past the current
         # length (speculation windows); widens bucket selection accordingly
         self.lookahead = 0
@@ -314,9 +322,16 @@ class ModelWrapper:
         params_struct = attach(params_struct, self._param_shardings)
         cache_struct = attach(cache_struct, self._cache_shardings)
         compiled = {}
-        for bucket, prog in self._programs.items():
-            lowered = prog.lower(params_struct, cache_struct, self.example_batch(bucket))
-            compiled[bucket] = lowered.compile()
+        # lower under this app's mesh: constrain()/shard_map kernel dispatch
+        # read the ambient abstract mesh at TRACE time — without it the AOT
+        # artifact would drop sharding constraints and pallas paths, and the
+        # persistent-cache entries would never match the serve-time programs
+        with jax.set_mesh(self._mesh):
+            for bucket, prog in self._programs.items():
+                lowered = prog.lower(
+                    params_struct, cache_struct, self.example_batch(bucket)
+                )
+                compiled[bucket] = lowered.compile()
         return compiled
 
     # ------------------------------------------------------------------
@@ -445,7 +460,10 @@ class ModelWrapper:
         extra: Dict[str, np.ndarray] = {}
         if getattr(self.layout, "route_by_seq_id", False):
             sids = np.asarray(batch_np.get("seq_ids", np.arange(b)), dtype=np.int32)
-            cb = self.config.tpu_config.max_batch_size
+            tc = self.config.tpu_config
+            # bound = the CACHE LINE count (what seq_ids index), not the
+            # per-step batch size
+            cb = tc.kv_cache_batch_size + tc.kv_cache_padding_size
             if sids.min(initial=0) < 0 or sids.max(initial=0) >= cb:
                 # loud host-side gate: an out-of-range seq_id would route a
                 # cache write to a clipped line on device (the commit kernel

@@ -1,0 +1,101 @@
+"""Interleaved per-layer window-sized KV stacks (reference:
+gpt_oss_kv_cache_manager.py / kv_cache_manager.py:195-210): models mixing
+full-attention and sliding-window layers keep full-length KV only on the
+full layers; window layers decode from a W-slot ring. Greedy tokens must
+stay EXACTLY equal to HF CPU even far past the window, and the cache must
+actually shrink."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.registry import get_family
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+from tests.integration.test_model_families import _tiny_hf
+
+WINDOW = 8
+SEQ_LEN = 64
+
+
+def _build_app(model_type, hf_model, hf_cfg, **tcfg_kwargs):
+    family, cfg_cls = get_family(model_type)
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=SEQ_LEN,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = cfg_cls(TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=family)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("model_type", ["gpt_oss", "gemma3"])
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_interleaved_ring_token_matching(model_type, tp_degree):
+    """Decode 3x past the window on the ring stacks: exact HF parity."""
+    hf_model, hf_cfg = _tiny_hf(model_type)
+    app = _build_app(
+        model_type, hf_model, hf_cfg, tp_degree=tp_degree,
+        window_sized_kv=True, sliding_window=WINDOW,
+    )
+    prompt = np.tile(
+        np.array([[5, 9, 3, 17, 2, 8, 11, 42, 7, 13, 21, 4]], np.int64), (2, 1)
+    )
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=24)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_interleaved_cache_is_split_and_smaller():
+    """Window layers hold W slots, full layers seq_len slots; total cache
+    memory shrinks accordingly vs the all-full layout."""
+    hf_model, hf_cfg = _tiny_hf("gpt_oss")
+    app = _build_app(
+        hf_model=hf_model, hf_cfg=hf_cfg, model_type="gpt_oss",
+        window_sized_kv=True, sliding_window=WINDOW,
+    )
+    kc = app.kv_cache
+    assert set(kc) == {"k", "v", "k_win", "v_win"}
+    # gpt-oss default: even layers sliding -> 2 of 4 layers each kind
+    assert kc["k"].shape[0] == 2 and kc["k"].shape[3] == SEQ_LEN
+    assert kc["k_win"].shape[0] == 2 and kc["k_win"].shape[3] == WINDOW
+
+    full = _build_app(
+        hf_model=hf_model, hf_cfg=hf_cfg, model_type="gpt_oss",
+    )
+    split_bytes = sum(int(np.prod(v.shape)) * v.dtype.itemsize for v in kc.values())
+    full_bytes = sum(
+        int(np.prod(v.shape)) * v.dtype.itemsize for v in full.kv_cache.values()
+    )
+    expected_ratio = (2 * SEQ_LEN + 2 * WINDOW) / (4 * SEQ_LEN)
+    assert split_bytes == int(full_bytes * expected_ratio)
+
+
+def test_interleaved_matches_unsplit_run():
+    """The split-cache app and the plain full-cache app must emit identical
+    tokens (the ring is a pure memory optimization)."""
+    hf_model, hf_cfg = _tiny_hf("gemma3")
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], np.int64)
+    ring_app = _build_app(
+        "gemma3", hf_model, hf_cfg, batch_size=1,
+        window_sized_kv=True, sliding_window=WINDOW,
+    )
+    full_app = _build_app("gemma3", hf_model, hf_cfg, batch_size=1)
+    a = HuggingFaceGenerationAdapter(ring_app).generate(prompt, max_new_tokens=20)
+    b = HuggingFaceGenerationAdapter(full_app).generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(a, b)

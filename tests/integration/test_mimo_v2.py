@@ -203,3 +203,39 @@ def test_mimo_v2_token_matching(tp_degree):
 
     actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=n_new)
     np.testing.assert_array_equal(actual[:, prompt.shape[1]:], expected)
+
+
+def test_mimo_v2_window_sized_swa_cache():
+    """window_sized_kv shrinks ONLY the swa stack to a W-slot ring; tokens
+    stay exactly equal to the torch golden (round-2 rejection lifted —
+    reference: per-layer window-sized caches, kv_cache_manager.py:195-210)."""
+    rng = np.random.default_rng(0)
+    sd = _random_sd(rng)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42], [7, 13, 21, 4, 33, 6, 19, 2]])
+    n_new = 12
+    expected = _golden_greedy(sd, prompt, n_new)
+
+    cfg = mv.MiMoV2InferenceConfig(
+        TpuConfig(
+            tp_degree=1,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+            window_sized_kv=True,
+            sliding_window=CFG["sliding_window"],
+        ),
+        load_config=lambda: dict(CFG),
+    )
+    app = mv.MiMoV2ForCausalLM("<memory>", cfg)
+    app.get_state_dict = lambda: sd
+    app.load()
+    assert app.kv_cache["k_swa"].shape[3] == CFG["sliding_window"]
+    assert app.kv_cache["k"].shape[3] == 64  # full stack untouched
+
+    from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=n_new)
+    np.testing.assert_array_equal(actual[:, prompt.shape[1]:], expected)

@@ -93,12 +93,12 @@ def test_mesh_axes_from_config():
     tc = TpuConfig(tp_degree=8, cp_degree=2, attention_dp_degree=2, batch_size=2)
     mesh = mesh_from_config(tc)
     assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-        "pp": 1, "dp": 2, "cp": 2, "ep": 1, "tp": 2
+        "pp": 1, "dp": 2, "cp": 2, "ep": 1, "epx": 1, "tp": 2
     }
     tc = TpuConfig(tp_degree=4, pp_degree=2, batch_size=2)
     mesh = mesh_from_config(tc)
     assert dict(zip(mesh.axis_names, mesh.devices.shape)) == {
-        "pp": 2, "dp": 1, "cp": 1, "ep": 1, "tp": 4
+        "pp": 2, "dp": 1, "cp": 1, "ep": 1, "epx": 1, "tp": 4
     }
 
 
@@ -117,10 +117,10 @@ def test_cache_partition_spec_variants():
     from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
 
     tc = TpuConfig(tp_degree=8, attention_dp_degree=2, batch_size=2)
-    assert kv_cache_partition_spec(tc)["k"] == P(None, "dp", ("ep", "tp"), None, None)
+    assert kv_cache_partition_spec(tc)["k"] == P(None, "dp", ("ep", "epx", "tp"), None, None)
     tc = TpuConfig(tp_degree=8, cp_degree=2, flash_decoding_enabled=True)
-    assert kv_cache_partition_spec(tc)["k"] == P(None, None, ("ep", "tp"), "cp", None)
-    assert kv_cache_partition_spec(None)["k"] == P(None, None, ("ep", "tp"), None, None)
+    assert kv_cache_partition_spec(tc)["k"] == P(None, None, ("ep", "epx", "tp"), "cp", None)
+    assert kv_cache_partition_spec(None)["k"] == P(None, None, ("ep", "epx", "tp"), None, None)
 
 
 @pytest.mark.parametrize(
@@ -179,3 +179,57 @@ def test_mlp_cp_degree_validation():
     with pytest.raises(ValueError, match="sequence_parallel"):
         TpuConfig(tp_degree=8, mlp_cp_degree=2)
     TpuConfig(tp_degree=8, mlp_cp_degree=2, sequence_parallel_enabled=True)
+
+
+def test_per_phase_hybrid_moe_token_matching():
+    """hybrid_sharding_config (reference: HybridShardingConfig config.py:1060):
+    CTE compiles TP-heavy, TKG EP-heavy over the duplicated expert copy, and
+    greedy tokens must still exactly match HF CPU on the 8-device mesh."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    from nxdi_tpu.models.mixtral import modeling_mixtral as mx
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = MixtralConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        num_local_experts=8,
+        num_experts_per_tok=2,
+    )
+    hf_model = MixtralForCausalLM(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=8,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        hybrid_sharding_config=dict(moe_cte_ep_degree=2, moe_tkg_ep_degree=8),
+    )
+    cfg = mx.MixtralInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=mx)
+    app.load()
+    arch_cte = app.models["context_encoding_model"].arch
+    arch_tkg = app.models["token_generation_model"].arch
+    assert arch_cte.moe.phase == "prefill" and arch_tkg.moe.phase == "decode"
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=16)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)

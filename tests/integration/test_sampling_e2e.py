@@ -91,3 +91,37 @@ def test_generation_config_passthrough(tiny_hf_llama, tmp_path):
     gc = GenerationConfig(max_new_tokens=6, do_sample=False)
     out = adapter.generate(prompt, generation_config=gc)
     assert out.shape[1] == prompt.shape[1] + 6
+
+
+def test_repetition_penalty_right_padded_matches_hf(tiny_hf_llama, tmp_path):
+    """Ids-dependent processors must not see right-padding as context: a
+    right-padded batch with RepetitionPenaltyLogitsProcessor must produce the
+    same greedy tokens HF produces for the equivalent left-padded batch
+    (reference: hf_adapter right-pad support + LogitsProcessorList)."""
+    import torch
+    from transformers.generation.logits_process import (
+        RepetitionPenaltyLogitsProcessor,
+    )
+
+    from tests.integration.test_llama_token_matching import build_app
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = build_app(
+        hf_model, hf_cfg, tmp_path, batch_size=2, output_logits=True
+    )
+    adapter = HuggingFaceGenerationAdapter(app)
+    # row 1 is shorter -> right-padded with 0s
+    prompt = np.array([[5, 9, 3, 17, 2, 8], [7, 13, 4, 0, 0, 0]], dtype=np.int64)
+    proc = RepetitionPenaltyLogitsProcessor(penalty=5.0)
+    out = adapter.generate(
+        prompt, max_new_tokens=8, logits_processor=[proc], pad_token_id=0
+    )
+    # HF golden per row (unpadded single-row runs sidestep HF's left-pad needs)
+    for b, true_len in enumerate((6, 3)):
+        row = torch.tensor(prompt[b : b + 1, :true_len])
+        with torch.no_grad():
+            ref = hf_model.generate(
+                row, max_new_tokens=8, do_sample=False, pad_token_id=0,
+                repetition_penalty=5.0,
+            ).numpy()
+        np.testing.assert_array_equal(out[b, true_len : true_len + 8], ref[0, true_len:])

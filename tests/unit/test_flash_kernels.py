@@ -134,3 +134,133 @@ def test_paged_decode_kernel_scaled_fp8_folding():
         v_scale=v_scale,
     )
     np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Fused deferred-write decode kernel
+# ---------------------------------------------------------------------------
+
+from nxdi_tpu.ops.attention import attention_two_part  # noqa: E402
+from nxdi_tpu.ops.kernels import flash_attention_decode_fused  # noqa: E402
+
+
+def _two_part_golden(q, kk, vv, kn, vn, q_pos, kv_pos, **kw):
+    """The deferred-write decode semantics from models/base.py: old cache
+    with this step's slot poisoned + the fresh row appended."""
+    wpos = q_pos.astype(jnp.int32)
+    hit = jnp.any(kv_pos[:, None, :] == wpos[:, :, None], axis=1)
+    kv_pos_poisoned = jnp.where(hit, jnp.int32(2**30), kv_pos)
+    return attention_two_part(
+        q, kk, vv, kn, vn, q_pos, kv_pos_poisoned, wpos, **kw
+    )
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2)])
+@pytest.mark.parametrize("window,chunk", [(None, None), (8, None), (None, 8)])
+def test_fused_decode_matches_two_part(H, KV, window, chunk):
+    B, W, D = 2, 32, 16
+    q = _rand((B, H, 1, D), 0)
+    kk, vv = _rand((B, KV, W, D), 1), _rand((B, KV, W, D), 2)
+    kn, vn = _rand((B, KV, 1, D), 3), _rand((B, KV, 1, D), 4)
+    q_pos = jnp.array([[13], [7]], jnp.int32)
+    kv_pos = jnp.tile(jnp.arange(W, dtype=jnp.int32), (B, 1))
+    expected = _two_part_golden(
+        q, kk, vv, kn, vn, q_pos, kv_pos, sliding_window=window, chunk_size=chunk
+    )
+    actual = flash_attention_decode_fused(
+        q, kk, vv, kn, vn, q_pos, kv_pos,
+        sliding_window=window, chunk_size=chunk, block_k=8,
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+def test_fused_decode_position_zero():
+    """Empty cache: only the fresh row is attendable."""
+    B, H, KV, W, D = 1, 4, 2, 16, 8
+    q = _rand((B, H, 1, D), 5)
+    kk, vv = _rand((B, KV, W, D), 6), _rand((B, KV, W, D), 7)
+    kn, vn = _rand((B, KV, 1, D), 8), _rand((B, KV, 1, D), 9)
+    q_pos = jnp.zeros((B, 1), jnp.int32)
+    kv_pos = jnp.tile(jnp.arange(W, dtype=jnp.int32), (B, 1))
+    expected = _two_part_golden(q, kk, vv, kn, vn, q_pos, kv_pos)
+    actual = flash_attention_decode_fused(q, kk, vv, kn, vn, q_pos, kv_pos, block_k=8)
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+def test_fused_decode_kv_len_bound():
+    """kv_len statically truncates attended cache without slicing it."""
+    B, H, KV, W, D = 1, 4, 2, 32, 8
+    q = _rand((B, H, 1, D), 10)
+    kk, vv = _rand((B, KV, W, D), 11), _rand((B, KV, W, D), 12)
+    kn, vn = _rand((B, KV, 1, D), 13), _rand((B, KV, 1, D), 14)
+    q_pos = jnp.array([[9]], jnp.int32)
+    kv_pos = jnp.tile(jnp.arange(W, dtype=jnp.int32), (B, 1))
+    expected = _two_part_golden(
+        q, kk[:, :, :16], vv[:, :, :16], kn, vn, q_pos, kv_pos[:, :16]
+    )
+    actual = flash_attention_decode_fused(
+        q, kk, vv, kn, vn, q_pos, kv_pos, block_k=8, kv_len=16
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Paged prefill (prefix-cache / chunked-prefill CTE) kernel
+# ---------------------------------------------------------------------------
+
+from nxdi_tpu.ops.kernels import paged_attention_prefill  # noqa: E402
+
+
+def _paged_pool(rng, total_slots, KV, D):
+    k = jnp.asarray(rng.standard_normal((total_slots, KV, D)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((total_slots, KV, D)), jnp.float32)
+    return k, v
+
+
+@pytest.mark.parametrize("H,KV", [(4, 4), (8, 2)])
+def test_paged_prefill_matches_gathered_read(H, KV):
+    """Bit-parity with the XLA path: materialized block-table gather +
+    attention_with_positions over the gathered window."""
+    rng = np.random.default_rng(0)
+    B, Sq, D, bs, NB = 2, 16, 16, 8, 6
+    total = 64
+    k_cache, v_cache = _paged_pool(rng, total, KV, D)
+    q = jnp.asarray(rng.standard_normal((B, H, Sq, D)), jnp.float32)
+    # prefix of 2 blocks + the 2-block chunk; trailing entries unallocated
+    bt = jnp.asarray([[3, 5, 0, 2, -1, -1], [7, 1, 6, 4, -1, -1]], jnp.int32)
+    chunk_start = 2 * bs  # suffix begins after the 2-block prefix
+    q_pos = chunk_start + jnp.tile(jnp.arange(Sq, dtype=jnp.int32), (B, 1))
+
+    # golden: gather the table window, causal mask on logical positions
+    offs = jnp.arange(bs, dtype=jnp.int32)
+    slots = (bt[:, :, None] * bs + offs[None, None, :]).reshape(B, -1)
+    kk = jnp.swapaxes(jnp.take(k_cache, slots, axis=0, mode="clip"), 1, 2)
+    vv = jnp.swapaxes(jnp.take(v_cache, slots, axis=0, mode="clip"), 1, 2)
+    W = NB * bs
+    kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
+    valid = jnp.repeat(bt >= 0, bs, axis=1)
+    kv_pos = jnp.where(valid, kv_pos, jnp.int32(2**30))
+    expected = attention_with_positions(q, kk, vv, q_pos, kv_pos)
+
+    actual = paged_attention_prefill(
+        q, k_cache, v_cache, bt, q_pos, block_size=bs, block_q=8
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)
+
+
+def test_paged_prefill_fp8_scale_folding():
+    """k_scale folds into the softmax scale, v_scale into the output."""
+    rng = np.random.default_rng(1)
+    B, H, KV, Sq, D, bs = 1, 4, 2, 8, 8, 8
+    k_cache, v_cache = _paged_pool(rng, 32, KV, D)
+    q = jnp.asarray(rng.standard_normal((B, H, Sq, D)), jnp.float32)
+    bt = jnp.asarray([[2, 0, -1, -1]], jnp.int32)
+    q_pos = bs + jnp.tile(jnp.arange(Sq, dtype=jnp.int32), (B, 1))
+    expected = paged_attention_prefill(
+        q, k_cache * 2.0, v_cache * 0.5, bt, q_pos, block_size=bs, block_q=8
+    )
+    actual = paged_attention_prefill(
+        q, k_cache, v_cache, bt, q_pos, block_size=bs, block_q=8,
+        k_scale=2.0, v_scale=0.5,
+    )
+    np.testing.assert_allclose(np.asarray(actual), np.asarray(expected), atol=2e-5)

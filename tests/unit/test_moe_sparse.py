@@ -183,8 +183,8 @@ def test_hybrid_tpxep_specs():
     specs = expert_parallel_specs(moe)
     from jax.sharding import PartitionSpec as P
 
-    assert specs["experts"]["gate_proj"]["w"] == P("ep", None, "tp")
-    assert specs["experts"]["down_proj"]["w"] == P("ep", "tp", None)
+    assert specs["experts"]["gate_proj"]["w"] == P("ep", None, ("epx", "tp"))
+    assert specs["experts"]["down_proj"]["w"] == P("ep", ("epx", "tp"), None)
 
     class TC2:
         tp_degree = 8
@@ -194,7 +194,79 @@ def test_hybrid_tpxep_specs():
     moe2 = MoEArch(**BASE, **moe_parallel_fields(TC2, 8))
     assert moe2.ep and not moe2.hybrid_ep
     specs2 = expert_parallel_specs(moe2)
-    assert specs2["experts"]["gate_proj"]["w"] == P(("ep", "tp"), None, None)
+    assert specs2["experts"]["gate_proj"]["w"] == P(("ep", "epx", "tp"), None, None)
 
     with pytest.raises(ValueError, match="must divide"):
         moe_parallel_fields(TC, 9)
+
+
+def test_per_phase_hybrid_specs_and_duplication():
+    """hybrid_sharding_config: prefill specs TP-heavy, decode copy EP-heavy
+    (reference: HybridShardingConfig config.py:1060 + mlp_op_tkg weight
+    duplication in the hybrid preshard hook)."""
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.config import HybridShardingConfig
+    from nxdi_tpu.ops.moe import duplicate_per_phase_experts
+
+    class TC:
+        tp_degree = 8
+        moe_ep_degree = None
+        moe_dispatch = "sparse"
+        hybrid_sharding_config = HybridShardingConfig(
+            moe_cte_ep_degree=2, moe_tkg_ep_degree=8
+        )
+
+    fields = moe_parallel_fields(TC, 8)
+    assert fields["per_phase_hybrid"] and fields["hybrid_ep"]
+    moe = MoEArch(**BASE, **fields)
+    specs = expert_parallel_specs(moe)
+    # prefill: experts over ep (2), intermediate over epx x tp (4x1... world/2)
+    assert specs["experts"]["gate_proj"]["w"] == P("ep", None, ("epx", "tp"))
+    # decode: experts over ep x epx (8), intermediate over tp
+    assert specs["experts_tkg"]["gate_proj"]["w"] == P(("ep", "epx"), None, "tp")
+    assert specs["experts_tkg"]["down_proj"]["w"] == P(("ep", "epx"), "tp", None)
+
+    rng = np.random.default_rng(0)
+    params = {"layers": _params(rng, moe, 16)}
+    dup = duplicate_per_phase_experts(params)
+    assert set(dup["layers"]) == {"router", "experts", "experts_tkg"}
+    np.testing.assert_array_equal(
+        dup["layers"]["experts_tkg"]["gate_proj"]["w"],
+        dup["layers"]["experts"]["gate_proj"]["w"],
+    )
+
+
+def test_per_phase_hybrid_block_matches_both_phases():
+    """The decode-phase block (EP-heavy copy) must produce the same numbers
+    as the prefill-phase block on an 8-device mesh."""
+    import jax
+
+    from nxdi_tpu.config import HybridShardingConfig
+    from nxdi_tpu.ops.moe import duplicate_per_phase_experts
+    from nxdi_tpu.parallel.mesh import build_mesh
+
+    class TC:
+        tp_degree = 8
+        moe_ep_degree = None
+        moe_dispatch = "sparse"
+        hybrid_sharding_config = HybridShardingConfig(
+            moe_cte_ep_degree=2, moe_tkg_ep_degree=8
+        )
+
+    fields = moe_parallel_fields(TC, 8)
+    moe_cte = MoEArch(**BASE, **fields)
+    moe_tkg = dataclasses.replace(moe_cte, phase="decode")
+    rng = np.random.default_rng(1)
+    p = duplicate_per_phase_experts(_params(rng, moe_cte, 16))
+    x = jnp.asarray(rng.standard_normal((2, 4, 16)) * 0.3, jnp.float32)
+
+    ref = moe_block(None, dataclasses.replace(moe_cte, hybrid_ep=False,
+                                              per_phase_hybrid=False), p, x)
+
+    mesh = build_mesh(tp_degree=8, ep_degree=2, epx_degree=4)
+    with jax.set_mesh(mesh):
+        out_cte = jax.jit(lambda p, x: moe_block(None, moe_cte, p, x))(p, x)
+        out_tkg = jax.jit(lambda p, x: moe_block(None, moe_tkg, p, x))(p, x)
+    np.testing.assert_allclose(np.asarray(out_cte), np.asarray(ref), atol=2e-5)
+    np.testing.assert_allclose(np.asarray(out_tkg), np.asarray(ref), atol=2e-5)

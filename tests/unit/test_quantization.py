@@ -91,13 +91,13 @@ def test_pytree_transforms_align():
         },
     }
     specs = {
-        "embed_tokens": P(("ep", "tp"), None),
+        "embed_tokens": P(("ep", "epx", "tp"), None),
         "layers": {
             "attn": {
-                "q_proj": {"w": P(None, None, ("ep", "tp"))},
-                "o_proj": {"w": P(None, ("ep", "tp"), None)},
+                "q_proj": {"w": P(None, None, ("ep", "epx", "tp"))},
+                "o_proj": {"w": P(None, ("ep", "epx", "tp"), None)},
             },
-            "mlp": {"down_proj": {"w": P(None, ("ep", "tp"), None), "b": P(None, None)}},
+            "mlp": {"down_proj": {"w": P(None, ("ep", "epx", "tp"), None), "b": P(None, None)}},
             "input_layernorm": P(None, None),
         },
     }
@@ -116,7 +116,7 @@ def test_pytree_transforms_align():
     assert "qw" in qp["layers"]["attn"]["q_proj"]
     assert "b" in qp["layers"]["mlp"]["down_proj"]
     # scale spec: in axis un-sharded, out axis inherits
-    assert qs["layers"]["attn"]["q_proj"]["scale"] == P(None, None, ("ep", "tp"))
+    assert qs["layers"]["attn"]["q_proj"]["scale"] == P(None, None, ("ep", "epx", "tp"))
     assert qs["layers"]["mlp"]["down_proj"]["scale"] == P(None, None, None)
 
     # shape struct mirrors quantized params
