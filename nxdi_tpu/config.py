@@ -408,6 +408,15 @@ class TpuConfig:
         # (reference: window-sized cache shapes kv_cache_manager.py:195-210):
         # cache S dim = sliding_window slots instead of seq_len
         self.window_sized_kv = kwargs.pop("window_sized_kv", False)
+        # long-context mode (reference: enable_long_context_mode, derived at
+        # >=32k — models/config.py:578-587 sets Neuron runtime/compiler modes;
+        # the TPU analog coarsens the bucket ladders so 128k-class configs
+        # don't compile a dozen huge CTE programs). Auto-on at 32k; override
+        # explicitly to force either way.
+        _lcm = kwargs.pop("long_context_mode", None)
+        self.long_context_mode = (
+            bool(_lcm) if _lcm is not None else self.seq_len >= 32 * 1024
+        )
         self.windowed_context_encoding_size = kwargs.pop("windowed_context_encoding_size", None)
         self.logical_nc_config = kwargs.pop("logical_nc_config", 1)
         self.skip_warmup = kwargs.pop("skip_warmup", False)

@@ -416,8 +416,12 @@ class WindowKVLayout:
         ):
             seq_ids = cache_inputs["seq_ids"] if self.route_by_seq_id else None
             if policy is not None:
+                # carry the policy's seq-dim axis through so a seq-sharded
+                # ring (never valid today — config rejects flash-decoding +
+                # window_sized_kv — but specs mirror the full cache) trips
+                # sharded_commit_call's bail instead of mis-sharding
                 ck = policy.cache_kv
-                pspec = P(None, ck[0], ck[1], None, None)
+                pspec = P(None, ck[0], ck[1], ck[2], None)
             else:
                 pspec = P(None, None, AXIS_MP, None, None)
             store = cache["k"].dtype

@@ -60,6 +60,18 @@ ACT_FNS: Dict[str, Callable] = {
     "relu": jax.nn.relu,
 }
 
+# Attention-strategy trace: attention_block appends the strategy each traced
+# attention body actually chose (kernel vs XLA fallback). Strategy decisions
+# are STATIC (flags, shapes, mesh layout), so recording at trace time is
+# exact — the analog of the reference's FlashAttentionStrategy logging
+# (attention_base.py:165,1330); model_wrapper snapshots this per
+# (submodel, bucket) so silent kernel fallbacks are visible and assertable.
+_STRATEGY_TRACE: list = []
+
+
+def _record_strategy(name: str) -> None:
+    _STRATEGY_TRACE.append(name)
+
 
 @dataclass(frozen=True)
 class DecoderArch:
@@ -492,11 +504,13 @@ def attention_block(
                 chunk_size=arch.chunk_size,
             )
             if ctx is not None:
+                _record_strategy("tkg_fused_kernel")
                 ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
                 out = _linear(
                     ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
                 )
                 return out, (k, v)
+        _record_strategy("tkg_two_part_xla")
         wpos = ci.get("write_positions", position_ids).astype(jnp.int32)
         hit = jnp.any(kv_pos[:, None, :] == wpos[:, :, None], axis=1)
         kv_pos = jnp.where(hit, jnp.int32(2 ** 30), kv_pos)
@@ -549,6 +563,7 @@ def attention_block(
                 v_scale=layout.v_scale,
             )
             if ctx is not None:
+                _record_strategy("cte_paged_kernel")
                 ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
                 out = _linear(
                     ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
@@ -583,6 +598,7 @@ def attention_block(
                 v_scale=layout.v_scale,
             )
             if ctx is not None:
+                _record_strategy("tkg_paged_kernel")
                 ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
                 out = _linear(
                     ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
@@ -598,6 +614,7 @@ def attention_block(
             # Sink/softcap still apply; window/chunk masks cannot compose with
             # an override (applications reject those combinations up front).
             W = kk.shape[2]
+            _record_strategy("attn_mask_override_xla")
             ctx = attn_ops.grouped_attention(
                 q, kk, vv, mask_override[:, :, :W],
                 scale=arch.attention_scale, softmax_dtype=jnp.float32,
@@ -624,6 +641,7 @@ def attention_block(
                 sliding_window=arch.sliding_window,
                 chunk_size=arch.chunk_size,
             )
+        _record_strategy("tkg_xla" if ctx is None else "tkg_kernel")
         if ctx is None:
             ctx = attn_ops.attention_with_positions(
                 q, kk, vv, position_ids, kv_pos,
@@ -654,6 +672,7 @@ def attention_block(
                 sliding_window=arch.sliding_window,
                 chunk_size=arch.chunk_size,
             )
+        _record_strategy("cte_xla" if ctx is None else "cte_flash_kernel")
         if ctx is None:
             ctx = attn_ops.attention_with_positions(
                 q, k, v, position_ids, position_ids,

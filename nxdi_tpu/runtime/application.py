@@ -287,10 +287,16 @@ class ApplicationBase:
     def _cache_struct(self):
         spec = self._cache_spec()
         shape_v = getattr(spec, "shape_v", spec.shape)
-        return {
+        struct = {
             "k": jax.ShapeDtypeStruct(spec.shape, spec.store_dtype),
             "v": jax.ShapeDtypeStruct(shape_v, spec.store_dtype),
         }
+        ring = self._ring_cache_spec()
+        if ring is not None:  # interleaved window-sized split (AOT parity
+            # with init_cache_host — the traced program needs k_win/v_win)
+            struct["k_win"] = jax.ShapeDtypeStruct(ring.shape, ring.store_dtype)
+            struct["v_win"] = jax.ShapeDtypeStruct(ring.shape_v, ring.store_dtype)
+        return struct
 
     def _cache_spec(self, family=None, config=None):
         family = family or self.family

@@ -15,6 +15,10 @@ from typing import List, Sequence
 BUCKET_SELECTION_STRATEGIES = {"max", "first_fit", "second_fit"}
 
 
+def _pow2_at_least(n: int) -> int:
+    return 1 << int(ceil(log2(n)))
+
+
 def generate_buckets(min_length: int, max_length: int) -> List[int]:
     """reference: autobucketing.py:8-20 (round(log2) spacing, max appended)."""
     if min_length == max_length:
@@ -62,6 +66,15 @@ def context_encoding_buckets(config) -> List[int]:
         return sorted(tc.context_encoding_buckets)
     if not tc.enable_bucketing:
         return [tc.max_context_length]
+    if getattr(tc, "long_context_mode", False):
+        # long-context mode (reference: enable_long_context_mode at >=32k,
+        # models/config.py:578-587 — there it flips runtime/compiler modes;
+        # here the compile-time lever is the LADDER: a dense pow-2 ladder to
+        # 128k+ means a dozen huge CTE programs, so keep only rungs within
+        # 8x of the max (lo rounded UP to a power of two — generate_buckets
+        # floors its log2, which would sneak in a 16x rung)
+        lo = _pow2_at_least(max(128, tc.max_context_length // 8))
+        return generate_buckets(min(lo, tc.max_context_length), tc.max_context_length)
     return generate_buckets(min(128, tc.max_context_length), tc.max_context_length)
 
 
@@ -76,6 +89,9 @@ def token_generation_buckets(config) -> List[int]:
         return sorted(tc.token_generation_buckets)
     if not tc.enable_bucketing:
         return [tc.seq_len]
+    if getattr(tc, "long_context_mode", False):
+        lo = _pow2_at_least(max(128, tc.seq_len // 8))
+        return generate_buckets(min(lo, tc.seq_len), tc.seq_len)
     return generate_buckets(min(128, tc.seq_len), tc.seq_len)
 
 

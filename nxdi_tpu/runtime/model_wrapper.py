@@ -16,6 +16,8 @@ subsumes most of the reference's async_execution machinery.
 
 from __future__ import annotations
 
+import logging
+
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
@@ -70,24 +72,51 @@ class _AutoLayoutProgram:
     only when) its current layout differs — one relayout at a program
     transition (e.g. prefill -> decode), zero in the steady-state chain."""
 
-    def __init__(self, jitted):
+    def __init__(self, jitted, label: str = "?"):
         self.jitted = jitted
+        self.label = label
         self._compiled = None
         self._cache_formats = None
+        # attention strategies the traced program actually chose (reference:
+        # FlashAttentionStrategy logging, attention_base.py:1330) — filled at
+        # lowering; silent kernel fallbacks become visible and assertable
+        self.attention_strategies: tuple = ()
 
     def lower(self, *args):  # AOT artifact path passthrough
-        return self.jitted.lower(*args)
+        from nxdi_tpu.models import base as base_mod
+
+        base_mod._STRATEGY_TRACE.clear()
+        lowered = self.jitted.lower(*args)
+        self._snap_strategies(base_mod)
+        return lowered
+
+    def _snap_strategies(self, base_mod):
+        if not base_mod._STRATEGY_TRACE:
+            # jaxpr-tracing cache hit: the python body (and its recording)
+            # did not re-run — keep the strategies from the first lowering
+            return
+        self.attention_strategies = tuple(base_mod._STRATEGY_TRACE)
+        logging.getLogger("nxdi_tpu").info(
+            "%s attention strategies: %s",
+            self.label,
+            ",".join(self.attention_strategies),
+        )
 
     def __call__(self, params, cache, batch):
         if self._compiled is None:
             # AUTO layouts resolve at compile time, so lowering must see
             # ABSTRACT args (concrete arrays carry a fixed layout and trip
             # jit's layout check)
+            from nxdi_tpu.models import base as base_mod
+
             absargs = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
                 (params, cache, batch),
             )
-            self._compiled = self.jitted.lower(*absargs).compile()
+            base_mod._STRATEGY_TRACE.clear()
+            lowered = self.jitted.lower(*absargs)
+            self._snap_strategies(base_mod)
+            self._compiled = lowered.compile()
             self._cache_formats = self._compiled.input_formats[0][1]
         flat, treedef = jax.tree_util.tree_flatten(cache)
         fmts = jax.tree_util.tree_leaves(self._cache_formats)
@@ -262,7 +291,7 @@ class ModelWrapper:
             out_shardings=(None, auto),
             donate_argnums=(1,),
         )
-        return _AutoLayoutProgram(jitted)
+        return _AutoLayoutProgram(jitted, label=f"{self.tag}[{bucket}]")
 
     def _layout_input_keys(self):
         if isinstance(self.layout, BlockKVLayout):

@@ -233,3 +233,40 @@ def test_per_phase_hybrid_moe_token_matching():
     expected = hf_greedy(hf_model, prompt, max_new_tokens=16)
     actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=16)
     np.testing.assert_array_equal(actual, expected)
+
+
+def test_attention_strategy_observability(tiny_hf_llama):
+    """Each compiled program records which attention strategy it traced with
+    (reference: FlashAttentionStrategy logging, attention_base.py:1330) — a
+    silently-disengaged kernel becomes an assertable regression, not a perf
+    mystery."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg, attn_kernel_enabled=True, attn_tkg_kernel_enabled=True
+    )
+    adapter = HuggingFaceGenerationAdapter(app)
+    adapter.generate(np.tile(PROMPT, (1, 1)), max_new_tokens=4)
+    strategies = {
+        tag: prog.attention_strategies
+        for tag, w in app.models.items()
+        for prog in w._programs.values()
+        if prog.attention_strategies
+    }
+    # prefill traced the flash kernel, decode the fused deferred-write kernel
+    assert any("cte_flash_kernel" in s for s in strategies.values()), strategies
+    assert any("tkg_fused_kernel" in s for s in strategies.values()), strategies
+
+    # flash decoding (KV-S sharded cache) CANNOT run the single-shard kernels:
+    # the fallback must be VISIBLE in the recorded strategies
+    app2 = _build_app(
+        hf_model, hf_cfg, attn_kernel_enabled=True, attn_tkg_kernel_enabled=True,
+        cp_degree=2, flash_decoding_enabled=True, enable_bucketing=False,
+    )
+    adapter2 = HuggingFaceGenerationAdapter(app2)
+    adapter2.generate(np.tile(PROMPT, (1, 1)), max_new_tokens=4)
+    tkg = app2.models["token_generation_model"]
+    tkg_strats = [p.attention_strategies for p in tkg._programs.values()
+                  if p.attention_strategies]
+    assert tkg_strats and all(
+        "tkg_xla" in s or "tkg_two_part_xla" in s for s in tkg_strats
+    ), tkg_strats
