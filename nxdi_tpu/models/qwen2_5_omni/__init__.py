@@ -1,0 +1,1 @@
+from nxdi_tpu.models.qwen2_5_omni import modeling_qwen2_5_omni  # noqa: F401

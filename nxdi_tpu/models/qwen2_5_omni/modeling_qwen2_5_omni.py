@@ -1,0 +1,312 @@
+"""Qwen2.5-Omni (thinker) — audio-to-text on the multimodal base.
+
+Reference: contrib/models/Qwen2.5-Omni-7B (the audio-omni slice of the contrib
+hub). The audio tower is a whisper-style windowed mel encoder
+(HF ``Qwen2_5OmniAudioEncoder``): mel features split into 2*n_window-frame
+chunks -> conv1(k3) gelu -> conv2(k3, stride 2) gelu -> per-chunk sinusoidal
+positions -> BLOCK-DIAGONAL bidirectional attention (each chunk attends only
+itself; k_proj has no bias, q/v/out do) -> pair-average pooling over the
+concatenated valid frames -> LayerNorm -> projection to the text width. The
+projected frames replace the ``<|AUDIO|>`` placeholder tokens in the prefill
+embedding stream — the image-to-text merge machinery verbatim
+(models/image_to_text.py; reference: image_to_text_model_base.py).
+
+Text side: the thinker text model is qwen2-style (qkv biases, o un-biased).
+Its TMRoPE collapses for text+audio inputs — HF assigns audio frames
+sequential positions IDENTICAL across the three rope streams
+(modeling_qwen2_5_omni.py get_rope_index: ``arange(audio_len).expand(3, -1)``)
+— so standard 1-D rope positions reproduce HF numerics exactly; the full
+M-RoPE machinery engages only for vision inputs (models/qwen2_vl).
+
+The whisper encoder machinery (models/whisper) is the sibling this reuses
+conceptually; the chunked/block-diagonal structure here maps to a batch dim
+(chunks) so no masking tricks are needed for full chunks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Qwen2_5OmniInferenceConfig(dense.DenseInferenceConfig):
+    """HF thinker config nests audio/vision/text configs; promote text."""
+
+    REQUIRED = ["text_config", "audio_config"]
+
+    def add_derived_config(self):
+        from nxdi_tpu.config import promote_text_config
+
+        promote_text_config(self)
+        ac = self.audio_config
+        if not isinstance(ac, dict):
+            self.audio_config = ac.to_dict()
+        if not hasattr(self, "audio_token_index"):
+            self.audio_token_index = getattr(self, "audio_token_id", None)
+        # the multimodal base reads image_token_index; audio IS the modality
+        self.image_token_index = self.audio_token_index
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    # thinker text attention is qwen2-style: qkv biases, o un-biased
+    return dense.build_arch(config, **{"attention_bias": True, **overrides})
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))
+
+
+# ---------------------------------------------------------------------------
+# Audio tower
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class AudioArch:
+    d_model: int
+    num_heads: int
+    num_layers: int
+    ffn_dim: int
+    num_mel_bins: int
+    n_window: int
+    output_dim: int
+
+
+def build_vision_arch(config: InferenceConfig) -> AudioArch:
+    """(named for the multimodal base's hook contract; the 'vision' tower of
+    this family is the AUDIO encoder)"""
+    ac = config.audio_config
+    return AudioArch(
+        d_model=ac["d_model"],
+        num_heads=ac["encoder_attention_heads"],
+        num_layers=ac["encoder_layers"],
+        ffn_dim=ac["encoder_ffn_dim"],
+        num_mel_bins=ac["num_mel_bins"],
+        n_window=ac.get("n_window", 100),
+        output_dim=ac.get("output_dim", config.hidden_size),
+    )
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    """Audio-frame capacity per request: the CTE program's fixed feature
+    width. T mel frames -> ceil(T/2) after the strided conv -> //2 after the
+    pair pooler."""
+    cap = int(getattr(config, "audio_frames_capacity", 4 * (config.audio_config.get("n_window", 100))))
+    return ((cap - 1) // 2 + 1) // 2
+
+
+def _layer_norm(x, w, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, -1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, -1, keepdims=True)
+    return ((xf - mu) * jax.lax.rsqrt(var + eps) * w + b).astype(x.dtype)
+
+
+def _sinusoid_positions(length: int, channels: int) -> np.ndarray:
+    log_inc = np.log(10000.0) / (channels // 2 - 1)
+    inv = np.exp(-log_inc * np.arange(channels // 2, dtype=np.float64))
+    t = np.arange(length, dtype=np.float64)[:, None] * inv[None, :]
+    return np.concatenate([np.sin(t), np.cos(t)], axis=1).astype(np.float32)
+
+
+def encode_audio(arch: AudioArch, params: Dict[str, Any], input_features, feature_len):
+    """(mel, T) mel features -> (1, N, output_dim) audio frames.
+
+    ``T`` must be a multiple of 2*n_window (the chunking grid; right-pad the
+    mel features — ``feature_len`` marks the true length and everything past
+    it is masked out of attention and pooling)."""
+    p = params["audio"]
+    mel, T = input_features.shape
+    win2 = 2 * arch.n_window
+    assert T % win2 == 0, "pad mel features to a multiple of 2*n_window"
+    n_chunks = T // win2
+    feat = input_features.astype(jnp.float32).reshape(mel, n_chunks, win2)
+    feat = jnp.swapaxes(feat, 0, 1)  # (chunks, mel, win2)
+
+    # per-chunk true lengths from the flat feature_len
+    starts = jnp.arange(n_chunks, dtype=jnp.int32) * win2
+    chunk_len = jnp.clip(feature_len - starts, 0, win2)  # (chunks,)
+    frame_idx = jnp.arange(win2, dtype=jnp.int32)[None, :]
+    in_mask = (frame_idx < chunk_len[:, None]).astype(jnp.float32)  # (chunks, win2)
+
+    def conv1d(x, w, b, stride):
+        # x (N, C, L), w (out, in, k) torch layout
+        return jax.lax.conv_general_dilated(
+            x, w, (stride,), [(1, 1)],
+            dimension_numbers=("NCH", "OIH", "NCH"),
+        ) + b[None, :, None]
+
+    h = jax.nn.gelu(conv1d(feat, p["conv1_w"], p["conv1_b"], 1))
+    h = h * in_mask[:, None, :]
+    h = jax.nn.gelu(conv1d(h, p["conv2_w"], p["conv2_b"], 2))
+    h = jnp.swapaxes(h, 1, 2)  # (chunks, win, d)
+    win = h.shape[1]
+    h = h + jnp.asarray(_sinusoid_positions(win, arch.d_model))[None]
+
+    after_len = (chunk_len - 1) // 2 + 1  # ceil(len/2); 0 stays invalid below
+    after_len = jnp.where(chunk_len > 0, after_len, 0)
+    pos = jnp.arange(win, dtype=jnp.int32)[None, :]
+    valid = pos < after_len[:, None]  # (chunks, win)
+
+    Hh = arch.num_heads
+    D = arch.d_model // Hh
+    scale = D ** -0.5
+    for layer in p["layers"]:
+        x = _layer_norm(h, layer["ln1_w"], layer["ln1_b"])
+        q = (x @ layer["q_w"] + layer["q_b"]).reshape(n_chunks, win, Hh, D)
+        k = (x @ layer["k_w"]).reshape(n_chunks, win, Hh, D)
+        v = (x @ layer["v_w"] + layer["v_b"]).reshape(n_chunks, win, Hh, D)
+        s = jnp.einsum("cqhd,ckhd->chqk", q, k).astype(jnp.float32) * scale
+        s = jnp.where(valid[:, None, None, :], s, -1e30)
+        w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("chqk,ckhd->cqhd", w, v).reshape(n_chunks, win, arch.d_model)
+        h = h + ctx @ layer["out_w"] + layer["out_b"]
+        x = _layer_norm(h, layer["ln2_w"], layer["ln2_b"])
+        x = jax.nn.gelu(x @ layer["fc1_w"] + layer["fc1_b"])
+        h = h + x @ layer["fc2_w"] + layer["fc2_b"]
+
+    # compact the valid frames of all chunks into one flat sequence
+    flat = h.reshape(n_chunks * win, arch.d_model)
+    flat_valid = valid.reshape(-1)
+    slot = jnp.cumsum(flat_valid.astype(jnp.int32)) - 1
+    cap = n_chunks * win
+    slot = jnp.where(flat_valid, slot, cap)
+    compact = jnp.zeros((cap + 1, arch.d_model), flat.dtype).at[slot].set(flat)[:cap]
+    n_flat = jnp.sum(flat_valid.astype(jnp.int32))
+
+    # pair-average pooling (AvgPool1d(2, 2): a trailing odd frame drops)
+    pooled = (compact[0::2] + compact[1::2]) * 0.5  # (cap//2, d)
+    n_pooled = n_flat // 2
+    pooled = _layer_norm(pooled, p["ln_post_w"], p["ln_post_b"])
+    out = pooled @ p["proj_w"] + p["proj_b"]
+    keep = jnp.arange(out.shape[0], dtype=jnp.int32) < n_pooled
+    out = jnp.where(keep[:, None], out, 0.0)
+    return out[None]  # (1, N, output_dim)
+
+
+def encode_images(varch, params, pixel_values):
+    """Multimodal-base hook: 'images' are mel features here. ``pixel_values``
+    (mel, T) or (1, mel, T); full-length features (no padding)."""
+    feats = jnp.asarray(pixel_values)
+    if feats.ndim == 3:
+        feats = feats[0]
+    return encode_audio(varch, params, feats, feats.shape[1])
+
+
+def convert_vision_params(state_dict, config: InferenceConfig):
+    arch = build_vision_arch(config)
+    f32 = lambda a: np.asarray(a, np.float32)  # noqa: E731
+
+    def get(name):
+        for k in (name, f"thinker.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    layers = []
+    for i in range(arch.num_layers):
+        lp = f"audio_tower.layers.{i}."
+        layers.append({
+            "ln1_w": f32(get(lp + "self_attn_layer_norm.weight")),
+            "ln1_b": f32(get(lp + "self_attn_layer_norm.bias")),
+            "q_w": f32(get(lp + "self_attn.q_proj.weight").T),
+            "q_b": f32(get(lp + "self_attn.q_proj.bias")),
+            "k_w": f32(get(lp + "self_attn.k_proj.weight").T),
+            "v_w": f32(get(lp + "self_attn.v_proj.weight").T),
+            "v_b": f32(get(lp + "self_attn.v_proj.bias")),
+            "out_w": f32(get(lp + "self_attn.out_proj.weight").T),
+            "out_b": f32(get(lp + "self_attn.out_proj.bias")),
+            "ln2_w": f32(get(lp + "final_layer_norm.weight")),
+            "ln2_b": f32(get(lp + "final_layer_norm.bias")),
+            "fc1_w": f32(get(lp + "fc1.weight").T),
+            "fc1_b": f32(get(lp + "fc1.bias")),
+            "fc2_w": f32(get(lp + "fc2.weight").T),
+            "fc2_b": f32(get(lp + "fc2.bias")),
+        })
+    audio = {
+        "conv1_w": f32(get("audio_tower.conv1.weight")),
+        "conv1_b": f32(get("audio_tower.conv1.bias")),
+        "conv2_w": f32(get("audio_tower.conv2.weight")),
+        "conv2_b": f32(get("audio_tower.conv2.bias")),
+        "layers": layers,
+        "ln_post_w": f32(get("audio_tower.ln_post.weight")),
+        "ln_post_b": f32(get("audio_tower.ln_post.bias")),
+        "proj_w": f32(get("audio_tower.proj.weight").T),
+        "proj_b": f32(get("audio_tower.proj.bias")),
+    }
+    return {"audio": audio}
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    arch = build_vision_arch(config)
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, np.float32)
+
+    d, f = arch.d_model, arch.ffn_dim
+    layer = {
+        "ln1_w": s(d), "ln1_b": s(d),
+        "q_w": s(d, d), "q_b": s(d),
+        "k_w": s(d, d),
+        "v_w": s(d, d), "v_b": s(d),
+        "out_w": s(d, d), "out_b": s(d),
+        "ln2_w": s(d), "ln2_b": s(d),
+        "fc1_w": s(d, f), "fc1_b": s(f),
+        "fc2_w": s(f, d), "fc2_b": s(d),
+    }
+    return {
+        "audio": {
+            "conv1_w": s(d, arch.num_mel_bins, 3),
+            "conv1_b": s(d),
+            "conv2_w": s(d, d, 3),
+            "conv2_b": s(d),
+            "layers": [dict(layer) for _ in range(arch.num_layers)],
+            "ln_post_w": s(d), "ln_post_b": s(d),
+            "proj_w": s(d, arch.output_dim), "proj_b": s(arch.output_dim),
+        }
+    }
+
+
+class Qwen2_5OmniForCausalLM(ImageToTextForCausalLM):
+    """Audio-to-text thinker application. ``forward``/``generate`` accept the
+    mel features as ``input_features`` (or through the adapter's
+    ``pixel_values`` slot, which this family defines as mel features)."""
+
+    def encode_images(self, pixel_values):
+        from functools import partial
+
+        if self._encode_jit is None:
+            varch = self.family.build_vision_arch(self.config)
+            self._encode_jit = jax.jit(partial(encode_images, varch))
+        with jax.set_mesh(self.mesh):
+            return self._encode_jit(
+                {"audio": self.params["audio"]},
+                np.asarray(pixel_values, dtype=np.float32),
+            )
+
+    def forward(self, input_ids, position_ids, input_features=None, **kwargs):
+        if input_features is not None:
+            kwargs.setdefault("pixel_values", input_features)
+        return super().forward(input_ids, position_ids, **kwargs)
+
+
+APPLICATION_CLS = Qwen2_5OmniForCausalLM

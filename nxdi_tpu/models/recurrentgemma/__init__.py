@@ -1,0 +1,1 @@
+from nxdi_tpu.models.recurrentgemma import modeling_recurrentgemma  # noqa: F401

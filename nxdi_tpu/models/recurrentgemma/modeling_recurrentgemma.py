@@ -1,0 +1,691 @@
+"""RecurrentGemma (Griffin) — RG-LRU recurrent blocks + local-attention hybrid.
+
+Reference: contrib/models/recurrentgemma-2b-it (the SSM/recurrent-hybrid slice
+of the contrib hub). The reusable recurrent-state machinery generalizes the
+qwen3_next pattern (models/qwen3_next): a heterogeneous per-layer walk with a
+dedicated state cache pytree — here
+  - ``k``/``v``:  (n_attn, B, KV, W, D) RING stacks for the attention layers
+                  (HF keeps a window-sized cache holding the last W tokens;
+                  slot = position % W, the WindowKVLayout convention),
+  - ``conv``:     (n_rec, B, lru_width, conv_kernel - 1) causal-conv tails,
+  - ``rec``:      (n_rec, B, lru_width) f32 RG-LRU hidden states.
+
+Architecture notes (HF ``modeling_recurrent_gemma.py`` semantics, matched
+exactly for token parity):
+  - blocks cycle ``block_types`` (default [recurrent, recurrent, attention]);
+  - every layer: x + temporal(temporal_norm(x)) -> r; r + mlp(channel_norm(r));
+  - gemma-style (1 + w) RMSNorm; embeddings scaled by sqrt(hidden) ROUNDED
+    THROUGH bf16 (HF registers the normalizer as a bfloat16 buffer);
+  - attention: GQA at head_dim with PARTIAL rotary (first half of the head
+    dim), o_proj bias always on, window-sized ring cache. HF's prefill mask
+    is plain causal (the window binds only through the decode-time ring
+    content), reproduced here;
+  - recurrent block: y = gelu_tanh(linear_y(x)); x2 = causal-conv1d(
+    linear_x(x)); x2 = RG-LRU(x2); out = linear_out(x2 * y). RG-LRU gates are
+    BLOCK-DIAGONAL per attention head over lru_width: in/rec gates =
+    sigmoid(x_h @ W_h + b_h); log_a = -8 * rec_gate * softplus(Lambda);
+    h_t = exp(log_a)*h_{t-1} + sqrt(1 - exp(2 log_a)) * in_gate * x_t (the
+    sqrt multiplier is 1 at position 0), state carried in f32;
+  - MLP: gelu_tanh(gate(x)) * up(x) -> down, ALL with biases, each projection
+    at intermediate_size // 2 (the config field is the doubled value);
+  - final logits soft-capped: 30 * tanh(logits / 30); embeddings tied.
+
+Right padding: pad lanes must not advance recurrent state — conv tails keep
+the last kernel-1 REAL inputs per row and the RG-LRU scan freezes its state
+on invalid positions (the HF reference trusts left-padding instead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, dtype_name
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops.rope import rope_cos_sin
+from nxdi_tpu.parallel.layers import REPLICATED
+from nxdi_tpu.parallel.mesh import AXIS_MP
+
+RGLRU_C = 8.0  # the recurrence temperature constant (HF log_recurrent_gate)
+
+
+@dataclass(frozen=True)
+class RecurrentGemmaArch:
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int  # per-projection (HF config value // 2)
+    lru_width: int
+    conv_kernel: int
+    attention_window: int
+    rotary_dim: int
+    vocab_size: int
+    vocab_pad: int
+    layer_types: Tuple[str, ...]  # "recurrent" | "attention" per layer
+    rms_norm_eps: float
+    attention_bias: bool
+    rope_theta: float
+    logits_softcap: Optional[float]
+    embed_scale: float
+    dtype: str
+
+    @property
+    def n_attn(self) -> int:
+        return sum(t == "attention" for t in self.layer_types)
+
+    @property
+    def n_rec(self) -> int:
+        return sum(t == "recurrent" for t in self.layer_types)
+
+    @property
+    def block_width(self) -> int:
+        return self.lru_width // self.num_attention_heads
+
+
+class RecurrentGemmaInferenceConfig(InferenceConfig):
+    REQUIRED = [
+        "hidden_size",
+        "intermediate_size",
+        "num_hidden_layers",
+        "num_attention_heads",
+        "num_key_value_heads",
+        "vocab_size",
+    ]
+
+    def add_derived_config(self):
+        if not hasattr(self, "block_types"):
+            self.block_types = ["recurrent", "recurrent", "attention"]
+        if not hasattr(self, "lru_width") or self.lru_width is None:
+            self.lru_width = self.hidden_size
+        if not hasattr(self, "conv1d_width"):
+            self.conv1d_width = 4
+        if not hasattr(self, "attention_window_size"):
+            self.attention_window_size = 2048
+        if not hasattr(self, "partial_rotary_factor"):
+            self.partial_rotary_factor = 0.5
+        if not hasattr(self, "logits_soft_cap"):
+            self.logits_soft_cap = 30.0
+        if not hasattr(self, "head_dim"):
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+def _layer_types(config: InferenceConfig) -> Tuple[str, ...]:
+    pattern = list(getattr(config, "block_types", ["recurrent", "recurrent", "attention"]))
+    return tuple(pattern[i % len(pattern)] for i in range(config.num_hidden_layers))
+
+
+def build_arch(config: InferenceConfig, **overrides) -> RecurrentGemmaArch:
+    import ml_dtypes
+
+    hidden = config.hidden_size
+    kwargs = dict(
+        num_layers=config.num_hidden_layers,
+        hidden_size=hidden,
+        num_attention_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        head_dim=config.head_dim,
+        intermediate_size=config.intermediate_size // 2,
+        lru_width=config.lru_width,
+        conv_kernel=config.conv1d_width,
+        attention_window=config.attention_window_size,
+        rotary_dim=int(config.partial_rotary_factor * config.head_dim),
+        vocab_size=config.vocab_size,
+        vocab_pad=0,
+        layer_types=_layer_types(config),
+        rms_norm_eps=float(getattr(config, "rms_norm_eps", 1e-6)),
+        attention_bias=bool(getattr(config, "attention_bias", False)),
+        rope_theta=float(getattr(config, "rope_theta", 10000.0)),
+        logits_softcap=float(getattr(config, "logits_soft_cap", 30.0)) or None,
+        # HF stores the sqrt(hidden) normalizer as a BFLOAT16 buffer — the
+        # rounded value is what scales the embeddings in every dtype
+        embed_scale=float(np.asarray(hidden**0.5, ml_dtypes.bfloat16)),
+        dtype=dtype_name(config.tpu_config.dtype),
+    )
+    kwargs.update(overrides)
+    return RecurrentGemmaArch(**kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    rd = int(config.partial_rotary_factor * config.head_dim)
+    theta = float(getattr(config, "rope_theta", 10000.0))
+    return 1.0 / (theta ** (np.arange(0, rd, 2, dtype=np.float64) / rd)).astype(
+        np.float64
+    )
+
+
+def _rms(arch, x, w):
+    xf = x.astype(jnp.float32)
+    n = xf * jax.lax.rsqrt(jnp.mean(xf * xf, -1, keepdims=True) + arch.rms_norm_eps)
+    return (n * (1.0 + w.astype(jnp.float32))).astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Recurrent (Griffin/Hawk) block
+# ---------------------------------------------------------------------------
+
+
+def _rg_lru(arch, lp, x, position_ids, valid, state0):
+    """x (B, S, lru) -> (out, new_state); state carried in f32.
+
+    HF RecurrentGemmaRglru semantics: block-diagonal gates per attention
+    head; h_t = a_t h_{t-1} + sqrt(1 - a_t^2) * i_t * x_t with the sqrt
+    multiplier replaced by 1 where position == 0. Invalid (right-pad) steps
+    freeze the state."""
+    B, S, L = x.shape
+    Hh, bw = arch.num_attention_heads, arch.block_width
+    xf = x.astype(jnp.float32)
+    xh = xf.reshape(B, S, Hh, bw)
+    in_gate = jax.nn.sigmoid(
+        jnp.einsum("bshw,hwo->bsho", xh, lp["input_gate_w"].astype(jnp.float32))
+        + lp["input_gate_b"].astype(jnp.float32)
+    ).reshape(B, S, L)
+    rec_gate = jax.nn.sigmoid(
+        jnp.einsum("bshw,hwo->bsho", xh, lp["recurrent_gate_w"].astype(jnp.float32))
+        + lp["recurrent_gate_b"].astype(jnp.float32)
+    ).reshape(B, S, L)
+    log_a = -RGLRU_C * rec_gate * jax.nn.softplus(
+        lp["recurrent_param"].astype(jnp.float32)
+    )
+    a = jnp.exp(log_a)
+    reset = (position_ids == 0)[:, :, None]
+    multiplier = jnp.where(reset, 1.0, jnp.sqrt(jnp.maximum(1.0 - jnp.exp(2.0 * log_a), 0.0)))
+    gated = xf * in_gate * multiplier
+    a = jnp.where(reset, 0.0, a)
+    # pad lanes: identity transition
+    ok = valid[:, :, None]
+    a = jnp.where(ok, a, 1.0)
+    gated = jnp.where(ok, gated, 0.0)
+
+    def step(h, xs):
+        a_t, g_t = xs
+        h = a_t * h + g_t
+        return h, h
+
+    state, ys = jax.lax.scan(
+        step, state0.astype(jnp.float32),
+        (jnp.swapaxes(a, 0, 1), jnp.swapaxes(gated, 0, 1)),
+    )
+    return jnp.swapaxes(ys, 0, 1).astype(x.dtype), state
+
+
+def recurrent_layer(arch, lp, x, position_ids, valid, conv_state, rec_state,
+                    last_token_index, is_decode):
+    """HF RecurrentGemmaRecurrentBlock: gelu(linear_y) gate x causal-conv +
+    RG-LRU core -> linear_out."""
+    B, S, _ = x.shape
+    K = arch.conv_kernel
+    y = jax.nn.gelu(x @ lp["linear_y_w"] + lp["linear_y_b"], approximate=True)
+    xb = x @ lp["linear_x_w"] + lp["linear_x_b"]  # (B, S, lru)
+    w = lp["conv_w"]  # (lru, K)
+    if is_decode:
+        # conv over [state, x_t]: one weighted sum per channel
+        window = jnp.concatenate(
+            [conv_state, jnp.swapaxes(xb, 1, 2)], axis=-1
+        )  # (B, lru, K-1+S) with S == 1 -> K
+        out = jnp.sum(window * w[None], axis=-1) + lp["conv_b"]
+        conv_out = out[:, None, :]  # (B, 1, lru)
+        new_conv = window[:, :, 1:]
+    else:
+        xt = jnp.swapaxes(xb, 1, 2)  # (B, lru, S)
+        padded = jnp.pad(xt, ((0, 0), (0, 0), (K - 1, 0)))
+        conv = sum(
+            padded[:, :, j : j + S] * w[:, j][None, :, None] for j in range(K)
+        ) + lp["conv_b"][None, :, None]
+        conv_out = jnp.swapaxes(conv, 1, 2)
+        # tail = last K-1 REAL inputs per row (right padding skipped)
+        lti = last_token_index.astype(jnp.int32)
+        idx = lti[:, None] - jnp.arange(K - 2, -1, -1, dtype=jnp.int32)[None, :]
+        gathered = jnp.take_along_axis(
+            jnp.pad(xt, ((0, 0), (0, 0), (0, 1))),
+            jnp.clip(idx, 0, S)[:, None, :].repeat(xt.shape[1], axis=1),
+            axis=2,
+        )
+        new_conv = jnp.where((idx >= 0)[:, None, :], gathered, 0.0).astype(
+            conv_state.dtype
+        )
+    core, new_rec = _rg_lru(arch, lp, conv_out, position_ids, valid, rec_state)
+    out = (core * y) @ lp["linear_out_w"] + lp["linear_out_b"]
+    return out, new_conv, new_rec
+
+
+# ---------------------------------------------------------------------------
+# Windowed (ring) attention block
+# ---------------------------------------------------------------------------
+
+
+def attention_layer(arch, lp, x, cos, sin, k_ring, v_ring, position_ids,
+                    last_token_index, is_decode):
+    B, S, _ = x.shape
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    W = k_ring.shape[2]
+    q = x @ lp["q_w"]
+    k = x @ lp["k_w"]
+    v = x @ lp["v_w"]
+    if arch.attention_bias:
+        q, k, v = q + lp["q_b"], k + lp["k_b"], v + lp["v_b"]
+    q = jnp.swapaxes(q.reshape(B, S, H, D), 1, 2)
+    k = jnp.swapaxes(k.reshape(B, S, KV, D), 1, 2)
+    v = jnp.swapaxes(v.reshape(B, S, KV, D), 1, 2)
+
+    rd = arch.rotary_dim
+    cosb = cos[:, None].astype(jnp.float32)
+    sinb = sin[:, None].astype(jnp.float32)
+
+    def rope(t):
+        tr = t[..., :rd].astype(jnp.float32)
+        h1, h2 = tr[..., : rd // 2], tr[..., rd // 2 :]
+        rot = jnp.concatenate([-h2, h1], axis=-1)
+        out = tr * cosb + rot * sinb
+        return jnp.concatenate([out.astype(t.dtype), t[..., rd:]], axis=-1)
+
+    q, k = rope(q), rope(k)
+
+    # ring write: slot = position % W, last W REAL tokens only
+    pos = position_ids.astype(jnp.int32)
+    lti = last_token_index.astype(jnp.int32)
+    last_real = jnp.take_along_axis(pos, lti[:, None], axis=1)
+    keep = (pos <= last_real) & (pos > last_real - W)
+    slot = jnp.where(keep, pos % W, W)  # W = dropped
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    new_k = k_ring.at[b_idx, :, slot].set(
+        jnp.swapaxes(k, 1, 2).astype(k_ring.dtype), mode="drop"
+    )
+    new_v = v_ring.at[b_idx, :, slot].set(
+        jnp.swapaxes(v, 1, 2).astype(v_ring.dtype), mode="drop"
+    )
+
+    if is_decode:
+        # ring read: slot s holds position p - ((p - s) mod W)
+        p = pos[:, :1]
+        s_idx = jnp.arange(W, dtype=jnp.int32)[None, :]
+        kv_pos = p - ((p - s_idx) % W)
+        kv_pos = jnp.where(kv_pos >= 0, kv_pos, jnp.int32(2**30))
+        ctx = attn_ops.attention_with_positions(
+            q, new_k.astype(q.dtype), new_v.astype(q.dtype), pos, kv_pos
+        )
+    else:
+        # HF prefill mask is PLAIN causal over the whole prompt (the window
+        # binds only through the decode-time ring content)
+        ctx = attn_ops.attention_with_positions(q, k, v, pos, pos)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    return ctx @ lp["o_w"] + lp["o_b"], new_k, new_v
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+
+def recurrentgemma_forward(
+    arch: RecurrentGemmaArch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+    policy=None,
+    layout=None,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    output_all_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+    return_next_inputs: bool = False,
+    **_unused,
+):
+    from nxdi_tpu.config import to_jax_dtype
+
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    dt = to_jax_dtype(arch.dtype)
+    B, S = input_ids.shape
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(dt)
+    hidden = hidden * jnp.asarray(arch.embed_scale, dt)
+    cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq), dtype=jnp.float32)
+
+    if attend_to_cache:
+        valid = jnp.ones((B, S), bool)
+        lti = jnp.zeros((B,), jnp.int32)
+    else:
+        lti = batch["last_token_index"]
+        valid = jnp.arange(S, dtype=jnp.int32)[None, :] <= lti[:, None]
+
+    new_k, new_v = cache["k"], cache["v"]
+    new_conv, new_rec = cache["conv"], cache["rec"]
+    ai = ri = 0
+    for i, lt in enumerate(arch.layer_types):
+        lp = params["layers"][i]
+        h = _rms(arch, hidden, lp["temporal_norm"])
+        if lt == "attention":
+            out, k_new, v_new = attention_layer(
+                arch, lp, h, cos, sin, new_k[ai], new_v[ai], position_ids,
+                lti, attend_to_cache,
+            )
+            new_k = new_k.at[ai].set(k_new)
+            new_v = new_v.at[ai].set(v_new)
+            ai += 1
+        else:
+            out, c_new, r_new = recurrent_layer(
+                arch, lp, h, position_ids, valid, new_conv[ri], new_rec[ri],
+                lti, attend_to_cache,
+            )
+            new_conv = new_conv.at[ri].set(c_new)
+            new_rec = new_rec.at[ri].set(r_new)
+            ri += 1
+        hidden = hidden + out
+        h = _rms(arch, hidden, lp["channel_norm"])
+        gate = jax.nn.gelu(h @ lp["gate_w"] + lp["gate_b"], approximate=True)
+        up = h @ lp["up_w"] + lp["up_b"]
+        hidden = hidden + (gate * up) @ lp["down_w"] + lp["down_b"]
+
+    hidden = _rms(arch, hidden, params["norm"])
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
+    if gather_last_token and not output_all_logits:
+        idx = batch["last_token_index"][:, None, None]
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
+        )
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    if arch.logits_softcap:
+        cap = arch.logits_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if on_device_sampling:
+        tokens = sampling_ops.sample(
+            logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )
+        outputs["tokens"] = tokens[:, None]
+    if output_logits or output_all_logits or not on_device_sampling:
+        outputs["logits"] = logits[..., : arch.vocab_size - arch.vocab_pad]
+    new_cache = {"k": new_k, "v": new_v, "conv": new_conv, "rec": new_rec}
+    return outputs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Conversion / specs / struct
+# ---------------------------------------------------------------------------
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    cast = lambda a: np.asarray(a, dtype=dense.np_dtype(arch.dtype))  # noqa: E731
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    layers = []
+    for i, lt in enumerate(arch.layer_types):
+        p = f"layers.{i}."
+        t = p + "temporal_block."
+        layer: Dict[str, Any] = {
+            "temporal_norm": cast(get(p + "temporal_pre_norm.weight")),
+            "channel_norm": cast(get(p + "channel_pre_norm.weight")),
+            "gate_w": cast(get(p + "mlp_block.gate_proj.weight").T),
+            "gate_b": cast(get(p + "mlp_block.gate_proj.bias")),
+            "up_w": cast(get(p + "mlp_block.up_proj.weight").T),
+            "up_b": cast(get(p + "mlp_block.up_proj.bias")),
+            "down_w": cast(get(p + "mlp_block.down_proj.weight").T),
+            "down_b": cast(get(p + "mlp_block.down_proj.bias")),
+        }
+        if lt == "attention":
+            layer.update(
+                q_w=cast(get(t + "q_proj.weight").T),
+                k_w=cast(get(t + "k_proj.weight").T),
+                v_w=cast(get(t + "v_proj.weight").T),
+                o_w=cast(get(t + "o_proj.weight").T),
+                o_b=cast(get(t + "o_proj.bias")),
+            )
+            if arch.attention_bias:
+                layer.update(
+                    q_b=cast(get(t + "q_proj.bias")),
+                    k_b=cast(get(t + "k_proj.bias")),
+                    v_b=cast(get(t + "v_proj.bias")),
+                )
+        else:
+            layer.update(
+                linear_y_w=cast(get(t + "linear_y.weight").T),
+                linear_y_b=cast(get(t + "linear_y.bias")),
+                linear_x_w=cast(get(t + "linear_x.weight").T),
+                linear_x_b=cast(get(t + "linear_x.bias")),
+                linear_out_w=cast(get(t + "linear_out.weight").T),
+                linear_out_b=cast(get(t + "linear_out.bias")),
+                conv_w=cast(get(t + "conv_1d.weight")[:, 0, :]),  # (C,1,K)->(C,K)
+                conv_b=cast(get(t + "conv_1d.bias")),
+                # RG-LRU states/gates stay f32 (selection-precision critical)
+                recurrent_param=get(t + "rg_lru.recurrent_param").astype(np.float32),
+                input_gate_w=get(t + "rg_lru.input_gate_weight").astype(np.float32),
+                input_gate_b=get(t + "rg_lru.input_gate_bias").astype(np.float32),
+                recurrent_gate_w=get(t + "rg_lru.recurrent_gate_weight").astype(np.float32),
+                recurrent_gate_b=get(t + "rg_lru.recurrent_gate_bias").astype(np.float32),
+            )
+        layers.append(layer)
+
+    params = {
+        "embed_tokens": cast(get("embed_tokens.weight")),
+        "norm": cast(get("final_norm.weight")),
+        "layers": layers,
+    }
+    if "lm_head.weight" in state_dict:
+        params["lm_head"] = cast(np.asarray(state_dict["lm_head.weight"]).T)
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    arch = build_arch(config)
+    tp = config.tpu_config.tp_degree
+    heads_ok = tp > 1 and arch.num_attention_heads % tp == 0
+    kv_ok = tp > 1 and arch.num_kv_heads % tp == 0 and arch.lru_width % tp == 0
+    col = P(None, AXIS_MP) if heads_ok else REPLICATED
+    row = P(AXIS_MP, None) if heads_ok else REPLICATED
+    colv = P(AXIS_MP) if heads_ok else REPLICATED
+
+    specs_layers = []
+    for lt in arch.layer_types:
+        layer = {
+            "temporal_norm": REPLICATED,
+            "channel_norm": REPLICATED,
+            "gate_w": col, "gate_b": colv,
+            "up_w": col, "up_b": colv,
+            "down_w": row, "down_b": REPLICATED,
+        }
+        if lt == "attention":
+            layer.update(
+                q_w=col, k_w=(col if kv_ok else REPLICATED),
+                v_w=(col if kv_ok else REPLICATED),
+                o_w=row, o_b=REPLICATED,
+            )
+            if arch.attention_bias:
+                layer.update(q_b=colv, k_b=REPLICATED, v_b=REPLICATED)
+        else:
+            # block-diagonal gates shard on the HEAD dim; lru projections on
+            # the lru dim (head blocks stay shard-local: lru = heads * bw)
+            layer.update(
+                linear_y_w=col, linear_y_b=colv,
+                linear_x_w=col, linear_x_b=colv,
+                linear_out_w=row, linear_out_b=REPLICATED,
+                conv_w=(P(AXIS_MP, None) if heads_ok else REPLICATED),
+                conv_b=colv,
+                recurrent_param=colv,
+                input_gate_w=(P(AXIS_MP, None, None) if heads_ok else REPLICATED),
+                input_gate_b=(P(AXIS_MP, None) if heads_ok else REPLICATED),
+                recurrent_gate_w=(P(AXIS_MP, None, None) if heads_ok else REPLICATED),
+                recurrent_gate_b=(P(AXIS_MP, None) if heads_ok else REPLICATED),
+            )
+        specs_layers.append(layer)
+    return {
+        "embed_tokens": P(AXIS_MP, None) if heads_ok else REPLICATED,
+        "norm": REPLICATED,
+        "layers": specs_layers,
+        "lm_head": P(None, AXIS_MP) if heads_ok else REPLICATED,
+    }
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+
+    def s(*shape, d=dt):
+        return jax.ShapeDtypeStruct(shape, d)
+
+    Hh, bw = arch.num_attention_heads, arch.block_width
+    layers = []
+    for lt in arch.layer_types:
+        layer = {
+            "temporal_norm": s(arch.hidden_size),
+            "channel_norm": s(arch.hidden_size),
+            "gate_w": s(arch.hidden_size, arch.intermediate_size),
+            "gate_b": s(arch.intermediate_size),
+            "up_w": s(arch.hidden_size, arch.intermediate_size),
+            "up_b": s(arch.intermediate_size),
+            "down_w": s(arch.intermediate_size, arch.hidden_size),
+            "down_b": s(arch.hidden_size),
+        }
+        if lt == "attention":
+            layer.update(
+                q_w=s(arch.hidden_size, arch.num_attention_heads * arch.head_dim),
+                k_w=s(arch.hidden_size, arch.num_kv_heads * arch.head_dim),
+                v_w=s(arch.hidden_size, arch.num_kv_heads * arch.head_dim),
+                o_w=s(arch.num_attention_heads * arch.head_dim, arch.hidden_size),
+                o_b=s(arch.hidden_size),
+            )
+            if arch.attention_bias:
+                layer.update(
+                    q_b=s(arch.num_attention_heads * arch.head_dim),
+                    k_b=s(arch.num_kv_heads * arch.head_dim),
+                    v_b=s(arch.num_kv_heads * arch.head_dim),
+                )
+        else:
+            layer.update(
+                linear_y_w=s(arch.hidden_size, arch.lru_width),
+                linear_y_b=s(arch.lru_width),
+                linear_x_w=s(arch.hidden_size, arch.lru_width),
+                linear_x_b=s(arch.lru_width),
+                linear_out_w=s(arch.lru_width, arch.hidden_size),
+                linear_out_b=s(arch.hidden_size),
+                conv_w=s(arch.lru_width, arch.conv_kernel),
+                conv_b=s(arch.lru_width),
+                recurrent_param=s(arch.lru_width, d=np.float32),
+                input_gate_w=s(Hh, bw, bw, d=np.float32),
+                input_gate_b=s(Hh, bw, d=np.float32),
+                recurrent_gate_w=s(Hh, bw, bw, d=np.float32),
+                recurrent_gate_b=s(Hh, bw, d=np.float32),
+            )
+        layers.append(layer)
+    return {
+        "embed_tokens": s(arch.vocab_size, arch.hidden_size),
+        "norm": s(arch.hidden_size),
+        "layers": layers,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cache + application
+# ---------------------------------------------------------------------------
+
+
+def cache_shapes(arch: RecurrentGemmaArch, batch_size: int, seq_len: int):
+    from nxdi_tpu.config import to_jax_dtype
+
+    dt = to_jax_dtype(arch.dtype)
+    W = min(arch.attention_window, seq_len)
+    return {
+        "k": ((arch.n_attn, batch_size, arch.num_kv_heads, W, arch.head_dim), dt),
+        "v": ((arch.n_attn, batch_size, arch.num_kv_heads, W, arch.head_dim), dt),
+        "conv": ((arch.n_rec, batch_size, arch.lru_width, arch.conv_kernel - 1), dt),
+        "rec": ((arch.n_rec, batch_size, arch.lru_width), jnp.float32),
+    }
+
+
+from nxdi_tpu.runtime.application import TpuModelForCausalLM  # noqa: E402
+
+
+class RecurrentGemmaForCausalLM(TpuModelForCausalLM):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        unsupported = [
+            ("async_mode", tc.async_mode),
+            ("is_prefix_caching", tc.is_prefix_caching),
+            ("is_chunked_prefill", tc.is_chunked_prefill),
+            ("is_block_kv_layout", tc.is_block_kv_layout),
+            ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
+            ("speculation", tc.speculation_length > 0 or tc.is_medusa),
+            ("tensor_capture_config", tc.tensor_capture_config is not None),
+        ]
+        bad = [name for name, val in unsupported if val]
+        if bad:
+            raise ValueError(
+                "recurrentgemma does not support: " + ", ".join(bad) + " — the "
+                "RG-LRU recurrence needs dedicated state routing for these "
+                "modes (conv/lru states are not paged or seq_id-routed)"
+            )
+
+    def enable_models(self) -> None:
+        super().enable_models()
+        for wrapper in self.models.values():
+            wrapper.forward_fn = recurrentgemma_forward
+
+    def _arch(self):
+        return build_arch(self.config)
+
+    def cache_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        arch = self._arch()
+        tp = self.tpu_config.tp_degree
+        kv = AXIS_MP if (tp > 1 and arch.num_kv_heads % tp == 0) else None
+        lr = AXIS_MP if (tp > 1 and arch.lru_width % tp == 0) else None
+        return {
+            "k": P(None, None, kv, None, None),
+            "v": P(None, None, kv, None, None),
+            "conv": P(None, None, lr, None),
+            "rec": P(None, None, lr),
+        }
+
+    def init_cache_host(self):
+        tc = self.tpu_config
+        return {
+            k: jnp.zeros(shape, dt)
+            for k, (shape, dt) in cache_shapes(
+                self._arch(),
+                tc.kv_cache_batch_size + tc.kv_cache_padding_size,
+                tc.seq_len,
+            ).items()
+        }
+
+    def _cache_struct(self):
+        tc = self.tpu_config
+        shapes = cache_shapes(
+            self._arch(), tc.kv_cache_batch_size + tc.kv_cache_padding_size, tc.seq_len
+        )
+        return {k: jax.ShapeDtypeStruct(shape, dt) for k, (shape, dt) in shapes.items()}
+
+
+APPLICATION_CLS = RecurrentGemmaForCausalLM

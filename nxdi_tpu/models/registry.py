@@ -43,6 +43,22 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "nxdi_tpu.models.qwen3_next.modeling_qwen3_next",
         "Qwen3NextInferenceConfig",
     ),
+    "recurrent_gemma": (
+        "nxdi_tpu.models.recurrentgemma.modeling_recurrentgemma",
+        "RecurrentGemmaInferenceConfig",
+    ),
+    "recurrentgemma": (
+        "nxdi_tpu.models.recurrentgemma.modeling_recurrentgemma",
+        "RecurrentGemmaInferenceConfig",
+    ),
+    "qwen2_5_omni": (
+        "nxdi_tpu.models.qwen2_5_omni.modeling_qwen2_5_omni",
+        "Qwen2_5OmniInferenceConfig",
+    ),
+    "qwen2_5_omni_thinker": (
+        "nxdi_tpu.models.qwen2_5_omni.modeling_qwen2_5_omni",
+        "Qwen2_5OmniInferenceConfig",
+    ),
 }
 
 

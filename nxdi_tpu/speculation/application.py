@@ -230,10 +230,36 @@ class EagleSpecCausalLM(FusedSpecCausalLM):
         self.tree = None
         ttc = getattr(tc, "token_tree_config", None)
         if ttc:
-            from nxdi_tpu.speculation.token_tree import TokenTree
+            if isinstance(ttc, dict) and "dynamic" in ttc:
+                # runtime-grown tree (reference: dynamic_token_tree.py:4)
+                from nxdi_tpu.speculation.token_tree import DynamicTreeSpec
 
-            choices = ttc["choices"] if isinstance(ttc, dict) else ttc
-            self.tree = TokenTree.from_choices(choices)
+                d = ttc["dynamic"]
+                steps = int(d["steps"])
+                bf = int(d["branching_factor"])
+                ni = int(d.get("num_inputs", 1))
+                if steps < 1 or bf < 1 or ni < 1:
+                    raise ValueError(
+                        "dynamic token tree needs steps/branching_factor/"
+                        f"num_inputs >= 1, got {d}"
+                    )
+                if ni > bf:
+                    # step-1 expands the first group (branching_factor nodes);
+                    # selecting more parents than that group holds is
+                    # unsatisfiable
+                    raise ValueError(
+                        f"dynamic token tree num_inputs ({ni}) cannot exceed "
+                        f"branching_factor ({bf}) — each step selects parents "
+                        "from the previous step's nodes"
+                    )
+                self.tree = DynamicTreeSpec(
+                    steps=steps, branching_factor=bf, num_inputs=ni
+                )
+            else:
+                from nxdi_tpu.speculation.token_tree import TokenTree
+
+                choices = ttc["choices"] if isinstance(ttc, dict) else ttc
+                self.tree = TokenTree.from_choices(choices)
             if tc.speculation_length != self.tree.max_depth:
                 raise ValueError(
                     f"speculation_length ({tc.speculation_length}) must equal "

@@ -85,6 +85,9 @@ def _draft_token(draft_params: Dict[str, Any], tokens: jax.Array) -> jax.Array:
     return tokens.astype(jnp.int32)
 
 
+_draft_ids = _draft_token  # alias: works element-wise on any id shape
+
+
 def eagle_context_encoding(
     draft_arch,
     target_arch,
@@ -485,8 +488,15 @@ class EagleSpecWrapper(FusedSpecWrapper):
             layout=self.layout,
         )
         if self.attend_to_cache and self.tree is not None:
+            from nxdi_tpu.speculation.token_tree import DynamicTreeSpec
+
+            fn = (
+                eagle_dynamic_tree_token_gen
+                if isinstance(self.tree, DynamicTreeSpec)
+                else eagle_tree_token_gen
+            )
             return partial(
-                eagle_tree_token_gen,
+                fn,
                 self.draft_arch,
                 self.arch,
                 self.draft_inv_freq,
@@ -515,3 +525,232 @@ class EagleSpecWrapper(FusedSpecWrapper):
             **common,
             **self.forward_kwargs,
         )
+
+
+def eagle_dynamic_tree_token_gen(
+    draft_arch,
+    target_arch,
+    draft_inv_freq,
+    target_inv_freq,
+    params: Dict[str, Any],
+    cache: Dict[str, Any],
+    batch: Dict[str, jax.Array],
+    *,
+    tree,  # DynamicTreeSpec
+    kv_window: int,
+    is_eagle3: bool = False,
+    aux_hidden_indices: Optional[Tuple[int, ...]] = None,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
+    """One EAGLE DYNAMIC-tree window (reference:
+    modules/eagle/dynamic_token_tree.py:4 + model_base.py:2148): the tree
+    topology is grown at RUNTIME from draft probabilities — step 0 takes the
+    root's top ``branching_factor`` tokens; each later step expands the
+    ``num_inputs`` highest-cumulative-log-prob nodes of the previous step.
+    Node count per step is static (fixed shapes); parents, ancestor masks and
+    acceptance all ride traced index arrays, unlike the static
+    ``eagle_tree_token_gen`` whose masks compile as constants. Probability
+    mass concentrates the fixed node budget on the likeliest branches, so
+    mean acceptance length beats a static tree of the same size."""
+    import numpy as np
+
+    from nxdi_tpu.speculation.token_tree import dynamic_tree_kv_mask
+
+    B = batch["input_ids"].shape[0]
+    tok0 = batch["input_ids"].astype(jnp.int32)  # (B, 1)
+    pos0 = batch["position_ids"].astype(jnp.int32)  # (B, 1)
+    rows = _feature_rows(batch, B)
+    feat0 = cache["features"][rows]  # (B, H)
+    sp = batch["sampling_params"]
+    K, M, steps = tree.branching_factor, tree.num_inputs, tree.steps
+    N = tree.num_nodes
+    N1 = N + 1
+    H_draft = feat0.shape[-1]
+
+    depth_rows = jnp.asarray(tree.depth_rows, jnp.int32)  # (1+N,)
+
+    # traced tree state
+    tree_mask = jnp.zeros((B, N1, N1), bool).at[:, 0, 0].set(True)
+    parent_row = jnp.zeros((B, N), jnp.int32)  # parent ROW index per node
+    node_tok = jnp.zeros((B, N), jnp.int32)
+    node_logp = jnp.full((B, N), -jnp.inf, jnp.float32)
+
+    d_cache = cache["draft"]
+
+    def draft_pass(row_lo, n_rows, tokens, feats, d_cache, tree_mask):
+        """Run the draft on rows [row_lo, row_lo + n_rows) of the tree."""
+        rope_pos = pos0 + depth_rows[None, row_lo : row_lo + n_rows][0][None, :]
+        write_pos = pos0 + jnp.arange(row_lo, row_lo + n_rows, dtype=jnp.int32)[None, :]
+        mask = dynamic_tree_kv_mask(
+            tree_mask[:, row_lo : row_lo + n_rows], pos0[:, 0], kv_window
+        )
+        dbatch = {
+            "input_ids": tokens,
+            "position_ids": jnp.broadcast_to(rope_pos, (B, n_rows)),
+            "write_positions": jnp.broadcast_to(write_pos, (B, n_rows)),
+            "attn_mask": mask,
+            "last_token_index": jnp.zeros((B,), jnp.int32),
+            "sampling_params": sp,
+            "prev_hidden": feats,
+        }
+        if "seq_ids" in batch:
+            dbatch["seq_ids"] = batch["seq_ids"]
+        return causal_lm_forward(
+            draft_arch, draft_inv_freq, params["draft"], d_cache, dbatch,
+            attend_to_cache=True, kv_window=kv_window, policy=policy,
+            layout=layout, gather_last_token=False, output_all_logits=True,
+            on_device_sampling=False, output_hidden=True,
+        )
+
+    # -- step 0: root row -> top-K children --
+    out, d_cache = draft_pass(0, 1, tok0, feat0[:, None, :], d_cache, tree_mask)
+    logp = jax.nn.log_softmax(out["logits"][:, 0].astype(jnp.float32), axis=-1)
+    top_lp, top_ids = jax.lax.top_k(logp, K)  # (B, K)
+    g_lo, g_n = tree.group_rows(0)
+    toks0 = _draft_ids(params["draft"], top_ids)
+    node_tok = node_tok.at[:, g_lo - 1 : g_lo - 1 + g_n].set(toks0)
+    node_logp = node_logp.at[:, g_lo - 1 : g_lo - 1 + g_n].set(top_lp)
+    parent_row = parent_row.at[:, g_lo - 1 : g_lo - 1 + g_n].set(0)
+    # children inherit the root's mask row + self
+    root_mask = tree_mask[:, 0:1]  # (B, 1, N1)
+    grp = jnp.broadcast_to(root_mask, (B, g_n, N1))
+    self_bits = jax.nn.one_hot(
+        jnp.arange(g_lo, g_lo + g_n), N1, dtype=jnp.bool_
+    )[None]
+    tree_mask = tree_mask.at[:, g_lo : g_lo + g_n].set(grp | self_bits)
+
+    prev_lo, prev_n = g_lo, g_n
+    prev_toks, prev_feats = toks0, jnp.broadcast_to(
+        out["hidden"][:, 0:1], (B, g_n, H_draft)
+    )
+
+    for step in range(1, steps + 1):
+        out, d_cache = draft_pass(
+            prev_lo, prev_n, prev_toks, prev_feats, d_cache, tree_mask
+        )
+        if step == steps:
+            break
+        # pick the M most probable nodes of the previous group to expand
+        prev_lp = node_logp[:, prev_lo - 1 : prev_lo - 1 + prev_n]  # (B, prev_n)
+        sel_lp, sel = jax.lax.top_k(prev_lp, M)  # (B, M) rel. indices
+        sel_rows = prev_lo + sel  # (B, M) absolute rows
+        sel_logits = jnp.take_along_axis(
+            out["logits"], sel[:, :, None], axis=1
+        )  # (B, M, V)
+        lp = jax.nn.log_softmax(sel_logits.astype(jnp.float32), axis=-1)
+        c_lp, c_ids = jax.lax.top_k(lp, K)  # (B, M, K)
+        g_lo, g_n = tree.group_rows(step)
+        toks = _draft_ids(params["draft"], c_ids.reshape(B, M * K))
+        cum = (sel_lp[:, :, None] + c_lp).reshape(B, M * K)
+        par = jnp.repeat(sel_rows, K, axis=1)  # (B, M*K)
+        node_tok = node_tok.at[:, g_lo - 1 : g_lo - 1 + g_n].set(toks)
+        node_logp = node_logp.at[:, g_lo - 1 : g_lo - 1 + g_n].set(cum)
+        parent_row = parent_row.at[:, g_lo - 1 : g_lo - 1 + g_n].set(par)
+        # child mask = parent's mask row | self
+        par_masks = jnp.take_along_axis(
+            tree_mask, par[:, :, None].astype(jnp.int32), axis=1
+        )  # (B, M*K, N1)
+        self_bits = jax.nn.one_hot(
+            jnp.arange(g_lo, g_lo + g_n), N1, dtype=jnp.bool_
+        )[None]
+        tree_mask = tree_mask.at[:, g_lo : g_lo + g_n].set(par_masks | self_bits)
+        prev_feats = jnp.take_along_axis(
+            out["hidden"], sel[:, :, None].astype(jnp.int32), axis=1
+        )
+        prev_feats = jnp.repeat(prev_feats, K, axis=1)  # (B, M*K, H)
+        prev_lo, prev_n, prev_toks = g_lo, g_n, toks
+
+    candidates = jnp.concatenate([tok0, node_tok], axis=1)  # (B, 1+N)
+
+    # -- target verify over the whole (runtime-shaped) tree --
+    full_mask = dynamic_tree_kv_mask(tree_mask, pos0[:, 0], kv_window)
+    tbatch = {
+        "input_ids": candidates,
+        "position_ids": pos0 + depth_rows[None, :],
+        "write_positions": pos0 + jnp.arange(N1, dtype=jnp.int32)[None, :],
+        "attn_mask": full_mask,
+        "last_token_index": jnp.zeros((B,), jnp.int32),
+        "sampling_params": sp,
+    }
+    if "seq_ids" in batch:
+        tbatch["seq_ids"] = batch["seq_ids"]
+    t_out, t_cache = causal_lm_forward(
+        target_arch, target_inv_freq, params["target"], cache["target"], tbatch,
+        attend_to_cache=True, kv_window=kv_window, policy=policy, layout=layout,
+        gather_last_token=False, output_all_logits=True, on_device_sampling=False,
+        **_target_feature_kwargs(is_eagle3, aux_hidden_indices),
+    )
+    target_tokens = jnp.argmax(t_out["logits"], axis=-1).astype(jnp.int32)
+
+    # -- acceptance over traced parents --
+    parent_full = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), parent_row], axis=1
+    )  # (B, 1+N): row -> parent row (root -> itself)
+    correct = candidates == jnp.take_along_axis(target_tokens, parent_full, axis=1)
+    chain_ok = jnp.zeros((B, N1), bool).at[:, 0].set(True)
+    for g in range(steps):
+        lo, n = tree.group_rows(g)
+        par = parent_full[:, lo : lo + n]
+        ok = correct[:, lo : lo + n] & jnp.take_along_axis(chain_ok, par, axis=1)
+        chain_ok = chain_ok.at[:, lo : lo + n].set(ok)
+    lens = jnp.where(chain_ok, depth_rows[None, :], 0)  # (B, 1+N)
+    best_row = jnp.argmax(lens, axis=1).astype(jnp.int32)  # (B,)
+    best_len = jnp.take_along_axis(lens, best_row[:, None], axis=1)[:, 0]
+    counts = best_len + 1
+    tree_fits = pos0[:, 0] + N1 <= kv_window
+    counts = jnp.where(tree_fits, counts, 1)
+
+    # walk parent pointers leaf -> root, then place rows by depth
+    path_rows = jnp.zeros((B, steps), jnp.int32)
+    r = best_row
+    for _ in range(steps):
+        d = jnp.take_along_axis(depth_rows[None, :], r[:, None], axis=1)[:, 0]
+        put = jax.nn.one_hot(d - 1, steps, dtype=jnp.int32)  # d == 0 -> zeros
+        path_rows = path_rows + put * r[:, None]
+        r = jnp.take_along_axis(parent_full, r[:, None], axis=1)[:, 0]
+    j = jnp.arange(steps, dtype=jnp.int32)[None, :]
+    emit_rows = jnp.concatenate(
+        [jnp.zeros((B, 1), jnp.int32), jnp.where(j < best_len[:, None], path_rows, 0)],
+        axis=1,
+    )
+    tokens_out = jnp.take_along_axis(target_tokens, emit_rows, axis=1)  # (B, 1+steps)
+
+    # -- KV fix-up on BOTH caches (accepted rows -> contiguous slots) --
+    src = pos0 + jnp.clip(path_rows, 0)  # (B, steps) kv slots of path rows
+    dest = pos0 + 1 + jnp.arange(steps, dtype=jnp.int32)[None, :]
+    b_idx = rows[:, None]
+
+    def fixup(cache_arr):
+        def per_layer(cl):
+            KVh, Dh = cl.shape[1], cl.shape[3]
+            lines = jnp.take(cl, rows, axis=0)
+            gathered = jnp.take_along_axis(
+                lines,
+                jnp.clip(src, 0, cl.shape[2] - 1)[:, None, :, None].astype(jnp.int32)
+                * jnp.ones((1, KVh, 1, Dh), jnp.int32),
+                axis=2,
+            )
+            vals = jnp.swapaxes(gathered, 1, 2)
+            return cl.at[b_idx, :, dest].set(vals, mode="drop")
+
+        return jax.vmap(per_layer)(cache_arr)
+
+    t_cache = {"k": fixup(t_cache["k"]), "v": fixup(t_cache["v"])}
+    d_cache = {"k": fixup(d_cache["k"]), "v": fixup(d_cache["v"])}
+
+    retire = jnp.clip(jnp.minimum(counts, kv_window - 1 - pos0[:, 0]), 1, steps + 1)
+    last_row = jnp.take_along_axis(emit_rows, (retire - 1)[:, None], axis=1)
+    feats_t = _project_features(
+        draft_arch, params["draft"], _target_features(is_eagle3, t_out)
+    )
+    new_feat = jnp.take_along_axis(
+        feats_t, last_row[:, :, None] * jnp.ones((1, 1, feats_t.shape[2]), jnp.int32), axis=1
+    )[:, 0]
+    feat_buf = cache["features"].at[rows].set(new_feat.astype(cache["features"].dtype))
+
+    return {"tokens": tokens_out, "counts": counts}, {
+        "draft": d_cache,
+        "target": t_cache,
+        "features": feat_buf,
+    }

@@ -167,3 +167,63 @@ def best_path_acceptance(
         [jnp.zeros((B, 1), jnp.int32), path_rows.astype(jnp.int32)], axis=1
     )
     return counts, best_path, emit_rows
+
+
+@dataclass(frozen=True)
+class DynamicTreeSpec:
+    """Static SHAPE of a dynamic token tree (reference:
+    modules/eagle/dynamic_token_tree.py:4 — [steps, branching_factor,
+    num_inputs, ...]). The topology itself is chosen at RUNTIME from draft
+    probabilities: step 0 expands the root into ``branching_factor``
+    children; each later step picks the ``num_inputs`` most probable nodes
+    of the previous step (by cumulative log-prob) and expands each into
+    ``branching_factor`` children. Only the node COUNT per step is static —
+    parents, masks and rope-slot wiring are traced values."""
+
+    steps: int  # tree depth (== speculation_length)
+    branching_factor: int
+    num_inputs: int
+
+    @property
+    def num_nodes(self) -> int:
+        return self.branching_factor + (self.steps - 1) * (
+            self.num_inputs * self.branching_factor
+        )
+
+    @property
+    def max_depth(self) -> int:
+        return self.steps
+
+    def group_rows(self, g: int) -> tuple:
+        """(start_row, count) of expansion group ``g`` in row space (row 0 is
+        the root; groups are laid out contiguously in creation order)."""
+        K, M = self.branching_factor, self.num_inputs
+        if g == 0:
+            return 1, K
+        return 1 + K + (g - 1) * M * K, M * K
+
+    @property
+    def depth_rows(self):
+        """Static per-row depth (row 0 = 0; group g rows all at depth g+1)."""
+        out = [0]
+        for g in range(self.steps):
+            _, n = self.group_rows(g)
+            out.extend([g + 1] * n)
+        return tuple(out)
+
+
+def dynamic_tree_kv_mask(mask_rows: jax.Array, pos0: jax.Array, kv_width: int) -> jax.Array:
+    """Scatter traced ancestor rows (B, R, 1+N) into KV-slot space:
+    row r may attend committed slots <= pos0 plus node col j at slot
+    pos0 + j (the dynamic analog of tree_verify_mask)."""
+    B, R, N1 = mask_rows.shape
+    slots = jnp.arange(kv_width, dtype=jnp.int32)[None, :]
+    prefix = slots < pos0[:, None]  # strictly before the root slot
+    tgt = jnp.clip(pos0[:, None] + jnp.arange(N1, dtype=jnp.int32)[None, :], 0, kv_width - 1)
+    out = jnp.zeros((B, R, kv_width), bool)
+    out = out.at[
+        jnp.arange(B)[:, None, None],
+        jnp.arange(R)[None, :, None],
+        tgt[:, None, :],
+    ].max(mask_rows)
+    return prefix[:, None, :] | out

