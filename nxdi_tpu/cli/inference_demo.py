@@ -60,6 +60,13 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--moe-ep-degree", type=int, default=None,
                    help="hybrid MoE expert-parallel degree (experts over ep, "
                         "expert intermediates over tp)")
+    p.add_argument("--moe-cte-ep-degree", type=int, default=None,
+                   help="PER-PHASE hybrid MoE: prefill expert-parallel degree "
+                        "(reference: HybridShardingConfig moe_cte_ep_degree)")
+    p.add_argument("--moe-tkg-ep-degree", type=int, default=None,
+                   help="PER-PHASE hybrid MoE: decode expert-parallel degree "
+                        "(a multiple of --moe-cte-ep-degree; expert weights "
+                        "are duplicated per regime)")
     p.add_argument("--moe-dispatch", default="sparse", choices=["sparse", "dense"])
     p.add_argument("--sequence-parallel-enabled", action="store_true")
     p.add_argument("--flash-decoding-enabled", action="store_true")
@@ -77,6 +84,17 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--enable-bucketing", action="store_true")
     p.add_argument("--context-encoding-buckets", nargs="+", type=int, default=None)
     p.add_argument("--token-generation-buckets", nargs="+", type=int, default=None)
+    p.add_argument("--long-context-mode", type=int, choices=[0, 1], default=None,
+                   help="coarsen bucket ladders for 32k+ contexts (auto-on at "
+                        ">=32k; pass 0/1 to force; reference: "
+                        "enable_long_context_mode config.py:578)")
+    p.add_argument("--dynamic-tree-steps", type=int, default=None,
+                   help="dynamic token tree depth (reference: "
+                        "dynamic_token_tree.py step)")
+    p.add_argument("--dynamic-tree-branching", type=int, default=2,
+                   help="children per expanded node")
+    p.add_argument("--dynamic-tree-num-inputs", type=int, default=1,
+                   help="nodes expanded per step (by cumulative probability)")
 
     # execution
     p.add_argument("--async-mode", action="store_true")
@@ -198,6 +216,16 @@ def create_tpu_config(args):
         pp_degree=args.pp_degree,
         pp_microbatches=args.pp_microbatches,
         moe_ep_degree=args.moe_ep_degree,
+        # a one-sided flag defaults the other side to a valid regime: the
+        # unset cte degree stays 1 (TP-heavy prefill), the unset tkg degree
+        # matches cte (tkg must be a multiple of cte)
+        hybrid_sharding_config=(
+            {"moe_cte_ep_degree": args.moe_cte_ep_degree or 1,
+             "moe_tkg_ep_degree": args.moe_tkg_ep_degree
+             or args.moe_cte_ep_degree or 1}
+            if args.moe_cte_ep_degree or args.moe_tkg_ep_degree
+            else None
+        ),
         moe_dispatch=args.moe_dispatch,
         sequence_parallel_enabled=args.sequence_parallel_enabled,
         flash_decoding_enabled=args.flash_decoding_enabled,
@@ -231,9 +259,17 @@ def create_tpu_config(args):
             if args.kv_cache_quant and args.kv_scale_mode == "per_tensor"
             else None
         ),
-        token_tree_config=_load_medusa_tree(args.token_tree_config),
+        token_tree_config=(
+            {"dynamic": {"steps": args.dynamic_tree_steps,
+                         "branching_factor": args.dynamic_tree_branching,
+                         "num_inputs": args.dynamic_tree_num_inputs}}
+            if args.dynamic_tree_steps
+            else _load_medusa_tree(args.token_tree_config)
+        ),
         skip_warmup=args.skip_warmup,
         lora_config=lora_cfg,
+        **({"long_context_mode": bool(args.long_context_mode)}
+           if args.long_context_mode is not None else {}),
     )
 
 

@@ -781,7 +781,7 @@ def decoder_layer(
 
 def _pipelined_decoder_layers(
     arch, layer_params, hidden, cos, sin, cache, position_ids, step_fn,
-    cache_inputs, adapter_ids,
+    cache_inputs, adapter_ids, defer=False,
 ):
     """GPipe-style pipeline over the ``pp`` mesh axis.
 
@@ -791,13 +791,20 @@ def _pipelined_decoder_layers(
     Mechanism: ``shard_map`` manual over ``pp`` only (tp/ep/... stay under
     GSPMD), the layer-stacked params and the cache sharded on their leading
     layer dim so each stage owns a contiguous slice of layers + stage-local
-    KV. The batch splits into M microbatches; for ``T = M + pp - 1`` ticks
-    each stage scans its local layers over its current microbatch and hands
-    the activations to the next stage with a ring ``ppermute`` — collectives
+    KV. The batch splits into M microbatches (``pp_microbatches`` deepens the
+    split to shrink the bubble); for ``T = M + pp - 1`` ticks each stage
+    scans its local layers over its current microbatch and hands the
+    activations to the next stage with a ring ``ppermute`` — collectives
     ride ICI, bubble fraction (pp-1)/(M+pp-1).
 
-    Bubble ticks still compute (SPMD requires it) but write back the old
-    cache values, so garbage never lands.
+    ``defer`` (decode hot path, round-2 weak #2): the scan emits only fresh
+    K/V rows and each tick lands them with ONE stage-local in-place commit
+    (the Pallas commit kernel addressed by microbatch line via seq-id
+    routing) instead of round-tripping the stage's whole cache through the
+    scan ys per tick. Bubble ticks commit with slot -1 (dropped).
+
+    Non-deferred bubble ticks still compute (SPMD requires it) but write
+    back the old cache values, so garbage never lands.
     """
     mesh = jax.sharding.get_abstract_mesh()
     pp = arch.pp_degree
@@ -820,7 +827,9 @@ def _pipelined_decoder_layers(
 
             def body(h, xs):
                 lp, kl, vl = xs
-                h, nk, nv = step_fn(h, lp, kl, vl, cos_m, sin_m, pos_m, ci_m, ad_m)
+                h, nk, nv = step_fn(
+                    h, lp, kl, vl, cos_m, sin_m, pos_m, ci_m, ad_m, defer_=defer
+                )
                 return h, (nk, nv)
 
             return body
@@ -842,11 +851,41 @@ def _pipelined_decoder_layers(
             h_out, (k_new, v_new) = jax.lax.scan(
                 scan_body(ctx), h, (params_local, k_mb, v_mb)
             )
-            # bubble ticks write back the old values (no-op update)
-            k_new = jnp.where(valid, k_new, k_mb)
-            v_new = jnp.where(valid, v_new, v_mb)
-            kl = jax.lax.dynamic_update_slice_in_dim(kl, k_new, i_c * mb, axis=1)
-            vl = jax.lax.dynamic_update_slice_in_dim(vl, v_new, i_c * mb, axis=1)
+            if defer:
+                # k_new/v_new are FRESH ROWS (L_local, mb, KV, 1, D): land
+                # them in the stage-local cache with one in-place commit at
+                # the microbatch's cache lines; bubble ticks drop (slot -1)
+                from nxdi_tpu.ops.kernels import kv_commit
+
+                pos_mb = slice_b(pos_, i_c).astype(jnp.int32)  # (mb, 1)
+                slots = jnp.where(valid, pos_mb, -1)
+                lines = i_c * mb + jnp.arange(mb, dtype=jnp.int32)
+                if kv_commit.commit_rows_supported(
+                    kl.shape, vl.shape, k_new.shape, v_new.shape
+                ):
+                    kl, vl = kv_commit.kv_commit_rows(
+                        kl, vl, k_new.astype(kl.dtype), v_new.astype(vl.dtype),
+                        slots, lines,
+                    )
+                else:
+                    b_idx = lines[:, None]
+                    sl = jnp.where(slots < 0, kl.shape[3], slots)
+
+                    def put(cache_arr, rows):
+                        vals = rows.astype(cache_arr.dtype).swapaxes(2, 3)
+
+                        def per_layer(cl, rl):
+                            return cl.at[b_idx, :, sl].set(rl, mode="drop")
+
+                        return jax.vmap(per_layer)(cache_arr, vals)
+
+                    kl, vl = put(kl, k_new), put(vl, v_new)
+            else:
+                # bubble ticks write back the old values (no-op update)
+                k_new = jnp.where(valid, k_new, k_mb)
+                v_new = jnp.where(valid, v_new, v_mb)
+                kl = jax.lax.dynamic_update_slice_in_dim(kl, k_new, i_c * mb, axis=1)
+                vl = jax.lax.dynamic_update_slice_in_dim(vl, v_new, i_c * mb, axis=1)
             # the last stage banks finished microbatches
             banked = jax.lax.dynamic_update_slice_in_dim(out, h_out[None], i_c, 0)
             out = jnp.where(valid & (stage == pp - 1), banked, out)
@@ -1056,19 +1095,22 @@ def run_decoder_layers(
         and (cache_inputs or {}).get("attn_mask") is None
     )
 
-    def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None, windowable_=None):
+    def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None,
+              windowable_=None, defer_=None):
         """One decoder layer with the bucket's static KV window applied.
-        ``layout_``/``windowable_`` override the stack-wide defaults for the
-        interleaved-window unit scan (ring slices use the ring layout)."""
+        ``layout_``/``windowable_``/``defer_`` override the stack-wide
+        defaults for the interleaved-window unit scan (ring slices use the
+        ring layout) and the pipelined path (stage-local deferred commit)."""
         lay = layout if layout_ is None else layout_
         win_ok = windowable if windowable_ is None else windowable_
+        dfr = defer if defer_ is None else defer_
         if win_ok and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos_, sin_, k_win, v_win, pos_, cache_spec,
-                attend_to_cache, policy, lay, ci_, ad_, defer_write=defer,
+                attend_to_cache, policy, lay, ci_, ad_, defer_write=dfr,
             )
-            if defer:
+            if dfr:
                 nk, nv = nkw, nvw  # fresh rows, committed after the scan
             else:
                 nk = jax.lax.dynamic_update_slice(kl, nkw, (0, 0, 0, 0))
@@ -1076,7 +1118,7 @@ def run_decoder_layers(
         else:
             h, (nk, nv) = decoder_layer(
                 arch, lp, h, cos_, sin_, kl, vl, pos_, cache_spec,
-                attend_to_cache, policy, lay, ci_, ad_, defer_write=defer,
+                attend_to_cache, policy, lay, ci_, ad_, defer_write=dfr,
             )
         return h, nk, nv
 
@@ -1105,9 +1147,23 @@ def run_decoder_layers(
                 f"num_layers ({n_layers_chk}) must be divisible by pp_degree "
                 f"({arch.pp_degree}) — pipeline stages hold equal layer slices"
             )
+        # deferred commit applies under pp too (stage-local in-place commit
+        # each tick; see _pipelined_decoder_layers) — decode-shaped only
+        defer_pp = (
+            attend_to_cache
+            and arch.mla is None
+            and isinstance(layout, ContiguousKVLayout)
+            and not getattr(layout, "route_by_seq_id", False)
+            and getattr(layout, "k_scale", 1.0) == 1.0
+            and getattr(layout, "v_scale", 1.0) == 1.0
+            and cache["k"].dtype == cache_spec.compute_dtype  # no quant store
+            and position_ids.shape[1] == 1
+            and (cache_inputs or {}).get("attn_mask") is None
+            and (cache_inputs or {}).get("write_positions") is None
+        )
         return _pipelined_decoder_layers(
             arch, segments_chk[0], hidden, cos, sin, cache, position_ids,
-            _step, cache_inputs, adapter_ids,
+            _step, cache_inputs, adapter_ids, defer=defer_pp,
         )
 
     if "k_win" in cache:
