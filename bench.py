@@ -50,36 +50,43 @@ def main():
     from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
     from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
 
-    tcfg = TpuConfig(
-        tp_degree=1,
-        batch_size=BATCH,
-        seq_len=SEQ_LEN,
-        max_context_length=PROMPT_LEN,
-        dtype="bfloat16",
-        on_device_sampling_config=OnDeviceSamplingConfig(),
-        async_mode=True,  # device-resident decode: steps chain on device
-        attn_kernel_enabled=True,  # Pallas flash prefill (D=64 Mosaic path)
-        # attn_tkg_kernel_enabled stays OFF: the fused deferred-write decode
-        # kernel (flash_attention_decode_fused) is correct and composes with
-        # the commit kernel, but measured SLOWER here than XLA's two-part
-        # path (17.1 vs 8.7 ms/step): a pallas operand can't fuse with the
-        # layer scan's cache slice (one materialized copy per layer), and at
-        # G=4 grouped queries XLA's VPU decode lowering is already at the
-        # bandwidth roofline. Revisit if XLA stops fusing the slice reads.
-        skip_warmup=False,
-    )
-    cfg = ml.LlamaInferenceConfig(
-        tcfg,
-        hidden_size=HIDDEN,
-        intermediate_size=INTERMEDIATE,
-        num_hidden_layers=N_LAYERS,
-        num_attention_heads=N_HEADS,
-        num_key_value_heads=N_KV_HEADS,
-        head_dim=HEAD_DIM,
-        vocab_size=VOCAB,
-        rms_norm_eps=1e-5,
-        rope_theta=500000.0,
-    )
+    def make_cfg(**quant_kwargs):
+        """One source of truth for the bench model/runtime shape; the int8
+        line differs ONLY in the quantization flags."""
+        tcfg = TpuConfig(
+            tp_degree=1,
+            batch_size=BATCH,
+            seq_len=SEQ_LEN,
+            max_context_length=PROMPT_LEN,
+            dtype="bfloat16",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            async_mode=True,  # device-resident decode: steps chain on device
+            attn_kernel_enabled=True,  # Pallas flash prefill (D=64 Mosaic path)
+            # attn_tkg_kernel_enabled stays OFF: the fused deferred-write
+            # decode kernel (flash_attention_decode_fused) is correct and
+            # composes with the commit kernel, but measured SLOWER here than
+            # XLA's two-part path (17.1 vs 8.7 ms/step): a pallas operand
+            # can't fuse with the layer scan's cache slice (one materialized
+            # copy per layer), and at G=4 grouped queries XLA's VPU decode
+            # lowering is already at the bandwidth roofline. Revisit if XLA
+            # stops fusing the slice reads.
+            skip_warmup=False,
+            **quant_kwargs,
+        )
+        return tcfg, ml.LlamaInferenceConfig(
+            tcfg,
+            hidden_size=HIDDEN,
+            intermediate_size=INTERMEDIATE,
+            num_hidden_layers=N_LAYERS,
+            num_attention_heads=N_HEADS,
+            num_key_value_heads=N_KV_HEADS,
+            head_dim=HEAD_DIM,
+            vocab_size=VOCAB,
+            rms_norm_eps=1e-5,
+            rope_theta=500000.0,
+        )
+
+    tcfg, cfg = make_cfg()
 
     rng = np.random.default_rng(0)
     arch = ml.build_arch(cfg)
@@ -151,31 +158,10 @@ def main():
     # --- int8-weight decode variant (second bench line; the param read is
     # ~half the decode HBM budget, so int8 weights raise the ceiling) ---
     del app
-    tcfg8 = TpuConfig(
-        tp_degree=1,
-        batch_size=BATCH,
-        seq_len=SEQ_LEN,
-        max_context_length=PROMPT_LEN,
-        dtype="bfloat16",
-        on_device_sampling_config=OnDeviceSamplingConfig(),
-        async_mode=True,
-        attn_kernel_enabled=True,
-        skip_warmup=False,
+    tcfg8, cfg8 = make_cfg(
         quantized=True,
         quantization_dtype="int8",
         quantization_type="per_channel_symmetric",
-    )
-    cfg8 = ml.LlamaInferenceConfig(
-        tcfg8,
-        hidden_size=HIDDEN,
-        intermediate_size=INTERMEDIATE,
-        num_hidden_layers=N_LAYERS,
-        num_attention_heads=N_HEADS,
-        num_key_value_heads=N_KV_HEADS,
-        head_dim=HEAD_DIM,
-        vocab_size=VOCAB,
-        rms_norm_eps=1e-5,
-        rope_theta=500000.0,
     )
 
     class App8(TpuModelForCausalLM):

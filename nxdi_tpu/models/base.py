@@ -781,7 +781,7 @@ def decoder_layer(
 
 def _pipelined_decoder_layers(
     arch, layer_params, hidden, cos, sin, cache, position_ids, step_fn,
-    cache_inputs, adapter_ids, defer=False,
+    cache_inputs, adapter_ids, defer=False, policy=DEFAULT_POLICY,
 ):
     """GPipe-style pipeline over the ``pp`` mesh axis.
 
@@ -854,7 +854,12 @@ def _pipelined_decoder_layers(
             if defer:
                 # k_new/v_new are FRESH ROWS (L_local, mb, KV, 1, D): land
                 # them in the stage-local cache with one in-place commit at
-                # the microbatch's cache lines; bubble ticks drop (slot -1)
+                # the microbatch's cache lines; bubble ticks drop (slot -1).
+                # Inside the pp-manual region the cache is STILL GSPMD-sharded
+                # over the kv-head axes — the pallas call must run per kv
+                # shard (a raw custom call would force the partitioner to
+                # gather the stage cache every tick), so it nests a shard_map
+                # over exactly those axes.
                 from nxdi_tpu.ops.kernels import kv_commit
 
                 pos_mb = slice_b(pos_, i_c).astype(jnp.int32)  # (mb, 1)
@@ -863,10 +868,30 @@ def _pipelined_decoder_layers(
                 if kv_commit.commit_rows_supported(
                     kl.shape, vl.shape, k_new.shape, v_new.shape
                 ):
-                    kl, vl = kv_commit.kv_commit_rows(
-                        kl, vl, k_new.astype(kl.dtype), v_new.astype(vl.dtype),
-                        slots, lines,
+                    kv_ax = policy.cache_kv[1]
+                    axes = tuple(
+                        a for a in (
+                            kv_ax if isinstance(kv_ax, (tuple, list)) else (kv_ax,)
+                        )
+                        if a is not None and a in mesh.axis_names
                     )
+                    kr = k_new.astype(kl.dtype)
+                    vr = v_new.astype(vl.dtype)
+                    if axes:
+                        cspec = P(None, None, kv_ax, None, None)
+                        commit = jax.shard_map(
+                            kv_commit.kv_commit_rows,
+                            # the CONTEXT mesh (pp already manual here)
+                            mesh=jax.sharding.get_abstract_mesh(),
+                            in_specs=(cspec, cspec, cspec, cspec, P(None, None),
+                                      P(None)),
+                            out_specs=(cspec, cspec),
+                            axis_names=set(axes),
+                            check_vma=False,
+                        )
+                        kl, vl = commit(kl, vl, kr, vr, slots, lines)
+                    else:
+                        kl, vl = kv_commit.kv_commit_rows(kl, vl, kr, vr, slots, lines)
                 else:
                     b_idx = lines[:, None]
                     sl = jnp.where(slots < 0, kl.shape[3], slots)
@@ -1163,7 +1188,7 @@ def run_decoder_layers(
         )
         return _pipelined_decoder_layers(
             arch, segments_chk[0], hidden, cos, sin, cache, position_ids,
-            _step, cache_inputs, adapter_ids, defer=defer_pp,
+            _step, cache_inputs, adapter_ids, defer=defer_pp, policy=policy,
         )
 
     if "k_win" in cache:

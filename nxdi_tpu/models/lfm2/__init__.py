@@ -1,0 +1,1 @@
+from nxdi_tpu.models.lfm2 import modeling_lfm2  # noqa: F401

@@ -1,0 +1,535 @@
+"""LFM2 (Liquid) — gated short-convolution + full-attention hybrid.
+
+Reference: the lfm2 entry of the contrib hub's SSM/hybrid slice (alongside
+recurrentgemma / Falcon-H1). The recurrent-state machinery reuses the
+qwen3_next/recurrentgemma pattern: a heterogeneous per-layer walk with a
+dedicated state pytree —
+  - ``k``/``v``:  (n_attn, B, KV, S, D) full-length stacks (exact-position
+                  writes) for the attention layers,
+  - ``conv``:     (n_conv, B, hidden, L_cache) gated-short-conv tails.
+
+HF ``modeling_lfm2.py`` semantics, matched exactly for token parity:
+  - every layer: x + op(operator_norm(x)); then x + mlp(ffn_norm(x)); SwiGLU
+    MLP (w1/w3/w2, no biases) at the block-adjusted intermediate width;
+  - attention layers: GQA (no biases), PER-HEAD q/k rmsnorm BEFORE rope,
+    full-head-dim rotary, out_proj;
+  - conv layers: in_proj -> (B, C, x) thirds; Bx = B * x; depthwise causal
+    conv1d (kernel ``conv_L_cache``); y = C * conv_out -> out_proj. The
+    decode state holds the last L_cache Bx columns;
+  - final ``embedding_norm``; embeddings tied by default.
+
+Right padding: pad lanes must not pollute the conv tail — the saved state
+keeps the last L_cache REAL Bx columns per row (HF zeroes padded inputs
+instead, which leaves zeros in the tail; uniform-length tests match both).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, dtype_name
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops.norms import rms_norm
+from nxdi_tpu.ops.rope import apply_rotary_pos_emb, rope_cos_sin
+from nxdi_tpu.parallel.layers import REPLICATED
+from nxdi_tpu.parallel.mesh import AXIS_MP
+
+
+@dataclass(frozen=True)
+class Lfm2Arch:
+    num_layers: int
+    hidden_size: int
+    num_attention_heads: int
+    num_kv_heads: int
+    head_dim: int
+    intermediate_size: int  # block-adjusted
+    conv_kernel: int
+    conv_bias: bool
+    vocab_size: int
+    vocab_pad: int
+    layer_types: Tuple[str, ...]  # "conv" | "full_attention"
+    rms_norm_eps: float
+    rope_theta: float
+    dtype: str
+
+    @property
+    def n_attn(self) -> int:
+        return sum(t == "full_attention" for t in self.layer_types)
+
+    @property
+    def n_conv(self) -> int:
+        return sum(t != "full_attention" for t in self.layer_types)
+
+
+class Lfm2InferenceConfig(InferenceConfig):
+    REQUIRED = [
+        "hidden_size",
+        "intermediate_size",
+        "num_hidden_layers",
+        "num_attention_heads",
+        "num_key_value_heads",
+        "vocab_size",
+    ]
+
+    def add_derived_config(self):
+        if not hasattr(self, "conv_L_cache"):
+            self.conv_L_cache = 3
+        if not hasattr(self, "conv_bias"):
+            self.conv_bias = False
+        if not hasattr(self, "norm_eps"):
+            self.norm_eps = 1e-5
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            self.layer_types = ["full_attention"] * self.num_hidden_layers
+
+
+def _ff_dim(config: InferenceConfig) -> int:
+    """HF Lfm2MLP block-adjusted width."""
+    inter = config.intermediate_size
+    if getattr(config, "block_auto_adjust_ff_dim", True):
+        inter = int(2 * inter / 3)
+        mult = getattr(config, "block_ffn_dim_multiplier", None)
+        if mult is not None:
+            inter = int(mult * inter)
+        m = getattr(config, "block_multiple_of", 256)
+        inter = m * ((inter + m - 1) // m)
+    return inter
+
+
+def build_arch(config: InferenceConfig, **overrides) -> Lfm2Arch:
+    kwargs = dict(
+        num_layers=config.num_hidden_layers,
+        hidden_size=config.hidden_size,
+        num_attention_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        head_dim=config.head_dim,
+        intermediate_size=_ff_dim(config),
+        conv_kernel=int(config.conv_L_cache),
+        conv_bias=bool(config.conv_bias),
+        vocab_size=config.vocab_size,
+        vocab_pad=0,
+        layer_types=tuple(config.layer_types),
+        rms_norm_eps=float(getattr(config, "norm_eps", 1e-5)),
+        rope_theta=float(getattr(config, "rope_theta", 1000000.0)),
+        dtype=dtype_name(config.tpu_config.dtype),
+    )
+    kwargs.update(overrides)
+    return Lfm2Arch(**kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    hd = config.head_dim
+    theta = float(getattr(config, "rope_theta", 1000000.0))
+    return 1.0 / (theta ** (np.arange(0, hd, 2, dtype=np.float64) / hd))
+
+
+def attention_layer(arch, lp, x, cos, sin, k_cache, v_cache, position_ids,
+                    attend_to_cache, kv_window):
+    B, S, _ = x.shape
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    q = (x @ lp["q_w"]).reshape(B, S, H, D)
+    k = (x @ lp["k_w"]).reshape(B, S, KV, D)
+    v = (x @ lp["v_w"]).reshape(B, S, KV, D)
+    # per-head q/k rmsnorm BEFORE rope (HF Lfm2Attention q/k_layernorm)
+    q = rms_norm(q, lp["q_norm"], arch.rms_norm_eps)
+    k = rms_norm(k, lp["k_norm"], arch.rms_norm_eps)
+    q = jnp.swapaxes(q, 1, 2)
+    k = jnp.swapaxes(k, 1, 2)
+    v = jnp.swapaxes(v, 1, 2)
+    q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+    pos = position_ids.astype(jnp.int32)
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    new_k = k_cache.at[b_idx, :, pos].set(
+        jnp.swapaxes(k, 1, 2).astype(k_cache.dtype), mode="drop"
+    )
+    new_v = v_cache.at[b_idx, :, pos].set(
+        jnp.swapaxes(v, 1, 2).astype(v_cache.dtype), mode="drop"
+    )
+    if attend_to_cache:
+        W = kv_window if kv_window is not None else new_k.shape[2]
+        kk = new_k[:, :, :W].astype(q.dtype)
+        vv = new_v[:, :, :W].astype(q.dtype)
+        kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
+        ctx = attn_ops.attention_with_positions(q, kk, vv, pos, kv_pos)
+    else:
+        ctx = attn_ops.attention_with_positions(q, k, v, pos, pos)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    return ctx @ lp["o_w"], new_k, new_v
+
+
+def conv_layer(arch, lp, x, conv_state, last_token_index, is_decode):
+    """HF Lfm2ShortConv: thirds gate the depthwise causal conv."""
+    B, S, Hh = x.shape
+    K = arch.conv_kernel
+    bcx = x @ lp["in_w"]
+    if arch.conv_bias:
+        bcx = bcx + lp["in_b"]
+    Bg, Cg, xg = jnp.split(bcx, 3, axis=-1)
+    bx = jnp.swapaxes(Bg * xg, 1, 2)  # (B, hidden, S)
+    w = lp["conv_w"]  # (hidden, K)
+    if is_decode:
+        window = jnp.concatenate([conv_state[:, :, 1:], bx], axis=-1)  # (B,H,K)
+        out = jnp.sum(window * w[None], axis=-1)
+        if arch.conv_bias:
+            out = out + lp["conv_b"]
+        conv_out = out[:, None, :]
+        new_conv = window
+    else:
+        padded = jnp.pad(bx, ((0, 0), (0, 0), (K - 1, 0)))
+        conv = sum(
+            padded[:, :, j : j + S] * w[:, j][None, :, None] for j in range(K)
+        )
+        if arch.conv_bias:
+            conv = conv + lp["conv_b"][None, :, None]
+        conv_out = jnp.swapaxes(conv, 1, 2)
+        # tail: last K REAL Bx columns per row (right padding skipped)
+        lti = last_token_index.astype(jnp.int32)
+        idx = lti[:, None] - jnp.arange(K - 1, -1, -1, dtype=jnp.int32)[None, :]
+        gathered = jnp.take_along_axis(
+            jnp.pad(bx, ((0, 0), (0, 0), (0, 1))),
+            jnp.clip(idx, 0, S)[:, None, :].repeat(bx.shape[1], axis=1),
+            axis=2,
+        )
+        new_conv = jnp.where((idx >= 0)[:, None, :], gathered, 0.0).astype(
+            conv_state.dtype
+        )
+    y = Cg * conv_out
+    y = y @ lp["out_w"]
+    if arch.conv_bias:
+        y = y + lp["out_b"]
+    return y, new_conv
+
+
+def lfm2_forward(
+    arch: Lfm2Arch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+    policy=None,
+    layout=None,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    output_all_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+    return_next_inputs: bool = False,
+    **_unused,
+):
+    from nxdi_tpu.config import to_jax_dtype
+
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    dt = to_jax_dtype(arch.dtype)
+    B, S = input_ids.shape
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(dt)
+    cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq), dtype=jnp.float32)
+    lti = batch.get("last_token_index", jnp.full((B,), S - 1, jnp.int32))
+
+    new_k, new_v, new_conv = cache["k"], cache["v"], cache["conv"]
+    ai = ci = 0
+    for i, lt in enumerate(arch.layer_types):
+        lp = params["layers"][i]
+        h = rms_norm(hidden, lp["operator_norm"], arch.rms_norm_eps)
+        if lt == "full_attention":
+            out, k_new, v_new = attention_layer(
+                arch, lp, h, cos, sin, new_k[ai], new_v[ai], position_ids,
+                attend_to_cache, kv_window,
+            )
+            new_k = new_k.at[ai].set(k_new)
+            new_v = new_v.at[ai].set(v_new)
+            ai += 1
+        else:
+            out, c_new = conv_layer(
+                arch, lp, h, new_conv[ci], lti, attend_to_cache
+            )
+            new_conv = new_conv.at[ci].set(c_new)
+            ci += 1
+        hidden = hidden + out
+        h = rms_norm(hidden, lp["ffn_norm"], arch.rms_norm_eps)
+        hidden = hidden + (
+            jax.nn.silu(h @ lp["w1"]) * (h @ lp["w3"])
+        ) @ lp["w2"]
+
+    hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
+    if gather_last_token and not output_all_logits:
+        idx = batch["last_token_index"][:, None, None]
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
+        )
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if on_device_sampling:
+        tokens = sampling_ops.sample(
+            logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )
+        outputs["tokens"] = tokens[:, None]
+    if output_logits or output_all_logits or not on_device_sampling:
+        outputs["logits"] = logits[..., : arch.vocab_size - arch.vocab_pad]
+    return outputs, {"k": new_k, "v": new_v, "conv": new_conv}
+
+
+# ---------------------------------------------------------------------------
+# Conversion / specs / struct
+# ---------------------------------------------------------------------------
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    cast = lambda a: np.asarray(a, dtype=dense.np_dtype(arch.dtype))  # noqa: E731
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    layers = []
+    for i, lt in enumerate(arch.layer_types):
+        p = f"layers.{i}."
+        layer: Dict[str, Any] = {
+            "operator_norm": cast(get(p + "operator_norm.weight")),
+            "ffn_norm": cast(get(p + "ffn_norm.weight")),
+            "w1": cast(get(p + "feed_forward.w1.weight").T),
+            "w3": cast(get(p + "feed_forward.w3.weight").T),
+            "w2": cast(get(p + "feed_forward.w2.weight").T),
+        }
+        if lt == "full_attention":
+            layer.update(
+                q_w=cast(get(p + "self_attn.q_proj.weight").T),
+                k_w=cast(get(p + "self_attn.k_proj.weight").T),
+                v_w=cast(get(p + "self_attn.v_proj.weight").T),
+                o_w=cast(get(p + "self_attn.out_proj.weight").T),
+                q_norm=cast(get(p + "self_attn.q_layernorm.weight")),
+                k_norm=cast(get(p + "self_attn.k_layernorm.weight")),
+            )
+        else:
+            layer.update(
+                in_w=cast(get(p + "conv.in_proj.weight").T),
+                out_w=cast(get(p + "conv.out_proj.weight").T),
+                conv_w=cast(get(p + "conv.conv.weight")[:, 0, :]),  # (H,1,K)->(H,K)
+            )
+            if arch.conv_bias:
+                layer.update(
+                    in_b=cast(get(p + "conv.in_proj.bias")),
+                    out_b=cast(get(p + "conv.out_proj.bias")),
+                    conv_b=cast(get(p + "conv.conv.bias")),
+                )
+        layers.append(layer)
+    params = {
+        "embed_tokens": cast(get("embed_tokens.weight")),
+        "norm": cast(get("embedding_norm.weight")),
+        "layers": layers,
+    }
+    # the CONFIG flag is the contract (specs/struct follow it): a tied torch
+    # state_dict may still carry a redundant lm_head.weight copy — drop it
+    if not getattr(config, "tie_word_embeddings", True):
+        params["lm_head"] = cast(np.asarray(state_dict["lm_head.weight"]).T)
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    arch = build_arch(config)
+    tp = config.tpu_config.tp_degree
+    heads_ok = tp > 1 and arch.num_attention_heads % tp == 0
+    kv_ok = heads_ok and arch.num_kv_heads % tp == 0
+    hid_ok = tp > 1 and arch.hidden_size % tp == 0
+    col = P(None, AXIS_MP) if heads_ok else REPLICATED
+    row = P(AXIS_MP, None) if heads_ok else REPLICATED
+
+    specs_layers = []
+    for lt in arch.layer_types:
+        layer = {
+            "operator_norm": REPLICATED,
+            "ffn_norm": REPLICATED,
+            "w1": col, "w3": col, "w2": row,
+        }
+        if lt == "full_attention":
+            layer.update(
+                q_w=col,
+                k_w=(col if kv_ok else REPLICATED),
+                v_w=(col if kv_ok else REPLICATED),
+                o_w=row,
+                q_norm=REPLICATED, k_norm=REPLICATED,
+            )
+        else:
+            # in_proj's 3*hidden output is [B|C|x] thirds — each third must
+            # shard consistently with the conv channels; keep replicated
+            # unless hidden divides tp (then shard channels per third is
+            # still interleaved across thirds, so stay replicated for
+            # correctness; the conv is cheap)
+            layer.update(
+                in_w=REPLICATED,
+                out_w=(P(AXIS_MP, None) if hid_ok else REPLICATED),
+                conv_w=REPLICATED,
+            )
+            if arch.conv_bias:
+                layer.update(in_b=REPLICATED, out_b=REPLICATED, conv_b=REPLICATED)
+        specs_layers.append(layer)
+    specs = {
+        "embed_tokens": P(AXIS_MP, None) if heads_ok else REPLICATED,
+        "norm": REPLICATED,
+        "layers": specs_layers,
+    }
+    if not getattr(config, "tie_word_embeddings", True):
+        # tied checkpoints carry no lm_head tensor (safetensors dedupes the
+        # shared weight) — the specs/struct/params pytrees must agree
+        specs["lm_head"] = P(None, AXIS_MP) if heads_ok else REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    Hd = arch.hidden_size
+    layers = []
+    for lt in arch.layer_types:
+        layer = {
+            "operator_norm": s(Hd),
+            "ffn_norm": s(Hd),
+            "w1": s(Hd, arch.intermediate_size),
+            "w3": s(Hd, arch.intermediate_size),
+            "w2": s(arch.intermediate_size, Hd),
+        }
+        if lt == "full_attention":
+            layer.update(
+                q_w=s(Hd, arch.num_attention_heads * arch.head_dim),
+                k_w=s(Hd, arch.num_kv_heads * arch.head_dim),
+                v_w=s(Hd, arch.num_kv_heads * arch.head_dim),
+                o_w=s(arch.num_attention_heads * arch.head_dim, Hd),
+                q_norm=s(arch.head_dim),
+                k_norm=s(arch.head_dim),
+            )
+        else:
+            layer.update(
+                in_w=s(Hd, 3 * Hd),
+                out_w=s(Hd, Hd),
+                conv_w=s(Hd, arch.conv_kernel),
+            )
+            if arch.conv_bias:
+                layer.update(in_b=s(3 * Hd), out_b=s(Hd), conv_b=s(Hd))
+        layers.append(layer)
+    struct = {
+        "embed_tokens": s(arch.vocab_size, Hd),
+        "norm": s(Hd),
+        "layers": layers,
+    }
+    if not getattr(config, "tie_word_embeddings", True):
+        struct["lm_head"] = s(Hd, arch.vocab_size)
+    return struct
+
+
+# ---------------------------------------------------------------------------
+# Cache + application
+# ---------------------------------------------------------------------------
+
+
+def cache_shapes(arch: Lfm2Arch, batch_size: int, seq_len: int):
+    from nxdi_tpu.config import to_jax_dtype
+
+    dt = to_jax_dtype(arch.dtype)
+    return {
+        "k": ((arch.n_attn, batch_size, arch.num_kv_heads, seq_len, arch.head_dim), dt),
+        "v": ((arch.n_attn, batch_size, arch.num_kv_heads, seq_len, arch.head_dim), dt),
+        "conv": ((arch.n_conv, batch_size, arch.hidden_size, arch.conv_kernel), dt),
+    }
+
+
+from nxdi_tpu.runtime.application import TpuModelForCausalLM  # noqa: E402
+
+
+class Lfm2ForCausalLM(TpuModelForCausalLM):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        unsupported = [
+            ("async_mode", tc.async_mode),
+            ("is_prefix_caching", tc.is_prefix_caching),
+            ("is_chunked_prefill", tc.is_chunked_prefill),
+            ("is_block_kv_layout", tc.is_block_kv_layout),
+            ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
+            ("speculation", tc.speculation_length > 0 or tc.is_medusa),
+            ("tensor_capture_config", tc.tensor_capture_config is not None),
+            # the raw-array param layout bypasses the {"w"} dict rewrite the
+            # quantizer/LoRA attach operate on — fail loudly, don't no-op
+            ("quantized", tc.quantized),
+            ("lora_config", tc.lora_config is not None),
+        ]
+        bad = [name for name, val in unsupported if val]
+        if bad:
+            raise ValueError(
+                "lfm2 does not support: " + ", ".join(bad) + " — the short-conv "
+                "recurrence needs dedicated state routing for these modes"
+            )
+
+    def enable_models(self) -> None:
+        super().enable_models()
+        for wrapper in self.models.values():
+            wrapper.forward_fn = lfm2_forward
+
+    def _arch(self):
+        return build_arch(self.config)
+
+    def cache_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        arch = self._arch()
+        tp = self.tpu_config.tp_degree
+        kv = AXIS_MP if (tp > 1 and arch.num_kv_heads % tp == 0) else None
+        return {
+            "k": P(None, None, kv, None, None),
+            "v": P(None, None, kv, None, None),
+            "conv": P(),  # interleaved [B|C|x] thirds: channels stay replicated
+        }
+
+    def init_cache_host(self):
+        tc = self.tpu_config
+        return {
+            k: jnp.zeros(shape, dt)
+            for k, (shape, dt) in cache_shapes(
+                self._arch(),
+                tc.kv_cache_batch_size + tc.kv_cache_padding_size,
+                tc.seq_len,
+            ).items()
+        }
+
+    def _cache_struct(self):
+        tc = self.tpu_config
+        shapes = cache_shapes(
+            self._arch(), tc.kv_cache_batch_size + tc.kv_cache_padding_size, tc.seq_len
+        )
+        return {k: jax.ShapeDtypeStruct(shape, dt) for k, (shape, dt) in shapes.items()}
+
+
+APPLICATION_CLS = Lfm2ForCausalLM

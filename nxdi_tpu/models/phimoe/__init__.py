@@ -1,0 +1,1 @@
+from nxdi_tpu.models.phimoe import modeling_phimoe  # noqa: F401

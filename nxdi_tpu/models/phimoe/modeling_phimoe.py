@@ -1,0 +1,140 @@
+"""Phi-3.5-MoE (phimoe) — sparsemixer-routed MoE with biased LayerNorms.
+
+Reference: the Phi-3.5-MoE entry of the contrib hub. Llama-lineage decoder
+distinguished by (HF ``modeling_phimoe.py``):
+  - BIASED LayerNorms (elementwise-affine, bias) for the per-layer and final
+    norms — the {"w","b"} dict-norm convention (models/base.py _norm);
+  - qkv AND o projections with biases;
+  - sparsemixer top-2 routing (ops/moe.py ``sparsemixer``): each expert's
+    weight comes from a softmax over THRESHOLD-masked scores
+    ((max - s)/clamp(|s|, min=max) > 2*jitter), the top-1 expert masked out
+    before picking the second;
+  - mixtral-style expert MLPs (w1/w3/w2);
+  - optional LongRoPE scaling (the phi3 short/long frequency machinery).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.moe import MoEArch, convert_hf_experts, moe_parallel_fields
+from nxdi_tpu.parallel.layers import REPLICATED
+
+_W_NAMES = {"gate": "w1", "up": "w3", "down": "w2"}
+
+
+class PhimoeInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        super().add_derived_config()
+        if getattr(self, "lm_head_bias", False):
+            raise NotImplementedError("phimoe lm_head_bias is not supported yet")
+        if not hasattr(self, "rms_norm_eps"):
+            self.rms_norm_eps = 1e-5
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    return MoEArch(
+        num_experts=config.num_local_experts,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.intermediate_size,
+        norm_topk_prob=False,
+        sparsemixer=True,
+        router_jitter=float(getattr(config, "router_jitter_noise", 0.01)),
+        **moe_parallel_fields(config.tpu_config, config.num_local_experts),
+    )
+
+
+# LongRoPE rides the phi3 machinery (short/long frequency sets)
+from nxdi_tpu.models.phi3.modeling_phi3 import build_inv_freq as _phi3_inv_freq  # noqa: E402
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return _phi3_inv_freq(config)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        moe=_moe_arch(config),
+        attention_bias=True,
+        attention_o_bias=True,
+        layernorm=True,
+    )
+    rs = getattr(config, "rope_scaling", None) or {}
+    if rs.get("type") == "longrope" or rs.get("rope_type") == "longrope":
+        kwargs["longrope_original_max"] = int(
+            getattr(config, "original_max_position_embeddings",
+                    config.max_position_embeddings)
+        )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+
+    def ff(get, has, cast, pre):
+        return "moe", convert_hf_experts(
+            get,
+            cast,
+            arch.moe.num_experts,
+            pre + "block_sparse_moe.gate.weight",
+            lambda j, proj: f"{pre}block_sparse_moe.experts.{j}.{_W_NAMES[proj]}.weight",
+        )
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+
+    # biased LayerNorms: wrap the weight-only arrays as {"w","b"} dicts
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+    for key, hf in (("input_layernorm", "input_layernorm"),
+                    ("post_attention_layernorm", "post_attention_layernorm")):
+        params["layers"][key] = {
+            "w": params["layers"][key],
+            "b": np.stack(
+                [src(f"layers.{i}.{hf}.bias") for i in range(L)]
+            ).astype(dt),
+        }
+    params["norm"] = {"w": params["norm"], "b": src("norm.bias").astype(dt)}
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["input_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
+    specs["layers"]["post_attention_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    struct["layers"]["input_layernorm"] = {"w": s(L, H), "b": s(L, H)}
+    struct["layers"]["post_attention_layernorm"] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    return struct

@@ -638,6 +638,9 @@ class RecurrentGemmaForCausalLM(TpuModelForCausalLM):
             ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
             ("speculation", tc.speculation_length > 0 or tc.is_medusa),
             ("tensor_capture_config", tc.tensor_capture_config is not None),
+            # raw-array param layout: the quantizer/LoRA rewrites would no-op
+            ("quantized", tc.quantized),
+            ("lora_config", tc.lora_config is not None),
         ]
         bad = [name for name, val in unsupported if val]
         if bad:

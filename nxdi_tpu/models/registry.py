@@ -55,6 +55,14 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "nxdi_tpu.models.qwen2_5_omni.modeling_qwen2_5_omni",
         "Qwen2_5OmniInferenceConfig",
     ),
+    "phimoe": (
+        "nxdi_tpu.models.phimoe.modeling_phimoe",
+        "PhimoeInferenceConfig",
+    ),
+    "lfm2": (
+        "nxdi_tpu.models.lfm2.modeling_lfm2",
+        "Lfm2InferenceConfig",
+    ),
     "qwen2_5_omni_thinker": (
         "nxdi_tpu.models.qwen2_5_omni.modeling_qwen2_5_omni",
         "Qwen2_5OmniInferenceConfig",

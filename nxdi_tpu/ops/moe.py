@@ -94,6 +94,12 @@ class MoEArch:
     # selection), grouped top-k over n_group groups keeping topk_group, final
     # weights from the UNCORRECTED sigmoid scores, scaled by routed_scaling
     sigmoid_routing: bool = False
+    # phimoe (Phi-3.5-MoE) sparsemixer routing (HF sparsemixer, eval path):
+    # expert k's weight comes from a softmax over scores THRESHOLD-masked at
+    # (max - s)/clamp(|s|, min=max) > 2*jitter_eps, with the top-1 expert
+    # masked out before selecting the second
+    sparsemixer: bool = False
+    router_jitter: float = 0.01
     n_group: Optional[int] = None
     topk_group: Optional[int] = None
     routed_scaling: float = 1.0
@@ -243,6 +249,34 @@ def route_topk(
     top-k on the INPUT scale (llama4), and deepseek-V3 sigmoid grouped top-k
     with selection-only correction bias."""
     logits = router_logits.astype(jnp.float32)
+    if moe.sparsemixer:
+        # HF phimoe sparsemixer, inference path (top-2 only)
+        assert moe.top_k == 2, "sparsemixer routing is top-2"
+
+        def pick(scores):
+            mx = jnp.max(scores, axis=-1, keepdims=True)
+            idx = jnp.argmax(scores, axis=-1, keepdims=True)
+            factor = jnp.maximum(jnp.abs(scores), mx)
+            drop = (mx - scores) / factor > 2.0 * moe.router_jitter
+            gates = jax.nn.softmax(jnp.where(drop, -jnp.inf, scores), axis=-1)
+            w = jnp.take_along_axis(gates, idx, axis=-1)
+            return w, idx
+
+        w1, i1 = pick(logits)
+        masked = jnp.where(
+            jax.nn.one_hot(i1[:, 0], logits.shape[-1], dtype=bool), -jnp.inf, logits
+        )
+        # the second threshold mask uses the ORIGINAL |scores| clamp floor
+        mx2 = jnp.max(masked, axis=-1, keepdims=True)
+        i2 = jnp.argmax(masked, axis=-1, keepdims=True)
+        factor2 = jnp.maximum(jnp.abs(logits), mx2)
+        drop2 = (mx2 - logits) / factor2 > 2.0 * moe.router_jitter
+        gates2 = jax.nn.softmax(jnp.where(drop2, -jnp.inf, masked), axis=-1)
+        w2 = jnp.take_along_axis(gates2, i2, axis=-1)
+        return (
+            jnp.concatenate([w1, w2], axis=-1),
+            jnp.concatenate([i1, i2], axis=-1).astype(jnp.int32),
+        )
     if moe.sigmoid_routing or moe.routed_scaling != 1.0 or (moe.n_group or 0) > 1:
         # deepseek lineage. V3 (sigmoid_routing): sigmoid scores, selection
         # over bias-corrected scores, group metric = sum of top-2 members.

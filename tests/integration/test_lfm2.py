@@ -1,0 +1,80 @@
+"""LFM2 token matching vs HF CPU (reference: the lfm2 entry of the contrib
+hub's SSM/hybrid slice): gated short-conv layers + full-attention layers with
+per-head qk norms, hybrid conv-state + KV cache across prefill -> decode."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.lfm2 import modeling_lfm2 as lf
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+
+@pytest.fixture
+def tiny_hf_lfm2():
+    from transformers import Lfm2Config, Lfm2ForCausalLM
+
+    torch.manual_seed(0)
+    cfg = Lfm2Config(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        norm_eps=1e-5,
+        rope_theta=10000.0,
+        conv_L_cache=3,
+        conv_bias=False,
+        block_multiple_of=32,
+        layer_types=["conv", "full_attention", "conv", "full_attention"],
+        tie_word_embeddings=True,
+    )
+    return Lfm2ForCausalLM(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = lf.Lfm2InferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(lf.Lfm2ForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=lf)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_lfm2_greedy_token_matching(tiny_hf_lfm2, tp_degree):
+    hf_model, hf_cfg = tiny_hf_lfm2
+    app = _build_app(hf_model, hf_cfg, tp_degree=tp_degree)
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=20)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=20)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_lfm2_cache_shapes(tiny_hf_lfm2):
+    hf_model, hf_cfg = tiny_hf_lfm2
+    app = _build_app(hf_model, hf_cfg)
+    kc = app.kv_cache
+    assert set(kc) == {"k", "v", "conv"}
+    assert kc["k"].shape[0] == 2  # attention layers only
+    assert kc["conv"].shape == (2, 1, 64, 3)

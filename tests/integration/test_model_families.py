@@ -104,6 +104,31 @@ def _tiny_hf(model_type):
             tie_word_embeddings=False,
         )
         model = GptOssForCausalLM(cfg)
+    elif model_type == "phimoe":
+        from transformers import PhimoeConfig, PhimoeForCausalLM
+
+        # sparsemixer top-2 routing + biased LayerNorms + biased qkv/o
+        cfg = PhimoeConfig(
+            hidden_size=64,
+            intermediate_size=128,
+            num_hidden_layers=4,
+            num_attention_heads=4,
+            num_key_value_heads=2,
+            vocab_size=256,
+            max_position_embeddings=256,
+            rms_norm_eps=1e-5,
+            rope_theta=10000.0,
+            num_local_experts=8,
+            num_experts_per_tok=2,
+            router_jitter_noise=0.01,
+            input_jitter_noise=0.0,
+            attention_bias=True,
+            lm_head_bias=False,
+            rope_scaling=None,
+            tie_word_embeddings=False,
+            sliding_window=None,
+        )
+        model = PhimoeForCausalLM(cfg)
     elif model_type == "deepseek_v3":
         from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
 
@@ -327,7 +352,7 @@ def _build_app(model_type, hf_model, hf_cfg, tp_degree=1):
     "model_type",
     ["qwen2", "qwen3", "mistral", "mixtral", "qwen3_moe", "gemma3", "gemma2",
      "phi3", "phi3_longrope", "gpt2", "dbrx", "gpt_oss", "deepseek_v3",
-     "deepseek_v3_moe", "llama4_text", "olmo2", "granite", "smollm3"]
+     "deepseek_v3_moe", "llama4_text", "olmo2", "granite", "smollm3", "phimoe"]
 )
 @pytest.mark.parametrize("tp_degree", [1, 8])
 def test_family_greedy_token_matching(model_type, tp_degree):
