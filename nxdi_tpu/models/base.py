@@ -1252,6 +1252,17 @@ def run_decoder_layers(
 # Full forward
 # ---------------------------------------------------------------------------
 
+# the layout-input keys every KV layout may consume (ContiguousKVLayout /
+# BlockKVLayout / WindowKVLayout .get what they need); single source of truth
+# for causal_lm_forward and the custom family forwards (e.g. mimo_v2)
+CACHE_INPUT_KEYS = ("seq_ids", "slot_mapping", "block_table",
+                    "write_positions", "attn_mask", "last_token_index")
+
+
+def collect_cache_inputs(batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+    return {k: batch[k] for k in CACHE_INPUT_KEYS if k in batch}
+
+
 def causal_lm_forward(
     arch: DecoderArch,
     inv_freq: np.ndarray,
@@ -1367,12 +1378,7 @@ def causal_lm_forward(
         )
     else:
         cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
-    cache_inputs = {
-        k: batch[k]
-        for k in ("seq_ids", "slot_mapping", "block_table", "write_positions",
-                  "attn_mask", "last_token_index")
-        if k in batch
-    }
+    cache_inputs = collect_cache_inputs(batch)
     layer_injections = None
     if image_token_id is not None and "deepstack_embeds" in batch:
         # qwen3-vl deepstack: layer k's output gains the k-th vision feature

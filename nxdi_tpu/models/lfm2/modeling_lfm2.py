@@ -241,6 +241,9 @@ def lfm2_forward(
     cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq), dtype=jnp.float32)
     lti = batch.get("last_token_index", jnp.full((B,), S - 1, jnp.int32))
 
+    from nxdi_tpu.models.state_routing import put_rows, take_rows
+
+    sids = batch.get("seq_ids")  # continuous batching: row i -> cache line
     new_k, new_v, new_conv = cache["k"], cache["v"], cache["conv"]
     ai = ci = 0
     for i, lt in enumerate(arch.layer_types):
@@ -248,17 +251,18 @@ def lfm2_forward(
         h = rms_norm(hidden, lp["operator_norm"], arch.rms_norm_eps)
         if lt == "full_attention":
             out, k_new, v_new = attention_layer(
-                arch, lp, h, cos, sin, new_k[ai], new_v[ai], position_ids,
-                attend_to_cache, kv_window,
+                arch, lp, h, cos, sin,
+                take_rows(new_k[ai], sids), take_rows(new_v[ai], sids),
+                position_ids, attend_to_cache, kv_window,
             )
-            new_k = new_k.at[ai].set(k_new)
-            new_v = new_v.at[ai].set(v_new)
+            new_k = put_rows(new_k, ai, k_new, sids)
+            new_v = put_rows(new_v, ai, v_new, sids)
             ai += 1
         else:
             out, c_new = conv_layer(
-                arch, lp, h, new_conv[ci], lti, attend_to_cache
+                arch, lp, h, take_rows(new_conv[ci], sids), lti, attend_to_cache
             )
-            new_conv = new_conv.at[ci].set(c_new)
+            new_conv = put_rows(new_conv, ci, c_new, sids)
             ci += 1
         hidden = hidden + out
         h = rms_norm(hidden, lp["ffn_norm"], arch.rms_norm_eps)
@@ -478,7 +482,6 @@ class Lfm2ForCausalLM(TpuModelForCausalLM):
             ("is_prefix_caching", tc.is_prefix_caching),
             ("is_chunked_prefill", tc.is_chunked_prefill),
             ("is_block_kv_layout", tc.is_block_kv_layout),
-            ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
             ("speculation", tc.speculation_length > 0 or tc.is_medusa),
             ("tensor_capture_config", tc.tensor_capture_config is not None),
             # the raw-array param layout bypasses the {"w"} dict rewrite the

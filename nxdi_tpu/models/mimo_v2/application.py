@@ -22,10 +22,9 @@ class MiMoV2Application(TpuModelForCausalLM):
         for flag, why in (
             (tc.async_mode, "async (device-resident) decode"),
             (tc.is_block_kv_layout, "paged KV layout"),
-            (tc.is_continuous_batching, "continuous batching"),
             (tc.lora_config is not None, "LoRA serving"),
-            (tc.speculation_length > 0 or tc.enable_fused_speculation or tc.is_medusa,
-             "speculative decoding"),
+            (tc.enable_fused_speculation or tc.is_medusa,
+             "fused/medusa speculative decoding"),
             (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
             (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
         ):
@@ -88,7 +87,6 @@ class MiMoV2Application(TpuModelForCausalLM):
         super().enable_models()
         for w in self.models.values():
             w.forward_fn = mv.causal_lm_forward
-            w.forward_kwargs.pop("output_all_logits", None)
             w.forward_kwargs.pop("tensor_capture", None)
             w.forward_kwargs.pop("return_next_inputs", None)
             if w.forward_kwargs.pop("dp_sampling", False):

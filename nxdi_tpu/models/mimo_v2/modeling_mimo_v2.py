@@ -206,10 +206,12 @@ def causal_lm_forward(
     layout=None,
     gather_last_token: bool = True,
     output_logits: bool = False,
+    output_all_logits: bool = False,
     on_device_sampling: bool = True,
     do_sample: bool = False,
     global_topk: int = 256,
     deterministic: bool = False,
+    **_unused,
 ):
     import jax.numpy as jnp
 
@@ -250,6 +252,12 @@ def causal_lm_forward(
             window=cache["k_swa"].shape[3],
             route_by_seq_id=getattr(layout, "route_by_seq_id", False),
         )
+    # full layout-input pass-through: seq_ids (continuous batching),
+    # write_positions (spec verify windows), attn_mask, last_token_index
+    # (the ring write's keep-mask under right padding — WindowKVLayout.update)
+    from nxdi_tpu.models.base import collect_cache_inputs
+
+    cache_inputs = collect_cache_inputs(batch) or None
     seg_new = {"full": {}, "swa": {}}  # type -> {lo: (k, v)}
     for kind, lo, hi, seg_idx in arch.schedule:
         ta = arch.full if kind == "full" else arch.swa
@@ -262,11 +270,7 @@ def causal_lm_forward(
             ta, params["segments"][seg_idx], hidden, cs[0], cs[1],
             {"k": k_sl, "v": v_sl}, position_ids, spec, attend_to_cache,
             kv_window=kv_window, policy=policy, layout=layouts[kind],
-            # the ring write's keep-mask needs the true last token under
-            # right padding (WindowKVLayout.update)
-            cache_inputs={"last_token_index": batch["last_token_index"]}
-            if "last_token_index" in batch
-            else None,
+            cache_inputs=cache_inputs,
         )
         seg_new[kind][lo] = seg_cache
 
@@ -288,7 +292,7 @@ def causal_lm_forward(
     lm_head = params.get("lm_head")
     if lm_head is None:
         lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
-    if gather_last_token:
+    if gather_last_token and not output_all_logits:
         idx = batch["last_token_index"][:, None, None]
         hidden = jnp.take_along_axis(
             hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
@@ -297,18 +301,28 @@ def causal_lm_forward(
     logits = constrain(logits, policy.logits)
     logits = sampling_ops.mask_padded_logits(logits, t.vocab_pad)
 
+    if output_all_logits and gather_last_token:
+        # ungathered hidden: the sampler still needs the TRUE last position,
+        # not the bucket-padded tail (base.py:1464-1469)
+        idx = batch["last_token_index"][:, None, None]
+        last_logits = jnp.take_along_axis(
+            logits, jnp.broadcast_to(idx, (B, 1, logits.shape[2])), axis=1
+        )
+    else:
+        last_logits = logits
+
     outputs: Dict[str, jax.Array] = {}
     if on_device_sampling:
         outputs["tokens"] = sampling_ops.sample(
-            logits[:, -1, :],
+            last_logits[:, -1, :],
             batch["sampling_params"],
             rng=batch.get("rng"),
             do_sample=do_sample,
             global_topk=global_topk,
             deterministic=deterministic,
         )[:, None]
-    if output_logits or not on_device_sampling:
-        outputs["logits"] = logits
+    if output_logits or output_all_logits or not on_device_sampling:
+        outputs["logits"] = logits[..., : t.vocab_size - t.vocab_pad]
     return outputs, new_cache
 
 
@@ -546,3 +560,13 @@ class MiMoV2ForCausalLM:
         from nxdi_tpu.models.mimo_v2.application import MiMoV2Application
 
         return MiMoV2Application(*args, **kwargs)
+
+
+def __getattr__(name):
+    # lazy APPLICATION_CLS: application.py imports this module, so a
+    # top-level import back would be circular
+    if name == "APPLICATION_CLS":
+        from nxdi_tpu.models.mimo_v2.application import MiMoV2Application
+
+        return MiMoV2Application
+    raise AttributeError(name)

@@ -450,6 +450,9 @@ def qwen3next_forward(
         lti = batch["last_token_index"]
         valid = jnp.arange(S, dtype=jnp.int32)[None, :] <= lti[:, None]
 
+    from nxdi_tpu.models.state_routing import put_rows, take_rows
+
+    sids = batch.get("seq_ids")  # continuous batching: row i -> cache line
     new_k, new_v = cache["k"], cache["v"]
     new_conv, new_rec = cache["conv"], cache["rec"]
     fi = li = 0
@@ -458,19 +461,21 @@ def qwen3next_forward(
         h = _g_norm(arch, hidden, lp["input_layernorm"])
         if lt == "linear_attention":
             out, c_new, r_new = linear_attention_layer(
-                arch, lp["linear_attn"], h, new_conv[li], new_rec[li], valid,
-                is_decode=attend_to_cache,
+                arch, lp["linear_attn"], h,
+                take_rows(new_conv[li], sids), take_rows(new_rec[li], sids),
+                valid, is_decode=attend_to_cache,
             )
-            new_conv = new_conv.at[li].set(c_new)
-            new_rec = new_rec.at[li].set(r_new)
+            new_conv = put_rows(new_conv, li, c_new, sids)
+            new_rec = put_rows(new_rec, li, r_new, sids)
             li += 1
         else:
             out, k_new, v_new = full_attention_layer(
-                arch, lp["self_attn"], h, cos, sin, new_k[fi], new_v[fi],
+                arch, lp["self_attn"], h, cos, sin,
+                take_rows(new_k[fi], sids), take_rows(new_v[fi], sids),
                 position_ids, attend_to_cache, kv_window,
             )
-            new_k = new_k.at[fi].set(k_new)
-            new_v = new_v.at[fi].set(v_new)
+            new_k = put_rows(new_k, fi, k_new, sids)
+            new_v = put_rows(new_v, fi, v_new, sids)
             fi += 1
         hidden = hidden + out
         h = _g_norm(arch, hidden, lp["post_attention_layernorm"])
@@ -784,7 +789,6 @@ class Qwen3NextForCausalLM(TpuModelForCausalLM):
             ("is_prefix_caching", tc.is_prefix_caching),
             ("is_chunked_prefill", tc.is_chunked_prefill),
             ("is_block_kv_layout", tc.is_block_kv_layout),
-            ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
             ("speculation", tc.speculation_length > 0 or tc.is_medusa),
             ("tensor_capture_config", tc.tensor_capture_config is not None),
             # raw-array param layout: the quantizer/LoRA rewrites would no-op
@@ -796,7 +800,7 @@ class Qwen3NextForCausalLM(TpuModelForCausalLM):
             raise ValueError(
                 "qwen3_next does not support: " + ", ".join(bad) + " — the "
                 "linear-attention recurrence needs dedicated state routing for "
-                "these modes (conv/delta states are not paged or seq_id-routed)"
+                "these modes (conv/delta states are not paged)"
             )
 
     def enable_models(self) -> None:

@@ -363,6 +363,9 @@ def recurrentgemma_forward(
         lti = batch["last_token_index"]
         valid = jnp.arange(S, dtype=jnp.int32)[None, :] <= lti[:, None]
 
+    from nxdi_tpu.models.state_routing import put_rows, take_rows
+
+    sids = batch.get("seq_ids")  # continuous batching: row i -> cache line
     new_k, new_v = cache["k"], cache["v"]
     new_conv, new_rec = cache["conv"], cache["rec"]
     ai = ri = 0
@@ -371,19 +374,21 @@ def recurrentgemma_forward(
         h = _rms(arch, hidden, lp["temporal_norm"])
         if lt == "attention":
             out, k_new, v_new = attention_layer(
-                arch, lp, h, cos, sin, new_k[ai], new_v[ai], position_ids,
-                lti, attend_to_cache,
+                arch, lp, h, cos, sin,
+                take_rows(new_k[ai], sids), take_rows(new_v[ai], sids),
+                position_ids, lti, attend_to_cache,
             )
-            new_k = new_k.at[ai].set(k_new)
-            new_v = new_v.at[ai].set(v_new)
+            new_k = put_rows(new_k, ai, k_new, sids)
+            new_v = put_rows(new_v, ai, v_new, sids)
             ai += 1
         else:
             out, c_new, r_new = recurrent_layer(
-                arch, lp, h, position_ids, valid, new_conv[ri], new_rec[ri],
+                arch, lp, h, position_ids, valid,
+                take_rows(new_conv[ri], sids), take_rows(new_rec[ri], sids),
                 lti, attend_to_cache,
             )
-            new_conv = new_conv.at[ri].set(c_new)
-            new_rec = new_rec.at[ri].set(r_new)
+            new_conv = put_rows(new_conv, ri, c_new, sids)
+            new_rec = put_rows(new_rec, ri, r_new, sids)
             ri += 1
         hidden = hidden + out
         h = _rms(arch, hidden, lp["channel_norm"])
@@ -635,7 +640,6 @@ class RecurrentGemmaForCausalLM(TpuModelForCausalLM):
             ("is_prefix_caching", tc.is_prefix_caching),
             ("is_chunked_prefill", tc.is_chunked_prefill),
             ("is_block_kv_layout", tc.is_block_kv_layout),
-            ("is_continuous_batching", getattr(tc, "is_continuous_batching", False)),
             ("speculation", tc.speculation_length > 0 or tc.is_medusa),
             ("tensor_capture_config", tc.tensor_capture_config is not None),
             # raw-array param layout: the quantizer/LoRA rewrites would no-op
@@ -647,7 +651,7 @@ class RecurrentGemmaForCausalLM(TpuModelForCausalLM):
             raise ValueError(
                 "recurrentgemma does not support: " + ", ".join(bad) + " — the "
                 "RG-LRU recurrence needs dedicated state routing for these "
-                "modes (conv/lru states are not paged or seq_id-routed)"
+                "modes (conv/lru states are not paged)"
             )
 
     def enable_models(self) -> None:

@@ -1,0 +1,53 @@
+"""Seq-id routing for raw per-row state stacks.
+
+The hybrid-state families (qwen3_next, lfm2, recurrentgemma, falcon_h1) keep
+their recurrent state — conv tails, delta-rule/RG-LRU states, ring KV stacks —
+as plain ``(n_layers, B_cache, ...)`` arrays outside the KV layout classes.
+Continuous batching routes the ACTIVE batch row ``i`` to cache line
+``seq_ids[i]`` (reference: the ``is_continuous_batching`` seq-id plumbing,
+modules/kvcache/kv_cache_manager.py — batchline gather on read, scatter on
+write). These helpers apply the same convention to raw stacks:
+
+- :func:`take_rows` gathers a layer's state rows for the active batch before
+  the layer runs;
+- :func:`put_rows` scatters the updated rows back into the stacked state.
+
+Padded batch lanes duplicate row 0's seq_id with identical values, so the
+duplicate-index scatter is idempotent (the repeated-first-batchline
+convention, see ModelWrapper._layout_inputs).
+
+TPU perf note: the routed write is a real batch-dim scatter (the unrouted
+path is a full-slice dynamic-update-slice XLA handles in place). XLA's TPU
+scatter lowering can materialize cache copies on large operands (the decode
+hot path routes KV through ops/kernels/kv_commit.py for exactly this
+reason); the hybrid families' recurrent states are small, but their
+attention KV stacks under continuous batching should move to the commit
+kernel before any of them becomes a benchmarked serving path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def take_rows(state: jax.Array, seq_ids: Optional[jax.Array]) -> jax.Array:
+    """Gather active-batch rows from one layer's ``(B_cache, ...)`` state."""
+    if seq_ids is None:
+        return state
+    return jnp.take(state, seq_ids.astype(jnp.int32), axis=0, mode="clip")
+
+
+def put_rows(
+    stack: jax.Array,
+    layer_idx: int,
+    rows: jax.Array,
+    seq_ids: Optional[jax.Array],
+) -> jax.Array:
+    """Scatter updated active rows into layer ``layer_idx`` of a stacked
+    ``(n_layers, B_cache, ...)`` state."""
+    if seq_ids is None:
+        return stack.at[layer_idx].set(rows)
+    return stack.at[layer_idx, seq_ids.astype(jnp.int32)].set(rows, mode="drop")

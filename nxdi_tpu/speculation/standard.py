@@ -42,6 +42,7 @@ class SpecTargetCausalLM(TpuModelForCausalLM):
         spec_len = tc.speculation_length
         arch = self.family.build_arch(self.config)
         inv_freq = self.family.build_inv_freq(self.config)
+        tkg = self.models[TAG_TOKEN_GENERATION]
         self.models[TAG_SPECULATION] = ModelWrapper(
             TAG_SPECULATION,
             self.config,
@@ -49,14 +50,35 @@ class SpecTargetCausalLM(TpuModelForCausalLM):
             inv_freq,
             batch_size=tc.tkg_batch_size,
             n_active_tokens=spec_len + 1,
-            buckets=self.models[TAG_TOKEN_GENERATION].buckets,
+            buckets=tkg.buckets,
             attend_to_cache=True,
+            # families with a custom forward (e.g. mimo_v2's segment walk)
+            # customize the TKG wrapper in their enable_models, which super()
+            # already ran — the verify submodel must run the same forward
+            forward_fn=tkg.forward_fn,
             forward_kwargs=dict(
                 gather_last_token=False,
                 output_all_logits=True,
                 on_device_sampling=False,
             ),
         )
+
+
+def _app_cls(family, base=None):
+    """Resolve the family's application class; with ``base`` (the spec-target
+    mixin) graft it in front so custom forwards/cache structs keep working
+    under speculation (reference: draft/target app construction,
+    inference_demo.py:502-537 resolves the model class per family)."""
+    cls = (
+        getattr(family, "APPLICATION_CLS", TpuModelForCausalLM)
+        if family
+        else TpuModelForCausalLM
+    )
+    if base is None:  # draft: the family app as-is
+        return cls
+    if cls is TpuModelForCausalLM or issubclass(base, cls):
+        return base
+    return type(f"{base.__name__}_{cls.__name__}", (base, cls), {})
 
 
 class StandardSpecCausalLM:
@@ -89,8 +111,10 @@ class StandardSpecCausalLM:
             draft_config.tpu_config.on_device_sampling_config = (
                 config.tpu_config.on_device_sampling_config
             )
-        self.target = SpecTargetCausalLM(model_path, config, model_family=model_family)
-        self.draft = TpuModelForCausalLM(
+        self.target = _app_cls(model_family, SpecTargetCausalLM)(
+            model_path, config, model_family=model_family
+        )
+        self.draft = _app_cls(draft_family or model_family)(
             draft_model_path, draft_config, model_family=draft_family or model_family
         )
 

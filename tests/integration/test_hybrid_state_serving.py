@@ -1,0 +1,239 @@
+"""Continuous batching (seq-id-routed recurrent state) and speculation for the
+hybrid-state families — round-4 composition work.
+
+The reference's published benchmarks are continuous-batching MoE serving
+(docs/benchmark_results/minimax-m25-bf16-trn2-benchmark.md), and its KV
+manager routes batch rows by seq_id (modules/kvcache/kv_cache_manager.py).
+Here the same routing covers the RAW state stacks (conv tails, delta-rule /
+RG-LRU states, ring KV) via models/state_routing.py: every flow must
+reproduce the per-sequence goldens exactly with interleaved prefills and
+SHUFFLED seq_ids (row order != cache line order)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.lfm2 import modeling_lfm2 as lf
+from nxdi_tpu.models.qwen3_next import modeling_qwen3_next as mq
+from nxdi_tpu.models.recurrentgemma import modeling_recurrentgemma as rg
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+P0 = [5, 9, 3, 17, 2, 8, 11, 42]
+P1 = [7, 13, 21, 4, 33]
+
+
+def _prefill(app, prompt, sid):
+    ids = np.asarray([prompt], dtype=np.int32)
+    pos = np.arange(len(prompt), dtype=np.int32)[None, :]
+    out = app.forward(
+        ids, pos,
+        last_token_index=np.array([len(prompt) - 1], np.int32),
+        seq_ids=np.array([sid], np.int32),
+    )
+    return int(np.asarray(out["tokens"])[0, 0])
+
+
+def _run_interleaved(app, greedy, n_new=12, sid0=1, sid1=0):
+    """Prefill A -> decode A alone -> prefill B into a DIFFERENT cache line ->
+    joint decode; rows deliberately routed to shuffled lines (row 0 -> line
+    ``sid0``=1). Both streams must match their unbatched goldens."""
+    e0, e1 = greedy(P0, n_new), greedy(P1, n_new)
+
+    got0 = [_prefill(app, P0, sid0)]
+    pos0 = len(P0)
+    for _ in range(3):
+        out = app.forward(
+            np.array([[got0[-1]]], np.int32), np.array([[pos0]], np.int32),
+            seq_ids=np.array([sid0], np.int32),
+        )
+        got0.append(int(np.asarray(out["tokens"])[0, 0]))
+        pos0 += 1
+
+    # prefill B into another line — must not disturb sid0's state
+    got1 = [_prefill(app, P1, sid1)]
+    pos1 = len(P1)
+
+    while len(got0) < n_new:
+        out = app.forward(
+            np.array([[got0[-1]], [got1[-1]]], np.int32),
+            np.array([[pos0], [pos1]], np.int32),
+            seq_ids=np.array([sid0, sid1], np.int32),
+        )
+        toks = np.asarray(out["tokens"])[:, 0]
+        got0.append(int(toks[0]))
+        got1.append(int(toks[1]))
+        pos0 += 1
+        pos1 += 1
+
+    np.testing.assert_array_equal(np.array(got0), e0[: len(got0)])
+    np.testing.assert_array_equal(np.array(got1), e1[: len(got1)])
+
+
+_CB = dict(
+    is_continuous_batching=True,
+    ctx_batch_size=1,
+    tkg_batch_size=2,
+    kv_cache_batch_size=2,
+)
+
+
+def _common_tcfg(**kw):
+    d = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    d.update(kw)
+    return d
+
+
+def _hf_row_greedy(hf_model):
+    def greedy(prompt, n):
+        return hf_greedy(hf_model, np.array([prompt]), n)[0, len(prompt):]
+
+    return greedy
+
+
+def test_qwen3_next_continuous_batching():
+    """Conv windows + delta-rule states are seq-id-routed: interleaved
+    prefills into shuffled cache lines keep both streams exact."""
+    from transformers import Qwen3NextConfig, Qwen3NextForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = Qwen3NextConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        vocab_size=256, max_position_embeddings=256, rms_norm_eps=1e-5,
+        rope_theta=10000.0, partial_rotary_factor=0.25,
+        linear_num_value_heads=4, linear_num_key_heads=2,
+        linear_key_head_dim=16, linear_value_head_dim=16,
+        linear_conv_kernel_dim=4, num_experts=0, decoder_sparse_step=0,
+        mlp_only_layers=[], tie_word_embeddings=False, eos_token_id=None,
+    )
+    hf = Qwen3NextForCausalLM(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    cfg = mq.Qwen3NextInferenceConfig(
+        TpuConfig(**_common_tcfg(**_CB)), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(mq.Qwen3NextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=mq)
+    app.load()
+    _run_interleaved(app, _hf_row_greedy(hf))
+
+
+def test_lfm2_continuous_batching():
+    from transformers import Lfm2Config, Lfm2ForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = Lfm2Config(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, norm_eps=1e-5, rope_theta=10000.0,
+        conv_L_cache=3, conv_bias=False, block_multiple_of=32,
+        layer_types=["conv", "full_attention", "conv", "full_attention"],
+        tie_word_embeddings=True,
+    )
+    hf = Lfm2ForCausalLM(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    cfg = lf.Lfm2InferenceConfig(
+        TpuConfig(**_common_tcfg(**_CB)), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(lf.Lfm2ForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=lf)
+    app.load()
+    _run_interleaved(app, _hf_row_greedy(hf))
+
+
+def test_recurrentgemma_continuous_batching():
+    from transformers import RecurrentGemmaConfig, RecurrentGemmaForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = RecurrentGemmaConfig(
+        hidden_size=64, intermediate_size=256, num_hidden_layers=6,
+        num_attention_heads=4, num_key_value_heads=2, head_dim=16,
+        lru_width=64, conv1d_width=4, attention_window_size=16,
+        vocab_size=256, rope_theta=10000.0, partial_rotary_factor=0.5,
+        logits_soft_cap=30.0, rms_norm_eps=1e-6,
+    )
+    hf = RecurrentGemmaForCausalLM(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    cfg = rg.RecurrentGemmaInferenceConfig(
+        TpuConfig(**_common_tcfg(**_CB)), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(rg.RecurrentGemmaForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=rg)
+    app.load()
+    _run_interleaved(app, _hf_row_greedy(hf))
+
+
+# ---------------------------------------------------------------------------
+# mimo_v2: continuous batching (shuffled seq_ids) + standard speculation
+# ---------------------------------------------------------------------------
+
+
+def test_mimo_v2_continuous_batching_shuffled():
+    from test_mimo_v2 import CFG, _golden_greedy, _random_sd
+
+    from nxdi_tpu.models.mimo_v2 import modeling_mimo_v2 as mv
+
+    sd = _random_sd(np.random.default_rng(0))
+    cfg = mv.MiMoV2InferenceConfig(
+        TpuConfig(**_common_tcfg(**_CB)), load_config=lambda: dict(CFG)
+    )
+    app = mv.MiMoV2ForCausalLM("<memory>", cfg)
+    app.get_state_dict = lambda: sd
+    app.load()
+
+    def greedy(prompt, n):
+        return _golden_greedy(sd, np.array([prompt]), n)[0]
+
+    _run_interleaved(app, greedy)
+
+
+@pytest.mark.parametrize("spec_len", [3])
+def test_mimo_v2_standard_speculation(spec_len):
+    """Standard (unfused) speculation over two mimo apps: the spec-target
+    mixin grafts onto MiMoV2Application (speculation/standard.py _app_cls),
+    the verify submodel runs the segment-walk forward."""
+    from test_mimo_v2 import CFG, _golden_greedy, _random_sd
+
+    from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+    from nxdi_tpu.models.mimo_v2 import modeling_mimo_v2 as mv
+    from nxdi_tpu.speculation import StandardSpecCausalLM
+
+    t_sd = _random_sd(np.random.default_rng(0))
+    d_sd = _random_sd(np.random.default_rng(7))  # different weights: partial accepts
+    common = _common_tcfg(batch_size=1)
+    cfg = mv.MiMoV2InferenceConfig(
+        TpuConfig(**common, speculation_length=spec_len),
+        load_config=lambda: dict(CFG),
+    )
+    dcfg = mv.MiMoV2InferenceConfig(
+        TpuConfig(**common), load_config=lambda: dict(CFG)
+    )
+    app = StandardSpecCausalLM("<target>", cfg, "<draft>", dcfg, model_family=mv)
+    app.target.get_state_dict = lambda: t_sd
+    app.draft.get_state_dict = lambda: d_sd
+    app.load()
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]])
+    expected = _golden_greedy(t_sd, prompt, 14)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=14)
+    np.testing.assert_array_equal(actual[:, prompt.shape[1]:], expected)
