@@ -116,6 +116,12 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
                    help="flash decode kernel")
     p.add_argument("--attn-block-tkg-kernel-enabled", action="store_true",
                    help="paged decode kernel (reads through the block table)")
+    p.add_argument("--fused-qkv", action="store_true",
+                   help="pack q/k/v into one interleaved projection weight")
+    p.add_argument("--qkv-kernel-enabled", action="store_true",
+                   help="Pallas fused-QKV matmul kernel (requires --fused-qkv)")
+    p.add_argument("--mlp-kernel-enabled", action="store_true",
+                   help="Pallas fused gate/up/down MLP kernel")
 
     # speculation
     p.add_argument("--draft-model-path", default=None)
@@ -238,6 +244,9 @@ def create_tpu_config(args):
         attn_kernel_enabled=args.attn_kernel_enabled,
         attn_tkg_kernel_enabled=args.attn_tkg_kernel_enabled,
         attn_block_tkg_kernel_enabled=args.attn_block_tkg_kernel_enabled,
+        fused_qkv=args.fused_qkv,
+        qkv_kernel_enabled=args.qkv_kernel_enabled,
+        mlp_kernel_enabled=args.mlp_kernel_enabled,
         on_device_sampling_config=odsc,
         enable_bucketing=args.enable_bucketing,
         context_encoding_buckets=args.context_encoding_buckets,

@@ -22,6 +22,7 @@ import os
 from typing import Any, Dict, List, Optional
 
 import jax.numpy as jnp
+import numpy as np
 
 CONFIG_FILE = "tpu_config.json"
 
@@ -80,24 +81,59 @@ class KVQuantizationConfig:
 
     def __init__(self, **kwargs):
         self.dtype = kwargs.pop("dtype", "float8_e4m3")
-        self.scale_mode = kwargs.pop("scale_mode", "direct_cast")  # direct_cast|per_tensor
+        # direct_cast | per_tensor | per_key | per_channel
+        # (reference: QuantizationType PER_TENSOR/PER_KEY/PER_CHANNEL
+        # _SYMMETRIC scale buffers, kv_cache_manager.py:642-692)
+        self.scale_mode = kwargs.pop("scale_mode", "direct_cast")
         # per_tensor: values are stored as value/scale in fp8 and rescaled on
-        # read (reference: calibrated k/v scale buffers, kv_cache_manager.py:
-        # 642-692). Static per-tensor scales, typically from offline amax
-        # calibration.
+        # read. Static scales, typically from offline amax calibration.
         self.k_scale = float(kwargs.pop("k_scale", 1.0))
         self.v_scale = float(kwargs.pop("v_scale", 1.0))
-        if self.scale_mode not in ("direct_cast", "per_tensor"):
+        # per_key: per-layer, per-kv-head scales, shape (L, KV).
+        # per_channel: per-layer, per-head-dim-channel scales, shape (L, D).
+        # Accepted as nested lists/arrays, or loaded from ``scales_path`` (an
+        # .npz with k_scales/v_scales produced by
+        # kvcache.calibration.calibrate_kv_scales).
+        self.scales_path = kwargs.pop("scales_path", None)
+        k_scales = kwargs.pop("k_scales", None)
+        v_scales = kwargs.pop("v_scales", None)
+        if self.scales_path is not None and k_scales is None:
+            with np.load(self.scales_path) as z:
+                k_scales = z["k_scales"]
+                v_scales = z["v_scales"]
+        if k_scales is not None:
+            k_scales = np.asarray(k_scales, dtype=np.float32)
+            v_scales = np.asarray(v_scales, dtype=np.float32)
+        self.k_scales = k_scales
+        self.v_scales = v_scales
+        if k_scales is not None and self.scale_mode not in ("per_key", "per_channel"):
             raise ValueError(
-                f"kv quant scale_mode must be direct_cast|per_tensor, got {self.scale_mode!r}"
+                "k_scales/v_scales arrays are only consumed by "
+                "scale_mode='per_key'|'per_channel' (per_tensor takes scalar "
+                f"k_scale/v_scale); got scale_mode={self.scale_mode!r}"
+            )
+        if self.scale_mode not in ("direct_cast", "per_tensor", "per_key", "per_channel"):
+            raise ValueError(
+                "kv quant scale_mode must be direct_cast|per_tensor|per_key|"
+                f"per_channel, got {self.scale_mode!r}"
             )
         if self.scale_mode == "direct_cast" and (self.k_scale != 1.0 or self.v_scale != 1.0):
             raise ValueError("k_scale/v_scale require scale_mode='per_tensor'")
+        if self.scale_mode in ("per_key", "per_channel") and self.k_scales is None:
+            raise ValueError(
+                f"scale_mode={self.scale_mode!r} needs k_scales/v_scales arrays "
+                "(or scales_path) from calibration "
+                "(nxdi_tpu.kvcache.calibration.calibrate_kv_scales)"
+            )
         if kwargs:
             raise ValueError(f"Unknown KVQuantizationConfig args: {sorted(kwargs)}")
 
     def to_dict(self):
-        return dict(self.__dict__)
+        d = dict(self.__dict__)
+        for key in ("k_scales", "v_scales"):
+            if d.get(key) is not None:
+                d[key] = np.asarray(d[key]).tolist()
+        return d
 
 
 class ChunkedPrefillConfig:
@@ -501,6 +537,42 @@ class TpuConfig:
                     f"moe_tkg_ep_degree ({hsc.moe_tkg_ep_degree}) must divide "
                     f"tp_degree ({self.tp_degree})"
                 )
+        kvq = self.kv_quant_config
+        if kvq is not None and kvq.scale_mode in ("per_key", "per_channel"):
+            if self.is_block_kv_layout or self.window_sized_kv:
+                raise ValueError(
+                    f"kv quant scale_mode={kvq.scale_mode!r} composes with the "
+                    "contiguous KV layout only (paged/ring layouts take "
+                    "per-tensor scales)"
+                )
+            if self.pp_degree > 1:
+                raise ValueError(
+                    f"kv quant scale_mode={kvq.scale_mode!r} is not supported "
+                    "under pipeline parallel yet (per-layer scale indexing "
+                    "needs the in-scan layer index)"
+                )
+        # fused projection kernels (reference: fused_qkv gqa.py:557, "QKV
+        # kernel only supported when fused_qkv is TRUE" gqa.py:669) — these
+        # flags either engage their kernels or raise; never a silent no-op
+        if self.qkv_kernel_enabled and not self.fused_qkv:
+            raise ValueError(
+                "qkv_kernel_enabled requires fused_qkv=True (the kernel runs "
+                "over the fused interleaved QKV weight)"
+            )
+        if self.fused_qkv and self.lora_config is not None:
+            raise ValueError(
+                "fused_qkv does not compose with LoRA serving (adapters "
+                "target the separate q/k/v projections)"
+            )
+        if self.mlp_kernel_enabled and self.lora_config is not None:
+            raise ValueError(
+                "mlp_kernel_enabled does not compose with LoRA serving"
+            )
+        if self.mlp_kernel_enabled and self.quantized:
+            raise ValueError(
+                "mlp_kernel_enabled composes with full-precision weights only "
+                "for now (quantized fused MLP is not implemented)"
+            )
         if self.window_sized_kv:
             if not self.sliding_window:
                 raise ValueError(

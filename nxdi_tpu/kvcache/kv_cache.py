@@ -27,6 +27,7 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from nxdi_tpu.parallel.mesh import AXIS_MP
@@ -164,11 +165,59 @@ class ContiguousKVLayout:
     by the scale before the fp8 store and re-multiplied after the load, so
     activations larger than the fp8 dynamic range survive. Static floats —
     part of the compiled program, like the reference's calibrated scale
-    buffers baked into the traced graph."""
+    buffers baked into the traced graph.
+
+    ``k_scales``/``v_scales`` are the per-layer PER-KEY / PER-CHANNEL scale
+    buffers (reference: PER_KEY/PER_CHANNEL_SYMMETRIC scale ParameterLists,
+    kv_cache_manager.py:642-667): nested tuples of shape (L, KV) (one scale
+    per kv head) or (L, D) (one per head-dim channel), produced by
+    kvcache.calibration. Inside the layer scan the active layer's scale row
+    is selected by ``cache_inputs["layer_idx"]`` (the scan's arange xs);
+    commit_rows broadcasts over the whole stack."""
 
     route_by_seq_id: bool = False
     k_scale: float = 1.0
     v_scale: float = 1.0
+    k_scales: Optional[tuple] = None  # (L, KV) or (L, D) nested tuple
+    v_scales: Optional[tuple] = None
+    scale_axis: Optional[str] = None  # "key" | "channel" when *_scales set
+
+    def _scale_for(self, which: str, cache_inputs, stacked: bool):
+        """The active scale: a python float (per-tensor), or an array
+        broadcastable against (B, KV, S, D) per-layer / (L, B, KV, S, D)
+        stacked views."""
+        scales = self.k_scales if which == "k" else self.v_scales
+        if scales is None:
+            return self.k_scale if which == "k" else self.v_scale
+        arr = jnp.asarray(np.asarray(scales, dtype=np.float32))  # (L, KV)|(L, D)
+        if self.scale_axis == "key":
+            arr = arr[:, None, :, None, None]  # (L, 1, KV, 1, 1)
+        else:  # channel
+            arr = arr[:, None, None, None, :]  # (L, 1, 1, 1, D)
+        if stacked:
+            return arr
+        li = (cache_inputs or {}).get("layer_idx")
+        if li is None:
+            raise NotImplementedError(
+                "per-key/per-channel KV scales need the in-scan layer index; "
+                "this execution path does not provide one"
+            )
+        return jnp.take(arr, li.astype(jnp.int32), axis=0, mode="clip")
+
+    def has_array_scales(self) -> bool:
+        return self.k_scales is not None or self.v_scales is not None
+
+    @staticmethod
+    def clip_to_store(x, store_dtype):
+        """Saturate (and, for integer stores, ROUND) before the store cast:
+        fp8 e4m3fn has NO inf — overflow becomes NaN — and an int8 astype
+        truncates toward zero, so both need explicit handling (the
+        reference's quantize_static_quant_activations clamps the same way)."""
+        if jnp.issubdtype(jnp.dtype(store_dtype), jnp.integer):
+            info = jnp.iinfo(store_dtype)
+            return jnp.clip(jnp.round(x), info.min, info.max)
+        lim = float(jnp.finfo(store_dtype).max)
+        return jnp.clip(x, -lim, lim)
 
     def update(self, k_cache_l, v_cache_l, k_new, v_new, cache_inputs, spec):
         B = k_new.shape[0]
@@ -182,10 +231,22 @@ class ContiguousKVLayout:
         else:
             b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
         store = k_cache_l.dtype
-        if self.k_scale != 1.0:
-            k_new = k_new / jnp.asarray(self.k_scale, k_new.dtype)
-        if self.v_scale != 1.0:
-            v_new = v_new / jnp.asarray(self.v_scale, v_new.dtype)
+        if self.has_array_scales() or self.k_scale != 1.0:
+            ks = self._scale_for("k", cache_inputs, stacked=False)
+            k_new = self.clip_to_store(
+                k_new.astype(jnp.float32) / ks, store
+            ).astype(k_new.dtype)
+        if self.has_array_scales() or self.v_scale != 1.0:
+            vs = self._scale_for("v", cache_inputs, stacked=False)
+            v_new = self.clip_to_store(
+                v_new.astype(jnp.float32) / vs, store
+            ).astype(v_new.dtype)
+        if store != k_new.dtype:
+            # narrowing store (incl. direct_cast fp8): saturate instead of
+            # overflowing to NaN — and match the deferred path's round-trip
+            # (models/base.py clips the attended fresh rows the same way)
+            k_new = self.clip_to_store(k_new, store)
+            v_new = self.clip_to_store(v_new, store)
         k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
         v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
         k_cache_l = k_cache_l.at[b_idx, :, pos].set(k_vals, mode="drop")
@@ -196,10 +257,10 @@ class ContiguousKVLayout:
         """Returns (kk, vv, kv_pos): (B, KV, W, D) x2 and (B, W) positions."""
         compute = spec.compute_dtype
         kk, vv = k_cache_l.astype(compute), v_cache_l.astype(compute)
-        if self.k_scale != 1.0:
-            kk = kk * jnp.asarray(self.k_scale, compute)
-        if self.v_scale != 1.0:
-            vv = vv * jnp.asarray(self.v_scale, compute)
+        if self.has_array_scales() or self.k_scale != 1.0:
+            kk = (kk * self._scale_for("k", cache_inputs, stacked=False)).astype(compute)
+        if self.has_array_scales() or self.v_scale != 1.0:
+            vv = (vv * self._scale_for("v", cache_inputs, stacked=False)).astype(compute)
         if self.route_by_seq_id:
             seq_ids = cache_inputs["seq_ids"].astype(jnp.int32)
             kk = jnp.take(kk, seq_ids, axis=0, mode="clip")
@@ -228,9 +289,21 @@ class ContiguousKVLayout:
         S = cache["k"].shape[3]
         raw_pos = position_ids.astype(jnp.int32)  # (B, S_act); <0 = drop
 
+        array_scales = self.has_array_scales()
+        stacked_ks = self._scale_for("k", cache_inputs, stacked=True)
+        stacked_vs = self._scale_for("v", cache_inputs, stacked=True)
+
         def scaled(rows, scale, store):
+            if array_scales:
+                return self.clip_to_store(
+                    rows.astype(jnp.float32) / scale, store
+                ).astype(store)
             if scale != 1.0:
                 rows = rows / jnp.asarray(scale, rows.dtype)
+            if store != rows.dtype:
+                # saturate narrowing stores (incl. direct_cast), matching the
+                # deferred attend's round-trip clip in models/base.py
+                rows = self.clip_to_store(rows, store)
             return rows.astype(store)
 
         from nxdi_tpu.ops.kernels import kv_commit
@@ -250,8 +323,8 @@ class ContiguousKVLayout:
                 pspec,
                 cache["k"],
                 cache["v"],
-                scaled(k_rows, self.k_scale, cache["k"].dtype),
-                scaled(v_rows, self.v_scale, cache["v"].dtype),
+                scaled(k_rows, stacked_ks, cache["k"].dtype),
+                scaled(v_rows, stacked_vs, cache["v"].dtype),
                 raw_pos,
                 seq_ids,
             )
@@ -274,8 +347,8 @@ class ContiguousKVLayout:
             return jax.vmap(per_layer)(cache_arr, vals)
 
         return {
-            "k": put(cache["k"], k_rows, self.k_scale),
-            "v": put(cache["v"], v_rows, self.v_scale),
+            "k": put(cache["k"], k_rows, stacked_ks),
+            "v": put(cache["v"], v_rows, stacked_vs),
         }
 
 

@@ -104,6 +104,16 @@ class DecoderArch:
     attn_kernel_enabled: bool = False
     attn_tkg_kernel_enabled: bool = False
     attn_block_tkg_kernel_enabled: bool = False  # paged decode through table
+    # fused projections (reference: fused_qkv gqa.py:530-683, qkv/mlp NKI
+    # kernels modeling_llama.py:502-943). fused_qkv packs q/k/v into ONE
+    # weight with per-tp-rank head-block interleave (dense.fuse_qkv_weights);
+    # the kernel flags route the fused matmuls through ops/kernels/fused_mlp.
+    # All three are enforced loudly: ModelWrapper raises after lowering if an
+    # enabled flag's strategy never engaged (no silent no-ops).
+    fused_qkv: bool = False
+    fused_qkv_tp: int = 1  # tp degree the fused weight was interleaved for
+    qkv_kernel_enabled: bool = False
+    mlp_kernel_enabled: bool = False
     # pipeline parallel: layer stack sharded over the pp mesh axis, GPipe
     # microbatch rotation in run_decoder_layers (reference: pp_degree,
     # models/config.py:366, application_base.py:158-163)
@@ -233,6 +243,21 @@ class DecoderArch:
 # ---------------------------------------------------------------------------
 
 def attention_param_specs(arch: DecoderArch) -> Dict[str, Any]:
+    if arch.fused_qkv:
+        # one interleaved weight: column-sharding hands each rank exactly its
+        # [q-heads | k-heads | v-heads] block (dense.fuse_qkv_weights)
+        spec = {
+            "qkv_proj": {"w": COLUMN_PARALLEL},
+            "o_proj": {"w": ROW_PARALLEL},
+        }
+        if arch.attention_bias:
+            spec["qkv_proj"]["b"] = P(AXIS_MP)
+        if arch.attention_o_bias:
+            spec["o_proj"]["b"] = REPLICATED
+        if arch.qk_norm:
+            spec["q_norm"] = REPLICATED
+            spec["k_norm"] = REPLICATED
+        return spec
     spec: Dict[str, Any] = {
         "q_proj": {"w": COLUMN_PARALLEL},
         "k_proj": {"w": COLUMN_PARALLEL},
@@ -358,6 +383,8 @@ def attention_block(
     window_enabled: Optional[jax.Array] = None,
     use_rope: Optional[jax.Array] = None,
     defer_write: bool = False,
+    qkv_stacked=None,  # (w_s (L,H,T), b_s|None) + layer_idx: in-scan kernel
+    layer_idx=None,
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -383,9 +410,48 @@ def attention_block(
     Dv = arch.v_head_dim or D  # mimo-v2: value width differs from q/k
 
     aq, ac = arch.act_quant, arch.act_clamp
-    q = _linear(hidden, p_attn["q_proj"], aq, ac, adapter_ids)
-    k = _linear(hidden, p_attn["k_proj"], aq, ac, adapter_ids)
-    v = _linear(hidden, p_attn["v_proj"], aq, ac, adapter_ids)
+    if arch.fused_qkv:
+        if "qkv_proj" not in p_attn:
+            raise NotImplementedError(
+                "fused_qkv is enabled but this model's params carry no fused "
+                "qkv_proj weight — the family's converter does not support "
+                "fused QKV; disable the flag"
+            )
+        pq = p_attn["qkv_proj"]
+        Tq, Tk, Tv = H * D, KV * D, KV * Dv
+        if arch.qkv_kernel_enabled:
+            if adapter_ids is not None or ("w" not in pq and qkv_stacked is None):
+                raise NotImplementedError(
+                    "qkv_kernel_enabled requires an unquantized, non-LoRA "
+                    "fused qkv_proj weight"
+                )
+            if qkv_stacked is not None:
+                w_s, b_s = qkv_stacked
+                qkv = attn_kernels.sharded_qkv_stacked_call(
+                    hidden, w_s, layer_idx, b_s
+                )
+            else:
+                qkv = attn_kernels.sharded_qkv_call(hidden, pq["w"], pq.get("b"))
+            if qkv is None:
+                raise NotImplementedError(
+                    "qkv_kernel_enabled: fused projection shape is not "
+                    "kernel-eligible; disable the flag"
+                )
+            _record_strategy("qkv_fused_kernel")
+        else:
+            qkv = _linear(hidden, pq, aq, ac, adapter_ids)
+            _record_strategy("qkv_fused_matmul")
+        # undo the per-rank interleave on the LOGICAL view: rank blocks are
+        # head blocks in order, so regrouping by rank reassembles q/k/v
+        tp = arch.fused_qkv_tp
+        t = qkv.reshape(B, S, tp, (Tq + Tk + Tv) // tp)
+        q = t[..., : Tq // tp].reshape(B, S, Tq)
+        k = t[..., Tq // tp : (Tq + Tk) // tp].reshape(B, S, Tk)
+        v = t[..., (Tq + Tk) // tp :].reshape(B, S, Tv)
+    else:
+        q = _linear(hidden, p_attn["q_proj"], aq, ac, adapter_ids)
+        k = _linear(hidden, p_attn["k_proj"], aq, ac, adapter_ids)
+        v = _linear(hidden, p_attn["v_proj"], aq, ac, adapter_ids)
     if arch.clip_qkv is not None:  # dbrx clamps the qkv outputs
         q = jnp.clip(q, -arch.clip_qkv, arch.clip_qkv)
         k = jnp.clip(k, -arch.clip_qkv, arch.clip_qkv)
@@ -461,6 +527,10 @@ def attention_block(
 
     ci = dict(cache_inputs or {})
     ci["position_ids"] = position_ids
+    if layer_idx is not None:
+        # in-scan layer index (the scan's arange xs): per-layer KV-quant
+        # scale selection (kv_cache.py _scale_for) and stacked kernels
+        ci["layer_idx"] = layer_idx
     # run_decoder_layers is the single authority on eligibility; the mask
     # check repeats here only because tree-verify programs statically carry
     # attn_mask in their cache inputs
@@ -472,13 +542,20 @@ def attention_block(
         kk = constrain(kk, policy.cache_kv)
         vv = constrain(vv, policy.cache_kv)
         store = cache_spec.store_dtype
-        if store != k.dtype or getattr(layout, "k_scale", 1.0) != 1.0:
+        array_scales = getattr(layout, "has_array_scales", lambda: False)()
+        if store != k.dtype or getattr(layout, "k_scale", 1.0) != 1.0 or array_scales:
             # quantized cache: round-trip the fresh rows through the store
             # dtype/scale so this step's numerics match the non-deferred
             # path (which attends the quantize->dequantize'd row) exactly
-            ks, vs = getattr(layout, "k_scale", 1.0), getattr(layout, "v_scale", 1.0)
-            k_att = ((k / ks).astype(store).astype(k.dtype) * ks).astype(k.dtype)
-            v_att = ((v / vs).astype(store).astype(v.dtype) * vs).astype(v.dtype)
+            if array_scales:
+                ks = layout._scale_for("k", ci, stacked=False)
+                vs = layout._scale_for("v", ci, stacked=False)
+            else:
+                ks = getattr(layout, "k_scale", 1.0)
+                vs = getattr(layout, "v_scale", 1.0)
+            clip = getattr(ContiguousKVLayout, "clip_to_store")
+            k_att = (clip(k / ks, store).astype(store).astype(k.dtype) * ks).astype(k.dtype)
+            v_att = (clip(v / vs, store).astype(store).astype(v.dtype) * vs).astype(v.dtype)
         else:
             k_att, v_att = k, v
         # fused TKG kernel: strict-causal online softmax over the old cache
@@ -692,10 +769,55 @@ def attention_block(
 
 
 def mlp_block(
-    arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array, adapter_ids=None
+    arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array, adapter_ids=None,
+    mlp_stacked=None, layer_idx=None,
 ) -> jax.Array:
     """Gated MLP (SwiGLU family) — or the plain 2-layer MLP for the gpt2
-    lineage (gated_mlp=False). XLA fuses act+mul into the matmuls."""
+    lineage (gated_mlp=False). XLA fuses act+mul into the matmuls.
+
+    ``mlp_kernel_enabled`` routes the gated path through the Pallas fused
+    gate/up/down kernel (ops/kernels/fused_mlp.py; reference: the NKI MLP
+    kernel, modeling_llama.py:502-943) — ineligible configurations raise,
+    they never silently fall back. Inside the layer scan the weights come
+    STACKED (``mlp_stacked`` = (L,H,I)/(L,I,H) arrays + in-scan layer index):
+    the kernel indexes them via scalar prefetch, avoiding the per-layer
+    slice-copy a pallas operand on scan xs would materialize."""
+    if arch.mlp_kernel_enabled:
+        bad = None
+        if not arch.gated_mlp:
+            bad = "non-gated MLP"
+        elif arch.mlp_bias:
+            bad = "MLP biases"
+        elif adapter_ids is not None:
+            bad = "LoRA adapters"
+        elif mlp_stacked is None and any(
+            "w" not in p_mlp[k] for k in ("gate_proj", "up_proj", "down_proj")
+        ):
+            bad = "quantized weights"
+        if bad is not None:
+            raise NotImplementedError(
+                f"mlp_kernel_enabled does not support {bad}; disable the flag"
+            )
+        if mlp_stacked is not None:
+            gs, us, ds = mlp_stacked
+            out = attn_kernels.sharded_fused_mlp_stacked_call(
+                x, gs, us, ds, layer_idx, act=arch.hidden_act
+            )
+        else:
+            out = attn_kernels.sharded_fused_mlp_call(
+                x,
+                p_mlp["gate_proj"]["w"],
+                p_mlp["up_proj"]["w"],
+                p_mlp["down_proj"]["w"],
+                act=arch.hidden_act,
+            )
+        if out is None:
+            raise NotImplementedError(
+                f"mlp_kernel_enabled: MLP shape (act={arch.hidden_act!r}) is "
+                "not kernel-eligible; disable the flag"
+            )
+        _record_strategy("mlp_fused_kernel")
+        return out
     act = ACT_FNS[arch.hidden_act]
     aq, ac = arch.act_quant, arch.act_clamp
     if not arch.gated_mlp:
@@ -722,6 +844,9 @@ def decoder_layer(
     cache_inputs: Optional[Dict[str, jax.Array]] = None,
     adapter_ids: Optional[jax.Array] = None,
     defer_write: bool = False,
+    mlp_stacked=None,
+    qkv_stacked=None,
+    layer_idx=None,
 ):
     # per-layer rope selection (gemma3 local/global thetas): cos/sin arrive
     # stacked (2, B, S, D) and the layer flag picks one inside the scan body
@@ -743,6 +868,8 @@ def decoder_layer(
     extra = {}
     if attn_block_fn is attention_block:
         extra["defer_write"] = defer_write
+        extra["qkv_stacked"] = qkv_stacked
+        extra["layer_idx"] = layer_idx
     attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
@@ -751,7 +878,7 @@ def decoder_layer(
     if arch.post_block_norm:
         # olmo2: x + norm(attn(x)); x + norm(mlp(x))
         hidden = hidden + _norm(arch, attn_out, lp["input_layernorm"]) * arch.residual_multiplier
-        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids)
+        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids, mlp_stacked, layer_idx)
         hidden = hidden + _norm(arch, ff, lp["post_attention_layernorm"]) * arch.residual_multiplier
     elif arch.sandwich_norm:
         # gemma lineage: post-norms applied to the block OUTPUT before the
@@ -765,7 +892,7 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden)
         else:
-            ff = mlp_block(arch, lp["mlp"], h, adapter_ids)
+            ff = mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, layer_idx)
         ff = _norm(arch, ff, lp["post_feedforward_layernorm"])
         hidden = hidden + ff
     else:
@@ -774,7 +901,7 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden) * arch.residual_multiplier
         else:
-            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids) * arch.residual_multiplier
+            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, layer_idx) * arch.residual_multiplier
     hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
@@ -1065,6 +1192,44 @@ def _interleaved_window_scan(
     }
 
 
+def _extract_stacked_weights(arch: DecoderArch, seg):
+    """Pull the layer-stacked MLP / fused-QKV weights out of a segment pytree
+    when their Pallas kernels are enabled, so the scan does not slice them
+    per layer (see run_decoder_layers). Returns (seg', mlp_stacked,
+    qkv_stacked) — stacked entries are None when the kernel is off or the
+    segment has no such weights (e.g. a MoE segment)."""
+    mlp_st = qkv_st = None
+    if (
+        arch.mlp_kernel_enabled
+        and isinstance(seg, dict)
+        and isinstance(seg.get("mlp"), dict)
+        and all(
+            isinstance(seg["mlp"].get(k), dict) and "w" in seg["mlp"][k]
+            for k in ("gate_proj", "up_proj", "down_proj")
+        )
+    ):
+        mlp = {k: dict(v) if isinstance(v, dict) else v for k, v in seg["mlp"].items()}
+        mlp_st = (
+            mlp["gate_proj"].pop("w"),
+            mlp["up_proj"].pop("w"),
+            mlp["down_proj"].pop("w"),
+        )
+        seg = {**seg, "mlp": mlp}
+    if (
+        arch.qkv_kernel_enabled
+        and isinstance(seg, dict)
+        and isinstance(seg.get("attn"), dict)
+        and isinstance(seg["attn"].get("qkv_proj"), dict)
+        and "w" in seg["attn"]["qkv_proj"]
+    ):
+        attn = dict(seg["attn"])
+        qp = dict(attn["qkv_proj"])
+        qkv_st = (qp.pop("w"), qp.pop("b", None))
+        attn["qkv_proj"] = qp
+        seg = {**seg, "attn": attn}
+    return seg, mlp_st, qkv_st
+
+
 def run_decoder_layers(
     arch: DecoderArch,
     layer_params: Dict[str, Any],  # layer-stacked pytree
@@ -1121,7 +1286,8 @@ def run_decoder_layers(
     )
 
     def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None,
-              windowable_=None, defer_=None):
+              windowable_=None, defer_=None, mlp_stacked=None,
+              qkv_stacked=None, layer_idx=None):
         """One decoder layer with the bucket's static KV window applied.
         ``layout_``/``windowable_``/``defer_`` override the stack-wide
         defaults for the interleaved-window unit scan (ring slices use the
@@ -1129,11 +1295,13 @@ def run_decoder_layers(
         lay = layout if layout_ is None else layout_
         win_ok = windowable if windowable_ is None else windowable_
         dfr = defer if defer_ is None else defer_
+        stk = dict(mlp_stacked=mlp_stacked, qkv_stacked=qkv_stacked,
+                   layer_idx=layer_idx)
         if win_ok and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos_, sin_, k_win, v_win, pos_, cache_spec,
-                attend_to_cache, policy, lay, ci_, ad_, defer_write=dfr,
+                attend_to_cache, policy, lay, ci_, ad_, defer_write=dfr, **stk,
             )
             if dfr:
                 nk, nv = nkw, nvw  # fresh rows, committed after the scan
@@ -1143,7 +1311,7 @@ def run_decoder_layers(
         else:
             h, (nk, nv) = decoder_layer(
                 arch, lp, h, cos_, sin_, kl, vl, pos_, cache_spec,
-                attend_to_cache, policy, lay, ci_, ad_, defer_write=dfr,
+                attend_to_cache, policy, lay, ci_, ad_, defer_write=dfr, **stk,
             )
         return h, nk, nv
 
@@ -1181,6 +1349,7 @@ def run_decoder_layers(
             and not getattr(layout, "route_by_seq_id", False)
             and getattr(layout, "k_scale", 1.0) == 1.0
             and getattr(layout, "v_scale", 1.0) == 1.0
+            and not getattr(layout, "has_array_scales", lambda: False)()
             and cache["k"].dtype == cache_spec.compute_dtype  # no quant store
             and position_ids.shape[1] == 1
             and (cache_inputs or {}).get("attn_mask") is None
@@ -1198,18 +1367,6 @@ def run_decoder_layers(
             adapter_ids, collect_hidden, layer_injections,
         )
 
-    def body(h, xs):
-        if layer_injections is not None:
-            lp, kl, vl, inj = xs
-        else:
-            (lp, kl, vl), inj = xs, None
-        h, nk, nv = _step(
-            h, lp, kl, vl, cos, sin, position_ids, cache_inputs, adapter_ids
-        )
-        if inj is not None:
-            h = h + inj.astype(h.dtype)
-        return h, ((nk, nv, h) if collect_hidden else (nk, nv))
-
     # Heterogeneous stacks (deepseek-V3 first_k_dense_replace, minimax) arrive
     # as a LIST of layer-stacked segments — e.g. [dense-MLP head, MoE rest] —
     # each scanned over its static slice of the cache. Homogeneous models pass
@@ -1220,14 +1377,32 @@ def run_decoder_layers(
     ks, vs, hs = [], [], []
     off = 0
     for seg in segments:
+        # kernel-stacked weights: keep the big MLP/QKV weights OUT of the
+        # scanned xs (a pallas operand on a scan slice materializes a full
+        # per-layer weight copy) — the kernels index the stacked arrays via
+        # scalar-prefetched layer index instead
+        seg, mlp_st, qkv_st = _extract_stacked_weights(arch, seg)
         n_seg = jax.tree_util.tree_leaves(seg)[0].shape[0]
+
+        def body(h, xs, mlp_st=mlp_st, qkv_st=qkv_st):
+            lp, kl, vl, inj, li = xs
+            h, nk, nv = _step(
+                h, lp, kl, vl, cos, sin, position_ids, cache_inputs,
+                adapter_ids, mlp_stacked=mlp_st, qkv_stacked=qkv_st,
+                layer_idx=li,
+            )
+            if inj is not None:
+                h = h + inj.astype(h.dtype)
+            return h, ((nk, nv, h) if collect_hidden else (nk, nv))
+
         k_seg = jax.lax.slice_in_dim(cache["k"], off, off + n_seg, axis=0)
         v_seg = jax.lax.slice_in_dim(cache["v"], off, off + n_seg, axis=0)
-        if layer_injections is not None:
-            inj_seg = jax.lax.slice_in_dim(layer_injections, off, off + n_seg, axis=0)
-            xs = (seg, k_seg, v_seg, inj_seg)
-        else:
-            xs = (seg, k_seg, v_seg)
+        inj_seg = (
+            jax.lax.slice_in_dim(layer_injections, off, off + n_seg, axis=0)
+            if layer_injections is not None
+            else None
+        )
+        xs = (seg, k_seg, v_seg, inj_seg, jnp.arange(n_seg, dtype=jnp.int32))
         hidden, ys = jax.lax.scan(body, hidden, xs)
         off += n_seg
         if collect_hidden:

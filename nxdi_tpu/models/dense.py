@@ -85,6 +85,18 @@ def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
         attn_block_tkg_kernel_enabled=bool(
             config.tpu_config.attn_block_tkg_kernel_enabled
         ),
+        fused_qkv=bool(getattr(config.tpu_config, "fused_qkv", False)),
+        fused_qkv_tp=(
+            int(config.tpu_config.tp_degree)
+            if getattr(config.tpu_config, "fused_qkv", False)
+            else 1
+        ),
+        qkv_kernel_enabled=bool(
+            getattr(config.tpu_config, "qkv_kernel_enabled", False)
+        ),
+        mlp_kernel_enabled=bool(
+            getattr(config.tpu_config, "mlp_kernel_enabled", False)
+        ),
         pp_degree=int(getattr(config.tpu_config, "pp_degree", 1) or 1),
         pp_microbatches=int(getattr(config.tpu_config, "pp_microbatches", 0) or 0),
         act_quant=getattr(config.tpu_config, "activation_quantization_type", None),
@@ -116,6 +128,29 @@ def rope_mscale_from_config(config: InferenceConfig) -> float:
             getattr(config, "max_position_embeddings", 4096),
         )[1]
     return 1.0
+
+
+def fuse_qkv_weights(ws, tp: int) -> np.ndarray:
+    """Interleave q/k/v weights (each (H_in, out)) into one fused weight whose
+    column-shards are self-contained per tp rank: [rank0: q|k|v | rank1: ...]
+    (reference: the fused Wqkv weight, gqa.py:582-599; here the interleave
+    replaces the reference's per-rank preshard hook). attention_block's split
+    regroups the logical view by rank block (models/base.py)."""
+    h_in = ws[0].shape[0]
+    outs = [w.shape[1] for w in ws]
+    for o in outs:
+        if o % tp:
+            raise ValueError(
+                f"fused_qkv: projection width {o} is not divisible by "
+                f"tp_degree {tp} — disable fused_qkv for this model/tp"
+            )
+    parts = [w.reshape(h_in, tp, o // tp) for w, o in zip(ws, outs)]
+    return np.concatenate(parts, axis=-1).reshape(h_in, sum(outs))
+
+
+def fuse_qkv_biases(bs, tp: int) -> np.ndarray:
+    parts = [b.reshape(tp, b.shape[0] // tp) for b in bs]
+    return np.concatenate(parts, axis=-1).reshape(-1)
 
 
 def convert_hf_state_dict(
@@ -180,6 +215,13 @@ def convert_hf_state_dict(
         if arch.qk_norm:
             attn["q_norm"] = cast(get(pre + "self_attn.q_norm.weight"))
             attn["k_norm"] = cast(get(pre + "self_attn.k_norm.weight"))
+        if arch.fused_qkv:
+            tp = arch.fused_qkv_tp
+            qp, kp, vp = attn.pop("q_proj"), attn.pop("k_proj"), attn.pop("v_proj")
+            fused = {"w": fuse_qkv_weights([qp["w"], kp["w"], vp["w"]], tp)}
+            if "b" in qp:
+                fused["b"] = fuse_qkv_biases([qp["b"], kp["b"], vp["b"]], tp)
+            attn["qkv_proj"] = fused
         layer = {
             "input_layernorm": cast(get(pre + "input_layernorm.weight")),
             "post_attention_layernorm": cast(get(pre + "post_attention_layernorm.weight")),
@@ -241,16 +283,25 @@ def param_shape_struct(config: InferenceConfig, arch: DecoderArch):
     def s(*shape):
         return jax.ShapeDtypeStruct(shape, dt)
 
-    attn = {
-        "q_proj": {"w": s(L, hs, H * D)},
-        "k_proj": {"w": s(L, hs, KV * D)},
-        "v_proj": {"w": s(L, hs, KV * D)},
-        "o_proj": {"w": s(L, H * D, hs)},
-    }
-    if arch.attention_bias:
-        attn["q_proj"]["b"] = s(L, H * D)
-        attn["k_proj"]["b"] = s(L, KV * D)
-        attn["v_proj"]["b"] = s(L, KV * D)
+    if arch.fused_qkv:
+        T = (H + 2 * KV) * D
+        attn = {
+            "qkv_proj": {"w": s(L, hs, T)},
+            "o_proj": {"w": s(L, H * D, hs)},
+        }
+        if arch.attention_bias:
+            attn["qkv_proj"]["b"] = s(L, T)
+    else:
+        attn = {
+            "q_proj": {"w": s(L, hs, H * D)},
+            "k_proj": {"w": s(L, hs, KV * D)},
+            "v_proj": {"w": s(L, hs, KV * D)},
+            "o_proj": {"w": s(L, H * D, hs)},
+        }
+        if arch.attention_bias:
+            attn["q_proj"]["b"] = s(L, H * D)
+            attn["k_proj"]["b"] = s(L, KV * D)
+            attn["v_proj"]["b"] = s(L, KV * D)
     if arch.attention_o_bias:
         attn["o_proj"]["b"] = s(L, hs)
     if arch.qk_norm:

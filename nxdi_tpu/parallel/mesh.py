@@ -81,19 +81,44 @@ def build_mesh(
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
     devices = list(devices)[:n]
+    shape = (pp_degree, dp_degree, cp_degree, ep_degree, epx_degree, inner_tp)
     if len(devices) == 1:
         dev_array = np.array(devices).reshape(1, 1, 1, 1, 1, 1)
+    elif jax.process_count() > 1:
+        # multi-host (launched via scripts/nxdi_tpu_distributed_launcher.py):
+        # the OUTER axes (pp, dp) span hosts — their collectives ride DCN —
+        # while cp/ep/tp stay host-local on ICI. create_hybrid_device_mesh is
+        # the topology-aware placement for exactly this factorization
+        # (reference analog: node-major rank order in the MPI launcher,
+        # scripts/nxdi_distributed_launcher.py:29-80).
+        # true per-host device count of the SELECTED devices (the [:n]
+        # truncation can land them all on one host)
+        hosts = {d.process_index for d in devices}
+        per_host = len(devices) // max(len(hosts), 1)
+        # place pp and dp over DCN when the inner axes fit on one host's
+        # devices and the outer axes span the hosts evenly
+        inner = cp_degree * ep_degree * epx_degree * inner_tp
+        if len(hosts) > 1 and inner <= per_host and pp_degree * dp_degree % len(hosts) == 0:
+            dcn = [pp_degree, dp_degree, 1, 1, 1, 1]
+            ici = [1, 1, cp_degree, ep_degree, epx_degree, inner_tp]
+            try:
+                dev_array = mesh_utils.create_hybrid_device_mesh(
+                    tuple(ici), tuple(dcn), devices=devices,
+                    allow_split_physical_axes=allow_split_physical_axes,
+                )
+            except (ValueError, AssertionError, NotImplementedError):
+                dev_array = np.array(devices).reshape(shape)
+        else:
+            dev_array = np.array(devices).reshape(shape)
     else:
         try:
             dev_array = mesh_utils.create_device_mesh(
-                (pp_degree, dp_degree, cp_degree, ep_degree, epx_degree, inner_tp),
+                shape,
                 devices=devices,
                 allow_split_physical_axes=allow_split_physical_axes,
             )
         except (ValueError, AssertionError, NotImplementedError):
-            dev_array = np.array(devices).reshape(
-                pp_degree, dp_degree, cp_degree, ep_degree, epx_degree, inner_tp
-            )
+            dev_array = np.array(devices).reshape(shape)
     return Mesh(dev_array, (AXIS_PP, AXIS_DP, AXIS_CP, AXIS_EP, AXIS_EPX, AXIS_TP))
 
 

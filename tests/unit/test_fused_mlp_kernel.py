@@ -1,0 +1,125 @@
+"""Fused MLP / fused-QKV Pallas kernels (ops/kernels/fused_mlp.py) vs plain
+jnp math — interpret mode on CPU; Mosaic correctness is covered by
+tests/tpu/test_mosaic_kernels_r4.py on hardware.
+
+Reference analogs: the NKI MLP kernel (modeling_llama.py:502-943) and the
+fused-QKV kernel (gqa.py:669)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import nxdi_tpu.ops.kernels.fused_proj as fk
+
+
+def _ref_mlp(x, g, u, d, act="silu"):
+    from nxdi_tpu.models.base import ACT_FNS
+
+    return (ACT_FNS[act](x @ g) * (x @ u)) @ d
+
+
+@pytest.mark.parametrize("act", ["silu", "gelu_pytorch_tanh"])
+@pytest.mark.parametrize("m", [8, 32, 96])
+def test_fused_mlp_matches_reference(act, m):
+    rng = np.random.default_rng(0)
+    H, I = 64, 256
+    x = jnp.asarray(rng.standard_normal((m, H)) * 0.1, jnp.float32)
+    g = jnp.asarray(rng.standard_normal((H, I)) * 0.1, jnp.float32)
+    u = jnp.asarray(rng.standard_normal((H, I)) * 0.1, jnp.float32)
+    d = jnp.asarray(rng.standard_normal((I, H)) * 0.1, jnp.float32)
+    got = fk.fused_mlp(x, g, u, d, act=act, block_m=32, block_i=64)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(_ref_mlp(x, g, u, d, act)), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_fused_mlp_stacked_indexes_layer():
+    """The scalar-prefetched layer index must select the right weight slab."""
+    rng = np.random.default_rng(1)
+    L, H, I, M = 3, 64, 128, 16
+    x = jnp.asarray(rng.standard_normal((M, H)) * 0.1, jnp.float32)
+    gs = jnp.asarray(rng.standard_normal((L, H, I)) * 0.1, jnp.float32)
+    us = jnp.asarray(rng.standard_normal((L, H, I)) * 0.1, jnp.float32)
+    ds = jnp.asarray(rng.standard_normal((L, I, H)) * 0.1, jnp.float32)
+    for li in range(L):
+        got = fk.fused_mlp_stacked(
+            x, gs, us, ds, jnp.array([li], jnp.int32), block_m=16, block_i=64
+        )
+        want = _ref_mlp(x, gs[li], us[li], ds[li])
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_fused_mlp_stacked_inside_scan():
+    """In-scan usage: the layer index rides the scan xs while the stacked
+    weights are closed over — the exact shape run_decoder_layers uses."""
+    rng = np.random.default_rng(2)
+    L, H, I, M = 4, 64, 128, 8
+    x0 = jnp.asarray(rng.standard_normal((M, H)) * 0.1, jnp.float32)
+    gs = jnp.asarray(rng.standard_normal((L, H, I)) * 0.1, jnp.float32)
+    us = jnp.asarray(rng.standard_normal((L, H, I)) * 0.1, jnp.float32)
+    ds = jnp.asarray(rng.standard_normal((L, I, H)) * 0.1, jnp.float32)
+
+    def body(h, li):
+        return h + fk.fused_mlp_stacked(h, gs, us, ds, li.reshape(1)), None
+
+    got, _ = jax.lax.scan(body, x0, jnp.arange(L, dtype=jnp.int32))
+    want = x0
+    for li in range(L):
+        want = want + _ref_mlp(want, gs[li], us[li], ds[li])
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("bias", [False, True])
+def test_qkv_matmul(bias):
+    rng = np.random.default_rng(3)
+    M, H, T = 16, 64, 192
+    x = jnp.asarray(rng.standard_normal((M, H)) * 0.1, jnp.float32)
+    w = jnp.asarray(rng.standard_normal((H, T)) * 0.1, jnp.float32)
+    b = jnp.asarray(rng.standard_normal(T) * 0.1, jnp.float32) if bias else None
+    got = fk.qkv_matmul(x, w, b, block_m=16, block_n=64)
+    want = x @ w + (b if bias else 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("bias", [False, True])
+def test_qkv_matmul_stacked(bias):
+    rng = np.random.default_rng(4)
+    L, M, H, T = 3, 16, 64, 192
+    x = jnp.asarray(rng.standard_normal((M, H)) * 0.1, jnp.float32)
+    ws = jnp.asarray(rng.standard_normal((L, H, T)) * 0.1, jnp.float32)
+    bs = jnp.asarray(rng.standard_normal((L, T)) * 0.1, jnp.float32) if bias else None
+    for li in range(L):
+        got = fk.qkv_matmul_stacked(
+            x, ws, jnp.array([li], jnp.int32), bs, block_m=16, block_n=64
+        )
+        want = x @ ws[li] + (bs[li] if bias else 0.0)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_fuse_qkv_weight_interleave_roundtrip():
+    """fuse_qkv_weights + the attention_block rank-block split must be exact
+    inverses on the logical view for every tp degree."""
+    from nxdi_tpu.models.dense import fuse_qkv_biases, fuse_qkv_weights
+
+    rng = np.random.default_rng(5)
+    Hin, Tq, Tk, Tv = 32, 64, 16, 16
+    q = rng.standard_normal((Hin, Tq)).astype(np.float32)
+    k = rng.standard_normal((Hin, Tk)).astype(np.float32)
+    v = rng.standard_normal((Hin, Tv)).astype(np.float32)
+    x = rng.standard_normal((2, 3, Hin)).astype(np.float32)
+    for tp in (1, 2, 4, 8):
+        fused = fuse_qkv_weights([q, k, v], tp)
+        qkv = x @ fused
+        t = qkv.reshape(2, 3, tp, (Tq + Tk + Tv) // tp)
+        q_out = t[..., : Tq // tp].reshape(2, 3, Tq)
+        k_out = t[..., Tq // tp : (Tq + Tk) // tp].reshape(2, 3, Tk)
+        v_out = t[..., (Tq + Tk) // tp :].reshape(2, 3, Tv)
+        np.testing.assert_allclose(q_out, x @ q, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(k_out, x @ k, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(v_out, x @ v, rtol=1e-5, atol=1e-5)
+        fb = fuse_qkv_biases(
+            [q[0].copy(), k[0].copy(), v[0].copy()], tp
+        )
+        tb = fb.reshape(tp, (Tq + Tk + Tv) // tp)
+        np.testing.assert_allclose(tb[:, : Tq // tp].reshape(-1), q[0])
