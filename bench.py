@@ -62,6 +62,11 @@ def main():
             on_device_sampling_config=OnDeviceSamplingConfig(),
             async_mode=True,  # device-resident decode: steps chain on device
             attn_kernel_enabled=True,  # Pallas flash prefill (D=64 Mosaic path)
+            # fused_qkv (one interleaved q|k|v weight, single matmul): the
+            # round-4 A/B winner on decode — 8.861 -> 8.638 ms/step (+2.6%
+            # tok/s) at bs32; CTE pays ~3% (one wider matmul tiles slightly
+            # worse at M=32k), a good trade at serving decode:prefill ratios.
+            fused_qkv=True,
             # attn_tkg_kernel_enabled stays OFF: the fused deferred-write
             # decode kernel (flash_attention_decode_fused) is correct and
             # composes with the commit kernel, but measured SLOWER here than
@@ -70,6 +75,12 @@ def main():
             # copy per layer), and at G=4 grouped queries XLA's VPU decode
             # lowering is already at the bandwidth roofline. Revisit if XLA
             # stops fusing the slice reads.
+            # mlp_kernel_enabled / qkv_kernel_enabled stay OFF in the bench:
+            # the round-4 Pallas fused MLP / fused QKV kernels (stacked
+            # scalar-prefetch variants, ops/kernels/fused_proj.py) measure
+            # PARITY with XLA at these shapes (8.915 / 8.642 vs 8.861 /
+            # 8.638 ms) — proof XLA already saturates the weight-streaming
+            # roofline; they remain Mosaic-verified opt-ins.
             skip_warmup=False,
             **quant_kwargs,
         )

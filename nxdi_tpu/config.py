@@ -106,11 +106,18 @@ class KVQuantizationConfig:
             v_scales = np.asarray(v_scales, dtype=np.float32)
         self.k_scales = k_scales
         self.v_scales = v_scales
-        if k_scales is not None and self.scale_mode not in ("per_key", "per_channel"):
+        if k_scales is not None and self.scale_mode == "per_tensor":
+            # calibration's per_tensor mode returns (L,) per-layer arrays;
+            # the per-tensor layout takes one static scalar — collapse to
+            # the max so the documented calibrate->config flow works
+            self.k_scale = float(np.max(k_scales))
+            self.v_scale = float(np.max(v_scales))
+            self.k_scales = self.v_scales = None
+        elif k_scales is not None and self.scale_mode not in ("per_key", "per_channel"):
             raise ValueError(
                 "k_scales/v_scales arrays are only consumed by "
-                "scale_mode='per_key'|'per_channel' (per_tensor takes scalar "
-                f"k_scale/v_scale); got scale_mode={self.scale_mode!r}"
+                "scale_mode='per_tensor'|'per_key'|'per_channel'; got "
+                f"scale_mode={self.scale_mode!r}"
             )
         if self.scale_mode not in ("direct_cast", "per_tensor", "per_key", "per_channel"):
             raise ValueError(
@@ -572,6 +579,15 @@ class TpuConfig:
             raise ValueError(
                 "mlp_kernel_enabled composes with full-precision weights only "
                 "for now (quantized fused MLP is not implemented)"
+            )
+        if (self.mlp_kernel_enabled or self.qkv_kernel_enabled) and (
+            self.window_sized_kv or self.pp_degree > 1
+        ):
+            # those paths scan without the stacked-weight extraction, so the
+            # kernels would silently pay a per-layer weight slice copy
+            raise ValueError(
+                "mlp_kernel_enabled/qkv_kernel_enabled are not supported with "
+                "window_sized_kv or pipeline parallel yet"
             )
         if self.window_sized_kv:
             if not self.sliding_window:

@@ -107,7 +107,7 @@ class DecoderArch:
     # fused projections (reference: fused_qkv gqa.py:530-683, qkv/mlp NKI
     # kernels modeling_llama.py:502-943). fused_qkv packs q/k/v into ONE
     # weight with per-tp-rank head-block interleave (dense.fuse_qkv_weights);
-    # the kernel flags route the fused matmuls through ops/kernels/fused_mlp.
+    # the kernel flags route the fused matmuls through ops/kernels/fused_proj.
     # All three are enforced loudly: ModelWrapper raises after lowering if an
     # enabled flag's strategy never engaged (no silent no-ops).
     fused_qkv: bool = False
@@ -188,6 +188,10 @@ class DecoderArch:
     # holds the post-ATTENTION norm, "post_attention_layernorm" the
     # post-FEEDFORWARD norm (conversion aliases them; HF Olmo2DecoderLayer).
     post_block_norm: bool = False
+    # parallel residual (cohere/command-r, gpt-neox use_parallel_residual):
+    # x + attn(norm1(x)) + mlp(norm2(x)) in ONE residual add; cohere aliases
+    # norm2 to norm1 (same weights), gpt-neox keeps them distinct
+    parallel_block: bool = False
     # granite: scalar multipliers on block outputs and logits
     # (HF GraniteForCausalLM residual_multiplier / logits_scaling)
     residual_multiplier: float = 1.0
@@ -340,7 +344,7 @@ def _norm(arch, x, w):
     if arch.layernorm:
         from nxdi_tpu.ops.norms import layer_norm
 
-        return layer_norm(x, w, eps=1e-5)
+        return layer_norm(x, w, eps=arch.rms_norm_eps)
     return rms_norm(x, w, arch.rms_norm_eps, gemma_style=arch.gemma_norm)
 
 
@@ -383,8 +387,9 @@ def attention_block(
     window_enabled: Optional[jax.Array] = None,
     use_rope: Optional[jax.Array] = None,
     defer_write: bool = False,
-    qkv_stacked=None,  # (w_s (L,H,T), b_s|None) + layer_idx: in-scan kernel
-    layer_idx=None,
+    qkv_stacked=None,  # (w_s (L,H,T), b_s|None) + stacked_layer_idx: in-scan kernel
+    layer_idx=None,  # GLOBAL layer index (per-layer KV-quant scale rows)
+    stacked_layer_idx=None,  # segment-local index into the stacked weights
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -428,7 +433,9 @@ def attention_block(
             if qkv_stacked is not None:
                 w_s, b_s = qkv_stacked
                 qkv = attn_kernels.sharded_qkv_stacked_call(
-                    hidden, w_s, layer_idx, b_s
+                    hidden, w_s,
+                    layer_idx if stacked_layer_idx is None else stacked_layer_idx,
+                    b_s,
                 )
             else:
                 qkv = attn_kernels.sharded_qkv_call(hidden, pq["w"], pq.get("b"))
@@ -776,7 +783,7 @@ def mlp_block(
     lineage (gated_mlp=False). XLA fuses act+mul into the matmuls.
 
     ``mlp_kernel_enabled`` routes the gated path through the Pallas fused
-    gate/up/down kernel (ops/kernels/fused_mlp.py; reference: the NKI MLP
+    gate/up/down kernel (ops/kernels/fused_proj.py; reference: the NKI MLP
     kernel, modeling_llama.py:502-943) — ineligible configurations raise,
     they never silently fall back. Inside the layer scan the weights come
     STACKED (``mlp_stacked`` = (L,H,I)/(L,I,H) arrays + in-scan layer index):
@@ -846,8 +853,11 @@ def decoder_layer(
     defer_write: bool = False,
     mlp_stacked=None,
     qkv_stacked=None,
-    layer_idx=None,
+    layer_idx=None,  # GLOBAL layer index (per-layer KV-quant scale rows)
+    stacked_layer_idx=None,  # segment-local index into the stacked weights
 ):
+    if stacked_layer_idx is None:
+        stacked_layer_idx = layer_idx
     # per-layer rope selection (gemma3 local/global thetas): cos/sin arrive
     # stacked (2, B, S, D) and the layer flag picks one inside the scan body
     if "use_local_rope" in lp:
@@ -870,15 +880,25 @@ def decoder_layer(
         extra["defer_write"] = defer_write
         extra["qkv_stacked"] = qkv_stacked
         extra["layer_idx"] = layer_idx
+        extra["stacked_layer_idx"] = stacked_layer_idx
     attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
         adapter_ids, window_enabled, use_rope, **extra,
     )
-    if arch.post_block_norm:
+    if arch.parallel_block:
+        # cohere / gpt-neox: attention and MLP read their (possibly shared)
+        # pre-norms off the SAME residual input, one residual add
+        h_mlp = _norm(arch, hidden, lp["post_attention_layernorm"])
+        if arch.moe is not None and "moe" in lp:
+            ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h_mlp, policy.hidden)
+        else:
+            ff = mlp_block(arch, lp["mlp"], h_mlp, adapter_ids, mlp_stacked, stacked_layer_idx)
+        hidden = hidden + (attn_out + ff) * arch.residual_multiplier
+    elif arch.post_block_norm:
         # olmo2: x + norm(attn(x)); x + norm(mlp(x))
         hidden = hidden + _norm(arch, attn_out, lp["input_layernorm"]) * arch.residual_multiplier
-        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids, mlp_stacked, layer_idx)
+        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids, mlp_stacked, stacked_layer_idx)
         hidden = hidden + _norm(arch, ff, lp["post_attention_layernorm"]) * arch.residual_multiplier
     elif arch.sandwich_norm:
         # gemma lineage: post-norms applied to the block OUTPUT before the
@@ -892,7 +912,7 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden)
         else:
-            ff = mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, layer_idx)
+            ff = mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, stacked_layer_idx)
         ff = _norm(arch, ff, lp["post_feedforward_layernorm"])
         hidden = hidden + ff
     else:
@@ -901,7 +921,7 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden) * arch.residual_multiplier
         else:
-            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, layer_idx) * arch.residual_multiplier
+            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, stacked_layer_idx) * arch.residual_multiplier
     hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
@@ -1287,7 +1307,7 @@ def run_decoder_layers(
 
     def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None,
               windowable_=None, defer_=None, mlp_stacked=None,
-              qkv_stacked=None, layer_idx=None):
+              qkv_stacked=None, layer_idx=None, stacked_layer_idx=None):
         """One decoder layer with the bucket's static KV window applied.
         ``layout_``/``windowable_``/``defer_`` override the stack-wide
         defaults for the interleaved-window unit scan (ring slices use the
@@ -1296,7 +1316,7 @@ def run_decoder_layers(
         win_ok = windowable if windowable_ is None else windowable_
         dfr = defer if defer_ is None else defer_
         stk = dict(mlp_stacked=mlp_stacked, qkv_stacked=qkv_stacked,
-                   layer_idx=layer_idx)
+                   layer_idx=layer_idx, stacked_layer_idx=stacked_layer_idx)
         if win_ok and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
@@ -1384,12 +1404,16 @@ def run_decoder_layers(
         seg, mlp_st, qkv_st = _extract_stacked_weights(arch, seg)
         n_seg = jax.tree_util.tree_leaves(seg)[0].shape[0]
 
-        def body(h, xs, mlp_st=mlp_st, qkv_st=qkv_st):
+        def body(h, xs, mlp_st=mlp_st, qkv_st=qkv_st, seg_off=off):
+            # xs carries the GLOBAL layer index (for per-layer KV-quant scale
+            # rows, kv_cache._scale_for); the per-SEGMENT stacked kernel
+            # weights index with the segment-local offset
             lp, kl, vl, inj, li = xs
+            li_local = li - jnp.int32(seg_off)
             h, nk, nv = _step(
                 h, lp, kl, vl, cos, sin, position_ids, cache_inputs,
                 adapter_ids, mlp_stacked=mlp_st, qkv_stacked=qkv_st,
-                layer_idx=li,
+                layer_idx=li, stacked_layer_idx=li_local,
             )
             if inj is not None:
                 h = h + inj.astype(h.dtype)
@@ -1402,7 +1426,8 @@ def run_decoder_layers(
             if layer_injections is not None
             else None
         )
-        xs = (seg, k_seg, v_seg, inj_seg, jnp.arange(n_seg, dtype=jnp.int32))
+        xs = (seg, k_seg, v_seg, inj_seg,
+              off + jnp.arange(n_seg, dtype=jnp.int32))
         hidden, ys = jax.lax.scan(body, hidden, xs)
         off += n_seg
         if collect_hidden:

@@ -1,0 +1,94 @@
+"""Cohere2 (Command R7B) family — PARALLEL attention+MLP block, interleaved
+sliding windows with local-only rope, scaled logits.
+
+Reference: contrib/models/c4ai-command-r7b-12-2024. HF Cohere2ForCausalLM
+(modeling_cohere2.py:79-500):
+  - ONE (mean-subtracted, weight-only) LayerNorm per layer; attention and
+    MLP both read it and sum into a single residual (``parallel_block``;
+    the shared norm is aliased onto both norm keys at conversion);
+  - GPT-J interleaved-pair rope, applied ONLY on sliding-window layers
+    (global layers are NoPE) — per-layer use_sliding_window/use_rope flags;
+  - logits multiplied by ``logit_scale`` (mapped onto the dividing
+    ``logits_scaling`` switch); embeddings tied."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Cohere2InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        self.rms_norm_eps = getattr(self, "layer_norm_eps", 1e-5)
+        if not hasattr(self, "tie_word_embeddings"):
+            self.tie_word_embeddings = True
+        super().add_derived_config()
+        if getattr(self, "use_qk_norm", False):
+            raise NotImplementedError("cohere2 use_qk_norm is not supported yet")
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            pat = getattr(self, "sliding_window_pattern", 4) or 4
+            self.layer_types = [
+                "full_attention" if (i + 1) % pat == 0 else "sliding_attention"
+                for i in range(self.num_hidden_layers)
+            ]
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        parallel_block=True,
+        layernorm=True,
+        rope_interleaved=True,
+        sliding_window=getattr(config, "sliding_window", None),
+        logits_scaling=1.0 / float(getattr(config, "logit_scale", 1.0)),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def _flags(config):
+    sliding = np.array(
+        [t == "sliding_attention" for t in config.layer_types], dtype=bool
+    )
+    return sliding
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    # ONE norm per layer: alias it onto post_attention_layernorm so the
+    # parallel block's MLP branch reads the same weights
+    sd = dict(state_dict)
+    for i in range(config.num_hidden_layers):
+        for pre in ("model.layers.", "layers."):
+            key = f"{pre}{i}.input_layernorm.weight"
+            if key in sd:
+                sd[f"{pre}{i}.post_attention_layernorm.weight"] = sd[key]
+    params = dense.convert_hf_state_dict(sd, config, arch)
+    sliding = _flags(config)
+    params["layers"]["use_sliding_window"] = sliding
+    params["layers"]["use_rope"] = sliding.copy()  # global layers are NoPE
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["use_sliding_window"] = REPLICATED
+    specs["layers"]["use_rope"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    L = config.num_hidden_layers
+    struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    struct["layers"]["use_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return struct

@@ -1,0 +1,47 @@
+"""ERNIE 4.5 (dense) family — llama with a single ``use_bias`` switch on all
+projections (q/k/v/o and gate/up/down).
+
+Reference: contrib/models/ERNIE-4.5-0.3B-PT. HF Ernie4_5ForCausalLM wires
+``config.use_bias`` into every linear (modeling_ernie4_5.py:86-194) and uses
+the GLM-style INTERLEAVED-pair rope over the full head dim
+(modeling_ernie4_5.py:160-176, repeat_interleave'd cos/sin); norms are the
+llama standard."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Ernie4_5InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        super().add_derived_config()
+        if not hasattr(self, "use_bias"):
+            self.use_bias = False
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    bias = bool(getattr(config, "use_bias", False))
+    kwargs = dict(
+        attention_bias=bias,
+        attention_o_bias=bias,
+        mlp_bias=bias,
+        rope_interleaved=True,  # GLM-style paired rope, full head dim
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -1,0 +1,102 @@
+"""EXAONE 4.0 family — post-block norms + per-head qk norm + hybrid
+sliding/global attention with global NoPE.
+
+Reference: contrib/models/EXAONE-4.0-1.2B. HF Exaone4ForCausalLM
+(modeling_exaone4.py:107-230):
+  - NO input norms; RMSNorm on the attention/MLP OUTPUT before the residual
+    (the olmo2 ``post_block_norm`` ordering) — HF names them
+    post_attention_layernorm / post_feedforward_layernorm;
+  - qwen3-style per-head q/k rmsnorm BEFORE rope;
+  - hybrid models (``sliding_window`` set): ``layer_types`` marks sliding
+    layers; GLOBAL layers skip rope entirely ("global NoPE") — both ride the
+    layer scan as per-layer flags (use_sliding_window / use_rope)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Exaone4InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        super().add_derived_config()
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            if self.sliding_window:
+                pat = getattr(self, "sliding_window_pattern", 4) or 4
+                # "LLLG" / 4: every pat-th layer is global
+                self.layer_types = [
+                    "full_attention" if (i + 1) % pat == 0 else "sliding_attention"
+                    for i in range(self.num_hidden_layers)
+                ]
+            else:
+                self.layer_types = ["full_attention"] * self.num_hidden_layers
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        post_block_norm=True,
+        qk_norm=True,
+        sliding_window=getattr(config, "sliding_window", None),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def _layer_flags(config):
+    """Hybrid models only: sliding layers attend windowed AND are the only
+    layers that rope (global NoPE)."""
+    sliding = np.array(
+        [t == "sliding_attention" for t in config.layer_types], dtype=bool
+    )
+    return sliding, sliding.copy()
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    # alias the post-norms onto the post_block_norm keys (olmo2 convention):
+    # HF post_attention -> "input_layernorm" (attn post-norm),
+    # HF post_feedforward -> "post_attention_layernorm" (mlp post-norm)
+    sd = dict(state_dict)
+    for i in range(config.num_hidden_layers):
+        for pre in ("model.layers.", "layers."):
+            p = f"{pre}{i}."
+            if p + "post_attention_layernorm.weight" not in sd:
+                continue
+            sd[p + "input_layernorm.weight"] = sd[p + "post_attention_layernorm.weight"]
+            sd[p + "post_attention_layernorm.weight"] = sd.pop(
+                p + "post_feedforward_layernorm.weight"
+            )
+    params = dense.convert_hf_state_dict(sd, config, arch)
+    if getattr(config, "sliding_window", None):
+        sliding, use_rope = _layer_flags(config)
+        params["layers"]["use_sliding_window"] = sliding
+        params["layers"]["use_rope"] = use_rope
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    if getattr(config, "sliding_window", None):
+        specs["layers"]["use_sliding_window"] = REPLICATED
+        specs["layers"]["use_rope"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    if getattr(config, "sliding_window", None):
+        L = config.num_hidden_layers
+        struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+        struct["layers"]["use_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return struct

@@ -1,0 +1,729 @@
+"""Falcon-H1 — PARALLEL attention + Mamba2 (SSD) hybrid with muP multipliers.
+
+Reference: contrib/models/Falcon-H1-0.5B-Instruct (the last distinct-machinery
+SSM hybrid of the contrib hub). Every layer runs a full GQA attention branch
+AND a Mamba2 mixer branch over the SAME input norm, summing both into the
+residual (HF ``FalconH1DecoderLayer``), followed by a gated MLP with scalar
+multipliers sprinkled muP-style on embeddings / keys / branch outputs / MLP
+gate / logits.
+
+TPU-native mapping (the qwen3_next/lfm2/recurrentgemma recurrent-state
+pattern, models/state_routing.py seq-id routing included):
+  - ``k``/``v``:  (L, B, KV, S, D) full-length exact-position stacks,
+  - ``conv``:     (L, B, conv_dim, K) causal-conv tails over [x|B|C],
+  - ``ssm``:      (L, B, Hm, P, N) f32 Mamba2 states.
+  - The SSM runs as a SEQUENTIAL ``lax.scan`` over positions in f32 — the
+    mathematically-equivalent recurrence of HF's chunked SSD prefill
+    (torch_forward, modeling_falcon_h1.py:777-990):
+        dt      = softplus(dt_raw + dt_bias)            (B, Hm)
+        state   = state * exp(dt * A) + dt * B ⊗ x      (B, Hm, P, N)
+        y       = state · C + D * x
+  - right padding freezes the recurrence (dt forced to 0 on pad lanes — HF
+    instead zeroes padded inputs via apply_mask_to_padding_states and trusts
+    left padding) and conv tails keep the last K REAL columns per row.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, dtype_name
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.state_routing import put_rows, take_rows
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops.norms import rms_norm
+from nxdi_tpu.ops.rope import apply_rotary_pos_emb, default_inv_freq, rope_cos_sin
+from nxdi_tpu.parallel.layers import REPLICATED
+from nxdi_tpu.parallel.mesh import AXIS_MP
+
+
+@dataclass(frozen=True)
+class FalconH1Arch:
+    num_layers: int
+    hidden_size: int
+    intermediate_size: int
+    vocab_size: int
+    vocab_pad: int
+    rms_norm_eps: float
+    # attention
+    num_attention_heads: int
+    num_kv_heads: int
+    head_dim: int
+    attention_bias: bool
+    # mamba2 mixer
+    d_ssm: int
+    mamba_heads: int  # Hm
+    mamba_head_dim: int  # P
+    d_state: int  # N
+    n_groups: int  # G
+    conv_kernel: int  # K
+    conv_bias: bool
+    proj_bias: bool
+    projectors_bias: bool
+    mamba_rms_norm: bool
+    norm_before_gate: bool
+    # muP multipliers
+    embedding_multiplier: float
+    lm_head_multiplier: float
+    key_multiplier: float
+    attention_in_multiplier: float
+    attention_out_multiplier: float
+    ssm_in_multiplier: float
+    ssm_out_multiplier: float
+    mlp_gate_multiplier: float
+    mlp_down_multiplier: float
+    ssm_multipliers: Tuple[float, ...] = field(default=(1.0,) * 5)
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+
+    @property
+    def conv_dim(self) -> int:
+        return self.d_ssm + 2 * self.n_groups * self.d_state
+
+    @property
+    def proj_dim(self) -> int:
+        return self.d_ssm + self.conv_dim + self.mamba_heads
+
+
+class FalconH1InferenceConfig(InferenceConfig):
+    REQUIRED = [
+        "hidden_size",
+        "intermediate_size",
+        "num_hidden_layers",
+        "num_attention_heads",
+        "num_key_value_heads",
+        "vocab_size",
+    ]
+
+    def add_derived_config(self):
+        defaults = dict(
+            rms_norm_eps=1e-5,
+            rope_theta=100000.0,
+            attention_bias=False,
+            mamba_d_ssm=None,
+            mamba_expand=2,
+            mamba_n_heads=128,
+            mamba_d_head="auto",
+            mamba_n_groups=1,
+            mamba_d_state=256,
+            mamba_d_conv=4,
+            mamba_conv_bias=True,
+            mamba_proj_bias=False,
+            projectors_bias=False,
+            mamba_rms_norm=False,
+            mamba_norm_before_gate=True,
+            embedding_multiplier=1.0,
+            lm_head_multiplier=1.0,
+            key_multiplier=1.0,
+            attention_out_multiplier=1.0,
+            attention_in_multiplier=1.0,
+            ssm_in_multiplier=1.0,
+            ssm_out_multiplier=1.0,
+            mlp_multipliers=[1.0, 1.0],
+            ssm_multipliers=[1.0] * 5,
+            tie_word_embeddings=False,
+        )
+        for k, v in defaults.items():
+            if not hasattr(self, k) or getattr(self, k) is None:
+                setattr(self, k, v)
+        if not hasattr(self, "head_dim") or self.head_dim is None:
+            self.head_dim = self.hidden_size // self.num_attention_heads
+
+
+def build_arch(config: InferenceConfig, **overrides) -> FalconH1Arch:
+    d_ssm = (
+        config.mamba_d_ssm
+        if config.mamba_d_ssm is not None
+        else int(config.mamba_expand * config.hidden_size)
+    )
+    d_head = config.mamba_d_head
+    if d_head == "auto":
+        d_head = d_ssm // config.mamba_n_heads
+    vocab, vocab_pad = dense.padded_vocab(config)
+    kwargs = dict(
+        num_layers=config.num_hidden_layers,
+        hidden_size=config.hidden_size,
+        intermediate_size=config.intermediate_size,
+        vocab_size=vocab,
+        vocab_pad=vocab_pad,
+        rms_norm_eps=config.rms_norm_eps,
+        num_attention_heads=config.num_attention_heads,
+        num_kv_heads=config.num_key_value_heads,
+        head_dim=config.head_dim,
+        attention_bias=bool(config.attention_bias),
+        d_ssm=d_ssm,
+        mamba_heads=config.mamba_n_heads,
+        mamba_head_dim=int(d_head),
+        d_state=config.mamba_d_state,
+        n_groups=config.mamba_n_groups,
+        conv_kernel=config.mamba_d_conv,
+        conv_bias=bool(config.mamba_conv_bias),
+        proj_bias=bool(config.mamba_proj_bias),
+        projectors_bias=bool(config.projectors_bias),
+        mamba_rms_norm=bool(config.mamba_rms_norm),
+        norm_before_gate=bool(config.mamba_norm_before_gate),
+        embedding_multiplier=float(config.embedding_multiplier),
+        lm_head_multiplier=float(config.lm_head_multiplier),
+        key_multiplier=float(config.key_multiplier),
+        attention_in_multiplier=float(config.attention_in_multiplier),
+        attention_out_multiplier=float(config.attention_out_multiplier),
+        ssm_in_multiplier=float(config.ssm_in_multiplier),
+        ssm_out_multiplier=float(config.ssm_out_multiplier),
+        mlp_gate_multiplier=float(config.mlp_multipliers[0]),
+        mlp_down_multiplier=float(config.mlp_multipliers[1]),
+        ssm_multipliers=tuple(float(m) for m in config.ssm_multipliers),
+        tie_word_embeddings=bool(config.tie_word_embeddings),
+        dtype=dtype_name(config.tpu_config.dtype),
+    )
+    kwargs.update(overrides)
+    return FalconH1Arch(**kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return default_inv_freq(config.head_dim, getattr(config, "rope_theta", 100000.0))
+
+
+def _mup_vector(arch: FalconH1Arch) -> np.ndarray:
+    """The per-section in_proj output multiplier (HF compute_mup_vector,
+    modeling_falcon_h1.py:1172): [gate | x | B | C | dt] sections."""
+    I, GN, Hm = arch.d_ssm, arch.n_groups * arch.d_state, arch.mamba_heads
+    m = np.ones(arch.proj_dim, dtype=np.float32)
+    z = arch.ssm_multipliers
+    m[:I] *= z[0]
+    m[I : 2 * I] *= z[1]
+    m[2 * I : 2 * I + GN] *= z[2]
+    m[2 * I + GN : 2 * I + 2 * GN] *= z[3]
+    m[2 * I + 2 * GN :] *= z[4]
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Mamba2 mixer (sequential SSD recurrence)
+# ---------------------------------------------------------------------------
+
+
+def mamba_mixer(arch: FalconH1Arch, lp, x, conv_state, ssm_state, valid, is_decode):
+    """HF FalconH1Mixer.torch_forward semantics via the sequential recurrence.
+
+    x: (B, S, H) already input-normed; conv_state (B, conv_dim, K);
+    ssm_state (B, Hm, P, N) f32; valid (B, S) bool."""
+    B, S, _ = x.shape
+    dt_ = x.dtype
+    I, GN, Hm = arch.d_ssm, arch.n_groups * arch.d_state, arch.mamba_heads
+    P, N, G, K = arch.mamba_head_dim, arch.d_state, arch.n_groups, arch.conv_kernel
+
+    x_in = jnp.where(valid[..., None], x, 0.0) * jnp.asarray(
+        arch.ssm_in_multiplier, dt_
+    )
+    proj = x_in @ lp["in_proj"]
+    if arch.proj_bias:
+        proj = proj + lp["in_proj_b"]
+    proj = proj * lp["mup_vector"].astype(proj.dtype)
+    gate = proj[..., :I]
+    hbc = proj[..., I : I + arch.conv_dim]
+    dt_raw = proj[..., I + arch.conv_dim :]  # (B, S, Hm)
+
+    # causal depthwise conv over [x|B|C]
+    hbc = jnp.where(valid[..., None], hbc, 0.0)
+    x_ch = jnp.swapaxes(hbc, 1, 2)  # (B, conv_dim, S)
+    w = lp["conv1d"]  # (conv_dim, K)
+    if is_decode:
+        window = jnp.concatenate([conv_state[:, :, 1:], x_ch], axis=-1)
+        conv = jnp.sum(window * w[None], axis=-1, keepdims=True)  # (B, C, 1)
+        new_conv = window
+    else:
+        padded = jnp.pad(x_ch, ((0, 0), (0, 0), (K - 1, 0)))
+        conv = sum(
+            padded[:, :, j : j + S] * w[:, j][None, :, None] for j in range(K)
+        )
+        # tail = last K REAL columns per row (right padding skipped)
+        lti = jnp.sum(valid.astype(jnp.int32), axis=1) - 1
+        idx = lti[:, None] - (K - 1) + jnp.arange(K, dtype=jnp.int32)[None, :]
+        take = jnp.clip(idx, 0, S - 1)
+        gathered = jnp.take_along_axis(
+            x_ch, jnp.broadcast_to(take[:, None, :], (B, arch.conv_dim, K)), axis=2
+        )
+        new_conv = jnp.where((idx >= 0)[:, None, :], gathered, 0.0).astype(
+            conv_state.dtype
+        )
+    if arch.conv_bias:
+        conv = conv + lp["conv1d_b"][None, :, None]
+    hbc = jax.nn.silu(jnp.swapaxes(conv, 1, 2).astype(jnp.float32)).astype(dt_)
+    hbc = jnp.where(valid[..., None], hbc, 0.0)
+
+    xs = hbc[..., :I].reshape(B, S, Hm, P).astype(jnp.float32)
+    Bv = hbc[..., I : I + GN].reshape(B, S, G, N).astype(jnp.float32)
+    Cv = hbc[..., I + GN :].reshape(B, S, G, N).astype(jnp.float32)
+    rep = Hm // G
+    Bv = jnp.repeat(Bv, rep, axis=2)  # (B, S, Hm, N)
+    Cv = jnp.repeat(Cv, rep, axis=2)
+
+    dt = jax.nn.softplus(dt_raw.astype(jnp.float32) + lp["dt_bias"].astype(jnp.float32))
+    # freeze the recurrence on padded positions: no decay, no write
+    dt = jnp.where(valid[..., None], dt, 0.0)  # (B, S, Hm)
+    A = -jnp.exp(lp["A_log"].astype(jnp.float32))  # (Hm,)
+    D = lp["D"].astype(jnp.float32)  # (Hm,)
+
+    def step(state, ts):
+        x_t, b_t, c_t, dt_t = ts  # (B,Hm,P), (B,Hm,N), (B,Hm,N), (B,Hm)
+        dA = jnp.exp(dt_t * A[None, :])[..., None, None]  # (B,Hm,1,1)
+        dBx = dt_t[..., None, None] * b_t[:, :, None, :] * x_t[..., None]
+        state = state * dA + dBx
+        y_t = jnp.einsum("bhpn,bhn->bhp", state, c_t) + D[None, :, None] * x_t
+        return state, y_t
+
+    ts = tuple(
+        jnp.swapaxes(t, 0, 1) for t in (xs, Bv, Cv, dt)
+    )
+    new_ssm, ys = jax.lax.scan(step, ssm_state.astype(jnp.float32), ts)
+    y = jnp.swapaxes(ys, 0, 1).reshape(B, S, I)  # (B, S, d_ssm) f32
+
+    gate_f = gate.astype(jnp.float32)
+    if arch.mamba_rms_norm:
+        if not arch.norm_before_gate:
+            y = y * jax.nn.silu(gate_f)
+        yg = y.reshape(B, S, G, I // G)
+        var = jnp.mean(yg * yg, axis=-1, keepdims=True)
+        yg = yg * jax.lax.rsqrt(var + arch.rms_norm_eps)
+        y = (yg * lp["norm"].reshape(G, I // G)[None, None]).reshape(B, S, I)
+        if arch.norm_before_gate:
+            y = y * jax.nn.silu(gate_f)
+    else:
+        y = y * jax.nn.silu(gate_f)
+
+    out = y.astype(dt_) @ lp["out_proj"]
+    if arch.projectors_bias:
+        out = out + lp["out_proj_b"]
+    return out, new_conv, new_ssm
+
+
+def attention_layer(arch, lp, x, cos, sin, k_cache, v_cache, position_ids,
+                    attend_to_cache):
+    B, S, _ = x.shape
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    q = x @ lp["q_w"]
+    k = x @ lp["k_w"]
+    v = x @ lp["v_w"]
+    if arch.attention_bias:
+        q, k, v = q + lp["q_b"], k + lp["k_b"], v + lp["v_b"]
+    k = k * jnp.asarray(arch.key_multiplier, k.dtype)
+    q = jnp.swapaxes(q.reshape(B, S, H, D), 1, 2)
+    k = jnp.swapaxes(k.reshape(B, S, KV, D), 1, 2)
+    v = jnp.swapaxes(v.reshape(B, S, KV, D), 1, 2)
+    q, k = apply_rotary_pos_emb(q, k, cos, sin)
+
+    pos = position_ids.astype(jnp.int32)
+    b_idx = jnp.arange(B, dtype=jnp.int32)[:, None]
+    new_k = k_cache.at[b_idx, :, pos].set(
+        jnp.swapaxes(k, 1, 2).astype(k_cache.dtype), mode="drop"
+    )
+    new_v = v_cache.at[b_idx, :, pos].set(
+        jnp.swapaxes(v, 1, 2).astype(v_cache.dtype), mode="drop"
+    )
+    if attend_to_cache:
+        W = new_k.shape[2]
+        kv_pos = jnp.broadcast_to(jnp.arange(W, dtype=jnp.int32)[None, :], (B, W))
+        ctx = attn_ops.attention_with_positions(
+            q, new_k.astype(q.dtype), new_v.astype(q.dtype), pos, kv_pos
+        )
+    else:
+        ctx = attn_ops.attention_with_positions(q, k, v, pos, pos)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    out = ctx @ lp["o_w"]
+    if arch.attention_bias:
+        out = out + lp["o_b"]
+    return out, new_k, new_v
+
+
+def falcon_h1_forward(
+    arch: FalconH1Arch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+    policy=None,
+    layout=None,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    output_all_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+    return_next_inputs: bool = False,
+    **_unused,
+):
+    from nxdi_tpu.config import to_jax_dtype
+
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    dt = to_jax_dtype(arch.dtype)
+    B, S = input_ids.shape
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(dt)
+    hidden = hidden * jnp.asarray(arch.embedding_multiplier, dt)
+    cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq), dtype=jnp.float32)
+
+    if attend_to_cache:
+        valid = jnp.ones((B, S), bool)
+    else:
+        lti = batch["last_token_index"]
+        valid = jnp.arange(S, dtype=jnp.int32)[None, :] <= lti[:, None]
+
+    sids = batch.get("seq_ids")  # continuous batching: row i -> cache line
+    new_k, new_v = cache["k"], cache["v"]
+    new_conv, new_ssm = cache["conv"], cache["ssm"]
+    a_in = jnp.asarray(arch.attention_in_multiplier, dt)
+    a_out = jnp.asarray(arch.attention_out_multiplier, dt)
+    s_out = jnp.asarray(arch.ssm_out_multiplier, dt)
+    for i in range(arch.num_layers):
+        lp = params["layers"][i]
+        h = rms_norm(hidden, lp["input_layernorm"], arch.rms_norm_eps)
+        m_out, c_new, s_new = mamba_mixer(
+            arch, lp["mamba"], h,
+            take_rows(new_conv[i], sids), take_rows(new_ssm[i], sids),
+            valid, attend_to_cache,
+        )
+        new_conv = put_rows(new_conv, i, c_new, sids)
+        new_ssm = put_rows(new_ssm, i, s_new, sids)
+        at_out, k_new, v_new = attention_layer(
+            arch, lp["attn"], h * a_in, cos, sin,
+            take_rows(new_k[i], sids), take_rows(new_v[i], sids),
+            position_ids, attend_to_cache,
+        )
+        new_k = put_rows(new_k, i, k_new, sids)
+        new_v = put_rows(new_v, i, v_new, sids)
+        hidden = hidden + m_out * s_out + at_out * a_out
+        h = rms_norm(hidden, lp["pre_ff_layernorm"], arch.rms_norm_eps)
+        ff = (h @ lp["up_w"]) * jax.nn.silu(
+            (h @ lp["gate_w"]) * jnp.asarray(arch.mlp_gate_multiplier, dt)
+        )
+        hidden = hidden + (ff @ lp["down_w"]) * jnp.asarray(
+            arch.mlp_down_multiplier, dt
+        )
+
+    hidden = rms_norm(hidden, params["norm"], arch.rms_norm_eps)
+    lm_head = params.get("lm_head")
+    if lm_head is None:
+        lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
+    if gather_last_token and not output_all_logits:
+        idx = batch["last_token_index"][:, None, None]
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
+        )
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    logits = logits * arch.lm_head_multiplier
+    logits = sampling_ops.mask_padded_logits(logits, arch.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if on_device_sampling:
+        tokens = sampling_ops.sample(
+            logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )
+        outputs["tokens"] = tokens[:, None]
+    if output_logits or output_all_logits or not on_device_sampling:
+        outputs["logits"] = logits[..., : arch.vocab_size - arch.vocab_pad]
+    return outputs, {"k": new_k, "v": new_v, "conv": new_conv, "ssm": new_ssm}
+
+
+# ---------------------------------------------------------------------------
+# Conversion / specs / struct
+# ---------------------------------------------------------------------------
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    cast = lambda a: np.asarray(a, dtype=dense.np_dtype(arch.dtype))  # noqa: E731
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    def has(name):
+        return name in state_dict or f"model.{name}" in state_dict
+
+    layers = []
+    for i in range(arch.num_layers):
+        p = f"layers.{i}."
+        attn = {
+            "q_w": cast(get(p + "self_attn.q_proj.weight").T),
+            "k_w": cast(get(p + "self_attn.k_proj.weight").T),
+            "v_w": cast(get(p + "self_attn.v_proj.weight").T),
+            "o_w": cast(get(p + "self_attn.o_proj.weight").T),
+        }
+        if arch.attention_bias:
+            attn.update(
+                q_b=cast(get(p + "self_attn.q_proj.bias")),
+                k_b=cast(get(p + "self_attn.k_proj.bias")),
+                v_b=cast(get(p + "self_attn.v_proj.bias")),
+                o_b=cast(get(p + "self_attn.o_proj.bias")),
+            )
+        mamba = {
+            "in_proj": cast(get(p + "mamba.in_proj.weight").T),
+            "conv1d": cast(get(p + "mamba.conv1d.weight")[:, 0, :]),
+            "dt_bias": np.asarray(get(p + "mamba.dt_bias"), np.float32),
+            "A_log": np.asarray(get(p + "mamba.A_log"), np.float32),
+            "D": np.asarray(get(p + "mamba.D"), np.float32),
+            "out_proj": cast(get(p + "mamba.out_proj.weight").T),
+            "mup_vector": _mup_vector(arch),
+        }
+        if arch.proj_bias:
+            mamba["in_proj_b"] = cast(get(p + "mamba.in_proj.bias"))
+        if arch.conv_bias:
+            mamba["conv1d_b"] = cast(get(p + "mamba.conv1d.bias"))
+        if arch.projectors_bias:
+            mamba["out_proj_b"] = cast(get(p + "mamba.out_proj.bias"))
+        if arch.mamba_rms_norm:
+            mamba["norm"] = cast(get(p + "mamba.norm.weight"))
+        layers.append({
+            "input_layernorm": cast(get(p + "input_layernorm.weight")),
+            "pre_ff_layernorm": cast(get(p + "pre_ff_layernorm.weight")),
+            "attn": attn,
+            "mamba": mamba,
+            "gate_w": cast(get(p + "feed_forward.gate_proj.weight").T),
+            "up_w": cast(get(p + "feed_forward.up_proj.weight").T),
+            "down_w": cast(get(p + "feed_forward.down_proj.weight").T),
+        })
+    embed = cast(get("embed_tokens.weight"))
+    if arch.vocab_pad:
+        embed = np.concatenate(
+            [embed, np.zeros((arch.vocab_pad, embed.shape[1]), embed.dtype)], axis=0
+        )
+    params = {
+        "embed_tokens": embed,
+        "norm": cast(get("final_layernorm.weight")),
+        "layers": layers,
+    }
+    if not arch.tie_word_embeddings:
+        head = (
+            cast(np.asarray(state_dict["lm_head.weight"]))
+            if "lm_head.weight" in state_dict
+            else embed[: config.vocab_size]
+        )
+        if arch.vocab_pad and head.shape[0] < arch.vocab_size:
+            head = np.concatenate(
+                [head, np.zeros((arch.vocab_pad, head.shape[1]), head.dtype)], axis=0
+            )
+        params["lm_head"] = head.T
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    arch = build_arch(config)
+    tp = config.tpu_config.tp_degree
+    h_ok = tp > 1 and arch.num_attention_heads % tp == 0
+    kv_ok = h_ok and arch.num_kv_heads % tp == 0
+    i_ok = tp > 1 and arch.intermediate_size % tp == 0
+    col = P(None, AXIS_MP)
+    row = P(AXIS_MP, None)
+
+    layers = []
+    for _ in range(arch.num_layers):
+        attn = {
+            "q_w": col if h_ok else REPLICATED,
+            "k_w": col if kv_ok else REPLICATED,
+            "v_w": col if kv_ok else REPLICATED,
+            "o_w": row if h_ok else REPLICATED,
+        }
+        if arch.attention_bias:
+            attn.update(
+                q_b=P(AXIS_MP) if h_ok else REPLICATED,
+                k_b=P(AXIS_MP) if kv_ok else REPLICATED,
+                v_b=P(AXIS_MP) if kv_ok else REPLICATED,
+                o_b=REPLICATED,
+            )
+        # the mamba mixer's [gate|x|B|C|dt] sections are interleaved across
+        # the in_proj output — stays replicated (like the hybrid families'
+        # conv stacks); attention + MLP + embeddings carry the TP scaling
+        mamba = {k: REPLICATED for k in (
+            "in_proj", "conv1d", "dt_bias", "A_log", "D", "out_proj",
+            "mup_vector",
+        )}
+        if arch.proj_bias:
+            mamba["in_proj_b"] = REPLICATED
+        if arch.conv_bias:
+            mamba["conv1d_b"] = REPLICATED
+        if arch.projectors_bias:
+            mamba["out_proj_b"] = REPLICATED
+        if arch.mamba_rms_norm:
+            mamba["norm"] = REPLICATED
+        layers.append({
+            "input_layernorm": REPLICATED,
+            "pre_ff_layernorm": REPLICATED,
+            "attn": attn,
+            "mamba": mamba,
+            "gate_w": col if i_ok else REPLICATED,
+            "up_w": col if i_ok else REPLICATED,
+            "down_w": row if i_ok else REPLICATED,
+        })
+    specs = {
+        "embed_tokens": P(AXIS_MP, None) if h_ok else REPLICATED,
+        "norm": REPLICATED,
+        "layers": layers,
+    }
+    if not arch.tie_word_embeddings:
+        specs["lm_head"] = P(None, AXIS_MP) if h_ok else REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+
+    def s(*shape, d=dt):
+        return jax.ShapeDtypeStruct(shape, d)
+
+    Hd = arch.hidden_size
+    H, KV, D = arch.num_attention_heads, arch.num_kv_heads, arch.head_dim
+    layers = []
+    for _ in range(arch.num_layers):
+        attn = {
+            "q_w": s(Hd, H * D),
+            "k_w": s(Hd, KV * D),
+            "v_w": s(Hd, KV * D),
+            "o_w": s(H * D, Hd),
+        }
+        if arch.attention_bias:
+            attn.update(q_b=s(H * D), k_b=s(KV * D), v_b=s(KV * D), o_b=s(Hd))
+        mamba = {
+            "in_proj": s(Hd, arch.proj_dim),
+            "conv1d": s(arch.conv_dim, arch.conv_kernel),
+            "dt_bias": s(arch.mamba_heads, d=np.float32),
+            "A_log": s(arch.mamba_heads, d=np.float32),
+            "D": s(arch.mamba_heads, d=np.float32),
+            "out_proj": s(arch.d_ssm, Hd),
+            "mup_vector": s(arch.proj_dim, d=np.float32),
+        }
+        if arch.proj_bias:
+            mamba["in_proj_b"] = s(arch.proj_dim)
+        if arch.conv_bias:
+            mamba["conv1d_b"] = s(arch.conv_dim)
+        if arch.projectors_bias:
+            mamba["out_proj_b"] = s(Hd)
+        if arch.mamba_rms_norm:
+            mamba["norm"] = s(arch.d_ssm)
+        layers.append({
+            "input_layernorm": s(Hd),
+            "pre_ff_layernorm": s(Hd),
+            "attn": attn,
+            "mamba": mamba,
+            "gate_w": s(Hd, arch.intermediate_size),
+            "up_w": s(Hd, arch.intermediate_size),
+            "down_w": s(arch.intermediate_size, Hd),
+        })
+    struct = {
+        "embed_tokens": s(arch.vocab_size, Hd),
+        "norm": s(Hd),
+        "layers": layers,
+    }
+    if not arch.tie_word_embeddings:
+        struct["lm_head"] = s(Hd, arch.vocab_size)
+    return struct
+
+
+# ---------------------------------------------------------------------------
+# Cache + application
+# ---------------------------------------------------------------------------
+
+
+def cache_shapes(arch: FalconH1Arch, batch_size: int, seq_len: int):
+    from nxdi_tpu.config import to_jax_dtype
+
+    dt = to_jax_dtype(arch.dtype)
+    L = arch.num_layers
+    return {
+        "k": ((L, batch_size, arch.num_kv_heads, seq_len, arch.head_dim), dt),
+        "v": ((L, batch_size, arch.num_kv_heads, seq_len, arch.head_dim), dt),
+        "conv": ((L, batch_size, arch.conv_dim, arch.conv_kernel), dt),
+        "ssm": (
+            (L, batch_size, arch.mamba_heads, arch.mamba_head_dim, arch.d_state),
+            jnp.float32,
+        ),
+    }
+
+
+from nxdi_tpu.runtime.application import TpuModelForCausalLM  # noqa: E402
+
+
+class FalconH1ForCausalLM(TpuModelForCausalLM):
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        unsupported = [
+            ("async_mode", tc.async_mode),
+            ("is_prefix_caching", tc.is_prefix_caching),
+            ("is_chunked_prefill", tc.is_chunked_prefill),
+            ("is_block_kv_layout", tc.is_block_kv_layout),
+            ("speculation", tc.speculation_length > 0 or tc.is_medusa),
+            ("tensor_capture_config", tc.tensor_capture_config is not None),
+            # raw-array param layout: the quantizer/LoRA rewrites would no-op
+            ("quantized", tc.quantized),
+            ("lora_config", tc.lora_config is not None),
+        ]
+        bad = [name for name, val in unsupported if val]
+        if bad:
+            raise ValueError(
+                "falcon_h1 does not support: " + ", ".join(bad) + " — the "
+                "Mamba2 recurrence needs dedicated state routing for these "
+                "modes (conv/ssm states are not paged)"
+            )
+
+    def enable_models(self) -> None:
+        super().enable_models()
+        for wrapper in self.models.values():
+            wrapper.forward_fn = falcon_h1_forward
+
+    def _arch(self):
+        return build_arch(self.config)
+
+    def cache_partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        arch = self._arch()
+        tp = self.tpu_config.tp_degree
+        kv = AXIS_MP if (tp > 1 and arch.num_kv_heads % tp == 0) else None
+        return {
+            "k": P(None, None, kv, None, None),
+            "v": P(None, None, kv, None, None),
+            "conv": P(),  # interleaved [x|B|C] sections: stays replicated
+            "ssm": P(),
+        }
+
+    def init_cache_host(self):
+        tc = self.tpu_config
+        return {
+            k: jnp.zeros(shape, dt)
+            for k, (shape, dt) in cache_shapes(
+                self._arch(),
+                tc.kv_cache_batch_size + tc.kv_cache_padding_size,
+                tc.seq_len,
+            ).items()
+        }
+
+    def _cache_struct(self):
+        tc = self.tpu_config
+        shapes = cache_shapes(
+            self._arch(), tc.kv_cache_batch_size + tc.kv_cache_padding_size, tc.seq_len
+        )
+        return {k: jax.ShapeDtypeStruct(shape, dt) for k, (shape, dt) in shapes.items()}
+
+
+APPLICATION_CLS = FalconH1ForCausalLM

@@ -1,0 +1,117 @@
+"""GLM-4 family — sandwich norms + partial INTERLEAVED rope + fused gate_up.
+
+Reference: contrib/models/glm-4-9b-chat-hf. HF Glm4ForCausalLM
+(modeling_glm4.py:53-230):
+  - four rms norms per layer: input, post_self_attn (on the attention
+    output, pre-residual), post_attention (pre-MLP), post_mlp (on the MLP
+    output, pre-residual) — exactly the gemma sandwich ordering, so the
+    names remap onto the shared sandwich keys;
+  - rope over ``head_dim * partial_rotary_factor`` channels with the
+    GPT-J ADJACENT-pair layout (repeat_interleave'd cos/sin);
+  - MLP stores one fused ``gate_up_proj`` ((2I, H)) — gate is the first I
+    rows; q/k/v optionally biased, o_proj not."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.rope import default_inv_freq
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class Glm4InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        if not hasattr(self, "partial_rotary_factor"):
+            self.partial_rotary_factor = 0.5
+        if not hasattr(self, "attention_bias"):
+            self.attention_bias = True
+        super().add_derived_config()
+
+
+def _rotary_dim(config) -> int:
+    head_dim = getattr(config, "head_dim", None) or (
+        config.hidden_size // config.num_attention_heads
+    )
+    return int(head_dim * config.partial_rotary_factor)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        sandwich_norm=True,
+        rope_interleaved=True,
+        rotary_dim=_rotary_dim(config),
+        attention_bias=bool(getattr(config, "attention_bias", True)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return default_inv_freq(
+        _rotary_dim(config), getattr(config, "rope_theta", 10000.0)
+    )
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    def ff(get, has, cast, pre):
+        gu = get(pre + "mlp.gate_up_proj.weight")  # (2I, H); gate first
+        I = gu.shape[0] // 2
+        return "mlp", {
+            "gate_proj": {"w": cast(gu[:I].T)},
+            "up_proj": {"w": cast(gu[I:].T)},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T)},
+        }
+
+    # remap glm4's norm names onto the shared sandwich keys BEFORE the dense
+    # converter reads them: post_self_attn -> post_attention (attn-out norm)
+    sd = dict(state_dict)
+    L = arch.num_layers
+    for i in range(L):
+        for a, b in ((f"layers.{i}.post_self_attn_layernorm.weight",
+                      f"layers.{i}.post_attention_layernorm.weight"),):
+            for pre in ("", "model."):
+                if pre + a in state_dict:
+                    sd[pre + b] = state_dict[pre + a]
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    params["layers"]["pre_feedforward_layernorm"] = np.stack(
+        [np.asarray(src(f"layers.{i}.post_attention_layernorm.weight"), dt)
+         for i in range(L)]
+    )
+    params["layers"]["post_feedforward_layernorm"] = np.stack(
+        [np.asarray(src(f"layers.{i}.post_mlp_layernorm.weight"), dt)
+         for i in range(L)]
+    )
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["pre_feedforward_layernorm"] = REPLICATED
+    specs["layers"]["post_feedforward_layernorm"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+    struct["layers"]["pre_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
+    struct["layers"]["post_feedforward_layernorm"] = jax.ShapeDtypeStruct((L, H), dt)
+    return struct

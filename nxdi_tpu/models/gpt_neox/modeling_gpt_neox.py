@@ -1,0 +1,159 @@
+"""GPT-NeoX (Pythia) family — parallel residual, fused per-head QKV, partial
+rotary, biased LayerNorms, non-gated gelu MLP.
+
+Reference: contrib/models/pythia-2.8b. HF GPTNeoXForCausalLM
+(modeling_gpt_neox.py:129-250):
+  - ``use_parallel_residual`` (default True): x + attn(ln1(x)) + mlp(ln2(x))
+    (``parallel_block``); False falls back to the sequential ordering;
+  - ``query_key_value`` packs per-head [q|k|v] blocks — de-interleaved at
+    conversion into the separate projections;
+  - rope over ``head_dim * rotary_pct`` channels (standard rotate-half);
+  - biased LayerNorms ({"w","b"} dicts), ``final_layer_norm``, ``embed_in``
+    embeddings and an ``embed_out`` head."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.rope import default_inv_freq
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class GPTNeoXInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        self.rms_norm_eps = getattr(self, "layer_norm_eps", 1e-5)
+        # NeoX is strictly MHA — ignore any stray num_key_value_heads
+        self.num_key_value_heads = self.num_attention_heads
+        if not hasattr(self, "rotary_pct"):
+            self.rotary_pct = 0.25
+        if not hasattr(self, "use_parallel_residual"):
+            self.use_parallel_residual = True
+        if not hasattr(self, "hidden_act"):
+            self.hidden_act = "gelu"
+        super().add_derived_config()
+
+
+def _rotary_dim(config) -> int:
+    head_dim = config.hidden_size // config.num_attention_heads
+    return int(head_dim * config.rotary_pct)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    bias = bool(getattr(config, "attention_bias", True))
+    kwargs = dict(
+        parallel_block=bool(getattr(config, "use_parallel_residual", True)),
+        layernorm=True,
+        gated_mlp=False,
+        attention_bias=bias,
+        attention_o_bias=bias,
+        mlp_bias=True,
+        rotary_dim=_rotary_dim(config),
+        hidden_act=getattr(config, "hidden_act", "gelu"),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    theta = getattr(config, "rope_theta", None) or getattr(
+        config, "rotary_emb_base", 10000.0
+    )
+    return default_inv_freq(_rotary_dim(config), float(theta))
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    H = config.num_attention_heads
+    D = config.hidden_size // H
+    hid = config.hidden_size
+
+    def src(name):
+        for k in (name, f"gpt_neox.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    # remap the NeoX layout into the dense (llama) key space
+    sd = {}
+    for i in range(arch.num_layers):
+        p = f"layers.{i}."
+        d = f"layers.{i}."
+        qkv_w = src(p + "attention.query_key_value.weight").reshape(H, 3, D, hid)
+        sd[d + "self_attn.q_proj.weight"] = qkv_w[:, 0].reshape(H * D, hid)
+        sd[d + "self_attn.k_proj.weight"] = qkv_w[:, 1].reshape(H * D, hid)
+        sd[d + "self_attn.v_proj.weight"] = qkv_w[:, 2].reshape(H * D, hid)
+        if arch.attention_bias:
+            qkv_b = src(p + "attention.query_key_value.bias").reshape(H, 3, D)
+            sd[d + "self_attn.q_proj.bias"] = qkv_b[:, 0].reshape(-1)
+            sd[d + "self_attn.k_proj.bias"] = qkv_b[:, 1].reshape(-1)
+            sd[d + "self_attn.v_proj.bias"] = qkv_b[:, 2].reshape(-1)
+            sd[d + "self_attn.o_proj.bias"] = src(p + "attention.dense.bias")
+        sd[d + "self_attn.o_proj.weight"] = src(p + "attention.dense.weight")
+        sd[d + "mlp.up_proj.weight"] = src(p + "mlp.dense_h_to_4h.weight")
+        sd[d + "mlp.up_proj.bias"] = src(p + "mlp.dense_h_to_4h.bias")
+        sd[d + "mlp.down_proj.weight"] = src(p + "mlp.dense_4h_to_h.weight")
+        sd[d + "mlp.down_proj.bias"] = src(p + "mlp.dense_4h_to_h.bias")
+        sd[d + "input_layernorm.weight"] = src(p + "input_layernorm.weight")
+        sd[d + "post_attention_layernorm.weight"] = src(
+            p + "post_attention_layernorm.weight"
+        )
+    sd["embed_tokens.weight"] = src("embed_in.weight")
+    sd["norm.weight"] = src("final_layer_norm.weight")
+    if "embed_out.weight" in state_dict:
+        sd["lm_head.weight"] = np.asarray(state_dict["embed_out.weight"])
+
+    def ff(get, has, cast, pre):
+        return "mlp", {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T),
+                        "b": cast(get(pre + "mlp.up_proj.bias"))},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T),
+                          "b": cast(get(pre + "mlp.down_proj.bias"))},
+        }
+
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+    for key, hf in (("input_layernorm", "input_layernorm"),
+                    ("post_attention_layernorm", "post_attention_layernorm")):
+        params["layers"][key] = {
+            "w": params["layers"][key],
+            "b": np.stack(
+                [np.asarray(src(f"layers.{i}.{hf}.bias"), dt) for i in range(L)]
+            ),
+        }
+    params["norm"] = {
+        "w": params["norm"], "b": np.asarray(src("final_layer_norm.bias"), dt)
+    }
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(build_arch(config))
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    return struct

@@ -1,0 +1,37 @@
+"""Helium (Kyutai) family — llama with the GPT-J interleaved-pair rope.
+
+Reference: contrib/models/helium-1-2b. HF HeliumForCausalLM
+(modeling_helium.py:154-189): GLM/GPT-J INTERLEAVED-pair rope over the full
+head dim (repeat_interleave'd cos/sin, adjacent (2i, 2i+1) channel pairs);
+everything else is the llama standard (optional q/k/v biases, o_proj
+without)."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class HeliumInferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(rope_interleaved=True)
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -1,0 +1,80 @@
+"""OLMo-3 family — olmo2 (post-block norms, flat qk rmsnorm) + interleaved
+sliding-window layers with DUAL rope tables.
+
+Reference: contrib/models/OLMo-3-7B-Think. HF Olmo3ForCausalLM
+(modeling_olmo3.py:148-420): ``layer_types`` marks sliding layers; sliding
+layers use the DEFAULT (unscaled) frequency table while full-attention
+layers use the rope_scaling'd one (two RotaryEmbedding instances, :351-356).
+The stacked (2, D/2) [global, local] inv_freq + per-layer ``use_local_rope``
+flag is the shared gemma3 machinery."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.models.olmo2 import modeling_olmo2 as olmo2
+from nxdi_tpu.ops.rope import default_inv_freq
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class Olmo3InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        super().add_derived_config()
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            self.layer_types = ["full_attention"] * self.num_hidden_layers
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(sliding_window=getattr(config, "sliding_window", None))
+    kwargs.update(overrides)
+    return olmo2.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    full = dense.build_inv_freq(config)  # rope_scaling'd table
+    if not getattr(config, "sliding_window", None):
+        return full
+    local = default_inv_freq(
+        dense.head_dim_of(config), getattr(config, "rope_theta", 10000.0)
+    )
+    return np.stack([np.asarray(full), local])  # [global, local]
+
+
+def _sliding_flags(config):
+    return np.array(
+        [t == "sliding_attention" for t in config.layer_types], dtype=bool
+    )
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    params = olmo2.convert_hf_state_dict(state_dict, config)
+    if getattr(config, "sliding_window", None):
+        sliding = _sliding_flags(config)
+        params["layers"]["use_sliding_window"] = sliding
+        params["layers"]["use_local_rope"] = sliding  # default table on SWA
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = olmo2.param_specs(config)
+    if getattr(config, "sliding_window", None):
+        specs["layers"]["use_sliding_window"] = REPLICATED
+        specs["layers"]["use_local_rope"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = olmo2.param_shape_struct(config)
+    if getattr(config, "sliding_window", None):
+        L = config.num_hidden_layers
+        struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+        struct["layers"]["use_local_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return struct

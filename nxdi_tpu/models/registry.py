@@ -67,6 +67,50 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "nxdi_tpu.models.qwen2_5_omni.modeling_qwen2_5_omni",
         "Qwen2_5OmniInferenceConfig",
     ),
+    "falcon_h1": (
+        "nxdi_tpu.models.falcon_h1.modeling_falcon_h1",
+        "FalconH1InferenceConfig",
+    ),
+    "ernie4_5": (
+        "nxdi_tpu.models.ernie4_5.modeling_ernie4_5",
+        "Ernie4_5InferenceConfig",
+    ),
+    "seed_oss": (
+        "nxdi_tpu.models.seed_oss.modeling_seed_oss",
+        "SeedOssInferenceConfig",
+    ),
+    "helium": (
+        "nxdi_tpu.models.helium.modeling_helium",
+        "HeliumInferenceConfig",
+    ),
+    "starcoder2": (
+        "nxdi_tpu.models.starcoder2.modeling_starcoder2",
+        "Starcoder2InferenceConfig",
+    ),
+    "stablelm": (
+        "nxdi_tpu.models.stablelm.modeling_stablelm",
+        "StableLmInferenceConfig",
+    ),
+    "glm4": (
+        "nxdi_tpu.models.glm4.modeling_glm4",
+        "Glm4InferenceConfig",
+    ),
+    "exaone4": (
+        "nxdi_tpu.models.exaone4.modeling_exaone4",
+        "Exaone4InferenceConfig",
+    ),
+    "olmo3": (
+        "nxdi_tpu.models.olmo3.modeling_olmo3",
+        "Olmo3InferenceConfig",
+    ),
+    "cohere2": (
+        "nxdi_tpu.models.cohere2.modeling_cohere2",
+        "Cohere2InferenceConfig",
+    ),
+    "gpt_neox": (
+        "nxdi_tpu.models.gpt_neox.modeling_gpt_neox",
+        "GPTNeoXInferenceConfig",
+    ),
 }
 
 

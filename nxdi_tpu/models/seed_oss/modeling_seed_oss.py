@@ -1,0 +1,45 @@
+"""Seed-OSS family — llama with q/k/v biases, a separate o-bias switch, and
+an explicit ``head_dim``.
+
+Reference: contrib/models/Seed-OSS-36B-Instruct. HF SeedOssForCausalLM
+(modeling_seed_oss.py:158-231): q/k/v carry ``attention_bias``, o_proj
+carries ``attention_out_bias``; rope and norms are the llama standard."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class SeedOssInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        super().add_derived_config()
+        for k, v in (("attention_bias", True), ("attention_out_bias", False),
+                     ("mlp_bias", False)):
+            if not hasattr(self, k) or getattr(self, k) is None:
+                setattr(self, k, v)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        attention_bias=bool(getattr(config, "attention_bias", True)),
+        attention_o_bias=bool(getattr(config, "attention_out_bias", False)),
+        mlp_bias=bool(getattr(config, "mlp_bias", False)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

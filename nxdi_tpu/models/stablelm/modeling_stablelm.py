@@ -1,0 +1,108 @@
+"""StableLM-2 family — partial rotary + biased LayerNorms + gated silu MLP.
+
+Reference: contrib/models/stablelm-2-1_6b. HF StableLmForCausalLM
+(modeling_stablelm.py:100-540): rotary over ``head_dim *
+partial_rotary_factor`` channels, biased ``nn.LayerNorm`` (layer_norm_eps),
+optional q/k/v biases (``use_qkv_bias``), o_proj without bias. The
+per-head qk-LayerNorm and parallel-residual variants are rejected loudly."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.rope import default_inv_freq
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class StableLmInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        self.rms_norm_eps = getattr(self, "layer_norm_eps", 1e-5)
+        if not hasattr(self, "partial_rotary_factor"):
+            self.partial_rotary_factor = 0.25
+        if not hasattr(self, "use_qkv_bias"):
+            self.use_qkv_bias = False
+        super().add_derived_config()
+        if getattr(self, "qk_layernorm", False):
+            raise NotImplementedError(
+                "stablelm per-head qk LayerNorm is not supported yet"
+            )
+        if getattr(self, "use_parallel_residual", False):
+            raise NotImplementedError(
+                "stablelm parallel residual is not supported yet"
+            )
+
+
+def _rotary_dim(config) -> int:
+    head_dim = config.hidden_size // config.num_attention_heads
+    return int(head_dim * config.partial_rotary_factor)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        layernorm=True,
+        attention_bias=bool(getattr(config, "use_qkv_bias", False)),
+        rotary_dim=_rotary_dim(config),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return default_inv_freq(
+        _rotary_dim(config), getattr(config, "rope_theta", 10000.0)
+    )
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch)
+    L = arch.num_layers
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        params["layers"][key] = {
+            "w": params["layers"][key],
+            "b": np.stack(
+                [np.asarray(src(f"layers.{i}.{key}.bias"), dt) for i in range(L)]
+            ),
+        }
+    params["norm"] = {"w": params["norm"], "b": np.asarray(src("norm.bias"), dt)}
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(build_arch(config))
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    return struct

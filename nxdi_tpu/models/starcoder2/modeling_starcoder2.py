@@ -1,0 +1,110 @@
+"""StarCoder2 family — rope + biased LayerNorms + plain (non-gated) gelu MLP.
+
+Reference: contrib/models/starcoder2-3b. HF Starcoder2ForCausalLM
+(modeling_starcoder2.py:57-217): ``use_bias`` on every projection, biased
+``nn.LayerNorm`` norms (``norm_epsilon``), ``mlp.c_fc``/``mlp.c_proj``
+non-gated MLP with gelu_pytorch_tanh, rope, tied embeddings, optional
+uniform sliding window."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class Starcoder2InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        self.rms_norm_eps = getattr(self, "norm_epsilon", 1e-5)
+        if not hasattr(self, "use_bias"):
+            self.use_bias = True
+        if not hasattr(self, "tie_word_embeddings"):
+            self.tie_word_embeddings = True
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    bias = bool(getattr(config, "use_bias", True))
+    kwargs = dict(
+        layernorm=True,
+        gated_mlp=False,
+        attention_bias=bias,
+        attention_o_bias=bias,
+        mlp_bias=bias,
+        sliding_window=getattr(config, "sliding_window", None),
+        hidden_act=getattr(config, "hidden_act", "gelu_pytorch_tanh"),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    def ff(get, has, cast, pre):
+        mlp = {
+            "up_proj": {"w": cast(get(pre + "mlp.c_fc.weight").T),
+                        "b": cast(get(pre + "mlp.c_fc.bias"))},
+            "down_proj": {"w": cast(get(pre + "mlp.c_proj.weight").T),
+                          "b": cast(get(pre + "mlp.c_proj.bias"))},
+        }
+        if not arch.mlp_bias:
+            for p in mlp.values():
+                p.pop("b", None)
+        return "mlp", mlp
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+    L = arch.num_layers
+    # biased LayerNorms -> {"w","b"} dicts (the gpt2-lineage convention)
+    for key, hf in (("input_layernorm", "input_layernorm"),
+                    ("post_attention_layernorm", "post_attention_layernorm")):
+        params["layers"][key] = {
+            "w": params["layers"][key],
+            "b": np.stack(
+                [np.asarray(src(f"layers.{i}.{hf}.bias"), dt) for i in range(L)]
+            ),
+        }
+    params["norm"] = {"w": params["norm"], "b": np.asarray(src("norm.bias"), dt)}
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(build_arch(config))
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    return struct

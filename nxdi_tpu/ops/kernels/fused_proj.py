@@ -51,6 +51,16 @@ def _pick_block(s: int, target: int) -> int:
     return max(b, 1)
 
 
+def _mlp_block_i(i_dim: int, h: int, target: int) -> int:
+    """Intermediate-dim tile clamped to the VMEM budget: each grid step
+    streams gate+up (H, bi) and down (bi, H) double-buffered — 12*H*bi bytes
+    in flight (bf16). Keep that under ~10 MB of the ~16 MB scoped VMEM."""
+    bi = _pick_block(i_dim, target)
+    while bi > 128 and 12 * h * bi > 10 * 1024 * 1024:
+        bi //= 2
+    return bi
+
+
 _KERNEL_ACTS = ("silu", "gelu", "gelu_pytorch_tanh", "gelu_new", "relu")
 
 
@@ -112,7 +122,7 @@ def fused_mlp(
     M, H = x.shape
     I = gate_w.shape[1]
     bm = _pick_block(M, block_m)
-    bi = _pick_block(I, block_i)
+    bi = _mlp_block_i(I, H, block_i)
     n_m, n_i = M // bm, I // bi
     kernel = functools.partial(_fused_mlp_kernel, act=act, n_i=n_i)
     return pl.pallas_call(
@@ -218,7 +228,7 @@ def fused_mlp_stacked(
     M, H = x.shape
     I = gate_s.shape[2]
     bm = _pick_block(M, block_m)
-    bi = _pick_block(I, block_i)
+    bi = _mlp_block_i(I, H, block_i)
     n_m, n_i = M // bm, I // bi
     kernel = functools.partial(_fused_mlp_stacked_kernel, act=act, n_i=n_i)
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -290,48 +300,41 @@ def _qkv_stacked_kernel(l_ref, x_ref, w_ref, o_ref):
     o_ref[...] = y.astype(o_ref.dtype)
 
 
-def _qkv_stacked_bias_kernel(l_ref, x_ref, w_ref, b_ref, o_ref):
-    del l_ref
-    y = jnp.dot(x_ref[...], w_ref[0], preferred_element_type=jnp.float32)
-    o_ref[...] = (y + b_ref[0].astype(jnp.float32)).astype(o_ref.dtype)
-
-
 def qkv_matmul_stacked(
     x: jax.Array,  # (M, H_in)
     w_s: jax.Array,  # (L, H_in, T)
     layer_idx: jax.Array,  # (1,) int32
-    b_s: Optional[jax.Array] = None,  # (L, T)
+    b_s: Optional[jax.Array] = None,  # (L, T) — added OUTSIDE the kernel
     *,
     block_m: int = 256,
     block_n: int = 512,
 ) -> jax.Array:
+    # bias stays out of the pallas operands: Mosaic rejects packed bf16
+    # bias layouts, and XLA fuses the add into the kernel's output for free
     M, H = x.shape
     T = w_s.shape[2]
     bm = _pick_block(M, block_m)
     bn = _pick_block(T, block_n)
-    in_specs = [
-        pl.BlockSpec((bm, H), lambda m, n, l_ref: (m, 0)),
-        pl.BlockSpec((1, H, bn), lambda m, n, l_ref: (l_ref[0], 0, n)),
-    ]
-    args = [x, w_s]
-    if b_s is not None:
-        in_specs.append(pl.BlockSpec((1, bn), lambda m, n, l_ref: (l_ref[0], n)))
-        args.append(b_s)
-        kernel = _qkv_stacked_bias_kernel
-    else:
-        kernel = _qkv_stacked_kernel
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(M // bm, T // bn),
-        in_specs=in_specs,
+        in_specs=[
+            pl.BlockSpec((bm, H), lambda m, n, l_ref: (m, 0)),
+            pl.BlockSpec((1, H, bn), lambda m, n, l_ref: (l_ref[0], 0, n)),
+        ],
         out_specs=pl.BlockSpec((bm, bn), lambda m, n, l_ref: (m, n)),
     )
-    return pl.pallas_call(
-        kernel,
+    out = pl.pallas_call(
+        _qkv_stacked_kernel,
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((M, T), x.dtype),
         interpret=_interpret(),
-    )(layer_idx.astype(jnp.int32), *args)
+    )(layer_idx.astype(jnp.int32), x, w_s)
+    if b_s is not None:
+        out = out + jnp.take(
+            b_s, layer_idx.reshape(()).astype(jnp.int32), axis=0, mode="clip"
+        ).astype(out.dtype)
+    return out
 
 
 def sharded_qkv_stacked_call(
@@ -380,46 +383,39 @@ def qkv_matmul_supported(m: int, h_in: int, t_local: int) -> bool:
     return h_in % 128 == 0 and t_local % 128 == 0
 
 
-def _matmul_bias_kernel(x_ref, w_ref, b_ref, o_ref):
+def _matmul_kernel(x_ref, w_ref, o_ref):
     y = jnp.dot(x_ref[...], w_ref[...], preferred_element_type=jnp.float32)
-    if b_ref is not None:
-        y = y + b_ref[...].astype(jnp.float32)
     o_ref[...] = y.astype(o_ref.dtype)
 
 
 def qkv_matmul(
     x: jax.Array,  # (M, H_in)
     w: jax.Array,  # (H_in, T)
-    b: Optional[jax.Array] = None,  # (T,)
+    b: Optional[jax.Array] = None,  # (T,) — added OUTSIDE the kernel
     *,
     block_m: int = 256,
     block_n: int = 512,
 ) -> jax.Array:
+    # bias stays out of the pallas operands: Mosaic rejects packed bf16
+    # bias layouts, and XLA fuses the add into the kernel's output for free
     M, H = x.shape
     T = w.shape[1]
     bm = _pick_block(M, block_m)
     bn = _pick_block(T, block_n)
-    in_specs = [
-        pl.BlockSpec((bm, H), lambda m, n: (m, 0)),
-        pl.BlockSpec((H, bn), lambda m, n: (0, n)),
-    ]
-    args = [x, w]
-    if b is not None:
-        in_specs.append(pl.BlockSpec((bn,), lambda m, n: (n,)))
-        args.append(b)
-        kernel = _matmul_bias_kernel
-    else:
-        kernel = lambda x_ref, w_ref, o_ref: _matmul_bias_kernel(  # noqa: E731
-            x_ref, w_ref, None, o_ref
-        )
-    return pl.pallas_call(
-        kernel,
+    out = pl.pallas_call(
+        _matmul_kernel,
         grid=(M // bm, T // bn),
-        in_specs=in_specs,
+        in_specs=[
+            pl.BlockSpec((bm, H), lambda m, n: (m, 0)),
+            pl.BlockSpec((H, bn), lambda m, n: (0, n)),
+        ],
         out_specs=pl.BlockSpec((bm, bn), lambda m, n: (m, n)),
         out_shape=jax.ShapeDtypeStruct((M, T), x.dtype),
         interpret=_interpret(),
-    )(*args)
+    )(x, w)
+    if b is not None:
+        out = out + b.astype(out.dtype)
+    return out
 
 
 def sharded_qkv_call(

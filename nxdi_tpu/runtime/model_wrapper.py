@@ -51,6 +51,19 @@ def kv_layout_from_config(tc, arch=None):
         # tuples (hashable); kv_cache.py selects the active layer's row via
         # the in-scan layer index (reference: PER_KEY/PER_CHANNEL scale
         # ParameterLists, kv_cache_manager.py:642-667)
+        if arch is not None:
+            want = (
+                (arch.num_layers, arch.num_kv_heads)
+                if kvq.scale_mode == "per_key"
+                else (arch.num_layers, arch.head_dim)
+            )
+            for name, arr in (("k_scales", kvq.k_scales), ("v_scales", kvq.v_scales)):
+                if tuple(arr.shape) != want:
+                    raise ValueError(
+                        f"kv quant {name} shape {tuple(arr.shape)} does not "
+                        f"match this model's {kvq.scale_mode} shape {want} — "
+                        "recalibrate (kvcache.calibration) for this model"
+                    )
         scales = {
             "k_scales": tuple(map(tuple, kvq.k_scales.tolist())),
             "v_scales": tuple(map(tuple, kvq.v_scales.tolist())),

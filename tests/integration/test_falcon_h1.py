@@ -1,0 +1,158 @@
+"""Falcon-H1 token matching vs HF CPU — parallel attention + Mamba2 hybrid
+(reference: contrib/models/Falcon-H1-0.5B-Instruct). Exercises the sequential
+SSD recurrence vs HF's chunked prefill, the muP multiplier wiring, and
+continuous batching over the seq-id-routed conv/ssm states."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.falcon_h1 import modeling_falcon_h1 as fh
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+
+@pytest.fixture(params=[False, True], ids=["silu_gate", "gated_rmsnorm"])
+def tiny_hf_falcon_h1(request):
+    from transformers import FalconH1Config, FalconH1ForCausalLM
+
+    torch.manual_seed(0)
+    cfg = FalconH1Config(
+        vocab_size=256,
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=256,
+        mamba_d_ssm=64,
+        mamba_n_heads=4,
+        mamba_n_groups=2,
+        mamba_d_state=16,
+        mamba_d_conv=4,
+        mamba_chunk_size=8,
+        mamba_rms_norm=request.param,
+        tie_word_embeddings=False,
+        # non-trivial muP multipliers: wiring mistakes change tokens
+        embedding_multiplier=1.25,
+        lm_head_multiplier=0.75,
+        key_multiplier=0.9,
+        attention_in_multiplier=1.1,
+        attention_out_multiplier=0.8,
+        ssm_in_multiplier=1.2,
+        ssm_out_multiplier=0.7,
+        mlp_multipliers=[1.3, 0.6],
+        ssm_multipliers=[1.1, 0.9, 1.2, 0.8, 1.05],
+        pad_token_id=None,
+        eos_token_id=None,
+        bos_token_id=None,
+    )
+    return FalconH1ForCausalLM(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = fh.FalconH1InferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(fh.FalconH1ForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=fh)
+    app.load()
+    return app
+
+
+PROMPT = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+
+
+@pytest.mark.parametrize("tp_degree", [1, 2])
+def test_falcon_h1_greedy_token_matching(tiny_hf_falcon_h1, tp_degree):
+    hf_model, hf_cfg = tiny_hf_falcon_h1
+    app = _build_app(hf_model, hf_cfg, tp_degree=tp_degree)
+    expected = hf_greedy(hf_model, PROMPT, max_new_tokens=16)
+    actual = HuggingFaceGenerationAdapter(app).generate(PROMPT, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_falcon_h1_padded_batch_state_isolation(tiny_hf_falcon_h1):
+    """Right-padded rows must not pollute the SSM/conv states."""
+    hf_model, hf_cfg = tiny_hf_falcon_h1
+    app = _build_app(hf_model, hf_cfg, batch_size=2)
+    p0 = [5, 9, 3, 17, 2, 8, 11, 42]
+    p1 = [7, 13, 21, 4]
+    prompt = np.zeros((2, 8), dtype=np.int64)
+    prompt[0] = p0
+    prompt[1, :4] = p1
+    mask = (prompt != 0).astype(np.int32)
+    out = HuggingFaceGenerationAdapter(app).generate(
+        prompt, attention_mask=mask, max_new_tokens=8
+    )
+    e0 = hf_greedy(hf_model, np.array([p0]), 8)
+    e1 = hf_greedy(hf_model, np.array([p1]), 8)
+    np.testing.assert_array_equal(out[0, : e0.shape[1]], e0[0])
+    np.testing.assert_array_equal(out[1, 4:12], e1[0, 4:])
+
+
+def test_falcon_h1_continuous_batching(tiny_hf_falcon_h1):
+    """Seq-id-routed conv/ssm state: interleaved prefills into shuffled cache
+    lines keep both streams exact (models/state_routing.py)."""
+    hf_model, hf_cfg = tiny_hf_falcon_h1
+    app = _build_app(
+        hf_model, hf_cfg,
+        batch_size=2, is_continuous_batching=True,
+        ctx_batch_size=1, tkg_batch_size=2, kv_cache_batch_size=2,
+    )
+    p0 = [5, 9, 3, 17, 2, 8, 11, 42]
+    p1 = [7, 13, 21, 4, 33]
+    e0 = hf_greedy(hf_model, np.array([p0]), 10)[0, len(p0):]
+    e1 = hf_greedy(hf_model, np.array([p1]), 10)[0, len(p1):]
+
+    def prefill(prompt, sid):
+        ids = np.asarray([prompt], np.int32)
+        pos = np.arange(len(prompt), dtype=np.int32)[None, :]
+        out = app.forward(
+            ids, pos, last_token_index=np.array([len(prompt) - 1], np.int32),
+            seq_ids=np.array([sid], np.int32),
+        )
+        return int(np.asarray(out["tokens"])[0, 0])
+
+    got0 = [prefill(p0, 1)]  # shuffled: row 0 -> line 1
+    pos0 = len(p0)
+    for _ in range(3):
+        out = app.forward(
+            np.array([[got0[-1]]], np.int32), np.array([[pos0]], np.int32),
+            seq_ids=np.array([1], np.int32),
+        )
+        got0.append(int(np.asarray(out["tokens"])[0, 0]))
+        pos0 += 1
+    got1 = [prefill(p1, 0)]
+    pos1 = len(p1)
+    while len(got0) < 10:
+        out = app.forward(
+            np.array([[got0[-1]], [got1[-1]]], np.int32),
+            np.array([[pos0], [pos1]], np.int32),
+            seq_ids=np.array([1, 0], np.int32),
+        )
+        toks = np.asarray(out["tokens"])[:, 0]
+        got0.append(int(toks[0]))
+        got1.append(int(toks[1]))
+        pos0 += 1
+        pos1 += 1
+    np.testing.assert_array_equal(np.array(got0), e0[: len(got0)])
+    np.testing.assert_array_equal(np.array(got1), e1[: len(got1)])

@@ -1,4 +1,4 @@
-"""Fused MLP / fused-QKV Pallas kernels (ops/kernels/fused_mlp.py) vs plain
+"""Fused MLP / fused-QKV Pallas kernels (ops/kernels/fused_proj.py) vs plain
 jnp math — interpret mode on CPU; Mosaic correctness is covered by
 tests/tpu/test_mosaic_kernels_r4.py on hardware.
 
