@@ -160,10 +160,17 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--quantization-dtype", default="int8")
     p.add_argument("--kv-cache-quant", action="store_true")
     p.add_argument("--kv-scale-mode", default="direct_cast",
-                   choices=["direct_cast", "per_tensor"],
-                   help="fp8 KV store: raw cast or scaled by --k-scale/--v-scale")
+                   choices=["direct_cast", "per_tensor", "per_key", "per_channel"],
+                   help="fp8/int8 KV store: raw cast, scalar scales, or "
+                        "per-layer per-key/per-channel scale buffers "
+                        "(--kv-scales-path)")
     p.add_argument("--k-scale", type=float, default=1.0)
     p.add_argument("--v-scale", type=float, default=1.0)
+    p.add_argument("--kv-quant-dtype", default="float8_e4m3",
+                   help="KV store dtype (float8_e4m3 | float8_e5m2 | int8)")
+    p.add_argument("--kv-scales-path", default=None,
+                   help=".npz from kvcache.calibration.calibrate_kv_scales "
+                        "(required for per_key/per_channel)")
 
     # accuracy / benchmark
     p.add_argument("--check-accuracy-mode", default="skip", choices=CHECK_ACCURACY_MODES)
@@ -263,9 +270,20 @@ def create_tpu_config(args):
         quantization_dtype=args.quantization_dtype,
         kv_cache_quant=args.kv_cache_quant,
         kv_quant_config=(
-            {"scale_mode": args.kv_scale_mode, "k_scale": args.k_scale,
-             "v_scale": args.v_scale}
-            if args.kv_cache_quant and args.kv_scale_mode == "per_tensor"
+            (
+                {"dtype": args.kv_quant_dtype,
+                 "scale_mode": args.kv_scale_mode,
+                 "scales_path": args.kv_scales_path}
+                if args.kv_scale_mode in ("per_key", "per_channel")
+                else {"dtype": args.kv_quant_dtype,
+                      "scale_mode": args.kv_scale_mode,
+                      "k_scale": args.k_scale, "v_scale": args.v_scale}
+                if args.kv_scale_mode == "per_tensor"
+                # direct_cast still honors --kv-quant-dtype (fp8/int8 store)
+                else {"dtype": args.kv_quant_dtype,
+                      "scale_mode": "direct_cast"}
+            )
+            if args.kv_cache_quant
             else None
         ),
         token_tree_config=(

@@ -390,6 +390,7 @@ def attention_block(
     qkv_stacked=None,  # (w_s (L,H,T), b_s|None) + stacked_layer_idx: in-scan kernel
     layer_idx=None,  # GLOBAL layer index (per-layer KV-quant scale rows)
     stacked_layer_idx=None,  # segment-local index into the stacked weights
+    tkg_stacked=None,  # (k_s, v_s, kv_len): stacked-cache fused decode kernel
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
@@ -565,6 +566,33 @@ def attention_block(
             v_att = (clip(v / vs, store).astype(store).astype(v.dtype) * vs).astype(v.dtype)
         else:
             k_att, v_att = k, v
+        # STACKED fused TKG kernel (round-4): reads the OLD cache straight
+        # from the (L, B, KV, S, D) stack via a scalar-prefetched layer
+        # index — no per-layer cache slice ever materializes for the pallas
+        # operand (the tax that made the per-layer kernel lose in round 3)
+        if (
+            tkg_stacked is not None
+            and S == 1
+            and stacked_layer_idx is not None
+            and window_enabled is None
+            and use_rope is None
+            and ci.get("write_positions") is None
+        ):
+            k_s, v_s, kv_len_s = tkg_stacked
+            ctx = attn_kernels.sharded_fused_decode_stacked_call(
+                policy, q, k_s, v_s, k, v, position_ids, stacked_layer_idx,
+                scale=arch.attention_scale,
+                sliding_window=arch.sliding_window,
+                chunk_size=arch.chunk_size,
+                kv_len=kv_len_s,
+            )
+            if ctx is not None:
+                _record_strategy("tkg_fused_kernel_stacked")
+                ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
+                out = _linear(
+                    ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
+                )
+                return out, (k, v)
         # fused TKG kernel: strict-causal online softmax over the old cache
         # merged with the fresh row in ONE pallas pass — the kernel that
         # COMPOSES with deferred writes (reference: fused TKG kernels,
@@ -855,6 +883,7 @@ def decoder_layer(
     qkv_stacked=None,
     layer_idx=None,  # GLOBAL layer index (per-layer KV-quant scale rows)
     stacked_layer_idx=None,  # segment-local index into the stacked weights
+    tkg_stacked=None,  # (k_s, v_s, kv_len): stacked-cache fused decode kernel
 ):
     if stacked_layer_idx is None:
         stacked_layer_idx = layer_idx
@@ -881,6 +910,7 @@ def decoder_layer(
         extra["qkv_stacked"] = qkv_stacked
         extra["layer_idx"] = layer_idx
         extra["stacked_layer_idx"] = stacked_layer_idx
+        extra["tkg_stacked"] = tkg_stacked
     attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
@@ -929,6 +959,7 @@ def decoder_layer(
 def _pipelined_decoder_layers(
     arch, layer_params, hidden, cos, sin, cache, position_ids, step_fn,
     cache_inputs, adapter_ids, defer=False, policy=DEFAULT_POLICY,
+    collect_hidden=False,
 ):
     """GPipe-style pipeline over the ``pp`` mesh axis.
 
@@ -977,12 +1008,12 @@ def _pipelined_decoder_layers(
                 h, nk, nv = step_fn(
                     h, lp, kl, vl, cos_m, sin_m, pos_m, ci_m, ad_m, defer_=defer
                 )
-                return h, (nk, nv)
+                return h, ((nk, nv, h) if collect_hidden else (nk, nv))
 
             return body
 
         def tick(t, carry):
-            h, out, kl, vl = carry
+            h, out, kl, vl, out_h = carry
             i = t - stage  # this stage's microbatch index at tick t
             i_c = jnp.clip(i, 0, n_micro - 1)
             valid = (i >= 0) & (i < n_micro)
@@ -995,9 +1026,19 @@ def _pipelined_decoder_layers(
             )
             k_mb = jax.lax.dynamic_slice_in_dim(kl, i_c * mb, mb, axis=1)
             v_mb = jax.lax.dynamic_slice_in_dim(vl, i_c * mb, mb, axis=1)
-            h_out, (k_new, v_new) = jax.lax.scan(
-                scan_body(ctx), h, (params_local, k_mb, v_mb)
-            )
+            if collect_hidden:
+                h_out, (k_new, v_new, h_layers) = jax.lax.scan(
+                    scan_body(ctx), h, (params_local, k_mb, v_mb)
+                )
+                # bank this stage's per-layer hiddens for microbatch i
+                banked_h = jax.lax.dynamic_update_slice_in_dim(
+                    out_h, h_layers[None], i_c, 0
+                )
+                out_h = jnp.where(valid, banked_h, out_h)
+            else:
+                h_out, (k_new, v_new) = jax.lax.scan(
+                    scan_body(ctx), h, (params_local, k_mb, v_mb)
+                )
             if defer:
                 # k_new/v_new are FRESH ROWS (L_local, mb, KV, 1, D): land
                 # them in the stage-local cache with one in-place commit at
@@ -1068,33 +1109,44 @@ def _pipelined_decoder_layers(
             )
             feed = slice_b(hidden_all, jnp.clip(t + 1, 0, n_micro - 1))
             h = jnp.where(stage == 0, feed, h_next)
-            return h, out, kl, vl
+            return h, out, kl, vl, out_h
 
         h0 = slice_b(hidden_all, 0)
         out0 = jnp.zeros((n_micro,) + h0.shape, h0.dtype)
-        h_fin, out, k_fin, v_fin = jax.lax.fori_loop(
-            0, n_micro + pp - 1, tick, (h0, out0, k_local, v_local)
+        n_local = jax.tree_util.tree_leaves(params_local)[0].shape[0]
+        out_h0 = jnp.zeros((n_micro, n_local) + h0.shape, h0.dtype)
+        h_fin, out, k_fin, v_fin, out_h = jax.lax.fori_loop(
+            0, n_micro + pp - 1, tick, (h0, out0, k_local, v_local, out_h0)
         )
         # replicate the last stage's banked outputs to every stage
         out = jax.lax.psum(
             jnp.where(stage == pp - 1, out, jnp.zeros_like(out)), AXIS_PP
         )
-        return out, k_fin, v_fin
+        # (n_micro, L_local, mb, S, H) -> (L_local, n_micro, mb, S, H): the
+        # layer axis leads so the pp out-spec stacks stages into global order
+        return out, k_fin, v_fin, jnp.swapaxes(out_h, 0, 1)
 
     p_specs = jax.tree_util.tree_map(lambda _: P(AXIS_PP), layer_params)
     ci_specs = {k: P() for k in ci}
-    out, new_k, new_v = jax.shard_map(
+    out, new_k, new_v, out_h = jax.shard_map(
         staged,
         mesh=mesh,
         in_specs=(p_specs, P(AXIS_PP), P(AXIS_PP), P(), P(), P(), P(), ci_specs,
                   P() if adapter_ids is not None else None),
-        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP), P(AXIS_PP)),
         axis_names={AXIS_PP},
         check_vma=False,
     )(layer_params, cache["k"], cache["v"], hidden, cos, sin, position_ids, ci,
       adapter_ids)
     hidden_out = out.reshape((B,) + out.shape[2:])
-    return hidden_out, {"k": new_k, "v": new_v}
+    new_cache = {"k": new_k, "v": new_v}
+    if collect_hidden:
+        # (L, n_micro, mb, S, H) -> (L, B, S, H): microbatch i holds batch
+        # rows [i*mb, (i+1)*mb) — contiguous, so a reshape reassembles
+        L = out_h.shape[0]
+        layer_h = out_h.reshape((L, B) + out_h.shape[3:])
+        return hidden_out, new_cache, layer_h
+    return hidden_out, new_cache
 
 
 def _interleaved_window_scan(
@@ -1307,7 +1359,8 @@ def run_decoder_layers(
 
     def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None,
               windowable_=None, defer_=None, mlp_stacked=None,
-              qkv_stacked=None, layer_idx=None, stacked_layer_idx=None):
+              qkv_stacked=None, layer_idx=None, stacked_layer_idx=None,
+              tkg_stacked=None):
         """One decoder layer with the bucket's static KV window applied.
         ``layout_``/``windowable_``/``defer_`` override the stack-wide
         defaults for the interleaved-window unit scan (ring slices use the
@@ -1316,8 +1369,10 @@ def run_decoder_layers(
         win_ok = windowable if windowable_ is None else windowable_
         dfr = defer if defer_ is None else defer_
         stk = dict(mlp_stacked=mlp_stacked, qkv_stacked=qkv_stacked,
-                   layer_idx=layer_idx, stacked_layer_idx=stacked_layer_idx)
-        if win_ok and kv_window is not None and kv_window < kl.shape[2] and attend_to_cache:
+                   layer_idx=layer_idx, stacked_layer_idx=stacked_layer_idx,
+                   tkg_stacked=tkg_stacked)
+        if (win_ok and kv_window is not None and kv_window < kl.shape[2]
+                and attend_to_cache and tkg_stacked is None):
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
             h, (nkw, nvw) = decoder_layer(
                 arch, lp, h, cos_, sin_, k_win, v_win, pos_, cache_spec,
@@ -1339,26 +1394,10 @@ def run_decoder_layers(
         segments_chk = (
             list(layer_params) if isinstance(layer_params, (list, tuple)) else [layer_params]
         )
-        if len(segments_chk) != 1:
-            raise NotImplementedError(
-                "pipeline parallel requires a homogeneous layer stack "
-                "(heterogeneous segment models are not pp-sharded yet)"
-            )
-        if collect_hidden:
-            raise NotImplementedError(
-                "collect_hidden (EAGLE3 aux taps / tensor capture) is not "
-                "supported under pipeline parallel"
-            )
         if layer_injections is not None:
             raise NotImplementedError(
                 "deepstack layer injections are not supported under "
                 "pipeline parallel"
-            )
-        n_layers_chk = jax.tree_util.tree_leaves(segments_chk[0])[0].shape[0]
-        if n_layers_chk % arch.pp_degree:
-            raise ValueError(
-                f"num_layers ({n_layers_chk}) must be divisible by pp_degree "
-                f"({arch.pp_degree}) — pipeline stages hold equal layer slices"
             )
         # deferred commit applies under pp too (stage-local in-place commit
         # each tick; see _pipelined_decoder_layers) — decode-shaped only
@@ -1375,10 +1414,44 @@ def run_decoder_layers(
             and (cache_inputs or {}).get("attn_mask") is None
             and (cache_inputs or {}).get("write_positions") is None
         )
-        return _pipelined_decoder_layers(
-            arch, segments_chk[0], hidden, cos, sin, cache, position_ids,
-            _step, cache_inputs, adapter_ids, defer=defer_pp, policy=policy,
-        )
+        # Heterogeneous segment stacks (deepseek-V3 first_k_dense + MoE rest,
+        # minimax) pipeline as MULTI-LAP virtual stages: each segment runs one
+        # full GPipe rotation over the pp mesh (stage s holds each segment's
+        # s-th layer slice — the looping-pipeline schedule), activations carry
+        # between laps (reference analog: generation_minimax_m2_pp_demo.py).
+        # Cost: one bubble set per segment.
+        pks, pvs, phs = [], [], []
+        off_pp = 0
+        for seg in segments_chk:
+            n_seg = jax.tree_util.tree_leaves(seg)[0].shape[0]
+            if n_seg % arch.pp_degree:
+                raise ValueError(
+                    f"segment of {n_seg} layers is not divisible by pp_degree "
+                    f"({arch.pp_degree}) — each pipeline lap needs equal "
+                    "per-stage layer slices"
+                )
+            seg_cache = {
+                "k": jax.lax.slice_in_dim(cache["k"], off_pp, off_pp + n_seg, axis=0),
+                "v": jax.lax.slice_in_dim(cache["v"], off_pp, off_pp + n_seg, axis=0),
+            }
+            res = _pipelined_decoder_layers(
+                arch, seg, hidden, cos, sin, seg_cache, position_ids,
+                _step, cache_inputs, adapter_ids, defer=defer_pp,
+                policy=policy, collect_hidden=collect_hidden,
+            )
+            if collect_hidden:
+                hidden, seg_new, seg_h = res
+                phs.append(seg_h)
+            else:
+                hidden, seg_new = res
+            pks.append(seg_new["k"])
+            pvs.append(seg_new["v"])
+            off_pp += n_seg
+        cat_pp = (lambda xs: xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0))
+        new_cache = {"k": cat_pp(pks), "v": cat_pp(pvs)}
+        if collect_hidden:
+            return hidden, new_cache, cat_pp(phs)
+        return hidden, new_cache
 
     if "k_win" in cache:
         return _interleaved_window_scan(
@@ -1394,6 +1467,39 @@ def run_decoder_layers(
     segments = (
         list(layer_params) if isinstance(layer_params, (list, tuple)) else [layer_params]
     )
+    # stacked-cache fused TKG kernel eligibility (round-4): the kernel reads
+    # the OLD cache from the full stack via scalar-prefetched layer index, so
+    # the scan's per-layer cache slices are never pallas operands (round-3's
+    # slice-copy tax). Conditions mirror the deferred-commit contract.
+    _has_layer_flags = any(
+        isinstance(sg, dict)
+        and any(k in sg for k in ("use_sliding_window", "use_rope", "use_local_rope"))
+        for sg in segments
+    )
+    use_stacked_tkg = (
+        arch.attn_tkg_kernel_enabled
+        and defer
+        and position_ids.shape[1] == 1
+        # flash decoding (KV-S sharded) and per-layer window/rope flags fall
+        # back per layer inside attention_block — skipping the kv_window
+        # slice for them would regress the XLA path to the full cache
+        and policy.cache_kv[2] is None
+        and not _has_layer_flags
+        and arch.v_head_dim is None
+        and not arch.attention_sink
+        and arch.attn_logit_softcap is None
+        and not getattr(layout, "route_by_seq_id", False)
+        and getattr(layout, "k_scale", 1.0) == 1.0
+        and getattr(layout, "v_scale", 1.0) == 1.0
+        and not getattr(layout, "has_array_scales", lambda: False)()
+        and cache["k"].dtype == cache_spec.compute_dtype
+        and (cache_inputs or {}).get("write_positions") is None
+        and attn_kernels.fused_decode_kernel_supported(
+            (position_ids.shape[0], arch.num_attention_heads, 1, arch.head_dim),
+            cache["k"].shape[1:],
+        )
+    )
+
     ks, vs, hs = [], [], []
     off = 0
     for seg in segments:
@@ -1404,7 +1510,8 @@ def run_decoder_layers(
         seg, mlp_st, qkv_st = _extract_stacked_weights(arch, seg)
         n_seg = jax.tree_util.tree_leaves(seg)[0].shape[0]
 
-        def body(h, xs, mlp_st=mlp_st, qkv_st=qkv_st, seg_off=off):
+        def body(h, xs, mlp_st=mlp_st, qkv_st=qkv_st, seg_off=off,
+                 tkg_st=None):
             # xs carries the GLOBAL layer index (for per-layer KV-quant scale
             # rows, kv_cache._scale_for); the per-SEGMENT stacked kernel
             # weights index with the segment-local offset
@@ -1413,7 +1520,7 @@ def run_decoder_layers(
             h, nk, nv = _step(
                 h, lp, kl, vl, cos, sin, position_ids, cache_inputs,
                 adapter_ids, mlp_stacked=mlp_st, qkv_stacked=qkv_st,
-                layer_idx=li, stacked_layer_idx=li_local,
+                layer_idx=li, stacked_layer_idx=li_local, tkg_stacked=tkg_st,
             )
             if inj is not None:
                 h = h + inj.astype(h.dtype)
@@ -1421,6 +1528,10 @@ def run_decoder_layers(
 
         k_seg = jax.lax.slice_in_dim(cache["k"], off, off + n_seg, axis=0)
         v_seg = jax.lax.slice_in_dim(cache["v"], off, off + n_seg, axis=0)
+        if use_stacked_tkg:
+            from functools import partial as _partial
+
+            body = _partial(body, tkg_st=(k_seg, v_seg, kv_window))
         inj_seg = (
             jax.lax.slice_in_dim(layer_injections, off, off + n_seg, axis=0)
             if layer_injections is not None

@@ -335,7 +335,7 @@ def fused_decode_kernel_supported(q_shape, k_cache_shape) -> bool:
 
 def _fused_decode_kernel(
     qs_ref, ks_ref, q_ref, k_ref, v_ref, kn_ref, vn_ref, o_ref, m_ref, l_ref, acc_ref,
-    *, scale, sliding_window, chunk_size, n_kv_blocks, KV, block_k,
+    *, scale, sliding_window, chunk_size, n_kv_blocks, KV, block_k, stacked=False,
 ):
     ki = pl.program_id(1)
     b = pl.program_id(0) // KV
@@ -351,8 +351,10 @@ def _fused_decode_kernel(
     @pl.when(kv_start + ki * block_k <= q_start)
     def _():
         q = q_ref[0]  # (G, D)
-        kT = k_ref[0]  # (D, block_k) — S-minor transposed cache view
-        vT = v_ref[0]  # (D, block_k)
+        # S-minor transposed cache view (D, block_k); the stacked variant's
+        # blocks carry a leading (1,) layer dim picked by scalar prefetch
+        kT = k_ref[0, 0] if stacked else k_ref[0]
+        vT = v_ref[0, 0] if stacked else v_ref[0]
         # VPU broadcast-multiply-reduce: with M = G (typically 4-8) an MXU
         # matmul wastes ~97% of the systolic array; the elementwise form
         # matches XLA's own near-roofline decode lowering
@@ -403,6 +405,130 @@ def _fused_decode_kernel(
         acc = acc_ref[:] * corr[:, None] + p2[:, None] * vn.astype(jnp.float32)
         l = jnp.maximum(l, 1e-20)
         o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+
+
+def _fused_decode_stacked_kernel(li_ref, qs_ref, ks_ref, *rest, **kw):
+    del li_ref  # consumed by the cache index maps
+    _fused_decode_kernel(qs_ref, ks_ref, *rest, stacked=True, **kw)
+
+
+def flash_attention_decode_fused_stacked(
+    q,  # (B, H, 1, D)
+    k_cache_s,  # (L, B, KV, Sk, D) — FULL stacked OLD cache
+    v_cache_s,
+    k_new,  # (B, KV, 1, D) — this step's fresh row
+    v_new,
+    q_pos,  # (B, 1)
+    layer_idx,  # scalar/1-elt int32 — the in-scan layer index
+    *,
+    scale: Optional[float] = None,
+    sliding_window: Optional[int] = None,
+    chunk_size: Optional[int] = None,
+    block_k: int = 512,
+    kv_len: Optional[int] = None,
+):
+    """The STACKED form of :func:`flash_attention_decode_fused`: the cache
+    operand is the whole (L, B, KV, S, D) stack and the active layer is
+    selected by a scalar-prefetched index — inside the decoder ``lax.scan`` a
+    pallas operand on the per-layer cache slice materializes a full-cache
+    copy per layer (the round-3 finding that made the per-layer kernel LOSE
+    to XLA two-part, bench.py notes); indexing the stack in the BlockSpec
+    reads only the touched blocks, like ops/kernels/kv_commit.py.
+
+    Same contract as the per-layer kernel otherwise (strict-causal old-cache
+    mask + fresh-row fold; contiguous layout kv positions = 0..Sk-1)."""
+    B, H, Sq, D = q.shape
+    assert Sq == 1, "fused decode kernel is single-position"
+    L, KV, Sk = k_cache_s.shape[0], k_cache_s.shape[2], k_cache_s.shape[3]
+    G = H // KV
+    scale = D ** -0.5 if scale is None else scale
+    attended = Sk if kv_len is None else min(kv_len, Sk)
+    block_k = _pick_block(attended, block_k)
+    n_kv_blocks = attended // block_k
+
+    qf = q.reshape(B, KV, G, D).reshape(B * KV, G, D)
+    # S-minor bitcast view of the stacked cache (L, B*KV, D, Sk)
+    kf = jnp.swapaxes(k_cache_s, 3, 4).reshape(L, B * KV, D, Sk)
+    vf = jnp.swapaxes(v_cache_s, 3, 4).reshape(L, B * KV, D, Sk)
+    knf = k_new.reshape(B * KV, 1, D)
+    vnf = v_new.reshape(B * KV, 1, D)
+    q_start = q_pos[:, 0].astype(jnp.int32)
+    kv_start = jnp.zeros((B,), jnp.int32)  # contiguous layout positions
+    li = jnp.asarray(layer_idx, jnp.int32).reshape(1)
+
+    kernel = functools.partial(
+        _fused_decode_stacked_kernel,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+        n_kv_blocks=n_kv_blocks,
+        KV=KV,
+        block_k=block_k,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B * KV, n_kv_blocks),
+        in_specs=[
+            pl.BlockSpec((1, G, D), lambda bk, ki, *_: (bk, 0, 0)),
+            pl.BlockSpec(
+                (1, 1, D, block_k), lambda bk, ki, li_ref, *_: (li_ref[0], bk, 0, ki)
+            ),
+            pl.BlockSpec(
+                (1, 1, D, block_k), lambda bk, ki, li_ref, *_: (li_ref[0], bk, 0, ki)
+            ),
+            pl.BlockSpec((1, 1, D), lambda bk, ki, *_: (bk, 0, 0)),
+            pl.BlockSpec((1, 1, D), lambda bk, ki, *_: (bk, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, G, D), lambda bk, ki, *_: (bk, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, 1), jnp.float32),
+            pltpu.VMEM((G, D), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B * KV, G, D), q.dtype),
+        interpret=_interpret(),
+    )(li, q_start, kv_start, qf, kf, vf, knf, vnf)
+    return out.reshape(B, KV, G, D).reshape(B, H, 1, D).astype(q.dtype)
+
+
+def sharded_fused_decode_stacked_call(
+    policy, q, k_cache_s, v_cache_s, k_new, v_new, q_pos, layer_idx,
+    *, scale=None, sliding_window=None, chunk_size=None, kv_len=None,
+):
+    """Stacked fused decode under GSPMD. Returns None when the KV sequence
+    dim is sharded (flash decoding) — callers fall back."""
+    from jax.sharding import PartitionSpec as P
+
+    fn = functools.partial(
+        flash_attention_decode_fused_stacked,
+        scale=scale,
+        sliding_window=sliding_window,
+        chunk_size=chunk_size,
+        kv_len=kv_len,
+    )
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return fn(q, k_cache_s, v_cache_s, k_new, v_new, q_pos, layer_idx)
+    kv_spec = policy.cache_kv
+    if kv_spec[2] is not None:
+        return None  # KV sequence sharded (flash decoding) -> XLA path
+    q_spec = P(*policy.q)
+    fresh_spec = P(*policy.kv)
+    cache_spec = P(None, *kv_spec)
+    qp_spec = P(policy.q[0], policy.q[2])
+    shard_fn = jax.shard_map(
+        fn,
+        mesh=mesh,
+        in_specs=(q_spec, cache_spec, cache_spec, fresh_spec, fresh_spec,
+                  qp_spec, P()),
+        out_specs=q_spec,
+        check_vma=False,
+    )
+    return shard_fn(q, k_cache_s, v_cache_s, k_new, v_new, q_pos, layer_idx)
 
 
 def flash_attention_decode_fused(

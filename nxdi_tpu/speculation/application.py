@@ -176,7 +176,11 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
             n_active_tokens=1,
             buckets=autobucketing.token_generation_buckets(self.config),
             attend_to_cache=True,
-            forward_kwargs={},
+            # async_mode: the window emits the NEXT window's inputs on device
+            # (device-resident spec chain; fused_spec_token_gen next_inputs)
+            forward_kwargs=(
+                {"return_next_inputs": True} if tc.async_mode else {}
+            ),
             **common,
         )
 

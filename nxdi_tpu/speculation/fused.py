@@ -104,6 +104,7 @@ def fused_spec_token_gen(
     kv_window: int,
     policy=DEFAULT_POLICY,
     layout=DEFAULT_KV_LAYOUT,
+    return_next_inputs: bool = False,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """One speculation window (reference: model_base.py:1866 ``_token_gen_forward``).
 
@@ -185,7 +186,24 @@ def fused_spec_token_gen(
     accepted = jnp.cumprod(matches, axis=1)  # prefix mask
     counts = jnp.sum(accepted, axis=1) + 1  # + bonus token
 
-    return {"tokens": target_tokens, "counts": counts}, {
+    outputs = {"tokens": target_tokens, "counts": counts}
+    if return_next_inputs:
+        # device-resident spec chain (the async-execution analog for spec
+        # windows): the next window starts from the LAST emitted token —
+        # target_tokens[b, counts[b]-1] at position pos0[b] + counts[b]
+        last_tok = jnp.take_along_axis(
+            target_tokens, (counts - 1)[:, None], axis=1
+        ).astype(jnp.int32)
+        nxt: Dict[str, jax.Array] = {
+            "input_ids": last_tok,
+            "position_ids": (pos0[:, 0] + counts)[:, None].astype(jnp.int32),
+            "last_token_index": lti,
+            "sampling_params": sp,
+        }
+        if "rng" in batch:
+            nxt["rng"] = jax.random.split(batch["rng"], 1)[0]
+        outputs["next_inputs"] = nxt
+    return outputs, {
         "draft": d_cache,
         "target": t_cache,
     }
@@ -219,6 +237,9 @@ class FusedSpecWrapper(ModelWrapper):
                 kv_window=bucket,
                 policy=self.policy,
                 layout=self.layout,
+                return_next_inputs=bool(
+                    self.forward_kwargs.get("return_next_inputs", False)
+                ),
             )
         return partial(
             fused_spec_context_encoding,

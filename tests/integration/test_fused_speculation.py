@@ -179,3 +179,53 @@ def test_fused_spec_batch_and_eos():
     # nothing but pad after the EOS position
     eos_idx = int(np.where(out_eos[0] == eos)[0][0])
     assert (out_eos[0, eos_idx + 1 :] == 0).all()
+
+
+def test_fused_spec_device_resident_chain_matches_hf():
+    """async_mode: each spec window emits the NEXT window's inputs on device
+    (fused_spec_token_gen return_next_inputs) — chaining windows through
+    forward_device with zero host math must reproduce HF greedy exactly."""
+    from nxdi_tpu.runtime.model_wrapper import TAG_FUSED_SPECULATION
+
+    spec_len = 3
+    target, target_cfg = _tiny_hf_llama(seed=0, layers=4)
+    draft, draft_cfg = _tiny_hf_llama(seed=1, layers=2)
+    app = _build_fused_app(
+        target, target_cfg, draft, draft_cfg, spec_len, async_mode=True
+    )
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    B, S = prompt.shape
+    expected = hf_greedy(target, prompt, max_new_tokens=17)[0, S:]
+
+    pos = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+    out = app.forward(
+        prompt.astype(np.int32), pos, last_token_index=np.array([S - 1], np.int32)
+    )
+    got = [int(np.asarray(out["tokens"])[0, 0])]
+
+    w = app.models[TAG_FUSED_SPECULATION]
+    # first window inputs assembled host-side once; afterwards the chain is
+    # fully device-resident (next_inputs feeds forward_device)
+    import jax.numpy as jnp
+
+    nxt = {
+        "input_ids": jnp.asarray([[got[0]]], jnp.int32),
+        "position_ids": jnp.asarray([[S]], jnp.int32),
+        "last_token_index": jnp.zeros((B,), jnp.int32),
+        "sampling_params": jnp.ones((B, 3), jnp.float32),
+    }
+    windows = []
+    for _ in range(12):
+        out, app.kv_cache = w.forward_device(
+            app.params, app.kv_cache, nxt, app.tpu_config.seq_len
+        )
+        windows.append(
+            (np.asarray(out["tokens"]), np.asarray(out["counts"]))
+        )
+        nxt = out["next_inputs"]
+    for toks, counts in windows:
+        got.extend(int(t) for t in toks[0, : counts[0]])
+    n = min(len(got), 16)
+    assert n >= 12  # 12 windows retire at least one token each
+    np.testing.assert_array_equal(np.array(got[:n]), expected[:n])

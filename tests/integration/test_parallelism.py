@@ -252,9 +252,11 @@ def test_attention_strategy_observability(tiny_hf_llama):
         for prog in w._programs.values()
         if prog.attention_strategies
     }
-    # prefill traced the flash kernel, decode the fused deferred-write kernel
+    # prefill traced the flash kernel; decode the STACKED fused kernel
+    # (round-4: reads the old cache from the layer stack via scalar-prefetch,
+    # taking priority over the per-layer fused kernel)
     assert any("cte_flash_kernel" in s for s in strategies.values()), strategies
-    assert any("tkg_fused_kernel" in s for s in strategies.values()), strategies
+    assert any("tkg_fused_kernel_stacked" in s for s in strategies.values()), strategies
 
     # flash decoding (KV-S sharded cache) CANNOT run the single-shard kernels:
     # the fallback must be VISIBLE in the recorded strategies
@@ -270,3 +272,79 @@ def test_attention_strategy_observability(tiny_hf_llama):
     assert tkg_strats and all(
         "tkg_xla" in s or "tkg_two_part_xla" in s for s in tkg_strats
     ), tkg_strats
+
+
+def test_segmented_pp2_deepseek_token_matching():
+    """Heterogeneous segment stack (deepseek-V3 first_k_dense head + MoE
+    rest) under pp2: each segment pipelines as its own GPipe lap (multi-lap
+    virtual stages, run_decoder_layers pp branch); tokens must equal HF CPU
+    greedy (reference analog: generation_minimax_m2_pp_demo.py)."""
+    import torch
+    from transformers import DeepseekV3Config, DeepseekV3ForCausalLM
+
+    from nxdi_tpu.models.deepseek import modeling_deepseek as ds
+
+    torch.manual_seed(0)
+    hf_cfg = DeepseekV3Config(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=8, num_key_value_heads=8, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        q_lora_rank=32, kv_lora_rank=32, qk_rope_head_dim=8,
+        qk_nope_head_dim=16, v_head_dim=16,
+        first_k_dense_replace=2,  # 2 dense + 2 MoE: both segments pp2-even
+        n_routed_experts=8, num_experts_per_tok=2, moe_intermediate_size=32,
+        n_group=4, topk_group=2, n_shared_experts=1, norm_topk_prob=True,
+        routed_scaling_factor=2.5, rope_scaling=None,
+        tie_word_embeddings=False, eos_token_id=None,
+    )
+    hf_model = DeepseekV3ForCausalLM(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+
+    tcfg = TpuConfig(
+        tp_degree=4, pp_degree=2, batch_size=2, seq_len=64,
+        max_context_length=32, dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(), skip_warmup=True,
+    )
+    cfg = ds.DeepseekInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=ds)
+    app.load()
+    prompt = np.tile(PROMPT, (2, 1))
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=12)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=12)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_collect_hidden_under_pp_matches_tp(tiny_hf_llama):
+    """EAGLE3 aux taps / tensor capture need per-layer hiddens; under pp the
+    stages bank their layers' hiddens per microbatch and the pp out-spec
+    reassembles global layer order — captured tensors must match a plain tp
+    run bit-for-bit."""
+    hf_model, hf_cfg = tiny_hf_llama
+    from nxdi_tpu.config import TensorCaptureConfig
+
+    caps = {}
+    for name, kw in (
+        ("tp", dict(tp_degree=8)),
+        ("pp", dict(tp_degree=4, pp_degree=2)),
+    ):
+        app = _build_app(
+            hf_model, hf_cfg, batch_size=2,
+            tensor_capture_config=TensorCaptureConfig(
+                capture_points=("layer_hiddens",)
+            ),
+            **kw,
+        )
+        prompt = np.tile(PROMPT, (2, 1)).astype(np.int32)
+        pos = np.tile(np.arange(prompt.shape[1], dtype=np.int32), (2, 1))
+        out = app.forward(
+            prompt, pos,
+            last_token_index=np.full((2,), prompt.shape[1] - 1, np.int32),
+        )
+        caps[name] = np.asarray(out["captured"]["layer_hiddens"])
+    assert caps["tp"].shape == caps["pp"].shape
+    np.testing.assert_allclose(caps["tp"], caps["pp"], rtol=2e-5, atol=2e-5)

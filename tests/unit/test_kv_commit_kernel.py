@@ -103,3 +103,38 @@ def test_distinct_v_head_dim():
     ok, ov = kv_commit_rows(kc, vc, kr, vr, pos)
     assert jnp.array_equal(ok, _golden(kc, kr, pos, b_idx))
     assert jnp.array_equal(ov, _golden(vc, vr, pos, b_idx))
+
+
+def test_fused_decode_stacked_matches_two_part():
+    """Stacked-cache fused decode kernel (interpret mode) vs the XLA two-part
+    reference, layer by layer through the scalar-prefetched index."""
+    import jax.numpy as jnp
+
+    from nxdi_tpu.ops.attention import attention_two_part
+    from nxdi_tpu.ops.kernels import flash_attention_decode_fused_stacked
+
+    rng = np.random.default_rng(0)
+    L, B, KV, G, S, D = 3, 2, 4, 2, 64, 16
+    H = KV * G
+    ks = jnp.asarray(rng.standard_normal((L, B, KV, S, D)) * 0.3, jnp.float32)
+    vs = jnp.asarray(rng.standard_normal((L, B, KV, S, D)) * 0.3, jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, H, 1, D)) * 0.3, jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, KV, 1, D)) * 0.3, jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, KV, 1, D)) * 0.3, jnp.float32)
+    q_pos = jnp.asarray([[17], [40]], jnp.int32)
+
+    for li in range(L):
+        got = flash_attention_decode_fused_stacked(
+            q, ks, vs, kn, vn, q_pos, jnp.asarray([li], jnp.int32)
+        )
+        kv_pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None], (B, S))
+        wpos = q_pos.astype(jnp.int32)
+        hit = jnp.any(kv_pos[:, None, :] == wpos[:, :, None], axis=1)
+        masked_pos = jnp.where(hit, jnp.int32(2 ** 30), kv_pos)
+        want = attention_two_part(
+            q, ks[li], vs[li], kn, vn, q_pos, masked_pos, wpos,
+            softmax_dtype=jnp.float32,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
