@@ -1,0 +1,112 @@
+"""Apertus family — per-head q/k RMSNorm + NON-gated xIELU MLP with
+per-layer learnable activation scalars.
+
+Reference: contrib/models/Apertus-8B-Instruct-2509. HF ApertusForCausalLM
+(modeling_apertus.py:43-300): ``attention_layernorm``/``feedforward_layernorm``
+pre-norms (renamed onto the standard slots), q/k RMSNorm before rope,
+``up_proj``/``down_proj`` with the xIELU activation — its ``alpha_p``/
+``alpha_n`` learnables live in bf16 inside HF's XIELUActivation, so the
+post-softplus values are baked host-side WITH the bf16 rounding
+(models/base.py:xielu)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import ml_dtypes
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class ApertusInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        if not hasattr(self, "hidden_act"):
+            self.hidden_act = "xielu"
+        super().add_derived_config()
+        if self.hidden_act != "xielu":
+            raise NotImplementedError(
+                f"apertus hidden_act {self.hidden_act!r} is not supported (xielu only)"
+            )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        qk_norm=True,
+        gated_mlp=False,
+        hidden_act="xielu",
+        attention_bias=bool(getattr(config, "attention_bias", False)),
+        mlp_bias=bool(getattr(config, "mlp_bias", False)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def _softplus_bf16(x: np.ndarray) -> np.ndarray:
+    """softplus computed the way HF does it — on the bf16 parameter, with a
+    bf16 result — then widened to f32 for the jax-side formula."""
+    xb = np.asarray(x, dtype=ml_dtypes.bfloat16).astype(np.float64)
+    out = np.log1p(np.exp(xb))
+    return np.asarray(out, dtype=ml_dtypes.bfloat16).astype(np.float32)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    sd = dict(state_dict)
+    for k in list(sd):
+        if "attention_layernorm." in k:
+            sd[k.replace("attention_layernorm", "input_layernorm")] = sd.pop(k)
+        elif "feedforward_layernorm." in k:
+            sd[k.replace("feedforward_layernorm", "post_attention_layernorm")] = sd.pop(k)
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in sd:
+                return np.asarray(sd[k])
+        raise KeyError(name)
+
+    def ff(get, has, cast, pre):
+        mlp = {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T)},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T)},
+        }
+        # beta buffer is 0.5 (exact in bf16); alpha_n adds beta post-softplus
+        ap = _softplus_bf16(src(pre + "mlp.act_fn.alpha_p"))
+        an_sp = _softplus_bf16(src(pre + "mlp.act_fn.alpha_n"))
+        an = (
+            np.asarray(an_sp, dtype=ml_dtypes.bfloat16)
+            + np.asarray(0.5, dtype=ml_dtypes.bfloat16)
+        ).astype(np.float32)
+        mlp["xielu"] = {
+            "alpha_p": ap.reshape(-1).astype(np.float32),
+            "alpha_n": np.asarray(an).reshape(-1).astype(np.float32),
+        }
+        return "mlp", mlp
+
+    return dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["mlp"]["xielu"] = {"alpha_p": REPLICATED, "alpha_n": REPLICATED}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    L = config.num_hidden_layers
+    struct["layers"]["mlp"]["xielu"] = {
+        "alpha_p": jax.ShapeDtypeStruct((L, 1), jnp.float32),
+        "alpha_n": jax.ShapeDtypeStruct((L, 1), jnp.float32),
+    }
+    return struct

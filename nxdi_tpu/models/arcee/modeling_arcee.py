@@ -1,0 +1,56 @@
+"""Arcee (AFM) family — llama geometry with a NON-gated squared-ReLU MLP.
+
+Reference: contrib/models/AFM-4.5B-Base. HF ArceeForCausalLM
+(modeling_arcee.py:50-61): ``up_proj``/``down_proj`` only (no gate) with
+``relu2`` (squared ReLU) activation; everything else is the llama
+standard."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class ArceeInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        if not hasattr(self, "hidden_act"):
+            self.hidden_act = "relu2"
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        gated_mlp=False,
+        hidden_act=getattr(config, "hidden_act", "relu2"),
+        attention_bias=bool(getattr(config, "attention_bias", False)),
+        mlp_bias=bool(getattr(config, "mlp_bias", False)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+
+    def ff(get, has, cast, pre):
+        mlp = {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T)},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T)},
+        }
+        if arch.mlp_bias:
+            mlp["up_proj"]["b"] = cast(get(pre + "mlp.up_proj.bias"))
+            mlp["down_proj"]["b"] = cast(get(pre + "mlp.down_proj.bias"))
+        return "mlp", mlp
+
+    return dense.convert_hf_state_dict(state_dict, config, arch, ff_converter=ff)
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

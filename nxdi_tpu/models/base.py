@@ -25,6 +25,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
@@ -58,7 +59,23 @@ ACT_FNS: Dict[str, Callable] = {
     "gelu_pytorch_tanh": partial(jax.nn.gelu, approximate=True),
     "gelu_new": partial(jax.nn.gelu, approximate=True),
     "relu": jax.nn.relu,
+    # squared ReLU (persimmon, arcee/AFM — HF ACT2FN["relu2"])
+    "relu2": lambda x: jnp.square(jax.nn.relu(x)),
 }
+
+
+def xielu(x: jax.Array, alpha_p: jax.Array, alpha_n: jax.Array) -> jax.Array:
+    """xIELU activation (apertus; arxiv 2411.13010). ``alpha_p``/``alpha_n``
+    are the POST-softplus per-layer scalars (host-computed at conversion to
+    reproduce HF's bfloat16 parameter rounding — XIELUActivation keeps its
+    learnables in bf16 regardless of model dtype)."""
+    xf = x.astype(jnp.float32)
+    beta = jnp.float32(0.5)
+    # HF stores eps as a bf16 buffer; bake the same rounding
+    eps = jnp.float32(np.float32(np.asarray(-1e-6, dtype=ml_dtypes.bfloat16)))
+    pos = alpha_p * xf * xf + beta * xf
+    neg = (jnp.expm1(jnp.minimum(xf, eps)) - xf) * alpha_n + beta * xf
+    return jnp.where(xf > 0, pos, neg).astype(x.dtype)
 
 # Attention-strategy trace: attention_block appends the strategy each traced
 # attention body actually chose (kernel vs XLA fallback). Strategy decisions
@@ -853,8 +870,15 @@ def mlp_block(
             )
         _record_strategy("mlp_fused_kernel")
         return out
-    act = ACT_FNS[arch.hidden_act]
     aq, ac = arch.act_quant, arch.act_clamp
+    if arch.hidden_act == "xielu":
+        # apertus: per-layer learnable activation scalars ride the scan with
+        # the mlp params (p_mlp["xielu"] = {"alpha_p", "alpha_n"}, f32)
+        a = p_mlp["xielu"]
+        up = xielu(_linear(x, p_mlp["up_proj"], aq, ac, adapter_ids),
+                   a["alpha_p"], a["alpha_n"])
+        return _linear(up, p_mlp["down_proj"], aq, ac, adapter_ids)
+    act = ACT_FNS[arch.hidden_act]
     if not arch.gated_mlp:
         up = act(_linear(x, p_mlp["up_proj"], aq, ac, adapter_ids))
         return _linear(up, p_mlp["down_proj"], aq, ac, adapter_ids)
@@ -1756,6 +1780,8 @@ def causal_lm_forward(
         )  # (B, 1, hidden)
 
     logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    if "lm_head_bias" in params:  # phi lineage: biased lm_head
+        logits = logits + params["lm_head_bias"].astype(jnp.float32)
     if arch.logits_scaling != 1.0:
         logits = logits / arch.logits_scaling
     if arch.final_logit_softcap is not None:

@@ -1,0 +1,58 @@
+"""BioGPT family — fairseq decoder with sqrt(H)-scaled embeddings.
+
+Reference: contrib/models/biogpt. HF BioGptForCausalLM (modeling_biogpt.py):
+``BioGptLearnedPositionalEmbedding`` (offset 2, baked at conversion),
+``scale_embedding`` sqrt(H) multiplier, biased pre-LayerNorms, gelu fc MLP,
+model-level ``layer_norm``, tied ``output_projection``."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense, fairseq_dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = fairseq_dense.build_inv_freq
+
+
+class BioGptInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = [
+        "hidden_size", "num_attention_heads", "num_hidden_layers",
+        "vocab_size", "intermediate_size",
+    ]
+
+    def add_derived_config(self):
+        self.num_key_value_heads = self.num_attention_heads
+        self.rms_norm_eps = 1e-5  # nn.LayerNorm default
+        self.tie_word_embeddings = bool(getattr(self, "tie_word_embeddings", True))
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        hidden_act=getattr(config, "hidden_act", "gelu"),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+        embed_scale=(
+            float(config.hidden_size) ** 0.5
+            if getattr(config, "scale_embedding", True) else None
+        ),
+    )
+    kwargs.update(overrides)
+    return fairseq_dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return fairseq_dense.convert_hf_state_dict(
+        state_dict, config, build_arch(config),
+        prefix="biogpt.",
+        final_norm_key="layer_norm",
+    )
+
+
+def param_specs(config: InferenceConfig):
+    return fairseq_dense.param_specs(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return fairseq_dense.param_shape_struct(
+        config, build_arch(config), config.max_position_embeddings
+    )

@@ -1,0 +1,163 @@
+"""Shared conversion for the fairseq-descended decoder families (OPT, BioGPT,
+XGLM): pre-norm biased LayerNorms (``self_attn_layer_norm`` /
+``final_layer_norm``), non-gated ``fc1``/``fc2`` MLP, q/k/v/out projections
+with biases, learned-or-sinusoidal ABSOLUTE position embeddings with the
+fairseq +2 offset (baked into the table at conversion), optional sqrt(H)
+embedding scale, and a model-level final LayerNorm.
+
+Reference analogs: contrib/models/opt-1.3b, biogpt, xglm-564M — each a torch
+module graph over the same fairseq decoder layout."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        layernorm=True,
+        learned_pos_embeds=True,
+        no_rope=True,
+        gated_mlp=False,
+        attention_bias=True,
+        attention_o_bias=True,
+        mlp_bias=True,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    # unused (no_rope) but the pipeline expects a frequency table
+    from nxdi_tpu.ops.rope import default_inv_freq
+
+    return default_inv_freq(dense.head_dim_of(config), 10000.0)
+
+
+def sinusoid_table(num_positions: int, dim: int, padding_idx: Optional[int]) -> np.ndarray:
+    """fairseq/tensor2tensor sinusoid (XGLMSinusoidalPositionalEmbedding
+    .get_embedding): [sin | cos] halves, zero-padded if odd."""
+    half = dim // 2
+    freq = np.exp(np.arange(half, dtype=np.float64) * -(np.log(10000.0) / (half - 1)))
+    ang = np.arange(num_positions, dtype=np.float64)[:, None] * freq[None, :]
+    emb = np.concatenate([np.sin(ang), np.cos(ang)], axis=1).astype(np.float32)
+    if dim % 2 == 1:
+        emb = np.concatenate([emb, np.zeros((num_positions, 1), np.float32)], axis=1)
+    if padding_idx is not None:
+        emb[padding_idx] = 0.0
+    return emb
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray],
+    config: InferenceConfig,
+    arch: DecoderArch,
+    *,
+    prefix: str,
+    embed_key: str = "embed_tokens.weight",
+    pos_table: Optional[Callable[[], np.ndarray]] = None,
+    pos_key: str = "embed_positions.weight",
+    pos_offset: int = 2,
+    final_norm_key: str = "final_layer_norm",
+) -> Dict[str, Any]:
+    """Normalize the fairseq layout into the dense layout. ``prefix`` is the
+    HF submodule path (``model.decoder.`` for OPT, ``biogpt.`` for BioGPT,
+    ``model.`` for XGLM). ``pos_table`` generates the position table when it
+    is not a checkpoint weight (XGLM's sinusoid buffer)."""
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+
+    def src(name):
+        for k in (prefix + name, name):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(prefix + name)
+
+    sd: Dict[str, np.ndarray] = {
+        "embed_tokens.weight": src(embed_key),
+        "norm.weight": src(final_norm_key + ".weight"),
+    }
+    for head_key in ("lm_head.weight", "output_projection.weight"):
+        if head_key in state_dict:
+            sd["lm_head.weight"] = np.asarray(state_dict[head_key])
+            break
+    norm_biases: Dict[str, np.ndarray] = {"norm": src(final_norm_key + ".bias")}
+    for i in range(L):
+        pre = f"layers.{i}."
+        for proj in ("q", "k", "v"):
+            sd[pre + f"self_attn.{proj}_proj.weight"] = src(pre + f"self_attn.{proj}_proj.weight")
+            sd[pre + f"self_attn.{proj}_proj.bias"] = src(pre + f"self_attn.{proj}_proj.bias")
+        sd[pre + "self_attn.o_proj.weight"] = src(pre + "self_attn.out_proj.weight")
+        sd[pre + "self_attn.o_proj.bias"] = src(pre + "self_attn.out_proj.bias")
+        sd[pre + "input_layernorm.weight"] = src(pre + "self_attn_layer_norm.weight")
+        sd[pre + "post_attention_layernorm.weight"] = src(pre + "final_layer_norm.weight")
+        norm_biases[f"layers.{i}.input"] = src(pre + "self_attn_layer_norm.bias")
+        norm_biases[f"layers.{i}.post"] = src(pre + "final_layer_norm.bias")
+        sd[pre + "mlp.up_proj.weight"] = src(pre + "fc1.weight")
+        sd[pre + "mlp.up_proj.bias"] = src(pre + "fc1.bias")
+        sd[pre + "mlp.down_proj.weight"] = src(pre + "fc2.weight")
+        sd[pre + "mlp.down_proj.bias"] = src(pre + "fc2.bias")
+
+    def ff(get, has, cast, pre):
+        return "mlp", {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T),
+                        "b": cast(get(pre + "mlp.up_proj.bias"))},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T),
+                          "b": cast(get(pre + "mlp.down_proj.bias"))},
+        }
+
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    params["layers"]["input_layernorm"] = {
+        "w": params["layers"]["input_layernorm"],
+        "b": np.stack([norm_biases[f"layers.{i}.input"] for i in range(L)]).astype(dt),
+    }
+    params["layers"]["post_attention_layernorm"] = {
+        "w": params["layers"]["post_attention_layernorm"],
+        "b": np.stack([norm_biases[f"layers.{i}.post"] for i in range(L)]).astype(dt),
+    }
+    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    if pos_table is not None:
+        table = np.asarray(pos_table())
+    else:
+        table = np.asarray(src(pos_key))
+    # fairseq offset: positions are looked up at position_ids + 2 — slice the
+    # first two rows off so runtime lookups are plain position_ids
+    params["position_embeddings"] = table[pos_offset:].astype(dt)
+    return params
+
+
+def param_specs(arch: DecoderArch):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(arch)
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    specs["position_embeddings"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig, arch: DecoderArch, num_positions: int):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    struct["position_embeddings"] = s(num_positions, H)
+    return struct

@@ -1,0 +1,210 @@
+"""Falcon family — fused query_key_value with three historical layouts,
+parallel attention+MLP residual, biased LayerNorms, non-gated gelu MLP.
+
+Reference: contrib/models/falcon-7b. HF FalconForCausalLM
+(modeling_falcon.py:186-640):
+  - falcon-7b: ``multi_query`` (ONE kv head appended after the query rows),
+    ``parallel_attn`` with a SINGLE shared input_layernorm (aliased onto the
+    parallel block's MLP slot at conversion);
+  - falcon-40b/180b (``new_decoder_architecture``): per-kv-group interleaved
+    [gxq | k | v] qkv rows, distinct ``ln_attn``/``ln_mlp`` parallel norms;
+  - falcon-rw (neither): per-head [q,k,v] interleave, sequential residual.
+ALiBi checkpoints are rejected loudly (rope only)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class FalconInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["hidden_size", "num_attention_heads", "num_hidden_layers", "vocab_size"]
+
+    def add_derived_config(self):
+        if getattr(self, "new_decoder_architecture", False):
+            self.num_key_value_heads = getattr(
+                self, "num_kv_heads", self.num_attention_heads
+            )
+        elif getattr(self, "multi_query", True):
+            self.num_key_value_heads = 1
+        else:
+            self.num_key_value_heads = self.num_attention_heads
+        self.intermediate_size = getattr(self, "ffn_hidden_size", None) or (
+            4 * self.hidden_size
+        )
+        self.rms_norm_eps = getattr(self, "layer_norm_epsilon", 1e-5)
+        self.hidden_act = getattr(self, "activation", "gelu")
+        if not hasattr(self, "tie_word_embeddings"):
+            self.tie_word_embeddings = True
+        super().add_derived_config()
+        if getattr(self, "alibi", False):
+            raise NotImplementedError("falcon ALiBi checkpoints are not supported (rope only)")
+
+
+def _parallel(config) -> bool:
+    return bool(getattr(config, "parallel_attn", True)) or bool(
+        getattr(config, "new_decoder_architecture", False)
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    bias = bool(getattr(config, "bias", False))
+    kwargs = dict(
+        layernorm=True,
+        gated_mlp=False,
+        parallel_block=_parallel(config),
+        attention_bias=bias,
+        attention_o_bias=bias,
+        mlp_bias=bias,
+        hidden_act=getattr(config, "activation", "gelu"),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def _split_qkv(w: np.ndarray, config, D: int):
+    """HF fused query_key_value rows -> (q, k, v) in HF (out, in) layout.
+    Mirrors FalconAttention._split_heads (modeling_falcon.py:229-258)."""
+    heads = config.num_attention_heads
+    if getattr(config, "new_decoder_architecture", False):
+        kv = config.num_key_value_heads
+        g = heads // kv
+        blocks = w.reshape(kv, g + 2, D, -1) if w.ndim == 2 else w.reshape(kv, g + 2, D)
+        q = blocks[:, :g].reshape((heads * D,) + w.shape[1:])
+        k = blocks[:, g].reshape((kv * D,) + w.shape[1:])
+        v = blocks[:, g + 1].reshape((kv * D,) + w.shape[1:])
+    elif getattr(config, "multi_query", True):
+        q = w[: heads * D]
+        k = w[heads * D : (heads + 1) * D]
+        v = w[(heads + 1) * D :]
+    else:
+        t = w.reshape((heads, 3, D) + w.shape[1:])
+        q = t[:, 0].reshape((heads * D,) + w.shape[1:])
+        k = t[:, 1].reshape((heads * D,) + w.shape[1:])
+        v = t[:, 2].reshape((heads * D,) + w.shape[1:])
+    return q, k, v
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    D = config.hidden_size // config.num_attention_heads
+    two_ln = bool(getattr(config, "new_decoder_architecture", False)) and (
+        getattr(config, "num_ln_in_parallel_attn", None) in (None, 2)
+    )
+
+    def src(name):
+        for k in (name, f"transformer.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    def has(name):
+        return name in state_dict or f"transformer.{name}" in state_dict
+
+    sd: Dict[str, np.ndarray] = {
+        "embed_tokens.weight": src("word_embeddings.weight"),
+        "norm.weight": src("ln_f.weight"),
+    }
+    if "lm_head.weight" in state_dict:
+        sd["lm_head.weight"] = np.asarray(state_dict["lm_head.weight"])
+    norm_biases: Dict[str, np.ndarray] = {"norm": src("ln_f.bias")}
+    for i in range(arch.num_layers):
+        pre = f"h.{i}."
+        dst = f"layers.{i}."
+        qw, kw, vw = _split_qkv(src(pre + "self_attention.query_key_value.weight"), config, D)
+        sd[dst + "self_attn.q_proj.weight"] = qw
+        sd[dst + "self_attn.k_proj.weight"] = kw
+        sd[dst + "self_attn.v_proj.weight"] = vw
+        if arch.attention_bias:
+            qb, kb, vb = _split_qkv(src(pre + "self_attention.query_key_value.bias"), config, D)
+            sd[dst + "self_attn.q_proj.bias"] = qb
+            sd[dst + "self_attn.k_proj.bias"] = kb
+            sd[dst + "self_attn.v_proj.bias"] = vb
+        sd[dst + "self_attn.o_proj.weight"] = src(pre + "self_attention.dense.weight")
+        if arch.attention_o_bias:
+            sd[dst + "self_attn.o_proj.bias"] = src(pre + "self_attention.dense.bias")
+        sd[dst + "mlp.up_proj.weight"] = src(pre + "mlp.dense_h_to_4h.weight")
+        sd[dst + "mlp.down_proj.weight"] = src(pre + "mlp.dense_4h_to_h.weight")
+        if arch.mlp_bias:
+            sd[dst + "mlp.up_proj.bias"] = src(pre + "mlp.dense_h_to_4h.bias")
+            sd[dst + "mlp.down_proj.bias"] = src(pre + "mlp.dense_4h_to_h.bias")
+        if two_ln:
+            sd[dst + "input_layernorm.weight"] = src(pre + "ln_attn.weight")
+            sd[dst + "post_attention_layernorm.weight"] = src(pre + "ln_mlp.weight")
+            norm_biases[f"layers.{i}.input"] = src(pre + "ln_attn.bias")
+            norm_biases[f"layers.{i}.post"] = src(pre + "ln_mlp.bias")
+        else:
+            sd[dst + "input_layernorm.weight"] = src(pre + "input_layernorm.weight")
+            norm_biases[f"layers.{i}.input"] = src(pre + "input_layernorm.bias")
+            if has(pre + "post_attention_layernorm.weight"):  # sequential falcon-rw
+                sd[dst + "post_attention_layernorm.weight"] = src(
+                    pre + "post_attention_layernorm.weight"
+                )
+                norm_biases[f"layers.{i}.post"] = src(pre + "post_attention_layernorm.bias")
+            else:  # parallel_attn single norm: alias onto the MLP slot
+                sd[dst + "post_attention_layernorm.weight"] = sd[dst + "input_layernorm.weight"]
+                norm_biases[f"layers.{i}.post"] = norm_biases[f"layers.{i}.input"]
+
+    def ff(get, has_, cast, pre):
+        mlp = {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T)},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T)},
+        }
+        if arch.mlp_bias:
+            mlp["up_proj"]["b"] = cast(get(pre + "mlp.up_proj.bias"))
+            mlp["down_proj"]["b"] = cast(get(pre + "mlp.down_proj.bias"))
+        return "mlp", mlp
+
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+    params["layers"]["input_layernorm"] = {
+        "w": params["layers"]["input_layernorm"],
+        "b": np.stack([norm_biases[f"layers.{i}.input"] for i in range(L)]).astype(dt),
+    }
+    params["layers"]["post_attention_layernorm"] = {
+        "w": params["layers"]["post_attention_layernorm"],
+        "b": np.stack([norm_biases[f"layers.{i}.post"] for i in range(L)]).astype(dt),
+    }
+    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["input_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
+    specs["layers"]["post_attention_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    struct["layers"]["input_layernorm"] = {"w": s(L, H), "b": s(L, H)}
+    struct["layers"]["post_attention_layernorm"] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    return struct

@@ -1,0 +1,47 @@
+"""Gemma (v1) family — (1+w) float32 RMSNorms, sqrt(H) embed scale, tied head.
+
+Reference: contrib/models/gemma-2b-it. HF GemmaForCausalLM
+(modeling_gemma.py:46-260): the gemma norm convention and embedding
+normalizer but NONE of gemma2's extras — standard pre/post block norms (no
+sandwich), no softcapping, head_dim**-0.5 scaling, one rope table."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class GemmaInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + ["head_dim"]
+
+    def add_derived_config(self):
+        if getattr(self, "hidden_activation", None):
+            self.hidden_act = self.hidden_activation
+        elif not hasattr(self, "hidden_act"):
+            self.hidden_act = "gelu_pytorch_tanh"
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        gemma_norm=True,
+        embed_scale=float(config.hidden_size) ** 0.5,
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

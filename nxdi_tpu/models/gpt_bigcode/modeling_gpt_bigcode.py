@@ -1,0 +1,167 @@
+"""GPTBigCode (santacoder/starcoder) family — gpt2 layout with MQA.
+
+Reference: contrib/models/gpt_bigcode-santacoder. HF GPTBigCodeForCausalLM
+(modeling_gpt_bigcode.py:123-270): ``c_attn`` is a fused nn.Linear (NOT
+Conv1D — rows are [H | kv | kv] with ONE kv head when ``multi_query``),
+learned ``wpe`` positions (no offset), biased LayerNorms, non-gated
+gelu_pytorch_tanh MLP, tied head."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class GPTBigCodeInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["n_embd", "n_head", "n_layer", "vocab_size", "n_positions"]
+
+    def add_derived_config(self):
+        self.hidden_size = self.n_embd
+        self.num_attention_heads = self.n_head
+        self.num_hidden_layers = self.n_layer
+        self.num_key_value_heads = 1 if getattr(self, "multi_query", True) else self.n_head
+        self.intermediate_size = getattr(self, "n_inner", None) or 4 * self.n_embd
+        self.rms_norm_eps = getattr(self, "layer_norm_epsilon", 1e-5)
+        self.hidden_act = getattr(self, "activation_function", "gelu_pytorch_tanh")
+        self.tie_word_embeddings = True
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        learned_pos_embeds=True,
+        no_rope=True,
+        layernorm=True,
+        gated_mlp=False,
+        attention_bias=True,
+        attention_o_bias=True,
+        mlp_bias=True,
+        tie_word_embeddings=True,
+        hidden_act=getattr(config, "activation_function", "gelu_pytorch_tanh"),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    from nxdi_tpu.ops.rope import default_inv_freq
+
+    return default_inv_freq(config.n_embd // config.n_head, 10000.0)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    H = config.hidden_size
+    D = H // config.num_attention_heads
+    kv_dim = config.num_key_value_heads * D
+
+    def src(name):
+        for k in (name, f"transformer.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    sd: Dict[str, np.ndarray] = {
+        "embed_tokens.weight": src("wte.weight"),
+        "norm.weight": src("ln_f.weight"),
+    }
+    norm_biases: Dict[str, np.ndarray] = {"norm": src("ln_f.bias")}
+    for i in range(arch.num_layers):
+        pre = f"h.{i}."
+        dst = f"layers.{i}."
+        ca_w = src(pre + "attn.c_attn.weight")  # ((H + 2*kv), H) out,in
+        ca_b = src(pre + "attn.c_attn.bias")
+        if getattr(config, "multi_query", True):
+            # MQA: flat [q-heads | k | v] row blocks
+            qw, kw, vw = ca_w[:H], ca_w[H : H + kv_dim], ca_w[H + kv_dim :]
+            qb, kb, vb = ca_b[:H], ca_b[H : H + kv_dim], ca_b[H + kv_dim :]
+        else:
+            # MHA: HF views rows per-HEAD as [q,k,v] interleave
+            heads = config.num_attention_heads
+            D = H // heads
+
+            def deint(w):
+                t = w.reshape((heads, 3, D) + w.shape[1:])
+                return tuple(
+                    t[:, j].reshape((heads * D,) + w.shape[1:]) for j in range(3)
+                )
+
+            (qw, kw, vw), (qb, kb, vb) = deint(ca_w), deint(ca_b)
+        sd[dst + "self_attn.q_proj.weight"] = qw
+        sd[dst + "self_attn.k_proj.weight"] = kw
+        sd[dst + "self_attn.v_proj.weight"] = vw
+        sd[dst + "self_attn.q_proj.bias"] = qb
+        sd[dst + "self_attn.k_proj.bias"] = kb
+        sd[dst + "self_attn.v_proj.bias"] = vb
+        sd[dst + "self_attn.o_proj.weight"] = src(pre + "attn.c_proj.weight")
+        sd[dst + "self_attn.o_proj.bias"] = src(pre + "attn.c_proj.bias")
+        sd[dst + "mlp.up_proj.weight"] = src(pre + "mlp.c_fc.weight")
+        sd[dst + "mlp.up_proj.bias"] = src(pre + "mlp.c_fc.bias")
+        sd[dst + "mlp.down_proj.weight"] = src(pre + "mlp.c_proj.weight")
+        sd[dst + "mlp.down_proj.bias"] = src(pre + "mlp.c_proj.bias")
+        sd[dst + "input_layernorm.weight"] = src(pre + "ln_1.weight")
+        sd[dst + "post_attention_layernorm.weight"] = src(pre + "ln_2.weight")
+        norm_biases[f"layers.{i}.input"] = src(pre + "ln_1.bias")
+        norm_biases[f"layers.{i}.post"] = src(pre + "ln_2.bias")
+
+    def ff(get, has, cast, pre):
+        return "mlp", {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T),
+                        "b": cast(get(pre + "mlp.up_proj.bias"))},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T),
+                          "b": cast(get(pre + "mlp.down_proj.bias"))},
+        }
+
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+    params["layers"]["input_layernorm"] = {
+        "w": params["layers"]["input_layernorm"],
+        "b": np.stack([norm_biases[f"layers.{i}.input"] for i in range(L)]).astype(dt),
+    }
+    params["layers"]["post_attention_layernorm"] = {
+        "w": params["layers"]["post_attention_layernorm"],
+        "b": np.stack([norm_biases[f"layers.{i}.post"] for i in range(L)]).astype(dt),
+    }
+    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    params["position_embeddings"] = np.asarray(src("wpe.weight"), dtype=dt)
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    specs = dense.param_specs_for(build_arch(config))
+    specs["layers"]["input_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
+    specs["layers"]["post_attention_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    specs["position_embeddings"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    struct["layers"]["input_layernorm"] = {"w": s(L, H), "b": s(L, H)}
+    struct["layers"]["post_attention_layernorm"] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    struct["position_embeddings"] = s(config.n_positions, H)
+    return struct

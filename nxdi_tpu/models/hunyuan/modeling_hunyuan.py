@@ -1,0 +1,43 @@
+"""HunYuan dense v1 family — llama geometry + per-head q/k RMSNorm.
+
+Reference: contrib/models/hunyuan-7b-instruct. HF HunYuanDenseV1ForCausalLM
+(modeling_hunyuan_v1_dense.py:155-210): per-head ``query_layernorm`` /
+``key_layernorm`` RMSNorms applied after head reshape and before rope
+(mapped onto the shared qk_norm switch with a key rename), explicit
+``head_dim``, silu gated MLP."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class HunYuanInferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(qk_norm=True)
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    sd = dict(state_dict)
+    for k in list(sd):
+        if "self_attn.query_layernorm." in k:
+            sd[k.replace("query_layernorm", "q_norm")] = sd.pop(k)
+        elif "self_attn.key_layernorm." in k:
+            sd[k.replace("key_layernorm", "k_norm")] = sd.pop(k)
+    return dense.convert_hf_state_dict(sd, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

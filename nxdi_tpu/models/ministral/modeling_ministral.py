@@ -1,0 +1,71 @@
+"""Ministral family — mistral with per-layer sliding/full attention types.
+
+Reference: contrib/models/Ministral-4b-instruct. HF MinistralForCausalLM
+(modeling_ministral.py:122-190): llama geometry with an explicit ``head_dim``
+and ``layer_types`` marking sliding-window layers (default: EVERY layer
+sliding when ``sliding_window`` is set); one rope table for all layers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class MinistralInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        super().add_derived_config()
+        if not hasattr(self, "sliding_window"):
+            self.sliding_window = None
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            kind = (
+                "sliding_attention" if self.sliding_window is not None
+                else "full_attention"
+            )
+            self.layer_types = [kind] * self.num_hidden_layers
+
+
+def _sliding_flags(config):
+    return np.array(
+        [t == "sliding_attention" for t in config.layer_types], dtype=bool
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(sliding_window=getattr(config, "sliding_window", None))
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    params = dense.convert_hf_state_dict(state_dict, config, arch)
+    if getattr(config, "sliding_window", None):
+        flags = _sliding_flags(config)
+        if not flags.all():  # mixed stack: per-layer flags ride the scan
+            params["layers"]["use_sliding_window"] = flags
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    if getattr(config, "sliding_window", None) and not _sliding_flags(config).all():
+        specs["layers"]["use_sliding_window"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    if getattr(config, "sliding_window", None) and not _sliding_flags(config).all():
+        struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct(
+            (config.num_hidden_layers,), jnp.bool_
+        )
+    return struct

@@ -1,0 +1,65 @@
+"""OPT family — fairseq decoder: learned positions (+2 offset), ReLU fc MLP.
+
+Reference: contrib/models/opt-1.3b. HF OPTForCausalLM
+(modeling_opt.py): ``OPTLearnedPositionalEmbedding`` (offset 2, baked at
+conversion), biased pre-LayerNorms, relu fc1/fc2, tied lm_head. The 350m
+post-norm (``do_layer_norm_before=False``) and projected-embedding
+(``word_embed_proj_dim != hidden_size``) variants are rejected loudly."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense, fairseq_dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = fairseq_dense.build_inv_freq
+
+
+class OPTInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["hidden_size", "num_attention_heads", "num_hidden_layers", "vocab_size"]
+
+    def add_derived_config(self):
+        self.num_key_value_heads = self.num_attention_heads
+        self.intermediate_size = getattr(self, "ffn_dim", 4 * self.hidden_size)
+        self.rms_norm_eps = 1e-5  # nn.LayerNorm default
+        self.hidden_act = getattr(self, "activation_function", "relu")
+        self.tie_word_embeddings = bool(getattr(self, "tie_word_embeddings", True))
+        super().add_derived_config()
+        if not getattr(self, "do_layer_norm_before", True):
+            raise NotImplementedError(
+                "OPT post-norm variant (do_layer_norm_before=False) is not supported"
+            )
+        wepd = getattr(self, "word_embed_proj_dim", None)
+        if wepd is not None and wepd != self.hidden_size:
+            raise NotImplementedError(
+                "OPT word_embed_proj_dim != hidden_size (project_in/out) is not supported"
+            )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        hidden_act=getattr(config, "activation_function", "relu"),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+    )
+    kwargs.update(overrides)
+    return fairseq_dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return fairseq_dense.convert_hf_state_dict(
+        state_dict, config, build_arch(config),
+        prefix="model.decoder.",
+        final_norm_key="final_layer_norm",
+    )
+
+
+def param_specs(config: InferenceConfig):
+    return fairseq_dense.param_specs(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return fairseq_dense.param_shape_struct(
+        config, build_arch(config), config.max_position_embeddings
+    )

@@ -1,0 +1,194 @@
+"""Persimmon family — per-head-interleaved fused qkv, per-head q/k LayerNorm
+(with bias), partial rotary, squared-ReLU MLP, biases everywhere.
+
+Reference: contrib/models/persimmon-8b-base. HF PersimmonForCausalLM
+(modeling_persimmon.py:135-270): ``query_key_value`` views as
+(heads, 3, head_dim) — per-HEAD [q,k,v] interleave (deinterleaved at
+conversion); ``q_layernorm``/``k_layernorm`` are full nn.LayerNorms over
+head_dim applied BEFORE rope; ``rotary_ndims = head_dim *
+partial_rotary_factor``; relu2 ``dense_h_to_4h``/``dense_4h_to_h`` MLP;
+biased LayerNorm block norms; untied lm_head."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.rope import default_inv_freq
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class PersimmonInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = [
+        "hidden_size", "num_attention_heads", "num_hidden_layers",
+        "vocab_size", "intermediate_size",
+    ]
+
+    def add_derived_config(self):
+        self.num_key_value_heads = self.num_attention_heads
+        self.rms_norm_eps = getattr(self, "layer_norm_eps", 1e-5)
+        if not hasattr(self, "partial_rotary_factor"):
+            self.partial_rotary_factor = 0.5
+        if not hasattr(self, "qk_layernorm"):
+            self.qk_layernorm = True
+        if not hasattr(self, "hidden_act"):
+            self.hidden_act = "relu2"
+        if not hasattr(self, "rope_theta"):
+            self.rope_theta = 25000.0
+        self.tie_word_embeddings = False
+        super().add_derived_config()
+
+
+def _rotary_dim(config) -> int:
+    head_dim = config.hidden_size // config.num_attention_heads
+    return int(head_dim * config.partial_rotary_factor)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        layernorm=True,
+        gated_mlp=False,
+        attention_bias=True,
+        attention_o_bias=True,
+        mlp_bias=True,
+        qk_norm=bool(getattr(config, "qk_layernorm", True)),
+        rotary_dim=_rotary_dim(config),
+        hidden_act=getattr(config, "hidden_act", "relu2"),
+        tie_word_embeddings=False,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return default_inv_freq(_rotary_dim(config), getattr(config, "rope_theta", 25000.0))
+
+
+def _deinterleave(w: np.ndarray, heads: int, D: int):
+    """(heads*3*D, ...) per-head [q,k,v] rows -> three (heads*D, ...) arrays
+    (PersimmonAttention._split_heads, modeling_persimmon.py:210-224)."""
+    t = w.reshape((heads, 3, D) + w.shape[1:])
+    return (
+        t[:, 0].reshape((heads * D,) + w.shape[1:]),
+        t[:, 1].reshape((heads * D,) + w.shape[1:]),
+        t[:, 2].reshape((heads * D,) + w.shape[1:]),
+    )
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    heads = config.num_attention_heads
+    D = config.hidden_size // heads
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    sd: Dict[str, np.ndarray] = {
+        "embed_tokens.weight": src("embed_tokens.weight"),
+        "norm.weight": src("final_layernorm.weight"),
+        "lm_head.weight": np.asarray(state_dict["lm_head.weight"]),
+    }
+    norm_biases: Dict[str, np.ndarray] = {"norm": src("final_layernorm.bias")}
+    for i in range(L):
+        pre = f"layers.{i}."
+        qw, kw, vw = _deinterleave(src(pre + "self_attn.query_key_value.weight"), heads, D)
+        qb, kb, vb = _deinterleave(src(pre + "self_attn.query_key_value.bias"), heads, D)
+        sd[pre + "self_attn.q_proj.weight"] = qw
+        sd[pre + "self_attn.k_proj.weight"] = kw
+        sd[pre + "self_attn.v_proj.weight"] = vw
+        sd[pre + "self_attn.q_proj.bias"] = qb
+        sd[pre + "self_attn.k_proj.bias"] = kb
+        sd[pre + "self_attn.v_proj.bias"] = vb
+        sd[pre + "self_attn.o_proj.weight"] = src(pre + "self_attn.dense.weight")
+        sd[pre + "self_attn.o_proj.bias"] = src(pre + "self_attn.dense.bias")
+        if arch.qk_norm:
+            # placeholder arrays keep the dense converter satisfied; the
+            # biased {"w","b"} dicts replace them below
+            sd[pre + "self_attn.q_norm.weight"] = src(pre + "self_attn.q_layernorm.weight")
+            sd[pre + "self_attn.k_norm.weight"] = src(pre + "self_attn.k_layernorm.weight")
+        sd[pre + "input_layernorm.weight"] = src(pre + "input_layernorm.weight")
+        sd[pre + "post_attention_layernorm.weight"] = src(pre + "post_attention_layernorm.weight")
+        norm_biases[f"layers.{i}.input"] = src(pre + "input_layernorm.bias")
+        norm_biases[f"layers.{i}.post"] = src(pre + "post_attention_layernorm.bias")
+        sd[pre + "mlp.up_proj.weight"] = src(pre + "mlp.dense_h_to_4h.weight")
+        sd[pre + "mlp.up_proj.bias"] = src(pre + "mlp.dense_h_to_4h.bias")
+        sd[pre + "mlp.down_proj.weight"] = src(pre + "mlp.dense_4h_to_h.weight")
+        sd[pre + "mlp.down_proj.bias"] = src(pre + "mlp.dense_4h_to_h.bias")
+
+    def ff(get, has, cast, pre):
+        return "mlp", {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T),
+                        "b": cast(get(pre + "mlp.up_proj.bias"))},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T),
+                          "b": cast(get(pre + "mlp.down_proj.bias"))},
+        }
+
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        params["layers"][key] = {
+            "w": params["layers"][key],
+            "b": np.stack(
+                [norm_biases[f"layers.{i}.{'input' if key == 'input_layernorm' else 'post'}"]
+                 for i in range(L)]
+            ).astype(dt),
+        }
+    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    if arch.qk_norm:
+        # per-head LayerNorm with bias: {"w","b"} dicts route _norm onto the
+        # biased-LayerNorm path (same eps as the block norms)
+        params["layers"]["attn"]["q_norm"] = {
+            "w": np.stack([src(f"layers.{i}.self_attn.q_layernorm.weight") for i in range(L)]).astype(dt),
+            "b": np.stack([src(f"layers.{i}.self_attn.q_layernorm.bias") for i in range(L)]).astype(dt),
+        }
+        params["layers"]["attn"]["k_norm"] = {
+            "w": np.stack([src(f"layers.{i}.self_attn.k_layernorm.weight") for i in range(L)]).astype(dt),
+            "b": np.stack([src(f"layers.{i}.self_attn.k_layernorm.bias") for i in range(L)]).astype(dt),
+        }
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    arch = build_arch(config)
+    specs = dense.param_specs_for(arch)
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    if arch.qk_norm:
+        specs["layers"]["attn"]["q_norm"] = {"w": REPLICATED, "b": REPLICATED}
+        specs["layers"]["attn"]["k_norm"] = {"w": REPLICATED, "b": REPLICATED}
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H, D = arch.num_layers, arch.hidden_size, arch.head_dim
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    if arch.qk_norm:
+        struct["layers"]["attn"]["q_norm"] = {"w": s(L, D), "b": s(L, D)}
+        struct["layers"]["attn"]["k_norm"] = {"w": s(L, D), "b": s(L, D)}
+    return struct

@@ -1,0 +1,158 @@
+"""Phi (phi-1/1.5/2) family — parallel attention+MLP block with ONE shared
+LayerNorm, partial rotary, biased everything including the lm_head.
+
+Reference: contrib/models/phi-1_5. HF PhiForCausalLM
+(modeling_phi.py:100-260): ``hidden = attn(ln(x)) + mlp(ln(x)) + x`` with a
+single ``input_layernorm`` (aliased onto the parallel block's MLP slot at
+conversion); ``rotary_ndims = head_dim * partial_rotary_factor``; gelu_new
+``fc1``/``fc2``; model-level ``final_layernorm``; lm_head WITH bias
+(params["lm_head_bias"])."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.ops.rope import default_inv_freq
+from nxdi_tpu.parallel.layers import REPLICATED
+
+
+class PhiInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = [
+        "hidden_size", "num_attention_heads", "num_hidden_layers",
+        "vocab_size", "intermediate_size",
+    ]
+
+    def add_derived_config(self):
+        if not hasattr(self, "num_key_value_heads") or self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+        self.rms_norm_eps = getattr(self, "layer_norm_eps", 1e-5)
+        if not hasattr(self, "partial_rotary_factor"):
+            self.partial_rotary_factor = 0.5
+        if not hasattr(self, "hidden_act"):
+            self.hidden_act = "gelu_new"
+        self.tie_word_embeddings = False
+        super().add_derived_config()
+        if getattr(self, "qk_layernorm", False):
+            raise NotImplementedError("phi qk_layernorm is not supported yet")
+
+
+def _rotary_dim(config) -> int:
+    head_dim = config.hidden_size // config.num_attention_heads
+    return int(head_dim * config.partial_rotary_factor)
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        layernorm=True,
+        parallel_block=True,
+        gated_mlp=False,
+        attention_bias=True,
+        attention_o_bias=True,
+        mlp_bias=True,
+        rotary_dim=_rotary_dim(config),
+        hidden_act=getattr(config, "hidden_act", "gelu_new"),
+        tie_word_embeddings=False,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return default_inv_freq(_rotary_dim(config), getattr(config, "rope_theta", 10000.0))
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    sd: Dict[str, np.ndarray] = {
+        "embed_tokens.weight": src("embed_tokens.weight"),
+        "norm.weight": src("final_layernorm.weight"),
+        "lm_head.weight": np.asarray(state_dict["lm_head.weight"]),
+    }
+    norm_biases: Dict[str, np.ndarray] = {"norm": src("final_layernorm.bias")}
+    for i in range(L):
+        pre = f"layers.{i}."
+        for proj in ("q", "k", "v"):
+            sd[pre + f"self_attn.{proj}_proj.weight"] = src(pre + f"self_attn.{proj}_proj.weight")
+            sd[pre + f"self_attn.{proj}_proj.bias"] = src(pre + f"self_attn.{proj}_proj.bias")
+        sd[pre + "self_attn.o_proj.weight"] = src(pre + "self_attn.dense.weight")
+        sd[pre + "self_attn.o_proj.bias"] = src(pre + "self_attn.dense.bias")
+        # ONE norm: alias onto both parallel-block slots
+        sd[pre + "input_layernorm.weight"] = src(pre + "input_layernorm.weight")
+        sd[pre + "post_attention_layernorm.weight"] = src(pre + "input_layernorm.weight")
+        norm_biases[f"layers.{i}.input"] = src(pre + "input_layernorm.bias")
+        norm_biases[f"layers.{i}.post"] = src(pre + "input_layernorm.bias")
+        sd[pre + "mlp.up_proj.weight"] = src(pre + "mlp.fc1.weight")
+        sd[pre + "mlp.up_proj.bias"] = src(pre + "mlp.fc1.bias")
+        sd[pre + "mlp.down_proj.weight"] = src(pre + "mlp.fc2.weight")
+        sd[pre + "mlp.down_proj.bias"] = src(pre + "mlp.fc2.bias")
+
+    def ff(get, has, cast, pre):
+        return "mlp", {
+            "up_proj": {"w": cast(get(pre + "mlp.up_proj.weight").T),
+                        "b": cast(get(pre + "mlp.up_proj.bias"))},
+            "down_proj": {"w": cast(get(pre + "mlp.down_proj.weight").T),
+                          "b": cast(get(pre + "mlp.down_proj.bias"))},
+        }
+
+    params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
+    for key, tag in (("input_layernorm", "input"), ("post_attention_layernorm", "post")):
+        params["layers"][key] = {
+            "w": params["layers"][key],
+            "b": np.stack([norm_biases[f"layers.{i}.{tag}"] for i in range(L)]).astype(dt),
+        }
+    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    head_bias = np.asarray(state_dict["lm_head.bias"], dtype=np.float32)
+    if arch.vocab_pad:
+        head_bias = np.concatenate([head_bias, np.zeros(arch.vocab_pad, np.float32)])
+    params["lm_head_bias"] = head_bias
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.parallel.mesh import AXIS_MP
+
+    specs = dense.param_specs_for(build_arch(config))
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    specs["lm_head_bias"] = P(AXIS_MP)  # vocab-parallel, like the head columns
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    dt = to_jax_dtype(arch.dtype)
+    L, H = arch.num_layers, arch.hidden_size
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, dt)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    struct["lm_head_bias"] = jax.ShapeDtypeStruct((arch.vocab_size,), jnp.float32)
+    return struct

@@ -111,6 +111,37 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "nxdi_tpu.models.gpt_neox.modeling_gpt_neox",
         "GPTNeoXInferenceConfig",
     ),
+    "ministral": (
+        "nxdi_tpu.models.ministral.modeling_ministral",
+        "MinistralInferenceConfig",
+    ),
+    "hunyuan_v1_dense": (
+        "nxdi_tpu.models.hunyuan.modeling_hunyuan",
+        "HunYuanInferenceConfig",
+    ),
+    "arcee": ("nxdi_tpu.models.arcee.modeling_arcee", "ArceeInferenceConfig"),
+    "gemma": ("nxdi_tpu.models.gemma.modeling_gemma", "GemmaInferenceConfig"),
+    "vaultgemma": (
+        "nxdi_tpu.models.vaultgemma.modeling_vaultgemma",
+        "VaultGemmaInferenceConfig",
+    ),
+    "opt": ("nxdi_tpu.models.opt.modeling_opt", "OPTInferenceConfig"),
+    "biogpt": ("nxdi_tpu.models.biogpt.modeling_biogpt", "BioGptInferenceConfig"),
+    "xglm": ("nxdi_tpu.models.xglm.modeling_xglm", "XGLMInferenceConfig"),
+    "gpt_bigcode": (
+        "nxdi_tpu.models.gpt_bigcode.modeling_gpt_bigcode",
+        "GPTBigCodeInferenceConfig",
+    ),
+    "falcon": ("nxdi_tpu.models.falcon.modeling_falcon", "FalconInferenceConfig"),
+    "persimmon": (
+        "nxdi_tpu.models.persimmon.modeling_persimmon",
+        "PersimmonInferenceConfig",
+    ),
+    "phi": ("nxdi_tpu.models.phi.modeling_phi", "PhiInferenceConfig"),
+    "apertus": (
+        "nxdi_tpu.models.apertus.modeling_apertus",
+        "ApertusInferenceConfig",
+    ),
 }
 
 

@@ -1,0 +1,98 @@
+"""VaultGemma family — gemma2's softcaps + alternating windows WITHOUT the
+sandwich norms.
+
+Reference: contrib/models/vaultgemma-1b. HF VaultGemmaForCausalLM
+(modeling_vaultgemma.py:163-290): two norms per layer only —
+``input_layernorm`` (pre-attention) and ``pre_feedforward_layernorm``
+(pre-MLP, mapped onto the post_attention_layernorm slot); gemma (1+w) f32
+norms, sqrt(H) embed scale, query_pre_attn_scalar softmax scaling, attn +
+final logit softcapping, ``layer_types`` sliding pattern, one rope table."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class VaultGemmaInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = dense.DenseInferenceConfig.REQUIRED + ["head_dim"]
+
+    def add_derived_config(self):
+        if getattr(self, "hidden_activation", None):
+            self.hidden_act = self.hidden_activation
+        elif not hasattr(self, "hidden_act"):
+            self.hidden_act = "gelu_pytorch_tanh"
+        super().add_derived_config()
+        defaults = {
+            "query_pre_attn_scalar": self.head_dim,
+            "sliding_window": None,
+            "attn_logit_softcapping": None,
+            "final_logit_softcapping": None,
+        }
+        for k, v in defaults.items():
+            if not hasattr(self, k):
+                setattr(self, k, v)
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            self.layer_types = [
+                "sliding_attention" if i % 2 == 0 else "full_attention"
+                for i in range(self.num_hidden_layers)
+            ]
+
+
+def _sliding_flags(config):
+    return np.array(
+        [t == "sliding_attention" for t in config.layer_types], dtype=bool
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        gemma_norm=True,
+        embed_scale=float(config.hidden_size) ** 0.5,
+        sliding_window=getattr(config, "sliding_window", None),
+        attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
+        attn_logit_softcap=getattr(config, "attn_logit_softcapping", None),
+        final_logit_softcap=getattr(config, "final_logit_softcapping", None),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    sd = dict(state_dict)
+    for k in list(sd):
+        if "pre_feedforward_layernorm." in k:
+            sd[k.replace("pre_feedforward_layernorm", "post_attention_layernorm")] = sd.pop(k)
+    params = dense.convert_hf_state_dict(sd, config, arch)
+    if getattr(config, "sliding_window", None):
+        flags = _sliding_flags(config)
+        if not flags.all():  # mixed/none: per-layer flags ride the scan
+            params["layers"]["use_sliding_window"] = flags
+    return params
+
+
+def param_specs(config: InferenceConfig):
+    specs = dense.param_specs_for(build_arch(config))
+    if getattr(config, "sliding_window", None) and not _sliding_flags(config).all():
+        specs["layers"]["use_sliding_window"] = REPLICATED
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    import jax
+    import jax.numpy as jnp
+
+    struct = dense.param_shape_struct(config, build_arch(config))
+    if getattr(config, "sliding_window", None) and not _sliding_flags(config).all():
+        struct["layers"]["use_sliding_window"] = jax.ShapeDtypeStruct(
+            (config.num_hidden_layers,), jnp.bool_
+        )
+    return struct

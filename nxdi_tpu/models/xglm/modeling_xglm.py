@@ -1,0 +1,69 @@
+"""XGLM family — fairseq decoder with FIXED sinusoidal positions.
+
+Reference: contrib/models/xglm-564M. HF XGLMForCausalLM (modeling_xglm.py):
+``XGLMSinusoidalPositionalEmbedding`` (tensor2tensor [sin|cos] halves,
+offset 2, padding_idx row zeroed) — regenerated deterministically at
+conversion and baked into the learned-position table; sqrt(H) embed scale,
+biased pre-LayerNorms, gelu fc MLP, model-level ``layer_norm``, tied head."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense, fairseq_dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = fairseq_dense.build_inv_freq
+
+
+class XGLMInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["d_model", "attention_heads", "num_layers", "vocab_size", "ffn_dim"]
+
+    def add_derived_config(self):
+        self.hidden_size = self.d_model
+        self.num_attention_heads = self.attention_heads
+        self.num_hidden_layers = self.num_layers
+        self.num_key_value_heads = self.attention_heads
+        self.intermediate_size = self.ffn_dim
+        self.rms_norm_eps = 1e-5  # nn.LayerNorm default
+        self.hidden_act = getattr(self, "activation_function", "gelu")
+        self.tie_word_embeddings = bool(getattr(self, "tie_word_embeddings", True))
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        hidden_act=getattr(config, "activation_function", "gelu"),
+        tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
+        embed_scale=(
+            float(config.d_model) ** 0.5
+            if getattr(config, "scale_embedding", True) else None
+        ),
+    )
+    kwargs.update(overrides)
+    return fairseq_dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    offset = 2
+    table = fairseq_dense.sinusoid_table(
+        config.max_position_embeddings + offset,
+        config.d_model,
+        padding_idx=getattr(config, "pad_token_id", 1),
+    )
+    return fairseq_dense.convert_hf_state_dict(
+        state_dict, config, build_arch(config),
+        prefix="model.",
+        pos_table=lambda: table,
+        pos_offset=offset,
+        final_norm_key="layer_norm",
+    )
+
+
+def param_specs(config: InferenceConfig):
+    return fairseq_dense.param_specs(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return fairseq_dense.param_shape_struct(
+        config, build_arch(config), config.max_position_embeddings
+    )

@@ -92,6 +92,72 @@ FAMILIES = [
         layer_norm_eps=1e-5, hidden_act="gelu", attention_bias=True,
         _id="gpt_neox-sequential",
     ),
+    # --- round-4 wave 2 ---
+    _case(
+        "ministral", "MinistralForCausalLM",
+        head_dim=16, rope_theta=10000.0, sliding_window=8,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+    ),
+    _case(
+        "hunyuan_v1_dense", "HunYuanDenseV1ForCausalLM",
+        head_dim=16, rope_theta=10000.0,
+    ),
+    _case("arcee", "ArceeForCausalLM", rope_theta=10000.0),
+    _case(
+        "gemma", "GemmaForCausalLM",
+        head_dim=16, rope_theta=10000.0, tie_word_embeddings=True,
+    ),
+    _case(
+        "vaultgemma", "VaultGemmaForCausalLM",
+        head_dim=16, query_pre_attn_scalar=16.0, rope_theta=10000.0,
+        sliding_window=8, attn_logit_softcapping=50.0,
+        final_logit_softcapping=30.0, tie_word_embeddings=True,
+        layer_types=["sliding_attention", "full_attention"] * 2,
+    ),
+    _case(
+        "opt", "OPTForCausalLM",
+        ffn_dim=128, word_embed_proj_dim=64, do_layer_norm_before=True,
+        activation_function="relu", tie_word_embeddings=True,
+    ),
+    _case(
+        "biogpt", "BioGptForCausalLM",
+        scale_embedding=True, hidden_act="gelu", tie_word_embeddings=True,
+    ),
+    _case(
+        "xglm", "XGLMForCausalLM",
+        ffn_dim=128, activation_function="gelu", tie_word_embeddings=True,
+    ),
+    _case(
+        "gpt_bigcode", "GPTBigCodeForCausalLM",
+        multi_query=True, activation_function="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+    ),
+    _case(
+        "gpt_bigcode", "GPTBigCodeForCausalLM", _id="gpt_bigcode-mha",
+        multi_query=False, activation_function="gelu_pytorch_tanh",
+        tie_word_embeddings=True,
+    ),
+    _case(
+        "falcon", "FalconForCausalLM", _id="falcon-7b-style",
+        multi_query=True, parallel_attn=True, new_decoder_architecture=False,
+        bias=False, alibi=False, rope_theta=10000.0, tie_word_embeddings=True,
+    ),
+    _case(
+        "falcon", "FalconForCausalLM", _id="falcon-new-arch",
+        multi_query=False, parallel_attn=True, new_decoder_architecture=True,
+        num_kv_heads=2, bias=True, alibi=False, rope_theta=10000.0,
+        tie_word_embeddings=True,
+    ),
+    _case(
+        "persimmon", "PersimmonForCausalLM",
+        hidden_act="relu2", partial_rotary_factor=0.5, qk_layernorm=True,
+        rope_theta=10000.0,
+    ),
+    _case(
+        "phi", "PhiForCausalLM",
+        partial_rotary_factor=0.5, hidden_act="gelu_new", rope_theta=10000.0,
+    ),
+    _case("apertus", "ApertusForCausalLM", rope_theta=10000.0, rope_scaling=None),
 ]
 
 
@@ -106,7 +172,13 @@ def _build(model_type, hf_cls_name, cfg_kwargs, tp_degree):
     kwargs.update(cfg_kwargs)
     hf_cfg = hf_cfg_cls(**kwargs)
     hf_model = getattr(transformers, hf_cls_name)(hf_cfg).eval()
-    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    sd = {
+        # bf16 leaves (apertus xielu alphas) have no numpy dtype; widen to f32
+        # (exact) — the family converter re-applies the bf16 rounding itself
+        k: (v.detach().float().numpy() if v.dtype == torch.bfloat16
+            else v.detach().numpy())
+        for k, v in hf_model.state_dict().items()
+    }
 
     family, cfg_cls = get_family(model_type)
     tcfg = TpuConfig(
