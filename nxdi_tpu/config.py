@@ -603,18 +603,29 @@ class TpuConfig:
                 )
             if (
                 self.is_block_kv_layout
-                or self.speculation_length > 0
-                or self.enable_fused_speculation
                 or self.is_medusa
                 or self.is_prefix_caching
                 or self.is_chunked_prefill
                 or self.flash_decoding_enabled
             ):
                 raise ValueError(
-                    "window_sized_kv composes with plain decode only: paged/"
-                    "speculative/prefix modes assume position-addressed cache "
-                    "slots, which the ring layout does not provide"
+                    "window_sized_kv composes with contiguous decode (and "
+                    "linear speculation) only: paged/medusa/prefix modes "
+                    "assume position-addressed cache slots, which the ring "
+                    "layout does not provide"
                 )
+            if self.speculation_length > 0 or self.enable_fused_speculation:
+                # linear speculation over a ring: the ring is over-provisioned
+                # by spec_len+1 slots so rejected-draft writes can never
+                # clobber a slot still inside any query's attention window,
+                # and stale rejected rows always resolve to out-of-window
+                # positions (see kvcache WindowKVLayout docstring)
+                if self.window_ring_slots > self.seq_len:
+                    raise ValueError(
+                        f"window_sized_kv + speculation needs sliding_window +"
+                        f" speculation_length + 1 = {self.window_ring_slots} "
+                        f"ring slots, which exceeds seq_len ({self.seq_len})"
+                    )
         if self.mlp_cp_degree and self.mlp_cp_degree > 1:
             if not self.sequence_parallel_enabled:
                 raise ValueError(
@@ -658,6 +669,18 @@ class TpuConfig:
         "lora_config": LoraServingConfig,
         "hybrid_sharding_config": HybridShardingConfig,
     }
+
+    @property
+    def window_ring_slots(self) -> int:
+        """Slot count of the window-sized ring stacks. Plain decode rings
+        hold exactly ``sliding_window`` slots; under linear speculation the
+        ring is over-provisioned by the spec window (spec_len + 1) so
+        rejected-draft writes land in slots whose previous occupants are
+        already outside every query's attention window."""
+        lookahead = (
+            self.speculation_length + 1 if self.speculation_length > 0 else 0
+        )
+        return int(self.sliding_window or 0) + lookahead
 
     def to_dict(self) -> Dict[str, Any]:
         out = {}

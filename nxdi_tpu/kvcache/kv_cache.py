@@ -420,10 +420,12 @@ class WindowKVLayout:
     care about.
 
     Reads (decode): slot ``s`` holds position ``p - ((p - s) mod W)`` for the
-    current position ``p``; slots that would be negative (early decode) are
-    pushed out of every causal mask. Single-position decode only — the
-    in-window read-after-write interleaving of speculation windows has no
-    consistent ring state, and applications reject those combinations.
+    FIRST query position ``p`` (single-token decode: the position; spec
+    verify windows: the committed length); slots that would be negative
+    (early decode) are pushed out of every causal mask. Linear speculation
+    composes via ring over-provisioning (W = sliding_window + spec_len + 1,
+    TpuConfig.window_ring_slots — see commit_rows); medusa/tree positions
+    stay rejected at config level.
     """
 
     window: int
@@ -467,17 +469,21 @@ class WindowKVLayout:
         return kk, vv, kv_pos
 
     def commit_rows(self, cache, k_rows, v_rows, cache_inputs, spec, policy=None):
-        """Deferred-write commit into the ring: the single decode row lands at
-        slot ``pos % W``. Correctness of attending the OLD ring before this
+        """Deferred-write commit into the ring: row for position ``p`` lands
+        at slot ``p % W``. Correctness of attending the OLD ring before this
         commit: the stale row in that slot reports kv_pos == pos (ring math in
         ``read``), which the deferred poison mask excludes, and its true
-        position pos - W is outside the window anyway. Single-position decode
-        only — speculation windows are rejected at config level."""
+        position pos - W is outside the window anyway.
+
+        Multi-position windows (linear speculation verify) are safe because
+        the ring is over-provisioned by the spec window
+        (TpuConfig.window_ring_slots = sliding_window + spec_len + 1): every
+        slot this commit clobbers previously held position ``p - W_ring``,
+        which is below every future query's attention window, and a stale
+        REJECTED row at position ``p_r`` resolves (for any later query
+        ``q < p_r``) to inferred position ``p_r - W_ring`` — also out of
+        window — until the true token at ``p_r`` overwrites it."""
         position_ids = cache_inputs["position_ids"]
-        if position_ids.shape[1] != 1:
-            raise NotImplementedError(
-                "window ring deferred commit is single-position (decode) only"
-            )
         W = self.window
         pos = position_ids.astype(jnp.int32)
         slots = jnp.where(pos >= 0, pos % W, jnp.int32(-1))  # neg = drop

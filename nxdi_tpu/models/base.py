@@ -1203,11 +1203,6 @@ def _interleaved_window_scan(
             "cache carries a k_win ring stack but arch.kv_window_pattern is "
             f"unset or mismatched (pattern {pat}, layers {arch.num_layers})"
         )
-    if collect_hidden or layer_injections is not None:
-        raise NotImplementedError(
-            "interleaved window-sized KV does not compose with EAGLE3 aux "
-            "taps / tensor capture / deepstack injections"
-        )
     if isinstance(layer_params, (list, tuple)):
         raise NotImplementedError(
             "interleaved window-sized KV requires a homogeneous layer stack"
@@ -1228,10 +1223,11 @@ def _interleaved_window_scan(
     unit_params = jax.tree_util.tree_map(unit, layer_params)
     kf, vf = unit(cache["k"]), unit(cache["v"])
     kw, vw = unit(cache["k_win"]), unit(cache["v_win"])
+    inj_u = unit(layer_injections) if layer_injections is not None else None
 
     def unit_body(h, xs):
-        lp_u, kf_u, vf_u, kw_u, vw_u = xs
-        rows_f, rows_w = [], []
+        lp_u, kf_u, vf_u, kw_u, vw_u, inj_unit = xs
+        rows_f, rows_w, hs = [], [], []
         fi = wi = 0
         for j in range(p):
             lp = jax.tree_util.tree_map(lambda x: x[j], lp_u)
@@ -1250,6 +1246,10 @@ def _interleaved_window_scan(
                 )
                 rows_f.append((nk, nv))
                 fi += 1
+            if inj_unit is not None:  # deepstack: per-layer residual adds
+                h = h + inj_unit[j].astype(h.dtype)
+            if collect_hidden:
+                hs.append(h)
 
         def stack(rows):
             return (
@@ -1257,11 +1257,15 @@ def _interleaved_window_scan(
                 jnp.stack([r[1] for r in rows]),
             )
 
-        return h, (stack(rows_f), stack(rows_w))
+        ys = (stack(rows_f), stack(rows_w))
+        if collect_hidden:
+            ys = ys + (jnp.stack(hs),)  # (p, B, S, hidden), layer order
+        return h, ys
 
-    hidden, ((ys_kf, ys_vf), (ys_kw, ys_vw)) = jax.lax.scan(
-        unit_body, hidden, (unit_params, kf, vf, kw, vw)
+    hidden, ys_all = jax.lax.scan(
+        unit_body, hidden, (unit_params, kf, vf, kw, vw, inj_u)
     )
+    (ys_kf, ys_vf), (ys_kw, ys_vw) = ys_all[0], ys_all[1]
 
     def flat(y):  # (U, per_unit, ...) -> (L_kind, ...)
         return y.reshape((-1,) + y.shape[2:])
@@ -1280,12 +1284,17 @@ def _interleaved_window_scan(
     else:
         full_new = {"k": flat(ys_kf), "v": flat(ys_vf)}
         win_new = {"k": flat(ys_kw), "v": flat(ys_vw)}
-    return hidden, {
+    new_cache = {
         "k": full_new["k"],
         "v": full_new["v"],
         "k_win": win_new["k"],
         "v_win": win_new["v"],
     }
+    if collect_hidden:
+        # (U, p, B, S, hidden) -> (L, B, S, hidden) in global layer order
+        layer_h = ys_all[2].reshape((-1,) + ys_all[2].shape[2:])
+        return hidden, new_cache, layer_h
+    return hidden, new_cache
 
 
 def _extract_stacked_weights(arch: DecoderArch, seg):

@@ -40,11 +40,14 @@ class Cohere2InferenceConfig(dense.DenseInferenceConfig):
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
     kwargs = dict(
         parallel_block=True,
         layernorm=True,
         rope_interleaved=True,
-        sliding_window=getattr(config, "sliding_window", None),
+        sliding_window=sw,
+        # window_sized_kv: full-attention layers stay off the ring
+        kv_window_pattern=tuple(_flags(config)) if sw else None,
         logits_scaling=1.0 / float(getattr(config, "logit_scale", 1.0)),
         tie_word_embeddings=bool(getattr(config, "tie_word_embeddings", True)),
     )

@@ -337,6 +337,46 @@ def param_shape_struct(config: InferenceConfig, arch: DecoderArch):
     return params
 
 
+def attach_norm_biases(params, input_biases, post_biases, final_bias, dtype):
+    """Biased-LayerNorm families (gpt2 lineage, fairseq lineage, falcon,
+    persimmon, phi): replace the weight-only block-norm arrays with
+    ``{"w","b"}`` dicts (the _norm dict contract, models/base.py) from
+    per-layer bias lists + the model-level final-norm bias."""
+    params["layers"]["input_layernorm"] = {
+        "w": params["layers"]["input_layernorm"],
+        "b": np.stack(input_biases).astype(dtype),
+    }
+    params["layers"]["post_attention_layernorm"] = {
+        "w": params["layers"]["post_attention_layernorm"],
+        "b": np.stack(post_biases).astype(dtype),
+    }
+    params["norm"] = {"w": params["norm"], "b": np.asarray(final_bias, dtype=dtype)}
+    return params
+
+
+def biased_layernorm_specs(specs):
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.parallel.layers import REPLICATED
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
+    specs["norm"] = {"w": P(), "b": P()}
+    return specs
+
+
+def biased_layernorm_struct(struct, L, H, jax_dtype):
+    import jax
+
+    def s(*shape):
+        return jax.ShapeDtypeStruct(shape, jax_dtype)
+
+    for key in ("input_layernorm", "post_attention_layernorm"):
+        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
+    struct["norm"] = {"w": s(H), "b": s(H)}
+    return struct
+
+
 def tree_stack(trees):
     """Stack a list of identical pytrees along a new leading (layer) axis."""
     import jax

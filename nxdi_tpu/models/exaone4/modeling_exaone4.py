@@ -41,10 +41,15 @@ class Exaone4InferenceConfig(dense.DenseInferenceConfig):
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
     kwargs = dict(
         post_block_norm=True,
         qk_norm=True,
-        sliding_window=getattr(config, "sliding_window", None),
+        sliding_window=sw,
+        # window_sized_kv: full-attention layers stay off the ring
+        kv_window_pattern=(
+            tuple(bool(f) for f in _layer_flags(config)[0]) if sw else None
+        ),
     )
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)

@@ -114,15 +114,12 @@ def convert_hf_state_dict(
         }
 
     params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
-    params["layers"]["input_layernorm"] = {
-        "w": params["layers"]["input_layernorm"],
-        "b": np.stack([norm_biases[f"layers.{i}.input"] for i in range(L)]).astype(dt),
-    }
-    params["layers"]["post_attention_layernorm"] = {
-        "w": params["layers"]["post_attention_layernorm"],
-        "b": np.stack([norm_biases[f"layers.{i}.post"] for i in range(L)]).astype(dt),
-    }
-    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    dense.attach_norm_biases(
+        params,
+        [norm_biases[f"layers.{i}.input"] for i in range(L)],
+        [norm_biases[f"layers.{i}.post"] for i in range(L)],
+        norm_biases["norm"], dt,
+    )
     if pos_table is not None:
         table = np.asarray(pos_table())
     else:
@@ -134,12 +131,7 @@ def convert_hf_state_dict(
 
 
 def param_specs(arch: DecoderArch):
-    from jax.sharding import PartitionSpec as P
-
-    specs = dense.param_specs_for(arch)
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
-    specs["norm"] = {"w": P(), "b": P()}
+    specs = dense.biased_layernorm_specs(dense.param_specs_for(arch))
     specs["position_embeddings"] = REPLICATED
     return specs
 
@@ -149,15 +141,12 @@ def param_shape_struct(config: InferenceConfig, arch: DecoderArch, num_positions
 
     from nxdi_tpu.config import to_jax_dtype
 
-    struct = dense.param_shape_struct(config, arch)
     dt = to_jax_dtype(arch.dtype)
-    L, H = arch.num_layers, arch.hidden_size
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, dt)
-
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
-    struct["norm"] = {"w": s(H), "b": s(H)}
-    struct["position_embeddings"] = s(num_positions, H)
+    struct = dense.biased_layernorm_struct(
+        dense.param_shape_struct(config, arch),
+        arch.num_layers, arch.hidden_size, dt,
+    )
+    struct["position_embeddings"] = jax.ShapeDtypeStruct(
+        (num_positions, arch.hidden_size), dt
+    )
     return struct

@@ -20,7 +20,6 @@ import numpy as np
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
-from nxdi_tpu.parallel.layers import REPLICATED
 
 build_inv_freq = dense.build_inv_freq
 
@@ -169,42 +168,23 @@ def convert_hf_state_dict(
     params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
     dt = dense.np_dtype(arch.dtype)
     L = arch.num_layers
-    params["layers"]["input_layernorm"] = {
-        "w": params["layers"]["input_layernorm"],
-        "b": np.stack([norm_biases[f"layers.{i}.input"] for i in range(L)]).astype(dt),
-    }
-    params["layers"]["post_attention_layernorm"] = {
-        "w": params["layers"]["post_attention_layernorm"],
-        "b": np.stack([norm_biases[f"layers.{i}.post"] for i in range(L)]).astype(dt),
-    }
-    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
-    return params
+    return dense.attach_norm_biases(
+        params,
+        [norm_biases[f"layers.{i}.input"] for i in range(L)],
+        [norm_biases[f"layers.{i}.post"] for i in range(L)],
+        norm_biases["norm"], dt,
+    )
 
 
 def param_specs(config: InferenceConfig):
-    from jax.sharding import PartitionSpec as P
-
-    specs = dense.param_specs_for(build_arch(config))
-    specs["layers"]["input_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
-    specs["layers"]["post_attention_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
-    specs["norm"] = {"w": P(), "b": P()}
-    return specs
+    return dense.biased_layernorm_specs(dense.param_specs_for(build_arch(config)))
 
 
 def param_shape_struct(config: InferenceConfig):
-    import jax
-
     from nxdi_tpu.config import to_jax_dtype
 
     arch = build_arch(config)
-    struct = dense.param_shape_struct(config, arch)
-    dt = to_jax_dtype(arch.dtype)
-    L, H = arch.num_layers, arch.hidden_size
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, dt)
-
-    struct["layers"]["input_layernorm"] = {"w": s(L, H), "b": s(L, H)}
-    struct["layers"]["post_attention_layernorm"] = {"w": s(L, H), "b": s(L, H)}
-    struct["norm"] = {"w": s(H), "b": s(H)}
-    return struct
+    return dense.biased_layernorm_struct(
+        dense.param_shape_struct(config, arch),
+        arch.num_layers, arch.hidden_size, to_jax_dtype(arch.dtype),
+    )

@@ -48,16 +48,23 @@ def _layer_is_sliding(config: InferenceConfig, i: int) -> bool:
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
     kwargs = dict(
         qk_norm=False,
         gemma_norm=True,
         sandwich_norm=True,
         embed_scale=float(config.hidden_size) ** 0.5,
-        sliding_window=getattr(config, "sliding_window", None),
+        sliding_window=sw,
         attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
         attn_logit_softcap=getattr(config, "attn_logit_softcapping", None),
         final_logit_softcap=getattr(config, "final_logit_softcapping", None),
         tie_word_embeddings=getattr(config, "tie_word_embeddings", True),
+        # window_sized_kv: full-attention layers stay off the ring
+        kv_window_pattern=(
+            tuple(_layer_is_sliding(config, i)
+                  for i in range(config.num_hidden_layers))
+            if sw else None
+        ),
     )
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)

@@ -123,26 +123,18 @@ def convert_hf_state_dict(
     params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
     dt = dense.np_dtype(arch.dtype)
     L = arch.num_layers
-    params["layers"]["input_layernorm"] = {
-        "w": params["layers"]["input_layernorm"],
-        "b": np.stack([norm_biases[f"layers.{i}.input"] for i in range(L)]).astype(dt),
-    }
-    params["layers"]["post_attention_layernorm"] = {
-        "w": params["layers"]["post_attention_layernorm"],
-        "b": np.stack([norm_biases[f"layers.{i}.post"] for i in range(L)]).astype(dt),
-    }
-    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    dense.attach_norm_biases(
+        params,
+        [norm_biases[f"layers.{i}.input"] for i in range(L)],
+        [norm_biases[f"layers.{i}.post"] for i in range(L)],
+        norm_biases["norm"], dt,
+    )
     params["position_embeddings"] = np.asarray(src("wpe.weight"), dtype=dt)
     return params
 
 
 def param_specs(config: InferenceConfig):
-    from jax.sharding import PartitionSpec as P
-
-    specs = dense.param_specs_for(build_arch(config))
-    specs["layers"]["input_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
-    specs["layers"]["post_attention_layernorm"] = {"w": REPLICATED, "b": REPLICATED}
-    specs["norm"] = {"w": P(), "b": P()}
+    specs = dense.biased_layernorm_specs(dense.param_specs_for(build_arch(config)))
     specs["position_embeddings"] = REPLICATED
     return specs
 
@@ -153,15 +145,12 @@ def param_shape_struct(config: InferenceConfig):
     from nxdi_tpu.config import to_jax_dtype
 
     arch = build_arch(config)
-    struct = dense.param_shape_struct(config, arch)
     dt = to_jax_dtype(arch.dtype)
-    L, H = arch.num_layers, arch.hidden_size
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, dt)
-
-    struct["layers"]["input_layernorm"] = {"w": s(L, H), "b": s(L, H)}
-    struct["layers"]["post_attention_layernorm"] = {"w": s(L, H), "b": s(L, H)}
-    struct["norm"] = {"w": s(H), "b": s(H)}
-    struct["position_embeddings"] = s(config.n_positions, H)
+    struct = dense.biased_layernorm_struct(
+        dense.param_shape_struct(config, arch),
+        arch.num_layers, arch.hidden_size, dt,
+    )
+    struct["position_embeddings"] = jax.ShapeDtypeStruct(
+        (config.n_positions, arch.hidden_size), dt
+    )
     return struct

@@ -1,0 +1,253 @@
+"""Janus (DeepSeek) family — SigLIP-style CLS-less vision tower + aligner MLP
++ llama language model (text/understanding mode).
+
+Reference: contrib/models/Janus-1.3B. HF JanusForConditionalGeneration
+(modeling_janus.py:144-1200): conv patch embed + learned per-patch positions
+(no class token, no pre-layernorm), pre-norm ViT blocks whose attention out
+projection is ``projection_layer``, model-level ``post_layernorm``, then the
+``aligner`` MLP (fc1 + (depth-1) hidden linears with gelu between) into the
+LM hidden space; image features replace ``image_token_id`` placeholders.
+The image-GENERATION path (VQVAE decoder, generation_* modules) is out of
+scope — text generation only, like the reference contrib port."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops import vision as vision_ops
+from nxdi_tpu.ops.norms import layer_norm
+
+
+class JanusInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["text_config", "vision_config"]
+
+    def add_derived_config(self):
+        from nxdi_tpu.config import promote_text_config
+
+        promote_text_config(self)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        if not hasattr(self, "image_token_index"):
+            self.image_token_index = getattr(self, "image_token_id", 100581)
+        super().add_derived_config()
+        if self.vision_config.get("use_qk_norm", False):
+            raise NotImplementedError("janus vision use_qk_norm is not supported yet")
+
+
+@dataclass(frozen=True)
+class JanusVisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int = 3
+    hidden_act: str = "gelu"
+    layer_norm_eps: float = 1e-6
+    attention_bias: bool = True
+    aligner_depth: int = 2
+    projection_dim: int = 2048
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    return dense.build_arch(config, **overrides)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return dense.build_inv_freq(config)
+
+
+def build_vision_arch(config: InferenceConfig) -> JanusVisionArch:
+    vc = config.vision_config
+    return JanusVisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=int(vc["hidden_size"] * vc.get("mlp_ratio", 4.0)),
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        hidden_act=vc.get("hidden_act", "gelu"),
+        layer_norm_eps=vc.get("layer_norm_eps", 1e-6),
+        attention_bias=vc.get("attention_bias", True),
+        aligner_depth=vc.get("depth", 2),
+        projection_dim=vc.get("projection_dim", 2048),
+    )
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    return build_vision_arch(config).num_patches
+
+
+def _strip_text_prefix(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    out = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+            if k.startswith(prefix):
+                out[k[len(prefix):]] = v
+                break
+        else:
+            if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                out["lm_head.weight"] = v
+    return out
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    return dense.convert_hf_state_dict(
+        _strip_text_prefix(state_dict), config, build_arch(config)
+    )
+
+
+def janus_vision_forward(
+    arch: JanusVisionArch, params: Dict[str, Any], pixel_values: jax.Array
+) -> jax.Array:
+    """pixel_values (B, C, H, W) -> post-layernorm patch features (B, N, Hv)
+    (HF JanusVisionModel.forward)."""
+    B = pixel_values.shape[0]
+    P, C = arch.patch_size, arch.num_channels
+    g = arch.image_size // P
+    # conv with stride=patch == unfold into patches + one matmul (MXU path)
+    x = pixel_values.reshape(B, C, g, P, g, P)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(B, g * g, C * P * P)
+    h = x @ params["patch_embedding"] + params["patch_bias"]
+    h = h + params["position_embedding"][None]
+
+    def body(carry, lp):
+        res = carry
+        y = layer_norm(res, lp["ln1"]["w"], lp["ln1"]["b"], eps=arch.layer_norm_eps)
+        res = res + vision_ops._vit_attention(lp["attn"], y, arch.num_heads)
+        y = layer_norm(res, lp["ln2"]["w"], lp["ln2"]["b"], eps=arch.layer_norm_eps)
+        y = vision_ops.ACTS[arch.hidden_act](y @ lp["fc1"]["w"] + lp["fc1"]["b"])
+        res = res + (y @ lp["fc2"]["w"] + lp["fc2"]["b"])
+        return res, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return layer_norm(
+        h, params["post_layernorm"]["w"], params["post_layernorm"]["b"],
+        eps=arch.layer_norm_eps,
+    )
+
+
+def encode_images(varch: JanusVisionArch, params: Dict[str, Any], pixel_values):
+    feat = janus_vision_forward(varch, params["vision"], pixel_values)
+    # aligner MLP: fc1, then (depth-1) x [gelu, linear] (JanusVisionAlignerMLP)
+    p = params["projector"]
+    h = feat @ p["fc1"]["w"] + p["fc1"]["b"]
+    for hp in p["hidden"]:
+        h = vision_ops.ACTS[varch.hidden_act](h)
+        h = h @ hp["w"] + hp["b"]
+    return h
+
+
+def convert_vision_params(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig, dtype=np.float32
+) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in ("model." + name, name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=dtype)
+        raise KeyError(name)
+
+    conv = get("vision_model.embeddings.patch_embedding.weight")  # (Hv, C, P, P)
+    vision: Dict[str, Any] = {
+        "patch_embedding": conv.reshape(conv.shape[0], -1).T,
+        "patch_bias": get("vision_model.embeddings.patch_embedding.bias"),
+        "position_embedding": get("vision_model.embeddings.position_embedding.weight"),
+        "post_layernorm": {
+            "w": get("vision_model.post_layernorm.weight"),
+            "b": get("vision_model.post_layernorm.bias"),
+        },
+    }
+    layers = []
+    for i in range(varch.num_layers):
+        pre = f"vision_model.encoder.layers.{i}."
+        attn = {
+            name: {
+                "w": get(pre + f"self_attn.{name}.weight").T,
+                "b": get(pre + f"self_attn.{name}.bias"),
+            }
+            for name in ("q_proj", "k_proj", "v_proj")
+        }
+        attn["out_proj"] = {
+            "w": get(pre + "self_attn.projection_layer.weight").T,
+            "b": get(pre + "self_attn.projection_layer.bias"),
+        }
+        layers.append({
+            "attn": attn,
+            "ln1": {"w": get(pre + "layer_norm1.weight"), "b": get(pre + "layer_norm1.bias")},
+            "ln2": {"w": get(pre + "layer_norm2.weight"), "b": get(pre + "layer_norm2.bias")},
+            "fc1": {"w": get(pre + "mlp.fc1.weight").T, "b": get(pre + "mlp.fc1.bias")},
+            "fc2": {"w": get(pre + "mlp.fc2.weight").T, "b": get(pre + "mlp.fc2.bias")},
+        })
+    import jax.tree_util as jtu
+
+    vision["layers"] = jtu.tree_map(lambda *xs: np.stack(xs), *layers)
+
+    projector: Dict[str, Any] = {
+        "fc1": {"w": get("aligner.fc1.weight").T, "b": get("aligner.fc1.bias")},
+        "hidden": [
+            {
+                "w": get(f"aligner.hidden_layers.{j}.weight").T,
+                "b": get(f"aligner.hidden_layers.{j}.bias"),
+            }
+            for j in range(varch.aligner_depth - 1)
+        ],
+    }
+    return {"vision": vision, "projector": projector}
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    P2 = varch.num_channels * varch.patch_size ** 2
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, np.float32)  # noqa: E731
+    lin = lambda i, o: {"w": s(L, i, o), "b": s(L, o)}  # noqa: E731
+    return {
+        "vision": {
+            "patch_embedding": s(P2, Hv),
+            "patch_bias": s(Hv),
+            "position_embedding": s(varch.num_patches, Hv),
+            "post_layernorm": {"w": s(Hv), "b": s(Hv)},
+            "layers": {
+                "attn": {
+                    n: lin(Hv, Hv) for n in ("q_proj", "k_proj", "v_proj", "out_proj")
+                },
+                "ln1": {"w": s(L, Hv), "b": s(L, Hv)},
+                "ln2": {"w": s(L, Hv), "b": s(L, Hv)},
+                "fc1": lin(Hv, Iv),
+                "fc2": lin(Iv, Hv),
+            },
+        },
+        "projector": {
+            "fc1": {"w": s(Hv, varch.projection_dim), "b": s(varch.projection_dim)},
+            "hidden": [
+                {"w": s(varch.projection_dim, varch.projection_dim), "b": s(varch.projection_dim)}
+                for _ in range(varch.aligner_depth - 1)
+            ],
+        },
+    }
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

@@ -31,7 +31,7 @@ class MiMoV2Application(TpuModelForCausalLM):
             if flag:
                 raise NotImplementedError(f"mimo_v2 does not support {why} yet")
 
-    def _interleaved_window_split(self, arch=None):
+    def _interleaved_window_split(self, arch=None, family=None, config=None):
         return None  # mimo manages its own dual stacks (k_swa/v_swa)
 
     def _cache_spec(self, family=None, config=None):
@@ -54,7 +54,9 @@ class MiMoV2Application(TpuModelForCausalLM):
         # per-layer window-sized cache shapes, kv_cache_manager.py:195-210)
         max_len = tc.seq_len
         if getattr(tc, "window_sized_kv", False):
-            max_len = min(max_len, tc.sliding_window)
+            # window_ring_slots over-provisions by spec_len+1 under linear
+            # speculation so rejected-draft writes never clobber live rows
+            max_len = min(max_len, tc.window_ring_slots)
         spec = arch.swa.kv_cache_spec(
             B, max_len,
             quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),

@@ -37,7 +37,13 @@ def _sliding_flags(config):
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    kwargs = dict(sliding_window=getattr(config, "sliding_window", None))
+    sw = getattr(config, "sliding_window", None)
+    kwargs = dict(
+        sliding_window=sw,
+        # window_sized_kv: full-attention layers must keep full-length KV —
+        # the pattern routes them off the ring (models/base.py unit scan)
+        kv_window_pattern=tuple(_sliding_flags(config)) if sw else None,
+    )
     kwargs.update(overrides)
     return dense.build_arch(config, **kwargs)
 

@@ -30,7 +30,12 @@ class Olmo3InferenceConfig(dense.DenseInferenceConfig):
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
-    kwargs = dict(sliding_window=getattr(config, "sliding_window", None))
+    sw = getattr(config, "sliding_window", None)
+    kwargs = dict(
+        sliding_window=sw,
+        # window_sized_kv: full-attention layers stay off the ring
+        kv_window_pattern=tuple(_sliding_flags(config)) if sw else None,
+    )
     kwargs.update(overrides)
     return olmo2.build_arch(config, **kwargs)
 

@@ -135,15 +135,12 @@ def convert_hf_state_dict(
         }
 
     params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        params["layers"][key] = {
-            "w": params["layers"][key],
-            "b": np.stack(
-                [norm_biases[f"layers.{i}.{'input' if key == 'input_layernorm' else 'post'}"]
-                 for i in range(L)]
-            ).astype(dt),
-        }
-    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    dense.attach_norm_biases(
+        params,
+        [norm_biases[f"layers.{i}.input"] for i in range(L)],
+        [norm_biases[f"layers.{i}.post"] for i in range(L)],
+        norm_biases["norm"], dt,
+    )
     if arch.qk_norm:
         # per-head LayerNorm with bias: {"w","b"} dicts route _norm onto the
         # biased-LayerNorm path (same eps as the block norms)
@@ -159,13 +156,8 @@ def convert_hf_state_dict(
 
 
 def param_specs(config: InferenceConfig):
-    from jax.sharding import PartitionSpec as P
-
     arch = build_arch(config)
-    specs = dense.param_specs_for(arch)
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
-    specs["norm"] = {"w": P(), "b": P()}
+    specs = dense.biased_layernorm_specs(dense.param_specs_for(arch))
     if arch.qk_norm:
         specs["layers"]["attn"]["q_norm"] = {"w": REPLICATED, "b": REPLICATED}
         specs["layers"]["attn"]["k_norm"] = {"w": REPLICATED, "b": REPLICATED}
@@ -178,17 +170,14 @@ def param_shape_struct(config: InferenceConfig):
     from nxdi_tpu.config import to_jax_dtype
 
     arch = build_arch(config)
-    struct = dense.param_shape_struct(config, arch)
     dt = to_jax_dtype(arch.dtype)
-    L, H, D = arch.num_layers, arch.hidden_size, arch.head_dim
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, dt)
-
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
-    struct["norm"] = {"w": s(H), "b": s(H)}
+    struct = dense.biased_layernorm_struct(
+        dense.param_shape_struct(config, arch),
+        arch.num_layers, arch.hidden_size, dt,
+    )
     if arch.qk_norm:
+        L, D = arch.num_layers, arch.head_dim
+        s = lambda *shape: jax.ShapeDtypeStruct(shape, dt)  # noqa: E731
         struct["layers"]["attn"]["q_norm"] = {"w": s(L, D), "b": s(L, D)}
         struct["layers"]["attn"]["k_norm"] = {"w": s(L, D), "b": s(L, D)}
     return struct

@@ -18,7 +18,6 @@ from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
 from nxdi_tpu.ops.rope import default_inv_freq
-from nxdi_tpu.parallel.layers import REPLICATED
 
 
 class PhiInferenceConfig(dense.DenseInferenceConfig):
@@ -111,12 +110,12 @@ def convert_hf_state_dict(
         }
 
     params = dense.convert_hf_state_dict(sd, config, arch, ff_converter=ff)
-    for key, tag in (("input_layernorm", "input"), ("post_attention_layernorm", "post")):
-        params["layers"][key] = {
-            "w": params["layers"][key],
-            "b": np.stack([norm_biases[f"layers.{i}.{tag}"] for i in range(L)]).astype(dt),
-        }
-    params["norm"] = {"w": params["norm"], "b": norm_biases["norm"].astype(dt)}
+    dense.attach_norm_biases(
+        params,
+        [norm_biases[f"layers.{i}.input"] for i in range(L)],
+        [norm_biases[f"layers.{i}.post"] for i in range(L)],
+        norm_biases["norm"], dt,
+    )
     head_bias = np.asarray(state_dict["lm_head.bias"], dtype=np.float32)
     if arch.vocab_pad:
         head_bias = np.concatenate([head_bias, np.zeros(arch.vocab_pad, np.float32)])
@@ -129,10 +128,7 @@ def param_specs(config: InferenceConfig):
 
     from nxdi_tpu.parallel.mesh import AXIS_MP
 
-    specs = dense.param_specs_for(build_arch(config))
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        specs["layers"][key] = {"w": REPLICATED, "b": REPLICATED}
-    specs["norm"] = {"w": P(), "b": P()}
+    specs = dense.biased_layernorm_specs(dense.param_specs_for(build_arch(config)))
     specs["lm_head_bias"] = P(AXIS_MP)  # vocab-parallel, like the head columns
     return specs
 
@@ -144,15 +140,9 @@ def param_shape_struct(config: InferenceConfig):
     from nxdi_tpu.config import to_jax_dtype
 
     arch = build_arch(config)
-    struct = dense.param_shape_struct(config, arch)
-    dt = to_jax_dtype(arch.dtype)
-    L, H = arch.num_layers, arch.hidden_size
-
-    def s(*shape):
-        return jax.ShapeDtypeStruct(shape, dt)
-
-    for key in ("input_layernorm", "post_attention_layernorm"):
-        struct["layers"][key] = {"w": s(L, H), "b": s(L, H)}
-    struct["norm"] = {"w": s(H), "b": s(H)}
+    struct = dense.biased_layernorm_struct(
+        dense.param_shape_struct(config, arch),
+        arch.num_layers, arch.hidden_size, to_jax_dtype(arch.dtype),
+    )
     struct["lm_head_bias"] = jax.ShapeDtypeStruct((arch.vocab_size,), jnp.float32)
     return struct

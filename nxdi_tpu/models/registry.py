@@ -142,6 +142,7 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "nxdi_tpu.models.apertus.modeling_apertus",
         "ApertusInferenceConfig",
     ),
+    "janus": ("nxdi_tpu.models.janus.modeling_janus", "JanusInferenceConfig"),
 }
 
 

@@ -52,10 +52,13 @@ def _sliding_flags(config):
 
 
 def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    sw = getattr(config, "sliding_window", None)
     kwargs = dict(
         gemma_norm=True,
         embed_scale=float(config.hidden_size) ** 0.5,
-        sliding_window=getattr(config, "sliding_window", None),
+        sliding_window=sw,
+        # window_sized_kv: full-attention layers stay off the ring
+        kv_window_pattern=tuple(_sliding_flags(config)) if sw else None,
         attention_scale=float(config.query_pre_attn_scalar) ** -0.5,
         attn_logit_softcap=getattr(config, "attn_logit_softcapping", None),
         final_logit_softcap=getattr(config, "final_logit_softcapping", None),

@@ -205,13 +205,16 @@ class ApplicationBase:
             specs = lora_spec_update(specs, self.tpu_config.lora_config)
         return maybe_quantize_specs(specs, self.tpu_config)
 
-    def _interleaved_window_split(self, arch=None):
+    def _interleaved_window_split(self, arch=None, family=None, config=None):
         """(n_full, n_window) when the cache splits into full + ring stacks
         (window_sized_kv on an interleaved-SWA arch), else None (reference:
-        per-layer window-sized caches, gpt_oss_kv_cache_manager.py)."""
-        if not getattr(self.tpu_config, "window_sized_kv", False):
+        per-layer window-sized caches, gpt_oss_kv_cache_manager.py). Flags are
+        read from the PASSED config's tpu_config — a fused-spec draft follows
+        its own window settings, not the target's."""
+        config = config or self.config
+        if not getattr(config.tpu_config, "window_sized_kv", False):
             return None
-        arch = arch or self.family.build_arch(self.config)
+        arch = arch or (family or self.family).build_arch(config)
         pat = getattr(arch, "kv_window_pattern", None)
         if not pat or all(pat) or not any(pat):
             return None  # homogeneous stacks keep the single-layout path
@@ -244,19 +247,22 @@ class ApplicationBase:
             cache["k_win"], cache["v_win"] = win["k"], win["v"]
         return cache
 
-    def _ring_cache_spec(self):
+    def _ring_cache_spec(self, family=None, config=None):
         """Ring-stack spec for the window layers of an interleaved split."""
         import dataclasses
 
-        arch = self.family.build_arch(self.config)
-        split = self._interleaved_window_split(arch)
+        family = family or self.family
+        config = config or self.config
+        arch = family.build_arch(config)
+        split = self._interleaved_window_split(arch, config=config)
         if split is None:
             return None
-        base = self._cache_spec()
+        base = self._cache_spec(family, config)
+        tc = config.tpu_config
         return dataclasses.replace(
             base,
             num_layers=split[1],
-            max_len=min(self.tpu_config.sliding_window, self.tpu_config.seq_len),
+            max_len=min(tc.window_ring_slots, tc.seq_len),
         )
 
     # ------------------------------------------------------------------
@@ -302,7 +308,10 @@ class ApplicationBase:
         family = family or self.family
         config = config or self.config
         arch = family.build_arch(config)
-        tc = self.tpu_config
+        # window/ring flags must follow the model whose cache this is — a
+        # fused-spec DRAFT without sliding windows keeps a full-length cache
+        # even when the target runs window_sized_kv
+        tc = config.tpu_config
         if tc.is_block_kv_layout:
             return BlockKVCacheSpec(
                 num_layers=arch.num_layers,
@@ -313,12 +322,13 @@ class ApplicationBase:
                 dtype=arch.dtype,
                 quant_dtype=(tc.kv_quant_config.dtype if tc.kv_quant_config else None),
             )
-        max_len = self.tpu_config.seq_len
-        split = self._interleaved_window_split(arch)
+        max_len = tc.seq_len
+        split = self._interleaved_window_split(arch, config=config)
         if getattr(tc, "window_sized_kv", False) and split is None:
-            # ring layout: W slots per layer instead of the full budget
-            # (reference: window-sized cache shapes kv_cache_manager.py:195)
-            max_len = min(max_len, tc.sliding_window)
+            # ring layout: W (+ spec lookahead) slots per layer instead of the
+            # full budget (reference: window-sized cache shapes
+            # kv_cache_manager.py:195)
+            max_len = min(max_len, tc.window_ring_slots)
         if split is not None:
             # interleaved split: this spec covers the FULL-attention layers
             # only; the window layers live in the ring stack (_ring_cache_spec)
@@ -331,12 +341,10 @@ class ApplicationBase:
             )
             return dataclasses.replace(spec, num_layers=split[0])
         return arch.kv_cache_spec(
-            self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size,
+            tc.kv_cache_batch_size + tc.kv_cache_padding_size,
             max_len,
             quant_dtype=(
-                self.tpu_config.kv_quant_config.dtype
-                if self.tpu_config.kv_quant_config
-                else None
+                tc.kv_quant_config.dtype if tc.kv_quant_config else None
             ),
         )
 

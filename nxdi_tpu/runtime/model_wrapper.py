@@ -79,10 +79,16 @@ def kv_layout_from_config(tc, arch=None):
                 "scaled fp8 KV is not wired into the window ring layout yet"
             )
         pat = getattr(arch, "kv_window_pattern", None) if arch is not None else None
+        if pat is not None and not any(pat):
+            raise ValueError(
+                "window_sized_kv is set but no layer of this model uses "
+                "sliding-window attention — a ring cache would silently "
+                "truncate full-attention history; unset window_sized_kv"
+            )
         if pat and any(pat) and not all(pat):
             return ContiguousKVLayout(route_by_seq_id=tc.is_continuous_batching)
         return WindowKVLayout(
-            window=tc.sliding_window, route_by_seq_id=tc.is_continuous_batching
+            window=tc.window_ring_slots, route_by_seq_id=tc.is_continuous_batching
         )
     if tc.is_continuous_batching:
         return ContiguousKVLayout(route_by_seq_id=True, **scales)

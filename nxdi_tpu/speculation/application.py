@@ -105,16 +105,31 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
         }
 
     def cache_partition_specs(self):
-        return {
-            "draft": kv_cache_partition_spec(self.tpu_config),
-            "target": kv_cache_partition_spec(self.tpu_config),
-        }
+        out = {}
+        for name, family, config in (
+            ("draft", self.draft_family, self.draft_config),
+            ("target", self.family, self.config),
+        ):
+            specs = dict(kv_cache_partition_spec(self.tpu_config))
+            if self._interleaved_window_split(family=family, config=config) is not None:
+                specs["k_win"] = specs["k"]
+                specs["v_win"] = specs["v"]
+            out[name] = specs
+        return out
 
     def init_cache_host(self):
-        return {
-            "draft": init_kv_cache(self._cache_spec(self.draft_family, self.draft_config)),
-            "target": init_kv_cache(self._cache_spec()),
-        }
+        out = {}
+        for name, family, config in (
+            ("draft", self.draft_family, self.draft_config),
+            ("target", self.family, self.config),
+        ):
+            cache = init_kv_cache(self._cache_spec(family, config))
+            ring = self._ring_cache_spec(family, config)
+            if ring is not None:
+                win = init_kv_cache(ring)
+                cache["k_win"], cache["v_win"] = win["k"], win["v"]
+            out[name] = cache
+        return out
 
     def _cache_struct(self):
         import jax
@@ -130,6 +145,10 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
                 "k": jax.ShapeDtypeStruct(spec.shape, spec.store_dtype),
                 "v": jax.ShapeDtypeStruct(shape_v, spec.store_dtype),
             }
+            ring = self._ring_cache_spec(family, config)
+            if ring is not None:
+                out[name]["k_win"] = jax.ShapeDtypeStruct(ring.shape, ring.store_dtype)
+                out[name]["v_win"] = jax.ShapeDtypeStruct(ring.shape_v, ring.store_dtype)
         return out
 
     # ------------------------------------------------------------------
@@ -149,10 +168,17 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
         d_inv = self.draft_family.build_inv_freq(self.draft_config)
         tc = self.tpu_config
 
+        from nxdi_tpu.runtime.model_wrapper import kv_layout_from_config
+
         common = dict(
             draft_arch=d_arch,
             draft_inv_freq=d_inv,
             spec_len=self.spec_len,
+            # the draft's own layout: a full-cache draft keeps contiguous
+            # addressing even when the target runs window_sized_kv rings
+            draft_layout=kv_layout_from_config(
+                self.draft_config.tpu_config, d_arch
+            ),
             **self._spec_wrapper_kwargs(),
         )
         self.models[TAG_CONTEXT_ENCODING] = self._wrapper_cls(

@@ -54,11 +54,14 @@ def fused_spec_context_encoding(
     batch: Dict[str, jax.Array],
     policy=DEFAULT_POLICY,
     layout=DEFAULT_KV_LAYOUT,
+    draft_layout=None,
     **sampling_kwargs,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """Draft CTE + target CTE back-to-back in one program (reference:
     model_base.py:1804 ``_context_encoding_forward``). Returns the target's
-    sampled first token; both caches come back filled with the prompt."""
+    sampled first token; both caches come back filled with the prompt.
+    ``draft_layout``: the DRAFT's own KV layout — a full-cache draft keeps
+    contiguous addressing even when the target runs a window ring."""
     t_out, t_cache = causal_lm_forward(
         target_arch,
         target_inv_freq,
@@ -80,7 +83,7 @@ def fused_spec_context_encoding(
         batch,
         attend_to_cache=False,
         policy=policy,
-        layout=layout,
+        layout=draft_layout if draft_layout is not None else layout,
         gather_last_token=True,
         on_device_sampling=True,
         **sampling_kwargs,
@@ -104,6 +107,7 @@ def fused_spec_token_gen(
     kv_window: int,
     policy=DEFAULT_POLICY,
     layout=DEFAULT_KV_LAYOUT,
+    draft_layout=None,
     return_next_inputs: bool = False,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, Any]]:
     """One speculation window (reference: model_base.py:1866 ``_token_gen_forward``).
@@ -141,7 +145,7 @@ def fused_spec_token_gen(
             attend_to_cache=True,
             kv_window=kv_window,
             policy=policy,
-            layout=layout,
+            layout=draft_layout if draft_layout is not None else layout,
             gather_last_token=False,
             on_device_sampling=True,
         )
@@ -158,7 +162,10 @@ def fused_spec_token_gen(
     tbatch = {
         "input_ids": candidates,
         "position_ids": positions,
-        "last_token_index": lti,
+        # index of the LAST candidate: unused by the verify gather (all
+        # logits come back) but read by the window-ring layout's keep-mask,
+        # which treats positions past it as right-padding
+        "last_token_index": jnp.full((B,), spec_len, jnp.int32),
         "sampling_params": sp,
     }
     if "seq_ids" in batch:
@@ -217,11 +224,17 @@ class FusedSpecWrapper(ModelWrapper):
     positions (up to pos + spec_len) stay inside the compiled KV window.
     """
 
-    def __init__(self, *args, draft_arch, draft_inv_freq, spec_len: int, **kwargs):
+    def __init__(
+        self, *args, draft_arch, draft_inv_freq, spec_len: int,
+        draft_layout=None, **kwargs,
+    ):
         super().__init__(*args, **kwargs)
         self.draft_arch = draft_arch
         self.draft_inv_freq = draft_inv_freq
         self.spec_len = spec_len
+        # the draft's OWN layout (from ITS tpu_config + arch): a full-cache
+        # draft keeps contiguous addressing when the target rides a ring
+        self.draft_layout = draft_layout if draft_layout is not None else self.layout
         if self.attend_to_cache:
             self.lookahead = spec_len + 1
 
@@ -237,6 +250,7 @@ class FusedSpecWrapper(ModelWrapper):
                 kv_window=bucket,
                 policy=self.policy,
                 layout=self.layout,
+                draft_layout=self.draft_layout,
                 return_next_inputs=bool(
                     self.forward_kwargs.get("return_next_inputs", False)
                 ),
@@ -249,5 +263,6 @@ class FusedSpecWrapper(ModelWrapper):
             self.inv_freq,
             policy=self.policy,
             layout=self.layout,
+            draft_layout=self.draft_layout,
             **self.forward_kwargs,
         )

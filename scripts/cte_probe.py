@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""CTE (prefill) bottleneck bisect on the real chip — one process, runs:
+
+  1. full CTE as benched (flash-prefill kernel ON, fused_qkv ON)
+  2. full CTE with the Pallas prefill kernel OFF (XLA attention)
+  3. pure-GEMM proxy: the 16-layer matmul skeleton alone (no attention,
+     no norms/rope/cache) — the MXU floor for the same weight traffic
+
+The gap (1)-(3) is what attention + elementwise + cache writes cost; the
+gap (1)-(2) is the kernel's win/loss vs XLA. Prints one JSON line."""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    sys.path.insert(0, "/root/repo")
+    from bench import BATCH, PROMPT_LEN, HIDDEN, INTERMEDIATE, N_LAYERS, N_HEADS, N_KV_HEADS, HEAD_DIM  # noqa: E501
+    import bench as bench_mod
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+    from nxdi_tpu.models.llama import modeling_llama as ml
+
+    rng = np.random.default_rng(0)
+
+    def run_cte(attn_kernel: bool):
+        make = bench_mod.main.__wrapped__ if hasattr(bench_mod.main, "__wrapped__") else None
+        # rebuild the bench config inline (keep one source of truth by
+        # importing the bench module's constants)
+        from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+
+        tcfg = TpuConfig(
+            tp_degree=1, batch_size=BATCH, seq_len=2048,
+            max_context_length=PROMPT_LEN, dtype="bfloat16",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            async_mode=True, attn_kernel_enabled=attn_kernel, fused_qkv=True,
+            skip_warmup=True,
+        )
+        cfg = ml.LlamaInferenceConfig(
+            tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
+            num_hidden_layers=N_LAYERS, num_attention_heads=N_HEADS,
+            num_key_value_heads=N_KV_HEADS, head_dim=HEAD_DIM,
+            vocab_size=128256, rms_norm_eps=1e-5, rope_theta=500000.0,
+        )
+        arch = ml.build_arch(cfg)
+        struct = params_shape_struct(ml, cfg, arch)
+
+        def rand(s):
+            return (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+                ml_dtypes.bfloat16
+            )
+
+        state = jtu.tree_map(rand, struct)
+
+        class App(TpuModelForCausalLM):
+            def build_params(self):
+                return state
+
+        app = App("<random>", cfg, model_family=ml)
+        app.load()
+        prompt = rng.integers(0, 32000, size=(BATCH, PROMPT_LEN)).astype(np.int32)
+        pos = np.tile(np.arange(PROMPT_LEN, dtype=np.int32), (BATCH, 1))
+        lti = np.full((BATCH,), PROMPT_LEN - 1, dtype=np.int32)
+        out = app.forward(prompt, pos, last_token_index=lti)
+        np.asarray(out["tokens"])
+        ms = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            out = app.forward(prompt, pos, last_token_index=lti)
+            np.asarray(out["tokens"])
+            ms.append((time.perf_counter() - t0) * 1000.0)
+        del app
+        return float(np.percentile(ms, 50))
+
+    # --- pure GEMM proxy ---
+    def gemm_proxy():
+        M = BATCH * PROMPT_LEN
+        qkv_out = (N_HEADS + 2 * N_KV_HEADS) * HEAD_DIM
+        key = jax.random.PRNGKey(0)
+        Wqkv = jax.random.normal(key, (N_LAYERS, HIDDEN, qkv_out), jnp.bfloat16) * 0.02
+        Wo = jax.random.normal(key, (N_LAYERS, N_HEADS * HEAD_DIM, HIDDEN), jnp.bfloat16) * 0.02
+        Wg = jax.random.normal(key, (N_LAYERS, HIDDEN, INTERMEDIATE), jnp.bfloat16) * 0.02
+        Wu = jax.random.normal(key, (N_LAYERS, HIDDEN, INTERMEDIATE), jnp.bfloat16) * 0.02
+        Wd = jax.random.normal(key, (N_LAYERS, INTERMEDIATE, HIDDEN), jnp.bfloat16) * 0.02
+        x0 = jax.random.normal(key, (M, HIDDEN), jnp.bfloat16)
+
+        @jax.jit
+        def f(x):
+            def body(h, ws):
+                wqkv, wo, wg, wu, wd = ws
+                qkv = h @ wqkv
+                h = h + qkv[:, : N_HEADS * HEAD_DIM] @ wo
+                g = jax.nn.silu(h @ wg)
+                u = h @ wu
+                h = h + (g * u) @ wd
+                return h, None
+
+            h, _ = jax.lax.scan(body, x, (Wqkv, Wo, Wg, Wu, Wd))
+            return h
+
+        f(x0).block_until_ready()
+        ms = []
+        for _ in range(6):
+            t0 = time.perf_counter()
+            # non-donated output: block_until_ready is a real barrier, and a
+            # full fetch of the (32k, 2048) result would swamp the tunnel
+            f(x0).block_until_ready()
+            ms.append((time.perf_counter() - t0) * 1000.0)
+        return float(np.percentile(ms, 50))
+
+    gemm_ms = gemm_proxy()
+    print(f"[probe] gemm proxy {gemm_ms:.1f} ms", file=sys.stderr, flush=True)
+    cte_kernel = run_cte(True)
+    print(f"[probe] cte kernel-on {cte_kernel:.1f} ms", file=sys.stderr, flush=True)
+    cte_xla = run_cte(False)
+    print(f"[probe] cte kernel-off {cte_xla:.1f} ms", file=sys.stderr, flush=True)
+    print(json.dumps({
+        "gemm_proxy_ms": round(gemm_ms, 1),
+        "cte_kernel_ms": round(cte_kernel, 1),
+        "cte_xla_attn_ms": round(cte_xla, 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

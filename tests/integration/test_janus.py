@@ -1,0 +1,107 @@
+"""Janus (DeepSeek) image-to-text: CLS-less SigLIP-style tower + aligner MLP
++ llama LM — exact token match vs HF CPU (reference analog:
+contrib/models/Janus-1.3B text-generation mode)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+from nxdi_tpu.models.janus import modeling_janus
+
+IMAGE_TOKEN = 255
+N_IMG_TOKENS = 4  # (32/16)^2
+
+
+def _tiny_hf_janus(seed=0):
+    import torch
+    from transformers import (
+        JanusConfig,
+        JanusForConditionalGeneration,
+        JanusVisionConfig,
+        JanusVQVAEConfig,
+        LlamaConfig,
+    )
+
+    torch.manual_seed(seed)
+    vc = JanusVisionConfig(
+        hidden_size=32, num_hidden_layers=2, num_attention_heads=2,
+        image_size=32, patch_size=16, mlp_ratio=2.0, projection_dim=64,
+        depth=2, num_image_tokens=N_IMG_TOKENS, use_qk_norm=False,
+    )
+    tc = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    vq = JanusVQVAEConfig(
+        embed_dim=8, num_embeddings=16, base_channels=32, channel_multiplier=[1, 1],
+        num_res_blocks=1, image_token_embed_dim=16, num_patches=4,
+        projection_dim=16,
+    )
+    cfg = JanusConfig(
+        text_config=tc, vision_config=vc, vq_config=vq, image_token_id=IMAGE_TOKEN
+    )
+    return JanusForConditionalGeneration(cfg).eval(), cfg
+
+
+def _build_app(hf_model, hf_cfg, tp_degree=1):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    tcfg = TpuConfig(
+        tp_degree=tp_degree,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=1,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = modeling_janus.JanusInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(ImageToTextForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=modeling_janus)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_janus_matches_hf_greedy(tp_degree):
+    import torch
+
+    hf, hf_cfg = _tiny_hf_janus()
+    app = _build_app(hf, hf_cfg, tp_degree)
+    adapter = HuggingFaceGenerationAdapter(app)
+
+    rng = np.random.default_rng(0)
+    pixels = rng.standard_normal((1, 3, 32, 32)).astype(np.float32)
+    ids = np.array([[5, 9] + [IMAGE_TOKEN] * N_IMG_TOKENS + [3, 17, 2, 8]], np.int64)
+
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.tensor(ids),
+            pixel_values=torch.tensor(pixels),
+            max_new_tokens=16,
+            do_sample=False,
+        ).numpy()
+    actual = adapter.generate(ids, max_new_tokens=16, pixel_values=pixels)
+    np.testing.assert_array_equal(actual, expected)
+
+
+def test_janus_text_only_matches_hf():
+    """Prompts without images skip the vision encoder entirely."""
+    import torch
+
+    hf, hf_cfg = _tiny_hf_janus(seed=1)
+    app = _build_app(hf, hf_cfg)
+    ids = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], np.int64)
+    with torch.no_grad():
+        expected = hf.generate(
+            torch.tensor(ids), max_new_tokens=12, do_sample=False
+        ).numpy()
+    actual = HuggingFaceGenerationAdapter(app).generate(ids, max_new_tokens=12)
+    np.testing.assert_array_equal(actual, expected)

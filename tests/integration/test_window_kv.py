@@ -90,7 +90,85 @@ def test_window_kv_cache_is_window_sized(tiny_hf_mistral_swa):
 
 
 def test_window_kv_rejects_unsupported_modes():
+    # linear speculation now composes (ring over-provisioned by spec_len+1)…
+    cfg = TpuConfig(
+        window_sized_kv=True, sliding_window=8, speculation_length=3, seq_len=64
+    )
+    assert cfg.window_ring_slots == 12
+    # …but the margin must fit the compiled budget
+    with pytest.raises(ValueError, match="ring slots"):
+        TpuConfig(
+            window_sized_kv=True, sliding_window=8, speculation_length=3, seq_len=10
+        )
+    # tree/paged modes still assume position-addressed slots
     with pytest.raises(ValueError, match="ring"):
-        TpuConfig(window_sized_kv=True, sliding_window=8, speculation_length=3)
+        TpuConfig(
+            window_sized_kv=True, sliding_window=8,
+            is_medusa=True, num_medusa_heads=2, medusa_speculation_length=4,
+        )
     with pytest.raises(ValueError, match="sliding_window"):
         TpuConfig(window_sized_kv=True)
+
+
+@pytest.mark.parametrize("spec_len", [3])
+def test_homogeneous_ring_fused_speculation(tiny_hf_mistral_swa, spec_len):
+    """Fused speculation when EVERY target layer rides the ring (uniform-SWA
+    mistral, window_sized_kv): the target layout is WindowKVLayout sized
+    window_ring_slots while the full-cache llama draft keeps its own
+    contiguous layout (FusedSpecWrapper.draft_layout); exact HF greedy."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from nxdi_tpu.config import SpeculationConfig
+    from nxdi_tpu.speculation import FusedSpecCausalLM
+
+    hf_model, hf_cfg = tiny_hf_mistral_swa
+    torch.manual_seed(5)
+    draft_cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    draft_hf = LlamaForCausalLM(draft_cfg).eval()
+
+    from nxdi_tpu.models.llama import modeling_llama as llama_family
+
+    t_sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    d_sd = {k: v.detach().numpy() for k, v in draft_hf.state_dict().items()}
+    common = dict(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    tcfg = TpuConfig(
+        **common,
+        window_sized_kv=True, sliding_window=WINDOW,
+        speculation_config=SpeculationConfig(
+            speculation_length=spec_len, enable_fused_speculation=True
+        ),
+    )
+    cfg = mistral.MistralInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+    dcfg = llama_family.LlamaInferenceConfig(
+        TpuConfig(**common), load_config=lambda: draft_cfg.to_dict()
+    )
+
+    class App(FusedSpecCausalLM):
+        def get_state_dict(self):
+            return t_sd
+
+        def get_draft_state_dict(self):
+            return d_sd
+
+    app = App(
+        "<target>", cfg, "<draft>", dcfg,
+        model_family=mistral, draft_family=llama_family,
+    )
+    app.load()
+    # EVERY target layer rides a ring over-provisioned by the spec window;
+    # the draft cache stays full-length contiguous
+    assert app.kv_cache["target"]["k"].shape[3] == WINDOW + spec_len + 1
+    assert app.kv_cache["draft"]["k"].shape[3] == 64
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], np.int64)
+    expected = hf_greedy(hf_model, prompt, max_new_tokens=24)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=24)
+    np.testing.assert_array_equal(actual, expected)
