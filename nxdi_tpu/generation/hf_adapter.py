@@ -74,6 +74,7 @@ class HuggingFaceGenerationAdapter:
         seed: int = 0,
         adapter_ids: Optional[np.ndarray] = None,
         pixel_values: Optional[np.ndarray] = None,
+        image_attention_mask: Optional[np.ndarray] = None,
         logits_processor=None,
         generation_config=None,
         **unused,
@@ -175,6 +176,10 @@ class HuggingFaceGenerationAdapter:
         cte_kwargs = dict(lora_kwargs)
         if pixel_values is not None:
             cte_kwargs["pixel_values"] = pixel_values
+        if image_attention_mask is not None:
+            # idefics: (B, S, num_images) per-prompt image visibility; decode
+            # steps reuse the last prompt row inside the application
+            cte_kwargs["image_attention_mask"] = image_attention_mask
         position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
         outputs = self.app.forward(
             input_ids.astype(np.int32),

@@ -1,0 +1,184 @@
+"""Idefics application — vision encoder (+ perceiver) feeding gated
+cross-attention CausalLM; the mllama pattern (cross K/V written into the
+donated cache pytree at prefill, reused at decode).
+
+Reference: contrib/models/idefics-9b-instruct (vision submodel + text model
+with per-interval gated cross blocks)."""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import numpy as np
+
+from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
+from nxdi_tpu.models.idefics import modeling_idefics as mi
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
+
+
+class IdeficsApplication(TpuModelForCausalLM):
+    def __init__(self, *args, **kwargs):
+        kwargs.setdefault("model_family", mi)
+        super().__init__(*args, **kwargs)
+        tc = self.tpu_config
+        for flag, why in (
+            (tc.async_mode, "async (device-resident) decode"),
+            (tc.is_block_kv_layout, "paged KV layout"),
+            (tc.lora_config is not None, "LoRA serving"),
+            (tc.speculation_length > 0, "speculative decoding"),
+            (tc.enable_fused_speculation, "fused speculation"),
+            (tc.is_medusa, "medusa"),
+            (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
+            (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
+            (tc.is_continuous_batching, "continuous batching (cross-KV is not "
+             "seq-id routed yet)"),
+        ):
+            if flag:
+                raise NotImplementedError(f"idefics does not support {why} yet")
+        self._encode_jit = None
+        # last prompt image-mask row per batch line (HF generation repeats
+        # image_attention_mask[:, -1:] for every generated token)
+        self._last_imask: Optional[np.ndarray] = None
+        self._arch = mi.build_arch(self.config)
+
+    # -- params --
+    def build_params(self):
+        return self.build_params_with_extras(
+            super().build_params, mi.convert_vision_params
+        )
+
+    def build_params_struct(self):
+        struct = super().build_params_struct()
+        struct.update(mi.vision_shape_struct(self.config))
+        return struct
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().param_specs()
+        struct = mi.vision_shape_struct(self.config)
+        specs.update(jax.tree_util.tree_map(lambda _: P(), struct))
+        return specs
+
+    # -- cache: self-attn KV + cross-attn KV --
+    def _cross_cache_struct(self):
+        arch = self._arch
+        t = arch.text
+        spec = self._cache_spec()
+        B = self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size
+        shape = (arch.n_cross, B, t.num_kv_heads, arch.t_img, t.head_dim)
+        return {
+            "cross_k": jax.ShapeDtypeStruct(shape, spec.store_dtype),
+            "cross_v": jax.ShapeDtypeStruct(shape, spec.store_dtype),
+        }
+
+    def _cache_struct(self):
+        struct = super()._cache_struct()
+        struct.update(self._cross_cache_struct())
+        return struct
+
+    def init_cache_host(self):
+        import jax.numpy as jnp
+
+        cache = super().init_cache_host()
+        for k, s in self._cross_cache_struct().items():
+            cache[k] = jnp.zeros(s.shape, s.dtype)
+        return cache
+
+    def cache_partition_specs(self):
+        specs = dict(kv_cache_partition_spec(self.tpu_config))
+        specs["cross_k"] = specs["k"]
+        specs["cross_v"] = specs["k"]
+        return specs
+
+    # -- submodels --
+    def enable_models(self) -> None:
+        import jax.numpy as jnp
+
+        super().enable_models()
+        arch = self._arch
+        M = arch.max_images
+        for tag, w in self.models.items():
+            w.forward_fn = mi.causal_lm_forward
+            w.forward_kwargs.pop("output_all_logits", None)
+            w.forward_kwargs.pop("tensor_capture", None)
+            w.forward_kwargs.pop("return_next_inputs", None)
+            if w.forward_kwargs.pop("dp_sampling", False):
+                raise NotImplementedError("idefics does not support dp_sampling yet")
+            if tag == TAG_CONTEXT_ENCODING:
+                w.extra_inputs["image_states"] = (
+                    (arch.t_img, arch.vision_dim), jnp.float32,
+                )
+                w.extra_inputs["image_attention_mask"] = (
+                    (self.tpu_config.max_context_length, M), jnp.float32,
+                )
+            else:
+                w.extra_inputs["image_attention_mask"] = ((1, M), jnp.float32)
+
+    # -- vision program --
+    def encode_images(self, pixel_values):
+        if self._encode_jit is None:
+            varch = mi.build_vision_arch(self.config)
+            self._encode_jit = jax.jit(
+                partial(mi.encode_images, self.config, varch)
+            )
+        with jax.set_mesh(self.mesh):
+            return self._encode_jit(
+                {k: self.params[k] for k in ("vision", "perceiver")
+                 if k in self.params},
+                np.asarray(pixel_values, np.float32),
+            )
+
+    # -- dispatch --
+    def forward(
+        self,
+        input_ids,
+        position_ids,
+        pixel_values=None,
+        image_attention_mask=None,
+        **kwargs,
+    ):
+        arch = self._arch
+        M = arch.max_images
+        B, S = np.asarray(input_ids).shape
+        if S > 1:  # prefill
+            if pixel_values is None:
+                raise NotImplementedError(
+                    "idefics prefill requires images (text-only prefill would "
+                    "need a cross-layer-free compiled variant)"
+                )
+            pv = np.asarray(pixel_values, np.float32)
+            if pv.shape[1] != M:
+                raise ValueError(
+                    f"pixel_values carries {pv.shape[1]} images but the "
+                    f"compiled graphs expect max_num_images={M}"
+                )
+            kwargs["image_states"] = np.asarray(self.encode_images(pv))
+            if image_attention_mask is None:
+                raise ValueError("image_attention_mask is required at prefill")
+            im = np.asarray(image_attention_mask, np.float32)  # (B, S, M)
+            S_cap = self.tpu_config.max_context_length
+            pad = np.zeros((B, S_cap, M), np.float32)
+            pad[:, : im.shape[1]] = im[:, :S_cap]
+            kwargs["image_attention_mask"] = pad
+            lti = kwargs.get("last_token_index")
+            last = (
+                np.asarray(lti, np.int64)
+                if lti is not None
+                else np.full((B,), im.shape[1] - 1, np.int64)
+            )
+            self._last_imask = im[np.arange(B), np.minimum(last, im.shape[1] - 1)]
+        else:
+            if image_attention_mask is not None:
+                im = np.asarray(image_attention_mask, np.float32).reshape(B, 1, M)
+            elif self._last_imask is not None:
+                im = self._last_imask[:B].reshape(B, 1, M)
+            else:
+                raise ValueError(
+                    "decode before prefill: no image_attention_mask available"
+                )
+            kwargs["image_attention_mask"] = im
+        return super().forward(input_ids, position_ids, **kwargs)

@@ -1,0 +1,641 @@
+"""Idefics (HuggingFace M4) — CLIP vision tower + optional perceiver
+resampler + llama decoder with GATED cross-attention blocks every
+``cross_layer_interval`` layers.
+
+Reference: contrib/models/idefics-9b-instruct. HF IdeficsForVisionText2Text
+(modeling_idefics.py:173-1200, perceiver.py:48-190):
+  - decoupled embedding/lm_head: ``additional_vocab_size`` trainable rows
+    appended to the frozen tables — merged into single [main | additional]
+    tables at conversion (IdeficsDecoupledEmbedding/Linear semantics);
+  - self layers are plain llama MHA (no biases; ``qk_layer_norms`` applies
+    to the CROSS attention only — HF passes it solely to the gated cross
+    block, modeling_idefics.py:701);
+  - a gated cross block runs BEFORE every ``cross_layer_interval``-th self
+    layer: q from text, k/v project the IMAGE states (vision embed dim),
+    no rope; outputs zeroed for tokens attending no image
+    (``cross_attention_gate``), then scaled by tanh(alpha) gates;
+  - vision tower is CLIP with the CLS token KEPT and no trailing
+    post-layernorm on the sequence features;
+  - the perceiver resampler (idefics-9b: 64 latents x 6 blocks) compresses
+    each image's patch sequence; k/v attend [context | latents].
+
+Cross K/V are computed ONCE at prefill from the image states and live in
+the donated cache pytree as ``cross_k``/``cross_v`` (the mllama pattern —
+reference analog: multimodal_kv_cache_manager.py)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, to_jax_dtype
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import (
+    DecoderArch,
+    mlp_block,
+    run_decoder_layers,
+)
+from nxdi_tpu.ops import attention as attn_ops
+from nxdi_tpu.ops import sampling as sampling_ops
+from nxdi_tpu.ops import vision as vision_ops
+from nxdi_tpu.ops.norms import layer_norm, rms_norm
+from nxdi_tpu.ops.rope import rope_cos_sin
+from nxdi_tpu.parallel import gqa
+from nxdi_tpu.parallel.layers import constrain
+from nxdi_tpu.parallel.policy import DEFAULT_POLICY
+from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT
+
+def __getattr__(name):
+    # lazy APPLICATION_CLS: application.py imports this module, so a
+    # top-level import back would be circular (the mimo_v2 pattern); the
+    # CLI / standard-spec loaders resolve the app class through this hook
+    if name == "APPLICATION_CLS":
+        from nxdi_tpu.models.idefics.application import IdeficsApplication
+
+        return IdeficsApplication
+    raise AttributeError(name)
+
+
+class IdeficsInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = [
+        "hidden_size", "num_attention_heads", "num_hidden_layers",
+        "vocab_size", "intermediate_size", "vision_config",
+    ]
+
+    def add_derived_config(self):
+        self.num_key_value_heads = self.num_attention_heads  # MHA
+        if not hasattr(self, "additional_vocab_size"):
+            self.additional_vocab_size = 0
+        # merged [main | additional] vocab drives padding + sampling
+        self.vocab_size = self.vocab_size + self.additional_vocab_size
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        pc = getattr(self, "perceiver_config", None)
+        if pc is not None and not isinstance(pc, dict):
+            self.perceiver_config = pc.to_dict()
+        if not hasattr(self, "cross_layer_interval"):
+            self.cross_layer_interval = 1
+        if not hasattr(self, "qk_layer_norms"):
+            self.qk_layer_norms = False
+        if not hasattr(self, "use_resampler"):
+            self.use_resampler = False
+        # the number of image SLOTS the compiled graphs carry per request
+        if not hasattr(self, "max_num_images"):
+            self.max_num_images = 1
+        self.rope_theta = 10000.0  # IdeficsEmbedding fixed base
+        self.rope_scaling = None
+        super().add_derived_config()
+
+
+@dataclass(frozen=True)
+class IdeficsArch:
+    text: DecoderArch  # the SELF layers (cross blocks are extra, unrolled)
+    cross_interval: int
+    n_cross: int
+    image_seq: int  # tokens per image fed to cross attention
+    vision_dim: int  # width of the image states (vision embed dim)
+    max_images: int
+
+    @property
+    def t_img(self) -> int:  # cross K/V length
+        return self.max_images * self.image_seq
+
+    def kv_cache_spec(self, batch_size, max_len, quant_dtype=None):
+        # the self-attn stack's cache; cross K/V are extra pytree entries
+        return self.text.kv_cache_spec(batch_size, max_len, quant_dtype)
+
+
+def _image_seq_len(config: InferenceConfig) -> int:
+    vc = config.vision_config
+    if getattr(config, "use_resampler", False):
+        return int(config.perceiver_config["resampler_n_latents"])
+    return (vc["image_size"] // vc["patch_size"]) ** 2 + 1  # patches + CLS
+
+
+def build_arch(config: InferenceConfig, **overrides) -> IdeficsArch:
+    # NOTE: config.qk_layer_norms applies to the CROSS attention only — HF
+    # passes it solely to IdeficsGatedCrossAttentionLayer (modeling_idefics
+    # .py:701); the self layers are plain llama MHA.
+    text = dense.build_arch(config, **overrides)
+    L = config.num_hidden_layers
+    interval = int(config.cross_layer_interval)
+    return IdeficsArch(
+        text=text,
+        cross_interval=interval,
+        n_cross=(L + interval - 1) // interval,
+        image_seq=_image_seq_len(config),
+        vision_dim=config.vision_config["embed_dim"],
+        max_images=int(getattr(config, "max_num_images", 1)),
+    )
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    from nxdi_tpu.ops.rope import default_inv_freq
+
+    return default_inv_freq(dense.head_dim_of(config), 10000.0)
+
+
+def build_vision_arch(config: InferenceConfig) -> vision_ops.ClipVisionArch:
+    vc = config.vision_config
+    return vision_ops.ClipVisionArch(
+        hidden_size=vc["embed_dim"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        hidden_act=vc.get("hidden_act", "gelu"),
+        layer_norm_eps=vc.get("layer_norm_eps", 1e-5),
+        feature_layer=-1,  # full depth, no post-layernorm on the sequence
+        drop_cls=False,  # idefics keeps the CLS token in the image states
+    )
+
+
+# ---------------------------------------------------------------------------
+# Perceiver resampler (perceiver.py:48-190)
+# ---------------------------------------------------------------------------
+
+def perceiver_forward(config_p: Dict[str, Any], params: Dict[str, Any], context):
+    """(B, T, Dv) -> (B, n_latents, Dv). ``config_p``: resampler_n_heads,
+    resampler_head_dim, qk_layer_norms_perceiver."""
+    nh = config_p["resampler_n_heads"]
+    hd = config_p["resampler_head_dim"]
+    qk_ln = bool(config_p.get("qk_layer_norms_perceiver", False))
+    B = context.shape[0]
+    lat = jnp.broadcast_to(
+        params["latents"][None], (B,) + params["latents"].shape
+    )
+
+    def ln(p, x):
+        return layer_norm(x, p["w"], p["b"], eps=1e-5)
+
+    for blk in params["blocks"]:
+        a = blk["attn"]
+        ctx_n = ln(a["context_ln"], context)
+        lat_n = ln(a["latents_ln"], lat)
+        kv_in = jnp.concatenate([ctx_n, lat_n], axis=1)
+        q = (lat_n @ a["q_proj"]).reshape(B, -1, nh, hd).swapaxes(1, 2)
+        k = (kv_in @ a["k_proj"]).reshape(B, -1, nh, hd).swapaxes(1, 2)
+        v = (kv_in @ a["v_proj"]).reshape(B, -1, nh, hd).swapaxes(1, 2)
+        if qk_ln:
+            q = ln(a["q_ln"], q)
+            k = ln(a["k_ln"], k)
+        scores = jnp.einsum("bhid,bhjd->bhij", q * (hd ** -0.5), k)
+        w = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhij,bhjd->bhid", w, v)
+        out = out.swapaxes(1, 2).reshape(B, lat.shape[1], nh * hd)
+        lat = lat + out @ a["out_proj"]
+        m = blk["mlp"]
+        y = ln(m["ln"], lat)
+        lat = lat + jax.nn.relu(y @ m["fc"]) @ m["c_proj"]
+    return ln(params["final_ln"], lat)
+
+
+def encode_images(config: InferenceConfig, varch, params: Dict[str, Any], pixel_values):
+    """pixel_values (B, M, C, H, W) -> image states (B, M*image_seq, Dv)."""
+    B, M = pixel_values.shape[:2]
+    flat = pixel_values.reshape((B * M,) + pixel_values.shape[2:])
+    feat = vision_ops.clip_vision_forward(varch, params["vision"], flat)
+    if getattr(config, "use_resampler", False):
+        feat = perceiver_forward(
+            {**config.perceiver_config,
+             "qk_layer_norms_perceiver": config.perceiver_config.get(
+                 "qk_layer_norms_perceiver", False)},
+            params["perceiver"], feat,
+        )
+    seq = feat.shape[1]
+    return feat.reshape(B, M * seq, feat.shape[-1])
+
+
+# ---------------------------------------------------------------------------
+# Gated cross-attention block (modeling_idefics.py:691-818)
+# ---------------------------------------------------------------------------
+
+def _cross_attention_layer(arch: IdeficsArch, lp, hidden, xk, xv, attend, policy):
+    t = arch.text
+    B, S, _ = hidden.shape
+    H, D = t.num_attention_heads, t.head_dim
+
+    y = rms_norm(hidden, lp["input_layernorm"], t.rms_norm_eps)
+    q = (y @ lp["attn"]["q_proj"]["w"]).reshape(B, S, H, D)
+    q = jnp.swapaxes(q, 1, 2)
+    if "q_norm" in lp["attn"]:
+        q = rms_norm(q, lp["attn"]["q_norm"], t.rms_norm_eps)
+    q = constrain(q, policy.q)
+    ctx = attn_ops.grouped_attention(q, xk, xv, attend, softmax_dtype=jnp.float32)
+    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+    attn_out = ctx @ lp["attn"]["o_proj"]["w"]
+    # zero rows attending no image, THEN the tanh(alpha) gate
+    gate_rows = jnp.any(attend, axis=-1, keepdims=True)
+    attn_out = jnp.where(gate_rows, attn_out, 0.0)
+    hidden = hidden + jnp.tanh(lp["alpha_cross_attn"]) * attn_out
+
+    y = rms_norm(hidden, lp["post_attention_layernorm"], t.rms_norm_eps)
+    ff = mlp_block(t, lp["mlp"], y)
+    hidden = hidden + jnp.tanh(lp["alpha_dense"]) * ff
+    return constrain(hidden, policy.hidden)
+
+
+def _compute_cross_kv(arch: IdeficsArch, lp, image_states, policy):
+    t = arch.text
+    B, T, _ = image_states.shape
+    KV, D = t.num_kv_heads, t.head_dim
+    k = (image_states @ lp["attn"]["k_proj"]["w"]).reshape(B, T, KV, D)
+    v = (image_states @ lp["attn"]["v_proj"]["w"]).reshape(B, T, KV, D)
+    k = jnp.swapaxes(k, 1, 2)
+    if "k_norm" in lp["attn"]:
+        k = rms_norm(k, lp["attn"]["k_norm"], t.rms_norm_eps)
+    v = jnp.swapaxes(v, 1, 2)
+    return constrain(k, policy.kv), constrain(v, policy.kv)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def causal_lm_forward(
+    arch: IdeficsArch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    attend_to_cache: bool,
+    kv_window: Optional[int] = None,
+    policy=DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    gather_last_token: bool = True,
+    output_logits: bool = False,
+    on_device_sampling: bool = True,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+):
+    """One submodel forward: a gated cross block BEFORE every
+    ``cross_interval``-th self layer (IdeficsModel.forward layer loop),
+    dense self segments scanned in between."""
+    t = arch.text
+    compute_dtype = to_jax_dtype(t.dtype)
+    input_ids = batch["input_ids"]
+    position_ids = batch["position_ids"]
+    B, S = input_ids.shape
+
+    hidden = jnp.take(params["embed_tokens"], input_ids, axis=0).astype(compute_dtype)
+    hidden = constrain(hidden, policy.hidden)
+    cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq), dtype=jnp.float32)
+    cache_spec = t.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
+
+    # (B, S_fixed, max_images) 1/0 -> (B, S, T_img) bool over image tokens
+    xmask = batch["image_attention_mask"][:, :S].astype(jnp.float32)
+    attend = jnp.repeat(xmask, arch.image_seq, axis=2) > 0
+
+    if attend_to_cache:
+        xk_all, xv_all = cache["cross_k"], cache["cross_v"]
+    else:
+        xk_list, xv_list = [], []
+
+    L = t.num_layers
+    interval = arch.cross_interval
+    k_segs, v_segs = [], []
+    for lo in range(0, L, interval):
+        hi = min(lo + interval, L)
+        ordinal = lo // interval
+        lp = jax.tree_util.tree_map(lambda x: x[ordinal], params["cross"])
+        if attend_to_cache:
+            xk = xk_all[ordinal].astype(compute_dtype)
+            xv = xv_all[ordinal].astype(compute_dtype)
+        else:
+            xk, xv = _compute_cross_kv(
+                arch, lp, batch["image_states"].astype(compute_dtype), policy
+            )
+            xk_list.append(xk)
+            xv_list.append(xv)
+        hidden = _cross_attention_layer(arch, lp, hidden, xk, xv, attend, policy)
+
+        seg = jax.tree_util.tree_map(lambda x: x[lo:hi], params["layers"])
+        k_sl = jax.lax.slice_in_dim(cache["k"], lo, hi, axis=0)
+        v_sl = jax.lax.slice_in_dim(cache["v"], lo, hi, axis=0)
+        hidden, seg_cache = run_decoder_layers(
+            t, seg, hidden, cos, sin, {"k": k_sl, "v": v_sl},
+            position_ids, cache_spec, attend_to_cache, kv_window=kv_window,
+            policy=policy, layout=layout,
+        )
+        k_segs.append(seg_cache["k"])
+        v_segs.append(seg_cache["v"])
+
+    cat = lambda xs: xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0)  # noqa: E731
+    new_cache = {"k": cat(k_segs), "v": cat(v_segs)}
+    if attend_to_cache:
+        new_cache["cross_k"], new_cache["cross_v"] = xk_all, xv_all
+    else:
+        store = cache["cross_k"].dtype
+        new_cache["cross_k"] = jnp.stack(xk_list).astype(store)
+        new_cache["cross_v"] = jnp.stack(xv_list).astype(store)
+
+    hidden = rms_norm(hidden, params["norm"], t.rms_norm_eps)
+    lm_head = params["lm_head"]  # decoupled head is never tied
+    if gather_last_token:
+        idx = batch["last_token_index"][:, None, None]
+        hidden = jnp.take_along_axis(
+            hidden, jnp.broadcast_to(idx, (B, 1, hidden.shape[2])), axis=1
+        )
+    logits = (hidden @ lm_head.astype(hidden.dtype)).astype(jnp.float32)
+    logits = constrain(logits, policy.logits)
+    logits = sampling_ops.mask_padded_logits(logits, t.vocab_pad)
+
+    outputs: Dict[str, jax.Array] = {}
+    if on_device_sampling:
+        outputs["tokens"] = sampling_ops.sample(
+            logits[:, -1, :],
+            batch["sampling_params"],
+            rng=batch.get("rng"),
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+        )[:, None]
+    if output_logits or not on_device_sampling:
+        outputs["logits"] = logits
+    return outputs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint conversion
+# ---------------------------------------------------------------------------
+
+def _merge_decoupled(main: np.ndarray, additional: Optional[np.ndarray]):
+    if additional is None or additional.size == 0:
+        return main
+    return np.concatenate([main, additional], axis=0)
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    t = arch.text
+
+    def src(name, default=None):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        if default is not None:
+            return default
+        raise KeyError(name)
+
+    def opt(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        return None
+
+    # text self layers: dense layout with merged decoupled embed/head
+    sd: Dict[str, np.ndarray] = {
+        "embed_tokens.weight": _merge_decoupled(
+            src("embed_tokens.weight"),
+            opt("embed_tokens.additional_embedding.weight"),
+        ),
+        "norm.weight": src("norm.weight"),
+        "lm_head.weight": _merge_decoupled(
+            np.asarray(state_dict["lm_head.weight"]),
+            (np.asarray(state_dict["lm_head.additional_fc.weight"])
+             if "lm_head.additional_fc.weight" in state_dict else None),
+        ),
+    }
+    for i in range(t.num_layers):
+        pre = f"layers.{i}."
+        for name in (
+            "self_attn.q_proj.weight", "self_attn.k_proj.weight",
+            "self_attn.v_proj.weight", "self_attn.o_proj.weight",
+            "input_layernorm.weight", "post_attention_layernorm.weight",
+            "mlp.gate_proj.weight", "mlp.up_proj.weight", "mlp.down_proj.weight",
+        ):
+            sd[pre + name] = src(pre + name)
+    params = dense.convert_hf_state_dict(sd, config, t)
+
+    # cross blocks: one pytree stacked over ordinals
+    dt = dense.np_dtype(t.dtype)
+    plan = dense.gqa_plan(config)
+    D = t.head_dim
+    cast = lambda x: np.asarray(x, dtype=dt)  # noqa: E731
+    cross_layers = []
+    for j in range(arch.n_cross):
+        pre = f"gated_cross_attn_layers.{j}."
+        attn = {
+            "q_proj": {"w": cast(gqa.convert_q(src(pre + "cross_attn.q_proj.weight"), D, plan).T)},
+            "k_proj": {"w": cast(gqa.convert_kv(src(pre + "cross_attn.k_proj.weight"), D, plan).T)},
+            "v_proj": {"w": cast(gqa.convert_kv(src(pre + "cross_attn.v_proj.weight"), D, plan).T)},
+            "o_proj": {"w": cast(gqa.convert_o(src(pre + "cross_attn.o_proj.weight"), D, plan).T)},
+        }
+        if opt(pre + "cross_attn.q_layer_norm.weight") is not None:
+            attn["q_norm"] = cast(src(pre + "cross_attn.q_layer_norm.weight"))
+            attn["k_norm"] = cast(src(pre + "cross_attn.k_layer_norm.weight"))
+        cross_layers.append({
+            "input_layernorm": cast(src(pre + "input_layernorm.weight")),
+            "post_attention_layernorm": cast(src(pre + "post_attention_layernorm.weight")),
+            "alpha_cross_attn": np.asarray(src(pre + "alpha_cross_attn"), np.float32),
+            "alpha_dense": np.asarray(src(pre + "alpha_dense"), np.float32),
+            "attn": attn,
+            "mlp": {
+                "gate_proj": {"w": cast(src(pre + "mlp.gate_proj.weight").T)},
+                "up_proj": {"w": cast(src(pre + "mlp.up_proj.weight").T)},
+                "down_proj": {"w": cast(src(pre + "mlp.down_proj.weight").T)},
+            },
+        })
+    params["cross"] = dense.tree_stack(cross_layers)
+    return params
+
+
+def convert_vision_params(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    out: Dict[str, Any] = {
+        "vision": vision_ops.convert_clip_vision(
+            state_dict, varch, prefix="vision_model."
+        ),
+    }
+    if getattr(config, "use_resampler", False):
+        def get(name):
+            for k in (f"model.perceiver_resampler.{name}", f"perceiver_resampler.{name}"):
+                if k in state_dict:
+                    return np.asarray(state_dict[k], np.float32)
+            raise KeyError(name)
+
+        def has(name):
+            return (f"model.perceiver_resampler.{name}" in state_dict
+                    or f"perceiver_resampler.{name}" in state_dict)
+
+        depth = int(config.perceiver_config["resampler_depth"])
+        blocks = []
+        for j in range(depth):
+            a = {
+                "context_ln": {"w": get(f"blocks.{j}.0.context_layer_norm.weight"),
+                               "b": get(f"blocks.{j}.0.context_layer_norm.bias")},
+                "latents_ln": {"w": get(f"blocks.{j}.0.latents_layer_norm.weight"),
+                               "b": get(f"blocks.{j}.0.latents_layer_norm.bias")},
+                "q_proj": get(f"blocks.{j}.0.q_proj.weight").T,
+                "k_proj": get(f"blocks.{j}.0.k_proj.weight").T,
+                "v_proj": get(f"blocks.{j}.0.v_proj.weight").T,
+                "out_proj": get(f"blocks.{j}.0.output_proj.weight").T,
+            }
+            if has(f"blocks.{j}.0.q_layer_norm.weight"):
+                a["q_ln"] = {"w": get(f"blocks.{j}.0.q_layer_norm.weight"),
+                             "b": get(f"blocks.{j}.0.q_layer_norm.bias")}
+                a["k_ln"] = {"w": get(f"blocks.{j}.0.k_layer_norm.weight"),
+                             "b": get(f"blocks.{j}.0.k_layer_norm.bias")}
+            m = {
+                "ln": {"w": get(f"blocks.{j}.1.ln.weight"),
+                       "b": get(f"blocks.{j}.1.ln.bias")},
+                "fc": get(f"blocks.{j}.1.fc.weight").T,
+                "c_proj": get(f"blocks.{j}.1.c_proj.weight").T,
+            }
+            blocks.append({"attn": a, "mlp": m})
+        out["perceiver"] = {
+            "latents": get("latents"),
+            "blocks": blocks,
+            "final_ln": {"w": get("layer_norm.weight"), "b": get("layer_norm.bias")},
+        }
+    return out
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    P2 = varch.num_channels * varch.patch_size ** 2
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, np.float32)  # noqa: E731
+    lin = lambda i, o: {"w": s(L, i, o), "b": s(L, o)}  # noqa: E731
+    out: Dict[str, Any] = {
+        "vision": {
+            "patch_embedding": s(P2, Hv),
+            "class_embedding": s(Hv),
+            "position_embedding": s(varch.num_patches + 1, Hv),
+            "pre_layernorm": {"w": s(Hv), "b": s(Hv)},
+            "layers": {
+                "attn": {
+                    n: lin(Hv, Hv) for n in ("q_proj", "k_proj", "v_proj", "out_proj")
+                },
+                "ln1": {"w": s(L, Hv), "b": s(L, Hv)},
+                "ln2": {"w": s(L, Hv), "b": s(L, Hv)},
+                "fc1": lin(Hv, Iv),
+                "fc2": lin(Iv, Hv),
+            },
+        },
+    }
+    if getattr(config, "use_resampler", False):
+        pc = config.perceiver_config
+        nh, hd = pc["resampler_n_heads"], pc["resampler_head_dim"]
+        inner = nh * hd
+        inter = Hv * 4
+        n_lat = pc["resampler_n_latents"]
+        lnp = {"w": s(Hv), "b": s(Hv)}
+
+        def blk():
+            a = {
+                "context_ln": dict(lnp), "latents_ln": dict(lnp),
+                "q_proj": s(Hv, inner), "k_proj": s(Hv, inner),
+                "v_proj": s(Hv, inner), "out_proj": s(inner, Hv),
+            }
+            if pc.get("qk_layer_norms_perceiver", False):
+                a["q_ln"] = {"w": s(hd), "b": s(hd)}
+                a["k_ln"] = {"w": s(hd), "b": s(hd)}
+            return {
+                "attn": a,
+                "mlp": {"ln": dict(lnp), "fc": s(Hv, inter), "c_proj": s(inter, Hv)},
+            }
+
+        out["perceiver"] = {
+            "latents": s(n_lat, Hv),
+            "blocks": [blk() for _ in range(int(pc["resampler_depth"]))],
+            "final_ln": dict(lnp),
+        }
+    return out
+
+
+def param_specs(config: InferenceConfig):
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.parallel.layers import (
+        COLUMN_PARALLEL, REPLICATED, ROW_PARALLEL,
+    )
+
+    arch = build_arch(config)
+    specs = dense.param_specs_for(arch.text)
+
+    def stack(tree):  # prepend the cross-ordinal stack dim to every spec
+        return jax.tree_util.tree_map(
+            lambda sp: P(*((None,) + tuple(sp))), tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+
+    cross = {
+        "input_layernorm": REPLICATED,
+        "post_attention_layernorm": REPLICATED,
+        "alpha_cross_attn": REPLICATED,
+        "alpha_dense": REPLICATED,
+        "attn": {
+            "q_proj": {"w": COLUMN_PARALLEL},
+            "k_proj": {"w": COLUMN_PARALLEL},
+            "v_proj": {"w": COLUMN_PARALLEL},
+            "o_proj": {"w": ROW_PARALLEL},
+        },
+        "mlp": {
+            "gate_proj": {"w": COLUMN_PARALLEL},
+            "up_proj": {"w": COLUMN_PARALLEL},
+            "down_proj": {"w": ROW_PARALLEL},
+        },
+    }
+    if getattr(config, "qk_layer_norms", False):
+        cross["attn"]["q_norm"] = REPLICATED
+        cross["attn"]["k_norm"] = REPLICATED
+    specs["cross"] = stack(cross)
+    return specs
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    t = arch.text
+    struct = dense.param_shape_struct(config, t)
+    dt = to_jax_dtype(t.dtype)
+    N, hs, D = arch.n_cross, t.hidden_size, t.head_dim
+    H, KV = t.num_attention_heads, t.num_kv_heads
+    inter = t.intermediate_size
+    Dv = arch.vision_dim
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, dt)  # noqa: E731
+    cross: Dict[str, Any] = {
+        "input_layernorm": s(N, hs),
+        "post_attention_layernorm": s(N, hs),
+        "alpha_cross_attn": jax.ShapeDtypeStruct(
+            (N,) + _alpha_shape(config), np.float32
+        ),
+        "alpha_dense": jax.ShapeDtypeStruct(
+            (N,) + _alpha_shape(config), np.float32
+        ),
+        "attn": {
+            "q_proj": {"w": s(N, hs, H * D)},
+            "k_proj": {"w": s(N, Dv, KV * D)},
+            "v_proj": {"w": s(N, Dv, KV * D)},
+            "o_proj": {"w": s(N, H * D, hs)},
+        },
+        "mlp": {
+            "gate_proj": {"w": s(N, hs, inter)},
+            "up_proj": {"w": s(N, hs, inter)},
+            "down_proj": {"w": s(N, inter, hs)},
+        },
+    }
+    if getattr(config, "qk_layer_norms", False):
+        cross["attn"]["q_norm"] = s(N, D)
+        cross["attn"]["k_norm"] = s(N, D)
+    struct["cross"] = cross
+    return struct
+
+
+def _alpha_shape(config) -> Tuple[int, ...]:
+    if getattr(config, "alpha_type", "float") == "vector":
+        return (1, 1, config.hidden_size)
+    return (1,)

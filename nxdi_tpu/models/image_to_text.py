@@ -51,23 +51,10 @@ class ImageToTextForCausalLM(TpuModelForCausalLM):
 
     # -- params: text + vision/projector sub-pytrees --
     def build_params(self):
-        # memoize the checkpoint read: the text conversion (super) and the
-        # vision conversion below must share ONE multi-GB safetensors load
-        real_get = self.get_state_dict
-        cache = {}
-
-        def cached():
-            if "sd" not in cache:
-                cache["sd"] = real_get()
-            return cache["sd"]
-
-        self.get_state_dict = cached
-        try:
-            params = super().build_params()
-            params.update(self.family.convert_vision_params(cached(), self.config))
-        finally:
-            self.get_state_dict = real_get
-        return params
+        # one checkpoint read shared by the text + vision conversions
+        return self.build_params_with_extras(
+            super().build_params, self.family.convert_vision_params
+        )
 
     def build_params_struct(self):
         struct = super().build_params_struct()

@@ -49,21 +49,9 @@ class MllamaApplication(TpuModelForCausalLM):
 
     # -- params --
     def build_params(self):
-        real_get = self.get_state_dict
-        cache = {}
-
-        def cached():
-            if "sd" not in cache:
-                cache["sd"] = real_get()
-            return cache["sd"]
-
-        self.get_state_dict = cached
-        try:
-            params = super().build_params()
-            params.update(mm.convert_vision_params(cached(), self.config))
-        finally:
-            self.get_state_dict = real_get
-        return params
+        return self.build_params_with_extras(
+            super().build_params, mm.convert_vision_params
+        )
 
     def build_params_struct(self):
         struct = super().build_params_struct()

@@ -143,6 +143,10 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "ApertusInferenceConfig",
     ),
     "janus": ("nxdi_tpu.models.janus.modeling_janus", "JanusInferenceConfig"),
+    "idefics": (
+        "nxdi_tpu.models.idefics.modeling_idefics",
+        "IdeficsInferenceConfig",
+    ),
 }
 
 

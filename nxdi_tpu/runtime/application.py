@@ -124,6 +124,28 @@ class ApplicationBase:
         sd = ckpt.load_state_dict(self.model_path)
         return sd
 
+    def build_params_with_extras(self, base_build, extra_converter) -> Any:
+        """``base_build()`` (the subclass's ``super().build_params``) + extra
+        sub-pytrees from the SAME checkpoint read: memoizes get_state_dict so
+        the text conversion and ``extra_converter(sd, config) -> dict`` share
+        one multi-GB safetensors load (multimodal apps: vision towers,
+        projectors)."""
+        real_get = self.get_state_dict
+        memo = {}
+
+        def cached():
+            if "sd" not in memo:
+                memo["sd"] = real_get()
+            return memo["sd"]
+
+        self.get_state_dict = cached
+        try:
+            params = base_build()
+            params.update(extra_converter(cached(), self.config))
+        finally:
+            self.get_state_dict = real_get
+        return params
+
     def build_params(self) -> Any:
         tc = self.tpu_config
         if tc.quantized and tc.quantized_checkpoints_path:

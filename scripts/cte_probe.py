@@ -1,13 +1,11 @@
 #!/usr/bin/env python
-"""CTE (prefill) bottleneck bisect on the real chip — one process, runs:
+"""CTE (prefill) kernel A/B on the real chip — one process, two runs:
 
   1. full CTE as benched (flash-prefill kernel ON, fused_qkv ON)
   2. full CTE with the Pallas prefill kernel OFF (XLA attention)
-  3. pure-GEMM proxy: the 16-layer matmul skeleton alone (no attention,
-     no norms/rope/cache) — the MXU floor for the same weight traffic
 
-The gap (1)-(3) is what attention + elementwise + cache writes cost; the
-gap (1)-(2) is the kernel's win/loss vs XLA. Prints one JSON line."""
+The (1)-(2) gap is the kernel's win/loss vs XLA at the bench shape.
+Prints one JSON line {cte_kernel_ms, cte_xla_attn_ms}."""
 import json
 import sys
 import time
@@ -78,50 +76,11 @@ def main():
         del app
         return float(np.percentile(ms, 50))
 
-    # --- pure GEMM proxy ---
-    def gemm_proxy():
-        M = BATCH * PROMPT_LEN
-        qkv_out = (N_HEADS + 2 * N_KV_HEADS) * HEAD_DIM
-        key = jax.random.PRNGKey(0)
-        Wqkv = jax.random.normal(key, (N_LAYERS, HIDDEN, qkv_out), jnp.bfloat16) * 0.02
-        Wo = jax.random.normal(key, (N_LAYERS, N_HEADS * HEAD_DIM, HIDDEN), jnp.bfloat16) * 0.02
-        Wg = jax.random.normal(key, (N_LAYERS, HIDDEN, INTERMEDIATE), jnp.bfloat16) * 0.02
-        Wu = jax.random.normal(key, (N_LAYERS, HIDDEN, INTERMEDIATE), jnp.bfloat16) * 0.02
-        Wd = jax.random.normal(key, (N_LAYERS, INTERMEDIATE, HIDDEN), jnp.bfloat16) * 0.02
-        x0 = jax.random.normal(key, (M, HIDDEN), jnp.bfloat16)
-
-        @jax.jit
-        def f(x):
-            def body(h, ws):
-                wqkv, wo, wg, wu, wd = ws
-                qkv = h @ wqkv
-                h = h + qkv[:, : N_HEADS * HEAD_DIM] @ wo
-                g = jax.nn.silu(h @ wg)
-                u = h @ wu
-                h = h + (g * u) @ wd
-                return h, None
-
-            h, _ = jax.lax.scan(body, x, (Wqkv, Wo, Wg, Wu, Wd))
-            return h
-
-        f(x0).block_until_ready()
-        ms = []
-        for _ in range(6):
-            t0 = time.perf_counter()
-            # non-donated output: block_until_ready is a real barrier, and a
-            # full fetch of the (32k, 2048) result would swamp the tunnel
-            f(x0).block_until_ready()
-            ms.append((time.perf_counter() - t0) * 1000.0)
-        return float(np.percentile(ms, 50))
-
-    gemm_ms = gemm_proxy()
-    print(f"[probe] gemm proxy {gemm_ms:.1f} ms", file=sys.stderr, flush=True)
     cte_kernel = run_cte(True)
     print(f"[probe] cte kernel-on {cte_kernel:.1f} ms", file=sys.stderr, flush=True)
     cte_xla = run_cte(False)
     print(f"[probe] cte kernel-off {cte_xla:.1f} ms", file=sys.stderr, flush=True)
     print(json.dumps({
-        "gemm_proxy_ms": round(gemm_ms, 1),
         "cte_kernel_ms": round(cte_kernel, 1),
         "cte_xla_attn_ms": round(cte_xla, 1),
     }))
