@@ -247,6 +247,24 @@ class ContiguousKVLayout:
             # (models/base.py clips the attended fresh rows the same way)
             k_new = self.clip_to_store(k_new, store)
             v_new = self.clip_to_store(v_new, store)
+        if (
+            k_new.shape[2] > 1
+            and cache_inputs.get("prefill_from_zero", False)
+            and not self.route_by_seq_id
+        ):
+            # CTE fast path: by the context-encoding contract every row
+            # writes positions [0, S_act) (right-pad lanes continue the
+            # arange), so the write is ONE dynamic_update_slice at the
+            # origin — XLA lowers the general positional write as a scatter
+            # over B*S_act rows, the same pathology the decode commit kernel
+            # killed (ops/kernels/kv_commit.py)
+            k_cache_l = jax.lax.dynamic_update_slice(
+                k_cache_l, k_new.astype(store), (0, 0, 0, 0)
+            )
+            v_cache_l = jax.lax.dynamic_update_slice(
+                v_cache_l, v_new.astype(store), (0, 0, 0, 0)
+            )
+            return k_cache_l, v_cache_l
         k_vals = jnp.swapaxes(k_new, 1, 2).astype(store)  # (B, S_act, KV, D)
         v_vals = jnp.swapaxes(v_new, 1, 2).astype(store)
         k_cache_l = k_cache_l.at[b_idx, :, pos].set(k_vals, mode="drop")

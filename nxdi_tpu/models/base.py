@@ -556,6 +556,11 @@ def attention_block(
         # in-scan layer index (the scan's arange xs): per-layer KV-quant
         # scale selection (kv_cache.py _scale_for) and stacked kernels
         ci["layer_idx"] = layer_idx
+    if not attend_to_cache and S > 1 and ci.get("write_positions") is None:
+        # context encoding from a fresh cache: positions are the row arange
+        # starting at 0, so the contiguous layout may take its slice-write
+        # fast path instead of a B*S-row scatter (kv_cache.py update)
+        ci["prefill_from_zero"] = True
     # run_decoder_layers is the single authority on eligibility; the mask
     # check repeats here only because tree-verify programs statically carry
     # attn_mask in their cache inputs

@@ -1,0 +1,101 @@
+"""Shared base for cross-attention vision-language applications (mllama,
+idefics): vision params riding the text pytree, cross-KV entries in the
+donated cache, and the common unsupported-mode guard.
+
+Reference analog: the multimodal KV manager + image-to-text wrappers
+(modules/kvcache/multimodal_kv_cache_manager.py, image_to_text_model_wrapper
+.py) that both reference families build on."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+
+from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+
+class CrossAttentionVLApplication(TpuModelForCausalLM):
+    """Subclasses set ``FAMILY_NAME`` (for error text) and implement
+    ``_cross_kv_shape()`` -> (n_cross, B, KV, T, D); ``self.family`` must
+    expose convert_vision_params / vision_shape_struct."""
+
+    FAMILY_NAME = "cross-attention VL model"
+
+    def _cross_kv_shape(self) -> Tuple[int, ...]:
+        raise NotImplementedError
+
+    def _reject_unsupported(self):
+        tc = self.tpu_config
+        for flag, why in (
+            (tc.async_mode, "async (device-resident) decode"),
+            (tc.is_block_kv_layout, "paged KV layout"),
+            (tc.lora_config is not None, "LoRA serving"),
+            (tc.speculation_length > 0, "speculative decoding"),
+            (tc.enable_fused_speculation, "fused speculation"),
+            (tc.is_medusa, "medusa"),
+            (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
+            (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
+            (tc.is_continuous_batching, "continuous batching (cross-KV is not "
+             "seq-id routed yet)"),
+            (tc.kv_quant_config is not None,
+             "KV-cache quantization (untested with the cross-KV store)"),
+        ):
+            if flag:
+                raise NotImplementedError(
+                    f"{self.FAMILY_NAME} does not support {why} yet"
+                )
+
+    # -- params: text + vision sub-pytrees from ONE checkpoint read --
+    def build_params(self):
+        return self.build_params_with_extras(
+            super().build_params, self.family.convert_vision_params
+        )
+
+    def build_params_struct(self):
+        struct = super().build_params_struct()
+        struct.update(self.family.vision_shape_struct(self.config))
+        return struct
+
+    def param_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        specs = super().param_specs()
+        struct = self.family.vision_shape_struct(self.config)
+        specs.update(jax.tree_util.tree_map(lambda _: P(), struct))
+        return specs
+
+    # -- cache: self-attn KV + cross-attn KV --
+    def _cross_cache_struct(self):
+        from nxdi_tpu.config import to_jax_dtype
+
+        # COMPUTE dtype, not the (possibly quantized) self-attn store dtype:
+        # the cross store has no scale plumbing, so a quantized cast would
+        # silently corrupt the vision keys — under kv_quant_config only the
+        # position-addressed self stacks quantize (guarded above anyway)
+        dt = to_jax_dtype(self.family.build_arch(self.config).text.dtype)
+        shape = self._cross_kv_shape()
+        return {
+            "cross_k": jax.ShapeDtypeStruct(shape, dt),
+            "cross_v": jax.ShapeDtypeStruct(shape, dt),
+        }
+
+    def _cache_struct(self):
+        struct = super()._cache_struct()
+        struct.update(self._cross_cache_struct())
+        return struct
+
+    def init_cache_host(self):
+        import jax.numpy as jnp
+
+        cache = super().init_cache_host()
+        for k, s in self._cross_cache_struct().items():
+            cache[k] = jnp.zeros(s.shape, s.dtype)
+        return cache
+
+    def cache_partition_specs(self):
+        specs = dict(kv_cache_partition_spec(self.tpu_config))
+        specs["cross_k"] = specs["k"]
+        specs["cross_v"] = specs["k"]
+        return specs

@@ -13,86 +13,29 @@ from typing import Optional
 import jax
 import numpy as np
 
-from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
+from nxdi_tpu.models.cross_attention_app import CrossAttentionVLApplication
 from nxdi_tpu.models.idefics import modeling_idefics as mi
-from nxdi_tpu.runtime.application import TpuModelForCausalLM
 from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
 
 
-class IdeficsApplication(TpuModelForCausalLM):
+class IdeficsApplication(CrossAttentionVLApplication):
+    FAMILY_NAME = "idefics"
+
     def __init__(self, *args, **kwargs):
         kwargs.setdefault("model_family", mi)
         super().__init__(*args, **kwargs)
-        tc = self.tpu_config
-        for flag, why in (
-            (tc.async_mode, "async (device-resident) decode"),
-            (tc.is_block_kv_layout, "paged KV layout"),
-            (tc.lora_config is not None, "LoRA serving"),
-            (tc.speculation_length > 0, "speculative decoding"),
-            (tc.enable_fused_speculation, "fused speculation"),
-            (tc.is_medusa, "medusa"),
-            (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
-            (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
-            (tc.is_continuous_batching, "continuous batching (cross-KV is not "
-             "seq-id routed yet)"),
-        ):
-            if flag:
-                raise NotImplementedError(f"idefics does not support {why} yet")
+        self._reject_unsupported()
         self._encode_jit = None
         # last prompt image-mask row per batch line (HF generation repeats
         # image_attention_mask[:, -1:] for every generated token)
         self._last_imask: Optional[np.ndarray] = None
         self._arch = mi.build_arch(self.config)
 
-    # -- params --
-    def build_params(self):
-        return self.build_params_with_extras(
-            super().build_params, mi.convert_vision_params
-        )
-
-    def build_params_struct(self):
-        struct = super().build_params_struct()
-        struct.update(mi.vision_shape_struct(self.config))
-        return struct
-
-    def param_specs(self):
-        from jax.sharding import PartitionSpec as P
-
-        specs = super().param_specs()
-        struct = mi.vision_shape_struct(self.config)
-        specs.update(jax.tree_util.tree_map(lambda _: P(), struct))
-        return specs
-
-    # -- cache: self-attn KV + cross-attn KV --
-    def _cross_cache_struct(self):
+    def _cross_kv_shape(self):
         arch = self._arch
         t = arch.text
-        spec = self._cache_spec()
         B = self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size
-        shape = (arch.n_cross, B, t.num_kv_heads, arch.t_img, t.head_dim)
-        return {
-            "cross_k": jax.ShapeDtypeStruct(shape, spec.store_dtype),
-            "cross_v": jax.ShapeDtypeStruct(shape, spec.store_dtype),
-        }
-
-    def _cache_struct(self):
-        struct = super()._cache_struct()
-        struct.update(self._cross_cache_struct())
-        return struct
-
-    def init_cache_host(self):
-        import jax.numpy as jnp
-
-        cache = super().init_cache_host()
-        for k, s in self._cross_cache_struct().items():
-            cache[k] = jnp.zeros(s.shape, s.dtype)
-        return cache
-
-    def cache_partition_specs(self):
-        specs = dict(kv_cache_partition_spec(self.tpu_config))
-        specs["cross_k"] = specs["k"]
-        specs["cross_v"] = specs["k"]
-        return specs
+        return (arch.n_cross, B, t.num_kv_heads, arch.t_img, t.head_dim)
 
     # -- submodels --
     def enable_models(self) -> None:

@@ -1,0 +1,45 @@
+"""InternLM3 family — llama geometry with split bias knobs.
+
+Reference: contrib/models/internlm3-8b-instruct
+(src/modeling_internlm3.py:60-120, mirroring the InternLM remote-code
+InternLM3ForCausalLM): ``qkv_bias`` gates the q/k/v biases and ``bias``
+the o_proj/MLP biases independently; optional explicit ``head_dim``."""
+
+from __future__ import annotations
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class InternLM3InferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        if not hasattr(self, "qkv_bias"):
+            self.qkv_bias = False
+        if not hasattr(self, "bias"):
+            self.bias = False
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(
+        attention_bias=bool(getattr(config, "qkv_bias", False)),
+        attention_o_bias=bool(getattr(config, "bias", False)),
+        mlp_bias=bool(getattr(config, "bias", False)),
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(state_dict, config, build_arch(config))
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))

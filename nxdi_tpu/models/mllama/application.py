@@ -4,8 +4,7 @@ Reference: NeuronMllamaForCausalLM (models/mllama/modeling_mllama.py:1083)
 and its model wrapper (model_wrapper_mllama.py): a vision submodel feeds
 cross-attention states into CTE; decode reads the cross-KV cache written at
 prefill. Here the cross-KV are two extra entries in the donated cache pytree
-(the reference's MultimodalKVCache as explicit state).
-"""
+(the reference's MultimodalKVCache as explicit state)."""
 
 from __future__ import annotations
 
@@ -15,31 +14,18 @@ from typing import Optional
 import jax
 import numpy as np
 
-from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
+from nxdi_tpu.models.cross_attention_app import CrossAttentionVLApplication
 from nxdi_tpu.models.mllama import modeling_mllama as mm
-from nxdi_tpu.runtime.application import TpuModelForCausalLM
-from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING, TAG_TOKEN_GENERATION
+from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
 
 
-class MllamaApplication(TpuModelForCausalLM):
+class MllamaApplication(CrossAttentionVLApplication):
+    FAMILY_NAME = "mllama"
+
     def __init__(self, *args, **kwargs):
         kwargs.setdefault("model_family", mm)
         super().__init__(*args, **kwargs)
-        tc = self.tpu_config
-        for flag, why in (
-            (tc.async_mode, "async (device-resident) decode"),
-            (tc.is_block_kv_layout, "paged KV layout"),
-            (tc.lora_config is not None, "LoRA serving"),
-            (tc.speculation_length > 0, "speculative decoding"),
-            (tc.enable_fused_speculation, "fused speculation"),
-            (tc.is_medusa, "medusa"),
-            (getattr(tc, "pp_degree", 1) > 1, "pipeline parallel"),
-            (tc.is_prefix_caching or tc.is_chunked_prefill, "prefix/chunked prefill"),
-            (tc.is_continuous_batching, "continuous batching (cross-KV is not "
-             "seq-id routed yet)"),
-        ):
-            if flag:
-                raise NotImplementedError(f"mllama does not support {why} yet")
+        self._reject_unsupported()
         self._encode_jit = None
         # last prompt cross-mask row per batch line (HF generation repeats it
         # for every generated token, modeling_mllama.py:1732)
@@ -47,56 +33,11 @@ class MllamaApplication(TpuModelForCausalLM):
         # static across the app's life; avoid rebuilding per decode dispatch
         self._arch = mm.build_arch(self.config)
 
-    # -- params --
-    def build_params(self):
-        return self.build_params_with_extras(
-            super().build_params, mm.convert_vision_params
-        )
-
-    def build_params_struct(self):
-        struct = super().build_params_struct()
-        struct.update(mm.vision_shape_struct(self.config))
-        return struct
-
-    def param_specs(self):
-        from jax.sharding import PartitionSpec as P
-
-        specs = super().param_specs()
-        struct = mm.vision_shape_struct(self.config)
-        specs.update(jax.tree_util.tree_map(lambda _: P(), struct))
-        return specs
-
-    # -- cache: self-attn KV + cross-attn KV --
-    def _cross_cache_struct(self):
-        arch = mm.build_arch(self.config)
+    def _cross_kv_shape(self):
+        arch = self._arch
         t = arch.text
-        spec = self._cache_spec()
         B = self.tpu_config.kv_cache_batch_size + self.tpu_config.kv_cache_padding_size
-        shape = (arch.n_cross, B, t.num_kv_heads, arch.t_vis, t.head_dim)
-        return {
-            "cross_k": jax.ShapeDtypeStruct(shape, spec.store_dtype),
-            "cross_v": jax.ShapeDtypeStruct(shape, spec.store_dtype),
-        }
-
-    def _cache_struct(self):
-        struct = super()._cache_struct()
-        struct.update(self._cross_cache_struct())
-        return struct
-
-    def init_cache_host(self):
-        import jax.numpy as jnp
-
-        cache = super().init_cache_host()
-        for k, s in self._cross_cache_struct().items():
-            cache[k] = jnp.zeros(s.shape, s.dtype)
-        return cache
-
-    def cache_partition_specs(self):
-        specs = dict(kv_cache_partition_spec(self.tpu_config))
-        self_spec = specs["k"]
-        specs["cross_k"] = self_spec
-        specs["cross_v"] = self_spec
-        return specs
+        return (arch.n_cross, B, t.num_kv_heads, arch.t_vis, t.head_dim)
 
     # -- submodels --
     def enable_models(self) -> None:

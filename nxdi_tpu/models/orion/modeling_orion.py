@@ -1,0 +1,61 @@
+"""Orion family — llama geometry with biased LayerNorm block norms.
+
+Reference: contrib/models/orion-14b-chat (src/modeling_orion.py:50-230,
+mirroring the OrionStarAI remote-code OrionForCausalLM): pre-norm llama
+whose ``input_layernorm``/``post_attention_layernorm``/final ``norm`` are
+full nn.LayerNorm (weight + bias, eps = rms_norm_eps); no projection
+biases."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch
+
+build_inv_freq = dense.build_inv_freq
+
+
+class OrionInferenceConfig(dense.DenseInferenceConfig):
+    pass
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    kwargs = dict(layernorm=True)
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+    L = arch.num_layers
+
+    def src(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    params = dense.convert_hf_state_dict(state_dict, config, arch)
+    return dense.attach_norm_biases(
+        params,
+        [src(f"layers.{i}.input_layernorm.bias") for i in range(L)],
+        [src(f"layers.{i}.post_attention_layernorm.bias") for i in range(L)],
+        src("norm.bias"), dt,
+    )
+
+
+def param_specs(config: InferenceConfig):
+    return dense.biased_layernorm_specs(dense.param_specs_for(build_arch(config)))
+
+
+def param_shape_struct(config: InferenceConfig):
+    from nxdi_tpu.config import to_jax_dtype
+
+    arch = build_arch(config)
+    return dense.biased_layernorm_struct(
+        dense.param_shape_struct(config, arch),
+        arch.num_layers, arch.hidden_size, to_jax_dtype(arch.dtype),
+    )

@@ -147,6 +147,13 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "nxdi_tpu.models.idefics.modeling_idefics",
         "IdeficsInferenceConfig",
     ),
+    "minicpm": ("nxdi_tpu.models.minicpm.modeling_minicpm", "MiniCPMInferenceConfig"),
+    "minicpm4": ("nxdi_tpu.models.minicpm.modeling_minicpm", "MiniCPMInferenceConfig"),
+    "internlm3": (
+        "nxdi_tpu.models.internlm3.modeling_internlm3",
+        "InternLM3InferenceConfig",
+    ),
+    "orion": ("nxdi_tpu.models.orion.modeling_orion", "OrionInferenceConfig"),
 }
 
 

@@ -14,8 +14,6 @@ import numpy as np
 
 
 def main():
-    import jax
-    import jax.numpy as jnp
     import jax.tree_util as jtu
     import ml_dtypes
 
@@ -76,6 +74,16 @@ def main():
         del app
         return float(np.percentile(ms, 50))
 
+    if "--kernel-only" in sys.argv:
+        import os
+
+        cte_kernel = run_cte(True)
+        print(json.dumps({
+            "cte_kernel_ms": round(cte_kernel, 1),
+            "block_q": os.environ.get("NXDI_TPU_PREFILL_BLOCK_Q", "512"),
+            "block_k": os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "512"),
+        }))
+        return
     cte_kernel = run_cte(True)
     print(f"[probe] cte kernel-on {cte_kernel:.1f} ms", file=sys.stderr, flush=True)
     cte_xla = run_cte(False)

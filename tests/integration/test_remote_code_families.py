@@ -1,0 +1,172 @@
+"""Families whose upstream lives as HF remote code (no transformers-core
+class): minicpm, internlm3, orion. Exact greedy token match against a
+SELF-CONTAINED torch reference implementing each variant's documented
+semantics (reference analogs: contrib/models/{MiniCPM4-8B,
+internlm3-8b-instruct, orion-14b-chat} integration tests)."""
+
+import math
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.registry import get_family
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+
+H, INTER, LAYERS, HEADS, KV, VOCAB, D = 64, 128, 4, 4, 2, 256, 16
+
+
+class _Ref(nn.Module):
+    """Minimal llama-variant decoder: norm kind, bias knobs, and the mu-P
+    scalings are the only degrees of freedom the three families need."""
+
+    def __init__(self, *, layernorm=False, qkv_bias=False, o_bias=False,
+                 mlp_bias=False, scale_emb=1.0, residual_mult=1.0,
+                 logits_div=1.0, seed=0):
+        super().__init__()
+        torch.manual_seed(seed)
+        self.scale_emb, self.residual_mult, self.logits_div = (
+            scale_emb, residual_mult, logits_div
+        )
+        self.embed = nn.Embedding(VOCAB, H)
+        mk_norm = (lambda: nn.LayerNorm(H, eps=1e-5)) if layernorm else (
+            lambda: nn.RMSNorm(H, eps=1e-5)
+        )
+        self.layers = nn.ModuleList()
+        for _ in range(LAYERS):
+            blk = nn.Module()
+            blk.ln1, blk.ln2 = mk_norm(), mk_norm()
+            blk.q = nn.Linear(H, HEADS * D, bias=qkv_bias)
+            blk.k = nn.Linear(H, KV * D, bias=qkv_bias)
+            blk.v = nn.Linear(H, KV * D, bias=qkv_bias)
+            blk.o = nn.Linear(HEADS * D, H, bias=o_bias)
+            blk.gate = nn.Linear(H, INTER, bias=mlp_bias)
+            blk.up = nn.Linear(H, INTER, bias=mlp_bias)
+            blk.down = nn.Linear(INTER, H, bias=mlp_bias)
+            self.layers.append(blk)
+        self.norm = mk_norm()
+        self.lm_head = nn.Linear(H, VOCAB, bias=False)
+
+    def _rope(self, x, pos):
+        half = D // 2
+        inv = 1.0 / (10000.0 ** (torch.arange(half, dtype=torch.float64) / half))
+        ang = pos[:, :, None].double() * inv[None, None]
+        cos = torch.cos(ang).float()[:, None]
+        sin = torch.sin(ang).float()[:, None]
+        x1, x2 = x[..., :half], x[..., half:]
+        return torch.cat([x1 * cos - x2 * sin, x2 * cos + x1 * sin], dim=-1)
+
+    def forward(self, ids):
+        B, S = ids.shape
+        pos = torch.arange(S)[None].expand(B, S)
+        h = self.embed(ids) * self.scale_emb
+        mask = torch.full((S, S), float("-inf")).triu(1)
+        for blk in self.layers:
+            y = blk.ln1(h)
+            q = blk.q(y).view(B, S, HEADS, D).transpose(1, 2)
+            k = blk.k(y).view(B, S, KV, D).transpose(1, 2)
+            v = blk.v(y).view(B, S, KV, D).transpose(1, 2)
+            q, k = self._rope(q, pos), self._rope(k, pos)
+            k = k.repeat_interleave(HEADS // KV, dim=1)
+            v = v.repeat_interleave(HEADS // KV, dim=1)
+            scores = q @ k.transpose(-1, -2) / math.sqrt(D) + mask
+            ctx = torch.softmax(scores.float(), dim=-1).to(v.dtype) @ v
+            ctx = ctx.transpose(1, 2).reshape(B, S, HEADS * D)
+            h = h + blk.o(ctx) * self.residual_mult
+            y = blk.ln2(h)
+            ff = blk.down(torch.nn.functional.silu(blk.gate(y)) * blk.up(y))
+            h = h + ff * self.residual_mult
+        return self.lm_head(self.norm(h)) / self.logits_div
+
+    def greedy(self, ids, n):
+        ids = torch.tensor(ids)
+        for _ in range(n):
+            logits = self.forward(ids)
+            ids = torch.cat([ids, logits[:, -1:].argmax(-1)], dim=1)
+        return ids.numpy()
+
+    def hf_state_dict(self):
+        """Rename into the HF llama key layout the family converters read."""
+        sd = {"model.embed_tokens.weight": self.embed.weight,
+              "model.norm.weight": self.norm.weight,
+              "lm_head.weight": self.lm_head.weight}
+        if hasattr(self.norm, "bias") and self.norm.bias is not None:
+            sd["model.norm.bias"] = self.norm.bias
+        names = {
+            "q": "self_attn.q_proj", "k": "self_attn.k_proj",
+            "v": "self_attn.v_proj", "o": "self_attn.o_proj",
+            "gate": "mlp.gate_proj", "up": "mlp.up_proj", "down": "mlp.down_proj",
+        }
+        for i, blk in enumerate(self.layers):
+            pre = f"model.layers.{i}."
+            sd[pre + "input_layernorm.weight"] = blk.ln1.weight
+            sd[pre + "post_attention_layernorm.weight"] = blk.ln2.weight
+            if hasattr(blk.ln1, "bias") and blk.ln1.bias is not None:
+                sd[pre + "input_layernorm.bias"] = blk.ln1.bias
+                sd[pre + "post_attention_layernorm.bias"] = blk.ln2.bias
+            for attr, hf in names.items():
+                mod = getattr(blk, attr)
+                sd[pre + hf + ".weight"] = mod.weight
+                if mod.bias is not None:
+                    sd[pre + hf + ".bias"] = mod.bias
+        return {k: v.detach().numpy() for k, v in sd.items()}
+
+
+BASE_CFG = dict(
+    hidden_size=H, intermediate_size=INTER, num_hidden_layers=LAYERS,
+    num_attention_heads=HEADS, num_key_value_heads=KV, vocab_size=VOCAB,
+    rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=256,
+    tie_word_embeddings=False,
+)
+
+CASES = [
+    pytest.param(
+        "minicpm",
+        dict(scale_emb=12.0, scale_depth=1.4, dim_model_base=32),
+        dict(scale_emb=12.0, residual_mult=1.4 / math.sqrt(LAYERS),
+             logits_div=H / 32),
+        id="minicpm",
+    ),
+    pytest.param(
+        "internlm3",
+        dict(qkv_bias=True, bias=False),
+        dict(qkv_bias=True),
+        id="internlm3",
+    ),
+    pytest.param("orion", dict(), dict(layernorm=True), id="orion"),
+]
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+@pytest.mark.parametrize("model_type,cfg_extra,ref_kwargs", CASES)
+def test_remote_code_family_token_matching(model_type, cfg_extra, ref_kwargs,
+                                           tp_degree):
+    ref = _Ref(**ref_kwargs).eval()
+    sd = ref.hf_state_dict()
+
+    family, cfg_cls = get_family(model_type)
+    tcfg = TpuConfig(
+        tp_degree=tp_degree, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = cfg_cls(
+        tcfg,
+        load_config=lambda: {**BASE_CFG, **cfg_extra, "model_type": model_type},
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=family)
+    app.load()
+
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    with torch.no_grad():
+        expected = ref.greedy(prompt, 16)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
