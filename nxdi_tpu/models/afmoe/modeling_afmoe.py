@@ -1,0 +1,280 @@
+"""Afmoe (Arcee Trinity) family — gated-attention MoE with expert-bias
+sigmoid routing, dual (sandwich) layer norms, NoPE global layers, and a
+dense head segment.
+
+Reference: contrib/models/Trinity (src/modeling_trinity.py:24-40, 553-640,
+1340-1480, mirroring the Arcee AfmoeForCausalLM remote code):
+  - attention: per-head q/k RMSNorm; output gated by
+    ``sigmoid(gate_proj(attention input))`` before o_proj (the shared
+    ``attn_out_gate`` switch); rope ONLY on sliding layers (every
+    ``global_attn_every_n_layers``-th layer is full attention AND NoPE);
+  - norms: input/post-attention + pre/post-MLP — the gemma sandwich
+    machinery with plain RMSNorms (pre_mlp/post_mlp renamed onto the
+    pre/post_feedforward slots);
+  - muP: embeddings scaled by sqrt(hidden) (``mup_enabled``);
+  - MoE (layers >= num_dense_layers): sigmoid router, top-k selected over
+    bias-ADDED scores but weighted by the raw scores (the deepseek-V3
+    correction-bias machinery), optional renorm (``route_norm``) and
+    ``route_scale``, ``num_shared_experts`` fused shared MLP; the first
+    ``num_dense_layers`` layers are a plain dense segment (segmented layer
+    stacks, like deepseek first_k_dense_replace)."""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.base import DecoderArch, decoder_param_specs
+from nxdi_tpu.ops.moe import MoEArch, moe_parallel_fields, moe_shape_struct
+from nxdi_tpu.parallel import gqa
+from nxdi_tpu.parallel.layers import REPLICATED
+
+build_inv_freq = dense.build_inv_freq
+
+
+class AfmoeInferenceConfig(dense.DenseInferenceConfig):
+    def add_derived_config(self):
+        defaults = {
+            "num_dense_layers": 2,
+            "num_experts_per_tok": 8,
+            "num_shared_experts": 1,
+            "route_norm": True,
+            "route_scale": 1.0,
+            "score_func": "sigmoid",
+            "global_attn_every_n_layers": 4,
+            "sliding_window": 2048,
+            "mup_enabled": True,
+        }
+        for k, v in defaults.items():
+            if not hasattr(self, k):
+                setattr(self, k, v)
+        if not hasattr(self, "num_local_experts"):
+            self.num_local_experts = getattr(self, "num_experts", 128)
+        if not hasattr(self, "moe_intermediate_size"):
+            self.moe_intermediate_size = self.intermediate_size
+        super().add_derived_config()
+        if self.score_func != "sigmoid":
+            raise NotImplementedError(
+                f"afmoe score_func {self.score_func!r} not supported (sigmoid only)"
+            )
+        if not hasattr(self, "layer_types") or self.layer_types is None:
+            n = self.global_attn_every_n_layers
+            self.layer_types = [
+                "sliding_attention" if bool((i + 1) % n) else "full_attention"
+                for i in range(self.num_hidden_layers)
+            ]
+
+
+def _moe_arch(config: InferenceConfig) -> MoEArch:
+    E = config.num_local_experts
+    n_shared = getattr(config, "num_shared_experts", 0) or 0
+    return MoEArch(
+        num_experts=E,
+        top_k=config.num_experts_per_tok,
+        intermediate_size=config.moe_intermediate_size,
+        hidden_act=getattr(config, "hidden_act", "silu"),
+        norm_topk_prob=bool(getattr(config, "route_norm", True)),
+        sigmoid_routing=True,
+        routed_scaling=float(getattr(config, "route_scale", 1.0)),
+        correction_bias=True,  # expert_bias: selection-only (RouterTopKWithBias)
+        shared_expert_intermediate_size=(
+            n_shared * config.moe_intermediate_size if n_shared else None
+        ),
+        **moe_parallel_fields(config.tpu_config, E),
+    )
+
+
+def _n_dense(config: InferenceConfig) -> int:
+    return int(getattr(config, "num_dense_layers", 0) or 0)
+
+
+def _sliding_flags(config) -> np.ndarray:
+    return np.array(
+        [t == "sliding_attention" for t in config.layer_types], dtype=bool
+    )
+
+
+def build_arch(config: InferenceConfig, **overrides) -> DecoderArch:
+    moe = _moe_arch(config)
+    if _n_dense(config) >= config.num_hidden_layers:
+        moe = None
+    sw = getattr(config, "sliding_window", None)
+    kwargs = dict(
+        qk_norm=True,
+        attn_out_gate=True,
+        sandwich_norm=True,
+        sliding_window=sw,
+        embed_scale=(
+            math.sqrt(config.hidden_size)
+            if getattr(config, "mup_enabled", True) else None
+        ),
+        moe=moe,
+        kv_window_pattern=tuple(_sliding_flags(config)) if sw else None,
+    )
+    kwargs.update(overrides)
+    return dense.build_arch(config, **kwargs)
+
+
+def _segment_archs(config: InferenceConfig, arch: DecoderArch):
+    k = _n_dense(config)
+    if arch.moe is None or not (0 < k < arch.num_layers):
+        return None
+    head = dataclasses.replace(arch, num_layers=k, moe=None)
+    tail = dataclasses.replace(arch, num_layers=arch.num_layers - k)
+    return head, tail
+
+
+def convert_hf_state_dict(
+    state_dict: Dict[str, np.ndarray], config: InferenceConfig
+) -> Dict[str, Any]:
+    arch = build_arch(config)
+    dt = dense.np_dtype(arch.dtype)
+    plan = dense.gqa_plan(config)
+    D = arch.head_dim
+    k_dense = _n_dense(config)
+
+    def get(name):
+        for k in (name, f"model.{name}"):
+            if k in state_dict:
+                return np.asarray(state_dict[k])
+        raise KeyError(name)
+
+    def cast(x):
+        return np.asarray(x, dtype=dt)
+
+    layers = []
+    for i in range(arch.num_layers):
+        pre = f"layers.{i}."
+        attn = {
+            "q_proj": {"w": cast(gqa.convert_q(get(pre + "self_attn.q_proj.weight"), D, plan).T)},
+            "k_proj": {"w": cast(gqa.convert_kv(get(pre + "self_attn.k_proj.weight"), D, plan).T)},
+            "v_proj": {"w": cast(gqa.convert_kv(get(pre + "self_attn.v_proj.weight"), D, plan).T)},
+            "o_proj": {"w": cast(gqa.convert_o(get(pre + "self_attn.o_proj.weight"), D, plan).T)},
+            # the attention output gate has q-shaped columns: same interleave
+            "gate_proj": {"w": cast(gqa.convert_q(get(pre + "self_attn.gate_proj.weight"), D, plan).T)},
+            "q_norm": cast(get(pre + "self_attn.q_norm.weight")),
+            "k_norm": cast(get(pre + "self_attn.k_norm.weight")),
+        }
+        layer: Dict[str, Any] = {
+            "input_layernorm": cast(get(pre + "input_layernorm.weight")),
+            "post_attention_layernorm": cast(get(pre + "post_attention_layernorm.weight")),
+            "pre_feedforward_layernorm": cast(get(pre + "pre_mlp_layernorm.weight")),
+            "post_feedforward_layernorm": cast(get(pre + "post_mlp_layernorm.weight")),
+            "attn": attn,
+        }
+        if arch.moe is not None and i >= k_dense:
+            moe = arch.moe
+            mo: Dict[str, Any] = {
+                "router": {
+                    "w": cast(get(pre + "mlp.router.gate.weight")).T,
+                    # expert_bias: selection-only, kept f32 (near-tie flips)
+                    "e_bias": np.asarray(get(pre + "mlp.expert_bias"), np.float32),
+                },
+                "experts": {
+                    p: {"w": cast(np.stack([
+                        np.asarray(get(f"{pre}mlp.experts.{j}.{p}.weight")).T
+                        for j in range(moe.num_experts)
+                    ]))}
+                    for p in ("gate_proj", "up_proj", "down_proj")
+                },
+            }
+            if moe.shared_expert_intermediate_size:
+                mo["shared_expert"] = {
+                    p: {"w": cast(get(f"{pre}mlp.shared_experts.{p}.weight")).T}
+                    for p in ("gate_proj", "up_proj", "down_proj")
+                }
+            layer["moe"] = mo
+        else:
+            layer["mlp"] = {
+                p: {"w": cast(get(f"{pre}mlp.{p}.weight")).T}
+                for p in ("gate_proj", "up_proj", "down_proj")
+            }
+        layers.append(layer)
+
+    sliding = _sliding_flags(config)
+    if arch.moe is not None and 0 < k_dense < arch.num_layers:
+        stacked = [dense.tree_stack(layers[:k_dense]), dense.tree_stack(layers[k_dense:])]
+        for seg, sl in ((stacked[0], sliding[:k_dense]), (stacked[1], sliding[k_dense:])):
+            seg["use_sliding_window"] = sl
+            seg["use_rope"] = sl.copy()  # full-attention layers are NoPE
+    else:
+        stacked = dense.tree_stack(layers)
+        stacked["use_sliding_window"] = sliding
+        stacked["use_rope"] = sliding.copy()
+
+    embed = get("embed_tokens.weight")
+    if arch.vocab_pad:
+        embed = np.concatenate(
+            [embed, np.zeros((arch.vocab_pad, embed.shape[1]), embed.dtype)], axis=0
+        )
+    params: Dict[str, Any] = {
+        "embed_tokens": cast(embed),
+        "layers": stacked,
+        "norm": cast(get("norm.weight")),
+    }
+    head = np.asarray(
+        state_dict.get("lm_head.weight", embed[: config.vocab_size]), dtype=dt
+    )
+    if arch.vocab_pad:
+        head = np.concatenate(
+            [head, np.zeros((arch.vocab_pad, head.shape[1]), dtype=dt)], axis=0
+        )
+    params["lm_head"] = head.T
+    return params
+
+
+def _seg_layer_specs(seg_arch: DecoderArch):
+    import jax.numpy as jnp  # noqa: F401
+
+    spec = decoder_param_specs(seg_arch)["layers"]
+    spec["pre_feedforward_layernorm"] = REPLICATED
+    spec["post_feedforward_layernorm"] = REPLICATED
+    spec["use_sliding_window"] = REPLICATED
+    spec["use_rope"] = REPLICATED
+    return spec
+
+
+def param_specs(config: InferenceConfig):
+    arch = build_arch(config)
+    segs = _segment_archs(config, arch)
+    specs = dense.param_specs_for(arch)
+    if segs is None:
+        specs["layers"] = _seg_layer_specs(arch)
+    else:
+        specs["layers"] = [_seg_layer_specs(s) for s in segs]
+    return specs
+
+
+def _seg_layer_struct(config, seg_arch: DecoderArch):
+    import jax
+    import jax.numpy as jnp
+
+    from nxdi_tpu.config import to_jax_dtype
+
+    dt = to_jax_dtype(seg_arch.dtype)
+    L, hs, D = seg_arch.num_layers, seg_arch.hidden_size, seg_arch.head_dim
+    H = seg_arch.num_attention_heads
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, dt)  # noqa: E731
+    st = dense.param_shape_struct(config, seg_arch)["layers"]
+    st["pre_feedforward_layernorm"] = s(L, hs)
+    st["post_feedforward_layernorm"] = s(L, hs)
+    st["attn"]["gate_proj"] = {"w": s(L, hs, H * D)}
+    st["use_sliding_window"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    st["use_rope"] = jax.ShapeDtypeStruct((L,), jnp.bool_)
+    return st
+
+
+def param_shape_struct(config: InferenceConfig):
+    arch = build_arch(config)
+    struct = dense.param_shape_struct(config, arch)
+    segs = _segment_archs(config, arch)
+    if segs is None:
+        struct["layers"] = _seg_layer_struct(config, arch)
+    else:
+        struct["layers"] = [_seg_layer_struct(config, s) for s in segs]
+    return struct

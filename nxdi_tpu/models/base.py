@@ -161,6 +161,9 @@ class DecoderArch:
     gated_mlp: bool = True
     # o_proj bias (gpt-oss; the llama lineage never has one)
     attention_o_bias: bool = False
+    # Trinity/Afmoe gated attention: ctx *= sigmoid(gate_proj(attn input))
+    # before o_proj (params: attn["gate_proj"]["w"], q-interleave sharded)
+    attn_out_gate: bool = False
     # YaRN attention factor multiplying cos/sin (gpt-oss, deepseek)
     rope_mscale: float = 1.0
     # LongRoPE (phi3 128k): inv_freq arrives stacked (2, D/2) [short, long];
@@ -294,6 +297,8 @@ def attention_param_specs(arch: DecoderArch) -> Dict[str, Any]:
     if arch.qk_norm:
         spec["q_norm"] = REPLICATED
         spec["k_norm"] = REPLICATED
+    if arch.attn_out_gate:  # Trinity/Afmoe
+        spec["gate_proj"] = {"w": COLUMN_PARALLEL}
     return spec
 
 
@@ -433,6 +438,18 @@ def attention_block(
     Dv = arch.v_head_dim or D  # mimo-v2: value width differs from q/k
 
     aq, ac = arch.act_quant, arch.act_clamp
+
+    def _o_proj(ctx2d):
+        """Output projection, optionally gated (Trinity/Afmoe: the context is
+        multiplied by sigmoid(gate_proj(attention input)) before o_proj —
+        composes with every attention strategy since the gate acts on the
+        kernel-agnostic context)."""
+        if arch.attn_out_gate:
+            g = jax.nn.sigmoid(
+                (hidden @ p_attn["gate_proj"]["w"]).astype(jnp.float32)
+            )
+            ctx2d = (ctx2d.astype(jnp.float32) * g).astype(ctx2d.dtype)
+        return _linear(ctx2d, p_attn["o_proj"], aq, ac, adapter_ids)
     if arch.fused_qkv:
         if "qkv_proj" not in p_attn:
             raise NotImplementedError(
@@ -611,9 +628,7 @@ def attention_block(
             if ctx is not None:
                 _record_strategy("tkg_fused_kernel_stacked")
                 ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
-                out = _linear(
-                    ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
-                )
+                out = _o_proj(ctx)
                 return out, (k, v)
         # fused TKG kernel: strict-causal online softmax over the old cache
         # merged with the fresh row in ONE pallas pass — the kernel that
@@ -640,9 +655,7 @@ def attention_block(
             if ctx is not None:
                 _record_strategy("tkg_fused_kernel")
                 ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
-                out = _linear(
-                    ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids
-                )
+                out = _o_proj(ctx)
                 return out, (k, v)
         _record_strategy("tkg_two_part_xla")
         wpos = ci.get("write_positions", position_ids).astype(jnp.int32)
@@ -660,7 +673,7 @@ def attention_block(
             logit_softcap=arch.attn_logit_softcap,
         )
         ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
-        out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
+        out = _o_proj(ctx)
         return out, (k, v)  # fresh rows only; committed after the scan
 
     new_k, new_v = layout.update(k_cache_l, v_cache_l, k, v, ci, cache_spec)
@@ -756,7 +769,7 @@ def attention_block(
                 logit_softcap=arch.attn_logit_softcap,
             )
             ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
-            out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
+            out = _o_proj(ctx)
             return out, (new_k, new_v)
         ctx = None
         if (
@@ -821,7 +834,7 @@ def attention_block(
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
-    out = _linear(ctx, p_attn["o_proj"], arch.act_quant, arch.act_clamp, adapter_ids)
+    out = _o_proj(ctx)
     return out, (new_k, new_v)
 
 

@@ -154,6 +154,7 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
         "InternLM3InferenceConfig",
     ),
     "orion": ("nxdi_tpu.models.orion.modeling_orion", "OrionInferenceConfig"),
+    "afmoe": ("nxdi_tpu.models.afmoe.modeling_afmoe", "AfmoeInferenceConfig"),
 }
 
 

@@ -1,0 +1,211 @@
+"""Afmoe (Arcee Trinity) — exact greedy token match against a SELF-CONTAINED
+torch reference implementing the documented Afmoe semantics: gated attention,
+per-head qk RMSNorm, sandwich norms, NoPE full-attention layers, dense head
+segment, sigmoid router with selection-only expert bias + route_norm/scale,
+shared expert (reference analog: contrib/models/Trinity integration tests)."""
+
+import math
+
+import numpy as np
+import pytest
+import torch
+import torch.nn as nn
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+from nxdi_tpu.models.registry import get_family
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+H, DENSE_I, MOE_I, LAYERS, HEADS, KV, VOCAB, D = 64, 128, 32, 4, 4, 2, 256, 16
+E, TOPK, N_DENSE, WINDOW, GLOBAL_EVERY = 8, 2, 1, 8, 4
+ROUTE_SCALE = 1.5
+
+
+class _RefAfmoe(nn.Module):
+    def __init__(self, seed=0):
+        super().__init__()
+        torch.manual_seed(seed)
+        self.embed = nn.Embedding(VOCAB, H)
+        self.layers = nn.ModuleList()
+        for i in range(LAYERS):
+            blk = nn.Module()
+            blk.is_sliding = bool((i + 1) % GLOBAL_EVERY)
+            for n in ("ln_in", "ln_post_attn", "ln_pre_mlp", "ln_post_mlp"):
+                setattr(blk, n, nn.RMSNorm(H, eps=1e-5))
+            blk.q = nn.Linear(H, HEADS * D, bias=False)
+            blk.k = nn.Linear(H, KV * D, bias=False)
+            blk.v = nn.Linear(H, KV * D, bias=False)
+            blk.o = nn.Linear(HEADS * D, H, bias=False)
+            blk.attn_gate = nn.Linear(H, HEADS * D, bias=False)
+            blk.q_norm = nn.RMSNorm(D, eps=1e-5)
+            blk.k_norm = nn.RMSNorm(D, eps=1e-5)
+            if i < N_DENSE:
+                blk.gate = nn.Linear(H, DENSE_I, bias=False)
+                blk.up = nn.Linear(H, DENSE_I, bias=False)
+                blk.down = nn.Linear(DENSE_I, H, bias=False)
+            else:
+                blk.router = nn.Linear(H, E, bias=False)
+                blk.expert_bias = nn.Parameter(
+                    torch.randn(E) * 0.5, requires_grad=False
+                )
+                blk.experts = nn.ModuleList()
+                for _ in range(E):
+                    ex = nn.Module()
+                    ex.gate = nn.Linear(H, MOE_I, bias=False)
+                    ex.up = nn.Linear(H, MOE_I, bias=False)
+                    ex.down = nn.Linear(MOE_I, H, bias=False)
+                    self_mod = ex
+                    blk.experts.append(self_mod)
+                blk.sh_gate = nn.Linear(H, MOE_I, bias=False)
+                blk.sh_up = nn.Linear(H, MOE_I, bias=False)
+                blk.sh_down = nn.Linear(MOE_I, H, bias=False)
+            self.layers.append(blk)
+        self.norm = nn.RMSNorm(H, eps=1e-5)
+        self.lm_head = nn.Linear(H, VOCAB, bias=False)
+
+    @staticmethod
+    def _rope(x, pos):
+        half = D // 2
+        inv = 1.0 / (10000.0 ** (torch.arange(half, dtype=torch.float64) / half))
+        ang = pos[:, :, None].double() * inv[None, None]
+        cos = torch.cos(ang).float()[:, None]
+        sin = torch.sin(ang).float()[:, None]
+        x1, x2 = x[..., :half], x[..., half:]
+        return torch.cat([x1 * cos - x2 * sin, x2 * cos + x1 * sin], dim=-1)
+
+    def _moe(self, blk, x):  # x (N, H)
+        aff = torch.sigmoid(blk.router(x))  # (N, E)
+        sel = torch.topk(aff + blk.expert_bias, TOPK, dim=-1).indices
+        w = torch.gather(aff, -1, sel)  # raw scores, bias selection-only
+        w = w / w.sum(-1, keepdim=True)  # route_norm
+        out = torch.zeros_like(x)
+        for e in range(E):
+            mask = sel == e
+            if not mask.any():
+                continue
+            rows, slots = mask.nonzero(as_tuple=True)
+            ex = blk.experts[e]
+            y = ex.down(torch.nn.functional.silu(ex.gate(x[rows])) * ex.up(x[rows]))
+            out[rows] += w[rows, slots, None] * y
+        out = out * ROUTE_SCALE
+        shared = blk.sh_down(
+            torch.nn.functional.silu(blk.sh_gate(x)) * blk.sh_up(x)
+        )
+        return out + shared
+
+    def forward(self, ids):
+        B, S = ids.shape
+        pos = torch.arange(S)[None].expand(B, S)
+        h = self.embed(ids) * math.sqrt(H)
+        causal = torch.full((S, S), float("-inf")).triu(1)
+        idx = torch.arange(S)
+        win_mask = causal + torch.where(
+            (idx[:, None] - idx[None, :]) >= WINDOW, float("-inf"), 0.0
+        )
+        for blk in self.layers:
+            y = blk.ln_in(h)
+            q = blk.q(y).view(B, S, HEADS, D).transpose(1, 2)
+            k = blk.k(y).view(B, S, KV, D).transpose(1, 2)
+            v = blk.v(y).view(B, S, KV, D).transpose(1, 2)
+            q, k = blk.q_norm(q), blk.k_norm(k)
+            if blk.is_sliding:
+                q, k = self._rope(q, pos), self._rope(k, pos)
+            k = k.repeat_interleave(HEADS // KV, dim=1)
+            v = v.repeat_interleave(HEADS // KV, dim=1)
+            mask = win_mask if blk.is_sliding else causal
+            scores = q @ k.transpose(-1, -2) / math.sqrt(D) + mask
+            ctx = torch.softmax(scores.float(), dim=-1).to(v.dtype) @ v
+            ctx = ctx.transpose(1, 2).reshape(B, S, HEADS * D)
+            gate = torch.sigmoid(blk.attn_gate(y))
+            attn_out = blk.o(ctx * gate)
+            h = h + blk.ln_post_attn(attn_out)
+            y = blk.ln_pre_mlp(h)
+            if hasattr(blk, "router"):
+                ff = self._moe(blk, y.reshape(-1, H)).reshape(B, S, H)
+            else:
+                ff = blk.down(torch.nn.functional.silu(blk.gate(y)) * blk.up(y))
+            h = h + blk.ln_post_mlp(ff)
+        return self.lm_head(self.norm(h))
+
+    def greedy(self, ids, n):
+        ids = torch.tensor(ids)
+        for _ in range(n):
+            ids = torch.cat([ids, self.forward(ids)[:, -1:].argmax(-1)], dim=1)
+        return ids.numpy()
+
+    def hf_state_dict(self):
+        sd = {
+            "model.embed_tokens.weight": self.embed.weight,
+            "model.norm.weight": self.norm.weight,
+            "lm_head.weight": self.lm_head.weight,
+        }
+        for i, blk in enumerate(self.layers):
+            p = f"model.layers.{i}."
+            sd[p + "input_layernorm.weight"] = blk.ln_in.weight
+            sd[p + "post_attention_layernorm.weight"] = blk.ln_post_attn.weight
+            sd[p + "pre_mlp_layernorm.weight"] = blk.ln_pre_mlp.weight
+            sd[p + "post_mlp_layernorm.weight"] = blk.ln_post_mlp.weight
+            sd[p + "self_attn.q_proj.weight"] = blk.q.weight
+            sd[p + "self_attn.k_proj.weight"] = blk.k.weight
+            sd[p + "self_attn.v_proj.weight"] = blk.v.weight
+            sd[p + "self_attn.o_proj.weight"] = blk.o.weight
+            sd[p + "self_attn.gate_proj.weight"] = blk.attn_gate.weight
+            sd[p + "self_attn.q_norm.weight"] = blk.q_norm.weight
+            sd[p + "self_attn.k_norm.weight"] = blk.k_norm.weight
+            if hasattr(blk, "router"):
+                sd[p + "mlp.router.gate.weight"] = blk.router.weight
+                sd[p + "mlp.expert_bias"] = blk.expert_bias
+                for e, ex in enumerate(blk.experts):
+                    sd[p + f"mlp.experts.{e}.gate_proj.weight"] = ex.gate.weight
+                    sd[p + f"mlp.experts.{e}.up_proj.weight"] = ex.up.weight
+                    sd[p + f"mlp.experts.{e}.down_proj.weight"] = ex.down.weight
+                sd[p + "mlp.shared_experts.gate_proj.weight"] = blk.sh_gate.weight
+                sd[p + "mlp.shared_experts.up_proj.weight"] = blk.sh_up.weight
+                sd[p + "mlp.shared_experts.down_proj.weight"] = blk.sh_down.weight
+            else:
+                sd[p + "mlp.gate_proj.weight"] = blk.gate.weight
+                sd[p + "mlp.up_proj.weight"] = blk.up.weight
+                sd[p + "mlp.down_proj.weight"] = blk.down.weight
+        return {k: v.detach().numpy() for k, v in sd.items()}
+
+
+@pytest.mark.parametrize("tp_degree", [1, 8])
+def test_afmoe_token_matching(tp_degree):
+    ref = _RefAfmoe().eval()
+    sd = ref.hf_state_dict()
+
+    family, cfg_cls = get_family("afmoe")
+    tcfg = TpuConfig(
+        tp_degree=tp_degree, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    cfg = cfg_cls(
+        tcfg,
+        load_config=lambda: dict(
+            model_type="afmoe",
+            hidden_size=H, intermediate_size=DENSE_I,
+            moe_intermediate_size=MOE_I,
+            num_hidden_layers=LAYERS, num_attention_heads=HEADS,
+            num_key_value_heads=KV, head_dim=D, vocab_size=VOCAB,
+            rms_norm_eps=1e-5, rope_theta=10000.0,
+            max_position_embeddings=256, tie_word_embeddings=False,
+            num_dense_layers=N_DENSE, num_local_experts=E,
+            num_experts_per_tok=TOPK, num_shared_experts=1,
+            route_norm=True, route_scale=ROUTE_SCALE,
+            sliding_window=WINDOW, global_attn_every_n_layers=GLOBAL_EVERY,
+            mup_enabled=True,
+        ),
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=family)
+    app.load()
+    prompt = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
+    with torch.no_grad():
+        expected = ref.greedy(prompt, 16)
+    actual = HuggingFaceGenerationAdapter(app).generate(prompt, max_new_tokens=16)
+    np.testing.assert_array_equal(actual, expected)
