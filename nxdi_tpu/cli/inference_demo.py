@@ -39,15 +39,27 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--skip-warmup", action="store_true")
     p.add_argument("--on-cpu", action="store_true", help="run on the CPU backend")
 
-    # shapes / dtypes
-    p.add_argument("--batch-size", type=int, default=1)
+    # shapes / dtypes (--max-length/--n-positions and --max-batch-size/
+    # --max-num-seqs are the reference's spellings for the same knobs)
+    p.add_argument("--batch-size", "--max-batch-size", "--max-num-seqs",
+                   dest="batch_size", type=int, default=1)
     p.add_argument("--ctx-batch-size", type=int, default=None)
     p.add_argument("--tkg-batch-size", type=int, default=None)
-    p.add_argument("--seq-len", type=int, default=1024)
+    p.add_argument("--seq-len", "--max-length", "--n-positions",
+                   dest="seq_len", type=int, default=1024)
     p.add_argument("--max-context-length", type=int, default=None)
     p.add_argument("--max-new-tokens", type=int, default=64)
     p.add_argument("--torch-dtype", "--dtype", dest="dtype", default="bfloat16")
+    p.add_argument("--attention-dtype", default=None,
+                   help="override the attention compute dtype (e.g. float32 "
+                        "attention under a bfloat16 model)")
+    p.add_argument("--rpl-reduce-dtype", default=None,
+                   help="row-parallel reduction dtype (psum accumulation)")
     p.add_argument("--padding-side", default="right", choices=["right", "left"])
+    p.add_argument("--allow-input-truncation", action="store_true",
+                   help="truncate prompts longer than --max-context-length "
+                        "to their LAST max-context-length tokens instead of "
+                        "raising")
 
     # parallelism
     p.add_argument("--tp-degree", type=int, default=1)
@@ -67,9 +79,25 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
                    help="PER-PHASE hybrid MoE: decode expert-parallel degree "
                         "(a multiple of --moe-cte-ep-degree; expert weights "
                         "are duplicated per regime)")
+    p.add_argument("--moe-tp-degree", type=int, default=None,
+                   help="expert-intermediate TP degree inside a hybrid TPxEP "
+                        "MoE layout (reference: moe_tp_degree)")
+    p.add_argument("--mlp-cp-degree", type=int, default=1,
+                   help="MLP context-parallel degree (prefill MLP sharded "
+                        "over the sequence; subsumed by SP when equal)")
     p.add_argument("--moe-dispatch", default="sparse", choices=["sparse", "dense"])
     p.add_argument("--sequence-parallel-enabled", action="store_true")
     p.add_argument("--flash-decoding-enabled", action="store_true")
+    p.add_argument("--vocab-parallel", type=int, choices=[0, 1], default=None,
+                   help="shard embedding/lm_head over the vocab dim (default "
+                        "on when divisible)")
+    p.add_argument("--logical-nc-config", type=int, default=1,
+                   help="cores ganged per logical device (v5p megacore analog "
+                        "of the reference's LNC)")
+    p.add_argument("--xla-flags", default=None,
+                   help="extra XLA_FLAGS appended before backend init — the "
+                        "TPU-native surface for collective/compiler tuning "
+                        "(the reference's cc-pipeline-tiling / DGE knobs)")
 
     # sampling
     p.add_argument("--on-device-sampling", action="store_true")
@@ -78,12 +106,20 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--top-p", type=float, default=1.0)
     p.add_argument("--temperature", type=float, default=1.0)
     p.add_argument("--global-topk", type=int, default=256)
+    p.add_argument("--sampling-dp-degree", type=int, default=1,
+                   help=">1 shards the on-device sampler's top-k stages over "
+                        "the batch (reference: DataParallelSampler)")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--output-logits", action="store_true",
+                   help="emit full-vocab logits as an extra model output")
 
     # bucketing
     p.add_argument("--enable-bucketing", action="store_true")
     p.add_argument("--context-encoding-buckets", nargs="+", type=int, default=None)
     p.add_argument("--token-generation-buckets", nargs="+", type=int, default=None)
+    p.add_argument("--prefix-buckets", nargs="+", type=int, default=None,
+                   help="prefix lengths for the 2-D prefix-prefill bucket "
+                        "grid (prefix caching / chunked prefill)")
     p.add_argument("--long-context-mode", type=int, choices=[0, 1], default=None,
                    help="coarsen bucket ladders for 32k+ contexts (auto-on at "
                         ">=32k; pass 0/1 to force; reference: "
@@ -108,6 +144,12 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--window-sized-kv", action="store_true",
                    help="ring KV cache sized to --sliding-window slots")
     p.add_argument("--sliding-window", type=int, default=None)
+    p.add_argument("--kv-cache-batch-size", type=int, default=None,
+                   help="KV cache rows when they exceed the run batch "
+                        "(continuous batching over more sequences than a "
+                        "single dispatch carries)")
+    p.add_argument("--windowed-context-encoding-size", type=int, default=None,
+                   help="windowed CTE chunk width (reference: WCTE)")
 
     # Pallas kernels
     p.add_argument("--attn-kernel-enabled", action="store_true",
@@ -126,19 +168,24 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     # speculation
     p.add_argument("--draft-model-path", default=None)
     p.add_argument("--draft-model-type", default=None, help="defaults to --model-type")
-    p.add_argument("--speculation-length", type=int, default=0)
+    p.add_argument("--draft-model-tp-degree", type=int, default=None,
+                   help="run the draft at its own (smaller) tp degree "
+                        "(unfused speculation only)")
+    p.add_argument("--speculation-length", "--medusa-speculation-length",
+                   dest="speculation_length", type=int, default=0)
     p.add_argument("--enable-fused-speculation", action="store_true")
     p.add_argument("--enable-eagle-speculation", action="store_true")
     p.add_argument("--is-eagle3", action="store_true")
     p.add_argument("--is-medusa", action="store_true")
     p.add_argument("--num-medusa-heads", type=int, default=0)
     p.add_argument(
-        "--medusa-tree", default=None,
+        "--medusa-tree", "--medusa-tree-json", dest="medusa_tree", default=None,
         help="token tree: path to a JSON file of paths, or inline JSON "
              "(reference: examples/medusa_mc_sim_7b_63.json)",
     )
     p.add_argument(
-        "--token-tree-config", default=None,
+        "--token-tree-config", "--token-tree-json", dest="token_tree_config",
+        default=None,
         help="EAGLE token tree: path to a JSON file of paths, or inline JSON",
     )
 
@@ -152,12 +199,22 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
         default=None,
         help="adapter_name=/path/to/peft_adapter (repeatable)",
     )
+    p.add_argument("--lora-ckpt-json", default=None,
+                   help='JSON {"adapter_name": "/path"} — file path or inline')
+    p.add_argument("--target-modules", nargs="+", default=None,
+                   help="projection names LoRA attaches to (default q/k/v/o)")
     p.add_argument("--adapter-id", action="append", default=None,
                    help="per-prompt adapter name (repeatable, aligns with --prompt)")
 
     # quantization
     p.add_argument("--quantized", action="store_true")
     p.add_argument("--quantization-dtype", default="int8")
+    p.add_argument("--quantization-type", default="per_tensor_symmetric",
+                   help="per_tensor_symmetric | per_channel_symmetric")
+    p.add_argument("--quantized-checkpoints-path", default=None,
+                   help="pre-quantized artifact dir (written by "
+                        "save_quantized_state_dict); skips on-the-fly "
+                        "quantization at load")
     p.add_argument("--kv-cache-quant", action="store_true")
     p.add_argument("--kv-scale-mode", default="direct_cast",
                    choices=["direct_cast", "per_tensor", "per_key", "per_channel"],
@@ -175,6 +232,17 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     # accuracy / benchmark
     p.add_argument("--check-accuracy-mode", default="skip", choices=CHECK_ACCURACY_MODES)
     p.add_argument("--divergence-difference-tol", type=float, default=0.001)
+    p.add_argument("--tol-map", default=None,
+                   help='JSON {"position": tol} of per-index tolerance '
+                        "relaxations for logit matching — file path or inline")
+    p.add_argument("--num-tokens-to-check", type=int, default=None,
+                   help="logit-match only the first N generated positions")
+    p.add_argument("--expected-outputs-path", default=None,
+                   help="token-matching golden from a saved .json/.npz of "
+                        "token ids instead of running the HF model")
+    p.add_argument("--input-capture-save-dir", default=None,
+                   help="snapshot every dispatched (padded) input batch to "
+                        "this directory (reference: input capture)")
     p.add_argument(
         "--capture-output-dir", default=None,
         help="on logit-matching failure, write a divergence repro bundle here "
@@ -198,10 +266,16 @@ def create_tpu_config(args):
     lora_cfg = None
     if args.enable_lora:
         paths = dict(e.split("=", 1) for e in (args.lora_ckpt_path or []))
+        if args.lora_ckpt_json:
+            paths.update(_load_json_arg(args.lora_ckpt_json))
+        lora_kwargs = {}
+        if args.target_modules:
+            lora_kwargs["target_modules"] = list(args.target_modules)
         lora_cfg = LoraServingConfig(
             max_loras=max(args.max_loras, len(paths)),
             max_lora_rank=args.max_lora_rank,
             lora_ckpt_paths=paths or None,
+            **lora_kwargs,
         )
 
     odsc = None
@@ -212,6 +286,7 @@ def create_tpu_config(args):
             top_p=args.top_p,
             temperature=args.temperature,
             global_topk=args.global_topk,
+            dp_sampling=args.sampling_dp_degree > 1,
         )
     return TpuConfig(
         batch_size=args.batch_size,
@@ -239,9 +314,19 @@ def create_tpu_config(args):
             if args.moe_cte_ep_degree or args.moe_tkg_ep_degree
             else None
         ),
+        moe_tp_degree=args.moe_tp_degree,
+        mlp_cp_degree=args.mlp_cp_degree,
         moe_dispatch=args.moe_dispatch,
         sequence_parallel_enabled=args.sequence_parallel_enabled,
         flash_decoding_enabled=args.flash_decoding_enabled,
+        logical_nc_config=args.logical_nc_config,
+        output_logits=args.output_logits,
+        attention_dtype=args.attention_dtype,
+        rpl_reduce_dtype=args.rpl_reduce_dtype,
+        prefix_buckets=args.prefix_buckets,
+        windowed_context_encoding_size=args.windowed_context_encoding_size,
+        **({"kv_cache_batch_size": args.kv_cache_batch_size}
+           if args.kv_cache_batch_size is not None else {}),
         is_continuous_batching=args.is_continuous_batching,
         is_block_kv_layout=args.is_block_kv_layout,
         pa_block_size=args.pa_block_size,
@@ -265,9 +350,11 @@ def create_tpu_config(args):
         is_eagle3=args.is_eagle3,
         is_medusa=args.is_medusa,
         num_medusa_heads=args.num_medusa_heads,
-        medusa_tree=_load_medusa_tree(args.medusa_tree),
+        medusa_tree=_load_json_arg(args.medusa_tree),
         quantized=args.quantized,
         quantization_dtype=args.quantization_dtype,
+        quantization_type=args.quantization_type,
+        quantized_checkpoints_path=args.quantized_checkpoints_path,
         kv_cache_quant=args.kv_cache_quant,
         kv_quant_config=(
             (
@@ -291,16 +378,19 @@ def create_tpu_config(args):
                          "branching_factor": args.dynamic_tree_branching,
                          "num_inputs": args.dynamic_tree_num_inputs}}
             if args.dynamic_tree_steps
-            else _load_medusa_tree(args.token_tree_config)
+            else _load_json_arg(args.token_tree_config)
         ),
         skip_warmup=args.skip_warmup,
         lora_config=lora_cfg,
         **({"long_context_mode": bool(args.long_context_mode)}
            if args.long_context_mode is not None else {}),
+        **({"vocab_parallel": bool(args.vocab_parallel)}
+           if args.vocab_parallel is not None else {}),
     )
 
 
-def _load_medusa_tree(arg):
+def _load_json_arg(arg):
+    """File-or-inline JSON (token trees, LoRA path maps, tolerance maps)."""
     if not arg:
         return None
     import os
@@ -311,23 +401,57 @@ def _load_medusa_tree(arg):
     return json.loads(arg)
 
 
-def _resolve_input_ids(args) -> np.ndarray:
+def _resolve_input_ids(args, max_ctx: int) -> np.ndarray:
+    """Tokenize/parse prompts; enforce --max-context-length BEFORE any model
+    build so an over-long prompt fails (or truncates) at zero compile cost.
+    Truncation keeps each row's TRAILING real tokens (per row, before the
+    batch right-pad — a columnwise slice of the padded matrix would drop a
+    short row's real tokens and keep its padding)."""
+
+    def truncate_rows(rows):
+        lens = [len(r) for r in rows]
+        if max(lens) <= max_ctx:
+            return rows
+        if not args.allow_input_truncation:
+            raise ValueError(
+                f"prompt length {max(lens)} exceeds max_context_length "
+                f"{max_ctx}; pass --allow-input-truncation to keep each "
+                "prompt's trailing tokens"
+            )
+        return [r[-max_ctx:] for r in rows]
+
     if args.input_ids:
-        return np.asarray(json.loads(args.input_ids), dtype=np.int64)
+        rows = truncate_rows([list(r) for r in json.loads(args.input_ids)])
+        width = max(len(r) for r in rows)
+        out = np.full((len(rows), width), args.pad_token_id, dtype=np.int64)
+        for i, r in enumerate(rows):
+            out[i, : len(r)] = r
+        return out
     prompts = args.prompt or ["I believe the meaning of life is"]
     from transformers import AutoTokenizer
 
     tok = AutoTokenizer.from_pretrained(args.model_path)
     if tok.pad_token_id is None:
         tok.pad_token = tok.eos_token
-    enc = tok(prompts, return_tensors="np", padding=True, padding_side="right")
+    enc = tok(prompts, return_tensors=None)["input_ids"]
+    rows = truncate_rows([list(r) for r in enc])
     args._tokenizer = tok
-    return enc["input_ids"].astype(np.int64)
+    width = max(len(r) for r in rows)
+    out = np.full((len(rows), width), tok.pad_token_id, dtype=np.int64)
+    for i, r in enumerate(rows):
+        out[i, : len(r)] = r
+    return out
 
 
 def run_inference(args) -> int:
     """Compile -> load -> accuracy -> generate -> benchmark
     (reference: inference_demo.py:495)."""
+    if args.xla_flags:
+        import os
+
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + args.xla_flags
+        ).strip()
     if args.on_cpu:
         import jax
 
@@ -343,6 +467,10 @@ def run_inference(args) -> int:
     family, cfg_cls = get_family(args.model_type)
     tpu_config = create_tpu_config(args)
     config = cfg_cls(tpu_config, load_config=load_pretrained_config(args.model_path))
+
+    # resolve + length-check prompts BEFORE any model build: an over-long
+    # prompt must fail (or truncate, per row) at zero compile cost
+    input_ids = _resolve_input_ids(args, tpu_config.max_context_length)
 
     wants_spec = (
         args.enable_fused_speculation
@@ -368,9 +496,12 @@ def run_inference(args) -> int:
     if args.compiled_model_path and not args.skip_compile:
         app.compile(args.compiled_model_path)
     app.load(args.compiled_model_path)
+    if args.input_capture_save_dir:
+        from nxdi_tpu.utils.snapshot import attach_snapshot_hooks
+
+        attach_snapshot_hooks(app, args.input_capture_save_dir)
     adapter = HuggingFaceGenerationAdapter(app)
 
-    input_ids = _resolve_input_ids(args)
     gen_kwargs = dict(
         max_new_tokens=args.max_new_tokens,
         do_sample=args.do_sample,
@@ -433,8 +564,19 @@ def _build_spec_app(args, family, config):
                if k not in ("speculation_config", "speculation_length",
                             "enable_fused_speculation", "enable_eagle_speculation")},
             "is_eagle3": args.is_eagle3,
+            # unfused speculation may run the draft at a smaller tp than the
+            # target (reference: draft_model_tp_degree)
+            **({"tp_degree": args.draft_model_tp_degree}
+               if args.draft_model_tp_degree else {}),
         }
     )
+    if (args.draft_model_tp_degree
+            and args.draft_model_tp_degree != config.tpu_config.tp_degree
+            and (args.enable_fused_speculation or args.enable_eagle_speculation)):
+        raise ValueError(
+            "--draft-model-tp-degree requires unfused speculation (the fused "
+            "one-graph window shares the target's mesh)"
+        )
     if args.enable_eagle_speculation:
         from nxdi_tpu.models import llama_eagle
 
@@ -468,8 +610,24 @@ def _run_accuracy(args, app, adapter, input_ids) -> int:
     from nxdi_tpu.utils import accuracy
     from nxdi_tpu.utils.exceptions import AccuracyValidationError, LogitMatchingValidationError
 
-    logger.info("loading HF golden model on CPU for accuracy check")
-    hf_model = AutoModelForCausalLM.from_pretrained(args.model_path).eval()
+    tol_map = None
+    if args.tol_map:
+        tol_map = {int(k): float(v) for k, v in _load_json_arg(args.tol_map).items()}
+
+    expected = None
+    if args.expected_outputs_path:
+        # saved golden tokens replace the HF CPU run (reference:
+        # --expected-outputs-path)
+        if args.expected_outputs_path.endswith(".npz"):
+            expected = np.load(args.expected_outputs_path)["tokens"]
+        else:
+            with open(args.expected_outputs_path) as f:
+                expected = np.asarray(json.load(f), dtype=np.int64)
+
+    hf_model = None
+    if expected is None or args.check_accuracy_mode == "logit-matching":
+        logger.info("loading HF golden model on CPU for accuracy check")
+        hf_model = AutoModelForCausalLM.from_pretrained(args.model_path).eval()
     checked_ids = input_ids  # the sequence the failing check actually ran on
     try:
         if args.check_accuracy_mode == "token-matching":
@@ -478,17 +636,24 @@ def _run_accuracy(args, app, adapter, input_ids) -> int:
                 input_ids,
                 args.max_new_tokens,
                 hf_model=hf_model,
+                expected_outputs=expected,
                 pad_token_id=args.pad_token_id,
             )
             print("Accuracy check (token-matching): PASS")
         else:
-            golden = accuracy.hf_greedy_generate(hf_model, input_ids, args.max_new_tokens)
+            golden = (
+                expected if expected is not None
+                else accuracy.hf_greedy_generate(hf_model, input_ids, args.max_new_tokens)
+            )
+            if args.num_tokens_to_check is not None:
+                golden = golden[:, : input_ids.shape[1] + args.num_tokens_to_check]
             checked_ids = golden
             errors = accuracy.check_accuracy_logits(
                 app,
                 golden,
                 hf_model=hf_model,
                 divergence_difference_tol=args.divergence_difference_tol,
+                tol_map=tol_map,
             )
             print(
                 f"Accuracy check (logit-matching): PASS "

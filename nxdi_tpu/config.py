@@ -242,6 +242,40 @@ class TensorCaptureConfig:
         return {"capture_points": list(self.capture_points)}
 
 
+class TensorReplacementConfig:
+    """Inject host-captured tensors INTO the device graph — tensor capture's
+    plumbing in reverse (reference: utils/tensor_replacement/registry.py:1-50,
+    config.py:1136-1166, model_wrapper.py:331-348: replay CPU-captured module
+    outputs inside the compiled graph to bisect numeric divergence).
+
+    TPU-native: each replacement point becomes an extra fixed-shape jitted
+    input (zeros + a zero mask when unused, so one compiled program serves
+    both plain and replaced runs). ``replace_points`` any of:
+      - "embeds": replace the post-embedding stream with ``tr_embeds`` (B,S,H)
+      - "layers": replace individual layers' output streams — inside the layer
+        scan, ``where(tr_layer_mask[l], tr_layer_values[l], hidden)`` with
+        ``tr_layer_values`` (B,L,S,H) and ``tr_layer_mask`` (L,) per row
+      - "hidden": replace the pre-final-norm stream with ``tr_hidden`` (B,S,H)
+    ("logits" is deliberately not a point: nothing downstream consumes it —
+    capture the logits instead.)"""
+
+    VALID = ("embeds", "layers", "hidden")
+
+    def __init__(self, **kwargs):
+        pts = tuple(kwargs.pop("replace_points", ("layers",)))
+        for p in pts:
+            if p not in self.VALID:
+                raise ValueError(
+                    f"unknown replacement point {p!r}; valid: {self.VALID}"
+                )
+        self.replace_points = pts
+        if kwargs:
+            raise ValueError(f"Unknown TensorReplacementConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return {"replace_points": list(self.replace_points)}
+
+
 class LoraServingConfig:
     """Multi-adapter LoRA serving (reference: modules/lora_serving/config.py)."""
 
@@ -469,6 +503,10 @@ class TpuConfig:
         if isinstance(tcc, dict):
             tcc = TensorCaptureConfig(**tcc)
         self.tensor_capture_config = tcc
+        trc = kwargs.pop("tensor_replacement_config", None)
+        if isinstance(trc, dict):
+            trc = TensorReplacementConfig(**trc)
+        self.tensor_replacement_config = trc
         self.allow_unknown = kwargs.pop("allow_unknown", False)
 
         self.is_prefill_stage = None  # set by enable_context_encoding/token_generation
@@ -665,6 +703,7 @@ class TpuConfig:
         "kv_quant_config": KVQuantizationConfig,
         "chunked_prefill_config": ChunkedPrefillConfig,
         "tensor_capture_config": TensorCaptureConfig,
+        "tensor_replacement_config": TensorReplacementConfig,
         "speculation_config": SpeculationConfig,
         "lora_config": LoraServingConfig,
         "hybrid_sharding_config": HybridShardingConfig,

@@ -1370,8 +1370,15 @@ def run_decoder_layers(
     collect_hidden: bool = False,
     adapter_ids: Optional[jax.Array] = None,
     layer_injections: Optional[jax.Array] = None,  # (L, B, S, hidden) or None
+    layer_replacements: Optional[Tuple[jax.Array, jax.Array]] = None,
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
+
+    ``layer_replacements``: ((L, B, S, hidden) values, (L,) mask) — layers
+    whose mask entry is nonzero have their output stream REPLACED by the
+    given value (tensor-replacement debugging, the capture plumbing in
+    reverse; reference: utils/tensor_replacement/registry.py). Homogeneous
+    single-lap stacks only.
 
     ``layer_injections``: per-layer residual additions applied AFTER each
     layer (qwen3-vl deepstack: vision features summed into the first K
@@ -1450,6 +1457,11 @@ def run_decoder_layers(
                 "deepstack layer injections are not supported under "
                 "pipeline parallel"
             )
+        if layer_replacements is not None:
+            raise NotImplementedError(
+                "tensor replacement at layer outputs is not supported under "
+                "pipeline parallel — bisect on a tp-only config"
+            )
         # deferred commit applies under pp too (stage-local in-place commit
         # each tick; see _pipelined_decoder_layers) — decode-shaped only
         defer_pp = (
@@ -1505,6 +1517,12 @@ def run_decoder_layers(
         return hidden, new_cache
 
     if "k_win" in cache:
+        if layer_replacements is not None:
+            raise NotImplementedError(
+                "tensor replacement at layer outputs is not supported with "
+                "interleaved window KV stacks — bisect with a full-attention "
+                "cache layout"
+            )
         return _interleaved_window_scan(
             arch, layer_params, hidden, cos, sin, cache, position_ids,
             cache_spec, _step, defer, layout, policy, cache_inputs,
@@ -1566,7 +1584,7 @@ def run_decoder_layers(
             # xs carries the GLOBAL layer index (for per-layer KV-quant scale
             # rows, kv_cache._scale_for); the per-SEGMENT stacked kernel
             # weights index with the segment-local offset
-            lp, kl, vl, inj, li = xs
+            lp, kl, vl, inj, li, repl = xs
             li_local = li - jnp.int32(seg_off)
             h, nk, nv = _step(
                 h, lp, kl, vl, cos, sin, position_ids, cache_inputs,
@@ -1575,6 +1593,9 @@ def run_decoder_layers(
             )
             if inj is not None:
                 h = h + inj.astype(h.dtype)
+            if repl is not None:
+                rv, rm = repl
+                h = jnp.where(rm > 0, rv.astype(h.dtype), h)
             return h, ((nk, nv, h) if collect_hidden else (nk, nv))
 
         k_seg = jax.lax.slice_in_dim(cache["k"], off, off + n_seg, axis=0)
@@ -1588,8 +1609,16 @@ def run_decoder_layers(
             if layer_injections is not None
             else None
         )
+        repl_seg = (
+            (
+                jax.lax.slice_in_dim(layer_replacements[0], off, off + n_seg, axis=0),
+                jax.lax.slice_in_dim(layer_replacements[1], off, off + n_seg, axis=0),
+            )
+            if layer_replacements is not None
+            else None
+        )
         xs = (seg, k_seg, v_seg, inj_seg,
-              off + jnp.arange(n_seg, dtype=jnp.int32))
+              off + jnp.arange(n_seg, dtype=jnp.int32), repl_seg)
         hidden, ys = jax.lax.scan(body, hidden, xs)
         off += n_seg
         if collect_hidden:
@@ -1649,6 +1678,7 @@ def causal_lm_forward(
     aux_hidden_indices: Optional[Tuple[int, ...]] = None,
     image_token_id: Optional[int] = None,
     tensor_capture: Optional[Tuple[str, ...]] = None,
+    tensor_replacement: Optional[Tuple[str, ...]] = None,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
 
@@ -1690,6 +1720,15 @@ def causal_lm_forward(
         hidden = _linear(
             jnp.concatenate([hidden, feats], axis=-1),
             params["fc"], arch.act_quant, arch.act_clamp,
+        )
+    if tensor_replacement and "embeds" in tensor_replacement:
+        # tensor replacement (capture in reverse, reference:
+        # utils/tensor_replacement/registry.py): swap the post-embedding
+        # stream for the injected host tensor when its mask is set — one
+        # compiled program serves both plain (zero mask) and replaced runs
+        hidden = jnp.where(
+            batch["tr_embeds_mask"][0] > 0,
+            batch["tr_embeds"].astype(compute_dtype), hidden,
         )
     hidden = constrain(hidden, policy.hidden)
     inv_freq = np.asarray(inv_freq)
@@ -1760,6 +1799,13 @@ def causal_lm_forward(
             [inj, jnp.zeros((pad,) + inj.shape[1:], inj.dtype)], axis=0
         )
 
+    layer_replacements = None
+    if tensor_replacement and "layers" in tensor_replacement:
+        layer_replacements = (
+            jnp.swapaxes(batch["tr_layer_values"], 0, 1),  # (L, B, S, H)
+            batch["tr_layer_mask"][0],  # (L,) — every batch row carries the same mask
+        )
+
     captured: Dict[str, jax.Array] = {}
     if tensor_capture and "embeds" in tensor_capture:
         captured["embeds"] = hidden
@@ -1772,6 +1818,7 @@ def causal_lm_forward(
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
             layer_injections=layer_injections,
+            layer_replacements=layer_replacements,
         )
         captured["layer_hiddens"] = layer_hiddens
     elif aux_hidden_indices:
@@ -1781,6 +1828,7 @@ def causal_lm_forward(
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
             layer_injections=layer_injections,
+            layer_replacements=layer_replacements,
         )
         if tensor_capture and "layer_hiddens" in tensor_capture:
             captured["layer_hiddens"] = layer_hiddens
@@ -1791,7 +1839,14 @@ def causal_lm_forward(
             policy=policy, layout=layout, cache_inputs=cache_inputs,
             adapter_ids=batch.get("adapter_ids"),
             layer_injections=layer_injections,
+            layer_replacements=layer_replacements,
         )
+    if tensor_replacement and "hidden" in tensor_replacement:
+        hidden = jnp.where(
+            batch["tr_hidden_mask"][0] > 0,
+            batch["tr_hidden"].astype(compute_dtype), hidden,
+        )
+        hidden = constrain(hidden, policy.hidden)
     pre_norm_hidden = hidden
     if "norm" in params:  # EAGLE drafts have no final norm
         hidden = _norm(arch, hidden, params["norm"])

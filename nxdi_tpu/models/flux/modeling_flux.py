@@ -321,12 +321,108 @@ def param_specs(config: InferenceConfig):
     return jax.tree_util.tree_map(lambda _: P(), param_shape_struct(config))
 
 
-def convert_hf_state_dict(state_dict, config):  # pragma: no cover - no goldens
-    raise NotImplementedError(
-        "flux checkpoint conversion needs the diffusers weight layout, which "
-        "is unavailable in this environment; construct params matching "
-        "param_shape_struct instead (see tests/integration/test_flux.py)"
-    )
+def convert_hf_state_dict(state_dict, config):
+    """Convert a diffusers ``FluxTransformer2DModel`` state dict into the
+    scanned param tree (reference: the flux application loading the
+    transformer subfolder of a flux checkpoint, flux/application.py:133-429).
+
+    Accepts keys with or without a ``transformer.`` prefix. Layout contracts
+    encoded here (golden-tested in test_flux.py against a torch restatement
+    that consumes this exact layout):
+      - ``norm1.linear`` / ``norm1_context.linear`` -> img/txt AdaLN-Zero
+        modulation, chunk order (shift, scale, gate) x (attn, mlp) — same as
+        ours, no permutation;
+      - ``attn.to_{q,k,v}`` + ``attn.norm_q/k`` = img stream,
+        ``attn.add_{q,k,v}_proj`` + ``attn.norm_added_q/k`` = txt stream,
+        ``attn.to_out.0`` / ``attn.to_add_out`` the two output projections;
+      - single blocks fuse [attn | mlp] through one ``proj_out`` (our order);
+      - final ``norm_out.linear`` emits (scale, shift) in diffusers'
+        AdaLayerNormContinuous — SWAPPED to our (shift, scale) order here.
+
+    VAE weights are NOT converted by this function: the compact VAE decoder
+    uses its own layout (see param_shape_struct); supply ``state_dict['vae']``
+    as an already-structured tree to pass it through.
+    """
+    arch = build_arch(config)
+    inner = arch.inner
+
+    pref = "transformer." if any(k.startswith("transformer.") for k in state_dict) else ""
+
+    def get(k):
+        return np.asarray(state_dict[pref + k])
+
+    def lin(k):
+        return {"w": get(k + ".weight").T, "b": get(k + ".bias")}
+
+    def swap_halves(p):
+        """(scale, shift) -> (shift, scale) on the output dim."""
+        w, b = p["w"], p["b"]
+        return {
+            "w": np.concatenate([w[:, inner:], w[:, :inner]], axis=1),
+            "b": np.concatenate([b[inner:], b[:inner]]),
+        }
+
+    def emb_mlp(base):
+        return {"fc1": lin(base + ".linear_1"), "fc2": lin(base + ".linear_2")}
+
+    def stack(trees):
+        return jax.tree_util.tree_map(lambda *xs: np.stack(xs), *trees)
+
+    def dbl(i):
+        p = f"transformer_blocks.{i}."
+        return {
+            "img_mod": lin(p + "norm1.linear"),
+            "txt_mod": lin(p + "norm1_context.linear"),
+            "img_attn": {
+                "q": lin(p + "attn.to_q"), "k": lin(p + "attn.to_k"),
+                "v": lin(p + "attn.to_v"), "o": lin(p + "attn.to_out.0"),
+                "q_norm": get(p + "attn.norm_q.weight"),
+                "k_norm": get(p + "attn.norm_k.weight"),
+            },
+            "txt_attn": {
+                "q": lin(p + "attn.add_q_proj"), "k": lin(p + "attn.add_k_proj"),
+                "v": lin(p + "attn.add_v_proj"), "o": lin(p + "attn.to_add_out"),
+                "q_norm": get(p + "attn.norm_added_q.weight"),
+                "k_norm": get(p + "attn.norm_added_k.weight"),
+            },
+            "img_mlp": {"fc1": lin(p + "ff.net.0.proj"), "fc2": lin(p + "ff.net.2")},
+            "txt_mlp": {"fc1": lin(p + "ff_context.net.0.proj"),
+                        "fc2": lin(p + "ff_context.net.2")},
+        }
+
+    def sgl(i):
+        p = f"single_transformer_blocks.{i}."
+        return {
+            "mod": lin(p + "norm.linear"),
+            "q": lin(p + "attn.to_q"), "k": lin(p + "attn.to_k"),
+            "v": lin(p + "attn.to_v"),
+            "q_norm": get(p + "attn.norm_q.weight"),
+            "k_norm": get(p + "attn.norm_k.weight"),
+            "mlp_in": lin(p + "proj_mlp"),
+            "out": lin(p + "proj_out"),
+        }
+
+    transformer = {
+        "time_text_embed": {
+            "time": emb_mlp("time_text_embed.timestep_embedder"),
+            "text": emb_mlp("time_text_embed.text_embedder"),
+            **(
+                {"guidance": emb_mlp("time_text_embed.guidance_embedder")}
+                if arch.guidance
+                else {}
+            ),
+        },
+        "x_embedder": lin("x_embedder"),
+        "context_embedder": lin("context_embedder"),
+        "double_blocks": stack([dbl(i) for i in range(arch.num_layers)]),
+        "single_blocks": stack([sgl(i) for i in range(arch.num_single_layers)]),
+        "norm_out": swap_halves(lin("norm_out.linear")),
+        "proj_out": lin("proj_out"),
+    }
+    out = {"transformer": transformer}
+    if "vae" in state_dict:
+        out["vae"] = state_dict["vae"]
+    return out
 
 
 def param_shape_struct(config: InferenceConfig):
@@ -398,13 +494,14 @@ def param_shape_struct(config: InferenceConfig):
 
 class FluxPipeline:
     """Text-to-image orchestration (reference: flux/application.py:133-429):
-    precomputed text embeddings -> host denoising loop over the compiled
-    transformer -> VAE decode. Text encoders (CLIP/T5) plug in as additional
-    encoder programs when their weights are supplied; the pipeline accepts
-    precomputed embeddings directly, matching the reference's embedding
-    hand-off between its text-encoder and transformer applications."""
+    CLIP + T5 text encoders -> host denoising loop over the compiled
+    transformer -> VAE decode, each submodel a separately-compiled encoder
+    program, mirroring the reference's multi-application pipeline. The
+    pipeline also accepts precomputed embeddings directly (the reference's
+    embedding hand-off between its text-encoder and transformer apps)."""
 
-    def __init__(self, model_path: str, config, params=None):
+    def __init__(self, model_path: str, config, params=None,
+                 text_config=None, text_params=None):
         from nxdi_tpu.models.flux import modeling_flux
         from nxdi_tpu.runtime.encoder import EncoderApplication
 
@@ -419,17 +516,61 @@ class FluxPipeline:
             )
             self.app.is_loaded = True
         self.arch = self.app.arch
+        self.text_app = None
+        if text_config is not None:
+            from nxdi_tpu.models.flux import text_encoders
+
+            self.text_app = EncoderApplication(
+                model_path, text_config, model_family=text_encoders
+            )
+            if text_params is not None:
+                from nxdi_tpu.parallel.layers import shard_pytree
+                from nxdi_tpu.parallel.mesh import mesh_from_config
+
+                self.text_app.mesh = mesh_from_config(text_config.tpu_config)
+                self.text_app.params = shard_pytree(
+                    text_params, text_encoders.param_specs(text_config),
+                    self.text_app.mesh,
+                )
+                self.text_app.is_loaded = True
+
+    def encode_prompt(self, clip_ids, t5_ids):
+        """(B, S_clip) + (B, S_t5) token ids -> (prompt_embeds, pooled):
+        T5 last hidden state is the transformer's joint text stream, CLIP's
+        EOS-pooled state the modulation conditioning (reference: the two
+        text-encoder applications feeding the flux transformer)."""
+        if self.text_app is None:
+            raise ValueError(
+                "FluxPipeline built without text_config/text_params; pass "
+                "prompt_embeds/pooled_embeds directly or supply the encoders"
+            )
+        _, pooled = self.text_app.forward("clip_text", np.asarray(clip_ids, np.int32))
+        prompt_embeds = self.text_app.forward("t5_text", np.asarray(t5_ids, np.int32))
+        return np.asarray(prompt_embeds), np.asarray(pooled)
 
     def __call__(
         self,
-        prompt_embeds,  # (B, S_txt, joint_dim)
-        pooled_embeds,  # (B, pooled_dim)
+        prompt_embeds=None,  # (B, S_txt, joint_dim)
+        pooled_embeds=None,  # (B, pooled_dim)
         height: int = 64,
         width: int = 64,
         num_steps: int = 4,
         guidance_scale: float = 3.5,
         seed: int = 0,
+        clip_ids=None,  # (B, S_clip) token ids — runs the CLIP encoder
+        t5_ids=None,  # (B, S_t5) token ids — runs the T5 encoder
     ):
+        if prompt_embeds is None:
+            if clip_ids is None or t5_ids is None:
+                raise ValueError(
+                    "pass either prompt_embeds+pooled_embeds or clip_ids+t5_ids"
+                )
+            prompt_embeds, pooled_embeds = self.encode_prompt(clip_ids, t5_ids)
+        elif pooled_embeds is None:
+            raise ValueError(
+                "prompt_embeds requires pooled_embeds (the CLIP conditioning "
+                "vector); pass both, or clip_ids+t5_ids to run the encoders"
+            )
         arch = self.arch
         B = prompt_embeds.shape[0]
         h, w = height // 16, width // 16  # 8x VAE + 2x2 patch packing

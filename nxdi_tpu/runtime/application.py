@@ -490,6 +490,22 @@ class TpuModelForCausalLM(ApplicationBase):
             sampling_kwargs["tensor_capture"] = tuple(
                 tc.tensor_capture_config.capture_points
             )
+        tr_extra = {}
+        if tc.tensor_replacement_config is not None:
+            # captured host tensors compiled back in as extra inputs selected
+            # by name+mask (reference: tensor replacement, config.py:1136-1166)
+            pts = tuple(tc.tensor_replacement_config.replace_points)
+            sampling_kwargs["tensor_replacement"] = pts
+            H, L = arch.hidden_size, arch.num_layers
+            if "embeds" in pts:
+                tr_extra["tr_embeds"] = ((-1, H), np.float32)
+                tr_extra["tr_embeds_mask"] = ((), np.float32)
+            if "layers" in pts:
+                tr_extra["tr_layer_values"] = ((L, -1, H), np.float32)
+                tr_extra["tr_layer_mask"] = ((L,), np.float32)
+            if "hidden" in pts:
+                tr_extra["tr_hidden"] = ((-1, H), np.float32)
+                tr_extra["tr_hidden_mask"] = ((), np.float32)
 
         self.models[TAG_CONTEXT_ENCODING] = ModelWrapper(
             TAG_CONTEXT_ENCODING,
@@ -506,6 +522,7 @@ class TpuModelForCausalLM(ApplicationBase):
                 on_device_sampling=on_device_sampling,
                 **sampling_kwargs,
             ),
+            extra_inputs=tr_extra,
         )
         self.models[TAG_TOKEN_GENERATION] = ModelWrapper(
             TAG_TOKEN_GENERATION,
@@ -522,6 +539,7 @@ class TpuModelForCausalLM(ApplicationBase):
                 on_device_sampling=on_device_sampling,
                 **sampling_kwargs,
             ),
+            extra_inputs=tr_extra,
         )
         if tc.is_prefix_caching or tc.is_chunked_prefill:
             # multi-token prefill that attends the cache: the new chunk/suffix
@@ -544,6 +562,7 @@ class TpuModelForCausalLM(ApplicationBase):
                     on_device_sampling=on_device_sampling,
                     **sampling_kwargs,
                 ),
+                extra_inputs=tr_extra,
             )
 
     # -- dispatch (reference: model_base.py:3606 _get_model_outputs) --

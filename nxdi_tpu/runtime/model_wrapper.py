@@ -396,6 +396,9 @@ class ModelWrapper:
         if self.lora_enabled:
             batch["adapter_ids"] = jax.ShapeDtypeStruct((B,), jnp.int32)
         for key, (shape, dtype) in self.extra_inputs.items():
+            # -1 dims mean "this dispatch's (padded) sequence length" — used
+            # by tensor-replacement inputs whose S tracks the bucket
+            shape = tuple(seq if d == -1 else d for d in shape)
             batch[key] = jax.ShapeDtypeStruct((B,) + tuple(shape), dtype)
         if self.needs_rng:
             batch["rng"] = jax.ShapeDtypeStruct((2,), jnp.uint32)
@@ -481,11 +484,26 @@ class ModelWrapper:
             extra["adapter_ids"] = np.asarray(
                 batch_np.get("adapter_ids", np.zeros((b,))), dtype=np.int32
             )
+        seq_now = (
+            self.n_active_tokens
+            if self.attend_to_cache and not self.prefill_to_cache
+            else pad_s
+        )
         for key, (shape, dtype) in self.extra_inputs.items():
             nd = np.dtype(dtype)
+            shape = tuple(seq_now if d == -1 else d for d in shape)
             val = batch_np.get(key)
             if val is None:
                 val = np.zeros((b,) + tuple(shape), dtype=nd)
+            else:
+                val = np.asarray(val, dtype=nd)
+                # right-pad any short dim up to the compiled shape (seq dims
+                # grow with the bucket; replacement masks make pads inert)
+                pads = [(0, 0)] + [
+                    (0, t - s) for t, s in zip(shape, val.shape[1:])
+                ]
+                if any(p[1] for p in pads):
+                    val = np.pad(val, pads)
             extra[key] = np.asarray(val, dtype=nd)
 
         # pad batch dim (reference: _forward_with_pad model_wrapper.py:569)

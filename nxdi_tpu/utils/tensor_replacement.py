@@ -1,0 +1,155 @@
+"""Tensor replacement — replay captured tensors inside the device graph.
+
+The debugging subsystem the reference builds in
+``utils/tensor_replacement/registry.py`` + ``models/config.py:1136-1166`` +
+``model_wrapper.py:331-348``: take tensors captured from a KNOWN-GOOD run
+(CPU/HF or an earlier device build) and substitute them for the device
+graph's own intermediates, to bisect which layer first introduces a numeric
+divergence.
+
+TPU-native shape: capture already compiles named intermediates into extra
+*outputs* (``TensorCaptureConfig``); replacement compiles the same names into
+extra *inputs* plus masks (``TensorReplacementConfig``), so one jitted program
+serves plain runs (zero masks) and any replacement subset — no graph edits,
+no recompiles per bisect step. This module is the host-side driver: it shapes
+captured tensors into the ``tr_*`` batch inputs and runs the layer bisect.
+
+Typical flow (see tests/unit/test_tensor_replacement.py)::
+
+    good = capture_layer_hiddens(app_good, input_ids)       # (L, B, S, H)
+    reg  = TensorReplacementRegistry(num_layers=L)
+    reg.add_layer_hiddens(good)
+    fault = bisect_layer_fault(app_bad, input_ids, reg)     # -> faulty layer
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+
+def capture_layer_hiddens(app, input_ids: np.ndarray, position_ids=None):
+    """Run one prefill on an app compiled with
+    ``TensorCaptureConfig(capture_points=("layer_hiddens",))`` and return the
+    stacked (L, B, S, H) per-layer output streams as numpy."""
+    input_ids = np.asarray(input_ids)
+    if position_ids is None:
+        position_ids = np.tile(
+            np.arange(input_ids.shape[1], dtype=np.int32), (input_ids.shape[0], 1)
+        )
+    out = app.forward(input_ids, position_ids)
+    if "captured" not in out:
+        raise ValueError(
+            "app was not compiled with tensor capture; set "
+            'tensor_capture_config=TensorCaptureConfig(capture_points=("layer_hiddens",))'
+        )
+    return np.asarray(out["captured"]["layer_hiddens"], dtype=np.float32)
+
+
+class TensorReplacementRegistry:
+    """Holds captured tensors by name and shapes them into ``tr_*`` batch
+    inputs (reference: the registry's module-name -> captured-file map; here
+    names are the framework's own capture points)."""
+
+    def __init__(self, num_layers: int):
+        self.num_layers = num_layers
+        self._layer_hiddens: Optional[np.ndarray] = None  # (L, B, S, H)
+        self._embeds: Optional[np.ndarray] = None  # (B, S, H)
+        self._hidden: Optional[np.ndarray] = None  # (B, S, H)
+
+    def add_layer_hiddens(self, stacked: np.ndarray) -> None:
+        stacked = np.asarray(stacked, dtype=np.float32)
+        if stacked.shape[0] != self.num_layers:
+            raise ValueError(
+                f"expected {self.num_layers} layers, got {stacked.shape[0]}"
+            )
+        self._layer_hiddens = stacked
+
+    def add_embeds(self, embeds: np.ndarray) -> None:
+        self._embeds = np.asarray(embeds, dtype=np.float32)
+
+    def add_hidden(self, hidden: np.ndarray) -> None:
+        self._hidden = np.asarray(hidden, dtype=np.float32)
+
+    # -- batch-input assembly --
+    def batch_inputs(
+        self,
+        replace_layers: Sequence[int] = (),
+        replace_embeds: bool = False,
+        replace_hidden: bool = False,
+    ) -> Dict[str, np.ndarray]:
+        """``tr_*`` entries for ``app.forward(..., **batch_inputs)``: values
+        from the registry, masks selecting the requested subset."""
+        out: Dict[str, np.ndarray] = {}
+        if replace_layers != ():
+            if self._layer_hiddens is None:
+                raise ValueError("no layer_hiddens captured")
+            L, B = self.num_layers, self._layer_hiddens.shape[1]
+            mask = np.zeros((L,), np.float32)
+            mask[list(replace_layers)] = 1.0
+            out["tr_layer_values"] = np.swapaxes(self._layer_hiddens, 0, 1)  # (B,L,S,H)
+            out["tr_layer_mask"] = np.tile(mask, (B, 1))
+        if replace_embeds:
+            if self._embeds is None:
+                raise ValueError("no embeds captured")
+            out["tr_embeds"] = self._embeds
+            out["tr_embeds_mask"] = np.ones((self._embeds.shape[0],), np.float32)
+        if replace_hidden:
+            if self._hidden is None:
+                raise ValueError("no hidden captured")
+            out["tr_hidden"] = self._hidden
+            out["tr_hidden_mask"] = np.ones((self._hidden.shape[0],), np.float32)
+        return out
+
+
+def bisect_layer_fault(
+    app,
+    input_ids: np.ndarray,
+    registry: TensorReplacementRegistry,
+    golden_tokens: Optional[np.ndarray] = None,
+    position_ids=None,
+) -> Optional[int]:
+    """Locate the first faulty layer by binary search over replacement
+    prefixes (reference flow: progressively replacing module outputs until
+    the divergence disappears).
+
+    Replacing the outputs of layers [0, k) with known-good values masks any
+    fault in those layers; the output matches the golden iff every faulty
+    layer is masked. The minimal such k-1 is the first faulty layer. Returns
+    None when the app already matches with no replacement (no fault).
+
+    ``golden_tokens``: expected (B, 1) greedy tokens from the known-good run;
+    derived from the registry's final layer hidden via the app itself when
+    omitted is NOT possible — pass them (e.g. the good app's output).
+    """
+    input_ids = np.asarray(input_ids)
+    if position_ids is None:
+        position_ids = np.tile(
+            np.arange(input_ids.shape[1], dtype=np.int32), (input_ids.shape[0], 1)
+        )
+    if golden_tokens is None:
+        raise ValueError("golden_tokens is required")
+    golden_tokens = np.asarray(golden_tokens)
+
+    def matches(prefix_len: int) -> bool:
+        extra = registry.batch_inputs(replace_layers=tuple(range(prefix_len)))
+        out = app.forward(input_ids, position_ids, **extra)
+        return bool(np.array_equal(np.asarray(out["tokens"]), golden_tokens))
+
+    if matches(0):
+        return None  # no fault observable at the output
+    lo, hi = 0, registry.num_layers  # matches(hi) must be True: all replaced
+    if not matches(hi):
+        raise ValueError(
+            "replacing every layer output still diverges — the fault is "
+            "outside the layer stack (embedding/norm/lm_head); replace "
+            "'embeds'/'hidden' points to bisect further"
+        )
+    while hi - lo > 1:
+        mid = (lo + hi) // 2
+        if matches(mid):
+            hi = mid
+        else:
+            lo = mid
+    return hi - 1

@@ -1,0 +1,377 @@
+"""Flux text encoders: exact numerics vs HF ``transformers`` CLIPTextModel /
+T5EncoderModel (real goldens — unlike diffusers, transformers IS in the
+image), TP variants, the diffusers-layout transformer converter golden, and
+the end-to-end text->image pipeline (reference: models/diffusers/flux/
+clip/modeling_clip.py, t5/modeling_t5.py, application.py:133-429)."""
+
+import numpy as np
+import pytest
+import torch
+
+from nxdi_tpu.config import TpuConfig
+from nxdi_tpu.models.flux import modeling_flux as mf
+from nxdi_tpu.models.flux import text_encoders as te
+
+CLIP_CFG = dict(
+    vocab_size=100,
+    hidden_size=32,
+    intermediate_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    max_position_embeddings=32,
+    eos_token_id=2,
+    hidden_act="quick_gelu",
+)
+T5_CFG = dict(
+    vocab_size=120,
+    d_model=32,
+    d_kv=8,
+    d_ff=64,
+    num_layers=2,
+    num_heads=4,
+    feed_forward_proj="gated-gelu",
+    relative_attention_num_buckets=8,
+    relative_attention_max_distance=16,
+)
+
+
+def _hf_encoders(seed=0):
+    from transformers import CLIPTextConfig, CLIPTextModel, T5Config, T5EncoderModel
+
+    torch.manual_seed(seed)
+    clip = CLIPTextModel(CLIPTextConfig(**CLIP_CFG)).eval()
+    t5 = T5EncoderModel(
+        T5Config(**{**T5_CFG, "dropout_rate": 0.0, "use_cache": False})
+    ).eval()
+    return clip, t5
+
+
+def _merged_sd(clip, t5):
+    sd = {}
+    for k, v in clip.state_dict().items():
+        sd["clip." + k] = v.detach().numpy()
+    for k, v in t5.state_dict().items():
+        sd["t5." + k] = v.detach().numpy()
+    return sd
+
+
+def _text_config(tp_degree=1):
+    tcfg = TpuConfig(tp_degree=tp_degree, seq_len=32, dtype="float32", skip_warmup=True)
+    return te.FluxTextConfig(
+        tcfg, load_config=lambda: {"clip": dict(CLIP_CFG), "t5": dict(T5_CFG)}
+    )
+
+
+def _build_text_app(sd, tp_degree=1):
+    from nxdi_tpu.runtime.encoder import EncoderApplication
+
+    cfg = _text_config(tp_degree)
+
+    class App(EncoderApplication):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=te)
+    app.load()
+    return app
+
+
+@pytest.mark.parametrize("tp_degree", [1, 4])
+def test_clip_text_matches_hf(tp_degree):
+    clip, t5 = _hf_encoders()
+    app = _build_text_app(_merged_sd(clip, t5), tp_degree)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(3, 100, size=(2, 12)).astype(np.int32)
+    ids[:, -1] = 2  # eos terminated (argmax-of-ids pooling path: 2 < other ids
+    # is fine — eos==2 config uses argmax of raw ids, both impls agree)
+    with torch.no_grad():
+        out = clip(input_ids=torch.tensor(ids, dtype=torch.long))
+    hidden, pooled = app.forward("clip_text", ids)
+    np.testing.assert_allclose(
+        np.asarray(hidden), out.last_hidden_state.numpy(), atol=2e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(pooled), out.pooler_output.numpy(), atol=2e-5
+    )
+
+
+def test_clip_pooled_first_eos_path():
+    """eos_token_id != 2 exercises the first-eos pooling branch."""
+    from transformers import CLIPTextConfig, CLIPTextModel
+
+    torch.manual_seed(1)
+    cfg = dict(CLIP_CFG, eos_token_id=99)
+    clip = CLIPTextModel(CLIPTextConfig(**cfg)).eval()
+    tcfg = TpuConfig(seq_len=32, dtype="float32", skip_warmup=True)
+    tc = te.FluxTextConfig(tcfg, load_config=lambda: {"clip": cfg, "t5": dict(T5_CFG)})
+    arch = te.build_arch(tc)
+    sd = {("clip." + k): v.detach().numpy() for k, v in clip.state_dict().items()}
+    # t5 keys unused by the clip program but required by the converter
+    _, t5 = _hf_encoders()
+    sd.update({("t5." + k): v.detach().numpy() for k, v in t5.state_dict().items()})
+    params = te.convert_hf_state_dict(sd, tc)
+    ids = np.array([[5, 7, 99, 11, 99, 3], [8, 4, 6, 99, 1, 1]], np.int32)
+    with torch.no_grad():
+        out = clip(input_ids=torch.tensor(ids, dtype=torch.long))
+    import jax
+
+    _, pooled = jax.jit(lambda p, i: te.clip_text_forward(arch, p, i))(
+        params["clip"], ids
+    )
+    np.testing.assert_allclose(np.asarray(pooled), out.pooler_output.numpy(), atol=2e-5)
+
+
+@pytest.mark.parametrize("tp_degree", [1, 4])
+def test_t5_matches_hf(tp_degree):
+    clip, t5 = _hf_encoders()
+    app = _build_text_app(_merged_sd(clip, t5), tp_degree)
+    rng = np.random.default_rng(1)
+    ids = rng.integers(0, 120, size=(2, 20)).astype(np.int32)
+    with torch.no_grad():
+        expected = t5(input_ids=torch.tensor(ids, dtype=torch.long)).last_hidden_state
+    actual = app.forward("t5_text", ids)
+    np.testing.assert_allclose(np.asarray(actual), expected.numpy(), atol=3e-5)
+
+
+# ---------------------------------------------------------------------------
+# Diffusers-layout transformer converter golden: build a synthetic state dict
+# in the EXACT diffusers FluxTransformer2DModel key layout, convert it, and
+# check our forward against a torch restatement that consumes the diffusers
+# layout directly (including its (scale, shift) norm_out chunk order).
+# ---------------------------------------------------------------------------
+
+FLUX_CFG = dict(
+    model_type="flux",
+    num_layers=2,
+    num_single_layers=2,
+    attention_head_dim=16,
+    num_attention_heads=4,
+    joint_attention_dim=48,
+    pooled_projection_dim=32,
+    in_channels=16,
+    axes_dims_rope=[4, 6, 6],
+    guidance_embeds=True,
+    vae_channels=16,
+    vae_latent_channels=4,
+)
+
+
+def _diffusers_sd(arch, seed=0):
+    rng = np.random.default_rng(seed)
+    inner, mlp = arch.inner, 4 * arch.inner
+    sd = {}
+
+    def lin(name, i, o):
+        sd[name + ".weight"] = (rng.standard_normal((o, i)) * 0.05).astype(np.float32)
+        sd[name + ".bias"] = (rng.standard_normal((o,)) * 0.05).astype(np.float32)
+
+    lin("time_text_embed.timestep_embedder.linear_1", 256, inner)
+    lin("time_text_embed.timestep_embedder.linear_2", inner, inner)
+    lin("time_text_embed.guidance_embedder.linear_1", 256, inner)
+    lin("time_text_embed.guidance_embedder.linear_2", inner, inner)
+    lin("time_text_embed.text_embedder.linear_1", arch.pooled_dim, inner)
+    lin("time_text_embed.text_embedder.linear_2", inner, inner)
+    lin("x_embedder", arch.in_channels, inner)
+    lin("context_embedder", arch.joint_dim, inner)
+    for i in range(arch.num_layers):
+        p = f"transformer_blocks.{i}."
+        lin(p + "norm1.linear", inner, 6 * inner)
+        lin(p + "norm1_context.linear", inner, 6 * inner)
+        for n in ("to_q", "to_k", "to_v", "add_q_proj", "add_k_proj", "add_v_proj"):
+            lin(p + "attn." + n, inner, inner)
+        lin(p + "attn.to_out.0", inner, inner)
+        lin(p + "attn.to_add_out", inner, inner)
+        for n in ("norm_q", "norm_k", "norm_added_q", "norm_added_k"):
+            sd[p + f"attn.{n}.weight"] = (
+                rng.standard_normal((arch.head_dim,)) * 0.05 + 1.0
+            ).astype(np.float32)
+        lin(p + "ff.net.0.proj", inner, mlp)
+        lin(p + "ff.net.2", mlp, inner)
+        lin(p + "ff_context.net.0.proj", inner, mlp)
+        lin(p + "ff_context.net.2", mlp, inner)
+    for i in range(arch.num_single_layers):
+        p = f"single_transformer_blocks.{i}."
+        lin(p + "norm.linear", inner, 3 * inner)
+        for n in ("to_q", "to_k", "to_v"):
+            lin(p + "attn." + n, inner, inner)
+        for n in ("norm_q", "norm_k"):
+            sd[p + f"attn.{n}.weight"] = (
+                rng.standard_normal((arch.head_dim,)) * 0.05 + 1.0
+            ).astype(np.float32)
+        lin(p + "proj_mlp", inner, mlp)
+        lin(p + "proj_out", inner + mlp, inner)
+    lin("norm_out.linear", inner, 2 * inner)
+    lin("proj_out", inner, arch.in_channels)
+    return sd
+
+
+def test_flux_converter_matches_diffusers_layout_golden():
+    cfg = mf.FluxInferenceConfig(
+        TpuConfig(seq_len=64, dtype="float32", skip_warmup=True),
+        load_config=lambda: dict(FLUX_CFG),
+    )
+    arch = mf.build_arch(cfg)
+    sd = _diffusers_sd(arch)
+    params = mf.convert_hf_state_dict(sd, cfg)["transformer"]
+
+    rng = np.random.default_rng(5)
+    B, S_img, S_txt = 2, 16, 6
+    hidden = rng.standard_normal((B, S_img, arch.in_channels)).astype(np.float32)
+    enc = rng.standard_normal((B, S_txt, arch.joint_dim)).astype(np.float32)
+    pooled = rng.standard_normal((B, arch.pooled_dim)).astype(np.float32)
+    t = np.array([0.6, 0.2], np.float32)
+    g = np.array([3.5, 3.5], np.float32)
+    ids = np.zeros((S_txt + S_img, 3), np.int64)
+    ids[S_txt:, 1] = np.arange(S_img) // 4
+    ids[S_txt:, 2] = np.arange(S_img) % 4
+    tab = mf.rope_table(arch, ids)
+
+    actual = np.asarray(
+        mf.flux_transformer_forward(arch, params, hidden, enc, pooled, t, g, tab)
+    )
+
+    # torch restatement consuming the DIFFUSERS layout directly
+    T = lambda k: torch.tensor(sd[k], dtype=torch.float64)  # noqa: E731
+
+    def tl(x, name):  # torch linear, diffusers (out, in) weights
+        return x @ T(name + ".weight").T + T(name + ".bias")
+
+    def ln(x, eps=1e-6):
+        mu = x.mean(-1, keepdim=True)
+        return (x - mu) / torch.sqrt(((x - mu) ** 2).mean(-1, keepdim=True) + eps)
+
+    def rms(x, w, eps=1e-6):
+        return x / torch.sqrt((x * x).mean(-1, keepdim=True) + eps) * w
+
+    def rope(x, tab):
+        cos = torch.tensor(tab[..., 0], dtype=torch.float64)[None, :, None, :]
+        sin = torch.tensor(tab[..., 1], dtype=torch.float64)[None, :, None, :]
+        a, b = x[..., 0::2], x[..., 1::2]
+        return torch.stack([a * cos - b * sin, a * sin + b * cos], -1).reshape(x.shape)
+
+    def attn_op(q, k, v):
+        B_, S, H, D = q.shape
+        s = torch.einsum("bqhd,bkhd->bhqk", q, k) * (D**-0.5)
+        return (
+            torch.einsum("bhqk,bkhd->bqhd", torch.softmax(s, -1), v)
+            .reshape(B_, S, H * D)
+        )
+
+    silu = torch.nn.functional.silu
+    gelu = lambda x: torch.nn.functional.gelu(x, approximate="tanh")  # noqa: E731
+    H, D = arch.num_heads, arch.head_dim
+
+    def sinus(tt, dim=256):
+        half = dim // 2
+        freqs = torch.exp(
+            -np.log(10000.0) * torch.arange(half, dtype=torch.float64) / half
+        )
+        args = tt[:, None] * freqs[None]
+        return torch.cat([torch.cos(args), torch.sin(args)], -1)
+
+    with torch.no_grad():
+        temb = tl(
+            silu(tl(sinus(torch.tensor(t, dtype=torch.float64) * 1000.0),
+                    "time_text_embed.timestep_embedder.linear_1")),
+            "time_text_embed.timestep_embedder.linear_2",
+        )
+        temb = temb + tl(
+            silu(tl(sinus(torch.tensor(g, dtype=torch.float64) * 1000.0),
+                    "time_text_embed.guidance_embedder.linear_1")),
+            "time_text_embed.guidance_embedder.linear_2",
+        )
+        temb = temb + tl(
+            silu(tl(torch.tensor(pooled, dtype=torch.float64),
+                    "time_text_embed.text_embedder.linear_1")),
+            "time_text_embed.text_embedder.linear_2",
+        )
+        img = tl(torch.tensor(hidden, dtype=torch.float64), "x_embedder")
+        txt = tl(torch.tensor(enc, dtype=torch.float64), "context_embedder")
+        for i in range(arch.num_layers):
+            p = f"transformer_blocks.{i}."
+            im = torch.chunk(tl(silu(temb), p + "norm1.linear")[:, None], 6, -1)
+            tm = torch.chunk(tl(silu(temb), p + "norm1_context.linear")[:, None], 6, -1)
+            img_n = ln(img) * (1 + im[1]) + im[0]
+            txt_n = ln(txt) * (1 + tm[1]) + tm[0]
+            iq = rms(tl(img_n, p + "attn.to_q").reshape(B, S_img, H, D),
+                     T(p + "attn.norm_q.weight"))
+            ik = rms(tl(img_n, p + "attn.to_k").reshape(B, S_img, H, D),
+                     T(p + "attn.norm_k.weight"))
+            iv = tl(img_n, p + "attn.to_v").reshape(B, S_img, H, D)
+            tq = rms(tl(txt_n, p + "attn.add_q_proj").reshape(B, S_txt, H, D),
+                     T(p + "attn.norm_added_q.weight"))
+            tk = rms(tl(txt_n, p + "attn.add_k_proj").reshape(B, S_txt, H, D),
+                     T(p + "attn.norm_added_k.weight"))
+            tv = tl(txt_n, p + "attn.add_v_proj").reshape(B, S_txt, H, D)
+            q = rope(torch.cat([tq, iq], 1), tab)
+            k = rope(torch.cat([tk, ik], 1), tab)
+            v = torch.cat([tv, iv], 1)
+            a = attn_op(q, k, v)
+            t_a, i_a = a[:, :S_txt], a[:, S_txt:]
+            img = img + im[2] * tl(i_a, p + "attn.to_out.0")
+            txt = txt + tm[2] * tl(t_a, p + "attn.to_add_out")
+            img = img + im[5] * tl(
+                gelu(tl(ln(img) * (1 + im[4]) + im[3], p + "ff.net.0.proj")),
+                p + "ff.net.2",
+            )
+            txt = txt + tm[5] * tl(
+                gelu(tl(ln(txt) * (1 + tm[4]) + tm[3], p + "ff_context.net.0.proj")),
+                p + "ff_context.net.2",
+            )
+        x = torch.cat([txt, img], 1)
+        S = S_txt + S_img
+        for i in range(arch.num_single_layers):
+            p = f"single_transformer_blocks.{i}."
+            sh, sc, gate = torch.chunk(tl(silu(temb), p + "norm.linear")[:, None], 3, -1)
+            xn = ln(x) * (1 + sc) + sh
+            q = rms(tl(xn, p + "attn.to_q").reshape(B, S, H, D), T(p + "attn.norm_q.weight"))
+            k = rms(tl(xn, p + "attn.to_k").reshape(B, S, H, D), T(p + "attn.norm_k.weight"))
+            v = tl(xn, p + "attn.to_v").reshape(B, S, H, D)
+            a = attn_op(rope(q, tab), rope(k, tab), v)
+            mlp = gelu(tl(xn, p + "proj_mlp"))
+            x = x + gate * tl(torch.cat([a, mlp], -1), p + "proj_out")
+        img = x[:, S_txt:]
+        # diffusers AdaLayerNormContinuous: chunk order is (scale, shift)
+        scale, shift = torch.chunk(tl(silu(temb), "norm_out.linear")[:, None], 2, -1)
+        img = ln(img) * (1 + scale) + shift
+        expected = tl(img, "proj_out").numpy()
+
+    np.testing.assert_allclose(actual, expected, atol=5e-4, rtol=5e-4)
+
+
+def test_flux_pipeline_text_to_image_end_to_end():
+    """prompt token ids -> CLIP/T5 -> transformer denoise -> VAE pixels."""
+    import jax
+
+    clip, t5 = _hf_encoders()
+    text_cfg = _text_config()
+    text_params = te.convert_hf_state_dict(_merged_sd(clip, t5), text_cfg)
+
+    cfg = mf.FluxInferenceConfig(
+        TpuConfig(seq_len=64, dtype="float32", skip_warmup=True),
+        load_config=lambda: dict(
+            FLUX_CFG, joint_attention_dim=T5_CFG["d_model"],
+            pooled_projection_dim=CLIP_CFG["hidden_size"],
+        ),
+    )
+    rng = np.random.default_rng(0)
+    struct = mf.param_shape_struct(cfg)
+    params = jax.tree_util.tree_map(
+        lambda s: (rng.standard_normal(s.shape) * 0.05).astype(np.float32), struct
+    )
+    params["vae"]["scaling_factor"] = np.float32(0.36)
+    params["vae"]["shift_factor"] = np.float32(0.11)
+
+    pipe = mf.FluxPipeline(
+        "<random>", cfg, params=params, text_config=text_cfg, text_params=text_params
+    )
+    clip_ids = rng.integers(3, 100, size=(1, 8)).astype(np.int32)
+    clip_ids[:, -1] = 2
+    t5_ids = rng.integers(0, 120, size=(1, 10)).astype(np.int32)
+    img = pipe(height=64, width=64, num_steps=2, clip_ids=clip_ids, t5_ids=t5_ids)
+    assert img.shape == (1, 64, 64, 3)
+    assert np.isfinite(img).all()
+    # encoders are LIVE: different prompt ids change the image
+    t5_ids2 = (t5_ids + 17) % 120
+    img2 = pipe(height=64, width=64, num_steps=2, clip_ids=clip_ids, t5_ids=t5_ids2)
+    assert np.abs(img - img2).max() > 1e-6

@@ -1,0 +1,140 @@
+"""Tensor replacement: captured tensors injected back into the device graph
+bisect an artificial numeric fault to one layer (reference analog:
+utils/tensor_replacement/registry.py + models/config.py:1136-1166)."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import (
+    OnDeviceSamplingConfig,
+    TensorCaptureConfig,
+    TensorReplacementConfig,
+    TpuConfig,
+)
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.utils.tensor_replacement import (
+    TensorReplacementRegistry,
+    bisect_layer_fault,
+    capture_layer_hiddens,
+)
+
+PROMPT = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int32)
+FAULTY_LAYER = 2
+
+
+def _tiny_hf():
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    return LlamaForCausalLM(cfg).eval(), cfg
+
+
+def _build_app(sd, hf_cfg, **extra):
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True, **extra,
+    )
+    cfg = llama.LlamaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return dict(sd)
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+@pytest.fixture(scope="module")
+def setup():
+    hf, hf_cfg = _tiny_hf()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    good_cap = _build_app(
+        sd, hf_cfg,
+        tensor_capture_config=TensorCaptureConfig(capture_points=("layer_hiddens",)),
+    )
+    # corrupt ONE layer's weights (the artificial numeric fault)
+    bad_sd = dict(sd)
+    key = f"model.layers.{FAULTY_LAYER}.mlp.down_proj.weight"
+    rng = np.random.default_rng(7)
+    bad_sd[key] = sd[key] + rng.standard_normal(sd[key].shape).astype(np.float32)
+    bad = _build_app(
+        bad_sd, hf_cfg,
+        tensor_replacement_config=TensorReplacementConfig(
+            replace_points=("embeds", "layers", "hidden")
+        ),
+    )
+    return good_cap, bad
+
+
+def test_bisect_finds_the_faulty_layer(setup):
+    good_cap, bad = setup
+    hiddens = capture_layer_hiddens(good_cap, PROMPT)  # (L, B, S_pad, H)
+    assert hiddens.shape[0] == 4
+    pos = np.tile(np.arange(PROMPT.shape[1], dtype=np.int32), (1, 1))
+    golden = np.asarray(good_cap.forward(PROMPT, pos)["tokens"])
+
+    reg = TensorReplacementRegistry(num_layers=4)
+    reg.add_layer_hiddens(hiddens)
+    assert bisect_layer_fault(bad, PROMPT, reg, golden_tokens=golden) == FAULTY_LAYER
+
+
+def test_no_fault_returns_none(setup):
+    good_cap, bad = setup
+    hiddens = capture_layer_hiddens(good_cap, PROMPT)
+    pos = np.tile(np.arange(PROMPT.shape[1], dtype=np.int32), (1, 1))
+    bad_tokens = np.asarray(bad.forward(PROMPT, pos)["tokens"])
+    reg = TensorReplacementRegistry(num_layers=4)
+    reg.add_layer_hiddens(hiddens)
+    # judged against ITS OWN output, the bad app has no observable fault
+    assert bisect_layer_fault(bad, PROMPT, reg, golden_tokens=bad_tokens) is None
+
+
+def test_single_layer_replacement_fixes_downstream(setup):
+    """Replacing ONLY the faulty layer's output restores the golden tokens —
+    the surgical use the reference's tr_map enables."""
+    good_cap, bad = setup
+    hiddens = capture_layer_hiddens(good_cap, PROMPT)
+    pos = np.tile(np.arange(PROMPT.shape[1], dtype=np.int32), (1, 1))
+    golden = np.asarray(good_cap.forward(PROMPT, pos)["tokens"])
+
+    reg = TensorReplacementRegistry(num_layers=4)
+    reg.add_layer_hiddens(hiddens)
+    extra = reg.batch_inputs(replace_layers=(FAULTY_LAYER,))
+    out = bad.forward(PROMPT, pos, **extra)
+    np.testing.assert_array_equal(np.asarray(out["tokens"]), golden)
+    # sanity: with no replacement the bad app diverges
+    out_plain = bad.forward(PROMPT, pos)
+    assert not np.array_equal(np.asarray(out_plain["tokens"]), golden)
+
+
+def test_hidden_point_replacement(setup):
+    """Replacing the pre-final-norm stream with the good app's masks every
+    layer fault at once (the coarse end of the bisect ladder)."""
+    good_cap, bad = setup
+    hiddens = capture_layer_hiddens(good_cap, PROMPT)
+    pos = np.tile(np.arange(PROMPT.shape[1], dtype=np.int32), (1, 1))
+    golden = np.asarray(good_cap.forward(PROMPT, pos)["tokens"])
+    reg = TensorReplacementRegistry(num_layers=4)
+    reg.add_hidden(hiddens[-1])  # pre-norm stream == last layer's output
+    out = bad.forward(PROMPT, pos, **reg.batch_inputs(replace_hidden=True))
+    np.testing.assert_array_equal(np.asarray(out["tokens"]), golden)
+
+
+def test_replacement_inputs_default_inert(setup):
+    """With the replacement points compiled in but no tensors supplied, the
+    zero masks must leave the forward untouched."""
+    good_cap, bad = setup
+    pos = np.tile(np.arange(PROMPT.shape[1], dtype=np.int32), (1, 1))
+    a = np.asarray(bad.forward(PROMPT, pos)["tokens"])
+    b = np.asarray(bad.forward(PROMPT, pos)["tokens"])
+    np.testing.assert_array_equal(a, b)
