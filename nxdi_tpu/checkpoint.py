@@ -202,3 +202,22 @@ def rename_keys(
             nk = re.sub(pat, rep, nk)
         out[nk] = v
     return out
+
+
+def strip_language_model_prefix(
+    state_dict: Dict[str, np.ndarray],
+) -> Dict[str, np.ndarray]:
+    """Select the text-decoder subtree of a composite (vision+text) HF state
+    dict: drop the ``[model.]language_model.`` prefixes and keep the top-level
+    ``lm_head.weight`` — the common ingestion step for every image-to-text
+    family (llava, pixtral/mistral3, gemma3-vision, ovis2, janus, ...)."""
+    out = {}
+    for k, v in state_dict.items():
+        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
+            if k.startswith(prefix):
+                out[k[len(prefix):]] = v
+                break
+        else:
+            if k in ("lm_head.weight", "language_model.lm_head.weight"):
+                out["lm_head.weight"] = v
+    return out

@@ -151,6 +151,9 @@ class DecoderArch:
     embed_scale: Optional[float] = None
     # gpt-oss style learned attention-sink logits (params: attn["sink"] (H,))
     attention_sink: bool = False
+    # gemma3-vision: prefill image-token spans attend each other
+    # bidirectionally (HF token_type_ids_mask_function); needs image_token_id
+    bidirectional_image_attention: bool = False
     # dbrx: weight-only LayerNorm instead of RMSNorm; qkv clamp
     layernorm: bool = False
     clip_qkv: Optional[float] = None
@@ -679,6 +682,17 @@ def attention_block(
     new_k, new_v = layout.update(k_cache_l, v_cache_l, k, v, ci, cache_spec)
 
     if attend_to_cache:
+        if ci and ci.get("bidir_spans") is not None and S > 1:
+            # a cache-attending multi-token prefill (prefix caching / chunked
+            # prefill) cannot honor the bidirectional image-span mask: span
+            # ids restart per chunk, so same-image tokens in the cached
+            # prefix could never match — reject at trace time instead of
+            # silently computing causal-only attention
+            raise NotImplementedError(
+                "bidirectional image attention (gemma3-vision) does not "
+                "compose with prefix-cached/chunked prefill; disable "
+                "prefix caching for this model"
+            )
         # prefix-cache / chunked-prefill CTE through the block table: the
         # chunk is already scattered into the pool (update above), so the
         # kernel reads prefix + chunk in token order without materializing
@@ -802,6 +816,16 @@ def attention_block(
                 logit_softcap=arch.attn_logit_softcap,
             )
     else:
+        # gemma3-vision: image-span tokens attend each other BIDIRECTIONALLY
+        # during prefill (HF token_type_ids_mask_function OR-ed into both the
+        # full and sliding masks); spans are derived in-graph from input_ids
+        # (causal_lm_forward), so only the CTE program pays for it
+        bidir = ci.get("bidir_spans") if ci else None
+        extra_or = None
+        if bidir is not None and S > 1:
+            extra_or = (bidir[:, None, :] == bidir[:, :, None]) & (
+                bidir[:, :, None] > 0
+            )
         ctx = None
         if (
             arch.attn_kernel_enabled
@@ -810,6 +834,7 @@ def attention_block(
             and arch.attn_logit_softcap is None
             and window_enabled is None
             and use_rope is None
+            and extra_or is None
             and attn_kernels.prefill_kernel_supported(q.shape, k.shape)
         ):
             ctx = attn_kernels.sharded_kernel_call(
@@ -831,6 +856,7 @@ def attention_block(
                 sliding_window_enabled=window_enabled,
                 chunk_enabled=use_rope,
                 logit_softcap=arch.attn_logit_softcap,
+                extra_or_mask=extra_or,
             )
 
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
@@ -1780,6 +1806,21 @@ def causal_lm_forward(
     else:
         cache_spec = arch.kv_cache_spec(cache["k"].shape[1], cache["k"].shape[3])
     cache_inputs = collect_cache_inputs(batch)
+    if (
+        arch.bidirectional_image_attention
+        and image_token_id is not None
+        and input_ids.shape[1] > 1
+    ):
+        # per-image span ids (consecutive placeholder runs; distinct images
+        # never attend each other — HF image_group_ids semantics), derived
+        # in-graph so no extra host input is needed
+        is_img = input_ids == image_token_id
+        starts = is_img & ~jnp.concatenate(
+            [jnp.zeros_like(is_img[:, :1]), is_img[:, :-1]], axis=1
+        )
+        cache_inputs["bidir_spans"] = jnp.where(
+            is_img, jnp.cumsum(starts.astype(jnp.int32), axis=1), 0
+        )
     layer_injections = None
     if image_token_id is not None and "deepstack_embeds" in batch:
         # qwen3-vl deepstack: layer k's output gains the k-th vision feature

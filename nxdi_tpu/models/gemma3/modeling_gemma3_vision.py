@@ -1,0 +1,180 @@
+"""Gemma3 multimodal — SigLIP vision tower + gemma3 text decoder.
+
+Reference: contrib/models/gemma3-vision (the last uncovered contrib vision
+family): ``Gemma3ForConditionalGeneration`` = SigLIP tower -> avg-pool to
+``mm_tokens_per_image`` -> gemma (1+w) RMSNorm -> biasless projection matmul
+into the text stream, with image-token spans attending BIDIRECTIONALLY
+during prefill (HF token_type_ids_mask_function — carried here by the
+``bidirectional_image_attention`` arch flag; masks are OR-ed in-graph from
+input_ids, models/base.py).
+
+This module also serves flat (text-only) ``gemma3`` configs so the registry
+key stays backward-compatible: without ``vision_config`` everything delegates
+to modeling_gemma3 and the plain causal-lm application is used.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, promote_text_config
+from nxdi_tpu.models import dense
+from nxdi_tpu.models.gemma3 import modeling_gemma3 as g3
+from nxdi_tpu.ops import vision as vision_ops
+
+
+def __getattr__(name):
+    if name == "APPLICATION_CLS":
+        return _app_factory
+    raise AttributeError(name)
+
+
+def _app_factory(model_path, config, model_family=None, **kwargs):
+    """Image-to-text app when the config carries a vision tower, the plain
+    causal-lm app for flat text configs (one registry key serves both)."""
+    import sys
+
+    from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+    family = model_family or sys.modules[__name__]
+    cls = ImageToTextForCausalLM if _has_vision(config) else TpuModelForCausalLM
+    return cls(model_path, config, model_family=family, **kwargs)
+
+
+def _has_vision(config: InferenceConfig) -> bool:
+    return getattr(config, "vision_config", None) is not None
+
+
+class Gemma3VisionInferenceConfig(g3.Gemma3InferenceConfig):
+    def add_derived_config(self):
+        if getattr(self, "text_config", None) is not None:
+            promote_text_config(self)
+            vc = getattr(self, "vision_config", None)
+            if vc is not None and not isinstance(vc, dict):
+                self.vision_config = vc.to_dict()
+            if not hasattr(self, "mm_tokens_per_image"):
+                self.mm_tokens_per_image = 256
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    if _has_vision(config):
+        overrides.setdefault("bidirectional_image_attention", True)
+    return g3.build_arch(config, **overrides)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return g3.build_inv_freq(config)
+
+
+from nxdi_tpu.checkpoint import strip_language_model_prefix as _strip_text_prefix
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    # g3's converter adds the per-layer window/local-rope flag arrays and
+    # sandwich norms — required for the interleaved gemma3 layer scan
+    if not _has_vision(config):
+        return g3.convert_hf_state_dict(state_dict, config)
+    return g3.convert_hf_state_dict(_strip_text_prefix(state_dict), config)
+
+
+def param_specs(config: InferenceConfig):
+    return g3.param_specs(config)
+
+
+def param_shape_struct(config: InferenceConfig):
+    return g3.param_shape_struct(config)
+
+
+# -- vision protocol (ImageToTextForCausalLM) --
+
+
+def build_vision_arch(config: InferenceConfig):
+    vc = config.vision_config
+    return vision_ops.SiglipVisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        hidden_act=vc.get("hidden_act", "gelu_pytorch_tanh"),
+        layer_norm_eps=vc.get("layer_norm_eps", 1e-6),
+        proj_tokens_per_image=int(config.mm_tokens_per_image),
+        proj_eps=float(vc.get("layer_norm_eps", 1e-6)),
+    )
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    return int(config.mm_tokens_per_image)
+
+
+def convert_vision_params(state_dict, config: InferenceConfig):
+    varch = build_vision_arch(config)
+
+    def get(name):
+        for k in ("multi_modal_projector." + name,
+                  "model.multi_modal_projector." + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=np.float32)
+        raise KeyError(name)
+
+    return {
+        "vision": vision_ops.convert_siglip_vision(state_dict, varch),
+        "projector": {
+            "mm_input_projection": get("mm_input_projection_weight"),
+            "mm_soft_emb_norm": get("mm_soft_emb_norm.weight"),
+        },
+    }
+
+
+def encode_images(varch, params: Dict[str, Any], pixel_values):
+    """SigLIP features -> avg-pool grid to tokens_per_side^2 -> gemma RMSNorm
+    -> projection (reference: Gemma3MultiModalProjector)."""
+    feat = vision_ops.siglip_vision_forward(varch, params["vision"], pixel_values)
+    p = params["projector"]
+    B, N, d = feat.shape
+    g = varch.grid
+    side = int(round(varch.proj_tokens_per_image ** 0.5))
+    k = g // side
+    # (B, g, g, d) average-pooled with kernel/stride k
+    grid = feat.reshape(B, g // k, k, g // k, k, d)
+    pooled = grid.mean(axis=(2, 4)).reshape(B, side * side, d)
+    # gemma-style (1+w) RMSNorm in fp32
+    x = pooled.astype(jnp.float32)
+    x = x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + varch.proj_eps)
+    x = x * (1.0 + p["mm_soft_emb_norm"].astype(jnp.float32))
+    return (x @ p["mm_input_projection"].astype(jnp.float32)).astype(feat.dtype)
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    P2 = varch.num_channels * varch.patch_size ** 2
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, np.float32)  # noqa: E731
+    lin = lambda i, o: {"w": s(L, i, o), "b": s(L, o)}  # noqa: E731
+    ln = lambda: {"w": s(L, Hv), "b": s(L, Hv)}  # noqa: E731
+    return {
+        "vision": {
+            "patch_embedding": s(P2, Hv),
+            "patch_bias": s(Hv),
+            "position_embedding": s(varch.num_patches, Hv),
+            "post_layernorm": {"w": s(Hv), "b": s(Hv)},
+            "layers": {
+                "attn": {n: lin(Hv, Hv)
+                         for n in ("q_proj", "k_proj", "v_proj", "out_proj")},
+                "ln1": ln(), "ln2": ln(),
+                "fc1": lin(Hv, Iv), "fc2": lin(Iv, Hv),
+            },
+        },
+        "projector": {
+            "mm_input_projection": s(Hv, config.hidden_size),
+            "mm_soft_emb_norm": s(Hv),
+        },
+    }

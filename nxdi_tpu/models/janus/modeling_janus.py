@@ -92,17 +92,7 @@ def num_image_tokens(config: InferenceConfig) -> int:
     return build_vision_arch(config).num_patches
 
 
-def _strip_text_prefix(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    out = {}
-    for k, v in state_dict.items():
-        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
-            if k.startswith(prefix):
-                out[k[len(prefix):]] = v
-                break
-        else:
-            if k in ("lm_head.weight", "language_model.lm_head.weight"):
-                out["lm_head.weight"] = v
-    return out
+from nxdi_tpu.checkpoint import strip_language_model_prefix as _strip_text_prefix
 
 
 def convert_hf_state_dict(

@@ -36,17 +36,7 @@ class LlavaInferenceConfig(dense.DenseInferenceConfig):
         super().add_derived_config()
 
 
-def _strip_text_prefix(state_dict: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
-    out = {}
-    for k, v in state_dict.items():
-        for prefix in ("model.language_model.", "language_model.model.", "language_model."):
-            if k.startswith(prefix):
-                out[k[len(prefix):]] = v
-                break
-        else:
-            if k == "lm_head.weight" or k == "language_model.lm_head.weight":
-                out["lm_head.weight"] = v
-    return out
+from nxdi_tpu.checkpoint import strip_language_model_prefix as _strip_text_prefix
 
 
 def build_arch(config: InferenceConfig, **overrides):

@@ -1,0 +1,223 @@
+"""Ovis2 family — probabilistic visual tokenizer + qwen2 decoder.
+
+Reference: contrib/models/Ovis2.5-9B (the last uncovered contrib family).
+HF ``Ovis2ForConditionalGeneration``: an RMS-norm ViT tower whose head emits
+a SOFTMAX DISTRIBUTION over a visual vocabulary; image features are that
+distribution times a visual embedding table (VTE) — "structural embedding
+alignment" instead of an MLP projector. Visual INDICATOR tokens (text-vocab
+ids listed in ``visual_indicator_token_ids``) take their embeddings from the
+VTE's last rows rather than the text table.
+
+TPU-native choices:
+  - the tower + head + VTE matmul compile as ONE fixed-shape encoder program
+    (ops/vision.py ``ovis2_visual_tokens``);
+  - indicator substitution is PREFILL-SCOPED, exactly like HF (which only
+    substitutes in the forward that carries pixel_values): the application
+    rewrites indicator ids to the image placeholder id host-side and appends
+    the VTE indicator rows into the merged ``image_embeds`` stream, so the
+    standard in-graph merge places them. Decode steps embed indicator ids
+    from the text table, matching HF's decode behavior bit-for-bit.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, promote_text_config
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops import vision as vision_ops
+
+
+def __getattr__(name):
+    if name == "APPLICATION_CLS":
+        return _application_cls()
+    raise AttributeError(name)
+
+
+_APP_CLS = None
+
+
+def _application_cls():
+    global _APP_CLS
+    if _APP_CLS is not None:
+        return _APP_CLS
+    from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+
+    class Ovis2ImageToText(ImageToTextForCausalLM):
+        """Prefill-scoped indicator substitution (HF Ovis2Model.forward:
+        substitution happens only in the forward carrying pixel_values)."""
+
+        def forward(self, input_ids, position_ids, pixel_values=None, **kwargs):
+            cfg = self.config
+            ind_ids = list(getattr(cfg, "visual_indicator_token_ids", []) or [])
+            if pixel_values is None or not ind_ids:
+                return super().forward(
+                    input_ids, position_ids, pixel_values=pixel_values, **kwargs
+                )
+            feats = np.asarray(self.encode_images(pixel_values))  # (B, N_img, H)
+            vte = np.asarray(self.params["projector"]["vte"], dtype=feats.dtype)
+            # HF maps indicator i -> row V - num_visual_indicator_tokens + i
+            # (the RESERVED row count, which may exceed the ids actually used)
+            n_res = self.family.build_vision_arch(cfg).num_indicator_tokens
+            ind_feats = vte[vte.shape[0] - n_res:]
+            ids = np.array(input_ids).copy()
+            B = ids.shape[0]
+            n_slots = self.family.num_image_tokens(cfg)
+            embeds = np.zeros((B, n_slots, feats.shape[-1]), feats.dtype)
+            img_tok = int(cfg.image_token_index)
+            for b in range(B):
+                special = np.where(
+                    (ids[b] == img_tok) | np.isin(ids[b], ind_ids)
+                )[0]
+                img_i = 0
+                for slot, s in enumerate(special):
+                    tok = int(ids[b, s])
+                    if tok == img_tok:
+                        embeds[b, slot] = feats[b, img_i]
+                        img_i += 1
+                    else:
+                        embeds[b, slot] = ind_feats[ind_ids.index(tok)]
+                        ids[b, s] = img_tok  # merged features replace it
+            kwargs["image_embeds"] = embeds
+            return super().forward(ids, position_ids, **kwargs)
+
+    _APP_CLS = Ovis2ImageToText
+    return _APP_CLS
+
+
+class Ovis2InferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["text_config", "vision_config", "image_token_index"]
+
+    def add_derived_config(self):
+        if not hasattr(self, "image_token_index") and hasattr(self, "image_token_id"):
+            self.image_token_index = self.image_token_id
+        promote_text_config(self)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        super().add_derived_config()
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    # ovis2's text model is qwen2 (qkv biases — HF Qwen2Attention)
+    from nxdi_tpu.models.qwen2 import modeling_qwen2
+
+    return modeling_qwen2.build_arch(config, **overrides)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return dense.build_inv_freq(config)
+
+
+from nxdi_tpu.checkpoint import strip_language_model_prefix as _strip_text_prefix
+
+
+def _vte(state_dict) -> np.ndarray:
+    for k in ("visual_embeddings_table.weight", "model.visual_embeddings_table.weight",
+              "vte.weight", "model.vte.weight"):
+        if k in state_dict:
+            return np.asarray(state_dict[k], dtype=np.float32)
+    raise KeyError("visual_embeddings_table.weight")
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(
+        _strip_text_prefix(state_dict), config, build_arch(config)
+    )
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))
+
+
+# -- vision protocol (ImageToTextForCausalLM) --
+
+
+def build_vision_arch(config: InferenceConfig):
+    vc = config.vision_config
+    return vision_ops.Ovis2VisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        vocab_size=vc["vocab_size"],
+        num_indicator_tokens=vc.get("num_visual_indicator_tokens", 5),
+        hidden_stride=vc.get("hidden_stride", 2),
+        num_channels=vc.get("num_channels", 3),
+        hidden_act=vc.get("hidden_act", "silu"),
+        rms_norm_eps=vc.get("rms_norm_eps", 1e-5),
+        qkv_bias=vc.get("qkv_bias", False),
+        mlp_bias=vc.get("mlp_bias", False),
+        tokenize_function=vc.get("tokenize_function", "softmax"),
+    )
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    # slot budget for the merged stream: image features + indicator rows
+    n_ind = len(getattr(config, "visual_indicator_token_ids", []) or [])
+    return build_vision_arch(config).num_tokens + n_ind
+
+
+def convert_vision_params(state_dict, config: InferenceConfig):
+    varch = build_vision_arch(config)
+    return {
+        "vision": vision_ops.convert_ovis2_vision(state_dict, varch),
+        "projector": {"vte": _vte(state_dict)},
+    }
+
+
+def encode_images(varch, params: Dict[str, Any], pixel_values):
+    """prob tokens (B, N, V-ind) @ VTE's first V-ind rows -> (B, N, hidden).
+    HF pads the distribution with zeros over the indicator rows before the
+    full-table matmul — algebraically identical to the truncated matmul."""
+    prob = vision_ops.ovis2_visual_tokens(varch, params["vision"], pixel_values)
+    vte = params["projector"]["vte"]
+    return prob @ vte[: vte.shape[0] - varch.num_indicator_tokens]
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    varch = build_vision_arch(config)
+    Hv, Iv, L = varch.hidden_size, varch.intermediate_size, varch.num_layers
+    P2 = varch.num_channels * varch.patch_size ** 2
+    V = varch.vocab_size
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, np.float32)  # noqa: E731
+
+    def lin(i, o, bias):
+        out = {"w": s(L, i, o)}
+        if bias:
+            out["b"] = s(L, o)
+        return out
+
+    m = varch.hidden_stride
+    return {
+        "vision": {
+            "patch_embedding": s(P2, Hv),
+            "patch_bias": s(Hv),
+            "embed_norm": s(Hv),
+            "position_embedding": s(varch.num_patches, Hv),
+            "final_norm": s(Hv),
+            "head_linear": s(Hv * m * m, V - varch.num_indicator_tokens),
+            "head_norm": {"w": s(V - varch.num_indicator_tokens),
+                          "b": s(V - varch.num_indicator_tokens)},
+            "layers": {
+                "norm1": s(L, Hv), "norm2": s(L, Hv),
+                "q_proj": lin(Hv, Hv, varch.qkv_bias),
+                "k_proj": lin(Hv, Hv, varch.qkv_bias),
+                "v_proj": lin(Hv, Hv, varch.qkv_bias),
+                "out_proj": lin(Hv, Hv, varch.qkv_bias),
+                "gate_proj": lin(Hv, Iv, varch.mlp_bias),
+                "up_proj": lin(Hv, Iv, varch.mlp_bias),
+                "down_proj": lin(Iv, Hv, varch.mlp_bias),
+            },
+        },
+        "projector": {"vte": s(V, config.hidden_size)},
+    }

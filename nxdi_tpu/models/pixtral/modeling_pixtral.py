@@ -1,0 +1,214 @@
+"""Pixtral / Mistral3 family — pixtral vision tower + mistral decoder.
+
+Reference: models/pixtral/ (modeling_pixtral.py 400 LoC + modeling_pixtral_vision.py
+~640 LoC) — the standalone pixtral image-to-text application the reference
+promotes out of contrib (Mistral-Small-3.1 lineage: ``NeuronPixtralForCausalLM``
+over ``NeuronPixtralVisionModel`` with the multi-modal projector).
+
+Two HF layouts share this family:
+  - ``mistral3`` (Mistral3ForConditionalGeneration): pixtral tower ->
+    Mistral3MultiModalProjector = RMSNorm (text eps) -> spatial patch-merger
+    (spatial_merge_size^2 unfold + biasless linear) -> linear_1/act/linear_2;
+  - llava-layout pixtral (no ``spatial_merge_size``): plain 2-layer llava
+    projector (also reachable via the llava family).
+
+The text model is the shared dense decoder (mistral flags). The vision tower
+is ops/vision.py ``pixtral_vision_forward`` (2-D rope ViT, no CLS).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from nxdi_tpu.config import InferenceConfig, promote_text_config
+from nxdi_tpu.models import dense
+from nxdi_tpu.ops import vision as vision_ops
+
+
+def __getattr__(name):
+    if name == "APPLICATION_CLS":
+        from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
+
+        return ImageToTextForCausalLM
+    raise AttributeError(name)
+
+
+class PixtralInferenceConfig(dense.DenseInferenceConfig):
+    REQUIRED = ["text_config", "vision_config", "image_token_index"]
+
+    def add_derived_config(self):
+        if not hasattr(self, "image_token_index") and hasattr(self, "image_token_id"):
+            # mistral3 spells it image_token_id
+            self.image_token_index = self.image_token_id
+        promote_text_config(self)
+        vc = self.vision_config
+        if not isinstance(vc, dict):
+            self.vision_config = vc.to_dict()
+        super().add_derived_config()
+
+
+Mistral3InferenceConfig = PixtralInferenceConfig
+
+
+def build_arch(config: InferenceConfig, **overrides):
+    # mistral text model: honor its sliding window when set
+    from nxdi_tpu.models.mistral import modeling_mistral
+
+    return modeling_mistral.build_arch(config, **overrides)
+
+
+def build_inv_freq(config: InferenceConfig) -> np.ndarray:
+    return dense.build_inv_freq(config)
+
+
+from nxdi_tpu.checkpoint import strip_language_model_prefix as _strip_text_prefix
+
+
+def convert_hf_state_dict(state_dict, config: InferenceConfig):
+    return dense.convert_hf_state_dict(
+        _strip_text_prefix(state_dict), config, build_arch(config)
+    )
+
+
+def param_specs(config: InferenceConfig):
+    return dense.param_specs_for(build_arch(config))
+
+
+def param_shape_struct(config: InferenceConfig):
+    return dense.param_shape_struct(config, build_arch(config))
+
+
+# -- vision protocol (ImageToTextForCausalLM) --
+
+
+def _merge_size(config: InferenceConfig) -> int:
+    return int(getattr(config, "spatial_merge_size", 1))
+
+
+def build_vision_arch(config: InferenceConfig):
+    vc = config.vision_config
+    fl = getattr(config, "vision_feature_layer", -1)
+    return vision_ops.PixtralVisionArch(
+        hidden_size=vc["hidden_size"],
+        intermediate_size=vc["intermediate_size"],
+        num_layers=vc["num_hidden_layers"],
+        num_heads=vc["num_attention_heads"],
+        image_size=vc["image_size"],
+        patch_size=vc["patch_size"],
+        num_channels=vc.get("num_channels", 3),
+        rope_theta=vc.get("rope_theta", 10000.0),
+        rms_norm_eps=vc.get("rms_norm_eps", 1e-5),
+        hidden_act=vc.get("hidden_act", "silu"),
+        feature_layer=fl if fl is not None else -1,
+        projector_act=getattr(config, "projector_hidden_act", "gelu"),
+        projector_norm_eps=float(getattr(config, "rms_norm_eps", 1e-5)),
+    )
+
+
+def num_image_tokens(config: InferenceConfig) -> int:
+    varch = build_vision_arch(config)
+    m = _merge_size(config)
+    return (varch.grid // m) ** 2
+
+
+def convert_vision_params(state_dict, config: InferenceConfig):
+    varch = build_vision_arch(config)
+    vision = vision_ops.convert_pixtral_vision(state_dict, varch)
+    if _merge_size(config) == 1:
+        return {"vision": vision,
+                "projector": vision_ops.convert_llava_projector(state_dict)}
+
+    def get(name, optional=False):
+        for k in ("multi_modal_projector." + name,
+                  "model.multi_modal_projector." + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=np.float32)
+        if optional:
+            return None
+        raise KeyError(name)
+
+    def lin(name):
+        out = {"w": get(name + ".weight").T}
+        b = get(name + ".bias", optional=True)
+        if b is not None:
+            out["b"] = b
+        return out
+
+    return {
+        "vision": vision,
+        "projector": {
+            "norm": get("norm.weight"),
+            "merging_layer": get("patch_merger.merging_layer.weight").T,
+            "linear_1": lin("linear_1"),
+            "linear_2": lin("linear_2"),
+        },
+    }
+
+
+def encode_images(varch, params: Dict[str, Any], pixel_values):
+    """(B, C, H, W) full-resolution square images -> (B, N_merged, text_hidden).
+
+    Mistral3 path (reference: NeuronLlavaMultiModalProjector + patch merger,
+    modeling_pixtral_vision.py:194-221): RMSNorm over the tower features,
+    spatial_merge_size^2 merge in torch-unfold channel-major order, then the
+    two projector linears.
+    """
+    feat = vision_ops.pixtral_vision_forward(varch, params["vision"], pixel_values)
+    p = params["projector"]
+    if "merging_layer" not in p:
+        return vision_ops.project_image_features(varch, p, feat)
+    from nxdi_tpu.ops.norms import rms_norm
+
+    feat = rms_norm(
+        feat, p["norm"], varch.projector_norm_eps or varch.rms_norm_eps
+    )
+    B, N, d = feat.shape
+    g = varch.grid
+    # merge size is encoded in the merging layer's input width (d * m^2) —
+    # the static weight shape, so no extra config threading into the jit
+    m = int(round((p["merging_layer"].shape[0] // d) ** 0.5))
+    gm = g // m
+    # (g, g, d) -> (gm, m, gm, m, d) -> (gm, gm, d, m, m): torch unfold is
+    # channel-major (d outer, kernel row, kernel col inner)
+    feat = feat.reshape(B, g, g, d).reshape(B, gm, m, gm, m, d)
+    feat = jnp.transpose(feat, (0, 1, 3, 5, 2, 4)).reshape(B, gm * gm, d * m * m)
+    feat = feat @ p["merging_layer"]
+    h = feat @ p["linear_1"]["w"]
+    if "b" in p["linear_1"]:
+        h = h + p["linear_1"]["b"]
+    h = vision_ops.ACTS[varch.projector_act](h)
+    h = h @ p["linear_2"]["w"]
+    if "b" in p["linear_2"]:
+        h = h + p["linear_2"]["b"]
+    return h
+
+
+def vision_shape_struct(config: InferenceConfig) -> Dict[str, Any]:
+    from nxdi_tpu.models.llava import modeling_llava
+
+    varch = build_vision_arch(config)
+    base = modeling_llava._pixtral_shape_struct(config, varch)
+    if _merge_size(config) == 1:
+        return base
+    Hv = varch.hidden_size
+    m = _merge_size(config)
+    bias = bool(getattr(config, "multimodal_projector_bias", False))
+    s = lambda *shape: jax.ShapeDtypeStruct(shape, np.float32)  # noqa: E731
+
+    def lin(i, o):
+        out = {"w": s(i, o)}
+        if bias:
+            out["b"] = s(o)
+        return out
+
+    base["projector"] = {
+        "norm": s(Hv),
+        "merging_layer": s(Hv * m * m, Hv),
+        "linear_1": lin(Hv, config.hidden_size),
+        "linear_2": lin(config.hidden_size, config.hidden_size),
+    }
+    return base

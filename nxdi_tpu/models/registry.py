@@ -18,8 +18,14 @@ _REGISTRY: Dict[str, Tuple[str, str]] = {
     "mistral": ("nxdi_tpu.models.mistral.modeling_mistral", "MistralInferenceConfig"),
     "mixtral": ("nxdi_tpu.models.mixtral.modeling_mixtral", "MixtralInferenceConfig"),
     "qwen3_moe": ("nxdi_tpu.models.qwen3_moe.modeling_qwen3_moe", "Qwen3MoeInferenceConfig"),
-    "gemma3": ("nxdi_tpu.models.gemma3.modeling_gemma3", "Gemma3InferenceConfig"),
+    "gemma3": (
+        "nxdi_tpu.models.gemma3.modeling_gemma3_vision",
+        "Gemma3VisionInferenceConfig",
+    ),
     "gemma3_text": ("nxdi_tpu.models.gemma3.modeling_gemma3", "Gemma3InferenceConfig"),
+    "pixtral": ("nxdi_tpu.models.pixtral.modeling_pixtral", "PixtralInferenceConfig"),
+    "mistral3": ("nxdi_tpu.models.pixtral.modeling_pixtral", "Mistral3InferenceConfig"),
+    "ovis2": ("nxdi_tpu.models.ovis2.modeling_ovis2", "Ovis2InferenceConfig"),
     "dbrx": ("nxdi_tpu.models.dbrx.modeling_dbrx", "DbrxInferenceConfig"),
     "gpt_oss": ("nxdi_tpu.models.gpt_oss.modeling_gpt_oss", "GptOssInferenceConfig"),
     "deepseek_v3": ("nxdi_tpu.models.deepseek.modeling_deepseek", "DeepseekInferenceConfig"),

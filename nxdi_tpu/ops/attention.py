@@ -98,6 +98,7 @@ def attention_with_positions(
     sliding_window_enabled=None,
     chunk_enabled=None,
     logit_softcap=None,
+    extra_or_mask=None,
 ):
     """Attention with the mask derived from positions (prefill and decode both).
 
@@ -106,10 +107,14 @@ def attention_with_positions(
     reference: get_updated_configs gemma3/modeling_gemma3.py:68, gpt-oss
     interleaved kv manager): the flag rides the layer scan, selecting between
     the windowed and plain causal mask inside one compiled body.
+
+    ``extra_or_mask`` (B, Sq, Skv) bool is OR-ed into the final mask — the
+    gemma3-vision bidirectional image-span pass (HF's or_mask_function applied
+    to both the full and sliding masks).
     """
     mask = _mask_from_positions(
         q_pos, kv_pos, sliding_window, chunk_size, sliding_window_enabled,
-        chunk_enabled,
+        chunk_enabled, extra_or_mask,
     )
     return grouped_attention(
         q, k, v, mask, scale=scale, softmax_dtype=softmax_dtype, sink=sink,
@@ -118,7 +123,8 @@ def attention_with_positions(
 
 
 def _mask_from_positions(
-    q_pos, kv_pos, sliding_window, chunk_size, sliding_window_enabled, chunk_enabled
+    q_pos, kv_pos, sliding_window, chunk_size, sliding_window_enabled,
+    chunk_enabled, extra_or_mask=None,
 ):
     if sliding_window is not None:
         mask = sliding_window_mask_from_positions(q_pos, kv_pos, sliding_window)
@@ -134,6 +140,8 @@ def _mask_from_positions(
             )
     else:
         mask = causal_mask_from_positions(q_pos, kv_pos)
+    if extra_or_mask is not None:
+        mask = mask | extra_or_mask
     return mask
 
 

@@ -207,6 +207,9 @@ class PixtralVisionArch:
     feature_layer: int = -1  # pixtral-llava taps the LAST layer, keeps all patches
     hidden_act: str = "gelu"  # HF PixtralVisionConfig default (NOT silu)
     projector_act: str = "gelu"
+    # mistral3: the projector RMSNorm uses the TEXT model's rms_norm_eps, not
+    # the tower's (HF Mistral3MultiModalProjector); None = use rms_norm_eps
+    projector_norm_eps: Optional[float] = None
 
     @property
     def head_dim(self) -> int:
@@ -328,5 +331,272 @@ def convert_pixtral_vision(
         "patch_embedding": conv.reshape(conv.shape[0], -1).T,
         "ln_pre": get("ln_pre.weight"),
         "rope_table": pixtral_rope_table(arch),
+        "layers": jtu.tree_map(lambda *xs: np.stack(xs), *layers),
+    }
+
+
+# ---------------------------------------------------------------------------
+# SigLIP vision tower (gemma3 lineage: no CLS, valid-conv patch embed,
+# pre-LN blocks, post layernorm — reference: contrib/models/gemma3-vision)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class SiglipVisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    num_channels: int = 3
+    hidden_act: str = "gelu_pytorch_tanh"
+    layer_norm_eps: float = 1e-6
+    # gemma3 projector statics (avg-pool target + soft-emb-norm eps); None
+    # when the tower is used without the gemma3 projector
+    proj_tokens_per_image: Optional[int] = None
+    proj_eps: float = 1e-6
+
+    @property
+    def num_patches(self) -> int:
+        return (self.image_size // self.patch_size) ** 2
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+
+def siglip_vision_forward(
+    arch: SiglipVisionArch, params: Dict[str, Any], pixel_values: jax.Array
+) -> jax.Array:
+    """(B, C, H, W) -> (B, N, hidden) post-layernormed patch features."""
+    B = pixel_values.shape[0]
+    P, C, Hd = arch.patch_size, arch.num_channels, arch.hidden_size
+    g = arch.grid
+    x = pixel_values.reshape(B, C, g, P, g, P)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(B, g * g, C * P * P)
+    h = x @ params["patch_embedding"] + params["patch_bias"]
+    h = h + params["position_embedding"][None]
+
+    def body(carry, lp):
+        y = layer_norm(carry, lp["ln1"]["w"], lp["ln1"]["b"], arch.layer_norm_eps)
+        y = _vit_attention(lp["attn"], y, arch.num_heads)
+        res = carry + y
+        y = layer_norm(res, lp["ln2"]["w"], lp["ln2"]["b"], arch.layer_norm_eps)
+        y = ACTS[arch.hidden_act](y @ lp["fc1"]["w"] + lp["fc1"]["b"])
+        y = y @ lp["fc2"]["w"] + lp["fc2"]["b"]
+        return res + y, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    return layer_norm(
+        h, params["post_layernorm"]["w"], params["post_layernorm"]["b"],
+        arch.layer_norm_eps,
+    )
+
+
+def convert_siglip_vision(
+    state_dict: Dict[str, np.ndarray],
+    arch: SiglipVisionArch,
+    prefix: str = "vision_tower.vision_model.",
+    dtype=np.float32,
+) -> Dict[str, Any]:
+    def get(name):
+        for k in (prefix + name, "model." + prefix + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=dtype)
+        raise KeyError(prefix + name)
+
+    conv = get("embeddings.patch_embedding.weight")  # (H, C, P, P)
+    layers = []
+    for i in range(arch.num_layers):
+        pre = f"encoder.layers.{i}."
+        layers.append({
+            "attn": {
+                name: {
+                    "w": get(pre + f"self_attn.{name}.weight").T,
+                    "b": get(pre + f"self_attn.{name}.bias"),
+                }
+                for name in ("q_proj", "k_proj", "v_proj", "out_proj")
+            },
+            "ln1": {"w": get(pre + "layer_norm1.weight"),
+                    "b": get(pre + "layer_norm1.bias")},
+            "ln2": {"w": get(pre + "layer_norm2.weight"),
+                    "b": get(pre + "layer_norm2.bias")},
+            "fc1": {"w": get(pre + "mlp.fc1.weight").T, "b": get(pre + "mlp.fc1.bias")},
+            "fc2": {"w": get(pre + "mlp.fc2.weight").T, "b": get(pre + "mlp.fc2.bias")},
+        })
+    import jax.tree_util as jtu
+
+    return {
+        "patch_embedding": conv.reshape(conv.shape[0], -1).T,
+        "patch_bias": get("embeddings.patch_embedding.bias"),
+        "position_embedding": get("embeddings.position_embedding.weight"),
+        "post_layernorm": {"w": get("post_layernorm.weight"),
+                           "b": get("post_layernorm.bias")},
+        "layers": jtu.tree_map(lambda *xs: np.stack(xs), *layers),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Ovis2 vision tower (RMS-norm pre-norm ViT + SwiGLU MLP, hidden-stride 2x2
+# merge, visual-token head — reference: contrib/models/Ovis2.5-9B)
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Ovis2VisionArch:
+    hidden_size: int
+    intermediate_size: int
+    num_layers: int
+    num_heads: int
+    image_size: int
+    patch_size: int
+    vocab_size: int  # visual vocab INCLUDING indicator rows
+    num_indicator_tokens: int
+    hidden_stride: int = 2
+    num_channels: int = 3
+    hidden_act: str = "silu"
+    rms_norm_eps: float = 1e-5
+    qkv_bias: bool = False
+    mlp_bias: bool = False
+    tokenize_function: str = "softmax"
+
+    @property
+    def grid(self) -> int:
+        return self.image_size // self.patch_size
+
+    @property
+    def num_patches(self) -> int:
+        return self.grid ** 2
+
+    @property
+    def num_tokens(self) -> int:
+        # after the hidden_stride x hidden_stride merge
+        s = -(-self.grid // self.hidden_stride)
+        return s * s
+
+
+def ovis2_visual_tokens(
+    arch: Ovis2VisionArch, params: Dict[str, Any], pixel_values: jax.Array
+) -> jax.Array:
+    """(B, C, H, W) -> (B, N_merged, visual_vocab - indicators) probabilistic
+    visual tokens (softmax over the visual vocabulary)."""
+    from nxdi_tpu.ops.norms import rms_norm
+
+    B = pixel_values.shape[0]
+    P, C, Hd = arch.patch_size, arch.num_channels, arch.hidden_size
+    g = arch.grid
+    x = pixel_values.reshape(B, C, g, P, g, P)
+    x = jnp.transpose(x, (0, 2, 4, 1, 3, 5)).reshape(B, g * g, C * P * P)
+    h = x @ params["patch_embedding"] + params["patch_bias"]
+    h = rms_norm(h, params["embed_norm"], arch.rms_norm_eps)
+    h = h + params["position_embedding"][None]
+
+    nH, D = arch.num_heads, Hd // arch.num_heads
+    act = ACTS[arch.hidden_act]
+
+    def attn(lp, y):
+        def proj(p):
+            out = y @ p["w"]
+            return out + p["b"] if "b" in p else out
+
+        q = jnp.swapaxes(proj(lp["q_proj"]).reshape(B, -1, nH, D), 1, 2)
+        k = jnp.swapaxes(proj(lp["k_proj"]).reshape(B, -1, nH, D), 1, 2)
+        v = jnp.swapaxes(proj(lp["v_proj"]).reshape(B, -1, nH, D), 1, 2)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
+        w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
+        out = jnp.swapaxes(ctx, 1, 2).reshape(B, -1, Hd)
+        out = out @ lp["out_proj"]["w"]
+        return out + lp["out_proj"]["b"] if "b" in lp["out_proj"] else out
+
+    def body(carry, lp):
+        y = rms_norm(carry, lp["norm1"], arch.rms_norm_eps)
+        res = carry + attn(lp, y)
+        y = rms_norm(res, lp["norm2"], arch.rms_norm_eps)
+
+        def mp(p):
+            out = y @ p["w"]
+            return out + p["b"] if "b" in p else out
+
+        gate = act(mp(lp["gate_proj"])) * mp(lp["up_proj"])
+        down = gate @ lp["down_proj"]["w"]
+        if "b" in lp["down_proj"]:
+            down = down + lp["down_proj"]["b"]
+        return res + down, None
+
+    h, _ = jax.lax.scan(body, h, params["layers"])
+    h = rms_norm(h, params["final_norm"], arch.rms_norm_eps)
+
+    # hidden_stride x hidden_stride spatial merge (row-major grid)
+    m = arch.hidden_stride
+    gm = -(-g // m)
+    pad = gm * m - g
+    h = h.reshape(B, g, g, Hd)
+    if pad:
+        h = jnp.pad(h, ((0, 0), (0, pad), (0, pad), (0, 0)))
+    h = h.reshape(B, gm, m, gm, m, Hd)
+    h = jnp.transpose(h, (0, 1, 3, 2, 4, 5)).reshape(B, gm * gm, m * m * Hd)
+
+    logits = h @ params["head_linear"]
+    logits = layer_norm(
+        logits, params["head_norm"]["w"], params["head_norm"]["b"], 1e-5
+    )
+    if arch.tokenize_function == "softmax":
+        return jax.nn.softmax(logits, axis=-1)
+    if arch.tokenize_function == "st_argmax":
+        # straight-through argmax == plain argmax one-hot at inference
+        return jax.nn.one_hot(jnp.argmax(logits, -1), logits.shape[-1],
+                              dtype=logits.dtype)
+    raise NotImplementedError(
+        f"ovis2 tokenize_function {arch.tokenize_function!r} (gumbel sampling "
+        "is a training-time stochastic path)"
+    )
+
+
+def convert_ovis2_vision(
+    state_dict: Dict[str, np.ndarray],
+    arch: Ovis2VisionArch,
+    prefix: str = "vision_tower.",
+    dtype=np.float32,
+) -> Dict[str, Any]:
+    def get(name, optional=False):
+        for k in (prefix + name, "model." + prefix + name):
+            if k in state_dict:
+                return np.asarray(state_dict[k], dtype=dtype)
+        if optional:
+            return None
+        raise KeyError(prefix + name)
+
+    def lin(name, transpose=True):
+        out = {"w": get(name + ".weight").T if transpose else get(name + ".weight")}
+        b = get(name + ".bias", optional=True)
+        if b is not None:
+            out["b"] = b
+        return out
+
+    conv = get("transformer.embeddings.patch_embedding.weight")
+    layers = []
+    for i in range(arch.num_layers):
+        pre = f"transformer.encoder.layers.{i}."
+        layers.append({
+            "norm1": get(pre + "rms_norm1.weight"),
+            "norm2": get(pre + "rms_norm2.weight"),
+            "q_proj": lin(pre + "attention.q_proj"),
+            "k_proj": lin(pre + "attention.k_proj"),
+            "v_proj": lin(pre + "attention.v_proj"),
+            "out_proj": lin(pre + "attention.out_proj"),
+            "gate_proj": lin(pre + "ffn.gate_proj"),
+            "up_proj": lin(pre + "ffn.up_proj"),
+            "down_proj": lin(pre + "ffn.down_proj"),
+        })
+    import jax.tree_util as jtu
+
+    return {
+        "patch_embedding": conv.reshape(conv.shape[0], -1).T,
+        "patch_bias": get("transformer.embeddings.patch_embedding.bias"),
+        "embed_norm": get("transformer.embeddings.rms_norm.weight"),
+        "position_embedding": get("transformer.embeddings.position_embedding.weight"),
+        "final_norm": get("transformer.rms_norm.weight"),
+        "head_linear": get("head_linear.weight").T,
+        "head_norm": {"w": get("head_norm.weight"), "b": get("head_norm.bias")},
         "layers": jtu.tree_map(lambda *xs: np.stack(xs), *layers),
     }
