@@ -215,6 +215,13 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
                    help="pre-quantized artifact dir (written by "
                         "save_quantized_state_dict); skips on-the-fly "
                         "quantization at load")
+    p.add_argument("--activation-quantization-type", default=None,
+                   choices=["dynamic", "static"],
+                   help="int8 activation quantization: per-token scales on "
+                        "the hot path (dynamic) or calibrated per-tensor "
+                        "scales from the quantized checkpoint (static)")
+    p.add_argument("--quantize-clamp-bound", type=float, default=None,
+                   help="clamp |activations| before quantizing")
     p.add_argument("--kv-cache-quant", action="store_true")
     p.add_argument("--kv-scale-mode", default="direct_cast",
                    choices=["direct_cast", "per_tensor", "per_key", "per_channel"],
@@ -355,6 +362,8 @@ def create_tpu_config(args):
         quantization_dtype=args.quantization_dtype,
         quantization_type=args.quantization_type,
         quantized_checkpoints_path=args.quantized_checkpoints_path,
+        activation_quantization_type=args.activation_quantization_type,
+        quantize_clamp_bound=args.quantize_clamp_bound,
         kv_cache_quant=args.kv_cache_quant,
         kv_quant_config=(
             (

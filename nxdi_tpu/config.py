@@ -363,19 +363,25 @@ class TpuConfig:
         self.kv_cache_quant = kwargs.pop("kv_cache_quant", False)
         if self.kv_cache_quant and self.kv_quant_config is None:
             self.kv_quant_config = KVQuantizationConfig()
-        # dynamic activation quantization (reference: config.py:434-517)
+        # activation quantization (reference: config.py:434-517): "dynamic"
+        # computes per-token scales on the hot path; "static" reads calibrated
+        # per-tensor input scales from the quantized checkpoint
+        # (ops/quantization.calibrate_input_scales)
         self.activation_quantization_type = kwargs.pop("activation_quantization_type", None)
+        if isinstance(self.activation_quantization_type, str):
+            self.activation_quantization_type = self.activation_quantization_type.lower()
         self.quantize_clamp_bound = kwargs.pop("quantize_clamp_bound", None)
         if self.activation_quantization_type is not None:
-            if self.activation_quantization_type != "dynamic":
+            if self.activation_quantization_type not in ("dynamic", "static"):
                 raise ValueError(
-                    "activation_quantization_type: only 'dynamic' is supported "
+                    "activation_quantization_type: 'dynamic' or 'static' "
                     f"(got {self.activation_quantization_type!r})"
                 )
             if not self.quantized or self.quantization_dtype != "int8":
                 raise ValueError(
-                    "activation_quantization_type='dynamic' requires quantized=True "
-                    "with quantization_dtype='int8' (the int8 MXU path)"
+                    f"activation_quantization_type={self.activation_quantization_type!r} "
+                    "requires quantized=True with quantization_dtype='int8' "
+                    "(the int8 MXU path)"
                 )
 
         # --- speculation (reference: config.py:244-272) ---
@@ -458,11 +464,10 @@ class TpuConfig:
             self.world_size = self.tp_degree * self.pp_degree
         self.start_rank_id = kwargs.pop("start_rank_id", 0)
         self.sequence_parallel_enabled = kwargs.pop("sequence_parallel_enabled", False)
-        # MLP-CP (reference: mlp_cp_degree config.py:364,374-375). Under GSPMD
-        # this is subsumed: with SP (or CP) the inter-layer hidden is already
-        # sequence-sharded, so the MLP computes context-parallel without a
-        # dedicated path — the knob is accepted for config parity and
-        # validated to require SP exactly like the reference.
+        # MLP-CP (reference: mlp_cp_degree config.py:364,374-375): without SP
+        # this shards JUST the MLP block's stream on S (the mlp_hidden policy,
+        # parallel/policy.py); with SP the whole inter-layer stream is already
+        # S-sharded and the knob is subsumed.
         self.mlp_cp_degree = kwargs.pop("mlp_cp_degree", 1)
         self.flash_decoding_enabled = kwargs.pop("flash_decoding_enabled", False)
         self.num_cores_per_group = kwargs.pop("num_cores_per_group", 1)
@@ -665,11 +670,10 @@ class TpuConfig:
                         f"ring slots, which exceeds seq_len ({self.seq_len})"
                     )
         if self.mlp_cp_degree and self.mlp_cp_degree > 1:
-            if not self.sequence_parallel_enabled:
-                raise ValueError(
-                    "mlp_cp_degree > 1 requires sequence_parallel_enabled "
-                    "(the context-parallel MLP reads S-sharded activations)"
-                )
+            # without SP this engages the dedicated MLP-CP policy (only the
+            # MLP stream shards on S — parallel/policy.py mlp_hidden); with
+            # SP the whole inter-layer stream is already S-sharded and the
+            # knob is subsumed
             if self.tp_degree % self.mlp_cp_degree != 0:
                 raise ValueError("mlp_cp_degree must divide tp_degree")
         if self.is_medusa and self.num_medusa_heads <= 0:

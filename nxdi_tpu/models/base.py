@@ -866,7 +866,7 @@ def attention_block(
 
 def mlp_block(
     arch: DecoderArch, p_mlp: Dict[str, Any], x: jax.Array, adapter_ids=None,
-    mlp_stacked=None, layer_idx=None,
+    mlp_stacked=None, layer_idx=None, policy: ShardingPolicy = DEFAULT_POLICY,
 ) -> jax.Array:
     """Gated MLP (SwiGLU family) — or the plain 2-layer MLP for the gpt2
     lineage (gated_mlp=False). XLA fuses act+mul into the matmuls.
@@ -877,7 +877,14 @@ def mlp_block(
     they never silently fall back. Inside the layer scan the weights come
     STACKED (``mlp_stacked`` = (L,H,I)/(L,I,H) arrays + in-scan layer index):
     the kernel indexes them via scalar prefetch, avoiding the per-layer
-    slice-copy a pallas operand on scan xs would materialize."""
+    slice-copy a pallas operand on scan xs would materialize.
+
+    ``policy.mlp_hidden`` (MLP-CP, reference: mlp_cp_degree
+    config.py:364,374-375): when set, the input stream is constrained
+    S-sharded on entry and the output re-replicates at the residual join —
+    GSPMD inserts the scatter/gather pair the reference wires by hand."""
+    if policy.mlp_hidden is not None and x.shape[1] > 1:
+        x = constrain(x, policy.mlp_hidden)
     if arch.mlp_kernel_enabled:
         bad = None
         if not arch.gated_mlp:
@@ -991,12 +998,12 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h_mlp, policy.hidden)
         else:
-            ff = mlp_block(arch, lp["mlp"], h_mlp, adapter_ids, mlp_stacked, stacked_layer_idx)
+            ff = mlp_block(arch, lp["mlp"], h_mlp, adapter_ids, mlp_stacked, stacked_layer_idx, policy=policy)
         hidden = hidden + (attn_out + ff) * arch.residual_multiplier
     elif arch.post_block_norm:
         # olmo2: x + norm(attn(x)); x + norm(mlp(x))
         hidden = hidden + _norm(arch, attn_out, lp["input_layernorm"]) * arch.residual_multiplier
-        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids, mlp_stacked, stacked_layer_idx)
+        ff = mlp_block(arch, lp["mlp"], hidden, adapter_ids, mlp_stacked, stacked_layer_idx, policy=policy)
         hidden = hidden + _norm(arch, ff, lp["post_attention_layernorm"]) * arch.residual_multiplier
     elif arch.sandwich_norm:
         # gemma lineage: post-norms applied to the block OUTPUT before the
@@ -1010,7 +1017,7 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             ff = moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden)
         else:
-            ff = mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, stacked_layer_idx)
+            ff = mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, stacked_layer_idx, policy=policy)
         ff = _norm(arch, ff, lp["post_feedforward_layernorm"])
         hidden = hidden + ff
     else:
@@ -1019,7 +1026,7 @@ def decoder_layer(
         if arch.moe is not None and "moe" in lp:
             hidden = hidden + moe_ops.moe_block(arch, arch.moe, lp["moe"], h, policy.hidden) * arch.residual_multiplier
         else:
-            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, stacked_layer_idx) * arch.residual_multiplier
+            hidden = hidden + mlp_block(arch, lp["mlp"], h, adapter_ids, mlp_stacked, stacked_layer_idx, policy=policy) * arch.residual_multiplier
     hidden = constrain(hidden, policy.hidden)
     return hidden, (nk, nv)
 
@@ -1143,6 +1150,14 @@ def _pipelined_decoder_layers(
                                       P(None)),
                             out_specs=(cspec, cspec),
                             axis_names=set(axes),
+                            # check_vma must be off: the commit kernel's
+                            # aliased (donated) cache outputs carry the
+                            # UNREDUCED vma of their inputs, and shard_map's
+                            # varying-manual-axes check rejects the alias
+                            # pair even though each shard only ever writes
+                            # its own rows (replicated-slot semantics are
+                            # preserved by construction — every shard gets
+                            # identical slots/lines inputs)
                             check_vma=False,
                         )
                         kl, vl = commit(kl, vl, kr, vr, slots, lines)
@@ -1203,6 +1218,15 @@ def _pipelined_decoder_layers(
                   P() if adapter_ids is not None else None),
         out_specs=(P(), P(AXIS_PP), P(AXIS_PP), P(AXIS_PP)),
         axis_names={AXIS_PP},
+        # check_vma off by necessity, not convenience: the GPipe body emits
+        # `out` with out_specs=P() (replicated) but its value is only
+        # meaningful on the LAST stage (earlier stages hold bubble garbage);
+        # the ppermute ring then delivers the real rows. The vma checker
+        # would demand a psum/all_gather to "prove" replication — a real
+        # collective round the schedule neither needs nor wants. The
+        # invariant (stage s's tick t output is consumed only by stage s+1
+        # at tick t+1) is enforced by the ppermute wiring itself and
+        # token-matched under pp in tests/integration/test_parallelism.py.
         check_vma=False,
     )(layer_params, cache["k"], cache["v"], hidden, cos, sin, position_ids, ci,
       adapter_ids)

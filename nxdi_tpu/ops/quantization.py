@@ -31,6 +31,7 @@ reference's dynamic ``ActivationQuantizationType`` (config.py:434-517).
 
 from __future__ import annotations
 
+from contextlib import contextmanager
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -178,6 +179,14 @@ def quantized_linear(
         if clamp_bound is not None:
             x = jnp.clip(x, -clamp_bound, clamp_bound)
         x_amax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+        if _CALIB is not None:
+            # calibration pass (under jax.disable_jit): record the largest
+            # activation magnitude this linear has seen. The key is a CONTENT
+            # fingerprint of the weight (shape + a 4x..x4 corner) because the
+            # layer scan hands the body fresh SLICES of the stacked weights —
+            # attach_input_scales recomputes the same fingerprints per layer
+            key = _weight_fingerprint(qw)
+            _CALIB[key] = max(_CALIB.get(key, 0.0), float(jnp.max(x_amax)))
         x_scale = jnp.maximum(x_amax.astype(jnp.float32), 1e-12) / 127.0
         qx = jnp.clip(
             jnp.round(x.astype(jnp.float32) / x_scale), -127, 127
@@ -188,6 +197,23 @@ def quantized_linear(
         )
         # scale: (..., 1, out) -> broadcast over y's out axis; x_scale per token
         y = y.astype(jnp.float32) * x_scale * jnp.squeeze(scale, axis=-2)
+        y = y.astype(x.dtype)
+    elif act_quant == "static" and qw.dtype == jnp.int8:
+        # static activation quantization (reference: config.py:434-517
+        # "STATIC"): the per-tensor input scale is CALIBRATED OFFLINE
+        # (calibrate_input_scales) and carried in the quantized checkpoint —
+        # no per-token amax reduction on the hot path
+        if clamp_bound is not None:
+            x = jnp.clip(x, -clamp_bound, clamp_bound)
+        in_s = p["input_scale"].astype(jnp.float32)
+        qx = jnp.clip(
+            jnp.round(x.astype(jnp.float32) / in_s), -127, 127
+        ).astype(jnp.int8)
+        y = jax.lax.dot_general(
+            qx, qw, (((qx.ndim - 1,), (qw.ndim - 2,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+        y = y.astype(jnp.float32) * in_s * jnp.squeeze(scale, axis=-2)
         y = y.astype(x.dtype)
     else:
         y = x @ qw.astype(x.dtype)
@@ -233,10 +259,17 @@ def quantize_params(
     quant_dtype: str = "int8",
     scheme: str = PER_CHANNEL,
     modules_to_not_convert: Optional[list] = None,
+    static_input_scales: bool = False,
 ) -> Dict[str, Any]:
     """Quantize every linear param dict (``{"w": ...}``) in a host params
     pytree. Biases and norms pass through untouched. This is the online analog
-    of the reference's offline ``generate_quantized_state_dict``."""
+    of the reference's offline ``generate_quantized_state_dict``.
+
+    ``static_input_scales`` additionally seeds an ``input_scale=1.0`` entry
+    per quantized linear (the static-activation-quant layout). 1.0 is an
+    IDENTITY placeholder — run :func:`calibrate_input_scales` (or load a
+    calibrated artifact) before serving, or activations simply round to the
+    nearest integer."""
 
     def fn(d, path):
         if not _should_quantize(path, modules_to_not_convert):
@@ -247,9 +280,138 @@ def quantize_params(
             out.update(qw4=qw, scale=scale)
         else:
             out.update(qw=qw, scale=scale)
+            if static_input_scales:
+                # identity placeholder, one per stacked layer (the leading
+                # dims before (in, out)) so it rides the layer scan's slicing
+                out["input_scale"] = np.ones(d["w"].shape[:-2], np.float32)
         return out
 
     return _walk(params, (), fn)
+
+
+# ---------------------------------------------------------------------------
+# Static activation calibration (reference: the offline quantization tooling
+# producing per-linear input scales consumed by config.py:434-517 "STATIC")
+# ---------------------------------------------------------------------------
+
+_CALIB: Optional[Dict[Any, float]] = None
+
+
+def _weight_fingerprint(qw) -> Tuple:
+    """Shape + 4^ndim-corner content key identifying a quantized weight (or a
+    per-layer slice of a stacked one) across the eager scan's re-slicing."""
+    corner = qw[tuple(slice(0, 4) for _ in range(qw.ndim))]
+    return (tuple(qw.shape), np.asarray(corner).tobytes())
+
+
+@contextmanager
+def activation_calibration():
+    """Collect per-linear activation amax during DYNAMIC-quant forwards run
+    under ``jax.disable_jit()`` (eager mode makes the amax concrete). Yields
+    the collector dict keyed by weight fingerprint."""
+    global _CALIB
+    prev, _CALIB = _CALIB, {}
+    try:
+        yield _CALIB
+    finally:
+        _CALIB = prev
+
+
+def attach_input_scales(
+    params: Dict[str, Any], amax_by_fp: Dict[Any, float]
+) -> Dict[str, Any]:
+    """Write calibrated ``input_scale = amax / 127`` into every quantized
+    linear the calibration traffic touched. Layer-stacked weights (leading
+    scan axis) get a PER-LAYER (L,) scale vector — it rides the layer scan's
+    slicing exactly like the weights do. Untouched linears keep their current
+    (placeholder) scale."""
+
+    def scale_of(amax: float) -> np.float32:
+        return np.float32(max(amax, 1e-12) / 127.0)
+
+    def walk(tree):
+        if not isinstance(tree, dict):
+            return tree
+        if "qw" in tree:
+            qw = np.asarray(tree["qw"])
+            whole = _weight_fingerprint(qw)
+            if whole in amax_by_fp:  # unstacked linear, called as-is
+                return {**tree, "input_scale": scale_of(amax_by_fp[whole])}
+            if qw.ndim >= 3:
+                keys = [_weight_fingerprint(qw[i]) for i in range(qw.shape[0])]
+                if any(k in amax_by_fp for k in keys):
+                    cur = np.broadcast_to(
+                        np.asarray(tree.get("input_scale", np.float32(1.0))),
+                        (qw.shape[0],),
+                    )
+                    scales = np.asarray(
+                        [
+                            scale_of(amax_by_fp[k]) if k in amax_by_fp else cur[i]
+                            for i, k in enumerate(keys)
+                        ],
+                        np.float32,
+                    )
+                    return {**tree, "input_scale": scales}
+            return tree
+        return {k: walk(v) for k, v in tree.items()}
+
+    return walk(params)
+
+
+def calibrate_input_scales(forward_fn, params, sample_batches):
+    """Offline static-activation calibration: run ``forward_fn(params, batch)``
+    for each sample batch in eager mode with the collector active, then
+    return params with calibrated ``input_scale`` entries attached.
+
+    ``forward_fn`` must route its linears through :func:`quantized_linear`
+    with ``act_quant="dynamic"`` (the dynamic path records the amax)."""
+    with jax.disable_jit(), activation_calibration() as rec:
+        for batch in sample_batches:
+            forward_fn(params, batch)
+    return attach_input_scales(params, rec)
+
+
+def calibrate_app_input_scales(app, sample_prompts):
+    """Application-level static-activation calibration (the analog of the
+    reference's offline quantization tooling emitting input scales): run CTE
+    prefills of the sample prompts EAGERLY on an app built with
+    ``activation_quantization_type="dynamic"``, record each linear's input
+    amax, and return the app's params with calibrated ``input_scale`` entries.
+
+    The compiled bucket programs are bypassed for the calibration traffic
+    (eager execution is what makes the amax concrete); tp=1 is the intended
+    calibration topology. Typical flow::
+
+        app = ...  # quantized=True, activation_quantization_type="dynamic"
+        app.load()
+        params = calibrate_app_input_scales(app, [prompt_ids, ...])
+        # serve statically: save params / rebuild the app with
+        # activation_quantization_type="static"
+    """
+    from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
+
+    w = app.models[TAG_CONTEXT_ENCODING]
+
+    class _EagerPrograms(dict):
+        def __missing__(self, bucket):
+            fn = w.make_forward(bucket)
+            self[bucket] = fn
+            return fn
+
+    saved = w._programs
+    w._programs = _EagerPrograms()
+    try:
+        with jax.disable_jit(), activation_calibration() as rec, \
+                jax.set_mesh(app.mesh):
+            for ids in sample_prompts:
+                ids = np.asarray(ids)
+                pos = np.tile(
+                    np.arange(ids.shape[1], dtype=np.int32), (ids.shape[0], 1)
+                )
+                app.forward(ids, pos)
+    finally:
+        w._programs = saved
+    return attach_input_scales(app.params, rec)
 
 
 def quantize_param_specs(
@@ -257,6 +419,7 @@ def quantize_param_specs(
     scheme: str = PER_CHANNEL,
     modules_to_not_convert: Optional[list] = None,
     quant_dtype: str = "int8",
+    static_input_scales: bool = False,
 ) -> Dict[str, Any]:
     """Mirror :func:`quantize_params` on a PartitionSpec pytree. The scale
     inherits the weight's spec with the ``in`` axis (index -2) un-sharded —
@@ -286,6 +449,8 @@ def quantize_param_specs(
             scale_spec = P(*(entries[:-2] + (None, out_entry)))
         out = {k: v for k, v in d.items() if k != "w"}
         out.update(qw=spec_w, scale=scale_spec)
+        if static_input_scales:
+            out["input_scale"] = P()
         return out
 
     return _walk(specs, (), fn)
@@ -296,6 +461,7 @@ def quantize_shape_struct(
     quant_dtype: str = "int8",
     scheme: str = PER_CHANNEL,
     modules_to_not_convert: Optional[list] = None,
+    static_input_scales: bool = False,
 ) -> Dict[str, Any]:
     """Mirror :func:`quantize_params` on a ShapeDtypeStruct pytree (AOT compile
     path, application.py params_shape_struct)."""
@@ -330,6 +496,8 @@ def quantize_shape_struct(
             qw=jax.ShapeDtypeStruct(s.shape, jnp.dtype(np_dt)),
             scale=jax.ShapeDtypeStruct(scale_shape, jnp.float32),
         )
+        if static_input_scales:
+            out["input_scale"] = jax.ShapeDtypeStruct(s.shape[:-2], jnp.float32)
         return out
 
     return _walk(struct, (), fn)

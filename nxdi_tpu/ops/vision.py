@@ -56,20 +56,23 @@ class ClipVisionArch:
 
 
 def _vit_attention(p, x, num_heads: int):
+    """Full (bidirectional) ViT self-attention; q/k/v/out biases optional
+    (CLIP/SigLIP carry them, ovis2's depend on qkv_bias)."""
     B, S, H = x.shape
     D = H // num_heads
 
-    def proj(name):
-        return (x @ p[name]["w"] + p[name]["b"]).reshape(B, S, num_heads, D)
+    def lin(name, y):
+        out = y @ p[name]["w"]
+        return out + p[name]["b"] if "b" in p[name] else out
 
-    q = jnp.swapaxes(proj("q_proj"), 1, 2)
-    k = jnp.swapaxes(proj("k_proj"), 1, 2)
-    v = jnp.swapaxes(proj("v_proj"), 1, 2)
+    q = jnp.swapaxes(lin("q_proj", x).reshape(B, S, num_heads, D), 1, 2)
+    k = jnp.swapaxes(lin("k_proj", x).reshape(B, S, num_heads, D), 1, 2)
+    v = jnp.swapaxes(lin("v_proj", x).reshape(B, S, num_heads, D), 1, 2)
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
     weights = jax.nn.softmax(scores, axis=-1).astype(v.dtype)
     ctx = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
     ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H)
-    return ctx @ p["out_proj"]["w"] + p["out_proj"]["b"]
+    return lin("out_proj", ctx)
 
 
 def clip_vision_forward(
@@ -490,27 +493,11 @@ def ovis2_visual_tokens(
     h = rms_norm(h, params["embed_norm"], arch.rms_norm_eps)
     h = h + params["position_embedding"][None]
 
-    nH, D = arch.num_heads, Hd // arch.num_heads
     act = ACTS[arch.hidden_act]
-
-    def attn(lp, y):
-        def proj(p):
-            out = y @ p["w"]
-            return out + p["b"] if "b" in p else out
-
-        q = jnp.swapaxes(proj(lp["q_proj"]).reshape(B, -1, nH, D), 1, 2)
-        k = jnp.swapaxes(proj(lp["k_proj"]).reshape(B, -1, nH, D), 1, 2)
-        v = jnp.swapaxes(proj(lp["v_proj"]).reshape(B, -1, nH, D), 1, 2)
-        s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * (D ** -0.5)
-        w = jax.nn.softmax(s, axis=-1).astype(v.dtype)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", w, v)
-        out = jnp.swapaxes(ctx, 1, 2).reshape(B, -1, Hd)
-        out = out @ lp["out_proj"]["w"]
-        return out + lp["out_proj"]["b"] if "b" in lp["out_proj"] else out
 
     def body(carry, lp):
         y = rms_norm(carry, lp["norm1"], arch.rms_norm_eps)
-        res = carry + attn(lp, y)
+        res = carry + _vit_attention(lp, y, arch.num_heads)
         y = rms_norm(res, lp["norm2"], arch.rms_norm_eps)
 
         def mp(p):

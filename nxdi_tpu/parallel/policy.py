@@ -48,6 +48,12 @@ class ShardingPolicy:
 
     Dims: hidden (B, S, H) — q/kv (B, heads, S, D) — cache_kv = the windowed
     cache view read during decode (B, KV_heads, W, D) — logits (B, S, V).
+
+    ``mlp_hidden`` (MLP-CP, reference: mlp_cp_degree config.py:364,374-375):
+    when set, the MLP block's input stream is constrained to this spec while
+    the surrounding attention/residual stream keeps ``hidden`` — the MLP
+    computes context-parallel on its own, without SP sharding the whole
+    inter-layer stream.
     """
 
     hidden: P = P()
@@ -55,6 +61,7 @@ class ShardingPolicy:
     kv: P = P(None, AXIS_MP, None, None)
     cache_kv: P = P(None, AXIS_MP, None, None)
     logits: P = P(None, None, AXIS_MP)
+    mlp_hidden: "P | None" = None
 
 
 DEFAULT_POLICY = ShardingPolicy()
@@ -72,8 +79,13 @@ def context_encoding_policy(tc) -> ShardingPolicy:
         )
     if tc.sequence_parallel_enabled:
         # SP: inter-layer activations S-sharded over tp; attention runs with
-        # full heads per rank (GSPMD re-shards at the QKV boundary)
+        # full heads per rank (GSPMD re-shards at the QKV boundary). MLP-CP
+        # is subsumed: the MLP already sees the S-sharded stream.
         return ShardingPolicy(hidden=P(None, AXIS_MP, None))
+    if getattr(tc, "mlp_cp_degree", 1) > 1:
+        # MLP-CP without SP: only the MLP block computes sequence-parallel;
+        # attention and the residual stream stay replicated
+        return ShardingPolicy(mlp_hidden=P(None, AXIS_MP, None))
     return DEFAULT_POLICY
 
 

@@ -60,6 +60,7 @@ def maybe_quantize_params(params, tc):
         quant_dtype=tc.quantization_dtype,
         scheme=tc.quantization_type,
         modules_to_not_convert=tc.modules_to_not_convert,
+        static_input_scales=tc.activation_quantization_type == "static",
     )
 
 
@@ -72,6 +73,7 @@ def maybe_quantize_specs(specs, tc):
         specs, scheme=tc.quantization_type,
         modules_to_not_convert=tc.modules_to_not_convert,
         quant_dtype=tc.quantization_dtype,
+        static_input_scales=tc.activation_quantization_type == "static",
     )
 
 
@@ -85,6 +87,7 @@ def maybe_quantize_struct(struct, tc):
         quant_dtype=tc.quantization_dtype,
         scheme=tc.quantization_type,
         modules_to_not_convert=tc.modules_to_not_convert,
+        static_input_scales=tc.activation_quantization_type == "static",
     )
 
 
@@ -208,12 +211,18 @@ class ApplicationBase:
         """Offline weight quantization artifact (reference:
         application_base.py:744 ``save_quantized_state_dict``): quantize the
         converted params pytree and save it flat as safetensors for fast reload
-        via ``quantized_checkpoints_path``."""
+        via ``quantized_checkpoints_path``. A LOADED app saves its in-memory
+        params instead — that is what preserves calibrated static-activation
+        input scales (ops/quantization.calibrate_app_input_scales)."""
         from nxdi_tpu.ops import quantization as quant_ops
 
-        sd = self.get_state_dict()
-        params = self.family.convert_hf_state_dict(sd, self.config)
-        flat = quant_ops.flatten_params(maybe_quantize_params(params, self.tpu_config))
+        if self.is_loaded:
+            qparams = self.params
+        else:
+            sd = self.get_state_dict()
+            params = self.family.convert_hf_state_dict(sd, self.config)
+            qparams = maybe_quantize_params(params, self.tpu_config)
+        flat = quant_ops.flatten_params(qparams)
         os.makedirs(path, exist_ok=True)
         ckpt.save_state_dict_safetensors(flat, path)
 

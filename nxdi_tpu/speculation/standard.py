@@ -74,6 +74,11 @@ def _app_cls(family, base=None):
         if family
         else TpuModelForCausalLM
     )
+    if not isinstance(cls, type):
+        # APPLICATION_CLS may be a config-dispatching FACTORY (gemma3's
+        # vision/text dual registry key) — speculation targets are plain
+        # causal LMs, so graft onto the base application
+        cls = TpuModelForCausalLM
     if base is None:  # draft: the family app as-is
         return cls
     if cls is TpuModelForCausalLM or issubclass(base, cls):

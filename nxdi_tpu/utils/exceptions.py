@@ -15,8 +15,11 @@ class LogitMatchingValidationError(AssertionError):
     can capture inputs at that position (reference: utils/exceptions.py +
     accuracy.py:474 divergence re-run)."""
 
-    def __init__(self, message, divergence_index=None, max_error=None, errors_by_index=None):
+    def __init__(self, message, divergence_index=None, max_error=None,
+                 errors_by_index=None, summary=None):
         super().__init__(message)
         self.divergence_index = divergence_index
         self.max_error = max_error
         self.errors_by_index = errors_by_index or {}
+        # error_summary() dict incl. suggested_tol_map (accuracy.py)
+        self.summary = summary or {}

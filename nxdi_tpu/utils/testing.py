@@ -114,3 +114,94 @@ def validate_accuracy(
                 expected = cpu_out
         actual = compiled(*args)
         assert_close(expected, actual, f"input {i}: expected vs compiled")
+
+
+# ---------------------------------------------------------------------------
+# Module-from-model adapters (reference: module_test/module_from_model_template/
+# mfm_adapter_base.py — extract single modules + weights from the complete
+# model and test them in isolation against the HF submodule)
+# ---------------------------------------------------------------------------
+
+
+def extract_layer_params(params: Dict[str, Any], layer: int):
+    """One layer's sub-pytree sliced out of the stacked layer params
+    (heterogeneous segment lists index across segment boundaries)."""
+    lp = params["layers"]
+    segments = lp if isinstance(lp, (list, tuple)) else [lp]
+    off = 0
+    for seg in segments:
+        n = jax.tree_util.tree_leaves(seg)[0].shape[0]
+        if layer < off + n:
+            return jax.tree_util.tree_map(lambda a: a[layer - off], seg)
+        off += n
+    raise IndexError(f"layer {layer} out of range ({off} layers)")
+
+
+def build_module_from_model(
+    family,
+    config,
+    state_dict: Dict[str, np.ndarray],
+    module: str = "mlp",
+    layer: int = 0,
+    tp_degree: int = 1,
+):
+    """MFM adapter (reference: mfm_adapter_base.py MFMHFAdapter): convert the
+    COMPLETE checkpoint through the family's converter, slice out one layer's
+    ``module``, and return it as a runnable mesh-sharded function — so a
+    module-level test exercises exactly the weights and block code the full
+    model would.
+
+    ``module``: "mlp" (the gated/plain MLP block), "input_layernorm" /
+    "post_attention_layernorm" (the norm), or "decoder_layer" (the whole
+    layer run through the real layer-scan machinery on a fresh prefill
+    cache). Returns a callable taking (hidden (B, S, H)[, position_ids]).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from nxdi_tpu.models import base as base_mod
+
+    arch = family.build_arch(config)
+    params = family.convert_hf_state_dict(state_dict, config)
+    lp = extract_layer_params(params, layer)
+
+    if module == "mlp":
+        return build_module(
+            lambda p, x: base_mod.mlp_block(arch, p, x),
+            lp["mlp"], tp_degree=tp_degree,
+        )
+    if module in ("input_layernorm", "post_attention_layernorm"):
+        return build_module(
+            lambda p, x: base_mod._norm(arch, x, p), lp[module],
+            tp_degree=tp_degree,
+        )
+    if module == "decoder_layer":
+        # the whole layer through run_decoder_layers (1-layer stack, fresh
+        # prefill cache) — rope/attention/KV handling identical to the model
+        one = jax.tree_util.tree_map(lambda a: a[None], lp)
+        inv_freq = family.build_inv_freq(config)
+
+        def fn(p, hidden, position_ids):
+            from nxdi_tpu.ops.rope import rope_cos_sin
+
+            B, S, _ = hidden.shape
+            cos, sin = rope_cos_sin(position_ids, np.asarray(inv_freq))
+            spec = arch.kv_cache_spec(B, S)
+            cache = {
+                "k": jax.numpy.zeros(
+                    (1, B, arch.num_kv_heads, S, arch.head_dim), hidden.dtype
+                ),
+                "v": jax.numpy.zeros(
+                    (1, B, arch.num_kv_heads, S, arch.head_dim), hidden.dtype
+                ),
+            }
+            out, _ = base_mod.run_decoder_layers(
+                arch, p, hidden, cos, sin, cache, position_ids, spec,
+                attend_to_cache=False,
+            )
+            return out
+
+        return build_module(fn, one, tp_degree=tp_degree)
+    raise ValueError(
+        f"unknown module {module!r}; supported: mlp, input_layernorm, "
+        "post_attention_layernorm, decoder_layer"
+    )

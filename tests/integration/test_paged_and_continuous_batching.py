@@ -298,3 +298,74 @@ def test_logit_matching_on_paged_app(tiny_hf_llama):
     ids = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
     errs = check_accuracy_logits(app, ids, hf_model=hf_model, divergence_difference_tol=0.01)
     assert max(errs.values()) < 0.01
+
+
+def test_chunked_prefill_logit_matching_v2(tiny_hf_llama):
+    """check_accuracy_logits_v2 on a chunked-prefill config must generate
+    THROUGH the chunked path (reference: generate_with_chunked_prefill,
+    accuracy.py:940) and logit-match every position vs HF CPU."""
+    from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
+    from nxdi_tpu.utils.accuracy import (
+        check_accuracy_logits_v2,
+        generate_with_chunked_prefill,
+    )
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model,
+        hf_cfg,
+        is_block_kv_layout=True,
+        chunked_prefill_config={"chunk_size": 8, "kernel_q_tile_size": 8},
+        pa_block_size=4,
+        pa_num_blocks=64,
+        ctx_batch_size=1,
+        tkg_batch_size=1,
+        batch_size=1,
+    )
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(1, 255, size=(1, 20)).astype(np.int64)
+
+    # the chunked generate path itself must match HF greedy exactly
+    full = generate_with_chunked_prefill(app, prompt, max_new_tokens=6)
+    expected = hf_greedy(hf_model, prompt, 6)
+    np.testing.assert_array_equal(full, expected)
+
+    errors = check_accuracy_logits_v2(
+        app,
+        HuggingFaceGenerationAdapter(app),
+        prompt,
+        max_new_tokens=6,
+        hf_model=hf_model,
+        divergence_difference_tol=0.01,
+    )
+    assert len(errors) > 0
+
+
+def test_error_summary_and_suggested_tol_map(tiny_hf_llama):
+    """A failing logit match must report the error summary and a suggested
+    tol_map that, fed back in, makes the run pass (the reference's
+    tolerance-relaxation loop)."""
+    from nxdi_tpu.utils.accuracy import check_accuracy_logits
+    from nxdi_tpu.utils.exceptions import LogitMatchingValidationError
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(hf_model, hf_cfg, batch_size=1, ctx_batch_size=1,
+                     tkg_batch_size=1)
+    prompt = np.array([[5, 9, 3, 17, 2, 8]], dtype=np.int64)
+    golden = hf_greedy(hf_model, prompt, 4)
+    with pytest.raises(LogitMatchingValidationError) as ei:
+        # impossible tolerance: float32 roundoff alone exceeds it
+        check_accuracy_logits(
+            app, golden, hf_model=hf_model, divergence_difference_tol=1e-12
+        )
+    err = ei.value
+    assert err.summary["n_over_tol"] > 0
+    assert "suggested --tol-map" in str(err)
+    relax = err.summary["suggested_tol_map"]
+    assert set(relax) == {i for i, e in err.errors_by_index.items() if e > 1e-12}
+    # feeding the suggestion back must pass
+    errors = check_accuracy_logits(
+        app, golden, hf_model=hf_model,
+        divergence_difference_tol=1e-12, tol_map=relax,
+    )
+    assert len(errors) == golden.shape[1]

@@ -49,6 +49,7 @@ PROMPT = np.array([[5, 9, 3, 17, 2, 8, 11, 42]], dtype=np.int64)
     "tcfg_kwargs",
     [
         pytest.param(dict(sequence_parallel_enabled=True), id="sp"),
+        pytest.param(dict(mlp_cp_degree=8), id="mlp-cp8"),
         pytest.param(dict(cp_degree=2), id="cp2"),
         pytest.param(dict(cp_degree=4), id="cp4"),
         pytest.param(
@@ -175,10 +176,16 @@ def test_dp_sampling_token_matching(tiny_hf_llama):
 
 def test_mlp_cp_degree_validation():
     from nxdi_tpu.config import TpuConfig
+    from nxdi_tpu.parallel.policy import context_encoding_policy
 
-    with pytest.raises(ValueError, match="sequence_parallel"):
-        TpuConfig(tp_degree=8, mlp_cp_degree=2)
-    TpuConfig(tp_degree=8, mlp_cp_degree=2, sequence_parallel_enabled=True)
+    with pytest.raises(ValueError, match="divide"):
+        TpuConfig(tp_degree=8, mlp_cp_degree=3)
+    # without SP the dedicated MLP-CP policy engages (mlp_hidden set)
+    tc = TpuConfig(tp_degree=8, mlp_cp_degree=2)
+    assert context_encoding_policy(tc).mlp_hidden is not None
+    # with SP the whole stream is already S-sharded — subsumed, no extra spec
+    tc_sp = TpuConfig(tp_degree=8, mlp_cp_degree=2, sequence_parallel_enabled=True)
+    assert context_encoding_policy(tc_sp).mlp_hidden is None
 
 
 def test_per_phase_hybrid_moe_token_matching():

@@ -174,8 +174,100 @@ def test_activation_quant_config_validation():
             quantized=True, quantization_dtype="f8e4m3",
             activation_quantization_type="dynamic",
         )
+    with pytest.raises(ValueError):  # static also needs the int8 path
+        TpuConfig(
+            quantized=True, quantization_dtype="f8e4m3",
+            activation_quantization_type="static",
+        )
     with pytest.raises(ValueError):
-        TpuConfig(quantized=True, activation_quantization_type="static")
+        TpuConfig(quantized=True, activation_quantization_type="bogus")
+    # static + int8 is valid; the reference's upper-case spelling normalizes
+    assert (
+        TpuConfig(quantized=True, activation_quantization_type="STATIC")
+        .activation_quantization_type == "static"
+    )
+
+
+def test_static_activation_quant_linear_mechanics():
+    """quantized_linear(act_quant='static') must match the hand computation
+    exactly: round(x/input_scale) clipped, int8 MXU dot, double rescale."""
+    from nxdi_tpu.ops import quantization as q
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((4, 16)).astype(np.float32)
+    w = rng.standard_normal((16, 8)).astype(np.float32)
+    qw, scale = q.quantize_array(w, "int8", "per_channel_symmetric")
+    in_s = np.float32(np.abs(x).max() / 127.0)
+    p = {"qw": jax.numpy.asarray(qw), "scale": jax.numpy.asarray(scale),
+         "input_scale": jax.numpy.asarray(in_s)}
+    actual = np.asarray(q.quantized_linear(jax.numpy.asarray(x), p, act_quant="static"))
+
+    qx = np.clip(np.round(x / in_s), -127, 127).astype(np.int32)
+    expected = (qx @ qw.astype(np.int32)).astype(np.float32) * in_s * scale.squeeze(-2)
+    np.testing.assert_allclose(actual, expected, rtol=1e-6)
+
+
+def test_static_activation_quant_calibrated_e2e(tiny_hf_llama, tmp_path):
+    """dynamic-mode calibration -> static serving: calibrated input scales
+    attach to every quantized linear, the static app generates, and the
+    quantized-artifact round trip preserves the scales exactly."""
+    from nxdi_tpu.ops import quantization as q
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app_dyn = build_app(
+        hf_model, hf_cfg, quantized=True,
+        activation_quantization_type="dynamic",
+    )
+    prompt = np.array([[5, 9, 3, 17, 2, 8]], dtype=np.int64)
+    calib = q.calibrate_app_input_scales(app_dyn, [prompt])
+
+    # every quantized linear gained a positive calibrated scale
+    n_scales = 0
+
+    def count(tree):
+        nonlocal n_scales
+        if isinstance(tree, dict):
+            if "qw" in tree:
+                assert "input_scale" in tree, "uncalibrated quantized linear"
+                assert (np.asarray(tree["input_scale"]) > 0).all()
+                # calibration must have replaced the identity placeholder
+                assert (np.asarray(tree["input_scale"]) != 1.0).any()
+                n_scales += 1
+            else:
+                for v in tree.values():
+                    count(v)
+
+    count(calib)
+    assert n_scales > 0
+
+    class AppS(TpuModelForCausalLM):
+        def build_params(self):
+            return calib
+
+    tcfg = TpuConfig(
+        tp_degree=1, seq_len=64, max_context_length=32, batch_size=1,
+        dtype="float32", on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True, quantized=True,
+        activation_quantization_type="static",
+    )
+    cfg = ml.LlamaInferenceConfig(tcfg, load_config=lambda: hf_cfg.to_dict())
+    app_s = AppS("<memory>", cfg, model_family=ml)
+    app_s.load()
+    out = HuggingFaceGenerationAdapter(app_s).generate(prompt, max_new_tokens=6)
+    assert out.shape == (1, 12)
+    assert (out >= 0).all()
+
+    # artifact round trip: saved scales reload bit-identically and the
+    # offline app generates the same tokens
+    qdir = str(tmp_path / "static_q")
+    app_s.save_quantized_state_dict(qdir)
+    app_off = build_app(
+        hf_model, hf_cfg, quantized=True,
+        activation_quantization_type="static",
+        quantized_checkpoints_path=qdir,
+    )
+    out_b = HuggingFaceGenerationAdapter(app_off).generate(prompt, max_new_tokens=6)
+    np.testing.assert_array_equal(out, out_b)
 
 
 def test_kv_cache_fp8_quant(tiny_hf_llama):

@@ -1,0 +1,54 @@
+"""Module-from-model adapter tests (reference: module_test/
+module_from_model_template/mfm_adapter_base.py)."""
+
+import numpy as np
+
+
+
+def test_module_from_model_mlp_and_layer():
+    """MFM adapters (reference: mfm_adapter_base.py): the extracted MLP and
+    full decoder layer must match the HF submodules bit-for-bit on the same
+    checkpoint weights."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from nxdi_tpu.config import TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.utils.testing import build_module_from_model, validate_accuracy
+
+    torch.manual_seed(0)
+    hf_cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    hf = LlamaForCausalLM(hf_cfg).eval()
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    cfg = ml.LlamaInferenceConfig(
+        TpuConfig(tp_degree=1, seq_len=32, dtype="float32", skip_warmup=True),
+        load_config=lambda: hf_cfg.to_dict(),
+    )
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((1, 8, 64)).astype(np.float32)
+
+    mlp = build_module_from_model(ml, cfg, sd, module="mlp", layer=1)
+    with torch.no_grad():
+        expected = hf.model.layers[1].mlp(torch.tensor(x)).numpy()
+    validate_accuracy(mlp, [(x,)], expected_outputs=[expected], atol=2e-5)
+
+    norm = build_module_from_model(ml, cfg, sd, module="input_layernorm", layer=0)
+    with torch.no_grad():
+        exp_n = hf.model.layers[0].input_layernorm(torch.tensor(x)).numpy()
+    validate_accuracy(norm, [(x,)], expected_outputs=[exp_n], atol=2e-5)
+
+    layer = build_module_from_model(ml, cfg, sd, module="decoder_layer", layer=0)
+    pos = np.arange(8, dtype=np.int32)[None, :]
+    with torch.no_grad():
+        rot = hf.model.rotary_emb(torch.tensor(x), torch.tensor(pos, dtype=torch.long))
+        out_l = hf.model.layers[0](torch.tensor(x), position_embeddings=rot)
+        if isinstance(out_l, tuple):
+            out_l = out_l[0]
+        exp_l = out_l.numpy()
+    validate_accuracy(layer, [(x, pos)], expected_outputs=[exp_l], atol=3e-5)
