@@ -673,9 +673,16 @@ class TpuConfig:
             # without SP this engages the dedicated MLP-CP policy (only the
             # MLP stream shards on S — parallel/policy.py mlp_hidden); with
             # SP the whole inter-layer stream is already S-sharded and the
-            # knob is subsumed
-            if self.tp_degree % self.mlp_cp_degree != 0:
-                raise ValueError("mlp_cp_degree must divide tp_degree")
+            # knob is subsumed. GSPMD shards S over the FULL model-parallel
+            # axis — partial subgroup S-sharding has no mesh sub-axis to
+            # land on, so intermediate degrees are rejected loudly rather
+            # than silently promoted.
+            if self.mlp_cp_degree != self.tp_degree:
+                raise ValueError(
+                    f"mlp_cp_degree ({self.mlp_cp_degree}) must equal "
+                    f"tp_degree ({self.tp_degree}) (or 1): the MLP-CP policy "
+                    "shards the MLP stream's S dim over the whole tp axis"
+                )
         if self.is_medusa and self.num_medusa_heads <= 0:
             raise ValueError("is_medusa requires num_medusa_heads > 0")
         if self.lora_config is not None and self.async_mode:

@@ -352,6 +352,16 @@ def attach_input_scales(
                         np.float32,
                     )
                     return {**tree, "input_scale": scales}
+            # untouched by the calibration traffic (MoE experts consumed via
+            # ragged-dot, linears the sample prompts never reached): keep any
+            # existing scale, else seed the identity placeholder — the static
+            # specs/struct expect input_scale on EVERY quantized linear, so a
+            # missing key would break shard_pytree with a tree mismatch
+            if "input_scale" not in tree:
+                return {
+                    **tree,
+                    "input_scale": np.ones(qw.shape[:-2], np.float32),
+                }
             return tree
         return {k: walk(v) for k, v in tree.items()}
 

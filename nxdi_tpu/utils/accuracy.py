@@ -416,11 +416,17 @@ def generate_with_chunked_prefill(
         raise ValueError("app is not configured for chunked prefill")
     input_ids = np.asarray(input_ids)
     B, S0 = input_ids.shape
+    if S0 + max_new_tokens > tc.seq_len:
+        raise ValueError(
+            f"prompt ({S0}) + max_new_tokens ({max_new_tokens}) exceeds "
+            f"seq_len ({tc.seq_len}); decode positions past seq_len would "
+            "silently clamp into the last KV slot"
+        )
     chunk = tc.chunked_prefill_config.chunk_size
     mgr = BlockSpaceManager(tc.pa_num_blocks, tc.pa_block_size)
     width = -(-tc.seq_len // tc.pa_block_size)
     for sid in range(B):
-        mgr.ensure_capacity(sid, min(S0 + max_new_tokens, tc.seq_len))
+        mgr.ensure_capacity(sid, S0 + max_new_tokens)
     bt = np.stack([mgr.block_table(sid, width) for sid in range(B)])
 
     tok = None

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Kernel flag A/B harness — the win-or-document discipline for every
+optional Pallas kernel at the HEADLINE bench shape (full-depth Llama-3.2-1B,
+bf16, bs32, 2k KV on one chip).
+
+Each optional kernel flag is measured against the XLA fallback at the exact
+configuration `bench.py` scores; results persist to KERNEL_AB.json so the
+repo always carries the CURRENT measured truth for why each flag defaults
+on or off (reference analog: the NKI-vs-compiler strategy decisions in
+modules/attention/attention_base.py:1330-1385 — made there by heuristics,
+made here by measurement).
+
+Usage:
+  python scripts/kernel_ab.py           # decode flags (TKG)
+  python scripts/kernel_ab.py --cte     # prefill: flash kernel + block sweep
+"""
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+B, SEQ, PROMPT = 32, 2048, 1024
+RESULT_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "KERNEL_AB.json"
+)
+
+
+def _build(**flags):
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+
+    tcfg = TpuConfig(
+        tp_degree=1, batch_size=B, seq_len=SEQ, max_context_length=PROMPT,
+        dtype="bfloat16", on_device_sampling_config=OnDeviceSamplingConfig(),
+        async_mode=True, skip_warmup=True, **flags,
+    )
+    cfg = ml.LlamaInferenceConfig(
+        tcfg, hidden_size=2048, intermediate_size=8192, num_hidden_layers=16,
+        num_attention_heads=32, num_key_value_heads=8, head_dim=64,
+        vocab_size=128256, rms_norm_eps=1e-5, rope_theta=500000.0,
+    )
+    rng = np.random.default_rng(0)
+    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
+    state = jtu.tree_map(
+        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16
+        ),
+        struct,
+    )
+
+    class App(TpuModelForCausalLM):
+        def build_params(self):
+            return state
+
+    app = App("<r>", cfg, model_family=ml)
+    app.load()
+    return app, rng
+
+
+def _decode_ms(app, rng):
+    from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
+
+    prompt = rng.integers(0, 32000, size=(B, PROMPT)).astype(np.int32)
+    pos = np.tile(np.arange(PROMPT, dtype=np.int32), (B, 1))
+    out = app.forward(prompt, pos, last_token_index=np.full((B,), PROMPT - 1, np.int32))
+    np.asarray(out["tokens"])
+    w = app.models[TAG_TOKEN_GENERATION]
+    nxt = out["next_inputs"]
+    for _ in range(20):
+        out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, SEQ)
+        nxt = out["next_inputs"]
+    np.asarray(out["tokens"])
+    per = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(100):
+            out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, SEQ)
+            nxt = out["next_inputs"]
+        np.asarray(out["tokens"])
+        per.append((time.perf_counter() - t0) * 1000.0 / 100)
+    return round(float(np.percentile(per, 50)), 3)
+
+
+def _cte_ms(app, rng):
+    prompt = rng.integers(0, 32000, size=(B, PROMPT)).astype(np.int32)
+    pos = np.tile(np.arange(PROMPT, dtype=np.int32), (B, 1))
+    lti = np.full((B,), PROMPT - 1, np.int32)
+    out = app.forward(prompt, pos, last_token_index=lti)
+    np.asarray(out["tokens"])
+    times = []
+    for _ in range(6):
+        t0 = time.perf_counter()
+        out = app.forward(prompt, pos, last_token_index=lti)
+        np.asarray(out["tokens"])
+        times.append((time.perf_counter() - t0) * 1000.0)
+    return round(float(np.percentile(times, 50)), 2)
+
+
+def _record(results):
+    old = {}
+    if os.path.exists(RESULT_PATH):
+        with open(RESULT_PATH) as f:
+            old = json.load(f)
+    old.update(results)
+    with open(RESULT_PATH, "w") as f:
+        json.dump(old, f, indent=2, sort_keys=True)
+    print(json.dumps(results))
+
+
+def run_decode_ab():
+    results = {}
+    variants = [
+        ("tkg_xla_baseline", dict(attn_kernel_enabled=True, fused_qkv=True)),
+        ("tkg_fused_qkv_off", dict(attn_kernel_enabled=True)),
+        ("tkg_attn_tkg_kernel", dict(attn_kernel_enabled=True, fused_qkv=True,
+                                     attn_tkg_kernel_enabled=True)),
+        ("tkg_mlp_kernel", dict(attn_kernel_enabled=True, fused_qkv=True,
+                                mlp_kernel_enabled=True)),
+        ("tkg_qkv_kernel", dict(attn_kernel_enabled=True, fused_qkv=True,
+                                qkv_kernel_enabled=True)),
+    ]
+    for name, flags in variants:
+        try:
+            app, rng = _build(**flags)
+            results[name + "_ms"] = _decode_ms(app, rng)
+        except Exception as e:  # noqa: BLE001
+            results[name + "_err"] = str(e)[:160]
+        print(f"[{name}] {results.get(name + '_ms', 'ERR')}",
+              file=sys.stderr, flush=True)
+        try:
+            del app
+        except NameError:
+            pass
+        gc.collect()
+    _record(results)
+
+
+def run_cte_ab():
+    results = {}
+    for name, env_q, env_k, flags in [
+        ("cte_xla", None, None, dict(fused_qkv=True)),
+        ("cte_flash_512", "512", "512", dict(attn_kernel_enabled=True, fused_qkv=True)),
+        ("cte_flash_1024_512", "1024", "512",
+         dict(attn_kernel_enabled=True, fused_qkv=True)),
+        ("cte_flash_512_1024", "512", "1024",
+         dict(attn_kernel_enabled=True, fused_qkv=True)),
+        ("cte_flash_256", "256", "256", dict(attn_kernel_enabled=True, fused_qkv=True)),
+        ("cte_flash_512_nofq", "512", "512", dict(attn_kernel_enabled=True)),
+    ]:
+        for var, val in (("NXDI_TPU_PREFILL_BLOCK_Q", env_q),
+                         ("NXDI_TPU_PREFILL_BLOCK_K", env_k)):
+            if val is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = val
+        try:
+            app, rng = _build(**flags)
+            results[name + "_ms"] = _cte_ms(app, rng)
+        except Exception as e:  # noqa: BLE001
+            results[name + "_err"] = str(e)[:160]
+        print(f"[{name}] {results.get(name + '_ms', 'ERR')}",
+              file=sys.stderr, flush=True)
+        try:
+            del app
+        except NameError:
+            pass
+        gc.collect()
+    _record(results)
+
+
+if __name__ == "__main__":
+    if "--cte" in sys.argv:
+        run_cte_ab()
+    else:
+        run_decode_ab()

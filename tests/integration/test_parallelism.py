@@ -178,13 +178,13 @@ def test_mlp_cp_degree_validation():
     from nxdi_tpu.config import TpuConfig
     from nxdi_tpu.parallel.policy import context_encoding_policy
 
-    with pytest.raises(ValueError, match="divide"):
-        TpuConfig(tp_degree=8, mlp_cp_degree=3)
+    with pytest.raises(ValueError, match="must equal"):
+        TpuConfig(tp_degree=8, mlp_cp_degree=2)  # partial degrees rejected
     # without SP the dedicated MLP-CP policy engages (mlp_hidden set)
-    tc = TpuConfig(tp_degree=8, mlp_cp_degree=2)
+    tc = TpuConfig(tp_degree=8, mlp_cp_degree=8)
     assert context_encoding_policy(tc).mlp_hidden is not None
     # with SP the whole stream is already S-sharded — subsumed, no extra spec
-    tc_sp = TpuConfig(tp_degree=8, mlp_cp_degree=2, sequence_parallel_enabled=True)
+    tc_sp = TpuConfig(tp_degree=8, mlp_cp_degree=8, sequence_parallel_enabled=True)
     assert context_encoding_policy(tc_sp).mlp_hidden is None
 
 
