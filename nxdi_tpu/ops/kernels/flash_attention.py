@@ -164,17 +164,20 @@ def flash_attention_prefill(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
 ):
-    """512x512 default blocks: at 128x128 the (B*H, Sq/bq, Sk/bk) grid hits
+    """512x1024 default blocks: at 128x128 the (B*H, Sq/bq, Sk/bk) grid hits
     ~65k steps/layer at prefill shapes and per-step overhead dominated the
     kernel (xprof: 30 ms/layer vs ~11 ms of FLOPs; 512x512 measured ~3x
-    faster end to end on v5e). NXDI_TPU_PREFILL_BLOCK_Q/_K override for
-    on-chip retuning (scripts/cte_probe.py)."""
+    faster end to end on v5e). The round-5 sweep (scripts/kernel_ab.py --cte,
+    KERNEL_AB.json) widened K: 512x1024 measured 683 vs 770 ms at the bench
+    prefill (bs32 x 1024) — fewer KV-stream restarts per Q block; 256x256
+    and 1024x512 both lose. NXDI_TPU_PREFILL_BLOCK_Q/_K override for
+    on-chip retuning."""
     import os
 
     if block_q is None:
         block_q = int(os.environ.get("NXDI_TPU_PREFILL_BLOCK_Q", "512"))
     if block_k is None:
-        block_k = int(os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "512"))
+        block_k = int(os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "1024"))
     B, H, Sq, D = q.shape
     KV, Sk = k.shape[1], k.shape[2]
     G = H // KV

@@ -81,7 +81,7 @@ def main():
         print(json.dumps({
             "cte_kernel_ms": round(cte_kernel, 1),
             "block_q": os.environ.get("NXDI_TPU_PREFILL_BLOCK_Q", "512"),
-            "block_k": os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "512"),
+            "block_k": os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "1024"),
         }))
         return
     cte_kernel = run_cte(True)
