@@ -46,6 +46,12 @@ def _interpret() -> bool:
     return jax.devices()[0].platform != "tpu"
 
 
+# single source of truth for the prefill block defaults (cte_probe and the
+# A/B harness report these; keep env names in sync)
+DEFAULT_PREFILL_BLOCK_Q = 512
+DEFAULT_PREFILL_BLOCK_K = 1024
+
+
 def _pick_block(s: int, target: int) -> int:
     b = min(target, s)
     while s % b:
@@ -175,9 +181,13 @@ def flash_attention_prefill(
     import os
 
     if block_q is None:
-        block_q = int(os.environ.get("NXDI_TPU_PREFILL_BLOCK_Q", "512"))
+        block_q = int(
+            os.environ.get("NXDI_TPU_PREFILL_BLOCK_Q", DEFAULT_PREFILL_BLOCK_Q)
+        )
     if block_k is None:
-        block_k = int(os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "1024"))
+        block_k = int(
+            os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", DEFAULT_PREFILL_BLOCK_K)
+        )
     B, H, Sq, D = q.shape
     KV, Sk = k.shape[1], k.shape[2]
     G = H // KV

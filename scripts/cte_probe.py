@@ -77,11 +77,20 @@ def main():
     if "--kernel-only" in sys.argv:
         import os
 
+        from nxdi_tpu.ops.kernels.flash_attention import (
+            DEFAULT_PREFILL_BLOCK_K,
+            DEFAULT_PREFILL_BLOCK_Q,
+        )
+
         cte_kernel = run_cte(True)
         print(json.dumps({
             "cte_kernel_ms": round(cte_kernel, 1),
-            "block_q": os.environ.get("NXDI_TPU_PREFILL_BLOCK_Q", "512"),
-            "block_k": os.environ.get("NXDI_TPU_PREFILL_BLOCK_K", "1024"),
+            "block_q": os.environ.get(
+                "NXDI_TPU_PREFILL_BLOCK_Q", str(DEFAULT_PREFILL_BLOCK_Q)
+            ),
+            "block_k": os.environ.get(
+                "NXDI_TPU_PREFILL_BLOCK_K", str(DEFAULT_PREFILL_BLOCK_K)
+            ),
         }))
         return
     cte_kernel = run_cte(True)

@@ -6,77 +6,24 @@ step-time delta with the bench chain discipline. Measured on v5e:
   base 8.856 ms | kv1024 7.924 (KV reads ~85-100% of bw) |
   v32k 8.38 (lm_head ~100%) | mlp4096 8.12 (MLP stream ~56%).
 One JSON line."""
+import gc
 import json
+import os
 import sys
-import time
 
-import numpy as np
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+from _bench import build_random_app, median_chain_ms  # noqa: E402
 
 
-def run_cfg(label, seq_len, vocab, res):
-    import gc
-
-    import jax.tree_util as jtu
-    import ml_dtypes
-
-    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
-    from nxdi_tpu.models.llama import modeling_llama as ml
-    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
-    from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
-
-    B = 32
-    PROMPT = min(1024, seq_len // 2)
-    tcfg = TpuConfig(
-        tp_degree=1, batch_size=B, seq_len=seq_len, max_context_length=PROMPT,
-        dtype="bfloat16", on_device_sampling_config=OnDeviceSamplingConfig(),
-        async_mode=True, attn_kernel_enabled=True, fused_qkv=True,
-        skip_warmup=True,
+def run_cfg(label, seq_len, vocab, res, inter=8192, layers=16):
+    app, _, _, _ = build_random_app(
+        seq_len=seq_len, prompt_len=min(1024, seq_len // 2),
+        vocab=vocab, inter=inter, layers=layers,
     )
-    cfg = ml.LlamaInferenceConfig(
-        tcfg, hidden_size=2048, intermediate_size=8192, num_hidden_layers=16,
-        num_attention_heads=32, num_key_value_heads=8, head_dim=64,
-        vocab_size=vocab, rms_norm_eps=1e-5, rope_theta=500000.0,
-    )
-    rng = np.random.default_rng(0)
-    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
-    state = jtu.tree_map(
-        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
-            ml_dtypes.bfloat16
-        ),
-        struct,
-    )
-
-    class App(TpuModelForCausalLM):
-        def build_params(self):
-            return state
-
-    app = App("<r>", cfg, model_family=ml)
-    app.load()
-    prompt = rng.integers(0, 32000 if vocab > 32000 else vocab - 1,
-                          size=(B, PROMPT)).astype(np.int32)
-    pos = np.tile(np.arange(PROMPT, dtype=np.int32), (B, 1))
-    out = app.forward(prompt, pos, last_token_index=np.full((B,), PROMPT - 1, np.int32))
-    np.asarray(out["tokens"])
-
-    nxt = out["next_inputs"]
-    w = app.models[TAG_TOKEN_GENERATION]
-    for _ in range(20):
-        out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, seq_len)
-        nxt = out["next_inputs"]
-    np.asarray(out["tokens"])
-    per = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(100):
-            out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, seq_len)
-            nxt = out["next_inputs"]
-        np.asarray(out["tokens"])
-        per.append((time.perf_counter() - t0) * 1000.0 / 100)
-    res[label] = round(float(np.percentile(per, 50)), 3)
-    print(f"[{label}] {res[label]} ms", file=sys.stderr, flush=True)
-    del app, state, out, nxt
+    res[label] = median_chain_ms(app, seq_len, label=label)
+    del app
     gc.collect()
 
 
@@ -85,6 +32,8 @@ def main():
     run_cfg("base_kv2048_v128k", 2048, 128256, res)
     run_cfg("kv1024_v128k", 1024, 128256, res)
     run_cfg("kv2048_v32k", 2048, 32064, res)
+    run_cfg("mlp4096", 2048, 128256, res, inter=4096)
+    run_cfg("layers8", 2048, 128256, res, layers=8)
     print(json.dumps(res))
 
 

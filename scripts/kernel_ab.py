@@ -22,6 +22,7 @@ import time
 
 import numpy as np
 
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 B, SEQ, PROMPT = 32, 2048, 1024
@@ -31,71 +32,27 @@ RESULT_PATH = os.path.join(
 
 
 def _build(**flags):
-    import jax.tree_util as jtu
-    import ml_dtypes
+    """Headline-shape app via the shared harness; kernel flags default OFF
+    here (each variant states its full flag set explicitly)."""
+    from _bench import build_random_app
 
-    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
-    from nxdi_tpu.models.llama import modeling_llama as ml
-    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
-
-    tcfg = TpuConfig(
-        tp_degree=1, batch_size=B, seq_len=SEQ, max_context_length=PROMPT,
-        dtype="bfloat16", on_device_sampling_config=OnDeviceSamplingConfig(),
-        async_mode=True, skip_warmup=True, **flags,
+    app, rng, prompt, pos = build_random_app(
+        batch=B, seq_len=SEQ, prompt_len=PROMPT,
+        **{"attn_kernel_enabled": None, "fused_qkv": False, **flags},
     )
-    cfg = ml.LlamaInferenceConfig(
-        tcfg, hidden_size=2048, intermediate_size=8192, num_hidden_layers=16,
-        num_attention_heads=32, num_key_value_heads=8, head_dim=64,
-        vocab_size=128256, rms_norm_eps=1e-5, rope_theta=500000.0,
-    )
-    rng = np.random.default_rng(0)
-    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
-    state = jtu.tree_map(
-        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
-            ml_dtypes.bfloat16
-        ),
-        struct,
-    )
-
-    class App(TpuModelForCausalLM):
-        def build_params(self):
-            return state
-
-    app = App("<r>", cfg, model_family=ml)
-    app.load()
+    app._probe_prompt = (prompt, pos)
     return app, rng
 
 
 def _decode_ms(app, rng):
-    from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
+    from _bench import median_chain_ms
 
-    prompt = rng.integers(0, 32000, size=(B, PROMPT)).astype(np.int32)
-    pos = np.tile(np.arange(PROMPT, dtype=np.int32), (B, 1))
-    out = app.forward(prompt, pos, last_token_index=np.full((B,), PROMPT - 1, np.int32))
-    np.asarray(out["tokens"])
-    w = app.models[TAG_TOKEN_GENERATION]
-    nxt = out["next_inputs"]
-    for _ in range(20):
-        out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, SEQ)
-        nxt = out["next_inputs"]
-    np.asarray(out["tokens"])
-    per = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(100):
-            out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, SEQ)
-            nxt = out["next_inputs"]
-        np.asarray(out["tokens"])
-        per.append((time.perf_counter() - t0) * 1000.0 / 100)
-    return round(float(np.percentile(per, 50)), 3)
+    return median_chain_ms(app, SEQ)
 
 
 def _cte_ms(app, rng):
-    prompt = rng.integers(0, 32000, size=(B, PROMPT)).astype(np.int32)
-    pos = np.tile(np.arange(PROMPT, dtype=np.int32), (B, 1))
+    prompt, pos = app._probe_prompt
     lti = np.full((B,), PROMPT - 1, np.int32)
-    out = app.forward(prompt, pos, last_token_index=lti)
-    np.asarray(out["tokens"])
     times = []
     for _ in range(6):
         t0 = time.perf_counter()

@@ -1,84 +1,35 @@
 #!/usr/bin/env python
 """Multi-step decode probe: K decode steps fused into ONE dispatched program
-(lax.scan over the device-resident step) vs the per-step chain. If the
-per-step chain carries fixed dispatch overhead, the fused program's ms/step
-drops toward the HBM roofline. One JSON line."""
+(lax.scan over the device-resident step) vs the per-step chain; measured
+8.909 vs 8.385 ms/step on v5e — dispatch overhead is NOT the decode gap
+(the async chain already pipelines dispatches). One JSON line."""
 import json
+import os
 import sys
 import time
 
 import numpy as np
 
-sys.path.insert(0, __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _bench import build_random_app, median_chain_ms  # noqa: E402
+
+SEQ = 2048
 
 
 def main():
     import jax
     import jax.numpy as jnp
-    import jax.tree_util as jtu
-    import ml_dtypes
 
-    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
-    from nxdi_tpu.models.llama import modeling_llama as ml
-    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
     from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
 
-    B, SEQ, PROMPT = 32, 2048, 1024
-    tcfg = TpuConfig(
-        tp_degree=1, batch_size=B, seq_len=SEQ, max_context_length=PROMPT,
-        dtype="bfloat16", on_device_sampling_config=OnDeviceSamplingConfig(),
-        async_mode=True, attn_kernel_enabled=True, fused_qkv=True,
-        skip_warmup=True,
-    )
-    cfg = ml.LlamaInferenceConfig(
-        tcfg, hidden_size=2048, intermediate_size=8192, num_hidden_layers=16,
-        num_attention_heads=32, num_key_value_heads=8, head_dim=64,
-        vocab_size=128256, rms_norm_eps=1e-5, rope_theta=500000.0,
-    )
-    rng = np.random.default_rng(0)
-    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
-    state = jtu.tree_map(
-        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
-            ml_dtypes.bfloat16
-        ),
-        struct,
-    )
+    app, _, _, _ = build_random_app(seq_len=SEQ)
+    res = {"per_step_chain_ms": median_chain_ms(app, SEQ, label="chain")}
 
-    class App(TpuModelForCausalLM):
-        def build_params(self):
-            return state
-
-    app = App("<r>", cfg, model_family=ml)
-    app.load()
-    prompt = rng.integers(0, 32000, size=(B, PROMPT)).astype(np.int32)
-    pos = np.tile(np.arange(PROMPT, dtype=np.int32), (B, 1))
-    out = app.forward(prompt, pos, last_token_index=np.full((B,), PROMPT - 1, np.int32))
-    np.asarray(out["tokens"])
-
-    w = app.models[TAG_TOKEN_GENERATION]
-    res = {}
-
-    # --- baseline: per-step chain ---
-    nxt = out["next_inputs"]
-    for _ in range(20):
-        out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, SEQ)
-        nxt = out["next_inputs"]
-    np.asarray(out["tokens"])
-    per = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(100):
-            out, app.kv_cache = w.forward_device(app.params, app.kv_cache, nxt, SEQ)
-            nxt = out["next_inputs"]
-        np.asarray(out["tokens"])
-        per.append((time.perf_counter() - t0) * 1000.0 / 100)
-    res["per_step_chain_ms"] = round(float(np.percentile(per, 50)), 3)
-    print(f"[chain] {res['per_step_chain_ms']}", file=sys.stderr, flush=True)
-
-    # --- fused K-step program ---
     K = 16
-    bucket = w.buckets[-1]
-    fn = w.make_forward(bucket)
+    w = app.models[TAG_TOKEN_GENERATION]
+    fn = w.make_forward(w.buckets[-1])
 
     def k_steps(params, cache, batch):
         def step(carry, _):
@@ -86,23 +37,13 @@ def main():
             outs, cache = fn(params, cache, batch)
             return (cache, outs["next_inputs"]), outs["tokens"]
 
-        (cache, batch), tokens = jax.lax.scan(
-            step, (cache, batch), None, length=K
-        )
+        (cache, batch), tokens = jax.lax.scan(step, (cache, batch), None, length=K)
         return tokens, cache, batch
 
-    from jax.experimental.layout import Format, Layout
-
-    auto = jtu.tree_map(lambda _: Format(Layout.AUTO), app.kv_cache)
-    fused = jax.jit(
-        k_steps,
-        in_shardings=(None, jtu.tree_map(lambda _: None, auto), None),
-        donate_argnums=(1,),
-    )
-    # strip device batch to the step signature
+    fused = jax.jit(k_steps, donate_argnums=(1,))
+    nxt = app._probe_first_out["next_inputs"]
     batch = {k: jnp.asarray(v) for k, v in nxt.items()}
-    tokens, cache2, batch = fused(app.params, app.kv_cache, batch)
-    app.kv_cache = cache2
+    tokens, app.kv_cache, batch = fused(app.params, app.kv_cache, batch)
     np.asarray(tokens)
     per = []
     for _ in range(3):
@@ -112,7 +53,6 @@ def main():
         np.asarray(tokens)
         per.append((time.perf_counter() - t0) * 1000.0 / (K * (100 // K)))
     res["fused_k16_ms_per_step"] = round(float(np.percentile(per, 50)), 3)
-    print(f"[fused] {res['fused_k16_ms_per_step']}", file=sys.stderr, flush=True)
     print(json.dumps(res))
 
 

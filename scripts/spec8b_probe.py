@@ -1,0 +1,203 @@
+#!/usr/bin/env python
+"""Fused-speculation window cost at the scale where speculation PAYS:
+Llama-3.1-8B-geometry int8 target + 1B-geometry int8 draft (a real ~6.5x
+parameter ratio), bs1. Reports the measured window cost and the break-even
+accept length (window_ms / non-spec 8B step) — any trained draft retiring
+more tokens per window than that wins. Random weights give chance-level
+acceptance between the two models, so acceptance itself is NOT claimed;
+the machinery cost is. Writes SPEC8B.json; one JSON line.
+
+Weights are generated DIRECTLY as random int8 + scales (the float->quantize
+pipeline costs 20+ min of host time for 8B and adds nothing to a random
+bench)."""
+import gc
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+VOCAB = 128256
+SEQ = 1024
+
+
+def rand_quantized(struct_q, rng):
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    def one(s):
+        if s.dtype == np.int8:
+            return rng.integers(-127, 128, size=s.shape, dtype=np.int8)
+        if np.dtype(s.dtype) == np.dtype(np.float32) and s.shape and s.shape[-2:-1] == (1,):
+            # quant scales: small positive
+            return (rng.random(s.shape, dtype=np.float32) * 1e-3 + 1e-4).astype(np.float32)
+        return (rng.standard_normal(s.shape).astype(np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16 if s.dtype == ml_dtypes.bfloat16 else s.dtype
+        )
+
+    return jtu.tree_map(one, struct_q)
+
+
+def main():
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+
+    from nxdi_tpu.config import (
+        OnDeviceSamplingConfig,
+        SpeculationConfig,
+        TpuConfig,
+    )
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import (
+        maybe_quantize_struct,
+        params_shape_struct,
+        TpuModelForCausalLM,
+    )
+    from nxdi_tpu.runtime.model_wrapper import (
+        TAG_FUSED_SPECULATION,
+        TAG_TOKEN_GENERATION,
+    )
+    from nxdi_tpu.speculation import FusedSpecCausalLM
+
+    t_start = time.time()
+
+    def mark(msg):
+        print(f"[spec8b +{time.time()-t_start:5.0f}s] {msg}", file=sys.stderr, flush=True)
+
+    def tcfg(batch=1, spec=None, quant=True):
+        kw = dict(
+            tp_degree=1, batch_size=batch, seq_len=SEQ, max_context_length=256,
+            dtype="bfloat16", on_device_sampling_config=OnDeviceSamplingConfig(),
+            async_mode=True, attn_kernel_enabled=True, fused_qkv=True,
+            skip_warmup=True,
+        )
+        if quant:
+            kw.update(quantized=True, quantization_dtype="int8",
+                      quantization_type="per_channel_symmetric")
+        if spec:
+            kw["speculation_config"] = spec
+        return TpuConfig(**kw)
+
+    def cfg_8b(tc):
+        return ml.LlamaInferenceConfig(
+            tc, hidden_size=4096, intermediate_size=14336,
+            num_hidden_layers=32, num_attention_heads=32,
+            num_key_value_heads=8, head_dim=128, vocab_size=VOCAB,
+            rms_norm_eps=1e-5, rope_theta=500000.0,
+        )
+
+    def cfg_1b(tc):
+        return ml.LlamaInferenceConfig(
+            tc, hidden_size=2048, intermediate_size=8192,
+            num_hidden_layers=16, num_attention_heads=32,
+            num_key_value_heads=8, head_dim=64, vocab_size=VOCAB,
+            rms_norm_eps=1e-5, rope_theta=500000.0,
+        )
+
+    rng = np.random.default_rng(0)
+    tc_t = tcfg()
+    c_t = cfg_8b(tc_t)
+    struct_t = maybe_quantize_struct(
+        params_shape_struct(ml, c_t, ml.build_arch(c_t)), tc_t
+    )
+    target = rand_quantized(struct_t, rng)
+    mark("8B int8 target built")
+    tc_d = tcfg()
+    c_d = cfg_1b(tc_d)
+    struct_d = maybe_quantize_struct(
+        params_shape_struct(ml, c_d, ml.build_arch(c_d)), tc_d
+    )
+    draft = rand_quantized(struct_d, rng)
+    mark("1B int8 draft built")
+
+    # --- non-spec 8B bs1 step (the latency baseline) ---
+    class App8(TpuModelForCausalLM):
+        def build_params(self):
+            return target
+
+    app8 = App8("<r>", c_t, model_family=ml)
+    app8.load()
+    prompt = rng.integers(0, 32000, size=(1, 256)).astype(np.int32)
+    pos = np.tile(np.arange(256, dtype=np.int32), (1, 1))
+    out = app8.forward(prompt, pos, last_token_index=np.array([255], np.int32))
+    np.asarray(out["tokens"])
+    mark("8B CTE done")
+    w = app8.models[TAG_TOKEN_GENERATION]
+    nxt = out["next_inputs"]
+    for _ in range(10):
+        out, app8.kv_cache = w.forward_device(app8.params, app8.kv_cache, nxt, SEQ)
+        nxt = out["next_inputs"]
+    np.asarray(out["tokens"])
+    per = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(50):
+            out, app8.kv_cache = w.forward_device(app8.params, app8.kv_cache, nxt, SEQ)
+            nxt = out["next_inputs"]
+        np.asarray(out["tokens"])
+        per.append((time.perf_counter() - t0) * 1000.0 / 50)
+    base_ms = float(np.percentile(per, 50))
+    mark(f"8B non-spec {base_ms:.2f} ms/tok")
+    del app8, out, nxt
+    gc.collect()
+
+    # --- fused spec: 8B target + 1B draft, spec_len 3 ---
+    spec_len = 3
+    tc_s = tcfg(spec=SpeculationConfig(
+        speculation_length=spec_len, enable_fused_speculation=True))
+    c_s = cfg_8b(tc_s)
+    c_ds = cfg_1b(tcfg())
+
+    class SpecApp(FusedSpecCausalLM):
+        def build_params(self):
+            return {"draft": draft, "target": target}
+
+    sp = SpecApp("<t>", c_s, "<d>", c_ds, model_family=ml)
+    sp.load()
+    out_s = sp.forward(prompt[:, :128], pos[:, :128],
+                       last_token_index=np.array([127], np.int32))
+    first = np.asarray(out_s["tokens"])[:, :1].astype(np.int32)
+    mark("spec CTE done")
+    ws = sp.models[TAG_FUSED_SPECULATION]
+    nxt = {
+        "input_ids": jnp.asarray(first),
+        "position_ids": jnp.full((1, 1), 128, jnp.int32),
+        "last_token_index": jnp.zeros((1,), jnp.int32),
+        "sampling_params": jnp.ones((1, 3), jnp.float32),
+    }
+    for _ in range(8):
+        out_s, sp.kv_cache = ws.forward_device(sp.params, sp.kv_cache, nxt, SEQ)
+        nxt = out_s["next_inputs"]
+    np.asarray(out_s["tokens"])
+    mark("spec warm")
+    counts = jnp.zeros((1,), jnp.int32)
+    n_win = 60
+    t0 = time.perf_counter()
+    for _ in range(n_win):
+        out_s, sp.kv_cache = ws.forward_device(sp.params, sp.kv_cache, nxt, SEQ)
+        counts = counts + out_s["counts"]
+        nxt = out_s["next_inputs"]
+    total = int(np.asarray(counts).sum())
+    window_ms = (time.perf_counter() - t0) * 1000.0 / n_win
+    rec = {
+        "target": "llama3.1-8b-geometry int8 bs1 kv1024 tp1",
+        "draft": "llama3.2-1b-geometry int8 (6.5x smaller)",
+        "nonspec_8b_bs1_tok_ms": round(base_ms, 3),
+        "spec8b_window_ms": round(window_ms, 3),
+        "spec8b_breakeven_accept": round(window_ms / base_ms, 2),
+        "spec8b_max_retirable": spec_len + 1,
+        "measured_accept_random_weights": round(total / n_win, 2),
+        "spec_len": spec_len,
+    }
+    side = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                        "SPEC8B.json")
+    with open(side, "w") as f:
+        json.dump(rec, f)
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
