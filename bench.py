@@ -310,6 +310,25 @@ def main():
             "--bs1-only; draft = first 4 of 16 layers, int8)"
         )
 
+    # --- multi-step decode line: measured by `python bench.py
+    # --decode-steps-per-dispatch K` (one extra app build + K-ladder compile),
+    # cached in BENCH_MULTISTEP.json and folded in with a source label ---
+    ms_per_tok_multistep = ms_multistep_k = ms_multistep_chain = None
+    ms_source = None
+    side_ms = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_MULTISTEP.json"
+    )
+    if os.path.exists(side_ms):
+        with open(side_ms) as f:
+            msrec = json.load(f)
+        ms_per_tok_multistep = msrec["tkg_multistep_ms_per_token"]
+        ms_multistep_k = msrec["decode_steps_per_dispatch"]
+        ms_multistep_chain = msrec["per_step_chain_ms"]
+        ms_source = (
+            "cached BENCH_MULTISTEP.json (measured on this chip by bench.py "
+            "--decode-steps-per-dispatch)"
+        )
+
     # --- 8B-int8 single-chip line: measured by `python bench.py --8b-only`
     # (the 32-layer compile + 8 GiB weight build/transfer takes >30 min — too
     # slow to repeat inside the default bench), cached in BENCH_8B.json and
@@ -373,6 +392,13 @@ def main():
                 "spec_bs1_window_ms": spec_bs1_window_ms,
                 "spec_bs1_breakeven_accept": spec_bs1_breakeven,
                 "bs1_source": bs1_source,
+                # multi-step decode (tkg_multistep submodel, cached
+                # BENCH_MULTISTEP.json): per-RETIRED-token ms when K decode
+                # steps run in ONE compiled program vs the 1-step chain
+                "tkg_multistep_ms_per_token": ms_per_tok_multistep,
+                "tkg_multistep_k": ms_multistep_k,
+                "tkg_multistep_vs_chain_ms": ms_multistep_chain,
+                "tkg_multistep_source": ms_source,
                 # Llama-3.1-8B geometry, int8 weights, one chip, bs16, 2k KV
                 # None when BENCH_8B.json is absent (run bench.py --8b-only)
                 "config_8b": cfg_8b_label,
@@ -664,10 +690,121 @@ def main_bs1_only():
     print(json.dumps(rec))
 
 
+def main_multistep(k: int):
+    """Measure the ``tkg_multistep`` K-steps-per-dispatch decode line against
+    the 1-step device-resident chain on the SAME app (both submodels compile
+    side by side when decode_steps_per_dispatch > 1) and cache it in
+    BENCH_MULTISTEP.json."""
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+    from nxdi_tpu.runtime.model_wrapper import (
+        MULTISTEP_EOS_SLOTS,
+        TAG_TOKEN_GENERATION,
+    )
+
+    tcfg = TpuConfig(
+        tp_degree=1, batch_size=BATCH, seq_len=SEQ_LEN,
+        max_context_length=PROMPT_LEN, dtype="bfloat16",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        async_mode=True, attn_kernel_enabled=True, fused_qkv=True,
+        skip_warmup=True, decode_steps_per_dispatch=k,
+    )
+    cfg = ml.LlamaInferenceConfig(
+        tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
+        num_hidden_layers=N_LAYERS, num_attention_heads=N_HEADS,
+        num_key_value_heads=N_KV_HEADS, head_dim=HEAD_DIM,
+        vocab_size=VOCAB, rms_norm_eps=1e-5, rope_theta=500000.0,
+    )
+    rng = np.random.default_rng(0)
+    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
+    state = jtu.tree_map(
+        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16
+        ),
+        struct,
+    )
+
+    class App(TpuModelForCausalLM):
+        def build_params(self):
+            return state
+
+    app = App("<random>", cfg, model_family=ml)
+    app.load()
+    prompt = rng.integers(0, 32000, size=(BATCH, PROMPT_LEN)).astype(np.int32)
+    pos = np.tile(np.arange(PROMPT_LEN, dtype=np.int32), (BATCH, 1))
+    out = app.forward(
+        prompt, pos, last_token_index=np.full((BATCH,), PROMPT_LEN - 1, np.int32)
+    )
+    np.asarray(out["tokens"])
+
+    # 1-step device-resident chain (the bench.py discipline)
+    w1 = app.models[TAG_TOKEN_GENERATION]
+    nxt = out["next_inputs"]
+    o = out
+    for _ in range(20):
+        o, app.kv_cache = w1.forward_device(app.params, app.kv_cache, nxt, SEQ_LEN)
+        nxt = o["next_inputs"]
+    np.asarray(o["tokens"])
+    per = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(100):
+            o, app.kv_cache = w1.forward_device(app.params, app.kv_cache, nxt, SEQ_LEN)
+            nxt = o["next_inputs"]
+        np.asarray(o["tokens"])
+        per.append((time.perf_counter() - t0) * 1000.0 / 100)
+    chain_ms = float(np.percentile(per, 50))
+    print(f"[multistep] 1-step chain {chain_ms:.3f} ms/tok", file=sys.stderr, flush=True)
+
+    # K-step windows: same device-resident discipline, one fetch per rep
+    dev_batch = dict(nxt)
+    dev_batch["eos_token_ids"] = jnp.full(
+        (BATCH, MULTISTEP_EOS_SLOTS), -1, jnp.int32
+    )
+    dev_batch["pad_token_id"] = jnp.zeros((BATCH,), jnp.int32)
+    o = app.token_gen_multistep_device(dev_batch, SEQ_LEN, steps=k)
+    np.asarray(o["tokens"])
+    nxt = o["next_inputs"]
+    for _ in range(max(1, 20 // k)):
+        o = app.token_gen_multistep_device(nxt, SEQ_LEN, steps=k)
+        nxt = o["next_inputs"]
+    np.asarray(o["tokens"])
+    n_win = max(1, 100 // k)
+    per = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_win):
+            o = app.token_gen_multistep_device(nxt, SEQ_LEN, steps=k)
+            nxt = o["next_inputs"]
+        np.asarray(o["tokens"])
+        per.append((time.perf_counter() - t0) * 1000.0 / (n_win * k))
+    multi_ms = float(np.percentile(per, 50))
+    rec = {
+        "decode_steps_per_dispatch": k,
+        "tkg_multistep_ms_per_token": round(multi_ms, 3),
+        "per_step_chain_ms": round(chain_ms, 3),
+        "config": f"llama3.2-1b full {N_LAYERS}L bf16 bs{BATCH} kv{SEQ_LEN} tp1",
+    }
+    side = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_MULTISTEP.json"
+    )
+    with open(side, "w") as f:
+        json.dump(rec, f)
+    print(json.dumps(rec))
+
+
 if __name__ == "__main__":
     if "--8b-only" in sys.argv:
         main_8b_only()
     elif "--bs1-only" in sys.argv:
         main_bs1_only()
+    elif "--decode-steps-per-dispatch" in sys.argv:
+        idx = sys.argv.index("--decode-steps-per-dispatch")
+        main_multistep(int(sys.argv[idx + 1]))
     else:
         main()

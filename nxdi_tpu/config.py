@@ -342,6 +342,16 @@ class TpuConfig:
         # flag controls explicit double-buffered dispatch in the generation loop.
         self.async_mode = kwargs.pop("async_mode", False)
 
+        # --- multi-step decode dispatch: ONE compiled program runs K token-
+        # generation steps (sample -> embed -> layer stack -> KV commit chained
+        # via lax.scan) per host dispatch, so the per-dispatch weight stream
+        # amortizes over K tokens ("Kernel Looping" / ClusterFusion-style
+        # collapse of per-step dispatch boundaries; see models/base.py
+        # multi_step_token_gen). 1 = classic one-dispatch-per-token decode.
+        self.decode_steps_per_dispatch = int(
+            kwargs.pop("decode_steps_per_dispatch", 1)
+        )
+
         # --- bucketing (reference: config.py:187-208) ---
         self.enable_bucketing = kwargs.pop("enable_bucketing", False)
         self.buckets = kwargs.pop("buckets", None)
@@ -699,6 +709,56 @@ class TpuConfig:
             )
         if self.speculation_length < 0:
             raise ValueError("speculation_length must be >= 0")
+        if self.decode_steps_per_dispatch < 1:
+            raise ValueError("decode_steps_per_dispatch must be >= 1")
+        if self.decode_steps_per_dispatch > 1:
+            # the K-step scan samples, advances positions, and commits KV
+            # in-graph — host-side sampling / speculative strides / per-step
+            # host inputs cannot ride inside it
+            if self.on_device_sampling_config is None:
+                raise ValueError(
+                    "decode_steps_per_dispatch > 1 requires on-device sampling "
+                    "(the K-step scan samples each token in-graph)"
+                )
+            if (
+                self.enable_fused_speculation
+                or self.is_medusa
+                or self.speculation_length > 0
+            ):
+                raise ValueError(
+                    "decode_steps_per_dispatch > 1 and speculative decoding "
+                    "both own the token-generation stride; enable one"
+                )
+            if self.is_block_kv_layout:
+                raise ValueError(
+                    "decode_steps_per_dispatch > 1 needs in-graph KV "
+                    "addressing by position; the block layout's slot mappings "
+                    "are host-computed per step"
+                )
+            if self.lora_config is not None:
+                raise ValueError(
+                    "decode_steps_per_dispatch > 1 does not thread per-request "
+                    "adapter_ids through the in-graph decode scan yet"
+                )
+            if (
+                self.tensor_capture_config is not None
+                or self.tensor_replacement_config is not None
+            ):
+                raise ValueError(
+                    "decode_steps_per_dispatch > 1 does not compose with "
+                    "tensor capture/replacement (per-step host tensors cannot "
+                    "ride the in-graph scan)"
+                )
+            if self.ctx_batch_size != self.tkg_batch_size:
+                # windows chain device-resident off the CTE's next_inputs
+                # (already padded to the CTE batch), so both programs must
+                # share one compiled batch — the same invariant the async
+                # 1-step chain enforces (application.async_supported)
+                raise ValueError(
+                    "decode_steps_per_dispatch > 1 requires ctx_batch_size == "
+                    "tkg_batch_size (the K-step windows chain device-resident "
+                    "from the context-encoding outputs)"
+                )
         if self.is_block_kv_layout and self.pa_num_blocks is None:
             self.pa_num_blocks = max(
                 1, (self.seq_len * self.max_batch_size) // self.pa_block_size

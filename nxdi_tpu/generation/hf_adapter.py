@@ -218,6 +218,27 @@ class HuggingFaceGenerationAdapter:
             )
             return self._assemble(input_ids, gen, lengths, pad_token_id)
 
+        # multi-step decode: the tkg_multistep submodel retires K tokens per
+        # dispatch (in-graph sample/advance/commit scan, models/base.py
+        # multi_step_token_gen); windows chain device-resident with the same
+        # lag-1 fetch pipeline as the 1-step async loop. Host-side logits
+        # interception and per-request adapters cannot ride the scan.
+        from nxdi_tpu.runtime.model_wrapper import MULTISTEP_EOS_SLOTS
+
+        if (
+            getattr(self.app, "multistep_supported", False)
+            and not finished.all()
+            and not lora_kwargs
+            and not logits_processor
+            and len(eos_ids) <= MULTISTEP_EOS_SLOTS
+        ):
+            gen = self._multistep_decode_loop(
+                next_tokens, lengths, n_new, eos_ids, pad_token_id,
+                sampling_params, B,
+                cte_next_inputs=outputs.get("next_inputs"),
+            )
+            return self._assemble(input_ids, gen, lengths, pad_token_id)
+
         # per-request adapters are host-side state the device decode loop
         # cannot carry; fall back to the sync loop when they are in play
         if (
@@ -354,20 +375,101 @@ class HuggingFaceGenerationAdapter:
             token_stream.append(tok)
 
         gen = np.stack(token_stream[:n_new], axis=1)
-        # mask tokens sampled after each row finished
-        if eos_ids:
-            for b in range(B):
-                hits = [i for i, t in enumerate(gen[b]) if t in eos_ids]
-                if hits:
-                    gen[b, hits[0] + 1 :] = pad_token_id
-            # the pipeline dispatches one step past the all-finished point;
-            # trim so output length matches the sync loop exactly
-            first_eos = []
-            for b in range(B):
-                hits = [i for i, t in enumerate(gen[b]) if t in eos_ids]
-                first_eos.append(hits[0] if hits else gen.shape[1] - 1)
-            gen = gen[:, : max(first_eos) + 1]
-        return gen
+        return self._mask_and_trim_eos(gen, eos_ids, pad_token_id)
+
+    @staticmethod
+    def _mask_and_trim_eos(gen, eos_ids, pad_token_id) -> np.ndarray:
+        """Pad-mask tokens sampled after each row's EOS, then trim the
+        device pipelines' overshoot past the all-finished point so the output
+        length matches the sync loop exactly (shared by the 1-step async and
+        multi-step window loops)."""
+        if not eos_ids:
+            return gen
+        B = gen.shape[0]
+        first_eos = []
+        for b in range(B):
+            hits = [i for i, t in enumerate(gen[b]) if t in eos_ids]
+            if hits:
+                gen[b, hits[0] + 1 :] = pad_token_id
+            first_eos.append(hits[0] if hits else gen.shape[1] - 1)
+        return gen[:, : max(first_eos) + 1]
+
+    def _multistep_decode_loop(
+        self, first_tokens, lengths, n_new, eos_ids, pad_token_id,
+        sampling_params, B, cte_next_inputs=None,
+    ) -> np.ndarray:
+        """Decode striding by K tokens per dispatch (tkg_multistep submodel).
+
+        Window j+1 is dispatched device-resident (its inputs are window j's
+        on-device next_inputs) BEFORE window j's tokens are fetched — the same
+        one-window-lag pipeline as :meth:`_device_decode_loop`, so the host
+        fetch overlaps the next window's execution. The step ladder picks the
+        smallest compiled rung covering the remaining budget, so tail windows
+        don't burn a full-K scan; any overshoot tokens are trimmed here
+        exactly like the 1-step loops trim post-EOS samples.
+        """
+        from nxdi_tpu.runtime.model_wrapper import (
+            MULTISTEP_EOS_SLOTS,
+            TAG_TOKEN_GENERATION_MULTISTEP,
+            decode_window_limit,
+        )
+
+        w = self.app.models[TAG_TOKEN_GENERATION_MULTISTEP]
+        window_limit = decode_window_limit(self.tpu_config, self.app.models)
+        remaining = n_new - 1
+        token_stream = [first_tokens]  # (B,) columns; step 0 from the CTE
+        finished = np.zeros((B,), dtype=bool)
+        for e in eos_ids:
+            finished |= first_tokens == e
+        if remaining <= 0 or finished.all():
+            return np.stack(token_stream, axis=1)
+
+        steps = w.select_steps(remaining)
+        max_len0 = int(lengths.max())
+        # window 0 starts device-resident straight off the CTE's next_inputs —
+        # zero host round trips, and the split-chained rng schedule is exactly
+        # the 1-step async chain's. The CTE always emits next_inputs for
+        # multistep apps (runtime/application.py enable_models; config
+        # validation forces on-device sampling), so this is never absent.
+        assert cte_next_inputs is not None, (
+            "multistep decode needs the CTE's device-resident next_inputs"
+        )
+        import jax.numpy as jnp
+
+        Bc = w.batch_size
+        eos_arr = np.full((Bc, MULTISTEP_EOS_SLOTS), -1, dtype=np.int32)
+        for j, e in enumerate(eos_ids):
+            eos_arr[:B, j] = e
+        dev_batch = dict(cte_next_inputs)
+        dev_batch["eos_token_ids"] = jnp.asarray(eos_arr)
+        dev_batch["pad_token_id"] = jnp.full((Bc,), pad_token_id, jnp.int32)
+        total_len = min(max_len0 + 1 + steps, window_limit)
+        outputs = self.app.token_gen_multistep_device(
+            dev_batch, total_len, steps=steps
+        )
+        device_stream = [outputs["tokens"]]  # (B, K_j) device arrays
+        nxt = outputs["next_inputs"]
+        produced = steps
+
+        while produced < remaining and not finished.all():
+            s = w.select_steps(remaining - produced)
+            total_len = min(max_len0 + 1 + produced + s, window_limit)
+            outputs = self.app.token_gen_multistep_device(nxt, total_len, steps=s)
+            nxt = outputs["next_inputs"]
+            device_stream.append(outputs["tokens"])
+            produced += s
+            # lag-1: fetch the PREVIOUS window while this one executes
+            prev = np.asarray(jax.device_get(device_stream[-2]))[:B]
+            token_stream.extend(prev.T)
+            for e in eos_ids:
+                finished |= (prev == e).any(axis=1)
+            if finished.all():
+                break
+        last = np.asarray(jax.device_get(device_stream[-1]))[:B]
+        token_stream.extend(last.T)
+
+        gen = np.stack(token_stream, axis=1)[:, :n_new]
+        return self._mask_and_trim_eos(gen, eos_ids, pad_token_id)
 
     def _fused_spec_decode(
         self, first_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B,

@@ -1,0 +1,125 @@
+"""Back-compat shims for older JAX releases (0.4.x).
+
+The codebase targets the current JAX API surface; a handful of names were
+renamed or promoted between 0.4.x and newer releases:
+
+  =============================  =========================================
+  current API (used here)        0.4.x equivalent
+  =============================  =========================================
+  ``jax.set_mesh(mesh)``         ``with mesh:`` (Mesh is a ctx manager)
+  ``jax.sharding.get_abstract_mesh()``  thread-resource physical mesh
+  ``jax.shard_map(..., axis_names=S, check_vma=b)``
+                                 ``jax.experimental.shard_map.shard_map(
+                                     ..., auto=mesh.axis_names - S,
+                                     check_rep=b)``
+  ``jax.experimental.layout.Format`` / ``.Layout``
+                                 ``.Layout`` / ``.DeviceLocalLayout``
+  ``Array.format`` / ``Compiled.input_formats``
+                                 ``Array.layout`` / ``Compiled.input_layouts``
+  ``jax.config jax_num_cpu_devices``
+                                 ``--xla_force_host_platform_device_count``
+  =============================  =========================================
+
+``ensure()`` installs the missing names as thin adapters and is a strict
+no-op on current JAX (every shim is gated on ``hasattr``). It runs once at
+``nxdi_tpu`` import. The array/compiled attribute differences are handled at
+their single call site (runtime/model_wrapper.py) via the ``array_format``/
+``compiled_input_formats`` helpers below.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+_done = False
+
+# True when running on a 0.4.x JAX through these shims (captured BEFORE any
+# patching). A few tests skip on legacy JAX where the old backend's lowering
+# genuinely differs (pp shard_map PartitionId, fp8 rounding, ragged_dot).
+LEGACY_JAX = not hasattr(jax, "shard_map")
+
+
+def ensure() -> None:
+    global _done
+    if _done:
+        return
+    _done = True
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        from jax._src import mesh as _mesh_lib
+
+        def get_abstract_mesh():
+            return _mesh_lib.thread_resources.env.physical_mesh
+
+        jax.sharding.get_abstract_mesh = get_abstract_mesh
+
+    if not hasattr(jax, "set_mesh"):
+        # Mesh is itself a context manager on 0.4.x; entering it is the
+        # analog of the newer explicit-mesh context
+        def set_mesh(mesh):
+            if mesh is None:
+                return contextlib.nullcontext()
+            return mesh
+
+        jax.set_mesh = set_mesh
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _old_shard_map
+
+        def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+                      check_vma=True, **kwargs):
+            auto = frozenset()
+            if axis_names is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+            return _old_shard_map(
+                f, mesh, in_specs, out_specs, check_rep=check_vma, auto=auto,
+                **kwargs,
+            )
+
+        jax.shard_map = shard_map
+
+    import jax.experimental.layout as _layout_mod
+
+    if not hasattr(_layout_mod, "Format"):
+        _layout_mod.Format = _layout_mod.Layout
+        _layout_mod.Layout = _layout_mod.DeviceLocalLayout
+
+
+def set_num_cpu_devices(n: int) -> None:
+    """``jax.config.update("jax_num_cpu_devices", n)`` where available; on
+    0.4.x the host-platform device count only exists as an XLA flag — set it
+    into the environment, which still works as long as the backend has not
+    initialized yet (callers that might be too late also export XLA_FLAGS
+    before python starts, like tests/conftest.py)."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n)
+        return
+    except AttributeError:
+        pass
+    import os
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    want = f"--xla_force_host_platform_device_count={n}"
+    if "xla_force_host_platform_device_count" in flags:
+        # replace a pre-exported count rather than silently keeping it
+        flags = re.sub(
+            r"--xla_force_host_platform_device_count=\d+", want, flags
+        )
+        os.environ["XLA_FLAGS"] = flags
+    else:
+        os.environ["XLA_FLAGS"] = (flags + " " + want).strip()
+
+
+def array_format(a):
+    """``Array.format`` (newer) / ``Array.layout`` (0.4.x)."""
+    return getattr(a, "format", None) or a.layout
+
+
+def compiled_input_formats(compiled):
+    """``Compiled.input_formats`` (newer) / ``.input_layouts`` (0.4.x)."""
+    if hasattr(compiled, "input_formats"):
+        return compiled.input_formats
+    return compiled.input_layouts

@@ -416,9 +416,19 @@ def attention_block(
     layer_idx=None,  # GLOBAL layer index (per-layer KV-quant scale rows)
     stacked_layer_idx=None,  # segment-local index into the stacked weights
     tkg_stacked=None,  # (k_s, v_s, kv_len): stacked-cache fused decode kernel
+    spec_window=None,  # (k_sp, v_sp, win_pos, slot): draft-window scratch
 ) -> Tuple[jax.Array, Tuple[jax.Array, jax.Array]]:
     """QKV -> RoPE -> KV update -> attention -> O (reference:
     attention_base.py:571 prep_qkv_tensors, :2075 attention_context_encode).
+
+    ``spec_window`` (fused-speculation draft loop, speculation/fused.py):
+    fresh K/V land in a small per-layer (B, KV, spec_len+1, D) scratch at
+    column ``slot`` instead of the full cache; attention reads the OLD cache
+    with ALL window positions masked (prior windows' stale rows live there)
+    plus the scratch as the fresh segment — its per-row rope positions are
+    ``win_pos`` and position causality hides the not-yet-written columns.
+    Returns the updated scratch slices; the window commits to the full cache
+    ONCE after the draft scan, not once per draft step.
 
     ``defer_write`` (decode hot path): instead of scattering fresh K/V into
     the cache slice and carrying the full slice through the layer scan (XLA
@@ -581,6 +591,40 @@ def attention_block(
         # starting at 0, so the contiguous layout may take its slice-write
         # fast path instead of a B*S-row scatter (kv_cache.py update)
         ci["prefill_from_zero"] = True
+    if spec_window is not None and attend_to_cache:
+        # fused-speculation draft window (one commit per WINDOW): write the
+        # fresh row into scratch column `slot`, then attend [old cache with
+        # every window position masked] + [scratch] — rows written by earlier
+        # draft steps are visible at their true positions, unwritten columns
+        # sit at future positions the causal mask hides. Numerically this
+        # attends exactly the same (position, value) set as the per-step
+        # commit path; only the two-part summation split differs.
+        k_sp, v_sp, win_pos, slot = spec_window
+        k_sp = jax.lax.dynamic_update_slice(
+            k_sp, k.astype(k_sp.dtype), (0, 0, slot, 0)
+        )
+        v_sp = jax.lax.dynamic_update_slice(
+            v_sp, v.astype(v_sp.dtype), (0, 0, slot, 0)
+        )
+        kk, vv, kv_pos = layout.read(k_cache_l, v_cache_l, ci, cache_spec)
+        kk = constrain(kk, policy.cache_kv)
+        vv = constrain(vv, policy.cache_kv)
+        kv_pos = jnp.where(kv_pos >= win_pos[:, :1], jnp.int32(2 ** 30), kv_pos)
+        _record_strategy("tkg_spec_window_xla")
+        ctx = attn_ops.attention_two_part(
+            q, kk, vv, k_sp, v_sp, position_ids, kv_pos, win_pos,
+            scale=arch.attention_scale,
+            softmax_dtype=jnp.float32,
+            sliding_window=arch.sliding_window,
+            chunk_size=arch.chunk_size,
+            sink=p_attn.get("sink") if arch.attention_sink else None,
+            sliding_window_enabled=window_enabled,
+            chunk_enabled=use_rope,
+            logit_softcap=arch.attn_logit_softcap,
+        )
+        ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
+        out = _o_proj(ctx)
+        return out, (k_sp, v_sp)
     # run_decoder_layers is the single authority on eligibility; the mask
     # check repeats here only because tree-verify programs statically carry
     # attn_mask in their cache inputs
@@ -959,6 +1003,7 @@ def decoder_layer(
     layer_idx=None,  # GLOBAL layer index (per-layer KV-quant scale rows)
     stacked_layer_idx=None,  # segment-local index into the stacked weights
     tkg_stacked=None,  # (k_s, v_s, kv_len): stacked-cache fused decode kernel
+    spec_window=None,  # (k_sp, v_sp, win_pos, slot): draft-window scratch
 ):
     if stacked_layer_idx is None:
         stacked_layer_idx = layer_idx
@@ -986,6 +1031,7 @@ def decoder_layer(
         extra["layer_idx"] = layer_idx
         extra["stacked_layer_idx"] = stacked_layer_idx
         extra["tkg_stacked"] = tkg_stacked
+        extra["spec_window"] = spec_window
     attn_out, (nk, nv) = attn_block_fn(
         arch, lp["attn"], h, cos, sin, k_cache_l, v_cache_l,
         position_ids, cache_spec, attend_to_cache, policy, layout, cache_inputs,
@@ -1421,8 +1467,14 @@ def run_decoder_layers(
     adapter_ids: Optional[jax.Array] = None,
     layer_injections: Optional[jax.Array] = None,  # (L, B, S, hidden) or None
     layer_replacements: Optional[Tuple[jax.Array, jax.Array]] = None,
+    spec_window_inputs: Optional[Tuple[jax.Array, jax.Array]] = None,
 ):
     """Scan the layer stack. Cache slices ride the scan as xs/ys.
+
+    ``spec_window_inputs`` (win_pos (B, W), slot ()): engaged when the cache
+    pytree carries ``k_spec``/``v_spec`` scratch stacks (the fused-speculation
+    draft loop, speculation/fused.py) — fresh rows land in the scratch, the
+    full cache is read-only, and the window commits ONCE after the draft scan.
 
     ``layer_replacements``: ((L, B, S, hidden) values, (L,) mask) — layers
     whose mask entry is nonzero have their output stream REPLACED by the
@@ -1464,11 +1516,25 @@ def run_decoder_layers(
         and isinstance(layout, ContiguousKVLayout)
         and (cache_inputs or {}).get("attn_mask") is None
     )
+    spec_mode = "k_spec" in cache
+    if spec_mode and (
+        not attend_to_cache
+        or arch.pp_degree > 1
+        or arch.mla is not None
+        or "k_win" in cache
+        or not isinstance(layout, ContiguousKVLayout)
+        or (cache_inputs or {}).get("attn_mask") is not None
+        or spec_window_inputs is None
+    ):
+        raise NotImplementedError(
+            "the speculation-window scratch rides the plain contiguous decode "
+            "path only (speculation/fused.py gates eligibility)"
+        )
 
     def _step(h, lp, kl, vl, cos_, sin_, pos_, ci_, ad_, layout_=None,
               windowable_=None, defer_=None, mlp_stacked=None,
               qkv_stacked=None, layer_idx=None, stacked_layer_idx=None,
-              tkg_stacked=None):
+              tkg_stacked=None, spec_window=None):
         """One decoder layer with the bucket's static KV window applied.
         ``layout_``/``windowable_``/``defer_`` override the stack-wide
         defaults for the interleaved-window unit scan (ring slices use the
@@ -1476,9 +1542,14 @@ def run_decoder_layers(
         lay = layout if layout_ is None else layout_
         win_ok = windowable if windowable_ is None else windowable_
         dfr = defer if defer_ is None else defer_
+        if spec_window is not None:
+            # the scratch IS the write target: ys carry its updated slices
+            # (the same plumbing as deferred fresh rows), commit happens once
+            # in the caller
+            dfr = True
         stk = dict(mlp_stacked=mlp_stacked, qkv_stacked=qkv_stacked,
                    layer_idx=layer_idx, stacked_layer_idx=stacked_layer_idx,
-                   tkg_stacked=tkg_stacked)
+                   tkg_stacked=tkg_stacked, spec_window=spec_window)
         if (win_ok and kv_window is not None and kv_window < kl.shape[2]
                 and attend_to_cache and tkg_stacked is None):
             k_win, v_win = kl[:, :, :kv_window], vl[:, :, :kv_window]
@@ -1598,6 +1669,7 @@ def run_decoder_layers(
     use_stacked_tkg = (
         arch.attn_tkg_kernel_enabled
         and defer
+        and not spec_mode
         and position_ids.shape[1] == 1
         # flash decoding (KV-S sharded) and per-layer window/rope flags fall
         # back per layer inside attention_block — skipping the kv_window
@@ -1634,12 +1706,16 @@ def run_decoder_layers(
             # xs carries the GLOBAL layer index (for per-layer KV-quant scale
             # rows, kv_cache._scale_for); the per-SEGMENT stacked kernel
             # weights index with the segment-local offset
-            lp, kl, vl, inj, li, repl = xs
+            lp, kl, vl, ksp, vsp, inj, li, repl = xs
             li_local = li - jnp.int32(seg_off)
+            spec_win = None
+            if ksp is not None:
+                spec_win = (ksp, vsp) + spec_window_inputs
             h, nk, nv = _step(
                 h, lp, kl, vl, cos, sin, position_ids, cache_inputs,
                 adapter_ids, mlp_stacked=mlp_st, qkv_stacked=qkv_st,
                 layer_idx=li, stacked_layer_idx=li_local, tkg_stacked=tkg_st,
+                spec_window=spec_win,
             )
             if inj is not None:
                 h = h + inj.astype(h.dtype)
@@ -1650,6 +1726,10 @@ def run_decoder_layers(
 
         k_seg = jax.lax.slice_in_dim(cache["k"], off, off + n_seg, axis=0)
         v_seg = jax.lax.slice_in_dim(cache["v"], off, off + n_seg, axis=0)
+        ksp_seg = vsp_seg = None
+        if spec_mode:
+            ksp_seg = jax.lax.slice_in_dim(cache["k_spec"], off, off + n_seg, axis=0)
+            vsp_seg = jax.lax.slice_in_dim(cache["v_spec"], off, off + n_seg, axis=0)
         if use_stacked_tkg:
             from functools import partial as _partial
 
@@ -1667,7 +1747,7 @@ def run_decoder_layers(
             if layer_replacements is not None
             else None
         )
-        xs = (seg, k_seg, v_seg, inj_seg,
+        xs = (seg, k_seg, v_seg, ksp_seg, vsp_seg, inj_seg,
               off + jnp.arange(n_seg, dtype=jnp.int32), repl_seg)
         hidden, ys = jax.lax.scan(body, hidden, xs)
         off += n_seg
@@ -1676,7 +1756,16 @@ def run_decoder_layers(
         else:
             ks.append(ys[0]); vs.append(ys[1])
     cat = (lambda xs: xs[0] if len(xs) == 1 else jnp.concatenate(xs, axis=0))
-    if defer:
+    if spec_mode:
+        # full cache untouched; the scratch stacks carry this step's rows and
+        # the whole window commits once, after the draft scan (fused.py)
+        new_cache = {
+            "k": cache["k"],
+            "v": cache["v"],
+            "k_spec": cat(ks),
+            "v_spec": cat(vs),
+        }
+    elif defer:
         ci_commit = dict(cache_inputs or {})
         ci_commit["position_ids"] = position_ids
         new_cache = layout.commit_rows(
@@ -1718,6 +1807,7 @@ def causal_lm_forward(
     gather_last_token: bool = True,
     output_logits: bool = False,
     output_all_logits: bool = False,
+    output_argmax_all: bool = False,
     on_device_sampling: bool = True,
     do_sample: bool = False,
     global_topk: int = 256,
@@ -1834,10 +1924,18 @@ def causal_lm_forward(
         arch.bidirectional_image_attention
         and image_token_id is not None
         and input_ids.shape[1] > 1
+        and not attend_to_cache
     ):
         # per-image span ids (consecutive placeholder runs; distinct images
         # never attend each other — HF image_group_ids semantics), derived
-        # in-graph so no extra host input is needed
+        # in-graph so no extra host input is needed. PREFILL-stage programs
+        # only (attend_to_cache=False): a cache-attending S>1 window is a
+        # speculation verify pass whose generated tokens carry no image spans
+        # — computing spans there tripped attention_block's prefix-caching
+        # rejection at trace time and kept fused/EAGLE speculation from
+        # compiling on gemma3-vision configs (ADVICE r5). Prefix-cached /
+        # chunked prefill (also cache-attending S>1) is rejected up front at
+        # wrapper construction for these models (runtime/model_wrapper.py).
         is_img = input_ids == image_token_id
         starts = is_img & ~jnp.concatenate(
             [jnp.zeros_like(is_img[:, :1]), is_img[:, :-1]], axis=1
@@ -1864,6 +1962,15 @@ def causal_lm_forward(
             [inj, jnp.zeros((pad,) + inj.shape[1:], inj.dtype)], axis=0
         )
 
+    spec_window_inputs = None
+    if "k_spec" in cache:
+        # fused-speculation draft window scratch (speculation/fused.py): the
+        # window's absolute rope positions and this step's scratch column
+        spec_window_inputs = (
+            batch["spec_win_pos"].astype(jnp.int32),
+            batch["spec_win_slot"].astype(jnp.int32),
+        )
+
     layer_replacements = None
     if tensor_replacement and "layers" in tensor_replacement:
         layer_replacements = (
@@ -1884,6 +1991,7 @@ def causal_lm_forward(
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
             layer_injections=layer_injections,
             layer_replacements=layer_replacements,
+            spec_window_inputs=spec_window_inputs,
         )
         captured["layer_hiddens"] = layer_hiddens
     elif aux_hidden_indices:
@@ -1894,6 +2002,7 @@ def causal_lm_forward(
             collect_hidden=True, adapter_ids=batch.get("adapter_ids"),
             layer_injections=layer_injections,
             layer_replacements=layer_replacements,
+            spec_window_inputs=spec_window_inputs,
         )
         if tensor_capture and "layer_hiddens" in tensor_capture:
             captured["layer_hiddens"] = layer_hiddens
@@ -1905,6 +2014,7 @@ def causal_lm_forward(
             adapter_ids=batch.get("adapter_ids"),
             layer_injections=layer_injections,
             layer_replacements=layer_replacements,
+            spec_window_inputs=spec_window_inputs,
         )
     if tensor_replacement and "hidden" in tensor_replacement:
         hidden = jnp.where(
@@ -1960,6 +2070,11 @@ def causal_lm_forward(
     else:
         last_logits = logits
 
+    if output_argmax_all:
+        # speculation verify: the greedy token at EVERY position, selected
+        # in-graph — the full-vocab fp32 logits never cross the program
+        # boundary, the accept/gather logic downstream runs on (B, S) tokens
+        outputs["tokens"] = sampling_ops.greedy_sample(logits)
     if on_device_sampling:
         sample_in = last_logits[:, -1, :]
         if dp_sampling:
@@ -1976,7 +2091,9 @@ def causal_lm_forward(
             deterministic=deterministic,
         )
         outputs["tokens"] = tokens[:, None]  # (B, 1)
-    if output_logits or output_all_logits or not on_device_sampling:
+    if output_logits or output_all_logits or (
+        not on_device_sampling and not output_argmax_all
+    ):
         outputs["logits"] = logits[..., : arch.vocab_size - arch.vocab_pad]
 
     if return_next_inputs and on_device_sampling:
@@ -1997,6 +2114,130 @@ def causal_lm_forward(
             "sampling_params": batch["sampling_params"],
         }
         if "rng" in batch:
-            nxt["rng"] = jax.random.split(batch["rng"], 1)[0]
+            nxt["rng"] = sampling_ops.next_step_rng(batch["rng"])
         outputs["next_inputs"] = nxt
     return outputs, new_cache
+
+
+# ---------------------------------------------------------------------------
+# Multi-step decode: K token-generation steps in ONE compiled program
+# ---------------------------------------------------------------------------
+
+# step-batch keys chained from one in-scan decode step to the next (exactly
+# the 1-step program's next_inputs contract)
+_MULTISTEP_CHAIN_KEYS = (
+    "input_ids", "position_ids", "last_token_index", "sampling_params",
+)
+# batch keys carried through the scan (and the window-to-window next_inputs)
+# unchanged
+_MULTISTEP_PASSTHROUGH_KEYS = ("seq_ids", "eos_token_ids", "pad_token_id")
+
+
+def multi_step_token_gen(
+    arch: DecoderArch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    num_steps: int,
+    kv_window: Optional[int] = None,
+    policy: ShardingPolicy = DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+    dp_sampling: bool = False,
+    return_next_inputs: bool = True,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """K decode steps fused into one dispatch (the ``tkg_multistep`` submodel).
+
+    One ``lax.scan`` chains K single-token ``causal_lm_forward`` steps —
+    sample -> embed -> layer stack -> deferred KV commit -> position advance —
+    entirely on device, so the host dispatches (and XLA enters/exits a
+    program) once per K tokens instead of once per token. The per-step
+    plumbing is EXACTLY the 1-step program's ``next_inputs`` contract,
+    including the :func:`sampling.next_step_rng` key schedule, which makes
+    the K-step scan token-identical to K chained 1-step dispatches (greedy
+    and sampled).
+
+    ``batch`` extends the decode contract with two optional fixed-shape
+    inputs for in-scan EOS handling:
+      - ``eos_token_ids`` (B, E) int32, -1 = unused slot: once a row samples
+        any of its EOS ids, its later in-window tokens are emitted as
+        ``pad_token_id`` and the pad is what feeds the next step — the same
+        stream the host-side sync loop produces for finished rows.
+      - ``pad_token_id`` (B,) int32.
+
+    Returns outputs with ``tokens`` (B, K) — all K emitted tokens, in order —
+    and (optionally) ``next_inputs`` carrying the step-batch for the NEXT
+    window plus the passthrough inputs, so windows chain device-resident.
+    """
+    B = batch["input_ids"].shape[0]
+    eos_ids = batch.get("eos_token_ids")  # (B, E) int32; None = no masking
+    pad_id = batch.get("pad_token_id")  # (B,) int32
+    passthrough = {
+        k: batch[k] for k in _MULTISTEP_PASSTHROUGH_KEYS if k in batch
+    }
+
+    step0 = {k: batch[k] for k in _MULTISTEP_CHAIN_KEYS}
+    if "rng" in batch:
+        step0["rng"] = batch["rng"]
+
+    def step(carry, _):
+        sbatch, done, kvc = carry
+        fwd_batch = dict(passthrough)
+        fwd_batch.update(sbatch)
+        out, kvc = causal_lm_forward(
+            arch,
+            inv_freq,
+            params,
+            kvc,
+            fwd_batch,
+            attend_to_cache=True,
+            kv_window=kv_window,
+            policy=policy,
+            layout=layout,
+            gather_last_token=False,
+            on_device_sampling=True,
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+            dp_sampling=dp_sampling,
+            return_next_inputs=True,
+        )
+        nxt = out["next_inputs"]
+        tok = out["tokens"][:, 0]  # (B,)
+        if eos_ids is not None:
+            # finished rows emit (and feed forward) the pad token; a row
+            # finishes the step AFTER its EOS is emitted, so the EOS itself
+            # always lands in the output — the sync host loop's semantics
+            pad = (
+                pad_id.astype(tok.dtype)
+                if pad_id is not None
+                else jnp.zeros_like(tok)
+            )
+            emitted = jnp.where(done, pad, tok)
+            done = done | jnp.any(emitted[:, None] == eos_ids, axis=1)
+        else:
+            emitted = tok
+        new_sbatch = {
+            "input_ids": emitted[:, None].astype(jnp.int32),
+            "position_ids": nxt["position_ids"],
+            "last_token_index": nxt["last_token_index"],
+            "sampling_params": nxt["sampling_params"],
+        }
+        if "rng" in sbatch:
+            new_sbatch["rng"] = nxt["rng"]
+        return (new_sbatch, done, kvc), emitted
+
+    done0 = jnp.zeros((B,), bool)
+    (step_k, _, cache), toks = jax.lax.scan(
+        step, (step0, done0, cache), None, length=num_steps
+    )
+    outputs: Dict[str, jax.Array] = {"tokens": jnp.swapaxes(toks, 0, 1)}  # (B, K)
+    if return_next_inputs:
+        nxt = dict(step_k)
+        nxt.update(passthrough)
+        outputs["next_inputs"] = nxt
+    return outputs, cache

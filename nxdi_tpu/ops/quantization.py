@@ -298,10 +298,18 @@ _CALIB: Optional[Dict[Any, float]] = None
 
 
 def _weight_fingerprint(qw) -> Tuple:
-    """Shape + 4^ndim-corner content key identifying a quantized weight (or a
-    per-layer slice of a stacked one) across the eager scan's re-slicing."""
-    corner = qw[tuple(slice(0, 4) for _ in range(qw.ndim))]
-    return (tuple(qw.shape), np.asarray(corner).tobytes())
+    """Shape + FULL-content hash identifying a quantized weight (or a
+    per-layer slice of a stacked one) across the eager scan's re-slicing.
+
+    Hashing the whole tensor (not a corner sample) matters: two linears with
+    identical shape and corner — tied projections, zero-heavy weights — must
+    not silently merge into one amax calibration bucket and share a max-based
+    input_scale (ADVICE r5). Calibration runs eagerly and rarely, so the full
+    SHA-1 pass over each weight's bytes is off every hot path."""
+    import hashlib
+
+    qb = np.ascontiguousarray(np.asarray(qw))
+    return (tuple(qb.shape), str(qb.dtype), hashlib.sha1(qb.tobytes()).digest())
 
 
 @contextmanager

@@ -49,6 +49,16 @@ def prepare_sampling_params(
     )
 
 
+def next_step_rng(rng: jax.Array) -> jax.Array:
+    """The per-step PRNG key schedule for device-resident decode chains: each
+    step's key is split off the previous step's. SINGLE source of truth —
+    the 1-step next_inputs path (models/base.py), the K-step decode scan
+    (multi_step_token_gen), and the fused-speculation window chain all fold
+    keys through this function, which is what makes a K-step scan emit
+    token-for-token the same sampled stream as K chained 1-step dispatches."""
+    return jax.random.split(rng, 1)[0]
+
+
 def mask_padded_logits(logits, pad_size: int):
     """Mask the vocab-padding tail added so vocab divides tp
     (reference: sampling.py:24-40)."""

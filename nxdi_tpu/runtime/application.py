@@ -39,7 +39,9 @@ from nxdi_tpu.runtime import autobucketing
 from nxdi_tpu.runtime.model_wrapper import (
     TAG_CONTEXT_ENCODING,
     TAG_TOKEN_GENERATION,
+    TAG_TOKEN_GENERATION_MULTISTEP,
     ModelWrapper,
+    MultiStepTKGWrapper,
 )
 
 TAG_PREFIX_PREFILL = "prefix_prefill_model"
@@ -421,24 +423,14 @@ class ApplicationBase:
             wrapper.build(self.mesh, param_shardings, cache_shardings)
 
     def warmup(self) -> None:
-        """Run every (submodel, bucket) once on dummy inputs so first real
-        requests never hit compile latency (reference: application_base.py:348)."""
+        """Run every compiled program once on dummy inputs so first real
+        requests never hit compile latency (reference: application_base.py:348).
+        Each wrapper enumerates its own program grid (buckets; the multi-step
+        wrapper also its step rungs — a cold tail rung would otherwise compile
+        mid-request)."""
         t0 = time.time()
         for wrapper in self.models.values():
-            for bucket in wrapper.buckets:
-                decode_like = wrapper.attend_to_cache and not wrapper.prefill_to_cache
-                seq = wrapper.n_active_tokens if decode_like else bucket
-                b = wrapper.batch_size
-                batch = {
-                    "input_ids": np.zeros((b, seq), dtype=np.int32),
-                    "position_ids": np.full(
-                        (b, seq), max(bucket - 1 - wrapper.lookahead, 0), dtype=np.int32
-                    )
-                    if decode_like
-                    else np.tile(np.arange(seq, dtype=np.int32), (b, 1)),
-                    "last_token_index": np.zeros((b,), dtype=np.int32),
-                    "sampling_params": np.tile([1.0, 1.0, 1.0], (b, 1)).astype(np.float32),
-                }
+            for batch in wrapper.warmup_batches():
                 out, self.kv_cache = wrapper.forward(self.params, self.kv_cache, batch)
                 jax.block_until_ready(out)
         logger.info("warmup done in %.1fs", time.time() - t0)
@@ -490,8 +482,11 @@ class TpuModelForCausalLM(ApplicationBase):
                 dp_sampling=getattr(odsc, "dp_sampling", False),
             )
         # async (device-resident) loop needs every step to emit the next step's
-        # inputs on device; only meaningful with on-device sampling
-        if tc.async_mode and on_device_sampling:
+        # inputs on device; only meaningful with on-device sampling. Multi-step
+        # decode chains its windows the same way, so it needs the CTE to emit
+        # next_inputs too (window 0 then starts device-resident with the same
+        # split-chained rng schedule as the 1-step async loop).
+        if (tc.async_mode or tc.decode_steps_per_dispatch > 1) and on_device_sampling:
             sampling_kwargs["return_next_inputs"] = True
         if tc.tensor_capture_config is not None:
             # debug intermediates compiled into extra outputs (reference:
@@ -550,6 +545,30 @@ class TpuModelForCausalLM(ApplicationBase):
             ),
             extra_inputs=tr_extra,
         )
+        if tc.decode_steps_per_dispatch > 1:
+            # multi-step decode: K chained TKG steps per dispatch (models/
+            # base.py multi_step_token_gen). The plain TKG submodel stays —
+            # it is the 1-step program the host falls back to (logits
+            # processors, >8 eos ids) and the async chain's building block.
+            self.models[TAG_TOKEN_GENERATION_MULTISTEP] = MultiStepTKGWrapper(
+                TAG_TOKEN_GENERATION_MULTISTEP,
+                self.config,
+                arch_tkg,
+                inv_freq,
+                batch_size=tc.tkg_batch_size,
+                n_active_tokens=1,
+                buckets=autobucketing.token_generation_buckets(self.config),
+                attend_to_cache=True,
+                steps_ladder=autobucketing.multistep_step_ladder(
+                    tc.decode_steps_per_dispatch
+                ),
+                forward_kwargs=dict(
+                    do_sample=odsc.do_sample,
+                    global_topk=odsc.global_topk,
+                    deterministic=odsc.deterministic,
+                    dp_sampling=getattr(odsc, "dp_sampling", False),
+                ),
+            )
         if tc.is_prefix_caching or tc.is_chunked_prefill:
             # multi-token prefill that attends the cache: the new chunk/suffix
             # sees the cached prefix through the block table (reference:
@@ -603,6 +622,25 @@ class TpuModelForCausalLM(ApplicationBase):
         (reference: causal_lm_async_execution async_execution.py:190)."""
         outputs, self.kv_cache = self.models[TAG_TOKEN_GENERATION].forward_device(
             self.params, self.kv_cache, device_batch, total_len
+        )
+        return outputs
+
+    @property
+    def multistep_supported(self) -> bool:
+        return TAG_TOKEN_GENERATION_MULTISTEP in self.models
+
+    def token_gen_multistep(self, batch_np):
+        """Host-path multi-step dispatch: pads inputs, retires K tokens."""
+        w = self.models[TAG_TOKEN_GENERATION_MULTISTEP]
+        outputs, self.kv_cache = w.forward(self.params, self.kv_cache, batch_np)
+        return outputs
+
+    def token_gen_multistep_device(self, device_batch, total_len: int, steps=None):
+        """Device-resident multi-step window: K tokens per dispatch, windows
+        chained through next_inputs with no host round trip."""
+        w = self.models[TAG_TOKEN_GENERATION_MULTISTEP]
+        outputs, self.kv_cache = w.forward_device(
+            self.params, self.kv_cache, device_batch, total_len, steps=steps
         )
         return outputs
 

@@ -107,6 +107,25 @@ def prefix_prefill_buckets(config) -> List[int]:
     return context_encoding_buckets(config)
 
 
+def multistep_step_ladder(max_steps: int) -> List[int]:
+    """Step-count rungs for the multi-step decode submodel (``tkg_multistep``):
+    powers of two from 2 with the configured K as the last rung, e.g. K=8 ->
+    [2, 4, 8], K=6 -> [2, 4, 6]. Each rung is a separately compiled K-step
+    program; the dispatcher picks the smallest rung covering the remaining
+    generation budget so tail windows don't run (and then discard) a full-K
+    scan. No rung 1 — the plain token_generation_model IS the 1-step program."""
+    if max_steps <= 2:
+        return [max(2, max_steps)]
+    return generate_buckets(2, max_steps)
+
+
+def get_target_steps(remaining: int, ladder: Sequence[int]) -> int:
+    """Smallest step rung covering ``remaining`` tokens; the largest rung when
+    even it cannot (the host trims overshoot tokens)."""
+    fits = [s for s in sorted(ladder) if s >= remaining]
+    return fits[0] if fits else max(ladder)
+
+
 def get_target_bucket(
     length: int, buckets: Sequence[int], strategy: str = "first_fit"
 ) -> int:

@@ -158,11 +158,15 @@ class _AutoLayoutProgram:
             lowered = self.jitted.lower(*absargs)
             self._snap_strategies(base_mod)
             self._compiled = lowered.compile()
-            self._cache_formats = self._compiled.input_formats[0][1]
+            from nxdi_tpu.jax_compat import compiled_input_formats
+
+            self._cache_formats = compiled_input_formats(self._compiled)[0][1]
+        from nxdi_tpu.jax_compat import array_format
+
         flat, treedef = jax.tree_util.tree_flatten(cache)
         fmts = jax.tree_util.tree_leaves(self._cache_formats)
         moved = [
-            a if a.format == f else jax.device_put(a, f)
+            a if array_format(a) == f else jax.device_put(a, f)
             for a, f in zip(flat, fmts)
         ]
         cache = jax.tree_util.tree_unflatten(treedef, moved)
@@ -171,9 +175,14 @@ class _AutoLayoutProgram:
 
 TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"
+TAG_TOKEN_GENERATION_MULTISTEP = "tkg_multistep"
 TAG_SPECULATION = "speculation_model"
 TAG_FUSED_SPECULATION = "fused_speculation_model"
 TAG_MEDUSA_SPECULATION = "medusa_speculation_model"
+
+# fixed width of the multi-step decode program's eos_token_ids input (HF eos
+# lists are ints or short lists; the host falls back to 1-step decode beyond)
+MULTISTEP_EOS_SLOTS = 8
 
 
 def decode_window_limit(tpu_config, models) -> int:
@@ -217,6 +226,17 @@ class ModelWrapper:
         # prefix through the block table (reference: perform_prefix_prefill
         # attention_base.py:909, chunked :1083)
         self.prefill_to_cache = prefill_to_cache
+        if prefill_to_cache and getattr(arch, "bidirectional_image_attention", False):
+            # span ids restart per chunk, so same-image tokens in the cached
+            # prefix could never match — reject at app construction instead of
+            # silently computing causal-only attention (causal_lm_forward only
+            # derives bidir spans for pure-prefill programs now, so this is
+            # the loud gate the old in-trace NotImplementedError provided)
+            raise ValueError(
+                "bidirectional image attention (gemma3-vision) does not "
+                "compose with prefix-cached/chunked prefill; disable prefix "
+                "caching for this model"
+            )
         self.bucket_strategy = bucket_strategy
         self.forward_fn = forward_fn or causal_lm_forward
         self.forward_kwargs = dict(forward_kwargs or {})
@@ -422,12 +442,36 @@ class ModelWrapper:
         # artifact would drop sharding constraints and pallas paths, and the
         # persistent-cache entries would never match the serve-time programs
         with jax.set_mesh(self._mesh):
-            for bucket, prog in self._programs.items():
+            for key, prog in self._programs.items():
                 lowered = prog.lower(
-                    params_struct, cache_struct, self.example_batch(bucket)
+                    params_struct, cache_struct, self._example_for_key(key)
                 )
-                compiled[bucket] = lowered.compile()
+                compiled[key] = lowered.compile()
         return compiled
+
+    def _example_for_key(self, key):
+        """Program key -> example batch (multi-step keys are (steps, bucket))."""
+        return self.example_batch(key)
+
+    def warmup_batches(self):
+        """One dummy host batch per compiled program, so warmup covers the
+        whole program grid (application.warmup)."""
+        for bucket in self.buckets:
+            decode_like = self.attend_to_cache and not self.prefill_to_cache
+            seq = self.n_active_tokens if decode_like else bucket
+            b = self.batch_size
+            yield {
+                "input_ids": np.zeros((b, seq), dtype=np.int32),
+                "position_ids": np.full(
+                    (b, seq), max(bucket - 1 - self.lookahead, 0), dtype=np.int32
+                )
+                if decode_like
+                else np.tile(np.arange(seq, dtype=np.int32), (b, 1)),
+                "last_token_index": np.zeros((b,), dtype=np.int32),
+                "sampling_params": np.tile([1.0, 1.0, 1.0], (b, 1)).astype(
+                    np.float32
+                ),
+            }
 
     # ------------------------------------------------------------------
     # dispatch (reference: model_wrapper.py:1314 forward)
@@ -546,7 +590,7 @@ class ModelWrapper:
         # can coexist in one process (the reference runs draft+target or
         # encoder+decoder apps side by side the same way)
         with jax.set_mesh(self._mesh):
-            outputs, new_cache = self._programs[bucket](params, cache, device_batch)
+            outputs, new_cache = self._run_program(bucket, params, cache, device_batch)
         if self.post_hooks:
             jax.block_until_ready(outputs)
             for hook in self.post_hooks:
@@ -616,6 +660,11 @@ class ModelWrapper:
             extra["slot_mapping"] = sm
         return extra
 
+    def _run_program(self, bucket, params, cache, device_batch):
+        """Program lookup + call; the multi-step wrapper keys on (steps,
+        bucket) pairs instead."""
+        return self._programs[bucket](params, cache, device_batch)
+
     def forward_device(self, params, cache, device_batch, total_len: int):
         """Hot-path dispatch with inputs already on device (the async loop:
         outputs of step N feed step N+1 without a host round trip; reference:
@@ -625,4 +674,111 @@ class ModelWrapper:
         """
         bucket = self.select_bucket(total_len)
         with jax.set_mesh(self._mesh):
-            return self._programs[bucket](params, cache, device_batch)
+            return self._run_program(bucket, params, cache, device_batch)
+
+
+class MultiStepTKGWrapper(ModelWrapper):
+    """The ``tkg_multistep`` submodel: one AOT-compiled program per
+    (step-rung, KV-bucket) pair running K chained decode steps per dispatch
+    (models/base.py ``multi_step_token_gen``).
+
+    The step ladder (autobucketing.multistep_step_ladder) exists for the
+    generation tail: a request with 3 tokens left dispatches the 4-step rung,
+    not the full-K scan. ``lookahead = max_steps - 1`` widens KV-bucket
+    selection so every in-window write position stays inside the compiled
+    window (same mechanism as the speculation wrappers).
+
+    Host contract additions over the plain TKG wrapper:
+      - ``eos_token_ids`` (B, E<=MULTISTEP_EOS_SLOTS) / ``pad_token_id`` (B,)
+        batch inputs drive in-scan EOS masking; both default to inert values
+        (-1 / 0) when the host omits them.
+      - ``batch_np["decode_steps"]`` (host int) picks the step rung; device
+        dispatch passes ``steps=`` explicitly.
+    """
+
+    def __init__(self, *args, steps_ladder: Sequence[int], **kwargs):
+        super().__init__(*args, **kwargs)
+        self.steps_ladder = sorted(steps_ladder)
+        self.max_steps = self.steps_ladder[-1]
+        # in-window writes reach position + steps - 1
+        self.lookahead = self.max_steps - 1
+        self.extra_inputs.setdefault(
+            "eos_token_ids", ((MULTISTEP_EOS_SLOTS,), np.int32)
+        )
+        self.extra_inputs.setdefault("pad_token_id", ((), np.int32))
+        self._steps_hint = self.max_steps
+        self._steps_building = self.max_steps
+
+    def make_forward(self, bucket: int):
+        from nxdi_tpu.models.base import multi_step_token_gen
+
+        return partial(
+            multi_step_token_gen,
+            self.arch,
+            self.inv_freq,
+            num_steps=self._steps_building,
+            kv_window=bucket,
+            policy=self.policy,
+            layout=self.layout,
+            **self.forward_kwargs,
+        )
+
+    def build(self, mesh, param_shardings, cache_shardings) -> None:
+        self._mesh = mesh
+        self._param_shardings = param_shardings
+        self._cache_shardings = cache_shardings
+        for steps in self.steps_ladder:
+            self._steps_building = steps
+            for bucket in self.buckets:
+                prog = self._make_program(
+                    bucket, mesh, param_shardings, cache_shardings
+                )
+                prog.label = f"{self.tag}[k{steps},{bucket}]"
+                self._programs[(steps, bucket)] = prog
+        self._steps_building = self.max_steps
+
+    def _example_for_key(self, key):
+        return self.example_batch(key[1])
+
+    def select_steps(self, remaining: Optional[int] = None) -> int:
+        if remaining is None:
+            return self.max_steps
+        return autobucketing.get_target_steps(remaining, self.steps_ladder)
+
+    def forward(self, params, cache, batch_np):
+        batch_np = dict(batch_np)
+        steps = int(batch_np.pop("decode_steps", self.max_steps))
+        if steps not in self.steps_ladder:
+            raise ValueError(
+                f"{self.tag}: decode_steps {steps} is not a compiled rung "
+                f"({self.steps_ladder})"
+            )
+        self._steps_hint = steps
+        b = np.asarray(batch_np["input_ids"]).shape[0]
+        if "eos_token_ids" not in batch_np:
+            batch_np["eos_token_ids"] = np.full(
+                (b, MULTISTEP_EOS_SLOTS), -1, dtype=np.int32
+            )
+        if "pad_token_id" not in batch_np:
+            batch_np["pad_token_id"] = np.zeros((b,), dtype=np.int32)
+        return super().forward(params, cache, batch_np)
+
+    def _run_program(self, bucket, params, cache, device_batch):
+        return self._programs[(self._steps_hint, bucket)](
+            params, cache, device_batch
+        )
+
+    def forward_device(
+        self, params, cache, device_batch, total_len: int,
+        steps: Optional[int] = None,
+    ):
+        self._steps_hint = steps if steps is not None else self.max_steps
+        return super().forward_device(params, cache, device_batch, total_len)
+
+    def warmup_batches(self):
+        # every (step rung, bucket) pair is its own compiled program — a
+        # warmed max-K rung does not cover the tail rungs
+        for steps in self.steps_ladder:
+            for batch in super().warmup_batches():
+                batch["decode_steps"] = steps
+                yield batch

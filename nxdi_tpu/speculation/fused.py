@@ -24,6 +24,19 @@ TPU-native shape of the same idea:
   *last* drafted token's KV is written too — without it, a fully-accepted window
   would leave a KV hole at its final position.
 
+Window slimming (round 6): ~45% of the measured bs1 window was in-graph loop
+machinery, not draft+verify compute. Two structural cuts:
+
+- the draft scan no longer re-lays/commits the FULL draft cache every step:
+  fresh K/V land in a small (L, B, KV, spec_len+1, D) scratch carried through
+  the scan (the old cache is closed over read-only, its window positions
+  masked), and the whole window commits with ONE multi-row scatter after the
+  scan (models/base.py ``spec_window`` path);
+- the accept-gather is fused into the verify program: the target emits its
+  greedy token per candidate position in-graph (``output_argmax_all``), so the
+  (B, spec_len+1, V) fp32 logits never cross a program boundary and acceptance
+  is pure (B, spec_len+1) token arithmetic.
+
 Greedy acceptance note: emitted tokens are the TARGET's greedy tokens at every
 position, so fused-spec output is bit-identical to target-only greedy decoding
 regardless of draft quality — drafts only change how many tokens each dispatch
@@ -38,8 +51,9 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT
+from nxdi_tpu.kvcache.kv_cache import DEFAULT_KV_LAYOUT, ContiguousKVLayout
 from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.ops import sampling as sampling_ops
 from nxdi_tpu.parallel.policy import DEFAULT_POLICY
 from nxdi_tpu.runtime.model_wrapper import ModelWrapper
 
@@ -122,39 +136,115 @@ def fused_spec_token_gen(
     pos0 = batch["position_ids"].astype(jnp.int32)  # (B, 1)
     lti = jnp.zeros((B,), jnp.int32)
     sp = batch["sampling_params"]
+    d_lay = draft_layout if draft_layout is not None else layout
+    d_cache0 = cache["draft"]
+    W = spec_len + 1
 
     # -- draft loop: spec_len+1 greedy single-token steps (see module docstring
     # for why the extra step). ys collect each step's INPUT token, so the
     # stacked ys are exactly the candidate tokens [t_cur, d_1, ..., d_k].
-    def draft_step(carry, _):
-        tok, pos, dcache = carry
-        dbatch = {
-            "input_ids": tok,
-            "position_ids": pos,
-            "last_token_index": lti,
-            "sampling_params": sp,
-        }
-        if "seq_ids" in batch:
-            dbatch["seq_ids"] = batch["seq_ids"]
-        out, dcache = causal_lm_forward(
-            draft_arch,
-            draft_inv_freq,
-            params["draft"],
-            dcache,
-            dbatch,
-            attend_to_cache=True,
-            kv_window=kv_window,
-            policy=policy,
-            layout=draft_layout if draft_layout is not None else layout,
-            gather_last_token=False,
-            on_device_sampling=True,
-        )
-        nxt = out["tokens"].astype(jnp.int32)  # (B, 1) greedy draft token
-        return (nxt, pos + 1, dcache), tok
-
-    (_, _, d_cache), fed = jax.lax.scan(
-        draft_step, (tok0, pos0, cache["draft"]), None, length=spec_len + 1
+    #
+    # SLIM path (the default): the scan carries a small (L, B, KV, W, D)
+    # scratch window instead of round-tripping + committing the FULL draft
+    # cache every step — each step attends [old cache, window positions
+    # masked] + [scratch], and the whole window lands in the draft cache with
+    # ONE multi-row commit after the scan (models/base.py spec_window path).
+    # Ring/paged/quantized-store/MLA drafts keep the per-step-commit scan.
+    slim = (
+        isinstance(d_lay, ContiguousKVLayout)
+        and not d_lay.has_array_scales()
+        and getattr(d_lay, "k_scale", 1.0) == 1.0
+        and getattr(d_lay, "v_scale", 1.0) == 1.0
+        and "k_win" not in d_cache0
+        and draft_arch.mla is None
+        and draft_arch.pp_degree == 1
+        and d_cache0["k"].dtype == d_cache0["v"].dtype
+        and str(d_cache0["k"].dtype) == draft_arch.dtype
     )
+    if slim:
+        L = d_cache0["k"].shape[0]
+        KV, D = draft_arch.num_kv_heads, draft_arch.head_dim
+        Dv = draft_arch.v_head_dim or D
+        win_pos = pos0 + jnp.arange(W, dtype=jnp.int32)[None, :]  # (B, W)
+        k_sp0 = jnp.zeros((L, B, KV, W, D), d_cache0["k"].dtype)
+        v_sp0 = jnp.zeros((L, B, KV, W, Dv), d_cache0["v"].dtype)
+
+        def draft_step(carry, slot):
+            tok, pos, k_sp, v_sp = carry
+            dbatch = {
+                "input_ids": tok,
+                "position_ids": pos,
+                "last_token_index": lti,
+                "sampling_params": sp,
+                "spec_win_pos": win_pos,
+                "spec_win_slot": slot,
+            }
+            if "seq_ids" in batch:
+                dbatch["seq_ids"] = batch["seq_ids"]
+            dc = {
+                "k": d_cache0["k"], "v": d_cache0["v"],
+                "k_spec": k_sp, "v_spec": v_sp,
+            }
+            out, dc = causal_lm_forward(
+                draft_arch,
+                draft_inv_freq,
+                params["draft"],
+                dc,
+                dbatch,
+                attend_to_cache=True,
+                kv_window=kv_window,
+                policy=policy,
+                layout=d_lay,
+                gather_last_token=False,
+                on_device_sampling=True,
+            )
+            nxt = out["tokens"].astype(jnp.int32)  # (B, 1) greedy draft token
+            return (nxt, pos + 1, dc["k_spec"], dc["v_spec"]), tok
+
+        (_, _, k_sp, v_sp), fed = jax.lax.scan(
+            draft_step, (tok0, pos0, k_sp0, v_sp0),
+            jnp.arange(W, dtype=jnp.int32),
+        )
+        ci_commit = {"position_ids": win_pos}
+        if "seq_ids" in batch:
+            ci_commit["seq_ids"] = batch["seq_ids"]
+        d_spec = draft_arch.kv_cache_spec(
+            d_cache0["k"].shape[1], d_cache0["k"].shape[3]
+        )
+        d_cache = d_lay.commit_rows(
+            {"k": d_cache0["k"], "v": d_cache0["v"]},
+            k_sp, v_sp, ci_commit, d_spec, policy=policy,
+        )
+    else:
+        def draft_step(carry, _):
+            tok, pos, dcache = carry
+            dbatch = {
+                "input_ids": tok,
+                "position_ids": pos,
+                "last_token_index": lti,
+                "sampling_params": sp,
+            }
+            if "seq_ids" in batch:
+                dbatch["seq_ids"] = batch["seq_ids"]
+            out, dcache = causal_lm_forward(
+                draft_arch,
+                draft_inv_freq,
+                params["draft"],
+                dcache,
+                dbatch,
+                attend_to_cache=True,
+                kv_window=kv_window,
+                policy=policy,
+                layout=d_lay,
+                gather_last_token=False,
+                on_device_sampling=True,
+            )
+            nxt = out["tokens"].astype(jnp.int32)  # (B, 1) greedy draft token
+            return (nxt, pos + 1, dcache), tok
+
+        (_, _, d_cache), fed = jax.lax.scan(
+            draft_step, (tok0, pos0, d_cache0), None, length=W
+        )
     candidates = jnp.swapaxes(fed[:, :, 0], 0, 1)  # (B, spec_len+1)
 
     # -- target verify: one multi-token forward over the candidates
@@ -181,10 +271,14 @@ def fused_spec_token_gen(
         policy=policy,
         layout=layout,
         gather_last_token=False,
-        output_all_logits=True,
+        # accept-gather fused into the verify program: the greedy token at
+        # every candidate position is selected in-graph (argmax over the
+        # vocab-sharded logits), so the (B, k+1, V) fp32 logits never
+        # materialize as a program output — acceptance below runs on tokens
+        output_argmax_all=True,
         on_device_sampling=False,
     )
-    target_tokens = jnp.argmax(t_out["logits"], axis=-1).astype(jnp.int32)  # (B, k+1)
+    target_tokens = t_out["tokens"].astype(jnp.int32)  # (B, k+1)
 
     # -- acceptance: longest prefix of drafts matching the target's greedy
     # choice (reference: _speculative_token_selection model_base.py:1773)
@@ -208,7 +302,7 @@ def fused_spec_token_gen(
             "sampling_params": sp,
         }
         if "rng" in batch:
-            nxt["rng"] = jax.random.split(batch["rng"], 1)[0]
+            nxt["rng"] = sampling_ops.next_step_rng(batch["rng"])
         outputs["next_inputs"] = nxt
     return outputs, {
         "draft": d_cache,

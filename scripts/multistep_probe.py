@@ -1,8 +1,12 @@
 #!/usr/bin/env python
 """Multi-step decode probe: K decode steps fused into ONE dispatched program
 (lax.scan over the device-resident step) vs the per-step chain; measured
-8.909 vs 8.385 ms/step on v5e — dispatch overhead is NOT the decode gap
-(the async chain already pipelines dispatches). One JSON line."""
+8.909 vs 8.385 ms/step at bs32 on v5e — at LARGE batch dispatch overhead is
+NOT the decode gap (the async chain already pipelines dispatches). The
+PRODUCTIZED path is `TpuConfig(decode_steps_per_dispatch=K)` -> the
+`tkg_multistep` submodel (models/base.py multi_step_token_gen; benched by
+`bench.py --decode-steps-per-dispatch K`), whose lever is the small-batch /
+bs1 regime the round-5 verdict flagged. One JSON line."""
 import json
 import os
 import sys

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Shard the test suite over N parallel pytest processes — plain stdlib, no
+pytest-xdist needed (the CI container doesn't ship it).
+
+Shards at FILE granularity (like xdist's --dist loadfile) so each module's
+tiny-HF fixtures build once, balanced by file size as a cheap runtime proxy.
+Exit code is 0 iff every shard passes.
+
+    python scripts/test_sharded.py          # 8 shards
+    python scripts/test_sharded.py -n 4     # small machines
+    python scripts/test_sharded.py -- -k multistep   # extra pytest args
+"""
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument(
+        "-n", type=int, default=min(8, os.cpu_count() or 1),
+        help="parallel pytest processes (default: min(8, cpu count) — "
+        "sharding only pays when there are cores to back it)",
+    )
+    ap.add_argument("rest", nargs="*", help="extra pytest args (after --)")
+    args = ap.parse_args()
+
+    files = sorted(
+        (REPO / "tests").rglob("test_*.py"), key=lambda p: -p.stat().st_size
+    )
+    shards = [[] for _ in range(args.n)]
+    sizes = [0] * args.n
+    for f in files:  # greedy longest-first bin packing by file size
+        i = sizes.index(min(sizes))
+        shards[i].append(str(f.relative_to(REPO)))
+        sizes[i] += f.stat().st_size
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    t0 = time.time()
+    procs = []
+    for i, shard in enumerate(shards):
+        if not shard:
+            continue
+        log = REPO / f".pytest_shard_{i}.log"
+        cmd = [
+            sys.executable, "-m", "pytest", "-q",
+            "-p", "no:cacheprovider", "-p", "no:randomly",
+            *shard, *args.rest,
+        ]
+        procs.append((i, log, subprocess.Popen(
+            cmd, cwd=REPO, env=env,
+            stdout=open(log, "w"), stderr=subprocess.STDOUT,
+        )))
+
+    rc = 0
+    for i, log, p in procs:
+        code = p.wait()
+        if code == 5:  # no tests collected in this shard (e.g. under -k) — fine
+            code = 0
+        tail = "".join(open(log).readlines()[-2:]).strip().replace("\n", " | ")
+        print(f"[shard {i}] rc={code} {tail}", flush=True)
+        rc = rc or code
+    print(f"total {time.time() - t0:.0f}s rc={rc}")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,7 +23,9 @@ import jax  # noqa: E402
 # making the env var too late — set the config explicitly as well.
 if not _HW:
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    from nxdi_tpu import jax_compat
+
+    jax_compat.set_num_cpu_devices(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

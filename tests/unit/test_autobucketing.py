@@ -55,3 +55,24 @@ def test_chunk_size_buckets():
     assert generate_buckets_on_chunk_size(128, 100) == [128]
     got = generate_buckets_on_chunk_size(128, 1024)
     assert len(got) <= 3 and all(b % 128 == 0 for b in got) and got[-1] == 1024
+
+
+def test_multistep_step_ladder():
+    from nxdi_tpu.runtime.autobucketing import multistep_step_ladder
+
+    assert multistep_step_ladder(2) == [2]
+    assert multistep_step_ladder(1) == [2]
+    assert multistep_step_ladder(4) == [2, 4]
+    assert multistep_step_ladder(8) == [2, 4, 8]
+    assert multistep_step_ladder(6) == [2, 4, 6]
+
+
+def test_get_target_steps_picks_smallest_covering_rung():
+    from nxdi_tpu.runtime.autobucketing import get_target_steps
+
+    ladder = [2, 4, 8]
+    assert get_target_steps(1, ladder) == 2
+    assert get_target_steps(3, ladder) == 4
+    assert get_target_steps(8, ladder) == 8
+    # nothing covers: largest rung, host trims the overshoot
+    assert get_target_steps(100, ladder) == 8

@@ -1,0 +1,32 @@
+"""Calibration weight fingerprints must key on FULL tensor content: two
+linears with identical shape and identical corner block (tied or zero-heavy
+weights) previously merged into one amax bucket and silently shared a
+max-based input_scale (ADVICE r5)."""
+
+import numpy as np
+
+from nxdi_tpu.ops.quantization import _weight_fingerprint
+
+
+def test_same_corner_different_body_distinct():
+    a = np.zeros((16, 16), dtype=np.int8)
+    b = np.zeros((16, 16), dtype=np.int8)
+    b[8, 8] = 17  # outside every 4x4 corner sample
+    assert _weight_fingerprint(a) != _weight_fingerprint(b)
+
+
+def test_identical_content_stable():
+    a = np.arange(256, dtype=np.int8).reshape(16, 16)
+    assert _weight_fingerprint(a) == _weight_fingerprint(a.copy())
+
+
+def test_shape_still_part_of_key():
+    a = np.zeros((8, 32), dtype=np.int8)
+    b = np.zeros((32, 8), dtype=np.int8)
+    assert _weight_fingerprint(a) != _weight_fingerprint(b)
+
+
+def test_stacked_slices_distinct():
+    stacked = np.zeros((2, 8, 8), dtype=np.int8)
+    stacked[1, 5, 5] = 3
+    assert _weight_fingerprint(stacked[0]) != _weight_fingerprint(stacked[1])
