@@ -43,7 +43,6 @@ VOCAB = 128256
 
 
 def main():
-    import jax
     import jax.tree_util as jtu
     import ml_dtypes
 

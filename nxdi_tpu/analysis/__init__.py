@@ -1,0 +1,61 @@
+"""Static program analysis over the AOT-compiled submodel zoo.
+
+NxDI serves from a small, fixed set of AOT-compiled programs — which means
+nearly every production failure mode is statically visible in the lowered
+jaxpr/HLO before a single request is served: an undonated KV cache doubling
+HBM, a sharding-policy typo inserting an extra all-gather per layer, a silent
+fp32 upcast in a bf16 path, a weight baked into the graph as a constant, a
+stray retrace mid-serving.
+
+This package is the audit layer over that program set:
+
+- :mod:`~nxdi_tpu.analysis.checkers` — the checker suite (donation audit,
+  collective budget, dtype-drift lint, baked-constant lint, required kernel
+  strategies), each returning :class:`Finding` records.
+- :mod:`~nxdi_tpu.analysis.auditor` — :func:`audit_application` /
+  :func:`audit_wrapper` orchestration + JSON reports.
+- :mod:`~nxdi_tpu.analysis.budget` — expected collective counts derived from
+  the config's ShardingPolicy.
+- :mod:`~nxdi_tpu.analysis.retrace` — the serve-time retrace guard
+  (``TpuConfig.retrace_guard``).
+- :mod:`~nxdi_tpu.analysis.source_lint` — stdlib pyflakes-lite (unused
+  imports / undefined names) gating tier-1; mirrors the repo ``ruff.toml``.
+
+CLI: ``python -m nxdi_tpu.cli.lint`` (per-model JSON report, nonzero exit on
+violations).
+"""
+
+from nxdi_tpu.analysis.auditor import (
+    AuditReport,
+    ProgramReport,
+    audit_application,
+    audit_wrapper,
+    collective_summary,
+)
+from nxdi_tpu.analysis.budget import expected_collective_budget
+from nxdi_tpu.analysis.checkers import (
+    CHECKERS,
+    DEFAULT_CONST_THRESHOLD_BYTES,
+    Finding,
+    ProgramArtifacts,
+    missing_required_strategies,
+    required_strategy_error,
+)
+from nxdi_tpu.analysis.retrace import RetraceAfterServingError, RetraceGuard
+
+__all__ = [
+    "AuditReport",
+    "ProgramReport",
+    "audit_application",
+    "audit_wrapper",
+    "collective_summary",
+    "expected_collective_budget",
+    "CHECKERS",
+    "DEFAULT_CONST_THRESHOLD_BYTES",
+    "Finding",
+    "ProgramArtifacts",
+    "missing_required_strategies",
+    "required_strategy_error",
+    "RetraceAfterServingError",
+    "RetraceGuard",
+]

@@ -1,0 +1,296 @@
+"""Static program auditor over every AOT-lowered submodel program.
+
+Entry points:
+
+- :func:`audit_application` — build (no weights needed) and audit every
+  ``(submodel, bucket[, steps])`` program of an application; returns an
+  :class:`AuditReport` (JSON-able, one :class:`ProgramReport` per program).
+- :func:`audit_wrapper` — the same for a single ModelWrapper.
+- :func:`collective_summary` — cheap per-program collective counts from the
+  executables a *loaded* app already holds (no retracing; what the bench
+  probes print next to their latency lines).
+
+Auditing traces/lowers with abstract args exactly like ``aot_compile`` —
+weights never load, so the auditor runs anywhere the compiler runs (the lint
+CLI audits TPU-shaped programs from a CPU box via the same path tests use).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.tree_util as jtu
+
+from nxdi_tpu.analysis import hlo as hlo_views
+from nxdi_tpu.analysis.checkers import (
+    CHECKERS,
+    DEFAULT_CONST_THRESHOLD_BYTES,
+    Finding,
+    ProgramArtifacts,
+)
+from nxdi_tpu.jax_compat import (
+    lowered_donated_flags,
+    lowered_kept_args,
+    optimized_hlo_text,
+    stablehlo_text,
+)
+
+logger = logging.getLogger("nxdi_tpu")
+
+
+def _key_str(key) -> str:
+    if isinstance(key, tuple):
+        return "k" + ",".join(str(k) for k in key)
+    return str(key)
+
+
+def _leaf_paths(tree) -> List[str]:
+    flat, _ = jtu.tree_flatten_with_path(tree)
+    return [jtu.keystr(path).lstrip(".") or str(i) for i, (path, _) in enumerate(flat)]
+
+
+@dataclass
+class ProgramReport:
+    tag: str
+    key: Any
+    label: str
+    collectives: Dict[str, int] = field(default_factory=dict)
+    budget: Dict[str, int] = field(default_factory=dict)
+    cache_inputs: int = 0
+    donated_cache_inputs: int = 0
+    strategies: List[str] = field(default_factory=list)
+    largest_const_bytes: int = 0
+    findings: List[Finding] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        return {
+            "submodel": self.tag,
+            "program": self.label,
+            "key": _key_str(self.key),
+            "collectives": self.collectives,
+            "collective_budget": self.budget,
+            "cache_inputs": self.cache_inputs,
+            "donated_cache_inputs": self.donated_cache_inputs,
+            "attention_strategies": self.strategies,
+            "largest_const_bytes": self.largest_const_bytes,
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+@dataclass
+class AuditReport:
+    programs: List[ProgramReport] = field(default_factory=list)
+    retrace: Optional[dict] = None
+
+    @property
+    def findings(self) -> List[Finding]:
+        return [f for p in self.programs for f in p.findings]
+
+    def errors(self) -> List[Finding]:
+        return [f for f in self.findings if f.severity == "error"]
+
+    def ok(self, fail_on: str = "error") -> bool:
+        if fail_on == "warning":
+            return not self.findings
+        return not self.errors()
+
+    def to_dict(self, fail_on: str = "error") -> dict:
+        d = {
+            "ok": self.ok(fail_on=fail_on),
+            "programs": [p.to_dict() for p in self.programs],
+            "n_findings": len(self.findings),
+        }
+        if self.retrace is not None:
+            d["retrace_guard"] = self.retrace
+        return d
+
+    def to_json(self, indent: int = 2, fail_on: str = "error") -> str:
+        return json.dumps(self.to_dict(fail_on=fail_on), indent=indent)
+
+    def collective_lines(self) -> Dict[str, Dict[str, int]]:
+        """{program label: nonzero collective counts} — the probes' summary."""
+        return {
+            p.label: {op: n for op, n in p.collectives.items() if n}
+            for p in self.programs
+        }
+
+
+def _max_const_bytes(closed_jaxpr) -> int:
+    import numpy as np
+
+    best = 0
+    try:
+        for c in closed_jaxpr.consts:
+            best = max(best, int(np.asarray(c).nbytes))
+    except Exception:
+        pass
+    return best
+
+
+def audit_wrapper(
+    wrapper,
+    params_struct,
+    cache_struct,
+    config=None,
+    checkers: Optional[Sequence[str]] = None,
+    const_threshold: int = DEFAULT_CONST_THRESHOLD_BYTES,
+    reuse_compiled: bool = True,
+) -> List[ProgramReport]:
+    """Audit every compiled program of one ModelWrapper.
+
+    ``params_struct`` / ``cache_struct`` are the abstract pytrees the app's
+    ``aot_compile`` uses (ShapeDtypeStructs, shardings attached here).
+    """
+    from nxdi_tpu.models import base as base_mod
+
+    config = config or wrapper.config
+    names = list(checkers) if checkers is not None else list(CHECKERS)
+
+    def attach(struct, shardings):
+        return jtu.tree_map(
+            lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+            struct, shardings,
+        )
+
+    ps = attach(params_struct, wrapper._param_shardings)
+    cs = attach(cache_struct, wrapper._cache_shardings)
+    n_param_leaves = len(jtu.tree_leaves(ps))
+    cache_paths = tuple(_leaf_paths(cs))
+
+    reports = []
+    for key, prog in wrapper._programs.items():
+        label = getattr(prog, "label", f"{wrapper.tag}[{_key_str(key)}]")
+        report = ProgramReport(tag=wrapper.tag, key=key, label=label)
+        reports.append(report)
+        try:
+            example = wrapper._example_for_key(key)
+            with jax.set_mesh(wrapper._mesh):
+                base_mod._STRATEGY_TRACE.clear()
+                traced = None
+                if hasattr(prog.jitted, "trace"):
+                    traced = prog.jitted.trace(ps, cs, example)
+                    lowered = traced.lower()
+                else:  # very old jax: no Traced stage
+                    lowered = prog.jitted.lower(ps, cs, example)
+                strategies = tuple(base_mod._STRATEGY_TRACE) or tuple(
+                    prog.attention_strategies
+                )
+                if reuse_compiled and prog._compiled is not None:
+                    compiled = prog._compiled
+                else:
+                    compiled = lowered.compile()
+        except Exception as e:  # an unauditable program is itself a finding
+            report.findings.append(Finding(
+                "auditor", "error", wrapper.tag, label,
+                f"program could not be traced/lowered for audit: {type(e).__name__}: {e}",
+            ))
+            continue
+
+        art = ProgramArtifacts(
+            wrapper=wrapper,
+            tag=wrapper.tag,
+            key=key,
+            label=label,
+            config=config,
+            arch=wrapper.arch,
+            jaxpr=traced.jaxpr if traced is not None else None,
+            stablehlo=stablehlo_text(lowered),
+            hlo=optimized_hlo_text(compiled),
+            strategies=strategies,
+            n_param_leaves=n_param_leaves,
+            cache_paths=cache_paths,
+            kept_args=lowered_kept_args(lowered),
+            donated_flags=lowered_donated_flags(lowered),
+            const_threshold=const_threshold,
+        )
+        for name in names:
+            try:
+                report.findings.extend(CHECKERS[name](art))
+            except Exception as e:
+                report.findings.append(Finding(
+                    "auditor", "warning", wrapper.tag, label,
+                    f"checker {name!r} crashed: {type(e).__name__}: {e}",
+                ))
+
+        report.collectives = art.collectives or (
+            hlo_views.collective_counts(art.hlo) if art.hlo else {}
+        )
+        from nxdi_tpu.analysis.budget import expected_collective_budget
+
+        report.budget = expected_collective_budget(
+            config.tpu_config, wrapper.arch, wrapper
+        )[0]
+        report.strategies = list(strategies)
+        report.cache_inputs = len(cache_paths)
+        if art.stablehlo is not None:
+            report.donated_cache_inputs = min(
+                len(hlo_views.aliased_arg_positions(art.stablehlo)),
+                len(cache_paths),
+            )
+        if traced is not None:
+            report.largest_const_bytes = _max_const_bytes(traced.jaxpr)
+    return reports
+
+
+def audit_application(
+    app,
+    submodels: Optional[Sequence[str]] = None,
+    checkers: Optional[Sequence[str]] = None,
+    const_threshold: int = DEFAULT_CONST_THRESHOLD_BYTES,
+    reuse_compiled: bool = True,
+) -> AuditReport:
+    """Audit every submodel program of an application (weights not required)."""
+    app._build_wrappers()
+    params_struct = app.build_params_struct()
+    cache_struct = app._cache_struct()
+    report = AuditReport()
+    for tag, wrapper in app.models.items():
+        if submodels is not None and tag not in submodels:
+            continue
+        try:
+            report.programs.extend(audit_wrapper(
+                wrapper, params_struct, cache_struct, config=app.config,
+                checkers=checkers, const_threshold=const_threshold,
+                reuse_compiled=reuse_compiled,
+            ))
+        except Exception as e:
+            report.programs.append(ProgramReport(
+                tag=tag, key=None, label=tag,
+                findings=[Finding(
+                    "auditor", "warning", tag, tag,
+                    f"wrapper could not be audited: {type(e).__name__}: {e}",
+                )],
+            ))
+    guard = getattr(app, "retrace_guard", None)
+    if guard is not None:
+        report.retrace = guard.to_dict()
+        for msg in guard.violations:
+            report.programs.append(ProgramReport(
+                tag="<runtime>", key=None, label="<retrace-guard>",
+                findings=[Finding(
+                    "retrace", "error", "<runtime>", "<retrace-guard>", msg,
+                )],
+            ))
+    return report
+
+
+def collective_summary(app) -> Dict[str, Dict[str, int]]:
+    """Per-program nonzero collective counts from the executables a LOADED
+    app already holds — zero retracing/recompilation, safe on the hot path."""
+    out: Dict[str, Dict[str, int]] = {}
+    for tag, wrapper in getattr(app, "models", {}).items():
+        for key, prog in getattr(wrapper, "_programs", {}).items():
+            compiled = getattr(prog, "_compiled", None)
+            if compiled is None:
+                continue
+            text = optimized_hlo_text(compiled)
+            if text is None:
+                continue
+            counts = hlo_views.collective_counts(text)
+            label = getattr(prog, "label", f"{tag}[{_key_str(key)}]")
+            out[label] = {op: n for op, n in counts.items() if n}
+    return out

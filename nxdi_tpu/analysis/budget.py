@@ -1,0 +1,145 @@
+"""Expected collective budget per compiled submodel program.
+
+The budget is derived from what ``parallel/policy.py`` SHOULD produce for the
+config — deliberately NOT from the ``ShardingPolicy`` object the wrapper
+actually compiled with. If a policy regression sneaks sharding into a program
+(the decode stream suddenly S-sharded, an extra replicated axis forcing
+all-gathers), the budget stays put and the observed counts blow past it;
+deriving the budget from the buggy policy itself would silently raise the
+ceiling along with the bug.
+
+Counts are *textual* upper bounds over the optimized HLO. The decoder layer
+stack runs under ``lax.scan`` (one ``while`` body in HLO), so the per-layer
+collectives appear once in text — budgets are therefore small constants per
+feature, not multiples of ``num_layers``. Unscanned (unrolled) model families
+can scale the body terms via ``layers_unrolled``.
+
+Every contribution is recorded as an ``explain`` string so a budget failure
+tells the reader what WAS allowed, not just that a number was exceeded.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+from nxdi_tpu.analysis.hlo import COLLECTIVE_OPS
+
+
+def _add(budget: Dict[str, int], explain: List[str], op: str, n: int, why: str) -> None:
+    if n <= 0:
+        return
+    budget[op] += n
+    explain.append(f"+{n} {op}: {why}")
+
+
+def expected_collective_budget(
+    tc, arch, wrapper
+) -> Tuple[Dict[str, int], List[str]]:
+    """Upper-bound collective counts for one submodel program.
+
+    ``tc``: TpuConfig — the source of truth for which policy the submodel is
+    *supposed* to run. ``arch``: the wrapper's DecoderArch (layer count, MoE).
+    ``wrapper``: the ModelWrapper (decode-vs-prefill kind, speculation).
+    """
+    budget = {op: 0 for op in COLLECTIVE_OPS}
+    explain: List[str] = []
+
+    world = tc.tp_degree * getattr(tc, "pp_degree", 1)
+    if world <= 1:
+        explain.append("single-device mesh: every collective is unexplained")
+        return budget, explain
+
+    decode_like = wrapper.attend_to_cache and not wrapper.prefill_to_cache
+    # which collective-inducing features the EXPECTED policy engages — owned
+    # by parallel/policy.py so policy changes and budgets evolve together
+    from nxdi_tpu.parallel.policy import expected_policy_features
+
+    feats = expected_policy_features(tc, decode_like)
+    # fused speculation runs TWO decoder stacks (draft + target) per program
+    stacks = 2 if getattr(wrapper, "draft_arch", None) is not None else 1
+    # unrolled families pay the body terms per layer; scanned (default) once
+    body_scale = stacks * (
+        arch.num_layers if getattr(wrapper, "layers_unrolled", False) else 1
+    )
+
+    if tc.tp_degree > 1:
+        _add(budget, explain, "all-reduce", 2 * body_scale,
+             "row-parallel attn-out + mlp-down psum (scanned layer body)")
+        _add(budget, explain, "all-reduce", 2 * stacks,
+             "final-norm / lm_head epilogue reduction")
+        if tc.on_device_sampling_config is not None:
+            _add(budget, explain, "all-gather", 3 * stacks,
+                 "on-device sampling cross-shard top-k gather (values+indices)")
+        if tc.output_logits:
+            _add(budget, explain, "all-gather", 1,
+                 "full-logits output gather (vocab-parallel lm_head)")
+
+    if feats["sp"]:
+        _add(budget, explain, "all-gather", 5 * body_scale,
+             "SP: S-sharded stream gathered at QKV/MLP boundaries")
+        _add(budget, explain, "reduce-scatter", 3 * body_scale,
+             "SP: row-parallel psums become reduce-scatters")
+        _add(budget, explain, "all-to-all", 2 * body_scale,
+             "SP: partitioner resharding between S- and H-sharded views")
+        _add(budget, explain, "all-reduce", 2 * body_scale,
+             "SP: residual-stream reductions the partitioner keeps as psum")
+    if feats["cp"]:
+        _add(budget, explain, "all-gather", 4 * body_scale,
+             "CP: KV all-gathered within the cp group per attention")
+        _add(budget, explain, "reduce-scatter", 2 * body_scale,
+             "CP: S-sharded stream scatter at block exits")
+        _add(budget, explain, "all-to-all", 2 * body_scale,
+             "CP: head<->sequence resharding around attention")
+    if feats["mlp_cp"]:
+        _add(budget, explain, "all-gather", 3 * body_scale,
+             "MLP-CP: MLP stream gathered back to the replicated residual")
+        _add(budget, explain, "reduce-scatter", 1 * body_scale,
+             "MLP-CP: scatter into the S-sharded MLP stream")
+        _add(budget, explain, "all-to-all", 1 * body_scale,
+             "MLP-CP: partitioner resharding at the MLP boundary")
+
+    if feats["flash_decoding"]:
+        _add(budget, explain, "all-reduce", 2 * body_scale,
+             "flash decoding: distributed softmax over KV-S shards")
+        _add(budget, explain, "all-gather", 2 * body_scale,
+             "flash decoding: per-shard partial attention assembly")
+    if feats["attention_dp"]:
+        _add(budget, explain, "all-gather", 3 * body_scale,
+             "attention-DP: batch-sharded decode regrouped at block exits")
+        _add(budget, explain, "all-to-all", 2 * body_scale,
+             "attention-DP: batch<->head resharding around attention")
+        _add(budget, explain, "collective-permute", 2 * body_scale,
+             "attention-DP: dp-group rotation")
+        _add(budget, explain, "all-reduce", 1 * body_scale,
+             "attention-DP: cross-group reduction")
+
+    if getattr(arch, "moe", None) is not None:
+        _add(budget, explain, "all-to-all", 4 * body_scale,
+             "MoE: token dispatch/combine over the expert axis")
+        _add(budget, explain, "all-gather", 4 * body_scale,
+             "MoE: router logits / expert outputs regrouped")
+        _add(budget, explain, "all-reduce", 2 * body_scale,
+             "MoE: expert-parallel partial-sum reduction")
+
+    if tc.quantized:
+        _add(budget, explain, "all-reduce", 1 * body_scale,
+             "quantized matmul: scale/accumulator reduction")
+
+    if getattr(tc, "pp_degree", 1) > 1:
+        _add(budget, explain, "collective-permute", 4,
+             "pipeline parallel: stage-boundary activation shifts")
+        _add(budget, explain, "all-gather", 2,
+             "pipeline parallel: final-stage output broadcast")
+
+    return budget, explain
+
+
+def over_budget(
+    observed: Dict[str, int], budget: Dict[str, int]
+) -> Dict[str, Tuple[int, int]]:
+    """``{op: (observed, budget)}`` for every op type exceeding its budget."""
+    return {
+        op: (observed.get(op, 0), budget.get(op, 0))
+        for op in COLLECTIVE_OPS
+        if observed.get(op, 0) > budget.get(op, 0)
+    }

@@ -1,0 +1,340 @@
+"""The checker suite the program auditor runs over every lowered submodel.
+
+Each checker is a pure function ``(ProgramArtifacts) -> [Finding]`` over the
+static views of one compiled program (jaxpr, StableHLO, optimized HLO, the
+attention-strategy trace). Registered in :data:`CHECKERS`; the auditor runs
+all of them unless told otherwise.
+
+Checkers never raise on a violation — they return findings, so one bad
+program cannot mask another's report. The CLI and the pytest wiring decide
+what severity fails the run.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from nxdi_tpu.analysis import hlo as hlo_views
+from nxdi_tpu.analysis.budget import expected_collective_budget, over_budget
+
+#: captured constants larger than this are "a weight baked into the graph"
+DEFAULT_CONST_THRESHOLD_BYTES = 512 * 1024
+
+#: low-precision source dtypes whose upcast to fp32 counts as drift
+_LOW_DTYPES = ("bfloat16", "float16", "float8_e4m3fn", "float8_e5m2")
+
+#: function-name fragments (matched against the nxdi_tpu frames of an op's
+#: traceback) where fp32 compute is intentional policy
+DTYPE_DRIFT_ALLOWLIST = (
+    "norm",        # rms_norm / layer_norm: fp32 variance per softmax_dtype
+    "softmax",     # attention + sampling softmax
+    "rotary",      # rope tables are fp32 by design
+    "rope",
+    "sample",      # sampling math on logits
+    "topk",
+    "top_k",
+    "logit",       # logits processors / penalties
+    "moe_router",  # router softmax precision
+)
+
+
+@dataclass
+class Finding:
+    """One violation (or notable observation) for one compiled program."""
+
+    checker: str
+    severity: str  # "error" | "warning"
+    submodel: str
+    program: str  # e.g. "token_generation_model[64]" / "tkg_multistep[k4,128]"
+    message: str
+
+    def to_dict(self) -> Dict[str, str]:
+        return {
+            "checker": self.checker,
+            "severity": self.severity,
+            "submodel": self.submodel,
+            "program": self.program,
+            "message": self.message,
+        }
+
+    def __str__(self) -> str:
+        return f"[{self.severity}] {self.program} {self.checker}: {self.message}"
+
+
+@dataclass
+class ProgramArtifacts:
+    """Everything a checker may look at for one (submodel, bucket) program."""
+
+    wrapper: Any  # ModelWrapper
+    tag: str
+    key: Any  # bucket int, or (steps, bucket) for multi-step programs
+    label: str
+    config: Any  # InferenceConfig
+    arch: Any  # DecoderArch
+    jaxpr: Any = None  # ClosedJaxpr (None if tracing unavailable)
+    stablehlo: Optional[str] = None
+    hlo: Optional[str] = None
+    strategies: Tuple[str, ...] = ()
+    n_param_leaves: int = 0
+    cache_paths: Tuple[str, ...] = ()
+    kept_args: Optional[Tuple[int, ...]] = None  # flat indices kept by lowering
+    donated_flags: Optional[Tuple[bool, ...]] = None  # per flat arg
+    const_threshold: int = DEFAULT_CONST_THRESHOLD_BYTES
+    collectives: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def tc(self):
+        return self.config.tpu_config
+
+    def finding(self, checker: str, message: str, severity: str = "error") -> Finding:
+        return Finding(checker, severity, self.tag, self.label, message)
+
+
+# ---------------------------------------------------------------------------
+# 1. donation audit
+# ---------------------------------------------------------------------------
+
+def check_donation(art: ProgramArtifacts) -> List[Finding]:
+    """Every KV-cache input must alias an output buffer, or decode holds two
+    copies of the cache in HBM for the life of the program."""
+    if art.stablehlo is None:
+        return [art.finding("donation", "no StableHLO available to audit",
+                            severity="warning")]
+    findings: List[Finding] = []
+    aliased = hlo_views.aliased_arg_positions(art.stablehlo)
+    n_cache = len(art.cache_paths)
+
+    if art.kept_args is not None:
+        kept = sorted(art.kept_args)
+        pos_of_flat = {flat: pos for pos, flat in enumerate(kept)}
+        for ci, path in enumerate(art.cache_paths):
+            flat = art.n_param_leaves + ci
+            if art.donated_flags is not None and not art.donated_flags[flat]:
+                findings.append(art.finding(
+                    "donation",
+                    f"cache input '{path}' was compiled WITHOUT donation "
+                    "(donate_argnums missing) — the program keeps a second "
+                    "copy of this cache buffer",
+                ))
+                continue
+            if flat not in pos_of_flat:
+                findings.append(art.finding(
+                    "donation",
+                    f"cache input '{path}' is unused by the compiled program "
+                    "(pruned from the signature) — a decode program that "
+                    "never reads its cache is miswired",
+                    severity="warning",
+                ))
+                continue
+            if pos_of_flat[flat] not in aliased:
+                findings.append(art.finding(
+                    "donation",
+                    f"cache input '{path}' is donated but did NOT resolve to "
+                    "an input/output alias — XLA will materialize a second "
+                    f"{path} buffer (check output sharding/layout drift on "
+                    "the donated round trip)",
+                ))
+        return findings
+
+    # fallback when kept_var_idx is unavailable: count aliases vs cache leaves
+    if len(aliased) < n_cache:
+        findings.append(art.finding(
+            "donation",
+            f"only {len(aliased)} of {n_cache} cache inputs resolved to an "
+            "input/output alias — at least one cache buffer is doubled "
+            f"(cache leaves: {', '.join(art.cache_paths)})",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 2. collective budget
+# ---------------------------------------------------------------------------
+
+def check_collectives(art: ProgramArtifacts) -> List[Finding]:
+    """Observed collective counts must stay within the budget derived from
+    the config's expected ShardingPolicy (a typo'd policy inserts extras)."""
+    if art.hlo is None:
+        return [art.finding("collectives", "no optimized HLO available to audit",
+                            severity="warning")]
+    observed = art.collectives or hlo_views.collective_counts(art.hlo)
+    art.collectives = observed
+    budget, explain = expected_collective_budget(art.tc, art.arch, art.wrapper)
+    findings = []
+    for op, (got, allowed) in over_budget(observed, budget).items():
+        why = "; ".join(explain) if explain else "no collectives budgeted"
+        findings.append(art.finding(
+            "collectives",
+            f"{got} {op} ops in the compiled program exceed the policy "
+            f"budget of {allowed} — an unexplained collective usually means "
+            "a sharding-policy regression (budget: " + why + ")",
+        ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 3. dtype-drift lint
+# ---------------------------------------------------------------------------
+
+def _nxdi_frames(eqn) -> List[Tuple[str, str]]:
+    """(file, function) pairs of the eqn's traceback inside this package."""
+    tb = getattr(eqn.source_info, "traceback", None)
+    out = []
+    if tb is None:
+        return out
+    for f in tb.frames:
+        if "nxdi_tpu" in f.file_name:
+            import os
+
+            out.append((os.path.basename(f.file_name), f.function_name))
+    return out
+
+
+def _walk_jaxprs(jaxpr, visit: Callable[[Any], None]) -> None:
+    """Depth-first over a Jaxpr and every nested (closed) jaxpr in eqn params."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        stack = list(eqn.params.values())
+        while stack:
+            v = stack.pop()
+            if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+                _walk_jaxprs(v.jaxpr, visit)  # ClosedJaxpr
+            elif hasattr(v, "eqns"):
+                _walk_jaxprs(v, visit)  # raw Jaxpr
+            elif isinstance(v, (list, tuple)):
+                stack.extend(v)
+
+
+def check_dtype_drift(art: ProgramArtifacts) -> List[Finding]:
+    """Flag fp32 intermediates materialized from low-precision values outside
+    the allowlisted islands (norms, softmax, rope, sampling logits)."""
+    if art.jaxpr is None:
+        return [art.finding("dtype_drift", "no jaxpr available to audit",
+                            severity="warning")]
+    vocab = getattr(art.arch, "vocab_size", -1)
+    hits: List[Tuple[Tuple[int, ...], List[Tuple[str, str]]]] = []
+
+    def visit(eqn):
+        if eqn.primitive.name != "convert_element_type":
+            return
+        src = str(eqn.invars[0].aval.dtype)
+        dst = str(eqn.outvars[0].aval.dtype)
+        if src not in _LOW_DTYPES or dst not in ("float32", "float64"):
+            return
+        shape = tuple(eqn.outvars[0].aval.shape)
+        if shape and shape[-1] == vocab:
+            return  # sampling logits: fp32 on purpose
+        frames = _nxdi_frames(eqn)
+        names = " ".join(fn for _, fn in frames).lower()
+        if any(allowed in names for allowed in DTYPE_DRIFT_ALLOWLIST):
+            return
+        hits.append((shape, frames[:3]))
+
+    _walk_jaxprs(art.jaxpr.jaxpr, visit)
+    findings, seen = [], set()
+    for shape, frames in hits:
+        where = " <- ".join(f"{fn} ({f})" for f, fn in frames) or "<no traceback>"
+        msg = (
+            f"low-precision value upcast to fp32 at {where} (result shape "
+            f"{shape}) outside the allowlisted fp32 islands "
+            f"({', '.join(DTYPE_DRIFT_ALLOWLIST[:4])}, ...) — a silent fp32 "
+            "path doubles the bytes this intermediate streams"
+        )
+        if msg not in seen:
+            seen.add(msg)
+            findings.append(art.finding("dtype_drift", msg))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 4. baked-constant lint
+# ---------------------------------------------------------------------------
+
+def check_baked_constants(art: ProgramArtifacts) -> List[Finding]:
+    """Any captured constant above the size threshold is almost certainly a
+    weight closed over instead of passed as an argument — it is duplicated
+    into every program that closes over it and re-uploaded per executable."""
+    if art.jaxpr is None:
+        return [art.finding("baked_constants", "no jaxpr available to audit",
+                            severity="warning")]
+    findings = []
+
+    def scan_consts(consts):
+        for c in consts:
+            try:
+                nbytes = int(np.asarray(c).nbytes)
+                shape = tuple(np.asarray(c).shape)
+                dtype = str(np.asarray(c).dtype)
+            except Exception:
+                continue
+            if nbytes > art.const_threshold:
+                findings.append(art.finding(
+                    "baked_constants",
+                    f"captured constant {dtype}{list(shape)} ({nbytes} bytes "
+                    f"> threshold {art.const_threshold}) is baked into the "
+                    "graph — pass it as a program argument so it is stored "
+                    "once and shared across programs",
+                ))
+
+    scan_consts(art.jaxpr.consts)
+
+    def visit(eqn):
+        for v in eqn.params.values():
+            if hasattr(v, "consts"):
+                scan_consts(v.consts)
+            elif isinstance(v, (list, tuple)):
+                for x in v:
+                    if hasattr(x, "consts"):
+                        scan_consts(x.consts)
+
+    _walk_jaxprs(art.jaxpr.jaxpr, visit)
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 5. required kernel strategies (absorbed from _AutoLayoutProgram)
+# ---------------------------------------------------------------------------
+
+def missing_required_strategies(
+    strategies: Tuple[str, ...], required
+) -> List[Tuple[str, Tuple[str, ...]]]:
+    """``[(flag, acceptable_names), ...]`` for every enabled kernel flag none
+    of whose strategies engaged in the traced program. Shared by the runtime
+    lowering check (runtime/model_wrapper.py) and the audit-time checker."""
+    missing = []
+    for flag, names in required:
+        if not any(n in strategies for n in names):
+            missing.append((flag, tuple(names)))
+    return missing
+
+
+def required_strategy_error(label: str, flag: str, names) -> str:
+    return (
+        f"{label}: {flag} is enabled but none of its kernel "
+        f"strategies {tuple(names)} engaged in the compiled program — "
+        "the flag would be a silent no-op for this model/config; "
+        "disable it or use a supported configuration"
+    )
+
+
+def check_required_strategies(art: ProgramArtifacts) -> List[Finding]:
+    required = art.wrapper._required_strategies()
+    findings = []
+    for flag, names in missing_required_strategies(art.strategies, required):
+        findings.append(art.finding(
+            "required_strategies", required_strategy_error(art.label, flag, names)
+        ))
+    return findings
+
+
+#: name -> checker; the auditor runs these in order
+CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
+    "donation": check_donation,
+    "collectives": check_collectives,
+    "dtype_drift": check_dtype_drift,
+    "baked_constants": check_baked_constants,
+    "required_strategies": check_required_strategies,
+}

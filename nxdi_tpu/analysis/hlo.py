@@ -1,0 +1,105 @@
+"""Text-level views of a lowered/compiled program.
+
+The auditor never interprets HLO semantically — it counts and maps things
+that XLA spells out in the program text:
+
+- **StableHLO** (``lowered.as_text()``): the ``@main`` signature carries a
+  ``tf.aliasing_output`` / ``jax.buffer_donor`` attribute on every argument
+  whose donation RESOLVED to an output alias. A donated-but-unaliased cache
+  input is exactly the "two copies of the KV cache in HBM" failure mode.
+- **optimized HLO** (``compiled.as_text()``): collectives exist only after
+  the SPMD partitioner ran, so ``all-gather``/``all-reduce``/... are counted
+  here. The layer stack is a ``lax.scan`` (a ``while`` loop in HLO), so the
+  textual count is per *program*, not per layer — a policy regression that
+  adds one collective to the loop body shows up as +1, regardless of depth.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+#: collective op mnemonics as they appear in optimized HLO text.
+COLLECTIVE_OPS = (
+    "all-reduce",
+    "all-gather",
+    "reduce-scatter",
+    "collective-permute",
+    "all-to-all",
+)
+
+# an op DEFINITION: the opcode token directly before its operand paren —
+# `... f32[...] all-reduce(...)` and the async halves `... (f32[...],
+# f32[...]) all-reduce-start(...)` (tuple result types contain spaces, so the
+# opcode may follow a `)` + space, not a single type token). `-done` ops take
+# the start's tuple without a fresh operand list and are NOT counted again;
+# operand references (`%all-reduce.5`) are excluded by the preceding-char
+# class (never `%`/`.`).
+_COLLECTIVE_DEF_RE = re.compile(
+    r"(?:^|[\s)])("
+    + "|".join(op.replace("-", "[-]") for op in COLLECTIVE_OPS)
+    + r")(?:-start)?\(",
+    re.M,
+)
+
+
+def collective_counts(hlo_text: str) -> Dict[str, int]:
+    """Per-type counts of collective op *definitions* in optimized HLO."""
+    counts = {op: 0 for op in COLLECTIVE_OPS}
+    for m in _COLLECTIVE_DEF_RE.finditer(hlo_text):
+        counts[m.group(1)] += 1
+    return counts
+
+
+def _main_signature(stablehlo_text: str) -> Optional[str]:
+    """The argument list of ``func.func public @main(...)`` with nesting and
+    quoted strings (sharding attrs contain braces) handled."""
+    anchor = stablehlo_text.find("@main(")
+    if anchor < 0:
+        return None
+    i = anchor + len("@main(")
+    depth = 1
+    in_quote = False
+    out = []
+    while i < len(stablehlo_text) and depth > 0:
+        c = stablehlo_text[i]
+        if in_quote:
+            if c == '"' and stablehlo_text[i - 1] != "\\":
+                in_quote = False
+        elif c == '"':
+            in_quote = True
+        elif c in "(<[{":
+            depth += 1
+        elif c in ")>]}":
+            depth -= 1
+        if depth > 0:
+            out.append(c)
+        i += 1
+    return "".join(out)
+
+
+_ARG_RE = re.compile(r"%arg(\d+):")
+
+
+def main_arg_segments(stablehlo_text: str) -> List[Tuple[int, str]]:
+    """``[(arg_index, segment_text), ...]`` — one segment per ``@main`` arg,
+    covering its type and attribute dictionary."""
+    sig = _main_signature(stablehlo_text)
+    if sig is None:
+        return []
+    marks = list(_ARG_RE.finditer(sig))
+    segments = []
+    for j, m in enumerate(marks):
+        end = marks[j + 1].start() if j + 1 < len(marks) else len(sig)
+        segments.append((int(m.group(1)), sig[m.start():end]))
+    return segments
+
+
+def aliased_arg_positions(stablehlo_text: str) -> Set[int]:
+    """Positions (``%argN`` numbers) whose argument carries a resolved
+    input/output alias or donor mark."""
+    out = set()
+    for idx, seg in main_arg_segments(stablehlo_text):
+        if "tf.aliasing_output" in seg or "jax.buffer_donor" in seg:
+            out.add(idx)
+    return out

@@ -1,0 +1,67 @@
+"""Retrace guard: no program may lower after serving starts.
+
+The framework's whole design bet is a *fixed* set of AOT-compiled programs.
+A lowering that happens mid-serving (a bucket that was never warmed, a step
+rung the ladder missed, an input signature drifting to a new jit cache entry)
+blocks a request on multi-second compilation — statically avoidable, so it is
+treated as a lint-able event, not an acceptable hiccup.
+
+:class:`RetraceGuard` is owned by the application and shared by its wrappers:
+every ``_AutoLayoutProgram`` lowering reports its ``(submodel, bucket[,steps])``
+label here. ``seal()`` is called once warmup has run every program; any
+lowering after that raises or warns per ``TpuConfig.retrace_guard``
+("error" | "warn" | "off").
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List
+
+logger = logging.getLogger("nxdi_tpu")
+
+MODES = ("off", "warn", "error")
+
+
+class RetraceAfterServingError(RuntimeError):
+    """A submodel program lowered after the application started serving."""
+
+
+class RetraceGuard:
+    def __init__(self, mode: str = "warn"):
+        if mode not in MODES:
+            raise ValueError(f"retrace_guard mode must be one of {MODES}, got {mode!r}")
+        self.mode = mode
+        self.sealed = False
+        # label -> number of lowerings observed (pre- and post-seal)
+        self.lowerings: Dict[str, int] = {}
+        self.violations: List[str] = []
+
+    def record(self, label: str) -> None:
+        """Called by a program at every actual lowering."""
+        self.lowerings[label] = self.lowerings.get(label, 0) + 1
+        if not self.sealed or self.mode == "off":
+            return
+        known = sorted(k for k in self.lowerings if k != label)
+        msg = (
+            f"program {label} lowered AFTER serving started — a mid-serving "
+            "(re)trace blocks requests on compilation. Warm every "
+            "(submodel, bucket, steps) program before serving (compiled at "
+            f"seal time: {known or 'none'})"
+        )
+        self.violations.append(msg)
+        if self.mode == "error":
+            raise RetraceAfterServingError(msg)
+        logger.warning(msg)
+
+    def seal(self) -> None:
+        """Mark the program set complete: serving starts now."""
+        self.sealed = True
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode,
+            "sealed": self.sealed,
+            "lowerings": dict(self.lowerings),
+            "violations": list(self.violations),
+        }

@@ -1,0 +1,262 @@
+"""Stdlib pyflakes-lite: unused imports (F401) and undefined names (F821).
+
+The repo's lint policy lives in ``ruff.toml`` (pyflakes rules); this module
+is the zero-dependency enforcement of the two highest-value rules so the
+tier-1 suite gates them (``tests/unit/test_source_lint.py``) even on boxes
+where ``ruff`` is not installed. Rule numbers and the ``# noqa`` convention
+match ruff/pyflakes, so both tools agree on what is clean.
+
+- **F401**: a module-level or local import whose binding is never referenced
+  (by name, in ``__all__``, or re-exported via ``import x as x``).
+  ``__init__.py`` files are exempt (re-export surface), mirroring the
+  ``per-file-ignores`` stanza in ``ruff.toml``.
+- **F821**: a name referenced in some scope that no enclosing scope defines,
+  is not a builtin, and is not declared ``global``/``nonlocal`` — found via
+  :mod:`symtable`, i.e. the compiler's own scope analysis.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+import os
+import symtable
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence, Set
+
+_BUILTINS: Set[str] = set(dir(builtins)) | {
+    "__file__", "__name__", "__doc__", "__package__", "__spec__",
+    "__loader__", "__builtins__", "__debug__", "__path__", "__all__",
+    "__version__", "__class__",
+}
+
+
+@dataclass
+class LintError:
+    path: str
+    line: int
+    code: str  # "F401" | "F821"
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: {self.code} {self.message}"
+
+
+def _noqa_lines(source: str, code: str) -> Set[int]:
+    """1-based line numbers carrying a ``# noqa`` that silences ``code``."""
+    out = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        if "# noqa" not in line:
+            continue
+        tail = line.split("# noqa", 1)[1].strip()
+        if not tail.startswith(":") or code in tail:
+            out.add(i)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# F401 — unused imports
+# ---------------------------------------------------------------------------
+
+class _ImportCollector(ast.NodeVisitor):
+    def __init__(self) -> None:
+        # binding name -> (lineno, shown_as)
+        self.imports: dict = {}
+        self.used: Set[str] = set()
+        self.redundant_alias: Set[str] = set()  # `import x as x` re-export
+
+    def visit_Import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            name = alias.asname or alias.name.split(".")[0]
+            if alias.asname and alias.asname == alias.name:
+                self.redundant_alias.add(name)
+            lineno = getattr(alias, "lineno", node.lineno)
+            self.imports[name] = (lineno, alias.name)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom) -> None:
+        if node.module == "__future__":
+            return  # compiler directive, not a binding (pyflakes exempts it)
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            name = alias.asname or alias.name
+            if alias.asname and alias.asname == alias.name:
+                self.redundant_alias.add(name)
+            shown = f"{node.module or '.'}.{alias.name}"
+            lineno = getattr(alias, "lineno", node.lineno)
+            self.imports[name] = (lineno, shown)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, (ast.Load, ast.Del)):
+            self.used.add(node.id)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        self.generic_visit(node)
+
+    def _collect_strings(self, node) -> None:
+        import re
+
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                self.used.add(sub.value)
+                # a string annotation like "Optional[Bar]" uses Optional AND
+                # Bar — count every identifier token (pyflakes parses these;
+                # token extraction keeps the two tools agreeing)
+                self.used.update(
+                    re.findall(r"[A-Za-z_][A-Za-z0-9_]*", sub.value)
+                )
+
+
+def _string_uses(tree: ast.Module, collector: _ImportCollector) -> None:
+    """Names used as strings: ``__all__`` entries and string annotations."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = [t.id for t in node.targets if isinstance(t, ast.Name)]
+            if "__all__" in targets:
+                collector._collect_strings(node.value)
+        elif isinstance(node, ast.AnnAssign) and node.annotation is not None:
+            collector._collect_strings(node.annotation)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for arg in list(node.args.args) + list(node.args.kwonlyargs):
+                if arg.annotation is not None:
+                    collector._collect_strings(arg.annotation)
+            if node.returns is not None:
+                collector._collect_strings(node.returns)
+
+
+def unused_imports(path: str, source: str) -> List[LintError]:
+    if os.path.basename(path) == "__init__.py":
+        return []  # re-export surface (ruff per-file-ignores analog)
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [LintError(path, e.lineno or 0, "F401", f"syntax error: {e.msg}")]
+    c = _ImportCollector()
+    c.visit(tree)
+    _string_uses(tree, c)
+    noqa = _noqa_lines(source, "F401")
+    out = []
+    for name, (lineno, shown) in sorted(c.imports.items(), key=lambda kv: kv[1][0]):
+        if name in c.used or name in c.redundant_alias or lineno in noqa:
+            continue
+        out.append(LintError(path, lineno, "F401", f"{shown!r} imported but unused"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# F821 — undefined names
+# ---------------------------------------------------------------------------
+
+def _module_level_names(table: symtable.SymbolTable) -> Set[str]:
+    return {
+        s.get_name()
+        for s in table.get_symbols()
+        if s.is_imported() or s.is_assigned() or s.is_parameter() or s.is_local()
+    }
+
+
+def _scope_undefined(
+    table: symtable.SymbolTable,
+    module_names: Set[str],
+    enclosing: Set[str],
+    hits: List,  # (scope_table, name)
+) -> None:
+    local = {
+        s.get_name()
+        for s in table.get_symbols()
+        if s.is_local() or s.is_parameter() or s.is_imported() or s.is_assigned()
+    }
+    for s in table.get_symbols():
+        name = s.get_name()
+        if not s.is_referenced():
+            continue
+        if s.is_local() or s.is_parameter() or s.is_imported() or s.is_assigned():
+            continue
+        if s.is_free() or s.is_declared_global():
+            continue  # closed-over / explicit global: defined elsewhere by intent
+        if name in _BUILTINS or name in module_names or name in enclosing:
+            continue
+        hits.append((table, name))
+    for child in table.get_children():
+        _scope_undefined(child, module_names, enclosing | local, hits)
+
+
+def _usage_line(tree: ast.Module, scope_lineno: int, scope_name: str, name: str) -> int:
+    """Line of the first load of ``name`` inside the scope whose def/lambda/
+    class starts at ``scope_lineno`` — so ``# noqa: F821`` on the USE line
+    works (the ruff/pyflakes convention). Falls back to the scope line."""
+    scope_node = None
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                              ast.ClassDef))
+            and node.lineno == scope_lineno
+            and (isinstance(node, ast.Lambda) or getattr(node, "name", scope_name) == scope_name)
+        ):
+            scope_node = node
+            break
+    search_root = scope_node if scope_node is not None else tree
+    for sub in ast.walk(search_root):
+        if isinstance(sub, ast.Name) and sub.id == name and isinstance(sub.ctx, ast.Load):
+            return sub.lineno
+    return scope_lineno
+
+
+def undefined_names(path: str, source: str) -> List[LintError]:
+    try:
+        table = symtable.symtable(source, path, "exec")
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [LintError(path, e.lineno or 0, "F821", f"syntax error: {e.msg}")]
+    hits: List = []
+    module_names = _module_level_names(table)
+    noqa = _noqa_lines(source, "F821")
+    for child in table.get_children():
+        _scope_undefined(child, module_names, set(), hits)
+    # module scope itself: referenced globals never bound anywhere
+    for s in table.get_symbols():
+        name = s.get_name()
+        if (
+            s.is_referenced()
+            and not (s.is_imported() or s.is_assigned() or s.is_local())
+            and name not in _BUILTINS
+        ):
+            hits.append((table, name))
+    errors = []
+    for scope, name in hits:
+        line = _usage_line(tree, scope.get_lineno(), scope.get_name(), name)
+        if line in noqa:
+            continue
+        where = "" if scope.get_type() == "module" else f" (scope {scope.get_name()!r})"
+        errors.append(LintError(path, line, "F821", f"undefined name {name!r}{where}"))
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def lint_source(path: str, source: str) -> List[LintError]:
+    return unused_imports(path, source) + undefined_names(path, source)
+
+
+def iter_py_files(roots: Sequence[str]) -> Iterable[str]:
+    for root in roots:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d not in ("__pycache__", ".git")]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_paths(roots: Sequence[str], repo_root: Optional[str] = None) -> List[LintError]:
+    errors = []
+    for path in iter_py_files(roots):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path, repo_root) if repo_root else path
+        errors.extend(lint_source(rel, source))
+    return errors

@@ -19,7 +19,7 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Callable, Dict, Iterable, Optional
+from typing import Dict, Iterable
 
 import numpy as np
 

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List
 
 import jax.numpy as jnp
 import numpy as np
@@ -522,6 +522,11 @@ class TpuConfig:
         if isinstance(trc, dict):
             trc = TensorReplacementConfig(**trc)
         self.tensor_replacement_config = trc
+        # serve-time retrace guard (analysis/retrace.py): "warn" logs and
+        # "error" raises when any submodel program lowers AFTER warmup sealed
+        # the program set (a mid-serving retrace blocks requests on multi-
+        # second compilation); "off" disables recording enforcement.
+        self.retrace_guard = kwargs.pop("retrace_guard", "warn")
         self.allow_unknown = kwargs.pop("allow_unknown", False)
 
         self.is_prefill_stage = None  # set by enable_context_encoding/token_generation
@@ -534,6 +539,10 @@ class TpuConfig:
     def validate(self) -> None:
         if self.padding_side not in ("right", "left"):
             raise ValueError("padding_side must be 'right' or 'left'")
+        if self.retrace_guard not in ("off", "warn", "error"):
+            raise ValueError(
+                f"retrace_guard must be 'off'|'warn'|'error', got {self.retrace_guard!r}"
+            )
         if self.max_context_length > self.seq_len:
             raise ValueError(
                 f"max_context_length ({self.max_context_length}) cannot exceed seq_len ({self.seq_len})"

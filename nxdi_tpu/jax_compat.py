@@ -123,3 +123,54 @@ def compiled_input_formats(compiled):
     if hasattr(compiled, "input_formats"):
         return compiled.input_formats
     return compiled.input_layouts
+
+
+# ---------------------------------------------------------------------------
+# program-text access for the static auditor (nxdi_tpu/analysis): the APIs
+# below vary across jax releases, so every difference is absorbed here and
+# the auditor stays version-agnostic. All return None when unavailable —
+# checkers degrade to warnings instead of crashing the audit.
+# ---------------------------------------------------------------------------
+
+def stablehlo_text(lowered):
+    """StableHLO (MLIR) text of a ``Lowered`` — carries per-arg donation/
+    aliasing attributes (``tf.aliasing_output`` / ``jax.buffer_donor``)."""
+    try:
+        return lowered.as_text()
+    except Exception:
+        try:
+            return str(lowered.compiler_ir())
+        except Exception:
+            return None
+
+
+def optimized_hlo_text(compiled):
+    """Post-compile optimized HLO of a ``Compiled`` — the only place GSPMD's
+    inserted collectives are visible/countable."""
+    try:
+        text = compiled.as_text()
+        return text if text else None
+    except Exception:
+        return None
+
+
+def lowered_kept_args(lowered):
+    """Flat indices of the args the lowering KEPT (unused args are pruned
+    from the HLO signature), or None when the private field moved."""
+    try:
+        kept = lowered._lowering.compile_args["kept_var_idx"]
+        return tuple(sorted(kept))
+    except Exception:
+        return None
+
+
+def lowered_donated_flags(lowered):
+    """Per-flat-arg donation flags from ``Lowered.args_info``, or None."""
+    try:
+        flat = jax.tree_util.tree_leaves(
+            lowered.args_info,
+            is_leaf=lambda x: hasattr(x, "donated"),
+        )
+        return tuple(bool(a.donated) for a in flat)
+    except Exception:
+        return None

@@ -23,14 +23,14 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import numpy as np
 
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch, decoder_param_specs
-from nxdi_tpu.ops.moe import MoEArch, moe_parallel_fields, moe_shape_struct
+from nxdi_tpu.ops.moe import MoEArch, moe_parallel_fields
 from nxdi_tpu.parallel import gqa
 from nxdi_tpu.parallel.layers import REPLICATED
 

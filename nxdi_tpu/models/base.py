@@ -18,7 +18,6 @@ interleaved sliding-window layers) pass per-layer scalars through the scan xs.
 
 from __future__ import annotations
 
-import dataclasses
 from dataclasses import dataclass
 from functools import partial
 from typing import Any, Callable, Dict, Optional, Tuple

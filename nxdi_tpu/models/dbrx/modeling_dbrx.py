@@ -21,7 +21,6 @@ from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense
 from nxdi_tpu.models.base import DecoderArch
 from nxdi_tpu.ops.moe import MoEArch, moe_parallel_fields
-from nxdi_tpu.parallel import gqa
 
 build_inv_freq = dense.build_inv_freq
 

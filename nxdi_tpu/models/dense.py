@@ -12,7 +12,7 @@ generic code in this module.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 import ml_dtypes
 import numpy as np

@@ -27,7 +27,6 @@ T5EncoderModel in tests/integration/test_flux_text_encoders.py):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -22,7 +22,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from nxdi_tpu.config import InferenceConfig, promote_text_config
-from nxdi_tpu.models import dense
 from nxdi_tpu.models.gemma3 import modeling_gemma3 as g3
 from nxdi_tpu.ops import vision as vision_ops
 

@@ -8,7 +8,6 @@ and ``logits_scaling`` (divides the final logits)."""
 
 from __future__ import annotations
 
-import numpy as np
 
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense

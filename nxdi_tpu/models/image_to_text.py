@@ -16,7 +16,6 @@ relative to the LM; TP sharding of the tower is a later optimization.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Dict, Optional
 
 import jax
 import numpy as np

@@ -163,7 +163,6 @@ def param_shape_struct(config: InferenceConfig):
 # ---------------------------------------------------------------------------
 
 from dataclasses import dataclass as _dataclass  # noqa: E402
-from typing import Tuple as _Tuple  # noqa: E402
 
 
 @_dataclass(frozen=True)

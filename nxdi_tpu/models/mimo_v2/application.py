@@ -7,7 +7,6 @@ each type owns a correctly-shaped stack."""
 from __future__ import annotations
 
 import jax
-import numpy as np
 
 from nxdi_tpu.kvcache.kv_cache import kv_cache_partition_spec
 from nxdi_tpu.models.mimo_v2 import modeling_mimo_v2 as mv

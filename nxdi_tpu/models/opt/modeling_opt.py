@@ -8,7 +8,6 @@ post-norm (``do_layer_norm_before=False``) and projected-embedding
 
 from __future__ import annotations
 
-import numpy as np
 
 from nxdi_tpu.config import InferenceConfig
 from nxdi_tpu.models import dense, fairseq_dense

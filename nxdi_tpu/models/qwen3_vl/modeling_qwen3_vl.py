@@ -25,9 +25,6 @@ import numpy as np
 
 from nxdi_tpu.config import InferenceConfig, promote_text_config
 from nxdi_tpu.models import dense
-from nxdi_tpu.models.qwen2_vl.modeling_qwen2_vl import (  # shared M-RoPE host helpers
-    get_rope_index,
-)
 from nxdi_tpu.ops.norms import layer_norm
 from nxdi_tpu.ops.rope import inv_freq_from_hf_config
 

@@ -16,13 +16,12 @@ Weight layout convention: ``(in_features, out_features)`` so forward is
 
 from __future__ import annotations
 
-from typing import Any, Dict
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from nxdi_tpu.parallel.mesh import AXIS_MP, AXIS_TP
+from nxdi_tpu.parallel.mesh import AXIS_MP
 
 # Column parallel: output features sharded over tp  (y = x @ W, W: [in, out/tp])
 COLUMN_PARALLEL = P(None, AXIS_MP)

@@ -107,6 +107,38 @@ def token_generation_policy(tc) -> ShardingPolicy:
     return DEFAULT_POLICY
 
 
+def expected_policy_features(tc, decode_like: bool) -> dict:
+    """Which collective-inducing features the EXPECTED policy for this config
+    engages — the contract the static auditor budgets against
+    (analysis/budget.py counts optimized-HLO collectives vs it).
+
+    Kept HERE, next to the policy constructors, so a policy change and its
+    collective budget evolve in the same review: the branch precedence below
+    mirrors context_encoding_policy / token_generation_policy exactly. It is
+    deliberately derived from the CONFIG, not from a ShardingPolicy instance
+    — a buggy policy object must not raise its own budget.
+    """
+    if decode_like:
+        return {
+            "attention_dp": tc.attention_dp_degree > 1,
+            "flash_decoding": (
+                tc.flash_decoding_enabled and tc.attention_dp_degree <= 1
+            ),
+            "cp": False,
+            "sp": False,
+            "mlp_cp": False,
+        }
+    cp = tc.cp_degree > 1
+    sp = tc.sequence_parallel_enabled and not cp
+    return {
+        "attention_dp": False,
+        "flash_decoding": False,
+        "cp": cp,
+        "sp": sp,
+        "mlp_cp": getattr(tc, "mlp_cp_degree", 1) > 1 and not cp and not sp,
+    }
+
+
 def kv_cache_partition_spec_for(tc) -> P:
     """Cache layout (L, B, KV_heads, S, D) matching the decode policy
     (reference analogs: DataParallelKVCacheManager batch split, flashdecode

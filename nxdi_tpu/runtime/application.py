@@ -21,7 +21,6 @@ import time
 from typing import Any, Dict, Optional
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 
 from nxdi_tpu import checkpoint as ckpt
@@ -117,6 +116,7 @@ class ApplicationBase:
         self.params = None
         self.kv_cache = None
         self.is_loaded = False
+        self.retrace_guard = None  # created in _build_wrappers per TpuConfig
 
     # -- submodel construction: subclasses populate self.models --
     def enable_models(self) -> None:
@@ -406,6 +406,11 @@ class ApplicationBase:
 
         if not self.tpu_config.skip_warmup:
             self.warmup()
+            # warmup compiled every (submodel, bucket, steps) program: any
+            # lowering from here on is a mid-serving retrace — the guard
+            # warns/raises per TpuConfig.retrace_guard. skip_warmup apps
+            # compile lazily by design, so the guard is never sealed there.
+            self.retrace_guard.seal()
         from nxdi_tpu.utils.snapshot import maybe_attach_from_env
 
         maybe_attach_from_env(self)  # reference-style env-driven snapshotting
@@ -417,9 +422,16 @@ class ApplicationBase:
         self.enable_models()
         if self.mesh is None:
             self.mesh = mesh_from_config(self.tpu_config)
+        if getattr(self, "retrace_guard", None) is None:
+            from nxdi_tpu.analysis import RetraceGuard
+
+            self.retrace_guard = RetraceGuard(
+                mode=getattr(self.tpu_config, "retrace_guard", "warn")
+            )
         param_shardings = sharding_tree(self.param_specs(), self.mesh)
         cache_shardings = sharding_tree(self.cache_partition_specs(), self.mesh)
         for wrapper in self.models.values():
+            wrapper.retrace_guard = self.retrace_guard
             wrapper.build(self.mesh, param_shardings, cache_shardings)
 
     def warmup(self) -> None:
@@ -439,6 +451,15 @@ class ApplicationBase:
         from nxdi_tpu.kvcache.kv_cache import reset_kv_cache
 
         self.kv_cache = reset_kv_cache(self.kv_cache)
+
+    def audit(self, **kwargs):
+        """Run the static program auditor over this app's compiled submodels
+        (nxdi_tpu/analysis): donation, collective budget, dtype drift, baked
+        constants, required kernel strategies. Weights are NOT required —
+        auditing traces/lowers from abstract structs like aot_compile."""
+        from nxdi_tpu.analysis import audit_application
+
+        return audit_application(self, **kwargs)
 
 
 def params_shape_struct(family, config, arch):

@@ -11,7 +11,7 @@ mappings, and reference-count shared prefix blocks for prefix caching.
 from __future__ import annotations
 
 from collections import deque
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 

@@ -101,7 +101,8 @@ class _AutoLayoutProgram:
     only when) its current layout differs — one relayout at a program
     transition (e.g. prefill -> decode), zero in the steady-state chain."""
 
-    def __init__(self, jitted, label: str = "?", required_strategies=()):
+    def __init__(self, jitted, label: str = "?", required_strategies=(),
+                 retrace_guard=None):
         self.jitted = jitted
         self.label = label
         self._compiled = None
@@ -114,14 +115,25 @@ class _AutoLayoutProgram:
         # an enabled kernel flag that never engaged raises instead of
         # silently no-opping (round-3 verdict weak #4)
         self.required_strategies = tuple(required_strategies)
+        # app-owned analysis.RetraceGuard: every actual lowering is reported
+        # so a (re)trace after serving starts is caught per TpuConfig
+        self.retrace_guard = retrace_guard
 
-    def lower(self, *args):  # AOT artifact path passthrough
+    def _lower(self, *args):
+        """The ONE lowering path — AOT artifact (`lower`) and lazy first-call
+        (`__call__`) both come through here, so required-strategy verification
+        and retrace-guard recording provably run on both."""
         from nxdi_tpu.models import base as base_mod
 
+        if self.retrace_guard is not None:
+            self.retrace_guard.record(self.label)
         base_mod._STRATEGY_TRACE.clear()
         lowered = self.jitted.lower(*args)
         self._snap_strategies(base_mod)
         return lowered
+
+    def lower(self, *args):  # AOT artifact path
+        return self._lower(*args)
 
     def _snap_strategies(self, base_mod):
         if not base_mod._STRATEGY_TRACE:
@@ -134,29 +146,26 @@ class _AutoLayoutProgram:
             self.label,
             ",".join(self.attention_strategies),
         )
-        for flag, names in self.required_strategies:
-            if not any(n in self.attention_strategies for n in names):
-                raise RuntimeError(
-                    f"{self.label}: {flag} is enabled but none of its kernel "
-                    f"strategies {names} engaged in the compiled program — "
-                    "the flag would be a silent no-op for this model/config; "
-                    "disable it or use a supported configuration"
-                )
+        from nxdi_tpu.analysis.checkers import (
+            missing_required_strategies,
+            required_strategy_error,
+        )
+
+        for flag, names in missing_required_strategies(
+            self.attention_strategies, self.required_strategies
+        ):
+            raise RuntimeError(required_strategy_error(self.label, flag, names))
 
     def __call__(self, params, cache, batch):
         if self._compiled is None:
             # AUTO layouts resolve at compile time, so lowering must see
             # ABSTRACT args (concrete arrays carry a fixed layout and trip
             # jit's layout check)
-            from nxdi_tpu.models import base as base_mod
-
             absargs = jax.tree_util.tree_map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=a.sharding),
                 (params, cache, batch),
             )
-            base_mod._STRATEGY_TRACE.clear()
-            lowered = self.jitted.lower(*absargs)
-            self._snap_strategies(base_mod)
+            lowered = self._lower(*absargs)
             self._compiled = lowered.compile()
             from nxdi_tpu.jax_compat import compiled_input_formats
 
@@ -188,11 +197,12 @@ MULTISTEP_EOS_SLOTS = 8
 def decode_window_limit(tpu_config, models) -> int:
     """Largest KV position the compiled decode programs can serve: the device
     drops KV writes beyond the largest compiled TKG bucket, not just beyond
-    seq_len (shared by the host decode loops that clamp retirement)."""
-    return min(
-        tpu_config.seq_len,
-        *(w.buckets[-1] for w in models.values() if w.attend_to_cache),
-    )
+    seq_len (shared by the host decode loops that clamp retirement).
+
+    A prefill-only app (no cache-attending submodel) is limited by seq_len
+    alone — guarded explicitly because ``min(x, *())`` is a TypeError."""
+    tops = [w.buckets[-1] for w in models.values() if w.attend_to_cache]
+    return min([tpu_config.seq_len, *tops])
 
 
 class ModelWrapper:
@@ -260,6 +270,9 @@ class ModelWrapper:
         # input snapshotting (utils/snapshot.py; reference: snapshot hooks
         # application_base.py:421) — called with (tag, numpy batch) per dispatch
         self.snapshot_hook: Optional[Callable] = None
+        # analysis.RetraceGuard shared across the app's wrappers; set by the
+        # application before build() so programs report their lowerings
+        self.retrace_guard = None
 
     # ------------------------------------------------------------------
     # build: one jitted program per bucket (reference: model_wrapper.py:1442
@@ -356,6 +369,7 @@ class ModelWrapper:
             jitted,
             label=f"{self.tag}[{bucket}]",
             required_strategies=self._required_strategies(),
+            retrace_guard=self.retrace_guard,
         )
 
     def _required_strategies(self):

@@ -10,7 +10,7 @@ token-generation graphs from :mod:`nxdi_tpu.speculation.fused`.
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 from nxdi_tpu import checkpoint as ckpt
 from nxdi_tpu.config import InferenceConfig

@@ -18,7 +18,7 @@ the same clamping the fused path applies in-graph.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Optional
 
 import jax
 import numpy as np

@@ -21,8 +21,8 @@ reference's accepted-indices KV gather (kv_cache_manager.py:266
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
-from typing import Any, Dict, List, Sequence, Tuple
+from dataclasses import dataclass
+from typing import List, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp

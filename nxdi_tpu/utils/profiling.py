@@ -12,7 +12,6 @@ from __future__ import annotations
 
 import json
 import os
-import time
 from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional
 

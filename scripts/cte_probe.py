@@ -25,7 +25,7 @@ def main():
 
     rng = np.random.default_rng(0)
 
-    def run_cte(attn_kernel: bool):
+    def run_cte(attn_kernel: bool, with_summary: bool = True):
         make = bench_mod.main.__wrapped__ if hasattr(bench_mod.main, "__wrapped__") else None
         # rebuild the bench config inline (keep one source of truth by
         # importing the bench module's constants)
@@ -71,8 +71,16 @@ def main():
             out = app.forward(prompt, pos, last_token_index=lti)
             np.asarray(out["tokens"])
             ms.append((time.perf_counter() - t0) * 1000.0)
+        # program-structure record next to the perf number: per-program
+        # collective counts from the executables this run already compiled
+        # (nxdi_tpu.analysis auditor; zero retracing)
+        collectives = None
+        if with_summary:
+            from nxdi_tpu.analysis import collective_summary
+
+            collectives = collective_summary(app)
         del app
-        return float(np.percentile(ms, 50))
+        return float(np.percentile(ms, 50)), collectives
 
     if "--kernel-only" in sys.argv:
         import os
@@ -82,7 +90,7 @@ def main():
             DEFAULT_PREFILL_BLOCK_Q,
         )
 
-        cte_kernel = run_cte(True)
+        cte_kernel, collectives = run_cte(True)
         print(json.dumps({
             "cte_kernel_ms": round(cte_kernel, 1),
             "block_q": os.environ.get(
@@ -91,15 +99,19 @@ def main():
             "block_k": os.environ.get(
                 "NXDI_TPU_PREFILL_BLOCK_K", str(DEFAULT_PREFILL_BLOCK_K)
             ),
+            "collectives": collectives,
         }))
         return
-    cte_kernel = run_cte(True)
+    cte_kernel, collectives = run_cte(True)
     print(f"[probe] cte kernel-on {cte_kernel:.1f} ms", file=sys.stderr, flush=True)
-    cte_xla = run_cte(False)
+    cte_xla, _ = run_cte(False, with_summary=False)
     print(f"[probe] cte kernel-off {cte_xla:.1f} ms", file=sys.stderr, flush=True)
     print(json.dumps({
         "cte_kernel_ms": round(cte_kernel, 1),
         "cte_xla_attn_ms": round(cte_xla, 1),
+        # BENCH rounds record program structure next to perf: the auditor's
+        # per-program collective counts for the kernel-on run
+        "collectives": collectives,
     }))
 
 

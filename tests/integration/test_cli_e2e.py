@@ -1,7 +1,6 @@
 """inference_demo CLI end-to-end on a tiny checkpoint (reference analog:
 inference_demo runs in test/integration)."""
 
-import numpy as np
 import pytest
 
 from nxdi_tpu.cli.inference_demo import main

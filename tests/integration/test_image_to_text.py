@@ -7,7 +7,6 @@ import pytest
 
 from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
 from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter
-from nxdi_tpu.models import llava as llava_pkg
 from nxdi_tpu.models.image_to_text import ImageToTextForCausalLM
 from nxdi_tpu.models.llava import modeling_llava
 

@@ -6,7 +6,6 @@ import json
 import os
 
 import numpy as np
-import pytest
 
 from nxdi_tpu.config import OnDeviceSamplingConfig, TensorCaptureConfig, TpuConfig
 from nxdi_tpu.generation.hf_adapter import HuggingFaceGenerationAdapter

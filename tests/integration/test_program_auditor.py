@@ -1,0 +1,357 @@
+"""Program auditor (nxdi_tpu/analysis) over the llama CPU-mesh reference app.
+
+Every checker gets BOTH directions:
+  - negative: the shipped programs audit clean (no error findings),
+  - positive: a deliberately seeded violation (undonated cache, policy with
+    extra collectives, injected fp32 cast, closed-over weight, post-serving
+    retrace, unmet kernel-strategy flag) is detected with an actionable
+    message naming the submodel and bucket.
+
+The audit path never loads weights (abstract structs, like aot_compile), so
+these compile the same tiny programs the rest of tier-1 compiles.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig
+from nxdi_tpu.models.base import causal_lm_forward
+from nxdi_tpu.models.llama import modeling_llama as ml
+from nxdi_tpu.runtime.application import params_shape_struct
+from nxdi_tpu.runtime.model_wrapper import (
+    TAG_CONTEXT_ENCODING,
+    TAG_TOKEN_GENERATION,
+    ModelWrapper,
+)
+
+
+def make_app(**tpu_kwargs):
+    """The SAME reference app the CLI audits (nxdi_tpu/cli/lint.py owns the
+    definition — one source of truth for what tier-1 gates)."""
+    from nxdi_tpu.cli.lint import build_reference_app
+
+    defaults = dict(
+        tp_degree=1,
+        batch_size=1,
+        seq_len=64,
+        max_context_length=32,
+        dtype="bfloat16",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+    )
+    defaults.update(tpu_kwargs)
+    return build_reference_app(defaults)
+
+
+def seeded_wrapper(app, forward_fn, tag="seeded_model"):
+    """A decode-shaped wrapper running ``forward_fn`` under the app's mesh and
+    shardings — the vehicle for injecting violations into a real program."""
+    from nxdi_tpu.parallel.layers import sharding_tree
+    from nxdi_tpu.parallel.mesh import mesh_from_config
+
+    app._build_wrappers()
+    arch = ml.build_arch(app.config)
+    w = ModelWrapper(
+        tag,
+        app.config,
+        arch,
+        ml.build_inv_freq(app.config),
+        batch_size=1,
+        n_active_tokens=1,
+        buckets=[app.tpu_config.seq_len],
+        attend_to_cache=True,
+        forward_fn=forward_fn,
+        forward_kwargs=dict(app.models[TAG_TOKEN_GENERATION].forward_kwargs),
+    )
+    mesh = app.mesh or mesh_from_config(app.tpu_config)
+    w.build(
+        mesh,
+        sharding_tree(app.param_specs(), mesh),
+        sharding_tree(app.cache_partition_specs(), mesh),
+    )
+    return w
+
+
+def audit_seeded(app, w):
+    from nxdi_tpu.analysis import audit_wrapper
+
+    return audit_wrapper(
+        w, app.build_params_struct(), app._cache_struct(), config=app.config
+    )
+
+
+def errors_of(reports, checker):
+    return [
+        f
+        for r in (reports if isinstance(reports, list) else reports.programs)
+        for f in r.findings
+        if f.checker == checker and f.severity == "error"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# clean path: the reference app (the CLI acceptance run) audits clean
+# ---------------------------------------------------------------------------
+
+def test_cli_lint_reference_app_clean(tmp_path):
+    """`python -m nxdi_tpu.cli.lint` exits 0 on all compiled submodels of the
+    llama CPU-mesh reference app — the tier-1 wiring of the audit."""
+    from nxdi_tpu.cli.lint import main
+
+    out = tmp_path / "report.json"
+    rc = main([
+        "--reference-app",
+        "--tp-degree", "8",
+        "--decode-steps-per-dispatch", "2",
+        "--json", str(out),
+        "-q",
+    ])
+    assert rc == 0
+    report = json.loads(out.read_text())
+    assert report["ok"] is True
+    tags = {p["submodel"] for p in report["programs"]}
+    assert tags == {
+        TAG_CONTEXT_ENCODING, TAG_TOKEN_GENERATION, "tkg_multistep",
+    }
+    for p in report["programs"]:
+        assert p["findings"] == [], p
+        # both KV stacks donated in every program
+        assert p["donated_cache_inputs"] == p["cache_inputs"] == 2
+        # collectives within the policy budget
+        for op, n in p["collectives"].items():
+            assert n <= p["collective_budget"][op], (p["program"], op)
+
+
+def test_audit_application_clean_tp1():
+    report = make_app().audit()
+    assert report.ok()
+    assert report.errors() == []
+    # tp=1: a single-device mesh budgets ZERO collectives, and the compiled
+    # programs indeed have none
+    for p in report.programs:
+        assert all(n == 0 for n in p.collectives.values()), p.label
+
+
+# ---------------------------------------------------------------------------
+# seeded violations, one per checker
+# ---------------------------------------------------------------------------
+
+def test_donation_violation_detected(monkeypatch):
+    """Programs compiled WITHOUT cache donation are flagged per cache leaf."""
+    orig_jit = jax.jit
+
+    def jit_without_donation(*args, **kwargs):
+        kwargs.pop("donate_argnums", None)
+        return orig_jit(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "jit", jit_without_donation)
+    app = make_app()
+    report = app.audit(submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "donation")
+    assert len(findings) == 2  # k and v
+    msg = " | ".join(f.message for f in findings)
+    assert "'k'" in msg and "'v'" in msg
+    assert all(f.program == "token_generation_model[64]" for f in findings)
+
+
+def test_collective_budget_violation_detected(monkeypatch):
+    """A sharding-policy typo (decode stream suddenly S-sharded over the mp
+    axis) inserts unbudgeted collectives — caught against the config-derived
+    budget, which does NOT follow the buggy policy."""
+    import nxdi_tpu.parallel.policy as pol
+
+    def typo_policy(tc):
+        from jax.sharding import PartitionSpec as P
+
+        return pol.ShardingPolicy(hidden=P(None, pol.AXIS_MP, None))
+
+    monkeypatch.setattr(pol, "token_generation_policy", typo_policy)
+    app = make_app(tp_degree=8)
+    report = app.audit(submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "collectives")
+    assert findings, report.to_json()
+    msg = findings[0].message
+    assert "token_generation_model[64]" == findings[0].program
+    assert "exceed the policy budget" in msg
+
+
+def test_dtype_drift_violation_detected():
+    """An injected fp32 detour on a bf16 tensor (outside the norm/softmax/
+    rope/logits islands) is flagged with its traceback location."""
+
+    def drifting_forward(arch, inv_freq, params, cache, batch, **kw):
+        out, cache = causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+        weight = next(
+            leaf for leaf in jax.tree_util.tree_leaves(params)
+            if leaf.dtype == jnp.bfloat16
+        )
+        leak = weight.astype(jnp.float32)  # seeded upcast
+        out = dict(out)
+        out["tokens"] = out["tokens"] + (leak.sum() * 0).astype(out["tokens"].dtype)
+        return out, cache
+
+    app = make_app()
+    w = seeded_wrapper(app, drifting_forward)
+    findings = errors_of(audit_seeded(app, w), "dtype_drift")
+    assert findings, "seeded fp32 upcast not flagged"
+    assert "drifting_forward" in findings[0].message or "upcast" in findings[0].message
+    assert findings[0].program == "seeded_model[64]"
+
+
+def test_dtype_drift_clean_on_reference_programs():
+    """The shipped bf16 programs keep fp32 only in allowlisted islands."""
+    report = make_app().audit(checkers=["dtype_drift"])
+    assert errors_of(report, "dtype_drift") == []
+
+
+def test_baked_constant_violation_detected():
+    """A weight closed over instead of passed as an argument becomes a jaxpr
+    constant above the size threshold."""
+    BIG = np.ones((512, 512), dtype=np.float32)  # 1 MiB
+
+    def baking_forward(arch, inv_freq, params, cache, batch, **kw):
+        out, cache = causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+        baked = jnp.asarray(BIG)  # closed-over weight -> baked constant
+        out = dict(out)
+        out["tokens"] = out["tokens"] + (baked.sum() * 0).astype(out["tokens"].dtype)
+        return out, cache
+
+    app = make_app()
+    w = seeded_wrapper(app, baking_forward)
+    findings = errors_of(audit_seeded(app, w), "baked_constants")
+    assert findings, "seeded 1 MiB constant not flagged"
+    assert "[512, 512]" in findings[0].message
+    assert findings[0].program == "seeded_model[64]"
+    # and the reference programs carry nothing near the threshold
+    clean = make_app().audit(checkers=["baked_constants"])
+    assert errors_of(clean, "baked_constants") == []
+
+
+def test_required_strategy_finding_via_auditor(monkeypatch):
+    monkeypatch.setattr(
+        ModelWrapper,
+        "_required_strategies",
+        lambda self: (("fake_kernel_flag", ("strategy_that_never_engages",)),),
+    )
+    app = make_app()
+    report = app.audit(submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "required_strategies")
+    assert findings
+    assert "fake_kernel_flag" in findings[0].message
+    assert "token_generation_model[64]" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# retrace guard
+# ---------------------------------------------------------------------------
+
+def loaded_app(**tpu_kwargs):
+    """A loaded (random-weight) app: warmup compiles every program, sealing
+    the retrace guard."""
+    app = make_app(skip_warmup=False, **tpu_kwargs)
+
+    class App(type(app)):
+        pass
+
+    struct = params_shape_struct(ml, app.config, ml.build_arch(app.config))
+    rng = np.random.default_rng(0)
+    import ml_dtypes
+
+    weights = jax.tree_util.tree_map(
+        lambda s: (rng.standard_normal(s.shape) * 0.02).astype(
+            ml_dtypes.bfloat16 if s.dtype == jnp.bfloat16 else s.dtype
+        ),
+        struct,
+    )
+    app.build_params = lambda: weights
+    app.load()
+    return app
+
+
+def test_retrace_guard_raises_after_serving():
+    from nxdi_tpu.analysis import RetraceAfterServingError
+
+    app = loaded_app(retrace_guard="error")
+    assert app.retrace_guard.sealed
+    assert app.retrace_guard.lowerings  # warmup recorded every program
+    w = app.models[TAG_TOKEN_GENERATION]
+    # a stray retrace mid-serving: the compiled program evaporated (new
+    # bucket, signature drift, eviction) and the next request must re-lower
+    w._programs[64]._compiled = None
+    with pytest.raises(RetraceAfterServingError, match=r"token_generation_model\[64\]"):
+        app.forward(
+            np.array([[7]], dtype=np.int32),
+            np.array([[3]], dtype=np.int32),
+        )
+
+
+def test_retrace_guard_warn_mode_records(caplog):
+    import logging
+
+    app = loaded_app(retrace_guard="warn")
+    w = app.models[TAG_TOKEN_GENERATION]
+    w._programs[64]._compiled = None
+    with caplog.at_level(logging.WARNING, logger="nxdi_tpu"):
+        app.forward(
+            np.array([[7]], dtype=np.int32),
+            np.array([[3]], dtype=np.int32),
+        )
+    assert any("lowered AFTER serving started" in r.message for r in caplog.records)
+    assert app.retrace_guard.violations
+    # the violation also surfaces in the audit report
+    report = app.audit()
+    assert any(f.checker == "retrace" for f in report.findings)
+
+
+def test_collective_summary_from_loaded_app():
+    """The probes' summary: per-program collective counts straight from the
+    executables a loaded app holds (no retracing/compiling)."""
+    from nxdi_tpu.analysis import collective_summary
+
+    app = loaded_app()
+    summary = collective_summary(app)
+    assert set(summary) == {
+        "context_encoding_model[32]", "token_generation_model[64]",
+    }
+    for counts in summary.values():  # tp=1: no collectives at all
+        assert counts == {}
+
+
+def test_retrace_guard_not_sealed_with_skip_warmup():
+    app = make_app(skip_warmup=True)
+    app._build_wrappers()
+    assert not app.retrace_guard.sealed
+
+
+# ---------------------------------------------------------------------------
+# satellite: required-strategy verification provably runs on the AOT path
+# ---------------------------------------------------------------------------
+
+def test_required_strategy_check_runs_on_aot_compile_path(monkeypatch, tmp_path):
+    """Regression: `app.compile()` (the AOT artifact path through
+    `_AutoLayoutProgram.lower`) must enforce required kernel strategies just
+    like the lazy first-call path — a flag that cannot engage raises at
+    compile time, naming the submodel and bucket."""
+    monkeypatch.setattr(
+        ModelWrapper,
+        "_required_strategies",
+        lambda self: (("fake_kernel_flag", ("strategy_that_never_engages",)),),
+    )
+    app = make_app()
+    with pytest.raises(RuntimeError, match=r"fake_kernel_flag") as ei:
+        app.compile(str(tmp_path / "artifact"))
+    assert "[" in str(ei.value)  # names the submodel[bucket] program
+
+
+def test_required_strategy_check_runs_on_first_call_path(monkeypatch):
+    monkeypatch.setattr(
+        ModelWrapper,
+        "_required_strategies",
+        lambda self: (("fake_kernel_flag", ("strategy_that_never_engages",)),),
+    )
+    with pytest.raises(RuntimeError, match=r"fake_kernel_flag"):
+        loaded_app()

@@ -302,7 +302,7 @@ def test_gemma3_vision_prefix_prefill_rejected_up_front():
 def test_gemma3_text_only_flat_config_still_works():
     """The registry's gemma3 key now points at the vision module; flat text
     configs must keep working through it (backward compatibility)."""
-    from transformers import Gemma3TextConfig, Gemma3TextModel, Gemma3ForCausalLM
+    from transformers import Gemma3TextConfig, Gemma3ForCausalLM
 
     from nxdi_tpu.models.gemma3 import modeling_gemma3_vision as mg
     from nxdi_tpu.models.registry import get_family

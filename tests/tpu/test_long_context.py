@@ -25,8 +25,6 @@ PROMPT = 32640  # 255*128: Pallas-tileable, 32k-class
 
 
 def _build_app(n_layers=16, seq=SEQ, prompt=PROMPT, quantized=False):
-    import jax.tree_util as jtu
-    import ml_dtypes
 
     from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
     from nxdi_tpu.models.llama import modeling_llama as ml

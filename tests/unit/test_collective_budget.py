@@ -1,0 +1,103 @@
+"""Collective-budget derivation (analysis/budget.py + the policy-feature
+contract in parallel/policy.py) — pure config-level tests, no compilation."""
+
+from types import SimpleNamespace
+
+from nxdi_tpu.analysis.budget import expected_collective_budget, over_budget
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.parallel.policy import expected_policy_features
+
+
+def tc(**kw):
+    defaults = dict(tp_degree=8, seq_len=64, max_context_length=32)
+    defaults.update(kw)
+    return TpuConfig(**defaults)
+
+
+def wrapper(decode=True, draft=False):
+    w = SimpleNamespace(attend_to_cache=decode, prefill_to_cache=False)
+    if draft:
+        w.draft_arch = object()
+    return w
+
+
+ARCH = SimpleNamespace(num_layers=4, moe=None)
+
+
+def test_single_device_budgets_zero():
+    budget, explain = expected_collective_budget(tc(tp_degree=1), ARCH, wrapper())
+    assert all(n == 0 for n in budget.values())
+    assert "unexplained" in explain[0]
+
+
+def test_default_tp_budget_covers_observed_shape():
+    budget, _ = expected_collective_budget(
+        tc(on_device_sampling_config=OnDeviceSamplingConfig()), ARCH, wrapper()
+    )
+    # empirical clean decode program at tp=8: 3 all-reduce + 2 all-gather
+    assert budget["all-reduce"] >= 3
+    assert budget["all-gather"] >= 2
+    # and nothing else is allowed — a policy typo's all-to-alls must trip
+    assert budget["all-to-all"] == 0
+    assert budget["collective-permute"] == 0
+
+
+def test_over_budget_reports_pairs():
+    budget, _ = expected_collective_budget(tc(tp_degree=1), ARCH, wrapper())
+    observed = {"all-reduce": 2, "all-gather": 0}
+    assert over_budget(observed, budget) == {"all-reduce": (2, 0)}
+    assert over_budget({"all-reduce": 0}, budget) == {}
+
+
+def test_sp_raises_prefill_budget_only():
+    sp = tc(sequence_parallel_enabled=True)
+    prefill_b, _ = expected_collective_budget(sp, ARCH, wrapper(decode=False))
+    decode_b, _ = expected_collective_budget(sp, ARCH, wrapper(decode=True))
+    assert prefill_b["reduce-scatter"] > 0
+    # SP never applies to single-token decode (policy.py): decode budget
+    # stays the plain-TP shape
+    assert decode_b["reduce-scatter"] == 0
+    assert decode_b["all-to-all"] == 0
+
+
+def test_policy_feature_precedence_mirrors_policy_constructors():
+    # CP wins over SP in prefill (context_encoding_policy branch order)
+    both = tc(cp_degree=8, sequence_parallel_enabled=True)
+    feats = expected_policy_features(both, decode_like=False)
+    assert feats["cp"] and not feats["sp"]
+    # SP subsumes MLP-CP
+    spc = tc(sequence_parallel_enabled=True, mlp_cp_degree=8)
+    feats = expected_policy_features(spc, decode_like=False)
+    assert feats["sp"] and not feats["mlp_cp"]
+    # decode: only the decode-side features can engage
+    feats = expected_policy_features(both, decode_like=True)
+    assert not any([feats["cp"], feats["sp"], feats["mlp_cp"]])
+
+
+def test_fused_spec_doubles_body_terms():
+    plain, _ = expected_collective_budget(tc(), ARCH, wrapper())
+    fused, _ = expected_collective_budget(tc(), ARCH, wrapper(draft=True))
+    assert fused["all-reduce"] == 2 * plain["all-reduce"]
+
+
+def test_collective_counts_text_forms():
+    """HLO text parsing: sync ops, async `-start` halves (tuple result types
+    with spaces — the TPU default), and NO double count from `-done` ops or
+    operand references."""
+    from nxdi_tpu.analysis.hlo import collective_counts
+
+    text = "\n".join([
+        "  %all-reduce.5 = f32[1,1,64]{2,1,0} all-reduce(f32[1,1,64]{2,1,0} %x), replica_groups=[1,8]<=[8]",
+        "  %ars = (f32[128]{0:T(256)}, f32[128]{0}) all-reduce-start(f32[128]{0} %p0), replica_groups={{0,1}}",
+        "  %ard = f32[128]{0} all-reduce-done((f32[128]{0}, f32[128]{0}) %ars)",
+        "  %ags = (f32[4]{0}, f32[32]{0}) all-gather-start(f32[4]{0} %p1), dimensions={0}",
+        "  %agd = f32[32]{0} all-gather-done((f32[4]{0}, f32[32]{0}) %ags)",
+        "  %cp = f32[2]{0} collective-permute(f32[2]{0} %p2), source_target_pairs={{0,1}}",
+        "  %fusion.1 = f32[2]{0} fusion(f32[2]{0} %all-reduce.5), kind=kLoop",
+    ])
+    counts = collective_counts(text)
+    assert counts["all-reduce"] == 2  # sync + async start, done not recounted
+    assert counts["all-gather"] == 1
+    assert counts["collective-permute"] == 1
+    assert counts["reduce-scatter"] == 0
+    assert counts["all-to-all"] == 0

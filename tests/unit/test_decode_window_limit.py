@@ -1,0 +1,71 @@
+"""decode_window_limit: the largest KV position the compiled decode programs
+can serve (runtime/model_wrapper.py). The host decode loops clamp retirement
+to it, so every bucket-ladder shape has to resolve correctly — including the
+multistep step ladder and the widened fused-speculation windows."""
+
+from types import SimpleNamespace
+
+from nxdi_tpu.runtime.model_wrapper import decode_window_limit
+
+
+def wrapper(buckets, attend=True):
+    return SimpleNamespace(buckets=sorted(buckets), attend_to_cache=attend)
+
+
+def tc(seq_len):
+    return SimpleNamespace(seq_len=seq_len)
+
+
+def test_limited_by_largest_tkg_bucket():
+    models = {
+        "context_encoding_model": wrapper([32, 64], attend=False),
+        "token_generation_model": wrapper([16, 32]),
+    }
+    # decode programs top out at 32 even though seq_len is 64
+    assert decode_window_limit(tc(64), models) == 32
+
+
+def test_limited_by_seq_len_when_buckets_cover_it():
+    models = {"token_generation_model": wrapper([64, 128])}
+    assert decode_window_limit(tc(96), models) == 96
+
+
+def test_prefill_only_app_falls_back_to_seq_len():
+    """No cache-attending submodel (encoder-style app): seq_len alone limits
+    — regression for the empty-min TypeError."""
+    models = {"context_encoding_model": wrapper([32, 64], attend=False)}
+    assert decode_window_limit(tc(64), models) == 64
+
+
+def test_empty_models_dict():
+    assert decode_window_limit(tc(128), {}) == 128
+
+
+def test_multistep_ladder_shares_the_tkg_buckets():
+    """The tkg_multistep wrapper compiles the SAME KV-bucket ladder per step
+    rung; its presence must not change the limit, and the min is taken over
+    ALL cache-attending wrappers (a multistep wrapper with a truncated ladder
+    drags the limit down — every dispatched program must fit)."""
+    models = {
+        "token_generation_model": wrapper([16, 32, 64]),
+        "tkg_multistep": wrapper([16, 32, 64]),
+    }
+    assert decode_window_limit(tc(64), models) == 64
+    models["tkg_multistep"] = wrapper([16, 32])
+    assert decode_window_limit(tc(64), models) == 32
+
+
+def test_fused_speculation_window_edges():
+    """Fused speculation widens bucket SELECTION by lookahead = spec_len + 1,
+    but the compiled windows themselves stay the ladder values: the limit is
+    the largest compiled window, never seq_len + lookahead."""
+    spec = wrapper([32, 64])  # fused_speculation_model windows
+    spec.lookahead = 5  # spec_len 4: ignored by the limit on purpose
+    models = {
+        "context_encoding_model": wrapper([32], attend=False),
+        "fused_speculation_model": spec,
+    }
+    assert decode_window_limit(tc(128), models) == 64
+    # a window ladder capped below seq_len bounds serving even when the
+    # target could hold more KV
+    assert decode_window_limit(tc(48), models) == 48
