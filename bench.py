@@ -26,6 +26,27 @@ import time
 import numpy as np
 
 NORTH_STAR_TOK_S_CHIP = 2000.0  # BASELINE.json: >=2000 tok/s/chip decode
+
+
+def metrics_out_path():
+    """--metrics-out FILE: where to dump the telemetry JSON snapshot(s)
+    (nxdi_tpu/telemetry registry) next to the latency lines; None if unset.
+    (Kept local — bench.py stays import-free of scripts/; probes share
+    scripts/_bench.maybe_dump_metrics instead.)"""
+    if "--metrics-out" not in sys.argv:
+        return None
+    i = sys.argv.index("--metrics-out")
+    if i + 1 >= len(sys.argv):
+        raise SystemExit("--metrics-out needs a FILE argument")
+    return sys.argv[i + 1]
+
+
+def write_metrics_snapshots(snaps, path):
+    if not path:
+        return
+    with open(path, "w") as f:
+        json.dump(snaps, f, indent=2)
+    print(f"[bench] telemetry snapshot -> {path}", file=sys.stderr, flush=True)
 V5E_HBM_GBS = 819.0
 V5E_BF16_TFLOPS = 197.0
 
@@ -169,6 +190,11 @@ def main():
     tok_s = BATCH / (tkg_p50 / 1000.0)
     print(f"[bench] bf16 done tkg={tkg_p50:.3f}ms cte={cte_p50:.1f}ms", file=sys.stderr, flush=True)
 
+    metrics_path = metrics_out_path()
+    metric_snaps = {}
+    if metrics_path:
+        metric_snaps["bf16_bs32"] = app.telemetry.snapshot()
+
     # --- int8-weight decode variant (second bench line; the param read is
     # ~half the decode HBM budget, so int8 weights raise the ceiling) ---
     del app
@@ -191,6 +217,8 @@ def main():
     tkg8_p50 = bench_decode(app8, out8)
     tok_s_int8 = BATCH / (tkg8_p50 / 1000.0)
     print(f"[bench] int8 done tkg={tkg8_p50:.3f}ms", file=sys.stderr, flush=True)
+    if metrics_path:
+        metric_snaps["int8_bs32"] = app8.telemetry.snapshot()
 
     # --- fused speculation line (reference: the latency-oriented spec
     # configs, utils/benchmark.py per-submodel reports). Draft = the SAME
@@ -286,6 +314,8 @@ def main():
     spec_tok_s = total / spec_elapsed
     accept_len = total / (SPEC_BATCH * n_windows)  # tokens retired per window
     print(f"[bench] spec done tok_s={spec_tok_s:.1f} accept={accept_len:.2f}", file=sys.stderr, flush=True)
+    if metrics_path:
+        metric_snaps["fused_spec_bs16"] = spec_app.telemetry.snapshot()
     del spec_app, out_s, nxt, total_counts
     gc.collect()
 
@@ -417,6 +447,7 @@ def main():
             }
         )
     )
+    write_metrics_snapshots(metric_snaps, metrics_path)
 
 
 def main_8b_only():
@@ -512,6 +543,9 @@ def main_8b_only():
     with open(side, "w") as f:
         json.dump(rec, f)
     print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"8b_int8": app_8b.telemetry.snapshot()}, metrics_out_path()
+    )
 
 
 def main_bs1_only():
@@ -603,6 +637,9 @@ def main_bs1_only():
         per.append((time.perf_counter() - t0) * 1000.0 / 100)
     bs1_tok_ms = float(np.percentile(per, 50))
     print(f"[bs1] non-spec {bs1_tok_ms:.3f} ms/tok", file=sys.stderr, flush=True)
+    metric_snaps = {}
+    if metrics_out_path():
+        metric_snaps["bs1"] = app_b1.telemetry.snapshot()
     del app_b1, out_b1, out, nxt
     gc.collect()
 
@@ -687,6 +724,9 @@ def main_bs1_only():
     with open(side, "w") as f:
         json.dump(rec, f)
     print(json.dumps(rec))
+    if metrics_out_path():
+        metric_snaps["spec_bs1"] = spec1.telemetry.snapshot()
+        write_metrics_snapshots(metric_snaps, metrics_out_path())
 
 
 def main_multistep(k: int):
@@ -795,6 +835,9 @@ def main_multistep(k: int):
     with open(side, "w") as f:
         json.dump(rec, f)
     print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"multistep": app.telemetry.snapshot()}, metrics_out_path()
+    )
 
 
 if __name__ == "__main__":

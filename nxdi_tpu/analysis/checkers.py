@@ -330,6 +330,75 @@ def check_required_strategies(art: ProgramArtifacts) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# 6. KV-layout addressing
+# ---------------------------------------------------------------------------
+
+def check_kv_layout(art: ProgramArtifacts) -> List[Finding]:
+    """Block-KV addressing inputs must be provably LIVE where the layout
+    needs them and provably DEAD everywhere else (via ``kept_var_idx``):
+
+    - paged programs: ``slot_mapping`` (the write path) must be live in every
+      program, and ``block_table`` (the pool read path) in cache-attending
+      programs — a dead one compiles fine today but routes KV writes/reads
+      nowhere;
+    - non-paged programs: a live ``slot_mapping``/``block_table`` input means
+      the program consumes paged addressing no host code maintains — a
+      layout-input mixup.
+    """
+    from nxdi_tpu.kvcache.kv_cache import BlockKVLayout
+
+    paged = isinstance(getattr(art.wrapper, "layout", None), BlockKVLayout)
+    try:
+        example = art.wrapper._example_for_key(art.key)
+    except Exception as e:
+        return [art.finding(
+            "kv_layout", f"example batch unavailable: {type(e).__name__}: {e}",
+            severity="warning",
+        )]
+    keys = sorted(example)  # jax flattens dicts in sorted-key order
+    present = [k for k in ("block_table", "slot_mapping") if k in keys]
+    findings: List[Finding] = []
+    if paged and "slot_mapping" not in present:
+        findings.append(art.finding(
+            "kv_layout",
+            "paged program has no 'slot_mapping' batch input — the compiled "
+            "program cannot address the block pool",
+        ))
+    if not present:
+        return findings
+    if art.kept_args is None:
+        return findings + [art.finding(
+            "kv_layout",
+            "kept_var_idx unavailable; cannot prove layout-input liveness",
+            severity="warning",
+        )]
+    kept = set(art.kept_args)
+    n_fixed = art.n_param_leaves + len(art.cache_paths)
+    # liveness required per input: the write path always, the read path only
+    # in programs that attend the cache through the block table
+    required_live = {"slot_mapping": True,
+                     "block_table": bool(getattr(art.wrapper, "attend_to_cache", False))}
+    for k in present:
+        live = (n_fixed + keys.index(k)) in kept
+        if paged and required_live[k] and not live:
+            findings.append(art.finding(
+                "kv_layout",
+                f"paged program DROPPED its '{k}' input (pruned by "
+                "kept_var_idx) — block-KV addressing is provably unused, so "
+                "cache writes/reads route nowhere; the forward is not "
+                "consuming the paged layout's inputs",
+            ))
+        elif not paged and live:
+            findings.append(art.finding(
+                "kv_layout",
+                f"non-paged program KEEPS a live '{k}' input — it consumes "
+                "paged addressing that no host code maintains for this "
+                "layout (layout-input mixup)",
+            ))
+    return findings
+
+
 #: name -> checker; the auditor runs these in order
 CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "donation": check_donation,
@@ -337,4 +406,5 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "dtype_drift": check_dtype_drift,
     "baked_constants": check_baked_constants,
     "required_strategies": check_required_strategies,
+    "kv_layout": check_kv_layout,
 }

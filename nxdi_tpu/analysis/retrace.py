@@ -28,7 +28,7 @@ class RetraceAfterServingError(RuntimeError):
 
 
 class RetraceGuard:
-    def __init__(self, mode: str = "warn"):
+    def __init__(self, mode: str = "warn", telemetry=None):
         if mode not in MODES:
             raise ValueError(f"retrace_guard mode must be one of {MODES}, got {mode!r}")
         self.mode = mode
@@ -36,10 +36,16 @@ class RetraceGuard:
         # label -> number of lowerings observed (pre- and post-seal)
         self.lowerings: Dict[str, int] = {}
         self.violations: List[str] = []
+        # nxdi_tpu/telemetry.Telemetry: lowerings count into
+        # nxdi_program_lowerings_total{phase=warmup|serving} — a nonzero
+        # "serving" series on a dashboard IS the post-seal retrace alarm
+        self.telemetry = telemetry
 
     def record(self, label: str) -> None:
         """Called by a program at every actual lowering."""
         self.lowerings[label] = self.lowerings.get(label, 0) + 1
+        if self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.record_lowering(label, post_seal=self.sealed)
         if not self.sealed or self.mode == "off":
             return
         known = sorted(k for k in self.lowerings if k != label)

@@ -1,7 +1,8 @@
-"""Stdlib pyflakes-lite: unused imports (F401) and undefined names (F821).
+"""Stdlib pyflakes-lite: unused imports (F401), undefined names (F821),
+and no bare ``print`` in core (T201).
 
 The repo's lint policy lives in ``ruff.toml`` (pyflakes rules); this module
-is the zero-dependency enforcement of the two highest-value rules so the
+is the zero-dependency enforcement of the highest-value rules so the
 tier-1 suite gates them (``tests/unit/test_source_lint.py``) even on boxes
 where ``ruff`` is not installed. Rule numbers and the ``# noqa`` convention
 match ruff/pyflakes, so both tools agree on what is clean.
@@ -13,6 +14,11 @@ match ruff/pyflakes, so both tools agree on what is clean.
 - **F821**: a name referenced in some scope that no enclosing scope defines,
   is not a builtin, and is not declared ``global``/``nonlocal`` — found via
   :mod:`symtable`, i.e. the compiler's own scope analysis.
+- **T201** (flake8-print's rule id): a bare ``print(...)`` call in
+  ``nxdi_tpu/`` core. Library output must go through ``logging`` or the
+  telemetry registry (``nxdi_tpu/telemetry``) so serving processes control
+  their streams; ``nxdi_tpu/cli/`` and top-level ``scripts/``/``bench.py``
+  are exempt — stdout IS their interface.
 """
 
 from __future__ import annotations
@@ -233,11 +239,51 @@ def undefined_names(path: str, source: str) -> List[LintError]:
 
 
 # ---------------------------------------------------------------------------
+# T201 — no bare print in nxdi_tpu core
+# ---------------------------------------------------------------------------
+
+def _is_core_path(path: str) -> bool:
+    p = path.replace(os.sep, "/")
+    return p.startswith("nxdi_tpu/") and not p.startswith("nxdi_tpu/cli/")
+
+
+def bare_prints(path: str, source: str) -> List[LintError]:
+    """``print(...)`` calls in nxdi_tpu core (cli/ exempt): core output must
+    go through ``logging`` or the telemetry registry. Silence an intentional
+    one with ``# noqa: T201`` (ruff's flake8-print id, so both tools agree)."""
+    if not _is_core_path(path):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # F401/F821 already report the syntax error
+    noqa = _noqa_lines(source, "T201")
+    out = []
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+            and node.lineno not in noqa
+        ):
+            out.append(LintError(
+                path, node.lineno, "T201",
+                "bare `print` in nxdi_tpu core — use logging or telemetry "
+                "(cli/ and scripts/ are exempt)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
 def lint_source(path: str, source: str) -> List[LintError]:
-    return unused_imports(path, source) + undefined_names(path, source)
+    return (
+        unused_imports(path, source)
+        + undefined_names(path, source)
+        + bare_prints(path, source)
+    )
 
 
 def iter_py_files(roots: Sequence[str]) -> Iterable[str]:

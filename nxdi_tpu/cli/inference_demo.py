@@ -548,7 +548,7 @@ def run_inference(args) -> int:
     if args.benchmark:
         from nxdi_tpu.utils.benchmark import BENCHMARK_REPORT_FILENAME, benchmark_sampling
 
-        benchmark_sampling(
+        report = benchmark_sampling(
             adapter,
             input_ids,
             args.max_new_tokens,
@@ -556,6 +556,8 @@ def run_inference(args) -> int:
             report_path=BENCHMARK_REPORT_FILENAME,
             **{k: v for k, v in gen_kwargs.items() if k != "max_new_tokens"},
         )
+        print("Benchmark completed and its result is as following")
+        print(json.dumps(report, indent=2))
     return rc
 
 

@@ -158,6 +158,38 @@ class ChunkedPrefillConfig:
         return dict(self.__dict__)
 
 
+class TelemetryConfig:
+    """Serving telemetry (nxdi_tpu/telemetry): always-on metrics registry +
+    per-request lifecycle spans owned by the application (``app.telemetry``).
+
+    ``detail``:
+      - ``"off"``   — nothing records.
+      - ``"basic"`` (default) — all metrics/spans record; dispatch latency is
+        the host cost only (never forces a device sync).
+      - ``"full"``  — host-path dispatches additionally block until outputs
+        are ready before recording, so latency histograms measure true step
+        time (``SubmodelProfiler`` flips this on while attached).
+
+    ``max_spans`` bounds the request-span ring buffer (Perfetto export).
+    """
+
+    def __init__(self, **kwargs):
+        self.enabled = bool(kwargs.pop("enabled", True))
+        self.detail = kwargs.pop("detail", "basic")
+        self.max_spans = int(kwargs.pop("max_spans", 256))
+        if self.detail not in ("off", "basic", "full"):
+            raise ValueError(
+                f"telemetry detail must be 'off'|'basic'|'full', got {self.detail!r}"
+            )
+        if self.max_spans < 1:
+            raise ValueError("telemetry max_spans must be >= 1")
+        if kwargs:
+            raise ValueError(f"Unknown TelemetryConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
 class HybridShardingConfig:
     """Per-phase hybrid MoE TPxEP regimes (reference: models/config.py:1060
     ``HybridShardingConfig``). ``moe_cte_ep_degree`` experts-axis width for
@@ -522,6 +554,17 @@ class TpuConfig:
         if isinstance(trc, dict):
             trc = TensorReplacementConfig(**trc)
         self.tensor_replacement_config = trc
+        # serving telemetry (nxdi_tpu/telemetry): always-on metrics registry
+        # + request spans; accepts a TelemetryConfig, a dict of its kwargs, or
+        # a detail-level string ("off" | "basic" | "full")
+        tel = kwargs.pop("telemetry", None)
+        if isinstance(tel, str):
+            tel = TelemetryConfig(detail=tel)
+        elif isinstance(tel, dict):
+            tel = TelemetryConfig(**tel)
+        elif tel is None:
+            tel = TelemetryConfig()
+        self.telemetry = tel
         # serve-time retrace guard (analysis/retrace.py): "warn" logs and
         # "error" raises when any submodel program lowers AFTER warmup sealed
         # the program set (a mid-serving retrace blocks requests on multi-
@@ -787,6 +830,7 @@ class TpuConfig:
         "speculation_config": SpeculationConfig,
         "lora_config": LoraServingConfig,
         "hybrid_sharding_config": HybridShardingConfig,
+        "telemetry": TelemetryConfig,
     }
 
     @property

@@ -59,8 +59,39 @@ class HuggingFaceGenerationAdapter:
         self.config = app.config
         self.tpu_config = app.tpu_config
 
-    def generate(
+    def generate(self, *args, **kwargs) -> np.ndarray:
+        """Greedy/sampling generation. Returns (B, S + new_tokens) ids, with each
+        row's generated tokens appended after its true prompt (right-padding in
+        the prompt region is preserved, like the reference's right-pad support).
+        See :meth:`_generate` for the parameters.
+
+        Telemetry: one request span (``app.telemetry``) covers this batched
+        call — phases pad -> prefill -> decode, TTFT at the first token fetch,
+        TPOT per generated token (window loops attribute their per-token
+        mean), tokens in/out counters. ``tokens_out`` counts emitted decode
+        positions, including a row's post-EOS padding inside the batch.
+        """
+        import time as _time
+
+        tel = getattr(self.app, "telemetry", None)
+        if tel is not None and tel.enabled:
+            span, clock = tel.start_request(), tel.clock
+        else:
+            from nxdi_tpu.telemetry.spans import NULL_SPAN
+
+            span, clock = NULL_SPAN, _time.perf_counter
+        try:
+            return self._generate(span, clock, *args, **kwargs)
+        finally:
+            # idempotent (success paths already finished): this closes the
+            # span when generate RAISES (prompt too long, dispatch error), so
+            # failed requests still count and render in the Perfetto trace
+            span.finish()
+
+    def _generate(
         self,
+        span,
+        clock,
         input_ids: np.ndarray,  # (B, S) right-padded
         attention_mask: Optional[np.ndarray] = None,
         max_new_tokens: Optional[int] = None,
@@ -79,10 +110,7 @@ class HuggingFaceGenerationAdapter:
         generation_config=None,
         **unused,
     ) -> np.ndarray:
-        """Greedy/sampling generation. Returns (B, S + new_tokens) ids, with each
-        row's generated tokens appended after its true prompt (right-padding in
-        the prompt region is preserved, like the reference's right-pad support).
-        """
+        span.phase("pad")
         # HF GenerationConfig passthrough (reference: hf_adapter.py generation
         # config plumbing): config values act as defaults for unset args
         if generation_config is not None:
@@ -139,9 +167,11 @@ class HuggingFaceGenerationAdapter:
                 f"{self.tpu_config.max_context_length} (largest context-encoding "
                 "bucket); recompile with a larger max_context_length"
             )
+        span.add_tokens_in(int(lengths.sum()))
         max_length = min(max_length, self.tpu_config.seq_len)
         n_new = max_length - int(lengths.max())
         if n_new <= 0:
+            span.finish()
             return input_ids
 
         eos_ids = []
@@ -181,6 +211,7 @@ class HuggingFaceGenerationAdapter:
             # steps reuse the last prompt row inside the application
             cte_kwargs["image_attention_mask"] = image_attention_mask
         position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
+        span.phase("prefill")
         outputs = self.app.forward(
             input_ids.astype(np.int32),
             position_ids,
@@ -199,6 +230,10 @@ class HuggingFaceGenerationAdapter:
             running = np.concatenate([running, next_tokens[:, None]], axis=1)
         else:
             next_tokens = self._next_tokens(outputs)
+        span.first_token()
+        span.tokens(B)
+        span.phase("decode")
+        _td0 = clock()
 
         generated: List[np.ndarray] = [next_tokens]
         finished = np.zeros((B,), dtype=bool)
@@ -216,6 +251,8 @@ class HuggingFaceGenerationAdapter:
                 next_tokens, lengths, n_new, eos_ids, pad_token_id, sampling_params, B,
                 lora_kwargs=lora_kwargs,
             )
+            span.tokens(gen.size - B, clock() - _td0)
+            span.finish()
             return self._assemble(input_ids, gen, lengths, pad_token_id)
 
         # multi-step decode: the tkg_multistep submodel retires K tokens per
@@ -237,6 +274,8 @@ class HuggingFaceGenerationAdapter:
                 sampling_params, B,
                 cte_next_inputs=outputs.get("next_inputs"),
             )
+            span.tokens(gen.size - B, clock() - _td0)
+            span.finish()
             return self._assemble(input_ids, gen, lengths, pad_token_id)
 
         # per-request adapters are host-side state the device decode loop
@@ -251,10 +290,13 @@ class HuggingFaceGenerationAdapter:
             gen = self._device_decode_loop(
                 outputs["next_inputs"], next_tokens, lengths, n_new, eos_ids, pad_token_id, B
             )
+            span.tokens(gen.size - B, clock() - _td0)
+            span.finish()
             return self._assemble(input_ids, gen, lengths, pad_token_id)
 
         # ---- token generation loop ----
         cur_pos = lengths.copy()  # position of the next token to write
+        _tstep = clock()
         for _ in range(n_new - 1):
             if finished.all():
                 break
@@ -278,11 +320,15 @@ class HuggingFaceGenerationAdapter:
                 next_tokens = self._next_tokens(outputs)
             next_tokens = np.where(finished, pad_token_id, next_tokens)
             generated.append(next_tokens)
+            _now = clock()
+            span.tokens(B, _now - _tstep)
+            _tstep = _now
             for e in eos_ids:
                 finished |= next_tokens == e
             cur_pos = cur_pos + 1
 
         gen = np.stack(generated, axis=1)  # (B, T)
+        span.finish()
         return self._assemble(input_ids, gen, lengths, pad_token_id)
 
     def _host_select(
@@ -491,6 +537,9 @@ class HuggingFaceGenerationAdapter:
 
         window_limit = decode_window_limit(self.tpu_config, self.app.models)
 
+        tel = getattr(self.app, "telemetry", None)
+        if tel is not None and not tel.enabled:
+            tel = None
         while not finished.all():
             outputs = self.app.forward(
                 cur_tok[:, None],
@@ -501,6 +550,11 @@ class HuggingFaceGenerationAdapter:
             )
             toks = np.asarray(jax.device_get(outputs["tokens"]))  # (B, k+1)
             cnts = np.asarray(jax.device_get(outputs["counts"]))  # (B,)
+            if tel is not None:
+                tel.record_spec_window(
+                    (int(c) for c, f in zip(cnts, finished) if not f),
+                    path=getattr(self.app, "spec_telemetry_path", "fused"),
+                )
             for b in range(B):
                 if finished[b]:
                     continue

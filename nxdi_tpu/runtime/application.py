@@ -117,6 +117,12 @@ class ApplicationBase:
         self.kv_cache = None
         self.is_loaded = False
         self.retrace_guard = None  # created in _build_wrappers per TpuConfig
+        # serving telemetry (nxdi_tpu/telemetry): always-on registry + spans,
+        # per TpuConfig(telemetry=...); the wrappers, generation adapter,
+        # block manager, and retrace guard all record into it
+        from nxdi_tpu.telemetry import Telemetry
+
+        self.telemetry = Telemetry.from_config(self.tpu_config)
 
     # -- submodel construction: subclasses populate self.models --
     def enable_models(self) -> None:
@@ -426,12 +432,14 @@ class ApplicationBase:
             from nxdi_tpu.analysis import RetraceGuard
 
             self.retrace_guard = RetraceGuard(
-                mode=getattr(self.tpu_config, "retrace_guard", "warn")
+                mode=getattr(self.tpu_config, "retrace_guard", "warn"),
+                telemetry=self.telemetry,
             )
         param_shardings = sharding_tree(self.param_specs(), self.mesh)
         cache_shardings = sharding_tree(self.cache_partition_specs(), self.mesh)
         for wrapper in self.models.values():
             wrapper.retrace_guard = self.retrace_guard
+            wrapper.telemetry = self.telemetry
             wrapper.build(self.mesh, param_shardings, cache_shardings)
 
     def warmup(self) -> None:

@@ -17,33 +17,51 @@ import numpy as np
 
 
 class BlockSpaceManager:
-    """First-fit block allocator with refcounts (prefix blocks can be shared)."""
+    """First-fit block allocator with refcounts (prefix blocks can be shared).
 
-    def __init__(self, num_blocks: int, block_size: int):
+    With ``telemetry`` (a ``nxdi_tpu.telemetry.Telemetry``, typically
+    ``app.telemetry``) attached, pool occupancy is published as the
+    ``nxdi_kv_blocks_free``/``nxdi_kv_blocks_used`` gauges and fork/free
+    events count into ``nxdi_kv_block_forks_total``/``nxdi_kv_block_frees_total``.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int, telemetry=None):
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._free = deque(range(num_blocks))
         self._tables: Dict[int, List[int]] = {}
         self._refs = np.zeros(num_blocks, dtype=np.int64)
+        self.telemetry = telemetry
+        self._publish()
 
     # ------------------------------------------------------------------
     def num_free_blocks(self) -> int:
         return len(self._free)
+
+    def _publish(self) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        tel.kv_blocks_free.set(len(self._free))
+        tel.kv_blocks_used.set(self.num_blocks - len(self._free))
 
     def ensure_capacity(self, seq_id: int, num_tokens: int) -> List[int]:
         """Grow seq_id's table to cover ``num_tokens`` positions; returns the
         table. Raises if the pool is exhausted (caller preempts/evicts)."""
         table = self._tables.setdefault(seq_id, [])
         needed = -(-num_tokens // self.block_size)
-        while len(table) < needed:
-            if not self._free:
-                raise RuntimeError(
-                    f"KV block pool exhausted ({self.num_blocks} blocks); "
-                    f"free a sequence or raise pa_num_blocks"
-                )
-            blk = self._free.popleft()
-            self._refs[blk] += 1
-            table.append(blk)
+        try:
+            while len(table) < needed:
+                if not self._free:
+                    raise RuntimeError(
+                        f"KV block pool exhausted ({self.num_blocks} blocks); "
+                        f"free a sequence or raise pa_num_blocks"
+                    )
+                blk = self._free.popleft()
+                self._refs[blk] += 1
+                table.append(blk)
+        finally:
+            self._publish()
         return table
 
     def fork_prefix(self, seq_id: int, prefix_table: Sequence[int]) -> None:
@@ -54,12 +72,19 @@ class BlockSpaceManager:
         for blk in prefix_table:
             self._refs[blk] += 1
         self._tables[seq_id] = list(prefix_table)
+        if self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.kv_block_forks_total.inc()
+        self._publish()
 
     def free_seq(self, seq_id: int) -> None:
-        for blk in self._tables.pop(seq_id, []):
+        freed = self._tables.pop(seq_id, [])
+        for blk in freed:
             self._refs[blk] -= 1
             if self._refs[blk] == 0:
                 self._free.append(blk)
+        if freed and self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.kv_block_frees_total.inc()
+        self._publish()
 
     # ------------------------------------------------------------------
     def block_table(self, seq_id: int, width: Optional[int] = None) -> np.ndarray:

@@ -273,6 +273,11 @@ class ModelWrapper:
         # analysis.RetraceGuard shared across the app's wrappers; set by the
         # application before build() so programs report their lowerings
         self.retrace_guard = None
+        # serving telemetry (nxdi_tpu/telemetry.Telemetry) shared across the
+        # app's wrappers; set by the application in _build_wrappers. Every
+        # dispatch records per-(submodel, bucket[, steps]) count + latency +
+        # padding waste into its registry.
+        self.telemetry = None
 
     # ------------------------------------------------------------------
     # build: one jitted program per bucket (reference: model_wrapper.py:1442
@@ -500,6 +505,11 @@ class ModelWrapper:
         (b,), sampling_params (b, 3). b may be smaller than the compiled batch.
         Returns (outputs, new_cache) with outputs still on device (async).
         """
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            _t0 = tel.clock()
+        else:
+            tel = None
         input_ids = np.asarray(batch_np["input_ids"], dtype=np.int32)
         position_ids = np.asarray(batch_np["position_ids"], dtype=np.int32)
         b, s = input_ids.shape
@@ -609,6 +619,15 @@ class ModelWrapper:
             jax.block_until_ready(outputs)
             for hook in self.post_hooks:
                 hook(self.tag)
+        if tel is not None:
+            if tel.sync_dispatch and not self.post_hooks:
+                jax.block_until_ready(outputs)
+            tel.record_dispatch(
+                self.tag, bucket, self._telemetry_steps(),
+                tel.clock() - _t0,
+                real_tokens=orig_b * s,
+                padded_tokens=self.batch_size * pad_s,
+            )
         outputs = {
             k: (v if k in ("next_inputs", "captured") else v[:orig_b])
             for k, v in outputs.items()
@@ -679,14 +698,30 @@ class ModelWrapper:
         bucket) pairs instead."""
         return self._programs[bucket](params, cache, device_batch)
 
+    def _telemetry_steps(self) -> int:
+        """Decode steps retired per dispatch — the ``steps`` metric label
+        (the multi-step wrapper reports its active rung)."""
+        return 1
+
     def forward_device(self, params, cache, device_batch, total_len: int):
         """Hot-path dispatch with inputs already on device (the async loop:
         outputs of step N feed step N+1 without a host round trip; reference:
         async_execution.py:131 execute_model + ranked I/O).
 
         ``total_len`` (host-tracked) picks the bucket; no device sync happens.
+        Telemetry records the host enqueue cost only — this path is never
+        synced, even at detail="full", to keep the chain pipelined.
         """
         bucket = self.select_bucket(total_len)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            t0 = tel.clock()
+            with jax.set_mesh(self._mesh):
+                out = self._run_program(bucket, params, cache, device_batch)
+            tel.record_dispatch(
+                self.tag, bucket, self._telemetry_steps(), tel.clock() - t0
+            )
+            return out
         with jax.set_mesh(self._mesh):
             return self._run_program(bucket, params, cache, device_batch)
 
@@ -781,6 +816,9 @@ class MultiStepTKGWrapper(ModelWrapper):
         return self._programs[(self._steps_hint, bucket)](
             params, cache, device_batch
         )
+
+    def _telemetry_steps(self) -> int:
+        return self._steps_hint
 
     def forward_device(
         self, params, cache, device_batch, total_len: int,

@@ -35,6 +35,9 @@ class FusedSpecCausalLM(TpuModelForCausalLM):
     """CausalLM with on-device speculative decoding (draft + target fused)."""
 
     is_fused_spec = True
+    # label for nxdi_spec_accepted_tokens{path=...} (recorded by the
+    # adapter's window loop); EAGLE inherits, medusa sets its own
+    spec_telemetry_path = "fused"
 
     def __init__(
         self,
@@ -387,6 +390,7 @@ class MedusaCausalLM(TpuModelForCausalLM):
     """
 
     is_fused_spec = True
+    spec_telemetry_path = "medusa"
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)

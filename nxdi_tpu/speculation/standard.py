@@ -91,6 +91,9 @@ class StandardSpecCausalLM:
     inference_demo.py:502 — two compiled models, CPU assisted-decoding)."""
 
     is_fused_spec = True
+    # label for nxdi_spec_accepted_tokens{path=...}: the adapter's window
+    # loop records acceptance for every is_fused_spec app under this path
+    spec_telemetry_path = "standard"
 
     def __init__(
         self,
@@ -127,6 +130,12 @@ class StandardSpecCausalLM:
     @property
     def models(self):
         return self.target.models
+
+    @property
+    def telemetry(self):
+        """One registry for the pair: the TARGET app's (draft dispatches
+        record into its own registry; window acceptance lands here)."""
+        return self.target.telemetry
 
     @property
     def is_loaded(self):
@@ -194,4 +203,7 @@ class StandardSpecCausalLM:
         matches = (candidates[:, 1:] == target_tokens[:, :-1]).astype(np.int32)
         accepted = np.cumprod(matches, axis=1)
         counts = accepted.sum(axis=1) + 1
+        # acceptance telemetry is recorded ONCE, by the adapter's window loop
+        # (hf_adapter._fused_spec_decode, path=spec_telemetry_path), which
+        # also filters finished rows — not here, or windows double-count
         return {"tokens": target_tokens, "counts": counts}

@@ -1,0 +1,253 @@
+"""Serving telemetry: always-on metrics + per-request lifecycle spans.
+
+Nearly everything that determines NxDI's production latency is decided on
+the HOST — bucket choice, padding waste, KV-block occupancy, speculation
+acceptance, retrace events — so it is cheap to record continuously. This
+package is the always-on layer the old pull-based tools
+(``SubmodelProfiler``, ``bench.py`` hooks) now read from, so there is
+exactly one timing path:
+
+- :mod:`~nxdi_tpu.telemetry.registry` — counters/gauges/histograms with
+  fixed log-spaced bounds (bounded memory, thread-safe).
+- :mod:`~nxdi_tpu.telemetry.spans` — request spans (queue/pad/prefill/decode,
+  TTFT, TPOT) in a bounded ring buffer.
+- :mod:`~nxdi_tpu.telemetry.export` — Perfetto ``trace_events`` JSON and a
+  stdlib ``/metrics`` HTTP endpoint; Prometheus text + JSON snapshot come
+  from the registry.
+
+Every application owns a :class:`Telemetry` (``app.telemetry``) built from
+``TpuConfig(telemetry=...)``; the dispatch spine (``runtime/model_wrapper``),
+generation adapter, block manager, speculation loops, and retrace guard all
+record into it. CLI: ``python -m nxdi_tpu.cli.metrics``.
+
+Metric catalog (labels in parens):
+
+====================================  =========  ==================================
+``nxdi_dispatches_total``             counter    (submodel, bucket, steps)
+``nxdi_dispatch_seconds``             histogram  (submodel, bucket, steps)
+``nxdi_padding_waste_ratio``          histogram  (submodel)
+``nxdi_real_tokens_total``            counter    (submodel)
+``nxdi_padded_tokens_total``          counter    (submodel)
+``nxdi_requests_total``               counter
+``nxdi_request_seconds``              histogram
+``nxdi_request_ttft_seconds``         histogram
+``nxdi_request_tpot_seconds``         histogram
+``nxdi_request_tokens_in_total``      counter
+``nxdi_request_tokens_out_total``     counter
+``nxdi_kv_blocks_free``               gauge
+``nxdi_kv_blocks_used``               gauge
+``nxdi_kv_block_forks_total``         counter
+``nxdi_kv_block_frees_total``         counter
+``nxdi_spec_accepted_tokens``         histogram  (path)
+``nxdi_program_lowerings_total``      counter    (phase: warmup|serving)
+====================================  =========  ==================================
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from nxdi_tpu.telemetry import export as _export
+from nxdi_tpu.telemetry.registry import (
+    LENGTH_BOUNDS,
+    RATIO_BOUNDS,
+    TIME_BOUNDS_S,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    log_spaced_bounds,
+    percentile_from_buckets,
+    prometheus_text,
+)
+from nxdi_tpu.telemetry.spans import NULL_SPAN, RequestSpan, SpanTracker
+
+__all__ = [
+    "Telemetry",
+    "MetricsRegistry",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "SpanTracker",
+    "RequestSpan",
+    "NULL_SPAN",
+    "MetricsServer",
+    "prometheus_text",
+    "percentile_from_buckets",
+    "log_spaced_bounds",
+    "TIME_BOUNDS_S",
+    "RATIO_BOUNDS",
+    "LENGTH_BOUNDS",
+]
+
+MetricsServer = _export.MetricsServer
+
+DETAIL_LEVELS = ("off", "basic", "full")
+
+
+class Telemetry:
+    """The per-application telemetry facade: one registry + one span tracker
+    + pre-bound metric families for the hot paths.
+
+    Detail levels (``TpuConfig(telemetry=...)``):
+
+    - ``"off"``   — nothing records; hot paths see one boolean check.
+    - ``"basic"`` (default) — all metrics and spans record; dispatch latency
+      is the HOST cost of a dispatch (pad + enqueue — JAX dispatch is async,
+      so this does not include device execution and never forces a sync).
+    - ``"full"``  — additionally ``sync_dispatch``: the host-path dispatch
+      blocks until outputs are ready before recording, so the latency
+      histogram measures true step latency (what ``SubmodelProfiler``
+      turns on while attached). Device-resident chains are never synced.
+    """
+
+    def __init__(self, enabled: bool = True, detail: str = "basic",
+                 max_spans: int = 256, clock=None):
+        if detail not in DETAIL_LEVELS:
+            raise ValueError(
+                f"telemetry detail must be one of {DETAIL_LEVELS}, got {detail!r}"
+            )
+        self.detail = detail
+        self.enabled = bool(enabled) and detail != "off"
+        self.sync_dispatch = detail == "full"
+        self.clock = clock or time.perf_counter
+        self.registry = MetricsRegistry()
+        self.spans = SpanTracker(self, max_spans=max_spans)
+
+        r = self.registry
+        disp_labels = ("submodel", "bucket", "steps")
+        self.dispatches_total = r.counter(
+            "nxdi_dispatches_total",
+            "host dispatches per compiled (submodel, bucket[, steps]) program",
+            disp_labels,
+        )
+        self.dispatch_seconds = r.histogram(
+            "nxdi_dispatch_seconds",
+            "host wall-clock per dispatch (sync_dispatch adds device wait)",
+            disp_labels, bounds=TIME_BOUNDS_S,
+        )
+        self.padding_waste = r.histogram(
+            "nxdi_padding_waste_ratio",
+            "(padded - real) / padded tokens per host-path dispatch",
+            ("submodel",), bounds=RATIO_BOUNDS,
+        )
+        self.real_tokens_total = r.counter(
+            "nxdi_real_tokens_total", "real tokens entering dispatch", ("submodel",)
+        )
+        self.padded_tokens_total = r.counter(
+            "nxdi_padded_tokens_total",
+            "tokens actually computed after bucket/batch padding", ("submodel",),
+        )
+        self.requests_total = r.counter(
+            "nxdi_requests_total", "finished generation requests"
+        )
+        self.request_seconds = r.histogram(
+            "nxdi_request_seconds", "end-to-end request wall-clock"
+        )
+        self.ttft_seconds = r.histogram(
+            "nxdi_request_ttft_seconds", "time to first token"
+        )
+        self.tpot_seconds = r.histogram(
+            "nxdi_request_tpot_seconds", "inter-token time (per generated token)"
+        )
+        self.tokens_in_total = r.counter(
+            "nxdi_request_tokens_in_total", "prompt tokens received"
+        )
+        self.tokens_out_total = r.counter(
+            "nxdi_request_tokens_out_total", "tokens generated"
+        )
+        self.kv_blocks_free = r.gauge(
+            "nxdi_kv_blocks_free", "free blocks in the paged-KV pool"
+        )
+        self.kv_blocks_used = r.gauge(
+            "nxdi_kv_blocks_used", "allocated blocks in the paged-KV pool"
+        )
+        self.kv_block_forks_total = r.counter(
+            "nxdi_kv_block_forks_total", "prefix forks (shared-block starts)"
+        )
+        self.kv_block_frees_total = r.counter(
+            "nxdi_kv_block_frees_total", "sequence frees returning blocks"
+        )
+        self.spec_accepted = r.histogram(
+            "nxdi_spec_accepted_tokens",
+            "tokens retired per speculation window (accepted + bonus)",
+            ("path",), bounds=LENGTH_BOUNDS,
+        )
+        self.lowerings_total = r.counter(
+            "nxdi_program_lowerings_total",
+            "program lowerings by phase (serving = post-seal retrace!)",
+            ("phase",),
+        )
+
+    # -- construction from config ------------------------------------------
+    @classmethod
+    def from_config(cls, tpu_config) -> "Telemetry":
+        tc = getattr(tpu_config, "telemetry", None)
+        if tc is None:
+            return cls()
+        return cls(
+            enabled=getattr(tc, "enabled", True),
+            detail=getattr(tc, "detail", "basic"),
+            max_spans=getattr(tc, "max_spans", 256),
+        )
+
+    # -- hot-path recorders -------------------------------------------------
+    def record_dispatch(
+        self,
+        submodel: str,
+        bucket,
+        steps,
+        seconds: float,
+        real_tokens: Optional[int] = None,
+        padded_tokens: Optional[int] = None,
+    ) -> None:
+        labels = dict(submodel=submodel, bucket=str(bucket), steps=str(steps))
+        self.dispatches_total.inc(**labels)
+        self.dispatch_seconds.observe(seconds, **labels)
+        if real_tokens is not None and padded_tokens:
+            self.real_tokens_total.inc(real_tokens, submodel=submodel)
+            self.padded_tokens_total.inc(padded_tokens, submodel=submodel)
+            self.padding_waste.observe(
+                (padded_tokens - real_tokens) / padded_tokens, submodel=submodel
+            )
+
+    def start_request(self, tokens_in: int = 0):
+        if not self.enabled:
+            return NULL_SPAN
+        return self.spans.start(tokens_in=tokens_in)
+
+    def record_spec_window(self, counts, path: str) -> None:
+        """Accepted-length histogram per speculation window; ``counts`` is a
+        per-row iterable of tokens retired (accepted + bonus)."""
+        for c in counts:
+            self.spec_accepted.observe(float(c), path=path)
+
+    def record_lowering(self, label: str, post_seal: bool) -> None:
+        self.lowerings_total.inc(phase="serving" if post_seal else "warmup")
+
+    # -- export -------------------------------------------------------------
+    def snapshot(self) -> dict:
+        snap = self.registry.snapshot()
+        snap["_spans"] = self.spans.to_list()
+        return snap
+
+    def prometheus_text(self) -> str:
+        return prometheus_text(self.registry)
+
+    def perfetto_trace(self, process_name: str = "nxdi_tpu") -> dict:
+        return _export.perfetto_trace(self.spans, process_name=process_name)
+
+    def write_perfetto_trace(self, path: str, process_name: str = "nxdi_tpu") -> dict:
+        return _export.write_perfetto_trace(
+            self.spans, path, process_name=process_name
+        )
+
+    def serve(self, host: str = "127.0.0.1", port: int = 9400) -> "MetricsServer":
+        """Start a daemon-thread HTTP server exposing ``/metrics`` (Prometheus
+        text), ``/metrics.json``, and ``/trace.json``."""
+        return MetricsServer(self, host=host, port=port).start()
+
+    def reset(self) -> None:
+        self.registry.reset()
+        self.spans.reset()

@@ -1,0 +1,135 @@
+"""Export surfaces: Perfetto/Chrome ``trace_events`` JSON and a stdlib
+``/metrics`` HTTP endpoint for Prometheus scrapes.
+
+The Prometheus text and JSON snapshot formatters live on the registry
+(:func:`nxdi_tpu.telemetry.registry.prometheus_text`,
+:meth:`~nxdi_tpu.telemetry.registry.MetricsRegistry.snapshot`); this module
+holds everything that needs the span tracker or a socket.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+
+def perfetto_trace(tracker, process_name: str = "nxdi_tpu") -> dict:
+    """Chrome/Perfetto ``trace_events`` JSON of the tracked request spans.
+
+    Each request renders as one track (``tid`` = request id) of complete
+    ("X") phase slices; timestamps are microseconds relative to the earliest
+    span so the trace opens at t=0 in the Perfetto UI. The file loads in
+    ``ui.perfetto.dev`` or ``chrome://tracing`` and can sit next to an xprof
+    capture of the same run (``nxdi_tpu.utils.profiling.trace``).
+    """
+    spans = list(tracker.spans)
+    t0 = min((s.t_start for s in spans), default=0.0)
+
+    def us(t: float) -> float:
+        return round((t - t0) * 1e6, 3)
+
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": 1,
+            "args": {"name": process_name},
+        }
+    ]
+    for s in spans:
+        events.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": 1,
+            "tid": s.request_id,
+            "args": {"name": f"request {s.request_id}"},
+        })
+        end = s.t_end if s.t_end is not None else s.t_start
+        events.append({
+            "name": "request",
+            "cat": "request",
+            "ph": "X",
+            "pid": 1,
+            "tid": s.request_id,
+            "ts": us(s.t_start),
+            "dur": round(max(end - s.t_start, 0.0) * 1e6, 3),
+            "args": {
+                "tokens_in": s.tokens_in,
+                "tokens_out": s.tokens_out,
+                "ttft_ms": None if s.ttft_s is None else round(s.ttft_s * 1e3, 3),
+            },
+        })
+        for name, b, e in s.phases:
+            events.append({
+                "name": name,
+                "cat": "phase",
+                "ph": "X",
+                "pid": 1,
+                "tid": s.request_id,
+                "ts": us(b),
+                "dur": round(max(e - b, 0.0) * 1e6, 3),
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def write_perfetto_trace(tracker, path: str, process_name: str = "nxdi_tpu") -> dict:
+    trace = perfetto_trace(tracker, process_name=process_name)
+    with open(path, "w") as f:
+        json.dump(trace, f)
+    return trace
+
+
+class MetricsServer:
+    """Tiny stdlib HTTP server: ``/metrics`` (Prometheus text), ``/metrics.json``
+    (JSON snapshot), ``/trace.json`` (Perfetto). Runs on a daemon thread."""
+
+    def __init__(self, telemetry, host: str = "127.0.0.1", port: int = 9400):
+        tel = telemetry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                if self.path.startswith("/metrics.json"):
+                    body = json.dumps(tel.snapshot(), indent=2).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/trace.json"):
+                    body = json.dumps(tel.perfetto_trace()).encode()
+                    ctype = "application/json"
+                elif self.path.startswith("/metrics"):
+                    body = tel.prometheus_text().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):  # quiet: scrapes are not events
+                pass
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsServer":
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        self._server.serve_forever()
+
+    def shutdown(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

@@ -1,0 +1,337 @@
+"""Always-on metrics registry: counters, gauges, histograms.
+
+Design constraints (the whole point of this module vs. the old pull-based
+``LatencyCollector`` lists):
+
+- **Bounded memory.** Histograms hold fixed log-spaced bucket counts — never
+  an unbounded per-observation list — so the registry can stay attached for
+  the life of a serving process under millions of requests.
+- **Low overhead.** One registry-wide lock, dict lookups keyed by label
+  tuples, no allocation on the hot path beyond the key tuple. A record is a
+  few microseconds; the dispatch spine calls it once per host dispatch.
+- **Thread-safe.** Serving loops, profiler attach/detach, and an exposition
+  scrape may run concurrently.
+
+The exposition formats (Prometheus text, JSON snapshot, Perfetto trace) live
+in :mod:`nxdi_tpu.telemetry.export`; request-lifecycle spans in
+:mod:`nxdi_tpu.telemetry.spans`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+
+def log_spaced_bounds(lo: float, hi: float, per_decade: int = 4) -> Tuple[float, ...]:
+    """Fixed log-spaced histogram bounds from ``lo`` to >= ``hi``."""
+    out: List[float] = []
+    v = float(lo)
+    ratio = 10.0 ** (1.0 / per_decade)
+    while v < hi * (1.0 + 1e-9):
+        out.append(float(f"{v:.6g}"))
+        v *= ratio
+    return tuple(out)
+
+
+#: seconds-valued histograms (dispatch latency, TTFT, TPOT): 25 us .. ~52 s,
+#: one bucket per power of two — fixed, log-spaced, 22 bounds
+TIME_BOUNDS_S: Tuple[float, ...] = tuple(25e-6 * (2.0 ** i) for i in range(22))
+
+#: ratios in [0, 1] (padding waste): sixteenth steps
+RATIO_BOUNDS: Tuple[float, ...] = tuple((i + 1) / 16.0 for i in range(16))
+
+#: small integer lengths (speculation accepted tokens, multi-step rungs)
+LENGTH_BOUNDS: Tuple[float, ...] = (1, 2, 3, 4, 6, 8, 12, 16, 24, 32)
+
+
+class _Metric:
+    """One metric family: a name, a type, fixed label names, and a series
+    per distinct label-value tuple."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, label_names: Sequence[str], lock):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = lock
+        self._series: Dict[Tuple[str, ...], object] = {}
+
+    def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
+        if tuple(sorted(labels)) != tuple(sorted(self.label_names)):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.label_names}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[n]) for n in self.label_names)
+
+    def labels_of(self, key: Tuple[str, ...]) -> Dict[str, str]:
+        return dict(zip(self.label_names, key))
+
+    def series(self) -> Dict[Tuple[str, ...], object]:
+        with self._lock:
+            return dict(self._series)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+    def total(self) -> float:
+        with self._lock:
+            return float(sum(self._series.values()))
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class _HistSeries:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, n_buckets: int):
+        self.counts = [0] * n_buckets  # one per bound + the +Inf bucket
+        self.sum = 0.0
+        self.count = 0
+
+
+class Histogram(_Metric):
+    """Fixed-bound histogram (default: log-spaced seconds). Percentiles are
+    estimated by linear interpolation within the containing bucket — exact
+    enough for serving dashboards, O(1) memory regardless of traffic."""
+
+    kind = "histogram"
+
+    def __init__(self, name, help, label_names, lock, bounds=TIME_BOUNDS_S):
+        super().__init__(name, help, label_names, lock)
+        if not bounds or list(bounds) != sorted(bounds):
+            raise ValueError(f"histogram {name!r} needs sorted, nonempty bounds")
+        self.bounds = tuple(float(b) for b in bounds)
+
+    def observe(self, value: float, n: int = 1, **labels) -> None:
+        """Record ``n`` observations of ``value`` (n>1 lets a window loop
+        attribute its per-token mean to each retired token in one call)."""
+        key = self._key(labels)
+        # bisect by hand: bounds are short tuples and this avoids an import
+        # in the hot path; bucket i covers (bounds[i-1], bounds[i]]
+        idx = 0
+        for b in self.bounds:
+            if value <= b:
+                break
+            idx += 1
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.bounds) + 1)
+            s.counts[idx] += n
+            s.sum += value * n
+            s.count += n
+
+    def snapshot_series(self, **labels) -> Optional[_HistSeries]:
+        with self._lock:
+            s = self._series.get(self._key(labels))
+            if s is None:
+                return None
+            out = _HistSeries(len(s.counts))
+            out.counts = list(s.counts)
+            out.sum = s.sum
+            out.count = s.count
+            return out
+
+    def series_snapshot(self) -> Dict[Tuple[str, ...], Tuple[List[int], float, int]]:
+        """Consistent copy of every series under the lock — what exporters
+        and the profiler read, so a concurrent observe() can never produce a
+        count that disagrees with the buckets/sum (torn read)."""
+        with self._lock:
+            return {
+                key: (list(s.counts), s.sum, s.count)
+                for key, s in self._series.items()
+            }
+
+    def percentile(self, p: float, **labels) -> float:
+        s = self.snapshot_series(**labels)
+        if s is None:
+            return 0.0
+        return percentile_from_buckets(self.bounds, s.counts, s.count, p)
+
+
+def percentile_from_buckets(
+    bounds: Sequence[float], counts: Sequence[int], total: int, p: float
+) -> float:
+    """Interpolated percentile from cumulative-free bucket counts. The +Inf
+    bucket clamps to the largest finite bound (we cannot extrapolate)."""
+    if total <= 0:
+        return 0.0
+    target = total * min(max(p, 0.0), 100.0) / 100.0
+    cum = 0
+    for i, c in enumerate(counts):
+        if c and cum + c >= target:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            return lo + (hi - lo) * ((target - cum) / c)
+        cum += c
+    return float(bounds[-1])
+
+
+class MetricsRegistry:
+    """Holds every metric family; one lock shared by all of them."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _register(self, cls, name, help, labels, **kw) -> _Metric:
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.label_names != tuple(labels):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.label_names}"
+                    )
+                return existing
+            m = cls(name, help, labels, self._lock, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "", labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "", labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge, name, help, labels)
+
+    def histogram(
+        self, name: str, help: str = "", labels: Sequence[str] = (),
+        bounds: Sequence[float] = TIME_BOUNDS_S,
+    ) -> Histogram:
+        return self._register(Histogram, name, help, labels, bounds=bounds)
+
+    def metrics(self) -> List[_Metric]:
+        with self._lock:
+            return list(self._metrics.values())
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Zero every series; registrations (the catalog) survive."""
+        for m in self.metrics():
+            m.reset()
+
+    # -- JSON snapshot ------------------------------------------------------
+    def snapshot(self) -> dict:
+        """JSON-able view of every non-empty series, with estimated
+        p50/p90/p99 for histograms (what ``--metrics-out`` and the CLI dump)."""
+        out: dict = {}
+        for m in self.metrics():
+            # consistent per-family copies: histograms snapshot counts/sum/
+            # count under the lock so a concurrent observe() can't tear them
+            series = (
+                m.series_snapshot() if isinstance(m, Histogram) else m.series()
+            )
+            if not series:
+                continue
+            entry: dict = {"type": m.kind, "help": m.help}
+            rows = []
+            for key in sorted(series):
+                val = series[key]
+                row: dict = {"labels": m.labels_of(key)}
+                if isinstance(m, Histogram):
+                    counts, total_sum, count = val
+                    row["count"] = count
+                    row["sum"] = total_sum
+                    row["buckets"] = {
+                        str(b): c for b, c in zip(m.bounds, counts) if c
+                    }
+                    if counts[-1]:
+                        row["buckets"]["+Inf"] = counts[-1]
+                    for p in (50, 90, 99):
+                        row[f"p{p}"] = percentile_from_buckets(
+                            m.bounds, counts, count, p
+                        )
+                else:
+                    row["value"] = val
+                rows.append(row)
+            entry["series"] = rows
+            out[m.name] = entry
+        return out
+
+
+def iter_prometheus_lines(registry: MetricsRegistry) -> Iterable[str]:
+    """Prometheus text-exposition lines (format 0.0.4) for every family that
+    has at least one series."""
+    def esc(v: str) -> str:
+        return v.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"')
+
+    def fmt_labels(d: Dict[str, str], extra: str = "") -> str:
+        parts = [f'{k}="{esc(v)}"' for k, v in d.items()]
+        if extra:
+            parts.append(extra)
+        return "{" + ",".join(parts) + "}" if parts else ""
+
+    def num(v: float) -> str:
+        f = float(v)
+        return str(int(f)) if f == int(f) else repr(f)
+
+    for m in registry.metrics():
+        # locked copies, so a concurrent observe() can't tear bucket/sum/count
+        series = m.series_snapshot() if isinstance(m, Histogram) else m.series()
+        if not series:
+            continue
+        if m.help:
+            yield f"# HELP {m.name} {m.help}"
+        yield f"# TYPE {m.name} {m.kind}"
+        for key in sorted(series):
+            labels = m.labels_of(key)
+            val = series[key]
+            if isinstance(m, Histogram):
+                counts, total_sum, count = val
+                cum = 0
+                for b, c in zip(m.bounds, counts):
+                    cum += c
+                    le = 'le="%s"' % num(b)
+                    yield f"{m.name}_bucket{fmt_labels(labels, le)} {cum}"
+                cum += counts[-1]
+                inf = 'le="+Inf"'
+                yield f"{m.name}_bucket{fmt_labels(labels, inf)} {cum}"
+                yield f"{m.name}_sum{fmt_labels(labels)} {repr(float(total_sum))}"
+                yield f"{m.name}_count{fmt_labels(labels)} {count}"
+            else:
+                yield f"{m.name}{fmt_labels(labels)} {num(val)}"
+
+
+def prometheus_text(registry: MetricsRegistry) -> str:
+    return "\n".join(iter_prometheus_lines(registry)) + "\n"

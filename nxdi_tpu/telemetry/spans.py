@@ -1,0 +1,150 @@
+"""Per-request lifecycle spans: queue -> pad -> prefill -> decode.
+
+A :class:`RequestSpan` is the host-side record of one generation request (for
+a batched ``generate`` call: one span covering the batch). Phases are closed
+by opening the next one, so instrumented code never needs paired begin/end
+calls on the hot path. On ``finish()`` the span folds into the registry
+(TTFT/TPOT histograms, token counters) and is retained in a bounded ring
+buffer for the Perfetto ``trace_events`` export — memory stays fixed no
+matter how long the process serves.
+
+The clock is injected through the owning :class:`~nxdi_tpu.telemetry.Telemetry`
+so tests drive spans deterministically.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+#: canonical phase order (external serving layers may add their own names;
+#: these are what the built-in generation adapter emits)
+PHASES = ("queue", "pad", "prefill", "decode")
+
+
+class RequestSpan:
+    __slots__ = (
+        "request_id", "t_start", "t_end", "phases", "tokens_in", "tokens_out",
+        "ttft_s", "_tel", "_open", "_finished",
+    )
+
+    def __init__(self, tel, request_id: int, t_start: float):
+        self._tel = tel
+        self.request_id = request_id
+        self.t_start = t_start
+        self.t_end: Optional[float] = None
+        # [(name, t_begin, t_end)] — a handful of entries, never per-token
+        self.phases: List[Tuple[str, float, float]] = []
+        self.tokens_in = 0
+        self.tokens_out = 0
+        self.ttft_s: Optional[float] = None
+        self._open: Optional[Tuple[str, float]] = None
+        self._finished = False
+
+    # -- lifecycle ----------------------------------------------------------
+    def phase(self, name: str) -> "RequestSpan":
+        """Open ``name``, closing any open phase at the same instant."""
+        now = self._tel.clock()
+        if self._open is not None:
+            self.phases.append((self._open[0], self._open[1], now))
+        self._open = (name, now)
+        return self
+
+    def first_token(self) -> None:
+        """Mark time-to-first-token (idempotent; the first call wins)."""
+        if self.ttft_s is None:
+            self.ttft_s = self._tel.clock() - self.t_start
+            self._tel.ttft_seconds.observe(self.ttft_s)
+
+    def add_tokens_in(self, n: int) -> None:
+        self.tokens_in += int(n)
+
+    def tokens(self, n: int, elapsed_s: Optional[float] = None) -> None:
+        """Record ``n`` generated tokens; with ``elapsed_s`` the per-token
+        mean is observed into the TPOT histogram once per token."""
+        n = int(n)
+        if n <= 0:
+            return
+        self.tokens_out += n
+        if elapsed_s is not None and elapsed_s >= 0:
+            self._tel.tpot_seconds.observe(elapsed_s / n, n=n)
+
+    def finish(self) -> None:
+        if self._finished:
+            return
+        self._finished = True
+        now = self._tel.clock()
+        if self._open is not None:
+            self.phases.append((self._open[0], self._open[1], now))
+            self._open = None
+        self.t_end = now
+        tel = self._tel
+        tel.requests_total.inc()
+        if self.tokens_in:
+            tel.tokens_in_total.inc(self.tokens_in)
+        if self.tokens_out:
+            tel.tokens_out_total.inc(self.tokens_out)
+        tel.request_seconds.observe(now - self.t_start)
+
+    # -- views --------------------------------------------------------------
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "t_start": self.t_start,
+            "t_end": self.t_end,
+            "phases": [
+                {"name": n, "t_begin": b, "t_end": e} for n, b, e in self.phases
+            ],
+            "tokens_in": self.tokens_in,
+            "tokens_out": self.tokens_out,
+            "ttft_s": self.ttft_s,
+        }
+
+
+class _NullSpan:
+    """No-op span handed out when telemetry is disabled — callers keep one
+    unconditional code path."""
+
+    __slots__ = ()
+
+    def phase(self, name):
+        return self
+
+    def first_token(self):
+        pass
+
+    def add_tokens_in(self, n):
+        pass
+
+    def tokens(self, n, elapsed_s=None):
+        pass
+
+    def finish(self):
+        pass
+
+
+NULL_SPAN = _NullSpan()
+
+
+class SpanTracker:
+    """Bounded ring of finished/active request spans."""
+
+    def __init__(self, tel, max_spans: int = 256):
+        self._tel = tel
+        self.max_spans = int(max_spans)
+        self.spans: Deque[RequestSpan] = deque(maxlen=self.max_spans)
+        self._next_id = 0
+
+    def start(self, tokens_in: int = 0) -> RequestSpan:
+        span = RequestSpan(self._tel, self._next_id, self._tel.clock())
+        self._next_id += 1
+        if tokens_in:
+            span.add_tokens_in(tokens_in)
+        self.spans.append(span)
+        return span
+
+    def reset(self) -> None:
+        self.spans.clear()
+
+    def to_list(self) -> List[dict]:
+        return [s.to_dict() for s in self.spans]

@@ -423,7 +423,10 @@ def generate_with_chunked_prefill(
             "silently clamp into the last KV slot"
         )
     chunk = tc.chunked_prefill_config.chunk_size
-    mgr = BlockSpaceManager(tc.pa_num_blocks, tc.pa_block_size)
+    mgr = BlockSpaceManager(
+        tc.pa_num_blocks, tc.pa_block_size,
+        telemetry=getattr(app, "telemetry", None),
+    )
     width = -(-tc.seq_len // tc.pa_block_size)
     for sid in range(B):
         mgr.ensure_capacity(sid, S0 + max_new_tokens)

@@ -2,40 +2,66 @@
 (utils/benchmark.py: ``benchmark_sampling`` :21, ``Benchmark`` :433,
 ``LatencyCollector`` :468, ``generate_report`` :480).
 
-Measures end-to-end generation latency plus per-submodel step latencies via
-ModelWrapper pre/post hooks, and writes ``benchmark_report.json`` with
-p50/p90/p95/p99/p100 and throughput = n_runs * max_length * batch / total_time.
+Measures end-to-end generation latency plus per-submodel step latencies and
+writes ``benchmark_report.json`` with p50/p90/p95/p99/p100 and throughput =
+n_runs * max_length * batch / total_time.
+
+The per-submodel numbers come from the serving-telemetry registry
+(``app.telemetry`` — the same ``nxdi_dispatch_seconds`` histograms the
+always-on metrics export), via :class:`~nxdi_tpu.utils.profiling.SubmodelProfiler`:
+one timing path for benchmarks, profiling, and dashboards.
+:class:`LatencyCollector` remains as a standalone hook-based collector for
+ad-hoc use; it is per-tag and nesting-safe.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
+
+logger = logging.getLogger("nxdi_tpu")
 
 BENCHMARK_REPORT_FILENAME = "benchmark_report.json"
 
 
 class LatencyCollector:
     """Collects per-dispatch wall-clock via wrapper pre/post hooks
-    (reference: benchmark.py:468)."""
+    (reference: benchmark.py:468).
+
+    Per-tag and nesting-safe: each tag keeps its own stack of start times, so
+    interleaved dispatches of different submodels (async pipelining: CTE of
+    request B between a TKG pre/post of request A) and re-entrant dispatches
+    of the SAME tag both time correctly. ``latency_list`` keeps every
+    completed latency in completion order (back-compat); ``by_tag`` splits
+    them per submodel."""
 
     def __init__(self):
         self.latency_list: List[float] = []
-        self._start = 0.0
+        self.by_tag: Dict[str, List[float]] = {}
+        self._starts: Dict[str, List[float]] = {}
 
     def pre_hook(self, tag):
-        self._start = time.perf_counter()
+        self._starts.setdefault(tag, []).append(time.perf_counter())
 
     def post_hook(self, tag):
-        self.latency_list.append(time.perf_counter() - self._start)
+        stack = self._starts.get(tag)
+        if not stack:
+            # unmatched post (hook attached mid-dispatch): drop rather than
+            # fabricate a latency from some other tag's start
+            return
+        dt = time.perf_counter() - stack.pop()
+        self.latency_list.append(dt)
+        self.by_tag.setdefault(tag, []).append(dt)
 
-    def percentile(self, p: float) -> float:
-        if not self.latency_list:
+    def percentile(self, p: float, tag: Optional[str] = None) -> float:
+        xs = self.latency_list if tag is None else self.by_tag.get(tag, [])
+        if not xs:
             return 0.0
-        return float(np.percentile(self.latency_list, p))
+        return float(np.percentile(xs, p))
 
 
 def generate_report(
@@ -53,6 +79,28 @@ def generate_report(
         "latency_ms_p100": float(np.percentile(latencies_s, 100)) * 1000,
         "latency_ms_avg": float(np.mean(latencies_s)) * 1000,
         "throughput": n_runs * max_length * max_batch_size / total,
+    }
+
+
+def _report_from_histogram(
+    bounds, counts, total_sum: float, total: int,
+    max_length: int, max_batch_size: int,
+) -> Dict[str, float]:
+    """The generate_report shape, estimated from a registry histogram's
+    fixed log-spaced buckets (percentiles interpolated within buckets)."""
+    from nxdi_tpu.telemetry import percentile_from_buckets
+
+    if total <= 0:
+        return {}
+    pct = lambda p: percentile_from_buckets(bounds, counts, total, p)  # noqa: E731
+    return {
+        "latency_ms_p50": pct(50) * 1000,
+        "latency_ms_p90": pct(90) * 1000,
+        "latency_ms_p95": pct(95) * 1000,
+        "latency_ms_p99": pct(99) * 1000,
+        "latency_ms_p100": pct(100) * 1000,
+        "latency_ms_avg": 1000.0 * total_sum / total,
+        "throughput": total * max_length * max_batch_size / total_sum,
     }
 
 
@@ -88,43 +136,42 @@ def benchmark_sampling(
 
     Returns {"e2e_model": {...}, "context_encoding_model": {...},
     "token_generation_model": {...}} and writes benchmark_report.json.
+    Per-submodel latencies are read from the telemetry registry (synced
+    dispatches while the profiler is attached) — the same timing path the
+    always-on metrics and ``SubmodelProfiler`` use.
     """
+    from nxdi_tpu.utils.profiling import SubmodelProfiler
+
     app = adapter.app
     input_ids = np.asarray(input_ids)
     max_batch = input_ids.shape[0]
     max_length = input_ids.shape[1] + max_new_tokens
 
-    collectors = {}
-    for tag, wrapper in app.models.items():
-        c = LatencyCollector()
-        wrapper.pre_hooks.append(c.pre_hook)
-        wrapper.post_hooks.append(c.post_hook)
-        collectors[tag] = c
-
+    prof = SubmodelProfiler(app)
     try:
         bench = Benchmark(
             lambda: adapter.generate(input_ids, max_new_tokens=max_new_tokens, **generate_kwargs),
             n_runs=n_runs,
         )
+        for _ in range(bench.warmup):
+            bench.benchmark_func()
+        prof.reset()  # warmup generations are excluded, like the e2e list
+        bench.warmup = 0
         e2e = bench.run()
-    finally:
-        # never leak hooks: an orphaned post_hook would force a
-        # block_until_ready on every future dispatch
-        for tag, wrapper in app.models.items():
-            c = collectors[tag]
-            if c.pre_hook in wrapper.pre_hooks:
-                wrapper.pre_hooks.remove(c.pre_hook)
-            if c.post_hook in wrapper.post_hooks:
-                wrapper.post_hooks.remove(c.post_hook)
 
-    report = {"e2e_model": generate_report(e2e, max_length, max_batch, n_runs)}
-    for tag, c in collectors.items():
-        if c.latency_list:
-            report[tag] = generate_report(c.latency_list, max_length, max_batch, len(c.latency_list))
+        report = {"e2e_model": generate_report(e2e, max_length, max_batch, n_runs)}
+        bounds = prof.telemetry.dispatch_seconds.bounds
+        for tag, (counts, total_sum, total) in prof.deltas().items():
+            report[tag] = _report_from_histogram(
+                bounds, counts, total_sum, total, max_length, max_batch
+            )
+    finally:
+        prof.detach()
 
     if report_path:
         with open(report_path, "w") as f:
             json.dump(report, f, indent=2)
-    print("Benchmark completed and its result is as following")
-    print(json.dumps(report, indent=2))
+    logger.debug(
+        "Benchmark completed:\n%s", json.dumps(report, indent=2)
+    )
     return report

@@ -3,9 +3,15 @@
 The analog of the reference's profiler wrapper (utils/profiling.py:33-63:
 wraps the neuron-profile binary, captures 2 executions and profiles the 2nd,
 emits a summary JSON). TPU-native: `jax.profiler` writes an xprof/perfetto
-trace viewable in TensorBoard or Perfetto; the per-submodel wall-clock
-summary comes from the same forward pre/post hooks the benchmark harness
-uses (runtime/model_wrapper.py hooks; reference: benchmark.py:468).
+trace viewable in TensorBoard or Perfetto.
+
+Since the telemetry subsystem (nxdi_tpu/telemetry) landed, the per-submodel
+wall-clock summary LAYERS ON THE REGISTRY instead of owning its own hook
+lists: :class:`SubmodelProfiler` reads ``app.telemetry``'s per-dispatch
+latency histograms (``nxdi_dispatch_seconds``) and, while attached, flips
+``sync_dispatch`` on so each host-path dispatch blocks until outputs are
+ready — exact step latency, one timing path shared with the always-on
+metrics and the benchmark harness.
 """
 
 from __future__ import annotations
@@ -16,6 +22,8 @@ from contextlib import contextmanager
 from typing import Any, Callable, Dict, Optional
 
 import jax
+
+from nxdi_tpu.telemetry import percentile_from_buckets
 
 
 @contextmanager
@@ -31,45 +39,68 @@ def trace(output_dir: str):
 
 
 class SubmodelProfiler:
-    """Per-submodel wall-clock stats via one LatencyCollector per tag
-    (utils/benchmark.py — the same hook machinery the benchmark harness uses;
-    reference: utils/profiling.py:87-121 summary JSON)."""
+    """Per-submodel wall-clock stats read from the app's telemetry registry.
+
+    Attaching forces ``telemetry.enabled`` and ``sync_dispatch`` on (restored
+    by :meth:`detach`), so every host-path dispatch records its TRUE step
+    latency; the summary aggregates the ``nxdi_dispatch_seconds`` histogram
+    per submodel, deltaed against the attach/:meth:`reset` baseline so
+    pre-existing traffic (e.g. warmup) is excluded. Percentiles are
+    interpolated from the fixed log-spaced buckets."""
 
     def __init__(self, app):
-        from nxdi_tpu.utils.benchmark import LatencyCollector
-
         self.app = app
-        self.collectors: Dict[str, Any] = {}
-        for tag, wrapper in app.models.items():
-            c = self.collectors[tag] = LatencyCollector()
-            wrapper.pre_hooks.append(c.pre_hook)
-            wrapper.post_hooks.append(c.post_hook)
+        self.telemetry = app.telemetry
+        self._was_enabled = self.telemetry.enabled
+        self._was_sync = self.telemetry.sync_dispatch
+        self.telemetry.enabled = True
+        self.telemetry.sync_dispatch = True
+        self._baseline: Dict[Any, Any] = {}
+        self.reset()
+
+    def _state(self) -> Dict[Any, Any]:
+        return self.telemetry.dispatch_seconds.series_snapshot()
 
     def reset(self):
-        """Drop everything recorded so far (call after warmup traffic)."""
-        for c in self.collectors.values():
-            c.latency_list.clear()
+        """Exclude everything recorded so far (call after warmup traffic)."""
+        self._baseline = self._state()
 
     def detach(self):
-        for tag, wrapper in self.app.models.items():
-            c = self.collectors[tag]
-            if c.pre_hook in wrapper.pre_hooks:
-                wrapper.pre_hooks.remove(c.pre_hook)
-            if c.post_hook in wrapper.post_hooks:
-                wrapper.post_hooks.remove(c.post_hook)
+        self.telemetry.sync_dispatch = self._was_sync
+        self.telemetry.enabled = self._was_enabled
+
+    def deltas(self) -> Dict[str, tuple]:
+        """Per-submodel (bucket counts, sum_s, count) since attach/reset,
+        merged over buckets and step rungs — the one histogram-delta path
+        shared by :meth:`summary` and ``benchmark_sampling``."""
+        hist = self.telemetry.dispatch_seconds
+        merged: Dict[str, list] = {}
+        for key, (counts, total_sum, total) in self._state().items():
+            base = self._baseline.get(key)
+            if base is not None:
+                counts = [c - b for c, b in zip(counts, base[0])]
+                total_sum -= base[1]
+                total -= base[2]
+            if total <= 0:
+                continue
+            tag = hist.labels_of(key)["submodel"]
+            acc = merged.setdefault(tag, [[0] * len(counts), 0.0, 0])
+            acc[0] = [a + c for a, c in zip(acc[0], counts)]
+            acc[1] += total_sum
+            acc[2] += total
+        return {tag: tuple(acc) for tag, acc in merged.items()}
 
     def summary(self) -> Dict[str, Any]:
+        bounds = self.telemetry.dispatch_seconds.bounds
         out: Dict[str, Any] = {}
-        for tag, c in self.collectors.items():
-            xs = c.latency_list
-            if not xs:
-                continue
+        for tag, (counts, total_sum, total) in self.deltas().items():
+            pct = lambda p: percentile_from_buckets(bounds, counts, total, p)  # noqa: E731
             out[tag] = {
-                "count": len(xs),
-                "mean_ms": 1000.0 * sum(xs) / len(xs),
-                "p50_ms": 1000.0 * c.percentile(50),
-                "p99_ms": 1000.0 * c.percentile(99),
-                "max_ms": 1000.0 * c.percentile(100),
+                "count": total,
+                "mean_ms": 1000.0 * total_sum / total,
+                "p50_ms": 1000.0 * pct(50),
+                "p99_ms": 1000.0 * pct(99),
+                "max_ms": 1000.0 * pct(100),
             }
         return out
 

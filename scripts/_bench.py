@@ -77,6 +77,35 @@ def build_random_app(
     return app, rng, prompt, pos
 
 
+def metrics_out_requested(argv=None) -> bool:
+    return "--metrics-out" in (argv if argv is not None else sys.argv)
+
+
+def maybe_dump_metrics(entries, argv=None):
+    """``--metrics-out FILE``: dump telemetry JSON snapshot(s) next to the
+    probe's latency lines. ``entries`` maps label -> a loaded app (whose
+    telemetry is snapshotted here) OR a pre-collected snapshot dict (for
+    apps already deleted to free HBM). Returns the path written, or None
+    when the flag is absent."""
+    import json
+
+    argv = argv if argv is not None else sys.argv
+    if "--metrics-out" not in argv:
+        return None
+    i = argv.index("--metrics-out")
+    if i + 1 >= len(argv):
+        raise SystemExit("--metrics-out needs a FILE argument")
+    path = argv[i + 1]
+    snaps = {
+        label: (v if isinstance(v, dict) else v.telemetry.snapshot())
+        for label, v in entries.items()
+    }
+    with open(path, "w") as f:
+        json.dump(snaps, f, indent=2)
+    print(f"[metrics] telemetry snapshot -> {path}", file=sys.stderr, flush=True)
+    return path
+
+
 def median_chain_ms(app, seq_len, warmup=20, steps=100, reps=3, label=None):
     """Decode p50 ms/step over device-resident chains (bench.py discipline)."""
     from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION

@@ -23,7 +23,10 @@ def main():
     from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
     from nxdi_tpu.models.llama import modeling_llama as ml
 
+    from _bench import maybe_dump_metrics, metrics_out_requested
+
     rng = np.random.default_rng(0)
+    metric_snaps = {}
 
     def run_cte(attn_kernel: bool, with_summary: bool = True):
         make = bench_mod.main.__wrapped__ if hasattr(bench_mod.main, "__wrapped__") else None
@@ -79,6 +82,8 @@ def main():
             from nxdi_tpu.analysis import collective_summary
 
             collectives = collective_summary(app)
+        if metrics_out_requested():
+            metric_snaps[f"cte_kernel_{attn_kernel}"] = app.telemetry.snapshot()
         del app
         return float(np.percentile(ms, 50)), collectives
 
@@ -101,6 +106,7 @@ def main():
             ),
             "collectives": collectives,
         }))
+        maybe_dump_metrics(metric_snaps)
         return
     cte_kernel, collectives = run_cte(True)
     print(f"[probe] cte kernel-on {cte_kernel:.1f} ms", file=sys.stderr, flush=True)
@@ -113,6 +119,7 @@ def main():
         # per-program collective counts for the kernel-on run
         "collectives": collectives,
     }))
+    maybe_dump_metrics(metric_snaps)
 
 
 if __name__ == "__main__":

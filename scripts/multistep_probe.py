@@ -63,6 +63,9 @@ def main():
 
     res["collectives"] = collective_summary(app)
     print(json.dumps(res))
+    from _bench import maybe_dump_metrics
+
+    maybe_dump_metrics({"multistep": app})
 
 
 if __name__ == "__main__":

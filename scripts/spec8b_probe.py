@@ -141,6 +141,11 @@ def main():
         per.append((time.perf_counter() - t0) * 1000.0 / 50)
     base_ms = float(np.percentile(per, 50))
     mark(f"8B non-spec {base_ms:.2f} ms/tok")
+    from _bench import maybe_dump_metrics, metrics_out_requested
+
+    metric_snaps = {}
+    if metrics_out_requested():
+        metric_snaps["target_8b_int8"] = app8.telemetry.snapshot()
     del app8, out, nxt
     gc.collect()
 
@@ -171,6 +176,8 @@ def main():
         per1.append((time.perf_counter() - t0) * 1000.0 / 50)
     draft_ms = float(np.percentile(per1, 50))
     mark(f"1B draft step {draft_ms:.2f} ms/tok")
+    if metrics_out_requested():
+        metric_snaps["draft_1b_int8"] = app1.telemetry.snapshot()
     del app1, out1, nxt1
     gc.collect()
 
@@ -245,6 +252,9 @@ def main():
     with open(side, "w") as f:
         json.dump(rec, f)
     print(json.dumps(rec))
+    if metrics_out_requested():
+        metric_snaps["fused_spec_8b"] = sp.telemetry.snapshot()
+        maybe_dump_metrics(metric_snaps)
 
 
 if __name__ == "__main__":

@@ -47,7 +47,7 @@ def make_app(**tpu_kwargs):
     return build_reference_app(defaults)
 
 
-def seeded_wrapper(app, forward_fn, tag="seeded_model"):
+def seeded_wrapper(app, forward_fn, tag="seeded_model", **wrapper_kwargs):
     """A decode-shaped wrapper running ``forward_fn`` under the app's mesh and
     shardings — the vehicle for injecting violations into a real program."""
     from nxdi_tpu.parallel.layers import sharding_tree
@@ -66,6 +66,7 @@ def seeded_wrapper(app, forward_fn, tag="seeded_model"):
         attend_to_cache=True,
         forward_fn=forward_fn,
         forward_kwargs=dict(app.models[TAG_TOKEN_GENERATION].forward_kwargs),
+        **wrapper_kwargs,
     )
     mesh = app.mesh or mesh_from_config(app.tpu_config)
     w.build(
@@ -243,6 +244,66 @@ def test_required_strategy_finding_via_auditor(monkeypatch):
     assert findings
     assert "fake_kernel_flag" in findings[0].message
     assert "token_generation_model[64]" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# KV-layout addressing (the ROADMAP unchecked-invariant, now checked)
+# ---------------------------------------------------------------------------
+
+def paged_app():
+    return make_app(is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=24)
+
+
+def test_kv_layout_clean_on_paged_and_contiguous_reference_apps():
+    """Shipped programs: paged apps keep their addressing inputs live,
+    contiguous apps carry none — both audit clean."""
+    assert errors_of(paged_app().audit(checkers=["kv_layout"]), "kv_layout") == []
+    assert errors_of(make_app().audit(checkers=["kv_layout"]), "kv_layout") == []
+
+
+def test_kv_layout_dead_paged_inputs_detected():
+    """A paged program whose forward ignores slot_mapping/block_table (the
+    addressing inputs are pruned by kept_var_idx) compiles fine but routes
+    every KV write nowhere — the checker must flag BOTH dead inputs."""
+
+    def dead_layout_forward(arch, inv_freq, params, cache, batch, **kw):
+        batch = dict(batch)
+        # constants of the right shape: the real inputs become provably dead
+        batch["slot_mapping"] = jnp.full(batch["slot_mapping"].shape, -1, jnp.int32)
+        batch["block_table"] = jnp.full(batch["block_table"].shape, -1, jnp.int32)
+        return causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+
+    app = paged_app()
+    w = seeded_wrapper(app, dead_layout_forward)
+    findings = errors_of(
+        audit_seeded(app, w), "kv_layout"
+    )
+    assert len(findings) == 2, findings
+    msg = " | ".join(f.message for f in findings)
+    assert "slot_mapping" in msg and "block_table" in msg
+    assert "DROPPED" in msg
+    assert all(f.program == "seeded_model[64]" for f in findings)
+
+
+def test_kv_layout_live_input_in_nonpaged_program_detected():
+    """The vice-versa mixup: a NON-paged program that consumes a live
+    block_table input is addressing a pool no host code maintains."""
+
+    def mixup_forward(arch, inv_freq, params, cache, batch, **kw):
+        out, cache = causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+        leak = batch["block_table"].sum()  # genuinely consumed -> stays live
+        out = dict(out)
+        out["tokens"] = out["tokens"] + (leak * 0).astype(out["tokens"].dtype)
+        return out, cache
+
+    app = make_app()  # contiguous layout
+    w = seeded_wrapper(
+        app, mixup_forward, extra_inputs={"block_table": ((8,), np.int32)}
+    )
+    findings = errors_of(audit_seeded(app, w), "kv_layout")
+    assert findings, "live paged input in a non-paged program not flagged"
+    assert "block_table" in findings[0].message
+    assert "mixup" in findings[0].message
 
 
 # ---------------------------------------------------------------------------

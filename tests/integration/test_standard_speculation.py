@@ -55,6 +55,18 @@ def test_standard_spec_matches_hf_greedy(spec_len):
     actual = adapter.generate(prompt, max_new_tokens=20)
     np.testing.assert_array_equal(actual, expected)
 
+    # acceptance telemetry: windows recorded ONCE, under path="standard"
+    # (not the fused label, and not double-counted by _spec_window)
+    hist = app.telemetry.spec_accepted
+    std = hist.snapshot_series(path="standard")
+    assert std is not None and std.count >= 1
+    assert hist.snapshot_series(path="fused") is None
+    windows = std.count
+    assert std.sum <= windows * (spec_len + 1)
+    # every decode token came from a recorded window: accepted sums (plus the
+    # CTE token) cover the generated span exactly once
+    assert std.sum >= actual.shape[1] - prompt.shape[1] - 1
+
 
 def test_standard_spec_draft_at_different_tp():
     target, target_cfg = _tiny_hf_llama(seed=0, layers=4)

@@ -64,6 +64,20 @@ def test_string_annotation_usage_not_flagged():
     assert lint_source("x.py", src) == []
 
 
+def test_detects_bare_print_in_core():
+    src = "def f():\n    print('hi')\n"
+    errs = lint_source("nxdi_tpu/utils/foo.py", src)
+    assert [e.code for e in errs] == ["T201"] and errs[0].line == 2
+    # cli/, scripts/, tests/ are exempt — stdout is their interface
+    assert lint_source("nxdi_tpu/cli/foo.py", src) == []
+    assert lint_source("scripts/foo.py", src) == []
+    assert lint_source("tests/unit/foo.py", src) == []
+    # noqa silences an intentional print, matching ruff's flake8-print id
+    assert lint_source(
+        "nxdi_tpu/utils/foo.py", "def f():\n    print('hi')  # noqa: T201\n"
+    ) == []
+
+
 def test_closures_globals_and_builtins_not_flagged():
     src = (
         "import os\n"

@@ -1,0 +1,338 @@
+"""Telemetry unit suite: registry semantics (bucketing, snapshot/reset,
+thread safety), deterministic request spans via an injected clock, the
+Prometheus exposition golden, Perfetto trace structure, and the
+nesting-safe LatencyCollector."""
+
+import json
+import threading
+
+import numpy as np
+
+from nxdi_tpu.telemetry import (
+    LENGTH_BOUNDS,
+    MetricsRegistry,
+    Telemetry,
+    log_spaced_bounds,
+    percentile_from_buckets,
+    prometheus_text,
+)
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_and_gauge_basic():
+    r = MetricsRegistry()
+    c = r.counter("c_total", "help", ("k",))
+    c.inc(k="a")
+    c.inc(2, k="a")
+    c.inc(k="b")
+    assert c.value(k="a") == 3 and c.value(k="b") == 1
+    assert c.total() == 4
+    g = r.gauge("g", "help")
+    g.set(5)
+    g.inc(2)
+    g.dec()
+    assert g.value() == 6
+
+
+def test_counter_rejects_decrease_and_wrong_labels():
+    import pytest
+
+    r = MetricsRegistry()
+    c = r.counter("c_total", "", ("k",))
+    with pytest.raises(ValueError):
+        c.inc(-1, k="a")
+    with pytest.raises(ValueError):
+        c.inc(wrong="a")
+
+
+def test_registration_idempotent_and_type_checked():
+    import pytest
+
+    r = MetricsRegistry()
+    c1 = r.counter("x_total", "", ("k",))
+    assert r.counter("x_total", "", ("k",)) is c1
+    with pytest.raises(ValueError):
+        r.gauge("x_total")
+    with pytest.raises(ValueError):
+        r.counter("x_total", "", ("other",))
+
+
+def test_histogram_bucketing_fixed_log_spaced():
+    r = MetricsRegistry()
+    h = r.histogram("h_seconds", "", bounds=(0.001, 0.01, 0.1))
+    # bucket i covers (bounds[i-1], bounds[i]]; above the top -> +Inf bucket
+    h.observe(0.0005)   # <= 0.001
+    h.observe(0.001)    # <= 0.001 (boundary inclusive)
+    h.observe(0.005)    # <= 0.01
+    h.observe(0.5)      # +Inf
+    s = h.snapshot_series()
+    assert s.counts == [2, 1, 0, 1]
+    assert s.count == 4
+    np.testing.assert_allclose(s.sum, 0.5065)
+    # observe(n=...) attributes a window's per-token mean to each token
+    h.observe(0.02, n=3)
+    assert h.snapshot_series().counts == [2, 1, 3, 1]
+
+
+def test_percentile_interpolation_and_empty():
+    bounds = (1.0, 2.0, 4.0)
+    # 4 observations in (1, 2]: p50 interpolates inside that bucket
+    assert percentile_from_buckets(bounds, [0, 4, 0, 0], 4, 50) == 1.5
+    assert percentile_from_buckets(bounds, [0, 4, 0, 0], 4, 100) == 2.0
+    # +Inf bucket clamps to the largest finite bound
+    assert percentile_from_buckets(bounds, [0, 0, 0, 2], 2, 99) == 4.0
+    assert percentile_from_buckets(bounds, [0, 0, 0, 0], 0, 50) == 0.0
+    r = MetricsRegistry()
+    h = r.histogram("h", "", bounds=bounds)
+    assert h.percentile(50) == 0.0  # no series yet
+
+
+def test_log_spaced_bounds_and_default_length_bounds():
+    b = log_spaced_bounds(1e-4, 1.0, per_decade=2)
+    assert b[0] == 1e-4 and b[-1] >= 1.0
+    assert all(x < y for x, y in zip(b, b[1:]))
+    assert list(LENGTH_BOUNDS) == sorted(LENGTH_BOUNDS)
+
+
+def test_snapshot_and_reset_keep_catalog():
+    r = MetricsRegistry()
+    c = r.counter("c_total", "helptext", ("k",))
+    h = r.histogram("h_seconds", "", bounds=(0.1, 1.0))
+    c.inc(k="a")
+    h.observe(0.05)
+    snap = r.snapshot()
+    assert snap["c_total"]["series"] == [{"labels": {"k": "a"}, "value": 1.0}]
+    row = snap["h_seconds"]["series"][0]
+    assert row["count"] == 1 and row["buckets"] == {"0.1": 1}
+    assert "p50" in row and "p99" in row
+    json.dumps(snap)  # JSON-able end to end
+    r.reset()
+    assert r.snapshot() == {}  # series gone...
+    assert r.get("c_total") is c  # ...registrations (the catalog) survive
+
+
+def test_thread_safety_exact_totals():
+    r = MetricsRegistry()
+    c = r.counter("c_total", "", ("k",))
+    h = r.histogram("h", "", bounds=(0.5,))
+    N, T = 2000, 8
+
+    def work(i):
+        for _ in range(N):
+            c.inc(k=str(i % 2))
+            h.observe(0.1)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(T)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.total() == N * T
+    s = h.snapshot_series()
+    assert s.count == N * T and s.counts[0] == N * T
+
+
+# ---------------------------------------------------------------------------
+# request spans with an injected clock
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_tel(**kw):
+    clock = FakeClock()
+    return Telemetry(clock=clock, **kw), clock
+
+
+def test_span_lifecycle_deterministic():
+    tel, clock = make_tel()
+    span = tel.start_request(tokens_in=7)
+    span.phase("pad")
+    clock.advance(0.5)
+    span.phase("prefill")
+    clock.advance(1.0)
+    span.first_token()
+    span.first_token()  # idempotent: first call wins
+    span.tokens(1)
+    span.phase("decode")
+    clock.advance(3.0)
+    span.tokens(3, elapsed_s=3.0)
+    span.finish()
+    span.finish()  # idempotent
+
+    assert span.ttft_s == 1.5
+    assert span.tokens_in == 7 and span.tokens_out == 4
+    assert span.phases == [
+        ("pad", 100.0, 100.5), ("prefill", 100.5, 101.5), ("decode", 101.5, 104.5),
+    ]
+    assert tel.requests_total.value() == 1
+    assert tel.tokens_in_total.value() == 7
+    assert tel.tokens_out_total.value() == 4
+    assert tel.ttft_seconds.snapshot_series().count == 1
+    np.testing.assert_allclose(tel.ttft_seconds.snapshot_series().sum, 1.5)
+    # TPOT: 3 tokens at 1.0 s/token mean + none for the elapsed-less call
+    tpot = tel.tpot_seconds.snapshot_series()
+    assert tpot.count == 3
+    np.testing.assert_allclose(tpot.sum, 3.0)
+    np.testing.assert_allclose(
+        tel.request_seconds.snapshot_series().sum, 4.5
+    )
+
+
+def test_span_ring_buffer_bounded():
+    tel, _ = make_tel(max_spans=4)
+    for _ in range(10):
+        tel.start_request().finish()
+    assert len(tel.spans.spans) == 4
+    assert [s.request_id for s in tel.spans.spans] == [6, 7, 8, 9]
+
+
+def test_disabled_telemetry_returns_null_span_and_records_nothing():
+    tel, _ = make_tel(detail="off")
+    assert not tel.enabled
+    span = tel.start_request(tokens_in=5)
+    span.phase("pad").first_token()
+    span.tokens(3, 1.0)
+    span.finish()
+    assert tel.requests_total.total() == 0
+    assert tel.snapshot()["_spans"] == []
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition golden
+# ---------------------------------------------------------------------------
+
+def test_prometheus_exposition_golden():
+    r = MetricsRegistry()
+    c = r.counter("nxdi_test_total", "a counter", ("submodel",))
+    g = r.gauge("nxdi_test_free")
+    h = r.histogram("nxdi_test_seconds", "a histogram", ("tag",),
+                    bounds=(0.001, 0.01))
+    c.inc(3, submodel="cte")
+    g.set(17)
+    h.observe(0.0005, tag="x")
+    h.observe(0.5, tag="x")
+    expected = "\n".join([
+        '# HELP nxdi_test_total a counter',
+        '# TYPE nxdi_test_total counter',
+        'nxdi_test_total{submodel="cte"} 3',
+        '# TYPE nxdi_test_free gauge',
+        'nxdi_test_free 17',
+        '# HELP nxdi_test_seconds a histogram',
+        '# TYPE nxdi_test_seconds histogram',
+        'nxdi_test_seconds_bucket{tag="x",le="0.001"} 1',
+        'nxdi_test_seconds_bucket{tag="x",le="0.01"} 1',
+        'nxdi_test_seconds_bucket{tag="x",le="+Inf"} 2',
+        'nxdi_test_seconds_sum{tag="x"} 0.5005',
+        'nxdi_test_seconds_count{tag="x"} 2',
+    ]) + "\n"
+    assert prometheus_text(r) == expected
+
+
+def test_prometheus_label_escaping():
+    r = MetricsRegistry()
+    c = r.counter("c_total", "", ("k",))
+    c.inc(k='we"ird\\lab\nel')
+    line = prometheus_text(r).splitlines()[-1]
+    assert line == 'c_total{k="we\\"ird\\\\lab\\nel"} 1'
+
+
+# ---------------------------------------------------------------------------
+# Perfetto trace structure
+# ---------------------------------------------------------------------------
+
+def test_perfetto_trace_structure():
+    tel, clock = make_tel()
+    for rid in range(2):
+        span = tel.start_request(tokens_in=3)
+        span.phase("prefill")
+        clock.advance(1.0)
+        span.phase("decode")
+        clock.advance(2.0)
+        span.tokens(4, 2.0)
+        span.finish()
+        clock.advance(0.5)
+
+    trace = tel.perfetto_trace()
+    json.dumps(trace)  # serializable
+    assert trace["displayTimeUnit"] == "ms"
+    events = trace["traceEvents"]
+    meta = [e for e in events if e["ph"] == "M"]
+    slices = [e for e in events if e["ph"] == "X"]
+    assert any(e["name"] == "process_name" for e in meta)
+    # every slice event is structurally complete and non-negative
+    for e in slices:
+        assert set(e) >= {"name", "ph", "pid", "tid", "ts", "dur"}
+        assert e["ts"] >= 0 and e["dur"] >= 0
+    # one request slice + phase slices per request, on distinct tracks
+    reqs = [e for e in slices if e["name"] == "request"]
+    assert len(reqs) == 2 and {e["tid"] for e in reqs} == {0, 1}
+    assert reqs[0]["args"]["tokens_out"] == 4
+    # timestamps are relative: the earliest span opens at ts=0
+    assert min(e["ts"] for e in slices) == 0
+    phases = sorted(
+        (e["name"], e["ts"], e["dur"]) for e in slices
+        if e["tid"] == 0 and e["name"] != "request"
+    )
+    assert phases == [("decode", 1e6, 2e6), ("prefill", 0.0, 1e6)]
+
+
+# ---------------------------------------------------------------------------
+# LatencyCollector: per-tag and nesting-safe
+# ---------------------------------------------------------------------------
+
+def test_latency_collector_interleaved_tags():
+    """Two tagged dispatches interleaved (async pipelining: cte pre, tkg
+    pre/post inside, cte post) must each time THEIR OWN window — the old
+    single shared `_start` attributed cte's full window to tkg's start."""
+    import time
+
+    from nxdi_tpu.utils.benchmark import LatencyCollector
+
+    c = LatencyCollector()
+    c.pre_hook("cte")
+    time.sleep(0.02)
+    c.pre_hook("tkg")
+    time.sleep(0.01)
+    c.post_hook("tkg")
+    time.sleep(0.005)
+    c.post_hook("cte")
+    assert set(c.by_tag) == {"cte", "tkg"}
+    tkg, cte = c.by_tag["tkg"][0], c.by_tag["cte"][0]
+    assert 0.01 <= tkg < 0.03
+    assert cte >= 0.035  # the full outer window, NOT since tkg's pre_hook
+    assert len(c.latency_list) == 2
+    assert c.percentile(100, tag="cte") == cte
+
+
+def test_latency_collector_nested_same_tag_and_unmatched_post():
+    import time
+
+    from nxdi_tpu.utils.benchmark import LatencyCollector
+
+    c = LatencyCollector()
+    c.pre_hook("tkg")
+    time.sleep(0.01)
+    c.pre_hook("tkg")          # re-entrant same tag
+    time.sleep(0.01)
+    c.post_hook("tkg")         # closes the INNER start
+    time.sleep(0.01)
+    c.post_hook("tkg")         # closes the outer start
+    inner, outer = c.by_tag["tkg"]
+    assert inner < outer
+    assert outer >= 0.025
+    # unmatched post (hook attached mid-dispatch) must not fabricate data
+    c2 = LatencyCollector()
+    c2.post_hook("tkg")
+    assert c2.latency_list == []
