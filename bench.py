@@ -11,8 +11,12 @@ loop (reference analog: async_execution.py:190).
 Headline metric: decode throughput in tok/s/chip, judged against the
 BASELINE.json north star "Llama-3.1-8B tp=8 on v5e-8 with on-device
 sampling: >= 2000 tok/s/chip" (vs_baseline = value / 2000). Aux fields
-report TKG/CTE step p50 and roofline utilization (HBM bytes/step at
-819 GB/s; MFU at 197 bf16 TFLOP/s — v5e datasheet numbers).
+report TKG/CTE step p50 and roofline utilization sourced from the cost
+observatory's per-program CostSheets (nxdi_tpu/analysis/costs.py — the
+same FLOP/HBM model and v5e datasheet peaks the serving gauges divide
+through, so this trajectory and the Prometheus export can never disagree;
+gate a fresh run against the BENCH_r*.json history with
+scripts/bench_gate.py).
 
 Prints exactly one JSON line:
   {"metric": ..., "value": N, "unit": "tok/s/chip", "vs_baseline": N, ...}
@@ -47,13 +51,12 @@ def write_metrics_snapshots(snaps, path):
     with open(path, "w") as f:
         json.dump(snaps, f, indent=2)
     print(f"[bench] telemetry snapshot -> {path}", file=sys.stderr, flush=True)
-V5E_HBM_GBS = 819.0
-V5E_BF16_TFLOPS = 197.0
+
 
 BATCH = 32
 SEQ_LEN = 2048
 PROMPT_LEN = 1024
-# full Llama-3.2-1B shape (the roofline math below reads these too)
+# full Llama-3.2-1B shape
 N_LAYERS = 16
 HIDDEN = 2048
 INTERMEDIATE = 8192
@@ -131,7 +134,6 @@ def main():
         )
 
     state = jtu.tree_map(rand, struct)
-    param_count = sum(int(np.prod(s.shape)) for s in jtu.tree_leaves(struct))
 
     class App(TpuModelForCausalLM):
         def build_params(self):
@@ -189,6 +191,16 @@ def main():
     tkg_p50 = bench_decode(app, out)
     tok_s = BATCH / (tkg_p50 / 1000.0)
     print(f"[bench] bf16 done tkg={tkg_p50:.3f}ms cte={cte_p50:.1f}ms", file=sys.stderr, flush=True)
+
+    # ONE cost path: the MFU/roofline fields below divide the measured p50s
+    # through the cost observatory's per-program CostSheets (the same sheets
+    # the serving gauges read), instead of re-deriving FLOP/byte math here
+    from nxdi_tpu.analysis.costs import cost_sheets
+    from nxdi_tpu.runtime.model_wrapper import TAG_CONTEXT_ENCODING
+
+    sheets = {(s.tag, s.bucket): s for s in cost_sheets(app)}
+    cte_sheet = sheets[(TAG_CONTEXT_ENCODING, PROMPT_LEN)]
+    tkg_sheet = sheets[(TAG_TOKEN_GENERATION, SEQ_LEN)]
 
     metrics_path = metrics_out_path()
     metric_snaps = {}
@@ -373,24 +385,10 @@ def main():
         cfg_8b_label = eight["config_8b"]
         params_8b_count = eight["params_8b"]
 
-    # prefill MFU: matmul FLOPs (2*params*tokens, minus the last-token-only
-    # lm_head) + causal attention FLOPs, against the v5e bf16 peak
-    tokens = BATCH * PROMPT_LEN
-    lm_head_params = VOCAB * HIDDEN
-    cte_flops = (
-        2.0 * (param_count - lm_head_params) * tokens
-        + 2.0 * lm_head_params * BATCH
-        + 2.0 * N_LAYERS * N_HEADS * HEAD_DIM * PROMPT_LEN * PROMPT_LEN * BATCH
-    )
-    cte_mfu_pct = cte_flops / 1e12 / V5E_BF16_TFLOPS / (cte_p50 / 1000.0) * 100
-
-    # --- roofline accounting (decode step) ---
-    param_bytes = 2.0 * param_count
-    kv_bytes = 2.0 * N_LAYERS * N_KV_HEADS * HEAD_DIM * SEQ_LEN * 2 * BATCH  # K+V read
-    hbm_pct = ((param_bytes + kv_bytes) / 1e9) / V5E_HBM_GBS / (tkg_p50 / 1000.0) * 100
-    attn_flops = 4.0 * N_LAYERS * N_HEADS * HEAD_DIM * SEQ_LEN * BATCH
-    step_flops = 2.0 * param_count * BATCH + attn_flops
-    mfu_pct = step_flops / 1e12 / V5E_BF16_TFLOPS / (tkg_p50 / 1000.0) * 100
+    # --- roofline fields from the CostSheets (measured / declared-peak) ---
+    cte_mfu_pct = cte_sheet.mfu_pct(cte_p50 / 1000.0)
+    hbm_pct = tkg_sheet.hbm_bw_pct(tkg_p50 / 1000.0)
+    mfu_pct = tkg_sheet.mfu_pct(tkg_p50 / 1000.0)
 
     print(
         json.dumps(
@@ -442,6 +440,11 @@ def main():
                 "cte_mfu_pct": round(cte_mfu_pct, 1),
                 "hbm_roofline_pct": round(hbm_pct, 1),
                 "mfu_pct": round(mfu_pct, 1),
+                # provenance of the three fields above (analysis/costs.py)
+                "cost_source": tkg_sheet.source,
+                "cost_chip": tkg_sheet.chip.name,
+                "tkg_roofline_floor_ms": round(tkg_sheet.floor_s * 1e3, 3),
+                "tkg_roofline_bound": tkg_sheet.bound,
                 "config": f"llama3.2-1b full {N_LAYERS}L bf16 bs{BATCH} kv{SEQ_LEN} prompt{PROMPT_LEN} tp1",
                 "mode": "device_resident_async",
             }

@@ -16,6 +16,11 @@ This package is the audit layer over that program set:
   :func:`audit_wrapper` orchestration + JSON reports.
 - :mod:`~nxdi_tpu.analysis.budget` — expected collective counts derived from
   the config's ShardingPolicy.
+- :mod:`~nxdi_tpu.analysis.costs` — the cost observatory: per-program
+  FLOP/HBM CostSheets (XLA ``cost_analysis``/``memory_analysis``
+  cross-checked against an analytic model), roofline classification on
+  declared chip specs, the ``hbm_fit`` budget, and the registry attachment
+  publishing the ``nxdi_program_mfu_pct`` family of gauges.
 - :mod:`~nxdi_tpu.analysis.retrace` — the serve-time retrace guard
   (``TpuConfig.retrace_guard``).
 - :mod:`~nxdi_tpu.analysis.source_lint` — stdlib pyflakes-lite (unused
@@ -30,9 +35,19 @@ from nxdi_tpu.analysis.auditor import (
     ProgramReport,
     audit_application,
     audit_wrapper,
+    check_cache_format_agreement,
     collective_summary,
 )
 from nxdi_tpu.analysis.budget import expected_collective_budget
+from nxdi_tpu.analysis.costs import (
+    CHIP_SPECS,
+    ChipSpec,
+    CostSheet,
+    attach_cost_gauges,
+    cost_sheets,
+    cost_summary,
+    resolve_chip,
+)
 from nxdi_tpu.analysis.checkers import (
     CHECKERS,
     DEFAULT_CONST_THRESHOLD_BYTES,
@@ -48,7 +63,15 @@ __all__ = [
     "ProgramReport",
     "audit_application",
     "audit_wrapper",
+    "check_cache_format_agreement",
     "collective_summary",
+    "CHIP_SPECS",
+    "ChipSpec",
+    "CostSheet",
+    "attach_cost_gauges",
+    "cost_sheets",
+    "cost_summary",
+    "resolve_chip",
     "expected_collective_budget",
     "CHECKERS",
     "DEFAULT_CONST_THRESHOLD_BYTES",

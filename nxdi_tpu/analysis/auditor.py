@@ -33,6 +33,7 @@ from nxdi_tpu.analysis.checkers import (
     ProgramArtifacts,
 )
 from nxdi_tpu.jax_compat import (
+    compiled_input_formats,
     lowered_donated_flags,
     lowered_kept_args,
     optimized_hlo_text,
@@ -65,6 +66,9 @@ class ProgramReport:
     strategies: List[str] = field(default_factory=list)
     largest_const_bytes: int = 0
     findings: List[Finding] = field(default_factory=list)
+    # stringified per-leaf cache input formats (AUTO layout resolution) for
+    # the cross-program agreement check; None when the backend has no view
+    cache_formats: Optional[tuple] = None
 
     def to_dict(self) -> dict:
         return {
@@ -77,6 +81,9 @@ class ProgramReport:
             "donated_cache_inputs": self.donated_cache_inputs,
             "attention_strategies": self.strategies,
             "largest_const_bytes": self.largest_const_bytes,
+            "cache_formats": (
+                list(self.cache_formats) if self.cache_formats else None
+            ),
             "findings": [f.to_dict() for f in self.findings],
         }
 
@@ -148,7 +155,13 @@ def audit_wrapper(
     from nxdi_tpu.models import base as base_mod
 
     config = config or wrapper.config
-    names = list(checkers) if checkers is not None else list(CHECKERS)
+    # "cache_format" is the cross-program pass audit_application runs — a
+    # valid selection here, just not a per-program checker. Anything else
+    # unknown still surfaces as a finding (a typo'd name must not read as
+    # "checker ran clean").
+    requested = list(checkers) if checkers is not None else list(CHECKERS)
+    names = [n for n in requested if n in CHECKERS]
+    unknown = [n for n in requested if n not in CHECKERS and n != "cache_format"]
 
     def attach(struct, shardings):
         return jtu.tree_map(
@@ -160,12 +173,22 @@ def audit_wrapper(
     cs = attach(cache_struct, wrapper._cache_shardings)
     n_param_leaves = len(jtu.tree_leaves(ps))
     cache_paths = tuple(_leaf_paths(cs))
+    from nxdi_tpu.analysis.costs import tree_bytes
+
+    param_bytes = tree_bytes(ps)
+    cache_bytes = tree_bytes(cs)
 
     reports = []
     for key, prog in wrapper._programs.items():
         label = getattr(prog, "label", f"{wrapper.tag}[{_key_str(key)}]")
         report = ProgramReport(tag=wrapper.tag, key=key, label=label)
         reports.append(report)
+        for n in unknown:
+            report.findings.append(Finding(
+                "auditor", "warning", wrapper.tag, label,
+                f"unknown checker {n!r} requested; known: "
+                f"{sorted(CHECKERS) + ['cache_format']}",
+            ))
         try:
             example = wrapper._example_for_key(key)
             with jax.set_mesh(wrapper._mesh):
@@ -206,6 +229,9 @@ def audit_wrapper(
             kept_args=lowered_kept_args(lowered),
             donated_flags=lowered_donated_flags(lowered),
             const_threshold=const_threshold,
+            compiled=compiled,
+            param_bytes=param_bytes,
+            cache_bytes=cache_bytes,
         )
         for name in names:
             try:
@@ -233,7 +259,54 @@ def audit_wrapper(
             )
         if traced is not None:
             report.largest_const_bytes = _max_const_bytes(traced.jaxpr)
+        try:
+            # the resolved AUTO cache layout of this executable's cache
+            # input subtree (arg 1 of (params, cache, batch)) — compared
+            # across programs by check_cache_format_agreement
+            fmt_tree = compiled_input_formats(compiled)[0][1]
+            report.cache_formats = tuple(
+                str(f) for f in jtu.tree_leaves(fmt_tree)
+            )
+        except Exception:
+            report.cache_formats = None
     return reports
+
+
+def check_cache_format_agreement(
+    reports: Sequence[ProgramReport],
+) -> List[Finding]:
+    """Every program of one app donates and returns THE SAME cache pytree,
+    so they must all resolve their AUTO memory layouts to the same per-leaf
+    formats — a prefill/decode pair that disagrees pays a full-cache
+    relayout (``device_put`` per leaf, ~GBs) at EVERY phase transition
+    (`_AutoLayoutProgram.__call__` moves the cache whenever the incoming
+    format differs from the program's preference). Findings are attached to
+    the later program, naming the agreeing reference."""
+    ref = None
+    findings: List[Finding] = []
+    for report in reports:
+        if report.cache_formats is None:
+            continue
+        if ref is None:
+            ref = report
+            continue
+        if report.cache_formats != ref.cache_formats:
+            diff = [
+                i for i, (a, b) in enumerate(
+                    zip(report.cache_formats, ref.cache_formats)
+                ) if a != b
+            ] or ["count"]
+            f = Finding(
+                "cache_format", "error", report.tag, report.label,
+                f"AUTO cache layouts disagree across the program set: "
+                f"{report.label} resolved {list(report.cache_formats)} but "
+                f"{ref.label} resolved {list(ref.cache_formats)} (differing "
+                f"leaves: {diff}) — every {ref.tag} -> {report.tag} phase "
+                "transition pays a full-cache relayout at dispatch time",
+            )
+            report.findings.append(f)
+            findings.append(f)
+    return findings
 
 
 def audit_application(
@@ -265,6 +338,11 @@ def audit_application(
                     f"wrapper could not be audited: {type(e).__name__}: {e}",
                 )],
             ))
+    # cross-program invariant: every program must resolve the shared cache
+    # pytree to the SAME AUTO layout, or phase transitions pay a relayout
+    # (not a per-program checker — it needs the whole program set)
+    if checkers is None or "cache_format" in checkers:
+        check_cache_format_agreement(report.programs)
     guard = getattr(app, "retrace_guard", None)
     if guard is not None:
         report.retrace = guard.to_dict()

@@ -84,6 +84,9 @@ class ProgramArtifacts:
     donated_flags: Optional[Tuple[bool, ...]] = None  # per flat arg
     const_threshold: int = DEFAULT_CONST_THRESHOLD_BYTES
     collectives: Dict[str, int] = field(default_factory=dict)
+    compiled: Any = None  # the compiled executable (memory/cost analyses)
+    param_bytes: int = 0  # GLOBAL weight bytes (abstract params struct)
+    cache_bytes: int = 0  # GLOBAL allocated KV bytes (= max-live KV)
 
     @property
     def tc(self):
@@ -399,6 +402,45 @@ def check_kv_layout(art: ProgramArtifacts) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# 7. HBM fit
+# ---------------------------------------------------------------------------
+
+def check_hbm_fit(art: ProgramArtifacts) -> List[Finding]:
+    """Weights + the full allocated KV cache (max-live across every bucket)
+    + XLA's temp/scratch must fit the declared chip's per-chip HBM. The
+    budget derives from the sharding world like analysis/budget.py derives
+    collective budgets — an over-provisioned ``seq_len * kv_cache_batch``
+    product fails here at audit time instead of OOMing at load."""
+    from nxdi_tpu.analysis.costs import (
+        hbm_residency,
+        resolve_chip,
+        xla_memory_analysis,
+    )
+
+    tc = art.tc
+    chip = resolve_chip(tc)
+    world = max(1, tc.tp_degree * getattr(tc, "pp_degree", 1))
+    memory = xla_memory_analysis(art.compiled) if art.compiled is not None else None
+    fit = hbm_residency(art.param_bytes, art.cache_bytes, world, chip, memory)
+    if fit["fits"]:
+        return []
+
+    def gib(x: float) -> str:
+        return f"{x / 2.0 ** 30:.3f} GiB"
+
+    return [art.finding(
+        "hbm_fit",
+        f"per-chip HBM residency {gib(fit['resident_bytes'])} exceeds the "
+        f"{chip.name} capacity {gib(fit['hbm_capacity_bytes'])}: weights "
+        f"{gib(fit['weight_bytes_per_chip'])} + max-live KV "
+        f"{gib(fit['kv_bytes_per_chip'])} + temp {gib(fit['temp_bytes'])} "
+        f"+ non-aliased outputs {gib(fit['output_extra_bytes'])} over a "
+        f"{world}-chip world — shrink seq_len/kv_cache_batch_size, quantize "
+        "weights or KV, or raise the parallel degrees",
+    )]
+
+
 #: name -> checker; the auditor runs these in order
 CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "donation": check_donation,
@@ -407,4 +449,5 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "baked_constants": check_baked_constants,
     "required_strategies": check_required_strategies,
     "kv_layout": check_kv_layout,
+    "hbm_fit": check_hbm_fit,
 }

@@ -11,6 +11,14 @@ telemetry three ways:
 - Chrome/Perfetto ``trace_events`` JSON of the request spans
   (``--perfetto FILE`` — load in ui.perfetto.dev or chrome://tracing).
 
+Every snapshot (including ``--serve``'s ``/metrics.json`` and the probes'
+``--metrics-out`` dumps) embeds the cost observatory's per-program
+CostSheet table as ``_cost_sheets``, and the Prometheus text carries the
+CostSheet-joined ``nxdi_program_mfu_pct`` / ``nxdi_program_hbm_bw_pct`` /
+``nxdi_roofline_gap_ratio`` gauges — one file captures measured AND
+theoretical (see ``python -m nxdi_tpu.cli.costs`` for the standalone
+table).
+
 Usage:
 
   # one-shot: demo traffic, Prometheus text + JSON snapshot to stdout

@@ -565,6 +565,11 @@ class TpuConfig:
         elif tel is None:
             tel = TelemetryConfig()
         self.telemetry = tel
+        # declared chip generation for the cost observatory's roofline math
+        # and the hbm_fit auditor checker (analysis/costs.py): a name from
+        # CHIP_SPECS ("v4"|"v5e"|"v5p"|"v6e"), or a dict of ChipSpec field
+        # overrides (optionally with "base": name). None = v5e.
+        self.chip = kwargs.pop("chip", None)
         # serve-time retrace guard (analysis/retrace.py): "warn" logs and
         # "error" raises when any submodel program lowers AFTER warmup sealed
         # the program set (a mid-serving retrace blocks requests on multi-
@@ -586,6 +591,20 @@ class TpuConfig:
             raise ValueError(
                 f"retrace_guard must be 'off'|'warn'|'error', got {self.retrace_guard!r}"
             )
+        if self.chip is not None:
+            if not isinstance(self.chip, (str, dict)):
+                raise ValueError(
+                    "chip must be a chip name or a dict of ChipSpec overrides "
+                    f"(analysis/costs.py CHIP_SPECS), got {type(self.chip)}"
+                )
+            # resolve eagerly so a typo'd name/field fails HERE, not inside a
+            # swallowed export attachment or an auditor checker at serve time
+            from nxdi_tpu.analysis.costs import resolve_chip
+
+            try:
+                resolve_chip(override=self.chip)
+            except (TypeError, ValueError) as e:
+                raise ValueError(f"invalid TpuConfig chip={self.chip!r}: {e}")
         if self.max_context_length > self.seq_len:
             raise ValueError(
                 f"max_context_length ({self.max_context_length}) cannot exceed seq_len ({self.seq_len})"

@@ -420,6 +420,14 @@ class ApplicationBase:
         from nxdi_tpu.utils.snapshot import maybe_attach_from_env
 
         maybe_attach_from_env(self)  # reference-style env-driven snapshotting
+        # cost observatory (analysis/costs.py): every export divides the
+        # measured dispatch latencies through this app's per-program
+        # CostSheets into the nxdi_program_mfu_pct / nxdi_program_hbm_bw_pct
+        # / nxdi_roofline_gap_ratio gauges, and the sheet table rides the
+        # JSON snapshot as _cost_sheets
+        from nxdi_tpu.analysis.costs import attach_cost_gauges
+
+        attach_cost_gauges(self)
         self.is_loaded = True
 
     def _build_wrappers(self) -> None:

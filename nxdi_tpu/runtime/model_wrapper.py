@@ -194,6 +194,16 @@ TAG_MEDUSA_SPECULATION = "medusa_speculation_model"
 MULTISTEP_EOS_SLOTS = 8
 
 
+def normalize_program_key(key):
+    """``(bucket, steps)`` from a program key — THE one place that knows
+    plain wrappers key on the bucket int and the multi-step wrapper on
+    ``(steps, bucket)`` (shared by ``iter_programs`` and the cost
+    observatory's sheet labeling)."""
+    if isinstance(key, tuple):
+        return int(key[1]), int(key[0])
+    return int(key), 1
+
+
 def decode_window_limit(tpu_config, models) -> int:
     """Largest KV position the compiled decode programs can serve: the device
     drops KV writes beyond the largest compiled TKG bucket, not just beyond
@@ -697,6 +707,16 @@ class ModelWrapper:
         """Program lookup + call; the multi-step wrapper keys on (steps,
         bucket) pairs instead."""
         return self._programs[bucket](params, cache, device_batch)
+
+    def iter_programs(self):
+        """``(bucket, steps, key, program)`` per compiled-program slot, with
+        the key shape normalized (plain wrappers key on the bucket, the
+        multi-step wrapper on ``(steps, bucket)``) — what the cost
+        observatory (analysis/costs.py) and exporters iterate so they never
+        re-learn each wrapper's key convention."""
+        for key, prog in self._programs.items():
+            bucket, steps = normalize_program_key(key)
+            yield bucket, steps, key, prog
 
     def _telemetry_steps(self) -> int:
         """Decode steps retired per dispatch — the ``steps`` metric label

@@ -40,13 +40,23 @@ Metric catalog (labels in parens):
 ``nxdi_kv_block_frees_total``         counter
 ``nxdi_spec_accepted_tokens``         histogram  (path)
 ``nxdi_program_lowerings_total``      counter    (phase: warmup|serving)
+``nxdi_program_mfu_pct``              gauge      (submodel, bucket, steps)
+``nxdi_program_hbm_bw_pct``           gauge      (submodel, bucket, steps)
+``nxdi_roofline_gap_ratio``           gauge      (submodel, bucket, steps)
 ====================================  =========  ==================================
+
+The three roofline gauges are published by the cost observatory
+(:func:`nxdi_tpu.analysis.costs.attach_cost_gauges`, wired at ``app.load()``):
+at every export the measured mean dispatch latency is divided through each
+program's :class:`~nxdi_tpu.analysis.costs.CostSheet`, and the sheet table
+itself rides the JSON snapshot as ``_cost_sheets``.
 """
 
 from __future__ import annotations
 
+import logging
 import time
-from typing import Optional
+from typing import Callable, Dict, Optional
 
 from nxdi_tpu.telemetry import export as _export
 from nxdi_tpu.telemetry.registry import (
@@ -179,6 +189,29 @@ class Telemetry:
             "program lowerings by phase (serving = post-seal retrace!)",
             ("phase",),
         )
+        # roofline gauges, set by the cost-observatory attachment
+        # (analysis/costs.attach_cost_gauges) from measured-mean / CostSheet
+        self.program_mfu_pct = r.gauge(
+            "nxdi_program_mfu_pct",
+            "achieved vs declared-chip-peak FLOP utilization per program",
+            disp_labels,
+        )
+        self.program_hbm_bw_pct = r.gauge(
+            "nxdi_program_hbm_bw_pct",
+            "achieved vs declared-chip-peak HBM bandwidth per program",
+            disp_labels,
+        )
+        self.roofline_gap_ratio = r.gauge(
+            "nxdi_roofline_gap_ratio",
+            "measured mean dispatch latency / CostSheet roofline floor",
+            disp_labels,
+        )
+        # export-time hooks: attachments run before every snapshot/scrape
+        # (the cost observatory refreshes its gauges here); snapshot extras
+        # merge additional keys (e.g. _cost_sheets) into the JSON snapshot.
+        # Both are wrapped so a failing provider can never break an export.
+        self._attachments: list = []
+        self._snapshot_extras: Dict[str, Callable[[], object]] = {}
 
     # -- construction from config ------------------------------------------
     @classmethod
@@ -226,13 +259,43 @@ class Telemetry:
     def record_lowering(self, label: str, post_seal: bool) -> None:
         self.lowerings_total.inc(phase="serving" if post_seal else "warmup")
 
+    # -- export-time hooks --------------------------------------------------
+    def attach(self, fn: Callable[[], None]) -> None:
+        """Register a hook run before every export (snapshot / Prometheus
+        text) — how derived gauges stay current without a hot-path cost."""
+        self._attachments.append(fn)
+
+    def add_snapshot_extra(self, key: str, fn: Callable[[], object]) -> None:
+        """Merge ``{key: fn()}`` into every JSON snapshot (and therefore
+        into ``--metrics-out`` dumps and the ``/metrics.json`` endpoint)."""
+        self._snapshot_extras[key] = fn
+
+    def _run_attachments(self) -> None:
+        for fn in list(self._attachments):
+            try:
+                fn()
+            except Exception:
+                logging.getLogger("nxdi_tpu").warning(
+                    "telemetry attachment failed; export continues", exc_info=True
+                )
+
     # -- export -------------------------------------------------------------
     def snapshot(self) -> dict:
+        self._run_attachments()
         snap = self.registry.snapshot()
         snap["_spans"] = self.spans.to_list()
+        for key, fn in list(self._snapshot_extras.items()):
+            try:
+                snap[key] = fn()
+            except Exception:
+                logging.getLogger("nxdi_tpu").warning(
+                    "snapshot extra %r failed; export continues", key,
+                    exc_info=True,
+                )
         return snap
 
     def prometheus_text(self) -> str:
+        self._run_attachments()
         return prometheus_text(self.registry)
 
     def perfetto_trace(self, process_name: str = "nxdi_tpu") -> dict:

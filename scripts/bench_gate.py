@@ -1,0 +1,155 @@
+#!/usr/bin/env python
+"""Bench regression gate: a fresh bench.py JSON vs the BENCH_r*.json
+trajectory, with per-metric tolerances. The documented tier-2 step after a
+bench run:
+
+    python bench.py > /tmp/bench_fresh.json
+    python scripts/bench_gate.py /tmp/bench_fresh.json
+
+Baseline resolution: ``--baseline FILE`` or the newest ``BENCH_r*.json``
+(lexicographically last round) in the repo root. Metrics missing or null on
+EITHER side are skipped with a note — the bench folds in cached side files
+(BENCH_8B/BS1/MULTISTEP) that not every run refreshes, and older rounds
+predate the CostSheet fields.
+
+Exit status: 0 = no metric regressed beyond its tolerance, 1 = regression,
+2 = usage error. Improvements and within-tolerance noise both pass (the
+gate is one-sided; ratcheting the baseline forward is a human decision).
+
+Stdlib-only on purpose: the gate must run in the bare bench container.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+#: metric -> (direction, relative tolerance). "higher" = bigger is better.
+#: Tolerances absorb run-to-run chip noise (p50s over 3-5 chains move ~2-3%
+#: on a quiet v5e; MFU fields inherit the p50 noise).
+TOLERANCES: Dict[str, Tuple[str, float]] = {
+    "value": ("higher", 0.05),  # headline decode tok/s/chip
+    "tkg_step_p50_ms": ("lower", 0.07),
+    "tkg_step_p50_ms_int8": ("lower", 0.07),
+    "decode_tok_s_int8_weights": ("higher", 0.05),
+    "cte_p50_ms": ("lower", 0.10),
+    "spec_tok_s": ("higher", 0.10),
+    "spec_accept_tokens_per_window": ("higher", 0.10),
+    "tkg_multistep_ms_per_token": ("lower", 0.07),
+    "bs1_tok_ms": ("lower", 0.07),
+    "spec_bs1_window_ms": ("lower", 0.07),
+    "decode_tok_s_8b_int8": ("higher", 0.05),
+    # the CostSheet-joined roofline fields (PR: cost observatory)
+    "cte_mfu_pct": ("higher", 0.10),
+    "mfu_pct": ("higher", 0.07),
+    "hbm_roofline_pct": ("higher", 0.07),
+}
+
+
+def default_baseline(root: str) -> Optional[str]:
+    rounds = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
+    return rounds[-1] if rounds else None
+
+
+def bench_record(d: dict) -> dict:
+    """Unwrap a bench record: the BENCH_r*.json trajectory files store the
+    bench.py JSON line under ``parsed`` (next to the driver's n/cmd/rc);
+    fresh bench.py output is the record itself."""
+    if "value" not in d and isinstance(d.get("parsed"), dict):
+        return d["parsed"]
+    return d
+
+
+def compare(
+    baseline: dict, fresh: dict, tolerances: Dict[str, Tuple[str, float]],
+    scale: float = 1.0,
+) -> Tuple[List[dict], List[str]]:
+    """``(rows, skipped)``: one row per comparable metric with its verdict."""
+    rows, skipped = [], []
+    for metric, (direction, tol) in tolerances.items():
+        base, new = baseline.get(metric), fresh.get(metric)
+        if not isinstance(base, (int, float)) or not isinstance(new, (int, float)):
+            skipped.append(metric)
+            continue
+        if base == 0:
+            skipped.append(metric)
+            continue
+        delta = (new - base) / abs(base)
+        worse = -delta if direction == "higher" else delta
+        rows.append({
+            "metric": metric,
+            "direction": direction,
+            "baseline": base,
+            "fresh": new,
+            "delta_pct": round(100.0 * delta, 2),
+            "tolerance_pct": round(100.0 * tol * scale, 2),
+            "regression": worse > tol * scale,
+        })
+    return rows, skipped
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python scripts/bench_gate.py",
+        description="gate a fresh bench JSON against the BENCH_r*.json trajectory",
+    )
+    parser.add_argument("fresh", help="fresh bench.py output JSON (file path)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: newest BENCH_r*.json "
+                             "next to this repo)")
+    parser.add_argument("--tolerance-scale", type=float, default=1.0,
+                        help="multiply every tolerance (e.g. 2.0 on a noisy "
+                             "shared chip)")
+    parser.add_argument("--json", dest="json_path", default=None,
+                        help="write the comparison rows as JSON here")
+    parser.add_argument("-q", "--quiet", action="store_true")
+    args = parser.parse_args(argv)
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    baseline_path = args.baseline or default_baseline(root)
+    if baseline_path is None:
+        print("bench_gate: no --baseline and no BENCH_r*.json found", file=sys.stderr)
+        return 2
+    try:
+        with open(args.fresh) as f:
+            fresh = bench_record(json.load(f))
+        with open(baseline_path) as f:
+            baseline = bench_record(json.load(f))
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_gate: {e}", file=sys.stderr)
+        return 2
+
+    rows, skipped = compare(baseline, fresh, TOLERANCES, scale=args.tolerance_scale)
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump({"baseline": baseline_path, "rows": rows,
+                       "skipped": skipped}, f, indent=2)
+
+    regressions = [r for r in rows if r["regression"]]
+    if not args.quiet:
+        print(f"bench_gate: vs {os.path.basename(baseline_path)}", file=sys.stderr)
+        for r in rows:
+            mark = "REGRESSION" if r["regression"] else "ok"
+            arrow = "^" if r["direction"] == "higher" else "v"
+            print(
+                f"  {r['metric']:<32} {arrow} {r['baseline']:>10g} -> "
+                f"{r['fresh']:>10g}  {r['delta_pct']:+7.2f}% "
+                f"(tol {r['tolerance_pct']:g}%)  {mark}",
+                file=sys.stderr,
+            )
+        if skipped:
+            print(f"  skipped (missing/null on a side): {', '.join(skipped)}",
+                  file=sys.stderr)
+        print(
+            f"bench_gate: {len(rows)} compared, {len(regressions)} regressions",
+            file=sys.stderr,
+        )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -75,17 +75,18 @@ def main():
             np.asarray(out["tokens"])
             ms.append((time.perf_counter() - t0) * 1000.0)
         # program-structure record next to the perf number: per-program
-        # collective counts from the executables this run already compiled
-        # (nxdi_tpu.analysis auditor; zero retracing)
-        collectives = None
+        # collective counts + cost sheets from the executables this run
+        # already compiled (nxdi_tpu.analysis; zero retracing)
+        collectives = costs = None
         if with_summary:
-            from nxdi_tpu.analysis import collective_summary
+            from nxdi_tpu.analysis import collective_summary, cost_summary
 
             collectives = collective_summary(app)
+            costs = cost_summary(app)
         if metrics_out_requested():
             metric_snaps[f"cte_kernel_{attn_kernel}"] = app.telemetry.snapshot()
         del app
-        return float(np.percentile(ms, 50)), collectives
+        return float(np.percentile(ms, 50)), collectives, costs
 
     if "--kernel-only" in sys.argv:
         import os
@@ -95,7 +96,7 @@ def main():
             DEFAULT_PREFILL_BLOCK_Q,
         )
 
-        cte_kernel, collectives = run_cte(True)
+        cte_kernel, collectives, costs = run_cte(True)
         print(json.dumps({
             "cte_kernel_ms": round(cte_kernel, 1),
             "block_q": os.environ.get(
@@ -105,19 +106,22 @@ def main():
                 "NXDI_TPU_PREFILL_BLOCK_K", str(DEFAULT_PREFILL_BLOCK_K)
             ),
             "collectives": collectives,
+            "cost_sheets": costs,
         }))
         maybe_dump_metrics(metric_snaps)
         return
-    cte_kernel, collectives = run_cte(True)
+    cte_kernel, collectives, costs = run_cte(True)
     print(f"[probe] cte kernel-on {cte_kernel:.1f} ms", file=sys.stderr, flush=True)
-    cte_xla, _ = run_cte(False, with_summary=False)
+    cte_xla, _, _ = run_cte(False, with_summary=False)
     print(f"[probe] cte kernel-off {cte_xla:.1f} ms", file=sys.stderr, flush=True)
     print(json.dumps({
         "cte_kernel_ms": round(cte_kernel, 1),
         "cte_xla_attn_ms": round(cte_xla, 1),
         # BENCH rounds record program structure next to perf: the auditor's
-        # per-program collective counts for the kernel-on run
+        # per-program collective counts + the observatory's cost sheets for
+        # the kernel-on run
         "collectives": collectives,
+        "cost_sheets": costs,
     }))
     maybe_dump_metrics(metric_snaps)
 

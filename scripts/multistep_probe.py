@@ -58,10 +58,12 @@ def main():
         per.append((time.perf_counter() - t0) * 1000.0 / (K * (100 // K)))
     res["fused_k16_ms_per_step"] = round(float(np.percentile(per, 50)), 3)
     # BENCH rounds record program structure next to perf: the auditor's
-    # per-program collective counts from the executables this run compiled
-    from nxdi_tpu.analysis import collective_summary
+    # per-program collective counts + the observatory's cost sheets from
+    # the executables this run compiled
+    from nxdi_tpu.analysis import collective_summary, cost_summary
 
     res["collectives"] = collective_summary(app)
+    res["cost_sheets"] = cost_summary(app)
     print(json.dumps(res))
     from _bench import maybe_dump_metrics
 

@@ -307,6 +307,113 @@ def test_kv_layout_live_input_in_nonpaged_program_detected():
 
 
 # ---------------------------------------------------------------------------
+# HBM fit (the cost observatory's budget, run as an auditor checker)
+# ---------------------------------------------------------------------------
+
+def test_hbm_fit_clean_on_reference_app():
+    """The tiny reference app trivially fits a v5e; no hbm_fit findings."""
+    report = make_app().audit(checkers=["hbm_fit"])
+    assert errors_of(report, "hbm_fit") == []
+
+
+def test_hbm_fit_overbudget_config_detected():
+    """A declared chip the config cannot fit (weights + max-live KV + temp
+    vs per-chip HBM) fails the audit with the GiB breakdown."""
+    app = make_app(chip={"hbm_gib": 1e-6})  # a part with ~1 KiB of HBM
+    report = app.audit(submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "hbm_fit")
+    assert findings, report.to_json()
+    msg = findings[0].message
+    assert "exceeds" in msg and "max-live KV" in msg and "GiB" in msg
+    assert findings[0].program == "token_generation_model[64]"
+
+
+def test_hbm_fit_sharding_raises_the_budget():
+    """The budget derives from the sharding world like the collective
+    budget: the same over-budget weights fit once divided over tp chips."""
+    from nxdi_tpu.analysis.costs import hbm_residency, resolve_chip
+
+    app = make_app()
+    chip = resolve_chip(app.tpu_config)
+    big_weights = int(chip.hbm_bytes * 1.5)
+    assert not hbm_residency(big_weights, 0, 1, chip)["fits"]
+    assert hbm_residency(big_weights, 0, 8, chip)["fits"]
+
+
+# ---------------------------------------------------------------------------
+# cross-program cache-format agreement (the ROADMAP invariant, now checked)
+# ---------------------------------------------------------------------------
+
+def test_cache_format_agreement_clean_on_reference_app():
+    """Prefill and decode resolve their AUTO cache layouts identically, and
+    the auditor recorded the per-leaf formats it compared."""
+    report = make_app().audit()
+    assert errors_of(report, "cache_format") == []
+    formats = [p.cache_formats for p in report.programs]
+    assert all(f is not None and len(f) == 2 for f in formats)  # k and v
+    assert len({f for fs in formats for f in fs}) == 1  # one layout overall
+
+
+def test_cache_format_disagreement_detected(monkeypatch):
+    """A prefill/decode pair resolving DIFFERENT cache layouts is flagged:
+    every phase transition would pay a full-cache relayout."""
+    from nxdi_tpu.analysis import auditor as auditor_mod
+
+    real = auditor_mod.compiled_input_formats
+    calls = {"n": 0}
+
+    def drifting_formats(compiled):
+        # each compiled program reports a different per-leaf layout
+        calls["n"] += 1
+        return ((None, {"k": f"fmt{calls['n']}", "v": f"fmt{calls['n']}"}, None),)
+
+    monkeypatch.setattr(auditor_mod, "compiled_input_formats", drifting_formats)
+    report = make_app().audit()
+    findings = errors_of(report, "cache_format")
+    assert findings, report.to_json()
+    msg = findings[0].message
+    assert "relayout" in msg and "disagree" in msg
+    # names both sides of the disagreeing pair
+    assert "context_encoding_model[32]" in msg
+    assert "token_generation_model[64]" in msg
+    monkeypatch.setattr(auditor_mod, "compiled_input_formats", real)
+
+
+def test_unknown_checker_name_still_surfaces():
+    """`checkers=["kv_layuot"]` (a typo) must not read as "ran clean": every
+    program reports the unknown name; the valid cross-program "cache_format"
+    selection stays silent."""
+    report = make_app().audit(checkers=["donation", "kv_layuot"])
+    msgs = [f.message for f in report.findings if f.checker == "auditor"]
+    assert msgs and all("kv_layuot" in m for m in msgs)
+    clean = make_app().audit(checkers=["cache_format"])
+    assert [f for f in clean.findings if f.checker == "auditor"] == []
+
+
+def test_cache_format_agreement_pure_function():
+    """Both directions through the comparison itself (no compile needed)."""
+    from nxdi_tpu.analysis import check_cache_format_agreement
+    from nxdi_tpu.analysis.auditor import ProgramReport
+
+    agree = [
+        ProgramReport("cte", 32, "cte[32]", cache_formats=("A", "A")),
+        ProgramReport("tkg", 64, "tkg[64]", cache_formats=("A", "A")),
+        ProgramReport("x", None, "x[?]", cache_formats=None),  # no view: skipped
+    ]
+    assert check_cache_format_agreement(agree) == []
+    disagree = [
+        ProgramReport("cte", 32, "cte[32]", cache_formats=("A", "A")),
+        ProgramReport("tkg", 64, "tkg[64]", cache_formats=("A", "B")),
+    ]
+    findings = check_cache_format_agreement(disagree)
+    assert len(findings) == 1
+    assert findings[0].checker == "cache_format"
+    assert findings[0].program == "tkg[64]"
+    # the finding landed on the report too (audit_application's view)
+    assert disagree[1].findings == findings
+
+
+# ---------------------------------------------------------------------------
 # retrace guard
 # ---------------------------------------------------------------------------
 

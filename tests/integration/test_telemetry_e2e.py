@@ -103,6 +103,91 @@ def test_telemetry_off_records_nothing(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# the cost -> telemetry join (PR: cost observatory)
+# ---------------------------------------------------------------------------
+
+def test_cost_gauges_exact_join_with_injected_latency(loaded_app):
+    """Injected dispatch latencies against the app's CostSheet must yield
+    EXACT roofline gauge values in both the JSON snapshot and the
+    Prometheus text: the join divides the histogram's mean (sum/count —
+    exact, unlike an interpolated percentile) through the sheet."""
+    app = loaded_app
+    tel = app.telemetry
+    tel.reset()
+    for _ in range(3):  # three known dispatches, 2 ms each
+        tel.record_dispatch("token_generation_model", 64, 1, 0.002)
+
+    snap = tel.snapshot()
+    sheets = {s["program"]: s for s in snap["_cost_sheets"]}
+    sheet = sheets["token_generation_model[64]"]
+    assert sheet["flops"] > 0 and sheet["hbm_bytes"] > 0
+    hist = snap["nxdi_dispatch_seconds"]["series"][0]
+    mean_s = hist["sum"] / hist["count"]  # what the attachment divides by
+
+    # parenthesized exactly like CostSheet.mfu_pct/hbm_bw_pct so the float
+    # arithmetic (and therefore the equality below) is bit-exact
+    expected_mfu = 100.0 * sheet["flops"] / (
+        mean_s * (sheet["chip"]["bf16_tflops"] * 1e12)
+    )
+    expected_bw = 100.0 * sheet["hbm_bytes"] / (
+        mean_s * (sheet["chip"]["hbm_gbs"] * 1e9)
+    )
+    expected_gap = mean_s / sheet["floor_s"]
+
+    def gauge(name):
+        (row,) = snap[name]["series"]
+        assert row["labels"] == {
+            "submodel": "token_generation_model", "bucket": "64", "steps": "1",
+        }
+        return row["value"]
+
+    assert gauge("nxdi_program_mfu_pct") == expected_mfu
+    assert gauge("nxdi_program_hbm_bw_pct") == expected_bw
+    assert gauge("nxdi_roofline_gap_ratio") == expected_gap
+
+    text = tel.prometheus_text()
+    labels = '{submodel="token_generation_model",bucket="64",steps="1"}'
+    line = next(
+        ln for ln in text.splitlines()
+        if ln.startswith("nxdi_program_mfu_pct{")
+    )
+    assert line == f"nxdi_program_mfu_pct{labels} {repr(float(expected_mfu))}"
+
+
+def test_cost_sheets_ride_every_snapshot(loaded_app):
+    """One file captures measured + theoretical: any snapshot (and thus
+    --metrics-out dumps and /metrics.json) embeds the CostSheet table."""
+    app = loaded_app
+    snap = app.telemetry.snapshot()
+    assert {s["program"] for s in snap["_cost_sheets"]} == {
+        "context_encoding_model[32]", "token_generation_model[64]",
+    }
+    for s in snap["_cost_sheets"]:
+        assert s["flops"] > 0 and s["hbm_bytes"] > 0
+        assert s["bound"] in ("compute", "hbm")
+        assert s["fit"]["fits"] is True
+    json.dumps(snap)  # the whole enriched snapshot stays JSON-able
+
+
+def test_cost_attachment_failure_never_breaks_export(loaded_app):
+    """A failing snapshot extra / attachment is logged and skipped; the
+    export itself must survive (the gauges degrade, serving does not)."""
+    app = loaded_app
+    tel = app.telemetry
+    def boom():
+        raise RuntimeError("cost model exploded")
+    tel.attach(boom)
+    tel.add_snapshot_extra("_boom", boom)
+    try:
+        snap = tel.snapshot()
+        assert "_boom" not in snap
+        assert tel.prometheus_text().endswith("\n")
+    finally:
+        tel._attachments.remove(boom)
+        tel._snapshot_extras.pop("_boom")
+
+
+# ---------------------------------------------------------------------------
 # exposition surfaces
 # ---------------------------------------------------------------------------
 
@@ -187,6 +272,18 @@ def test_cli_metrics_end_to_end(tmp_path, capsys):
     assert snap["nxdi_request_tpot_seconds"]["series"][0]["count"] >= 2
     assert snap["nxdi_requests_total"]["series"][0]["value"] == 2
     assert len(snap["_spans"]) == 2
+    # the cost observatory rides the same snapshot: sheet table + the
+    # CostSheet-joined roofline gauges for every dispatched program
+    sheet_tags = {s["submodel"] for s in snap["_cost_sheets"]}
+    assert {"context_encoding_model", "token_generation_model"} <= sheet_tags
+    assert all(s["flops"] > 0 and s["hbm_bytes"] > 0 for s in snap["_cost_sheets"])
+    mfu_tags = {
+        s["labels"]["submodel"] for s in snap["nxdi_program_mfu_pct"]["series"]
+    }
+    assert {"context_encoding_model", "token_generation_model"} <= mfu_tags
+    for fam in ("nxdi_program_mfu_pct", "nxdi_program_hbm_bw_pct"):
+        assert f"{fam}{{" in prom_part  # exported in the Prometheus text too
+        assert all(s["value"] > 0 for s in snap[fam]["series"])
 
     # the Perfetto trace loads and is structurally sound
     trace = json.loads(trace_path.read_text())

@@ -1,0 +1,137 @@
+"""scripts/bench_gate.py: the bench-trajectory regression gate (tier-2).
+Stdlib-only module loaded from its file path (scripts/ is not a package)."""
+
+import importlib.util
+import json
+import os
+
+_SPEC = importlib.util.spec_from_file_location(
+    "bench_gate",
+    os.path.join(os.path.dirname(__file__), "..", "..", "scripts", "bench_gate.py"),
+)
+bench_gate = importlib.util.module_from_spec(_SPEC)
+_SPEC.loader.exec_module(bench_gate)
+
+
+BASE = {
+    "value": 3700.0,
+    "tkg_step_p50_ms": 8.64,
+    "cte_p50_ms": 683.0,
+    "cte_mfu_pct": 60.0,
+    "mfu_pct": 4.6,
+    "hbm_roofline_pct": 90.0,
+    "bs1_tok_ms": None,  # cached side file absent in this round
+}
+
+
+def _write(tmp_path, name, d):
+    p = tmp_path / name
+    p.write_text(json.dumps(d))
+    return str(p)
+
+
+def test_within_tolerance_passes(tmp_path):
+    fresh = dict(BASE, value=3650.0, tkg_step_p50_ms=8.8)  # ~1-2% noise
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fresh),
+        "--baseline", _write(tmp_path, "base.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+
+
+def test_regression_fails_and_reports(tmp_path, capsys):
+    fresh = dict(BASE, tkg_step_p50_ms=11.0)  # +27% step latency
+    out = tmp_path / "rows.json"
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fresh),
+        "--baseline", _write(tmp_path, "base.json", BASE),
+        "--json", str(out),
+    ])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "tkg_step_p50_ms" in err and "REGRESSION" in err
+    rows = json.loads(out.read_text())["rows"]
+    (bad,) = [r for r in rows if r["regression"]]
+    assert bad["metric"] == "tkg_step_p50_ms"
+
+
+def test_improvement_passes_both_directions(tmp_path):
+    # higher-is-better metric up AND lower-is-better metric down = all good
+    fresh = dict(BASE, value=5000.0, tkg_step_p50_ms=6.0, mfu_pct=7.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fresh),
+        "--baseline", _write(tmp_path, "base.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+
+
+def test_mfu_field_regression_gates(tmp_path):
+    # the new CostSheet-sourced fields are first-class gated metrics
+    fresh = dict(BASE, hbm_roofline_pct=70.0)  # -22%
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fresh),
+        "--baseline", _write(tmp_path, "base.json", BASE),
+        "-q",
+    ])
+    assert rc == 1
+
+
+def test_missing_and_null_metrics_skip(tmp_path, capsys):
+    # bs1_tok_ms is None in the baseline; spec_tok_s missing on both sides —
+    # neither may crash or count as a regression
+    fresh = dict(BASE)
+    fresh["bs1_tok_ms"] = 12.0
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fresh),
+        "--baseline", _write(tmp_path, "base.json", BASE),
+    ])
+    assert rc == 0
+    assert "bs1_tok_ms" in capsys.readouterr().err  # listed as skipped
+
+
+def test_tolerance_scale(tmp_path):
+    fresh = dict(BASE, value=3400.0)  # -8.1%: fails at 1x, passes at 2x
+    base = _write(tmp_path, "base.json", BASE)
+    f = _write(tmp_path, "fresh.json", fresh)
+    assert bench_gate.main([f, "--baseline", base, "-q"]) == 1
+    assert bench_gate.main(
+        [f, "--baseline", base, "-q", "--tolerance-scale", "2.0"]
+    ) == 0
+
+
+def test_wrapped_trajectory_baseline_unwraps(tmp_path):
+    # the repo's BENCH_r*.json files store the bench record under "parsed"
+    # (next to the driver's n/cmd/rc wrapper) — the gate must unwrap it
+    wrapped = {"n": 5, "cmd": "python bench.py", "rc": 0, "parsed": dict(BASE)}
+    fresh = dict(BASE, tkg_step_p50_ms=11.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fresh),
+        "--baseline", _write(tmp_path, "base.json", wrapped),
+        "-q",
+    ])
+    assert rc == 1  # the wrapped baseline's metrics were actually compared
+
+
+def test_gate_against_real_trajectory_file():
+    # BENCH_r05.json vs itself: every comparable metric is identical -> pass
+    root = os.path.join(os.path.dirname(__file__), "..", "..")
+    r05 = os.path.join(root, "BENCH_r05.json")
+    assert bench_gate.main([r05, "--baseline", r05, "-q"]) == 0
+    rec = bench_gate.bench_record(json.load(open(r05)))
+    rows, _ = bench_gate.compare(rec, rec, bench_gate.TOLERANCES)
+    assert rows, "real trajectory file yielded no comparable metrics"
+
+
+def test_default_baseline_picks_latest_round():
+    # the repo root carries the BENCH_r*.json trajectory; the gate must pick
+    # the newest round
+    root = os.path.join(os.path.dirname(__file__), "..", "..")
+    picked = bench_gate.default_baseline(root)
+    assert picked is not None and os.path.basename(picked) >= "BENCH_r05.json"
+
+
+def test_usage_errors(tmp_path):
+    assert bench_gate.main([str(tmp_path / "missing.json"),
+                            "--baseline", str(tmp_path / "nope.json")]) == 2

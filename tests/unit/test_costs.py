@@ -1,0 +1,342 @@
+"""Cost observatory unit suite (nxdi_tpu/analysis/costs.py): chip-spec
+resolution, the analytic FLOP/HBM model, XLA-source extraction with graceful
+degradation (None/partial/raising backends -> source="analytic", never a
+crash), the >2x mismatch warning, roofline classification, and the HBM-fit
+account the ``hbm_fit`` auditor checker reads."""
+
+import logging
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.analysis.costs import (
+    CHIP_SPECS,
+    ChipSpec,
+    MISMATCH_RATIO,
+    analytic_program_costs,
+    hbm_residency,
+    program_cost_sheet,
+    resolve_chip,
+    tree_bytes,
+    tree_param_count,
+    xla_cost_analysis,
+    xla_memory_analysis,
+)
+from nxdi_tpu.config import TpuConfig
+
+
+# ---------------------------------------------------------------------------
+# chip specs
+# ---------------------------------------------------------------------------
+
+def test_default_chip_is_v5e():
+    chip = resolve_chip(TpuConfig(seq_len=32))
+    assert chip.name == "v5e"
+    assert chip.bf16_tflops == 197.0 and chip.hbm_gbs == 819.0
+    assert chip.hbm_bytes == 16 * 2**30
+
+
+def test_chip_by_name_and_dict_override():
+    assert resolve_chip(TpuConfig(seq_len=32, chip="v5p")).name == "v5p"
+    custom = resolve_chip(TpuConfig(seq_len=32, chip={"hbm_gib": 8.0}))
+    assert custom.name == "custom"
+    assert custom.hbm_gib == 8.0
+    # unspecified fields inherit v5e
+    assert custom.bf16_tflops == CHIP_SPECS["v5e"].bf16_tflops
+    # dict "base" picks another generation to override from
+    v4ish = resolve_chip(None, override={"base": "v4", "hbm_gbs": 999.0})
+    assert v4ish.bf16_tflops == CHIP_SPECS["v4"].bf16_tflops
+    assert v4ish.hbm_gbs == 999.0
+
+
+def test_unknown_chip_rejected():
+    with pytest.raises(ValueError, match="unknown chip"):
+        resolve_chip(None, override="v99")
+    with pytest.raises(ValueError, match="chip must be"):
+        TpuConfig(seq_len=32, chip=3.14)
+
+
+def test_unknown_chip_base_is_a_value_error():
+    # dict specs with a typo'd "base" must not escape as a bare KeyError
+    with pytest.raises(ValueError, match="unknown chip base"):
+        resolve_chip(None, override={"base": "v5x", "hbm_gib": 8})
+    with pytest.raises(ValueError, match="invalid TpuConfig chip"):
+        TpuConfig(seq_len=32, chip={"base": "v5x"})
+
+
+def test_config_rejects_bad_chip_eagerly():
+    """A typo'd chip name/field fails at TpuConfig construction — not
+    swallowed later inside an export attachment or auditor checker."""
+    with pytest.raises(ValueError, match="invalid TpuConfig chip"):
+        TpuConfig(seq_len=32, chip="v5")  # typo for v5e
+    with pytest.raises(ValueError, match="invalid TpuConfig chip"):
+        TpuConfig(seq_len=32, chip={"hbm_gigs": 8})  # typo'd field name
+    # the round trip keeps working for valid values
+    assert TpuConfig(seq_len=32, chip="v5p").copy().chip == "v5p"
+
+
+# ---------------------------------------------------------------------------
+# pytree byte accounting
+# ---------------------------------------------------------------------------
+
+def test_tree_bytes_counts_dtypes():
+    import jax
+    import jax.numpy as jnp
+
+    tree = {
+        "bf16": jax.ShapeDtypeStruct((4, 8), jnp.bfloat16),
+        "int8": jax.ShapeDtypeStruct((16,), jnp.int8),
+    }
+    assert tree_bytes(tree) == 4 * 8 * 2 + 16
+    assert tree_param_count(tree) == 32 + 16
+
+
+# ---------------------------------------------------------------------------
+# the analytic model (against a hand-built wrapper stand-in)
+# ---------------------------------------------------------------------------
+
+class _Arch:
+    num_layers = 16
+    num_attention_heads = 32
+    num_kv_heads = 8
+    head_dim = 64
+    v_head_dim = None
+    hidden_size = 2048
+    vocab_size = 128256
+
+
+class _W:
+    """Just the attributes analytic_program_costs reads — the bench 1B
+    geometry, so the expectations below are the bench.py formulas."""
+
+    arch = _Arch()
+    batch_size = 32
+    n_active_tokens = 1
+    attend_to_cache = True
+    prefill_to_cache = False
+
+
+PARAM_COUNT = 1_235_814_400  # llama-3.2-1b full-depth param count
+PARAM_BYTES = 2 * PARAM_COUNT
+
+
+def test_analytic_decode_matches_bench_formulas():
+    a = _Arch()
+    got = analytic_program_costs(_W(), 2048, 1, PARAM_COUNT, PARAM_BYTES)
+    step_flops = (
+        2.0 * PARAM_COUNT * 32
+        + 4.0 * a.num_layers * a.num_attention_heads * a.head_dim * 2048 * 32
+    )
+    kv_bytes = 2.0 * a.num_layers * a.num_kv_heads * a.head_dim * 2048 * 2 * 32
+    np.testing.assert_allclose(got["flops"], step_flops)
+    np.testing.assert_allclose(got["hbm_bytes"], PARAM_BYTES + kv_bytes)
+    np.testing.assert_allclose(got["kv_bytes"], kv_bytes)
+
+
+def test_analytic_prefill_matches_bench_formulas():
+    a = _Arch()
+
+    class P(_W):
+        attend_to_cache = False
+        n_active_tokens = 0
+
+    got = analytic_program_costs(P(), 1024, 1, PARAM_COUNT, PARAM_BYTES)
+    tokens = 32 * 1024
+    lm_head = a.vocab_size * a.hidden_size
+    want = (
+        2.0 * (PARAM_COUNT - lm_head) * tokens
+        + 2.0 * lm_head * 32
+        + 2.0 * a.num_layers * a.num_attention_heads * a.head_dim * 1024 * 1024 * 32
+    )
+    np.testing.assert_allclose(got["flops"], want)
+
+
+def test_analytic_multistep_scales_per_step():
+    one = analytic_program_costs(_W(), 2048, 1, PARAM_COUNT, PARAM_BYTES)
+    four = analytic_program_costs(_W(), 2048, 4, PARAM_COUNT, PARAM_BYTES)
+    np.testing.assert_allclose(four["flops"], 4 * one["flops"])
+    np.testing.assert_allclose(four["hbm_bytes"], 4 * one["hbm_bytes"])
+
+
+# ---------------------------------------------------------------------------
+# XLA extraction: every degraded shape falls back, never raises
+# ---------------------------------------------------------------------------
+
+class _FakeCompiled:
+    def __init__(self, cost=None, memory=None, raise_cost=False, raise_mem=False):
+        self._cost, self._memory = cost, memory
+        self._rc, self._rm = raise_cost, raise_mem
+
+    def cost_analysis(self):
+        if self._rc:
+            raise RuntimeError("backend says no")
+        return self._cost
+
+    def memory_analysis(self):
+        if self._rm:
+            raise RuntimeError("backend says no")
+        return self._memory
+
+
+class _FakeMem:
+    argument_size_in_bytes = 1000
+    output_size_in_bytes = 600
+    alias_size_in_bytes = 500
+    temp_size_in_bytes = 200
+    generated_code_size_in_bytes = 10
+
+
+def test_xla_cost_analysis_shapes():
+    assert xla_cost_analysis(_FakeCompiled(cost=None)) is None
+    assert xla_cost_analysis(_FakeCompiled(raise_cost=True)) is None
+    assert xla_cost_analysis(_FakeCompiled(cost=[])) is None
+    # partial: a dict without "flops" is useless -> None
+    assert xla_cost_analysis(_FakeCompiled(cost=[{"bytes accessed": 5.0}])) is None
+    # list-of-dict (jax 0.4.x) and plain dict (newer) both parse
+    got = xla_cost_analysis(
+        _FakeCompiled(cost=[{"flops": 2.0, "bytes accessed": 3.0}])
+    )
+    assert got == {"flops": 2.0, "bytes_accessed": 3.0}
+    assert xla_cost_analysis(_FakeCompiled(cost={"flops": 7.0})) == {"flops": 7.0}
+
+
+def test_xla_memory_analysis_shapes():
+    assert xla_memory_analysis(_FakeCompiled(memory=None)) is None
+    assert xla_memory_analysis(_FakeCompiled(raise_mem=True)) is None
+    got = xla_memory_analysis(_FakeCompiled(memory=_FakeMem()))
+    assert got["temp_bytes"] == 200 and got["alias_bytes"] == 500
+
+
+def _sheet(compiled, chip=None, **wrapper_overrides):
+    class W(_W):
+        class config:
+            tpu_config = TpuConfig(seq_len=32)
+
+        tag = "token_generation_model"
+        _programs = {}
+
+    w = W()
+    for k, v in wrapper_overrides.items():
+        setattr(w, k, v)
+    return program_cost_sheet(
+        w, 2048, None,
+        param_count=PARAM_COUNT, param_bytes=PARAM_BYTES,
+        cache_bytes=8 * 2**20, kv_itemsize=2,
+        chip=chip, compiled=compiled,
+    )
+
+
+def test_sheet_source_fallback_and_xla():
+    ana = _sheet(None)
+    assert ana.source == "analytic"
+    assert ana.xla_flops is None and ana.flops > 0 and ana.hbm_bytes > 0
+    # an agreeing XLA answer keeps source="xla", no mismatch
+    agreeing = _sheet(_FakeCompiled(
+        cost=[{"flops": ana.flops * 1.2, "bytes accessed": ana.hbm_bytes}],
+        memory=_FakeMem(),
+    ))
+    assert agreeing.source == "xla"
+    assert agreeing.mismatch is None
+    assert agreeing.memory["temp_bytes"] == 200
+    # a raising backend degrades identically to None
+    raising = _sheet(_FakeCompiled(raise_cost=True, raise_mem=True))
+    assert raising.source == "analytic" and raising.memory is None
+
+
+def test_sheet_mismatch_warning_on_2x_divergence(caplog):
+    ana = _sheet(None)
+    with caplog.at_level(logging.WARNING, logger="nxdi_tpu"):
+        off = _sheet(_FakeCompiled(
+            cost=[{"flops": ana.flops * (MISMATCH_RATIO * 1.5)}]
+        ))
+    assert off.mismatch is not None
+    assert "mismatch" in " ".join(r.message for r in caplog.records)
+    # canonical numbers stay analytic even when XLA disagrees
+    np.testing.assert_allclose(off.flops, ana.flops)
+
+
+def test_sheet_mismatch_undercount_allows_scan_body():
+    """XLA counts the lax.scan layer body ONCE, so an L-layer scanned model
+    legitimately reports up to ~L fewer FLOPs — within that allowance is
+    NOT a mismatch; beyond it is."""
+    ana = _sheet(None)
+    L = _Arch.num_layers
+    within_scan = _sheet(_FakeCompiled(cost=[{"flops": ana.flops / L}]))
+    assert within_scan.mismatch is None
+    beyond = _sheet(_FakeCompiled(
+        cost=[{"flops": ana.flops / (MISMATCH_RATIO * L * 4)}]
+    ))
+    assert beyond.mismatch is not None
+    assert "scan-undercount" in beyond.mismatch
+
+
+# ---------------------------------------------------------------------------
+# roofline classification + the measured joins
+# ---------------------------------------------------------------------------
+
+def test_roofline_bound_follows_chip_spec():
+    # bs32 decode on v5e: weight-streaming dominates -> HBM-bound
+    on_v5e = _sheet(None)
+    assert on_v5e.bound == "hbm"
+    assert on_v5e.floor_s == pytest.approx(on_v5e.t_hbm_s)
+    # same program on a fantasy part with near-infinite bandwidth flips
+    fast_hbm = ChipSpec("fast", bf16_tflops=197.0, hbm_gbs=1e9, hbm_gib=16.0)
+    assert _sheet(None, chip=fast_hbm).bound == "compute"
+
+
+def test_measured_joins_share_one_formula():
+    s = _sheet(None)
+    measured = 2.0 * s.floor_s  # running at half the roofline
+    assert s.gap_ratio(measured) == pytest.approx(2.0)
+    np.testing.assert_allclose(
+        s.mfu_pct(measured), 100.0 * s.flops / (measured * 197e12)
+    )
+    np.testing.assert_allclose(
+        s.hbm_bw_pct(measured), 100.0 * s.hbm_bytes / (measured * 819e9)
+    )
+    assert s.mfu_pct(0.0) == 0.0 and s.gap_ratio(0.0) == 0.0
+
+
+def test_sheet_world_divides_per_chip():
+    class W8(_W):
+        class config:
+            tpu_config = TpuConfig(seq_len=32, tp_degree=8)
+
+        tag = "token_generation_model"
+
+    s1 = _sheet(None)
+    s8 = program_cost_sheet(
+        W8(), 2048, None, param_count=PARAM_COUNT, param_bytes=PARAM_BYTES,
+        cache_bytes=8 * 2**20, kv_itemsize=2, compiled=None,
+    )
+    assert s8.world == 8
+    np.testing.assert_allclose(s8.flops, s1.flops / 8)
+    np.testing.assert_allclose(s8.hbm_bytes, s1.hbm_bytes / 8)
+
+
+# ---------------------------------------------------------------------------
+# HBM-fit account
+# ---------------------------------------------------------------------------
+
+def test_hbm_residency_breakdown_and_fit():
+    chip = CHIP_SPECS["v5e"]
+    fit = hbm_residency(8 * 2**30, 4 * 2**30, 1, chip, {
+        "temp_bytes": 2**30, "output_bytes": 2**20, "alias_bytes": 2**20,
+    })
+    assert fit["fits"]  # 8 + 4 + 1 GiB < 16 GiB
+    assert fit["output_extra_bytes"] == 0  # fully aliased outputs are free
+    over = hbm_residency(20 * 2**30, 4 * 2**30, 1, chip)
+    assert not over["fits"]
+    # sharding the same model over 2 chips brings it back under
+    assert hbm_residency(20 * 2**30, 4 * 2**30, 2, chip)["fits"]
+
+
+def test_cost_sheet_to_dict_is_jsonable():
+    import json
+
+    s = _sheet(_FakeCompiled(cost=[{"flops": 1e9}], memory=_FakeMem()))
+    d = s.to_dict()
+    json.dumps(d)
+    assert d["bound"] in ("compute", "hbm")
+    assert d["fit"]["fits"] in (True, False)
+    assert d["source"] == "xla" and "xla_flops" in d
