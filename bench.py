@@ -843,6 +843,131 @@ def main_multistep(k: int):
     )
 
 
+def _flag_value(name, default):
+    if name not in sys.argv:
+        return default
+    idx = sys.argv.index(name)
+    if idx + 1 >= len(sys.argv):
+        raise SystemExit(f"{name} requires a value")
+    return type(default)(sys.argv[idx + 1])
+
+
+def main_serving(
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+):
+    """``bench.py --serving``: continuous-batching goodput under a Poisson
+    arrival workload (nxdi_tpu/serving InferenceEngine over the paged
+    layout) on the full-depth 1B geometry — req/s, tok/s, and p50/p95
+    TTFT/TPOT measured per request from its request span (TTFT counts
+    queueing: that is what "under load" means for serving). One JSON line,
+    gated by scripts/bench_gate.py (serving_* metrics; older trajectory
+    files without them are skipped, not failed)."""
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+    from nxdi_tpu.serving import (
+        InferenceEngine,
+        SamplingParams,
+        SchedulerConfig,
+        drive_arrivals,
+        goodput_summary,
+    )
+
+    block = 128
+    tcfg = TpuConfig(
+        tp_degree=1,
+        batch_size=slots,
+        ctx_batch_size=1,
+        tkg_batch_size=slots,
+        seq_len=seq_len,
+        max_context_length=prompt_len,
+        dtype="bfloat16",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        is_block_kv_layout=True,
+        pa_block_size=block,
+        # every slot can hold a full window plus one block of headroom for
+        # the admission watermark
+        pa_num_blocks=slots * (-(-seq_len // block)) + slots,
+        skip_warmup=False,
+    )
+    cfg = ml.LlamaInferenceConfig(
+        tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
+        num_hidden_layers=n_layers, num_attention_heads=N_HEADS,
+        num_key_value_heads=N_KV_HEADS, head_dim=HEAD_DIM,
+        vocab_size=VOCAB, rms_norm_eps=1e-5, rope_theta=500000.0,
+    )
+    rng = np.random.default_rng(0)
+    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
+    state = jtu.tree_map(
+        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16
+        ),
+        struct,
+    )
+
+    class App(TpuModelForCausalLM):
+        def build_params(self):
+            return state
+
+    app = App("<random>", cfg, model_family=ml)
+    app.load()
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=slots))
+
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+    prompts = [
+        rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+        .astype(np.int32).tolist()
+        for _ in range(requests)
+    ]
+    # ONE arrival driver with the cli.serve demo (serving/workload.py): the
+    # bench measures the same loop the demo runs
+    outputs, wall = drive_arrivals(
+        engine,
+        arrivals,
+        lambda eng, i, arrival_s: eng.add_request(
+            prompts[i],
+            SamplingParams(max_new_tokens=max_new),
+            arrival_s=arrival_s,
+        ),
+    )
+
+    # ONE statistics rule with the cli.serve demo (serving/workload.py)
+    s = goodput_summary(outputs, wall)
+    rec = {
+        "metric": "llama3.2-1b_serving_goodput",
+        "value": s["goodput_req_s"],
+        "unit": "req/s",
+        "serving_goodput_req_s": s["goodput_req_s"],
+        "serving_tok_s": s["tok_s"],
+        "serving_ttft_p50_ms": s["ttft_p50_ms"],
+        "serving_ttft_p95_ms": s["ttft_p95_ms"],
+        "serving_tpot_p50_ms": s["tpot_p50_ms"],
+        "serving_tpot_p95_ms": s["tpot_p95_ms"],
+        "serving_preemptions": s["preemptions"],
+        "serving_requests": requests,
+        "serving_arrival_rate_req_s": rate,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged slots{slots} "
+            f"kv{seq_len} prompt~{prompt_len} max_new{max_new} tp1"
+        ),
+        "mode": "continuous_batching_engine",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"serving": app.telemetry.snapshot()}, metrics_out_path()
+    )
+    return rec
+
+
 if __name__ == "__main__":
     if "--8b-only" in sys.argv:
         main_8b_only()
@@ -851,5 +976,12 @@ if __name__ == "__main__":
     elif "--decode-steps-per-dispatch" in sys.argv:
         idx = sys.argv.index("--decode-steps-per-dispatch")
         main_multistep(int(sys.argv[idx + 1]))
+    elif "--serving" in sys.argv:
+        main_serving(
+            requests=_flag_value("--serving-requests", 32),
+            rate=_flag_value("--serving-rate", 16.0),
+            slots=_flag_value("--serving-slots", 8),
+            max_new=_flag_value("--serving-max-new", 256),
+        )
     else:
         main()

@@ -146,13 +146,20 @@ def audit_wrapper(
     checkers: Optional[Sequence[str]] = None,
     const_threshold: int = DEFAULT_CONST_THRESHOLD_BYTES,
     reuse_compiled: bool = True,
+    shared: Optional[dict] = None,
 ) -> List[ProgramReport]:
     """Audit every compiled program of one ModelWrapper.
 
     ``params_struct`` / ``cache_struct`` are the abstract pytrees the app's
     ``aot_compile`` uses (ShapeDtypeStructs, shardings attached here).
+    ``shared`` is the one-dict-per-audit state letting checkers run their
+    program-independent passes once (audit_application threads a single
+    dict through every wrapper).
     """
     from nxdi_tpu.models import base as base_mod
+
+    if shared is None:
+        shared = {}
 
     config = config or wrapper.config
     # "cache_format" is the cross-program pass audit_application runs — a
@@ -232,6 +239,8 @@ def audit_wrapper(
             compiled=compiled,
             param_bytes=param_bytes,
             cache_bytes=cache_bytes,
+            params_struct=ps,
+            shared=shared,
         )
         for name in names:
             try:
@@ -321,6 +330,7 @@ def audit_application(
     params_struct = app.build_params_struct()
     cache_struct = app._cache_struct()
     report = AuditReport()
+    shared: dict = {}  # one per audit: checkers dedupe cross-program passes
     for tag, wrapper in app.models.items():
         if submodels is not None and tag not in submodels:
             continue
@@ -328,7 +338,7 @@ def audit_application(
             report.programs.extend(audit_wrapper(
                 wrapper, params_struct, cache_struct, config=app.config,
                 checkers=checkers, const_threshold=const_threshold,
-                reuse_compiled=reuse_compiled,
+                reuse_compiled=reuse_compiled, shared=shared,
             ))
         except Exception as e:
             report.programs.append(ProgramReport(

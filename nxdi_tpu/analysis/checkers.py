@@ -87,6 +87,13 @@ class ProgramArtifacts:
     compiled: Any = None  # the compiled executable (memory/cost analyses)
     param_bytes: int = 0  # GLOBAL weight bytes (abstract params struct)
     cache_bytes: int = 0  # GLOBAL allocated KV bytes (= max-live KV)
+    #: abstract params pytree WITH shardings attached (what aot_compile
+    #: lowers against) — lets checkers reason about per-leaf PartitionSpecs
+    params_struct: Any = None
+    #: one dict per audit run, shared by every program's artifacts — lets a
+    #: checker run program-independent passes once instead of re-emitting
+    #: identical findings per (submodel, bucket)
+    shared: Any = None
 
     @property
     def tc(self):
@@ -403,7 +410,140 @@ def check_kv_layout(art: ProgramArtifacts) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# 7. HBM fit
+# 7. LoRA adapter sharding
+# ---------------------------------------------------------------------------
+
+def _spec_axes(leaf, dim: int, mesh=None):
+    """EFFECTIVE mesh axes a leaf's PartitionSpec assigns to array dim
+    ``dim`` (as a tuple; () = unsharded). Specs shorter than the array rank
+    leave the trailing dims unsharded (GSPMD trailing rule); size-1 mesh
+    axes shard nothing, so they are dropped — ``("ep", "epx", "tp")`` and
+    ``("tp",)`` agree on a non-MoE mesh and genuinely differ once ep > 1."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    entries = tuple(spec) if spec is not None else ()
+    rank = len(getattr(leaf, "shape", ()))
+    entries = entries + (None,) * max(0, rank - len(entries))
+    e = entries[dim] if dim < len(entries) else None
+    if e is None:
+        return ()
+    axes = tuple(e) if isinstance(e, (tuple, list)) else (e,)
+    if mesh is not None:
+        sizes = dict(mesh.shape)
+        axes = tuple(a for a in axes if sizes.get(a, 1) > 1)
+    return axes
+
+
+def _lora_spec_findings(art: ProgramArtifacts, lc) -> List[Finding]:
+    """The program-independent half of the LoRA audit: adapter A/B buffer
+    PartitionSpecs vs their base projections (see check_lora_sharding)."""
+    ps = art.params_struct
+    layers = ps.get("layers") if isinstance(ps, dict) else None
+    if not isinstance(layers, dict):
+        return [art.finding(
+            "lora_sharding", "params struct unavailable; cannot audit LoRA "
+            "buffer shardings", severity="warning",
+        )]
+    from nxdi_tpu.lora.serving import LORA_TARGETABLE_MODULES
+
+    findings: List[Finding] = []
+    for name in lc.target_modules:
+        group, proj = LORA_TARGETABLE_MODULES[name][0]
+        p = layers.get(group, {}).get(proj)
+        if not isinstance(p, dict) or "lora_A" not in p:
+            continue
+        base = p.get("w", p.get("qw"))
+        if base is None:
+            continue
+        mesh = getattr(art.wrapper, "_mesh", None)
+        rank_w = len(base.shape)
+        in_w = _spec_axes(base, rank_w - 2, mesh)
+        out_w = _spec_axes(base, rank_w - 1, mesh)
+        in_a = _spec_axes(p["lora_A"], 2, mesh)
+        out_b = _spec_axes(p["lora_B"], 3, mesh)
+        rank_axes = _spec_axes(p["lora_A"], 3, mesh) + _spec_axes(
+            p["lora_B"], 2, mesh
+        )
+        if in_a != in_w:
+            findings.append(art.finding(
+                "lora_sharding",
+                f"{group}.{proj}: lora_A shards its in-features dim on axes "
+                f"{in_a or '()'} but the base weight shards on "
+                f"{in_w or '()'} — the adapter delta no longer decomposes "
+                "the sharded projection in place, so GSPMD inserts a "
+                "per-layer gather/reshard",
+            ))
+        if out_b != out_w:
+            findings.append(art.finding(
+                "lora_sharding",
+                f"{group}.{proj}: lora_B shards its out-features dim on axes "
+                f"{out_b or '()'} but the base weight shards on "
+                f"{out_w or '()'} — a replicated adapter next to an "
+                "mp-sharded weight silently all-gathers per layer",
+            ))
+        if rank_axes:
+            findings.append(art.finding(
+                "lora_sharding",
+                f"{group}.{proj}: the LoRA rank dim is sharded on "
+                f"{rank_axes} — the low-rank contraction becomes a per-layer "
+                "cross-shard reduce; keep the rank dim replicated",
+            ))
+    return findings
+
+
+def check_lora_sharding(art: ProgramArtifacts) -> List[Finding]:
+    """LoRA adapter buffers must shard on the SAME mesh axes as the base
+    projections they rank-decompose (lora/serving.py layout: ``lora_A``
+    (L, S, in, r), ``lora_B`` (L, S, r, out) next to a base ``w``/``qw``
+    (L, in, out)):
+
+    - column-parallel base (out dim sharded): ``lora_B``'s out dim must
+      carry the same axes — a replicated ``lora_B`` next to an mp-sharded
+      weight makes GSPMD all-gather the delta (or reshard the activations)
+      EVERY layer;
+    - row-parallel base (in dim sharded): same for ``lora_A``'s in dim;
+    - the rank dim must stay unsharded on both (a sharded contraction dim
+      inserts a per-layer reduce);
+    - ``adapter_ids`` routing must stay batch-replicated: every row's
+      adapter gather happens on every shard, so a sharded id vector would
+      route different adapters on different shards.
+    """
+    lc = getattr(art.tc, "lora_config", None)
+    if lc is None:
+        return []
+    findings: List[Finding] = []
+    # the buffer-spec comparison reads only the audit-wide params struct +
+    # adapter spec layout — program-independent, so run it ONCE per audit
+    # rather than re-emitting identical findings per (submodel, bucket)
+    shared = art.shared
+    run_specs = shared is None or not shared.get("lora_spec_checked")
+    if shared is not None:
+        shared["lora_spec_checked"] = True
+    if run_specs:
+        findings.extend(_lora_spec_findings(art, lc))
+    # adapter_ids routing: the batch input must be fully replicated. Scan
+    # every positional arg for the entry rather than assuming its position —
+    # a reordered aot_compile signature must degrade to "not found", never
+    # to auditing the wrong input. compiled_arg_shardings returns None on
+    # jax releases without the input_shardings view (spec checks above
+    # still ran).
+    from nxdi_tpu.jax_compat import compiled_arg_shardings
+
+    args = compiled_arg_shardings(art.compiled)
+    for arg in args if isinstance(args, (tuple, list)) else ():
+        sh = arg.get("adapter_ids") if isinstance(arg, dict) else None
+        if sh is not None and not getattr(sh, "is_fully_replicated", True):
+            findings.append(art.finding(
+                "lora_sharding",
+                "the 'adapter_ids' batch input is not batch-replicated "
+                f"(compiled sharding {sh}) — shards would gather DIFFERENT "
+                "adapters for the same row",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 8. HBM fit
 # ---------------------------------------------------------------------------
 
 def check_hbm_fit(art: ProgramArtifacts) -> List[Finding]:
@@ -449,5 +589,6 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "baked_constants": check_baked_constants,
     "required_strategies": check_required_strategies,
     "kv_layout": check_kv_layout,
+    "lora_sharding": check_lora_sharding,
     "hbm_fit": check_hbm_fit,
 }

@@ -58,8 +58,9 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
     p.add_argument("--padding-side", default="right", choices=["right", "left"])
     p.add_argument("--allow-input-truncation", action="store_true",
                    help="truncate prompts longer than --max-context-length "
-                        "to their LAST max-context-length tokens instead of "
-                        "raising")
+                        "to their FIRST max-context-length tokens instead of "
+                        "raising (head-keep, matching the reference's "
+                        "negative pad in model_wrapper.py:766)")
 
     # parallelism
     p.add_argument("--tp-degree", type=int, default=1)
@@ -84,7 +85,12 @@ def setup_run_parser(parser: argparse.ArgumentParser) -> None:
                         "MoE layout (reference: moe_tp_degree)")
     p.add_argument("--mlp-cp-degree", type=int, default=1,
                    help="MLP context-parallel degree (prefill MLP sharded "
-                        "over the sequence; subsumed by SP when equal)")
+                        "over the sequence; subsumed by SP when equal). "
+                        "Must equal --tp-degree or 1 — TIGHTER than the "
+                        "reference's divides-tp rule: GSPMD shards S over "
+                        "the whole model-parallel axis, so intermediate "
+                        "degrees (e.g. tp=8 mlp-cp=2) have no mesh sub-axis "
+                        "to land on and are rejected loudly")
     p.add_argument("--moe-dispatch", default="sparse", choices=["sparse", "dense"])
     p.add_argument("--sequence-parallel-enabled", action="store_true")
     p.add_argument("--flash-decoding-enabled", action="store_true")
@@ -413,9 +419,10 @@ def _load_json_arg(arg):
 def _resolve_input_ids(args, max_ctx: int) -> np.ndarray:
     """Tokenize/parse prompts; enforce --max-context-length BEFORE any model
     build so an over-long prompt fails (or truncates) at zero compile cost.
-    Truncation keeps each row's TRAILING real tokens (per row, before the
-    batch right-pad — a columnwise slice of the padded matrix would drop a
-    short row's real tokens and keep its padding)."""
+    Truncation keeps each row's LEADING real tokens, like the reference's
+    head-negative ``F.pad`` (model_wrapper.py:766) — identical commands
+    must produce identical prompts across stacks (applied per row, before
+    the batch right-pad)."""
 
     def truncate_rows(rows):
         lens = [len(r) for r in rows]
@@ -425,9 +432,9 @@ def _resolve_input_ids(args, max_ctx: int) -> np.ndarray:
             raise ValueError(
                 f"prompt length {max(lens)} exceeds max_context_length "
                 f"{max_ctx}; pass --allow-input-truncation to keep each "
-                "prompt's trailing tokens"
+                "prompt's leading tokens"
             )
-        return [r[-max_ctx:] for r in rows]
+        return [r[:max_ctx] for r in rows]
 
     if args.input_ids:
         rows = truncate_rows([list(r) for r in json.loads(args.input_ids)])

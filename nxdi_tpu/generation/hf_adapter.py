@@ -21,7 +21,12 @@ from typing import List, Optional
 import jax
 import numpy as np
 
-from nxdi_tpu.ops.sampling import prepare_sampling_params
+from nxdi_tpu.ops.sampling import (
+    SamplingParams,
+    StepRngSchedule,
+    extract_next_tokens,
+    normalize_eos_ids,
+)
 
 logger = logging.getLogger("nxdi_tpu")
 
@@ -174,9 +179,7 @@ class HuggingFaceGenerationAdapter:
             span.finish()
             return input_ids
 
-        eos_ids = []
-        if eos_token_id is not None:
-            eos_ids = list(np.atleast_1d(eos_token_id).astype(np.int64))
+        eos_ids = normalize_eos_ids(eos_token_id)
 
         odsc = self.tpu_config.on_device_sampling_config
         compiled_do_sample = bool(odsc and odsc.do_sample)
@@ -188,15 +191,19 @@ class HuggingFaceGenerationAdapter:
                 "without on-device sampling (OnDeviceSamplingConfig(do_sample="
                 "True)); falling back to greedy."
             )
-        self._rng_counter = 0
-        self._seed = seed
+        self._rng = StepRngSchedule(seed)
 
-        sampling_params = prepare_sampling_params(
-            B,
-            top_k=[top_k if do_sample else 1],
-            top_p=[top_p],
-            temperature=[temperature],
-        )
+        # ONE sampling-row rule with the serving engine (serving/request.py):
+        # both paths build their (top_k, top_p, temperature) rows through
+        # SamplingParams, so greedy coercion can never diverge between the
+        # static batch adapter and the continuous-batching engine
+        sampling_params = SamplingParams(
+            max_new_tokens=n_new,
+            do_sample=do_sample,
+            top_k=top_k,
+            top_p=top_p,
+            temperature=temperature,
+        ).tensor(B)
 
         lora_kwargs = {}
         if adapter_ids is not None:
@@ -582,15 +589,9 @@ class HuggingFaceGenerationAdapter:
         return gen
 
     def _next_rng(self) -> np.ndarray:
-        """Fresh (seed, counter) threefry key data per step — distinct draws
-        every step, reproducible under a fixed seed."""
-        self._rng_counter += 1
-        return np.array([self._seed, self._rng_counter], dtype=np.uint32)
+        return self._rng.next()
 
     def _next_tokens(self, outputs) -> np.ndarray:
-        """On-device sampled tokens, or host-side greedy from logits when
-        on-device sampling is off (reference keeps both paths too)."""
-        if "tokens" in outputs:
-            return np.asarray(jax.device_get(outputs["tokens"]))[:, 0]
-        logits = np.asarray(jax.device_get(outputs["logits"]))
-        return logits[:, -1, :].argmax(axis=-1).astype(np.int64)
+        # shared with the serving engine (ops/sampling.py): ONE extraction
+        # rule, ONE rng schedule — fixed-seed decode cannot diverge
+        return extract_next_tokens(outputs)

@@ -125,6 +125,16 @@ def compiled_input_formats(compiled):
     return compiled.input_layouts
 
 
+def compiled_arg_shardings(compiled):
+    """Positional-arg sharding pytree of a ``Compiled``
+    (``input_shardings[0]``), or None when the release has no view — used
+    by the lora_sharding checker to prove batch inputs stay replicated."""
+    try:
+        return compiled.input_shardings[0]
+    except Exception:
+        return None
+
+
 # ---------------------------------------------------------------------------
 # program-text access for the static auditor (nxdi_tpu/analysis): the APIs
 # below vary across jax releases, so every difference is absorbed here and

@@ -17,7 +17,8 @@ TPU-native notes:
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -47,6 +48,92 @@ def prepare_sampling_params(
         [bcast(top_k, "top_k"), bcast(top_p, "top_p"), bcast(temperature, "temperature")],
         axis=1,
     )
+
+
+def normalize_eos_ids(eos_token_id) -> List[int]:
+    """int | list | array | None -> list of int eos ids (shared by the HF
+    adapter and the serving engine so both accept the same spellings)."""
+    if eos_token_id is None:
+        return []
+    return [int(e) for e in np.atleast_1d(eos_token_id).astype(np.int64)]
+
+
+@dataclass
+class SamplingParams:
+    """Per-request sampling knobs. ``do_sample=False`` coerces the row to
+    greedy (top_k=1) exactly like the HF adapter's generate path; actual
+    stochastic sampling additionally needs the app compiled with
+    ``OnDeviceSamplingConfig(do_sample=True)``. THE one sampling-row builder:
+    the static generation adapter and the serving engine both encode their
+    ``(top_k, top_p, temperature)`` rows through this class, so greedy
+    coercion can never diverge between the two paths."""
+
+    max_new_tokens: int = 64
+    eos_token_ids: Tuple[int, ...] = ()
+    do_sample: bool = False
+    top_k: int = 1
+    top_p: float = 1.0
+    temperature: float = 1.0
+
+    def __post_init__(self):
+        self.eos_token_ids = tuple(normalize_eos_ids(self.eos_token_ids))
+        if self.max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+
+    def row(self) -> Tuple[float, float, float]:
+        """One (top_k, top_p, temperature) sampling row; greedy unless
+        ``do_sample``."""
+        return (
+            float(self.top_k if self.do_sample else 1),
+            float(self.top_p),
+            float(self.temperature),
+        )
+
+    def tensor(self, batch_size: int) -> np.ndarray:
+        """(B, 3) float32 sampling-params tensor with this row broadcast —
+        what the static adapter dispatches for a whole-batch generate."""
+        k, p, t = self.row()
+        return prepare_sampling_params(
+            batch_size, top_k=[k], top_p=[p], temperature=[t]
+        )
+
+    @staticmethod
+    def rows_tensor(params: Sequence["SamplingParams"]) -> np.ndarray:
+        """(B, 3) tensor with one row per request — the serving engine's
+        batched decode dispatch."""
+        rows = [p.row() for p in params]
+        return prepare_sampling_params(
+            len(rows),
+            top_k=[r[0] for r in rows],
+            top_p=[r[1] for r in rows],
+            temperature=[r[2] for r in rows],
+        )
+
+
+class StepRngSchedule:
+    """Host-side per-dispatch rng key data: fresh ``(seed, counter)`` threefry
+    key every step — distinct draws each dispatch, reproducible under a fixed
+    seed. THE one schedule shared by the static generation adapter and the
+    serving engine, so fixed-seed sampled decode cannot diverge between them."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self.counter = 0
+
+    def next(self) -> np.ndarray:
+        self.counter += 1
+        return np.array([self.seed, self.counter], dtype=np.uint32)
+
+
+def extract_next_tokens(outputs) -> np.ndarray:
+    """(B,) next tokens of a forward's outputs: on-device sampled ``tokens``
+    when compiled with on-device sampling, host-side greedy argmax from
+    ``logits`` otherwise (the reference keeps both paths too). THE one
+    extraction rule shared by the static adapter and the serving engine."""
+    if "tokens" in outputs:
+        return np.asarray(jax.device_get(outputs["tokens"]))[:, 0]
+    logits = np.asarray(jax.device_get(outputs["logits"]))
+    return logits[:, -1, :].argmax(axis=-1).astype(np.int64)
 
 
 def next_step_rng(rng: jax.Array) -> jax.Array:

@@ -45,6 +45,13 @@ class BlockSpaceManager:
         tel.kv_blocks_free.set(len(self._free))
         tel.kv_blocks_used.set(self.num_blocks - len(self._free))
 
+    def blocks_needed(self, seq_id: int, num_tokens: int) -> int:
+        """NEW blocks ``ensure_capacity(seq_id, num_tokens)`` would have to
+        allocate beyond what the sequence already holds — the serving
+        scheduler's admission/watermark arithmetic."""
+        have = len(self._tables.get(seq_id, ()))
+        return max(0, -(-num_tokens // self.block_size) - have)
+
     def ensure_capacity(self, seq_id: int, num_tokens: int) -> List[int]:
         """Grow seq_id's table to cover ``num_tokens`` positions; returns the
         table. Raises if the pool is exhausted (caller preempts/evicts)."""

@@ -1,0 +1,51 @@
+"""Continuous-batching serving engine (host-side).
+
+The reference stack delegates serving to vLLM — NxDI only consumes block
+tables and seq_ids. This package supplies that missing layer natively:
+
+- :mod:`~nxdi_tpu.serving.request` — ``Request`` / ``SamplingParams`` /
+  ``RequestOutput`` with a WAITING -> RUNNING -> (PREEMPTED ->) FINISHED
+  lifecycle and per-token streaming callbacks.
+- :mod:`~nxdi_tpu.serving.scheduler` — slot scheduler: FCFS admission under
+  a free-KV-block watermark, decode/prefill interleave policy, chunked-
+  prefill admission, recompute-style preemption on pool exhaustion.
+- :mod:`~nxdi_tpu.serving.engine` — ``InferenceEngine.step()``: seq-id /
+  block-table routed prefill into free slots, one batched decode per step
+  (``tkg_multistep`` windows when no slot is near finishing), retirement
+  and slot recycling.
+
+Demo: ``python -m nxdi_tpu.cli.serve`` (Poisson arrivals over the paged
+tiny-llama reference app). Correctness anchor: greedy engine outputs are
+token-identical to per-prompt static ``generate``, including across a
+forced preemption (tests/integration/test_serving_engine.py).
+"""
+
+from nxdi_tpu.serving.engine import InferenceEngine
+from nxdi_tpu.serving.request import (
+    FINISHED,
+    PREEMPTED,
+    RUNNING,
+    WAITING,
+    Request,
+    RequestOutput,
+    SamplingParams,
+    normalize_eos_ids,
+)
+from nxdi_tpu.serving.scheduler import Scheduler, SchedulerConfig
+from nxdi_tpu.serving.workload import drive_arrivals, goodput_summary
+
+__all__ = [
+    "InferenceEngine",
+    "drive_arrivals",
+    "goodput_summary",
+    "Request",
+    "RequestOutput",
+    "SamplingParams",
+    "Scheduler",
+    "SchedulerConfig",
+    "normalize_eos_ids",
+    "WAITING",
+    "RUNNING",
+    "PREEMPTED",
+    "FINISHED",
+]

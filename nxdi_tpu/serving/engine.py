@@ -1,0 +1,484 @@
+"""Continuous-batching inference engine over the compiled program ladder.
+
+``InferenceEngine.step()`` is one scheduler iteration over the app's
+fixed-shape AOT programs — the host-side loop that turns them into a
+streaming multi-tenant server (the role vLLM plays for the reference
+stack):
+
+1. **Prefill** admitted requests into free slots: one CTE dispatch per
+   request (``ctx_batch_size`` rows; batch padding repeats row 0, whose
+   duplicate KV writes are idempotent). Under ``chunked_prefill_config``
+   a long prompt prefills ``chunk_size`` tokens per step through the
+   prefix-prefill submodel, interleaving with other slots' decodes.
+2. **Decode** every running slot in ONE batched TKG dispatch — rows carry
+   their own positions and block tables / seq_ids, so a newly prefilled
+   neighbor never disturbs an in-flight row (the continuous-batching
+   property the integration tests pin token-for-token against per-prompt
+   static ``generate``).
+   With ``decode_steps_per_dispatch > 1`` compiled (contiguous layout),
+   the engine dispatches a ``tkg_multistep`` window whenever no slot is
+   within K tokens of its budget — in-scan EOS masking keeps mid-window
+   finishes exact, and the rung choice guarantees fused steps never
+   overshoot ``max_new_tokens``.
+3. **Retire** finished slots (EOS / length): blocks freed, slot recycled
+   for the next admission (the new request overwrites the line from
+   position 0, so a dirty slot is safe by construction).
+
+Preemption: when the paged pool cannot grow a running decode, the
+scheduler evicts the youngest request back to WAITING (blocks freed); on
+re-admission the engine re-prefills ``prompt + generated`` and the CTE's
+sampled token is simply the next new token — token-exact under greedy
+sampling (asserted across a forced preemption in the integration tests).
+
+Telemetry rides the app's existing registry: ``nxdi_serve_queue_depth`` /
+``nxdi_serve_slots_busy`` gauges, ``nxdi_serve_preemptions_total``
+counter, and one request span per request covering
+queue -> prefill -> decode with TTFT measured from arrival (under load it
+includes queueing, as a serving TTFT should).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from nxdi_tpu.runtime.application import TAG_PREFIX_PREFILL
+from nxdi_tpu.runtime.block_manager import BlockSpaceManager
+from nxdi_tpu.runtime.model_wrapper import (
+    MULTISTEP_EOS_SLOTS,
+    TAG_CONTEXT_ENCODING,
+    TAG_TOKEN_GENERATION,
+    TAG_TOKEN_GENERATION_MULTISTEP,
+    decode_window_limit,
+)
+from nxdi_tpu.ops.sampling import StepRngSchedule, extract_next_tokens
+from nxdi_tpu.serving.request import Request, RequestOutput, SamplingParams
+from nxdi_tpu.serving.scheduler import Scheduler, SchedulerConfig
+
+logger = logging.getLogger("nxdi_tpu")
+
+
+class InferenceEngine:
+    """Host-side continuous-batching engine over a LOADED application.
+
+    Supported KV layouts:
+
+    - **paged** (``is_block_kv_layout``): slots are decode batch rows; a
+      :class:`BlockSpaceManager` owns the pool, admission respects the
+      free-block watermark, preemption on exhaustion.
+    - **contiguous continuous batching** (``is_continuous_batching``): the
+      slot index IS the ``seq_ids`` cache line; admission is slot-bounded
+      (every line holds a full ``seq_len``, so decode growth cannot fail).
+    """
+
+    def __init__(
+        self,
+        app,
+        scheduler_config: Optional[SchedulerConfig] = None,
+        seed: int = 0,
+    ):
+        if not getattr(app, "is_loaded", False):
+            raise RuntimeError("InferenceEngine needs a loaded application")
+        self.app = app
+        tc = app.tpu_config
+        self.tpu_config = tc
+        if tc.on_device_sampling_config is None and not tc.output_logits:
+            raise ValueError(
+                "the engine needs token outputs: compile with "
+                "on_device_sampling_config (or output_logits=True for host "
+                "argmax)"
+            )
+        self.paged = bool(tc.is_block_kv_layout)
+        if not self.paged and not tc.is_continuous_batching:
+            raise ValueError(
+                "InferenceEngine drives the paged (is_block_kv_layout) or "
+                "continuous-batching (is_continuous_batching) layouts; the "
+                "static single-batch layout has no per-request cache routing "
+                "— use HuggingFaceGenerationAdapter.generate instead"
+            )
+        self.telemetry = getattr(app, "telemetry", None)
+        tel = self.telemetry if (self.telemetry and self.telemetry.enabled) else None
+
+        # work on a copy: the resolved chunk_size below must not mutate a
+        # caller-owned config (the Scheduler re-copies for the same reason)
+        cfg = (
+            dataclasses.replace(scheduler_config)
+            if scheduler_config is not None
+            else SchedulerConfig()
+        )
+        num_slots = (
+            cfg.num_slots if cfg.num_slots is not None else tc.tkg_batch_size
+        )
+        if num_slots > tc.tkg_batch_size:
+            raise ValueError(
+                f"num_slots ({num_slots}) cannot exceed the compiled decode "
+                f"batch (tkg_batch_size={tc.tkg_batch_size})"
+            )
+        if not self.paged:
+            lines = tc.kv_cache_batch_size + tc.kv_cache_padding_size
+            if num_slots > lines:
+                raise ValueError(
+                    f"num_slots ({num_slots}) cannot exceed the KV cache "
+                    f"lines (kv_cache_batch_size + kv_cache_padding_size = "
+                    f"{lines})"
+                )
+        self.block_manager = (
+            BlockSpaceManager(tc.pa_num_blocks, tc.pa_block_size, telemetry=tel)
+            if self.paged
+            else None
+        )
+        if cfg.chunk_size is None and tc.chunked_prefill_config is not None:
+            cfg.chunk_size = tc.chunked_prefill_config.chunk_size
+        if cfg.chunk_size is not None and TAG_PREFIX_PREFILL not in app.models:
+            # without a continuation submodel every multi-chunk prompt would
+            # error-finish at its second chunk — even ones a single ordinary
+            # CTE pass could have served; fail the misconfiguration loudly
+            # at construction instead
+            raise ValueError(
+                f"chunk_size ({cfg.chunk_size}) needs a prefix-prefill "
+                "submodel to continue chunks; compile the app with "
+                "chunked_prefill_config (or is_prefix_caching)"
+            )
+        self.scheduler = Scheduler(
+            num_slots, block_manager=self.block_manager, config=cfg, telemetry=tel
+        )
+        self.window_limit = decode_window_limit(tc, app.models)
+        self._table_width = (
+            -(-tc.seq_len // tc.pa_block_size) if self.paged else 0
+        )
+        self._rng = StepRngSchedule(seed)
+        self._tkg = app.models[TAG_TOKEN_GENERATION]
+        self._can_continue_prefill = TAG_PREFIX_PREFILL in app.models
+        self._progress = False
+
+    # -- request intake -----------------------------------------------------
+    def add_request(
+        self,
+        prompt: Sequence[int],
+        params: Optional[SamplingParams] = None,
+        on_token=None,
+        request_id: Optional[int] = None,
+        arrival_s: Optional[float] = None,
+    ) -> Request:
+        """Queue a request (WAITING). ``on_token(request, token)`` streams
+        every generated token as it is sampled. ``arrival_s`` backdates the
+        request's arrival for TTFT — it must be in the telemetry ``clock``
+        domain (``time.perf_counter`` under the default clock)."""
+        tel = self.telemetry
+        if arrival_s is None and tel is not None and tel.enabled:
+            # stamp arrival through the telemetry clock, not a hardcoded
+            # perf_counter: under an injected clock the span's t_start must
+            # share the domain first_token() subtracts it from
+            arrival_s = tel.clock()
+        req = Request(
+            prompt, params=params, request_id=request_id, on_token=on_token,
+            arrival_s=arrival_s,
+        )
+        # ids key the block tables: two LIVE requests sharing one would
+        # decode through the same blocks (silent KV corruption) and
+        # double-free on retirement. A user-supplied collision is rejected;
+        # the auto counter catching up to a live user-chosen id just redraws
+        # (that caller never asked for a specific id)
+        live_ids = {r.request_id for r in self.scheduler.waiting}
+        live_ids.update(r.request_id for r in self.scheduler.running())
+        if request_id is None:
+            while req.request_id in live_ids:
+                req.request_id = next(Request._ids)
+        elif req.request_id in live_ids:
+            raise ValueError(
+                f"request_id {req.request_id} is already live in the engine"
+            )
+        tc = self.tpu_config
+        if len(req.prompt) >= self.window_limit:
+            raise ValueError(
+                f"prompt length {len(req.prompt)} leaves no decode room "
+                f"inside the compiled window ({self.window_limit})"
+            )
+        if (
+            len(req.prompt) > tc.max_context_length
+            and self.scheduler.config.chunk_size is None
+        ):
+            raise ValueError(
+                f"prompt length {len(req.prompt)} exceeds max_context_length "
+                f"{tc.max_context_length} and chunked prefill is not "
+                "configured (chunked_prefill_config)"
+            )
+        # clamp the budget to the compiled window, like the static adapter's
+        # max_length = min(max_length, seq_len) — parity demands one rule
+        budget = self.window_limit - len(req.prompt)
+        if req.params.max_new_tokens > budget:
+            req.params = dataclasses.replace(req.params, max_new_tokens=budget)
+        if self.block_manager is not None:
+            # reject up front what the pool can never hold even running
+            # alone — otherwise the request livelocks through self-preempt/
+            # resume cycles until the scheduler's never-fits guard trips and
+            # takes the whole engine (and its neighbors) down with it
+            bs = self.block_manager.block_size
+            final = len(req.prompt) + req.params.max_new_tokens
+            needed = -(-final // bs)
+            if needed > self.block_manager.num_blocks:
+                raise ValueError(
+                    f"request needs {needed} KV blocks at its full length "
+                    f"({final} tokens) but the pool holds "
+                    f"{self.block_manager.num_blocks}; raise pa_num_blocks, "
+                    "shorten the prompt, or lower max_new_tokens"
+                )
+        if tel is not None and tel.enabled:
+            # backdate to the request's ARRIVAL: a driver submitting between
+            # engine steps must not shave that wait off the reported TTFT
+            req.span = tel.start_request(
+                tokens_in=len(req.prompt), t_start=req.arrival_s
+            )
+            req.span.phase("queue")
+        self.scheduler.add(req)
+        return req
+
+    # -- the engine loop ----------------------------------------------------
+    def has_work(self) -> bool:
+        return self.scheduler.has_work()
+
+    def step(self) -> List[RequestOutput]:
+        """One engine iteration: prefill work, then one batched decode.
+        Returns the requests that FINISHED during this step."""
+        finished: List[RequestOutput] = []
+        preempted: List[Request] = []
+        prefills = self.scheduler.schedule_prefills()
+        for req in prefills:
+            self._prefill_chunk(req, finished)
+        rows = self.scheduler.decodable()
+        if rows:
+            rows, preempted = self.scheduler.ensure_decode_capacity(rows)
+            for victim in preempted:
+                logger.info(
+                    "preempted request %d (recompute on re-admission)",
+                    victim.request_id,
+                )
+        if rows:
+            steps = self._choose_steps(rows)
+            if steps > 1:
+                self._decode_multistep(rows, steps, finished)
+            else:
+                self._decode_single(rows, finished)
+        # a preemption-only step still made progress (the freed blocks are
+        # what lets the NEXT step admit) — only a true no-op step may trip
+        # the stall guard in run()
+        self._progress = bool(prefills) or bool(rows) or bool(preempted)
+        self.scheduler.publish()
+        return finished
+
+    def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
+        """Step until every queued request finishes; returns all outputs."""
+        outputs: List[RequestOutput] = []
+        n = 0
+        while self.has_work():
+            if max_steps is not None and n >= max_steps:
+                break
+            outputs.extend(self.step())
+            n += 1
+            if not self._progress and self.has_work():
+                raise RuntimeError(
+                    "scheduler stalled: requests waiting but nothing "
+                    "admissible or decodable (KV pool too small for the "
+                    "queued work?)"
+                )
+        return outputs
+
+    # -- prefill ------------------------------------------------------------
+    def _prefill_chunk(self, req: Request, finished: List[RequestOutput]) -> None:
+        seq = req.seq_tokens[: req.prefill_target]
+        start = req.num_prefilled
+        limit = self.scheduler.config.chunk_size or self.tpu_config.max_context_length
+        if len(seq) > limit and not self._can_continue_prefill:
+            # a preempted request's prompt+generated replay outgrew the one
+            # CTE pass and no prefix/chunked submodel is compiled to continue
+            # it — fail THIS request (before dispatching a truncated, wrong-
+            # content prefill), not the engine: its neighbors keep serving
+            logger.warning(
+                "request %d cannot resume: its %d-token re-prefill exceeds "
+                "max_context_length %d and no prefix-prefill submodel is "
+                "compiled (enable chunked_prefill_config or is_prefix_caching)",
+                req.request_id, len(seq), self.tpu_config.max_context_length,
+            )
+            self._finish(req, "error", finished)
+            return
+        chunk = seq[start : start + limit]
+        n = len(chunk)
+        ids = np.asarray([chunk], dtype=np.int32)
+        pos = (start + np.arange(n, dtype=np.int32))[None, :]
+        kwargs = self._layout_kwargs([(req.slot, req)])
+        self._maybe_rng(kwargs)
+        submodel = TAG_CONTEXT_ENCODING if start == 0 else TAG_PREFIX_PREFILL
+        out = self.app.forward(
+            ids,
+            pos,
+            last_token_index=np.array([n - 1], dtype=np.int32),
+            sampling_params=req.params.tensor(1),
+            submodel=submodel,
+            **kwargs,
+        )
+        req.num_prefilled += n
+        if not req.prefill_done:
+            return  # more chunks next step; decodes interleave meanwhile
+        tok = int(self._tokens_of(out)[0])
+        if req.span is not None:
+            req.span.first_token()  # idempotent: a resume keeps the original
+            req.span.phase("decode")
+            req.span.tokens(1)
+        req.emit(tok)
+        reason = req.check_finish()
+        if reason:
+            self._finish(req, reason, finished)
+
+    # -- decode -------------------------------------------------------------
+    def _choose_steps(self, rows: List[Tuple[int, Request]]) -> int:
+        """Largest compiled multistep rung no slot can overshoot: every row
+        must have >= rung tokens of budget left AND the window's last write
+        must stay inside the compiled decode window. Rows near EOS cannot be
+        predicted — in-scan EOS masking keeps them exact — but rows near
+        ``max_new_tokens`` force the fallback to single-step dispatches."""
+        if not getattr(self.app, "multistep_supported", False):
+            return 1
+        if any(
+            len(r.params.eos_token_ids) > MULTISTEP_EOS_SLOTS for _, r in rows
+        ):
+            return 1
+        w = self.app.models[TAG_TOKEN_GENERATION_MULTISTEP]
+        min_rem = min(r.remaining for _, r in rows)
+        max_len = max(r.total_len for _, r in rows)
+        rungs = [
+            s
+            for s in w.steps_ladder
+            if s <= min_rem and max_len + s <= self.window_limit + 1
+        ]
+        return max(rungs) if rungs else 1
+
+    def _layout_kwargs(
+        self, rows: List[Tuple[int, Request]]
+    ) -> Dict[str, np.ndarray]:
+        if self.paged:
+            bt = np.stack(
+                [
+                    self.block_manager.block_table(r.request_id, self._table_width)
+                    for _, r in rows
+                ]
+            )
+            return {"block_table": bt}
+        return {"seq_ids": np.array([slot for slot, _ in rows], dtype=np.int32)}
+
+    def _maybe_rng(self, kwargs: Dict[str, np.ndarray]) -> None:
+        if self._tkg.needs_rng:
+            kwargs["rng"] = self._rng.next()
+
+    def _decode_single(
+        self, rows: List[Tuple[int, Request]], finished: List[RequestOutput]
+    ) -> None:
+        B = len(rows)
+        ids = np.array([[r.generated[-1]] for _, r in rows], dtype=np.int32)
+        pos = np.array([[r.total_len - 1] for _, r in rows], dtype=np.int32)
+        kwargs = self._layout_kwargs(rows)
+        self._maybe_rng(kwargs)
+        clock = self.telemetry.clock if self.telemetry is not None else None
+        t0 = clock() if clock else 0.0
+        out = self.app.forward(
+            ids,
+            pos,
+            last_token_index=np.zeros((B,), dtype=np.int32),
+            sampling_params=SamplingParams.rows_tensor([r.params for _, r in rows]),
+            submodel=TAG_TOKEN_GENERATION,
+            **kwargs,
+        )
+        toks = self._tokens_of(out)
+        dt = (clock() - t0) if clock else None
+        for (slot, req), tok in zip(rows, toks):
+            if req.span is not None:
+                req.span.tokens(1, dt)
+            req.emit(int(tok))
+            reason = req.check_finish()
+            if reason:
+                self._finish(req, reason, finished)
+
+    def _decode_multistep(
+        self,
+        rows: List[Tuple[int, Request]],
+        steps: int,
+        finished: List[RequestOutput],
+    ) -> None:
+        B = len(rows)
+        eos = np.full((B, MULTISTEP_EOS_SLOTS), -1, dtype=np.int32)
+        for i, (_, r) in enumerate(rows):
+            for j, e in enumerate(r.params.eos_token_ids):
+                eos[i, j] = e
+        batch = {
+            "input_ids": np.array(
+                [[r.generated[-1]] for _, r in rows], dtype=np.int32
+            ),
+            "position_ids": np.array(
+                [[r.total_len - 1] for _, r in rows], dtype=np.int32
+            ),
+            "last_token_index": np.zeros((B,), dtype=np.int32),
+            "sampling_params": SamplingParams.rows_tensor(
+                [r.params for _, r in rows]
+            ),
+            "eos_token_ids": eos,
+            "pad_token_id": np.zeros((B,), dtype=np.int32),
+            "decode_steps": steps,
+        }
+        batch.update(self._layout_kwargs(rows))
+        self._maybe_rng(batch)
+        clock = self.telemetry.clock if self.telemetry is not None else None
+        t0 = clock() if clock else 0.0
+        out = self.app.token_gen_multistep(batch)
+        toks = np.asarray(jax.device_get(out["tokens"]))[:B]  # (B, steps)
+        dt = (clock() - t0) if clock else None
+        for i, (slot, req) in enumerate(rows):
+            emitted = 0
+            for j in range(steps):
+                req.emit(int(toks[i, j]))
+                emitted += 1
+                reason = req.check_finish()
+                if reason:
+                    # later in-window tokens for this row are pad-masked by
+                    # the in-scan EOS logic; discard them
+                    self._finish(req, reason, finished)
+                    break
+            if req.span is not None and emitted:
+                req.span.tokens(emitted, dt if dt is None else dt * emitted / steps)
+
+    # -- retirement ---------------------------------------------------------
+    def _finish(
+        self, req: Request, reason: str, finished: List[RequestOutput]
+    ) -> None:
+        self.scheduler.retire(req, reason)
+        metrics: Dict[str, float] = {"preemptions": req.preemptions}
+        if req.span is not None:
+            req.span.finish()
+            metrics["ttft_s"] = req.span.ttft_s
+            metrics["e2e_s"] = req.span.t_end - req.span.t_start
+            n_dec = max(len(req.generated) - 1, 0)
+            if n_dec and req.span.ttft_s is not None:
+                metrics["tpot_s"] = (
+                    metrics["e2e_s"] - req.span.ttft_s
+                ) / n_dec
+        finished.append(
+            RequestOutput(
+                request_id=req.request_id,
+                prompt=list(req.prompt),
+                token_ids=list(req.generated),
+                finish_reason=reason,
+                metrics=metrics,
+            )
+        )
+
+    # -- helpers ------------------------------------------------------------
+    def _tokens_of(self, outputs) -> np.ndarray:
+        # shared with the HF adapter (ops/sampling.py): ONE extraction rule,
+        # ONE rng schedule — the greedy-parity anchor depends on it
+        return extract_next_tokens(outputs)
+
+    def preempt_youngest(self) -> Optional[Request]:
+        """Force one recompute-style preemption (tests / demos)."""
+        return self.scheduler.preempt_youngest()

@@ -1,0 +1,280 @@
+"""Slot scheduler for the continuous-batching engine.
+
+Host-side bookkeeping only — no dispatches happen here. The scheduler owns
+
+- the FCFS **waiting queue** (preempted requests re-enter at the FRONT so a
+  victim resumes as soon as capacity returns),
+- the **slot table**: one slot per row of the token-generation batch bucket
+  (``tkg_batch_size``). A slot is the engine's unit of residency — for the
+  contiguous continuous-batching layout the slot index IS the ``seq_id``
+  cache line; for the paged layout a slot just names a decode batch row and
+  the request's identity lives in its block table.
+- the **paged-KV admission policy**: a request is admitted when a slot is
+  free AND the pool keeps ``watermark_blocks`` free blocks after its
+  (re)prefill allocation — the watermark is what guarantees running decodes
+  can always grow a little before preemption kicks in (vLLM's watermark,
+  block_manager semantics).
+- **recompute-style preemption**: when a running decode cannot grow
+  (pool exhausted even past the watermark), the YOUNGEST running request is
+  evicted back to WAITING — its blocks are freed and the whole
+  ``prompt + generated`` sequence re-prefills on re-admission (exact under
+  greedy sampling; token parity is asserted in the integration tests).
+
+Interleave policy (``SchedulerConfig.interleave``):
+
+- ``"prefill_first"`` (default, continuous batching): admit up to
+  ``max_prefills_per_step`` waiting requests every step, even while other
+  slots decode — lowest TTFT, one prefill's latency added to that step's
+  decode (the classic in-flight batching tradeoff).
+- ``"decode_first"``: only admit when nothing is decodable — drains the
+  running batch before taking new work (batch-oriented; better TPOT, worse
+  TTFT).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, List, Optional, Tuple
+
+from nxdi_tpu.serving.request import (
+    FINISHED,
+    PREEMPTED,
+    RUNNING,
+    WAITING,
+    Request,
+)
+
+INTERLEAVE_POLICIES = ("prefill_first", "decode_first")
+
+
+@dataclass
+class SchedulerConfig:
+    #: engine slots; None = the app's tkg_batch_size
+    num_slots: Optional[int] = None
+    #: free blocks the paged pool must retain after an admission; None =
+    #: max(1, num_blocks // 100) (vLLM's 1% watermark, floored at one block)
+    watermark_blocks: Optional[int] = None
+    max_prefills_per_step: int = 1
+    interleave: str = "prefill_first"
+    #: prompt tokens prefilled per step; None = whole prompt in one dispatch
+    #: (set from chunked_prefill_config.chunk_size by the engine)
+    chunk_size: Optional[int] = None
+
+    def __post_init__(self):
+        if self.interleave not in INTERLEAVE_POLICIES:
+            raise ValueError(
+                f"interleave must be one of {INTERLEAVE_POLICIES}, "
+                f"got {self.interleave!r}"
+            )
+        if self.max_prefills_per_step < 1:
+            raise ValueError("max_prefills_per_step must be >= 1")
+
+
+class Scheduler:
+    """Slot/admission/preemption bookkeeping over an optional
+    :class:`~nxdi_tpu.runtime.block_manager.BlockSpaceManager` (paged
+    layout) — with ``block_manager=None`` (contiguous seq-id layout)
+    admission is slot-bounded only and growth never fails."""
+
+    def __init__(
+        self,
+        num_slots: int,
+        block_manager=None,
+        config: Optional[SchedulerConfig] = None,
+        telemetry=None,
+    ):
+        if num_slots < 1:
+            raise ValueError("num_slots must be >= 1")
+        # private copy: derived values (watermark default, engine-resolved
+        # chunk_size) must not leak into a caller-owned config reused for
+        # another engine over a differently-sized pool
+        self.config = (
+            dataclasses.replace(config) if config is not None else SchedulerConfig()
+        )
+        self.num_slots = num_slots
+        self.block_manager = block_manager
+        self.telemetry = telemetry
+        self.waiting: Deque[Request] = deque()
+        self.slots: List[Optional[Request]] = [None] * num_slots
+        self._admit_counter = 0
+        if block_manager is not None and self.config.watermark_blocks is None:
+            self.config.watermark_blocks = max(1, block_manager.num_blocks // 100)
+
+    # -- views --------------------------------------------------------------
+    @property
+    def queue_depth(self) -> int:
+        return len(self.waiting)
+
+    @property
+    def slots_busy(self) -> int:
+        return sum(1 for r in self.slots if r is not None)
+
+    def running(self) -> List[Request]:
+        return [r for r in self.slots if r is not None]
+
+    def decodable(self) -> List[Tuple[int, Request]]:
+        """(slot, request) rows ready for a batched decode step: prefill
+        complete (first token already sampled) and not finished."""
+        return [
+            (i, r)
+            for i, r in enumerate(self.slots)
+            if r is not None and r.prefill_done and not r.is_finished
+        ]
+
+    def has_work(self) -> bool:
+        return bool(self.waiting) or self.slots_busy > 0
+
+    # -- block math ---------------------------------------------------------
+    def _blocks_needed(self, req: Request, num_tokens: int) -> int:
+        mgr = self.block_manager
+        return mgr.blocks_needed(req.request_id, num_tokens)
+
+    def _admissible(self, req: Request) -> bool:
+        mgr = self.block_manager
+        if mgr is None:
+            return True
+        needed = self._blocks_needed(req, len(req.seq_tokens))
+        if needed > mgr.num_blocks:
+            raise RuntimeError(
+                f"request {req.request_id} needs {needed} KV blocks but the "
+                f"pool only has {mgr.num_blocks} in total — it can never be "
+                "scheduled; raise pa_num_blocks or shorten the prompt"
+            )
+        free_after = mgr.num_free_blocks() - needed
+        if self.slots_busy == 0:
+            # nothing is decoding, so nothing needs the growth headroom: a
+            # lone request may dip below the watermark rather than deadlock
+            return free_after >= 0
+        return free_after >= self.config.watermark_blocks
+
+    # -- queue / admission --------------------------------------------------
+    def add(self, req: Request) -> None:
+        req.state = WAITING
+        self.waiting.append(req)
+        self.publish()
+
+    def schedule_prefills(self) -> List[Request]:
+        """RUNNING requests with prefill work this step: in-flight chunked
+        prefills first (they always continue), then new FCFS admissions per
+        the interleave policy and the block watermark. Head-of-line blocking
+        is intentional — admission stays strictly FCFS."""
+        out = [r for r in self.slots if r is not None and not r.prefill_done]
+        admitted = 0
+        while (
+            self.waiting
+            and admitted < self.config.max_prefills_per_step
+            and not (self.config.interleave == "decode_first" and self.decodable())
+        ):
+            slot = self._free_slot()
+            if slot is None:
+                break
+            req = self.waiting[0]
+            if not self._admissible(req):
+                break
+            self.waiting.popleft()
+            self._place(req, slot)
+            out.append(req)
+            admitted += 1
+        self.publish()
+        return out
+
+    def _free_slot(self) -> Optional[int]:
+        for i, r in enumerate(self.slots):
+            if r is None:
+                return i
+        return None
+
+    def _place(self, req: Request, slot: int) -> None:
+        req.slot = slot
+        req.state = RUNNING
+        req.num_prefilled = 0
+        req.prefill_target = len(req.seq_tokens)
+        self._admit_counter += 1
+        req._admit_seq = self._admit_counter
+        if self.block_manager is not None:
+            # covers the whole (re)prefill; decode growth is incremental
+            self.block_manager.ensure_capacity(req.request_id, len(req.seq_tokens))
+        if req.span is not None:
+            req.span.phase("prefill")
+        self.slots[slot] = req
+
+    # -- decode growth / preemption ----------------------------------------
+    def ensure_decode_capacity(
+        self, rows: List[Tuple[int, Request]]
+    ) -> Tuple[List[Tuple[int, Request]], List[Request]]:
+        """Grow each row's block table to cover its next KV write (the fed
+        token's position = ``total_len - 1``). On pool exhaustion the
+        YOUNGEST running request is preempted (possibly a row in ``rows``,
+        possibly the grower itself) and growth retries — oldest requests are
+        processed first, so they always win the remaining blocks."""
+        preempted: List[Request] = []
+        if self.block_manager is None:
+            return list(rows), preempted
+        kept: List[Tuple[int, Request]] = []
+        for slot, req in sorted(rows, key=lambda sr: sr[1]._admit_seq):
+            while req.state == RUNNING:  # may flip if evicted as a victim
+                try:
+                    self.block_manager.ensure_capacity(req.request_id, req.total_len)
+                    kept.append((slot, req))
+                    break
+                except RuntimeError:
+                    victim = self.preempt_youngest()
+                    if victim is not None:
+                        preempted.append(victim)
+                    if victim is None or victim is req:
+                        break  # req itself evicted (or nothing left to evict)
+        # keep the original slot order for dispatch determinism
+        kept.sort(key=lambda sr: sr[0])
+        self.publish()
+        return kept, preempted
+
+    def preempt_youngest(self) -> Optional[Request]:
+        """Evict the youngest RUNNING request back to the FRONT of the
+        waiting queue, freeing its blocks (recompute-style preemption)."""
+        running = self.running()
+        if not running:
+            return None
+        victim = max(running, key=lambda r: r._admit_seq)
+        self._preempt(victim)
+        return victim
+
+    def _preempt(self, req: Request) -> None:
+        assert req.slot is not None
+        self.slots[req.slot] = None
+        req.slot = None
+        req.state = PREEMPTED
+        req.num_prefilled = 0
+        req.prefill_target = 0
+        req.preemptions += 1
+        if self.block_manager is not None:
+            self.block_manager.free_seq(req.request_id)
+        if req.span is not None:
+            req.span.phase("queue")
+        self.waiting.appendleft(req)
+        if self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.serve_preemptions_total.inc()
+        self.publish()
+
+    # -- retirement ---------------------------------------------------------
+    def retire(self, req: Request, reason: str) -> None:
+        """Finish a request: free its KV space and recycle the slot without
+        disturbing in-flight neighbors (the slot simply goes empty; the next
+        admission overwrites the line/blocks from position 0)."""
+        if req.slot is not None:
+            self.slots[req.slot] = None
+            req.slot = None
+        if self.block_manager is not None:
+            self.block_manager.free_seq(req.request_id)
+        req.state = FINISHED
+        req.finish_reason = reason
+        self.publish()
+
+    # -- telemetry ----------------------------------------------------------
+    def publish(self) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        tel.serve_queue_depth.set(self.queue_depth)
+        tel.serve_slots_busy.set(self.slots_busy)

@@ -1,0 +1,114 @@
+"""Open-loop arrival driver shared by the serving demo and the bench.
+
+One implementation of the wall-clock arrival loop (submit every request
+whose arrival offset has passed, step the engine, idle-sleep only when
+nothing is runnable) AND of the goodput arithmetic over the finished
+outputs, so ``python -m nxdi_tpu.cli.serve`` and ``bench.py --serving``
+measure the SAME driver with the SAME statistics — a fix to either can
+never apply to one consumer and not the other.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from nxdi_tpu.serving.request import RequestOutput
+
+
+def drive_arrivals(
+    engine,
+    arrivals: Sequence[float],
+    submit: Callable[[object, int, float], None],
+    before_step: Optional[Callable[[object], None]] = None,
+    after_step: Optional[Callable[[object], None]] = None,
+) -> Tuple[List[RequestOutput], float]:
+    """Drive an open-loop workload to completion.
+
+    ``arrivals`` — sorted arrival offsets in seconds from the loop start
+    (e.g. ``np.cumsum(rng.exponential(1/rate, n))`` for a Poisson process);
+    ``submit(engine, i, arrival_s)`` — add request ``i`` (called once its
+    offset has passed). ``arrival_s`` is the request's TRUE arrival time in
+    the engine's telemetry ``clock`` domain (``time.perf_counter`` under the
+    default clock) — pass it to ``add_request(arrival_s=)`` so TTFT counts
+    from arrival even when submission lagged behind a long engine step (an
+    open-loop driver must charge that wait to the server).
+    ``before_step``/``after_step`` — per-iteration hooks (forced preemption,
+    peak-occupancy metric captures, ...).
+
+    Returns ``(outputs, wall_seconds)`` with every request finished.
+    """
+    # arrival timestamps must live in the SAME domain the request spans
+    # subtract them from — the telemetry clock. An INJECTED clock cannot
+    # pace this wall-clock loop (a frozen clock would hang it forever
+    # waiting for arrivals[0]): refuse loudly; deterministic tests should
+    # drive engine.step() directly instead
+    tel = getattr(engine, "telemetry", None)
+    clock = time.perf_counter
+    if tel is not None and getattr(tel, "enabled", False):
+        if tel.clock is not time.perf_counter:
+            raise ValueError(
+                "drive_arrivals paces arrivals on wall-clock time and the "
+                "engine's telemetry uses an injected clock — TTFT stamps "
+                "would mix clock domains and a non-advancing clock would "
+                "hang the loop; use the default telemetry clock here, or "
+                "drive engine.step() directly in deterministic tests"
+            )
+        clock = tel.clock
+    outputs: List[RequestOutput] = []
+    t0 = clock()
+    next_i, n = 0, len(arrivals)
+    while next_i < n or engine.has_work():
+        now = clock() - t0
+        while next_i < n and arrivals[next_i] <= now:
+            submit(engine, next_i, t0 + float(arrivals[next_i]))
+            next_i += 1
+        if not engine.has_work():
+            # idle before the next arrival: nap briefly instead of spinning
+            time.sleep(min(1e-3, max(0.0, arrivals[next_i] - now)))
+            continue
+        if before_step is not None:
+            before_step(engine)
+        outputs.extend(engine.step())
+        if after_step is not None:
+            after_step(engine)
+    return outputs, clock() - t0
+
+
+def goodput_summary(
+    outputs: Sequence[RequestOutput], wall_s: float
+) -> Dict[str, object]:
+    """Serving goodput statistics over a finished workload: req/s, tok/s,
+    p50/p95 TTFT and TPOT in ms (None when no request carried the metric —
+    telemetry off), total recompute preemptions. GOODput by definition:
+    only eos/length completions count toward req/s and tok/s — a request
+    finished with reason ``"error"`` is reported in ``errors``, never as
+    served throughput. Percentiles come from the per-request span metrics,
+    so TTFT counts queueing from arrival."""
+    ok = [o for o in outputs if o.finish_reason != "error"]
+    n_tok = sum(len(o.token_ids) for o in ok)
+    # `is not None`, not truthiness: an injected/coarse clock can yield a
+    # legitimate 0.0 that must stay in the percentile population
+    ttfts = [
+        o.metrics["ttft_s"] for o in ok if o.metrics.get("ttft_s") is not None
+    ]
+    tpots = [
+        o.metrics["tpot_s"] for o in ok if o.metrics.get("tpot_s") is not None
+    ]
+
+    def pct(xs: List[float], q: float) -> Optional[float]:
+        return round(float(np.percentile(xs, q)) * 1e3, 2) if xs else None
+
+    return {
+        "requests": len(outputs),
+        "errors": len(outputs) - len(ok),
+        "goodput_req_s": round(len(ok) / wall_s, 3),
+        "tok_s": round(n_tok / wall_s, 1),
+        "ttft_p50_ms": pct(ttfts, 50),
+        "ttft_p95_ms": pct(ttfts, 95),
+        "tpot_p50_ms": pct(tpots, 50),
+        "tpot_p95_ms": pct(tpots, 95),
+        "preemptions": int(sum(o.metrics.get("preemptions", 0) for o in outputs)),
+    }

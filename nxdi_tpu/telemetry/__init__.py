@@ -39,6 +39,9 @@ Metric catalog (labels in parens):
 ``nxdi_kv_block_forks_total``         counter
 ``nxdi_kv_block_frees_total``         counter
 ``nxdi_spec_accepted_tokens``         histogram  (path)
+``nxdi_serve_queue_depth``            gauge
+``nxdi_serve_slots_busy``             gauge
+``nxdi_serve_preemptions_total``      counter
 ``nxdi_program_lowerings_total``      counter    (phase: warmup|serving)
 ``nxdi_program_mfu_pct``              gauge      (submodel, bucket, steps)
 ``nxdi_program_hbm_bw_pct``           gauge      (submodel, bucket, steps)
@@ -184,6 +187,22 @@ class Telemetry:
             "tokens retired per speculation window (accepted + bonus)",
             ("path",), bounds=LENGTH_BOUNDS,
         )
+        # serving-engine occupancy (nxdi_tpu/serving): the scheduler
+        # publishes queue depth / busy slots every transition and counts
+        # recompute-style preemptions
+        self.serve_queue_depth = r.gauge(
+            "nxdi_serve_queue_depth",
+            "requests waiting for an engine slot (FCFS queue)",
+        )
+        self.serve_slots_busy = r.gauge(
+            "nxdi_serve_slots_busy",
+            "engine slots holding a running request",
+        )
+        self.serve_preemptions_total = r.counter(
+            "nxdi_serve_preemptions_total",
+            "requests evicted back to WAITING on KV-pool exhaustion "
+            "(recompute-style preemption)",
+        )
         self.lowerings_total = r.counter(
             "nxdi_program_lowerings_total",
             "program lowerings by phase (serving = post-seal retrace!)",
@@ -245,10 +264,12 @@ class Telemetry:
                 (padded_tokens - real_tokens) / padded_tokens, submodel=submodel
             )
 
-    def start_request(self, tokens_in: int = 0):
+    def start_request(self, tokens_in: int = 0, t_start=None):
+        """``t_start`` (optional, ``clock`` domain) backdates the span to the
+        request's true arrival so TTFT includes queueing before this call."""
         if not self.enabled:
             return NULL_SPAN
-        return self.spans.start(tokens_in=tokens_in)
+        return self.spans.start(tokens_in=tokens_in, t_start=t_start)
 
     def record_spec_window(self, counts, path: str) -> None:
         """Accepted-length histogram per speculation window; ``counts`` is a

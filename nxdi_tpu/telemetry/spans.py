@@ -135,8 +135,14 @@ class SpanTracker:
         self.spans: Deque[RequestSpan] = deque(maxlen=self.max_spans)
         self._next_id = 0
 
-    def start(self, tokens_in: int = 0) -> RequestSpan:
-        span = RequestSpan(self._tel, self._next_id, self._tel.clock())
+    def start(self, tokens_in: int = 0, t_start: Optional[float] = None) -> RequestSpan:
+        """``t_start`` backdates the span to the request's true arrival time
+        (same clock domain as ``tel.clock``) so TTFT under load includes the
+        queueing a late ``start`` call would otherwise omit."""
+        span = RequestSpan(
+            self._tel, self._next_id,
+            self._tel.clock() if t_start is None else t_start,
+        )
         self._next_id += 1
         if tokens_in:
             span.add_tokens_in(tokens_in)

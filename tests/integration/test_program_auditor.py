@@ -307,6 +307,51 @@ def test_kv_layout_live_input_in_nonpaged_program_detected():
 
 
 # ---------------------------------------------------------------------------
+# LoRA adapter sharding
+# ---------------------------------------------------------------------------
+
+LORA_CFG = {"max_loras": 2, "max_lora_rank": 4}
+
+
+def test_lora_sharding_clean_on_lora_app():
+    """The shipped lora_spec_update keeps adapter buffers on the base
+    projections' axes — a tp=8 LoRA app audits clean, and a non-LoRA app
+    produces no lora_sharding findings at all."""
+    app = make_app(tp_degree=8, lora_config=dict(LORA_CFG))
+    assert errors_of(app.audit(checkers=["lora_sharding"]), "lora_sharding") == []
+    assert errors_of(
+        make_app(tp_degree=8).audit(checkers=["lora_sharding"]), "lora_sharding"
+    ) == []
+
+
+def test_lora_sharding_violation_detected(monkeypatch):
+    """Seeded violation: a REPLICATED lora_B next to the column-parallel
+    q_proj weight (the silent per-layer all-gather the ROADMAP invariant
+    describes) must fail the audit with the module named."""
+    import nxdi_tpu.lora as lora_pkg
+    from nxdi_tpu.parallel.layers import REPLICATED
+
+    orig = lora_pkg.lora_spec_update
+
+    def bad(specs, lora_cfg):
+        specs = orig(specs, lora_cfg)
+        specs["layers"]["attn"]["q_proj"]["lora_B"] = REPLICATED
+        return specs
+
+    monkeypatch.setattr(lora_pkg, "lora_spec_update", bad)
+    app = make_app(tp_degree=8, lora_config=dict(LORA_CFG))
+    findings = errors_of(app.audit(checkers=["lora_sharding"]), "lora_sharding")
+    assert findings, "replicated lora_B next to a tp-sharded weight not flagged"
+    msg = findings[0].message
+    assert "q_proj" in msg and "lora_B" in msg and "all-gathers" in msg
+    # only the seeded module is named — the healthy targets stay clean
+    assert all("q_proj" in f.message for f in findings)
+    # the spec comparison is program-independent: ONE finding per audit,
+    # not one per (submodel, bucket) program
+    assert len(findings) == 1
+
+
+# ---------------------------------------------------------------------------
 # HBM fit (the cost observatory's budget, run as an auditor checker)
 # ---------------------------------------------------------------------------
 

@@ -135,3 +135,56 @@ def test_default_baseline_picks_latest_round():
 def test_usage_errors(tmp_path):
     assert bench_gate.main([str(tmp_path / "missing.json"),
                             "--baseline", str(tmp_path / "nope.json")]) == 2
+
+
+def test_serving_metrics_gate_and_skip_when_absent(tmp_path):
+    """The bench.py --serving goodput line gates one-sided; a baseline from
+    BEFORE the serving engine (no serving_* fields) skips them instead of
+    failing."""
+    serving = {
+        "value": 1.8,
+        "serving_goodput_req_s": 1.8,
+        "serving_tok_s": 450.0,
+        "serving_ttft_p50_ms": 220.0,
+        "serving_ttft_p95_ms": 900.0,
+        "serving_tpot_p50_ms": 9.0,
+        "serving_tpot_p95_ms": 14.0,
+    }
+    # old baseline without serving metrics: everything serving_* skips
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", serving),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, serving, bench_gate.TOLERANCES)
+    assert "serving_tok_s" in skipped and "serving_ttft_p95_ms" in skipped
+
+    # the "value" suppression keys on the FRESH side only: a decode-mode
+    # record keeps its headline gate even against a trajectory baseline
+    # that folded serving_* fields in (side-file folding)
+    folded_base = dict(BASE, serving_goodput_req_s=1.8)
+    regressed = dict(BASE, value=BASE["value"] * 0.5)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh_decode.json", regressed),
+        "--baseline", _write(tmp_path, "base_folded.json", folded_base),
+        "-q",
+    ])
+    assert rc == 1
+
+    # same-shape baseline: a goodput drop beyond tolerance fails...
+    worse = dict(serving, serving_tok_s=380.0, serving_goodput_req_s=1.5)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", worse),
+        "--baseline", _write(tmp_path, "base.json", serving),
+        "-q",
+    ])
+    assert rc == 1
+    # ... while a TTFT improvement (lower) plus in-tolerance noise passes
+    better = dict(serving, serving_ttft_p50_ms=150.0, serving_tpot_p95_ms=14.5)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", better),
+        "--baseline", _write(tmp_path, "base.json", serving),
+        "-q",
+    ])
+    assert rc == 0

@@ -55,3 +55,27 @@ def test_speculation_flags():
 
 def test_accuracy_modes_exposed():
     assert set(CHECK_ACCURACY_MODES) == {"skip", "token-matching", "logit-matching"}
+
+
+def test_allow_input_truncation_keeps_leading_tokens():
+    """--allow-input-truncation keeps each row's FIRST max-context-length
+    tokens, matching the reference's head-negative pad
+    (model_wrapper.py:766) — identical commands, identical prompts."""
+    import json
+
+    import pytest
+
+    from nxdi_tpu.cli.inference_demo import _resolve_input_ids
+
+    rows = [[1, 2, 3, 4, 5, 6], [7, 8, 9]]
+    a = parse(BASE + ["--input-ids", json.dumps(rows), "--allow-input-truncation"])
+    out = _resolve_input_ids(a, max_ctx=4)
+    # long row truncated to its HEAD; short row untouched (per-row, before
+    # the batch right-pad)
+    assert out[0].tolist() == [1, 2, 3, 4]
+    assert out[1].tolist() == [7, 8, 9, 0]
+
+    # without the flag an over-long prompt still fails fast
+    a2 = parse(BASE + ["--input-ids", json.dumps(rows)])
+    with pytest.raises(ValueError, match="leading"):
+        _resolve_input_ids(a2, max_ctx=4)

@@ -1,0 +1,273 @@
+"""Scheduler/request bookkeeping for the continuous-batching engine
+(nxdi_tpu/serving) — pure host-side logic, no model required.
+
+The model-driven edge cases (token parity across preemption, EOS inside a
+multistep window, dirty-slot recycling) live in
+tests/integration/test_serving_engine.py; here the slot/watermark/
+preemption state machine is pinned down exactly."""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.runtime.block_manager import BlockSpaceManager
+from nxdi_tpu.serving import (
+    FINISHED,
+    PREEMPTED,
+    RUNNING,
+    WAITING,
+    Request,
+    SamplingParams,
+    Scheduler,
+    SchedulerConfig,
+    normalize_eos_ids,
+)
+
+
+def _complete(*reqs):
+    # simulate the engine finishing each request's prefill dispatch(es)
+    for r in reqs:
+        r.num_prefilled = r.prefill_target
+
+
+def req(n_prompt=8, max_new=8, **kw):
+    return Request(list(range(1, n_prompt + 1)),
+                   SamplingParams(max_new_tokens=max_new, **kw))
+
+
+# ---------------------------------------------------------------------------
+# SamplingParams / Request primitives
+# ---------------------------------------------------------------------------
+
+def test_sampling_params_greedy_coercion():
+    # do_sample=False coerces top_k to 1 — the HF adapter's rule, now shared
+    sp = SamplingParams(top_k=50, top_p=0.9, temperature=0.7)
+    assert sp.row() == (1.0, 0.9, 0.7)
+    sp = SamplingParams(top_k=50, top_p=0.9, temperature=0.7, do_sample=True)
+    assert sp.row() == (50.0, 0.9, 0.7)
+    t = SamplingParams.rows_tensor([SamplingParams(), sp])
+    np.testing.assert_allclose(t, [[1, 1, 1], [50, 0.9, 0.7]], rtol=1e-6)
+    np.testing.assert_allclose(sp.tensor(2), [[50, 0.9, 0.7]] * 2, rtol=1e-6)
+
+
+def test_normalize_eos_ids():
+    assert normalize_eos_ids(None) == []
+    assert normalize_eos_ids(7) == [7]
+    assert normalize_eos_ids([7, np.int64(9)]) == [7, 9]
+    # SamplingParams accepts every spelling the HF adapter does
+    assert SamplingParams(eos_token_ids=2).eos_token_ids == (2,)
+    assert SamplingParams(eos_token_ids=np.int64(2)).eos_token_ids == (2,)
+    assert SamplingParams(eos_token_ids=None).eos_token_ids == ()
+
+
+def test_request_lifecycle_helpers():
+    r = req(n_prompt=3, max_new=2, eos_token_ids=(99,))
+    assert r.state == WAITING and r.remaining == 2 and not r.prefill_done
+    r.prefill_target = 3
+    r.num_prefilled = 3
+    assert r.prefill_done
+    seen = []
+    r.on_token = lambda rq, t: seen.append(t)
+    r.emit(5)
+    assert r.check_finish() is None and r.seq_tokens == [1, 2, 3, 5]
+    r.emit(99)
+    assert r.check_finish() == "eos" and seen == [5, 99]
+    # length cap fires when eos never arrives
+    r2 = req(n_prompt=3, max_new=1)
+    r2.emit(4)
+    assert r2.check_finish() == "length"
+
+
+def test_request_rejects_empty_prompt_and_bad_budget():
+    with pytest.raises(ValueError):
+        Request([])
+    with pytest.raises(ValueError):
+        SamplingParams(max_new_tokens=0)
+
+
+# ---------------------------------------------------------------------------
+# admission / watermark
+# ---------------------------------------------------------------------------
+
+def test_watermark_blocks_admission_until_a_retirement():
+    """Satellite case: admission blocked AT the watermark, unblocked by a
+    retirement returning blocks to the pool."""
+    mgr = BlockSpaceManager(8, 4)
+    s = Scheduler(4, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=2,
+                                         max_prefills_per_step=4))
+    a, b, c = req(8), req(8), req(8)  # 2 blocks each
+    for r in (a, b, c):
+        s.add(r)
+    # 8-block pool: every admission leaves >= 2 free -> all three admit
+    assert s.schedule_prefills() == [a, b, c]
+    _complete(a, b, c)
+
+    mgr2 = BlockSpaceManager(6, 4)
+    s2 = Scheduler(4, block_manager=mgr2,
+                   config=SchedulerConfig(watermark_blocks=2,
+                                          max_prefills_per_step=4))
+    a2, b2, c2 = req(8), req(8), req(8)
+    for r in (a2, b2, c2):
+        s2.add(r)
+    assert s2.schedule_prefills() == [a2, b2]  # c2 would dip below watermark
+    _complete(a2, b2)
+    assert c2.state == WAITING and s2.queue_depth == 1
+    # nothing changes while the pool stays tight
+    assert s2.schedule_prefills() == []
+    # a retirement frees its blocks -> c2 admits on the next pass
+    s2.retire(a2, "length")
+    assert a2.state == FINISHED
+    assert s2.schedule_prefills() == [c2]
+    assert c2.state == RUNNING and c2.slot is not None
+
+
+def test_lone_request_may_dip_below_watermark():
+    """With nothing running there is no decode to protect: a request whose
+    allocation dips below the watermark still admits (no deadlock)."""
+    mgr = BlockSpaceManager(4, 4)
+    s = Scheduler(2, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=2))
+    r = req(13)  # 4 blocks: free_after = 0 < watermark, but slots are empty
+    s.add(r)
+    assert s.schedule_prefills() == [r]
+
+
+def test_never_fitting_request_raises():
+    mgr = BlockSpaceManager(2, 4)
+    s = Scheduler(2, block_manager=mgr, config=SchedulerConfig())
+    s.add(req(16))  # 4 blocks > 2-block pool: can never run, even alone
+    with pytest.raises(RuntimeError, match="never"):
+        s.schedule_prefills()
+
+
+def test_admission_is_fcfs_with_head_of_line_blocking():
+    mgr = BlockSpaceManager(4, 4)
+    s = Scheduler(4, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0,
+                                         max_prefills_per_step=4))
+    big, small = req(16), req(4)  # big: 4 blocks, small: 1
+    occupant = req(4)
+    s.add(occupant)
+    _complete(*s.schedule_prefills())
+    s.add(big)
+    s.add(small)
+    # big does not fit (3 free < 4); small would, but FCFS must not bypass
+    assert s.schedule_prefills() == []
+    assert [r.request_id for r in s.waiting] == [big.request_id, small.request_id]
+
+
+def test_slots_bound_admission_without_block_manager():
+    s = Scheduler(2, config=SchedulerConfig(max_prefills_per_step=4))
+    rs = [req(), req(), req()]
+    for r in rs:
+        s.add(r)
+    assert s.schedule_prefills() == rs[:2]  # contiguous: slot-bounded only
+    _complete(*rs[:2])
+    assert s.slots_busy == 2 and s.queue_depth == 1
+    s.retire(rs[0], "length")
+    assert s.schedule_prefills() == [rs[2]]
+    assert rs[2].slot == 0  # recycled slot
+
+
+def test_decode_first_interleave_defers_admission():
+    s = Scheduler(2, config=SchedulerConfig(interleave="decode_first",
+                                            max_prefills_per_step=4))
+    a = req()
+    s.add(a)
+    assert s.schedule_prefills() == [a]  # nothing decodable yet
+    a.num_prefilled = a.prefill_target  # prefill done -> decodable
+    a.emit(1)
+    b = req()
+    s.add(b)
+    assert s.schedule_prefills() == []  # decode runs first
+    s.retire(a, "length")
+    assert s.schedule_prefills() == [b]
+
+
+def test_scheduler_config_not_mutated_across_pools():
+    """The caller's SchedulerConfig must not inherit one scheduler's derived
+    watermark: reusing it over a much smaller pool keeps that pool's own
+    default."""
+    cfg = SchedulerConfig()
+    big = Scheduler(2, block_manager=BlockSpaceManager(10_000, 4), config=cfg)
+    assert big.config.watermark_blocks == 100
+    assert cfg.watermark_blocks is None  # caller copy untouched
+    small = Scheduler(2, block_manager=BlockSpaceManager(100, 4), config=cfg)
+    assert small.config.watermark_blocks == 1
+
+
+def test_interleave_validation():
+    with pytest.raises(ValueError, match="interleave"):
+        SchedulerConfig(interleave="nope")
+
+
+# ---------------------------------------------------------------------------
+# preemption
+# ---------------------------------------------------------------------------
+
+def _run_and_prefill(s, r):
+    s.add(r)
+    assert r in s.schedule_prefills()
+    r.num_prefilled = r.prefill_target
+    r.emit(1)
+
+
+def test_decode_growth_preempts_youngest_and_oldest_wins():
+    mgr = BlockSpaceManager(4, 4, telemetry=None)
+    s = Scheduler(2, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0,
+                                         max_prefills_per_step=4))
+    old, young = req(8, max_new=16), req(8, max_new=16)  # 2 blocks each
+    _run_and_prefill(s, old)
+    _run_and_prefill(s, young)
+    assert mgr.num_free_blocks() == 0
+    # both sit at total_len 9 -> each needs a 3rd block the pool does not
+    # have: the YOUNGEST is evicted and the oldest takes its freed blocks
+    kept, preempted = s.ensure_decode_capacity([(0, old), (1, young)])
+    assert [r for _, r in kept] == [old]
+    assert preempted == [young]
+    assert young.state == PREEMPTED and young.preemptions == 1
+    assert young.num_prefilled == 0 and young.prefill_target == 0
+    assert s.waiting[0] is young  # resumes at the FRONT of the queue
+    # young's blocks were freed; old now holds 3 of 4
+    assert mgr.num_free_blocks() == 1
+
+
+def test_self_preemption_when_nothing_younger_helps():
+    mgr = BlockSpaceManager(2, 4)
+    s = Scheduler(1, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0))
+    lone = req(8, max_new=16)  # 2 blocks = the whole pool
+    _run_and_prefill(s, lone)
+    # total_len 9 needs a 3rd block that does not exist -> self-preempt
+    kept, preempted = s.ensure_decode_capacity([(0, lone)])
+    assert kept == [] and preempted == [lone]
+    assert lone.state == PREEMPTED
+
+
+def test_contiguous_growth_never_preempts():
+    s = Scheduler(2, config=SchedulerConfig())
+    a = req()
+    _run_and_prefill(s, a)
+    kept, preempted = s.ensure_decode_capacity([(0, a)])
+    assert kept == [(0, a)] and preempted == []
+
+
+def test_preemption_publishes_counter_and_gauges():
+    from nxdi_tpu.telemetry import Telemetry
+
+    tel = Telemetry()
+    mgr = BlockSpaceManager(4, 4, telemetry=tel)
+    s = Scheduler(2, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0,
+                                         max_prefills_per_step=4),
+                  telemetry=tel)
+    a, b = req(8, max_new=16), req(8, max_new=16)
+    _run_and_prefill(s, a)
+    _run_and_prefill(s, b)
+    assert tel.serve_slots_busy.value() == 2
+    victim = s.preempt_youngest()
+    assert victim is b
+    assert tel.serve_preemptions_total.value() == 1
+    assert tel.serve_queue_depth.value() == 1
+    assert tel.serve_slots_busy.value() == 1
