@@ -24,7 +24,9 @@ Usage:
   # one-shot: demo traffic, Prometheus text + JSON snapshot to stdout
   python -m nxdi_tpu.cli.metrics
 
-  # serve a /metrics endpoint for a scrape (also /metrics.json, /trace.json)
+  # serve a /metrics endpoint for a scrape (also /metrics.json, /snapshot,
+  # /healthz, /trace.json, /postmortem — the last needs a flight recorder,
+  # i.e. a live serving engine on the same telemetry)
   python -m nxdi_tpu.cli.metrics --serve --port 9400
 
   # write the Perfetto trace of the demo requests
@@ -195,6 +197,33 @@ def main(argv: Optional[List[str]] = None) -> int:
         run_paged_demo(app, args.requests, args.max_new_tokens)
 
     tel = app.telemetry
+    if not args.quiet:
+        # the registry's interpolated percentile estimator, one line per
+        # latency family (same numbers the JSON snapshot rows carry).
+        # Percentiles come from the ONE series_snapshot copy so n and
+        # p50/p95/p99 can never describe different populations mid-traffic
+        from nxdi_tpu.telemetry import percentile_from_buckets
+
+        for fam in ("nxdi_dispatch_seconds", "nxdi_request_ttft_seconds",
+                    "nxdi_request_tpot_seconds"):
+            hist = tel.registry.get(fam)
+            if hist is None:
+                continue
+            for key, (counts, _, count) in sorted(hist.series_snapshot().items()):
+                if not count:
+                    continue
+                tag = ",".join(
+                    f"{k}={v}" for k, v in hist.labels_of(key).items()
+                )
+                pcts = " ".join(
+                    "p%d=%.2fms" % (
+                        p,
+                        percentile_from_buckets(hist.bounds, counts, count, p)
+                        * 1e3,
+                    )
+                    for p in (50, 95, 99)
+                )
+                _note(False, f"[metrics] {fam}{{{tag}}} n={count} {pcts}")
     if args.format in ("prom", "both"):
         print(tel.prometheus_text(), end="")
     if args.format in ("json", "both"):

@@ -55,6 +55,14 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                    help="force one recompute preemption if none occurs "
                         "naturally (default 1: the demo must exercise the "
                         "resume path)")
+    p.add_argument("--slo-ttft-ms", type=float, default=None,
+                   help="declare a TTFT SLO target (TpuConfig(slo=...)): "
+                        "attainment gauges + breach-triggered postmortems")
+    p.add_argument("--slo-tpot-ms", type=float, default=None,
+                   help="declare a mean inter-token SLO target")
+    p.add_argument("--postmortem-dir", default=None, metavar="DIR",
+                   help="where trigger-fired flight-recorder bundles land "
+                        "(default: in-memory only)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stream", action="store_true",
                    help="print each request's tokens as they stream")
@@ -167,12 +175,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         tkg_batch_size=args.slots,
         dtype="bfloat16",
         skip_warmup=True,
-        telemetry="full",
+        telemetry={"detail": "full", "postmortem_dir": args.postmortem_dir},
         is_block_kv_layout=True,
         pa_block_size=args.pa_block_size,
         pa_num_blocks=args.pa_num_blocks,
         on_device_sampling_config=OnDeviceSamplingConfig(),
     )
+    if args.slo_ttft_ms is not None or args.slo_tpot_ms is not None:
+        tpu_kwargs["slo"] = {
+            "ttft_s": None if args.slo_ttft_ms is None else args.slo_ttft_ms / 1e3,
+            "tpot_s": None if args.slo_tpot_ms is None else args.slo_tpot_ms / 1e3,
+        }
     if args.chunked_prefill:
         tpu_kwargs["chunked_prefill_config"] = {
             "chunk_size": args.chunked_prefill,
@@ -192,9 +205,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         _note(args.quiet,
               f"[serve] req {o.request_id}: {len(o.token_ids)} tokens, "
               f"{o.finish_reason}, preemptions={o.metrics['preemptions']}")
-    # ONE statistics rule with bench.py --serving (serving/workload.py)
-    summary = goodput_summary(outputs, wall)
+    # ONE statistics rule with bench.py --serving (serving/workload.py):
+    # exact per-request percentiles, SLO fields when targets were declared
+    summary = goodput_summary(outputs, wall, slo=app.tpu_config.slo)
     _note(args.quiet, f"[serve] {json.dumps(summary)}")
+    if engine.flight is not None and engine.flight.postmortems:
+        _note(args.quiet,
+              f"[serve] postmortem bundles: {engine.flight.postmortems}")
 
     tel = app.telemetry
     if args.format in ("prom", "both"):
@@ -211,7 +228,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     if args.serve:
         server = tel.serve(host=args.host, port=args.port)
         _note(args.quiet,
-              f"[serve] http://{args.host}:{server.port}/metrics — Ctrl-C to stop")
+              f"[serve] http://{args.host}:{server.port}/metrics "
+              "(/metrics.json, /snapshot, /healthz, /trace.json, "
+              "/postmortem) — Ctrl-C to stop")
         try:
             while True:
                 time.sleep(3600)

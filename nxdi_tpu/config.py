@@ -171,20 +171,75 @@ class TelemetryConfig:
         time (``SubmodelProfiler`` flips this on while attached).
 
     ``max_spans`` bounds the request-span ring buffer (Perfetto export).
+
+    Flight recorder (nxdi_tpu/telemetry/flight.py; serving engine only):
+
+    ``flight`` enables the per-step engine flight recorder;
+    ``flight_records`` bounds its StepRecord ring buffer;
+    ``postmortem_dir`` — directory where trigger-fired postmortem bundles
+    (SLO breach, preemption storm, retrace-guard trip) are written as JSON;
+    ``None`` keeps the recorder in-memory only (manual dumps still work).
+    ``storm_window`` / ``storm_preemptions`` — a preemption storm fires the
+    postmortem trigger when the last ``storm_window`` engine steps carried
+    >= ``storm_preemptions`` recompute preemptions.
     """
 
     def __init__(self, **kwargs):
         self.enabled = bool(kwargs.pop("enabled", True))
         self.detail = kwargs.pop("detail", "basic")
         self.max_spans = int(kwargs.pop("max_spans", 256))
+        self.flight = bool(kwargs.pop("flight", True))
+        self.flight_records = int(kwargs.pop("flight_records", 512))
+        self.postmortem_dir = kwargs.pop("postmortem_dir", None)
+        self.storm_window = int(kwargs.pop("storm_window", 32))
+        self.storm_preemptions = int(kwargs.pop("storm_preemptions", 8))
         if self.detail not in ("off", "basic", "full"):
             raise ValueError(
                 f"telemetry detail must be 'off'|'basic'|'full', got {self.detail!r}"
             )
         if self.max_spans < 1:
             raise ValueError("telemetry max_spans must be >= 1")
+        if self.flight_records < 1:
+            raise ValueError("telemetry flight_records must be >= 1")
+        if self.storm_window < 1 or self.storm_preemptions < 1:
+            raise ValueError(
+                "telemetry storm_window and storm_preemptions must be >= 1"
+            )
         if kwargs:
             raise ValueError(f"Unknown TelemetryConfig args: {sorted(kwargs)}")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class SloConfig:
+    """Declared serving SLOs (nxdi_tpu/telemetry/slo.py): latency targets the
+    SLO tracker measures per-request attainment against.
+
+    ``ttft_s`` — time-to-first-token target in seconds (None = not declared);
+    ``tpot_s`` — mean inter-token (time-per-output-token) target in seconds.
+    A request ATTAINS its SLO when every declared target holds with
+    ``value <= target`` (exactly at the target is attained; the breach is
+    strict ``>``). ``window`` bounds the rolling population behind the
+    ``nxdi_slo_attainment_pct`` / ``nxdi_slo_goodput_tok_s`` gauges.
+    """
+
+    def __init__(self, **kwargs):
+        ttft = kwargs.pop("ttft_s", None)
+        tpot = kwargs.pop("tpot_s", None)
+        self.ttft_s = None if ttft is None else float(ttft)
+        self.tpot_s = None if tpot is None else float(tpot)
+        self.window = int(kwargs.pop("window", 256))
+        if kwargs:
+            raise ValueError(f"Unknown SloConfig args: {sorted(kwargs)}")
+        if self.ttft_s is None and self.tpot_s is None:
+            raise ValueError("SloConfig needs at least one of ttft_s / tpot_s")
+        if (self.ttft_s is not None and self.ttft_s <= 0) or (
+            self.tpot_s is not None and self.tpot_s <= 0
+        ):
+            raise ValueError("SLO targets must be positive seconds")
+        if self.window < 1:
+            raise ValueError("SLO window must be >= 1")
 
     def to_dict(self):
         return dict(self.__dict__)
@@ -565,6 +620,14 @@ class TpuConfig:
         elif tel is None:
             tel = TelemetryConfig()
         self.telemetry = tel
+        # declared serving SLOs (nxdi_tpu/telemetry/slo.py): TTFT/TPOT
+        # latency targets the SLO tracker measures attainment against and
+        # the flight recorder's breach trigger fires on. An SloConfig, a
+        # dict of its kwargs, or None (no SLO declared — nothing tracked).
+        slo = kwargs.pop("slo", None)
+        if isinstance(slo, dict):
+            slo = SloConfig(**slo)
+        self.slo = slo
         # declared chip generation for the cost observatory's roofline math
         # and the hbm_fit auditor checker (analysis/costs.py): a name from
         # CHIP_SPECS ("v4"|"v5e"|"v5p"|"v6e"), or a dict of ChipSpec field
@@ -850,6 +913,7 @@ class TpuConfig:
         "lora_config": LoraServingConfig,
         "hybrid_sharding_config": HybridShardingConfig,
         "telemetry": TelemetryConfig,
+        "slo": SloConfig,
     }
 
     @property

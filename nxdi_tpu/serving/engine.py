@@ -34,7 +34,12 @@ Telemetry rides the app's existing registry: ``nxdi_serve_queue_depth`` /
 ``nxdi_serve_slots_busy`` gauges, ``nxdi_serve_preemptions_total``
 counter, and one request span per request covering
 queue -> prefill -> decode with TTFT measured from arrival (under load it
-includes queueing, as a serving TTFT should).
+includes queueing, as a serving TTFT should). On top of that the engine
+owns a flight recorder (``telemetry/flight.py``: one StepRecord per
+``step()`` with the host-vs-dispatch time split, postmortem bundles on
+SLO breach / preemption storm / retrace trip) and, when
+``TpuConfig(slo=...)`` declares targets, an SLO tracker
+(``telemetry/slo.py``: rolling attainment + SLO-conditioned goodput).
 """
 
 from __future__ import annotations
@@ -155,6 +160,44 @@ class InferenceEngine:
         self._can_continue_prefill = TAG_PREFIX_PREFILL in app.models
         self._progress = False
 
+        # flight recorder + SLO tracker (telemetry/flight.py, telemetry/
+        # slo.py): the recorder journals every step() decision into a
+        # bounded ring and fires postmortem bundles on SLO breach /
+        # preemption storm / retrace-guard trip; the tracker turns declared
+        # TpuConfig(slo=...) targets into rolling attainment gauges
+        self.flight = None
+        self.slo = None
+        self._pending_breaches: List[Tuple[Request, List[str]]] = []
+        if tel is not None:
+            tc_tel = tc.telemetry
+            if getattr(tc_tel, "flight", True):
+                from nxdi_tpu.telemetry import FlightRecorder
+
+                self.flight = FlightRecorder(
+                    tel,
+                    num_slots=num_slots,
+                    max_records=getattr(tc_tel, "flight_records", 512),
+                    postmortem_dir=getattr(tc_tel, "postmortem_dir", None),
+                    storm_window=getattr(tc_tel, "storm_window", 32),
+                    storm_preemptions=getattr(tc_tel, "storm_preemptions", 8),
+                    state_fn=self.scheduler_state,
+                    retrace_guard=getattr(app, "retrace_guard", None),
+                )
+                tel.attach_flight(self.flight)
+                self.scheduler.flight = self.flight
+            if getattr(tc, "slo", None) is not None:
+                from nxdi_tpu.telemetry import SloTracker
+
+                self.slo = SloTracker(tel, tc.slo)
+                # every JSON snapshot (and so every postmortem bundle and
+                # /snapshot probe) carries the targets-vs-measured readout
+                tel.add_snapshot_extra("_slo", self.slo.to_dict)
+        elif getattr(tc, "slo", None) is not None:
+            logger.warning(
+                "TpuConfig(slo=...) declared but telemetry is off — SLO "
+                "attainment needs the request spans; nothing will be tracked"
+            )
+
     # -- request intake -----------------------------------------------------
     def add_request(
         self,
@@ -243,7 +286,13 @@ class InferenceEngine:
 
     def step(self) -> List[RequestOutput]:
         """One engine iteration: prefill work, then one batched decode.
-        Returns the requests that FINISHED during this step."""
+        Returns the requests that FINISHED during this step. With the
+        flight recorder enabled every iteration journals one StepRecord
+        (admissions, prefill chunks, the decode dispatch, preemptions,
+        retirements, KV level, host-vs-dispatch time split)."""
+        fl = self.flight
+        if fl is not None:
+            fl.begin_step()
         finished: List[RequestOutput] = []
         preempted: List[Request] = []
         prefills = self.scheduler.schedule_prefills()
@@ -268,6 +317,23 @@ class InferenceEngine:
         # the stall guard in run()
         self._progress = bool(prefills) or bool(rows) or bool(preempted)
         self.scheduler.publish()
+        if fl is not None:
+            fl.end_step(
+                self.scheduler.queue_depth,
+                self.scheduler.slots_busy,
+                self.block_manager.num_free_blocks()
+                if self.block_manager is not None else None,
+            )
+            # SLO-breach postmortems fire AFTER end_step so the bundle's
+            # timeline includes the step the breaching request finished in
+            pending, self._pending_breaches = self._pending_breaches, []
+            for req, kinds in pending:
+                fl.postmortem(
+                    "slo_breach",
+                    detail={"kinds": kinds},
+                    request_span=req.span,
+                    request_id=req.request_id,
+                )
         return finished
 
     def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
@@ -320,6 +386,10 @@ class InferenceEngine:
             submodel=submodel,
             **kwargs,
         )
+        if self.flight is not None:
+            self.flight.record_prefill(
+                req.request_id, req.slot, submodel, start, n
+            )
         req.num_prefilled += n
         if not req.prefill_done:
             return  # more chunks next step; decodes interleave meanwhile
@@ -381,6 +451,10 @@ class InferenceEngine:
         pos = np.array([[r.total_len - 1] for _, r in rows], dtype=np.int32)
         kwargs = self._layout_kwargs(rows)
         self._maybe_rng(kwargs)
+        if self.flight is not None:
+            self.flight.record_decode(
+                TAG_TOKEN_GENERATION, 1, rows, self.tpu_config.tkg_batch_size
+            )
         clock = self.telemetry.clock if self.telemetry is not None else None
         t0 = clock() if clock else 0.0
         out = self.app.forward(
@@ -429,6 +503,11 @@ class InferenceEngine:
         }
         batch.update(self._layout_kwargs(rows))
         self._maybe_rng(batch)
+        if self.flight is not None:
+            self.flight.record_decode(
+                TAG_TOKEN_GENERATION_MULTISTEP, steps, rows,
+                self.tpu_config.tkg_batch_size,
+            )
         clock = self.telemetry.clock if self.telemetry is not None else None
         t0 = clock() if clock else 0.0
         out = self.app.token_gen_multistep(batch)
@@ -452,6 +531,7 @@ class InferenceEngine:
     def _finish(
         self, req: Request, reason: str, finished: List[RequestOutput]
     ) -> None:
+        slot = req.slot  # retire() recycles it; the record keeps the row
         self.scheduler.retire(req, reason)
         metrics: Dict[str, float] = {"preemptions": req.preemptions}
         if req.span is not None:
@@ -463,6 +543,22 @@ class InferenceEngine:
                 metrics["tpot_s"] = (
                     metrics["e2e_s"] - req.span.ttft_s
                 ) / n_dec
+        if self.flight is not None:
+            self.flight.record_retirement(req.request_id, slot, reason)
+        if self.slo is not None and req.span is not None and reason != "error":
+            # error finishes never count toward SLO attainment — the same
+            # exclusion goodput_summary applies to served throughput
+            kinds = self.slo.observe(
+                metrics.get("ttft_s"),
+                metrics.get("tpot_s"),
+                tokens_out=len(req.generated),
+                t_finish=req.span.t_end,
+            )
+            metrics["slo_breaches"] = kinds
+            if kinds and self.flight is not None:
+                # deferred to step()'s end: the bundle must include the
+                # StepRecord of the very step this finish happened in
+                self._pending_breaches.append((req, kinds))
         finished.append(
             RequestOutput(
                 request_id=req.request_id,
@@ -474,6 +570,39 @@ class InferenceEngine:
         )
 
     # -- helpers ------------------------------------------------------------
+    def scheduler_state(self) -> dict:
+        """JSON-able scheduler picture for postmortem bundles and probes:
+        the FCFS queue, each slot's occupant, and the KV headroom."""
+        sch = self.scheduler
+        return {
+            "waiting": [
+                {
+                    "request_id": r.request_id,
+                    "state": r.state,
+                    "preemptions": r.preemptions,
+                    "prompt_tokens": len(r.prompt),
+                    "generated": len(r.generated),
+                }
+                for r in sch.waiting
+            ],
+            "slots": [
+                None if r is None else {
+                    "request_id": r.request_id,
+                    "state": r.state,
+                    "prefilled": r.num_prefilled,
+                    "prefill_target": r.prefill_target,
+                    "generated": len(r.generated),
+                    "remaining": r.remaining,
+                }
+                for r in sch.slots
+            ],
+            "kv_blocks_free": (
+                self.block_manager.num_free_blocks()
+                if self.block_manager is not None else None
+            ),
+            "watermark_blocks": sch.config.watermark_blocks,
+        }
+
     def _tokens_of(self, outputs) -> np.ndarray:
         # shared with the HF adapter (ops/sampling.py): ONE extraction rule,
         # ONE rng schedule — the greedy-parity anchor depends on it

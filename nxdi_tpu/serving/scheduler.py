@@ -96,6 +96,10 @@ class Scheduler:
         self.num_slots = num_slots
         self.block_manager = block_manager
         self.telemetry = telemetry
+        # telemetry/flight.FlightRecorder, set by the owning engine: the
+        # scheduler is where slot identity is still known at admission and
+        # preemption time, so it records those transitions
+        self.flight = None
         self.waiting: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * num_slots
         self._admit_counter = 0
@@ -199,6 +203,10 @@ class Scheduler:
         if req.span is not None:
             req.span.phase("prefill")
         self.slots[slot] = req
+        if self.flight is not None:
+            self.flight.record_admission(
+                req.request_id, slot, resumed=req.preemptions > 0
+            )
 
     # -- decode growth / preemption ----------------------------------------
     def ensure_decode_capacity(
@@ -242,6 +250,9 @@ class Scheduler:
 
     def _preempt(self, req: Request) -> None:
         assert req.slot is not None
+        if self.flight is not None:
+            # the vacated slot is part of the record; capture before clearing
+            self.flight.record_preemption(req.request_id, req.slot)
         self.slots[req.slot] = None
         req.slot = None
         req.state = PREEMPTED

@@ -13,9 +13,9 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-import numpy as np
-
 from nxdi_tpu.serving.request import RequestOutput
+from nxdi_tpu.telemetry.registry import percentile_exact
+from nxdi_tpu.telemetry.slo import breach_kinds
 
 
 def drive_arrivals(
@@ -78,15 +78,33 @@ def drive_arrivals(
 
 
 def goodput_summary(
-    outputs: Sequence[RequestOutput], wall_s: float
+    outputs: Sequence[RequestOutput],
+    wall_s: float,
+    slo=None,
 ) -> Dict[str, object]:
     """Serving goodput statistics over a finished workload: req/s, tok/s,
     p50/p95 TTFT and TPOT in ms (None when no request carried the metric —
     telemetry off), total recompute preemptions. GOODput by definition:
     only eos/length completions count toward req/s and tok/s — a request
     finished with reason ``"error"`` is reported in ``errors``, never as
-    served throughput. Percentiles come from the per-request span metrics,
-    so TTFT counts queueing from arrival."""
+    served throughput.
+
+    Percentiles are EXACT over the per-request span metrics (TTFT counts
+    queueing from arrival; TPOT is the request's ``(e2e - ttft) / n_dec``
+    including host gaps and preemption stalls) through the shared
+    :func:`~nxdi_tpu.telemetry.registry.percentile_exact` — deliberately
+    NOT the registry's bucket estimator: these fields gate the bench
+    trajectory, where power-of-2 bucket interpolation against exact
+    baselines would read as phantom regressions, and the dispatch-fed
+    histograms measure a narrower population (no inter-step host time).
+
+    With ``slo`` (an :class:`~nxdi_tpu.config.SloConfig`) the summary adds
+    the SLO-conditioned headline fields the Gemma-on-Cloud-TPU comparison
+    scores on: ``slo_attainment_pct`` (share of served requests meeting
+    every declared target — same :func:`breach_kinds` rule as the rolling
+    gauges) and ``goodput_slo_tok_s`` (tokens/s counting ONLY attaining
+    requests).
+    """
     ok = [o for o in outputs if o.finish_reason != "error"]
     n_tok = sum(len(o.token_ids) for o in ok)
     # `is not None`, not truthiness: an injected/coarse clock can yield a
@@ -99,9 +117,9 @@ def goodput_summary(
     ]
 
     def pct(xs: List[float], q: float) -> Optional[float]:
-        return round(float(np.percentile(xs, q)) * 1e3, 2) if xs else None
+        return round(percentile_exact(xs, q) * 1e3, 2) if xs else None
 
-    return {
+    summary: Dict[str, object] = {
         "requests": len(outputs),
         "errors": len(outputs) - len(ok),
         "goodput_req_s": round(len(ok) / wall_s, 3),
@@ -112,3 +130,17 @@ def goodput_summary(
         "tpot_p95_ms": pct(tpots, 95),
         "preemptions": int(sum(o.metrics.get("preemptions", 0) for o in outputs)),
     }
+    if slo is not None:
+        attained = [
+            o for o in ok
+            if not breach_kinds(
+                slo, o.metrics.get("ttft_s"), o.metrics.get("tpot_s")
+            )
+        ]
+        summary["slo_attainment_pct"] = (
+            round(100.0 * len(attained) / len(ok), 2) if ok else 0.0
+        )
+        summary["goodput_slo_tok_s"] = round(
+            sum(len(o.token_ids) for o in attained) / wall_s, 1
+        )
+    return summary

@@ -46,6 +46,16 @@ Metric catalog (labels in parens):
 ``nxdi_program_mfu_pct``              gauge      (submodel, bucket, steps)
 ``nxdi_program_hbm_bw_pct``           gauge      (submodel, bucket, steps)
 ``nxdi_roofline_gap_ratio``           gauge      (submodel, bucket, steps)
+``nxdi_spans_dropped_total``          counter
+``nxdi_engine_steps_total``           counter
+``nxdi_engine_step_seconds``          histogram
+``nxdi_engine_host_seconds``          histogram
+``nxdi_postmortems_total``            counter    (trigger)
+``nxdi_slo_target_seconds``           gauge      (kind: ttft|tpot)
+``nxdi_slo_requests_total``           counter    (outcome)
+``nxdi_slo_breaches_total``           counter    (kind)
+``nxdi_slo_attainment_pct``           gauge
+``nxdi_slo_goodput_tok_s``            gauge
 ====================================  =========  ==================================
 
 The three roofline gauges are published by the cost observatory
@@ -71,9 +81,12 @@ from nxdi_tpu.telemetry.registry import (
     Histogram,
     MetricsRegistry,
     log_spaced_bounds,
+    percentile_exact,
     percentile_from_buckets,
     prometheus_text,
 )
+from nxdi_tpu.telemetry.flight import FlightRecorder, StepRecord
+from nxdi_tpu.telemetry.slo import SloTracker, breach_kinds
 from nxdi_tpu.telemetry.spans import NULL_SPAN, RequestSpan, SpanTracker
 
 __all__ = [
@@ -85,9 +98,14 @@ __all__ = [
     "SpanTracker",
     "RequestSpan",
     "NULL_SPAN",
+    "FlightRecorder",
+    "StepRecord",
+    "SloTracker",
+    "breach_kinds",
     "MetricsServer",
     "prometheus_text",
     "percentile_from_buckets",
+    "percentile_exact",
     "log_spaced_bounds",
     "TIME_BOUNDS_S",
     "RATIO_BOUNDS",
@@ -126,9 +144,22 @@ class Telemetry:
         self.sync_dispatch = detail == "full"
         self.clock = clock or time.perf_counter
         self.registry = MetricsRegistry()
-        self.spans = SpanTracker(self, max_spans=max_spans)
+        # engine flight recorder (telemetry/flight.py), attached by the
+        # serving engine via attach_flight(); rides record_dispatch, the
+        # Perfetto export, and the JSON snapshot once attached
+        self.flight = None
 
         r = self.registry
+        self.spans_dropped_total = r.counter(
+            "nxdi_spans_dropped_total",
+            "request spans evicted from the bounded ring buffer "
+            "(nonzero = exported span history is truncated)",
+        )
+        # pre-seed the zero series: a scrape must SEE the counter before the
+        # first eviction, so "no drops" and "not recording" read differently
+        if self.enabled:
+            self.spans_dropped_total.inc(0)
+        self.spans = SpanTracker(self, max_spans=max_spans)
         disp_labels = ("submodel", "bucket", "steps")
         self.dispatches_total = r.counter(
             "nxdi_dispatches_total",
@@ -257,6 +288,11 @@ class Telemetry:
         labels = dict(submodel=submodel, bucket=str(bucket), steps=str(steps))
         self.dispatches_total.inc(**labels)
         self.dispatch_seconds.observe(seconds, **labels)
+        fl = self.flight
+        if fl is not None:
+            # the open StepRecord's program attribution — same numbers as
+            # the registry, one None-check on the non-serving hot path
+            fl._note_dispatch(submodel, bucket, steps, seconds)
         if real_tokens is not None and padded_tokens:
             self.real_tokens_total.inc(real_tokens, submodel=submodel)
             self.padded_tokens_total.inc(padded_tokens, submodel=submodel)
@@ -279,6 +315,15 @@ class Telemetry:
 
     def record_lowering(self, label: str, post_seal: bool) -> None:
         self.lowerings_total.inc(phase="serving" if post_seal else "warmup")
+
+    def attach_flight(self, recorder) -> None:
+        """Adopt an engine's :class:`~nxdi_tpu.telemetry.flight.FlightRecorder`:
+        ``record_dispatch`` feeds its open StepRecord, the Perfetto export
+        grows the per-slot engine timeline, and every JSON snapshot carries
+        a ``_flight`` summary. The LAST attached recorder wins (one live
+        engine per app is the supported shape)."""
+        self.flight = recorder
+        self.add_snapshot_extra("_flight", recorder.summary)
 
     # -- export-time hooks --------------------------------------------------
     def attach(self, fn: Callable[[], None]) -> None:
@@ -320,11 +365,13 @@ class Telemetry:
         return prometheus_text(self.registry)
 
     def perfetto_trace(self, process_name: str = "nxdi_tpu") -> dict:
-        return _export.perfetto_trace(self.spans, process_name=process_name)
+        return _export.perfetto_trace(
+            self.spans, process_name=process_name, flight=self.flight
+        )
 
     def write_perfetto_trace(self, path: str, process_name: str = "nxdi_tpu") -> dict:
         return _export.write_perfetto_trace(
-            self.spans, path, process_name=process_name
+            self.spans, path, process_name=process_name, flight=self.flight
         )
 
     def serve(self, host: str = "127.0.0.1", port: int = 9400) -> "MetricsServer":

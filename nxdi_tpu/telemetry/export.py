@@ -1,10 +1,11 @@
 """Export surfaces: Perfetto/Chrome ``trace_events`` JSON and a stdlib
-``/metrics`` HTTP endpoint for Prometheus scrapes.
+HTTP endpoint for Prometheus scrapes and router probes.
 
 The Prometheus text and JSON snapshot formatters live on the registry
 (:func:`nxdi_tpu.telemetry.registry.prometheus_text`,
 :meth:`~nxdi_tpu.telemetry.registry.MetricsRegistry.snapshot`); this module
-holds everything that needs the span tracker or a socket.
+holds everything that needs the span tracker, the flight recorder, or a
+socket.
 """
 
 from __future__ import annotations
@@ -14,35 +15,52 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
+#: pid of the per-request span tracks / the engine-step timeline tracks
+REQUEST_PID = 1
+ENGINE_PID = 2
 
-def perfetto_trace(tracker, process_name: str = "nxdi_tpu") -> dict:
-    """Chrome/Perfetto ``trace_events`` JSON of the tracked request spans.
 
-    Each request renders as one track (``tid`` = request id) of complete
-    ("X") phase slices; timestamps are microseconds relative to the earliest
-    span so the trace opens at t=0 in the Perfetto UI. The file loads in
-    ``ui.perfetto.dev`` or ``chrome://tracing`` and can sit next to an xprof
-    capture of the same run (``nxdi_tpu.utils.profiling.trace``).
+def perfetto_trace(
+    tracker, process_name: str = "nxdi_tpu", flight=None
+) -> dict:
+    """Chrome/Perfetto ``trace_events`` JSON of the tracked request spans,
+    plus (when a flight recorder is attached) the engine-step timeline.
+
+    Requests render as one track each (``pid`` 1, ``tid`` = request id) of
+    complete ("X") phase slices. The flight recorder adds a second process
+    (``pid`` 2): **one track per decode slot** carrying the slot's
+    prefill / decode / preempted segments per engine step, plus a
+    **host-overhead track** whose slices are each step's
+    ``wall - dispatch`` remainder — a ``cli.serve`` run opens as a per-slot
+    Gantt chart. Timestamps are microseconds relative to the earliest
+    event so the trace opens at t=0 in ``ui.perfetto.dev`` /
+    ``chrome://tracing``; the file can sit next to an xprof capture of the
+    same run (``nxdi_tpu.utils.profiling.trace``).
     """
     spans = list(tracker.spans)
-    t0 = min((s.t_start for s in spans), default=0.0)
+    records = flight.snapshot_records() if flight is not None else []
+    starts = [s.t_start for s in spans] + [r.t_start for r in records]
+    t0 = min(starts, default=0.0)
 
     def us(t: float) -> float:
         return round((t - t0) * 1e6, 3)
+
+    def dur_us(seconds: float) -> float:
+        return round(max(seconds, 0.0) * 1e6, 3)
 
     events = [
         {
             "name": "process_name",
             "ph": "M",
-            "pid": 1,
-            "args": {"name": process_name},
+            "pid": REQUEST_PID,
+            "args": {"name": f"{process_name} requests"},
         }
     ]
     for s in spans:
         events.append({
             "name": "thread_name",
             "ph": "M",
-            "pid": 1,
+            "pid": REQUEST_PID,
             "tid": s.request_id,
             "args": {"name": f"request {s.request_id}"},
         })
@@ -51,10 +69,10 @@ def perfetto_trace(tracker, process_name: str = "nxdi_tpu") -> dict:
             "name": "request",
             "cat": "request",
             "ph": "X",
-            "pid": 1,
+            "pid": REQUEST_PID,
             "tid": s.request_id,
             "ts": us(s.t_start),
-            "dur": round(max(end - s.t_start, 0.0) * 1e6, 3),
+            "dur": dur_us(end - s.t_start),
             "args": {
                 "tokens_in": s.tokens_in,
                 "tokens_out": s.tokens_out,
@@ -66,36 +84,145 @@ def perfetto_trace(tracker, process_name: str = "nxdi_tpu") -> dict:
                 "name": name,
                 "cat": "phase",
                 "ph": "X",
-                "pid": 1,
+                "pid": REQUEST_PID,
                 "tid": s.request_id,
                 "ts": us(b),
-                "dur": round(max(e - b, 0.0) * 1e6, 3),
+                "dur": dur_us(e - b),
             })
+
+    if flight is not None:
+        events.extend(_engine_timeline_events(flight, records, us, dur_us))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
-def write_perfetto_trace(tracker, path: str, process_name: str = "nxdi_tpu") -> dict:
-    trace = perfetto_trace(tracker, process_name=process_name)
+def _engine_timeline_events(flight, records, us, dur_us) -> list:
+    """The engine-step Gantt: slot tracks + the host-overhead track."""
+    host_tid = flight.num_slots
+    events = [
+        {
+            "name": "process_name",
+            "ph": "M",
+            "pid": ENGINE_PID,
+            "args": {"name": "engine steps (per slot)"},
+        },
+        {
+            "name": "thread_name",
+            "ph": "M",
+            "pid": ENGINE_PID,
+            "tid": host_tid,
+            "args": {"name": "host overhead"},
+        },
+    ]
+    for slot in range(flight.num_slots):
+        events.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": ENGINE_PID,
+            "tid": slot,
+            "args": {"name": f"slot {slot}"},
+        })
+
+    def slot_slice(name, slot, rec, args):
+        return {
+            "name": name,
+            "cat": "engine",
+            "ph": "X",
+            "pid": ENGINE_PID,
+            "tid": slot,
+            "ts": us(rec.t_start),
+            "dur": dur_us(rec.wall_s),
+            "args": args,
+        }
+
+    for rec in records:
+        for pf in rec.prefills:
+            events.append(slot_slice("prefill", pf["slot"], rec, {
+                "request_id": pf["request_id"],
+                "submodel": pf["submodel"],
+                "start": pf["start"],
+                "tokens": pf["tokens"],
+            }))
+        if rec.decode is not None:
+            for row in rec.decode["rows"]:
+                events.append(slot_slice("decode", row["slot"], rec, {
+                    "request_id": row["request_id"],
+                    "steps": rec.decode["steps"],
+                    "padding_rows": rec.decode["padding_rows"],
+                }))
+        for pe in rec.preempted:
+            events.append(slot_slice("preempted", pe["slot"], rec, {
+                "request_id": pe["request_id"],
+            }))
+        # where the step's wall went that no dispatch accounts for — the
+        # host-side sync/orchestration boundary (Kernel Looping's target)
+        events.append({
+            "name": "host",
+            "cat": "engine",
+            "ph": "X",
+            "pid": ENGINE_PID,
+            "tid": host_tid,
+            "ts": us(rec.t_start),
+            "dur": dur_us(rec.host_s),
+            "args": {
+                "step": rec.step,
+                "wall_ms": round(rec.wall_s * 1e3, 3),
+                "dispatch_ms": round(rec.dispatch_s * 1e3, 3),
+            },
+        })
+    return events
+
+
+def write_perfetto_trace(
+    tracker, path: str, process_name: str = "nxdi_tpu", flight=None
+) -> dict:
+    trace = perfetto_trace(tracker, process_name=process_name, flight=flight)
     with open(path, "w") as f:
         json.dump(trace, f)
     return trace
 
 
 class MetricsServer:
-    """Tiny stdlib HTTP server: ``/metrics`` (Prometheus text), ``/metrics.json``
-    (JSON snapshot), ``/trace.json`` (Perfetto). Runs on a daemon thread."""
+    """Tiny stdlib HTTP server on a daemon thread:
+
+    - ``/metrics``       Prometheus text exposition
+    - ``/metrics.json``  JSON snapshot
+    - ``/snapshot``      alias of ``/metrics.json`` (router-probe surface)
+    - ``/healthz``       liveness JSON (router-probe surface)
+    - ``/trace.json``    Perfetto trace_events
+    - ``/postmortem``    manual flight-recorder dump (404 without a
+      recorder attached); the bundle is returned AND written to the
+      recorder's ``postmortem_dir`` when configured
+    """
 
     def __init__(self, telemetry, host: str = "127.0.0.1", port: int = 9400):
         tel = telemetry
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                if self.path.startswith("/metrics.json"):
+                ctype = "application/json"
+                if self.path.startswith("/healthz"):
+                    body = json.dumps({
+                        "status": "ok",
+                        "requests_total": tel.requests_total.total(),
+                        "engine_steps": (
+                            tel.flight.steps
+                            if tel.flight is not None else None
+                        ),
+                        "spans_dropped": tel.spans_dropped_total.total(),
+                    }).encode()
+                elif self.path.startswith(("/metrics.json", "/snapshot")):
                     body = json.dumps(tel.snapshot(), indent=2).encode()
-                    ctype = "application/json"
                 elif self.path.startswith("/trace.json"):
                     body = json.dumps(tel.perfetto_trace()).encode()
-                    ctype = "application/json"
+                elif self.path.startswith("/postmortem"):
+                    if tel.flight is None:
+                        self.send_error(404, "no flight recorder attached")
+                        return
+                    body = json.dumps(
+                        tel.flight.postmortem("manual",
+                                              detail={"source": "http"}),
+                        indent=2,
+                    ).encode()
                 elif self.path.startswith("/metrics"):
                     body = tel.prometheus_text().encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"

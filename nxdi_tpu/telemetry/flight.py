@@ -1,0 +1,422 @@
+"""Serving flight recorder: per-step engine timeline + postmortem capture.
+
+ROADMAP items 3 (replica router) and 5 (SLO-aware scheduling) both need to
+know what the engine *decided* each step — and "Kernel Looping" (PAPERS.md)
+argues the host-side sync boundary between dispatches is where decode
+latency hides. This module records both continuously: every
+``InferenceEngine.step()`` emits one :class:`StepRecord` into a bounded
+ring buffer, carrying
+
+- the scheduling decisions: admissions (with resume flag), chunk prefills,
+  the decode dispatch (rows occupied, multistep rung, padding rows),
+  preemptions (with the vacated slot), retirements,
+- the resource picture: free KV blocks, queue depth, busy slots,
+- the **host-vs-dispatch time split**: ``dispatch_s`` is the sum of the
+  step's per-program dispatch latencies (the existing
+  ``nxdi_dispatch_seconds`` path feeds it via
+  ``Telemetry.record_dispatch``, so there is ONE timing source); the
+  remainder ``host_s = wall - dispatch_s`` is host orchestration — the
+  sync-boundary cost Kernel Looping targets. At ``telemetry="full"``
+  dispatches block on device completion, so ``host_s`` is pure host
+  overhead; at ``"basic"`` dispatch is the async enqueue cost and the
+  device wait lands in ``host_s`` of whichever later step blocks.
+
+Trigger-based **postmortem capture**: on SLO breach (fed by
+:class:`~nxdi_tpu.telemetry.slo.SloTracker`), preemption storm
+(>= ``storm_preemptions`` recompute preemptions inside the last
+``storm_window`` steps), or a retrace-guard trip, the recorder dumps a JSON
+bundle — trigger, breaching request's span, every StepRecord overlapping
+its lifetime, scheduler queue state, and a full metrics snapshot — to
+``TelemetryConfig(postmortem_dir=...)``; a manual dump is reachable from
+``python -m nxdi_tpu.cli.flightrec`` and the ``/postmortem`` endpoint of
+``cli.metrics --serve`` / ``cli.serve --serve``.
+
+The ring rides the Perfetto export: one track per decode slot
+(prefill / decode / preempted segments) plus a host-overhead track, so a
+``cli.serve`` run opens in the Perfetto UI as a per-slot Gantt chart.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+from collections import deque
+from typing import Callable, Deque, Dict, List, Optional
+
+logger = logging.getLogger("nxdi_tpu")
+
+#: postmortem trigger names (the ``trigger`` field of every bundle)
+TRIGGERS = ("slo_breach", "preemption_storm", "retrace_guard", "manual")
+
+
+class StepRecord:
+    """One ``InferenceEngine.step()``: what the engine decided and where the
+    wall-clock went. A handful of small lists — never per-token."""
+
+    __slots__ = (
+        "step", "t_start", "t_end", "admitted", "prefills", "decode",
+        "preempted", "retired", "programs", "kv_blocks_free", "queue_depth",
+        "slots_busy", "dispatch_s", "host_s",
+    )
+
+    def __init__(self, step: int, t_start: float):
+        self.step = step
+        self.t_start = t_start
+        self.t_end: Optional[float] = None
+        #: [{request_id, slot, resumed}] — placements this step
+        self.admitted: List[dict] = []
+        #: [{request_id, slot, submodel, start, tokens}] — one per chunk
+        self.prefills: List[dict] = []
+        #: {submodel, steps, rows: [{slot, request_id}], batch, padding_rows}
+        self.decode: Optional[dict] = None
+        #: [{request_id, slot}] — slot is the row the victim vacated
+        self.preempted: List[dict] = []
+        #: [{request_id, slot, reason}]
+        self.retired: List[dict] = []
+        #: {(submodel, bucket, steps) -> {dispatches, seconds}} — fed by
+        #: Telemetry.record_dispatch while this step is open, so program
+        #: keys and latencies are EXACTLY what the registry saw
+        self.programs: Dict[tuple, Dict[str, float]] = {}
+        self.kv_blocks_free: Optional[int] = None
+        self.queue_depth = 0
+        self.slots_busy = 0
+        self.dispatch_s = 0.0
+        self.host_s = 0.0
+
+    @property
+    def wall_s(self) -> float:
+        return (self.t_end - self.t_start) if self.t_end is not None else 0.0
+
+    def overlaps(self, t0: float, t1: float) -> bool:
+        end = self.t_end if self.t_end is not None else self.t_start
+        return end >= t0 and self.t_start <= t1
+
+    def to_dict(self) -> dict:
+        return {
+            "step": self.step,
+            "t_start": self.t_start,
+            "t_end": self.t_end,
+            "wall_s": self.wall_s,
+            "dispatch_s": self.dispatch_s,
+            "host_s": self.host_s,
+            "admitted": list(self.admitted),
+            "prefills": list(self.prefills),
+            "decode": self.decode,
+            "preempted": list(self.preempted),
+            "retired": list(self.retired),
+            "programs": [
+                {
+                    "submodel": k[0], "bucket": k[1], "steps": k[2],
+                    "dispatches": v["dispatches"], "seconds": v["seconds"],
+                }
+                for k, v in sorted(self.programs.items())
+            ],
+            "kv_blocks_free": self.kv_blocks_free,
+            "queue_depth": self.queue_depth,
+            "slots_busy": self.slots_busy,
+        }
+
+
+class FlightRecorder:
+    """Bounded StepRecord ring + postmortem triggers, owned by one engine.
+
+    ``state_fn`` returns the scheduler's queue/slot state for bundles;
+    ``retrace_guard`` (optional) is polled every step for new violations.
+    Construction registers the engine-step metric families on the
+    telemetry registry (idempotent).
+    """
+
+    def __init__(
+        self,
+        telemetry,
+        num_slots: int,
+        max_records: int = 512,
+        postmortem_dir: Optional[str] = None,
+        storm_window: int = 32,
+        storm_preemptions: int = 8,
+        state_fn: Optional[Callable[[], dict]] = None,
+        retrace_guard=None,
+    ):
+        self.telemetry = telemetry
+        self.num_slots = int(num_slots)
+        self.max_records = int(max_records)
+        self.postmortem_dir = postmortem_dir
+        self.storm_window = int(storm_window)
+        self.storm_preemptions = int(storm_preemptions)
+        self.state_fn = state_fn
+        self.retrace_guard = retrace_guard
+        # one lock around the ring and the postmortem index: the engine
+        # thread appends while the MetricsServer thread (/trace.json,
+        # /postmortem, snapshot extras) iterates — an unguarded deque read
+        # raises "mutated during iteration" on the probe surface. The open
+        # record (``current``) stays engine-thread-only and lock-free.
+        self._lock = threading.Lock()
+        self.records: Deque[StepRecord] = deque()
+        self.records_dropped = 0
+        self.postmortems: List[dict] = []  # {trigger, step, path} (bounded)
+        self._bundle_seq = 0  # monotonic: filenames never collide
+        self.current: Optional[StepRecord] = None
+        # scheduling events raised BETWEEN steps (a forced preemption from a
+        # driver's before_step hook, a direct scheduler call) buffer here
+        # and fold into the NEXT step's record — they shape that step's
+        # decisions, and nothing may vanish just for arriving early
+        self._pending: List[tuple] = []
+        self._step_counter = 0
+        # rolling per-step preemption counts for the storm trigger: O(1)
+        # per step instead of rescanning the ring
+        self._recent_preempts: Deque[int] = deque()
+        self._recent_preempt_sum = 0
+        self._storm_fired_step: Optional[int] = None
+        self._seen_violations = (
+            len(retrace_guard.violations) if retrace_guard is not None else 0
+        )
+        r = telemetry.registry
+        self.steps_total = r.counter(
+            "nxdi_engine_steps_total", "InferenceEngine.step() iterations"
+        )
+        self.step_seconds = r.histogram(
+            "nxdi_engine_step_seconds", "wall-clock per engine step"
+        )
+        self.host_seconds = r.histogram(
+            "nxdi_engine_host_seconds",
+            "host-orchestration remainder per engine step (wall - dispatch)",
+        )
+        self.postmortems_total = r.counter(
+            "nxdi_postmortems_total", "postmortem bundles by trigger", ("trigger",)
+        )
+
+    # -- the per-step protocol (driven by InferenceEngine.step) -------------
+    def begin_step(self) -> StepRecord:
+        rec = StepRecord(self._step_counter, self.telemetry.clock())
+        self._step_counter += 1
+        self.current = rec
+        for field, entry in self._pending:
+            getattr(rec, field).append(entry)
+        self._pending.clear()
+        return rec
+
+    def _append(self, field: str, entry: dict) -> None:
+        rec = self.current
+        if rec is None:
+            self._pending.append((field, entry))
+        else:
+            getattr(rec, field).append(entry)
+
+    def _note_dispatch(
+        self, submodel: str, bucket, steps, seconds: float
+    ) -> None:
+        """Called by ``Telemetry.record_dispatch`` while a step is open: the
+        step's program attribution IS the registry's, never a re-derivation."""
+        rec = self.current
+        if rec is None:
+            return
+        key = (submodel, str(bucket), str(steps))
+        entry = rec.programs.get(key)
+        if entry is None:
+            entry = rec.programs[key] = {"dispatches": 0, "seconds": 0.0}
+        entry["dispatches"] += 1
+        entry["seconds"] += seconds
+        rec.dispatch_s += seconds
+
+    def record_admission(self, request_id, slot: int, resumed: bool) -> None:
+        self._append(
+            "admitted",
+            {"request_id": request_id, "slot": slot, "resumed": resumed},
+        )
+
+    def record_prefill(
+        self, request_id, slot, submodel: str, start: int, tokens: int
+    ) -> None:
+        self._append("prefills", {
+            "request_id": request_id, "slot": slot, "submodel": submodel,
+            "start": start, "tokens": tokens,
+        })
+
+    def record_decode(
+        self, submodel: str, steps: int, rows, batch: int
+    ) -> None:
+        if self.current is not None:
+            self.current.decode = {
+                "submodel": submodel,
+                "steps": steps,
+                "rows": [
+                    {"slot": slot, "request_id": r.request_id} for slot, r in rows
+                ],
+                "batch": batch,
+                "padding_rows": batch - len(rows),
+            }
+
+    def record_preemption(self, request_id, slot) -> None:
+        self._append("preempted", {"request_id": request_id, "slot": slot})
+
+    def record_retirement(self, request_id, slot, reason: str) -> None:
+        self._append(
+            "retired", {"request_id": request_id, "slot": slot, "reason": reason}
+        )
+
+    def end_step(
+        self,
+        queue_depth: int,
+        slots_busy: int,
+        kv_blocks_free: Optional[int],
+    ) -> StepRecord:
+        """Close the open record, fold it into the ring + metrics, and run
+        the step-scoped triggers (storm, retrace). Returns the record."""
+        rec = self.current
+        assert rec is not None, "end_step without begin_step"
+        self.current = None
+        rec.t_end = self.telemetry.clock()
+        rec.queue_depth = int(queue_depth)
+        rec.slots_busy = int(slots_busy)
+        rec.kv_blocks_free = kv_blocks_free
+        rec.host_s = max(rec.wall_s - rec.dispatch_s, 0.0)
+        with self._lock:
+            self.records.append(rec)
+            if len(self.records) > self.max_records:
+                self.records.popleft()
+                self.records_dropped += 1
+        self.steps_total.inc()
+        self.step_seconds.observe(rec.wall_s)
+        self.host_seconds.observe(rec.host_s)
+        self._check_storm(rec)
+        self._check_retrace(rec)
+        return rec
+
+    # -- triggers -----------------------------------------------------------
+    def _check_storm(self, rec: StepRecord) -> None:
+        if len(self._recent_preempts) == self.storm_window:
+            self._recent_preempt_sum -= self._recent_preempts.popleft()
+        self._recent_preempts.append(len(rec.preempted))
+        self._recent_preempt_sum += len(rec.preempted)
+        if self._storm_fired_step is not None and (
+            rec.step <= self._storm_fired_step + self.storm_window
+        ):
+            return  # cooldown: one bundle per storm, not one per step
+        n = self._recent_preempt_sum
+        if n >= self.storm_preemptions:
+            self._storm_fired_step = rec.step
+            self.postmortem(
+                "preemption_storm",
+                detail={
+                    "preemptions": n,
+                    "window_steps": self.storm_window,
+                    "threshold": self.storm_preemptions,
+                },
+            )
+
+    def _check_retrace(self, rec: StepRecord) -> None:
+        guard = self.retrace_guard
+        if guard is None:
+            return
+        n = len(guard.violations)
+        if n > self._seen_violations:
+            new = list(guard.violations[self._seen_violations:])
+            self._seen_violations = n
+            self.postmortem("retrace_guard", detail={"violations": new})
+
+    # -- queries (safe from any thread) -------------------------------------
+    @property
+    def steps(self) -> int:
+        """Engine steps begun so far (the /healthz liveness number)."""
+        return self._step_counter
+
+    def snapshot_records(self) -> List[StepRecord]:
+        """Consistent copy of the ring — what every cross-thread reader
+        (Perfetto export, bundles, CLI tables) iterates."""
+        with self._lock:
+            return list(self.records)
+
+    def records_overlapping(self, t0: float, t1: float) -> List[StepRecord]:
+        """Every retained StepRecord overlapping ``[t0, t1]`` (a request's
+        span window) in step order."""
+        return [r for r in self.snapshot_records() if r.overlaps(t0, t1)]
+
+    def summary(self) -> dict:
+        """Small dict for the JSON-snapshot extra (``_flight``) — the full
+        ring only travels in postmortem bundles and the Perfetto export."""
+        with self._lock:
+            last = self.records[-1] if self.records else None
+            n, dropped = len(self.records), self.records_dropped
+            postmortems = list(self.postmortems)
+        return {
+            "steps": self._step_counter,
+            "records": n,
+            "records_dropped": dropped,
+            "num_slots": self.num_slots,
+            "postmortems": postmortems,
+            "last_step": last.to_dict() if last is not None else None,
+        }
+
+    # -- postmortem capture -------------------------------------------------
+    def postmortem(
+        self,
+        trigger: str,
+        detail: Optional[dict] = None,
+        request_span=None,
+        request_id=None,
+    ) -> dict:
+        """Capture a bundle: trigger + breaching request's span + every
+        StepRecord overlapping its lifetime (the whole ring for span-less
+        triggers) + scheduler queue state + a full metrics snapshot. Written
+        to ``postmortem_dir`` when configured; always returned."""
+        if trigger not in TRIGGERS:
+            raise ValueError(f"trigger must be one of {TRIGGERS}, got {trigger!r}")
+        tel = self.telemetry
+        now = tel.clock()
+        if request_span is not None:
+            t0 = request_span.t_start
+            t1 = request_span.t_end if request_span.t_end is not None else now
+            records = self.records_overlapping(t0, t1)
+            span_dict = request_span.to_dict()
+        else:
+            records = self.snapshot_records()
+            span_dict = None
+        dropped = tel.spans_dropped_total.total() + self.records_dropped
+        bundle = {
+            "trigger": trigger,
+            "detail": detail or {},
+            "t": now,
+            "step": self._step_counter - 1,
+            "request_id": request_id,
+            "request_span": span_dict,
+            "step_records": [r.to_dict() for r in records],
+            "scheduler": self.state_fn() if self.state_fn is not None else None,
+            "metrics": tel.snapshot(),
+            # nonzero = the ring/span buffers evicted history this bundle
+            # can no longer show — read the timeline as truncated
+            "history_dropped": dropped,
+            "path": None,
+        }
+        self.postmortems_total.inc(trigger=trigger)
+        with self._lock:
+            seq = self._bundle_seq
+            self._bundle_seq += 1
+        if self.postmortem_dir is not None:
+            try:
+                os.makedirs(self.postmortem_dir, exist_ok=True)
+                name = (
+                    f"postmortem_{trigger}_step{bundle['step']}_{seq}.json"
+                )
+                path = os.path.join(self.postmortem_dir, name)
+                with open(path, "w") as f:
+                    json.dump(bundle, f, indent=2)
+                bundle["path"] = path
+            except OSError:
+                logger.warning(
+                    "flight recorder could not write the postmortem bundle; "
+                    "serving continues", exc_info=True,
+                )
+        with self._lock:
+            self.postmortems.append(
+                {"trigger": trigger, "step": bundle["step"],
+                 "path": bundle["path"]}
+            )
+            del self.postmortems[:-32]  # bound the index, keep the newest
+        logger.warning(
+            "flight recorder postmortem: trigger=%s step=%d%s",
+            trigger, bundle["step"],
+            f" -> {bundle['path']}" if bundle["path"] else " (in-memory)",
+        )
+        return bundle

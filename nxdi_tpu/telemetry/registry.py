@@ -187,6 +187,22 @@ class Histogram(_Metric):
         return percentile_from_buckets(self.bounds, s.counts, s.count, p)
 
 
+def percentile_exact(values: Sequence[float], p: float) -> float:
+    """Exact linear-interpolated percentile over raw values (numpy's
+    default convention). The ONE scalar-percentile rule for consumers that
+    still hold the individual measurements (per-request span metrics);
+    consumers that only have buckets use :func:`percentile_from_buckets`."""
+    xs = sorted(values)
+    if not xs:
+        return 0.0
+    if len(xs) == 1:
+        return float(xs[0])
+    pos = (len(xs) - 1) * min(max(p, 0.0), 100.0) / 100.0
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return float(xs[lo] + (xs[hi] - xs[lo]) * (pos - lo))
+
+
 def percentile_from_buckets(
     bounds: Sequence[float], counts: Sequence[int], total: int, p: float
 ) -> float:
@@ -254,7 +270,11 @@ class MetricsRegistry:
     # -- JSON snapshot ------------------------------------------------------
     def snapshot(self) -> dict:
         """JSON-able view of every non-empty series, with estimated
-        p50/p90/p99 for histograms (what ``--metrics-out`` and the CLI dump)."""
+        p50/p95/p99 for histograms (interpolated from the fixed log-spaced
+        buckets — what ``--metrics-out``, the CLI printout, and the SLO
+        tracker's measured readout use; ``goodput_summary`` keeps its gated
+        percentiles EXACT from the per-request metrics it still holds,
+        through the shared :func:`percentile_exact`)."""
         out: dict = {}
         for m in self.metrics():
             # consistent per-family copies: histograms snapshot counts/sum/
@@ -278,7 +298,7 @@ class MetricsRegistry:
                     }
                     if counts[-1]:
                         row["buckets"]["+Inf"] = counts[-1]
-                    for p in (50, 90, 99):
+                    for p in (50, 95, 99):
                         row[f"p{p}"] = percentile_from_buckets(
                             m.bounds, counts, count, p
                         )

@@ -1,0 +1,158 @@
+"""SLO attainment tracking over declared TTFT/TPOT targets.
+
+The serving comparison we benchmark against (the Gemma-on-Cloud-TPU study,
+PAPERS.md) evaluates on **SLO-conditioned goodput**: only requests whose
+latency met the declared targets count as served. This module is the
+measuring side of that contract. ``TpuConfig(slo=...)`` declares the
+targets (:class:`~nxdi_tpu.config.SloConfig`); the serving engine feeds
+every finished request's span-derived TTFT/TPOT through
+:meth:`SloTracker.observe`, which
+
+- classifies the request (attained, or breached per target — the breach is
+  STRICT ``value > target``, so hitting the target exactly attains it),
+- folds it into breach counters and a bounded rolling window,
+- refreshes the rolling ``nxdi_slo_attainment_pct`` and SLO-conditioned
+  ``nxdi_slo_goodput_tok_s`` gauges,
+- returns the breach kinds so the caller (the flight recorder's breach
+  trigger) can fire a postmortem.
+
+Metric catalog (labels in parens):
+
+========================================  =======  =========================
+``nxdi_slo_target_seconds``               gauge    (kind: ttft|tpot)
+``nxdi_slo_requests_total``               counter  (outcome: attained|breached)
+``nxdi_slo_breaches_total``               counter  (kind: ttft|tpot)
+``nxdi_slo_attainment_pct``               gauge    rolling window
+``nxdi_slo_goodput_tok_s``                gauge    rolling window
+========================================  =======  =========================
+
+One attainment rule: :func:`breach_kinds` is shared with
+:func:`nxdi_tpu.serving.workload.goodput_summary`, so the per-request bench
+fields and the rolling gauges can never classify the same request
+differently.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+
+def breach_kinds(
+    slo, ttft_s: Optional[float], tpot_s: Optional[float]
+) -> List[str]:
+    """Which declared targets the request broke (``[]`` = attained).
+
+    Only MEASURED latencies can breach: a ``None`` value means the metric
+    does not exist for this request (a single-token completion has no
+    inter-token time), so the target holds vacuously. Error-finished
+    requests never reach this function — the engine excludes them from SLO
+    accounting the same way goodput excludes them from served throughput.
+    """
+    kinds: List[str] = []
+    if slo.ttft_s is not None and ttft_s is not None and ttft_s > slo.ttft_s:
+        kinds.append("ttft")
+    if slo.tpot_s is not None and tpot_s is not None and tpot_s > slo.tpot_s:
+        kinds.append("tpot")
+    return kinds
+
+
+class SloTracker:
+    """Rolling SLO attainment over the telemetry registry.
+
+    The rolling window holds the last ``slo.window`` finished requests as
+    ``(t_finish, attained, tokens_out)``; the goodput gauge divides the
+    window's SLO-attaining tokens by the window's wall span (finish of the
+    oldest entry to finish of the newest), so a dashboard scrape reads
+    "tokens/s served within SLO lately", not a lifetime average.
+    """
+
+    def __init__(self, telemetry, slo):
+        self.telemetry = telemetry
+        self.slo = slo
+        r = telemetry.registry
+        self.target_seconds = r.gauge(
+            "nxdi_slo_target_seconds",
+            "declared SLO target per latency kind",
+            ("kind",),
+        )
+        self.requests_total = r.counter(
+            "nxdi_slo_requests_total",
+            "finished requests by SLO outcome",
+            ("outcome",),
+        )
+        self.breaches_total = r.counter(
+            "nxdi_slo_breaches_total",
+            "SLO breaches by latency kind (one request may breach both)",
+            ("kind",),
+        )
+        self.attainment_pct = r.gauge(
+            "nxdi_slo_attainment_pct",
+            "requests meeting every declared SLO target (rolling window)",
+        )
+        self.goodput_tok_s = r.gauge(
+            "nxdi_slo_goodput_tok_s",
+            "tokens/s from SLO-attaining requests (rolling window)",
+        )
+        if slo.ttft_s is not None:
+            self.target_seconds.set(slo.ttft_s, kind="ttft")
+        if slo.tpot_s is not None:
+            self.target_seconds.set(slo.tpot_s, kind="tpot")
+        self._window: Deque[Tuple[float, bool, int]] = deque(maxlen=slo.window)
+
+    def observe(
+        self,
+        ttft_s: Optional[float],
+        tpot_s: Optional[float],
+        tokens_out: int = 0,
+        t_finish: Optional[float] = None,
+    ) -> List[str]:
+        """Record one finished request; returns its breach kinds (``[]`` =
+        attained). ``t_finish`` defaults to the telemetry clock's now."""
+        kinds = breach_kinds(self.slo, ttft_s, tpot_s)
+        self.requests_total.inc(outcome="breached" if kinds else "attained")
+        for k in kinds:
+            self.breaches_total.inc(kind=k)
+        if t_finish is None:
+            t_finish = self.telemetry.clock()
+        self._window.append((t_finish, not kinds, int(tokens_out)))
+        self._refresh_gauges()
+        return kinds
+
+    def _refresh_gauges(self) -> None:
+        w = self._window
+        n = len(w)
+        attained = sum(1 for _, ok, _ in w if ok)
+        self.attainment_pct.set(100.0 * attained / n if n else 0.0)
+        span_s = w[-1][0] - w[0][0] if n > 1 else 0.0
+        if span_s > 0:
+            ok_tokens = sum(t for _, ok, t in w if ok)
+            self.goodput_tok_s.set(ok_tokens / span_s)
+        elif n:
+            # a single (or simultaneous) finish has no window span yet; the
+            # gauge stays directionally honest: all-attained reads as its
+            # token count, all-breached as zero
+            self.goodput_tok_s.set(float(sum(t for _, ok, t in w if ok)))
+
+    def to_dict(self) -> dict:
+        tel = self.telemetry
+        n = len(self._window)
+        return {
+            "targets": self.slo.to_dict(),
+            "window_requests": n,
+            "attainment_pct": self.attainment_pct.value(),
+            "goodput_tok_s": self.goodput_tok_s.value(),
+            "breaches": {
+                k: self.breaches_total.value(kind=k) for k in ("ttft", "tpot")
+            },
+            # measured latency vs target, through the registry's bucket
+            # estimator (Histogram.percentile) — the "how far from the SLO
+            # are we" readout a dashboard or router probe wants
+            "measured": {
+                f"{kind}_p{p}_s": hist.percentile(p)
+                for kind, hist in (
+                    ("ttft", tel.ttft_seconds), ("tpot", tel.tpot_seconds)
+                )
+                for p in (50, 95, 99)
+            },
+        }

@@ -127,12 +127,14 @@ NULL_SPAN = _NullSpan()
 
 
 class SpanTracker:
-    """Bounded ring of finished/active request spans."""
+    """Bounded ring of finished/active request spans. Overflow is NOT
+    silent: every eviction counts into ``nxdi_spans_dropped_total`` so a
+    postmortem reading the ring can flag truncated history."""
 
     def __init__(self, tel, max_spans: int = 256):
         self._tel = tel
         self.max_spans = int(max_spans)
-        self.spans: Deque[RequestSpan] = deque(maxlen=self.max_spans)
+        self.spans: Deque[RequestSpan] = deque()
         self._next_id = 0
 
     def start(self, tokens_in: int = 0, t_start: Optional[float] = None) -> RequestSpan:
@@ -147,6 +149,9 @@ class SpanTracker:
         if tokens_in:
             span.add_tokens_in(tokens_in)
         self.spans.append(span)
+        while len(self.spans) > self.max_spans:
+            self.spans.popleft()
+            self._tel.spans_dropped_total.inc()
         return span
 
     def reset(self) -> None:

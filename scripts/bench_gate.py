@@ -58,6 +58,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "serving_ttft_p95_ms": ("lower", 0.15),
     "serving_tpot_p50_ms": ("lower", 0.07),
     "serving_tpot_p95_ms": ("lower", 0.12),
+    # SLO-conditioned headline pair (PR: flight recorder + SLO monitor).
+    # Same skip-vs-older-baselines behavior as the serving_* fields.
+    # Attainment is a share of requests: near 100% the relative tolerance
+    # is effectively absolute; goodput_slo inherits the tail-latency noise
+    # (one extra breaching request moves it by a whole request's tokens).
+    "slo_attainment_pct": ("higher", 0.05),
+    "goodput_slo_tok_s": ("higher", 0.10),
 }
 
 

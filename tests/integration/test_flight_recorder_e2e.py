@@ -1,0 +1,337 @@
+"""Flight recorder + SLO monitor end to end (the PR's acceptance surface):
+
+- an SLO breach under cli.serve-style traffic fires a postmortem bundle
+  containing the breaching request's span, every StepRecord overlapping its
+  lifetime, scheduler queue state, and a full metrics snapshot;
+- the Perfetto export of the same run carries one track per decode slot
+  (prefill/decode/preempted segments) plus a host-overhead track;
+- the /healthz, /snapshot, and /postmortem endpoints answer with correct
+  content types;
+- the recorder adds <5% to ``InferenceEngine.step()`` when enabled;
+- ``python -m nxdi_tpu.cli.flightrec`` drives the Poisson workload,
+  captures breach bundles, and reads them back with ``--inspect``.
+"""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.serving import (
+    InferenceEngine,
+    SamplingParams,
+    SchedulerConfig,
+    goodput_summary,
+)
+
+P0 = [5, 9, 3, 17, 2, 8, 11, 42]
+P1 = [7, 13, 21, 4, 33]
+P2 = [9, 9, 2, 40, 17, 3]
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        ctx_batch_size=1,
+        tkg_batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        is_block_kv_layout=True,
+        pa_block_size=8,
+        pa_num_blocks=32,
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+# ---------------------------------------------------------------------------
+# SLO breach -> postmortem bundle (the acceptance anchor)
+# ---------------------------------------------------------------------------
+
+def test_slo_breach_fires_postmortem_with_full_context(tiny_hf_llama, tmp_path):
+    """Serve-style traffic against an unmeetable TTFT target: every request
+    breaches, and each bundle must reconstruct the breach — span, every
+    StepRecord overlapping the request's lifetime, scheduler state, and a
+    full metrics snapshot — from the postmortem file alone."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        telemetry={"detail": "basic", "postmortem_dir": str(tmp_path)},
+        slo={"ttft_s": 1e-9, "tpot_s": 10.0},
+    )
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+    ra = engine.add_request(P0, SamplingParams(max_new_tokens=6))
+    engine.step()
+    rb = engine.add_request(P1, SamplingParams(max_new_tokens=5))
+    outs = engine.run()
+    assert {o.finish_reason for o in outs} == {"length"}
+    # every request breached ttft (and only ttft)
+    assert all(o.metrics["slo_breaches"] == ["ttft"] for o in outs)
+    tel = app.telemetry
+    assert tel.registry.get("nxdi_slo_attainment_pct").value() == 0.0
+    assert tel.registry.get("nxdi_slo_breaches_total").value(kind="ttft") == 2
+    assert tel.registry.get("nxdi_slo_breaches_total").value(kind="tpot") == 0
+
+    files = sorted(tmp_path.glob("postmortem_slo_breach_*.json"))
+    assert len(files) == 2
+    bundles = {b["request_id"]: b for b in map(json.loads, (f.read_text() for f in files))}
+    assert set(bundles) == {ra.request_id, rb.request_id}
+
+    for req in (ra, rb):
+        bundle = bundles[req.request_id]
+        # the breaching request's span, with its real lifecycle
+        span = bundle["request_span"]
+        assert span is not None and span["t_end"] is not None
+        assert [p["name"] for p in span["phases"]] == ["queue", "prefill", "decode"]
+        assert span["tokens_out"] == len(req.generated)
+        # EVERY retained StepRecord overlapping the lifetime, none missing:
+        # recompute the overlap from the live ring and compare step ids
+        expected = [
+            r.step for r in engine.flight.records
+            if r.overlaps(span["t_start"], span["t_end"])
+        ]
+        got = [r["step"] for r in bundle["step_records"]]
+        assert got == expected and len(got) >= 2
+        # the record of the finishing step is included (postmortems fire
+        # after end_step), and it shows the retirement
+        assert any(
+            ret["request_id"] == req.request_id
+            for r in bundle["step_records"] for ret in r["retired"]
+        )
+        # scheduler state + full metrics snapshot ride along
+        assert "waiting" in bundle["scheduler"] and "slots" in bundle["scheduler"]
+        assert "nxdi_dispatches_total" in bundle["metrics"]
+        assert "nxdi_slo_attainment_pct" in bundle["metrics"]
+        assert bundle["metrics"]["_flight"]["num_slots"] == 2
+
+
+def test_slo_attained_run_and_preempted_request_counted_once(tiny_hf_llama):
+    """Generous targets + a forced preemption: the victim resumes, finishes,
+    and is observed by the SLO tracker exactly once (attained); no
+    postmortem fires."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        pa_block_size=4, pa_num_blocks=16,
+        slo={"ttft_s": 100.0, "tpot_s": 100.0},
+    )
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2, watermark_blocks=1))
+    engine.add_request(P0, SamplingParams(max_new_tokens=8))
+    engine.add_request(P1, SamplingParams(max_new_tokens=8))
+    engine.step()
+    victim = engine.preempt_youngest()
+    assert victim is not None
+    outs = engine.run()
+    assert len(outs) == 2
+    slo_total = app.telemetry.registry.get("nxdi_slo_requests_total")
+    assert slo_total.value(outcome="attained") == 2  # once per request
+    assert slo_total.value(outcome="breached") == 0
+    assert app.telemetry.registry.get("nxdi_slo_attainment_pct").value() == 100.0
+    assert engine.flight.postmortems == []
+    # the preemption is journaled with its vacated slot
+    preempted = [p for r in engine.flight.records for p in r.preempted]
+    assert any(p["request_id"] == victim.request_id for p in preempted)
+    # goodput_summary agrees through the SAME breach rule
+    s = goodput_summary(outs, 1.0, slo=app.tpu_config.slo)
+    assert s["slo_attainment_pct"] == 100.0
+    assert s["goodput_slo_tok_s"] == pytest.approx(
+        sum(len(o.token_ids) for o in outs), rel=0.01
+    )
+
+
+# ---------------------------------------------------------------------------
+# Perfetto: per-slot engine timeline
+# ---------------------------------------------------------------------------
+
+def test_perfetto_export_has_per_slot_and_host_tracks(tiny_hf_llama, tmp_path):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(hf_model, hf_cfg, pa_block_size=4, pa_num_blocks=16)
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2, watermark_blocks=1))
+    engine.add_request(P0, SamplingParams(max_new_tokens=6))
+    engine.add_request(P1, SamplingParams(max_new_tokens=6))
+    engine.step()
+    engine.preempt_youngest()  # a preempted segment must render too
+    engine.run()
+
+    path = tmp_path / "trace.json"
+    app.telemetry.write_perfetto_trace(str(path))
+    trace = json.loads(path.read_text())
+    engine_ev = [e for e in trace["traceEvents"] if e.get("pid") == 2]
+    tracks = {
+        e["args"]["name"]
+        for e in engine_ev if e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    # one track per decode slot + the host-overhead track
+    assert tracks == {"slot 0", "slot 1", "host overhead"}
+    names = {e["name"] for e in engine_ev if e["ph"] == "X"}
+    assert {"prefill", "decode", "preempted", "host"} <= names
+    # host slices: one per engine step, wall >= dispatch accounting
+    host = [e for e in engine_ev if e["ph"] == "X" and e["name"] == "host"]
+    assert len(host) == len(engine.flight.records)
+    for e in host:
+        assert e["dur"] >= 0
+        assert e["args"]["wall_ms"] >= e["args"]["dispatch_ms"] - 1e-6
+    # request spans still render on pid 1 alongside
+    assert any(
+        e.get("pid") == 1 and e.get("name") == "request"
+        for e in trace["traceEvents"]
+    )
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints (router-probe groundwork)
+# ---------------------------------------------------------------------------
+
+def test_http_healthz_snapshot_postmortem_endpoints(tiny_hf_llama):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(hf_model, hf_cfg)
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+    engine.add_request(P2, SamplingParams(max_new_tokens=3))
+    engine.run()
+    server = app.telemetry.serve(port=0)
+    try:
+        base = f"http://127.0.0.1:{server.port}"
+        with urllib.request.urlopen(f"{base}/healthz") as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert health["engine_steps"] == len(engine.flight.records)
+        assert health["requests_total"] == 1
+        with urllib.request.urlopen(f"{base}/snapshot") as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            snap = json.loads(resp.read())
+        assert "nxdi_dispatches_total" in snap and "_flight" in snap
+        with urllib.request.urlopen(f"{base}/postmortem") as resp:
+            assert resp.headers["Content-Type"] == "application/json"
+            bundle = json.loads(resp.read())
+        assert bundle["trigger"] == "manual"
+        assert bundle["detail"] == {"source": "http"}
+        assert len(bundle["step_records"]) == len(engine.flight.records)
+        with urllib.request.urlopen(f"{base}/metrics") as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+    finally:
+        server.shutdown()
+
+
+def test_http_postmortem_404_without_recorder(tiny_hf_llama):
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(hf_model, hf_cfg)  # no engine -> no flight attached
+    server = app.telemetry.serve(port=0)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/postmortem"
+            )
+        assert exc.value.code == 404
+    finally:
+        server.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# overhead smoke: recorder-enabled step() within 5%
+# ---------------------------------------------------------------------------
+
+def test_recorder_step_overhead_under_5pct(tiny_hf_llama):
+    """Interleave recorder-on / recorder-off engine steps over a steady
+    2-row decode (ABBA blocks so drift cancels symmetrically) and compare
+    the per-parity FLOORS: the acceptance bound is <5%. The floor (min over
+    ~30 identical steps) is the honest estimator here — medians of ~2 ms
+    CPU steps carry scheduler noise an order of magnitude above the
+    recorder's actual per-step cost."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(hf_model, hf_cfg, seq_len=128)
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+    # budgets large enough that the measured window is pure steady decode
+    engine.add_request(P0, SamplingParams(max_new_tokens=110))
+    engine.add_request(P1, SamplingParams(max_new_tokens=110))
+    for _ in range(6):  # prefills + warm both step paths
+        engine.step()
+
+    fl, tel = engine.flight, app.telemetry
+
+    def set_recorder(on: bool):
+        engine.flight = fl if on else None
+        engine.scheduler.flight = fl if on else None
+        tel.flight = fl if on else None
+
+    on_ms, off_ms = [], []
+    pattern = [True, False, False, True]
+    for i in range(60):
+        on = pattern[i % 4]
+        set_recorder(on)
+        t0 = time.perf_counter()
+        engine.step()
+        (on_ms if on else off_ms).append((time.perf_counter() - t0) * 1e3)
+    set_recorder(True)
+    on_min, off_min = min(on_ms), min(off_ms)
+    assert on_min - off_min < 0.05 * off_min, (on_min, off_min)
+
+
+# ---------------------------------------------------------------------------
+# the flightrec CLI (cli.serve-style Poisson traffic, end to end)
+# ---------------------------------------------------------------------------
+
+def test_flightrec_cli_end_to_end(tmp_path, capsys):
+    """``python -m nxdi_tpu.cli.flightrec`` under an unmeetable TTFT SLO:
+    the Poisson workload completes, breach bundles land in --out, the
+    manual bundle and the per-slot Perfetto Gantt are written, and
+    --inspect reads a bundle back."""
+    from nxdi_tpu.cli.flightrec import main
+
+    out_dir = tmp_path / "pm"
+    bundle_path = tmp_path / "manual.json"
+    trace_path = tmp_path / "gantt.json"
+    rc = main([
+        "--requests", "6",
+        "--rate", "200",
+        "--max-new-tokens", "4",
+        "--slots", "3",
+        "--slo-ttft-ms", "0.001",
+        "--out", str(out_dir),
+        "--bundle", str(bundle_path),
+        "--perfetto", str(trace_path),
+        "-q",
+    ])
+    assert rc == 0
+    table = capsys.readouterr().out
+    assert "wall_ms" in table and "host_ms" in table  # the timeline printed
+
+    breach_files = sorted(out_dir.glob("postmortem_slo_breach_*.json"))
+    assert breach_files, "an unmeetable TTFT target must fire breach bundles"
+    bundle = json.loads(breach_files[0].read_text())
+    assert bundle["request_span"] is not None
+    assert bundle["step_records"]
+
+    manual = json.loads(bundle_path.read_text())
+    assert manual["trigger"] == "manual" and manual["step_records"]
+
+    trace = json.loads(trace_path.read_text())
+    tracks = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("pid") == 2 and e["ph"] == "M" and e["name"] == "thread_name"
+    }
+    assert tracks == {"slot 0", "slot 1", "slot 2", "host overhead"}
+
+    assert main(["--inspect", str(breach_files[0])]) == 0
+    inspected = capsys.readouterr().out
+    assert "trigger:   slo_breach" in inspected

@@ -1,0 +1,179 @@
+"""SLO tracker unit suite (nxdi_tpu/telemetry/slo.py): attainment edge
+cases under an injected clock — breach exactly at the target, unmeasured
+latencies, rolling attainment/goodput gauges, breach counters — plus
+SloConfig validation and the shared breach rule goodput_summary uses."""
+
+import pytest
+
+from nxdi_tpu.config import SloConfig
+from nxdi_tpu.telemetry import SloTracker, Telemetry, breach_kinds
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_tracker(**slo_kwargs):
+    clock = FakeClock()
+    tel = Telemetry(clock=clock)
+    slo = SloConfig(**(slo_kwargs or dict(ttft_s=0.5, tpot_s=0.05)))
+    return SloTracker(tel, slo), tel, clock
+
+
+# ---------------------------------------------------------------------------
+# SloConfig validation
+# ---------------------------------------------------------------------------
+
+def test_slo_config_validation():
+    cfg = SloConfig(ttft_s=0.5)
+    assert cfg.ttft_s == 0.5 and cfg.tpot_s is None and cfg.window == 256
+    with pytest.raises(ValueError, match="at least one"):
+        SloConfig(window=8)
+    with pytest.raises(ValueError, match="positive"):
+        SloConfig(ttft_s=-1.0)
+    with pytest.raises(ValueError, match="positive"):
+        SloConfig(tpot_s=0.0)
+    with pytest.raises(ValueError, match="window"):
+        SloConfig(ttft_s=1.0, window=0)
+    with pytest.raises(ValueError, match="Unknown"):
+        SloConfig(ttft_s=1.0, nope=3)
+
+
+def test_tpu_config_accepts_slo_dict_and_roundtrips():
+    from nxdi_tpu.config import TpuConfig
+
+    tc = TpuConfig(tp_degree=1, batch_size=1, slo={"ttft_s": 0.25, "tpot_s": 0.02})
+    assert isinstance(tc.slo, SloConfig)
+    assert tc.slo.ttft_s == 0.25
+    tc2 = TpuConfig.from_dict(tc.to_dict())
+    assert isinstance(tc2.slo, SloConfig) and tc2.slo.tpot_s == 0.02
+    assert TpuConfig(tp_degree=1, batch_size=1).slo is None
+
+
+# ---------------------------------------------------------------------------
+# the breach rule (shared with serving/workload.goodput_summary)
+# ---------------------------------------------------------------------------
+
+def test_breach_exactly_at_target_attains():
+    slo = SloConfig(ttft_s=0.5, tpot_s=0.05)
+    # exactly AT the target attains — the breach is strict >
+    assert breach_kinds(slo, 0.5, 0.05) == []
+    assert breach_kinds(slo, 0.5 + 1e-9, 0.05) == ["ttft"]
+    assert breach_kinds(slo, 0.5, 0.05 + 1e-9) == ["tpot"]
+    assert breach_kinds(slo, 1.0, 1.0) == ["ttft", "tpot"]
+
+
+def test_unmeasured_latency_holds_vacuously():
+    slo = SloConfig(ttft_s=0.5, tpot_s=0.05)
+    # a 1-token completion has no inter-token time: tpot target holds
+    assert breach_kinds(slo, 0.1, None) == []
+    assert breach_kinds(slo, None, None) == []
+    # an undeclared target never breaches, whatever was measured
+    assert breach_kinds(SloConfig(ttft_s=0.5), 0.1, 99.0) == []
+
+
+# ---------------------------------------------------------------------------
+# tracker: counters + rolling gauges
+# ---------------------------------------------------------------------------
+
+def test_tracker_counters_and_target_gauges():
+    tracker, tel, clock = make_tracker()
+    assert tracker.target_seconds.value(kind="ttft") == 0.5
+    assert tracker.target_seconds.value(kind="tpot") == 0.05
+
+    assert tracker.observe(0.5, 0.05, tokens_out=4) == []      # at-target
+    assert tracker.observe(0.6, 0.01, tokens_out=4) == ["ttft"]
+    assert tracker.observe(0.7, 0.06, tokens_out=4) == ["ttft", "tpot"]
+    assert tracker.requests_total.value(outcome="attained") == 1
+    assert tracker.requests_total.value(outcome="breached") == 2
+    assert tracker.breaches_total.value(kind="ttft") == 2
+    assert tracker.breaches_total.value(kind="tpot") == 1
+    d = tracker.to_dict()
+    assert d["window_requests"] == 3
+    assert d["breaches"] == {"ttft": 2.0, "tpot": 1.0}
+
+
+def test_rolling_attainment_and_goodput_gauges():
+    tracker, tel, clock = make_tracker(ttft_s=0.5, window=4)
+    # 4 finishes, one second apart: 3 attained x 10 tokens, 1 breached
+    for i, (ttft, toks) in enumerate(
+        [(0.1, 10), (0.2, 10), (0.9, 10), (0.3, 10)]
+    ):
+        clock.advance(1.0)
+        tracker.observe(ttft, None, tokens_out=toks)
+    assert tracker.attainment_pct.value() == 75.0
+    # window spans 3 s (first to last finish); 30 attained tokens inside
+    assert tracker.goodput_tok_s.value() == pytest.approx(30.0 / 3.0)
+    # the window is bounded: 4 more attained finishes evict the breach
+    for _ in range(4):
+        clock.advance(1.0)
+        tracker.observe(0.1, None, tokens_out=5)
+    assert tracker.attainment_pct.value() == 100.0
+
+
+def test_single_finish_has_no_window_span_yet():
+    tracker, tel, clock = make_tracker(ttft_s=0.5)
+    tracker.observe(0.1, None, tokens_out=7)
+    assert tracker.attainment_pct.value() == 100.0
+    # no span to divide by yet: the gauge reads the attained token count
+    assert tracker.goodput_tok_s.value() == 7.0
+
+
+def test_goodput_summary_exact_percentiles_and_slo_fields():
+    """goodput_summary keeps its gated percentiles EXACT over the
+    per-request span metrics (the bucket estimator would quantize the bench
+    trajectory), through the shared percentile_exact rule, and derives the
+    SLO-conditioned headline pair through breach_kinds."""
+    from nxdi_tpu.serving import RequestOutput
+    from nxdi_tpu.serving.workload import goodput_summary
+    from nxdi_tpu.telemetry import percentile_exact
+
+    outs = [
+        RequestOutput(
+            request_id=i, prompt=[1], token_ids=[2, 3, 4],
+            finish_reason="length",
+            metrics={"ttft_s": t, "tpot_s": 0.01, "preemptions": 0},
+        )
+        for i, t in enumerate((0.1, 0.2, 0.3, 0.4))
+    ]
+    s = goodput_summary(outs, 2.0)
+    assert s["ttft_p50_ms"] == 250.0  # exact interpolation, not a bucket
+    assert s["ttft_p95_ms"] == round(
+        percentile_exact([0.1, 0.2, 0.3, 0.4], 95) * 1e3, 2
+    )
+    assert s["tok_s"] == 6.0 and "slo_attainment_pct" not in s
+    # percentile_exact matches numpy's linear convention
+    assert percentile_exact([0.1, 0.2, 0.3, 0.4], 95) == pytest.approx(0.385)
+    assert percentile_exact([], 50) == 0.0
+    assert percentile_exact([3.0], 95) == 3.0
+
+    # SLO fields: 0.1 and 0.2 attain a 0.25 s TTFT target -> 50%, and only
+    # their tokens count toward the conditioned goodput
+    s3 = goodput_summary(outs, 2.0, slo=SloConfig(ttft_s=0.25))
+    assert s3["slo_attainment_pct"] == 50.0
+    assert s3["goodput_slo_tok_s"] == 3.0
+
+
+def test_preempted_then_finished_counts_once():
+    """A preempted request is only OBSERVED at its final finish — the
+    tracker has no partial-observation path, so one request can never be
+    double-counted no matter how many times it was evicted and resumed.
+    The engine-side contract (observe called from _finish only, error
+    finishes excluded) is pinned in the integration suite."""
+    tracker, tel, clock = make_tracker()
+    # the resumed request keeps its ORIGINAL first-token ttft (idempotent
+    # span.first_token): one observe with the final metrics
+    kinds = tracker.observe(0.45, 0.04, tokens_out=12)
+    assert kinds == []
+    total = (
+        tracker.requests_total.value(outcome="attained")
+        + tracker.requests_total.value(outcome="breached")
+    )
+    assert total == 1

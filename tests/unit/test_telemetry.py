@@ -107,7 +107,9 @@ def test_snapshot_and_reset_keep_catalog():
     assert snap["c_total"]["series"] == [{"labels": {"k": "a"}, "value": 1.0}]
     row = snap["h_seconds"]["series"][0]
     assert row["count"] == 1 and row["buckets"] == {"0.1": 1}
-    assert "p50" in row and "p99" in row
+    # the snapshot's estimator emits p50/p95/p99 (the SLO-relevant tail)
+    assert "p50" in row and "p95" in row and "p99" in row
+    assert "p90" not in row
     json.dumps(snap)  # JSON-able end to end
     r.reset()
     assert r.snapshot() == {}  # series gone...
