@@ -852,6 +852,69 @@ def _flag_value(name, default):
     return type(default)(sys.argv[idx + 1])
 
 
+def _build_serving_stack(
+    slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+    replica_id=None, rng=None,
+):
+    """One loaded full-depth 1B app + engine for the serving/fleet bench.
+
+    ``rng`` draws the random weights and is NOT reset afterwards — the
+    single-replica bench passes its workload rng through so the
+    arrival/prompt stream continues from the post-weights state exactly as
+    before this helper existed (a changed sample would read as a phantom
+    shift against the recorded trajectory baselines)."""
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+    from nxdi_tpu.serving import InferenceEngine, SchedulerConfig
+
+    block = 128
+    tcfg = TpuConfig(
+        tp_degree=1,
+        batch_size=slots,
+        ctx_batch_size=1,
+        tkg_batch_size=slots,
+        seq_len=seq_len,
+        max_context_length=prompt_len,
+        dtype="bfloat16",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        is_block_kv_layout=True,
+        pa_block_size=block,
+        # every slot can hold a full window plus one block of headroom for
+        # the admission watermark
+        pa_num_blocks=slots * (-(-seq_len // block)) + slots,
+        skip_warmup=False,
+        slo={"ttft_s": slo_ttft_ms / 1e3, "tpot_s": slo_tpot_ms / 1e3},
+        telemetry={"detail": "basic", "replica_id": replica_id},
+    )
+    cfg = ml.LlamaInferenceConfig(
+        tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
+        num_hidden_layers=n_layers, num_attention_heads=N_HEADS,
+        num_key_value_heads=N_KV_HEADS, head_dim=HEAD_DIM,
+        vocab_size=VOCAB, rms_norm_eps=1e-5, rope_theta=500000.0,
+    )
+    if rng is None:
+        rng = np.random.default_rng(0)
+    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
+    state = jtu.tree_map(
+        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16
+        ),
+        struct,
+    )
+
+    class App(TpuModelForCausalLM):
+        def build_params(self):
+            return state
+
+    app = App("<random>", cfg, model_family=ml)
+    app.load()
+    return app, InferenceEngine(app, SchedulerConfig(num_slots=slots))
+
+
 def main_serving(
     requests=32,
     rate=16.0,
@@ -875,61 +938,17 @@ def main_serving(
     pathologies breach). One JSON line, gated by scripts/bench_gate.py
     (serving_* and slo metrics; older trajectory files without them are
     skipped, not failed)."""
-    import jax.tree_util as jtu
-    import ml_dtypes
+    from nxdi_tpu.serving import SamplingParams, drive_arrivals, goodput_summary
 
-    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
-    from nxdi_tpu.models.llama import modeling_llama as ml
-    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
-    from nxdi_tpu.serving import (
-        InferenceEngine,
-        SamplingParams,
-        SchedulerConfig,
-        drive_arrivals,
-        goodput_summary,
-    )
-
-    block = 128
-    tcfg = TpuConfig(
-        tp_degree=1,
-        batch_size=slots,
-        ctx_batch_size=1,
-        tkg_batch_size=slots,
-        seq_len=seq_len,
-        max_context_length=prompt_len,
-        dtype="bfloat16",
-        on_device_sampling_config=OnDeviceSamplingConfig(),
-        is_block_kv_layout=True,
-        pa_block_size=block,
-        # every slot can hold a full window plus one block of headroom for
-        # the admission watermark
-        pa_num_blocks=slots * (-(-seq_len // block)) + slots,
-        skip_warmup=False,
-        slo={"ttft_s": slo_ttft_ms / 1e3, "tpot_s": slo_tpot_ms / 1e3},
-    )
-    cfg = ml.LlamaInferenceConfig(
-        tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
-        num_hidden_layers=n_layers, num_attention_heads=N_HEADS,
-        num_key_value_heads=N_KV_HEADS, head_dim=HEAD_DIM,
-        vocab_size=VOCAB, rms_norm_eps=1e-5, rope_theta=500000.0,
-    )
+    # ONE rng stream for weights THEN arrivals/prompts, exactly as before
+    # the stack builder was factored out — the workload sample must not
+    # shift against the recorded trajectory baselines
     rng = np.random.default_rng(0)
-    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
-    state = jtu.tree_map(
-        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
-            ml_dtypes.bfloat16
-        ),
-        struct,
+    app, engine = _build_serving_stack(
+        slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+        rng=rng,
     )
-
-    class App(TpuModelForCausalLM):
-        def build_params(self):
-            return state
-
-    app = App("<random>", cfg, model_family=ml)
-    app.load()
-    engine = InferenceEngine(app, SchedulerConfig(num_slots=slots))
-
+    tcfg = app.tpu_config
     arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
     prompts = [
         rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
@@ -980,6 +999,132 @@ def main_serving(
     return rec
 
 
+def main_fleet_serving(
+    replicas=2,
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+):
+    """``bench.py --serving --replicas N``: N in-process engines behind the
+    fleet observatory (telemetry/fleet.py). Each replica runs its own
+    full-depth 1B engine with a stable ``replica_id``, serves ``/snapshot``
+    on an ephemeral port, and takes an independent Poisson arrival stream
+    at ``rate / N`` req/s with ``requests / N`` requests (same total
+    offered load as the single-replica line); the replica driver threads
+    run concurrently, so host contention produces REAL stragglers. The
+    :class:`FleetMonitor` polls the fleet over localhost HTTP — the same
+    path a production monitor takes — and the record emits the fleet
+    headline fields gated one-sided by scripts/bench_gate.py:
+
+    - ``fleet_goodput_req_s`` / ``fleet_tok_s`` — summed served work over
+      the slowest replica's wall (the fleet is done when its straggler is);
+    - ``fleet_straggler_gap_pct`` — ``100 * (1 - min/max)`` over the
+      per-replica tok/s: the spread the future router's least-loaded
+      dispatch exists to close;
+    - ``fleet_slo_attainment_pct`` — pooled over every replica's requests
+      through the ONE breach rule (serving/workload.goodput_summary).
+    """
+    import threading
+
+    from nxdi_tpu.config import FleetConfig
+    from nxdi_tpu.serving import SamplingParams, drive_arrivals, goodput_summary
+    from nxdi_tpu.telemetry.fleet import FleetMonitor
+
+    per_replica = max(requests // replicas, 1)
+    per_rate = rate / replicas
+    stacks, servers, targets = [], [], []
+    for i in range(replicas):
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            replica_id=f"bench-r{i}",
+        )
+        server = app.telemetry.serve(port=0)
+        stacks.append((app, engine))
+        servers.append(server)
+        targets.append((f"bench-r{i}", server.url))
+
+    monitor = FleetMonitor(targets, config=FleetConfig(staleness_s=3600.0))
+
+    results = [None] * replicas
+
+    def drive(i):
+        app, engine = stacks[i]
+        rng = np.random.default_rng(100 + i)
+        arrivals = np.cumsum(rng.exponential(1.0 / per_rate, size=per_replica))
+        prompts = [
+            rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+            .astype(np.int32).tolist()
+            for _ in range(per_replica)
+        ]
+        outputs, wall = drive_arrivals(
+            engine,
+            arrivals,
+            lambda eng, j, arrival_s: eng.add_request(
+                prompts[j],
+                SamplingParams(max_new_tokens=max_new),
+                arrival_s=arrival_s,
+            ),
+        )
+        results[i] = (outputs, wall)
+
+    threads = [
+        threading.Thread(target=drive, args=(i,), daemon=True)
+        for i in range(replicas)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    monitor.poll()
+
+    slo = stacks[0][0].tpu_config.slo
+    per_summaries = [
+        goodput_summary(outs, wall, slo=slo) for outs, wall in results
+    ]
+    all_outputs = [o for outs, _ in results for o in outs]
+    max_wall = max(wall for _, wall in results)
+    pooled = goodput_summary(all_outputs, max_wall, slo=slo)
+    tok_s = [s["tok_s"] for s in per_summaries]
+    gap_pct = (
+        round(100.0 * (1.0 - min(tok_s) / max(tok_s)), 2)
+        if max(tok_s) > 0 else 0.0
+    )
+    rec = {
+        "metric": "llama3.2-1b_fleet_serving_goodput",
+        "value": pooled["goodput_req_s"],
+        "unit": "req/s",
+        "fleet_replicas": replicas,
+        "fleet_goodput_req_s": pooled["goodput_req_s"],
+        "fleet_tok_s": pooled["tok_s"],
+        "fleet_straggler_gap_pct": gap_pct,
+        "fleet_slo_attainment_pct": pooled["slo_attainment_pct"],
+        "fleet_goodput_slo_tok_s": pooled["goodput_slo_tok_s"],
+        "slo_ttft_ms": slo_ttft_ms,
+        "slo_tpot_ms": slo_tpot_ms,
+        "fleet_per_replica_tok_s": tok_s,
+        "fleet_states": {
+            rep.label: rep.state for rep in monitor.replicas
+        },
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged x{replicas} replicas "
+            f"slots{slots} kv{seq_len} prompt~{prompt_len} max_new{max_new} "
+            f"tp1 rate{per_rate:g}/replica"
+        ),
+        "mode": "fleet_continuous_batching",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots({"fleet": monitor.snapshot()}, metrics_out_path())
+    for server in servers:
+        server.shutdown()
+    return rec
+
+
 if __name__ == "__main__":
     if "--8b-only" in sys.argv:
         main_8b_only()
@@ -989,7 +1134,7 @@ if __name__ == "__main__":
         idx = sys.argv.index("--decode-steps-per-dispatch")
         main_multistep(int(sys.argv[idx + 1]))
     elif "--serving" in sys.argv:
-        main_serving(
+        _serving_kwargs = dict(
             requests=_flag_value("--serving-requests", 32),
             rate=_flag_value("--serving-rate", 16.0),
             slots=_flag_value("--serving-slots", 8),
@@ -997,5 +1142,10 @@ if __name__ == "__main__":
             slo_ttft_ms=_flag_value("--serving-slo-ttft-ms", 4000.0),
             slo_tpot_ms=_flag_value("--serving-slo-tpot-ms", 25.0),
         )
+        _replicas = _flag_value("--replicas", 1)
+        if _replicas > 1:
+            main_fleet_serving(replicas=_replicas, **_serving_kwargs)
+        else:
+            main_serving(**_serving_kwargs)
     else:
         main()

@@ -114,12 +114,8 @@ def expected_collective_budget(
              "attention-DP: cross-group reduction")
 
     if getattr(arch, "moe", None) is not None:
-        _add(budget, explain, "all-to-all", 4 * body_scale,
-             "MoE: token dispatch/combine over the expert axis")
-        _add(budget, explain, "all-gather", 4 * body_scale,
-             "MoE: router logits / expert outputs regrouped")
-        _add(budget, explain, "all-reduce", 2 * body_scale,
-             "MoE: expert-parallel partial-sum reduction")
+        _moe_budget(budget, explain, tc, arch.moe, decode_like, body_scale,
+                    world)
 
     if tc.quantized:
         _add(budget, explain, "all-reduce", 1 * body_scale,
@@ -132,6 +128,75 @@ def expected_collective_budget(
              "pipeline parallel: final-stage output broadcast")
 
     return budget, explain
+
+
+def _moe_budget(
+    budget: Dict[str, int],
+    explain: List[str],
+    tc,
+    moe,
+    decode_like: bool,
+    body_scale: int,
+    world: int,
+) -> None:
+    """MoE dispatch/combine collective budget.
+
+    **TPxEP meshes** (an explicit ``moe_ep_degree`` or a
+    ``hybrid_sharding_config``) get EXACT derived counts instead of the old
+    generous flat budget: the sparse MoE path (ops/moe.py ``_sparse_moe``)
+    dispatches tokens by a LOCAL gather inside ``shard_map`` (every shard
+    holds the replicated token stream) and combines with **one psum over
+    the (ep[, epx], tp) world** per layer body — so the budget is one
+    all-reduce per body (plus one for the always-on shared expert), and
+    **zero** all-to-all / all-gather. The degrees come from the CONFIG
+    (``moe_ep_degree`` / ``hybrid_sharding_config.moe_{cte,tkg}_ep_degree``
+    with the per-phase regime picked by the submodel kind), never from the
+    compiled arch — a regime typo must blow past the budget, not raise it.
+
+    Regimes WITHOUT declared degrees (full-world EP from the family
+    builder's ``ep_policy``, expert-internal TP, dense dispatch) keep the
+    flat allowance: GSPMD owns their lowering and its collective pattern is
+    not pinned by this repo's code.
+    """
+    hsc = getattr(tc, "hybrid_sharding_config", None)
+    ep_degree = None
+    if hsc is not None:
+        ep_degree = (
+            hsc.moe_tkg_ep_degree if decode_like else hsc.moe_cte_ep_degree
+        )
+        regime = (
+            f"per-phase hybrid TPxEP ({'tkg' if decode_like else 'cte'} "
+            f"regime: moe_{'tkg' if decode_like else 'cte'}_ep_degree="
+            f"{ep_degree})"
+        )
+    elif getattr(tc, "moe_ep_degree", None) and tc.moe_ep_degree > 1:
+        ep_degree = tc.moe_ep_degree
+        regime = f"hybrid TPxEP (moe_ep_degree={ep_degree})"
+
+    sparse = getattr(tc, "moe_dispatch", "sparse") == "sparse"
+    if ep_degree is not None and sparse:
+        tp_inner = max(world // ep_degree, 1)
+        n_ar = 1
+        why = (
+            f"MoE {regime} x tp={tp_inner}: sparse dispatch is a local "
+            "gather; combine is ONE psum over the (ep, tp) world"
+        )
+        if getattr(moe, "shared_expert_intermediate_size", None):
+            n_ar += 1
+            why += "; +1 shared-expert row-parallel psum"
+        _add(budget, explain, "all-reduce", n_ar * body_scale, why)
+        explain.append(
+            "+0 all-to-all, +0 all-gather: TPxEP dispatch/combine counts "
+            "derived from moe_*_degree (no flat allowance)"
+        )
+        return
+
+    _add(budget, explain, "all-to-all", 4 * body_scale,
+         "MoE: token dispatch/combine over the expert axis")
+    _add(budget, explain, "all-gather", 4 * body_scale,
+         "MoE: router logits / expert outputs regrouped")
+    _add(budget, explain, "all-reduce", 2 * body_scale,
+         "MoE: expert-parallel partial-sum reduction")
 
 
 def over_budget(

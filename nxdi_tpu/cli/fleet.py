@@ -1,0 +1,253 @@
+"""``python -m nxdi_tpu.cli.fleet`` — the fleet observatory's operator
+surface.
+
+Points a :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor` at N replica
+``/snapshot`` endpoints (every ``cli.serve --serve`` / ``cli.metrics
+--serve`` process exposes one) and renders the fleet: a live per-replica
+table (state, snapshot age, queue depth, busy slots, KV headroom, SLO
+attainment, load score), merged ``nxdi_fleet_*`` Prometheus text / JSON,
+the merged multi-replica Perfetto trace, and a ``--serve`` federation
+endpoint answering the SAME probe paths as a single replica.
+
+Modes:
+
+- ``--once`` (default): one poll round, print the table (or ``--format
+  json/prom``), exit **non-zero when any replica is unreachable** — the
+  scriptable fleet smoke (tier-1 runs it against two in-process replicas).
+- ``--watch``: poll every ``--poll-interval`` seconds, reprinting the
+  table until interrupted.
+- ``--serve``: keep polling in the background and serve the federated
+  /metrics, /metrics.json, /snapshot, /healthz, /trace.json.
+- ``--demo N``: no fleet handy — spin up N in-process tiny-llama replicas
+  (the same reference app cli.serve drives), run a short serving burst on
+  each, and observe them over real localhost HTTP.
+
+Usage:
+
+  # one table of an existing fleet
+  python -m nxdi_tpu.cli.fleet http://10.0.0.1:9400 http://10.0.0.2:9400 --once
+
+  # name the replicas, keep watching
+  python -m nxdi_tpu.cli.fleet a=http://h1:9400 b=http://h2:9400 --watch
+
+  # zero-setup demo fleet + federation endpoint
+  python -m nxdi_tpu.cli.fleet --demo 2 --serve --port 9500
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import List, Optional
+
+from nxdi_tpu.telemetry.fleet import UNREACHABLE, FleetMonitor
+
+
+def setup_fleet_parser(p: argparse.ArgumentParser) -> None:
+    p.add_argument("targets", nargs="*",
+                   help="replica base URLs (http://host:port), optionally "
+                        "named as name=url")
+    p.add_argument("--once", action="store_true",
+                   help="one poll round, print, exit 1 on unreachable "
+                        "replicas (default mode)")
+    p.add_argument("--watch", action="store_true",
+                   help="poll repeatedly, reprinting the table")
+    p.add_argument("--serve", action="store_true",
+                   help="serve the federated /metrics, /snapshot, /healthz, "
+                        "/trace.json while polling in the background")
+    p.add_argument("--demo", type=int, default=0, metavar="N",
+                   help="spin up N in-process tiny reference replicas on "
+                        "ephemeral ports and observe those")
+    p.add_argument("--poll-interval", type=float, default=1.0,
+                   help="seconds between poll rounds (FleetConfig.poll_interval_s)")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-replica HTTP timeout seconds")
+    p.add_argument("--staleness", type=float, default=10.0,
+                   help="snapshot age (vs its own _process.snapshot_unix_s) "
+                        "beyond which a poll counts as failed")
+    p.add_argument("--unreachable-after", type=int, default=3,
+                   help="consecutive failed polls before UNREACHABLE")
+    p.add_argument("--format", choices=["table", "json", "prom"],
+                   default="table")
+    p.add_argument("--json", dest="json_path", default=None,
+                   help="also write the fleet JSON snapshot to this file")
+    p.add_argument("--perfetto", dest="perfetto_path", default=None,
+                   help="write the merged multi-replica Perfetto trace here")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=9500,
+                   help="federation endpoint port (--serve; 0 = ephemeral)")
+    p.add_argument("--demo-requests", type=int, default=4,
+                   help="serving burst per demo replica (--demo)")
+    p.add_argument("-q", "--quiet", action="store_true")
+
+
+def _note(quiet: bool, msg: str) -> None:
+    if not quiet:
+        print(msg, file=sys.stderr, flush=True)
+
+
+def print_fleet_table(monitor: FleetMonitor, file=None) -> None:
+    """The live table: one row per replica, ranked least-loaded first,
+    trailing rows for replicas outside the aggregates."""
+    out = file if file is not None else sys.stdout
+    sigs = {s.replica: s for s in monitor.load_signals()}
+    now = monitor.wall_clock()
+    hdr = (f"{'rank':>4} {'replica':<24} {'state':<12} {'age_s':>7} "
+           f"{'queue':>5} {'busy':>5} {'kv_free':>7} {'kv_used':>7} "
+           f"{'slo%':>6} {'score':>8}")
+    print(hdr, file=out)
+    print("-" * len(hdr), file=out)
+    ranked = list(sigs)
+    for rank, label in enumerate(ranked, start=1):
+        s = sigs[label]
+        rep = next(r for r in monitor.replicas if r.label == label)
+        age = rep.snapshot_age_s(now)
+        # pre-stamp replicas report no age (format(None, '>7') would raise)
+        age_s = "-" if age is None else f"{age:.1f}"
+        print(
+            f"{rank:>4} {label:<24} {rep.state:<12} "
+            f"{age_s:>7} "
+            f"{s.queue_depth:>5g} {s.slots_busy:>5g} "
+            f"{s.kv_blocks_free:>7g} {s.kv_blocks_used:>7g} "
+            f"{s.slo_attainment_pct:>6.1f} {s.score:>8.4f}",
+            file=out,
+        )
+    for rep in monitor.replicas:
+        if rep.label in sigs:
+            continue
+        print(
+            f"{'-':>4} {rep.label:<24} {rep.state:<12} "
+            f"{'-':>7} {'-':>5} {'-':>5} {'-':>7} {'-':>7} {'-':>6} {'-':>8}"
+            f"  {rep.last_error or ''}",
+            file=out,
+        )
+
+
+def build_demo_fleet(n: int, requests: int, quiet: bool):
+    """N in-process tiny-llama replicas, each with demo serving traffic and
+    a MetricsServer on an ephemeral port. Returns (targets, servers)."""
+    from nxdi_tpu.cli.metrics import build_loaded_reference_app, run_paged_demo
+    from nxdi_tpu.config import OnDeviceSamplingConfig
+
+    targets, servers = [], []
+    for i in range(n):
+        _note(quiet, f"[fleet] building demo replica {i} ...")
+        app = build_loaded_reference_app(dict(
+            tp_degree=1,
+            batch_size=1,
+            dtype="bfloat16",
+            skip_warmup=True,
+            telemetry={"detail": "full", "replica_id": f"demo-{i}"},
+            is_block_kv_layout=True,
+            pa_block_size=8,
+            pa_num_blocks=32,
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+        ))
+        run_paged_demo(app, requests, max_new_tokens=4)
+        server = app.telemetry.serve(port=0)
+        servers.append(server)
+        targets.append((f"demo-{i}", server.url))
+        _note(quiet, f"[fleet] demo replica {i} at {server.url}")
+    return targets, servers
+
+
+def emit(monitor: FleetMonitor, args) -> None:
+    if args.format == "table":
+        print_fleet_table(monitor)
+    elif args.format == "json":
+        print(json.dumps(monitor.snapshot(), indent=2))
+    else:
+        print(monitor.prometheus_text(), end="")
+    if args.json_path:
+        with open(args.json_path, "w") as f:
+            json.dump(monitor.snapshot(), f, indent=2)
+    if args.perfetto_path:
+        with open(args.perfetto_path, "w") as f:
+            json.dump(monitor.perfetto_trace(), f)
+        _note(args.quiet, f"[fleet] merged Perfetto trace: "
+                          f"{args.perfetto_path} (open in ui.perfetto.dev)")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nxdi_tpu.cli.fleet",
+        description="fleet observatory: poll replica /snapshot endpoints, "
+                    "merge metrics, rank load",
+    )
+    setup_fleet_parser(parser)
+    args = parser.parse_args(argv)
+
+    from nxdi_tpu.config import FleetConfig
+
+    servers = []
+    targets = list(args.targets)
+    if args.demo:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from nxdi_tpu.jax_compat import set_num_cpu_devices
+
+        set_num_cpu_devices(8)
+        demo_targets, servers = build_demo_fleet(
+            args.demo, args.demo_requests, args.quiet
+        )
+        targets.extend(demo_targets)
+    if not targets:
+        parser.error("no replica targets (pass URLs or --demo N)")
+
+    monitor = FleetMonitor(
+        targets,
+        config=FleetConfig(
+            poll_interval_s=args.poll_interval,
+            timeout_s=args.timeout,
+            staleness_s=args.staleness,
+            unreachable_failures=args.unreachable_after,
+        ),
+    )
+
+    try:
+        if args.watch and not args.serve:
+            while True:
+                monitor.poll()
+                emit(monitor, args)
+                time.sleep(monitor.config.poll_interval_s)
+        if args.serve:
+            monitor.poll()
+            server = monitor.serve(host=args.host, port=args.port)
+            _note(args.quiet,
+                  f"[fleet] federation endpoint http://{args.host}:"
+                  f"{server.port}/metrics (/metrics.json, /snapshot, "
+                  "/healthz, /trace.json) — Ctrl-C to stop")
+            emit(monitor, args)
+            try:
+                while True:
+                    time.sleep(monitor.config.poll_interval_s)
+                    monitor.poll()
+            except KeyboardInterrupt:
+                server.shutdown()
+            return 0
+        # --once (the default): one round, scriptable exit status
+        states = monitor.poll()
+        emit(monitor, args)
+        bad = sorted(
+            rep.label for rep in monitor.replicas
+            if rep.state == UNREACHABLE or rep.failures > 0
+        )
+        if bad:
+            _note(args.quiet,
+                  f"[fleet] unreachable/failing replicas: {', '.join(bad)}")
+            return 1
+        _note(args.quiet,
+              f"[fleet] {len(states)} replicas healthy")
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        for server in servers:
+            server.shutdown()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

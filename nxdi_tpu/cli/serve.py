@@ -63,6 +63,11 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--postmortem-dir", default=None, metavar="DIR",
                    help="where trigger-fired flight-recorder bundles land "
                         "(default: in-memory only)")
+    p.add_argument("--replica-id", default=None, metavar="ID",
+                   help="stable replica identity for the fleet observatory "
+                        "(TelemetryConfig(replica_id=...); the 'replica' "
+                        "label cli.fleet attaches to this process's series; "
+                        "default: hostname:pid)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stream", action="store_true",
                    help="print each request's tokens as they stream")
@@ -175,7 +180,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         tkg_batch_size=args.slots,
         dtype="bfloat16",
         skip_warmup=True,
-        telemetry={"detail": "full", "postmortem_dir": args.postmortem_dir},
+        telemetry={"detail": "full", "postmortem_dir": args.postmortem_dir,
+                   "replica_id": args.replica_id},
         is_block_kv_layout=True,
         pa_block_size=args.pa_block_size,
         pa_num_blocks=args.pa_num_blocks,

@@ -182,12 +182,23 @@ class TelemetryConfig:
     ``storm_window`` / ``storm_preemptions`` — a preemption storm fires the
     postmortem trigger when the last ``storm_window`` engine steps carried
     >= ``storm_preemptions`` recompute preemptions.
+
+    ``replica_id`` — stable replica identity for the fleet observatory
+    (telemetry/fleet.py): rides every JSON snapshot as
+    ``_process.replica_id`` and becomes the ``replica`` label on federated
+    series. None = ``"<hostname>:<pid>"``, derived once per process.
     """
 
     def __init__(self, **kwargs):
         self.enabled = bool(kwargs.pop("enabled", True))
         self.detail = kwargs.pop("detail", "basic")
         self.max_spans = int(kwargs.pop("max_spans", 256))
+        # stable replica identity (fleet observatory, telemetry/fleet.py):
+        # the label every federated series carries for this process. None =
+        # derived once per Telemetry as "<hostname>:<pid>" — stable for the
+        # process lifetime; pin it here for stable labels across restarts.
+        rid = kwargs.pop("replica_id", None)
+        self.replica_id = None if rid is None else str(rid)
         self.flight = bool(kwargs.pop("flight", True))
         self.flight_records = int(kwargs.pop("flight_records", 512))
         self.postmortem_dir = kwargs.pop("postmortem_dir", None)
@@ -240,6 +251,52 @@ class SloConfig:
             raise ValueError("SLO targets must be positive seconds")
         if self.window < 1:
             raise ValueError("SLO window must be >= 1")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
+class FleetConfig:
+    """Fleet observatory (nxdi_tpu/telemetry/fleet.py): how a
+    :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor` polls N replica
+    ``/snapshot`` endpoints and classifies their health.
+
+    ``poll_interval_s`` — seconds between poll rounds (``cli.fleet --watch``
+    and the ``--serve`` federation endpoint pace on this);
+    ``timeout_s`` — per-replica HTTP timeout (a poll can never hang the
+    monitor longer than this per replica);
+    ``staleness_s`` — a snapshot whose embedded ``_process.snapshot_unix_s``
+    is older than this counts as a FAILED poll even when transport
+    succeeded (a wedged replica keeps answering with frozen metrics — the
+    age-out is what catches it);
+    ``unreachable_failures`` — consecutive failed polls before a replica
+    transitions DEGRADED -> UNREACHABLE (the first failure is DEGRADED
+    unless this is 1); UNREACHABLE replicas leave the fleet aggregates;
+    ``backoff_base_s`` / ``backoff_max_s`` — per-replica exponential
+    backoff between polls of a FAILING replica
+    (``min(base * 2**(failures-1), max)``); healthy replicas poll every
+    round.
+    """
+
+    def __init__(self, **kwargs):
+        self.poll_interval_s = float(kwargs.pop("poll_interval_s", 1.0))
+        self.timeout_s = float(kwargs.pop("timeout_s", 2.0))
+        self.staleness_s = float(kwargs.pop("staleness_s", 10.0))
+        self.unreachable_failures = int(kwargs.pop("unreachable_failures", 3))
+        self.backoff_base_s = float(kwargs.pop("backoff_base_s", 0.5))
+        self.backoff_max_s = float(kwargs.pop("backoff_max_s", 30.0))
+        if kwargs:
+            raise ValueError(f"Unknown FleetConfig args: {sorted(kwargs)}")
+        if self.poll_interval_s <= 0 or self.timeout_s <= 0:
+            raise ValueError("fleet poll_interval_s and timeout_s must be > 0")
+        if self.staleness_s <= 0:
+            raise ValueError("fleet staleness_s must be > 0")
+        if self.unreachable_failures < 1:
+            raise ValueError("fleet unreachable_failures must be >= 1")
+        if self.backoff_base_s <= 0 or self.backoff_max_s < self.backoff_base_s:
+            raise ValueError(
+                "fleet backoff needs 0 < backoff_base_s <= backoff_max_s"
+            )
 
     def to_dict(self):
         return dict(self.__dict__)

@@ -58,6 +58,21 @@ Metric catalog (labels in parens):
 ``nxdi_slo_goodput_tok_s``            gauge
 ====================================  =========  ==================================
 
+Fleet observatory series (telemetry/fleet.py — emitted by a
+:class:`~nxdi_tpu.telemetry.fleet.FleetMonitor`'s merged view, NOT by
+replicas; every member gauge additionally gains a ``replica`` label there):
+
+==========================================  =======  ========================
+``nxdi_fleet_replicas``                     gauge    (state)
+``nxdi_fleet_replica_state``                gauge    (replica) 0/1/2 code
+``nxdi_fleet_health_transitions_total``     counter  (replica, from_state, to_state)
+``nxdi_fleet_polls_total``                  counter  (replica, outcome)
+``nxdi_fleet_snapshot_age_s``               gauge    (replica)
+``nxdi_fleet_load_signal``                  gauge    (replica) router score
+``nxdi_fleet_straggler_gap``                gauge    max-min load score
+``nxdi_fleet_slo_attainment_pct``           gauge    from summed counters
+==========================================  =======  ========================
+
 The three roofline gauges are published by the cost observatory
 (:func:`nxdi_tpu.analysis.costs.attach_cost_gauges`, wired at ``app.load()``):
 at every export the measured mean dispatch latency is divided through each
@@ -85,6 +100,18 @@ from nxdi_tpu.telemetry.registry import (
     percentile_from_buckets,
     prometheus_text,
 )
+from nxdi_tpu.telemetry.federation import (
+    merge_perfetto_traces,
+    merge_snapshots,
+)
+from nxdi_tpu.telemetry.fleet import (
+    DEGRADED,
+    HEALTHY,
+    UNREACHABLE,
+    FleetMonitor,
+    LoadSignal,
+    rank_load_signals,
+)
 from nxdi_tpu.telemetry.flight import FlightRecorder, StepRecord
 from nxdi_tpu.telemetry.slo import SloTracker, breach_kinds
 from nxdi_tpu.telemetry.spans import NULL_SPAN, RequestSpan, SpanTracker
@@ -102,6 +129,14 @@ __all__ = [
     "StepRecord",
     "SloTracker",
     "breach_kinds",
+    "FleetMonitor",
+    "LoadSignal",
+    "rank_load_signals",
+    "merge_snapshots",
+    "merge_perfetto_traces",
+    "HEALTHY",
+    "DEGRADED",
+    "UNREACHABLE",
     "MetricsServer",
     "prometheus_text",
     "percentile_from_buckets",
@@ -134,7 +169,8 @@ class Telemetry:
     """
 
     def __init__(self, enabled: bool = True, detail: str = "basic",
-                 max_spans: int = 256, clock=None):
+                 max_spans: int = 256, clock=None, replica_id=None,
+                 wall_clock=None):
         if detail not in DETAIL_LEVELS:
             raise ValueError(
                 f"telemetry detail must be one of {DETAIL_LEVELS}, got {detail!r}"
@@ -143,6 +179,20 @@ class Telemetry:
         self.enabled = bool(enabled) and detail != "off"
         self.sync_dispatch = detail == "full"
         self.clock = clock or time.perf_counter
+        # wall-clock (unix seconds) for the _process snapshot stamp — kept
+        # SEPARATE from `clock` (perf_counter domain) and injectable so the
+        # fleet staleness tests can freeze it
+        self.wall_clock = wall_clock or time.time
+        # stable replica identity: the label every federated series carries
+        # for this process (telemetry/fleet.py). Derived once; a fleet of
+        # local replicas stays distinguishable because the pid differs.
+        if replica_id is None:
+            import os
+            import socket
+
+            replica_id = f"{socket.gethostname()}:{os.getpid()}"
+        self.replica_id = str(replica_id)
+        self._t0 = self.clock()
         self.registry = MetricsRegistry()
         # engine flight recorder (telemetry/flight.py), attached by the
         # serving engine via attach_flight(); rides record_dispatch, the
@@ -262,6 +312,25 @@ class Telemetry:
         # Both are wrapped so a failing provider can never break an export.
         self._attachments: list = []
         self._snapshot_extras: Dict[str, Callable[[], object]] = {}
+        # every JSON snapshot self-describes its origin: the federator ages
+        # out replicas on snapshot_unix_s (NOT on transport success — a
+        # wedged process keeps answering) and labels series by replica_id.
+        # Gated on enabled: "off" keeps its nothing-recorded contract.
+        if self.enabled:
+            self.add_snapshot_extra("_process", self.process_info)
+
+    def process_info(self) -> dict:
+        """Identity + freshness stamp embedded as the ``_process`` snapshot
+        extra: who produced this snapshot, when (wall clock), and how long
+        the process has been up (telemetry clock domain)."""
+        import os
+
+        return {
+            "replica_id": self.replica_id,
+            "snapshot_unix_s": self.wall_clock(),
+            "uptime_s": self.clock() - self._t0,
+            "pid": os.getpid(),
+        }
 
     # -- construction from config ------------------------------------------
     @classmethod
@@ -273,6 +342,7 @@ class Telemetry:
             enabled=getattr(tc, "enabled", True),
             detail=getattr(tc, "detail", "basic"),
             max_spans=getattr(tc, "max_spans", 256),
+            replica_id=getattr(tc, "replica_id", None),
         )
 
     # -- hot-path recorders -------------------------------------------------

@@ -181,6 +181,48 @@ def write_perfetto_trace(
     return trace
 
 
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+def telemetry_routes(tel) -> list:
+    """The replica probe surface as ``(path_prefix, content_type, fn)``
+    rows, longest-match-first. A ``fn`` returning ``None`` answers 404 (the
+    flight recorder may not be attached). Shared with the fleet federation
+    endpoint (telemetry/fleet.py), which serves the SAME paths over the
+    merged view — a router probe never needs to know which tier it hit."""
+
+    def healthz():
+        return json.dumps({
+            "status": "ok",
+            "replica_id": tel.replica_id,
+            "requests_total": tel.requests_total.total(),
+            "engine_steps": (
+                tel.flight.steps if tel.flight is not None else None
+            ),
+            "spans_dropped": tel.spans_dropped_total.total(),
+        })
+
+    def postmortem():
+        if tel.flight is None:
+            return None
+        return json.dumps(
+            tel.flight.postmortem("manual", detail={"source": "http"}),
+            indent=2,
+        )
+
+    return [
+        ("/healthz", "application/json", healthz),
+        ("/metrics.json", "application/json",
+         lambda: json.dumps(tel.snapshot(), indent=2)),
+        ("/snapshot", "application/json",
+         lambda: json.dumps(tel.snapshot(), indent=2)),
+        ("/trace.json", "application/json",
+         lambda: json.dumps(tel.perfetto_trace())),
+        ("/postmortem", "application/json", postmortem),
+        ("/metrics", PROM_CONTENT_TYPE, tel.prometheus_text),
+    ]
+
+
 class MetricsServer:
     """Tiny stdlib HTTP server on a daemon thread:
 
@@ -192,58 +234,58 @@ class MetricsServer:
     - ``/postmortem``    manual flight-recorder dump (404 without a
       recorder attached); the bundle is returned AND written to the
       recorder's ``postmortem_dir`` when configured
+
+    ``port=0`` binds an OS-assigned ephemeral port; read it back from
+    ``.port`` (or ``.url``) — multi-replica tests and local fleets never
+    need to coordinate hard-coded ports. ``shutdown()`` is graceful and
+    idempotent (in-flight requests drain, the listening socket closes, the
+    thread joins); the server is also a context manager that starts on
+    ``__enter__`` and shuts down on ``__exit__``.
     """
 
-    def __init__(self, telemetry, host: str = "127.0.0.1", port: int = 9400):
-        tel = telemetry
+    def __init__(self, telemetry=None, host: str = "127.0.0.1",
+                 port: int = 9400, routes: Optional[list] = None):
+        if routes is None:
+            if telemetry is None:
+                raise ValueError("MetricsServer needs telemetry or routes")
+            routes = telemetry_routes(telemetry)
+        route_table = list(routes)
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (stdlib API name)
-                ctype = "application/json"
-                if self.path.startswith("/healthz"):
-                    body = json.dumps({
-                        "status": "ok",
-                        "requests_total": tel.requests_total.total(),
-                        "engine_steps": (
-                            tel.flight.steps
-                            if tel.flight is not None else None
-                        ),
-                        "spans_dropped": tel.spans_dropped_total.total(),
-                    }).encode()
-                elif self.path.startswith(("/metrics.json", "/snapshot")):
-                    body = json.dumps(tel.snapshot(), indent=2).encode()
-                elif self.path.startswith("/trace.json"):
-                    body = json.dumps(tel.perfetto_trace()).encode()
-                elif self.path.startswith("/postmortem"):
-                    if tel.flight is None:
-                        self.send_error(404, "no flight recorder attached")
+                for prefix, ctype, fn in route_table:
+                    if self.path.startswith(prefix):
+                        body = fn()
+                        if body is None:
+                            self.send_error(404)
+                            return
+                        payload = (
+                            body.encode() if isinstance(body, str) else body
+                        )
+                        self.send_response(200)
+                        self.send_header("Content-Type", ctype)
+                        self.send_header("Content-Length", str(len(payload)))
+                        self.end_headers()
+                        self.wfile.write(payload)
                         return
-                    body = json.dumps(
-                        tel.flight.postmortem("manual",
-                                              detail={"source": "http"}),
-                        indent=2,
-                    ).encode()
-                elif self.path.startswith("/metrics"):
-                    body = tel.prometheus_text().encode()
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
-                else:
-                    self.send_error(404)
-                    return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+                self.send_error(404)
 
             def log_message(self, *args):  # quiet: scrapes are not events
                 pass
 
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._thread: Optional[threading.Thread] = None
+        self._closed = False
 
     @property
     def port(self) -> int:
+        """The ACTUALLY-BOUND port (resolves ``port=0`` ephemeral binds)."""
         return self._server.server_address[1]
+
+    @property
+    def url(self) -> str:
+        host = self._server.server_address[0]
+        return f"http://{host}:{self.port}"
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(
@@ -256,7 +298,19 @@ class MetricsServer:
         self._server.serve_forever()
 
     def shutdown(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         self._server.shutdown()
         self._server.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5)
+            self._thread = None
+
+    def __enter__(self) -> "MetricsServer":
+        if self._thread is None and not self._closed:
+            self.start()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,222 @@
+"""Multi-replica metrics federation: merge N replica JSON snapshots into
+one fleet view.
+
+Every replica already exports a complete JSON snapshot (``/snapshot``,
+``Telemetry.snapshot()``) — this module is the pure merge over those
+dicts, shared by the :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor`, the
+``python -m nxdi_tpu.cli.fleet`` CLI, and ``bench.py --serving
+--replicas N``. Merge semantics (the contract the property tests in
+``tests/unit/test_federation.py`` pin):
+
+- **counters sum**: the fleet total of ``nxdi_requests_total`` is the sum
+  over replicas, per label tuple — no replica label, because a counter's
+  fleet meaning IS its sum.
+- **gauges carry a ``replica`` label**: a gauge (queue depth, free KV
+  blocks, SLO attainment) is a point-in-time per-process fact; summing or
+  averaging it silently destroys the signal a router needs. Every gauge
+  series gains a leading ``replica`` label, so two replicas can NEVER
+  collide or overwrite one another.
+- **histograms merge bucket-exact**: :class:`MetricsRegistry` histograms
+  have FIXED log-spaced bounds, identical across replicas by
+  construction, so bucket counts / sum / count simply add — the merged
+  percentile estimate equals the estimate a single registry would have
+  produced had it observed the pooled series (asserted property-style in
+  the unit tests). The snapshot's family-level ``bounds`` list is what
+  lets the merge rebuild exact bucket arrays from the sparse per-row
+  bucket dicts.
+
+The merged result is a real :class:`MetricsRegistry`, so the fleet's
+Prometheus text and JSON snapshot come from the SAME exposition code the
+replicas use — one formatter, no fleet-only drift.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from nxdi_tpu.telemetry.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+
+#: snapshot keys that are extras (``_spans``, ``_slo``, ``_process``, ...),
+#: not metric families — the merge skips them; the fleet monitor surfaces
+#: the interesting ones per replica under ``_replicas``
+def _is_family(name: str, fam) -> bool:
+    return not name.startswith("_") and isinstance(fam, dict) and "type" in fam
+
+
+def _family_label_names(fam: dict) -> Tuple[str, ...]:
+    """Label names of a snapshot family — from the first series row (every
+    row of one family carries the same keys; sorted for a stable
+    registration order across replicas)."""
+    series = fam.get("series") or []
+    if not series:
+        return ()
+    return tuple(sorted(series[0].get("labels", {})))
+
+
+def _bucket_counts(row: dict, bounds: List[float]) -> List[int]:
+    """Rebuild the dense bucket array (one per bound + the +Inf bucket)
+    from a snapshot row's sparse ``buckets`` dict. Bound keys were
+    stringified with ``str(float)`` at snapshot time, so ``str()`` of the
+    parsed bounds round-trips exactly."""
+    sparse = row.get("buckets") or {}
+    counts = [0] * (len(bounds) + 1)
+    for i, b in enumerate(bounds):
+        counts[i] = int(sparse.get(str(b), 0))
+    counts[-1] = int(sparse.get("+Inf", 0))
+    return counts
+
+
+def merge_snapshots(
+    snapshots: Dict[str, dict],
+    registry: Optional[MetricsRegistry] = None,
+) -> Tuple[MetricsRegistry, List[str]]:
+    """Merge ``{replica_label: snapshot_dict}`` into a registry.
+
+    Returns ``(registry, notes)`` — ``notes`` lists families that could not
+    merge (e.g. the same name registered with different types/labels across
+    replica versions); a skew-y fleet degrades per family, never by
+    dropping a whole replica. Replica labels are the dict keys: the caller
+    (FleetMonitor) guarantees they are unique and stable.
+    """
+    reg = registry if registry is not None else MetricsRegistry()
+    notes: List[str] = []
+    for replica in sorted(snapshots):
+        snap = snapshots[replica] or {}
+        for name in sorted(snap):
+            fam = snap[name]
+            if not _is_family(name, fam):
+                continue
+            try:
+                _merge_family(reg, replica, name, fam)
+            except (ValueError, TypeError, KeyError) as e:
+                note = f"{name}: {type(e).__name__}: {e}"
+                if note not in notes:
+                    notes.append(note)
+    return reg, notes
+
+
+def _merge_family(reg: MetricsRegistry, replica: str, name: str, fam: dict) -> None:
+    kind = fam.get("type")
+    help_ = fam.get("help", "")
+    names = _family_label_names(fam)
+    if kind == "counter":
+        c: Counter = reg.counter(name, help_, names)
+        for row in fam.get("series", []):
+            c.inc(float(row["value"]), **row.get("labels", {}))
+    elif kind == "gauge":
+        if "replica" in names:
+            # already-federated input (a fleet observing a fleet): the
+            # member rows carry their own replica labels — nest them under
+            # this source's label instead of colliding on the keyword
+            g: Gauge = reg.gauge(name, help_, names)
+            for row in fam.get("series", []):
+                labels = dict(row.get("labels", {}))
+                labels["replica"] = f"{replica}/{labels.get('replica', '')}"
+                g.set(float(row["value"]), **labels)
+        else:
+            g = reg.gauge(name, help_, ("replica",) + names)
+            for row in fam.get("series", []):
+                g.set(
+                    float(row["value"]), replica=replica,
+                    **row.get("labels", {}),
+                )
+    elif kind == "histogram":
+        bounds = [float(b) for b in fam.get("bounds") or _bounds_from_rows(fam)]
+        if not bounds:
+            raise ValueError("histogram family carries no bounds")
+        h: Histogram = reg.histogram(name, help_, names, bounds=tuple(bounds))
+        for row in fam.get("series", []):
+            h.add_series(
+                _bucket_counts(row, bounds),
+                float(row.get("sum", 0.0)),
+                int(row.get("count", 0)),
+                **row.get("labels", {}),
+            )
+    else:
+        raise ValueError(f"unknown family type {kind!r}")
+
+
+def _bounds_from_rows(fam: dict) -> List[float]:
+    """Fallback for snapshots from builds that predate the family-level
+    ``bounds`` list: the union of observed bucket keys. Sparse (empty
+    buckets are invisible), so percentile interpolation may coarsen — the
+    merge itself stays count-exact."""
+    keys = set()
+    for row in fam.get("series", []):
+        for k in (row.get("buckets") or {}):
+            if k != "+Inf":
+                keys.add(float(k))
+    return sorted(keys)
+
+
+def copy_registry_into(src: MetricsRegistry, dst: MetricsRegistry) -> List[str]:
+    """Copy every series of ``src`` into ``dst`` verbatim (the fleet
+    monitor's own persistent series — health transitions, poll counters —
+    joining a freshly merged member view). A family that already exists in
+    ``dst`` with a different shape (e.g. a tier-2 monitor whose member
+    snapshots were themselves fleet views carrying ``nxdi_fleet_*``
+    families) is skipped and noted — an export must degrade per family,
+    never crash the scrape surface."""
+    notes: List[str] = []
+    for m in src.metrics():
+        try:
+            if isinstance(m, Histogram):
+                h = dst.histogram(
+                    m.name, m.help, m.label_names, bounds=m.bounds
+                )
+                for key, (counts, total_sum, count) in (
+                    m.series_snapshot().items()
+                ):
+                    h.add_series(counts, total_sum, count, **m.labels_of(key))
+            elif isinstance(m, Counter):
+                c = dst.counter(m.name, m.help, m.label_names)
+                for key, val in m.series().items():
+                    c.inc(float(val), **m.labels_of(key))
+            elif isinstance(m, Gauge):
+                g = dst.gauge(m.name, m.help, m.label_names)
+                for key, val in m.series().items():
+                    g.set(float(val), **m.labels_of(key))
+        except (ValueError, TypeError) as e:
+            notes.append(f"{m.name}: {type(e).__name__}: {e}")
+    return notes
+
+
+# ---------------------------------------------------------------------------
+# merged multi-replica Perfetto export
+# ---------------------------------------------------------------------------
+
+#: pid stride per replica in the merged trace: each replica's process ids
+#: (1 = request tracks, 2 = engine per-slot tracks) shift by
+#: ``index * PID_STRIDE`` so the fleet trace opens as one process group per
+#: replica, reusing the per-slot tracks exactly as the replica emitted them
+PID_STRIDE = 100
+
+
+def merge_perfetto_traces(traces: Dict[str, dict]) -> dict:
+    """Merge ``{replica_label: trace_events_dict}`` into one trace.
+
+    Replicas sort by label (deterministic pid assignment); every event's
+    ``pid`` shifts by the replica's stride and every ``process_name``
+    metadata row is prefixed with the replica label, so ui.perfetto.dev
+    renders one collapsible process group per replica with the SAME
+    per-slot / host-overhead / request tracks PR 6 introduced.
+    """
+    events: List[dict] = []
+    for i, replica in enumerate(sorted(traces)):
+        trace = traces[replica] or {}
+        offset = i * PID_STRIDE
+        for ev in trace.get("traceEvents", []):
+            ev = dict(ev)
+            if "pid" in ev:
+                ev["pid"] = ev["pid"] + offset
+            if ev.get("ph") == "M" and ev.get("name") == "process_name":
+                args = dict(ev.get("args") or {})
+                args["name"] = f"{replica} · {args.get('name', '')}"
+                ev["args"] = args
+            events.append(ev)
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -1,0 +1,517 @@
+"""Fleet observatory: poll N replica ``/snapshot`` endpoints, drive a
+per-replica health state machine, and compute router-ready load signals.
+
+ROADMAP item 3's router tier needs three things before any dispatch policy
+can exist: (1) one place that can SEE every replica at once, (2) an honest
+health classification per replica, and (3) a deterministic load score over
+the per-replica telemetry gauges that already exist. This module is those
+three things — the router PR that follows is a pure policy change over
+:meth:`FleetMonitor.load_signals`.
+
+**Health state machine** (per replica)::
+
+                 poll ok (fresh snapshot)
+      +------------------------------------------+
+      v                                          |
+  HEALTHY --fail/stale--> DEGRADED --fail x N--> UNREACHABLE
+      ^                       |    (N = FleetConfig.unreachable_failures)
+      |                       v
+      +-----poll ok (fresh)---+  (recovery is immediate on one good poll)
+
+- a poll FAILS on transport error/timeout OR when the snapshot's embedded
+  ``_process.snapshot_unix_s`` is older than ``staleness_s`` (stale-snapshot
+  age-out: a wedged replica that still answers HTTP must not read as
+  healthy);
+- failing replicas back off exponentially
+  (``min(backoff_base_s * 2**(failures-1), backoff_max_s)``);
+- every edge increments
+  ``nxdi_fleet_health_transitions_total{replica,from_state,to_state}``;
+- UNREACHABLE replicas are EXCLUDED from the fleet aggregates (their last
+  snapshot is kept for postmortem reading only).
+
+**LoadSignal** — the exact scoring surface the future router consumes.
+Units are pinned; the score is computed in this exact term order with
+float64 arithmetic, so two monitors over the same snapshots rank
+identically bit for bit::
+
+    score = queue_depth                          # waiting requests
+          + slots_busy                           # running requests
+          + 4.0 * kv_used_frac                   # KV pressure in [0, 4]
+          + 2.0 * (1.0 - slo_attainment_pct/100) # SLO pressure in [0, 2]
+
+    kv_used_frac = used / (used + free)   (0.0 when the pool is unreported)
+    slo_attainment_pct defaults to 100.0 when no SLO is declared
+
+Lower score = less loaded. Ranking sorts by ``(score, replica)`` —
+deterministic even on exact ties.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+from nxdi_tpu.telemetry.federation import (
+    copy_registry_into,
+    merge_perfetto_traces,
+    merge_snapshots,
+)
+from nxdi_tpu.telemetry.registry import MetricsRegistry, prometheus_text
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+UNREACHABLE = "unreachable"
+STATES = (HEALTHY, DEGRADED, UNREACHABLE)
+
+#: numeric code per state for the ``nxdi_fleet_replica_state`` gauge
+#: (0 = healthy keeps dashboards' "0 is good" convention)
+STATE_CODES = {HEALTHY: 0, DEGRADED: 1, UNREACHABLE: 2}
+
+
+@dataclass(frozen=True)
+class LoadSignal:
+    """One replica's router-facing load picture (see module docstring for
+    the pinned score formula)."""
+
+    replica: str
+    queue_depth: float
+    slots_busy: float
+    kv_blocks_free: float
+    kv_blocks_used: float
+    slo_attainment_pct: float
+
+    @property
+    def kv_used_frac(self) -> float:
+        total = self.kv_blocks_used + self.kv_blocks_free
+        return self.kv_blocks_used / total if total > 0 else 0.0
+
+    @property
+    def score(self) -> float:
+        return (
+            self.queue_depth
+            + self.slots_busy
+            + 4.0 * self.kv_used_frac
+            + 2.0 * (1.0 - self.slo_attainment_pct / 100.0)
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "replica": self.replica,
+            "queue_depth": self.queue_depth,
+            "slots_busy": self.slots_busy,
+            "kv_blocks_free": self.kv_blocks_free,
+            "kv_blocks_used": self.kv_blocks_used,
+            "slo_attainment_pct": self.slo_attainment_pct,
+            "kv_used_frac": self.kv_used_frac,
+            "score": self.score,
+        }
+
+
+def _gauge_value(snap: dict, family: str, default: float = 0.0) -> float:
+    """First (unlabeled) series value of a gauge family in a snapshot."""
+    fam = snap.get(family)
+    if not isinstance(fam, dict):
+        return default
+    series = fam.get("series") or []
+    if not series:
+        return default
+    return float(series[0].get("value", default))
+
+
+def load_signal_from_snapshot(replica: str, snap: dict) -> LoadSignal:
+    """Extract the LoadSignal inputs from a replica snapshot — every field
+    is an EXISTING gauge the serving engine already publishes (PRs 3/5/6);
+    nothing here asks replicas to export anything new."""
+    has_slo = isinstance(snap.get("nxdi_slo_attainment_pct"), dict)
+    return LoadSignal(
+        replica=replica,
+        queue_depth=_gauge_value(snap, "nxdi_serve_queue_depth"),
+        slots_busy=_gauge_value(snap, "nxdi_serve_slots_busy"),
+        kv_blocks_free=_gauge_value(snap, "nxdi_kv_blocks_free"),
+        kv_blocks_used=_gauge_value(snap, "nxdi_kv_blocks_used"),
+        slo_attainment_pct=(
+            _gauge_value(snap, "nxdi_slo_attainment_pct") if has_slo else 100.0
+        ),
+    )
+
+
+def rank_load_signals(signals: Sequence[LoadSignal]) -> List[LoadSignal]:
+    """Least-loaded first; ties break on the replica label — fully
+    deterministic, the property the router's dispatch tests will pin."""
+    return sorted(signals, key=lambda s: (s.score, s.replica))
+
+
+class Replica:
+    """Poll-side bookkeeping for one target. ``label`` prefers the stable
+    ``_process.replica_id`` the replica self-reports (survives URL/port
+    changes across restarts when pinned via TelemetryConfig(replica_id=));
+    until a first good snapshot it falls back to the target name."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.state = HEALTHY  # optimistic until the first poll says otherwise
+        self.failures = 0  # consecutive failed polls
+        self.not_before = 0.0  # backoff gate (monitor wall-clock domain)
+        self.snapshot: Optional[dict] = None  # last GOOD snapshot
+        self.last_ok_s: Optional[float] = None  # monitor clock of last good poll
+        self.last_error: Optional[str] = None
+        self._label: Optional[str] = None
+
+    @property
+    def label(self) -> str:
+        if self._label is not None:
+            return self._label
+        rid = ((self.snapshot or {}).get("_process") or {}).get("replica_id")
+        return str(rid) if rid else self.name
+
+    def snapshot_age_s(self, now: float) -> Optional[float]:
+        """Age of the last good snapshot by its OWN wall stamp; None before
+        the first good poll or for pre-stamp replicas."""
+        ts = ((self.snapshot or {}).get("_process") or {}).get("snapshot_unix_s")
+        return None if ts is None else max(now - float(ts), 0.0)
+
+
+def _http_fetch(url: str, timeout_s: float) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+        return json.loads(resp.read())
+
+
+class FleetMonitor:
+    """Polls N replicas, merges their registries into one fleet view, and
+    owns the per-replica health state machine + load signals.
+
+    ``targets`` — replica base URLs (``http://host:port``), optionally
+    named as ``(name, url)`` tuples or ``"name=url"`` strings.
+    ``fetch(url, timeout_s) -> dict`` is injectable for tests; the default
+    is a bounded-timeout stdlib GET of ``<base>/snapshot``.
+    ``wall_clock`` is the monitor's unix-seconds clock (injectable — the
+    staleness unit tests freeze it).
+
+    Thread-safety: ``poll()`` and the export surfaces may run from
+    different threads (the federation HTTP server scrapes while a watch
+    loop polls); one lock guards the replica table.
+    """
+
+    def __init__(
+        self,
+        targets: Sequence[Union[str, Tuple[str, str]]],
+        config=None,
+        fetch: Optional[Callable[[str, float], dict]] = None,
+        wall_clock: Optional[Callable[[], float]] = None,
+    ):
+        from nxdi_tpu.config import FleetConfig
+
+        if not targets:
+            raise ValueError("FleetMonitor needs at least one replica target")
+        self.config = config if config is not None else FleetConfig()
+        self.fetch = fetch if fetch is not None else (
+            lambda url, t: _http_fetch(url + "/snapshot", t)
+        )
+        import time
+
+        self.wall_clock = wall_clock or time.time
+        self.replicas: List[Replica] = []
+        for t in targets:
+            if isinstance(t, tuple):
+                name, url = t
+            elif "=" in t.split("://")[0]:
+                name, url = t.split("=", 1)
+            else:
+                name, url = t, t
+            self.replicas.append(Replica(str(name), str(url)))
+        self._lock = threading.Lock()
+        # the monitor's PERSISTENT series (edge counters survive re-merges;
+        # the merged member view is rebuilt fresh on every export)
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self.transitions_total = r.counter(
+            "nxdi_fleet_health_transitions_total",
+            "health state machine edges per replica",
+            ("replica", "from_state", "to_state"),
+        )
+        self.polls_total = r.counter(
+            "nxdi_fleet_polls_total",
+            "snapshot polls by outcome (stale = transport ok, snapshot aged out)",
+            ("replica", "outcome"),
+        )
+        self.replica_state = r.gauge(
+            "nxdi_fleet_replica_state",
+            "replica health code (0 healthy, 1 degraded, 2 unreachable)",
+            ("replica",),
+        )
+        self.replicas_gauge = r.gauge(
+            "nxdi_fleet_replicas", "replica count per health state", ("state",)
+        )
+        self.snapshot_age = r.gauge(
+            "nxdi_fleet_snapshot_age_s",
+            "age of each replica's last good snapshot (its own wall stamp)",
+            ("replica",),
+        )
+        self.load_signal_gauge = r.gauge(
+            "nxdi_fleet_load_signal",
+            "deterministic router load score per replica (lower = less "
+            "loaded; see telemetry/fleet.py for the pinned formula)",
+            ("replica",),
+        )
+        self.straggler_gap = r.gauge(
+            "nxdi_fleet_straggler_gap",
+            "max - min load score over non-unreachable replicas (0 with <2)",
+        )
+        self.slo_attainment = r.gauge(
+            "nxdi_fleet_slo_attainment_pct",
+            "lifetime fleet SLO attainment from the summed per-replica "
+            "nxdi_slo_requests_total counters",
+        )
+
+    # -- polling + the state machine ----------------------------------------
+    def poll(self) -> Dict[str, str]:
+        """One poll round over every due replica (failing replicas inside
+        their backoff window are skipped). Returns ``{label: state}``.
+
+        The blocking HTTP fetches run OUTSIDE the monitor lock — a scrape
+        of the federation endpoint (snapshot / prometheus_text / the load
+        table) must never stall behind a round of socket timeouts to dead
+        replicas. One poller thread is the supported shape; a concurrent
+        second poll() would only double-fetch, the state application below
+        is lock-serialized either way."""
+        now = self.wall_clock()
+        with self._lock:
+            due = [rep for rep in self.replicas if now >= rep.not_before]
+        results: List[tuple] = []
+        for rep in due:
+            try:
+                snap = self.fetch(rep.url, self.config.timeout_s)
+                if not isinstance(snap, dict):
+                    raise ValueError(f"snapshot is {type(snap).__name__}")
+                results.append((rep, snap, None))
+            except Exception as e:  # noqa: BLE001 — any poll fault degrades
+                results.append((rep, None, f"{type(e).__name__}: {e}"))
+        with self._lock:
+            for rep, snap, error in results:
+                if error is not None:
+                    self._poll_failed(rep, now, error)
+                    continue
+                ts = (snap.get("_process") or {}).get("snapshot_unix_s")
+                if ts is not None and now - float(ts) > self.config.staleness_s:
+                    rep.snapshot = snap  # keep for postmortem reading
+                    self._poll_failed(
+                        rep, now,
+                        f"stale snapshot ({now - float(ts):.1f}s old "
+                        f"> staleness_s={self.config.staleness_s:g})",
+                        outcome="stale",
+                    )
+                    continue
+                rep.snapshot = snap
+                rep.last_ok_s = now
+                rep.last_error = None
+                rep.failures = 0
+                rep.not_before = 0.0
+                self.polls_total.inc(replica=rep.label, outcome="ok")
+                self._transition(rep, HEALTHY)
+            self._dedup_labels()
+            out = {rep.label: rep.state for rep in self.replicas}
+        self._refresh_fleet_gauges()
+        return out
+
+    def _poll_failed(
+        self, rep: Replica, now: float, error: str, outcome: str = "error"
+    ) -> None:
+        rep.failures += 1
+        rep.last_error = error
+        rep.not_before = now + min(
+            self.config.backoff_base_s * (2.0 ** (rep.failures - 1)),
+            self.config.backoff_max_s,
+        )
+        self.polls_total.inc(replica=rep.label, outcome=outcome)
+        self._transition(
+            rep,
+            UNREACHABLE
+            if rep.failures >= self.config.unreachable_failures
+            else DEGRADED,
+        )
+
+    def _transition(self, rep: Replica, new_state: str) -> None:
+        if new_state == rep.state:
+            return
+        self.transitions_total.inc(
+            replica=rep.label, from_state=rep.state, to_state=new_state
+        )
+        rep.state = new_state
+
+    def _dedup_labels(self) -> None:
+        """Two targets reporting the SAME replica_id (a copy-pasted config)
+        must not silently merge into one label: suffix by target order so
+        every replica keeps its own series."""
+        seen: Dict[str, int] = {}
+        for rep in self.replicas:
+            rep._label = None  # recompute from the preferred source
+            base = rep.label  # replica_id once known, else the target name
+            n = seen.get(base, 0)
+            seen[base] = n + 1
+            rep._label = base if n == 0 else f"{base}#{n + 1}"
+
+    # -- fleet view ----------------------------------------------------------
+    def _included(self) -> List[Replica]:
+        """Replicas whose series join the fleet aggregates: everything with
+        a last-good snapshot that is not UNREACHABLE. DEGRADED replicas
+        stay in (their last-good data is recent by construction — the
+        age-out bounds how stale it can be)."""
+        return [
+            rep for rep in self.replicas
+            if rep.state != UNREACHABLE and rep.snapshot is not None
+        ]
+
+    def load_signals(self) -> List[LoadSignal]:
+        """Ranked (least-loaded first) LoadSignals over the included
+        replicas — the router's dispatch input."""
+        with self._lock:
+            sigs = [
+                load_signal_from_snapshot(rep.label, rep.snapshot)
+                for rep in self._included()
+            ]
+        return rank_load_signals(sigs)
+
+    def _refresh_fleet_gauges(self) -> None:
+        now = self.wall_clock()
+        with self._lock:
+            reps = list(self.replicas)
+            included = self._included()
+        # gauges rebuild from scratch every refresh: a replica whose label
+        # changed (fallback URL -> self-reported replica_id, a dedup
+        # suffix, a restart under the default hostname:pid identity) must
+        # not leave a phantom old-label series in every export. The edge
+        # COUNTERS (transitions/polls) deliberately keep old labels —
+        # they are history.
+        for gauge in (self.replica_state, self.replicas_gauge,
+                      self.snapshot_age, self.load_signal_gauge):
+            gauge.reset()
+        for state in STATES:
+            self.replicas_gauge.set(
+                sum(1 for r in reps if r.state == state), state=state
+            )
+        for rep in reps:
+            self.replica_state.set(STATE_CODES[rep.state], replica=rep.label)
+            age = rep.snapshot_age_s(now)
+            if age is not None:
+                self.snapshot_age.set(age, replica=rep.label)
+        sigs = rank_load_signals([
+            load_signal_from_snapshot(rep.label, rep.snapshot)
+            for rep in included
+        ])
+        for s in sigs:
+            self.load_signal_gauge.set(s.score, replica=s.replica)
+        scores = [s.score for s in sigs]
+        self.straggler_gap.set(max(scores) - min(scores) if len(scores) > 1 else 0.0)
+        # lifetime fleet SLO attainment from SUMMED counters (merge-exact,
+        # unlike averaging the per-replica rolling gauges)
+        attained = breached = 0.0
+        for rep in included:
+            fam = rep.snapshot.get("nxdi_slo_requests_total")
+            for row in (fam or {}).get("series", []):
+                if row.get("labels", {}).get("outcome") == "attained":
+                    attained += float(row["value"])
+                elif row.get("labels", {}).get("outcome") == "breached":
+                    breached += float(row["value"])
+        total = attained + breached
+        if total > 0:
+            self.slo_attainment.set(100.0 * attained / total)
+
+    def fleet_registry(self) -> Tuple[MetricsRegistry, List[str]]:
+        """Fresh merged registry: included member snapshots (counters
+        summed, gauges replica-labeled, histograms bucket-exact) + the
+        monitor's own persistent ``nxdi_fleet_*`` series."""
+        self._refresh_fleet_gauges()
+        with self._lock:
+            member = {
+                rep.label: rep.snapshot for rep in self._included()
+            }
+        reg, notes = merge_snapshots(member)
+        notes.extend(copy_registry_into(self.registry, reg))
+        return reg, notes
+
+    def prometheus_text(self) -> str:
+        reg, _ = self.fleet_registry()
+        return prometheus_text(reg)
+
+    def snapshot(self) -> dict:
+        """Fleet JSON snapshot: the merged families plus a ``_fleet``
+        summary and per-replica detail under ``_replicas``."""
+        reg, notes = self.fleet_registry()
+        snap = reg.snapshot()
+        now = self.wall_clock()
+        with self._lock:
+            snap["_replicas"] = {
+                rep.label: {
+                    "url": rep.url,
+                    "state": rep.state,
+                    "failures": rep.failures,
+                    "last_error": rep.last_error,
+                    "snapshot_age_s": rep.snapshot_age_s(now),
+                    "process": (rep.snapshot or {}).get("_process"),
+                    "slo": (rep.snapshot or {}).get("_slo"),
+                }
+                for rep in self.replicas
+            }
+            states = {rep.label: rep.state for rep in self.replicas}
+        snap["_fleet"] = {
+            "replicas": len(states),
+            "states": states,
+            "load_signals": [s.to_dict() for s in self.load_signals()],
+            "merge_notes": notes,
+        }
+        return snap
+
+    def healthz(self) -> dict:
+        with self._lock:
+            states = {rep.label: rep.state for rep in self.replicas}
+        unreachable = sorted(k for k, v in states.items() if v == UNREACHABLE)
+        return {
+            "status": "ok" if not unreachable else "degraded",
+            "replicas": states,
+            "unreachable": unreachable,
+        }
+
+    def perfetto_trace(self) -> dict:
+        """Merged multi-replica Perfetto trace: fetch each included
+        replica's ``/trace.json`` and stack them one process group per
+        replica (federation.merge_perfetto_traces). Replicas that fail the
+        trace fetch are skipped — the trace is a debugging surface, not a
+        health signal."""
+        with self._lock:
+            targets = [(rep.label, rep.url) for rep in self._included()]
+        traces: Dict[str, dict] = {}
+        for label, url in targets:
+            try:
+                traces[label] = _http_fetch(
+                    url + "/trace.json", self.config.timeout_s
+                )
+            except Exception:  # noqa: BLE001
+                continue
+        return merge_perfetto_traces(traces)
+
+    def serve(self, host: str = "127.0.0.1", port: int = 9500):
+        """Federation endpoint: the SAME probe paths a single replica
+        serves (/metrics, /metrics.json, /snapshot, /healthz,
+        /trace.json), answered from the merged fleet view. ``port=0``
+        binds ephemeral; read ``.port`` back."""
+        from nxdi_tpu.telemetry.export import (
+            PROM_CONTENT_TYPE,
+            MetricsServer,
+        )
+
+        routes = [
+            ("/healthz", "application/json",
+             lambda: json.dumps(self.healthz())),
+            ("/metrics.json", "application/json",
+             lambda: json.dumps(self.snapshot(), indent=2)),
+            ("/snapshot", "application/json",
+             lambda: json.dumps(self.snapshot(), indent=2)),
+            ("/trace.json", "application/json",
+             lambda: json.dumps(self.perfetto_trace())),
+            ("/metrics", PROM_CONTENT_TYPE, self.prometheus_text),
+        ]
+        return MetricsServer(host=host, port=port, routes=routes).start()

@@ -159,6 +159,29 @@ class Histogram(_Metric):
             s.sum += value * n
             s.count += n
 
+    def add_series(
+        self, counts: Sequence[int], total_sum: float, count: int, **labels
+    ) -> None:
+        """Fold pre-bucketed counts into one series — the federation merge
+        path (telemetry/federation.py). ``counts`` must already be bucketed
+        against THIS histogram's bounds (one entry per bound + the +Inf
+        bucket); because every replica registers the same fixed bounds, the
+        merge is bucket-exact, never a re-estimate."""
+        if len(counts) != len(self.bounds) + 1:
+            raise ValueError(
+                f"histogram {self.name!r} merge needs {len(self.bounds) + 1} "
+                f"bucket counts (bounds + +Inf), got {len(counts)}"
+            )
+        key = self._key(labels)
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = _HistSeries(len(self.bounds) + 1)
+            for i, c in enumerate(counts):
+                s.counts[i] += int(c)
+            s.sum += float(total_sum)
+            s.count += int(count)
+
     def snapshot_series(self, **labels) -> Optional[_HistSeries]:
         with self._lock:
             s = self._series.get(self._key(labels))
@@ -285,6 +308,11 @@ class MetricsRegistry:
             if not series:
                 continue
             entry: dict = {"type": m.kind, "help": m.help}
+            if isinstance(m, Histogram):
+                # the full fixed bound ladder: what lets a federator
+                # (telemetry/federation.py) rebuild exact bucket arrays from
+                # the sparse per-row bucket dicts below
+                entry["bounds"] = list(m.bounds)
             rows = []
             for key in sorted(series):
                 val = series[key]

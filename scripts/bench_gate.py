@@ -65,6 +65,16 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     # (one extra breaching request moves it by a whole request's tokens).
     "slo_attainment_pct": ("higher", 0.05),
     "goodput_slo_tok_s": ("higher", 0.10),
+    # fleet-mode headline fields (bench.py --serving --replicas N; PR:
+    # fleet observatory). One-sided, skipped against pre-fleet baselines
+    # (missing on a side). The straggler gap measures cross-replica spread
+    # on a host-contended run — the noisiest fleet number, so it gets the
+    # widest tolerance; attainment behaves like its single-replica twin.
+    "fleet_goodput_req_s": ("higher", 0.07),
+    "fleet_tok_s": ("higher", 0.07),
+    "fleet_straggler_gap_pct": ("lower", 0.30),
+    "fleet_slo_attainment_pct": ("higher", 0.05),
+    "fleet_goodput_slo_tok_s": ("higher", 0.10),
 }
 
 
@@ -142,15 +152,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     tolerances = dict(TOLERANCES)
-    if "serving_goodput_req_s" in fresh:
-        # a serving-mode FRESH record duplicates its "value" headline as
-        # serving_goodput_req_s (which carries the serving tolerance), and
-        # against a decode-mode baseline "value" (tok/s/chip) measures
-        # something else entirely — the generic "value" row must not gate
-        # it. Keyed on the FRESH side only: a decode-mode record must keep
-        # its headline gate even against a trajectory baseline that folded
-        # serving_* fields in (the side-file folding the docstring
-        # describes), or a real tok/s regression would pass silently.
+    if "serving_goodput_req_s" in fresh or "fleet_goodput_req_s" in fresh:
+        # a serving- or fleet-mode FRESH record duplicates its "value"
+        # headline as serving_goodput_req_s / fleet_goodput_req_s (which
+        # carry their own tolerances), and against a decode-mode baseline
+        # "value" (tok/s/chip) measures something else entirely — the
+        # generic "value" row must not gate it. Keyed on the FRESH side
+        # only: a decode-mode record must keep its headline gate even
+        # against a trajectory baseline that folded serving_*/fleet_*
+        # fields in (the side-file folding the docstring describes), or a
+        # real tok/s regression would pass silently.
         tolerances.pop("value", None)
     rows, skipped = compare(baseline, fresh, tolerances, scale=args.tolerance_scale)
     if args.json_path:

@@ -1,0 +1,326 @@
+"""Fleet observatory end to end (the PR's acceptance surface): real
+tiny-model engines on the CPU mesh, each serving its probe endpoints on an
+ephemeral port, observed over real localhost HTTP by a FleetMonitor —
+
+- the merged Prometheus output parses and carries summed counters,
+  replica-labeled gauges, bucket-exact merged histograms, and the
+  ``nxdi_fleet_*`` series;
+- per-replica labels are stable across polls;
+- killing one replica drives HEALTHY -> UNREACHABLE (edge-counted) and
+  excludes its series from the fleet aggregates;
+- LoadSignal ranking is deterministic and matches the documented formula
+  bit-exactly;
+- ``python -m nxdi_tpu.cli.fleet --once`` exits 0 against the healthy
+  fleet and non-zero once a replica is unreachable (the tier-1 fleet
+  smoke);
+- the ``--serve`` federation endpoint and the merged multi-replica
+  Perfetto trace reuse the per-replica tracks one process group apart.
+"""
+
+import json
+import urllib.request
+
+import pytest
+
+from nxdi_tpu.config import FleetConfig, OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.serving import InferenceEngine, SamplingParams, SchedulerConfig
+from nxdi_tpu.telemetry.fleet import HEALTHY, UNREACHABLE, FleetMonitor
+
+P0 = [5, 9, 3, 17, 2, 8, 11, 42]
+P1 = [7, 13, 21, 4, 33]
+P2 = [9, 9, 2, 40, 17, 3]
+
+
+def _build_replica(hf_model, hf_cfg, replica_id, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        ctx_batch_size=1,
+        tkg_batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        is_block_kv_layout=True,
+        pa_block_size=8,
+        pa_num_blocks=32,
+        telemetry={"detail": "basic", "replica_id": replica_id},
+        slo={"ttft_s": 100.0, "tpot_s": 100.0},
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app, InferenceEngine(app, SchedulerConfig(num_slots=2))
+
+
+@pytest.fixture(scope="module")
+def fleet(tiny_hf_llama_module):
+    """Two live replicas with distinct load: r0 drained (all requests
+    finished), r1 mid-flight (stepped once, queue + busy slots non-trivial).
+    Yields (apps, engines, servers, urls)."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines, servers = [], [], []
+    for i in range(2):
+        app, engine = _build_replica(hf_model, hf_cfg, f"rep-{i}")
+        apps.append(app)
+        engines.append(engine)
+    # r0: a finished workload
+    engines[0].add_request(P0, SamplingParams(max_new_tokens=4))
+    engines[0].add_request(P1, SamplingParams(max_new_tokens=3))
+    engines[0].run()
+    # r1: mid-flight — two running slots plus one queued request (one
+    # admission per step, so two steps fill both slots)
+    engines[1].add_request(P0, SamplingParams(max_new_tokens=12))
+    engines[1].add_request(P1, SamplingParams(max_new_tokens=12))
+    engines[1].add_request(P2, SamplingParams(max_new_tokens=12))
+    engines[1].step()
+    engines[1].step()
+    for app in apps:
+        servers.append(app.telemetry.serve(port=0))
+    yield apps, engines, servers, [s.url for s in servers]
+    for s in servers:
+        s.shutdown()
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama_module():
+    """Module-scoped twin of the conftest tiny_hf_llama fixture (two loaded
+    replica apps are worth amortizing across this file)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    return model, cfg
+
+
+def _parse_prom(text):
+    """{(name, frozenset(label pairs)): value} over non-comment lines —
+    the 'merged output parses' acceptance check."""
+    out = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        head, val = line.rsplit(" ", 1)
+        if "{" in head:
+            name, rest = head.split("{", 1)
+            labels = frozenset(
+                tuple(kv.split("=", 1)) for kv in rest.rstrip("}").split(",")
+            )
+        else:
+            name, labels = head, frozenset()
+        out[(name, labels)] = float(val)
+    return out
+
+
+def test_fleet_merges_two_live_replicas(fleet):
+    apps, engines, servers, urls = fleet
+    monitor = FleetMonitor(
+        [("rep-0", urls[0]), ("rep-1", urls[1])],
+        config=FleetConfig(staleness_s=3600.0),
+    )
+    assert monitor.poll() == {"rep-0": HEALTHY, "rep-1": HEALTHY}
+
+    series = _parse_prom(monitor.prometheus_text())
+    # counters summed (no replica label): both replicas' finished requests
+    r0 = apps[0].telemetry.requests_total.total()
+    r1 = apps[1].telemetry.requests_total.total()
+    assert r0 > 0
+    assert series[("nxdi_requests_total", frozenset())] == r0 + r1
+    # gauges replica-labeled: r1's live queue and busy slots are visible
+    q1 = ("nxdi_serve_queue_depth", frozenset({("replica", '"rep-1"')}))
+    b1 = ("nxdi_serve_slots_busy", frozenset({("replica", '"rep-1"')}))
+    assert series[q1] == engines[1].scheduler.queue_depth == 1
+    assert series[b1] == engines[1].scheduler.slots_busy == 2
+    # bucket-exact histogram merge: fleet dispatch count = sum of members
+    d0 = apps[0].telemetry.dispatch_seconds.series_snapshot()
+    d1 = apps[1].telemetry.dispatch_seconds.series_snapshot()
+    member_count = sum(c for _, _, c in d0.values()) + sum(
+        c for _, _, c in d1.values()
+    )
+    fleet_count = sum(
+        v for (name, _), v in series.items()
+        if name == "nxdi_dispatch_seconds_count"
+    )
+    assert fleet_count == member_count
+    # the fleet-level series are present
+    assert series[("nxdi_fleet_replicas",
+                   frozenset({("state", '"healthy"')}))] == 2
+    assert ("nxdi_fleet_straggler_gap", frozenset()) in series
+
+    # labels stay stable across polls
+    monitor.poll()
+    again = _parse_prom(monitor.prometheus_text())
+    assert series[q1] == again[q1]
+    assert {k for k in again if k[0] == "nxdi_serve_queue_depth"} == \
+        {k for k in series if k[0] == "nxdi_serve_queue_depth"}
+
+
+def test_load_signal_ranking_matches_documented_formula(fleet):
+    apps, engines, servers, urls = fleet
+    monitor = FleetMonitor(
+        [("rep-0", urls[0]), ("rep-1", urls[1])],
+        config=FleetConfig(staleness_s=3600.0),
+    )
+    monitor.poll()
+    sigs = monitor.load_signals()
+    assert [s.replica for s in sigs] == ["rep-0", "rep-1"]  # drained first
+
+    # bit-exact against the documented formula over the REPLICA's own
+    # exported gauges (fetched straight from its /snapshot endpoint)
+    for sig, url in zip(sigs, [urls[0], urls[1]]):
+        with urllib.request.urlopen(f"{url}/snapshot") as resp:
+            snap = json.loads(resp.read())
+
+        def gauge(name, default=0.0):
+            fam = snap.get(name)
+            return float(fam["series"][0]["value"]) if fam else default
+
+        used, free = gauge("nxdi_kv_blocks_used"), gauge("nxdi_kv_blocks_free")
+        expected = (
+            gauge("nxdi_serve_queue_depth")
+            + gauge("nxdi_serve_slots_busy")
+            + 4.0 * (used / (used + free) if used + free > 0 else 0.0)
+            + 2.0 * (1.0 - gauge("nxdi_slo_attainment_pct", 100.0) / 100.0)
+        )
+        assert sig.score == expected  # no approx: the formula IS the API
+    # deterministic: a second poll ranks identically
+    monitor.poll()
+    assert [s.replica for s in monitor.load_signals()] == ["rep-0", "rep-1"]
+
+
+def test_killing_a_replica_excludes_it_from_aggregates(
+    fleet, tiny_hf_llama_module
+):
+    """The acceptance kill test: the shared fixture's rep-0 survives; a
+    disposable third replica is built, observed healthy, then killed."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines, servers, urls = fleet
+    app_k, engine_k = _build_replica(hf_model, hf_cfg, "kill-1")
+    engine_k.add_request(P1, SamplingParams(max_new_tokens=3))
+    engine_k.run()
+    sk = app_k.telemetry.serve(port=0)
+    try:
+        monitor = FleetMonitor(
+            [("rep-0", urls[0]), ("kill-1", sk.url)],
+            config=FleetConfig(
+                staleness_s=3600.0, unreachable_failures=2,
+                backoff_base_s=0.01, backoff_max_s=0.02, timeout_s=2.0,
+            ),
+        )
+        assert monitor.poll() == {"rep-0": HEALTHY, "kill-1": HEALTHY}
+        r0_total = apps[0].telemetry.requests_total.total()
+        both = monitor.fleet_registry()[0].get("nxdi_requests_total").total()
+        assert both == r0_total + 1.0
+
+        sk.shutdown()  # kill the replica
+        import time
+
+        deadline = time.time() + 10.0
+        while monitor.poll()["kill-1"] != UNREACHABLE:
+            assert time.time() < deadline, "never went unreachable"
+            time.sleep(0.03)
+        # series excluded from fleet aggregates; the edge was counted
+        reg, _ = monitor.fleet_registry()
+        assert reg.get("nxdi_requests_total").total() == r0_total
+        gauges = reg.get("nxdi_serve_queue_depth")
+        assert all(
+            lbl != ("kill-1",) for lbl in gauges.series()
+        )
+        t = monitor.transitions_total
+        assert t.value(replica="kill-1", from_state="degraded",
+                       to_state="unreachable") == 1
+        snap = monitor.snapshot()
+        assert snap["_fleet"]["states"]["kill-1"] == UNREACHABLE
+        assert snap["_replicas"]["kill-1"]["last_error"]
+    finally:
+        sk.shutdown()
+
+
+def test_fleet_cli_once_smoke_and_unreachable_exit(fleet, capsys):
+    """The tier-1 fleet smoke: cli.fleet --once against two in-process
+    replicas exits 0 and prints the ranked table; against a dead target it
+    exits non-zero."""
+    from nxdi_tpu.cli.fleet import main
+
+    apps, engines, servers, urls = fleet
+    rc = main(["--once", "--staleness", "3600",
+               f"rep-0={urls[0]}", f"rep-1={urls[1]}"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rep-0" in out and "rep-1" in out and "score" in out
+
+    # JSON mode carries the fleet summary
+    rc = main(["--once", "--format", "json", "--staleness", "3600",
+               f"rep-0={urls[0]}", f"rep-1={urls[1]}"])
+    snap = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert snap["_fleet"]["replicas"] == 2
+    assert [s["replica"] for s in snap["_fleet"]["load_signals"]] == \
+        ["rep-0", "rep-1"]
+
+    # a dead port: non-zero exit names the failing replica
+    rc = main(["--once", "--timeout", "0.2",
+               f"rep-0={urls[0]}", "dead=http://127.0.0.1:9"])
+    assert rc == 1
+
+
+def test_federation_endpoint_and_merged_perfetto(fleet, tmp_path):
+    apps, engines, servers, urls = fleet
+    monitor = FleetMonitor(
+        [("rep-0", urls[0]), ("rep-1", urls[1])],
+        config=FleetConfig(staleness_s=3600.0),
+    )
+    monitor.poll()
+    fs = monitor.serve(port=0)
+    try:
+        with urllib.request.urlopen(f"{fs.url}/healthz") as resp:
+            health = json.loads(resp.read())
+        assert health["status"] == "ok"
+        assert set(health["replicas"]) == {"rep-0", "rep-1"}
+        with urllib.request.urlopen(f"{fs.url}/metrics") as resp:
+            assert resp.headers["Content-Type"].startswith("text/plain")
+            text = resp.read().decode()
+        assert "nxdi_fleet_replica_state" in text
+        assert 'replica="rep-0"' in text
+        with urllib.request.urlopen(f"{fs.url}/snapshot") as resp:
+            snap = json.loads(resp.read())
+        assert "_fleet" in snap and "_replicas" in snap
+        with urllib.request.urlopen(f"{fs.url}/trace.json") as resp:
+            trace = json.loads(resp.read())
+    finally:
+        fs.shutdown()
+    # one process group per replica, per-slot engine tracks preserved
+    names = {
+        e["args"]["name"]
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "process_name"
+    }
+    assert "rep-0 · nxdi_tpu requests" in names
+    assert "rep-1 · engine steps (per slot)" in names
+    slot_tracks = {
+        (e["pid"], e["args"]["name"])
+        for e in trace["traceEvents"]
+        if e.get("ph") == "M" and e["name"] == "thread_name"
+        and e["args"]["name"].startswith("slot ")
+    }
+    assert {n for _, n in slot_tracks} == {"slot 0", "slot 1"}
+    assert len({p for p, _ in slot_tracks}) == 2  # two engine process groups

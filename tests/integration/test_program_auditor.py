@@ -247,6 +247,91 @@ def test_required_strategy_finding_via_auditor(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# MoE TPxEP collective budget (the ROADMAP invariant: dispatch/combine
+# counts derived from moe_*_degree instead of the generous flat allowance)
+# ---------------------------------------------------------------------------
+
+def make_moe_app(**tpu_kwargs):
+    """Tiny mixtral on the 8-device CPU mesh with an explicit TPxEP regime
+    (moe_ep_degree=2 carves the ep axis out of tp=8)."""
+    from nxdi_tpu.config import TpuConfig
+    from nxdi_tpu.models.registry import get_family
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM
+
+    family, cfg_cls = get_family("mixtral")
+    defaults = dict(
+        tp_degree=8,
+        seq_len=64,
+        max_context_length=32,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        moe_ep_degree=2,
+    )
+    defaults.update(tpu_kwargs)
+    cfg = cfg_cls(
+        TpuConfig(**defaults),
+        hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=8, num_key_value_heads=8, vocab_size=256,
+        rms_norm_eps=1e-5, num_local_experts=8, num_experts_per_tok=2,
+    )
+
+    class App(TpuModelForCausalLM):
+        pass
+
+    return App("<abstract>", cfg, model_family=family)
+
+
+def test_moe_tpxep_budget_clean_and_exact():
+    """The shipped sparse TPxEP program fits the budget DERIVED from
+    moe_ep_degree — and that budget allows ZERO all-to-all/extra
+    all-gathers (the old flat allowance granted 4 of each)."""
+    app = make_moe_app()
+    report = app.audit(submodels=[TAG_TOKEN_GENERATION])
+    assert errors_of(report, "collectives") == [], report.to_json()
+    (prog,) = report.programs
+    assert prog.budget["all-to-all"] == 0
+    assert prog.collectives["all-to-all"] == 0
+    # the combine really is the single derived psum allowance
+    assert prog.budget["all-reduce"] <= 5
+
+
+def test_moe_tpxep_budget_violation_detected(monkeypatch):
+    """Seeded violation: extra per-layer psums smuggled into the MoE combine
+    (a wasteful regime regression). The OLD flat budget (+2 MoE all-reduce)
+    would have absorbed them; the moe_ep_degree-derived budget (+1) trips
+    with the regime named in the explain."""
+    import nxdi_tpu.ops.moe as ops_moe
+    from nxdi_tpu.parallel.mesh import AXIS_MP
+
+    orig = ops_moe._sparse_moe
+
+    def wasteful(moe, experts, x, weights, idx, hidden_spec):
+        out = orig(moe, experts, x, weights, idx, hidden_spec)
+        mesh = jax.sharding.get_abstract_mesh()
+        world = 1
+        for a in AXIS_MP:
+            world *= mesh.shape.get(a, 1)
+        f = jax.shard_map(
+            lambda v: jax.lax.psum(v, AXIS_MP), mesh=mesh,
+            in_specs=jax.sharding.PartitionSpec(),
+            out_specs=jax.sharding.PartitionSpec(), check_vma=False,
+        )
+        for _ in range(3):  # 3 unbudgeted all-reduces per layer body
+            out = f(out) / world
+        return out
+
+    monkeypatch.setattr(ops_moe, "_sparse_moe", wasteful)
+    app = make_moe_app()
+    report = app.audit(submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "collectives")
+    assert findings, report.to_json()
+    msg = findings[0].message
+    assert "all-reduce" in msg and "exceed the policy budget" in msg
+    assert "moe_ep_degree=2" in msg  # the derived regime is in the explain
+
+
+# ---------------------------------------------------------------------------
 # KV-layout addressing (the ROADMAP unchecked-invariant, now checked)
 # ---------------------------------------------------------------------------
 

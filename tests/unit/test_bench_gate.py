@@ -137,6 +137,57 @@ def test_usage_errors(tmp_path):
                             "--baseline", str(tmp_path / "nope.json")]) == 2
 
 
+def test_fleet_metrics_gate_and_skip_when_absent(tmp_path):
+    """bench.py --serving --replicas N emits fleet_* headline fields:
+    one-sided gating, skipped against pre-fleet baselines, and the generic
+    'value' row suppressed for fleet-mode fresh records (their req/s
+    headline must not gate against a decode-mode tok/s baseline)."""
+    fleet = {
+        "value": 1.6,
+        "fleet_replicas": 2,
+        "fleet_goodput_req_s": 1.6,
+        "fleet_tok_s": 410.0,
+        "fleet_straggler_gap_pct": 12.0,
+        "fleet_slo_attainment_pct": 96.0,
+        "fleet_goodput_slo_tok_s": 400.0,
+    }
+    # pre-fleet baseline (decode-mode BASE): every fleet_* field skips and
+    # the suppressed "value" row cannot fail the run
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fleet),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, fleet, bench_gate.TOLERANCES)
+    assert "fleet_tok_s" in skipped and "fleet_straggler_gap_pct" in skipped
+
+    # same-shape baseline: a goodput drop beyond tolerance fails...
+    worse = dict(fleet, fleet_tok_s=330.0, fleet_goodput_req_s=1.3)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", worse),
+        "--baseline", _write(tmp_path, "base.json", fleet),
+        "-q",
+    ])
+    assert rc == 1
+    # ... a straggler-gap blowout fails (lower is better, one-sided) ...
+    straggly = dict(fleet, fleet_straggler_gap_pct=40.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", straggly),
+        "--baseline", _write(tmp_path, "base.json", fleet),
+        "-q",
+    ])
+    assert rc == 1
+    # ... and a gap IMPROVEMENT plus in-tolerance noise passes (one-sided)
+    better = dict(fleet, fleet_straggler_gap_pct=2.0, fleet_tok_s=402.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", better),
+        "--baseline", _write(tmp_path, "base.json", fleet),
+        "-q",
+    ])
+    assert rc == 0
+
+
 def test_serving_metrics_gate_and_skip_when_absent(tmp_path):
     """The bench.py --serving goodput line gates one-sided; a baseline from
     BEFORE the serving engine (no serving_* fields) skips them instead of

@@ -74,6 +74,52 @@ def test_policy_feature_precedence_mirrors_policy_constructors():
     assert not any([feats["cp"], feats["sp"], feats["mlp_cp"]])
 
 
+MOE = SimpleNamespace(num_layers=4, moe=SimpleNamespace(
+    num_experts=8, shared_expert_intermediate_size=None))
+MOE_SHARED = SimpleNamespace(num_layers=4, moe=SimpleNamespace(
+    num_experts=8, shared_expert_intermediate_size=64))
+
+
+def test_moe_tpxep_budget_derived_from_moe_ep_degree():
+    """TPxEP (moe_ep_degree set): the sparse path's dispatch is a local
+    gather and its combine ONE psum — the derived budget is exactly one
+    all-reduce per body and ZERO all-to-all / all-gather, replacing the old
+    flat 4/4/2 allowance."""
+    plain, _ = expected_collective_budget(tc(), ARCH, wrapper())
+    moe_b, explain = expected_collective_budget(
+        tc(moe_ep_degree=2), MOE, wrapper()
+    )
+    assert moe_b["all-reduce"] == plain["all-reduce"] + 1
+    assert moe_b["all-to-all"] == plain["all-to-all"] == 0
+    assert moe_b["all-gather"] == plain["all-gather"]  # no MoE AG allowance
+    assert any("moe_ep_degree=2" in e for e in explain)
+    # the shared (always-on) expert pays its own row-parallel psum
+    shared_b, _ = expected_collective_budget(
+        tc(moe_ep_degree=2), MOE_SHARED, wrapper()
+    )
+    assert shared_b["all-reduce"] == plain["all-reduce"] + 2
+
+
+def test_moe_per_phase_hybrid_budget_picks_the_phase_degree():
+    """hybrid_sharding_config: decode programs budget against
+    moe_tkg_ep_degree, prefill against moe_cte_ep_degree — and the explain
+    names which regime was derived."""
+    cfg = tc(hybrid_sharding_config=dict(
+        moe_cte_ep_degree=2, moe_tkg_ep_degree=8))
+    _, dec_explain = expected_collective_budget(cfg, MOE, wrapper(decode=True))
+    _, pre_explain = expected_collective_budget(cfg, MOE, wrapper(decode=False))
+    assert any("moe_tkg_ep_degree=8" in e for e in dec_explain)
+    assert any("moe_cte_ep_degree=2" in e for e in pre_explain)
+
+
+def test_moe_without_declared_degrees_keeps_flat_budget():
+    """Full-world EP / expert-internal TP (no moe_*_degree declared): GSPMD
+    owns the lowering, so the generous flat allowance stays."""
+    flat, explain = expected_collective_budget(tc(), MOE, wrapper())
+    assert flat["all-to-all"] == 4
+    assert any("dispatch/combine over the expert axis" in e for e in explain)
+
+
 def test_fused_spec_doubles_body_terms():
     plain, _ = expected_collective_budget(tc(), ARCH, wrapper())
     fused, _ = expected_collective_budget(tc(), ARCH, wrapper(draft=True))

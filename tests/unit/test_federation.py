@@ -1,0 +1,372 @@
+"""Fleet federation unit suite: merge semantics (counters sum, gauges get
+replica labels, histograms merge bucket-exact), the replica health state
+machine under an injected clock (staleness age-out, exponential backoff,
+edge-transition counters), and the pinned LoadSignal formula/ranking."""
+
+import json
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import FleetConfig
+from nxdi_tpu.telemetry import Telemetry
+from nxdi_tpu.telemetry.federation import (
+    merge_perfetto_traces,
+    merge_snapshots,
+)
+from nxdi_tpu.telemetry.fleet import (
+    DEGRADED,
+    HEALTHY,
+    UNREACHABLE,
+    FleetMonitor,
+    LoadSignal,
+    load_signal_from_snapshot,
+    rank_load_signals,
+)
+from nxdi_tpu.telemetry.registry import (
+    percentile_from_buckets,
+    prometheus_text,
+)
+
+
+def roundtrip(snap):
+    """Snapshots cross an HTTP boundary in production — merge what JSON
+    round-tripping actually delivers."""
+    return json.loads(json.dumps(snap))
+
+
+def replica_snapshot(replica_id, requests=0, queue=0.0, observations=()):
+    tel = Telemetry(replica_id=replica_id)
+    if requests:
+        tel.requests_total.inc(requests)
+    tel.serve_queue_depth.set(queue)
+    for v in observations:
+        tel.dispatch_seconds.observe(v, submodel="tkg", bucket="64", steps="1")
+    return tel, roundtrip(tel.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+def test_counters_sum_across_replicas():
+    _, s1 = replica_snapshot("r1", requests=3)
+    _, s2 = replica_snapshot("r2", requests=5)
+    reg, notes = merge_snapshots({"r1": s1, "r2": s2})
+    assert notes == []
+    snap = reg.snapshot()
+    (row,) = snap["nxdi_requests_total"]["series"]
+    assert row["value"] == 8.0 and row["labels"] == {}
+
+
+def test_gauges_carry_replica_labels_and_never_collide():
+    """Two replicas exporting the SAME gauge must land as two distinct
+    series — identical label tuples would silently overwrite."""
+    _, s1 = replica_snapshot("r1", queue=2.0)
+    _, s2 = replica_snapshot("r2", queue=7.0)
+    reg, _ = merge_snapshots({"r1": s1, "r2": s2})
+    g = reg.get("nxdi_serve_queue_depth")
+    assert g.kind == "gauge"
+    assert g.value(replica="r1") == 2.0
+    assert g.value(replica="r2") == 7.0
+    assert len(g.series()) == 2  # nothing overwrote anything
+    # and the exposition renders both, labeled
+    text = prometheus_text(reg)
+    assert 'nxdi_serve_queue_depth{replica="r1"} 2' in text
+    assert 'nxdi_serve_queue_depth{replica="r2"} 7' in text
+
+
+def test_merged_histogram_percentiles_equal_pooled_series():
+    """Property (fixed bounds make the merge bucket-exact): merging each
+    replica's histogram equals one histogram that observed the POOLED
+    series — identical buckets, sum, count, and therefore identical
+    percentile estimates at every p."""
+    rng = np.random.default_rng(7)
+    shards = [rng.lognormal(-5.0, 2.0, size=n) for n in (37, 11, 53)]
+    snaps = {}
+    for i, xs in enumerate(shards):
+        _, snap = replica_snapshot(f"r{i}", observations=xs)
+        snaps[f"r{i}"] = snap
+    merged, _ = merge_snapshots(snaps)
+    mh = merged.get("nxdi_dispatch_seconds")
+
+    pooled_tel = Telemetry(replica_id="pooled")
+    for xs in shards:
+        for v in xs:
+            pooled_tel.dispatch_seconds.observe(
+                v, submodel="tkg", bucket="64", steps="1"
+            )
+    ph = pooled_tel.dispatch_seconds
+
+    labels = dict(submodel="tkg", bucket="64", steps="1")
+    ms, ps = mh.snapshot_series(**labels), ph.snapshot_series(**labels)
+    assert ms.counts == ps.counts
+    assert ms.count == ps.count == sum(len(xs) for xs in shards)
+    assert ms.sum == pytest.approx(ps.sum)
+    assert tuple(mh.bounds) == tuple(ph.bounds)
+    for p in (1, 25, 50, 90, 95, 99, 99.9):
+        assert percentile_from_buckets(mh.bounds, ms.counts, ms.count, p) == \
+            percentile_from_buckets(ph.bounds, ps.counts, ps.count, p)
+
+
+def test_merge_skews_degrade_per_family_not_per_replica():
+    """A family registered with a different type across replicas is noted
+    and skipped; every other family still merges."""
+    _, s1 = replica_snapshot("r1", requests=1)
+    _, s2 = replica_snapshot("r2", requests=2)
+    s2["nxdi_requests_total"]["type"] = "gauge"  # version-skewed replica
+    reg, notes = merge_snapshots({"r1": s1, "r2": s2})
+    assert any("nxdi_requests_total" in n for n in notes)
+    # r2's gauges still merged fine
+    assert reg.get("nxdi_serve_queue_depth").value(replica="r2") == 0.0
+
+
+def test_snapshot_carries_process_stamp_and_bounds():
+    """Satellite: every snapshot self-describes its origin (replica_id,
+    snapshot_unix_s wall stamp, uptime) and its histograms carry the full
+    bounds ladder the federator rebuilds exact buckets from."""
+    wall = {"t": 1000.0}
+    mono = {"t": 50.0}
+    tel = Telemetry(replica_id="stamped", clock=lambda: mono["t"],
+                    wall_clock=lambda: wall["t"])
+    tel.dispatch_seconds.observe(0.01, submodel="tkg", bucket="64", steps="1")
+    mono["t"] = 62.5
+    wall["t"] = 1012.5
+    snap = tel.snapshot()
+    proc = snap["_process"]
+    assert proc["replica_id"] == "stamped"
+    assert proc["snapshot_unix_s"] == 1012.5
+    assert proc["uptime_s"] == 12.5
+    assert snap["nxdi_dispatch_seconds"]["bounds"] == list(
+        tel.dispatch_seconds.bounds
+    )
+
+
+# ---------------------------------------------------------------------------
+# health state machine (injected clock)
+# ---------------------------------------------------------------------------
+
+class FakeFleet:
+    """Injectable fetch + wall clock around a FleetMonitor."""
+
+    def __init__(self, snapshots, **cfg):
+        self.now = 1000.0
+        self.snapshots = dict(snapshots)  # url -> snapshot | Exception
+        cfg.setdefault("backoff_base_s", 0.5)
+        self.monitor = FleetMonitor(
+            [(name, name) for name in sorted(self.snapshots)],
+            config=FleetConfig(**cfg),
+            fetch=self.fetch,
+            wall_clock=lambda: self.now,
+        )
+
+    def fetch(self, url, timeout_s):
+        v = self.snapshots[url]
+        if isinstance(v, Exception):
+            raise v
+        return v
+
+    def stamped(self, replica_id, t):
+        return {"_process": {"replica_id": replica_id, "snapshot_unix_s": t}}
+
+
+def test_health_degraded_then_unreachable_with_edge_counters():
+    f = FakeFleet({"a": None, "b": None}, unreachable_failures=3)
+    f.snapshots["a"] = f.stamped("a", 1000.0)
+    f.snapshots["b"] = f.stamped("b", 1000.0)
+    assert f.monitor.poll() == {"a": HEALTHY, "b": HEALTHY}
+
+    f.snapshots["b"] = ConnectionError("refused")
+    for expect_b, dt in ((DEGRADED, 100.0), (DEGRADED, 100.0),
+                         (UNREACHABLE, 100.0)):
+        f.now += dt
+        f.snapshots["a"] = f.stamped("a", f.now)
+        states = f.monitor.poll()
+        assert states["a"] == HEALTHY and states["b"] == expect_b
+
+    t = f.monitor.transitions_total
+    # each EDGE counted once, no re-counting while the state holds
+    assert t.value(replica="b", from_state=HEALTHY, to_state=DEGRADED) == 1
+    assert t.value(replica="b", from_state=DEGRADED, to_state=UNREACHABLE) == 1
+    assert t.value(replica="a", from_state=HEALTHY, to_state=DEGRADED) == 0
+
+    # recovery is immediate on one good poll, and counted as its own edge
+    f.now += 100.0
+    f.snapshots["a"] = f.stamped("a", f.now)
+    f.snapshots["b"] = f.stamped("b", f.now)
+    assert f.monitor.poll()["b"] == HEALTHY
+    assert t.value(replica="b", from_state=UNREACHABLE, to_state=HEALTHY) == 1
+
+
+def test_staleness_age_out_with_injected_clock():
+    """Transport keeps succeeding but the snapshot's wall stamp freezes
+    (a wedged replica): the federator must NOT trust transport success —
+    the stale snapshot counts as a failed poll and walks the replica to
+    UNREACHABLE."""
+    f = FakeFleet({"a": None}, staleness_s=10.0, unreachable_failures=2,
+                  backoff_max_s=0.5)
+    f.snapshots["a"] = f.stamped("a", 1000.0)
+    assert f.monitor.poll() == {"a": HEALTHY}
+
+    f.now = 1005.0  # still fresh
+    assert f.monitor.poll() == {"a": HEALTHY}
+
+    f.now = 1011.0  # 11 s old > staleness_s=10 — transport still "ok"
+    assert f.monitor.poll() == {"a": DEGRADED}
+    f.now = 1020.0
+    assert f.monitor.poll() == {"a": UNREACHABLE}
+    assert f.monitor.polls_total.value(replica="a", outcome="stale") == 2
+    # a fresh stamp recovers it
+    f.now = 1030.0
+    f.snapshots["a"] = f.stamped("a", 1030.0)
+    assert f.monitor.poll() == {"a": HEALTHY}
+
+
+def test_failing_replica_backs_off_exponentially():
+    f = FakeFleet({"a": None}, unreachable_failures=99,
+                  backoff_base_s=1.0, backoff_max_s=8.0)
+    f.snapshots["a"] = ConnectionError("down")
+    calls = []
+    real_fetch = f.fetch
+
+    def counting_fetch(url, timeout_s):
+        calls.append(f.now)
+        return real_fetch(url, timeout_s)
+
+    f.monitor.fetch = counting_fetch
+    for _ in range(40):
+        f.monitor.poll()
+        f.now += 0.5
+    # fetch times follow the 1, 2, 4, 8, 8... backoff ladder, not every tick
+    gaps = np.diff(calls)
+    assert list(gaps[:4]) == [1.0, 2.0, 4.0, 8.0]
+    assert all(g == 8.0 for g in gaps[4:])  # clamped at backoff_max_s
+
+
+def test_unreachable_replicas_leave_the_aggregates():
+    f = FakeFleet({"a": None, "b": None}, unreachable_failures=1)
+    sa, sb = Telemetry(replica_id="a"), Telemetry(replica_id="b")
+    sa.requests_total.inc(3)
+    sb.requests_total.inc(5)
+    for tel, url in ((sa, "a"), (sb, "b")):
+        tel.wall_clock = lambda: f.now
+        f.snapshots[url] = roundtrip(tel.snapshot())
+    f.monitor.poll()
+    reg, _ = f.monitor.fleet_registry()
+    assert reg.get("nxdi_requests_total").total() == 8.0
+
+    f.snapshots["b"] = ConnectionError("killed")
+    f.now += 100.0
+    f.snapshots["a"] = roundtrip(sa.snapshot())
+    assert f.monitor.poll()["b"] == UNREACHABLE
+    reg, _ = f.monitor.fleet_registry()
+    assert reg.get("nxdi_requests_total").total() == 3.0  # b excluded
+    # the fleet gauges say so too
+    assert f.monitor.replicas_gauge.value(state=UNREACHABLE) == 1
+    assert f.monitor.replica_state.value(replica="b") == 2
+
+
+def test_duplicate_replica_ids_disambiguate():
+    """Two targets self-reporting the same replica_id (copy-pasted config)
+    must keep distinct labels, never silently merge."""
+    f = FakeFleet({"a": None, "b": None})
+    f.snapshots["a"] = f.stamped("same", 1000.0)
+    f.snapshots["b"] = f.stamped("same", 1000.0)
+    states = f.monitor.poll()
+    assert set(states) == {"same", "same#2"}
+
+
+# ---------------------------------------------------------------------------
+# LoadSignal: the pinned formula and deterministic ranking
+# ---------------------------------------------------------------------------
+
+def test_load_signal_formula_bit_exact():
+    s = LoadSignal(replica="r", queue_depth=3.0, slots_busy=2.0,
+                   kv_blocks_free=6.0, kv_blocks_used=18.0,
+                   slo_attainment_pct=87.5)
+    # THE documented formula, term for term (fleet.py module docstring)
+    expected = 3.0 + 2.0 + 4.0 * (18.0 / 24.0) + 2.0 * (1.0 - 87.5 / 100.0)
+    assert s.score == expected  # bit-exact, not approx
+    assert s.kv_used_frac == 18.0 / 24.0
+    # empty pool contributes zero pressure, undeclared SLO counts as 100%
+    idle = LoadSignal("i", 0.0, 0.0, 0.0, 0.0, 100.0)
+    assert idle.score == 0.0
+
+
+def test_load_signal_from_snapshot_reads_existing_gauges():
+    tel = Telemetry(replica_id="x")
+    tel.serve_queue_depth.set(4)
+    tel.serve_slots_busy.set(3)
+    tel.kv_blocks_free.set(10)
+    tel.kv_blocks_used.set(30)
+    sig = load_signal_from_snapshot("x", roundtrip(tel.snapshot()))
+    assert (sig.queue_depth, sig.slots_busy) == (4.0, 3.0)
+    assert sig.slo_attainment_pct == 100.0  # no SLO declared -> vacuous
+    assert sig.score == 4.0 + 3.0 + 4.0 * 0.75 + 0.0
+
+
+def test_ranking_is_deterministic_with_ties():
+    a = LoadSignal("b-replica", 1.0, 0.0, 0.0, 0.0, 100.0)
+    b = LoadSignal("a-replica", 1.0, 0.0, 0.0, 0.0, 100.0)  # same score
+    c = LoadSignal("z-light", 0.0, 0.0, 0.0, 0.0, 100.0)
+    ranked = rank_load_signals([a, b, c])
+    assert [s.replica for s in ranked] == ["z-light", "a-replica", "b-replica"]
+    # permutation-invariant
+    assert rank_load_signals([c, a, b]) == ranked
+
+
+# ---------------------------------------------------------------------------
+# merged Perfetto
+# ---------------------------------------------------------------------------
+
+def test_merge_perfetto_traces_one_process_group_per_replica():
+    def trace(tag):
+        return {"traceEvents": [
+            {"name": "process_name", "ph": "M", "pid": 1,
+             "args": {"name": "nxdi_tpu requests"}},
+            {"name": "process_name", "ph": "M", "pid": 2,
+             "args": {"name": "engine steps (per slot)"}},
+            {"name": "decode", "cat": "engine", "ph": "X", "pid": 2,
+             "tid": 0, "ts": 1.0, "dur": 2.0, "args": {"tag": tag}},
+        ]}
+
+    merged = merge_perfetto_traces({"r1": trace("r1"), "r2": trace("r2")})
+    ev = merged["traceEvents"]
+    pids = {e["pid"] for e in ev}
+    assert pids == {1, 2, 101, 102}  # stride-offset process groups
+    names = {e["args"]["name"] for e in ev
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert names == {
+        "r1 · nxdi_tpu requests", "r1 · engine steps (per slot)",
+        "r2 · nxdi_tpu requests", "r2 · engine steps (per slot)",
+    }
+    # slices kept their slot tids inside each replica's group
+    decodes = [e for e in ev if e["name"] == "decode"]
+    assert {e["pid"] for e in decodes} == {2, 102}
+    assert all(e["tid"] == 0 for e in decodes)
+
+
+# ---------------------------------------------------------------------------
+# MetricsServer ephemeral port + graceful shutdown (satellite)
+# ---------------------------------------------------------------------------
+
+def test_metrics_server_ephemeral_port_and_idempotent_shutdown():
+    import urllib.request
+
+    tel = Telemetry(replica_id="srv")
+    tel.requests_total.inc(2)
+    with tel.serve(port=0) as server:
+        assert server.port != 0  # the ACTUAL bound port surfaced
+        assert server.url.endswith(str(server.port))
+        with urllib.request.urlopen(f"{server.url}/healthz") as resp:
+            health = json.loads(resp.read())
+        assert health["replica_id"] == "srv"
+    # __exit__ shut it down; a second shutdown is a no-op, and the port is
+    # free for the next ephemeral bind
+    server.shutdown()
+    second = tel.serve(port=0)
+    try:
+        assert second.port != 0
+    finally:
+        second.shutdown()
+        second.shutdown()
