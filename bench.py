@@ -854,7 +854,7 @@ def _flag_value(name, default):
 
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
-    replica_id=None, rng=None,
+    replica_id=None, rng=None, sentinel=None,
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -889,6 +889,7 @@ def _build_serving_stack(
         skip_warmup=False,
         slo={"ttft_s": slo_ttft_ms / 1e3, "tpot_s": slo_tpot_ms / 1e3},
         telemetry={"detail": "basic", "replica_id": replica_id},
+        sentinel=sentinel,
     )
     cfg = ml.LlamaInferenceConfig(
         tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
@@ -915,6 +916,63 @@ def _build_serving_stack(
     return app, InferenceEngine(app, SchedulerConfig(num_slots=slots))
 
 
+def _mean_engine_step_s(engine) -> tuple:
+    """(sum, count) of the engine's step-wall histogram — exact, the same
+    series the flight recorder feeds."""
+    series = engine.flight.step_seconds.series()
+    s = series.get(())
+    return (s.sum, s.count) if s is not None else (0.0, 0)
+
+
+def _sentinel_overhead_smoke(
+    slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+    requests=8, max_new=32,
+):
+    """``sentinel_overhead_pct``: mean engine-step wall with the numerics
+    sentinel compiled in + enabled vs the plain stack, on the SAME geometry
+    and an identical drain workload, ABBA-interleaved (off, on, on, off) so
+    host warmup/jitter spreads across both sides. The sentinel side pays
+    the in-graph logit-stat reduction AND the host fetch/record — the full
+    cost a production operator would turn on (shadow replay stays off: it
+    is sampling-gated and runs the probe, not the step hot path). Gated
+    one-sided (< 3% absolute) by scripts/bench_gate.py."""
+    from nxdi_tpu.serving import SamplingParams
+
+    stacks = {}
+    # replay + preemption check stay off: they are sampling/event-gated
+    # probe dispatches, not step-hot-path cost — and preemption_check=True
+    # would pre-build the all-logits probe at load (a full CTE compile the
+    # smoke never uses)
+    on_cfg = {"replay_rate": 0.0, "preemption_check": False}
+    for name, sentinel in (("off", None), ("on", on_cfg)):
+        rng = np.random.default_rng(7)
+        stacks[name] = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            rng=rng, sentinel=sentinel,
+        )
+    wrng = np.random.default_rng(7)
+    prompts = [
+        wrng.integers(0, 32000, size=prompt_len - int(wrng.integers(0, 16)))
+        .astype(np.int32).tolist()
+        for _ in range(requests)
+    ]
+    walls = {"off": [0.0, 0], "on": [0.0, 0]}
+    for name in ("off", "on", "on", "off"):
+        app, engine = stacks[name]
+        s0, c0 = _mean_engine_step_s(engine)
+        for p in prompts:
+            engine.add_request(p, SamplingParams(max_new_tokens=max_new))
+        engine.run()
+        s1, c1 = _mean_engine_step_s(engine)
+        walls[name][0] += s1 - s0
+        walls[name][1] += c1 - c0
+    mean_off = walls["off"][0] / max(walls["off"][1], 1)
+    mean_on = walls["on"][0] / max(walls["on"][1], 1)
+    if mean_off <= 0:
+        return None
+    return round(100.0 * (mean_on - mean_off) / mean_off, 3)
+
+
 def main_serving(
     requests=32,
     rate=16.0,
@@ -925,6 +983,7 @@ def main_serving(
     n_layers=N_LAYERS,
     slo_ttft_ms=4000.0,
     slo_tpot_ms=25.0,
+    sentinel_smoke=True,
 ):
     """``bench.py --serving``: continuous-batching goodput under a Poisson
     arrival workload (nxdi_tpu/serving InferenceEngine over the paged
@@ -992,6 +1051,14 @@ def main_serving(
         ),
         "mode": "continuous_batching_engine",
     }
+    if sentinel_smoke:
+        # numerics-sentinel overhead smoke (telemetry/sentinel.py): the
+        # correctness observatory must cost < 3% of the engine step —
+        # measured on two fresh same-geometry stacks so the main goodput
+        # numbers above stay comparable with the pre-sentinel trajectory
+        rec["sentinel_overhead_pct"] = _sentinel_overhead_smoke(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+        )
     print(json.dumps(rec))
     write_metrics_snapshots(
         {"serving": app.telemetry.snapshot()}, metrics_out_path()
@@ -1146,6 +1213,9 @@ if __name__ == "__main__":
         if _replicas > 1:
             main_fleet_serving(replicas=_replicas, **_serving_kwargs)
         else:
-            main_serving(**_serving_kwargs)
+            main_serving(
+                sentinel_smoke="--skip-sentinel-smoke" not in sys.argv,
+                **_serving_kwargs,
+            )
     else:
         main()

@@ -38,6 +38,9 @@ DTYPE_DRIFT_ALLOWLIST = (
     "top_k",
     "logit",       # logits processors / penalties
     "moe_router",  # router softmax precision
+    "quantized_linear",  # activation-quantize scale math is fp32 by design;
+                         # the actual contraction dtype is policed by the
+                         # quantized_dtype checker instead
 )
 
 
@@ -543,7 +546,173 @@ def check_lora_sharding(art: ProgramArtifacts) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
-# 8. HBM fit
+# 8. quantized-path dtype rules
+# ---------------------------------------------------------------------------
+
+#: elementwise-ish primitives a dequant/quantize chain may pass through
+#: between a convert and the dot it feeds
+_QDQ_CHAIN_PRIMS = (
+    "convert_element_type", "mul", "div", "add", "sub", "max", "min",
+    "round", "nearbyint", "clamp", "broadcast_in_dim", "reshape",
+    "transpose", "squeeze", "expand_dims", "select_n", "abs", "neg",
+    "stop_gradient",
+    # jnp.round / jnp.clip lower as small pjit/custom_jvp wrapper eqns —
+    # flow through them (their invars) or every quantize chain dead-ends
+    # one hop from the dot
+    "pjit", "custom_jvp_call", "custom_vjp_call", "closed_call",
+)
+
+_INT8_DTYPES = ("int8", "uint8", "float8_e4m3fn", "float8_e5m2")
+
+
+def _scan_quantized_dots(jaxpr, on_dot) -> None:
+    """Depth-first over every (sub)jaxpr; calls ``on_dot(eqn, defs)`` for
+    each ``dot_general`` with that jaxpr level's ``{var: producing eqn}``
+    map — quantize/dequant chains never cross a scan boundary, so per-level
+    dataflow is exact for this audit."""
+    defs = {}
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            defs[ov] = eqn
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "dot_general":
+            on_dot(eqn, defs)
+        stack = list(eqn.params.values())
+        while stack:
+            v = stack.pop()
+            if hasattr(v, "jaxpr") and hasattr(getattr(v, "jaxpr"), "eqns"):
+                _scan_quantized_dots(v.jaxpr, on_dot)
+            elif hasattr(v, "eqns"):
+                _scan_quantized_dots(v, on_dot)
+            elif isinstance(v, (list, tuple)):
+                stack.extend(v)
+
+
+def _chain_reaches(var, defs, match, max_depth: int = 16):
+    """The first eqn satisfying ``match(eqn)`` reachable BACKWARD from
+    ``var`` through elementwise/layout ops (None if the chain dead-ends
+    into a real compute op, an argument, or the depth bound). Non-matching
+    chain ops — including intermediate converts — are walked THROUGH, so a
+    layered ``int8 -> f32 -> bf16`` dequant still attributes to its int8
+    origin."""
+    seen = set()
+    frontier = [(var, 0)]
+    while frontier:
+        v, depth = frontier.pop()
+        if depth > max_depth or id(v) in seen:
+            continue
+        seen.add(id(v))
+        eqn = defs.get(v)
+        if eqn is None:
+            continue
+        name = eqn.primitive.name
+        if match(eqn):
+            return eqn
+        if name in _QDQ_CHAIN_PRIMS or name.startswith("reduce_"):
+            for iv in eqn.invars:
+                if hasattr(iv, "aval"):
+                    frontier.append((iv, depth + 1))
+    return None
+
+
+def check_quantized_dtype(art: ProgramArtifacts) -> List[Finding]:
+    """Quantized-path dtype rules for the w8a8 MXU path
+    (``quantized=True`` + ``activation_quantization_type``):
+
+    - **un-upcast reach**: at least one ``dot_general`` must contract
+      int8 x int8 operands — a program that declared the int8 MXU path but
+      upcasts/dequantizes before every dot (an fp32 detour between the
+      dequant scale and the dot) silently pays full-precision matmul
+      bandwidth while reporting int8 throughput;
+    - **static scales are constants**: under
+      ``activation_quantization_type="static"`` the calibrated
+      ``input_scale`` is a checkpoint constant — an int8 dot whose quantize
+      chain contains a per-token ``reduce_max`` means the hot path is
+      recomputing the scale the calibration was supposed to eliminate.
+
+    Weight-only quantization (no activation quant) upcasts INTO the matmul
+    by design (dequantize-on-read) and is out of scope here.
+    """
+    tc = art.tc
+    aq = getattr(tc, "activation_quantization_type", None)
+    if not getattr(tc, "quantized", False) or aq not in ("dynamic", "static"):
+        return []
+    if art.jaxpr is None:
+        return [art.finding("quantized_dtype", "no jaxpr available to audit",
+                            severity="warning")]
+
+    int8_dots: List[Tuple[Any, dict]] = []
+    detours: List[str] = []
+
+    def on_dot(eqn, defs):
+        dts = [str(iv.aval.dtype) for iv in eqn.invars[:2]]
+        if all(d in _INT8_DTYPES for d in dts):
+            int8_dots.append((eqn, defs))
+            return
+        # a float dot whose operand chain passes through an int8 upcast is
+        # the dequant-before-dot detour (record one attribution per shape)
+        def from_int8(e):
+            return (
+                e.primitive.name == "convert_element_type"
+                and str(e.invars[0].aval.dtype) in _INT8_DTYPES
+            )
+
+        for iv in eqn.invars[:2]:
+            if not str(iv.aval.dtype).startswith("float"):
+                continue
+            cvt = _chain_reaches(iv, defs, from_int8)
+            if cvt is not None:
+                frames = _nxdi_frames(cvt)
+                where = " <- ".join(f"{fn} ({f})" for f, fn in frames[:3])
+                detours.append(
+                    f"dot of shape {tuple(eqn.outvars[0].aval.shape)} consumes "
+                    f"an int8 weight upcast to {iv.aval.dtype} before the "
+                    f"contraction ({where or 'no traceback'})"
+                )
+
+    _scan_quantized_dots(art.jaxpr.jaxpr, on_dot)
+
+    findings: List[Finding] = []
+    if not int8_dots:
+        hint = ("; ".join(detours[:2])) or "no int8 contraction found at all"
+        findings.append(art.finding(
+            "quantized_dtype",
+            f"activation_quantization_type={aq!r} declares the int8 MXU "
+            "path, but NO dot_general contracts int8 x int8 operands — the "
+            f"dequant happens before the dot (fp32 detour: {hint}); the "
+            "program pays full-precision matmul bandwidth while the config "
+            "promises w8a8",
+        ))
+    if aq == "static":
+        # the per-token amax reduction lives inside quantized_linear
+        # (ops/quantization.py) — attribute by traceback like dtype_drift,
+        # which survives the pjit/scan jaxpr nesting the dataflow walk
+        # cannot cross. The KV-quant amax (kvcache/) never matches.
+        recomputes = []
+
+        def visit(eqn):
+            if not eqn.primitive.name.startswith("reduce_max"):
+                return
+            for fname, fn in _nxdi_frames(eqn):
+                if fname == "quantization.py" and "quantized_linear" in fn:
+                    recomputes.append(eqn)
+                    return
+
+        _walk_jaxprs(art.jaxpr.jaxpr, visit)
+        if recomputes:
+            findings.append(art.finding(
+                "quantized_dtype",
+                "static activation quantization declared, but the program "
+                f"contains {len(recomputes)} per-token reduce_max amax "
+                "reduction(s) inside quantized_linear — the input scale is "
+                "being RECOMPUTED on the hot path instead of consumed as "
+                "the calibrated input_scale constant",
+            ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# 9. HBM fit
 # ---------------------------------------------------------------------------
 
 def check_hbm_fit(art: ProgramArtifacts) -> List[Finding]:
@@ -590,5 +759,6 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "required_strategies": check_required_strategies,
     "kv_layout": check_kv_layout,
     "lora_sharding": check_lora_sharding,
+    "quantized_dtype": check_quantized_dtype,
     "hbm_fit": check_hbm_fit,
 }

@@ -79,7 +79,33 @@ def inspect_bundle(path: str) -> int:
     span = bundle.get("request_span")
     sched = bundle.get("scheduler") or {}
     print(f"bundle: {path}")
-    print(f"  trigger:   {bundle.get('trigger')}  detail={bundle.get('detail')}")
+    detail = bundle.get("detail") or {}
+    if bundle.get("trigger") == "numerics":
+        # numerics-sentinel bundles (telemetry/sentinel.py): lead with WHAT
+        # diverged — the nonfinite program or the replay divergence index —
+        # before the generic dump
+        kind = detail.get("kind", "?")
+        print(f"  trigger:   numerics ({kind})")
+        if kind == "logit_nonfinite":
+            print(
+                f"  program:   {detail.get('submodel')}[{detail.get('bucket')}]"
+                f"  rows={detail.get('rows')}  nan={detail.get('nan_count')}"
+                f"  inf={detail.get('inf_count')}"
+                f"  max|logit|={detail.get('max_abs_logit')}"
+            )
+        else:
+            print(
+                f"  request:   id={detail.get('request_id')} diverged at "
+                f"generated index {detail.get('divergence_index')} "
+                f"(replay argmax {detail.get('expected')} vs streamed "
+                f"{detail.get('got')}; preemptions="
+                f"{detail.get('preemptions')})"
+            )
+            summ = detail.get("summary") or {}
+            if summ.get("suggested_tol_map"):
+                print(f"  tol-map:   suggested {summ['suggested_tol_map']}")
+    else:
+        print(f"  trigger:   {bundle.get('trigger')}  detail={detail}")
     print(f"  at step:   {bundle.get('step')}")
     if span is not None:
         print(

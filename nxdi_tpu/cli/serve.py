@@ -63,6 +63,14 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--postmortem-dir", default=None, metavar="DIR",
                    help="where trigger-fired flight-recorder bundles land "
                         "(default: in-memory only)")
+    p.add_argument("--sentinel-replay-rate", type=float, default=None,
+                   metavar="RATE",
+                   help="enable the numerics sentinel "
+                        "(TpuConfig(sentinel=...)): in-graph logit-health "
+                        "stats + teacher-forced shadow replay of this "
+                        "fraction of retired requests + the "
+                        "preemption-replay invariant; divergences fire "
+                        "'numerics' postmortem bundles")
     p.add_argument("--replica-id", default=None, metavar="ID",
                    help="stable replica identity for the fleet observatory "
                         "(TelemetryConfig(replica_id=...); the 'replica' "
@@ -197,6 +205,8 @@ def main(argv: Optional[List[str]] = None) -> int:
             "chunk_size": args.chunked_prefill,
             "kernel_q_tile_size": args.chunked_prefill,
         }
+    if args.sentinel_replay_rate is not None:
+        tpu_kwargs["sentinel"] = {"replay_rate": args.sentinel_replay_rate}
     t0 = time.time()
     _note(args.quiet, "[serve] building + loading the reference app ...")
     app = build_loaded_reference_app(tpu_kwargs)

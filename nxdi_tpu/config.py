@@ -256,6 +256,59 @@ class SloConfig:
         return dict(self.__dict__)
 
 
+class SentinelConfig:
+    """Numerics sentinel (nxdi_tpu/telemetry/sentinel.py): online correctness
+    observability for the serving path — in-graph logit-health stats,
+    sampled shadow-replay verification, and the preemption-replay invariant.
+
+    ``logit_health`` — compile a small in-graph reduction over each
+    dispatch's sampled-position logit row block (NaN/Inf counts, max|logit|,
+    mean entropy, top1-top2 margin) exported as ``nxdi_numerics_*`` series
+    per (submodel, bucket); a nonzero NaN/Inf count fires the ``numerics``
+    postmortem trigger through the flight recorder.
+    ``replay_rate`` — fraction of RETIRED greedy requests teacher-force
+    replayed through the static all-position logit probe
+    (utils/accuracy.py) and token-matched against what the engine actually
+    streamed (0.0 = off, 1.0 = every request; deterministic credit
+    accumulator, not a random draw, so tests and fleets are reproducible).
+    ``preemption_check`` — on every recompute-resume, verify the replayed
+    ``prompt + generated`` prefix reproduces the pre-preemption tokens
+    exactly (greedy rows) — a mismatch counts
+    ``nxdi_sentinel_replay_mismatch_total{kind="preemption"}`` and fires a
+    ``numerics`` bundle instead of silently serving a forked continuation.
+    ``divergence_tol`` / ``tol_map`` — tolerance (and per-index overrides,
+    accuracy.py tol-map convention) on the replay's logit-margin report;
+    token equality is always strict.
+    ``bundle_cooldown`` — minimum dispatches between two ``numerics``
+    bundles of the same kind (a persistent NaN must not write a bundle per
+    step).
+    """
+
+    def __init__(self, **kwargs):
+        self.logit_health = bool(kwargs.pop("logit_health", True))
+        self.replay_rate = float(kwargs.pop("replay_rate", 0.0))
+        self.preemption_check = bool(kwargs.pop("preemption_check", True))
+        self.divergence_tol = float(kwargs.pop("divergence_tol", 0.001))
+        tol_map = kwargs.pop("tol_map", None)
+        # JSON round trips stringify int keys; accept both spellings
+        self.tol_map = (
+            None if tol_map is None
+            else {int(k): float(v) for k, v in dict(tol_map).items()}
+        )
+        self.bundle_cooldown = int(kwargs.pop("bundle_cooldown", 64))
+        if kwargs:
+            raise ValueError(f"Unknown SentinelConfig args: {sorted(kwargs)}")
+        if not 0.0 <= self.replay_rate <= 1.0:
+            raise ValueError("sentinel replay_rate must be in [0, 1]")
+        if self.divergence_tol < 0:
+            raise ValueError("sentinel divergence_tol must be >= 0")
+        if self.bundle_cooldown < 1:
+            raise ValueError("sentinel bundle_cooldown must be >= 1")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
 class FleetConfig:
     """Fleet observatory (nxdi_tpu/telemetry/fleet.py): how a
     :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor` polls N replica
@@ -685,6 +738,17 @@ class TpuConfig:
         if isinstance(slo, dict):
             slo = SloConfig(**slo)
         self.slo = slo
+        # numerics sentinel (nxdi_tpu/telemetry/sentinel.py): in-graph
+        # logit-health stats + sampled shadow-replay verification + the
+        # preemption-replay invariant. A SentinelConfig, a dict of its
+        # kwargs, True (defaults), or None (off — no stats compiled in,
+        # serving output byte-identical to previous rounds).
+        sentinel = kwargs.pop("sentinel", None)
+        if sentinel is True:
+            sentinel = SentinelConfig()
+        elif isinstance(sentinel, dict):
+            sentinel = SentinelConfig(**sentinel)
+        self.sentinel = sentinel
         # declared chip generation for the cost observatory's roofline math
         # and the hbm_fit auditor checker (analysis/costs.py): a name from
         # CHIP_SPECS ("v4"|"v5e"|"v5p"|"v6e"), or a dict of ChipSpec field
@@ -971,6 +1035,7 @@ class TpuConfig:
         "hybrid_sharding_config": HybridShardingConfig,
         "telemetry": TelemetryConfig,
         "slo": SloConfig,
+        "sentinel": SentinelConfig,
     }
 
     @property

@@ -1807,6 +1807,7 @@ def causal_lm_forward(
     output_logits: bool = False,
     output_all_logits: bool = False,
     output_argmax_all: bool = False,
+    output_logit_stats: bool = False,
     on_device_sampling: bool = True,
     do_sample: bool = False,
     global_topk: int = 256,
@@ -2069,6 +2070,11 @@ def causal_lm_forward(
     else:
         last_logits = logits
 
+    if output_logit_stats:
+        # numerics sentinel (TpuConfig(sentinel=...)): a (B, 5) health
+        # readout over the sampled position's logit row block, computed
+        # in-graph so only five floats per row cross the program boundary
+        outputs["logit_stats"] = sampling_ops.logit_health_stats(last_logits)
     if output_argmax_all:
         # speculation verify: the greedy token at EVERY position, selected
         # in-graph — the full-vocab fp32 logits never cross the program

@@ -136,6 +136,41 @@ def extract_next_tokens(outputs) -> np.ndarray:
     return logits[:, -1, :].argmax(axis=-1).astype(np.int64)
 
 
+#: column order of the (B, 5) array :func:`logit_health_stats` emits — the
+#: numerics sentinel (telemetry/sentinel.py) indexes by this tuple, never by
+#: magic numbers
+LOGIT_STAT_FIELDS = ("nan", "inf", "max_abs", "entropy", "margin")
+
+
+def logit_health_stats(logits) -> jax.Array:
+    """(B, 5) per-row health stats over the sampled-position logit row block:
+    ``[nan_count, inf_count, max|logit|, entropy_nats, top1-top2 margin]``
+    (column order :data:`LOGIT_STAT_FIELDS`).
+
+    One small in-graph reduction over logits the program already
+    materialized — compiled into the forward when
+    ``TpuConfig(sentinel=...)`` asks for logit health, so the stats ride
+    the dispatch as a tiny extra output instead of shipping the full-vocab
+    fp32 row across the program boundary. max|logit|, entropy, and margin
+    are computed over the FINITE entries (a NaN burst must not turn every
+    other column into NaN too — the counts carry the alarm)."""
+    x = logits.astype(jnp.float32)
+    if x.ndim == 3:
+        x = x[:, -1, :]  # the sampled position's row block
+    nan = jnp.sum(jnp.isnan(x), axis=-1).astype(jnp.float32)
+    inf = jnp.sum(jnp.isinf(x), axis=-1).astype(jnp.float32)
+    finite = jnp.where(jnp.isfinite(x), x, NEG_INF)
+    # vocab-padding entries arrive as mask_padded_logits' NEG_INF (finite!)
+    # — they are not model output and must not peg max|logit| at 30000
+    valid = jnp.isfinite(x) & (x > NEG_INF)
+    max_abs = jnp.max(jnp.where(valid, jnp.abs(x), 0.0), axis=-1)
+    logp = jax.nn.log_softmax(finite, axis=-1)
+    entropy = -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+    top2 = jax.lax.top_k(finite, 2)[0]
+    margin = top2[:, 0] - top2[:, 1]
+    return jnp.stack([nan, inf, max_abs, entropy, margin], axis=-1)
+
+
 def next_step_rng(rng: jax.Array) -> jax.Array:
     """The per-step PRNG key schedule for device-resident decode chains: each
     step's key is split off the previous step's. SINGLE source of truth —

@@ -428,6 +428,26 @@ class ApplicationBase:
         from nxdi_tpu.analysis.costs import attach_cost_gauges
 
         attach_cost_gauges(self)
+        # numerics sentinel (telemetry/sentinel.py): adopt the app so the
+        # compiled-in logit-health stats record on EVERY host path (static
+        # generate and serving alike); the serving engine later binds its
+        # flight recorder for postmortem capture and replay verification
+        if self.tpu_config.sentinel is not None and self.telemetry.enabled:
+            from nxdi_tpu.telemetry.sentinel import NumericsSentinel
+
+            sentinel = NumericsSentinel(
+                self.telemetry, self.tpu_config.sentinel, app=self
+            )
+            self.telemetry.attach_sentinel(sentinel)
+            # warm the replay probe NOW (params are resident): the first
+            # replay must never stall a serving step on a probe compile
+            sentinel.prepare()
+        elif self.tpu_config.sentinel is not None:
+            logger.warning(
+                "TpuConfig(sentinel=...) declared but telemetry is off — "
+                "the numerics sentinel records through the metrics "
+                "registry; nothing will be observed"
+            )
         self.is_loaded = True
 
     def _build_wrappers(self) -> None:
@@ -525,6 +545,19 @@ class TpuModelForCausalLM(ApplicationBase):
         # split-chained rng schedule as the 1-step async loop).
         if (tc.async_mode or tc.decode_steps_per_dispatch > 1) and on_device_sampling:
             sampling_kwargs["return_next_inputs"] = True
+        if (
+            tc.sentinel is not None
+            and tc.sentinel.logit_health
+            and self.telemetry.enabled
+        ):
+            # numerics sentinel (telemetry/sentinel.py): compile the (B, 5)
+            # logit-health reduction into every host-path dispatch (CTE,
+            # TKG, prefix-prefill) — the sentinel reads it as the
+            # nxdi_numerics_* series and the NaN/Inf postmortem trigger.
+            # Gated on telemetry like the attach in load(): with telemetry
+            # off nothing could observe the stats, so the graph must not
+            # pay for them either (load() warns about the combination).
+            sampling_kwargs["output_logit_stats"] = True
         if tc.tensor_capture_config is not None:
             # debug intermediates compiled into extra outputs (reference:
             # TensorCaptureConfig, model_base.py:1091-1198)

@@ -642,6 +642,11 @@ class ModelWrapper:
             k: (v if k in ("next_inputs", "captured") else v[:orig_b])
             for k, v in outputs.items()
         }
+        if tel is not None and tel.sentinel is not None and "logit_stats" in outputs:
+            # numerics sentinel: the compiled-in (B, 5) health readout is
+            # recorded AFTER batch-padding rows are sliced away (padding
+            # repeats row 0 — double-counting it would skew the series)
+            tel.sentinel.observe(self.tag, bucket, outputs["logit_stats"])
         return outputs, new_cache
 
     def _layout_inputs(

@@ -167,6 +167,13 @@ class InferenceEngine:
         # TpuConfig(slo=...) targets into rolling attainment gauges
         self.flight = None
         self.slo = None
+        # numerics sentinel (telemetry/sentinel.py), attached at app.load()
+        # when TpuConfig(sentinel=...) is declared: the engine adds the two
+        # serving-only checks — the preemption-replay invariant on every
+        # recompute-resume and the sampled shadow replay on retirement —
+        # and (below, via attach_flight) binds its flight recorder so
+        # numerics events capture postmortem bundles
+        self.sentinel = tel.sentinel if tel is not None else None
         self._pending_breaches: List[Tuple[Request, List[str]]] = []
         if tel is not None:
             tc_tel = tc.telemetry
@@ -393,6 +400,19 @@ class InferenceEngine:
         req.num_prefilled += n
         if not req.prefill_done:
             return  # more chunks next step; decodes interleave meanwhile
+        if (
+            self.sentinel is not None
+            and self.sentinel.config.preemption_check
+            and req.preemptions > 0
+            and req.generated
+        ):
+            # preemption-replay invariant: the prompt+generated replay this
+            # (re)prefill just committed must reproduce the pre-preemption
+            # tokens exactly — verified through the independent logit probe;
+            # a mismatch counts nxdi_sentinel_replay_mismatch_total
+            # {kind="preemption"} and bundles instead of silently serving a
+            # forked continuation
+            self.sentinel.verify_replay(req, "preemption")
         tok = int(self._tokens_of(out)[0])
         if req.span is not None:
             req.span.first_token()  # idempotent: a resume keeps the original
@@ -559,6 +579,16 @@ class InferenceEngine:
                 # deferred to step()'s end: the bundle must include the
                 # StepRecord of the very step this finish happened in
                 self._pending_breaches.append((req, kinds))
+        if (
+            self.sentinel is not None
+            and reason != "error"
+            and self.sentinel.should_replay(req)
+        ):
+            # shadow replay: teacher-force the retired request through the
+            # offline toolkit's logit probe and token-match what was
+            # actually streamed; divergence -> mismatch counter + numerics
+            # bundle with the index and tol-map summary
+            self.sentinel.verify_replay(req, "shadow")
         finished.append(
             RequestOutput(
                 request_id=req.request_id,

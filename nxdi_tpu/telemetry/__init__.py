@@ -56,7 +56,19 @@ Metric catalog (labels in parens):
 ``nxdi_slo_breaches_total``           counter    (kind)
 ``nxdi_slo_attainment_pct``           gauge
 ``nxdi_slo_goodput_tok_s``            gauge
+``nxdi_numerics_nonfinite_total``     counter    (submodel, bucket, kind: nan|inf)
+``nxdi_numerics_max_abs_logit``       gauge      (submodel, bucket)
+``nxdi_numerics_entropy``             histogram  (submodel, bucket)
+``nxdi_numerics_margin``              histogram  (submodel, bucket)
+``nxdi_sentinel_replays_total``       counter    (kind, outcome)
+``nxdi_sentinel_replay_mismatch_total``  counter  (kind: shadow|preemption)
 ====================================  =========  ==================================
+
+The ``nxdi_numerics_*`` / ``nxdi_sentinel_*`` series belong to the numerics
+sentinel (:mod:`~nxdi_tpu.telemetry.sentinel`, ``TpuConfig(sentinel=...)``)
+and are pre-seeded at attach time so absence-of-errors is observable from
+the first scrape; a nonzero NaN/Inf count or replay mismatch fires the
+``numerics`` postmortem trigger through the flight recorder.
 
 Fleet observatory series (telemetry/fleet.py — emitted by a
 :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor`'s merged view, NOT by
@@ -113,6 +125,7 @@ from nxdi_tpu.telemetry.fleet import (
     rank_load_signals,
 )
 from nxdi_tpu.telemetry.flight import FlightRecorder, StepRecord
+from nxdi_tpu.telemetry.sentinel import NumericsSentinel
 from nxdi_tpu.telemetry.slo import SloTracker, breach_kinds
 from nxdi_tpu.telemetry.spans import NULL_SPAN, RequestSpan, SpanTracker
 
@@ -127,6 +140,7 @@ __all__ = [
     "NULL_SPAN",
     "FlightRecorder",
     "StepRecord",
+    "NumericsSentinel",
     "SloTracker",
     "breach_kinds",
     "FleetMonitor",
@@ -198,6 +212,11 @@ class Telemetry:
         # serving engine via attach_flight(); rides record_dispatch, the
         # Perfetto export, and the JSON snapshot once attached
         self.flight = None
+        # numerics sentinel (telemetry/sentinel.py), attached at app.load()
+        # when TpuConfig(sentinel=...) is declared; the dispatch spine
+        # (ModelWrapper.forward) feeds it each program's compiled-in
+        # logit-health readout
+        self.sentinel = None
 
         r = self.registry
         self.spans_dropped_total = r.counter(
@@ -394,6 +413,19 @@ class Telemetry:
         engine per app is the supported shape)."""
         self.flight = recorder
         self.add_snapshot_extra("_flight", recorder.summary)
+        if self.sentinel is not None:
+            # an app-attached sentinel gains the engine's postmortem path
+            self.sentinel.flight = recorder
+
+    def attach_sentinel(self, sentinel) -> None:
+        """Adopt a :class:`~nxdi_tpu.telemetry.sentinel.NumericsSentinel`:
+        every host-path dispatch with compiled-in logit stats records
+        through it, and its summary rides the JSON snapshot as
+        ``_sentinel``. The LAST attached sentinel wins (one live app)."""
+        self.sentinel = sentinel
+        if self.flight is not None and sentinel.flight is None:
+            sentinel.flight = self.flight
+        self.add_snapshot_extra("_sentinel", sentinel.summary)
 
     # -- export-time hooks --------------------------------------------------
     def attach(self, fn: Callable[[], None]) -> None:

@@ -47,8 +47,13 @@ from typing import Callable, Deque, Dict, List, Optional
 
 logger = logging.getLogger("nxdi_tpu")
 
-#: postmortem trigger names (the ``trigger`` field of every bundle)
-TRIGGERS = ("slo_breach", "preemption_storm", "retrace_guard", "manual")
+#: postmortem trigger names (the ``trigger`` field of every bundle).
+#: ``numerics`` is fired by the sentinel (telemetry/sentinel.py): a NaN/Inf
+#: logit burst, a shadow-replay divergence, or a preemption-replay mismatch
+#: (``detail["kind"]`` names which).
+TRIGGERS = (
+    "slo_breach", "preemption_storm", "retrace_guard", "numerics", "manual",
+)
 
 
 class StepRecord:

@@ -1,0 +1,350 @@
+"""Numerics sentinel: online correctness observability for the serving path.
+
+The offline accuracy toolkit (utils/accuracy.py) can prove a build correct
+before it ships; this module keeps watching AFTER it ships, joining three
+previously-disconnected subsystems into one always-on correctness
+observatory:
+
+1. **In-graph logit health** — when ``TpuConfig(sentinel=...)`` is
+   declared, every host-path program (CTE, TKG, prefix-prefill) compiles a
+   five-float-per-row reduction over the sampled position's logit block
+   (``ops.sampling.logit_health_stats``: NaN/Inf counts, max|logit|, mean
+   entropy, top1-top2 margin). The dispatch spine feeds it here per
+   (submodel, bucket) as the ``nxdi_numerics_*`` series, and a nonzero
+   NaN/Inf count fires the ``numerics`` postmortem trigger through the
+   flight recorder — a numerics burst becomes a bundled, alertable event
+   instead of garbled user output.
+2. **Shadow-replay verification** — a deterministic sampling policy
+   (``SentinelConfig(replay_rate=...)``) teacher-force-replays retired
+   greedy requests through the SAME all-position logit probe the offline
+   toolkit uses (``utils.accuracy.probe_all_logits``) and token-matches
+   the replay against what the engine actually streamed
+   (``check_replay_consistency``). A divergence names the index, the
+   expected/streamed tokens, and the tol-map summary, counts
+   ``nxdi_sentinel_replay_mismatch_total{kind="shadow"}``, and dumps a
+   ``numerics`` bundle.
+3. **Preemption-replay invariant** — on every recompute-resume the engine
+   re-prefills ``prompt + generated``; the sentinel independently verifies
+   that replayed prefix reproduces the pre-preemption tokens exactly
+   (the engine holds both sides). A mismatch is a forked continuation —
+   counted as ``kind="preemption"`` and bundled, never silently served.
+
+The sentinel NEVER changes what the engine serves: stats are a pure extra
+program output, replays run on the probe's own cache, and a mismatch
+counts + bundles but does not abort the request (greedy engine output is
+bit-identical with the sentinel on or off — pinned by the parity test).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+import numpy as np
+
+from nxdi_tpu.telemetry.registry import log_spaced_bounds
+
+logger = logging.getLogger("nxdi_tpu")
+
+#: replay kinds (the ``kind`` label of the nxdi_sentinel_* series)
+REPLAY_KINDS = ("shadow", "preemption")
+#: replay outcomes (``outcome`` label): ``skip`` = sampled out, sampled
+#: (non-greedy) request, or sequence longer than the probe's largest bucket
+REPLAY_OUTCOMES = ("match", "mismatch", "skip")
+
+#: entropy is bounded by ln(V) (~11 nats at 64k vocab), margins by the
+#: logit scale — one shared small log ladder covers both
+_STAT_BOUNDS = log_spaced_bounds(1e-3, 100.0, per_decade=2)
+
+
+class NumericsSentinel:
+    """Owns the ``nxdi_numerics_*`` / ``nxdi_sentinel_*`` series and the
+    ``numerics`` postmortem trigger for one application.
+
+    Built at ``app.load()`` when ``TpuConfig(sentinel=...)`` is declared and
+    adopted by the telemetry facade (``Telemetry.attach_sentinel``); the
+    serving engine binds its :class:`~nxdi_tpu.telemetry.flight.FlightRecorder`
+    on construction so bundles capture the engine timeline. Without a flight
+    recorder (static generation path) violations still count and log.
+    """
+
+    def __init__(self, telemetry, config, app=None, flight=None):
+        self.telemetry = telemetry
+        self.config = config
+        self.app = app
+        self.flight = flight
+        # deterministic replay sampling: accumulate rate per retirement and
+        # replay when the credit crosses 1 — replay_rate=0.25 replays every
+        # 4th retired request, reproducibly, with no rng to seed
+        self._replay_credit = 0.0
+        # per-kind cooldown for numerics bundles: the clock advances on
+        # every observed dispatch AND every replay verification (so it
+        # cannot freeze when logit_health is off), and a kind's first event
+        # always fires — after that, one bundle per cooldown window even
+        # for a flapping fault (a persistent OR intermittent NaN must not
+        # write a postmortem per step)
+        self._dispatches = 0
+        self._last_bundle_at = {}
+
+        r = telemetry.registry
+        num_labels = ("submodel", "bucket")
+        self.nonfinite_total = r.counter(
+            "nxdi_numerics_nonfinite_total",
+            "NaN/Inf logit entries seen at sampled positions, per program "
+            "(nonzero = the numerics postmortem trigger fired)",
+            num_labels + ("kind",),
+        )
+        self.max_abs_logit = r.gauge(
+            "nxdi_numerics_max_abs_logit",
+            "largest finite |logit| at the sampled position of the latest "
+            "dispatch (a runaway scale precedes most overflow bursts)",
+            num_labels,
+        )
+        self.entropy = r.histogram(
+            "nxdi_numerics_entropy",
+            "per-row sampled-position logit entropy in nats (collapse to ~0 "
+            "= degenerate distribution; drift up = flattening)",
+            num_labels, bounds=_STAT_BOUNDS,
+        )
+        self.margin = r.histogram(
+            "nxdi_numerics_margin",
+            "per-row top1-top2 logit margin (near-zero = argmax decided by "
+            "roundoff; greedy parity is fragile there)",
+            num_labels, bounds=_STAT_BOUNDS,
+        )
+        self.replays_total = r.counter(
+            "nxdi_sentinel_replays_total",
+            "sentinel replay verifications by kind and outcome (skip = "
+            "sampled out / non-greedy / over the probe's context budget)",
+            ("kind", "outcome"),
+        )
+        self.replay_mismatch_total = r.counter(
+            "nxdi_sentinel_replay_mismatch_total",
+            "replay verifications that DIVERGED from the streamed tokens "
+            "(shadow = post-retirement audit, preemption = recompute-resume "
+            "invariant) — any nonzero value is a correctness incident",
+            ("kind",),
+        )
+        # pre-seed the zero series (same convention as
+        # nxdi_spans_dropped_total): a scrape at step 0 must SEE every
+        # absence-of-errors series, so "no mismatches" and "not recording"
+        # read differently in Prometheus
+        for kind in REPLAY_KINDS:
+            self.replay_mismatch_total.inc(0, kind=kind)
+            for outcome in REPLAY_OUTCOMES:
+                self.replays_total.inc(0, kind=kind, outcome=outcome)
+        if app is not None:
+            self._preseed_program_series(app)
+
+    def _preseed_program_series(self, app) -> None:
+        """Zero series per (submodel, bucket) for every program compiled
+        with the in-graph stats — the scrape-from-step-0 convention."""
+        for tag, wrapper in getattr(app, "models", {}).items():
+            if not wrapper.forward_kwargs.get("output_logit_stats"):
+                continue
+            for bucket, _steps, _key, _prog in wrapper.iter_programs():
+                labels = dict(submodel=tag, bucket=str(bucket))
+                for kind in ("nan", "inf"):
+                    self.nonfinite_total.inc(0, kind=kind, **labels)
+                self.max_abs_logit.set(0.0, **labels)
+
+    def prepare(self) -> None:
+        """Pre-build + warm the replay probe (every CTE bucket) at attach
+        time, so the FIRST shadow/preemption replay never stalls a live
+        engine step on a probe compile. The probe wrapper deliberately sits
+        outside the retrace guard (it is diagnostic, not serving), so a
+        lazy mid-serving compile would be both slow AND invisible to the
+        guard — warming at load removes the event entirely. Failure is
+        non-fatal: the first replay then compiles lazily (and logs)."""
+        if self.app is None:
+            return
+        if self.config.replay_rate <= 0 and not self.config.preemption_check:
+            return
+        try:
+            from nxdi_tpu.utils.accuracy import (
+                _get_logit_probe,
+                probe_all_logits,
+            )
+
+            probe, _ = _get_logit_probe(self.app)
+            for bucket in probe.buckets:
+                probe_all_logits(
+                    self.app, np.zeros((1, int(bucket)), dtype=np.int64)
+                )
+        except Exception:
+            logger.warning(
+                "sentinel could not pre-build the replay probe; the first "
+                "replay will compile it lazily", exc_info=True,
+            )
+
+    # -- in-graph logit health ---------------------------------------------
+    def observe(self, submodel: str, bucket, stats) -> None:
+        """Record one dispatch's compiled-in ``(B, 5)`` health readout
+        (called by ``ModelWrapper.forward`` after batch-padding rows are
+        sliced away). Columns per ``ops.sampling.LOGIT_STAT_FIELDS``."""
+        arr = np.asarray(jax.device_get(stats), dtype=np.float64)
+        if arr.ndim != 2 or arr.shape[1] != 5 or not arr.shape[0]:
+            return
+        self._dispatches += 1
+        labels = dict(submodel=submodel, bucket=str(bucket))
+        nan = float(arr[:, 0].sum())
+        inf = float(arr[:, 1].sum())
+        if nan:
+            self.nonfinite_total.inc(nan, kind="nan", **labels)
+        if inf:
+            self.nonfinite_total.inc(inf, kind="inf", **labels)
+        self.max_abs_logit.set(float(arr[:, 2].max()), **labels)
+        for row in arr:
+            self.entropy.observe(float(row[3]), **labels)
+            self.margin.observe(float(row[4]), **labels)
+        if nan or inf:
+            rows = [int(i) for i in np.nonzero(arr[:, 0] + arr[:, 1])[0]]
+            self._fire(
+                "logit_nonfinite",
+                {
+                    "kind": "logit_nonfinite",
+                    "submodel": submodel,
+                    "bucket": str(bucket),
+                    "rows": rows,
+                    "nan_count": nan,
+                    "inf_count": inf,
+                    "max_abs_logit": float(arr[:, 2].max()),
+                },
+            )
+
+    # -- replay verification -----------------------------------------------
+    def should_replay(self, request) -> bool:
+        """Deterministic shadow-replay sampling decision for one retirement
+        (counts a ``skip`` when sampled out). Ineligible retirements
+        (non-greedy rows, nothing generated) never consume replay credit:
+        ``replay_rate`` is a fraction of the GREEDY retirements, so mixed
+        sampled/greedy traffic cannot starve the verification coverage the
+        config promises."""
+        rate = self.config.replay_rate
+        if rate <= 0.0:
+            return False
+        if request.params.do_sample or not request.generated:
+            self.replays_total.inc(kind="shadow", outcome="skip")
+            return False
+        self._replay_credit += rate
+        if self._replay_credit >= 1.0 - 1e-9:
+            self._replay_credit -= 1.0
+            return True
+        self.replays_total.inc(kind="shadow", outcome="skip")
+        return False
+
+    def _replay_logits_check(self, request):
+        """Run the probe-backed replay matcher for one request; None when
+        the request cannot be verified (non-greedy row, no generated
+        tokens, or sequence over the probe's context budget)."""
+        from nxdi_tpu.utils.accuracy import check_replay_consistency
+
+        if request.params.do_sample or not request.generated:
+            return None
+        if self.app is None or not getattr(self.app, "is_loaded", False):
+            return None
+        if request.total_len > self.app.tpu_config.max_context_length:
+            return None
+        return check_replay_consistency(
+            self.app,
+            request.seq_tokens,
+            len(request.prompt),
+            divergence_difference_tol=self.config.divergence_tol,
+            tol_map=self.config.tol_map,
+        )
+
+    def verify_replay(self, request, kind: str) -> Optional[dict]:
+        """Teacher-force-replay ``request`` and token-match it against the
+        engine's streamed tokens. ``kind="shadow"`` audits a RETIRED request
+        (its whole generation); ``kind="preemption"`` verifies a
+        recompute-resume (``generated`` holds exactly the pre-preemption
+        tokens at that point). Returns the report, or None on skip."""
+        if kind not in REPLAY_KINDS:
+            raise ValueError(f"kind must be one of {REPLAY_KINDS}, got {kind!r}")
+        # every verification advances the bundle-cooldown clock: with
+        # logit_health off, observe() never runs, and a frozen clock would
+        # suppress every bundle after a kind's first forever
+        self._dispatches += 1
+        try:
+            report = self._replay_logits_check(request)
+        except Exception:
+            # a replay must never take the serving path down with it
+            logger.warning(
+                "sentinel %s replay failed for request %s; serving continues",
+                kind, request.request_id, exc_info=True,
+            )
+            self.replays_total.inc(kind=kind, outcome="skip")
+            return None
+        if report is None:
+            self.replays_total.inc(kind=kind, outcome="skip")
+            return None
+        if report["match"]:
+            self.replays_total.inc(kind=kind, outcome="match")
+            return report
+        self.replays_total.inc(kind=kind, outcome="mismatch")
+        self.replay_mismatch_total.inc(kind=kind)
+        from nxdi_tpu.utils.accuracy import format_error_summary
+
+        detail = {
+            "kind": f"{kind}_replay_divergence",
+            "request_id": request.request_id,
+            "preemptions": request.preemptions,
+            "prompt_tokens": len(request.prompt),
+            "generated_tokens": len(request.generated),
+            "divergence_index": report["divergence_index"],
+            "expected": report["expected"],
+            "got": report["got"],
+            "summary": report["summary"],
+        }
+        logger.warning(
+            "sentinel %s replay DIVERGED for request %s at generated index "
+            "%s (replay argmax %s vs streamed %s): %s",
+            kind, request.request_id, report["divergence_index"],
+            report["expected"], report["got"],
+            format_error_summary(report["summary"]),
+        )
+        # first mismatch of a kind bundles immediately; a SYSTEMIC
+        # divergence — every retirement mismatching — is then rate-limited
+        # to one bundle per cooldown window instead of a full snapshot+disk
+        # write per retired request (the counters above still count every
+        # incident)
+        self._fire(
+            f"{kind}_replay", detail,
+            request_span=request.span, request_id=request.request_id,
+        )
+        return report
+
+    # -- postmortem plumbing -------------------------------------------------
+    def _fire(self, kind: str, detail: dict, request_span=None,
+              request_id=None) -> None:
+        fl = self.flight
+        if fl is None:
+            logger.warning("sentinel numerics event (no flight recorder "
+                           "attached, not bundled): %s", detail)
+            return
+        last = self._last_bundle_at.get(kind)
+        if last is not None and (
+            self._dispatches - last < self.config.bundle_cooldown
+        ):
+            return
+        self._last_bundle_at[kind] = self._dispatches
+        fl.postmortem(
+            "numerics", detail=detail,
+            request_span=request_span, request_id=request_id,
+        )
+
+    # -- export ---------------------------------------------------------------
+    def summary(self) -> dict:
+        """The ``_sentinel`` JSON-snapshot extra."""
+        return {
+            "replay_rate": self.config.replay_rate,
+            "preemption_check": self.config.preemption_check,
+            "logit_health": self.config.logit_health,
+            "dispatches_observed": self._dispatches,
+            "nonfinite_total": self.nonfinite_total.total(),
+            "replay_mismatches": {
+                kind: self.replay_mismatch_total.value(kind=kind)
+                for kind in REPLAY_KINDS
+            },
+        }

@@ -114,6 +114,9 @@ def _get_logit_probe(app):
     wrapper = app.models["context_encoding_model"]
     fkw = dict(wrapper.forward_kwargs)
     fkw.update(output_all_logits=True, output_logits=True)
+    # the probe is itself the sentinel's replay vehicle — it must not emit
+    # (or recursively record) the in-graph health stats
+    fkw.pop("output_logit_stats", None)
     # always a plain ModelWrapper probing the TARGET model — for fused-spec
     # apps logit matching is defined on the target (the draft never changes
     # greedy outputs), and FusedSpecWrapper's graph has a different signature
@@ -157,27 +160,13 @@ def _get_logit_probe(app):
     return app._logit_probe
 
 
-def check_accuracy_logits(
-    app,
-    input_ids: np.ndarray,
-    hf_model=None,
-    golden_logits: Optional[np.ndarray] = None,
-    divergence_difference_tol: float = 0.001,
-    tol_map: Optional[Dict[int, float]] = None,
-) -> Dict[int, float]:
-    """Teacher-forced logit matching (reference: accuracy.py:474).
-
-    Runs the full golden sequence through the app's context-encoding submodel
-    with all-position logits and compares each position against HF CPU.
-    ``tol_map`` maps position -> looser tolerance (reference's per-index
-    tolerance maps for known-noisy positions). Returns {index: max_abs_err}.
-    """
+def probe_all_logits(app, input_ids: np.ndarray) -> np.ndarray:
+    """Teacher-forced ALL-position logits ``(B, S, V)`` through the cached
+    CTE logit probe — the shared dispatch half of
+    :func:`check_accuracy_logits` and the serving sentinel's shadow/
+    preemption replays (telemetry/sentinel.py), so every replay path runs
+    the exact probe the offline toolkit validates with."""
     input_ids = np.asarray(input_ids)
-    if golden_logits is None:
-        if hf_model is None:
-            raise ValueError("need hf_model or golden_logits")
-        golden_logits = hf_forward_logits(hf_model, input_ids)
-
     B, S = input_ids.shape
     position_ids = np.tile(np.arange(S, dtype=np.int32), (B, 1))
     probe, cache = _get_logit_probe(app)
@@ -203,7 +192,32 @@ def check_accuracy_logits(
     # the probe program DONATES its cache buffer: keep the returned one so a
     # later probe run (e.g. capture-on-divergence re-runs) stays valid
     app._logit_probe = (probe, new_cache)
-    actual = np.asarray(jax.device_get(outputs["logits"]))[:, :S, :]
+    return np.asarray(jax.device_get(outputs["logits"]))[:, :S, :]
+
+
+def check_accuracy_logits(
+    app,
+    input_ids: np.ndarray,
+    hf_model=None,
+    golden_logits: Optional[np.ndarray] = None,
+    divergence_difference_tol: float = 0.001,
+    tol_map: Optional[Dict[int, float]] = None,
+) -> Dict[int, float]:
+    """Teacher-forced logit matching (reference: accuracy.py:474).
+
+    Runs the full golden sequence through the app's context-encoding submodel
+    with all-position logits and compares each position against HF CPU.
+    ``tol_map`` maps position -> looser tolerance (reference's per-index
+    tolerance maps for known-noisy positions). Returns {index: max_abs_err}.
+    """
+    input_ids = np.asarray(input_ids)
+    if golden_logits is None:
+        if hf_model is None:
+            raise ValueError("need hf_model or golden_logits")
+        golden_logits = hf_forward_logits(hf_model, input_ids)
+
+    B, S = input_ids.shape
+    actual = probe_all_logits(app, input_ids)
 
     errors_by_index: Dict[int, float] = {}
     first_divergence = None
@@ -260,6 +274,69 @@ def error_summary(
         "suggested_tol_map": {
             i: float(f"{e * 1.2:.3g}") for i, e in over.items()
         },
+    }
+
+
+def check_replay_consistency(
+    app,
+    full_ids,
+    prompt_len: int,
+    divergence_difference_tol: float = 0.0,
+    tol_map: Optional[Dict[int, float]] = None,
+) -> Dict[str, Any]:
+    """Teacher-force ``full_ids = prompt + generated`` through the
+    all-position logit probe and greedy-match the generated suffix: the
+    argmax at position ``prompt_len - 1 + j`` must reproduce
+    ``generated[j]`` for every ``j`` — the self-consistency invariant the
+    serving sentinel's shadow replay and preemption-replay checks verify
+    (and what makes a continuous-batching KV routing bug, a forked
+    preemption resume, or a numerics burst visible as *wrong tokens*).
+
+    Per-index error = the logit gap ``logit[argmax] - logit[streamed]``
+    (0.0 where tokens agree), so a mismatch report carries the same
+    tol-map machinery as :func:`check_accuracy_logits`:
+    ``divergence_difference_tol`` / ``tol_map[j]`` forgive near-tie argmax
+    flips up to the given gap (default 0.0 = strict token equality).
+
+    Returns a JSON-able report::
+
+        {match, divergence_index, expected, got, n_checked,
+         errors_by_index, summary}
+
+    ``divergence_index`` indexes into the GENERATED suffix (0 = first
+    generated token); ``summary`` is :func:`error_summary` over the gap
+    errors (``suggested_tol_map`` pastes back into ``tol_map``).
+    """
+    full = np.asarray(full_ids, dtype=np.int64).reshape(1, -1)
+    L = full.shape[1]
+    prompt_len = int(prompt_len)
+    if not 0 < prompt_len < L:
+        raise ValueError(
+            f"prompt_len ({prompt_len}) must split full_ids (len {L}) into a "
+            "nonempty prompt and a nonempty generated suffix"
+        )
+    logits = probe_all_logits(app, full)[0]  # (L, V)
+    n = L - prompt_len
+    rows = logits[prompt_len - 1 : L - 1, :]  # predicts generated[0..n-1]
+    pred = rows.argmax(axis=-1)
+    streamed = full[0, prompt_len:]
+    errors_by_index: Dict[int, float] = {}
+    divergence = None
+    for j in range(n):
+        gap = float(rows[j, pred[j]] - rows[j, streamed[j]])
+        errors_by_index[j] = 0.0 if pred[j] == streamed[j] else gap
+        tol = (tol_map or {}).get(j, divergence_difference_tol)
+        if pred[j] != streamed[j] and gap > tol and divergence is None:
+            divergence = j
+    summary = error_summary(errors_by_index, divergence_difference_tol, tol_map)
+    return {
+        "match": divergence is None,
+        "divergence_index": divergence,
+        "expected": None if divergence is None else int(pred[divergence]),
+        "got": None if divergence is None else int(streamed[divergence]),
+        "n_checked": n,
+        "errors_by_index": errors_by_index,
+        "summary": summary,
     }
 
 

@@ -77,6 +77,39 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "fleet_goodput_slo_tok_s": ("higher", 0.10),
 }
 
+#: metric -> (direction, absolute limit) checked on the FRESH record alone —
+#: no baseline needed (so a pre-sentinel trajectory cannot make the gate
+#: vacuous) and trivially skipped when the field is absent. "lower" = the
+#: fresh value must stay strictly under the limit.
+#: sentinel_overhead_pct: the numerics sentinel (PR: numerics sentinel) is
+#: an always-on correctness observatory; it may not cost 3% of the engine
+#: step (bench.py --serving A/B smoke, ABBA-interleaved).
+ABSOLUTE_LIMITS: Dict[str, Tuple[str, float]] = {
+    "sentinel_overhead_pct": ("lower", 3.0),
+}
+
+
+def check_absolute(
+    fresh: dict, limits: Dict[str, Tuple[str, float]],
+) -> Tuple[List[dict], List[str]]:
+    """``(rows, skipped)`` like :func:`compare`, against fixed limits."""
+    rows, skipped = [], []
+    for metric, (direction, limit) in limits.items():
+        val = fresh.get(metric)
+        if not isinstance(val, (int, float)):
+            skipped.append(metric)
+            continue
+        worse = val >= limit if direction == "lower" else val <= limit
+        rows.append({
+            "metric": metric,
+            "direction": direction,
+            "baseline": None,
+            "fresh": val,
+            "limit": limit,
+            "regression": bool(worse),
+        })
+    return rows, skipped
+
 
 def default_baseline(root: str) -> Optional[str]:
     rounds = sorted(glob.glob(os.path.join(root, "BENCH_r*.json")))
@@ -164,6 +197,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         # real tok/s regression would pass silently.
         tolerances.pop("value", None)
     rows, skipped = compare(baseline, fresh, tolerances, scale=args.tolerance_scale)
+    abs_rows, abs_skipped = check_absolute(fresh, ABSOLUTE_LIMITS)
+    rows += abs_rows
+    skipped += abs_skipped
     if args.json_path:
         with open(args.json_path, "w") as f:
             json.dump({"baseline": baseline_path, "rows": rows,
@@ -175,6 +211,13 @@ def main(argv: Optional[List[str]] = None) -> int:
         for r in rows:
             mark = "REGRESSION" if r["regression"] else "ok"
             arrow = "^" if r["direction"] == "higher" else "v"
+            if r.get("baseline") is None:  # absolute-limit row
+                print(
+                    f"  {r['metric']:<32} {arrow} {r['fresh']:>10g} "
+                    f"(absolute limit {r['limit']:g})  {mark}",
+                    file=sys.stderr,
+                )
+                continue
             print(
                 f"  {r['metric']:<32} {arrow} {r['baseline']:>10g} -> "
                 f"{r['fresh']:>10g}  {r['delta_pct']:+7.2f}% "

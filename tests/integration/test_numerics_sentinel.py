@@ -1,0 +1,353 @@
+"""Numerics sentinel (nxdi_tpu/telemetry/sentinel.py) — the acceptance
+anchors:
+
+- greedy engine output is BIT-IDENTICAL with the sentinel on (replay_rate
+  1.0) and off, and every retired greedy request's shadow replay matches;
+- the shadow replay keeps matching across forced recompute preemption and
+  chunked prefill (the recompute-resume invariant verifies per resume);
+- an injected logit perturbation produces the CORRECT divergence index in
+  a ``numerics`` postmortem bundle naming the request;
+- an injected NaN in decode logits produces a ``numerics`` bundle naming
+  the (submodel, bucket), with the pre-seeded zero series visible in
+  Prometheus scrapes BEFORE anything ever went wrong;
+- a preemption-replay mismatch is counted + bundled while the engine keeps
+  serving (never a crash, never a silent fork).
+"""
+
+import glob
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, SentinelConfig, TpuConfig
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.runtime.model_wrapper import TAG_TOKEN_GENERATION
+from nxdi_tpu.serving import InferenceEngine, SamplingParams, SchedulerConfig
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+P0 = [5, 9, 3, 17, 2, 8, 11, 42]
+P1 = [7, 13, 21, 4, 33]
+P2 = [9, 9, 2, 40, 17, 3]
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        telemetry="basic",
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+def _expected(hf_model, prompt, n):
+    return hf_greedy(hf_model, np.array([prompt]), n)[0, len(prompt):].tolist()
+
+
+def _numerics_bundles(pm_dir):
+    return sorted(glob.glob(str(pm_dir) + "/postmortem_numerics_*.json"))
+
+
+def test_sentinel_on_parity_and_shadow_replay_matches(tiny_hf_llama):
+    """replay_rate=1.0: every retired greedy request teacher-force replays
+    and matches, the sentinel-on engine streams exactly what the
+    sentinel-off static path generates, and the absence-of-errors series
+    are scrapeable from step 0."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=32,
+        ctx_batch_size=1, tkg_batch_size=3,
+        sentinel={"replay_rate": 1.0},
+    )
+    sent = app.telemetry.sentinel
+    assert sent is not None
+    # pre-seed satellite: BEFORE any traffic, one zero series per compiled
+    # (submodel, bucket) program and per replay (kind, outcome) pair
+    prom = app.telemetry.prometheus_text()
+    for tag, bucket in (
+        ("context_encoding_model", "32"),
+        ("token_generation_model", "64"),
+    ):
+        for kind in ("nan", "inf"):
+            assert (
+                f'nxdi_numerics_nonfinite_total{{submodel="{tag}",'
+                f'bucket="{bucket}",kind="{kind}"}} 0' in prom
+            ), (tag, bucket, kind)
+    for kind in ("shadow", "preemption"):
+        assert (
+            f'nxdi_sentinel_replay_mismatch_total{{kind="{kind}"}} 0' in prom
+        )
+
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=3))
+    budgets = {0: 10, 1: 12, 2: 9}
+    reqs = {}
+    reqs[0] = engine.add_request(P0, SamplingParams(max_new_tokens=10))
+    reqs[1] = engine.add_request(P1, SamplingParams(max_new_tokens=12))
+    outs = engine.step() + engine.step()
+    reqs[2] = engine.add_request(P2, SamplingParams(max_new_tokens=9))
+    outs += engine.run()
+    got = {o.request_id: o.token_ids for o in outs}
+    for i, prompt in enumerate((P0, P1, P2)):
+        assert got[reqs[i].request_id] == _expected(hf_model, prompt, budgets[i])
+
+    # every retirement replayed and MATCHED; nothing diverged
+    assert sent.replays_total.value(kind="shadow", outcome="match") == 3
+    assert sent.replays_total.value(kind="shadow", outcome="mismatch") == 0
+    assert sent.replay_mismatch_total.total() == 0
+    # the in-graph health stats recorded per dispatched program
+    assert sent.nonfinite_total.value(
+        submodel=TAG_TOKEN_GENERATION, bucket="64", kind="nan"
+    ) == 0
+    margins = app.telemetry.registry.snapshot()["nxdi_numerics_margin"]
+    assert any(s["count"] > 0 for s in margins["series"])
+
+
+def test_shadow_replay_across_preemption_and_chunked_prefill(tiny_hf_llama):
+    """Forced recompute preemption under chunked prefill: the resume fires
+    the preemption-replay invariant (match), retirement fires the shadow
+    replay (match), and the streams stay token-exact."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        is_block_kv_layout=True,
+        chunked_prefill_config={"chunk_size": 8, "kernel_q_tile_size": 8},
+        pa_block_size=4, pa_num_blocks=32,
+        ctx_batch_size=1, tkg_batch_size=2,
+        sentinel={"replay_rate": 1.0},
+    )
+    sent = app.telemetry.sentinel
+    rng = np.random.default_rng(0)
+    long_prompt = rng.integers(1, 255, size=20).tolist()  # 3 chunks of 8
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+    ra = engine.add_request(P1, SamplingParams(max_new_tokens=10))
+    rb = engine.add_request(long_prompt, SamplingParams(max_new_tokens=6))
+    outs = engine.step()
+    while not rb.generated:  # let the 3-chunk prefill finish + decode once
+        outs += engine.step()
+    victim = engine.preempt_youngest()
+    assert victim is rb and victim.preemptions == 1 and victim.generated
+    outs += engine.run()
+    got = {o.request_id: o.token_ids for o in outs}
+    assert got[ra.request_id] == _expected(hf_model, P1, 10)
+    assert got[rb.request_id] == _expected(hf_model, long_prompt, 6)
+    # the victim resumed with generated tokens -> the invariant verified
+    assert sent.replays_total.value(kind="preemption", outcome="match") >= 1
+    assert sent.replays_total.value(kind="shadow", outcome="match") == 2
+    assert sent.replay_mismatch_total.total() == 0
+
+
+def test_injected_divergence_reports_index_in_bundle(
+    tiny_hf_llama, tmp_path, monkeypatch
+):
+    """A logit perturbation injected at generated index 2 must produce a
+    numerics bundle with divergence_index == 2, the request id, and the
+    mismatch counted — the capture-on-divergence flow, online."""
+    from nxdi_tpu.utils import accuracy as acc
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=32,
+        ctx_batch_size=1, tkg_batch_size=2,
+        sentinel={"replay_rate": 1.0},
+        telemetry={"detail": "basic", "postmortem_dir": str(tmp_path)},
+    )
+    sent = app.telemetry.sentinel
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+
+    target_j = 2
+    real_probe = acc.probe_all_logits
+
+    def perturbed_probe(papp, input_ids):
+        logits = real_probe(papp, input_ids).copy()
+        pos = len(P0) - 1 + target_j  # predicts generated[target_j]
+        top = int(logits[0, pos].argmax())
+        flipped = (top + 1) % logits.shape[-1]
+        logits[0, pos, flipped] = logits[0, pos, top] + 100.0
+        return logits
+
+    monkeypatch.setattr(acc, "probe_all_logits", perturbed_probe)
+    req = engine.add_request(P0, SamplingParams(max_new_tokens=8))
+    outs = engine.run()
+    assert outs[0].token_ids == _expected(hf_model, P0, 8)  # serving unchanged
+
+    assert sent.replay_mismatch_total.value(kind="shadow") == 1
+    bundles = _numerics_bundles(tmp_path)
+    assert bundles, "divergence must dump a numerics bundle"
+    b = json.load(open(bundles[0]))
+    assert b["trigger"] == "numerics"
+    d = b["detail"]
+    assert d["kind"] == "shadow_replay_divergence"
+    assert d["request_id"] == req.request_id
+    assert d["divergence_index"] == target_j
+    assert d["got"] == outs[0].token_ids[target_j]
+    assert d["summary"]["n_over_tol"] >= 1
+    # the tol-map suggestion names the diverged index (accuracy.py flow)
+    assert str(target_j) in json.dumps(d["summary"]["suggested_tol_map"])
+
+    # flightrec --inspect renders the numerics trigger with the index
+    from nxdi_tpu.cli.flightrec import inspect_bundle
+    import io
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        inspect_bundle(bundles[0])
+    text = buf.getvalue()
+    assert "numerics (shadow_replay_divergence)" in text
+    assert f"generated index {target_j}" in text
+
+
+def test_injected_nan_fires_numerics_bundle(tiny_hf_llama, tmp_path):
+    """A NaN burst in DECODE logits (poisoned lm_head column after prefill)
+    must count nxdi_numerics_nonfinite_total and dump one numerics bundle
+    naming the (submodel, bucket) — with a cooldown, not a bundle storm."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=32,
+        ctx_batch_size=1, tkg_batch_size=2,
+        sentinel=True,
+        telemetry={"detail": "basic", "postmortem_dir": str(tmp_path)},
+    )
+    sent = app.telemetry.sentinel
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+    engine.add_request(P0, SamplingParams(max_new_tokens=8))
+    engine.step()  # clean prefill
+    lm = np.array(app.params["lm_head"], copy=True)
+    lm[:, 7] = np.nan
+    app.params["lm_head"] = jax.device_put(lm, app.params["lm_head"].sharding)
+    engine.step()
+    assert sent.nonfinite_total.value(
+        submodel=TAG_TOKEN_GENERATION, bucket="64", kind="nan"
+    ) >= 1
+    bundles = _numerics_bundles(tmp_path)
+    assert len(bundles) == 1
+    b = json.load(open(bundles[0]))
+    assert b["trigger"] == "numerics"
+    assert b["detail"]["kind"] == "logit_nonfinite"
+    assert b["detail"]["submodel"] == TAG_TOKEN_GENERATION
+    assert b["detail"]["bucket"] == "64"
+    assert b["detail"]["rows"] == [0]
+    # persistent NaN: counted every step, but the edge trigger + cooldown
+    # keep it at ONE bundle
+    engine.step()
+    engine.step()
+    assert len(_numerics_bundles(tmp_path)) == 1
+    assert sent.nonfinite_total.value(
+        submodel=TAG_TOKEN_GENERATION, bucket="64", kind="nan"
+    ) >= 3
+
+
+def test_preemption_replay_mismatch_counts_and_serving_continues(
+    tiny_hf_llama, tmp_path, monkeypatch
+):
+    """A forked preemption resume (injected replay divergence at resume
+    time) counts nxdi_sentinel_replay_mismatch_total{kind="preemption"} and
+    bundles with the request + index — and the engine finishes every
+    request instead of crashing."""
+    from nxdi_tpu.utils import accuracy as acc
+
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        is_block_kv_layout=True, pa_block_size=4, pa_num_blocks=16,
+        ctx_batch_size=1, tkg_batch_size=2,
+        sentinel={"replay_rate": 0.0},  # isolate the preemption check
+        telemetry={"detail": "basic", "postmortem_dir": str(tmp_path)},
+    )
+    sent = app.telemetry.sentinel
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2, watermark_blocks=1))
+    ra = engine.add_request(P0, SamplingParams(max_new_tokens=10))
+    rb = engine.add_request(P1, SamplingParams(max_new_tokens=10))
+    engine.step()
+    victim = engine.preempt_youngest()
+    assert victim is not None and len(victim.generated) >= 1
+
+    real_probe = acc.probe_all_logits
+
+    def forked_probe(papp, input_ids):
+        logits = real_probe(papp, input_ids).copy()
+        pos = len(victim.prompt) - 1  # predicts generated[0]
+        top = int(logits[0, pos].argmax())
+        logits[0, pos, (top + 1) % logits.shape[-1]] = logits[0, pos, top] + 100.0
+        return logits
+
+    monkeypatch.setattr(acc, "probe_all_logits", forked_probe)
+    outs = engine.run()
+    got = {o.request_id: o for o in outs}
+    assert set(got) == {ra.request_id, rb.request_id}  # both finished
+    assert sent.replay_mismatch_total.value(kind="preemption") == 1
+    bundles = _numerics_bundles(tmp_path)
+    assert bundles
+    b = json.load(open(bundles[0]))
+    assert b["detail"]["kind"] == "preemption_replay_divergence"
+    assert b["detail"]["request_id"] == victim.request_id
+    assert b["detail"]["divergence_index"] == 0
+    assert b["detail"]["preemptions"] == 1
+
+
+def test_sampled_requests_skip_replay(tiny_hf_llama):
+    """Non-greedy (do_sample) rows cannot be argmax-verified: the replay
+    policy counts them as skips, never as mismatches."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _build_app(
+        hf_model, hf_cfg,
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=32,
+        ctx_batch_size=1, tkg_batch_size=2,
+        on_device_sampling_config=OnDeviceSamplingConfig(do_sample=True),
+        sentinel={"replay_rate": 1.0},
+    )
+    sent = app.telemetry.sentinel
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=2))
+    engine.add_request(
+        P0, SamplingParams(max_new_tokens=5, do_sample=True, top_k=4,
+                           temperature=0.8)
+    )
+    engine.run()
+    assert sent.replays_total.value(kind="shadow", outcome="skip") == 1
+    assert sent.replays_total.value(kind="shadow", outcome="mismatch") == 0
+    assert sent.replay_mismatch_total.total() == 0
+
+
+def test_sentinel_config_roundtrip_and_validation():
+    """SentinelConfig rides TpuConfig.to_dict/from_dict (tol_map int keys
+    survive the JSON stringification) and validates its knobs."""
+    tc = TpuConfig(
+        sentinel={"replay_rate": 0.25, "tol_map": {3: 0.5},
+                  "divergence_tol": 0.01},
+    )
+    d = json.loads(json.dumps(tc.to_dict()))  # a real JSON round trip
+    tc2 = TpuConfig.from_dict(d)
+    assert isinstance(tc2.sentinel, SentinelConfig)
+    assert tc2.sentinel.replay_rate == 0.25
+    assert tc2.sentinel.tol_map == {3: 0.5}
+    assert tc2.sentinel.divergence_tol == 0.01
+    assert TpuConfig(sentinel=True).sentinel.replay_rate == 0.0
+    assert TpuConfig().sentinel is None
+    with pytest.raises(ValueError, match="replay_rate"):
+        SentinelConfig(replay_rate=1.5)
+    with pytest.raises(ValueError, match="bundle_cooldown"):
+        SentinelConfig(bundle_cooldown=0)
+    with pytest.raises(ValueError, match="Unknown SentinelConfig"):
+        SentinelConfig(replay_rte=0.5)

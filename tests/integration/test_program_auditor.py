@@ -471,6 +471,70 @@ def test_hbm_fit_sharding_raises_the_budget():
 
 
 # ---------------------------------------------------------------------------
+# quantized-path dtype rules (the last ROADMAP invariant, now checked)
+# ---------------------------------------------------------------------------
+
+def test_quantized_dtype_clean_on_quantized_apps():
+    """The shipped w8a8 paths audit clean under both activation-quant modes,
+    and the checker is inert on unquantized / weight-only configs."""
+    for mode in ("dynamic", "static"):
+        report = make_app(
+            quantized=True, activation_quantization_type=mode
+        ).audit(checkers=["quantized_dtype"])
+        assert errors_of(report, "quantized_dtype") == [], (mode, report.to_json())
+    # unquantized: out of scope, zero findings
+    assert make_app().audit(checkers=["quantized_dtype"]).findings == []
+    # weight-only int8 (no activation quant): upcast-into-matmul is the
+    # design there — the checker must not flag it
+    report = make_app(quantized=True).audit(checkers=["quantized_dtype"])
+    assert errors_of(report, "quantized_dtype") == []
+
+
+def test_quantized_dtype_upcast_detour_detected(monkeypatch):
+    """A dequantize-before-dot regression (the weight-only fallback engaged
+    while the config declares the int8 MXU path) is flagged: no dot reaches
+    int8 x int8 operands un-upcast."""
+    import nxdi_tpu.ops.quantization as quant_ops
+
+    orig = quant_ops.quantized_linear
+
+    def upcast_linear(x, p, act_quant=None, clamp_bound=None):
+        return orig(x, p, act_quant=None, clamp_bound=None)  # fp32 detour
+
+    monkeypatch.setattr(quant_ops, "quantized_linear", upcast_linear)
+    report = make_app(
+        quantized=True, activation_quantization_type="dynamic"
+    ).audit(checkers=["quantized_dtype"], submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "quantized_dtype")
+    assert findings, report.to_json()
+    msg = findings[0].message
+    assert "NO dot_general contracts int8" in msg and "detour" in msg
+    assert findings[0].program == "token_generation_model[64]"
+
+
+def test_quantized_dtype_static_scale_recompute_detected(monkeypatch):
+    """Under static activation quantization the calibrated input_scale must
+    be consumed as a constant: a hot path that recomputes the per-token
+    amax (the dynamic branch engaged under a static declaration) is
+    flagged."""
+    import nxdi_tpu.ops.quantization as quant_ops
+
+    orig = quant_ops.quantized_linear
+
+    def recomputing_linear(x, p, act_quant=None, clamp_bound=None):
+        return orig(x, p, act_quant="dynamic", clamp_bound=clamp_bound)
+
+    monkeypatch.setattr(quant_ops, "quantized_linear", recomputing_linear)
+    report = make_app(
+        quantized=True, activation_quantization_type="static"
+    ).audit(checkers=["quantized_dtype"], submodels=[TAG_TOKEN_GENERATION])
+    findings = errors_of(report, "quantized_dtype")
+    assert findings, report.to_json()
+    assert "RECOMPUTED" in findings[0].message
+    assert "input_scale" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
 # cross-program cache-format agreement (the ROADMAP invariant, now checked)
 # ---------------------------------------------------------------------------
 

@@ -188,6 +188,46 @@ def test_fleet_metrics_gate_and_skip_when_absent(tmp_path):
     assert rc == 0
 
 
+def test_sentinel_overhead_absolute_gate(tmp_path, capsys):
+    """sentinel_overhead_pct (bench.py --serving numerics-sentinel smoke)
+    gates against the ABSOLUTE < 3% limit on the fresh record alone: it
+    never needs a baseline (pre-sentinel trajectories cannot make it
+    vacuous) and is skipped, not failed, when the smoke did not run."""
+    ok = dict(BASE, sentinel_overhead_pct=1.4)
+    base = _write(tmp_path, "base.json", BASE)  # pre-sentinel baseline
+    rc = bench_gate.main([_write(tmp_path, "ok.json", ok), "--baseline", base])
+    assert rc == 0
+    assert "sentinel_overhead_pct" in capsys.readouterr().err
+
+    # over the limit fails even though the baseline has no such field...
+    hot = dict(BASE, sentinel_overhead_pct=4.5)
+    rc = bench_gate.main(
+        [_write(tmp_path, "hot.json", hot), "--baseline", base, "-q"]
+    )
+    assert rc == 1
+    # ... exactly at the limit fails too (strictly under 3%) ...
+    at = dict(BASE, sentinel_overhead_pct=3.0)
+    rc = bench_gate.main(
+        [_write(tmp_path, "at.json", at), "--baseline", base, "-q"]
+    )
+    assert rc == 1
+    # ... a negative measurement (noise: sentinel side faster) passes ...
+    neg = dict(BASE, sentinel_overhead_pct=-0.4)
+    rc = bench_gate.main(
+        [_write(tmp_path, "neg.json", neg), "--baseline", base, "-q"]
+    )
+    assert rc == 0
+    # ... and absence (smoke skipped / null) is a skip, not a failure
+    rows, skipped = bench_gate.check_absolute(
+        dict(BASE, sentinel_overhead_pct=None), bench_gate.ABSOLUTE_LIMITS
+    )
+    assert rows == [] and "sentinel_overhead_pct" in skipped
+    rc = bench_gate.main(
+        [_write(tmp_path, "plain.json", BASE), "--baseline", base, "-q"]
+    )
+    assert rc == 0
+
+
 def test_serving_metrics_gate_and_skip_when_absent(tmp_path):
     """The bench.py --serving goodput line gates one-sided; a baseline from
     BEFORE the serving engine (no serving_* fields) skips them instead of
