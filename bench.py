@@ -1192,6 +1192,182 @@ def main_fleet_serving(
     return rec
 
 
+def main_routed_serving(
+    replicas=2,
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+):
+    """``bench.py --serving --replicas N --routed``: the fleet as ONE
+    routed workload instead of N independent drivers. Each replica runs a
+    full-depth 1B engine behind a :class:`ReplicaIngest` (HTTP request
+    plane) next to its metrics port; a :class:`Router` frontend dispatches
+    a single pooled Poisson arrival stream over real localhost HTTP —
+    least-loaded ranking off the fleet LoadSignals plus the router's local
+    in-flight term — and client threads poll their token streams through
+    the frontend, so every measured number includes the full network tier.
+    Halfway through the stream one replica is **cooperatively drained**
+    (the measured-failover-behavior half of the line: the router
+    rebalances the rest of the workload onto the survivors and the drained
+    replica finishes what it holds).
+
+    Headline fields gated by scripts/bench_gate.py (skipped against
+    pre-router baselines):
+
+    - ``routed_goodput_req_s`` / ``routed_tok_s`` — served work over the
+      wall from first arrival to last finish, one-sided like the fleet
+      twins;
+    - ``routed_ttft_p50_ms`` / ``routed_ttft_p95_ms`` — CLIENT-observed
+      TTFT through submit + dispatch + stream-poll (poll granularity
+      included: that is what a router-tier user sees);
+    - ``routed_failovers`` — absolute-gated < 1: nothing dies in this run,
+      so ANY failover is a routing bug, not noise.
+    """
+    import threading
+    import time as _time
+
+    from nxdi_tpu.cli.route import _http
+    from nxdi_tpu.config import FleetConfig, RouterConfig
+    from nxdi_tpu.router import ReplicaIngest, Router
+    from nxdi_tpu.telemetry.registry import percentile_exact
+
+    stacks, servers, ingests, targets = [], [], [], []
+    for i in range(replicas):
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            replica_id=f"bench-r{i}",
+        )
+        mserver = app.telemetry.serve(port=0)
+        ingest = ReplicaIngest(engine)
+        iserver = ingest.serve(port=0)
+        stacks.append((app, engine))
+        servers.extend([mserver, iserver])
+        ingests.append(ingest)
+        targets.append((f"bench-r{i}", mserver.url, iserver.url))
+
+    router = Router(
+        targets,
+        # shedding off for the bench: the line measures routing, not
+        # backpressure; a shed would silently shrink the workload
+        config=RouterConfig(shed_queue_depth=float(requests + slots),
+                            poll_interval_s=0.25),
+        fleet_config=FleetConfig(staleness_s=3600.0),
+    )
+    router.start()
+    frontend = router.serve(port=0)
+
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+    prompts = [
+        rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+        .astype(np.int32).tolist()
+        for _ in range(requests)
+    ]
+    drain_at = float(arrivals[requests // 2])
+    drain_target = f"bench-r{replicas - 1}"
+    results = [None] * requests
+    t0 = _time.perf_counter()
+
+    def drain_thread():
+        _time.sleep(max(drain_at - (_time.perf_counter() - t0), 0.0))
+        _http("POST", f"{frontend.url}/drain?replica={drain_target}")
+
+    def client(i):
+        arrival = t0 + float(arrivals[i])
+        _time.sleep(max(arrival - _time.perf_counter(), 0.0))
+        status, resp = _http("POST", f"{frontend.url}/submit", {
+            "request_id": f"bench-{i}",
+            "prompt": prompts[i],
+            "max_new_tokens": max_new,
+        })
+        if status != 200:
+            results[i] = {"error": f"submit HTTP {status}", "tokens": 0}
+            return
+        cursor, n_tok, ttft = 0, 0, None
+        while True:
+            status, resp = _http(
+                "GET",
+                f"{frontend.url}/stream?request_id=bench-{i}&cursor={cursor}",
+            )
+            if status != 200:
+                results[i] = {"error": f"stream HTTP {status}",
+                              "tokens": n_tok}
+                return
+            cursor = resp["cursor"]
+            n_tok += len(resp["tokens"])
+            if ttft is None and n_tok > 0:
+                ttft = _time.perf_counter() - arrival
+            if resp["done"]:
+                results[i] = {
+                    "error": resp["error"] if resp["finish_reason"] == "error"
+                    else None,
+                    "tokens": n_tok,
+                    "ttft_s": ttft,
+                    "end_s": _time.perf_counter() - t0,
+                    "failovers": resp.get("failovers", 0),
+                }
+                return
+            _time.sleep(0.003)
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(requests)]
+    threads.append(threading.Thread(target=drain_thread, daemon=True))
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    ok = [r for r in results if r and not r["error"]]
+    wall = max((r["end_s"] for r in ok), default=1e-9)
+    ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+    n_tok = sum(r["tokens"] for r in ok)
+    snap = router.snapshot()
+    rec = {
+        "metric": "llama3.2-1b_routed_serving_goodput",
+        "value": round(len(ok) / wall, 3),
+        "unit": "req/s",
+        "routed_replicas": replicas,
+        "routed_goodput_req_s": round(len(ok) / wall, 3),
+        "routed_tok_s": round(n_tok / wall, 1),
+        "routed_ttft_p50_ms": (
+            round(percentile_exact(ttfts, 50) * 1e3, 2) if ttfts else None
+        ),
+        "routed_ttft_p95_ms": (
+            round(percentile_exact(ttfts, 95) * 1e3, 2) if ttfts else None
+        ),
+        "routed_failovers": sum(
+            float(v) for v in router.failovers_total.series().values()
+        ),
+        "routed_sheds": router.sheds_total.total(),
+        "routed_drains": sum(
+            float(v) for v in router.drains_total.series().values()
+        ),
+        "routed_errors": len([r for r in results if r and r["error"]]),
+        "routed_dispatches": snap["_router"]["dispatches"],
+        "routed_drained_replica": drain_target,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged x{replicas} replicas "
+            f"slots{slots} kv{seq_len} prompt~{prompt_len} max_new{max_new} "
+            f"tp1 rate{rate:g} routed (one drain mid-run)"
+        ),
+        "mode": "routed_continuous_batching",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots({"router": snap}, metrics_out_path())
+    router.stop()
+    for ingest in ingests:
+        ingest.stop()
+    for server in servers:
+        server.shutdown()
+    return rec
+
+
 if __name__ == "__main__":
     if "--8b-only" in sys.argv:
         main_8b_only()
@@ -1210,7 +1386,9 @@ if __name__ == "__main__":
             slo_tpot_ms=_flag_value("--serving-slo-tpot-ms", 25.0),
         )
         _replicas = _flag_value("--replicas", 1)
-        if _replicas > 1:
+        if "--routed" in sys.argv:
+            main_routed_serving(replicas=max(_replicas, 2), **_serving_kwargs)
+        elif _replicas > 1:
             main_fleet_serving(replicas=_replicas, **_serving_kwargs)
         else:
             main_serving(

@@ -60,6 +60,10 @@ def setup_fleet_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--demo", type=int, default=0, metavar="N",
                    help="spin up N in-process tiny reference replicas on "
                         "ephemeral ports and observe those")
+    p.add_argument("--router", default=None, metavar="URL",
+                   help="a router frontend's base URL (cli.route --serve): "
+                        "its /snapshot is fetched each round and the table "
+                        "gains a per-replica router-dispatch-count column")
     p.add_argument("--poll-interval", type=float, default=1.0,
                    help="seconds between poll rounds (FleetConfig.poll_interval_s)")
     p.add_argument("--timeout", type=float, default=2.0,
@@ -88,15 +92,41 @@ def _note(quiet: bool, msg: str) -> None:
         print(msg, file=sys.stderr, flush=True)
 
 
-def print_fleet_table(monitor: FleetMonitor, file=None) -> None:
+def router_dispatch_counts(source) -> Optional[dict]:
+    """``{replica: dispatch_count}`` from a router surface: either a live
+    :class:`~nxdi_tpu.router.frontend.Router` (its counter is read
+    directly) or a router ``/snapshot`` JSON dict (the ``_router`` summary
+    every frontend serves). ``None`` when no router data is present."""
+    if source is None:
+        return None
+    dispatches = getattr(source, "dispatches_total", None)
+    if dispatches is not None:  # a live Router object
+        return {
+            labels[0]: float(v) for labels, v in dispatches.series().items()
+        }
+    if isinstance(source, dict):
+        d = (source.get("_router") or {}).get("dispatches")
+        if isinstance(d, dict):
+            return {str(k): float(v) for k, v in d.items()}
+    return None
+
+
+def print_fleet_table(monitor: FleetMonitor, file=None,
+                      dispatches: Optional[dict] = None) -> None:
     """The live table: one row per replica, ranked least-loaded first,
-    trailing rows for replicas outside the aggregates."""
+    trailing rows for replicas outside the aggregates. The state column
+    reads straight off each :class:`LoadSignal` (same poll round as the
+    scores). With ``dispatches`` (a router attached — see
+    :func:`router_dispatch_counts`) a per-replica router-dispatch-count
+    column is appended."""
     out = file if file is not None else sys.stdout
     sigs = {s.replica: s for s in monitor.load_signals()}
     now = monitor.wall_clock()
     hdr = (f"{'rank':>4} {'replica':<24} {'state':<12} {'age_s':>7} "
            f"{'queue':>5} {'busy':>5} {'kv_free':>7} {'kv_used':>7} "
            f"{'slo%':>6} {'score':>8}")
+    if dispatches is not None:
+        hdr += f" {'dispatched':>10}"
     print(hdr, file=out)
     print("-" * len(hdr), file=out)
     ranked = list(sigs)
@@ -106,23 +136,26 @@ def print_fleet_table(monitor: FleetMonitor, file=None) -> None:
         age = rep.snapshot_age_s(now)
         # pre-stamp replicas report no age (format(None, '>7') would raise)
         age_s = "-" if age is None else f"{age:.1f}"
-        print(
-            f"{rank:>4} {label:<24} {rep.state:<12} "
+        row = (
+            f"{rank:>4} {label:<24} {s.state:<12} "
             f"{age_s:>7} "
             f"{s.queue_depth:>5g} {s.slots_busy:>5g} "
             f"{s.kv_blocks_free:>7g} {s.kv_blocks_used:>7g} "
-            f"{s.slo_attainment_pct:>6.1f} {s.score:>8.4f}",
-            file=out,
+            f"{s.slo_attainment_pct:>6.1f} {s.score:>8.4f}"
         )
+        if dispatches is not None:
+            row += f" {dispatches.get(label, 0):>10g}"
+        print(row, file=out)
     for rep in monitor.replicas:
         if rep.label in sigs:
             continue
-        print(
+        row = (
             f"{'-':>4} {rep.label:<24} {rep.state:<12} "
             f"{'-':>7} {'-':>5} {'-':>5} {'-':>7} {'-':>7} {'-':>6} {'-':>8}"
-            f"  {rep.last_error or ''}",
-            file=out,
         )
+        if dispatches is not None:
+            row += f" {dispatches.get(rep.label, 0):>10g}"
+        print(row + f"  {rep.last_error or ''}", file=out)
 
 
 def build_demo_fleet(n: int, requests: int, quiet: bool):
@@ -153,9 +186,27 @@ def build_demo_fleet(n: int, requests: int, quiet: bool):
     return targets, servers
 
 
+def _fetch_router_dispatches(args) -> Optional[dict]:
+    """Dispatch counts from ``--router URL``'s /snapshot; None (column
+    absent) without the flag, {} on a fetch failure (column shows zeros
+    rather than vanishing mid-watch)."""
+    if not args.router:
+        return None
+    import json as _json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            args.router.rstrip("/") + "/snapshot", timeout=args.timeout
+        ) as resp:
+            return router_dispatch_counts(_json.loads(resp.read())) or {}
+    except Exception:  # noqa: BLE001 — the router is an optional adornment
+        return {}
+
+
 def emit(monitor: FleetMonitor, args) -> None:
     if args.format == "table":
-        print_fleet_table(monitor)
+        print_fleet_table(monitor, dispatches=_fetch_router_dispatches(args))
     elif args.format == "json":
         print(json.dumps(monitor.snapshot(), indent=2))
     else:

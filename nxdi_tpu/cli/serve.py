@@ -40,6 +40,10 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--rate", type=float, default=30.0,
                    help="mean arrival rate in req/s (default 30)")
     p.add_argument("--max-new-tokens", type=int, default=8)
+    p.add_argument("--sessions", type=int, default=4,
+                   help="demo traffic cycles its requests over this many "
+                        "session ids (Request.session_id — the router "
+                        "tier's affinity key; spans carry it)")
     p.add_argument("--slots", type=int, default=4,
                    help="engine slots = decode batch rows (default 4)")
     p.add_argument("--pa-block-size", type=int, default=8)
@@ -84,6 +88,11 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                    help="write the final JSON telemetry snapshot here")
     p.add_argument("--serve", action="store_true",
                    help="after the workload, serve /metrics until interrupted")
+    p.add_argument("--ingest-port", type=int, default=None, metavar="PORT",
+                   help="with --serve, also open the replica INGEST on this "
+                        "sibling port (nxdi_tpu/router: POST /submit, GET "
+                        "/stream, POST /drain) so a router tier can "
+                        "dispatch to this process; 0 = ephemeral")
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=9400)
     p.add_argument("-q", "--quiet", action="store_true")
@@ -131,6 +140,9 @@ def run_workload(args, app):
             SamplingParams(max_new_tokens=args.max_new_tokens),
             on_token=on_token,
             arrival_s=arrival_s,
+            # multi-turn shape: requests cycle over a few conversations so
+            # the affinity key is exercised even in this off-router demo
+            session_id=f"sess-{i % max(args.sessions, 1)}",
         )
 
     state = {"forced": args.force_preempt == 0, "peak": None, "peak_load": -1}
@@ -247,11 +259,24 @@ def main(argv: Optional[List[str]] = None) -> int:
               f"[serve] http://{args.host}:{server.port}/metrics "
               "(/metrics.json, /snapshot, /healthz, /trace.json, "
               "/postmortem) — Ctrl-C to stop")
+        ingest = None
+        if args.ingest_port is not None:
+            # the request plane on the metrics port's sibling: the drained
+            # demo engine keeps serving — a router can now dispatch to it
+            from nxdi_tpu.router import ReplicaIngest
+
+            ingest = ReplicaIngest(engine)
+            iserver = ingest.serve(host=args.host, port=args.ingest_port)
+            _note(args.quiet,
+                  f"[serve] ingest {iserver.url}/submit "
+                  "(/stream, /drain, /status)")
         try:
             while True:
                 time.sleep(3600)
         except KeyboardInterrupt:
             server.shutdown()
+            if ingest is not None:
+                ingest.stop()
     return 0
 
 

@@ -355,6 +355,72 @@ class FleetConfig:
         return dict(self.__dict__)
 
 
+class RouterConfig:
+    """Replica router tier (nxdi_tpu/router): dispatch/failover/shedding
+    knobs over the fleet observatory's load signals.
+
+    ``degraded_penalty`` — score added to a DEGRADED replica when ranking a
+    NEW dispatch (it stays dispatchable — its data is recent by the fleet
+    age-out — but healthy peers win ties decisively); existing session pins
+    survive DEGRADED so multi-turn traffic keeps its warm KV;
+    ``inflight_weight`` — per-request weight of the router's OWN live
+    assignment count in the ranking (least-outstanding-requests: polled
+    load signals lag a poll interval, the local term keeps a burst between
+    polls from landing wholesale on one replica; 0 ranks on the pinned
+    fleet score alone);
+    ``shed_queue_depth`` — router-level load-shedding watermark: a submit
+    is rejected with explicit backpressure (HTTP 429, counted in
+    ``nxdi_router_sheds_total``) when EVERY dispatchable replica's
+    queue-depth gauge exceeds this;
+    ``max_failovers`` — bounded retry: how many times one request may be
+    re-dispatched after its replica fails (None = replica count - 1, i.e.
+    every other replica gets one chance);
+    ``stream_failures`` — consecutive transport failures polling one
+    request's upstream stream before the router forces a health poll and
+    takes the failover decision (1 = fail over on the first error);
+    ``ingest_timeout_s`` — per-call HTTP timeout against replica ingest
+    endpoints (/submit, /stream, /drain);
+    ``poll_interval_s`` — background health/load poll cadence of the
+    router's embedded FleetMonitor (``Router.start()``);
+    ``max_sessions`` — LRU bound on the session-affinity pin table;
+    ``max_requests`` — bound on retained finished-request records (live
+    requests are never evicted).
+    """
+
+    def __init__(self, **kwargs):
+        self.degraded_penalty = float(kwargs.pop("degraded_penalty", 4.0))
+        self.inflight_weight = float(kwargs.pop("inflight_weight", 1.0))
+        self.shed_queue_depth = float(kwargs.pop("shed_queue_depth", 16.0))
+        mf = kwargs.pop("max_failovers", None)
+        self.max_failovers = None if mf is None else int(mf)
+        self.stream_failures = int(kwargs.pop("stream_failures", 2))
+        self.ingest_timeout_s = float(kwargs.pop("ingest_timeout_s", 5.0))
+        self.poll_interval_s = float(kwargs.pop("poll_interval_s", 0.5))
+        self.max_sessions = int(kwargs.pop("max_sessions", 4096))
+        self.max_requests = int(kwargs.pop("max_requests", 4096))
+        if kwargs:
+            raise ValueError(f"Unknown RouterConfig args: {sorted(kwargs)}")
+        if self.degraded_penalty < 0:
+            raise ValueError("router degraded_penalty must be >= 0")
+        if self.inflight_weight < 0:
+            raise ValueError("router inflight_weight must be >= 0")
+        if self.shed_queue_depth < 0:
+            raise ValueError("router shed_queue_depth must be >= 0")
+        if self.max_failovers is not None and self.max_failovers < 0:
+            raise ValueError("router max_failovers must be >= 0 (or None)")
+        if self.stream_failures < 1:
+            raise ValueError("router stream_failures must be >= 1")
+        if self.ingest_timeout_s <= 0 or self.poll_interval_s <= 0:
+            raise ValueError(
+                "router ingest_timeout_s and poll_interval_s must be > 0"
+            )
+        if self.max_sessions < 1 or self.max_requests < 1:
+            raise ValueError("router max_sessions/max_requests must be >= 1")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
 class HybridShardingConfig:
     """Per-phase hybrid MoE TPxEP regimes (reference: models/config.py:1060
     ``HybridShardingConfig``). ``moe_cte_ep_degree`` experts-axis width for

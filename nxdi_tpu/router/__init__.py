@@ -1,0 +1,51 @@
+"""Replica router tier: data-parallel serving over N engine replicas.
+
+ROADMAP item 3's front door. The fleet observatory (telemetry/fleet.py)
+already sees every replica — health state machine, deterministic
+:class:`~nxdi_tpu.telemetry.fleet.LoadSignal` scores; this package is the
+POLICY and REQUEST PLANE over it:
+
+- :mod:`~nxdi_tpu.router.policy` — deterministic least-loaded ranking
+  (DEGRADED down-weighted) + session affinity over ``Request.session_id``;
+- :mod:`~nxdi_tpu.router.ingest` — the replica-side HTTP request plane
+  (``/submit`` + ``/stream`` + ``/drain`` on the metrics port's sibling);
+- :mod:`~nxdi_tpu.router.retry` — bounded retry-with-failover (prompt
+  replay, duplicate-suppression by request_id);
+- :mod:`~nxdi_tpu.router.frontend` — the :class:`Router`: one network
+  door proxying submit/stream, shedding on fleet saturation, draining
+  cooperatively, exporting ``nxdi_router_*`` telemetry through the fleet
+  registry.
+
+CLI: ``python -m nxdi_tpu.cli.route`` (``--demo N`` spins a routed
+in-process fleet); bench: ``bench.py --serving --replicas N --routed``.
+"""
+
+from nxdi_tpu.router.frontend import Router, http_json, parse_target
+from nxdi_tpu.router.ingest import ReplicaIngest
+from nxdi_tpu.router.policy import DispatchPolicy, dispatchable, should_shed
+from nxdi_tpu.router.retry import (
+    DISPATCHED,
+    DONE,
+    FAILED,
+    PENDING,
+    RouterRequest,
+    exhausted,
+    should_failover,
+)
+
+__all__ = [
+    "Router",
+    "ReplicaIngest",
+    "DispatchPolicy",
+    "RouterRequest",
+    "dispatchable",
+    "should_shed",
+    "should_failover",
+    "exhausted",
+    "parse_target",
+    "http_json",
+    "PENDING",
+    "DISPATCHED",
+    "DONE",
+    "FAILED",
+]

@@ -1,0 +1,701 @@
+"""The router frontend: one network door over N engine replicas.
+
+``Router`` composes the three pieces of the tier:
+
+- a :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor` over the replicas'
+  METRICS ports — health states and :class:`LoadSignal` scores come from
+  the existing observatory, the router adds no new probe protocol;
+- a :class:`~nxdi_tpu.router.policy.DispatchPolicy` — deterministic
+  least-loaded ranking + session affinity (policy.py);
+- the per-request failover machine (retry.py) against the replicas'
+  INGEST ports (ingest.py).
+
+Every replica is a ``(name, metrics_url, ingest_url)`` target. The
+frontend proxies the same ``/submit`` / ``/stream`` shapes the ingest
+speaks, so a client never knows which replica served it — and a replica
+death mid-stream is invisible apart from the ``failovers`` field.
+
+Router telemetry (federated into every fleet export via
+``FleetMonitor.attach_registry``):
+
+- ``nxdi_router_dispatches_total{replica}`` — submissions placed (failover
+  re-dispatches included: each is a real placement);
+- ``nxdi_router_failovers_total{replica}`` — labeled by the replica that
+  FAILED the request (the diagnostic question is "who is dropping work");
+- ``nxdi_router_sheds_total`` — fleet-saturation rejections;
+- ``nxdi_router_drains_total{replica}`` — cooperative drains initiated;
+- ``nxdi_router_inflight{replica}`` — requests currently assigned.
+
+Thread model: HTTP handler threads call ``submit``/``stream``
+concurrently. One router lock guards the tables and the policy; each
+request carries its own lock serializing upstream stream syncs. Lock
+order is request -> router (never the reverse), and no upstream HTTP call
+runs under the router lock.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+from urllib.parse import parse_qs, quote, urlsplit
+
+from nxdi_tpu.router.policy import DispatchPolicy, dispatchable, should_shed
+from nxdi_tpu.router.retry import (
+    RouterRequest,
+    exhausted,
+    requests_summary,
+    should_failover,
+)
+from nxdi_tpu.telemetry.fleet import FleetMonitor
+from nxdi_tpu.telemetry.registry import MetricsRegistry
+
+logger = logging.getLogger("nxdi_tpu")
+
+#: replica-fault marker the ingest stamps on records killed by an engine
+#: step crash — the ONE "error" finish the router retries (a validation
+#: rejection reproduces identically on every replica; a crash does not)
+ENGINE_FAULT_PREFIX = "engine step failed"
+
+
+def parse_target(
+    spec: Union[str, Tuple[str, str, str]],
+) -> Tuple[str, str, str]:
+    """``(name, metrics_url, ingest_url)`` from a tuple or the CLI string
+    form ``name,metrics_url,ingest_url``."""
+    if isinstance(spec, tuple):
+        name, metrics, ingest = spec
+    else:
+        parts = str(spec).split(",")
+        if len(parts) != 3:
+            raise ValueError(
+                f"replica target {spec!r} must be name,metrics_url,ingest_url"
+            )
+        name, metrics, ingest = parts
+    return str(name), str(metrics).rstrip("/"), str(ingest).rstrip("/")
+
+
+def http_json(
+    method: str, url: str, payload: Optional[dict] = None,
+    timeout_s: float = 10.0,
+) -> Tuple[int, dict]:
+    """One JSON round-trip — THE request-plane HTTP helper (the Router's
+    default transport, and what cli.route / bench reuse as clients).
+    Non-2xx answers RETURN (status, body) — they are protocol answers
+    (429 shed, 503 draining), not transport faults; only transport-level
+    failures raise."""
+    data = None if payload is None else json.dumps(payload).encode()
+    req = urllib.request.Request(
+        url, data=data, method=method,
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        try:
+            return e.code, json.loads(body)
+        except (json.JSONDecodeError, ValueError):
+            return e.code, {"error": body.decode(errors="replace")}
+
+
+class Router:
+    """Least-loaded + session-affinity dispatch with bounded failover,
+    cooperative draining, and load shedding over N replica targets."""
+
+    def __init__(
+        self,
+        targets: Sequence[Union[str, Tuple[str, str, str]]],
+        config=None,
+        fleet_config=None,
+        monitor: Optional[FleetMonitor] = None,
+        http=None,
+    ):
+        from nxdi_tpu.config import RouterConfig
+
+        parsed = [parse_target(t) for t in targets]
+        if not parsed:
+            raise ValueError("Router needs at least one replica target")
+        self.config = config if config is not None else RouterConfig()
+        self.ingest_urls: Dict[str, str] = {n: i for n, _, i in parsed}
+        if len(self.ingest_urls) != len(parsed):
+            raise ValueError("duplicate replica names in router targets")
+        self.monitor = monitor if monitor is not None else FleetMonitor(
+            [(n, m) for n, m, _ in parsed], config=fleet_config
+        )
+        self.policy = DispatchPolicy(self.config)
+        self.http = http if http is not None else http_json
+        self._lock = threading.Lock()
+        self._requests: Dict[str, RouterRequest] = {}
+        self._order: List[str] = []  # insertion order for bounded eviction
+        self._draining: set = set()
+        self._inflight: Dict[str, int] = {}
+        self._rid_seq = 0
+        self._stop = threading.Event()
+        self._poll_thread: Optional[threading.Thread] = None
+        self._server = None
+
+        # router telemetry — pre-seeded zero per target so absence-of-events
+        # is observable from the first scrape, federated into every fleet
+        # export next to the member replicas' merged series
+        self.registry = MetricsRegistry()
+        r = self.registry
+        self.dispatches_total = r.counter(
+            "nxdi_router_dispatches_total",
+            "requests placed on a replica (failover re-dispatches included)",
+            ("replica",),
+        )
+        self.failovers_total = r.counter(
+            "nxdi_router_failovers_total",
+            "in-flight requests re-dispatched away, labeled by the replica "
+            "that FAILED them",
+            ("replica",),
+        )
+        self.sheds_total = r.counter(
+            "nxdi_router_sheds_total",
+            "submissions rejected with backpressure (every dispatchable "
+            "replica over the queue-depth watermark)",
+        )
+        self.drains_total = r.counter(
+            "nxdi_router_drains_total",
+            "cooperative drains initiated per replica",
+            ("replica",),
+        )
+        self.inflight_gauge = r.gauge(
+            "nxdi_router_inflight",
+            "requests currently assigned to each replica",
+            ("replica",),
+        )
+        self.sheds_total.inc(0)
+        for name in self.ingest_urls:
+            self.dispatches_total.inc(0, replica=name)
+            self.failovers_total.inc(0, replica=name)
+            self.drains_total.inc(0, replica=name)
+            self.inflight_gauge.set(0, replica=name)
+            self._inflight[name] = 0
+        self.monitor.attach_registry(self.registry)
+
+    # -- fleet plumbing ------------------------------------------------------
+    def poll(self) -> Dict[str, str]:
+        """One health/load poll round (the background thread's tick; tests
+        call it directly for deterministic state)."""
+        return self.monitor.poll()
+
+    def _signals(self):
+        sigs = self.monitor.load_signals()
+        if not sigs:
+            self.poll()
+            sigs = self.monitor.load_signals()
+        return sigs
+
+    def _replica_state(self, label: str) -> Optional[str]:
+        for rep in self.monitor.replicas:
+            if rep.label == label:
+                return rep.state
+        return None
+
+    def _ingest_url(self, label: str) -> Optional[str]:
+        # labels prefer the replica's self-reported replica_id; fall back
+        # through the monitor's target-name mapping so a renamed replica
+        # still resolves to its ingest port
+        url = self.ingest_urls.get(label)
+        if url is not None:
+            return url
+        for rep in self.monitor.replicas:
+            if rep.label == label:
+                return self.ingest_urls.get(rep.name)
+        return None
+
+    def _label_of(self, name_or_label: str) -> Optional[str]:
+        """Normalize onto the FLEET label (the key signals, counters, pins
+        and the draining set all use): a self-reported replica_id passes
+        through; a target name resolves to its replica's current label.
+        None for an unknown replica. Without this, drain('r0') against a
+        replica self-reporting 'host:pid' would exclude a name no signal
+        ever carries."""
+        for rep in self.monitor.replicas:
+            if rep.label == name_or_label:
+                return rep.label
+        for rep in self.monitor.replicas:
+            if rep.name == name_or_label:
+                return rep.label
+        return None
+
+    def _set_inflight(self, label: str, delta: int) -> None:
+        # caller holds self._lock
+        self._inflight[label] = max(self._inflight.get(label, 0) + delta, 0)
+        self.inflight_gauge.set(self._inflight[label], replica=label)
+
+    # -- submit --------------------------------------------------------------
+    def submit(self, payload: dict) -> Tuple[int, dict]:
+        """Route one submission. Returns ``(status, response)``:
+        200 queued/duplicate, 400 bad request, 429 shed, 502 dispatch
+        failed, 503 no dispatchable replicas."""
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return 400, {"error": "prompt must be a non-empty token list"}
+        session_id = payload.get("session_id")
+        params = {
+            k: v for k, v in payload.items()
+            if k not in ("prompt", "request_id", "session_id") and v is not None
+        }
+        with self._lock:
+            rid = payload.get("request_id")
+            if rid is None:
+                self._rid_seq += 1
+                rid = f"rt-{self._rid_seq}"
+            rid = str(rid)
+            existing = self._requests.get(rid)
+            if existing is not None:
+                # router-level duplicate-suppression: same id = same request
+                return 200, dict(existing.to_dict(), status="duplicate")
+        signals = self._signals()
+        with self._lock:
+            # re-check under the lock: a concurrent twin submit may have
+            # registered the id while the signals were being fetched
+            existing = self._requests.get(rid)
+            if existing is not None:
+                return 200, dict(existing.to_dict(), status="duplicate")
+            candidates = dispatchable(signals, draining=self._draining)
+            if not candidates:
+                return 503, {
+                    "error": "no_replicas",
+                    "states": {r.label: r.state for r in self.monitor.replicas},
+                    "draining": sorted(self._draining),
+                }
+            if should_shed(candidates, self.config.shed_queue_depth):
+                self.sheds_total.inc()
+                return 429, {
+                    "error": "shed",
+                    "watermark": self.config.shed_queue_depth,
+                    "queue_depths": {
+                        s.replica: s.queue_depth for s in candidates
+                    },
+                }
+            req = RouterRequest(
+                rid, list(prompt), session_id=session_id, params=params
+            )
+            self._requests[rid] = req
+            self._order.append(rid)
+            self._evict_finished()
+        with req.lock:
+            return self._dispatch(req, signals)
+
+    def _evict_finished(self) -> None:
+        # caller holds self._lock; finished requests evict first, and the
+        # bound is HARD: if every record is somehow live past the cap, the
+        # oldest is error-finished and dropped (a network frontend must
+        # not grow without bound because clients stopped polling)
+        while len(self._requests) > self.config.max_requests:
+            for i, rid in enumerate(self._order):
+                r = self._requests.get(rid)
+                if r is None or r.done:
+                    del self._order[i]
+                    self._requests.pop(rid, None)
+                    break
+            else:
+                rid = self._order.pop(0)
+                req = self._requests.pop(rid)
+                req.finish("error", "evicted: router request table overflow")
+                if req.replica is not None:
+                    self._set_inflight(req.replica, -1)
+                logger.warning(
+                    "router: evicted live request %s (table over "
+                    "max_requests=%d)", rid, self.config.max_requests,
+                )
+
+    def _dispatch(self, req: RouterRequest, signals) -> Tuple[int, dict]:
+        """Place ``req`` on the best dispatchable replica, walking down the
+        ranking on per-replica submit failures. Called with ``req.lock``
+        held; finishes the request with reason ``"error"`` when nothing
+        can take it."""
+        while True:
+            with self._lock:
+                n_replicas = len(self.ingest_urls)
+                if exhausted(req, self.config.max_failovers, n_replicas):
+                    req.finish("error", "failover budget exhausted")
+                    return 502, dict(req.to_dict(), status="failed")
+                replica = self.policy.choose(
+                    signals,
+                    session_id=req.session_id,
+                    draining=self._draining,
+                    exclude=req.tried,
+                    inflight=dict(self._inflight),
+                )
+            if replica is None:
+                req.finish("error", "no dispatchable replica")
+                return 502, dict(req.to_dict(), status="failed")
+            url = self._ingest_url(replica)
+            req.assign(replica)
+            ok, status, resp = False, 0, {}
+            if url is not None:
+                try:
+                    status, resp = self.http(
+                        "POST", url + "/submit",
+                        dict(req.params, request_id=req.request_id,
+                             prompt=req.prompt, session_id=req.session_id),
+                        self.config.ingest_timeout_s,
+                    )
+                    ok = status == 200
+                except Exception as e:  # noqa: BLE001 — transport fault
+                    logger.warning(
+                        "router: submit to %s failed: %s", replica, e
+                    )
+            if ok:
+                with self._lock:
+                    self.dispatches_total.inc(replica=replica)
+                    self._set_inflight(replica, +1)
+                return 200, {
+                    "request_id": req.request_id,
+                    "replica": replica,
+                    "status": resp.get("status", "queued"),
+                    "failovers": req.failovers,
+                }
+            if status == 503:
+                # the replica is draining and we had not noticed yet: honor
+                # it locally and retry the next-ranked WITHOUT burning a
+                # failover (the replica never held the request)
+                with self._lock:
+                    self._draining.add(replica)
+                    self.policy.unpin_replica(replica)
+                req.replica = None
+                if replica not in req.tried:
+                    req.tried.append(replica)
+                continue
+            # transport fault or ingest-side error: this replica failed the
+            # request before ever running it — counts as a failover. Only
+            # THIS request excludes the replica (req.tried); other sessions
+            # keep their pins — a single timed-out POST is not the health
+            # transition the affinity contract breaks on (this request's
+            # own session re-pins via choose(), whose exclusion set hides
+            # the old pin)
+            failed = req.mark_failed_replica()
+            with self._lock:
+                self.failovers_total.inc(replica=failed)
+
+    # -- stream --------------------------------------------------------------
+    def stream(self, rid: str, cursor: int = 0) -> Tuple[int, dict]:
+        """Proxied token poll: returns delivered tokens past ``cursor``.
+        The upstream sync — and any failover it triggers — happens inline,
+        so a polling client IS the failure detector's clock."""
+        with self._lock:
+            req = self._requests.get(str(rid))
+        if req is None:
+            return 404, {"error": "unknown request", "request_id": rid}
+        cursor = max(int(cursor), 0)
+        req.touch()  # the background sweep skips client-attended requests
+        with req.lock:
+            if not req.done:
+                self._sync(req)
+            toks = list(req.delivered[cursor:])
+            return 200, {
+                "request_id": req.request_id,
+                "tokens": toks,
+                "cursor": cursor + len(toks),
+                "done": req.done,
+                "finish_reason": req.finish_reason,
+                "error": req.error,
+                "replica": req.replica,
+                "failovers": req.failovers,
+            }
+
+    def _sync(self, req: RouterRequest) -> None:
+        """Pull new tokens from the request's replica; detect its death and
+        fail over. Called with ``req.lock`` held."""
+        replica = req.replica
+        url = None if replica is None else self._ingest_url(replica)
+        if url is None:
+            self._failover(req)
+            return
+        try:
+            status, resp = self.http(
+                "GET",
+                f"{url}/stream?request_id={quote(req.request_id)}"
+                f"&cursor={len(req.delivered)}",
+                None,
+                self.config.ingest_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001 — transport fault
+            req.stream_errors += 1
+            # force a health round so the state the failover rule consults
+            # reflects THIS failure, not the last background tick
+            self.poll()
+            state = self._replica_state(replica)
+            logger.warning(
+                "router: stream poll of %s failed (%d consecutive, "
+                "state=%s): %s", replica, req.stream_errors, state, e,
+            )
+            if should_failover(req, state, self.config.stream_failures):
+                self._failover(req)
+            return
+        if status == 404:
+            # the replica no longer knows the request (restarted): replay
+            self._failover(req)
+            return
+        if status != 200:
+            req.stream_errors += 1
+            if req.stream_errors >= self.config.stream_failures:
+                self._failover(req)
+            return
+        req.stream_errors = 0
+        req.delivered.extend(int(t) for t in resp.get("tokens", []))
+        if not resp.get("done"):
+            return
+        reason = resp.get("finish_reason") or "error"
+        err = resp.get("error")
+        if reason == "error" and str(err or "").startswith(ENGINE_FAULT_PREFIX):
+            # a replica-side crash is NOT deterministic — retry elsewhere;
+            # a validation rejection would reproduce identically and final-
+            # izes below instead
+            self._failover(req)
+            return
+        self._finish(req, reason, err)
+
+    def _finish(self, req: RouterRequest, reason: str, error=None) -> None:
+        req.finish(reason, error)
+        with self._lock:
+            if req.replica is not None:
+                self._set_inflight(req.replica, -1)
+
+    def _failover(self, req: RouterRequest) -> None:
+        """Re-dispatch an in-flight request whose replica failed: prompt
+        replay on the next-ranked replica, duplicate-suppressed by
+        request_id, already-delivered tokens never re-sent (the new
+        upstream is polled from cursor ``len(delivered)``). Called with
+        ``req.lock`` held."""
+        failed = req.mark_failed_replica()
+        with self._lock:
+            n_replicas = len(self.ingest_urls)
+            if failed is not None:
+                self.failovers_total.inc(replica=failed)
+                self._set_inflight(failed, -1)
+                # affinity breaks ONLY on the health transition that got us
+                # here — every session pinned to the dead replica re-pins on
+                # its next dispatch
+                self.policy.unpin_replica(failed)
+        if exhausted(req, self.config.max_failovers, n_replicas):
+            req.finish("error", "failover budget exhausted")
+            return
+        logger.info(
+            "router: failing request %s over from %s (attempt %d)",
+            req.request_id, failed, req.failovers,
+        )
+        self.poll()  # refresh health so the dead replica ranks out by state
+        status, _ = self._dispatch(req, self._signals())
+        if status == 200 and not req.done:
+            # pull the replacement stream immediately so the client poll
+            # that DETECTED the death already returns continuation tokens;
+            # recursion is bounded — every level burns a failover toward
+            # the cap before it can recurse again
+            self._sync(req)
+
+    # -- drain ---------------------------------------------------------------
+    def drain(self, replica: str) -> Tuple[int, dict]:
+        """Cooperative drain: the replica stops ACCEPTING (its ingest 503s
+        new submits), running requests finish in place, and this router
+        stops dispatching to it immediately — the fleet rebalances onto the
+        survivors. Local exclusion holds even when the upstream call fails
+        (a drain you asked for must stick)."""
+        replica = self._label_of(replica) or replica
+        url = self._ingest_url(replica)
+        if url is None:
+            return 404, {"error": "unknown replica", "replica": replica}
+        with self._lock:
+            already = replica in self._draining
+            self._draining.add(replica)
+            self.policy.unpin_replica(replica)
+            if not already:
+                self.drains_total.inc(replica=replica)
+        out = {"replica": replica, "draining": True}
+        try:
+            _, resp = self.http(
+                "POST", url + "/drain", {}, self.config.ingest_timeout_s
+            )
+            out["upstream"] = resp
+        except Exception as e:  # noqa: BLE001
+            out["upstream_error"] = str(e)
+        return 200, out
+
+    def undrain(self, replica: str) -> Tuple[int, dict]:
+        replica = self._label_of(replica) or replica
+        url = self._ingest_url(replica)
+        if url is None:
+            return 404, {"error": "unknown replica", "replica": replica}
+        with self._lock:
+            self._draining.discard(replica)
+        out = {"replica": replica, "draining": False}
+        try:
+            _, resp = self.http(
+                "POST", url + "/undrain", {}, self.config.ingest_timeout_s
+            )
+            out["upstream"] = resp
+        except Exception as e:  # noqa: BLE001
+            out["upstream_error"] = str(e)
+        return 200, out
+
+    @property
+    def draining(self) -> List[str]:
+        with self._lock:
+            return sorted(self._draining)
+
+    # -- export surfaces -----------------------------------------------------
+    def request(self, rid: str) -> Optional[RouterRequest]:
+        with self._lock:
+            return self._requests.get(str(rid))
+
+    def healthz(self) -> dict:
+        h = self.monitor.healthz()
+        with self._lock:
+            h["draining"] = sorted(self._draining)
+            h["requests"] = requests_summary(self._requests)
+        return h
+
+    def snapshot(self) -> dict:
+        """The fleet snapshot (router series federated in) + a ``_router``
+        summary block."""
+        snap = self.monitor.snapshot()
+        with self._lock:
+            snap["_router"] = {
+                "config": self.config.to_dict(),
+                "requests": requests_summary(self._requests),
+                "sessions": self.policy.sessions(),
+                "draining": sorted(self._draining),
+                "ingest": dict(self.ingest_urls),
+                # keyed by the counter's ACTUAL labels (fleet labels), not
+                # the target names — they differ when replica_id is not
+                # pinned, and reading value(replica=name) there would show
+                # zeros forever while traffic flows
+                "dispatches": {
+                    labels[0]: float(v)
+                    for labels, v in self.dispatches_total.series().items()
+                },
+            }
+        return snap
+
+    def prometheus_text(self) -> str:
+        return self.monitor.prometheus_text()
+
+    # -- background poll + HTTP frontend -------------------------------------
+    def start(self) -> "Router":
+        """Start the background health/load poll thread."""
+        if self._poll_thread is None:
+            self._stop.clear()
+            self._poll_thread = threading.Thread(
+                target=self._poll_loop, daemon=True
+            )
+            self._poll_thread.start()
+        return self
+
+    def _poll_loop(self) -> None:
+        while not self._stop.wait(self.config.poll_interval_s):
+            try:
+                self.poll()
+                self._sweep()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.warning("router poll round failed", exc_info=True)
+
+    def _sweep(self, limit: int = 8) -> None:
+        """Server-side progress for client-abandoned requests: sync the
+        oldest non-done requests nobody polled for a poll interval, so
+        their upstream finishes (or failovers) land, in-flight accounting
+        drains, and the table stays evictable — a crashed client must not
+        skew the least-outstanding ranking forever. Attended requests are
+        skipped (their own polls sync them); at most ``limit`` per tick."""
+        import time as _time
+
+        now = _time.monotonic()
+        with self._lock:
+            stale = sorted(
+                (
+                    r for r in self._requests.values()
+                    if not r.done
+                    and now - r.last_poll_s > self.config.poll_interval_s
+                ),
+                key=lambda r: r.last_poll_s,
+            )[:limit]
+        for req in stale:
+            if not req.lock.acquire(blocking=False):
+                continue  # a client poll is syncing it right now
+            try:
+                if not req.done:
+                    self._sync(req)
+            finally:
+                req.lock.release()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._poll_thread is not None:
+            self._poll_thread.join(timeout=10)
+            self._poll_thread = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    def routes(self) -> list:
+        from nxdi_tpu.telemetry.export import PROM_CONTENT_TYPE
+
+        def submit(path, body):
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return 400, json.dumps({"error": f"bad JSON: {e}"})
+            status, resp = self.submit(payload)
+            return status, json.dumps(resp)
+
+        def stream(path, body):
+            q = parse_qs(urlsplit(path).query)
+            rid = (q.get("request_id") or [None])[0]
+            if rid is None:
+                return 400, json.dumps({"error": "request_id required"})
+            cursor = int((q.get("cursor") or ["0"])[0])
+            status, resp = self.stream(rid, cursor)
+            return status, json.dumps(resp)
+
+        def replica_action(fn):
+            def handler(path, body):
+                q = parse_qs(urlsplit(path).query)
+                replica = (q.get("replica") or [None])[0]
+                if replica is None and body:
+                    try:
+                        replica = json.loads(body).get("replica")
+                    except json.JSONDecodeError:
+                        replica = None
+                if replica is None:
+                    return 400, json.dumps({"error": "replica required"})
+                status, resp = fn(replica)
+                return status, json.dumps(resp)
+            return handler
+
+        return [
+            ("POST", "/submit", "application/json", submit),
+            ("GET", "/stream", "application/json", stream),
+            ("POST", "/undrain", "application/json",
+             replica_action(self.undrain)),
+            ("POST", "/drain", "application/json", replica_action(self.drain)),
+            ("GET", "/healthz", "application/json",
+             lambda path, body: json.dumps(self.healthz())),
+            ("GET", "/metrics.json", "application/json",
+             lambda path, body: json.dumps(self.snapshot(), indent=2)),
+            ("GET", "/snapshot", "application/json",
+             lambda path, body: json.dumps(self.snapshot(), indent=2)),
+            ("POST", "/poll", "application/json",
+             lambda path, body: json.dumps(self.poll())),
+            ("GET", "/metrics", PROM_CONTENT_TYPE,
+             lambda path, body: self.prometheus_text()),
+        ]
+
+    def serve(self, host: str = "127.0.0.1", port: int = 9600):
+        """Start the frontend HTTP server (and the poll thread). The same
+        ``MetricsServer`` machinery every replica uses; ``port=0`` binds
+        ephemeral — read ``.url`` back."""
+        from nxdi_tpu.telemetry.export import MetricsServer
+
+        self.start()
+        self._server = MetricsServer(
+            host=host, port=port, routes=self.routes()
+        ).start()
+        return self._server

@@ -1,0 +1,328 @@
+"""Replica-side HTTP ingest: the request plane a router dispatches into.
+
+Every serving replica today exposes a METRICS port (``MetricsServer``:
+/metrics, /snapshot, /healthz). The ingest is its sibling port — same
+stdlib server, but carrying requests instead of probes:
+
+- ``POST /submit``  — enqueue one generation request
+  (``{"request_id", "prompt": [ids], "session_id"?, "max_new_tokens"?,
+  ...sampling}``). Answers ``{"status": "queued"}``; a KNOWN request_id
+  answers ``{"status": "duplicate"}`` without enqueueing (idempotent
+  submit — the router's failover re-dispatch can never run one request
+  twice on one replica); while draining answers **503**
+  ``{"error": "draining"}``.
+- ``GET /stream?request_id=R&cursor=N`` — SSE-style token poll: the
+  generated tokens past ``cursor`` plus ``done``/``finish_reason``. Tokens
+  appear here the moment the engine's streaming callback fires, so a
+  polling client sees per-token progress exactly like ``cli.serve
+  --stream`` does in-process.
+- ``POST /drain`` — cooperative drain: stop ACCEPTING (submits 503),
+  finish everything already queued/running. ``POST /undrain`` reverses it.
+- ``GET /status`` — ingest view: draining flag, queue/slot occupancy,
+  live/finished record counts.
+
+The engine is single-threaded by design, so the ingest owns a **driver
+thread** that is the only caller of ``engine.add_request``/``engine.step``
+— HTTP handler threads just append to a submission queue and read token
+records under one lock (the same in-process path ``cli.serve`` drives,
+with the queue in between). Engine faults error-finish the affected
+request, not the replica: the driver keeps stepping and the router fails
+the request over.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Deque, Dict, Optional
+from urllib.parse import parse_qs, urlsplit
+
+logger = logging.getLogger("nxdi_tpu")
+
+#: sampling keys a /submit payload may carry through to SamplingParams
+SAMPLING_KEYS = (
+    "max_new_tokens", "eos_token_ids", "do_sample", "top_k", "top_p",
+    "temperature",
+)
+
+
+class ReplicaIngest:
+    """HTTP request plane over one :class:`~nxdi_tpu.serving.InferenceEngine`.
+
+    ``step_delay_s`` throttles the driver loop (sleep after every engine
+    step) — demos and the failover tests use it to hold requests mid-stream
+    long enough to kill/drain the replica deterministically; production
+    leaves it 0. ``max_records`` bounds retained FINISHED records (live
+    ones never evict); the bound doubles as the duplicate-suppression
+    memory, so it should comfortably exceed the retry window.
+    """
+
+    def __init__(self, engine, max_records: int = 4096,
+                 step_delay_s: float = 0.0, idle_sleep_s: float = 0.002):
+        self.engine = engine
+        self.telemetry = getattr(engine, "telemetry", None)
+        self.max_records = int(max_records)
+        self.step_delay_s = float(step_delay_s)
+        self.idle_sleep_s = float(idle_sleep_s)
+        self._lock = threading.Lock()
+        #: request_id -> record dict (insertion-ordered for bounded eviction)
+        self._records: "OrderedDict[str, dict]" = OrderedDict()
+        self._pending: Deque[dict] = deque()  # submissions awaiting the driver
+        self._engine_ids: Dict[int, str] = {}  # engine request_id -> rid
+        self.draining = False
+        self._rid_seq = 0  # fallback ids for clients that submit without one
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._server = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "ReplicaIngest":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+        if self._server is not None:
+            self._server.shutdown()
+            self._server = None
+
+    # -- request plane (handler-thread side) ---------------------------------
+    def submit(self, payload: dict) -> tuple:
+        """``(status, response_dict)`` for one submission."""
+        prompt = payload.get("prompt")
+        if not isinstance(prompt, (list, tuple)) or not prompt:
+            return 400, {"error": "prompt must be a non-empty token list"}
+        rid = payload.get("request_id")
+        sampling = {
+            k: payload[k] for k in SAMPLING_KEYS if payload.get(k) is not None
+        }
+        with self._lock:
+            if rid is None:
+                self._rid_seq += 1
+                rid = f"in-{self._rid_seq}"
+            rid = str(rid)
+            rec = self._records.get(rid)
+            if rec is not None:
+                # duplicate-suppression: idempotent submit — report current
+                # progress, never enqueue a second copy
+                return 200, {
+                    "request_id": rid, "status": "duplicate",
+                    "done": rec["done"], "tokens": len(rec["tokens"]),
+                }
+            if self.draining:
+                return 503, {
+                    "error": "draining", "request_id": rid,
+                    "replica_id": self.replica_id,
+                }
+            rec = {
+                "request_id": rid,
+                "session_id": payload.get("session_id"),
+                "tokens": [],
+                "done": False,
+                "finish_reason": None,
+                "error": None,
+            }
+            self._records[rid] = rec
+            self._evict_finished()
+            self._pending.append({
+                "rid": rid,
+                "prompt": [int(t) for t in prompt],
+                "session_id": payload.get("session_id"),
+                "sampling": sampling,
+            })
+        self._wake.set()
+        return 200, {"request_id": rid, "status": "queued",
+                     "replica_id": self.replica_id}
+
+    def stream(self, rid: str, cursor: int = 0) -> tuple:
+        cursor = max(int(cursor), 0)
+        with self._lock:
+            rec = self._records.get(str(rid))
+            if rec is None:
+                return 404, {"error": "unknown request", "request_id": rid}
+            toks = list(rec["tokens"][cursor:])
+            return 200, {
+                "request_id": rec["request_id"],
+                "tokens": toks,
+                "cursor": cursor + len(toks),
+                "done": rec["done"],
+                "finish_reason": rec["finish_reason"],
+                "error": rec["error"],
+            }
+
+    def drain(self) -> dict:
+        with self._lock:
+            self.draining = True
+            live = sum(1 for r in self._records.values() if not r["done"])
+        logger.info("ingest %s draining (%d live requests finish first)",
+                    self.replica_id, live)
+        return {"draining": True, "live": live, "replica_id": self.replica_id}
+
+    def undrain(self) -> dict:
+        with self._lock:
+            self.draining = False
+        return {"draining": False, "replica_id": self.replica_id}
+
+    def status(self) -> dict:
+        sch = self.engine.scheduler
+        with self._lock:
+            live = sum(1 for r in self._records.values() if not r["done"])
+            total = len(self._records)
+            draining = self.draining
+        return {
+            "replica_id": self.replica_id,
+            "draining": draining,
+            "queue_depth": sch.queue_depth,
+            "slots_busy": sch.slots_busy,
+            "live": live,
+            "records": total,
+        }
+
+    @property
+    def replica_id(self) -> str:
+        tel = self.telemetry
+        return tel.replica_id if tel is not None else "unknown"
+
+    def _evict_finished(self) -> None:
+        # bounded memory: oldest FINISHED records go first; live ones stay
+        while len(self._records) > self.max_records:
+            for rid, rec in self._records.items():
+                if rec["done"]:
+                    del self._records[rid]
+                    break
+            else:
+                return  # everything live: never evict an in-flight record
+
+    # -- driver thread -------------------------------------------------------
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._admit_pending()
+            if self.engine.has_work():
+                self._step_once()
+                if self.step_delay_s > 0:
+                    time.sleep(self.step_delay_s)
+            else:
+                self._wake.wait(timeout=self.idle_sleep_s)
+                self._wake.clear()
+
+    def _admit_pending(self) -> None:
+        from nxdi_tpu.serving import SamplingParams
+
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                sub = self._pending.popleft()
+            rid = sub["rid"]
+
+            def on_token(req, tok, rid=rid):
+                with self._lock:
+                    rec = self._records.get(rid)
+                    if rec is not None:
+                        rec["tokens"].append(int(tok))
+
+            try:
+                req = self.engine.add_request(
+                    sub["prompt"],
+                    SamplingParams(**sub["sampling"]),
+                    on_token=on_token,
+                    session_id=sub["session_id"],
+                )
+            except (ValueError, TypeError) as e:
+                # a deterministic rejection (prompt too long, bad sampling
+                # args): error-finish the RECORD — the router reports it,
+                # no failover (every replica would reject it identically)
+                with self._lock:
+                    rec = self._records.get(rid)
+                    if rec is not None:
+                        rec["done"] = True
+                        rec["finish_reason"] = "error"
+                        rec["error"] = f"{type(e).__name__}: {e}"
+                continue
+            with self._lock:
+                self._engine_ids[req.request_id] = rid
+
+    def _step_once(self) -> None:
+        try:
+            outputs = self.engine.step()
+        except Exception as e:  # noqa: BLE001 — a step fault must not kill
+            # the driver; error-finish the records that were IN the engine
+            # (so the router can fail them over) and keep serving whatever
+            # comes next. Submissions still in _pending were never part of
+            # the faulting step — they stay queued and admit normally.
+            logger.exception("ingest %s: engine step failed", self.replica_id)
+            with self._lock:
+                for rid in self._engine_ids.values():
+                    rec = self._records.get(rid)
+                    if rec is not None and not rec["done"]:
+                        rec["done"] = True
+                        rec["finish_reason"] = "error"
+                        rec["error"] = f"engine step failed: {e}"
+                self._engine_ids.clear()
+            return
+        if not outputs:
+            return
+        with self._lock:
+            for out in outputs:
+                rid = self._engine_ids.pop(out.request_id, None)
+                rec = None if rid is None else self._records.get(rid)
+                if rec is None:
+                    continue
+                rec["tokens"] = list(out.token_ids)  # authoritative copy
+                rec["done"] = True
+                rec["finish_reason"] = out.finish_reason
+
+    # -- the sibling-port server ---------------------------------------------
+    def routes(self) -> list:
+        """Request-plane route rows for a
+        :class:`~nxdi_tpu.telemetry.export.MetricsServer` (the
+        method-aware shape)."""
+
+        def submit(path, body):
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return 400, json.dumps({"error": f"bad JSON: {e}"})
+            status, resp = self.submit(payload)
+            return status, json.dumps(resp)
+
+        def stream(path, body):
+            q = parse_qs(urlsplit(path).query)
+            rid = (q.get("request_id") or [None])[0]
+            if rid is None:
+                return 400, json.dumps({"error": "request_id required"})
+            cursor = int((q.get("cursor") or ["0"])[0])
+            status, resp = self.stream(rid, cursor)
+            return status, json.dumps(resp)
+
+        return [
+            ("POST", "/submit", "application/json", submit),
+            ("GET", "/stream", "application/json", stream),
+            ("POST", "/undrain", "application/json",
+             lambda path, body: json.dumps(self.undrain())),
+            ("POST", "/drain", "application/json",
+             lambda path, body: json.dumps(self.drain())),
+            ("GET", "/status", "application/json",
+             lambda path, body: json.dumps(self.status())),
+        ]
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the ingest HTTP server (and the driver thread if it is not
+        running yet). ``port=0`` binds ephemeral — read ``.url`` back."""
+        from nxdi_tpu.telemetry.export import MetricsServer
+
+        self.start()
+        self._server = MetricsServer(
+            host=host, port=port, routes=self.routes()
+        ).start()
+        return self._server

@@ -1,0 +1,158 @@
+"""Dispatch policy: deterministic least-loaded ranking + session affinity.
+
+Pure decision logic over :class:`~nxdi_tpu.telemetry.fleet.LoadSignal`
+rows — no sockets, no clocks, no engine state — so every rule here is unit
+testable with injected signals and two routers fed the same signals always
+pick the same replica.
+
+**Ranking.** Candidates are the signals whose replica is *dispatchable*:
+not UNREACHABLE (those never appear in ``FleetMonitor.load_signals()``
+anyway, but injected signals may carry the state), not draining, and not
+in the caller's exclusion set (replicas a request already failed over
+from). They sort ascending by::
+
+    effective_score = signal.score                       # the pinned fleet
+                    + (degraded_penalty if DEGRADED)     #   formula, as-is
+                    + inflight_weight * router_inflight  # local correction
+
+with ties broken on the replica label — the same determinism contract as
+:func:`~nxdi_tpu.telemetry.fleet.rank_load_signals`, which this extends by
+two terms. DEGRADED replicas are down-weighted, never excluded: their last
+snapshot is recent by the fleet age-out, and a degraded-but-alive replica
+beats a shed. ``router_inflight`` is the router's OWN per-replica
+assignment count (the ``nxdi_router_inflight`` gauge): polled signals lag
+by a poll interval, and without the local term a burst between polls lands
+wholesale on whichever replica the stale snapshot ranked first
+(least-outstanding-requests, the standard fix). The decision stays a pure
+function of (signals, router state) — two routers with the same state
+still agree.
+
+**Session affinity.** ``session_id`` pins to the replica that served the
+session last, so multi-turn conversations keep hitting warm KV/prefix
+state. A pin holds while its replica stays dispatchable — including
+through DEGRADED (the warm cache is exactly what you don't want to walk
+away from over one slow poll) — and breaks only when the replica goes
+UNREACHABLE, starts draining, or is excluded by failover; the next
+dispatch then re-pins to the least-loaded survivor. The pin table is a
+bounded LRU (``RouterConfig.max_sessions``).
+
+**Shedding.** :func:`should_shed` is the router-level backpressure rule:
+shed when EVERY dispatchable replica's queue-depth gauge exceeds the
+watermark. One idle replica anywhere means no shed — shedding exists for
+the fleet-wide-saturation case where queueing more work only converts
+latency SLO breaches into deeper queues.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from nxdi_tpu.telemetry.fleet import DEGRADED, UNREACHABLE, LoadSignal
+
+
+def dispatchable(
+    signals: Sequence[LoadSignal],
+    draining: Iterable[str] = (),
+    exclude: Iterable[str] = (),
+) -> List[LoadSignal]:
+    """The candidate set for one dispatch decision."""
+    draining, exclude = set(draining), set(exclude)
+    return [
+        s for s in signals
+        if s.state != UNREACHABLE
+        and s.replica not in draining
+        and s.replica not in exclude
+    ]
+
+
+def should_shed(candidates: Sequence[LoadSignal], watermark: float) -> bool:
+    """True when every dispatchable replica's queue depth EXCEEDS the
+    watermark (strictly >: watermark 0 sheds only once every queue is
+    non-empty). An empty candidate set is not a shed — it is a
+    no-replicas failure the caller reports differently."""
+    if not candidates:
+        return False
+    return all(s.queue_depth > watermark for s in candidates)
+
+
+class DispatchPolicy:
+    """Owns the ranking rule and the session-pin table. Not thread-safe by
+    itself — the :class:`~nxdi_tpu.router.frontend.Router` serializes calls
+    under its lock."""
+
+    def __init__(self, config=None):
+        from nxdi_tpu.config import RouterConfig
+
+        self.config = config if config is not None else RouterConfig()
+        #: session_id -> replica label, LRU-bounded
+        self._pins: "OrderedDict[str, str]" = OrderedDict()
+
+    # -- ranking -------------------------------------------------------------
+    def effective_score(
+        self, sig: LoadSignal, inflight: Optional[Dict[str, int]] = None
+    ) -> float:
+        local = 0.0 if inflight is None else float(inflight.get(sig.replica, 0))
+        return (
+            sig.score
+            + (self.config.degraded_penalty if sig.state == DEGRADED else 0.0)
+            + self.config.inflight_weight * local
+        )
+
+    def ranked(
+        self,
+        candidates: Sequence[LoadSignal],
+        inflight: Optional[Dict[str, int]] = None,
+    ) -> List[LoadSignal]:
+        return sorted(
+            candidates,
+            key=lambda s: (self.effective_score(s, inflight), s.replica),
+        )
+
+    # -- the decision --------------------------------------------------------
+    def choose(
+        self,
+        signals: Sequence[LoadSignal],
+        session_id: Optional[str] = None,
+        draining: Iterable[str] = (),
+        exclude: Iterable[str] = (),
+        inflight: Optional[Dict[str, int]] = None,
+    ) -> Optional[str]:
+        """Pick the replica for one dispatch; ``None`` when nothing is
+        dispatchable. Affinity first (while the pin is dispatchable), then
+        deterministic least-loaded; a broken or missing pin re-pins to the
+        chosen replica. ``inflight`` is the router's live per-replica
+        assignment count (the local ranking term)."""
+        candidates = dispatchable(signals, draining=draining, exclude=exclude)
+        if not candidates:
+            return None
+        if session_id is not None:
+            pin = self._pins.get(session_id)
+            if pin is not None and any(s.replica == pin for s in candidates):
+                self._pins.move_to_end(session_id)  # LRU touch
+                return pin
+        chosen = self.ranked(candidates, inflight)[0].replica
+        if session_id is not None:
+            self._pin(session_id, chosen)
+        return chosen
+
+    # -- pin management ------------------------------------------------------
+    def _pin(self, session_id: str, replica: str) -> None:
+        self._pins[session_id] = replica
+        self._pins.move_to_end(session_id)
+        while len(self._pins) > self.config.max_sessions:
+            self._pins.popitem(last=False)
+
+    def pin_of(self, session_id: str) -> Optional[str]:
+        return self._pins.get(session_id)
+
+    def unpin_replica(self, replica: str) -> int:
+        """Break every session pinned to ``replica`` (health transition to
+        UNREACHABLE, or a drain). Returns how many pins broke."""
+        broken = [s for s, r in self._pins.items() if r == replica]
+        for s in broken:
+            del self._pins[s]
+        return len(broken)
+
+    def sessions(self) -> Dict[str, str]:
+        return dict(self._pins)

@@ -1,0 +1,151 @@
+"""Bounded retry-with-failover: the router-side request record and the
+pure failover decision rules.
+
+A :class:`RouterRequest` is the router's view of one in-flight generation:
+which replica holds it now, which replicas already failed it, and the
+tokens DELIVERED toward the client so far. Failover is recompute-style,
+mirroring the engine's own preemption semantics one tier up:
+
+- the original prompt is re-submitted (same ``request_id``) to the
+  next-ranked replica — **prompt replay**, no KV handoff;
+- the replacement replica regenerates from position 0; because every
+  replica serves the same weights and the stream is greedy, its output is
+  token-identical, so the router polls the new upstream from cursor
+  ``len(delivered)`` and the client stream continues seamlessly — already
+  delivered tokens are never re-sent and never change;
+- **duplicate-suppression** is two-layered: the router keys its record
+  table by ``request_id`` (a re-submitted id returns the existing record
+  instead of spawning a twin), and each replica ingest treats a ``/submit``
+  for a known id as idempotent — so a failover race (submit acked but the
+  response lost) can never run one request twice on one replica;
+- the retry is **bounded**: once ``max_failovers`` re-dispatches are spent
+  (default: every other replica got one chance) the request finishes with
+  reason ``"error"`` instead of orbiting a dying fleet.
+
+The decision helpers (:func:`should_failover`, :func:`exhausted`) are pure
+so the unit tests pin them with injected states; the
+:class:`~nxdi_tpu.router.frontend.Router` owns when they run.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from nxdi_tpu.telemetry.fleet import UNREACHABLE
+
+#: router-request lifecycle (the upstream engine keeps its own WAITING/
+#: RUNNING states; these are the ROUTER's — a DISPATCHED request may still
+#: be queued inside its replica)
+PENDING = "PENDING"
+DISPATCHED = "DISPATCHED"
+DONE = "DONE"
+FAILED = "FAILED"
+
+
+class RouterRequest:
+    """One request's router-side bookkeeping. ``lock`` serializes stream
+    syncs for the same request from concurrent client polls; the router's
+    global lock is never held while this one is (lock order: request ->
+    router, acquired disjointly)."""
+
+    def __init__(
+        self,
+        request_id: str,
+        prompt: List[int],
+        session_id: Optional[str] = None,
+        params: Optional[dict] = None,
+    ):
+        self.request_id = str(request_id)
+        self.prompt = [int(t) for t in prompt]
+        self.session_id = session_id
+        self.params = dict(params or {})
+        self.state = PENDING
+        self.replica: Optional[str] = None  # current assignment
+        self.tried: List[str] = []  # replicas that failed this request
+        self.delivered: List[int] = []  # tokens surfaced toward the client
+        self.finish_reason: Optional[str] = None
+        self.error: Optional[str] = None
+        self.failovers = 0
+        self.stream_errors = 0  # consecutive upstream poll faults
+        #: monotonic stamp of the last CLIENT touch (submit or stream poll)
+        #: — the router's background sweep finishes requests whose client
+        #: went away, so an abandoned request can never pin in-flight
+        #: accounting or table space forever
+        self.last_poll_s = time.monotonic()
+        self.lock = threading.Lock()
+
+    def touch(self) -> None:
+        self.last_poll_s = time.monotonic()
+
+    @property
+    def done(self) -> bool:
+        return self.state in (DONE, FAILED)
+
+    def assign(self, replica: str) -> None:
+        self.replica = replica
+        self.state = DISPATCHED
+        self.stream_errors = 0
+
+    def mark_failed_replica(self) -> Optional[str]:
+        """Record the current replica as failed; returns it (the failover
+        counter's label) and clears the assignment."""
+        failed = self.replica
+        if failed is not None and failed not in self.tried:
+            self.tried.append(failed)
+        self.replica = None
+        self.failovers += 1
+        self.stream_errors = 0
+        return failed
+
+    def finish(self, reason: str, error: Optional[str] = None) -> None:
+        self.state = FAILED if reason == "error" else DONE
+        self.finish_reason = reason
+        self.error = error
+
+    def to_dict(self) -> dict:
+        return {
+            "request_id": self.request_id,
+            "state": self.state,
+            "session_id": self.session_id,
+            "replica": self.replica,
+            "tried": list(self.tried),
+            "delivered": len(self.delivered),
+            "failovers": self.failovers,
+            "finish_reason": self.finish_reason,
+            "error": self.error,
+        }
+
+
+def should_failover(
+    req: RouterRequest, replica_state: Optional[str], stream_failures: int
+) -> bool:
+    """Re-dispatch when the request's replica is KNOWN unreachable (the
+    health machine said so, or it vanished from the fleet table) or when
+    enough consecutive stream polls died that waiting for the next health
+    round would just stall the client. Affinity and failover share one
+    trigger: the health transition."""
+    if replica_state is None or replica_state == UNREACHABLE:
+        return True
+    return req.stream_errors >= stream_failures
+
+
+def exhausted(
+    req: RouterRequest, max_failovers: Optional[int], n_replicas: int
+) -> bool:
+    """The bounded-retry cap: ``max_failovers`` re-dispatches (default
+    ``n_replicas - 1`` — every OTHER replica gets one chance)."""
+    cap = max_failovers if max_failovers is not None else max(n_replicas - 1, 0)
+    return req.failovers > cap
+
+
+def requests_summary(requests: Dict[str, RouterRequest]) -> dict:
+    by_state: Dict[str, int] = {}
+    for r in requests.values():
+        by_state[r.state] = by_state.get(r.state, 0) + 1
+    return {
+        "total": len(requests),
+        "by_state": by_state,
+        "failovers": sum(r.failovers for r in requests.values()),
+    }

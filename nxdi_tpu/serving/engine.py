@@ -213,11 +213,14 @@ class InferenceEngine:
         on_token=None,
         request_id: Optional[int] = None,
         arrival_s: Optional[float] = None,
+        session_id: Optional[str] = None,
     ) -> Request:
         """Queue a request (WAITING). ``on_token(request, token)`` streams
         every generated token as it is sampled. ``arrival_s`` backdates the
         request's arrival for TTFT — it must be in the telemetry ``clock``
-        domain (``time.perf_counter`` under the default clock)."""
+        domain (``time.perf_counter`` under the default clock).
+        ``session_id`` is the conversation identity the router tier keys
+        affinity on; it rides the request span."""
         tel = self.telemetry
         if arrival_s is None and tel is not None and tel.enabled:
             # stamp arrival through the telemetry clock, not a hardcoded
@@ -226,7 +229,7 @@ class InferenceEngine:
             arrival_s = tel.clock()
         req = Request(
             prompt, params=params, request_id=request_id, on_token=on_token,
-            arrival_s=arrival_s,
+            arrival_s=arrival_s, session_id=session_id,
         )
         # ids key the block tables: two LIVE requests sharing one would
         # decode through the same blocks (silent KV corruption) and
@@ -281,7 +284,8 @@ class InferenceEngine:
             # backdate to the request's ARRIVAL: a driver submitting between
             # engine steps must not shave that wait off the reported TTFT
             req.span = tel.start_request(
-                tokens_in=len(req.prompt), t_start=req.arrival_s
+                tokens_in=len(req.prompt), t_start=req.arrival_s,
+                session_id=req.session_id,
             )
             req.span.phase("queue")
         self.scheduler.add(req)

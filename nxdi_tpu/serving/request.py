@@ -63,6 +63,7 @@ class Request:
         request_id: Optional[int] = None,
         on_token: Optional[Callable[["Request", int], None]] = None,
         arrival_s: Optional[float] = None,
+        session_id: Optional[str] = None,
     ):
         self.request_id = (
             int(request_id) if request_id is not None else next(Request._ids)
@@ -73,6 +74,11 @@ class Request:
         self.params = params or SamplingParams()
         self.on_token = on_token
         self.arrival_s = time.perf_counter() if arrival_s is None else arrival_s
+        #: conversation identity for the router tier's session affinity
+        #: (nxdi_tpu/router): requests sharing a session_id keep hitting the
+        #: same replica's warm KV/prefix state while it stays dispatchable.
+        #: First-class even off-router so spans carry it end to end.
+        self.session_id = None if session_id is None else str(session_id)
 
         self.state = WAITING
         self.generated: List[int] = []
@@ -129,10 +135,11 @@ class Request:
         return None
 
     def __repr__(self) -> str:
+        sess = "" if self.session_id is None else f", session={self.session_id}"
         return (
             f"Request(id={self.request_id}, state={self.state}, "
             f"prompt={len(self.prompt)}t, generated={len(self.generated)}t, "
-            f"slot={self.slot}, preemptions={self.preemptions})"
+            f"slot={self.slot}, preemptions={self.preemptions}{sess})"
         )
 
 

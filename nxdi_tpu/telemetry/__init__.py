@@ -85,6 +85,18 @@ replicas; every member gauge additionally gains a ``replica`` label there):
 ``nxdi_fleet_slo_attainment_pct``           gauge    from summed counters
 ==========================================  =======  ========================
 
+Replica router series (nxdi_tpu/router — owned by a ``Router``'s registry
+and federated into every fleet export via ``FleetMonitor.attach_registry``,
+pre-seeded zero per target):
+
+==========================================  =======  ========================
+``nxdi_router_dispatches_total``            counter  (replica) placements
+``nxdi_router_failovers_total``             counter  (replica = who FAILED it)
+``nxdi_router_sheds_total``                 counter  backpressure rejections
+``nxdi_router_drains_total``                counter  (replica) drains initiated
+``nxdi_router_inflight``                    gauge    (replica) assigned now
+==========================================  =======  ========================
+
 The three roofline gauges are published by the cost observatory
 (:func:`nxdi_tpu.analysis.costs.attach_cost_gauges`, wired at ``app.load()``):
 at every export the measured mean dispatch latency is divided through each
@@ -389,12 +401,17 @@ class Telemetry:
                 (padded_tokens - real_tokens) / padded_tokens, submodel=submodel
             )
 
-    def start_request(self, tokens_in: int = 0, t_start=None):
+    def start_request(self, tokens_in: int = 0, t_start=None,
+                      session_id=None):
         """``t_start`` (optional, ``clock`` domain) backdates the span to the
-        request's true arrival so TTFT includes queueing before this call."""
+        request's true arrival so TTFT includes queueing before this call;
+        ``session_id`` tags the span with its conversation identity (the
+        router tier's affinity key)."""
         if not self.enabled:
             return NULL_SPAN
-        return self.spans.start(tokens_in=tokens_in, t_start=t_start)
+        return self.spans.start(
+            tokens_in=tokens_in, t_start=t_start, session_id=session_id
+        )
 
     def record_spec_window(self, counts, path: str) -> None:
         """Accepted-length histogram per speculation window; ``counts`` is a

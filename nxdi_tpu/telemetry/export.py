@@ -235,6 +235,17 @@ class MetricsServer:
       recorder attached); the bundle is returned AND written to the
       recorder's ``postmortem_dir`` when configured
 
+    A route row is either the classic probe shape ``(prefix, ctype, fn)``
+    (GET, ``fn()`` -> body) or the request-plane shape
+    ``(method, prefix, ctype, fn)`` where ``fn(path, body)`` receives the
+    raw request path (query string included) and the request body bytes
+    (``b""`` for GET). Either ``fn`` may return ``str``/``bytes`` (200),
+    ``None`` (404), or ``(status, body)`` — the explicit-status form is
+    what the replica ingest and the router frontend use for backpressure
+    answers (429 shed, 503 draining) that a plain probe route can't
+    express. POST is how ``/submit`` and ``/drain`` arrive; matching is
+    method-exact, longest-prefix-first by table order as before.
+
     ``port=0`` binds an OS-assigned ephemeral port; read it back from
     ``.port`` (or ``.url``) — multi-replica tests and local fleets never
     need to coordinate hard-coded ports. ``shutdown()`` is graceful and
@@ -252,23 +263,40 @@ class MetricsServer:
         route_table = list(routes)
 
         class Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (stdlib API name)
-                for prefix, ctype, fn in route_table:
-                    if self.path.startswith(prefix):
-                        body = fn()
-                        if body is None:
-                            self.send_error(404)
-                            return
-                        payload = (
-                            body.encode() if isinstance(body, str) else body
-                        )
-                        self.send_response(200)
-                        self.send_header("Content-Type", ctype)
-                        self.send_header("Content-Length", str(len(payload)))
-                        self.end_headers()
-                        self.wfile.write(payload)
+            def _dispatch(self, method: str, body: bytes):
+                for row in route_table:
+                    if len(row) == 3:
+                        m, (prefix, ctype, fn) = "GET", row
+                        call = fn
+                    else:
+                        m, prefix, ctype, fn = row
+                        call = lambda fn=fn: fn(self.path, body)  # noqa: E731
+                    if m != method or not self.path.startswith(prefix):
+                        continue
+                    result = call()
+                    if result is None:
+                        self.send_error(404)
                         return
+                    status = 200
+                    if isinstance(result, tuple):
+                        status, result = result
+                    payload = (
+                        result.encode() if isinstance(result, str) else result
+                    )
+                    self.send_response(int(status))
+                    self.send_header("Content-Type", ctype)
+                    self.send_header("Content-Length", str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
                 self.send_error(404)
+
+            def do_GET(self):  # noqa: N802 (stdlib API name)
+                self._dispatch("GET", b"")
+
+            def do_POST(self):  # noqa: N802 (stdlib API name)
+                n = int(self.headers.get("Content-Length") or 0)
+                self._dispatch("POST", self.rfile.read(n) if n else b"")
 
             def log_message(self, *args):  # quiet: scrapes are not events
                 pass

@@ -74,7 +74,10 @@ STATE_CODES = {HEALTHY: 0, DEGRADED: 1, UNREACHABLE: 2}
 @dataclass(frozen=True)
 class LoadSignal:
     """One replica's router-facing load picture (see module docstring for
-    the pinned score formula)."""
+    the pinned score formula). ``state`` is the replica's health at signal
+    time — carried ON the signal so a dispatch policy never has to join
+    against :meth:`FleetMonitor.states` (and can never join against a
+    different poll round than the scores came from)."""
 
     replica: str
     queue_depth: float
@@ -82,6 +85,7 @@ class LoadSignal:
     kv_blocks_free: float
     kv_blocks_used: float
     slo_attainment_pct: float
+    state: str = HEALTHY
 
     @property
     def kv_used_frac(self) -> float:
@@ -100,6 +104,7 @@ class LoadSignal:
     def to_dict(self) -> dict:
         return {
             "replica": self.replica,
+            "state": self.state,
             "queue_depth": self.queue_depth,
             "slots_busy": self.slots_busy,
             "kv_blocks_free": self.kv_blocks_free,
@@ -121,7 +126,9 @@ def _gauge_value(snap: dict, family: str, default: float = 0.0) -> float:
     return float(series[0].get("value", default))
 
 
-def load_signal_from_snapshot(replica: str, snap: dict) -> LoadSignal:
+def load_signal_from_snapshot(
+    replica: str, snap: dict, state: str = HEALTHY
+) -> LoadSignal:
     """Extract the LoadSignal inputs from a replica snapshot — every field
     is an EXISTING gauge the serving engine already publishes (PRs 3/5/6);
     nothing here asks replicas to export anything new."""
@@ -135,6 +142,7 @@ def load_signal_from_snapshot(replica: str, snap: dict) -> LoadSignal:
         slo_attainment_pct=(
             _gauge_value(snap, "nxdi_slo_attainment_pct") if has_slo else 100.0
         ),
+        state=state,
     )
 
 
@@ -224,6 +232,10 @@ class FleetMonitor:
                 name, url = t, t
             self.replicas.append(Replica(str(name), str(url)))
         self._lock = threading.Lock()
+        # registries of co-located tiers (the replica router) whose series
+        # join every fleet export next to the monitor's own — see
+        # attach_registry()
+        self._extra_registries: List[MetricsRegistry] = []
         # the monitor's PERSISTENT series (edge counters survive re-merges;
         # the merged member view is rebuilt fresh on every export)
         self.registry = MetricsRegistry()
@@ -367,10 +379,11 @@ class FleetMonitor:
 
     def load_signals(self) -> List[LoadSignal]:
         """Ranked (least-loaded first) LoadSignals over the included
-        replicas — the router's dispatch input."""
+        replicas — the router's dispatch input. Each signal carries the
+        replica's health state from the SAME poll round as its scores."""
         with self._lock:
             sigs = [
-                load_signal_from_snapshot(rep.label, rep.snapshot)
+                load_signal_from_snapshot(rep.label, rep.snapshot, rep.state)
                 for rep in self._included()
             ]
         return rank_load_signals(sigs)
@@ -399,7 +412,7 @@ class FleetMonitor:
             if age is not None:
                 self.snapshot_age.set(age, replica=rep.label)
         sigs = rank_load_signals([
-            load_signal_from_snapshot(rep.label, rep.snapshot)
+            load_signal_from_snapshot(rep.label, rep.snapshot, rep.state)
             for rep in included
         ])
         for s in sigs:
@@ -420,17 +433,30 @@ class FleetMonitor:
         if total > 0:
             self.slo_attainment.set(100.0 * attained / total)
 
+    def attach_registry(self, registry: MetricsRegistry) -> None:
+        """Federate a co-located tier's live registry (e.g. the replica
+        router's ``nxdi_router_*`` series) through this monitor: its series
+        are copied verbatim into every :meth:`fleet_registry` export, so
+        one scrape of the fleet endpoint sees dispatch/failover counters
+        next to the member replicas' merged metrics."""
+        with self._lock:
+            self._extra_registries.append(registry)
+
     def fleet_registry(self) -> Tuple[MetricsRegistry, List[str]]:
         """Fresh merged registry: included member snapshots (counters
         summed, gauges replica-labeled, histograms bucket-exact) + the
-        monitor's own persistent ``nxdi_fleet_*`` series."""
+        monitor's own persistent ``nxdi_fleet_*`` series + any attached
+        co-tier registries (router telemetry)."""
         self._refresh_fleet_gauges()
         with self._lock:
             member = {
                 rep.label: rep.snapshot for rep in self._included()
             }
+            extras = list(self._extra_registries)
         reg, notes = merge_snapshots(member)
         notes.extend(copy_registry_into(self.registry, reg))
+        for extra in extras:
+            notes.extend(copy_registry_into(extra, reg))
         return reg, notes
 
     def prometheus_text(self) -> str:

@@ -24,13 +24,17 @@ PHASES = ("queue", "pad", "prefill", "decode")
 
 class RequestSpan:
     __slots__ = (
-        "request_id", "t_start", "t_end", "phases", "tokens_in", "tokens_out",
-        "ttft_s", "_tel", "_open", "_finished",
+        "request_id", "session_id", "t_start", "t_end", "phases", "tokens_in",
+        "tokens_out", "ttft_s", "_tel", "_open", "_finished",
     )
 
-    def __init__(self, tel, request_id: int, t_start: float):
+    def __init__(self, tel, request_id: int, t_start: float,
+                 session_id: Optional[str] = None):
         self._tel = tel
         self.request_id = request_id
+        # conversation identity (router session affinity); rides the span so
+        # postmortem bundles and Perfetto args can group multi-turn traffic
+        self.session_id = session_id
         self.t_start = t_start
         self.t_end: Optional[float] = None
         # [(name, t_begin, t_end)] — a handful of entries, never per-token
@@ -90,6 +94,7 @@ class RequestSpan:
     def to_dict(self) -> dict:
         return {
             "request_id": self.request_id,
+            "session_id": self.session_id,
             "t_start": self.t_start,
             "t_end": self.t_end,
             "phases": [
@@ -137,13 +142,15 @@ class SpanTracker:
         self.spans: Deque[RequestSpan] = deque()
         self._next_id = 0
 
-    def start(self, tokens_in: int = 0, t_start: Optional[float] = None) -> RequestSpan:
+    def start(self, tokens_in: int = 0, t_start: Optional[float] = None,
+              session_id: Optional[str] = None) -> RequestSpan:
         """``t_start`` backdates the span to the request's true arrival time
         (same clock domain as ``tel.clock``) so TTFT under load includes the
         queueing a late ``start`` call would otherwise omit."""
         span = RequestSpan(
             self._tel, self._next_id,
             self._tel.clock() if t_start is None else t_start,
+            session_id=session_id,
         )
         self._next_id += 1
         if tokens_in:

@@ -75,6 +75,15 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "fleet_straggler_gap_pct": ("lower", 0.30),
     "fleet_slo_attainment_pct": ("higher", 0.05),
     "fleet_goodput_slo_tok_s": ("higher", 0.10),
+    # routed-mode headline fields (bench.py --serving --replicas N --routed;
+    # PR: replica router). One-sided, skipped against pre-router baselines
+    # (missing on a side). TTFTs are CLIENT-observed through the HTTP
+    # frontend + stream polling, so they carry the most scheduling AND
+    # network noise of any latency the bench emits — widest tolerances.
+    "routed_goodput_req_s": ("higher", 0.07),
+    "routed_tok_s": ("higher", 0.07),
+    "routed_ttft_p50_ms": ("lower", 0.12),
+    "routed_ttft_p95_ms": ("lower", 0.18),
 }
 
 #: metric -> (direction, absolute limit) checked on the FRESH record alone —
@@ -84,8 +93,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
 #: sentinel_overhead_pct: the numerics sentinel (PR: numerics sentinel) is
 #: an always-on correctness observatory; it may not cost 3% of the engine
 #: step (bench.py --serving A/B smoke, ABBA-interleaved).
+#: routed_failovers / routed_errors: the routed bench kills nothing (its
+#: one drain is cooperative), so ANY failover or error-finished request is
+#: a routing bug, not noise — must stay strictly under 1, fresh-side only.
 ABSOLUTE_LIMITS: Dict[str, Tuple[str, float]] = {
     "sentinel_overhead_pct": ("lower", 3.0),
+    "routed_failovers": ("lower", 1.0),
+    "routed_errors": ("lower", 1.0),
 }
 
 
@@ -185,9 +199,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     tolerances = dict(TOLERANCES)
-    if "serving_goodput_req_s" in fresh or "fleet_goodput_req_s" in fresh:
-        # a serving- or fleet-mode FRESH record duplicates its "value"
-        # headline as serving_goodput_req_s / fleet_goodput_req_s (which
+    if any(k in fresh for k in ("serving_goodput_req_s",
+                                "fleet_goodput_req_s",
+                                "routed_goodput_req_s")):
+        # a serving-, fleet-, or routed-mode FRESH record duplicates its
+        # "value" headline as serving_/fleet_/routed_goodput_req_s (which
         # carry their own tolerances), and against a decode-mode baseline
         # "value" (tok/s/chip) measures something else entirely — the
         # generic "value" row must not gate it. Keyed on the FRESH side

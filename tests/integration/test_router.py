@@ -1,0 +1,400 @@
+"""Replica router end to end (the PR's acceptance surface): real
+tiny-model engines behind real HTTP ingests, routed through a real
+frontend —
+
+- routed greedy output is TOKEN-IDENTICAL to the same workload run
+  unrouted against a single replica;
+- killing a replica mid-stream fails the request over: it finishes on
+  another replica with the identical greedy tokens, exactly one failover
+  counted, affinity broken by the health transition (and ONLY by it);
+- duplicate-suppression: a re-submitted request_id never runs twice on a
+  replica;
+- cooperative drain: the drained replica finishes what it holds (token-
+  identical, zero failovers), stops accepting (ingest 503), and the
+  router rebalances new work — session pins included — onto survivors;
+- the tier-1 router smoke: ``python -m nxdi_tpu.cli.route --demo 2
+  --once`` exits 0 (and is what the acceptance criteria name).
+
+The policy/failure-machine semantics are exhaustively unit-tested over
+fake transports in tests/unit/test_router_policy.py; this file proves the
+same machine over live engines and sockets.
+"""
+
+import time
+
+import pytest
+
+from nxdi_tpu.config import (
+    FleetConfig,
+    OnDeviceSamplingConfig,
+    RouterConfig,
+    TpuConfig,
+)
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.router import ReplicaIngest, Router
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.serving import InferenceEngine, SamplingParams, SchedulerConfig
+
+# the routed workload: (prompt, max_new_tokens) — index-aligned with the
+# EXPECTED unrouted outputs the fixture precomputes
+WORKLOAD = [
+    ([5, 9, 3, 17, 2, 8, 11, 42], 6),
+    ([7, 13, 21, 4, 33], 6),
+    ([9, 9, 2, 40, 17, 3], 6),
+    ([12, 5, 88, 3], 6),
+]
+KILL_PROMPT, KILL_MAX_NEW = [23, 5, 71, 200, 14, 6, 90], 16
+DRAIN_PROMPT, DRAIN_MAX_NEW = [31, 7, 15, 150, 2], 12
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama_module():
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    return model, cfg
+
+
+def _build_replica(hf_model, hf_cfg, replica_id):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(
+            tp_degree=1,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            ctx_batch_size=1,
+            tkg_batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+            is_block_kv_layout=True,
+            pa_block_size=8,
+            pa_num_blocks=32,
+            telemetry={"detail": "basic", "replica_id": replica_id},
+        ),
+        load_config=lambda: hf_cfg.to_dict(),
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app, InferenceEngine(app, SchedulerConfig(num_slots=2))
+
+
+def _unrouted_outputs(engine, jobs):
+    """The single-replica reference run: each job generated alone, greedy —
+    the token sequences every routed run must reproduce exactly."""
+    expected = []
+    for prompt, max_new in jobs:
+        engine.add_request(prompt, SamplingParams(max_new_tokens=max_new))
+        (out,) = engine.run()
+        assert out.finish_reason in ("eos", "length")
+        expected.append(list(out.token_ids))
+    return expected
+
+
+@pytest.fixture(scope="module")
+def routed_fleet(tiny_hf_llama_module):
+    """Two live replicas (identical weights), each with a throttled ingest
+    + both HTTP ports, plus the precomputed UNROUTED expected outputs.
+    Yields (apps, engines, ingests, targets, expected)."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines = [], []
+    for i in range(2):
+        app, engine = _build_replica(hf_model, hf_cfg, f"rep-{i}")
+        apps.append(app)
+        engines.append(engine)
+    # the unrouted reference run happens BEFORE any ingest driver thread
+    # exists — same engine object a routed request will later hit
+    expected = _unrouted_outputs(
+        engines[0],
+        WORKLOAD + [(KILL_PROMPT, KILL_MAX_NEW), (DRAIN_PROMPT, DRAIN_MAX_NEW)],
+    )
+    ingests, servers, targets = [], [], []
+    for i in range(2):
+        # throttled so drains/kills can land mid-stream deterministically
+        ingest = ReplicaIngest(engines[i], step_delay_s=0.02)
+        mserver = apps[i].telemetry.serve(port=0)
+        iserver = ingest.serve(port=0)
+        ingests.append(ingest)
+        servers.extend([mserver, iserver])
+        targets.append((f"rep-{i}", mserver.url, iserver.url))
+    yield apps, engines, ingests, targets, expected
+    for ingest in ingests:
+        ingest.stop()
+    for s in servers:
+        s.shutdown()
+
+
+def _http(method, url, payload=None, timeout=10.0):
+    from nxdi_tpu.router import http_json
+
+    return http_json(method, url, payload, timeout)
+
+
+def _poll_until_done(url, rid, deadline_s=60.0, min_tokens_then=None,
+                     then=None):
+    """Poll one stream to completion through the frontend; optionally run
+    ``then()`` once ``min_tokens_then`` tokens have been delivered (the
+    mid-stream kill/drain hook). Returns the final response with the FULL
+    delivered token list."""
+    deadline = time.time() + deadline_s
+    cursor, tokens, fired = 0, [], then is None
+    last = None
+    while time.time() < deadline:
+        status, resp = _http(
+            "GET", f"{url}/stream?request_id={rid}&cursor={cursor}"
+        )
+        assert status == 200, resp
+        cursor = resp["cursor"]
+        tokens.extend(resp["tokens"])
+        last = resp
+        if not fired and len(tokens) >= min_tokens_then:
+            fired = True
+            then()
+        if resp["done"]:
+            last = dict(resp, tokens=tokens)
+            return last
+        time.sleep(0.01)
+    raise AssertionError(f"request {rid} never finished; last={last}")
+
+
+def _router_over(targets, **router_kwargs):
+    cfg = router_kwargs.pop("config", None) or RouterConfig(
+        stream_failures=1, poll_interval_s=0.2
+    )
+    fc = router_kwargs.pop("fleet_config", None) or FleetConfig(
+        staleness_s=3600.0, unreachable_failures=1,
+        backoff_base_s=0.01, backoff_max_s=0.02, timeout_s=2.0,
+    )
+    return Router(targets, config=cfg, fleet_config=fc, **router_kwargs)
+
+
+def test_routed_output_token_identical_with_affinity(routed_fleet):
+    """The core parity anchor: the routed multi-session workload over real
+    HTTP reproduces the unrouted single-replica tokens exactly, requests
+    of one session stick to one replica, and router counters federate
+    through the fleet export."""
+    apps, engines, ingests, targets, expected = routed_fleet
+    router = _router_over(targets)
+    frontend = router.serve(port=0)
+    try:
+        router.poll()
+        for i, (prompt, max_new) in enumerate(WORKLOAD):
+            status, resp = _http("POST", f"{frontend.url}/submit", {
+                "request_id": f"par-{i}",
+                "prompt": prompt,
+                "max_new_tokens": max_new,
+                "session_id": f"conv-{i % 2}",
+            })
+            assert status == 200, resp
+        finals = {}
+        for i in range(len(WORKLOAD)):
+            finals[i] = _poll_until_done(frontend.url, f"par-{i}")
+        for i in range(len(WORKLOAD)):
+            assert finals[i]["tokens"] == expected[i], (
+                f"routed request par-{i} diverged from the unrouted run"
+            )
+            assert finals[i]["finish_reason"] in ("eos", "length")
+            assert finals[i]["failovers"] == 0
+        # session affinity: same conversation -> same replica
+        by_session = {}
+        for i in range(len(WORKLOAD)):
+            by_session.setdefault(i % 2, set()).add(finals[i]["replica"])
+        for session, replicas in by_session.items():
+            assert len(replicas) == 1, (
+                f"session conv-{session} spread over {replicas}"
+            )
+        # router telemetry federates through the fleet registry
+        text = router.monitor.prometheus_text()
+        assert "nxdi_router_dispatches_total" in text
+        total_dispatch = sum(
+            float(v) for v in router.dispatches_total.series().values()
+        )
+        assert total_dispatch == len(WORKLOAD)
+        # and the fleet table renders the router-dispatch column
+        import io
+
+        from nxdi_tpu.cli.fleet import (
+            print_fleet_table,
+            router_dispatch_counts,
+        )
+
+        buf = io.StringIO()
+        print_fleet_table(
+            router.monitor, file=buf,
+            dispatches=router_dispatch_counts(router),
+        )
+        table = buf.getvalue()
+        assert "dispatched" in table and "rep-0" in table
+    finally:
+        router.stop()
+
+
+def test_ingest_duplicate_suppression_over_http(routed_fleet):
+    """Idempotent /submit at the replica ingest: a re-dispatched
+    request_id reports 'duplicate' and the engine serves it ONCE."""
+    apps, engines, ingests, targets, expected = routed_fleet
+    ingest_url = targets[1][2]
+    before = apps[1].telemetry.requests_total.total()
+    payload = {"request_id": "dup-1", "prompt": [4, 8, 15], "max_new_tokens": 3}
+    status, resp = _http("POST", f"{ingest_url}/submit", payload)
+    assert status == 200 and resp["status"] == "queued"
+    status, resp = _http("POST", f"{ingest_url}/submit", payload)
+    assert status == 200 and resp["status"] == "duplicate"
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        status, resp = _http(
+            "GET", f"{ingest_url}/stream?request_id=dup-1&cursor=0"
+        )
+        if resp["done"]:
+            break
+        time.sleep(0.01)
+    assert resp["done"] and resp["finish_reason"] in ("eos", "length")
+    # exactly ONE engine request was served for the two submits
+    assert apps[1].telemetry.requests_total.total() == before + 1
+
+
+def test_midstream_replica_kill_fails_over_token_identical(
+    routed_fleet, tiny_hf_llama_module
+):
+    """The acceptance kill test: the replica serving a streaming request is
+    killed (ingest + metrics servers down) after a few tokens; the request
+    finishes on the surviving replica with greedy output identical to the
+    unrouted run, one failover counted against the dead replica, and the
+    session pin moved by the health transition."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines, ingests, targets, expected = routed_fleet
+    expected_kill = expected[len(WORKLOAD)]
+    # disposable victim; its name ranks FIRST on ties so the request lands
+    # on it deterministically
+    app_k, engine_k = _build_replica(hf_model, hf_cfg, "a-kill")
+    ingest_k = ReplicaIngest(engine_k, step_delay_s=0.05)
+    mserver_k = app_k.telemetry.serve(port=0)
+    iserver_k = ingest_k.serve(port=0)
+    router = _router_over([("a-kill", mserver_k.url, iserver_k.url),
+                           targets[1]])
+    frontend = router.serve(port=0)
+    try:
+        router.poll()
+        status, resp = _http("POST", f"{frontend.url}/submit", {
+            "request_id": "kill-req",
+            "prompt": KILL_PROMPT,
+            "max_new_tokens": KILL_MAX_NEW,
+            "session_id": "conv-kill",
+        })
+        assert status == 200 and resp["replica"] == "a-kill"
+        assert router.policy.pin_of("conv-kill") == "a-kill"
+
+        def kill():
+            iserver_k.shutdown()
+            mserver_k.shutdown()
+            ingest_k.stop()
+
+        final = _poll_until_done(
+            frontend.url, "kill-req", min_tokens_then=3, then=kill
+        )
+        assert final["done"] and final["finish_reason"] in ("eos", "length")
+        # token-identical to the unrouted single-replica run, straight
+        # through a mid-stream replica death
+        assert final["tokens"] == expected_kill
+        assert final["failovers"] == 1
+        assert final["replica"] == "rep-1"
+        assert router.failovers_total.value(replica="a-kill") == 1
+        # affinity broke ON the health transition (and re-pinned)
+        assert router.policy.pin_of("conv-kill") == "rep-1"
+        # the health machine recorded the death
+        assert router.monitor.poll()["a-kill"] == "unreachable"
+    finally:
+        router.stop()
+        ingest_k.stop()
+        iserver_k.shutdown()
+        mserver_k.shutdown()
+
+
+def test_cooperative_drain_finishes_in_place_and_rebalances(routed_fleet):
+    """Drain semantics: the drained replica FINISHES its running request
+    (token-identical, zero failovers), new submits 503 at its ingest, the
+    router redirects new work — including the drained session — and
+    undrain restores it."""
+    apps, engines, ingests, targets, expected = routed_fleet
+    expected_drain = expected[len(WORKLOAD) + 1]
+    router = _router_over(targets)
+    frontend = router.serve(port=0)
+    try:
+        router.poll()
+        status, resp = _http("POST", f"{frontend.url}/submit", {
+            "request_id": "drain-req",
+            "prompt": DRAIN_PROMPT,
+            "max_new_tokens": DRAIN_MAX_NEW,
+            "session_id": "conv-drain",
+        })
+        assert status == 200
+        victim = resp["replica"]
+        survivor = next(n for n, _, _ in targets if n != victim)
+        drained = {"fired": False}
+
+        def drain():
+            st, dresp = _http(
+                "POST", f"{frontend.url}/drain?replica={victim}"
+            )
+            assert st == 200 and dresp["draining"]
+            drained["fired"] = True
+
+        final = _poll_until_done(
+            frontend.url, "drain-req", min_tokens_then=2, then=drain
+        )
+        assert drained["fired"]
+        # the running request FINISHED on the draining replica, exactly
+        assert final["tokens"] == expected_drain
+        assert final["failovers"] == 0 and final["replica"] == victim
+        assert router.drains_total.value(replica=victim) == 1
+        # its ingest rejects new work with explicit backpressure
+        victim_ingest = next(i for n, _, i in targets if n == victim)
+        status, resp = _http("POST", f"{victim_ingest}/submit", {
+            "request_id": "post-drain", "prompt": [1, 2], "max_new_tokens": 2,
+        })
+        assert status == 503 and resp["error"] == "draining"
+        # the router rebalances the drained session onto the survivor
+        status, resp = _http("POST", f"{frontend.url}/submit", {
+            "request_id": "drain-req-2",
+            "prompt": WORKLOAD[0][0],
+            "max_new_tokens": WORKLOAD[0][1],
+            "session_id": "conv-drain",
+        })
+        assert status == 200 and resp["replica"] == survivor
+        final2 = _poll_until_done(frontend.url, "drain-req-2")
+        assert final2["tokens"] == expected[0]  # parity holds post-drain
+        # undrain restores acceptance
+        status, resp = _http(
+            "POST", f"{frontend.url}/undrain?replica={victim}"
+        )
+        assert status == 200
+        status, resp = _http("POST", f"{victim_ingest}/submit", {
+            "request_id": "post-undrain", "prompt": [1, 2],
+            "max_new_tokens": 2,
+        })
+        assert status == 200 and resp["status"] == "queued"
+    finally:
+        router.stop()
+
+
+def test_router_cli_demo_smoke():
+    """The tier-1 router smoke the acceptance criteria name:
+    ``python -m nxdi_tpu.cli.route --demo 2 --once`` exits 0 — non-zero on
+    any dispatch or failover error."""
+    from nxdi_tpu.cli.route import main
+
+    assert main(["--demo", "2", "--once", "-q"]) == 0

@@ -188,6 +188,70 @@ def test_fleet_metrics_gate_and_skip_when_absent(tmp_path):
     assert rc == 0
 
 
+def test_routed_metrics_gate_and_failover_absolute(tmp_path):
+    """bench.py --serving --replicas N --routed emits routed_* headline
+    fields: one-sided gating, skipped against pre-router baselines, the
+    generic 'value' row suppressed for routed-mode fresh records, and the
+    failover/error counts gated ABSOLUTELY (< 1 — nothing dies in a
+    healthy routed bench, so any failover is a bug, baseline or not)."""
+    routed = {
+        "value": 1.5,
+        "routed_replicas": 2,
+        "routed_goodput_req_s": 1.5,
+        "routed_tok_s": 390.0,
+        "routed_ttft_p50_ms": 260.0,
+        "routed_ttft_p95_ms": 1100.0,
+        "routed_failovers": 0.0,
+        "routed_errors": 0,
+        "routed_drains": 1.0,
+    }
+    # pre-router baseline (decode-mode BASE): every routed_* comparison
+    # skips, the suppressed "value" row cannot fail, and the ABSOLUTE
+    # failover gate still passes at 0
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", routed),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, routed, bench_gate.TOLERANCES)
+    assert "routed_tok_s" in skipped and "routed_ttft_p95_ms" in skipped
+
+    # a single failover fails ABSOLUTELY even against a pre-router baseline
+    failover = dict(routed, routed_failovers=1.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", failover),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 1
+    # an error-finished request too
+    errored = dict(routed, routed_errors=2)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", errored),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 1
+
+    # same-shape baseline: a routed goodput drop beyond tolerance fails...
+    worse = dict(routed, routed_tok_s=320.0, routed_goodput_req_s=1.2)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", worse),
+        "--baseline", _write(tmp_path, "base.json", routed),
+        "-q",
+    ])
+    assert rc == 1
+    # ... in-tolerance noise and a TTFT improvement pass (one-sided)
+    better = dict(routed, routed_ttft_p50_ms=200.0, routed_tok_s=385.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", better),
+        "--baseline", _write(tmp_path, "base.json", routed),
+        "-q",
+    ])
+    assert rc == 0
+
+
 def test_sentinel_overhead_absolute_gate(tmp_path, capsys):
     """sentinel_overhead_pct (bench.py --serving numerics-sentinel smoke)
     gates against the ABSOLUTE < 3% limit on the fresh record alone: it

