@@ -854,7 +854,7 @@ def _flag_value(name, default):
 
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
-    replica_id=None, rng=None, sentinel=None,
+    replica_id=None, rng=None, sentinel=None, mixed=False,
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -890,6 +890,7 @@ def _build_serving_stack(
         slo={"ttft_s": slo_ttft_ms / 1e3, "tpot_s": slo_tpot_ms / 1e3},
         telemetry={"detail": "basic", "replica_id": replica_id},
         sentinel=sentinel,
+        mixed_dispatch=mixed,
     )
     cfg = ml.LlamaInferenceConfig(
         tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
@@ -1062,6 +1063,95 @@ def main_serving(
     print(json.dumps(rec))
     write_metrics_snapshots(
         {"serving": app.telemetry.snapshot()}, metrics_out_path()
+    )
+    return rec
+
+
+def _padding_waste_pct(app) -> float:
+    """Dispatch padding overhead across ALL submodels, from the counters
+    every record_dispatch already feeds: 100 * (padded - real) / padded."""
+    real = app.telemetry.real_tokens_total.total()
+    padded = app.telemetry.padded_tokens_total.total()
+    if padded <= 0:
+        return 0.0
+    return round(100.0 * (padded - real) / padded, 3)
+
+
+def main_mixed_serving(
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+):
+    """``bench.py --serving --mixed-dispatch``: the SAME Poisson workload
+    through the unified mixed prefill+decode engine (TpuConfig(
+    mixed_dispatch=True): one ragged packed dispatch per step) AND the
+    split prefill/decode engine on identical geometry — headline
+    ``mixed_goodput_tok_s`` plus the packing-efficiency pair
+    ``mixed_padding_waste_pct`` / ``unmixed_padding_waste_pct`` from the
+    real/padded token counters every dispatch feeds. The acceptance
+    invariant (packing beats per-phase bucket padding on a mixed workload)
+    is mixed < unmixed; scripts/bench_gate.py gates both headline metrics
+    one-sided against the recorded trajectory."""
+    from nxdi_tpu.serving import SamplingParams, drive_arrivals, goodput_summary
+
+    sides = {}
+    for name, mixed in (("mixed", True), ("unmixed", False)):
+        # identical rng discipline per side: weights THEN arrivals/prompts
+        # from one stream, so both engines see the very same workload
+        rng = np.random.default_rng(0)
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            rng=rng, mixed=mixed,
+        )
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+        prompts = [
+            rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+            .astype(np.int32).tolist()
+            for _ in range(requests)
+        ]
+        outputs, wall = drive_arrivals(
+            engine,
+            arrivals,
+            lambda eng, i, arrival_s: eng.add_request(
+                prompts[i],
+                SamplingParams(max_new_tokens=max_new),
+                arrival_s=arrival_s,
+            ),
+        )
+        sides[name] = (
+            app, goodput_summary(outputs, wall, slo=app.tpu_config.slo)
+        )
+    app, s = sides["mixed"]
+    rec = {
+        "metric": "llama3.2-1b_mixed_serving_goodput",
+        "value": s["tok_s"],
+        "unit": "tok/s",
+        "mixed_goodput_tok_s": s["tok_s"],
+        "mixed_goodput_req_s": s["goodput_req_s"],
+        "mixed_ttft_p95_ms": s["ttft_p95_ms"],
+        "mixed_tpot_p95_ms": s["tpot_p95_ms"],
+        "mixed_padding_waste_pct": _padding_waste_pct(app),
+        "unmixed_padding_waste_pct": _padding_waste_pct(sides["unmixed"][0]),
+        "unmixed_goodput_tok_s": sides["unmixed"][1]["tok_s"],
+        "mixed_preemptions": s["preemptions"],
+        "serving_requests": requests,
+        "serving_arrival_rate_req_s": rate,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged slots{slots} "
+            f"kv{seq_len} prompt~{prompt_len} max_new{max_new} tp1 "
+            "mixed_dispatch"
+        ),
+        "mode": "mixed_dispatch_engine",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"mixed_serving": app.telemetry.snapshot()}, metrics_out_path()
     )
     return rec
 
@@ -1386,7 +1476,9 @@ if __name__ == "__main__":
             slo_tpot_ms=_flag_value("--serving-slo-tpot-ms", 25.0),
         )
         _replicas = _flag_value("--replicas", 1)
-        if "--routed" in sys.argv:
+        if "--mixed-dispatch" in sys.argv:
+            main_mixed_serving(**_serving_kwargs)
+        elif "--routed" in sys.argv:
             main_routed_serving(replicas=max(_replicas, 2), **_serving_kwargs)
         elif _replicas > 1:
             main_fleet_serving(replicas=_replicas, **_serving_kwargs)

@@ -413,6 +413,78 @@ def check_kv_layout(art: ProgramArtifacts) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 6b. mixed prefill+decode dispatch
+# ---------------------------------------------------------------------------
+
+def check_mixed_program(art: ProgramArtifacts) -> List[Finding]:
+    """The mixed-dispatch program packs prefill chunks and decode singles of
+    R slots into one token stream, so its correctness hangs on three ragged
+    row-descriptor inputs reaching the compiled program ALIVE (the kv_layout
+    recipe, via ``kept_var_idx``):
+
+    - ``mixed_row_ids``: per-token slot ownership — a pruned one means the
+      kernel attends every token to every row's KV (cross-request leakage);
+    - ``block_table`` / ``slot_mapping``: the combined R-row pool read and
+      per-token write paths;
+
+    and on the KV cache being donated: the packed program both reads and
+    commits KV in one launch, so a non-donated cache doubles HBM for the
+    largest program in the ladder.
+    """
+    from nxdi_tpu.runtime.model_wrapper import TAG_MIXED
+
+    if art.tag != TAG_MIXED:
+        return []
+    try:
+        example = art.wrapper._example_for_key(art.key)
+    except Exception as e:
+        return [art.finding(
+            "mixed_program",
+            f"example batch unavailable: {type(e).__name__}: {e}",
+            severity="warning",
+        )]
+    keys = sorted(example)  # jax flattens dicts in sorted-key order
+    findings: List[Finding] = []
+    required = ("mixed_row_ids", "block_table", "slot_mapping")
+    missing = [k for k in required if k not in keys]
+    if missing:
+        return [art.finding(
+            "mixed_program",
+            f"mixed program is missing batch input(s) {missing} — the packed "
+            "token stream cannot be attributed to slots or addressed into "
+            "the block pool",
+        )]
+    n_fixed = art.n_param_leaves + len(art.cache_paths)
+    if art.kept_args is None:
+        findings.append(art.finding(
+            "mixed_program",
+            "kept_var_idx unavailable; cannot prove ragged row-descriptor "
+            "liveness", severity="warning",
+        ))
+    else:
+        kept = set(art.kept_args)
+        for k in required:
+            if (n_fixed + keys.index(k)) not in kept:
+                findings.append(art.finding(
+                    "mixed_program",
+                    f"mixed program DROPPED its '{k}' input (pruned by "
+                    "kept_var_idx) — the ragged row descriptors are provably "
+                    "unused, so packed tokens either attend across requests "
+                    "or route KV nowhere",
+                ))
+    if art.donated_flags is not None:
+        for ci, path in enumerate(art.cache_paths):
+            if not art.donated_flags[art.n_param_leaves + ci]:
+                findings.append(art.finding(
+                    "mixed_program",
+                    f"mixed program cache input '{path}' compiled WITHOUT "
+                    "donation — the single-launch read+commit program would "
+                    "hold two cache copies at its largest token bucket",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # 7. LoRA adapter sharding
 # ---------------------------------------------------------------------------
 
@@ -758,6 +830,7 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "baked_constants": check_baked_constants,
     "required_strategies": check_required_strategies,
     "kv_layout": check_kv_layout,
+    "mixed_program": check_mixed_program,
     "lora_sharding": check_lora_sharding,
     "quantized_dtype": check_quantized_dtype,
     "hbm_fit": check_hbm_fit,

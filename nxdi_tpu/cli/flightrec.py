@@ -47,6 +47,11 @@ def setup_flightrec_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--slots", type=int, default=4)
     p.add_argument("--pa-block-size", type=int, default=8)
     p.add_argument("--pa-num-blocks", type=int, default=24)
+    p.add_argument("--mixed-dispatch", action="store_true",
+                   help="drive the unified mixed prefill+decode engine "
+                        "(TpuConfig(mixed_dispatch=True)); the timeline's "
+                        "program column shows the per-step packing split "
+                        "and efficiency")
     p.add_argument("--slo-ttft-ms", type=float, default=None,
                    help="declare a TTFT SLO target (TpuConfig(slo=...)); "
                         "breaches fire postmortem bundles")
@@ -152,8 +157,20 @@ def _print_timeline(records: List[dict], last: int) -> None:
     print("-" * len(hdr))
     for r in shown:
         dec = r["decode"]
+        mixed = r.get("mixed")
         prog = ""
-        if dec is not None:
+        if mixed is not None:
+            # packed mixed dispatch: prefill/decode row split + packing
+            # efficiency (real packed tokens over the padded token bucket)
+            eff = (100.0 * mixed["packed_tokens"] / mixed["padded_tokens"]
+                   if mixed["padded_tokens"] else 0.0)
+            prog = (
+                f"{mixed['submodel']}[{mixed['bucket']}] "
+                f"pf={mixed['prefill_rows']} dec={mixed['decode_rows']} "
+                f"pack={mixed['packed_tokens']}/{mixed['padded_tokens']} "
+                f"({eff:.0f}%)"
+            )
+        elif dec is not None:
             prog = f"{dec['submodel']}[steps={dec['steps']}]"
             if dec["padding_rows"]:
                 prog += f" pad={dec['padding_rows']}"
@@ -202,6 +219,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         pa_num_blocks=args.pa_num_blocks,
         on_device_sampling_config=OnDeviceSamplingConfig(),
     )
+    if args.mixed_dispatch:
+        tpu_kwargs["mixed_dispatch"] = True
     if args.slo_ttft_ms is not None or args.slo_tpot_ms is not None:
         tpu_kwargs["slo"] = {
             "ttft_s": None if args.slo_ttft_ms is None else args.slo_ttft_ms / 1e3,

@@ -55,6 +55,11 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                    default="prefill_first")
     p.add_argument("--chunked-prefill", type=int, default=None, metavar="CHUNK",
                    help="enable chunked prefill with this chunk size")
+    p.add_argument("--mixed-dispatch", action="store_true",
+                   help="unified mixed prefill+decode dispatch "
+                        "(TpuConfig(mixed_dispatch=True)): every engine "
+                        "step packs prefill chunks and decode rows into "
+                        "ONE ragged paged-attention program")
     p.add_argument("--force-preempt", type=int, choices=[0, 1], default=1,
                    help="force one recompute preemption if none occurs "
                         "naturally (default 1: the demo must exercise the "
@@ -212,7 +217,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             "ttft_s": None if args.slo_ttft_ms is None else args.slo_ttft_ms / 1e3,
             "tpot_s": None if args.slo_tpot_ms is None else args.slo_tpot_ms / 1e3,
         }
-    if args.chunked_prefill:
+    if args.mixed_dispatch:
+        tpu_kwargs["mixed_dispatch"] = True
+    if args.chunked_prefill and not args.mixed_dispatch:
+        # under mixed dispatch chunk_size is pure packing policy (the
+        # SchedulerConfig above carries it); no prefix-prefill submodel
         tpu_kwargs["chunked_prefill_config"] = {
             "chunk_size": args.chunked_prefill,
             "kernel_q_tile_size": args.chunked_prefill,

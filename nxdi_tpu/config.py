@@ -695,6 +695,11 @@ class TpuConfig:
             cpc = ChunkedPrefillConfig(**cpc)
         self.chunked_prefill_config = cpc
         self.is_chunked_prefill = cpc is not None
+        # unified mixed prefill+decode dispatch: compile the `mixed` packed
+        # submodel (token-count bucket ladder) and let the serving engine
+        # issue ONE program per step for a batch holding prefill chunks AND
+        # decode rows together (ragged paged-attention kernel / XLA mask)
+        self.mixed_dispatch = kwargs.pop("mixed_dispatch", False)
 
         # --- LoRA (reference: config.py:357-359) ---
         lora = kwargs.pop("lora_config", None)
@@ -1088,6 +1093,11 @@ class TpuConfig:
             raise ValueError("is_prefix_caching requires is_block_kv_layout")
         if self.is_chunked_prefill and not self.is_block_kv_layout:
             raise ValueError("chunked prefill requires is_block_kv_layout")
+        if self.mixed_dispatch and not self.is_block_kv_layout:
+            raise ValueError(
+                "mixed_dispatch requires is_block_kv_layout (the packed rows "
+                "read KV through the paged block tables)"
+            )
 
     # -- (de)serialization (reference: config.py:891-1002) --
     _SUBCONFIGS = {

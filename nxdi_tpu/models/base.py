@@ -736,6 +736,71 @@ def attention_block(
                 "compose with prefix-cached/chunked prefill; disable "
                 "prefix caching for this model"
             )
+        # mixed ragged dispatch (serving one-dispatch step): the packed
+        # token stream carries per-token (row, position) tags and one
+        # combined per-row block table, so prefill chunks and decode rows
+        # share this single attention call — the chunk/fresh rows are
+        # already scattered into the pool (update above), exactly like the
+        # per-row paged paths below
+        mixed_rids = ci.get("mixed_row_ids")
+        if mixed_rids is not None and S > 1:
+            rids = mixed_rids.astype(jnp.int32)  # (1, S); -1 = padding
+            R = ci["last_token_index"].shape[0]  # rows per step (static)
+            bt = ci["block_table"].reshape(R, -1)  # (R, Wt) per-row tables
+            if (
+                isinstance(layout, BlockKVLayout)
+                and arch.v_head_dim is None
+                and arch.attn_kernel_enabled
+                and ci.get("attn_mask") is None
+                and ci.get("write_positions") is None
+                and not arch.attention_sink
+                and arch.attn_logit_softcap is None
+                and arch.sliding_window is None
+                and arch.chunk_size is None
+                and window_enabled is None
+                and use_rope is None
+                and attn_kernels.ragged_paged_kernel_supported(
+                    q.shape, new_k.shape, layout.block_size
+                )
+            ):
+                ctx = attn_kernels.sharded_ragged_paged_call(
+                    policy, q, new_k, new_v, bt, rids[0], position_ids[0],
+                    block_size=layout.block_size,
+                    scale=arch.attention_scale,
+                    k_scale=layout.k_scale,
+                    v_scale=layout.v_scale,
+                )
+                if ctx is not None:
+                    _record_strategy("mixed_ragged_kernel")
+                    ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * D)
+                    out = _o_proj(ctx)
+                    return out, (new_k, new_v)
+            # XLA fallback: gather the combined window and rebuild the
+            # ragged causal mask from the token tags — kv col g serves row
+            # g // row_width at in-row position g % row_width; holes carry
+            # the layout's poisoned 2**30 position
+            kk, vv, kv_pos = layout.read(new_k, new_v, ci, cache_spec)
+            kk = constrain(kk, policy.cache_kv)
+            vv = constrain(vv, policy.cache_kv)
+            W = kk.shape[2]
+            row_width = W // R
+            g = jnp.arange(W, dtype=jnp.int32)
+            kv_row = g // row_width
+            kv_in = g % row_width
+            live = kv_pos[0] < jnp.int32(2 ** 30)
+            mask = (
+                (rids[:, :, None] == kv_row[None, None, :])
+                & (kv_in[None, None, :] <= position_ids[:, :, None])
+                & live[None, None, :]
+            )
+            _record_strategy("mixed_ragged_xla")
+            ctx = attn_ops.grouped_attention(
+                q, kk, vv, mask,
+                scale=arch.attention_scale, softmax_dtype=jnp.float32,
+            )
+            ctx = jnp.swapaxes(ctx, 1, 2).reshape(B, S, H * Dv)
+            out = _o_proj(ctx)
+            return out, (new_k, new_v)
         # prefix-cache / chunked-prefill CTE through the block table: the
         # chunk is already scattered into the pool (update above), so the
         # kernel reads prefix + chunk in token order without materializing
@@ -1785,7 +1850,8 @@ def run_decoder_layers(
 # BlockKVLayout / WindowKVLayout .get what they need); single source of truth
 # for causal_lm_forward and the custom family forwards (e.g. mimo_v2)
 CACHE_INPUT_KEYS = ("seq_ids", "slot_mapping", "block_table",
-                    "write_positions", "attn_mask", "last_token_index")
+                    "write_positions", "attn_mask", "last_token_index",
+                    "mixed_row_ids")
 
 
 def collect_cache_inputs(batch: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
@@ -1819,12 +1885,19 @@ def causal_lm_forward(
     image_token_id: Optional[int] = None,
     tensor_capture: Optional[Tuple[str, ...]] = None,
     tensor_replacement: Optional[Tuple[str, ...]] = None,
+    mixed_rows: bool = False,
 ) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
     """One submodel forward (reference: model_base.py:713 NeuronBaseModel.forward).
 
     ``batch`` keys: input_ids (B,S) i32, position_ids (B,S) i32,
     last_token_index (B,) i32, sampling_params (B,3) f32, rng key.
     Returns (outputs, new_cache); outputs has "tokens" and/or "logits".
+
+    ``mixed_rows`` (the serving engine's one-dispatch mixed step): the batch
+    dim is 1 and the scheduler's ROWS live along the packed token axis,
+    tagged by ``mixed_row_ids``; ``last_token_index`` is (R,) packed indices
+    of each row's newest token and ``sampling_params`` is (R, 3), so the
+    lm_head/sampling tail runs with R as its batch dim.
     """
     from nxdi_tpu.config import to_jax_dtype
 
@@ -2030,7 +2103,13 @@ def causal_lm_forward(
     if lm_head is None:  # tied embeddings
         lm_head = jnp.swapaxes(params["embed_tokens"], 0, 1)
 
-    if gather_last_token and not output_all_logits:
+    if mixed_rows:
+        # packed mixed stream: gather each ROW's newest token off the single
+        # packed batch row — everything below (lm_head, stats, sampling)
+        # sees (R, 1, hidden) exactly like an R-row decode batch
+        idx = batch["last_token_index"].astype(jnp.int32)  # (R,)
+        hidden = jnp.take(hidden[0], idx, axis=0)[:, None, :]
+    elif gather_last_token and not output_all_logits:
         idx = batch["last_token_index"][:, None, None]  # (B,1,1)
         hidden = jnp.take_along_axis(
             hidden, jnp.broadcast_to(idx, (hidden.shape[0], 1, hidden.shape[2])), axis=1

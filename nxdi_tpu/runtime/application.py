@@ -37,8 +37,10 @@ from nxdi_tpu.parallel.mesh import mesh_from_config
 from nxdi_tpu.runtime import autobucketing
 from nxdi_tpu.runtime.model_wrapper import (
     TAG_CONTEXT_ENCODING,
+    TAG_MIXED,
     TAG_TOKEN_GENERATION,
     TAG_TOKEN_GENERATION_MULTISTEP,
+    MixedModelWrapper,
     ModelWrapper,
     MultiStepTKGWrapper,
 )
@@ -662,6 +664,44 @@ class TpuModelForCausalLM(ApplicationBase):
                 ),
                 extra_inputs=tr_extra,
             )
+        if tc.mixed_dispatch:
+            # unified mixed prefill+decode dispatch: one program per
+            # TOTAL-packed-token bucket serves a whole serving step (prefill
+            # chunks + decode singles in one flat stream) through the ragged
+            # paged-attention kernel (ops/kernels/ragged_paged_attention)
+            mixed_kwargs = dict(sampling_kwargs)
+            # rows enter and leave the packed batch between steps, so the
+            # next step is always host-assembled — the device-resident
+            # next_inputs chain assumes the per-row (B,) contract
+            mixed_kwargs.pop("return_next_inputs", None)
+            self.models[TAG_MIXED] = MixedModelWrapper(
+                TAG_MIXED,
+                self.config,
+                arch,
+                inv_freq,
+                batch_size=1,
+                n_active_tokens=0,  # bucket-determined (packed token count)
+                buckets=autobucketing.mixed_token_buckets(self.config),
+                attend_to_cache=True,
+                prefill_to_cache=True,
+                num_rows=tc.tkg_batch_size,
+                forward_kwargs=dict(
+                    gather_last_token=True,
+                    mixed_rows=True,
+                    output_logits=tc.output_logits,
+                    on_device_sampling=on_device_sampling,
+                    **mixed_kwargs,
+                ),
+                extra_inputs=dict(tr_extra),
+            )
+            if self.telemetry.enabled:
+                self.telemetry.seed_mixed_buckets(
+                    self.models[TAG_MIXED].buckets
+                )
+
+    @property
+    def mixed_supported(self) -> bool:
+        return TAG_MIXED in self.models
 
     # -- dispatch (reference: model_base.py:3606 _get_model_outputs) --
     def forward(

@@ -107,6 +107,27 @@ def prefix_prefill_buckets(config) -> List[int]:
     return context_encoding_buckets(config)
 
 
+def mixed_token_buckets(config) -> List[int]:
+    """TOTAL-packed-token ladder for the ``mixed`` submodel (one-dispatch
+    prefill+decode serving step): rungs count tokens across the WHOLE packed
+    batch — not per-row sequence lengths — because the packed program's only
+    shape dim is the flat token stream. The top rung must hold the largest
+    step the scheduler can pack: one full prefill contribution (a chunk when
+    chunked prefill is on, else a whole max-length prompt) plus one decode
+    token for every slot.
+
+    The ladder bottoms out at 2, NOT at the 16/128 floor the per-phase
+    ladders use: a decode-only step packs exactly one token per live slot,
+    so without fine rungs every such step would burn a 16-token program on
+    R<=8 real tokens — worse padding than the split decode path it
+    replaces. Small rungs are cheap programs; they are what lets the
+    packed ladder beat per-phase padding on ramp-up and drain-tail steps
+    where only a few slots are live."""
+    tc = config.tpu_config
+    top = tc.max_context_length + tc.tkg_batch_size
+    return generate_buckets(min(2, top), top)
+
+
 def multistep_step_ladder(max_steps: int) -> List[int]:
     """Step-count rungs for the multi-step decode submodel (``tkg_multistep``):
     powers of two from 2 with the configured K as the last rung, e.g. K=8 ->

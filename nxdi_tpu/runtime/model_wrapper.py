@@ -188,6 +188,7 @@ TAG_TOKEN_GENERATION_MULTISTEP = "tkg_multistep"
 TAG_SPECULATION = "speculation_model"
 TAG_FUSED_SPECULATION = "fused_speculation_model"
 TAG_MEDUSA_SPECULATION = "medusa_speculation_model"
+TAG_MIXED = "mixed_model"
 
 # fixed width of the multi-step decode program's eos_token_ids input (HF eos
 # lists are ints or short lists; the host falls back to 1-step decode beyond)
@@ -210,8 +211,16 @@ def decode_window_limit(tpu_config, models) -> int:
     seq_len (shared by the host decode loops that clamp retirement).
 
     A prefill-only app (no cache-attending submodel) is limited by seq_len
-    alone — guarded explicitly because ``min(x, *())`` is a TypeError."""
-    tops = [w.buckets[-1] for w in models.values() if w.attend_to_cache]
+    alone — guarded explicitly because ``min(x, *())`` is a TypeError.
+
+    Wrappers whose buckets are NOT KV windows (``window_buckets = False``,
+    i.e. the mixed wrapper's total-packed-token ladder) are excluded: their
+    rungs say nothing about how much KV a program can attend."""
+    tops = [
+        w.buckets[-1]
+        for w in models.values()
+        if w.attend_to_cache and getattr(w, "window_buckets", True)
+    ]
     return min([tpu_config.seq_len, *tops])
 
 
@@ -638,16 +647,22 @@ class ModelWrapper:
                 real_tokens=orig_b * s,
                 padded_tokens=self.batch_size * pad_s,
             )
-        outputs = {
-            k: (v if k in ("next_inputs", "captured") else v[:orig_b])
-            for k, v in outputs.items()
-        }
+        outputs = self._slice_batch_padding(outputs, orig_b)
         if tel is not None and tel.sentinel is not None and "logit_stats" in outputs:
             # numerics sentinel: the compiled-in (B, 5) health readout is
             # recorded AFTER batch-padding rows are sliced away (padding
             # repeats row 0 — double-counting it would skew the series)
             tel.sentinel.observe(self.tag, bucket, outputs["logit_stats"])
         return outputs, new_cache
+
+    def _slice_batch_padding(self, outputs, orig_b: int):
+        """Drop batch-padding rows from per-row outputs. The mixed wrapper
+        overrides this with a no-op: its compiled batch dim is always 1 (the
+        packed token stream) while its outputs lead with the R slot dim."""
+        return {
+            k: (v if k in ("next_inputs", "captured") else v[:orig_b])
+            for k, v in outputs.items()
+        }
 
     def _layout_inputs(
         self, batch_np, b: int, s: int, pad_s: int, position_ids
@@ -859,3 +874,92 @@ class MultiStepTKGWrapper(ModelWrapper):
             for batch in super().warmup_batches():
                 batch["decode_steps"] = steps
                 yield batch
+
+
+class MixedModelWrapper(ModelWrapper):
+    """The ``mixed_model`` submodel: ONE program serving a whole mixed
+    prefill+decode serving step (ops/kernels/ragged_paged_attention).
+
+    Shape contract (R = scheduler slots = tkg_batch_size, T = token bucket):
+      - input_ids / position_ids (1, T): the flat packed token stream —
+        prefill chunks and decode singles concatenated, -1-row padded tail
+      - ``mixed_row_ids`` (1, T) int32: per-token slot index, -1 = padding
+      - ``slot_mapping`` (1, T): per-token KV pool slot, HOST-computed per
+        row (the generic position-derived path indexes the COMBINED table
+        and is wrong here — forward() refuses to derive)
+      - ``block_table`` (1, R*Wt): R per-row tables concatenated; idle
+        slots all -1
+      - ``last_token_index`` (R,): packed index of each row's newest token
+      - ``sampling_params`` (R, 3); outputs["tokens"] (R, 1)
+
+    Buckets count TOTAL packed tokens (autobucketing.mixed_token_buckets),
+    not KV windows — ``window_buckets = False`` keeps them out of
+    ``decode_window_limit``.
+    """
+
+    window_buckets = False
+
+    def __init__(self, *args, num_rows: int, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.num_rows = num_rows
+        self.extra_inputs.setdefault("mixed_row_ids", ((-1,), np.int32))
+
+    def example_batch(self, bucket: int):
+        batch = super().example_batch(bucket)
+        R = self.num_rows
+        batch["last_token_index"] = jax.ShapeDtypeStruct((R,), jnp.int32)
+        batch["sampling_params"] = jax.ShapeDtypeStruct((R, 3), jnp.float32)
+        batch["block_table"] = jax.ShapeDtypeStruct(
+            (1, R * self._block_table_width()), jnp.int32
+        )
+        return batch
+
+    def forward(self, params, cache, batch_np):
+        batch_np = dict(batch_np)
+        if "slot_mapping" not in batch_np:
+            # the base derive path maps position -> combined-table entry,
+            # which aliases every row onto row 0's pages — never legal here
+            raise ValueError(
+                f"{self.tag}: mixed dispatch requires a host-computed "
+                "slot_mapping (per-token, through each row's own table)"
+            )
+        s = int(np.asarray(batch_np["input_ids"]).shape[1])
+        bucket = self.select_bucket(s)
+        # pre-pad the row tags with -1 BEFORE the generic extra-input pad:
+        # np.pad's zero fill would tag padding tokens as row 0
+        rids = np.asarray(batch_np["mixed_row_ids"], dtype=np.int32)
+        if rids.ndim == 1:
+            rids = rids[None, :]
+        if rids.shape[1] < bucket:
+            rids = np.concatenate(
+                [rids, np.full((rids.shape[0], bucket - rids.shape[1]), -1, np.int32)],
+                axis=1,
+            )
+        batch_np["mixed_row_ids"] = rids
+        out = super().forward(params, cache, batch_np)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.record_mixed(bucket, packed_tokens=s, padded_tokens=bucket)
+        return out
+
+    def _slice_batch_padding(self, outputs, orig_b: int):
+        # the packed batch dim is always exactly 1; outputs lead with the R
+        # slot dim (tokens (R, 1), logit_stats (R, 5)) — never slice them
+        return outputs
+
+    def warmup_batches(self):
+        R = self.num_rows
+        wt = self._block_table_width()
+        for bucket in self.buckets:
+            # all -1: no KV writes, all-masked attention (finite — NEG_INF
+            # is a large negative constant, so fully-masked rows softmax to
+            # uniform garbage the last-token gather never reads)
+            yield {
+                "input_ids": np.zeros((1, bucket), dtype=np.int32),
+                "position_ids": np.tile(np.arange(bucket, dtype=np.int32), (1, 1)),
+                "last_token_index": np.zeros((R,), dtype=np.int32),
+                "sampling_params": np.tile([1.0, 1.0, 1.0], (R, 1)).astype(np.float32),
+                "mixed_row_ids": np.full((1, bucket), -1, dtype=np.int32),
+                "slot_mapping": np.full((1, bucket), -1, dtype=np.int32),
+                "block_table": np.full((1, R * wt), -1, dtype=np.int32),
+            }

@@ -56,12 +56,18 @@ from nxdi_tpu.runtime.block_manager import BlockSpaceManager
 from nxdi_tpu.runtime.model_wrapper import (
     MULTISTEP_EOS_SLOTS,
     TAG_CONTEXT_ENCODING,
+    TAG_MIXED,
     TAG_TOKEN_GENERATION,
     TAG_TOKEN_GENERATION_MULTISTEP,
     decode_window_limit,
 )
 from nxdi_tpu.ops.sampling import StepRngSchedule, extract_next_tokens
-from nxdi_tpu.serving.request import Request, RequestOutput, SamplingParams
+from nxdi_tpu.serving.request import (
+    RUNNING,
+    Request,
+    RequestOutput,
+    SamplingParams,
+)
 from nxdi_tpu.serving.scheduler import Scheduler, SchedulerConfig
 
 logger = logging.getLogger("nxdi_tpu")
@@ -136,9 +142,20 @@ class InferenceEngine:
             if self.paged
             else None
         )
+        # unified mixed dispatch: the whole step (prefill chunks + decode
+        # rows) rides ONE packed mixed_model program; requires the app to
+        # have compiled the submodel (TpuConfig(mixed_dispatch=True))
+        self.mixed = bool(getattr(tc, "mixed_dispatch", False)) and getattr(
+            app, "mixed_supported", False
+        )
+        self._mixed = app.models[TAG_MIXED] if self.mixed else None
         if cfg.chunk_size is None and tc.chunked_prefill_config is not None:
             cfg.chunk_size = tc.chunked_prefill_config.chunk_size
-        if cfg.chunk_size is not None and TAG_PREFIX_PREFILL not in app.models:
+        if (
+            cfg.chunk_size is not None
+            and TAG_PREFIX_PREFILL not in app.models
+            and not self.mixed
+        ):
             # without a continuation submodel every multi-chunk prompt would
             # error-finish at its second chunk — even ones a single ordinary
             # CTE pass could have served; fail the misconfiguration loudly
@@ -254,7 +271,10 @@ class InferenceEngine:
         if (
             len(req.prompt) > tc.max_context_length
             and self.scheduler.config.chunk_size is None
+            and not self.mixed
         ):
+            # mixed dispatch chunks inherently: any prompt too big for the
+            # packed bucket budget simply continues next step
             raise ValueError(
                 f"prompt length {len(req.prompt)} exceeds max_context_length "
                 f"{tc.max_context_length} and chunked prefill is not "
@@ -296,15 +316,45 @@ class InferenceEngine:
         return self.scheduler.has_work()
 
     def step(self) -> List[RequestOutput]:
-        """One engine iteration: prefill work, then one batched decode.
-        Returns the requests that FINISHED during this step. With the
-        flight recorder enabled every iteration journals one StepRecord
-        (admissions, prefill chunks, the decode dispatch, preemptions,
-        retirements, KV level, host-vs-dispatch time split)."""
+        """One engine iteration. Split dispatch (default): prefill work,
+        then one batched decode. Mixed dispatch (``mixed_dispatch``): the
+        step's prefill chunks AND decode rows ride ONE packed
+        ``mixed_model`` program. Returns the requests that FINISHED during
+        this step. With the flight recorder enabled every iteration
+        journals one StepRecord (admissions, prefill chunks, the decode or
+        mixed dispatch, preemptions, retirements, KV level,
+        host-vs-dispatch time split)."""
         fl = self.flight
         if fl is not None:
             fl.begin_step()
         finished: List[RequestOutput] = []
+        if self.mixed:
+            self._step_mixed(finished)
+        else:
+            self._step_split(finished)
+        self.scheduler.publish()
+        if fl is not None:
+            fl.end_step(
+                self.scheduler.queue_depth,
+                self.scheduler.slots_busy,
+                self.block_manager.num_free_blocks()
+                if self.block_manager is not None else None,
+            )
+            # SLO-breach postmortems fire AFTER end_step so the bundle's
+            # timeline includes the step the breaching request finished in
+            pending, self._pending_breaches = self._pending_breaches, []
+            for req, kinds in pending:
+                fl.postmortem(
+                    "slo_breach",
+                    detail={"kinds": kinds},
+                    request_span=req.span,
+                    request_id=req.request_id,
+                )
+        return finished
+
+    def _step_split(self, finished: List[RequestOutput]) -> None:
+        """The classic two-phase step: per-request prefill dispatches, then
+        one batched decode dispatch."""
         preempted: List[Request] = []
         prefills = self.scheduler.schedule_prefills()
         for req in prefills:
@@ -327,25 +377,144 @@ class InferenceEngine:
         # what lets the NEXT step admit) — only a true no-op step may trip
         # the stall guard in run()
         self._progress = bool(prefills) or bool(rows) or bool(preempted)
-        self.scheduler.publish()
-        if fl is not None:
-            fl.end_step(
-                self.scheduler.queue_depth,
-                self.scheduler.slots_busy,
-                self.block_manager.num_free_blocks()
-                if self.block_manager is not None else None,
-            )
-            # SLO-breach postmortems fire AFTER end_step so the bundle's
-            # timeline includes the step the breaching request finished in
-            pending, self._pending_breaches = self._pending_breaches, []
-            for req, kinds in pending:
-                fl.postmortem(
-                    "slo_breach",
-                    detail={"kinds": kinds},
-                    request_span=req.span,
-                    request_id=req.request_id,
+
+    def _step_mixed(self, finished: List[RequestOutput]) -> None:
+        """One-dispatch mixed step: pack this step's prefill chunks and
+        every decode row into ONE flat token stream and serve it with a
+        single ``mixed_model`` dispatch (the ragged paged-attention
+        program). Chunking IS the packing policy — whatever part of a
+        prompt does not fit the remaining bucket budget continues next
+        step — so chunked prefill needs no separate admission path and no
+        prefix-prefill submodel."""
+        tc = self.tpu_config
+        preempted: List[Request] = []
+        prefills = self.scheduler.schedule_prefills()
+        rows = self.scheduler.decodable()
+        if rows:
+            # grow every decode row's table BEFORE packing: a preemption
+            # must evict its victim from THIS step's packed batch, never
+            # fault mid-dispatch. The victim may be a request admitted just
+            # above — the state filter below drops it from the pack.
+            rows, preempted = self.scheduler.ensure_decode_capacity(rows)
+            for victim in preempted:
+                logger.info(
+                    "preempted request %d (recompute on re-admission)",
+                    victim.request_id,
                 )
-        return finished
+        prefills = [r for r in prefills if r.state == RUNNING]
+
+        w = self._mixed
+        budget = w.buckets[-1] - len(rows)  # decode singles ride along
+        limit = self.scheduler.config.chunk_size or tc.max_context_length
+        tokens: List[int] = []
+        positions: List[int] = []
+        row_ids: List[int] = []
+        packed_prefills: List[Tuple[Request, int]] = []  # (req, chunk len)
+        for req in prefills:
+            room = min(limit, budget)
+            if room <= 0:
+                continue  # bucket full; this chunk continues next step
+            start = req.num_prefilled
+            chunk = req.seq_tokens[: req.prefill_target][start : start + room]
+            if not chunk:
+                continue
+            tokens.extend(chunk)
+            positions.extend(range(start, start + len(chunk)))
+            row_ids.extend([req.slot] * len(chunk))
+            packed_prefills.append((req, len(chunk)))
+            budget -= len(chunk)
+        for slot, req in rows:
+            tokens.append(req.generated[-1])
+            positions.append(req.total_len - 1)
+            row_ids.append(slot)
+
+        self._progress = bool(packed_prefills) or bool(rows) or bool(preempted)
+        if not tokens:
+            return
+
+        R = tc.tkg_batch_size
+        wt = self._table_width
+        bs = tc.pa_block_size
+        total = len(tokens)
+        bt = np.full((R, wt), -1, dtype=np.int32)
+        lti = np.zeros((R,), dtype=np.int32)
+        params_rows: List[Optional[SamplingParams]] = [None] * R
+        tables: Dict[int, np.ndarray] = {}
+        by_slot: Dict[int, Request] = {req.slot: req for req, _ in packed_prefills}
+        by_slot.update({slot: req for slot, req in rows})
+        for slot, req in by_slot.items():
+            table = np.asarray(
+                self.block_manager.block_table(req.request_id, wt),
+                dtype=np.int32,
+            )
+            tables[slot] = table
+            bt[slot] = table
+            params_rows[slot] = req.params
+        sm = np.empty((total,), dtype=np.int32)
+        for t, (slot, p) in enumerate(zip(row_ids, positions)):
+            entry = int(tables[slot][p // bs])
+            sm[t] = entry * bs + p % bs if entry >= 0 else -1
+            lti[slot] = t  # per-row tokens are packed ascending: last wins
+
+        kwargs: Dict[str, np.ndarray] = {
+            "block_table": bt.reshape(1, R * wt),
+            "slot_mapping": sm[None, :],
+            "mixed_row_ids": np.asarray(row_ids, dtype=np.int32)[None, :],
+        }
+        if w.needs_rng:
+            kwargs["rng"] = self._rng.next()
+        bucket = w.select_bucket(total)
+        if self.flight is not None:
+            self.flight.record_mixed(
+                TAG_MIXED, bucket, len(packed_prefills), len(rows),
+                total, bucket,
+            )
+            for req, n in packed_prefills:
+                self.flight.record_prefill(
+                    req.request_id, req.slot, TAG_MIXED, req.num_prefilled, n
+                )
+        clock = self.telemetry.clock if self.telemetry is not None else None
+        t0 = clock() if clock else 0.0
+        out = self.app.forward(
+            np.asarray(tokens, dtype=np.int32)[None, :],
+            np.asarray(positions, dtype=np.int32)[None, :],
+            last_token_index=lti,
+            sampling_params=SamplingParams.rows_tensor(
+                [p if p is not None else SamplingParams() for p in params_rows]
+            ),
+            submodel=TAG_MIXED,
+            **kwargs,
+        )
+        toks = self._tokens_of(out)  # (R,): one per slot; idle rows garbage
+        dt = (clock() - t0) if clock else None
+
+        for req, n in packed_prefills:
+            req.num_prefilled += n
+            if not req.prefill_done:
+                continue  # more chunks next step; decodes keep interleaving
+            if (
+                self.sentinel is not None
+                and self.sentinel.config.preemption_check
+                and req.preemptions > 0
+                and req.generated
+            ):
+                # preemption-replay invariant, same as the split path
+                self.sentinel.verify_replay(req, "preemption")
+            if req.span is not None:
+                req.span.first_token()
+                req.span.phase("decode")
+                req.span.tokens(1)
+            req.emit(int(toks[req.slot]))
+            reason = req.check_finish()
+            if reason:
+                self._finish(req, reason, finished)
+        for slot, req in rows:
+            if req.span is not None:
+                req.span.tokens(1, dt)
+            req.emit(int(toks[slot]))
+            reason = req.check_finish()
+            if reason:
+                self._finish(req, reason, finished)
 
     def run(self, max_steps: Optional[int] = None) -> List[RequestOutput]:
         """Step until every queued request finishes; returns all outputs."""

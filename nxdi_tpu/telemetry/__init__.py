@@ -28,6 +28,8 @@ Metric catalog (labels in parens):
 ``nxdi_padding_waste_ratio``          histogram  (submodel)
 ``nxdi_real_tokens_total``            counter    (submodel)
 ``nxdi_padded_tokens_total``          counter    (submodel)
+``nxdi_mixed_packed_tokens``          gauge      (bucket)
+``nxdi_mixed_padding_waste``          gauge      (bucket)
 ``nxdi_requests_total``               counter
 ``nxdi_request_seconds``              histogram
 ``nxdi_request_ttft_seconds``         histogram
@@ -264,6 +266,22 @@ class Telemetry:
             "nxdi_padded_tokens_total",
             "tokens actually computed after bucket/batch padding", ("submodel",),
         )
+        # mixed one-dispatch serving (runtime/model_wrapper.MixedModelWrapper):
+        # last-seen packing per token-bucket rung — how full the packed
+        # stream ran and what fraction of the rung was padding. Gauges (not
+        # histograms) because the ladder is small and the flight recorder
+        # already keeps the per-step series; pre-seeded zero per rung at app
+        # registration (seed_mixed_buckets) so an idle rung is observable.
+        self.mixed_packed_tokens = r.gauge(
+            "nxdi_mixed_packed_tokens",
+            "real packed tokens in the last mixed dispatch per bucket rung",
+            ("bucket",),
+        )
+        self.mixed_padding_waste = r.gauge(
+            "nxdi_mixed_padding_waste",
+            "(bucket - packed) / bucket of the last mixed dispatch per rung",
+            ("bucket",),
+        )
         self.requests_total = r.counter(
             "nxdi_requests_total", "finished generation requests"
         )
@@ -399,6 +417,25 @@ class Telemetry:
             self.padded_tokens_total.inc(padded_tokens, submodel=submodel)
             self.padding_waste.observe(
                 (padded_tokens - real_tokens) / padded_tokens, submodel=submodel
+            )
+
+    def seed_mixed_buckets(self, buckets) -> None:
+        """Pre-seed the mixed packing gauges with a zero per token-bucket
+        rung (application registration time): a scrape distinguishes "rung
+        never dispatched" from "metric not recorded"."""
+        if not self.enabled:
+            return
+        for b in buckets:
+            self.mixed_packed_tokens.set(0.0, bucket=str(b))
+            self.mixed_padding_waste.set(0.0, bucket=str(b))
+
+    def record_mixed(self, bucket, packed_tokens: int, padded_tokens: int) -> None:
+        """One mixed dispatch's packing efficiency (MixedModelWrapper)."""
+        labels = dict(bucket=str(bucket))
+        self.mixed_packed_tokens.set(float(packed_tokens), **labels)
+        if padded_tokens:
+            self.mixed_padding_waste.set(
+                (padded_tokens - packed_tokens) / padded_tokens, **labels
             )
 
     def start_request(self, tokens_in: int = 0, t_start=None,

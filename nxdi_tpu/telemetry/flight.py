@@ -62,8 +62,8 @@ class StepRecord:
 
     __slots__ = (
         "step", "t_start", "t_end", "admitted", "prefills", "decode",
-        "preempted", "retired", "programs", "kv_blocks_free", "queue_depth",
-        "slots_busy", "dispatch_s", "host_s",
+        "mixed", "preempted", "retired", "programs", "kv_blocks_free",
+        "queue_depth", "slots_busy", "dispatch_s", "host_s",
     )
 
     def __init__(self, step: int, t_start: float):
@@ -76,6 +76,11 @@ class StepRecord:
         self.prefills: List[dict] = []
         #: {submodel, steps, rows: [{slot, request_id}], batch, padding_rows}
         self.decode: Optional[dict] = None
+        #: one-dispatch mixed step (mixed_dispatch): {submodel, bucket,
+        #: prefill_rows, decode_rows, packed_tokens, padded_tokens} — the
+        #: prefill/decode split is what cli.flightrec renders as packing
+        #: efficiency
+        self.mixed: Optional[dict] = None
         #: [{request_id, slot}] — slot is the row the victim vacated
         self.preempted: List[dict] = []
         #: [{request_id, slot, reason}]
@@ -109,6 +114,7 @@ class StepRecord:
             "admitted": list(self.admitted),
             "prefills": list(self.prefills),
             "decode": self.decode,
+            "mixed": self.mixed,
             "preempted": list(self.preempted),
             "retired": list(self.retired),
             "programs": [
@@ -251,6 +257,27 @@ class FlightRecorder:
                 ],
                 "batch": batch,
                 "padding_rows": batch - len(rows),
+            }
+
+    def record_mixed(
+        self,
+        submodel: str,
+        bucket: int,
+        prefill_rows: int,
+        decode_rows: int,
+        packed_tokens: int,
+        padded_tokens: int,
+    ) -> None:
+        """One unified mixed prefill+decode dispatch (mixed_dispatch): row
+        split + packing so timelines show how full the packed stream ran."""
+        if self.current is not None:
+            self.current.mixed = {
+                "submodel": submodel,
+                "bucket": int(bucket),
+                "prefill_rows": int(prefill_rows),
+                "decode_rows": int(decode_rows),
+                "packed_tokens": int(packed_tokens),
+                "padded_tokens": int(padded_tokens),
             }
 
     def record_preemption(self, request_id, slot) -> None:

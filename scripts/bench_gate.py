@@ -84,6 +84,15 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "routed_tok_s": ("higher", 0.07),
     "routed_ttft_p50_ms": ("lower", 0.12),
     "routed_ttft_p95_ms": ("lower", 0.18),
+    # mixed-dispatch headline fields (bench.py --serving --mixed-dispatch;
+    # PR: unified mixed prefill+decode dispatch). One-sided, skipped
+    # against pre-mixed baselines (missing on a side). Padding waste is a
+    # packing-efficiency share of dispatched tokens: it regresses when the
+    # token-bucket ladder or the packer fragments, and gets a wider
+    # tolerance than goodput because one awkward arrival pattern can shift
+    # a bucket rung.
+    "mixed_goodput_tok_s": ("higher", 0.07),
+    "mixed_padding_waste_pct": ("lower", 0.15),
 }
 
 #: metric -> (direction, absolute limit) checked on the FRESH record alone —
@@ -201,7 +210,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     tolerances = dict(TOLERANCES)
     if any(k in fresh for k in ("serving_goodput_req_s",
                                 "fleet_goodput_req_s",
-                                "routed_goodput_req_s")):
+                                "routed_goodput_req_s",
+                                "mixed_goodput_tok_s")):
         # a serving-, fleet-, or routed-mode FRESH record duplicates its
         # "value" headline as serving_/fleet_/routed_goodput_req_s (which
         # carry their own tolerances), and against a decode-mode baseline

@@ -1,0 +1,214 @@
+"""Unified mixed prefill+decode dispatch (TpuConfig(mixed_dispatch=True)).
+
+The acceptance anchors from the mixed-dispatch issue:
+
+- with mixed dispatch ON, ``InferenceEngine.step()`` issues exactly ONE
+  model dispatch for a step holding both prefill and decode rows (asserted
+  through the dispatch-count telemetry, not by inspection);
+- greedy engine output stays TOKEN-IDENTICAL to per-prompt static
+  ``generate`` — with mixed dispatch ON and OFF — across interleaved
+  arrivals, forced and natural (pool-exhaustion) preemption, and chunked
+  prefill (which under mixed dispatch is just the packing policy, needing
+  no prefix-prefill submodel).
+"""
+
+import numpy as np
+
+from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.runtime.model_wrapper import TAG_MIXED
+from nxdi_tpu.serving import InferenceEngine, SamplingParams, SchedulerConfig
+from nxdi_tpu.utils.accuracy import hf_greedy_generate as hf_greedy
+
+P0 = [5, 9, 3, 17, 2, 8, 11, 42]
+P1 = [7, 13, 21, 4, 33]
+P2 = [9, 9, 2, 40, 17, 3]
+
+
+def _build_app(hf_model, hf_cfg, **tcfg_kwargs):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    defaults = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        telemetry="basic",
+    )
+    defaults.update(tcfg_kwargs)
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(**defaults), load_config=lambda: hf_cfg.to_dict()
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app
+
+
+def _mixed_app(hf_model, hf_cfg, **kw):
+    defaults = dict(
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=32,
+        ctx_batch_size=1, tkg_batch_size=3, mixed_dispatch=True,
+    )
+    defaults.update(kw)
+    return _build_app(hf_model, hf_cfg, **defaults)
+
+
+def _expected(hf_model, prompt, n):
+    return hf_greedy(hf_model, np.array([prompt]), n)[0, len(prompt):].tolist()
+
+
+def test_mixed_one_dispatch_and_parity_interleaved(tiny_hf_llama):
+    """Interleaved arrivals: every stream token-identical to static
+    generate, and a step serving prefill+decode together issues exactly
+    ONE dispatch (the mixed program)."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _mixed_app(hf_model, hf_cfg)
+    assert app.mixed_supported
+    engine = InferenceEngine(app, SchedulerConfig(num_slots=3))
+    assert engine.mixed
+
+    r0 = engine.add_request(P0, SamplingParams(max_new_tokens=10))
+    outs = engine.step()  # r0 prefills alone
+    assert r0.prefill_done and len(r0.generated) == 1
+
+    # r1 arrives mid-flight: the next step packs r1's WHOLE prefill AND
+    # r0's decode row into one program — count dispatches across ALL
+    # submodels to prove nothing else ran
+    r1 = engine.add_request(P1, SamplingParams(max_new_tokens=12))
+    disp = app.telemetry.dispatches_total
+    before = disp.total()
+    outs += engine.step()
+    assert disp.total() - before == 1.0, (
+        "a mixed prefill+decode step must be exactly one dispatch"
+    )
+    # the flight recorder journals the packing split for the step
+    rec = engine.flight.snapshot_records()[-1]
+    assert rec.mixed is not None
+    assert rec.mixed["prefill_rows"] == 1 and rec.mixed["decode_rows"] == 1
+    assert rec.mixed["packed_tokens"] == len(P1) + 1
+    bucket = str(rec.mixed["bucket"])
+    assert disp.value(
+        submodel=TAG_MIXED, bucket=bucket, steps="1"
+    ) >= 1.0, "and that dispatch must be the mixed program"
+    # packing telemetry: the bucket rung gauge saw the packed count
+    tel = app.telemetry
+    assert tel.mixed_packed_tokens.value(bucket=bucket) == len(P1) + 1
+    waste = tel.mixed_padding_waste.value(bucket=bucket)
+    assert 0.0 <= waste < 1.0
+
+    r2 = engine.add_request(P2, SamplingParams(max_new_tokens=9))
+    outs += engine.run()
+    got = {o.request_id: o.token_ids for o in outs}
+    for req, prompt, n in ((r0, P0, 10), (r1, P1, 12), (r2, P2, 9)):
+        assert got[req.request_id] == _expected(hf_model, prompt, n)
+
+
+def test_mixed_on_off_identical_streams(tiny_hf_llama):
+    """The SAME workload through a mixed engine and a split engine (same
+    paged geometry, mixed_dispatch off) produces identical token streams —
+    the packing never changes what is computed, only how it is dispatched."""
+    hf_model, hf_cfg = tiny_hf_llama
+
+    def run(mixed: bool):
+        app = _build_app(
+            hf_model, hf_cfg,
+            is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=32,
+            ctx_batch_size=1, tkg_batch_size=3, mixed_dispatch=mixed,
+        )
+        engine = InferenceEngine(app, SchedulerConfig(num_slots=3))
+        assert engine.mixed is mixed
+        reqs = [
+            engine.add_request(P0, SamplingParams(max_new_tokens=8)),
+            engine.add_request(P1, SamplingParams(max_new_tokens=8)),
+            engine.add_request(P2, SamplingParams(max_new_tokens=8)),
+        ]
+        outs = {o.request_id: o.token_ids for o in engine.run()}
+        return [outs[r.request_id] for r in reqs]
+
+    assert run(True) == run(False)
+
+
+def test_mixed_parity_across_preemption(tiny_hf_llama):
+    """Forced AND natural preemption under mixed dispatch: victims resume
+    by re-prefilling prompt+generated through the packed program and every
+    final stream matches the uninterrupted greedy run."""
+    hf_model, hf_cfg = tiny_hf_llama
+
+    app = _mixed_app(
+        hf_model, hf_cfg, pa_block_size=4, pa_num_blocks=16,
+        tkg_batch_size=2,
+    )
+    engine = InferenceEngine(
+        app, SchedulerConfig(num_slots=2, watermark_blocks=1)
+    )
+    ra = engine.add_request(P0, SamplingParams(max_new_tokens=10))
+    rb = engine.add_request(P1, SamplingParams(max_new_tokens=10))
+    outs = engine.step()
+    victim = engine.preempt_youngest()
+    assert victim is not None and victim.preemptions == 1
+    outs += engine.run()
+    got = {o.request_id: o.token_ids for o in outs}
+    assert got[ra.request_id] == _expected(hf_model, P0, 10)
+    assert got[rb.request_id] == _expected(hf_model, P1, 10)
+
+    # natural: a pool too small for both full sequences evicts mid-decode
+    app2 = _mixed_app(
+        hf_model, hf_cfg, pa_block_size=4, pa_num_blocks=8,
+        tkg_batch_size=2,
+    )
+    engine2 = InferenceEngine(
+        app2, SchedulerConfig(num_slots=2, watermark_blocks=1)
+    )
+    rc = engine2.add_request(P0, SamplingParams(max_new_tokens=12))
+    rd = engine2.add_request(P1, SamplingParams(max_new_tokens=12))
+    outs2 = engine2.run()
+    got2 = {o.request_id: o.token_ids for o in outs2}
+    assert got2[rc.request_id] == _expected(hf_model, P0, 12)
+    assert got2[rd.request_id] == _expected(hf_model, P1, 12)
+    assert app2.telemetry.serve_preemptions_total.value() >= 1, (
+        "the sizing was chosen to exhaust the pool mid-decode"
+    )
+
+
+def test_mixed_chunked_prefill_no_special_path(tiny_hf_llama):
+    """chunk_size under mixed dispatch is pure packing policy: no
+    prefix-prefill submodel is compiled, prompts longer than one chunk
+    prefill across steps inside the packed program, decodes interleave,
+    and parity holds."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _mixed_app(hf_model, hf_cfg, tkg_batch_size=2)
+    from nxdi_tpu.runtime.application import TAG_PREFIX_PREFILL
+
+    assert TAG_PREFIX_PREFILL not in app.models
+    engine = InferenceEngine(
+        app, SchedulerConfig(num_slots=2, chunk_size=3)
+    )
+    ra = engine.add_request(P0, SamplingParams(max_new_tokens=8))  # 8t: 3 chunks
+    outs = engine.step()
+    assert ra.num_prefilled == 3 and not ra.prefill_done
+    rb = engine.add_request(P1, SamplingParams(max_new_tokens=6))
+    outs += engine.run()
+    got = {o.request_id: o.token_ids for o in outs}
+    assert got[ra.request_id] == _expected(hf_model, P0, 8)
+    assert got[rb.request_id] == _expected(hf_model, P1, 6)
+
+
+def test_mixed_gauges_preseeded(tiny_hf_llama):
+    """Every token-bucket rung's packing gauges exist (zero) from app load,
+    before any dispatch — absence-of-traffic is observable."""
+    hf_model, hf_cfg = tiny_hf_llama
+    app = _mixed_app(hf_model, hf_cfg)
+    buckets = app.models[TAG_MIXED].buckets
+    series = app.telemetry.mixed_packed_tokens.series()
+    assert len(series) == len(buckets)
+    for b in buckets:
+        assert app.telemetry.mixed_packed_tokens.value(bucket=str(b)) == 0.0
+        assert app.telemetry.mixed_padding_waste.value(bucket=str(b)) == 0.0

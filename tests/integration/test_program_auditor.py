@@ -392,6 +392,61 @@ def test_kv_layout_live_input_in_nonpaged_program_detected():
 
 
 # ---------------------------------------------------------------------------
+# mixed prefill+decode dispatch program
+# ---------------------------------------------------------------------------
+
+def mixed_app(**kw):
+    defaults = dict(
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=24,
+        ctx_batch_size=1, tkg_batch_size=2, mixed_dispatch=True,
+    )
+    defaults.update(kw)
+    return make_app(**defaults)
+
+
+def test_mixed_program_clean_on_mixed_reference_app():
+    """The shipped mixed programs keep all three ragged row-descriptor
+    inputs live and donate the cache at every token-bucket rung — and the
+    checker is inert on apps without a mixed submodel."""
+    from nxdi_tpu.runtime.model_wrapper import TAG_MIXED
+
+    report = mixed_app().audit(submodels=[TAG_MIXED])
+    assert errors_of(report, "mixed_program") == [], report.to_json()
+    assert errors_of(report, "donation") == [], report.to_json()
+    assert report.programs, "mixed submodel compiled no programs"
+    # one program per token-bucket rung of the packed ladder
+    assert all(p.tag == TAG_MIXED for p in report.programs)
+    # non-mixed apps: zero mixed_program findings anywhere
+    clean = paged_app().audit(checkers=["mixed_program"])
+    assert [f for f in clean.findings if f.checker == "mixed_program"] == []
+
+
+def test_mixed_program_dead_row_ids_detected():
+    """Seeded violation: a mixed-tagged program whose forward ignores
+    ``mixed_row_ids`` (constant-folded to -1, so kept_var_idx prunes the
+    input) would attend packed tokens across requests — flagged with the
+    input named."""
+    from nxdi_tpu.runtime.model_wrapper import TAG_MIXED
+
+    def dead_rows_forward(arch, inv_freq, params, cache, batch, **kw):
+        batch = dict(batch)
+        batch["mixed_row_ids"] = jnp.full(
+            batch["mixed_row_ids"].shape, -1, jnp.int32
+        )
+        return causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+
+    app = paged_app()
+    w = seeded_wrapper(
+        app, dead_rows_forward, tag=TAG_MIXED,
+        extra_inputs={"mixed_row_ids": ((-1,), np.int32)},
+    )
+    findings = errors_of(audit_seeded(app, w), "mixed_program")
+    assert findings, "seeded dead mixed_row_ids not flagged"
+    msg = " | ".join(f.message for f in findings)
+    assert "mixed_row_ids" in msg and "DROPPED" in msg
+
+
+# ---------------------------------------------------------------------------
 # LoRA adapter sharding
 # ---------------------------------------------------------------------------
 

@@ -316,3 +316,35 @@ def test_serve_cli_demo_tier1_smoke(capsys):
     assert metrics["nxdi_serve_preemptions_total"] >= 1
     assert metrics["nxdi_serve_slots_busy"] >= 1
     assert metrics["nxdi_serve_queue_depth"] >= 1
+
+
+def test_serve_cli_demo_mixed_dispatch_smoke(capsys):
+    """Tier-1 serving smoke, mixed edition: the same cli.serve demo with
+    --mixed-dispatch completes, and the exported Prometheus text shows the
+    packed program carried the traffic (mixed packing gauges populated)."""
+    from nxdi_tpu.cli.serve import main
+
+    rc = main([
+        "--requests", "8",
+        "--rate", "200",
+        "--max-new-tokens", "5",
+        "--slots", "3",
+        "--pa-num-blocks", "24",
+        "--mixed-dispatch",
+        "--seed", "0",
+        "--format", "prom",
+        "-q",
+    ])
+    assert rc == 0
+    prom = capsys.readouterr().out
+    assert 'nxdi_dispatches_total{submodel="mixed_model"' in prom
+    packed = [
+        line for line in prom.splitlines()
+        if line.startswith("nxdi_mixed_packed_tokens")
+    ]
+    assert packed, "mixed packing gauges missing from the export"
+    assert any(float(line.rsplit(" ", 1)[1]) > 0 for line in packed), (
+        "no bucket rung ever saw packed tokens"
+    )
+    # the packed program really carried dispatches
+    assert 'submodel="mixed_model"' in prom

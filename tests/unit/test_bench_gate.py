@@ -252,6 +252,58 @@ def test_routed_metrics_gate_and_failover_absolute(tmp_path):
     assert rc == 0
 
 
+def test_mixed_metrics_gate_and_skip_when_absent(tmp_path):
+    """bench.py --serving --mixed-dispatch emits mixed_* headline fields:
+    one-sided gating (goodput higher, padding waste lower), skipped against
+    pre-mixed baselines, and the generic 'value' row suppressed for
+    mixed-mode fresh records (their tok/s headline must not gate against a
+    decode-mode tok/s/chip baseline)."""
+    mixed = {
+        "value": 430.0,
+        "mixed_goodput_tok_s": 430.0,
+        "mixed_goodput_req_s": 1.7,
+        "mixed_padding_waste_pct": 22.0,
+        "unmixed_padding_waste_pct": 41.0,
+    }
+    # pre-mixed baseline (decode-mode BASE): every mixed_* field skips and
+    # the suppressed "value" row cannot fail the run
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", mixed),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, mixed, bench_gate.TOLERANCES)
+    assert "mixed_goodput_tok_s" in skipped
+    assert "mixed_padding_waste_pct" in skipped
+
+    # same-shape baseline: a goodput drop beyond tolerance fails...
+    worse = dict(mixed, mixed_goodput_tok_s=350.0, value=350.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", worse),
+        "--baseline", _write(tmp_path, "base.json", mixed),
+        "-q",
+    ])
+    assert rc == 1
+    # ... a padding-waste blowout fails (lower is better: the packer or the
+    # token-bucket ladder fragmented) ...
+    wasteful = dict(mixed, mixed_padding_waste_pct=35.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", wasteful),
+        "--baseline", _write(tmp_path, "base.json", mixed),
+        "-q",
+    ])
+    assert rc == 1
+    # ... and a waste IMPROVEMENT plus in-tolerance noise passes (one-sided)
+    better = dict(mixed, mixed_padding_waste_pct=15.0, mixed_goodput_tok_s=425.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", better),
+        "--baseline", _write(tmp_path, "base.json", mixed),
+        "-q",
+    ])
+    assert rc == 0
+
+
 def test_sentinel_overhead_absolute_gate(tmp_path, capsys):
     """sentinel_overhead_pct (bench.py --serving numerics-sentinel smoke)
     gates against the ABSOLUTE < 3% limit on the fresh record alone: it
