@@ -843,6 +843,151 @@ def main_multistep(k: int):
     )
 
 
+def main_device_loop(k: int, cap: int = 128):
+    """A/B the ``tkg_device_loop`` resident decode loop against the
+    ``tkg_multistep`` K-step rung at bs1 — the host-boundary-dominated
+    regime the loop exists for. One launch retires ``cap`` tokens per
+    dispatch against the rung's K; the per-token lines show what amortizing
+    the dispatch boundary buys. Both submodels compile side by side on the
+    SAME app/weights. Cached in BENCH_DEVICE_LOOP.json."""
+    import jax
+    import jax.numpy as jnp
+    import jax.tree_util as jtu
+    import ml_dtypes
+
+    from nxdi_tpu.config import OnDeviceSamplingConfig, TpuConfig
+    from nxdi_tpu.models.llama import modeling_llama as ml
+    from nxdi_tpu.runtime.application import TpuModelForCausalLM, params_shape_struct
+    from nxdi_tpu.ops.sampling import SamplingParams
+    from nxdi_tpu.runtime.model_wrapper import (
+        MULTISTEP_EOS_SLOTS,
+        TAG_DEVICE_LOOP,
+    )
+
+    tcfg = TpuConfig(
+        tp_degree=1, batch_size=1, seq_len=SEQ_LEN,
+        max_context_length=PROMPT_LEN, dtype="bfloat16",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        async_mode=True, attn_kernel_enabled=True, fused_qkv=True,
+        skip_warmup=True, decode_steps_per_dispatch=k,
+        device_loop=True, device_loop_fence=cap,
+    )
+    cfg = ml.LlamaInferenceConfig(
+        tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
+        num_hidden_layers=N_LAYERS, num_attention_heads=N_HEADS,
+        num_key_value_heads=N_KV_HEADS, head_dim=HEAD_DIM,
+        vocab_size=VOCAB, rms_norm_eps=1e-5, rope_theta=500000.0,
+    )
+    rng = np.random.default_rng(0)
+    struct = params_shape_struct(ml, cfg, ml.build_arch(cfg))
+    state = jtu.tree_map(
+        lambda s: (rng.standard_normal(s.shape, dtype=np.float32) * 0.02).astype(
+            ml_dtypes.bfloat16
+        ),
+        struct,
+    )
+
+    class App(TpuModelForCausalLM):
+        def build_params(self):
+            return state
+
+    app = App("<random>", cfg, model_family=ml)
+    app.load()
+    prompt = rng.integers(0, VOCAB, size=(1, PROMPT_LEN)).astype(np.int32)
+    pos = np.arange(PROMPT_LEN, dtype=np.int32)[None, :]
+    out = app.forward(
+        prompt, pos, last_token_index=np.full((1,), PROMPT_LEN - 1, np.int32)
+    )
+    np.asarray(out["tokens"])
+
+    # incumbent: the K-step scan rung, device-resident windows (the
+    # main_multistep discipline at bs1)
+    dev_batch = dict(out["next_inputs"])
+    dev_batch["eos_token_ids"] = jnp.full((1, MULTISTEP_EOS_SLOTS), -1, jnp.int32)
+    dev_batch["pad_token_id"] = jnp.zeros((1,), jnp.int32)
+    o = app.token_gen_multistep_device(dev_batch, SEQ_LEN, steps=k)
+    np.asarray(o["tokens"])
+    nxt = o["next_inputs"]
+    for _ in range(max(1, 20 // k)):
+        o = app.token_gen_multistep_device(nxt, SEQ_LEN, steps=k)
+        nxt = o["next_inputs"]
+    np.asarray(o["tokens"])
+    n_win = max(1, 60 // k)
+    per = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        for _ in range(n_win):
+            o = app.token_gen_multistep_device(nxt, SEQ_LEN, steps=k)
+            nxt = o["next_inputs"]
+        np.asarray(o["tokens"])
+        per.append((time.perf_counter() - t0) * 1000.0 / (n_win * k))
+    multi_ms = float(np.percentile(per, 50))
+    print(
+        f"[device-loop] multistep k={k} {multi_ms:.3f} ms/tok",
+        file=sys.stderr, flush=True,
+    )
+
+    # challenger: one while-loop launch retiring `cap` tokens per dispatch.
+    # Positions chain launch-to-launch so the KV window stays honest; the
+    # cache content beyond the prompt is bench fill, same as the scan line.
+    w = app.models[TAG_DEVICE_LOOP]
+    last_tok = int(np.asarray(jax.device_get(out["tokens"])).ravel()[0])
+
+    def launch(p0: int, tok: int) -> tuple:
+        batch = {
+            "input_ids": np.array([[tok]], dtype=np.int32),
+            "position_ids": np.array([[p0]], dtype=np.int32),
+            "last_token_index": np.zeros((1,), dtype=np.int32),
+            "sampling_params": SamplingParams().tensor(1),
+            "eos_token_ids": np.full((1, MULTISTEP_EOS_SLOTS), -1, np.int32),
+            "pad_token_id": np.zeros((1,), dtype=np.int32),
+            "budget_steps": np.array([cap], dtype=np.int32),
+            "loop_cap": cap,
+        }
+        if w.needs_rng:
+            batch["rng"] = np.zeros((2,), dtype=np.uint32)
+        o = app.token_gen_device_loop(batch)
+        iters = int(np.asarray(jax.device_get(o["loop_iters"])))
+        toks = np.asarray(jax.device_get(o["tokens"]))
+        return iters, int(toks[0, max(iters - 1, 0)])
+
+    p = PROMPT_LEN - 1
+    iters, last_tok = launch(p, last_tok)  # compile + first execute
+    p += iters
+    per = []
+    toks_per_dispatch = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        iters, last_tok = launch(p, last_tok)
+        dt_ms = (time.perf_counter() - t0) * 1000.0
+        p += iters
+        per.append(dt_ms / max(iters, 1))
+        toks_per_dispatch.append(iters)
+    loop_ms = float(np.percentile(per, 50))
+    rec = {
+        "decode_steps_per_dispatch": k,
+        "device_loop_cap": cap,
+        "device_loop_ms_per_tok": round(loop_ms, 3),
+        "device_loop_tokens_per_dispatch": float(np.mean(toks_per_dispatch)),
+        "tkg_multistep_ms_per_token": round(multi_ms, 3),
+        "tkg_multistep_tokens_per_dispatch": float(k),
+        "config": (
+            f"llama3.2-1b full {N_LAYERS}L bf16 bs1 kv{SEQ_LEN} tp1 "
+            f"loop-cap{cap} vs k{k}"
+        ),
+    }
+    side = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DEVICE_LOOP.json"
+    )
+    with open(side, "w") as f:
+        json.dump(rec, f)
+    print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"device_loop": app.telemetry.snapshot()}, metrics_out_path()
+    )
+    return rec
+
+
 def _flag_value(name, default):
     if name not in sys.argv:
         return default
@@ -1463,6 +1608,11 @@ if __name__ == "__main__":
         main_8b_only()
     elif "--bs1-only" in sys.argv:
         main_bs1_only()
+    elif "--device-loop" in sys.argv:
+        main_device_loop(
+            _flag_value("--decode-steps-per-dispatch", 4),
+            cap=_flag_value("--loop-cap", 128),
+        )
     elif "--decode-steps-per-dispatch" in sys.argv:
         idx = sys.argv.index("--decode-steps-per-dispatch")
         main_multistep(int(sys.argv[idx + 1]))

@@ -485,6 +485,112 @@ def check_mixed_program(art: ProgramArtifacts) -> List[Finding]:
 
 
 # ---------------------------------------------------------------------------
+# 6c. device-resident decode loop
+# ---------------------------------------------------------------------------
+
+def _jaxpr_has_while(jaxpr) -> bool:
+    """True iff a ``while`` primitive appears anywhere in ``jaxpr`` —
+    including inside nested call/scan/cond sub-jaxprs. The check must run
+    on the JAXPR, not the StableHLO: ``lax.scan`` (the layer stack) also
+    lowers to ``stablehlo.while``, so the text alone cannot distinguish a
+    data-dependent decode loop from a fixed-trip layer scan."""
+    seen: list = [jaxpr]
+    while seen:
+        j = seen.pop()
+        for eqn in j.eqns:
+            if eqn.primitive.name == "while":
+                return True
+            for v in eqn.params.values():
+                for x in (v if isinstance(v, (list, tuple)) else (v,)):
+                    inner = getattr(x, "jaxpr", x)
+                    if hasattr(inner, "eqns"):
+                        seen.append(inner)
+    return False
+
+
+def check_device_loop(art: ProgramArtifacts) -> List[Finding]:
+    """The ``tkg_device_loop`` program amortizes host dispatch over a
+    data-dependent number of decode steps, so its correctness hangs on
+    three static properties of the lowered program:
+
+    - an actual ``while`` loop in the traced program: a loop that traced
+      away (folded/unrolled to a fixed chain) silently reverts to
+      fixed-rung semantics and the per-row exit is gone;
+    - the per-row halt vectors ``budget_steps`` and ``eos_token_ids``
+      surviving lowering ALIVE (the kv_layout recipe, via
+      ``kept_var_idx``): a pruned one means rows cannot exit early — every
+      lane runs to the cap and the host receives tokens past EOS/budget;
+    - the KV-cache carry donated through the loop body: the body reads and
+      commits KV every iteration, so a non-donated cache doubles HBM for
+      the whole launch.
+    """
+    from nxdi_tpu.runtime.model_wrapper import TAG_DEVICE_LOOP
+
+    if art.tag != TAG_DEVICE_LOOP:
+        return []
+    findings: List[Finding] = []
+    if art.jaxpr is None:
+        findings.append(art.finding(
+            "device_loop",
+            "traced jaxpr unavailable; cannot prove the decode while-loop "
+            "survived tracing", severity="warning",
+        ))
+    elif not _jaxpr_has_while(art.jaxpr.jaxpr):
+        findings.append(art.finding(
+            "device_loop",
+            "no while primitive in the traced program (stablehlo.while "
+            "alone cannot prove it: the layer scan lowers to one too) — "
+            "the decode loop traced away, so the launch cannot run a "
+            "data-dependent number of steps",
+        ))
+    try:
+        example = art.wrapper._example_for_key(art.key)
+    except Exception as e:
+        return findings + [art.finding(
+            "device_loop",
+            f"example batch unavailable: {type(e).__name__}: {e}",
+            severity="warning",
+        )]
+    keys = sorted(example)  # jax flattens dicts in sorted-key order
+    required = ("budget_steps", "eos_token_ids")
+    missing = [k for k in required if k not in keys]
+    if missing:
+        return findings + [art.finding(
+            "device_loop",
+            f"device-loop program is missing batch input(s) {missing} — the "
+            "in-graph per-row halt has nothing to compare against",
+        )]
+    n_fixed = art.n_param_leaves + len(art.cache_paths)
+    if art.kept_args is None:
+        findings.append(art.finding(
+            "device_loop",
+            "kept_var_idx unavailable; cannot prove halt-vector liveness",
+            severity="warning",
+        ))
+    else:
+        kept = set(art.kept_args)
+        for k in required:
+            if (n_fixed + keys.index(k)) not in kept:
+                findings.append(art.finding(
+                    "device_loop",
+                    f"device-loop program DROPPED its '{k}' input (pruned "
+                    "by kept_var_idx) — the per-row halt is provably "
+                    "unused, so every lane runs to the cap and emits past "
+                    "its EOS/budget exit",
+                ))
+    if art.donated_flags is not None:
+        for ci, path in enumerate(art.cache_paths):
+            if not art.donated_flags[art.n_param_leaves + ci]:
+                findings.append(art.finding(
+                    "device_loop",
+                    f"device-loop cache input '{path}' compiled WITHOUT "
+                    "donation — the while-loop body reads and commits KV "
+                    "every iteration, so the launch holds two cache copies",
+                ))
+    return findings
+
+
+# ---------------------------------------------------------------------------
 # 7. LoRA adapter sharding
 # ---------------------------------------------------------------------------
 
@@ -831,6 +937,7 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "required_strategies": check_required_strategies,
     "kv_layout": check_kv_layout,
     "mixed_program": check_mixed_program,
+    "device_loop": check_device_loop,
     "lora_sharding": check_lora_sharding,
     "quantized_dtype": check_quantized_dtype,
     "hbm_fit": check_hbm_fit,

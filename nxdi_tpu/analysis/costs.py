@@ -456,6 +456,11 @@ def program_cost_sheet(
         scan_layers = 1 if getattr(wrapper, "layers_unrolled", False) else max(
             1, getattr(wrapper.arch, "num_layers", 1)
         )
+        # a stepped program (K-step scan window / device-loop cap rung)
+        # repeats the WHOLE decode body in a while loop the counter also
+        # sees once — the analytic side legitimately counts `steps` times
+        # more, so the undercount bound widens by steps as well
+        scan_layers *= max(1, steps or 1)
         ratio = sheet.xla_flops / sheet.flops
         if ratio > MISMATCH_RATIO or ratio < 1.0 / (MISMATCH_RATIO * scan_layers):
             sheet.mismatch = (

@@ -174,6 +174,12 @@ def _print_timeline(records: List[dict], last: int) -> None:
             prog = f"{dec['submodel']}[steps={dec['steps']}]"
             if dec["padding_rows"]:
                 prog += f" pad={dec['padding_rows']}"
+            toks = dec.get("tokens_emitted")
+            if toks:
+                # per-token host overhead: the sync-boundary cost the
+                # device loop amortizes — one launch retiring N tokens
+                # divides the step's host remainder by N
+                prog += f" tok={toks} host={r['host_s'] * 1e6 / toks:.0f}us/tok"
         print(
             f"{r['step']:>5} {r['wall_s'] * 1e3:>8.2f} "
             f"{r['dispatch_s'] * 1e3:>8.2f} {r['host_s'] * 1e3:>8.2f} "

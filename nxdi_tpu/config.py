@@ -615,6 +615,23 @@ class TpuConfig:
             kwargs.pop("decode_steps_per_dispatch", 1)
         )
 
+        # --- device-resident decode loop: compile the `tkg_device_loop`
+        # submodel — a lax.while_loop running one full decode step per
+        # iteration with per-row EOS + token-budget exit applied IN-GRAPH
+        # (models/base.py device_loop_token_gen). The serving engine then
+        # retires a batch's whole heterogeneous remaining budget in ONE
+        # dispatch instead of a ladder of fixed-K scan windows.
+        self.device_loop = bool(kwargs.pop("device_loop", False))
+        # per-iteration device->host token out-feed (io_callback ring).
+        # None = auto: ON for real accelerator backends, OFF on CPU/interpret
+        # where the buffered whole-result path is the exact tier-1 surface.
+        self.device_loop_outfeed = kwargs.pop("device_loop_outfeed", None)
+        # upper bound on tokens per loop launch (0 = unlimited). A fence
+        # forces the loop back to the host every N iterations so admission /
+        # retirement / preemption get a scheduling point under load — the
+        # "preemption fence" between resident-loop launches.
+        self.device_loop_fence = int(kwargs.pop("device_loop_fence", 0))
+
         # --- bucketing (reference: config.py:187-208) ---
         self.enable_bucketing = kwargs.pop("enable_bucketing", False)
         self.buckets = kwargs.pop("buckets", None)
@@ -1084,6 +1101,57 @@ class TpuConfig:
                     "decode_steps_per_dispatch > 1 requires ctx_batch_size == "
                     "tkg_batch_size (the K-step windows chain device-resident "
                     "from the context-encoding outputs)"
+                )
+        if self.device_loop_fence < 0:
+            raise ValueError("device_loop_fence must be >= 0 (0 = unlimited)")
+        if self.device_loop:
+            # the while-loop body samples, advances positions, and commits KV
+            # in-graph — the same closed-world contract as the K-step scan,
+            # plus a data-dependent trip count no host input can ride inside
+            if self.on_device_sampling_config is None:
+                raise ValueError(
+                    "device_loop requires on-device sampling (the loop body "
+                    "samples each token in-graph)"
+                )
+            if (
+                self.enable_fused_speculation
+                or self.is_medusa
+                or self.speculation_length > 0
+            ):
+                raise ValueError(
+                    "device_loop and speculative decoding both own the "
+                    "token-generation stride; enable one"
+                )
+            if self.is_block_kv_layout:
+                raise ValueError(
+                    "device_loop needs in-graph KV addressing by position; "
+                    "the block layout's slot mappings are host-computed per "
+                    "step"
+                )
+            if self.lora_config is not None:
+                raise ValueError(
+                    "device_loop does not thread per-request adapter_ids "
+                    "through the in-graph decode loop yet"
+                )
+            if (
+                self.tensor_capture_config is not None
+                or self.tensor_replacement_config is not None
+            ):
+                raise ValueError(
+                    "device_loop does not compose with tensor capture/"
+                    "replacement (per-step host tensors cannot ride the "
+                    "in-graph loop)"
+                )
+            if self.ctx_batch_size != self.tkg_batch_size:
+                raise ValueError(
+                    "device_loop requires ctx_batch_size == tkg_batch_size "
+                    "(loop launches share the decode batch the CTE filled)"
+                )
+            if self.mixed_dispatch:
+                raise ValueError(
+                    "device_loop and mixed_dispatch are different serving "
+                    "step shapes (resident decode loop vs one packed "
+                    "prefill+decode program); enable one"
                 )
         if self.is_block_kv_layout and self.pa_num_blocks is None:
             self.pa_num_blocks = max(

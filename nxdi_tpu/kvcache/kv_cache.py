@@ -326,7 +326,18 @@ class ContiguousKVLayout:
 
         from nxdi_tpu.ops.kernels import kv_commit
 
-        if kv_commit.commit_rows_supported(
+        # Frozen-lane drops break the commit kernel's window contract: a
+        # negative write position turns that lane's grid step into a
+        # passthrough read-modify-write of its clipped (line, window) block,
+        # and when a padding lane shares row 0's cache line (batch padding
+        # duplicates row 0's seq_ids) the stale write-back clobbers row 0's
+        # valid write landing in the same 128-slot window (kv_commit.py
+        # CONTRACT). ``write_positions`` in the cache inputs is the static
+        # trace-time marker that frozen lanes are possible — the multistep
+        # scan and device-loop bodies inject it unconditionally — so those
+        # commits keep the jnp scatter, whose mode='drop' is exact per
+        # update.
+        if "write_positions" not in cache_inputs and kv_commit.commit_rows_supported(
             cache["k"].shape, cache["v"].shape, k_rows.shape, v_rows.shape
         ):
             seq_ids = (
@@ -501,14 +512,21 @@ class WindowKVLayout:
         REJECTED row at position ``p_r`` resolves (for any later query
         ``q < p_r``) to inferred position ``p_r - W_ring`` — also out of
         window — until the true token at ``p_r`` overwrites it."""
-        position_ids = cache_inputs["position_ids"]
+        # write_positions override: negative = frozen lane, drop the write
+        # (multistep scan / device-loop freeze semantics, same as the
+        # contiguous layout's commit)
+        position_ids = cache_inputs.get(
+            "write_positions", cache_inputs["position_ids"]
+        )
         W = self.window
         pos = position_ids.astype(jnp.int32)
         slots = jnp.where(pos >= 0, pos % W, jnp.int32(-1))  # neg = drop
 
         from nxdi_tpu.ops.kernels import kv_commit
 
-        if kv_commit.commit_rows_supported(
+        # same frozen-lane kernel hazard as the contiguous commit above:
+        # write_positions present -> possible dropped lanes -> jnp scatter
+        if "write_positions" not in cache_inputs and kv_commit.commit_rows_supported(
             cache["k"].shape, cache["v"].shape, k_rows.shape, v_rows.shape
         ):
             seq_ids = cache_inputs["seq_ids"] if self.route_by_seq_id else None

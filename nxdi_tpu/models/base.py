@@ -2245,13 +2245,24 @@ def multi_step_token_gen(
     the K-step scan token-identical to K chained 1-step dispatches (greedy
     and sampled).
 
-    ``batch`` extends the decode contract with two optional fixed-shape
-    inputs for in-scan EOS handling:
+    ``batch`` extends the decode contract with three optional fixed-shape
+    inputs for in-scan EOS/budget handling:
       - ``eos_token_ids`` (B, E) int32, -1 = unused slot: once a row samples
         any of its EOS ids, its later in-window tokens are emitted as
         ``pad_token_id`` and the pad is what feeds the next step — the same
         stream the host-side sync loop produces for finished rows.
       - ``pad_token_id`` (B,) int32.
+      - ``budget_steps`` (B,) int32, <= 0 = unlimited: row i may emit at most
+        ``budget_steps[i]`` tokens this window, then finishes like EOS. This
+        is what lets the serving engine dispatch a window LARGER than the
+        smallest per-row remaining budget — near-EOS rows ride along and
+        halt per-row instead of degrading the whole batch to 1-step.
+
+    Finished rows (EOS'd or out of budget) freeze: their position stops
+    advancing and their KV writes are dropped (negative write positions →
+    the layout scatter's drop mode), so a long window can never push a
+    finished row's pad-chain garbage over its own last real KV line or out
+    of the compiled window.
 
     Returns outputs with ``tokens`` (B, K) — all K emitted tokens, in order —
     and (optionally) ``next_inputs`` carrying the step-batch for the NEXT
@@ -2260,6 +2271,7 @@ def multi_step_token_gen(
     B = batch["input_ids"].shape[0]
     eos_ids = batch.get("eos_token_ids")  # (B, E) int32; None = no masking
     pad_id = batch.get("pad_token_id")  # (B,) int32
+    budget = batch.get("budget_steps")  # (B,) int32; None/<=0 = unlimited
     passthrough = {
         k: batch[k] for k in _MULTISTEP_PASSTHROUGH_KEYS if k in batch
     }
@@ -2268,10 +2280,13 @@ def multi_step_token_gen(
     if "rng" in batch:
         step0["rng"] = batch["rng"]
 
-    def step(carry, _):
+    def step(carry, t):
         sbatch, done, kvc = carry
         fwd_batch = dict(passthrough)
         fwd_batch.update(sbatch)
+        fwd_batch["write_positions"] = jnp.where(
+            done[:, None], jnp.int32(-1), sbatch["position_ids"]
+        )
         out, kvc = causal_lm_forward(
             arch,
             inv_freq,
@@ -2305,9 +2320,15 @@ def multi_step_token_gen(
             done = done | jnp.any(emitted[:, None] == eos_ids, axis=1)
         else:
             emitted = tok
+        if budget is not None:
+            # the budget-hit token itself is real (the host's "length"
+            # finish emits it); only LATER steps are frozen out
+            done = done | ((budget > 0) & (t + 1 >= budget))
         new_sbatch = {
             "input_ids": emitted[:, None].astype(jnp.int32),
-            "position_ids": nxt["position_ids"],
+            "position_ids": jnp.where(
+                done[:, None], sbatch["position_ids"], nxt["position_ids"]
+            ),
             "last_token_index": nxt["last_token_index"],
             "sampling_params": nxt["sampling_params"],
         }
@@ -2317,7 +2338,7 @@ def multi_step_token_gen(
 
     done0 = jnp.zeros((B,), bool)
     (step_k, _, cache), toks = jax.lax.scan(
-        step, (step0, done0, cache), None, length=num_steps
+        step, (step0, done0, cache), jnp.arange(num_steps, dtype=jnp.int32)
     )
     outputs: Dict[str, jax.Array] = {"tokens": jnp.swapaxes(toks, 0, 1)}  # (B, K)
     if return_next_inputs:
@@ -2325,3 +2346,142 @@ def multi_step_token_gen(
         nxt.update(passthrough)
         outputs["next_inputs"] = nxt
     return outputs, cache
+
+
+# ---------------------------------------------------------------------------
+# Device-resident decode loop: while-loop with per-row EOS/budget exit
+# ---------------------------------------------------------------------------
+
+
+def device_loop_token_gen(
+    arch: DecoderArch,
+    inv_freq: np.ndarray,
+    params: Dict[str, Any],
+    cache: Dict[str, jax.Array],
+    batch: Dict[str, jax.Array],
+    *,
+    max_steps: int,
+    kv_window: Optional[int] = None,
+    policy: ShardingPolicy = DEFAULT_POLICY,
+    layout=DEFAULT_KV_LAYOUT,
+    do_sample: bool = False,
+    global_topk: int = 256,
+    deterministic: bool = False,
+    dp_sampling: bool = False,
+    outfeed: Optional[Any] = None,
+) -> Tuple[Dict[str, jax.Array], Dict[str, jax.Array]]:
+    """The ``tkg_device_loop`` submodel: a ``lax.while_loop`` whose body is
+    one full sample -> embed -> layer stack -> KV-commit decode step, exiting
+    as soon as EVERY row has sampled one of its EOS ids or exhausted its
+    per-row token budget. Unlike the fixed-rung scan (``tkg_multistep``) the
+    iteration count is data-dependent: a batch with heterogeneous remaining
+    budgets runs ONE dispatch and each row halts exactly where the host loop
+    would have stopped it — the host never re-enters the hot path to referee.
+
+    Contract (all of ``multi_step_token_gen``'s, plus):
+      - ``max_steps`` is the STATIC capacity of the token out-buffer
+        (B, max_steps); the loop exits early once all rows are done, so the
+        cap bounds — never schedules — the work.
+      - ``budget_steps`` (B,) int32, <= 0 = unlimited: per-row emission
+        budget; the budget-hit token itself is emitted (the host's "length"
+        finish semantics).
+      - sampling keys are COUNTER-BASED: iteration t draws with
+        ``batch["rng"] + [0, t]`` — i.e. the host ``StepRngSchedule``'s own
+        ``(seed, counter + t)`` sequence — so a fixed-seed sampled loop
+        reproduces N chained 1-step engine dispatches token-for-token (the
+        host advances its counter by the returned ``loop_iters - 1``).
+      - ``outfeed``, when given, is a host callable ``(t, tokens, done)``
+        invoked per iteration via an unordered ``io_callback`` — the
+        device→host token out-feed ring. The (B, max_steps) result buffer is
+        ALWAYS returned too, so CPU/interpret runs (and tier-1) stay exact
+        without the ring.
+
+    Finished rows freeze exactly like the scan: pad-token feed-forward,
+    position pinned, KV writes dropped via negative write positions.
+
+    Returns outputs with ``tokens`` (B, max_steps) — entries past a row's
+    halt point are ``pad_token_id`` — and ``loop_iters`` (scalar int32), the
+    number of body iterations the loop actually ran.
+    """
+    from jax.experimental import io_callback
+
+    B = batch["input_ids"].shape[0]
+    eos_ids = batch.get("eos_token_ids")
+    pad_id = batch.get("pad_token_id")
+    budget = batch.get("budget_steps")
+    base_rng = batch.get("rng")
+    passthrough = {
+        k: batch[k] for k in _MULTISTEP_PASSTHROUGH_KEYS if k in batch
+    }
+
+    step0 = {k: batch[k] for k in _MULTISTEP_CHAIN_KEYS}
+    pad0 = (
+        pad_id.astype(jnp.int32)
+        if pad_id is not None
+        else jnp.zeros((B,), jnp.int32)
+    )
+    toks0 = jnp.broadcast_to(pad0[:, None], (B, max_steps)).astype(jnp.int32)
+
+    def cond(carry):
+        t, done, _sbatch, _toks, _kvc = carry
+        return (t < max_steps) & ~jnp.all(done)
+
+    def body(carry):
+        t, done, sbatch, toks, kvc = carry
+        fwd_batch = dict(passthrough)
+        fwd_batch.update(sbatch)
+        fwd_batch["write_positions"] = jnp.where(
+            done[:, None], jnp.int32(-1), sbatch["position_ids"]
+        )
+        if base_rng is not None:
+            # counter-based key schedule: one host counter per iteration
+            fwd_batch["rng"] = base_rng + jnp.array(
+                [0, 1], jnp.uint32
+            ) * t.astype(jnp.uint32)
+        out, kvc = causal_lm_forward(
+            arch,
+            inv_freq,
+            params,
+            kvc,
+            fwd_batch,
+            attend_to_cache=True,
+            kv_window=kv_window,
+            policy=policy,
+            layout=layout,
+            gather_last_token=False,
+            on_device_sampling=True,
+            do_sample=do_sample,
+            global_topk=global_topk,
+            deterministic=deterministic,
+            dp_sampling=dp_sampling,
+            return_next_inputs=True,
+        )
+        nxt = out["next_inputs"]
+        tok = out["tokens"][:, 0]  # (B,)
+        emitted = jnp.where(done, pad0.astype(tok.dtype), tok)
+        if eos_ids is not None:
+            done = done | jnp.any(emitted[:, None] == eos_ids, axis=1)
+        if budget is not None:
+            done = done | ((budget > 0) & (t + 1 >= budget))
+        toks = jax.lax.dynamic_update_slice(
+            toks, emitted[:, None].astype(jnp.int32), (0, t)
+        )
+        if outfeed is not None:
+            # unordered: iteration index t rides along so the host ring can
+            # reassemble order without serializing the loop on the callback
+            io_callback(outfeed, None, t, emitted, done, ordered=False)
+        new_sbatch = {
+            "input_ids": emitted[:, None].astype(jnp.int32),
+            "position_ids": jnp.where(
+                done[:, None], sbatch["position_ids"], nxt["position_ids"]
+            ),
+            "last_token_index": nxt["last_token_index"],
+            "sampling_params": nxt["sampling_params"],
+        }
+        return (t + 1, done, new_sbatch, toks, kvc)
+
+    done0 = jnp.zeros((B,), bool)
+    t_end, _done, _sbatch, toks, cache = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), done0, step0, toks0, cache)
+    )
+    return {"tokens": toks, "loop_iters": t_end}, cache

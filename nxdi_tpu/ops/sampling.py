@@ -124,6 +124,16 @@ class StepRngSchedule:
         self.counter += 1
         return np.array([self.seed, self.counter], dtype=np.uint32)
 
+    def advance(self, steps: int) -> None:
+        """Skip ``steps`` counter values without drawing them. The device
+        loop (models/base.py device_loop_token_gen) burns one counter per
+        loop iteration IN-GRAPH (iteration t samples with key
+        ``(seed, counter + t)``), so after a launch that ran N iterations
+        the host schedule must land where N chained 1-step dispatches
+        would have — that alignment is the sampled ON/OFF parity
+        contract."""
+        self.counter += max(int(steps), 0)
+
 
 def extract_next_tokens(outputs) -> np.ndarray:
     """(B,) next tokens of a forward's outputs: on-device sampled ``tokens``

@@ -37,9 +37,11 @@ from nxdi_tpu.parallel.mesh import mesh_from_config
 from nxdi_tpu.runtime import autobucketing
 from nxdi_tpu.runtime.model_wrapper import (
     TAG_CONTEXT_ENCODING,
+    TAG_DEVICE_LOOP,
     TAG_MIXED,
     TAG_TOKEN_GENERATION,
     TAG_TOKEN_GENERATION_MULTISTEP,
+    DeviceLoopTKGWrapper,
     MixedModelWrapper,
     ModelWrapper,
     MultiStepTKGWrapper,
@@ -641,6 +643,38 @@ class TpuModelForCausalLM(ApplicationBase):
                     dp_sampling=getattr(odsc, "dp_sampling", False),
                 ),
             )
+        if tc.device_loop:
+            # device-resident decode loop: a while_loop running one full
+            # decode step per iteration with per-row EOS + budget exit
+            # applied in-graph (models/base.py device_loop_token_gen). The
+            # plain TKG (and any multistep) submodels stay — they are the
+            # host fallbacks for >8 eos ids and the 1-2 token tails below
+            # the cap ladder's floor.
+            outfeed = tc.device_loop_outfeed
+            if outfeed is None:
+                # auto: stream on real accelerators; buffered whole-result
+                # on CPU/interpret (the exact tier-1 surface)
+                outfeed = jax.default_backend() not in ("cpu",)
+            self.models[TAG_DEVICE_LOOP] = DeviceLoopTKGWrapper(
+                TAG_DEVICE_LOOP,
+                self.config,
+                arch_tkg,
+                inv_freq,
+                batch_size=tc.tkg_batch_size,
+                n_active_tokens=1,
+                buckets=autobucketing.token_generation_buckets(self.config),
+                attend_to_cache=True,
+                cap_ladder=autobucketing.device_loop_budget_ladder(
+                    tc.device_loop_fence or tc.seq_len
+                ),
+                outfeed_enabled=bool(outfeed),
+                forward_kwargs=dict(
+                    do_sample=odsc.do_sample,
+                    global_topk=odsc.global_topk,
+                    deterministic=odsc.deterministic,
+                    dp_sampling=getattr(odsc, "dp_sampling", False),
+                ),
+            )
         if tc.is_prefix_caching or tc.is_chunked_prefill:
             # multi-token prefill that attends the cache: the new chunk/suffix
             # sees the cached prefix through the block table (reference:
@@ -752,6 +786,18 @@ class TpuModelForCausalLM(ApplicationBase):
         outputs, self.kv_cache = w.forward_device(
             self.params, self.kv_cache, device_batch, total_len, steps=steps
         )
+        return outputs
+
+    @property
+    def device_loop_supported(self) -> bool:
+        return TAG_DEVICE_LOOP in self.models
+
+    def token_gen_device_loop(self, batch_np):
+        """One resident-loop launch: pads inputs, runs the while_loop to
+        per-row EOS/budget exhaustion, retires up to cap tokens per row.
+        Outputs carry ``tokens`` (b, cap) and ``loop_iters``."""
+        w = self.models[TAG_DEVICE_LOOP]
+        outputs, self.kv_cache = w.forward(self.params, self.kv_cache, batch_np)
         return outputs
 
     @property

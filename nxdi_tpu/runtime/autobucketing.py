@@ -140,6 +140,20 @@ def multistep_step_ladder(max_steps: int) -> List[int]:
     return generate_buckets(2, max_steps)
 
 
+def device_loop_budget_ladder(max_budget: int) -> List[int]:
+    """Token-buffer capacity rungs for the device-resident decode loop
+    (``tkg_device_loop``): powers of two from 4 with the largest possible
+    per-launch budget as the last rung, e.g. max 24 -> [4, 8, 16, 24]. Each
+    rung is a separately compiled program whose STATIC (B, cap) out-buffer
+    bounds — never schedules — the loop: the while-cond exits as soon as
+    every row halts, so the dispatcher just picks the smallest cap covering
+    the largest per-row remaining budget in the batch. No rung below 4 — a
+    1-2 token tail is the plain/multistep programs' home turf."""
+    if max_budget <= 4:
+        return [max(1, max_budget)]
+    return generate_buckets(4, max_budget)
+
+
 def get_target_steps(remaining: int, ladder: Sequence[int]) -> int:
     """Smallest step rung covering ``remaining`` tokens; the largest rung when
     even it cannot (the host trims overshoot tokens)."""

@@ -185,6 +185,7 @@ class _AutoLayoutProgram:
 TAG_CONTEXT_ENCODING = "context_encoding_model"
 TAG_TOKEN_GENERATION = "token_generation_model"
 TAG_TOKEN_GENERATION_MULTISTEP = "tkg_multistep"
+TAG_DEVICE_LOOP = "tkg_device_loop"
 TAG_SPECULATION = "speculation_model"
 TAG_FUSED_SPECULATION = "fused_speculation_model"
 TAG_MEDUSA_SPECULATION = "medusa_speculation_model"
@@ -658,9 +659,15 @@ class ModelWrapper:
     def _slice_batch_padding(self, outputs, orig_b: int):
         """Drop batch-padding rows from per-row outputs. The mixed wrapper
         overrides this with a no-op: its compiled batch dim is always 1 (the
-        packed token stream) while its outputs lead with the R slot dim."""
+        packed token stream) while its outputs lead with the R slot dim.
+        Scalars (e.g. the device loop's ``loop_iters``) have no batch dim to
+        slice and pass through."""
         return {
-            k: (v if k in ("next_inputs", "captured") else v[:orig_b])
+            k: (
+                v
+                if k in ("next_inputs", "captured") or np.ndim(v) == 0
+                else v[:orig_b]
+            )
             for k, v in outputs.items()
         }
 
@@ -766,6 +773,24 @@ class ModelWrapper:
             return self._run_program(bucket, params, cache, device_batch)
 
 
+def _pad_budget_rows(budget, b: int, batch_size: int) -> np.ndarray:
+    """Per-row emission budgets padded to the compiled batch with ONES (not
+    row 0's value): batch padding duplicates row 0's inputs, and under
+    SAMPLED decode a duplicate lane's in-graph chain diverges from row 0
+    after its first draw (each batch index gets its own uniform) — a
+    1-token budget freezes every padding lane right after its first,
+    still-idempotent write, so a diverged lane can never scribble over
+    row 0's cache line."""
+    if budget is None:
+        budget = np.zeros((b,), dtype=np.int32)
+    budget = np.asarray(budget, dtype=np.int32)
+    if b < batch_size:
+        budget = np.concatenate(
+            [budget, np.ones((batch_size - b,), dtype=np.int32)]
+        )
+    return budget
+
+
 class MultiStepTKGWrapper(ModelWrapper):
     """The ``tkg_multistep`` submodel: one AOT-compiled program per
     (step-rung, KV-bucket) pair running K chained decode steps per dispatch
@@ -795,6 +820,9 @@ class MultiStepTKGWrapper(ModelWrapper):
             "eos_token_ids", ((MULTISTEP_EOS_SLOTS,), np.int32)
         )
         self.extra_inputs.setdefault("pad_token_id", ((), np.int32))
+        # per-row in-window emission budget; the zero-fill default means
+        # UNLIMITED so warmup / budget-less callers compile the same graph
+        self.extra_inputs.setdefault("budget_steps", ((), np.int32))
         self._steps_hint = self.max_steps
         self._steps_building = self.max_steps
 
@@ -850,6 +878,9 @@ class MultiStepTKGWrapper(ModelWrapper):
             )
         if "pad_token_id" not in batch_np:
             batch_np["pad_token_id"] = np.zeros((b,), dtype=np.int32)
+        batch_np["budget_steps"] = _pad_budget_rows(
+            batch_np.get("budget_steps"), b, self.batch_size
+        )
         return super().forward(params, cache, batch_np)
 
     def _run_program(self, bucket, params, cache, device_batch):
@@ -865,6 +896,14 @@ class MultiStepTKGWrapper(ModelWrapper):
         steps: Optional[int] = None,
     ):
         self._steps_hint = steps if steps is not None else self.max_steps
+        if "budget_steps" not in device_batch:
+            # the device-resident window chain has no per-row budgets (the
+            # host trims overshoot); zero-fill = UNLIMITED keeps the
+            # compiled signature satisfied without changing its semantics
+            device_batch = dict(device_batch)
+            device_batch["budget_steps"] = jnp.zeros(
+                (self.batch_size,), dtype=jnp.int32
+            )
         return super().forward_device(params, cache, device_batch, total_len)
 
     def warmup_batches(self):
@@ -873,6 +912,171 @@ class MultiStepTKGWrapper(ModelWrapper):
         for steps in self.steps_ladder:
             for batch in super().warmup_batches():
                 batch["decode_steps"] = steps
+                yield batch
+
+
+class DeviceLoopTKGWrapper(ModelWrapper):
+    """The ``tkg_device_loop`` submodel: one AOT-compiled program per
+    (cap-rung, KV-bucket) pair running a device-resident decode
+    ``while_loop`` with per-row EOS + budget exit
+    (models/base.py ``device_loop_token_gen``).
+
+    The cap ladder (autobucketing.device_loop_budget_ladder) sizes the
+    STATIC (B, cap) token out-buffer; the loop's trip count is
+    data-dependent, so unlike the multistep step ladder a rung bounds —
+    never schedules — the work. The dispatcher picks the smallest cap
+    covering the LARGEST per-row budget in the batch (the scan ladder had
+    to cover the smallest), and the KV bucket covers each row's own last
+    write position ``p_i + min(budget_i, cap)`` instead of a uniform
+    ``max_len + steps`` — that asymmetry is exactly what lets near-EOS rows
+    ride a big launch.
+
+    Host contract additions over the multistep wrapper:
+      - ``batch_np["budget_steps"]`` (b,) drives BOTH the in-graph per-row
+        halt and the cap/bucket choice; padding lanes are budgeted 1
+        (see ``_pad_budget_rows``).
+      - ``batch_np["loop_cap"]`` (host int, optional) pins the cap rung —
+        warmup uses it to touch every compiled program.
+      - outputs carry ``loop_iters`` (scalar int32), the iterations the
+        launch actually ran — the host rng schedule advances by it.
+      - with ``outfeed_enabled`` every iteration streams ``(t, tokens,
+        done)`` into the host out-feed ring (``drain_outfeed``); the result
+        buffer is returned either way, so CPU/interpret stays exact.
+    """
+
+    def __init__(
+        self,
+        *args,
+        cap_ladder: Sequence[int],
+        outfeed_enabled: bool = False,
+        **kwargs,
+    ):
+        super().__init__(*args, **kwargs)
+        self.cap_ladder = sorted(cap_ladder)
+        self.max_cap = self.cap_ladder[-1]
+        self.extra_inputs.setdefault(
+            "eos_token_ids", ((MULTISTEP_EOS_SLOTS,), np.int32)
+        )
+        self.extra_inputs.setdefault("pad_token_id", ((), np.int32))
+        self.extra_inputs.setdefault("budget_steps", ((), np.int32))
+        self.outfeed_enabled = bool(outfeed_enabled)
+        self._outfeed_ring: List[tuple] = []
+        self._cap_hint = self.max_cap
+        self._cap_building = self.max_cap
+
+    # -- out-feed ring ---------------------------------------------------
+    def _outfeed_tap(self, t, tokens, done) -> None:
+        # called from XLA via an UNORDERED io_callback: entries may arrive
+        # out of iteration order; each carries its own index t
+        self._outfeed_ring.append(
+            (int(t), np.asarray(tokens).copy(), np.asarray(done).copy())
+        )
+
+    def drain_outfeed(self) -> List[tuple]:
+        """All ``(t, tokens, done)`` entries of the LAST launch, iteration
+        order restored. Flushes pending callbacks first (the unordered
+        io_callback only promises delivery by the effects barrier)."""
+        jax.effects_barrier()
+        ring, self._outfeed_ring = self._outfeed_ring, []
+        return sorted(ring, key=lambda e: e[0])
+
+    # -- build: one program per (cap, bucket) ----------------------------
+    def make_forward(self, bucket: int):
+        from nxdi_tpu.models.base import device_loop_token_gen
+
+        return partial(
+            device_loop_token_gen,
+            self.arch,
+            self.inv_freq,
+            max_steps=self._cap_building,
+            kv_window=bucket,
+            policy=self.policy,
+            layout=self.layout,
+            outfeed=self._outfeed_tap if self.outfeed_enabled else None,
+            **self.forward_kwargs,
+        )
+
+    def build(self, mesh, param_shardings, cache_shardings) -> None:
+        self._mesh = mesh
+        self._param_shardings = param_shardings
+        self._cache_shardings = cache_shardings
+        for cap in self.cap_ladder:
+            self._cap_building = cap
+            for bucket in self.buckets:
+                prog = self._make_program(
+                    bucket, mesh, param_shardings, cache_shardings
+                )
+                prog.label = f"{self.tag}[cap{cap},{bucket}]"
+                self._programs[(cap, bucket)] = prog
+        self._cap_building = self.max_cap
+
+    def _example_for_key(self, key):
+        return self.example_batch(key[1])
+
+    def select_cap(self, max_budget: int) -> int:
+        return autobucketing.get_target_steps(max_budget, self.cap_ladder)
+
+    def forward(self, params, cache, batch_np):
+        batch_np = dict(batch_np)
+        b = np.asarray(batch_np["input_ids"]).shape[0]
+        if "eos_token_ids" not in batch_np:
+            batch_np["eos_token_ids"] = np.full(
+                (b, MULTISTEP_EOS_SLOTS), -1, dtype=np.int32
+            )
+        if "pad_token_id" not in batch_np:
+            batch_np["pad_token_id"] = np.zeros((b,), dtype=np.int32)
+        real_budget = np.asarray(
+            batch_np.get("budget_steps", np.zeros((b,), np.int32)),
+            dtype=np.int32,
+        )
+        cap = batch_np.pop("loop_cap", None)
+        if cap is None:
+            # smallest rung covering the largest per-row ask; an unlimited
+            # (<= 0) budget asks for the full ladder
+            max_ask = (
+                int(real_budget.max(initial=0))
+                if (real_budget > 0).all() and real_budget.size
+                else self.max_cap
+            )
+            cap = self.select_cap(max_ask)
+        cap = int(cap)
+        if cap not in self.cap_ladder:
+            raise ValueError(
+                f"{self.tag}: loop_cap {cap} is not a compiled rung "
+                f"({self.cap_ladder})"
+            )
+        self._cap_hint = cap
+        batch_np["budget_steps"] = _pad_budget_rows(
+            real_budget, b, self.batch_size
+        )
+        # per-row last write position p_i + min(budget_i, cap) sizes the KV
+        # bucket; the base forward adds `lookahead` to pos.max()+1, so feed
+        # it the gap between that and the loop's true reach
+        pos = np.asarray(batch_np["position_ids"], dtype=np.int32)
+        p_last = pos.max(axis=1)  # (b,)
+        m = np.where(real_budget > 0, np.minimum(real_budget, cap), cap)
+        needed = int((p_last + m).max()) if b else cap
+        self.lookahead = max(needed - (int(pos.max()) + 1), 0)
+        self._outfeed_ring.clear()
+        return super().forward(params, cache, batch_np)
+
+    def _run_program(self, bucket, params, cache, device_batch):
+        return self._programs[(self._cap_hint, bucket)](
+            params, cache, device_batch
+        )
+
+    def _telemetry_steps(self) -> int:
+        return self._cap_hint
+
+    def warmup_batches(self):
+        # every (cap rung, bucket) pair is its own compiled program; a
+        # 1-token budget makes the warmed loop exit after one iteration —
+        # warmup pays compilation, not max_cap decode steps
+        for cap in self.cap_ladder:
+            for batch in super().warmup_batches():
+                batch["loop_cap"] = cap
+                b = batch["input_ids"].shape[0]
+                batch["budget_steps"] = np.ones((b,), dtype=np.int32)
                 yield batch
 
 

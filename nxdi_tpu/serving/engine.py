@@ -56,6 +56,7 @@ from nxdi_tpu.runtime.block_manager import BlockSpaceManager
 from nxdi_tpu.runtime.model_wrapper import (
     MULTISTEP_EOS_SLOTS,
     TAG_CONTEXT_ENCODING,
+    TAG_DEVICE_LOOP,
     TAG_MIXED,
     TAG_TOKEN_GENERATION,
     TAG_TOKEN_GENERATION_MULTISTEP,
@@ -149,6 +150,37 @@ class InferenceEngine:
             app, "mixed_supported", False
         )
         self._mixed = app.models[TAG_MIXED] if self.mixed else None
+        # device-resident decode loop: a decode window rides ONE
+        # tkg_device_loop launch (lax.while_loop with per-row EOS/budget
+        # exit in-graph) instead of per-token or per-rung dispatches;
+        # requires the compiled submodel (TpuConfig(device_loop=True))
+        self.device_loop = bool(getattr(tc, "device_loop", False)) and getattr(
+            app, "device_loop_supported", False
+        )
+        self._dloop = app.models[TAG_DEVICE_LOOP] if self.device_loop else None
+        self._loop_launches = None
+        if self.device_loop and tel is not None:
+            r = tel.registry
+            self._loop_launches = r.counter(
+                "nxdi_device_loop_launches_total",
+                "device-resident decode loop launches per cap rung",
+                ("cap",),
+            )
+            self._loop_iters_total = r.counter(
+                "nxdi_device_loop_iterations_total",
+                "while-loop iterations executed across launches per cap rung",
+                ("cap",),
+            )
+            self._loop_tokens_total = r.counter(
+                "nxdi_device_loop_tokens_total",
+                "real tokens retired by device-loop launches per cap rung",
+                ("cap",),
+            )
+            self._loop_tokens_per_dispatch = r.gauge(
+                "nxdi_device_loop_tokens_per_dispatch",
+                "real tokens retired by the LAST device-loop launch (the "
+                "one-dispatch amortization the resident loop exists to buy)",
+            )
         if cfg.chunk_size is None and tc.chunked_prefill_config is not None:
             cfg.chunk_size = tc.chunked_prefill_config.chunk_size
         if (
@@ -368,11 +400,14 @@ class InferenceEngine:
                     victim.request_id,
                 )
         if rows:
-            steps = self._choose_steps(rows)
-            if steps > 1:
-                self._decode_multistep(rows, steps, finished)
+            if self._use_device_loop(rows):
+                self._decode_device_loop(rows, finished)
             else:
-                self._decode_single(rows, finished)
+                steps = self._choose_steps(rows)
+                if steps > 1:
+                    self._decode_multistep(rows, steps, finished)
+                else:
+                    self._decode_single(rows, finished)
         # a preemption-only step still made progress (the freed blocks are
         # what lets the NEXT step admit) — only a true no-op step may trip
         # the stall guard in run()
@@ -598,11 +633,15 @@ class InferenceEngine:
 
     # -- decode -------------------------------------------------------------
     def _choose_steps(self, rows: List[Tuple[int, Request]]) -> int:
-        """Largest compiled multistep rung no slot can overshoot: every row
-        must have >= rung tokens of budget left AND the window's last write
-        must stay inside the compiled decode window. Rows near EOS cannot be
-        predicted — in-scan EOS masking keeps them exact — but rows near
-        ``max_new_tokens`` force the fallback to single-step dispatches."""
+        """Pick the multistep rung for this window. The in-scan per-row
+        ``budget_steps`` mask lets rows near ``max_new_tokens`` join a
+        window — they freeze in-graph after their last real token (KV
+        write dropped, position pinned) and the host discards the pad
+        tail — so the rung no longer clamps to the MINIMUM remaining
+        budget. What remains: every row's LAST real write must stay
+        inside the compiled decode window (per-row math, since a row only
+        advances min(remaining, rung) steps), and rows with more EOS ids
+        than the compiled slots force single-step."""
         if not getattr(self.app, "multistep_supported", False):
             return 1
         if any(
@@ -610,14 +649,23 @@ class InferenceEngine:
         ):
             return 1
         w = self.app.models[TAG_TOKEN_GENERATION_MULTISTEP]
-        min_rem = min(r.remaining for _, r in rows)
-        max_len = max(r.total_len for _, r in rows)
-        rungs = [
-            s
-            for s in w.steps_ladder
-            if s <= min_rem and max_len + s <= self.window_limit + 1
-        ]
-        return max(rungs) if rungs else 1
+        max_rem = max(r.remaining for _, r in rows)
+        if max_rem <= 1:
+            return 1
+
+        def window_ok(s: int) -> bool:
+            return all(
+                r.total_len + min(r.remaining, s) <= self.window_limit + 1
+                for _, r in rows
+            )
+
+        rungs = [s for s in w.steps_ladder if window_ok(s)]
+        if not rungs:
+            return 1
+        covering = [s for s in rungs if s >= max_rem]
+        # the smallest rung that finishes EVERY row beats the biggest rung
+        # that scans (and then discards) a frozen tail
+        return min(covering) if covering else max(rungs)
 
     def _layout_kwargs(
         self, rows: List[Tuple[int, Request]]
@@ -667,6 +715,8 @@ class InferenceEngine:
             reason = req.check_finish()
             if reason:
                 self._finish(req, reason, finished)
+        if self.flight is not None:
+            self.flight.note_decode_tokens(len(rows))
 
     def _decode_multistep(
         self,
@@ -692,6 +742,12 @@ class InferenceEngine:
             ),
             "eos_token_ids": eos,
             "pad_token_id": np.zeros((B,), dtype=np.int32),
+            # per-row remaining budgets: the in-scan mask freezes a row
+            # after its budget-hit token, which is what lets _choose_steps
+            # hand near-EOS rows a window bigger than their budget
+            "budget_steps": np.array(
+                [r.remaining for _, r in rows], dtype=np.int32
+            ),
             "decode_steps": steps,
         }
         batch.update(self._layout_kwargs(rows))
@@ -706,6 +762,7 @@ class InferenceEngine:
         out = self.app.token_gen_multistep(batch)
         toks = np.asarray(jax.device_get(out["tokens"]))[:B]  # (B, steps)
         dt = (clock() - t0) if clock else None
+        total_emitted = 0
         for i, (slot, req) in enumerate(rows):
             emitted = 0
             for j in range(steps):
@@ -714,11 +771,108 @@ class InferenceEngine:
                 reason = req.check_finish()
                 if reason:
                     # later in-window tokens for this row are pad-masked by
-                    # the in-scan EOS logic; discard them
+                    # the in-scan EOS/budget logic; discard them
                     self._finish(req, reason, finished)
                     break
+            total_emitted += emitted
             if req.span is not None and emitted:
                 req.span.tokens(emitted, dt if dt is None else dt * emitted / steps)
+        if self.flight is not None:
+            self.flight.note_decode_tokens(total_emitted)
+
+    def _use_device_loop(self, rows: List[Tuple[int, Request]]) -> bool:
+        """Device-loop admissibility for THIS window: the submodel is
+        compiled, every row's EOS list fits the baked (B, 8) slots, and at
+        least one row has more than a single token left — a 1-token tail
+        is the plain TKG program's home turf, a while-loop launch for it
+        buys nothing."""
+        if not self.device_loop:
+            return False
+        if any(
+            len(r.params.eos_token_ids) > MULTISTEP_EOS_SLOTS for _, r in rows
+        ):
+            return False
+        return max(r.remaining for _, r in rows) > 1
+
+    def _decode_device_loop(
+        self, rows: List[Tuple[int, Request]], finished: List[RequestOutput]
+    ) -> None:
+        """ONE ``tkg_device_loop`` launch serves every row to EOS / budget /
+        fence: the while-loop body runs sample->embed->layers->KV-commit
+        each iteration and the cond exits when all rows halt, so a batch
+        with heterogeneous remaining budgets costs a single dispatch
+        instead of one per token (or per rung). ``device_loop_fence`` caps
+        tokens per launch — the preemption fence: admission, retirement,
+        and preemption all get a scheduling point between launches."""
+        tc = self.tpu_config
+        B = len(rows)
+        eos = np.full((B, MULTISTEP_EOS_SLOTS), -1, dtype=np.int32)
+        for i, (_, r) in enumerate(rows):
+            for j, e in enumerate(r.params.eos_token_ids):
+                eos[i, j] = e
+        budgets = np.array([r.remaining for _, r in rows], dtype=np.int32)
+        fence = int(getattr(tc, "device_loop_fence", 0) or 0)
+        if fence:
+            budgets = np.minimum(budgets, fence)
+        cap = self._dloop.select_cap(int(budgets.max()))
+        batch = {
+            "input_ids": np.array(
+                [[r.generated[-1]] for _, r in rows], dtype=np.int32
+            ),
+            "position_ids": np.array(
+                [[r.total_len - 1] for _, r in rows], dtype=np.int32
+            ),
+            "last_token_index": np.zeros((B,), dtype=np.int32),
+            "sampling_params": SamplingParams.rows_tensor(
+                [r.params for _, r in rows]
+            ),
+            "eos_token_ids": eos,
+            "pad_token_id": np.zeros((B,), dtype=np.int32),
+            "budget_steps": budgets,
+            "loop_cap": cap,
+        }
+        batch.update(self._layout_kwargs(rows))
+        if self._dloop.needs_rng:
+            batch["rng"] = self._rng.next()
+        clock = self.telemetry.clock if self.telemetry is not None else None
+        t0 = clock() if clock else 0.0
+        out = self.app.token_gen_device_loop(batch)
+        toks = np.asarray(jax.device_get(out["tokens"]))[:B]  # (B, cap)
+        iters = int(jax.device_get(out["loop_iters"]))
+        dt = (clock() - t0) if clock else None
+        if self._dloop.needs_rng and iters > 1:
+            # iteration t sampled with counter base+t IN-GRAPH; land the
+            # host schedule where ``iters`` chained 1-step dispatches would
+            # have (the sampled loop-ON/OFF parity contract)
+            self._rng.advance(iters - 1)
+        total_emitted = 0
+        for i, (slot, req) in enumerate(rows):
+            emitted = 0
+            for j in range(min(iters, int(budgets[i]))):
+                req.emit(int(toks[i, j]))
+                emitted += 1
+                reason = req.check_finish()
+                if reason:
+                    # this row halted mid-loop; its later buffer columns
+                    # are pad fill — discard them
+                    self._finish(req, reason, finished)
+                    break
+            total_emitted += emitted
+            if req.span is not None and emitted:
+                req.span.tokens(
+                    emitted, dt if dt is None else dt * emitted / max(iters, 1)
+                )
+        if self.flight is not None:
+            self.flight.record_decode(
+                TAG_DEVICE_LOOP, cap, rows, tc.tkg_batch_size,
+                tokens_emitted=total_emitted,
+            )
+        if self._loop_launches is not None:
+            lbl = str(cap)
+            self._loop_launches.inc(cap=lbl)
+            self._loop_iters_total.inc(iters, cap=lbl)
+            self._loop_tokens_total.inc(total_emitted, cap=lbl)
+            self._loop_tokens_per_dispatch.set(float(total_emitted))
 
     # -- retirement ---------------------------------------------------------
     def _finish(

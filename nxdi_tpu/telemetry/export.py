@@ -143,11 +143,22 @@ def _engine_timeline_events(flight, records, us, dur_us) -> list:
                 "tokens": pf["tokens"],
             }))
         if rec.decode is not None:
+            toks = rec.decode.get("tokens_emitted")
+            dec_args = {
+                "steps": rec.decode["steps"],
+                "padding_rows": rec.decode["padding_rows"],
+            }
+            if toks:
+                # per-token host overhead on the slot track: the launch
+                # amortizes the step's host remainder over every real
+                # token it retired (the device loop's whole point)
+                dec_args["tokens_emitted"] = toks
+                dec_args["host_us_per_tok"] = round(
+                    rec.host_s * 1e6 / toks, 3
+                )
             for row in rec.decode["rows"]:
                 events.append(slot_slice("decode", row["slot"], rec, {
-                    "request_id": row["request_id"],
-                    "steps": rec.decode["steps"],
-                    "padding_rows": rec.decode["padding_rows"],
+                    "request_id": row["request_id"], **dec_args,
                 }))
         for pe in rec.preempted:
             events.append(slot_slice("preempted", pe["slot"], rec, {

@@ -246,7 +246,12 @@ class FlightRecorder:
         })
 
     def record_decode(
-        self, submodel: str, steps: int, rows, batch: int
+        self,
+        submodel: str,
+        steps: int,
+        rows,
+        batch: int,
+        tokens_emitted: Optional[int] = None,
     ) -> None:
         if self.current is not None:
             self.current.decode = {
@@ -257,7 +262,20 @@ class FlightRecorder:
                 ],
                 "batch": batch,
                 "padding_rows": batch - len(rows),
+                # REAL tokens the host unpacked from the dispatch: multistep
+                # and device-loop rows can finish mid-window, so intent-time
+                # rows * steps overstates it. None until the engine notes it
+                # (single/multistep note after unpack; the device loop passes
+                # it directly — the launch already ran when it records).
+                "tokens_emitted": tokens_emitted,
             }
+
+    def note_decode_tokens(self, tokens: int) -> None:
+        """Fill the open step's decode record with the real emitted-token
+        count once the host has unpacked the dispatch."""
+        rec = self.current
+        if rec is not None and rec.decode is not None:
+            rec.decode["tokens_emitted"] = int(tokens)
 
     def record_mixed(
         self,

@@ -40,6 +40,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "spec_tok_s": ("higher", 0.10),
     "spec_accept_tokens_per_window": ("higher", 0.10),
     "tkg_multistep_ms_per_token": ("lower", 0.07),
+    # device-resident decode loop (bench.py --device-loop; PR: device loop).
+    # One-sided and skipped against pre-loop baselines (missing on a side,
+    # like every new-mode field). Tokens-per-dispatch is the loop's whole
+    # point — a drop means launches are exiting early or the cap ladder
+    # regressed — and is near-deterministic, so it gets a tight tolerance.
+    "device_loop_ms_per_tok": ("lower", 0.07),
+    "device_loop_tokens_per_dispatch": ("higher", 0.02),
     "bs1_tok_ms": ("lower", 0.07),
     "spec_bs1_window_ms": ("lower", 0.07),
     "decode_tok_s_8b_int8": ("higher", 0.05),

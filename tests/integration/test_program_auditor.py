@@ -447,6 +447,122 @@ def test_mixed_program_dead_row_ids_detected():
 
 
 # ---------------------------------------------------------------------------
+# device-resident decode loop
+# ---------------------------------------------------------------------------
+
+def test_device_loop_clean_on_device_loop_reference_app():
+    """The shipped ``tkg_device_loop`` programs lower an actual
+    ``stablehlo.while``, keep both per-row halt vectors live, and donate
+    the cache at every cap rung — and the checker is inert on apps without
+    a device-loop submodel."""
+    from nxdi_tpu.runtime.model_wrapper import TAG_DEVICE_LOOP
+
+    report = make_app(device_loop=True).audit(submodels=[TAG_DEVICE_LOOP])
+    assert errors_of(report, "device_loop") == [], report.to_json()
+    assert errors_of(report, "donation") == [], report.to_json()
+    assert report.programs, "device-loop submodel compiled no programs"
+    assert all(p.tag == TAG_DEVICE_LOOP for p in report.programs)
+    # non-loop apps: zero device_loop findings anywhere
+    clean = make_app().audit(checkers=["device_loop"])
+    assert [f for f in clean.findings if f.checker == "device_loop"] == []
+
+
+def test_device_loop_dead_halt_vectors_detected():
+    """Seeded violation: a loop-tagged program whose forward ignores
+    ``budget_steps`` and ``eos_token_ids`` (constant-folded, so
+    kept_var_idx prunes the inputs) would run every lane to the cap —
+    flagged with each pruned halt vector named."""
+    from nxdi_tpu.runtime.model_wrapper import (
+        MULTISTEP_EOS_SLOTS,
+        TAG_DEVICE_LOOP,
+    )
+
+    def dead_halt_forward(arch, inv_freq, params, cache, batch, **kw):
+        batch = dict(batch)
+        batch["budget_steps"] = jnp.full(
+            batch["budget_steps"].shape, 0, jnp.int32
+        )
+        batch["eos_token_ids"] = jnp.full(
+            batch["eos_token_ids"].shape, -1, jnp.int32
+        )
+        return causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+
+    app = make_app()
+    w = seeded_wrapper(
+        app, dead_halt_forward, tag=TAG_DEVICE_LOOP,
+        extra_inputs={
+            "budget_steps": ((), np.int32),
+            "eos_token_ids": ((MULTISTEP_EOS_SLOTS,), np.int32),
+        },
+    )
+    findings = errors_of(audit_seeded(app, w), "device_loop")
+    assert findings, "seeded dead halt vectors not flagged"
+    msg = " | ".join(f.message for f in findings)
+    assert "budget_steps" in msg and "eos_token_ids" in msg
+    assert "DROPPED" in msg
+
+
+def test_device_loop_missing_while_detected():
+    """Seeded violation: a loop-tagged program whose traced jaxpr has no
+    ``while`` primitive (a single fixed step consuming the halt vectors)
+    reverted to fixed-rung semantics — flagged, and the live halt vectors
+    raise no liveness findings of their own. The layer scan's own
+    ``stablehlo.while`` must NOT mask this."""
+    from nxdi_tpu.runtime.model_wrapper import (
+        MULTISTEP_EOS_SLOTS,
+        TAG_DEVICE_LOOP,
+    )
+
+    def no_loop_forward(arch, inv_freq, params, cache, batch, **kw):
+        batch = dict(batch)
+        budget = batch.pop("budget_steps")
+        eos = batch.pop("eos_token_ids")
+        out, cache = causal_lm_forward(arch, inv_freq, params, cache, batch, **kw)
+        out = dict(out)
+        # halt vectors stay LIVE (data dependence) but loop-free
+        keep = (budget.sum() + eos.sum()) * 0
+        out["tokens"] = out["tokens"] + keep.astype(out["tokens"].dtype)
+        return out, cache
+
+    app = make_app()
+    w = seeded_wrapper(
+        app, no_loop_forward, tag=TAG_DEVICE_LOOP,
+        extra_inputs={
+            "budget_steps": ((), np.int32),
+            "eos_token_ids": ((MULTISTEP_EOS_SLOTS,), np.int32),
+        },
+    )
+    findings = errors_of(audit_seeded(app, w), "device_loop")
+    assert findings, "seeded loop-free device-loop program not flagged"
+    msg = " | ".join(f.message for f in findings)
+    assert "traced away" in msg
+    assert "DROPPED" not in msg
+
+
+def test_device_loop_undonated_cache_detected(monkeypatch):
+    """Seeded violation: device-loop programs compiled WITHOUT cache
+    donation double the KV residency for the whole launch — flagged per
+    cache leaf."""
+    from nxdi_tpu.runtime.model_wrapper import TAG_DEVICE_LOOP
+
+    orig_jit = jax.jit
+
+    def jit_without_donation(*args, **kwargs):
+        kwargs.pop("donate_argnums", None)
+        return orig_jit(*args, **kwargs)
+
+    monkeypatch.setattr(jax, "jit", jit_without_donation)
+    app = make_app(device_loop=True)
+    report = app.audit(
+        submodels=[TAG_DEVICE_LOOP], checkers=["device_loop"]
+    )
+    findings = errors_of(report, "device_loop")
+    assert findings, "undonated device-loop cache not flagged"
+    msg = " | ".join(f.message for f in findings)
+    assert "'k'" in msg and "'v'" in msg and "donation" in msg
+
+
+# ---------------------------------------------------------------------------
 # LoRA adapter sharding
 # ---------------------------------------------------------------------------
 

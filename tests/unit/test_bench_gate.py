@@ -304,6 +304,58 @@ def test_mixed_metrics_gate_and_skip_when_absent(tmp_path):
     assert rc == 0
 
 
+def test_device_loop_metrics_gate_and_skip_when_absent(tmp_path):
+    """bench.py --device-loop emits the resident-loop A/B pair:
+    device_loop_ms_per_tok gates lower-is-better, tokens-per-dispatch
+    higher-is-better (a drop means launches exit early or the cap ladder
+    regressed), and both skip against pre-loop baselines."""
+    loop = dict(
+        BASE,
+        device_loop_ms_per_tok=9.1,
+        device_loop_tokens_per_dispatch=128.0,
+        tkg_multistep_ms_per_token=10.4,
+    )
+    # pre-loop baseline: both device_loop_* fields skip
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", loop),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, loop, bench_gate.TOLERANCES)
+    assert "device_loop_ms_per_tok" in skipped
+    assert "device_loop_tokens_per_dispatch" in skipped
+
+    # same-shape baseline: a per-token regression beyond tolerance fails...
+    slower = dict(loop, device_loop_ms_per_tok=10.5)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", slower),
+        "--baseline", _write(tmp_path, "base.json", loop),
+        "-q",
+    ])
+    assert rc == 1
+    # ... launches retiring fewer tokens per dispatch fails ...
+    shallow = dict(loop, device_loop_tokens_per_dispatch=96.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", shallow),
+        "--baseline", _write(tmp_path, "base.json", loop),
+        "-q",
+    ])
+    assert rc == 1
+    # ... and improvements on both pass (one-sided)
+    better = dict(
+        loop,
+        device_loop_ms_per_tok=8.4,
+        device_loop_tokens_per_dispatch=256.0,
+    )
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", better),
+        "--baseline", _write(tmp_path, "base.json", loop),
+        "-q",
+    ])
+    assert rc == 0
+
+
 def test_sentinel_overhead_absolute_gate(tmp_path, capsys):
     """sentinel_overhead_pct (bench.py --serving numerics-sentinel smoke)
     gates against the ABSOLUTE < 3% limit on the fresh record alone: it

@@ -97,6 +97,7 @@ def test_decode_and_retirement_records():
         "rows": [{"slot": 0, "request_id": 10}, {"slot": 2, "request_id": 11}],
         "batch": 4,
         "padding_rows": 2,
+        "tokens_emitted": None,
     }
     assert r.retired == [{"request_id": 11, "slot": 2, "reason": "eos"}]
 
