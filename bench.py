@@ -999,7 +999,7 @@ def _flag_value(name, default):
 
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
-    replica_id=None, rng=None, sentinel=None, mixed=False,
+    replica_id=None, rng=None, sentinel=None, mixed=False, prefix_cache=False,
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -1036,6 +1036,7 @@ def _build_serving_stack(
         telemetry={"detail": "basic", "replica_id": replica_id},
         sentinel=sentinel,
         mixed_dispatch=mixed,
+        is_prefix_caching=prefix_cache,
     )
     cfg = ml.LlamaInferenceConfig(
         tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
@@ -1059,7 +1060,9 @@ def _build_serving_stack(
 
     app = App("<random>", cfg, model_family=ml)
     app.load()
-    return app, InferenceEngine(app, SchedulerConfig(num_slots=slots))
+    return app, InferenceEngine(
+        app, SchedulerConfig(num_slots=slots, prefix_cache=prefix_cache)
+    )
 
 
 def _mean_engine_step_s(engine) -> tuple:
@@ -1297,6 +1300,100 @@ def main_mixed_serving(
     print(json.dumps(rec))
     write_metrics_snapshots(
         {"mixed_serving": app.telemetry.snapshot()}, metrics_out_path()
+    )
+    return rec
+
+
+def main_prefix_serving(
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+    shared_frac=0.75,
+):
+    """``bench.py --serving --prefix-cache``: the radix prefix cache
+    (nxdi_tpu/serving/prefix_cache) on a SHARED-PREFIX Poisson workload —
+    every request opens with the same ``shared_frac`` of the prompt (the
+    multi-tenant system-prompt shape the cache exists for) and differs
+    only in its tail. Both sides run identical geometry and the very same
+    workload: cache ON (is_prefix_caching + SchedulerConfig(prefix_cache))
+    vs cache OFF. Headline pair, gated one-sided by scripts/bench_gate.py
+    (skipped against pre-prefix trajectory files — missing on a side):
+
+    - ``prefix_hit_rate_pct`` — admission lookups that matched; on this
+      workload every request after the first must hit, so a drop means the
+      radix tree or the retire-insert path broke;
+    - ``prefix_goodput_tok_s`` — cache-ON tok/s (the cache pays off as
+      skipped prefill compute), with ``noprefix_goodput_tok_s`` carried
+      alongside as the same-run baseline."""
+    from nxdi_tpu.serving import SamplingParams, drive_arrivals, goodput_summary
+
+    sides = {}
+    for name, on in (("prefix", True), ("noprefix", False)):
+        # identical rng discipline per side: weights THEN arrivals/prompts
+        # from one stream, so both engines see the very same workload
+        rng = np.random.default_rng(0)
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            rng=rng, prefix_cache=on,
+        )
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+        shared = rng.integers(
+            0, 32000, size=int(prompt_len * shared_frac)
+        ).astype(np.int32).tolist()
+        prompts = [
+            shared
+            + rng.integers(
+                0, 32000, size=prompt_len - len(shared) - int(rng.integers(0, 16))
+            ).astype(np.int32).tolist()
+            for _ in range(requests)
+        ]
+        outputs, wall = drive_arrivals(
+            engine,
+            arrivals,
+            lambda eng, i, arrival_s: eng.add_request(
+                prompts[i],
+                SamplingParams(max_new_tokens=max_new),
+                arrival_s=arrival_s,
+            ),
+        )
+        sides[name] = (
+            app,
+            engine,
+            goodput_summary(outputs, wall, slo=app.tpu_config.slo),
+        )
+    app, engine, s = sides["prefix"]
+    pc = engine.scheduler.prefix_cache
+    rec = {
+        "metric": "llama3.2-1b_prefix_serving_goodput",
+        "value": s["tok_s"],
+        "unit": "tok/s",
+        "prefix_goodput_tok_s": s["tok_s"],
+        "prefix_hit_rate_pct": round(pc.hit_rate_pct, 3),
+        "prefix_tokens_saved": pc.tokens_saved_n,
+        "prefix_cow_copies": pc.cow_copies_n,
+        "prefix_evictions": pc.evictions_n,
+        "prefix_ttft_p95_ms": s["ttft_p95_ms"],
+        "noprefix_goodput_tok_s": sides["noprefix"][2]["tok_s"],
+        "prefix_preemptions": s["preemptions"],
+        "serving_requests": requests,
+        "serving_arrival_rate_req_s": rate,
+        "prefix_shared_frac": shared_frac,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged slots{slots} "
+            f"kv{seq_len} prompt~{prompt_len} max_new{max_new} tp1 "
+            f"prefix_cache shared{int(shared_frac * 100)}pct"
+        ),
+        "mode": "prefix_cache_engine",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"prefix_serving": app.telemetry.snapshot()}, metrics_out_path()
     )
     return rec
 
@@ -1626,7 +1723,12 @@ if __name__ == "__main__":
             slo_tpot_ms=_flag_value("--serving-slo-tpot-ms", 25.0),
         )
         _replicas = _flag_value("--replicas", 1)
-        if "--mixed-dispatch" in sys.argv:
+        if "--prefix-cache" in sys.argv:
+            main_prefix_serving(
+                shared_frac=_flag_value("--prefix-shared-frac", 0.75),
+                **_serving_kwargs,
+            )
+        elif "--mixed-dispatch" in sys.argv:
             main_mixed_serving(**_serving_kwargs)
         elif "--routed" in sys.argv:
             main_routed_serving(replicas=max(_replicas, 2), **_serving_kwargs)

@@ -118,7 +118,9 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
     reads straight off each :class:`LoadSignal` (same poll round as the
     scores). With ``dispatches`` (a router attached — see
     :func:`router_dispatch_counts`) a per-replica router-dispatch-count
-    column is appended."""
+    column is appended. ``kv_used`` is NON-RECLAIMABLE usage: replicas
+    running the serving prefix cache count evictable cached blocks as
+    free, so a warm cache never ranks a replica as loaded."""
     out = file if file is not None else sys.stdout
     sigs = {s.replica: s for s in monitor.load_signals()}
     now = monitor.wall_clock()

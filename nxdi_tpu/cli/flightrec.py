@@ -151,8 +151,8 @@ def _print_timeline(records: List[dict], last: int) -> None:
     if len(shown) < len(records):
         print(f"... {len(records) - len(shown)} earlier steps elided ...")
     hdr = (f"{'step':>5} {'wall_ms':>8} {'disp_ms':>8} {'host_ms':>8} "
-           f"{'adm':>3} {'pf':>3} {'dec':>3} {'pre':>3} {'ret':>3} "
-           f"{'kv_free':>7} {'queue':>5}  program")
+           f"{'adm':>3} {'cached':>9} {'pf':>3} {'dec':>3} {'pre':>3} "
+           f"{'ret':>3} {'kv_free':>7} {'queue':>5}  program")
     print(hdr)
     print("-" * len(hdr))
     for r in shown:
@@ -180,10 +180,18 @@ def _print_timeline(records: List[dict], last: int) -> None:
                 # device loop amortizes — one launch retiring N tokens
                 # divides the step's host remainder by N
                 prog += f" tok={toks} host={r['host_s'] * 1e6 / toks:.0f}us/tok"
+        # per-admission prefix-cache reuse: K of N (re)prefill tokens were
+        # already KV-resident this step (summed across the step's admits)
+        adm = r["admitted"]
+        if adm and any("total" in a for a in adm):
+            cached = (f"{sum(a.get('cached', 0) for a in adm)}"
+                      f"/{sum(a.get('total', 0) for a in adm)}")
+        else:
+            cached = "-"
         print(
             f"{r['step']:>5} {r['wall_s'] * 1e3:>8.2f} "
             f"{r['dispatch_s'] * 1e3:>8.2f} {r['host_s'] * 1e3:>8.2f} "
-            f"{len(r['admitted']):>3} {len(r['prefills']):>3} "
+            f"{len(adm):>3} {cached:>9} {len(r['prefills']):>3} "
             f"{len(dec['rows']) if dec else 0:>3} "
             f"{len(r['preempted']):>3} {len(r['retired']):>3} "
             f"{r['kv_blocks_free'] if r['kv_blocks_free'] is not None else '-':>7} "

@@ -60,6 +60,17 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                         "(TpuConfig(mixed_dispatch=True)): every engine "
                         "step packs prefill chunks and decode rows into "
                         "ONE ragged paged-attention program")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="radix prefix cache (serving/prefix_cache): retired "
+                        "requests' full KV blocks enter a token-keyed radix "
+                        "tree; later admissions fork the longest cached "
+                        "prefix and prefill only the tail (LRU eviction "
+                        "feeds the pool on demand)")
+    p.add_argument("--shared-prefix", type=int, default=0, metavar="N",
+                   help="open every demo prompt with the same N-token "
+                        "system prefix (the multi-tenant shape the prefix "
+                        "cache exists for; pair with --prefix-cache to see "
+                        "nxdi_prefix_hits/tokens_saved move)")
     p.add_argument("--force-preempt", type=int, choices=[0, 1], default=1,
                    help="force one recompute preemption if none occurs "
                         "naturally (default 1: the demo must exercise the "
@@ -125,13 +136,23 @@ def run_workload(args, app):
             watermark_blocks=args.watermark_blocks,
             interleave=args.interleave,
             chunk_size=args.chunked_prefill,
+            prefix_cache=getattr(args, "prefix_cache", False),
         ),
         seed=args.seed,
     )
     rng = np.random.default_rng(args.seed)
     arrivals = np.cumsum(rng.exponential(1.0 / args.rate, size=args.requests))
+    shared = (
+        rng.integers(4, 200, size=args.shared_prefix).tolist()
+        if getattr(args, "shared_prefix", 0) > 0 else []
+    )
+    # the compiled window bounds prompt + at least one decode position;
+    # keep the shared prefix short enough that per-request tails survive
+    limit = engine.window_limit - 1
+    shared = shared[: max(0, limit - 4)]
     prompts = [
-        rng.integers(4, 200, size=int(rng.integers(5, 13))).tolist()
+        (shared + rng.integers(4, 200, size=int(rng.integers(5, 13))).tolist())
+        [:limit]
         for _ in range(args.requests)
     ]
 
@@ -219,6 +240,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         }
     if args.mixed_dispatch:
         tpu_kwargs["mixed_dispatch"] = True
+    if args.prefix_cache:
+        # compiles the prefix-prefill submodel so cache-hit admissions can
+        # start their (re)prefill mid-sequence (mixed dispatch packs
+        # arbitrary starts already and needs no extra submodel)
+        tpu_kwargs["is_prefix_caching"] = True
     if args.chunked_prefill and not args.mixed_dispatch:
         # under mixed dispatch chunk_size is pure packing policy (the
         # SchedulerConfig above carries it); no prefix-prefill submodel
@@ -246,6 +272,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     # exact per-request percentiles, SLO fields when targets were declared
     summary = goodput_summary(outputs, wall, slo=app.tpu_config.slo)
     _note(args.quiet, f"[serve] {json.dumps(summary)}")
+    pc = engine.scheduler.prefix_cache
+    if pc is not None:
+        _note(args.quiet,
+              f"[serve] prefix cache: hit_rate={pc.hit_rate_pct:.1f}% "
+              f"tokens_saved={pc.tokens_saved_n} cached_blocks={len(pc)} "
+              f"evictions={pc.evictions_n} cow_copies={pc.cow_copies_n}")
     if engine.flight is not None and engine.flight.postmortems:
         _note(args.quiet,
               f"[serve] postmortem bundles: {engine.flight.postmortems}")

@@ -23,6 +23,7 @@ same effect from its scatter at position_ids, kv_cache_manager.py:374).
 from __future__ import annotations
 
 from dataclasses import dataclass
+from functools import partial
 from typing import Dict, Optional, Tuple
 
 import jax
@@ -571,3 +572,33 @@ DEFAULT_KV_LAYOUT = ContiguousKVLayout()
 def reset_kv_cache(cache: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
     """Zero the cache (reference: model_base.py:3964 ``reset_kv_cache``)."""
     return jax.tree_util.tree_map(jnp.zeros_like, cache)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _copy_kv_slots(cache, src_slots, dst_slots):
+    out = dict(cache)
+    for key in ("k", "v"):
+        arr = cache[key]
+        out[key] = arr.at[:, dst_slots].set(arr[:, src_slots])
+    return out
+
+
+def copy_kv_blocks(cache, src_blocks, dst_blocks, block_size: int):
+    """Device-side KV block copy on the paged pool — the copy-on-write
+    primitive: every slot of each ``src`` block is duplicated into the
+    matching ``dst`` block across all layers for both k and v, in place
+    (the cache is donated, as every forward already does). The serving
+    engine calls this when a sequence must write into a block whose
+    refcount says it is shared (prefix-cache partial blocks, ``n > 1``
+    continuation forks) — the host-side table swap is
+    ``BlockSpaceManager.cow_block``; this is the data movement."""
+    src = np.asarray(src_blocks, dtype=np.int32).reshape(-1)
+    dst = np.asarray(dst_blocks, dtype=np.int32).reshape(-1)
+    if src.shape != dst.shape:
+        raise ValueError(f"src/dst block counts differ: {src.shape} vs {dst.shape}")
+    if src.size == 0:
+        return cache
+    offs = np.arange(block_size, dtype=np.int32)
+    src_slots = (src[:, None] * block_size + offs[None, :]).reshape(-1)
+    dst_slots = (dst[:, None] * block_size + offs[None, :]).reshape(-1)
+    return _copy_kv_slots(cache, src_slots, dst_slots)

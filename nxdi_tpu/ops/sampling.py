@@ -74,11 +74,19 @@ class SamplingParams:
     top_k: int = 1
     top_p: float = 1.0
     temperature: float = 1.0
+    #: continuations to generate from ONE prompt (best-of-n). The serving
+    #: engine expands n > 1 into sibling requests that fork the parent's
+    #: prompt KV blocks copy-on-write instead of re-prefilling n times
+    #: (paged layout; elsewhere siblings simply prefill). Host-side only —
+    #: never part of the per-row sampling tensor.
+    n: int = 1
 
     def __post_init__(self):
         self.eos_token_ids = tuple(normalize_eos_ids(self.eos_token_ids))
         if self.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if self.n < 1:
+            raise ValueError("n must be >= 1")
 
     def row(self) -> Tuple[float, float, float]:
         """One (top_k, top_p, temperature) sampling row; greedy unless

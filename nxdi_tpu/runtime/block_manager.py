@@ -22,7 +22,15 @@ class BlockSpaceManager:
     With ``telemetry`` (a ``nxdi_tpu.telemetry.Telemetry``, typically
     ``app.telemetry``) attached, pool occupancy is published as the
     ``nxdi_kv_blocks_free``/``nxdi_kv_blocks_used`` gauges and fork/free
-    events count into ``nxdi_kv_block_forks_total``/``nxdi_kv_block_frees_total``.
+    events count PER BLOCK into ``nxdi_kv_block_forks_total``/
+    ``nxdi_kv_block_frees_total`` (a 12-block fork is 12 forks of pool
+    churn, not one event).
+
+    A ``reclaimer`` (the serving prefix cache) may hold blocks that no
+    sequence references: those stay out of ``_free`` but are released on
+    demand, so ``num_free_blocks`` — the admission/watermark arithmetic —
+    reports free + reclaimable and an exhausted pool asks the reclaimer to
+    evict before failing an allocation.
     """
 
     def __init__(self, num_blocks: int, block_size: int, telemetry=None):
@@ -32,18 +40,36 @@ class BlockSpaceManager:
         self._tables: Dict[int, List[int]] = {}
         self._refs = np.zeros(num_blocks, dtype=np.int64)
         self.telemetry = telemetry
+        #: optional prefix cache: must expose ``reclaimable() -> int`` and
+        #: ``evict(n) -> int`` (release >= min(n, reclaimable) blocks into
+        #: the pool via release_block)
+        self.reclaimer = None
         self._publish()
 
     # ------------------------------------------------------------------
     def num_free_blocks(self) -> int:
-        return len(self._free)
+        """Allocatable blocks: the free list plus whatever the reclaimer
+        (prefix cache) could evict on demand — the "free" the scheduler's
+        watermark/admission arithmetic must see, or a warm cache would
+        read as pool pressure."""
+        n = len(self._free)
+        if self.reclaimer is not None:
+            n += self.reclaimer.reclaimable()
+        return n
+
+    def refcount(self, blk: int) -> int:
+        return int(self._refs[blk])
 
     def _publish(self) -> None:
         tel = self.telemetry
         if tel is None or not tel.enabled:
             return
-        tel.kv_blocks_free.set(len(self._free))
-        tel.kv_blocks_used.set(self.num_blocks - len(self._free))
+        # free includes reclaimable cache blocks (see num_free_blocks), so
+        # nxdi_kv_blocks_used — and the router's kv_used_frac derived from
+        # it — means NON-RECLAIMABLE usage: a warm prefix cache is not load
+        free = self.num_free_blocks()
+        tel.kv_blocks_free.set(free)
+        tel.kv_blocks_used.set(self.num_blocks - free)
 
     def blocks_needed(self, seq_id: int, num_tokens: int) -> int:
         """NEW blocks ``ensure_capacity(seq_id, num_tokens)`` would have to
@@ -59,28 +85,54 @@ class BlockSpaceManager:
         needed = -(-num_tokens // self.block_size)
         try:
             while len(table) < needed:
-                if not self._free:
-                    raise RuntimeError(
-                        f"KV block pool exhausted ({self.num_blocks} blocks); "
-                        f"free a sequence or raise pa_num_blocks"
-                    )
-                blk = self._free.popleft()
-                self._refs[blk] += 1
-                table.append(blk)
+                table.append(self._alloc_block())
         finally:
             self._publish()
         return table
 
-    def fork_prefix(self, seq_id: int, prefix_table: Sequence[int]) -> None:
+    def _alloc_block(self) -> int:
+        """Pop one free block (refcount 1), evicting from the reclaimer
+        (prefix cache) first when the free list is dry. Raises on a truly
+        exhausted pool (caller preempts)."""
+        if not self._free and self.reclaimer is not None:
+            self.reclaimer.evict(1)
+        if not self._free:
+            raise RuntimeError(
+                f"KV block pool exhausted ({self.num_blocks} blocks); "
+                f"free a sequence or raise pa_num_blocks"
+            )
+        blk = self._free.popleft()
+        self._refs[blk] += 1
+        return blk
+
+    def fork_prefix(
+        self, seq_id: int, prefix_table: Sequence[int], resurrect: bool = False
+    ) -> None:
         """Start seq_id with shared (refcounted) prefix blocks — prefix caching
-        (reference: is_prefix_caching config + 2-D prefix buckets)."""
+        (reference: is_prefix_caching config + 2-D prefix buckets).
+
+        Blocks with refcount 0 sit in the free list; incrementing them
+        without removal would let the allocator hand the same block to
+        another sequence (two sequences aliasing one KV region). Such a
+        fork is rejected unless ``resurrect=True``, which pulls the block
+        back out of ``_free`` (its KV content is whatever the last owner
+        left — callers must know it is still valid)."""
         if seq_id in self._tables:
             raise ValueError(f"seq {seq_id} already allocated")
+        dead = [blk for blk in prefix_table if self._refs[blk] == 0]
+        if dead and not resurrect:
+            raise ValueError(
+                f"fork_prefix({seq_id}): blocks {dead} have refcount 0 (they "
+                "are in the free pool and would be double-allocated); hold a "
+                "reference before forking or pass resurrect=True"
+            )
+        for blk in dead:
+            self._free.remove(blk)
         for blk in prefix_table:
             self._refs[blk] += 1
         self._tables[seq_id] = list(prefix_table)
-        if self.telemetry is not None and self.telemetry.enabled:
-            self.telemetry.kv_block_forks_total.inc()
+        if prefix_table and self.telemetry is not None and self.telemetry.enabled:
+            self.telemetry.kv_block_forks_total.inc(len(prefix_table))
         self._publish()
 
     def free_seq(self, seq_id: int) -> None:
@@ -90,8 +142,52 @@ class BlockSpaceManager:
             if self._refs[blk] == 0:
                 self._free.append(blk)
         if freed and self.telemetry is not None and self.telemetry.enabled:
-            self.telemetry.kv_block_frees_total.inc()
+            self.telemetry.kv_block_frees_total.inc(len(freed))
         self._publish()
+
+    # -- cache retention / copy-on-write -------------------------------
+    def retain_block(self, blk: int) -> None:
+        """Take one table-less reference (the prefix cache's own hold) on a
+        LIVE block. Refcount-0 blocks are in the free pool — retaining one
+        would alias it with a future allocation, so that is an error."""
+        if self._refs[blk] == 0:
+            raise ValueError(
+                f"retain_block({blk}): block is free; retain must happen "
+                "while the owning sequence still holds it"
+            )
+        self._refs[blk] += 1
+        self._publish()
+
+    def release_block(self, blk: int) -> None:
+        """Drop one table-less reference; the block rejoins the free pool
+        when nobody else holds it (prefix-cache eviction path)."""
+        if self._refs[blk] <= 0:
+            raise ValueError(f"release_block({blk}): block is not held")
+        self._refs[blk] -= 1
+        if self._refs[blk] == 0:
+            self._free.append(blk)
+        self._publish()
+
+    def cow_block(self, seq_id: int, block_idx: int) -> tuple:
+        """Copy-on-write: give ``seq_id`` a PRIVATE copy of the shared block
+        at table index ``block_idx`` before it writes there. Allocates a
+        fresh block, swaps it into the table, and drops one reference on
+        the shared original (which other holders keep). Returns
+        ``(src_blk, dst_blk)`` so the caller can issue the device-side KV
+        copy (kvcache.kv_cache.copy_kv_blocks) — the manager only does the
+        host bookkeeping."""
+        table = self._tables[seq_id]
+        src = table[block_idx]
+        if self._refs[src] <= 1:
+            raise ValueError(
+                f"cow_block({seq_id}, {block_idx}): block {src} is not "
+                "shared (refcount <= 1); write in place instead"
+            )
+        dst = self._alloc_block()
+        table[block_idx] = dst
+        self._refs[src] -= 1
+        self._publish()
+        return src, dst
 
     # ------------------------------------------------------------------
     def block_table(self, seq_id: int, width: Optional[int] = None) -> np.ndarray:

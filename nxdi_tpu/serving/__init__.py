@@ -13,6 +13,11 @@ tables and seq_ids. This package supplies that missing layer natively:
   block-table routed prefill into free slots, one batched decode per step
   (``tkg_multistep`` windows when no slot is near finishing), retirement
   and slot recycling.
+- :mod:`~nxdi_tpu.serving.prefix_cache` — radix tree of retained KV block
+  chains (``SchedulerConfig(prefix_cache=True)``, paged layout): admission
+  forks the longest cached full-block prefix and prefills only the tail;
+  LRU eviction of unreferenced blocks feeds the pool on demand; shared
+  partial-block writes (``SamplingParams(n > 1)`` forks) copy-on-write.
 
 Demo: ``python -m nxdi_tpu.cli.serve`` (Poisson arrivals over the paged
 tiny-llama reference app). Correctness anchor: greedy engine outputs are
@@ -21,6 +26,7 @@ forced preemption (tests/integration/test_serving_engine.py).
 """
 
 from nxdi_tpu.serving.engine import InferenceEngine
+from nxdi_tpu.serving.prefix_cache import PrefixCache
 from nxdi_tpu.serving.request import (
     FINISHED,
     PREEMPTED,
@@ -36,6 +42,7 @@ from nxdi_tpu.serving.workload import drive_arrivals, goodput_summary
 
 __all__ = [
     "InferenceEngine",
+    "PrefixCache",
     "drive_arrivals",
     "goodput_summary",
     "Request",

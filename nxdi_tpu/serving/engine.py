@@ -200,6 +200,38 @@ class InferenceEngine:
         self.scheduler = Scheduler(
             num_slots, block_manager=self.block_manager, config=cfg, telemetry=tel
         )
+        # radix prefix cache (serving/prefix_cache.py): retired sequences'
+        # full KV blocks enter a radix tree; admissions fork the longest
+        # cached prefix and prefill only the tail. Needs the paged layout
+        # plus the ability to continue a prefill from a nonzero position
+        # (prefix-prefill submodel or mixed dispatch).
+        self.prefix_cache = None
+        self._cow_counter = None
+        if cfg.prefix_cache:
+            if not self.paged:
+                raise ValueError(
+                    "prefix_cache requires the paged KV layout "
+                    "(is_block_kv_layout=True)"
+                )
+            if TAG_PREFIX_PREFILL not in app.models and not self.mixed:
+                raise ValueError(
+                    "prefix_cache starts prefills at the cached position; "
+                    "compile the app with is_prefix_caching (or "
+                    "chunked_prefill_config) for the prefix-prefill "
+                    "submodel, or with mixed_dispatch"
+                )
+            from nxdi_tpu.serving.prefix_cache import PrefixCache
+
+            self.prefix_cache = PrefixCache(self.block_manager, telemetry=tel)
+            self.scheduler.prefix_cache = self.prefix_cache
+        elif self.paged and tel is not None:
+            # COW can fire without the cache (n>1 continuation forks), so
+            # the counter family must exist either way
+            self._cow_counter = tel.registry.counter(
+                "nxdi_prefix_cow_copies",
+                "private block copies materialized before a shared-block write",
+            )
+            self._cow_counter.inc(0)
         self.window_limit = decode_window_limit(tc, app.models)
         self._table_width = (
             -(-tc.seq_len // tc.pa_block_size) if self.paged else 0
@@ -207,6 +239,11 @@ class InferenceEngine:
         self._rng = StepRngSchedule(seed)
         self._tkg = app.models[TAG_TOKEN_GENERATION]
         self._can_continue_prefill = TAG_PREFIX_PREFILL in app.models
+        # n>1 sibling forks also start their tail prefill mid-prompt, so
+        # the scheduler may only fork when a continuation path is compiled
+        self.scheduler.can_fork = self.paged and (
+            self._can_continue_prefill or self.mixed
+        )
         self._progress = False
 
         # flight recorder + SLO tracker (telemetry/flight.py, telemetry/
@@ -270,6 +307,28 @@ class InferenceEngine:
         domain (``time.perf_counter`` under the default clock).
         ``session_id`` is the conversation identity the router tier keys
         affinity on; it rides the request span."""
+        if params is not None and params.n > 1:
+            # best-of-n: ONE prompt, n continuations. The primary request
+            # prefills normally; each sibling is its own request that — on
+            # the paged layout with a continuation path compiled — forks
+            # the parent's committed prompt blocks at admission and
+            # prefills only the last prompt token (sampling its own first
+            # token), copy-on-writing the shared partial block on first
+            # write. Elsewhere siblings degrade to plain re-prefills.
+            base = dataclasses.replace(params, n=1)
+            primary = self.add_request(
+                prompt, base, on_token=on_token, request_id=request_id,
+                arrival_s=arrival_s, session_id=session_id,
+            )
+            for _ in range(params.n - 1):
+                sib = self.add_request(
+                    prompt, base, on_token=on_token,
+                    arrival_s=primary.arrival_s, session_id=session_id,
+                )
+                if self.paged:
+                    sib.fork_of = primary
+                sib.fork_parent_id = primary.request_id
+            return primary
         tel = self.telemetry
         if arrival_s is None and tel is not None and tel.enabled:
             # stamp arrival through the telemetry clock, not a hardcoded
@@ -399,6 +458,7 @@ class InferenceEngine:
                     "preempted request %d (recompute on re-admission)",
                     victim.request_id,
                 )
+            rows = self._cow_decode_rows(rows)
         if rows:
             if self._use_device_loop(rows):
                 self._decode_device_loop(rows, finished)
@@ -436,6 +496,7 @@ class InferenceEngine:
                     "preempted request %d (recompute on re-admission)",
                     victim.request_id,
                 )
+            rows = self._cow_decode_rows(rows)
         prefills = [r for r in prefills if r.state == RUNNING]
 
         w = self._mixed
@@ -452,6 +513,15 @@ class InferenceEngine:
             start = req.num_prefilled
             chunk = req.seq_tokens[: req.prefill_target][start : start + room]
             if not chunk:
+                continue
+            try:
+                self._cow_for_write(req, start, start + len(chunk))
+            except RuntimeError:
+                logger.info(
+                    "preempted request %d: no block for its COW copy",
+                    req.request_id,
+                )
+                self.scheduler._preempt(req)
                 continue
             tokens.extend(chunk)
             positions.extend(range(start, start + len(chunk)))
@@ -527,6 +597,7 @@ class InferenceEngine:
             req.num_prefilled += n
             if not req.prefill_done:
                 continue  # more chunks next step; decodes keep interleaving
+            self.scheduler.note_prefill_complete(req)
             if (
                 self.sentinel is not None
                 and self.sentinel.config.preemption_check
@@ -568,6 +639,59 @@ class InferenceEngine:
                 )
         return outputs
 
+    # -- copy-on-write ------------------------------------------------------
+    def _cow_for_write(self, req: Request, lo: int, hi: int) -> None:
+        """Before ``req`` writes KV for positions ``[lo, hi)``, give it a
+        private copy of every SHARED block the range touches (refcount > 1:
+        a prefix-cache chain or an ``n > 1`` fork still holds it). The
+        manager swaps the table entry (``cow_block``); the data moves on
+        device (``copy_kv_blocks``). Full-block cache hits never trigger
+        this — the uncached tail starts block-aligned — so in practice it
+        fires on the partial prompt block an n-fork shares."""
+        mgr = self.block_manager
+        if mgr is None or hi <= lo:
+            return
+        table = mgr._tables.get(req.request_id)
+        if not table:
+            return
+        bs = mgr.block_size
+        src: List[int] = []
+        dst: List[int] = []
+        for bi in range(lo // bs, min((hi - 1) // bs, len(table) - 1) + 1):
+            if mgr._refs[table[bi]] > 1:
+                s, d = mgr.cow_block(req.request_id, bi)
+                src.append(s)
+                dst.append(d)
+        if src:
+            from nxdi_tpu.kvcache.kv_cache import copy_kv_blocks
+
+            self.app.kv_cache = copy_kv_blocks(self.app.kv_cache, src, dst, bs)
+            if self.prefix_cache is not None:
+                self.prefix_cache.note_cow(len(src))
+            elif self._cow_counter is not None:
+                self._cow_counter.inc(len(src))
+
+    def _cow_decode_rows(
+        self, rows: List[Tuple[int, Request]]
+    ) -> List[Tuple[int, Request]]:
+        """COW each decode row's next write position. A row whose private
+        copy cannot be allocated (pool truly dry even after cache
+        eviction) is preempted instead of faulting the whole step."""
+        if self.block_manager is None:
+            return rows
+        kept: List[Tuple[int, Request]] = []
+        for slot, req in rows:
+            try:
+                self._cow_for_write(req, req.total_len - 1, req.total_len)
+                kept.append((slot, req))
+            except RuntimeError:
+                logger.info(
+                    "preempted request %d: no block for its COW copy",
+                    req.request_id,
+                )
+                self.scheduler._preempt(req)
+        return kept
+
     # -- prefill ------------------------------------------------------------
     def _prefill_chunk(self, req: Request, finished: List[RequestOutput]) -> None:
         seq = req.seq_tokens[: req.prefill_target]
@@ -588,6 +712,16 @@ class InferenceEngine:
             return
         chunk = seq[start : start + limit]
         n = len(chunk)
+        try:
+            self._cow_for_write(req, start, start + n)
+        except RuntimeError:
+            # pool dry even after cache eviction: requeue rather than fault
+            logger.info(
+                "preempted request %d: no block for its COW copy",
+                req.request_id,
+            )
+            self.scheduler._preempt(req)
+            return
         ids = np.asarray([chunk], dtype=np.int32)
         pos = (start + np.arange(n, dtype=np.int32))[None, :]
         kwargs = self._layout_kwargs([(req.slot, req)])
@@ -608,6 +742,7 @@ class InferenceEngine:
         req.num_prefilled += n
         if not req.prefill_done:
             return  # more chunks next step; decodes interleave meanwhile
+        self.scheduler.note_prefill_complete(req)
         if (
             self.sentinel is not None
             and self.sentinel.config.preemption_check
@@ -881,6 +1016,9 @@ class InferenceEngine:
         slot = req.slot  # retire() recycles it; the record keeps the row
         self.scheduler.retire(req, reason)
         metrics: Dict[str, float] = {"preemptions": req.preemptions}
+        if req.fork_parent_id is not None:
+            # n>1 sibling: callers group continuations by the parent id
+            metrics["parent_request_id"] = req.fork_parent_id
         if req.span is not None:
             req.span.finish()
             metrics["ttft_s"] = req.span.ttft_s
@@ -958,6 +1096,17 @@ class InferenceEngine:
                 if self.block_manager is not None else None
             ),
             "watermark_blocks": sch.config.watermark_blocks,
+            "prefix_cache": (
+                None if self.prefix_cache is None else {
+                    "cached_blocks": len(self.prefix_cache),
+                    "reclaimable": self.prefix_cache.reclaimable(),
+                    "hits": self.prefix_cache.hits_n,
+                    "misses": self.prefix_cache.misses_n,
+                    "evictions": self.prefix_cache.evictions_n,
+                    "cow_copies": self.prefix_cache.cow_copies_n,
+                    "tokens_saved": self.prefix_cache.tokens_saved_n,
+                }
+            ),
         }
 
     def _tokens_of(self, outputs) -> np.ndarray:

@@ -94,6 +94,12 @@ class Request:
         self.finish_reason: Optional[str] = None
         self.span = None  # telemetry RequestSpan (engine-owned)
         self._admit_seq = -1  # admission order; youngest = max
+        #: live parent Request this one is an ``n > 1`` continuation of:
+        #: admission forks the parent's prompt KV blocks (COW) instead of
+        #: re-prefilling; cleared when the parent is no longer forkable
+        self.fork_of: Optional["Request"] = None
+        #: stable parent id for output grouping (survives fork_of clearing)
+        self.fork_parent_id: Optional[int] = None
 
     # -- derived views ------------------------------------------------------
     @property

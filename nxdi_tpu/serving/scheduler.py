@@ -61,6 +61,12 @@ class SchedulerConfig:
     #: prompt tokens prefilled per step; None = whole prompt in one dispatch
     #: (set from chunked_prefill_config.chunk_size by the engine)
     chunk_size: Optional[int] = None
+    #: radix prefix cache (serving/prefix_cache.py): retired sequences'
+    #: full KV blocks enter a radix tree and later admissions fork the
+    #: longest cached prefix instead of re-prefilling it. Paged layout
+    #: only, and the engine must be able to continue a prefill from a
+    #: nonzero position (prefix-prefill submodel or mixed dispatch).
+    prefix_cache: bool = False
 
     def __post_init__(self):
         if self.interleave not in INTERLEAVE_POLICIES:
@@ -96,6 +102,14 @@ class Scheduler:
         self.num_slots = num_slots
         self.block_manager = block_manager
         self.telemetry = telemetry
+        # serving/prefix_cache.PrefixCache, attached by the owning engine
+        # when config.prefix_cache is on: admission forks cached chains,
+        # retirement/preemption insert retired full blocks into the tree
+        self.prefix_cache = None
+        # set by the engine when a fork's tail prefill can actually start
+        # mid-prompt (prefix-prefill submodel or mixed dispatch compiled);
+        # without it n>1 siblings fall back to full prefills
+        self.can_fork = False
         # telemetry/flight.FlightRecorder, set by the owning engine: the
         # scheduler is where slot identity is still known at admission and
         # preemption time, so it records those transitions
@@ -175,6 +189,8 @@ class Scheduler:
             if slot is None:
                 break
             req = self.waiting[0]
+            if not self._fork_ready(req):
+                break  # n>1 sibling: hold until its parent's prefill lands
             if not self._admissible(req):
                 break
             self.waiting.popleft()
@@ -190,6 +206,20 @@ class Scheduler:
                 return i
         return None
 
+    def _fork_ready(self, req: Request) -> bool:
+        """Gate for ``n > 1`` continuation siblings: admit only once the
+        parent's prompt KV is committed (its prefill landed) so the fork
+        shares real blocks. A finished/errored parent is no longer
+        forkable — the sibling falls back to a normal prefill (which the
+        prefix cache may still shortcut)."""
+        parent = req.fork_of
+        if parent is None or not self.can_fork:
+            return True
+        if parent.state == FINISHED:
+            req.fork_of = None
+            return True
+        return parent.state == RUNNING and parent.prefill_done
+
     def _place(self, req: Request, slot: int) -> None:
         req.slot = slot
         req.state = RUNNING
@@ -197,16 +227,96 @@ class Scheduler:
         req.prefill_target = len(req.seq_tokens)
         self._admit_counter += 1
         req._admit_seq = self._admit_counter
+        cached = 0
         if self.block_manager is not None:
+            cached = self._fork_shared(req)
             # covers the whole (re)prefill; decode growth is incremental
             self.block_manager.ensure_capacity(req.request_id, len(req.seq_tokens))
+        req.fork_of = None
+        # the engine's (re)prefill starts AFTER the shared prefix: chunked
+        # prefill and mixed packing just see a shorter remaining prompt
+        req.num_prefilled = cached
         if req.span is not None:
             req.span.phase("prefill")
         self.slots[slot] = req
         if self.flight is not None:
             self.flight.record_admission(
-                req.request_id, slot, resumed=req.preemptions > 0
+                req.request_id, slot, resumed=req.preemptions > 0,
+                cached_tokens=cached, total_tokens=len(req.seq_tokens),
             )
+
+    def _fork_shared(self, req: Request) -> int:
+        """Hand ``req`` whatever committed KV it can share instead of
+        re-prefilling: an ``n > 1`` sibling forks its live parent's prompt
+        blocks (all blocks the first ``len(prompt) - 1`` positions touch —
+        the last prompt token is left to the sibling's own tail prefill so
+        it samples its own first token; if that boundary lands inside the
+        parent's partial block, the first write copy-on-writes it); any
+        other request forks the prefix cache's longest full-block match.
+        Returns the token count the fork covers (= the new
+        ``num_prefilled``)."""
+        mgr = self.block_manager
+        parent = req.fork_of
+        if (
+            parent is not None
+            and self.can_fork
+            and parent.state == RUNNING
+            and parent.prefill_done
+        ):
+            p = len(req.prompt) - 1
+            nb = -(-p // mgr.block_size)
+            ptable = mgr._tables.get(parent.request_id, [])
+            if p > 0 and len(ptable) >= nb:
+                mgr.fork_prefix(req.request_id, ptable[:nb])
+                return p
+        cache = self.prefix_cache
+        if cache is not None and len(req.seq_tokens) > 1:
+            chain, ntok = cache.match(
+                req.seq_tokens, max_tokens=len(req.seq_tokens) - 1
+            )
+            if chain:
+                mgr.fork_prefix(req.request_id, chain)
+            return ntok
+        return 0
+
+    def note_prefill_complete(self, req: Request) -> None:
+        """Cross-request sharing without waiting for retirement: the moment
+        a (re)prefill lands, every full block it committed enters the radix
+        tree — CONCURRENT shared-prefix traffic (the Poisson multi-tenant
+        shape) hits while the first request is still decoding. The engine
+        calls this when ``prefill_done`` flips. Committed positions: all of
+        ``prefill_target`` (a prefill writes its whole chunk's KV; the
+        decode-emitted token after it has none yet). Decode growth never
+        touches these blocks — writes land at positions >= prefill_target,
+        beyond the inserted FULL blocks — so the retained chain stays
+        immutable; duplicate paths dedup inside ``PrefixCache.insert``."""
+        cache = self.prefix_cache
+        mgr = self.block_manager
+        if cache is None or mgr is None:
+            return
+        k = req.prefill_target
+        if k < mgr.block_size:
+            return
+        table = mgr._tables.get(req.request_id)
+        if table:
+            cache.insert(req.seq_tokens[:k], table)
+
+    def _cache_insert(self, req: Request) -> None:
+        """Feed a departing sequence's committed full blocks into the radix
+        tree (BEFORE ``free_seq`` drops its table, so the cache's retain
+        lands while the blocks are still live). Committed positions: every
+        prefilled chunk, and — once prefill is done — everything but the
+        just-emitted last token (whose KV was never written)."""
+        cache = self.prefix_cache
+        mgr = self.block_manager
+        if cache is None or mgr is None:
+            return
+        k = max(req.total_len - 1, 0) if req.prefill_done else req.num_prefilled
+        if k < mgr.block_size:
+            return
+        table = mgr._tables.get(req.request_id)
+        if table:
+            cache.insert(req.seq_tokens[:k], table)
 
     # -- decode growth / preemption ----------------------------------------
     def ensure_decode_capacity(
@@ -256,11 +366,17 @@ class Scheduler:
         self.slots[req.slot] = None
         req.slot = None
         req.state = PREEMPTED
+        if self.block_manager is not None:
+            # the victim's committed blocks enter the cache instead of
+            # dropping: its recompute-resume (and any shared-prompt peer)
+            # re-forks them, so preemption stops costing a full re-prefill.
+            # Must run while num_prefilled/prefill_target still describe
+            # the committed KV — they are reset just below.
+            self._cache_insert(req)
+            self.block_manager.free_seq(req.request_id)
         req.num_prefilled = 0
         req.prefill_target = 0
         req.preemptions += 1
-        if self.block_manager is not None:
-            self.block_manager.free_seq(req.request_id)
         if req.span is not None:
             req.span.phase("queue")
         self.waiting.appendleft(req)
@@ -277,6 +393,8 @@ class Scheduler:
             self.slots[req.slot] = None
             req.slot = None
         if self.block_manager is not None:
+            if reason != "error":
+                self._cache_insert(req)
             self.block_manager.free_seq(req.request_id)
         req.state = FINISHED
         req.finish_reason = reason

@@ -36,10 +36,16 @@ Metric catalog (labels in parens):
 ``nxdi_request_tpot_seconds``         histogram
 ``nxdi_request_tokens_in_total``      counter
 ``nxdi_request_tokens_out_total``     counter
-``nxdi_kv_blocks_free``               gauge
-``nxdi_kv_blocks_used``               gauge
-``nxdi_kv_block_forks_total``         counter
-``nxdi_kv_block_frees_total``         counter
+``nxdi_kv_blocks_free``               gauge      (free + cache-reclaimable)
+``nxdi_kv_blocks_used``               gauge      (non-reclaimable usage)
+``nxdi_kv_block_forks_total``         counter    (PER BLOCK forked)
+``nxdi_kv_block_frees_total``         counter    (PER BLOCK freed)
+``nxdi_prefix_hits``                  counter
+``nxdi_prefix_misses``                counter
+``nxdi_prefix_evictions``             counter
+``nxdi_prefix_cow_copies``            counter
+``nxdi_prefix_cached_blocks``         gauge
+``nxdi_prefix_tokens_saved_total``    counter
 ``nxdi_spec_accepted_tokens``         histogram  (path)
 ``nxdi_serve_queue_depth``            gauge
 ``nxdi_serve_slots_busy``             gauge
@@ -301,16 +307,22 @@ class Telemetry:
             "nxdi_request_tokens_out_total", "tokens generated"
         )
         self.kv_blocks_free = r.gauge(
-            "nxdi_kv_blocks_free", "free blocks in the paged-KV pool"
+            "nxdi_kv_blocks_free",
+            "allocatable blocks in the paged-KV pool (free list + blocks "
+            "the prefix cache can evict on demand)",
         )
         self.kv_blocks_used = r.gauge(
-            "nxdi_kv_blocks_used", "allocated blocks in the paged-KV pool"
+            "nxdi_kv_blocks_used",
+            "non-reclaimable blocks in the paged-KV pool (live sequences; "
+            "a warm prefix cache does NOT count as usage)",
         )
         self.kv_block_forks_total = r.counter(
-            "nxdi_kv_block_forks_total", "prefix forks (shared-block starts)"
+            "nxdi_kv_block_forks_total",
+            "blocks started shared via fork_prefix (counted per block)",
         )
         self.kv_block_frees_total = r.counter(
-            "nxdi_kv_block_frees_total", "sequence frees returning blocks"
+            "nxdi_kv_block_frees_total",
+            "blocks released by sequence frees (counted per block)",
         )
         self.spec_accepted = r.histogram(
             "nxdi_spec_accepted_tokens",

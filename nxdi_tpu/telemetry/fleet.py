@@ -42,6 +42,11 @@ identically bit for bit::
     kv_used_frac = used / (used + free)   (0.0 when the pool is unreported)
     slo_attainment_pct defaults to 100.0 when no SLO is declared
 
+Replicas running the serving prefix cache publish ``nxdi_kv_blocks_used``
+as NON-RECLAIMABLE usage (cache-retained blocks nobody references count as
+free, since an exhausted pool evicts them on demand) — so ``kv_used_frac``
+means real KV pressure and a warm cache never reads as load.
+
 Lower score = less loaded. Ranking sorts by ``(score, replica)`` —
 deterministic even on exact ties.
 """

@@ -231,10 +231,23 @@ class FlightRecorder:
         entry["seconds"] += seconds
         rec.dispatch_s += seconds
 
-    def record_admission(self, request_id, slot: int, resumed: bool) -> None:
+    def record_admission(
+        self,
+        request_id,
+        slot: int,
+        resumed: bool,
+        cached_tokens: int = 0,
+        total_tokens: int = 0,
+    ) -> None:
+        """One admission: ``cached_tokens`` of the request's ``total_tokens``
+        (re)prefill arrived via a prefix-cache / fork hit — the timeline's
+        ``cached=K/N`` column."""
         self._append(
             "admitted",
-            {"request_id": request_id, "slot": slot, "resumed": resumed},
+            {
+                "request_id": request_id, "slot": slot, "resumed": resumed,
+                "cached": cached_tokens, "total": total_tokens,
+            },
         )
 
     def record_prefill(

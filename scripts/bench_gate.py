@@ -100,6 +100,15 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     # a bucket rung.
     "mixed_goodput_tok_s": ("higher", 0.07),
     "mixed_padding_waste_pct": ("lower", 0.15),
+    # prefix-cache headline pair (bench.py --serving --prefix-cache;
+    # PR: radix prefix cache). One-sided, skipped against pre-prefix
+    # baselines (missing on a side). The hit rate on the shared-prefix
+    # bench workload is near-deterministic (every request after the first
+    # shares the prompt head), so it gets a tight tolerance: a drop means
+    # the radix match or the retire-insert path broke, not noise. Goodput
+    # inherits the usual serving scheduling noise.
+    "prefix_hit_rate_pct": ("higher", 0.02),
+    "prefix_goodput_tok_s": ("higher", 0.07),
 }
 
 #: metric -> (direction, absolute limit) checked on the FRESH record alone —
@@ -218,7 +227,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     if any(k in fresh for k in ("serving_goodput_req_s",
                                 "fleet_goodput_req_s",
                                 "routed_goodput_req_s",
-                                "mixed_goodput_tok_s")):
+                                "mixed_goodput_tok_s",
+                                "prefix_goodput_tok_s")):
         # a serving-, fleet-, or routed-mode FRESH record duplicates its
         # "value" headline as serving_/fleet_/routed_goodput_req_s (which
         # carry their own tolerances), and against a decode-mode baseline

@@ -267,7 +267,8 @@ def test_cli_metrics_end_to_end(tmp_path, capsys):
     assert snap["nxdi_padding_waste_ratio"]["series"]
     assert snap["nxdi_kv_blocks_used"]["series"][0]["value"] == 0  # all freed
     assert snap["nxdi_kv_blocks_free"]["series"][0]["value"] > 0
-    assert snap["nxdi_kv_block_frees_total"]["series"][0]["value"] == 2
+    # frees count PER BLOCK (2 requests x 2 blocks each at this geometry)
+    assert snap["nxdi_kv_block_frees_total"]["series"][0]["value"] == 4
     assert snap["nxdi_request_ttft_seconds"]["series"][0]["count"] == 2
     assert snap["nxdi_request_tpot_seconds"]["series"][0]["count"] >= 2
     assert snap["nxdi_requests_total"]["series"][0]["value"] == 2

@@ -304,6 +304,57 @@ def test_mixed_metrics_gate_and_skip_when_absent(tmp_path):
     assert rc == 0
 
 
+def test_prefix_metrics_gate_and_skip_when_absent(tmp_path):
+    """bench.py --serving --prefix-cache emits the prefix-cache headline
+    pair: one-sided gating (hit rate AND goodput higher-is-better), skipped
+    against pre-prefix baselines, and the generic 'value' row suppressed
+    for prefix-mode fresh records (their tok/s headline must not gate
+    against a decode-mode tok/s/chip baseline)."""
+    prefix = {
+        "value": 410.0,
+        "prefix_goodput_tok_s": 410.0,
+        "prefix_hit_rate_pct": 96.8,
+        "noprefix_goodput_tok_s": 360.0,
+    }
+    # pre-prefix baseline (decode-mode BASE): every prefix_* field skips
+    # and the suppressed "value" row cannot fail the run
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", prefix),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, prefix, bench_gate.TOLERANCES)
+    assert "prefix_goodput_tok_s" in skipped
+    assert "prefix_hit_rate_pct" in skipped
+
+    # same-shape baseline: a hit-rate collapse fails (the radix match or
+    # the retire-insert path broke — near-deterministic on this workload)
+    cold = dict(prefix, prefix_hit_rate_pct=60.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", cold),
+        "--baseline", _write(tmp_path, "base.json", prefix),
+        "-q",
+    ])
+    assert rc == 1
+    # ... a goodput drop beyond tolerance fails ...
+    slow = dict(prefix, prefix_goodput_tok_s=350.0, value=350.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", slow),
+        "--baseline", _write(tmp_path, "base.json", prefix),
+        "-q",
+    ])
+    assert rc == 1
+    # ... and in-tolerance noise passes (one-sided: improvements free)
+    fine = dict(prefix, prefix_hit_rate_pct=97.0, prefix_goodput_tok_s=405.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fine),
+        "--baseline", _write(tmp_path, "base.json", prefix),
+        "-q",
+    ])
+    assert rc == 0
+
+
 def test_device_loop_metrics_gate_and_skip_when_absent(tmp_path):
     """bench.py --device-loop emits the resident-loop A/B pair:
     device_loop_ms_per_tok gates lower-is-better, tokens-per-dispatch

@@ -73,7 +73,9 @@ def test_dispatch_attribution_and_host_split():
         {"submodel": "token_generation_model", "bucket": "64", "steps": "1",
          "dispatches": 2, "seconds": pytest.approx(0.004)},
     ]
-    assert d["admitted"] == [{"request_id": 7, "slot": 1, "resumed": False}]
+    assert d["admitted"] == [
+        {"request_id": 7, "slot": 1, "resumed": False, "cached": 0, "total": 0}
+    ]
     assert d["kv_blocks_free"] == 17 and d["queue_depth"] == 2
     # dispatches OUTSIDE a step (static generate traffic) attribute nowhere
     tel.record_dispatch("token_generation_model", 64, 1, 0.002)

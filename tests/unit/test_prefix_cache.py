@@ -1,0 +1,345 @@
+"""Radix prefix cache (nxdi_tpu/serving/prefix_cache) + the block-manager
+primitives underneath it (fork_prefix refcount safety, retain/release,
+copy-on-write, reclaimer-fed allocation) and the device-side block copy.
+
+Property anchors (ISSUE 13):
+- a match is the LONGEST cached full-block prefix (and never exceeds the
+  caller's cap),
+- eviction only ever touches blocks no live sequence references (manager
+  refcount 1 = the cache's own hold), leaf-first,
+- the tree's physical block set stays identical to the set of blocks the
+  manager holds a cache reference on (no leaks, no aliasing).
+"""
+
+import numpy as np
+import pytest
+
+from nxdi_tpu.runtime.block_manager import BlockSpaceManager
+from nxdi_tpu.serving.prefix_cache import PrefixCache
+from nxdi_tpu.telemetry import Telemetry
+
+BS = 4  # block size for every manager in this file
+
+
+def mgr_cache(num_blocks=16, telemetry=None):
+    mgr = BlockSpaceManager(num_blocks, BS, telemetry=telemetry)
+    return mgr, PrefixCache(mgr, telemetry=telemetry)
+
+
+def seed(mgr, cache, seq_id, tokens):
+    """Prefill-and-retire one sequence: allocate, insert, free — the
+    scheduler's retire path in miniature. Returns the retained chain."""
+    table = list(mgr.ensure_capacity(seq_id, len(tokens)))
+    cache.insert(tokens, table)
+    mgr.free_seq(seq_id)
+    return table[: len(tokens) // BS]
+
+
+# ---------------------------------------------------------------- fork_prefix
+def test_fork_prefix_rejects_refcount_zero_blocks():
+    """Satellite: forking a freed (refcount-0) block would alias it with a
+    future allocation — must be rejected, naming the dead blocks."""
+    mgr = BlockSpaceManager(8, BS)
+    table = list(mgr.ensure_capacity(1, 8))
+    mgr.free_seq(1)  # blocks now refcount 0, sitting in the free list
+    with pytest.raises(ValueError, match="refcount 0"):
+        mgr.fork_prefix(2, table)
+    # nothing was half-applied: the fork target holds no table
+    assert 2 not in mgr._tables
+    assert all(mgr.refcount(b) == 0 for b in table)
+
+
+def test_fork_prefix_resurrect_pulls_blocks_out_of_free():
+    """resurrect=True revives the chain: blocks leave the free list, so the
+    allocator can never hand them to someone else while forked."""
+    mgr = BlockSpaceManager(4, BS)
+    table = list(mgr.ensure_capacity(1, 8))
+    mgr.free_seq(1)
+    mgr.fork_prefix(2, table, resurrect=True)
+    assert all(mgr.refcount(b) == 1 for b in table)
+    assert all(b not in mgr._free for b in table)
+    # pool arithmetic: 2 of 4 blocks are owned again
+    assert mgr.num_free_blocks() == 2
+    # and a full drain never re-hands a resurrected block
+    others = [mgr._alloc_block() for _ in range(2)]
+    assert not set(others) & set(table)
+    with pytest.raises(RuntimeError, match="exhausted"):
+        mgr._alloc_block()
+
+
+def test_fork_counts_per_block():
+    """Satellite: nxdi_kv_block_forks_total counts PER BLOCK (a 3-block fork
+    is 3 of pool churn), frees likewise."""
+    tel = Telemetry()
+    mgr = BlockSpaceManager(8, BS, telemetry=tel)
+    table = list(mgr.ensure_capacity(1, 12))  # 3 blocks
+    mgr.fork_prefix(2, table)
+    assert tel.kv_block_forks_total.value() == 3
+    mgr.free_seq(1)
+    mgr.free_seq(2)
+    assert tel.kv_block_frees_total.value() == 6
+
+
+# ------------------------------------------------- retain / release / cow
+def test_retain_release_lifecycle():
+    mgr = BlockSpaceManager(4, BS)
+    (blk,) = mgr.ensure_capacity(1, 4)
+    mgr.retain_block(blk)
+    assert mgr.refcount(blk) == 2
+    mgr.free_seq(1)  # sequence gone, cache hold keeps it out of the pool
+    assert mgr.refcount(blk) == 1 and blk not in mgr._free
+    mgr.release_block(blk)
+    assert mgr.refcount(blk) == 0 and blk in mgr._free
+    with pytest.raises(ValueError, match="not held"):
+        mgr.release_block(blk)
+    with pytest.raises(ValueError, match="free"):
+        mgr.retain_block(blk)
+
+
+def test_cow_block_swaps_private_copy():
+    mgr = BlockSpaceManager(8, BS)
+    table = list(mgr.ensure_capacity(1, 8))
+    mgr.fork_prefix(2, table)
+    src, dst = mgr.cow_block(2, 1)
+    assert src == table[1] and dst != src
+    assert mgr._tables[2] == [table[0], dst]
+    assert mgr._tables[1] == table  # original owner untouched
+    assert mgr.refcount(src) == 1 and mgr.refcount(dst) == 1
+    # an unshared block must be written in place, not copied
+    with pytest.raises(ValueError, match="not .*shared|refcount"):
+        mgr.cow_block(2, 1)
+
+
+def test_copy_kv_blocks_moves_data_and_leaves_rest():
+    """Device-side COW primitive: dst blocks become bit-identical to src,
+    every other slot is untouched, k and v both move."""
+    from nxdi_tpu.kvcache.kv_cache import copy_kv_blocks
+
+    rng = np.random.default_rng(0)
+    layers, blocks, kv, d = 2, 6, 2, 4
+    cache = {
+        "k": rng.normal(size=(layers, blocks * BS, kv, d)).astype(np.float32),
+        "v": rng.normal(size=(layers, blocks * BS, kv, d)).astype(np.float32),
+    }
+    before = {k: v.copy() for k, v in cache.items()}
+    out = copy_kv_blocks(
+        {k: np.asarray(v) for k, v in cache.items()}, [0, 3], [2, 5], BS
+    )
+    for key in ("k", "v"):
+        got = np.asarray(out[key])
+        for src, dst in ((0, 2), (3, 5)):
+            np.testing.assert_array_equal(
+                got[:, dst * BS : (dst + 1) * BS],
+                before[key][:, src * BS : (src + 1) * BS],
+            )
+        for untouched in (0, 1, 3, 4):  # src blocks + never-named blocks
+            np.testing.assert_array_equal(
+                got[:, untouched * BS : (untouched + 1) * BS],
+                before[key][:, untouched * BS : (untouched + 1) * BS],
+            )
+    # no-op contract: empty copy returns the cache unchanged, same object
+    same = copy_kv_blocks(out, [], [], BS)
+    assert same is out
+    with pytest.raises(ValueError, match="differ"):
+        copy_kv_blocks(out, [0], [], BS)
+
+
+# ------------------------------------------------------------ radix matching
+def test_match_is_longest_and_capped():
+    mgr, cache = mgr_cache()
+    toks = list(range(1, 13))  # 3 full blocks
+    chain = seed(mgr, cache, 1, toks)
+    assert len(cache) == 3
+
+    # full 3-block hit
+    got, n = cache.match(toks)
+    assert got == chain and n == 12
+    # longest: a 2.5-block query matches exactly 2 blocks
+    got, n = cache.match(toks[:10])
+    assert got == chain[:2] and n == 8
+    # cap: len(seq)-1 leaves the logit-producing tail uncached
+    got, n = cache.match(toks, max_tokens=len(toks) - 1)
+    assert got == chain[:2] and n == 8
+    # diverging second block stops the walk after block 0
+    div = toks[:4] + [99, 98, 97, 96] + toks[8:]
+    got, n = cache.match(div)
+    assert got == chain[:1] and n == 4
+    # nothing shared at all
+    got, n = cache.match([77] * 12)
+    assert got == [] and n == 0
+    assert cache.hits_n == 4 and cache.misses_n == 1
+    assert cache.tokens_saved_n == 12 + 8 + 8 + 4
+
+
+def test_match_then_fork_roundtrip():
+    """The consumer flow: match, fork the chain, decode-extend, free —
+    refcounts return to the cache-only hold and the chain stays matchable."""
+    mgr, cache = mgr_cache()
+    toks = list(range(1, 9))
+    chain = seed(mgr, cache, 1, toks)
+    got, n = cache.match(toks + [50, 51], max_tokens=9)
+    assert got == chain and n == 8
+    mgr.fork_prefix(2, got)
+    table = mgr.ensure_capacity(2, 10)  # grows a private tail block
+    assert table[:2] == chain and len(table) == 3
+    assert all(mgr.refcount(b) == 2 for b in chain)
+    mgr.free_seq(2)
+    assert all(mgr.refcount(b) == 1 for b in chain)
+    assert cache.match(toks)[0] == chain
+
+
+def test_insert_never_replaces_existing_chain():
+    """Two retirements of the same prompt: the second's duplicate blocks are
+    NOT adopted (the first chain keeps serving) and simply free with their
+    own sequence — no leak, no double-retain."""
+    mgr, cache = mgr_cache()
+    toks = list(range(1, 9))
+    chain = seed(mgr, cache, 1, toks)
+    t2 = list(mgr.ensure_capacity(2, 8))
+    assert cache.insert(toks, t2) == 0  # nothing adopted
+    mgr.free_seq(2)
+    assert cache.blocks() == set(chain)
+    assert all(mgr.refcount(b) == 0 for b in t2)
+
+
+def test_insert_extends_shared_prefix():
+    """A longer retirement grafts only its NEW tail blocks under the shared
+    prefix node — the radix property."""
+    mgr, cache = mgr_cache()
+    base = list(range(1, 9))
+    chain = seed(mgr, cache, 1, base)
+    longer = base + [20, 21, 22, 23]
+    t2 = list(mgr.ensure_capacity(2, 12))
+    assert cache.insert(longer, t2) == 1  # only the third block is new
+    mgr.free_seq(2)
+    got, n = cache.match(longer)
+    assert n == 12 and got[:2] == chain and got[2] == t2[2]
+
+
+# ------------------------------------------------------------------ eviction
+def test_evict_only_unreferenced_leaf_first():
+    """Property: eviction never touches a block a live sequence references,
+    and removes leaves before their parents (surviving chains stay
+    matchable from the root)."""
+    mgr, cache = mgr_cache()
+    toks = list(range(1, 13))
+    chain = seed(mgr, cache, 1, toks)
+
+    # a live consumer pins the whole chain (refs 2) — nothing evictable
+    mgr.fork_prefix(7, chain)
+    assert cache.reclaimable() == 0
+    assert cache.evict(3) == 0
+    assert cache.blocks() == set(chain)
+
+    mgr.free_seq(7)
+    assert cache.reclaimable() == 3
+    # evict one: must be the LEAF (deepest) block, so [b0, b1] still match
+    assert cache.evict(1) == 1
+    assert cache.blocks() == set(chain[:2])
+    assert cache.match(toks)[1] == 8
+    assert mgr.refcount(chain[2]) == 0
+    assert cache.evictions_n == 1
+
+
+def test_evict_lru_order_across_chains():
+    mgr, cache = mgr_cache(num_blocks=8)
+    a, b = [1, 2, 3, 4], [9, 8, 7, 6]
+    (blk_a,) = seed(mgr, cache, 1, a)
+    (blk_b,) = seed(mgr, cache, 2, b)
+    cache.match(a)  # touch A — B becomes the LRU victim
+    assert cache.evict(1) == 1
+    assert cache.blocks() == {blk_a}
+    assert mgr.refcount(blk_b) == 0
+
+
+def test_allocation_evicts_on_demand():
+    """An exhausted free list pulls reclaimable cache blocks back before
+    failing — the num_free_blocks arithmetic made real."""
+    mgr, cache = mgr_cache(num_blocks=4)
+    seed(mgr, cache, 1, list(range(1, 13)))  # cache retains 3 of 4 blocks
+    assert len(mgr._free) == 1 and mgr.num_free_blocks() == 4
+    table = mgr.ensure_capacity(2, 12)  # needs 3: 1 free + 2 evicted
+    assert len(table) == 3
+    assert len(cache) == 1  # leaf-first: the shallowest block survived
+    assert cache.evictions_n == 2
+    # pool truly exhausted now (1 cached + 3 live): next alloc evicts the
+    # last cached block, then one more fails
+    mgr.ensure_capacity(3, 4)
+    assert len(cache) == 0
+    with pytest.raises(RuntimeError, match="exhausted"):
+        mgr.ensure_capacity(4, 4)
+
+
+# ------------------------------------------------------- tree/pool invariant
+def test_tree_blocks_equal_manager_cache_holds():
+    """Property: after an arbitrary interleaving of seeds, matches, forks,
+    frees and evictions, the tree's block set == {blocks whose refcount
+    includes the cache hold}, and refcounts decompose exactly into
+    (table memberships) + (cache holds)."""
+    rng = np.random.default_rng(7)
+    mgr, cache = mgr_cache(num_blocks=24)
+    prompts = [list(rng.integers(1, 9, size=rng.integers(4, 17))) for _ in range(12)]
+    live = {}
+    for i, toks in enumerate(prompts):
+        sid = 100 + i
+        chain, n = cache.match(toks, max_tokens=max(len(toks) - 1, 0))
+        if chain:
+            mgr.fork_prefix(sid, chain)
+        mgr.ensure_capacity(sid, len(toks))
+        live[sid] = toks
+        if rng.random() < 0.6 and live:  # retire a random live seq
+            vid = int(rng.choice(list(live)))
+            cache.insert(live[vid], mgr._tables[vid])
+            mgr.free_seq(vid)
+            del live[vid]
+        if rng.random() < 0.3:
+            cache.evict(1)
+        # invariant check after every step
+        expected = np.zeros(mgr.num_blocks, dtype=np.int64)
+        for table in mgr._tables.values():
+            for b in table:
+                expected[b] += 1
+        for b in cache.blocks():
+            expected[b] += 1
+        assert (mgr._refs == expected).all(), "refcount decomposition broken"
+        assert cache.blocks().isdisjoint(mgr._free)
+        assert cache.reclaimable() == sum(
+            1 for b in cache.blocks() if mgr.refcount(b) == 1
+        )
+    # teardown: clear() releases every unreferenced chain
+    for sid in list(live):
+        mgr.free_seq(sid)
+    cache.clear()
+    assert len(cache) == 0
+    assert sorted(mgr._free) == list(range(mgr.num_blocks))
+    assert (mgr._refs == 0).all()
+
+
+# ---------------------------------------------------------------- telemetry
+def test_prefix_counters_registered_and_preseeded():
+    tel = Telemetry()
+    mgr, cache = mgr_cache(telemetry=tel)
+    for name in (
+        "nxdi_prefix_hits",
+        "nxdi_prefix_misses",
+        "nxdi_prefix_evictions",
+        "nxdi_prefix_cow_copies",
+        "nxdi_prefix_cached_blocks",
+        "nxdi_prefix_tokens_saved_total",
+    ):
+        metric = tel.registry.get(name)
+        assert metric is not None, name
+        assert metric.value() == 0
+    seed(mgr, cache, 1, list(range(1, 9)))
+    cache.match(list(range(1, 9)))
+    cache.match([50] * 8)
+    cache.note_cow(2)
+    cache.evict(1)
+    assert tel.registry.get("nxdi_prefix_hits").value() == 1
+    assert tel.registry.get("nxdi_prefix_misses").value() == 1
+    assert tel.registry.get("nxdi_prefix_tokens_saved_total").value() == 8
+    assert tel.registry.get("nxdi_prefix_cow_copies").value() == 2
+    assert tel.registry.get("nxdi_prefix_evictions").value() == 1
+    # seed cached 2 blocks, one was evicted — the gauge tracks the tree
+    assert tel.registry.get("nxdi_prefix_cached_blocks").value() == len(cache) == 1
+    assert cache.hit_rate_pct == pytest.approx(50.0)
