@@ -1000,6 +1000,7 @@ def _flag_value(name, default):
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
     replica_id=None, rng=None, sentinel=None, mixed=False, prefix_cache=False,
+    faults=None,
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -1037,6 +1038,7 @@ def _build_serving_stack(
         sentinel=sentinel,
         mixed_dispatch=mixed,
         is_prefix_caching=prefix_cache,
+        faults=faults,
     )
     cfg = ml.LlamaInferenceConfig(
         tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
@@ -1561,12 +1563,14 @@ def main_routed_serving(
     - ``routed_failovers`` — absolute-gated < 1: nothing dies in this run,
       so ANY failover is a routing bug, not noise.
     """
+    import random as _random
     import threading
     import time as _time
 
     from nxdi_tpu.cli.route import _http
     from nxdi_tpu.config import FleetConfig, RouterConfig
     from nxdi_tpu.router import ReplicaIngest, Router
+    from nxdi_tpu.runtime.faults import jittered_backoff
     from nxdi_tpu.telemetry.registry import percentile_exact
 
     stacks, servers, ingests, targets = [], [], [], []
@@ -1621,7 +1625,8 @@ def main_routed_serving(
         if status != 200:
             results[i] = {"error": f"submit HTTP {status}", "tokens": 0}
             return
-        cursor, n_tok, ttft = 0, 0, None
+        poll_rng = _random.Random(i)
+        cursor, n_tok, ttft, idle = 0, 0, None, 0
         while True:
             status, resp = _http(
                 "GET",
@@ -1645,7 +1650,13 @@ def main_routed_serving(
                     "failovers": resp.get("failovers", 0),
                 }
                 return
-            _time.sleep(0.003)
+            # jittered backoff between re-polls: dry polls grow the sleep
+            # (capped), a token resets it — 32 clients stop synchronously
+            # hammering the frontend while streams that move stay snappy
+            idle = idle + 1 if not resp["tokens"] else 0
+            _time.sleep(jittered_backoff(
+                idle, base_s=0.003, max_s=0.05, rng=poll_rng
+            ))
 
     threads = [threading.Thread(target=client, args=(i,), daemon=True)
                for i in range(requests)]
@@ -1700,6 +1711,234 @@ def main_routed_serving(
     return rec
 
 
+def main_chaos_serving(
+    replicas=2,
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=64,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+):
+    """``bench.py --serving --chaos``: the routed fleet under a seeded
+    :class:`~nxdi_tpu.runtime.faults.FaultPlan`. The SAME greedy Poisson
+    workload runs twice on one 2-replica routed stack — once fault-free
+    (the baseline), once with injected transient dispatch failures, a KV
+    pool exhaustion, and probabilistic transport faults — and the
+    headline is what the recovery machinery preserved:
+
+    - ``chaos_goodput_retention_pct`` — faulted goodput as a percentage
+      of the fault-free pass on identical work; ABSOLUTE-gated (>= 70)
+      by scripts/bench_gate.py: recovery must keep most of the
+      throughput, not merely avoid crashing.
+    - ``chaos_recovery_p95_ms`` — p95 of requeue -> re-admission latency
+      for step-fault victims (``engine.recovery_resume_s``).
+    - ``chaos_stream_mismatches`` — per-request token streams compared
+      against the fault-free pass: greedy recovery is supposed to be
+      token-identical, so every mismatch is a correctness bug surfacing
+      as a number instead of a vibe.
+    - ``chaos_errors`` / ``chaos_requeues`` / ``chaos_injected`` —
+      error finishes under fault (should be 0), recovery requeues
+      (> 0 proves the faults actually landed in the engine), and total
+      injections delivered by the plan.
+    """
+    import random as _random
+    import threading
+    import time as _time
+
+    from nxdi_tpu.cli.route import _http
+    from nxdi_tpu.config import FleetConfig, RouterConfig
+    from nxdi_tpu.router import ReplicaIngest, Router
+    from nxdi_tpu.runtime import faults
+    from nxdi_tpu.runtime.faults import jittered_backoff
+    from nxdi_tpu.telemetry.registry import percentile_exact
+
+    stacks, servers, ingests, targets = [], [], [], []
+    for i in range(replicas):
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            replica_id=f"chaos-r{i}",
+            faults={"watchdog": True},
+        )
+        mserver = app.telemetry.serve(port=0)
+        ingest = ReplicaIngest(engine)
+        iserver = ingest.serve(port=0)
+        stacks.append((app, engine))
+        servers.extend([mserver, iserver])
+        ingests.append(ingest)
+        targets.append((f"chaos-r{i}", mserver.url, iserver.url))
+
+    router = Router(
+        targets,
+        config=RouterConfig(shed_queue_depth=float(requests + slots),
+                            poll_interval_s=0.25),
+        fleet_config=FleetConfig(staleness_s=3600.0),
+    )
+    router.start()
+    frontend = router.serve(port=0)
+
+    def run_pass(tag):
+        """One full workload pass; same seed both times, so prompts and
+        arrivals are identical and greedy streams must match 1:1."""
+        rng = np.random.default_rng(0)
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+        prompts = [
+            rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+            .astype(np.int32).tolist()
+            for _ in range(requests)
+        ]
+        results = [None] * requests
+        t0 = _time.perf_counter()
+
+        def client(i):
+            arrival = t0 + float(arrivals[i])
+            _time.sleep(max(arrival - _time.perf_counter(), 0.0))
+            brng = _random.Random(i)
+
+            def call(method, url, payload=None, attempts=8):
+                # transport faults hit the client's own HTTP calls too;
+                # a real client retries with jittered backoff, so ours does
+                last = None
+                for a in range(attempts):
+                    try:
+                        return _http(method, url, payload)
+                    except Exception as e:  # noqa: BLE001 — retried
+                        last = e
+                        _time.sleep(jittered_backoff(
+                            a, base_s=0.02, max_s=0.25, rng=brng
+                        ))
+                raise last
+
+            rid = f"{tag}-{i}"
+            status, resp = call("POST", f"{frontend.url}/submit", {
+                "request_id": rid,
+                "prompt": prompts[i],
+                "max_new_tokens": max_new,
+            })
+            if status != 200:
+                results[i] = {"error": f"submit HTTP {status}", "tokens": []}
+                return
+            cursor, toks, ttft, idle = 0, [], None, 0
+            while True:
+                status, resp = call(
+                    "GET",
+                    f"{frontend.url}/stream?request_id={rid}&cursor={cursor}",
+                )
+                if status != 200:
+                    results[i] = {"error": f"stream HTTP {status}",
+                                  "tokens": toks}
+                    return
+                cursor = resp["cursor"]
+                new = resp["tokens"]
+                toks.extend(new)
+                if ttft is None and toks:
+                    ttft = _time.perf_counter() - arrival
+                if resp["done"]:
+                    results[i] = {
+                        "error": resp["error"]
+                        if resp["finish_reason"] == "error" else None,
+                        "tokens": toks,
+                        "ttft_s": ttft,
+                        "end_s": _time.perf_counter() - t0,
+                    }
+                    return
+                idle = idle + 1 if not new else 0
+                _time.sleep(jittered_backoff(
+                    idle, base_s=0.003, max_s=0.05, rng=brng
+                ))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        ok = [r for r in results if r and not r["error"]]
+        wall = max((r["end_s"] for r in ok), default=1e-9)
+        return results, len(ok) / wall
+
+    # pass 1: fault-free baseline (also fully warms both replicas, so the
+    # faulted pass never reads warmup as fault cost)
+    base_results, base_goodput = run_pass("warm")
+
+    # pass 2: identical workload under a seeded plan covering all three
+    # fault families the acceptance demands — transient dispatch failures
+    # (watchdog retry / step requeue), one KV pool exhaustion (targeted
+    # preemption), and probabilistic transport faults (router + client
+    # backoff-and-retry)
+    plan = faults.FaultPlan(seed=20260805)
+    plan.add(faults.FaultRule(
+        faults.SITE_DISPATCH, "every", n=40,
+        kind=faults.KIND_TRANSIENT, limit=4,
+    ))
+    plan.add(faults.FaultRule(
+        faults.SITE_BLOCK_ALLOC, "nth", n=60,
+        kind=faults.KIND_EXHAUSTED, limit=1,
+    ))
+    plan.add(faults.FaultRule(
+        faults.SITE_TRANSPORT, "prob", p=0.01,
+        kind=faults.KIND_TRANSIENT, limit=6,
+    ))
+    faults.arm(plan)
+    try:
+        chaos_results, chaos_goodput = run_pass("chaos")
+    finally:
+        faults.disarm()
+
+    mismatches = sum(
+        1 for b, c in zip(base_results, chaos_results)
+        if b and c and not b["error"] and not c["error"]
+        and b["tokens"] != c["tokens"]
+    )
+    resume_s = [s for _, e in stacks for s in e.recovery_resume_s]
+    requeues = sum(
+        e._recovery_requeues.total()
+        for _, e in stacks if e._recovery_requeues is not None
+    )
+    retention = (
+        100.0 * chaos_goodput / base_goodput if base_goodput > 0 else 0.0
+    )
+    rec = {
+        "metric": "llama3.2-1b_chaos_serving_retention",
+        "value": round(retention, 2),
+        "unit": "pct",
+        "chaos_goodput_retention_pct": round(retention, 2),
+        "chaos_base_goodput_req_s": round(base_goodput, 3),
+        "chaos_goodput_req_s": round(chaos_goodput, 3),
+        "chaos_recovery_p95_ms": (
+            round(percentile_exact(resume_s, 95) * 1e3, 2)
+            if resume_s else 0.0
+        ),
+        "chaos_stream_mismatches": mismatches,
+        "chaos_errors": len(
+            [r for r in chaos_results if r and r["error"]]
+        ),
+        "chaos_requeues": requeues,
+        "chaos_injected": plan.injected_total(),
+        "chaos_injected_by_site": dict(plan.fired),
+        "chaos_watchdog_trips": sum(
+            e.watchdog.trips for _, e in stacks if e.watchdog is not None
+        ),
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged x{replicas} replicas "
+            f"slots{slots} kv{seq_len} prompt~{prompt_len} max_new{max_new} "
+            f"tp1 rate{rate:g} routed chaos (seeded plan, 2 passes)"
+        ),
+        "mode": "chaos_routed_serving",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots({"router": router.snapshot()}, metrics_out_path())
+    router.stop()
+    for ingest in ingests:
+        ingest.stop()
+    for server in servers:
+        server.shutdown()
+    return rec
+
+
 if __name__ == "__main__":
     if "--8b-only" in sys.argv:
         main_8b_only()
@@ -1730,6 +1969,9 @@ if __name__ == "__main__":
             )
         elif "--mixed-dispatch" in sys.argv:
             main_mixed_serving(**_serving_kwargs)
+        elif "--chaos" in sys.argv:
+            _serving_kwargs["max_new"] = _flag_value("--serving-max-new", 64)
+            main_chaos_serving(replicas=max(_replicas, 2), **_serving_kwargs)
         elif "--routed" in sys.argv:
             main_routed_serving(replicas=max(_replicas, 2), **_serving_kwargs)
         elif _replicas > 1:

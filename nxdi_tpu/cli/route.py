@@ -32,9 +32,12 @@ from __future__ import annotations
 
 import argparse
 import json
+import random
 import sys
 import time
 from typing import List, Optional
+
+from nxdi_tpu.runtime.faults import jittered_backoff
 
 
 def setup_route_parser(p: argparse.ArgumentParser) -> None:
@@ -174,7 +177,13 @@ def run_demo_workload(router, frontend_url: str, args) -> dict:
     results = {}
     cursors = {rid: 0 for rid in rids}
     pending = [rid for rid in rids if rid not in failed_submits]
+    # jittered backoff between re-poll rounds: rounds that make no token
+    # progress grow the sleep (capped), progress resets it — idle polling
+    # stops hammering the frontend while active streams stay snappy
+    backoff_rng = random.Random(0)
+    idle_rounds = 0
     while pending and time.time() < deadline:
+        progressed = False
         for rid in list(pending):
             status, resp = _http(
                 "GET",
@@ -185,13 +194,18 @@ def run_demo_workload(router, frontend_url: str, args) -> dict:
                 errors.append(f"stream {rid}: HTTP {status} {resp}")
                 pending.remove(rid)
                 continue
+            if resp["cursor"] > cursors[rid] or resp["done"]:
+                progressed = True
             cursors[rid] = resp["cursor"]
             if resp["done"]:
                 results[rid] = resp
                 pending.remove(rid)
                 if resp["finish_reason"] == "error":
                     errors.append(f"{rid} error-finished: {resp['error']}")
-        time.sleep(0.01)
+        idle_rounds = 0 if progressed else idle_rounds + 1
+        time.sleep(jittered_backoff(
+            idle_rounds, base_s=0.01, max_s=0.25, rng=backoff_rng
+        ))
     for rid in pending:
         errors.append(f"{rid} never finished (deadline)")
 

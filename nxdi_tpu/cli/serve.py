@@ -96,6 +96,18 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                         "(TelemetryConfig(replica_id=...); the 'replica' "
                         "label cli.fleet attaches to this process's series; "
                         "default: hostname:pid)")
+    p.add_argument("--fault-plan", default=None, metavar="JSON",
+                   help="arm a deterministic fault plan for the workload "
+                        "(nxdi_tpu/runtime/faults.py): a JSON object or "
+                        "@file path with {'seed': N, 'rules': [{'site', "
+                        "'trigger', 'n'|'p', 'kind', 'limit'}]}; injections "
+                        "count into nxdi_fault_injected_total{site} and "
+                        "exercise the step-fault recovery machinery")
+    p.add_argument("--watchdog", action="store_true",
+                   help="enable the dispatch watchdog "
+                        "(TpuConfig(faults={'watchdog': True})): per-program "
+                        "timeouts from CostSheet floors x multiplier plus "
+                        "bounded transient retry with backoff")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stream", action="store_true",
                    help="print each request's tokens as they stream")
@@ -254,13 +266,31 @@ def main(argv: Optional[List[str]] = None) -> int:
         }
     if args.sentinel_replay_rate is not None:
         tpu_kwargs["sentinel"] = {"replay_rate": args.sentinel_replay_rate}
+    if args.watchdog:
+        tpu_kwargs["faults"] = {"watchdog": True}
     t0 = time.time()
     _note(args.quiet, "[serve] building + loading the reference app ...")
     app = build_loaded_reference_app(tpu_kwargs)
     _note(args.quiet, f"[serve] loaded in {time.time() - t0:.1f}s; "
                       f"{args.requests} Poisson arrivals at {args.rate} req/s")
 
-    engine, outputs, peak_prom, wall = run_workload(args, app)
+    from nxdi_tpu.runtime import faults as _faults
+
+    plan = None
+    if args.fault_plan:
+        spec = args.fault_plan
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = f.read()
+        plan = _faults.FaultPlan.from_dict(json.loads(spec))
+    if plan is not None:
+        with _faults.armed(plan):
+            engine, outputs, peak_prom, wall = run_workload(args, app)
+        _note(args.quiet,
+              f"[serve] fault plan: injected={plan.injected_total()} "
+              f"by_site={plan.fired}")
+    else:
+        engine, outputs, peak_prom, wall = run_workload(args, app)
 
     from nxdi_tpu.serving import goodput_summary
 

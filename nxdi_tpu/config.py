@@ -421,6 +421,55 @@ class RouterConfig:
         return dict(self.__dict__)
 
 
+class FaultConfig:
+    """Fault tolerance and recovery (nxdi_tpu/runtime/faults.py): the
+    dispatch watchdog and the engine's step-fault recovery budget.
+
+    ``watchdog`` — run every model dispatch on a watchdog worker thread
+    with a per-program timeout of ``CostSheet floor × watchdog_multiplier``
+    (clamped below by ``watchdog_min_timeout_s``); a timed-out launch trips
+    the watchdog, counts as transient, and retries. Off by default — the
+    worker-thread hop costs a context switch per dispatch.
+    ``watchdog_multiplier`` / ``watchdog_min_timeout_s`` — the timeout
+    formula's two knobs (floors come from the cost observatory; tags
+    without a sheet use the bare minimum).
+    ``max_retries`` — in-place transient-dispatch retries before the fault
+    escapes to the engine step (each preceded by the deterministic backoff
+    ``min(backoff_base_s * 2**attempt, backoff_max_s)``).
+    ``max_recoveries`` — times one request may be requeued through the
+    recompute-preemption path after a transient step fault before it
+    error-finishes (the router then fails it over).
+    """
+
+    def __init__(self, **kwargs):
+        self.watchdog = bool(kwargs.pop("watchdog", False))
+        self.watchdog_multiplier = float(kwargs.pop("watchdog_multiplier", 20.0))
+        self.watchdog_min_timeout_s = float(
+            kwargs.pop("watchdog_min_timeout_s", 0.5)
+        )
+        self.max_retries = int(kwargs.pop("max_retries", 2))
+        self.backoff_base_s = float(kwargs.pop("backoff_base_s", 0.05))
+        self.backoff_max_s = float(kwargs.pop("backoff_max_s", 2.0))
+        self.max_recoveries = int(kwargs.pop("max_recoveries", 3))
+        if kwargs:
+            raise ValueError(f"Unknown FaultConfig args: {sorted(kwargs)}")
+        if self.watchdog_multiplier <= 0:
+            raise ValueError("fault watchdog_multiplier must be > 0")
+        if self.watchdog_min_timeout_s <= 0:
+            raise ValueError("fault watchdog_min_timeout_s must be > 0")
+        if self.max_retries < 0:
+            raise ValueError("fault max_retries must be >= 0")
+        if self.backoff_base_s <= 0 or self.backoff_max_s < self.backoff_base_s:
+            raise ValueError(
+                "fault backoff needs 0 < backoff_base_s <= backoff_max_s"
+            )
+        if self.max_recoveries < 0:
+            raise ValueError("fault max_recoveries must be >= 0")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
 class HybridShardingConfig:
     """Per-phase hybrid MoE TPxEP regimes (reference: models/config.py:1060
     ``HybridShardingConfig``). ``moe_cte_ep_degree`` experts-axis width for
@@ -837,6 +886,16 @@ class TpuConfig:
         elif isinstance(sentinel, dict):
             sentinel = SentinelConfig(**sentinel)
         self.sentinel = sentinel
+        # fault tolerance (nxdi_tpu/runtime/faults.py): dispatch watchdog +
+        # step-fault recovery budgets. A FaultConfig, a dict of its kwargs,
+        # True (defaults), or None (defaults too — recovery is always on;
+        # the config only tunes budgets and opts into the watchdog).
+        faults = kwargs.pop("faults", None)
+        if faults is True or faults is None:
+            faults = FaultConfig()
+        elif isinstance(faults, dict):
+            faults = FaultConfig(**faults)
+        self.faults = faults
         # declared chip generation for the cost observatory's roofline math
         # and the hbm_fit auditor checker (analysis/costs.py): a name from
         # CHIP_SPECS ("v4"|"v5e"|"v5p"|"v6e"), or a dict of ChipSpec field
@@ -1180,6 +1239,7 @@ class TpuConfig:
         "telemetry": TelemetryConfig,
         "slo": SloConfig,
         "sentinel": SentinelConfig,
+        "faults": FaultConfig,
     }
 
     @property

@@ -44,6 +44,7 @@ from typing import Dict, List, Optional, Sequence, Tuple, Union
 from urllib.parse import parse_qs, quote, urlsplit
 
 from nxdi_tpu.router.policy import DispatchPolicy, dispatchable, should_shed
+from nxdi_tpu.runtime import faults
 from nxdi_tpu.router.retry import (
     RouterRequest,
     exhausted,
@@ -80,13 +81,22 @@ def parse_target(
 
 def http_json(
     method: str, url: str, payload: Optional[dict] = None,
-    timeout_s: float = 10.0,
+    timeout_s: Optional[float] = 10.0,
 ) -> Tuple[int, dict]:
     """One JSON round-trip — THE request-plane HTTP helper (the Router's
     default transport, and what cli.route / bench reuse as clients).
     Non-2xx answers RETURN (status, body) — they are protocol answers
     (429 shed, 503 draining), not transport faults; only transport-level
-    failures raise."""
+    failures raise. The socket timeout is always explicit: a caller
+    passing ``None`` still gets the 10s default, so a wedged replica
+    socket can never hang a poll loop indefinitely."""
+    if timeout_s is None:
+        timeout_s = 10.0
+    if faults.ACTIVE_PLAN is not None:
+        # failpoint "router.transport": injectable transport fault — the
+        # raised error takes the same except-Exception paths a dead socket
+        # does (stream_errors, health poll, failover rule)
+        faults.fire(faults.SITE_TRANSPORT)
     data = None if payload is None else json.dumps(payload).encode()
     req = urllib.request.Request(
         url, data=data, method=method,

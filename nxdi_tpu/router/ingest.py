@@ -253,13 +253,28 @@ class ReplicaIngest:
                 self._engine_ids[req.request_id] = rid
 
     def _step_once(self) -> None:
+        from nxdi_tpu.runtime import faults
+
         try:
             outputs = self.engine.step()
         except Exception as e:  # noqa: BLE001 — a step fault must not kill
-            # the driver; error-finish the records that were IN the engine
-            # (so the router can fail them over) and keep serving whatever
-            # comes next. Submissions still in _pending were never part of
-            # the faulting step — they stay queued and admit normally.
+            # the driver. Route through the fault taxonomy: the engine
+            # already requeues RUNNING requests for transient/exhausted
+            # faults internally, so one escaping here just means THIS step
+            # made no progress — keep the records live and step again
+            # (local recovery). Only a FATAL fault — replaying would
+            # reproduce it — error-finishes the records that were in the
+            # engine (with the engine-fault marker the router keys
+            # failover off) and keeps the driver serving whatever comes
+            # next. Submissions still in _pending were never part of the
+            # faulting step — they stay queued and admit normally.
+            kind = faults.classify(e)
+            if kind != faults.KIND_FATAL:
+                logger.warning(
+                    "ingest %s: recoverable engine fault (%s), retrying "
+                    "locally: %s", self.replica_id, kind, e,
+                )
+                return
             logger.exception("ingest %s: engine step failed", self.replica_id)
             with self._lock:
                 for rid in self._engine_ids.values():
@@ -281,6 +296,11 @@ class ReplicaIngest:
                 rec["tokens"] = list(out.token_ids)  # authoritative copy
                 rec["done"] = True
                 rec["finish_reason"] = out.finish_reason
+                if out.error is not None:
+                    # per-request recovery-budget exhaustion: carries the
+                    # engine-fault marker so the router fails THIS request
+                    # over while its neighbors keep streaming
+                    rec["error"] = out.error
 
     # -- the sibling-port server ---------------------------------------------
     def routes(self) -> list:

@@ -15,6 +15,8 @@ from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 
+from nxdi_tpu.runtime import faults
+
 
 class BlockSpaceManager:
     """First-fit block allocator with refcounts (prefix blocks can be shared).
@@ -94,6 +96,11 @@ class BlockSpaceManager:
         """Pop one free block (refcount 1), evicting from the reclaimer
         (prefix cache) first when the free list is dry. Raises on a truly
         exhausted pool (caller preempts)."""
+        if faults.ACTIVE_PLAN is not None:
+            # failpoint "block.alloc": injectable pool exhaustion — a
+            # ResourceExhausted is a RuntimeError, so it rides the exact
+            # paths a real dry pool takes (preempt-and-retry, never crash)
+            faults.fire(faults.SITE_BLOCK_ALLOC, self.telemetry)
         if not self._free and self.reclaimer is not None:
             self.reclaimer.evict(1)
         if not self._free:

@@ -1,0 +1,563 @@
+"""Deterministic fault injection and dispatch-recovery machinery.
+
+Three pieces live here, layered bottom-up:
+
+1. **Error taxonomy** — every backend failure the serving stack can
+   recover from is normalised into one of three ``RuntimeError``
+   subclasses (``TransientDispatchError`` / ``ResourceExhausted`` /
+   ``FatalModelError``).  ``classify`` maps arbitrary exceptions —
+   including live JAX/XLA runtime errors and socket-level transport
+   failures — onto the taxonomy so callers branch on *kind*, never on
+   backend-specific types.
+
+2. **Failpoint registry** — a ``FaultPlan`` is a seeded, fully
+   deterministic schedule of faults over named sites.  Production code
+   hosts a site with a two-line guard::
+
+       if faults.ACTIVE_PLAN is not None:
+           faults.ACTIVE_PLAN.hit(faults.SITE_DISPATCH, counter=ctr)
+
+   When no plan is armed the guard is a single module-attribute load
+   and ``is not None`` test — no call, no allocation, no lock — so the
+   sites are free in production.  When armed, triggers fire on the
+   nth hit, every kth hit, or with seeded probability, and either raise
+   a taxonomy error or inject latency (for watchdog tests).
+
+3. **Dispatch watchdog** — ``DispatchWatchdog`` runs a dispatch closure
+   on a worker thread with a per-program timeout derived from the
+   analysis tier's CostSheet floor (``floor × multiplier``, clamped to
+   a minimum), and retries transient failures with a deterministic
+   exponential backoff.  A timed-out dispatch abandons its worker
+   thread (it cannot be killed) and counts a *trip*.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+import time
+import zlib
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as _FutureTimeout
+from contextlib import contextmanager
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "TransientDispatchError",
+    "ResourceExhausted",
+    "FatalModelError",
+    "classify",
+    "make_error",
+    "FaultRule",
+    "FaultPlan",
+    "arm",
+    "disarm",
+    "armed",
+    "fire",
+    "ACTIVE_PLAN",
+    "DispatchWatchdog",
+    "jittered_backoff",
+    "SITE_DISPATCH",
+    "SITE_BLOCK_ALLOC",
+    "SITE_ENGINE_STEP",
+    "SITE_TRANSPORT",
+]
+
+# Canonical failpoint site names.  Sites are plain strings so plans can
+# target sites this module has never heard of, but the four the stack
+# ships are named here to keep call sites and tests in sync.
+SITE_DISPATCH = "dispatch.forward"
+SITE_BLOCK_ALLOC = "block.alloc"
+SITE_ENGINE_STEP = "engine.step"
+SITE_TRANSPORT = "router.transport"
+
+
+# ---------------------------------------------------------------------------
+# Error taxonomy
+# ---------------------------------------------------------------------------
+
+class TransientDispatchError(RuntimeError):
+    """A dispatch failed in a way that a clean re-execution can fix.
+
+    Retrying is safe because every dispatch closure is idempotent: the
+    batch, rng values, and KV write positions are captured before the
+    launch, so a replay writes the same values to the same slots.
+    """
+
+
+class ResourceExhausted(RuntimeError):
+    """An allocation failed because a bounded pool (KV blocks, slots)
+    is full.  Recoverable by freeing capacity — preempt and retry —
+    never by blind re-execution."""
+
+
+class FatalModelError(RuntimeError):
+    """The program or its inputs are broken (shape mismatch, compile
+    corruption, poisoned weights).  Retrying reproduces the failure;
+    the only safe move is to fail the work unit upward."""
+
+
+KIND_TRANSIENT = "transient"
+KIND_EXHAUSTED = "exhausted"
+KIND_FATAL = "fatal"
+KIND_LATENCY = "latency"
+
+_KIND_TO_ERROR = {
+    KIND_TRANSIENT: TransientDispatchError,
+    KIND_EXHAUSTED: ResourceExhausted,
+    KIND_FATAL: FatalModelError,
+}
+
+# Substrings of gRPC/absl status phrases that XLA's runtime surfaces in
+# XlaRuntimeError messages.  DEADLINE/UNAVAILABLE/ABORTED/CANCELLED are
+# launch-path hiccups worth retrying; RESOURCE_EXHAUSTED is an HBM/OOM
+# style allocation failure; everything else (INVALID_ARGUMENT,
+# INTERNAL, FAILED_PRECONDITION, ...) is treated as fatal.
+_TRANSIENT_STATUS = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "ABORTED", "CANCELLED")
+_EXHAUSTED_STATUS = ("RESOURCE_EXHAUSTED", "OUT_OF_MEMORY", "OOM", "POOL EXHAUSTED")
+# The signature of a donation race: a watchdog-abandoned launch completed
+# late and donated buffers out from under the retry (or vice versa — with
+# donation, exactly one of two concurrent replays survives).  The survivor
+# left the model state coherent, so a fresh replay reads refreshed
+# references and succeeds — transient by construction.
+_STALE_BUFFER = ("HAS BEEN DELETED", "DELETED OR DONATED")
+
+
+def classify(exc: BaseException) -> str:
+    """Map an exception onto the taxonomy: ``"transient"``,
+    ``"exhausted"``, or ``"fatal"``.
+
+    The taxonomy classes classify as themselves; backend exceptions are
+    classified by type (socket/timeout → transient) and, for XLA
+    runtime errors, by the status phrase embedded in the message.
+    Unknown exceptions default to fatal — retrying an unclassified
+    failure risks corrupting state for no proven benefit.
+    """
+    if isinstance(exc, TransientDispatchError):
+        return KIND_TRANSIENT
+    if isinstance(exc, ResourceExhausted):
+        return KIND_EXHAUSTED
+    if isinstance(exc, FatalModelError):
+        return KIND_FATAL
+    if isinstance(exc, (TimeoutError, _FutureTimeout, ConnectionError, BrokenPipeError)):
+        return KIND_TRANSIENT
+    # OSError covers socket.timeout/socket.error on the transport path;
+    # narrower ConnectionError is already handled above.
+    if isinstance(exc, OSError):
+        return KIND_TRANSIENT
+    if isinstance(exc, MemoryError):
+        return KIND_EXHAUSTED
+    msg = str(exc).upper()
+    # jaxlib.xla_extension.XlaRuntimeError (and jax.errors.JaxRuntimeError
+    # wrapping it) carry the absl status phrase in the message.  Match by
+    # class name so this module never imports jaxlib.
+    name = type(exc).__name__
+    if name in ("XlaRuntimeError", "JaxRuntimeError", "JaxStackTraceBeforeTransformation"):
+        for phrase in _EXHAUSTED_STATUS:
+            if phrase in msg:
+                return KIND_EXHAUSTED
+        for phrase in _TRANSIENT_STATUS + _STALE_BUFFER:
+            if phrase in msg:
+                return KIND_TRANSIENT
+        return KIND_FATAL
+    if isinstance(exc, RuntimeError):
+        for phrase in _EXHAUSTED_STATUS:
+            if phrase in msg:
+                return KIND_EXHAUSTED
+        for phrase in _STALE_BUFFER:
+            if phrase in msg:
+                return KIND_TRANSIENT
+    return KIND_FATAL
+
+
+def make_error(kind: str, detail: str) -> RuntimeError:
+    """Build the taxonomy exception for ``kind`` with ``detail``."""
+    try:
+        cls = _KIND_TO_ERROR[kind]
+    except KeyError:
+        raise ValueError(f"unknown fault kind {kind!r}") from None
+    return cls(detail)
+
+
+# ---------------------------------------------------------------------------
+# Failpoint registry
+# ---------------------------------------------------------------------------
+
+_TRIGGERS = ("nth", "every", "prob")
+_KINDS = (KIND_TRANSIENT, KIND_EXHAUSTED, KIND_FATAL, KIND_LATENCY)
+
+
+class FaultRule:
+    """One (site, trigger, action) line of a :class:`FaultPlan`.
+
+    ``site`` may be an exact site name or an ``fnmatch`` pattern
+    (``"dispatch.*"``).  Triggers:
+
+    - ``"nth"``  — fire on exactly the ``n``-th hit of the site.
+    - ``"every"`` — fire on every ``n``-th hit.
+    - ``"prob"`` — fire with probability ``p`` per hit, from a stream
+      seeded by ``crc32(site_pattern) ^ plan_seed`` (never the salted
+      builtin ``hash``), so two plans with the same seed fire on the
+      same hits in any process.
+
+    Action: ``kind`` is a taxonomy kind to raise, or ``"latency"`` to
+    sleep ``delay_s`` in place (for watchdog timeout tests).  An error
+    kind with ``delay_s > 0`` stalls for ``delay_s`` first and THEN
+    raises — a wedge, the shape a watchdog-abandoned launch takes.
+    ``limit`` caps total fires (0 = unlimited).
+    """
+
+    __slots__ = ("site", "trigger", "n", "p", "kind", "delay_s", "limit")
+
+    def __init__(self, site, trigger="nth", *, n=1, p=0.0, kind=KIND_TRANSIENT,
+                 delay_s=0.0, limit=1):
+        if trigger not in _TRIGGERS:
+            raise ValueError(f"trigger must be one of {_TRIGGERS}, got {trigger!r}")
+        if kind not in _KINDS:
+            raise ValueError(f"kind must be one of {_KINDS}, got {kind!r}")
+        if trigger in ("nth", "every") and n < 1:
+            raise ValueError(f"{trigger!r} trigger needs n >= 1, got {n}")
+        if trigger == "prob" and not (0.0 <= p <= 1.0):
+            raise ValueError(f"prob trigger needs 0 <= p <= 1, got {p}")
+        if delay_s < 0:
+            raise ValueError(f"delay_s must be >= 0, got {delay_s}")
+        self.site = str(site)
+        self.trigger = trigger
+        self.n = int(n)
+        self.p = float(p)
+        self.kind = kind
+        self.delay_s = float(delay_s)
+        self.limit = int(limit)
+
+    def to_dict(self):
+        return {
+            "site": self.site, "trigger": self.trigger, "n": self.n,
+            "p": self.p, "kind": self.kind, "delay_s": self.delay_s,
+            "limit": self.limit,
+        }
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d)
+        site = d.pop("site")
+        trigger = d.pop("trigger", "nth")
+        return cls(site, trigger, **d)
+
+    def __repr__(self):
+        return (f"FaultRule({self.site!r}, {self.trigger!r}, n={self.n}, "
+                f"p={self.p}, kind={self.kind!r}, delay_s={self.delay_s}, "
+                f"limit={self.limit})")
+
+
+class FaultPlan:
+    """A deterministic, seeded schedule of faults over named sites.
+
+    Hit counters are **per site name**, shared by every rule matching
+    that site, and all mutation happens under one lock so concurrent
+    replica driver threads see a consistent schedule.  ``fired`` maps
+    ``site -> count`` of injections actually delivered; tests read it
+    to prove a fault landed (recovery, not luck).
+    """
+
+    def __init__(self, rules: Sequence[FaultRule] = (), *, seed: int = 0):
+        self.seed = int(seed)
+        self.rules: List[FaultRule] = []
+        self._rngs: List[random.Random] = []
+        self._rule_fired: List[int] = []
+        self.hits: Dict[str, int] = {}
+        self.fired: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._sleep = time.sleep
+        for r in rules:
+            self.add(r)
+
+    def add(self, rule: FaultRule) -> "FaultPlan":
+        if not isinstance(rule, FaultRule):
+            rule = FaultRule.from_dict(rule)
+        # Stable per-rule stream: crc32 of the site pattern (never the
+        # per-process-salted builtin hash) xor plan seed xor rule index,
+        # so identical plans replay identically in any process.
+        seed = zlib.crc32(rule.site.encode()) ^ self.seed ^ (len(self.rules) << 17)
+        self.rules.append(rule)
+        self._rngs.append(random.Random(seed))
+        self._rule_fired.append(0)
+        return self
+
+    def injected_total(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+    def hit(self, site: str, counter=None) -> Optional[str]:
+        """Register one hit of ``site`` and apply the first matching
+        rule that fires.
+
+        Returns the fired kind (``"latency"`` after sleeping) or
+        ``None``; raises the taxonomy error for error kinds.  ``counter``
+        is an optional telemetry counter incremented with a ``site``
+        label on every fire (before raising).
+        """
+        fire: Optional[Tuple[int, FaultRule]] = None
+        with self._lock:
+            h = self.hits.get(site, 0) + 1
+            self.hits[site] = h
+            for i, rule in enumerate(self.rules):
+                if not fnmatch.fnmatchcase(site, rule.site):
+                    continue
+                if rule.limit and self._rule_fired[i] >= rule.limit:
+                    # Exhausted rules still consume their prob stream so
+                    # later rules' schedules never depend on limits.
+                    if rule.trigger == "prob":
+                        self._rngs[i].random()
+                    continue
+                if rule.trigger == "nth":
+                    hot = h == rule.n
+                elif rule.trigger == "every":
+                    hot = h % rule.n == 0
+                else:  # prob
+                    hot = self._rngs[i].random() < rule.p
+                if hot and fire is None:
+                    self._rule_fired[i] += 1
+                    self.fired[site] = self.fired.get(site, 0) + 1
+                    fire = (i, rule)
+        if fire is None:
+            return None
+        _, rule = fire
+        if counter is not None:
+            counter.inc(1, site=site)
+        if rule.kind == KIND_LATENCY:
+            self._sleep(rule.delay_s)
+            return KIND_LATENCY
+        if rule.delay_s > 0:
+            # a wedge: the site stalls for delay_s and THEN fails — the
+            # shape a watchdog-abandoned launch takes (it must never
+            # complete its work late, or it would replay into live state)
+            self._sleep(rule.delay_s)
+        raise make_error(rule.kind, f"injected {rule.kind} fault at {site}")
+
+    def to_dict(self):
+        return {"seed": self.seed, "rules": [r.to_dict() for r in self.rules]}
+
+    @classmethod
+    def from_dict(cls, d):
+        return cls([FaultRule.from_dict(r) for r in d.get("rules", ())],
+                   seed=d.get("seed", 0))
+
+    def __repr__(self):
+        return f"FaultPlan(seed={self.seed}, rules={self.rules!r})"
+
+
+# The armed plan.  Sites guard with a bare ``is not None`` test so the
+# unarmed path costs one attribute load — no call, no lock.
+ACTIVE_PLAN: Optional[FaultPlan] = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Make ``plan`` the process-wide active plan."""
+    global ACTIVE_PLAN
+    if not isinstance(plan, FaultPlan):
+        plan = FaultPlan.from_dict(plan)
+    ACTIVE_PLAN = plan
+    return plan
+
+
+def disarm() -> None:
+    """Clear the active plan; every site reverts to a no-op."""
+    global ACTIVE_PLAN
+    ACTIVE_PLAN = None
+
+
+@contextmanager
+def armed(plan: FaultPlan):
+    """Context manager: arm ``plan`` for the block, restore the previous
+    plan (usually None) after."""
+    global ACTIVE_PLAN
+    prev = ACTIVE_PLAN
+    plan = arm(plan)
+    try:
+        yield plan
+    finally:
+        ACTIVE_PLAN = prev
+
+
+def fire(site: str, telemetry=None) -> Optional[str]:
+    """Site-side helper: hit ``site`` on the active plan, wiring the
+    ``nxdi_fault_injected_total{site}`` counter through ``telemetry``
+    when one is attached.  Callers still guard with the bare
+    ``ACTIVE_PLAN is not None`` test so the unarmed path never enters
+    this function."""
+    plan = ACTIVE_PLAN
+    if plan is None:
+        return None
+    ctr = None
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        ctr = telemetry.registry.counter(
+            "nxdi_fault_injected_total",
+            "faults injected by the armed FaultPlan, by failpoint site",
+            ("site",),
+        )
+    return plan.hit(site, counter=ctr)
+
+
+# ---------------------------------------------------------------------------
+# Backoff + dispatch watchdog
+# ---------------------------------------------------------------------------
+
+def jittered_backoff(attempt: int, *, base_s: float, max_s: float,
+                     rng: Optional[random.Random] = None,
+                     jitter: float = 0.5) -> float:
+    """Exponential backoff with optional multiplicative jitter.
+
+    Deterministic core: ``min(base * 2**attempt, max)``.  With ``rng``,
+    the delay is scaled by a factor drawn uniformly from
+    ``[1 - jitter, 1]`` — "equal jitter lite": replicas polling the
+    same wedged socket desynchronise without ever exceeding the cap.
+    """
+    # clamp the exponent: callers feed unbounded counters (e.g. dry-poll
+    # streaks during a replica's compile warmup) and 2.0**1024 overflows
+    delay = min(base_s * (2.0 ** min(attempt, 63)), max_s)
+    if rng is not None and jitter > 0:
+        delay *= 1.0 - jitter * rng.random()
+    return delay
+
+
+class DispatchWatchdog:
+    """Run dispatch closures with a per-program timeout and bounded
+    transient retry.
+
+    The timeout for a program tag is ``floor_s × multiplier`` clamped to
+    ``min_timeout_s``, where ``floor_s`` comes from the analysis tier's
+    CostSheet (``max(t_compute, t_hbm)``; XLA-measured when available,
+    analytic fallback otherwise) — the cheapest honest lower bound on a
+    healthy launch.  Tags without a floor use ``min_timeout_s`` alone.
+
+    A dispatch that exceeds its timeout cannot be killed (the worker
+    thread is wedged inside the runtime), so the watchdog abandons the
+    worker, counts a *trip*, and treats the loss as transient.
+    Transient failures — trips or :func:`classify`-transient
+    exceptions — are retried up to ``max_retries`` times with the
+    deterministic schedule ``min(backoff_base * 2**attempt,
+    backoff_max)``.  Retries are safe because dispatch closures capture
+    batch + rng up front (idempotent replay).
+    """
+
+    def __init__(self, *, multiplier: float = 20.0, min_timeout_s: float = 0.5,
+                 max_retries: int = 2, backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0,
+                 on_retry: Optional[Callable[[], None]] = None,
+                 on_trip: Optional[Callable[[], None]] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        if multiplier <= 0:
+            raise ValueError(f"multiplier must be > 0, got {multiplier}")
+        if min_timeout_s <= 0:
+            raise ValueError(f"min_timeout_s must be > 0, got {min_timeout_s}")
+        if max_retries < 0:
+            raise ValueError(f"max_retries must be >= 0, got {max_retries}")
+        self.multiplier = float(multiplier)
+        self.min_timeout_s = float(min_timeout_s)
+        self.max_retries = int(max_retries)
+        self.backoff_base_s = float(backoff_base_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.floors: Dict[str, float] = {}
+        self.floor_sources: Dict[str, str] = {}
+        self.trips = 0
+        self.retries = 0
+        self._on_retry = on_retry
+        self._on_trip = on_trip
+        self._sleep = sleep
+        self._pool: Optional[ThreadPoolExecutor] = None
+
+    # -- configuration -----------------------------------------------------
+
+    def set_floor(self, tag: str, floor_s: float, source: str = "analytic"):
+        """Record the CostSheet floor for ``tag`` (keeps the max across
+        buckets — the widest bucket bounds every dispatch of the tag)."""
+        prev = self.floors.get(tag)
+        if prev is None or floor_s > prev:
+            self.floors[tag] = float(floor_s)
+            self.floor_sources[tag] = source
+
+    def load_floors(self, app) -> int:
+        """Populate floors from an application's compiled programs via
+        the cost observatory.  Returns the number of sheets read; safe
+        to call when analysis deps are unavailable (keeps defaults)."""
+        try:
+            from nxdi_tpu.analysis.costs import cost_sheets
+            sheets = cost_sheets(app, compile_missing=False)
+        except Exception:
+            return 0
+        n = 0
+        for s in sheets:
+            self.set_floor(s.tag, s.floor_s, s.source)
+            n += 1
+        return n
+
+    def timeout_for(self, tag: str) -> float:
+        """Per-program timeout: ``floor × multiplier`` clamped below by
+        ``min_timeout_s``; bare ``min_timeout_s`` for unknown tags."""
+        floor = self.floors.get(tag)
+        if floor is None:
+            return self.min_timeout_s
+        return max(self.min_timeout_s, floor * self.multiplier)
+
+    def backoff_schedule(self, attempt: int) -> float:
+        """Deterministic delay before retry ``attempt`` (0-based)."""
+        return min(self.backoff_base_s * (2.0 ** attempt), self.backoff_max_s)
+
+    # -- execution ---------------------------------------------------------
+
+    def _worker(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="nxdi-watchdog")
+        return self._pool
+
+    def _run_once(self, tag: str, fn: Callable):
+        timeout = self.timeout_for(tag)
+        try:
+            fut = self._worker().submit(fn)
+        except RuntimeError:
+            # the pool raced a shutdown (a trip abandoning it, or engine
+            # teardown); rebuild once — if the rebuild is also dead the
+            # process is exiting and the error propagates as fatal
+            self._pool = None
+            fut = self._worker().submit(fn)
+        try:
+            return fut.result(timeout=timeout)
+        except _FutureTimeout:
+            # The worker is wedged inside the runtime; abandon it (the
+            # thread leaks until the launch returns) and start fresh.
+            self.trips += 1
+            if self._on_trip is not None:
+                self._on_trip()
+            pool, self._pool = self._pool, None
+            if pool is not None:
+                pool.shutdown(wait=False)
+            raise TransientDispatchError(
+                f"dispatch watchdog: {tag} exceeded {timeout:.3f}s "
+                f"(floor {self.floors.get(tag, 0.0):.6f}s x {self.multiplier:g})"
+            ) from None
+
+    def run(self, tag: str, fn: Callable):
+        """Execute ``fn`` under the ``tag`` timeout, retrying transient
+        failures with deterministic exponential backoff."""
+        last: Optional[BaseException] = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                self.retries += 1
+                if self._on_retry is not None:
+                    self._on_retry()
+                self._sleep(self.backoff_schedule(attempt - 1))
+            try:
+                return self._run_once(tag, fn)
+            except Exception as e:  # noqa: BLE001 - classified below
+                if classify(e) != KIND_TRANSIENT:
+                    raise
+                last = e
+        assert last is not None
+        raise last
+
+    def shutdown(self):
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)

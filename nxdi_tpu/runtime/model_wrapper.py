@@ -28,7 +28,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from nxdi_tpu.kvcache.kv_cache import BlockKVLayout, ContiguousKVLayout
 from nxdi_tpu.models.base import causal_lm_forward
-from nxdi_tpu.runtime import autobucketing
+from nxdi_tpu.runtime import autobucketing, faults
 from nxdi_tpu.runtime.padding import pad_with_first_batchline
 
 
@@ -530,6 +530,11 @@ class ModelWrapper:
             _t0 = tel.clock()
         else:
             tel = None
+        if faults.ACTIVE_PLAN is not None:
+            # failpoint "dispatch.forward": injectable exception / latency
+            # for the watchdog + step-recovery machinery. Fires BEFORE any
+            # KV write lands, so a retried dispatch replays identically.
+            faults.fire(faults.SITE_DISPATCH, self.telemetry)
         input_ids = np.asarray(batch_np["input_ids"], dtype=np.int32)
         position_ids = np.asarray(batch_np["position_ids"], dtype=np.int32)
         b, s = input_ids.shape

@@ -51,6 +51,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import jax
 import numpy as np
 
+from nxdi_tpu.runtime import faults
 from nxdi_tpu.runtime.application import TAG_PREFIX_PREFILL
 from nxdi_tpu.runtime.block_manager import BlockSpaceManager
 from nxdi_tpu.runtime.model_wrapper import (
@@ -72,6 +73,11 @@ from nxdi_tpu.serving.request import (
 from nxdi_tpu.serving.scheduler import Scheduler, SchedulerConfig
 
 logger = logging.getLogger("nxdi_tpu")
+
+#: replica-fault marker (must match router.frontend.ENGINE_FAULT_PREFIX):
+#: an error finish whose message starts with this is a replica-side crash
+#: the router retries elsewhere — a validation rejection is not
+ENGINE_FAULT_PREFIX = "engine step failed"
 
 
 class InferenceEngine:
@@ -291,6 +297,64 @@ class InferenceEngine:
                 "attainment needs the request spans; nothing will be tracked"
             )
 
+        # fault tolerance (runtime/faults.py): taxonomy-driven step
+        # recovery is always on (budgets from TpuConfig(faults=...)); the
+        # dispatch watchdog is opt-in — it hops every dispatch through a
+        # worker thread to bound it by the CostSheet-floor-derived timeout
+        from nxdi_tpu.config import FaultConfig
+
+        self.fault_config = getattr(tc, "faults", None) or FaultConfig()
+        self._recovery_retries = None
+        self._recovery_requeues = None
+        self._recovery_fatal = None
+        self._watchdog_trips = None
+        if tel is not None:
+            r = tel.registry
+            self._recovery_retries = r.counter(
+                "nxdi_recovery_retries_total",
+                "in-place transient dispatch re-executions (watchdog retry)",
+            )
+            self._recovery_requeues = r.counter(
+                "nxdi_recovery_requeues_total",
+                "RUNNING requests requeued through the recompute-preemption "
+                "path after a recoverable engine-step fault",
+            )
+            self._recovery_fatal = r.counter(
+                "nxdi_recovery_fatal_total",
+                "requests error-finished by fault recovery (fatal fault or "
+                "recovery budget exhausted)",
+            )
+            self._watchdog_trips = r.counter(
+                "nxdi_watchdog_trips_total",
+                "dispatches abandoned by the watchdog timeout",
+            )
+            for c in (self._recovery_retries, self._recovery_requeues,
+                      self._recovery_fatal, self._watchdog_trips):
+                c.inc(0)
+        self.watchdog = None
+        fc = self.fault_config
+        if fc.watchdog:
+            self.watchdog = faults.DispatchWatchdog(
+                multiplier=fc.watchdog_multiplier,
+                min_timeout_s=fc.watchdog_min_timeout_s,
+                max_retries=fc.max_retries,
+                backoff_base_s=fc.backoff_base_s,
+                backoff_max_s=fc.backoff_max_s,
+                on_retry=(
+                    self._recovery_retries.inc
+                    if self._recovery_retries is not None else None
+                ),
+                on_trip=(
+                    self._watchdog_trips.inc
+                    if self._watchdog_trips is not None else None
+                ),
+            )
+            self.watchdog.load_floors(app)
+        #: requeue -> resumed-admission latencies (seconds) of step-fault
+        #: recoveries; bench.py --serving --chaos reads it for the
+        #: chaos_recovery_p95_ms headline
+        self.recovery_resume_s: List[float] = []
+
     # -- request intake -----------------------------------------------------
     def add_request(
         self,
@@ -419,10 +483,26 @@ class InferenceEngine:
         if fl is not None:
             fl.begin_step()
         finished: List[RequestOutput] = []
-        if self.mixed:
-            self._step_mixed(finished)
-        else:
-            self._step_split(finished)
+        try:
+            if faults.ACTIVE_PLAN is not None:
+                # failpoint "engine.step": a whole-step fault, upstream of
+                # any dispatch — exercises the requeue recovery directly
+                faults.fire(faults.SITE_ENGINE_STEP, self.telemetry)
+            if self.mixed:
+                self._step_mixed(finished)
+            else:
+                self._step_split(finished)
+        except Exception as e:  # noqa: BLE001 — classified below
+            kind = faults.classify(e)
+            if kind == faults.KIND_FATAL:
+                # the program or its inputs are broken: replaying would
+                # reproduce the failure — escalate to the driver (the
+                # ingest error-finishes with the engine-fault marker and
+                # the router fails the work over to another replica)
+                if self._recovery_fatal is not None:
+                    self._recovery_fatal.inc()
+                raise
+            self._recover_step_fault(e, kind, finished)
         self.scheduler.publish()
         if fl is not None:
             fl.end_step(
@@ -443,11 +523,84 @@ class InferenceEngine:
                 )
         return finished
 
+    def _dispatch_guarded(self, tag: str, fn):
+        """Run one dispatch closure, under the watchdog when armed. The
+        closure captures batch + rng up front, so a watchdog retry replays
+        the identical launch (same KV positions, same sampled values)."""
+        if self.watchdog is not None:
+            return self.watchdog.run(tag, fn)
+        return fn()
+
+    def _recover_step_fault(self, exc, kind: str, finished) -> None:
+        """A recoverable (transient / exhausted) fault escaped the step:
+        requeue every RUNNING request through the recompute-preemption
+        path — the prompt+generated replay is token-exact under greedy
+        (the PR-8 sentinel preemption-replay invariant) — instead of
+        error-finishing the whole engine's work. A request over its
+        ``max_recoveries`` budget error-finishes with the engine-fault
+        marker so the router fails THAT request over individually."""
+        fc = self.fault_config
+        clock = self.telemetry.clock if self.telemetry is not None else None
+        victims = [r for r in self.scheduler.slots if r is not None]
+        logger.warning(
+            "engine step fault (%s), recovering %d running request(s): %s",
+            kind, len(victims), exc,
+        )
+        requeued = failed = 0
+        for req in victims:
+            req.recoveries += 1
+            if req.recoveries > fc.max_recoveries:
+                req.error = (
+                    f"{ENGINE_FAULT_PREFIX}: {exc} (recovery budget "
+                    f"exhausted after {fc.max_recoveries})"
+                )
+                if self._recovery_fatal is not None:
+                    self._recovery_fatal.inc()
+                failed += 1
+                span = req.span
+                self._finish(req, "error", finished)
+                if self.flight is not None:
+                    self.flight.postmortem(
+                        "fault_recovery",
+                        detail={
+                            "kind": kind, "error": str(exc),
+                            "recoveries": req.recoveries,
+                            "max_recoveries": fc.max_recoveries,
+                        },
+                        request_span=span,
+                        request_id=req.request_id,
+                    )
+            else:
+                if clock is not None:
+                    req._recovered_at = clock()
+                self.scheduler._preempt(req)
+                requeued += 1
+                if self._recovery_requeues is not None:
+                    self._recovery_requeues.inc()
+        if self.flight is not None:
+            self.flight.record_fault(kind, str(exc), requeued, failed)
+        # the requeues freed blocks and reshaped the queue — that IS the
+        # progress that lets the next step readmit; never trip the stall
+        # guard for a recovered fault
+        self._progress = True
+
+    def _note_resumes(self, prefills: List[Request]) -> None:
+        """Stamp requeue -> resumed-admission latency for requests that
+        re-entered a slot after a step-fault recovery."""
+        clock = self.telemetry.clock if self.telemetry is not None else None
+        for req in prefills:
+            t = req._recovered_at
+            if t is not None:
+                req._recovered_at = None
+                if clock is not None:
+                    self.recovery_resume_s.append(clock() - t)
+
     def _step_split(self, finished: List[RequestOutput]) -> None:
         """The classic two-phase step: per-request prefill dispatches, then
         one batched decode dispatch."""
         preempted: List[Request] = []
         prefills = self.scheduler.schedule_prefills()
+        self._note_resumes(prefills)
         for req in prefills:
             self._prefill_chunk(req, finished)
         rows = self.scheduler.decodable()
@@ -484,6 +637,7 @@ class InferenceEngine:
         tc = self.tpu_config
         preempted: List[Request] = []
         prefills = self.scheduler.schedule_prefills()
+        self._note_resumes(prefills)
         rows = self.scheduler.decodable()
         if rows:
             # grow every decode row's table BEFORE packing: a preemption
@@ -580,15 +734,18 @@ class InferenceEngine:
                 )
         clock = self.telemetry.clock if self.telemetry is not None else None
         t0 = clock() if clock else 0.0
-        out = self.app.forward(
-            np.asarray(tokens, dtype=np.int32)[None, :],
-            np.asarray(positions, dtype=np.int32)[None, :],
-            last_token_index=lti,
-            sampling_params=SamplingParams.rows_tensor(
-                [p if p is not None else SamplingParams() for p in params_rows]
+        out = self._dispatch_guarded(
+            TAG_MIXED,
+            lambda: self.app.forward(
+                np.asarray(tokens, dtype=np.int32)[None, :],
+                np.asarray(positions, dtype=np.int32)[None, :],
+                last_token_index=lti,
+                sampling_params=SamplingParams.rows_tensor(
+                    [p if p is not None else SamplingParams() for p in params_rows]
+                ),
+                submodel=TAG_MIXED,
+                **kwargs,
             ),
-            submodel=TAG_MIXED,
-            **kwargs,
         )
         toks = self._tokens_of(out)  # (R,): one per slot; idle rows garbage
         dt = (clock() - t0) if clock else None
@@ -727,13 +884,16 @@ class InferenceEngine:
         kwargs = self._layout_kwargs([(req.slot, req)])
         self._maybe_rng(kwargs)
         submodel = TAG_CONTEXT_ENCODING if start == 0 else TAG_PREFIX_PREFILL
-        out = self.app.forward(
-            ids,
-            pos,
-            last_token_index=np.array([n - 1], dtype=np.int32),
-            sampling_params=req.params.tensor(1),
-            submodel=submodel,
-            **kwargs,
+        out = self._dispatch_guarded(
+            submodel,
+            lambda: self.app.forward(
+                ids,
+                pos,
+                last_token_index=np.array([n - 1], dtype=np.int32),
+                sampling_params=req.params.tensor(1),
+                submodel=submodel,
+                **kwargs,
+            ),
         )
         if self.flight is not None:
             self.flight.record_prefill(
@@ -833,13 +993,18 @@ class InferenceEngine:
             )
         clock = self.telemetry.clock if self.telemetry is not None else None
         t0 = clock() if clock else 0.0
-        out = self.app.forward(
-            ids,
-            pos,
-            last_token_index=np.zeros((B,), dtype=np.int32),
-            sampling_params=SamplingParams.rows_tensor([r.params for _, r in rows]),
-            submodel=TAG_TOKEN_GENERATION,
-            **kwargs,
+        out = self._dispatch_guarded(
+            TAG_TOKEN_GENERATION,
+            lambda: self.app.forward(
+                ids,
+                pos,
+                last_token_index=np.zeros((B,), dtype=np.int32),
+                sampling_params=SamplingParams.rows_tensor(
+                    [r.params for _, r in rows]
+                ),
+                submodel=TAG_TOKEN_GENERATION,
+                **kwargs,
+            ),
         )
         toks = self._tokens_of(out)
         dt = (clock() - t0) if clock else None
@@ -894,7 +1059,9 @@ class InferenceEngine:
             )
         clock = self.telemetry.clock if self.telemetry is not None else None
         t0 = clock() if clock else 0.0
-        out = self.app.token_gen_multistep(batch)
+        out = self._dispatch_guarded(
+            "token_gen_multistep", lambda: self.app.token_gen_multistep(batch)
+        )
         toks = np.asarray(jax.device_get(out["tokens"]))[:B]  # (B, steps)
         dt = (clock() - t0) if clock else None
         total_emitted = 0
@@ -971,7 +1138,9 @@ class InferenceEngine:
             batch["rng"] = self._rng.next()
         clock = self.telemetry.clock if self.telemetry is not None else None
         t0 = clock() if clock else 0.0
-        out = self.app.token_gen_device_loop(batch)
+        out = self._dispatch_guarded(
+            "token_gen_device_loop", lambda: self.app.token_gen_device_loop(batch)
+        )
         toks = np.asarray(jax.device_get(out["tokens"]))[:B]  # (B, cap)
         iters = int(jax.device_get(out["loop_iters"]))
         dt = (clock() - t0) if clock else None
@@ -1016,6 +1185,8 @@ class InferenceEngine:
         slot = req.slot  # retire() recycles it; the record keeps the row
         self.scheduler.retire(req, reason)
         metrics: Dict[str, float] = {"preemptions": req.preemptions}
+        if req.recoveries:
+            metrics["recoveries"] = req.recoveries
         if req.fork_parent_id is not None:
             # n>1 sibling: callers group continuations by the parent id
             metrics["parent_request_id"] = req.fork_parent_id
@@ -1061,6 +1232,7 @@ class InferenceEngine:
                 token_ids=list(req.generated),
                 finish_reason=reason,
                 metrics=metrics,
+                error=req.error,
             )
         )
 

@@ -145,6 +145,26 @@ class PrefixCache:
         return int(np.count_nonzero(self.mgr._refs[blks] == 1))
 
     # -- match / insert / evict ---------------------------------------------
+    def peek(self, tokens: Sequence[int], max_tokens: Optional[int] = None) -> int:
+        """Token count the longest cached full-block prefix of ``tokens``
+        would cover — WITHOUT touching LRU ticks or hit/miss stats. The
+        scheduler's cache-aware admission scan probes every waiting request
+        each step; only the request actually placed should move the cache's
+        observable state (its ``match`` at fork time does)."""
+        bs = self.block_size
+        limit = len(tokens) if max_tokens is None else min(max_tokens, len(tokens))
+        node = self._root
+        depth = 0
+        for i in range(limit // bs):
+            child = node.children.get(
+                tuple(int(t) for t in tokens[i * bs : (i + 1) * bs])
+            )
+            if child is None:
+                break
+            depth += 1
+            node = child
+        return depth * bs
+
     def match(
         self, tokens: Sequence[int], max_tokens: Optional[int] = None
     ) -> Tuple[List[int], int]:

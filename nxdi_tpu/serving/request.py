@@ -90,6 +90,19 @@ class Request:
         self.prefill_target = 0
         self.slot: Optional[int] = None
         self.preemptions = 0
+        #: step-fault recoveries consumed (requeues through the preemption
+        #: path after a transient engine fault); error-finishes past
+        #: ``FaultConfig.max_recoveries``
+        self.recoveries = 0
+        #: human-readable failure detail for ``finish_reason == "error"``
+        self.error: Optional[str] = None
+        #: telemetry-clock stamp of the last fault requeue; cleared (and
+        #: turned into a resume-latency sample) on re-admission
+        self._recovered_at: Optional[float] = None
+        #: telemetry-clock stamp of the last (re)entry into the waiting
+        #: queue — the scheduler's starvation bound for cache-aware
+        #: admission reads queue age from it
+        self.queued_s: Optional[float] = None
         # "eos" | "length" | "error" (un-resumable after preemption)
         self.finish_reason: Optional[str] = None
         self.span = None  # telemetry RequestSpan (engine-owned)
@@ -158,6 +171,9 @@ class RequestOutput:
     token_ids: List[int]  # generated tokens only
     finish_reason: str
     metrics: dict = field(default_factory=dict)
+    #: failure detail when ``finish_reason == "error"`` (None otherwise);
+    #: the router keys failover off its engine-fault prefix
+    error: Optional[str] = None
 
     @property
     def full_ids(self) -> List[int]:
@@ -170,4 +186,5 @@ class RequestOutput:
             "token_ids": list(self.token_ids),
             "finish_reason": self.finish_reason,
             "metrics": dict(self.metrics),
+            "error": self.error,
         }

@@ -34,6 +34,7 @@ Interleave policy (``SchedulerConfig.interleave``):
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import deque
 from dataclasses import dataclass
 from typing import Deque, List, Optional, Tuple
@@ -67,6 +68,17 @@ class SchedulerConfig:
     #: only, and the engine must be able to continue a prefill from a
     #: nonzero position (prefix-prefill submodel or mixed dispatch).
     prefix_cache: bool = False
+    #: with ``prefix_cache``: admit the waiting request with the LONGEST
+    #: cached prefix first (FCFS on ties) instead of strict FCFS — a warm
+    #: request costs a fraction of a cold prefill, so serving it first
+    #: raises goodput without starving anyone (see ``max_queue_age_s``)
+    cache_aware_admission: bool = True
+    #: starvation bound for cache-aware admission: once the queue HEAD has
+    #: waited this long, admission reverts to strict FCFS until it lands
+    max_queue_age_s: float = 2.0
+    #: waiting-queue positions the cache-aware scan inspects (bounds the
+    #: per-step host cost under deep queues; FCFS beyond the window)
+    admission_scan_limit: int = 64
 
     def __post_init__(self):
         if self.interleave not in INTERLEAVE_POLICIES:
@@ -76,6 +88,10 @@ class SchedulerConfig:
             )
         if self.max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
+        if self.max_queue_age_s <= 0:
+            raise ValueError("max_queue_age_s must be > 0")
+        if self.admission_scan_limit < 1:
+            raise ValueError("admission_scan_limit must be >= 1")
 
 
 class Scheduler:
@@ -168,16 +184,29 @@ class Scheduler:
         return free_after >= self.config.watermark_blocks
 
     # -- queue / admission --------------------------------------------------
+    def _now(self) -> float:
+        """Queue-age clock: the telemetry clock when present (tests
+        monkeypatch it for deterministic starvation-bound checks), else
+        ``time.monotonic``."""
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "clock", None) is not None:
+            return tel.clock()
+        return time.monotonic()
+
     def add(self, req: Request) -> None:
         req.state = WAITING
+        req.queued_s = self._now()
         self.waiting.append(req)
         self.publish()
 
     def schedule_prefills(self) -> List[Request]:
         """RUNNING requests with prefill work this step: in-flight chunked
-        prefills first (they always continue), then new FCFS admissions per
-        the interleave policy and the block watermark. Head-of-line blocking
-        is intentional — admission stays strictly FCFS."""
+        prefills first (they always continue), then new admissions per the
+        interleave policy and the block watermark. Admission order is FCFS
+        unless the prefix cache is on and ``cache_aware_admission`` holds:
+        then the waiting request with the longest cached prefix goes first
+        (FCFS tiebreak), reverting to strict FCFS whenever the queue head
+        has aged past ``max_queue_age_s`` so nobody starves."""
         out = [r for r in self.slots if r is not None and not r.prefill_done]
         admitted = 0
         while (
@@ -188,17 +217,72 @@ class Scheduler:
             slot = self._free_slot()
             if slot is None:
                 break
-            req = self.waiting[0]
+            idx = self._pick_admission()
+            req = self.waiting[idx]
             if not self._fork_ready(req):
                 break  # n>1 sibling: hold until its parent's prefill lands
             if not self._admissible(req):
                 break
-            self.waiting.popleft()
-            self._place(req, slot)
+            del self.waiting[idx]
+            try:
+                self._place(req, slot)
+            except RuntimeError:
+                # mid-admission pool failure (real exhaustion or an injected
+                # block.alloc fault): undo the half-placement, free a little
+                # room, and let the next step retry — never crash admission
+                self._unplace_failed(req)
+                self.preempt_youngest()
+                break
             out.append(req)
             admitted += 1
         self.publish()
         return out
+
+    def _pick_admission(self) -> int:
+        """Waiting-queue index to admit next. Strict FCFS (0) unless
+        cache-aware admission applies; then the longest cached prefix wins
+        with a strict ``>`` so equal hits keep arrival order. The scan is
+        read-only (``PrefixCache.peek``) — hit/miss stats and LRU ticks
+        only move when the fork actually happens at placement."""
+        cfg = self.config
+        cache = self.prefix_cache
+        if (
+            cache is None
+            or not cfg.cache_aware_admission
+            or len(self.waiting) < 2
+        ):
+            return 0
+        head = self.waiting[0]
+        if (
+            head.queued_s is not None
+            and self._now() - head.queued_s >= cfg.max_queue_age_s
+        ):
+            return 0  # starvation bound: an aged head always goes first
+        best_i, best_n = 0, -1
+        for i, req in enumerate(self.waiting):
+            if i >= cfg.admission_scan_limit:
+                break
+            toks = req.seq_tokens
+            n = cache.peek(toks, max_tokens=len(toks) - 1) if len(toks) > 1 else 0
+            if n > best_n:
+                best_i, best_n = i, n
+        return best_i
+
+    def _unplace_failed(self, req: Request) -> None:
+        """Undo a ``_place`` that died inside its block allocation: at that
+        point the slot table was not yet updated, but the request was
+        marked RUNNING and may hold forked/partially-grown blocks. Free
+        them and put the request back at the queue front (it keeps its
+        admission priority; ``fork_of`` was not yet cleared, so a sibling
+        fork retries intact)."""
+        if self.block_manager is not None:
+            self.block_manager.free_seq(req.request_id)
+        req.slot = None
+        req.state = WAITING
+        req.num_prefilled = 0
+        req.prefill_target = 0
+        req.queued_s = self._now()
+        self.waiting.appendleft(req)
 
     def _free_slot(self) -> Optional[int]:
         for i, r in enumerate(self.slots):
@@ -377,6 +461,7 @@ class Scheduler:
         req.num_prefilled = 0
         req.prefill_target = 0
         req.preemptions += 1
+        req.queued_s = self._now()
         if req.span is not None:
             req.span.phase("queue")
         self.waiting.appendleft(req)

@@ -53,6 +53,7 @@ logger = logging.getLogger("nxdi_tpu")
 #: (``detail["kind"]`` names which).
 TRIGGERS = (
     "slo_breach", "preemption_storm", "retrace_guard", "numerics", "manual",
+    "fault_recovery",
 )
 
 
@@ -63,7 +64,7 @@ class StepRecord:
     __slots__ = (
         "step", "t_start", "t_end", "admitted", "prefills", "decode",
         "mixed", "preempted", "retired", "programs", "kv_blocks_free",
-        "queue_depth", "slots_busy", "dispatch_s", "host_s",
+        "queue_depth", "slots_busy", "dispatch_s", "host_s", "faults",
     )
 
     def __init__(self, step: int, t_start: float):
@@ -83,6 +84,10 @@ class StepRecord:
         self.mixed: Optional[dict] = None
         #: [{request_id, slot}] — slot is the row the victim vacated
         self.preempted: List[dict] = []
+        #: [{kind, error, requeued, failed}] — step-fault recoveries: the
+        #: classified fault and how many running requests it requeued vs
+        #: error-finished (recovery budget exhausted)
+        self.faults: List[dict] = []
         #: [{request_id, slot, reason}]
         self.retired: List[dict] = []
         #: {(submodel, bucket, steps) -> {dispatches, seconds}} — fed by
@@ -117,6 +122,7 @@ class StepRecord:
             "mixed": self.mixed,
             "preempted": list(self.preempted),
             "retired": list(self.retired),
+            "faults": list(self.faults),
             "programs": [
                 {
                     "submodel": k[0], "bucket": k[1], "steps": k[2],
@@ -313,6 +319,16 @@ class FlightRecorder:
 
     def record_preemption(self, request_id, slot) -> None:
         self._append("preempted", {"request_id": request_id, "slot": slot})
+
+    def record_fault(
+        self, kind: str, error: str, requeued: int, failed: int
+    ) -> None:
+        """One recovered step fault: its taxonomy ``kind``, the error text,
+        and how the RUNNING set was disposed (requeued vs error-finished)."""
+        self._append(
+            "faults",
+            {"kind": kind, "error": error, "requeued": requeued, "failed": failed},
+        )
 
     def record_retirement(self, request_id, slot, reason: str) -> None:
         self._append(

@@ -91,6 +91,13 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     "routed_tok_s": ("higher", 0.07),
     "routed_ttft_p50_ms": ("lower", 0.12),
     "routed_ttft_p95_ms": ("lower", 0.18),
+    # chaos-mode recovery latency (bench.py --serving --chaos; PR: chaos
+    # harness). One-sided, skipped against pre-chaos baselines (missing
+    # on a side). Requeue -> re-admission latency rides the scheduler's
+    # admission cadence under a faulted Poisson workload — noisy, so it
+    # gets a wide tolerance; the retention headline is ABSOLUTE-gated
+    # below instead (a ratio of two same-run passes needs no baseline).
+    "chaos_recovery_p95_ms": ("lower", 0.30),
     # mixed-dispatch headline fields (bench.py --serving --mixed-dispatch;
     # PR: unified mixed prefill+decode dispatch). One-sided, skipped
     # against pre-mixed baselines (missing on a side). Padding waste is a
@@ -121,10 +128,15 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
 #: routed_failovers / routed_errors: the routed bench kills nothing (its
 #: one drain is cooperative), so ANY failover or error-finished request is
 #: a routing bug, not noise — must stay strictly under 1, fresh-side only.
+#: chaos_goodput_retention_pct: the chaos bench's faulted pass vs its own
+#: fault-free pass on identical work (bench.py --serving --chaos) — the
+#: recovery machinery must preserve at least 70% of goodput under the
+#: seeded fault plan, not merely avoid crashing. Higher-is-better floor.
 ABSOLUTE_LIMITS: Dict[str, Tuple[str, float]] = {
     "sentinel_overhead_pct": ("lower", 3.0),
     "routed_failovers": ("lower", 1.0),
     "routed_errors": ("lower", 1.0),
+    "chaos_goodput_retention_pct": ("higher", 70.0),
 }
 
 
@@ -228,7 +240,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 "fleet_goodput_req_s",
                                 "routed_goodput_req_s",
                                 "mixed_goodput_tok_s",
-                                "prefix_goodput_tok_s")):
+                                "prefix_goodput_tok_s",
+                                "chaos_goodput_retention_pct")):
         # a serving-, fleet-, or routed-mode FRESH record duplicates its
         # "value" headline as serving_/fleet_/routed_goodput_req_s (which
         # carry their own tolerances), and against a decode-mode baseline

@@ -1,0 +1,378 @@
+"""Chaos harness acceptance (ISSUE 14): deterministic fault injection
+over live engines — the serving stack must absorb injected faults with
+token-identical greedy streams and zero error finishes.
+
+- routed 2-replica workload under a seeded FaultPlan spanning all three
+  fault families (transient dispatch/step, allocation exhaustion,
+  transport) reproduces the fault-free streams bit-exactly, with
+  ``nxdi_recovery_requeues_total`` > 0 proving recovery (not luck);
+- a request over its ``max_recoveries`` budget error-finishes with the
+  engine-fault marker (``RequestOutput.error``), a fatal-recovery count,
+  and a ``fault_recovery`` postmortem bundle — neighbors unaffected;
+- the ingest driver recovers transient step faults LOCALLY (records stay
+  live, no failover) and only error-finishes — the router's failover
+  signal — on a fatal fault;
+- an injected latency fault trips the dispatch watchdog: the wedged
+  worker is abandoned, the retry replays the identical launch, and the
+  stream stays token-identical.
+"""
+
+import time
+
+import pytest
+
+from nxdi_tpu.config import (
+    FleetConfig,
+    OnDeviceSamplingConfig,
+    RouterConfig,
+    TpuConfig,
+)
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.router import ReplicaIngest, Router
+from nxdi_tpu.runtime import faults
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.serving import InferenceEngine, SamplingParams, SchedulerConfig
+from nxdi_tpu.serving.engine import ENGINE_FAULT_PREFIX
+
+WORKLOAD = [
+    ([5, 9, 3, 17, 2, 8, 11, 42], 6),
+    ([7, 13, 21, 4, 33], 6),
+    ([9, 9, 2, 40, 17, 3], 6),
+    ([12, 5, 88, 3], 6),
+]
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama_module():
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    return model, cfg
+
+
+def _build_engine(hf_model, hf_cfg, replica_id="rep-0", faults_cfg=None,
+                  num_slots=2):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(
+            tp_degree=1,
+            seq_len=64,
+            max_context_length=32,
+            batch_size=2,
+            ctx_batch_size=1,
+            tkg_batch_size=2,
+            dtype="float32",
+            on_device_sampling_config=OnDeviceSamplingConfig(),
+            skip_warmup=True,
+            is_block_kv_layout=True,
+            pa_block_size=8,
+            pa_num_blocks=32,
+            telemetry={"detail": "basic", "replica_id": replica_id},
+            faults=faults_cfg or {},
+        ),
+        load_config=lambda: hf_cfg.to_dict(),
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app, InferenceEngine(app, SchedulerConfig(num_slots=num_slots))
+
+
+def _expected_streams(engine, jobs):
+    """Fault-free single-engine reference run (also warms every compiled
+    program, so the chaos pass never reads compile time as fault cost)."""
+    expected = []
+    for prompt, max_new in jobs:
+        engine.add_request(prompt, SamplingParams(max_new_tokens=max_new))
+        (out,) = engine.run()
+        assert out.finish_reason in ("eos", "length") and out.error is None
+        expected.append(list(out.token_ids))
+    return expected
+
+
+def _call(method, url, payload=None, attempts=10):
+    """HTTP through the faultable ``http_json`` — the client retries
+    injected transport faults exactly like a production client would."""
+    from nxdi_tpu.router import http_json
+
+    last = None
+    for attempt in range(attempts):
+        try:
+            status, resp = http_json(method, url, payload, timeout_s=10.0)
+            if status < 500:
+                return status, resp
+            last = (status, resp)
+        except Exception as e:  # noqa: BLE001 — injected transport faults
+            last = e
+        time.sleep(0.02 * (attempt + 1))
+    raise AssertionError(f"{method} {url} never succeeded: {last}")
+
+
+def _poll_stream(url, rid, deadline_s=120.0):
+    deadline = time.time() + deadline_s
+    cursor, tokens = 0, []
+    while time.time() < deadline:
+        status, resp = _call("GET",
+                             f"{url}/stream?request_id={rid}&cursor={cursor}")
+        assert status == 200, resp
+        cursor = resp["cursor"]
+        tokens.extend(resp["tokens"])
+        if resp["done"]:
+            return dict(resp, tokens=tokens)
+        time.sleep(0.01)
+    raise AssertionError(f"request {rid} never finished under chaos")
+
+
+@pytest.mark.slow
+def test_routed_chaos_parity_token_identical_under_faults(
+    tiny_hf_llama_module,
+):
+    """The acceptance anchor: a seeded FaultPlan spanning transient
+    dispatch faults, a whole-step fault, an allocation exhaustion, and
+    transport faults — streams stay bit-identical to the fault-free run,
+    nothing error-finishes, and the requeue counter proves at least one
+    request actually travelled the recovery path."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines = [], []
+    for i in range(2):
+        # watchdog armed; recovery budget widened so repeated injected
+        # step faults can never exhaust a single request's budget
+        app, engine = _build_engine(
+            hf_model, hf_cfg, replica_id=f"rep-{i}",
+            faults_cfg={"watchdog": True, "max_recoveries": 8},
+        )
+        apps.append(app)
+        engines.append(engine)
+    expected = _expected_streams(engines[0], WORKLOAD)
+
+    ingests, servers, targets = [], [], []
+    for i in range(2):
+        ingest = ReplicaIngest(engines[i])
+        mserver = apps[i].telemetry.serve(port=0)
+        iserver = ingest.serve(port=0)
+        ingests.append(ingest)
+        servers.extend([mserver, iserver])
+        targets.append((f"rep-{i}", mserver.url, iserver.url))
+    router = Router(
+        targets,
+        config=RouterConfig(stream_failures=3, poll_interval_s=0.2),
+        # lenient health thresholds: injected transport faults must cost
+        # retries, not replica evictions
+        fleet_config=FleetConfig(
+            staleness_s=3600.0, unreachable_failures=5,
+            backoff_base_s=0.01, backoff_max_s=0.05, timeout_s=5.0,
+        ),
+    )
+    frontend = router.serve(port=0)
+    plan = faults.FaultPlan([
+        # transient dispatch faults: absorbed by the watchdog retry
+        faults.FaultRule(faults.SITE_DISPATCH, "every", n=5,
+                         kind="transient", limit=2),
+        # whole-step faults: exercise the requeue recovery repeatedly
+        faults.FaultRule(faults.SITE_ENGINE_STEP, "every", n=4,
+                         kind="transient", limit=4),
+        # one allocation exhaustion mid-admission or mid-growth
+        faults.FaultRule(faults.SITE_BLOCK_ALLOC, "nth", n=3,
+                         kind="exhausted", limit=1),
+        # transport faults: clients and router retry, never evict
+        faults.FaultRule(faults.SITE_TRANSPORT, "every", n=6,
+                         kind="transient", limit=4),
+    ], seed=20260805)
+    try:
+        router.poll()
+        finals = {}
+        with faults.armed(plan):
+            for i, (prompt, max_new) in enumerate(WORKLOAD):
+                status, resp = _call("POST", f"{frontend.url}/submit", {
+                    "request_id": f"chaos-{i}",
+                    "prompt": prompt,
+                    "max_new_tokens": max_new,
+                    "session_id": f"conv-{i % 2}",
+                })
+                assert status == 200, resp
+            for i in range(len(WORKLOAD)):
+                finals[i] = _poll_stream(frontend.url, f"chaos-{i}")
+        for i in range(len(WORKLOAD)):
+            assert finals[i]["tokens"] == expected[i], (
+                f"request chaos-{i} diverged under faults"
+            )
+            assert finals[i]["finish_reason"] in ("eos", "length")
+            assert finals[i].get("error") is None
+        # every fault family actually landed ...
+        assert plan.fired.get(faults.SITE_DISPATCH, 0) >= 1
+        assert plan.fired.get(faults.SITE_ENGINE_STEP, 0) >= 1
+        assert plan.fired.get(faults.SITE_BLOCK_ALLOC, 0) >= 1
+        assert plan.fired.get(faults.SITE_TRANSPORT, 0) >= 1
+        # ... and at least one request travelled the requeue recovery path
+        requeues = sum(e._recovery_requeues.total() for e in engines)
+        assert requeues > 0
+        # the injected-fault counter federates per site
+        injected = sum(
+            e.telemetry.registry.counter(
+                "nxdi_fault_injected_total", "", ("site",)
+            ).total()
+            for e in engines
+        )
+        assert injected >= 1  # engine-side sites count into telemetry
+    finally:
+        router.stop()
+        for ingest in ingests:
+            ingest.stop()
+        for s in servers:
+            s.shutdown()
+
+
+def test_recovery_budget_exhaustion_error_finishes_with_marker(
+    tiny_hf_llama_module,
+):
+    """A request that keeps getting requeued past ``max_recoveries``
+    error-finishes with the ENGINE_FAULT_PREFIX marker (the router's
+    failover signal), counts a fatal recovery, and captures a
+    ``fault_recovery`` postmortem bundle."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    app, engine = _build_engine(
+        hf_model, hf_cfg, faults_cfg={"max_recoveries": 1},
+    )
+    engine.add_request(WORKLOAD[0][0], SamplingParams(max_new_tokens=6))
+    # every 2nd step faults: odd steps make progress (prefill/replay),
+    # even steps requeue — recoveries hits 2 > budget 1 -> error-finish
+    plan = faults.FaultPlan([
+        faults.FaultRule(faults.SITE_ENGINE_STEP, "every", n=2,
+                         kind="transient", limit=0),
+    ])
+    with faults.armed(plan):
+        outs = engine.run()
+    (out,) = outs
+    assert out.finish_reason == "error"
+    assert out.error is not None and out.error.startswith(ENGINE_FAULT_PREFIX)
+    assert "recovery budget exhausted" in out.error
+    assert out.metrics["recoveries"] == 2
+    assert engine._recovery_requeues.total() >= 1
+    assert engine._recovery_fatal.total() == 1
+    assert any(p["trigger"] == "fault_recovery"
+               for p in engine.flight.postmortems)
+    # the engine is not poisoned: the same prompt now runs clean
+    engine.add_request(WORKLOAD[0][0], SamplingParams(max_new_tokens=6))
+    (clean,) = engine.run()
+    assert clean.finish_reason in ("eos", "length") and clean.error is None
+
+
+def test_ingest_recovers_transient_locally_and_fails_over_on_fatal(
+    tiny_hf_llama_module,
+):
+    """Satellite 6 precedence pin: a transient step fault escaping the
+    engine must NOT error-finish the ingest's records (local recovery —
+    the stream finishes token-identical); only a FATAL fault raises the
+    engine-fault marker the router keys failover off."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    app, engine = _build_engine(hf_model, hf_cfg)
+    prompt, max_new = WORKLOAD[1]
+    expected = _expected_streams(engine, [(prompt, max_new)])[0]
+
+    ingest = ReplicaIngest(engine)
+    ingest.start()
+
+    def wait_done(rid, deadline_s=60.0):
+        deadline = time.time() + deadline_s
+        while time.time() < deadline:
+            status, rec = ingest.stream(rid, 0)
+            assert status == 200
+            if rec["done"]:
+                return rec
+            time.sleep(0.01)
+        raise AssertionError(f"{rid} never finished")
+
+    try:
+        # phase 1 — transient: recovered locally, stream token-identical
+        plan = faults.FaultPlan([
+            faults.FaultRule(faults.SITE_ENGINE_STEP, "nth", n=2,
+                             kind="transient", limit=1),
+        ])
+        with faults.armed(plan):
+            status, resp = ingest.submit(
+                {"request_id": "t-1", "prompt": prompt,
+                 "max_new_tokens": max_new})
+            assert status == 200 and resp["status"] == "queued"
+            rec = wait_done("t-1")
+        assert plan.injected_total() == 1
+        assert rec["finish_reason"] in ("eos", "length")
+        assert rec["error"] is None
+        assert rec["tokens"] == expected
+        assert engine._recovery_requeues.total() >= 0  # zero-victim fire ok
+
+        # phase 2 — fatal: the driver error-finishes with the marker
+        plan = faults.FaultPlan([
+            faults.FaultRule(faults.SITE_ENGINE_STEP, "nth", n=1,
+                             kind="fatal", limit=1),
+        ])
+        with faults.armed(plan):
+            status, resp = ingest.submit(
+                {"request_id": "f-1", "prompt": prompt,
+                 "max_new_tokens": max_new})
+            assert status == 200 and resp["status"] == "queued"
+            rec = wait_done("f-1")
+        assert rec["finish_reason"] == "error"
+        assert rec["error"].startswith(ENGINE_FAULT_PREFIX)
+        assert engine._recovery_fatal.total() >= 1
+
+        # the driver survived the fatal fault: fresh work serves clean
+        status, resp = ingest.submit(
+            {"request_id": "c-1", "prompt": prompt,
+             "max_new_tokens": max_new})
+        assert status == 200 and resp["status"] == "queued"
+        rec = wait_done("c-1")
+        assert rec["finish_reason"] in ("eos", "length")
+        assert rec["tokens"] == expected
+    finally:
+        ingest.stop()
+
+
+def test_watchdog_trips_on_injected_latency_and_replays_identically(
+    tiny_hf_llama_module,
+):
+    """An injected wedge (stall past the timeout, then fail — the fault
+    NEVER completes the dispatch, so its late failure cannot replay into
+    live buffers) trips the watchdog: the worker is abandoned, a trip is
+    counted, and the retry replays the identical launch — the stream
+    stays token-identical."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    app, engine = _build_engine(
+        hf_model, hf_cfg,
+        faults_cfg={"watchdog": True, "watchdog_min_timeout_s": 0.25,
+                    "watchdog_multiplier": 1.0, "backoff_base_s": 0.01},
+    )
+    prompt, max_new = WORKLOAD[2]
+    assert engine.watchdog is not None
+    # warm WITHOUT the tight watchdog: the first execution of each program
+    # is compile-skewed and is not a health signal (production arms the
+    # watchdog after warmup for the same reason)
+    wd, engine.watchdog = engine.watchdog, None
+    expected = _expected_streams(engine, [(prompt, max_new)])[0]
+    engine.watchdog = wd
+    # CPU floors are microseconds: floor x multiplier stays clamped at
+    # min_timeout_s, so a 1.2s stall must trip
+    plan = faults.FaultPlan([
+        faults.FaultRule(faults.SITE_DISPATCH, "nth", n=1, kind="transient",
+                         delay_s=1.2, limit=1),
+    ])
+    engine.add_request(prompt, SamplingParams(max_new_tokens=max_new))
+    with faults.armed(plan):
+        (out,) = engine.run()
+    assert plan.injected_total() == 1
+    assert engine.watchdog.trips == 1
+    assert engine._watchdog_trips.total() == 1
+    assert engine.watchdog.retries >= 1
+    assert out.finish_reason in ("eos", "length") and out.error is None
+    assert list(out.token_ids) == expected

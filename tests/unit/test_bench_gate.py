@@ -252,6 +252,60 @@ def test_routed_metrics_gate_and_failover_absolute(tmp_path):
     assert rc == 0
 
 
+def test_chaos_retention_absolute_gate(tmp_path):
+    """bench.py --serving --chaos emits chaos_* fields: the goodput
+    retention is ABSOLUTE-gated (>= 70, higher-is-better — a ratio of two
+    same-run passes needs no baseline), chaos_recovery_p95_ms gates
+    one-sided against same-shape baselines and skips against pre-chaos
+    ones, and the generic 'value' row (the retention pct) is suppressed
+    so it never gates against a decode-mode tok/s baseline."""
+    chaos = {
+        "value": 88.0,
+        "chaos_goodput_retention_pct": 88.0,
+        "chaos_recovery_p95_ms": 45.0,
+        "chaos_stream_mismatches": 0,
+        "chaos_errors": 0,
+        "chaos_requeues": 3,
+        "chaos_injected": 9,
+    }
+    # pre-chaos baseline (decode-mode BASE): chaos_* comparisons skip, the
+    # suppressed "value" row cannot fail, and the ABSOLUTE floor passes
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", chaos),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, chaos, bench_gate.TOLERANCES)
+    assert "chaos_recovery_p95_ms" in skipped
+
+    # retention under the 70% floor fails ABSOLUTELY, baseline or not
+    leaky = dict(chaos, value=55.0, chaos_goodput_retention_pct=55.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", leaky),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 1
+
+    # same-shape baseline: recovery-latency blowup beyond the (wide)
+    # tolerance fails; an improvement passes one-sided
+    slow = dict(chaos, chaos_recovery_p95_ms=90.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", slow),
+        "--baseline", _write(tmp_path, "base.json", chaos),
+        "-q",
+    ])
+    assert rc == 1
+    fast = dict(chaos, chaos_recovery_p95_ms=20.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fast),
+        "--baseline", _write(tmp_path, "base.json", chaos),
+        "-q",
+    ])
+    assert rc == 0
+
+
 def test_mixed_metrics_gate_and_skip_when_absent(tmp_path):
     """bench.py --serving --mixed-dispatch emits mixed_* headline fields:
     one-sided gating (goodput higher, padding waste lower), skipped against

@@ -343,3 +343,25 @@ def test_prefix_counters_registered_and_preseeded():
     # seed cached 2 blocks, one was evicted — the gauge tracks the tree
     assert tel.registry.get("nxdi_prefix_cached_blocks").value() == len(cache) == 1
     assert cache.hit_rate_pct == pytest.approx(50.0)
+
+
+# ------------------------------------------------------------------- peek
+def test_peek_longest_prefix_is_read_only():
+    """ISSUE 14 satellite: the scheduler's cache-aware admission scan
+    probes every waiting request each step via ``peek`` — it must agree
+    with ``match`` on length while moving NO observable cache state
+    (hit/miss counters, LRU ticks)."""
+    mgr, cache = mgr_cache()
+    toks = list(range(10, 22))  # 12 tokens = 3 full blocks
+    seed(mgr, cache, 1, toks)
+    tick = cache._tick
+    assert cache.peek(toks) == 12
+    assert cache.peek(toks, max_tokens=11) == 8  # cap rounds to full blocks
+    assert cache.peek(toks[:6]) == 4  # partial tail block never counts
+    assert cache.peek(toks[:3]) == 0  # under one block
+    assert cache.peek([1, 2, 3, 4, 5]) == 0  # total miss
+    assert cache.hits_n == 0 and cache.misses_n == 0
+    assert cache._tick == tick
+    # and it agrees with what match would fork (same cap convention)
+    chain, ntok = cache.match(toks, max_tokens=11)
+    assert ntok == 8 and len(chain) == 2

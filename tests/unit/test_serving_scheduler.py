@@ -271,3 +271,144 @@ def test_preemption_publishes_counter_and_gauges():
     assert tel.serve_preemptions_total.value() == 1
     assert tel.serve_queue_depth.value() == 1
     assert tel.serve_slots_busy.value() == 1
+
+
+# ---------------------------------------------------------------------------
+# Cache-aware admission (ISSUE 14 satellite): longest cached prefix first,
+# FCFS tiebreak, starvation bound, and the read-only scan
+# ---------------------------------------------------------------------------
+
+def _seeded_cache(mgr, tokens, seq_id=999):
+    """Prefill-and-retire one sequence so its full blocks live in the
+    radix tree (the scheduler retire path in miniature)."""
+    from nxdi_tpu.serving.prefix_cache import PrefixCache
+
+    cache = PrefixCache(mgr)
+    table = list(mgr.ensure_capacity(seq_id, len(tokens)))
+    cache.insert(tokens, table)
+    mgr.free_seq(seq_id)
+    return cache
+
+
+SHARED = list(range(100, 112))  # 12 tokens = 3 full blocks of 4
+
+
+def _cache_sched(num_slots=2, telemetry=None, **cfg):
+    mgr = BlockSpaceManager(32, 4)
+    cache = _seeded_cache(mgr, SHARED)
+    s = Scheduler(num_slots, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0, **cfg),
+                  telemetry=telemetry)
+    s.prefix_cache = cache
+    return s, cache
+
+
+def _cold(n=12, max_new=4):
+    return Request(list(range(1, n + 1)), SamplingParams(max_new_tokens=max_new))
+
+
+def _warm(max_new=4):
+    return Request(SHARED + [500], SamplingParams(max_new_tokens=max_new))
+
+
+def test_cache_aware_admission_prefers_longest_cached_prefix():
+    s, cache = _cache_sched(max_prefills_per_step=1)
+    cold, warm = _cold(), _warm()
+    s.add(cold)
+    s.add(warm)  # arrives SECOND but holds a 12-token cached prefix
+    placed = s.schedule_prefills()
+    assert placed == [warm]
+    assert warm.state == RUNNING and warm.num_prefilled == 12  # forked chain
+    assert cold.state == WAITING and list(s.waiting) == [cold]
+    # the cold request is not starved — it simply goes next
+    _complete(warm)
+    assert s.schedule_prefills() == [cold]
+
+
+def test_cache_aware_admission_fcfs_tiebreak_on_equal_hits():
+    s, _ = _cache_sched(max_prefills_per_step=1)
+    a, b = _cold(), _cold()  # both miss the cache entirely
+    s.add(a)
+    s.add(b)
+    assert s.schedule_prefills() == [a]  # strict > keeps arrival order
+    s2, _ = _cache_sched(max_prefills_per_step=1)
+    wa, wb = _warm(), _warm()  # both share the SAME cached prefix
+    s2.add(wa)
+    s2.add(wb)
+    assert s2.schedule_prefills() == [wa]
+
+
+def test_cache_aware_admission_starvation_bound_by_queue_age():
+    from nxdi_tpu.telemetry import Telemetry
+
+    t = {"now": 0.0}
+    tel = Telemetry(clock=lambda: t["now"])
+    s, _ = _cache_sched(max_prefills_per_step=1, telemetry=tel,
+                        max_queue_age_s=5.0)
+    cold, warm = _cold(), _warm()
+    s.add(cold)
+    s.add(warm)
+    # young head: the cache hit still wins ...
+    assert s._pick_admission() == 1
+    # ... but once the head ages past the bound, FCFS reasserts itself
+    t["now"] = 6.0
+    assert s._pick_admission() == 0
+    assert s.schedule_prefills() == [cold]
+
+
+def test_cache_aware_admission_can_be_disabled():
+    s, _ = _cache_sched(max_prefills_per_step=1, cache_aware_admission=False)
+    cold, warm = _cold(), _warm()
+    s.add(cold)
+    s.add(warm)
+    assert s.schedule_prefills() == [cold]  # strict FCFS, cache ignored
+
+
+def test_admission_scan_is_read_only_on_the_cache():
+    s, cache = _cache_sched(max_prefills_per_step=1)
+    s.add(_cold())
+    s.add(_warm())
+    tick_before = cache._tick
+    for _ in range(5):
+        assert s._pick_admission() == 1
+    # probing every waiting request moved NO observable cache state
+    assert cache.hits_n == 0 and cache.misses_n == 0
+    assert cache._tick == tick_before
+    # the fork at placement is the first real touch
+    s.schedule_prefills()
+    assert cache.hits_n == 1
+
+
+def test_admission_degrades_on_injected_alloc_failure():
+    """Satellite: a mid-placement pool failure (here an injected
+    ``block.alloc`` exhaustion) must undo the half-placement, requeue the
+    request at the front, preempt the youngest runner for headroom, and
+    admit cleanly on the next step — never crash the scheduler."""
+    from nxdi_tpu.runtime import faults
+
+    mgr = BlockSpaceManager(8, 4)
+    s = Scheduler(2, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0,
+                                         max_prefills_per_step=4))
+    occupant = req(8)
+    s.add(occupant)
+    _complete(*s.schedule_prefills())
+    nxt = req(8)
+    s.add(nxt)
+    plan = faults.FaultPlan(
+        [faults.FaultRule(faults.SITE_BLOCK_ALLOC, "nth", n=1,
+                          kind="exhausted")])
+    with faults.armed(plan):
+        placed = s.schedule_prefills()
+    assert placed == [] and plan.injected_total() == 1
+    # the half-placement was undone ...
+    assert nxt.slot is None and nxt.state == WAITING
+    assert nxt.num_prefilled == 0 and nxt.prefill_target == 0
+    assert mgr._tables.get(nxt.request_id) is None
+    # ... the youngest runner was preempted for headroom ...
+    assert occupant.state == PREEMPTED
+    assert list(s.waiting) == [occupant, nxt]
+    # ... and the next step admits both without residue
+    placed = s.schedule_prefills()
+    assert placed == [occupant, nxt]
+    assert occupant.state == RUNNING and nxt.state == RUNNING
