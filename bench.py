@@ -1000,7 +1000,7 @@ def _flag_value(name, default):
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
     replica_id=None, rng=None, sentinel=None, mixed=False, prefix_cache=False,
-    faults=None,
+    faults=None, role="unified",
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -1039,6 +1039,7 @@ def _build_serving_stack(
         mixed_dispatch=mixed,
         is_prefix_caching=prefix_cache,
         faults=faults,
+        role=role,
     )
     cfg = ml.LlamaInferenceConfig(
         tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
@@ -1711,6 +1712,211 @@ def main_routed_serving(
     return rec
 
 
+def main_disagg_serving(
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+):
+    """``bench.py --serving --disaggregated``: prefill/decode disaggregation
+    vs a unified fleet on the SAME two engines' worth of hardware and the
+    very same pooled Poisson workload. Side A routes over two unified
+    replicas (every engine interleaves CTE dispatches between decode
+    steps); side B routes over one ``role='prefill'`` plus one
+    ``role='decode'`` replica, with the router moving each request's KV
+    block chain from the prefill engine to the decode engine after the
+    first token (nxdi_tpu/serving/handoff wire payload, retained until
+    the decode side acks). Headline fields gated one-sided by
+    scripts/bench_gate.py (skipped against pre-disagg baselines — missing
+    on a side):
+
+    - ``disagg_tpot_p95_ms`` — CLIENT-observed p95 inter-token latency on
+      the disaggregated side; the disaggregation claim is that decode
+      steps no longer stall behind another request's prefill, so this must
+      come in UNDER ``unified_tpot_p95_ms`` (carried alongside as the
+      same-run reference);
+    - ``disagg_goodput_tok_s`` — served tok/s through the disaggregated
+      router tier;
+    - ``disagg_handoff_p50_ms`` — p50 of the router's fetch->place->ack
+      handoff span (``nxdi_handoff_latency``): the migration cost a
+      request pays once, amortized over its whole decode stream.
+    """
+    import random as _random
+    import threading
+    import time as _time
+
+    from nxdi_tpu.cli.route import _http
+    from nxdi_tpu.config import FleetConfig, RouterConfig
+    from nxdi_tpu.router import ReplicaIngest, Router
+    from nxdi_tpu.runtime.faults import jittered_backoff
+    from nxdi_tpu.telemetry.registry import percentile_exact
+
+    def run_side(tag, roles):
+        stacks, servers, ingests, targets = [], [], [], []
+        for i, role in enumerate(roles):
+            app, engine = _build_serving_stack(
+                slots, seq_len, prompt_len, n_layers, slo_ttft_ms,
+                slo_tpot_ms, replica_id=f"{tag}-r{i}", role=role,
+            )
+            mserver = app.telemetry.serve(port=0)
+            ingest = ReplicaIngest(engine)
+            iserver = ingest.serve(port=0)
+            stacks.append((app, engine))
+            servers.extend([mserver, iserver])
+            ingests.append(ingest)
+            targets.append((f"{tag}-r{i}", mserver.url, iserver.url))
+
+        router = Router(
+            targets,
+            config=RouterConfig(shed_queue_depth=float(requests + slots),
+                                poll_interval_s=0.25),
+            fleet_config=FleetConfig(staleness_s=3600.0),
+        )
+        router.start()
+        frontend = router.serve(port=0)
+
+        # identical stream both sides: same seed, same prompts, same
+        # arrival times — the ONLY variable is the fleet topology
+        rng = np.random.default_rng(0)
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+        prompts = [
+            rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+            .astype(np.int32).tolist()
+            for _ in range(requests)
+        ]
+        results = [None] * requests
+        t0 = _time.perf_counter()
+
+        def client(i):
+            arrival = t0 + float(arrivals[i])
+            _time.sleep(max(arrival - _time.perf_counter(), 0.0))
+            status, resp = _http("POST", f"{frontend.url}/submit", {
+                "request_id": f"{tag}-{i}",
+                "prompt": prompts[i],
+                "max_new_tokens": max_new,
+            })
+            if status != 200:
+                results[i] = {"error": f"submit HTTP {status}", "tokens": 0}
+                return
+            poll_rng = _random.Random(i)
+            cursor, n_tok, first_s, idle = 0, 0, None, 0
+            while True:
+                status, resp = _http(
+                    "GET",
+                    f"{frontend.url}/stream"
+                    f"?request_id={tag}-{i}&cursor={cursor}",
+                )
+                if status != 200:
+                    results[i] = {"error": f"stream HTTP {status}",
+                                  "tokens": n_tok}
+                    return
+                cursor = resp["cursor"]
+                n_tok += len(resp["tokens"])
+                if first_s is None and n_tok > 0:
+                    first_s = _time.perf_counter()
+                if resp["done"]:
+                    end_s = _time.perf_counter()
+                    results[i] = {
+                        "error": resp["error"]
+                        if resp["finish_reason"] == "error" else None,
+                        "tokens": n_tok,
+                        "ttft_s": (first_s - arrival)
+                        if first_s is not None else None,
+                        # client-observed inter-token pace: decode stream
+                        # wall over the tokens after the first — on the
+                        # disagg side this includes the one handoff gap
+                        "tpot_s": (end_s - first_s) / max(n_tok - 1, 1)
+                        if first_s is not None else None,
+                        "end_s": end_s - t0,
+                        "failovers": resp.get("failovers", 0),
+                    }
+                    return
+                idle = idle + 1 if not resp["tokens"] else 0
+                _time.sleep(jittered_backoff(
+                    idle, base_s=0.003, max_s=0.05, rng=poll_rng
+                ))
+
+        threads = [threading.Thread(target=client, args=(i,), daemon=True)
+                   for i in range(requests)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        ok = [r for r in results if r and not r["error"]]
+        wall = max((r["end_s"] for r in ok), default=1e-9)
+        tpots = [r["tpot_s"] for r in ok if r.get("tpot_s") is not None]
+        ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
+        handoff_n = sum(
+            s.count for s in router.handoff_latency._series.values()
+        )
+        side = {
+            "tok_s": round(sum(r["tokens"] for r in ok) / wall, 1),
+            "goodput_req_s": round(len(ok) / wall, 3),
+            "tpot_p95_ms": (
+                round(percentile_exact(tpots, 95) * 1e3, 2)
+                if tpots else None
+            ),
+            "ttft_p95_ms": (
+                round(percentile_exact(ttfts, 95) * 1e3, 2)
+                if ttfts else None
+            ),
+            "handoffs": handoff_n,
+            "handoff_p50_ms": (
+                round(router.handoff_latency.percentile(50) * 1e3, 2)
+                if handoff_n else None
+            ),
+            "handoff_retries": router.handoff_retries_total.total(),
+            "failovers": sum(r.get("failovers", 0) for r in ok),
+            "errors": len([r for r in results if r and r["error"]]),
+            "snapshot": router.snapshot(),
+        }
+        router.stop()
+        for ingest in ingests:
+            ingest.stop()
+        for server in servers:
+            server.shutdown()
+        return side
+
+    uni = run_side("uni", ["unified", "unified"])
+    dis = run_side("disagg", ["prefill", "decode"])
+    rec = {
+        "metric": "llama3.2-1b_disagg_serving_goodput",
+        "value": dis["tok_s"],
+        "unit": "tok/s",
+        "disagg_goodput_tok_s": dis["tok_s"],
+        "disagg_goodput_req_s": dis["goodput_req_s"],
+        "disagg_tpot_p95_ms": dis["tpot_p95_ms"],
+        "disagg_ttft_p95_ms": dis["ttft_p95_ms"],
+        "disagg_handoff_p50_ms": dis["handoff_p50_ms"],
+        "disagg_handoffs": dis["handoffs"],
+        "disagg_handoff_retries": dis["handoff_retries"],
+        "disagg_failovers": dis["failovers"],
+        "disagg_errors": dis["errors"],
+        "unified_goodput_tok_s": uni["tok_s"],
+        "unified_tpot_p95_ms": uni["tpot_p95_ms"],
+        "unified_ttft_p95_ms": uni["ttft_p95_ms"],
+        "serving_requests": requests,
+        "serving_arrival_rate_req_s": rate,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged slots{slots} "
+            f"kv{seq_len} prompt~{prompt_len} max_new{max_new} tp1 "
+            f"rate{rate:g} routed 1 prefill + 1 decode vs 2 unified"
+        ),
+        "mode": "disaggregated_serving",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"disagg_router": dis["snapshot"]}, metrics_out_path()
+    )
+    return rec
+
+
 def main_chaos_serving(
     replicas=2,
     requests=32,
@@ -1969,6 +2175,8 @@ if __name__ == "__main__":
             )
         elif "--mixed-dispatch" in sys.argv:
             main_mixed_serving(**_serving_kwargs)
+        elif "--disaggregated" in sys.argv:
+            main_disagg_serving(**_serving_kwargs)
         elif "--chaos" in sys.argv:
             _serving_kwargs["max_new"] = _flag_value("--serving-max-new", 64)
             main_chaos_serving(replicas=max(_replicas, 2), **_serving_kwargs)

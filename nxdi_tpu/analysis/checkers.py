@@ -928,6 +928,53 @@ def check_hbm_fit(art: ProgramArtifacts) -> List[Finding]:
     )]
 
 
+# ---------------------------------------------------------------------------
+# 12. serving-role program-set audit
+# ---------------------------------------------------------------------------
+
+#: program tags a role-restricted app must NOT ship (dead weight: compiled,
+#: loaded into HBM, never dispatched by that role's engine)
+_ROLE_FORBIDDEN_TAGS: Dict[str, Tuple[str, ...]] = {
+    "decode": (
+        "context_encoding_model",   # decode admits KV imports, never prefills
+        "prefix_prefill_model",
+        "mixed_model",              # mixed packs prefill chunks — same dead CTE
+    ),
+    "prefill": (
+        "tkg_multistep",            # prefill emits ONE token then hands off
+        "tkg_device_loop",
+        "mixed_model",
+    ),
+}
+
+
+def check_program_set(art: ProgramArtifacts) -> List[Finding]:
+    """A role-restricted app (``TpuConfig(role="prefill"|"decode")``) must
+    ship ONLY its role's program set. Disaggregation's perf story rests on
+    the specialization: a decode replica that still compiles the CTE bucket
+    ladder pays its compile time, its HBM residency, and its warmup for
+    programs the decode engine can never dispatch — and symmetrically for
+    multi-step/device-loop TKG programs on a prefill replica. config.py
+    refuses the obvious combinations at build time; this checker audits the
+    COMPILED reality (what iter_programs actually yields), so a hand-built
+    or deserialized app cannot smuggle dead submodels past the role."""
+    role = getattr(art.tc, "role", "unified")
+    forbidden = _ROLE_FORBIDDEN_TAGS.get(role, ())
+    if art.tag not in forbidden:
+        return []
+    # one finding per (submodel, bucket) program: each is a separately
+    # compiled + resident executable, so per-program reporting sizes the
+    # waste honestly
+    return [art.finding(
+        "program_set",
+        f"role={role!r} app ships submodel {art.tag!r} — a "
+        f"{'decode' if role == 'decode' else 'prefill'}-role engine never "
+        f"dispatches it, so the program is dead weight (compile time + HBM "
+        f"residency); rebuild with role='unified' or drop the submodel "
+        f"flags that compile it",
+    )]
+
+
 #: name -> checker; the auditor runs these in order
 CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "donation": check_donation,
@@ -941,4 +988,5 @@ CHECKERS: Dict[str, Callable[[ProgramArtifacts], List[Finding]]] = {
     "lora_sharding": check_lora_sharding,
     "quantized_dtype": check_quantized_dtype,
     "hbm_fit": check_hbm_fit,
+    "program_set": check_program_set,
 }

@@ -124,7 +124,8 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
     out = file if file is not None else sys.stdout
     sigs = {s.replica: s for s in monitor.load_signals()}
     now = monitor.wall_clock()
-    hdr = (f"{'rank':>4} {'replica':<24} {'state':<12} {'age_s':>7} "
+    hdr = (f"{'rank':>4} {'replica':<24} {'state':<12} {'role':<8} "
+           f"{'age_s':>7} "
            f"{'queue':>5} {'busy':>5} {'kv_free':>7} {'kv_used':>7} "
            f"{'slo%':>6} {'score':>8}")
     if dispatches is not None:
@@ -139,7 +140,7 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
         # pre-stamp replicas report no age (format(None, '>7') would raise)
         age_s = "-" if age is None else f"{age:.1f}"
         row = (
-            f"{rank:>4} {label:<24} {s.state:<12} "
+            f"{rank:>4} {label:<24} {s.state:<12} {s.role:<8} "
             f"{age_s:>7} "
             f"{s.queue_depth:>5g} {s.slots_busy:>5g} "
             f"{s.kv_blocks_free:>7g} {s.kv_blocks_used:>7g} "
@@ -152,7 +153,7 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
         if rep.label in sigs:
             continue
         row = (
-            f"{'-':>4} {rep.label:<24} {rep.state:<12} "
+            f"{'-':>4} {rep.label:<24} {rep.state:<12} {'-':<8} "
             f"{'-':>7} {'-':>5} {'-':>5} {'-':>7} {'-':>7} {'-':>6} {'-':>8}"
         )
         if dispatches is not None:

@@ -108,6 +108,15 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                         "(TpuConfig(faults={'watchdog': True})): per-program "
                         "timeouts from CostSheet floors x multiplier plus "
                         "bounded transient retry with backoff")
+    p.add_argument("--role", choices=["unified", "prefill", "decode"],
+                   default="unified",
+                   help="serving role (TpuConfig(role=...)): 'prefill' "
+                        "compiles CTE + a 1-token TKG and parks finished "
+                        "prefills for KV handoff; 'decode' compiles TKG "
+                        "only and admits KV imports instead of submits. "
+                        "Role replicas skip the local demo workload — pair "
+                        "with --serve --ingest-port so a router tier "
+                        "drives them")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--stream", action="store_true",
                    help="print each request's tokens as they stream")
@@ -264,6 +273,13 @@ def main(argv: Optional[List[str]] = None) -> int:
             "chunk_size": args.chunked_prefill,
             "kernel_q_tile_size": args.chunked_prefill,
         }
+    if args.role != "unified":
+        # a prefill engine parks every finished prefill for handoff and a
+        # decode engine rejects direct submits — the local Poisson demo
+        # cannot complete on either, so role replicas build + serve only
+        tpu_kwargs["role"] = args.role
+        args.requests = 0
+        args.force_preempt = 0
     if args.sentinel_replay_rate is not None:
         tpu_kwargs["sentinel"] = {"replay_rate": args.sentinel_replay_rate}
     if args.watchdog:

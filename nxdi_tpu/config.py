@@ -766,6 +766,16 @@ class TpuConfig:
         # issue ONE program per step for a batch holding prefill chunks AND
         # decode rows together (ragged paged-attention kernel / XLA mask)
         self.mixed_dispatch = kwargs.pop("mixed_dispatch", False)
+        # prefill/decode disaggregation (serving/handoff.py): which half of
+        # the serving topology this process compiles.
+        #   "unified" — every submodel the other flags ask for (default);
+        #   "prefill" — CTE/prefix-prefill + the plain 1-token TKG only: the
+        #     engine prefills, samples the first token, then parks the KV
+        #     block chain for export to a decode replica;
+        #   "decode"  — TKG/multistep/device-loop only (no CTE bucket
+        #     ladder — a smaller HBM program footprint): requests enter via
+        #     an imported KV chain, never a local prefill.
+        self.role = kwargs.pop("role", "unified")
 
         # --- LoRA (reference: config.py:357-359) ---
         lora = kwargs.pop("lora_config", None)
@@ -1225,6 +1235,29 @@ class TpuConfig:
                 "mixed_dispatch requires is_block_kv_layout (the packed rows "
                 "read KV through the paged block tables)"
             )
+        if self.role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be 'unified', 'prefill' or 'decode', got {self.role!r}"
+            )
+        if self.role != "unified":
+            if not self.is_block_kv_layout:
+                raise ValueError(
+                    f"role={self.role!r} requires is_block_kv_layout (the KV "
+                    "handoff plane exports/imports paged block chains)"
+                )
+            if self.mixed_dispatch:
+                raise ValueError(
+                    "mixed_dispatch is inherently a unified prefill+decode "
+                    f"program; it cannot compose with role={self.role!r}"
+                )
+            if self.role == "prefill" and (
+                self.decode_steps_per_dispatch > 1 or self.device_loop
+            ):
+                raise ValueError(
+                    "role='prefill' ships only CTE/prefix-prefill + a 1-token "
+                    "TKG; decode_steps_per_dispatch > 1 and device_loop are "
+                    "decode-role program shapes"
+                )
 
     # -- (de)serialization (reference: config.py:891-1002) --
     _SUBCONFIGS = {

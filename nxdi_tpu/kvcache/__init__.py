@@ -1,0 +1,12 @@
+"""Paged/contiguous KV cache layer. The handoff primitives are re-exported
+here so the serving handoff plane (serving/handoff.py) can address them as
+``nxdi_tpu.kvcache.export_kv_blocks`` / ``import_kv_blocks`` without caring
+which module the layout code lives in."""
+
+from nxdi_tpu.kvcache.kv_cache import (  # noqa: F401
+    copy_kv_blocks,
+    export_kv_blocks,
+    import_kv_blocks,
+)
+
+__all__ = ["copy_kv_blocks", "export_kv_blocks", "import_kv_blocks"]

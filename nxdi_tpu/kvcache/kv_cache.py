@@ -602,3 +602,69 @@ def copy_kv_blocks(cache, src_blocks, dst_blocks, block_size: int):
     src_slots = (src[:, None] * block_size + offs[None, :]).reshape(-1)
     dst_slots = (dst[:, None] * block_size + offs[None, :]).reshape(-1)
     return _copy_kv_slots(cache, src_slots, dst_slots)
+
+
+def _block_slots(blocks, block_size: int) -> np.ndarray:
+    blocks = np.asarray(blocks, dtype=np.int32).reshape(-1)
+    if blocks.size == 0:
+        raise ValueError("empty block chain")
+    if (blocks < 0).any():
+        raise ValueError(f"negative block id in chain: {blocks.tolist()}")
+    offs = np.arange(block_size, dtype=np.int32)
+    return (blocks[:, None] * block_size + offs[None, :]).reshape(-1)
+
+
+@jax.jit
+def _gather_kv_slots(cache, slots):
+    return cache["k"][:, slots], cache["v"][:, slots]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _scatter_kv_slots(cache, slots, k_rows, v_rows):
+    out = dict(cache)
+    out["k"] = cache["k"].at[:, slots].set(k_rows)
+    out["v"] = cache["v"].at[:, slots].set(v_rows)
+    return out
+
+
+def export_kv_blocks(cache, blocks, block_size: int) -> Dict[str, np.ndarray]:
+    """Gather a block chain's K/V contents to HOST numpy for the
+    disaggregation handoff plane (serving/handoff.py): the prefill replica
+    exports its finished chain, the wire carries it, and the decode replica
+    scatters it via :func:`import_kv_blocks`. Same flat-slot addressing as
+    :func:`copy_kv_blocks`; returns ``{"k", "v"}`` arrays of shape
+    ``(num_layers, len(blocks) * block_size, num_kv_heads, head_dim)``."""
+    slots = _block_slots(blocks, block_size)
+    k, v = _gather_kv_slots(cache, slots)
+    return {"k": np.asarray(jax.device_get(k)), "v": np.asarray(jax.device_get(v))}
+
+
+def import_kv_blocks(cache, blocks, payload: Dict[str, np.ndarray], block_size: int):
+    """Scatter an exported chain (:func:`export_kv_blocks` payload) into the
+    receiver's block pool at ``blocks`` — length-checked and dtype/layout-
+    validated against the receiver's cache format before any device work, so
+    a mismatched wire payload fails loudly instead of corrupting the pool.
+    The cache is donated like every other paged mutation."""
+    slots = _block_slots(blocks, block_size)
+    for side in ("k", "v"):
+        rows = payload[side]
+        want = cache[side].shape
+        have = rows.shape
+        if len(have) != len(want) or have[0] != want[0] or have[2:] != want[2:]:
+            raise ValueError(
+                f"handoff {side} layout mismatch: payload {tuple(have)} does "
+                f"not address a cache of shape {tuple(want)} "
+                "(layers/heads/head_dim must agree)"
+            )
+        if have[1] != slots.size:
+            raise ValueError(
+                f"handoff {side} length mismatch: payload carries {have[1]} "
+                f"slots but the chain places {slots.size} "
+                f"({len(np.asarray(blocks).reshape(-1))} blocks x {block_size})"
+            )
+        if jnp.dtype(rows.dtype) != jnp.dtype(cache[side].dtype):
+            raise ValueError(
+                f"handoff {side} dtype mismatch: payload {rows.dtype} vs "
+                f"receiver cache {cache[side].dtype}"
+            )
+    return _scatter_kv_slots(cache, slots, payload["k"], payload["v"])

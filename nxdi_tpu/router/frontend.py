@@ -38,12 +38,18 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple, Union
 from urllib.parse import parse_qs, quote, urlsplit
 
-from nxdi_tpu.router.policy import DispatchPolicy, dispatchable, should_shed
+from nxdi_tpu.router.policy import (
+    DispatchPolicy,
+    dispatchable,
+    role_candidates,
+    should_shed,
+)
 from nxdi_tpu.runtime import faults
 from nxdi_tpu.router.retry import (
     RouterRequest,
@@ -60,6 +66,11 @@ logger = logging.getLogger("nxdi_tpu")
 #: step crash — the ONE "error" finish the router retries (a validation
 #: rejection reproduces identically on every replica; a crash does not)
 ENGINE_FAULT_PREFIX = "engine step failed"
+
+#: decode-side import-failure marker (serving/handoff.py) — classified
+#: transient like an engine fault: the chain is still retained upstream,
+#: so the router re-handoffs instead of finalizing the error
+HANDOFF_FAULT_PREFIX = "handoff import failed"
 
 
 def parse_target(
@@ -180,7 +191,18 @@ class Router:
             "requests currently assigned to each replica",
             ("replica",),
         )
+        self.handoff_retries_total = r.counter(
+            "nxdi_handoff_retries_total",
+            "KV handoff placements retried on a different decode replica "
+            "(transient import failure or pre-ack decode death)",
+        )
+        self.handoff_latency = r.histogram(
+            "nxdi_handoff_latency",
+            "prefill->decode KV handoff latency in seconds (payload fetch "
+            "through the retention ack)",
+        )
         self.sheds_total.inc(0)
+        self.handoff_retries_total.inc(0)
         for name in self.ingest_urls:
             self.dispatches_total.inc(0, replica=name)
             self.failovers_total.inc(0, replica=name)
@@ -270,7 +292,9 @@ class Router:
             existing = self._requests.get(rid)
             if existing is not None:
                 return 200, dict(existing.to_dict(), status="duplicate")
-            candidates = dispatchable(signals, draining=self._draining)
+            candidates = role_candidates(
+                dispatchable(signals, draining=self._draining), "prompt"
+            )
             if not candidates:
                 return 503, {
                     "error": "no_replicas",
@@ -335,6 +359,7 @@ class Router:
                     draining=self._draining,
                     exclude=req.tried,
                     inflight=dict(self._inflight),
+                    want="prompt",
                 )
             if replica is None:
                 req.finish("error", "no dispatchable replica")
@@ -416,6 +441,10 @@ class Router:
     def _sync(self, req: RouterRequest) -> None:
         """Pull new tokens from the request's replica; detect its death and
         fail over. Called with ``req.lock`` held."""
+        if req.handoff_src is not None and req.replica != req.handoff_src:
+            # an earlier ack never landed: the prefill side still parks the
+            # (already imported) chain — retry the release before polling
+            self._ack_handoff(req)
         replica = req.replica
         url = None if replica is None else self._ingest_url(replica)
         if url is None:
@@ -454,10 +483,21 @@ class Router:
         req.stream_errors = 0
         req.delivered.extend(int(t) for t in resp.get("tokens", []))
         if not resp.get("done"):
+            if resp.get("handoff_ready"):
+                # prefill role parked the request after its first token:
+                # move the chain to a decode replica now
+                self._handoff(req)
             return
         reason = resp.get("finish_reason") or "error"
         err = resp.get("error")
-        if reason == "error" and str(err or "").startswith(ENGINE_FAULT_PREFIX):
+        if reason == "handoff":
+            # the prefill side already handed this off but we lost track of
+            # the import (response race): recompute-style replay
+            self._failover(req)
+            return
+        if reason == "error" and str(err or "").startswith(
+            (ENGINE_FAULT_PREFIX, HANDOFF_FAULT_PREFIX)
+        ):
             # a replica-side crash is NOT deterministic — retry elsewhere;
             # a validation rejection would reproduce identically and final-
             # izes below instead
@@ -471,12 +511,160 @@ class Router:
             if req.replica is not None:
                 self._set_inflight(req.replica, -1)
 
+    # -- KV handoff (disaggregation) -----------------------------------------
+    def _handoff(self, req: RouterRequest) -> None:
+        """The prefill replica parked ``req`` with its KV chain and first
+        sampled token ready: fetch the wire payload and place it on a
+        decode replica. Called with ``req.lock`` held. The prefill side
+        RETAINS the chain until the ack lands, so any failure in here is
+        recoverable — the next poll simply retries the whole move."""
+        prefill = req.replica
+        url = None if prefill is None else self._ingest_url(prefill)
+        if url is None:
+            self._failover(req)
+            return
+        t0 = time.monotonic()
+        try:
+            status, resp = self.http(
+                "GET",
+                f"{url}/handoff?request_id={quote(req.request_id)}",
+                None,
+                self.config.ingest_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001 — transport fault
+            req.stream_errors += 1
+            self.poll()
+            state = self._replica_state(prefill)
+            logger.warning(
+                "router: handoff fetch from %s failed (state=%s): %s",
+                prefill, state, e,
+            )
+            if should_failover(req, state, self.config.stream_failures):
+                self._failover(req)
+            return
+        if status != 200:
+            # 404/409: the park evaporated (replica restarted, or raced a
+            # finish) — treat like any upstream inconsistency
+            req.stream_errors += 1
+            if req.stream_errors >= self.config.stream_failures:
+                self._failover(req)
+            return
+        req.stream_errors = 0
+        req.handoff_src = prefill
+        self._place_handoff(req, resp.get("payload"), t0)
+
+    def _place_handoff(self, req: RouterRequest, wire, t0: float) -> None:
+        """Import the fetched KV payload into a decode replica, walking the
+        KV-pressure-weighted ranking on transient failures. Called with
+        ``req.lock`` held and ``req.handoff_src`` set (the chain is still
+        retained upstream — returning without placing is always safe)."""
+        tried_round: List[str] = []
+        while True:
+            signals = self._signals()
+            with self._lock:
+                target = self.policy.choose(
+                    signals,
+                    session_id=req.session_id,
+                    draining=self._draining,
+                    exclude=list(req.tried) + tried_round + [req.handoff_src],
+                    inflight=dict(self._inflight),
+                    want="import",
+                )
+            if target is None:
+                # nowhere to place right now; the chain stays parked on the
+                # prefill side and the next client poll retries the move
+                logger.warning(
+                    "router: no decode replica for handoff of %s; retrying "
+                    "on next poll", req.request_id,
+                )
+                return
+            url = self._ingest_url(target)
+            status, resp = 0, {}
+            if url is not None:
+                try:
+                    status, resp = self.http(
+                        "POST", url + "/import",
+                        {"request_id": req.request_id, "payload": wire},
+                        self.config.ingest_timeout_s,
+                    )
+                except Exception as e:  # noqa: BLE001 — transport fault
+                    logger.warning(
+                        "router: handoff import to %s failed: %s", target, e
+                    )
+            if status == 200:
+                src = req.handoff_src
+                with self._lock:
+                    self.dispatches_total.inc(replica=target)
+                    if src is not None:
+                        self._set_inflight(src, -1)
+                    self._set_inflight(target, +1)
+                req.assign(target)
+                req.handoffs += 1
+                # release the retained chain; on ack failure handoff_src
+                # stays set and _sync retries the ack next poll
+                self._ack_handoff(req)
+                self.handoff_latency.observe(time.monotonic() - t0)
+                return
+            if status == 400:
+                # deterministic rejection (schema/layout mismatch) — would
+                # reproduce on every decode replica; release the chain and
+                # surface the error
+                self._ack_handoff(req)
+                self._finish(
+                    req, "error",
+                    f"handoff import rejected: {resp.get('error')}",
+                )
+                return
+            # 409 capacity / transport fault: transient — next-ranked
+            tried_round.append(target)
+            with self._lock:
+                self.handoff_retries_total.inc()
+            if len(tried_round) >= len(self.ingest_urls):
+                return
+
+    def _ack_handoff(self, req: RouterRequest) -> None:
+        """Tell the prefill replica to release the retained chain. Best
+        effort: on failure ``handoff_src`` stays set and ``_sync`` retries
+        before its next poll — the park is idempotent to re-ack (404/409
+        mean it is already gone, which is the goal state)."""
+        src = req.handoff_src
+        if src is None:
+            return
+        url = self._ingest_url(src)
+        if url is None:
+            # the prefill replica left the fleet; nothing to release
+            req.handoff_src = None
+            return
+        try:
+            status, _ = self.http(
+                "POST", url + "/handoff_ack",
+                {"request_id": req.request_id},
+                self.config.ingest_timeout_s,
+            )
+        except Exception as e:  # noqa: BLE001 — transport fault
+            logger.warning(
+                "router: handoff ack to %s failed (will retry): %s", src, e
+            )
+            return
+        if status in (200, 404, 409):
+            req.handoff_src = None
+
     def _failover(self, req: RouterRequest) -> None:
         """Re-dispatch an in-flight request whose replica failed: prompt
         replay on the next-ranked replica, duplicate-suppressed by
         request_id, already-delivered tokens never re-sent (the new
         upstream is polled from cursor ``len(delivered)``). Called with
-        ``req.lock`` held."""
+        ``req.lock`` held.
+
+        Disaggregation special case: when the DECODE replica dies before
+        the retention ack released the prefill side (``handoff_src`` still
+        set), the parked KV chain is intact — re-handoff from it instead
+        of replaying the prompt, so no token is recomputed or lost."""
+        rehandoff = (
+            req.handoff_src is not None
+            and req.replica is not None
+            and req.replica != req.handoff_src
+        )
         failed = req.mark_failed_replica()
         with self._lock:
             n_replicas = len(self.ingest_urls)
@@ -490,6 +678,28 @@ class Router:
         if exhausted(req, self.config.max_failovers, n_replicas):
             req.finish("error", "failover budget exhausted")
             return
+        if rehandoff:
+            # decode-side death pre-ack: point back at the prefill replica
+            # that still parks the chain and re-run the handoff — the dead
+            # decode replica is in req.tried, so the placement skips it
+            with self._lock:
+                self.handoff_retries_total.inc()
+                self._set_inflight(req.handoff_src, +1)
+            req.assign(req.handoff_src)
+            logger.info(
+                "router: re-handing request %s off from retained chain on "
+                "%s (attempt %d)", req.request_id, req.handoff_src,
+                req.failovers,
+            )
+            self.poll()
+            self._sync(req)
+            return
+        if req.handoff_src is not None:
+            # prompt replay abandons the handoff lineage: best-effort
+            # release of the retained chain, then forget it either way (a
+            # dead prefill replica must not pin ack retries forever)
+            self._ack_handoff(req)
+            req.handoff_src = None
         logger.info(
             "router: failing request %s over from %s (attempt %d)",
             req.request_id, failed, req.failovers,

@@ -18,14 +18,28 @@ stdlib server, but carrying requests instead of probes:
   --stream`` does in-process.
 - ``POST /drain`` — cooperative drain: stop ACCEPTING (submits 503),
   finish everything already queued/running. ``POST /undrain`` reverses it.
-- ``GET /status`` — ingest view: draining flag, queue/slot occupancy,
-  live/finished record counts.
+- ``GET /status`` — ingest view: role, draining flag, queue/slot
+  occupancy, live/finished record counts.
+
+Disaggregation plane (prefill/decode roles, serving/handoff.py):
+
+- ``GET /handoff?request_id=R`` — prefill side: the wire payload of a
+  parked request (KV chain + first token + rng cursor). Re-fetchable
+  until the ack — a failed import retries the SAME bytes elsewhere.
+- ``POST /import`` — decode side: ``{"request_id": R, "payload": wire}``
+  admits the chain directly RUNNING. Synchronous: 200 once the engine
+  placed it, 409 on transient capacity pressure (router tries the next
+  decode replica), 400 on a deterministic format mismatch.
+- ``POST /handoff_ack`` — prefill side: the router confirmed an import;
+  the parked chain retires (finish_reason ``"handoff"``).
 
 The engine is single-threaded by design, so the ingest owns a **driver
 thread** that is the only caller of ``engine.add_request``/``engine.step``
 — HTTP handler threads just append to a submission queue and read token
 records under one lock (the same in-process path ``cli.serve`` drives,
-with the queue in between). Engine faults error-finish the affected
+with the queue in between). The handoff endpoints touch engine/KV state,
+so their handlers hop onto the driver thread through a small RPC queue
+drained every loop iteration. Engine faults error-finish the affected
 request, not the replica: the driver keeps stepping and the router fails
 the request over.
 """
@@ -71,6 +85,10 @@ class ReplicaIngest:
         #: request_id -> record dict (insertion-ordered for bounded eviction)
         self._records: "OrderedDict[str, dict]" = OrderedDict()
         self._pending: Deque[dict] = deque()  # submissions awaiting the driver
+        #: (fn, result_box, done_event) calls awaiting the driver thread —
+        #: handoff export/ack/import run HERE because the engine (and its
+        #: donated KV buffers) is single-threaded by contract
+        self._rpc: Deque[tuple] = deque()
         self._engine_ids: Dict[int, str] = {}  # engine request_id -> rid
         self.draining = False
         self._rid_seq = 0  # fallback ids for clients that submit without one
@@ -111,6 +129,13 @@ class ReplicaIngest:
                 self._rid_seq += 1
                 rid = f"in-{self._rid_seq}"
             rid = str(rid)
+            if getattr(self.engine, "role", "unified") == "decode":
+                # prompts belong on prefill replicas; answer like a drain so
+                # a misrouted submit is retried elsewhere, never error-lost
+                return 503, {
+                    "error": "decode-role replica admits KV imports only",
+                    "request_id": rid, "replica_id": self.replica_id,
+                }
             rec = self._records.get(rid)
             if rec is not None:
                 # duplicate-suppression: idempotent submit — report current
@@ -158,6 +183,10 @@ class ReplicaIngest:
                 "done": rec["done"],
                 "finish_reason": rec["finish_reason"],
                 "error": rec["error"],
+                # prefill role: first token sampled, chain parked — the
+                # router should fetch /handoff and place it on a decode
+                # replica instead of waiting for more tokens here
+                "handoff_ready": bool(rec.get("handoff_ready")),
             }
 
     def drain(self) -> dict:
@@ -181,12 +210,160 @@ class ReplicaIngest:
             draining = self.draining
         return {
             "replica_id": self.replica_id,
+            "role": getattr(self.engine, "role", "unified"),
             "draining": draining,
             "queue_depth": sch.queue_depth,
             "slots_busy": sch.slots_busy,
             "live": live,
             "records": total,
         }
+
+    # -- KV handoff plane (prefill/decode disaggregation) --------------------
+    def handoff(self, rid: str) -> tuple:
+        """Prefill side: the wire payload of a parked request. The chain
+        stays parked (re-fetchable) until :meth:`handoff_ack`."""
+        with self._lock:
+            eid = next(
+                (e for e, r in self._engine_ids.items() if r == str(rid)), None
+            )
+            rec = self._records.get(str(rid))
+        if eid is None or rec is None:
+            return 404, {"error": "unknown request", "request_id": rid}
+        try:
+            payload = self._call_on_driver(
+                lambda: self.engine.export_handoff(eid)
+            )
+        except KeyError:
+            return 409, {"error": "request is not parked for handoff",
+                         "request_id": rid}
+        except Exception as e:  # noqa: BLE001 — surfaced to the router
+            return 500, {"error": f"{type(e).__name__}: {e}",
+                         "request_id": rid}
+        return 200, {"request_id": rid, "payload": payload.to_wire()}
+
+    def handoff_ack(self, rid: str) -> tuple:
+        """Prefill side: a decode replica holds the chain now — retire the
+        parked request and finish its record (reason ``"handoff"``: the
+        tokens keep streaming from the importing replica)."""
+        with self._lock:
+            eid = next(
+                (e for e, r in self._engine_ids.items() if r == str(rid)), None
+            )
+        if eid is None:
+            return 404, {"error": "unknown request", "request_id": rid}
+        try:
+            self._call_on_driver(lambda: self.engine.ack_handoff(eid))
+        except KeyError:
+            return 409, {"error": "request is not parked for handoff",
+                         "request_id": rid}
+        with self._lock:
+            self._engine_ids.pop(eid, None)
+            rec = self._records.get(str(rid))
+            if rec is not None:
+                rec["done"] = True
+                rec["finish_reason"] = "handoff"
+        return 200, {"request_id": rid, "status": "acked"}
+
+    def import_handoff(self, body: dict) -> tuple:
+        """Decode side: admit an exported chain directly RUNNING. The
+        record is created BEFORE the engine call and pre-seeded with the
+        tokens the prefill side already streamed, so the router's cursor
+        arithmetic continues seamlessly and a poll can never 404."""
+        from nxdi_tpu.serving import HandoffCapacityError, HandoffPayload
+
+        rid = body.get("request_id")
+        wire = body.get("payload")
+        if rid is None or not isinstance(wire, dict):
+            return 400, {"error": "import needs {'request_id', 'payload'}"}
+        rid = str(rid)
+        try:
+            payload = HandoffPayload.from_wire(wire)
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"error": f"bad handoff payload: {e}", "request_id": rid}
+        with self._lock:
+            if rid in self._records:
+                rec = self._records[rid]
+                return 200, {
+                    "request_id": rid, "status": "duplicate",
+                    "done": rec["done"], "tokens": len(rec["tokens"]),
+                }
+            rec = {
+                "request_id": rid,
+                "session_id": payload.session_id,
+                "tokens": [int(t) for t in payload.first_tokens],
+                "done": False,
+                "finish_reason": None,
+                "error": None,
+            }
+            self._records[rid] = rec
+            self._evict_finished()
+
+        def on_token(req, tok, rid=rid):
+            with self._lock:
+                r = self._records.get(rid)
+                if r is not None:
+                    r["tokens"].append(int(tok))
+
+        try:
+            req = self._call_on_driver(
+                lambda: self.engine.admit_handoff(payload, on_token=on_token)
+            )
+        except HandoffCapacityError as e:
+            with self._lock:
+                self._records.pop(rid, None)
+            return 409, {"error": f"capacity: {e}", "request_id": rid,
+                         "replica_id": self.replica_id}
+        except (ValueError, TypeError) as e:
+            with self._lock:
+                self._records.pop(rid, None)
+            return 400, {"error": f"{type(e).__name__}: {e}",
+                         "request_id": rid}
+        with self._lock:
+            self._engine_ids[req.request_id] = rid
+        self._wake.set()
+        return 200, {"request_id": rid, "status": "imported",
+                     "replica_id": self.replica_id}
+
+    def _call_on_driver(self, fn, timeout: float = 30.0):
+        """Run ``fn`` on the driver thread (the engine's only legal caller)
+        and return its result; exceptions propagate to THIS thread."""
+        if self._thread is None or threading.current_thread() is self._thread:
+            return fn()
+        box: dict = {}
+        ev = threading.Event()
+        with self._lock:
+            self._rpc.append((fn, box, ev))
+        self._wake.set()
+        if not ev.wait(timeout):
+            raise TimeoutError("ingest driver RPC timed out")
+        if "error" in box:
+            raise box["error"]
+        return box["value"]
+
+    def _drain_rpc(self) -> None:
+        while True:
+            with self._lock:
+                if not self._rpc:
+                    return
+                fn, box, ev = self._rpc.popleft()
+            try:
+                box["value"] = fn()
+            except BaseException as e:  # noqa: BLE001 — ferried to the caller
+                box["error"] = e
+            ev.set()
+
+    def _note_ready_handoffs(self) -> None:
+        if getattr(self.engine, "role", "unified") != "prefill":
+            return
+        ready = self.engine.take_ready_handoffs()
+        if not ready:
+            return
+        with self._lock:
+            for eid in ready:
+                rid = self._engine_ids.get(eid)
+                rec = None if rid is None else self._records.get(rid)
+                if rec is not None:
+                    rec["handoff_ready"] = True
 
     @property
     def replica_id(self) -> str:
@@ -206,9 +383,11 @@ class ReplicaIngest:
     # -- driver thread -------------------------------------------------------
     def _loop(self) -> None:
         while not self._stop.is_set():
+            self._drain_rpc()
             self._admit_pending()
             if self.engine.has_work():
                 self._step_once()
+                self._note_ready_handoffs()
                 if self.step_delay_s > 0:
                     time.sleep(self.step_delay_s)
             else:
@@ -325,9 +504,39 @@ class ReplicaIngest:
             status, resp = self.stream(rid, cursor)
             return status, json.dumps(resp)
 
+        def handoff(path, body):
+            q = parse_qs(urlsplit(path).query)
+            rid = (q.get("request_id") or [None])[0]
+            if rid is None:
+                return 400, json.dumps({"error": "request_id required"})
+            status, resp = self.handoff(rid)
+            return status, json.dumps(resp)
+
+        def handoff_ack(path, body):
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return 400, json.dumps({"error": f"bad JSON: {e}"})
+            rid = payload.get("request_id")
+            if rid is None:
+                return 400, json.dumps({"error": "request_id required"})
+            status, resp = self.handoff_ack(rid)
+            return status, json.dumps(resp)
+
+        def import_handoff(path, body):
+            try:
+                payload = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return 400, json.dumps({"error": f"bad JSON: {e}"})
+            status, resp = self.import_handoff(payload)
+            return status, json.dumps(resp)
+
         return [
             ("POST", "/submit", "application/json", submit),
             ("GET", "/stream", "application/json", stream),
+            ("GET", "/handoff", "application/json", handoff),
+            ("POST", "/handoff_ack", "application/json", handoff_ack),
+            ("POST", "/import", "application/json", import_handoff),
             ("POST", "/undrain", "application/json",
              lambda path, body: json.dumps(self.undrain())),
             ("POST", "/drain", "application/json",

@@ -66,6 +66,21 @@ def dispatchable(
     ]
 
 
+def role_candidates(
+    signals: Sequence[LoadSignal], want: str = "prompt"
+) -> List[LoadSignal]:
+    """Role-aware narrowing for a disaggregated fleet. ``want="prompt"``
+    keeps replicas that can PREFILL a prompt (prefill + unified — a
+    decode-role engine rejects submits outright); ``want="import"`` keeps
+    only decode-role replicas (the KV handoff targets). A homogeneous
+    unified fleet passes through untouched either way except that
+    ``"import"`` then yields nothing — there is nobody to hand off to,
+    which is correct: unified replicas never park a prefill."""
+    if want == "import":
+        return [s for s in signals if getattr(s, "role", "unified") == "decode"]
+    return [s for s in signals if getattr(s, "role", "unified") != "decode"]
+
+
 def should_shed(candidates: Sequence[LoadSignal], watermark: float) -> bool:
     """True when every dispatchable replica's queue depth EXCEEDS the
     watermark (strictly >: watermark 0 sheds only once every queue is
@@ -117,22 +132,33 @@ class DispatchPolicy:
         draining: Iterable[str] = (),
         exclude: Iterable[str] = (),
         inflight: Optional[Dict[str, int]] = None,
+        want: str = "prompt",
     ) -> Optional[str]:
         """Pick the replica for one dispatch; ``None`` when nothing is
         dispatchable. Affinity first (while the pin is dispatchable), then
         deterministic least-loaded; a broken or missing pin re-pins to the
         chosen replica. ``inflight`` is the router's live per-replica
-        assignment count (the local ranking term)."""
-        candidates = dispatchable(signals, draining=draining, exclude=exclude)
+        assignment count (the local ranking term). ``want`` narrows by
+        serving role ("prompt" vs "import", :func:`role_candidates`); in a
+        disaggregated fleet session pins live on the DECODE tier (that is
+        where the warm KV ends up), so the prompt leg neither consults nor
+        writes the pin table there — only the handoff-import leg does."""
+        candidates = role_candidates(
+            dispatchable(signals, draining=draining, exclude=exclude), want
+        )
         if not candidates:
             return None
-        if session_id is not None:
+        disagg = any(
+            getattr(s, "role", "unified") != "unified" for s in signals
+        )
+        affinity = session_id is not None and not (disagg and want == "prompt")
+        if affinity:
             pin = self._pins.get(session_id)
             if pin is not None and any(s.replica == pin for s in candidates):
                 self._pins.move_to_end(session_id)  # LRU touch
                 return pin
         chosen = self.ranked(candidates, inflight)[0].replica
-        if session_id is not None:
+        if affinity:
             self._pin(session_id, chosen)
         return chosen
 

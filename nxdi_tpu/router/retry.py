@@ -69,6 +69,13 @@ class RouterRequest:
         self.error: Optional[str] = None
         self.failovers = 0
         self.stream_errors = 0  # consecutive upstream poll faults
+        #: prefill replica still RETAINING this request's parked KV chain
+        #: (disaggregation): set when the router fetches /handoff, cleared
+        #: by a successful /handoff_ack. While set, a decode-side failure
+        #: re-handoffs from the retained chain instead of replaying the
+        #: prompt — no token is recomputed or lost.
+        self.handoff_src: Optional[str] = None
+        self.handoffs = 0  # completed prefill->decode handoffs
         #: monotonic stamp of the last CLIENT touch (submit or stream poll)
         #: — the router's background sweep finishes requests whose client
         #: went away, so an abandoned request can never pin in-flight
@@ -113,6 +120,8 @@ class RouterRequest:
             "tried": list(self.tried),
             "delivered": len(self.delivered),
             "failovers": self.failovers,
+            "handoffs": self.handoffs,
+            "handoff_src": self.handoff_src,
             "finish_reason": self.finish_reason,
             "error": self.error,
         }

@@ -585,23 +585,31 @@ class TpuModelForCausalLM(ApplicationBase):
                 tr_extra["tr_hidden"] = ((-1, H), np.float32)
                 tr_extra["tr_hidden_mask"] = ((), np.float32)
 
-        self.models[TAG_CONTEXT_ENCODING] = ModelWrapper(
-            TAG_CONTEXT_ENCODING,
-            self.config,
-            arch,
-            inv_freq,
-            batch_size=tc.ctx_batch_size,
-            n_active_tokens=0,  # bucket-determined
-            buckets=autobucketing.context_encoding_buckets(self.config),
-            attend_to_cache=False,
-            forward_kwargs=dict(
-                gather_last_token=True,
-                output_logits=tc.output_logits,
-                on_device_sampling=on_device_sampling,
-                **sampling_kwargs,
-            ),
-            extra_inputs=tr_extra,
-        )
+        # prefill/decode disaggregation: a decode-role process never runs a
+        # local prefill, so the whole CTE bucket ladder (and prefix-prefill
+        # below) stays uncompiled — requests arrive as imported KV chains
+        # (serving/handoff.py) and the HBM program footprint shrinks to the
+        # decode set. Validation already pinned role-incompatible flags
+        # (mixed_dispatch, and decode-only shapes under role='prefill').
+        role = getattr(tc, "role", "unified")
+        if role != "decode":
+            self.models[TAG_CONTEXT_ENCODING] = ModelWrapper(
+                TAG_CONTEXT_ENCODING,
+                self.config,
+                arch,
+                inv_freq,
+                batch_size=tc.ctx_batch_size,
+                n_active_tokens=0,  # bucket-determined
+                buckets=autobucketing.context_encoding_buckets(self.config),
+                attend_to_cache=False,
+                forward_kwargs=dict(
+                    gather_last_token=True,
+                    output_logits=tc.output_logits,
+                    on_device_sampling=on_device_sampling,
+                    **sampling_kwargs,
+                ),
+                extra_inputs=tr_extra,
+            )
         self.models[TAG_TOKEN_GENERATION] = ModelWrapper(
             TAG_TOKEN_GENERATION,
             self.config,
@@ -675,7 +683,7 @@ class TpuModelForCausalLM(ApplicationBase):
                     dp_sampling=getattr(odsc, "dp_sampling", False),
                 ),
             )
-        if tc.is_prefix_caching or tc.is_chunked_prefill:
+        if (tc.is_prefix_caching or tc.is_chunked_prefill) and role != "decode":
             # multi-token prefill that attends the cache: the new chunk/suffix
             # sees the cached prefix through the block table (reference:
             # prefix-caching CTE with 2-D buckets, model_wrapper.py:918;
@@ -755,6 +763,12 @@ class TpuModelForCausalLM(ApplicationBase):
                 submodel = TAG_PREFIX_PREFILL
             else:
                 submodel = TAG_CONTEXT_ENCODING if is_prefill else TAG_TOKEN_GENERATION
+        if submodel not in self.models:
+            raise KeyError(
+                f"submodel {submodel!r} is not compiled in this app (role="
+                f"{getattr(self.tpu_config, 'role', 'unified')!r}, available: "
+                f"{sorted(self.models)})"
+            )
         batch = {"input_ids": input_ids, "position_ids": position_ids, **kwargs}
         outputs, self.kv_cache = self.models[submodel].forward(
             self.params, self.kv_cache, batch

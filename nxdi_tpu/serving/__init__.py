@@ -26,6 +26,11 @@ forced preemption (tests/integration/test_serving_engine.py).
 """
 
 from nxdi_tpu.serving.engine import InferenceEngine
+from nxdi_tpu.serving.handoff import (
+    HANDOFF_FAULT_PREFIX,
+    HandoffCapacityError,
+    HandoffPayload,
+)
 from nxdi_tpu.serving.prefix_cache import PrefixCache
 from nxdi_tpu.serving.request import (
     FINISHED,
@@ -42,6 +47,9 @@ from nxdi_tpu.serving.workload import drive_arrivals, goodput_summary
 
 __all__ = [
     "InferenceEngine",
+    "HandoffPayload",
+    "HandoffCapacityError",
+    "HANDOFF_FAULT_PREFIX",
     "PrefixCache",
     "drive_arrivals",
     "goodput_summary",

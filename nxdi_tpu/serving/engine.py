@@ -111,6 +111,11 @@ class InferenceEngine:
                 "argmax)"
             )
         self.paged = bool(tc.is_block_kv_layout)
+        # prefill/decode disaggregation role (serving/handoff.py): a
+        # "prefill" engine parks each request after its first sampled token
+        # and retains the KV chain until the router acks the handoff; a
+        # "decode" engine admits requests ONLY as imported chains
+        self.role = getattr(tc, "role", "unified")
         if not self.paged and not tc.is_continuous_batching:
             raise ValueError(
                 "InferenceEngine drives the paged (is_block_kv_layout) or "
@@ -355,6 +360,34 @@ class InferenceEngine:
         #: chaos_recovery_p95_ms headline
         self.recovery_resume_s: List[float] = []
 
+        # -- KV handoff plane (prefill/decode disaggregation) --
+        #: parked prefill-role requests: first token emitted, chain retained
+        #: until the router's ack (request_id -> Request)
+        self._handoffs: Dict[int, Request] = {}
+        #: request_ids newly parked since the last ``take_ready_handoffs``
+        self._handoff_ready: List[int] = []
+        self._handoff_exports = None
+        self._handoff_imports = None
+        self._handoff_bytes = None
+        if tel is not None and self.paged:
+            r = tel.registry
+            self._handoff_exports = r.counter(
+                "nxdi_handoff_exports_total",
+                "prefill-side KV chains exported for decode handoff",
+            )
+            self._handoff_imports = r.counter(
+                "nxdi_handoff_imports_total",
+                "decode-side KV chains imported and admitted RUNNING",
+            )
+            self._handoff_bytes = r.counter(
+                "nxdi_handoff_bytes_total",
+                "raw K/V bytes moved through the handoff plane",
+            )
+            if self.role != "unified":
+                for c in (self._handoff_exports, self._handoff_imports,
+                          self._handoff_bytes):
+                    c.inc(0)
+
     # -- request intake -----------------------------------------------------
     def add_request(
         self,
@@ -371,6 +404,11 @@ class InferenceEngine:
         domain (``time.perf_counter`` under the default clock).
         ``session_id`` is the conversation identity the router tier keys
         affinity on; it rides the request span."""
+        if self.role == "decode":
+            raise ValueError(
+                "decode-role engine admits requests via KV handoff only "
+                "(admit_handoff); route prompts to a prefill replica"
+            )
         if params is not None and params.n > 1:
             # best-of-n: ONE prompt, n continuations. The primary request
             # prefills normally; each sibling is its own request that — on
@@ -468,6 +506,14 @@ class InferenceEngine:
 
     # -- the engine loop ----------------------------------------------------
     def has_work(self) -> bool:
+        if self._handoffs:
+            # a parked handoff waits on the ROUTER's ack, not on a step —
+            # only unparked occupants and queued work keep the loop hot
+            busy = sum(
+                1 for r in self.scheduler.slots
+                if r is not None and r.request_id not in self._handoffs
+            )
+            return bool(self.scheduler.waiting) or busy > 0
         return self.scheduler.has_work()
 
     def step(self) -> List[RequestOutput]:
@@ -599,11 +645,31 @@ class InferenceEngine:
         """The classic two-phase step: per-request prefill dispatches, then
         one batched decode dispatch."""
         preempted: List[Request] = []
+        if self.role == "decode" and self.scheduler.waiting:
+            # a decode-role engine compiles no prefill program: anything in
+            # the waiting queue (a preempted import) cannot be replayed
+            # locally — error-finish with the engine-fault marker so the
+            # router re-routes it through a prefill replica (prompt replay
+            # + fresh handoff; greedy tokens are identical, delivered ones
+            # are cursor-skipped)
+            while self.scheduler.waiting:
+                req = self.scheduler.waiting.popleft()
+                req.error = (
+                    f"{ENGINE_FAULT_PREFIX}: decode-role replica cannot "
+                    "re-prefill a preempted request"
+                )
+                self._finish(req, "error", finished)
         prefills = self.scheduler.schedule_prefills()
         self._note_resumes(prefills)
         for req in prefills:
             self._prefill_chunk(req, finished)
         rows = self.scheduler.decodable()
+        if self._handoffs and rows:
+            # parked prefill-role requests hold their slot/chain for export;
+            # they never join a decode batch
+            rows = [
+                (s, r) for s, r in rows if r.request_id not in self._handoffs
+            ]
         if rows:
             rows, preempted = self.scheduler.ensure_decode_capacity(rows)
             for victim in preempted:
@@ -624,7 +690,9 @@ class InferenceEngine:
         # a preemption-only step still made progress (the freed blocks are
         # what lets the NEXT step admit) — only a true no-op step may trip
         # the stall guard in run()
-        self._progress = bool(prefills) or bool(rows) or bool(preempted)
+        self._progress = (
+            bool(prefills) or bool(rows) or bool(preempted) or bool(finished)
+        )
 
     def _step_mixed(self, finished: List[RequestOutput]) -> None:
         """One-dispatch mixed step: pack this step's prefill chunks and
@@ -925,6 +993,162 @@ class InferenceEngine:
         reason = req.check_finish()
         if reason:
             self._finish(req, reason, finished)
+        elif self.role == "prefill":
+            self._park_for_handoff(req)
+
+    # -- KV handoff plane (prefill/decode disaggregation) -------------------
+    def _park_for_handoff(self, req: Request) -> None:
+        """Prefill role: the first token is sampled and streamed; instead of
+        decoding on, hold the request in its slot — blocks pinned, excluded
+        from decode batches and victim selection — until the router exports
+        the chain and acks a decode-side import."""
+        self._handoffs[req.request_id] = req
+        self._handoff_ready.append(req.request_id)
+        self.scheduler.unpreemptible.add(req.request_id)
+        if req.span is not None:
+            req.span.phase("handoff")
+
+    def take_ready_handoffs(self) -> List[int]:
+        """Request ids newly parked since the last call (ingest driver poll)."""
+        out, self._handoff_ready = self._handoff_ready, []
+        return out
+
+    def export_handoff(self, request_id: int):
+        """Build the wire payload for a parked request. The chain stays
+        parked — re-exportable — until :meth:`ack_handoff`."""
+        from nxdi_tpu.kvcache import export_kv_blocks
+        from nxdi_tpu.serving.handoff import HandoffPayload
+
+        req = self._handoffs.get(request_id)
+        if req is None:
+            raise KeyError(f"request {request_id} is not parked for handoff")
+        mgr = self.block_manager
+        bs = mgr.block_size
+        committed = req.prefill_target
+        n_blocks = -(-committed // bs)
+        table = mgr._tables.get(req.request_id, [])[:n_blocks]
+        if len(table) < n_blocks:
+            raise RuntimeError(
+                f"parked request {request_id} holds {len(table)} blocks but "
+                f"its committed prefill needs {n_blocks}"
+            )
+        kv = export_kv_blocks(self.app.kv_cache, table, bs)
+        payload = HandoffPayload(
+            request_id=req.request_id,
+            prompt=list(req.prompt),
+            first_tokens=list(req.generated),
+            committed=committed,
+            sampling=HandoffPayload.sampling_wire(req.params),
+            rng_seed=self._rng.seed,
+            rng_counter=self._rng.counter,
+            block_size=bs,
+            dtype=str(np.asarray(kv["k"]).dtype),
+            kv=kv,
+            session_id=req.session_id,
+        )
+        if self._handoff_exports is not None:
+            self._handoff_exports.inc()
+            self._handoff_bytes.inc(payload.nbytes)
+        return payload
+
+    def ack_handoff(self, request_id: int) -> None:
+        """The router confirmed a decode replica imported the chain: retire
+        the parked request (its committed blocks enter the prefix cache
+        before the pool reclaims them) and recycle the slot."""
+        req = self._handoffs.pop(request_id, None)
+        if req is None:
+            raise KeyError(f"request {request_id} is not parked for handoff")
+        self.scheduler.unpreemptible.discard(request_id)
+        slot = req.slot
+        if req.span is not None:
+            req.span.finish()
+        self.scheduler.retire(req, "handoff")
+        if self.flight is not None:
+            self.flight.record_retirement(req.request_id, slot, "handoff")
+
+    def admit_handoff(self, payload, on_token=None) -> Request:
+        """Decode-side admission: validate the payload against this cache's
+        format, place the chain into the block pool, and enter the request
+        directly RUNNING in decode state — no local prefill ever runs.
+        Raises ``ValueError`` on a deterministic format mismatch and
+        :class:`~nxdi_tpu.serving.handoff.HandoffCapacityError` when a slot
+        or the pool has no room right now (transient: the router re-ranks
+        and tries the next decode replica)."""
+        from nxdi_tpu.kvcache import import_kv_blocks
+        from nxdi_tpu.serving.handoff import HandoffCapacityError
+
+        if not self.paged:
+            raise ValueError("admit_handoff requires the paged KV layout")
+        mgr = self.block_manager
+        payload.validate_against(mgr.block_size, self.app.kv_cache["k"].dtype)
+        sch = self.scheduler
+        slot = sch._free_slot()
+        if slot is None:
+            raise HandoffCapacityError("no free engine slot for the import")
+        params = payload.sampling_params()
+        req = Request(
+            payload.prompt, params=params, on_token=on_token,
+            session_id=payload.session_id,
+        )
+        live_ids = {r.request_id for r in sch.waiting}
+        live_ids.update(r.request_id for r in sch.running())
+        req.request_id = payload.request_id
+        while req.request_id in live_ids:
+            req.request_id = next(Request._ids)
+        if payload.committed + max(params.max_new_tokens, 1) > self.window_limit:
+            # same budget clamp as add_request: one rule on both roles keeps
+            # greedy parity with the unified engine
+            budget = self.window_limit - payload.committed
+            if budget < 1:
+                raise ValueError(
+                    f"imported chain ({payload.committed} committed tokens) "
+                    f"leaves no decode room in the compiled window "
+                    f"({self.window_limit})"
+                )
+            req.params = dataclasses.replace(req.params, max_new_tokens=budget)
+        committed = payload.committed
+        n_blocks = -(-committed // mgr.block_size)
+        free = mgr.num_free_blocks()
+        headroom = sch.config.watermark_blocks or 0
+        if free - n_blocks < (headroom if sch.slots_busy else 0):
+            raise HandoffCapacityError(
+                f"pool pressure: import needs {n_blocks} blocks, "
+                f"{free} free (watermark {headroom})"
+            )
+        try:
+            table = mgr.ensure_capacity(req.request_id, committed)
+        except RuntimeError as e:
+            mgr.free_seq(req.request_id)
+            raise HandoffCapacityError(str(e)) from e
+        try:
+            self.app.kv_cache = import_kv_blocks(
+                self.app.kv_cache, table[:n_blocks], payload.kv, mgr.block_size
+            )
+        except Exception:
+            mgr.free_seq(req.request_id)
+            raise
+        # seed the already-streamed tokens WITHOUT re-firing on_token: the
+        # prefill side delivered them; the decode side's stream continues
+        # from its cursor
+        req.generated = [int(t) for t in payload.first_tokens]
+        sch.place_imported(req, slot, committed)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            req.span = tel.start_request(
+                tokens_in=len(req.prompt), session_id=req.session_id,
+            )
+            req.span.first_token()
+            req.span.phase("decode")
+            req.span.tokens(len(req.generated))
+        if self._handoff_imports is not None:
+            self._handoff_imports.inc()
+            self._handoff_bytes.inc(payload.nbytes)
+        if self.flight is not None:
+            self.flight.record_admission(
+                req.request_id, slot, resumed=False,
+                cached_tokens=committed, total_tokens=req.total_len,
+            )
+        return req
 
     # -- decode -------------------------------------------------------------
     def _choose_steps(self, rows: List[Tuple[int, Request]]) -> int:

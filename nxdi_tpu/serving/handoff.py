@@ -1,0 +1,205 @@
+"""KV handoff plane for prefill/decode disaggregation.
+
+A prefill-role engine (``TpuConfig(role="prefill")``) runs a request's
+prefill, samples the FIRST generated token, then parks the request: its KV
+block chain stays resident until the router confirms a decode replica has
+imported it. The payload exported here is everything a decode-role engine
+needs to continue the request as if it had prefilled locally:
+
+- the prompt token ids and every token already emitted (normally just the
+  first sampled token),
+- the committed KV positions (= prompt length: the first generated token's
+  KV is written by the first decode step, exactly like the unified path),
+- the raw K/V rows of the block chain (``kvcache.export_kv_blocks``),
+- the sampling params, and the exporting engine's ``StepRngSchedule``
+  cursor (seed + counter) so sampled-decode parity is auditable end to end,
+- the exporting cache's block size and store dtype, which the importer
+  validates against its own cache format before touching the pool.
+
+Ack/retry contract (the router drives it): the prefill replica retains the
+parked chain until ``ack``; any transport or import failure before the ack
+re-fetches the SAME payload and re-targets the next-ranked decode replica —
+no token is ever recomputed or lost. Import failures raise
+:class:`HandoffCapacityError` (transient: try another replica) or
+``ValueError`` (deterministic format mismatch: do not retry the same pair).
+The replica-side error-record marker is :data:`HANDOFF_FAULT_PREFIX`; the
+router classifies it transient like the PR-14 taxonomy's
+``TransientDispatchError``.
+"""
+
+from __future__ import annotations
+
+import base64
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from nxdi_tpu.ops.sampling import SamplingParams
+
+__all__ = [
+    "HANDOFF_WIRE_VERSION",
+    "HANDOFF_FAULT_PREFIX",
+    "HandoffCapacityError",
+    "HandoffPayload",
+]
+
+#: wire schema version; ``from_wire`` rejects anything it does not speak
+HANDOFF_WIRE_VERSION = 1
+
+#: error-record marker for a failed decode-side import — the router treats a
+#: stream record erroring with this prefix as a TRANSIENT handoff fault
+#: (re-handoff to the next-ranked decode replica), never a prompt replay
+HANDOFF_FAULT_PREFIX = "handoff import failed"
+
+#: the sampling knobs that ride the wire (same surface the router ingest
+#: accepts on /submit, plus nothing engine-internal)
+SAMPLING_WIRE_KEYS = (
+    "max_new_tokens",
+    "eos_token_ids",
+    "do_sample",
+    "top_k",
+    "top_p",
+    "temperature",
+)
+
+
+class HandoffCapacityError(RuntimeError):
+    """The receiving engine has no slot / pool room for the imported chain
+    right now — transient by the PR-14 taxonomy: the router should re-rank
+    and try another decode replica while the prefill side retains the
+    chain."""
+
+
+def _np_dtype(name: str):
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # jax dependency; carries bfloat16/fp8 numpy dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _encode_array(arr: np.ndarray) -> dict:
+    arr = np.ascontiguousarray(arr)
+    return {
+        "shape": list(arr.shape),
+        "dtype": str(arr.dtype),
+        "data": base64.b64encode(arr.tobytes()).decode("ascii"),
+    }
+
+
+def _decode_array(obj: dict) -> np.ndarray:
+    raw = base64.b64decode(obj["data"])
+    return np.frombuffer(raw, dtype=_np_dtype(obj["dtype"])).reshape(obj["shape"])
+
+
+@dataclass
+class HandoffPayload:
+    """One parked prefill, ready to continue on a decode replica."""
+
+    request_id: int
+    prompt: List[int]
+    #: tokens the prefill side already emitted (and streamed) — the decode
+    #: side seeds ``Request.generated`` with them WITHOUT re-firing its
+    #: streaming callback, so cursors continue instead of duplicating
+    first_tokens: List[int]
+    #: KV positions resident in ``kv`` (= len(prompt): the last emitted
+    #: token's KV is written by the importer's first decode step)
+    committed: int
+    sampling: dict
+    rng_seed: int
+    rng_counter: int
+    block_size: int
+    dtype: str
+    #: host K/V rows from :func:`nxdi_tpu.kvcache.export_kv_blocks`
+    kv: Dict[str, np.ndarray] = field(repr=False, default_factory=dict)
+    session_id: Optional[str] = None
+    version: int = HANDOFF_WIRE_VERSION
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(a.nbytes for a in self.kv.values()))
+
+    def sampling_params(self) -> SamplingParams:
+        return SamplingParams(**{
+            k: (tuple(v) if k == "eos_token_ids" else v)
+            for k, v in self.sampling.items()
+            if k in SAMPLING_WIRE_KEYS
+        })
+
+    @staticmethod
+    def sampling_wire(params: SamplingParams) -> dict:
+        return {
+            k: (list(getattr(params, k)) if k == "eos_token_ids"
+                else getattr(params, k))
+            for k in SAMPLING_WIRE_KEYS
+        }
+
+    def to_wire(self) -> dict:
+        """JSON-safe dict (K/V rows base64-encoded)."""
+        return {
+            "version": self.version,
+            "request_id": self.request_id,
+            "session_id": self.session_id,
+            "prompt": list(self.prompt),
+            "first_tokens": list(self.first_tokens),
+            "committed": self.committed,
+            "sampling": dict(self.sampling),
+            "rng": {"seed": self.rng_seed, "counter": self.rng_counter},
+            "block_size": self.block_size,
+            "dtype": self.dtype,
+            "k": _encode_array(self.kv["k"]),
+            "v": _encode_array(self.kv["v"]),
+        }
+
+    @classmethod
+    def from_wire(cls, obj: dict) -> "HandoffPayload":
+        version = obj.get("version")
+        if version != HANDOFF_WIRE_VERSION:
+            raise ValueError(
+                f"unsupported handoff wire version {version!r} "
+                f"(this build speaks {HANDOFF_WIRE_VERSION})"
+            )
+        return cls(
+            request_id=int(obj["request_id"]),
+            prompt=[int(t) for t in obj["prompt"]],
+            first_tokens=[int(t) for t in obj["first_tokens"]],
+            committed=int(obj["committed"]),
+            sampling=dict(obj["sampling"]),
+            rng_seed=int(obj["rng"]["seed"]),
+            rng_counter=int(obj["rng"]["counter"]),
+            block_size=int(obj["block_size"]),
+            dtype=str(obj["dtype"]),
+            kv={"k": _decode_array(obj["k"]), "v": _decode_array(obj["v"])},
+            session_id=obj.get("session_id"),
+            version=int(version),
+        )
+
+    def validate_against(self, block_size: int, store_dtype) -> None:
+        """Receiver-side format gate, BEFORE any allocation: block geometry
+        and store dtype must agree (the per-array layer/head/head_dim and
+        length checks happen again inside ``import_kv_blocks``)."""
+        if self.block_size != block_size:
+            raise ValueError(
+                f"handoff block_size mismatch: payload {self.block_size} vs "
+                f"receiver pool {block_size}"
+            )
+        if str(np.dtype(_np_dtype(self.dtype))) != str(np.dtype(store_dtype)):
+            raise ValueError(
+                f"handoff dtype mismatch: payload {self.dtype!r} vs receiver "
+                f"cache {np.dtype(store_dtype)}"
+            )
+        if self.committed < 1 or not self.prompt or not self.first_tokens:
+            raise ValueError(
+                "handoff payload incomplete: needs a prompt, at least one "
+                "emitted token and committed >= 1"
+            )
+        n_blocks = -(-self.committed // self.block_size)
+        rows = self.kv["k"].shape[1] if self.kv else 0
+        if rows != n_blocks * self.block_size:
+            raise ValueError(
+                f"handoff chain length mismatch: committed={self.committed} "
+                f"needs {n_blocks} blocks x {self.block_size} slots but the "
+                f"payload carries {rows} rows"
+            )

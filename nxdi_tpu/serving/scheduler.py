@@ -48,6 +48,7 @@ from nxdi_tpu.serving.request import (
 )
 
 INTERLEAVE_POLICIES = ("prefill_first", "decode_first")
+PREEMPT_POLICIES = ("cheapest_recompute", "youngest")
 
 
 @dataclass
@@ -79,12 +80,25 @@ class SchedulerConfig:
     #: waiting-queue positions the cache-aware scan inspects (bounds the
     #: per-step host cost under deep queues; FCFS beyond the window)
     admission_scan_limit: int = 64
+    #: preemption victim selection. ``"cheapest_recompute"`` (default):
+    #: among RUNNING requests, evict the one whose ``prompt + generated``
+    #: replay is longest-prefix-covered by the prefix cache (its recompute
+    #: re-forks cached blocks, so eviction costs the least), youngest-first
+    #: on coverage ties (FCFS: the oldest admitted keeps running). Without
+    #: a prefix cache every coverage is zero and the tie-break IS
+    #: youngest-first. ``"youngest"`` opts out of the cache probe entirely.
+    preempt_policy: str = "cheapest_recompute"
 
     def __post_init__(self):
         if self.interleave not in INTERLEAVE_POLICIES:
             raise ValueError(
                 f"interleave must be one of {INTERLEAVE_POLICIES}, "
                 f"got {self.interleave!r}"
+            )
+        if self.preempt_policy not in PREEMPT_POLICIES:
+            raise ValueError(
+                f"preempt_policy must be one of {PREEMPT_POLICIES}, "
+                f"got {self.preempt_policy!r}"
             )
         if self.max_prefills_per_step < 1:
             raise ValueError("max_prefills_per_step must be >= 1")
@@ -133,6 +147,10 @@ class Scheduler:
         self.waiting: Deque[Request] = deque()
         self.slots: List[Optional[Request]] = [None] * num_slots
         self._admit_counter = 0
+        #: request_ids victim selection must never touch: a prefill-role
+        #: engine's parked handoffs pin their chains until the router acks
+        #: the decode-side import (serving/handoff.py retention contract)
+        self.unpreemptible: set = set()
         if block_manager is not None and self.config.watermark_blocks is None:
             self.config.watermark_blocks = max(1, block_manager.num_blocks // 100)
 
@@ -231,7 +249,7 @@ class Scheduler:
                 # block.alloc fault): undo the half-placement, free a little
                 # room, and let the next step retry — never crash admission
                 self._unplace_failed(req)
-                self.preempt_youngest()
+                self.preempt_one()
                 break
             out.append(req)
             admitted += 1
@@ -363,6 +381,23 @@ class Scheduler:
             return ntok
         return 0
 
+    def place_imported(self, req: Request, slot: int, committed: int) -> None:
+        """Seat a handoff import directly RUNNING with its prefill already
+        accounted for: the engine allocated and scattered the KV chain
+        before calling this, so there is no placement-side block work — the
+        request decodes on the very next step as if it had prefilled here
+        (``prefill_done`` is immediately true)."""
+        if self.slots[slot] is not None:
+            raise RuntimeError(f"slot {slot} is already occupied")
+        req.slot = slot
+        req.state = RUNNING
+        req.num_prefilled = committed
+        req.prefill_target = committed
+        self._admit_counter += 1
+        req._admit_seq = self._admit_counter
+        self.slots[slot] = req
+        self.publish()
+
     def note_prefill_complete(self, req: Request) -> None:
         """Cross-request sharing without waiting for retirement: the moment
         a (re)prefill lands, every full block it committed enters the radix
@@ -407,10 +442,11 @@ class Scheduler:
         self, rows: List[Tuple[int, Request]]
     ) -> Tuple[List[Tuple[int, Request]], List[Request]]:
         """Grow each row's block table to cover its next KV write (the fed
-        token's position = ``total_len - 1``). On pool exhaustion the
-        YOUNGEST running request is preempted (possibly a row in ``rows``,
-        possibly the grower itself) and growth retries — oldest requests are
-        processed first, so they always win the remaining blocks."""
+        token's position = ``total_len - 1``). On pool exhaustion one running
+        request is preempted per ``preempt_policy`` (possibly a row in
+        ``rows``, possibly the grower itself) and growth retries — oldest
+        requests are processed first, so under the youngest/FCFS tie-break
+        they always win the remaining blocks."""
         preempted: List[Request] = []
         if self.block_manager is None:
             return list(rows), preempted
@@ -422,7 +458,7 @@ class Scheduler:
                     kept.append((slot, req))
                     break
                 except RuntimeError:
-                    victim = self.preempt_youngest()
+                    victim = self.preempt_one()
                     if victim is not None:
                         preempted.append(victim)
                     if victim is None or victim is req:
@@ -432,10 +468,52 @@ class Scheduler:
         self.publish()
         return kept, preempted
 
+    def preempt_one(self) -> Optional[Request]:
+        """Evict one RUNNING request back to the FRONT of the waiting queue
+        per ``preempt_policy``, freeing its blocks (recompute-style
+        preemption). Returns the victim, or None when nothing is evictable."""
+        running = [
+            r for r in self.running()
+            if r.request_id not in self.unpreemptible
+        ]
+        if not running:
+            return None
+        victim = self._pick_victim(running)
+        self._preempt(victim)
+        return victim
+
+    def _pick_victim(self, running: List[Request]) -> Request:
+        """Cheapest-recompute-first: the victim whose replay the prefix
+        cache covers deepest loses the least work to eviction (its
+        re-admission forks the cached chain and re-prefills only the tail).
+        Coverage ties — including the whole-field tie of a cold cache or
+        ``preempt_policy="youngest"`` — fall back to youngest-admitted, so
+        the oldest request always keeps running (FCFS). The probe is the
+        read-only ``PrefixCache.peek``: hit/miss stats and LRU ticks move
+        only when a replay actually forks."""
+        cache = self.prefix_cache
+        if (
+            self.config.preempt_policy == "youngest"
+            or cache is None
+            or len(running) == 1
+        ):
+            return max(running, key=lambda r: r._admit_seq)
+
+        def recompute_key(r: Request):
+            toks = r.seq_tokens
+            cov = cache.peek(toks, max_tokens=len(toks) - 1) if len(toks) > 1 else 0
+            return (cov, r._admit_seq)
+
+        return max(running, key=recompute_key)
+
     def preempt_youngest(self) -> Optional[Request]:
-        """Evict the youngest RUNNING request back to the FRONT of the
-        waiting queue, freeing its blocks (recompute-style preemption)."""
-        running = self.running()
+        """Evict the youngest RUNNING request unconditionally (tests/demos
+        force deterministic victims through this; the capacity paths go
+        through :meth:`preempt_one` and honor ``preempt_policy``)."""
+        running = [
+            r for r in self.running()
+            if r.request_id not in self.unpreemptible
+        ]
         if not running:
             return None
         victim = max(running, key=lambda r: r._admit_seq)

@@ -226,6 +226,10 @@ class Telemetry:
 
             replica_id = f"{socket.gethostname()}:{os.getpid()}"
         self.replica_id = str(replica_id)
+        # serving role ("unified" | "prefill" | "decode") — stamped into the
+        # _process snapshot extra so the fleet tier can role-split its
+        # dispatch scoring; from_config copies TpuConfig.role here
+        self.role = "unified"
         self._t0 = self.clock()
         self.registry = MetricsRegistry()
         # engine flight recorder (telemetry/flight.py), attached by the
@@ -388,6 +392,7 @@ class Telemetry:
 
         return {
             "replica_id": self.replica_id,
+            "role": self.role,
             "snapshot_unix_s": self.wall_clock(),
             "uptime_s": self.clock() - self._t0,
             "pid": os.getpid(),
@@ -398,13 +403,16 @@ class Telemetry:
     def from_config(cls, tpu_config) -> "Telemetry":
         tc = getattr(tpu_config, "telemetry", None)
         if tc is None:
-            return cls()
-        return cls(
-            enabled=getattr(tc, "enabled", True),
-            detail=getattr(tc, "detail", "basic"),
-            max_spans=getattr(tc, "max_spans", 256),
-            replica_id=getattr(tc, "replica_id", None),
-        )
+            tel = cls()
+        else:
+            tel = cls(
+                enabled=getattr(tc, "enabled", True),
+                detail=getattr(tc, "detail", "basic"),
+                max_spans=getattr(tc, "max_spans", 256),
+                replica_id=getattr(tc, "replica_id", None),
+            )
+        tel.role = getattr(tpu_config, "role", "unified")
+        return tel
 
     # -- hot-path recorders -------------------------------------------------
     def record_dispatch(

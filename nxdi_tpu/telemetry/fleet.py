@@ -42,6 +42,14 @@ identically bit for bit::
     kv_used_frac = used / (used + free)   (0.0 when the pool is unreported)
     slo_attainment_pct defaults to 100.0 when no SLO is declared
 
+Role-specialized replicas (``TpuConfig(role=...)``, stamped into the
+``_process`` snapshot extra) get role-split weights — prefill replicas are
+queue-depth-weighted (``2.0 * queue_depth + slots_busy + 1.0 *
+kv_used_frac + slo_term``: TTFT-bound, chains transient), decode replicas
+are KV-pressure-weighted (``0.5 * queue_depth + slots_busy + 8.0 *
+kv_used_frac + slo_term``: pool-bound, queue near-empty by construction).
+Unified replicas keep the formula above bit-exact.
+
 Replicas running the serving prefix cache publish ``nxdi_kv_blocks_used``
 as NON-RECLAIMABLE usage (cache-retained blocks nobody references count as
 free, since an exhausted pool evicts them on demand) — so ``kv_used_frac``
@@ -91,6 +99,10 @@ class LoadSignal:
     kv_blocks_used: float
     slo_attainment_pct: float
     state: str = HEALTHY
+    #: serving role from the replica's ``_process`` stamp — "unified"
+    #: replicas keep the PINNED score formula bit-exact; "prefill"/"decode"
+    #: replicas get role-split weights (see ``score``)
+    role: str = "unified"
 
     @property
     def kv_used_frac(self) -> float:
@@ -99,17 +111,34 @@ class LoadSignal:
 
     @property
     def score(self) -> float:
-        return (
-            self.queue_depth
-            + self.slots_busy
-            + 4.0 * self.kv_used_frac
-            + 2.0 * (1.0 - self.slo_attainment_pct / 100.0)
-        )
+        slo_term = 2.0 * (1.0 - self.slo_attainment_pct / 100.0)
+        if self.role == "prefill":
+            # TTFT-bound: a prefill replica's chains are transient (exported
+            # on the first token), so queue depth dominates and KV pressure
+            # barely matters — queue-depth-weighted dispatch
+            return (
+                2.0 * self.queue_depth
+                + self.slots_busy
+                + 1.0 * self.kv_used_frac
+                + slo_term
+            )
+        if self.role == "decode":
+            # KV-bound: a decode replica admits whole committed chains and
+            # holds them to EOS — pool pressure is the real capacity signal,
+            # its waiting queue should stay near-empty by construction
+            return (
+                0.5 * self.queue_depth
+                + self.slots_busy
+                + 8.0 * self.kv_used_frac
+                + slo_term
+            )
+        return self.queue_depth + self.slots_busy + 4.0 * self.kv_used_frac + slo_term
 
     def to_dict(self) -> dict:
         return {
             "replica": self.replica,
             "state": self.state,
+            "role": self.role,
             "queue_depth": self.queue_depth,
             "slots_busy": self.slots_busy,
             "kv_blocks_free": self.kv_blocks_free,
@@ -148,6 +177,7 @@ def load_signal_from_snapshot(
             _gauge_value(snap, "nxdi_slo_attainment_pct") if has_slo else 100.0
         ),
         state=state,
+        role=str((snap.get("_process") or {}).get("role") or "unified"),
     )
 
 

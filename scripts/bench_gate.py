@@ -116,6 +116,18 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
     # inherits the usual serving scheduling noise.
     "prefix_hit_rate_pct": ("higher", 0.02),
     "prefix_goodput_tok_s": ("higher", 0.07),
+    # disaggregated-serving headline triple (bench.py --serving
+    # --disaggregated; PR: prefill/decode disaggregation). One-sided,
+    # skipped against pre-disagg baselines (missing on a side). The p95
+    # TPOT is the disaggregation claim itself — decode steps freed from
+    # prefill interference — and is CLIENT-observed through stream
+    # polling, so it inherits the routed-tier noise; the handoff p50 is a
+    # one-time per-request migration span (payload fetch -> decode-side
+    # import -> retention ack) over localhost HTTP, the noisiest small
+    # number here, so it gets the widest tolerance.
+    "disagg_goodput_tok_s": ("higher", 0.07),
+    "disagg_tpot_p95_ms": ("lower", 0.15),
+    "disagg_handoff_p50_ms": ("lower", 0.30),
 }
 
 #: metric -> (direction, absolute limit) checked on the FRESH record alone —
@@ -241,6 +253,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 "routed_goodput_req_s",
                                 "mixed_goodput_tok_s",
                                 "prefix_goodput_tok_s",
+                                "disagg_goodput_tok_s",
                                 "chaos_goodput_retention_pct")):
         # a serving-, fleet-, or routed-mode FRESH record duplicates its
         # "value" headline as serving_/fleet_/routed_goodput_req_s (which

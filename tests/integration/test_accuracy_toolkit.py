@@ -9,10 +9,31 @@ from nxdi_tpu.utils.exceptions import AccuracyValidationError, LogitMatchingVali
 from tests.integration.test_llama_token_matching import build_app
 
 
-@pytest.fixture()
-def app_and_hf(tiny_hf_llama, tmp_path):
-    hf_model, hf_cfg = tiny_hf_llama
-    app = build_app(hf_model, hf_cfg, tmp_path, output_logits=True)
+@pytest.fixture(scope="module")
+def app_and_hf(tmp_path_factory):
+    # module-scoped on purpose: every test here is a read-only
+    # generate-and-match consumer, and rebuilding the same traced app per
+    # test was the single heaviest repeated setup in the tier-1 run
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    hf_cfg = LlamaConfig(
+        hidden_size=64,
+        intermediate_size=128,
+        num_hidden_layers=4,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        vocab_size=256,
+        max_position_embeddings=256,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    hf_model = LlamaForCausalLM(hf_cfg).eval()
+    app = build_app(
+        hf_model, hf_cfg, tmp_path_factory.mktemp("acc"), output_logits=True
+    )
     return app, hf_model
 
 

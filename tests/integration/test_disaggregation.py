@@ -1,0 +1,380 @@
+"""Prefill/decode disaggregation end to end (the PR's acceptance surface):
+role-specialized engines behind real HTTP ingests, routed through a real
+frontend —
+
+- the routed disaggregated greedy output is TOKEN-IDENTICAL to the same
+  workload run on a unified engine, under interleaved arrivals and
+  chunked prefill: prompts land on the prefill replica, the KV chain +
+  first token move to a decode replica over the wire, and the stream
+  continues without recomputing or losing a token;
+- a decode-role app compiles STRICTLY FEWER programs than the unified
+  build (``iter_programs``) — the specialization is real, not a flag;
+- killing the decode replica mid-handoff (import landed, retention ack
+  withheld) re-handoffs from the prefill side's retained chain onto the
+  next-ranked decode replica: ack retried, zero duplicated or lost
+  tokens, and the prompt is never replayed through a second prefill;
+- a decode-role ingest refuses direct ``/submit`` (503), so a role-blind
+  client cannot bypass the handoff plane.
+
+The wire-payload validation rules are unit-tested in serving/handoff.py's
+callers; this file proves the full routed plane over live engines and
+sockets.
+"""
+
+import time
+
+import pytest
+
+from nxdi_tpu.config import (
+    FleetConfig,
+    OnDeviceSamplingConfig,
+    RouterConfig,
+    TpuConfig,
+)
+from nxdi_tpu.models.llama import modeling_llama as llama
+from nxdi_tpu.router import ReplicaIngest, Router, http_json
+from nxdi_tpu.runtime.application import TpuModelForCausalLM
+from nxdi_tpu.serving import InferenceEngine, SamplingParams, SchedulerConfig
+
+# interleaved-arrival workload: (prompt, max_new_tokens); the 20-token
+# prompt prefills in 3 chunks of 8 (chunked_prefill_config below), so the
+# handoff payload's committed length crosses chunk boundaries
+_RNG_PROMPT = [7, 201, 44, 13, 95, 8, 160, 77, 31, 5,
+               118, 9, 64, 2, 250, 41, 86, 19, 140, 55]
+WORKLOAD = [
+    ([5, 9, 3, 17, 2, 8, 11, 42], 6),
+    (_RNG_PROMPT, 6),
+    ([9, 9, 2, 40, 17, 3], 6),
+    ([12, 5, 88, 3, 7, 19], 6),
+]
+KILL_PROMPT, KILL_MAX_NEW = [23, 5, 71, 200, 14, 6, 90, 12, 44], 16
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_llama_module():
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        hidden_size=64, intermediate_size=128, num_hidden_layers=4,
+        num_attention_heads=4, num_key_value_heads=2, vocab_size=256,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    return model, cfg
+
+
+def _build_replica(hf_model, hf_cfg, replica_id, role="unified",
+                   chunked=False):
+    sd = {k: v.detach().numpy() for k, v in hf_model.state_dict().items()}
+    kwargs = dict(
+        tp_degree=1,
+        seq_len=64,
+        max_context_length=32,
+        batch_size=2,
+        ctx_batch_size=1,
+        tkg_batch_size=2,
+        dtype="float32",
+        on_device_sampling_config=OnDeviceSamplingConfig(),
+        skip_warmup=True,
+        is_block_kv_layout=True,
+        pa_block_size=8,
+        pa_num_blocks=32,
+        telemetry={"detail": "basic", "replica_id": replica_id},
+    )
+    if role != "unified":
+        kwargs["role"] = role
+    if chunked:
+        kwargs["chunked_prefill_config"] = {
+            "chunk_size": 8, "kernel_q_tile_size": 8,
+        }
+    cfg = llama.LlamaInferenceConfig(
+        TpuConfig(**kwargs), load_config=lambda: hf_cfg.to_dict(),
+    )
+
+    class App(TpuModelForCausalLM):
+        def get_state_dict(self):
+            return sd
+
+    app = App("<memory>", cfg, model_family=llama)
+    app.load()
+    return app, InferenceEngine(app, SchedulerConfig(num_slots=2))
+
+
+def _unrouted_outputs(engine, jobs):
+    expected = []
+    for prompt, max_new in jobs:
+        engine.add_request(prompt, SamplingParams(max_new_tokens=max_new))
+        (out,) = engine.run()
+        assert out.finish_reason in ("eos", "length")
+        expected.append(list(out.token_ids))
+    return expected
+
+
+@pytest.fixture(scope="module")
+def disagg_fleet(tiny_hf_llama_module):
+    """One prefill + two decode replicas (identical weights) with live HTTP
+    ports, plus a unified app for program-set comparison and the UNROUTED
+    expected outputs precomputed on it. Yields
+    (apps, engines, ingests, targets, expected) with apps/engines keyed
+    'unified'/'pf0'/'dc0'/'dc1'."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines = {}, {}
+    apps["unified"], engines["unified"] = _build_replica(
+        hf_model, hf_cfg, "unified", chunked=True
+    )
+    apps["pf0"], engines["pf0"] = _build_replica(
+        hf_model, hf_cfg, "pf0", role="prefill", chunked=True
+    )
+    for name in ("dc0", "dc1"):
+        apps[name], engines[name] = _build_replica(
+            hf_model, hf_cfg, name, role="decode"
+        )
+    expected = _unrouted_outputs(
+        engines["unified"], WORKLOAD + [(KILL_PROMPT, KILL_MAX_NEW)]
+    )
+    ingests, servers, targets = {}, [], []
+    for name in ("pf0", "dc0", "dc1"):
+        # throttled so kills land mid-stream deterministically
+        ingest = ReplicaIngest(engines[name], step_delay_s=0.02)
+        mserver = apps[name].telemetry.serve(port=0)
+        iserver = ingest.serve(port=0)
+        ingests[name] = ingest
+        servers.extend([mserver, iserver])
+        targets.append((name, mserver.url, iserver.url))
+    yield apps, engines, ingests, targets, expected
+    for ingest in ingests.values():
+        ingest.stop()
+    for s in servers:
+        s.shutdown()
+
+
+def _router_over(targets, **router_kwargs):
+    cfg = router_kwargs.pop("config", None) or RouterConfig(
+        stream_failures=1, poll_interval_s=0.2
+    )
+    fc = router_kwargs.pop("fleet_config", None) or FleetConfig(
+        staleness_s=3600.0, unreachable_failures=1,
+        backoff_base_s=0.01, backoff_max_s=0.02, timeout_s=2.0,
+    )
+    return Router(targets, config=cfg, fleet_config=fc, **router_kwargs)
+
+
+def _drive_to_done(router, rids, deadline_s=120.0):
+    """Poll every request round-robin until all finish; returns
+    {rid: (tokens, final_resp)}. Round-robin polling IS the interleaving:
+    handoffs and decode progress for different requests overlap."""
+    deadline = time.time() + deadline_s
+    state = {rid: {"cursor": 0, "tokens": [], "final": None} for rid in rids}
+    while time.time() < deadline:
+        pending = [r for r, s in state.items() if s["final"] is None]
+        if not pending:
+            return {
+                r: (s["tokens"], s["final"]) for r, s in state.items()
+            }
+        for rid in pending:
+            st = state[rid]
+            status, resp = router.stream(rid, st["cursor"])
+            assert status == 200, resp
+            st["cursor"] = resp["cursor"]
+            st["tokens"].extend(resp["tokens"])
+            if resp["done"]:
+                st["final"] = resp
+        time.sleep(0.01)
+    raise AssertionError(f"requests never finished: {state}")
+
+
+def test_decode_role_compiles_strictly_fewer_programs(disagg_fleet):
+    """The specialization acceptance: role='decode' ships strictly fewer
+    compiled programs than the unified build of the same model (the CTE
+    bucket ladder and the chunked prefix-prefill programs are gone), and
+    the tags it does ship are decode-only."""
+    apps, _, _, _, _ = disagg_fleet
+
+    def programs(app):
+        return [
+            (m.tag, key)
+            for m in app.models.values()
+            for (_b, _s, key, _p) in m.iter_programs()
+        ]
+
+    uni, dec = programs(apps["unified"]), programs(apps["dc0"])
+    assert len(dec) < len(uni)
+    assert {t for t, _ in dec} == {"token_generation_model"}
+    assert "context_encoding_model" in {t for t, _ in uni}
+    # prefill keeps the prefill ladder but serves it with a plain TKG for
+    # the single handoff token — no multistep/device-loop programs
+    pre = programs(apps["pf0"])
+    assert "context_encoding_model" in {t for t, _ in pre}
+    assert not {t for t, _ in pre} & {"tkg_multistep", "tkg_device_loop"}
+
+
+def test_decode_ingest_refuses_direct_submit(disagg_fleet):
+    """A decode-role replica admits KV imports only: direct /submit gets
+    the same 503 treatment as a draining replica, so the router retries
+    prompt work elsewhere instead of finalizing an error."""
+    _, _, _, targets, _ = disagg_fleet
+    dc0_ingest = next(i for n, _, i in targets if n == "dc0")
+    status, resp = http_json("POST", f"{dc0_ingest}/submit", {
+        "request_id": "direct-1", "prompt": [1, 2, 3], "max_new_tokens": 2,
+    })
+    assert status == 503
+    assert "decode-role" in resp["error"]
+
+
+def test_routed_disaggregated_token_identical(disagg_fleet):
+    """The parity anchor: interleaved arrivals routed through the
+    disaggregated fleet reproduce the unified engine's greedy tokens
+    exactly — every prompt prefills on pf0 (chunked), hands its chain to a
+    decode replica, finishes there with exactly one handoff, and the
+    session pins live on the decode tier."""
+    apps, engines, ingests, targets, expected = disagg_fleet
+    router = _router_over(targets)
+    try:
+        router.poll()
+        exports_before = engines["pf0"]._handoff_exports.value()
+        for i, (prompt, max_new) in enumerate(WORKLOAD):
+            status, resp = router.submit({
+                "request_id": f"dis-{i}",
+                "prompt": prompt,
+                "max_new_tokens": max_new,
+                "session_id": f"conv-{i % 2}",
+            })
+            assert status == 200, resp
+            # the prompt leg can only land on the prefill replica
+            assert resp["replica"] == "pf0"
+        finals = _drive_to_done(router, [f"dis-{i}" for i in
+                                         range(len(WORKLOAD))])
+        for i in range(len(WORKLOAD)):
+            tokens, final = finals[f"dis-{i}"]
+            assert tokens == expected[i], (
+                f"routed request dis-{i} diverged from the unified run"
+            )
+            assert final["finish_reason"] in ("eos", "length")
+            assert final["failovers"] == 0
+            assert final["replica"] in ("dc0", "dc1")
+            req = router.request(f"dis-{i}")
+            assert req.handoffs == 1 and req.handoff_src is None
+        # session affinity lives on the DECODE tier
+        by_session = {}
+        for i in range(len(WORKLOAD)):
+            by_session.setdefault(i % 2, set()).add(
+                finals[f"dis-{i}"][1]["replica"]
+            )
+        for session, replicas in by_session.items():
+            assert len(replicas) == 1, (
+                f"session conv-{session} spread over {replicas}"
+            )
+            assert router.policy.pin_of(f"conv-{session}") in replicas
+        # every chain exported once, imported once, acked (nothing parked)
+        n = len(WORKLOAD)
+        assert engines["pf0"]._handoff_exports.value() == exports_before + n
+        assert not engines["pf0"]._handoffs
+        imports = sum(
+            engines[d]._handoff_imports.value() for d in ("dc0", "dc1")
+        )
+        assert imports >= n
+        assert router.handoff_retries_total.value() == 0
+        lat = router.handoff_latency
+        observed = sum(s.count for s in lat._series.values())
+        assert observed == n
+    finally:
+        router.stop()
+
+
+def test_mid_handoff_decode_kill_rehandoffs_from_retained_chain(
+    disagg_fleet, tiny_hf_llama_module
+):
+    """The acceptance kill test: the decode replica dies AFTER the import
+    landed but BEFORE the retention ack released the prefill side (acks
+    are transport-blocked). The router re-handoffs from the retained
+    chain onto the surviving decode replica — ack retried until it lands,
+    exactly one failover, two handoffs, zero duplicated or lost tokens,
+    and the prompt is never replayed through a second prefill."""
+    hf_model, hf_cfg = tiny_hf_llama_module
+    apps, engines, ingests, targets, expected = disagg_fleet
+    expected_kill = expected[len(WORKLOAD)]
+    # disposable decode victim; 'dc-a' < 'dc0' so it wins score ties and
+    # the first placement deterministically lands on it
+    app_k, engine_k = _build_replica(hf_model, hf_cfg, "dc-a", role="decode")
+    ingest_k = ReplicaIngest(engine_k, step_delay_s=0.05)
+    mserver_k = app_k.telemetry.serve(port=0)
+    iserver_k = ingest_k.serve(port=0)
+    pf0 = next(t for t in targets if t[0] == "pf0")
+    dc0 = next(t for t in targets if t[0] == "dc0")
+    calls = {"acks": 0, "block_acks": True}
+
+    def flaky_http(method, url, payload=None, timeout=None):
+        if url.endswith("/handoff_ack"):
+            calls["acks"] += 1
+            if calls["block_acks"]:
+                raise ConnectionError("injected ack transport fault")
+        return http_json(method, url, payload, timeout)
+
+    router = _router_over(
+        [pf0, dc0, ("dc-a", mserver_k.url, iserver_k.url)], http=flaky_http
+    )
+    try:
+        router.poll()
+        prefill_reqs_before = apps["pf0"].telemetry.requests_total.total()
+        status, resp = router.submit({
+            "request_id": "kill-req",
+            "prompt": KILL_PROMPT,
+            "max_new_tokens": KILL_MAX_NEW,
+            "session_id": "conv-kill",
+        })
+        assert status == 200 and resp["replica"] == "pf0"
+        req = router.request("kill-req")
+        cursor, tokens = 0, []
+        killed = False
+        deadline = time.time() + 120
+        final = None
+        while time.time() < deadline:
+            status, resp = router.stream("kill-req", cursor)
+            assert status == 200, resp
+            cursor = resp["cursor"]
+            tokens.extend(resp["tokens"])
+            if not killed and req.handoffs == 1:
+                # import landed on dc-a, ack still withheld: the prefill
+                # side MUST still retain the parked chain — kill the
+                # decode replica mid-handoff
+                assert req.handoff_src == "pf0"
+                assert req.replica == "dc-a"
+                assert engines["pf0"]._handoffs, "chain must stay retained"
+                iserver_k.shutdown()
+                mserver_k.shutdown()
+                ingest_k.stop()
+                killed = True
+            if killed and req.handoffs >= 2:
+                # second placement landed: let the ack finally go through
+                calls["block_acks"] = False
+            if resp["done"]:
+                final = dict(resp, tokens=tokens)
+                break
+            time.sleep(0.01)
+        assert killed, "the request finished before the kill could land"
+        assert final is not None, "request never finished after the kill"
+        assert final["finish_reason"] in ("eos", "length")
+        # zero duplicated or lost tokens through the mid-handoff death
+        assert final["tokens"] == expected_kill
+        assert req.handoffs == 2
+        assert final["replica"] == "dc0"
+        assert final["failovers"] == 1
+        # the ack was retried: blocked attempts + the one that landed
+        assert calls["acks"] >= 2
+        assert req.handoff_src is None
+        # the retained chain was re-exported, then released by the ack
+        assert engines["pf0"]._handoff_exports.value() >= 2
+        assert not engines["pf0"]._handoffs
+        # re-handoff, not prompt replay: the prefill replica served exactly
+        # one request (no token recomputed)
+        assert (apps["pf0"].telemetry.requests_total.total()
+                == prefill_reqs_before + 1)
+        assert router.handoff_retries_total.value() >= 1
+    finally:
+        router.stop()
+        ingest_k.stop()
+        iserver_k.shutdown()
+        mserver_k.shutdown()

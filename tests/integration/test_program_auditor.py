@@ -888,3 +888,52 @@ def test_required_strategy_check_runs_on_first_call_path(monkeypatch):
     )
     with pytest.raises(RuntimeError, match=r"fake_kernel_flag"):
         loaded_app()
+
+
+# ---------------------------------------------------------------------------
+# serving-role program-set audit (ISSUE 15 satellite): role-restricted apps
+# ship no dead submodels; one seeded violation per direction
+# ---------------------------------------------------------------------------
+
+def _role_app(role):
+    return make_app(
+        is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=24, role=role
+    )
+
+
+def test_program_set_clean_on_both_role_reference_apps():
+    """The role reference apps the disaggregation tier deploys audit clean:
+    config-level gating (config.py + application.py) and the compiled
+    reality agree on what each role ships."""
+    for role in ("prefill", "decode"):
+        report = _role_app(role).audit(checkers=["program_set"])
+        assert errors_of(report, "program_set") == [], role
+    # the unified app never triggers the checker at all
+    assert errors_of(make_app().audit(checkers=["program_set"]),
+                     "program_set") == []
+
+
+def test_program_set_decode_role_with_cte_detected():
+    """Seeded violation, decode direction: a unified build (CTE ladder
+    compiled) re-labeled role='decode' post-build — the checker flags every
+    context-encoding program as dead weight."""
+    app = make_app(is_block_kv_layout=True, pa_block_size=8, pa_num_blocks=24)
+    app._build_wrappers()  # compile the unified program set first
+    app.tpu_config.role = "decode"  # bypass build-time gating on purpose
+    findings = errors_of(app.audit(checkers=["program_set"]), "program_set")
+    assert findings, "dead CTE programs must be flagged on a decode-role app"
+    assert all(f.submodel == TAG_CONTEXT_ENCODING for f in findings)
+    assert "dead weight" in findings[0].message
+
+
+def test_program_set_prefill_role_with_multistep_detected():
+    """Seeded violation, prefill direction: a multistep build
+    (decode_steps_per_dispatch > 1 compiles tkg_multistep) re-labeled
+    role='prefill' — the checker flags the multi-token decode programs a
+    one-token-then-handoff engine can never dispatch."""
+    app = make_app(decode_steps_per_dispatch=2)
+    app._build_wrappers()
+    app.tpu_config.role = "prefill"
+    findings = errors_of(app.audit(checkers=["program_set"]), "program_set")
+    assert findings, "multistep programs must be flagged on a prefill-role app"
+    assert {f.submodel for f in findings} == {"tkg_multistep"}

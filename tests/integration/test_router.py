@@ -391,10 +391,13 @@ def test_cooperative_drain_finishes_in_place_and_rebalances(routed_fleet):
         router.stop()
 
 
+@pytest.mark.slow
 def test_router_cli_demo_smoke():
-    """The tier-1 router smoke the acceptance criteria name:
-    ``python -m nxdi_tpu.cli.route --demo 2 --once`` exits 0 — non-zero on
-    any dispatch or failover error."""
+    """The router CLI smoke: ``python -m nxdi_tpu.cli.route --demo 2
+    --once`` exits 0 — non-zero on any dispatch or failover error.
+    Slow-marked (tier-2): the longest router case in the tier-1 run, and
+    every routing path it exercises is pinned tier-1 by the direct
+    Router/ingest tests above."""
     from nxdi_tpu.cli.route import main
 
     assert main(["--demo", "2", "--once", "-q"]) == 0

@@ -552,3 +552,67 @@ def test_serving_metrics_gate_and_skip_when_absent(tmp_path):
         "-q",
     ])
     assert rc == 0
+
+
+def test_disagg_metrics_gate_and_skip_when_absent(tmp_path):
+    """bench.py --serving --disaggregated emits the disaggregation headline
+    triple: one-sided gating (goodput higher; TPOT p95 and handoff p50
+    lower), skipped against pre-disagg baselines, and the generic 'value'
+    row suppressed for disagg-mode fresh records (their tok/s headline must
+    not gate against a decode-mode tok/s/chip baseline)."""
+    disagg = {
+        "value": 420.0,
+        "disagg_goodput_tok_s": 420.0,
+        "disagg_tpot_p95_ms": 12.0,
+        "disagg_handoff_p50_ms": 35.0,
+        "unified_goodput_tok_s": 400.0,
+        "unified_tpot_p95_ms": 18.0,
+    }
+    # pre-disagg baseline (decode-mode BASE): every disagg_* field skips
+    # and the suppressed "value" row cannot fail the run
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", disagg),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+    rows, skipped = bench_gate.compare(BASE, disagg, bench_gate.TOLERANCES)
+    assert "disagg_goodput_tok_s" in skipped
+    assert "disagg_tpot_p95_ms" in skipped
+    assert "disagg_handoff_p50_ms" in skipped
+
+    # same-shape baseline: a goodput drop beyond tolerance fails...
+    slow = dict(disagg, disagg_goodput_tok_s=350.0, value=350.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", slow),
+        "--baseline", _write(tmp_path, "base.json", disagg),
+        "-q",
+    ])
+    assert rc == 1
+    # ... a TPOT p95 blowout fails (lower is better: decode steps stalling
+    # again means the role split or the dispatch path regressed) ...
+    stalled = dict(disagg, disagg_tpot_p95_ms=16.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", stalled),
+        "--baseline", _write(tmp_path, "base.json", disagg),
+        "-q",
+    ])
+    assert rc == 1
+    # ... a handoff-latency blowout fails (the fetch->place->ack span is
+    # the migration cost every request pays once) ...
+    sticky = dict(disagg, disagg_handoff_p50_ms=60.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", sticky),
+        "--baseline", _write(tmp_path, "base.json", disagg),
+        "-q",
+    ])
+    assert rc == 1
+    # ... and improvements plus in-tolerance noise pass (one-sided)
+    fine = dict(disagg, disagg_tpot_p95_ms=11.0, disagg_goodput_tok_s=415.0,
+                disagg_handoff_p50_ms=30.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", fine),
+        "--baseline", _write(tmp_path, "base.json", disagg),
+        "-q",
+    ])
+    assert rc == 0

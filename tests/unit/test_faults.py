@@ -409,9 +409,12 @@ def test_watchdog_trip_abandons_worker_and_is_transient():
 
 
 # ---------------------------------------------------------- unarmed overhead
+@pytest.mark.slow
 def test_unarmed_site_guard_overhead_abba_smoke():
     """The unarmed failpoint guard (`faults.ACTIVE_PLAN is not None`) must
-    cost under 1% of a dispatch-sized body. ABBA-interleaved
+    cost under 1% of a dispatch-sized body. Slow-marked (tier-2): a pure
+    wall-clock A/B smoke — the longest chaos-harness case in the tier-1
+    run and the one most sensitive to suite load. ABBA-interleaved
     (guarded, bare, bare, guarded) so host warmup/jitter spreads across
     both sides; the body (a 512x512 matmul, tens of microseconds — still
     orders of magnitude below a real millisecond-scale dispatch) dwarfs

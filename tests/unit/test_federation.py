@@ -305,6 +305,45 @@ def test_load_signal_from_snapshot_reads_existing_gauges():
     assert sig.score == 4.0 + 3.0 + 4.0 * 0.75 + 0.0
 
 
+def test_load_signal_role_split_formulas_bit_exact():
+    """Disaggregation roles re-weight the pinned formula (fleet.py module
+    docstring): a prefill replica's cost driver is its prompt queue, a
+    decode replica's is KV pressure. Term for term, bit-exact — and the
+    unified formula is byte-identical to the seed (the test above)."""
+    kw = dict(queue_depth=3.0, slots_busy=2.0, kv_blocks_free=6.0,
+              kv_blocks_used=18.0, slo_attainment_pct=87.5)
+    slo_term = 2.0 * (1.0 - 87.5 / 100.0)
+    kv = 18.0 / 24.0
+    pre = LoadSignal(replica="p", role="prefill", **kw)
+    assert pre.score == 2.0 * 3.0 + 2.0 + 1.0 * kv + slo_term
+    dec = LoadSignal(replica="d", role="decode", **kw)
+    assert dec.score == 0.5 * 3.0 + 2.0 + 8.0 * kv + slo_term
+    # explicit unified role == the default formula, same bits
+    uni = LoadSignal(replica="u", role="unified", **kw)
+    assert uni.score == LoadSignal(replica="u", **kw).score
+    assert uni.score == 3.0 + 2.0 + 4.0 * kv + slo_term
+
+
+def test_load_signal_role_reads_process_snapshot():
+    """Telemetry.role (set from TpuConfig.role) travels through /snapshot's
+    ``_process`` block into the LoadSignal, defaulting to unified for
+    replicas predating the field."""
+    tel = Telemetry(replica_id="x")
+    tel.role = "decode"
+    tel.serve_queue_depth.set(4)
+    tel.kv_blocks_free.set(10)
+    tel.kv_blocks_used.set(30)
+    snap = roundtrip(tel.snapshot())
+    assert snap["_process"]["role"] == "decode"
+    sig = load_signal_from_snapshot("x", snap)
+    assert sig.role == "decode"
+    assert sig.score == 0.5 * 4.0 + 0.0 + 8.0 * 0.75 + 0.0
+    assert sig.to_dict()["role"] == "decode"
+    # a snapshot with no role field (older replica) stays unified
+    del snap["_process"]["role"]
+    assert load_signal_from_snapshot("x", snap).role == "unified"
+
+
 def test_ranking_is_deterministic_with_ties():
     a = LoadSignal("b-replica", 1.0, 0.0, 0.0, 0.0, 100.0)
     b = LoadSignal("a-replica", 1.0, 0.0, 0.0, 0.0, 100.0)  # same score

@@ -412,3 +412,81 @@ def test_admission_degrades_on_injected_alloc_failure():
     placed = s.schedule_prefills()
     assert placed == [occupant, nxt]
     assert occupant.state == RUNNING and nxt.state == RUNNING
+
+
+# ---------------------------------------------------------------------------
+# Preemption policy (ISSUE 15 satellite): cheapest-recompute-first victim
+# selection, FCFS ties, the youngest opt-out, and the unpreemptible set
+# ---------------------------------------------------------------------------
+
+def _running_pair(s, older, younger):
+    """Admit two requests in order and run both to decode-ready."""
+    for r in (older, younger):
+        s.add(r)
+        assert r in s.schedule_prefills()
+        r.num_prefilled = r.prefill_target
+        r.emit(1)
+
+
+def test_preempt_one_evicts_cheapest_recompute_first():
+    """With a warm prefix cache the victim is the runner whose replay the
+    cache covers deepest — even when it is OLDER — because its eviction
+    loses the least work (re-admission forks the cached chain)."""
+    s, cache = _cache_sched(max_prefills_per_step=4)
+    warm, cold = _warm(), _cold()
+    _running_pair(s, warm, cold)  # warm admitted FIRST (older)
+    victim = s.preempt_one()
+    assert victim is warm
+    assert warm.state == PREEMPTED and s.waiting[0] is warm
+    assert cold.state == RUNNING
+    # the probe was read-only: no hit/miss stats moved
+    assert cache.hits_n <= 1  # the admission fork, never the victim scan
+
+
+def test_preempt_one_fcfs_tie_falls_back_to_youngest():
+    """Equal coverage (here: both cold) keeps the seed behavior — the
+    youngest-admitted request is evicted, the oldest keeps running."""
+    s, _ = _cache_sched(max_prefills_per_step=4)
+    a, b = _cold(), _cold()
+    _running_pair(s, a, b)
+    victim = s.preempt_one()
+    assert victim is b
+    assert a.state == RUNNING and b.state == PREEMPTED
+
+
+def test_preempt_policy_youngest_opt_out_ignores_the_cache():
+    """preempt_policy='youngest' restores unconditional youngest-first:
+    the cold (younger) request is evicted even though the warm one would
+    be the cheaper recompute."""
+    mgr = BlockSpaceManager(32, 4)
+    cache = _seeded_cache(mgr, SHARED)
+    s = Scheduler(2, block_manager=mgr,
+                  config=SchedulerConfig(watermark_blocks=0,
+                                         max_prefills_per_step=4,
+                                         preempt_policy="youngest"))
+    s.prefix_cache = cache
+    warm, cold = _warm(), _cold()
+    _running_pair(s, warm, cold)
+    victim = s.preempt_one()
+    assert victim is cold
+    assert warm.state == RUNNING
+
+
+def test_preempt_skips_unpreemptible_requests():
+    """A request in the scheduler's unpreemptible set (a parked handoff
+    chain) is never chosen — by preempt_one OR the forced-youngest path —
+    and an all-unpreemptible field yields no victim at all."""
+    s, _ = _cache_sched(max_prefills_per_step=4)
+    a, b = _cold(), _cold()
+    _running_pair(s, a, b)
+    s.unpreemptible.add(b.request_id)
+    assert s.preempt_one() is a          # b (youngest) is protected
+    s.unpreemptible.add(a.request_id)
+    a.state = RUNNING  # pretend it kept running; both now protected
+    s.slots[a.slot or 0] = a
+    assert s.preempt_youngest() is None
+
+
+def test_preempt_policy_validation():
+    with pytest.raises(ValueError, match="preempt_policy"):
+        SchedulerConfig(preempt_policy="oldest")
