@@ -25,60 +25,73 @@ This package is the audit layer over that program set:
   (``TpuConfig.retrace_guard``).
 - :mod:`~nxdi_tpu.analysis.source_lint` — stdlib pyflakes-lite (unused
   imports / undefined names) gating tier-1; mirrors the repo ``ruff.toml``.
+- :mod:`~nxdi_tpu.analysis.concurrency` — the host-plane concurrency
+  auditor: thread-entrypoint discovery, lock-discipline (``guarded_by``)
+  enforcement, lock-order-cycle and blocking-under-lock detection over the
+  serving plane's driver/HTTP/poller threads.
+
+The program-audit surface (auditor/checkers/costs) imports jax at module
+scope; the source-level surfaces (``source_lint``, ``concurrency``) are
+stdlib-only. Attribute access is therefore lazy (PEP 562): importing
+``nxdi_tpu.analysis`` — e.g. for the ``guarded_by`` marker used across the
+serving plane — stays cheap, and the heavy modules load on first touch.
 
 CLI: ``python -m nxdi_tpu.cli.lint`` (per-model JSON report, nonzero exit on
-violations).
+violations); ``--concurrency`` for the host-plane report.
 """
 
-from nxdi_tpu.analysis.auditor import (
-    AuditReport,
-    ProgramReport,
-    audit_application,
-    audit_wrapper,
-    check_cache_format_agreement,
-    collective_summary,
-)
-from nxdi_tpu.analysis.budget import expected_collective_budget
-from nxdi_tpu.analysis.costs import (
-    CHIP_SPECS,
-    ChipSpec,
-    CostSheet,
-    attach_cost_gauges,
-    cost_sheets,
-    cost_summary,
-    resolve_chip,
-)
-from nxdi_tpu.analysis.checkers import (
-    CHECKERS,
-    DEFAULT_CONST_THRESHOLD_BYTES,
-    Finding,
-    ProgramArtifacts,
-    missing_required_strategies,
-    required_strategy_error,
-)
-from nxdi_tpu.analysis.retrace import RetraceAfterServingError, RetraceGuard
+import importlib
 
-__all__ = [
-    "AuditReport",
-    "ProgramReport",
-    "audit_application",
-    "audit_wrapper",
-    "check_cache_format_agreement",
-    "collective_summary",
-    "CHIP_SPECS",
-    "ChipSpec",
-    "CostSheet",
-    "attach_cost_gauges",
-    "cost_sheets",
-    "cost_summary",
-    "resolve_chip",
-    "expected_collective_budget",
-    "CHECKERS",
-    "DEFAULT_CONST_THRESHOLD_BYTES",
-    "Finding",
-    "ProgramArtifacts",
-    "missing_required_strategies",
-    "required_strategy_error",
-    "RetraceAfterServingError",
-    "RetraceGuard",
-]
+# Concurrency markers are decorators applied at import time across the
+# serving plane — eager and dependency-free by design.
+from nxdi_tpu.analysis.concurrency import guarded_by, thread_entrypoint
+
+_EXPORTS = {
+    # auditor (imports jax)
+    "AuditReport": "nxdi_tpu.analysis.auditor",
+    "ProgramReport": "nxdi_tpu.analysis.auditor",
+    "audit_application": "nxdi_tpu.analysis.auditor",
+    "audit_wrapper": "nxdi_tpu.analysis.auditor",
+    "check_cache_format_agreement": "nxdi_tpu.analysis.auditor",
+    "collective_summary": "nxdi_tpu.analysis.auditor",
+    # budget
+    "expected_collective_budget": "nxdi_tpu.analysis.budget",
+    # costs (imports jax)
+    "CHIP_SPECS": "nxdi_tpu.analysis.costs",
+    "ChipSpec": "nxdi_tpu.analysis.costs",
+    "CostSheet": "nxdi_tpu.analysis.costs",
+    "attach_cost_gauges": "nxdi_tpu.analysis.costs",
+    "cost_sheets": "nxdi_tpu.analysis.costs",
+    "cost_summary": "nxdi_tpu.analysis.costs",
+    "resolve_chip": "nxdi_tpu.analysis.costs",
+    # checkers (imports jax)
+    "CHECKERS": "nxdi_tpu.analysis.checkers",
+    "DEFAULT_CONST_THRESHOLD_BYTES": "nxdi_tpu.analysis.checkers",
+    "Finding": "nxdi_tpu.analysis.checkers",
+    "ProgramArtifacts": "nxdi_tpu.analysis.checkers",
+    "missing_required_strategies": "nxdi_tpu.analysis.checkers",
+    "required_strategy_error": "nxdi_tpu.analysis.checkers",
+    # retrace guard
+    "RetraceAfterServingError": "nxdi_tpu.analysis.retrace",
+    "RetraceGuard": "nxdi_tpu.analysis.retrace",
+    # concurrency auditor (stdlib-only)
+    "ConcurrencyFinding": "nxdi_tpu.analysis.concurrency",
+    "ConcurrencyReport": "nxdi_tpu.analysis.concurrency",
+    "analyze_paths": "nxdi_tpu.analysis.concurrency",
+    "analyze_sources": "nxdi_tpu.analysis.concurrency",
+}
+
+__all__ = sorted(set(_EXPORTS) | {"guarded_by", "thread_entrypoint"})
+
+
+def __getattr__(name):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    value = getattr(importlib.import_module(mod), name)
+    globals()[name] = value  # cache: next access skips __getattr__
+    return value
+
+
+def __dir__():
+    return sorted(set(globals()) | set(__all__))

@@ -1,0 +1,1694 @@
+"""Static concurrency auditor for the host-side serving plane.
+
+The program auditor verifies every *compiled* program; this module is its
+host-plane counterpart: a whole-package, stdlib-``ast`` analysis (same
+zero-dependency style as :mod:`~nxdi_tpu.analysis.source_lint`) of the
+threads that orchestrate those programs — engine driver loops, router and
+ingest HTTP handlers, the fleet poller, the frontend sweep thread — and of
+the lock discipline that keeps their shared state coherent.
+
+The analysis runs in two phases:
+
+- **Phase A** parses each module and records, per function, every attribute
+  access (read / write / mutate / iterate), every lock acquisition (``with
+  self._lock:`` blocks, manual ``acquire``/``try/finally release`` regions),
+  every call edge, every blocking call, and every ``threading.Thread``
+  construction — each tagged with the set of locks held at that point.
+- **Phase B** resolves receivers to classes (param annotations, local
+  annotations, constructor assignments, module-global annotations, attribute
+  chains), discovers thread entrypoints, propagates thread labels over the
+  call graph, runs two lock-set fixpoints (*must-hold* at entry via
+  intersection over call sites; *may-hold* via union) and evaluates the
+  rules.
+
+Rules (each a named entry in the JSON report):
+
+==================  =======================================================
+``unguarded-write``  write/mutation of a guarded attribute of a cross-thread
+                     lock-owning class outside its lock
+``unguarded-read``   read of such an attribute outside its lock (annotate
+                     ``# lock-free: <reason>`` when intentional)
+``ring-iteration``   direct iteration over a cross-thread deque/ring buffer
+                     outside the lock — readers must use ``snapshot_*``
+``lock-order-cycle`` cycle in the inter-class lock-acquisition-order graph
+                     (deadlock potential)
+``blocking-under-lock`` ``time.sleep`` / HTTP / zero-arg ``.wait()``/
+                     ``.get()``/``.join()`` while holding a lock that is not
+                     annotated ``# blocking-ok: <reason>``
+``raw-thread``       ``threading.Thread(...)`` without both ``daemon=`` and
+                     ``name=``
+``guarded-call``     call of a ``@guarded_by``-decorated function from a
+                     site that does not hold the declared lock
+==================  =======================================================
+
+Annotation surface (all load-bearing for the analyzer, no-ops at runtime):
+
+- ``@guarded_by("_lock")`` — this function requires the named lock at entry.
+  On methods the lock resolves against the method's class; on module-level
+  functions against the class of the first typed parameter.
+- ``@thread_entrypoint("name")`` — seed this function as a thread root.
+- ``# lock-free: <reason>`` trailing comment on an attribute's init line —
+  the attribute is intentionally accessed outside the lock (single-writer
+  ownership, monotonic flag, ...).
+- ``# guarded_by: <lock>`` trailing comment on an attribute's init line —
+  declares which lock guards it when the class owns several.
+- ``# blocking-ok: <reason>`` trailing comment on a lock's creation line —
+  blocking calls under this lock are the documented contract (e.g. a
+  request's own lock serializing its upstream HTTP).
+
+Known soundness limits (documented, deliberate): lock identity is tracked at
+class granularity — two *instances* of the same class are not distinguished
+— and receivers the type rules cannot resolve are invisible rather than
+flagged, so the analyzer stays quiet instead of crying wolf.
+
+CLI: ``python -m nxdi_tpu.cli.lint --concurrency`` (JSON report, exit codes
+0/1/2). Tier-1: ``tests/unit/test_concurrency_lint.py`` seeds one violation
+per rule on synthetic fixtures and gates the real tree clean.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Any, Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from nxdi_tpu.analysis.source_lint import iter_py_files
+
+__all__ = [
+    "ConcurrencyFinding",
+    "ConcurrencyReport",
+    "RULES",
+    "analyze_paths",
+    "analyze_sources",
+    "guarded_by",
+    "thread_entrypoint",
+]
+
+RULES = (
+    "unguarded-write",
+    "unguarded-read",
+    "ring-iteration",
+    "lock-order-cycle",
+    "blocking-under-lock",
+    "raw-thread",
+    "guarded-call",
+)
+
+# ---------------------------------------------------------------------------
+# runtime markers
+# ---------------------------------------------------------------------------
+
+
+def guarded_by(lock: str):
+    """Declare that the decorated function must be entered with ``lock``
+    (an attribute name on its class, or on the class of its first typed
+    parameter for module-level functions) already held.
+
+    Runtime no-op; the concurrency auditor treats it as a contract: the
+    function's body may touch guarded attributes, and every call site must
+    hold the lock (rule ``guarded-call``).
+    """
+
+    def mark(fn):
+        try:
+            held = list(getattr(fn, "__guarded_by__", ()))
+            held.append(lock)
+            fn.__guarded_by__ = tuple(held)
+        except (AttributeError, TypeError):  # e.g. already a property
+            pass
+        return fn
+
+    return mark
+
+
+def thread_entrypoint(name: str):
+    """Mark the decorated function as a thread root labelled ``name`` for
+    the concurrency auditor's reachability analysis. Runtime no-op."""
+
+    def mark(fn):
+        try:
+            fn.__thread_entrypoint__ = name
+        except (AttributeError, TypeError):
+            pass
+        return fn
+
+    return mark
+
+
+# ---------------------------------------------------------------------------
+# report types
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ConcurrencyFinding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+
+@dataclass
+class ConcurrencyReport:
+    findings: List[ConcurrencyFinding] = field(default_factory=list)
+    entrypoints: List[Dict[str, Any]] = field(default_factory=list)
+    lock_order_edges: List[Dict[str, Any]] = field(default_factory=list)
+    lock_order_cycles: List[List[str]] = field(default_factory=list)
+    lock_owners: Dict[str, Dict[str, Any]] = field(default_factory=dict)
+
+    @property
+    def ok(self) -> bool:
+        return not self.findings
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "ok": self.ok,
+            "findings": [f.to_dict() for f in self.findings],
+            "entrypoints": self.entrypoints,
+            "lock_order": {
+                "edges": self.lock_order_edges,
+                "cycles": self.lock_order_cycles,
+            },
+            "lock_owners": self.lock_owners,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Phase A — per-module fact collection
+# ---------------------------------------------------------------------------
+
+# Receiver descriptors: ("self",) | ("name", var) | ("attr", base_desc, attr)
+Desc = Tuple[Any, ...]
+# A lock reference as seen in source: (receiver descriptor, lock attr name)
+LockRef = Tuple[Optional[Desc], str]
+
+_MUTATORS = frozenset({
+    "append", "appendleft", "pop", "popleft", "add", "clear", "extend",
+    "extendleft", "update", "discard", "remove", "insert", "setdefault",
+    "popitem", "sort", "reverse", "rotate",
+})
+
+_SYNC_TYPES = frozenset({
+    "Event", "Condition", "Semaphore", "BoundedSemaphore", "Barrier",
+    "Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+    "ThreadPoolExecutor",
+})
+
+_BLOCKING_NAMES = frozenset({"sleep", "http_json", "_http_fetch", "urlopen"})
+_BLOCKING_SELF_ATTRS = frozenset({"_sleep", "http", "fetch", "http_json"})
+_BLOCKING_ZERO_ARG = frozenset({"wait", "get", "join"})
+
+
+_LOCKISH_RE = re.compile(r"(?:^|_)r?lock\d*$")
+
+
+def _is_lockish(attr: str) -> bool:
+    # matches ``lock``/``_lock``/``state_lock``/``rlock`` but NOT ``block``
+    # or ``wall_clock`` — the word must be a standalone trailing component
+    return bool(_LOCKISH_RE.search(attr.lower()))
+
+
+@dataclass
+class Access:
+    recv: Desc
+    attr: str
+    kind: str  # read | write | mutate | iterate
+    line: int
+    held: Tuple[LockRef, ...]
+
+
+@dataclass
+class CallEv:
+    kind: str  # "name" | "method" | "modfunc"
+    data: Tuple[Any, ...]
+    line: int
+    held: Tuple[LockRef, ...]
+
+
+@dataclass
+class AcquireEv:
+    ref: LockRef
+    line: int
+    held_before: Tuple[LockRef, ...]
+
+
+@dataclass
+class BlockEv:
+    what: str
+    line: int
+    held: Tuple[LockRef, ...]
+
+
+@dataclass
+class SpawnEv:
+    target: Optional[Desc]
+    has_daemon: bool
+    has_name: bool
+    name_label: Optional[str]
+    line: int
+
+
+@dataclass
+class FunctionInfo:
+    name: str
+    qual: str
+    path: str
+    line: int
+    module: "ModuleInfo"
+    cls: Optional["ClassInfo"]
+    guarded_locks: Tuple[str, ...] = ()
+    entry_label: Optional[str] = None
+    is_property: bool = False
+    is_init: bool = False
+    param_types: Dict[str, str] = field(default_factory=dict)
+    local_types: Dict[str, List[Tuple[Any, ...]]] = field(default_factory=dict)
+    nested: Dict[str, "FunctionInfo"] = field(default_factory=dict)
+    parent: Optional["FunctionInfo"] = None
+    accesses: List[Access] = field(default_factory=list)
+    calls: List[CallEv] = field(default_factory=list)
+    acquires: List[AcquireEv] = field(default_factory=list)
+    blocking: List[BlockEv] = field(default_factory=list)
+    spawns: List[SpawnEv] = field(default_factory=list)
+    # Phase B state
+    labels: Set[str] = field(default_factory=set)
+    entry_must: Optional[FrozenSet[str]] = None  # None = TOP
+    entry_may: Set[str] = field(default_factory=set)
+    seeded: bool = False
+
+    @property
+    def is_public_method(self) -> bool:
+        return self.cls is not None and self.parent is None and (
+            not self.name.startswith("_")
+        )
+
+    @property
+    def is_internal(self) -> bool:
+        """Internal = lock-set at entry inferable from call sites: private
+        methods and nested closures. Everything else is an external surface
+        and must stand on its own (or carry ``@guarded_by``)."""
+        if self.seeded or self.entry_label:
+            return False
+        if self.parent is not None:
+            return True
+        if self.cls is not None:
+            return self.name.startswith("_") and not self.name.startswith("__")
+        return False
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    qual: str
+    path: str
+    line: int
+    module: "ModuleInfo"
+    bases: List[str] = field(default_factory=list)
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    attr_types: Dict[str, List[Tuple[Any, ...]]] = field(default_factory=dict)
+    lock_attrs: Set[str] = field(default_factory=set)
+    attr_first_assign: Dict[str, int] = field(default_factory=dict)
+    attrs_written_outside_init: Set[str] = field(default_factory=set)
+    sync_attrs: Set[str] = field(default_factory=set)
+    deque_attrs: Set[str] = field(default_factory=set)
+    ann_lock_free: Dict[str, str] = field(default_factory=dict)
+    ann_guarded: Dict[str, str] = field(default_factory=dict)
+    blocking_ok: Dict[str, str] = field(default_factory=dict)
+    is_http_handler: bool = False
+    # Phase B state
+    resolved_bases: List["ClassInfo"] = field(default_factory=list)
+    labels: Set[str] = field(default_factory=set)
+
+    def chain(self) -> List["ClassInfo"]:
+        out, seen = [], set()
+        stack = [self]
+        while stack:
+            c = stack.pop(0)
+            if id(c) in seen:
+                continue
+            seen.add(id(c))
+            out.append(c)
+            stack.extend(c.resolved_bases)
+        return out
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    name: str
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    global_types: Dict[str, str] = field(default_factory=dict)
+    import_mods: Dict[str, str] = field(default_factory=dict)
+    from_imports: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    line_notes: Dict[int, Tuple[str, str]] = field(default_factory=dict)
+
+
+_NOTE_KINDS = ("lock-free", "guarded_by", "blocking-ok")
+
+
+def _collect_line_notes(source: str) -> Dict[int, Tuple[str, str]]:
+    notes: Dict[int, Tuple[str, str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        if "#" not in line:
+            continue
+        comment = line.split("#", 1)[1].strip()
+        for kind in _NOTE_KINDS:
+            prefix = kind + ":"
+            if comment.startswith(prefix):
+                notes[i] = (kind, comment[len(prefix):].strip())
+                break
+    return notes
+
+
+def _ann_to_type(node: Optional[ast.expr]) -> Optional[str]:
+    """A deliberately narrow annotation → class-name mapping: ``Name``,
+    ``"Name"`` strings, and ``Optional[Name]``. Containers and dotted types
+    resolve to None (invisible) — precision over recall."""
+    if node is None:
+        return None
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value.strip()
+        if text.startswith("Optional[") and text.endswith("]"):
+            text = text[len("Optional["):-1].strip()
+        return text if text.isidentifier() else None
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id == "Optional":
+            return _ann_to_type(node.slice)
+    return None
+
+
+_SEQ_GENERICS = (
+    "List", "Sequence", "Deque", "Set", "FrozenSet", "Iterable",
+    "list", "set", "tuple", "frozenset",
+)
+
+
+def _ann_elt_type(node: Optional[ast.expr]) -> Optional[str]:
+    """Element type of a homogeneous-container annotation: ``List[Name]``,
+    ``Sequence[Name]`` etc (and their string forms). The container variable
+    itself stays invisible — only iteration targets pick the type up."""
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        if isinstance(base, ast.Name) and base.id in _SEQ_GENERICS:
+            return _ann_to_type(node.slice)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        text = node.value.strip()
+        for g in _SEQ_GENERICS:
+            if text.startswith(g + "[") and text.endswith("]"):
+                inner = text[len(g) + 1:-1].strip()
+                return inner if inner.isidentifier() else None
+    return None
+
+
+def _type_desc_from_value(node: ast.expr) -> Optional[Tuple[Any, ...]]:
+    """Type evidence from an assignment's RHS. Returns one of
+    ``("cls", Name)``, ``("expr", desc)``, ``("ret", desc, meth)`` or None."""
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name):
+            return ("cls", node.func.id)
+        if isinstance(node.func, ast.Attribute):
+            d = _desc_of(node.func.value)
+            if d is not None:
+                return ("ret", d, node.func.attr)
+        return None
+    d = _desc_of(node)
+    if d is not None:
+        return ("expr", d)
+    if isinstance(node, ast.BoolOp):
+        for operand in node.values:
+            got = _type_desc_from_value(operand)
+            if got is not None:
+                return got
+    if isinstance(node, ast.IfExp):
+        for operand in (node.body, node.orelse):
+            got = _type_desc_from_value(operand)
+            if got is not None:
+                return got
+    return None
+
+
+def _desc_of(node: ast.expr) -> Optional[Desc]:
+    if isinstance(node, ast.Name):
+        return ("self",) if node.id == "self" else ("name", node.id)
+    if isinstance(node, ast.Attribute):
+        base = _desc_of(node.value)
+        if base is None:
+            return None
+        return ("attr", base, node.attr)
+    return None
+
+
+def _const_str(node: Optional[ast.expr]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _nonblocking_acquire(node: ast.Call) -> bool:
+    """``lock.acquire(False)`` / ``lock.acquire(blocking=False)``."""
+    if node.args and isinstance(node.args[0], ast.Constant):
+        return node.args[0].value is False
+    for k in node.keywords:
+        if k.arg == "blocking" and isinstance(k.value, ast.Constant):
+            return k.value.value is False
+    return False
+
+
+def _is_thread_ctor(func: ast.expr) -> bool:
+    if isinstance(func, ast.Attribute) and func.attr == "Thread":
+        return isinstance(func.value, ast.Name) and func.value.id == "threading"
+    return isinstance(func, ast.Name) and func.id == "Thread"
+
+
+def _lock_value_kind(node: ast.expr) -> Optional[str]:
+    """Classify an ``__init__`` RHS: 'lock' | 'sync' | 'deque' | None."""
+    if isinstance(node, ast.Call):
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname in ("Lock", "RLock"):
+            return "lock"
+        if fname in _SYNC_TYPES:
+            return "sync"
+        if fname == "deque":
+            return "deque"
+    if isinstance(node, ast.Name) and _is_lockish(node.id):
+        return "lock"  # e.g. ``self._lock = lock`` sharing a caller's lock
+    if isinstance(node, (ast.BoolOp, ast.IfExp)):
+        for sub in ast.iter_child_nodes(node):
+            got = _lock_value_kind(sub) if isinstance(sub, ast.expr) else None
+            if got:
+                return got
+    return None
+
+
+class _FunctionWalker:
+    """Walks one function body, tracking the set of locks held at each
+    statement, and records facts onto the FunctionInfo."""
+
+    def __init__(self, fn: FunctionInfo, collector: "_ModuleCollector") -> None:
+        self.fn = fn
+        self.col = collector
+
+    # -- statements ---------------------------------------------------------
+
+    def walk_body(self, stmts: Sequence[ast.stmt], held: Tuple[LockRef, ...]) -> None:
+        for stmt in stmts:
+            self.walk_stmt(stmt, held)
+
+    def walk_stmt(self, stmt: ast.stmt, held: Tuple[LockRef, ...]) -> None:
+        if isinstance(stmt, ast.With):
+            extra: List[LockRef] = []
+            for item in stmt.items:
+                ref = self._lock_ref(item.context_expr)
+                if ref is not None:
+                    self.fn.acquires.append(
+                        AcquireEv(ref, item.context_expr.lineno, held + tuple(extra))
+                    )
+                    extra.append(ref)
+                else:
+                    self.scan_expr(item.context_expr, held)
+                    if item.optional_vars is not None:
+                        self._bind_target(item.optional_vars, item.context_expr)
+            self.walk_body(stmt.body, held + tuple(extra))
+        elif isinstance(stmt, ast.Try):
+            manual = self._manual_release_refs(stmt.finalbody)
+            self.walk_body(stmt.body, held + tuple(manual))
+            for handler in stmt.handlers:
+                self.walk_body(handler.body, held)
+            self.walk_body(stmt.orelse, held + tuple(manual))
+            self.walk_body(stmt.finalbody, held)
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self.col.collect_function(
+                stmt, cls=self.fn.cls, parent=self.fn
+            )
+        elif isinstance(stmt, ast.ClassDef):
+            self.col.collect_class(stmt, prefix=self.fn.qual + ".")
+        elif isinstance(stmt, ast.Assign):
+            self.scan_expr(stmt.value, held)
+            for tgt in stmt.targets:
+                self._store_target(tgt, held)
+                self._bind_target(tgt, stmt.value)
+        elif isinstance(stmt, ast.AnnAssign):
+            if stmt.value is not None:
+                self.scan_expr(stmt.value, held)
+            self._store_target(stmt.target, held)
+            ty = _ann_to_type(stmt.annotation)
+            elt = _ann_elt_type(stmt.annotation)
+            if isinstance(stmt.target, ast.Name) and ty:
+                self.fn.local_types.setdefault(stmt.target.id, []).append(("cls", ty))
+            elif isinstance(stmt.target, ast.Name) and elt:
+                self.fn.local_types.setdefault(stmt.target.id, []).append(("elt", elt))
+            elif stmt.value is not None:
+                self._bind_target(stmt.target, stmt.value)
+            if (
+                isinstance(stmt.target, ast.Attribute)
+                and _desc_of(stmt.target.value) == ("self",)
+                and ty
+                and self.fn.cls is not None
+            ):
+                self.fn.cls.attr_types.setdefault(stmt.target.attr, []).append(
+                    ("cls", ty)
+                )
+        elif isinstance(stmt, ast.AugAssign):
+            self.scan_expr(stmt.value, held)
+            self._store_target(stmt.target, held, aug=True)
+        elif isinstance(stmt, ast.Delete):
+            for tgt in stmt.targets:
+                self._store_target(tgt, held)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._record_iteration(stmt.iter, held)
+            self.scan_expr(stmt.iter, held, as_iter=True)
+            if isinstance(stmt.target, ast.Name) and isinstance(stmt.iter, ast.Name):
+                # ``for req in stale:`` — element type flows from the
+                # container's ``List[T]`` annotation (resolved lazily)
+                self.fn.local_types.setdefault(stmt.target.id, []).append(
+                    ("iterelt", stmt.iter.id)
+                )
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.While):
+            self.scan_expr(stmt.test, held)
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.If):
+            self.scan_expr(stmt.test, held)
+            self.walk_body(stmt.body, held)
+            self.walk_body(stmt.orelse, held)
+        elif isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                self.scan_expr(stmt.value, held)
+        elif isinstance(stmt, ast.Expr):
+            self.scan_expr(stmt.value, held)
+        elif isinstance(stmt, (ast.Raise, ast.Assert)):
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self.scan_expr(sub, held)
+        else:
+            for sub in ast.iter_child_nodes(stmt):
+                if isinstance(sub, ast.expr):
+                    self.scan_expr(sub, held)
+                elif isinstance(sub, ast.stmt):
+                    self.walk_stmt(sub, held)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _lock_ref(self, node: ast.expr) -> Optional[LockRef]:
+        if isinstance(node, ast.Attribute) and _is_lockish(node.attr):
+            return (_desc_of(node.value), node.attr)
+        return None
+
+    def _manual_release_refs(self, finalbody: Sequence[ast.stmt]) -> List[LockRef]:
+        refs = []
+        for stmt in finalbody:
+            if not (isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Call)):
+                continue
+            func = stmt.value.func
+            if isinstance(func, ast.Attribute) and func.attr == "release":
+                ref = self._lock_ref(func.value)
+                if ref is not None:
+                    refs.append(ref)
+        return refs
+
+    def _bind_target(self, tgt: ast.expr, value: ast.expr) -> None:
+        td = _type_desc_from_value(value)
+        if td is None:
+            return
+        if isinstance(tgt, ast.Name):
+            self.fn.local_types.setdefault(tgt.id, []).append(td)
+        elif (
+            isinstance(tgt, ast.Attribute)
+            and _desc_of(tgt.value) == ("self",)
+            and self.fn.cls is not None
+        ):
+            self.fn.cls.attr_types.setdefault(tgt.attr, []).append(td)
+
+    def _store_target(
+        self, tgt: ast.expr, held: Tuple[LockRef, ...], aug: bool = False
+    ) -> None:
+        if isinstance(tgt, ast.Attribute):
+            recv = _desc_of(tgt.value)
+            if recv is not None:
+                self.fn.accesses.append(
+                    Access(recv, tgt.attr, "write", tgt.lineno, held)
+                )
+                self._note_class_attr_write(recv, tgt)
+            else:
+                self.scan_expr(tgt.value, held)
+        elif isinstance(tgt, ast.Subscript):
+            if isinstance(tgt.value, ast.Attribute):
+                recv = _desc_of(tgt.value.value)
+                if recv is not None:
+                    self.fn.accesses.append(
+                        Access(recv, tgt.value.attr, "mutate", tgt.lineno, held)
+                    )
+                    self._mark_mutation(recv, tgt.value.attr)
+            else:
+                self.scan_expr(tgt.value, held)
+            self.scan_expr(tgt.slice, held)
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._store_target(elt, held, aug=aug)
+        elif isinstance(tgt, ast.Name) and aug:
+            pass  # local augment — no attribute involved
+        elif isinstance(tgt, ast.Starred):
+            self._store_target(tgt.value, held, aug=aug)
+
+    def _mark_mutation(self, recv: Desc, attr: str) -> None:
+        """Container mutation counts as a write for init-only detection."""
+        if recv == ("self",) and self.fn.cls is not None and not self.fn.is_init:
+            self.fn.cls.attrs_written_outside_init.add(attr)
+
+    def _note_class_attr_write(self, recv: Desc, tgt: ast.Attribute) -> None:
+        if recv != ("self",) or self.fn.cls is None or self.fn.parent is not None:
+            if recv == ("self",) and self.fn.cls is not None:
+                self.fn.cls.attrs_written_outside_init.add(tgt.attr)
+            return
+        cls = self.fn.cls
+        if self.fn.is_init:
+            cls.attr_first_assign.setdefault(tgt.attr, tgt.lineno)
+        else:
+            cls.attrs_written_outside_init.add(tgt.attr)
+
+    def _record_iteration(self, it: ast.expr, held: Tuple[LockRef, ...]) -> None:
+        if isinstance(it, ast.Attribute):
+            recv = _desc_of(it.value)
+            if recv is not None:
+                self.fn.accesses.append(
+                    Access(recv, it.attr, "iterate", it.lineno, held)
+                )
+
+    # -- expressions --------------------------------------------------------
+
+    def scan_expr(
+        self, node: ast.expr, held: Tuple[LockRef, ...], as_iter: bool = False
+    ) -> None:
+        if isinstance(node, ast.Call):
+            self._scan_call(node, held)
+            return
+        if isinstance(node, ast.Attribute):
+            if not as_iter:  # iteration accesses are recorded by the caller
+                recv = _desc_of(node.value)
+                if recv is not None:
+                    self.fn.accesses.append(
+                        Access(recv, node.attr, "read", node.lineno, held)
+                    )
+            self.scan_expr(node.value, held)
+            return
+        if isinstance(node, ast.Lambda):
+            self.col.collect_lambda(node, self.fn)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                self._record_iteration(gen.iter, held)
+                self.scan_expr(gen.iter, held, as_iter=True)
+                for cond in gen.ifs:
+                    self.scan_expr(cond, held)
+            if isinstance(node, ast.DictComp):
+                self.scan_expr(node.key, held)
+                self.scan_expr(node.value, held)
+            else:
+                self.scan_expr(node.elt, held)
+            return
+        for sub in ast.iter_child_nodes(node):
+            if isinstance(sub, ast.expr):
+                self.scan_expr(sub, held)
+
+    def _scan_call(self, node: ast.Call, held: Tuple[LockRef, ...]) -> None:
+        func = node.func
+        # thread construction
+        if _is_thread_ctor(func):
+            kw = {k.arg: k.value for k in node.keywords if k.arg}
+            self.fn.spawns.append(SpawnEv(
+                target=_desc_of(kw["target"]) if "target" in kw else None,
+                has_daemon="daemon" in kw,
+                has_name="name" in kw,
+                name_label=_const_str(kw.get("name")),
+                line=node.lineno,
+            ))
+            for arg in node.args:
+                self.scan_expr(arg, held)
+            for k in node.keywords:
+                if k.arg != "target":
+                    self.scan_expr(k.value, held)
+            return
+
+        nargs = len(node.args) + len(node.keywords)
+        if isinstance(func, ast.Name):
+            if func.id == "io_callback" and node.args:
+                d = _desc_of(node.args[0])
+                if d is not None:
+                    self.col.xla_seeds.append((self.fn, d))
+            if func.id in _BLOCKING_NAMES:
+                self.fn.blocking.append(BlockEv(func.id, node.lineno, held))
+            self.fn.calls.append(CallEv("name", (func.id,), node.lineno, held))
+        elif isinstance(func, ast.Attribute):
+            recv = _desc_of(func.value)
+            meth = func.attr
+            # blocking call shapes
+            if meth == "sleep" and isinstance(func.value, ast.Name) and \
+                    func.value.id == "time":
+                self.fn.blocking.append(BlockEv("time.sleep", node.lineno, held))
+            elif meth in _BLOCKING_NAMES:
+                self.fn.blocking.append(BlockEv(meth, node.lineno, held))
+            elif recv == ("self",) and meth in _BLOCKING_SELF_ATTRS:
+                self.fn.blocking.append(BlockEv(f"self.{meth}", node.lineno, held))
+            elif meth in _BLOCKING_ZERO_ARG and nargs == 0:
+                self.fn.blocking.append(BlockEv(f".{meth}()", node.lineno, held))
+            if meth == "acquire":
+                # explicit ``lock.acquire()`` participates in lock ordering
+                # unless it is the non-blocking try-lock form, which can
+                # never contribute to a deadlock cycle
+                ref = self._lock_ref(func.value)
+                if ref is not None and not _nonblocking_acquire(node):
+                    self.fn.acquires.append(AcquireEv(ref, node.lineno, held))
+            if meth == "io_callback" and node.args:
+                d = _desc_of(node.args[0])
+                if d is not None:
+                    self.col.xla_seeds.append((self.fn, d))
+            if meth == "submit" and node.args:
+                d = _desc_of(node.args[0])
+                if d is not None:
+                    self.col.worker_seeds.append((self.fn, d))
+            if (
+                isinstance(func.value, ast.Name)
+                and func.value.id in self.col.module.import_mods
+            ):
+                self.fn.calls.append(CallEv(
+                    "modfunc",
+                    (self.col.module.import_mods[func.value.id], meth),
+                    node.lineno, held,
+                ))
+            elif recv is not None:
+                self.fn.calls.append(CallEv("method", (recv, meth), node.lineno, held))
+                # receiver-attribute mutation (self._x.append(...)) / read
+                if isinstance(func.value, ast.Attribute):
+                    inner = _desc_of(func.value.value)
+                    if inner is not None:
+                        kind = "mutate" if meth in _MUTATORS else "read"
+                        self.fn.accesses.append(Access(
+                            inner, func.value.attr, kind, func.value.lineno, held
+                        ))
+                        if kind == "mutate":
+                            self._mark_mutation(inner, func.value.attr)
+            else:
+                self.scan_expr(func.value, held)
+        else:
+            self.scan_expr(func, held)
+        for arg in node.args:
+            self.scan_expr(arg, held)
+        for k in node.keywords:
+            self.scan_expr(k.value, held)
+
+
+class _ModuleCollector:
+    def __init__(self, module: ModuleInfo, analyzer: "_Analyzer") -> None:
+        self.module = module
+        self.an = analyzer
+        self.xla_seeds = analyzer.xla_seeds
+        self.worker_seeds = analyzer.worker_seeds
+        self._lambda_seq = 0
+
+    def collect(self, tree: ast.Module) -> None:
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.Import, ast.ImportFrom)):
+                self._collect_import(stmt)
+            elif isinstance(stmt, ast.ClassDef):
+                self.collect_class(stmt)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.collect_function(stmt, cls=None, parent=None)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                ty = _ann_to_type(stmt.annotation)
+                if ty:
+                    self.module.global_types[stmt.target.id] = ty
+
+    def _collect_import(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.Import):
+            for alias in stmt.names:
+                name = alias.asname or alias.name.split(".")[0]
+                self.module.import_mods[name] = alias.asname and alias.name or alias.name
+        elif isinstance(stmt, ast.ImportFrom) and stmt.module:
+            for alias in stmt.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                # ``from pkg import mod`` can bind a module; record both ways
+                self.module.import_mods.setdefault(
+                    bound, f"{stmt.module}.{alias.name}"
+                )
+                self.module.from_imports[bound] = (stmt.module, alias.name)
+
+    def collect_class(self, node: ast.ClassDef, prefix: str = "") -> None:
+        cls = ClassInfo(
+            name=node.name,
+            qual=f"{self.module.name}:{prefix}{node.name}",
+            path=self.module.path,
+            line=node.lineno,
+            module=self.module,
+        )
+        for base in node.bases:
+            if isinstance(base, ast.Name):
+                cls.bases.append(base.id)
+            elif isinstance(base, ast.Attribute):
+                cls.bases.append(base.attr)
+        if "BaseHTTPRequestHandler" in cls.bases:
+            cls.is_http_handler = True
+        self.module.classes.setdefault(f"{prefix}{node.name}", cls)
+        self.an.register_class(cls)
+        for stmt in node.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.collect_function(stmt, cls=cls, parent=None)
+            elif isinstance(stmt, ast.AnnAssign) and isinstance(stmt.target, ast.Name):
+                attr = stmt.target.id
+                cls.attr_first_assign.setdefault(attr, stmt.lineno)
+                ty = _ann_to_type(stmt.annotation)
+                if ty:
+                    cls.attr_types.setdefault(attr, []).append(("cls", ty))
+                if stmt.value is not None:
+                    self._classify_attr_value(cls, attr, stmt.value)
+            elif isinstance(stmt, ast.Assign):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        cls.attr_first_assign.setdefault(tgt.id, stmt.lineno)
+                        self._classify_attr_value(cls, tgt.id, stmt.value)
+
+    def _classify_attr_value(self, cls: ClassInfo, attr: str, value: ast.expr) -> None:
+        kind = _lock_value_kind(value)
+        if kind == "lock" and _is_lockish(attr):
+            cls.lock_attrs.add(attr)
+        elif kind == "sync":
+            cls.sync_attrs.add(attr)
+        elif kind == "deque":
+            cls.deque_attrs.add(attr)
+
+    def collect_function(
+        self,
+        node: ast.stmt,
+        cls: Optional[ClassInfo],
+        parent: Optional[FunctionInfo],
+    ) -> None:
+        assert isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        if parent is not None:
+            qual = f"{parent.qual}.{node.name}"
+        elif cls is not None:
+            qual = f"{cls.qual}.{node.name}"
+        else:
+            qual = f"{self.module.name}:{node.name}"
+        fn = FunctionInfo(
+            name=node.name, qual=qual, path=self.module.path,
+            line=node.lineno, module=self.module, cls=cls, parent=parent,
+            is_init=(node.name == "__init__" and cls is not None and parent is None),
+        )
+        guarded, label, is_prop = self._decorations(node)
+        fn.guarded_locks = tuple(guarded)
+        fn.entry_label = label
+        fn.is_property = is_prop
+        args = node.args
+        params = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        for a in params:
+            if a.arg in ("self", "cls"):
+                continue
+            ty = _ann_to_type(a.annotation)
+            if ty:
+                fn.param_types[a.arg] = ty
+        if parent is not None:
+            parent.nested[node.name] = fn
+        elif cls is not None:
+            cls.methods[node.name] = fn
+        else:
+            self.module.functions.setdefault(node.name, fn)
+        self.an.register_function(fn)
+        _FunctionWalker(fn, self).walk_body(node.body, held=())
+        if cls is not None and parent is None and node.name == "__init__":
+            self._classify_init_attrs(cls, node)
+
+    def _decorations(self, node) -> Tuple[List[str], Optional[str], bool]:
+        guarded: List[str] = []
+        label: Optional[str] = None
+        is_prop = False
+        for dec in node.decorator_list:
+            if isinstance(dec, ast.Name) and dec.id == "property":
+                is_prop = True
+            elif isinstance(dec, ast.Call):
+                dname = None
+                if isinstance(dec.func, ast.Name):
+                    dname = dec.func.id
+                elif isinstance(dec.func, ast.Attribute):
+                    dname = dec.func.attr
+                arg = _const_str(dec.args[0]) if dec.args else None
+                if dname == "guarded_by" and arg:
+                    guarded.append(arg)
+                elif dname == "thread_entrypoint" and arg:
+                    label = arg
+        return guarded, label, is_prop
+
+    def _classify_init_attrs(self, cls: ClassInfo, node) -> None:
+        for stmt in ast.walk(node):
+            value = None
+            targets: List[ast.expr] = []
+            if isinstance(stmt, ast.Assign):
+                value, targets = stmt.value, list(stmt.targets)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                value, targets = stmt.value, [stmt.target]
+            if value is None:
+                continue
+            for tgt in targets:
+                if not (
+                    isinstance(tgt, ast.Attribute)
+                    and _desc_of(tgt.value) == ("self",)
+                ):
+                    continue
+                self._classify_attr_value(cls, tgt.attr, value)
+                note = self.module.line_notes.get(tgt.lineno)
+                if note:
+                    kind, text = note
+                    if kind == "lock-free":
+                        cls.ann_lock_free[tgt.attr] = text
+                    elif kind == "guarded_by":
+                        cls.ann_guarded[tgt.attr] = text
+                    elif kind == "blocking-ok" and _is_lockish(tgt.attr):
+                        cls.blocking_ok[tgt.attr] = text
+
+    def collect_lambda(self, node: ast.Lambda, parent: FunctionInfo) -> None:
+        self._lambda_seq += 1
+        name = f"<lambda:{node.lineno}:{self._lambda_seq}>"
+        fn = FunctionInfo(
+            name=name, qual=f"{parent.qual}.{name}", path=self.module.path,
+            line=node.lineno, module=self.module, cls=parent.cls, parent=parent,
+        )
+        parent.nested[name] = fn
+        self.an.register_function(fn)
+        _FunctionWalker(fn, self).scan_expr(node.body, held=())
+
+
+# ---------------------------------------------------------------------------
+# Phase B — package-wide resolution + rules
+# ---------------------------------------------------------------------------
+
+_AMBIGUOUS = object()
+
+
+class _Analyzer:
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.class_index: Dict[str, Any] = {}  # bare name -> ClassInfo|_AMBIGUOUS
+        self.functions: List[FunctionInfo] = []
+        self.xla_seeds: List[Tuple[FunctionInfo, Desc]] = []
+        self.worker_seeds: List[Tuple[FunctionInfo, Desc]] = []
+        self.findings: List[ConcurrencyFinding] = []
+        self.entrypoints: List[Dict[str, Any]] = []
+
+    # -- registration -------------------------------------------------------
+
+    def register_class(self, cls: ClassInfo) -> None:
+        cur = self.class_index.get(cls.name)
+        if cur is None:
+            self.class_index[cls.name] = cls
+        elif cur is not cls:
+            self.class_index[cls.name] = _AMBIGUOUS
+
+    def register_function(self, fn: FunctionInfo) -> None:
+        self.functions.append(fn)
+
+    # -- input --------------------------------------------------------------
+
+    def add_module(self, path: str, source: str) -> None:
+        name = path[:-3] if path.endswith(".py") else path
+        name = name.replace(os.sep, "/").replace("/", ".")
+        if name.endswith(".__init__"):
+            name = name[: -len(".__init__")]
+        mod = ModuleInfo(path=path, name=name)
+        mod.line_notes = _collect_line_notes(source)
+        try:
+            tree = ast.parse(source, filename=path)
+        except SyntaxError:
+            return  # source_lint reports syntax errors
+        self.modules[name] = mod
+        _ModuleCollector(mod, self).collect(tree)
+
+    # -- type resolution ----------------------------------------------------
+
+    def class_by_name(self, name: Optional[str]) -> Optional[ClassInfo]:
+        got = self.class_index.get(name or "")
+        return got if isinstance(got, ClassInfo) else None
+
+    def _resolve_type_desc(
+        self, td: Tuple[Any, ...], fn: FunctionInfo, depth: int
+    ) -> Optional[ClassInfo]:
+        kind = td[0]
+        if kind == "cls":
+            return self.class_by_name(td[1])
+        if kind == "expr":
+            return self.resolve_type(td[1], fn, depth + 1)
+        if kind == "ret":
+            recv = self.resolve_type(td[1], fn, depth + 1)
+            if recv is not None and recv.name == "MetricsRegistry":
+                return self.class_by_name(
+                    {"counter": "Counter", "gauge": "Gauge",
+                     "histogram": "Histogram"}.get(td[2])
+                )
+            return None
+        if kind == "iterelt":
+            # loop variable: element type of the iterated container's
+            # ``List[T]``-style annotation, found by scope walk
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None and depth <= 8:
+                for sub in scope.local_types.get(td[1], ()):
+                    if sub[0] == "elt":
+                        got = self.class_by_name(sub[1])
+                        if got is not None:
+                            return got
+                scope = scope.parent
+            return None
+        return None
+
+    def resolve_type(
+        self, desc: Optional[Desc], fn: FunctionInfo, depth: int = 0
+    ) -> Optional[ClassInfo]:
+        if desc is None or depth > 8:
+            return None
+        if desc[0] == "self":
+            return fn.cls
+        if desc[0] == "name":
+            name = desc[1]
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                for td in scope.local_types.get(name, ()):
+                    got = self._resolve_type_desc(td, scope, depth)
+                    if got is not None:
+                        return got
+                if name in scope.param_types:
+                    return self.class_by_name(scope.param_types[name])
+                scope = scope.parent
+            gty = fn.module.global_types.get(name)
+            if gty:
+                return self.class_by_name(gty)
+            return None
+        if desc[0] == "attr":
+            base = self.resolve_type(desc[1], fn, depth + 1)
+            if base is None:
+                return None
+            attr = desc[2]
+            for c in base.chain():
+                for td in c.attr_types.get(attr, ()):
+                    init = c.methods.get("__init__")
+                    got = self._resolve_type_desc(td, init or fn, depth)
+                    if got is not None:
+                        return got
+            return None
+        return None
+
+    # -- lock canonicalization ----------------------------------------------
+
+    def canon_lock(self, ref: LockRef, fn: FunctionInfo) -> str:
+        recv, attr = ref
+        cls = self.resolve_type(recv, fn)
+        if cls is None:
+            return f"*.{attr}"
+        for c in cls.chain():
+            if attr in c.lock_attrs:
+                return f"{c.name}.{attr}"
+        return f"{cls.name}.{attr}"
+
+    def canon_held(
+        self, held: Tuple[LockRef, ...], fn: FunctionInfo
+    ) -> FrozenSet[str]:
+        return frozenset(self.canon_lock(r, fn) for r in held)
+
+    def class_lock_key(self, cls: ClassInfo, attr: str) -> str:
+        for c in cls.chain():
+            if attr in c.lock_attrs:
+                return f"{c.name}.{attr}"
+        return f"{cls.name}.{attr}"
+
+    def decoration_keys(self, fn: FunctionInfo) -> FrozenSet[str]:
+        keys = set()
+        for lock in fn.guarded_locks:
+            cls = fn.cls
+            if cls is None:
+                for pname, tyname in fn.param_types.items():
+                    got = self.class_by_name(tyname)
+                    if got is not None:
+                        cls = got
+                        break
+            if cls is not None:
+                keys.add(self.class_lock_key(cls, lock))
+            else:
+                keys.add(f"*.{lock}")
+        return frozenset(keys)
+
+    # -- call graph ---------------------------------------------------------
+
+    def resolve_callable(
+        self, desc: Optional[Desc], fn: FunctionInfo
+    ) -> Optional[FunctionInfo]:
+        if desc is None:
+            return None
+        if desc[0] == "name":
+            name = desc[1]
+            scope: Optional[FunctionInfo] = fn
+            while scope is not None:
+                if name in scope.nested:
+                    return scope.nested[name]
+                scope = scope.parent
+            if fn.cls is not None and name in fn.cls.methods:
+                pass  # bare name never binds a method in Python
+            if name in fn.module.functions:
+                return fn.module.functions[name]
+            fi = fn.module.from_imports.get(name)
+            if fi:
+                src = self.modules.get(fi[0])
+                if src:
+                    return src.functions.get(fi[1])
+            return None
+        if desc[0] == "attr":
+            base, meth = desc[1], desc[2]
+            cls = self.resolve_type(base, fn)
+            if cls is not None:
+                for c in cls.chain():
+                    if meth in c.methods:
+                        return c.methods[meth]
+            return None
+        return None
+
+    def resolve_call(
+        self, ev: CallEv, fn: FunctionInfo
+    ) -> Optional[FunctionInfo]:
+        if ev.kind == "name":
+            return self.resolve_callable(("name", ev.data[0]), fn)
+        if ev.kind == "method":
+            recv, meth = ev.data
+            cls = self.resolve_type(recv, fn)
+            if cls is not None:
+                for c in cls.chain():
+                    if meth in c.methods:
+                        return c.methods[meth]
+            return None
+        if ev.kind == "modfunc":
+            modname, name = ev.data
+            mod = self.modules.get(modname)
+            if mod:
+                return mod.functions.get(name)
+            return None
+        return None
+
+    # -- analysis -----------------------------------------------------------
+
+    def run(self) -> ConcurrencyReport:
+        self._resolve_bases()
+        lock_owners = [
+            c
+            for m in self.modules.values()
+            for c in m.classes.values()
+            if any(cc.lock_attrs for cc in c.chain())
+        ]
+        self._seed_labels(lock_owners)
+        self._mark_cross_class_writes()
+        edges = self._build_call_edges()
+        self._propagate_labels(edges)
+        self._fixpoint_entry_must(edges)
+        self._fixpoint_entry_may(edges)
+        class_labels = self._class_labels(lock_owners)
+
+        self._rule_raw_thread()
+        self._rule_discipline(lock_owners, class_labels)
+        self._rule_guarded_call(edges)
+        order_edges, cycles = self._rule_lock_order()
+        self._rule_blocking()
+
+        report = ConcurrencyReport()
+        report.findings = sorted(
+            self.findings, key=lambda f: (f.path, f.line, f.rule, f.message)
+        )
+        report.entrypoints = sorted(
+            self.entrypoints, key=lambda e: (e["path"], e["line"], e["label"])
+        )
+        report.lock_order_edges = order_edges
+        report.lock_order_cycles = cycles
+        for cls in sorted(lock_owners, key=lambda c: c.qual):
+            locks = sorted(
+                {self.class_lock_key(cls, a) for c in cls.chain()
+                 for a in c.lock_attrs}
+            )
+            report.lock_owners[cls.name] = {
+                "path": cls.path,
+                "locks": locks,
+                "threads": sorted(class_labels.get(id(cls), ())),
+            }
+        return report
+
+    def _resolve_bases(self) -> None:
+        for m in self.modules.values():
+            for cls in m.classes.values():
+                for b in cls.bases:
+                    got = self.class_by_name(b)
+                    if got is not None and got is not cls:
+                        cls.resolved_bases.append(got)
+
+    # labels ---------------------------------------------------------------
+
+    def _seed(self, fn: Optional[FunctionInfo], label: str,
+              line: Optional[int] = None) -> None:
+        if fn is None:
+            return
+        fn.seeded = True
+        if label not in fn.labels:
+            fn.labels.add(label)
+            self.entrypoints.append({
+                "function": fn.qual,
+                "path": fn.path,
+                "line": line if line is not None else fn.line,
+                "label": label,
+            })
+
+    def _seed_labels(self, lock_owners: List[ClassInfo]) -> None:
+        for fn in self.functions:
+            if fn.entry_label:
+                self._seed(fn, fn.entry_label)
+            for sp in fn.spawns:
+                target = self.resolve_callable(sp.target, fn)
+                label = sp.name_label or (
+                    f"thread:{target.name}" if target else "thread:?"
+                )
+                self._seed(target, label, sp.line)
+            # closures under routes()/serve() run on HTTP handler threads
+            if fn.parent is not None and fn.parent.name in ("routes", "serve"):
+                self._seed(fn, "http")
+        for fn, desc in self.xla_seeds:
+            self._seed(self.resolve_callable(desc, fn), "xla")
+        for fn, desc in self.worker_seeds:
+            self._seed(self.resolve_callable(desc, fn), "worker")
+        for m in self.modules.values():
+            for cls in m.classes.values():
+                if cls.is_http_handler:
+                    for meth in cls.methods.values():
+                        self._seed(meth, "http")
+        for cls in lock_owners:
+            for name, meth in cls.methods.items():
+                if not name.startswith("_"):
+                    meth.labels.add("main")
+
+    def _mark_cross_class_writes(self) -> None:
+        """Writes/mutations through typed receivers from *other* classes also
+        defeat the init-only exemption (Phase A only sees ``self``)."""
+        for fn in self.functions:
+            for acc in fn.accesses:
+                if acc.kind not in ("write", "mutate"):
+                    continue
+                cls = self.resolve_type(acc.recv, fn)
+                if cls is None:
+                    continue
+                if fn.cls is cls and fn.is_init:
+                    continue
+                cls.attrs_written_outside_init.add(acc.attr)
+
+    def _build_call_edges(
+        self,
+    ) -> List[Tuple[FunctionInfo, FunctionInfo, CallEv]]:
+        edges = []
+        for fn in self.functions:
+            for ev in fn.calls:
+                callee = self.resolve_call(ev, fn)
+                if callee is not None and callee is not fn:
+                    edges.append((fn, callee, ev))
+        return edges
+
+    def _propagate_labels(self, edges) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee, _ev in edges:
+                missing = caller.labels - callee.labels
+                if missing:
+                    callee.labels |= missing
+                    changed = True
+            # closures inherit the labels of the function that defines them
+            for fn in self.functions:
+                for sub in fn.nested.values():
+                    if fn.labels - sub.labels:
+                        sub.labels |= fn.labels
+                        changed = True
+
+    def _class_labels(self, lock_owners) -> Dict[int, Set[str]]:
+        out: Dict[int, Set[str]] = {}
+        for cls in lock_owners:
+            labels: Set[str] = set()
+            for meth in cls.methods.values():
+                labels |= meth.labels
+            out[id(cls)] = labels
+        # functions elsewhere that touch a class through a typed receiver
+        for fn in self.functions:
+            for acc in fn.accesses:
+                cls = self.resolve_type(acc.recv, fn)
+                if cls is not None and id(cls) in out:
+                    out[id(cls)] |= fn.labels
+        return out
+
+    # fixpoints ------------------------------------------------------------
+
+    def _fixpoint_entry_must(self, edges) -> None:
+        sites: Dict[int, List[Tuple[FunctionInfo, FrozenSet[str]]]] = {}
+        for caller, callee, ev in edges:
+            sites.setdefault(id(callee), []).append(
+                (caller, self.canon_held(ev.held, caller))
+            )
+        for fn in self.functions:
+            if fn.is_internal:
+                fn.entry_must = None  # TOP
+            else:
+                fn.entry_must = self.decoration_keys(fn)
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions:
+                if not fn.is_internal:
+                    continue
+                fn_sites = sites.get(id(fn))
+                if not fn_sites:
+                    continue
+                acc: Optional[FrozenSet[str]] = None
+                for caller, held in fn_sites:
+                    if caller.entry_must is None:
+                        continue  # TOP caller imposes no constraint yet
+                    avail = caller.entry_must | held
+                    acc = avail if acc is None else (acc & avail)
+                if acc is not None:
+                    acc = acc | self.decoration_keys(fn)
+                    if fn.entry_must is None or acc != fn.entry_must:
+                        # monotone: sets only shrink from TOP, so this converges
+                        fn.entry_must = acc
+                        changed = True
+        for fn in self.functions:
+            if fn.entry_must is None:
+                fn.entry_must = self.decoration_keys(fn)
+
+    def _fixpoint_entry_may(self, edges) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for caller, callee, ev in edges:
+                flow = (
+                    caller.entry_may
+                    | set(self.canon_held(ev.held, caller))
+                    | set(caller.entry_must or ())
+                )
+                missing = flow - callee.entry_may
+                if missing:
+                    callee.entry_may |= missing
+                    changed = True
+
+    # rules ----------------------------------------------------------------
+
+    def _exempt_cli(self, path: str) -> bool:
+        p = path.replace(os.sep, "/")
+        return "/cli/" in p or p.startswith("cli/")
+
+    def _rule_raw_thread(self) -> None:
+        for fn in self.functions:
+            if self._exempt_cli(fn.path):
+                continue
+            for sp in fn.spawns:
+                missing = [k for k, ok in (("daemon", sp.has_daemon),
+                                           ("name", sp.has_name)) if not ok]
+                if missing:
+                    self.findings.append(ConcurrencyFinding(
+                        fn.path, sp.line, "raw-thread",
+                        f"threading.Thread without {' and '.join(missing)} — "
+                        "every serving-plane thread must be daemonized and "
+                        "named for the watchdog/telemetry surface",
+                    ))
+
+    def _attr_lookup(self, cls: ClassInfo, attr: str):
+        """(defining_class, info) for ``attr`` across the inheritance chain."""
+        for c in cls.chain():
+            if (
+                attr in c.attr_first_assign
+                or attr in c.attrs_written_outside_init
+                or attr in c.attr_types
+            ):
+                return c
+        return None
+
+    def _required_lock(self, cls: ClassInfo, attr: str) -> Optional[str]:
+        owner = self._attr_lookup(cls, attr) or cls
+        ann = None
+        for c in cls.chain():
+            if attr in c.ann_guarded:
+                ann = c.ann_guarded[attr]
+                break
+        if ann:
+            return self.class_lock_key(cls, ann)
+        locks: List[str] = []
+        for c in (owner,) + tuple(owner.chain()[1:]) + tuple(cls.chain()):
+            for la in c.lock_attrs:
+                key = self.class_lock_key(c, la)
+                if key not in locks:
+                    locks.append(key)
+        if not locks:
+            return None
+        for key in locks:
+            if key.endswith("._lock"):
+                return key
+        return locks[0]
+
+    def _attr_exempt(self, cls: ClassInfo, attr: str) -> bool:
+        for c in cls.chain():
+            if attr in c.lock_attrs or attr in c.sync_attrs:
+                return True
+            if attr in c.ann_lock_free:
+                return True
+        # init-only attributes (never written outside __init__) are
+        # effectively frozen after construction
+        written_outside = any(
+            attr in c.attrs_written_outside_init for c in cls.chain()
+        )
+        known = any(
+            attr in c.attr_first_assign or attr in c.attr_types
+            for c in cls.chain()
+        )
+        if known and not written_outside:
+            return True
+        if not known:
+            return True  # property/descriptor or dynamic — not a data attr
+        return False
+
+    def _held_satisfies(self, required: str, held: Set[str]) -> bool:
+        if required in held:
+            return True
+        attr = required.rsplit(".", 1)[1]
+        return f"*.{attr}" in held or any(
+            h.startswith("*.") and h.rsplit(".", 1)[1] == attr for h in held
+        )
+
+    def _rule_discipline(self, lock_owners, class_labels) -> None:
+        owner_ids = {id(c) for c in lock_owners}
+        for fn in self.functions:
+            if fn.is_init:
+                continue  # construction precedes sharing
+            for acc in fn.accesses:
+                cls = self.resolve_type(acc.recv, fn)
+                if cls is None or id(cls) not in owner_ids:
+                    continue
+                if len(class_labels.get(id(cls), ())) < 2:
+                    continue  # not reachable from two threads
+                attr = acc.attr
+                # attribute names that are methods/properties are call
+                # surfaces, not data accesses
+                if any(attr in c.methods for c in cls.chain()):
+                    continue
+                if self._attr_exempt(cls, attr):
+                    continue
+                # site-level waiver: a trailing ``# lock-free: <reason>`` on
+                # the accessing line documents a deliberate lockless read
+                # (e.g. a monotonic-terminal-state check that must not take
+                # the lock to preserve the pinned acquisition order)
+                note = fn.module.line_notes.get(acc.line)
+                if note is not None and note[0] == "lock-free":
+                    continue
+                required = self._required_lock(cls, attr)
+                if required is None:
+                    continue
+                held = set(self.canon_held(acc.held, fn)) | set(fn.entry_must or ())
+                if self._held_satisfies(required, held):
+                    continue
+                is_deque = any(attr in c.deque_attrs for c in cls.chain())
+                if acc.kind == "iterate" and is_deque:
+                    rule = "ring-iteration"
+                    msg = (
+                        f"iterating ring buffer {cls.name}.{attr} outside "
+                        f"{required} — cross-thread readers must use a "
+                        "snapshot_* method"
+                    )
+                elif acc.kind in ("write", "mutate"):
+                    rule = "unguarded-write"
+                    msg = (
+                        f"{acc.kind} of {cls.name}.{attr} outside {required} "
+                        f"(class is reachable from threads: "
+                        f"{', '.join(sorted(class_labels[id(cls)]))})"
+                    )
+                else:
+                    rule = "unguarded-read"
+                    msg = (
+                        f"read of {cls.name}.{attr} outside {required} — "
+                        "hold the lock or annotate the attribute "
+                        "`# lock-free: <reason>`"
+                    )
+                self.findings.append(
+                    ConcurrencyFinding(fn.path, acc.line, rule, msg)
+                )
+
+    def _rule_guarded_call(self, edges) -> None:
+        for caller, callee, ev in edges:
+            need = self.decoration_keys(callee)
+            if not need:
+                continue
+            held = (
+                set(self.canon_held(ev.held, caller))
+                | set(caller.entry_must or ())
+            )
+            for req in sorted(need):
+                if not self._held_satisfies(req, held):
+                    self.findings.append(ConcurrencyFinding(
+                        caller.path, ev.line, "guarded-call",
+                        f"call of {callee.qual} requires {req} "
+                        f"(@guarded_by) but the call site does not hold it",
+                    ))
+
+    def _rule_lock_order(self):
+        edge_sites: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        for fn in self.functions:
+            if fn.is_init:
+                continue
+            for acq in fn.acquires:
+                to_key = self.canon_lock(acq.ref, fn)
+                if to_key.startswith("*."):
+                    continue
+                from_keys = (
+                    set(self.canon_held(acq.held_before, fn))
+                    | set(fn.entry_must or ())
+                    | fn.entry_may
+                )
+                for fk in from_keys:
+                    if fk.startswith("*.") or fk == to_key:
+                        if fk == to_key:
+                            edge_sites.setdefault((fk, to_key),
+                                                  (fn.path, acq.line))
+                        continue
+                    edge_sites.setdefault((fk, to_key), (fn.path, acq.line))
+        graph: Dict[str, Set[str]] = {}
+        for (a, b) in edge_sites:
+            graph.setdefault(a, set()).add(b)
+            graph.setdefault(b, set())
+        cycles: List[List[str]] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+
+        def dfs(node: str, stack: List[str], on_stack: Set[str],
+                done: Set[str]) -> None:
+            on_stack.add(node)
+            stack.append(node)
+            for nxt in sorted(graph.get(node, ())):
+                if nxt in on_stack:
+                    cyc = stack[stack.index(nxt):] + [nxt]
+                    key = tuple(sorted(set(cyc)))
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        cycles.append(cyc)
+                elif nxt not in done:
+                    dfs(nxt, stack, on_stack, done)
+            on_stack.discard(node)
+            stack.pop()
+            done.add(node)
+
+        done: Set[str] = set()
+        for node in sorted(graph):
+            if node not in done:
+                dfs(node, [], set(), done)
+        for cyc in cycles:
+            first_edge = (cyc[0], cyc[1]) if len(cyc) > 1 else (cyc[0], cyc[0])
+            path, line = edge_sites.get(first_edge, ("<package>", 0))
+            self.findings.append(ConcurrencyFinding(
+                path, line, "lock-order-cycle",
+                "lock acquisition order cycle (deadlock potential): "
+                + " -> ".join(cyc),
+            ))
+        edges_out = [
+            {"from": a, "to": b, "path": p, "line": ln}
+            for (a, b), (p, ln) in sorted(edge_sites.items())
+            if a != b
+        ]
+        return edges_out, cycles
+
+    def _blocking_ok(self, key: str) -> bool:
+        if key.startswith("*."):
+            return True  # unresolvable — stay quiet rather than guess
+        cname, attr = key.rsplit(".", 1)
+        cls = self.class_by_name(cname)
+        if cls is None:
+            return False
+        return any(attr in c.blocking_ok for c in cls.chain())
+
+    def _rule_blocking(self) -> None:
+        for fn in self.functions:
+            if fn.is_init:
+                continue
+            for ev in fn.blocking:
+                held = (
+                    set(self.canon_held(ev.held, fn))
+                    | set(fn.entry_must or ())
+                    | fn.entry_may
+                )
+                offending = sorted(
+                    k for k in held if not self._blocking_ok(k)
+                )
+                if offending:
+                    self.findings.append(ConcurrencyFinding(
+                        fn.path, ev.line, "blocking-under-lock",
+                        f"blocking call {ev.what} while holding "
+                        f"{', '.join(offending)} — move it outside the lock "
+                        "or annotate the lock `# blocking-ok: <reason>`",
+                    ))
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def analyze_sources(modules: Sequence[Tuple[str, str]]) -> ConcurrencyReport:
+    """Analyze ``(path, source)`` pairs as one package and return the report."""
+    an = _Analyzer()
+    for path, source in modules:
+        an.add_module(path, source)
+    return an.run()
+
+
+def analyze_paths(
+    roots: Sequence[str], repo_root: Optional[str] = None
+) -> ConcurrencyReport:
+    """Analyze every ``.py`` file under ``roots`` as one package."""
+    pairs = []
+    for path in iter_py_files(roots):
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        rel = os.path.relpath(path, repo_root) if repo_root else path
+        pairs.append((rel, source))
+    return analyze_sources(pairs)

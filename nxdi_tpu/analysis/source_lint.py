@@ -19,6 +19,11 @@ match ruff/pyflakes, so both tools agree on what is clean.
   telemetry registry (``nxdi_tpu/telemetry``) so serving processes control
   their streams; ``nxdi_tpu/cli/`` and top-level ``scripts/``/``bench.py``
   are exempt — stdout IS their interface.
+- **NXD001** (repo-local rule, no ruff analog): a ``threading.Thread(...)``
+  construction in ``nxdi_tpu/`` core missing ``daemon=`` or ``name=``.
+  Same exemptions as T201. The concurrency auditor
+  (:mod:`nxdi_tpu.analysis.concurrency`) enforces the identical contract
+  package-wide as its ``raw-thread`` rule.
 """
 
 from __future__ import annotations
@@ -275,6 +280,49 @@ def bare_prints(path: str, source: str) -> List[LintError]:
 
 
 # ---------------------------------------------------------------------------
+# NXD001 — no bare threading.Thread in nxdi_tpu core
+# ---------------------------------------------------------------------------
+
+def _is_thread_call(node: ast.Call) -> bool:
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr == "Thread":
+        return isinstance(f.value, ast.Name) and f.value.id == "threading"
+    return isinstance(f, ast.Name) and f.id == "Thread"
+
+
+def bare_threads(path: str, source: str) -> List[LintError]:
+    """``threading.Thread(...)`` in nxdi_tpu core without BOTH ``daemon=``
+    and ``name=`` keywords (cli/ exempt, mirroring T201). Anonymous
+    non-daemon threads dodge the watchdog/telemetry surface and can pin a
+    shutdown; the concurrency auditor enforces the same contract with its
+    ``raw-thread`` rule — this is the per-file fast path. Silence an
+    intentional one with ``# noqa: NXD001``."""
+    if not _is_core_path(path):
+        return []
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []  # F401/F821 already report the syntax error
+    noqa = _noqa_lines(source, "NXD001")
+    out = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and _is_thread_call(node)):
+            continue
+        if node.lineno in noqa:
+            continue
+        kwargs = {k.arg for k in node.keywords if k.arg}
+        missing = [k for k in ("daemon", "name") if k not in kwargs]
+        if missing:
+            out.append(LintError(
+                path, node.lineno, "NXD001",
+                f"threading.Thread without {' and '.join(missing)} in "
+                "nxdi_tpu core — serving-plane threads must be daemonized "
+                "and named (cli/ and scripts/ are exempt)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -283,6 +331,7 @@ def lint_source(path: str, source: str) -> List[LintError]:
         unused_imports(path, source)
         + undefined_names(path, source)
         + bare_prints(path, source)
+        + bare_threads(path, source)
     )
 
 

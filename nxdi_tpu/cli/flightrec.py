@@ -274,13 +274,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     _note(args.quiet, f"[flightrec] {json.dumps(summary)}")
 
     fl = engine.flight
-    records = [r.to_dict() for r in fl.records]
+    records = [r.to_dict() for r in fl.snapshot_records()]
     if args.format == "json":
         print(json.dumps({"summary": summary, "step_records": records}, indent=2))
     else:
         _print_timeline(records, args.last)
-    if fl.postmortems:
-        _note(args.quiet, f"[flightrec] trigger-fired bundles: {fl.postmortems}")
+    postmortems = fl.summary()["postmortems"]
+    if postmortems:
+        _note(args.quiet, f"[flightrec] trigger-fired bundles: {postmortems}")
     if args.bundle:
         bundle = fl.postmortem("manual", detail={"source": "cli.flightrec"})
         with open(args.bundle, "w") as f:

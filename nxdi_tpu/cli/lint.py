@@ -18,6 +18,12 @@ Usage:
   # a real checkpoint:
   python -m nxdi_tpu.cli.lint --model-type llama --model-path /ckpt \\
       --tp-degree 8 --seq-len 1024 --on-device-sampling
+
+  # the host-plane concurrency auditor (source-level; no model needed):
+  python -m nxdi_tpu.cli.lint --concurrency
+
+  # both, one merged report:
+  python -m nxdi_tpu.cli.lint --reference-app --all --json report.json
 """
 
 from __future__ import annotations
@@ -57,6 +63,14 @@ def setup_lint_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--const-threshold", type=int, default=None,
                    help="baked-constant size threshold in bytes")
     p.add_argument("--fail-on", choices=["error", "warning"], default="error")
+    p.add_argument("--concurrency", action="store_true",
+                   help="run the host-plane concurrency auditor (lock "
+                        "discipline, lock ordering, thread hygiene) over the "
+                        "nxdi_tpu sources instead of the program audit; "
+                        "needs no model or checkpoint")
+    p.add_argument("--all", dest="run_all", action="store_true",
+                   help="run the program audit AND the concurrency auditor, "
+                        "merged into one JSON report")
     p.add_argument("--json", dest="json_path", default=None,
                    help="write the JSON report here ('-' = stdout, default)")
     p.add_argument("-q", "--quiet", action="store_true",
@@ -134,6 +148,25 @@ def build_checkpoint_app(args, tpu_kwargs: dict):
     return TpuModelForCausalLM(args.model_path, config, model_family=family)
 
 
+def run_concurrency_audit():
+    """The host-plane concurrency auditor over the installed nxdi_tpu tree
+    (source-level, jax-free — lintable from any box)."""
+    import os
+
+    from nxdi_tpu.analysis.concurrency import analyze_paths
+
+    pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return analyze_paths([pkg_dir], repo_root=os.path.dirname(pkg_dir))
+
+
+def _emit(payload: str, json_path: Optional[str]) -> None:
+    if json_path and json_path != "-":
+        with open(json_path, "w") as f:
+            f.write(payload + "\n")
+    else:
+        print(payload)
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m nxdi_tpu.cli.lint",
@@ -141,6 +174,25 @@ def main(argv: Optional[List[str]] = None) -> int:
     )
     setup_lint_parser(parser)
     args = parser.parse_args(argv)
+
+    conc = None
+    if args.concurrency or args.run_all:
+        conc = run_concurrency_audit()
+
+    if args.concurrency and not args.run_all:
+        # source-level only: no app to build, no compiler to invoke
+        _emit(json.dumps(conc.to_dict(), indent=2, sort_keys=True),
+              args.json_path)
+        if not args.quiet:
+            for f in conc.findings:
+                print(str(f), file=sys.stderr)
+            print(
+                f"lint: concurrency audit — {len(conc.findings)} findings, "
+                f"{len(conc.lock_order_cycles)} lock-order cycles, "
+                f"{len(conc.lock_owners)} lock-owning classes",
+                file=sys.stderr,
+            )
+        return 0 if conc.ok else 1
 
     if not args.reference_app and not (args.model_type and args.model_path):
         parser.print_usage(sys.stderr)
@@ -183,12 +235,15 @@ def main(argv: Optional[List[str]] = None) -> int:
         audit_kwargs["const_threshold"] = args.const_threshold
     report = audit_application(app, **audit_kwargs)
 
-    payload = report.to_json(fail_on=args.fail_on)
-    if args.json_path and args.json_path != "-":
-        with open(args.json_path, "w") as f:
-            f.write(payload + "\n")
+    if conc is not None:
+        # --all: one merged report — the program audit's payload plus a
+        # `concurrency` section, failing if either side fails
+        merged = json.loads(report.to_json(fail_on=args.fail_on))
+        merged["concurrency"] = conc.to_dict()
+        payload = json.dumps(merged, indent=2, sort_keys=True)
     else:
-        print(payload)
+        payload = report.to_json(fail_on=args.fail_on)
+    _emit(payload, args.json_path)
 
     if not args.quiet:
         for f in report.findings:
@@ -200,7 +255,16 @@ def main(argv: Optional[List[str]] = None) -> int:
             f"{n_err} errors, {n_warn} warnings",
             file=sys.stderr,
         )
-    return 0 if report.ok(fail_on=args.fail_on) else 1
+        if conc is not None:
+            for f in conc.findings:
+                print(str(f), file=sys.stderr)
+            print(
+                f"lint: concurrency audit — {len(conc.findings)} findings, "
+                f"{len(conc.lock_order_cycles)} lock-order cycles",
+                file=sys.stderr,
+            )
+    ok = report.ok(fail_on=args.fail_on) and (conc is None or conc.ok)
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

@@ -157,8 +157,8 @@ class Router:
         self._inflight: Dict[str, int] = {}
         self._rid_seq = 0
         self._stop = threading.Event()
-        self._poll_thread: Optional[threading.Thread] = None
-        self._server = None
+        self._poll_thread = None  # lock-free: start/stop lifecycle is owner-thread-only
+        self._server = None  # lock-free: start/stop lifecycle is owner-thread-only
 
         # router telemetry — pre-seeded zero per target so absence-of-events
         # is observable from the first scrape, federated into every fleet
@@ -275,6 +275,7 @@ class Router:
             k: v for k, v in payload.items()
             if k not in ("prompt", "request_id", "session_id") and v is not None
         }
+        existing: Optional[RouterRequest] = None
         with self._lock:
             rid = payload.get("request_id")
             if rid is None:
@@ -282,48 +283,69 @@ class Router:
                 rid = f"rt-{self._rid_seq}"
             rid = str(rid)
             existing = self._requests.get(rid)
-            if existing is not None:
-                # router-level duplicate-suppression: same id = same request
+        if existing is not None:
+            # router-level duplicate-suppression: same id = same request.
+            # The snapshot is taken under the REQUEST lock, outside the
+            # router lock (pinned order: request -> router, never nested
+            # the other way).
+            with existing._lock:
                 return 200, dict(existing.to_dict(), status="duplicate")
         signals = self._signals()
+        evicted: List[RouterRequest] = []
         with self._lock:
             # re-check under the lock: a concurrent twin submit may have
             # registered the id while the signals were being fetched
             existing = self._requests.get(rid)
-            if existing is not None:
+            if existing is None:
+                candidates = role_candidates(
+                    dispatchable(signals, draining=self._draining), "prompt"
+                )
+                if not candidates:
+                    return 503, {
+                        "error": "no_replicas",
+                        "states": {
+                            r.label: r.state for r in self.monitor.replicas
+                        },
+                        "draining": sorted(self._draining),
+                    }
+                if should_shed(candidates, self.config.shed_queue_depth):
+                    self.sheds_total.inc()
+                    return 429, {
+                        "error": "shed",
+                        "watermark": self.config.shed_queue_depth,
+                        "queue_depths": {
+                            s.replica: s.queue_depth for s in candidates
+                        },
+                    }
+                req = RouterRequest(
+                    rid, list(prompt), session_id=session_id, params=params
+                )
+                self._requests[rid] = req
+                self._order.append(rid)
+                evicted = self._evict_finished()
+        if existing is not None:
+            with existing._lock:
                 return 200, dict(existing.to_dict(), status="duplicate")
-            candidates = role_candidates(
-                dispatchable(signals, draining=self._draining), "prompt"
-            )
-            if not candidates:
-                return 503, {
-                    "error": "no_replicas",
-                    "states": {r.label: r.state for r in self.monitor.replicas},
-                    "draining": sorted(self._draining),
-                }
-            if should_shed(candidates, self.config.shed_queue_depth):
-                self.sheds_total.inc()
-                return 429, {
-                    "error": "shed",
-                    "watermark": self.config.shed_queue_depth,
-                    "queue_depths": {
-                        s.replica: s.queue_depth for s in candidates
-                    },
-                }
-            req = RouterRequest(
-                rid, list(prompt), session_id=session_id, params=params
-            )
-            self._requests[rid] = req
-            self._order.append(rid)
-            self._evict_finished()
-        with req.lock:
+        # live victims are finished OUTSIDE the router lock, each under its
+        # own request lock — finishing them inline used to race concurrent
+        # stream syncs and nested request-lock work under the router lock
+        for victim in evicted:
+            with victim._lock:
+                victim.finish("error", "evicted: router request table overflow")
+                failed = victim.replica
+            if failed is not None:
+                with self._lock:
+                    self._set_inflight(failed, -1)
+        with req._lock:
             return self._dispatch(req, signals)
 
-    def _evict_finished(self) -> None:
+    def _evict_finished(self) -> List[RouterRequest]:
         # caller holds self._lock; finished requests evict first, and the
         # bound is HARD: if every record is somehow live past the cap, the
-        # oldest is error-finished and dropped (a network frontend must
-        # not grow without bound because clients stopped polling)
+        # oldest is dropped from the table and returned for the caller to
+        # error-finish once the router lock is released (a network frontend
+        # must not grow without bound because clients stopped polling)
+        victims: List[RouterRequest] = []
         while len(self._requests) > self.config.max_requests:
             for i, rid in enumerate(self._order):
                 r = self._requests.get(rid)
@@ -333,18 +355,16 @@ class Router:
                     break
             else:
                 rid = self._order.pop(0)
-                req = self._requests.pop(rid)
-                req.finish("error", "evicted: router request table overflow")
-                if req.replica is not None:
-                    self._set_inflight(req.replica, -1)
+                victims.append(self._requests.pop(rid))
                 logger.warning(
-                    "router: evicted live request %s (table over "
+                    "router: evicting live request %s (table over "
                     "max_requests=%d)", rid, self.config.max_requests,
                 )
+        return victims
 
     def _dispatch(self, req: RouterRequest, signals) -> Tuple[int, dict]:
         """Place ``req`` on the best dispatchable replica, walking down the
-        ranking on per-replica submit failures. Called with ``req.lock``
+        ranking on per-replica submit failures. Called with ``req._lock``
         held; finishes the request with reason ``"error"`` when nothing
         can take it."""
         while True:
@@ -417,13 +437,14 @@ class Router:
         """Proxied token poll: returns delivered tokens past ``cursor``.
         The upstream sync — and any failover it triggers — happens inline,
         so a polling client IS the failure detector's clock."""
+        req: Optional[RouterRequest] = None
         with self._lock:
             req = self._requests.get(str(rid))
         if req is None:
             return 404, {"error": "unknown request", "request_id": rid}
         cursor = max(int(cursor), 0)
         req.touch()  # the background sweep skips client-attended requests
-        with req.lock:
+        with req._lock:
             if not req.done:
                 self._sync(req)
             toks = list(req.delivered[cursor:])
@@ -440,7 +461,7 @@ class Router:
 
     def _sync(self, req: RouterRequest) -> None:
         """Pull new tokens from the request's replica; detect its death and
-        fail over. Called with ``req.lock`` held."""
+        fail over. Called with ``req._lock`` held."""
         if req.handoff_src is not None and req.replica != req.handoff_src:
             # an earlier ack never landed: the prefill side still parks the
             # (already imported) chain — retry the release before polling
@@ -515,7 +536,7 @@ class Router:
     def _handoff(self, req: RouterRequest) -> None:
         """The prefill replica parked ``req`` with its KV chain and first
         sampled token ready: fetch the wire payload and place it on a
-        decode replica. Called with ``req.lock`` held. The prefill side
+        decode replica. Called with ``req._lock`` held. The prefill side
         RETAINS the chain until the ack lands, so any failure in here is
         recoverable — the next poll simply retries the whole move."""
         prefill = req.replica
@@ -556,7 +577,7 @@ class Router:
     def _place_handoff(self, req: RouterRequest, wire, t0: float) -> None:
         """Import the fetched KV payload into a decode replica, walking the
         KV-pressure-weighted ranking on transient failures. Called with
-        ``req.lock`` held and ``req.handoff_src`` set (the chain is still
+        ``req._lock`` held and ``req.handoff_src`` set (the chain is still
         retained upstream — returning without placing is always safe)."""
         tried_round: List[str] = []
         while True:
@@ -654,7 +675,7 @@ class Router:
         replay on the next-ranked replica, duplicate-suppressed by
         request_id, already-delivered tokens never re-sent (the new
         upstream is polled from cursor ``len(delivered)``). Called with
-        ``req.lock`` held.
+        ``req._lock`` held.
 
         Disaggregation special case: when the DECODE replica dies before
         the retention ack released the prefill side (``handoff_src`` still
@@ -805,7 +826,7 @@ class Router:
         if self._poll_thread is None:
             self._stop.clear()
             self._poll_thread = threading.Thread(
-                target=self._poll_loop, daemon=True
+                target=self._poll_loop, daemon=True, name="nxdi-router-poll"
             )
             self._poll_thread.start()
         return self
@@ -829,7 +850,9 @@ class Router:
 
         now = _time.monotonic()
         with self._lock:
-            stale = sorted(
+            # staleness selection reads are deliberately lockless: a torn
+            # ``last_poll_s`` only reorders sweep candidates for one tick
+            stale: List[RouterRequest] = sorted(
                 (
                     r for r in self._requests.values()
                     if not r.done
@@ -838,13 +861,13 @@ class Router:
                 key=lambda r: r.last_poll_s,
             )[:limit]
         for req in stale:
-            if not req.lock.acquire(blocking=False):
+            if not req._lock.acquire(blocking=False):
                 continue  # a client poll is syncing it right now
             try:
                 if not req.done:
                     self._sync(req)
             finally:
-                req.lock.release()
+                req._lock.release()
 
     def stop(self) -> None:
         self._stop.set()

@@ -51,8 +51,11 @@ import logging
 import threading
 import time
 from collections import OrderedDict, deque
-from typing import Deque, Dict, Optional
+from typing import TYPE_CHECKING, Deque, Dict
 from urllib.parse import parse_qs, urlsplit
+
+if TYPE_CHECKING:  # import cycle: serving.engine pulls the router package
+    from nxdi_tpu.serving.engine import InferenceEngine
 
 logger = logging.getLogger("nxdi_tpu")
 
@@ -74,7 +77,7 @@ class ReplicaIngest:
     memory, so it should comfortably exceed the retry window.
     """
 
-    def __init__(self, engine, max_records: int = 4096,
+    def __init__(self, engine: "InferenceEngine", max_records: int = 4096,
                  step_delay_s: float = 0.0, idle_sleep_s: float = 0.002):
         self.engine = engine
         self.telemetry = getattr(engine, "telemetry", None)
@@ -94,13 +97,15 @@ class ReplicaIngest:
         self._rid_seq = 0  # fallback ids for clients that submit without one
         self._stop = threading.Event()
         self._wake = threading.Event()
-        self._thread: Optional[threading.Thread] = None
-        self._server = None
+        self._thread = None  # lock-free: start/stop lifecycle is owner-thread-only
+        self._server = None  # lock-free: start/stop lifecycle is owner-thread-only
 
     # -- lifecycle -----------------------------------------------------------
     def start(self) -> "ReplicaIngest":
         if self._thread is None:
-            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="nxdi-ingest-driver"
+            )
             self._thread.start()
         return self
 

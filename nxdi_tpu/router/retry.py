@@ -24,7 +24,9 @@ mirroring the engine's own preemption semantics one tier up:
 
 The decision helpers (:func:`should_failover`, :func:`exhausted`) are pure
 so the unit tests pin them with injected states; the
-:class:`~nxdi_tpu.router.frontend.Router` owns when they run.
+:class:`~nxdi_tpu.router.frontend.Router` owns when they run. Both carry
+``@guarded_by("_lock")``: the concurrency auditor verifies every call site
+holds the request's lock.
 """
 
 from __future__ import annotations
@@ -33,6 +35,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from nxdi_tpu.analysis.concurrency import guarded_by
 from nxdi_tpu.telemetry.fleet import UNREACHABLE
 
 #: router-request lifecycle (the upstream engine keeps its own WAITING/
@@ -45,7 +48,7 @@ FAILED = "FAILED"
 
 
 class RouterRequest:
-    """One request's router-side bookkeeping. ``lock`` serializes stream
+    """One request's router-side bookkeeping. ``_lock`` serializes stream
     syncs for the same request from concurrent client polls; the router's
     global lock is never held while this one is (lock order: request ->
     router, acquired disjointly)."""
@@ -81,20 +84,28 @@ class RouterRequest:
         #: went away, so an abandoned request can never pin in-flight
         #: accounting or table space forever
         self.last_poll_s = time.monotonic()
-        self.lock = threading.Lock()
+        # Only sibling polls of the SAME request ever wait on this lock:
+        self._lock = threading.Lock()  # blocking-ok: serializes the request's own upstream HTTP sync
 
     def touch(self) -> None:
-        self.last_poll_s = time.monotonic()
+        with self._lock:
+            self.last_poll_s = time.monotonic()
 
     @property
     def done(self) -> bool:
-        return self.state in (DONE, FAILED)
+        # Deliberately lockless: the router reads ``done`` while holding its
+        # OWN lock (eviction/sweep selection), and taking the request lock
+        # there would invert the pinned request -> router order. DONE/FAILED
+        # are terminal, so a stale answer only delays a decision.
+        return self.state in (DONE, FAILED)  # lock-free: terminal states are monotonic
 
+    @guarded_by("_lock")
     def assign(self, replica: str) -> None:
         self.replica = replica
         self.state = DISPATCHED
         self.stream_errors = 0
 
+    @guarded_by("_lock")
     def mark_failed_replica(self) -> Optional[str]:
         """Record the current replica as failed; returns it (the failover
         counter's label) and clears the assignment."""
@@ -106,11 +117,13 @@ class RouterRequest:
         self.stream_errors = 0
         return failed
 
+    @guarded_by("_lock")
     def finish(self, reason: str, error: Optional[str] = None) -> None:
         self.state = FAILED if reason == "error" else DONE
         self.finish_reason = reason
         self.error = error
 
+    @guarded_by("_lock")
     def to_dict(self) -> dict:
         return {
             "request_id": self.request_id,
@@ -127,6 +140,7 @@ class RouterRequest:
         }
 
 
+@guarded_by("_lock")
 def should_failover(
     req: RouterRequest, replica_state: Optional[str], stream_failures: int
 ) -> bool:
@@ -140,6 +154,7 @@ def should_failover(
     return req.stream_errors >= stream_failures
 
 
+@guarded_by("_lock")
 def exhausted(
     req: RouterRequest, max_failovers: Optional[int], n_replicas: int
 ) -> bool:

@@ -275,13 +275,20 @@ class FaultPlan:
     def add(self, rule: FaultRule) -> "FaultPlan":
         if not isinstance(rule, FaultRule):
             rule = FaultRule.from_dict(rule)
-        # Stable per-rule stream: crc32 of the site pattern (never the
-        # per-process-salted builtin hash) xor plan seed xor rule index,
-        # so identical plans replay identically in any process.
-        seed = zlib.crc32(rule.site.encode()) ^ self.seed ^ (len(self.rules) << 17)
-        self.rules.append(rule)
-        self._rngs.append(random.Random(seed))
-        self._rule_fired.append(0)
+        # Under the lock: the three parallel lists (rules/_rngs/_rule_fired)
+        # must grow as one unit, or a concurrent ``hit`` from a driver
+        # thread indexes a rule whose rng/fired slot does not exist yet.
+        with self._lock:
+            # Stable per-rule stream: crc32 of the site pattern (never the
+            # per-process-salted builtin hash) xor plan seed xor rule index,
+            # so identical plans replay identically in any process.
+            seed = (
+                zlib.crc32(rule.site.encode()) ^ self.seed
+                ^ (len(self.rules) << 17)
+            )
+            self.rules.append(rule)
+            self._rngs.append(random.Random(seed))
+            self._rule_fired.append(0)
         return self
 
     def injected_total(self) -> int:
@@ -336,7 +343,9 @@ class FaultPlan:
         raise make_error(rule.kind, f"injected {rule.kind} fault at {site}")
 
     def to_dict(self):
-        return {"seed": self.seed, "rules": [r.to_dict() for r in self.rules]}
+        with self._lock:
+            return {"seed": self.seed,
+                    "rules": [r.to_dict() for r in self.rules]}
 
     @classmethod
     def from_dict(cls, d):
@@ -344,7 +353,8 @@ class FaultPlan:
                    seed=d.get("seed", 0))
 
     def __repr__(self):
-        return f"FaultPlan(seed={self.seed}, rules={self.rules!r})"
+        with self._lock:
+            return f"FaultPlan(seed={self.seed}, rules={self.rules!r})"
 
 
 # The armed plan.  Sites guard with a bare ``is not None`` test so the

@@ -91,6 +91,17 @@ class InferenceEngine:
     - **contiguous continuous batching** (``is_continuous_batching``): the
       slot index IS the ``seq_ids`` cache line; admission is slot-bounded
       (every line holds a full ``seq_len``, so decode growth cannot fail).
+
+    **Threading model** (checked by :mod:`nxdi_tpu.analysis.concurrency`):
+    the engine is *single-driver*. Exactly one thread — the ingest driver
+    loop under ``cli.serve``, otherwise the caller's own — invokes
+    ``add_request``/``step``/lifecycle methods, so the engine, its
+    :class:`Scheduler`, the :class:`BlockSpaceManager`, and the handoff
+    buffers deliberately own no locks. Cross-thread probes (the metrics
+    HTTP plane, the router) never touch this state directly: they read
+    through the FlightRecorder's and MetricsRegistry's locked snapshot
+    surfaces, which is why those classes carry ``guarded_by`` annotations
+    and this one does not.
     """
 
     def __init__(

@@ -25,6 +25,12 @@ no token is ever recomputed or lost. Import failures raise
 The replica-side error-record marker is :data:`HANDOFF_FAULT_PREFIX`; the
 router classifies it transient like the PR-14 taxonomy's
 ``TransientDispatchError``.
+
+Threading: the parked-chain table and import paths run entirely on each
+engine's single driver thread (the ingest HTTP handler hands work to the
+driver loop, it does not call in here) — no locks by design; the
+concurrency auditor's thread labeling verifies no second thread reaches
+this state.
 """
 
 from __future__ import annotations

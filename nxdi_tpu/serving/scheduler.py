@@ -112,7 +112,12 @@ class Scheduler:
     """Slot/admission/preemption bookkeeping over an optional
     :class:`~nxdi_tpu.runtime.block_manager.BlockSpaceManager` (paged
     layout) — with ``block_manager=None`` (contiguous seq-id layout)
-    admission is slot-bounded only and growth never fails."""
+    admission is slot-bounded only and growth never fails.
+
+    Lock-free by ownership: queue/slot state is touched only by the
+    engine's single driver thread (see the InferenceEngine threading
+    model); cross-thread observers read the FlightRecorder's locked
+    snapshots, never this object."""
 
     def __init__(
         self,

@@ -328,7 +328,8 @@ class MetricsServer:
 
     def start(self) -> "MetricsServer":
         self._thread = threading.Thread(
-            target=self._server.serve_forever, daemon=True
+            target=self._server.serve_forever, daemon=True,
+            name=f"nxdi-http-{self.port}",
         )
         self._thread.start()
         return self

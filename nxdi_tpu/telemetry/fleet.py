@@ -270,7 +270,7 @@ class FleetMonitor:
         # registries of co-located tiers (the replica router) whose series
         # join every fleet export next to the monitor's own — see
         # attach_registry()
-        self._extra_registries: List[MetricsRegistry] = []
+        self._extra_registries: List[MetricsRegistry] = []  # guarded_by: _lock
         # the monitor's PERSISTENT series (edge counters survive re-merges;
         # the merged member view is rebuilt fresh on every export)
         self.registry = MetricsRegistry()

@@ -170,23 +170,26 @@ class FlightRecorder:
         # raises "mutated during iteration" on the probe surface. The open
         # record (``current``) stays engine-thread-only and lock-free.
         self._lock = threading.Lock()
-        self.records: Deque[StepRecord] = deque()
-        self.records_dropped = 0
-        self.postmortems: List[dict] = []  # {trigger, step, path} (bounded)
+        self.records: Deque[StepRecord] = deque()  # guarded_by: _lock
+        self.records_dropped = 0  # guarded_by: _lock
+        #: {trigger, step, path} — bounded index of captured bundles
+        self.postmortems: List[dict] = []  # guarded_by: _lock
         self._bundle_seq = 0  # monotonic: filenames never collide
-        self.current: Optional[StepRecord] = None
+        self.current: Optional[StepRecord] = None  # lock-free: engine-thread-only open record
         # scheduling events raised BETWEEN steps (a forced preemption from a
         # driver's before_step hook, a direct scheduler call) buffer here
         # and fold into the NEXT step's record — they shape that step's
         # decisions, and nothing may vanish just for arriving early
-        self._pending: List[tuple] = []
-        self._step_counter = 0
+        self._pending: List[tuple] = []  # lock-free: engine-thread-only between-step buffer
+        # ``steps``/bundles read this cross-thread: a single int store is
+        # atomic under the GIL, and a stale count only lags the liveness probe
+        self._step_counter = 0  # lock-free: engine-thread-written monotonic int
         # rolling per-step preemption counts for the storm trigger: O(1)
         # per step instead of rescanning the ring
-        self._recent_preempts: Deque[int] = deque()
-        self._recent_preempt_sum = 0
-        self._storm_fired_step: Optional[int] = None
-        self._seen_violations = (
+        self._recent_preempts: Deque[int] = deque()  # lock-free: engine-thread-only storm window
+        self._recent_preempt_sum = 0  # lock-free: engine-thread-only
+        self._storm_fired_step: Optional[int] = None  # lock-free: engine-thread-only cooldown mark
+        self._seen_violations = (  # lock-free: engine-thread-only retrace cursor
             len(retrace_guard.violations) if retrace_guard is not None else 0
         )
         r = telemetry.registry
@@ -452,7 +455,15 @@ class FlightRecorder:
         else:
             records = self.snapshot_records()
             span_dict = None
-        dropped = tel.spans_dropped_total.total() + self.records_dropped
+        # one lock block for everything the engine thread mutates: the ring
+        # drop counter (end_step bumps it under the lock) and the bundle
+        # sequence number — a torn pair here would misname or misreport a
+        # bundle captured mid-step
+        with self._lock:
+            dropped_ring = self.records_dropped
+            seq = self._bundle_seq
+            self._bundle_seq += 1
+        dropped = tel.spans_dropped_total.total() + dropped_ring
         bundle = {
             "trigger": trigger,
             "detail": detail or {},
@@ -469,9 +480,6 @@ class FlightRecorder:
             "path": None,
         }
         self.postmortems_total.inc(trigger=trigger)
-        with self._lock:
-            seq = self._bundle_seq
-            self._bundle_seq += 1
         if self.postmortem_dir is not None:
             try:
                 os.makedirs(self.postmortem_dir, exist_ok=True)

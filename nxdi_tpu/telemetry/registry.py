@@ -56,7 +56,7 @@ class _Metric:
         self.help = help
         self.label_names = tuple(label_names)
         self._lock = lock
-        self._series: Dict[Tuple[str, ...], object] = {}
+        self._series: Dict[Tuple[str, ...], object] = {}  # guarded_by: _lock
 
     def _key(self, labels: Dict[str, object]) -> Tuple[str, ...]:
         if tuple(sorted(labels)) != tuple(sorted(self.label_names)):
@@ -249,7 +249,7 @@ class MetricsRegistry:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._metrics: Dict[str, _Metric] = {}
+        self._metrics: Dict[str, _Metric] = {}  # guarded_by: _lock
 
     def _register(self, cls, name, help, labels, **kw) -> _Metric:
         with self._lock:

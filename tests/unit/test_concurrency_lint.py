@@ -1,0 +1,247 @@
+"""Concurrency auditor gate (tier-1): lock discipline + lock ordering.
+
+Two halves, mirroring ``test_source_lint.py``:
+
+- **self-tests** — synthetic fixtures seed exactly one violation per rule
+  (unguarded write/read, ring iteration, lock-order cycle, blocking under
+  lock, raw thread, guarded call) and assert the auditor reports it with
+  the right rule id, file, and line;
+- **the gate** — the real ``nxdi_tpu`` tree must be clean with every rule
+  enabled, and the package lock-order graph must stay acyclic with the
+  pinned ``request -> router`` edge direction.
+
+The auditor is stdlib-``ast`` only, so this file never imports jax.
+"""
+
+import os
+import subprocess
+import sys
+
+from nxdi_tpu.analysis.concurrency import analyze_paths, analyze_sources
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _findings(*sources):
+    """analyze_sources over {path: source} pairs given as (path, src)."""
+    return analyze_sources(list(sources))
+
+
+# A lock-owning class reachable from two threads: the module spawns a
+# properly-hygienic thread at import surface so the auditor labels the
+# class {main, worker}.
+_BOX_HEADER = """\
+import threading
+import time
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.items = []
+        self._ring = __import__('collections').deque()
+
+    def worker(self):
+        with self._lock:
+            self.items.append(1)
+
+def start(box: "Box"):
+    t = threading.Thread(target=box.worker, daemon=True, name="w")
+    t.start()
+"""
+
+
+def _rules_of(report):
+    return sorted({f.rule for f in report.findings})
+
+
+# -- self-tests: one seeded violation per rule ------------------------------
+
+def test_unguarded_write_detected():
+    src = _BOX_HEADER + """
+    # (methods below are on Box via re-open in real code; here a module fn)
+def poke(box: "Box"):
+    box.items.append(2)
+"""
+    rep = _findings(("fix/box.py", src))
+    hits = [f for f in rep.findings if f.rule == "unguarded-write"]
+    assert hits, _rules_of(rep)
+    f = hits[0]
+    assert f.path == "fix/box.py" and "Box.items" in f.message
+    assert f.line == src.splitlines().index("    box.items.append(2)") + 1
+
+
+def test_unguarded_read_detected_and_lock_free_waiver():
+    src = _BOX_HEADER + """
+def peek(box: "Box"):
+    return len(box.items)
+"""
+    rep = _findings(("fix/box.py", src))
+    assert any(
+        f.rule == "unguarded-read" and "Box.items" in f.message
+        for f in rep.findings
+    ), _rules_of(rep)
+    # a site-level waiver documents a deliberate lockless read
+    waived = src.replace(
+        "return len(box.items)",
+        "return len(box.items)  # lock-free: len is atomic, estimate only",
+    )
+    rep = _findings(("fix/box.py", waived))
+    assert not any(f.rule == "unguarded-read" for f in rep.findings)
+
+
+def test_ring_iteration_detected():
+    src = _BOX_HEADER + """
+def push(box: "Box"):
+    with box._lock:
+        box._ring.append(1)
+
+def drain(box: "Box"):
+    return [x for x in box._ring]
+"""
+    rep = _findings(("fix/box.py", src))
+    hits = [f for f in rep.findings if f.rule == "ring-iteration"]
+    assert hits, _rules_of(rep)
+    assert "snapshot_" in hits[0].message and "Box._ring" in hits[0].message
+
+
+def test_lock_order_cycle_detected():
+    src = """\
+import threading
+from typing import Optional
+
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+        self.n = 0
+
+    def left_inner(self):
+        with self._lock:
+            self.n += 1
+
+    def left(self):
+        with self._lock:
+            self.n += 1
+            self.b.right_inner()
+
+class B:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.a: Optional["A"] = None
+        self.m = 0
+
+    def right_inner(self):
+        with self._lock:
+            self.m += 1
+
+    def right(self):
+        with self._lock:
+            self.m += 1
+            self.a.left_inner()
+
+def wire(a: "A", b: "B"):
+    t = threading.Thread(target=a.left, daemon=True, name="t1")
+    u = threading.Thread(target=b.right, daemon=True, name="t2")
+    t.start(); u.start()
+"""
+    rep = _findings(("fix/cycle.py", src))
+    hits = [f for f in rep.findings if f.rule == "lock-order-cycle"]
+    assert hits, _rules_of(rep)
+    assert "A._lock" in hits[0].message and "B._lock" in hits[0].message
+    # the cycle is pinned in the report's lock_order section too
+    assert rep.lock_order_cycles
+    cyc = set(rep.lock_order_cycles[0])
+    assert {"A._lock", "B._lock"} <= cyc
+
+
+def test_blocking_under_lock_detected():
+    src = _BOX_HEADER.replace(
+        "        with self._lock:\n            self.items.append(1)",
+        "        with self._lock:\n"
+        "            time.sleep(0.1)\n"
+        "            self.items.append(1)",
+    )
+    rep = _findings(("fix/box.py", src))
+    hits = [f for f in rep.findings if f.rule == "blocking-under-lock"]
+    assert hits, _rules_of(rep)
+    assert "time.sleep" in hits[0].message
+
+
+def test_raw_thread_detected():
+    src = """\
+import threading
+
+def go(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+"""
+    rep = _findings(("fix/raw.py", src))
+    hits = [f for f in rep.findings if f.rule == "raw-thread"]
+    assert hits and hits[0].line == 4
+    assert "daemon" in hits[0].message and "name" in hits[0].message
+
+
+def test_guarded_call_detected():
+    src = _BOX_HEADER + """
+from nxdi_tpu.analysis.concurrency import guarded_by
+
+@guarded_by("_lock")
+def reset(box: "Box"):
+    box.items = []
+
+def careless(box: "Box"):
+    reset(box)
+
+def careful(box: "Box"):
+    with box._lock:
+        reset(box)
+"""
+    rep = _findings(("fix/box.py", src))
+    hits = [f for f in rep.findings if f.rule == "guarded-call"]
+    assert hits, _rules_of(rep)
+    assert "reset" in hits[0].message and "Box._lock" in hits[0].message
+    # exactly the careless site — the locked caller is clean
+    assert len(hits) == 1
+    assert hits[0].line == src.splitlines().index("    reset(box)") + 1
+
+
+def test_thread_labels_and_entrypoints_reported():
+    rep = _findings(("fix/box.py", _BOX_HEADER))
+    assert any(e["label"] == "w" for e in rep.entrypoints)
+    assert "Box" in rep.lock_owners
+    assert set(rep.lock_owners["Box"]["threads"]) >= {"main", "w"}
+
+
+# -- the gate: the real tree is clean ---------------------------------------
+
+def test_nxdi_tpu_tree_is_concurrency_clean():
+    rep = analyze_paths([os.path.join(REPO, "nxdi_tpu")], repo_root=REPO)
+    assert rep.ok, "concurrency violations:\n" + "\n".join(
+        str(f) for f in rep.findings
+    )
+    assert not rep.lock_order_cycles
+
+
+def test_package_lock_order_is_pinned():
+    """The serving plane's one cross-class order: request lock before
+    router lock, never the reverse — the direction ``Router._dispatch``
+    and ``Router._sync`` rely on."""
+    rep = analyze_paths([os.path.join(REPO, "nxdi_tpu")], repo_root=REPO)
+    edges = {(e["from"], e["to"]) for e in rep.lock_order_edges}
+    assert ("RouterRequest._lock", "Router._lock") in edges
+    assert ("Router._lock", "RouterRequest._lock") not in edges
+
+
+def test_cli_lint_concurrency_exits_clean():
+    proc = subprocess.run(
+        [sys.executable, "-m", "nxdi_tpu.cli.lint", "--concurrency", "-q"],
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    import json
+
+    payload = json.loads(proc.stdout)
+    assert payload["ok"] is True
+    assert payload["lock_order"]["cycles"] == []
+    assert "RouterRequest" in payload["lock_owners"]

@@ -408,6 +408,45 @@ def test_watchdog_trip_abandons_worker_and_is_transient():
     wd.shutdown()
 
 
+# ------------------------------------------------------- plan concurrency
+def test_fault_plan_add_is_atomic_under_concurrent_hits():
+    """PR-17 regression (concurrency auditor true positive): ``add`` grows
+    the three parallel lists (rules/_rngs/_rule_fired) as ONE unit under
+    the plan lock. Before the fix a ``hit`` racing an ``add`` could index
+    a rule whose rng/fired slot did not exist yet (IndexError), or tear
+    the seed derivation (len(self.rules) read mid-append)."""
+    plan = FaultPlan(seed=7)
+    stop = threading.Event()
+    errors = []
+
+    def hammer():
+        while not stop.is_set():
+            try:
+                # prob p=0.0 matches every rule but never fires: each hit
+                # walks ALL rules and consumes their rng streams — maximal
+                # overlap with add()'s list growth
+                plan.hit("dispatch.step")
+            except Exception as e:  # pragma: no cover - the regression
+                errors.append(e)
+                return
+
+    threads = [
+        threading.Thread(target=hammer, daemon=True, name=f"nxdi-test-hit{i}")
+        for i in range(2)
+    ]
+    for t in threads:
+        t.start()
+    for _ in range(300):
+        plan.add(FaultRule("dispatch.*", "prob", p=0.0, limit=0))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10.0)
+    assert not errors, errors
+    assert (
+        len(plan.rules) == len(plan._rngs) == len(plan._rule_fired) == 300
+    )
+
+
 # ---------------------------------------------------------- unarmed overhead
 @pytest.mark.slow
 def test_unarmed_site_guard_overhead_abba_smoke():

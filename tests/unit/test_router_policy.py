@@ -517,6 +517,28 @@ def test_request_table_bound_is_hard():
     assert r.request("q4") is not None
 
 
+def test_live_eviction_error_finishes_victim_and_drains_inflight():
+    """PR-17 regression (concurrency auditor true positive): a live
+    overflow victim is error-finished under ITS OWN lock after the router
+    lock is released (pinned order: request -> router), and its in-flight
+    accounting drains — it must not vanish silently mid-dispatch."""
+    a = FakeReplica("a", [1])
+    r = build_fake_router(
+        [a], config=RouterConfig(stream_failures=1, max_requests=2)
+    )
+    r.poll()
+    r.submit({"request_id": "q0", "prompt": [5]})
+    victim = r.request("q0")
+    assert victim is not None and r._inflight["a"] == 1
+    r.submit({"request_id": "q1", "prompt": [5]})
+    r.submit({"request_id": "q2", "prompt": [5]})  # evicts live q0
+    assert r.request("q0") is None
+    assert victim.done and victim.finish_reason == "error"
+    assert "evicted" in victim.error
+    # 3 dispatches, 1 eviction: the victim's in-flight slot is returned
+    assert r._inflight["a"] == 2
+
+
 def test_router_metrics_federate_through_fleet_registry():
     a, b = FakeReplica("a", [1]), FakeReplica("b", [1])
     r = build_fake_router([a, b])

@@ -78,6 +78,48 @@ def test_detects_bare_print_in_core():
     ) == []
 
 
+def test_detects_bare_thread_in_core():
+    src = (
+        "import threading\n"
+        "def f():\n"
+        "    t = threading.Thread(target=f)\n"
+        "    return t\n"
+    )
+    errs = lint_source("nxdi_tpu/router/foo.py", src)
+    assert [e.code for e in errs] == ["NXD001"] and errs[0].line == 3
+    assert "daemon and name" in errs[0].message
+    # one missing keyword is still a violation, named precisely
+    partial = (
+        "import threading\n"
+        "def f():\n"
+        "    return threading.Thread(target=f, daemon=True)\n"
+    )
+    errs = lint_source("nxdi_tpu/router/foo.py", partial)
+    assert [e.code for e in errs] == ["NXD001"] and "name" in errs[0].message
+    # both keywords present -> clean; bare `Thread` name counts too
+    clean = (
+        "from threading import Thread\n"
+        "def f():\n"
+        "    return Thread(target=f, daemon=True, name='nxdi-x')\n"
+    )
+    assert lint_source("nxdi_tpu/router/foo.py", clean) == []
+    # cli/ and scripts/ are exempt, mirroring T201
+    bare = (
+        "import threading\n"
+        "def f():\n"
+        "    return threading.Thread(target=f)\n"
+    )
+    assert lint_source("nxdi_tpu/cli/foo.py", bare) == []
+    assert lint_source("scripts/foo.py", bare) == []
+    # noqa silences an intentional one
+    silenced = (
+        "import threading\n"
+        "def f():\n"
+        "    return threading.Thread(target=f)  # noqa: NXD001\n"
+    )
+    assert lint_source("nxdi_tpu/router/foo.py", silenced) == []
+
+
 def test_closures_globals_and_builtins_not_flagged():
     src = (
         "import os\n"
