@@ -1000,7 +1000,7 @@ def _flag_value(name, default):
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
     replica_id=None, rng=None, sentinel=None, mixed=False, prefix_cache=False,
-    faults=None, role="unified",
+    faults=None, role="unified", trace=True,
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -1034,7 +1034,8 @@ def _build_serving_stack(
         pa_num_blocks=slots * (-(-seq_len // block)) + slots,
         skip_warmup=False,
         slo={"ttft_s": slo_ttft_ms / 1e3, "tpot_s": slo_tpot_ms / 1e3},
-        telemetry={"detail": "basic", "replica_id": replica_id},
+        telemetry={"detail": "basic", "replica_id": replica_id,
+                   "trace": trace},
         sentinel=sentinel,
         mixed_dispatch=mixed,
         is_prefix_caching=prefix_cache,
@@ -1527,6 +1528,85 @@ def main_fleet_serving(
     return rec
 
 
+def _trace_overhead_smoke(
+    slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+    requests=8, max_new=16,
+):
+    """``trace_overhead_pct``: routed wall with distributed tracing fully
+    on (replica telemetry ``trace=True``, router sample rate 1.0 — every
+    hop of every request recorded) vs fully off (``trace=False`` replicas,
+    sample rate 0.0 — contexts still mint, nothing records), on two
+    identical single-replica routed stacks running the same burst,
+    ABBA-interleaved (off, on, on, off) so host warmup/jitter spreads
+    across both sides. Measures the whole instrumented path — submit
+    parse/mint, per-hop buffer records, header injection — as wall from
+    first submit to last stream completing. Gated one-sided (< 3%
+    absolute) by scripts/bench_gate.py."""
+    import time as _time
+
+    from nxdi_tpu.cli.route import _http
+    from nxdi_tpu.config import FleetConfig, RouterConfig
+    from nxdi_tpu.router import ReplicaIngest, Router
+
+    stacks = {}
+    for name, trace in (("off", False), ("on", True)):
+        rng = np.random.default_rng(11)
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            replica_id=f"ov-{name}", rng=rng, trace=trace,
+        )
+        mserver = app.telemetry.serve(port=0)
+        ingest = ReplicaIngest(engine)
+        iserver = ingest.serve(port=0)
+        router = Router(
+            [(f"ov-{name}", mserver.url, iserver.url)],
+            config=RouterConfig(
+                shed_queue_depth=float(requests + slots),
+                poll_interval_s=0.1,
+                trace_sample_rate=1.0 if trace else 0.0,
+            ),
+            fleet_config=FleetConfig(staleness_s=3600.0),
+        )
+        router.start()
+        frontend = router.serve(port=0)
+        stacks[name] = (router, frontend, ingest, [mserver, iserver])
+
+    wrng = np.random.default_rng(11)
+    prompts = [
+        wrng.integers(0, 32000, size=prompt_len - int(wrng.integers(0, 16)))
+        .astype(np.int32).tolist()
+        for _ in range(requests)
+    ]
+    walls = {"off": 0.0, "on": 0.0}
+    for rnd, name in enumerate(("off", "on", "on", "off")):
+        _, frontend, _, _ = stacks[name]
+        t0 = _time.perf_counter()
+        ids = [f"ov-{name}-{rnd}-{i}" for i in range(requests)]
+        for rid, p in zip(ids, prompts):
+            _http("POST", f"{frontend.url}/submit", {
+                "request_id": rid, "prompt": p, "max_new_tokens": max_new,
+            })
+        pending = set(ids)
+        while pending:
+            for rid in sorted(pending):
+                status, resp = _http(
+                    "GET", f"{frontend.url}/stream?request_id={rid}&cursor=0"
+                )
+                if status == 200 and resp.get("done"):
+                    pending.discard(rid)
+            _time.sleep(0.002)
+        walls[name] += _time.perf_counter() - t0
+
+    for router, _, ingest, servers in stacks.values():
+        router.stop()
+        ingest.stop()
+        for server in servers:
+            server.shutdown()
+    if walls["off"] <= 0:
+        return None
+    return round(100.0 * (walls["on"] - walls["off"]) / walls["off"], 3)
+
+
 def main_routed_serving(
     replicas=2,
     requests=32,
@@ -1618,6 +1698,7 @@ def main_routed_serving(
     def client(i):
         arrival = t0 + float(arrivals[i])
         _time.sleep(max(arrival - _time.perf_counter(), 0.0))
+        submit_wall = _time.time()
         status, resp = _http("POST", f"{frontend.url}/submit", {
             "request_id": f"bench-{i}",
             "prompt": prompts[i],
@@ -1626,8 +1707,10 @@ def main_routed_serving(
         if status != 200:
             results[i] = {"error": f"submit HTTP {status}", "tokens": 0}
             return
+        trace_id = resp.get("trace_id")
         poll_rng = _random.Random(i)
         cursor, n_tok, ttft, idle = 0, 0, None, 0
+        first_tok_wall = None
         while True:
             status, resp = _http(
                 "GET",
@@ -1641,6 +1724,7 @@ def main_routed_serving(
             n_tok += len(resp["tokens"])
             if ttft is None and n_tok > 0:
                 ttft = _time.perf_counter() - arrival
+                first_tok_wall = _time.time()
             if resp["done"]:
                 results[i] = {
                     "error": resp["error"] if resp["finish_reason"] == "error"
@@ -1649,6 +1733,9 @@ def main_routed_serving(
                     "ttft_s": ttft,
                     "end_s": _time.perf_counter() - t0,
                     "failovers": resp.get("failovers", 0),
+                    "trace_id": trace_id,
+                    "submit_wall": submit_wall,
+                    "first_tok_wall": first_tok_wall,
                 }
                 return
             # jittered backoff between re-polls: dry polls grow the sleep
@@ -1672,6 +1759,27 @@ def main_routed_serving(
     ttfts = [r["ttft_s"] for r in ok if r.get("ttft_s") is not None]
     n_tok = sum(r["tokens"] for r in ok)
     snap = router.snapshot()
+
+    # trace_ttft_attribution_pct: join the hop spans every tier recorded
+    # (router + replicas, over their real /traces endpoints) and ask, per
+    # request, how much of the CLIENT-observed submit→first-token window
+    # the assembled critical path accounts for — median over requests
+    from nxdi_tpu.telemetry.tracing import assemble_traces, critical_path
+
+    spans = []
+    for url in [frontend.url] + [t[1] for t in targets]:
+        status, body = _http("GET", f"{url}/traces")
+        if status == 200 and isinstance(body, dict):
+            spans.extend(body.get("spans") or [])
+    by_trace = {t["trace_id"]: t for t in assemble_traces(spans)}
+    coverages = []
+    for r in ok:
+        trace = by_trace.get(r.get("trace_id"))
+        if (trace is None or r.get("submit_wall") is None
+                or r.get("first_tok_wall") is None):
+            continue
+        cp = critical_path(trace, (r["submit_wall"], r["first_tok_wall"]))
+        coverages.append(cp["coverage_pct"])
     rec = {
         "metric": "llama3.2-1b_routed_serving_goodput",
         "value": round(len(ok) / wall, 3),
@@ -1695,6 +1803,9 @@ def main_routed_serving(
         "routed_errors": len([r for r in results if r and r["error"]]),
         "routed_dispatches": snap["_router"]["dispatches"],
         "routed_drained_replica": drain_target,
+        "trace_ttft_attribution_pct": (
+            round(percentile_exact(coverages, 50), 2) if coverages else None
+        ),
         "config": (
             f"llama3.2-1b full {n_layers}L bf16 paged x{replicas} replicas "
             f"slots{slots} kv{seq_len} prompt~{prompt_len} max_new{max_new} "
@@ -1702,6 +1813,9 @@ def main_routed_serving(
         ),
         "mode": "routed_continuous_batching",
     }
+    rec["trace_overhead_pct"] = _trace_overhead_smoke(
+        slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+    )
     print(json.dumps(rec))
     write_metrics_snapshots({"router": snap}, metrics_out_path())
     router.stop()

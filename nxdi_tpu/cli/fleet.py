@@ -111,6 +111,30 @@ def router_dispatch_counts(source) -> Optional[dict]:
     return None
 
 
+def _counter_total(snap: Optional[dict], family: str) -> float:
+    """Summed series value of a counter family in a replica snapshot."""
+    fam = (snap or {}).get(family)
+    if not isinstance(fam, dict):
+        return 0.0
+    return float(sum(row.get("value", 0.0) for row in fam.get("series") or []))
+
+
+def handoff_counts(monitor: FleetMonitor) -> dict:
+    """``{label: (exports, imports)}`` from each replica's EXISTING
+    ``nxdi_handoff_{exports,imports}_total`` counters — the disaggregation
+    plane's activity per replica (a prefill replica exports, a decode
+    replica imports; a unified replica shows 0/0). The fleet-level
+    in-flight handoff count is ``sum(exports) - sum(imports)``: chains
+    exported whose decode-side import has not landed yet."""
+    out = {}
+    for rep in monitor.replicas:
+        out[rep.label] = (
+            _counter_total(rep.snapshot, "nxdi_handoff_exports_total"),
+            _counter_total(rep.snapshot, "nxdi_handoff_imports_total"),
+        )
+    return out
+
+
 def print_fleet_table(monitor: FleetMonitor, file=None,
                       dispatches: Optional[dict] = None) -> None:
     """The live table: one row per replica, ranked least-loaded first,
@@ -124,10 +148,11 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
     out = file if file is not None else sys.stdout
     sigs = {s.replica: s for s in monitor.load_signals()}
     now = monitor.wall_clock()
+    hoffs = handoff_counts(monitor)
     hdr = (f"{'rank':>4} {'replica':<24} {'state':<12} {'role':<8} "
            f"{'age_s':>7} "
            f"{'queue':>5} {'busy':>5} {'kv_free':>7} {'kv_used':>7} "
-           f"{'slo%':>6} {'score':>8}")
+           f"{'slo%':>6} {'hoff e/i':>9} {'score':>8}")
     if dispatches is not None:
         hdr += f" {'dispatched':>10}"
     print(hdr, file=out)
@@ -139,12 +164,14 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
         age = rep.snapshot_age_s(now)
         # pre-stamp replicas report no age (format(None, '>7') would raise)
         age_s = "-" if age is None else f"{age:.1f}"
+        exp, imp = hoffs.get(label, (0.0, 0.0))
         row = (
             f"{rank:>4} {label:<24} {s.state:<12} {s.role:<8} "
             f"{age_s:>7} "
             f"{s.queue_depth:>5g} {s.slots_busy:>5g} "
             f"{s.kv_blocks_free:>7g} {s.kv_blocks_used:>7g} "
-            f"{s.slo_attainment_pct:>6.1f} {s.score:>8.4f}"
+            f"{s.slo_attainment_pct:>6.1f} "
+            f"{f'{exp:g}/{imp:g}':>9} {s.score:>8.4f}"
         )
         if dispatches is not None:
             row += f" {dispatches.get(label, 0):>10g}"
@@ -154,11 +181,18 @@ def print_fleet_table(monitor: FleetMonitor, file=None,
             continue
         row = (
             f"{'-':>4} {rep.label:<24} {rep.state:<12} {'-':<8} "
-            f"{'-':>7} {'-':>5} {'-':>5} {'-':>7} {'-':>7} {'-':>6} {'-':>8}"
+            f"{'-':>7} {'-':>5} {'-':>5} {'-':>7} {'-':>7} {'-':>6} "
+            f"{'-':>9} {'-':>8}"
         )
         if dispatches is not None:
             row += f" {dispatches.get(rep.label, 0):>10g}"
         print(row + f"  {rep.last_error or ''}", file=out)
+    inflight = (sum(e for e, _ in hoffs.values())
+                - sum(i for _, i in hoffs.values()))
+    if any(e or i for e, i in hoffs.values()):
+        # chains exported whose decode-side import has not landed yet
+        print(f"in-flight handoffs (exports - imports): {inflight:g}",
+              file=out)
 
 
 def build_demo_fleet(n: int, requests: int, quiet: bool):

@@ -119,6 +119,15 @@ def inspect_bundle(path: str) -> int:
             f"ttft_s={span.get('ttft_s')}"
         )
         print(f"  phases:    {[p['name'] for p in span.get('phases', [])]}")
+    trace_id = bundle.get("trace_id")
+    if trace_id:
+        hops = bundle.get("trace_hops") or []
+        print(f"  trace:     {trace_id} ({len(hops)} hop spans on this "
+              "replica; assemble fleet-wide with cli.trace --trace-id)")
+        for h in sorted(hops, key=lambda s: s.get("t_start", 0.0)):
+            print(f"    {h.get('hop', '?'):<26} "
+                  f"{h.get('duration_s', 0.0) * 1e3:>9.3f} ms  "
+                  f"span={h.get('span_id')} parent={h.get('parent_span_id')}")
     print(f"  timeline:  {len(recs)} step records", end="")
     if recs:
         host = sum(r["host_s"] for r in recs)

@@ -1,0 +1,204 @@
+"""``python -m nxdi_tpu.cli.trace`` — fleet-wide distributed-trace
+waterfalls with critical-path TTFT attribution.
+
+Pulls hop spans from any mix of sources — replica ``/traces`` endpoints
+(every ``Telemetry.serve()`` / router frontend exposes one), a fleet
+federation endpoint's assembled ``/traces``, or local JSON files with
+either shape — joins them by ``trace_id``
+(:func:`~nxdi_tpu.telemetry.tracing.assemble_traces`), and renders each
+request's life across the fleet: an indented waterfall (parent/child from
+the spans' own ``parent_span_id`` links, one row per hop with replica,
+offset, duration, and a proportional bar) followed by the critical-path
+summary — the trace window decomposed into per-hop EXCLUSIVE
+contributions (:func:`~nxdi_tpu.telemetry.tracing.critical_path`), i.e.
+where the client-observed TTFT actually went.
+
+Usage::
+
+  # waterfall every trace two replicas + the router know about
+  python -m nxdi_tpu.cli.trace http://h1:9400 http://h2:9400 http://rt:8080
+
+  # the three slowest requests by end-to-end trace duration
+  python -m nxdi_tpu.cli.trace http://fleet:9500 --slowest 3
+
+  # one request, by (prefix of) its trace id, plus a Perfetto export
+  python -m nxdi_tpu.cli.trace http://fleet:9500 --trace-id 4f2a --perfetto /tmp/t.json
+
+The ``--perfetto`` file maps per-request trees onto per-replica process
+groups with cross-replica hops drawn as flow arrows
+(:func:`~nxdi_tpu.telemetry.federation.traces_to_perfetto`) — same pid
+stride as ``cli.fleet --perfetto``'s merged trace, so the two overlay.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import List, Optional
+
+from nxdi_tpu.telemetry.tracing import (
+    assemble_traces,
+    critical_path,
+    span_depths,
+)
+
+_BAR_W = 24
+
+
+def setup_trace_parser(p: argparse.ArgumentParser) -> None:
+    p.add_argument("sources", nargs="+",
+                   help="span sources: replica/router base URLs (their "
+                        "/traces is fetched), a fleet federation URL, or "
+                        "paths to JSON files in either /traces shape")
+    p.add_argument("--trace-id", default=None, metavar="HEX",
+                   help="show only traces whose id starts with this prefix "
+                        "(exit 1 when none match)")
+    p.add_argument("--slowest", type=int, default=0, metavar="N",
+                   help="show only the N slowest traces by end-to-end "
+                        "duration (client-observed TTFT for a streamed "
+                        "request: the window closes at stream.deliver)")
+    p.add_argument("--format", choices=["table", "json"], default="table")
+    p.add_argument("--perfetto", dest="perfetto_path", default=None,
+                   metavar="PATH",
+                   help="also write the per-request flow-event Perfetto "
+                        "trace here (open in ui.perfetto.dev)")
+    p.add_argument("--timeout", type=float, default=2.0,
+                   help="per-source HTTP timeout seconds")
+    p.add_argument("-q", "--quiet", action="store_true")
+
+
+def _spans_from_obj(obj) -> List[dict]:
+    """Hop spans from either /traces body shape: a per-process buffer dump
+    (``{"replica_id": ..., "spans": [...]}``) or a federation endpoint's
+    assembled view (``{"traces": [{"spans": [...]}, ...]}``)."""
+    if not isinstance(obj, dict):
+        return []
+    if isinstance(obj.get("spans"), list):
+        return [s for s in obj["spans"] if isinstance(s, dict)]
+    out: List[dict] = []
+    for t in obj.get("traces") or []:
+        if isinstance(t, dict):
+            out.extend(s for s in t.get("spans", []) if isinstance(s, dict))
+    return out
+
+
+def fetch_spans(source: str, timeout: float = 2.0) -> List[dict]:
+    """Hop spans from one source: ``http(s)://`` URLs get ``/traces``
+    fetched (a URL already ending in ``/traces`` is used as-is), anything
+    else is read as a local JSON file. Raises on unreachable sources —
+    the caller decides whether a partial fleet view is acceptable."""
+    if source.startswith(("http://", "https://")):
+        import urllib.request
+
+        url = source if source.rstrip("/").endswith("/traces") \
+            else source.rstrip("/") + "/traces"
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return _spans_from_obj(json.loads(resp.read()))
+    with open(source) as f:
+        return _spans_from_obj(json.load(f))
+
+
+def select_traces(traces: List[dict], trace_id: Optional[str] = None,
+                  slowest: int = 0) -> List[dict]:
+    if trace_id:
+        traces = [
+            t for t in traces
+            if str(t.get("trace_id", "")).startswith(trace_id)
+        ]
+    if slowest > 0:
+        traces = sorted(
+            traces, key=lambda t: -float(t.get("duration_s", 0.0))
+        )[:slowest]
+    return traces
+
+
+def _bar(frac: float) -> str:
+    n = max(0, min(_BAR_W, round(frac * _BAR_W)))
+    return "#" * n
+
+
+def print_waterfall(traces: List[dict], file=None) -> None:
+    """The human rendering: per trace, an indented hop waterfall plus the
+    critical-path decomposition of the trace window."""
+    out = file if file is not None else sys.stdout
+    if not traces:
+        print("no traces (is tracing enabled and sampled on the sources?)",
+              file=out)
+        return
+    for trace in traces:
+        spans = trace.get("spans", [])
+        dur = float(trace.get("duration_s", 0.0))
+        print(f"trace {trace.get('trace_id')}  "
+              f"{dur * 1e3:.3f} ms  {len(spans)} hops  "
+              f"replicas: {', '.join(trace.get('replicas', [])) or '-'}",
+              file=out)
+        depths = span_depths(spans)
+        t0 = float(trace.get("t_start", 0.0))
+        window = max(dur, 1e-9)
+        for s in spans:
+            indent = "  " * depths.get(s.get("span_id"), 0)
+            hop = f"{indent}{s.get('hop', '?')}"
+            off = (float(s.get("t_start", 0.0)) - t0) * 1e3
+            ms = float(s.get("duration_s", 0.0)) * 1e3
+            print(f"  {hop:<34} {str(s.get('replica') or '-'):<14} "
+                  f"+{off:>9.3f} ms {ms:>9.3f} ms  "
+                  f"{_bar(float(s.get('duration_s', 0.0)) / window)}",
+                  file=out)
+        cp = critical_path(trace)
+        print(f"  critical path: {cp['total_s'] * 1e3:.3f} of "
+              f"{cp['window_s'] * 1e3:.3f} ms attributed "
+              f"({cp['coverage_pct']:.1f}% coverage)", file=out)
+        for hop, sec in sorted(cp["by_hop"].items(), key=lambda kv: -kv[1]):
+            pct = 100.0 * sec / cp["window_s"] if cp["window_s"] > 0 else 0.0
+            print(f"    {hop:<34} {sec * 1e3:>9.3f} ms  {pct:>5.1f}%",
+                  file=out)
+        print(file=out)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m nxdi_tpu.cli.trace",
+        description="assemble distributed traces from /traces sources and "
+                    "render waterfalls with critical-path TTFT attribution",
+    )
+    setup_trace_parser(parser)
+    args = parser.parse_args(argv)
+
+    spans: List[dict] = []
+    failures = 0
+    for src in args.sources:
+        try:
+            spans.extend(fetch_spans(src, timeout=args.timeout))
+        except Exception as exc:  # noqa: BLE001 — report, keep going
+            failures += 1
+            if not args.quiet:
+                print(f"[trace] {src}: {exc}", file=sys.stderr)
+    traces = select_traces(
+        assemble_traces(spans), trace_id=args.trace_id, slowest=args.slowest
+    )
+
+    if args.perfetto_path:
+        from nxdi_tpu.telemetry.federation import traces_to_perfetto
+
+        with open(args.perfetto_path, "w") as f:
+            json.dump(traces_to_perfetto(traces), f)
+        if not args.quiet:
+            print(f"[trace] Perfetto flow trace: {args.perfetto_path} "
+                  f"(open in ui.perfetto.dev)", file=sys.stderr)
+
+    if args.format == "json":
+        print(json.dumps(
+            [dict(t, critical_path=critical_path(t)) for t in traces],
+            indent=2,
+        ))
+    else:
+        print_waterfall(traces)
+
+    if args.trace_id and not traces:
+        return 1
+    return 1 if failures and not spans else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -187,12 +187,26 @@ class TelemetryConfig:
     (telemetry/fleet.py): rides every JSON snapshot as
     ``_process.replica_id`` and becomes the ``replica`` label on federated
     series. None = ``"<hostname>:<pid>"``, derived once per process.
+
+    Distributed tracing (nxdi_tpu/telemetry/tracing.py):
+
+    ``trace`` enables per-hop trace recording (ingest queueing, prefill,
+    handoff export/import, first decode token) into a bounded per-replica
+    buffer served at ``/traces``; a no-op at ``detail="off"`` like every
+    other surface. ``trace_buffer`` bounds retained hop spans (overflow
+    counts ``nxdi_traces_dropped_total``); ``trace_sample_rate`` is the
+    deterministic credit-accumulator rate applied when THIS process mints
+    a fresh context (requests arriving with a valid ``traceparent`` keep
+    the sender's sampling decision).
     """
 
     def __init__(self, **kwargs):
         self.enabled = bool(kwargs.pop("enabled", True))
         self.detail = kwargs.pop("detail", "basic")
         self.max_spans = int(kwargs.pop("max_spans", 256))
+        self.trace = bool(kwargs.pop("trace", True))
+        self.trace_buffer = int(kwargs.pop("trace_buffer", 256))
+        self.trace_sample_rate = float(kwargs.pop("trace_sample_rate", 1.0))
         # stable replica identity (fleet observatory, telemetry/fleet.py):
         # the label every federated series carries for this process. None =
         # derived once per Telemetry as "<hostname>:<pid>" — stable for the
@@ -210,6 +224,12 @@ class TelemetryConfig:
             )
         if self.max_spans < 1:
             raise ValueError("telemetry max_spans must be >= 1")
+        if self.trace_buffer < 1:
+            raise ValueError("telemetry trace_buffer must be >= 1")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError(
+                "telemetry trace_sample_rate must be within [0, 1]"
+            )
         if self.flight_records < 1:
             raise ValueError("telemetry flight_records must be >= 1")
         if self.storm_window < 1 or self.storm_preemptions < 1:
@@ -384,7 +404,13 @@ class RouterConfig:
     router's embedded FleetMonitor (``Router.start()``);
     ``max_sessions`` — LRU bound on the session-affinity pin table;
     ``max_requests`` — bound on retained finished-request records (live
-    requests are never evicted).
+    requests are never evicted);
+    ``trace_sample_rate`` — deterministic credit-accumulator sampling rate
+    for distributed traces minted at submit (telemetry/tracing.py): every
+    submission carries a trace id regardless, but only sampled requests
+    record hop spans (0 disables recording entirely);
+    ``trace_buffer`` — bound on the router's retained hop spans (overflow
+    counts the router registry's ``nxdi_traces_dropped_total``).
     """
 
     def __init__(self, **kwargs):
@@ -398,6 +424,8 @@ class RouterConfig:
         self.poll_interval_s = float(kwargs.pop("poll_interval_s", 0.5))
         self.max_sessions = int(kwargs.pop("max_sessions", 4096))
         self.max_requests = int(kwargs.pop("max_requests", 4096))
+        self.trace_sample_rate = float(kwargs.pop("trace_sample_rate", 1.0))
+        self.trace_buffer = int(kwargs.pop("trace_buffer", 512))
         if kwargs:
             raise ValueError(f"Unknown RouterConfig args: {sorted(kwargs)}")
         if self.degraded_penalty < 0:
@@ -416,6 +444,10 @@ class RouterConfig:
             )
         if self.max_sessions < 1 or self.max_requests < 1:
             raise ValueError("router max_sessions/max_requests must be >= 1")
+        if not 0.0 <= self.trace_sample_rate <= 1.0:
+            raise ValueError("router trace_sample_rate must be within [0, 1]")
+        if self.trace_buffer < 1:
+            raise ValueError("router trace_buffer must be >= 1")
 
     def to_dict(self):
         return dict(self.__dict__)

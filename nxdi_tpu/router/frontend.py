@@ -24,7 +24,20 @@ Router telemetry (federated into every fleet export via
   FAILED the request (the diagnostic question is "who is dropping work");
 - ``nxdi_router_sheds_total`` — fleet-saturation rejections;
 - ``nxdi_router_drains_total{replica}`` — cooperative drains initiated;
-- ``nxdi_router_inflight{replica}`` — requests currently assigned.
+- ``nxdi_router_inflight{replica}`` — requests currently assigned;
+- ``nxdi_trace_hop_seconds{hop}`` / ``nxdi_traces_dropped_total`` — the
+  router tier's own distributed-tracing pair (telemetry/tracing.py): hop
+  durations for the router-side hops (router.queue, router.dispatch,
+  handoff.transfer, stream.deliver) and trace-buffer evictions.
+
+Distributed tracing: ``/submit`` mints (or extracts from the client's
+``traceparent``) a :class:`~nxdi_tpu.telemetry.tracing.TraceContext`;
+every dispatch ships a traceparent whose span_id is that attempt's
+``router.dispatch`` hop, so the replica-side hops parent under it.
+Failover re-dispatches reuse the SAME parent (the ``router.queue`` hop) —
+they appear as sibling dispatch hops under one trace. ``GET /traces``
+exposes the router's bounded hop-span buffer in the same shape as the
+replica endpoint; the FleetMonitor assembles both into per-request trees.
 
 Thread model: HTTP handler threads call ``submit``/``stream``
 concurrently. One router lock guards the tables and the policy; each
@@ -58,7 +71,17 @@ from nxdi_tpu.router.retry import (
     should_failover,
 )
 from nxdi_tpu.telemetry.fleet import FleetMonitor
-from nxdi_tpu.telemetry.registry import MetricsRegistry
+from nxdi_tpu.telemetry.registry import TIME_BOUNDS_S, MetricsRegistry
+from nxdi_tpu.telemetry.tracing import (
+    HOP_HANDOFF_TRANSFER,
+    HOP_ROUTER_DISPATCH,
+    HOP_ROUTER_QUEUE,
+    HOP_STREAM_DELIVER,
+    TRACEPARENT_KEY,
+    TraceBuffer,
+    TraceContext,
+    TraceSampler,
+)
 
 logger = logging.getLogger("nxdi_tpu")
 
@@ -93,6 +116,7 @@ def parse_target(
 def http_json(
     method: str, url: str, payload: Optional[dict] = None,
     timeout_s: Optional[float] = 10.0,
+    traceparent: Optional[str] = None,
 ) -> Tuple[int, dict]:
     """One JSON round-trip — THE request-plane HTTP helper (the Router's
     default transport, and what cli.route / bench reuse as clients).
@@ -100,7 +124,12 @@ def http_json(
     (429 shed, 503 draining), not transport faults; only transport-level
     failures raise. The socket timeout is always explicit: a caller
     passing ``None`` still gets the 10s default, so a wedged replica
-    socket can never hang a poll loop indefinitely."""
+    socket can never hang a poll loop indefinitely.
+
+    ``traceparent`` (or a ``"traceparent"`` key already in ``payload`` —
+    the router's injection path, since injected transports keep the
+    4-positional call shape) additionally rides as a REAL HTTP header, so
+    intermediaries that only see headers can join the trace."""
     if timeout_s is None:
         timeout_s = 10.0
     if faults.ACTIVE_PLAN is not None:
@@ -108,10 +137,14 @@ def http_json(
         # raised error takes the same except-Exception paths a dead socket
         # does (stream_errors, health poll, failover rule)
         faults.fire(faults.SITE_TRANSPORT)
+    headers = {"Content-Type": "application/json"}
+    if traceparent is None and isinstance(payload, dict):
+        traceparent = payload.get(TRACEPARENT_KEY)
+    if isinstance(traceparent, str) and traceparent:
+        headers[TRACEPARENT_KEY] = traceparent
     data = None if payload is None else json.dumps(payload).encode()
     req = urllib.request.Request(
-        url, data=data, method=method,
-        headers={"Content-Type": "application/json"},
+        url, data=data, method=method, headers=headers,
     )
     try:
         with urllib.request.urlopen(req, timeout=timeout_s) as resp:
@@ -201,8 +234,32 @@ class Router:
             "prefill->decode KV handoff latency in seconds (payload fetch "
             "through the retention ack)",
         )
+        # distributed tracing (telemetry/tracing.py): the router tier keeps
+        # its own bounded hop-span buffer and a sibling metric pair under
+        # the SAME names the replicas use — federation merges them like any
+        # other member series. Sampling is the deterministic credit
+        # accumulator; rate 0.0 disables recording (contexts still mint so
+        # responses carry trace ids and headers stay well-formed).
+        self.traces_dropped_total = r.counter(
+            "nxdi_traces_dropped_total",
+            "trace hop spans evicted from the router's bounded trace buffer",
+        )
+        self.trace_hop_seconds = r.histogram(
+            "nxdi_trace_hop_seconds",
+            "distributed-trace hop durations in seconds",
+            ("hop",), bounds=TIME_BOUNDS_S,
+        )
+        self._trace_sampler = TraceSampler(
+            getattr(self.config, "trace_sample_rate", 1.0)
+        )
+        self._trace_buffer = TraceBuffer(
+            getattr(self.config, "trace_buffer", 512),
+            dropped_counter=self.traces_dropped_total,
+            hop_seconds=self.trace_hop_seconds,
+        )
         self.sheds_total.inc(0)
         self.handoff_retries_total.inc(0)
+        self.traces_dropped_total.inc(0)
         for name in self.ingest_urls:
             self.dispatches_total.inc(0, replica=name)
             self.failovers_total.inc(0, replica=name)
@@ -210,6 +267,7 @@ class Router:
             self.inflight_gauge.set(0, replica=name)
             self._inflight[name] = 0
         self.monitor.attach_registry(self.registry)
+        self.monitor.attach_trace_source(self._trace_buffer.snapshot)
 
     # -- fleet plumbing ------------------------------------------------------
     def poll(self) -> Dict[str, str]:
@@ -262,6 +320,38 @@ class Router:
         self._inflight[label] = max(self._inflight.get(label, 0) + delta, 0)
         self.inflight_gauge.set(self._inflight[label], replica=label)
 
+    # -- distributed tracing -------------------------------------------------
+    def _record_hop(self, hop: str, trace, *, t_start: float,
+                    duration_s: float, parent_span_id=None, span_id=None,
+                    attrs=None) -> Optional[str]:
+        """Record one router-side hop span; no-op (returns None) for
+        unsampled/absent contexts. Safe under any caller lock — the buffer
+        lock is a leaf."""
+        if trace is None or not trace.sampled:
+            return None
+        return self._trace_buffer.record(
+            hop, trace.trace_id,
+            parent_span_id if parent_span_id is not None else trace.span_id,
+            t_start=t_start, duration_s=duration_s, replica="router",
+            span_id=span_id, attrs=attrs,
+        )
+
+    def _hop(self, req: RouterRequest, hop: str, attrs=None) -> None:
+        """Record a hop ending NOW from the request's ``trace_t0`` stamp
+        and advance its context so the next hop parents under this one.
+        Called with ``req._lock`` held."""
+        tr = req.trace
+        if tr is None:
+            return
+        now = time.time()
+        start = req.trace_t0 if req.trace_t0 is not None else now
+        sid = self._record_hop(
+            hop, tr, t_start=start, duration_s=now - start, attrs=attrs
+        )
+        if sid is not None:
+            req.trace = tr.child(span_id=sid)
+        req.trace_t0 = now
+
     # -- submit --------------------------------------------------------------
     def submit(self, payload: dict) -> Tuple[int, dict]:
         """Route one submission. Returns ``(status, response)``:
@@ -273,8 +363,16 @@ class Router:
         session_id = payload.get("session_id")
         params = {
             k: v for k, v in payload.items()
-            if k not in ("prompt", "request_id", "session_id") and v is not None
+            if k not in ("prompt", "request_id", "session_id", TRACEPARENT_KEY)
+            and v is not None
         }
+        # trace root: extract the client's traceparent when valid, else
+        # mint (malformed/oversized headers parse to None — NEVER an
+        # error). Sampling only gates hop RECORDING; the id always rides
+        # the response so clients can correlate either way.
+        trace = TraceContext.from_header(payload.get(TRACEPARENT_KEY))
+        if trace is None:
+            trace = TraceContext.mint(sampled=self._trace_sampler.sample())
         existing: Optional[RouterRequest] = None
         with self._lock:
             rid = payload.get("request_id")
@@ -318,7 +416,8 @@ class Router:
                         },
                     }
                 req = RouterRequest(
-                    rid, list(prompt), session_id=session_id, params=params
+                    rid, list(prompt), session_id=session_id, params=params,
+                    trace=trace,
                 )
                 self._requests[rid] = req
                 self._order.append(rid)
@@ -337,6 +436,11 @@ class Router:
                 with self._lock:
                     self._set_inflight(failed, -1)
         with req._lock:
+            # router.queue: submit arrival -> dispatch start (shed checks,
+            # signal fetch, lock waits); every dispatch attempt — including
+            # failover re-dispatches — then parents under THIS hop, which
+            # is what makes re-dispatches siblings of the original
+            self._hop(req, HOP_ROUTER_QUEUE)
             return self._dispatch(req, signals)
 
     def _evict_finished(self) -> List[RouterRequest]:
@@ -387,12 +491,24 @@ class Router:
             url = self._ingest_url(replica)
             req.assign(replica)
             ok, status, resp = False, 0, {}
+            # pre-allocate this attempt's router.dispatch span id: the
+            # traceparent shipped with the submit carries it, so the
+            # replica's ingest.queue hop parents under THIS dispatch even
+            # though the hop itself is only recorded once the RTT is known.
+            # req.trace is NOT advanced past the queue hop — every attempt
+            # (and every failover re-dispatch) stays a sibling under it.
+            disp_ctx = None if req.trace is None else req.trace.child()
+            t_disp = time.time()
             if url is not None:
+                submit_payload = dict(
+                    req.params, request_id=req.request_id,
+                    prompt=req.prompt, session_id=req.session_id,
+                )
+                if disp_ctx is not None:
+                    submit_payload[TRACEPARENT_KEY] = disp_ctx.to_header()
                 try:
                     status, resp = self.http(
-                        "POST", url + "/submit",
-                        dict(req.params, request_id=req.request_id,
-                             prompt=req.prompt, session_id=req.session_id),
+                        "POST", url + "/submit", submit_payload,
                         self.config.ingest_timeout_s,
                     )
                     ok = status == 200
@@ -401,12 +517,27 @@ class Router:
                         "router: submit to %s failed: %s", replica, e
                     )
             if ok:
+                if disp_ctx is not None:
+                    now = time.time()
+                    attrs = {"replica": replica}
+                    if req.failovers:
+                        attrs["failover"] = req.failovers
+                    self._record_hop(
+                        HOP_ROUTER_DISPATCH, req.trace,
+                        t_start=t_disp, duration_s=now - t_disp,
+                        span_id=disp_ctx.span_id, attrs=attrs,
+                    )
+                    req.deliver_parent = disp_ctx.span_id
+                    req.deliver_t0 = now
+                    req.trace_t0 = now
                 with self._lock:
                     self.dispatches_total.inc(replica=replica)
                     self._set_inflight(replica, +1)
                 return 200, {
                     "request_id": req.request_id,
                     "replica": replica,
+                    "trace_id": None if req.trace is None
+                    else req.trace.trace_id,
                     "status": resp.get("status", "queued"),
                     "failovers": req.failovers,
                 }
@@ -447,9 +578,27 @@ class Router:
         with req._lock:
             if not req.done:
                 self._sync(req)
+            if req.delivered and not req.delivered_hop:
+                # stream.deliver: dispatch-complete -> the first CLIENT
+                # poll that can return tokens. Stamped here — not inside
+                # _sync — so an inline handoff between the upstream sync
+                # and this response counts toward delivery, exactly as the
+                # blocked client experiences it. Last in chain order, so
+                # critical-path clipping credits it only the residual the
+                # upstream hops don't cover (poll cadence, proxy overhead).
+                now = time.time()
+                start = req.deliver_t0 if req.deliver_t0 is not None else now
+                self._record_hop(
+                    HOP_STREAM_DELIVER, req.trace,
+                    t_start=start, duration_s=now - start,
+                    parent_span_id=req.deliver_parent,
+                    attrs={"tokens": len(req.delivered)},
+                )
+                req.delivered_hop = True
             toks = list(req.delivered[cursor:])
             return 200, {
                 "request_id": req.request_id,
+                "trace_id": None if req.trace is None else req.trace.trace_id,
                 "tokens": toks,
                 "cursor": cursor + len(toks),
                 "done": req.done,
@@ -545,6 +694,7 @@ class Router:
             self._failover(req)
             return
         t0 = time.monotonic()
+        w0 = time.time()  # wall-clock twin of t0 for the transfer hop span
         try:
             status, resp = self.http(
                 "GET",
@@ -572,9 +722,10 @@ class Router:
             return
         req.stream_errors = 0
         req.handoff_src = prefill
-        self._place_handoff(req, resp.get("payload"), t0)
+        self._place_handoff(req, resp.get("payload"), t0, w0)
 
-    def _place_handoff(self, req: RouterRequest, wire, t0: float) -> None:
+    def _place_handoff(self, req: RouterRequest, wire, t0: float,
+                       w0: Optional[float] = None) -> None:
         """Import the fetched KV payload into a decode replica, walking the
         KV-pressure-weighted ranking on transient failures. Called with
         ``req._lock`` held and ``req.handoff_src`` set (the chain is still
@@ -619,6 +770,20 @@ class Router:
                     if src is not None:
                         self._set_inflight(src, -1)
                     self._set_inflight(target, +1)
+                # handoff.transfer: payload fetch through the accepted
+                # import, parented under the prefill side's handoff.export
+                # hop (the wire trace's span_id) — sibling of the decode
+                # side's handoff.import, which parents there too
+                trw = wire.get("trace") if isinstance(wire, dict) else None
+                tr_ctx = TraceContext.from_dict(trw) if trw else None
+                if tr_ctx is not None:
+                    now = time.time()
+                    start = w0 if w0 is not None else now
+                    self._record_hop(
+                        HOP_HANDOFF_TRANSFER, tr_ctx,
+                        t_start=start, duration_s=now - start,
+                        attrs={"src": src, "dst": target},
+                    )
                 req.assign(target)
                 req.handoffs += 1
                 # release the retained chain; on ack failure handoff_src
@@ -927,6 +1092,11 @@ class Router:
              lambda path, body: json.dumps(self.snapshot(), indent=2)),
             ("POST", "/poll", "application/json",
              lambda path, body: json.dumps(self.poll())),
+            ("GET", "/traces", "application/json",
+             lambda path, body: json.dumps({
+                 "replica_id": "router",
+                 "spans": self._trace_buffer.snapshot(),
+             })),
             ("GET", "/metrics", PROM_CONTENT_TYPE,
              lambda path, body: self.prometheus_text()),
         ]

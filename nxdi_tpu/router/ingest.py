@@ -5,12 +5,15 @@ Every serving replica today exposes a METRICS port (``MetricsServer``:
 stdlib server, but carrying requests instead of probes:
 
 - ``POST /submit``  — enqueue one generation request
-  (``{"request_id", "prompt": [ids], "session_id"?, "max_new_tokens"?,
-  ...sampling}``). Answers ``{"status": "queued"}``; a KNOWN request_id
-  answers ``{"status": "duplicate"}`` without enqueueing (idempotent
-  submit — the router's failover re-dispatch can never run one request
-  twice on one replica); while draining answers **503**
-  ``{"error": "draining"}``.
+  (``{"request_id", "prompt": [ids], "session_id"?, "traceparent"?,
+  "max_new_tokens"?, ...sampling}``). Answers ``{"status": "queued",
+  "trace_id"}``; a KNOWN request_id answers ``{"status": "duplicate"}``
+  with the ORIGINAL trace_id, without enqueueing (idempotent submit — the
+  router's failover re-dispatch can never run one request twice on one
+  replica); while draining answers **503** ``{"error": "draining"}``.
+  ``traceparent`` is the W3C-style trace header (telemetry/tracing.py):
+  extracted when valid, silently replaced by a fresh mint when absent or
+  malformed — a bad header can never fail a submit.
 - ``GET /stream?request_id=R&cursor=N`` — SSE-style token poll: the
   generated tokens past ``cursor`` plus ``done``/``finish_reason``. Tokens
   appear here the moment the engine's streaming callback fires, so a
@@ -56,6 +59,8 @@ from urllib.parse import parse_qs, urlsplit
 
 if TYPE_CHECKING:  # import cycle: serving.engine pulls the router package
     from nxdi_tpu.serving.engine import InferenceEngine
+
+from nxdi_tpu.telemetry.tracing import HOP_INGEST_QUEUE, TraceContext
 
 logger = logging.getLogger("nxdi_tpu")
 
@@ -129,6 +134,15 @@ class ReplicaIngest:
         sampling = {
             k: payload[k] for k in SAMPLING_KEYS if payload.get(k) is not None
         }
+        # distributed trace: extract the caller's context (the router ships
+        # a traceparent whose span_id is its dispatch hop) or, for a direct
+        # client submit, mint a fresh root. A malformed/oversized header
+        # parses to None and falls through to minting — NEVER an error.
+        tel = self.telemetry
+        trace = TraceContext.from_header(payload.get("traceparent"))
+        if trace is None and tel is not None:
+            trace = tel.mint_trace()
+        recv_s = time.time()
         with self._lock:
             if rid is None:
                 self._rid_seq += 1
@@ -144,9 +158,12 @@ class ReplicaIngest:
             rec = self._records.get(rid)
             if rec is not None:
                 # duplicate-suppression: idempotent submit — report current
-                # progress, never enqueue a second copy
+                # progress (and the ORIGINAL trace_id: the duplicate's
+                # freshly-minted/extracted context is discarded), never
+                # enqueue a second copy
                 return 200, {
                     "request_id": rid, "status": "duplicate",
+                    "trace_id": rec.get("trace_id"),
                     "done": rec["done"], "tokens": len(rec["tokens"]),
                 }
             if self.draining:
@@ -157,6 +174,7 @@ class ReplicaIngest:
             rec = {
                 "request_id": rid,
                 "session_id": payload.get("session_id"),
+                "trace_id": None if trace is None else trace.trace_id,
                 "tokens": [],
                 "done": False,
                 "finish_reason": None,
@@ -169,9 +187,12 @@ class ReplicaIngest:
                 "prompt": [int(t) for t in prompt],
                 "session_id": payload.get("session_id"),
                 "sampling": sampling,
+                "trace": trace,
+                "recv_s": recv_s,
             })
         self._wake.set()
         return 200, {"request_id": rid, "status": "queued",
+                     "trace_id": None if trace is None else trace.trace_id,
                      "replica_id": self.replica_id}
 
     def stream(self, rid: str, cursor: int = 0) -> tuple:
@@ -183,6 +204,7 @@ class ReplicaIngest:
             toks = list(rec["tokens"][cursor:])
             return 200, {
                 "request_id": rec["request_id"],
+                "trace_id": rec.get("trace_id"),
                 "tokens": toks,
                 "cursor": cursor + len(toks),
                 "done": rec["done"],
@@ -290,11 +312,14 @@ class ReplicaIngest:
                 rec = self._records[rid]
                 return 200, {
                     "request_id": rid, "status": "duplicate",
+                    "trace_id": rec.get("trace_id"),
                     "done": rec["done"], "tokens": len(rec["tokens"]),
                 }
             rec = {
                 "request_id": rid,
                 "session_id": payload.session_id,
+                "trace_id": None if payload.trace is None
+                else payload.trace.get("trace_id"),
                 "tokens": [int(t) for t in payload.first_tokens],
                 "done": False,
                 "finish_reason": None,
@@ -415,12 +440,26 @@ class ReplicaIngest:
                     if rec is not None:
                         rec["tokens"].append(int(tok))
 
+            # ingest.queue hop: submit receipt -> engine admission on the
+            # driver thread; the engine's hops then parent under it
+            ctx = sub.get("trace")
+            tel = self.telemetry
+            if ctx is not None and tel is not None:
+                now = time.time()
+                sid = tel.record_hop(
+                    HOP_INGEST_QUEUE, ctx,
+                    t_start=sub["recv_s"], duration_s=now - sub["recv_s"],
+                )
+                if sid is not None:
+                    ctx = ctx.child(span_id=sid)
+
             try:
                 req = self.engine.add_request(
                     sub["prompt"],
                     SamplingParams(**sub["sampling"]),
                     on_token=on_token,
                     session_id=sub["session_id"],
+                    trace=ctx,
                 )
             except (ValueError, TypeError) as e:
                 # a deterministic rejection (prompt too long, bad sampling

@@ -59,11 +59,29 @@ class RouterRequest:
         prompt: List[int],
         session_id: Optional[str] = None,
         params: Optional[dict] = None,
+        trace=None,
     ):
         self.request_id = str(request_id)
         self.prompt = [int(t) for t in prompt]
         self.session_id = session_id
         self.params = dict(params or {})
+        #: distributed-trace context (telemetry/tracing.py TraceContext or
+        #: None). Minted (or extracted from the client's traceparent) at
+        #: /submit; advanced hop by hop — its span_id is always the LAST
+        #: recorded router-side hop, so the next hop parents under it.
+        self.trace = trace  # guarded_by: _lock
+        #: wall-clock stamp the NEXT router-side hop starts from (submit
+        #: time at mint; then each hop's end)
+        self.trace_t0 = time.time() if trace is not None else None  # guarded_by: _lock
+        #: wall-clock stamp dispatch completed — the stream.deliver hop
+        #: runs from here to the first tokens surfacing at the router
+        self.deliver_t0: Optional[float] = None  # guarded_by: _lock
+        #: span id of the WINNING router.dispatch hop: the stream.deliver
+        #: hop parents under it (req.trace stays at the queue hop so
+        #: re-dispatches land as siblings)
+        self.deliver_parent: Optional[str] = None  # guarded_by: _lock
+        #: stream.deliver recorded (first tokens seen); one hop per request
+        self.delivered_hop = False  # guarded_by: _lock
         self.state = PENDING
         self.replica: Optional[str] = None  # current assignment
         self.tried: List[str] = []  # replicas that failed this request
@@ -127,6 +145,7 @@ class RouterRequest:
     def to_dict(self) -> dict:
         return {
             "request_id": self.request_id,
+            "trace_id": None if self.trace is None else self.trace.trace_id,
             "state": self.state,
             "session_id": self.session_id,
             "replica": self.replica,

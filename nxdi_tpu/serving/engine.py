@@ -46,10 +46,19 @@ from __future__ import annotations
 
 import dataclasses
 import logging
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
+
+from nxdi_tpu.telemetry.tracing import (
+    HOP_ENGINE_DECODE_FIRST,
+    HOP_ENGINE_PREFILL,
+    HOP_HANDOFF_EXPORT,
+    HOP_HANDOFF_IMPORT,
+    TraceContext,
+)
 
 from nxdi_tpu.runtime import faults
 from nxdi_tpu.runtime.application import TAG_PREFIX_PREFILL
@@ -408,13 +417,17 @@ class InferenceEngine:
         request_id: Optional[int] = None,
         arrival_s: Optional[float] = None,
         session_id: Optional[str] = None,
+        trace=None,
     ) -> Request:
         """Queue a request (WAITING). ``on_token(request, token)`` streams
         every generated token as it is sampled. ``arrival_s`` backdates the
         request's arrival for TTFT — it must be in the telemetry ``clock``
         domain (``time.perf_counter`` under the default clock).
         ``session_id`` is the conversation identity the router tier keys
-        affinity on; it rides the request span."""
+        affinity on; it rides the request span. ``trace`` (optional
+        :class:`~nxdi_tpu.telemetry.tracing.TraceContext`) is the request's
+        distributed-trace position: engine-side hop spans (engine.prefill,
+        handoff.export) parent under it and it rides the KV handoff wire."""
         if self.role == "decode":
             raise ValueError(
                 "decode-role engine admits requests via KV handoff only "
@@ -429,9 +442,11 @@ class InferenceEngine:
             # token), copy-on-writing the shared partial block on first
             # write. Elsewhere siblings degrade to plain re-prefills.
             base = dataclasses.replace(params, n=1)
+            # the trace follows the PRIMARY only: one request, one trace —
+            # sibling continuations are engine-internal fan-out
             primary = self.add_request(
                 prompt, base, on_token=on_token, request_id=request_id,
-                arrival_s=arrival_s, session_id=session_id,
+                arrival_s=arrival_s, session_id=session_id, trace=trace,
             )
             for _ in range(params.n - 1):
                 sib = self.add_request(
@@ -450,7 +465,7 @@ class InferenceEngine:
             arrival_s = tel.clock()
         req = Request(
             prompt, params=params, request_id=request_id, on_token=on_token,
-            arrival_s=arrival_s, session_id=session_id,
+            arrival_s=arrival_s, session_id=session_id, trace=trace,
         )
         # ids key the block tables: two LIVE requests sharing one would
         # decode through the same blocks (silent KV corruption) and
@@ -509,11 +524,32 @@ class InferenceEngine:
             # engine steps must not shave that wait off the reported TTFT
             req.span = tel.start_request(
                 tokens_in=len(req.prompt), t_start=req.arrival_s,
-                session_id=req.session_id,
+                session_id=req.session_id, trace=req.trace,
             )
             req.span.phase("queue")
         self.scheduler.add(req)
         return req
+
+    def _trace_hop(self, req: Request, hop: str, t0: Optional[float] = None,
+                   attrs: Optional[dict] = None) -> None:
+        """Record one engine-side hop span for a traced request, ending
+        NOW, and advance the request's context so its next hop parents
+        under this one. ``t0`` (wall clock) overrides the default start —
+        the request's ``trace_t0`` stamp (admission / previous hop end)."""
+        tel = self.telemetry
+        tr = req.trace
+        if tel is None or tr is None:
+            return
+        now = time.time()
+        start = req.trace_t0 if t0 is None else t0
+        if start is None:
+            start = now
+        sid = tel.record_hop(
+            hop, tr, t_start=start, duration_s=now - start, attrs=attrs
+        )
+        if sid is not None:
+            req.trace = tr.child(span_id=sid)
+        req.trace_t0 = now
 
     # -- the engine loop ----------------------------------------------------
     def has_work(self) -> bool:
@@ -846,6 +882,7 @@ class InferenceEngine:
                 req.span.first_token()
                 req.span.phase("decode")
                 req.span.tokens(1)
+            self._trace_hop(req, HOP_ENGINE_PREFILL)
             req.emit(int(toks[req.slot]))
             reason = req.check_finish()
             if reason:
@@ -1000,6 +1037,7 @@ class InferenceEngine:
             req.span.first_token()  # idempotent: a resume keeps the original
             req.span.phase("decode")
             req.span.tokens(1)
+        self._trace_hop(req, HOP_ENGINE_PREFILL)
         req.emit(tok)
         reason = req.check_finish()
         if reason:
@@ -1030,6 +1068,7 @@ class InferenceEngine:
         from nxdi_tpu.kvcache import export_kv_blocks
         from nxdi_tpu.serving.handoff import HandoffPayload
 
+        t0 = time.time()
         req = self._handoffs.get(request_id)
         if req is None:
             raise KeyError(f"request {request_id} is not parked for handoff")
@@ -1060,6 +1099,14 @@ class InferenceEngine:
         if self._handoff_exports is not None:
             self._handoff_exports.inc()
             self._handoff_bytes.inc(payload.nbytes)
+        # export hop covers the payload build; the wire then carries the
+        # advanced context so the decode side's import hop parents under it
+        # (a re-export after a failed import re-stamps — last export wins,
+        # matching which decode replica actually continued the request)
+        self._trace_hop(req, HOP_HANDOFF_EXPORT, t0=t0,
+                        attrs={"bytes": payload.nbytes})
+        if req.trace is not None:
+            payload.trace = req.trace.to_dict()
         return payload
 
     def ack_handoff(self, request_id: int) -> None:
@@ -1088,6 +1135,7 @@ class InferenceEngine:
         from nxdi_tpu.kvcache import import_kv_blocks
         from nxdi_tpu.serving.handoff import HandoffCapacityError
 
+        t0 = time.time()
         if not self.paged:
             raise ValueError("admit_handoff requires the paged KV layout")
         mgr = self.block_manager
@@ -1143,10 +1191,24 @@ class InferenceEngine:
         # from its cursor
         req.generated = [int(t) for t in payload.first_tokens]
         sch.place_imported(req, slot, committed)
+        # continue the prefill side's trace: the wire context's span_id is
+        # the exporting replica's handoff.export hop, so this replica's
+        # import/decode hops land as its children in the assembled tree
+        req.trace = TraceContext.from_dict(payload.trace) \
+            if payload.trace is not None else None
+        req.trace_t0 = t0
+        self._trace_hop(req, HOP_HANDOFF_IMPORT, t0=t0,
+                        attrs={"bytes": payload.nbytes})
+        # the handed-off first token is available to the client the moment
+        # the import commits — near-zero duration by construction; residual
+        # delivery time is the router's stream.deliver hop
+        self._trace_hop(req, HOP_ENGINE_DECODE_FIRST,
+                        attrs={"seeded_tokens": len(req.generated)})
         tel = self.telemetry
         if tel is not None and tel.enabled:
             req.span = tel.start_request(
                 tokens_in=len(req.prompt), session_id=req.session_id,
+                trace=req.trace,
             )
             req.span.first_token()
             req.span.phase("decode")

@@ -121,6 +121,12 @@ class HandoffPayload:
     #: host K/V rows from :func:`nxdi_tpu.kvcache.export_kv_blocks`
     kv: Dict[str, np.ndarray] = field(repr=False, default_factory=dict)
     session_id: Optional[str] = None
+    #: distributed-trace context of the exporting side (the ``to_dict`` of
+    #: a :class:`~nxdi_tpu.telemetry.tracing.TraceContext` whose span_id is
+    #: the prefill-side ``handoff.export`` hop) — OPTIONAL on the wire and
+    #: absent pre-tracing, so no wire-version bump: the decode side parents
+    #: its import/decode hops under it when present
+    trace: Optional[dict] = None
     version: int = HANDOFF_WIRE_VERSION
 
     @property
@@ -148,6 +154,7 @@ class HandoffPayload:
             "version": self.version,
             "request_id": self.request_id,
             "session_id": self.session_id,
+            "trace": None if self.trace is None else dict(self.trace),
             "prompt": list(self.prompt),
             "first_tokens": list(self.first_tokens),
             "committed": self.committed,
@@ -179,6 +186,8 @@ class HandoffPayload:
             dtype=str(obj["dtype"]),
             kv={"k": _decode_array(obj["k"]), "v": _decode_array(obj["v"])},
             session_id=obj.get("session_id"),
+            trace=obj.get("trace") if isinstance(obj.get("trace"), dict)
+            else None,
             version=int(version),
         )
 

@@ -64,6 +64,7 @@ class Request:
         on_token: Optional[Callable[["Request", int], None]] = None,
         arrival_s: Optional[float] = None,
         session_id: Optional[str] = None,
+        trace=None,
     ):
         self.request_id = (
             int(request_id) if request_id is not None else next(Request._ids)
@@ -79,6 +80,15 @@ class Request:
         #: same replica's warm KV/prefix state while it stays dispatchable.
         #: First-class even off-router so spans carry it end to end.
         self.session_id = None if session_id is None else str(session_id)
+        #: distributed-trace context (telemetry/tracing.py TraceContext or
+        #: None): the parent for the hop spans this request records next
+        #: (engine.prefill, handoff.export, ...). Requests admitted outside
+        #: the routed plane carry None and record no hops.
+        self.trace = trace
+        #: wall-clock stamp of engine admission — the start of the
+        #: engine-side hop spans (hop spans join across processes, so they
+        #: ride the wall clock, not the telemetry clock)
+        self.trace_t0 = time.time() if trace is not None else None
 
         self.state = WAITING
         self.generated: List[int] = []

@@ -70,6 +70,8 @@ Metric catalog (labels in parens):
 ``nxdi_numerics_margin``              histogram  (submodel, bucket)
 ``nxdi_sentinel_replays_total``       counter    (kind, outcome)
 ``nxdi_sentinel_replay_mismatch_total``  counter  (kind: shadow|preemption)
+``nxdi_trace_hop_seconds``            histogram  (hop) distributed-trace hop duration
+``nxdi_traces_dropped_total``         counter    hop spans evicted from the trace ring
 ====================================  =========  ==================================
 
 The ``nxdi_numerics_*`` / ``nxdi_sentinel_*`` series belong to the numerics
@@ -77,6 +79,13 @@ sentinel (:mod:`~nxdi_tpu.telemetry.sentinel`, ``TpuConfig(sentinel=...)``)
 and are pre-seeded at attach time so absence-of-errors is observable from
 the first scrape; a nonzero NaN/Inf count or replay mismatch fires the
 ``numerics`` postmortem trigger through the flight recorder.
+
+The ``nxdi_trace_*`` series belong to distributed request tracing
+(:mod:`~nxdi_tpu.telemetry.tracing`, ``TelemetryConfig(trace=...)``): hop
+spans land in a bounded per-replica :class:`~nxdi_tpu.telemetry.tracing.
+TraceBuffer` served at ``/traces`` and federated by the fleet monitor
+into per-request trace trees; the router tier owns a sibling pair of the
+same two series in its own registry for the router-side hops.
 
 Fleet observatory series (telemetry/fleet.py — emitted by a
 :class:`~nxdi_tpu.telemetry.fleet.FleetMonitor`'s merged view, NOT by
@@ -148,6 +157,13 @@ from nxdi_tpu.telemetry.flight import FlightRecorder, StepRecord
 from nxdi_tpu.telemetry.sentinel import NumericsSentinel
 from nxdi_tpu.telemetry.slo import SloTracker, breach_kinds
 from nxdi_tpu.telemetry.spans import NULL_SPAN, RequestSpan, SpanTracker
+from nxdi_tpu.telemetry.tracing import (
+    TraceBuffer,
+    TraceContext,
+    TraceSampler,
+    assemble_traces,
+    critical_path,
+)
 
 __all__ = [
     "Telemetry",
@@ -166,6 +182,11 @@ __all__ = [
     "FleetMonitor",
     "LoadSignal",
     "rank_load_signals",
+    "TraceBuffer",
+    "TraceContext",
+    "TraceSampler",
+    "assemble_traces",
+    "critical_path",
     "merge_snapshots",
     "merge_perfetto_traces",
     "HEALTHY",
@@ -204,7 +225,8 @@ class Telemetry:
 
     def __init__(self, enabled: bool = True, detail: str = "basic",
                  max_spans: int = 256, clock=None, replica_id=None,
-                 wall_clock=None):
+                 wall_clock=None, trace: bool = True,
+                 trace_buffer: int = 256, trace_sample_rate: float = 1.0):
         if detail not in DETAIL_LEVELS:
             raise ValueError(
                 f"telemetry detail must be one of {DETAIL_LEVELS}, got {detail!r}"
@@ -253,6 +275,30 @@ class Telemetry:
         if self.enabled:
             self.spans_dropped_total.inc(0)
         self.spans = SpanTracker(self, max_spans=max_spans)
+        # distributed tracing (telemetry/tracing.py): per-replica hop-span
+        # ring + deterministic sampler for contexts THIS process mints.
+        # Rides the enabled gate like every other surface — detail="off"
+        # keeps its nothing-recorded contract and record_hop is a no-op.
+        self.tracing = bool(trace) and self.enabled
+        self.traces_dropped_total = r.counter(
+            "nxdi_traces_dropped_total",
+            "trace hop spans evicted from the bounded trace buffer "
+            "(nonzero = exported trace history is truncated)",
+        )
+        self.trace_hop_seconds = r.histogram(
+            "nxdi_trace_hop_seconds",
+            "distributed-trace hop duration by typed hop name",
+            ("hop",), bounds=TIME_BOUNDS_S,
+        )
+        self.trace_sampler = TraceSampler(trace_sample_rate)
+        self.trace_buffer = TraceBuffer(
+            trace_buffer, dropped_counter=self.traces_dropped_total,
+            hop_seconds=self.trace_hop_seconds,
+        )
+        if self.tracing:
+            # pre-seed the zero series: "no drops" and "not tracing" must
+            # read differently from the first scrape
+            self.traces_dropped_total.inc(0)
         disp_labels = ("submodel", "bucket", "steps")
         self.dispatches_total = r.counter(
             "nxdi_dispatches_total",
@@ -383,6 +429,10 @@ class Telemetry:
         # Gated on enabled: "off" keeps its nothing-recorded contract.
         if self.enabled:
             self.add_snapshot_extra("_process", self.process_info)
+        if self.tracing:
+            # hop spans ride every JSON snapshot so the fleet monitor's
+            # regular /snapshot poll federates traces with no extra probe
+            self.add_snapshot_extra("_traces", self.trace_buffer.snapshot)
 
     def process_info(self) -> dict:
         """Identity + freshness stamp embedded as the ``_process`` snapshot
@@ -410,9 +460,45 @@ class Telemetry:
                 detail=getattr(tc, "detail", "basic"),
                 max_spans=getattr(tc, "max_spans", 256),
                 replica_id=getattr(tc, "replica_id", None),
+                trace=getattr(tc, "trace", True),
+                trace_buffer=getattr(tc, "trace_buffer", 256),
+                trace_sample_rate=getattr(tc, "trace_sample_rate", 1.0),
             )
         tel.role = getattr(tpu_config, "role", "unified")
         return tel
+
+    # -- distributed tracing -------------------------------------------------
+    def mint_trace(self):
+        """A fresh root :class:`~nxdi_tpu.telemetry.tracing.TraceContext`
+        for a request that arrived without a (valid) ``traceparent`` —
+        sampled by the deterministic credit accumulator. None when tracing
+        is off, so callers keep one None-check like every other surface."""
+        if not self.tracing:
+            return None
+        return TraceContext.mint(sampled=self.trace_sampler.sample())
+
+    def record_hop(self, hop: str, trace, *, t_start: float,
+                   duration_s: float, parent_span_id=None, attrs=None):
+        """Record one finished hop span against ``trace`` (a TraceContext).
+        No-op — returning None — when tracing is off or the trace is
+        unsampled, so hot paths pay one boolean check. Returns the hop's
+        span id otherwise (the parent for the request's next hop).
+        ``t_start`` is WALL-clock unix seconds: hop spans join across
+        processes and cannot ride the per-process telemetry clock."""
+        if not self.tracing or trace is None or not trace.sampled:
+            return None
+        return self.trace_buffer.record(
+            hop, trace.trace_id,
+            parent_span_id if parent_span_id is not None else trace.span_id,
+            t_start=t_start, duration_s=duration_s,
+            replica=self.replica_id, attrs=attrs,
+        )
+
+    def trace_spans(self):
+        """Retained hop spans (the ``/traces`` endpoint body)."""
+        if not self.tracing:
+            return []
+        return self.trace_buffer.snapshot()
 
     # -- hot-path recorders -------------------------------------------------
     def record_dispatch(
@@ -459,15 +545,18 @@ class Telemetry:
             )
 
     def start_request(self, tokens_in: int = 0, t_start=None,
-                      session_id=None):
+                      session_id=None, trace=None):
         """``t_start`` (optional, ``clock`` domain) backdates the span to the
         request's true arrival so TTFT includes queueing before this call;
         ``session_id`` tags the span with its conversation identity (the
-        router tier's affinity key)."""
+        router tier's affinity key); ``trace`` (optional TraceContext)
+        stamps the span with its distributed-trace identity so postmortem
+        bundles link back to the fleet trace."""
         if not self.enabled:
             return NULL_SPAN
         return self.spans.start(
-            tokens_in=tokens_in, t_start=t_start, session_id=session_id
+            tokens_in=tokens_in, t_start=t_start, session_id=session_id,
+            trace=trace,
         )
 
     def record_spec_window(self, counts, path: str) -> None:

@@ -221,12 +221,19 @@ def telemetry_routes(tel) -> list:
             indent=2,
         )
 
+    def traces():
+        return json.dumps({
+            "replica_id": tel.replica_id,
+            "spans": tel.trace_spans(),
+        })
+
     return [
         ("/healthz", "application/json", healthz),
         ("/metrics.json", "application/json",
          lambda: json.dumps(tel.snapshot(), indent=2)),
         ("/snapshot", "application/json",
          lambda: json.dumps(tel.snapshot(), indent=2)),
+        ("/traces", "application/json", traces),
         ("/trace.json", "application/json",
          lambda: json.dumps(tel.perfetto_trace())),
         ("/postmortem", "application/json", postmortem),
@@ -241,6 +248,7 @@ class MetricsServer:
     - ``/metrics.json``  JSON snapshot
     - ``/snapshot``      alias of ``/metrics.json`` (router-probe surface)
     - ``/healthz``       liveness JSON (router-probe surface)
+    - ``/traces``        distributed-trace hop spans (telemetry/tracing.py)
     - ``/trace.json``    Perfetto trace_events
     - ``/postmortem``    manual flight-recorder dump (404 without a
       recorder attached); the bundle is returned AND written to the

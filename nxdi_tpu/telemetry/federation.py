@@ -220,3 +220,88 @@ def merge_perfetto_traces(traces: Dict[str, dict]) -> dict:
                 ev["args"] = args
             events.append(ev)
     return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+#: pid offset of the distributed-trace track inside a replica's process
+#: group — past the request (1) and per-slot (2) tracks a replica's own
+#: Perfetto export occupies, so :func:`traces_to_perfetto` output overlays
+#: cleanly onto :func:`merge_perfetto_traces` output in the same pid space
+TRACE_TRACK_PID = 3
+
+
+def _flow_id(span_id) -> int:
+    """Stable 31-bit Perfetto flow id from a hex span id."""
+    try:
+        return int(str(span_id), 16) & 0x7FFFFFFF
+    except (TypeError, ValueError):
+        return abs(hash(span_id)) & 0x7FFFFFFF
+
+
+def traces_to_perfetto(traces: List[dict]) -> dict:
+    """Render assembled distributed traces (one record per ``trace_id``,
+    :func:`~nxdi_tpu.telemetry.tracing.assemble_traces` shape) as a
+    Perfetto trace: one process group per replica (pid =
+    ``replica_index * PID_STRIDE + TRACE_TRACK_PID``, same stride as the
+    merged fleet trace so the two files share a pid layout), one thread
+    row per request inside each group, hop spans as complete events, and
+    every cross-replica parent→child hop edge as a flow arrow — the
+    request's path through the fleet reads as arrows hopping between
+    process groups in ui.perfetto.dev.
+
+    Timestamps are wall-clock microseconds rebased to the earliest hop
+    start across all traces, so the file opens at t=0 regardless of when
+    the fleet ran."""
+    spans = [s for t in traces for s in t.get("spans", [])]
+    replicas = sorted({str(s.get("replica") or "?") for s in spans})
+    pid_of = {
+        r: i * PID_STRIDE + TRACE_TRACK_PID for i, r in enumerate(replicas)
+    }
+    t0 = min((float(s.get("t_start", 0.0)) for s in spans), default=0.0)
+    events: List[dict] = []
+    for r in replicas:
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid_of[r],
+            "args": {"name": f"{r} · distributed trace"},
+        })
+    for tid, trace in enumerate(
+        sorted(traces, key=lambda t: float(t.get("t_start", 0.0))), start=1
+    ):
+        short = str(trace.get("trace_id", "?"))[:8]
+        by_id = {s.get("span_id"): s for s in trace.get("spans", [])}
+        for r in sorted({
+            str(s.get("replica") or "?") for s in trace.get("spans", [])
+        }):
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid_of[r],
+                "tid": tid, "args": {"name": f"trace {short}"},
+            })
+        for s in trace.get("spans", []):
+            pid = pid_of[str(s.get("replica") or "?")]
+            ts = (float(s.get("t_start", 0.0)) - t0) * 1e6
+            # floor 1 µs so instant-ish hops stay clickable in the UI
+            dur = max(float(s.get("duration_s", 0.0)) * 1e6, 1.0)
+            args = {
+                "trace_id": trace.get("trace_id"),
+                "span_id": s.get("span_id"),
+                "parent_span_id": s.get("parent_span_id"),
+            }
+            args.update(s.get("attrs") or {})
+            events.append({
+                "ph": "X", "name": s.get("hop", "?"), "cat": "hop",
+                "pid": pid, "tid": tid, "ts": ts, "dur": dur, "args": args,
+            })
+            parent = by_id.get(s.get("parent_span_id"))
+            if parent is None or parent.get("replica") == s.get("replica"):
+                continue  # flow arrows only where the chain changes process
+            fid = _flow_id(s.get("span_id"))
+            p_pid = pid_of[str(parent.get("replica") or "?")]
+            p_ts = (float(parent.get("t_start", 0.0)) - t0) * 1e6
+            events.append({
+                "ph": "s", "name": "hop", "cat": "trace", "id": fid,
+                "pid": p_pid, "tid": tid, "ts": p_ts,
+            })
+            events.append({
+                "ph": "f", "bp": "e", "name": "hop", "cat": "trace",
+                "id": fid, "pid": pid, "tid": tid, "ts": ts,
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

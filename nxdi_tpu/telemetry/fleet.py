@@ -271,6 +271,10 @@ class FleetMonitor:
         # join every fleet export next to the monitor's own — see
         # attach_registry()
         self._extra_registries: List[MetricsRegistry] = []  # guarded_by: _lock
+        # live hop-span sources of co-located tiers (the router's trace
+        # buffer) joining trace assembly next to the polled replica spans —
+        # see attach_trace_source()
+        self._extra_trace_sources: List[Callable[[], list]] = []  # guarded_by: _lock
         # the monitor's PERSISTENT series (edge counters survive re-merges;
         # the merged member view is rebuilt fresh on every export)
         self.registry = MetricsRegistry()
@@ -477,6 +481,43 @@ class FleetMonitor:
         with self._lock:
             self._extra_registries.append(registry)
 
+    def attach_trace_source(self, source: Callable[[], list]) -> None:
+        """Join a co-located tier's live hop-span buffer (e.g. the router's
+        ``TraceBuffer.snapshot``) into :meth:`assembled_traces` — the
+        router-side hops (router.queue/dispatch, handoff.transfer,
+        stream.deliver) land in the same per-request trees as the polled
+        replica spans."""
+        with self._lock:
+            self._extra_trace_sources.append(source)
+
+    def trace_spans(self) -> List[dict]:
+        """Every hop span the fleet currently retains: the ``_traces``
+        extra of each included replica's last snapshot (rides the SAME
+        ``/snapshot`` the health poll already fetches — no new probe
+        round) plus any attached live sources."""
+        with self._lock:
+            snaps = [rep.snapshot for rep in self._included()]
+            sources = list(self._extra_trace_sources)
+        spans: List[dict] = []
+        for snap in snaps:
+            extra = (snap or {}).get("_traces")
+            if isinstance(extra, list):
+                spans.extend(s for s in extra if isinstance(s, dict))
+        for source in sources:
+            try:
+                spans.extend(s for s in source() if isinstance(s, dict))
+            except Exception:  # noqa: BLE001 — assembly is a debug surface
+                continue
+        return spans
+
+    def assembled_traces(self) -> List[dict]:
+        """Fleet-wide trace assembly: every retained hop span joined by
+        trace_id into one tree per request (telemetry/tracing.py
+        ``assemble_traces``) — the ``cli.trace`` waterfall's data source."""
+        from nxdi_tpu.telemetry.tracing import assemble_traces
+
+        return assemble_traces(self.trace_spans())
+
     def fleet_registry(self) -> Tuple[MetricsRegistry, List[str]]:
         """Fresh merged registry: included member snapshots (counters
         summed, gauges replica-labeled, histograms bucket-exact) + the
@@ -571,6 +612,8 @@ class FleetMonitor:
              lambda: json.dumps(self.snapshot(), indent=2)),
             ("/snapshot", "application/json",
              lambda: json.dumps(self.snapshot(), indent=2)),
+            ("/traces", "application/json",
+             lambda: json.dumps({"traces": self.assembled_traces()})),
             ("/trace.json", "application/json",
              lambda: json.dumps(self.perfetto_trace())),
             ("/metrics", PROM_CONTENT_TYPE, self.prometheus_text),

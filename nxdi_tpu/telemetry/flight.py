@@ -464,12 +464,23 @@ class FlightRecorder:
             seq = self._bundle_seq
             self._bundle_seq += 1
         dropped = tel.spans_dropped_total.total() + dropped_ring
+        # distributed-trace correlation: when the breaching request carries
+        # a trace, the bundle names it and embeds this replica's retained
+        # hop spans for it — a postmortem reader can jump straight from the
+        # bundle to the fleet-wide waterfall (cli.trace --trace-id)
+        trace_id = (span_dict or {}).get("trace_id")
+        trace_hops = (
+            tel.trace_buffer.spans_for(trace_id)
+            if trace_id and getattr(tel, "tracing", False) else []
+        )
         bundle = {
             "trigger": trigger,
             "detail": detail or {},
             "t": now,
             "step": self._step_counter - 1,
             "request_id": request_id,
+            "trace_id": trace_id,
+            "trace_hops": trace_hops,
             "request_span": span_dict,
             "step_records": [r.to_dict() for r in records],
             "scheduler": self.state_fn() if self.state_fn is not None else None,

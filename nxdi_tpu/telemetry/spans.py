@@ -24,17 +24,21 @@ PHASES = ("queue", "pad", "prefill", "decode")
 
 class RequestSpan:
     __slots__ = (
-        "request_id", "session_id", "t_start", "t_end", "phases", "tokens_in",
-        "tokens_out", "ttft_s", "_tel", "_open", "_finished",
+        "request_id", "session_id", "trace", "t_start", "t_end", "phases",
+        "tokens_in", "tokens_out", "ttft_s", "_tel", "_open", "_finished",
     )
 
     def __init__(self, tel, request_id: int, t_start: float,
-                 session_id: Optional[str] = None):
+                 session_id: Optional[str] = None, trace=None):
         self._tel = tel
         self.request_id = request_id
         # conversation identity (router session affinity); rides the span so
         # postmortem bundles and Perfetto args can group multi-turn traffic
         self.session_id = session_id
+        # distributed-trace identity (telemetry/tracing.py TraceContext or
+        # None): links this per-replica span to its fleet-wide trace tree —
+        # postmortem bundles and /traces correlate through it
+        self.trace = trace
         self.t_start = t_start
         self.t_end: Optional[float] = None
         # [(name, t_begin, t_end)] — a handful of entries, never per-token
@@ -92,9 +96,12 @@ class RequestSpan:
 
     # -- views --------------------------------------------------------------
     def to_dict(self) -> dict:
+        tr = self.trace
         return {
             "request_id": self.request_id,
             "session_id": self.session_id,
+            "trace_id": None if tr is None else tr.trace_id,
+            "trace_span_id": None if tr is None else tr.span_id,
             "t_start": self.t_start,
             "t_end": self.t_end,
             "phases": [
@@ -143,14 +150,14 @@ class SpanTracker:
         self._next_id = 0
 
     def start(self, tokens_in: int = 0, t_start: Optional[float] = None,
-              session_id: Optional[str] = None) -> RequestSpan:
+              session_id: Optional[str] = None, trace=None) -> RequestSpan:
         """``t_start`` backdates the span to the request's true arrival time
         (same clock domain as ``tel.clock``) so TTFT under load includes the
         queueing a late ``start`` call would otherwise omit."""
         span = RequestSpan(
             self._tel, self._next_id,
             self._tel.clock() if t_start is None else t_start,
-            session_id=session_id,
+            session_id=session_id, trace=trace,
         )
         self._next_id += 1
         if tokens_in:

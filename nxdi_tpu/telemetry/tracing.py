@@ -1,0 +1,446 @@
+"""Distributed request tracing: cross-replica trace propagation with
+critical-path TTFT attribution.
+
+Since the replica router and prefill/decode disaggregation, one request's
+life crosses process boundaries — router dispatch, prefill replica, KV
+handoff, decode replica, possibly a failover — while every span surface
+(:mod:`~nxdi_tpu.telemetry.spans`, flight recorder, Perfetto export) is
+per-replica. This module is the fleet-wide layer: a W3C-traceparent-style
+:class:`TraceContext` is minted at router submit, propagated through every
+hop of the request plane (submit payload ``traceparent`` key, real
+``traceparent`` HTTP header via ``router.http_json``, and the KV handoff
+wire payload's ``trace`` key), and each hop records one typed
+:data:`HOPS` span into a bounded per-process :class:`TraceBuffer` exposed
+via ``/traces``. The fleet monitor joins the per-replica buffers by
+``trace_id`` (:func:`assemble_traces`) and :func:`critical_path`
+decomposes the client-observed TTFT into per-hop contributions — the
+signals the SLO-aware placement loop needs.
+
+Header format (W3C trace context, version ``00``)::
+
+    traceparent: 00-<32 hex trace_id>-<16 hex span_id>-<2 hex flags>
+
+Parsing is fail-open by contract: a malformed or oversized header yields
+``None`` and the receiver mints a fresh context — propagation bugs degrade
+to per-replica traces, never to a 500.
+
+Sampling is the numerics sentinel's deterministic credit-accumulator
+pattern (:class:`TraceSampler` — no rng, no modulo bias): every submit
+adds ``rate`` to a credit; crossing 1.0 samples the trace and pays the
+credit down. Unsampled requests still carry (and return) a trace id —
+only hop *recording* is skipped — so the overhead bound is exact and
+clients can always correlate.
+
+Hop spans use the WALL clock (unix seconds): they must join across
+processes, unlike :class:`~nxdi_tpu.telemetry.spans.RequestSpan` which
+stays in the per-process telemetry clock domain. Cross-host skew shows up
+as overlap/gap between hops; chain-ordered clipping in
+:func:`critical_path` keeps the attributed sum bounded by the window
+regardless.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import deque
+from typing import Deque, Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "HOPS",
+    "TRACEPARENT_KEY",
+    "TraceBuffer",
+    "TraceContext",
+    "TraceSampler",
+    "assemble_traces",
+    "critical_path",
+    "hop_rank",
+    "new_span_id",
+    "new_trace_id",
+]
+
+#: the JSON payload key AND HTTP header name the context rides under
+TRACEPARENT_KEY = "traceparent"
+
+#: the one header version this build speaks (W3C trace context)
+TRACE_VERSION = "00"
+
+#: hard parse bound — anything longer is rejected before splitting, so a
+#: hostile or corrupted header can never cost more than a length check
+MAX_HEADER_LEN = 128
+
+# -- hop taxonomy (canonical critical-path order) ---------------------------
+HOP_ROUTER_QUEUE = "router.queue"
+HOP_ROUTER_DISPATCH = "router.dispatch"
+HOP_INGEST_QUEUE = "ingest.queue"
+HOP_ENGINE_PREFILL = "engine.prefill"
+HOP_HANDOFF_EXPORT = "handoff.export"
+HOP_HANDOFF_TRANSFER = "handoff.transfer"
+HOP_HANDOFF_IMPORT = "handoff.import"
+HOP_ENGINE_DECODE_FIRST = "engine.decode_first_token"
+HOP_STREAM_DELIVER = "stream.deliver"
+
+#: every typed hop, in the order the request plane traverses them — the
+#: tiebreak :func:`critical_path` clips overlapping intervals by
+HOPS = (
+    HOP_ROUTER_QUEUE,
+    HOP_ROUTER_DISPATCH,
+    HOP_INGEST_QUEUE,
+    HOP_ENGINE_PREFILL,
+    # transfer ranks BEFORE the export/import legs it encloses: the
+    # router-initiated transfer RTT contains the replica-side export and
+    # import wall windows, so chain-ordered clipping credits the enclosure
+    # once (to transfer) instead of splitting the head off to nobody
+    HOP_HANDOFF_TRANSFER,
+    HOP_HANDOFF_EXPORT,
+    HOP_HANDOFF_IMPORT,
+    HOP_ENGINE_DECODE_FIRST,
+    HOP_STREAM_DELIVER,
+)
+
+_HOP_RANK = {name: i for i, name in enumerate(HOPS)}
+
+_HEX = set("0123456789abcdef")
+
+
+def hop_rank(name: str) -> int:
+    """Chain position of a hop name (unknown names sort last): the
+    deterministic tiebreak for same-instant spans."""
+    return _HOP_RANK.get(name, len(HOPS))
+
+
+def _hex_id(nbytes: int) -> str:
+    # os.urandom, not random: id minting must not perturb any seeded rng
+    # stream the engines replay for sampled-decode parity
+    out = os.urandom(nbytes).hex()
+    while set(out) == {"0"}:  # all-zero ids are invalid on the wire
+        out = os.urandom(nbytes).hex()
+    return out
+
+
+def new_trace_id() -> str:
+    return _hex_id(16)
+
+
+def new_span_id() -> str:
+    return _hex_id(8)
+
+
+def _is_hex(s: str, n: int) -> bool:
+    return len(s) == n and set(s) <= _HEX
+
+
+class TraceContext:
+    """One request's position in its trace: which trace, which span is the
+    current parent, and whether hops record. Immutable by convention —
+    propagation hands out children (:meth:`child`), never mutates."""
+
+    __slots__ = ("trace_id", "span_id", "parent_span_id", "sampled")
+
+    def __init__(self, trace_id: str, span_id: str,
+                 parent_span_id: Optional[str] = None, sampled: bool = True):
+        self.trace_id = str(trace_id)
+        self.span_id = str(span_id)
+        self.parent_span_id = (
+            None if parent_span_id is None else str(parent_span_id)
+        )
+        self.sampled = bool(sampled)
+
+    @classmethod
+    def mint(cls, sampled: bool = True) -> "TraceContext":
+        """A fresh root context (router submit, or a replica receiving a
+        request with no/invalid header)."""
+        return cls(new_trace_id(), new_span_id(), None, sampled)
+
+    def child(self, span_id: Optional[str] = None) -> "TraceContext":
+        """A context one hop deeper: same trace, new span, parented here."""
+        return TraceContext(
+            self.trace_id, span_id or new_span_id(), self.span_id, self.sampled
+        )
+
+    # -- wire ----------------------------------------------------------------
+    def to_header(self) -> str:
+        flags = "01" if self.sampled else "00"
+        return f"{TRACE_VERSION}-{self.trace_id}-{self.span_id}-{flags}"
+
+    @classmethod
+    def from_header(cls, value) -> Optional["TraceContext"]:
+        """Parse a ``traceparent`` value; ``None`` on ANY malformation
+        (wrong type, oversized, bad field widths, non-hex, all-zero ids,
+        reserved version) — the caller mints a fresh context instead.
+        Never raises: a hostile header must not 500 the request plane."""
+        if not isinstance(value, str) or len(value) > MAX_HEADER_LEN:
+            return None
+        parts = value.strip().split("-")
+        if len(parts) != 4:
+            return None
+        version, trace_id, span_id, flags = parts
+        if not _is_hex(version, 2) or version == "ff":
+            return None
+        if not _is_hex(trace_id, 32) or set(trace_id) == {"0"}:
+            return None
+        if not _is_hex(span_id, 16) or set(span_id) == {"0"}:
+            return None
+        if not _is_hex(flags, 2):
+            return None
+        return cls(trace_id, span_id, None, bool(int(flags, 16) & 1))
+
+    def to_dict(self) -> dict:
+        """JSON-safe form (the handoff wire payload's ``trace`` key)."""
+        return {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": self.parent_span_id,
+            "sampled": self.sampled,
+        }
+
+    @classmethod
+    def from_dict(cls, obj) -> Optional["TraceContext"]:
+        """Inverse of :meth:`to_dict`; ``None`` on anything malformed (the
+        handoff ``trace`` key is optional and backward-compatible)."""
+        if not isinstance(obj, dict):
+            return None
+        tid, sid = obj.get("trace_id"), obj.get("span_id")
+        if not isinstance(tid, str) or not _is_hex(tid, 32):
+            return None
+        if not isinstance(sid, str) or not _is_hex(sid, 16):
+            return None
+        parent = obj.get("parent_span_id")
+        if parent is not None and (
+            not isinstance(parent, str) or not _is_hex(parent, 16)
+        ):
+            parent = None
+        return cls(tid, sid, parent, bool(obj.get("sampled", True)))
+
+    def __repr__(self) -> str:
+        return f"TraceContext({self.to_header()})"
+
+
+class TraceSampler:
+    """Deterministic head sampling, the sentinel's no-rng credit pattern:
+    ``rate`` accumulates per decision and every whole credit samples one
+    trace — exactly ``rate`` of submits sample, with no rng stream to
+    perturb and no modulo aliasing against request arrival patterns."""
+
+    def __init__(self, rate: float = 1.0):
+        self.rate = min(max(float(rate), 0.0), 1.0)
+        self._lock = threading.Lock()
+        self._credit = 0.0  # guarded_by: _lock
+
+    def sample(self) -> bool:
+        if self.rate <= 0.0:
+            return False
+        with self._lock:
+            self._credit += self.rate
+            if self._credit >= 1.0 - 1e-9:
+                self._credit -= 1.0
+                return True
+            return False
+
+
+class TraceBuffer:
+    """Bounded ring of finished hop spans (one per process: each replica's
+    telemetry owns one, the router owns one). Overflow is NOT silent —
+    every eviction counts into the pre-seeded
+    ``nxdi_traces_dropped_total``, so truncated trace history is
+    observable from the first scrape. Hop durations additionally feed the
+    ``nxdi_trace_hop_seconds{hop}`` histogram when one is bound."""
+
+    def __init__(self, capacity: int = 256, dropped_counter=None,
+                 hop_seconds=None):
+        self.capacity = max(int(capacity), 1)
+        self._dropped = dropped_counter
+        self._hop_seconds = hop_seconds
+        self._lock = threading.Lock()
+        self._spans: Deque[dict] = deque()  # guarded_by: _lock
+
+    def record(
+        self,
+        hop: str,
+        trace_id: str,
+        parent_span_id: Optional[str] = None,
+        *,
+        t_start: float,
+        duration_s: float,
+        replica: Optional[str] = None,
+        span_id: Optional[str] = None,
+        attrs: Optional[dict] = None,
+    ) -> str:
+        """Append one finished hop span; returns its span id (minted when
+        not supplied) so the call site can parent the NEXT hop to it.
+        ``t_start`` is wall-clock unix seconds — hop spans join across
+        processes, so they cannot ride the per-process telemetry clock."""
+        sid = span_id if span_id is not None else new_span_id()
+        span = {
+            "hop": str(hop),
+            "trace_id": str(trace_id),
+            "span_id": sid,
+            "parent_span_id": parent_span_id,
+            "replica": replica,
+            "t_start": float(t_start),
+            "duration_s": max(float(duration_s), 0.0),
+        }
+        if attrs:
+            span["attrs"] = dict(attrs)
+        dropped = 0
+        with self._lock:
+            self._spans.append(span)
+            while len(self._spans) > self.capacity:
+                self._spans.popleft()
+                dropped += 1
+        # metric updates stay OUTSIDE the buffer lock: registry series take
+        # their own locks and nothing here needs the pair held together
+        if dropped and self._dropped is not None:
+            self._dropped.inc(dropped)
+        if self._hop_seconds is not None:
+            self._hop_seconds.observe(span["duration_s"], hop=span["hop"])
+        return sid
+
+    def snapshot(self) -> List[dict]:
+        """Copies of every retained hop span (the ``/traces`` body and the
+        ``_traces`` snapshot extra)."""
+        with self._lock:
+            return [dict(s) for s in self._spans]
+
+    def spans_for(self, trace_id: str) -> List[dict]:
+        tid = str(trace_id)
+        return [s for s in self.snapshot() if s["trace_id"] == tid]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._spans)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+
+
+# -- fleet-side assembly -----------------------------------------------------
+def _span_end(s: dict) -> float:
+    return float(s.get("t_start", 0.0)) + float(s.get("duration_s", 0.0))
+
+
+def assemble_traces(spans: Iterable[dict]) -> List[dict]:
+    """Join hop spans gathered from any number of per-process buffers into
+    one record per ``trace_id``: spans de-duplicated by span id (a hop can
+    arrive via both ``/traces`` and the ``_traces`` snapshot extra) and
+    ordered by start time (chain rank tiebreak), plus the trace's wall
+    window. Parent/child structure stays in the spans' own
+    ``parent_span_id`` links — :func:`span_depths` derives tree depth for
+    rendering."""
+    by_trace: Dict[str, Dict[str, dict]] = {}
+    for s in spans:
+        if not isinstance(s, dict):
+            continue
+        tid = s.get("trace_id")
+        if not tid:
+            continue
+        by_trace.setdefault(str(tid), {}).setdefault(
+            str(s.get("span_id")), s
+        )
+    traces = []
+    for tid, by_span in by_trace.items():
+        hops = sorted(
+            by_span.values(),
+            key=lambda h: (float(h.get("t_start", 0.0)),
+                           hop_rank(h.get("hop", ""))),
+        )
+        t0 = min(float(h.get("t_start", 0.0)) for h in hops)
+        t1 = max(_span_end(h) for h in hops)
+        traces.append({
+            "trace_id": tid,
+            "spans": hops,
+            "t_start": t0,
+            "t_end": t1,
+            "duration_s": t1 - t0,
+            "hops": [h.get("hop") for h in hops],
+            "replicas": sorted({
+                str(h.get("replica")) for h in hops if h.get("replica")
+            }),
+        })
+    traces.sort(key=lambda t: t["t_start"])
+    return traces
+
+
+def span_depths(spans: List[dict]) -> Dict[str, int]:
+    """Tree depth per span id from the ``parent_span_id`` links (orphaned
+    parents — e.g. the client's root span, which no buffer records — count
+    one level like a present root). Cycle-safe: depth resolution is
+    bounded by the span count."""
+    by_id = {s.get("span_id"): s for s in spans}
+    depths: Dict[str, int] = {}
+
+    def depth_of(sid, hops_left: int) -> int:
+        if sid in depths:
+            return depths[sid]
+        s = by_id.get(sid)
+        parent = None if s is None else s.get("parent_span_id")
+        if parent is None or hops_left <= 0:
+            d = 0
+        elif parent in by_id:
+            d = depth_of(parent, hops_left - 1) + 1
+        else:
+            d = 1  # parent exists but was recorded elsewhere / never
+        depths[sid] = d
+        return d
+
+    for sid in by_id:
+        depth_of(sid, len(by_id))
+    return depths
+
+
+def critical_path(
+    trace: dict, window: Optional[Tuple[float, float]] = None
+) -> dict:
+    """Decompose a wall-clock window (default: the trace's own extent)
+    into per-hop EXCLUSIVE contributions by chain-ordered interval
+    clipping: hops are walked in :data:`HOPS` order (start-time tiebreak)
+    behind a cursor, and each contributes only the part of its interval
+    past the cursor and inside the window. Overlap between hops (one
+    replica's export inside the router's transfer) is attributed once, to
+    the earlier hop in chain order; uninstrumented time is attributed to
+    nobody — so ``total_s`` never exceeds the window and ``coverage_pct``
+    is an honest fraction of the client-observed TTFT when the caller
+    passes ``(submit_wall, submit_wall + ttft)``."""
+    spans = list(trace.get("spans", []))
+    if window is not None:
+        w0, w1 = float(window[0]), float(window[1])
+    elif spans:
+        w0 = min(float(s.get("t_start", 0.0)) for s in spans)
+        w1 = max(_span_end(s) for s in spans)
+    else:
+        w0 = w1 = 0.0
+    ordered = sorted(
+        spans,
+        key=lambda s: (hop_rank(s.get("hop", "")),
+                       float(s.get("t_start", 0.0))),
+    )
+    cursor = w0
+    hops_out = []
+    by_hop: Dict[str, float] = {}
+    total = 0.0
+    for s in ordered:
+        lo = max(float(s.get("t_start", 0.0)), cursor, w0)
+        hi = min(_span_end(s), w1)
+        contribution = max(hi - lo, 0.0)
+        cursor = max(cursor, min(hi, w1))
+        total += contribution
+        name = s.get("hop", "?")
+        by_hop[name] = by_hop.get(name, 0.0) + contribution
+        hops_out.append({
+            "hop": name,
+            "span_id": s.get("span_id"),
+            "replica": s.get("replica"),
+            "t_start": float(s.get("t_start", 0.0)),
+            "duration_s": float(s.get("duration_s", 0.0)),
+            "contribution_s": contribution,
+        })
+    window_s = max(w1 - w0, 0.0)
+    return {
+        "window": [w0, w1],
+        "window_s": window_s,
+        "total_s": total,
+        "coverage_pct": (100.0 * total / window_s) if window_s > 0 else 0.0,
+        "by_hop": by_hop,
+        "hops": hops_out,
+    }

@@ -144,11 +144,21 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
 #: fault-free pass on identical work (bench.py --serving --chaos) — the
 #: recovery machinery must preserve at least 70% of goodput under the
 #: seeded fault plan, not merely avoid crashing. Higher-is-better floor.
+#: trace_overhead_pct: distributed tracing fully on (sample rate 1.0,
+#: every hop recorded) vs fully off, same routed mini-workload,
+#: ABBA-interleaved (bench.py --serving --routed) — always-on tracing may
+#: not cost 3% of routed wall.
+#: trace_ttft_attribution_pct: median fraction of the CLIENT-observed
+#: submit→first-token window that the assembled trace's critical path
+#: accounts for — the attribution story must explain at least 90% of the
+#: TTFT it claims to decompose, or the waterfall is decoration.
 ABSOLUTE_LIMITS: Dict[str, Tuple[str, float]] = {
     "sentinel_overhead_pct": ("lower", 3.0),
     "routed_failovers": ("lower", 1.0),
     "routed_errors": ("lower", 1.0),
     "chaos_goodput_retention_pct": ("higher", 70.0),
+    "trace_overhead_pct": ("lower", 3.0),
+    "trace_ttft_attribution_pct": ("higher", 90.0),
 }
 
 

@@ -378,3 +378,143 @@ def test_mid_handoff_decode_kill_rehandoffs_from_retained_chain(
         ingest_k.stop()
         iserver_k.shutdown()
         mserver_k.shutdown()
+
+
+def test_routed_disagg_assembles_one_complete_trace(disagg_fleet):
+    """The tracing acceptance: ONE routed disaggregated request yields ONE
+    assembled trace whose hop chain crosses every tier — router.queue →
+    router.dispatch → ingest.queue → engine.prefill → handoff.export →
+    handoff.{transfer,import} → engine.decode_first_token →
+    stream.deliver — with parent/child span ids consistent ACROSS replica
+    processes, and the critical-path decomposition of the client-observed
+    submit→first-token window bounded by (and mostly covering) it."""
+    from nxdi_tpu.telemetry.tracing import assemble_traces, critical_path
+
+    apps, engines, ingests, targets, expected = disagg_fleet
+    router = _router_over(targets)
+    try:
+        router.poll()
+        prompt, max_new = WORKLOAD[0]
+        submit_wall = time.time()
+        status, resp = router.submit({
+            "request_id": "trace-0", "prompt": prompt,
+            "max_new_tokens": max_new,
+        })
+        assert status == 200, resp
+        tid = resp["trace_id"]
+        assert isinstance(tid, str) and len(tid) == 32
+        cursor, tokens, first_tok_wall, final = 0, [], None, None
+        deadline = time.time() + 120.0
+        while final is None and time.time() < deadline:
+            status, sresp = router.stream("trace-0", cursor)
+            assert status == 200, sresp
+            cursor = sresp["cursor"]
+            if sresp["tokens"] and first_tok_wall is None:
+                first_tok_wall = time.time()
+            tokens.extend(sresp["tokens"])
+            if sresp["done"]:
+                final = sresp
+            time.sleep(0.005)
+        assert final is not None and final["finish_reason"] in ("eos",
+                                                                "length")
+        assert final["trace_id"] == tid
+        assert tokens == expected[0]  # tracing never touches the tokens
+
+        # join the spans exactly as cli.trace would: the router's buffer
+        # plus every replica's
+        spans = list(router._trace_buffer.snapshot())
+        for name in ("pf0", "dc0", "dc1"):
+            spans.extend(apps[name].telemetry.trace_spans())
+        traces = [t for t in assemble_traces(spans) if t["trace_id"] == tid]
+        assert len(traces) == 1, "one request = ONE assembled trace"
+        trace = traces[0]
+        by_hop = {}
+        for s in trace["spans"]:
+            by_hop.setdefault(s["hop"], []).append(s)
+        for hop in ("router.queue", "router.dispatch", "ingest.queue",
+                    "engine.prefill", "handoff.export", "handoff.transfer",
+                    "handoff.import", "engine.decode_first_token",
+                    "stream.deliver"):
+            assert hop in by_hop, f"missing hop span: {hop}"
+        one = {h: v[0] for h, v in by_hop.items()}
+        # parent/child consistency across process boundaries
+        chain = [
+            ("router.dispatch", "router.queue"),
+            ("ingest.queue", "router.dispatch"),
+            ("engine.prefill", "ingest.queue"),
+            ("handoff.export", "engine.prefill"),
+            ("handoff.transfer", "handoff.export"),
+            ("handoff.import", "handoff.export"),
+            ("engine.decode_first_token", "handoff.import"),
+            ("stream.deliver", "router.dispatch"),
+        ]
+        for child, parent in chain:
+            assert one[child]["parent_span_id"] == one[parent]["span_id"], (
+                f"{child} must parent under {parent}"
+            )
+        # each hop was recorded by the tier that owns it
+        assert one["router.queue"]["replica"] == "router"
+        assert one["handoff.transfer"]["replica"] == "router"
+        assert one["ingest.queue"]["replica"] == "pf0"
+        assert one["engine.prefill"]["replica"] == "pf0"
+        assert one["handoff.export"]["replica"] == "pf0"
+        assert one["handoff.import"]["replica"] in ("dc0", "dc1")
+        assert (one["engine.decode_first_token"]["replica"]
+                == one["handoff.import"]["replica"])
+        # critical-path attribution of the CLIENT-observed TTFT window:
+        # clipped (never exceeds the window) and covering most of it
+        cp = critical_path(trace, (submit_wall, first_tok_wall))
+        assert cp["total_s"] <= cp["window_s"] + 1e-9
+        # most of the client-observed TTFT is attributed; the residual is
+        # the client poll cadence between the prefill parking the chain
+        # and the poll that discovers (and inline-runs) the handoff
+        assert cp["coverage_pct"] > 70.0, cp
+        assert cp["by_hop"]["engine.prefill"] > 0.0
+
+        # the fleet table surfaces the handoff plane: exports/imports per
+        # replica from the existing engine counters
+        import io
+
+        from nxdi_tpu.cli.fleet import print_fleet_table
+
+        router.poll()
+        buf = io.StringIO()
+        print_fleet_table(router.monitor, file=buf)
+        table = buf.getvalue()
+        assert "hoff e/i" in table
+        exports = engines["pf0"]._handoff_exports.value()
+        assert exports >= 1 and f"{exports:g}/0" in table
+        assert "in-flight handoffs" in table
+    finally:
+        router.stop()
+
+
+def test_routed_disagg_unsampled_trace_records_nothing(disagg_fleet):
+    """Sample rate 0.0 at the router: the trace id still mints and rides
+    every response (clients correlate either way), but NO hop span is
+    recorded on any tier — and the greedy output stays token-identical to
+    the unified run (tracing on vs off cannot perturb the engines)."""
+    apps, engines, ingests, targets, expected = disagg_fleet
+    router = _router_over(targets, config=RouterConfig(
+        stream_failures=1, poll_interval_s=0.2, trace_sample_rate=0.0,
+    ))
+    try:
+        router.poll()
+        prompt, max_new = WORKLOAD[1]
+        status, resp = router.submit({
+            "request_id": "trace-off-0", "prompt": prompt,
+            "max_new_tokens": max_new,
+        })
+        assert status == 200, resp
+        tid = resp["trace_id"]
+        assert isinstance(tid, str) and len(tid) == 32
+        finals = _drive_to_done(router, ["trace-off-0"])
+        tokens, final = finals["trace-off-0"]
+        assert tokens == expected[1]
+        assert final["trace_id"] == tid
+        assert router._trace_buffer.spans_for(tid) == []
+        for name in ("pf0", "dc0", "dc1"):
+            assert [s for s in apps[name].telemetry.trace_spans()
+                    if s["trace_id"] == tid] == []
+    finally:
+        router.stop()

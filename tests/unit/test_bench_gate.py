@@ -501,6 +501,52 @@ def test_sentinel_overhead_absolute_gate(tmp_path, capsys):
     assert rc == 0
 
 
+def test_trace_overhead_and_attribution_absolute_gates(tmp_path, capsys):
+    """The distributed-tracing pair from bench.py --serving --routed gates
+    on the fresh record alone: trace_overhead_pct strictly under 3%
+    (lower-is-better ceiling, like the sentinel), and
+    trace_ttft_attribution_pct strictly over 90% (higher-is-better floor —
+    the critical path must actually explain the client TTFT it claims
+    to). Absence of either field skips, never fails."""
+    base = _write(tmp_path, "base.json", BASE)  # pre-tracing baseline
+    ok = dict(BASE, trace_overhead_pct=0.8, trace_ttft_attribution_pct=97.2)
+    rc = bench_gate.main([_write(tmp_path, "ok.json", ok), "--baseline", base])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "trace_overhead_pct" in err
+    assert "trace_ttft_attribution_pct" in err
+
+    # tracing costing 3% or more fails on the fresh record alone ...
+    hot = dict(ok, trace_overhead_pct=3.0)
+    rc = bench_gate.main(
+        [_write(tmp_path, "hot.json", hot), "--baseline", base, "-q"]
+    )
+    assert rc == 1
+    # ... attribution at or under the 90% floor fails ...
+    thin = dict(ok, trace_ttft_attribution_pct=90.0)
+    rc = bench_gate.main(
+        [_write(tmp_path, "thin.json", thin), "--baseline", base, "-q"]
+    )
+    assert rc == 1
+    # ... negative overhead (noise: traced side faster) passes ...
+    neg = dict(ok, trace_overhead_pct=-0.5)
+    rc = bench_gate.main(
+        [_write(tmp_path, "neg.json", neg), "--baseline", base, "-q"]
+    )
+    assert rc == 0
+    # ... and null / absent fields are skips, not failures
+    rows, skipped = bench_gate.check_absolute(
+        dict(BASE, trace_overhead_pct=None), bench_gate.ABSOLUTE_LIMITS
+    )
+    assert rows == []
+    assert "trace_overhead_pct" in skipped
+    assert "trace_ttft_attribution_pct" in skipped
+    rc = bench_gate.main(
+        [_write(tmp_path, "plain.json", BASE), "--baseline", base, "-q"]
+    )
+    assert rc == 0
+
+
 def test_serving_metrics_gate_and_skip_when_absent(tmp_path):
     """The bench.py --serving goodput line gates one-sided; a baseline from
     BEFORE the serving engine (no serving_* fields) skips them instead of

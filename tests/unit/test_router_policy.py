@@ -359,6 +359,75 @@ def test_router_duplicate_submit_suppressed():
     assert r.dispatches_total.value(replica="a") == 1
 
 
+def test_router_submit_returns_trace_id_duplicate_returns_original():
+    """Every /submit and /stream response carries the request's trace id;
+    duplicate suppression returns the ORIGINAL trace id (same id = same
+    request = same trace), a client traceparent is adopted, and a
+    malformed one falls back to minting — never an error."""
+    from nxdi_tpu.telemetry.tracing import TraceContext
+
+    a = FakeReplica("a", [1])
+    r = build_fake_router([a])
+    r.poll()
+    status, resp = r.submit({"request_id": "q1", "prompt": [5]})
+    tid = resp["trace_id"]
+    assert status == 200 and isinstance(tid, str) and len(tid) == 32
+    status, resp = r.submit({"request_id": "q1", "prompt": [5]})
+    assert resp["status"] == "duplicate" and resp["trace_id"] == tid
+    status, resp = r.stream("q1")
+    assert status == 200 and resp["trace_id"] == tid
+    # a valid client traceparent is adopted instead of minting ...
+    ctx = TraceContext.mint()
+    status, resp = r.submit({
+        "request_id": "q2", "prompt": [5], "traceparent": ctx.to_header(),
+    })
+    assert status == 200 and resp["trace_id"] == ctx.trace_id
+    # ... and a malformed one mints fresh, never 400s/500s
+    status, resp = r.submit({
+        "request_id": "q3", "prompt": [5], "traceparent": "not-a-header",
+    })
+    assert status == 200
+    assert len(resp["trace_id"]) == 32 and resp["trace_id"] != ctx.trace_id
+
+
+def test_router_records_queue_and_dispatch_hops():
+    """The router's own trace buffer holds a router.queue span per submit
+    and a router.dispatch span per attempt, dispatch parented under queue;
+    a failover re-dispatch lands as a SIBLING dispatch span (same parent,
+    same trace) — the sibling-hop contract the trace waterfall renders."""
+    script = [11, 22, 33]
+    a, b = FakeReplica("a", script), FakeReplica("b", script)
+    r = build_fake_router([a, b])
+    r.poll()
+    _, resp = r.submit({"request_id": "q1", "prompt": [5]})
+    tid = resp["trace_id"]
+    spans = r._trace_buffer.spans_for(tid)
+    by_hop = {s["hop"]: s for s in spans}
+    assert set(by_hop) == {"router.queue", "router.dispatch"}
+    queue, disp = by_hop["router.queue"], by_hop["router.dispatch"]
+    assert disp["parent_span_id"] == queue["span_id"]
+    assert disp["replica"] == "router"
+    # kill the serving replica mid-stream: the failover re-dispatch must
+    # be a sibling of the first dispatch, not its child
+    a.records["q1"]["tokens"] = script[:1]
+    a.records["q1"]["done"] = False
+    r.stream("q1")
+    a.dead = True
+    status, resp = r.stream("q1", cursor=1)
+    assert status == 200 and resp["failovers"] == 1
+    disps = [s for s in r._trace_buffer.spans_for(tid)
+             if s["hop"] == "router.dispatch"]
+    assert len(disps) == 2
+    assert {s["parent_span_id"] for s in disps} == {queue["span_id"]}
+    assert disps[0]["span_id"] != disps[1]["span_id"]
+    assert disps[1]["attrs"]["failover"] == 1
+    # first-token delivery is recorded once, under the WINNING dispatch
+    delivers = [s for s in r._trace_buffer.spans_for(tid)
+                if s["hop"] == "stream.deliver"]
+    assert len(delivers) == 1
+    assert delivers[0]["parent_span_id"] == disps[0]["span_id"]
+
+
 def test_router_failover_midstream_continues_token_stream():
     """The unit twin of the integration kill test: replica a dies after
     delivering 2 of 5 tokens; the stream continues on b with no duplicate

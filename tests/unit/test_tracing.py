@@ -1,0 +1,240 @@
+"""Distributed-tracing unit pins: the traceparent wire contract
+(round-trip, fail-open rejection of every malformation class), the
+bounded trace buffer's observable overflow, deterministic sampling,
+fleet-side assembly / depth / critical-path math, and the telemetry
+facade's off-switch semantics."""
+
+import json
+
+import pytest
+
+from nxdi_tpu.telemetry import Telemetry
+from nxdi_tpu.telemetry.tracing import (
+    HOPS,
+    MAX_HEADER_LEN,
+    TraceBuffer,
+    TraceContext,
+    TraceSampler,
+    assemble_traces,
+    critical_path,
+    hop_rank,
+    span_depths,
+)
+
+
+# -- trace context wire contract ---------------------------------------------
+def test_traceparent_header_round_trip():
+    ctx = TraceContext.mint()
+    back = TraceContext.from_header(ctx.to_header())
+    assert back is not None
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.sampled is True
+    # the unsampled flag survives the wire too
+    cold = TraceContext.mint(sampled=False)
+    assert cold.to_header().endswith("-00")
+    assert TraceContext.from_header(cold.to_header()).sampled is False
+
+
+def test_traceparent_child_links_to_parent():
+    root = TraceContext.mint()
+    kid = root.child()
+    assert kid.trace_id == root.trace_id
+    assert kid.parent_span_id == root.span_id
+    assert kid.span_id != root.span_id
+    # an explicit span id (the router's pre-allocated dispatch hop) sticks
+    named = root.child(span_id="aabbccdd00112233")
+    assert named.span_id == "aabbccdd00112233"
+
+
+@pytest.mark.parametrize("bad", [
+    None,
+    42,
+    "",
+    "garbage",
+    "00-abc-def-01",                                    # bad field widths
+    "00" + "-" + "g" * 32 + "-" + "1" * 16 + "-01",     # non-hex trace id
+    "00-" + "0" * 32 + "-" + "1" * 16 + "-01",          # all-zero trace id
+    "00-" + "1" * 32 + "-" + "0" * 16 + "-01",          # all-zero span id
+    "ff-" + "1" * 32 + "-" + "2" * 16 + "-01",          # reserved version
+    "00-" + "1" * 32 + "-" + "2" * 16 + "-zz",          # non-hex flags
+    "00-" + "1" * 32 + "-" + "2" * 16,                  # missing flags
+    "00-" + "1" * 32 + "-" + "2" * 16 + "-01-extra",    # trailing field
+    "x" * (MAX_HEADER_LEN + 1),                         # oversized
+])
+def test_traceparent_malformed_rejected(bad):
+    """Every malformation class parses to None — the receiver mints fresh
+    (fail-open), it never raises and never 500s."""
+    assert TraceContext.from_header(bad) is None
+
+
+def test_trace_dict_round_trip_and_rejection():
+    ctx = TraceContext.mint().child()
+    back = TraceContext.from_dict(ctx.to_dict())
+    assert back.trace_id == ctx.trace_id
+    assert back.span_id == ctx.span_id
+    assert back.parent_span_id == ctx.parent_span_id
+    assert TraceContext.from_dict(None) is None
+    assert TraceContext.from_dict({"trace_id": "zz", "span_id": "11"}) is None
+    # the dict form is JSON-safe (it rides the handoff wire payload)
+    assert json.loads(json.dumps(ctx.to_dict())) == ctx.to_dict()
+
+
+# -- sampler ------------------------------------------------------------------
+def test_sampler_deterministic_credit():
+    assert [TraceSampler(1.0).sample() for _ in range(5)] == [True] * 5
+    assert [TraceSampler(0.0).sample() for _ in range(5)] == [False] * 5
+    s = TraceSampler(0.25)
+    got = [s.sample() for _ in range(16)]
+    assert sum(got) == 4  # exactly rate * n, no rng
+    # and the pattern is evenly spread, not front-loaded
+    assert got[:4].count(True) == 1
+
+
+# -- buffer -------------------------------------------------------------------
+def test_trace_buffer_overflow_counts_drops():
+    tel = Telemetry(enabled=True, replica_id="r0", trace_buffer=2)
+    # pre-seeded: observable as zero before any drop
+    assert tel.traces_dropped_total.total() == 0
+    ctx = TraceContext.mint()
+    for i in range(5):
+        tel.record_hop(HOPS[0], ctx, t_start=float(i), duration_s=0.1)
+    assert len(tel.trace_buffer) == 2
+    assert tel.traces_dropped_total.total() == 3
+    assert len(tel.trace_spans()) == 2
+
+
+def test_trace_buffer_chains_span_ids():
+    buf = TraceBuffer(capacity=8)
+    ctx = TraceContext.mint()
+    sid1 = buf.record("a", ctx.trace_id, None, t_start=0.0, duration_s=1.0)
+    sid2 = buf.record("b", ctx.trace_id, sid1, t_start=1.0, duration_s=1.0)
+    spans = buf.snapshot()
+    assert spans[1]["parent_span_id"] == sid1
+    assert spans[1]["span_id"] == sid2
+    assert buf.spans_for(ctx.trace_id) == spans
+    assert buf.spans_for("unknown") == []
+
+
+# -- telemetry facade gating --------------------------------------------------
+def test_tracing_off_is_a_noop_everywhere():
+    for tel in (Telemetry(enabled=False),
+                Telemetry(enabled=True, trace=False)):
+        assert tel.mint_trace() is None
+        assert tel.record_hop(
+            HOPS[0], TraceContext.mint(), t_start=0.0, duration_s=0.1
+        ) is None
+        assert tel.trace_spans() == []
+        assert "_traces" not in (tel.snapshot() or {})
+
+
+def test_unsampled_trace_records_nothing_but_keeps_ids():
+    tel = Telemetry(enabled=True, replica_id="r0", trace_sample_rate=0.0)
+    ctx = tel.mint_trace()
+    assert ctx is not None and not ctx.sampled  # id still mints (clients
+    # correlate by id even when hop recording is off)
+    assert tel.record_hop(HOPS[0], ctx, t_start=0.0, duration_s=0.1) is None
+    assert tel.trace_spans() == []
+
+
+def test_sampled_hop_feeds_histogram_and_snapshot_extra():
+    tel = Telemetry(enabled=True, replica_id="r0")
+    ctx = tel.mint_trace()
+    sid = tel.record_hop(HOPS[0], ctx, t_start=0.0, duration_s=0.25)
+    assert sid is not None
+    snap = tel.snapshot()
+    assert snap["_traces"][0]["span_id"] == sid
+    assert snap["_traces"][0]["replica"] == "r0"
+    hist = snap["nxdi_trace_hop_seconds"]["series"][0]
+    assert hist["labels"]["hop"] == HOPS[0]
+    assert hist["count"] == 1
+
+
+# -- assembly / depth / critical path ----------------------------------------
+def _chain(buf, ctx, hops, t0=100.0, step=0.01, replica="r"):
+    sid, t = None, t0
+    for hop in hops:
+        sid = buf.record(hop, ctx.trace_id, sid, t_start=t,
+                         duration_s=step, replica=replica)
+        t += step
+    return sid
+
+
+def test_assemble_traces_joins_and_dedups():
+    a, b = TraceBuffer(64), TraceBuffer(64)
+    ctx = TraceContext.mint()
+    _chain(a, ctx, HOPS[:2], replica="router")
+    _chain(b, TraceContext.mint(), HOPS[:1], replica="r1")
+    # overlap: the same spans arriving via two collection paths dedup
+    spans = a.snapshot() + b.snapshot() + a.snapshot()
+    traces = assemble_traces(spans)
+    assert len(traces) == 2
+    mine = next(t for t in traces if t["trace_id"] == ctx.trace_id)
+    assert mine["hops"] == list(HOPS[:2])
+    assert mine["replicas"] == ["router"]
+    assert mine["duration_s"] == pytest.approx(0.02)
+
+
+def test_span_depths_follow_parent_links():
+    buf = TraceBuffer(64)
+    ctx = TraceContext.mint()
+    _chain(buf, ctx, HOPS[:3])
+    spans = buf.snapshot()
+    depths = span_depths(spans)
+    assert [depths[s["span_id"]] for s in spans] == [0, 1, 2]
+    # a span whose parent was never collected counts one level, not zero
+    orphan = TraceBuffer(4)
+    orphan.record("x", ctx.trace_id, "feedfacefeedface",
+                  t_start=0.0, duration_s=0.1)
+    assert list(span_depths(orphan.snapshot()).values()) == [1]
+
+
+def test_critical_path_clips_overlap_and_bounds_coverage():
+    buf = TraceBuffer(64)
+    ctx = TraceContext.mint()
+    # prefill 0.10-0.20; export 0.15-0.25 overlaps it by 0.05 — chain
+    # order attributes the overlap to prefill exactly once
+    buf.record("engine.prefill", ctx.trace_id, None,
+               t_start=0.10, duration_s=0.10)
+    buf.record("handoff.export", ctx.trace_id, None,
+               t_start=0.15, duration_s=0.10)
+    trace = assemble_traces(buf.snapshot())[0]
+    cp = critical_path(trace, window=(0.0, 0.30))
+    assert cp["by_hop"]["engine.prefill"] == pytest.approx(0.10)
+    assert cp["by_hop"]["handoff.export"] == pytest.approx(0.05)
+    assert cp["total_s"] == pytest.approx(0.15)
+    assert cp["coverage_pct"] == pytest.approx(50.0)
+    # attribution can never exceed the window, whatever the spans claim
+    wild = critical_path(trace, window=(0.12, 0.14))
+    assert wild["total_s"] <= wild["window_s"] + 1e-12
+    assert wild["coverage_pct"] <= 100.0 + 1e-9
+
+
+def test_hop_rank_orders_the_taxonomy():
+    ranks = [hop_rank(h) for h in HOPS]
+    assert ranks == sorted(ranks)
+    assert hop_rank("not.a.hop") == len(HOPS)
+
+
+# -- cli.trace offline mode ---------------------------------------------------
+def test_cli_trace_renders_waterfall_from_file(tmp_path, capsys):
+    from nxdi_tpu.cli.trace import main
+
+    buf = TraceBuffer(16)
+    ctx = TraceContext.mint()
+    _chain(buf, ctx, HOPS[:4], replica="router")
+    path = tmp_path / "spans.json"
+    path.write_text(json.dumps(
+        {"replica_id": "router", "spans": buf.snapshot()}
+    ))
+    assert main([str(path)]) == 0
+    out = capsys.readouterr().out
+    assert ctx.trace_id in out
+    assert "critical path" in out
+    assert HOPS[3] in out
+    # unknown --trace-id exits nonzero; --perfetto writes flow-event JSON
+    assert main([str(path), "--trace-id", "ffffffff"]) == 1
+    pf = tmp_path / "pf.json"
+    assert main([str(path), "--perfetto", str(pf), "-q"]) == 0
+    events = json.loads(pf.read_text())["traceEvents"]
+    assert any(e["ph"] == "X" and e["name"] == HOPS[0] for e in events)
