@@ -1000,7 +1000,7 @@ def _flag_value(name, default):
 def _build_serving_stack(
     slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
     replica_id=None, rng=None, sentinel=None, mixed=False, prefix_cache=False,
-    faults=None, role="unified", trace=True,
+    faults=None, role="unified", trace=True, qos=None,
 ):
     """One loaded full-depth 1B app + engine for the serving/fleet bench.
 
@@ -1041,6 +1041,7 @@ def _build_serving_stack(
         is_prefix_caching=prefix_cache,
         faults=faults,
         role=role,
+        qos=qos,
     )
     cfg = ml.LlamaInferenceConfig(
         tcfg, hidden_size=HIDDEN, intermediate_size=INTERMEDIATE,
@@ -2259,6 +2260,334 @@ def main_chaos_serving(
     return rec
 
 
+def main_multitenant_serving(
+    requests=32,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=256,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+    tenants=4,
+):
+    """``bench.py --serving --multi-tenant``: the QoS control plane
+    (nxdi_tpu/control/qos.py) under a MIXED-CLASS Poisson workload — the
+    same full-depth 1B engine as the plain serving line, with requests
+    cycling three priority classes (``interactive`` at the bench SLO,
+    ``batch`` at 4x looser targets, ``best_effort`` with none) across
+    ``tenants`` tenants. Deadline-slack admission orders the waiting
+    queue so latency-critical work prefills first; the per-class
+    attainment windows the policy keeps are the headline. Gated ABSOLUTE
+    by scripts/bench_gate.py:
+
+    - ``qos_slo_attainment_pct_interactive`` — the floor the control
+      plane exists to defend: interactive attainment must hold even
+      though 2/3 of the offered load is background work;
+    - ``qos_fairness_jain`` — Jain's index over per-tenant served tokens
+      (1.0 = perfectly even); the scheduler must not starve a tenant to
+      buy the attainment number.
+    """
+    from nxdi_tpu.control import jain_index
+    from nxdi_tpu.ops.sampling import PRIORITY_CLASSES
+    from nxdi_tpu.serving import SamplingParams, drive_arrivals, goodput_summary
+
+    qos_cfg = {
+        "default_class": "batch",
+        "class_slos": {
+            "interactive": {"ttft_s": slo_ttft_ms / 1e3,
+                            "tpot_s": slo_tpot_ms / 1e3},
+            "batch": {"ttft_s": 4 * slo_ttft_ms / 1e3,
+                      "tpot_s": 4 * slo_tpot_ms / 1e3},
+            "best_effort": None,
+        },
+        # quotas stay unbounded: this line measures scheduling under mixed
+        # classes, not admission control — a quota shed would silently
+        # shrink the offered load
+    }
+    rng = np.random.default_rng(0)
+    app, engine = _build_serving_stack(
+        slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+        rng=rng, qos=qos_cfg,
+    )
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+    prompts = [
+        rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+        .astype(np.int32).tolist()
+        for _ in range(requests)
+    ]
+    # request i -> (class, tenant): a fixed cycle, so every class and every
+    # tenant sees the same request count and prompt-length distribution
+    meta = {
+        i: (PRIORITY_CLASSES[i % len(PRIORITY_CLASSES)],
+            f"tenant-{i % max(tenants, 1)}")
+        for i in range(requests)
+    }
+    outputs, wall = drive_arrivals(
+        engine,
+        arrivals,
+        lambda eng, i, arrival_s: eng.add_request(
+            prompts[i],
+            SamplingParams(max_new_tokens=max_new,
+                           priority=meta[i][0], tenant_id=meta[i][1]),
+            request_id=i,
+            arrival_s=arrival_s,
+        ),
+    )
+
+    by_class = {c: [] for c in PRIORITY_CLASSES}
+    tenant_tok = {f"tenant-{t}": 0 for t in range(max(tenants, 1))}
+    for o in outputs:
+        cls, ten = meta[o.request_id]
+        by_class[cls].append(o)
+        if o.finish_reason != "error":
+            tenant_tok[ten] += len(o.token_ids)
+    summaries = {
+        c: goodput_summary(outs, wall, slo=engine.qos.class_slo(c))
+        for c, outs in by_class.items()
+    }
+    att = engine.qos.attainment_pct()
+    fairness = jain_index(list(tenant_tok.values()))
+    pooled = goodput_summary(outputs, wall)
+    rec = {
+        "metric": "llama3.2-1b_multitenant_serving_qos",
+        "value": att["interactive"],
+        "unit": "pct",
+        "qos_slo_attainment_pct_interactive": att["interactive"],
+        "qos_slo_attainment_pct_batch": att["batch"],
+        "qos_slo_attainment_pct_best_effort": att["best_effort"],
+        "qos_fairness_jain": round(fairness, 4),
+        "qos_tenant_tokens": tenant_tok,
+        "qos_tenants": max(tenants, 1),
+        "qos_goodput_tok_s": pooled["tok_s"],
+        "qos_goodput_req_s": pooled["goodput_req_s"],
+        "qos_interactive_ttft_p95_ms": summaries["interactive"]["ttft_p95_ms"],
+        "qos_batch_ttft_p95_ms": summaries["batch"]["ttft_p95_ms"],
+        "qos_best_effort_ttft_p95_ms": (
+            summaries["best_effort"]["ttft_p95_ms"]
+        ),
+        "qos_preemptions": pooled["preemptions"],
+        "slo_ttft_ms": slo_ttft_ms,
+        "slo_tpot_ms": slo_tpot_ms,
+        "serving_requests": requests,
+        "serving_arrival_rate_req_s": rate,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged slots{slots} "
+            f"kv{seq_len} prompt~{prompt_len} max_new{max_new} tp1 "
+            f"qos 3 classes x {max(tenants, 1)} tenants"
+        ),
+        "mode": "multitenant_qos_engine",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots(
+        {"multitenant": app.telemetry.snapshot()}, metrics_out_path()
+    )
+    return rec
+
+
+def main_autoscale_serving(
+    requests=24,
+    rate=16.0,
+    slots=8,
+    seq_len=SEQ_LEN,
+    prompt_len=PROMPT_LEN,
+    max_new=64,
+    n_layers=N_LAYERS,
+    slo_ttft_ms=4000.0,
+    slo_tpot_ms=25.0,
+):
+    """``bench.py --serving --autoscale``: the QoS control plane's fleet
+    tier (nxdi_tpu/control/autoscaler.py) closing the loop against LIVE
+    engines — a 2-replica routed stack where replica 1 starts as a warm
+    STANDBY (cooperatively drained at the router), and the
+    :class:`Autoscaler` alone decides when it joins and leaves the fleet:
+
+    1. a pooled Poisson burst lands on the single active replica; its
+       queue builds, the EWMA trend crosses ``scale_up_score``, and the
+       autoscaler's scale-up actuator UNDRAINS the standby (1 -> 2);
+    2. the burst finishes, the trend decays below ``scale_down_score``,
+       and the autoscaler drains the least-loaded replica back out — the
+       real cooperative drain: in-flight requests finish in place (2 -> 1);
+    3. the drained replica's signals show it empty and the autoscaler
+       retires it to standby.
+
+    The full decision journal (the ``/autoscale`` ring, satellite: also
+    served live by the frontend during the run) is embedded in the JSON
+    record as ``autoscale_trace``. ``autoscale_cycle_ok`` is the headline
+    acceptance bit: scale_up, then drain, then retire, in order, with
+    ZERO error finishes — the elastic cycle ran against real engines, not
+    a simulation."""
+    import threading
+    import time as _time
+
+    from nxdi_tpu.cli.route import _http
+    from nxdi_tpu.config import AutoscaleConfig, FleetConfig, RouterConfig
+    from nxdi_tpu.control import Autoscaler
+    from nxdi_tpu.router import ReplicaIngest, Router
+    from nxdi_tpu.runtime.faults import jittered_backoff
+
+    replicas = 2
+    stacks, servers, ingests, targets = [], [], [], []
+    for i in range(replicas):
+        app, engine = _build_serving_stack(
+            slots, seq_len, prompt_len, n_layers, slo_ttft_ms, slo_tpot_ms,
+            replica_id=f"auto-r{i}",
+        )
+        mserver = app.telemetry.serve(port=0)
+        ingest = ReplicaIngest(engine)
+        iserver = ingest.serve(port=0)
+        stacks.append((app, engine))
+        servers.extend([mserver, iserver])
+        ingests.append(ingest)
+        targets.append((f"auto-r{i}", mserver.url, iserver.url))
+
+    router = Router(
+        targets,
+        config=RouterConfig(shed_queue_depth=float(requests + slots),
+                            poll_interval_s=0.25),
+        fleet_config=FleetConfig(staleness_s=3600.0),
+    )
+    router.start()
+    frontend = router.serve(port=0)
+    standby = "auto-r1"
+    router.drain(standby)  # park the warm standby before any traffic
+
+    autoscaler = Autoscaler(
+        router.monitor,
+        AutoscaleConfig(
+            interval_s=0.25,
+            ewma_alpha=0.6,
+            scale_up_score=6.0,
+            scale_down_score=3.0,
+            min_replicas=1,
+            max_replicas=replicas,
+            cooldown_s=2.0,
+        ),
+        # the actuators ARE the PR 9/15 machinery: undrain to add capacity,
+        # cooperative drain to remove it; retire leaves the replica parked
+        # at the router (the autoscaler returns it to its standby pool)
+        scale_up=lambda: (router.undrain(standby), standby)[1],
+        drain=lambda replica: router.drain(replica),
+        retire=lambda replica: None,
+        standby=[standby],
+        poll=False,  # the router's own background poll feeds the monitor
+    )
+    router.attach_autoscaler(autoscaler)
+    autoscaler.start()
+
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(rng.exponential(1.0 / rate, size=requests))
+    prompts = [
+        rng.integers(0, 32000, size=prompt_len - int(rng.integers(0, 16)))
+        .astype(np.int32).tolist()
+        for _ in range(requests)
+    ]
+    results = [None] * requests
+    t0 = _time.perf_counter()
+
+    def client(i):
+        import random as _random
+
+        arrival = t0 + float(arrivals[i])
+        _time.sleep(max(arrival - _time.perf_counter(), 0.0))
+        status, resp = _http("POST", f"{frontend.url}/submit", {
+            "request_id": f"auto-{i}",
+            "prompt": prompts[i],
+            "max_new_tokens": max_new,
+        })
+        if status != 200:
+            results[i] = {"error": f"submit HTTP {status}", "tokens": 0}
+            return
+        poll_rng = _random.Random(i)
+        cursor, n_tok, idle = 0, 0, 0
+        while True:
+            status, resp = _http(
+                "GET",
+                f"{frontend.url}/stream?request_id=auto-{i}&cursor={cursor}",
+            )
+            if status != 200:
+                results[i] = {"error": f"stream HTTP {status}",
+                              "tokens": n_tok}
+                return
+            cursor = resp["cursor"]
+            n_tok += len(resp["tokens"])
+            if resp["done"]:
+                results[i] = {
+                    "error": resp["error"]
+                    if resp["finish_reason"] == "error" else None,
+                    "tokens": n_tok,
+                    "end_s": _time.perf_counter() - t0,
+                }
+                return
+            idle = idle + 1 if not resp["tokens"] else 0
+            _time.sleep(jittered_backoff(
+                idle, base_s=0.003, max_s=0.05, rng=poll_rng
+            ))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    # the burst is served; wait for the trend to decay and the autoscaler
+    # to walk the fleet back down (drain -> retire) before reading the log
+    deadline = _time.perf_counter() + 60.0
+    while _time.perf_counter() < deadline:
+        if any(d["action"] == "retire" for d in autoscaler.snapshot_log()):
+            break
+        _time.sleep(0.25)
+
+    # the journal as served live over HTTP — the same ring the record embeds
+    status, live = _http("GET", f"{frontend.url}/autoscale")
+    trace = (live.get("decisions") if status == 200 and isinstance(live, dict)
+             else None) or autoscaler.snapshot_log()
+    autoscaler.stop()
+
+    actions = [d["action"] for d in trace]
+    cycle_ok = False
+    if "scale_up" in actions:
+        after_up = actions[actions.index("scale_up"):]
+        if "drain" in after_up:
+            cycle_ok = "retire" in after_up[after_up.index("drain"):]
+    errors = [r for r in results if r and r["error"]]
+    ok = [r for r in results if r and not r["error"]]
+    wall = max((r["end_s"] for r in ok), default=1e-9)
+    rec = {
+        "metric": "llama3.2-1b_autoscale_serving_cycle",
+        "value": float(cycle_ok and not errors),
+        "unit": "bool",
+        "autoscale_cycle_ok": bool(cycle_ok and not errors),
+        "autoscale_scale_ups": actions.count("scale_up"),
+        "autoscale_drains": actions.count("drain"),
+        "autoscale_retires": actions.count("retire"),
+        "autoscale_errors": len(errors),
+        "autoscale_goodput_req_s": round(len(ok) / wall, 3),
+        "autoscale_tok_s": round(sum(r["tokens"] for r in ok) / wall, 1),
+        "autoscale_standby": autoscaler.standby(),
+        "autoscale_trace": trace,
+        "serving_requests": requests,
+        "serving_arrival_rate_req_s": rate,
+        "config": (
+            f"llama3.2-1b full {n_layers}L bf16 paged x{replicas} replicas "
+            f"slots{slots} kv{seq_len} prompt~{prompt_len} max_new{max_new} "
+            f"tp1 rate{rate:g} autoscale 1->2->1"
+        ),
+        "mode": "autoscale_routed_serving",
+    }
+    print(json.dumps(rec))
+    write_metrics_snapshots({"autoscale": router.snapshot()},
+                            metrics_out_path())
+    router.stop()
+    for ingest in ingests:
+        ingest.stop()
+    for server in servers:
+        server.shutdown()
+    return rec
+
+
 if __name__ == "__main__":
     if "--8b-only" in sys.argv:
         main_8b_only()
@@ -2291,6 +2620,13 @@ if __name__ == "__main__":
             main_mixed_serving(**_serving_kwargs)
         elif "--disaggregated" in sys.argv:
             main_disagg_serving(**_serving_kwargs)
+        elif "--multi-tenant" in sys.argv:
+            main_multitenant_serving(
+                tenants=_flag_value("--tenants", 4), **_serving_kwargs
+            )
+        elif "--autoscale" in sys.argv:
+            _serving_kwargs["max_new"] = _flag_value("--serving-max-new", 64)
+            main_autoscale_serving(**_serving_kwargs)
         elif "--chaos" in sys.argv:
             _serving_kwargs["max_new"] = _flag_value("--serving-max-new", 64)
             main_chaos_serving(replicas=max(_replicas, 2), **_serving_kwargs)

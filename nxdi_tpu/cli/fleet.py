@@ -64,6 +64,10 @@ def setup_fleet_parser(p: argparse.ArgumentParser) -> None:
                    help="a router frontend's base URL (cli.route --serve): "
                         "its /snapshot is fetched each round and the table "
                         "gains a per-replica router-dispatch-count column")
+    p.add_argument("--autoscale-log", action="store_true",
+                   help="fetch /autoscale from --router (or the first "
+                        "target URL) and print the autoscaler's bounded "
+                        "decision journal, one line per decision, then exit")
     p.add_argument("--poll-interval", type=float, default=1.0,
                    help="seconds between poll rounds (FleetConfig.poll_interval_s)")
     p.add_argument("--timeout", type=float, default=2.0,
@@ -241,6 +245,52 @@ def _fetch_router_dispatches(args) -> Optional[dict]:
         return {}
 
 
+def fetch_autoscale_payload(base_url: str, timeout: float = 2.0) -> dict:
+    """GET ``<base_url>/autoscale`` — the Autoscaler journal every router
+    frontend and fleet federation endpoint serves once an autoscaler is
+    attached."""
+    import urllib.request
+
+    with urllib.request.urlopen(
+        base_url.rstrip("/") + "/autoscale", timeout=timeout
+    ) as resp:
+        return json.loads(resp.read())
+
+
+def print_autoscale_log(payload: dict, file=None) -> int:
+    """Render the bounded decision ring, oldest first; returns the number
+    of decisions printed. A payload carrying ``error`` (no autoscaler
+    attached at the source) prints that instead."""
+    out = file if file is not None else sys.stdout
+    if payload.get("error"):
+        print(f"autoscale: {payload['error']}", file=out)
+        return 0
+    decisions = payload.get("decisions") or []
+    known = ("t", "action", "replica", "signal_trend", "reason")
+    for d in decisions:
+        # AutoscaleDecision.to_dict flattens its extra keys into the row
+        tail = "".join(
+            f" {k}={v}" for k, v in sorted(d.items()) if k not in known
+        )
+        print(
+            f"t={d['t']:10.3f} {d['action']:<9} "
+            f"replica={d.get('replica') or '-':<16} "
+            f"trend={d['signal_trend']:7.3f} {d['reason']}{tail}",
+            file=out,
+        )
+    trend = payload.get("signal_trend")
+    draining = sorted(payload.get("draining") or ())
+    standby = sorted(payload.get("standby") or ())
+    print(
+        f"{len(decisions)} decisions; trend="
+        f"{'-' if trend is None else format(trend, '.3f')}"
+        + (f"; draining: {', '.join(draining)}" if draining else "")
+        + (f"; standby: {', '.join(standby)}" if standby else ""),
+        file=out,
+    )
+    return len(decisions)
+
+
 def emit(monitor: FleetMonitor, args) -> None:
     if args.format == "table":
         print_fleet_table(monitor, dispatches=_fetch_router_dispatches(args))
@@ -271,6 +321,20 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     servers = []
     targets = list(args.targets)
+    if args.autoscale_log:
+        # journal-only mode: one fetch, print, scriptable exit status
+        base = args.router or (
+            targets[0].split("=", 1)[-1] if targets else None
+        )
+        if not base:
+            parser.error("--autoscale-log wants --router URL or a target URL")
+        try:
+            payload = fetch_autoscale_payload(base, timeout=args.timeout)
+        except Exception as exc:  # noqa: BLE001 — report, don't trace
+            _note(args.quiet, f"[fleet] autoscale fetch failed: {exc}")
+            return 1
+        print_autoscale_log(payload)
+        return 0
     if args.demo:
         import jax
 

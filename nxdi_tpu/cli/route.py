@@ -64,6 +64,11 @@ def setup_route_parser(p: argparse.ArgumentParser) -> None:
     p.add_argument("--shed-queue-depth", type=float, default=64.0,
                    help="router load-shedding watermark "
                         "(RouterConfig.shed_queue_depth)")
+    p.add_argument("--shed-class-factors", default=None, metavar="JSON",
+                   help="per-priority-class multipliers on the shed "
+                        "watermark (RouterConfig.shed_class_factors), e.g. "
+                        "'{\"interactive\": 2.0, \"best_effort\": 0.5}' — "
+                        "best_effort sheds first, interactive last")
     p.add_argument("--degraded-penalty", type=float, default=4.0)
     p.add_argument("--poll-interval", type=float, default=0.5,
                    help="background health/load poll cadence seconds")
@@ -165,6 +170,11 @@ def run_demo_workload(router, frontend_url: str, args) -> dict:
             "prompt": prompts[i],
             "session_id": f"sess-{i % max(args.sessions, 1)}",
             "max_new_tokens": args.max_new_tokens,
+            # QoS passthrough: tenant + class ride the sampling params end
+            # to end (and pick the class-aware shed watermark at the
+            # frontend) even on engines with QoS off
+            "tenant_id": f"tenant-{i % 2}",
+            "priority": ("interactive", "batch", "best_effort")[i % 3],
         })
         if status != 200:
             errors.append(f"submit {rid}: HTTP {status} {resp}")
@@ -256,13 +266,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     if not targets:
         parser.error("no replica targets (pass name,metrics,ingest or --demo N)")
 
+    router_kwargs = dict(
+        shed_queue_depth=args.shed_queue_depth,
+        degraded_penalty=args.degraded_penalty,
+        poll_interval_s=args.poll_interval,
+    )
+    if args.shed_class_factors:
+        try:
+            router_kwargs["shed_class_factors"] = json.loads(
+                args.shed_class_factors
+            )
+        except json.JSONDecodeError:
+            parser.error("--shed-class-factors wants a JSON object")
     router = Router(
         targets,
-        config=RouterConfig(
-            shed_queue_depth=args.shed_queue_depth,
-            degraded_penalty=args.degraded_penalty,
-            poll_interval_s=args.poll_interval,
-        ),
+        config=RouterConfig(**router_kwargs),
         fleet_config=FleetConfig(
             poll_interval_s=args.poll_interval,
             timeout_s=args.timeout,

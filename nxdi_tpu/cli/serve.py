@@ -80,6 +80,15 @@ def setup_serve_parser(p: argparse.ArgumentParser) -> None:
                         "attainment gauges + breach-triggered postmortems")
     p.add_argument("--slo-tpot-ms", type=float, default=None,
                    help="declare a mean inter-token SLO target")
+    p.add_argument("--qos", action="store_true",
+                   help="enable the QoS control plane (TpuConfig(qos=...)): "
+                        "demo requests cycle tenants + priority classes, "
+                        "admission orders by deadline slack, preemption "
+                        "spares near-breach requests")
+    p.add_argument("--qos-quota", default=None, metavar="REFILL:BURST",
+                   help="with --qos, a default per-tenant token-bucket "
+                        "quota (tokens/s refill : burst tokens); over-quota "
+                        "submits error-finish deterministically (429)")
     p.add_argument("--postmortem-dir", default=None, metavar="DIR",
                    help="where trigger-fired flight-recorder bundles land "
                         "(default: in-memory only)")
@@ -181,16 +190,34 @@ def run_workload(args, app):
         if args.stream:
             print(f"  [req {req.request_id}] +{tok}", file=sys.stderr)
 
+    qos_on = getattr(args, "qos", False)
+    if qos_on:
+        from nxdi_tpu.ops.sampling import PRIORITY_CLASSES
+
     def submit(eng, i, arrival_s):
-        eng.add_request(
-            prompts[i],
-            SamplingParams(max_new_tokens=args.max_new_tokens),
-            on_token=on_token,
-            arrival_s=arrival_s,
-            # multi-turn shape: requests cycle over a few conversations so
-            # the affinity key is exercised even in this off-router demo
-            session_id=f"sess-{i % max(args.sessions, 1)}",
-        )
+        params = dict(max_new_tokens=args.max_new_tokens)
+        if qos_on:
+            # the multi-tenant shape: requests cycle tenants and priority
+            # classes so every QoS surface (quota, slack, class SLOs) moves
+            params["tenant_id"] = f"tenant-{i % 2}"
+            params["priority"] = PRIORITY_CLASSES[i % len(PRIORITY_CLASSES)]
+        try:
+            eng.add_request(
+                prompts[i],
+                SamplingParams(**params),
+                on_token=on_token,
+                arrival_s=arrival_s,
+                # multi-turn shape: requests cycle over a few conversations
+                # so the affinity key is exercised even in this off-router
+                # demo
+                session_id=f"sess-{i % max(args.sessions, 1)}",
+            )
+        except ValueError as exc:
+            # over-quota rejection (QuotaExceeded rides ValueError) — the
+            # deterministic 429 path; the demo reports rather than dies
+            if getattr(exc, "status", None) != 429:
+                raise
+            _note(args.quiet, f"[serve] req {i} rejected: {exc}")
 
     state = {"forced": args.force_preempt == 0, "peak": None, "peak_load": -1}
     tel = app.telemetry
@@ -259,6 +286,17 @@ def main(argv: Optional[List[str]] = None) -> int:
             "ttft_s": None if args.slo_ttft_ms is None else args.slo_ttft_ms / 1e3,
             "tpot_s": None if args.slo_tpot_ms is None else args.slo_tpot_ms / 1e3,
         }
+    if args.qos:
+        qos: dict = {}
+        if args.qos_quota:
+            try:
+                refill_s, burst_s = args.qos_quota.split(":", 1)
+                qos["default_quota"] = {
+                    "refill_per_s": float(refill_s), "burst": float(burst_s),
+                }
+            except ValueError:
+                parser.error("--qos-quota wants REFILL:BURST, e.g. 50:200")
+        tpu_kwargs["qos"] = qos
     if args.mixed_dispatch:
         tpu_kwargs["mixed_dispatch"] = True
     if args.prefix_cache:
@@ -318,6 +356,13 @@ def main(argv: Optional[List[str]] = None) -> int:
     # exact per-request percentiles, SLO fields when targets were declared
     summary = goodput_summary(outputs, wall, slo=app.tpu_config.slo)
     _note(args.quiet, f"[serve] {json.dumps(summary)}")
+    if getattr(engine, "qos", None) is not None:
+        for cls, row in engine.qos.to_dict()["classes"].items():
+            _note(args.quiet,
+                  f"[serve] qos[{cls}]: admitted={row['admitted']} "
+                  f"rejected={row['rejected_quota']} "
+                  f"preempted={row['preempted_deadline']} "
+                  f"attainment={row['attainment_pct']}")
     pc = engine.scheduler.prefix_cache
     if pc is not None:
         _note(args.quiet,

@@ -276,6 +276,174 @@ class SloConfig:
         return dict(self.__dict__)
 
 
+class QosConfig:
+    """QoS control plane, engine tier (nxdi_tpu/control/qos.py): multi-tenant
+    token-bucket quotas + deadline-aware admission/preemption over the
+    priority classes ``interactive`` | ``batch`` | ``best_effort``.
+
+    ``default_class`` — priority class of requests that declare none;
+    ``class_slos`` — per-class latency targets (class name -> SloConfig /
+    its kwargs dict / None = no deadline for that class). Classes absent
+    from the map fall back to the built-in defaults; an explicit None
+    entry disables the class's deadline. Slack against these targets is
+    what deadline-aware admission orders the waiting queue by
+    (``deadline = arrival + ttft_s + tpot_s * |generated|``);
+    ``quotas`` — per-tenant token buckets (tenant -> {"refill_per_s",
+    "burst"}); a submission is charged ``prompt + max_new_tokens`` at
+    admission and rejected with a deterministic 429-style error finish
+    when its tenant's bucket cannot cover it;
+    ``default_quota`` — bucket for tenants not in ``quotas`` (None =
+    unbounded — the greedy-parity default);
+    ``default_tenant`` — tenant identity of requests that declare none;
+    ``deadline_admission`` / ``deadline_preemption`` — enable the two
+    scheduler hooks independently;
+    ``slack_guard_s`` — a RUNNING request whose slack is below this is
+    never chosen as a preemption victim (it is about to breach; evicting
+    it guarantees the breach) unless every candidate is below the guard;
+    ``window`` — rolling per-class attainment population behind the
+    ``nxdi_qos_slo_attainment_pct{class}`` gauges.
+    """
+
+    #: built-in per-class deadline targets (seconds); best_effort has none
+    DEFAULT_CLASS_SLOS = {
+        "interactive": {"ttft_s": 0.5, "tpot_s": 0.1},
+        "batch": {"ttft_s": 5.0, "tpot_s": 0.5},
+        "best_effort": None,
+    }
+
+    def __init__(self, **kwargs):
+        from nxdi_tpu.ops.sampling import PRIORITY_CLASSES
+
+        self.default_class = str(kwargs.pop("default_class", "batch"))
+        if self.default_class not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"qos default_class must be one of {PRIORITY_CLASSES}, "
+                f"got {self.default_class!r}"
+            )
+        slos = dict(kwargs.pop("class_slos", None) or {})
+        unknown = sorted(set(slos) - set(PRIORITY_CLASSES))
+        if unknown:
+            raise ValueError(f"qos class_slos has unknown classes: {unknown}")
+        self.class_slos = {}
+        for cls in PRIORITY_CLASSES:
+            slo = slos.get(cls, self.DEFAULT_CLASS_SLOS[cls])
+            if isinstance(slo, dict):
+                slo = SloConfig(**slo)
+            if slo is not None and not isinstance(slo, SloConfig):
+                raise ValueError(
+                    f"qos class_slos[{cls!r}] must be an SloConfig, a dict "
+                    f"of its kwargs, or None — got {type(slo)}"
+                )
+            self.class_slos[cls] = slo
+        self.default_tenant = str(kwargs.pop("default_tenant", "default"))
+        self.quotas = {
+            str(t): self._quota(t, q)
+            for t, q in dict(kwargs.pop("quotas", None) or {}).items()
+        }
+        dq = kwargs.pop("default_quota", None)
+        self.default_quota = None if dq is None else self._quota("*", dq)
+        self.deadline_admission = bool(kwargs.pop("deadline_admission", True))
+        self.deadline_preemption = bool(kwargs.pop("deadline_preemption", True))
+        self.slack_guard_s = float(kwargs.pop("slack_guard_s", 0.05))
+        self.window = int(kwargs.pop("window", 256))
+        if kwargs:
+            raise ValueError(f"Unknown QosConfig args: {sorted(kwargs)}")
+        if self.slack_guard_s < 0:
+            raise ValueError("qos slack_guard_s must be >= 0")
+        if self.window < 1:
+            raise ValueError("qos window must be >= 1")
+
+    @staticmethod
+    def _quota(tenant, q) -> dict:
+        q = dict(q)
+        try:
+            refill = float(q.pop("refill_per_s"))
+            burst = float(q.pop("burst"))
+        except KeyError as e:
+            raise ValueError(
+                f"qos quota for tenant {tenant!r} needs refill_per_s and "
+                f"burst, missing {e}"
+            )
+        if q:
+            raise ValueError(
+                f"Unknown qos quota keys for tenant {tenant!r}: {sorted(q)}"
+            )
+        if refill < 0 or burst <= 0:
+            raise ValueError(
+                f"qos quota for tenant {tenant!r} needs refill_per_s >= 0 "
+                "and burst > 0"
+            )
+        return {"refill_per_s": refill, "burst": burst}
+
+    def to_dict(self):
+        d = dict(self.__dict__)
+        d["class_slos"] = {
+            c: None if s is None else s.to_dict()
+            for c, s in self.class_slos.items()
+        }
+        return d
+
+
+class AutoscaleConfig:
+    """QoS control plane, fleet tier (nxdi_tpu/control/autoscaler.py): the
+    policy loop that closes FleetMonitor load signals back into replica
+    lifecycle.
+
+    ``interval_s`` — loop pace of the background autoscaler thread;
+    ``ewma_alpha`` — smoothing weight of the fleet-mean load-score trend
+    (``trend = alpha * mean + (1 - alpha) * trend``; 1.0 = unsmoothed);
+    ``scale_up_score`` / ``scale_down_score`` — hysteresis band on the
+    smoothed trend: above the high watermark the fleet grows, below the
+    low one it shrinks, in between it holds (the band is what stops
+    flapping on a noisy signal);
+    ``min_replicas`` / ``max_replicas`` — hard bounds on ACTIVE (non-
+    draining) replicas;
+    ``cooldown_s`` — minimum seconds between two scaling actions (retire
+    of an already-drained replica is exempt — it frees resources and
+    cannot flap);
+    ``rebalance_ratio`` — prefill:decode mean-score ratio beyond which the
+    role mix rebalances one replica toward the pressured role (applies
+    symmetrically as ratio and 1/ratio; 0 disables role rebalance);
+    ``decision_ring`` — bound on the journaled decision trace behind the
+    ``/autoscale`` endpoint and ``cli.fleet --autoscale-log``.
+    """
+
+    def __init__(self, **kwargs):
+        self.interval_s = float(kwargs.pop("interval_s", 1.0))
+        self.ewma_alpha = float(kwargs.pop("ewma_alpha", 0.5))
+        self.scale_up_score = float(kwargs.pop("scale_up_score", 6.0))
+        self.scale_down_score = float(kwargs.pop("scale_down_score", 1.5))
+        self.min_replicas = int(kwargs.pop("min_replicas", 1))
+        self.max_replicas = int(kwargs.pop("max_replicas", 8))
+        self.cooldown_s = float(kwargs.pop("cooldown_s", 10.0))
+        self.rebalance_ratio = float(kwargs.pop("rebalance_ratio", 0.0))
+        self.decision_ring = int(kwargs.pop("decision_ring", 256))
+        if kwargs:
+            raise ValueError(f"Unknown AutoscaleConfig args: {sorted(kwargs)}")
+        if self.interval_s <= 0:
+            raise ValueError("autoscale interval_s must be > 0")
+        if not 0.0 < self.ewma_alpha <= 1.0:
+            raise ValueError("autoscale ewma_alpha must be in (0, 1]")
+        if self.scale_down_score >= self.scale_up_score:
+            raise ValueError(
+                "autoscale needs scale_down_score < scale_up_score "
+                "(the hysteresis band)"
+            )
+        if self.min_replicas < 1 or self.max_replicas < self.min_replicas:
+            raise ValueError(
+                "autoscale needs 1 <= min_replicas <= max_replicas"
+            )
+        if self.cooldown_s < 0:
+            raise ValueError("autoscale cooldown_s must be >= 0")
+        if self.rebalance_ratio < 0:
+            raise ValueError("autoscale rebalance_ratio must be >= 0 (0 off)")
+        if self.decision_ring < 1:
+            raise ValueError("autoscale decision_ring must be >= 1")
+
+    def to_dict(self):
+        return dict(self.__dict__)
+
+
 class SentinelConfig:
     """Numerics sentinel (nxdi_tpu/telemetry/sentinel.py): online correctness
     observability for the serving path — in-graph logit-health stats,
@@ -392,6 +560,12 @@ class RouterConfig:
     is rejected with explicit backpressure (HTTP 429, counted in
     ``nxdi_router_sheds_total``) when EVERY dispatchable replica's
     queue-depth gauge exceeds this;
+    ``shed_class_factors`` — class-aware shedding (QoS control plane):
+    per-priority-class multipliers on the shed watermark, so under
+    pressure ``best_effort`` sheds first (factor < 1) while
+    ``interactive`` keeps landing until the fleet is far deeper
+    underwater (factor > 1). Requests without a priority class shed at
+    the base watermark (factor 1.0);
     ``max_failovers`` — bounded retry: how many times one request may be
     re-dispatched after its replica fails (None = replica count - 1, i.e.
     every other replica gets one chance);
@@ -417,6 +591,10 @@ class RouterConfig:
         self.degraded_penalty = float(kwargs.pop("degraded_penalty", 4.0))
         self.inflight_weight = float(kwargs.pop("inflight_weight", 1.0))
         self.shed_queue_depth = float(kwargs.pop("shed_queue_depth", 16.0))
+        scf = kwargs.pop("shed_class_factors", None)
+        if scf is None:
+            scf = {"interactive": 2.0, "batch": 1.0, "best_effort": 0.5}
+        self.shed_class_factors = {str(k): float(v) for k, v in dict(scf).items()}
         mf = kwargs.pop("max_failovers", None)
         self.max_failovers = None if mf is None else int(mf)
         self.stream_failures = int(kwargs.pop("stream_failures", 2))
@@ -434,6 +612,8 @@ class RouterConfig:
             raise ValueError("router inflight_weight must be >= 0")
         if self.shed_queue_depth < 0:
             raise ValueError("router shed_queue_depth must be >= 0")
+        if any(v <= 0 for v in self.shed_class_factors.values()):
+            raise ValueError("router shed_class_factors must all be > 0")
         if self.max_failovers is not None and self.max_failovers < 0:
             raise ValueError("router max_failovers must be >= 0 (or None)")
         if self.stream_failures < 1:
@@ -917,6 +1097,17 @@ class TpuConfig:
         if isinstance(slo, dict):
             slo = SloConfig(**slo)
         self.slo = slo
+        # QoS control plane, engine tier (nxdi_tpu/control/qos.py):
+        # multi-tenant token-bucket quotas + deadline-aware admission and
+        # preemption over priority classes. A QosConfig, a dict of its
+        # kwargs, True (defaults), or None (off — admission stays FCFS/
+        # cache-aware and output is byte-identical to previous rounds).
+        qos = kwargs.pop("qos", None)
+        if qos is True:
+            qos = QosConfig()
+        elif isinstance(qos, dict):
+            qos = QosConfig(**qos)
+        self.qos = qos
         # numerics sentinel (nxdi_tpu/telemetry/sentinel.py): in-graph
         # logit-health stats + sampled shadow-replay verification + the
         # preemption-replay invariant. A SentinelConfig, a dict of its

@@ -1,0 +1,25 @@
+"""QoS control plane: the policy tier between request intake and the fleet.
+
+Two halves, one subsystem:
+
+- **Engine tier** (:mod:`nxdi_tpu.control.qos`): per-tenant token-bucket
+  quotas, priority classes, and deadline-aware admission/preemption hooks
+  the slot scheduler consults. Declared via ``TpuConfig(qos=...)``.
+- **Fleet tier** (:mod:`nxdi_tpu.control.autoscaler`): a policy loop over
+  the fleet observatory's load signals that drives replica lifecycle —
+  scale-up, cooperative drain, retire, and prefill:decode role rebalance —
+  through the router's existing actuators.
+
+The control plane never changes what a request generates, only when and
+where it runs (and whether it is admitted at all): sampling rows, greedy
+parity, and the recompute-preemption invariants are untouched.
+"""
+
+from nxdi_tpu.control.autoscaler import AutoscaleDecision, Autoscaler  # noqa: F401
+from nxdi_tpu.control.qos import (  # noqa: F401
+    PRIORITY_CLASSES,
+    QosPolicy,
+    QuotaExceeded,
+    TokenBucket,
+    jain_index,
+)

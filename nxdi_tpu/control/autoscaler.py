@@ -1,0 +1,350 @@
+"""QoS control plane, fleet tier: the elastic autoscaling policy loop.
+
+Closes the loop ROADMAP item 2 left open: the fleet observatory
+(:class:`~nxdi_tpu.telemetry.fleet.FleetMonitor`) *observes* replica load;
+this module *acts* on it. One :class:`Autoscaler` watches the smoothed
+fleet-mean load score and drives replica lifecycle through injected
+actuator callbacks — the router's cooperative drain/undrain and whatever
+spawn/retire hooks the host wires in (``bench --serving --autoscale``
+exercises it against live in-process engines):
+
+::
+
+                 trend > scale_up_score          drained empty
+       HOLD ──────────────────────────▶ SCALE_UP      │
+        ▲  ◀──────── cooldown ─────────────┘          ▼
+        │        trend < scale_down_score          RETIRE
+        └──────────────────────────▶ DRAIN ──────────▲
+
+- **scale-up** when the EWMA-smoothed trend crosses the high watermark
+  (and active replicas < max) — the actuator adds capacity (typically
+  undraining a warm standby or spawning a replica);
+- **drain** when the trend falls below the low watermark (and active
+  replicas > min) — the LEAST loaded replica drains cooperatively: no new
+  dispatches, in-flight requests finish in place (PR 9/15 semantics);
+- **retire** a draining replica the moment its signals show it empty
+  (queue 0, slots 0) — exempt from cooldown, it only frees resources;
+- **role rebalance** (optional) when the prefill:decode mean-score ratio
+  leaves ``[1/ratio, ratio]`` — one replica converts toward the
+  pressured role.
+
+The hysteresis band (``scale_down_score < scale_up_score``), the EWMA
+smoothing, and the action cooldown are what keep a noisy signal from
+flapping the fleet. Every decision is journaled into a bounded ring
+exposed at ``/autoscale`` and rendered by ``cli.fleet --autoscale-log``.
+
+Threading: ``start()`` runs the loop on a named daemon thread
+(``nxdi-autoscale``). Policy state (trend, ring, draining set, cooldown
+stamp) is guarded by ``_lock``; the monitor poll, signal read, and every
+actuator call happen OUTSIDE the lock (actuators do HTTP).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Callable, Deque, Dict, List, Optional
+
+logger = logging.getLogger("nxdi_tpu")
+
+__all__ = ["ACTIONS", "AutoscaleDecision", "Autoscaler"]
+
+ACTIONS = ("scale_up", "drain", "retire", "rebalance")
+
+
+@dataclass
+class AutoscaleDecision:
+    """One journaled policy decision (the ``/autoscale`` trace line)."""
+
+    t: float
+    action: str
+    replica: Optional[str]
+    signal_trend: float
+    reason: str
+    extra: dict = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = {
+            "t": self.t,
+            "action": self.action,
+            "replica": self.replica,
+            "signal_trend": self.signal_trend,
+            "reason": self.reason,
+        }
+        d.update(self.extra)
+        return d
+
+
+class Autoscaler:
+    """Policy loop from fleet load signals to replica lifecycle.
+
+    ``monitor`` — the FleetMonitor whose :meth:`load_signals` feed the
+    trend; ``scale_up()``, ``drain(replica)``, ``retire(replica)``, and
+    ``rebalance(from_role, to_role)`` are the actuator callbacks (any may
+    be None — the corresponding action is then never taken).
+    ``scale_up`` returns the replica label it activated (or None);
+    ``standby`` names replicas parked warm (drained but still polled by
+    the monitor) — they are excluded from the active count and the trend
+    until a scale-up activates one, and a retired replica returns to
+    standby (in-process fleets keep polling it; a real fleet's terminated
+    replica simply stops appearing in the signals). ``poll`` polls the
+    monitor each tick (leave False when a co-located router already polls
+    it). ``wall_clock`` injects the clock domain — tests freeze it for
+    deterministic hysteresis/cooldown checks."""
+
+    def __init__(
+        self,
+        monitor,
+        config=None,
+        *,
+        scale_up: Optional[Callable[[], Optional[str]]] = None,
+        drain: Optional[Callable[[str], object]] = None,
+        retire: Optional[Callable[[str], object]] = None,
+        rebalance: Optional[Callable[[str, str], Optional[str]]] = None,
+        standby: Optional[List[str]] = None,
+        poll: bool = False,
+        wall_clock: Optional[Callable[[], float]] = None,
+    ):
+        from nxdi_tpu.config import AutoscaleConfig
+
+        self.monitor = monitor
+        self.config = config if config is not None else AutoscaleConfig()
+        self.wall_clock = wall_clock or time.monotonic
+        self.poll = bool(poll)
+        self._scale_up = scale_up
+        self._drain = drain
+        self._retire = retire
+        self._rebalance = rebalance
+        self._lock = threading.Lock()
+        self._trend: Optional[float] = None  # guarded_by: _lock
+        self._last_action_s: Optional[float] = None  # guarded_by: _lock
+        #: replicas this autoscaler put into cooperative drain, with the
+        #: decision stamp (cleared on retire)
+        self._draining: Dict[str, float] = {}  # guarded_by: _lock
+        #: warm parked replicas a scale-up can activate; retire refills it
+        self._standby = set(standby or ())  # guarded_by: _lock
+        self._ring: Deque[AutoscaleDecision] = deque(  # guarded_by: _lock
+            maxlen=self.config.decision_ring
+        )
+        self._stop = threading.Event()
+        self._thread = None  # lock-free: start/stop lifecycle is owner-thread-only
+
+        # autoscale telemetry lives on the MONITOR's persistent registry so
+        # one fleet scrape carries decisions next to the health series
+        r = monitor.registry
+        self.decisions_total = r.counter(
+            "nxdi_autoscale_decisions_total",
+            "autoscaler policy decisions by action",
+            ("action",),
+        )
+        self.replicas_target = r.gauge(
+            "nxdi_autoscale_replicas_target",
+            "active (non-draining) replica count the autoscaler is steering "
+            "toward",
+        )
+        for a in ACTIONS:
+            self.decisions_total.inc(0, action=a)
+        self.replicas_target.set(0.0)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "Autoscaler":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="nxdi-autoscale"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.config.interval_s):
+            try:
+                if self.poll:
+                    self.monitor.poll()
+                self.evaluate()
+            except Exception:  # noqa: BLE001 — the loop must survive
+                logger.warning("autoscale round failed", exc_info=True)
+
+    # -- the policy step ----------------------------------------------------
+    def evaluate(self) -> List[AutoscaleDecision]:
+        """One policy round: refresh the trend from the current load
+        signals, retire emptied drains, then take at most ONE scaling
+        action if the hysteresis band and cooldown allow. Returns the
+        decisions taken this round (possibly empty). Deterministic given
+        (signals, clock) — the unit tests drive it directly."""
+        cfg = self.config
+        now = self.wall_clock()
+        signals = list(self.monitor.load_signals())  # outside the lock
+        with self._lock:
+            draining = dict(self._draining)
+            standby = set(self._standby)
+            last_action = self._last_action_s
+        active = [
+            s for s in signals
+            if s.replica not in draining and s.replica not in standby
+        ]
+        mean_score = (
+            sum(s.score for s in active) / len(active) if active else 0.0
+        )
+        with self._lock:
+            if self._trend is None:
+                self._trend = mean_score
+            else:
+                self._trend = (
+                    cfg.ewma_alpha * mean_score
+                    + (1.0 - cfg.ewma_alpha) * self._trend
+                )
+            trend = self._trend
+
+        decisions: List[AutoscaleDecision] = []
+
+        # retire pass — cooldown-exempt: an emptied drain only frees space
+        for s in signals:
+            if (
+                s.replica in draining
+                and s.queue_depth == 0
+                and s.slots_busy == 0
+            ):
+                decisions.append(AutoscaleDecision(
+                    t=now, action="retire", replica=s.replica,
+                    signal_trend=trend,
+                    reason="drained empty (queue 0, slots 0)",
+                ))
+                if self._retire is not None:
+                    self._retire(s.replica)
+                with self._lock:
+                    self._draining.pop(s.replica, None)
+                    # back to warm standby: the monitor may keep polling an
+                    # in-process replica; only a scale-up reactivates it
+                    self._standby.add(s.replica)
+                draining.pop(s.replica, None)
+
+        in_cooldown = (
+            last_action is not None and now - last_action < cfg.cooldown_s
+        )
+        action = self._pick_scaling(
+            cfg, trend, active, draining, in_cooldown, now
+        )
+        if action is not None:
+            decisions.append(action)
+
+        for d in decisions:
+            self.decisions_total.inc(action=d.action)
+        with self._lock:
+            for d in decisions:
+                self._ring.append(d)
+                if d.action in ("scale_up", "drain", "rebalance"):
+                    self._last_action_s = d.t
+        self.replicas_target.set(self._target_count(signals))
+        return decisions
+
+    def _pick_scaling(
+        self, cfg, trend, active, draining, in_cooldown, now
+    ) -> Optional[AutoscaleDecision]:
+        """The single scaling action of a round (or None): scale-up wins
+        over drain, drain over rebalance. Actuators are invoked here —
+        outside the policy lock."""
+        if in_cooldown or not active:
+            return None
+        if (
+            trend > cfg.scale_up_score
+            and len(active) < cfg.max_replicas
+            and self._scale_up is not None
+        ):
+            replica = self._scale_up()
+            if replica is not None:
+                with self._lock:
+                    self._standby.discard(replica)
+            return AutoscaleDecision(
+                t=now, action="scale_up", replica=replica, signal_trend=trend,
+                reason=(
+                    f"trend {trend:.2f} > scale_up_score "
+                    f"{cfg.scale_up_score:g} with {len(active)} active"
+                ),
+            )
+        if (
+            trend < cfg.scale_down_score
+            and len(active) > cfg.min_replicas
+            and self._drain is not None
+        ):
+            # drain the LEAST loaded active replica: cheapest to empty,
+            # and its in-flight work finishes in place (cooperative drain)
+            victim = min(active, key=lambda s: (s.score, s.replica)).replica
+            self._drain(victim)
+            with self._lock:
+                self._draining[victim] = now
+            return AutoscaleDecision(
+                t=now, action="drain", replica=victim, signal_trend=trend,
+                reason=(
+                    f"trend {trend:.2f} < scale_down_score "
+                    f"{cfg.scale_down_score:g} with {len(active)} active"
+                ),
+            )
+        if cfg.rebalance_ratio > 0 and self._rebalance is not None:
+            prefill = [s for s in active if s.role == "prefill"]
+            decode = [s for s in active if s.role == "decode"]
+            if prefill and decode:
+                p = sum(s.score for s in prefill) / len(prefill)
+                d = sum(s.score for s in decode) / len(decode)
+                ratio = p / d if d > 0 else float("inf") if p > 0 else 1.0
+                src = dst = None
+                if ratio > cfg.rebalance_ratio and len(decode) > 1:
+                    src, dst = "decode", "prefill"
+                elif (
+                    ratio < 1.0 / cfg.rebalance_ratio and len(prefill) > 1
+                ):
+                    src, dst = "prefill", "decode"
+                if src is not None:
+                    replica = self._rebalance(src, dst)
+                    return AutoscaleDecision(
+                        t=now, action="rebalance", replica=replica,
+                        signal_trend=trend,
+                        reason=(
+                            f"prefill:decode pressure {ratio:.2f} outside "
+                            f"±{cfg.rebalance_ratio:g} band"
+                        ),
+                        extra={"from_role": src, "to_role": dst},
+                    )
+        return None
+
+    def _target_count(self, signals) -> int:
+        with self._lock:
+            parked = set(self._draining) | self._standby
+        return sum(1 for s in signals if s.replica not in parked)
+
+    # -- observability ------------------------------------------------------
+    def draining(self) -> List[str]:
+        with self._lock:
+            return sorted(self._draining)
+
+    def standby(self) -> List[str]:
+        with self._lock:
+            return sorted(self._standby)
+
+    def snapshot_log(self) -> List[dict]:
+        """The journaled decision trace, oldest first (bounded ring)."""
+        with self._lock:
+            return [d.to_dict() for d in self._ring]
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            trend = self._trend
+            draining = sorted(self._draining)
+            standby = sorted(self._standby)
+            decisions = [d.to_dict() for d in self._ring]
+        return {
+            "config": self.config.to_dict(),
+            "signal_trend": trend,
+            "draining": draining,
+            "standby": standby,
+            "decisions": decisions,
+        }

@@ -1,0 +1,315 @@
+"""QoS control plane, engine tier: tenants, priority classes, deadlines.
+
+Three policy surfaces over the serving engine, all declared through
+``TpuConfig(qos=...)`` (:class:`~nxdi_tpu.config.QosConfig`):
+
+- **Token-bucket quotas** — every admission charges its tenant's bucket
+  ``prompt + max_new_tokens`` tokens (the reservation the KV admission
+  check already sizes against). A submission the bucket cannot cover is
+  rejected deterministically with :class:`QuotaExceeded` — a ``ValueError``
+  subclass, so the ingest tier's existing error-finish path turns it into
+  the 429-style finish without a new code path.
+- **Deadline-aware admission** — the scheduler orders the waiting queue by
+  slack against the per-class SLO targets::
+
+      deadline(r) = arrival + ttft_target + tpot_target * |generated|
+      slack(r)    = deadline(r) - now
+
+  (the ``|generated|`` term gives a preempted request credit for the
+  tokens it already owes at the class's inter-token rate). Least slack
+  admits first; the prefix-cache coverage probe breaks exact-slack ties
+  (PR 14's cache-aware admission) and the aged-head starvation bound
+  still reverts the whole decision to FCFS.
+- **Deadline-aware preemption** — victim choice prefers the request with
+  the MOST slack and never picks one inside ``slack_guard_s`` of its
+  deadline (evicting a request about to breach guarantees the breach)
+  unless every candidate is; exact-slack ties fall back to PR 15's
+  cheapest-recompute-first key.
+
+Threading: a :class:`QosPolicy` is owned by the engine's single driver
+thread, exactly like the :class:`~nxdi_tpu.serving.scheduler.Scheduler`
+that consults it — no locks, by ownership. Cross-thread observers read
+the telemetry snapshot (``_qos`` extra), never this object.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from collections import deque
+from typing import Deque, Dict, Optional, Sequence
+
+from nxdi_tpu.ops.sampling import PRIORITY_CLASSES
+
+__all__ = [
+    "PRIORITY_CLASSES",
+    "QosPolicy",
+    "QuotaExceeded",
+    "TokenBucket",
+    "jain_index",
+]
+
+
+class QuotaExceeded(ValueError):
+    """A tenant's token bucket cannot cover a submission (HTTP 429 moral
+    equivalent). Subclasses ``ValueError`` so every intake tier that
+    already converts admission ValueErrors into deterministic error
+    finishes (router ingest, bench drivers) handles it unchanged."""
+
+    status = 429
+
+    def __init__(self, tenant: str, cost: float, available: float):
+        self.tenant = tenant
+        self.cost = cost
+        self.available = available
+        super().__init__(
+            f"quota exceeded (429): tenant {tenant!r} asked {cost:g} tokens "
+            f"with {available:g} available"
+        )
+
+
+class TokenBucket:
+    """Deterministic token bucket: capacity ``burst``, refilled at
+    ``refill_per_s`` from the elapsed time of the injected clock domain —
+    no background thread, the refill happens lazily inside :meth:`take`,
+    so identical (clock, arrival) sequences always admit identically."""
+
+    __slots__ = ("refill_per_s", "burst", "level", "t_last")
+
+    def __init__(self, refill_per_s: float, burst: float, now: float = 0.0):
+        if refill_per_s < 0 or burst <= 0:
+            raise ValueError("TokenBucket needs refill_per_s >= 0, burst > 0")
+        self.refill_per_s = float(refill_per_s)
+        self.burst = float(burst)
+        self.level = float(burst)  # buckets start full
+        self.t_last = float(now)
+
+    def _refill(self, now: float) -> None:
+        dt = now - self.t_last
+        if dt > 0:
+            self.level = min(self.burst, self.level + dt * self.refill_per_s)
+        self.t_last = max(self.t_last, now)
+
+    def peek(self, now: float) -> float:
+        """Available tokens at ``now`` (refills as a side effect)."""
+        self._refill(now)
+        return self.level
+
+    def take(self, cost: float, now: float) -> bool:
+        """Charge ``cost`` tokens; False (and no charge) when the bucket
+        cannot cover it."""
+        self._refill(now)
+        if cost > self.level:
+            return False
+        self.level -= cost
+        return True
+
+
+def jain_index(values: Sequence[float]) -> float:
+    """Jain's fairness index over per-tenant goodput: ``(Σx)² / (n·Σx²)``,
+    1.0 = perfectly fair, 1/n = one tenant took everything. Empty or
+    all-zero populations read 1.0 (nothing was shared unfairly)."""
+    xs = [float(v) for v in values]
+    if not xs:
+        return 1.0
+    sq = sum(x * x for x in xs)
+    if sq == 0.0:
+        return 1.0
+    s = sum(xs)
+    return (s * s) / (len(xs) * sq)
+
+
+class QosPolicy:
+    """Engine-side QoS state: per-tenant buckets, per-class slack math,
+    and the per-class attainment windows behind the telemetry catalog."""
+
+    def __init__(self, config, telemetry=None, clock=None):
+        self.config = config
+        self.telemetry = telemetry
+        if clock is None:
+            clock = (
+                telemetry.clock
+                if telemetry is not None and getattr(telemetry, "clock", None)
+                else time.monotonic
+            )
+        self.clock = clock
+        #: tenant -> TokenBucket, created lazily on first admission so a
+        #: default_quota applies to tenants never named in the config
+        self._buckets: Dict[str, TokenBucket] = {}
+        #: class -> rolling (attained: bool) window for the attainment gauge
+        self._windows: Dict[str, Deque[bool]] = {
+            c: deque(maxlen=config.window) for c in PRIORITY_CLASSES
+        }
+        #: lifetime admission/rejection tallies (survive window rollover)
+        self.admitted_n = {c: 0 for c in PRIORITY_CLASSES}
+        self.rejected_n = {c: 0 for c in PRIORITY_CLASSES}
+        self.preempted_n = {c: 0 for c in PRIORITY_CLASSES}
+        self.tenant_tokens_n: Dict[str, float] = {}
+
+        self._admitted = self._rejected = self._preempted = None
+        self._tenant_tokens = self._attainment_gauge = None
+        if telemetry is not None and telemetry.enabled:
+            r = telemetry.registry
+            self._admitted = r.counter(
+                "nxdi_qos_admitted_total",
+                "requests admitted past the QoS quota gate, per class",
+                ("priority",),
+            )
+            self._rejected = r.counter(
+                "nxdi_qos_rejected_quota_total",
+                "submissions rejected over tenant quota (429-style error "
+                "finish), per class",
+                ("priority",),
+            )
+            self._preempted = r.counter(
+                "nxdi_qos_preempted_deadline_total",
+                "preemptions chosen by deadline-aware victim selection, "
+                "per victim class",
+                ("priority",),
+            )
+            self._tenant_tokens = r.counter(
+                "nxdi_tenant_tokens_total",
+                "tokens charged against each tenant's bucket at admission "
+                "(prompt + max_new_tokens reservation)",
+                ("tenant",),
+            )
+            self._attainment_gauge = r.gauge(
+                "nxdi_qos_slo_attainment_pct",
+                "rolling per-class SLO attainment over the QoS window",
+                ("priority",),
+            )
+            for c in PRIORITY_CLASSES:
+                self._admitted.inc(0, priority=c)
+                self._rejected.inc(0, priority=c)
+                self._preempted.inc(0, priority=c)
+                self._attainment_gauge.set(100.0, priority=c)
+            for t in sorted(set(config.quotas) | {config.default_tenant}):
+                self._tenant_tokens.inc(0, tenant=t)
+            telemetry.add_snapshot_extra("_qos", self.to_dict)
+
+    # -- identity -----------------------------------------------------------
+    def class_of(self, req) -> str:
+        cls = getattr(req, "priority", None)
+        return cls if cls is not None else self.config.default_class
+
+    def tenant_of(self, req) -> str:
+        tenant = getattr(req, "tenant_id", None)
+        return tenant if tenant is not None else self.config.default_tenant
+
+    def class_slo(self, cls: str):
+        return self.config.class_slos.get(cls)
+
+    # -- quota gate ---------------------------------------------------------
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        b = self._buckets.get(tenant)
+        if b is None:
+            spec = self.config.quotas.get(tenant, self.config.default_quota)
+            if spec is None:
+                return None  # unbounded tenant — the greedy-parity default
+            b = TokenBucket(
+                spec["refill_per_s"], spec["burst"], now=self.clock()
+            )
+            self._buckets[tenant] = b
+        return b
+
+    def admit(self, req) -> None:
+        """Charge ``req``'s tenant bucket or raise :class:`QuotaExceeded`.
+        The cost is the same worst-case reservation the paged-pool check
+        sizes against: ``prompt + max_new_tokens``."""
+        cls = self.class_of(req)
+        tenant = self.tenant_of(req)
+        cost = float(len(req.prompt) + req.params.max_new_tokens)
+        bucket = self._bucket(tenant)
+        if bucket is not None and not bucket.take(cost, self.clock()):
+            self.rejected_n[cls] += 1
+            if self._rejected is not None:
+                self._rejected.inc(priority=cls)
+            raise QuotaExceeded(tenant, cost, bucket.level)
+        self.admitted_n[cls] += 1
+        self.tenant_tokens_n[tenant] = (
+            self.tenant_tokens_n.get(tenant, 0.0) + cost
+        )
+        if self._admitted is not None:
+            self._admitted.inc(priority=cls)
+            self._tenant_tokens.inc(cost, tenant=tenant)
+
+    # -- deadline math ------------------------------------------------------
+    def deadline(self, req) -> float:
+        """Absolute deadline (telemetry-clock domain) of ``req``'s NEXT
+        due token under its class targets; ``inf`` for undeadlined
+        classes. ``arrival + ttft + tpot * |generated|`` — a request that
+        already emitted tokens owes the next one at the class's
+        inter-token rate, which is exactly what makes re-queued preempted
+        interactive requests urgent again."""
+        slo = self.class_slo(self.class_of(req))
+        if slo is None:
+            return math.inf
+        d = req.arrival_s
+        if slo.ttft_s is not None:
+            d += slo.ttft_s
+        if slo.tpot_s is not None:
+            d += slo.tpot_s * len(req.generated)
+        elif req.generated:
+            return math.inf  # TTFT already spent; no inter-token target
+        return d
+
+    def slack(self, req, now: Optional[float] = None) -> float:
+        if now is None:
+            now = self.clock()
+        return self.deadline(req) - now
+
+    # -- accounting ---------------------------------------------------------
+    def note_preempted(self, req) -> None:
+        cls = self.class_of(req)
+        self.preempted_n[cls] += 1
+        if self._preempted is not None:
+            self._preempted.inc(priority=cls)
+
+    def observe_finish(self, req, ttft_s, tpot_s) -> None:
+        """Record one non-error finish into its class's rolling attainment
+        window (same strict-``>`` breach rule the engine-wide SLO tracker
+        uses; a class without declared targets attains vacuously)."""
+        from nxdi_tpu.telemetry.slo import breach_kinds
+
+        cls = self.class_of(req)
+        slo = self.class_slo(cls)
+        attained = True if slo is None else not breach_kinds(slo, ttft_s, tpot_s)
+        win = self._windows[cls]
+        win.append(attained)
+        if self._attainment_gauge is not None:
+            self._attainment_gauge.set(
+                100.0 * sum(win) / len(win), priority=cls
+            )
+
+    def attainment_pct(self) -> Dict[str, Optional[float]]:
+        """Rolling per-class attainment; None for classes with no finishes
+        yet (so dashboards can tell 'no traffic' from 'perfect')."""
+        return {
+            c: (100.0 * sum(w) / len(w) if w else None)
+            for c, w in self._windows.items()
+        }
+
+    def to_dict(self) -> dict:
+        return {
+            "classes": {
+                c: {
+                    "admitted": self.admitted_n[c],
+                    "rejected_quota": self.rejected_n[c],
+                    "preempted_deadline": self.preempted_n[c],
+                    "attainment_pct": a,
+                    "slo": (
+                        None if self.class_slo(c) is None
+                        else self.class_slo(c).to_dict()
+                    ),
+                }
+                for c, a in self.attainment_pct().items()
+            },
+            "tenants": {
+                t: {
+                    "tokens_charged": self.tenant_tokens_n.get(t, 0.0),
+                    "bucket_level": b.level,
+                }
+                for t, b in sorted(self._buckets.items())
+            },
+            "default_class": self.config.default_class,
+        }

@@ -58,6 +58,13 @@ def normalize_eos_ids(eos_token_id) -> List[int]:
     return [int(e) for e in np.atleast_1d(eos_token_id).astype(np.int64)]
 
 
+#: QoS priority classes (nxdi_tpu/control/qos.py), most latency-critical
+#: first. Defined HERE because SamplingParams is the wire format the class
+#: rides on (a leaf module the router, engine, and control plane all
+#: import); the control plane re-exports it.
+PRIORITY_CLASSES = ("interactive", "batch", "best_effort")
+
+
 @dataclass
 class SamplingParams:
     """Per-request sampling knobs. ``do_sample=False`` coerces the row to
@@ -80,6 +87,13 @@ class SamplingParams:
     #: (paged layout; elsewhere siblings simply prefill). Host-side only —
     #: never part of the per-row sampling tensor.
     n: int = 1
+    #: QoS identity (nxdi_tpu/control/qos.py): the tenant a token-bucket
+    #: quota charges and the priority class deadline-aware scheduling
+    #: orders by. Host-side only, like ``n`` — never part of the sampling
+    #: tensor row, so QoS can never change what a request generates, only
+    #: when it runs. None = the QosConfig defaults (or no QoS at all).
+    tenant_id: Optional[str] = None
+    priority: Optional[str] = None
 
     def __post_init__(self):
         self.eos_token_ids = tuple(normalize_eos_ids(self.eos_token_ids))
@@ -87,6 +101,13 @@ class SamplingParams:
             raise ValueError("max_new_tokens must be >= 1")
         if self.n < 1:
             raise ValueError("n must be >= 1")
+        if self.tenant_id is not None:
+            self.tenant_id = str(self.tenant_id)
+        if self.priority is not None and self.priority not in PRIORITY_CLASSES:
+            raise ValueError(
+                f"priority must be one of {PRIORITY_CLASSES}, "
+                f"got {self.priority!r}"
+            )
 
     def row(self) -> Tuple[float, float, float]:
         """One (top_k, top_p, temperature) sampling row; greedy unless

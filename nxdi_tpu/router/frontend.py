@@ -59,6 +59,7 @@ from urllib.parse import parse_qs, quote, urlsplit
 
 from nxdi_tpu.router.policy import (
     DispatchPolicy,
+    class_shed_watermark,
     dispatchable,
     role_candidates,
     should_shed,
@@ -192,6 +193,9 @@ class Router:
         self._stop = threading.Event()
         self._poll_thread = None  # lock-free: start/stop lifecycle is owner-thread-only
         self._server = None  # lock-free: start/stop lifecycle is owner-thread-only
+        # control/autoscaler.Autoscaler joined via attach_autoscaler():
+        # its decision trace answers /autoscale and rides /snapshot
+        self._autoscaler = None  # lock-free: attached once before serve()
 
         # router telemetry — pre-seeded zero per target so absence-of-events
         # is observable from the first scrape, federated into every fleet
@@ -406,11 +410,22 @@ class Router:
                         },
                         "draining": sorted(self._draining),
                     }
-                if should_shed(candidates, self.config.shed_queue_depth):
+                # class-aware shedding (QoS): best_effort sheds first —
+                # its watermark is a fraction of the base — while an
+                # interactive submit keeps landing until the fleet is far
+                # deeper underwater, so 429s reach the latency-critical
+                # class last
+                watermark = class_shed_watermark(
+                    self.config.shed_queue_depth,
+                    payload.get("priority"),
+                    getattr(self.config, "shed_class_factors", None),
+                )
+                if should_shed(candidates, watermark):
                     self.sheds_total.inc()
                     return 429, {
                         "error": "shed",
-                        "watermark": self.config.shed_queue_depth,
+                        "watermark": watermark,
+                        "priority": payload.get("priority"),
                         "queue_depths": {
                             s.replica: s.queue_depth for s in candidates
                         },
@@ -960,10 +975,26 @@ class Router:
             h["requests"] = requests_summary(self._requests)
         return h
 
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Join the QoS control plane's fleet-tier policy loop
+        (control/autoscaler.py): its journaled decision trace becomes the
+        router's ``/autoscale`` endpoint and a ``_autoscale`` snapshot
+        block. Attach before :meth:`serve` — the reference is read by
+        handler threads without a lock."""
+        self._autoscaler = autoscaler
+
+    def autoscale_payload(self) -> dict:
+        a = self._autoscaler
+        if a is None:
+            return {"error": "no autoscaler attached", "decisions": []}
+        return a.to_dict()
+
     def snapshot(self) -> dict:
         """The fleet snapshot (router series federated in) + a ``_router``
         summary block."""
         snap = self.monitor.snapshot()
+        if self._autoscaler is not None:
+            snap["_autoscale"] = self._autoscaler.to_dict()
         with self._lock:
             snap["_router"] = {
                 "config": self.config.to_dict(),
@@ -1092,6 +1123,8 @@ class Router:
              lambda path, body: json.dumps(self.snapshot(), indent=2)),
             ("POST", "/poll", "application/json",
              lambda path, body: json.dumps(self.poll())),
+            ("GET", "/autoscale", "application/json",
+             lambda path, body: json.dumps(self.autoscale_payload())),
             ("GET", "/traces", "application/json",
              lambda path, body: json.dumps({
                  "replica_id": "router",

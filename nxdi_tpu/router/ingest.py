@@ -64,10 +64,12 @@ from nxdi_tpu.telemetry.tracing import HOP_INGEST_QUEUE, TraceContext
 
 logger = logging.getLogger("nxdi_tpu")
 
-#: sampling keys a /submit payload may carry through to SamplingParams
+#: sampling keys a /submit payload may carry through to SamplingParams —
+#: including the host-side QoS identity pair (tenant_id, priority), which
+#: rides SamplingParams like ``n`` and never touches the sampling tensor
 SAMPLING_KEYS = (
     "max_new_tokens", "eos_token_ids", "do_sample", "top_k", "top_p",
-    "temperature",
+    "temperature", "tenant_id", "priority",
 )
 
 

@@ -91,6 +91,25 @@ def should_shed(candidates: Sequence[LoadSignal], watermark: float) -> bool:
     return all(s.queue_depth > watermark for s in candidates)
 
 
+def class_shed_watermark(
+    base: float,
+    priority: Optional[str] = None,
+    factors: Optional[Dict[str, float]] = None,
+) -> float:
+    """Class-aware shedding watermark (QoS control plane): the base
+    watermark scaled by the request's priority-class factor
+    (``RouterConfig.shed_class_factors``). With the default factors
+    ``best_effort`` (0.5x) sheds first as pressure builds, ``batch``
+    (1.0x) at the base rule, and ``interactive`` (2.0x) only once the
+    fleet is twice as deep underwater — so the router degrades the cheap
+    traffic before ever returning 429 to a latency-critical request. A
+    missing class or factor map keeps the base watermark (pre-QoS rule,
+    bit-for-bit)."""
+    if not priority or not factors:
+        return base
+    return base * float(factors.get(priority, 1.0))
+
+
 class DispatchPolicy:
     """Owns the ranking rule and the session-pin table. Not thread-safe by
     itself — the :class:`~nxdi_tpu.router.frontend.Router` serializes calls

@@ -284,6 +284,11 @@ class InferenceEngine:
         # TpuConfig(slo=...) targets into rolling attainment gauges
         self.flight = None
         self.slo = None
+        # QoS control plane, engine tier (control/qos.py): tenant quotas +
+        # deadline-aware scheduling, attached below when TpuConfig(qos=...)
+        # is declared alongside live telemetry (its slack math and bucket
+        # refills ride the telemetry clock, so the two must share a domain)
+        self.qos = None
         # numerics sentinel (telemetry/sentinel.py), attached at app.load()
         # when TpuConfig(sentinel=...) is declared: the engine adds the two
         # serving-only checks — the preemption-replay invariant on every
@@ -321,6 +326,18 @@ class InferenceEngine:
                 "TpuConfig(slo=...) declared but telemetry is off — SLO "
                 "attainment needs the request spans; nothing will be tracked"
             )
+        if getattr(tc, "qos", None) is not None:
+            if tel is not None and tel.enabled:
+                from nxdi_tpu.control.qos import QosPolicy
+
+                self.qos = QosPolicy(tc.qos, telemetry=tel)
+                self.scheduler.qos = self.qos
+            else:
+                logger.warning(
+                    "TpuConfig(qos=...) declared but telemetry is off — "
+                    "quota buckets and deadline slack ride the telemetry "
+                    "clock; QoS is disabled"
+                )
 
         # fault tolerance (runtime/faults.py): taxonomy-driven step
         # recovery is always on (budgets from TpuConfig(faults=...)); the
@@ -519,6 +536,13 @@ class InferenceEngine:
                     f"{self.block_manager.num_blocks}; raise pa_num_blocks, "
                     "shorten the prompt, or lower max_new_tokens"
                 )
+        if self.qos is not None:
+            # LAST gate, after every other validation: a request rejected
+            # for a malformed shape must not consume tenant quota. Raises
+            # QuotaExceeded (a ValueError) — the ingest tier's existing
+            # error-finish conversion is what makes it a deterministic
+            # 429-style finish instead of a crash.
+            self.qos.admit(req)
         if tel is not None and tel.enabled:
             # backdate to the request's ARRIVAL: a driver submitting between
             # engine steps must not shave that wait off the reported TTFT
@@ -1512,6 +1536,12 @@ class InferenceEngine:
                 # deferred to step()'s end: the bundle must include the
                 # StepRecord of the very step this finish happened in
                 self._pending_breaches.append((req, kinds))
+        if self.qos is not None and reason != "error":
+            # per-class attainment rides the same ttft/tpot the span
+            # measured (and the same error exclusion as the engine SLO)
+            self.qos.observe_finish(
+                req, metrics.get("ttft_s"), metrics.get("tpot_s")
+            )
         if (
             self.sentinel is not None
             and reason != "error"

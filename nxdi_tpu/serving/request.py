@@ -126,6 +126,18 @@ class Request:
 
     # -- derived views ------------------------------------------------------
     @property
+    def tenant_id(self) -> Optional[str]:
+        """QoS tenant identity (rides SamplingParams like ``n`` — host-side
+        only; None = the control plane's default tenant)."""
+        return self.params.tenant_id
+
+    @property
+    def priority(self) -> Optional[str]:
+        """QoS priority class (``interactive`` | ``batch`` | ``best_effort``;
+        None = the control plane's default class)."""
+        return self.params.priority
+
+    @property
     def seq_tokens(self) -> List[int]:
         """The full sequence a (re)prefill must commit: prompt + generated.
         A preempted request replays all of it (recompute-style resume)."""

@@ -141,6 +141,11 @@ class Scheduler:
         # when config.prefix_cache is on: admission forks cached chains,
         # retirement/preemption insert retired full blocks into the tree
         self.prefix_cache = None
+        # control/qos.QosPolicy, attached by the owning engine when
+        # TpuConfig(qos=...) is declared: deadline-aware admission ordering
+        # and preemption victim choice consult its per-class slack math.
+        # None keeps every decision byte-identical to the pre-QoS rules.
+        self.qos = None
         # set by the engine when a fork's tail prefill can actually start
         # mid-prompt (prefix-prefill submodel or mixed dispatch compiled);
         # without it n>1 siblings fall back to full prefills
@@ -263,17 +268,21 @@ class Scheduler:
 
     def _pick_admission(self) -> int:
         """Waiting-queue index to admit next. Strict FCFS (0) unless
-        cache-aware admission applies; then the longest cached prefix wins
-        with a strict ``>`` so equal hits keep arrival order. The scan is
-        read-only (``PrefixCache.peek``) — hit/miss stats and LRU ticks
-        only move when the fork actually happens at placement."""
+        cache-aware admission and/or QoS deadline-aware admission apply;
+        then the scan minimizes ``(slack, -coverage, position)`` — least
+        slack against the per-class deadline first (control/qos.py; 0 for
+        every request when QoS is off), longest cached prefix on
+        exact-slack ties (strict, so equal keys keep arrival order), FCFS
+        beyond that. The cache probe is read-only (``PrefixCache.peek``) —
+        hit/miss stats and LRU ticks only move when the fork actually
+        happens at placement. The starvation bound is unconditional: an
+        aged head always goes first, whatever its slack or coverage."""
         cfg = self.config
-        cache = self.prefix_cache
-        if (
-            cache is None
-            or not cfg.cache_aware_admission
-            or len(self.waiting) < 2
-        ):
+        cache = self.prefix_cache if cfg.cache_aware_admission else None
+        qos = self.qos
+        if qos is not None and not qos.config.deadline_admission:
+            qos = None
+        if (cache is None and qos is None) or len(self.waiting) < 2:
             return 0
         head = self.waiting[0]
         if (
@@ -281,14 +290,20 @@ class Scheduler:
             and self._now() - head.queued_s >= cfg.max_queue_age_s
         ):
             return 0  # starvation bound: an aged head always goes first
-        best_i, best_n = 0, -1
+        now = self._now()
+        best_i, best_key = 0, None
         for i, req in enumerate(self.waiting):
             if i >= cfg.admission_scan_limit:
                 break
             toks = req.seq_tokens
-            n = cache.peek(toks, max_tokens=len(toks) - 1) if len(toks) > 1 else 0
-            if n > best_n:
-                best_i, best_n = i, n
+            n = (
+                cache.peek(toks, max_tokens=len(toks) - 1)
+                if cache is not None and len(toks) > 1 else 0
+            )
+            slack = qos.slack(req, now) if qos is not None else 0.0
+            key = (slack, -n, i)
+            if best_key is None or key < best_key:
+                best_i, best_key = i, key
         return best_i
 
     def _unplace_failed(self, req: Request) -> None:
@@ -466,6 +481,13 @@ class Scheduler:
                     victim = self.preempt_one()
                     if victim is not None:
                         preempted.append(victim)
+                        # the victim may already sit in ``kept`` (deadline-
+                        # aware or coverage-based policies can evict an OLDER
+                        # request than the grower): its blocks are freed, so
+                        # it must leave THIS step's decode batch too, or the
+                        # dispatch reads recycled KV and appends a garbage
+                        # token to a waiting request
+                        kept = [(s, r) for s, r in kept if r is not victim]
                     if victim is None or victim is req:
                         break  # req itself evicted (or nothing left to evict)
         # keep the original slot order for dispatch determinism
@@ -495,8 +517,40 @@ class Scheduler:
         ``preempt_policy="youngest"`` — fall back to youngest-admitted, so
         the oldest request always keeps running (FCFS). The probe is the
         read-only ``PrefixCache.peek``: hit/miss stats and LRU ticks move
-        only when a replay actually forks."""
+        only when a replay actually forks.
+
+        With QoS deadline-aware preemption (control/qos.py) a slack term
+        layers ON TOP: candidates inside ``slack_guard_s`` of their class
+        deadline are excluded (evicting a request about to breach
+        guarantees the breach) unless every candidate is, and the victim
+        is the most-slack request — exact-slack ties fall back to the
+        cheapest-recompute key above, so a single class with identical
+        deadlines reduces to the pre-QoS rule."""
         cache = self.prefix_cache
+        qos = self.qos
+        if qos is not None and not qos.config.deadline_preemption:
+            qos = None
+        if qos is not None and len(running) > 1:
+            now = self._now()
+            safe = [
+                r for r in running
+                if qos.slack(r, now) >= qos.config.slack_guard_s
+            ]
+            if safe:
+                running = safe
+            probe = cache if self.config.preempt_policy != "youngest" else None
+
+            def deadline_key(r: Request):
+                toks = r.seq_tokens
+                cov = (
+                    probe.peek(toks, max_tokens=len(toks) - 1)
+                    if probe is not None and len(toks) > 1 else 0
+                )
+                return (qos.slack(r, now), cov, r._admit_seq)
+
+            victim = max(running, key=deadline_key)
+            qos.note_preempted(victim)
+            return victim
         if (
             self.config.preempt_policy == "youngest"
             or cache is None

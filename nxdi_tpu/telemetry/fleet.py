@@ -275,6 +275,9 @@ class FleetMonitor:
         # buffer) joining trace assembly next to the polled replica spans —
         # see attach_trace_source()
         self._extra_trace_sources: List[Callable[[], list]] = []  # guarded_by: _lock
+        # control/autoscaler.Autoscaler joined via attach_autoscaler():
+        # exposes /autoscale on serve() and an _autoscale snapshot block
+        self._autoscaler = None  # lock-free: attached once before serve()
         # the monitor's PERSISTENT series (edge counters survive re-merges;
         # the merged member view is rebuilt fresh on every export)
         self.registry = MetricsRegistry()
@@ -481,6 +484,20 @@ class FleetMonitor:
         with self._lock:
             self._extra_registries.append(registry)
 
+    def attach_autoscaler(self, autoscaler) -> None:
+        """Join the QoS control plane's autoscaler
+        (control/autoscaler.py): its journaled decision trace answers the
+        ``/autoscale`` federation route and rides every snapshot under
+        ``_autoscale``. Attach before :meth:`serve` — handler threads read
+        the reference without a lock."""
+        self._autoscaler = autoscaler
+
+    def autoscale_payload(self) -> dict:
+        a = self._autoscaler
+        if a is None:
+            return {"error": "no autoscaler attached", "decisions": []}
+        return a.to_dict()
+
     def attach_trace_source(self, source: Callable[[], list]) -> None:
         """Join a co-located tier's live hop-span buffer (e.g. the router's
         ``TraceBuffer.snapshot``) into :meth:`assembled_traces` — the
@@ -565,6 +582,8 @@ class FleetMonitor:
             "load_signals": [s.to_dict() for s in self.load_signals()],
             "merge_notes": notes,
         }
+        if self._autoscaler is not None:
+            snap["_autoscale"] = self._autoscaler.to_dict()
         return snap
 
     def healthz(self) -> dict:
@@ -614,6 +633,8 @@ class FleetMonitor:
              lambda: json.dumps(self.snapshot(), indent=2)),
             ("/traces", "application/json",
              lambda: json.dumps({"traces": self.assembled_traces()})),
+            ("/autoscale", "application/json",
+             lambda: json.dumps(self.autoscale_payload())),
             ("/trace.json", "application/json",
              lambda: json.dumps(self.perfetto_trace())),
             ("/metrics", PROM_CONTENT_TYPE, self.prometheus_text),

@@ -152,6 +152,12 @@ TOLERANCES: Dict[str, Tuple[str, float]] = {
 #: submit→first-token window that the assembled trace's critical path
 #: accounts for — the attribution story must explain at least 90% of the
 #: TTFT it claims to decompose, or the waterfall is decoration.
+#: qos_slo_attainment_pct_interactive: the QoS control plane's reason to
+#: exist — interactive-class SLO attainment on the mixed 3-class workload
+#: must hold an absolute floor even with 2/3 of the load being background
+#: classes; qos_fairness_jain: Jain's index over per-tenant served tokens
+#: (1.0 = even) — the scheduler may not buy that floor by starving a
+#: tenant.
 ABSOLUTE_LIMITS: Dict[str, Tuple[str, float]] = {
     "sentinel_overhead_pct": ("lower", 3.0),
     "routed_failovers": ("lower", 1.0),
@@ -159,6 +165,8 @@ ABSOLUTE_LIMITS: Dict[str, Tuple[str, float]] = {
     "chaos_goodput_retention_pct": ("higher", 70.0),
     "trace_overhead_pct": ("lower", 3.0),
     "trace_ttft_attribution_pct": ("higher", 90.0),
+    "qos_slo_attainment_pct_interactive": ("higher", 80.0),
+    "qos_fairness_jain": ("higher", 0.8),
 }
 
 
@@ -264,7 +272,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                                 "mixed_goodput_tok_s",
                                 "prefix_goodput_tok_s",
                                 "disagg_goodput_tok_s",
-                                "chaos_goodput_retention_pct")):
+                                "chaos_goodput_retention_pct",
+                                "qos_slo_attainment_pct_interactive",
+                                "autoscale_cycle_ok")):
         # a serving-, fleet-, or routed-mode FRESH record duplicates its
         # "value" headline as serving_/fleet_/routed_goodput_req_s (which
         # carry their own tolerances), and against a decode-mode baseline

@@ -1,0 +1,263 @@
+"""QoS control plane, fleet tier (nxdi_tpu/control/autoscaler.py) — the
+policy loop from smoothed load signals to replica lifecycle, driven
+step-by-step with an injected clock and a stub monitor.
+
+Every test calls ``evaluate()`` directly (no thread): one round is
+deterministic given (signals, clock), which is exactly the contract the
+journaled ``/autoscale`` trace depends on."""
+
+from nxdi_tpu.config import AutoscaleConfig
+from nxdi_tpu.control import Autoscaler
+from nxdi_tpu.telemetry.fleet import LoadSignal
+from nxdi_tpu.telemetry.registry import MetricsRegistry
+
+
+def sig(replica, queue=0.0, busy=0.0, kv=0.0, att=100.0, role="unified"):
+    # kv_blocks_used/free chosen so kv_used_frac == kv
+    return LoadSignal(
+        replica=replica,
+        queue_depth=queue,
+        slots_busy=busy,
+        kv_blocks_free=100.0 * (1.0 - kv),
+        kv_blocks_used=100.0 * kv,
+        slo_attainment_pct=att,
+        role=role,
+    )
+
+
+class StubMonitor:
+    """The two things an Autoscaler needs: a registry and load signals."""
+
+    def __init__(self, signals=()):
+        self.registry = MetricsRegistry()
+        self.signals = list(signals)
+        self.polls = 0
+
+    def poll(self):
+        self.polls += 1
+
+    def load_signals(self):
+        return list(self.signals)
+
+
+class Fleet:
+    """Actuator recorder with a warm-standby pool, mirroring how the
+    bench wires the router: scale_up undrains a parked replica."""
+
+    def __init__(self, pool=()):
+        self.pool = list(pool)
+        self.calls = []
+
+    def scale_up(self):
+        self.calls.append(("scale_up",))
+        return self.pool.pop(0) if self.pool else None
+
+    def drain(self, replica):
+        self.calls.append(("drain", replica))
+
+    def retire(self, replica):
+        self.calls.append(("retire", replica))
+
+    def rebalance(self, src, dst):
+        self.calls.append(("rebalance", src, dst))
+        return "r-converted"
+
+
+def make(mon, fleet, clock, **cfg):
+    cfg.setdefault("ewma_alpha", 1.0)  # trend == instantaneous mean
+    cfg.setdefault("cooldown_s", 0.0)
+    return Autoscaler(
+        mon,
+        AutoscaleConfig(**cfg),
+        scale_up=fleet.scale_up,
+        drain=fleet.drain,
+        retire=fleet.retire,
+        rebalance=fleet.rebalance,
+        wall_clock=lambda: clock["t"],
+    )
+
+
+def actions(decisions):
+    return [d.action for d in decisions]
+
+
+def test_trend_crossing_high_watermark_scales_up():
+    mon = StubMonitor([sig("r0", queue=10.0, busy=4.0)])
+    fleet = Fleet(pool=["r1"])
+    a = make(mon, fleet, {"t": 0.0},
+             scale_up_score=6.0, scale_down_score=1.5, max_replicas=2)
+    ds = a.evaluate()
+    assert actions(ds) == ["scale_up"]
+    assert ds[0].replica == "r1" and fleet.calls == [("scale_up",)]
+    assert a.decisions_total.value(action="scale_up") == 1.0
+    # at max_replicas no further scale-up, however hot the trend
+    mon.signals.append(sig("r1", queue=10.0, busy=4.0))
+    assert a.evaluate() == []
+
+
+def test_ewma_smoothing_delays_the_crossing():
+    # an idle-seeded trend absorbs a sustained spike over several rounds
+    # instead of reacting to the first sample — the anti-flap half of the
+    # hysteresis story
+    mon = StubMonitor([sig("r0")])
+    fleet = Fleet(pool=["r1"])
+    clock = {"t": 0.0}
+    a = make(mon, fleet, clock, ewma_alpha=0.5,
+             scale_up_score=6.0, scale_down_score=1.5, max_replicas=2)
+    assert a.evaluate() == []          # seeds trend at the idle mean: 0.0
+    mon.signals = [sig("r0", queue=8.0)]
+    clock["t"] = 1.0
+    assert a.evaluate() == []          # trend 4.0: spike absorbed
+    clock["t"] = 2.0
+    assert a.evaluate() == []          # trend 6.0: at, not above, the mark
+    clock["t"] = 3.0
+    ds = a.evaluate()                  # trend 7.0 > 6.0: NOW it scales
+    assert actions(ds) == ["scale_up"] and ds[0].replica == "r1"
+
+
+def test_hysteresis_band_holds():
+    # trend inside (scale_down_score, scale_up_score] -> no action at all
+    mon = StubMonitor([sig("r0", queue=3.0), sig("r1", queue=3.0)])
+    fleet = Fleet(pool=["r2"])
+    a = make(mon, fleet, {"t": 0.0},
+             scale_up_score=6.0, scale_down_score=1.5, max_replicas=3)
+    for _ in range(5):
+        assert a.evaluate() == []
+    assert fleet.calls == []
+
+
+def test_drain_picks_least_loaded_and_cooldown_blocks():
+    mon = StubMonitor([sig("r0", queue=2.0), sig("r1", queue=0.0)])
+    fleet = Fleet()
+    clock = {"t": 100.0}
+    a = make(mon, fleet, clock,
+             scale_up_score=6.0, scale_down_score=1.5,
+             min_replicas=1, cooldown_s=10.0)
+    ds = a.evaluate()
+    assert actions(ds) == ["drain"] and ds[0].replica == "r1"
+    assert fleet.calls == [("drain", "r1")]
+    assert a.draining() == ["r1"]
+    # r1 still busy: no retire, and the cooldown stamps out more scaling
+    mon.signals = [sig("r0", queue=0.0), sig("r1", queue=0.0, busy=1.0)]
+    clock["t"] = 105.0
+    assert a.evaluate() == []
+    # cooldown expired -> r0 would drain next, but min_replicas=1 holds it
+    clock["t"] = 111.0
+    assert a.evaluate() == []
+
+
+def test_retire_is_cooldown_exempt_and_parks_standby():
+    mon = StubMonitor([sig("r0", queue=2.0), sig("r1")])
+    fleet = Fleet()
+    clock = {"t": 0.0}
+    a = make(mon, fleet, clock,
+             scale_up_score=50.0, scale_down_score=1.5,
+             min_replicas=1, cooldown_s=60.0)
+    assert actions(a.evaluate()) == ["drain"]      # r1 drains (least loaded)
+    # next round, deep inside the cooldown: r1 reads empty -> retire fires
+    clock["t"] = 1.0
+    ds = a.evaluate()
+    assert actions(ds) == ["retire"] and ds[0].replica == "r1"
+    assert fleet.calls[-1] == ("retire", "r1")
+    assert a.draining() == [] and a.standby() == ["r1"]
+    # parked: r1 neither counts as active nor feeds the trend
+    mon.signals = [sig("r0", queue=2.0), sig("r1", queue=99.0)]
+    clock["t"] = 2.0
+    a.evaluate()
+    assert a.to_dict()["signal_trend"] == 2.0  # r1's 99 ignored
+    assert a.replicas_target.value() == 1.0
+
+
+def test_scale_up_reactivates_standby():
+    mon = StubMonitor([sig("r0", queue=10.0), sig("r1", queue=10.0)])
+    fleet = Fleet(pool=["r1"])
+    a = Autoscaler(
+        mon,
+        AutoscaleConfig(ewma_alpha=1.0, cooldown_s=0.0,
+                        scale_up_score=6.0, scale_down_score=1.5,
+                        max_replicas=2),
+        scale_up=fleet.scale_up,
+        standby=["r1"],
+        wall_clock=lambda: 0.0,
+    )
+    assert a.standby() == ["r1"]
+    # r1 is parked, so active == 1 < max even though both replicas report
+    ds = a.evaluate()
+    assert actions(ds) == ["scale_up"] and ds[0].replica == "r1"
+    assert a.standby() == []
+    assert a.replicas_target.value() == 2.0
+
+
+def test_rebalance_both_directions_with_flattened_extra():
+    fleet = Fleet()
+    # prefill pressure 8x decode, two decode replicas to take from
+    mon = StubMonitor([
+        sig("p0", queue=2.0, role="prefill"),
+        sig("d0", queue=1.0, role="decode"),
+        sig("d1", queue=1.0, role="decode"),
+    ])
+    a = make(mon, fleet, {"t": 0.0},
+             scale_up_score=100.0, scale_down_score=0.0,
+             rebalance_ratio=2.0, max_replicas=8)
+    ds = a.evaluate()
+    assert actions(ds) == ["rebalance"]
+    assert fleet.calls == [("rebalance", "decode", "prefill")]
+    row = ds[0].to_dict()
+    # the trace row FLATTENS extra keys — the cli.fleet renderer contract
+    assert row["from_role"] == "decode" and row["to_role"] == "prefill"
+
+    # opposite skew converts the other way (needs >1 prefill replica)
+    fleet2 = Fleet()
+    mon2 = StubMonitor([
+        sig("p0", queue=0.1, role="prefill"),
+        sig("p1", queue=0.1, role="prefill"),
+        sig("d0", queue=4.0, role="decode"),
+    ])
+    a2 = make(mon2, fleet2, {"t": 0.0},
+              scale_up_score=100.0, scale_down_score=0.0,
+              rebalance_ratio=2.0, max_replicas=8)
+    assert actions(a2.evaluate()) == ["rebalance"]
+    assert fleet2.calls == [("rebalance", "prefill", "decode")]
+
+
+def test_decision_ring_is_bounded_oldest_first():
+    mon = StubMonitor([sig("p0", role="prefill"),
+                       sig("p1", role="prefill"),
+                       sig("d0", queue=4.0, role="decode")])
+    fleet = Fleet()
+    clock = {"t": 0.0}
+    a = make(mon, fleet, clock,
+             scale_up_score=100.0, scale_down_score=0.0,
+             rebalance_ratio=2.0, max_replicas=8, decision_ring=4)
+    for i in range(10):
+        clock["t"] = float(i)
+        a.evaluate()
+    log = a.snapshot_log()
+    assert len(log) == 4  # bounded
+    assert [d["t"] for d in log] == [6.0, 7.0, 8.0, 9.0]  # oldest first
+    assert a.decisions_total.value(action="rebalance") == 10.0
+
+
+def test_counters_preseeded_and_config_validated():
+    import pytest
+
+    mon = StubMonitor()
+    a = Autoscaler(mon, AutoscaleConfig(), wall_clock=lambda: 0.0)
+    for action in ("scale_up", "drain", "retire", "rebalance"):
+        assert a.decisions_total.value(action=action) == 0.0
+    snap = mon.registry.snapshot()
+    assert "nxdi_autoscale_decisions_total" in snap
+    assert "nxdi_autoscale_replicas_target" in snap
+    # no actuators wired -> every round is a safe no-op
+    mon.signals = [sig("r0", queue=50.0)]
+    assert a.evaluate() == []
+    d = a.to_dict()
+    assert set(d) == {"config", "signal_trend", "draining", "standby",
+                      "decisions"}
+
+    with pytest.raises(ValueError):
+        AutoscaleConfig(scale_up_score=1.0, scale_down_score=2.0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(min_replicas=0)
+    with pytest.raises(ValueError):
+        AutoscaleConfig(ewma_alpha=1.5)

@@ -306,6 +306,54 @@ def test_chaos_retention_absolute_gate(tmp_path):
     assert rc == 0
 
 
+def test_qos_metrics_absolute_gate(tmp_path):
+    """bench.py --serving --multi-tenant emits the QoS control-plane
+    headline pair, both ABSOLUTE-gated (no baseline needed): interactive
+    attainment >= 80 and Jain fairness >= 0.8. The generic 'value' row
+    (the attainment pct) is suppressed so it never gates against a
+    decode-mode tok/s baseline."""
+    qos = {
+        "value": 96.0,
+        "qos_slo_attainment_pct_interactive": 96.0,
+        "qos_slo_attainment_pct_batch": 100.0,
+        "qos_fairness_jain": 0.97,
+        "qos_goodput_tok_s": 800.0,
+    }
+    # pre-QoS baseline (decode-mode BASE): qos_* comparisons skip, the
+    # suppressed "value" row cannot fail, both ABSOLUTE floors pass
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", qos),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 0
+
+    # interactive attainment under the 80 floor fails ABSOLUTELY
+    breached = dict(qos, value=60.0,
+                    qos_slo_attainment_pct_interactive=60.0)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", breached),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 1
+
+    # a starved tenant (Jain under 0.8) fails even with attainment held
+    unfair = dict(qos, qos_fairness_jain=0.55)
+    rc = bench_gate.main([
+        _write(tmp_path, "fresh.json", unfair),
+        "--baseline", _write(tmp_path, "base_old.json", BASE),
+        "-q",
+    ])
+    assert rc == 1
+
+    # a missing side (autoscale-mode record, say) skips both floors
+    rows, _ = bench_gate.check_absolute(
+        {"autoscale_cycle_ok": True}, bench_gate.ABSOLUTE_LIMITS
+    )
+    assert not any(r["metric"].startswith("qos_") for r in rows)
+
+
 def test_mixed_metrics_gate_and_skip_when_absent(tmp_path):
     """bench.py --serving --mixed-dispatch emits mixed_* headline fields:
     one-sided gating (goodput higher, padding waste lower), skipped against
